@@ -1,0 +1,53 @@
+"""RuntimeConfig — a Config with everything pre-built for the hot path.
+
+Equivalent of the reference's ``filterapi.RuntimeConfig``
+(filterapi/runtime.go:29-73): auth handlers constructed, cost expressions
+compiled, routes indexed — so per-request processing never touches parsing
+or compilation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from aigw_tpu.config.model import Backend, Config, Route
+
+
+@dataclass
+class RuntimeBackend:
+    """A Backend plus its pre-built auth handler."""
+
+    backend: Backend
+    auth_handler: Any  # aigw_tpu.gateway.auth.AuthHandler
+
+
+@dataclass
+class RuntimeConfig:
+    config: Config
+    backends: dict[str, RuntimeBackend] = field(default_factory=dict)
+    cost_calculator: Any = None  # aigw_tpu.gateway.costs.CostCalculator
+
+    @staticmethod
+    def build(config: Config) -> "RuntimeConfig":
+        # Local imports keep aigw_tpu.config importable without the gateway
+        # package (mirrors the filterapi/extproc layering of the reference).
+        from aigw_tpu.gateway.auth import new_handler
+        from aigw_tpu.gateway.costs import CostCalculator
+
+        config.validate()
+        rc = RuntimeConfig(config=config)
+        for b in config.backends:
+            rc.backends[b.name] = RuntimeBackend(
+                backend=b, auth_handler=new_handler(b.auth)
+            )
+        rc.cost_calculator = CostCalculator.from_config(config)
+        return rc
+
+    def routes_for_host(self, host: str) -> list[Route]:
+        host = host.split(":")[0].lower()
+        out = []
+        for r in self.config.routes:
+            if not r.hostnames or host in r.hostnames:
+                out.append(r)
+        return out

@@ -1,0 +1,16 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU platform.
+
+Multi-chip hardware is not available in CI; shardings are validated on a
+virtual CPU mesh (``--xla_force_host_platform_device_count=8``), the same
+way the driver's ``dryrun_multichip`` does. Must run before jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

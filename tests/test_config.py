@@ -1,0 +1,129 @@
+"""Config model / bundle / runtime tests (reference test model:
+internal/filterapi/*_test.go golden-compile style)."""
+
+import json
+import os
+
+import pytest
+
+from aigw_tpu.config import (
+    APISchemaName,
+    Config,
+    ConfigError,
+    RuntimeConfig,
+    read_bundle,
+    write_bundle,
+)
+from aigw_tpu.config.model import MODEL_NAME_HEADER, load_config
+
+BASIC = {
+    "version": "v1",
+    "backends": [
+        {
+            "name": "openai",
+            "schema": "OpenAI",
+            "url": "https://api.openai.com",
+            "auth": {"kind": "APIKey", "api_key": "sk-test"},
+        },
+        {
+            "name": "tpu",
+            "schema": "TPUServe",
+            "url": "http://127.0.0.1:8011",
+        },
+    ],
+    "routes": [
+        {
+            "name": "chat",
+            "rules": [
+                {"models": ["llama-3-8b"], "backends": [{"backend": "tpu"}]},
+                {
+                    "models": ["gpt-4o"],
+                    "backends": [
+                        {"backend": "openai", "weight": 9},
+                        {"backend": "tpu", "weight": 1, "priority": 1},
+                    ],
+                },
+            ],
+        }
+    ],
+    "models": ["llama-3-8b", {"name": "gpt-4o", "owned_by": "openai"}],
+    "llm_request_costs": [
+        {"metadata_key": "total", "type": "TotalToken"},
+        {
+            "metadata_key": "weighted",
+            "type": "Expression",
+            "expression": "input_tokens + 4 * output_tokens",
+        },
+    ],
+}
+
+
+def test_parse_roundtrip():
+    cfg = Config.parse(BASIC)
+    assert cfg.backend("openai").schema.name is APISchemaName.OPENAI
+    assert cfg.backend("tpu").schema.name is APISchemaName.TPUSERVE
+    again = Config.parse(cfg.to_dict())
+    assert again == cfg
+    assert again.checksum() == cfg.checksum()
+
+
+def test_rule_matching():
+    cfg = Config.parse(BASIC)
+    rule = cfg.routes[0].rules[0]
+    assert rule.matches({MODEL_NAME_HEADER: "llama-3-8b"})
+    assert not rule.matches({MODEL_NAME_HEADER: "gpt-4o"})
+
+
+def test_unknown_backend_rejected():
+    bad = json.loads(json.dumps(BASIC))
+    bad["routes"][0]["rules"][0]["backends"] = [{"backend": "nope"}]
+    with pytest.raises(ConfigError, match="unknown backend"):
+        Config.parse(bad)
+
+
+def test_version_gate():
+    bad = dict(BASIC, version="v999")
+    with pytest.raises(ConfigError, match="version"):
+        Config.parse(bad)
+
+
+def test_duplicate_backends_rejected():
+    bad = json.loads(json.dumps(BASIC))
+    bad["backends"].append(bad["backends"][0])
+    with pytest.raises(ConfigError, match="duplicate"):
+        Config.parse(bad)
+
+
+def test_yaml_load(tmp_path):
+    p = tmp_path / "config.yaml"
+    p.write_text(json.dumps(BASIC))  # JSON is valid YAML
+    cfg = load_config(str(p))
+    assert len(cfg.backends) == 2
+
+
+def test_bundle_roundtrip(tmp_path):
+    cfg = Config.parse(BASIC)
+    d = str(tmp_path / "bundle")
+    write_bundle(cfg, d, part_size=64)  # force multiple parts
+    assert len(os.listdir(d)) > 2
+    got = read_bundle(d)
+    assert got.backends == cfg.backends
+    assert got.uuid  # assigned
+
+
+def test_bundle_checksum_gate(tmp_path):
+    cfg = Config.parse(BASIC)
+    d = str(tmp_path / "bundle")
+    write_bundle(cfg, d, part_size=64)
+    # Corrupt one part: load must fail, not deliver a broken config.
+    with open(os.path.join(d, "part-1.json"), "ab") as f:
+        f.write(b"x")
+    with pytest.raises(ConfigError, match="checksum"):
+        read_bundle(d)
+
+
+def test_runtime_config_build():
+    rc = RuntimeConfig.build(Config.parse(BASIC))
+    assert set(rc.backends) == {"openai", "tpu"}
+    assert rc.cost_calculator is not None
+    assert rc.routes_for_host("anything.example.com")

@@ -1,0 +1,169 @@
+"""Cost engine + auth handler tests (reference internal/llmcostcel/cel_test.go,
+internal/backendauth/*_test.go)."""
+
+import pytest
+
+from aigw_tpu.config.model import AuthConfig, ConfigError
+from aigw_tpu.gateway.auth import AuthError, new_handler
+from aigw_tpu.gateway.costs import CostCalculator, CostProgram, TokenUsage
+from aigw_tpu.config.model import LLMRequestCost, LLMRequestCostType
+
+
+def usage(i=10, o=20):
+    return TokenUsage(input_tokens=i, output_tokens=o, total_tokens=i + o)
+
+
+class TestCostProgram:
+    def test_basic(self):
+        p = CostProgram("input_tokens + 4 * output_tokens")
+        assert p.evaluate(usage()) == 10 + 80
+
+    def test_conditional_on_model(self):
+        p = CostProgram("total_tokens * 2 if model == 'gpt-4o' else total_tokens")
+        assert p.evaluate(usage(), model="gpt-4o") == 60
+        assert p.evaluate(usage(), model="other") == 30
+
+    def test_min_max(self):
+        p = CostProgram("max(1, min(output_tokens, 5))")
+        assert p.evaluate(usage()) == 5
+
+    def test_rejects_attribute_access(self):
+        with pytest.raises(ConfigError, match="disallowed"):
+            CostProgram("().__class__")
+
+    def test_rejects_unknown_names(self):
+        with pytest.raises(ConfigError, match="unknown variable"):
+            CostProgram("__import__ + secret_var")
+
+    def test_rejects_arbitrary_calls(self):
+        with pytest.raises(ConfigError):
+            CostProgram("open('/etc/passwd')")
+
+    def test_bad_syntax_fails_at_compile(self):
+        with pytest.raises(ConfigError):
+            CostProgram("1 +")
+
+
+class TestTokenUsage:
+    def test_override_merge(self):
+        a = TokenUsage(input_tokens=5, output_tokens=1, total_tokens=6)
+        b = TokenUsage(output_tokens=9, total_tokens=14)
+        m = a.merge_override(b)
+        # last stream chunk wins for present fields (processor_impl.go:556-574)
+        assert (m.input_tokens, m.output_tokens, m.total_tokens) == (5, 9, 14)
+
+
+class TestCostCalculator:
+    def test_calculate(self):
+        calc = CostCalculator(
+            (
+                LLMRequestCost("in", LLMRequestCostType.INPUT_TOKEN),
+                LLMRequestCost("out", LLMRequestCostType.OUTPUT_TOKEN),
+                LLMRequestCost(
+                    "expr", LLMRequestCostType.EXPRESSION, "total_tokens // 2"
+                ),
+            )
+        )
+        got = calc.calculate(usage(), model="m", backend="b")
+        assert got == {"in": 10, "out": 20, "expr": 15}
+
+
+class TestAuthHandlers:
+    def test_api_key(self):
+        h = new_handler(AuthConfig.parse({"kind": "APIKey", "api_key": "sk-1"}))
+        headers, path = h.apply({}, b"{}", "/v1/chat/completions")
+        assert headers["authorization"] == "Bearer sk-1"
+
+    def test_api_key_file(self, tmp_path):
+        p = tmp_path / "key"
+        p.write_text("sk-from-file\n")
+        h = new_handler(
+            AuthConfig.parse({"kind": "APIKey", "api_key": f"file:{p}"})
+        )
+        headers, _ = h.apply({}, b"", "/")
+        assert headers["authorization"] == "Bearer sk-from-file"
+        # rotation: rewrite the file, handler picks it up
+        import os, time
+
+        p.write_text("sk-rotated")
+        os.utime(p, (time.time() + 5, time.time() + 5))
+        headers, _ = h.apply({}, b"", "/")
+        assert headers["authorization"] == "Bearer sk-rotated"
+
+    def test_missing_key_raises(self):
+        h = new_handler(AuthConfig.parse({"kind": "APIKey"}))
+        with pytest.raises(AuthError):
+            h.apply({}, b"", "/")
+
+    def test_anthropic(self):
+        h = new_handler(
+            AuthConfig.parse({"kind": "AnthropicAPIKey", "api_key": "ak"})
+        )
+        headers, _ = h.apply({"authorization": "Bearer leak"}, b"", "/v1/messages")
+        assert headers["x-api-key"] == "ak"
+        assert headers["anthropic-version"] == "2023-06-01"
+        assert "authorization" not in headers
+
+    def test_azure(self):
+        h = new_handler(
+            AuthConfig.parse({"kind": "AzureAPIKey", "azure_api_key": "zk"})
+        )
+        headers, _ = h.apply({}, b"", "/")
+        assert headers["api-key"] == "zk"
+
+    def test_gcp_path_rewrite(self):
+        h = new_handler(
+            AuthConfig.parse(
+                {
+                    "kind": "GCPToken",
+                    "gcp_access_token": "tok",
+                    "gcp_project": "proj-1",
+                    "gcp_region": "us-central1",
+                }
+            )
+        )
+        headers, path = h.apply(
+            {}, b"", "/v1/projects/{GCP_PROJECT}/locations/{GCP_REGION}/x"
+        )
+        assert path == "/v1/projects/proj-1/locations/us-central1/x"
+        assert headers["authorization"] == "Bearer tok"
+
+    def test_sigv4_deterministic_shape(self):
+        h = new_handler(
+            AuthConfig.parse(
+                {
+                    "kind": "AWSSigV4",
+                    "aws_access_key_id": "AKID",
+                    "aws_secret_access_key": "SECRET",
+                    "aws_region": "us-east-1",
+                }
+            )
+        )
+        headers, _ = h.apply(
+            {"host": "bedrock-runtime.us-east-1.amazonaws.com"},
+            b'{"x":1}',
+            "/model/m/converse",
+        )
+        auth = headers["authorization"]
+        assert auth.startswith("AWS4-HMAC-SHA256 Credential=AKID/")
+        assert "SignedHeaders=host;x-amz-date" in auth
+        assert "Signature=" in auth
+        assert "x-amz-date" in headers
+
+    def test_sigv4_body_changes_signature(self):
+        cfg = AuthConfig.parse(
+            {
+                "kind": "AWSSigV4",
+                "aws_access_key_id": "AKID",
+                "aws_secret_access_key": "SECRET",
+                "aws_region": "us-east-1",
+            }
+        )
+        h = new_handler(cfg)
+        base = {"host": "h", "x-amz-date": "20260101T000000Z"}
+        h1, _ = h.apply(dict(base), b"a", "/p")
+        h2, _ = h.apply(dict(base), b"b", "/p")
+        # the body hash is signed → retries must re-sign after retranslation
+        sig1 = h1["authorization"].split("Signature=")[1]
+        sig2 = h2["authorization"].split("Signature=")[1]
+        assert sig1 != sig2
