@@ -1,0 +1,212 @@
+"""OpenAI API schema helpers (reference internal/apischema/openai/openai.go).
+
+Covers the endpoint surface the gateway fronts: chat completions (incl.
+streaming chunks and tool calls), legacy completions, embeddings, models
+list, tokenize (vLLM-compatible), plus error bodies and usage extraction.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Any, Iterable
+
+from aigw_tpu.gateway.costs import TokenUsage
+
+
+class SchemaError(ValueError):
+    """Client-facing 400: malformed request body."""
+
+
+# ---------------------------------------------------------------------------
+# Requests
+# ---------------------------------------------------------------------------
+
+
+def parse_json_body(body: bytes) -> dict[str, Any]:
+    try:
+        data = json.loads(body)
+    except json.JSONDecodeError as e:
+        raise SchemaError(f"invalid JSON body: {e}") from None
+    if not isinstance(data, dict):
+        raise SchemaError("request body must be a JSON object")
+    return data
+
+
+def request_model(body: dict[str, Any]) -> str:
+    model = body.get("model")
+    if not isinstance(model, str) or not model:
+        raise SchemaError("missing required field: model")
+    return model
+
+
+def request_stream(body: dict[str, Any]) -> bool:
+    return bool(body.get("stream", False))
+
+
+def include_stream_usage(body: dict[str, Any]) -> bool:
+    opts = body.get("stream_options") or {}
+    return bool(opts.get("include_usage", False))
+
+
+def message_content_text(content: Any) -> str:
+    """Flatten the string-or-parts content union to text
+    (the union type the reference custom-unmarshals, openai.go)."""
+    if content is None:
+        return ""
+    if isinstance(content, str):
+        return content
+    if isinstance(content, list):
+        out = []
+        for part in content:
+            if isinstance(part, dict) and part.get("type") == "text":
+                out.append(str(part.get("text", "")))
+        return "".join(out)
+    raise SchemaError(f"invalid message content type {type(content).__name__}")
+
+
+def validate_chat_request(body: dict[str, Any]) -> None:
+    request_model(body)
+    messages = body.get("messages")
+    if not isinstance(messages, list) or not messages:
+        raise SchemaError("messages must be a non-empty array")
+    for i, m in enumerate(messages):
+        if not isinstance(m, dict):
+            raise SchemaError(f"messages[{i}] must be an object")
+        role = m.get("role")
+        if role not in ("system", "developer", "user", "assistant", "tool"):
+            raise SchemaError(f"messages[{i}] has invalid role {role!r}")
+
+
+# ---------------------------------------------------------------------------
+# Responses
+# ---------------------------------------------------------------------------
+
+
+def extract_usage(body: dict[str, Any]) -> TokenUsage:
+    """OpenAI usage object → TokenUsage (incl. details fields)."""
+    u = body.get("usage")
+    if not isinstance(u, dict):
+        return TokenUsage()
+    prompt_details = u.get("prompt_tokens_details") or {}
+    completion_details = u.get("completion_tokens_details") or {}
+    return TokenUsage(
+        input_tokens=int(u.get("prompt_tokens", 0) or 0),
+        output_tokens=int(u.get("completion_tokens", 0) or 0),
+        total_tokens=int(u.get("total_tokens", 0) or 0),
+        cached_input_tokens=int(prompt_details.get("cached_tokens", 0) or 0),
+        reasoning_tokens=int(completion_details.get("reasoning_tokens", 0) or 0),
+    )
+
+
+def usage_dict(usage: TokenUsage) -> dict[str, Any]:
+    d: dict[str, Any] = {
+        "prompt_tokens": usage.input_tokens,
+        "completion_tokens": usage.output_tokens,
+        "total_tokens": usage.total_tokens
+        or usage.input_tokens + usage.output_tokens,
+    }
+    if usage.cached_input_tokens:
+        d["prompt_tokens_details"] = {"cached_tokens": usage.cached_input_tokens}
+    if usage.reasoning_tokens:
+        d["completion_tokens_details"] = {
+            "reasoning_tokens": usage.reasoning_tokens
+        }
+    return d
+
+
+def chat_completion_response(
+    *,
+    model: str,
+    content: str,
+    finish_reason: str = "stop",
+    usage: TokenUsage | None = None,
+    tool_calls: list[dict[str, Any]] | None = None,
+    response_id: str = "",
+) -> dict[str, Any]:
+    message: dict[str, Any] = {"role": "assistant", "content": content}
+    if tool_calls:
+        message["tool_calls"] = tool_calls
+        if not content:
+            message["content"] = None
+    return {
+        "id": response_id or f"chatcmpl-{uuid.uuid4().hex[:24]}",
+        "object": "chat.completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [
+            {"index": 0, "message": message, "finish_reason": finish_reason}
+        ],
+        "usage": usage_dict(usage or TokenUsage()),
+    }
+
+
+def chat_completion_chunk(
+    *,
+    response_id: str,
+    model: str,
+    delta: dict[str, Any] | None = None,
+    finish_reason: str | None = None,
+    usage: TokenUsage | None = None,
+    created: int = 0,
+) -> dict[str, Any]:
+    chunk: dict[str, Any] = {
+        "id": response_id,
+        "object": "chat.completion.chunk",
+        "created": created or int(time.time()),
+        "model": model,
+        "choices": [],
+    }
+    if delta is not None or finish_reason is not None:
+        chunk["choices"] = [
+            {
+                "index": 0,
+                "delta": delta if delta is not None else {},
+                "finish_reason": finish_reason,
+            }
+        ]
+    if usage is not None:
+        chunk["usage"] = usage_dict(usage)
+    return chunk
+
+
+def embeddings_response(
+    *, model: str, vectors: Iterable[list[float]], usage: TokenUsage
+) -> dict[str, Any]:
+    return {
+        "object": "list",
+        "model": model,
+        "data": [
+            {"object": "embedding", "index": i, "embedding": v}
+            for i, v in enumerate(vectors)
+        ],
+        "usage": {
+            "prompt_tokens": usage.input_tokens,
+            "total_tokens": usage.total_tokens or usage.input_tokens,
+        },
+    }
+
+
+def models_response(models: Iterable[tuple[str, str, int]]) -> dict[str, Any]:
+    """(name, owned_by, created) triples → /v1/models body."""
+    return {
+        "object": "list",
+        "data": [
+            {
+                "id": name,
+                "object": "model",
+                "created": created or int(time.time()),
+                "owned_by": owned_by,
+            }
+            for name, owned_by, created in models
+        ],
+    }
+
+
+def error_body(message: str, type_: str = "invalid_request_error", code: Any = None) -> bytes:
+    """OpenAI-format error envelope. The gateway wraps upstream errors the
+    same way the reference does (internalapi user-facing error wrapper)."""
+    return json.dumps(
+        {"error": {"message": message, "type": type_, "code": code}}
+    ).encode()

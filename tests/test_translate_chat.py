@@ -1,0 +1,483 @@
+"""Translator matrix tests — golden request/response pairs per schema pair
+(reference model: internal/translator/openai_awsbedrock_test.go etc.)."""
+
+import json
+
+import pytest
+
+from aigw_tpu.config.model import APISchemaName as S
+from aigw_tpu.translate import Endpoint, get_translator
+from aigw_tpu.translate.eventstream import encode_message
+from aigw_tpu.translate.sse import SSEParser
+
+CHAT_REQ = {
+    "model": "m-1",
+    "messages": [
+        {"role": "system", "content": "be brief"},
+        {"role": "user", "content": "hi"},
+    ],
+    "max_tokens": 64,
+    "temperature": 0.5,
+}
+
+TOOL_REQ = {
+    "model": "m-1",
+    "messages": [
+        {"role": "user", "content": "weather in SF?"},
+        {
+            "role": "assistant",
+            "content": None,
+            "tool_calls": [
+                {
+                    "id": "call_1",
+                    "type": "function",
+                    "function": {
+                        "name": "get_weather",
+                        "arguments": '{"city": "SF"}',
+                    },
+                }
+            ],
+        },
+        {"role": "tool", "tool_call_id": "call_1", "content": "sunny"},
+    ],
+    "tools": [
+        {
+            "type": "function",
+            "function": {
+                "name": "get_weather",
+                "description": "get weather",
+                "parameters": {
+                    "type": "object",
+                    "properties": {"city": {"type": "string"}},
+                },
+            },
+        }
+    ],
+}
+
+
+def sse_events(body: bytes):
+    p = SSEParser()
+    return p.feed(body) + p.flush()
+
+
+class TestOpenAIToAnthropic:
+    def test_request_golden(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI, S.ANTHROPIC)
+        tx = t.request(json.loads(json.dumps(CHAT_REQ)))
+        body = json.loads(tx.body)
+        assert tx.path == "/v1/messages"
+        assert body["system"] == "be brief"
+        assert body["messages"] == [
+            {"role": "user", "content": [{"type": "text", "text": "hi"}]}
+        ]
+        assert body["max_tokens"] == 64
+        assert body["temperature"] == 0.5
+
+    def test_request_tools(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI, S.ANTHROPIC)
+        body = json.loads(t.request(json.loads(json.dumps(TOOL_REQ))).body)
+        assert body["tools"][0]["name"] == "get_weather"
+        assert body["tools"][0]["input_schema"]["type"] == "object"
+        # assistant tool_use then user tool_result
+        assert body["messages"][1]["content"][0]["type"] == "tool_use"
+        assert body["messages"][1]["content"][0]["input"] == {"city": "SF"}
+        assert body["messages"][2]["content"][0]["type"] == "tool_result"
+
+    def test_response_golden(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI, S.ANTHROPIC)
+        t.request(json.loads(json.dumps(CHAT_REQ)))
+        upstream = {
+            "id": "msg_01",
+            "type": "message",
+            "role": "assistant",
+            "model": "claude-3-5",
+            "content": [{"type": "text", "text": "hello!"}],
+            "stop_reason": "end_turn",
+            "usage": {"input_tokens": 9, "output_tokens": 3},
+        }
+        rx = t.response_body(json.dumps(upstream).encode(), True)
+        got = json.loads(rx.body)
+        assert got["object"] == "chat.completion"
+        assert got["choices"][0]["message"]["content"] == "hello!"
+        assert got["choices"][0]["finish_reason"] == "stop"
+        assert got["usage"] == {
+            "prompt_tokens": 9,
+            "completion_tokens": 3,
+            "total_tokens": 12,
+        }
+        assert rx.usage.input_tokens == 9 and rx.usage.output_tokens == 3
+
+    def test_response_tool_use(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI, S.ANTHROPIC)
+        t.request(json.loads(json.dumps(TOOL_REQ)))
+        upstream = {
+            "model": "c",
+            "content": [
+                {"type": "tool_use", "id": "tu_1", "name": "get_weather",
+                 "input": {"city": "SF"}}
+            ],
+            "stop_reason": "tool_use",
+            "usage": {"input_tokens": 5, "output_tokens": 7},
+        }
+        got = json.loads(t.response_body(json.dumps(upstream).encode(), True).body)
+        msg = got["choices"][0]["message"]
+        assert msg["tool_calls"][0]["function"]["name"] == "get_weather"
+        assert json.loads(msg["tool_calls"][0]["function"]["arguments"]) == {
+            "city": "SF"
+        }
+        assert got["choices"][0]["finish_reason"] == "tool_calls"
+
+    def test_streaming_conversion(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI, S.ANTHROPIC)
+        req = dict(CHAT_REQ, stream=True,
+                   stream_options={"include_usage": True})
+        tx = t.request(json.loads(json.dumps(req)))
+        assert json.loads(tx.body)["stream"] is True
+
+        events = [
+            ("message_start", {"type": "message_start", "message": {
+                "model": "claude-3-5",
+                "usage": {"input_tokens": 9, "output_tokens": 0}}}),
+            ("content_block_start", {"type": "content_block_start", "index": 0,
+                                     "content_block": {"type": "text", "text": ""}}),
+            ("content_block_delta", {"type": "content_block_delta", "index": 0,
+                                     "delta": {"type": "text_delta", "text": "he"}}),
+            ("content_block_delta", {"type": "content_block_delta", "index": 0,
+                                     "delta": {"type": "text_delta", "text": "llo"}}),
+            ("content_block_stop", {"type": "content_block_stop", "index": 0}),
+            ("message_delta", {"type": "message_delta",
+                               "delta": {"stop_reason": "end_turn"},
+                               "usage": {"output_tokens": 2}}),
+            ("message_stop", {"type": "message_stop"}),
+        ]
+        raw = b"".join(
+            f"event: {n}\ndata: {json.dumps(d)}\n\n".encode() for n, d in events
+        )
+        # feed in awkward chunk boundaries to exercise incremental parsing
+        out = b""
+        usage = None
+        for i in range(0, len(raw), 37):
+            rx = t.response_body(raw[i : i + 37], False)
+            out += rx.body
+            if rx.usage.total_tokens:
+                usage = rx.usage
+        rx = t.response_body(b"", True)
+        out += rx.body
+
+        got = sse_events(out)
+        datas = [e.data for e in got]
+        assert datas[-1] == "[DONE]"
+        chunks = [json.loads(d) for d in datas if d != "[DONE]"]
+        text = "".join(
+            c["choices"][0]["delta"].get("content", "")
+            for c in chunks
+            if c["choices"]
+        )
+        assert text == "hello"
+        finishes = [
+            c["choices"][0]["finish_reason"]
+            for c in chunks
+            if c["choices"] and c["choices"][0]["finish_reason"]
+        ]
+        assert finishes == ["stop"]
+        assert usage is not None
+        assert usage.input_tokens == 9 and usage.output_tokens == 2
+        # usage chunk present because include_usage was set
+        assert any(c.get("usage", {}).get("total_tokens") == 11 for c in chunks)
+
+
+class TestAnthropicToOpenAI:
+    REQ = {
+        "model": "claude-x",
+        "max_tokens": 100,
+        "system": "be brief",
+        "messages": [{"role": "user", "content": "hi"}],
+    }
+
+    def test_request_golden(self):
+        t = get_translator(Endpoint.MESSAGES, S.ANTHROPIC, S.OPENAI)
+        tx = t.request(json.loads(json.dumps(self.REQ)))
+        body = json.loads(tx.body)
+        assert tx.path == "/v1/chat/completions"
+        assert body["messages"][0] == {"role": "system", "content": "be brief"}
+        assert body["messages"][1] == {"role": "user", "content": "hi"}
+        assert body["max_tokens"] == 100
+
+    def test_response_golden(self):
+        t = get_translator(Endpoint.MESSAGES, S.ANTHROPIC, S.OPENAI)
+        t.request(json.loads(json.dumps(self.REQ)))
+        upstream = {
+            "id": "chatcmpl-1",
+            "model": "gpt-4o",
+            "choices": [
+                {
+                    "index": 0,
+                    "message": {"role": "assistant", "content": "hey"},
+                    "finish_reason": "stop",
+                }
+            ],
+            "usage": {"prompt_tokens": 4, "completion_tokens": 2,
+                      "total_tokens": 6},
+        }
+        got = json.loads(t.response_body(json.dumps(upstream).encode(), True).body)
+        assert got["type"] == "message"
+        assert got["content"] == [{"type": "text", "text": "hey"}]
+        assert got["stop_reason"] == "end_turn"
+        assert got["usage"] == {"input_tokens": 4, "output_tokens": 2}
+
+    def test_streaming_conversion(self):
+        t = get_translator(Endpoint.MESSAGES, S.ANTHROPIC, S.OPENAI)
+        tx = t.request(json.loads(json.dumps(dict(self.REQ, stream=True))))
+        body = json.loads(tx.body)
+        assert body["stream"] is True
+        assert body["stream_options"] == {"include_usage": True}
+
+        def chunk(delta, finish=None, usage=None):
+            c = {
+                "id": "chatcmpl-1",
+                "object": "chat.completion.chunk",
+                "model": "gpt-4o",
+                "choices": [{"index": 0, "delta": delta,
+                             "finish_reason": finish}],
+            }
+            if usage:
+                c["usage"] = usage
+            return f"data: {json.dumps(c)}\n\n".encode()
+
+        raw = (
+            chunk({"role": "assistant", "content": ""})
+            + chunk({"content": "he"})
+            + chunk({"content": "y"})
+            + chunk({}, finish="stop")
+            + chunk({}, usage={"prompt_tokens": 4, "completion_tokens": 2,
+                               "total_tokens": 6})
+            + b"data: [DONE]\n\n"
+        )
+        out = b""
+        for i in range(0, len(raw), 53):
+            out += t.response_body(raw[i : i + 53], False).body
+        out += t.response_body(b"", True).body
+
+        evs = sse_events(out)
+        types = [e.event for e in evs]
+        assert types[0] == "message_start"
+        assert "content_block_start" in types
+        assert types[-2:] == ["message_delta", "message_stop"]
+        deltas = [
+            json.loads(e.data)["delta"]["text"]
+            for e in evs
+            if e.event == "content_block_delta"
+        ]
+        assert "".join(deltas) == "hey"
+        md = json.loads([e for e in evs if e.event == "message_delta"][0].data)
+        assert md["delta"]["stop_reason"] == "end_turn"
+        assert md["usage"]["output_tokens"] == 2
+
+
+class TestOpenAIToBedrock:
+    def test_request_golden(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI, S.AWS_BEDROCK)
+        tx = t.request(json.loads(json.dumps(CHAT_REQ)))
+        body = json.loads(tx.body)
+        assert tx.path == "/model/m-1/converse"
+        assert body["system"] == [{"text": "be brief"}]
+        assert body["messages"] == [{"role": "user", "content": [{"text": "hi"}]}]
+        assert body["inferenceConfig"] == {"maxTokens": 64, "temperature": 0.5}
+
+    def test_response_golden(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI, S.AWS_BEDROCK)
+        t.request(json.loads(json.dumps(CHAT_REQ)))
+        upstream = {
+            "output": {
+                "message": {"role": "assistant", "content": [{"text": "hola"}]}
+            },
+            "stopReason": "end_turn",
+            "usage": {"inputTokens": 7, "outputTokens": 2, "totalTokens": 9},
+        }
+        rx = t.response_body(json.dumps(upstream).encode(), True)
+        got = json.loads(rx.body)
+        assert got["choices"][0]["message"]["content"] == "hola"
+        assert got["usage"]["total_tokens"] == 9
+        assert rx.usage.input_tokens == 7
+
+    def test_streaming_eventstream(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI, S.AWS_BEDROCK)
+        t.request(json.loads(json.dumps(dict(CHAT_REQ, stream=True))))
+        assert t.request.__self__ is t  # translator is stateful per request
+
+        def frame(etype, payload):
+            return encode_message(
+                {":message-type": "event", ":event-type": etype},
+                json.dumps(payload).encode(),
+            )
+
+        raw = (
+            frame("messageStart", {"role": "assistant"})
+            + frame("contentBlockDelta", {"delta": {"text": "bon"}})
+            + frame("contentBlockDelta", {"delta": {"text": "jour"}})
+            + frame("messageStop", {"stopReason": "end_turn"})
+            + frame(
+                "metadata",
+                {"usage": {"inputTokens": 3, "outputTokens": 2, "totalTokens": 5}},
+            )
+        )
+        out = b""
+        usage = None
+        for i in range(0, len(raw), 41):  # split across frame boundaries
+            rx = t.response_body(raw[i : i + 41], False)
+            out += rx.body
+            if rx.usage.total_tokens:
+                usage = rx.usage
+        out += t.response_body(b"", True).body
+        evs = sse_events(out)
+        assert evs[-1].data == "[DONE]"
+        chunks = [json.loads(e.data) for e in evs if e.data != "[DONE]"]
+        text = "".join(
+            c["choices"][0]["delta"].get("content", "")
+            for c in chunks
+            if c["choices"]
+        )
+        assert text == "bonjour"
+        assert usage.input_tokens == 3 and usage.output_tokens == 2
+
+
+class TestOpenAIToGemini:
+    def test_request_golden(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI, S.GCP_VERTEX_AI)
+        tx = t.request(json.loads(json.dumps(CHAT_REQ)))
+        body = json.loads(tx.body)
+        assert ":generateContent" in tx.path
+        assert "{GCP_PROJECT}" in tx.path
+        assert body["systemInstruction"] == {"parts": [{"text": "be brief"}]}
+        assert body["contents"] == [{"role": "user", "parts": [{"text": "hi"}]}]
+        assert body["generationConfig"]["maxOutputTokens"] == 64
+
+    def test_response_golden(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI, S.GCP_VERTEX_AI)
+        t.request(json.loads(json.dumps(CHAT_REQ)))
+        upstream = {
+            "candidates": [
+                {
+                    "content": {"role": "model", "parts": [{"text": "ciao"}]},
+                    "finishReason": "STOP",
+                }
+            ],
+            "usageMetadata": {
+                "promptTokenCount": 6,
+                "candidatesTokenCount": 1,
+                "totalTokenCount": 7,
+            },
+        }
+        rx = t.response_body(json.dumps(upstream).encode(), True)
+        got = json.loads(rx.body)
+        assert got["choices"][0]["message"]["content"] == "ciao"
+        assert rx.usage.total_tokens == 7
+
+    def test_streaming(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI, S.GCP_VERTEX_AI)
+        t.request(json.loads(json.dumps(dict(CHAT_REQ, stream=True))))
+
+        def ev(payload):
+            return f"data: {json.dumps(payload)}\n\n".encode()
+
+        raw = ev(
+            {"candidates": [{"content": {"parts": [{"text": "ci"}]}}]}
+        ) + ev(
+            {
+                "candidates": [
+                    {"content": {"parts": [{"text": "ao"}]},
+                     "finishReason": "STOP"}
+                ],
+                "usageMetadata": {"promptTokenCount": 6,
+                                  "candidatesTokenCount": 2,
+                                  "totalTokenCount": 8},
+            }
+        )
+        out = t.response_body(raw, False).body
+        rx = t.response_body(b"", True)
+        out += rx.body
+        evs = sse_events(out)
+        assert evs[-1].data == "[DONE]"
+        chunks = [json.loads(e.data) for e in evs if e.data != "[DONE]"]
+        text = "".join(
+            c["choices"][0]["delta"].get("content", "")
+            for c in chunks
+            if c["choices"]
+        )
+        assert text == "ciao"
+        assert rx.usage.total_tokens == 8
+
+
+class TestAzure:
+    def test_path(self):
+        t = get_translator(
+            Endpoint.CHAT_COMPLETIONS, S.OPENAI, S.AZURE_OPENAI,
+            out_version="2024-10-21",
+        )
+        tx = t.request(json.loads(json.dumps(CHAT_REQ)))
+        assert tx.path == (
+            "/openai/deployments/m-1/chat/completions?api-version=2024-10-21"
+        )
+
+
+class TestPassthrough:
+    def test_model_override(self):
+        t = get_translator(
+            Endpoint.CHAT_COMPLETIONS, S.OPENAI, S.OPENAI,
+            model_name_override="upstream-model",
+        )
+        tx = t.request(json.loads(json.dumps(CHAT_REQ)))
+        assert json.loads(tx.body)["model"] == "upstream-model"
+
+    def test_streaming_usage_mining(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI, S.OPENAI)
+        t.request(json.loads(json.dumps(dict(CHAT_REQ, stream=True))))
+        raw = (
+            b'data: {"choices":[{"index":0,"delta":{"content":"x"}}],"model":"m"}\n\n'
+            b'data: {"choices":[],"usage":{"prompt_tokens":3,'
+            b'"completion_tokens":1,"total_tokens":4}}\n\n'
+            b"data: [DONE]\n\n"
+        )
+        rx = t.response_body(raw, True)
+        assert rx.body == raw  # bytes forwarded unchanged
+        assert rx.usage.total_tokens == 4
+        assert rx.model == "m"
+
+
+class TestEmbeddingsAndTokenize:
+    def test_vertex_embeddings(self):
+        t = get_translator(Endpoint.EMBEDDINGS, S.OPENAI, S.GCP_VERTEX_AI)
+        tx = t.request({"model": "text-emb", "input": ["a", "b"]})
+        assert json.loads(tx.body) == {
+            "instances": [{"content": "a"}, {"content": "b"}]
+        }
+        upstream = {
+            "predictions": [
+                {"embeddings": {"values": [0.1], "statistics": {"token_count": 2}}},
+                {"embeddings": {"values": [0.2], "statistics": {"token_count": 3}}},
+            ]
+        }
+        rx = t.response_body(json.dumps(upstream).encode(), True)
+        got = json.loads(rx.body)
+        assert [d["embedding"] for d in got["data"]] == [[0.1], [0.2]]
+        assert rx.usage.input_tokens == 5
+
+    def test_bedrock_embeddings(self):
+        t = get_translator(Endpoint.EMBEDDINGS, S.OPENAI, S.AWS_BEDROCK)
+        tx = t.request({"model": "amazon.titan-embed-text-v2:0", "input": "hi"})
+        assert tx.path == "/model/amazon.titan-embed-text-v2:0/invoke"
+        rx = t.response_body(
+            json.dumps({"embedding": [1.0, 2.0], "inputTextTokenCount": 4}).encode(),
+            True,
+        )
+        got = json.loads(rx.body)
+        assert got["data"][0]["embedding"] == [1.0, 2.0]
+        assert rx.usage.input_tokens == 4
+
+    def test_tokenize_anthropic(self):
+        t = get_translator(Endpoint.TOKENIZE, S.OPENAI, S.ANTHROPIC)
+        tx = t.request({"model": "c", "prompt": "hello world"})
+        assert tx.path == "/v1/messages/count_tokens"
+        rx = t.response_body(json.dumps({"input_tokens": 11}).encode(), True)
+        assert json.loads(rx.body)["count"] == 11
