@@ -1,0 +1,122 @@
+"""`aigw-tpu` CLI — run the gateway standalone (reference cmd/aigw:
+``aigw run`` embeds the whole system in one process, run.go:91-235).
+
+Subcommands:
+  run <config.yaml|bundle-dir>   start the gateway data plane
+  validate <config>              parse + validate a config, print summary
+  tpuserve <model-config>        start the TPU serving engine (tpuserve)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="aigw-tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_run = sub.add_parser("run", help="run the gateway data plane")
+    p_run.add_argument("config", help="config YAML file or bundle directory")
+    p_run.add_argument("--host", default="127.0.0.1")
+    p_run.add_argument("--port", type=int, default=1975)
+    p_run.add_argument("--watch-interval", type=float, default=5.0)
+    p_run.add_argument("--log-level", default="info")
+
+    p_val = sub.add_parser("validate", help="validate a config file")
+    p_val.add_argument("config")
+
+    p_serve = sub.add_parser("tpuserve", help="run the TPU serving engine")
+    p_serve.add_argument("--model", required=True,
+                         help="model name or path (see aigw_tpu.models)")
+    p_serve.add_argument("--host", default="127.0.0.1")
+    p_serve.add_argument("--port", type=int, default=8011)
+    p_serve.add_argument("--max-batch-size", type=int, default=8)
+    p_serve.add_argument("--max-seq-len", type=int, default=2048)
+    p_serve.add_argument("--page-size", type=int, default=128)
+    p_serve.add_argument("--hbm-pages", type=int, default=0,
+                         help="KV pages to allocate (0 = auto)")
+    p_serve.add_argument("--log-level", default="info")
+
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=getattr(logging, getattr(args, "log_level", "info").upper(), 20),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+    if args.cmd == "validate":
+        from aigw_tpu.config.model import ConfigError, load_config
+
+        try:
+            cfg = load_config(args.config)
+        except ConfigError as e:
+            print(f"INVALID: {e}", file=sys.stderr)
+            return 1
+        print(
+            f"OK: {len(cfg.backends)} backends, {len(cfg.routes)} routes, "
+            f"{len(cfg.models)} models, {len(cfg.llm_request_costs)} cost metrics"
+        )
+        return 0
+
+    if args.cmd == "run":
+        return asyncio.run(_run_gateway(args))
+    if args.cmd == "tpuserve":
+        return asyncio.run(_run_tpuserve(args))
+    return 2
+
+
+async def _run_gateway(args: argparse.Namespace) -> int:
+    from aigw_tpu.config.watcher import ConfigWatcher
+    from aigw_tpu.gateway.server import run_gateway
+
+    holder = {}
+
+    def on_reload(rc):
+        server = holder.get("server")
+        if server is not None:
+            server.set_runtime(rc)
+
+    watcher = ConfigWatcher(args.config, on_reload, interval=args.watch_interval)
+    runtime = watcher.load_initial()
+    server, runner = await run_gateway(runtime, host=args.host, port=args.port)
+    holder["server"] = server
+    await watcher.start()
+    print(f"gateway listening on http://{args.host}:{args.port}", flush=True)
+    await _wait_for_signal()
+    await watcher.stop()
+    await runner.cleanup()
+    return 0
+
+
+async def _run_tpuserve(args: argparse.Namespace) -> int:
+    from aigw_tpu.tpuserve.server import run_tpuserve
+
+    runner = await run_tpuserve(
+        model=args.model,
+        host=args.host,
+        port=args.port,
+        max_batch_size=args.max_batch_size,
+        max_seq_len=args.max_seq_len,
+        page_size=args.page_size,
+        hbm_pages=args.hbm_pages,
+    )
+    print(f"tpuserve listening on http://{args.host}:{args.port}", flush=True)
+    await _wait_for_signal()
+    await runner.cleanup()
+    return 0
+
+
+async def _wait_for_signal() -> None:
+    loop = asyncio.get_running_loop()
+    stop = asyncio.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

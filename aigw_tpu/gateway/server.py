@@ -1,0 +1,430 @@
+"""The native gateway data-plane server.
+
+Replaces the reference's Envoy + ext_proc pair (internal/extproc/server.go,
+processor_impl.go) with one native server that keeps the reference's
+deepest design insight — the **two-phase processor**:
+
+  Phase 1 (route selection): parse the body only enough to extract the
+  model, stamp the model header, match a route. The original parsed body is
+  captured. (≈ routerProcessor.ProcessRequestBody, processor_impl.go:213)
+
+  Phase 2 (upstream, per attempt): against the finally-chosen backend,
+  translate the captured body to the backend schema, apply header/body
+  mutations, inject credentials, send. A retry/fallover constructs a fresh
+  translator and re-translates from the captured body — which is what makes
+  fallback *across schemas* work (processor_impl.go:73-131,334-339).
+
+Streaming responses flow through the translator chunk-by-chunk with token
+usage mined mid-stream; cost metadata is produced at end-of-stream and fed
+to the quota/rate-limit engine (≈ Envoy dynamic metadata consumed by the
+rate-limit filter, filterconfig.go:84-87).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import logging
+import time
+from typing import Any, Callable
+
+import aiohttp
+from aiohttp import web
+
+from aigw_tpu.config.model import (
+    Config,
+    DESTINATION_ENDPOINT_HEADER,
+    MODEL_NAME_HEADER,
+    ORIGINAL_PATH_HEADER,
+    APISchemaName,
+)
+from aigw_tpu.config.runtime import RuntimeBackend, RuntimeConfig
+from aigw_tpu.gateway.auth import AuthError
+from aigw_tpu.gateway.costs import TokenUsage
+from aigw_tpu.gateway.mutators import apply_body_mutation, apply_header_mutation
+from aigw_tpu.gateway.router import BackendSelector, NoRouteError, match_route
+from aigw_tpu.obs.metrics import GenAIMetrics, RequestMetrics
+from aigw_tpu.schemas import anthropic as anth
+from aigw_tpu.schemas import openai as oai
+from aigw_tpu.translate import Endpoint, TranslationError, get_translator
+
+logger = logging.getLogger(__name__)
+
+#: endpoint path → (Endpoint, front schema, metrics operation)
+_ENDPOINTS: dict[str, tuple[Endpoint, APISchemaName, str]] = {
+    Endpoint.CHAT_COMPLETIONS.value: (
+        Endpoint.CHAT_COMPLETIONS, APISchemaName.OPENAI, "chat"),
+    Endpoint.COMPLETIONS.value: (
+        Endpoint.COMPLETIONS, APISchemaName.OPENAI, "text_completion"),
+    Endpoint.EMBEDDINGS.value: (
+        Endpoint.EMBEDDINGS, APISchemaName.OPENAI, "embeddings"),
+    Endpoint.MESSAGES.value: (
+        Endpoint.MESSAGES, APISchemaName.ANTHROPIC, "chat"),
+    Endpoint.TOKENIZE.value: (
+        Endpoint.TOKENIZE, APISchemaName.OPENAI, "tokenize"),
+    Endpoint.RESPONSES.value: (
+        Endpoint.RESPONSES, APISchemaName.OPENAI, "responses"),
+    Endpoint.IMAGES_GENERATIONS.value: (
+        Endpoint.IMAGES_GENERATIONS, APISchemaName.OPENAI, "image_generation"),
+}
+
+#: upstream statuses that trigger failover to the next backend
+_RETRIABLE_STATUS = {429, 500, 502, 503, 504}
+
+CostSink = Callable[[dict[str, int], dict[str, str]], Any]
+
+
+class GatewayServer:
+    """aiohttp application hosting the full data plane."""
+
+    def __init__(
+        self,
+        runtime: RuntimeConfig,
+        *,
+        metrics: GenAIMetrics | None = None,
+        cost_sink: CostSink | None = None,
+    ):
+        self._runtime = runtime
+        self.metrics = metrics or GenAIMetrics()
+        self._cost_sink = cost_sink
+        self._session: aiohttp.ClientSession | None = None
+        self.app = web.Application(client_max_size=64 * 1024 * 1024)
+        for path in _ENDPOINTS:
+            self.app.router.add_post(path, self._handle)
+        self.app.router.add_get("/v1/models", self._handle_models)
+        self.app.router.add_get("/health", self._handle_health)
+        self.app.router.add_get("/metrics", self._handle_metrics)
+        self.app.on_cleanup.append(self._cleanup)
+
+    # -- lifecycle --------------------------------------------------------
+    @property
+    def runtime(self) -> RuntimeConfig:
+        return self._runtime
+
+    def set_runtime(self, rc: RuntimeConfig) -> None:
+        """Hot-swap config (called by ConfigWatcher)."""
+        self._runtime = rc
+
+    async def _get_session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                auto_decompress=True,
+                timeout=aiohttp.ClientTimeout(total=None),
+            )
+        return self._session
+
+    async def _cleanup(self, _app: web.Application) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    # -- admin endpoints --------------------------------------------------
+    async def _handle_health(self, _request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok", "uuid": self._runtime.config.uuid})
+
+    async def _handle_metrics(self, _request: web.Request) -> web.Response:
+        return web.Response(body=self.metrics.export(),
+                            content_type="text/plain")
+
+    async def _handle_models(self, request: web.Request) -> web.Response:
+        """/v1/models — list configured models (reference
+        models_processor.go:30-150, host-scoped)."""
+        cfg = self._runtime.config
+        body = oai.models_response(
+            (m.name, m.owned_by, m.created_at) for m in cfg.models
+        )
+        return web.json_response(body)
+
+    # -- the data plane ---------------------------------------------------
+    async def _handle(self, request: web.Request) -> web.StreamResponse:
+        endpoint, front_schema, operation = _ENDPOINTS[request.path]
+        rc = self._runtime  # pin the config for this request
+        raw = await request.read()
+        error_body = (
+            anth.error_body
+            if front_schema is APISchemaName.ANTHROPIC
+            else oai.error_body
+        )
+        # ---- phase 1: route selection ----------------------------------
+        try:
+            body = oai.parse_json_body(raw)
+            model = oai.request_model(body)
+            if endpoint is Endpoint.CHAT_COMPLETIONS:
+                oai.validate_chat_request(body)
+            elif endpoint is Endpoint.MESSAGES:
+                anth.validate_messages_request(body)
+        except oai.SchemaError as e:
+            return web.Response(
+                status=400, body=error_body(str(e)),
+                content_type="application/json")
+        match_headers = {
+            MODEL_NAME_HEADER: model,
+            ORIGINAL_PATH_HEADER: request.path,
+            **{k.lower(): v for k, v in request.headers.items()},
+        }
+        try:
+            match = match_route(rc, request.host, match_headers)
+        except NoRouteError:
+            return web.Response(
+                status=404,
+                body=error_body(
+                    f"model {model!r} is not served by this gateway",
+                    type_="model_not_found" if front_schema is APISchemaName.OPENAI
+                    else "not_found_error",
+                ),
+                content_type="application/json",
+            )
+
+        req_metrics = RequestMetrics(
+            metrics=self.metrics, operation=operation, request_model=model
+        )
+        selector = BackendSelector(rule=match.rule)
+        route_name = match.route.name
+
+        # ---- phase 2: upstream attempts --------------------------------
+        last_error: tuple[int, bytes] = (
+            502,
+            error_body("all upstream backends failed", type_="upstream_error"),
+        )
+        attempt = 0
+        while True:
+            ref = selector.next_backend()
+            if ref is None:
+                break
+            rb = rc.backends[ref.backend]
+            if attempt > 0:
+                self.metrics.retries_total.labels(route_name, rb.backend.name).inc()
+            attempt += 1
+            req_metrics.provider = rb.backend.name
+            try:
+                result = await self._attempt(
+                    request, endpoint, front_schema, rb, body,
+                    req_metrics, route_name, error_body,
+                )
+            except _RetriableUpstreamError as e:
+                logger.warning(
+                    "backend %s failed (%s), trying next", rb.backend.name, e
+                )
+                last_error = (e.status, e.client_body)
+                self.metrics.requests_total.labels(
+                    route_name, rb.backend.name, str(e.status)
+                ).inc()
+                continue
+            except AuthError as e:
+                req_metrics.finish(TokenUsage(), error_type="auth")
+                return web.Response(
+                    status=401, body=error_body(str(e), type_="authentication_error"),
+                    content_type="application/json")
+            except (TranslationError, oai.SchemaError) as e:
+                req_metrics.finish(TokenUsage(), error_type="translation")
+                return web.Response(
+                    status=400, body=error_body(str(e)),
+                    content_type="application/json")
+            return result
+
+        req_metrics.finish(TokenUsage(), error_type="upstream_exhausted")
+        return web.Response(
+            status=last_error[0], body=last_error[1],
+            content_type="application/json")
+
+    async def _attempt(
+        self,
+        request: web.Request,
+        endpoint: Endpoint,
+        front_schema: APISchemaName,
+        rb: RuntimeBackend,
+        body: dict[str, Any],
+        req_metrics: RequestMetrics,
+        route_name: str,
+        error_body: Callable[..., bytes],
+    ) -> web.StreamResponse:
+        backend = rb.backend
+        translator = get_translator(
+            endpoint,
+            front_schema,
+            backend.schema.name,
+            model_name_override=backend.model_name_override,
+            out_version=backend.schema.version,
+        )
+        # Retry safety: translate from a fresh copy of the captured body.
+        tx = translator.request(copy.deepcopy(body))
+        out_body = apply_body_mutation(tx.body, backend.body_mutation)
+
+        headers: dict[str, str] = {
+            "content-type": "application/json",
+            "accept": "text/event-stream" if tx.stream else "application/json",
+        }
+        # Endpoint-picker support: honor a pre-selected destination set by
+        # the picker (reference x-gateway-destination-endpoint +
+        # ORIGINAL_DST, post_cluster_modify.go:67-80).
+        dest = request.headers.get(DESTINATION_ENDPOINT_HEADER, "")
+        base_url = f"http://{dest}" if dest else backend.url
+        if not base_url:
+            raise _RetriableUpstreamError(
+                502, error_body(f"backend {backend.name} has no url"),
+                "missing url")
+        headers.update(tx.headers)
+        headers = apply_header_mutation(headers, backend.header_mutation)
+        import urllib.parse as _up
+
+        headers["host"] = _up.urlsplit(base_url).netloc
+        path = tx.path or request.path
+        headers, path = rb.auth_handler.apply(headers, out_body, path)
+
+        session = await self._get_session()
+        timeout = aiohttp.ClientTimeout(
+            total=backend.request_timeout,
+            sock_connect=min(10.0, backend.request_timeout),
+            sock_read=backend.stream_idle_timeout if tx.stream else None,
+        )
+        try:
+            resp = await session.post(
+                base_url + path, data=out_body, headers=headers, timeout=timeout
+            )
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            raise _RetriableUpstreamError(
+                502, error_body(f"upstream connect error: {e}",
+                                type_="upstream_error"),
+                str(e) or type(e).__name__,
+            ) from None
+
+        async with _closing(resp):
+            if resp.status >= 400:
+                err = await resp.read()
+                client_err = translator.response_error(resp.status, err)
+                if resp.status in _RETRIABLE_STATUS:
+                    raise _RetriableUpstreamError(resp.status, client_err,
+                                                  f"status {resp.status}")
+                req_metrics.finish(TokenUsage(), error_type=str(resp.status))
+                self.metrics.requests_total.labels(
+                    route_name, backend.name, str(resp.status)
+                ).inc()
+                return web.Response(
+                    status=resp.status, body=client_err,
+                    content_type="application/json")
+
+            translator.response_headers(
+                resp.status, {k.lower(): v for k, v in resp.headers.items()}
+            )
+            ctype = resp.headers.get("content-type", "")
+            upstream_streams = tx.stream and (
+                "text/event-stream" in ctype
+                or "vnd.amazon.eventstream" in ctype
+            )
+            if upstream_streams:
+                return await self._stream_response(
+                    request, resp, translator, rb, req_metrics, route_name
+                )
+            raw = await resp.read()
+            rx = translator.response_body(raw, True)
+            usage = rx.usage
+            req_metrics.response_model = rx.model
+            req_metrics.finish(usage)
+            self._sink_costs(usage, rx.model, backend.name, route_name)
+            self.metrics.requests_total.labels(
+                route_name, backend.name, str(resp.status)
+            ).inc()
+            return web.Response(
+                status=resp.status, body=rx.body or raw,
+                content_type="application/json")
+
+    async def _stream_response(
+        self,
+        request: web.Request,
+        resp: aiohttp.ClientResponse,
+        translator: Any,
+        rb: RuntimeBackend,
+        req_metrics: RequestMetrics,
+        route_name: str,
+    ) -> web.StreamResponse:
+        """Proxy the SSE stream through the translator — the hot loop
+        (reference processor_impl.go:481-575)."""
+        out = web.StreamResponse(
+            status=200,
+            headers={
+                "content-type": "text/event-stream",
+                "cache-control": "no-cache",
+                "x-accel-buffering": "no",
+            },
+        )
+        await out.prepare(request)
+        usage = TokenUsage()
+        model = ""
+        try:
+            async for chunk in resp.content.iter_any():
+                rx = translator.response_body(chunk, False)
+                usage = usage.merge_override(rx.usage)
+                model = rx.model or model
+                req_metrics.record_tokens_emitted(rx.tokens_emitted)
+                if rx.body:
+                    await out.write(rx.body)
+            rx = translator.response_body(b"", True)
+            usage = usage.merge_override(rx.usage)
+            model = rx.model or model
+            if rx.body:
+                await out.write(rx.body)
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            # Mid-stream failure: the client already has bytes; surface an
+            # SSE error event rather than failing over (the reference's
+            # per-try idle timeout only retries before response start).
+            logger.warning("stream from %s aborted: %s", rb.backend.name, e)
+            await out.write(
+                b'data: {"error": {"message": "upstream stream interrupted", '
+                b'"type": "upstream_error", "code": null}}\n\n'
+            )
+        req_metrics.response_model = model
+        req_metrics.finish(usage)
+        self._sink_costs(usage, model, rb.backend.name, route_name)
+        self.metrics.requests_total.labels(route_name, rb.backend.name, "200").inc()
+        await out.write_eof()
+        return out
+
+    def _sink_costs(
+        self, usage: TokenUsage, model: str, backend: str, route_name: str
+    ) -> None:
+        """End-of-stream cost metadata (≈ dynamic metadata for the
+        rate-limit filter, extproc/util.go buildDynamicMetadata)."""
+        if self._cost_sink is None:
+            return
+        costs = self._runtime.cost_calculator.calculate(
+            usage, model=model, backend=backend, route_name=route_name
+        )
+        if costs:
+            self._cost_sink(
+                costs,
+                {"model": model, "backend": backend, "route": route_name},
+            )
+
+
+class _RetriableUpstreamError(Exception):
+    def __init__(self, status: int, client_body: bytes, reason: str):
+        super().__init__(reason)
+        self.status = status
+        self.client_body = client_body
+
+
+class _closing:
+    def __init__(self, resp: aiohttp.ClientResponse):
+        self._resp = resp
+
+    async def __aenter__(self):
+        return self._resp
+
+    async def __aexit__(self, *exc):
+        self._resp.release()
+        return False
+
+
+async def run_gateway(
+    runtime: RuntimeConfig,
+    host: str = "127.0.0.1",
+    port: int = 1975,
+    **kwargs: Any,
+) -> tuple[GatewayServer, web.AppRunner]:
+    """Start the gateway; returns (server, runner). Caller owns shutdown."""
+    server = GatewayServer(runtime, **kwargs)
+    runner = web.AppRunner(server.app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    logger.info("gateway listening on %s:%d", host, port)
+    return server, runner

@@ -171,6 +171,33 @@ def chat_completion_chunk(
     return chunk
 
 
+def stream_chunk_sse(
+    *,
+    response_id: str,
+    model: str,
+    created: int,
+    delta: dict[str, Any] | None = None,
+    finish_reason: str | None = None,
+    usage: TokenUsage | None = None,
+) -> bytes:
+    """One chat.completion.chunk encoded as an SSE event — the shared
+    emitter for every cross-schema streaming translator."""
+    from aigw_tpu.translate.sse import SSEEvent
+
+    return SSEEvent(
+        data=json.dumps(
+            chat_completion_chunk(
+                response_id=response_id,
+                model=model,
+                delta=delta,
+                finish_reason=finish_reason,
+                usage=usage,
+                created=created,
+            )
+        )
+    ).encode()
+
+
 def embeddings_response(
     *, model: str, vectors: Iterable[list[float]], usage: TokenUsage
 ) -> dict[str, Any]:

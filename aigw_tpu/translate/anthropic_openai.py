@@ -8,7 +8,6 @@ backends — including the in-tree TPU engine.
 from __future__ import annotations
 
 import json
-import time
 import uuid
 from typing import Any
 
@@ -344,7 +343,13 @@ class AnthropicToOpenAIChat(Translator):
                     "stop_reason": self._finish or "end_turn",
                     "stop_sequence": None,
                 },
-                "usage": {"output_tokens": self._usage.output_tokens},
+                # include input_tokens so streaming clients can bill
+            # correctly even though usage arrives at end-of-stream from
+            # the OpenAI upstream (message_start carried zeros).
+            "usage": {
+                "input_tokens": self._usage.input_tokens,
+                "output_tokens": self._usage.output_tokens,
+            },
             },
         )
         out += self._event("message_stop", {"type": "message_stop"})

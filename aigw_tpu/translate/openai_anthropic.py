@@ -387,16 +387,10 @@ class OpenAIToAnthropicChat(Translator):
         )
 
     def _emit(self, delta: dict[str, Any]) -> bytes:
-        return SSEEvent(
-            data=json.dumps(
-                oai.chat_completion_chunk(
-                    response_id=self._id,
-                    model=self._model,
-                    delta=delta,
-                    created=self._created,
-                )
-            )
-        ).encode()
+        return oai.stream_chunk_sse(
+            response_id=self._id, model=self._model, created=self._created,
+            delta=delta,
+        )
 
 
 def _factory(*, model_name_override: str = "", stream: bool = False, **_: object):

@@ -45,6 +45,8 @@ def openai_messages_to_converse(
     out: list[dict[str, Any]] = []
 
     def push(role: str, blocks: list[dict[str, Any]]) -> None:
+        if not blocks:
+            return
         if out and out[-1]["role"] == role:
             out[-1]["content"].extend(blocks)
         else:
@@ -57,8 +59,7 @@ def openai_messages_to_converse(
             if text:
                 system.append({"text": text})
         elif role == "user":
-            text = oai.message_content_text(m.get("content"))
-            push("user", [{"text": text}] if text else [])
+            push("user", _user_blocks(m.get("content")))
         elif role == "assistant":
             blocks: list[dict[str, Any]] = []
             text = oai.message_content_text(m.get("content"))
@@ -102,6 +103,34 @@ def openai_messages_to_converse(
         else:
             raise TranslationError(f"unsupported message role {role!r}")
     return system, out
+
+
+def _user_blocks(content: Any) -> list[dict[str, Any]]:
+    """User content union → Converse blocks (text + base64 images)."""
+    if content is None:
+        return []
+    if isinstance(content, str):
+        return [{"text": content}] if content else []
+    blocks: list[dict[str, Any]] = []
+    for part in content:
+        ptype = part.get("type")
+        if ptype == "text":
+            if part.get("text"):
+                blocks.append({"text": part["text"]})
+        elif ptype == "image_url":
+            url = (part.get("image_url") or {}).get("url", "")
+            if not url.startswith("data:"):
+                raise TranslationError(
+                    "Bedrock Converse requires base64 data: image URLs"
+                )
+            media, _, b64 = url[len("data:") :].partition(";base64,")
+            fmt = media.rpartition("/")[2] or "png"
+            blocks.append(
+                {"image": {"format": fmt, "source": {"bytes": b64}}}
+            )
+        else:
+            raise TranslationError(f"unsupported content part {ptype!r}")
+    return blocks
 
 
 def converse_usage(u: dict[str, Any]) -> TokenUsage:
@@ -154,6 +183,10 @@ class OpenAIToBedrockChat(Translator):
         if inference:
             out["inferenceConfig"] = inference
         tools = body.get("tools")
+        # tool_choice "none" means the model must not call tools; Converse
+        # has no NONE mode, so omit toolConfig entirely.
+        if body.get("tool_choice") == "none":
+            tools = None
         if tools:
             tool_config: dict[str, Any] = {
                 "tools": [
@@ -335,16 +368,10 @@ class OpenAIToBedrockChat(Translator):
         )
 
     def _emit(self, delta: dict[str, Any]) -> bytes:
-        return SSEEvent(
-            data=json.dumps(
-                oai.chat_completion_chunk(
-                    response_id=self._id,
-                    model=self._model,
-                    delta=delta,
-                    created=self._created,
-                )
-            )
-        ).encode()
+        return oai.stream_chunk_sse(
+            response_id=self._id, model=self._model, created=self._created,
+            delta=delta,
+        )
 
 
 register_translator(

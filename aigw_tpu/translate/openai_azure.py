@@ -7,6 +7,7 @@ translator). The APISchema.version of the backend carries the api-version.
 
 from __future__ import annotations
 
+import urllib.parse
 from typing import Any
 
 from aigw_tpu.config.model import APISchemaName
@@ -47,7 +48,9 @@ class OpenAIToAzure(PassthroughTranslator):
 
     def request(self, body: dict[str, Any]) -> RequestTx:
         tx = super().request(body)
-        deployment = self._override or oai.request_model(body)
+        deployment = urllib.parse.quote(
+            self._override or oai.request_model(body), safe=""
+        )
         suffix = _ENDPOINT_SUFFIX[self._endpoint]
         tx.path = (
             f"/openai/deployments/{deployment}/{suffix}"

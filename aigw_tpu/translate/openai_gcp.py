@@ -50,6 +50,35 @@ def gemini_usage(data: dict[str, Any]) -> TokenUsage:
     )
 
 
+def _user_parts(content: Any) -> list[dict[str, Any]]:
+    """User content union → Gemini parts (text + inline/file images)."""
+    if content is None:
+        return []
+    if isinstance(content, str):
+        return [{"text": content}] if content else []
+    parts: list[dict[str, Any]] = []
+    for part in content:
+        ptype = part.get("type")
+        if ptype == "text":
+            if part.get("text"):
+                parts.append({"text": part["text"]})
+        elif ptype == "image_url":
+            url = (part.get("image_url") or {}).get("url", "")
+            if url.startswith("data:"):
+                media, _, b64 = url[len("data:") :].partition(";base64,")
+                parts.append(
+                    {"inlineData": {"mimeType": media or "image/png",
+                                    "data": b64}}
+                )
+            else:
+                parts.append(
+                    {"fileData": {"mimeType": "image/png", "fileUri": url}}
+                )
+        else:
+            raise TranslationError(f"unsupported content part {ptype!r}")
+    return parts
+
+
 def openai_messages_to_gemini(
     messages: list[dict[str, Any]],
 ) -> tuple[dict[str, Any] | None, list[dict[str, Any]]]:
@@ -71,8 +100,7 @@ def openai_messages_to_gemini(
             if text:
                 system_parts.append({"text": text})
         elif role == "user":
-            text = oai.message_content_text(m.get("content"))
-            push("user", [{"text": text}] if text else [])
+            push("user", _user_parts(m.get("content")))
         elif role == "assistant":
             parts: list[dict[str, Any]] = []
             text = oai.message_content_text(m.get("content"))
@@ -149,9 +177,13 @@ class OpenAIToGeminiChat(Translator):
         stop = body.get("stop")
         if stop:
             gen["stopSequences"] = [stop] if isinstance(stop, str) else list(stop)
-        n = body.get("n")
-        if n:
-            gen["candidateCount"] = int(n)
+        n = int(body.get("n") or 1)
+        if n > 1:
+            if self._stream:
+                raise TranslationError(
+                    "n>1 is not supported for streaming Gemini requests"
+                )
+            gen["candidateCount"] = n
         if gen:
             out["generationConfig"] = gen
         tools = body.get("tools")
@@ -206,34 +238,46 @@ class OpenAIToGeminiChat(Translator):
         except json.JSONDecodeError as e:
             raise TranslationError(f"invalid upstream JSON: {e}") from None
         usage = gemini_usage(data)
-        cand = (data.get("candidates") or [{}])[0]
-        parts = (cand.get("content") or {}).get("parts") or []
-        text = "".join(p.get("text", "") for p in parts if "text" in p)
-        tool_calls = [
-            {
-                "id": f"call_{uuid.uuid4().hex[:16]}",
-                "type": "function",
-                "function": {
-                    "name": p["functionCall"].get("name", ""),
-                    "arguments": json.dumps(p["functionCall"].get("args", {})),
-                },
-            }
-            for p in parts
-            if "functionCall" in p
-        ]
-        finish = _FINISH_TO_OPENAI.get(cand.get("finishReason") or "STOP", "stop")
-        if tool_calls:
-            finish = "tool_calls"
-        out = oai.chat_completion_response(
-            model=str(data.get("modelVersion", "") or self._model),
-            content=text,
-            finish_reason=finish,
-            usage=usage,
-            tool_calls=tool_calls or None,
-            response_id=self._id,
-        )
+        model = str(data.get("modelVersion", "") or self._model)
+        choices = []
+        for i, cand in enumerate(data.get("candidates") or [{}]):
+            parts = (cand.get("content") or {}).get("parts") or []
+            text = "".join(p.get("text", "") for p in parts if "text" in p)
+            tool_calls = [
+                {
+                    "id": f"call_{uuid.uuid4().hex[:16]}",
+                    "type": "function",
+                    "function": {
+                        "name": p["functionCall"].get("name", ""),
+                        "arguments": json.dumps(p["functionCall"].get("args", {})),
+                    },
+                }
+                for p in parts
+                if "functionCall" in p
+            ]
+            finish = _FINISH_TO_OPENAI.get(
+                cand.get("finishReason") or "STOP", "stop"
+            )
+            if tool_calls:
+                finish = "tool_calls"
+            message: dict[str, Any] = {"role": "assistant", "content": text}
+            if tool_calls:
+                message["tool_calls"] = tool_calls
+                if not text:
+                    message["content"] = None
+            choices.append(
+                {"index": i, "message": message, "finish_reason": finish}
+            )
+        out = {
+            "id": self._id,
+            "object": "chat.completion",
+            "created": self._created,
+            "model": model,
+            "choices": choices,
+            "usage": oai.usage_dict(usage),
+        }
         return ResponseTx(
-            body=json.dumps(out).encode(), usage=usage, model=self._model
+            body=json.dumps(out).encode(), usage=usage, model=model
         )
 
     def _stream_chunk(self, chunk: bytes, end_of_stream: bool) -> ResponseTx:
@@ -305,16 +349,10 @@ class OpenAIToGeminiChat(Translator):
         )
 
     def _emit(self, delta: dict[str, Any]) -> bytes:
-        return SSEEvent(
-            data=json.dumps(
-                oai.chat_completion_chunk(
-                    response_id=self._id,
-                    model=self._model,
-                    delta=delta,
-                    created=self._created,
-                )
-            )
-        ).encode()
+        return oai.stream_chunk_sse(
+            response_id=self._id, model=self._model, created=self._created,
+            delta=delta,
+        )
 
 
 register_translator(

@@ -86,6 +86,12 @@ class PassthroughTranslator(Translator):
                 delta = choice.get("delta") or {}
                 if delta.get("content"):
                     tokens += 1
+            # Anthropic-shaped stream events carry no "choices"
+            if data.get("type") == "content_block_delta":
+                if (data.get("delta") or {}).get("type") in (
+                    "text_delta", "thinking_delta",
+                ):
+                    tokens += 1
         return ResponseTx(body=chunk, usage=usage, model=model, tokens_emitted=tokens)
 
 

@@ -1,0 +1,141 @@
+"""Test fakes (reference tests/internal/testupstreamlib: a programmable echo
+upstream driven by the test; here driven by registered handlers)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable
+
+from aiohttp import web
+
+
+@dataclass
+class Captured:
+    path: str
+    headers: dict[str, str]
+    body: bytes
+
+    @property
+    def json(self) -> Any:
+        return json.loads(self.body)
+
+
+class FakeUpstream:
+    """Programmable upstream: register handlers per path; captures requests."""
+
+    def __init__(self) -> None:
+        self.captured: list[Captured] = []
+        self._handlers: dict[str, Callable[[Captured], Awaitable[web.StreamResponse]]] = {}
+        self._app = web.Application()
+        self._app.router.add_route("*", "/{tail:.*}", self._dispatch)
+        self._runner: web.AppRunner | None = None
+        self.url = ""
+
+    def on(self, path: str, handler: Callable[[Captured], Awaitable[web.StreamResponse]]):
+        self._handlers[path] = handler
+        return self
+
+    def on_json(self, path: str, payload: dict | Callable[[Captured], dict],
+                status: int = 200):
+        async def handler(cap: Captured) -> web.Response:
+            data = payload(cap) if callable(payload) else payload
+            return web.json_response(data, status=status)
+
+        return self.on(path, handler)
+
+    def on_sse(self, path: str, events: list[bytes] | Callable[[Captured], list[bytes]]):
+        async def handler(cap: Captured) -> web.StreamResponse:
+            resp = web.StreamResponse(
+                status=200, headers={"content-type": "text/event-stream"}
+            )
+            await resp.prepare(cap._request)  # type: ignore[attr-defined]
+            evs = events(cap) if callable(events) else events
+            for ev in evs:
+                await resp.write(ev)
+                await asyncio.sleep(0)  # force chunk boundaries
+            await resp.write_eof()
+            return resp
+
+        return self.on(path, handler)
+
+    async def _dispatch(self, request: web.Request) -> web.StreamResponse:
+        body = await request.read()
+        path = request.path_qs
+        cap = Captured(
+            path=path,
+            headers={k.lower(): v for k, v in request.headers.items()},
+            body=body,
+        )
+        cap._request = request  # type: ignore[attr-defined]
+        self.captured.append(cap)
+        handler = self._handlers.get(path) or self._handlers.get(request.path)
+        if handler is None:
+            return web.json_response({"error": f"no handler for {path}"}, status=404)
+        return await handler(cap)
+
+    async def start(self) -> "FakeUpstream":
+        self._runner = web.AppRunner(self._app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+        self.url = f"http://127.0.0.1:{port}"
+        return self
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+
+def openai_chat_response(content: str = "hello", model: str = "fake-model",
+                         prompt_tokens: int = 5, completion_tokens: int = 7):
+    return {
+        "id": "chatcmpl-fake",
+        "object": "chat.completion",
+        "created": 1700000000,
+        "model": model,
+        "choices": [
+            {
+                "index": 0,
+                "message": {"role": "assistant", "content": content},
+                "finish_reason": "stop",
+            }
+        ],
+        "usage": {
+            "prompt_tokens": prompt_tokens,
+            "completion_tokens": completion_tokens,
+            "total_tokens": prompt_tokens + completion_tokens,
+        },
+    }
+
+
+def openai_stream_events(texts: list[str], model: str = "fake-model",
+                         prompt_tokens: int = 5) -> list[bytes]:
+    out = []
+    for t in texts:
+        chunk = {
+            "id": "chatcmpl-fake",
+            "object": "chat.completion.chunk",
+            "created": 1700000000,
+            "model": model,
+            "choices": [{"index": 0, "delta": {"content": t},
+                         "finish_reason": None}],
+        }
+        out.append(f"data: {json.dumps(chunk)}\n\n".encode())
+    final = {
+        "id": "chatcmpl-fake",
+        "object": "chat.completion.chunk",
+        "created": 1700000000,
+        "model": model,
+        "choices": [{"index": 0, "delta": {}, "finish_reason": "stop"}],
+        "usage": {
+            "prompt_tokens": prompt_tokens,
+            "completion_tokens": len(texts),
+            "total_tokens": prompt_tokens + len(texts),
+        },
+    }
+    out.append(f"data: {json.dumps(final)}\n\n".encode())
+    out.append(b"data: [DONE]\n\n")
+    return out

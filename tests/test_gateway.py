@@ -1,0 +1,415 @@
+"""Gateway data-plane integration tests: real HTTP through the native server
+to fake upstreams (reference tests/data-plane/extproc_test.go model)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from aigw_tpu.config.model import Config
+from aigw_tpu.config.runtime import RuntimeConfig
+from aigw_tpu.gateway.server import run_gateway
+from tests.fakes import (
+    FakeUpstream,
+    openai_chat_response,
+    openai_stream_events,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_config(backends, routes, costs=()):
+    return Config.parse(
+        {
+            "version": "v1",
+            "backends": backends,
+            "routes": routes,
+            "models": ["m1"],
+            "llm_request_costs": list(costs),
+        }
+    )
+
+
+async def start_env(upstreams: dict[str, FakeUpstream], cfg_fn, **gw_kwargs):
+    for up in upstreams.values():
+        await up.start()
+    cfg = cfg_fn({name: up.url for name, up in upstreams.items()})
+    server, runner = await run_gateway(
+        RuntimeConfig.build(cfg), port=0, **gw_kwargs
+    )
+    port = runner.addresses and runner.addresses[0][1]
+    # AppRunner.addresses empty with TCPSite(port=0)? use the site directly
+    site = list(runner.sites)[0]
+    port = site._server.sockets[0].getsockname()[1]
+    return server, runner, f"http://127.0.0.1:{port}", upstreams
+
+
+async def stop_env(runner, upstreams):
+    await runner.cleanup()
+    for up in upstreams.values():
+        await up.stop()
+
+
+CHAT = {
+    "model": "m1",
+    "messages": [{"role": "user", "content": "hi"}],
+}
+
+
+class TestGatewayBasic:
+    def test_chat_passthrough(self):
+        async def main():
+            up = FakeUpstream().on_json(
+                "/v1/chat/completions", openai_chat_response("hey there")
+            )
+            server, runner, url, ups = await start_env(
+                {"a": up},
+                lambda urls: make_config(
+                    [{"name": "a", "schema": "OpenAI", "url": urls["a"],
+                      "auth": {"kind": "APIKey", "api_key": "sk-up"}}],
+                    [{"name": "r", "rules": [
+                        {"models": ["m1"], "backends": ["a"]}]}],
+                ),
+            )
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(url + "/v1/chat/completions",
+                                      json=CHAT) as resp:
+                        assert resp.status == 200
+                        got = await resp.json()
+                assert got["choices"][0]["message"]["content"] == "hey there"
+                # upstream saw injected credentials, not client creds
+                cap = up.captured[0]
+                assert cap.headers["authorization"] == "Bearer sk-up"
+                assert cap.json["model"] == "m1"
+            finally:
+                await stop_env(runner, ups)
+
+        run(main())
+
+    def test_chat_streaming(self):
+        async def main():
+            up = FakeUpstream().on_sse(
+                "/v1/chat/completions",
+                openai_stream_events(["a", "b", "c"]),
+            )
+            server, runner, url, ups = await start_env(
+                {"a": up},
+                lambda urls: make_config(
+                    [{"name": "a", "schema": "OpenAI", "url": urls["a"]}],
+                    [{"name": "r", "rules": [
+                        {"models": ["m1"], "backends": ["a"]}]}],
+                ),
+            )
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        url + "/v1/chat/completions",
+                        json=dict(CHAT, stream=True),
+                    ) as resp:
+                        assert resp.status == 200
+                        assert "text/event-stream" in resp.headers["content-type"]
+                        raw = await resp.read()
+                text = raw.decode()
+                assert "[DONE]" in text
+                datas = [
+                    json.loads(line[len("data: "):])
+                    for line in text.split("\n")
+                    if line.startswith("data: ") and "[DONE]" not in line
+                ]
+                content = "".join(
+                    d["choices"][0]["delta"].get("content", "")
+                    for d in datas if d["choices"]
+                )
+                assert content == "abc"
+            finally:
+                await stop_env(runner, ups)
+
+        run(main())
+
+    def test_unknown_model_404(self):
+        async def main():
+            up = FakeUpstream()
+            server, runner, url, ups = await start_env(
+                {"a": up},
+                lambda urls: make_config(
+                    [{"name": "a", "schema": "OpenAI", "url": urls["a"]}],
+                    [{"name": "r", "rules": [
+                        {"models": ["m1"], "backends": ["a"]}]}],
+                ),
+            )
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        url + "/v1/chat/completions",
+                        json=dict(CHAT, model="nope"),
+                    ) as resp:
+                        assert resp.status == 404
+                        err = await resp.json()
+                        assert err["error"]["type"] == "model_not_found"
+            finally:
+                await stop_env(runner, ups)
+
+        run(main())
+
+    def test_bad_body_400(self):
+        async def main():
+            server, runner, url, ups = await start_env(
+                {},
+                lambda urls: make_config(
+                    [{"name": "a", "schema": "OpenAI", "url": "http://x"}],
+                    [{"name": "r", "rules": [
+                        {"models": ["m1"], "backends": ["a"]}]}],
+                ),
+            )
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(url + "/v1/chat/completions",
+                                      data=b"{not json") as resp:
+                        assert resp.status == 400
+            finally:
+                await stop_env(runner, ups)
+
+        run(main())
+
+    def test_models_endpoint(self):
+        async def main():
+            server, runner, url, ups = await start_env(
+                {},
+                lambda urls: make_config(
+                    [{"name": "a", "schema": "OpenAI", "url": "http://x"}],
+                    [{"name": "r", "rules": [
+                        {"models": ["m1"], "backends": ["a"]}]}],
+                ),
+            )
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(url + "/v1/models") as resp:
+                        got = await resp.json()
+                assert [m["id"] for m in got["data"]] == ["m1"]
+            finally:
+                await stop_env(runner, ups)
+
+        run(main())
+
+
+class TestFallback:
+    def test_priority_failover(self):
+        async def main():
+            primary = FakeUpstream().on_json(
+                "/v1/chat/completions", {"error": "down"}, status=503
+            )
+            fallback = FakeUpstream().on_json(
+                "/v1/chat/completions", openai_chat_response("from fallback")
+            )
+            server, runner, url, ups = await start_env(
+                {"p": primary, "f": fallback},
+                lambda urls: make_config(
+                    [
+                        {"name": "p", "schema": "OpenAI", "url": urls["p"]},
+                        {"name": "f", "schema": "OpenAI", "url": urls["f"]},
+                    ],
+                    [{"name": "r", "rules": [{
+                        "models": ["m1"],
+                        "backends": [
+                            {"backend": "p", "priority": 0},
+                            {"backend": "f", "priority": 1},
+                        ],
+                    }]}],
+                ),
+            )
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(url + "/v1/chat/completions",
+                                      json=CHAT) as resp:
+                        assert resp.status == 200
+                        got = await resp.json()
+                assert got["choices"][0]["message"]["content"] == "from fallback"
+                assert len(primary.captured) == 1
+                assert len(fallback.captured) == 1
+            finally:
+                await stop_env(runner, ups)
+
+        run(main())
+
+    def test_cross_schema_failover(self):
+        """Primary OpenAI down → fallback is an *Anthropic* backend; the
+        retry re-translates the captured body (the two-phase design)."""
+
+        async def main():
+            primary = FakeUpstream().on_json(
+                "/v1/chat/completions", {"error": "down"}, status=500
+            )
+            fallback = FakeUpstream().on_json(
+                "/v1/messages",
+                {
+                    "id": "msg_1", "type": "message", "role": "assistant",
+                    "model": "claude", "stop_reason": "end_turn",
+                    "content": [{"type": "text", "text": "anthropic says hi"}],
+                    "usage": {"input_tokens": 3, "output_tokens": 4},
+                },
+            )
+            server, runner, url, ups = await start_env(
+                {"p": primary, "f": fallback},
+                lambda urls: make_config(
+                    [
+                        {"name": "p", "schema": "OpenAI", "url": urls["p"]},
+                        {"name": "f", "schema": "Anthropic", "url": urls["f"],
+                         "auth": {"kind": "AnthropicAPIKey", "api_key": "ak"}},
+                    ],
+                    [{"name": "r", "rules": [{
+                        "models": ["m1"],
+                        "backends": [
+                            {"backend": "p", "priority": 0},
+                            {"backend": "f", "priority": 1},
+                        ],
+                    }]}],
+                ),
+            )
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(url + "/v1/chat/completions",
+                                      json=CHAT) as resp:
+                        assert resp.status == 200
+                        got = await resp.json()
+                # client gets OpenAI format even though fallback is Anthropic
+                assert got["object"] == "chat.completion"
+                assert got["choices"][0]["message"]["content"] == "anthropic says hi"
+                cap = fallback.captured[0]
+                assert cap.headers["x-api-key"] == "ak"
+                assert cap.json["messages"][0]["content"][0]["text"] == "hi"
+            finally:
+                await stop_env(runner, ups)
+
+        run(main())
+
+    def test_exhausted_502(self):
+        async def main():
+            p = FakeUpstream().on_json(
+                "/v1/chat/completions", {"error": "x"}, status=500
+            )
+            server, runner, url, ups = await start_env(
+                {"p": p},
+                lambda urls: make_config(
+                    [{"name": "p", "schema": "OpenAI", "url": urls["p"]}],
+                    [{"name": "r", "rules": [
+                        {"models": ["m1"], "backends": ["p"]}]}],
+                ),
+            )
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(url + "/v1/chat/completions",
+                                      json=CHAT) as resp:
+                        assert resp.status == 500
+                        err = await resp.json()
+                        assert err["error"]["type"] == "upstream_error"
+            finally:
+                await stop_env(runner, ups)
+
+        run(main())
+
+
+class TestCostsAndMutations:
+    def test_cost_sink_and_header_mutation(self):
+        async def main():
+            sunk = []
+            up = FakeUpstream().on_json(
+                "/v1/chat/completions",
+                openai_chat_response(prompt_tokens=10, completion_tokens=20),
+            )
+            server, runner, url, ups = await start_env(
+                {"a": up},
+                lambda urls: make_config(
+                    [{
+                        "name": "a", "schema": "OpenAI", "url": urls["a"],
+                        "header_mutation": {
+                            "set": [{"name": "x-extra", "value": "1"}]},
+                        "body_mutation": {
+                            "set": [{"name": "temperature", "value": 0.1}]},
+                    }],
+                    [{"name": "r", "rules": [
+                        {"models": ["m1"], "backends": ["a"]}]}],
+                    costs=[
+                        {"metadata_key": "total", "type": "TotalToken"},
+                        {"metadata_key": "weighted", "type": "Expression",
+                         "expression": "input_tokens + 3 * output_tokens"},
+                    ],
+                ),
+                cost_sink=lambda costs, attrs: sunk.append((costs, attrs)),
+            )
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(url + "/v1/chat/completions",
+                                      json=CHAT) as resp:
+                        assert resp.status == 200
+                cap = up.captured[0]
+                assert cap.headers["x-extra"] == "1"
+                assert cap.json["temperature"] == 0.1
+                assert sunk[0][0] == {"total": 30, "weighted": 70}
+                assert sunk[0][1]["backend"] == "a"
+            finally:
+                await stop_env(runner, ups)
+
+        run(main())
+
+    def test_metrics_exported(self):
+        async def main():
+            up = FakeUpstream().on_json(
+                "/v1/chat/completions", openai_chat_response()
+            )
+            server, runner, url, ups = await start_env(
+                {"a": up},
+                lambda urls: make_config(
+                    [{"name": "a", "schema": "OpenAI", "url": urls["a"]}],
+                    [{"name": "r", "rules": [
+                        {"models": ["m1"], "backends": ["a"]}]}],
+                ),
+            )
+            try:
+                async with aiohttp.ClientSession() as s:
+                    await s.post(url + "/v1/chat/completions", json=CHAT)
+                    async with s.get(url + "/metrics") as resp:
+                        text = await resp.text()
+                assert "gen_ai_client_token_usage" in text
+                assert "gen_ai_server_request_duration_seconds" in text
+                assert 'aigw_requests_total{backend="a"' in text
+            finally:
+                await stop_env(runner, ups)
+
+        run(main())
+
+
+class TestAnthropicFront:
+    def test_messages_to_openai_backend(self):
+        async def main():
+            up = FakeUpstream().on_json(
+                "/v1/chat/completions", openai_chat_response("yo")
+            )
+            server, runner, url, ups = await start_env(
+                {"a": up},
+                lambda urls: make_config(
+                    [{"name": "a", "schema": "OpenAI", "url": urls["a"]}],
+                    [{"name": "r", "rules": [
+                        {"models": ["m1"], "backends": ["a"]}]}],
+                ),
+            )
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        url + "/v1/messages",
+                        json={"model": "m1", "max_tokens": 10,
+                              "messages": [{"role": "user", "content": "hi"}]},
+                    ) as resp:
+                        assert resp.status == 200
+                        got = await resp.json()
+                assert got["type"] == "message"
+                assert got["content"] == [{"type": "text", "text": "yo"}]
+            finally:
+                await stop_env(runner, ups)
+
+        run(main())

@@ -481,3 +481,97 @@ class TestEmbeddingsAndTokenize:
         assert tx.path == "/v1/messages/count_tokens"
         rx = t.response_body(json.dumps({"input_tokens": 11}).encode(), True)
         assert json.loads(rx.body)["count"] == 11
+
+
+class TestReviewFixes:
+    """Regression tests for code-review findings."""
+
+    IMG_MSG = {
+        "role": "user",
+        "content": [
+            {"type": "text", "text": "what is this?"},
+            {"type": "image_url",
+             "image_url": {"url": "data:image/jpeg;base64,QUJD"}},
+        ],
+    }
+
+    def test_bedrock_images(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI, S.AWS_BEDROCK)
+        body = json.loads(t.request({"model": "m", "messages": [self.IMG_MSG]}).body)
+        blocks = body["messages"][0]["content"]
+        assert blocks[0] == {"text": "what is this?"}
+        assert blocks[1]["image"]["format"] == "jpeg"
+        assert blocks[1]["image"]["source"]["bytes"] == "QUJD"
+
+    def test_gemini_images(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI, S.GCP_VERTEX_AI)
+        body = json.loads(t.request({"model": "m", "messages": [self.IMG_MSG]}).body)
+        parts = body["contents"][0]["parts"]
+        assert parts[1]["inlineData"] == {"mimeType": "image/jpeg", "data": "QUJD"}
+
+    def test_bedrock_no_empty_user_content(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI, S.AWS_BEDROCK)
+        body = json.loads(
+            t.request(
+                {"model": "m", "messages": [
+                    {"role": "user", "content": ""},
+                    {"role": "user", "content": "real"},
+                ]}
+            ).body
+        )
+        assert body["messages"] == [
+            {"role": "user", "content": [{"text": "real"}]}
+        ]
+
+    def test_bedrock_tool_choice_none_drops_tools(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI, S.AWS_BEDROCK)
+        req = dict(TOOL_REQ, tool_choice="none")
+        body = json.loads(t.request(json.loads(json.dumps(req))).body)
+        assert "toolConfig" not in body
+
+    def test_gemini_multi_candidates(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI, S.GCP_VERTEX_AI)
+        t.request({"model": "m", "n": 2,
+                   "messages": [{"role": "user", "content": "x"}]})
+        upstream = {
+            "candidates": [
+                {"content": {"parts": [{"text": "a"}]}, "finishReason": "STOP"},
+                {"content": {"parts": [{"text": "b"}]}, "finishReason": "STOP"},
+            ],
+            "usageMetadata": {"promptTokenCount": 1, "candidatesTokenCount": 2,
+                              "totalTokenCount": 3},
+        }
+        got = json.loads(t.response_body(json.dumps(upstream).encode(), True).body)
+        assert [c["message"]["content"] for c in got["choices"]] == ["a", "b"]
+        assert [c["index"] for c in got["choices"]] == [0, 1]
+
+    def test_gemini_stream_n_rejected(self):
+        from aigw_tpu.translate import TranslationError
+
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI, S.GCP_VERTEX_AI)
+        with pytest.raises(TranslationError, match="n>1"):
+            t.request({"model": "m", "n": 2, "stream": True,
+                       "messages": [{"role": "user", "content": "x"}]})
+
+    def test_azure_deployment_quoted(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI, S.AZURE_OPENAI)
+        tx = t.request({"model": "dep?x=1", "messages":
+                        [{"role": "user", "content": "x"}]})
+        assert "dep%3Fx%3D1" in tx.path and "?api-version=" in tx.path
+
+    def test_anthropic_front_stream_input_tokens(self):
+        t = get_translator(Endpoint.MESSAGES, S.ANTHROPIC, S.OPENAI)
+        t.request({"model": "c", "max_tokens": 5, "stream": True,
+                   "messages": [{"role": "user", "content": "hi"}]})
+        raw = (
+            b'data: {"choices":[{"index":0,"delta":{"content":"x"},'
+            b'"finish_reason":null}],"model":"g"}\n\n'
+            b'data: {"choices":[],"usage":{"prompt_tokens":7,'
+            b'"completion_tokens":1,"total_tokens":8}}\n\n'
+            b"data: [DONE]\n\n"
+        )
+        out = t.response_body(raw, False).body + t.response_body(b"", True).body
+        evs = sse_events(out)
+        md = json.loads([e for e in evs if e.event == "message_delta"][0].data)
+        assert md["usage"]["input_tokens"] == 7
+        assert md["usage"]["output_tokens"] == 1
