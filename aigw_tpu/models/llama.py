@@ -1,0 +1,282 @@
+"""Llama-family transformer as a pure functional JAX program.
+
+TPU-first design decisions (not a port of any torch implementation):
+
+- **bfloat16 everywhere** except RMSNorm accumulation and attention
+  softmax, which run in float32 — keeps the MXU fed while preserving
+  numerics (pallas_guide.md tiling: bf16 tiles are (16, 128)).
+- **Static shapes**: prefill is bucketed by padded sequence length, decode
+  is a fixed [max_batch, 1] step — each shape compiles exactly once.
+- **Paged KV cache**: the cache is a flat page pool
+  ``[L, 2, n_pages * page_size, n_kv_heads, head_dim]``; sequences own
+  pages via an int32 page table. Flattening pages makes cache writes one
+  scatter and cache reads one gather — both XLA-native ops that fuse well,
+  and the same layout the Pallas paged-attention kernel consumes
+  (PAPERS.md: Ragged Paged Attention for TPU).
+- **GQA**: K/V heads are kept un-repeated in the cache (HBM bandwidth is
+  the bottleneck); Q heads are grouped over KV heads inside attention.
+
+Weight layout is a flat dict pytree so `jax.sharding` partition specs can
+be assigned per-leaf by name (aigw_tpu/parallel/sharding.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+# Published Llama-3 architecture shapes (public model cards).
+LLAMA3_8B = LlamaConfig()
+LLAMA3_70B = LlamaConfig(
+    dim=8192, n_layers=80, n_heads=64, n_kv_heads=8, ffn_dim=28672
+)
+#: Tiny config for tests / CPU fake-chip mode (reference's testupstream role)
+TINY = LlamaConfig(
+    vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    ffn_dim=128, max_seq_len=512, rope_theta=10000.0,
+)
+
+
+def init_params(
+    key: jax.Array, cfg: LlamaConfig, dtype: Any = jnp.bfloat16
+) -> dict[str, jax.Array]:
+    """Random-init weights (testing / tiny-random serving)."""
+    keys = iter(jax.random.split(key, 4 + cfg.n_layers * 9))
+
+    def dense(shape, scale=None):
+        scale = scale or 1.0 / math.sqrt(shape[0])
+        return (jax.random.normal(next(keys), shape, jnp.float32) * scale).astype(
+            dtype
+        )
+
+    p: dict[str, jax.Array] = {
+        "embed": dense((cfg.vocab_size, cfg.dim), scale=0.02),
+        "norm_f": jnp.ones((cfg.dim,), dtype),
+    }
+    if not cfg.tie_embeddings:
+        p["lm_head"] = dense((cfg.dim, cfg.vocab_size))
+    hd = cfg.head_dim
+    for i in range(cfg.n_layers):
+        p[f"l{i}.attn_norm"] = jnp.ones((cfg.dim,), dtype)
+        p[f"l{i}.wq"] = dense((cfg.dim, cfg.n_heads * hd))
+        p[f"l{i}.wk"] = dense((cfg.dim, cfg.n_kv_heads * hd))
+        p[f"l{i}.wv"] = dense((cfg.dim, cfg.n_kv_heads * hd))
+        p[f"l{i}.wo"] = dense((cfg.n_heads * hd, cfg.dim))
+        p[f"l{i}.mlp_norm"] = jnp.ones((cfg.dim,), dtype)
+        p[f"l{i}.w_gate"] = dense((cfg.dim, cfg.ffn_dim))
+        p[f"l{i}.w_up"] = dense((cfg.dim, cfg.ffn_dim))
+        p[f"l{i}.w_down"] = dense((cfg.ffn_dim, cfg.dim))
+    return p
+
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    scale = lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * scale).astype(x.dtype) * w
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embeddings. x: [..., S, H, D], positions broadcastable [..., S]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = (
+        positions.astype(jnp.float32)[..., :, None, None] * freqs[None, None, :]
+    )  # [..., S, 1, D/2]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    o1 = xf1 * cos - xf2 * sin
+    o2 = xf2 * cos + xf1 * sin
+    out = jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+def _attention(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,  # [B, T, Hkv, D]
+    v: jax.Array,  # [B, T, Hkv, D]
+    mask: jax.Array,  # [B, S, T] bool, True = attend
+) -> jax.Array:
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    group = H // Hkv
+    qg = q.reshape(B, S, Hkv, group, D)
+    logits = jnp.einsum(
+        "bshgd,bthd->bhgst", qg, k, preferred_element_type=jnp.float32
+    )
+    logits = logits / math.sqrt(D)
+    logits = jnp.where(mask[:, None, None, :, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhgst,bthd->bshgd", probs.astype(v.dtype), v)
+    return out.reshape(B, S, H * D)
+
+
+def _project_qkv(p, i, x, positions, cfg):
+    hd = cfg.head_dim
+    B, S, _ = x.shape
+    q = (x @ p[f"l{i}.wq"]).reshape(B, S, cfg.n_heads, hd)
+    k = (x @ p[f"l{i}.wk"]).reshape(B, S, cfg.n_kv_heads, hd)
+    v = (x @ p[f"l{i}.wv"]).reshape(B, S, cfg.n_kv_heads, hd)
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+    return q, k, v
+
+
+def _mlp(p, i, x):
+    gate = jax.nn.silu(x @ p[f"l{i}.w_gate"])
+    return (gate * (x @ p[f"l{i}.w_up"])) @ p[f"l{i}.w_down"]
+
+
+def _logits(p: dict[str, jax.Array], cfg: LlamaConfig, x: jax.Array) -> jax.Array:
+    head = p["embed"].T if cfg.tie_embeddings else p["lm_head"]
+    return (x @ head).astype(jnp.float32)
+
+
+def prefill(
+    p: dict[str, jax.Array],
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [B, S] int32, right-padded
+    seq_lens: jax.Array,  # [B] int32 true lengths
+    kv_cache: jax.Array,  # [L, 2, P*page, Hkv, D]
+    page_table: jax.Array,  # [B, max_pages] int32 page ids
+    page_size: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Process prompts; returns (last-position logits [B, V], updated cache).
+
+    Prompt self-attention never reads the cache (the prompt is
+    self-contained); K/V are computed in-registers and scattered into the
+    page pool once at the end — one HBM write per layer.
+    """
+    B, S = tokens.shape
+    positions = jnp.arange(S, dtype=jnp.int32)[None, :].repeat(B, 0)
+    valid = positions < seq_lens[:, None]  # [B, S]
+    causal = positions[:, :, None] >= positions[:, None, :]
+    mask = causal & valid[:, None, :]
+
+    # flat cache slot per (b, s): page_table[b, s // page] * page + s % page
+    n_slots = kv_cache.shape[2]
+    slot = (
+        jnp.take_along_axis(page_table, positions // page_size, axis=1) * page_size
+        + positions % page_size
+    )  # [B, S]
+    x = jnp.take(p["embed"], tokens, axis=0)
+    for i in range(cfg.n_layers):
+        h = rms_norm(x, p[f"l{i}.attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(p, i, h, positions, cfg)
+        # padded positions scatter to an out-of-bounds slot, which
+        # mode="drop" discards (negative indices would wrap instead)
+        flat = jnp.where(valid, slot, n_slots)
+        kv_cache = kv_cache.at[i, 0, flat].set(k, mode="drop")
+        kv_cache = kv_cache.at[i, 1, flat].set(v, mode="drop")
+        attn = _attention(q, k, v, mask)
+        x = x + attn @ p[f"l{i}.wo"]
+        h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
+        x = x + _mlp(p, i, h)
+    x = rms_norm(x, p["norm_f"], cfg.norm_eps)
+    last = jnp.take_along_axis(
+        x, (seq_lens - 1)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    return _logits(p, cfg, last), kv_cache
+
+
+def decode_step(
+    p: dict[str, jax.Array],
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [B] int32 current token per slot
+    positions: jax.Array,  # [B] int32 position of `tokens`
+    kv_cache: jax.Array,
+    page_table: jax.Array,  # [B, max_pages]
+    page_size: int,
+    active: jax.Array,  # [B] bool slot occupied
+) -> tuple[jax.Array, jax.Array]:
+    """One continuous-batching decode step; returns (logits [B, V], cache).
+
+    The hot loop: fixed shapes, cache gathered per sequence window
+    [B, T_max] where T_max = max_pages * page_size. Inactive slots are
+    masked and write to dropped slots.
+    """
+    B = tokens.shape[0]
+    max_pages = page_table.shape[1]
+    T = max_pages * page_size
+    pos1 = positions[:, None]  # [B, 1]
+
+    n_slots = kv_cache.shape[2]
+    slot = (
+        jnp.take_along_axis(page_table, pos1 // page_size, axis=1) * page_size
+        + pos1 % page_size
+    )  # [B, 1]
+    slot = jnp.where(active[:, None], slot, n_slots)  # OOB → dropped
+
+    # gather the full (padded) KV window for each slot
+    t_idx = jnp.arange(T, dtype=jnp.int32)[None, :].repeat(B, 0)  # [B, T]
+    gslot = page_table[:, :, None] * page_size + jnp.arange(
+        page_size, dtype=jnp.int32
+    )
+    gslot = gslot.reshape(B, T)  # [B, T] flat cache indices
+    attend = t_idx <= pos1  # causal within the sequence window [B, T]
+
+    x = jnp.take(p["embed"], tokens[:, None], axis=0)  # [B, 1, dim]
+    for i in range(cfg.n_layers):
+        h = rms_norm(x, p[f"l{i}.attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(p, i, h, pos1, cfg)
+        kv_cache = kv_cache.at[i, 0, slot].set(k, mode="drop")
+        kv_cache = kv_cache.at[i, 1, slot].set(v, mode="drop")
+        k_all = kv_cache[i, 0][gslot]  # [B, T, Hkv, D]
+        v_all = kv_cache[i, 1][gslot]
+        attn = _attention(q, k_all, v_all, attend[:, None, :])
+        x = x + attn @ p[f"l{i}.wo"]
+        h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
+        x = x + _mlp(p, i, h)
+    x = rms_norm(x, p["norm_f"], cfg.norm_eps)
+    return _logits(p, cfg, x[:, 0]), kv_cache
+
+
+def hidden_states(
+    p: dict[str, jax.Array],
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [B, S]
+    seq_lens: jax.Array,  # [B]
+) -> jax.Array:
+    """Mean-pooled final hidden states (the /v1/embeddings path)."""
+    B, S = tokens.shape
+    positions = jnp.arange(S, dtype=jnp.int32)[None, :].repeat(B, 0)
+    valid = positions < seq_lens[:, None]
+    causal = positions[:, :, None] >= positions[:, None, :]
+    mask = causal & valid[:, None, :]
+    x = jnp.take(p["embed"], tokens, axis=0)
+    for i in range(cfg.n_layers):
+        h = rms_norm(x, p[f"l{i}.attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(p, i, h, positions, cfg)
+        x = x + _attention(q, k, v, mask) @ p[f"l{i}.wo"]
+        h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
+        x = x + _mlp(p, i, h)
+    x = rms_norm(x, p["norm_f"], cfg.norm_eps)
+    w = valid[..., None].astype(jnp.float32)
+    pooled = (x.astype(jnp.float32) * w).sum(1) / jnp.maximum(w.sum(1), 1.0)
+    return pooled

@@ -1,0 +1,45 @@
+"""Model registry: name → (family, config, weight source).
+
+The serving engine resolves ``--model`` through this registry. Weight
+sources: ``random`` (tiny test models — the fake-chip mode the reference
+achieves with testupstream), ``orbax:<path>`` sharded checkpoints, or
+``hf:<path>`` local safetensors (no network).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from aigw_tpu.models import llama
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    name: str
+    family: str  # "llama" | "mixtral"
+    config: Any
+    weights: str = "random"  # "random" | "orbax:<dir>" | "hf:<dir>"
+    tokenizer: str = "byte"  # "byte" | path to tokenizer.json
+
+
+_REGISTRY: dict[str, ModelSpec] = {}
+
+
+def register_model(spec: ModelSpec) -> None:
+    _REGISTRY[spec.name] = spec
+
+
+def get_model_spec(name: str) -> ModelSpec:
+    if name in _REGISTRY:
+        return _REGISTRY[name]
+    raise KeyError(
+        f"unknown model {name!r}; registered: {sorted(_REGISTRY)}"
+    )
+
+
+register_model(ModelSpec("tiny-random", "llama", llama.TINY))
+register_model(ModelSpec("llama-3-8b", "llama", llama.LLAMA3_8B,
+                         weights="orbax:checkpoints/llama-3-8b"))
+register_model(ModelSpec("llama-3-70b", "llama", llama.LLAMA3_70B,
+                         weights="orbax:checkpoints/llama-3-70b"))

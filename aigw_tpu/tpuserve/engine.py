@@ -1,0 +1,406 @@
+"""Continuous-batching engine.
+
+The TPU-native scheduler design (not a vLLM port):
+
+- **Fixed decode geometry**: decode runs a single jit-compiled program of
+  shape [max_batch, 1] every tick; finished slots are masked, not removed,
+  so there is exactly ONE compiled decode program for the engine lifetime.
+- **Bucketed prefill**: prompts are right-padded to power-of-two buckets so
+  the number of compiled prefill programs is log(max_seq_len).
+- **Sampling fused into the step**: logits never leave the device — each
+  tick transfers only [max_batch] int32 sampled tokens to the host.
+- **Donated cache**: the paged KV pool is donated through every step, so
+  XLA updates it in place (no per-tick HBM copy of the cache).
+- **Engine thread**: the loop runs in its own thread; JAX dispatch is
+  async, so the thread overlaps host bookkeeping with device compute.
+  Tokens flow back to asyncio consumers via loop.call_soon_threadsafe.
+
+Telemetry (KV occupancy, queue depth, active slots) feeds the endpoint
+picker — the reference's EPP signal (SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from aigw_tpu.models import llama
+from aigw_tpu.tpuserve.kvcache import OutOfPagesError, PageAllocator
+from aigw_tpu.tpuserve.sampling import SamplingParams, sample
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class EngineConfig:
+    max_batch_size: int = 8
+    max_seq_len: int = 2048
+    page_size: int = 128
+    num_pages: int = 0  # 0 = auto: enough for max_batch full sequences
+    min_prefill_bucket: int = 64
+
+    def __post_init__(self) -> None:
+        if self.max_seq_len % self.page_size != 0:
+            raise ValueError(
+                f"max_seq_len ({self.max_seq_len}) must be a multiple of "
+                f"page_size ({self.page_size})"
+            )
+        if self.num_pages == 0:
+            self.num_pages = (
+                self.max_batch_size * self.max_seq_len // self.page_size
+            )
+
+    @property
+    def max_pages_per_seq(self) -> int:
+        return self.max_seq_len // self.page_size
+
+
+@dataclass
+class GenRequest:
+    prompt: list[int]
+    max_tokens: int
+    sampling: SamplingParams
+    stop_token_ids: tuple[int, ...] = ()
+    # (token_id, finish_reason): token_id < 0 means no token, just finish
+    emit: Callable[[int, str | None], None] = lambda t, f: None
+    id: int = 0
+    enqueued_at: float = field(default_factory=time.monotonic)
+    # set by the consumer to abandon the request (client disconnect / stop
+    # sequence hit); the engine frees the slot at the next tick
+    cancelled: threading.Event = field(default_factory=threading.Event)
+
+
+@dataclass
+class _Slot:
+    req: GenRequest
+    # Position at which the *pending input token* (self._tokens[slot]) will
+    # be written by the next decode step. After prefilling a prompt of
+    # length n, the first sampled token is the pending input at position n.
+    pos: int
+    generated: int
+    key_seed: int
+
+
+@dataclass
+class EngineStats:
+    active_slots: int = 0
+    queued: int = 0
+    kv_pages_free: int = 0
+    kv_occupancy: float = 0.0
+    tokens_generated: int = 0
+    prefills: int = 0
+    decode_steps: int = 0
+
+
+class Engine:
+    """One model instance on one chip/slice."""
+
+    def __init__(
+        self,
+        params: dict[str, jax.Array],
+        model_cfg: llama.LlamaConfig,
+        cfg: EngineConfig,
+        eos_token_ids: tuple[int, ...] = (),
+        mesh: Any = None,
+    ):
+        self.params = params
+        self.model_cfg = model_cfg
+        self.cfg = cfg
+        self.eos = eos_token_ids
+        self.allocator = PageAllocator(cfg.num_pages, cfg.page_size)
+        self.stats = EngineStats()
+        self.healthy = True
+        self.last_error: str | None = None
+
+        B = cfg.max_batch_size
+        self._slots: list[_Slot | None] = [None] * B
+        self._queue: "queue.Queue[GenRequest]" = queue.Queue()
+        self._seq_ids = itertools.count()
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: threading.Thread | None = None
+
+        # device state
+        self.kv_cache = jnp.zeros(
+            (
+                model_cfg.n_layers,
+                2,
+                cfg.num_pages * cfg.page_size,
+                model_cfg.n_kv_heads,
+                model_cfg.head_dim,
+            ),
+            jnp.bfloat16,
+        )
+        # host mirrors of per-slot arrays
+        self._page_table = np.zeros((B, cfg.max_pages_per_seq), np.int32)
+        self._tokens = np.zeros((B,), np.int32)
+        self._positions = np.zeros((B,), np.int32)
+        self._active = np.zeros((B,), bool)
+        self._temp = np.ones((B,), np.float32)
+        self._top_p = np.ones((B,), np.float32)
+        self._top_k = np.zeros((B,), np.int32)
+
+        mc, ps = model_cfg, cfg.page_size
+
+        def _prefill_step(params, tokens, seq_lens, kv, page_table, keys,
+                          temp, top_p, top_k):
+            logits, kv = llama.prefill(params, mc, tokens, seq_lens, kv,
+                                       page_table, ps)
+            return sample(logits, keys, temp, top_p, top_k), kv
+
+        def _decode_step(params, tokens, positions, kv, page_table, active,
+                         keys, temp, top_p, top_k):
+            logits, kv = llama.decode_step(params, mc, tokens, positions, kv,
+                                           page_table, ps, active)
+            return sample(logits, keys, temp, top_p, top_k), kv
+
+        self._prefill_fn = jax.jit(_prefill_step, donate_argnums=(3,))
+        self._decode_fn = jax.jit(_decode_step, donate_argnums=(3,))
+
+    # -- public API -------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="tpuserve-engine", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def submit(self, req: GenRequest) -> None:
+        if len(req.prompt) + req.max_tokens > self.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt+max_tokens {len(req.prompt)}+{req.max_tokens} exceeds "
+                f"max_seq_len {self.cfg.max_seq_len}"
+            )
+        self._queue.put(req)
+        self._wake.set()
+
+    def warmup(self) -> None:
+        """Compile the decode program before traffic arrives (the first
+        request then only pays the prefill compile for its bucket)."""
+        B = self.cfg.max_batch_size
+        _, self.kv_cache = self._decode_fn(
+            self.params,
+            jnp.zeros((B,), jnp.int32),
+            jnp.zeros((B,), jnp.int32),
+            self.kv_cache,
+            jnp.asarray(self._page_table),
+            jnp.zeros((B,), bool),
+            jnp.zeros((B, 2), jnp.uint32),
+            jnp.ones((B,), jnp.float32),
+            jnp.ones((B,), jnp.float32),
+            jnp.zeros((B,), jnp.int32),
+        )
+
+    # -- engine loop ------------------------------------------------------
+    def _run(self) -> None:
+        logger.info("engine loop started (batch=%d, pages=%d×%d)",
+                    self.cfg.max_batch_size, self.cfg.num_pages,
+                    self.cfg.page_size)
+        while not self._stop.is_set():
+            try:
+                self._reap_cancelled()
+                admitted = self._admit()
+                worked = self._decode_tick()
+            except Exception as e:  # never die silently: fail loudly and
+                # error out every in-flight request instead of hanging them
+                logger.exception("engine tick failed")
+                self.healthy = False
+                self.last_error = f"{type(e).__name__}: {e}"
+                self._abort_all(str(e))
+                return
+            if not admitted and not worked:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+        logger.info("engine loop stopped")
+
+    def _abort_all(self, reason: str) -> None:
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                s.req.emit(-1, "error")
+                self.allocator.free(s.req.id)
+                self._slots[i] = None
+        try:
+            while True:
+                req = self._queue.get_nowait()
+                req.emit(-1, "error")
+        except queue.Empty:
+            pass
+
+    def _reap_cancelled(self) -> None:
+        for i, s in enumerate(self._slots):
+            if s is not None and s.req.cancelled.is_set():
+                self.allocator.free(s.req.id)
+                self._slots[i] = None
+                self._active[i] = False
+
+    def _free_slot_index(self) -> int | None:
+        for i, s in enumerate(self._slots):
+            if s is None:
+                return i
+        return None
+
+    def _admit(self) -> bool:
+        """Admit queued requests: prefill + first token."""
+        admitted = False
+        while True:
+            slot_idx = self._free_slot_index()
+            if slot_idx is None:
+                break
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if req.cancelled.is_set():
+                continue
+            n = len(req.prompt)
+            total = min(n + req.max_tokens, self.cfg.max_seq_len)
+            seq_id = next(self._seq_ids)
+            try:
+                self.allocator.allocate(seq_id, total)
+            except OutOfPagesError:
+                # put it back and wait for a slot to free pages
+                self._requeue_front(req)
+                break
+            pages = self.allocator.pages(seq_id)
+            req.id = seq_id
+
+            # bucketed padded length
+            S = self.cfg.min_prefill_bucket
+            while S < n:
+                S *= 2
+            S = min(S, self.cfg.max_seq_len)
+            tokens = np.zeros((1, S), np.int32)
+            tokens[0, :n] = req.prompt
+            pt = np.zeros((1, self.cfg.max_pages_per_seq), np.int32)
+            pt[0, : len(pages)] = pages
+
+            key = np.array([[req.sampling.seed or seq_id, 0]], np.uint32)
+            t0 = time.monotonic()
+            next_tok, self.kv_cache = self._prefill_fn(
+                self.params,
+                jnp.asarray(tokens),
+                jnp.asarray([n], jnp.int32),
+                self.kv_cache,
+                jnp.asarray(pt),
+                jnp.asarray(key),
+                jnp.asarray([req.sampling.temperature], jnp.float32),
+                jnp.asarray([req.sampling.top_p], jnp.float32),
+                jnp.asarray([req.sampling.top_k], jnp.int32),
+            )
+            tok = int(next_tok[0])
+            self.stats.prefills += 1
+            logger.debug("prefill seq=%d len=%d bucket=%d %.1fms",
+                         seq_id, n, S, 1e3 * (time.monotonic() - t0))
+
+            # pos=n-1: _emit_token advances it to n, the write position of
+            # the just-sampled first token.
+            self._slots[slot_idx] = _Slot(req=req, pos=n - 1, generated=0,
+                                          key_seed=req.sampling.seed or seq_id)
+            self._page_table[slot_idx] = pt[0]
+            self._install_sampling(slot_idx, req.sampling)
+            self._emit_token(slot_idx, tok)
+            admitted = True
+        return admitted
+
+    def _requeue_front(self, req: GenRequest) -> None:
+        # queue.Queue has no push-front; use a tiny shim list
+        items = [req]
+        try:
+            while True:
+                items.append(self._queue.get_nowait())
+        except queue.Empty:
+            pass
+        for it in items:
+            self._queue.put(it)
+
+    def _install_sampling(self, i: int, sp: SamplingParams) -> None:
+        self._temp[i] = sp.temperature
+        self._top_p[i] = sp.top_p
+        self._top_k[i] = sp.top_k
+
+    def _decode_tick(self) -> bool:
+        active_idx = [i for i, s in enumerate(self._slots) if s is not None]
+        if not active_idx:
+            self.stats.active_slots = 0
+            self._refresh_stats()
+            return False
+        for i in active_idx:
+            s = self._slots[i]
+            self._positions[i] = s.pos
+            self._active[i] = True
+        for i in range(len(self._slots)):
+            if self._slots[i] is None:
+                self._active[i] = False
+
+        # per-slot deterministic PRNG keys: (seed, position)
+        keys = np.zeros((len(self._slots), 2), np.uint32)
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                keys[i, 0] = np.uint32(s.key_seed & 0xFFFFFFFF)
+                keys[i, 1] = np.uint32(s.pos)
+
+        next_tok, self.kv_cache = self._decode_fn(
+            self.params,
+            jnp.asarray(self._tokens),
+            jnp.asarray(self._positions),
+            self.kv_cache,
+            jnp.asarray(self._page_table),
+            jnp.asarray(self._active),
+            jnp.asarray(keys),
+            jnp.asarray(self._temp),
+            jnp.asarray(self._top_p),
+            jnp.asarray(self._top_k),
+        )
+        toks = np.asarray(next_tok)
+        self.stats.decode_steps += 1
+        for i in active_idx:
+            self._emit_token(i, int(toks[i]))
+        self.stats.active_slots = sum(s is not None for s in self._slots)
+        self._refresh_stats()
+        return True
+
+    def _emit_token(self, i: int, tok: int) -> None:
+        """Record one generated token for slot i; finish if stopping."""
+        s = self._slots[i]
+        assert s is not None
+        req = s.req
+        s.generated += 1
+        finish: str | None = None
+        if tok in self.eos or tok in req.stop_token_ids:
+            finish = "stop"
+            req.emit(-1, finish)
+        else:
+            s.pos += 1  # where `tok` will be written by the next decode
+            if s.generated >= req.max_tokens or s.pos >= self.cfg.max_seq_len:
+                finish = "length"
+            req.emit(tok, finish)
+        self.stats.tokens_generated += 1
+        if finish is not None:
+            self.allocator.free(req.id)
+            self._slots[i] = None
+            self._active[i] = False
+            self._wake.set()  # maybe admit a queued request
+        else:
+            # the sampled token is the input of the next decode step
+            self._tokens[i] = tok
+
+    def _refresh_stats(self) -> None:
+        self.stats.queued = self._queue.qsize()
+        self.stats.kv_pages_free = self.allocator.free_pages
+        self.stats.kv_occupancy = self.allocator.occupancy

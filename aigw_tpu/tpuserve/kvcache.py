@@ -1,0 +1,82 @@
+"""Host-side paged KV cache bookkeeping.
+
+The device side is a flat page pool (models/llama.py); this allocator owns
+which pages belong to which sequence. Free pages are a LIFO stack — O(1)
+alloc/free, no fragmentation by construction (pages are fixed-size).
+
+The occupancy numbers exported here are the load-balancing signal for the
+endpoint picker (BASELINE.json north star: pick pods by KV-cache
+occupancy), the role the reference's EPP plays via
+``x-gateway-destination-endpoint`` (reference inferencepool.go:47).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+class OutOfPagesError(Exception):
+    """KV pool exhausted — request must wait in queue."""
+
+
+@dataclass
+class PageAllocator:
+    num_pages: int
+    page_size: int
+    _free: list[int] = field(default_factory=list)
+    _owned: dict[int, list[int]] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        self._free = list(range(self.num_pages - 1, -1, -1))
+
+    # -- allocation -------------------------------------------------------
+    def pages_for(self, n_tokens: int) -> int:
+        return max(1, -(-n_tokens // self.page_size))
+
+    def can_allocate(self, n_tokens: int) -> bool:
+        return len(self._free) >= self.pages_for(n_tokens)
+
+    def allocate(self, seq_id: int, n_tokens: int) -> list[int]:
+        need = self.pages_for(n_tokens)
+        if len(self._free) < need:
+            raise OutOfPagesError(
+                f"need {need} pages, {len(self._free)} free"
+            )
+        pages = [self._free.pop() for _ in range(need)]
+        self._owned.setdefault(seq_id, []).extend(pages)
+        return pages
+
+    def extend(self, seq_id: int, new_total_tokens: int) -> list[int]:
+        """Grow a sequence to cover new_total_tokens; returns new pages."""
+        owned = self._owned.get(seq_id, [])
+        need = self.pages_for(new_total_tokens) - len(owned)
+        if need <= 0:
+            return []
+        if len(self._free) < need:
+            raise OutOfPagesError(
+                f"extend needs {need} pages, {len(self._free)} free"
+            )
+        pages = [self._free.pop() for _ in range(need)]
+        owned.extend(pages)
+        self._owned[seq_id] = owned
+        return pages
+
+    def free(self, seq_id: int) -> None:
+        for page in self._owned.pop(seq_id, []):
+            self._free.append(page)
+
+    def pages(self, seq_id: int) -> list[int]:
+        return self._owned.get(seq_id, [])
+
+    # -- telemetry (the picker signal) ------------------------------------
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return self.num_pages - len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        return self.used_pages / self.num_pages if self.num_pages else 1.0

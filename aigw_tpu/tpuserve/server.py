@@ -1,0 +1,486 @@
+"""tpuserve HTTP server — the OpenAI-compatible surface over the engine.
+
+Endpoints: /v1/chat/completions (stream + non-stream), /v1/completions,
+/v1/embeddings, /tokenize (vLLM-compatible, reference mainlib/main.go:326),
+/v1/models, /health, /metrics, and /state — the KV-occupancy/queue-depth
+telemetry consumed by the gateway's endpoint picker (the reference's EPP
+protocol speaks ext_proc; ours is a plain JSON poll + the same
+``x-gateway-destination-endpoint`` contract, internalapi.go:76).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+import uuid
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from aiohttp import web
+
+from aigw_tpu.gateway.costs import TokenUsage
+from aigw_tpu.models import llama
+from aigw_tpu.models.registry import get_model_spec
+from aigw_tpu.obs.metrics import GenAIMetrics, RequestMetrics
+from aigw_tpu.schemas import openai as oai
+from aigw_tpu.translate.sse import SSEEvent
+from aigw_tpu.tpuserve.engine import Engine, EngineConfig, GenRequest
+from aigw_tpu.tpuserve.sampling import SamplingParams
+from aigw_tpu.tpuserve.tokenizer import (
+    StreamingDecoder,
+    apply_chat_template,
+    load_tokenizer,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def _find_stop(text: str, stop_strs: list[str]) -> int | None:
+    """Earliest index where a stop sequence begins, or None."""
+    best = None
+    for s in stop_strs:
+        if not s:
+            continue
+        i = text.find(s)
+        if i >= 0 and (best is None or i < best):
+            best = i
+    return best
+
+
+class TPUServeServer:
+    def __init__(
+        self,
+        model: str,
+        engine_cfg: EngineConfig,
+        metrics: GenAIMetrics | None = None,
+    ):
+        self.model_name = model
+        spec = get_model_spec(model)
+        if spec.family != "llama":
+            raise ValueError(f"unsupported family {spec.family}")
+        self.model_cfg = spec.config
+        self.tokenizer = load_tokenizer(spec.tokenizer)
+        self.metrics = metrics or GenAIMetrics()
+
+        params = self._load_params(spec)
+        self.engine = Engine(
+            params,
+            self.model_cfg,
+            engine_cfg,
+            eos_token_ids=(self.tokenizer.eos_id,),
+        )
+        # jitted embeddings path (bucketed like prefill)
+        self._hidden_fn = jax.jit(
+            lambda p, t, l: llama.hidden_states(p, self.model_cfg, t, l)
+        )
+
+        self.app = web.Application()
+        self.app.router.add_post("/v1/chat/completions", self._chat)
+        self.app.router.add_post("/v1/completions", self._completions)
+        self.app.router.add_post("/v1/embeddings", self._embeddings)
+        self.app.router.add_post("/tokenize", self._tokenize)
+        self.app.router.add_get("/v1/models", self._models)
+        self.app.router.add_get("/health", self._health)
+        self.app.router.add_get("/state", self._state)
+        self.app.router.add_get("/metrics", self._metrics)
+        self.app.on_startup.append(self._on_start)
+        self.app.on_cleanup.append(self._on_stop)
+
+    def _load_params(self, spec) -> dict[str, jax.Array]:
+        if spec.weights == "random":
+            logger.info("initializing random weights for %s", spec.name)
+            return llama.init_params(jax.random.PRNGKey(0), self.model_cfg)
+        if spec.weights.startswith("orbax:"):
+            import orbax.checkpoint as ocp
+
+            path = spec.weights[len("orbax:") :]
+            logger.info("restoring orbax checkpoint %s", path)
+            ckptr = ocp.StandardCheckpointer()
+            shapes = jax.eval_shape(
+                lambda: llama.init_params(jax.random.PRNGKey(0), self.model_cfg)
+            )
+            return ckptr.restore(path, shapes)
+        raise ValueError(f"unsupported weight source {spec.weights}")
+
+    async def _on_start(self, _app) -> None:
+        self.engine.start()
+        # compile the decode program off the request path
+        await asyncio.to_thread(self.engine.warmup)
+
+    async def _on_stop(self, _app) -> None:
+        self.engine.stop()
+
+    # -- helpers ----------------------------------------------------------
+    def _submit(self, prompt: list[int], body: dict[str, Any]):
+        """Submit to the engine; returns an asyncio.Queue of
+        (token_id, finish_reason) tuples."""
+        loop = asyncio.get_running_loop()
+        out: asyncio.Queue = asyncio.Queue()
+
+        def emit(tok: int, finish: str | None) -> None:
+            loop.call_soon_threadsafe(out.put_nowait, (tok, finish))
+
+        max_tokens = int(
+            body.get("max_completion_tokens") or body.get("max_tokens") or 256
+        )
+        stop_ids: tuple[int, ...] = ()
+        req = GenRequest(
+            prompt=prompt,
+            max_tokens=max_tokens,
+            sampling=SamplingParams.from_request(body),
+            stop_token_ids=stop_ids,
+            emit=emit,
+        )
+        self.engine.submit(req)
+        return out, req
+
+    # -- endpoints --------------------------------------------------------
+    async def _chat(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = oai.parse_json_body(await request.read())
+            oai.validate_chat_request(body)
+        except oai.SchemaError as e:
+            return web.Response(status=400, body=oai.error_body(str(e)),
+                                content_type="application/json")
+        prompt = apply_chat_template(body["messages"], self.tokenizer)
+        return await self._generate(request, body, prompt, chat=True)
+
+    async def _completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = oai.parse_json_body(await request.read())
+            oai.request_model(body)
+        except oai.SchemaError as e:
+            return web.Response(status=400, body=oai.error_body(str(e)),
+                                content_type="application/json")
+        prompt_text = body.get("prompt", "")
+        if isinstance(prompt_text, list):
+            prompt_text = "".join(prompt_text)
+        prompt = [self.tokenizer.bos_id] + self.tokenizer.encode(prompt_text)
+        return await self._generate(request, body, prompt, chat=False)
+
+    async def _generate(
+        self,
+        request: web.Request,
+        body: dict[str, Any],
+        prompt: list[int],
+        chat: bool,
+    ) -> web.StreamResponse:
+        stream = bool(body.get("stream", False))
+        include_usage = oai.include_stream_usage(body)
+        rid = (
+            f"chatcmpl-{uuid.uuid4().hex[:24]}"
+            if chat
+            else f"cmpl-{uuid.uuid4().hex[:24]}"
+        )
+        created = int(time.time())
+        rm = RequestMetrics(
+            metrics=self.metrics,
+            operation="chat" if chat else "text_completion",
+            provider="tpuserve",
+            request_model=body.get("model", self.model_name),
+            response_model=self.model_name,
+        )
+        stops = body.get("stop")
+        stop_strs: list[str] = (
+            [stops] if isinstance(stops, str) else list(stops or [])
+        )
+        try:
+            out, gen_req = self._submit(prompt, body)
+        except ValueError as e:
+            return web.Response(status=400, body=oai.error_body(str(e)),
+                                content_type="application/json")
+
+        n_prompt = len(prompt)
+        if not stream:
+            try:
+                text, n_out, finish = await self._collect(out, stop_strs)
+            except asyncio.CancelledError:
+                gen_req.cancelled.set()
+                raise
+            usage = TokenUsage(
+                input_tokens=n_prompt,
+                output_tokens=n_out,
+                total_tokens=n_prompt + n_out,
+            )
+            rm.finish(usage, error_type="engine" if finish == "error"
+                      else "")
+            if finish == "error":
+                return web.Response(
+                    status=500,
+                    body=oai.error_body("engine failure", type_="server_error"),
+                    content_type="application/json",
+                )
+            if chat:
+                resp = oai.chat_completion_response(
+                    model=self.model_name, content=text,
+                    finish_reason=finish, usage=usage, response_id=rid,
+                )
+            else:
+                resp = {
+                    "id": rid,
+                    "object": "text_completion",
+                    "created": created,
+                    "model": self.model_name,
+                    "choices": [
+                        {"index": 0, "text": text, "finish_reason": finish}
+                    ],
+                    "usage": oai.usage_dict(usage),
+                }
+            return web.json_response(resp)
+
+        # streaming
+        resp = web.StreamResponse(
+            status=200,
+            headers={"content-type": "text/event-stream",
+                     "cache-control": "no-cache"},
+        )
+        await resp.prepare(request)
+        decoder = StreamingDecoder(self.tokenizer)
+        emitted = ""
+        n_out = 0
+        finish = "stop"
+
+        async def write_piece(piece: str) -> None:
+            if not piece:
+                return
+            if chat:
+                await resp.write(
+                    oai.stream_chunk_sse(
+                        response_id=rid, model=self.model_name,
+                        created=created, delta={"content": piece},
+                    )
+                )
+            else:
+                await resp.write(
+                    SSEEvent(
+                        data=json.dumps(
+                            {
+                                "id": rid,
+                                "object": "text_completion",
+                                "created": created,
+                                "model": self.model_name,
+                                "choices": [
+                                    {"index": 0, "text": piece,
+                                     "finish_reason": None}
+                                ],
+                            }
+                        )
+                    ).encode()
+                )
+
+        try:
+            if chat:
+                await resp.write(
+                    oai.stream_chunk_sse(
+                        response_id=rid, model=self.model_name,
+                        created=created,
+                        delta={"role": "assistant", "content": ""},
+                    )
+                )
+            while True:
+                tok, fin = await out.get()
+                if tok >= 0:
+                    n_out += 1
+                    rm.record_tokens_emitted(1)
+                    piece = decoder.push(tok)
+                    if piece:
+                        emitted += piece
+                        hit = _find_stop(emitted, stop_strs)
+                        if hit is not None:
+                            # trim to just before the stop sequence
+                            keep = hit - (len(emitted) - len(piece))
+                            await write_piece(piece[:max(keep, 0)])
+                            finish = "stop"
+                            gen_req.cancelled.set()
+                            break
+                        await write_piece(piece)
+                if fin is not None:
+                    finish = fin
+                    if fin != "error":
+                        await write_piece(decoder.flush())
+                    break
+        except (asyncio.CancelledError, ConnectionResetError):
+            # client went away: stop generating, free the slot
+            gen_req.cancelled.set()
+            raise
+        usage = TokenUsage(
+            input_tokens=n_prompt, output_tokens=n_out,
+            total_tokens=n_prompt + n_out,
+        )
+        rm.finish(usage)
+        await resp.write(
+            oai.stream_chunk_sse(
+                response_id=rid, model=self.model_name, created=created,
+                delta={}, finish_reason=finish,
+                usage=usage if include_usage else None,
+            )
+        )
+        await resp.write(SSEEvent(data="[DONE]").encode())
+        await resp.write_eof()
+        return resp
+
+    async def _collect(
+        self, out: asyncio.Queue, stop_strs: list[str]
+    ) -> tuple[str, int, str]:
+        """Drain a generation to completion (non-streaming path)."""
+        decoder = StreamingDecoder(self.tokenizer)
+        text = ""
+        n_out = 0
+        finish = "stop"
+        while True:
+            tok, fin = await out.get()
+            if tok >= 0:
+                n_out += 1
+                text += decoder.push(tok)
+                hit = _find_stop(text, stop_strs)
+                if hit is not None:
+                    return text[:hit], n_out, "stop"
+            if fin is not None:
+                finish = fin
+                if fin != "error":
+                    text += decoder.flush()
+                return text, n_out, finish
+
+    async def _embeddings(self, request: web.Request) -> web.Response:
+        try:
+            body = oai.parse_json_body(await request.read())
+        except oai.SchemaError as e:
+            return web.Response(status=400, body=oai.error_body(str(e)),
+                                content_type="application/json")
+        raw = body.get("input")
+        if isinstance(raw, str):
+            items: list = [raw]
+        elif isinstance(raw, list) and raw and all(
+            isinstance(x, int) for x in raw
+        ):
+            items = [raw]  # a single pre-tokenized input
+        elif isinstance(raw, list):
+            items = list(raw)
+        else:
+            items = []
+        if not items:
+            return web.Response(
+                status=400,
+                body=oai.error_body(
+                    "input must be a string, array of strings, or array of "
+                    "token ids"
+                ),
+                content_type="application/json",
+            )
+        max_len = self.engine.cfg.max_seq_len
+        encoded = []
+        for it in items:
+            if isinstance(it, str):
+                encoded.append(self.tokenizer.encode(it)[:max_len])
+            elif isinstance(it, list) and all(isinstance(x, int) for x in it):
+                encoded.append([x % self.model_cfg.vocab_size for x in it][:max_len])
+            else:
+                return web.Response(
+                    status=400,
+                    body=oai.error_body("invalid embeddings input element"),
+                    content_type="application/json",
+                )
+        S = max(8, max(len(e) for e in encoded))
+        S = 1 << (S - 1).bit_length()  # pow2 bucket to bound compiles
+        toks = np.zeros((len(encoded), S), np.int32)
+        lens = np.zeros((len(encoded),), np.int32)
+        for i, e in enumerate(encoded):
+            toks[i, : len(e)] = e
+            lens[i] = len(e)
+        hidden = await asyncio.to_thread(
+            lambda: np.asarray(
+                self._hidden_fn(self.engine.params, jnp.asarray(toks),
+                                jnp.asarray(lens))
+            )
+        )
+        n_tokens = int(lens.sum())
+        usage = TokenUsage(input_tokens=n_tokens, total_tokens=n_tokens)
+        return web.json_response(
+            oai.embeddings_response(
+                model=self.model_name,
+                vectors=[h.tolist() for h in hidden],
+                usage=usage,
+            )
+        )
+
+    async def _tokenize(self, request: web.Request) -> web.Response:
+        try:
+            body = oai.parse_json_body(await request.read())
+        except oai.SchemaError as e:
+            return web.Response(status=400, body=oai.error_body(str(e)),
+                                content_type="application/json")
+        if isinstance(body.get("messages"), list):
+            ids = apply_chat_template(body["messages"], self.tokenizer)
+        else:
+            ids = self.tokenizer.encode(str(body.get("prompt", "")))
+        return web.json_response(
+            {
+                "count": len(ids),
+                "max_model_len": self.engine.cfg.max_seq_len,
+                "tokens": ids,
+            }
+        )
+
+    async def _models(self, _request: web.Request) -> web.Response:
+        return web.json_response(
+            oai.models_response([(self.model_name, "tpuserve", 0)])
+        )
+
+    async def _health(self, _request: web.Request) -> web.Response:
+        if not self.engine.healthy:
+            return web.json_response(
+                {"status": "error", "model": self.model_name,
+                 "error": self.engine.last_error},
+                status=503,
+            )
+        return web.json_response({"status": "ok", "model": self.model_name})
+
+    async def _state(self, _request: web.Request) -> web.Response:
+        """Endpoint-picker telemetry (KV occupancy + queue depth)."""
+        s = self.engine.stats
+        return web.json_response(
+            {
+                "model": self.model_name,
+                "active_slots": s.active_slots,
+                "max_slots": self.engine.cfg.max_batch_size,
+                "queued": s.queued,
+                "kv_pages_free": s.kv_pages_free,
+                "kv_occupancy": s.kv_occupancy,
+                "tokens_generated": s.tokens_generated,
+                "decode_steps": s.decode_steps,
+            }
+        )
+
+    async def _metrics(self, _request: web.Request) -> web.Response:
+        return web.Response(body=self.metrics.export(),
+                            content_type="text/plain")
+
+
+async def run_tpuserve(
+    model: str,
+    host: str = "127.0.0.1",
+    port: int = 8011,
+    max_batch_size: int = 8,
+    max_seq_len: int = 2048,
+    page_size: int = 128,
+    hbm_pages: int = 0,
+) -> web.AppRunner:
+    server = TPUServeServer(
+        model,
+        EngineConfig(
+            max_batch_size=max_batch_size,
+            max_seq_len=max_seq_len,
+            page_size=page_size,
+            num_pages=hbm_pages,
+        ),
+    )
+    runner = web.AppRunner(server.app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    logger.info("tpuserve listening on %s:%d (model=%s)", host, port, model)
+    return runner

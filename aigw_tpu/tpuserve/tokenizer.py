@@ -1,0 +1,125 @@
+"""Tokenizers + chat templating for tpuserve.
+
+Two implementations behind one protocol:
+- ``HFTokenizer`` wraps a local ``tokenizer.json`` (tokenizers library; no
+  network) for real checkpoints.
+- ``ByteTokenizer`` is the dependency-free fallback used by tiny-random
+  models and tests (byte-level, vocab 256 + specials) — the fake-chip mode
+  that replaces the reference's testupstream in our test pyramid
+  (SURVEY.md §4 implication (b)).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Protocol
+
+
+class Tokenizer(Protocol):
+    bos_id: int
+    eos_id: int
+
+    def encode(self, text: str) -> list[int]: ...
+    def decode(self, ids: list[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 bytes as tokens 0..255; BOS=256, EOS=257."""
+
+    bos_id = 256
+    eos_id = 257
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: list[int]) -> str:
+        return bytes(i for i in ids if 0 <= i < 256).decode(
+            "utf-8", errors="replace"
+        )
+
+
+class HFTokenizer:
+    def __init__(self, path: str):
+        from tokenizers import Tokenizer as _T
+
+        self._t = _T.from_file(path)
+        vocab = self._t.get_vocab()
+        self.bos_id = vocab.get("<|begin_of_text|>", vocab.get("<s>", 0))
+        self.eos_id = vocab.get(
+            "<|eot_id|>", vocab.get("<|end_of_text|>", vocab.get("</s>", 0))
+        )
+
+    def encode(self, text: str) -> list[int]:
+        return self._t.encode(text, add_special_tokens=False).ids
+
+    def decode(self, ids: list[int]) -> str:
+        return self._t.decode(ids, skip_special_tokens=True)
+
+
+def load_tokenizer(source: str) -> Tokenizer:
+    if source == "byte":
+        return ByteTokenizer()
+    return HFTokenizer(source)
+
+
+def apply_chat_template(
+    messages: list[dict[str, Any]], tokenizer: Tokenizer
+) -> list[int]:
+    """Render an OpenAI-style message list to prompt tokens.
+
+    Uses the Llama-3 header layout for HF tokenizers and a plain textual
+    layout for the byte tokenizer. (Template strings are the public Llama-3
+    prompt format.)
+    """
+    from aigw_tpu.schemas.openai import message_content_text
+
+    if isinstance(tokenizer, ByteTokenizer):
+        parts = []
+        for m in messages:
+            parts.append(f"<{m.get('role', 'user')}>: "
+                         f"{message_content_text(m.get('content'))}\n")
+        parts.append("<assistant>: ")
+        return tokenizer.encode("".join(parts))
+
+    text = "<|begin_of_text|>"
+    for m in messages:
+        role = m.get("role", "user")
+        content = message_content_text(m.get("content"))
+        text += (
+            f"<|start_header_id|>{role}<|end_header_id|>\n\n{content}<|eot_id|>"
+        )
+    text += "<|start_header_id|>assistant<|end_header_id|>\n\n"
+    return tokenizer.encode(text)
+
+
+class StreamingDecoder:
+    """Incremental detokenizer: emits only text that can no longer change.
+
+    Token-by-token ``decode([tok])`` corrupts multi-byte UTF-8 characters
+    and multi-token graphemes; instead the full id list is re-decoded and
+    the stable prefix delta is emitted. Text ending in U+FFFD is held back
+    until the continuation token arrives.
+    """
+
+    def __init__(self, tokenizer: Tokenizer):
+        self._t = tokenizer
+        self._ids: list[int] = []
+        self._sent = 0
+
+    def push(self, token_id: int) -> str:
+        self._ids.append(token_id)
+        text = self._t.decode(self._ids)
+        # hold back a possibly-incomplete trailing character
+        if text.endswith("\ufffd"):
+            stable = text[: text.rindex("\ufffd")]
+        else:
+            stable = text
+        out = stable[self._sent :]
+        if out:
+            self._sent = len(stable)
+        return out
+
+    def flush(self) -> str:
+        text = self._t.decode(self._ids)
+        out = text[self._sent :]
+        self._sent = len(text)
+        return out

@@ -2,15 +2,24 @@
 
 Multi-chip hardware is not available in CI; shardings are validated on a
 virtual CPU mesh (``--xla_force_host_platform_device_count=8``), the same
-way the driver's ``dryrun_multichip`` does. Must run before jax import.
+way the driver's ``dryrun_multichip`` does.
+
+NOTE: this environment pins ``JAX_PLATFORMS=axon`` (the TPU tunnel) via a
+sitecustomize that re-applies it even if the env var is overwritten, so
+``jax.config.update("jax_platforms", "cpu")`` after import is the only
+reliable override. Without it, every eager op is a network round trip to
+the real chip and the suite takes minutes instead of seconds.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,166 @@
+"""END-TO-END: client → gateway → tpuserve (tiny-random on the CPU
+fake-chip). The milestone flow of SURVEY.md §7 step 4 / BASELINE.json
+config 2 — `curl /v1/chat/completions` through the gateway to the TPU
+engine, plus provider-fallback INTO tpuserve."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from aigw_tpu.config.model import Config
+from aigw_tpu.config.runtime import RuntimeConfig
+from aigw_tpu.gateway.server import run_gateway
+from tests.fakes import FakeUpstream
+from tests.test_tpuserve import tpuserve_url  # noqa: F401  (fixture reuse)
+
+
+def gateway_config(tpu_url: str, extra_backends=(), extra_rules=()):
+    return Config.parse(
+        {
+            "version": "v1",
+            "backends": [
+                {"name": "tpu", "schema": "TPUServe", "url": tpu_url},
+                *extra_backends,
+            ],
+            "routes": [
+                {
+                    "name": "serving",
+                    "rules": [
+                        {"models": ["tiny-random"], "backends": ["tpu"]},
+                        *extra_rules,
+                    ],
+                }
+            ],
+            "models": ["tiny-random"],
+            "llm_request_costs": [
+                {"metadata_key": "output", "type": "OutputToken"}
+            ],
+        }
+    )
+
+
+class TestGatewayToTPUServe:
+    def test_chat_through_gateway(self, tpuserve_url):  # noqa: F811
+        async def main():
+            sunk = []
+            server, runner = await run_gateway(
+                RuntimeConfig.build(gateway_config(tpuserve_url)),
+                port=0,
+                cost_sink=lambda costs, attrs: sunk.append((costs, attrs)),
+            )
+            site = list(runner.sites)[0]
+            port = site._server.sockets[0].getsockname()[1]
+            url = f"http://127.0.0.1:{port}"
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        url + "/v1/chat/completions",
+                        json={
+                            "model": "tiny-random",
+                            "messages": [{"role": "user", "content": "hi"}],
+                            "max_tokens": 4,
+                            "temperature": 0,
+                        },
+                    ) as resp:
+                        assert resp.status == 200
+                        got = await resp.json()
+                assert got["object"] == "chat.completion"
+                assert got["usage"]["completion_tokens"] >= 1
+                # real token costs flowed to the rate-limit sink
+                assert sunk and sunk[0][0]["output"] >= 1
+                assert sunk[0][1]["backend"] == "tpu"
+            finally:
+                await runner.cleanup()
+
+        asyncio.run(main())
+
+    def test_streaming_through_gateway(self, tpuserve_url):  # noqa: F811
+        async def main():
+            server, runner = await run_gateway(
+                RuntimeConfig.build(gateway_config(tpuserve_url)), port=0
+            )
+            site = list(runner.sites)[0]
+            port = site._server.sockets[0].getsockname()[1]
+            url = f"http://127.0.0.1:{port}"
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        url + "/v1/chat/completions",
+                        json={
+                            "model": "tiny-random",
+                            "messages": [{"role": "user", "content": "hi"}],
+                            "max_tokens": 4, "temperature": 0, "stream": True,
+                        },
+                    ) as resp:
+                        assert resp.status == 200
+                        assert "text/event-stream" in resp.headers[
+                            "content-type"]
+                        raw = (await resp.read()).decode()
+                assert "[DONE]" in raw
+                deltas = [
+                    json.loads(line[len("data: "):])
+                    for line in raw.split("\n")
+                    if line.startswith("data: ") and "[DONE]" not in line
+                ]
+                contents = [
+                    d["choices"][0]["delta"].get("content")
+                    for d in deltas if d.get("choices")
+                ]
+                assert sum(1 for c in contents if c) >= 1
+            finally:
+                await runner.cleanup()
+
+        asyncio.run(main())
+
+    def test_fallback_into_tpuserve(self, tpuserve_url):  # noqa: F811
+        """Dead OpenAI primary → tpuserve fallback (BASELINE.json
+        provider_fallback config, inverted: TPU as the rescue)."""
+
+        async def main():
+            dead = FakeUpstream().on_json(
+                "/v1/chat/completions", {"error": "down"}, status=503
+            )
+            await dead.start()
+            cfg = gateway_config(
+                tpuserve_url,
+                extra_backends=[
+                    {"name": "dead-openai", "schema": "OpenAI",
+                     "url": dead.url}
+                ],
+                extra_rules=[
+                    {
+                        "models": ["resilient"],
+                        "backends": [
+                            {"backend": "dead-openai", "priority": 0},
+                            {"backend": "tpu", "priority": 1},
+                        ],
+                    }
+                ],
+            )
+            server, runner = await run_gateway(RuntimeConfig.build(cfg), port=0)
+            site = list(runner.sites)[0]
+            port = site._server.sockets[0].getsockname()[1]
+            url = f"http://127.0.0.1:{port}"
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        url + "/v1/chat/completions",
+                        json={
+                            "model": "resilient",
+                            "messages": [{"role": "user", "content": "hi"}],
+                            "max_tokens": 3, "temperature": 0,
+                        },
+                    ) as resp:
+                        assert resp.status == 200
+                        got = await resp.json()
+                assert got["model"] == "tiny-random"  # served by tpuserve
+                assert len(dead.captured) == 1  # primary was tried first
+            finally:
+                await runner.cleanup()
+                await dead.stop()
+
+        asyncio.run(main())

@@ -1,0 +1,335 @@
+"""tpuserve engine + server tests on the CPU fake-chip (tiny-random model).
+
+Mirrors the reference's data-plane tier: a real server process boundary,
+no orchestration (SURVEY.md §4)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+
+import aiohttp
+import jax
+import numpy as np
+import pytest
+
+from aigw_tpu.models import llama
+from aigw_tpu.tpuserve.engine import Engine, EngineConfig, GenRequest
+from aigw_tpu.tpuserve.kvcache import OutOfPagesError, PageAllocator
+from aigw_tpu.tpuserve.sampling import SamplingParams
+from aigw_tpu.tpuserve.server import TPUServeServer
+
+
+class TestPageAllocator:
+    def test_alloc_free_cycle(self):
+        a = PageAllocator(num_pages=8, page_size=16)
+        p1 = a.allocate(1, 40)  # 3 pages
+        assert len(p1) == 3 and a.free_pages == 5
+        p2 = a.allocate(2, 16)
+        assert len(p2) == 1 and a.free_pages == 4
+        assert set(p1).isdisjoint(p2)
+        a.free(1)
+        assert a.free_pages == 7
+        a.free(2)
+        assert a.free_pages == 8
+
+    def test_extend(self):
+        a = PageAllocator(num_pages=4, page_size=16)
+        a.allocate(1, 10)
+        assert a.extend(1, 20) != []  # second page
+        assert a.extend(1, 25) == []  # still fits in 2 pages
+        assert len(a.pages(1)) == 2
+
+    def test_exhaustion(self):
+        a = PageAllocator(num_pages=2, page_size=16)
+        a.allocate(1, 32)
+        with pytest.raises(OutOfPagesError):
+            a.allocate(2, 1)
+        assert not a.can_allocate(1)
+        assert a.occupancy == 1.0
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = EngineConfig(max_batch_size=4, max_seq_len=256, page_size=16,
+                       min_prefill_bucket=32)
+    params = llama.init_params(jax.random.PRNGKey(0), llama.TINY)
+    eng = Engine(params, llama.TINY, cfg, eos_token_ids=(257,))
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def collect(engine, prompt, max_tokens=8, **sp):
+    done = threading.Event()
+    toks: list[int] = []
+    finish: list[str] = []
+
+    def emit(tok, fin):
+        if tok >= 0:
+            toks.append(tok)
+        if fin is not None:
+            finish.append(fin)
+            done.set()
+
+    engine.submit(
+        GenRequest(prompt=prompt, max_tokens=max_tokens,
+                   sampling=SamplingParams(**sp), emit=emit)
+    )
+    assert done.wait(timeout=120), "generation timed out"
+    return toks, finish[0]
+
+
+class TestEngine:
+    def test_greedy_generation(self, engine):
+        toks, finish = collect(engine, [1, 2, 3], max_tokens=6,
+                               temperature=0.0)
+        assert finish in ("stop", "length")
+        if finish == "length":
+            assert len(toks) == 6
+        assert all(0 <= t < llama.TINY.vocab_size for t in toks)
+
+    def test_greedy_is_deterministic(self, engine):
+        a, _ = collect(engine, [5, 6, 7], max_tokens=5, temperature=0.0)
+        b, _ = collect(engine, [5, 6, 7], max_tokens=5, temperature=0.0)
+        assert a == b
+
+    def test_seeded_sampling_deterministic(self, engine):
+        a, _ = collect(engine, [9, 9], max_tokens=5, temperature=0.8, seed=42)
+        b, _ = collect(engine, [9, 9], max_tokens=5, temperature=0.8, seed=42)
+        assert a == b
+
+    def test_concurrent_requests_isolated(self, engine):
+        """Continuous batching: concurrent generations must match their
+        solo-run outputs exactly (KV pages don't leak across slots)."""
+        solo1, _ = collect(engine, [10, 20, 30], max_tokens=5, temperature=0.0)
+        solo2, _ = collect(engine, [40, 50, 60], max_tokens=5, temperature=0.0)
+
+        results: dict[int, list[int]] = {0: [], 1: []}
+        dones = [threading.Event(), threading.Event()]
+
+        def mk_emit(i):
+            def emit(tok, fin):
+                if tok >= 0:
+                    results[i].append(tok)
+                if fin is not None:
+                    dones[i].set()
+            return emit
+
+        engine.submit(GenRequest(prompt=[10, 20, 30], max_tokens=5,
+                                 sampling=SamplingParams(temperature=0.0),
+                                 emit=mk_emit(0)))
+        engine.submit(GenRequest(prompt=[40, 50, 60], max_tokens=5,
+                                 sampling=SamplingParams(temperature=0.0),
+                                 emit=mk_emit(1)))
+        assert all(d.wait(timeout=120) for d in dones)
+        assert results[0] == solo1
+        assert results[1] == solo2
+
+    def test_too_long_rejected(self, engine):
+        with pytest.raises(ValueError, match="max_seq_len"):
+            engine.submit(GenRequest(prompt=[1] * 300, max_tokens=10,
+                                     sampling=SamplingParams()))
+
+    def test_queueing_over_capacity(self, engine):
+        """More requests than slots: all must finish via the queue."""
+        n = 9  # > max_batch_size
+        dones = [threading.Event() for _ in range(n)]
+
+        def mk(i):
+            def emit(tok, fin):
+                if fin is not None:
+                    dones[i].set()
+            return emit
+
+        for i in range(n):
+            engine.submit(GenRequest(prompt=[i + 1, i + 2], max_tokens=3,
+                                     sampling=SamplingParams(temperature=0.0),
+                                     emit=mk(i)))
+        assert all(d.wait(timeout=240) for d in dones)
+        # the engine thread frees pages just after signalling completion;
+        # poll briefly instead of racing its stats refresh
+        deadline = time.monotonic() + 5
+        while engine.allocator.occupancy > 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert engine.allocator.occupancy == 0.0  # everything freed
+
+
+@pytest.fixture(scope="module")
+def tpuserve_url():
+    """Run a real tpuserve server (tiny-random) in a thread."""
+    from aiohttp import web
+
+    holder = {}
+    started = threading.Event()
+
+    def run():
+        async def main():
+            server = TPUServeServer(
+                "tiny-random",
+                EngineConfig(max_batch_size=2, max_seq_len=256, page_size=16,
+                             min_prefill_bucket=32),
+            )
+            runner = web.AppRunner(server.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            holder["port"] = site._server.sockets[0].getsockname()[1]
+            holder["loop"] = asyncio.get_running_loop()
+            started.set()
+            await asyncio.Event().wait()
+
+        try:
+            asyncio.run(main())
+        except RuntimeError:
+            pass
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(timeout=60)
+    yield f"http://127.0.0.1:{holder['port']}"
+    holder["loop"].call_soon_threadsafe(holder["loop"].stop)
+
+
+async def _post(url, path, payload):
+    async with aiohttp.ClientSession() as s:
+        async with s.post(url + path, json=payload) as resp:
+            return resp.status, await resp.read(), dict(resp.headers)
+
+
+class TestTPUServeServer:
+    def test_chat_completion(self, tpuserve_url):
+        status, body, _ = asyncio.run(
+            _post(tpuserve_url, "/v1/chat/completions", {
+                "model": "tiny-random",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4,
+                "temperature": 0,
+            })
+        )
+        assert status == 200
+        got = json.loads(body)
+        assert got["object"] == "chat.completion"
+        assert got["usage"]["completion_tokens"] >= 1
+        assert got["model"] == "tiny-random"
+
+    def test_chat_streaming(self, tpuserve_url):
+        async def main():
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    tpuserve_url + "/v1/chat/completions",
+                    json={
+                        "model": "tiny-random",
+                        "messages": [{"role": "user", "content": "hi"}],
+                        "max_tokens": 4, "temperature": 0, "stream": True,
+                        "stream_options": {"include_usage": True},
+                    },
+                ) as resp:
+                    assert resp.status == 200
+                    assert "text/event-stream" in resp.headers["content-type"]
+                    return await resp.read()
+
+        raw = asyncio.run(main()).decode()
+        assert "[DONE]" in raw
+        chunks = [json.loads(x[len("data: "):]) for x in raw.split("\n\n")
+                  if x.startswith("data: ") and "[DONE]" not in x]
+        finishes = [c["choices"][0]["finish_reason"] for c in chunks
+                    if c.get("choices")]
+        assert finishes[-1] in ("stop", "length")
+        assert any(c.get("usage") for c in chunks)
+
+    def test_embeddings(self, tpuserve_url):
+        status, body, _ = asyncio.run(
+            _post(tpuserve_url, "/v1/embeddings",
+                  {"model": "tiny-random", "input": ["alpha", "beta"]})
+        )
+        assert status == 200
+        got = json.loads(body)
+        assert len(got["data"]) == 2
+        assert len(got["data"][0]["embedding"]) == llama.TINY.dim
+        # embeddings differ for different inputs
+        assert got["data"][0]["embedding"] != got["data"][1]["embedding"]
+
+    def test_tokenize(self, tpuserve_url):
+        status, body, _ = asyncio.run(
+            _post(tpuserve_url, "/tokenize",
+                  {"model": "tiny-random", "prompt": "hello"})
+        )
+        got = json.loads(body)
+        assert status == 200 and got["count"] == 5
+
+    def test_state_telemetry(self, tpuserve_url):
+        async def main():
+            async with aiohttp.ClientSession() as s:
+                async with s.get(tpuserve_url + "/state") as resp:
+                    return await resp.json()
+
+        got = asyncio.run(main())
+        assert got["max_slots"] == 2
+        assert "kv_occupancy" in got and "queued" in got
+
+
+class TestEngineNumerics:
+    def test_engine_matches_full_recompute(self, engine):
+        """Greedy engine output must equal token-by-token full-context
+        recompute through prefill — the strongest end-to-end numerics
+        check for the paged-cache decode path."""
+        import jax.numpy as jnp
+
+        prompt = [3, 1, 4, 1, 5]
+        got, _ = collect(engine, prompt, max_tokens=4, temperature=0.0)
+
+        seq = list(prompt)
+        expected = []
+        for _ in range(4):
+            cache = jnp.zeros(
+                (llama.TINY.n_layers, 2, 64 * 16, llama.TINY.n_kv_heads,
+                 llama.TINY.head_dim), jnp.bfloat16)
+            pt = jnp.arange(8, dtype=jnp.int32)[None, :]
+            logits, _ = llama.prefill(
+                engine.params, llama.TINY,
+                jnp.asarray([seq], jnp.int32),
+                jnp.asarray([len(seq)], jnp.int32), cache, pt, 16,
+            )
+            tok = int(np.asarray(logits[0]).argmax())
+            expected.append(tok)
+            seq.append(tok)
+        assert got == expected
+
+
+class TestServerRobustness:
+    """Regression tests for review findings (nulls, stops, unicode)."""
+
+    def test_null_sampling_params(self, tpuserve_url):
+        status, body, _ = asyncio.run(
+            _post(tpuserve_url, "/v1/chat/completions", {
+                "model": "tiny-random",
+                "messages": [{"role": "user", "content": "x"}],
+                "max_tokens": 2, "temperature": None, "top_p": None,
+                "seed": None,
+            })
+        )
+        assert status == 200
+
+    def test_embeddings_token_ids(self, tpuserve_url):
+        status, body, _ = asyncio.run(
+            _post(tpuserve_url, "/v1/embeddings",
+                  {"model": "tiny-random", "input": [1, 2, 3]})
+        )
+        assert status == 200
+        got = json.loads(body)
+        assert len(got["data"]) == 1
+
+    def test_invalid_config_rejected(self):
+        with pytest.raises(ValueError, match="multiple"):
+            EngineConfig(max_seq_len=1000, page_size=128)
+
+    def test_streaming_decoder_multibyte(self):
+        from aigw_tpu.tpuserve.tokenizer import ByteTokenizer, StreamingDecoder
+
+        d = StreamingDecoder(ByteTokenizer())
+        emoji = "héllo 🌍".encode("utf-8")
+        out = "".join(d.push(b) for b in emoji) + d.flush()
+        assert out == "héllo 🌍"
