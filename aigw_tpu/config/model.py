@@ -477,6 +477,9 @@ class Config:
     routes: tuple[Route, ...] = ()
     models: tuple[Model, ...] = ()
     llm_request_costs: tuple[LLMRequestCost, ...] = ()
+    # Quota rules (parsed/enforced by aigw_tpu.gateway.ratelimit — the
+    # QuotaPolicy equivalent); stored frozen for hashability.
+    quotas: tuple[Any, ...] = ()
     mcp: dict[str, Any] | None = None  # parsed by aigw_tpu.mcp
     version: str = CONFIG_VERSION
     uuid: str = ""
@@ -521,6 +524,7 @@ class Config:
             llm_request_costs=tuple(
                 LLMRequestCost.parse(c) for c in value.get("llm_request_costs", ())
             ),
+            quotas=tuple(_freeze(q) for q in value.get("quotas", ())),
             mcp=value.get("mcp"),
             version=version,
             uuid=value.get("uuid", ""),
@@ -540,6 +544,8 @@ class Config:
             d["models"] = [m.to_dict() for m in self.models]
         if self.llm_request_costs:
             d["llm_request_costs"] = [c.to_dict() for c in self.llm_request_costs]
+        if self.quotas:
+            d["quotas"] = [_thaw(q) for q in self.quotas]
         if self.mcp is not None:
             d["mcp"] = self.mcp
         return d

@@ -27,13 +27,17 @@ class RuntimeConfig:
     config: Config
     backends: dict[str, RuntimeBackend] = field(default_factory=dict)
     cost_calculator: Any = None  # aigw_tpu.gateway.costs.CostCalculator
+    rate_limiter: Any = None  # aigw_tpu.gateway.ratelimit.RateLimiter
 
     @staticmethod
-    def build(config: Config) -> "RuntimeConfig":
+    def build(config: Config,
+              previous: "RuntimeConfig | None" = None) -> "RuntimeConfig":
         # Local imports keep aigw_tpu.config importable without the gateway
         # package (mirrors the filterapi/extproc layering of the reference).
         from aigw_tpu.gateway.auth import new_handler
         from aigw_tpu.gateway.costs import CostCalculator
+        from aigw_tpu.gateway.ratelimit import RateLimiter
+        from aigw_tpu.config.model import _thaw
 
         config.validate()
         rc = RuntimeConfig(config=config)
@@ -42,6 +46,9 @@ class RuntimeConfig:
                 backend=b, auth_handler=new_handler(b.auth)
             )
         rc.cost_calculator = CostCalculator.from_config(config)
+        rc.rate_limiter = RateLimiter.from_config_value(
+            [_thaw(q) for q in config.quotas]
+        ).adopt(previous.rate_limiter if previous else None)
         return rc
 
     def routes_for_host(self, host: str) -> list[Route]:

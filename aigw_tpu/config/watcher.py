@@ -35,6 +35,7 @@ class ConfigWatcher:
         self.interval = interval
         self._checksum = ""
         self._task: asyncio.Task | None = None
+        self._current: RuntimeConfig | None = None
 
     def _load(self) -> Config:
         if os.path.isdir(self.path):
@@ -47,6 +48,7 @@ class ConfigWatcher:
         cfg = self._load()
         self._checksum = cfg.checksum()
         rc = RuntimeConfig.build(cfg)
+        self._current = rc
         self.on_reload(rc)
         return rc
 
@@ -70,13 +72,14 @@ class ConfigWatcher:
                 checksum = cfg.checksum()
                 if checksum == self._checksum:
                     continue
-                rc = RuntimeConfig.build(cfg)
+                rc = RuntimeConfig.build(cfg, previous=self._current)
             except asyncio.CancelledError:
                 raise
             except Exception as e:  # keep last good config
                 logger.warning("config reload failed, keeping current: %s", e)
                 continue
             self._checksum = checksum
+            self._current = rc
             self.on_reload(rc)
             logger.info(
                 "config reloaded (uuid=%s, %d backends, %d routes)",

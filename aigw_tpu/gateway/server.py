@@ -94,6 +94,13 @@ class GatewayServer:
         self.app.router.add_get("/v1/models", self._handle_models)
         self.app.router.add_get("/health", self._handle_health)
         self.app.router.add_get("/metrics", self._handle_metrics)
+        if runtime.config.mcp:
+            # MCP endpoint path/backends are fixed at startup (config hot
+            # reload swaps routes/backends; MCP topology needs a restart).
+            from aigw_tpu.mcp import MCPConfig, MCPProxy
+
+            self.mcp = MCPProxy(MCPConfig.parse(runtime.config.mcp))
+            self.mcp.register(self.app)
         self.app.on_cleanup.append(self._cleanup)
 
     # -- lifecycle --------------------------------------------------------
@@ -156,10 +163,11 @@ class GatewayServer:
             return web.Response(
                 status=400, body=error_body(str(e)),
                 content_type="application/json")
+        client_headers = {k.lower(): v for k, v in request.headers.items()}
         match_headers = {
+            **client_headers,
             MODEL_NAME_HEADER: model,
             ORIGINAL_PATH_HEADER: request.path,
-            **{k.lower(): v for k, v in request.headers.items()},
         }
         try:
             match = match_route(rc, request.host, match_headers)
@@ -198,7 +206,7 @@ class GatewayServer:
             try:
                 result = await self._attempt(
                     request, endpoint, front_schema, rb, body,
-                    req_metrics, route_name, error_body,
+                    req_metrics, route_name, error_body, client_headers,
                 )
             except _RetriableUpstreamError as e:
                 logger.warning(
@@ -236,8 +244,12 @@ class GatewayServer:
         req_metrics: RequestMetrics,
         route_name: str,
         error_body: Callable[..., bytes],
+        client_headers: dict[str, str],
     ) -> web.StreamResponse:
         backend = rb.backend
+        if rc_limited := self._check_quota(client_headers, rb, req_metrics,
+                                           error_body):
+            return rc_limited
         translator = get_translator(
             endpoint,
             front_schema,
@@ -312,14 +324,15 @@ class GatewayServer:
             )
             if upstream_streams:
                 return await self._stream_response(
-                    request, resp, translator, rb, req_metrics, route_name
+                    request, resp, translator, rb, req_metrics, route_name,
+                    client_headers,
                 )
             raw = await resp.read()
             rx = translator.response_body(raw, True)
             usage = rx.usage
             req_metrics.response_model = rx.model
             req_metrics.finish(usage)
-            self._sink_costs(usage, rx.model, backend.name, route_name)
+            self._sink_costs(usage, req_metrics, route_name, client_headers)
             self.metrics.requests_total.labels(
                 route_name, backend.name, str(resp.status)
             ).inc()
@@ -335,6 +348,7 @@ class GatewayServer:
         rb: RuntimeBackend,
         req_metrics: RequestMetrics,
         route_name: str,
+        client_headers: dict[str, str],
     ) -> web.StreamResponse:
         """Proxy the SSE stream through the translator — the hot loop
         (reference processor_impl.go:481-575)."""
@@ -373,22 +387,69 @@ class GatewayServer:
             )
         req_metrics.response_model = model
         req_metrics.finish(usage)
-        self._sink_costs(usage, model, rb.backend.name, route_name)
+        self._sink_costs(usage, req_metrics, route_name, client_headers)
         self.metrics.requests_total.labels(route_name, rb.backend.name, "200").inc()
         await out.write_eof()
         return out
 
+    def _check_quota(self, client_headers, rb, req_metrics, error_body):
+        """Admission check against token quotas (reference: Envoy
+        ratelimit filter with domain ai-gateway-quota,
+        extensionserver/quota_ratelimit.go:59). Consumption happens at
+        end-of-stream in _sink_costs."""
+        limiter = self._runtime.rate_limiter
+        if limiter is None or not limiter.rules:
+            return None
+        ok, rule = limiter.check(
+            req_metrics.request_model, rb.backend.name, client_headers
+        )
+        if ok:
+            return None
+        client_err = error_body(
+            f"token quota exceeded (rule {rule.name!r})",
+            type_="rate_limit_error",
+        )
+        if rule.backend:
+            # a backend-scoped budget: other backends may still have
+            # budget, so fail over like any other backend-level 429
+            raise _RetriableUpstreamError(429, client_err,
+                                          f"quota {rule.name}")
+        req_metrics.finish(TokenUsage(), error_type="429")
+        return web.Response(
+            status=429,
+            body=client_err,
+            headers={"retry-after": "1"},
+            content_type="application/json",
+        )
+
     def _sink_costs(
-        self, usage: TokenUsage, model: str, backend: str, route_name: str
+        self,
+        usage: TokenUsage,
+        req_metrics: RequestMetrics,
+        route_name: str,
+        client_headers: dict[str, str],
     ) -> None:
         """End-of-stream cost metadata (≈ dynamic metadata for the
-        rate-limit filter, extproc/util.go buildDynamicMetadata)."""
-        if self._cost_sink is None:
+        rate-limit filter, extproc/util.go buildDynamicMetadata).
+
+        Quota consumption is keyed by the *request* model — the same value
+        _check_quota matched against — so model-scoped budgets enforce
+        consistently even when the backend reports a versioned response
+        model or a model_name_override rewrote the upstream name."""
+        limiter = self._runtime.rate_limiter
+        has_quota = limiter is not None and limiter.rules
+        if self._cost_sink is None and not has_quota:
             return
+        model = req_metrics.request_model
+        backend = req_metrics.provider
         costs = self._runtime.cost_calculator.calculate(
             usage, model=model, backend=backend, route_name=route_name
         )
-        if costs:
+        if not costs:
+            return
+        if has_quota:
+            limiter.consume(costs, model, backend, client_headers)
+        if self._cost_sink is not None:
             self._cost_sink(
                 costs,
                 {"model": model, "backend": backend, "route": route_name},

@@ -1,0 +1,398 @@
+"""MCP proxy core: JSON-RPC demux + session multiplexing + tool routing.
+
+Parity with the reference (internal/mcpproxy/mcpproxy.go:59,
+handlers.go:326-460):
+
+- ``initialize``     — fan-out to every backend, compose the encrypted
+  client session from per-backend session IDs
+- ``tools/list``     — aggregate + filter, names prefixed ``backend__tool``
+  (collision-free routing key, like the reference's tool→backend map)
+- ``tools/call``     — strip the prefix, route to the owning backend with
+  its own session ID
+- ``prompts/list`` / ``resources/list`` — aggregated (prefixing names/URIs)
+- ``ping`` / ``notifications/*`` — handled locally / broadcast
+- Streamable-HTTP: accepts JSON responses and single-event SSE replies
+  from backends (spec 2025-06-18).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import fnmatch
+import json
+import logging
+import secrets
+from dataclasses import dataclass, field
+from typing import Any
+
+import aiohttp
+from aiohttp import web
+
+from aigw_tpu.mcp.crypto import SessionCrypto, SessionCryptoError
+
+logger = logging.getLogger(__name__)
+
+PROTOCOL_VERSION = "2025-06-18"
+SESSION_HEADER = "mcp-session-id"
+TOOL_SEP = "__"
+
+
+@dataclass(frozen=True)
+class MCPBackend:
+    name: str
+    url: str  # full MCP endpoint, e.g. http://host:port/mcp
+    include_tools: tuple[str, ...] = ()  # glob patterns; empty = all
+    exclude_tools: tuple[str, ...] = ()
+    headers: tuple[tuple[str, str], ...] = ()
+
+    def allows(self, tool: str) -> bool:
+        if self.include_tools and not any(
+            fnmatch.fnmatch(tool, p) for p in self.include_tools
+        ):
+            return False
+        return not any(fnmatch.fnmatch(tool, p) for p in self.exclude_tools)
+
+
+@dataclass(frozen=True)
+class MCPConfig:
+    backends: tuple[MCPBackend, ...]
+    path: str = "/mcp"
+    # No constant default: an unset seed becomes a random per-process one
+    # (sessions then don't survive restarts/replicas — set it explicitly in
+    # production, as the reference requires via flags, mainlib/main.go:337).
+    session_seed: str = ""
+    session_fallback_seed: str = ""
+
+    @staticmethod
+    def parse(value: dict[str, Any]) -> "MCPConfig":
+        backends = tuple(
+            MCPBackend(
+                name=b["name"],
+                url=b["url"],
+                include_tools=tuple(
+                    (b.get("tool_filter") or {}).get("include", ())
+                ),
+                exclude_tools=tuple(
+                    (b.get("tool_filter") or {}).get("exclude", ())
+                ),
+                headers=tuple(
+                    (str(h["name"]).lower(), str(h["value"]))
+                    for h in b.get("headers", ())
+                ),
+            )
+            for b in value.get("backends", ())
+        )
+        seed = value.get("session_seed", "")
+        if not seed:
+            seed = secrets.token_hex(32)
+            logger.warning(
+                "mcp.session_seed not configured — using a random "
+                "per-process seed; sessions will not survive restarts or "
+                "span replicas"
+            )
+        return MCPConfig(
+            backends=backends,
+            path=value.get("path", "/mcp"),
+            session_seed=seed,
+            session_fallback_seed=value.get("session_fallback_seed", ""),
+        )
+
+
+def _rpc_error(id_: Any, code: int, message: str) -> dict[str, Any]:
+    return {"jsonrpc": "2.0", "id": id_,
+            "error": {"code": code, "message": message}}
+
+
+class MCPProxy:
+    def __init__(self, cfg: MCPConfig):
+        self.cfg = cfg
+        seed = cfg.session_seed or secrets.token_hex(32)
+        self._crypto = SessionCrypto(seed, cfg.session_fallback_seed)
+        self._session: aiohttp.ClientSession | None = None
+
+    def register(self, app: web.Application) -> None:
+        app.router.add_post(self.cfg.path, self.handle)
+        app.router.add_delete(self.cfg.path, self.handle_delete)
+        app.on_cleanup.append(self._cleanup)
+
+    async def _cleanup(self, _app) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    async def _http(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=60)
+            )
+        return self._session
+
+    # -- backend I/O ------------------------------------------------------
+    async def _call_backend(
+        self,
+        backend: MCPBackend,
+        payload: dict[str, Any],
+        session_id: str = "",
+    ) -> tuple[dict[str, Any] | None, str]:
+        """POST one JSON-RPC message; returns (response-or-None, session id).
+
+        Accepts direct JSON or a single-response SSE stream (both allowed
+        by streamable HTTP)."""
+        headers = {
+            "content-type": "application/json",
+            "accept": "application/json, text/event-stream",
+            "mcp-protocol-version": PROTOCOL_VERSION,
+        }
+        headers.update(dict(backend.headers))
+        if session_id:
+            headers[SESSION_HEADER] = session_id
+        http = await self._http()
+        async with http.post(backend.url, json=payload,
+                             headers=headers) as resp:
+            new_session = resp.headers.get(SESSION_HEADER, session_id)
+            if resp.status == 202:
+                return None, new_session
+            ctype = resp.headers.get("content-type", "")
+            raw = await resp.read()
+            if resp.status >= 400:
+                raise RuntimeError(
+                    f"backend {backend.name} returned {resp.status}: "
+                    f"{raw[:200]!r}"
+                )
+            if "text/event-stream" in ctype:
+                from aigw_tpu.translate.sse import SSEParser
+
+                for ev in SSEParser().feed(raw) or []:
+                    if not ev.data:
+                        continue
+                    msg = json.loads(ev.data)
+                    if "result" in msg or "error" in msg:
+                        return msg, new_session
+                return None, new_session
+            return (json.loads(raw) if raw else None), new_session
+
+    # -- session composition ---------------------------------------------
+    def _encode_session(self, sessions: dict[str, str]) -> str:
+        return self._crypto.encrypt(json.dumps(sessions).encode())
+
+    def _decode_session(self, token: str) -> dict[str, str]:
+        return json.loads(self._crypto.decrypt(token))
+
+    # -- HTTP entry -------------------------------------------------------
+    async def handle(self, request: web.Request) -> web.StreamResponse:
+        try:
+            payload = json.loads(await request.read())
+        except json.JSONDecodeError:
+            return web.json_response(
+                _rpc_error(None, -32700, "parse error"), status=400
+            )
+        if isinstance(payload, list):
+            return web.json_response(
+                _rpc_error(None, -32600, "batching not supported"),
+                status=400,
+            )
+        method = payload.get("method", "")
+        msg_id = payload.get("id")
+        is_notification = msg_id is None
+
+        try:
+            if method == "initialize":
+                result, session = await self._initialize(payload)
+                resp = web.json_response(result)
+                resp.headers[SESSION_HEADER] = session
+                return resp
+
+            session_token = request.headers.get(SESSION_HEADER, "")
+            try:
+                sessions = (
+                    self._decode_session(session_token)
+                    if session_token
+                    else {}
+                )
+            except SessionCryptoError as e:
+                return web.json_response(
+                    _rpc_error(msg_id, -32000, str(e)), status=404
+                )
+
+            if is_notification:
+                await self._broadcast(payload, sessions)
+                return web.Response(status=202)
+            if method == "ping":
+                return web.json_response(
+                    {"jsonrpc": "2.0", "id": msg_id, "result": {}}
+                )
+            if method == "tools/list":
+                return web.json_response(
+                    await self._tools_list(msg_id, sessions)
+                )
+            if method == "tools/call":
+                return web.json_response(
+                    await self._tools_call(payload, sessions)
+                )
+            if method in ("prompts/list", "resources/list"):
+                return web.json_response(
+                    await self._aggregate_list(method, msg_id, sessions)
+                )
+            if method == "logging/setLevel":
+                await self._broadcast(payload, sessions)
+                return web.json_response(
+                    {"jsonrpc": "2.0", "id": msg_id, "result": {}}
+                )
+            return web.json_response(
+                _rpc_error(msg_id, -32601, f"method {method!r} not supported")
+            )
+        except Exception as e:
+            logger.exception("mcp request failed")
+            return web.json_response(
+                _rpc_error(msg_id, -32603, f"internal error: {e}")
+            )
+
+    async def handle_delete(self, request: web.Request) -> web.Response:
+        """Session teardown: best-effort DELETE to each backend."""
+        token = request.headers.get(SESSION_HEADER, "")
+        try:
+            sessions = self._decode_session(token) if token else {}
+        except SessionCryptoError:
+            return web.Response(status=404)
+        http = await self._http()
+        for b in self.cfg.backends:
+            sid = sessions.get(b.name)
+            if not sid:
+                continue
+            try:
+                await http.delete(
+                    b.url, headers={SESSION_HEADER: sid,
+                                    **dict(b.headers)}
+                )
+            except aiohttp.ClientError:
+                pass
+        return web.Response(status=200)
+
+    # -- methods ----------------------------------------------------------
+    async def _initialize(
+        self, payload: dict[str, Any]
+    ) -> tuple[dict[str, Any], str]:
+        async def init_one(b: MCPBackend):
+            try:
+                resp, session = await self._call_backend(b, payload)
+                # spec: notify initialized after the response
+                await self._call_backend(
+                    b,
+                    {"jsonrpc": "2.0",
+                     "method": "notifications/initialized"},
+                    session,
+                )
+                return b.name, session, resp
+            except (aiohttp.ClientError, RuntimeError) as e:
+                logger.warning("mcp backend %s init failed: %s", b.name, e)
+                return b.name, "", None
+
+        results = await asyncio.gather(
+            *(init_one(b) for b in self.cfg.backends)
+        )
+        sessions = {name: sid for name, sid, _ in results if sid}
+        caps: dict[str, Any] = {"tools": {"listChanged": False}}
+        result = {
+            "jsonrpc": "2.0",
+            "id": payload.get("id"),
+            "result": {
+                "protocolVersion": PROTOCOL_VERSION,
+                "capabilities": caps,
+                "serverInfo": {"name": "aigw-tpu-mcp", "version": "0.1.0"},
+            },
+        }
+        return result, self._encode_session(sessions)
+
+    async def _broadcast(
+        self, payload: dict[str, Any], sessions: dict[str, str]
+    ) -> None:
+        await asyncio.gather(
+            *(
+                self._call_backend(b, payload, sessions.get(b.name, ""))
+                for b in self.cfg.backends
+                if sessions.get(b.name)
+            ),
+            return_exceptions=True,
+        )
+
+    async def _tools_list(
+        self, msg_id: Any, sessions: dict[str, str]
+    ) -> dict[str, Any]:
+        async def list_one(b: MCPBackend):
+            sid = sessions.get(b.name, "")
+            if not sid:
+                return []
+            try:
+                resp, _ = await self._call_backend(
+                    b,
+                    {"jsonrpc": "2.0", "id": msg_id, "method": "tools/list"},
+                    sid,
+                )
+            except (aiohttp.ClientError, RuntimeError) as e:
+                logger.warning("tools/list from %s failed: %s", b.name, e)
+                return []
+            tools = ((resp or {}).get("result") or {}).get("tools") or []
+            out = []
+            for t in tools:
+                name = t.get("name", "")
+                if not b.allows(name):
+                    continue
+                out.append(dict(t, name=f"{b.name}{TOOL_SEP}{name}"))
+            return out
+
+        lists = await asyncio.gather(
+            *(list_one(b) for b in self.cfg.backends)
+        )
+        tools = [t for sub in lists for t in sub]
+        return {"jsonrpc": "2.0", "id": msg_id, "result": {"tools": tools}}
+
+    async def _tools_call(
+        self, payload: dict[str, Any], sessions: dict[str, str]
+    ) -> dict[str, Any]:
+        msg_id = payload.get("id")
+        params = payload.get("params") or {}
+        full_name = params.get("name", "")
+        backend_name, sep, tool = full_name.partition(TOOL_SEP)
+        backend = next(
+            (b for b in self.cfg.backends if b.name == backend_name), None
+        )
+        if not sep or backend is None:
+            return _rpc_error(msg_id, -32602, f"unknown tool {full_name!r}")
+        if not backend.allows(tool):
+            return _rpc_error(
+                msg_id, -32602, f"tool {full_name!r} is not allowed"
+            )
+        sid = sessions.get(backend.name, "")
+        routed = dict(payload, params=dict(params, name=tool))
+        resp, _ = await self._call_backend(backend, routed, sid)
+        return resp or _rpc_error(msg_id, -32603, "no response from backend")
+
+    async def _aggregate_list(
+        self, method: str, msg_id: Any, sessions: dict[str, str]
+    ) -> dict[str, Any]:
+        key = "prompts" if method == "prompts/list" else "resources"
+
+        async def one(b: MCPBackend):
+            sid = sessions.get(b.name, "")
+            if not sid:
+                return []
+            try:
+                resp, _ = await self._call_backend(
+                    b, {"jsonrpc": "2.0", "id": msg_id, "method": method}, sid
+                )
+            except (aiohttp.ClientError, RuntimeError):
+                return []
+            items = ((resp or {}).get("result") or {}).get(key) or []
+            out = []
+            for it in items:
+                it = dict(it)
+                if "name" in it:
+                    it["name"] = f"{b.name}{TOOL_SEP}{it['name']}"
+                out.append(it)
+            return out
+
+        lists = await asyncio.gather(*(one(b) for b in self.cfg.backends))
+        return {
+            "jsonrpc": "2.0",
+            "id": msg_id,
+            "result": {key: [x for sub in lists for x in sub]},
+        }

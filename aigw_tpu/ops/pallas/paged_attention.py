@@ -1,0 +1,160 @@
+"""Pallas TPU kernel: paged-attention decode.
+
+One query token per sequence attends over its paged KV cache (the decode
+hot loop). Design (ragged-paged-attention style, PAPERS.md
+arxiv 2604.15464 — implementation is original):
+
+- Grid ``(B, P)`` — sequence-major, pages innermost. The page table is a
+  **scalar-prefetch** argument, so each page's K/V block is DMA'd from the
+  HBM pool straight to VMEM by the Pallas pipeline (auto double-buffered)
+  using a *data-dependent* index map: block ``p`` of sequence ``b`` comes
+  from pool row ``page_table[b, p]``.
+- Online softmax across pages: running max / denominator / weighted
+  accumulator live in VMEM scratch, carried across the page loop for a
+  fixed sequence; the output tile is written on the last page.
+- GQA: Q heads are grouped per KV head inside the kernel; K/V stay
+  un-repeated in HBM (bandwidth is the decode bottleneck).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _decode_kernel(
+    # scalar prefetch
+    page_table_ref,  # [B * P] int32 — pool row per (b, p)
+    lengths_ref,  # [B] int32 — attend length per sequence
+    # blocks
+    q_ref,  # [1, H, D]
+    k_ref,  # [1, page, Hkv, D]  (pool row selected by index map)
+    v_ref,  # [1, page, Hkv, D]
+    o_ref,  # [1, H, D]
+    # scratch
+    m_ref,  # [H, 128] f32 running max (col 0 used)
+    l_ref,  # [H, 128] f32 running denom (col 0 used)
+    acc_ref,  # [H, D] f32 weighted accumulator
+    *,
+    page_size: int,
+    n_pages: int,
+):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -1e30)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    length = lengths_ref[b]
+
+    # number of valid tokens in this page
+    page_start = p * page_size
+    valid = jnp.clip(length - page_start, 0, page_size)
+
+    @pl.when(valid > 0)
+    def _attend():
+        q = q_ref[0]  # [H, D]
+        k = k_ref[0]  # [page, Hkv, D]
+        v = v_ref[0]
+        H, D = q.shape
+        page, Hkv, _ = k.shape
+        group = H // Hkv
+
+        qg = q.reshape(Hkv, group, D).astype(jnp.float32)
+        kf = k.astype(jnp.float32)
+        # logits [Hkv, group, page]
+        logits = jax.lax.dot_general(
+            qg, kf,
+            dimension_numbers=(((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        ) / math.sqrt(D)
+        idx = jax.lax.broadcasted_iota(jnp.int32, (Hkv, group, page), 2)
+        logits = jnp.where(idx < valid, logits, -1e30)
+        logits = logits.reshape(H, page)
+
+        m_prev = m_ref[:, 0:1]  # [H, 1]
+        m_cur = jnp.max(logits, axis=1, keepdims=True)  # [H, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)  # rescale factor [H, 1]
+        probs = jnp.exp(logits - m_new)  # [H, page]
+        # zero out invalid columns (exp(-1e30 - m) underflows already)
+        l_new = alpha * l_ref[:, 0:1] + jnp.sum(probs, axis=1, keepdims=True)
+
+        vf = v.astype(jnp.float32)  # [page, Hkv, D]
+        pg = probs.reshape(Hkv, group, page)
+        # pv [Hkv, group, D]
+        pv = jax.lax.dot_general(
+            pg, vf,
+            dimension_numbers=(((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * alpha + pv.reshape(H, D)
+        m_ref[:, 0:1] = m_new
+        l_ref[:, 0:1] = l_new
+
+    @pl.when(p == n_pages - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[:, 0:1], 1e-30)
+        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
+def paged_attention_decode(
+    q: jax.Array,  # [B, H, D]
+    k_pool: jax.Array,  # [n_slots, Hkv, D] flattened page pool
+    v_pool: jax.Array,  # [n_slots, Hkv, D]
+    page_table: jax.Array,  # [B, P] int32
+    lengths: jax.Array,  # [B] int32
+    *,
+    page_size: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Returns attention output [B, H, D] (same dtype as q)."""
+    B, H, D = q.shape
+    n_slots, Hkv, _ = k_pool.shape
+    P = page_table.shape[1]
+    # view the pool as pages for block indexing
+    k_pages = k_pool.reshape(n_slots // page_size, page_size, Hkv, D)
+    v_pages = v_pool.reshape(n_slots // page_size, page_size, Hkv, D)
+    flat_pt = page_table.reshape(-1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, P),
+        in_specs=[
+            pl.BlockSpec(
+                (1, H, D), lambda b, p, pt, ln: (b, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, page_size, Hkv, D),
+                lambda b, p, pt, ln: (pt[b * P + p], 0, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, page_size, Hkv, D),
+                lambda b, p, pt, ln: (pt[b * P + p], 0, 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda b, p, pt, ln: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, D), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel, page_size=page_size, n_pages=P
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        interpret=interpret,
+    )(flat_pt, lengths, q, k_pages, v_pages)

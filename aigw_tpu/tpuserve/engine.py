@@ -49,6 +49,10 @@ class EngineConfig:
     page_size: int = 128
     num_pages: int = 0  # 0 = auto: enough for max_batch full sequences
     min_prefill_bucket: int = 64
+    # Decode steps executed per host round-trip (lax.scan inside one jitted
+    # program). Amortizes host↔device latency; tokens sampled after a
+    # sequence's EOS within a window are discarded by the host.
+    decode_steps_per_tick: int = 8
 
     def __post_init__(self) -> None:
         if self.max_seq_len % self.page_size != 0:
@@ -84,12 +88,18 @@ class GenRequest:
 @dataclass
 class _Slot:
     req: GenRequest
-    # Position at which the *pending input token* (self._tokens[slot]) will
-    # be written by the next decode step. After prefilling a prompt of
-    # length n, the first sampled token is the pending input at position n.
+    # Position at which the *pending input token* will be written by the
+    # next decode step. After prefilling a prompt of length n, the first
+    # sampled token is the pending input at position n.
     pos: int
     generated: int
     key_seed: int
+    pending_token: int = 0
+    limit: int = 0  # exclusive max write position (page-safety fence)
+    page_row: np.ndarray | None = None
+    # becomes True when the slot has been included in a dispatched device
+    # state; windows dispatched earlier don't carry its tokens
+    started: bool = False
 
 
 @dataclass
@@ -142,16 +152,20 @@ class Engine:
             ),
             jnp.bfloat16,
         )
-        # host mirrors of per-slot arrays
-        self._page_table = np.zeros((B, cfg.max_pages_per_seq), np.int32)
-        self._tokens = np.zeros((B,), np.int32)
-        self._positions = np.zeros((B,), np.int32)
-        self._active = np.zeros((B,), bool)
-        self._temp = np.ones((B,), np.float32)
-        self._top_p = np.ones((B,), np.float32)
-        self._top_k = np.zeros((B,), np.int32)
+        # Per-slot decode state lives ON DEVICE between ticks (uploaded
+        # only when membership/sampling changes) — the decode hot loop
+        # transfers just the sampled [K, B] tokens per round-trip.
+        self._device_state: dict[str, jax.Array] | None = None
+        self._state_dirty = True
+        # 1-deep pipeline: the window dispatched to the device while the
+        # host processes the previous window's tokens.
+        self._inflight: jax.Array | None = None
+        # pages owned by finished sequences are recycled only after the
+        # in-flight window completes (it may still write into them).
+        self._pending_frees: list[int] = []
 
         mc, ps = model_cfg, cfg.page_size
+        K = cfg.decode_steps_per_tick
 
         def _prefill_step(params, tokens, seq_lens, kv, page_table, keys,
                           temp, top_p, top_k):
@@ -159,14 +173,36 @@ class Engine:
                                        page_table, ps)
             return sample(logits, keys, temp, top_p, top_k), kv
 
-        def _decode_step(params, tokens, positions, kv, page_table, active,
-                         keys, temp, top_p, top_k):
-            logits, kv = llama.decode_step(params, mc, tokens, positions, kv,
-                                           page_table, ps, active)
-            return sample(logits, keys, temp, top_p, top_k), kv
+        def _decode_scan(params, kv, state):
+            """K fused decode+sample steps; sampled tokens feed forward
+            on-device (no host round-trip inside the window)."""
+
+            def body(carry, _):
+                kv, st = carry
+                act = st["active"] & (st["positions"] < st["limits"])
+                logits, kv = llama.decode_step(
+                    params, mc, st["tokens"], st["positions"], kv,
+                    st["page_table"], ps, act,
+                )
+                sampled = sample(logits, st["keys"], st["temp"],
+                                 st["top_p"], st["top_k"])
+                step = act.astype(jnp.uint32)
+                new = dict(
+                    st,
+                    tokens=jnp.where(act, sampled, st["tokens"]),
+                    positions=jnp.where(act, st["positions"] + 1,
+                                        st["positions"]),
+                    keys=st["keys"].at[:, 1].add(step),
+                )
+                return (kv, new), sampled
+
+            (kv, state), sampled = jax.lax.scan(
+                body, (kv, state), None, length=K
+            )
+            return sampled, state, kv
 
         self._prefill_fn = jax.jit(_prefill_step, donate_argnums=(3,))
-        self._decode_fn = jax.jit(_decode_step, donate_argnums=(3,))
+        self._decode_fn = jax.jit(_decode_scan, donate_argnums=(1, 2))
 
     # -- public API -------------------------------------------------------
     def start(self) -> None:
@@ -193,18 +229,9 @@ class Engine:
     def warmup(self) -> None:
         """Compile the decode program before traffic arrives (the first
         request then only pays the prefill compile for its bucket)."""
-        B = self.cfg.max_batch_size
-        _, self.kv_cache = self._decode_fn(
-            self.params,
-            jnp.zeros((B,), jnp.int32),
-            jnp.zeros((B,), jnp.int32),
-            self.kv_cache,
-            jnp.asarray(self._page_table),
-            jnp.zeros((B,), bool),
-            jnp.zeros((B, 2), jnp.uint32),
-            jnp.ones((B,), jnp.float32),
-            jnp.ones((B,), jnp.float32),
-            jnp.zeros((B,), jnp.int32),
+        state = self._build_device_state()
+        _, _, self.kv_cache = self._decode_fn(
+            self.params, self.kv_cache, state
         )
 
     # -- engine loop ------------------------------------------------------
@@ -217,6 +244,9 @@ class Engine:
                 self._reap_cancelled()
                 admitted = self._admit()
                 worked = self._decode_tick()
+                if self._stop.is_set():
+                    self._drain_inflight()
+                    self._apply_frees()
             except Exception as e:  # never die silently: fail loudly and
                 # error out every in-flight request instead of hanging them
                 logger.exception("engine tick failed")
@@ -227,9 +257,17 @@ class Engine:
             if not admitted and not worked:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
+        # deliver any tokens still in flight before exiting
+        try:
+            self._drain_inflight()
+            self._apply_frees()
+        except Exception:
+            pass
         logger.info("engine loop stopped")
 
     def _abort_all(self, reason: str) -> None:
+        self._inflight = None
+        self._apply_frees()
         for i, s in enumerate(self._slots):
             if s is not None:
                 s.req.emit(-1, "error")
@@ -245,9 +283,9 @@ class Engine:
     def _reap_cancelled(self) -> None:
         for i, s in enumerate(self._slots):
             if s is not None and s.req.cancelled.is_set():
-                self.allocator.free(s.req.id)
+                self._pending_frees.append(s.req.id)
                 self._slots[i] = None
-                self._active[i] = False
+                self._state_dirty = True
 
     def _free_slot_index(self) -> int | None:
         for i, s in enumerate(self._slots):
@@ -310,11 +348,13 @@ class Engine:
 
             # pos=n-1: _emit_token advances it to n, the write position of
             # the just-sampled first token.
-            self._slots[slot_idx] = _Slot(req=req, pos=n - 1, generated=0,
-                                          key_seed=req.sampling.seed or seq_id)
-            self._page_table[slot_idx] = pt[0]
-            self._install_sampling(slot_idx, req.sampling)
+            self._slots[slot_idx] = _Slot(
+                req=req, pos=n - 1, generated=0,
+                key_seed=req.sampling.seed or seq_id,
+                limit=total, page_row=pt[0],
+            )
             self._emit_token(slot_idx, tok)
+            self._state_dirty = True
             admitted = True
         return admitted
 
@@ -329,48 +369,98 @@ class Engine:
         for it in items:
             self._queue.put(it)
 
-    def _install_sampling(self, i: int, sp: SamplingParams) -> None:
-        self._temp[i] = sp.temperature
-        self._top_p[i] = sp.top_p
-        self._top_k[i] = sp.top_k
+    def _build_device_state(self) -> dict[str, jax.Array]:
+        """Upload per-slot state after membership changes (admission /
+        completion) — small arrays, uploaded rarely."""
+        B = self.cfg.max_batch_size
+        P = self.cfg.max_pages_per_seq
+        tokens = np.zeros((B,), np.int32)
+        positions = np.zeros((B,), np.int32)
+        limits = np.zeros((B,), np.int32)
+        active = np.zeros((B,), bool)
+        page_table = np.zeros((B, P), np.int32)
+        keys = np.zeros((B, 2), np.uint32)
+        temp = np.ones((B,), np.float32)
+        top_p = np.ones((B,), np.float32)
+        top_k = np.zeros((B,), np.int32)
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            tokens[i] = s.pending_token
+            positions[i] = s.pos
+            limits[i] = s.limit
+            active[i] = True
+            page_table[i] = s.page_row
+            keys[i, 0] = np.uint32(s.key_seed & 0xFFFFFFFF)
+            keys[i, 1] = np.uint32(s.pos)
+            temp[i] = s.req.sampling.temperature
+            top_p[i] = s.req.sampling.top_p
+            top_k[i] = s.req.sampling.top_k
+        return {
+            "tokens": jnp.asarray(tokens),
+            "positions": jnp.asarray(positions),
+            "limits": jnp.asarray(limits),
+            "active": jnp.asarray(active),
+            "page_table": jnp.asarray(page_table),
+            "keys": jnp.asarray(keys),
+            "temp": jnp.asarray(temp),
+            "top_p": jnp.asarray(top_p),
+            "top_k": jnp.asarray(top_k),
+        }
+
+    def _process_window(self, sampled: jax.Array) -> None:
+        """Consume one decode window's sampled tokens (blocks until the
+        device finishes that window)."""
+        toks = np.asarray(sampled)  # [K, B]
+        K = toks.shape[0]
+        self.stats.decode_steps += K
+        for k in range(K):
+            for i, s in enumerate(self._slots):
+                if s is None:
+                    continue  # free slot / finished earlier in this window
+                if not s.started:
+                    continue  # admitted after this window was dispatched
+                self._emit_token(i, int(toks[k, i]))
+
+    def _drain_inflight(self) -> None:
+        if self._inflight is not None:
+            sampled, self._inflight = self._inflight, None
+            self._process_window(sampled)
+
+    def _apply_frees(self) -> None:
+        for seq_id in self._pending_frees:
+            self.allocator.free(seq_id)
+        self._pending_frees.clear()
 
     def _decode_tick(self) -> bool:
+        """Pipelined: dispatch window N+1, then process window N while
+        the device runs. State changes (admission/finish) force a drain so
+        the device never decodes against stale page tables."""
+        if self._state_dirty:
+            # finish the window computed under the old state first
+            self._drain_inflight()
+            self._apply_frees()
+            if self._state_dirty:
+                for s in self._slots:
+                    if s is not None:
+                        s.started = True
+                self._device_state = self._build_device_state()
+                self._state_dirty = False
+
         active_idx = [i for i, s in enumerate(self._slots) if s is not None]
         if not active_idx:
+            self._drain_inflight()
+            self._apply_frees()
             self.stats.active_slots = 0
             self._refresh_stats()
             return False
-        for i in active_idx:
-            s = self._slots[i]
-            self._positions[i] = s.pos
-            self._active[i] = True
-        for i in range(len(self._slots)):
-            if self._slots[i] is None:
-                self._active[i] = False
 
-        # per-slot deterministic PRNG keys: (seed, position)
-        keys = np.zeros((len(self._slots), 2), np.uint32)
-        for i, s in enumerate(self._slots):
-            if s is not None:
-                keys[i, 0] = np.uint32(s.key_seed & 0xFFFFFFFF)
-                keys[i, 1] = np.uint32(s.pos)
-
-        next_tok, self.kv_cache = self._decode_fn(
-            self.params,
-            jnp.asarray(self._tokens),
-            jnp.asarray(self._positions),
-            self.kv_cache,
-            jnp.asarray(self._page_table),
-            jnp.asarray(self._active),
-            jnp.asarray(keys),
-            jnp.asarray(self._temp),
-            jnp.asarray(self._top_p),
-            jnp.asarray(self._top_k),
+        sampled, self._device_state, self.kv_cache = self._decode_fn(
+            self.params, self.kv_cache, self._device_state
         )
-        toks = np.asarray(next_tok)
-        self.stats.decode_steps += 1
-        for i in active_idx:
-            self._emit_token(i, int(toks[i]))
+        # process the PREVIOUS window while this one runs on-device
+        self._drain_inflight()
+        self._inflight = sampled
         self.stats.active_slots = sum(s is not None for s in self._slots)
         self._refresh_stats()
         return True
@@ -392,13 +482,13 @@ class Engine:
             req.emit(tok, finish)
         self.stats.tokens_generated += 1
         if finish is not None:
-            self.allocator.free(req.id)
+            self._pending_frees.append(req.id)
             self._slots[i] = None
-            self._active[i] = False
+            self._state_dirty = True
             self._wake.set()  # maybe admit a queued request
         else:
             # the sampled token is the input of the next decode step
-            self._tokens[i] = tok
+            s.pending_token = tok
 
     def _refresh_stats(self) -> None:
         self.stats.queued = self._queue.qsize()

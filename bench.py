@@ -1,0 +1,141 @@
+"""Round benchmark — prints ONE JSON line.
+
+Measures the BASELINE.json north-star ratio on the real chip: continuous-
+batching engine decode throughput vs the raw JAX decode-loop ceiling for
+the same model/batch (the "≥90% of raw JAX tokens/sec" criterion), on a
+~1.1B-parameter Llama-architecture model (random weights — throughput is
+weight-agnostic) that fits a single v5e chip in bf16.
+
+    {"metric": "...", "value": engine_tokens_per_sec, "unit": "tokens/s",
+     "vs_baseline": engine/raw_jax}
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from aigw_tpu.models import llama
+from aigw_tpu.tpuserve.engine import Engine, EngineConfig, GenRequest
+from aigw_tpu.tpuserve.sampling import SamplingParams, sample
+
+BENCH_CFG = llama.LlamaConfig(
+    vocab_size=32000, dim=2048, n_layers=16, n_heads=16, n_kv_heads=8,
+    ffn_dim=8192, max_seq_len=1024, rope_theta=500000.0,
+)
+BATCH = 8
+PAGE = 128
+PROMPT_LEN = 128
+GEN_TOKENS = 128
+
+
+def raw_jax_tokens_per_sec(params) -> float:
+    """The ceiling: bare jitted decode steps, no scheduler, no HTTP."""
+    cfg = EngineConfig(max_batch_size=BATCH, max_seq_len=BENCH_CFG.max_seq_len,
+                       page_size=PAGE)
+    kv = jnp.zeros(
+        (BENCH_CFG.n_layers, 2, cfg.num_pages * PAGE, BENCH_CFG.n_kv_heads,
+         BENCH_CFG.head_dim), jnp.bfloat16,
+    )
+    pt = jnp.arange(BATCH * cfg.max_pages_per_seq, dtype=jnp.int32).reshape(
+        BATCH, cfg.max_pages_per_seq
+    )
+    active = jnp.ones((BATCH,), bool)
+    keys = jnp.zeros((BATCH, 2), jnp.uint32)
+    temp = jnp.zeros((BATCH,), jnp.float32)
+    top_p = jnp.ones((BATCH,), jnp.float32)
+    top_k = jnp.zeros((BATCH,), jnp.int32)
+
+    def step(params, tokens, positions, kv):
+        logits, kv = llama.decode_step(
+            params, BENCH_CFG, tokens, positions, kv, pt, PAGE, active
+        )
+        return sample(logits, keys, temp, top_p, top_k), kv
+
+    step = jax.jit(step, donate_argnums=(3,))
+    tokens = jnp.ones((BATCH,), jnp.int32)
+    positions = jnp.full((BATCH,), PROMPT_LEN, jnp.int32)
+
+    tokens, kv = step(params, tokens, positions, kv)  # compile
+    jax.block_until_ready(tokens)
+    n_steps = 64
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        tokens, kv = step(params, tokens, positions + 1 + i, kv)
+    jax.block_until_ready(tokens)
+    dt = time.perf_counter() - t0
+    return BATCH * n_steps / dt
+
+
+def engine_tokens_per_sec(params) -> float:
+    """The product: same decode through the continuous-batching engine."""
+    eng = Engine(
+        params,
+        BENCH_CFG,
+        EngineConfig(max_batch_size=BATCH,
+                     max_seq_len=BENCH_CFG.max_seq_len, page_size=PAGE),
+    )
+    eng.start()
+    try:
+        eng.warmup()
+        # warm the prefill bucket for PROMPT_LEN
+        done = threading.Event()
+        eng.submit(GenRequest(
+            prompt=[1] * PROMPT_LEN, max_tokens=2,
+            sampling=SamplingParams(temperature=0.0),
+            emit=lambda t, f: done.set() if f else None,
+        ))
+        done.wait(timeout=300)
+
+        dones = [threading.Event() for _ in range(BATCH)]
+        counts = [0] * BATCH
+
+        def mk(i):
+            def emit(tok, fin):
+                if tok >= 0:
+                    counts[i] += 1
+                if fin is not None:
+                    dones[i].set()
+            return emit
+
+        t0 = time.perf_counter()
+        for i in range(BATCH):
+            eng.submit(GenRequest(
+                prompt=[1 + i] * PROMPT_LEN, max_tokens=GEN_TOKENS,
+                sampling=SamplingParams(temperature=0.0), emit=mk(i),
+            ))
+        for d in dones:
+            d.wait(timeout=600)
+        dt = time.perf_counter() - t0
+        return sum(counts) / dt
+    finally:
+        eng.stop()
+
+
+def main() -> None:
+    params = llama.init_params(jax.random.PRNGKey(0), BENCH_CFG)
+    jax.block_until_ready(params)
+    raw = raw_jax_tokens_per_sec(params)
+    engine = engine_tokens_per_sec(params)
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "decode tokens/sec/chip, 1.1B llama-arch bf16, batch=8, "
+                    "paged KV (engine vs raw-JAX-loop ratio in vs_baseline)"
+                ),
+                "value": round(engine, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(engine / raw, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,294 @@
+"""MCP proxy tests: fake MCP backends behind the gateway's /mcp endpoint
+(reference tests/internal/testmcp + mcpproxy handlers_test)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import uuid
+
+import aiohttp
+import pytest
+from aiohttp import web
+
+from aigw_tpu.mcp import MCPBackend, MCPConfig, MCPProxy
+from aigw_tpu.mcp.crypto import SessionCrypto, SessionCryptoError
+
+
+class FakeMCPServer:
+    """Minimal streamable-HTTP MCP server with per-session state."""
+
+    def __init__(self, name: str, tools: list[str]):
+        self.name = name
+        self.tools = tools
+        self.sessions: set[str] = set()
+        self.calls: list[tuple[str, dict]] = []
+        self._app = web.Application()
+        self._app.router.add_post("/mcp", self._handle)
+        self._app.router.add_delete("/mcp", self._delete)
+        self._runner = None
+        self.url = ""
+
+    async def _handle(self, request: web.Request) -> web.Response:
+        msg = json.loads(await request.read())
+        method = msg.get("method")
+        sid = request.headers.get("mcp-session-id", "")
+        if method == "initialize":
+            sid = f"{self.name}-{uuid.uuid4().hex[:8]}"
+            self.sessions.add(sid)
+            return web.json_response(
+                {"jsonrpc": "2.0", "id": msg["id"],
+                 "result": {"protocolVersion": "2025-06-18",
+                            "capabilities": {"tools": {}},
+                            "serverInfo": {"name": self.name}}},
+                headers={"mcp-session-id": sid},
+            )
+        if sid not in self.sessions:
+            return web.json_response({"error": "no session"}, status=404)
+        if msg.get("id") is None:  # notification
+            return web.Response(status=202)
+        if method == "tools/list":
+            return web.json_response(
+                {"jsonrpc": "2.0", "id": msg["id"], "result": {
+                    "tools": [
+                        {"name": t,
+                         "description": f"{t} from {self.name}",
+                         "inputSchema": {"type": "object"}}
+                        for t in self.tools
+                    ]}}
+            )
+        if method == "tools/call":
+            params = msg.get("params") or {}
+            self.calls.append((params.get("name", ""), params))
+            return web.json_response(
+                {"jsonrpc": "2.0", "id": msg["id"], "result": {
+                    "content": [{"type": "text",
+                                 "text": f"{self.name} ran "
+                                         f"{params.get('name')}"}]}}
+            )
+        return web.json_response(
+            {"jsonrpc": "2.0", "id": msg["id"],
+             "error": {"code": -32601, "message": "nope"}}
+        )
+
+    async def _delete(self, request: web.Request) -> web.Response:
+        self.sessions.discard(request.headers.get("mcp-session-id", ""))
+        return web.Response(status=200)
+
+    async def start(self):
+        self._runner = web.AppRunner(self._app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        self.url = f"http://127.0.0.1:{port}/mcp"
+        return self
+
+    async def stop(self):
+        if self._runner:
+            await self._runner.cleanup()
+
+
+class TestSessionCrypto:
+    def test_roundtrip(self):
+        c = SessionCrypto("seed-1")
+        tok = c.encrypt(b'{"a": "b"}')
+        assert c.decrypt(tok) == b'{"a": "b"}'
+
+    def test_tamper_rejected(self):
+        c = SessionCrypto("seed-1")
+        tok = c.encrypt(b"payload")
+        bad = tok[:-2] + ("AA" if not tok.endswith("AA") else "BB")
+        with pytest.raises(SessionCryptoError):
+            c.decrypt(bad)
+
+    def test_wrong_seed_rejected(self):
+        tok = SessionCrypto("seed-1").encrypt(b"x")
+        with pytest.raises(SessionCryptoError):
+            SessionCrypto("other").decrypt(tok)
+
+    def test_rotation_via_fallback(self):
+        old = SessionCrypto("old-seed")
+        tok = old.encrypt(b"x")
+        rotated = SessionCrypto("new-seed", fallback_seed="old-seed")
+        assert rotated.decrypt(tok) == b"x"
+
+
+async def _mcp_env(include=(), exclude=()):
+    s1 = await FakeMCPServer("alpha", ["search", "fetch"]).start()
+    s2 = await FakeMCPServer("beta", ["compute", "secret_tool"]).start()
+    cfg = MCPConfig(
+        backends=(
+            MCPBackend(name="alpha", url=s1.url, include_tools=tuple(include)),
+            MCPBackend(name="beta", url=s2.url, exclude_tools=tuple(exclude)),
+        ),
+        session_seed="test-seed",
+    )
+    proxy = MCPProxy(cfg)
+    app = web.Application()
+    proxy.register(app)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return s1, s2, runner, f"http://127.0.0.1:{port}/mcp"
+
+
+async def _rpc(url, method, params=None, session=None, id_=1):
+    headers = {}
+    if session:
+        headers["mcp-session-id"] = session
+    payload = {"jsonrpc": "2.0", "id": id_, "method": method}
+    if params is not None:
+        payload["params"] = params
+    async with aiohttp.ClientSession() as s:
+        async with s.post(url, json=payload, headers=headers) as resp:
+            body = await resp.json() if resp.status != 202 else None
+            return resp.status, body, dict(resp.headers)
+
+
+class TestMCPProxy:
+    def test_initialize_and_tools(self):
+        async def main():
+            s1, s2, runner, url = await _mcp_env(exclude=["secret_*"])
+            try:
+                status, body, headers = await _rpc(
+                    url, "initialize",
+                    {"protocolVersion": "2025-06-18", "capabilities": {}},
+                )
+                assert status == 200
+                assert body["result"]["serverInfo"]["name"] == "aigw-tpu-mcp"
+                session = headers["mcp-session-id"]
+                assert session
+                # both backends got their own sessions
+                assert len(s1.sessions) == 1 and len(s2.sessions) == 1
+
+                status, body, _ = await _rpc(url, "tools/list",
+                                             session=session)
+                names = [t["name"] for t in body["result"]["tools"]]
+                assert "alpha__search" in names
+                assert "alpha__fetch" in names
+                assert "beta__compute" in names
+                assert "beta__secret_tool" not in names  # filtered
+
+                status, body, _ = await _rpc(
+                    url, "tools/call",
+                    {"name": "beta__compute", "arguments": {"x": 1}},
+                    session=session,
+                )
+                assert body["result"]["content"][0]["text"] == \
+                    "beta ran compute"
+                assert s2.calls[0][0] == "compute"  # prefix stripped
+
+                # filtered tool cannot be called either
+                status, body, _ = await _rpc(
+                    url, "tools/call", {"name": "beta__secret_tool"},
+                    session=session,
+                )
+                assert "error" in body
+            finally:
+                await runner.cleanup()
+                await s1.stop()
+                await s2.stop()
+
+        asyncio.run(main())
+
+    def test_session_stateless_resume(self):
+        """The encrypted session ID carries everything — a *new* proxy
+        instance (different replica) can serve it (reference
+        session.go:51-66)."""
+
+        async def main():
+            s1, s2, runner, url = await _mcp_env()
+            try:
+                _, _, headers = await _rpc(
+                    url, "initialize",
+                    {"protocolVersion": "2025-06-18", "capabilities": {}},
+                )
+                session = headers["mcp-session-id"]
+                # tear down the first proxy, boot a second one (same seed)
+                await runner.cleanup()
+                cfg = MCPConfig(
+                    backends=(
+                        MCPBackend(name="alpha", url=s1.url),
+                        MCPBackend(name="beta", url=s2.url),
+                    ),
+                    session_seed="test-seed",
+                )
+                proxy2 = MCPProxy(cfg)
+                app = web.Application()
+                proxy2.register(app)
+                runner2 = web.AppRunner(app)
+                await runner2.setup()
+                site = web.TCPSite(runner2, "127.0.0.1", 0)
+                await site.start()
+                port = site._server.sockets[0].getsockname()[1]
+                url2 = f"http://127.0.0.1:{port}/mcp"
+
+                status, body, _ = await _rpc(
+                    url2, "tools/call", {"name": "alpha__search"},
+                    session=session,
+                )
+                assert status == 200
+                assert body["result"]["content"][0]["text"] == \
+                    "alpha ran search"
+                await runner2.cleanup()
+            finally:
+                await s1.stop()
+                await s2.stop()
+
+        asyncio.run(main())
+
+    def test_bad_session_404(self):
+        async def main():
+            s1, s2, runner, url = await _mcp_env()
+            try:
+                status, body, _ = await _rpc(url, "tools/list",
+                                             session="garbage")
+                assert status == 404
+            finally:
+                await runner.cleanup()
+                await s1.stop()
+                await s2.stop()
+
+        asyncio.run(main())
+
+    def test_unknown_tool(self):
+        async def main():
+            s1, s2, runner, url = await _mcp_env()
+            try:
+                _, _, headers = await _rpc(
+                    url, "initialize",
+                    {"protocolVersion": "2025-06-18", "capabilities": {}},
+                )
+                session = headers["mcp-session-id"]
+                _, body, _ = await _rpc(url, "tools/call",
+                                        {"name": "nosuch__tool"},
+                                        session=session)
+                assert body["error"]["code"] == -32602
+            finally:
+                await runner.cleanup()
+                await s1.stop()
+                await s2.stop()
+
+        asyncio.run(main())
+
+    def test_ping(self):
+        async def main():
+            s1, s2, runner, url = await _mcp_env()
+            try:
+                _, _, headers = await _rpc(
+                    url, "initialize",
+                    {"protocolVersion": "2025-06-18", "capabilities": {}},
+                )
+                status, body, _ = await _rpc(
+                    url, "ping", session=headers["mcp-session-id"]
+                )
+                assert status == 200 and body["result"] == {}
+            finally:
+                await runner.cleanup()
+                await s1.stop()
+                await s2.stop()
+
+        asyncio.run(main())

@@ -1,0 +1,164 @@
+"""Quota / rate-limit engine tests (reference: internal/ratelimit/translator
+descriptor semantics + token_ratelimit e2e)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from aigw_tpu.config.model import Config, ConfigError
+from aigw_tpu.config.runtime import RuntimeConfig
+from aigw_tpu.gateway.ratelimit import QuotaRule, RateLimiter
+from aigw_tpu.gateway.server import run_gateway
+from tests.fakes import FakeUpstream, openai_chat_response
+
+
+class TestRateLimiter:
+    def rules(self):
+        return [
+            QuotaRule(name="global", metadata_key="total", limit=100,
+                      window_seconds=60),
+            QuotaRule(name="per-user", metadata_key="total", limit=10,
+                      window_seconds=60, client_key_header="x-user-id"),
+            QuotaRule(name="gpt4-only", metadata_key="out", limit=5,
+                      window_seconds=60, model="gpt-4o"),
+        ]
+
+    def test_enforce_after_consume(self):
+        rl = RateLimiter(self.rules())
+        h = {"x-user-id": "alice"}
+        ok, _ = rl.check("m", "b", h, now=0)
+        assert ok
+        rl.consume({"total": 10}, "m", "b", h, now=1)
+        ok, rule = rl.check("m", "b", h, now=2)
+        assert not ok and rule.name == "per-user"
+        # other user unaffected
+        ok, _ = rl.check("m", "b", {"x-user-id": "bob"}, now=2)
+        assert ok
+
+    def test_window_reset(self):
+        rl = RateLimiter(self.rules())
+        h = {"x-user-id": "alice"}
+        rl.consume({"total": 10}, "m", "b", h, now=1)
+        assert not rl.check("m", "b", h, now=2)[0]
+        assert rl.check("m", "b", h, now=61)[0]  # next window
+
+    def test_model_scoping(self):
+        rl = RateLimiter(self.rules())
+        rl.consume({"out": 5}, "gpt-4o", "b", {}, now=0)
+        assert not rl.check("gpt-4o", "b", {}, now=1)[0]
+        assert rl.check("other-model", "b", {}, now=1)[0]
+
+    def test_remaining(self):
+        rl = RateLimiter(self.rules())
+        rl.consume({"total": 30}, "m", "b", {}, now=0)
+        assert rl.remaining("global", now=1) == 70
+
+    def test_parse_validation(self):
+        with pytest.raises(ConfigError):
+            QuotaRule.parse({"name": "x", "metadata_key": "t", "limit": 0})
+        with pytest.raises(ConfigError):
+            QuotaRule.parse({"name": "x"})
+
+
+class TestGatewayQuota:
+    def test_429_after_budget_exhausted(self):
+        async def main():
+            up = FakeUpstream().on_json(
+                "/v1/chat/completions",
+                openai_chat_response(prompt_tokens=5, completion_tokens=45),
+            )
+            await up.start()
+            cfg = Config.parse({
+                "version": "v1",
+                "backends": [
+                    {"name": "a", "schema": "OpenAI", "url": up.url}
+                ],
+                "routes": [{"name": "r", "rules": [
+                    {"models": ["m1"], "backends": ["a"]}]}],
+                "llm_request_costs": [
+                    {"metadata_key": "total", "type": "TotalToken"}
+                ],
+                "quotas": [
+                    {"name": "cap", "metadata_key": "total", "limit": 60,
+                     "window_seconds": 3600,
+                     "client_key_header": "x-user-id"}
+                ],
+            })
+            server, runner = await run_gateway(RuntimeConfig.build(cfg),
+                                               port=0)
+            site = list(runner.sites)[0]
+            port = site._server.sockets[0].getsockname()[1]
+            url = f"http://127.0.0.1:{port}/v1/chat/completions"
+            payload = {"model": "m1",
+                       "messages": [{"role": "user", "content": "hi"}]}
+            try:
+                async with aiohttp.ClientSession() as s:
+                    # request 1: under budget (costs 50 after completion)
+                    async with s.post(url, json=payload,
+                                      headers={"x-user-id": "u1"}) as r1:
+                        assert r1.status == 200
+                    # request 2: 50 < 60 still admitted; consumes 50 more
+                    async with s.post(url, json=payload,
+                                      headers={"x-user-id": "u1"}) as r2:
+                        assert r2.status == 200
+                    # request 3: budget (100 > 60) exhausted → 429
+                    async with s.post(url, json=payload,
+                                      headers={"x-user-id": "u1"}) as r3:
+                        assert r3.status == 429
+                        err = await r3.json()
+                        assert err["error"]["type"] == "rate_limit_error"
+                        assert r3.headers.get("retry-after")
+                    # other client unaffected
+                    async with s.post(url, json=payload,
+                                      headers={"x-user-id": "u2"}) as r4:
+                        assert r4.status == 200
+            finally:
+                await runner.cleanup()
+                await up.stop()
+
+        asyncio.run(main())
+
+
+class TestReloadCarryover:
+    def test_adopt_preserves_windows(self):
+        """Config hot reload must not refill exhausted budgets."""
+        from aigw_tpu.config.model import Config
+        from aigw_tpu.config.runtime import RuntimeConfig
+
+        cfg_dict = {
+            "version": "v1",
+            "backends": [{"name": "a", "schema": "OpenAI", "url": "http://x"}],
+            "routes": [{"name": "r", "rules": [
+                {"models": ["m"], "backends": ["a"]}]}],
+            "quotas": [{"name": "cap", "metadata_key": "total",
+                        "limit": 10, "window_seconds": 3600}],
+        }
+        rc1 = RuntimeConfig.build(Config.parse(cfg_dict))
+        rc1.rate_limiter.consume({"total": 10}, "m", "a", {}, now=100)
+        assert not rc1.rate_limiter.check("m", "a", {}, now=101)[0]
+
+        # reload with an unrelated change — budget stays exhausted
+        cfg_dict2 = dict(cfg_dict)
+        cfg_dict2["models"] = ["m"]
+        rc2 = RuntimeConfig.build(Config.parse(cfg_dict2), previous=rc1)
+        assert not rc2.rate_limiter.check("m", "a", {}, now=102)[0]
+
+        # reload that CHANGES the rule — fresh budget
+        cfg_dict3 = dict(cfg_dict)
+        cfg_dict3["quotas"] = [{"name": "cap", "metadata_key": "total",
+                                "limit": 20, "window_seconds": 3600}]
+        rc3 = RuntimeConfig.build(Config.parse(cfg_dict3), previous=rc2)
+        assert rc3.rate_limiter.check("m", "a", {}, now=103)[0]
+
+    def test_window_sweep(self):
+        rl = RateLimiter([QuotaRule(name="r", metadata_key="t", limit=5,
+                                    window_seconds=1)])
+        rl._SWEEP_EVERY = 10
+        for i in range(25):
+            rl.consume({"t": 1}, "m", "b", {"x": str(i)}, now=float(i * 10))
+        # old windows were evicted (2×window grace)
+        assert len(rl._windows) < 10
