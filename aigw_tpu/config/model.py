@@ -208,6 +208,20 @@ class BodyMutation:
         return d
 
 
+def _check_endpoint(e: Any) -> Any:
+    """Reject malformed picker endpoints at config load so a bad hot
+    reload is dropped by the keep-last-good path instead of blowing up in
+    the reload callback."""
+    if isinstance(e, str) and e:
+        return e
+    if isinstance(e, dict) and isinstance(e.get("address"), str) and e["address"]:
+        return e
+    raise ConfigError(
+        f"invalid endpoint entry {e!r}: expected 'host:port' or "
+        "{{address: ..., slice: ...}}"
+    )
+
+
 def _freeze(v: Any) -> Any:
     """Make parsed JSON hashable so dataclasses stay frozen."""
     if isinstance(v, dict):
@@ -277,6 +291,12 @@ class Backend:
     # Upstream base URL, e.g. "https://api.openai.com" or
     # "http://127.0.0.1:8011". TLS decided by the scheme.
     url: str = ""
+    # Replica pool for the endpoint picker (InferencePool equivalent):
+    # entries are "host:port" strings or {address, slice} mappings. When
+    # set, the picker chooses a replica per request by KV occupancy /
+    # queue depth / slice affinity and overrides `url`.
+    endpoints: tuple[Any, ...] = ()
+    picker_poll_interval: float = 1.0
     auth: AuthConfig = AuthConfig()
     header_mutation: HeaderMutation = HeaderMutation()
     body_mutation: BodyMutation = BodyMutation()
@@ -295,6 +315,13 @@ class Backend:
                 name=value["name"],
                 schema=APISchema.parse(value["schema"]),
                 url=value.get("url", ""),
+                endpoints=tuple(
+                    _freeze(_check_endpoint(e))
+                    for e in value.get("endpoints", ())
+                ),
+                picker_poll_interval=float(
+                    value.get("picker_poll_interval", 1.0)
+                ),
                 auth=AuthConfig.parse(value.get("auth")),
                 header_mutation=HeaderMutation.parse(value.get("header_mutation")),
                 body_mutation=BodyMutation.parse(value.get("body_mutation")),
@@ -309,6 +336,10 @@ class Backend:
         d: dict[str, Any] = {"name": self.name, "schema": self.schema.to_dict()}
         if self.url:
             d["url"] = self.url
+        if self.endpoints:
+            d["endpoints"] = [_thaw(e) for e in self.endpoints]
+        if self.picker_poll_interval != 1.0:
+            d["picker_poll_interval"] = self.picker_poll_interval
         if self.auth.kind is not AuthKind.NONE:
             d["auth"] = self.auth.to_dict()
         if self.header_mutation != HeaderMutation():
@@ -494,6 +525,9 @@ class Config:
         names = [b.name for b in self.backends]
         if len(names) != len(set(names)):
             raise ConfigError("duplicate backend names")
+        # NOTE: a backend with neither url nor endpoints is legal — it can
+        # be driven purely by the x-gateway-destination-endpoint header
+        # (external EPP flow, reference post_cluster_modify.go:67-80).
         for r in self.routes:
             for rule in r.rules:
                 for ref in rule.backends:

@@ -1,0 +1,176 @@
+"""TPU endpoint picker — KV-occupancy- and topology-aware load balancing.
+
+The role the reference delegates to an external EPP service speaking
+ext_proc (InferencePool → picker sets ``x-gateway-destination-endpoint``,
+reference inferencepool.go:47, post_cluster_modify.go:67-80). Here the
+picker is in-process: it polls each tpuserve replica's ``/state``
+telemetry (KV page occupancy, queue depth, active slots — exported by
+aigw_tpu/tpuserve/server.py) and scores endpoints:
+
+    score = kv_occupancy                     (HBM pressure)
+          + queued / max_slots               (waiting work)
+          + active_slots / max_slots * 0.5   (decode batch load)
+          + 0.25 if on a different slice than the session's previous
+            endpoint (ICI affinity: keeps a conversation's KV-cache
+            locality when replicas span slices)
+
+Unhealthy or stale endpoints are skipped; with no telemetry at all the
+picker falls back to round-robin.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+import aiohttp
+
+logger = logging.getLogger(__name__)
+
+#: request header carrying a session affinity key (optional)
+AFFINITY_HEADER = "x-aigw-session-affinity"
+
+
+@dataclass(frozen=True)
+class Endpoint:
+    address: str  # host:port
+    slice_name: str = ""  # ICI slice / host grouping label
+
+    @staticmethod
+    def parse(value: Any) -> "Endpoint":
+        if isinstance(value, str):
+            return Endpoint(address=value)
+        return Endpoint(address=value["address"],
+                        slice_name=value.get("slice", ""))
+
+
+@dataclass
+class EndpointState:
+    healthy: bool = False
+    kv_occupancy: float = 0.0
+    queued: int = 0
+    active_slots: int = 0
+    max_slots: int = 1
+    updated_at: float = 0.0
+
+
+class EndpointPicker:
+    """Picker for one backend pool."""
+
+    STALE_AFTER = 10.0  # seconds without telemetry → treat as unknown
+
+    def __init__(self, endpoints: list[Endpoint],
+                 poll_interval: float = 1.0):
+        self.endpoints = endpoints
+        self.poll_interval = poll_interval
+        self.state: dict[str, EndpointState] = {
+            e.address: EndpointState() for e in endpoints
+        }
+        self._rr = itertools.cycle([e.address for e in endpoints])
+        self._affinity: dict[str, str] = {}  # session key → address
+        self._task: asyncio.Task | None = None
+
+    # -- polling ----------------------------------------------------------
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._poll_loop(),
+                                         name="endpoint-picker")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _poll_loop(self) -> None:
+        async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=2.0)
+        ) as session:
+            while True:
+                await asyncio.gather(
+                    *(self._poll_one(session, e) for e in self.endpoints),
+                    return_exceptions=True,
+                )
+                await asyncio.sleep(self.poll_interval)
+
+    async def _poll_one(self, session: aiohttp.ClientSession,
+                        e: Endpoint) -> None:
+        st = self.state[e.address]
+        try:
+            async with session.get(f"http://{e.address}/state") as resp:
+                if resp.status != 200:
+                    st.healthy = False
+                    return
+                data = await resp.json()
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            st.healthy = False
+            return
+        st.healthy = True
+        st.kv_occupancy = float(data.get("kv_occupancy", 0.0))
+        st.queued = int(data.get("queued", 0))
+        st.active_slots = int(data.get("active_slots", 0))
+        st.max_slots = max(1, int(data.get("max_slots", 1)))
+        st.updated_at = time.monotonic()
+
+    # -- manual state injection (tests / push-based telemetry) ------------
+    def observe(self, address: str, *, kv_occupancy: float = 0.0,
+                queued: int = 0, active_slots: int = 0,
+                max_slots: int = 1) -> None:
+        st = self.state[address]
+        st.healthy = True
+        st.kv_occupancy = kv_occupancy
+        st.queued = queued
+        st.active_slots = active_slots
+        st.max_slots = max(1, max_slots)
+        st.updated_at = time.monotonic()
+
+    # -- picking ----------------------------------------------------------
+    def pick(self, headers: dict[str, str] | None = None) -> str | None:
+        """Returns 'host:port' for the request, or None if no endpoints."""
+        if not self.endpoints:
+            return None
+        now = time.monotonic()
+        affinity_key = (headers or {}).get(AFFINITY_HEADER, "")
+        preferred_slice = ""
+        if affinity_key:
+            prev = self._affinity.get(affinity_key)
+            if prev:
+                preferred_slice = next(
+                    (e.slice_name for e in self.endpoints
+                     if e.address == prev),
+                    "",
+                )
+
+        best: tuple[float, str] | None = None
+        any_fresh = False
+        for e in self.endpoints:
+            st = self.state[e.address]
+            fresh = st.healthy and now - st.updated_at < self.STALE_AFTER
+            if not fresh:
+                continue
+            any_fresh = True
+            score = (
+                st.kv_occupancy
+                + st.queued / st.max_slots
+                + 0.5 * st.active_slots / st.max_slots
+            )
+            if preferred_slice and e.slice_name != preferred_slice:
+                score += 0.25
+            if best is None or score < best[0]:
+                best = (score, e.address)
+        if not any_fresh:
+            # no telemetry (cold start / all down): round-robin blindly
+            chosen = next(self._rr)
+        else:
+            chosen = best[1]  # type: ignore[index]
+        if affinity_key:
+            self._affinity[affinity_key] = chosen
+            if len(self._affinity) > 100_000:
+                self._affinity.clear()  # bounded memory, coarse reset
+        return chosen

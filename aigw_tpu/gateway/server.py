@@ -42,6 +42,7 @@ from aigw_tpu.config.runtime import RuntimeBackend, RuntimeConfig
 from aigw_tpu.gateway.auth import AuthError
 from aigw_tpu.gateway.costs import TokenUsage
 from aigw_tpu.gateway.mutators import apply_body_mutation, apply_header_mutation
+from aigw_tpu.gateway.picker import Endpoint as PickerEndpoint, EndpointPicker
 from aigw_tpu.gateway.router import BackendSelector, NoRouteError, match_route
 from aigw_tpu.obs.metrics import GenAIMetrics, RequestMetrics
 from aigw_tpu.schemas import anthropic as anth
@@ -94,6 +95,10 @@ class GatewayServer:
         self.app.router.add_get("/v1/models", self._handle_models)
         self.app.router.add_get("/health", self._handle_health)
         self.app.router.add_get("/metrics", self._handle_metrics)
+        self._pickers: dict[str, EndpointPicker] = {}
+        self._picker_tasks: set[asyncio.Task] = set()
+        self._build_pickers(runtime)
+        self.app.on_startup.append(self._start_pickers)
         if runtime.config.mcp:
             # MCP endpoint path/backends are fixed at startup (config hot
             # reload swaps routes/backends; MCP topology needs a restart).
@@ -109,8 +114,54 @@ class GatewayServer:
         return self._runtime
 
     def set_runtime(self, rc: RuntimeConfig) -> None:
-        """Hot-swap config (called by ConfigWatcher)."""
+        """Hot-swap config (called by ConfigWatcher). Pickers whose
+        endpoint pools are unchanged are reused so telemetry and session
+        affinity survive reloads."""
         self._runtime = rc
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        old = self._pickers
+        self._build_pickers(rc)
+        if loop is not None:
+            for name, picker in old.items():
+                if self._pickers.get(name) is not picker:
+                    self._spawn(loop, picker.stop())
+            for name, picker in self._pickers.items():
+                if old.get(name) is not picker:
+                    self._spawn(loop, picker.start())
+
+    def _spawn(self, loop: asyncio.AbstractEventLoop, coro) -> None:
+        # the loop holds tasks weakly; retain refs until completion
+        task = loop.create_task(coro)
+        self._picker_tasks.add(task)
+        task.add_done_callback(self._picker_tasks.discard)
+
+    def _build_pickers(self, rc: RuntimeConfig) -> None:
+        from aigw_tpu.config.model import _thaw
+
+        pickers: dict[str, EndpointPicker] = {}
+        for name, rb in rc.backends.items():
+            b = rb.backend
+            if not b.endpoints:
+                continue
+            prev = self._pickers.get(name)
+            key = (b.endpoints, b.picker_poll_interval)
+            if prev is not None and getattr(prev, "_config_key", None) == key:
+                pickers[name] = prev  # unchanged pool: keep state
+                continue
+            picker = EndpointPicker(
+                [PickerEndpoint.parse(_thaw(e)) for e in b.endpoints],
+                poll_interval=b.picker_poll_interval,
+            )
+            picker._config_key = key  # type: ignore[attr-defined]
+            pickers[name] = picker
+        self._pickers = pickers
+
+    async def _start_pickers(self, _app) -> None:
+        for picker in self._pickers.values():
+            await picker.start()
 
     async def _get_session(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
@@ -121,6 +172,8 @@ class GatewayServer:
         return self._session
 
     async def _cleanup(self, _app: web.Application) -> None:
+        for picker in self._pickers.values():
+            await picker.stop()
         if self._session is not None and not self._session.closed:
             await self._session.close()
 
@@ -265,10 +318,13 @@ class GatewayServer:
             "content-type": "application/json",
             "accept": "text/event-stream" if tx.stream else "application/json",
         }
-        # Endpoint-picker support: honor a pre-selected destination set by
-        # the picker (reference x-gateway-destination-endpoint +
-        # ORIGINAL_DST, post_cluster_modify.go:67-80).
+        # Endpoint-picker support: an externally pre-selected destination
+        # (the reference's x-gateway-destination-endpoint + ORIGINAL_DST
+        # contract, post_cluster_modify.go:67-80) wins; otherwise the
+        # in-process picker chooses a replica from the backend's pool.
         dest = request.headers.get(DESTINATION_ENDPOINT_HEADER, "")
+        if not dest and backend.name in self._pickers:
+            dest = self._pickers[backend.name].pick(client_headers) or ""
         base_url = f"http://{dest}" if dest else backend.url
         if not base_url:
             raise _RetriableUpstreamError(

@@ -4,16 +4,17 @@ One query token per sequence attends over its paged KV cache (the decode
 hot loop). Design (ragged-paged-attention style, PAPERS.md
 arxiv 2604.15464 — implementation is original):
 
-- Grid ``(B, P)`` — sequence-major, pages innermost. The page table is a
-  **scalar-prefetch** argument, so each page's K/V block is DMA'd from the
-  HBM pool straight to VMEM by the Pallas pipeline (auto double-buffered)
-  using a *data-dependent* index map: block ``p`` of sequence ``b`` comes
-  from pool row ``page_table[b, p]``.
+- Grid ``(B, Hkv, P)`` — sequence, KV head, then pages innermost. The page
+  table is a **scalar-prefetch** argument, so each page's K/V block is
+  DMA'd from the HBM pool straight to VMEM by the Pallas pipeline (auto
+  double-buffered) using a *data-dependent* index map: page ``p`` of
+  sequence ``b`` comes from pool row ``page_table[b, p]``.
 - Online softmax across pages: running max / denominator / weighted
   accumulator live in VMEM scratch, carried across the page loop for a
-  fixed sequence; the output tile is written on the last page.
-- GQA: Q heads are grouped per KV head inside the kernel; K/V stay
-  un-repeated in HBM (bandwidth is the decode bottleneck).
+  fixed (sequence, head); the output tile is written on the last page.
+- GQA: each grid step processes the ``group = H // Hkv`` query heads that
+  share one KV head, as plain 2D matmuls (Mosaic-friendly; K/V stay
+  un-repeated in HBM since bandwidth is the decode bottleneck).
 """
 
 from __future__ import annotations
@@ -29,23 +30,23 @@ from jax.experimental.pallas import tpu as pltpu
 
 def _decode_kernel(
     # scalar prefetch
-    page_table_ref,  # [B * P] int32 — pool row per (b, p)
+    page_table_ref,  # [B * P] int32 — pool page id per (b, p)
     lengths_ref,  # [B] int32 — attend length per sequence
     # blocks
-    q_ref,  # [1, H, D]
-    k_ref,  # [1, page, Hkv, D]  (pool row selected by index map)
-    v_ref,  # [1, page, Hkv, D]
-    o_ref,  # [1, H, D]
+    q_ref,  # [1, 1, group, D]
+    k_ref,  # [page, D] (pool page row + head column selected by index map)
+    v_ref,  # [page, D]
+    o_ref,  # [1, 1, group, D]
     # scratch
-    m_ref,  # [H, 128] f32 running max (col 0 used)
-    l_ref,  # [H, 128] f32 running denom (col 0 used)
-    acc_ref,  # [H, D] f32 weighted accumulator
+    m_ref,  # [group, 128] f32 running max (col 0 used)
+    l_ref,  # [group, 128] f32 running denom (col 0 used)
+    acc_ref,  # [group, D] f32 weighted accumulator
     *,
     page_size: int,
     n_pages: int,
 ):
     b = pl.program_id(0)
-    p = pl.program_id(1)
+    p = pl.program_id(2)
 
     @pl.when(p == 0)
     def _init():
@@ -54,56 +55,44 @@ def _decode_kernel(
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     length = lengths_ref[b]
-
-    # number of valid tokens in this page
-    page_start = p * page_size
-    valid = jnp.clip(length - page_start, 0, page_size)
+    valid = jnp.clip(length - p * page_size, 0, page_size)
 
     @pl.when(valid > 0)
     def _attend():
-        q = q_ref[0]  # [H, D]
-        k = k_ref[0]  # [page, Hkv, D]
-        v = v_ref[0]
-        H, D = q.shape
-        page, Hkv, _ = k.shape
-        group = H // Hkv
+        q = q_ref[0, 0].astype(jnp.float32)  # [group, D]
+        k = k_ref[:].astype(jnp.float32)  # [page, D]
+        v = v_ref[:].astype(jnp.float32)  # [page, D]
+        group, D = q.shape
+        page = k.shape[0]
 
-        qg = q.reshape(Hkv, group, D).astype(jnp.float32)
-        kf = k.astype(jnp.float32)
-        # logits [Hkv, group, page]
+        # logits [group, page]
         logits = jax.lax.dot_general(
-            qg, kf,
-            dimension_numbers=(((2,), (2,)), ((0,), (1,))),
+            q, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) / math.sqrt(D)
-        idx = jax.lax.broadcasted_iota(jnp.int32, (Hkv, group, page), 2)
+        idx = jax.lax.broadcasted_iota(jnp.int32, (group, page), 1)
         logits = jnp.where(idx < valid, logits, -1e30)
-        logits = logits.reshape(H, page)
 
-        m_prev = m_ref[:, 0:1]  # [H, 1]
-        m_cur = jnp.max(logits, axis=1, keepdims=True)  # [H, 1]
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)  # rescale factor [H, 1]
-        probs = jnp.exp(logits - m_new)  # [H, page]
-        # zero out invalid columns (exp(-1e30 - m) underflows already)
-        l_new = alpha * l_ref[:, 0:1] + jnp.sum(probs, axis=1, keepdims=True)
-
-        vf = v.astype(jnp.float32)  # [page, Hkv, D]
-        pg = probs.reshape(Hkv, group, page)
-        # pv [Hkv, group, D]
-        pv = jax.lax.dot_general(
-            pg, vf,
-            dimension_numbers=(((2,), (0,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32,
+        m_prev = m_ref[:, 0:1]  # [group, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(logits, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        probs = jnp.exp(logits - m_new)  # [group, page]
+        l_ref[:, 0:1] = alpha * l_ref[:, 0:1] + jnp.sum(
+            probs, axis=1, keepdims=True
         )
-        acc_ref[:] = acc_ref[:] * alpha + pv.reshape(H, D)
+        pv = jax.lax.dot_general(
+            probs, v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [group, D]
+        acc_ref[:] = acc_ref[:] * alpha + pv
         m_ref[:, 0:1] = m_new
-        l_ref[:, 0:1] = l_new
 
     @pl.when(p == n_pages - 1)
     def _finalize():
         denom = jnp.maximum(l_ref[:, 0:1], 1e-30)
-        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+        o_ref[0, 0] = (acc_ref[:] / denom).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
@@ -121,26 +110,146 @@ def paged_attention_decode(
     B, H, D = q.shape
     n_slots, Hkv, _ = k_pool.shape
     P = page_table.shape[1]
-    # view the pool as pages for block indexing
-    k_pages = k_pool.reshape(n_slots // page_size, page_size, Hkv, D)
-    v_pages = v_pool.reshape(n_slots // page_size, page_size, Hkv, D)
+    group = H // Hkv
+    # views for block indexing: the pool flattens to 2D so a (page, D)
+    # block can select [pool row = page id, column window = kv head] —
+    # contiguous reshapes only, no data movement.
+    q4 = q.reshape(B, Hkv, group, D)
+    k2d = k_pool.reshape(n_slots, Hkv * D)
+    v2d = v_pool.reshape(n_slots, Hkv * D)
     flat_pt = page_table.reshape(-1)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(B, P),
+        grid=(B, Hkv, P),
         in_specs=[
             pl.BlockSpec(
-                (1, H, D), lambda b, p, pt, ln: (b, 0, 0),
+                (1, 1, group, D), lambda b, h, p, pt, ln: (b, h, 0, 0),
             ),
             pl.BlockSpec(
-                (1, page_size, Hkv, D),
-                lambda b, p, pt, ln: (pt[b * P + p], 0, 0, 0),
+                (page_size, D),
+                lambda b, h, p, pt, ln: (pt[b * P + p], h),
             ),
             pl.BlockSpec(
-                (1, page_size, Hkv, D),
-                lambda b, p, pt, ln: (pt[b * P + p], 0, 0, 0),
+                (page_size, D),
+                lambda b, h, p, pt, ln: (pt[b * P + p], h),
             ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, group, D), lambda b, h, p, pt, ln: (b, h, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, D), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel, page_size=page_size, n_pages=P
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, group, D), q.dtype),
+        interpret=interpret,
+    )(flat_pt, lengths, q4, k2d, v2d)
+    return out.reshape(B, H, D)
+
+
+def _decode_kernel_v2(
+    page_table_ref,  # [B * P] int32
+    lengths_ref,  # [B] int32
+    q_ref,  # [1, H, D]
+    k_ref,  # [page, Hkv * D] — one full pool page, all heads
+    v_ref,  # [page, Hkv * D]
+    o_ref,  # [1, H, D]
+    m_ref,  # [H, 128] f32
+    l_ref,  # [H, 128] f32
+    acc_ref,  # [H, D] f32
+    *,
+    page_size: int,
+    n_pages: int,
+    n_kv_heads: int,
+):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -1e30)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    length = lengths_ref[b]
+    valid = jnp.clip(length - p * page_size, 0, page_size)
+
+    @pl.when(valid > 0)
+    def _attend():
+        H, D = q_ref.shape[1], q_ref.shape[2]
+        page = k_ref.shape[0]
+        group = H // n_kv_heads
+        q = q_ref[0].astype(jnp.float32)  # [H, D]
+        mask = jax.lax.broadcasted_iota(jnp.int32, (group, page), 1) < valid
+        for h in range(n_kv_heads):  # static unroll: one 2D matmul pair/head
+            rows = slice(h * group, (h + 1) * group)
+            k_h = k_ref[:, h * D : (h + 1) * D].astype(jnp.float32)
+            v_h = v_ref[:, h * D : (h + 1) * D].astype(jnp.float32)
+            logits = jax.lax.dot_general(
+                q[rows], k_h,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) / math.sqrt(D)
+            logits = jnp.where(mask, logits, -1e30)
+            m_prev = m_ref[rows, 0:1]
+            m_new = jnp.maximum(m_prev,
+                                jnp.max(logits, axis=1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            probs = jnp.exp(logits - m_new)
+            l_ref[rows, 0:1] = alpha * l_ref[rows, 0:1] + jnp.sum(
+                probs, axis=1, keepdims=True
+            )
+            pv = jax.lax.dot_general(
+                probs, v_h,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_ref[rows, :] = acc_ref[rows, :] * alpha + pv
+            m_ref[rows, 0:1] = m_new
+
+    @pl.when(p == n_pages - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[:, 0:1], 1e-30)
+        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
+def paged_attention_decode_v2(
+    q: jax.Array,  # [B, H, D]
+    k_pool: jax.Array,  # [n_slots, Hkv, D]
+    v_pool: jax.Array,
+    page_table: jax.Array,  # [B, P]
+    lengths: jax.Array,  # [B]
+    *,
+    page_size: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Grid (B, P): one instance streams a full page (all KV heads) —
+    fewer grid steps, bigger DMAs than v1."""
+    B, H, D = q.shape
+    n_slots, Hkv, _ = k_pool.shape
+    P = page_table.shape[1]
+    k2d = k_pool.reshape(n_slots, Hkv * D)
+    v2d = v_pool.reshape(n_slots, Hkv * D)
+    flat_pt = page_table.reshape(-1)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, P),
+        in_specs=[
+            pl.BlockSpec((1, H, D), lambda b, p, pt, ln: (b, 0, 0)),
+            pl.BlockSpec((page_size, Hkv * D),
+                         lambda b, p, pt, ln: (pt[b * P + p], 0)),
+            pl.BlockSpec((page_size, Hkv * D),
+                         lambda b, p, pt, ln: (pt[b * P + p], 0)),
         ],
         out_specs=pl.BlockSpec((1, H, D), lambda b, p, pt, ln: (b, 0, 0)),
         scratch_shapes=[
@@ -150,11 +259,11 @@ def paged_attention_decode(
         ],
     )
     kernel = functools.partial(
-        _decode_kernel, page_size=page_size, n_pages=P
+        _decode_kernel_v2, page_size=page_size, n_pages=P, n_kv_heads=Hkv
     )
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
         interpret=interpret,
-    )(flat_pt, lengths, q, k_pages, v_pages)
+    )(flat_pt, lengths, q, k2d, v2d)

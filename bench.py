@@ -78,7 +78,8 @@ def engine_tokens_per_sec(params) -> float:
         params,
         BENCH_CFG,
         EngineConfig(max_batch_size=BATCH,
-                     max_seq_len=BENCH_CFG.max_seq_len, page_size=PAGE),
+                     max_seq_len=BENCH_CFG.max_seq_len, page_size=PAGE,
+                     decode_steps_per_tick=16),
     )
     eng.start()
     try:

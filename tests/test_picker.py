@@ -1,0 +1,98 @@
+"""Endpoint picker tests: KV-occupancy scoring, staleness, affinity, and
+live polling of real tpuserve /state (the EPP role, SURVEY.md §3.4)."""
+
+from __future__ import annotations
+
+import asyncio
+
+import aiohttp
+import pytest
+
+from aigw_tpu.gateway.picker import (
+    AFFINITY_HEADER,
+    Endpoint,
+    EndpointPicker,
+)
+
+
+def make_picker():
+    return EndpointPicker(
+        [
+            Endpoint("10.0.0.1:8011", slice_name="s0"),
+            Endpoint("10.0.0.2:8011", slice_name="s0"),
+            Endpoint("10.0.0.3:8011", slice_name="s1"),
+        ]
+    )
+
+
+class TestScoring:
+    def test_picks_least_loaded(self):
+        p = make_picker()
+        p.observe("10.0.0.1:8011", kv_occupancy=0.9, max_slots=8)
+        p.observe("10.0.0.2:8011", kv_occupancy=0.1, max_slots=8)
+        p.observe("10.0.0.3:8011", kv_occupancy=0.5, max_slots=8)
+        assert p.pick() == "10.0.0.2:8011"
+
+    def test_queue_depth_penalized(self):
+        p = make_picker()
+        p.observe("10.0.0.1:8011", kv_occupancy=0.2, queued=8, max_slots=8)
+        p.observe("10.0.0.2:8011", kv_occupancy=0.4, queued=0, max_slots=8)
+        p.observe("10.0.0.3:8011", kv_occupancy=0.9, max_slots=8)
+        assert p.pick() == "10.0.0.2:8011"
+
+    def test_unhealthy_skipped(self):
+        p = make_picker()
+        p.observe("10.0.0.1:8011", kv_occupancy=0.0)
+        p.state["10.0.0.1:8011"].healthy = False
+        p.observe("10.0.0.2:8011", kv_occupancy=0.8)
+        assert p.pick() == "10.0.0.2:8011"
+
+    def test_no_telemetry_round_robin(self):
+        p = make_picker()
+        picks = {p.pick() for _ in range(3)}
+        assert picks == {e.address for e in p.endpoints}
+
+    def test_slice_affinity(self):
+        """A session that landed on slice s1 prefers s1 replicas while
+        load is comparable (ICI/KV-cache locality)."""
+        p = make_picker()
+        headers = {AFFINITY_HEADER: "conv-42"}
+        p.observe("10.0.0.1:8011", kv_occupancy=0.30, max_slots=8)
+        p.observe("10.0.0.2:8011", kv_occupancy=0.45, max_slots=8)
+        p.observe("10.0.0.3:8011", kv_occupancy=0.35, max_slots=8)
+        first = p.pick(headers)
+        assert first == "10.0.0.1:8011"
+        # s0 nodes get slightly busier; affinity (0.25 penalty for leaving
+        # the slice) keeps the session on s0 anyway
+        p.observe("10.0.0.1:8011", kv_occupancy=0.50, max_slots=8)
+        p.observe("10.0.0.2:8011", kv_occupancy=0.55, max_slots=8)
+        p.observe("10.0.0.3:8011", kv_occupancy=0.35, max_slots=8)
+        assert p.pick(headers) == "10.0.0.1:8011"
+        # without affinity the cheaper s1 node wins
+        assert p.pick() == "10.0.0.3:8011"
+
+
+class TestLivePolling:
+    def test_polls_real_tpuserve_state(self, tpuserve_url):
+        from tests.test_tpuserve import tpuserve_url as _  # fixture dep
+
+        async def main():
+            addr = tpuserve_url.replace("http://", "")
+            p = EndpointPicker([Endpoint(addr)], poll_interval=0.1)
+            await p.start()
+            try:
+                for _ in range(50):
+                    await asyncio.sleep(0.1)
+                    if p.state[addr].healthy:
+                        break
+                assert p.state[addr].healthy
+                assert p.state[addr].max_slots == 2
+                assert p.pick() == addr
+            finally:
+                await p.stop()
+
+        asyncio.run(main())
+
+
+# reuse the module-scoped tpuserve fixture
+from tests.test_tpuserve import tpuserve_url  # noqa: E402,F401
