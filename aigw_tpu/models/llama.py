@@ -166,6 +166,7 @@ def prefill(
     kv_cache: jax.Array,  # [L, 2, P*page, Hkv, D]
     page_table: jax.Array,  # [B, max_pages] int32 page ids
     page_size: int,
+    mlp=None,  # pluggable feed-forward (MoE families override; see mixtral)
 ) -> tuple[jax.Array, jax.Array]:
     """Process prompts; returns (last-position logits [B, V], updated cache).
 
@@ -197,7 +198,7 @@ def prefill(
         attn = _attention(q, k, v, mask)
         x = x + attn @ p[f"l{i}.wo"]
         h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
-        x = x + _mlp(p, i, h)
+        x = x + (mlp or _mlp)(p, i, h)
     x = rms_norm(x, p["norm_f"], cfg.norm_eps)
     last = jnp.take_along_axis(
         x, (seq_lens - 1)[:, None, None].astype(jnp.int32), axis=1
@@ -214,6 +215,7 @@ def decode_step(
     page_table: jax.Array,  # [B, max_pages]
     page_size: int,
     active: jax.Array,  # [B] bool slot occupied
+    mlp=None,  # pluggable feed-forward (MoE families override)
 ) -> tuple[jax.Array, jax.Array]:
     """One continuous-batching decode step; returns (logits [B, V], cache).
 
@@ -252,7 +254,7 @@ def decode_step(
         attn = _attention(q, k_all, v_all, attend[:, None, :])
         x = x + attn @ p[f"l{i}.wo"]
         h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
-        x = x + _mlp(p, i, h)
+        x = x + (mlp or _mlp)(p, i, h)
     x = rms_norm(x, p["norm_f"], cfg.norm_eps)
     return _logits(p, cfg, x[:, 0]), kv_cache
 
@@ -262,6 +264,7 @@ def hidden_states(
     cfg: LlamaConfig,
     tokens: jax.Array,  # [B, S]
     seq_lens: jax.Array,  # [B]
+    mlp=None,  # pluggable feed-forward (MoE families override)
 ) -> jax.Array:
     """Mean-pooled final hidden states (the /v1/embeddings path)."""
     B, S = tokens.shape
@@ -275,7 +278,7 @@ def hidden_states(
         q, k, v = _project_qkv(p, i, h, positions, cfg)
         x = x + _attention(q, k, v, mask) @ p[f"l{i}.wo"]
         h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
-        x = x + _mlp(p, i, h)
+        x = x + (mlp or _mlp)(p, i, h)
     x = rms_norm(x, p["norm_f"], cfg.norm_eps)
     w = valid[..., None].astype(jnp.float32)
     pooled = (x.astype(jnp.float32) * w).sum(1) / jnp.maximum(w.sum(1), 1.0)

@@ -23,6 +23,29 @@ class ModelSpec:
     tokenizer: str = "byte"  # "byte" | path to tokenizer.json
 
 
+@dataclass(frozen=True)
+class ModelFns:
+    """The functional surface the serving engine drives — uniform across
+    model families (prefill/decode share the paged-KV contract)."""
+
+    init_params: Any
+    prefill: Any
+    decode_step: Any
+    hidden_states: Any
+
+
+def family_fns(family: str) -> ModelFns:
+    if family == "llama":
+        return ModelFns(llama.init_params, llama.prefill, llama.decode_step,
+                        llama.hidden_states)
+    if family == "mixtral":
+        from aigw_tpu.models import mixtral
+
+        return ModelFns(mixtral.init_params, mixtral.prefill,
+                        mixtral.decode_step, mixtral.hidden_states)
+    raise KeyError(f"unknown model family {family!r}")
+
+
 _REGISTRY: dict[str, ModelSpec] = {}
 
 
@@ -39,6 +62,18 @@ def get_model_spec(name: str) -> ModelSpec:
 
 
 register_model(ModelSpec("tiny-random", "llama", llama.TINY))
+
+
+def _register_mixtral() -> None:
+    from aigw_tpu.models import mixtral
+
+    register_model(ModelSpec("tiny-moe", "mixtral", mixtral.TINY_MOE))
+    register_model(ModelSpec("mixtral-8x7b", "mixtral",
+                             mixtral.MIXTRAL_8X7B,
+                             weights="orbax:checkpoints/mixtral-8x7b"))
+
+
+_register_mixtral()
 register_model(ModelSpec("llama-3-8b", "llama", llama.LLAMA3_8B,
                          weights="orbax:checkpoints/llama-3-8b"))
 register_model(ModelSpec("llama-3-70b", "llama", llama.LLAMA3_70B,

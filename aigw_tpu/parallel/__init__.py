@@ -12,6 +12,7 @@ from aigw_tpu.parallel.mesh import MeshSpec, make_mesh
 from aigw_tpu.parallel.sharding import (
     kv_cache_spec,
     llama_param_specs,
+    mixtral_param_specs,
     shard_params,
 )
 
@@ -19,6 +20,7 @@ __all__ = [
     "MeshSpec",
     "kv_cache_spec",
     "llama_param_specs",
+    "mixtral_param_specs",
     "make_mesh",
     "shard_params",
 ]

@@ -54,3 +54,30 @@ def shard_params(
         k: jax.device_put(v, NamedSharding(mesh, specs[k]))
         for k, v in params.items()
     }
+
+
+def mixtral_param_specs(cfg) -> dict[str, P]:
+    """Expert-parallel + tensor-parallel specs for the Mixtral family.
+
+    Expert weights [E, D, F] shard experts over ``ep`` and the FFN width
+    over ``tp``; GSPMD turns the dispatch/combine einsums in
+    models/mixtral.py into all-to-alls over ``ep`` (SURVEY.md §2.9:
+    "mesh axis for experts + all-to-all dispatch").
+    """
+    specs: dict[str, P] = {
+        "embed": P("tp", None),
+        "norm_f": P(None),
+        "lm_head": P(None, "tp"),
+    }
+    for i in range(cfg.n_layers):
+        specs[f"l{i}.attn_norm"] = P(None)
+        specs[f"l{i}.wq"] = P(None, "tp")
+        specs[f"l{i}.wk"] = P(None, "tp")
+        specs[f"l{i}.wv"] = P(None, "tp")
+        specs[f"l{i}.wo"] = P("tp", None)
+        specs[f"l{i}.mlp_norm"] = P(None)
+        specs[f"l{i}.gate"] = P(None, None)  # router: tiny, replicated
+        specs[f"l{i}.w_gate"] = P("ep", None, "tp")
+        specs[f"l{i}.w_up"] = P("ep", None, "tp")
+        specs[f"l{i}.w_down"] = P("ep", "tp", None)
+    return specs

@@ -119,11 +119,15 @@ class Engine:
     def __init__(
         self,
         params: dict[str, jax.Array],
-        model_cfg: llama.LlamaConfig,
+        model_cfg: Any,  # LlamaConfig / MixtralConfig (shared attributes)
         cfg: EngineConfig,
         eos_token_ids: tuple[int, ...] = (),
         mesh: Any = None,
+        fns: Any = None,  # models.registry.ModelFns; default = llama
     ):
+        from aigw_tpu.models.registry import family_fns
+
+        self.fns = fns or family_fns("llama")
         self.params = params
         self.model_cfg = model_cfg
         self.cfg = cfg
@@ -167,9 +171,12 @@ class Engine:
         mc, ps = model_cfg, cfg.page_size
         K = cfg.decode_steps_per_tick
 
+        model_prefill = self.fns.prefill
+        model_decode = self.fns.decode_step
+
         def _prefill_step(params, tokens, seq_lens, kv, page_table, keys,
                           temp, top_p, top_k):
-            logits, kv = llama.prefill(params, mc, tokens, seq_lens, kv,
+            logits, kv = model_prefill(params, mc, tokens, seq_lens, kv,
                                        page_table, ps)
             return sample(logits, keys, temp, top_p, top_k), kv
 
@@ -180,7 +187,7 @@ class Engine:
             def body(carry, _):
                 kv, st = carry
                 act = st["active"] & (st["positions"] < st["limits"])
-                logits, kv = llama.decode_step(
+                logits, kv = model_decode(
                     params, mc, st["tokens"], st["positions"], kv,
                     st["page_table"], ps, act,
                 )

@@ -24,7 +24,7 @@ from aiohttp import web
 
 from aigw_tpu.gateway.costs import TokenUsage
 from aigw_tpu.models import llama
-from aigw_tpu.models.registry import get_model_spec
+from aigw_tpu.models.registry import family_fns, get_model_spec
 from aigw_tpu.obs.metrics import GenAIMetrics, RequestMetrics
 from aigw_tpu.schemas import openai as oai
 from aigw_tpu.translate.sse import SSEEvent
@@ -60,8 +60,7 @@ class TPUServeServer:
     ):
         self.model_name = model
         spec = get_model_spec(model)
-        if spec.family != "llama":
-            raise ValueError(f"unsupported family {spec.family}")
+        self.fns = family_fns(spec.family)
         self.model_cfg = spec.config
         self.tokenizer = load_tokenizer(spec.tokenizer)
         self.metrics = metrics or GenAIMetrics()
@@ -72,10 +71,12 @@ class TPUServeServer:
             self.model_cfg,
             engine_cfg,
             eos_token_ids=(self.tokenizer.eos_id,),
+            fns=self.fns,
         )
         # jitted embeddings path (bucketed like prefill)
+        hidden = self.fns.hidden_states
         self._hidden_fn = jax.jit(
-            lambda p, t, l: llama.hidden_states(p, self.model_cfg, t, l)
+            lambda p, t, l: hidden(p, self.model_cfg, t, l)
         )
 
         self.app = web.Application()
@@ -93,7 +94,7 @@ class TPUServeServer:
     def _load_params(self, spec) -> dict[str, jax.Array]:
         if spec.weights == "random":
             logger.info("initializing random weights for %s", spec.name)
-            return llama.init_params(jax.random.PRNGKey(0), self.model_cfg)
+            return self.fns.init_params(jax.random.PRNGKey(0), self.model_cfg)
         if spec.weights.startswith("orbax:"):
             import orbax.checkpoint as ocp
 
@@ -101,7 +102,8 @@ class TPUServeServer:
             logger.info("restoring orbax checkpoint %s", path)
             ckptr = ocp.StandardCheckpointer()
             shapes = jax.eval_shape(
-                lambda: llama.init_params(jax.random.PRNGKey(0), self.model_cfg)
+                lambda: self.fns.init_params(jax.random.PRNGKey(0),
+                                             self.model_cfg)
             )
             return ckptr.restore(path, shapes)
         raise ValueError(f"unsupported weight source {spec.weights}")

@@ -1,0 +1,149 @@
+"""Mixtral MoE correctness + expert-parallel sharding tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from aigw_tpu.models import llama, mixtral
+from aigw_tpu.parallel import (
+    MeshSpec,
+    kv_cache_spec,
+    make_mesh,
+    mixtral_param_specs,
+)
+
+CFG = mixtral.TINY_MOE
+PAGE = 16
+
+
+@pytest.fixture(scope="module")
+def params():
+    return mixtral.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def fresh_cache(n_pages=64):
+    return jnp.zeros(
+        (CFG.n_layers, 2, n_pages * PAGE, CFG.n_kv_heads, CFG.head_dim),
+        jnp.bfloat16,
+    )
+
+
+def test_single_expert_equals_dense():
+    """With 1 expert and k=1 the MoE must reduce to a plain dense MLP —
+    the routing/dispatch machinery proves itself against the closed form."""
+    cfg = mixtral.MixtralConfig(
+        vocab_size=64, dim=32, n_layers=1, n_heads=2, n_kv_heads=2,
+        ffn_dim=64, n_experts=1, experts_per_token=1, capacity_factor=8.0,
+        max_seq_len=64, rope_theta=10000.0,
+    )
+    p = mixtral.init_params(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 5, cfg.dim),
+                          jnp.bfloat16)
+    got = mixtral.moe_mlp(p, 0, x, cfg)
+    gate = jax.nn.silu(x @ p["l0.w_gate"][0])
+    want = (gate * (x @ p["l0.w_up"][0])) @ p["l0.w_down"][0]
+    np.testing.assert_allclose(
+        np.asarray(got, jnp.float32), np.asarray(want, jnp.float32),
+        rtol=5e-2, atol=5e-2,
+    )
+
+
+def test_topk_weights_normalized(params):
+    """Combine weights per token must sum to 1 across chosen experts when
+    no tokens overflow capacity."""
+    cfg = CFG
+    x = jax.random.normal(jax.random.PRNGKey(2), (1, 6, cfg.dim),
+                          jnp.bfloat16)
+    # direct check through the routing math
+    xt = x.reshape(-1, cfg.dim)
+    logits = xt.astype(jnp.float32) @ params["l0.gate"].astype(jnp.float32)
+    topv, _ = jax.lax.top_k(logits, cfg.experts_per_token)
+    w = jax.nn.softmax(topv, axis=-1)
+    np.testing.assert_allclose(np.asarray(w.sum(-1)), 1.0, rtol=1e-5)
+
+
+def test_prefill_decode_consistency(params):
+    """The MoE path preserves the paged-KV decode invariant."""
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 20), 0,
+                                CFG.vocab_size)
+    pt = jnp.arange(4, dtype=jnp.int32)[None, :]
+    full, _ = mixtral.prefill(
+        params, CFG, tokens, jnp.array([20]), fresh_cache(), pt, PAGE
+    )
+    logits, cache = mixtral.prefill(
+        params, CFG, tokens[:, :12], jnp.array([12]), fresh_cache(), pt, PAGE
+    )
+    for pos in range(12, 20):
+        logits, cache = mixtral.decode_step(
+            params, CFG, tokens[:, pos], jnp.array([pos], jnp.int32),
+            cache, pt, PAGE, jnp.array([True]),
+        )
+    np.testing.assert_allclose(
+        np.asarray(full), np.asarray(logits), rtol=5e-2, atol=5e-2
+    )
+
+
+def test_expert_parallel_matches_single_device(params):
+    """EP×TP sharded prefill == unsharded (all-to-alls preserve math)."""
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 16), 0,
+                                CFG.vocab_size)
+    lens = jnp.array([16, 9])
+    pt = jnp.arange(8, dtype=jnp.int32).reshape(2, 4)
+
+    def run(p, kv):
+        return mixtral.prefill(p, CFG, tokens, lens, kv, pt, PAGE)
+
+    kv0 = fresh_cache(16)
+    ref_logits, _ = jax.jit(run)(params, kv0)
+
+    mesh = make_mesh(MeshSpec(dp=1, tp=2, ep=4))
+    specs = mixtral_param_specs(CFG)
+    sharded = {
+        k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+        for k, v in params.items()
+    }
+    kv_sh = jax.device_put(kv0, NamedSharding(mesh, kv_cache_spec()))
+    ep_logits, _ = jax.jit(run)(sharded, kv_sh)
+    np.testing.assert_allclose(
+        np.asarray(ref_logits), np.asarray(ep_logits), atol=7e-2
+    )
+    assert (np.asarray(ref_logits).argmax(-1)
+            == np.asarray(ep_logits).argmax(-1)).all()
+
+
+def test_engine_serves_tiny_moe():
+    """The continuous-batching engine drives the MoE family end to end."""
+    import threading
+
+    from aigw_tpu.models.registry import family_fns
+    from aigw_tpu.tpuserve.engine import Engine, EngineConfig, GenRequest
+    from aigw_tpu.tpuserve.sampling import SamplingParams
+
+    params = mixtral.init_params(jax.random.PRNGKey(0), CFG)
+    eng = Engine(
+        params, CFG,
+        EngineConfig(max_batch_size=2, max_seq_len=128, page_size=16,
+                     min_prefill_bucket=16, decode_steps_per_tick=4),
+        eos_token_ids=(257,),
+        fns=family_fns("mixtral"),
+    )
+    eng.start()
+    try:
+        done = threading.Event()
+        toks: list[int] = []
+
+        def emit(tok, fin):
+            if tok >= 0:
+                toks.append(tok)
+            if fin is not None:
+                done.set()
+
+        eng.submit(GenRequest(prompt=[3, 5, 7], max_tokens=4,
+                              sampling=SamplingParams(temperature=0.0),
+                              emit=emit))
+        assert done.wait(timeout=240)
+        assert 1 <= len(toks) <= 4
+    finally:
+        eng.stop()
