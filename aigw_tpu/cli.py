@@ -21,7 +21,10 @@ def main(argv: list[str] | None = None) -> int:
     sub = parser.add_subparsers(dest="cmd", required=True)
 
     p_run = sub.add_parser("run", help="run the gateway data plane")
-    p_run.add_argument("config", help="config YAML file or bundle directory")
+    p_run.add_argument("config", nargs="?", default="",
+                       help="config YAML/bundle dir (omit to autoconfig "
+                            "from env: OPENAI_API_KEY, ANTHROPIC_API_KEY, "
+                            "AZURE_OPENAI_*, TPUSERVE_URL)")
     p_run.add_argument("--host", default="127.0.0.1")
     p_run.add_argument("--port", type=int, default=1975)
     p_run.add_argument("--watch-interval", type=float, default=5.0)
@@ -63,13 +66,20 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     if args.cmd == "run":
-        return asyncio.run(_run_gateway(args))
+        from aigw_tpu.config.model import ConfigError
+
+        try:
+            return asyncio.run(_run_gateway(args))
+        except ConfigError as e:
+            print(f"config error: {e}", file=sys.stderr)
+            return 1
     if args.cmd == "tpuserve":
         return asyncio.run(_run_tpuserve(args))
     return 2
 
 
 async def _run_gateway(args: argparse.Namespace) -> int:
+    from aigw_tpu.config.runtime import RuntimeConfig
     from aigw_tpu.config.watcher import ConfigWatcher
     from aigw_tpu.gateway.server import run_gateway
 
@@ -80,14 +90,26 @@ async def _run_gateway(args: argparse.Namespace) -> int:
         if server is not None:
             server.set_runtime(rc)
 
-    watcher = ConfigWatcher(args.config, on_reload, interval=args.watch_interval)
-    runtime = watcher.load_initial()
+    watcher = None
+    if args.config:
+        watcher = ConfigWatcher(args.config, on_reload,
+                                interval=args.watch_interval)
+        runtime = watcher.load_initial()
+    else:
+        from aigw_tpu.config.autoconfig import autoconfig_from_env
+
+        cfg = autoconfig_from_env()
+        print(f"autoconfig: {len(cfg.backends)} backend(s): "
+              f"{', '.join(b.name for b in cfg.backends)}", flush=True)
+        runtime = RuntimeConfig.build(cfg)
     server, runner = await run_gateway(runtime, host=args.host, port=args.port)
     holder["server"] = server
-    await watcher.start()
+    if watcher is not None:
+        await watcher.start()
     print(f"gateway listening on http://{args.host}:{args.port}", flush=True)
     await _wait_for_signal()
-    await watcher.stop()
+    if watcher is not None:
+        await watcher.stop()
     await runner.cleanup()
     return 0
 

@@ -409,12 +409,17 @@ class RouteRule:
     headers: tuple[HeaderMatch, ...] = ()
     # Convenience sugar: `models: [m1, m2]` expands to model-header matches.
     models: tuple[str, ...] = ()
+    # Prefix matches (e.g. "claude-" routes every Claude model).
+    model_prefixes: tuple[str, ...] = ()
     name: str = ""
 
     def matches(self, headers: dict[str, str]) -> bool:
         model = headers.get(MODEL_NAME_HEADER, "")
-        if self.models and model not in self.models:
-            return False
+        if self.models or self.model_prefixes:
+            exact = model in self.models
+            prefix = any(model.startswith(p) for p in self.model_prefixes)
+            if not exact and not prefix:
+                return False
         for m in self.headers:
             if headers.get(m.name) != m.value:
                 return False
@@ -429,6 +434,7 @@ class RouteRule:
             backends=backends,
             headers=tuple(HeaderMatch.parse(h) for h in value.get("headers", ())),
             models=tuple(value.get("models", ())),
+            model_prefixes=tuple(value.get("model_prefixes", ())),
             name=value.get("name", ""),
         )
 
@@ -438,6 +444,8 @@ class RouteRule:
             d["headers"] = [h.to_dict() for h in self.headers]
         if self.models:
             d["models"] = list(self.models)
+        if self.model_prefixes:
+            d["model_prefixes"] = list(self.model_prefixes)
         if self.name:
             d["name"] = self.name
         return d

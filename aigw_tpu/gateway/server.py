@@ -45,6 +45,7 @@ from aigw_tpu.gateway.mutators import apply_body_mutation, apply_header_mutation
 from aigw_tpu.gateway.picker import Endpoint as PickerEndpoint, EndpointPicker
 from aigw_tpu.gateway.router import BackendSelector, NoRouteError, match_route
 from aigw_tpu.obs.metrics import GenAIMetrics, RequestMetrics
+from aigw_tpu.obs.tracing import SpanContext, Tracer, genai_attributes
 from aigw_tpu.schemas import anthropic as anth
 from aigw_tpu.schemas import openai as oai
 from aigw_tpu.translate import Endpoint, TranslationError, get_translator
@@ -67,6 +68,8 @@ _ENDPOINTS: dict[str, tuple[Endpoint, APISchemaName, str]] = {
         Endpoint.RESPONSES, APISchemaName.OPENAI, "responses"),
     Endpoint.IMAGES_GENERATIONS.value: (
         Endpoint.IMAGES_GENERATIONS, APISchemaName.OPENAI, "image_generation"),
+    Endpoint.RERANK.value: (
+        Endpoint.RERANK, APISchemaName.COHERE, "rerank"),
 }
 
 #: upstream statuses that trigger failover to the next backend
@@ -84,9 +87,11 @@ class GatewayServer:
         *,
         metrics: GenAIMetrics | None = None,
         cost_sink: CostSink | None = None,
+        tracer: Tracer | None = None,
     ):
         self._runtime = runtime
         self.metrics = metrics or GenAIMetrics()
+        self.tracer = tracer or Tracer()
         self._cost_sink = cost_sink
         self._session: aiohttp.ClientSession | None = None
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
@@ -241,10 +246,45 @@ class GatewayServer:
         selector = BackendSelector(rule=match.rule)
         route_name = match.route.name
 
+        # tracing: continue the caller's trace, span per gateway request
+        # (reference: router processor starts the span and injects headers,
+        # processor_impl.go:289-295)
+        span = None
+        if self.tracer.enabled:
+            parent = SpanContext.parse(client_headers.get("traceparent", ""))
+            span = self.tracer.start_span(f"{operation} {model}", parent)
+
         # ---- phase 2: upstream attempts --------------------------------
+        try:
+            return await self._attempt_loop(
+                request, endpoint, front_schema, selector, rc, body,
+                req_metrics, route_name, error_body, client_headers, span,
+            )
+        finally:
+            if span is not None:
+                span.attributes.update(
+                    genai_attributes(
+                        operation=operation,
+                        request_model=model,
+                        response_model=req_metrics.response_model,
+                        backend=req_metrics.provider,
+                        input_tokens=req_metrics.final_usage.input_tokens,
+                        output_tokens=req_metrics.final_usage.output_tokens,
+                        streaming=req_metrics.tokens_seen > 0,
+                    )
+                )
+                if req_metrics.error_type:
+                    span.record_error(req_metrics.error_type)
+                span.end()
+
+    async def _attempt_loop(
+        self, request, endpoint, front_schema, selector, rc, body,
+        req_metrics, route_name, error_body, client_headers, span,
+    ) -> web.StreamResponse:
         last_error: tuple[int, bytes] = (
             502,
-            error_body("all upstream backends failed", type_="upstream_error"),
+            error_body("all upstream backends failed",
+                       type_="upstream_error"),
         )
         attempt = 0
         while True:
@@ -260,6 +300,7 @@ class GatewayServer:
                 result = await self._attempt(
                     request, endpoint, front_schema, rb, body,
                     req_metrics, route_name, error_body, client_headers,
+                    span,
                 )
             except _RetriableUpstreamError as e:
                 logger.warning(
@@ -298,6 +339,7 @@ class GatewayServer:
         route_name: str,
         error_body: Callable[..., bytes],
         client_headers: dict[str, str],
+        span=None,
     ) -> web.StreamResponse:
         backend = rb.backend
         if rc_limited := self._check_quota(client_headers, rb, req_metrics,
@@ -331,6 +373,8 @@ class GatewayServer:
                 502, error_body(f"backend {backend.name} has no url"),
                 "missing url")
         headers.update(tx.headers)
+        if span is not None:
+            headers["traceparent"] = span.context.traceparent()
         headers = apply_header_mutation(headers, backend.header_mutation)
         import urllib.parse as _up
 

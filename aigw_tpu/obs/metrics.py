@@ -93,6 +93,8 @@ class RequestMetrics:
     first_token_at: float = 0.0
     last_token_at: float = 0.0
     tokens_seen: int = 0
+    final_usage: TokenUsage = field(default_factory=TokenUsage)
+    error_type: str = ""
 
     def _labels(self) -> list[str]:
         return [
@@ -120,6 +122,8 @@ class RequestMetrics:
         self.tokens_seen += n
 
     def finish(self, usage: TokenUsage, error_type: str = "") -> None:
+        self.final_usage = usage
+        self.error_type = error_type
         labels = self._labels()
         for token_type, n in (
             ("input", usage.input_tokens),

@@ -153,6 +153,7 @@ def _install_all() -> None:
         openai_gcp,
         embeddings,
         tokenize,
+        rerank,
     )
 
 

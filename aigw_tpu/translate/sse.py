@@ -11,6 +11,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from aigw_tpu.utils import native as _native
+
 
 @dataclass
 class SSEEvent:
@@ -41,7 +43,22 @@ class SSEParser:
     def feed(self, chunk: bytes) -> list[SSEEvent]:
         self._buf += chunk
         events: list[SSEEvent] = []
-        # Normalize CRLF once so the split below only deals with \n\n.
+        # Fast path: the C++ scanner finds all boundaries in one pass
+        # (native/sse_scan.cpp; byte-exact with the loop below).
+        scan = _native.sse_scan(self._buf)
+        if scan is not None:
+            while True:
+                boundaries, tail, truncated = scan
+                start = 0
+                for end, sep in boundaries:
+                    ev = _parse_event(self._buf[start:end])
+                    if ev is not None:
+                        events.append(ev)
+                    start = end + sep
+                self._buf = self._buf[tail:]
+                if not truncated:
+                    return events
+                scan = _native.sse_scan(self._buf)
         while True:
             # An event terminates at the first blank line.
             sep = -1

@@ -1,0 +1,178 @@
+"""Autoconfig (env → Config) and Cohere rerank endpoint tests."""
+
+from __future__ import annotations
+
+import asyncio
+
+import aiohttp
+import pytest
+
+from aigw_tpu.config.autoconfig import autoconfig_from_env
+from aigw_tpu.config.model import APISchemaName, ConfigError
+from aigw_tpu.config.runtime import RuntimeConfig
+from aigw_tpu.gateway.server import run_gateway
+from tests.fakes import FakeUpstream
+
+
+class TestAutoconfig:
+    def test_openai_only(self):
+        cfg = autoconfig_from_env({"OPENAI_API_KEY": "sk-x"})
+        assert [b.name for b in cfg.backends] == ["openai"]
+        assert cfg.backends[0].auth.api_key == "sk-x"
+        # catch-all rule routes any model
+        assert cfg.routes[0].rules[-1].matches({"x-aigw-model": "whatever"})
+
+    def test_multi_provider_priority(self):
+        cfg = autoconfig_from_env({
+            "TPUSERVE_URL": "http://127.0.0.1:8011",
+            "OPENAI_API_KEY": "sk-x",
+            "ANTHROPIC_API_KEY": "ak-y",
+        })
+        names = [b.name for b in cfg.backends]
+        assert names == ["tpuserve", "openai", "anthropic"]
+        # tpuserve is the default backend for the catch-all
+        assert cfg.routes[0].rules[-1].backends[0].backend == "tpuserve"
+
+    def test_azure(self):
+        cfg = autoconfig_from_env({
+            "AZURE_OPENAI_API_KEY": "zk",
+            "AZURE_OPENAI_ENDPOINT": "https://me.openai.azure.com",
+            "AZURE_OPENAI_API_VERSION": "2024-10-21",
+        })
+        b = cfg.backends[0]
+        assert b.schema.name is APISchemaName.AZURE_OPENAI
+        assert b.schema.version == "2024-10-21"
+
+    def test_models_env(self):
+        cfg = autoconfig_from_env({
+            "OPENAI_API_KEY": "sk-x",
+            "AIGW_MODELS": "gpt-4o, gpt-4o-mini",
+        })
+        assert [m.name for m in cfg.models] == ["gpt-4o", "gpt-4o-mini"]
+
+    def test_empty_env_rejected(self):
+        with pytest.raises(ConfigError, match="no credentials"):
+            autoconfig_from_env({})
+
+
+class TestRerank:
+    def test_rerank_through_gateway(self):
+        async def main():
+            up = FakeUpstream().on_json(
+                "/v2/rerank",
+                {
+                    "results": [
+                        {"index": 1, "relevance_score": 0.9},
+                        {"index": 0, "relevance_score": 0.2},
+                    ],
+                    "model": "rerank-v3.5",
+                    "meta": {"billed_units": {"input_tokens": 12,
+                                              "output_tokens": 0}},
+                },
+            )
+            await up.start()
+            from aigw_tpu.config.model import Config
+
+            cfg = Config.parse({
+                "version": "v1",
+                "backends": [{
+                    "name": "cohere", "schema": "Cohere", "url": up.url,
+                    "auth": {"kind": "APIKey", "api_key": "co-key"},
+                }],
+                "routes": [{"name": "r", "rules": [
+                    {"models": ["rerank-v3.5"], "backends": ["cohere"]}]}],
+            })
+            server, runner = await run_gateway(RuntimeConfig.build(cfg),
+                                               port=0)
+            site = list(runner.sites)[0]
+            port = site._server.sockets[0].getsockname()[1]
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        f"http://127.0.0.1:{port}/v2/rerank",
+                        json={
+                            "model": "rerank-v3.5",
+                            "query": "what is a tpu?",
+                            "documents": ["a bird", "a chip"],
+                        },
+                    ) as resp:
+                        assert resp.status == 200
+                        got = await resp.json()
+                assert got["results"][0]["index"] == 1
+                assert up.captured[0].headers["authorization"] == \
+                    "Bearer co-key"
+                # billed units reached the metrics pipeline
+                text = server.metrics.export().decode()
+                assert 'gen_ai_operation_name="rerank"' in text
+            finally:
+                await runner.cleanup()
+                await up.stop()
+
+        asyncio.run(main())
+
+    def test_rerank_validation(self):
+        async def main():
+            from aigw_tpu.config.model import Config
+
+            cfg = Config.parse({
+                "version": "v1",
+                "backends": [{"name": "c", "schema": "Cohere",
+                              "url": "http://x"}],
+                "routes": [{"name": "r", "rules": [
+                    {"backends": ["c"]}]}],
+            })
+            server, runner = await run_gateway(RuntimeConfig.build(cfg),
+                                               port=0)
+            site = list(runner.sites)[0]
+            port = site._server.sockets[0].getsockname()[1]
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        f"http://127.0.0.1:{port}/v2/rerank",
+                        json={"model": "m", "query": "q"},  # no documents
+                    ) as resp:
+                        assert resp.status == 400
+            finally:
+                await runner.cleanup()
+
+        asyncio.run(main())
+
+
+class TestAutoconfigRouting:
+    def test_every_provider_reachable(self):
+        """Multi-provider env: claude-* reaches anthropic, gpt-* reaches
+        openai, anything else falls back through the chain."""
+        from aigw_tpu.config.model import MODEL_NAME_HEADER
+
+        cfg = autoconfig_from_env({
+            "TPUSERVE_URL": "http://127.0.0.1:8011",
+            "OPENAI_API_KEY": "sk-x",
+            "ANTHROPIC_API_KEY": "ak-y",
+        })
+        rules = cfg.routes[0].rules
+
+        def route_of(model):
+            for r in rules:
+                if r.matches({MODEL_NAME_HEADER: model}):
+                    return [b.backend for b in r.backends]
+            return []
+
+        assert route_of("claude-sonnet-4-20250514") == ["anthropic"]
+        assert route_of("gpt-4o") == ["openai"]
+        # catch-all is a fallback chain over all backends, tpuserve first
+        assert route_of("llama-3-8b") == ["tpuserve", "openai", "anthropic"]
+        prios = [b.priority for b in rules[-1].backends]
+        assert prios == [0, 1, 2]
+
+
+class TestSamplingPropagation:
+    def test_unsampled_parent_not_exported(self, capsys):
+        from aigw_tpu.obs.tracing import SpanContext, Tracer
+
+        t = Tracer(exporter="console")
+        parent = SpanContext.parse("00-" + "a" * 32 + "-" + "b" * 16 + "-00")
+        span = t.start_span("x", parent)
+        assert not span.context.sampled
+        assert span.context.traceparent().endswith("-00")
+        span.end()
+        assert capsys.readouterr().err.strip() == ""  # nothing exported

@@ -1,0 +1,132 @@
+"""Tracing tests: traceparent propagation, span export, GenAI attributes
+(reference internal/tracing/tracing_test + openinference parity tests)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from aigw_tpu.config.model import Config
+from aigw_tpu.config.runtime import RuntimeConfig
+from aigw_tpu.gateway.server import run_gateway
+from aigw_tpu.obs.tracing import SpanContext, Tracer, genai_attributes
+from tests.fakes import FakeUpstream, openai_chat_response
+
+
+class TestSpanContext:
+    def test_parse_valid(self):
+        ctx = SpanContext.parse(
+            "00-0123456789abcdef0123456789abcdef-0123456789abcdef-01"
+        )
+        assert ctx is not None
+        assert ctx.trace_id == "0123456789abcdef0123456789abcdef"
+        assert ctx.sampled
+
+    def test_parse_invalid(self):
+        assert SpanContext.parse("garbage") is None
+        assert SpanContext.parse("00-" + "0" * 32 + "-" + "1" * 16 + "-01") is None
+
+    def test_roundtrip(self):
+        ctx = SpanContext(trace_id="ab" * 16, span_id="cd" * 8)
+        assert SpanContext.parse(ctx.traceparent()).trace_id == "ab" * 16
+
+
+class TestTracer:
+    def test_disabled_by_default(self, monkeypatch):
+        monkeypatch.delenv("OTEL_TRACES_EXPORTER", raising=False)
+        t = Tracer()
+        assert not t.enabled
+
+    def test_console_export(self, capsys):
+        t = Tracer(exporter="console")
+        span = t.start_span("chat gpt-4o")
+        span.set("gen_ai.request.model", "gpt-4o")
+        span.end()
+        err = capsys.readouterr().err
+        data = json.loads(err.strip().splitlines()[-1])
+        assert data["name"] == "chat gpt-4o"
+        assert data["attributes"]["gen_ai.request.model"] == "gpt-4o"
+        assert data["endTimeUnixNano"] >= data["startTimeUnixNano"]
+
+    def test_child_inherits_trace(self):
+        t = Tracer(exporter="console")
+        parent = SpanContext.parse(
+            "00-0123456789abcdef0123456789abcdef-aaaaaaaaaaaaaaaa-01"
+        )
+        span = t.start_span("child", parent)
+        assert span.context.trace_id == "0123456789abcdef0123456789abcdef"
+        assert span.parent_span_id == "aaaaaaaaaaaaaaaa"
+        assert span.context.span_id != "aaaaaaaaaaaaaaaa"
+
+    def test_otlp_payload_shape(self):
+        t = Tracer(exporter="console")
+        s = t.start_span("x")
+        s.set("gen_ai.usage.input_tokens", 7)
+        s.end_ns = s.start_ns + 1
+        payload = t._otlp_payload([s])
+        sp = payload["resourceSpans"][0]["scopeSpans"][0]["spans"][0]
+        assert sp["name"] == "x"
+        assert {"key": "gen_ai.usage.input_tokens",
+                "value": {"intValue": "7"}} in sp["attributes"]
+
+    def test_genai_attributes(self):
+        attrs = genai_attributes(
+            operation="chat", request_model="m", response_model="m-v2",
+            backend="tpu", input_tokens=3, output_tokens=4, streaming=True,
+        )
+        assert attrs["gen_ai.operation.name"] == "chat"
+        assert attrs["gen_ai.usage.output_tokens"] == 4
+        assert attrs["llm.is_streaming"] is True
+
+
+class TestGatewayTracing:
+    def test_span_per_request_and_propagation(self, capsys):
+        async def main():
+            up = FakeUpstream().on_json(
+                "/v1/chat/completions", openai_chat_response()
+            )
+            await up.start()
+            cfg = Config.parse({
+                "version": "v1",
+                "backends": [{"name": "a", "schema": "OpenAI",
+                              "url": up.url}],
+                "routes": [{"name": "r", "rules": [
+                    {"models": ["m1"], "backends": ["a"]}]}],
+            })
+            server, runner = await run_gateway(
+                RuntimeConfig.build(cfg), port=0,
+                tracer=Tracer(exporter="console"),
+            )
+            site = list(runner.sites)[0]
+            port = site._server.sockets[0].getsockname()[1]
+            try:
+                incoming = (
+                    "00-11111111111111111111111111111111-"
+                    "2222222222222222-01"
+                )
+                async with aiohttp.ClientSession() as s:
+                    await s.post(
+                        f"http://127.0.0.1:{port}/v1/chat/completions",
+                        json={"model": "m1", "messages": [
+                            {"role": "user", "content": "hi"}]},
+                        headers={"traceparent": incoming},
+                    )
+                # upstream received a traceparent in the same trace
+                sent = up.captured[0].headers["traceparent"]
+                assert sent.split("-")[1] == "1" * 32
+                assert sent.split("-")[2] != "2222222222222222"
+            finally:
+                await runner.cleanup()
+                await up.stop()
+
+        asyncio.run(main())
+        err = capsys.readouterr().err
+        span = json.loads(err.strip().splitlines()[-1])
+        assert span["traceId"] == "1" * 32
+        assert span["parentSpanId"] == "2222222222222222"
+        assert span["attributes"]["gen_ai.request.model"] == "m1"
+        assert span["attributes"]["gen_ai.usage.input_tokens"] == 5
+        assert span["attributes"]["gen_ai.provider.name"] == "a"
