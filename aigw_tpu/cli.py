@@ -33,6 +33,12 @@ def main(argv: list[str] | None = None) -> int:
     p_val = sub.add_parser("validate", help="validate a config file")
     p_val.add_argument("config")
 
+    p_conv = sub.add_parser(
+        "convert", help="import a local HF safetensors dir into an orbax "
+                        "checkpoint usable by tpuserve")
+    p_conv.add_argument("hf_dir")
+    p_conv.add_argument("out_dir")
+
     p_serve = sub.add_parser("tpuserve", help="run the TPU serving engine")
     p_serve.add_argument("--model", required=True,
                          help="model name or path (see aigw_tpu.models)")
@@ -43,6 +49,8 @@ def main(argv: list[str] | None = None) -> int:
     p_serve.add_argument("--page-size", type=int, default=128)
     p_serve.add_argument("--hbm-pages", type=int, default=0,
                          help="KV pages to allocate (0 = auto)")
+    p_serve.add_argument("--tp", type=int, default=1,
+                         help="tensor-parallel degree (devices on the mesh)")
     p_serve.add_argument("--log-level", default="info")
 
     args = parser.parse_args(argv)
@@ -63,6 +71,17 @@ def main(argv: list[str] | None = None) -> int:
             f"OK: {len(cfg.backends)} backends, {len(cfg.routes)} routes, "
             f"{len(cfg.models)} models, {len(cfg.llm_request_costs)} cost metrics"
         )
+        return 0
+
+    if args.cmd == "convert":
+        from aigw_tpu.models.checkpoint import (
+            import_hf_checkpoint,
+            save_checkpoint,
+        )
+
+        params = import_hf_checkpoint(args.hf_dir)
+        save_checkpoint(params, args.out_dir)
+        print(f"converted {len(params)} tensors -> {args.out_dir}")
         return 0
 
     if args.cmd == "run":
@@ -125,6 +144,7 @@ async def _run_tpuserve(args: argparse.Namespace) -> int:
         max_seq_len=args.max_seq_len,
         page_size=args.page_size,
         hbm_pages=args.hbm_pages,
+        tp=args.tp,
     )
     print(f"tpuserve listening on http://{args.host}:{args.port}", flush=True)
     await _wait_for_signal()

@@ -128,6 +128,7 @@ class Engine:
         from aigw_tpu.models.registry import family_fns
 
         self.fns = fns or family_fns("llama")
+        self.mesh = mesh
         self.params = params
         self.model_cfg = model_cfg
         self.cfg = cfg
@@ -145,17 +146,40 @@ class Engine:
         self._wake = threading.Event()
         self._thread: threading.Thread | None = None
 
-        # device state
-        self.kv_cache = jnp.zeros(
-            (
-                model_cfg.n_layers,
-                2,
-                cfg.num_pages * cfg.page_size,
-                model_cfg.n_kv_heads,
-                model_cfg.head_dim,
-            ),
-            jnp.bfloat16,
+        # device state. With a mesh, weights/cache are laid out with
+        # tensor/expert-parallel shardings and every jitted step runs SPMD
+        # (GSPMD inserts the collectives; SURVEY.md §2.9).
+        kv_shape = (
+            model_cfg.n_layers,
+            2,
+            cfg.num_pages * cfg.page_size,
+            model_cfg.n_kv_heads,
+            model_cfg.head_dim,
         )
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            from aigw_tpu.parallel.sharding import (
+                kv_cache_spec,
+                llama_param_specs,
+                mixtral_param_specs,
+            )
+
+            specs = (
+                mixtral_param_specs(model_cfg)
+                if hasattr(model_cfg, "n_experts")
+                else llama_param_specs(model_cfg)
+            )
+            self.params = {
+                k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+                for k, v in params.items()
+            }
+            self.kv_cache = jax.device_put(
+                jnp.zeros(kv_shape, jnp.bfloat16),
+                NamedSharding(mesh, kv_cache_spec()),
+            )
+        else:
+            self.kv_cache = jnp.zeros(kv_shape, jnp.bfloat16)
         # Per-slot decode state lives ON DEVICE between ticks (uploaded
         # only when membership/sampling changes) — the decode hot loop
         # transfers just the sampled [K, B] tokens per round-trip.

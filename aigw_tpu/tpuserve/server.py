@@ -57,6 +57,7 @@ class TPUServeServer:
         model: str,
         engine_cfg: EngineConfig,
         metrics: GenAIMetrics | None = None,
+        tp: int = 1,
     ):
         self.model_name = model
         spec = get_model_spec(model)
@@ -65,12 +66,20 @@ class TPUServeServer:
         self.tokenizer = load_tokenizer(spec.tokenizer)
         self.metrics = metrics or GenAIMetrics()
 
+        mesh = None
+        if tp > 1:
+            from aigw_tpu.parallel import MeshSpec, make_mesh
+
+            mesh = make_mesh(MeshSpec(dp=1, tp=tp))
+            logger.info("tensor-parallel serving: tp=%d over %s", tp,
+                        [str(d) for d in mesh.devices.flat])
         params = self._load_params(spec)
         self.engine = Engine(
             params,
             self.model_cfg,
             engine_cfg,
             eos_token_ids=(self.tokenizer.eos_id,),
+            mesh=mesh,
             fns=self.fns,
         )
         # jitted embeddings path (bucketed like prefill)
@@ -96,16 +105,15 @@ class TPUServeServer:
             logger.info("initializing random weights for %s", spec.name)
             return self.fns.init_params(jax.random.PRNGKey(0), self.model_cfg)
         if spec.weights.startswith("orbax:"):
-            import orbax.checkpoint as ocp
+            from aigw_tpu.models.checkpoint import restore_checkpoint
 
             path = spec.weights[len("orbax:") :]
             logger.info("restoring orbax checkpoint %s", path)
-            ckptr = ocp.StandardCheckpointer()
-            shapes = jax.eval_shape(
+            like = jax.eval_shape(
                 lambda: self.fns.init_params(jax.random.PRNGKey(0),
                                              self.model_cfg)
             )
-            return ckptr.restore(path, shapes)
+            return restore_checkpoint(path, like)
         raise ValueError(f"unsupported weight source {spec.weights}")
 
     async def _on_start(self, _app) -> None:
@@ -470,6 +478,7 @@ async def run_tpuserve(
     max_seq_len: int = 2048,
     page_size: int = 128,
     hbm_pages: int = 0,
+    tp: int = 1,
 ) -> web.AppRunner:
     server = TPUServeServer(
         model,
@@ -479,6 +488,7 @@ async def run_tpuserve(
             page_size=page_size,
             num_pages=hbm_pages,
         ),
+        tp=tp,
     )
     runner = web.AppRunner(server.app)
     await runner.setup()
