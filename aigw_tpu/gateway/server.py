@@ -70,12 +70,61 @@ _ENDPOINTS: dict[str, tuple[Endpoint, APISchemaName, str]] = {
         Endpoint.IMAGES_GENERATIONS, APISchemaName.OPENAI, "image_generation"),
     Endpoint.RERANK.value: (
         Endpoint.RERANK, APISchemaName.COHERE, "rerank"),
+    Endpoint.AUDIO_SPEECH.value: (
+        Endpoint.AUDIO_SPEECH, APISchemaName.OPENAI, "audio_speech"),
+    Endpoint.AUDIO_TRANSCRIPTIONS.value: (
+        Endpoint.AUDIO_TRANSCRIPTIONS, APISchemaName.OPENAI,
+        "audio_transcription"),
+    Endpoint.AUDIO_TRANSLATIONS.value: (
+        Endpoint.AUDIO_TRANSLATIONS, APISchemaName.OPENAI,
+        "audio_translation"),
 }
+
+#: endpoints whose request body is multipart/form-data, not JSON — these
+#: pass through untranslated (model extracted from the form part; the
+#: reference's ParseMultipartBody, endpointspec.go)
+_MULTIPART_ENDPOINTS = {
+    Endpoint.AUDIO_TRANSCRIPTIONS,
+    Endpoint.AUDIO_TRANSLATIONS,
+}
+
+
+def _multipart_model(raw: bytes, content_type: str) -> str:
+    """Extract the `model` form field from a multipart body without
+    touching the (possibly large) audio parts."""
+    import re as _re
+
+    m = _re.search(r'boundary="?([^";,]+)"?', content_type)
+    if not m:
+        return ""
+    boundary = b"--" + m.group(1).encode()
+    for part in raw.split(boundary):
+        header_end = part.find(b"\r\n\r\n")
+        if header_end < 0:
+            continue
+        headers = part[:header_end]
+        if b'name="model"' in headers:
+            return (
+                part[header_end + 4 :]
+                .rstrip(b"\r\n-")
+                .decode("utf-8", errors="replace")
+                .strip()
+            )
+    return ""
 
 #: upstream statuses that trigger failover to the next backend
 _RETRIABLE_STATUS = {429, 500, 502, 503, 504}
 
 CostSink = Callable[[dict[str, int], dict[str, str]], Any]
+
+
+class _RawBody:
+    """Non-JSON (multipart) request carried through phase 2 untranslated."""
+
+    def __init__(self, raw: bytes, content_type: str, model: str):
+        self.raw = raw
+        self.content_type = content_type
+        self.model = model
 
 
 class GatewayServer:
@@ -210,17 +259,27 @@ class GatewayServer:
             else oai.error_body
         )
         # ---- phase 1: route selection ----------------------------------
-        try:
-            body = oai.parse_json_body(raw)
-            model = oai.request_model(body)
-            if endpoint is Endpoint.CHAT_COMPLETIONS:
-                oai.validate_chat_request(body)
-            elif endpoint is Endpoint.MESSAGES:
-                anth.validate_messages_request(body)
-        except oai.SchemaError as e:
-            return web.Response(
-                status=400, body=error_body(str(e)),
-                content_type="application/json")
+        if endpoint in _MULTIPART_ENDPOINTS:
+            ctype = request.headers.get("content-type", "")
+            model = _multipart_model(raw, ctype)
+            if not model:
+                return web.Response(
+                    status=400,
+                    body=error_body("missing 'model' form field"),
+                    content_type="application/json")
+            body: Any = _RawBody(raw, ctype, model)
+        else:
+            try:
+                body = oai.parse_json_body(raw)
+                model = oai.request_model(body)
+                if endpoint is Endpoint.CHAT_COMPLETIONS:
+                    oai.validate_chat_request(body)
+                elif endpoint is Endpoint.MESSAGES:
+                    anth.validate_messages_request(body)
+            except oai.SchemaError as e:
+                return web.Response(
+                    status=400, body=error_body(str(e)),
+                    content_type="application/json")
         client_headers = {k.lower(): v for k, v in request.headers.items()}
         match_headers = {
             **client_headers,
@@ -345,21 +404,54 @@ class GatewayServer:
         if rc_limited := self._check_quota(client_headers, rb, req_metrics,
                                            error_body):
             return rc_limited
-        translator = get_translator(
-            endpoint,
-            front_schema,
-            backend.schema.name,
-            model_name_override=backend.model_name_override,
-            out_version=backend.schema.version,
-        )
-        # Retry safety: translate from a fresh copy of the captured body.
-        tx = translator.request(copy.deepcopy(body))
-        out_body = apply_body_mutation(tx.body, backend.body_mutation)
+        if isinstance(body, _RawBody):
+            # multipart passthrough: no translation, original bytes forward
+            from aigw_tpu.translate.base import RequestTx as _RequestTx
 
-        headers: dict[str, str] = {
-            "content-type": "application/json",
-            "accept": "text/event-stream" if tx.stream else "application/json",
-        }
+            translator = get_translator(
+                Endpoint.CHAT_COMPLETIONS,  # response side is passthrough
+                APISchemaName.OPENAI,
+                APISchemaName.OPENAI,
+            )
+            path = request.path
+            if backend.schema.name is APISchemaName.AZURE_OPENAI:
+                from aigw_tpu.translate.openai_azure import (
+                    DEFAULT_API_VERSION,
+                    _ENDPOINT_SUFFIX,
+                )
+                import urllib.parse as _up2
+
+                dep = _up2.quote(
+                    backend.model_name_override or body.model, safe="")
+                path = (
+                    f"/openai/deployments/{dep}/"
+                    f"{_ENDPOINT_SUFFIX[endpoint]}"
+                    f"?api-version="
+                    f"{backend.schema.version or DEFAULT_API_VERSION}"
+                )
+            tx = _RequestTx(body=body.raw, path=path)
+            out_body = tx.body
+            headers = {
+                "content-type": body.content_type,
+                "accept": "application/json",
+            }
+        else:
+            translator = get_translator(
+                endpoint,
+                front_schema,
+                backend.schema.name,
+                model_name_override=backend.model_name_override,
+                out_version=backend.schema.version,
+            )
+            # Retry safety: translate from a fresh copy of the captured body.
+            tx = translator.request(copy.deepcopy(body))
+            out_body = apply_body_mutation(tx.body, backend.body_mutation)
+
+            headers = {
+                "content-type": "application/json",
+                "accept": "text/event-stream" if tx.stream
+                else "application/json",
+            }
         # Endpoint-picker support: an externally pre-selected destination
         # (the reference's x-gateway-destination-endpoint + ORIGINAL_DST
         # contract, post_cluster_modify.go:67-80) wins; otherwise the
@@ -382,6 +474,15 @@ class GatewayServer:
         path = tx.path or request.path
         headers, path = rb.auth_handler.apply(headers, out_body, path)
 
+        if logger.isEnabledFor(logging.DEBUG):
+            from aigw_tpu.utils.redaction import redact_body, redact_headers
+
+            logger.debug(
+                "upstream attempt backend=%s path=%s headers=%s body=%s",
+                backend.name, path, redact_headers(headers),
+                redact_body(body) if not isinstance(body, _RawBody)
+                else f"[multipart {len(body.raw)} bytes]",
+            )
         session = await self._get_session()
         timeout = aiohttp.ClientTimeout(
             total=backend.request_timeout,
@@ -436,9 +537,11 @@ class GatewayServer:
             self.metrics.requests_total.labels(
                 route_name, backend.name, str(resp.status)
             ).inc()
+            upstream_ctype = resp.headers.get(
+                "content-type", "application/json")
             return web.Response(
                 status=resp.status, body=rx.body or raw,
-                content_type="application/json")
+                content_type=upstream_ctype.split(";")[0])
 
     async def _stream_response(
         self,

@@ -26,14 +26,15 @@ class MeshSpec:
     tp: int = 1
     sp: int = 1
     ep: int = 1
+    pp: int = 1
 
     @property
     def size(self) -> int:
-        return self.dp * self.tp * self.sp * self.ep
+        return self.dp * self.tp * self.sp * self.ep * self.pp
 
     @property
     def axis_names(self) -> tuple[str, ...]:
-        return ("dp", "tp", "sp", "ep")
+        return ("dp", "tp", "sp", "ep", "pp")
 
 
 def make_mesh(spec: MeshSpec, devices: list | None = None) -> Mesh:
@@ -43,6 +44,6 @@ def make_mesh(spec: MeshSpec, devices: list | None = None) -> Mesh:
             f"mesh {spec} needs {spec.size} devices, have {len(devices)}"
         )
     grid = np.asarray(devices[: spec.size]).reshape(
-        spec.dp, spec.tp, spec.sp, spec.ep
+        spec.dp, spec.tp, spec.sp, spec.ep, spec.pp
     )
     return Mesh(grid, spec.axis_names)

@@ -413,3 +413,101 @@ class TestAnthropicFront:
                 await stop_env(runner, ups)
 
         run(main())
+
+
+class TestAudioEndpoints:
+    def test_multipart_transcription_passthrough(self):
+        async def main():
+            from aiohttp import FormData
+
+            up = FakeUpstream().on_json(
+                "/v1/audio/transcriptions", {"text": "hello world"}
+            )
+            server, runner, url, ups = await start_env(
+                {"a": up},
+                lambda urls: make_config(
+                    [{"name": "a", "schema": "OpenAI", "url": urls["a"],
+                      "auth": {"kind": "APIKey", "api_key": "sk"}}],
+                    [{"name": "r", "rules": [
+                        {"models": ["whisper-1"], "backends": ["a"]}]}],
+                ),
+            )
+            try:
+                form = FormData()
+                form.add_field("model", "whisper-1")
+                form.add_field("file", b"RIFF....fake-audio",
+                               filename="a.wav",
+                               content_type="audio/wav")
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(url + "/v1/audio/transcriptions",
+                                      data=form) as resp:
+                        assert resp.status == 200
+                        got = await resp.json()
+                assert got["text"] == "hello world"
+                cap = up.captured[0]
+                # original multipart body forwarded byte-for-byte w/ creds
+                assert b"fake-audio" in cap.body
+                assert cap.headers["authorization"] == "Bearer sk"
+                assert "multipart/form-data" in cap.headers["content-type"]
+            finally:
+                await stop_env(runner, ups)
+
+        run(main())
+
+    def test_speech_binary_response(self):
+        async def main():
+            from aiohttp import web as _web
+
+            up = FakeUpstream()
+
+            async def speech(cap):
+                return _web.Response(body=b"\x00\x01binary-mp3",
+                                     content_type="audio/mpeg")
+
+            up.on("/v1/audio/speech", speech)
+            server, runner, url, ups = await start_env(
+                {"a": up},
+                lambda urls: make_config(
+                    [{"name": "a", "schema": "OpenAI", "url": urls["a"]}],
+                    [{"name": "r", "rules": [
+                        {"models": ["tts-1"], "backends": ["a"]}]}],
+                ),
+            )
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        url + "/v1/audio/speech",
+                        json={"model": "tts-1", "input": "hi",
+                              "voice": "alloy"},
+                    ) as resp:
+                        assert resp.status == 200
+                        assert resp.headers["content-type"] == "audio/mpeg"
+                        body = await resp.read()
+                assert body == b"\x00\x01binary-mp3"
+            finally:
+                await stop_env(runner, ups)
+
+        run(main())
+
+    def test_multipart_missing_model_400(self):
+        async def main():
+            server, runner, url, ups = await start_env(
+                {},
+                lambda urls: make_config(
+                    [{"name": "a", "schema": "OpenAI", "url": "http://x"}],
+                    [{"name": "r", "rules": [{"backends": ["a"]}]}],
+                ),
+            )
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        url + "/v1/audio/transcriptions",
+                        data=b"not-multipart",
+                        headers={"content-type":
+                                 "multipart/form-data; boundary=xyz"},
+                    ) as resp:
+                        assert resp.status == 400
+            finally:
+                await stop_env(runner, ups)
+
+        run(main())

@@ -26,7 +26,7 @@ PAGE = 16
 
 def test_mesh_axes():
     mesh = make_mesh(MeshSpec(dp=2, tp=4))
-    assert mesh.shape == {"dp": 2, "tp": 4, "sp": 1, "ep": 1}
+    assert mesh.shape == {"dp": 2, "tp": 4, "sp": 1, "ep": 1, "pp": 1}
 
 
 def test_mesh_too_big_rejected():

@@ -1,0 +1,83 @@
+"""Debug-log redaction tests (reference internal/redaction + server.go
+sensitive-header masking)."""
+
+import logging
+
+from aigw_tpu.utils.redaction import redact_body, redact_headers
+
+
+def test_headers_masked():
+    got = redact_headers({
+        "authorization": "Bearer sk-secret",
+        "x-api-key": "ak",
+        "content-type": "application/json",
+        "Cookie": "session=1",
+    })
+    assert got["authorization"] == "[REDACTED]"
+    assert got["x-api-key"] == "[REDACTED]"
+    assert got["Cookie"] == "[REDACTED]"
+    assert got["content-type"] == "application/json"
+
+
+def test_body_content_masked(monkeypatch):
+    monkeypatch.delenv("AIGW_LOG_SENSITIVE", raising=False)
+    got = redact_body({
+        "model": "gpt-4o",
+        "messages": [{"role": "user", "content": "my SSN is ..."}],
+        "temperature": 0.3,
+    })
+    assert got["model"] == "gpt-4o"
+    assert got["temperature"] == 0.3
+    assert got["messages"] == "[REDACTED 1 items]"
+
+
+def test_opt_in_keeps_content(monkeypatch):
+    monkeypatch.setenv("AIGW_LOG_SENSITIVE", "true")
+    body = {"messages": [{"role": "user", "content": "x"}]}
+    assert redact_body(body) == body
+
+
+def test_gateway_debug_log_redacts(caplog):
+    """End to end: a debug-logged attempt must not leak the API key."""
+    import asyncio
+
+    import aiohttp
+
+    from aigw_tpu.config.model import Config
+    from aigw_tpu.config.runtime import RuntimeConfig
+    from aigw_tpu.gateway.server import run_gateway
+    from tests.fakes import FakeUpstream, openai_chat_response
+
+    async def main():
+        up = FakeUpstream().on_json("/v1/chat/completions",
+                                    openai_chat_response())
+        await up.start()
+        cfg = Config.parse({
+            "version": "v1",
+            "backends": [{"name": "a", "schema": "OpenAI", "url": up.url,
+                          "auth": {"kind": "APIKey",
+                                   "api_key": "sk-SUPERSECRET"}}],
+            "routes": [{"name": "r", "rules": [
+                {"models": ["m1"], "backends": ["a"]}]}],
+        })
+        server, runner = await run_gateway(RuntimeConfig.build(cfg), port=0)
+        site = list(runner.sites)[0]
+        port = site._server.sockets[0].getsockname()[1]
+        try:
+            with caplog.at_level(logging.DEBUG, "aigw_tpu.gateway.server"):
+                async with aiohttp.ClientSession() as s:
+                    await s.post(
+                        f"http://127.0.0.1:{port}/v1/chat/completions",
+                        json={"model": "m1", "messages": [
+                            {"role": "user", "content": "topsecretpayload"}]},
+                    )
+        finally:
+            await runner.cleanup()
+            await up.stop()
+
+    asyncio.run(main())
+    logged = "\n".join(r.getMessage() for r in caplog.records)
+    assert "upstream attempt" in logged
+    assert "sk-SUPERSECRET" not in logged
+    assert "topsecretpayload" not in logged
+    assert "[REDACTED]" in logged
