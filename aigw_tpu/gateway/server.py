@@ -23,7 +23,6 @@ rate-limit filter, filterconfig.go:84-87).
 from __future__ import annotations
 
 import asyncio
-import copy
 import logging
 import time
 from typing import Any, Callable
@@ -443,8 +442,11 @@ class GatewayServer:
                 model_name_override=backend.model_name_override,
                 out_version=backend.schema.version,
             )
-            # Retry safety: translate from a fresh copy of the captured body.
-            tx = translator.request(copy.deepcopy(body))
+            # Retry safety: translators are contractually read-only over
+            # the captured body (they build fresh structures — the
+            # reference's sjson no-in-place rule, translator.go:140-153),
+            # so each attempt can re-translate without a deep copy.
+            tx = translator.request(body)
             out_body = apply_body_mutation(tx.body, backend.body_mutation)
 
             headers = {

