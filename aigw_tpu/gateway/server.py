@@ -148,6 +148,13 @@ class GatewayServer:
         self.app.router.add_get("/v1/models", self._handle_models)
         self.app.router.add_get("/health", self._handle_health)
         self.app.router.add_get("/metrics", self._handle_metrics)
+        # debug/admin surface (reference: pprof :6060 + admin server;
+        # internal/pprof/pprof.go:18-40) — enabled unless AIGW_DISABLE_DEBUG
+        import os as _os
+
+        if _os.environ.get("AIGW_DISABLE_DEBUG", "").lower() != "true":
+            self.app.router.add_get("/debug/config", self._handle_debug_config)
+            self.app.router.add_get("/debug/stacks", self._handle_debug_stacks)
         self._pickers: dict[str, EndpointPicker] = {}
         self._picker_tasks: set[asyncio.Task] = set()
         self._build_pickers(runtime)
@@ -239,13 +246,54 @@ class GatewayServer:
                             content_type="text/plain")
 
     async def _handle_models(self, request: web.Request) -> web.Response:
-        """/v1/models — list configured models (reference
-        models_processor.go:30-150, host-scoped)."""
-        cfg = self._runtime.config
+        """/v1/models — configured models, host-scoped like the
+        reference's ModelsByHost (models_processor.go:30-150): models whose
+        serving routes are restricted to other hostnames are hidden."""
+        rc = self._runtime
+        host = request.host.split(":")[0].lower()
+        visible_rules = [
+            rule for route in rc.routes_for_host(host) for rule in route.rules
+        ]
+
+        def visible(name: str) -> bool:
+            probe = {MODEL_NAME_HEADER: name}
+            return any(r.matches(probe) for r in visible_rules)
+
         body = oai.models_response(
-            (m.name, m.owned_by, m.created_at) for m in cfg.models
+            (m.name, m.owned_by, m.created_at)
+            for m in rc.config.models
+            if visible(m.name)
         )
         return web.json_response(body)
+
+    async def _handle_debug_config(self, _request: web.Request) -> web.Response:
+        """Redacted view of the live config (credentials masked)."""
+        import json as _json
+
+        from aigw_tpu.utils.redaction import SENSITIVE_HEADERS  # noqa: F401
+
+        cfg = self._runtime.config.to_dict()
+        for b in cfg.get("backends", ()):
+            if "auth" in b:
+                b["auth"] = {"kind": b["auth"].get("kind", "?"),
+                             "credentials": "[REDACTED]"}
+        if "mcp" in cfg and isinstance(cfg["mcp"], dict):
+            cfg["mcp"] = dict(cfg["mcp"])
+            cfg["mcp"].pop("session_seed", None)
+            cfg["mcp"].pop("session_fallback_seed", None)
+        return web.json_response(cfg)
+
+    async def _handle_debug_stacks(self, _request: web.Request) -> web.Response:
+        """Thread stack dump — the pprof-goroutine equivalent."""
+        import sys as _sys
+        import traceback as _tb
+
+        out = []
+        for tid, frame in _sys._current_frames().items():
+            out.append(f"--- thread {tid} ---")
+            out.extend(_tb.format_stack(frame))
+        return web.Response(text="\n".join(out),
+                            content_type="text/plain")
 
     # -- the data plane ---------------------------------------------------
     async def _handle(self, request: web.Request) -> web.StreamResponse:

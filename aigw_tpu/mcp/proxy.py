@@ -225,8 +225,8 @@ class MCPProxy:
                     await self._tools_list(msg_id, sessions)
                 )
             if method == "tools/call":
-                return web.json_response(
-                    await self._tools_call(payload, sessions)
+                return await self._tools_call_streaming(
+                    request, payload, sessions
                 )
             if method in ("prompts/list", "resources/list"):
                 return web.json_response(
@@ -344,6 +344,85 @@ class MCPProxy:
         )
         tools = [t for sub in lists for t in sub]
         return {"jsonrpc": "2.0", "id": msg_id, "result": {"tools": tools}}
+
+    async def _tools_call_streaming(
+        self,
+        request: web.Request,
+        payload: dict[str, Any],
+        sessions: dict[str, str],
+    ) -> web.StreamResponse:
+        """tools/call with streamable-HTTP support: if the backend answers
+        with an SSE stream (progress notifications before the result), the
+        events are relayed to the client with monotonically increasing
+        event ids (the resumption contract of spec 2025-06-18; reference
+        mcpproxy/sse.go)."""
+        msg_id = payload.get("id")
+        params = payload.get("params") or {}
+        full_name = params.get("name", "")
+        backend_name, sep, tool = full_name.partition(TOOL_SEP)
+        backend = next(
+            (b for b in self.cfg.backends if b.name == backend_name), None
+        )
+        if not sep or backend is None:
+            return web.json_response(
+                _rpc_error(msg_id, -32602, f"unknown tool {full_name!r}")
+            )
+        if not backend.allows(tool):
+            return web.json_response(
+                _rpc_error(msg_id, -32602,
+                           f"tool {full_name!r} is not allowed")
+            )
+        sid = sessions.get(backend.name, "")
+        routed = dict(payload, params=dict(params, name=tool))
+
+        headers = {
+            "content-type": "application/json",
+            "accept": "application/json, text/event-stream",
+            "mcp-protocol-version": PROTOCOL_VERSION,
+            **dict(backend.headers),
+        }
+        if sid:
+            headers[SESSION_HEADER] = sid
+        http = await self._http()
+        async with http.post(backend.url, json=routed,
+                             headers=headers) as resp:
+            ctype = resp.headers.get("content-type", "")
+            if resp.status >= 400:
+                raw = await resp.read()
+                return web.json_response(
+                    _rpc_error(msg_id, -32603,
+                               f"backend {backend.name} returned "
+                               f"{resp.status}: {raw[:200]!r}")
+                )
+            if "text/event-stream" not in ctype:
+                raw = await resp.read()
+                msg = json.loads(raw) if raw else None
+                return web.json_response(
+                    msg or _rpc_error(msg_id, -32603,
+                                      "no response from backend")
+                )
+            # relay the stream with our own event ids
+            from aigw_tpu.translate.sse import SSEParser
+
+            out = web.StreamResponse(
+                status=200,
+                headers={"content-type": "text/event-stream",
+                         "cache-control": "no-cache"},
+            )
+            await out.prepare(request)
+            parser = SSEParser()
+            event_id = 0
+            async for chunk in resp.content.iter_any():
+                for ev in parser.feed(chunk):
+                    event_id += 1
+                    ev.id = str(event_id)
+                    await out.write(ev.encode())
+            for ev in parser.flush():
+                event_id += 1
+                ev.id = str(event_id)
+                await out.write(ev.encode())
+            await out.write_eof()
+            return out
 
     async def _tools_call(
         self, payload: dict[str, Any], sessions: dict[str, str]

@@ -511,3 +511,71 @@ class TestAudioEndpoints:
                 await stop_env(runner, ups)
 
         run(main())
+
+
+class TestAdminAndModels:
+    def test_host_scoped_models(self):
+        async def main():
+            cfg = Config.parse({
+                "version": "v1",
+                "backends": [{"name": "a", "schema": "OpenAI",
+                              "url": "http://x"}],
+                "routes": [
+                    {"name": "pub", "rules": [
+                        {"models": ["public-model"], "backends": ["a"]}]},
+                    {"name": "priv", "hostnames": ["internal.example"],
+                     "rules": [
+                        {"models": ["secret-model"], "backends": ["a"]}]},
+                ],
+                "models": ["public-model", "secret-model"],
+            })
+            server, runner = await run_gateway(RuntimeConfig.build(cfg),
+                                               port=0)
+            site = list(runner.sites)[0]
+            port = site._server.sockets[0].getsockname()[1]
+            url = f"http://127.0.0.1:{port}"
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(url + "/v1/models") as resp:
+                        ids = [m["id"] for m in (await resp.json())["data"]]
+                    assert ids == ["public-model"]
+                    async with s.get(
+                        url + "/v1/models",
+                        headers={"host": "internal.example"},
+                    ) as resp:
+                        ids = [m["id"] for m in (await resp.json())["data"]]
+                    assert set(ids) == {"public-model", "secret-model"}
+            finally:
+                await runner.cleanup()
+
+        run(main())
+
+    def test_debug_endpoints_redacted(self):
+        async def main():
+            cfg = Config.parse({
+                "version": "v1",
+                "backends": [{"name": "a", "schema": "OpenAI",
+                              "url": "http://x",
+                              "auth": {"kind": "APIKey",
+                                       "api_key": "sk-hidden"}}],
+                "routes": [{"name": "r", "rules": [{"backends": ["a"]}]}],
+            })
+            server, runner = await run_gateway(RuntimeConfig.build(cfg),
+                                               port=0)
+            site = list(runner.sites)[0]
+            port = site._server.sockets[0].getsockname()[1]
+            url = f"http://127.0.0.1:{port}"
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(url + "/debug/config") as resp:
+                        text = await resp.text()
+                        assert resp.status == 200
+                        assert "sk-hidden" not in text
+                        assert "REDACTED" in text
+                    async with s.get(url + "/debug/stacks") as resp:
+                        assert resp.status == 200
+                        assert "thread" in await resp.text()
+            finally:
+                await runner.cleanup()
+
+        run(main())

@@ -292,3 +292,72 @@ class TestMCPProxy:
                 await s2.stop()
 
         asyncio.run(main())
+
+
+class TestStreamingRelay:
+    def test_tools_call_sse_relayed_with_event_ids(self):
+        """A backend that streams progress notifications before the result
+        is relayed as SSE with gateway-assigned incrementing event ids."""
+
+        async def main():
+            from aiohttp import web as _web
+
+            class StreamingMCP(FakeMCPServer):
+                async def _handle(self, request):
+                    msg = json.loads(await request.read())
+                    if msg.get("method") == "tools/call":
+                        resp = _web.StreamResponse(
+                            status=200,
+                            headers={"content-type": "text/event-stream"})
+                        await resp.prepare(request)
+                        note = {"jsonrpc": "2.0",
+                                "method": "notifications/progress",
+                                "params": {"progress": 1}}
+                        await resp.write(
+                            f"data: {json.dumps(note)}\n\n".encode())
+                        final = {"jsonrpc": "2.0", "id": msg["id"],
+                                 "result": {"content": [
+                                     {"type": "text", "text": "done"}]}}
+                        await resp.write(
+                            f"data: {json.dumps(final)}\n\n".encode())
+                        await resp.write_eof()
+                        return resp
+                    return await super()._handle(request)
+
+            s1 = await StreamingMCP("alpha", ["work"]).start()
+            cfg = MCPConfig(backends=(MCPBackend(name="alpha", url=s1.url),),
+                            session_seed="t")
+            proxy = MCPProxy(cfg)
+            app = web.Application()
+            proxy.register(app)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            url = f"http://127.0.0.1:{port}/mcp"
+            try:
+                _, _, headers = await _rpc(
+                    url, "initialize",
+                    {"protocolVersion": "2025-06-18", "capabilities": {}})
+                session = headers["mcp-session-id"]
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        url,
+                        json={"jsonrpc": "2.0", "id": 5,
+                              "method": "tools/call",
+                              "params": {"name": "alpha__work"}},
+                        headers={"mcp-session-id": session},
+                    ) as resp:
+                        assert "text/event-stream" in \
+                            resp.headers["content-type"]
+                        raw = (await resp.read()).decode()
+                # two events with ids 1, 2; result last
+                assert "id: 1" in raw and "id: 2" in raw
+                assert "notifications/progress" in raw
+                assert '"text": "done"' in raw
+            finally:
+                await runner.cleanup()
+                await s1.stop()
+
+        asyncio.run(main())
