@@ -71,6 +71,18 @@ _HF_MAP = [
      "l{}.w_up", True),
     (re.compile(r"^model\.layers\.(\d+)\.mlp\.down_proj\.weight$"),
      "l{}.w_down", True),
+    # Mixtral MoE layout: experts are stacked into [E, ...] after loading
+    (re.compile(r"^model\.layers\.(\d+)\.block_sparse_moe\.gate\.weight$"),
+     "l{}.gate", True),
+    (re.compile(
+        r"^model\.layers\.(\d+)\.block_sparse_moe\.experts\.(\d+)\.w1\.weight$"),
+     "l{}.w_gate.__expert{}", True),
+    (re.compile(
+        r"^model\.layers\.(\d+)\.block_sparse_moe\.experts\.(\d+)\.w3\.weight$"),
+     "l{}.w_up.__expert{}", True),
+    (re.compile(
+        r"^model\.layers\.(\d+)\.block_sparse_moe\.experts\.(\d+)\.w2\.weight$"),
+     "l{}.w_down.__expert{}", True),
 ]
 
 
@@ -111,4 +123,20 @@ def import_hf_checkpoint(
                 ).astype(dtype)
     if unmapped:
         logger.warning("unmapped HF tensors ignored: %s", unmapped[:8])
-    return params
+    return _stack_experts(params)
+
+
+def _stack_experts(params: dict[str, jax.Array]) -> dict[str, jax.Array]:
+    """Collapse `l{i}.w_*.{__expertE}` staging keys into [E, ...] arrays
+    (Mixtral's per-expert HF tensors → our stacked MoE layout)."""
+    staged: dict[str, dict[int, jax.Array]] = {}
+    out: dict[str, jax.Array] = {}
+    for k, v in params.items():
+        if ".__expert" in k:
+            base, _, e = k.partition(".__expert")
+            staged.setdefault(base, {})[int(e)] = v
+        else:
+            out[k] = v
+    for base, experts in staged.items():
+        out[base] = jnp.stack([experts[e] for e in sorted(experts)])
+    return out

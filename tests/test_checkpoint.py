@@ -72,3 +72,60 @@ def test_hf_import_matches_native(tmp_path):
     lb, _ = llama.prefill(imported, CFG, tokens, jnp.array([4]),
                           jnp.zeros_like(cache), pt, 16)
     np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=2e-2)
+
+
+def test_mixtral_hf_import(tmp_path):
+    """Mixtral-layout safetensors (per-expert w1/w2/w3 + router gate) import
+    into our stacked [E, ...] MoE params with identical logits."""
+    from safetensors.numpy import save_file
+
+    from aigw_tpu.models import mixtral
+
+    cfg = mixtral.MixtralConfig(
+        vocab_size=64, dim=32, n_layers=1, n_heads=2, n_kv_heads=2,
+        ffn_dim=48, n_experts=2, experts_per_token=1, max_seq_len=64,
+        rope_theta=10000.0, capacity_factor=8.0,
+    )
+    params = mixtral.init_params(jax.random.PRNGKey(0), cfg)
+
+    def c32(x):
+        return np.ascontiguousarray(np.asarray(x, np.float32))
+
+    hf = {
+        "model.embed_tokens.weight": c32(params["embed"]),
+        "model.norm.weight": c32(params["norm_f"]),
+        "lm_head.weight": np.ascontiguousarray(c32(params["lm_head"]).T),
+        "model.layers.0.input_layernorm.weight": c32(params["l0.attn_norm"]),
+        "model.layers.0.post_attention_layernorm.weight": c32(
+            params["l0.mlp_norm"]),
+        "model.layers.0.block_sparse_moe.gate.weight":
+            np.ascontiguousarray(c32(params["l0.gate"]).T),
+    }
+    for ours, theirs in [("wq", "q_proj"), ("wk", "k_proj"),
+                         ("wv", "v_proj"), ("wo", "o_proj")]:
+        hf[f"model.layers.0.self_attn.{theirs}.weight"] = \
+            np.ascontiguousarray(c32(params[f"l0.{ours}"]).T)
+    for e in range(cfg.n_experts):
+        hf[f"model.layers.0.block_sparse_moe.experts.{e}.w1.weight"] = \
+            np.ascontiguousarray(c32(params["l0.w_gate"][e]).T)
+        hf[f"model.layers.0.block_sparse_moe.experts.{e}.w3.weight"] = \
+            np.ascontiguousarray(c32(params["l0.w_up"][e]).T)
+        hf[f"model.layers.0.block_sparse_moe.experts.{e}.w2.weight"] = \
+            np.ascontiguousarray(c32(params["l0.w_down"][e]).T)
+    hf_dir = tmp_path / "hf-moe"
+    hf_dir.mkdir()
+    save_file(hf, str(hf_dir / "model.safetensors"))
+
+    imported = import_hf_checkpoint(str(hf_dir))
+    assert set(imported) == set(params)
+    assert imported["l0.w_gate"].shape == params["l0.w_gate"].shape
+
+    tokens = jnp.array([[3, 4, 5]], jnp.int32)
+    pt = jnp.arange(4, dtype=jnp.int32)[None, :]
+    cache = jnp.zeros((1, 2, 16 * 16, cfg.n_kv_heads, cfg.head_dim),
+                      jnp.bfloat16)
+    la, _ = mixtral.prefill(params, cfg, tokens, jnp.array([3]), cache,
+                            pt, 16)
+    lb, _ = mixtral.prefill(imported, cfg, tokens, jnp.array([3]),
+                            jnp.zeros_like(cache), pt, 16)
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=2e-2)

@@ -1,0 +1,130 @@
+"""E2E: the inference-pool flow (BASELINE.json config 2/3) — a tpuserve
+replica POOL behind the gateway's KV-occupancy picker, including replica
+failure (reference examples/inference-pool + e2e-inference-extension)."""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+
+import aiohttp
+import pytest
+from aiohttp import web
+
+from aigw_tpu.config.model import Config
+from aigw_tpu.config.runtime import RuntimeConfig
+from aigw_tpu.gateway.server import run_gateway
+from aigw_tpu.tpuserve.engine import EngineConfig
+from aigw_tpu.tpuserve.server import TPUServeServer
+
+
+@pytest.fixture(scope="module")
+def two_replicas():
+    """Two real tpuserve servers (tiny-random) in one background loop."""
+    holder = {}
+    started = threading.Event()
+
+    def run():
+        async def main():
+            runners = []
+            addrs = []
+            for _ in range(2):
+                server = TPUServeServer(
+                    "tiny-random",
+                    EngineConfig(max_batch_size=2, max_seq_len=128,
+                                 page_size=16, min_prefill_bucket=16,
+                                 decode_steps_per_tick=4),
+                )
+                runner = web.AppRunner(server.app)
+                await runner.setup()
+                site = web.TCPSite(runner, "127.0.0.1", 0)
+                await site.start()
+                port = site._server.sockets[0].getsockname()[1]
+                runners.append(runner)
+                addrs.append(f"127.0.0.1:{port}")
+            holder["addrs"] = addrs
+            holder["runners"] = runners
+            holder["loop"] = asyncio.get_running_loop()
+            started.set()
+            await asyncio.Event().wait()
+
+        try:
+            asyncio.run(main())
+        except RuntimeError:
+            pass
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(timeout=120)
+    yield holder
+    holder["loop"].call_soon_threadsafe(holder["loop"].stop)
+
+
+def pool_config(addrs):
+    return Config.parse({
+        "version": "v1",
+        "backends": [{
+            "name": "pool",
+            "schema": "TPUServe",
+            "endpoints": [{"address": a, "slice": f"s{i}"}
+                          for i, a in enumerate(addrs)],
+            "picker_poll_interval": 0.2,
+        }],
+        "routes": [{"name": "serving", "rules": [
+            {"model_prefixes": ["tiny"], "backends": ["pool"]}]}],
+        "models": ["tiny-random"],
+    })
+
+
+def test_pool_serving_and_failover(two_replicas):
+    async def main():
+        addrs = two_replicas["addrs"]
+        server, runner = await run_gateway(
+            RuntimeConfig.build(pool_config(addrs)), port=0
+        )
+        site = list(runner.sites)[0]
+        port = site._server.sockets[0].getsockname()[1]
+        url = f"http://127.0.0.1:{port}"
+        payload = {"model": "tiny-random",
+                   "messages": [{"role": "user", "content": "hi"}],
+                   "max_tokens": 2, "temperature": 0}
+        try:
+            # wait until the picker has live telemetry from both replicas
+            picker = server._pickers["pool"]
+            for _ in range(100):
+                if all(st.healthy for st in picker.state.values()):
+                    break
+                await asyncio.sleep(0.1)
+            assert all(st.healthy for st in picker.state.values())
+
+            async with aiohttp.ClientSession() as s:
+                for _ in range(6):
+                    async with s.post(url + "/v1/chat/completions",
+                                      json=payload) as resp:
+                        assert resp.status == 200
+                        got = await resp.json()
+                        assert got["usage"]["completion_tokens"] >= 1
+
+                # kill replica 0 → picker must mark it unhealthy and route
+                # everything to replica 1 (cleanup must run on the
+                # replica's own event loop)
+                fut = asyncio.run_coroutine_threadsafe(
+                    two_replicas["runners"][0].cleanup(),
+                    two_replicas["loop"],
+                )
+                await asyncio.wrap_future(fut)
+                for _ in range(100):
+                    if not picker.state[addrs[0]].healthy:
+                        break
+                    await asyncio.sleep(0.1)
+                assert not picker.state[addrs[0]].healthy
+
+                for _ in range(4):
+                    async with s.post(url + "/v1/chat/completions",
+                                      json=payload) as resp:
+                        assert resp.status == 200
+        finally:
+            await runner.cleanup()
+
+    # the replicas live in another loop/thread; drive the gateway here
+    asyncio.run(main())
