@@ -63,6 +63,10 @@ class MCPConfig:
     session_seed: str = ""
     session_fallback_seed: str = ""
 
+    # parsed MCPAuthzConfig | None (kept out of the frozen dataclass
+    # equality on purpose — see parse())
+    authorization: Any = None
+
     @staticmethod
     def parse(value: dict[str, Any]) -> "MCPConfig":
         backends = tuple(
@@ -90,11 +94,16 @@ class MCPConfig:
                 "per-process seed; sessions will not survive restarts or "
                 "span replicas"
             )
+        from aigw_tpu.mcp.authz import MCPAuthzConfig
+
         return MCPConfig(
             backends=backends,
             path=value.get("path", "/mcp"),
             session_seed=seed,
             session_fallback_seed=value.get("session_fallback_seed", ""),
+            authorization=MCPAuthzConfig.parse(
+                value.get("authorization")
+            ),
         )
 
 
@@ -109,11 +118,42 @@ class MCPProxy:
         seed = cfg.session_seed or secrets.token_hex(32)
         self._crypto = SessionCrypto(seed, cfg.session_fallback_seed)
         self._session: aiohttp.ClientSession | None = None
+        self._authz = None
+        if cfg.authorization is not None:
+            from aigw_tpu.mcp.authz import JWTValidator
+
+            self._authz = JWTValidator(cfg.authorization)
 
     def register(self, app: web.Application) -> None:
         app.router.add_post(self.cfg.path, self.handle)
         app.router.add_delete(self.cfg.path, self.handle_delete)
+        if self._authz is not None:
+            app.router.add_get(
+                "/.well-known/oauth-protected-resource",
+                self._protected_resource_metadata,
+            )
         app.on_cleanup.append(self._cleanup)
+
+    async def _protected_resource_metadata(self, _request) -> web.Response:
+        """RFC 9728 protected-resource metadata (reference
+        MCPRouteOAuth)."""
+        cfg = self.cfg.authorization
+        return web.json_response({
+            "resource": cfg.resource or self.cfg.path,
+            "authorization_servers": list(cfg.authorization_servers),
+            "bearer_methods_supported": ["header"],
+        })
+
+    def _authenticate(self, request: web.Request) -> dict[str, Any] | None:
+        """Returns verified claims, or None when authz is disabled."""
+        if self._authz is None:
+            return None
+        from aigw_tpu.mcp.authz import AuthzError
+
+        auth = request.headers.get("authorization", "")
+        if not auth.lower().startswith("bearer "):
+            raise AuthzError("missing bearer token")
+        return self._authz.validate(auth[7:])
 
     async def _cleanup(self, _app) -> None:
         if self._session is not None and not self._session.closed:
@@ -194,6 +234,21 @@ class MCPProxy:
         msg_id = payload.get("id")
         is_notification = msg_id is None
 
+        from aigw_tpu.mcp.authz import AuthzError
+
+        try:
+            claims = self._authenticate(request)
+        except AuthzError as e:
+            resp = web.json_response(
+                _rpc_error(msg_id, -32001, str(e)), status=e.status
+            )
+            if e.status == 401:
+                resp.headers["www-authenticate"] = (
+                    'Bearer resource_metadata='
+                    '"/.well-known/oauth-protected-resource"'
+                )
+            return resp
+
         try:
             if method == "initialize":
                 result, session = await self._initialize(payload)
@@ -225,6 +280,15 @@ class MCPProxy:
                     await self._tools_list(msg_id, sessions)
                 )
             if method == "tools/call":
+                if self._authz is not None:
+                    full = (payload.get("params") or {}).get("name", "")
+                    try:
+                        self._authz.authorize_tool(full, claims or {})
+                    except AuthzError as e:
+                        return web.json_response(
+                            _rpc_error(msg_id, -32001, str(e)),
+                            status=e.status,
+                        )
                 return await self._tools_call_streaming(
                     request, payload, sessions
                 )

@@ -400,11 +400,26 @@ class Engine:
         for it in items:
             self._queue.put(it)
 
+    def _decode_bucket_pages(self) -> int:
+        """Smallest power-of-two page count covering every active slot's
+        allocation — the decode gather window shrinks to what the batch
+        actually needs (short sequences don't pay max_seq_len attention).
+        jax.jit compiles one program per bucket shape."""
+        P = self.cfg.max_pages_per_seq
+        need = 1
+        for s in self._slots:
+            if s is not None:
+                need = max(need, -(-s.limit // self.cfg.page_size))
+        bucket = 1
+        while bucket < need:
+            bucket *= 2
+        return min(bucket, P)
+
     def _build_device_state(self) -> dict[str, jax.Array]:
         """Upload per-slot state after membership changes (admission /
         completion) — small arrays, uploaded rarely."""
         B = self.cfg.max_batch_size
-        P = self.cfg.max_pages_per_seq
+        P = self._decode_bucket_pages()
         tokens = np.zeros((B,), np.int32)
         positions = np.zeros((B,), np.int32)
         limits = np.zeros((B,), np.int32)
@@ -421,7 +436,7 @@ class Engine:
             positions[i] = s.pos
             limits[i] = s.limit
             active[i] = True
-            page_table[i] = s.page_row
+            page_table[i] = s.page_row[:P]
             keys[i, 0] = np.uint32(s.key_seed & 0xFFFFFFFF)
             keys[i, 1] = np.uint32(s.pos)
             temp[i] = s.req.sampling.temperature

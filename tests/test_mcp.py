@@ -361,3 +361,131 @@ class TestStreamingRelay:
                 await s1.stop()
 
         asyncio.run(main())
+
+
+class TestAuthorization:
+    def _env(self):
+        from aigw_tpu.mcp.authz import MCPAuthzConfig
+
+        async def make():
+            s1 = await FakeMCPServer("alpha", ["search", "admin_reset"]).start()
+            cfg = MCPConfig(
+                backends=(MCPBackend(name="alpha", url=s1.url),),
+                session_seed="t",
+                authorization=MCPAuthzConfig.parse({
+                    "resource": "/mcp",
+                    "authorization_servers": ["https://auth.example"],
+                    "jwt": {"hs256_secret": "jwt-secret",
+                            "issuer": "https://auth.example",
+                            "audience": "mcp"},
+                    "rules": [
+                        {"tools": ["alpha__search"],
+                         "claims": {"role": "user"}},
+                        {"tools": ["alpha__*"],
+                         "claims": {"role": "admin"}},
+                    ],
+                }),
+            )
+            proxy = MCPProxy(cfg)
+            app = web.Application()
+            proxy.register(app)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            return s1, runner, f"http://127.0.0.1:{port}"
+
+        return make
+
+    def test_jwt_enforced(self):
+        from aigw_tpu.mcp.authz import sign_hs256
+
+        async def main():
+            s1, runner, base = await self._env()()
+            url = base + "/mcp"
+            try:
+                # no token → 401 with resource-metadata pointer
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(url, json={
+                        "jsonrpc": "2.0", "id": 1, "method": "ping"
+                    }) as resp:
+                        assert resp.status == 401
+                        assert "resource_metadata" in \
+                            resp.headers["www-authenticate"]
+                    # metadata endpoint
+                    async with s.get(
+                        base + "/.well-known/oauth-protected-resource"
+                    ) as resp:
+                        meta = await resp.json()
+                        assert meta["authorization_servers"] == \
+                            ["https://auth.example"]
+
+                    user_tok = sign_hs256(
+                        {"iss": "https://auth.example", "aud": "mcp",
+                         "role": "user"}, "jwt-secret")
+                    admin_tok = sign_hs256(
+                        {"iss": "https://auth.example", "aud": "mcp",
+                         "role": "admin"}, "jwt-secret")
+                    bad_tok = sign_hs256(
+                        {"iss": "https://auth.example", "aud": "mcp",
+                         "role": "user"}, "wrong-secret")
+
+                    # initialize with a valid token
+                    async with s.post(url, json={
+                        "jsonrpc": "2.0", "id": 1, "method": "initialize",
+                        "params": {"protocolVersion": "2025-06-18",
+                                   "capabilities": {}},
+                    }, headers={"authorization": f"Bearer {user_tok}"}
+                    ) as resp:
+                        session = resp.headers["mcp-session-id"]
+
+                    def hdrs(tok):
+                        return {"authorization": f"Bearer {tok}",
+                                "mcp-session-id": session}
+
+                    # forged signature rejected
+                    async with s.post(url, json={
+                        "jsonrpc": "2.0", "id": 2, "method": "ping"
+                    }, headers=hdrs(bad_tok)) as resp:
+                        assert resp.status == 401
+
+                    # user may call search
+                    async with s.post(url, json={
+                        "jsonrpc": "2.0", "id": 3, "method": "tools/call",
+                        "params": {"name": "alpha__search"},
+                    }, headers=hdrs(user_tok)) as resp:
+                        assert resp.status == 200
+                        assert "result" in await resp.json()
+                    # ...but not admin_reset
+                    async with s.post(url, json={
+                        "jsonrpc": "2.0", "id": 4, "method": "tools/call",
+                        "params": {"name": "alpha__admin_reset"},
+                    }, headers=hdrs(user_tok)) as resp:
+                        assert resp.status == 403
+                    # admin may
+                    async with s.post(url, json={
+                        "jsonrpc": "2.0", "id": 5, "method": "tools/call",
+                        "params": {"name": "alpha__admin_reset"},
+                    }, headers=hdrs(admin_tok)) as resp:
+                        assert resp.status == 200
+            finally:
+                await runner.cleanup()
+                await s1.stop()
+
+        asyncio.run(main())
+
+    def test_expired_token(self):
+        import time as _time
+
+        from aigw_tpu.mcp.authz import (
+            AuthzError, JWTValidator, MCPAuthzConfig, sign_hs256,
+        )
+
+        cfg = MCPAuthzConfig.parse({"jwt": {"hs256_secret": "s"}})
+        v = JWTValidator(cfg)
+        tok = sign_hs256({"exp": _time.time() - 10}, "s")
+        import pytest as _pytest
+
+        with _pytest.raises(AuthzError, match="expired"):
+            v.validate(tok)
