@@ -44,7 +44,14 @@ from aigw_tpu.gateway.mutators import apply_body_mutation, apply_header_mutation
 from aigw_tpu.gateway.picker import Endpoint as PickerEndpoint, EndpointPicker
 from aigw_tpu.gateway.router import BackendSelector, NoRouteError, match_route
 from aigw_tpu.obs.metrics import GenAIMetrics, RequestMetrics
-from aigw_tpu.obs.tracing import SpanContext, Tracer, genai_attributes
+from aigw_tpu.obs.tracing import (
+    DEFAULT_HEADER_ATTRIBUTES,
+    SpanContext,
+    Tracer,
+    genai_attributes,
+    header_attributes,
+    parse_header_attribute_mapping,
+)
 from aigw_tpu.schemas import anthropic as anth
 from aigw_tpu.schemas import openai as oai
 from aigw_tpu.translate import Endpoint, TranslationError, get_translator
@@ -137,9 +144,17 @@ class GatewayServer:
         cost_sink: CostSink | None = None,
         tracer: Tracer | None = None,
     ):
+        import os as _os2
+
         self._runtime = runtime
         self.metrics = metrics or GenAIMetrics()
         self.tracer = tracer or Tracer()
+        # request-header → span-attribute mapping (reference
+        # requestheaderattrs; default agent-session-id:session.id)
+        self._header_attrs = parse_header_attribute_mapping(
+            _os2.environ.get("AIGW_HEADER_ATTRIBUTES",
+                             DEFAULT_HEADER_ATTRIBUTES)
+        )
         self._cost_sink = cost_sink
         self._session: aiohttp.ClientSession | None = None
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
@@ -359,6 +374,9 @@ class GatewayServer:
         if self.tracer.enabled:
             parent = SpanContext.parse(client_headers.get("traceparent", ""))
             span = self.tracer.start_span(f"{operation} {model}", parent)
+            span.attributes.update(
+                header_attributes(client_headers, self._header_attrs)
+            )
 
         # ---- phase 2: upstream attempts --------------------------------
         try:

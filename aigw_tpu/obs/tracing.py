@@ -252,3 +252,30 @@ def genai_attributes(
     if output_tokens:
         attrs["gen_ai.usage.output_tokens"] = output_tokens
     return attrs
+
+
+def parse_header_attribute_mapping(spec: str) -> list[tuple[str, str]]:
+    """``header:attribute[,header:attribute...]`` → mapping list
+    (reference internalapi.ParseRequestHeaderAttributeMapping; default
+    ``agent-session-id:session.id``). Configured via
+    ``AIGW_HEADER_ATTRIBUTES``."""
+    out: list[tuple[str, str]] = []
+    for pair in spec.split(","):
+        pair = pair.strip()
+        if not pair:
+            continue
+        header, _, attr = pair.partition(":")
+        if header and attr:
+            out.append((header.strip().lower(), attr.strip()))
+    return out
+
+
+DEFAULT_HEADER_ATTRIBUTES = "agent-session-id:session.id"
+
+
+def header_attributes(
+    headers: dict[str, str], mapping: list[tuple[str, str]]
+) -> dict[str, str]:
+    return {
+        attr: headers[h] for h, attr in mapping if h in headers
+    }

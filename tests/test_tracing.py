@@ -130,3 +130,49 @@ class TestGatewayTracing:
         assert span["attributes"]["gen_ai.request.model"] == "m1"
         assert span["attributes"]["gen_ai.usage.input_tokens"] == 5
         assert span["attributes"]["gen_ai.provider.name"] == "a"
+
+
+class TestHeaderAttributes:
+    def test_mapping_parse(self):
+        from aigw_tpu.obs.tracing import parse_header_attribute_mapping
+
+        got = parse_header_attribute_mapping(
+            "Agent-Session-Id:session.id, x-team : team.name,,bad")
+        assert got == [("agent-session-id", "session.id"),
+                       ("x-team", "team.name")]
+
+    def test_span_gets_mapped_header(self, capsys):
+        async def main():
+            up = FakeUpstream().on_json(
+                "/v1/chat/completions", openai_chat_response()
+            )
+            await up.start()
+            cfg = Config.parse({
+                "version": "v1",
+                "backends": [{"name": "a", "schema": "OpenAI",
+                              "url": up.url}],
+                "routes": [{"name": "r", "rules": [
+                    {"models": ["m1"], "backends": ["a"]}]}],
+            })
+            server, runner = await run_gateway(
+                RuntimeConfig.build(cfg), port=0,
+                tracer=Tracer(exporter="console"),
+            )
+            site = list(runner.sites)[0]
+            port = site._server.sockets[0].getsockname()[1]
+            try:
+                async with aiohttp.ClientSession() as s:
+                    await s.post(
+                        f"http://127.0.0.1:{port}/v1/chat/completions",
+                        json={"model": "m1", "messages": [
+                            {"role": "user", "content": "hi"}]},
+                        headers={"agent-session-id": "sess-42"},
+                    )
+            finally:
+                await runner.cleanup()
+                await up.stop()
+
+        asyncio.run(main())
+        err = capsys.readouterr().err
+        span = json.loads(err.strip().splitlines()[-1])
+        assert span["attributes"]["session.id"] == "sess-42"
