@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from typing import Any, Callable
 
@@ -144,16 +145,14 @@ class GatewayServer:
         cost_sink: CostSink | None = None,
         tracer: Tracer | None = None,
     ):
-        import os as _os2
-
         self._runtime = runtime
         self.metrics = metrics or GenAIMetrics()
         self.tracer = tracer or Tracer()
         # request-header → span-attribute mapping (reference
         # requestheaderattrs; default agent-session-id:session.id)
         self._header_attrs = parse_header_attribute_mapping(
-            _os2.environ.get("AIGW_HEADER_ATTRIBUTES",
-                             DEFAULT_HEADER_ATTRIBUTES)
+            os.environ.get("AIGW_HEADER_ATTRIBUTES",
+                           DEFAULT_HEADER_ATTRIBUTES)
         )
         self._cost_sink = cost_sink
         self._session: aiohttp.ClientSession | None = None
@@ -165,9 +164,7 @@ class GatewayServer:
         self.app.router.add_get("/metrics", self._handle_metrics)
         # debug/admin surface (reference: pprof :6060 + admin server;
         # internal/pprof/pprof.go:18-40) — enabled unless AIGW_DISABLE_DEBUG
-        import os as _os
-
-        if _os.environ.get("AIGW_DISABLE_DEBUG", "").lower() != "true":
+        if os.environ.get("AIGW_DISABLE_DEBUG", "").lower() != "true":
             self.app.router.add_get("/debug/config", self._handle_debug_config)
             self.app.router.add_get("/debug/stacks", self._handle_debug_stacks)
         self._pickers: dict[str, EndpointPicker] = {}

@@ -1,0 +1,236 @@
+"""OIDC credential-exchange tests against local fake token services
+(reference rotators + tokenprovider tests, no egress needed)."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import aiohttp
+import pytest
+from aiohttp import web
+
+from aigw_tpu.gateway.auth.oidc import (
+    AWSOIDCExchanger,
+    AzureOIDCExchanger,
+    CredentialRotator,
+    GCPOIDCExchanger,
+    OIDCTokenProvider,
+)
+
+
+class FakeIdP:
+    """Fake OIDC + STS endpoints."""
+
+    def __init__(self):
+        self.requests: list[tuple[str, dict]] = []
+        app = web.Application()
+        app.router.add_post("/oauth/token", self._token)
+        app.router.add_post("/aws-sts/", self._aws_sts)
+        app.router.add_post("/gcp-sts", self._gcp_sts)
+        app.router.add_post("/impersonate", self._impersonate)
+        self._app = app
+        self._runner = None
+        self.url = ""
+
+    async def _token(self, request):
+        form = dict(await request.post())
+        self.requests.append(("token", form))
+        if form.get("client_secret") != "s3cret":
+            return web.json_response({"error": "invalid_client"}, status=401)
+        return web.json_response({
+            "id_token": "oidc-jwt-123", "token_type": "Bearer",
+            "expires_in": 120,
+        })
+
+    async def _aws_sts(self, request):
+        form = dict(await request.post())
+        self.requests.append(("aws", form))
+        if form.get("WebIdentityToken") != "oidc-jwt-123":
+            return web.Response(status=403, text="<Error/>")
+        return web.Response(
+            content_type="text/xml",
+            text="""<AssumeRoleWithWebIdentityResponse>
+  <AssumeRoleWithWebIdentityResult><Credentials>
+    <AccessKeyId>ASIATEST</AccessKeyId>
+    <SecretAccessKey>awsSecret</SecretAccessKey>
+    <SessionToken>awsSession</SessionToken>
+    <Expiration>2099-01-01T00:00:00Z</Expiration>
+  </Credentials></AssumeRoleWithWebIdentityResult>
+</AssumeRoleWithWebIdentityResponse>""",
+        )
+
+    async def _gcp_sts(self, request):
+        body = await request.json()
+        self.requests.append(("gcp", body))
+        if body.get("subjectToken") != "oidc-jwt-123":
+            return web.json_response({}, status=403)
+        return web.json_response({"access_token": "gcp-fed-token",
+                                  "expires_in": 300})
+
+    async def _impersonate(self, request):
+        auth = request.headers.get("authorization", "")
+        self.requests.append(("impersonate", {"auth": auth}))
+        if auth != "Bearer gcp-fed-token":
+            return web.json_response({}, status=403)
+        return web.json_response({"accessToken": "gcp-sa-token"})
+
+    async def start(self):
+        self._runner = web.AppRunner(self._app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        self.url = f"http://127.0.0.1:{port}"
+        return self
+
+    async def stop(self):
+        await self._runner.cleanup()
+
+
+def provider(idp):
+    return OIDCTokenProvider(idp.url + "/oauth/token", "client-1", "s3cret")
+
+
+def test_aws_oidc_exchange():
+    async def main():
+        idp = await FakeIdP().start()
+        try:
+            ex = AWSOIDCExchanger(provider(idp), "arn:aws:iam::1:role/r",
+                                  sts_url=idp.url + "/aws-sts")
+            async with aiohttp.ClientSession() as s:
+                cred = await ex.fetch(s)
+            assert cred.value == {
+                "aws_access_key_id": "ASIATEST",
+                "aws_secret_access_key": "awsSecret",
+                "aws_session_token": "awsSession",
+            }
+            assert cred.expires_at > time.time() + 3600
+        finally:
+            await idp.stop()
+
+    asyncio.run(main())
+
+
+def test_gcp_oidc_exchange_with_impersonation():
+    async def main():
+        idp = await FakeIdP().start()
+        try:
+            ex = GCPOIDCExchanger(
+                provider(idp), audience="//iam.googleapis.com/x",
+                sts_url=idp.url + "/gcp-sts",
+                impersonate_url=idp.url + "/impersonate",
+            )
+            async with aiohttp.ClientSession() as s:
+                cred = await ex.fetch(s)
+            assert cred.value == {"gcp_access_token": "gcp-sa-token"}
+        finally:
+            await idp.stop()
+
+    asyncio.run(main())
+
+
+def test_azure_flow_and_bad_secret():
+    async def main():
+        idp = await FakeIdP().start()
+        try:
+            ex = AzureOIDCExchanger(idp.url + "/oauth/token", "client-1",
+                                    "s3cret")
+            async with aiohttp.ClientSession() as s:
+                cred = await ex.fetch(s)
+                assert cred.value["azure_access_token"] == "oidc-jwt-123"
+                bad = AzureOIDCExchanger(idp.url + "/oauth/token",
+                                         "client-1", "WRONG")
+                with pytest.raises(RuntimeError, match="401"):
+                    await bad.fetch(s)
+        finally:
+            await idp.stop()
+
+    asyncio.run(main())
+
+
+def test_rotator_writes_files_for_auth_handlers(tmp_path):
+    """The full loop: rotated AWS creds land in files that the SigV4
+    handler's file-backed secrets pick up (mounted-Secret contract)."""
+
+    async def main():
+        idp = await FakeIdP().start()
+        try:
+            paths = {
+                "aws_access_key_id": str(tmp_path / "akid"),
+                "aws_secret_access_key": str(tmp_path / "secret"),
+                "aws_session_token": str(tmp_path / "session"),
+            }
+            rot = CredentialRotator(
+                AWSOIDCExchanger(provider(idp), "arn:x",
+                                 sts_url=idp.url + "/aws-sts"),
+                paths,
+            )
+            async with aiohttp.ClientSession() as s:
+                await rot.refresh_once(s)
+            for p in paths.values():
+                assert open(p).read()
+        finally:
+            await idp.stop()
+
+    asyncio.run(main())
+
+    from aigw_tpu.config.model import AuthConfig
+    from aigw_tpu.gateway.auth import new_handler
+
+    h = new_handler(AuthConfig.parse({
+        "kind": "AWSSigV4",
+        "aws_access_key_id": f"file:{tmp_path}/akid",
+        "aws_secret_access_key": f"file:{tmp_path}/secret",
+        "aws_session_token": f"file:{tmp_path}/session",
+        "aws_region": "us-east-1",
+    }))
+    headers, _ = h.apply({"host": "bedrock.amazonaws.com"}, b"{}", "/m")
+    assert "Credential=ASIATEST/" in headers["authorization"]
+    assert headers["x-amz-security-token"] == "awsSession"
+
+
+def test_secret_files_mode_and_atomicity(tmp_path):
+    from aigw_tpu.gateway.auth.oidc import CredentialRotator
+    import os as _os
+
+    p = str(tmp_path / "cred")
+    CredentialRotator._write_secret(p, "v1")
+    assert oct(_os.stat(p).st_mode & 0o777) == "0o600"
+    CredentialRotator._write_secret(p, "v2")
+    assert open(p).read() == "v2"
+    assert not _os.path.exists(p + ".tmp")
+
+
+def test_sts_token_not_in_url():
+    """The OIDC bearer token must travel in the POST body, never the URL."""
+
+    async def main():
+        seen = {}
+
+        async def sts(request):
+            seen["query"] = dict(request.rel_url.query)
+            seen["form"] = dict(await request.post())
+            return web.Response(content_type="text/xml",
+                                text="<AccessKeyId>A</AccessKeyId>")
+
+        app = web.Application()
+        app.router.add_post("/", sts)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        idp = await FakeIdP().start()
+        try:
+            ex = AWSOIDCExchanger(provider(idp), "arn:x",
+                                  sts_url=f"http://127.0.0.1:{port}")
+            async with aiohttp.ClientSession() as s:
+                await ex.fetch(s)
+            assert "WebIdentityToken" not in seen["query"]
+            assert seen["form"]["WebIdentityToken"] == "oidc-jwt-123"
+        finally:
+            await runner.cleanup()
+            await idp.stop()
+
+    asyncio.run(main())
