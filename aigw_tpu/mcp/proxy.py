@@ -18,7 +18,9 @@ handlers.go:326-460):
 from __future__ import annotations
 
 import asyncio
+import collections
 import fnmatch
+import hashlib
 import json
 import logging
 import secrets
@@ -123,9 +125,17 @@ class MCPProxy:
             from aigw_tpu.mcp.authz import JWTValidator
 
             self._authz = JWTValidator(cfg.authorization)
+        # bounded per-session replay buffers for Last-Event-Id resumption
+        # (reference sse.go). Best-effort and replica-local: the encrypted
+        # session itself stays stateless; only recent stream events are
+        # cached here, keyed by a digest of the session token.
+        self._replay: "collections.OrderedDict[str, collections.deque]" = (
+            collections.OrderedDict()
+        )
 
     def register(self, app: web.Application) -> None:
         app.router.add_post(self.cfg.path, self.handle)
+        app.router.add_get(self.cfg.path, self.handle_get)
         app.router.add_delete(self.cfg.path, self.handle_delete)
         if self._authz is not None:
             app.router.add_get(
@@ -209,6 +219,48 @@ class MCPProxy:
                         return msg, new_session
                 return None, new_session
             return (json.loads(raw) if raw else None), new_session
+
+    _REPLAY_EVENTS = 256  # per session
+    _REPLAY_SESSIONS = 1024
+
+    def _replay_buffer(self, session_token: str) -> "collections.deque":
+        key = hashlib.sha256(session_token.encode()).hexdigest()[:32]
+        buf = self._replay.get(key)
+        if buf is None:
+            buf = collections.deque(maxlen=self._REPLAY_EVENTS)
+            self._replay[key] = buf
+            while len(self._replay) > self._REPLAY_SESSIONS:
+                self._replay.popitem(last=False)
+        else:
+            self._replay.move_to_end(key)
+        return buf
+
+    async def handle_get(self, request: web.Request) -> web.StreamResponse:
+        """GET /mcp with Last-Event-Id: replay buffered stream events
+        after the given id (streamable-HTTP resumption)."""
+        token = request.headers.get(SESSION_HEADER, "")
+        if not token:
+            return web.Response(status=405)
+        try:
+            self._decode_session(token)
+        except SessionCryptoError:
+            return web.Response(status=404)
+        try:
+            last = int(request.headers.get("last-event-id", "0"))
+        except ValueError:
+            last = 0
+        buf = self._replay_buffer(token)
+        resp = web.StreamResponse(
+            status=200,
+            headers={"content-type": "text/event-stream",
+                     "cache-control": "no-cache"},
+        )
+        await resp.prepare(request)
+        for event_id, encoded in list(buf):
+            if event_id > last:
+                await resp.write(encoded)
+        await resp.write_eof()
+        return resp
 
     # -- session composition ---------------------------------------------
     def _encode_session(self, sessions: dict[str, str]) -> str:
@@ -475,16 +527,24 @@ class MCPProxy:
             )
             await out.prepare(request)
             parser = SSEParser()
-            event_id = 0
-            async for chunk in resp.content.iter_any():
-                for ev in parser.feed(chunk):
-                    event_id += 1
-                    ev.id = str(event_id)
-                    await out.write(ev.encode())
-            for ev in parser.flush():
+            buf = self._replay_buffer(
+                request.headers.get(SESSION_HEADER, "")
+            )
+            event_id = max((i for i, _ in buf), default=0)
+
+            async def relay(ev):
+                nonlocal event_id
                 event_id += 1
                 ev.id = str(event_id)
-                await out.write(ev.encode())
+                encoded = ev.encode()
+                buf.append((event_id, encoded))
+                await out.write(encoded)
+
+            async for chunk in resp.content.iter_any():
+                for ev in parser.feed(chunk):
+                    await relay(ev)
+            for ev in parser.flush():
+                await relay(ev)
             await out.write_eof()
             return out
 

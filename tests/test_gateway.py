@@ -579,3 +579,89 @@ class TestAdminAndModels:
                 await runner.cleanup()
 
         run(main())
+
+
+class TestTrafficSemantics:
+    def test_weighted_traffic_split(self):
+        """~90/10 weighted split across two healthy backends (reference
+        e2e traffic_splitting)."""
+
+        async def main():
+            a = FakeUpstream().on_json("/v1/chat/completions",
+                                       openai_chat_response("a"))
+            b = FakeUpstream().on_json("/v1/chat/completions",
+                                       openai_chat_response("b"))
+            server, runner, url, ups = await start_env(
+                {"a": a, "b": b},
+                lambda urls: make_config(
+                    [{"name": "a", "schema": "OpenAI", "url": urls["a"]},
+                     {"name": "b", "schema": "OpenAI", "url": urls["b"]}],
+                    [{"name": "r", "rules": [{
+                        "models": ["m1"],
+                        "backends": [{"backend": "a", "weight": 9},
+                                     {"backend": "b", "weight": 1}],
+                    }]}],
+                ),
+            )
+            try:
+                async with aiohttp.ClientSession() as s:
+                    for _ in range(120):
+                        async with s.post(url + "/v1/chat/completions",
+                                          json=CHAT) as resp:
+                            assert resp.status == 200
+                na, nb = len(a.captured), len(b.captured)
+                assert na + nb == 120
+                # 9:1 split — loose bounds to avoid flaky randomness
+                assert 85 <= na <= 120 and 0 < nb <= 35
+            finally:
+                await stop_env(runner, ups)
+
+        run(main())
+
+    def test_stream_idle_timeout_aborts(self):
+        """A stalled SSE stream is cut off after stream_idle_timeout with
+        an error event (reference examples/stream_idle_timeout →
+        per_try_idle_timeout)."""
+
+        async def main():
+            from aiohttp import web as _web
+
+            async def stalling(cap):
+                resp = _web.StreamResponse(
+                    status=200,
+                    headers={"content-type": "text/event-stream"})
+                await resp.prepare(cap._request)
+                await resp.write(
+                    b'data: {"choices":[{"index":0,'
+                    b'"delta":{"content":"x"},"finish_reason":null}]}\n\n')
+                await asyncio.sleep(30)  # stall far past the idle timeout
+                return resp
+
+            up = FakeUpstream().on("/v1/chat/completions", stalling)
+            server, runner, url, ups = await start_env(
+                {"a": up},
+                lambda urls: make_config(
+                    [{"name": "a", "schema": "OpenAI", "url": urls["a"],
+                      "stream_idle_timeout": 0.5}],
+                    [{"name": "r", "rules": [
+                        {"models": ["m1"], "backends": ["a"]}]}],
+                ),
+            )
+            try:
+                import time as _time
+
+                t0 = _time.monotonic()
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        url + "/v1/chat/completions",
+                        json=dict(CHAT, stream=True),
+                    ) as resp:
+                        raw = (await resp.read()).decode()
+                elapsed = _time.monotonic() - t0
+                assert elapsed < 5, f"not cut off in time ({elapsed:.1f}s)"
+                assert '"content":"x"' in raw.replace(" ", "")
+                assert "upstream stream interrupted" in raw
+            finally:
+                await stop_env(runner, ups)
+
+        run(main())

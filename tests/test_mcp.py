@@ -489,3 +489,77 @@ class TestAuthorization:
 
         with _pytest.raises(AuthzError, match="expired"):
             v.validate(tok)
+
+
+class TestLastEventIdReplay:
+    def test_replay_after_disconnect(self):
+        """GET /mcp with Last-Event-Id replays buffered stream events the
+        client missed (streamable-HTTP resumption, reference sse.go)."""
+
+        async def main():
+            from aiohttp import web as _web
+
+            class StreamingMCP(FakeMCPServer):
+                async def _handle(self, request):
+                    msg = json.loads(await request.read())
+                    if msg.get("method") == "tools/call":
+                        resp = _web.StreamResponse(
+                            status=200,
+                            headers={"content-type": "text/event-stream"})
+                        await resp.prepare(request)
+                        for i in range(3):
+                            note = {"jsonrpc": "2.0",
+                                    "method": "notifications/progress",
+                                    "params": {"progress": i}}
+                            await resp.write(
+                                f"data: {json.dumps(note)}\n\n".encode())
+                        final = {"jsonrpc": "2.0", "id": msg["id"],
+                                 "result": {"content": []}}
+                        await resp.write(
+                            f"data: {json.dumps(final)}\n\n".encode())
+                        await resp.write_eof()
+                        return resp
+                    return await super()._handle(request)
+
+            s1 = await StreamingMCP("alpha", ["work"]).start()
+            cfg = MCPConfig(backends=(MCPBackend(name="alpha", url=s1.url),),
+                            session_seed="t")
+            proxy = MCPProxy(cfg)
+            app = web.Application()
+            proxy.register(app)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            url = f"http://127.0.0.1:{port}/mcp"
+            try:
+                _, _, headers = await _rpc(
+                    url, "initialize",
+                    {"protocolVersion": "2025-06-18", "capabilities": {}})
+                session = headers["mcp-session-id"]
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        url,
+                        json={"jsonrpc": "2.0", "id": 7,
+                              "method": "tools/call",
+                              "params": {"name": "alpha__work"}},
+                        headers={"mcp-session-id": session},
+                    ) as resp:
+                        await resp.read()
+                    # client "lost" everything after event 2 — replay
+                    async with s.get(
+                        url,
+                        headers={"mcp-session-id": session,
+                                 "last-event-id": "2"},
+                    ) as resp:
+                        assert resp.status == 200
+                        raw = (await resp.read()).decode()
+                assert "id: 3" in raw and "id: 4" in raw
+                assert "id: 1" not in raw and "id: 2" not in raw
+                assert '"result"' in raw  # the final message is replayable
+            finally:
+                await runner.cleanup()
+                await s1.stop()
+
+        asyncio.run(main())
