@@ -159,6 +159,7 @@ def _install_all() -> None:
         embeddings,
         tokenize,
         rerank,
+        responses,
     )
 
 

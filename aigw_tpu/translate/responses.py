@@ -1,0 +1,259 @@
+"""OpenAI Responses API front → chat-completions backends.
+
+The Responses API is the reference's 11th endpoint (endpointspec.go:99-121
+registers /v1/responses). OpenAI-schema backends get passthrough
+(passthrough.py); this module makes the endpoint work against every
+*chat-capable* backend by mapping Responses ⇄ chat completions, then
+chaining the existing chat translators for non-OpenAI schemas:
+
+    Responses request ─→ chat request ─→ (chat translator for backend)
+    backend response ─→ chat response ─→ Responses response
+
+Streaming re-encodes chat chunks as ``response.output_text.delta`` /
+``response.completed`` events.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Any
+
+from aigw_tpu.config.model import APISchemaName
+from aigw_tpu.gateway.costs import TokenUsage
+from aigw_tpu.schemas import openai as oai
+from aigw_tpu.schemas.openai import SchemaError
+from aigw_tpu.translate.base import (
+    Endpoint,
+    RequestTx,
+    ResponseTx,
+    Translator,
+    get_translator,
+    register_translator,
+)
+from aigw_tpu.translate.sse import SSEEvent, SSEParser
+
+
+def responses_to_chat_request(body: dict[str, Any]) -> dict[str, Any]:
+    """Responses request → chat completions request."""
+    messages: list[dict[str, Any]] = []
+    if body.get("instructions"):
+        messages.append({"role": "system", "content": body["instructions"]})
+    raw = body.get("input")
+    if isinstance(raw, str):
+        messages.append({"role": "user", "content": raw})
+    elif isinstance(raw, list):
+        for item in raw:
+            if not isinstance(item, dict):
+                raise SchemaError("input items must be objects")
+            itype = item.get("type", "message")
+            if itype != "message":
+                raise SchemaError(f"unsupported input item type {itype!r}")
+            content = item.get("content")
+            if isinstance(content, list):
+                text = "".join(
+                    p.get("text", "")
+                    for p in content
+                    if p.get("type") in ("input_text", "output_text", "text")
+                )
+            else:
+                text = content or ""
+            messages.append({"role": item.get("role", "user"),
+                             "content": text})
+    else:
+        raise SchemaError("missing required field: input")
+    out: dict[str, Any] = {"model": body["model"], "messages": messages}
+    if body.get("max_output_tokens") is not None:
+        out["max_tokens"] = int(body["max_output_tokens"])
+    for src, dst in (("temperature", "temperature"), ("top_p", "top_p")):
+        if body.get(src) is not None:
+            out[dst] = body[src]
+    if body.get("stream"):
+        out["stream"] = True
+        out["stream_options"] = {"include_usage": True}
+    return out
+
+
+def chat_to_responses_response(
+    chat: dict[str, Any], response_id: str, created: int
+) -> dict[str, Any]:
+    usage = oai.extract_usage(chat)
+    choice = (chat.get("choices") or [{}])[0]
+    msg = choice.get("message") or {}
+    text = msg.get("content") or ""
+    status = "completed"
+    if choice.get("finish_reason") == "length":
+        status = "incomplete"
+    return {
+        "id": response_id,
+        "object": "response",
+        "created_at": created,
+        "status": status,
+        "model": chat.get("model", ""),
+        "output": [
+            {
+                "type": "message",
+                "id": f"msg_{uuid.uuid4().hex[:24]}",
+                "role": "assistant",
+                "status": "completed",
+                "content": [
+                    {"type": "output_text", "text": text, "annotations": []}
+                ],
+            }
+        ],
+        "output_text": text,
+        "usage": {
+            "input_tokens": usage.input_tokens,
+            "output_tokens": usage.output_tokens,
+            "total_tokens": usage.total_tokens
+            or usage.input_tokens + usage.output_tokens,
+        },
+    }
+
+
+class ResponsesToChat(Translator):
+    """Responses front ⇄ any chat-capable backend schema.
+
+    Chains the registered chat translator for the backend, so one
+    implementation covers Anthropic/Bedrock/Gemini/TPUServe/… backends.
+    """
+
+    def __init__(self, out_schema: APISchemaName, *,
+                 model_name_override: str = "", stream: bool = False,
+                 out_version: str = ""):
+        self._out_schema = out_schema
+        self._override = model_name_override
+        self._out_version = out_version
+        self._stream = stream
+        self._inner: Translator | None = None
+        self._id = f"resp_{uuid.uuid4().hex[:24]}"
+        self._created = int(time.time())
+        self._model = ""
+        self._parser = SSEParser()
+        self._text: list[str] = []
+        self._usage = TokenUsage()
+        self._started = False
+        self._done = False
+
+    def request(self, body: dict[str, Any]) -> RequestTx:
+        oai.request_model(body)
+        chat_req = responses_to_chat_request(body)
+        self._stream = bool(chat_req.get("stream", False))
+        self._inner = get_translator(
+            Endpoint.CHAT_COMPLETIONS,
+            APISchemaName.OPENAI,
+            self._out_schema,
+            model_name_override=self._override,
+            stream=self._stream,
+            out_version=self._out_version,
+        )
+        tx = self._inner.request(chat_req)
+        tx.stream = self._stream
+        return tx
+
+    def response_headers(self, status: int, headers: dict[str, str]) -> None:
+        if self._inner is not None:
+            self._inner.response_headers(status, headers)
+
+    def response_error(self, status: int, body: bytes) -> bytes:
+        assert self._inner is not None
+        return self._inner.response_error(status, body)
+
+    def response_body(self, chunk: bytes, end_of_stream: bool) -> ResponseTx:
+        assert self._inner is not None
+        inner_rx = self._inner.response_body(chunk, end_of_stream)
+        if not self._stream:
+            if not end_of_stream:
+                return ResponseTx()
+            try:
+                chat = json.loads(inner_rx.body or chunk)
+            except json.JSONDecodeError:
+                return inner_rx
+            out = chat_to_responses_response(chat, self._id, self._created)
+            return ResponseTx(
+                body=json.dumps(out).encode(),
+                usage=inner_rx.usage,
+                model=inner_rx.model,
+            )
+        # streaming: inner produced OpenAI chat chunks; re-encode as
+        # Responses events
+        events = self._parser.feed(inner_rx.body)
+        if end_of_stream:
+            events += self._parser.flush()
+        out = bytearray()
+        if not self._started and (events or inner_rx.body):
+            self._started = True
+            out += SSEEvent(
+                event="response.created",
+                data=json.dumps({
+                    "type": "response.created",
+                    "response": {"id": self._id, "object": "response",
+                                 "status": "in_progress"},
+                }),
+            ).encode()
+        for ev in events:
+            if not ev.data or ev.data.strip() == "[DONE]":
+                continue
+            try:
+                data = json.loads(ev.data)
+            except json.JSONDecodeError:
+                continue
+            self._model = str(data.get("model", "") or "") or self._model
+            if data.get("usage"):
+                self._usage = self._usage.merge_override(
+                    oai.extract_usage(data)
+                )
+            for choice in data.get("choices", ()):
+                delta = (choice.get("delta") or {}).get("content")
+                if delta:
+                    self._text.append(delta)
+                    out += SSEEvent(
+                        event="response.output_text.delta",
+                        data=json.dumps({
+                            "type": "response.output_text.delta",
+                            "delta": delta,
+                        }),
+                    ).encode()
+        if end_of_stream and not self._done:
+            self._done = True
+            final = chat_to_responses_response(
+                {
+                    "model": self._model,
+                    "choices": [{
+                        "message": {"content": "".join(self._text)},
+                        "finish_reason": "stop",
+                    }],
+                    "usage": oai.usage_dict(self._usage),
+                },
+                self._id, self._created,
+            )
+            out += SSEEvent(
+                event="response.completed",
+                data=json.dumps({"type": "response.completed",
+                                 "response": final}),
+            ).encode()
+        return ResponseTx(
+            body=bytes(out),
+            usage=inner_rx.usage,
+            model=inner_rx.model or self._model,
+            tokens_emitted=inner_rx.tokens_emitted,
+        )
+
+
+def _install() -> None:
+    for schema in (APISchemaName.ANTHROPIC, APISchemaName.AWS_BEDROCK,
+                   APISchemaName.GCP_VERTEX_AI, APISchemaName.GCP_ANTHROPIC,
+                   APISchemaName.AWS_ANTHROPIC, APISchemaName.TPUSERVE):
+        def make(*, model_name_override: str = "", stream: bool = False,
+                 out_version: str = "", _s: APISchemaName = schema):
+            return ResponsesToChat(
+                _s, model_name_override=model_name_override, stream=stream,
+                out_version=out_version,
+            )
+
+        register_translator(Endpoint.RESPONSES, APISchemaName.OPENAI,
+                            schema, make)
+
+
+_install()
