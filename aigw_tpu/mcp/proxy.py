@@ -223,11 +223,17 @@ class MCPProxy:
     _REPLAY_EVENTS = 256  # per session
     _REPLAY_SESSIONS = 1024
 
-    def _replay_buffer(self, session_token: str) -> "collections.deque":
+    def _replay_buffer(self, session_token: str):
+        """Per-session replay state: (deque, shared id allocator) — the
+        allocator is shared across concurrent streams on the session so
+        event ids stay unique. Returns None without a session token."""
+        if not session_token:
+            return None
         key = hashlib.sha256(session_token.encode()).hexdigest()[:32]
         buf = self._replay.get(key)
         if buf is None:
-            buf = collections.deque(maxlen=self._REPLAY_EVENTS)
+            buf = {"events": collections.deque(maxlen=self._REPLAY_EVENTS),
+                   "next_id": 1}
             self._replay[key] = buf
             while len(self._replay) > self._REPLAY_SESSIONS:
                 self._replay.popitem(last=False)
@@ -237,28 +243,40 @@ class MCPProxy:
 
     async def handle_get(self, request: web.Request) -> web.StreamResponse:
         """GET /mcp with Last-Event-Id: replay buffered stream events
-        after the given id (streamable-HTTP resumption)."""
+        after the given id (streamable-HTTP resumption). Without the
+        header this is the listening stream — we have no server-initiated
+        messages to push, so it completes empty (no replay: re-delivering
+        consumed JSON-RPC responses would break strict clients)."""
+        from aigw_tpu.mcp.authz import AuthzError
+
         token = request.headers.get(SESSION_HEADER, "")
         if not token:
             return web.Response(status=405)
         try:
+            self._authenticate(request)
+        except AuthzError as e:
+            return web.Response(status=e.status)
+        try:
             self._decode_session(token)
         except SessionCryptoError:
             return web.Response(status=404)
-        try:
-            last = int(request.headers.get("last-event-id", "0"))
-        except ValueError:
-            last = 0
-        buf = self._replay_buffer(token)
+        last_header = request.headers.get("last-event-id", "")
         resp = web.StreamResponse(
             status=200,
             headers={"content-type": "text/event-stream",
                      "cache-control": "no-cache"},
         )
         await resp.prepare(request)
-        for event_id, encoded in list(buf):
-            if event_id > last:
-                await resp.write(encoded)
+        if last_header:
+            try:
+                last = int(last_header)
+            except ValueError:
+                last = 0
+            buf = self._replay_buffer(token)
+            if buf is not None:
+                for event_id, encoded in list(buf["events"]):
+                    if event_id > last:
+                        await resp.write(encoded)
         await resp.write_eof()
         return resp
 
@@ -530,14 +548,16 @@ class MCPProxy:
             buf = self._replay_buffer(
                 request.headers.get(SESSION_HEADER, "")
             )
-            event_id = max((i for i, _ in buf), default=0)
 
             async def relay(ev):
-                nonlocal event_id
-                event_id += 1
+                if buf is None:
+                    await out.write(ev.encode())
+                    return
+                event_id = buf["next_id"]
+                buf["next_id"] += 1
                 ev.id = str(event_id)
                 encoded = ev.encode()
-                buf.append((event_id, encoded))
+                buf["events"].append((event_id, encoded))
                 await out.write(encoded)
 
             async for chunk in resp.content.iter_any():

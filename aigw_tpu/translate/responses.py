@@ -52,6 +52,8 @@ def responses_to_chat_request(body: dict[str, Any]) -> dict[str, Any]:
                 raise SchemaError(f"unsupported input item type {itype!r}")
             content = item.get("content")
             if isinstance(content, list):
+                if not all(isinstance(p, dict) for p in content):
+                    raise SchemaError("content parts must be objects")
                 text = "".join(
                     p.get("text", "")
                     for p in content
@@ -135,6 +137,7 @@ class ResponsesToChat(Translator):
         self._usage = TokenUsage()
         self._started = False
         self._done = False
+        self._finish = "stop"
 
     def request(self, body: dict[str, Any]) -> RequestTx:
         oai.request_model(body)
@@ -205,6 +208,8 @@ class ResponsesToChat(Translator):
                     oai.extract_usage(data)
                 )
             for choice in data.get("choices", ()):
+                if choice.get("finish_reason"):
+                    self._finish = choice["finish_reason"]
                 delta = (choice.get("delta") or {}).get("content")
                 if delta:
                     self._text.append(delta)
@@ -222,7 +227,7 @@ class ResponsesToChat(Translator):
                     "model": self._model,
                     "choices": [{
                         "message": {"content": "".join(self._text)},
-                        "finish_reason": "stop",
+                        "finish_reason": self._finish,
                     }],
                     "usage": oai.usage_dict(self._usage),
                 },

@@ -563,3 +563,81 @@ class TestLastEventIdReplay:
                 await s1.stop()
 
         asyncio.run(main())
+
+
+class TestReplayHardening:
+    def test_get_without_header_replays_nothing(self):
+        async def main():
+            s1, s2, runner, url = await _mcp_env()
+            try:
+                _, _, headers = await _rpc(
+                    url, "initialize",
+                    {"protocolVersion": "2025-06-18", "capabilities": {}})
+                session = headers["mcp-session-id"]
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(
+                        url, headers={"mcp-session-id": session}
+                    ) as resp:
+                        assert resp.status == 200
+                        assert await resp.read() == b""
+            finally:
+                await runner.cleanup()
+                await s1.stop()
+                await s2.stop()
+
+        asyncio.run(main())
+
+    def test_get_requires_jwt_when_authz_enabled(self):
+        from aigw_tpu.mcp.authz import MCPAuthzConfig, sign_hs256
+
+        async def main():
+            s1 = await FakeMCPServer("alpha", ["t"]).start()
+            cfg = MCPConfig(
+                backends=(MCPBackend(name="alpha", url=s1.url),),
+                session_seed="t",
+                authorization=MCPAuthzConfig.parse(
+                    {"jwt": {"hs256_secret": "k"}}),
+            )
+            proxy = MCPProxy(cfg)
+            app = web.Application()
+            proxy.register(app)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            url = f"http://127.0.0.1:{port}/mcp"
+            try:
+                tok = sign_hs256({"sub": "u"}, "k")
+                _, _, headers = await _rpc_auth(url, tok)
+                session = headers["mcp-session-id"]
+                async with aiohttp.ClientSession() as s:
+                    # replay GET without a JWT → 401
+                    async with s.get(
+                        url,
+                        headers={"mcp-session-id": session,
+                                 "last-event-id": "0"},
+                    ) as resp:
+                        assert resp.status == 401
+                    # with the JWT → 200
+                    async with s.get(
+                        url,
+                        headers={"mcp-session-id": session,
+                                 "last-event-id": "0",
+                                 "authorization": f"Bearer {tok}"},
+                    ) as resp:
+                        assert resp.status == 200
+            finally:
+                await runner.cleanup()
+                await s1.stop()
+
+        asyncio.run(main())
+
+
+async def _rpc_auth(url, tok):
+    async with aiohttp.ClientSession() as s:
+        async with s.post(url, json={
+            "jsonrpc": "2.0", "id": 1, "method": "initialize",
+            "params": {"protocolVersion": "2025-06-18", "capabilities": {}},
+        }, headers={"authorization": f"Bearer {tok}"}) as resp:
+            return resp.status, await resp.json(), dict(resp.headers)

@@ -51,16 +51,16 @@ class TestResponsesTranslator:
                                      "content": "plain string"}]
 
 
+from tests.test_tpuserve import tpuserve_url  # noqa: F401  (fixture)
+
+
 class TestResponsesEndToEnd:
-    def test_responses_through_gateway_to_tpuserve(self):
+    def test_responses_through_gateway_to_tpuserve(self, tpuserve_url):
         """Responses-SDK shape request served by the TPU engine via the
         gateway (chained translation)."""
         from aigw_tpu.config.model import Config
         from aigw_tpu.config.runtime import RuntimeConfig
         from aigw_tpu.gateway.server import run_gateway
-        from tests.test_tpuserve import tpuserve_url  # noqa: F401
-
-        pytest.importorskip("jax")
 
         async def main(tpu_url):
             cfg = Config.parse({
@@ -101,15 +101,36 @@ class TestResponsesEndToEnd:
             finally:
                 await runner.cleanup()
 
-        # reuse the module fixture machinery manually
-        import tests.test_tpuserve as tt
-        gen = tt.tpuserve_url.__wrapped__  # underlying generator function
-        it = gen()
-        url = next(it)
-        try:
-            asyncio.run(main(url))
-        finally:
-            try:
-                next(it)
-            except StopIteration:
-                pass
+        asyncio.run(main(tpuserve_url))
+
+
+class TestStreamingTruncation:
+    def test_length_reports_incomplete(self):
+        """Streaming truncation must surface status=incomplete like the
+        non-streaming path."""
+        t = get_translator(Endpoint.RESPONSES, S.OPENAI, S.TPUSERVE)
+        t.request({"model": "m", "input": "hi", "stream": True,
+                   "max_output_tokens": 2})
+        raw = (
+            b'data: {"choices":[{"index":0,"delta":{"content":"a"},'
+            b'"finish_reason":null}],"model":"m"}\n\n'
+            b'data: {"choices":[{"index":0,"delta":{},'
+            b'"finish_reason":"length"}],"model":"m"}\n\n'
+            b"data: [DONE]\n\n"
+        )
+        out = t.response_body(raw, False).body + t.response_body(b"", True).body
+        text = out.decode()
+        assert "response.completed" in text
+        completed = [json.loads(line[len("data: "):])
+                     for line in text.split("\n")
+                     if line.startswith("data: ")
+                     and "response.completed" in line]
+        assert completed[0]["response"]["status"] == "incomplete"
+
+    def test_bad_content_parts_schema_error(self):
+        from aigw_tpu.schemas.openai import SchemaError
+
+        t = get_translator(Endpoint.RESPONSES, S.OPENAI, S.TPUSERVE)
+        with pytest.raises(SchemaError, match="content parts"):
+            t.request({"model": "m", "input": [
+                {"type": "message", "content": ["plain string"]}]})
