@@ -160,6 +160,7 @@ def _install_all() -> None:
         tokenize,
         rerank,
         responses,
+        anthropic_hosted,
     )
 
 

@@ -1,0 +1,106 @@
+"""Hosted-Anthropic (Vertex rawPredict / Bedrock invoke) translators."""
+
+import base64
+import json
+
+import pytest
+
+from aigw_tpu.config.model import APISchemaName as S
+from aigw_tpu.translate import Endpoint, get_translator
+from aigw_tpu.translate.eventstream import encode_message
+from aigw_tpu.translate.sse import SSEParser
+
+CHAT = {"model": "claude-sonnet", "max_tokens": 16,
+        "messages": [{"role": "user", "content": "hi"}]}
+
+
+def events_of(body: bytes):
+    p = SSEParser()
+    return p.feed(body) + p.flush()
+
+
+class TestVertex:
+    def test_openai_front_request(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI,
+                           S.GCP_ANTHROPIC)
+        tx = t.request({"model": "claude-sonnet", "max_tokens": 16,
+                        "messages": [{"role": "user", "content": "hi"}]})
+        body = json.loads(tx.body)
+        assert "model" not in body
+        assert body["anthropic_version"] == "vertex-2023-10-16"
+        assert tx.path.endswith(
+            "/publishers/anthropic/models/claude-sonnet:rawPredict")
+        assert "{GCP_PROJECT}" in tx.path
+
+    def test_anthropic_front_stream_path(self):
+        t = get_translator(Endpoint.MESSAGES, S.ANTHROPIC, S.GCP_ANTHROPIC)
+        tx = t.request(dict(CHAT, stream=True))
+        assert tx.path.endswith(":streamRawPredict?alt=sse")
+        assert "stream" not in json.loads(tx.body)
+
+
+class TestBedrock:
+    def frame(self, payload: dict) -> bytes:
+        wrapped = {"bytes": base64.b64encode(
+            json.dumps(payload).encode()).decode()}
+        return encode_message(
+            {":message-type": "event", ":event-type": "chunk"},
+            json.dumps(wrapped).encode(),
+        )
+
+    def test_openai_front_request(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI,
+                           S.AWS_ANTHROPIC)
+        tx = t.request({"model": "anthropic.claude-v3", "max_tokens": 8,
+                        "messages": [{"role": "user", "content": "x"}],
+                        "stream": True})
+        body = json.loads(tx.body)
+        assert "model" not in body and "stream" not in body
+        assert body["anthropic_version"] == "bedrock-2023-05-31"
+        assert tx.path == (
+            "/model/anthropic.claude-v3/invoke-with-response-stream")
+
+    def test_streaming_decode_to_openai(self):
+        """Bedrock event-stream(b64 anthropic events) → OpenAI chunks."""
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI,
+                           S.AWS_ANTHROPIC)
+        t.request({"model": "m", "messages": [
+            {"role": "user", "content": "x"}], "stream": True})
+        raw = (
+            self.frame({"type": "message_start",
+                        "message": {"model": "claude",
+                                    "usage": {"input_tokens": 3,
+                                              "output_tokens": 0}}})
+            + self.frame({"type": "content_block_delta", "index": 0,
+                          "delta": {"type": "text_delta", "text": "yo"}})
+            + self.frame({"type": "message_delta",
+                          "delta": {"stop_reason": "end_turn"},
+                          "usage": {"output_tokens": 1}})
+            + self.frame({"type": "message_stop"})
+        )
+        out = b""
+        usage = None
+        for i in range(0, len(raw), 57):
+            rx = t.response_body(raw[i:i + 57], False)
+            out += rx.body
+            if rx.usage.total_tokens:
+                usage = rx.usage
+        out += t.response_body(b"", True).body
+        evs = events_of(out)
+        assert evs[-1].data == "[DONE]"
+        chunks = [json.loads(e.data) for e in evs if e.data != "[DONE]"]
+        text = "".join(c["choices"][0]["delta"].get("content", "")
+                       for c in chunks if c["choices"])
+        assert text == "yo"
+        assert usage.input_tokens == 3 and usage.output_tokens == 1
+
+    def test_anthropic_front_passthrough_stream(self):
+        """Anthropic-front: bedrock frames come back out as anthropic SSE."""
+        t = get_translator(Endpoint.MESSAGES, S.ANTHROPIC, S.AWS_ANTHROPIC)
+        t.request(dict(CHAT, stream=True))
+        raw = self.frame({"type": "content_block_delta", "index": 0,
+                          "delta": {"type": "text_delta", "text": "hej"}})
+        rx = t.response_body(raw, True)
+        evs = events_of(rx.body)
+        assert evs[0].event == "content_block_delta"
+        assert json.loads(evs[0].data)["delta"]["text"] == "hej"
