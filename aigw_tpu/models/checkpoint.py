@@ -63,6 +63,13 @@ _HF_MAP = [
      "l{}.wv", True),
     (re.compile(r"^model\.layers\.(\d+)\.self_attn\.o_proj\.weight$"),
      "l{}.wo", True),
+    # Qwen2 QKV biases
+    (re.compile(r"^model\.layers\.(\d+)\.self_attn\.q_proj\.bias$"),
+     "l{}.bq", False),
+    (re.compile(r"^model\.layers\.(\d+)\.self_attn\.k_proj\.bias$"),
+     "l{}.bk", False),
+    (re.compile(r"^model\.layers\.(\d+)\.self_attn\.v_proj\.bias$"),
+     "l{}.bv", False),
     (re.compile(r"^model\.layers\.(\d+)\.post_attention_layernorm\.weight$"),
      "l{}.mlp_norm", False),
     (re.compile(r"^model\.layers\.(\d+)\.mlp\.gate_proj\.weight$"),

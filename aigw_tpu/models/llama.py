@@ -45,6 +45,8 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     max_seq_len: int = 8192
     tie_embeddings: bool = False
+    # QKV projection bias (the Qwen2 family uses it; Llama doesn't)
+    attn_bias: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -56,6 +58,18 @@ LLAMA3_8B = LlamaConfig()
 LLAMA3_70B = LlamaConfig(
     dim=8192, n_layers=80, n_heads=64, n_kv_heads=8, ffn_dim=28672
 )
+# Qwen2 family: Llama skeleton + QKV bias (+ tied embeddings on small
+# sizes). Published architecture shapes.
+QWEN2_7B = LlamaConfig(
+    vocab_size=152064, dim=3584, n_layers=28, n_heads=28, n_kv_heads=4,
+    ffn_dim=18944, rope_theta=1e6, max_seq_len=32768, attn_bias=True,
+)
+QWEN2_05B = LlamaConfig(
+    vocab_size=151936, dim=896, n_layers=24, n_heads=14, n_kv_heads=2,
+    ffn_dim=4864, rope_theta=1e6, max_seq_len=32768, attn_bias=True,
+    tie_embeddings=True,
+)
+
 #: Tiny config for tests / CPU fake-chip mode (reference's testupstream role)
 TINY = LlamaConfig(
     vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
@@ -87,6 +101,10 @@ def init_params(
         p[f"l{i}.wq"] = dense((cfg.dim, cfg.n_heads * hd))
         p[f"l{i}.wk"] = dense((cfg.dim, cfg.n_kv_heads * hd))
         p[f"l{i}.wv"] = dense((cfg.dim, cfg.n_kv_heads * hd))
+        if cfg.attn_bias:
+            p[f"l{i}.bq"] = jnp.zeros((cfg.n_heads * hd,), dtype)
+            p[f"l{i}.bk"] = jnp.zeros((cfg.n_kv_heads * hd,), dtype)
+            p[f"l{i}.bv"] = jnp.zeros((cfg.n_kv_heads * hd,), dtype)
         p[f"l{i}.wo"] = dense((cfg.n_heads * hd, cfg.dim))
         p[f"l{i}.mlp_norm"] = jnp.ones((cfg.dim,), dtype)
         p[f"l{i}.w_gate"] = dense((cfg.dim, cfg.ffn_dim))
@@ -140,9 +158,12 @@ def _attention(
 def _project_qkv(p, i, x, positions, cfg):
     hd = cfg.head_dim
     B, S, _ = x.shape
-    q = (x @ p[f"l{i}.wq"]).reshape(B, S, cfg.n_heads, hd)
-    k = (x @ p[f"l{i}.wk"]).reshape(B, S, cfg.n_kv_heads, hd)
-    v = (x @ p[f"l{i}.wv"]).reshape(B, S, cfg.n_kv_heads, hd)
+    q, k, v = x @ p[f"l{i}.wq"], x @ p[f"l{i}.wk"], x @ p[f"l{i}.wv"]
+    if cfg.attn_bias:
+        q, k, v = q + p[f"l{i}.bq"], k + p[f"l{i}.bk"], v + p[f"l{i}.bv"]
+    q = q.reshape(B, S, cfg.n_heads, hd)
+    k = k.reshape(B, S, cfg.n_kv_heads, hd)
+    v = v.reshape(B, S, cfg.n_kv_heads, hd)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
     return q, k, v

@@ -76,5 +76,16 @@ def _register_mixtral() -> None:
 _register_mixtral()
 register_model(ModelSpec("llama-3-8b", "llama", llama.LLAMA3_8B,
                          weights="orbax:checkpoints/llama-3-8b"))
+register_model(ModelSpec("qwen2-7b", "llama", llama.QWEN2_7B,
+                         weights="orbax:checkpoints/qwen2-7b"))
+register_model(ModelSpec("qwen2-0.5b", "llama", llama.QWEN2_05B,
+                         weights="orbax:checkpoints/qwen2-0.5b"))
+register_model(ModelSpec(
+    "tiny-qwen", "llama",
+    llama.LlamaConfig(vocab_size=512, dim=64, n_layers=2, n_heads=4,
+                      n_kv_heads=2, ffn_dim=128, max_seq_len=512,
+                      rope_theta=10000.0, attn_bias=True,
+                      tie_embeddings=True),
+))
 register_model(ModelSpec("llama-3-70b", "llama", llama.LLAMA3_70B,
                          weights="orbax:checkpoints/llama-3-70b"))

@@ -32,6 +32,10 @@ def llama_param_specs(cfg: LlamaConfig) -> dict[str, P]:
         specs[f"l{i}.wq"] = P(None, "tp")  # column parallel (heads)
         specs[f"l{i}.wk"] = P(None, "tp")
         specs[f"l{i}.wv"] = P(None, "tp")
+        if getattr(cfg, "attn_bias", False):
+            specs[f"l{i}.bq"] = P("tp")
+            specs[f"l{i}.bk"] = P("tp")
+            specs[f"l{i}.bv"] = P("tp")
         specs[f"l{i}.wo"] = P("tp", None)  # row parallel
         specs[f"l{i}.mlp_norm"] = P(None)
         specs[f"l{i}.w_gate"] = P(None, "tp")
