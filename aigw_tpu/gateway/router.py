@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import random
 from dataclasses import dataclass, field
+from typing import Any
 
 from aigw_tpu.config.model import Route, RouteRule, RuleBackendRef
 from aigw_tpu.config.runtime import RuntimeConfig
@@ -43,14 +44,27 @@ class BackendSelector:
 
     Walks priority tiers in ascending order (priority 0 first). Within a
     tier, picks weighted-random among backends not yet tried — equivalent to
-    Envoy's weighted-cluster pick plus priority failover.
+    Envoy's weighted-cluster pick plus priority failover. Backends whose
+    circuit is open (outlier ejection) are deferred to a second pass so a
+    fully-ejected rule still gets a best-effort attempt.
     """
 
     rule: RouteRule
+    circuit: Any = None  # aigw_tpu.gateway.circuit.CircuitBreaker | None
     rng: random.Random = field(default_factory=random.Random)
     _tried: set[str] = field(default_factory=set)
+    _skip_open: bool = True
 
     def next_backend(self) -> RuleBackendRef | None:
+        ref = self._next_backend_pass()
+        if ref is None and self._skip_open and self.circuit is not None:
+            # every healthy candidate is exhausted: allow open-circuit
+            # backends rather than failing outright
+            self._skip_open = False
+            ref = self._next_backend_pass()
+        return ref
+
+    def _next_backend_pass(self) -> RuleBackendRef | None:
         for priority in sorted({b.priority for b in self.rule.backends}):
             tier = [
                 b
@@ -58,6 +72,11 @@ class BackendSelector:
                 if b.priority == priority
                 and b.backend not in self._tried
                 and b.weight > 0
+                and not (
+                    self._skip_open
+                    and self.circuit is not None
+                    and self.circuit.is_open(b.backend)
+                )
             ]
             if not tier:
                 continue

@@ -40,6 +40,7 @@ from aigw_tpu.config.model import (
 )
 from aigw_tpu.config.runtime import RuntimeBackend, RuntimeConfig
 from aigw_tpu.gateway.auth import AuthError
+from aigw_tpu.gateway.circuit import CircuitBreaker
 from aigw_tpu.gateway.costs import TokenUsage
 from aigw_tpu.gateway.mutators import apply_body_mutation, apply_header_mutation
 from aigw_tpu.gateway.picker import Endpoint as PickerEndpoint, EndpointPicker
@@ -155,6 +156,7 @@ class GatewayServer:
                            DEFAULT_HEADER_ATTRIBUTES)
         )
         self._cost_sink = cost_sink
+        self.circuit = CircuitBreaker()
         self._session: aiohttp.ClientSession | None = None
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
         for path in _ENDPOINTS:
@@ -251,7 +253,11 @@ class GatewayServer:
 
     # -- admin endpoints --------------------------------------------------
     async def _handle_health(self, _request: web.Request) -> web.Response:
-        return web.json_response({"status": "ok", "uuid": self._runtime.config.uuid})
+        return web.json_response({
+            "status": "ok",
+            "uuid": self._runtime.config.uuid,
+            "circuit": self.circuit.snapshot(),
+        })
 
     async def _handle_metrics(self, _request: web.Request) -> web.Response:
         return web.Response(body=self.metrics.export(),
@@ -361,7 +367,7 @@ class GatewayServer:
         req_metrics = RequestMetrics(
             metrics=self.metrics, operation=operation, request_model=model
         )
-        selector = BackendSelector(rule=match.rule)
+        selector = BackendSelector(rule=match.rule, circuit=self.circuit)
         route_name = match.route.name
 
         # tracing: continue the caller's trace, span per gateway request
@@ -427,6 +433,7 @@ class GatewayServer:
                 logger.warning(
                     "backend %s failed (%s), trying next", rb.backend.name, e
                 )
+                self.circuit.record_failure(rb.backend.name)
                 last_error = (e.status, e.client_body)
                 self.metrics.requests_total.labels(
                     route_name, rb.backend.name, str(e.status)
@@ -442,6 +449,7 @@ class GatewayServer:
                 return web.Response(
                     status=400, body=error_body(str(e)),
                     content_type="application/json")
+            self.circuit.record_success(rb.backend.name)
             return result
 
         req_metrics.finish(TokenUsage(), error_type="upstream_exhausted")

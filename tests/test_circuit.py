@@ -1,0 +1,138 @@
+"""Circuit-breaker (outlier ejection) tests."""
+
+from __future__ import annotations
+
+import asyncio
+
+import aiohttp
+import pytest
+
+from aigw_tpu.gateway.circuit import CircuitBreaker
+from aigw_tpu.config.model import Config
+from aigw_tpu.config.runtime import RuntimeConfig
+from aigw_tpu.gateway.server import run_gateway
+from tests.fakes import FakeUpstream, openai_chat_response
+
+
+class TestBreakerUnit:
+    def test_opens_after_threshold(self):
+        cb = CircuitBreaker(threshold=3, cooldown=10)
+        for _ in range(2):
+            cb.record_failure("b", now=0)
+        assert not cb.is_open("b", now=1)
+        cb.record_failure("b", now=2)
+        assert cb.is_open("b", now=3)
+        assert not cb.is_open("b", now=13)  # cooldown elapsed
+
+    def test_success_closes(self):
+        cb = CircuitBreaker(threshold=2, cooldown=10)
+        cb.record_failure("b", now=0)
+        cb.record_failure("b", now=1)
+        assert cb.is_open("b", now=2)
+        cb.record_success("b")
+        assert not cb.is_open("b", now=2)
+
+    def test_snapshot(self):
+        cb = CircuitBreaker(threshold=1, cooldown=5)
+        cb.record_failure("x", now=None)
+        snap = cb.snapshot()
+        assert "x" in snap
+
+
+class TestBreakerIntegration:
+    def test_open_circuit_skips_backend(self):
+        """After repeated failures the dead primary stops being attempted:
+        requests go straight to the fallback (no per-request probe)."""
+
+        async def main():
+            dead = FakeUpstream().on_json(
+                "/v1/chat/completions", {"error": "x"}, status=503
+            )
+            ok = FakeUpstream().on_json(
+                "/v1/chat/completions", openai_chat_response("live")
+            )
+            await dead.start()
+            await ok.start()
+            cfg = Config.parse({
+                "version": "v1",
+                "backends": [
+                    {"name": "dead", "schema": "OpenAI", "url": dead.url},
+                    {"name": "ok", "schema": "OpenAI", "url": ok.url},
+                ],
+                "routes": [{"name": "r", "rules": [{
+                    "models": ["m1"],
+                    "backends": [
+                        {"backend": "dead", "priority": 0},
+                        {"backend": "ok", "priority": 1},
+                    ],
+                }]}],
+            })
+            server, runner = await run_gateway(RuntimeConfig.build(cfg),
+                                               port=0)
+            server.circuit.threshold = 3
+            server.circuit.cooldown = 60
+            site = list(runner.sites)[0]
+            port = site._server.sockets[0].getsockname()[1]
+            url = f"http://127.0.0.1:{port}/v1/chat/completions"
+            payload = {"model": "m1",
+                       "messages": [{"role": "user", "content": "hi"}]}
+            try:
+                async with aiohttp.ClientSession() as s:
+                    for _ in range(6):
+                        async with s.post(url, json=payload) as resp:
+                            assert resp.status == 200
+                attempts_on_dead = len(dead.captured)
+                # circuit opened after 3 consecutive failures: the dead
+                # backend saw ~threshold attempts, not one per request
+                assert attempts_on_dead == 3
+                assert len(ok.captured) == 6
+                # health endpoint surfaces the ejection
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(
+                        f"http://127.0.0.1:{port}/health") as resp:
+                        health = await resp.json()
+                assert "dead" in health["circuit"]
+            finally:
+                await runner.cleanup()
+                await dead.stop()
+                await ok.stop()
+
+        asyncio.run(main())
+
+    def test_all_open_still_serves(self):
+        """Fail-static: when every backend's circuit is open, requests are
+        still attempted rather than rejected."""
+
+        async def main():
+            up = FakeUpstream().on_json(
+                "/v1/chat/completions", openai_chat_response("back")
+            )
+            await up.start()
+            cfg = Config.parse({
+                "version": "v1",
+                "backends": [{"name": "a", "schema": "OpenAI",
+                              "url": up.url}],
+                "routes": [{"name": "r", "rules": [
+                    {"models": ["m1"], "backends": ["a"]}]}],
+            })
+            server, runner = await run_gateway(RuntimeConfig.build(cfg),
+                                               port=0)
+            # force the circuit open
+            server.circuit.threshold = 1
+            server.circuit.record_failure("a")
+            assert server.circuit.is_open("a")
+            site = list(runner.sites)[0]
+            port = site._server.sockets[0].getsockname()[1]
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        f"http://127.0.0.1:{port}/v1/chat/completions",
+                        json={"model": "m1", "messages": [
+                            {"role": "user", "content": "x"}]},
+                    ) as resp:
+                        assert resp.status == 200
+            finally:
+                await runner.cleanup()
+                await up.stop()
+
+        asyncio.run(main())
