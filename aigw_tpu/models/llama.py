@@ -304,3 +304,62 @@ def hidden_states(
     w = valid[..., None].astype(jnp.float32)
     pooled = (x.astype(jnp.float32) * w).sum(1) / jnp.maximum(w.sum(1), 1.0)
     return pooled
+
+
+def prefill_suffix(
+    p: dict[str, jax.Array],
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [B, S] suffix tokens, right-padded
+    prefix_lens: jax.Array,  # [B] int32 — tokens already in the cache
+    seq_lens: jax.Array,  # [B] int32 — TOTAL length incl. prefix
+    kv_cache: jax.Array,
+    page_table: jax.Array,  # [B, max_pages]
+    page_size: int,
+    mlp=None,
+) -> tuple[jax.Array, jax.Array]:
+    """Prefill only the suffix of a prompt whose prefix K/V already sits in
+    cache pages (prefix caching / chunked prefill). Per layer: suffix K/V
+    are scattered into the pool first, then attention gathers the full
+    page window — so suffix queries see both the cached prefix and the
+    suffix itself under a global causal mask. With ``prefix_lens == 0``
+    this degenerates to (a gather-based) full prefill.
+    """
+    B, S = tokens.shape
+    T = page_table.shape[1] * page_size
+    n_slots = kv_cache.shape[2]
+    positions = prefix_lens[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    valid = positions < seq_lens[:, None]  # [B, S]
+
+    slot = (
+        jnp.take_along_axis(page_table, positions // page_size, axis=1)
+        * page_size
+        + positions % page_size
+    )
+    flat = jnp.where(valid, slot, n_slots)  # OOB → dropped by scatter
+
+    gslot = page_table[:, :, None] * page_size + jnp.arange(
+        page_size, dtype=jnp.int32
+    )
+    gslot = gslot.reshape(B, T)
+    t_idx = jnp.arange(T, dtype=jnp.int32)[None, :]
+
+    x = jnp.take(p["embed"], tokens, axis=0)
+    for i in range(cfg.n_layers):
+        h = rms_norm(x, p[f"l{i}.attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(p, i, h, positions, cfg)
+        kv_cache = kv_cache.at[i, 0, flat].set(k, mode="drop")
+        kv_cache = kv_cache.at[i, 1, flat].set(v, mode="drop")
+        k_all = kv_cache[i, 0][gslot]  # [B, T, Hkv, D]
+        v_all = kv_cache[i, 1][gslot]
+        # causal over global positions; padded queries masked by `valid`
+        mask = (t_idx[:, None, :] <= positions[:, :, None]) & valid[..., None]
+        attn = _attention(q, k_all, v_all, mask)
+        x = x + attn @ p[f"l{i}.wo"]
+        h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
+        x = x + (mlp or _mlp)(p, i, h)
+    x = rms_norm(x, p["norm_f"], cfg.norm_eps)
+    last = jnp.take_along_axis(
+        x, (seq_lens - prefix_lens - 1)[:, None, None].astype(jnp.int32),
+        axis=1,
+    )[:, 0]
+    return _logits(p, cfg, last), kv_cache

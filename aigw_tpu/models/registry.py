@@ -32,12 +32,16 @@ class ModelFns:
     prefill: Any
     decode_step: Any
     hidden_states: Any
+    # chunked prefill over cached prefix pages; None disables the engine's
+    # prefix cache for the family
+    prefill_suffix: Any = None
 
 
 def family_fns(family: str) -> ModelFns:
     if family == "llama":
         return ModelFns(llama.init_params, llama.prefill, llama.decode_step,
-                        llama.hidden_states)
+                        llama.hidden_states,
+                        prefill_suffix=llama.prefill_suffix)
     if family == "mixtral":
         from aigw_tpu.models import mixtral
 

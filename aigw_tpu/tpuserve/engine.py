@@ -36,7 +36,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from aigw_tpu.models import llama
-from aigw_tpu.tpuserve.kvcache import OutOfPagesError, PageAllocator
+from aigw_tpu.tpuserve.kvcache import (
+    OutOfPagesError,
+    PageAllocator,
+    PrefixCache,
+    RefcountedAllocator,
+)
 from aigw_tpu.tpuserve.sampling import SamplingParams, sample
 
 logger = logging.getLogger(__name__)
@@ -53,6 +58,9 @@ class EngineConfig:
     # program). Amortizes host↔device latency; tokens sampled after a
     # sequence's EOS within a window are discarded by the host.
     decode_steps_per_tick: int = 8
+    # Automatic prefix caching: full prompt pages are content-addressed and
+    # shared across requests (chat-history reuse → TTFT win).
+    enable_prefix_cache: bool = True
 
     def __post_init__(self) -> None:
         if self.max_seq_len % self.page_size != 0:
@@ -111,6 +119,8 @@ class EngineStats:
     tokens_generated: int = 0
     prefills: int = 0
     decode_steps: int = 0
+    prefix_cache_hits: int = 0
+    prefix_tokens_reused: int = 0
 
 
 class Engine:
@@ -133,7 +143,12 @@ class Engine:
         self.model_cfg = model_cfg
         self.cfg = cfg
         self.eos = eos_token_ids
-        self.allocator = PageAllocator(cfg.num_pages, cfg.page_size)
+        if cfg.enable_prefix_cache and self.fns.prefill_suffix is not None:
+            self.allocator = RefcountedAllocator(cfg.num_pages, cfg.page_size)
+            self.prefix_cache = PrefixCache(self.allocator, cfg.page_size)
+        else:
+            self.allocator = PageAllocator(cfg.num_pages, cfg.page_size)
+            self.prefix_cache = None
         self.stats = EngineStats()
         self.healthy = True
         self.last_error: str | None = None
@@ -204,6 +219,16 @@ class Engine:
                                        page_table, ps)
             return sample(logits, keys, temp, top_p, top_k), kv
 
+        model_prefill_suffix = self.fns.prefill_suffix
+
+        def _prefill_suffix_step(params, tokens, prefix_lens, seq_lens, kv,
+                                 page_table, keys, temp, top_p, top_k):
+            logits, kv = model_prefill_suffix(
+                params, mc, tokens, prefix_lens, seq_lens, kv, page_table,
+                ps,
+            )
+            return sample(logits, keys, temp, top_p, top_k), kv
+
         def _decode_scan(params, kv, state):
             """K fused decode+sample steps; sampled tokens feed forward
             on-device (no host round-trip inside the window)."""
@@ -233,6 +258,8 @@ class Engine:
             return sampled, state, kv
 
         self._prefill_fn = jax.jit(_prefill_step, donate_argnums=(3,))
+        self._prefill_suffix_fn = jax.jit(_prefill_suffix_step,
+                                          donate_argnums=(4,))
         self._decode_fn = jax.jit(_decode_scan, donate_argnums=(1, 2))
 
     # -- public API -------------------------------------------------------
@@ -340,42 +367,91 @@ class Engine:
             n = len(req.prompt)
             total = min(n + req.max_tokens, self.cfg.max_seq_len)
             seq_id = next(self._seq_ids)
+            ps = self.cfg.page_size
+
+            # prefix cache: adopt the longest cached page-prefix (capped so
+            # at least one suffix token remains to produce first logits)
+            cached_pages: list[int] = []
+            chain_keys: list = []
+            if self.prefix_cache is not None and n > 1:
+                hits, hit_pages, chain_keys = self.prefix_cache.lookup(
+                    req.prompt
+                )
+                hits = min(hits, (n - 1) // ps)
+                cached_pages = hit_pages[:hits]
+            prefix_len = len(cached_pages) * ps
+
             try:
-                self.allocator.allocate(seq_id, total)
+                if cached_pages:
+                    self.allocator.adopt(seq_id, cached_pages)
+                    extra = self.allocator.pages_for(total) - len(cached_pages)
+                    if extra > 0:
+                        self.allocator.allocate_extra(seq_id, extra)
+                else:
+                    self.allocator.allocate(seq_id, total)
             except OutOfPagesError:
+                self.allocator.free(seq_id)
                 # put it back and wait for a slot to free pages
                 self._requeue_front(req)
                 break
             pages = self.allocator.pages(seq_id)
             req.id = seq_id
 
+            suffix = req.prompt[prefix_len:]
+            ns = len(suffix)
             # bucketed padded length
             S = self.cfg.min_prefill_bucket
-            while S < n:
+            while S < ns:
                 S *= 2
             S = min(S, self.cfg.max_seq_len)
             tokens = np.zeros((1, S), np.int32)
-            tokens[0, :n] = req.prompt
+            tokens[0, :ns] = suffix
             pt = np.zeros((1, self.cfg.max_pages_per_seq), np.int32)
             pt[0, : len(pages)] = pages
 
             key = np.array([[req.sampling.seed or seq_id, 0]], np.uint32)
-            t0 = time.monotonic()
-            next_tok, self.kv_cache = self._prefill_fn(
-                self.params,
-                jnp.asarray(tokens),
-                jnp.asarray([n], jnp.int32),
-                self.kv_cache,
-                jnp.asarray(pt),
+            sampling_args = (
                 jnp.asarray(key),
                 jnp.asarray([req.sampling.temperature], jnp.float32),
                 jnp.asarray([req.sampling.top_p], jnp.float32),
                 jnp.asarray([req.sampling.top_k], jnp.int32),
             )
+            t0 = time.monotonic()
+            if prefix_len:
+                self.stats.prefix_cache_hits += 1
+                self.stats.prefix_tokens_reused += prefix_len
+                # bucket the gather window like decode: pow2 pages covering
+                # the sequence, not the full max_seq_len window
+                need = self.allocator.pages_for(total)
+                bucket = 1
+                while bucket < need:
+                    bucket *= 2
+                bucket = min(bucket, self.cfg.max_pages_per_seq)
+                next_tok, self.kv_cache = self._prefill_suffix_fn(
+                    self.params,
+                    jnp.asarray(tokens),
+                    jnp.asarray([prefix_len], jnp.int32),
+                    jnp.asarray([n], jnp.int32),
+                    self.kv_cache,
+                    jnp.asarray(pt[:, :bucket]),
+                    *sampling_args,
+                )
+            else:
+                next_tok, self.kv_cache = self._prefill_fn(
+                    self.params,
+                    jnp.asarray(tokens),
+                    jnp.asarray([n], jnp.int32),
+                    self.kv_cache,
+                    jnp.asarray(pt),
+                    *sampling_args,
+                )
             tok = int(next_tok[0])
             self.stats.prefills += 1
-            logger.debug("prefill seq=%d len=%d bucket=%d %.1fms",
-                         seq_id, n, S, 1e3 * (time.monotonic() - t0))
+            if self.prefix_cache is not None and chain_keys:
+                self.prefix_cache.insert(chain_keys, pages)
+            logger.debug("prefill seq=%d len=%d prefix=%d bucket=%d %.1fms",
+                         seq_id, n, prefix_len, S,
+                         1e3 * (time.monotonic() - t0))
 
             # pos=n-1: _emit_token advances it to n, the write position of
             # the just-sampled first token.

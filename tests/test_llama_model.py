@@ -199,3 +199,29 @@ class TestQwenVariant:
             assert len(toks) >= 1
         finally:
             eng.stop()
+
+
+def test_prefill_suffix_matches_full(params):
+    """Suffix prefill over cached prefix pages == one-shot full prefill."""
+    tokens = jax.random.randint(jax.random.PRNGKey(9), (1, 40), 0,
+                                CFG.vocab_size)
+    pt = jnp.arange(4, dtype=jnp.int32)[None, :]  # 4 pages × 16 = 64 slots
+
+    full_logits, _ = llama.prefill(
+        params, CFG, tokens, jnp.array([40]), fresh_cache(), pt, PAGE
+    )
+
+    # cache the first 2 pages (32 tokens) via normal prefill, then do the
+    # remaining 8 tokens through prefill_suffix
+    cache = fresh_cache()
+    _, cache = llama.prefill(
+        params, CFG, tokens[:, :32], jnp.array([32]), cache, pt, PAGE
+    )
+    suffix_logits, _ = llama.prefill_suffix(
+        params, CFG, tokens[:, 32:], jnp.array([32], jnp.int32),
+        jnp.array([40], jnp.int32), cache, pt, PAGE,
+    )
+    np.testing.assert_allclose(
+        np.asarray(full_logits), np.asarray(suffix_logits),
+        rtol=3e-2, atol=3e-2,
+    )
