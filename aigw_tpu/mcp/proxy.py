@@ -366,6 +366,14 @@ class MCPProxy:
                 return web.json_response(
                     await self._aggregate_list(method, msg_id, sessions)
                 )
+            if method in ("prompts/get", "completion/complete"):
+                return web.json_response(
+                    await self._route_by_name(payload, sessions)
+                )
+            if method == "resources/read":
+                return web.json_response(
+                    await self._route_resource(payload, sessions)
+                )
             if method == "logging/setLevel":
                 await self._broadcast(payload, sessions)
                 return web.json_response(
@@ -588,6 +596,56 @@ class MCPProxy:
         routed = dict(payload, params=dict(params, name=tool))
         resp, _ = await self._call_backend(backend, routed, sid)
         return resp or _rpc_error(msg_id, -32603, "no response from backend")
+
+    async def _route_by_name(
+        self, payload: dict[str, Any], sessions: dict[str, str]
+    ) -> dict[str, Any]:
+        """prompts/get + completion/complete: route by the
+        ``backend__name`` prefix (same contract as tools/call)."""
+        msg_id = payload.get("id")
+        params = payload.get("params") or {}
+        # completion/complete nests the name under ref.name / ref.uri
+        name = params.get("name", "")
+        ref = params.get("ref") or {}
+        if not name and isinstance(ref, dict):
+            name = ref.get("name", "")
+        backend_name, sep, bare = name.partition(TOOL_SEP)
+        backend = next(
+            (b for b in self.cfg.backends if b.name == backend_name), None
+        )
+        if not sep or backend is None:
+            return _rpc_error(msg_id, -32602, f"unknown name {name!r}")
+        routed_params = dict(params)
+        if params.get("name"):
+            routed_params["name"] = bare
+        elif isinstance(ref, dict) and ref.get("name"):
+            routed_params["ref"] = dict(ref, name=bare)
+        routed = dict(payload, params=routed_params)
+        resp, _ = await self._call_backend(
+            backend, routed, sessions.get(backend.name, "")
+        )
+        return resp or _rpc_error(msg_id, -32603, "no response from backend")
+
+    async def _route_resource(
+        self, payload: dict[str, Any], sessions: dict[str, str]
+    ) -> dict[str, Any]:
+        """resources/read: route by URI. Aggregated resource listings are
+        not renamed (URIs are globally unique), so try each backend that
+        has a session until one answers without error."""
+        msg_id = payload.get("id")
+        last: dict[str, Any] | None = None
+        for b in self.cfg.backends:
+            sid = sessions.get(b.name)
+            if not sid:
+                continue
+            try:
+                resp, _ = await self._call_backend(b, payload, sid)
+            except (aiohttp.ClientError, RuntimeError):
+                continue
+            if resp is not None and "error" not in resp:
+                return resp
+            last = resp
+        return last or _rpc_error(msg_id, -32602, "resource not found")
 
     async def _aggregate_list(
         self, method: str, msg_id: Any, sessions: dict[str, str]

@@ -21,6 +21,7 @@ class ModelSpec:
     config: Any
     weights: str = "random"  # "random" | "orbax:<dir>" | "hf:<dir>"
     tokenizer: str = "byte"  # "byte" | path to tokenizer.json
+    chat_template: str = "llama3"  # "llama3" | "chatml"
 
 
 @dataclass(frozen=True)
@@ -81,9 +82,11 @@ _register_mixtral()
 register_model(ModelSpec("llama-3-8b", "llama", llama.LLAMA3_8B,
                          weights="orbax:checkpoints/llama-3-8b"))
 register_model(ModelSpec("qwen2-7b", "llama", llama.QWEN2_7B,
-                         weights="orbax:checkpoints/qwen2-7b"))
+                         weights="orbax:checkpoints/qwen2-7b",
+                         chat_template="chatml"))
 register_model(ModelSpec("qwen2-0.5b", "llama", llama.QWEN2_05B,
-                         weights="orbax:checkpoints/qwen2-0.5b"))
+                         weights="orbax:checkpoints/qwen2-0.5b",
+                         chat_template="chatml"))
 register_model(ModelSpec(
     "tiny-qwen", "llama",
     llama.LlamaConfig(vocab_size=512, dim=64, n_layers=2, n_heads=4,

@@ -64,6 +64,7 @@ class TPUServeServer:
         self.fns = family_fns(spec.family)
         self.model_cfg = spec.config
         self.tokenizer = load_tokenizer(spec.tokenizer)
+        self.chat_template = spec.chat_template
         self.metrics = metrics or GenAIMetrics()
 
         mesh = None
@@ -156,7 +157,8 @@ class TPUServeServer:
         except oai.SchemaError as e:
             return web.Response(status=400, body=oai.error_body(str(e)),
                                 content_type="application/json")
-        prompt = apply_chat_template(body["messages"], self.tokenizer)
+        prompt = apply_chat_template(body["messages"], self.tokenizer,
+                                     self.chat_template)
         return await self._generate(request, body, prompt, chat=True)
 
     async def _completions(self, request: web.Request) -> web.StreamResponse:
@@ -424,7 +426,8 @@ class TPUServeServer:
             return web.Response(status=400, body=oai.error_body(str(e)),
                                 content_type="application/json")
         if isinstance(body.get("messages"), list):
-            ids = apply_chat_template(body["messages"], self.tokenizer)
+            ids = apply_chat_template(body["messages"], self.tokenizer,
+                                      self.chat_template)
         else:
             ids = self.tokenizer.encode(str(body.get("prompt", "")))
         return web.json_response(

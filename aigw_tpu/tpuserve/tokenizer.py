@@ -62,13 +62,14 @@ def load_tokenizer(source: str) -> Tokenizer:
 
 
 def apply_chat_template(
-    messages: list[dict[str, Any]], tokenizer: Tokenizer
+    messages: list[dict[str, Any]], tokenizer: Tokenizer,
+    template: str = "llama3",
 ) -> list[int]:
     """Render an OpenAI-style message list to prompt tokens.
 
-    Uses the Llama-3 header layout for HF tokenizers and a plain textual
-    layout for the byte tokenizer. (Template strings are the public Llama-3
-    prompt format.)
+    ``template``: "llama3" (header-id layout), "chatml" (Qwen families),
+    or the plain textual layout for the byte tokenizer. (Template strings
+    are the public prompt formats of the respective model cards.)
     """
     from aigw_tpu.schemas.openai import message_content_text
 
@@ -79,6 +80,15 @@ def apply_chat_template(
                          f"{message_content_text(m.get('content'))}\n")
         parts.append("<assistant>: ")
         return tokenizer.encode("".join(parts))
+
+    if template == "chatml":
+        text = ""
+        for m in messages:
+            role = m.get("role", "user")
+            content = message_content_text(m.get("content"))
+            text += f"<|im_start|>{role}\n{content}<|im_end|>\n"
+        text += "<|im_start|>assistant\n"
+        return tokenizer.encode(text)
 
     text = "<|begin_of_text|>"
     for m in messages:

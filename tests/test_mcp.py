@@ -641,3 +641,73 @@ async def _rpc_auth(url, tok):
             "params": {"protocolVersion": "2025-06-18", "capabilities": {}},
         }, headers={"authorization": f"Bearer {tok}"}) as resp:
             return resp.status, await resp.json(), dict(resp.headers)
+
+
+class TestPromptsResources:
+    def test_prompts_get_routed(self):
+        async def main():
+            from aiohttp import web as _web
+
+            class PromptMCP(FakeMCPServer):
+                async def _handle(self, request):
+                    msg = json.loads(await request.read())
+                    if msg.get("method") == "prompts/list":
+                        return _web.json_response(
+                            {"jsonrpc": "2.0", "id": msg["id"], "result": {
+                                "prompts": [{"name": "greet"}]}})
+                    if msg.get("method") == "prompts/get":
+                        name = msg["params"]["name"]
+                        return _web.json_response(
+                            {"jsonrpc": "2.0", "id": msg["id"], "result": {
+                                "messages": [{"role": "user", "content": {
+                                    "type": "text",
+                                    "text": f"prompt:{name}"}}]}})
+                    if msg.get("method") == "resources/read":
+                        uri = msg["params"]["uri"]
+                        if uri != "file://known":
+                            return _web.json_response(
+                                {"jsonrpc": "2.0", "id": msg["id"],
+                                 "error": {"code": -32002,
+                                           "message": "nope"}})
+                        return _web.json_response(
+                            {"jsonrpc": "2.0", "id": msg["id"], "result": {
+                                "contents": [{"uri": uri, "text": "data"}]}})
+                    return await super()._handle(request)
+
+            s1 = await PromptMCP("alpha", []).start()
+            cfg = MCPConfig(backends=(MCPBackend(name="alpha", url=s1.url),),
+                            session_seed="t")
+            proxy = MCPProxy(cfg)
+            app = web.Application()
+            proxy.register(app)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            url = f"http://127.0.0.1:{port}/mcp"
+            try:
+                _, _, headers = await _rpc(
+                    url, "initialize",
+                    {"protocolVersion": "2025-06-18", "capabilities": {}})
+                session = headers["mcp-session-id"]
+                _, body, _ = await _rpc(url, "prompts/list", session=session)
+                assert body["result"]["prompts"][0]["name"] == "alpha__greet"
+                _, body, _ = await _rpc(url, "prompts/get",
+                                        {"name": "alpha__greet"},
+                                        session=session)
+                assert body["result"]["messages"][0]["content"]["text"] == \
+                    "prompt:greet"
+                _, body, _ = await _rpc(url, "resources/read",
+                                        {"uri": "file://known"},
+                                        session=session)
+                assert body["result"]["contents"][0]["text"] == "data"
+                _, body, _ = await _rpc(url, "resources/read",
+                                        {"uri": "file://missing"},
+                                        session=session)
+                assert "error" in body
+            finally:
+                await runner.cleanup()
+                await s1.stop()
+
+        asyncio.run(main())

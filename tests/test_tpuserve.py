@@ -397,3 +397,28 @@ class TestPrefixCache:
             assert eng.allocator.available_pages > 0
         finally:
             eng.stop()
+
+
+class TestChatTemplates:
+    def test_chatml_template(self):
+        from aigw_tpu.tpuserve.tokenizer import (
+            HFTokenizer, apply_chat_template,
+        )
+
+        class FakeHF:
+            bos_id, eos_id = 0, 1
+
+            def encode(self, text):
+                self.last = text
+                return [1, 2]
+
+            def decode(self, ids):
+                return ""
+
+        tok = FakeHF()
+        apply_chat_template(
+            [{"role": "system", "content": "s"},
+             {"role": "user", "content": "u"}], tok, "chatml")
+        assert tok.last == (
+            "<|im_start|>system\ns<|im_end|>\n"
+            "<|im_start|>user\nu<|im_end|>\n<|im_start|>assistant\n")
