@@ -19,6 +19,8 @@ import struct
 import zlib
 from dataclasses import dataclass, field
 
+from aigw_tpu.utils import native as _native
+
 
 @dataclass
 class EventStreamMessage:
@@ -43,6 +45,21 @@ class EventStreamParser:
     def feed(self, chunk: bytes) -> list[EventStreamMessage]:
         self._buf += chunk
         out: list[EventStreamMessage] = []
+        # native fast path: frame boundaries + CRCs validated in C++
+        # (native/eventstream_scan.cpp); headers still parse in Python
+        while True:
+            scan = _native.es_scan(self._buf)
+            if scan is None:
+                break
+            frames, tail, truncated = scan
+            for off, total, hlen in frames:
+                headers = _parse_headers(self._buf[off + 12 : off + 12 + hlen])
+                payload = self._buf[off + 12 + hlen : off + total - 4]
+                out.append(EventStreamMessage(headers=headers,
+                                              payload=payload))
+            self._buf = self._buf[tail:]
+            if not truncated:
+                return out
         while len(self._buf) >= 16:
             total_len, headers_len, prelude_crc = struct.unpack_from(
                 ">III", self._buf

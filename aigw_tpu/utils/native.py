@@ -35,8 +35,16 @@ def _load():
             ctypes.c_int,
             ctypes.POINTER(ctypes.c_size_t),
         ]
+        lib.aigw_es_scan.restype = ctypes.c_int
+        lib.aigw_es_scan.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
         _LIB = lib
-    except OSError:
+    except (OSError, AttributeError):
         _LIB = None
     return _LIB
 
@@ -69,4 +77,33 @@ def sse_scan(buf: bytes) -> tuple[list[tuple[int, int]], int, bool] | None:
         [(out[2 * i], out[2 * i + 1]) for i in range(n)],
         tail.value,
         n >= _MAX_EVENTS,
+    )
+
+
+_MAX_FRAMES = 1024
+_es_out = None
+_es_tail = None
+
+
+def es_scan(buf: bytes):
+    """AWS event-stream frame scan: returns
+    ([(offset, total_len, headers_len), ...], tail, truncated), None when
+    the native library is unavailable, or raises ValueError on CRC error —
+    mirroring aigw_tpu/translate/eventstream.py semantics."""
+    global _es_out, _es_tail
+    lib = _load()
+    if lib is None:
+        return None
+    if _es_out is None:
+        _es_out = (ctypes.c_int32 * (3 * _MAX_FRAMES))()
+        _es_tail = ctypes.c_size_t(0)
+    out, tail = _es_out, _es_tail
+    n = lib.aigw_es_scan(buf, len(buf), out, _MAX_FRAMES,
+                         ctypes.byref(tail))
+    if n < 0:
+        raise ValueError("event-stream CRC/framing error")
+    return (
+        [(out[3 * i], out[3 * i + 1], out[3 * i + 2]) for i in range(n)],
+        tail.value,
+        n >= _MAX_FRAMES,
     )

@@ -61,3 +61,48 @@ class TestNativeSSE:
             nat._LIB = old
         assert native_events == python_events
         assert len(native_events) >= 140  # ~1/4 are comments, dropped by design
+
+
+@pytest.mark.skipif(not native.available(),
+                    reason="libaigw_native.so not built")
+class TestNativeEventStream:
+    def test_parity_with_python(self):
+        import json
+
+        from aigw_tpu.translate.eventstream import (
+            EventStreamParser, encode_message,
+        )
+        import aigw_tpu.utils.native as nat
+
+        frames = b"".join(
+            encode_message({":event-type": f"e{i}", ":message-type": "event"},
+                           json.dumps({"i": i}).encode())
+            for i in range(50)
+        )
+
+        def run(chunks):
+            p = EventStreamParser()
+            out = []
+            for c in chunks:
+                out.extend(p.feed(c))
+            return [(m.event_type, m.payload) for m in out]
+
+        chunks = [frames[i:i + 37] for i in range(0, len(frames), 37)]
+        native_msgs = run(chunks)
+        old, nat._LIB = nat._LIB, None
+        try:
+            python_msgs = run(chunks)
+        finally:
+            nat._LIB = old
+        assert native_msgs == python_msgs
+        assert len(native_msgs) == 50
+
+    def test_crc_error_raised(self):
+        from aigw_tpu.translate.eventstream import (
+            EventStreamParser, encode_message,
+        )
+
+        good = encode_message({":event-type": "x"}, b"{}")
+        corrupted = good[:-1] + bytes([good[-1] ^ 0xFF])
+        with pytest.raises(ValueError, match="CRC"):
+            EventStreamParser().feed(corrupted)
