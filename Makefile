@@ -1,0 +1,24 @@
+# Developer entry points (tests force the CPU fake-chip platform through
+# tests/conftest.py; bench runs on the real TPU).
+
+.PHONY: test test-fast native bench gateway-bench clean
+
+test: native
+	python -m pytest tests/ -q
+
+test-fast: native
+	python -m pytest tests/ -q -x --ignore=tests/test_llama_model.py \
+	  --ignore=tests/test_parallel.py --ignore=tests/test_mixtral.py \
+	  --ignore=tests/test_ring_attention.py --ignore=tests/test_pipeline.py
+
+native:
+	$(MAKE) -C native
+
+bench:
+	python bench.py
+
+gateway-bench:
+	python benchmarks/gateway_overhead.py
+
+clean:
+	$(MAKE) -C native clean
