@@ -33,6 +33,13 @@ def main(argv: list[str] | None = None) -> int:
     p_val = sub.add_parser("validate", help="validate a config file")
     p_val.add_argument("config")
 
+    p_tr = sub.add_parser(
+        "translate",
+        help="compile a config and print the normalized runtime view "
+             "(resolved translator pairs, auth kinds, quota rules) as JSON",
+    )
+    p_tr.add_argument("config")
+
     p_conv = sub.add_parser(
         "convert", help="import a local HF safetensors dir into an orbax "
                         "checkpoint usable by tpuserve")
@@ -71,6 +78,61 @@ def main(argv: list[str] | None = None) -> int:
             f"OK: {len(cfg.backends)} backends, {len(cfg.routes)} routes, "
             f"{len(cfg.models)} models, {len(cfg.llm_request_costs)} cost metrics"
         )
+        return 0
+
+    if args.cmd == "translate":
+        import json as _json
+
+        from aigw_tpu.config.model import (
+            APISchemaName,
+            ConfigError,
+            load_config,
+        )
+        from aigw_tpu.config.runtime import RuntimeConfig
+        from aigw_tpu.translate import Endpoint, TranslationError, get_translator
+
+        try:
+            cfg = load_config(args.config)
+            rc = RuntimeConfig.build(cfg)
+        except ConfigError as e:
+            print(f"INVALID: {e}", file=sys.stderr)
+            return 1
+        routes = []
+        for route in cfg.routes:
+            rules = []
+            for rule in route.rules:
+                backends = []
+                for ref in rule.backends:
+                    b = cfg.backend(ref.backend)
+                    try:
+                        # probe: is OpenAI-front chat translatable here?
+                        get_translator(Endpoint.CHAT_COMPLETIONS,
+                                       APISchemaName.OPENAI, b.schema.name)
+                        chat_ok = True
+                    except TranslationError:
+                        chat_ok = False
+                    backends.append({
+                        "backend": ref.backend,
+                        "weight": ref.weight,
+                        "priority": ref.priority,
+                        "schema": b.schema.name.value,
+                        "auth": b.auth.kind.value,
+                        "chat_translation": chat_ok,
+                    })
+                rules.append({
+                    "models": list(rule.models),
+                    "model_prefixes": list(rule.model_prefixes),
+                    "backends": backends,
+                })
+            routes.append({"name": route.name, "rules": rules})
+        print(_json.dumps({
+            "version": cfg.version,
+            "routes": routes,
+            "models": [m.name for m in cfg.models],
+            "costs": [c.to_dict() for c in cfg.llm_request_costs],
+            "quotas": len(rc.rate_limiter.rules),
+            "mcp_backends": len((cfg.mcp or {}).get("backends", [])),
+        }, indent=2))
         return 0
 
     if args.cmd == "convert":

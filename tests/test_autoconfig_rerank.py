@@ -176,3 +176,25 @@ class TestSamplingPropagation:
         assert span.context.traceparent().endswith("-00")
         span.end()
         assert capsys.readouterr().err.strip() == ""  # nothing exported
+
+
+class TestTranslateCLI:
+    def test_translate_subcommand(self, capsys, tmp_path):
+        import json
+
+        from aigw_tpu.cli import main
+
+        rc = main(["translate", "examples/provider-fallback/config.yaml"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        backends = out["routes"][0]["rules"][0]["backends"]
+        assert [b["backend"] for b in backends] == ["tpu", "openai",
+                                                    "anthropic"]
+        assert all(b["chat_translation"] for b in backends)
+
+    def test_translate_invalid(self, capsys, tmp_path):
+        from aigw_tpu.cli import main
+
+        p = tmp_path / "bad.yaml"
+        p.write_text("version: v9")
+        assert main(["translate", str(p)]) == 1

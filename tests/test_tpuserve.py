@@ -422,3 +422,37 @@ class TestChatTemplates:
         assert tok.last == (
             "<|im_start|>system\ns<|im_end|>\n"
             "<|im_start|>user\nu<|im_end|>\n<|im_start|>assistant\n")
+
+
+class TestStopSequences:
+    def test_stop_string_truncates(self, tpuserve_url):
+        """The OpenAI `stop` parameter cuts generation at the sequence and
+        reports finish_reason=stop (reference: vLLM-compatible serving)."""
+
+        async def main():
+            async with aiohttp.ClientSession() as s:
+                # run once unconstrained to learn the greedy continuation
+                async with s.post(tpuserve_url + "/v1/chat/completions",
+                                  json={"model": "tiny-random",
+                                        "messages": [{"role": "user",
+                                                      "content": "q"}],
+                                        "max_tokens": 8,
+                                        "temperature": 0}) as resp:
+                    base = (await resp.json())["choices"][0]["message"][
+                        "content"]
+                if len(base) < 2:
+                    return  # degenerate tiny-random output; nothing to cut
+                stop = base[1]  # second character of the greedy output
+                async with s.post(tpuserve_url + "/v1/chat/completions",
+                                  json={"model": "tiny-random",
+                                        "messages": [{"role": "user",
+                                                      "content": "q"}],
+                                        "max_tokens": 8, "temperature": 0,
+                                        "stop": [stop]}) as resp:
+                    got = await resp.json()
+                text = got["choices"][0]["message"]["content"]
+                assert stop not in text
+                assert got["choices"][0]["finish_reason"] == "stop"
+                assert len(text) < len(base)
+
+        asyncio.run(main())
