@@ -118,7 +118,45 @@ def engine_tokens_per_sec(params) -> float:
         eng.stop()
 
 
+def _chip_responsive(timeout_s: float = 180.0) -> bool:
+    """The axon tunnel can go down entirely (observed 2026-07-28); probe
+    with a watchdog so the bench prints an honest line instead of hanging
+    the driver."""
+    import threading
+
+    ok = threading.Event()
+
+    def probe():
+        try:
+            x = jnp.ones((128, 128)) @ jnp.ones((128, 128))
+            x.block_until_ready()
+            ok.set()
+        except Exception:
+            pass
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    return ok.wait(timeout_s)
+
+
 def main() -> None:
+    if not _chip_responsive():
+        print(
+            json.dumps(
+                {
+                    "metric": (
+                        "decode tokens/sec/chip — TPU tunnel unresponsive at "
+                        "bench time (device probe timed out; last recorded "
+                        "run: 780-790 tok/s, vs_baseline 1.11-1.21, see "
+                        "BASELINE.md)"
+                    ),
+                    "value": 0,
+                    "unit": "tokens/s",
+                    "vs_baseline": 0,
+                }
+            )
+        )
+        return
     params = llama.init_params(jax.random.PRNGKey(0), BENCH_CFG)
     jax.block_until_ready(params)
     raw = raw_jax_tokens_per_sec(params)
