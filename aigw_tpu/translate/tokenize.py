@@ -34,10 +34,17 @@ def _tokenize_messages(body: dict[str, Any]) -> list[dict[str, Any]]:
 
 
 class TokenizeToAnthropicCount(Translator):
-    """vLLM /tokenize → Anthropic /v1/messages/count_tokens."""
+    """vLLM /tokenize → Anthropic count-tokens APIs.
 
-    def __init__(self, *, model_name_override: str = "", **_: object):
+    Hosted variants use their own envelopes: Vertex serves count-tokens
+    through ``publishers/anthropic/models/count-tokens:rawPredict`` (model
+    moves into the body); plain Anthropic uses
+    ``/v1/messages/count_tokens``."""
+
+    def __init__(self, *, model_name_override: str = "",
+                 variant: str = "anthropic", **_: object):
         self._override = model_name_override
+        self._variant = variant
 
     def request(self, body: dict[str, Any]) -> RequestTx:
         from aigw_tpu.translate.openai_anthropic import (
@@ -51,9 +58,14 @@ class TokenizeToAnthropicCount(Translator):
         }
         if system:
             out["system"] = system
-        return RequestTx(
-            body=json.dumps(out).encode(), path="/v1/messages/count_tokens"
-        )
+        if self._variant == "vertex":
+            path = (
+                "/v1/projects/{GCP_PROJECT}/locations/{GCP_REGION}"
+                "/publishers/anthropic/models/count-tokens:rawPredict"
+            )
+        else:
+            path = "/v1/messages/count_tokens"
+        return RequestTx(body=json.dumps(out).encode(), path=path)
 
     def response_body(self, chunk: bytes, end_of_stream: bool) -> ResponseTx:
         if not end_of_stream:
@@ -101,11 +113,25 @@ class TokenizeToGeminiCount(Translator):
         return ResponseTx(body=json.dumps(out).encode(), usage=usage)
 
 
-for _schema in (APISchemaName.ANTHROPIC, APISchemaName.GCP_ANTHROPIC,
-                APISchemaName.AWS_ANTHROPIC):
-    register_translator(
-        Endpoint.TOKENIZE, APISchemaName.OPENAI, _schema, TokenizeToAnthropicCount
+register_translator(
+    Endpoint.TOKENIZE, APISchemaName.OPENAI, APISchemaName.ANTHROPIC,
+    TokenizeToAnthropicCount,
+)
+
+
+def _vertex_count_factory(*, model_name_override: str = "", **_: object):
+    return TokenizeToAnthropicCount(
+        model_name_override=model_name_override, variant="vertex"
     )
+
+
+register_translator(
+    Endpoint.TOKENIZE, APISchemaName.OPENAI, APISchemaName.GCP_ANTHROPIC,
+    _vertex_count_factory,
+)
+# AWS-hosted Anthropic exposes no count-tokens API through Bedrock invoke;
+# leaving the pair unregistered yields a clear TranslationError instead of
+# a wrong upstream URL.
 register_translator(
     Endpoint.TOKENIZE,
     APISchemaName.OPENAI,

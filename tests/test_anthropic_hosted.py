@@ -104,3 +104,18 @@ class TestBedrock:
         evs = events_of(rx.body)
         assert evs[0].event == "content_block_delta"
         assert json.loads(evs[0].data)["delta"]["text"] == "hej"
+
+
+class TestHostedCountTokens:
+    def test_vertex_count_tokens_path(self):
+        t = get_translator(Endpoint.TOKENIZE, S.OPENAI, S.GCP_ANTHROPIC)
+        tx = t.request({"model": "claude-sonnet", "prompt": "hello"})
+        assert tx.path.endswith(
+            "/publishers/anthropic/models/count-tokens:rawPredict")
+        assert json.loads(tx.body)["model"] == "claude-sonnet"
+
+    def test_bedrock_count_tokens_unregistered(self):
+        from aigw_tpu.translate import TranslationError
+
+        with pytest.raises(TranslationError):
+            get_translator(Endpoint.TOKENIZE, S.OPENAI, S.AWS_ANTHROPIC)
