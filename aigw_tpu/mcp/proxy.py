@@ -604,11 +604,15 @@ class MCPProxy:
         ``backend__name`` prefix (same contract as tools/call)."""
         msg_id = payload.get("id")
         params = payload.get("params") or {}
-        # completion/complete nests the name under ref.name / ref.uri
+        # completion/complete nests the name under ref.name; resource-
+        # template refs carry ref.uri instead (URIs aren't prefixed —
+        # route them like resources/read)
         name = params.get("name", "")
         ref = params.get("ref") or {}
         if not name and isinstance(ref, dict):
             name = ref.get("name", "")
+            if not name and ref.get("uri"):
+                return await self._route_resource(payload, sessions)
         backend_name, sep, bare = name.partition(TOOL_SEP)
         backend = next(
             (b for b in self.cfg.backends if b.name == backend_name), None
@@ -633,7 +637,7 @@ class MCPProxy:
         not renamed (URIs are globally unique), so try each backend that
         has a session until one answers without error."""
         msg_id = payload.get("id")
-        last: dict[str, Any] | None = None
+        first_error: dict[str, Any] | None = None
         for b in self.cfg.backends:
             sid = sessions.get(b.name)
             if not sid:
@@ -644,8 +648,13 @@ class MCPProxy:
                 continue
             if resp is not None and "error" not in resp:
                 return resp
-            last = resp
-        return last or _rpc_error(msg_id, -32602, "resource not found")
+            # keep the FIRST backend's error: with URI-owned resources the
+            # owner answers first with a meaningful code; later backends'
+            # generic not-found must not mask it
+            if resp is not None and first_error is None:
+                first_error = resp
+        return first_error or _rpc_error(msg_id, -32602,
+                                         "resource not found")
 
     async def _aggregate_list(
         self, method: str, msg_id: Any, sessions: dict[str, str]

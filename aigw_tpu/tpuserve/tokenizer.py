@@ -44,9 +44,15 @@ class HFTokenizer:
         self._t = _T.from_file(path)
         vocab = self._t.get_vocab()
         self.bos_id = vocab.get("<|begin_of_text|>", vocab.get("<s>", 0))
-        self.eos_id = vocab.get(
-            "<|eot_id|>", vocab.get("<|end_of_text|>", vocab.get("</s>", 0))
-        )
+        # end-of-turn token by family: Llama-3 <|eot_id|>, ChatML (Qwen)
+        # <|im_end|>, GPT-style <|endoftext|>, sentencepiece </s>
+        for tok in ("<|eot_id|>", "<|im_end|>", "<|end_of_text|>",
+                    "<|endoftext|>", "</s>"):
+            if tok in vocab:
+                self.eos_id = vocab[tok]
+                break
+        else:
+            self.eos_id = 0
 
     def encode(self, text: str) -> list[int]:
         return self._t.encode(text, add_special_tokens=False).ids

@@ -124,19 +124,26 @@ def _chip_responsive(timeout_s: float = 180.0) -> bool:
     the driver."""
     import threading
 
-    ok = threading.Event()
+    done = threading.Event()
+    result = {"ok": False}
 
     def probe():
         try:
             x = jnp.ones((128, 128)) @ jnp.ones((128, 128))
             x.block_until_ready()
-            ok.set()
-        except Exception:
-            pass
+            result["ok"] = True
+        except Exception as e:  # fail fast with the real reason
+            result["error"] = f"{type(e).__name__}: {e}"
+        finally:
+            done.set()
 
     t = threading.Thread(target=probe, daemon=True)
     t.start()
-    return ok.wait(timeout_s)
+    done.wait(timeout_s)
+    if not result["ok"] and "error" in result:
+        print(f"device probe failed: {result['error']}",
+              file=__import__("sys").stderr)
+    return result["ok"]
 
 
 def main() -> None:

@@ -711,3 +711,68 @@ class TestPromptsResources:
                 await s1.stop()
 
         asyncio.run(main())
+
+
+def test_completion_resource_ref_routed():
+    """completion/complete with a ref.uri (resource template) routes like
+    resources/read instead of failing on the missing name."""
+
+    async def main():
+        from aiohttp import web as _web
+
+        class CompMCP(FakeMCPServer):
+            async def _handle(self, request):
+                msg = json.loads(await request.read())
+                if msg.get("method") == "completion/complete":
+                    return _web.json_response(
+                        {"jsonrpc": "2.0", "id": msg["id"], "result": {
+                            "completion": {"values": ["a", "b"]}}})
+                return await super()._handle(request)
+
+        s1 = await CompMCP("alpha", []).start()
+        cfg = MCPConfig(backends=(MCPBackend(name="alpha", url=s1.url),),
+                        session_seed="t")
+        proxy = MCPProxy(cfg)
+        app = web.Application()
+        proxy.register(app)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        url = f"http://127.0.0.1:{port}/mcp"
+        try:
+            _, _, headers = await _rpc(
+                url, "initialize",
+                {"protocolVersion": "2025-06-18", "capabilities": {}})
+            session = headers["mcp-session-id"]
+            _, body, _ = await _rpc(
+                url, "completion/complete",
+                {"ref": {"type": "ref/resource",
+                         "uri": "file://tpl/{x}"},
+                 "argument": {"name": "x", "value": "a"}},
+                session=session)
+            assert body["result"]["completion"]["values"] == ["a", "b"]
+        finally:
+            await runner.cleanup()
+            await s1.stop()
+
+    asyncio.run(main())
+
+
+def test_hf_tokenizer_chatml_eos(tmp_path):
+    """A ChatML-vocab tokenizer resolves <|im_end|> as EOS."""
+    import json as _json
+
+    from tokenizers import Tokenizer as _T
+    from tokenizers.models import WordLevel
+
+    vocab = {"hello": 0, "<|im_end|>": 1, "<|endoftext|>": 2}
+    tok = _T(WordLevel(vocab, unk_token="hello"))
+    p = tmp_path / "tokenizer.json"
+    tok.save(str(p))
+
+    from aigw_tpu.tpuserve.tokenizer import HFTokenizer
+
+    t = HFTokenizer(str(p))
+    assert t.eos_id == 1
