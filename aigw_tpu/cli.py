@@ -58,6 +58,8 @@ def main(argv: list[str] | None = None) -> int:
                          help="KV pages to allocate (0 = auto)")
     p_serve.add_argument("--tp", type=int, default=1,
                          help="tensor-parallel degree (devices on the mesh)")
+    p_serve.add_argument("--quantize", default="", choices=["", "int8"],
+                         help="weight-only quantization (W8A16)")
     p_serve.add_argument("--log-level", default="info")
 
     args = parser.parse_args(argv)
@@ -207,6 +209,7 @@ async def _run_tpuserve(args: argparse.Namespace) -> int:
         page_size=args.page_size,
         hbm_pages=args.hbm_pages,
         tp=args.tp,
+        quantize=args.quantize,
     )
     print(f"tpuserve listening on http://{args.host}:{args.port}", flush=True)
     await _wait_for_signal()

@@ -488,6 +488,9 @@ class Route:
     # Hostnames this route applies to ("" = all), mirroring per-host model
     # scoping (reference filterapi ModelsByHost).
     hostnames: tuple[str, ...] = ()
+    # Route-level costs, merged over the global list (reference
+    # AIGatewayRoute.Spec.LLMRequestCosts, ai_gateway_route.go:57).
+    llm_request_costs: tuple[LLMRequestCost, ...] = ()
 
     @staticmethod
     def parse(value: dict[str, Any]) -> "Route":
@@ -495,6 +498,10 @@ class Route:
             name=value["name"],
             rules=tuple(RouteRule.parse(r) for r in value.get("rules", ())),
             hostnames=tuple(value.get("hostnames", ())),
+            llm_request_costs=tuple(
+                LLMRequestCost.parse(c)
+                for c in value.get("llm_request_costs", ())
+            ),
         )
 
     def to_dict(self) -> dict[str, Any]:
@@ -504,6 +511,10 @@ class Route:
         }
         if self.hostnames:
             d["hostnames"] = list(self.hostnames)
+        if self.llm_request_costs:
+            d["llm_request_costs"] = [
+                c.to_dict() for c in self.llm_request_costs
+            ]
         return d
 
 
@@ -549,6 +560,12 @@ class Config:
         keys = [c.metadata_key for c in self.llm_request_costs]
         if len(keys) != len(set(keys)):
             raise ConfigError("duplicate llm_request_costs metadata keys")
+        for r in self.routes:
+            rkeys = [c.metadata_key for c in r.llm_request_costs]
+            if len(rkeys) != len(set(rkeys)):
+                raise ConfigError(
+                    f"route {r.name!r}: duplicate cost metadata keys"
+                )
 
     @staticmethod
     def parse(value: dict[str, Any]) -> "Config":

@@ -27,6 +27,8 @@ class RuntimeConfig:
     config: Config
     backends: dict[str, RuntimeBackend] = field(default_factory=dict)
     cost_calculator: Any = None  # aigw_tpu.gateway.costs.CostCalculator
+    # per-route calculators (global costs + route-level overrides)
+    route_cost_calculators: dict[str, Any] = field(default_factory=dict)
     rate_limiter: Any = None  # aigw_tpu.gateway.ratelimit.RateLimiter
 
     @staticmethod
@@ -46,10 +48,24 @@ class RuntimeConfig:
                 backend=b, auth_handler=new_handler(b.auth)
             )
         rc.cost_calculator = CostCalculator.from_config(config)
+        global_costs = {c.metadata_key: c for c in config.llm_request_costs}
+        for route in config.routes:
+            if route.llm_request_costs:
+                merged = dict(global_costs)
+                merged.update(
+                    {c.metadata_key: c for c in route.llm_request_costs}
+                )
+                rc.route_cost_calculators[route.name] = CostCalculator(
+                    tuple(merged.values())
+                )
         rc.rate_limiter = RateLimiter.from_config_value(
             [_thaw(q) for q in config.quotas]
         ).adopt(previous.rate_limiter if previous else None)
         return rc
+
+    def cost_calculator_for(self, route_name: str):
+        return self.route_cost_calculators.get(route_name,
+                                               self.cost_calculator)
 
     def routes_for_host(self, host: str) -> list[Route]:
         host = host.split(":")[0].lower()

@@ -718,7 +718,7 @@ class GatewayServer:
             return
         model = req_metrics.request_model
         backend = req_metrics.provider
-        costs = self._runtime.cost_calculator.calculate(
+        costs = self._runtime.cost_calculator_for(route_name).calculate(
             usage, model=model, backend=backend, route_name=route_name
         )
         if not costs:

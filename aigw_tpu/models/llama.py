@@ -113,6 +113,25 @@ def init_params(
     return p
 
 
+def _w(p: dict[str, jax.Array], key: str) -> jax.Array:
+    """Resolve a weight that may be stored bf16 or int8+scale (W8A16,
+    models/quant.py). The convert-and-scale sits on the matmul operand so
+    XLA fuses it; HBM traffic is the int8 bytes."""
+    q = p.get(key + ".q")
+    if q is None:
+        return p[key]
+    return q.astype(jnp.bfloat16) * p[key + ".scale"].astype(jnp.bfloat16)
+
+
+def _embed_rows(p: dict[str, jax.Array], tokens: jax.Array) -> jax.Array:
+    q = p.get("embed.q")
+    if q is None:
+        return jnp.take(p["embed"], tokens, axis=0)
+    rows = jnp.take(q, tokens, axis=0).astype(jnp.bfloat16)
+    scales = jnp.take(p["embed.scale"][:, 0], tokens, axis=0)
+    return rows * scales[..., None].astype(jnp.bfloat16)
+
+
 def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
     xf = x.astype(jnp.float32)
     scale = lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
@@ -158,7 +177,9 @@ def _attention(
 def _project_qkv(p, i, x, positions, cfg):
     hd = cfg.head_dim
     B, S, _ = x.shape
-    q, k, v = x @ p[f"l{i}.wq"], x @ p[f"l{i}.wk"], x @ p[f"l{i}.wv"]
+    q = x @ _w(p, f"l{i}.wq")
+    k = x @ _w(p, f"l{i}.wk")
+    v = x @ _w(p, f"l{i}.wv")
     if cfg.attn_bias:
         q, k, v = q + p[f"l{i}.bq"], k + p[f"l{i}.bk"], v + p[f"l{i}.bv"]
     q = q.reshape(B, S, cfg.n_heads, hd)
@@ -170,12 +191,12 @@ def _project_qkv(p, i, x, positions, cfg):
 
 
 def _mlp(p, i, x):
-    gate = jax.nn.silu(x @ p[f"l{i}.w_gate"])
-    return (gate * (x @ p[f"l{i}.w_up"])) @ p[f"l{i}.w_down"]
+    gate = jax.nn.silu(x @ _w(p, f"l{i}.w_gate"))
+    return (gate * (x @ _w(p, f"l{i}.w_up"))) @ _w(p, f"l{i}.w_down")
 
 
 def _logits(p: dict[str, jax.Array], cfg: LlamaConfig, x: jax.Array) -> jax.Array:
-    head = p["embed"].T if cfg.tie_embeddings else p["lm_head"]
+    head = _w(p, "embed").T if cfg.tie_embeddings else _w(p, "lm_head")
     return (x @ head).astype(jnp.float32)
 
 
@@ -207,7 +228,7 @@ def prefill(
         jnp.take_along_axis(page_table, positions // page_size, axis=1) * page_size
         + positions % page_size
     )  # [B, S]
-    x = jnp.take(p["embed"], tokens, axis=0)
+    x = _embed_rows(p, tokens)
     for i in range(cfg.n_layers):
         h = rms_norm(x, p[f"l{i}.attn_norm"], cfg.norm_eps)
         q, k, v = _project_qkv(p, i, h, positions, cfg)
@@ -217,7 +238,7 @@ def prefill(
         kv_cache = kv_cache.at[i, 0, flat].set(k, mode="drop")
         kv_cache = kv_cache.at[i, 1, flat].set(v, mode="drop")
         attn = _attention(q, k, v, mask)
-        x = x + attn @ p[f"l{i}.wo"]
+        x = x + attn @ _w(p, f"l{i}.wo")
         h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
         x = x + (mlp or _mlp)(p, i, h)
     x = rms_norm(x, p["norm_f"], cfg.norm_eps)
@@ -264,7 +285,7 @@ def decode_step(
     gslot = gslot.reshape(B, T)  # [B, T] flat cache indices
     attend = t_idx <= pos1  # causal within the sequence window [B, T]
 
-    x = jnp.take(p["embed"], tokens[:, None], axis=0)  # [B, 1, dim]
+    x = _embed_rows(p, tokens[:, None])  # [B, 1, dim]
     for i in range(cfg.n_layers):
         h = rms_norm(x, p[f"l{i}.attn_norm"], cfg.norm_eps)
         q, k, v = _project_qkv(p, i, h, pos1, cfg)
@@ -273,7 +294,7 @@ def decode_step(
         k_all = kv_cache[i, 0][gslot]  # [B, T, Hkv, D]
         v_all = kv_cache[i, 1][gslot]
         attn = _attention(q, k_all, v_all, attend[:, None, :])
-        x = x + attn @ p[f"l{i}.wo"]
+        x = x + attn @ _w(p, f"l{i}.wo")
         h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
         x = x + (mlp or _mlp)(p, i, h)
     x = rms_norm(x, p["norm_f"], cfg.norm_eps)
@@ -293,11 +314,11 @@ def hidden_states(
     valid = positions < seq_lens[:, None]
     causal = positions[:, :, None] >= positions[:, None, :]
     mask = causal & valid[:, None, :]
-    x = jnp.take(p["embed"], tokens, axis=0)
+    x = _embed_rows(p, tokens)
     for i in range(cfg.n_layers):
         h = rms_norm(x, p[f"l{i}.attn_norm"], cfg.norm_eps)
         q, k, v = _project_qkv(p, i, h, positions, cfg)
-        x = x + _attention(q, k, v, mask) @ p[f"l{i}.wo"]
+        x = x + _attention(q, k, v, mask) @ _w(p, f"l{i}.wo")
         h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
         x = x + (mlp or _mlp)(p, i, h)
     x = rms_norm(x, p["norm_f"], cfg.norm_eps)
@@ -343,7 +364,7 @@ def prefill_suffix(
     gslot = gslot.reshape(B, T)
     t_idx = jnp.arange(T, dtype=jnp.int32)[None, :]
 
-    x = jnp.take(p["embed"], tokens, axis=0)
+    x = _embed_rows(p, tokens)
     for i in range(cfg.n_layers):
         h = rms_norm(x, p[f"l{i}.attn_norm"], cfg.norm_eps)
         q, k, v = _project_qkv(p, i, h, positions, cfg)
@@ -354,7 +375,7 @@ def prefill_suffix(
         # causal over global positions; padded queries masked by `valid`
         mask = (t_idx[:, None, :] <= positions[:, :, None]) & valid[..., None]
         attn = _attention(q, k_all, v_all, mask)
-        x = x + attn @ p[f"l{i}.wo"]
+        x = x + attn @ _w(p, f"l{i}.wo")
         h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
         x = x + (mlp or _mlp)(p, i, h)
     x = rms_norm(x, p["norm_f"], cfg.norm_eps)

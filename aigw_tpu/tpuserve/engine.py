@@ -185,8 +185,25 @@ class Engine:
                 if hasattr(model_cfg, "n_experts")
                 else llama_param_specs(model_cfg)
             )
+
+            def spec_for(key: str, value) -> object:
+                # quantized weights: name.q shards like the base matrix;
+                # name.scale keeps the base spec only on axes it actually
+                # has extent in (keepdims axes of size 1 stay unsharded)
+                from jax.sharding import PartitionSpec as P
+
+                if key.endswith(".q"):
+                    return specs[key[:-2]]
+                if key.endswith(".scale"):
+                    base = specs[key[: -len(".scale")]]
+                    return P(*(
+                        ax if value.shape[i] > 1 else None
+                        for i, ax in enumerate(base)
+                    ))
+                return specs[key]
+
             self.params = {
-                k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+                k: jax.device_put(v, NamedSharding(mesh, spec_for(k, v)))
                 for k, v in params.items()
             }
             self.kv_cache = jax.device_put(

@@ -58,6 +58,7 @@ class TPUServeServer:
         engine_cfg: EngineConfig,
         metrics: GenAIMetrics | None = None,
         tp: int = 1,
+        quantize: str = "",  # "" | "int8" (W8A16; llama-family only)
     ):
         self.model_name = model
         spec = get_model_spec(model)
@@ -74,7 +75,18 @@ class TPUServeServer:
             mesh = make_mesh(MeshSpec(dp=1, tp=tp))
             logger.info("tensor-parallel serving: tp=%d over %s", tp,
                         [str(d) for d in mesh.devices.flat])
+        if quantize and quantize != "int8":
+            raise ValueError(f"unknown quantization {quantize!r}")
+        if quantize == "int8" and spec.family != "llama":
+            raise ValueError(
+                "int8 quantization currently supports the llama family"
+            )
         params = self._load_params(spec)
+        if quantize == "int8":
+            from aigw_tpu.models.quant import quantize_params
+
+            params = quantize_params(params)
+            logger.info("weights quantized to int8 (W8A16)")
         self.engine = Engine(
             params,
             self.model_cfg,
@@ -482,6 +494,7 @@ async def run_tpuserve(
     page_size: int = 128,
     hbm_pages: int = 0,
     tp: int = 1,
+    quantize: str = "",
 ) -> web.AppRunner:
     server = TPUServeServer(
         model,
@@ -492,6 +505,7 @@ async def run_tpuserve(
             num_pages=hbm_pages,
         ),
         tp=tp,
+        quantize=quantize,
     )
     runner = web.AppRunner(server.app)
     await runner.setup()

@@ -167,3 +167,52 @@ class TestAuthHandlers:
         sig1 = h1["authorization"].split("Signature=")[1]
         sig2 = h2["authorization"].split("Signature=")[1]
         assert sig1 != sig2
+
+
+from aigw_tpu.config.model import Config
+from aigw_tpu.config.runtime import RuntimeConfig
+
+
+class TestRouteLevelCosts:
+    def test_route_costs_merge_and_override(self):
+        cfg = Config.parse({
+            "version": "v1",
+            "backends": [{"name": "a", "schema": "OpenAI",
+                          "url": "http://x"}],
+            "routes": [
+                {"name": "cheap", "rules": [{"backends": ["a"]}]},
+                {"name": "premium",
+                 "llm_request_costs": [
+                     {"metadata_key": "credits", "type": "Expression",
+                      "expression": "total_tokens * 10"},
+                     {"metadata_key": "route_only", "type": "OutputToken"},
+                 ],
+                 "rules": [{"models": ["vip"], "backends": ["a"]}]},
+            ],
+            "llm_request_costs": [
+                {"metadata_key": "credits", "type": "TotalToken"},
+            ],
+        })
+        rc = RuntimeConfig.build(cfg)
+        from aigw_tpu.gateway.costs import TokenUsage
+
+        u = TokenUsage(input_tokens=3, output_tokens=2, total_tokens=5)
+        assert rc.cost_calculator_for("cheap").calculate(u) == {"credits": 5}
+        got = rc.cost_calculator_for("premium").calculate(u)
+        assert got == {"credits": 50, "route_only": 2}
+
+    def test_route_duplicate_keys_rejected(self):
+        with pytest.raises(ConfigError, match="duplicate cost"):
+            Config.parse({
+                "version": "v1",
+                "backends": [{"name": "a", "schema": "OpenAI",
+                              "url": "http://x"}],
+                "routes": [{
+                    "name": "r",
+                    "llm_request_costs": [
+                        {"metadata_key": "k", "type": "TotalToken"},
+                        {"metadata_key": "k", "type": "InputToken"},
+                    ],
+                    "rules": [{"backends": ["a"]}],
+                }],
+            })
