@@ -42,7 +42,11 @@ from aigw_tpu.tpuserve.kvcache import (
     PrefixCache,
     RefcountedAllocator,
 )
-from aigw_tpu.tpuserve.sampling import SamplingParams, sample
+from aigw_tpu.tpuserve.sampling import (
+    SamplingParams,
+    apply_penalties,
+    sample,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -108,6 +112,9 @@ class _Slot:
     # becomes True when the slot has been included in a dispatched device
     # state; windows dispatched earlier don't carry its tokens
     started: bool = False
+    # generated-token histogram (repetition penalties survive state
+    # rebuilds across admissions)
+    token_counts: dict[int, int] = field(default_factory=dict)
 
 
 @dataclass
@@ -231,20 +238,20 @@ class Engine:
         model_decode = self.fns.decode_step
 
         def _prefill_step(params, tokens, seq_lens, kv, page_table, keys,
-                          temp, top_p, top_k):
+                          temp, top_p, top_k, bias):
             logits, kv = model_prefill(params, mc, tokens, seq_lens, kv,
                                        page_table, ps)
-            return sample(logits, keys, temp, top_p, top_k), kv
+            return sample(logits + bias, keys, temp, top_p, top_k), kv
 
         model_prefill_suffix = self.fns.prefill_suffix
 
         def _prefill_suffix_step(params, tokens, prefix_lens, seq_lens, kv,
-                                 page_table, keys, temp, top_p, top_k):
+                                 page_table, keys, temp, top_p, top_k, bias):
             logits, kv = model_prefill_suffix(
                 params, mc, tokens, prefix_lens, seq_lens, kv, page_table,
                 ps,
             )
-            return sample(logits, keys, temp, top_p, top_k), kv
+            return sample(logits + bias, keys, temp, top_p, top_k), kv
 
         def _decode_scan(params, kv, state):
             """K fused decode+sample steps; sampled tokens feed forward
@@ -257,15 +264,24 @@ class Engine:
                     params, mc, st["tokens"], st["positions"], kv,
                     st["page_table"], ps, act,
                 )
+                logits = apply_penalties(
+                    logits, st["counts"], st["freq_pen"], st["pres_pen"],
+                    st["bias"],
+                )
                 sampled = sample(logits, st["keys"], st["temp"],
                                  st["top_p"], st["top_k"])
                 step = act.astype(jnp.uint32)
+                B = sampled.shape[0]
+                counts = st["counts"].at[
+                    jnp.arange(B), sampled
+                ].add(act.astype(st["counts"].dtype))
                 new = dict(
                     st,
                     tokens=jnp.where(act, sampled, st["tokens"]),
                     positions=jnp.where(act, st["positions"] + 1,
                                         st["positions"]),
                     keys=st["keys"].at[:, 1].add(step),
+                    counts=counts,
                 )
                 return (kv, new), sampled
 
@@ -427,11 +443,16 @@ class Engine:
             pt[0, : len(pages)] = pages
 
             key = np.array([[req.sampling.seed or seq_id, 0]], np.uint32)
+            bias_row = np.zeros((1, self.model_cfg.vocab_size), np.float32)
+            for tok_id, b in req.sampling.logit_bias:
+                if 0 <= tok_id < self.model_cfg.vocab_size:
+                    bias_row[0, tok_id] = b
             sampling_args = (
                 jnp.asarray(key),
                 jnp.asarray([req.sampling.temperature], jnp.float32),
                 jnp.asarray([req.sampling.top_p], jnp.float32),
                 jnp.asarray([req.sampling.top_k], jnp.int32),
+                jnp.asarray(bias_row),
             )
             t0 = time.monotonic()
             if prefix_len:
@@ -522,6 +543,11 @@ class Engine:
         temp = np.ones((B,), np.float32)
         top_p = np.ones((B,), np.float32)
         top_k = np.zeros((B,), np.int32)
+        freq_pen = np.zeros((B,), np.float32)
+        pres_pen = np.zeros((B,), np.float32)
+        V = self.model_cfg.vocab_size
+        counts = np.zeros((B, V), np.int32)
+        bias = np.zeros((B, V), np.float32)
         for i, s in enumerate(self._slots):
             if s is None:
                 continue
@@ -535,6 +561,14 @@ class Engine:
             temp[i] = s.req.sampling.temperature
             top_p[i] = s.req.sampling.top_p
             top_k[i] = s.req.sampling.top_k
+            freq_pen[i] = s.req.sampling.frequency_penalty
+            pres_pen[i] = s.req.sampling.presence_penalty
+            for tok_id, cnt in s.token_counts.items():
+                if 0 <= tok_id < V:
+                    counts[i, tok_id] = cnt
+            for tok_id, b in s.req.sampling.logit_bias:
+                if 0 <= tok_id < V:
+                    bias[i, tok_id] = b
         return {
             "tokens": jnp.asarray(tokens),
             "positions": jnp.asarray(positions),
@@ -545,6 +579,10 @@ class Engine:
             "temp": jnp.asarray(temp),
             "top_p": jnp.asarray(top_p),
             "top_k": jnp.asarray(top_k),
+            "freq_pen": jnp.asarray(freq_pen),
+            "pres_pen": jnp.asarray(pres_pen),
+            "counts": jnp.asarray(counts),
+            "bias": jnp.asarray(bias),
         }
 
     def _process_window(self, sampled: jax.Array) -> None:
@@ -628,6 +666,7 @@ class Engine:
         else:
             # the sampled token is the input of the next decode step
             s.pending_token = tok
+            s.token_counts[tok] = s.token_counts.get(tok, 0) + 1
 
     def _refresh_stats(self) -> None:
         self.stats.queued = self._queue.qsize()

@@ -23,6 +23,10 @@ class SamplingParams:
     top_p: float = 1.0
     top_k: int = 0  # 0 = disabled
     seed: int = 0
+    frequency_penalty: float = 0.0
+    presence_penalty: float = 0.0
+    # token id → additive logit bias (OpenAI logit_bias)
+    logit_bias: tuple[tuple[int, float], ...] = ()
 
     @staticmethod
     def from_request(body: dict) -> "SamplingParams":
@@ -33,12 +37,38 @@ class SamplingParams:
             v = body.get(key)
             return default if v is None else float(v)
 
+        bias = body.get("logit_bias") or {}
         return SamplingParams(
             temperature=pick("temperature", 1.0),
             top_p=pick("top_p", 1.0),
             top_k=int(pick("top_k", 0)),
             seed=int(pick("seed", 0)),
+            frequency_penalty=pick("frequency_penalty", 0.0),
+            presence_penalty=pick("presence_penalty", 0.0),
+            logit_bias=tuple(
+                (int(k), float(v)) for k, v in bias.items()
+            ),
         )
+
+
+def apply_penalties(
+    logits: jax.Array,  # [B, V] float32
+    counts: jax.Array,  # [B, V] — occurrences of each token so far
+    freq_penalty: jax.Array,  # [B]
+    pres_penalty: jax.Array,  # [B]
+    bias: jax.Array | None = None,  # [B, V] additive logit bias
+) -> jax.Array:
+    """OpenAI-semantics penalties: logit -= freq·count + pres·(count>0),
+    plus per-request logit_bias."""
+    countf = counts.astype(jnp.float32)
+    out = (
+        logits
+        - freq_penalty[:, None] * countf
+        - pres_penalty[:, None] * (countf > 0)
+    )
+    if bias is not None:
+        out = out + bias
+    return out
 
 
 def sample(

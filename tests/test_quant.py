@@ -131,3 +131,71 @@ def test_quantized_tp_serving_matches_single_device():
     single = generate(None)
     tp = generate(make_mesh(MeshSpec(dp=1, tp=2)))
     assert single == tp
+
+
+class TestPenaltiesAndBias:
+    def _generate(self, eng, prompt, **sp):
+        import threading
+
+        from aigw_tpu.tpuserve.engine import GenRequest
+        from aigw_tpu.tpuserve.sampling import SamplingParams
+
+        done = threading.Event()
+        toks = []
+
+        def emit(tok, fin):
+            if tok >= 0:
+                toks.append(tok)
+            if fin is not None:
+                done.set()
+
+        eng.submit(GenRequest(prompt=prompt, max_tokens=8,
+                              sampling=SamplingParams(temperature=0.0, **sp),
+                              emit=emit))
+        assert done.wait(timeout=240)
+        return toks
+
+    def test_logit_bias_forces_token(self):
+        from aigw_tpu.tpuserve.engine import Engine, EngineConfig
+
+        params = llama.init_params(jax.random.PRNGKey(0), CFG)
+        eng = Engine(params, CFG,
+                     EngineConfig(max_batch_size=2, max_seq_len=128,
+                                  page_size=16, min_prefill_bucket=16,
+                                  decode_steps_per_tick=4))
+        eng.start()
+        try:
+            # +1000 bias on token 123 must dominate greedy sampling
+            toks = self._generate(eng, [5, 6, 7],
+                                  logit_bias=((123, 1000.0),))
+            assert set(toks) == {123}
+            # -inf-ish bias bans the otherwise-greedy token
+            base = self._generate(eng, [5, 6, 7])
+            banned = base[0]
+            toks2 = self._generate(eng, [5, 6, 7],
+                                   logit_bias=((banned, -1000.0),))
+            assert toks2[0] != banned
+        finally:
+            eng.stop()
+
+    def test_frequency_penalty_reduces_repetition(self):
+        from aigw_tpu.tpuserve.engine import Engine, EngineConfig
+
+        params = llama.init_params(jax.random.PRNGKey(0), CFG)
+        eng = Engine(params, CFG,
+                     EngineConfig(max_batch_size=2, max_seq_len=128,
+                                  page_size=16, min_prefill_bucket=16,
+                                  decode_steps_per_tick=4))
+        eng.start()
+        try:
+            # bias token 99 to dominate; penalty must break the repetition
+            repeat = self._generate(eng, [4, 4],
+                                    logit_bias=((99, 50.0),))
+            assert repeat.count(99) == len(repeat)  # repeats forever
+            penalized = self._generate(eng, [4, 4],
+                                       logit_bias=((99, 50.0),),
+                                       frequency_penalty=100.0)
+            assert penalized[0] == 99  # first pick unchanged
+            assert penalized.count(99) < len(penalized)  # then penalized
+        finally:
+            eng.stop()
