@@ -194,6 +194,21 @@ class TPUServeServer:
         chat: bool,
     ) -> web.StreamResponse:
         stream = bool(body.get("stream", False))
+        n = int(body.get("n") or 1)
+        if n > 1:
+            if stream:
+                return web.Response(
+                    status=400,
+                    body=oai.error_body("n>1 with stream is not supported"),
+                    content_type="application/json")
+            if n > self.engine.cfg.max_batch_size:
+                return web.Response(
+                    status=400,
+                    body=oai.error_body(
+                        f"n={n} exceeds max_batch_size "
+                        f"{self.engine.cfg.max_batch_size}"),
+                    content_type="application/json")
+            return await self._generate_n(body, prompt, chat, n)
         include_usage = oai.include_stream_usage(body)
         rid = (
             f"chatcmpl-{uuid.uuid4().hex[:24]}"
@@ -346,6 +361,57 @@ class TPUServeServer:
         await resp.write(SSEEvent(data="[DONE]").encode())
         await resp.write_eof()
         return resp
+
+    async def _generate_n(
+        self, body: dict[str, Any], prompt: list[int], chat: bool, n: int
+    ) -> web.Response:
+        """n>1 choices: fan out n engine requests (continuous batching
+        runs them concurrently — same prompt pages shared by the prefix
+        cache) and assemble a multi-choice response."""
+        stops = body.get("stop")
+        stop_strs = [stops] if isinstance(stops, str) else list(stops or [])
+        sampling = SamplingParams.from_request(body)
+        outs = []
+        for i in range(n):
+            # distinct seeds per choice so samples differ deterministically
+            per_choice = dict(body)
+            per_choice["seed"] = (sampling.seed or 0) + i if (
+                sampling.seed or sampling.temperature > 0
+            ) else 0
+            outs.append(self._submit(prompt, per_choice))
+        results = await asyncio.gather(
+            *(self._collect(q, stop_strs) for q, _req in outs)
+        )
+        usage = TokenUsage(
+            input_tokens=len(prompt),
+            output_tokens=sum(r[1] for r in results),
+            total_tokens=len(prompt) + sum(r[1] for r in results),
+        )
+        rid = (f"chatcmpl-{uuid.uuid4().hex[:24]}" if chat
+               else f"cmpl-{uuid.uuid4().hex[:24]}")
+        if chat:
+            choices = [
+                {"index": i,
+                 "message": {"role": "assistant", "content": text},
+                 "finish_reason": finish}
+                for i, (text, _n, finish) in enumerate(results)
+            ]
+            resp = {
+                "id": rid, "object": "chat.completion",
+                "created": int(time.time()), "model": self.model_name,
+                "choices": choices, "usage": oai.usage_dict(usage),
+            }
+        else:
+            resp = {
+                "id": rid, "object": "text_completion",
+                "created": int(time.time()), "model": self.model_name,
+                "choices": [
+                    {"index": i, "text": text, "finish_reason": finish}
+                    for i, (text, _n, finish) in enumerate(results)
+                ],
+                "usage": oai.usage_dict(usage),
+            }
+        return web.json_response(resp)
 
     async def _collect(
         self, out: asyncio.Queue, stop_strs: list[str]

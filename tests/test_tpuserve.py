@@ -456,3 +456,38 @@ class TestStopSequences:
                 assert len(text) < len(base)
 
         asyncio.run(main())
+
+
+class TestNChoices:
+    def test_n_choices(self, tpuserve_url):
+        status, body, _ = asyncio.run(
+            _post(tpuserve_url, "/v1/chat/completions", {
+                "model": "tiny-random",
+                "messages": [{"role": "user", "content": "pick"}],
+                "max_tokens": 4, "n": 2, "temperature": 0.9, "seed": 7,
+            })
+        )
+        assert status == 200
+        got = json.loads(body)
+        assert [c["index"] for c in got["choices"]] == [0, 1]
+        assert got["usage"]["completion_tokens"] >= 2
+
+    def test_n_too_large_rejected(self, tpuserve_url):
+        status, body, _ = asyncio.run(
+            _post(tpuserve_url, "/v1/chat/completions", {
+                "model": "tiny-random",
+                "messages": [{"role": "user", "content": "x"}],
+                "n": 99,
+            })
+        )
+        assert status == 400
+
+    def test_n_stream_rejected(self, tpuserve_url):
+        status, body, _ = asyncio.run(
+            _post(tpuserve_url, "/v1/chat/completions", {
+                "model": "tiny-random",
+                "messages": [{"role": "user", "content": "x"}],
+                "n": 2, "stream": True,
+            })
+        )
+        assert status == 400
