@@ -575,7 +575,10 @@ class GatewayServer:
 
         async with _closing(resp):
             if resp.status >= 400:
-                err = await resp.read()
+                try:
+                    err = await resp.read()
+                except (aiohttp.ClientError, asyncio.TimeoutError):
+                    err = b
                 client_err = translator.response_error(resp.status, err)
                 if resp.status in _RETRIABLE_STATUS:
                     raise _RetriableUpstreamError(resp.status, client_err,
@@ -601,7 +604,15 @@ class GatewayServer:
                     request, resp, translator, rb, req_metrics, route_name,
                     client_headers,
                 )
-            raw = await resp.read()
+            try:
+                raw = await resp.read()
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                raise _RetriableUpstreamError(
+                    502,
+                    error_body(f"upstream body read failed: {e}",
+                               type_="upstream_error"),
+                    str(e) or type(e).__name__,
+                ) from None
             rx = translator.response_body(raw, True)
             usage = rx.usage
             req_metrics.response_model = rx.model

@@ -665,3 +665,52 @@ class TestTrafficSemantics:
                 await stop_env(runner, ups)
 
         run(main())
+
+
+class TestMidBodyFailure:
+    def test_truncated_upstream_body_fails_over(self):
+        """Upstream dies mid-body (non-streaming): the gateway retries the
+        next backend instead of 500ing."""
+
+        async def main():
+            from aiohttp import web as _web
+
+            async def die_mid_body(cap):
+                resp = _web.StreamResponse(
+                    status=200,
+                    headers={"content-type": "application/json",
+                             "content-length": "1000"},
+                )
+                await resp.prepare(cap._request)
+                await resp.write(b'{"partial":')
+                cap._request.transport.close()  # hard drop
+                return resp
+
+            dead = FakeUpstream().on("/v1/chat/completions", die_mid_body)
+            ok = FakeUpstream().on_json("/v1/chat/completions",
+                                        openai_chat_response("rescued"))
+            server, runner, url, ups = await start_env(
+                {"d": dead, "o": ok},
+                lambda urls: make_config(
+                    [{"name": "d", "schema": "OpenAI", "url": urls["d"]},
+                     {"name": "o", "schema": "OpenAI", "url": urls["o"]}],
+                    [{"name": "r", "rules": [{
+                        "models": ["m1"],
+                        "backends": [
+                            {"backend": "d", "priority": 0},
+                            {"backend": "o", "priority": 1},
+                        ],
+                    }]}],
+                ),
+            )
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(url + "/v1/chat/completions",
+                                      json=CHAT) as resp:
+                        assert resp.status == 200
+                        got = await resp.json()
+                assert got["choices"][0]["message"]["content"] == "rescued"
+            finally:
+                await stop_env(runner, ups)
+
+        run(main())
