@@ -1,0 +1,19 @@
+# Runtime image for the gateway and tpuserve (the reference ships a
+# Dockerfile that pulls the Envoy binary; ours is self-contained).
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+      g++ make zlib1g-dev && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY pyproject.toml README.md ./
+COPY aigw_tpu ./aigw_tpu
+COPY native ./native
+RUN pip install --no-cache-dir . && make -C native
+
+# TPU runtime: install the libtpu-enabled jax build for your fleet, e.g.
+#   pip install 'jax[tpu]' -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+
+EXPOSE 1975 8011
+ENTRYPOINT ["python", "-m", "aigw_tpu"]
+CMD ["run", "/etc/aigw/config.yaml", "--host", "0.0.0.0"]

@@ -60,6 +60,9 @@ def main(argv: list[str] | None = None) -> int:
                          help="tensor-parallel degree (devices on the mesh)")
     p_serve.add_argument("--quantize", default="", choices=["", "int8"],
                          help="weight-only quantization (W8A16)")
+    p_serve.add_argument("--platform", default="",
+                         help="force a JAX platform (e.g. cpu for the "
+                              "fake-chip mode; default: auto/TPU)")
     p_serve.add_argument("--log-level", default="info")
 
     args = parser.parse_args(argv)
@@ -157,6 +160,10 @@ def main(argv: list[str] | None = None) -> int:
             print(f"config error: {e}", file=sys.stderr)
             return 1
     if args.cmd == "tpuserve":
+        if args.platform:
+            import jax
+
+            jax.config.update("jax_platforms", args.platform)
         return asyncio.run(_run_tpuserve(args))
     return 2
 
