@@ -60,6 +60,10 @@ def main(argv: list[str] | None = None) -> int:
                          help="tensor-parallel degree (devices on the mesh)")
     p_serve.add_argument("--quantize", default="", choices=["", "int8"],
                          help="weight-only quantization (W8A16)")
+    p_serve.add_argument("--lora", action="append", default=[],
+                         metavar="NAME=ORBAX_DIR",
+                         help="load a LoRA adapter (repeatable); serve it "
+                              "via model '<base>:<name>'")
     p_serve.add_argument("--platform", default="",
                          help="force a JAX platform (e.g. cpu for the "
                               "fake-chip mode; default: auto/TPU)")
@@ -207,6 +211,16 @@ async def _run_gateway(args: argparse.Namespace) -> int:
 async def _run_tpuserve(args: argparse.Namespace) -> int:
     from aigw_tpu.tpuserve.server import run_tpuserve
 
+    lora_adapters = {}
+    for spec_str in args.lora:
+        name, _, path = spec_str.partition("=")
+        if not name or not path:
+            print(f"--lora expects NAME=ORBAX_DIR, got {spec_str!r}",
+                  file=sys.stderr)
+            return 1
+        from aigw_tpu.models.checkpoint import restore_checkpoint
+
+        lora_adapters[name] = restore_checkpoint(path)
     runner = await run_tpuserve(
         model=args.model,
         host=args.host,
@@ -217,6 +231,7 @@ async def _run_tpuserve(args: argparse.Namespace) -> int:
         hbm_pages=args.hbm_pages,
         tp=args.tp,
         quantize=args.quantize,
+        lora_adapters=lora_adapters or None,
     )
     print(f"tpuserve listening on http://{args.host}:{args.port}", flush=True)
     await _wait_for_signal()

@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from aigw_tpu.models.lora import lora_delta
+
 
 @dataclass(frozen=True)
 class LlamaConfig:
@@ -174,12 +176,28 @@ def _attention(
     return out.reshape(B, S, H * D)
 
 
-def _project_qkv(p, i, x, positions, cfg):
+def _wo_project(p, i, attn, lora=None, adapter_idx=None):
+    """Attention out-projection with optional per-slot LoRA delta."""
+    out = attn @ _w(p, f"l{i}.wo")
+    d = lora_delta(lora, f"l{i}.wo", attn, adapter_idx)
+    return out if d is None else out + d
+
+
+def _project_qkv(p, i, x, positions, cfg, lora=None, adapter_idx=None):
     hd = cfg.head_dim
     B, S, _ = x.shape
     q = x @ _w(p, f"l{i}.wq")
     k = x @ _w(p, f"l{i}.wk")
     v = x @ _w(p, f"l{i}.wv")
+    for name, ref in (("wq", "q"), ("wk", "k"), ("wv", "v")):
+        d = lora_delta(lora, f"l{i}.{name}", x, adapter_idx)
+        if d is not None:
+            if ref == "q":
+                q = q + d
+            elif ref == "k":
+                k = k + d
+            else:
+                v = v + d
     if cfg.attn_bias:
         q, k, v = q + p[f"l{i}.bq"], k + p[f"l{i}.bk"], v + p[f"l{i}.bv"]
     q = q.reshape(B, S, cfg.n_heads, hd)
@@ -190,9 +208,15 @@ def _project_qkv(p, i, x, positions, cfg):
     return q, k, v
 
 
-def _mlp(p, i, x):
-    gate = jax.nn.silu(x @ _w(p, f"l{i}.w_gate"))
-    return (gate * (x @ _w(p, f"l{i}.w_up"))) @ _w(p, f"l{i}.w_down")
+def _mlp(p, i, x, lora=None, adapter_idx=None):
+    def with_delta(y, name, inp):
+        d = lora_delta(lora, f"l{i}.{name}", inp, adapter_idx)
+        return y if d is None else y + d
+
+    gate = jax.nn.silu(with_delta(x @ _w(p, f"l{i}.w_gate"), "w_gate", x))
+    up = with_delta(x @ _w(p, f"l{i}.w_up"), "w_up", x)
+    h = gate * up
+    return with_delta(h @ _w(p, f"l{i}.w_down"), "w_down", h)
 
 
 def _logits(p: dict[str, jax.Array], cfg: LlamaConfig, x: jax.Array) -> jax.Array:
@@ -209,6 +233,8 @@ def prefill(
     page_table: jax.Array,  # [B, max_pages] int32 page ids
     page_size: int,
     mlp=None,  # pluggable feed-forward (MoE families override; see mixtral)
+    lora=None,
+    adapter_idx=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Process prompts; returns (last-position logits [B, V], updated cache).
 
@@ -231,16 +257,17 @@ def prefill(
     x = _embed_rows(p, tokens)
     for i in range(cfg.n_layers):
         h = rms_norm(x, p[f"l{i}.attn_norm"], cfg.norm_eps)
-        q, k, v = _project_qkv(p, i, h, positions, cfg)
+        q, k, v = _project_qkv(p, i, h, positions, cfg, lora, adapter_idx)
         # padded positions scatter to an out-of-bounds slot, which
         # mode="drop" discards (negative indices would wrap instead)
         flat = jnp.where(valid, slot, n_slots)
         kv_cache = kv_cache.at[i, 0, flat].set(k, mode="drop")
         kv_cache = kv_cache.at[i, 1, flat].set(v, mode="drop")
         attn = _attention(q, k, v, mask)
-        x = x + attn @ _w(p, f"l{i}.wo")
+        x = x + _wo_project(p, i, attn, lora, adapter_idx)
         h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
-        x = x + (mlp or _mlp)(p, i, h)
+        x = x + (mlp(p, i, h) if mlp is not None
+                 else _mlp(p, i, h, lora, adapter_idx))
     x = rms_norm(x, p["norm_f"], cfg.norm_eps)
     last = jnp.take_along_axis(
         x, (seq_lens - 1)[:, None, None].astype(jnp.int32), axis=1
@@ -258,6 +285,8 @@ def decode_step(
     page_size: int,
     active: jax.Array,  # [B] bool slot occupied
     mlp=None,  # pluggable feed-forward (MoE families override)
+    lora=None,  # stacked adapters (models/lora.py)
+    adapter_idx=None,  # [B] int32 adapter row per slot
 ) -> tuple[jax.Array, jax.Array]:
     """One continuous-batching decode step; returns (logits [B, V], cache).
 
@@ -288,15 +317,16 @@ def decode_step(
     x = _embed_rows(p, tokens[:, None])  # [B, 1, dim]
     for i in range(cfg.n_layers):
         h = rms_norm(x, p[f"l{i}.attn_norm"], cfg.norm_eps)
-        q, k, v = _project_qkv(p, i, h, pos1, cfg)
+        q, k, v = _project_qkv(p, i, h, pos1, cfg, lora, adapter_idx)
         kv_cache = kv_cache.at[i, 0, slot].set(k, mode="drop")
         kv_cache = kv_cache.at[i, 1, slot].set(v, mode="drop")
         k_all = kv_cache[i, 0][gslot]  # [B, T, Hkv, D]
         v_all = kv_cache[i, 1][gslot]
         attn = _attention(q, k_all, v_all, attend[:, None, :])
-        x = x + attn @ _w(p, f"l{i}.wo")
+        x = x + _wo_project(p, i, attn, lora, adapter_idx)
         h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
-        x = x + (mlp or _mlp)(p, i, h)
+        x = x + (mlp(p, i, h) if mlp is not None
+                 else _mlp(p, i, h, lora, adapter_idx))
     x = rms_norm(x, p["norm_f"], cfg.norm_eps)
     return _logits(p, cfg, x[:, 0]), kv_cache
 
@@ -307,6 +337,8 @@ def hidden_states(
     tokens: jax.Array,  # [B, S]
     seq_lens: jax.Array,  # [B]
     mlp=None,  # pluggable feed-forward (MoE families override)
+    lora=None,
+    adapter_idx=None,
 ) -> jax.Array:
     """Mean-pooled final hidden states (the /v1/embeddings path)."""
     B, S = tokens.shape
@@ -317,10 +349,12 @@ def hidden_states(
     x = _embed_rows(p, tokens)
     for i in range(cfg.n_layers):
         h = rms_norm(x, p[f"l{i}.attn_norm"], cfg.norm_eps)
-        q, k, v = _project_qkv(p, i, h, positions, cfg)
-        x = x + _attention(q, k, v, mask) @ _w(p, f"l{i}.wo")
+        q, k, v = _project_qkv(p, i, h, positions, cfg, lora, adapter_idx)
+        x = x + _wo_project(p, i, _attention(q, k, v, mask), lora,
+                            adapter_idx)
         h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
-        x = x + (mlp or _mlp)(p, i, h)
+        x = x + (mlp(p, i, h) if mlp is not None
+                 else _mlp(p, i, h, lora, adapter_idx))
     x = rms_norm(x, p["norm_f"], cfg.norm_eps)
     w = valid[..., None].astype(jnp.float32)
     pooled = (x.astype(jnp.float32) * w).sum(1) / jnp.maximum(w.sum(1), 1.0)
@@ -337,6 +371,8 @@ def prefill_suffix(
     page_table: jax.Array,  # [B, max_pages]
     page_size: int,
     mlp=None,
+    lora=None,
+    adapter_idx=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Prefill only the suffix of a prompt whose prefix K/V already sits in
     cache pages (prefix caching / chunked prefill). Per layer: suffix K/V
@@ -367,7 +403,7 @@ def prefill_suffix(
     x = _embed_rows(p, tokens)
     for i in range(cfg.n_layers):
         h = rms_norm(x, p[f"l{i}.attn_norm"], cfg.norm_eps)
-        q, k, v = _project_qkv(p, i, h, positions, cfg)
+        q, k, v = _project_qkv(p, i, h, positions, cfg, lora, adapter_idx)
         kv_cache = kv_cache.at[i, 0, flat].set(k, mode="drop")
         kv_cache = kv_cache.at[i, 1, flat].set(v, mode="drop")
         k_all = kv_cache[i, 0][gslot]  # [B, T, Hkv, D]
@@ -375,9 +411,10 @@ def prefill_suffix(
         # causal over global positions; padded queries masked by `valid`
         mask = (t_idx[:, None, :] <= positions[:, :, None]) & valid[..., None]
         attn = _attention(q, k_all, v_all, mask)
-        x = x + attn @ _w(p, f"l{i}.wo")
+        x = x + _wo_project(p, i, attn, lora, adapter_idx)
         h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
-        x = x + (mlp or _mlp)(p, i, h)
+        x = x + (mlp(p, i, h) if mlp is not None
+                 else _mlp(p, i, h, lora, adapter_idx))
     x = rms_norm(x, p["norm_f"], cfg.norm_eps)
     last = jnp.take_along_axis(
         x, (seq_lens - prefix_lens - 1)[:, None, None].astype(jnp.int32),

@@ -144,13 +144,14 @@ def _mlp_fn(cfg: MixtralConfig):
 
 
 def prefill(p, cfg: MixtralConfig, tokens, seq_lens, kv_cache, page_table,
-            page_size):
+            page_size, lora=None, adapter_idx=None):
+    # LoRA is llama-family-only for now; args accepted for interface parity
     return llama.prefill(p, cfg.as_llama(), tokens, seq_lens, kv_cache,
                          page_table, page_size, mlp=_mlp_fn(cfg))
 
 
 def decode_step(p, cfg: MixtralConfig, tokens, positions, kv_cache,
-                page_table, page_size, active):
+                page_table, page_size, active, lora=None, adapter_idx=None):
     return llama.decode_step(p, cfg.as_llama(), tokens, positions, kv_cache,
                              page_table, page_size, active,
                              mlp=_mlp_fn(cfg))

@@ -95,6 +95,8 @@ class GenRequest:
     # set by the consumer to abandon the request (client disconnect / stop
     # sequence hit); the engine frees the slot at the next tick
     cancelled: threading.Event = field(default_factory=threading.Event)
+    # LoRA adapter name ("" = base model)
+    adapter: str = ""
 
 
 @dataclass
@@ -115,6 +117,7 @@ class _Slot:
     # generated-token histogram (repetition penalties survive state
     # rebuilds across admissions)
     token_counts: dict[int, int] = field(default_factory=dict)
+    adapter_row: int = 0
 
 
 @dataclass
@@ -141,10 +144,17 @@ class Engine:
         eos_token_ids: tuple[int, ...] = (),
         mesh: Any = None,
         fns: Any = None,  # models.registry.ModelFns; default = llama
+        lora_params: dict[str, jax.Array] | None = None,
+        adapter_names: tuple[str, ...] = (),
     ):
         from aigw_tpu.models.registry import family_fns
 
         self.fns = fns or family_fns("llama")
+        # multi-LoRA: stacked adapters + name→row map; the LAST row of the
+        # stack is the all-zeros base-model row (models/lora.py)
+        self.lora_params = lora_params
+        self.adapter_rows = {n: i for i, n in enumerate(adapter_names)}
+        self._base_row = len(adapter_names)
         self.mesh = mesh
         self.params = params
         self.model_cfg = model_cfg
@@ -237,23 +247,25 @@ class Engine:
         model_prefill = self.fns.prefill
         model_decode = self.fns.decode_step
 
-        def _prefill_step(params, tokens, seq_lens, kv, page_table, keys,
-                          temp, top_p, top_k, bias):
+        def _prefill_step(params, lora, tokens, seq_lens, kv, page_table,
+                          keys, temp, top_p, top_k, bias, adapter_idx):
             logits, kv = model_prefill(params, mc, tokens, seq_lens, kv,
-                                       page_table, ps)
+                                       page_table, ps, lora=lora,
+                                       adapter_idx=adapter_idx)
             return sample(logits + bias, keys, temp, top_p, top_k), kv
 
         model_prefill_suffix = self.fns.prefill_suffix
 
-        def _prefill_suffix_step(params, tokens, prefix_lens, seq_lens, kv,
-                                 page_table, keys, temp, top_p, top_k, bias):
+        def _prefill_suffix_step(params, lora, tokens, prefix_lens,
+                                 seq_lens, kv, page_table, keys, temp,
+                                 top_p, top_k, bias, adapter_idx):
             logits, kv = model_prefill_suffix(
                 params, mc, tokens, prefix_lens, seq_lens, kv, page_table,
-                ps,
+                ps, lora=lora, adapter_idx=adapter_idx,
             )
             return sample(logits + bias, keys, temp, top_p, top_k), kv
 
-        def _decode_scan(params, kv, state):
+        def _decode_scan(params, lora, kv, state):
             """K fused decode+sample steps; sampled tokens feed forward
             on-device (no host round-trip inside the window)."""
 
@@ -263,6 +275,7 @@ class Engine:
                 logits, kv = model_decode(
                     params, mc, st["tokens"], st["positions"], kv,
                     st["page_table"], ps, act,
+                    lora=lora, adapter_idx=st["adapter_idx"],
                 )
                 logits = apply_penalties(
                     logits, st["counts"], st["freq_pen"], st["pres_pen"],
@@ -290,10 +303,10 @@ class Engine:
             )
             return sampled, state, kv
 
-        self._prefill_fn = jax.jit(_prefill_step, donate_argnums=(3,))
+        self._prefill_fn = jax.jit(_prefill_step, donate_argnums=(4,))
         self._prefill_suffix_fn = jax.jit(_prefill_suffix_step,
-                                          donate_argnums=(4,))
-        self._decode_fn = jax.jit(_decode_scan, donate_argnums=(1, 2))
+                                          donate_argnums=(5,))
+        self._decode_fn = jax.jit(_decode_scan, donate_argnums=(2, 3))
 
     # -- public API -------------------------------------------------------
     def start(self) -> None:
@@ -322,7 +335,7 @@ class Engine:
         request then only pays the prefill compile for its bucket)."""
         state = self._build_device_state()
         _, _, self.kv_cache = self._decode_fn(
-            self.params, self.kv_cache, state
+            self.params, self.lora_params, self.kv_cache, state
         )
 
     # -- engine loop ------------------------------------------------------
@@ -442,6 +455,14 @@ class Engine:
             pt = np.zeros((1, self.cfg.max_pages_per_seq), np.int32)
             pt[0, : len(pages)] = pages
 
+            adapter_row = self._base_row
+            if req.adapter:
+                row = self.adapter_rows.get(req.adapter)
+                if row is None:
+                    req.emit(-1, "error")
+                    self.allocator.free(seq_id)
+                    continue
+                adapter_row = row
             key = np.array([[req.sampling.seed or seq_id, 0]], np.uint32)
             bias_row = np.zeros((1, self.model_cfg.vocab_size), np.float32)
             for tok_id, b in req.sampling.logit_bias:
@@ -453,6 +474,7 @@ class Engine:
                 jnp.asarray([req.sampling.top_p], jnp.float32),
                 jnp.asarray([req.sampling.top_k], jnp.int32),
                 jnp.asarray(bias_row),
+                jnp.asarray([adapter_row], jnp.int32),
             )
             t0 = time.monotonic()
             if prefix_len:
@@ -467,6 +489,7 @@ class Engine:
                 bucket = min(bucket, self.cfg.max_pages_per_seq)
                 next_tok, self.kv_cache = self._prefill_suffix_fn(
                     self.params,
+                    self.lora_params,
                     jnp.asarray(tokens),
                     jnp.asarray([prefix_len], jnp.int32),
                     jnp.asarray([n], jnp.int32),
@@ -477,6 +500,7 @@ class Engine:
             else:
                 next_tok, self.kv_cache = self._prefill_fn(
                     self.params,
+                    self.lora_params,
                     jnp.asarray(tokens),
                     jnp.asarray([n], jnp.int32),
                     self.kv_cache,
@@ -496,7 +520,7 @@ class Engine:
             self._slots[slot_idx] = _Slot(
                 req=req, pos=n - 1, generated=0,
                 key_seed=req.sampling.seed or seq_id,
-                limit=total, page_row=pt[0],
+                limit=total, page_row=pt[0], adapter_row=adapter_row,
             )
             self._emit_token(slot_idx, tok)
             self._state_dirty = True
@@ -548,6 +572,7 @@ class Engine:
         V = self.model_cfg.vocab_size
         counts = np.zeros((B, V), np.int32)
         bias = np.zeros((B, V), np.float32)
+        adapter_idx = np.full((B,), self._base_row, np.int32)
         for i, s in enumerate(self._slots):
             if s is None:
                 continue
@@ -569,6 +594,7 @@ class Engine:
             for tok_id, b in s.req.sampling.logit_bias:
                 if 0 <= tok_id < V:
                     bias[i, tok_id] = b
+            adapter_idx[i] = s.adapter_row
         return {
             "tokens": jnp.asarray(tokens),
             "positions": jnp.asarray(positions),
@@ -583,6 +609,7 @@ class Engine:
             "pres_pen": jnp.asarray(pres_pen),
             "counts": jnp.asarray(counts),
             "bias": jnp.asarray(bias),
+            "adapter_idx": jnp.asarray(adapter_idx),
         }
 
     def _process_window(self, sampled: jax.Array) -> None:
@@ -633,7 +660,7 @@ class Engine:
             return False
 
         sampled, self._device_state, self.kv_cache = self._decode_fn(
-            self.params, self.kv_cache, self._device_state
+            self.params, self.lora_params, self.kv_cache, self._device_state
         )
         # process the PREVIOUS window while this one runs on-device
         self._drain_inflight()

@@ -59,6 +59,10 @@ class TPUServeServer:
         metrics: GenAIMetrics | None = None,
         tp: int = 1,
         quantize: str = "",  # "" | "int8" (W8A16; llama-family only)
+        # name → adapter param dict (un-stacked [r,in]/[out,r] per target);
+        # served when a request's model == "<base>:<adapter>" or the bare
+        # adapter name
+        lora_adapters: dict[str, dict] | None = None,
     ):
         self.model_name = model
         spec = get_model_spec(model)
@@ -87,6 +91,14 @@ class TPUServeServer:
 
             params = quantize_params(params)
             logger.info("weights quantized to int8 (W8A16)")
+        lora_params = None
+        adapter_names: tuple[str, ...] = ()
+        if lora_adapters:
+            if spec.family != "llama":
+                raise ValueError("LoRA serving supports the llama family")
+            adapter_names = tuple(lora_adapters)
+            lora_params = self._stack_adapters(lora_adapters)
+        self.adapter_names = adapter_names
         self.engine = Engine(
             params,
             self.model_cfg,
@@ -94,6 +106,8 @@ class TPUServeServer:
             eos_token_ids=(self.tokenizer.eos_id,),
             mesh=mesh,
             fns=self.fns,
+            lora_params=lora_params,
+            adapter_names=adapter_names,
         )
         # jitted embeddings path (bucketed like prefill)
         hidden = self.fns.hidden_states
@@ -129,6 +143,50 @@ class TPUServeServer:
             return restore_checkpoint(path, like)
         raise ValueError(f"unsupported weight source {spec.weights}")
 
+    def _stack_adapters(self, adapters: dict[str, dict]):
+        """Per-adapter dicts → stacked [n+1, ...] arrays (last row zero =
+        base model; models/lora.py layout)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        names = list(adapters)
+        keys = set()
+        for d in adapters.values():
+            keys.update(d)
+        stacked = {}
+        for k in keys:
+            rows = []
+            for n in names:
+                arr = adapters[n].get(k)
+                if arr is None:
+                    raise ValueError(
+                        f"adapter {n!r} missing tensor {k!r} (all adapters "
+                        "must target the same modules/rank)"
+                    )
+                if rows and arr.shape != rows[0].shape:
+                    raise ValueError(
+                        f"adapter {n!r} tensor {k!r} shape {arr.shape} "
+                        f"differs from {rows[0].shape} (ranks must match)"
+                    )
+                rows.append(np.asarray(arr, np.float32))
+            rows.append(np.zeros_like(rows[0]))  # base-model zero row
+            stacked[k] = jnp.asarray(np.stack(rows)).astype(jnp.bfloat16)
+        return stacked
+
+    def _resolve_adapter(self, model: str) -> str:
+        """`<base>:<adapter>` or bare adapter name → adapter name.
+        Raises SchemaError for an unknown colon-suffixed adapter (a typo
+        must not silently serve base-model output)."""
+        if model.startswith(self.model_name + ":"):
+            cand = model[len(self.model_name) + 1 :]
+            if cand not in self.adapter_names:
+                raise oai.SchemaError(
+                    f"unknown LoRA adapter {cand!r}; loaded: "
+                    f"{sorted(self.adapter_names)}"
+                )
+            return cand
+        return model if model in self.adapter_names else ""
+
     async def _on_start(self, _app) -> None:
         self.engine.start()
         # compile the decode program off the request path
@@ -157,6 +215,7 @@ class TPUServeServer:
             sampling=SamplingParams.from_request(body),
             stop_token_ids=stop_ids,
             emit=emit,
+            adapter=self._resolve_adapter(str(body.get("model", ""))),
         )
         self.engine.submit(req)
         return out, req
@@ -229,6 +288,11 @@ class TPUServeServer:
         )
         try:
             out, gen_req = self._submit(prompt, body)
+        except oai.SchemaError as e:
+            return web.Response(
+                status=404,
+                body=oai.error_body(str(e), type_="model_not_found"),
+                content_type="application/json")
         except ValueError as e:
             return web.Response(status=400, body=oai.error_body(str(e)),
                                 content_type="application/json")
@@ -517,9 +581,11 @@ class TPUServeServer:
         )
 
     async def _models(self, _request: web.Request) -> web.Response:
-        return web.json_response(
-            oai.models_response([(self.model_name, "tpuserve", 0)])
-        )
+        entries = [(self.model_name, "tpuserve", 0)] + [
+            (f"{self.model_name}:{a}", "tpuserve-lora", 0)
+            for a in self.adapter_names
+        ]
+        return web.json_response(oai.models_response(entries))
 
     async def _health(self, _request: web.Request) -> web.Response:
         if not self.engine.healthy:
@@ -561,6 +627,7 @@ async def run_tpuserve(
     hbm_pages: int = 0,
     tp: int = 1,
     quantize: str = "",
+    lora_adapters: dict | None = None,
 ) -> web.AppRunner:
     server = TPUServeServer(
         model,
@@ -572,6 +639,7 @@ async def run_tpuserve(
         ),
         tp=tp,
         quantize=quantize,
+        lora_adapters=lora_adapters,
     )
     runner = web.AppRunner(server.app)
     await runner.setup()
