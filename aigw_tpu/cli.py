@@ -60,6 +60,10 @@ def main(argv: list[str] | None = None) -> int:
                          help="tensor-parallel degree (devices on the mesh)")
     p_serve.add_argument("--quantize", default="", choices=["", "int8"],
                          help="weight-only quantization (W8A16)")
+    p_serve.add_argument("--decode-steps-per-tick", type=int, default=8,
+                         help="fused decode steps per host round-trip")
+    p_serve.add_argument("--no-prefix-cache", action="store_true",
+                         help="disable automatic prompt prefix caching")
     p_serve.add_argument("--lora", action="append", default=[],
                          metavar="NAME=ORBAX_DIR",
                          help="load a LoRA adapter (repeatable); serve it "
@@ -232,6 +236,8 @@ async def _run_tpuserve(args: argparse.Namespace) -> int:
         tp=args.tp,
         quantize=args.quantize,
         lora_adapters=lora_adapters or None,
+        decode_steps_per_tick=args.decode_steps_per_tick,
+        enable_prefix_cache=not args.no_prefix_cache,
     )
     print(f"tpuserve listening on http://{args.host}:{args.port}", flush=True)
     await _wait_for_signal()

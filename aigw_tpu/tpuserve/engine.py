@@ -316,10 +316,13 @@ class Engine:
         self._thread.start()
 
     def stop(self) -> None:
+        """Stop the loop; any still-pending requests finish with
+        "error" so waiting consumers never hang."""
         self._stop.set()
         self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
+        self._abort_all("engine stopped")
 
     def submit(self, req: GenRequest) -> None:
         if len(req.prompt) + req.max_tokens > self.cfg.max_seq_len:

@@ -628,6 +628,8 @@ async def run_tpuserve(
     tp: int = 1,
     quantize: str = "",
     lora_adapters: dict | None = None,
+    decode_steps_per_tick: int = 8,
+    enable_prefix_cache: bool = True,
 ) -> web.AppRunner:
     server = TPUServeServer(
         model,
@@ -636,6 +638,8 @@ async def run_tpuserve(
             max_seq_len=max_seq_len,
             page_size=page_size,
             num_pages=hbm_pages,
+            decode_steps_per_tick=decode_steps_per_tick,
+            enable_prefix_cache=enable_prefix_cache,
         ),
         tp=tp,
         quantize=quantize,

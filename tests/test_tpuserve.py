@@ -491,3 +491,27 @@ class TestNChoices:
             })
         )
         assert status == 400
+
+
+def test_stop_finishes_pending_requests():
+    """Engine shutdown must error out queued work, not strand consumers."""
+    cfg = EngineConfig(max_batch_size=1, max_seq_len=128, page_size=16,
+                       min_prefill_bucket=16, decode_steps_per_tick=2)
+    params = llama.init_params(jax.random.PRNGKey(0), llama.TINY)
+    eng = Engine(params, llama.TINY, cfg)
+    eng.start()
+    fins = []
+    done = threading.Event()
+
+    def emit(tok, fin):
+        if fin is not None:
+            fins.append(fin)
+            done.set()
+
+    # long generation + immediate stop: the request must still resolve
+    eng.submit(GenRequest(prompt=[1, 2], max_tokens=64,
+                          sampling=SamplingParams(temperature=0.0),
+                          emit=emit))
+    eng.stop()
+    assert done.wait(timeout=30)
+    assert fins and fins[0] in ("error", "length", "stop")
