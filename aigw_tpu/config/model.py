@@ -297,6 +297,11 @@ class Backend:
     # queue depth / slice affinity and overrides `url`.
     endpoints: tuple[Any, ...] = ()
     picker_poll_interval: float = 1.0
+    # Derive a session-affinity key from the conversation prefix (all
+    # messages except the latest user turn) so consecutive turns land on
+    # the replica holding their KV prefix cache. Explicit
+    # x-aigw-session-affinity headers still win.
+    picker_content_affinity: bool = False
     auth: AuthConfig = AuthConfig()
     header_mutation: HeaderMutation = HeaderMutation()
     body_mutation: BodyMutation = BodyMutation()
@@ -322,6 +327,9 @@ class Backend:
                 picker_poll_interval=float(
                     value.get("picker_poll_interval", 1.0)
                 ),
+                picker_content_affinity=bool(
+                    value.get("picker_content_affinity", False)
+                ),
                 auth=AuthConfig.parse(value.get("auth")),
                 header_mutation=HeaderMutation.parse(value.get("header_mutation")),
                 body_mutation=BodyMutation.parse(value.get("body_mutation")),
@@ -340,6 +348,8 @@ class Backend:
             d["endpoints"] = [_thaw(e) for e in self.endpoints]
         if self.picker_poll_interval != 1.0:
             d["picker_poll_interval"] = self.picker_poll_interval
+        if self.picker_content_affinity:
+            d["picker_content_affinity"] = True
         if self.auth.kind is not AuthKind.NONE:
             d["auth"] = self.auth.to_dict()
         if self.header_mutation != HeaderMutation():

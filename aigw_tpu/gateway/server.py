@@ -43,7 +43,11 @@ from aigw_tpu.gateway.auth import AuthError
 from aigw_tpu.gateway.circuit import CircuitBreaker
 from aigw_tpu.gateway.costs import TokenUsage
 from aigw_tpu.gateway.mutators import apply_body_mutation, apply_header_mutation
-from aigw_tpu.gateway.picker import Endpoint as PickerEndpoint, EndpointPicker
+from aigw_tpu.gateway.picker import (
+    AFFINITY_HEADER,
+    Endpoint as PickerEndpoint,
+    EndpointPicker,
+)
 from aigw_tpu.gateway.router import BackendSelector, NoRouteError, match_route
 from aigw_tpu.obs.metrics import GenAIMetrics, RequestMetrics
 from aigw_tpu.obs.tracing import (
@@ -95,6 +99,26 @@ _MULTIPART_ENDPOINTS = {
     Endpoint.AUDIO_TRANSCRIPTIONS,
     Endpoint.AUDIO_TRANSLATIONS,
 }
+
+
+def _conversation_affinity_key(body: dict) -> str:
+    """Hash the conversation prefix (everything before the newest user
+    message) — stable across turns of one chat, so the picker keeps the
+    conversation on the replica whose prefix cache holds it."""
+    import hashlib as _hashlib
+    import json as _json
+
+    messages = body.get("messages")
+    if not isinstance(messages, list) or len(messages) < 2:
+        return ""
+    prefix = messages[:-1]
+    # only genuine continuations: a prefix that is just a (possibly shared)
+    # system prompt would funnel unrelated conversations onto one replica
+    if not any(isinstance(m, dict) and m.get("role") == "assistant"
+               for m in prefix):
+        return ""
+    blob = _json.dumps(prefix, sort_keys=True).encode()
+    return _hashlib.blake2b(blob, digest_size=12).hexdigest()
 
 
 def _multipart_model(raw: bytes, content_type: str) -> str:
@@ -531,7 +555,17 @@ class GatewayServer:
         # in-process picker chooses a replica from the backend's pool.
         dest = request.headers.get(DESTINATION_ENDPOINT_HEADER, "")
         if not dest and backend.name in self._pickers:
-            dest = self._pickers[backend.name].pick(client_headers) or ""
+            pick_headers = client_headers
+            if (
+                backend.picker_content_affinity
+                and AFFINITY_HEADER not in client_headers
+                and isinstance(body, dict)
+            ):
+                key = _conversation_affinity_key(body)
+                if key:
+                    pick_headers = dict(client_headers)
+                    pick_headers[AFFINITY_HEADER] = key
+            dest = self._pickers[backend.name].pick(pick_headers) or ""
         base_url = f"http://{dest}" if dest else backend.url
         if not base_url:
             raise _RetriableUpstreamError(

@@ -51,6 +51,10 @@ from aigw_tpu.tpuserve.sampling import (
 logger = logging.getLogger(__name__)
 
 
+class EngineOverloadedError(Exception):
+    """Admission queue full — callers should surface 429/503."""
+
+
 @dataclass
 class EngineConfig:
     max_batch_size: int = 8
@@ -65,6 +69,10 @@ class EngineConfig:
     # Automatic prefix caching: full prompt pages are content-addressed and
     # shared across requests (chat-history reuse → TTFT win).
     enable_prefix_cache: bool = True
+    # Admission cap: waiting requests beyond this are rejected at submit
+    # (the server surfaces 429 + retry-after) instead of growing an
+    # unbounded queue.
+    max_queued_requests: int = 256
 
     def __post_init__(self) -> None:
         if self.max_seq_len % self.page_size != 0:
@@ -329,6 +337,10 @@ class Engine:
             raise ValueError(
                 f"prompt+max_tokens {len(req.prompt)}+{req.max_tokens} exceeds "
                 f"max_seq_len {self.cfg.max_seq_len}"
+            )
+        if self._queue.qsize() >= self.cfg.max_queued_requests:
+            raise EngineOverloadedError(
+                f"queue full ({self.cfg.max_queued_requests} waiting)"
             )
         self._queue.put(req)
         self._wake.set()

@@ -28,7 +28,12 @@ from aigw_tpu.models.registry import family_fns, get_model_spec
 from aigw_tpu.obs.metrics import GenAIMetrics, RequestMetrics
 from aigw_tpu.schemas import openai as oai
 from aigw_tpu.translate.sse import SSEEvent
-from aigw_tpu.tpuserve.engine import Engine, EngineConfig, GenRequest
+from aigw_tpu.tpuserve.engine import (
+    Engine,
+    EngineConfig,
+    EngineOverloadedError,
+    GenRequest,
+)
 from aigw_tpu.tpuserve.sampling import SamplingParams
 from aigw_tpu.tpuserve.tokenizer import (
     StreamingDecoder,
@@ -288,6 +293,12 @@ class TPUServeServer:
         )
         try:
             out, gen_req = self._submit(prompt, body)
+        except EngineOverloadedError as e:
+            return web.Response(
+                status=429,
+                body=oai.error_body(str(e), type_="rate_limit_error"),
+                headers={"retry-after": "1"},
+                content_type="application/json")
         except oai.SchemaError as e:
             return web.Response(
                 status=404,

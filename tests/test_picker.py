@@ -96,3 +96,40 @@ class TestLivePolling:
 
 # reuse the module-scoped tpuserve fixture
 from tests.test_tpuserve import tpuserve_url  # noqa: E402,F401
+
+
+class TestContentAffinity:
+    def test_conversation_prefix_key_stability(self):
+        from aigw_tpu.gateway.server import _conversation_affinity_key
+
+        turn1 = {"messages": [{"role": "system", "content": "s"},
+                              {"role": "user", "content": "q1"}]}
+        # next turn: same history + assistant reply + new user msg
+        turn2 = {"messages": [{"role": "system", "content": "s"},
+                              {"role": "user", "content": "q1"},
+                              {"role": "assistant", "content": "a1"},
+                              {"role": "user", "content": "q2"}]}
+        k1 = _conversation_affinity_key(turn2)
+        assert k1  # multi-message → keyed
+        # a DIFFERENT conversation gets a different key
+        other = {"messages": [{"role": "system", "content": "s"},
+                              {"role": "user", "content": "zzz"},
+                              {"role": "assistant", "content": "a"},
+                              {"role": "user", "content": "q2"}]}
+        assert _conversation_affinity_key(other) != k1
+        # first turns (no assistant history) are NOT keyed: a shared
+        # system prompt must not funnel unrelated chats to one replica
+        assert _conversation_affinity_key(turn1) == ""
+
+    def test_affinity_keeps_conversation_on_replica(self):
+        p = EndpointPicker([
+            Endpoint("a:1", slice_name="s0"),
+            Endpoint("b:1", slice_name="s1"),
+        ])
+        p.observe("a:1", kv_occupancy=0.3, max_slots=8)
+        p.observe("b:1", kv_occupancy=0.31, max_slots=8)
+        h = {AFFINITY_HEADER: "conv-1"}
+        first = p.pick(h)
+        # load shifts slightly against the chosen node; affinity holds
+        p.observe(first, kv_occupancy=0.45, max_slots=8)
+        assert p.pick(h) == first

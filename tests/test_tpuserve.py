@@ -515,3 +515,20 @@ def test_stop_finishes_pending_requests():
     eng.stop()
     assert done.wait(timeout=30)
     assert fins and fins[0] in ("error", "length", "stop")
+
+
+def test_queue_overload_raises():
+    from aigw_tpu.tpuserve.engine import EngineOverloadedError
+
+    cfg = EngineConfig(max_batch_size=1, max_seq_len=64, page_size=16,
+                       min_prefill_bucket=16, decode_steps_per_tick=2,
+                       max_queued_requests=2)
+    params = llama.init_params(jax.random.PRNGKey(0), llama.TINY)
+    eng = Engine(params, llama.TINY, cfg)
+    # don't start the loop: the queue just fills
+    for _ in range(2):
+        eng.submit(GenRequest(prompt=[1], max_tokens=1,
+                              sampling=SamplingParams()))
+    with pytest.raises(EngineOverloadedError):
+        eng.submit(GenRequest(prompt=[1], max_tokens=1,
+                              sampling=SamplingParams()))
