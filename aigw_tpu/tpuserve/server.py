@@ -396,7 +396,15 @@ class TPUServeServer:
                     )
                 )
             while True:
-                tok, fin = await out.get()
+                # keepalive comments while queued behind prefills so
+                # intermediaries don't drop an apparently-idle stream
+                while True:
+                    try:
+                        tok, fin = await asyncio.wait_for(out.get(),
+                                                          timeout=10.0)
+                        break
+                    except asyncio.TimeoutError:
+                        await resp.write(b": ping\n\n")
                 if tok >= 0:
                     n_out += 1
                     rm.record_tokens_emitted(1)
