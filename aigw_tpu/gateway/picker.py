@@ -10,17 +10,19 @@ aigw_tpu/tpuserve/server.py) and scores endpoints:
     score = kv_occupancy                     (HBM pressure)
           + queued / max_slots               (waiting work)
           + active_slots / max_slots * 0.5   (decode batch load)
-          + 0.25 if on a different slice than the session's previous
-            endpoint (ICI affinity: keeps a conversation's KV-cache
-            locality when replicas span slices)
 
-Unhealthy or stale endpoints are skipped; with no telemetry at all the
-picker falls back to round-robin.
+Session affinity (``x-aigw-session-affinity``, or derived from the
+conversation head by the gateway) is per-endpoint STICKY: the session
+stays on its previous replica — whose prefix cache holds its KV — unless
+that replica's score exceeds the best alternative by
+``STICKINESS_MARGIN``. Unhealthy or stale endpoints are skipped; with no
+telemetry at all the picker falls back to round-robin.
 """
 
 from __future__ import annotations
 
 import asyncio
+import collections
 import itertools
 import logging
 import time
@@ -71,7 +73,10 @@ class EndpointPicker:
             e.address: EndpointState() for e in endpoints
         }
         self._rr = itertools.cycle([e.address for e in endpoints])
-        self._affinity: dict[str, str] = {}  # session key → address
+        # session key → address, LRU-bounded
+        self._affinity: "collections.OrderedDict[str, str]" = (
+            collections.OrderedDict()
+        )
         self._task: asyncio.Task | None = None
 
     # -- polling ----------------------------------------------------------
@@ -131,46 +136,49 @@ class EndpointPicker:
         st.updated_at = time.monotonic()
 
     # -- picking ----------------------------------------------------------
+    #: a sticky endpoint keeps the session unless its score exceeds the
+    #: best alternative by this much (KV locality beats small load skew)
+    STICKINESS_MARGIN = 0.5
+    _AFFINITY_MAX = 100_000
+
     def pick(self, headers: dict[str, str] | None = None) -> str | None:
         """Returns 'host:port' for the request, or None if no endpoints."""
         if not self.endpoints:
             return None
         now = time.monotonic()
         affinity_key = (headers or {}).get(AFFINITY_HEADER, "")
-        preferred_slice = ""
-        if affinity_key:
-            prev = self._affinity.get(affinity_key)
-            if prev:
-                preferred_slice = next(
-                    (e.slice_name for e in self.endpoints
-                     if e.address == prev),
-                    "",
-                )
+        prev_addr = self._affinity.get(affinity_key) if affinity_key else None
 
-        best: tuple[float, str] | None = None
-        any_fresh = False
-        for e in self.endpoints:
+        def score_of(e: Endpoint) -> float | None:
             st = self.state[e.address]
-            fresh = st.healthy and now - st.updated_at < self.STALE_AFTER
-            if not fresh:
-                continue
-            any_fresh = True
-            score = (
+            if not (st.healthy and now - st.updated_at < self.STALE_AFTER):
+                return None
+            return (
                 st.kv_occupancy
                 + st.queued / st.max_slots
                 + 0.5 * st.active_slots / st.max_slots
             )
-            if preferred_slice and e.slice_name != preferred_slice:
-                score += 0.25
-            if best is None or score < best[0]:
-                best = (score, e.address)
-        if not any_fresh:
+
+        scores = {e.address: score_of(e) for e in self.endpoints}
+        fresh = {a: s for a, s in scores.items() if s is not None}
+        if not fresh:
             # no telemetry (cold start / all down): round-robin blindly
             chosen = next(self._rr)
         else:
-            chosen = best[1]  # type: ignore[index]
+            best_addr = min(fresh, key=fresh.__getitem__)
+            chosen = best_addr
+            # per-endpoint stickiness: stay on the session's previous
+            # replica (its prefix cache lives there) unless it is now much
+            # worse than the best choice
+            if (
+                prev_addr in fresh
+                and fresh[prev_addr] <= fresh[best_addr]
+                + self.STICKINESS_MARGIN
+            ):
+                chosen = prev_addr
         if affinity_key:
             self._affinity[affinity_key] = chosen
-            if len(self._affinity) > 100_000:
-                self._affinity.clear()  # bounded memory, coarse reset
+            self._affinity.move_to_end(affinity_key)
+            while len(self._affinity) > self._AFFINITY_MAX:
+                self._affinity.popitem(last=False)  # LRU eviction
         return chosen

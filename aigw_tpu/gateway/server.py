@@ -102,22 +102,34 @@ _MULTIPART_ENDPOINTS = {
 
 
 def _conversation_affinity_key(body: dict) -> str:
-    """Hash the conversation prefix (everything before the newest user
-    message) — stable across turns of one chat, so the picker keeps the
-    conversation on the replica whose prefix cache holds it."""
+    """Key a conversation by its STABLE head — the system prompt(s) plus
+    the first user message. Unlike the growing message prefix, the head is
+    identical on every turn of one chat, so the picker can pin the
+    conversation to the replica whose prefix cache holds it; distinct
+    conversations differ in their first user message."""
     import hashlib as _hashlib
     import json as _json
 
     messages = body.get("messages")
-    if not isinstance(messages, list) or len(messages) < 2:
+    if not isinstance(messages, list) or not messages:
         return ""
-    prefix = messages[:-1]
-    # only genuine continuations: a prefix that is just a (possibly shared)
-    # system prompt would funnel unrelated conversations onto one replica
-    if not any(isinstance(m, dict) and m.get("role") == "assistant"
-               for m in prefix):
+    head: list = []
+    first_user = None
+    for m in messages:
+        if not isinstance(m, dict):
+            return ""
+        role = m.get("role")
+        if role in ("system", "developer"):
+            head.append(m)
+        elif role == "user":
+            first_user = m
+            break
+        else:
+            break
+    if first_user is None:
         return ""
-    blob = _json.dumps(prefix, sort_keys=True).encode()
+    head.append(first_user)
+    blob = _json.dumps(head, sort_keys=True).encode()
     return _hashlib.blake2b(blob, digest_size=12).hexdigest()
 
 

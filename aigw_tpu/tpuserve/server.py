@@ -455,13 +455,23 @@ class TPUServeServer:
         stop_strs = [stops] if isinstance(stops, str) else list(stops or [])
         sampling = SamplingParams.from_request(body)
         outs = []
-        for i in range(n):
-            # distinct seeds per choice so samples differ deterministically
-            per_choice = dict(body)
-            per_choice["seed"] = (sampling.seed or 0) + i if (
-                sampling.seed or sampling.temperature > 0
-            ) else 0
-            outs.append(self._submit(prompt, per_choice))
+        try:
+            for i in range(n):
+                # distinct seeds per choice so samples differ
+                # deterministically
+                per_choice = dict(body)
+                per_choice["seed"] = (sampling.seed or 0) + i if (
+                    sampling.seed or sampling.temperature > 0
+                ) else 0
+                outs.append(self._submit(prompt, per_choice))
+        except EngineOverloadedError as e:
+            for _q, req in outs:  # don't orphan already-queued choices
+                req.cancelled.set()
+            return web.Response(
+                status=429,
+                body=oai.error_body(str(e), type_="rate_limit_error"),
+                headers={"retry-after": "1"},
+                content_type="application/json")
         results = await asyncio.gather(
             *(self._collect(q, stop_strs) for q, _req in outs)
         )

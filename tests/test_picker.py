@@ -99,37 +99,45 @@ from tests.test_tpuserve import tpuserve_url  # noqa: E402,F401
 
 
 class TestContentAffinity:
-    def test_conversation_prefix_key_stability(self):
+    def test_key_stable_across_turns(self):
         from aigw_tpu.gateway.server import _conversation_affinity_key
 
         turn1 = {"messages": [{"role": "system", "content": "s"},
                               {"role": "user", "content": "q1"}]}
-        # next turn: same history + assistant reply + new user msg
         turn2 = {"messages": [{"role": "system", "content": "s"},
                               {"role": "user", "content": "q1"},
                               {"role": "assistant", "content": "a1"},
                               {"role": "user", "content": "q2"}]}
-        k1 = _conversation_affinity_key(turn2)
-        assert k1  # multi-message → keyed
-        # a DIFFERENT conversation gets a different key
+        turn3 = {"messages": turn2["messages"] + [
+            {"role": "assistant", "content": "a2"},
+            {"role": "user", "content": "q3"}]}
+        k1 = _conversation_affinity_key(turn1)
+        assert k1
+        # THE property that makes pinning work: every turn → same key
+        assert _conversation_affinity_key(turn2) == k1
+        assert _conversation_affinity_key(turn3) == k1
+        # a different conversation (different first user msg) → new key
         other = {"messages": [{"role": "system", "content": "s"},
-                              {"role": "user", "content": "zzz"},
-                              {"role": "assistant", "content": "a"},
-                              {"role": "user", "content": "q2"}]}
+                              {"role": "user", "content": "zzz"}]}
         assert _conversation_affinity_key(other) != k1
-        # first turns (no assistant history) are NOT keyed: a shared
-        # system prompt must not funnel unrelated chats to one replica
-        assert _conversation_affinity_key(turn1) == ""
 
-    def test_affinity_keeps_conversation_on_replica(self):
+    def test_endpoint_stickiness_same_slice(self):
+        """Stickiness is per ENDPOINT, not per slice: a conversation stays
+        on its replica even when both replicas share a slice and load
+        shifts slightly."""
         p = EndpointPicker([
             Endpoint("a:1", slice_name="s0"),
-            Endpoint("b:1", slice_name="s1"),
+            Endpoint("b:1", slice_name="s0"),
         ])
-        p.observe("a:1", kv_occupancy=0.3, max_slots=8)
+        p.observe("a:1", kv_occupancy=0.30, max_slots=8)
         p.observe("b:1", kv_occupancy=0.31, max_slots=8)
         h = {AFFINITY_HEADER: "conv-1"}
         first = p.pick(h)
-        # load shifts slightly against the chosen node; affinity holds
-        p.observe(first, kv_occupancy=0.45, max_slots=8)
-        assert p.pick(h) == first
+        assert first == "a:1"
+        # load flips moderately against the sticky node → still held
+        p.observe("a:1", kv_occupancy=0.60, max_slots=8)
+        p.observe("b:1", kv_occupancy=0.25, max_slots=8)
+        assert p.pick(h) == "a:1"
+        # …but a LARGE imbalance releases the session
+        p.observe("a:1", kv_occupancy=0.95, queued=8, max_slots=8)
+        assert p.pick(h) == "b:1"
