@@ -40,6 +40,12 @@ def main(argv: list[str] | None = None) -> int:
     )
     p_tr.add_argument("config")
 
+    p_hc = sub.add_parser(
+        "healthcheck",
+        help="probe a gateway/tpuserve /health endpoint (exit 0 = healthy)")
+    p_hc.add_argument("url", nargs="?", default="http://127.0.0.1:1975")
+    p_hc.add_argument("--timeout", type=float, default=5.0)
+
     p_conv = sub.add_parser(
         "convert", help="import a local HF safetensors dir into an orbax "
                         "checkpoint usable by tpuserve")
@@ -91,6 +97,25 @@ def main(argv: list[str] | None = None) -> int:
             f"OK: {len(cfg.backends)} backends, {len(cfg.routes)} routes, "
             f"{len(cfg.models)} models, {len(cfg.llm_request_costs)} cost metrics"
         )
+        return 0
+
+    if args.cmd == "healthcheck":
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                args.url.rstrip("/") + "/health", timeout=args.timeout
+            ) as resp:
+                data = _json.loads(resp.read())
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            print(f"UNHEALTHY: {e}", file=sys.stderr)
+            return 1
+        if data.get("status") != "ok":
+            print(f"UNHEALTHY: {data}", file=sys.stderr)
+            return 1
+        print(_json.dumps(data))
         return 0
 
     if args.cmd == "translate":

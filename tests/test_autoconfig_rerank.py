@@ -198,3 +198,11 @@ class TestTranslateCLI:
         p = tmp_path / "bad.yaml"
         p.write_text("version: v9")
         assert main(["translate", str(p)]) == 1
+
+
+class TestHealthcheckCLI:
+    def test_healthcheck_down(self):
+        from aigw_tpu.cli import main
+
+        assert main(["healthcheck", "http://127.0.0.1:1",
+                     "--timeout", "0.5"]) == 1
