@@ -239,3 +239,30 @@ def test_unknown_adapter_suffix_404():
             await runner.cleanup()
 
     asyncio.run(main())
+
+
+def test_quantized_base_with_lora_and_prefix_cache():
+    """Feature interaction: int8 base weights + per-slot LoRA + prefix
+    caching all active in one engine."""
+    from aigw_tpu.models.quant import quantize_params
+
+    qparams = quantize_params(llama.init_params(jax.random.PRNGKey(0), CFG))
+    lora = init_lora_adapters(jax.random.PRNGKey(7), CFG, LORA, 1,
+                              random_b=True)
+    eng = Engine(qparams, CFG,
+                 EngineConfig(max_batch_size=2, max_seq_len=128,
+                              page_size=16, min_prefill_bucket=16,
+                              decode_steps_per_tick=4),
+                 lora_params=lora, adapter_names=("ad",))
+    eng.start()
+    try:
+        shared = list(range(1, 40))
+        base1 = generate(eng, shared + [7])
+        adapt1 = generate(eng, shared + [7], adapter="ad")
+        assert adapt1 != base1  # adapter applied on quantized base
+        # second pass hits the prefix cache; outputs identical
+        base2 = generate(eng, shared + [7])
+        assert base2 == base1
+        assert eng.stats.prefix_cache_hits >= 1
+    finally:
+        eng.stop()
