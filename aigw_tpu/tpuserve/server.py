@@ -642,8 +642,28 @@ class TPUServeServer:
         )
 
     async def _metrics(self, _request: web.Request) -> web.Response:
-        return web.Response(body=self.metrics.export(),
-                            content_type="text/plain")
+        body = self.metrics.export() + self._engine_gauges()
+        return web.Response(body=body, content_type="text/plain")
+
+    def _engine_gauges(self) -> bytes:
+        """EngineStats as Prometheus gauges (the /state telemetry, in
+        scrapeable form)."""
+        s = self.engine.stats
+        lines = []
+        for name, value in (
+            ("tpuserve_active_slots", s.active_slots),
+            ("tpuserve_queued_requests", s.queued),
+            ("tpuserve_kv_pages_free", s.kv_pages_free),
+            ("tpuserve_kv_occupancy", s.kv_occupancy),
+            ("tpuserve_tokens_generated_total", s.tokens_generated),
+            ("tpuserve_prefills_total", s.prefills),
+            ("tpuserve_decode_steps_total", s.decode_steps),
+            ("tpuserve_prefix_cache_hits_total", s.prefix_cache_hits),
+            ("tpuserve_prefix_tokens_reused_total", s.prefix_tokens_reused),
+        ):
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {value}")
+        return ("\n".join(lines) + "\n").encode()
 
 
 async def run_tpuserve(

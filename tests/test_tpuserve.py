@@ -260,6 +260,17 @@ class TestTPUServeServer:
         got = json.loads(body)
         assert status == 200 and got["count"] == 5
 
+    def test_metrics_engine_gauges(self, tpuserve_url):
+        async def main():
+            async with aiohttp.ClientSession() as s:
+                async with s.get(tpuserve_url + "/metrics") as resp:
+                    return await resp.text()
+
+        text = asyncio.run(main())
+        assert "tpuserve_kv_occupancy" in text
+        assert "tpuserve_prefix_cache_hits_total" in text
+        assert "gen_ai_server_request_duration_seconds" in text
+
     def test_state_telemetry(self, tpuserve_url):
         async def main():
             async with aiohttp.ClientSession() as s:
