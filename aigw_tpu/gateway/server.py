@@ -200,9 +200,12 @@ class GatewayServer:
         self.app.router.add_get("/v1/models", self._handle_models)
         self.app.router.add_get("/health", self._handle_health)
         self.app.router.add_get("/metrics", self._handle_metrics)
-        # debug/admin surface (reference: pprof :6060 + admin server;
-        # internal/pprof/pprof.go:18-40) — enabled unless AIGW_DISABLE_DEBUG
-        if os.environ.get("AIGW_DISABLE_DEBUG", "").lower() != "true":
+        # debug/admin surface (reference: pprof :6060 + admin server on a
+        # separate local port, internal/pprof/pprof.go:18-40). Off by
+        # default on the data-plane port — any API client could otherwise
+        # read thread stacks and config topology; opt in with
+        # AIGW_ENABLE_DEBUG=true (e.g. when bound to localhost).
+        if os.environ.get("AIGW_ENABLE_DEBUG", "").lower() == "true":
             self.app.router.add_get("/debug/config", self._handle_debug_config)
             self.app.router.add_get("/debug/stacks", self._handle_debug_stacks)
         self._pickers: dict[str, EndpointPicker] = {}
@@ -469,7 +472,8 @@ class GatewayServer:
                 logger.warning(
                     "backend %s failed (%s), trying next", rb.backend.name, e
                 )
-                self.circuit.record_failure(rb.backend.name)
+                if e.count_failure:
+                    self.circuit.record_failure(rb.backend.name)
                 last_error = (e.status, e.client_body)
                 self.metrics.requests_total.labels(
                     route_name, rb.backend.name, str(e.status)
@@ -624,7 +628,7 @@ class GatewayServer:
                 try:
                     err = await resp.read()
                 except (aiohttp.ClientError, asyncio.TimeoutError):
-                    err = b
+                    err = b""
                 client_err = translator.response_error(resp.status, err)
                 if resp.status in _RETRIABLE_STATUS:
                     raise _RetriableUpstreamError(resp.status, client_err,
@@ -648,7 +652,7 @@ class GatewayServer:
             if upstream_streams:
                 return await self._stream_response(
                     request, resp, translator, rb, req_metrics, route_name,
-                    client_headers,
+                    client_headers, front_schema,
                 )
             try:
                 raw = await resp.read()
@@ -682,6 +686,7 @@ class GatewayServer:
         req_metrics: RequestMetrics,
         route_name: str,
         client_headers: dict[str, str],
+        front_schema: APISchemaName = APISchemaName.OPENAI,
     ) -> web.StreamResponse:
         """Proxy the SSE stream through the translator — the hot loop
         (reference processor_impl.go:481-575)."""
@@ -713,11 +718,23 @@ class GatewayServer:
             # Mid-stream failure: the client already has bytes; surface an
             # SSE error event rather than failing over (the reference's
             # per-try idle timeout only retries before response start).
+            # The event is shaped for the *front* schema so the client
+            # SDK recognizes it (Anthropic SDKs need `event: error` with
+            # an Anthropic error envelope).
             logger.warning("stream from %s aborted: %s", rb.backend.name, e)
-            await out.write(
-                b'data: {"error": {"message": "upstream stream interrupted", '
-                b'"type": "upstream_error", "code": null}}\n\n'
-            )
+            if front_schema is APISchemaName.ANTHROPIC:
+                await out.write(
+                    b'event: error\n'
+                    b'data: {"type": "error", "error": {"type": '
+                    b'"overloaded_error", "message": '
+                    b'"upstream stream interrupted"}}\n\n'
+                )
+            else:
+                await out.write(
+                    b'data: {"error": {"message": '
+                    b'"upstream stream interrupted", '
+                    b'"type": "upstream_error", "code": null}}\n\n'
+                )
         req_metrics.response_model = model
         req_metrics.finish(usage)
         self._sink_costs(usage, req_metrics, route_name, client_headers)
@@ -744,9 +761,12 @@ class GatewayServer:
         )
         if rule.backend:
             # a backend-scoped budget: other backends may still have
-            # budget, so fail over like any other backend-level 429
+            # budget, so fail over — but without a circuit-breaker
+            # failure mark (the backend is healthy; a refilled quota
+            # window must not find the circuit open)
             raise _RetriableUpstreamError(429, client_err,
-                                          f"quota {rule.name}")
+                                          f"quota {rule.name}",
+                                          count_failure=False)
         req_metrics.finish(TokenUsage(), error_type="429")
         return web.Response(
             status=429,
@@ -790,10 +810,15 @@ class GatewayServer:
 
 
 class _RetriableUpstreamError(Exception):
-    def __init__(self, status: int, client_body: bytes, reason: str):
+    def __init__(self, status: int, client_body: bytes, reason: str,
+                 count_failure: bool = True):
         super().__init__(reason)
         self.status = status
         self.client_body = client_body
+        #: whether the circuit breaker should count this as a backend
+        #: failure; quota rejections fail over without poisoning the
+        #: circuit (the backend itself is healthy)
+        self.count_failure = count_failure
 
 
 class _closing:

@@ -66,7 +66,182 @@ def message_content_text(content: Any) -> str:
     raise SchemaError(f"invalid message content type {type(content).__name__}")
 
 
+#: content-part types accepted in user messages (reference openai.go
+#: ChatCompletionContentPartUnionParam)
+_USER_CONTENT_PART_TYPES = ("text", "image_url", "input_audio", "file")
+
+
+def _validate_content(i: int, role: str, content: Any) -> None:
+    if content is None or isinstance(content, str):
+        return
+    if not isinstance(content, list):
+        raise SchemaError(
+            f"messages[{i}].content must be a string or an array of "
+            f"content parts, got {type(content).__name__}")
+    for j, part in enumerate(content):
+        if not isinstance(part, dict):
+            raise SchemaError(
+                f"messages[{i}].content[{j}] must be an object")
+        ptype = part.get("type")
+        if role == "user":
+            if ptype not in _USER_CONTENT_PART_TYPES:
+                raise SchemaError(
+                    f"messages[{i}].content[{j}] has invalid type "
+                    f"{ptype!r}")
+            if ptype == "text" and not isinstance(part.get("text"), str):
+                raise SchemaError(
+                    f"messages[{i}].content[{j}].text must be a string")
+            if ptype == "image_url" and not isinstance(
+                    part.get("image_url"), dict):
+                raise SchemaError(
+                    f"messages[{i}].content[{j}].image_url must be an "
+                    "object")
+        else:  # assistant/system/developer/tool accept text (+ assistant
+            # refusal) parts
+            if ptype == "refusal" and role == "assistant":
+                if not isinstance(part.get("refusal"), str):
+                    raise SchemaError(
+                        f"messages[{i}].content[{j}].refusal must be a "
+                        "string")
+                continue
+            if ptype != "text":
+                raise SchemaError(
+                    f"messages[{i}].content[{j}] has invalid type "
+                    f"{ptype!r} for role {role!r}")
+            if not isinstance(part.get("text"), str):
+                raise SchemaError(
+                    f"messages[{i}].content[{j}].text must be a string")
+
+
+def _validate_tool_calls(i: int, tool_calls: Any) -> None:
+    if tool_calls is None:
+        return
+    if not isinstance(tool_calls, list):
+        raise SchemaError(f"messages[{i}].tool_calls must be an array")
+    for j, tc in enumerate(tool_calls):
+        if not isinstance(tc, dict):
+            raise SchemaError(
+                f"messages[{i}].tool_calls[{j}] must be an object")
+        ttype = tc.get("type")
+        if ttype == "custom":
+            cu = tc.get("custom")
+            if not isinstance(cu, dict) or not isinstance(
+                    cu.get("name"), str):
+                raise SchemaError(
+                    f"messages[{i}].tool_calls[{j}].custom.name is "
+                    "required")
+            continue
+        if ttype != "function":
+            raise SchemaError(
+                f"messages[{i}].tool_calls[{j}].type must be 'function' "
+                "or 'custom'")
+        fn = tc.get("function")
+        if not isinstance(fn, dict) or not isinstance(fn.get("name"), str):
+            raise SchemaError(
+                f"messages[{i}].tool_calls[{j}].function.name is required")
+        args = fn.get("arguments")
+        if args is not None and not isinstance(args, str):
+            raise SchemaError(
+                f"messages[{i}].tool_calls[{j}].function.arguments must "
+                "be a string")
+
+
+def _validate_tools(body: dict[str, Any]) -> None:
+    tools = body.get("tools")
+    if tools is None:
+        return
+    if not isinstance(tools, list):
+        raise SchemaError("tools must be an array")
+    for i, t in enumerate(tools):
+        if not isinstance(t, dict):
+            raise SchemaError(f"tools[{i}] must be an object")
+        ttype = t.get("type")
+        if ttype != "function":
+            raise SchemaError(
+                f"tools[{i}].type must be 'function', got {ttype!r}")
+        fn = t.get("function")
+        if not isinstance(fn, dict):
+            raise SchemaError(f"tools[{i}].function must be an object")
+        if not isinstance(fn.get("name"), str) or not fn.get("name"):
+            raise SchemaError(f"tools[{i}].function.name is required")
+        params = fn.get("parameters")
+        if params is not None and not isinstance(params, dict):
+            raise SchemaError(
+                f"tools[{i}].function.parameters must be an object")
+
+
+def _validate_tool_choice(body: dict[str, Any]) -> None:
+    choice = body.get("tool_choice")
+    if choice is None:
+        return
+    if isinstance(choice, str):
+        if choice not in ("none", "auto", "required"):
+            raise SchemaError(
+                f"tool_choice must be 'none', 'auto', 'required' or a "
+                f"named-tool object, got {choice!r}")
+        return
+    if not isinstance(choice, dict):
+        raise SchemaError("tool_choice must be a string or an object")
+    if choice.get("type") != "function":
+        raise SchemaError("tool_choice.type must be 'function'")
+    fn = choice.get("function")
+    if not isinstance(fn, dict) or not isinstance(fn.get("name"), str) \
+            or not fn.get("name"):
+        raise SchemaError("tool_choice.function.name is required")
+    if body.get("tools") in (None, []):
+        raise SchemaError(
+            "tool_choice requires a non-empty tools array")
+
+
+def _validate_stream_options(body: dict[str, Any]) -> None:
+    opts = body.get("stream_options")
+    if opts is None:
+        return
+    if not isinstance(opts, dict):
+        raise SchemaError("stream_options must be an object")
+    if not body.get("stream"):
+        raise SchemaError(
+            "stream_options is only allowed when stream is true")
+    iu = opts.get("include_usage")
+    if iu is not None and not isinstance(iu, bool):
+        raise SchemaError("stream_options.include_usage must be a boolean")
+
+
+def _validate_sampling_fields(body: dict[str, Any]) -> None:
+    for key, lo, hi in (("temperature", 0.0, 2.0), ("top_p", 0.0, 1.0),
+                        ("presence_penalty", -2.0, 2.0),
+                        ("frequency_penalty", -2.0, 2.0)):
+        v = body.get(key)
+        if v is None:
+            continue
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            raise SchemaError(f"{key} must be a number")
+        if not (lo <= float(v) <= hi):
+            raise SchemaError(f"{key} must be between {lo} and {hi}")
+    n = body.get("n")
+    if n is not None and (isinstance(n, bool) or not isinstance(n, int)
+                          or n < 1):
+        raise SchemaError("n must be a positive integer")
+    lp = body.get("logprobs")
+    if lp is not None and not isinstance(lp, bool):
+        raise SchemaError("logprobs must be a boolean")
+    tlp = body.get("top_logprobs")
+    if tlp is not None:
+        if isinstance(tlp, bool) or not isinstance(tlp, int) \
+                or not (0 <= tlp <= 20):
+            raise SchemaError("top_logprobs must be an integer in [0, 20]")
+    stop = body.get("stop")
+    if stop is not None and not isinstance(stop, str):
+        if not isinstance(stop, list) or \
+                any(not isinstance(s, str) for s in stop):
+            raise SchemaError(
+                "stop must be a string or an array of strings")
+
+
 def validate_chat_request(body: dict[str, Any]) -> None:
+    """Strict request validation at the edge (reference: typed unmarshal
+    of apischema/openai ChatCompletionRequest 400s malformed bodies
+    before any upstream traffic)."""
     request_model(body)
     messages = body.get("messages")
     if not isinstance(messages, list) or not messages:
@@ -77,6 +252,26 @@ def validate_chat_request(body: dict[str, Any]) -> None:
         role = m.get("role")
         if role not in ("system", "developer", "user", "assistant", "tool"):
             raise SchemaError(f"messages[{i}] has invalid role {role!r}")
+        _validate_content(i, role, m.get("content"))
+        if role == "assistant":
+            _validate_tool_calls(i, m.get("tool_calls"))
+        if role == "tool" and not isinstance(m.get("tool_call_id"), str):
+            raise SchemaError(
+                f"messages[{i}] with role 'tool' requires tool_call_id")
+    _validate_tools(body)
+    _validate_tool_choice(body)
+    _validate_stream_options(body)
+    _validate_sampling_fields(body)
+    # response_format union (lazy import: translate package imports us)
+    from aigw_tpu.translate.structured import (
+        JSONSchemaError,
+        parse_response_format,
+    )
+
+    try:
+        parse_response_format(body)
+    except JSONSchemaError as e:
+        raise SchemaError(str(e)) from None
 
 
 # ---------------------------------------------------------------------------
@@ -150,6 +345,7 @@ def chat_completion_chunk(
     finish_reason: str | None = None,
     usage: TokenUsage | None = None,
     created: int = 0,
+    logprobs: dict[str, Any] | None = None,
 ) -> dict[str, Any]:
     chunk: dict[str, Any] = {
         "id": response_id,
@@ -159,13 +355,14 @@ def chat_completion_chunk(
         "choices": [],
     }
     if delta is not None or finish_reason is not None:
-        chunk["choices"] = [
-            {
-                "index": 0,
-                "delta": delta if delta is not None else {},
-                "finish_reason": finish_reason,
-            }
-        ]
+        choice: dict[str, Any] = {
+            "index": 0,
+            "delta": delta if delta is not None else {},
+            "finish_reason": finish_reason,
+        }
+        if logprobs is not None:
+            choice["logprobs"] = logprobs
+        chunk["choices"] = [choice]
     if usage is not None:
         chunk["usage"] = usage_dict(usage)
     return chunk
@@ -179,6 +376,7 @@ def stream_chunk_sse(
     delta: dict[str, Any] | None = None,
     finish_reason: str | None = None,
     usage: TokenUsage | None = None,
+    logprobs: dict[str, Any] | None = None,
 ) -> bytes:
     """One chat.completion.chunk encoded as an SSE event — the shared
     emitter for every cross-schema streaming translator."""
@@ -193,6 +391,7 @@ def stream_chunk_sse(
                 finish_reason=finish_reason,
                 usage=usage,
                 created=created,
+                logprobs=logprobs,
             )
         )
     ).encode()

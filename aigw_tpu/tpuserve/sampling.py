@@ -86,8 +86,12 @@ def sample(
     kth = jnp.take_along_axis(sorted_logits, k_idx[:, None], axis=-1)
     keep_k = (top_k[:, None] <= 0) | (logits >= kth)
 
-    # top-p (nucleus) mask over the sorted distribution
-    probs_sorted = jax.nn.softmax(sorted_logits, axis=-1)
+    # top-p (nucleus) mask over the sorted distribution. OpenAI/vLLM
+    # semantics: temperature scaling precedes the nucleus cutoff, so
+    # membership is computed on the *scaled* distribution (sort order is
+    # invariant under the positive scale, so one sort serves both masks).
+    inv_t = 1.0 / jnp.maximum(temperature[:, None], 1e-6)
+    probs_sorted = jax.nn.softmax(sorted_logits * inv_t, axis=-1)
     cum = jnp.cumsum(probs_sorted, axis=-1)
     # keep tokens whose cumulative mass *before* them is < top_p
     cutoff_mass = cum - probs_sorted

@@ -88,6 +88,12 @@ class _BedrockAnthropicStream:
 
 
 class OpenAIToVertexAnthropic(OpenAIToAnthropicChat):
+    def __init__(self, **kw: Any):
+        # GCP-hosted Anthropic lacks structured-output support (reference
+        # anthropic_helper.go isGCPBackend check): skip output_config.
+        kw.setdefault("gcp_backend", True)
+        super().__init__(**kw)
+
     def request(self, body: dict[str, Any]) -> RequestTx:
         return _vertexify(super().request(body))
 

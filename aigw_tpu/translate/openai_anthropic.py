@@ -26,6 +26,7 @@ from aigw_tpu.translate.base import (
     register_translator,
 )
 from aigw_tpu.translate.sse import SSEEvent, SSEParser
+from aigw_tpu.translate.structured import parse_response_format
 
 
 def openai_messages_to_anthropic(
@@ -175,8 +176,10 @@ def anthropic_usage_to_openai(usage: TokenUsage) -> TokenUsage:
 class OpenAIToAnthropicChat(Translator):
     """OpenAI chat completions client ⇄ Anthropic messages upstream."""
 
-    def __init__(self, *, model_name_override: str = "", stream: bool = False):
+    def __init__(self, *, model_name_override: str = "", stream: bool = False,
+                 gcp_backend: bool = False):
         self._override = model_name_override
+        self._gcp = gcp_backend
         self._stream = stream
         self._include_usage = False
         self._parser = SSEParser()
@@ -216,6 +219,27 @@ class OpenAIToAnthropicChat(Translator):
         if stop:
             out["stop_sequences"] = [stop] if isinstance(stop, str) else list(stop)
         out.update(openai_tools_to_anthropic(body))
+        # Structured outputs: response_format json_schema → Anthropic
+        # output_config.format (reference anthropic_helper.go:712-734).
+        # GCP-hosted Anthropic does not support structured output; the
+        # reference skips it there too (isGCPBackend check). The schema
+        # passes through verbatim — Anthropic accepts standard JSON
+        # Schema including $defs/$ref.
+        rf = parse_response_format(body)
+        if (rf is not None and rf.kind == "json_schema"
+                and rf.schema is not None and not self._gcp):
+            out["output_config"] = {
+                "format": {"type": "json_schema", "schema": rf.schema}
+            }
+        # reasoning_effort → output_config.effort (anthropic_helper.go:737)
+        effort = body.get("reasoning_effort")
+        if effort and not self._gcp:
+            if effort == "minimal":  # OpenAI's lowest tier → Anthropic low
+                effort = "low"
+            if effort not in ("low", "medium", "high", "xhigh", "max"):
+                raise TranslationError(
+                    f"unsupported reasoning effort level: {effort!r}")
+            out.setdefault("output_config", {})["effort"] = effort
         if self._stream:
             out["stream"] = True
         if isinstance(body.get("metadata"), dict) and body["metadata"].get("user_id"):
@@ -393,7 +417,8 @@ class OpenAIToAnthropicChat(Translator):
         )
 
 
-def _factory(*, model_name_override: str = "", stream: bool = False, **_: object):
+def _factory(*, model_name_override: str = "", stream: bool = False,
+             **_: object):
     return OpenAIToAnthropicChat(
         model_name_override=model_name_override, stream=stream
     )
@@ -405,18 +430,6 @@ register_translator(
     APISchemaName.ANTHROPIC,
     _factory,
 )
-# GCP/AWS-hosted Anthropic speak the same messages schema with different
-# paths/auth; the backend's URL+auth handle the difference (reference
-# openai→gcpanthropic/awsanthropic reuse the same body mapping).
-register_translator(
-    Endpoint.CHAT_COMPLETIONS,
-    APISchemaName.OPENAI,
-    APISchemaName.GCP_ANTHROPIC,
-    _factory,
-)
-register_translator(
-    Endpoint.CHAT_COMPLETIONS,
-    APISchemaName.OPENAI,
-    APISchemaName.AWS_ANTHROPIC,
-    _factory,
-)
+# The GCP/AWS-hosted Anthropic variants (different envelopes/paths; GCP
+# additionally lacks structured-output support) are registered by
+# anthropic_hosted.py, which subclasses this translator.

@@ -26,6 +26,11 @@ from aigw_tpu.translate.base import (
 )
 from aigw_tpu.translate.eventstream import EventStreamParser
 from aigw_tpu.translate.sse import SSEEvent
+from aigw_tpu.translate.structured import (
+    JSONSchemaError,
+    dereference,
+    parse_response_format,
+)
 
 _STOP_TO_OPENAI = {
     "end_turn": "stop",
@@ -159,6 +164,12 @@ class OpenAIToBedrockChat(Translator):
         self._tool_idx = -1
         self._finish: str | None = None
         self._sent_done = False
+        #: name of the synthetic structured-output tool ("" = none); set
+        #: when response_format json_schema is requested — Converse has no
+        #: native structured output, so the schema rides a forced tool
+        #: whose toolUse input is converted back into message content
+        self._json_tool = ""
+        self._in_json_block = False
 
     def request(self, body: dict[str, Any]) -> RequestTx:
         oai.validate_chat_request(body)
@@ -217,6 +228,31 @@ class OpenAIToBedrockChat(Translator):
                     "tool": {"name": (choice.get("function") or {}).get("name", "")}
                 }
             out["toolConfig"] = tool_config
+        rf = parse_response_format(body)
+        if rf is not None and rf.kind == "json_schema" \
+                and rf.schema is not None:
+            if tools:
+                raise TranslationError(
+                    "response_format json_schema cannot be combined with "
+                    "tools for AWS Bedrock backends")
+            name = rf.name or "json_response"
+            try:
+                schema = dereference(rf.schema)
+            except JSONSchemaError as e:
+                raise TranslationError(
+                    f"invalid JSON schema: {e}") from None
+            out["toolConfig"] = {
+                "tools": [{
+                    "toolSpec": {
+                        "name": name,
+                        "description":
+                            "Respond with JSON matching this schema.",
+                        "inputSchema": {"json": schema},
+                    }
+                }],
+                "toolChoice": {"tool": {"name": name}},
+            }
+            self._json_tool = name
         verb = "converse-stream" if self._stream else "converse"
         model_id = urllib.parse.quote(self._model, safe="")
         return RequestTx(
@@ -243,6 +279,11 @@ class OpenAIToBedrockChat(Translator):
                 text_parts.append(block["text"])
             elif "toolUse" in block:
                 tu = block["toolUse"]
+                if self._json_tool and tu.get("name") == self._json_tool:
+                    # structured output rode the forced tool: the input IS
+                    # the JSON response
+                    text_parts.append(json.dumps(tu.get("input", {})))
+                    continue
                 tool_calls.append(
                     {
                         "id": tu.get("toolUseId", ""),
@@ -254,6 +295,8 @@ class OpenAIToBedrockChat(Translator):
                     }
                 )
         finish = _STOP_TO_OPENAI.get(data.get("stopReason") or "end_turn", "stop")
+        if self._json_tool and not tool_calls and finish == "tool_calls":
+            finish = "stop"
         out = oai.chat_completion_response(
             model=self._model,
             content="".join(text_parts),
@@ -295,7 +338,10 @@ class OpenAIToBedrockChat(Translator):
                 out += self._emit({"role": "assistant", "content": ""})
             elif etype == "contentBlockStart":
                 start = (data.get("start") or {}).get("toolUse")
-                if start:
+                if start and self._json_tool \
+                        and start.get("name") == self._json_tool:
+                    self._in_json_block = True
+                elif start:
                     self._tool_idx += 1
                     out += self._emit(
                         {
@@ -318,20 +364,27 @@ class OpenAIToBedrockChat(Translator):
                     tokens += 1
                     out += self._emit({"content": delta["text"]})
                 elif "toolUse" in delta:
-                    out += self._emit(
-                        {
-                            "tool_calls": [
-                                {
-                                    "index": self._tool_idx,
-                                    "function": {
-                                        "arguments": delta["toolUse"].get(
-                                            "input", ""
-                                        )
-                                    },
-                                }
-                            ]
-                        }
-                    )
+                    if self._in_json_block:
+                        # structured-output tool: stream the JSON as
+                        # content deltas
+                        tokens += 1
+                        out += self._emit(
+                            {"content": delta["toolUse"].get("input", "")})
+                    else:
+                        out += self._emit(
+                            {
+                                "tool_calls": [
+                                    {
+                                        "index": self._tool_idx,
+                                        "function": {
+                                            "arguments": delta["toolUse"].get(
+                                                "input", ""
+                                            )
+                                        },
+                                    }
+                                ]
+                            }
+                        )
                 elif "reasoningContent" in delta:
                     rc = delta["reasoningContent"]
                     if rc.get("text"):
@@ -341,6 +394,9 @@ class OpenAIToBedrockChat(Translator):
                 self._finish = _STOP_TO_OPENAI.get(
                     data.get("stopReason") or "end_turn", "stop"
                 )
+                if self._json_tool and self._finish == "tool_calls" \
+                        and self._tool_idx < 0:
+                    self._finish = "stop"
             elif etype == "metadata":
                 self._usage = self._usage.merge_override(
                     converse_usage(data.get("usage") or {})

@@ -25,6 +25,11 @@ from aigw_tpu.translate.base import (
     register_translator,
 )
 from aigw_tpu.translate.sse import SSEEvent, SSEParser
+from aigw_tpu.translate.structured import (
+    JSONSchemaError,
+    parse_response_format,
+    to_gemini_schema,
+)
 
 _FINISH_TO_OPENAI = {
     "STOP": "stop",
@@ -35,6 +40,30 @@ _FINISH_TO_OPENAI = {
     "BLOCKLIST": "content_filter",
     "MALFORMED_FUNCTION_CALL": "tool_calls",
 }
+
+
+def gemini_logprobs_to_openai(result: dict[str, Any]) -> dict[str, Any] | None:
+    """Gemini logprobsResult → OpenAI choice.logprobs
+    (gemini_helper.go geminiLogprobsToOpenAILogprobs:991-1031)."""
+    chosen = result.get("chosenCandidates") or []
+    if not chosen:
+        return None
+    top = result.get("topCandidates") or []
+    content = []
+    for i, c in enumerate(chosen):
+        top_lps = []
+        if i < len(top) and isinstance(top[i], dict):
+            for tc in top[i].get("candidates") or []:
+                top_lps.append({
+                    "token": tc.get("token", ""),
+                    "logprob": float(tc.get("logProbability", 0.0) or 0.0),
+                })
+        content.append({
+            "token": c.get("token", ""),
+            "logprob": float(c.get("logProbability", 0.0) or 0.0),
+            "top_logprobs": top_lps,
+        })
+    return {"content": content}
 
 
 def gemini_usage(data: dict[str, Any]) -> TokenUsage:
@@ -156,6 +185,7 @@ class OpenAIToGeminiChat(Translator):
         self._finish: str | None = None
         self._sent_role = False
         self._sent_done = False
+        self._want_logprobs = False
 
     def request(self, body: dict[str, Any]) -> RequestTx:
         oai.validate_chat_request(body)
@@ -184,6 +214,20 @@ class OpenAIToGeminiChat(Translator):
                     "n>1 is not supported for streaming Gemini requests"
                 )
             gen["candidateCount"] = n
+        if body.get("seed") is not None:
+            gen["seed"] = int(body["seed"])
+        if body.get("presence_penalty") is not None:
+            gen["presencePenalty"] = float(body["presence_penalty"])
+        if body.get("frequency_penalty") is not None:
+            gen["frequencyPenalty"] = float(body["frequency_penalty"])
+        # logprobs (gemini_helper.go:657-665): top_logprobs → logprobs
+        # count, logprobs flag → responseLogprobs
+        if body.get("top_logprobs") is not None:
+            gen["logprobs"] = int(body["top_logprobs"])
+        if body.get("logprobs") is not None:
+            gen["responseLogprobs"] = bool(body["logprobs"])
+        self._want_logprobs = bool(body.get("logprobs"))
+        self._apply_output_format(body, gen)
         if gen:
             out["generationConfig"] = gen
         tools = body.get("tools")
@@ -228,6 +272,46 @@ class OpenAIToGeminiChat(Translator):
             body=json.dumps(out).encode(), path=path, stream=self._stream
         )
 
+    def _apply_output_format(self, body: dict[str, Any],
+                             gen: dict[str, Any]) -> None:
+        """response_format + guided_{choice,regex,json} → Gemini response
+        MIME type / schema (gemini_helper.go:667-744). The vLLM-style
+        guided_* vendor fields and response_format are mutually
+        exclusive."""
+        specified = 0
+        rf = parse_response_format(body)
+        if rf is not None:
+            specified += 1
+            if rf.kind == "text":
+                gen["responseMimeType"] = "text/plain"
+            elif rf.kind == "json_object":
+                gen["responseMimeType"] = "application/json"
+            elif rf.kind == "json_schema" and rf.schema is not None:
+                gen["responseMimeType"] = "application/json"
+                try:
+                    gen["responseSchema"] = to_gemini_schema(rf.schema)
+                except JSONSchemaError as e:
+                    raise TranslationError(
+                        f"invalid JSON schema: {e}") from None
+        if body.get("guided_choice") is not None:
+            specified += 1
+            gen["responseMimeType"] = "text/x.enum"
+            gen["responseSchema"] = {"type": "STRING",
+                                     "enum": list(body["guided_choice"])}
+        if body.get("guided_regex"):
+            specified += 1
+            gen["responseMimeType"] = "application/json"
+            gen["responseSchema"] = {"type": "STRING",
+                                     "pattern": str(body["guided_regex"])}
+        if body.get("guided_json") is not None:
+            specified += 1
+            gen["responseMimeType"] = "application/json"
+            gen["responseJsonSchema"] = body["guided_json"]
+        if specified > 1:
+            raise TranslationError(
+                "only one of response_format, guided_choice, guided_regex, "
+                "guided_json can be specified")
+
     def response_body(self, chunk: bytes, end_of_stream: bool) -> ResponseTx:
         if self._stream:
             return self._stream_chunk(chunk, end_of_stream)
@@ -265,9 +349,15 @@ class OpenAIToGeminiChat(Translator):
                 message["tool_calls"] = tool_calls
                 if not text:
                     message["content"] = None
-            choices.append(
-                {"index": i, "message": message, "finish_reason": finish}
-            )
+            choice: dict[str, Any] = {
+                "index": i, "message": message, "finish_reason": finish
+            }
+            if self._want_logprobs:
+                lp = gemini_logprobs_to_openai(
+                    cand.get("logprobsResult") or {})
+                if lp is not None:
+                    choice["logprobs"] = lp
+            choices.append(choice)
         out = {
             "id": self._id,
             "object": "chat.completion",
@@ -299,10 +389,16 @@ class OpenAIToGeminiChat(Translator):
                 self._sent_role = True
                 out += self._emit({"role": "assistant", "content": ""})
             for cand in data.get("candidates") or ():
+                chunk_lp = None
+                if self._want_logprobs:
+                    chunk_lp = gemini_logprobs_to_openai(
+                        cand.get("logprobsResult") or {})
                 for p in (cand.get("content") or {}).get("parts") or ():
                     if p.get("text"):
                         tokens += 1
-                        out += self._emit({"content": p["text"]})
+                        out += self._emit({"content": p["text"]},
+                                          logprobs=chunk_lp)
+                        chunk_lp = None  # attach once per upstream chunk
                     elif "functionCall" in p:
                         self._tool_idx += 1
                         fc = p["functionCall"]
@@ -348,10 +444,11 @@ class OpenAIToGeminiChat(Translator):
             body=bytes(out), usage=usage, model=self._model, tokens_emitted=tokens
         )
 
-    def _emit(self, delta: dict[str, Any]) -> bytes:
+    def _emit(self, delta: dict[str, Any],
+              logprobs: dict[str, Any] | None = None) -> bytes:
         return oai.stream_chunk_sse(
             response_id=self._id, model=self._model, created=self._created,
-            delta=delta,
+            delta=delta, logprobs=logprobs,
         )
 
 

@@ -1,0 +1,358 @@
+"""Failure-path sweep for the gateway's _attempt/_stream_response except
+branches (VERDICT r1 item 4): error-body read failures, mid-stream
+disconnects (both front schemas), stream-idle timeout, and quota-429
+interaction with the circuit breaker (ADVICE r1)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import aiohttp
+
+from aigw_tpu.config.model import Config
+from aigw_tpu.config.runtime import RuntimeConfig
+from aigw_tpu.gateway.server import run_gateway
+
+from fakes import FakeUpstream, openai_chat_response
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TruncatingUpstream:
+    """Raw TCP server speaking just enough HTTP/1.1 to advertise a body it
+    never sends — forces the gateway's `resp.read()` to raise mid-error-body
+    (the `err = b` NameError regression, gateway/server.py)."""
+
+    def __init__(self, status: int = 400):
+        self.status = status
+        self.url = ""
+        self._server: asyncio.AbstractServer | None = None
+        self.hits = 0
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        self.hits += 1
+        # drain the request (headers + body) without parsing carefully
+        try:
+            await asyncio.wait_for(reader.read(65536), timeout=1.0)
+        except asyncio.TimeoutError:
+            pass
+        reason = {400: "Bad Request", 503: "Service Unavailable"}.get(
+            self.status, "Error")
+        writer.write(
+            f"HTTP/1.1 {self.status} {reason}\r\n"
+            "Content-Type: application/json\r\n"
+            "Content-Length: 1000\r\n"
+            "\r\n"
+            '{"partial": '.encode()
+        )
+        await writer.drain()
+        writer.close()  # body truncated: 1000 promised, ~13 sent
+
+    async def start(self) -> "TruncatingUpstream":
+        self._server = await asyncio.start_server(
+            self._handle, "127.0.0.1", 0)
+        port = self._server.sockets[0].getsockname()[1]
+        self.url = f"http://127.0.0.1:{port}"
+        return self
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+
+CHAT = {"model": "m1", "messages": [{"role": "user", "content": "hi"}]}
+
+
+def _config(backends, routes, extra=None):
+    d = {"version": "v1", "backends": backends, "routes": routes,
+         "models": ["m1"]}
+    if extra:
+        d.update(extra)
+    return Config.parse(d)
+
+
+async def _start(cfg, **kw):
+    server, runner = await run_gateway(RuntimeConfig.build(cfg), port=0, **kw)
+    site = list(runner.sites)[0]
+    port = site._server.sockets[0].getsockname()[1]
+    return server, runner, f"http://127.0.0.1:{port}"
+
+
+class TestErrorBodyReadFailure:
+    def test_nonretriable_error_body_truncated_returns_4xx(self):
+        """Upstream 400 whose error body read fails → the gateway falls
+        back to an empty error body and still answers 400 (previously a
+        NameError → 500)."""
+
+        async def main():
+            up = await TruncatingUpstream(status=400).start()
+            cfg = _config(
+                [{"name": "a", "schema": "OpenAI", "url": up.url}],
+                [{"name": "r", "rules": [{"models": ["m1"],
+                                          "backends": ["a"]}]}],
+            )
+            server, runner, url = await _start(cfg)
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(url + "/v1/chat/completions",
+                                      json=CHAT) as resp:
+                        assert resp.status == 400
+                        body = await resp.json()
+                        assert "error" in body
+            finally:
+                await runner.cleanup()
+                await up.stop()
+
+        run(main())
+
+    def test_retriable_error_body_truncated_fails_over(self):
+        """Upstream 503 with a truncated error body must still fail over
+        to the healthy backend."""
+
+        async def main():
+            bad = await TruncatingUpstream(status=503).start()
+            good = await FakeUpstream().on_json(
+                "/v1/chat/completions", openai_chat_response("rescued")
+            ).start()
+            cfg = _config(
+                [{"name": "a", "schema": "OpenAI", "url": bad.url},
+                 {"name": "b", "schema": "OpenAI", "url": good.url}],
+                [{"name": "r", "rules": [
+                    {"models": ["m1"],
+                     "backends": [{"backend": "a", "priority": 0},
+                                  {"backend": "b", "priority": 1}]}]}],
+            )
+            server, runner, url = await _start(cfg)
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(url + "/v1/chat/completions",
+                                      json=CHAT) as resp:
+                        assert resp.status == 200
+                        body = await resp.json()
+                        content = body["choices"][0]["message"]["content"]
+                        assert content == "rescued"
+                assert bad.hits == 1
+            finally:
+                await runner.cleanup()
+                await bad.stop()
+                await good.stop()
+
+        run(main())
+
+
+class TestMidStreamFailure:
+    def test_openai_front_disconnect_emits_openai_error_event(self):
+        async def main():
+            up = FakeUpstream()
+
+            async def aborting_sse(cap):
+                from aiohttp import web
+
+                resp = web.StreamResponse(
+                    status=200,
+                    headers={"content-type": "text/event-stream"})
+                await resp.prepare(cap._request)
+                chunk = {"id": "c", "object": "chat.completion.chunk",
+                         "created": 1, "model": "fake",
+                         "choices": [{"index": 0,
+                                      "delta": {"content": "hi"},
+                                      "finish_reason": None}]}
+                await resp.write(
+                    f"data: {json.dumps(chunk)}\n\n".encode())
+                await asyncio.sleep(0.05)
+                cap._request.transport.close()  # hard abort mid-stream
+                return resp
+
+            up.on("/v1/chat/completions", aborting_sse)
+            await up.start()
+            cfg = _config(
+                [{"name": "a", "schema": "OpenAI", "url": up.url}],
+                [{"name": "r", "rules": [{"models": ["m1"],
+                                          "backends": ["a"]}]}],
+            )
+            server, runner, url = await _start(cfg)
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        url + "/v1/chat/completions",
+                        json={**CHAT, "stream": True},
+                    ) as resp:
+                        assert resp.status == 200
+                        text = (await resp.read()).decode()
+                assert '"content": "hi"' in text or '"content":"hi"' in text
+                assert "upstream stream interrupted" in text
+                assert '"type": "upstream_error"' in text
+                assert "event: error" not in text  # OpenAI shape, no event line
+            finally:
+                await runner.cleanup()
+                await up.stop()
+
+        run(main())
+
+    def test_anthropic_front_disconnect_emits_anthropic_error_event(self):
+        """Anthropic SDKs only recognize `event: error` + an Anthropic
+        error envelope (ADVICE r1)."""
+
+        async def main():
+            up = FakeUpstream()
+
+            async def aborting_sse(cap):
+                from aiohttp import web
+
+                resp = web.StreamResponse(
+                    status=200,
+                    headers={"content-type": "text/event-stream"})
+                await resp.prepare(cap._request)
+                start = {"type": "message_start",
+                         "message": {"id": "m", "type": "message",
+                                     "role": "assistant", "content": [],
+                                     "model": "fake", "usage":
+                                     {"input_tokens": 1,
+                                      "output_tokens": 0}}}
+                await resp.write(
+                    b"event: message_start\ndata: "
+                    + json.dumps(start).encode() + b"\n\n")
+                await asyncio.sleep(0.05)
+                cap._request.transport.close()
+                return resp
+
+            up.on("/v1/messages", aborting_sse)
+            await up.start()
+            cfg = _config(
+                [{"name": "a", "schema": "Anthropic", "url": up.url}],
+                [{"name": "r", "rules": [{"models": ["m1"],
+                                          "backends": ["a"]}]}],
+            )
+            server, runner, url = await _start(cfg)
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        url + "/v1/messages",
+                        json={"model": "m1", "max_tokens": 16,
+                              "stream": True,
+                              "messages": [{"role": "user",
+                                            "content": "hi"}]},
+                    ) as resp:
+                        assert resp.status == 200
+                        text = (await resp.read()).decode()
+                assert "event: error" in text
+                assert '"type": "error"' in text
+                assert "upstream stream interrupted" in text
+            finally:
+                await runner.cleanup()
+                await up.stop()
+
+        run(main())
+
+    def test_stream_idle_timeout_mid_stream(self):
+        """A stalled SSE stream exceeds stream_idle_timeout → the client
+        receives the error event instead of hanging (reference:
+        per_try_idle_timeout semantics after response start)."""
+
+        async def main():
+            up = FakeUpstream()
+
+            async def stalling_sse(cap):
+                from aiohttp import web
+
+                resp = web.StreamResponse(
+                    status=200,
+                    headers={"content-type": "text/event-stream"})
+                await resp.prepare(cap._request)
+                chunk = {"id": "c", "object": "chat.completion.chunk",
+                         "created": 1, "model": "fake",
+                         "choices": [{"index": 0,
+                                      "delta": {"content": "x"},
+                                      "finish_reason": None}]}
+                await resp.write(
+                    f"data: {json.dumps(chunk)}\n\n".encode())
+                await asyncio.sleep(30)  # stall far beyond idle timeout
+                return resp
+
+            up.on("/v1/chat/completions", stalling_sse)
+            await up.start()
+            cfg = _config(
+                [{"name": "a", "schema": "OpenAI", "url": up.url,
+                  "stream_idle_timeout": 0.3}],
+                [{"name": "r", "rules": [{"models": ["m1"],
+                                          "backends": ["a"]}]}],
+            )
+            server, runner, url = await _start(cfg)
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        url + "/v1/chat/completions",
+                        json={**CHAT, "stream": True},
+                        timeout=aiohttp.ClientTimeout(total=10),
+                    ) as resp:
+                        text = (await resp.read()).decode()
+                assert "upstream stream interrupted" in text
+            finally:
+                await runner.cleanup()
+                await up.stop()
+
+        run(main())
+
+
+class TestQuotaCircuitInteraction:
+    def test_backend_quota_429_does_not_open_circuit(self):
+        """Backend-scoped quota rejections fail over WITHOUT counting as
+        circuit failures: after the quota window refills, the backend must
+        be immediately usable (ADVICE r1 low #2)."""
+
+        async def main():
+            a = await FakeUpstream().on_json(
+                "/v1/chat/completions",
+                openai_chat_response("from-a", prompt_tokens=5,
+                                     completion_tokens=7),
+            ).start()
+            b = await FakeUpstream().on_json(
+                "/v1/chat/completions", openai_chat_response("from-b")
+            ).start()
+            cfg = _config(
+                [{"name": "a", "schema": "OpenAI", "url": a.url},
+                 {"name": "b", "schema": "OpenAI", "url": b.url}],
+                [{"name": "r", "rules": [
+                    {"models": ["m1"],
+                     "backends": [{"backend": "a", "priority": 0},
+                                  {"backend": "b", "priority": 1}]}]}],
+                extra={
+                    "llm_request_costs": [
+                        {"metadata_key": "total", "type": "TotalToken"}],
+                    "quotas": [
+                        {"name": "a-budget", "metadata_key": "total",
+                         "limit": 10, "window_seconds": 3600,
+                         "backend": "a"}],
+                },
+            )
+            server, runner, url = await _start(cfg)
+            try:
+                async with aiohttp.ClientSession() as s:
+                    # first request goes to a (12 tokens > 10: budget gone)
+                    async with s.post(url + "/v1/chat/completions",
+                                      json=CHAT) as r:
+                        assert r.status == 200
+                        assert (await r.json())["choices"][0]["message"][
+                            "content"] == "from-a"
+                    # 8 more requests: each one quota-rejects a, serves b
+                    for _ in range(8):
+                        async with s.post(url + "/v1/chat/completions",
+                                          json=CHAT) as r:
+                            assert r.status == 200
+                            body = await r.json()
+                            assert body["choices"][0]["message"][
+                                "content"] == "from-b"
+                # 8 quota rejections must not have opened a's circuit
+                assert not server.circuit.is_open("a")
+                assert "a" not in server.circuit.snapshot()
+            finally:
+                await runner.cleanup()
+                await a.stop()
+                await b.stop()
+
+        run(main())

@@ -552,6 +552,9 @@ class TestAdminAndModels:
 
     def test_debug_endpoints_redacted(self):
         async def main():
+            import os
+
+            os.environ["AIGW_ENABLE_DEBUG"] = "true"
             cfg = Config.parse({
                 "version": "v1",
                 "backends": [{"name": "a", "schema": "OpenAI",
@@ -575,6 +578,35 @@ class TestAdminAndModels:
                     async with s.get(url + "/debug/stacks") as resp:
                         assert resp.status == 200
                         assert "thread" in await resp.text()
+            finally:
+                os.environ.pop("AIGW_ENABLE_DEBUG", None)
+                await runner.cleanup()
+
+        run(main())
+
+    def test_debug_endpoints_off_by_default(self):
+        """Without AIGW_ENABLE_DEBUG the debug surface is absent from the
+        data-plane port (ADVICE r1: it leaked stacks/config to any API
+        client)."""
+
+        async def main():
+            cfg = Config.parse({
+                "version": "v1",
+                "backends": [{"name": "a", "schema": "OpenAI",
+                              "url": "http://x"}],
+                "routes": [{"name": "r", "rules": [{"backends": ["a"]}]}],
+            })
+            server, runner = await run_gateway(RuntimeConfig.build(cfg),
+                                               port=0)
+            site = list(runner.sites)[0]
+            port = site._server.sockets[0].getsockname()[1]
+            url = f"http://127.0.0.1:{port}"
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(url + "/debug/config") as resp:
+                        assert resp.status == 404
+                    async with s.get(url + "/debug/stacks") as resp:
+                        assert resp.status == 404
             finally:
                 await runner.cleanup()
 

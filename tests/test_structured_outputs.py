@@ -1,0 +1,383 @@
+"""Structured outputs (response_format/json_schema), logprobs, and strict
+edge validation (VERDICT r1 item 2; reference jsonschema_helper.go:1-624,
+gemini_helper.go:640-744, anthropic_helper.go:712-734)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from aigw_tpu.config.model import APISchemaName
+from aigw_tpu.schemas import openai as oai
+from aigw_tpu.translate import Endpoint, get_translator
+from aigw_tpu.translate.base import TranslationError
+from aigw_tpu.translate.structured import (
+    JSONSchemaError,
+    dereference,
+    parse_response_format,
+    to_gemini_schema,
+)
+
+PERSON_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "name": {"type": "string"},
+        "age": {"type": ["integer", "null"]},
+        "pet": {"$ref": "#/$defs/pet"},
+    },
+    "required": ["name"],
+    "additionalProperties": False,
+    "$defs": {
+        "pet": {
+            "type": "object",
+            "properties": {"species": {"type": "string"}},
+        }
+    },
+}
+
+
+def chat(extra):
+    return {"model": "m", "messages": [
+        {"role": "user", "content": "hi"}], **extra}
+
+
+RF_SCHEMA = {"response_format": {"type": "json_schema", "json_schema": {
+    "name": "person", "strict": True, "schema": PERSON_SCHEMA}}}
+
+
+class TestSchemaUtils:
+    def test_dereference_resolves_refs(self):
+        out = dereference(PERSON_SCHEMA)
+        assert out["properties"]["pet"]["properties"]["species"] == {
+            "type": "string"}
+
+    def test_dereference_circular_raises(self):
+        s = {"$defs": {"a": {"$ref": "#/$defs/b"},
+                       "b": {"$ref": "#/$defs/a"}},
+             "properties": {"x": {"$ref": "#/$defs/a"}},
+             "type": "object"}
+        with pytest.raises(JSONSchemaError, match="circular"):
+            dereference(s)
+
+    def test_dereference_missing_ref_raises(self):
+        with pytest.raises(JSONSchemaError, match="not found"):
+            dereference({"$ref": "#/$defs/nope", "$defs": {}})
+
+    def test_to_gemini_nullable_and_field_filter(self):
+        g = to_gemini_schema(PERSON_SCHEMA)
+        # type list with null → nullable
+        assert g["properties"]["age"] == {"type": "integer",
+                                          "nullable": True}
+        # disallowed field dropped
+        assert "additionalProperties" not in g
+        # $defs stripped, ref resolved
+        assert "$defs" not in g
+        assert g["properties"]["pet"]["properties"]["species"][
+            "type"] == "string"
+
+    def test_to_gemini_anyof_null_branch(self):
+        g = to_gemini_schema({
+            "anyOf": [{"type": "string"}, {"type": "null"}]})
+        assert g["nullable"] is True
+        assert g["anyOf"] == [{"type": "string"}]
+
+    def test_to_gemini_allof_single_collapse(self):
+        g = to_gemini_schema({"allOf": [{"type": "string"}]})
+        assert g == {"type": "string"}
+        with pytest.raises(JSONSchemaError, match="one value"):
+            to_gemini_schema(
+                {"allOf": [{"type": "string"}, {"type": "integer"}]})
+
+    def test_parse_response_format(self):
+        assert parse_response_format({}) is None
+        rf = parse_response_format(chat(RF_SCHEMA))
+        assert rf.kind == "json_schema" and rf.name == "person"
+        assert rf.strict and rf.schema == PERSON_SCHEMA
+        assert parse_response_format(
+            {"response_format": {"type": "json_object"}}).kind == \
+            "json_object"
+        with pytest.raises(JSONSchemaError):
+            parse_response_format({"response_format": {"type": "xml"}})
+        with pytest.raises(JSONSchemaError):
+            parse_response_format(
+                {"response_format": {"type": "json_schema",
+                                     "json_schema": "not-an-object"}})
+
+
+class TestAnthropicStructured:
+    def test_json_schema_to_output_config(self):
+        tx = get_translator(Endpoint.CHAT_COMPLETIONS, APISchemaName.OPENAI,
+                            APISchemaName.ANTHROPIC).request(chat(RF_SCHEMA))
+        body = json.loads(tx.body)
+        assert body["output_config"]["format"] == {
+            "type": "json_schema", "schema": PERSON_SCHEMA}
+
+    def test_gcp_anthropic_skips_output_config(self):
+        tx = get_translator(
+            Endpoint.CHAT_COMPLETIONS, APISchemaName.OPENAI,
+            APISchemaName.GCP_ANTHROPIC).request(chat(RF_SCHEMA))
+        assert "output_config" not in json.loads(tx.body)
+
+    def test_reasoning_effort_maps(self):
+        tx = get_translator(
+            Endpoint.CHAT_COMPLETIONS, APISchemaName.OPENAI,
+            APISchemaName.ANTHROPIC).request(
+                chat({"reasoning_effort": "high"}))
+        assert json.loads(tx.body)["output_config"]["effort"] == "high"
+        with pytest.raises(TranslationError):
+            get_translator(
+                Endpoint.CHAT_COMPLETIONS, APISchemaName.OPENAI,
+                APISchemaName.ANTHROPIC).request(
+                    chat({"reasoning_effort": "ultra"}))
+
+
+class TestGeminiStructured:
+    def _req(self, extra):
+        tx = get_translator(
+            Endpoint.CHAT_COMPLETIONS, APISchemaName.OPENAI,
+            APISchemaName.GCP_VERTEX_AI).request(chat(extra))
+        return json.loads(tx.body)
+
+    def test_json_schema_to_response_schema(self):
+        gen = self._req(RF_SCHEMA)["generationConfig"]
+        assert gen["responseMimeType"] == "application/json"
+        assert gen["responseSchema"]["properties"]["age"]["nullable"] is True
+
+    def test_json_object_and_text(self):
+        assert self._req({"response_format": {"type": "json_object"}})[
+            "generationConfig"]["responseMimeType"] == "application/json"
+        assert self._req({"response_format": {"type": "text"}})[
+            "generationConfig"]["responseMimeType"] == "text/plain"
+
+    def test_guided_choice(self):
+        gen = self._req({"guided_choice": ["yes", "no"]})[
+            "generationConfig"]
+        assert gen["responseMimeType"] == "text/x.enum"
+        assert gen["responseSchema"] == {"type": "STRING",
+                                         "enum": ["yes", "no"]}
+
+    def test_guided_and_response_format_mutually_exclusive(self):
+        with pytest.raises(TranslationError, match="only one of"):
+            self._req({"response_format": {"type": "json_object"},
+                       "guided_choice": ["a"]})
+
+    def test_logprobs_request_mapping(self):
+        gen = self._req({"logprobs": True, "top_logprobs": 3})[
+            "generationConfig"]
+        assert gen["responseLogprobs"] is True
+        assert gen["logprobs"] == 3
+
+    def test_seed_and_penalties(self):
+        gen = self._req({"seed": 42, "presence_penalty": 0.5,
+                         "frequency_penalty": -0.25})["generationConfig"]
+        assert gen["seed"] == 42
+        assert gen["presencePenalty"] == 0.5
+        assert gen["frequencyPenalty"] == -0.25
+
+    def test_logprobs_response_conversion(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, APISchemaName.OPENAI,
+                           APISchemaName.GCP_VERTEX_AI)
+        t.request(chat({"logprobs": True, "top_logprobs": 2}))
+        upstream = {
+            "candidates": [{
+                "content": {"role": "model", "parts": [{"text": "hi"}]},
+                "finishReason": "STOP",
+                "logprobsResult": {
+                    "chosenCandidates": [
+                        {"token": "hi", "logProbability": -0.1}],
+                    "topCandidates": [{"candidates": [
+                        {"token": "hi", "logProbability": -0.1},
+                        {"token": "yo", "logProbability": -2.5}]}],
+                },
+            }],
+            "usageMetadata": {"promptTokenCount": 1,
+                              "candidatesTokenCount": 1},
+        }
+        rx = t.response_body(json.dumps(upstream).encode(), True)
+        lp = json.loads(rx.body)["choices"][0]["logprobs"]
+        assert lp["content"][0]["token"] == "hi"
+        assert lp["content"][0]["logprob"] == -0.1
+        assert lp["content"][0]["top_logprobs"][1] == {
+            "token": "yo", "logprob": -2.5}
+
+
+class TestBedrockStructured:
+    def test_json_schema_tool_trick_request(self):
+        tx = get_translator(Endpoint.CHAT_COMPLETIONS, APISchemaName.OPENAI,
+                            APISchemaName.AWS_BEDROCK).request(chat(RF_SCHEMA))
+        body = json.loads(tx.body)
+        tc = body["toolConfig"]
+        assert tc["toolChoice"] == {"tool": {"name": "person"}}
+        spec = tc["tools"][0]["toolSpec"]
+        assert spec["name"] == "person"
+        # schema is dereferenced for Converse
+        assert spec["inputSchema"]["json"]["properties"]["pet"][
+            "properties"]["species"] == {"type": "string"}
+
+    def test_json_schema_with_tools_rejected(self):
+        with pytest.raises(TranslationError, match="cannot be combined"):
+            get_translator(
+                Endpoint.CHAT_COMPLETIONS, APISchemaName.OPENAI,
+                APISchemaName.AWS_BEDROCK).request(chat({
+                    **RF_SCHEMA,
+                    "tools": [{"type": "function",
+                               "function": {"name": "f"}}]}))
+
+    def test_tool_use_converted_back_to_content(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, APISchemaName.OPENAI,
+                           APISchemaName.AWS_BEDROCK)
+        t.request(chat(RF_SCHEMA))
+        upstream = {
+            "output": {"message": {"role": "assistant", "content": [
+                {"toolUse": {"toolUseId": "t1", "name": "person",
+                             "input": {"name": "Ada"}}}]}},
+            "stopReason": "tool_use",
+            "usage": {"inputTokens": 3, "outputTokens": 5},
+        }
+        rx = t.response_body(json.dumps(upstream).encode(), True)
+        out = json.loads(rx.body)
+        msg = out["choices"][0]["message"]
+        assert json.loads(msg["content"]) == {"name": "Ada"}
+        assert "tool_calls" not in msg
+        assert out["choices"][0]["finish_reason"] == "stop"
+
+
+class TestStrictValidation:
+    def _bad(self, extra, match):
+        with pytest.raises(oai.SchemaError, match=match):
+            oai.validate_chat_request(chat(extra))
+
+    def test_malformed_tools(self):
+        self._bad({"tools": "nope"}, "tools must be an array")
+        self._bad({"tools": [{"type": "retrieval"}]}, "type must be")
+        self._bad({"tools": [{"type": "function", "function": {}}]},
+                  "name is required")
+        self._bad({"tools": [{"type": "function",
+                              "function": {"name": "f",
+                                           "parameters": []}}]},
+                  "parameters must be an object")
+
+    def test_malformed_tool_choice(self):
+        self._bad({"tool_choice": "sometimes"}, "tool_choice must be")
+        self._bad({"tool_choice": {"type": "function"}},
+                  "function.name is required")
+        self._bad({"tool_choice": {"type": "function",
+                                   "function": {"name": "f"}}},
+                  "requires a non-empty tools")
+
+    def test_malformed_stream_options(self):
+        self._bad({"stream_options": {"include_usage": True}},
+                  "only allowed when stream")
+        self._bad({"stream": True, "stream_options": [1]},
+                  "stream_options must be an object")
+        self._bad({"stream": True,
+                   "stream_options": {"include_usage": "yes"}},
+                  "include_usage must be a boolean")
+
+    def test_malformed_content_parts(self):
+        self._bad({"messages": [{"role": "user", "content":
+                                 [{"type": "video"}]}]}, "invalid type")
+        self._bad({"messages": [{"role": "user", "content":
+                                 [{"type": "text", "text": 42}]}]},
+                  "text must be a string")
+        self._bad({"messages": [{"role": "user", "content": 17}]},
+                  "content must be")
+
+    def test_tool_role_requires_id(self):
+        self._bad({"messages": [{"role": "tool", "content": "r"}]},
+                  "requires tool_call_id")
+
+    def test_sampling_ranges(self):
+        self._bad({"temperature": 3.5}, "between 0.0 and 2.0")
+        self._bad({"top_p": "high"}, "must be a number")
+        self._bad({"n": 0}, "positive integer")
+        self._bad({"top_logprobs": 50}, r"\[0, 20\]")
+        self._bad({"logprobs": "yes"}, "must be a boolean")
+        self._bad({"stop": [1]}, "array of strings")
+
+    def test_malformed_response_format(self):
+        self._bad({"response_format": {"type": "xml"}},
+                  "must be one of")
+
+    def test_valid_request_passes(self):
+        oai.validate_chat_request(chat({
+            "tools": [{"type": "function",
+                       "function": {"name": "f",
+                                    "parameters": {"type": "object"}}}],
+            "tool_choice": {"type": "function", "function": {"name": "f"}},
+            "stream": True,
+            "stream_options": {"include_usage": True},
+            "temperature": 1.0, "top_p": 0.9, "n": 2,
+            "logprobs": True, "top_logprobs": 5,
+            **RF_SCHEMA,
+        }))
+
+
+class TestReviewRegressions:
+    """Fixes from the round-2 inline code review."""
+
+    def test_custom_tool_call_accepted(self):
+        oai.validate_chat_request(chat({"messages": [
+            {"role": "user", "content": "q"},
+            {"role": "assistant", "tool_calls": [
+                {"type": "custom", "id": "c1",
+                 "custom": {"name": "q", "input": "x"}}]},
+        ]}))
+        with pytest.raises(oai.SchemaError, match="custom.name"):
+            oai.validate_chat_request(chat({"messages": [
+                {"role": "assistant", "tool_calls": [
+                    {"type": "custom", "custom": {}}]}]}))
+
+    def test_assistant_refusal_part_accepted(self):
+        oai.validate_chat_request(chat({"messages": [
+            {"role": "user", "content": "q"},
+            {"role": "assistant", "content": [
+                {"type": "refusal", "refusal": "no can do"}]},
+        ]}))
+
+    def test_ref_into_properties_dereferences(self):
+        s = {"type": "object", "properties": {
+            "a": {"type": "string"},
+            "b": {"$ref": "#/properties/a"}}}
+        out = dereference(s)
+        assert out["properties"]["b"] == {"type": "string"}
+        g = to_gemini_schema(s)
+        assert g["properties"]["b"] == {"type": "string"}
+
+    def test_unresolved_ref_raises_not_silent(self):
+        # a schema handed straight to _to_gapic with a leftover $ref must
+        # error, not silently become accept-anything
+        from aigw_tpu.translate.structured import _to_gapic
+
+        with pytest.raises(JSONSchemaError, match="unresolved"):
+            _to_gapic({"type": "object",
+                       "properties": {"b": {"$ref": "#/x"}}})
+
+    def test_reasoning_effort_minimal_maps_to_low(self):
+        tx = get_translator(
+            Endpoint.CHAT_COMPLETIONS, APISchemaName.OPENAI,
+            APISchemaName.ANTHROPIC).request(
+                chat({"reasoning_effort": "minimal"}))
+        assert json.loads(tx.body)["output_config"]["effort"] == "low"
+
+    def test_gemini_streaming_logprobs_attached(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, APISchemaName.OPENAI,
+                           APISchemaName.GCP_VERTEX_AI)
+        t.request(chat({"stream": True, "logprobs": True,
+                        "top_logprobs": 1}))
+        ev = {"candidates": [{
+            "content": {"role": "model", "parts": [{"text": "hi"}]},
+            "logprobsResult": {"chosenCandidates": [
+                {"token": "hi", "logProbability": -0.5}]},
+        }]}
+        rx = t.response_body(
+            b"data: " + json.dumps(ev).encode() + b"\n\n", False)
+        chunks = [json.loads(line[6:]) for line in
+                  rx.body.decode().strip().split("\n\n")
+                  if line.startswith("data: ")]
+        content_chunks = [c for c in chunks
+                          if c["choices"] and
+                          c["choices"][0]["delta"].get("content")]
+        lp = content_chunks[0]["choices"][0]["logprobs"]
+        assert lp["content"][0]["token"] == "hi"

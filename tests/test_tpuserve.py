@@ -543,3 +543,28 @@ def test_queue_overload_raises():
     with pytest.raises(EngineOverloadedError):
         eng.submit(GenRequest(prompt=[1], max_tokens=1,
                               sampling=SamplingParams()))
+
+
+def test_top_p_temperature_order():
+    """OpenAI/vLLM semantics: temperature scaling precedes the nucleus
+    cutoff (ADVICE r1 low #3). With temperature=0.1 and logits
+    [1.0, 0.9, 0.8, -10], the scaled distribution puts ~66% mass on
+    token 0, so top_p=0.5 keeps ONLY token 0 — whereas nucleus
+    membership computed on the unscaled distribution keeps {0, 1} and
+    token 1 then carries ~27% of the post-scale mass (P[all-zero over
+    64 draws] ≈ 2e-9 under the old ordering)."""
+    import jax.numpy as jnp
+
+    from aigw_tpu.tpuserve.sampling import sample
+
+    B = 64
+    logits = jnp.tile(jnp.array([[1.0, 0.9, 0.8, -10.0]]), (B, 1))
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(B))
+    toks = sample(
+        logits,
+        keys,
+        temperature=jnp.full((B,), 0.1),
+        top_p=jnp.full((B,), 0.5),
+        top_k=jnp.zeros((B,), jnp.int32),
+    )
+    assert (toks == 0).all(), toks
