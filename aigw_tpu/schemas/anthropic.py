@@ -39,8 +39,14 @@ def validate_messages_request(body: dict[str, Any]) -> None:
     if not isinstance(body.get("max_tokens"), int):
         raise SchemaError("missing required field: max_tokens")
     for i, m in enumerate(body["messages"]):
-        if not isinstance(m, dict) or m.get("role") not in ("user", "assistant"):
-            raise SchemaError(f"messages[{i}] must have role user|assistant")
+        # "system" is permitted in the array (mid-conversation system
+        # prompts; some clients send them as messages rather than the
+        # top-level parameter — reference
+        # promoteAnthropicSystemMessagesToParam)
+        if not isinstance(m, dict) or m.get("role") not in (
+                "user", "assistant", "system"):
+            raise SchemaError(
+                f"messages[{i}] must have role user|assistant|system")
 
 
 def content_blocks(content: Any) -> list[dict[str, Any]]:

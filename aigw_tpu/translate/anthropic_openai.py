@@ -41,7 +41,13 @@ def anthropic_messages_to_openai(
     for m in messages:
         role = m.get("role")
         blocks = anth.content_blocks(m.get("content"))
-        if role == "user":
+        if role == "system":
+            # mid-conversation system message → OpenAI system message in
+            # place (array position preserved)
+            text = anth.text_of_blocks(blocks)
+            if text:
+                out.append({"role": "system", "content": text})
+        elif role == "user":
             texts: list[str] = []
             for b in blocks:
                 btype = b.get("type")

@@ -154,6 +154,7 @@ def _install_all() -> None:
         openai_anthropic,
         anthropic_openai,
         openai_awsbedrock,
+        anthropic_awsbedrock,
         openai_azure,
         openai_gcp,
         embeddings,

@@ -1,0 +1,344 @@
+"""Anthropic /v1/messages front → AWS Bedrock Converse backend
+(reference internal/translator/anthropic_awsbedrock.go:1-832)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from aigw_tpu.config.model import APISchemaName as S
+from aigw_tpu.translate import Endpoint, get_translator
+from aigw_tpu.translate.base import TranslationError
+from aigw_tpu.translate.eventstream import encode_message
+
+REQ = {
+    "model": "nova-pro",
+    "max_tokens": 128,
+    "system": "be terse",
+    "messages": [{"role": "user", "content": "hi"}],
+    "temperature": 0.5,
+    "top_p": 0.9,
+    "top_k": 40,
+    "stop_sequences": ["END"],
+}
+
+
+def t():
+    return get_translator(Endpoint.MESSAGES, S.ANTHROPIC, S.AWS_BEDROCK)
+
+
+def frame(etype, payload):
+    return encode_message(
+        {":message-type": "event", ":event-type": etype},
+        json.dumps(payload).encode(),
+    )
+
+
+class TestRequest:
+    def test_basic_mapping(self):
+        tx = t().request(REQ)
+        assert tx.path == "/model/nova-pro/converse"
+        body = json.loads(tx.body)
+        assert body["system"] == [{"text": "be terse"}]
+        assert body["messages"] == [
+            {"role": "user", "content": [{"text": "hi"}]}]
+        inf = body["inferenceConfig"]
+        assert inf == {"maxTokens": 128, "temperature": 0.5, "topP": 0.9,
+                       "stopSequences": ["END"]}
+        assert body["additionalModelRequestFields"] == {"top_k": 40}
+
+    def test_stream_path(self):
+        tx = t().request({**REQ, "stream": True})
+        assert tx.path == "/model/nova-pro/converse-stream"
+        assert tx.stream
+
+    def test_system_message_promotion(self):
+        tx = t().request({
+            "model": "m", "max_tokens": 8,
+            "messages": [
+                {"role": "system", "content": "mid-conv system"},
+                {"role": "user", "content": "q"},
+            ],
+        })
+        body = json.loads(tx.body)
+        assert body["system"] == [{"text": "mid-conv system"}]
+        assert [m["role"] for m in body["messages"]] == ["user"]
+
+    def test_tools_and_tool_choice(self):
+        tx = t().request({
+            "model": "m", "max_tokens": 8,
+            "messages": [{"role": "user", "content": "q"}],
+            "tools": [{"name": "get_weather", "description": "w",
+                       "input_schema": {"type": "object"}}],
+            "tool_choice": {"type": "tool", "name": "get_weather"},
+        })
+        tc = json.loads(tx.body)["toolConfig"]
+        assert tc["tools"][0]["toolSpec"]["name"] == "get_weather"
+        assert tc["tools"][0]["toolSpec"]["inputSchema"] == {
+            "json": {"type": "object"}}
+        assert tc["toolChoice"] == {"tool": {"name": "get_weather"}}
+
+    def test_tool_result_and_tool_use_round_trip(self):
+        tx = t().request({
+            "model": "m", "max_tokens": 8,
+            "messages": [
+                {"role": "user", "content": "q"},
+                {"role": "assistant", "content": [
+                    {"type": "tool_use", "id": "t1", "name": "f",
+                     "input": {"x": 1}}]},
+                {"role": "user", "content": [
+                    {"type": "tool_result", "tool_use_id": "t1",
+                     "content": "42", "is_error": False}]},
+            ],
+        })
+        msgs = json.loads(tx.body)["messages"]
+        assert msgs[1]["content"][0]["toolUse"] == {
+            "toolUseId": "t1", "name": "f", "input": {"x": 1}}
+        assert msgs[2]["content"][0]["toolResult"] == {
+            "toolUseId": "t1", "content": [{"text": "42"}]}
+
+    def test_thinking_config(self):
+        tx = t().request({**REQ, "thinking": {"type": "enabled",
+                                              "budget_tokens": 1024}})
+        extra = json.loads(tx.body)["additionalModelRequestFields"]
+        assert extra["thinking"] == {"type": "enabled",
+                                     "budget_tokens": 1024}
+
+    def test_non_base64_image_rejected(self):
+        with pytest.raises(TranslationError, match="base64"):
+            t().request({
+                "model": "m", "max_tokens": 8,
+                "messages": [{"role": "user", "content": [
+                    {"type": "image",
+                     "source": {"type": "url", "url": "http://x"}}]}],
+            })
+
+
+class TestResponse:
+    def test_non_streaming(self):
+        tr = t()
+        tr.request(REQ)
+        upstream = {
+            "output": {"message": {"role": "assistant", "content": [
+                {"text": "hello"},
+                {"toolUse": {"toolUseId": "t1", "name": "f",
+                             "input": {"a": 2}}},
+            ]}},
+            "stopReason": "tool_use",
+            "usage": {"inputTokens": 10, "outputTokens": 4,
+                      "totalTokens": 14, "cacheReadInputTokens": 3},
+        }
+        rx = tr.response_body(json.dumps(upstream).encode(), True)
+        out = json.loads(rx.body)
+        assert out["type"] == "message" and out["role"] == "assistant"
+        assert out["model"] == "nova-pro"
+        assert out["content"][0] == {"type": "text", "text": "hello"}
+        assert out["content"][1] == {"type": "tool_use", "id": "t1",
+                                     "name": "f", "input": {"a": 2}}
+        assert out["stop_reason"] == "tool_use"
+        assert out["usage"]["input_tokens"] == 10
+        assert out["usage"]["cache_read_input_tokens"] == 3
+        assert rx.usage.input_tokens == 10
+
+    def test_thinking_block(self):
+        tr = t()
+        tr.request(REQ)
+        upstream = {
+            "output": {"message": {"role": "assistant", "content": [
+                {"reasoningContent": {"reasoningText": {
+                    "text": "hmm", "signature": "sig"}}},
+                {"text": "ok"},
+            ]}},
+            "stopReason": "end_turn",
+            "usage": {"inputTokens": 1, "outputTokens": 1},
+        }
+        out = json.loads(tr.response_body(
+            json.dumps(upstream).encode(), True).body)
+        assert out["content"][0] == {"type": "thinking", "thinking": "hmm",
+                                     "signature": "sig"}
+
+    def test_error_envelope(self):
+        tr = t()
+        tr.request(REQ)
+        err = json.loads(tr.response_error(
+            429, json.dumps({"message": "slow down"}).encode()))
+        assert err == {"type": "error", "error": {
+            "type": "rate_limit_error", "message": "slow down"}}
+
+
+class TestStreaming:
+    def _drive(self, raw, chunk_size=37):
+        tr = t()
+        tr.request({**REQ, "stream": True})
+        body = b""
+        usage = None
+        for i in range(0, len(raw), chunk_size):
+            rx = tr.response_body(raw[i:i + chunk_size], False)
+            body += rx.body
+            if rx.usage.total_tokens:
+                usage = rx.usage
+        rx = tr.response_body(b"", True)
+        body += rx.body
+        events = []
+        for block in body.decode().strip().split("\n\n"):
+            lines = dict(
+                line.split(": ", 1) for line in block.split("\n") if line)
+            events.append((lines.get("event"),
+                           json.loads(lines.get("data", "{}"))))
+        return events, usage
+
+    def test_text_stream_to_anthropic_sse(self):
+        # NOTE: real ConverseStream output has NO contentBlockStart for
+        # text blocks (the start union only carries toolUse) — the
+        # translator must open the block lazily on the first delta
+        raw = (
+            frame("messageStart", {"role": "assistant"})
+            + frame("contentBlockDelta", {"contentBlockIndex": 0,
+                                          "delta": {"text": "hel"}})
+            + frame("contentBlockDelta", {"contentBlockIndex": 0,
+                                          "delta": {"text": "lo"}})
+            + frame("contentBlockStop", {"contentBlockIndex": 0})
+            + frame("messageStop", {"stopReason": "end_turn"})
+            + frame("metadata", {"usage": {"inputTokens": 5,
+                                           "outputTokens": 2,
+                                           "totalTokens": 7}})
+        )
+        events, usage = self._drive(raw)
+        kinds = [e[0] for e in events]
+        assert kinds == ["message_start", "content_block_start",
+                         "content_block_delta", "content_block_delta",
+                         "content_block_stop", "message_delta",
+                         "message_stop"]
+        # deferred block start resolved to text
+        assert events[1][1]["content_block"] == {"type": "text",
+                                                 "text": ""}
+        assert events[2][1]["delta"] == {"type": "text_delta",
+                                         "text": "hel"}
+        # message_delta carries the metadata usage (emitted after
+        # metadata, not at messageStop), including input_tokens which
+        # message_start could not report
+        assert events[5][1]["delta"]["stop_reason"] == "end_turn"
+        assert events[5][1]["usage"]["output_tokens"] == 2
+        assert events[5][1]["usage"]["input_tokens"] == 5
+        assert usage.input_tokens == 5 and usage.output_tokens == 2
+
+    def test_tool_use_stream(self):
+        raw = (
+            frame("messageStart", {"role": "assistant"})
+            + frame("contentBlockStart", {
+                "contentBlockIndex": 0,
+                "start": {"toolUse": {"toolUseId": "t1", "name": "f"}}})
+            + frame("contentBlockDelta", {
+                "contentBlockIndex": 0,
+                "delta": {"toolUse": {"input": '{"a":'}}})
+            + frame("contentBlockDelta", {
+                "contentBlockIndex": 0,
+                "delta": {"toolUse": {"input": '1}'}}})
+            + frame("contentBlockStop", {"contentBlockIndex": 0})
+            + frame("messageStop", {"stopReason": "tool_use"})
+            + frame("metadata", {"usage": {"inputTokens": 2,
+                                           "outputTokens": 3}})
+        )
+        events, _ = self._drive(raw)
+        assert events[1][1]["content_block"]["type"] == "tool_use"
+        assert events[1][1]["content_block"]["name"] == "f"
+        assert events[2][1]["delta"] == {"type": "input_json_delta",
+                                         "partial_json": '{"a":'}
+        assert events[-2][1]["delta"]["stop_reason"] == "tool_use"
+
+    def test_thinking_stream_deferred_start(self):
+        raw = (
+            frame("messageStart", {"role": "assistant"})
+            + frame("contentBlockDelta", {
+                "contentBlockIndex": 0,
+                "delta": {"reasoningContent": {"text": "let me think"}}})
+            + frame("contentBlockDelta", {
+                "contentBlockIndex": 0,
+                "delta": {"reasoningContent": {"signature": "s1"}}})
+            + frame("contentBlockStop", {"contentBlockIndex": 0})
+            + frame("messageStop", {"stopReason": "end_turn"})
+            + frame("metadata", {"usage": {"inputTokens": 1,
+                                           "outputTokens": 1}})
+        )
+        events, _ = self._drive(raw)
+        assert events[1][1]["content_block"] == {"type": "thinking",
+                                                 "thinking": ""}
+        assert events[2][1]["delta"] == {"type": "thinking_delta",
+                                         "thinking": "let me think"}
+        assert events[3][1]["delta"] == {"type": "signature_delta",
+                                         "signature": "s1"}
+
+    def test_stream_without_metadata_closes_at_eof(self):
+        raw = (
+            frame("messageStart", {"role": "assistant"})
+            + frame("contentBlockDelta", {"contentBlockIndex": 0,
+                                          "delta": {"text": "x"}})
+            + frame("contentBlockStop", {"contentBlockIndex": 0})
+            + frame("messageStop", {"stopReason": "max_tokens"})
+        )
+        events, _ = self._drive(raw)
+        assert [e[0] for e in events][-2:] == ["message_delta",
+                                               "message_stop"]
+        assert events[-2][1]["delta"]["stop_reason"] == "max_tokens"
+
+    def test_second_block_opens_independently(self):
+        # two text blocks, no contentBlockStart frames at all
+        raw = (
+            frame("messageStart", {"role": "assistant"})
+            + frame("contentBlockDelta", {"contentBlockIndex": 0,
+                                          "delta": {"text": "a"}})
+            + frame("contentBlockStop", {"contentBlockIndex": 0})
+            + frame("contentBlockDelta", {"contentBlockIndex": 1,
+                                          "delta": {"text": "b"}})
+            + frame("contentBlockStop", {"contentBlockIndex": 1})
+            + frame("messageStop", {"stopReason": "end_turn"})
+        )
+        events, _ = self._drive(raw)
+        starts = [(e[1]["index"]) for e in events
+                  if e[0] == "content_block_start"]
+        assert starts == [0, 1]
+
+
+class TestReviewRegressions:
+    def test_consecutive_assistant_messages_coalesced(self):
+        tx = t().request({
+            "model": "m", "max_tokens": 8,
+            "messages": [
+                {"role": "user", "content": "q"},
+                {"role": "assistant", "content": "partial"},
+                {"role": "assistant", "content": " prefill"},
+            ],
+        })
+        msgs = json.loads(tx.body)["messages"]
+        assert [m["role"] for m in msgs] == ["user", "assistant"]
+        assert msgs[1]["content"] == [{"text": "partial"},
+                                      {"text": " prefill"}]
+
+    def test_tool_result_without_content_gets_content_member(self):
+        tx = t().request({
+            "model": "m", "max_tokens": 8,
+            "messages": [
+                {"role": "user", "content": "q"},
+                {"role": "assistant", "content": [
+                    {"type": "tool_use", "id": "t1", "name": "f",
+                     "input": {}}]},
+                {"role": "user", "content": [
+                    {"type": "tool_result", "tool_use_id": "t1"}]},
+            ],
+        })
+        tr = json.loads(tx.body)["messages"][2]["content"][0]["toolResult"]
+        assert tr["content"] == [{"text": ""}]
+
+    def test_system_role_message_promoted_via_gateway_validation(self):
+        from aigw_tpu.schemas import anthropic as anth
+
+        # the shared validator must admit what the translator promotes
+        anth.validate_messages_request({
+            "model": "m", "max_tokens": 8,
+            "messages": [
+                {"role": "user", "content": "q"},
+                {"role": "system", "content": "mid-conv"},
+                {"role": "user", "content": "q2"},
+            ],
+        })
