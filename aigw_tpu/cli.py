@@ -64,6 +64,16 @@ def main(argv: list[str] | None = None) -> int:
                          help="KV pages to allocate (0 = auto)")
     p_serve.add_argument("--tp", type=int, default=1,
                          help="tensor-parallel degree (devices on the mesh)")
+    p_serve.add_argument("--ep", type=int, default=1,
+                         help="expert-parallel degree (MoE families; mesh "
+                              "is dp=1 × tp × sp × ep)")
+    p_serve.add_argument("--sp", type=int, default=1,
+                         help="sequence-parallel degree: prompts >= "
+                              "--sp-prefill-min-tokens prefill via ring "
+                              "attention over the sp mesh axis")
+    p_serve.add_argument("--sp-prefill-min-tokens", type=int, default=1024,
+                         help="minimum prompt length routed through the "
+                              "sequence-parallel prefill path")
     p_serve.add_argument("--quantize", default="", choices=["", "int8"],
                          help="weight-only quantization (W8A16)")
     p_serve.add_argument("--decode-steps-per-tick", type=int, default=8,
@@ -259,10 +269,13 @@ async def _run_tpuserve(args: argparse.Namespace) -> int:
         page_size=args.page_size,
         hbm_pages=args.hbm_pages,
         tp=args.tp,
+        ep=args.ep,
+        sp=args.sp,
         quantize=args.quantize,
         lora_adapters=lora_adapters or None,
         decode_steps_per_tick=args.decode_steps_per_tick,
         enable_prefix_cache=not args.no_prefix_cache,
+        sp_prefill_min_tokens=args.sp_prefill_min_tokens,
     )
     print(f"tpuserve listening on http://{args.host}:{args.port}", flush=True)
     await _wait_for_signal()

@@ -275,6 +275,66 @@ def prefill(
     return _logits(p, cfg, last), kv_cache
 
 
+def prefill_sp(
+    p: dict[str, jax.Array],
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [B, S] int32, right-padded; S divisible by sp
+    seq_lens: jax.Array,  # [B] int32 true lengths
+    kv_cache: jax.Array,
+    page_table: jax.Array,  # [B, max_pages]
+    page_size: int,
+    *,
+    mesh,  # jax.sharding.Mesh with an "sp" axis
+    strategy: str = "ring",  # "ring" | "ulysses"
+    mlp=None,
+    lora=None,
+    adapter_idx=None,
+) -> tuple[jax.Array, jax.Array]:
+    """Sequence-parallel prefill: context parallelism for prompts whose
+    attention working set exceeds one chip's HBM budget (SURVEY.md §5
+    long-context). Identical to ``prefill`` except attention runs as ring
+    attention over the ``sp`` mesh axis (ops/ring_attention.py) — each
+    device holds S/sp of the sequence and K/V blocks rotate over ICI
+    neighbors.
+
+    Correctness under right padding: ring attention is causal-only (no
+    validity mask), but padding sits at positions >= seq_len, so a valid
+    query at position i < seq_len only ever attends keys <= i, all valid.
+    Outputs at padded positions are garbage and are never read (logits are
+    taken at seq_lens-1; padded K/V scatters are dropped)."""
+    from aigw_tpu.ops.ring_attention import ring_attention
+
+    B, S = tokens.shape
+    positions = jnp.arange(S, dtype=jnp.int32)[None, :].repeat(B, 0)
+    valid = positions < seq_lens[:, None]
+    n_slots = kv_cache.shape[2]
+    slot = (
+        jnp.take_along_axis(page_table, positions // page_size, axis=1)
+        * page_size
+        + positions % page_size
+    )
+    x = _embed_rows(p, tokens)
+    for i in range(cfg.n_layers):
+        h = rms_norm(x, p[f"l{i}.attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(p, i, h, positions, cfg, lora, adapter_idx)
+        flat = jnp.where(valid, slot, n_slots)
+        kv_cache = kv_cache.at[i, 0, flat].set(k, mode="drop")
+        kv_cache = kv_cache.at[i, 1, flat].set(v, mode="drop")
+        attn = ring_attention(
+            q, k.astype(q.dtype), v.astype(q.dtype),
+            mesh=mesh, causal=True, strategy=strategy,
+        ).astype(x.dtype)
+        x = x + _wo_project(p, i, attn, lora, adapter_idx)
+        h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
+        x = x + (mlp(p, i, h) if mlp is not None
+                 else _mlp(p, i, h, lora, adapter_idx))
+    x = rms_norm(x, p["norm_f"], cfg.norm_eps)
+    last = jnp.take_along_axis(
+        x, (seq_lens - 1)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    return _logits(p, cfg, last), kv_cache
+
+
 def decode_step(
     p: dict[str, jax.Array],
     cfg: LlamaConfig,

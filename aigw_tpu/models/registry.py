@@ -36,13 +36,17 @@ class ModelFns:
     # chunked prefill over cached prefix pages; None disables the engine's
     # prefix cache for the family
     prefill_suffix: Any = None
+    # sequence-parallel (ring-attention) prefill for long prompts; None
+    # disables the engine's sp prefill path for the family
+    prefill_sp: Any = None
 
 
 def family_fns(family: str) -> ModelFns:
     if family == "llama":
         return ModelFns(llama.init_params, llama.prefill, llama.decode_step,
                         llama.hidden_states,
-                        prefill_suffix=llama.prefill_suffix)
+                        prefill_suffix=llama.prefill_suffix,
+                        prefill_sp=llama.prefill_sp)
     if family == "mixtral":
         from aigw_tpu.models import mixtral
 

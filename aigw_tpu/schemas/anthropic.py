@@ -49,6 +49,45 @@ def validate_messages_request(body: dict[str, Any]) -> None:
                 f"messages[{i}] must have role user|assistant|system")
 
 
+def promote_system_messages(body: dict[str, Any]) -> dict[str, Any]:
+    """Return a new request body with any role:"system" messages removed
+    from the array and their text folded into the top-level ``system``
+    parameter (reference promoteAnthropicSystemMessagesToParam — the
+    Anthropic upstream itself rejects role:system in messages, so
+    passthrough backends need the promotion too). No-op (same dict) when
+    no system messages are present."""
+    messages = body.get("messages")
+    if not isinstance(messages, list) or not any(
+        isinstance(m, dict) and m.get("role") == "system" for m in messages
+    ):
+        return body
+    parts: list[str] = []
+    sys_param = body.get("system")
+    if isinstance(sys_param, str) and sys_param:
+        parts.append(sys_param)
+    elif isinstance(sys_param, list):
+        parts.extend(
+            b.get("text", "")
+            for b in sys_param
+            if isinstance(b, dict) and b.get("type") == "text"
+        )
+    kept: list[Any] = []
+    for m in messages:
+        if isinstance(m, dict) and m.get("role") == "system":
+            content = m.get("content")
+            text = (content if isinstance(content, str)
+                    else text_of_blocks(content_blocks(content)))
+            if text:
+                parts.append(text)
+        else:
+            kept.append(m)
+    out = dict(body, messages=kept)
+    system = "\n".join(p for p in parts if p)
+    if system:
+        out["system"] = system
+    return out
+
+
 def content_blocks(content: Any) -> list[dict[str, Any]]:
     """Normalize the string-or-blocks content union to a block list."""
     if isinstance(content, str):

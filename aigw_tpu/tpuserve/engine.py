@@ -73,6 +73,12 @@ class EngineConfig:
     # (the server surfaces 429 + retry-after) instead of growing an
     # unbounded queue.
     max_queued_requests: int = 256
+    # Sequence-parallel prefill: prompts at least this long run through
+    # the ring-attention path when the mesh has an sp axis > 1 (context
+    # parallelism for prompts whose attention working set exceeds one
+    # chip). Shorter prompts use the plain prefill — the ICI rotation
+    # only pays for itself on long sequences.
+    sp_prefill_min_tokens: int = 1024
 
     def __post_init__(self) -> None:
         if self.max_seq_len % self.page_size != 0:
@@ -136,6 +142,7 @@ class EngineStats:
     kv_occupancy: float = 0.0
     tokens_generated: int = 0
     prefills: int = 0
+    sp_prefills: int = 0  # prefills routed through ring attention
     decode_steps: int = 0
     prefix_cache_hits: int = 0
     prefix_tokens_reused: int = 0
@@ -272,6 +279,25 @@ class Engine:
                 ps, lora=lora, adapter_idx=adapter_idx,
             )
             return sample(logits + bias, keys, temp, top_p, top_k), kv
+
+        # sequence-parallel (ring attention) prefill for long prompts on
+        # an sp mesh (SURVEY §2.9 context parallelism)
+        self._sp = int(mesh.shape.get("sp", 1)) if mesh is not None else 1
+        self._prefill_sp_fn = None
+        if self._sp > 1 and self.fns.prefill_sp is not None:
+            model_prefill_sp = self.fns.prefill_sp
+
+            def _prefill_sp_step(params, lora, tokens, seq_lens, kv,
+                                 page_table, keys, temp, top_p, top_k,
+                                 bias, adapter_idx):
+                logits, kv = model_prefill_sp(
+                    params, mc, tokens, seq_lens, kv, page_table, ps,
+                    mesh=mesh, lora=lora, adapter_idx=adapter_idx,
+                )
+                return sample(logits + bias, keys, temp, top_p, top_k), kv
+
+            self._prefill_sp_fn = jax.jit(_prefill_sp_step,
+                                          donate_argnums=(4,))
 
         def _decode_scan(params, lora, kv, state):
             """K fused decode+sample steps; sampled tokens feed forward
@@ -510,6 +536,21 @@ class Engine:
                     jnp.asarray([n], jnp.int32),
                     self.kv_cache,
                     jnp.asarray(pt[:, :bucket]),
+                    *sampling_args,
+                )
+            elif (
+                self._prefill_sp_fn is not None
+                and ns >= self.cfg.sp_prefill_min_tokens
+                and S % self._sp == 0
+            ):
+                self.stats.sp_prefills += 1
+                next_tok, self.kv_cache = self._prefill_sp_fn(
+                    self.params,
+                    self.lora_params,
+                    jnp.asarray(tokens),
+                    jnp.asarray([n], jnp.int32),
+                    self.kv_cache,
+                    jnp.asarray(pt),
                     *sampling_args,
                 )
             else:

@@ -63,6 +63,8 @@ class TPUServeServer:
         engine_cfg: EngineConfig,
         metrics: GenAIMetrics | None = None,
         tp: int = 1,
+        ep: int = 1,  # expert parallel (MoE families)
+        sp: int = 1,  # sequence parallel (ring-attention long prefill)
         quantize: str = "",  # "" | "int8" (W8A16; llama-family only)
         # name → adapter param dict (un-stacked [r,in]/[out,r] per target);
         # served when a request's model == "<base>:<adapter>" or the bare
@@ -78,12 +80,31 @@ class TPUServeServer:
         self.metrics = metrics or GenAIMetrics()
 
         mesh = None
-        if tp > 1:
+        if tp > 1 or ep > 1 or sp > 1:
             from aigw_tpu.parallel import MeshSpec, make_mesh
 
-            mesh = make_mesh(MeshSpec(dp=1, tp=tp))
-            logger.info("tensor-parallel serving: tp=%d over %s", tp,
-                        [str(d) for d in mesh.devices.flat])
+            if ep > 1:
+                n_experts = getattr(spec.config, "n_experts", 0)
+                if not n_experts:
+                    raise ValueError(
+                        f"--ep requires a MoE model family; {model!r} "
+                        "has no experts")
+                if n_experts % ep != 0:
+                    raise ValueError(
+                        f"n_experts {n_experts} not divisible by ep={ep}")
+            if tp > 1 and spec.config.n_kv_heads % tp != 0:
+                raise ValueError(
+                    f"n_kv_heads {spec.config.n_kv_heads} not divisible "
+                    f"by tp={tp}")
+            if sp > 1 and self.fns.prefill_sp is None:
+                raise ValueError(
+                    f"--sp requires a model family with a "
+                    f"sequence-parallel prefill; {spec.family!r} has none "
+                    "(devices on the sp axis would sit idle)")
+            mesh = make_mesh(MeshSpec(dp=1, tp=tp, sp=sp, ep=ep))
+            logger.info(
+                "parallel serving: tp=%d ep=%d sp=%d over %s", tp, ep, sp,
+                [str(d) for d in mesh.devices.flat])
         if quantize and quantize != "int8":
             raise ValueError(f"unknown quantization {quantize!r}")
         if quantize == "int8" and spec.family != "llama":
@@ -657,6 +678,7 @@ class TPUServeServer:
             ("tpuserve_kv_occupancy", s.kv_occupancy),
             ("tpuserve_tokens_generated_total", s.tokens_generated),
             ("tpuserve_prefills_total", s.prefills),
+            ("tpuserve_sp_prefills_total", s.sp_prefills),
             ("tpuserve_decode_steps_total", s.decode_steps),
             ("tpuserve_prefix_cache_hits_total", s.prefix_cache_hits),
             ("tpuserve_prefix_tokens_reused_total", s.prefix_tokens_reused),
@@ -675,10 +697,13 @@ async def run_tpuserve(
     page_size: int = 128,
     hbm_pages: int = 0,
     tp: int = 1,
+    ep: int = 1,
+    sp: int = 1,
     quantize: str = "",
     lora_adapters: dict | None = None,
     decode_steps_per_tick: int = 8,
     enable_prefix_cache: bool = True,
+    sp_prefill_min_tokens: int = 1024,
 ) -> web.AppRunner:
     server = TPUServeServer(
         model,
@@ -689,8 +714,11 @@ async def run_tpuserve(
             num_pages=hbm_pages,
             decode_steps_per_tick=decode_steps_per_tick,
             enable_prefix_cache=enable_prefix_cache,
+            sp_prefill_min_tokens=sp_prefill_min_tokens,
         ),
         tp=tp,
+        ep=ep,
+        sp=sp,
         quantize=quantize,
         lora_adapters=lora_adapters,
     )

@@ -185,6 +185,7 @@ class AnthropicToBedrockConverse(Translator):
         self._usage = TokenUsage()
         self._stop_reason: str | None = None
         self._open_blocks: set[int] = set()
+        self._saw_message_start = False
         self._saw_message_stop = False
         self._sent_message_stop = False
 
@@ -380,6 +381,7 @@ class AnthropicToBedrockConverse(Translator):
                 continue
             etype = msg.event_type
             if etype == "messageStart":
+                self._saw_message_start = True
                 self._sse("message_start", {
                     "type": "message_start",
                     "message": {
@@ -465,7 +467,10 @@ class AnthropicToBedrockConverse(Translator):
                     usage = usage.merge_override(self._usage)
                 if self._saw_message_stop:
                     self._emit_message_close(out)
-        if end_of_stream and self._saw_message_stop:
+        if end_of_stream and self._saw_message_start:
+            # close unconditionally once the message opened — a stream
+            # truncated before messageStop must still terminate with
+            # message_delta/message_stop or SDK accumulators hang
             usage = usage.merge_override(self._usage)
             self._emit_message_close(out)
         return ResponseTx(

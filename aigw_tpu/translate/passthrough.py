@@ -108,6 +108,13 @@ class AnthropicPassthrough(PassthroughTranslator):
         kw.setdefault("usage_extractor", _anthropic_stream_usage)
         super().__init__(**kw)
 
+    def request(self, body: dict[str, Any]) -> RequestTx:
+        # the gateway admits mid-conversation role:system messages, but
+        # the Anthropic upstream rejects them — promote to the top-level
+        # system parameter before forwarding
+        return super().request(
+            anthropic_schema.promote_system_messages(body))
+
     def response_error(self, status: int, body: bytes) -> bytes:
         text = body.decode("utf-8", errors="replace")[:4096]
         return anthropic_schema.error_body(

@@ -342,3 +342,34 @@ class TestReviewRegressions:
                 {"role": "user", "content": "q2"},
             ],
         })
+
+    def test_truncated_stream_still_closes(self):
+        # stream dies after one delta: no messageStop/metadata frames —
+        # the Anthropic SSE must still terminate properly
+        raw = (
+            frame("messageStart", {"role": "assistant"})
+            + frame("contentBlockDelta", {"contentBlockIndex": 0,
+                                          "delta": {"text": "par"}})
+        )
+        events, _ = TestStreaming._drive(TestStreaming(), raw)
+        kinds = [e[0] for e in events]
+        assert kinds[-2:] == ["message_delta", "message_stop"]
+
+
+class TestSystemPromotion:
+    def test_passthrough_promotes_system_messages(self):
+        from aigw_tpu.translate.passthrough import AnthropicPassthrough
+
+        tx = AnthropicPassthrough().request({
+            "model": "m", "max_tokens": 8,
+            "system": "top",
+            "messages": [
+                {"role": "user", "content": "q"},
+                {"role": "system", "content": "mid-conv"},
+                {"role": "user", "content": "q2"},
+            ],
+        })
+        body = json.loads(tx.body)
+        assert body["system"] == "top\nmid-conv"
+        assert all(m["role"] != "system" for m in body["messages"])
+        assert len(body["messages"]) == 2

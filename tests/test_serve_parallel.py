@@ -1,0 +1,213 @@
+"""EP and SP serving through the REAL product surface (VERDICT r1 item 3):
+Mixtral on an ep×tp mesh behind the tpuserve HTTP server, and
+ring-attention (sequence-parallel) prefill inside the engine — not just
+op-level dryruns. Runs on the virtual 8-device CPU mesh (conftest)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+
+import jax
+import pytest
+
+from aigw_tpu.tpuserve.engine import Engine, EngineConfig, GenRequest
+from aigw_tpu.tpuserve.sampling import SamplingParams
+from aigw_tpu.tpuserve.server import TPUServeServer
+
+
+def _start_server(**kw):
+    from aiohttp import web
+
+    holder: dict = {}
+    started = threading.Event()
+
+    def run():
+        async def main():
+            server = TPUServeServer(**kw)
+            runner = web.AppRunner(server.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            holder["port"] = site._server.sockets[0].getsockname()[1]
+            started.set()
+            await asyncio.Event().wait()
+
+        try:
+            asyncio.run(main())
+        except RuntimeError:
+            pass
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(timeout=120)
+    return f"http://127.0.0.1:{holder['port']}"
+
+
+async def _post(url, path, payload):
+    import aiohttp
+
+    async with aiohttp.ClientSession() as s:
+        async with s.post(url + path, json=payload) as resp:
+            return resp.status, await resp.read()
+
+
+class TestExpertParallelServing:
+    """Mixtral-EP through the real server path — the north-star config
+    (BASELINE.json Mixtral-8x7B EP) at tiny scale."""
+
+    @pytest.fixture(scope="class")
+    def ep_url(self):
+        return _start_server(
+            model="tiny-moe",
+            engine_cfg=EngineConfig(max_batch_size=2, max_seq_len=128,
+                                    page_size=16, min_prefill_bucket=16,
+                                    decode_steps_per_tick=4),
+            ep=4, tp=2,
+        )
+
+    def test_chat_completion_on_ep_mesh(self, ep_url):
+        status, body = asyncio.run(_post(ep_url, "/v1/chat/completions", {
+            "model": "tiny-moe",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 4,
+            "temperature": 0,
+        }))
+        assert status == 200, body
+        got = json.loads(body)
+        assert got["object"] == "chat.completion"
+        assert got["usage"]["completion_tokens"] >= 1
+
+    def test_streaming_on_ep_mesh(self, ep_url):
+        async def main():
+            import aiohttp
+
+            async with aiohttp.ClientSession() as s:
+                async with s.post(ep_url + "/v1/chat/completions", json={
+                    "model": "tiny-moe",
+                    "messages": [{"role": "user", "content": "go"}],
+                    "max_tokens": 3, "temperature": 0, "stream": True,
+                }) as resp:
+                    assert resp.status == 200
+                    text = (await resp.read()).decode()
+            assert "data: [DONE]" in text
+
+        asyncio.run(main())
+
+    def test_state_telemetry_live(self, ep_url):
+        async def main():
+            import aiohttp
+
+            async with aiohttp.ClientSession() as s:
+                async with s.get(ep_url + "/state") as resp:
+                    return await resp.json()
+
+        state = asyncio.run(main())
+        assert state["model"] == "tiny-moe"
+        assert state["decode_steps"] > 0
+
+
+class TestSequenceParallelPrefill:
+    def test_sp_prefill_matches_plain_prefill(self):
+        """Greedy generation through the ring-attention prefill path must
+        match the single-path engine exactly (same weights, same prompt)."""
+        from aigw_tpu.models import llama
+        from aigw_tpu.parallel import MeshSpec, make_mesh
+
+        cfg = llama.LlamaConfig(
+            vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=4,
+            ffn_dim=128, max_seq_len=512, rope_theta=10000.0,
+        )
+        params = llama.init_params(jax.random.PRNGKey(7), cfg)
+        prompt = [int(x) for x in
+                  jax.random.randint(jax.random.PRNGKey(1), (70,), 1, 255)]
+
+        def generate(mesh, sp_min):
+            eng = Engine(
+                params, cfg,
+                EngineConfig(max_batch_size=2, max_seq_len=512,
+                             page_size=16, min_prefill_bucket=32,
+                             decode_steps_per_tick=4,
+                             enable_prefix_cache=False,
+                             sp_prefill_min_tokens=sp_min),
+                mesh=mesh,
+            )
+            eng.start()
+            done = threading.Event()
+            toks: list[int] = []
+
+            def emit(tok, fin):
+                if tok >= 0:
+                    toks.append(tok)
+                if fin is not None:
+                    done.set()
+
+            eng.submit(GenRequest(
+                prompt=prompt, max_tokens=8,
+                sampling=SamplingParams(temperature=0.0), emit=emit))
+            assert done.wait(timeout=300)
+            sp_prefills = eng.stats.sp_prefills
+            eng.stop()
+            return toks, sp_prefills
+
+        ref_toks, ref_sp = generate(None, 10**9)
+        assert ref_sp == 0
+        mesh = make_mesh(MeshSpec(dp=1, tp=1, sp=4))
+        sp_toks, sp_count = generate(mesh, 64)  # 70-token prompt routes sp
+        assert sp_count == 1, "prompt did not take the sp prefill path"
+        assert sp_toks == ref_toks
+
+    def test_short_prompt_skips_sp_path(self):
+        from aigw_tpu.models import llama
+        from aigw_tpu.parallel import MeshSpec, make_mesh
+
+        cfg = llama.LlamaConfig(
+            vocab_size=256, dim=64, n_layers=1, n_heads=4, n_kv_heads=4,
+            ffn_dim=128, max_seq_len=256, rope_theta=10000.0,
+        )
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        mesh = make_mesh(MeshSpec(dp=1, tp=1, sp=4))
+        eng = Engine(
+            params, cfg,
+            EngineConfig(max_batch_size=1, max_seq_len=256, page_size=16,
+                         min_prefill_bucket=16, decode_steps_per_tick=2,
+                         enable_prefix_cache=False,
+                         sp_prefill_min_tokens=1024),
+            mesh=mesh,
+        )
+        eng.start()
+        done = threading.Event()
+
+        def emit(tok, fin):
+            if fin is not None:
+                done.set()
+
+        eng.submit(GenRequest(prompt=[1, 2, 3], max_tokens=2,
+                              sampling=SamplingParams(temperature=0.0),
+                              emit=emit))
+        assert done.wait(timeout=120)
+        assert eng.stats.sp_prefills == 0
+        eng.stop()
+
+
+class TestServerValidation:
+    def test_ep_on_dense_model_rejected(self):
+        with pytest.raises(ValueError, match="MoE"):
+            TPUServeServer(
+                model="tiny-random",
+                engine_cfg=EngineConfig(max_batch_size=1, max_seq_len=64,
+                                        page_size=16,
+                                        min_prefill_bucket=16),
+                ep=4,
+            )
+
+    def test_indivisible_tp_rejected(self):
+        with pytest.raises(ValueError, match="divisible"):
+            TPUServeServer(
+                model="tiny-random",
+                engine_cfg=EngineConfig(max_batch_size=1, max_seq_len=64,
+                                        page_size=16,
+                                        min_prefill_bucket=16),
+                tp=3,
+            )
