@@ -396,18 +396,46 @@ class RuleBackendRef:
 
 @dataclass(frozen=True)
 class HeaderMatch:
-    """Exact header match for a route rule (reference matches on
-    x-ai-eg-model via HTTPRoute header matching)."""
+    """Exact or regex header match for a route rule (reference matches on
+    x-ai-eg-model via HTTPRoute header matching, types Exact and
+    RegularExpression)."""
 
     name: str
     value: str
+    regex: bool = False
+
+    def match(self, got: str) -> bool:
+        if self.regex:
+            import re
+
+            try:
+                return re.fullmatch(self.value, got) is not None
+            except re.error:
+                return False
+        return got == self.value
 
     @staticmethod
     def parse(value: dict[str, Any]) -> "HeaderMatch":
-        return HeaderMatch(name=str(value["name"]).lower(), value=str(value["value"]))
+        m = HeaderMatch(
+            name=str(value["name"]).lower(),
+            value=str(value["value"]),
+            regex=bool(value.get("regex", False)),
+        )
+        if m.regex:
+            import re
+
+            try:
+                re.compile(m.value)
+            except re.error as e:
+                raise ConfigError(
+                    f"invalid regex for header {m.name!r}: {e}") from None
+        return m
 
     def to_dict(self) -> dict[str, Any]:
-        return {"name": self.name, "value": self.value}
+        d: dict[str, Any] = {"name": self.name, "value": self.value}
+        if self.regex:
+            d["regex"] = True
+        return d
 
 
 @dataclass(frozen=True)
@@ -431,7 +459,10 @@ class RouteRule:
             if not exact and not prefix:
                 return False
         for m in self.headers:
-            if headers.get(m.name) != m.value:
+            got = headers.get(m.name)
+            # a missing header never matches — even patterns that accept
+            # the empty string (HTTPRoute semantics: header must exist)
+            if got is None or not m.match(got):
                 return False
         return True
 
@@ -627,11 +658,27 @@ class Config:
 
 
 def load_config(path: str) -> Config:
-    """Load a Config from a YAML or JSON file."""
+    """Load a Config from a YAML or JSON file. K8s CRD manifests (the
+    reference's example YAML, multi-document with kind/apiVersion) are
+    detected and compiled via config.crd — ``aigw run basic.yaml`` works
+    on the reference's own examples unchanged."""
     import yaml
 
     with open(path, "r", encoding="utf-8") as f:
-        data = yaml.safe_load(f)
+        text = f.read()
+    docs = [d for d in yaml.safe_load_all(text) if d is not None]
+    if not docs:
+        raise ConfigError(f"empty config file {path!r}")
+    from aigw_tpu.config.crd import compile_crd_objects, looks_like_crd
+
+    if looks_like_crd([d for d in docs if isinstance(d, dict)]):
+        return Config.parse(compile_crd_objects(
+            [d for d in docs if isinstance(d, dict)]))
+    if len(docs) > 1:
+        raise ConfigError(
+            f"{path!r} contains {len(docs)} YAML documents but is not a "
+            "K8s CRD manifest; native configs must be a single document")
+    data = docs[0]
     if not isinstance(data, dict):
         raise ConfigError(f"config root must be a mapping, got {type(data)}")
     return Config.parse(data)

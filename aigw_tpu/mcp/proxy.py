@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import collections
 import fnmatch
+import re
 import hashlib
 import json
 import logging
@@ -45,13 +46,19 @@ class MCPBackend:
     url: str  # full MCP endpoint, e.g. http://host:port/mcp
     include_tools: tuple[str, ...] = ()  # glob patterns; empty = all
     exclude_tools: tuple[str, ...] = ()
+    # regex patterns (reference MCPToolFilter includeRegex) — a tool is
+    # included when it matches any glob OR any regex
+    include_tools_regex: tuple[str, ...] = ()
     headers: tuple[tuple[str, str], ...] = ()
 
     def allows(self, tool: str) -> bool:
-        if self.include_tools and not any(
-            fnmatch.fnmatch(tool, p) for p in self.include_tools
-        ):
-            return False
+        if self.include_tools or self.include_tools_regex:
+            globbed = any(
+                fnmatch.fnmatch(tool, p) for p in self.include_tools)
+            rex = any(
+                re.fullmatch(p, tool) for p in self.include_tools_regex)
+            if not globbed and not rex:
+                return False
         return not any(fnmatch.fnmatch(tool, p) for p in self.exclude_tools)
 
 
@@ -80,6 +87,9 @@ class MCPConfig:
                 ),
                 exclude_tools=tuple(
                     (b.get("tool_filter") or {}).get("exclude", ())
+                ),
+                include_tools_regex=tuple(
+                    (b.get("tool_filter") or {}).get("include_regex", ())
                 ),
                 headers=tuple(
                     (str(h["name"]).lower(), str(h["value"]))

@@ -61,16 +61,7 @@ def promote_system_messages(body: dict[str, Any]) -> dict[str, Any]:
         isinstance(m, dict) and m.get("role") == "system" for m in messages
     ):
         return body
-    parts: list[str] = []
-    sys_param = body.get("system")
-    if isinstance(sys_param, str) and sys_param:
-        parts.append(sys_param)
-    elif isinstance(sys_param, list):
-        parts.extend(
-            b.get("text", "")
-            for b in sys_param
-            if isinstance(b, dict) and b.get("type") == "text"
-        )
+    promoted: list[str] = []
     kept: list[Any] = []
     for m in messages:
         if isinstance(m, dict) and m.get("role") == "system":
@@ -78,13 +69,24 @@ def promote_system_messages(body: dict[str, Any]) -> dict[str, Any]:
             text = (content if isinstance(content, str)
                     else text_of_blocks(content_blocks(content)))
             if text:
-                parts.append(text)
+                promoted.append(text)
         else:
             kept.append(m)
     out = dict(body, messages=kept)
-    system = "\n".join(p for p in parts if p)
-    if system:
-        out["system"] = system
+    sys_param = body.get("system")
+    if isinstance(sys_param, list):
+        # block-form system param: preserve the original blocks verbatim
+        # (cache_control etc. must survive) and append promoted text as
+        # new blocks
+        out["system"] = list(sys_param) + [
+            {"type": "text", "text": t} for t in promoted
+        ]
+    else:
+        parts = ([sys_param] if isinstance(sys_param, str) and sys_param
+                 else []) + promoted
+        system = "\n".join(parts)
+        if system:
+            out["system"] = system
     return out
 
 

@@ -491,6 +491,16 @@ class Engine:
             while S < ns:
                 S *= 2
             S = min(S, self.cfg.max_seq_len)
+            use_sp = (
+                self._prefill_sp_fn is not None
+                and prefix_len == 0
+                and ns >= self.cfg.sp_prefill_min_tokens
+            )
+            if use_sp and S % self._sp:
+                # ring attention shards the padded length over sp — round
+                # the bucket up to a multiple of sp (non-power-of-two sp
+                # like 6 must not silently disable the path)
+                S = -(-S // self._sp) * self._sp
             tokens = np.zeros((1, S), np.int32)
             tokens[0, :ns] = suffix
             pt = np.zeros((1, self.cfg.max_pages_per_seq), np.int32)
@@ -538,11 +548,7 @@ class Engine:
                     jnp.asarray(pt[:, :bucket]),
                     *sampling_args,
                 )
-            elif (
-                self._prefill_sp_fn is not None
-                and ns >= self.cfg.sp_prefill_min_tokens
-                and S % self._sp == 0
-            ):
+            elif use_sp:
                 self.stats.sp_prefills += 1
                 next_tok, self.kv_cache = self._prefill_sp_fn(
                     self.params,

@@ -1,0 +1,307 @@
+"""The kind-aware CRD loader: the reference's example manifests compile
+UNCHANGED into native config, and a compiled example serves traffic
+(VERDICT r1 item 5; reference cmd/aigw/translate.go:114-392)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+
+import aiohttp
+import jax
+import pytest
+
+from aigw_tpu.config.crd import load_crd_yaml
+from aigw_tpu.config.model import Config, load_config
+from aigw_tpu.config.runtime import RuntimeConfig
+from aigw_tpu.gateway.server import run_gateway
+
+from fakes import FakeUpstream, openai_chat_response
+
+EXAMPLES = "/root/reference/examples"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(EXAMPLES), reason="reference examples not mounted")
+
+
+def load_example(rel: str) -> Config:
+    return load_config(os.path.join(EXAMPLES, rel))
+
+
+class TestReferenceExamplesCompile:
+    def test_basic(self):
+        cfg = load_example("basic/basic.yaml")
+        b = cfg.backend("envoy-ai-gateway-basic-testupstream")
+        assert b.schema.name.value == "OpenAI"
+        assert b.url == ("http://envoy-ai-gateway-basic-testupstream"
+                         ".default.svc.cluster.local:80")
+        rule = cfg.routes[0].rules[0]
+        assert rule.models == ("some-cool-self-hosted-model",)
+        assert rule.backends[0].backend == \
+            "envoy-ai-gateway-basic-testupstream"
+        assert cfg.models[0].name == "some-cool-self-hosted-model"
+
+    def test_ollama_regex_matchall_and_secret_env(self):
+        os.environ["OPENAI_API_KEY"] = "sk-from-env"
+        try:
+            cfg = load_example("aigw/ollama.yaml")
+        finally:
+            del os.environ["OPENAI_API_KEY"]
+        b = cfg.backend("openai")
+        assert b.url == "http://localhost:11434"
+        # BSP APIKey resolved through the Secret with ${ENV} substitution
+        assert b.auth.kind.value == "APIKey"
+        assert b.auth.api_key == "sk-from-env"
+        # timeouts: ASB 3m wins as backend timeout
+        assert b.request_timeout == 180.0
+        # regex .* model match → matches any model
+        from aigw_tpu.config.model import MODEL_NAME_HEADER
+
+        rule = cfg.routes[0].rules[0]
+        assert rule.matches({MODEL_NAME_HEADER: "anything-at-all"})
+        # llmRequestCosts mapped
+        keys = {c.metadata_key for c in cfg.llm_request_costs}
+        assert {"llm_input_token", "llm_output_token"} <= keys
+
+    def test_token_ratelimit_quotas(self):
+        cfg = load_example("token_ratelimit/token_ratelimit.yaml")
+        # 5 descriptor rules ride io.envoy.ai_gateway metadata
+        assert len(cfg.quotas) == 5
+        q0 = dict(cfg.quotas[0])
+        assert q0["client_key_header"] == "x-tenant-id"
+        assert q0["window_seconds"] == 3600
+        # CEL cost expression mapped to the native Expression engine
+        cel = [c for c in cfg.llm_request_costs
+               if c.metadata_key == "llm_cel_calculated_token"]
+        assert cel and cel[0].cost_type.value == "Expression"
+        assert "input_tokens" in cel[0].expression
+
+    def test_provider_fallback_aws(self):
+        cfg = load_example("provider_fallback/base.yaml")
+        aws = cfg.backend("provider-fallback-aws")
+        assert aws.schema.name.value == "AWSBedrock"
+        assert aws.auth.kind.value == "AWSSigV4"
+        assert aws.auth.aws_region == "us-east-1"
+
+    def test_inference_pool_route(self):
+        cfg = load_example("inference-pool/aigwroute.yaml")
+        # InferencePool-backed refs become pool backends with no static
+        # address (driven by the picker / destination header)
+        pool = cfg.backend("vllm-llama3-8b-instruct")
+        assert not pool.url and not pool.endpoints
+        # complex multi-header match (model + Authorization api key)
+        from aigw_tpu.config.model import MODEL_NAME_HEADER
+
+        rule = cfg.routes[0].rules[0]
+        assert rule.matches({
+            MODEL_NAME_HEADER: "meta-llama/Llama-3.1-8B-Instruct",
+            "authorization": "sk-abcdefghijklmnopqrstuvwxyz"})
+        assert not rule.matches({
+            MODEL_NAME_HEADER: "meta-llama/Llama-3.1-8B-Instruct",
+            "authorization": "wrong"})
+
+    def test_mcp_route(self):
+        os.environ.setdefault("GITHUB_ACCESS_TOKEN", "gh-test-token")
+        cfg = load_example("mcp/openai-github.yaml")
+        assert cfg.mcp is not None
+        mcp = dict(cfg.mcp) if not isinstance(cfg.mcp, dict) else cfg.mcp
+        backends = {b["name"]: b for b in mcp["backends"]}
+        gh = backends["github"]
+        # BackendTLSPolicy + port 443 → https; per-ref path appended
+        assert gh["url"] == \
+            "https://api.githubcopilot.com:443/mcp/x/issues/readonly"
+        assert "issue_read" in gh["tool_filter"]["include"]
+
+    def test_unknown_kind_warns_not_fails(self, caplog):
+        cfg_dict = load_crd_yaml("""
+apiVersion: example.io/v1
+kind: SomethingElse
+metadata: {name: x}
+---
+apiVersion: aigateway.envoyproxy.io/v1beta1
+kind: AIGatewayRoute
+metadata: {name: r}
+spec:
+  rules:
+    - matches:
+        - headers:
+            - {type: Exact, name: x-ai-eg-model, value: m}
+      backendRefs:
+        - {name: b}
+""")
+        assert cfg_dict["routes"][0]["rules"][0]["models"] == ["m"]
+
+
+class TestCompiledExampleServes:
+    def test_basic_example_drives_traffic(self):
+        """The compiled basic.yaml serves a chat completion end to end.
+        The cluster-local hostname can't resolve here, so the request
+        carries x-gateway-destination-endpoint — the reference's own EPP
+        contract (internalapi.go:76) — pointing at the fake upstream."""
+
+        async def main():
+            up = await FakeUpstream().on_json(
+                "/v1/chat/completions", openai_chat_response("served")
+            ).start()
+            cfg = load_example("basic/basic.yaml")
+            server, runner = await run_gateway(RuntimeConfig.build(cfg),
+                                               port=0)
+            site = list(runner.sites)[0]
+            port = site._server.sockets[0].getsockname()[1]
+            url = f"http://127.0.0.1:{port}"
+            try:
+                async with aiohttp.ClientSession() as s:
+                    dest = up.url[len("http://"):]
+                    async with s.post(
+                        url + "/v1/chat/completions",
+                        json={"model": "some-cool-self-hosted-model",
+                              "messages": [{"role": "user",
+                                            "content": "hi"}]},
+                        headers={"x-gateway-destination-endpoint": dest},
+                    ) as resp:
+                        assert resp.status == 200
+                        body = await resp.json()
+                        assert body["choices"][0]["message"][
+                            "content"] == "served"
+                    # a model the example does not declare → 404
+                    async with s.post(
+                        url + "/v1/chat/completions",
+                        json={"model": "other",
+                              "messages": [{"role": "user",
+                                            "content": "hi"}]},
+                    ) as resp:
+                        assert resp.status == 404
+                    # /v1/models lists the example's model
+                    async with s.get(url + "/v1/models") as resp:
+                        ids = [m["id"]
+                               for m in (await resp.json())["data"]]
+                        assert "some-cool-self-hosted-model" in ids
+            finally:
+                await runner.cleanup()
+                await up.stop()
+
+        asyncio.run(main())
+
+
+class TestTranslateCLI:
+    def test_translate_reference_example(self, capsys):
+        from aigw_tpu.cli import main
+
+        rc = main(["translate", os.path.join(EXAMPLES, "basic/basic.yaml")])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["routes"]
+
+
+class TestReviewRegressions:
+    def test_regex_model_match_rewritten_to_native_header(self):
+        from aigw_tpu.config.model import MODEL_NAME_HEADER
+
+        cfg_dict = load_crd_yaml("""
+apiVersion: aigateway.envoyproxy.io/v1beta1
+kind: AIGatewayRoute
+metadata: {name: r}
+spec:
+  rules:
+    - matches:
+        - headers:
+            - {type: RegularExpression, name: x-ai-eg-model, value: "gpt-.*"}
+      backendRefs:
+        - {name: b}
+""")
+        cfg = Config.parse(cfg_dict)
+        rule = cfg.routes[0].rules[0]
+        assert rule.matches({MODEL_NAME_HEADER: "gpt-4o"})
+        assert not rule.matches({MODEL_NAME_HEADER: "claude-3"})
+
+    def test_missing_header_never_matches(self):
+        from aigw_tpu.config.model import RouteRule
+
+        rule = RouteRule.parse({
+            "backends": ["b"],
+            "headers": [{"name": "authorization", "value": ".*",
+                         "regex": True}],
+        })
+        assert rule.matches({"authorization": "Bearer x"})
+        assert not rule.matches({})  # header must exist
+
+    def test_invalid_regex_rejected_at_parse(self):
+        from aigw_tpu.config.model import ConfigError, RouteRule
+
+        with pytest.raises(ConfigError, match="invalid regex"):
+            RouteRule.parse({
+                "backends": ["b"],
+                "headers": [{"name": "h", "value": "gpt-(",
+                             "regex": True}],
+            })
+
+    def test_multi_doc_native_config_rejected(self, tmp_path):
+        from aigw_tpu.config.model import ConfigError
+
+        p = tmp_path / "cfg.yaml"
+        p.write_text("version: v1\nbackends: []\nroutes: []\n---\n"
+                     "version: v1\nbackends: []\n")
+        with pytest.raises(ConfigError, match="documents"):
+            load_config(str(p))
+
+    def test_mcp_include_regex_filters_correctly(self):
+        from aigw_tpu.mcp.proxy import MCPBackend
+
+        b = MCPBackend(name="b", url="http://x",
+                       include_tools_regex=("issue_.*",))
+        assert b.allows("issue_read")
+        assert not b.allows("pr_create")
+
+    def test_system_promotion_preserves_cache_control_blocks(self):
+        from aigw_tpu.schemas.anthropic import promote_system_messages
+
+        out = promote_system_messages({
+            "model": "m", "max_tokens": 8,
+            "system": [{"type": "text", "text": "big prompt",
+                        "cache_control": {"type": "ephemeral"}}],
+            "messages": [
+                {"role": "user", "content": "q"},
+                {"role": "system", "content": "mid"},
+            ],
+        })
+        assert out["system"][0]["cache_control"] == {"type": "ephemeral"}
+        assert out["system"][1] == {"type": "text", "text": "mid"}
+        assert all(m["role"] != "system" for m in out["messages"])
+
+
+class TestSpBucketRounding:
+    def test_non_pow2_sp_still_routes_sp_prefill(self):
+        import threading
+
+        from aigw_tpu.models import llama
+        from aigw_tpu.parallel import MeshSpec, make_mesh
+        from aigw_tpu.tpuserve.engine import Engine, EngineConfig, GenRequest
+        from aigw_tpu.tpuserve.sampling import SamplingParams
+
+        cfg = llama.LlamaConfig(
+            vocab_size=128, dim=32, n_layers=1, n_heads=2, n_kv_heads=2,
+            ffn_dim=64, max_seq_len=256, rope_theta=10000.0,
+        )
+        mesh = make_mesh(MeshSpec(dp=1, tp=1, sp=2))
+        eng = Engine(
+            llama.init_params(jax.random.PRNGKey(0), cfg), cfg,
+            EngineConfig(max_batch_size=1, max_seq_len=256, page_size=16,
+                         min_prefill_bucket=16, decode_steps_per_tick=2,
+                         enable_prefix_cache=False,
+                         sp_prefill_min_tokens=20),
+            mesh=mesh,
+        )
+        eng.start()
+        done = threading.Event()
+
+        def emit(tok, fin):
+            if fin is not None:
+                done.set()
+
+        eng.submit(GenRequest(prompt=list(range(1, 31)), max_tokens=2,
+                              sampling=SamplingParams(temperature=0.0),
+                              emit=emit))
+        assert done.wait(timeout=300)
+        assert eng.stats.sp_prefills == 1
+        eng.stop()
