@@ -23,6 +23,7 @@ rate-limit filter, filterconfig.go:84-87).
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import os
 import time
@@ -192,6 +193,13 @@ class GatewayServer:
                            DEFAULT_HEADER_ATTRIBUTES)
         )
         self._cost_sink = cost_sink
+        # OpenInference privacy knobs + structured access log (reference:
+        # openinference/config.go env vars; Envoy access-log enrichment)
+        from aigw_tpu.obs.accesslog import AccessLogger
+        from aigw_tpu.obs.openinference import TraceConfig as OITraceConfig
+
+        self._oi_config = OITraceConfig.from_env()
+        self.access_log = AccessLogger()
         self.circuit = CircuitBreaker()
         self._session: aiohttp.ClientSession | None = None
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
@@ -212,13 +220,15 @@ class GatewayServer:
         self._picker_tasks: set[asyncio.Task] = set()
         self._build_pickers(runtime)
         self.app.on_startup.append(self._start_pickers)
-        if runtime.config.mcp:
-            # MCP endpoint path/backends are fixed at startup (config hot
-            # reload swaps routes/backends; MCP topology needs a restart).
-            from aigw_tpu.mcp import MCPConfig, MCPProxy
+        # MCP proxy is always registered (default path /mcp) so a config
+        # hot-reload can add/change backends, filters, and authz without a
+        # restart — only the HTTP *path* is fixed once the router freezes
+        # (the reference hot-reloads MCPConfig through the same filterapi
+        # bundle watcher as routes).
+        from aigw_tpu.mcp import MCPConfig, MCPProxy
 
-            self.mcp = MCPProxy(MCPConfig.parse(runtime.config.mcp))
-            self.mcp.register(self.app)
+        self.mcp = MCPProxy(MCPConfig.parse(runtime.config.mcp or {}))
+        self.mcp.register(self.app)
         self.app.on_cleanup.append(self._cleanup)
 
     # -- lifecycle --------------------------------------------------------
@@ -231,6 +241,9 @@ class GatewayServer:
         endpoint pools are unchanged are reused so telemetry and session
         affinity survive reloads."""
         self._runtime = rc
+        from aigw_tpu.mcp import MCPConfig
+
+        self.mcp.update_config(MCPConfig.parse(rc.config.mcp or {}))
         try:
             loop = asyncio.get_running_loop()
         except RuntimeError:
@@ -352,10 +365,33 @@ class GatewayServer:
         return web.Response(text="\n".join(out),
                             content_type="text/plain")
 
+    def _log_rejection(
+        self, request: web.Request, status: int, started: float,
+        model: str = "", reason: str = "",
+    ) -> None:
+        """Access-log line for requests rejected before the attempt loop
+        (schema 400s, unknown-model 404s) — the lines operators grep for
+        when debugging client misconfiguration."""
+        if not self.access_log.enabled:
+            return
+        from aigw_tpu.obs.openinference import error_type_for_status
+
+        self.access_log.log(
+            method=request.method,
+            path=request.path,
+            status=status,
+            duration_ms=(time.monotonic() - started) * 1000.0,
+            model=model,
+            error_type=reason or error_type_for_status(status),
+            client=request.remote or "",
+            request_id=request.headers.get("x-request-id", ""),
+        )
+
     # -- the data plane ---------------------------------------------------
     async def _handle(self, request: web.Request) -> web.StreamResponse:
         endpoint, front_schema, operation = _ENDPOINTS[request.path]
         rc = self._runtime  # pin the config for this request
+        started = time.monotonic()
         raw = await request.read()
         error_body = (
             anth.error_body
@@ -367,6 +403,8 @@ class GatewayServer:
             ctype = request.headers.get("content-type", "")
             model = _multipart_model(raw, ctype)
             if not model:
+                self._log_rejection(request, 400, started,
+                                    reason="missing_model")
                 return web.Response(
                     status=400,
                     body=error_body("missing 'model' form field"),
@@ -381,6 +419,8 @@ class GatewayServer:
                 elif endpoint is Endpoint.MESSAGES:
                     anth.validate_messages_request(body)
             except oai.SchemaError as e:
+                self._log_rejection(request, 400, started,
+                                    reason="invalid_request")
                 return web.Response(
                     status=400, body=error_body(str(e)),
                     content_type="application/json")
@@ -393,6 +433,8 @@ class GatewayServer:
         try:
             match = match_route(rc, request.host, match_headers)
         except NoRouteError:
+            self._log_rejection(request, 404, started, model=model,
+                                reason="model_not_found")
             return web.Response(
                 status=404,
                 body=error_body(
@@ -419,13 +461,20 @@ class GatewayServer:
             span.attributes.update(
                 header_attributes(client_headers, self._header_attrs)
             )
+            if isinstance(body, dict):
+                span.attributes.update(
+                    self._openinference_request_attrs(endpoint, body, raw)
+                )
 
         # ---- phase 2: upstream attempts --------------------------------
+        status = 500
         try:
-            return await self._attempt_loop(
+            resp_out = await self._attempt_loop(
                 request, endpoint, front_schema, selector, rc, body,
                 req_metrics, route_name, error_body, client_headers, span,
             )
+            status = resp_out.status
+            return resp_out
         finally:
             if span is not None:
                 span.attributes.update(
@@ -442,6 +491,88 @@ class GatewayServer:
                 if req_metrics.error_type:
                     span.record_error(req_metrics.error_type)
                 span.end()
+            if self.access_log.enabled:
+                from aigw_tpu.obs.openinference import error_type_for_status
+
+                err = req_metrics.error_type
+                if err.isdigit():
+                    err = error_type_for_status(int(err))
+                self.access_log.log(
+                    method=request.method,
+                    path=request.path,
+                    status=status,
+                    duration_ms=(time.monotonic()
+                                 - req_metrics.start) * 1000.0,
+                    route=route_name,
+                    backend=req_metrics.provider,
+                    model=model,
+                    response_model=req_metrics.response_model,
+                    stream=req_metrics.tokens_seen > 0,
+                    input_tokens=req_metrics.final_usage.input_tokens,
+                    output_tokens=req_metrics.final_usage.output_tokens,
+                    total_tokens=req_metrics.final_usage.total_tokens,
+                    cached_tokens=(
+                        req_metrics.final_usage.cached_input_tokens),
+                    costs=req_metrics.costs,
+                    error_type=err,
+                    client=request.remote or "",
+                    trace_id=(span.context.trace_id
+                              if span is not None else ""),
+                    request_id=client_headers.get("x-request-id", ""),
+                    attempts=req_metrics.attempts,
+                )
+
+    def _openinference_request_attrs(
+        self, endpoint: Endpoint, body: dict[str, Any], raw: bytes
+    ) -> dict[str, Any]:
+        from aigw_tpu.obs import openinference as oi
+
+        try:
+            if endpoint is Endpoint.CHAT_COMPLETIONS:
+                return oi.chat_request_attributes(
+                    body, raw, self._oi_config)
+            if endpoint is Endpoint.MESSAGES:
+                return oi.chat_request_attributes(
+                    body, raw, self._oi_config,
+                    system=oi.LLM_SYSTEM_ANTHROPIC)
+            if endpoint is Endpoint.EMBEDDINGS:
+                return oi.embeddings_request_attributes(
+                    body, raw, self._oi_config)
+            if endpoint is Endpoint.COMPLETIONS:
+                return oi.completion_request_attributes(
+                    body, raw, self._oi_config)
+        except Exception:  # noqa: BLE001 — telemetry must never 500
+            logger.debug("openinference request attrs failed",
+                         exc_info=True)
+        return {}
+
+    def _openinference_response_attrs(
+        self, span, endpoint: Endpoint, front_schema: APISchemaName,
+        payload: bytes,
+    ) -> None:
+        from aigw_tpu.obs import openinference as oi
+
+        try:
+            resp = json.loads(payload)
+            if not isinstance(resp, dict):
+                return
+            if endpoint is Endpoint.CHAT_COMPLETIONS:
+                attrs = oi.chat_response_attributes(resp, self._oi_config)
+            elif endpoint is Endpoint.MESSAGES:
+                attrs = oi.anthropic_response_attributes(
+                    resp, self._oi_config)
+            elif endpoint is Endpoint.EMBEDDINGS:
+                attrs = oi.embeddings_response_attributes(
+                    resp, self._oi_config)
+            elif endpoint is Endpoint.COMPLETIONS:
+                attrs = oi.completion_response_attributes(
+                    resp, self._oi_config)
+            else:
+                return
+            span.attributes.update(attrs)
+        except Exception:  # noqa: BLE001 — telemetry must never 500
+            logger.debug("openinference response attrs failed",
+                         exc_info=True)
 
     async def _attempt_loop(
         self, request, endpoint, front_schema, selector, rc, body,
@@ -461,6 +592,7 @@ class GatewayServer:
             if attempt > 0:
                 self.metrics.retries_total.labels(route_name, rb.backend.name).inc()
             attempt += 1
+            req_metrics.attempts = attempt
             req_metrics.provider = rb.backend.name
             try:
                 result = await self._attempt(
@@ -652,7 +784,8 @@ class GatewayServer:
             if upstream_streams:
                 return await self._stream_response(
                     request, resp, translator, rb, req_metrics, route_name,
-                    client_headers, front_schema,
+                    client_headers, front_schema, span=span,
+                    endpoint=endpoint,
                 )
             try:
                 raw = await resp.read()
@@ -666,6 +799,9 @@ class GatewayServer:
             rx = translator.response_body(raw, True)
             usage = rx.usage
             req_metrics.response_model = rx.model
+            if span is not None:
+                self._openinference_response_attrs(
+                    span, endpoint, front_schema, rx.body or raw)
             req_metrics.finish(usage)
             self._sink_costs(usage, req_metrics, route_name, client_headers)
             self.metrics.requests_total.labels(
@@ -687,6 +823,8 @@ class GatewayServer:
         route_name: str,
         client_headers: dict[str, str],
         front_schema: APISchemaName = APISchemaName.OPENAI,
+        span=None,
+        endpoint: Endpoint | None = None,
     ) -> web.StreamResponse:
         """Proxy the SSE stream through the translator — the hot loop
         (reference processor_impl.go:481-575)."""
@@ -701,6 +839,17 @@ class GatewayServer:
         await out.prepare(request)
         usage = TokenUsage()
         model = ""
+        # span output attrs for streams: reconstruct the response from
+        # the front-schema SSE bytes (reference sse_converter.go). Only
+        # when tracing is on — the accumulator parses every event.
+        acc = None
+        if span is not None and endpoint in (
+            Endpoint.CHAT_COMPLETIONS, Endpoint.MESSAGES,
+            Endpoint.COMPLETIONS,
+        ):
+            from aigw_tpu.obs.openinference import StreamAccumulator
+
+            acc = StreamAccumulator()
         try:
             async for chunk in resp.content.iter_any():
                 rx = translator.response_body(chunk, False)
@@ -708,11 +857,15 @@ class GatewayServer:
                 model = rx.model or model
                 req_metrics.record_tokens_emitted(rx.tokens_emitted)
                 if rx.body:
+                    if acc is not None:
+                        acc.feed(rx.body)
                     await out.write(rx.body)
             rx = translator.response_body(b"", True)
             usage = usage.merge_override(rx.usage)
             model = rx.model or model
             if rx.body:
+                if acc is not None:
+                    acc.feed(rx.body)
                 await out.write(rx.body)
         except (aiohttp.ClientError, asyncio.TimeoutError) as e:
             # Mid-stream failure: the client already has bytes; surface an
@@ -736,6 +889,26 @@ class GatewayServer:
                     b'"type": "upstream_error", "code": null}}\n\n'
                 )
         req_metrics.response_model = model
+        if acc is not None:
+            final = acc.response()
+            if final is not None:
+                from aigw_tpu.obs import openinference as oi
+
+                try:
+                    if front_schema is APISchemaName.ANTHROPIC:
+                        span.attributes.update(
+                            oi.anthropic_response_attributes(
+                                final, self._oi_config))
+                    elif endpoint is Endpoint.COMPLETIONS:
+                        span.attributes.update(
+                            oi.completion_response_attributes(
+                                final, self._oi_config))
+                    else:
+                        span.attributes.update(
+                            oi.chat_response_attributes(
+                                final, self._oi_config))
+                except Exception:  # noqa: BLE001
+                    logger.debug("stream span attrs failed", exc_info=True)
         req_metrics.finish(usage)
         self._sink_costs(usage, req_metrics, route_name, client_headers)
         self.metrics.requests_total.labels(route_name, rb.backend.name, "200").inc()
@@ -791,7 +964,8 @@ class GatewayServer:
         model or a model_name_override rewrote the upstream name."""
         limiter = self._runtime.rate_limiter
         has_quota = limiter is not None and limiter.rules
-        if self._cost_sink is None and not has_quota:
+        if (self._cost_sink is None and not has_quota
+                and not self.access_log.enabled):
             return
         model = req_metrics.request_model
         backend = req_metrics.provider
@@ -800,6 +974,7 @@ class GatewayServer:
         )
         if not costs:
             return
+        req_metrics.costs = dict(costs)
         if has_quota:
             limiter.consume(costs, model, backend, client_headers)
         if self._cost_sink is not None:

@@ -9,8 +9,23 @@ handlers.go:326-460):
   (collision-free routing key, like the reference's tool→backend map)
 - ``tools/call``     — strip the prefix, route to the owning backend with
   its own session ID
-- ``prompts/list`` / ``resources/list`` — aggregated (prefixing names/URIs)
+- ``prompts/list`` / ``resources/list`` / ``resources/templates/list`` —
+  aggregated (prefixing names; URIs stay globally unique and unprefixed)
+- ``resources/subscribe`` / ``unsubscribe`` — routed by URI ownership
 - ``ping`` / ``notifications/*`` — handled locally / broadcast
+- Reverse direction (reference handlers.go:983-1100): server→client
+  requests (``roots/list``, ``sampling/createMessage``,
+  ``elicitation/create``) arriving on a backend stream get their ``id``
+  rewritten to a routable composite; the client's JSON-RPC *response*
+  POSTed back is decoded and forwarded to the owning backend
+  (handleClientToServerResponse, handlers.go:606-700). Server-issued
+  ``_meta.progressToken`` values are rewritten the same way so client
+  ``notifications/progress`` route back to the issuing backend
+  (maybeUpdateProgressTokenMetadata / handlers.go:1752).
+- GET listening stream: fans out GET streams to every backend in the
+  session and relays their server-initiated traffic with proxy event
+  ids, heartbeats, and gateway tool-change notifications (reference
+  session.go streamNotifications).
 - Streamable-HTTP: accepts JSON responses and single-event SSE replies
   from backends (spec 2025-06-18).
 """
@@ -18,8 +33,10 @@ handlers.go:326-460):
 from __future__ import annotations
 
 import asyncio
+import base64
 import collections
 import fnmatch
+import os
 import re
 import hashlib
 import json
@@ -38,6 +55,51 @@ logger = logging.getLogger(__name__)
 PROTOCOL_VERSION = "2025-06-18"
 SESSION_HEADER = "mcp-session-id"
 TOOL_SEP = "__"
+
+# Server→client request ids and server-issued progress tokens are rewritten
+# to carry the owning backend so the client's reply can be routed back
+# (reference maybeServerToClientRequestModify encodes id+type+backend with a
+# separator; we JSON-encode the original value, which preserves int/str
+# distinction without per-type identifiers).
+S2C_ID_PREFIX = "aigw-s2c."
+PROGRESS_TOKEN_PREFIX = "aigw-pt."
+# Gateway-initiated pings on the listening stream; client responses to
+# these ids are swallowed (reference doNotForwardResponseToBackends).
+PING_ID_PREFIX = "aigw-ping-"
+# Server→client request methods that expect a client response routed back.
+# ``ping`` is included so a backend-initiated ping's pong finds its way
+# home (and int ids from different backends can't collide at the client).
+S2C_REQUEST_METHODS = (
+    "roots/list",
+    "sampling/createMessage",
+    "elicitation/create",
+    "ping",
+)
+
+
+def _encode_routed(prefix: str, value: Any, backend: str) -> str:
+    enc = (
+        base64.urlsafe_b64encode(json.dumps(value).encode())
+        .decode()
+        .rstrip("=")
+    )
+    return f"{prefix}{enc}.{backend}"
+
+
+def _decode_routed(prefix: str, s: Any) -> tuple[Any, str] | None:
+    """Inverse of _encode_routed; None when ``s`` is not a routed value."""
+    if not isinstance(s, str) or not s.startswith(prefix):
+        return None
+    enc, sep, backend = s[len(prefix):].partition(".")
+    if not sep or not backend:
+        return None
+    try:
+        value = json.loads(
+            base64.urlsafe_b64decode(enc + "=" * (-len(enc) % 4))
+        )
+    except (ValueError, json.JSONDecodeError):
+        return None
+    return value, backend
 
 
 @dataclass(frozen=True)
@@ -98,20 +160,14 @@ class MCPConfig:
             )
             for b in value.get("backends", ())
         )
-        seed = value.get("session_seed", "")
-        if not seed:
-            seed = secrets.token_hex(32)
-            logger.warning(
-                "mcp.session_seed not configured — using a random "
-                "per-process seed; sessions will not survive restarts or "
-                "span replicas"
-            )
         from aigw_tpu.mcp.authz import MCPAuthzConfig
 
         return MCPConfig(
             backends=backends,
             path=value.get("path", "/mcp"),
-            session_seed=seed,
+            # unset stays "" — MCPProxy generates a per-process random seed
+            # once, so config hot-reloads don't invalidate live sessions
+            session_seed=value.get("session_seed", ""),
             session_fallback_seed=value.get("session_fallback_seed", ""),
             authorization=MCPAuthzConfig.parse(
                 value.get("authorization")
@@ -127,7 +183,16 @@ def _rpc_error(id_: Any, code: int, message: str) -> dict[str, Any]:
 class MCPProxy:
     def __init__(self, cfg: MCPConfig):
         self.cfg = cfg
-        seed = cfg.session_seed or secrets.token_hex(32)
+        seed = cfg.session_seed
+        if not seed:
+            seed = secrets.token_hex(32)
+            if cfg.backends:
+                logger.warning(
+                    "mcp.session_seed not configured — using a random "
+                    "per-process seed; sessions will not survive restarts "
+                    "or span replicas"
+                )
+        self._seed = seed
         self._crypto = SessionCrypto(seed, cfg.session_fallback_seed)
         self._session: aiohttp.ClientSession | None = None
         self._authz = None
@@ -135,6 +200,10 @@ class MCPProxy:
             from aigw_tpu.mcp.authz import JWTValidator
 
             self._authz = JWTValidator(cfg.authorization)
+        # listening GET streams to wake when the tool topology changes
+        # (reference toolChangeSignaler in streamNotifications)
+        self._tool_change_listeners: set[asyncio.Event] = set()
+        self._ping_seq = 0
         # bounded per-session replay buffers for Last-Event-Id resumption
         # (reference sse.go). Best-effort and replica-local: the encrypted
         # session itself stays stateless; only recent stream events are
@@ -147,16 +216,44 @@ class MCPProxy:
         app.router.add_post(self.cfg.path, self.handle)
         app.router.add_get(self.cfg.path, self.handle_get)
         app.router.add_delete(self.cfg.path, self.handle_delete)
-        if self._authz is not None:
-            app.router.add_get(
-                "/.well-known/oauth-protected-resource",
-                self._protected_resource_metadata,
-            )
+        # registered unconditionally so authz can be enabled by a config
+        # hot-reload after the router is frozen; 404 while authz is off
+        app.router.add_get(
+            "/.well-known/oauth-protected-resource",
+            self._protected_resource_metadata,
+        )
         app.on_cleanup.append(self._cleanup)
+
+    def update_config(self, cfg: MCPConfig) -> None:
+        """Hot-swap backends/filters/authz (reference: MCPConfig rides the
+        same filterapi bundle watcher as routes). The HTTP path is fixed at
+        registration time; live sessions survive unless the seed changes.
+        Listening GET streams are woken with a tools/list_changed
+        notification when the backend topology differs."""
+        old = self.cfg
+        self.cfg = cfg
+        seed_changed = cfg.session_seed and cfg.session_seed != self._seed
+        if (seed_changed
+                or cfg.session_fallback_seed != old.session_fallback_seed):
+            if seed_changed:
+                self._seed = cfg.session_seed
+            self._crypto = SessionCrypto(
+                self._seed, cfg.session_fallback_seed
+            )
+        self._authz = None
+        if cfg.authorization is not None:
+            from aigw_tpu.mcp.authz import JWTValidator
+
+            self._authz = JWTValidator(cfg.authorization)
+        if old.backends != cfg.backends:
+            for ev in self._tool_change_listeners:
+                ev.set()
 
     async def _protected_resource_metadata(self, _request) -> web.Response:
         """RFC 9728 protected-resource metadata (reference
         MCPRouteOAuth)."""
+        if self._authz is None:
+            return web.Response(status=404)
         cfg = self.cfg.authorization
         return web.json_response({
             "resource": cfg.resource or self.cfg.path,
@@ -253,10 +350,16 @@ class MCPProxy:
 
     async def handle_get(self, request: web.Request) -> web.StreamResponse:
         """GET /mcp with Last-Event-Id: replay buffered stream events
-        after the given id (streamable-HTTP resumption). Without the
-        header this is the listening stream — we have no server-initiated
-        messages to push, so it completes empty (no replay: re-delivering
-        consumed JSON-RPC responses would break strict clients)."""
+        after the given id (streamable-HTTP resumption), then close so the
+        client re-opens a fresh listening stream. Without the header this
+        is the listening stream (reference session.streamNotifications):
+        a GET stream is opened to every backend in the session and their
+        server-initiated traffic (notifications, elicitation/sampling/
+        roots requests) is relayed with proxy event ids, periodic
+        heartbeat pings, and a ``notifications/tools/list_changed`` event
+        when a config reload changes the backend topology. Backends that
+        answer GET with 405 (POST-only servers) are skipped; with zero
+        live backend streams the response completes empty."""
         from aigw_tpu.mcp.authz import AuthzError
 
         token = request.headers.get(SESSION_HEADER, "")
@@ -267,7 +370,7 @@ class MCPProxy:
         except AuthzError as e:
             return web.Response(status=e.status)
         try:
-            self._decode_session(token)
+            sessions = self._decode_session(token)
         except SessionCryptoError:
             return web.Response(status=404)
         last_header = request.headers.get("last-event-id", "")
@@ -287,8 +390,154 @@ class MCPProxy:
                 for event_id, encoded in list(buf["events"]):
                     if event_id > last:
                         await resp.write(encoded)
-        await resp.write_eof()
+            await resp.write_eof()
+            return resp
+        await self._listen_streams(request, resp, token, sessions)
         return resp
+
+    async def _listen_streams(
+        self,
+        request: web.Request,
+        resp: web.StreamResponse,
+        token: str,
+        sessions: dict[str, str],
+    ) -> None:
+        from aigw_tpu.translate.sse import SSEEvent, SSEParser
+
+        http = await self._http()
+        queue: asyncio.Queue = asyncio.Queue()
+
+        async def open_stream(b: MCPBackend):
+            headers = {
+                "accept": "text/event-stream",
+                "mcp-protocol-version": PROTOCOL_VERSION,
+                SESSION_HEADER: sessions[b.name],
+                **dict(b.headers),
+            }
+            try:
+                r = await http.get(
+                    b.url, headers=headers,
+                    timeout=aiohttp.ClientTimeout(total=None,
+                                                  sock_connect=10),
+                )
+            except aiohttp.ClientError as e:
+                logger.debug("mcp GET stream to %s failed: %s", b.name, e)
+                return None
+            if (r.status != 200
+                    or "text/event-stream"
+                    not in r.headers.get("content-type", "")):
+                r.release()
+                return None
+            return b, r
+
+        opened = await asyncio.gather(
+            *(open_stream(b) for b in self.cfg.backends
+              if sessions.get(b.name))
+        )
+        streams: list[tuple[MCPBackend, Any]] = [
+            s for s in opened if s is not None
+        ]
+        if not streams:
+            await resp.write_eof()
+            return
+
+        async def pump(b: MCPBackend, r) -> None:
+            parser = SSEParser()
+            try:
+                async for chunk in r.content.iter_any():
+                    for ev in parser.feed(chunk):
+                        await queue.put((b.name, ev))
+                for ev in parser.flush():
+                    await queue.put((b.name, ev))
+            except aiohttp.ClientError:
+                pass
+            finally:
+                r.close()
+                await queue.put(None)  # stream-ended sentinel
+
+        pumps = [asyncio.ensure_future(pump(b, r)) for b, r in streams]
+        change = asyncio.Event()
+        self._tool_change_listeners.add(change)
+        buf = self._replay_buffer(token)
+
+        async def write_event(
+            ev, backend_name: str | None = None, replayable: bool = True
+        ) -> None:
+            await resp.write(
+                self._prepare_relay_event(ev, backend_name, buf,
+                                          replayable=replayable)
+            )
+
+        def ping_event():
+            self._ping_seq += 1
+            return SSEEvent(
+                event="message",
+                data=json.dumps({
+                    "jsonrpc": "2.0",
+                    "id": f"{PING_ID_PREFIX}{self._ping_seq}",
+                    "method": "ping",
+                }),
+            )
+
+        try:
+            heartbeat = float(
+                os.environ.get("MCP_PROXY_HEARTBEAT_INTERVAL", "60") or 0
+            )
+        except ValueError:
+            heartbeat = 60.0
+        live = len(pumps)
+        getter: asyncio.Task | None = None
+        changed: asyncio.Task | None = None
+        try:
+            # eager heartbeat: some clients block on the first event
+            # (reference streamNotifications does the same)
+            await write_event(ping_event(), replayable=False)
+            while live > 0:
+                if getter is None:
+                    getter = asyncio.ensure_future(queue.get())
+                if changed is None:
+                    changed = asyncio.ensure_future(change.wait())
+                done, _ = await asyncio.wait(
+                    {getter, changed},
+                    timeout=heartbeat if heartbeat > 0 else None,
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                if changed in done:
+                    change.clear()
+                    changed = None
+                    await write_event(SSEEvent(
+                        event="message",
+                        data=json.dumps({
+                            "jsonrpc": "2.0",
+                            "method":
+                                "notifications/tools/list_changed",
+                        }),
+                    ))
+                if getter in done:
+                    item = getter.result()
+                    getter = None
+                    if item is None:
+                        live -= 1
+                        continue
+                    backend_name, ev = item
+                    await write_event(ev, backend_name=backend_name)
+                elif not done:
+                    await write_event(ping_event(),
+                                      replayable=False)  # heartbeat
+        except (ConnectionResetError, aiohttp.ClientError,
+                asyncio.CancelledError):
+            pass  # client went away
+        finally:
+            self._tool_change_listeners.discard(change)
+            for t in pumps:
+                t.cancel()
+            for t in (getter, changed):
+                if t is not None:
+                    t.cancel()
+        try:
+            await resp.write_eof()
+        except ConnectionResetError:
+            pass
 
     # -- session composition ---------------------------------------------
     def _encode_session(self, sessions: dict[str, str]) -> str:
@@ -348,6 +597,31 @@ class MCPProxy:
                     _rpc_error(msg_id, -32000, str(e)), status=404
                 )
 
+            if "method" not in payload:
+                # JSON-RPC *response* from the client — the reverse leg of
+                # a server→client request (reference
+                # handleClientToServerResponse, handlers.go:606)
+                if not session_token:
+                    return web.json_response(
+                        _rpc_error(None, -32600, "missing session ID"),
+                        status=400,
+                    )
+                return await self._client_to_server_response(
+                    payload, sessions
+                )
+            if method == "notifications/initialized":
+                # already sent per-backend during the session fan-out
+                return web.Response(status=202)
+            if method == "notifications/cancelled":
+                # broadcast best-effort: request ids are forwarded to
+                # backends unmodified, so the owner recognizes its id and
+                # aborts; others ignore it. (The reference 202s without
+                # forwarding — handlers.go:490 TODO — this is strictly
+                # more useful.)
+                await self._broadcast(payload, sessions)
+                return web.Response(status=202)
+            if method == "notifications/progress":
+                return await self._route_progress(payload, sessions)
             if is_notification:
                 await self._broadcast(payload, sessions)
                 return web.Response(status=202)
@@ -372,7 +646,8 @@ class MCPProxy:
                 return await self._tools_call_streaming(
                     request, payload, sessions
                 )
-            if method in ("prompts/list", "resources/list"):
+            if method in ("prompts/list", "resources/list",
+                          "resources/templates/list"):
                 return web.json_response(
                     await self._aggregate_list(method, msg_id, sessions)
                 )
@@ -380,7 +655,8 @@ class MCPProxy:
                 return web.json_response(
                     await self._route_by_name(payload, sessions)
                 )
-            if method == "resources/read":
+            if method in ("resources/read", "resources/subscribe",
+                          "resources/unsubscribe"):
                 return web.json_response(
                     await self._route_resource(payload, sessions)
                 )
@@ -442,7 +718,9 @@ class MCPProxy:
             *(init_one(b) for b in self.cfg.backends)
         )
         sessions = {name: sid for name, sid, _ in results if sid}
-        caps: dict[str, Any] = {"tools": {"listChanged": False}}
+        # listChanged: the proxy emits notifications/tools/list_changed on
+        # config hot-reloads (see update_config)
+        caps: dict[str, Any] = {"tools": {"listChanged": True}}
         result = {
             "jsonrpc": "2.0",
             "id": payload.get("id"),
@@ -568,15 +846,11 @@ class MCPProxy:
             )
 
             async def relay(ev):
-                if buf is None:
-                    await out.write(ev.encode())
-                    return
-                event_id = buf["next_id"]
-                buf["next_id"] += 1
-                ev.id = str(event_id)
-                encoded = ev.encode()
-                buf["events"].append((event_id, encoded))
-                await out.write(encoded)
+                # server→client requests riding the tools/call stream
+                # (elicitation, sampling, roots) need routable ids
+                await out.write(
+                    self._prepare_relay_event(ev, backend.name, buf)
+                )
 
             async for chunk in resp.content.iter_any():
                 for ev in parser.feed(chunk):
@@ -643,9 +917,13 @@ class MCPProxy:
     async def _route_resource(
         self, payload: dict[str, Any], sessions: dict[str, str]
     ) -> dict[str, Any]:
-        """resources/read: route by URI. Aggregated resource listings are
-        not renamed (URIs are globally unique), so try each backend that
-        has a session until one answers without error."""
+        """resources/read + subscribe/unsubscribe: route by URI.
+        Aggregated resource listings are not renamed (URIs are globally
+        unique), so try each backend that has a session until one answers
+        without error. The reference instead prefixes URIs with the
+        backend name (upstreamResourceURI); same routing power, but our
+        unprefixed URIs also mean ``notifications/resources/updated``
+        needs no URI rewrite on the way back to the client."""
         msg_id = payload.get("id")
         first_error: dict[str, Any] | None = None
         for b in self.cfg.backends:
@@ -666,10 +944,158 @@ class MCPProxy:
         return first_error or _rpc_error(msg_id, -32602,
                                          "resource not found")
 
+    # -- reverse direction (server→client requests) -----------------------
+    def _prepare_relay_event(
+        self, ev, backend_name: str | None, buf,
+        replayable: bool = True,
+    ) -> bytes:
+        """Shared relay path for backend stream events (tools/call SSE
+        and the GET listening stream): rewrites server-initiated messages
+        so replies can route back (``backend_name=None`` skips the
+        rewrite — gateway-generated pings/tool-change events must keep
+        their ids), then allocates a replayable proxy event id. Returns
+        the encoded bytes to write."""
+        if backend_name is not None and ev.data:
+            try:
+                msg = json.loads(ev.data)
+            except ValueError:
+                msg = None
+            if isinstance(msg, dict) and msg.get("method"):
+                modified = self._modify_server_message(msg, backend_name)
+                if modified is not msg:
+                    ev.data = json.dumps(modified)
+        # heartbeats are written without ids and never buffered — they
+        # must not evict resumable events from the bounded replay deque
+        # or advance Last-Event-Id
+        if replayable and buf is not None:
+            event_id = buf["next_id"]
+            buf["next_id"] += 1
+            ev.id = str(event_id)
+            encoded = ev.encode()
+            buf["events"].append((event_id, encoded))
+            return encoded
+        return ev.encode()
+
+    def _modify_server_message(
+        self, msg: dict[str, Any], backend: str
+    ) -> dict[str, Any]:
+        """Rewrites a server-initiated JSON-RPC message before relaying it
+        to the client: request ids for ``roots/list`` /
+        ``sampling/createMessage`` / ``elicitation/create`` become
+        routable composites, as do server-issued ``_meta.progressToken``
+        values (reference maybeServerToClientRequestModify,
+        handlers.go:983-1070)."""
+        if msg.get("method") not in S2C_REQUEST_METHODS:
+            return msg
+        if msg.get("id") is None:
+            return msg
+        msg = dict(msg, id=_encode_routed(S2C_ID_PREFIX, msg["id"], backend))
+        params = msg.get("params")
+        if isinstance(params, dict):
+            meta = params.get("_meta")
+            if isinstance(meta, dict) and "progressToken" in meta:
+                token = _encode_routed(
+                    PROGRESS_TOKEN_PREFIX, meta["progressToken"], backend
+                )
+                msg["params"] = dict(
+                    params, _meta=dict(meta, progressToken=token)
+                )
+        return msg
+
+    async def _client_to_server_response(
+        self, payload: dict[str, Any], sessions: dict[str, str]
+    ) -> web.Response:
+        """Routes a client JSON-RPC response back to the backend that
+        issued the server→client request (reference
+        handleClientToServerResponse)."""
+        rid = payload.get("id")
+        if isinstance(rid, str) and rid.startswith(PING_ID_PREFIX):
+            # reply to a gateway-initiated heartbeat ping — swallow
+            # (reference doNotForwardResponseToBackends)
+            return web.Response(status=202)
+        decoded = _decode_routed(S2C_ID_PREFIX, rid)
+        if decoded is None:
+            return web.json_response(
+                _rpc_error(None, -32600, f"invalid response ID {rid!r}"),
+                status=400,
+            )
+        orig_id, backend_name = decoded
+        backend = next(
+            (b for b in self.cfg.backends if b.name == backend_name), None
+        )
+        if backend is None:
+            return web.json_response(
+                _rpc_error(None, -32602,
+                           f"unknown backend {backend_name!r}"),
+                status=404,
+            )
+        sid = sessions.get(backend_name, "")
+        if not sid:
+            return web.json_response(
+                _rpc_error(None, -32602,
+                           f"no session for backend {backend_name!r}"),
+                status=400,
+            )
+        restored = dict(payload, id=orig_id)
+        try:
+            resp, _ = await self._call_backend(backend, restored, sid)
+        except (aiohttp.ClientError, RuntimeError) as e:
+            return web.json_response(
+                _rpc_error(None, -32603, f"failed to forward: {e}"),
+                status=502,
+            )
+        if resp is None:
+            return web.Response(status=202)
+        return web.json_response(resp)
+
+    async def _route_progress(
+        self, payload: dict[str, Any], sessions: dict[str, str]
+    ) -> web.Response:
+        """notifications/progress from the client carries a rewritten
+        progressToken naming the backend that asked for progress
+        (reference handleClientToServerNotificationsProgress)."""
+        params = payload.get("params") or {}
+        decoded = _decode_routed(
+            PROGRESS_TOKEN_PREFIX, params.get("progressToken")
+        )
+        if decoded is None:
+            return web.json_response(
+                _rpc_error(
+                    None, -32602,
+                    f"invalid progressToken "
+                    f"{params.get('progressToken')!r}",
+                ),
+                status=400,
+            )
+        token, backend_name = decoded
+        backend = next(
+            (b for b in self.cfg.backends if b.name == backend_name), None
+        )
+        sid = sessions.get(backend_name, "")
+        if backend is None or not sid:
+            return web.json_response(
+                _rpc_error(None, -32602,
+                           f"unknown backend {backend_name!r}"),
+                status=400,
+            )
+        restored = dict(
+            payload, params=dict(params, progressToken=token)
+        )
+        try:
+            await self._call_backend(backend, restored, sid)
+        except (aiohttp.ClientError, RuntimeError) as e:
+            logger.warning("progress forward to %s failed: %s",
+                           backend_name, e)
+        return web.Response(status=202)
+
     async def _aggregate_list(
         self, method: str, msg_id: Any, sessions: dict[str, str]
     ) -> dict[str, Any]:
-        key = "prompts" if method == "prompts/list" else "resources"
+        key = {
+            "prompts/list": "prompts",
+            "resources/list": "resources",
+            "resources/templates/list": "resourceTemplates",
+        }[method]
 
         async def one(b: MCPBackend):
             sid = sessions.get(b.name, "")

@@ -95,6 +95,10 @@ class RequestMetrics:
     tokens_seen: int = 0
     final_usage: TokenUsage = field(default_factory=TokenUsage)
     error_type: str = ""
+    # enrichment surfaced to the structured access log (reference: Envoy
+    # dynamic-metadata pipeline)
+    costs: dict[str, int] = field(default_factory=dict)
+    attempts: int = 0
 
     def _labels(self) -> list[str]:
         return [

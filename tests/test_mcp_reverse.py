@@ -1,0 +1,511 @@
+"""MCP reverse-direction + completeness tests.
+
+Covers the method surface of the reference proxy (handlers.go:326-460):
+resources/templates/list, resources/subscribe|unsubscribe, server→client
+requests (elicitation/create, roots/list, sampling/createMessage) with
+response routing, progress-token round-trips, the GET listening stream
+(session.go streamNotifications), and MCPConfig hot-reload.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+
+import aiohttp
+import pytest
+from aiohttp import web
+
+from aigw_tpu.mcp import MCPBackend, MCPConfig, MCPProxy
+from aigw_tpu.mcp.proxy import (
+    PING_ID_PREFIX,
+    PROGRESS_TOKEN_PREFIX,
+    S2C_ID_PREFIX,
+    _decode_routed,
+    _encode_routed,
+)
+
+from tests.test_mcp import FakeMCPServer, _rpc
+
+
+class ReverseMCPServer(FakeMCPServer):
+    """Fake backend that issues server→client requests and supports the
+    GET listening stream plus resource templates/subscriptions."""
+
+    def __init__(self, name, tools, resources=()):
+        super().__init__(name, tools)
+        self.resources = list(resources)
+        self.responses: list[dict] = []  # client responses routed back
+        self.progress: list[dict] = []
+        self.subscribed: list[str] = []
+        self.get_stream_events: list[dict] = []
+        self.get_stream_open = asyncio.Event()
+        self.get_stream_release = asyncio.Event()
+        self._app.router.add_get("/mcp", self._handle_get)
+
+    async def _handle(self, request):
+        msg = json.loads(await request.read())
+        method = msg.get("method")
+        if "method" not in msg:  # a routed client response
+            self.responses.append(msg)
+            return web.Response(status=202)
+        if method == "notifications/progress":
+            self.progress.append(msg)
+            return web.Response(status=202)
+        if method == "resources/templates/list":
+            return web.json_response(
+                {"jsonrpc": "2.0", "id": msg["id"], "result": {
+                    "resourceTemplates": [
+                        {"name": f"{self.name}-tpl",
+                         "uriTemplate": f"{self.name}://{{path}}"}]}})
+        if method in ("resources/subscribe", "resources/unsubscribe"):
+            uri = (msg.get("params") or {}).get("uri", "")
+            if not any(r == uri for r in self.resources):
+                return web.json_response(
+                    {"jsonrpc": "2.0", "id": msg["id"],
+                     "error": {"code": -32002, "message": "not found"}})
+            self.subscribed.append(f"{method}:{uri}")
+            return web.json_response(
+                {"jsonrpc": "2.0", "id": msg["id"], "result": {}})
+        if method == "tools/call":
+            # stream: elicitation request (with a progress token), then
+            # the tool result
+            params = msg.get("params") or {}
+            self.calls.append((params.get("name", ""), params))
+            resp = web.StreamResponse(
+                status=200,
+                headers={"content-type": "text/event-stream"})
+            await resp.prepare(request)
+            elic = {"jsonrpc": "2.0", "id": "elic-1",
+                    "method": "elicitation/create",
+                    "params": {"message": "ok to proceed?",
+                               "_meta": {"progressToken": "pt-9"}}}
+            await resp.write(
+                f"data: {json.dumps(elic)}\n\n".encode())
+            final = {"jsonrpc": "2.0", "id": msg["id"],
+                     "result": {"content": [{"type": "text",
+                                             "text": "done"}]}}
+            await resp.write(f"data: {json.dumps(final)}\n\n".encode())
+            await resp.write_eof()
+            return resp
+        return await super()._handle(request)
+
+    async def _handle_get(self, request):
+        resp = web.StreamResponse(
+            status=200, headers={"content-type": "text/event-stream"})
+        await resp.prepare(request)
+        self.get_stream_open.set()
+        for ev in self.get_stream_events:
+            await resp.write(f"data: {json.dumps(ev)}\n\n".encode())
+        await self.get_stream_release.wait()
+        await resp.write_eof()
+        return resp
+
+
+async def _serve(proxy: MCPProxy):
+    app = web.Application()
+    proxy.register(app)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}/mcp"
+
+
+async def _init_session(url):
+    _, _, headers = await _rpc(
+        url, "initialize",
+        {"protocolVersion": "2025-06-18", "capabilities": {}})
+    return headers["mcp-session-id"]
+
+
+def test_routed_value_roundtrip():
+    for v in (7, "str-id", 1.5, "with.dots", ""):
+        enc = _encode_routed(S2C_ID_PREFIX, v, "back.end")
+        out = _decode_routed(S2C_ID_PREFIX, enc)
+        assert out == (v, "back.end")
+    assert _decode_routed(S2C_ID_PREFIX, "plain") is None
+    assert _decode_routed(S2C_ID_PREFIX, 12) is None
+    assert _decode_routed(S2C_ID_PREFIX, S2C_ID_PREFIX + "nodot") is None
+
+
+class TestMethodSurface:
+    """Every method the reference routes (handlers.go:326-460) must be
+    handled — none may fall through to 'method not supported'."""
+
+    METHODS = [
+        "ping", "tools/list", "prompts/list", "resources/list",
+        "resources/templates/list", "logging/setLevel",
+    ]
+
+    def test_no_unsupported(self):
+        async def main():
+            s1 = await ReverseMCPServer("alpha", ["t"]).start()
+            cfg = MCPConfig(
+                backends=(MCPBackend(name="alpha", url=s1.url),),
+                session_seed="t")
+            proxy = MCPProxy(cfg)
+            runner, url = await _serve(proxy)
+            try:
+                session = await _init_session(url)
+                for i, m in enumerate(self.METHODS):
+                    status, body, _ = await _rpc(
+                        url, m, {}, session=session, id_=i + 10)
+                    assert status == 200, m
+                    err = (body or {}).get("error") or {}
+                    assert err.get("code") != -32601, m
+                # notifications (no id) → 202
+                async with aiohttp.ClientSession() as s:
+                    for m in ("notifications/initialized",
+                              "notifications/cancelled",
+                              "notifications/roots/list_changed"):
+                        async with s.post(url, json={
+                            "jsonrpc": "2.0", "method": m, "params": {},
+                        }, headers={"mcp-session-id": session}) as r:
+                            assert r.status == 202, m
+            finally:
+                await runner.cleanup()
+                await s1.stop()
+
+        asyncio.run(main())
+
+
+class TestTemplatesAndSubscriptions:
+    def test_templates_aggregated_with_prefix(self):
+        async def main():
+            s1 = await ReverseMCPServer("alpha", ["t"]).start()
+            s2 = await ReverseMCPServer("beta", ["u"]).start()
+            cfg = MCPConfig(
+                backends=(MCPBackend(name="alpha", url=s1.url),
+                          MCPBackend(name="beta", url=s2.url)),
+                session_seed="t")
+            proxy = MCPProxy(cfg)
+            runner, url = await _serve(proxy)
+            try:
+                session = await _init_session(url)
+                _, body, _ = await _rpc(
+                    url, "resources/templates/list", {}, session=session)
+                tpls = body["result"]["resourceTemplates"]
+                names = sorted(t["name"] for t in tpls)
+                assert names == ["alpha__alpha-tpl", "beta__beta-tpl"]
+                # uriTemplate untouched (URIs are never prefixed)
+                assert {t["uriTemplate"] for t in tpls} == {
+                    "alpha://{path}", "beta://{path}"}
+            finally:
+                await runner.cleanup()
+                await s1.stop()
+                await s2.stop()
+
+        asyncio.run(main())
+
+    def test_subscribe_routed_to_owner(self):
+        async def main():
+            s1 = await ReverseMCPServer(
+                "alpha", ["t"], resources=["alpha://doc"]).start()
+            s2 = await ReverseMCPServer(
+                "beta", ["u"], resources=["beta://doc"]).start()
+            cfg = MCPConfig(
+                backends=(MCPBackend(name="alpha", url=s1.url),
+                          MCPBackend(name="beta", url=s2.url)),
+                session_seed="t")
+            proxy = MCPProxy(cfg)
+            runner, url = await _serve(proxy)
+            try:
+                session = await _init_session(url)
+                _, body, _ = await _rpc(
+                    url, "resources/subscribe", {"uri": "beta://doc"},
+                    session=session)
+                assert body["result"] == {}
+                assert s2.subscribed == ["resources/subscribe:beta://doc"]
+                assert s1.subscribed == []
+                _, body, _ = await _rpc(
+                    url, "resources/unsubscribe", {"uri": "beta://doc"},
+                    session=session)
+                assert s2.subscribed[-1] == (
+                    "resources/unsubscribe:beta://doc")
+                # unknown URI → error surfaced
+                _, body, _ = await _rpc(
+                    url, "resources/subscribe", {"uri": "nope://x"},
+                    session=session)
+                assert "error" in body
+            finally:
+                await runner.cleanup()
+                await s1.stop()
+                await s2.stop()
+
+        asyncio.run(main())
+
+
+class TestServerToClient:
+    def test_elicitation_roundtrip_via_tools_call(self):
+        """elicitation/create rides the tools/call stream with a routable
+        id + progress token; the client's response and progress
+        notifications route back to the issuing backend with original
+        values restored."""
+
+        async def main():
+            s1 = await ReverseMCPServer("alpha", ["work"]).start()
+            cfg = MCPConfig(
+                backends=(MCPBackend(name="alpha", url=s1.url),),
+                session_seed="t")
+            proxy = MCPProxy(cfg)
+            runner, url = await _serve(proxy)
+            try:
+                session = await _init_session(url)
+                events = []
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(url, json={
+                        "jsonrpc": "2.0", "id": 7, "method": "tools/call",
+                        "params": {"name": "alpha__work"},
+                    }, headers={"mcp-session-id": session}) as resp:
+                        raw = (await resp.read()).decode()
+                    for block in raw.split("\n\n"):
+                        for line in block.splitlines():
+                            if line.startswith("data: "):
+                                events.append(json.loads(line[6:]))
+                    elic = next(
+                        e for e in events
+                        if e.get("method") == "elicitation/create")
+                    rid = elic["id"]
+                    assert rid.startswith(S2C_ID_PREFIX)
+                    assert rid.endswith(".alpha")
+                    token = elic["params"]["_meta"]["progressToken"]
+                    assert token.startswith(PROGRESS_TOKEN_PREFIX)
+                    # progress notification routes back, token restored
+                    async with s.post(url, json={
+                        "jsonrpc": "2.0",
+                        "method": "notifications/progress",
+                        "params": {"progressToken": token,
+                                   "progress": 0.5},
+                    }, headers={"mcp-session-id": session}) as r:
+                        assert r.status == 202
+                    # the client's response routes back, id restored
+                    async with s.post(url, json={
+                        "jsonrpc": "2.0", "id": rid,
+                        "result": {"action": "accept",
+                                   "content": {"ok": True}},
+                    }, headers={"mcp-session-id": session}) as r:
+                        assert r.status == 202
+                assert s1.progress[0]["params"]["progressToken"] == "pt-9"
+                assert s1.responses[0]["id"] == "elic-1"
+                assert s1.responses[0]["result"]["action"] == "accept"
+            finally:
+                await runner.cleanup()
+                await s1.stop()
+
+        asyncio.run(main())
+
+    def test_bad_reverse_values_rejected(self):
+        async def main():
+            s1 = await ReverseMCPServer("alpha", ["t"]).start()
+            cfg = MCPConfig(
+                backends=(MCPBackend(name="alpha", url=s1.url),),
+                session_seed="t")
+            proxy = MCPProxy(cfg)
+            runner, url = await _serve(proxy)
+            try:
+                session = await _init_session(url)
+                async with aiohttp.ClientSession() as s:
+                    # response without a session → 400
+                    async with s.post(url, json={
+                        "jsonrpc": "2.0", "id": "x", "result": {}}) as r:
+                        assert r.status == 400
+                    # unroutable response id → 400
+                    async with s.post(url, json={
+                        "jsonrpc": "2.0", "id": "rand", "result": {}},
+                        headers={"mcp-session-id": session},
+                    ) as r:
+                        assert r.status == 400
+                    # ping reply swallowed → 202
+                    async with s.post(url, json={
+                        "jsonrpc": "2.0", "id": f"{PING_ID_PREFIX}1",
+                        "result": {}},
+                        headers={"mcp-session-id": session},
+                    ) as r:
+                        assert r.status == 202
+                    # unknown backend in a routed id → 404
+                    bad = _encode_routed(S2C_ID_PREFIX, 1, "ghost")
+                    async with s.post(url, json={
+                        "jsonrpc": "2.0", "id": bad, "result": {}},
+                        headers={"mcp-session-id": session},
+                    ) as r:
+                        assert r.status == 404
+                    # invalid progress token → 400 (reference behavior)
+                    async with s.post(url, json={
+                        "jsonrpc": "2.0",
+                        "method": "notifications/progress",
+                        "params": {"progressToken": "plain",
+                                   "progress": 1}},
+                        headers={"mcp-session-id": session},
+                    ) as r:
+                        assert r.status == 400
+            finally:
+                await runner.cleanup()
+                await s1.stop()
+
+        asyncio.run(main())
+
+
+class TestListeningStream:
+    def test_get_relays_backend_stream(self, monkeypatch):
+        """The GET listening stream fans out to backend GET streams and
+        relays notifications + server→client requests with proxy event
+        ids after an eager heartbeat ping."""
+        monkeypatch.setenv("MCP_PROXY_HEARTBEAT_INTERVAL", "30")
+
+        async def main():
+            s1 = await ReverseMCPServer("alpha", ["t"]).start()
+            s1.get_stream_events = [
+                {"jsonrpc": "2.0",
+                 "method": "notifications/resources/updated",
+                 "params": {"uri": "alpha://doc"}},
+                {"jsonrpc": "2.0", "id": 42, "method": "roots/list",
+                 "params": {}},
+            ]
+            s1.get_stream_release.set()  # close after sending
+            cfg = MCPConfig(
+                backends=(MCPBackend(name="alpha", url=s1.url),),
+                session_seed="t")
+            proxy = MCPProxy(cfg)
+            runner, url = await _serve(proxy)
+            try:
+                session = await _init_session(url)
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(url, headers={
+                        "mcp-session-id": session}) as resp:
+                        assert resp.status == 200
+                        raw = (await resp.read()).decode()
+                msgs = []
+                for block in raw.split("\n\n"):
+                    for line in block.splitlines():
+                        if line.startswith("data: "):
+                            msgs.append(json.loads(line[6:]))
+                assert msgs[0]["method"] == "ping"
+                assert msgs[0]["id"].startswith(PING_ID_PREFIX)
+                updated = next(
+                    m for m in msgs
+                    if m.get("method")
+                    == "notifications/resources/updated")
+                assert updated["params"]["uri"] == "alpha://doc"
+                roots = next(
+                    m for m in msgs if m.get("method") == "roots/list")
+                # routable id so the client's reply can come back
+                decoded = _decode_routed(S2C_ID_PREFIX, roots["id"])
+                assert decoded == (42, "alpha")
+                # relayed events got replayable proxy ids
+                assert "id: 1" in raw and "id: 2" in raw
+            finally:
+                await runner.cleanup()
+                await s1.stop()
+
+        asyncio.run(main())
+
+    def test_tool_change_notification_on_reload(self, monkeypatch):
+        monkeypatch.setenv("MCP_PROXY_HEARTBEAT_INTERVAL", "30")
+
+        async def main():
+            s1 = await ReverseMCPServer("alpha", ["t"]).start()
+            s2 = await ReverseMCPServer("beta", ["u"]).start()
+            cfg = MCPConfig(
+                backends=(MCPBackend(name="alpha", url=s1.url),),
+                session_seed="t")
+            proxy = MCPProxy(cfg)
+            runner, url = await _serve(proxy)
+            try:
+                session = await _init_session(url)
+
+                async def reader():
+                    got = []
+                    async with aiohttp.ClientSession() as s:
+                        async with s.get(url, headers={
+                            "mcp-session-id": session}) as resp:
+                            async for chunk in resp.content.iter_any():
+                                got.append(chunk.decode())
+                                if "tools/list_changed" in "".join(got):
+                                    s1.get_stream_release.set()
+                    return "".join(got)
+
+                task = asyncio.ensure_future(reader())
+                await asyncio.wait_for(
+                    s1.get_stream_open.wait(), timeout=5)
+                await asyncio.sleep(0.1)  # listener registered
+                proxy.update_config(MCPConfig(
+                    backends=(MCPBackend(name="alpha", url=s1.url),
+                              MCPBackend(name="beta", url=s2.url)),
+                    session_seed="t"))
+                raw = await asyncio.wait_for(task, timeout=5)
+                assert "notifications/tools/list_changed" in raw
+                # the old session still works, new sessions see beta
+                _, body, _ = await _rpc(
+                    url, "tools/list", {}, session=session, id_=5)
+                names = {t["name"] for t in body["result"]["tools"]}
+                assert names == {"alpha__t"}
+                session2 = await _init_session(url)
+                _, body, _ = await _rpc(
+                    url, "tools/list", {}, session=session2, id_=6)
+                names = {t["name"] for t in body["result"]["tools"]}
+                assert names == {"alpha__t", "beta__u"}
+            finally:
+                s1.get_stream_release.set()
+                await runner.cleanup()
+                await s1.stop()
+                await s2.stop()
+
+        asyncio.run(main())
+
+
+class TestHotReloadThroughGateway:
+    def test_mcp_config_hot_swap(self):
+        """gateway set_runtime swaps MCP backends without restart."""
+        from aigw_tpu.config.model import Config
+        from aigw_tpu.config.runtime import RuntimeConfig
+
+        async def main():
+            s1 = await ReverseMCPServer("alpha", ["t"]).start()
+            s2 = await ReverseMCPServer("beta", ["u"]).start()
+            base = {
+                "routes": [], "backends": [],
+                "mcp": {"backends": [{"name": "alpha", "url": s1.url}],
+                        "session_seed": "seed-x"},
+            }
+            from aigw_tpu.gateway.server import GatewayServer
+
+            rt = RuntimeConfig.build(Config.parse(base))
+            gw = GatewayServer(rt)
+            runner = web.AppRunner(gw.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            url = f"http://127.0.0.1:{port}/mcp"
+            try:
+                session = await _init_session(url)
+                _, body, _ = await _rpc(
+                    url, "tools/list", {}, session=session)
+                assert {t["name"] for t in body["result"]["tools"]} == {
+                    "alpha__t"}
+                new = dict(base)
+                new["mcp"] = {
+                    "backends": [{"name": "alpha", "url": s1.url},
+                                 {"name": "beta", "url": s2.url}],
+                    "session_seed": "seed-x",
+                }
+                gw.set_runtime(RuntimeConfig.build(Config.parse(new)))
+                # existing session keeps working (same seed)
+                _, body, _ = await _rpc(
+                    url, "tools/list", {}, session=session, id_=2)
+                assert "result" in body
+                # a fresh session sees the new topology
+                session2 = await _init_session(url)
+                _, body, _ = await _rpc(
+                    url, "tools/list", {}, session=session2, id_=3)
+                assert {t["name"] for t in body["result"]["tools"]} == {
+                    "alpha__t", "beta__u"}
+            finally:
+                await runner.cleanup()
+                await s1.stop()
+                await s2.stop()
+
+        asyncio.run(main())

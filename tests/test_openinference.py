@@ -1,0 +1,494 @@
+"""OpenInference span attribute parity + structured access-log tests.
+
+Attribute names/values mirror the reference's
+``internal/tracing/openinference`` test expectations
+(request_attrs_test.go / response_attrs_test.go) for chat and
+embeddings; access-log fields mirror the Envoy dynamic-metadata
+enrichment (internal/extproc/util.go).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import aiohttp
+
+from aigw_tpu.obs import openinference as oi
+from aigw_tpu.obs.accesslog import AccessLogger
+from aigw_tpu.obs.openinference import StreamAccumulator, TraceConfig
+
+
+CFG = TraceConfig()
+
+
+class TestChatRequestAttrs:
+    REQ = {
+        "model": "gpt-4o",
+        "temperature": 0.5,
+        "messages": [
+            {"role": "system", "content": "be helpful"},
+            {"role": "user", "content": [
+                {"type": "text", "text": "what is this?"},
+                {"type": "image_url",
+                 "image_url": {"url": "https://x/img.png"}},
+            ]},
+            {"role": "assistant", "tool_calls": [
+                {"id": "call_1", "type": "function",
+                 "function": {"name": "f", "arguments": "{\"a\":1}"}},
+            ]},
+        ],
+        "tools": [{"type": "function",
+                   "function": {"name": "f", "parameters": {}}}],
+    }
+
+    def test_names_and_values(self):
+        raw = json.dumps(self.REQ)
+        attrs = oi.chat_request_attributes(self.REQ, raw, CFG)
+        assert attrs["openinference.span.kind"] == "LLM"
+        assert attrs["llm.system"] == "openai"
+        assert attrs["llm.model_name"] == "gpt-4o"
+        assert attrs["input.value"] == raw
+        assert attrs["input.mime_type"] == "application/json"
+        inv = json.loads(attrs["llm.invocation_parameters"])
+        assert inv == {"model": "gpt-4o", "temperature": 0.5}
+        assert attrs["llm.input_messages.0.message.role"] == "system"
+        assert attrs["llm.input_messages.0.message.content"] == (
+            "be helpful")
+        assert attrs[
+            "llm.input_messages.1.message.contents.0."
+            "message_content.text"] == "what is this?"
+        assert attrs[
+            "llm.input_messages.1.message.contents.0."
+            "message_content.type"] == "text"
+        assert attrs[
+            "llm.input_messages.1.message.contents.1."
+            "message_content.image.image.url"] == "https://x/img.png"
+        assert attrs[
+            "llm.input_messages.1.message.contents.1."
+            "message_content.type"] == "image"
+        assert attrs[
+            "llm.input_messages.2.message.tool_calls.0."
+            "tool_call.id"] == "call_1"
+        assert attrs[
+            "llm.input_messages.2.message.tool_calls.0."
+            "tool_call.function.name"] == "f"
+        assert attrs[
+            "llm.input_messages.2.message.tool_calls.0."
+            "tool_call.function.arguments"] == "{\"a\":1}"
+        assert json.loads(attrs["llm.tools.0.tool.json_schema"]) == (
+            self.REQ["tools"][0])
+
+    def test_hide_inputs(self):
+        cfg = TraceConfig(hide_inputs=True)
+        attrs = oi.chat_request_attributes(self.REQ, "raw", cfg)
+        assert attrs["input.value"] == "__REDACTED__"
+        assert "input.mime_type" not in attrs
+        assert not any(k.startswith("llm.input_messages") for k in attrs)
+        # invocation params are independent of HideInputs (reference)
+        assert "llm.invocation_parameters" in attrs
+
+    def test_hide_input_text(self):
+        cfg = TraceConfig(hide_input_text=True)
+        attrs = oi.chat_request_attributes(self.REQ, "raw", cfg)
+        assert attrs["llm.input_messages.0.message.content"] == (
+            "__REDACTED__")
+        assert attrs[
+            "llm.input_messages.1.message.contents.0."
+            "message_content.text"] == "__REDACTED__"
+
+    def test_hide_images_and_base64_cap(self):
+        cfg = TraceConfig(hide_input_images=True)
+        attrs = oi.chat_request_attributes(self.REQ, "raw", cfg)
+        assert not any("image" in k for k in attrs)
+        # oversized base64 image dropped entirely
+        big = {"model": "m", "messages": [
+            {"role": "user", "content": [
+                {"type": "image_url",
+                 "image_url": {"url": "data:image/png;base64," +
+                               "A" * 40000}}]}]}
+        attrs = oi.chat_request_attributes(big, "raw", CFG)
+        assert not any("image.image.url" in k for k in attrs)
+
+    def test_env_config(self, monkeypatch):
+        monkeypatch.setenv("OPENINFERENCE_HIDE_INPUTS", "true")
+        monkeypatch.setenv(
+            "OPENINFERENCE_BASE64_IMAGE_MAX_LENGTH", "100")
+        cfg = TraceConfig.from_env()
+        assert cfg.hide_inputs and cfg.base64_image_max_length == 100
+
+
+class TestChatResponseAttrs:
+    RESP = {
+        "model": "gpt-4o-2024",
+        "choices": [
+            {"index": 0,
+             "message": {"role": "assistant", "content": "hi there",
+                         "tool_calls": [
+                             {"id": "call_9", "type": "function",
+                              "function": {"name": "g",
+                                           "arguments": "{}"}}]},
+             "finish_reason": "stop"},
+        ],
+        "usage": {
+            "prompt_tokens": 11, "completion_tokens": 3,
+            "total_tokens": 14,
+            "prompt_tokens_details": {"cached_tokens": 7,
+                                      "audio_tokens": 2},
+            "completion_tokens_details": {"reasoning_tokens": 1},
+        },
+    }
+
+    def test_names_and_values(self):
+        attrs = oi.chat_response_attributes(self.RESP, CFG)
+        assert attrs["llm.model_name"] == "gpt-4o-2024"
+        assert json.loads(attrs["output.value"]) == self.RESP
+        assert attrs["output.mime_type"] == "application/json"
+        assert attrs["llm.output_messages.0.message.role"] == "assistant"
+        assert attrs["llm.output_messages.0.message.content"] == (
+            "hi there")
+        assert attrs[
+            "llm.output_messages.0.message.tool_calls.0."
+            "tool_call.id"] == "call_9"
+        assert attrs["llm.token_count.prompt"] == 11
+        assert attrs["llm.token_count.completion"] == 3
+        assert attrs["llm.token_count.total"] == 14
+        assert attrs[
+            "llm.token_count.prompt_details.cache_read"] == 7
+        assert attrs["llm.token_count.prompt_details.audio"] == 2
+        assert attrs[
+            "llm.token_count.completion_details.reasoning"] == 1
+
+    def test_hide_outputs(self):
+        attrs = oi.chat_response_attributes(
+            self.RESP, TraceConfig(hide_outputs=True))
+        assert attrs["output.value"] == "__REDACTED__"
+        assert not any(
+            k.startswith("llm.output_messages") for k in attrs)
+        # token counts are not sensitive
+        assert attrs["llm.token_count.total"] == 14
+
+
+class TestAnthropicResponseAttrs:
+    def test_messages_response(self):
+        resp = {
+            "model": "claude-3-7", "role": "assistant",
+            "content": [
+                {"type": "text", "text": "hello "},
+                {"type": "text", "text": "world"},
+                {"type": "tool_use", "id": "tu_1", "name": "f",
+                 "input": {"x": 2}},
+            ],
+            "usage": {"input_tokens": 9, "output_tokens": 4,
+                      "cache_read_input_tokens": 5},
+        }
+        attrs = oi.anthropic_response_attributes(resp, CFG)
+        assert attrs["llm.model_name"] == "claude-3-7"
+        assert attrs["llm.output_messages.0.message.content"] == (
+            "hello world")
+        assert attrs[
+            "llm.output_messages.0.message.tool_calls.0."
+            "tool_call.function.name"] == "f"
+        assert json.loads(attrs[
+            "llm.output_messages.0.message.tool_calls.0."
+            "tool_call.function.arguments"]) == {"x": 2}
+        assert attrs["llm.token_count.prompt"] == 9
+        assert attrs["llm.token_count.completion"] == 4
+        assert attrs["llm.token_count.prompt_details.cache_read"] == 5
+
+
+class TestEmbeddingsAttrs:
+    def test_request(self):
+        req = {"model": "text-embedding-3", "input": ["a", "b"],
+               "dimensions": 64}
+        raw = json.dumps(req)
+        attrs = oi.embeddings_request_attributes(req, raw, CFG)
+        assert attrs["openinference.span.kind"] == "EMBEDDING"
+        assert attrs["embedding.model_name"] == "text-embedding-3"
+        inv = json.loads(attrs["embedding.invocation_parameters"])
+        assert "input" not in inv and inv["dimensions"] == 64
+        assert attrs["embedding.embeddings.0.embedding.text"] == "a"
+        assert attrs["embedding.embeddings.1.embedding.text"] == "b"
+
+    def test_response(self):
+        resp = {"model": "text-embedding-3",
+                "data": [{"embedding": [0.1, 0.2]}],
+                "usage": {"prompt_tokens": 4, "total_tokens": 4}}
+        attrs = oi.embeddings_response_attributes(resp, CFG)
+        assert attrs["embedding.embeddings.0.embedding.vector"] == (
+            [0.1, 0.2])
+        assert attrs["llm.token_count.prompt"] == 4
+        hidden = oi.embeddings_response_attributes(
+            resp, TraceConfig(hide_embeddings_vectors=True))
+        assert not any("vector" in k for k in hidden)
+
+
+class TestCompletionAttrs:
+    def test_request_response(self):
+        req = {"model": "m", "prompt": ["p1", "p2"], "max_tokens": 4}
+        attrs = oi.completion_request_attributes(
+            req, json.dumps(req), CFG)
+        assert attrs["llm.prompts.0.prompt.text"] == "p1"
+        assert attrs["llm.prompts.1.prompt.text"] == "p2"
+        assert "prompt" not in json.loads(
+            attrs["llm.invocation_parameters"])
+        resp = {"model": "m", "choices": [{"index": 0, "text": "out"}],
+                "usage": {"prompt_tokens": 2, "completion_tokens": 1,
+                          "total_tokens": 3}}
+        rattrs = oi.completion_response_attributes(resp, CFG)
+        assert rattrs["llm.choices.0.completion.text"] == "out"
+        assert rattrs["llm.token_count.total"] == 3
+
+
+class TestErrorTypes:
+    def test_mapping(self):
+        assert oi.error_type_for_status(400) == "BadRequestError"
+        assert oi.error_type_for_status(401) == "AuthenticationError"
+        assert oi.error_type_for_status(403) == "PermissionDeniedError"
+        assert oi.error_type_for_status(404) == "NotFoundError"
+        assert oi.error_type_for_status(429) == "RateLimitError"
+        assert oi.error_type_for_status(503) == "InternalServerError"
+        assert oi.error_type_for_status(418) == "Error"
+
+
+class TestStreamAccumulator:
+    def test_openai_chunks(self):
+        acc = StreamAccumulator()
+        chunks = [
+            {"model": "m-v2", "choices": [
+                {"index": 0, "delta": {"role": "assistant",
+                                       "content": "he"}}]},
+            {"choices": [{"index": 0, "delta": {"content": "llo"}}]},
+            {"choices": [{"index": 0, "delta": {"tool_calls": [
+                {"index": 0, "id": "c1",
+                 "function": {"name": "f", "arguments": "{\"a\""}}]}}]},
+            {"choices": [{"index": 0, "delta": {"tool_calls": [
+                {"index": 0, "function": {"arguments": ":1}"}}]},
+                "finish_reason": "tool_calls"}]},
+            {"usage": {"prompt_tokens": 3, "completion_tokens": 2,
+                       "total_tokens": 5}},
+        ]
+        for c in chunks:
+            acc.feed(f"data: {json.dumps(c)}\n\n".encode())
+        acc.feed(b"data: [DONE]\n\n")
+        resp = acc.response()
+        assert resp["model"] == "m-v2"
+        msg = resp["choices"][0]["message"]
+        assert msg["content"] == "hello"
+        assert msg["tool_calls"][0]["id"] == "c1"
+        assert msg["tool_calls"][0]["function"]["arguments"] == (
+            "{\"a\":1}")
+        assert resp["usage"]["total_tokens"] == 5
+        attrs = oi.chat_response_attributes(resp, CFG)
+        assert attrs["llm.output_messages.0.message.content"] == "hello"
+
+    def test_anthropic_events(self):
+        acc = StreamAccumulator()
+        events = [
+            {"type": "message_start", "message": {
+                "model": "claude-x", "role": "assistant",
+                "usage": {"input_tokens": 7}}},
+            {"type": "content_block_start", "index": 0,
+             "content_block": {"type": "text", "text": ""}},
+            {"type": "content_block_delta", "index": 0,
+             "delta": {"type": "text_delta", "text": "hey"}},
+            {"type": "content_block_start", "index": 1,
+             "content_block": {"type": "tool_use", "id": "tu1",
+                               "name": "f"}},
+            {"type": "content_block_delta", "index": 1,
+             "delta": {"type": "input_json_delta",
+                       "partial_json": "{\"k\":2}"}},
+            {"type": "message_delta", "delta": {"stop_reason": "end"},
+             "usage": {"output_tokens": 9}},
+        ]
+        for e in events:
+            acc.feed(f"event: {e['type']}\n"
+                     f"data: {json.dumps(e)}\n\n".encode())
+        resp = acc.response()
+        assert resp["model"] == "claude-x"
+        assert resp["content"][0]["text"] == "hey"
+        assert resp["content"][1]["input"] == {"k": 2}
+        attrs = oi.anthropic_response_attributes(resp, CFG)
+        assert attrs["llm.output_messages.0.message.content"] == "hey"
+        assert attrs["llm.token_count.prompt"] == 7
+        assert attrs["llm.token_count.completion"] == 9
+
+
+class TestGatewayIntegration:
+    def _config(self, up_url):
+        from aigw_tpu.config.model import Config
+
+        return Config.parse({
+            "version": "v1",
+            "backends": [{"name": "a", "schema": "OpenAI",
+                          "url": up_url}],
+            "routes": [{"name": "r", "rules": [
+                {"models": ["m1"], "backends": ["a"]}]}],
+            "llm_request_costs": [
+                {"metadata_key": "total", "type": "TotalToken"}],
+        })
+
+    def test_span_openinference_attrs_unary(self, capsys):
+        from aigw_tpu.config.runtime import RuntimeConfig
+        from aigw_tpu.gateway.server import run_gateway
+        from aigw_tpu.obs.tracing import Tracer
+        from tests.fakes import FakeUpstream, openai_chat_response
+
+        async def main():
+            up = FakeUpstream().on_json(
+                "/v1/chat/completions", openai_chat_response())
+            await up.start()
+            server, runner = await run_gateway(
+                RuntimeConfig.build(self._config(up.url)), port=0,
+                tracer=Tracer(exporter="console"))
+            site = list(runner.sites)[0]
+            port = site._server.sockets[0].getsockname()[1]
+            try:
+                async with aiohttp.ClientSession() as s:
+                    await s.post(
+                        f"http://127.0.0.1:{port}/v1/chat/completions",
+                        json={"model": "m1", "messages": [
+                            {"role": "user", "content": "hi"}]})
+            finally:
+                await runner.cleanup()
+                await up.stop()
+
+        asyncio.run(main())
+        err = capsys.readouterr().err
+        span = json.loads(err.strip().splitlines()[-1])
+        attrs = span["attributes"]
+        assert attrs["openinference.span.kind"] == "LLM"
+        assert attrs["llm.system"] == "openai"
+        assert attrs["llm.model_name"] == "fake-model"  # response model
+        assert attrs["llm.input_messages.0.message.role"] == "user"
+        assert attrs["llm.output_messages.0.message.content"] == "hello"
+        assert attrs["llm.token_count.prompt"] == 5
+        assert attrs["llm.token_count.completion"] == 7
+        assert json.loads(attrs["llm.invocation_parameters"]) == {
+            "model": "m1"}
+
+    def test_span_openinference_attrs_streaming(self, capsys):
+        from aigw_tpu.config.runtime import RuntimeConfig
+        from aigw_tpu.gateway.server import run_gateway
+        from aigw_tpu.obs.tracing import Tracer
+        from tests.fakes import FakeUpstream, openai_stream_events
+
+        async def main():
+            up = FakeUpstream().on_sse(
+                "/v1/chat/completions",
+                openai_stream_events(["str", "eamed"]))
+            await up.start()
+            server, runner = await run_gateway(
+                RuntimeConfig.build(self._config(up.url)), port=0,
+                tracer=Tracer(exporter="console"))
+            site = list(runner.sites)[0]
+            port = site._server.sockets[0].getsockname()[1]
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        f"http://127.0.0.1:{port}/v1/chat/completions",
+                        json={"model": "m1", "stream": True,
+                              "messages": [
+                                  {"role": "user", "content": "hi"}]},
+                    ) as resp:
+                        await resp.read()
+            finally:
+                await runner.cleanup()
+                await up.stop()
+
+        asyncio.run(main())
+        err = capsys.readouterr().err
+        span = json.loads(err.strip().splitlines()[-1])
+        attrs = span["attributes"]
+        assert attrs["llm.output_messages.0.message.content"] == (
+            "streamed")
+        assert attrs["llm.output_messages.0.message.role"] == "assistant"
+
+    def test_access_log_line(self, tmp_path, monkeypatch):
+        from aigw_tpu.config.runtime import RuntimeConfig
+        from aigw_tpu.gateway.server import run_gateway
+        from tests.fakes import FakeUpstream, openai_chat_response
+
+        log_path = tmp_path / "access.jsonl"
+        monkeypatch.setenv("AIGW_ACCESS_LOG", str(log_path))
+
+        async def main():
+            up = FakeUpstream().on_json(
+                "/v1/chat/completions", openai_chat_response())
+            await up.start()
+            server, runner = await run_gateway(
+                RuntimeConfig.build(self._config(up.url)), port=0)
+            site = list(runner.sites)[0]
+            port = site._server.sockets[0].getsockname()[1]
+            try:
+                async with aiohttp.ClientSession() as s:
+                    await s.post(
+                        f"http://127.0.0.1:{port}/v1/chat/completions",
+                        json={"model": "m1", "messages": [
+                            {"role": "user", "content": "hi"}]},
+                        headers={"x-request-id": "req-77"})
+            finally:
+                await runner.cleanup()
+                await up.stop()
+
+        asyncio.run(main())
+        lines = log_path.read_text().strip().splitlines()
+        assert len(lines) == 1
+        entry = json.loads(lines[0])
+        assert entry["path"] == "/v1/chat/completions"
+        assert entry["status"] == 200
+        assert entry["route"] == "r"
+        assert entry["backend"] == "a"
+        assert entry["model"] == "m1"
+        assert entry["response_model"] == "fake-model"
+        assert entry["usage"] == {"input": 5, "output": 7, "total": 12}
+        assert entry["costs"] == {"total": 12}
+        assert entry["request_id"] == "req-77"
+        assert entry["duration_ms"] >= 0
+
+    def test_access_log_error_typed(self, tmp_path, monkeypatch):
+        from aigw_tpu.config.runtime import RuntimeConfig
+        from aigw_tpu.gateway.server import run_gateway
+        from tests.fakes import FakeUpstream
+
+        log_path = tmp_path / "access.jsonl"
+        monkeypatch.setenv("AIGW_ACCESS_LOG", str(log_path))
+
+        async def main():
+            up = FakeUpstream().on_json(
+                "/v1/chat/completions", {"error": {"message": "nope"}},
+                status=401)
+            await up.start()
+            server, runner = await run_gateway(
+                RuntimeConfig.build(self._config(up.url)), port=0)
+            site = list(runner.sites)[0]
+            port = site._server.sockets[0].getsockname()[1]
+            try:
+                async with aiohttp.ClientSession() as s:
+                    await s.post(
+                        f"http://127.0.0.1:{port}/v1/chat/completions",
+                        json={"model": "m1", "messages": [
+                            {"role": "user", "content": "hi"}]})
+            finally:
+                await runner.cleanup()
+                await up.stop()
+
+        asyncio.run(main())
+        entry = json.loads(log_path.read_text().strip().splitlines()[-1])
+        assert entry["status"] == 401
+        assert entry["error"] == "AuthenticationError"
+
+
+class TestAccessLoggerUnit:
+    def test_disabled_by_default(self, monkeypatch):
+        monkeypatch.delenv("AIGW_ACCESS_LOG", raising=False)
+        assert not AccessLogger().enabled
+        assert not AccessLogger("off").enabled
+
+    def test_minimal_fields_omitted(self, tmp_path):
+        p = tmp_path / "a.log"
+        al = AccessLogger(str(p))
+        al.log(method="POST", path="/x", status=200, duration_ms=1.0)
+        entry = json.loads(p.read_text())
+        assert "usage" not in entry and "costs" not in entry
+        assert "error" not in entry and "attempts" not in entry
