@@ -29,6 +29,11 @@ def main(argv: list[str] | None = None) -> int:
     p_run.add_argument("--port", type=int, default=1975)
     p_run.add_argument("--watch-interval", type=float, default=5.0)
     p_run.add_argument("--log-level", default="info")
+    p_run.add_argument(
+        "--workers", type=int, default=1,
+        help="worker processes sharing the port via SO_REUSEPORT "
+             "(each runs the full data plane and watches the config; "
+             "requires an explicit --port)")
 
     p_val = sub.add_parser("validate", help="validate a config file")
     p_val.add_argument("config")
@@ -198,6 +203,8 @@ def main(argv: list[str] | None = None) -> int:
         from aigw_tpu.config.model import ConfigError
 
         try:
+            if getattr(args, "workers", 1) > 1:
+                return _run_gateway_workers(args)
             return asyncio.run(_run_gateway(args))
         except ConfigError as e:
             print(f"config error: {e}", file=sys.stderr)
@@ -211,7 +218,52 @@ def main(argv: list[str] | None = None) -> int:
     return 2
 
 
-async def _run_gateway(args: argparse.Namespace) -> int:
+def _run_gateway_workers(args: argparse.Namespace) -> int:
+    """Multi-worker mode: N processes share the port via SO_REUSEPORT,
+    the kernel spreading accepted connections across them — the
+    horizontal-scaling answer to the reference's multi-threaded Envoy
+    core (CPython's GIL caps one process at one core). Each worker runs
+    the complete data plane, including its own config watcher, so hot
+    reloads converge within --watch-interval on every worker; state that
+    was already replica-safe across gateway pods (encrypted MCP
+    sessions, quota windows, circuit breakers) is equally worker-local
+    here."""
+    import multiprocessing
+    import os
+    import secrets
+
+    if args.port == 0:
+        print("--workers requires an explicit --port (SO_REUSEPORT "
+              "workers must bind the same port)", file=sys.stderr)
+        return 1
+    # MCP session tokens are encrypted with mcp.session_seed; when it's
+    # unconfigured each process would otherwise mint its own random seed
+    # and tokens issued by one worker would 404 on the others. One
+    # process-group seed (inherited through the spawn env) keeps
+    # sessions valid on every worker.
+    os.environ.setdefault("AIGW_MCP_SESSION_SEED", secrets.token_hex(32))
+    ctx = multiprocessing.get_context("spawn")
+    procs = [
+        ctx.Process(target=_gateway_worker_main, args=(args,), daemon=True)
+        for _ in range(args.workers - 1)
+    ]
+    for p in procs:
+        p.start()
+    try:
+        return asyncio.run(_run_gateway(args, reuse_port=True))
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.join(timeout=5)
+
+
+def _gateway_worker_main(args: argparse.Namespace) -> None:
+    asyncio.run(_run_gateway(args, reuse_port=True))
+
+
+async def _run_gateway(args: argparse.Namespace,
+                       reuse_port: bool = False) -> int:
     from aigw_tpu.config.runtime import RuntimeConfig
     from aigw_tpu.config.watcher import ConfigWatcher
     from aigw_tpu.gateway.server import run_gateway
@@ -235,7 +287,9 @@ async def _run_gateway(args: argparse.Namespace) -> int:
         print(f"autoconfig: {len(cfg.backends)} backend(s): "
               f"{', '.join(b.name for b in cfg.backends)}", flush=True)
         runtime = RuntimeConfig.build(cfg)
-    server, runner = await run_gateway(runtime, host=args.host, port=args.port)
+    server, runner = await run_gateway(runtime, host=args.host,
+                                       port=args.port,
+                                       reuse_port=reuse_port)
     holder["server"] = server
     if watcher is not None:
         await watcher.start()

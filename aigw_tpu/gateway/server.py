@@ -546,30 +546,30 @@ class GatewayServer:
                          exc_info=True)
         return {}
 
-    def _openinference_response_attrs(
-        self, span, endpoint: Endpoint, front_schema: APISchemaName,
-        payload: bytes,
-    ) -> None:
+    def _oi_response_builder(self, endpoint: Endpoint):
+        """One endpoint→builder dispatch for both the unary and
+        streaming span-attribute paths (endpoint MESSAGES ⇔ the
+        Anthropic front)."""
         from aigw_tpu.obs import openinference as oi
 
+        return {
+            Endpoint.CHAT_COMPLETIONS: oi.chat_response_attributes,
+            Endpoint.MESSAGES: oi.anthropic_response_attributes,
+            Endpoint.EMBEDDINGS: oi.embeddings_response_attributes,
+            Endpoint.COMPLETIONS: oi.completion_response_attributes,
+        }.get(endpoint)
+
+    def _openinference_response_attrs(
+        self, span, endpoint: Endpoint, payload: bytes,
+    ) -> None:
+        builder = self._oi_response_builder(endpoint)
+        if builder is None:
+            return
         try:
             resp = json.loads(payload)
             if not isinstance(resp, dict):
                 return
-            if endpoint is Endpoint.CHAT_COMPLETIONS:
-                attrs = oi.chat_response_attributes(resp, self._oi_config)
-            elif endpoint is Endpoint.MESSAGES:
-                attrs = oi.anthropic_response_attributes(
-                    resp, self._oi_config)
-            elif endpoint is Endpoint.EMBEDDINGS:
-                attrs = oi.embeddings_response_attributes(
-                    resp, self._oi_config)
-            elif endpoint is Endpoint.COMPLETIONS:
-                attrs = oi.completion_response_attributes(
-                    resp, self._oi_config)
-            else:
-                return
-            span.attributes.update(attrs)
+            span.attributes.update(builder(resp, self._oi_config))
         except Exception:  # noqa: BLE001 — telemetry must never 500
             logger.debug("openinference response attrs failed",
                          exc_info=True)
@@ -801,7 +801,7 @@ class GatewayServer:
             req_metrics.response_model = rx.model
             if span is not None:
                 self._openinference_response_attrs(
-                    span, endpoint, front_schema, rx.body or raw)
+                    span, endpoint, rx.body or raw)
             req_metrics.finish(usage)
             self._sink_costs(usage, req_metrics, route_name, client_headers)
             self.metrics.requests_total.labels(
@@ -891,22 +891,11 @@ class GatewayServer:
         req_metrics.response_model = model
         if acc is not None:
             final = acc.response()
-            if final is not None:
-                from aigw_tpu.obs import openinference as oi
-
+            builder = self._oi_response_builder(endpoint)
+            if final is not None and builder is not None:
                 try:
-                    if front_schema is APISchemaName.ANTHROPIC:
-                        span.attributes.update(
-                            oi.anthropic_response_attributes(
-                                final, self._oi_config))
-                    elif endpoint is Endpoint.COMPLETIONS:
-                        span.attributes.update(
-                            oi.completion_response_attributes(
-                                final, self._oi_config))
-                    else:
-                        span.attributes.update(
-                            oi.chat_response_attributes(
-                                final, self._oi_config))
+                    span.attributes.update(
+                        builder(final, self._oi_config))
                 except Exception:  # noqa: BLE001
                     logger.debug("stream span attrs failed", exc_info=True)
         req_metrics.finish(usage)
@@ -1012,13 +1001,23 @@ async def run_gateway(
     runtime: RuntimeConfig,
     host: str = "127.0.0.1",
     port: int = 1975,
+    reuse_port: bool = False,
     **kwargs: Any,
 ) -> tuple[GatewayServer, web.AppRunner]:
-    """Start the gateway; returns (server, runner). Caller owns shutdown."""
+    """Start the gateway; returns (server, runner). Caller owns shutdown.
+
+    ``reuse_port=True`` binds with SO_REUSEPORT so multiple worker
+    processes share one listening port, the kernel load-balancing
+    accepted connections across them (the multi-worker mode — Envoy's
+    role in the reference is a multi-threaded C++ proxy; CPython's GIL
+    means horizontal processes, not threads)."""
     server = GatewayServer(runtime, **kwargs)
-    runner = web.AppRunner(server.app)
+    # aiohttp's per-request INFO access log is pure hot-path overhead
+    # (~4x rps at high concurrency); structured access logging is our
+    # own AIGW_ACCESS_LOG pipeline (obs/accesslog.py)
+    runner = web.AppRunner(server.app, access_log=None)
     await runner.setup()
-    site = web.TCPSite(runner, host, port)
+    site = web.TCPSite(runner, host, port, reuse_port=reuse_port or None)
     await site.start()
     logger.info("gateway listening on %s:%d", host, port)
     return server, runner

@@ -185,6 +185,11 @@ class MCPProxy:
         self.cfg = cfg
         seed = cfg.session_seed
         if not seed:
+            # AIGW_MCP_SESSION_SEED: process-group seed set by the
+            # multi-worker launcher so SO_REUSEPORT workers can decrypt
+            # each other's session tokens
+            seed = os.environ.get("AIGW_MCP_SESSION_SEED", "")
+        if not seed:
             seed = secrets.token_hex(32)
             if cfg.backends:
                 logger.warning(

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 from dataclasses import dataclass
 from functools import partial
 from typing import Any
@@ -176,9 +177,32 @@ def _attention(
     return out.reshape(B, S, H * D)
 
 
+def _matmul(p: dict[str, jax.Array], key: str, x: jax.Array) -> jax.Array:
+    """``x @ weight`` with the W8A16 Pallas fast path.
+
+    For quantized weights at decode shapes (small M, aligned K/N) the
+    fused kernel streams int8 and applies the scale to the accumulator
+    (ops/pallas/qmatmul.py); other shapes — prefill, unaligned, or
+    AIGW_PALLAS_QMATMUL=off — fall back to dequant-then-matmul via
+    ``_w`` (XLA fuses the dequant as the matmul's producer)."""
+    q = p.get(key + ".q")
+    if q is None or os.environ.get(
+            "AIGW_PALLAS_QMATMUL", "on").lower() in ("0", "false", "off"):
+        return x @ _w(p, key)
+    from aigw_tpu.ops.pallas import qmatmul
+
+    lead, k = x.shape[:-1], x.shape[-1]
+    m = math.prod(lead)
+    n = q.shape[-1]
+    if not qmatmul.supported(m, k, n):
+        return x @ _w(p, key)
+    y = qmatmul.w8a16_matmul(x.reshape(m, k), q, p[key + ".scale"])
+    return y.reshape(*lead, n)
+
+
 def _wo_project(p, i, attn, lora=None, adapter_idx=None):
     """Attention out-projection with optional per-slot LoRA delta."""
-    out = attn @ _w(p, f"l{i}.wo")
+    out = _matmul(p, f"l{i}.wo", attn)
     d = lora_delta(lora, f"l{i}.wo", attn, adapter_idx)
     return out if d is None else out + d
 
@@ -186,9 +210,9 @@ def _wo_project(p, i, attn, lora=None, adapter_idx=None):
 def _project_qkv(p, i, x, positions, cfg, lora=None, adapter_idx=None):
     hd = cfg.head_dim
     B, S, _ = x.shape
-    q = x @ _w(p, f"l{i}.wq")
-    k = x @ _w(p, f"l{i}.wk")
-    v = x @ _w(p, f"l{i}.wv")
+    q = _matmul(p, f"l{i}.wq", x)
+    k = _matmul(p, f"l{i}.wk", x)
+    v = _matmul(p, f"l{i}.wv", x)
     for name, ref in (("wq", "q"), ("wk", "k"), ("wv", "v")):
         d = lora_delta(lora, f"l{i}.{name}", x, adapter_idx)
         if d is not None:
@@ -213,15 +237,17 @@ def _mlp(p, i, x, lora=None, adapter_idx=None):
         d = lora_delta(lora, f"l{i}.{name}", inp, adapter_idx)
         return y if d is None else y + d
 
-    gate = jax.nn.silu(with_delta(x @ _w(p, f"l{i}.w_gate"), "w_gate", x))
-    up = with_delta(x @ _w(p, f"l{i}.w_up"), "w_up", x)
+    gate = jax.nn.silu(with_delta(_matmul(p, f"l{i}.w_gate", x),
+                                  "w_gate", x))
+    up = with_delta(_matmul(p, f"l{i}.w_up", x), "w_up", x)
     h = gate * up
-    return with_delta(h @ _w(p, f"l{i}.w_down"), "w_down", h)
+    return with_delta(_matmul(p, f"l{i}.w_down", h), "w_down", h)
 
 
 def _logits(p: dict[str, jax.Array], cfg: LlamaConfig, x: jax.Array) -> jax.Array:
-    head = _w(p, "embed").T if cfg.tie_embeddings else _w(p, "lm_head")
-    return (x @ head).astype(jnp.float32)
+    if cfg.tie_embeddings:
+        return (x @ _w(p, "embed").T).astype(jnp.float32)
+    return _matmul(p, "lm_head", x).astype(jnp.float32)
 
 
 def prefill(

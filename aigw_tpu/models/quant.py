@@ -14,6 +14,8 @@ resolves either representation through ``models.llama._w``.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 
@@ -21,8 +23,14 @@ import jax.numpy as jnp
 _MATRIX_KINDS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
 
+@partial(jax.jit, static_argnames=("axis",))
 def _quantize_matrix(w: jax.Array, axis: int) -> tuple[jax.Array, jax.Array]:
-    """Symmetric int8 along ``axis`` (the preserved/output axis)."""
+    """Symmetric int8 along ``axis`` (the preserved/output axis).
+
+    Jitted so the f32 upcast fuses into the reduction and the rounding —
+    eager dispatch would materialize a full f32 copy (2GB for an 8B
+    embedding), which busts HBM when quantizing a 16GB bf16 model in
+    place on a 16GB chip."""
     wf = w.astype(jnp.float32)
     reduce_axes = tuple(i for i in range(w.ndim) if i != axis)
     amax = jnp.max(jnp.abs(wf), axis=reduce_axes, keepdims=True)
@@ -31,10 +39,19 @@ def _quantize_matrix(w: jax.Array, axis: int) -> tuple[jax.Array, jax.Array]:
     return q, scale.astype(jnp.float32)
 
 
-def quantize_params(params: dict[str, jax.Array]) -> dict[str, jax.Array]:
-    """bf16 param dict → W8A16 dict (un-quantized leaves pass through)."""
+def quantize_params(
+    params: dict[str, jax.Array], consume: bool = False
+) -> dict[str, jax.Array]:
+    """bf16 param dict → W8A16 dict (un-quantized leaves pass through).
+
+    ``consume=True`` removes each bf16 tensor from ``params`` as soon as
+    its int8 replacement is materialized, bounding peak HBM to
+    bf16-model + one tensor instead of bf16 + int8 copies — required to
+    quantize an 8B bf16 model in place on a 16GB chip.
+    """
     out: dict[str, jax.Array] = {}
-    for name, w in params.items():
+    for name in list(params):
+        w = params.pop(name) if consume else params[name]
         kind = name.rsplit(".", 1)[-1]
         if kind in _MATRIX_KINDS and w.ndim >= 2:
             # output channels = last axis for [in, out] (and [E, in, out])

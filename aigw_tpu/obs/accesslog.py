@@ -17,7 +17,9 @@ from __future__ import annotations
 import json
 import logging
 import os
+import queue
 import sys
+import threading
 import time
 from typing import Any, IO
 
@@ -25,11 +27,19 @@ logger = logging.getLogger(__name__)
 
 
 class AccessLogger:
+    """Lines are handed to a daemon writer thread — a synchronous
+    write+flush per request on the event loop would be exactly the
+    hot-path tax that dropping aiohttp's access log removed. The queue
+    is bounded; overflow drops lines rather than stalling requests."""
+
+    _QUEUE_MAX = 8192
+
     def __init__(self, target: str | None = None):
         if target is None:
             target = os.environ.get("AIGW_ACCESS_LOG", "")
         self._target = (target or "").strip()
         self._fp: IO[str] | None = None
+        self._q: "queue.Queue[str]" = queue.Queue(maxsize=self._QUEUE_MAX)
         if not self._target or self._target.lower() == "off":
             return
         if self._target == "stdout":
@@ -42,6 +52,36 @@ class AccessLogger:
             except OSError as e:
                 logger.warning("access log %s unavailable: %s",
                                self._target, e)
+        if self._fp is not None:
+            threading.Thread(target=self._writer, name="access-log",
+                             daemon=True).start()
+
+    def _writer(self) -> None:
+        while True:
+            lines = [self._q.get()]
+            # batch whatever else is queued before flushing once
+            try:
+                while True:
+                    lines.append(self._q.get_nowait())
+            except queue.Empty:
+                pass
+            try:
+                for line in lines:
+                    self._fp.write(line)
+                self._fp.flush()
+            except (OSError, ValueError):
+                pass  # telemetry must never crash the data plane
+            finally:
+                for _ in lines:
+                    self._q.task_done()
+
+    def drain(self, timeout: float = 5.0) -> None:
+        """Block until queued lines are written (tests, shutdown)."""
+        if self._fp is None:
+            return
+        deadline = time.monotonic() + timeout
+        while self._q.unfinished_tasks and time.monotonic() < deadline:
+            time.sleep(0.01)
 
     @property
     def enabled(self) -> bool:
@@ -107,7 +147,6 @@ class AccessLogger:
         if attempts > 1:
             entry["attempts"] = attempts
         try:
-            self._fp.write(json.dumps(entry) + "\n")
-            self._fp.flush()
-        except (OSError, ValueError):
-            pass  # telemetry must never crash the data plane
+            self._q.put_nowait(json.dumps(entry) + "\n")
+        except queue.Full:
+            pass  # drop rather than block the data plane

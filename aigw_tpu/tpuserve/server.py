@@ -115,7 +115,7 @@ class TPUServeServer:
         if quantize == "int8":
             from aigw_tpu.models.quant import quantize_params
 
-            params = quantize_params(params)
+            params = quantize_params(params, consume=True)
             logger.info("weights quantized to int8 (W8A16)")
         lora_params = None
         adapter_names: tuple[str, ...] = ()

@@ -1,30 +1,37 @@
 """Round benchmark — prints ONE JSON line.
 
-Measures the BASELINE.json north-star ratio on the real chip: continuous-
-batching engine decode throughput vs the raw JAX decode-loop ceiling for
-the same model/batch (the "≥90% of raw JAX tokens/sec" criterion), on a
-~1.1B-parameter Llama-architecture model (random weights — throughput is
-weight-agnostic) that fits a single v5e chip in bf16.
+Headline: the BASELINE.json north star measured on the real chip —
+continuous-batching engine decode throughput for **Llama-3-8B
+architecture, W8A16 int8, batch 8, paged KV** (random weights:
+throughput is weight-value-agnostic), plus TTFT. ``vs_baseline`` is the
+engine / raw-JAX-decode-ceiling ratio for the same model — the "≥90% of
+raw JAX tokens/sec" criterion. The raw ceiling is the best raw loop we
+can write: a K-step ``lax.scan`` inside one jit (single-step dispatch
+pays ~8ms/step of tunnel latency and would flatter the engine).
+
+Falls back to a 1.1B bf16 llama-arch model when the 8B int8 model
+doesn't fit the chip, and prints an honest zero when the TPU tunnel is
+unresponsive (watchdog probe).
 
     {"metric": "...", "value": engine_tokens_per_sec, "unit": "tokens/s",
-     "vs_baseline": engine/raw_jax}
+     "vs_baseline": engine/raw_ceiling, "ttft_ms_p50": ...}
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import threading
 import time
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from aigw_tpu.models import llama
 from aigw_tpu.tpuserve.engine import Engine, EngineConfig, GenRequest
 from aigw_tpu.tpuserve.sampling import SamplingParams, sample
 
-BENCH_CFG = llama.LlamaConfig(
+FALLBACK_CFG = llama.LlamaConfig(
     vocab_size=32000, dim=2048, n_layers=16, n_heads=16, n_kv_heads=8,
     ffn_dim=8192, max_seq_len=1024, rope_theta=500000.0,
 )
@@ -32,18 +39,23 @@ BATCH = 8
 PAGE = 128
 PROMPT_LEN = 128
 GEN_TOKENS = 128
+K_STEPS = 16  # matches EngineConfig.decode_steps_per_tick below
 
 
-def raw_jax_tokens_per_sec(params) -> float:
-    """The ceiling: bare jitted decode steps, no scheduler, no HTTP."""
-    cfg = EngineConfig(max_batch_size=BATCH, max_seq_len=BENCH_CFG.max_seq_len,
-                       page_size=PAGE)
+def raw_ceiling_tokens_per_sec(params, cfg) -> float:
+    """The ceiling: K decode steps scanned inside one jit — bare model
+    math + sampling with dispatch fully amortized; no scheduler, no
+    paging bookkeeping, no HTTP."""
+    from jax import lax
+
+    ecfg = EngineConfig(max_batch_size=BATCH, max_seq_len=cfg.max_seq_len,
+                        page_size=PAGE)
     kv = jnp.zeros(
-        (BENCH_CFG.n_layers, 2, cfg.num_pages * PAGE, BENCH_CFG.n_kv_heads,
-         BENCH_CFG.head_dim), jnp.bfloat16,
+        (cfg.n_layers, 2, ecfg.num_pages * PAGE, cfg.n_kv_heads,
+         cfg.head_dim), jnp.bfloat16,
     )
-    pt = jnp.arange(BATCH * cfg.max_pages_per_seq, dtype=jnp.int32).reshape(
-        BATCH, cfg.max_pages_per_seq
+    pt = jnp.arange(BATCH * ecfg.max_pages_per_seq, dtype=jnp.int32).reshape(
+        BATCH, ecfg.max_pages_per_seq
     )
     active = jnp.ones((BATCH,), bool)
     keys = jnp.zeros((BATCH, 2), jnp.uint32)
@@ -51,35 +63,47 @@ def raw_jax_tokens_per_sec(params) -> float:
     top_p = jnp.ones((BATCH,), jnp.float32)
     top_k = jnp.zeros((BATCH,), jnp.int32)
 
-    def step(params, tokens, positions, kv):
-        logits, kv = llama.decode_step(
-            params, BENCH_CFG, tokens, positions, kv, pt, PAGE, active
-        )
-        return sample(logits, keys, temp, top_p, top_k), kv
+    def kstep(params, tokens, positions, kv):
+        def body(carry, _):
+            tokens, positions, kv = carry
+            logits, kv = llama.decode_step(
+                params, cfg, tokens, positions, kv, pt, PAGE, active
+            )
+            nxt = sample(logits, keys, temp, top_p, top_k)
+            return (nxt, positions + 1, kv), nxt
 
-    step = jax.jit(step, donate_argnums=(3,))
+        (tokens, positions, kv), _ = lax.scan(
+            body, (tokens, positions, kv), None, length=K_STEPS
+        )
+        return tokens, positions, kv
+
+    kstep = jax.jit(kstep, donate_argnums=(3,))
     tokens = jnp.ones((BATCH,), jnp.int32)
     positions = jnp.full((BATCH,), PROMPT_LEN, jnp.int32)
 
-    tokens, kv = step(params, tokens, positions, kv)  # compile
+    tokens, positions, kv = kstep(params, tokens, positions, kv)  # compile
     jax.block_until_ready(tokens)
-    n_steps = 64
-    t0 = time.perf_counter()
-    for i in range(n_steps):
-        tokens, kv = step(params, tokens, positions + 1 + i, kv)
-    jax.block_until_ready(tokens)
-    dt = time.perf_counter() - t0
-    return BATCH * n_steps / dt
+    n_ticks = max(1, 64 // K_STEPS)
+    best = 0.0
+    for _ in range(2):  # two trials, keep the best (tunnel jitter)
+        t0 = time.perf_counter()
+        for _ in range(n_ticks):
+            tokens, positions, kv = kstep(params, tokens, positions, kv)
+        jax.block_until_ready(tokens)
+        dt = time.perf_counter() - t0
+        best = max(best, BATCH * K_STEPS * n_ticks / dt)
+    return best
 
 
-def engine_tokens_per_sec(params) -> float:
-    """The product: same decode through the continuous-batching engine."""
+def engine_numbers(params, cfg) -> tuple[float, float]:
+    """The product: same decode through the continuous-batching engine.
+    Returns (tokens/sec, ttft_ms p50 over the batch)."""
     eng = Engine(
         params,
-        BENCH_CFG,
+        cfg,
         EngineConfig(max_batch_size=BATCH,
-                     max_seq_len=BENCH_CFG.max_seq_len, page_size=PAGE,
-                     decode_steps_per_tick=16),
+                     max_seq_len=cfg.max_seq_len, page_size=PAGE,
+                     decode_steps_per_tick=K_STEPS),
     )
     eng.start()
     try:
@@ -91,14 +115,17 @@ def engine_tokens_per_sec(params) -> float:
             sampling=SamplingParams(temperature=0.0),
             emit=lambda t, f: done.set() if f else None,
         ))
-        done.wait(timeout=300)
+        done.wait(timeout=600)
 
         dones = [threading.Event() for _ in range(BATCH)]
         counts = [0] * BATCH
+        first_at = [0.0] * BATCH
 
         def mk(i):
             def emit(tok, fin):
                 if tok >= 0:
+                    if counts[i] == 0:
+                        first_at[i] = time.perf_counter()
                     counts[i] += 1
                 if fin is not None:
                     dones[i].set()
@@ -113,7 +140,9 @@ def engine_tokens_per_sec(params) -> float:
         for d in dones:
             d.wait(timeout=600)
         dt = time.perf_counter() - t0
-        return sum(counts) / dt
+        ttfts = sorted((f - t0) * 1000.0 for f in first_at if f > 0)
+        ttft_p50 = ttfts[len(ttfts) // 2] if ttfts else -1.0
+        return sum(counts) / dt, ttft_p50
     finally:
         eng.stop()
 
@@ -122,8 +151,6 @@ def _chip_responsive(timeout_s: float = 180.0) -> bool:
     """The axon tunnel can go down entirely (observed 2026-07-28); probe
     with a watchdog so the bench prints an honest line instead of hanging
     the driver."""
-    import threading
-
     done = threading.Event()
     result = {"ok": False}
 
@@ -141,9 +168,24 @@ def _chip_responsive(timeout_s: float = 180.0) -> bool:
     t.start()
     done.wait(timeout_s)
     if not result["ok"] and "error" in result:
-        print(f"device probe failed: {result['error']}",
-              file=__import__("sys").stderr)
+        print(f"device probe failed: {result['error']}", file=sys.stderr)
     return result["ok"]
+
+
+def _build_8b_int8():
+    from aigw_tpu.models.quant import quantize_params
+
+    cfg = llama.LlamaConfig(max_seq_len=1024)  # LLAMA3_8B shapes
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    params = quantize_params(params, consume=True)
+    jax.block_until_ready(params)
+    return params, cfg, "llama-3-8b-arch W8A16 int8"
+
+
+def _build_fallback():
+    params = llama.init_params(jax.random.PRNGKey(0), FALLBACK_CFG)
+    jax.block_until_ready(params)
+    return params, FALLBACK_CFG, "1.1B llama-arch bf16"
 
 
 def main() -> None:
@@ -152,10 +194,9 @@ def main() -> None:
             json.dumps(
                 {
                     "metric": (
-                        "decode tokens/sec/chip — TPU tunnel unresponsive at "
-                        "bench time (device probe timed out; last recorded "
-                        "run: 780-790 tok/s, vs_baseline 1.11-1.21, see "
-                        "BASELINE.md)"
+                        "decode tokens/sec/chip — TPU tunnel unresponsive "
+                        "at bench time (device probe timed out; last "
+                        "recorded run: see BASELINE.md Measured table)"
                     ),
                     "value": 0,
                     "unit": "tokens/s",
@@ -164,20 +205,27 @@ def main() -> None:
             )
         )
         return
-    params = llama.init_params(jax.random.PRNGKey(0), BENCH_CFG)
-    jax.block_until_ready(params)
-    raw = raw_jax_tokens_per_sec(params)
-    engine = engine_tokens_per_sec(params)
+    try:
+        params, cfg, desc = _build_8b_int8()
+    except Exception as e:  # OOM on smaller chips → honest fallback
+        print(f"8B int8 build failed ({type(e).__name__}: {e}), "
+              f"falling back to 1.1B bf16", file=sys.stderr)
+        params, cfg, desc = _build_fallback()
+    raw = raw_ceiling_tokens_per_sec(params, cfg)
+    engine, ttft_ms = engine_numbers(params, cfg)
     print(
         json.dumps(
             {
                 "metric": (
-                    "decode tokens/sec/chip, 1.1B llama-arch bf16, batch=8, "
-                    "paged KV (engine vs raw-JAX-loop ratio in vs_baseline)"
+                    f"decode tokens/sec/chip, {desc}, batch={BATCH}, "
+                    f"prompt={PROMPT_LEN}, paged KV (engine vs "
+                    f"raw-JAX-K-step-scan ceiling in vs_baseline)"
                 ),
                 "value": round(engine, 1),
                 "unit": "tokens/s",
                 "vs_baseline": round(engine / raw, 4),
+                "raw_ceiling": round(raw, 1),
+                "ttft_ms_p50": round(ttft_ms, 1),
             }
         )
     )

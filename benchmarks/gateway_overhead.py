@@ -60,7 +60,7 @@ async def bench(session, url, payload, n, concurrency, stream=False):
     }
 
 
-async def main(n: int, concurrency: int) -> None:
+async def main(n: int, concurrency: int, workers: int = 0) -> None:
     up = FakeUpstream()
     up.on_json("/v1/chat/completions", openai_chat_response("y" * 256))
     up.on_json("/v1/embeddings", {
@@ -89,10 +89,46 @@ async def main(n: int, concurrency: int) -> None:
         ]}],
         "llm_request_costs": [{"metadata_key": "total", "type": "TotalToken"}],
     })
-    server, runner = await run_gateway(RuntimeConfig.build(cfg), port=0)
-    site = list(runner.sites)[0]
-    gw_port = site._server.sockets[0].getsockname()[1]
-    gw = f"http://127.0.0.1:{gw_port}"
+    proc = None
+    runner = None
+    if workers > 1:
+        # multi-worker SO_REUSEPORT mode through the real CLI
+        import socket
+        import subprocess
+        import tempfile
+
+        import yaml
+
+        with socket.socket() as probe:
+            probe.bind(("127.0.0.1", 0))
+            gw_port = probe.getsockname()[1]
+        cfg_file = tempfile.NamedTemporaryFile(
+            "w", suffix=".yaml", delete=False)
+        yaml.safe_dump(cfg.to_dict(), cfg_file)
+        cfg_file.close()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "aigw_tpu", "run", cfg_file.name,
+             "--port", str(gw_port), "--workers", str(workers)],
+            cwd=os.path.join(os.path.dirname(__file__), ".."),
+        )
+        gw = f"http://127.0.0.1:{gw_port}"
+        deadline = time.time() + 30
+        async with aiohttp.ClientSession() as s:
+            while time.time() < deadline:
+                try:
+                    async with s.get(gw + "/health") as r:
+                        if r.status == 200:
+                            break
+                except aiohttp.ClientError:
+                    await asyncio.sleep(0.3)
+            else:
+                raise RuntimeError("multi-worker gateway failed to start")
+    else:
+        server, runner = await run_gateway(RuntimeConfig.build(cfg),
+                                           port=0)
+        site = list(runner.sites)[0]
+        gw_port = site._server.sockets[0].getsockname()[1]
+        gw = f"http://127.0.0.1:{gw_port}"
 
     results = {}
     async with aiohttp.ClientSession() as s:
@@ -128,9 +164,16 @@ async def main(n: int, concurrency: int) -> None:
             "added_p50_ms": round(sg["p50_ms"] - sd["p50_ms"], 3),
         }
 
-    await runner.cleanup()
+    if runner is not None:
+        await runner.cleanup()
+    if proc is not None:
+        proc.terminate()
+        proc.wait(timeout=10)
+        os.unlink(cfg_file.name)
     await up.stop()
     await up_stream.stop()
+    if workers > 1:
+        results["workers"] = workers
     print(json.dumps(results, indent=2))
 
 
@@ -138,5 +181,8 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=200)
     ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--workers", type=int, default=0,
+                    help="bench the multi-process SO_REUSEPORT gateway "
+                         "via the real CLI instead of in-process")
     args = ap.parse_args()
-    asyncio.run(main(args.requests, args.concurrency))
+    asyncio.run(main(args.requests, args.concurrency, args.workers))

@@ -1,0 +1,72 @@
+"""Multi-worker SO_REUSEPORT gateway smoke test: N processes share one
+port through the real CLI; requests succeed and all workers stay up.
+(Scaling itself is a deployment property — this box has 1 core — so the
+test asserts mechanics, not throughput.)"""
+
+from __future__ import annotations
+
+import json
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.mark.skipif(not hasattr(socket, "SO_REUSEPORT"),
+                    reason="SO_REUSEPORT not available")
+def test_workers_share_port(tmp_path):
+    cfg = tmp_path / "gw.yaml"
+    cfg.write_text(json.dumps({
+        "version": "v1",
+        "backends": [],
+        "routes": [],
+    }))
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "aigw_tpu", "run", str(cfg),
+         "--port", str(port), "--workers", "2"],
+        cwd=REPO, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        deadline = time.time() + 30
+        ok = False
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/health", timeout=2) as r:
+                    ok = r.status == 200
+                    break
+            except OSError:
+                time.sleep(0.3)
+        assert ok, "gateway with --workers never became healthy"
+        # a burst of requests all succeed regardless of which worker
+        # the kernel hands each connection to
+        for _ in range(20):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/health", timeout=5) as r:
+                assert r.status == 200
+        assert proc.poll() is None  # parent still running
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_workers_requires_explicit_port(tmp_path):
+    cfg = tmp_path / "gw.yaml"
+    cfg.write_text(json.dumps({"version": "v1", "backends": [],
+                               "routes": []}))
+    out = subprocess.run(
+        [sys.executable, "-m", "aigw_tpu", "run", str(cfg),
+         "--port", "0", "--workers", "2"],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 1
+    assert "explicit --port" in out.stderr

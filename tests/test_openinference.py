@@ -428,6 +428,7 @@ class TestGatewayIntegration:
                             {"role": "user", "content": "hi"}]},
                         headers={"x-request-id": "req-77"})
             finally:
+                server.access_log.drain()
                 await runner.cleanup()
                 await up.stop()
 
@@ -470,6 +471,7 @@ class TestGatewayIntegration:
                         json={"model": "m1", "messages": [
                             {"role": "user", "content": "hi"}]})
             finally:
+                server.access_log.drain()
                 await runner.cleanup()
                 await up.stop()
 
@@ -489,6 +491,7 @@ class TestAccessLoggerUnit:
         p = tmp_path / "a.log"
         al = AccessLogger(str(p))
         al.log(method="POST", path="/x", status=200, duration_ms=1.0)
+        al.drain()
         entry = json.loads(p.read_text())
         assert "usage" not in entry and "costs" not in entry
         assert "error" not in entry and "attempts" not in entry
