@@ -618,8 +618,13 @@ class GatewayServer:
                     content_type="application/json")
             except (TranslationError, oai.SchemaError) as e:
                 req_metrics.finish(TokenUsage(), error_type="translation")
+                status = getattr(e, "status", 400)  # NotFoundError → 404
                 return web.Response(
-                    status=400, body=error_body(str(e)),
+                    status=status,
+                    body=error_body(
+                        str(e),
+                        type_="not_found" if status == 404
+                        else "invalid_request_error"),
                     content_type="application/json")
             self.circuit.record_success(rb.backend.name)
             return result

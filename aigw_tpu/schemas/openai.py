@@ -18,6 +18,16 @@ from aigw_tpu.gateway.costs import TokenUsage
 class SchemaError(ValueError):
     """Client-facing 400: malformed request body."""
 
+    status = 400
+
+
+class NotFoundError(SchemaError):
+    """Client-facing 404: a referenced resource doesn't exist (e.g. an
+    unknown ``previous_response_id`` — OpenAI returns 404 for these,
+    and SDK retry logic branches on 404 vs 400)."""
+
+    status = 404
+
 
 # ---------------------------------------------------------------------------
 # Requests

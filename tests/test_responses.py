@@ -134,3 +134,332 @@ class TestStreamingTruncation:
         with pytest.raises(SchemaError, match="content parts"):
             t.request({"model": "m", "input": [
                 {"type": "message", "content": ["plain string"]}]})
+
+
+class TestResponsesTools:
+    def test_tools_convert_to_chat_and_back(self):
+        from aigw_tpu.translate.responses import (
+            chat_to_responses_response,
+            responses_to_chat_request,
+        )
+
+        req = responses_to_chat_request({
+            "model": "m",
+            "input": "weather in SF?",
+            "tools": [{"type": "function", "name": "get_weather",
+                       "description": "d",
+                       "parameters": {"type": "object"}}],
+            "tool_choice": "auto",
+        })
+        assert req["tools"][0]["function"]["name"] == "get_weather"
+        assert req["tool_choice"] == "auto"
+
+        out = chat_to_responses_response({
+            "model": "m",
+            "choices": [{"message": {
+                "role": "assistant", "content": None,
+                "tool_calls": [{"id": "call_1", "type": "function",
+                                "function": {"name": "get_weather",
+                                             "arguments": "{\"q\":1}"}}],
+            }, "finish_reason": "tool_calls"}],
+            "usage": {"prompt_tokens": 3, "completion_tokens": 2,
+                      "total_tokens": 5},
+        }, "resp_x", 0)
+        fc = [o for o in out["output"] if o["type"] == "function_call"]
+        assert fc[0]["name"] == "get_weather"
+        assert fc[0]["call_id"] == "call_1"
+        assert fc[0]["arguments"] == "{\"q\":1}"
+
+    def test_function_call_io_items(self):
+        from aigw_tpu.translate.responses import responses_to_chat_request
+
+        req = responses_to_chat_request({
+            "model": "m",
+            "input": [
+                {"type": "message", "role": "user", "content": "weather?"},
+                {"type": "function_call", "call_id": "call_1",
+                 "name": "get_weather", "arguments": "{\"city\":\"SF\"}"},
+                {"type": "function_call_output", "call_id": "call_1",
+                 "output": "{\"temp\": 18}"},
+            ],
+        })
+        msgs = req["messages"]
+        assert msgs[1]["tool_calls"][0]["id"] == "call_1"
+        assert msgs[1]["tool_calls"][0]["function"]["name"] == (
+            "get_weather")
+        assert msgs[2] == {"role": "tool", "tool_call_id": "call_1",
+                           "content": "{\"temp\": 18}"}
+
+    def test_parallel_function_calls_merge_into_one_message(self):
+        """Replayed parallel tool calls (call A, call B, output A,
+        output B) must produce ONE assistant message with both
+        tool_calls — strict chat backends reject interleaved
+        assistant/tool orderings."""
+        from aigw_tpu.translate.responses import responses_to_chat_request
+
+        req = responses_to_chat_request({
+            "model": "m",
+            "input": [
+                {"type": "message", "role": "user", "content": "both?"},
+                {"type": "function_call", "call_id": "a",
+                 "name": "fa", "arguments": "{}"},
+                {"type": "function_call", "call_id": "b",
+                 "name": "fb", "arguments": "{}"},
+                {"type": "function_call_output", "call_id": "a",
+                 "output": "1"},
+                {"type": "function_call_output", "call_id": "b",
+                 "output": "2"},
+            ],
+        })
+        msgs = req["messages"]
+        assert [m["role"] for m in msgs] == [
+            "user", "assistant", "tool", "tool"]
+        assert [tc["id"] for tc in msgs[1]["tool_calls"]] == ["a", "b"]
+
+    def test_named_tool_choice(self):
+        from aigw_tpu.translate.responses import responses_to_chat_request
+
+        req = responses_to_chat_request({
+            "model": "m", "input": "x",
+            "tools": [{"type": "function", "name": "f"}],
+            "tool_choice": {"type": "function", "name": "f"},
+        })
+        assert req["tool_choice"] == {
+            "type": "function", "function": {"name": "f"}}
+
+
+class TestResponsesMultiTurn:
+    def test_previous_response_id_chains_transcript(self):
+        from aigw_tpu.translate.responses import ResponsesToChat
+
+        t1 = ResponsesToChat(S.TPUSERVE)
+        t1.request({"model": "m", "input": "my name is alice",
+                    "instructions": "be brief"})
+        t1.response_body(json.dumps({
+            "model": "m",
+            "choices": [{"message": {"role": "assistant",
+                                     "content": "hi alice"},
+                         "finish_reason": "stop"}],
+            "usage": {"prompt_tokens": 5, "completion_tokens": 2,
+                      "total_tokens": 7},
+        }).encode(), True)
+        rid = t1._id
+
+        t2 = ResponsesToChat(S.TPUSERVE)
+        tx = t2.request({"model": "m", "input": "what is my name?",
+                         "previous_response_id": rid})
+        msgs = json.loads(tx.body)["messages"]
+        contents = [m.get("content") for m in msgs]
+        assert "my name is alice" in contents
+        assert "hi alice" in contents
+        assert contents[-1] == "what is my name?"
+        # instructions are NOT inherited across turns (OpenAI
+        # semantics): turn 2 omitted them, so no system message
+        assert all(m.get("role") != "system" for m in msgs)
+
+        t3 = ResponsesToChat(S.TPUSERVE)
+        tx = t3.request({"model": "m", "input": "again",
+                         "previous_response_id": rid,
+                         "instructions": "be verbose"})
+        msgs = json.loads(tx.body)["messages"]
+        assert msgs[0] == {"role": "system", "content": "be verbose"}
+        assert sum(m.get("role") == "system" for m in msgs) == 1
+
+    def test_unknown_previous_response_id_rejected(self):
+        from aigw_tpu.schemas.openai import SchemaError
+        from aigw_tpu.translate.responses import ResponsesToChat
+
+        t = ResponsesToChat(S.TPUSERVE)
+        with pytest.raises(SchemaError, match="not found"):
+            t.request({"model": "m", "input": "x",
+                       "previous_response_id": "resp_nope"})
+
+    def test_store_false_not_persisted(self):
+        from aigw_tpu.translate.responses import (
+            RESPONSE_STORE,
+            ResponsesToChat,
+        )
+
+        t = ResponsesToChat(S.TPUSERVE)
+        t.request({"model": "m", "input": "secret", "store": False})
+        t.response_body(json.dumps({
+            "model": "m",
+            "choices": [{"message": {"role": "assistant", "content": "ok"},
+                         "finish_reason": "stop"}],
+        }).encode(), True)
+        assert RESPONSE_STORE.get(t._id) is None
+
+    def test_store_lru_and_ttl(self):
+        from aigw_tpu.translate.responses import ResponseStore
+
+        s = ResponseStore(max_entries=2, ttl_s=1000)
+        s.put("a", [{"role": "user", "content": "1"}])
+        s.put("b", [{"role": "user", "content": "2"}])
+        s.put("c", [{"role": "user", "content": "3"}])
+        assert s.get("a") is None  # evicted
+        assert s.get("b") is not None
+        expired = ResponseStore(ttl_s=0)
+        expired.put("x", [])
+        import time as _t
+
+        _t.sleep(0.01)
+        assert expired.get("x") is None
+
+
+class TestResponsesStreamingTools:
+    def test_streaming_tool_call_events(self):
+        from aigw_tpu.translate.responses import ResponsesToChat
+
+        t = ResponsesToChat(S.TPUSERVE)
+        t.request({"model": "m", "input": "weather?", "stream": True,
+                   "tools": [{"type": "function", "name": "get_weather"}]})
+
+        def chunk(payload):
+            return f"data: {json.dumps(payload)}\n\n".encode()
+
+        raw = bytearray()
+        rx = t.response_body(chunk({
+            "model": "m",
+            "choices": [{"index": 0, "delta": {"tool_calls": [
+                {"index": 0, "id": "call_9",
+                 "function": {"name": "get_weather",
+                              "arguments": "{\"ci"}}]}}],
+        }), False)
+        raw += rx.body
+        rx = t.response_body(chunk({
+            "choices": [{"index": 0, "delta": {"tool_calls": [
+                {"index": 0,
+                 "function": {"arguments": "ty\":\"SF\"}"}}]},
+                "finish_reason": "tool_calls"}],
+        }), False)
+        raw += rx.body
+        rx = t.response_body(b"data: [DONE]\n\n", True)
+        raw += rx.body
+        events = []
+        for block in bytes(raw).decode().split("\n\n"):
+            for line in block.splitlines():
+                if line.startswith("data: "):
+                    events.append(json.loads(line[6:]))
+        types = [e["type"] for e in events]
+        assert "response.output_item.added" in types
+        assert types.count(
+            "response.function_call_arguments.delta") == 2
+        done = next(e for e in events
+                    if e["type"]
+                    == "response.function_call_arguments.done")
+        assert done["arguments"] == "{\"city\":\"SF\"}"
+        completed = next(e for e in events
+                         if e["type"] == "response.completed")
+        fc = [o for o in completed["response"]["output"]
+              if o["type"] == "function_call"]
+        assert fc[0]["call_id"] == "call_9"
+        assert fc[0]["arguments"] == "{\"city\":\"SF\"}"
+        # monotonic sequence numbers
+        seqs = [e["sequence_number"] for e in events
+                if "sequence_number" in e]
+        assert seqs == sorted(seqs)
+
+    def test_mixed_text_and_tool_stream_indexes_match_final(self):
+        """output_index in streamed events must agree with each item's
+        position in the final response.completed output array."""
+        from aigw_tpu.translate.responses import ResponsesToChat
+
+        t = ResponsesToChat(S.TPUSERVE)
+        t.request({"model": "m", "input": "x", "stream": True})
+
+        def chunk(payload):
+            return f"data: {json.dumps(payload)}\n\n".encode()
+
+        raw = bytearray()
+        raw += t.response_body(chunk({
+            "model": "m",
+            "choices": [{"index": 0,
+                         "delta": {"content": "let me check"}}],
+        }), False).body
+        raw += t.response_body(chunk({
+            "choices": [{"index": 0, "delta": {"tool_calls": [
+                {"index": 0, "id": "c1",
+                 "function": {"name": "f", "arguments": "{}"}}]},
+                "finish_reason": "tool_calls"}],
+        }), False).body
+        raw += t.response_body(b"data: [DONE]\n\n", True).body
+        events = [json.loads(line[6:])
+                  for block in bytes(raw).decode().split("\n\n")
+                  for line in block.splitlines()
+                  if line.startswith("data: ")]
+        added = [e for e in events
+                 if e["type"] == "response.output_item.added"]
+        assert [a["item"]["type"] for a in added] == [
+            "message", "function_call"]
+        assert [a["output_index"] for a in added] == [0, 1]
+        completed = next(e for e in events
+                         if e["type"] == "response.completed")
+        out = completed["response"]["output"]
+        assert out[0]["type"] == "message"
+        assert out[1]["type"] == "function_call"
+        assert out[1]["call_id"] == "c1"
+
+    def test_arguments_before_name_still_ordered(self):
+        """A malformed backend that streams arguments before the name
+        must still produce added-then-delta ordering and a matching
+        arguments.done."""
+        from aigw_tpu.translate.responses import ResponsesToChat
+
+        t = ResponsesToChat(S.TPUSERVE)
+        t.request({"model": "m", "input": "x", "stream": True})
+
+        def chunk(payload):
+            return f"data: {json.dumps(payload)}\n\n".encode()
+
+        raw = bytearray()
+        raw += t.response_body(chunk({
+            "model": "m",
+            "choices": [{"index": 0, "delta": {"tool_calls": [
+                {"index": 0, "function": {"arguments": "{\"a\":1}"}}]}}],
+        }), False).body
+        raw += t.response_body(b"data: [DONE]\n\n", True).body
+        events = [json.loads(line[6:])
+                  for block in bytes(raw).decode().split("\n\n")
+                  for line in block.splitlines()
+                  if line.startswith("data: ")]
+        types = [e["type"] for e in events]
+        assert types.index("response.output_item.added") < types.index(
+            "response.function_call_arguments.delta")
+        done = next(e for e in events
+                    if e["type"]
+                    == "response.function_call_arguments.done")
+        assert done["arguments"] == "{\"a\":1}"
+
+
+class TestResponses404:
+    def test_unknown_previous_response_404_through_gateway(self):
+        from aigw_tpu.config.model import Config
+        from aigw_tpu.config.runtime import RuntimeConfig
+        from aigw_tpu.gateway.server import run_gateway
+
+        async def main():
+            cfg = Config.parse({
+                "version": "v1",
+                "backends": [{"name": "a", "schema": "Anthropic",
+                              "url": "http://127.0.0.1:1"}],
+                "routes": [{"name": "r", "rules": [
+                    {"models": ["m"], "backends": ["a"]}]}],
+            })
+            server, runner = await run_gateway(
+                RuntimeConfig.build(cfg), port=0)
+            site = list(runner.sites)[0]
+            port = site._server.sockets[0].getsockname()[1]
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        f"http://127.0.0.1:{port}/v1/responses",
+                        json={"model": "m", "input": "x",
+                              "previous_response_id": "resp_missing"},
+                    ) as resp:
+                        return resp.status, await resp.json()
+            finally:
+                await runner.cleanup()
+
+        status, body = asyncio.run(main())
+        assert status == 404
+        assert "not found" in json.dumps(body)
