@@ -392,12 +392,46 @@ class GatewayServer:
         endpoint, front_schema, operation = _ENDPOINTS[request.path]
         rc = self._runtime  # pin the config for this request
         started = time.monotonic()
-        raw = await request.read()
         error_body = (
             anth.error_body
             if front_schema is APISchemaName.ANTHROPIC
             else oai.error_body
         )
+        try:
+            raw = await request.read()
+        except (aiohttp.web.RequestPayloadError,
+                aiohttp.http_exceptions.HttpProcessingError) as e:
+            # e.g. a corrupt gzip request body fails the server-side
+            # inflater mid-read — that's the client's 400, not our 500
+            self._log_rejection(request, 400, started,
+                                reason="bad_request_body")
+            return web.Response(
+                status=400,
+                body=error_body(f"unreadable request body: {e}"),
+                content_type="application/json")
+        # compressed request bodies (reference: extproc decodes encoded
+        # bodies before translation, util.go decodeContentIfNeeded; the
+        # inference-extension conformance drives gzipped JSON).
+        # aiohttp's server layer transparently inflates supported
+        # codings and 400s unsupported/corrupt ones at read time (the
+        # try/except above); this fallback only fires when gzip bytes
+        # reach us undecoded (magic 1f 8b — e.g. behind a raw
+        # transport). The translated upstream body is re-serialized, so
+        # the encoding is consumed and never forwarded.
+        enc = request.headers.get("content-encoding", "").lower().strip()
+        if enc == "gzip" and raw[:2] == b"\x1f\x8b":
+            import gzip as _gzip
+            import zlib as _zlib
+
+            try:
+                raw = _gzip.decompress(raw)
+            except (OSError, EOFError, _zlib.error):
+                self._log_rejection(request, 400, started,
+                                    reason="bad_encoding")
+                return web.Response(
+                    status=400,
+                    body=error_body("invalid gzip request body"),
+                    content_type="application/json")
         # ---- phase 1: route selection ----------------------------------
         if endpoint in _MULTIPART_ENDPOINTS:
             ctype = request.headers.get("content-type", "")

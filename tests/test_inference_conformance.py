@@ -1,0 +1,197 @@
+"""Gateway-API inference-extension conformance parity.
+
+The reference runs the upstream conformance suite against its
+InferencePool/EPP surface (tests/e2e-inference-extension/
+conformance_test.go + inference_pool_test.go). That suite is Go +
+Kubernetes and cannot run here, so this file asserts the SAME scenario
+list against this gateway's picker surface:
+
+1. pool-backed route, matched model (+ header variants)      → 200
+2. unmatched model                                           → 404
+3. pool whose members expose NO metrics surface → blind round-robin
+   fallback pick, every member still serves (the reference's
+   "invalid pod metrics → fallback to a random pick" scenario)
+4. InferencePool and plain AIServiceBackend coexisting in one route
+5. pre-selected x-gateway-destination-endpoint honored (EPP contract)
+6. gzip-compressed and identity JSON request bodies          → 200
+"""
+
+from __future__ import annotations
+
+import asyncio
+import gzip
+import json
+
+import aiohttp
+
+from aigw_tpu.config.model import DESTINATION_ENDPOINT_HEADER, Config
+from aigw_tpu.config.runtime import RuntimeConfig
+from aigw_tpu.gateway.server import run_gateway
+from tests.fakes import FakeUpstream, openai_chat_response
+
+
+async def _pool_member(name: str, with_state: bool = True):
+    """OpenAI-wire fake pool member; optionally exposes the tpuserve
+    /state telemetry surface the picker scores on."""
+    up = FakeUpstream().on_json(
+        "/v1/chat/completions", openai_chat_response(f"from-{name}"))
+    if with_state:
+        up.on_json("/state", {
+            "kv_pages_free": 10, "kv_pages_total": 16,
+            "queue_depth": 0, "active_slots": 0, "batch_slots": 2,
+        })
+    await up.start()
+    return up
+
+
+def _config(pool_addrs, backend_url):
+    return Config.parse({
+        "version": "v1",
+        "backends": [
+            {"name": "pool", "schema": "OpenAI",
+             "endpoints": [{"address": a, "slice": f"s{i}"}
+                           for i, a in enumerate(pool_addrs)],
+             "picker_poll_interval": 0.2},
+            {"name": "svc", "schema": "OpenAI", "url": backend_url},
+        ],
+        "routes": [{"name": "conf", "rules": [
+            {"models": ["pool-model"], "backends": ["pool"]},
+            {"models": ["svc-model"], "backends": ["svc"]},
+        ]}],
+    })
+
+
+async def _env():
+    # neither member has a metrics surface — the picker has no
+    # telemetry and must fall back to blind round-robin (scenario 3)
+    m1 = await _pool_member("m1", with_state=False)
+    m2 = await _pool_member("m2", with_state=False)
+    svc = await FakeUpstream().on_json(
+        "/v1/chat/completions", openai_chat_response("from-svc")).start()
+    addrs = [u.url.removeprefix("http://") for u in (m1, m2)]
+    server, runner = await run_gateway(
+        RuntimeConfig.build(_config(addrs, svc.url)), port=0)
+    site = list(runner.sites)[0]
+    port = site._server.sockets[0].getsockname()[1]
+    return (m1, m2, svc), (server, runner), f"http://127.0.0.1:{port}", addrs
+
+
+def _payload(model):
+    return {"model": model,
+            "messages": [{"role": "user", "content": "hi"}]}
+
+
+def test_inference_extension_conformance_scenarios():
+    async def main():
+        ups, (server, runner), url, addrs = await _env()
+        try:
+            async with aiohttp.ClientSession() as s:
+                # 1. matched model via the pool, arbitrary client
+                # headers (auth variants) → 200
+                for hdr in ({}, {"authorization": "sk-abc"},
+                            {"authorization": "sk-zyx"}):
+                    async with s.post(
+                        url + "/v1/chat/completions",
+                        json=_payload("pool-model"), headers=hdr,
+                    ) as resp:
+                        assert resp.status == 200
+                        got = await resp.json()
+                        assert got["choices"][0]["message"][
+                            "content"].startswith("from-m")
+
+                # 2. unmatched model → 404 from the gateway directly
+                async with s.post(
+                    url + "/v1/chat/completions",
+                    json=_payload("no-such-model"),
+                ) as resp:
+                    assert resp.status == 404
+
+                # 3. no member has metrics: picks must still succeed
+                # via blind round-robin, and over a burst BOTH members
+                # serve (no one is blackholed)
+                seen = set()
+                for _ in range(12):
+                    async with s.post(
+                        url + "/v1/chat/completions",
+                        json=_payload("pool-model"),
+                    ) as resp:
+                        assert resp.status == 200
+                        got = await resp.json()
+                        seen.add(
+                            got["choices"][0]["message"]["content"])
+                assert {"from-m1", "from-m2"} <= seen
+
+                # 4. plain AIServiceBackend coexists in the same route
+                async with s.post(
+                    url + "/v1/chat/completions",
+                    json=_payload("svc-model"),
+                ) as resp:
+                    assert resp.status == 200
+                    got = await resp.json()
+                    assert got["choices"][0]["message"]["content"] == (
+                        "from-svc")
+
+                # 5. a pre-selected destination endpoint wins (the EPP
+                # x-gateway-destination-endpoint contract)
+                for target in addrs:
+                    async with s.post(
+                        url + "/v1/chat/completions",
+                        json=_payload("pool-model"),
+                        headers={DESTINATION_ENDPOINT_HEADER: target},
+                    ) as resp:
+                        assert resp.status == 200
+                        got = await resp.json()
+                    member = "m1" if target == addrs[0] else "m2"
+                    assert got["choices"][0]["message"]["content"] == (
+                        f"from-{member}")
+
+                # 6. gzip-compressed request body → 200; corrupt or
+                # undecodable encodings → 400 (never a 500)
+                async with s.post(
+                    url + "/v1/chat/completions",
+                    data=json.dumps(_payload("pool-model")).encode(),
+                    headers={"content-type": "application/json"},
+                    compress="gzip",
+                ) as resp:
+                    assert resp.status == 200
+                # corrupt gzip body via a raw socket client (aiohttp
+                # would re-compress a manual content-encoding header)
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", int(url.rsplit(":", 1)[1]))
+                bad = b"\x00bad"
+                writer.write(
+                    b"POST /v1/chat/completions HTTP/1.1\r\n"
+                    b"Host: x\r\ncontent-type: application/json\r\n"
+                    b"content-encoding: gzip\r\n"
+                    + f"content-length: {len(bad)}\r\n\r\n".encode()
+                    + bad)
+                await writer.drain()
+                status_line = await reader.readline()
+                assert b"400" in status_line
+                writer.close()
+                # a decoded body that STILL carries a gzip magic but is
+                # corrupt hits the gateway's own inflater → 400 too
+                async with s.post(
+                    url + "/v1/chat/completions",
+                    data=b"\x1f\x8b" + b"junkjunk",
+                    headers={"content-type": "application/json"},
+                    compress="gzip",
+                ) as resp:
+                    assert resp.status == 400
+                # encodings the server stack can't decode are client
+                # errors (400), never 500s
+                for coding in ("br", "zstd"):
+                    async with s.post(
+                        url + "/v1/chat/completions",
+                        data=json.dumps(
+                            _payload("pool-model")).encode(),
+                        headers={"content-type": "application/json",
+                                 "content-encoding": coding},
+                    ) as resp:
+                        assert resp.status == 400, coding
+        finally:
+            await runner.cleanup()
+            for u in ups:
+                await u.stop()
+
+    asyncio.run(main())
