@@ -81,6 +81,10 @@ def main(argv: list[str] | None = None) -> int:
                               "sequence-parallel prefill path")
     p_serve.add_argument("--quantize", default="", choices=["", "int8"],
                          help="weight-only quantization (W8A16)")
+    p_serve.add_argument("--prefill-chunk-tokens", type=int, default=0,
+                         help="chunk prompts longer than this into "
+                              "fixed-size prefill steps with decode "
+                              "ticks interleaved (0 = off)")
     p_serve.add_argument("--decode-steps-per-tick", type=int, default=8,
                          help="fused decode steps per host round-trip")
     p_serve.add_argument("--no-prefix-cache", action="store_true",
@@ -330,6 +334,7 @@ async def _run_tpuserve(args: argparse.Namespace) -> int:
         decode_steps_per_tick=args.decode_steps_per_tick,
         enable_prefix_cache=not args.no_prefix_cache,
         sp_prefill_min_tokens=args.sp_prefill_min_tokens,
+        prefill_chunk_tokens=args.prefill_chunk_tokens,
     )
     print(f"tpuserve listening on http://{args.host}:{args.port}", flush=True)
     await _wait_for_signal()

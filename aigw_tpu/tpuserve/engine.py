@@ -79,6 +79,11 @@ class EngineConfig:
     # chip). Shorter prompts use the plain prefill — the ICI rotation
     # only pays for itself on long sequences.
     sp_prefill_min_tokens: int = 1024
+    # Chunked prefill: prompts longer than this run as fixed-size
+    # prefill_suffix steps with a decode tick between chunks — bounding
+    # both the largest compiled bucket and how long active streams
+    # stall behind a long prompt. 0 disables (whole-prompt prefill).
+    prefill_chunk_tokens: int = 0
 
     def __post_init__(self) -> None:
         if self.max_seq_len % self.page_size != 0:
@@ -143,6 +148,7 @@ class EngineStats:
     tokens_generated: int = 0
     prefills: int = 0
     sp_prefills: int = 0  # prefills routed through ring attention
+    chunked_prefill_steps: int = 0  # intermediate chunk device steps
     decode_steps: int = 0
     prefix_cache_hits: int = 0
     prefix_tokens_reused: int = 0
@@ -486,23 +492,11 @@ class Engine:
 
             suffix = req.prompt[prefix_len:]
             ns = len(suffix)
-            # bucketed padded length
-            S = self.cfg.min_prefill_bucket
-            while S < ns:
-                S *= 2
-            S = min(S, self.cfg.max_seq_len)
             use_sp = (
                 self._prefill_sp_fn is not None
                 and prefix_len == 0
                 and ns >= self.cfg.sp_prefill_min_tokens
             )
-            if use_sp and S % self._sp:
-                # ring attention shards the padded length over sp — round
-                # the bucket up to a multiple of sp (non-power-of-two sp
-                # like 6 must not silently disable the path)
-                S = -(-S // self._sp) * self._sp
-            tokens = np.zeros((1, S), np.int32)
-            tokens[0, :ns] = suffix
             pt = np.zeros((1, self.cfg.max_pages_per_seq), np.int32)
             pt[0, : len(pages)] = pages
 
@@ -528,21 +522,85 @@ class Engine:
                 jnp.asarray([adapter_row], jnp.int32),
             )
             t0 = time.monotonic()
+            # pow2 page bucket covering the sequence — the gather window
+            # of suffix/chunked steps, not the full max_seq_len window
+            need = self.allocator.pages_for(total)
+            bucket = 1
+            while bucket < need:
+                bucket *= 2
+            bucket = min(bucket, self.cfg.max_pages_per_seq)
+
+            # chunked prefill: long prompts run as fixed-size suffix
+            # steps so no giant bucket is ever compiled and a decode
+            # tick runs between chunks — active streams keep emitting
+            # behind a long prompt instead of stalling for its whole
+            # prefill (vLLM-style chunked prefill; the prefill_suffix
+            # kernel with prefix_lens=consumed IS the chunk step)
+            chunk = self.cfg.prefill_chunk_tokens
+            consumed = 0
+            if (chunk > 0 and not use_sp
+                    and self.fns.prefill_suffix is not None
+                    and ns > chunk):
+                # loop-invariant device uploads hoisted; each boundary
+                # is also a cancellation/shutdown yield point — exactly
+                # what chunking exists to provide
+                pt_dev = jnp.asarray(pt[:, :bucket])
+                ctokens = np.zeros((1, chunk), np.int32)
+                aborted = False
+                while ns - consumed > chunk:
+                    if req.cancelled.is_set() or self._stop.is_set():
+                        aborted = True
+                        break
+                    ctokens[0, :] = suffix[consumed:consumed + chunk]
+                    _, self.kv_cache = self._prefill_suffix_fn(
+                        self.params,
+                        self.lora_params,
+                        jnp.asarray(ctokens),
+                        jnp.asarray([prefix_len + consumed], jnp.int32),
+                        jnp.asarray([prefix_len + consumed + chunk],
+                                    jnp.int32),
+                        self.kv_cache,
+                        pt_dev,
+                        *sampling_args,
+                    )
+                    consumed += chunk
+                    self.stats.chunked_prefill_steps += 1
+                    self._decode_tick()
+                if aborted:
+                    self.allocator.free(seq_id)
+                    if self._stop.is_set():
+                        # graceful stop mid-prompt: hand it back like an
+                        # OutOfPages retry; the drain path settles it
+                        if not req.cancelled.is_set():
+                            self._requeue_front(req)
+                        break
+                    continue  # cancelled: next queued request
+
+            eff_prefix = prefix_len + consumed
+            tail = suffix[consumed:]
+            ns_tail = len(tail)
+            # bucketed padded length for the remaining tokens
+            S = self.cfg.min_prefill_bucket
+            while S < ns_tail:
+                S *= 2
+            S = min(S, self.cfg.max_seq_len)
+            if use_sp and S % self._sp:
+                # ring attention shards the padded length over sp — round
+                # the bucket up to a multiple of sp (non-power-of-two sp
+                # like 6 must not silently disable the path)
+                S = -(-S // self._sp) * self._sp
+            tokens = np.zeros((1, S), np.int32)
+            tokens[0, :ns_tail] = tail
+
             if prefix_len:
                 self.stats.prefix_cache_hits += 1
                 self.stats.prefix_tokens_reused += prefix_len
-                # bucket the gather window like decode: pow2 pages covering
-                # the sequence, not the full max_seq_len window
-                need = self.allocator.pages_for(total)
-                bucket = 1
-                while bucket < need:
-                    bucket *= 2
-                bucket = min(bucket, self.cfg.max_pages_per_seq)
+            if eff_prefix:
                 next_tok, self.kv_cache = self._prefill_suffix_fn(
                     self.params,
                     self.lora_params,
                     jnp.asarray(tokens),
-                    jnp.asarray([prefix_len], jnp.int32),
+                    jnp.asarray([eff_prefix], jnp.int32),
                     jnp.asarray([n], jnp.int32),
                     self.kv_cache,
                     jnp.asarray(pt[:, :bucket]),

@@ -679,6 +679,8 @@ class TPUServeServer:
             ("tpuserve_tokens_generated_total", s.tokens_generated),
             ("tpuserve_prefills_total", s.prefills),
             ("tpuserve_sp_prefills_total", s.sp_prefills),
+            ("tpuserve_chunked_prefill_steps_total",
+             s.chunked_prefill_steps),
             ("tpuserve_decode_steps_total", s.decode_steps),
             ("tpuserve_prefix_cache_hits_total", s.prefix_cache_hits),
             ("tpuserve_prefix_tokens_reused_total", s.prefix_tokens_reused),
@@ -704,6 +706,7 @@ async def run_tpuserve(
     decode_steps_per_tick: int = 8,
     enable_prefix_cache: bool = True,
     sp_prefill_min_tokens: int = 1024,
+    prefill_chunk_tokens: int = 0,
 ) -> web.AppRunner:
     server = TPUServeServer(
         model,
@@ -715,6 +718,7 @@ async def run_tpuserve(
             decode_steps_per_tick=decode_steps_per_tick,
             enable_prefix_cache=enable_prefix_cache,
             sp_prefill_min_tokens=sp_prefill_min_tokens,
+            prefill_chunk_tokens=prefill_chunk_tokens,
         ),
         tp=tp,
         ep=ep,

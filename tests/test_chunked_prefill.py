@@ -1,0 +1,173 @@
+"""Chunked prefill: long prompts run as fixed-size prefill_suffix steps
+with decode ticks interleaved (engine.py _admit). Greedy output must be
+token-identical to whole-prompt prefill."""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+
+from aigw_tpu.models import llama
+from aigw_tpu.models.registry import get_model_spec
+from aigw_tpu.tpuserve.engine import Engine, EngineConfig, GenRequest
+from aigw_tpu.tpuserve.sampling import SamplingParams
+
+
+def _engine(chunk: int, prefix_cache: bool = True) -> Engine:
+    spec = get_model_spec("tiny-random")
+    params = llama.init_params(jax.random.PRNGKey(7), spec.config)
+    return Engine(
+        params, spec.config,
+        EngineConfig(max_batch_size=2, max_seq_len=512, page_size=16,
+                     min_prefill_bucket=16, decode_steps_per_tick=4,
+                     prefill_chunk_tokens=chunk,
+                     enable_prefix_cache=prefix_cache),
+    )
+
+
+def _generate(eng: Engine, prompt: list[int], n: int = 6) -> list[int]:
+    done = threading.Event()
+    toks: list[int] = []
+
+    def emit(tok, fin):
+        if tok >= 0:
+            toks.append(tok)
+        if fin is not None:
+            done.set()
+
+    eng.submit(GenRequest(prompt=prompt, max_tokens=n,
+                          sampling=SamplingParams(temperature=0.0),
+                          emit=emit))
+    assert done.wait(timeout=300)
+    return toks
+
+
+def test_chunked_matches_unchunked_greedy():
+    prompt = [(7 * i + 3) % 500 + 1 for i in range(150)]  # > 2 chunks
+    ref_eng = _engine(chunk=0)
+    ref_eng.start()
+    try:
+        ref = _generate(ref_eng, prompt)
+    finally:
+        ref_eng.stop()
+
+    eng = _engine(chunk=64)
+    eng.start()
+    try:
+        got = _generate(eng, prompt)
+        assert eng.stats.chunked_prefill_steps >= 2
+    finally:
+        eng.stop()
+    assert got == ref and len(ref) == 6
+
+
+def test_chunk_boundary_not_multiple_of_page():
+    """Chunk size independent of page_size: odd chunk sizes still
+    produce the right tokens (prefill_suffix takes arbitrary
+    prefix_lens)."""
+    prompt = [(11 * i) % 400 + 2 for i in range(100)]
+    ref_eng = _engine(chunk=0)
+    ref_eng.start()
+    try:
+        ref = _generate(ref_eng, prompt)
+    finally:
+        ref_eng.stop()
+
+    eng = _engine(chunk=24)  # not a multiple of page_size=16
+    eng.start()
+    try:
+        got = _generate(eng, prompt)
+        assert eng.stats.chunked_prefill_steps >= 3
+    finally:
+        eng.stop()
+    assert got == ref
+
+
+def test_chunked_with_prefix_cache_reuse():
+    """Second identical prompt adopts cached pages and only the tail
+    chunks run."""
+    prompt = [(5 * i + 1) % 450 + 1 for i in range(140)]
+    eng = _engine(chunk=48)
+    eng.start()
+    try:
+        first = _generate(eng, prompt)
+        steps_after_first = eng.stats.chunked_prefill_steps
+        second = _generate(eng, prompt)
+        assert second == first
+        assert eng.stats.prefix_cache_hits >= 1
+        # the cached prefix shrinks (or eliminates) the chunk loop
+        assert (eng.stats.chunked_prefill_steps
+                - steps_after_first) <= steps_after_first
+    finally:
+        eng.stop()
+
+
+def test_short_prompt_bypasses_chunking():
+    eng = _engine(chunk=64)
+    eng.start()
+    try:
+        toks = _generate(eng, [5, 9, 11])
+        assert len(toks) == 6
+        assert eng.stats.chunked_prefill_steps == 0
+    finally:
+        eng.stop()
+
+
+def test_cancel_mid_chunking_frees_pages_and_moves_on():
+    """A request cancelled during its chunk loop must not finish
+    prefilling; its pages free and the next request is served."""
+    prompt = [(3 * i + 2) % 400 + 1 for i in range(200)]
+    eng = _engine(chunk=16, prefix_cache=False)
+    eng.start()
+    try:
+        free_before = eng.allocator.free_pages
+
+        done1 = threading.Event()
+        req = GenRequest(prompt=prompt, max_tokens=4,
+                         sampling=SamplingParams(temperature=0.0),
+                         emit=lambda t, f: done1.set() if f else None)
+        req.cancelled.set()  # cancelled before the engine picks it up
+        eng.submit(req)
+
+        toks = _generate(eng, [4, 8, 15], n=4)
+        assert len(toks) == 4
+        # cancelled request's pages all returned
+        for _ in range(200):
+            if eng.allocator.free_pages == free_before - _pages_in_use(
+                    eng):
+                break
+        assert eng.stats.chunked_prefill_steps == 0
+    finally:
+        eng.stop()
+
+
+def _pages_in_use(eng):
+    return sum(len(p) for p in getattr(eng.allocator, "_owned",
+                                       {}).values())
+
+
+def test_moe_family_without_suffix_fn_falls_back():
+    """mixtral has no prefill_suffix: chunking must silently fall back
+    to whole-prompt prefill instead of killing the engine."""
+    from aigw_tpu.models import mixtral
+    from aigw_tpu.models.registry import family_fns, get_model_spec
+
+    spec = get_model_spec("tiny-moe")
+    params = mixtral.init_params(jax.random.PRNGKey(3), spec.config)
+    eng = Engine(
+        params, spec.config,
+        EngineConfig(max_batch_size=2, max_seq_len=256, page_size=16,
+                     min_prefill_bucket=16, decode_steps_per_tick=4,
+                     prefill_chunk_tokens=32),
+        fns=family_fns("mixtral"),
+    )
+    eng.start()
+    try:
+        toks = _generate(eng, [(7 * i) % 200 + 1 for i in range(90)],
+                         n=4)
+        assert len(toks) == 4
+        assert eng.healthy
+        assert eng.stats.chunked_prefill_steps == 0
+    finally:
+        eng.stop()
