@@ -226,8 +226,12 @@ class GatewayServer:
         # (the reference hot-reloads MCPConfig through the same filterapi
         # bundle watcher as routes).
         from aigw_tpu.mcp import MCPConfig, MCPProxy
+        from aigw_tpu.obs.metrics import MCPMetrics
 
-        self.mcp = MCPProxy(MCPConfig.parse(runtime.config.mcp or {}))
+        self.mcp = MCPProxy(
+            MCPConfig.parse(runtime.config.mcp or {}),
+            metrics=MCPMetrics(self.metrics.registry),
+        )
         self.mcp.register(self.app)
         self.app.on_cleanup.append(self._cleanup)
 

@@ -42,6 +42,7 @@ import hashlib
 import json
 import logging
 import secrets
+import time
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -180,8 +181,38 @@ def _rpc_error(id_: Any, code: int, message: str) -> dict[str, Any]:
             "error": {"code": code, "message": message}}
 
 
+def _metric_error_type(status: int) -> str:
+    """HTTP status → MCP error-type attribute (reference
+    metrics.MCPErrorType values)."""
+    return {
+        400: "invalid_param",
+        401: "unauthorized",
+        403: "unauthorized",
+        404: "invalid_session_id",
+        413: "internal_error",
+    }.get(status, "internal_error")
+
+
+def _rpc_error_type(code: Any) -> str:
+    """JSON-RPC error code → MCP error-type attribute (reference
+    handlers.go errorType)."""
+    return {
+        -32601: "unsupported_method",
+        -32602: "invalid_param",
+        -32700: "invalid_json_rpc",
+        -32600: "invalid_json_rpc",
+        -32603: "internal_error",
+        -32000: "invalid_session_id",
+        -32001: "unauthorized",
+    }.get(code, "internal_error")
+
+
 class MCPProxy:
-    def __init__(self, cfg: MCPConfig):
+    def __init__(self, cfg: MCPConfig, metrics: Any = None):
+        #: obs.metrics.MCPMetrics | None — method counts, durations,
+        #: init/capability/progress instruments (reference
+        #: internal/metrics/mcp_metrics.go)
+        self.metrics = metrics
         self.cfg = cfg
         seed = cfg.session_seed
         if not seed:
@@ -553,6 +584,41 @@ class MCPProxy:
 
     # -- HTTP entry -------------------------------------------------------
     async def handle(self, request: web.Request) -> web.StreamResponse:
+        if self.metrics is None:
+            return await self._handle_post(request)
+        t0 = time.monotonic()
+        resp = await self._handle_post(request)
+        method = request.get("mcp_method") or "unknown"
+        # errors surface two ways: HTTP-level (4xx/5xx) and JSON-RPC
+        # error envelopes riding HTTP 200 (unknown tool, backend
+        # failure, internal error) — both must count as errors or a
+        # total backend outage reads as "success" on the dashboard
+        status = "success"
+        err_type = ""
+        if resp.status >= 400:
+            status = "error"
+            err_type = _metric_error_type(resp.status)
+        else:
+            body = getattr(resp, "body", None)
+            if isinstance(body, (bytes, bytearray)) and b'"error"' in body:
+                try:
+                    parsed = json.loads(body)
+                except ValueError:
+                    parsed = None
+                if isinstance(parsed, dict) and parsed.get("error"):
+                    status = "error"
+                    err_type = _rpc_error_type(
+                        (parsed["error"] or {}).get("code"))
+        self.metrics.method_total.labels(method, "", status).inc()
+        self.metrics.request_duration.labels(method).observe(
+            time.monotonic() - t0)
+        if status == "error":
+            self.metrics.errors_total.labels(method, err_type).inc()
+        return resp
+
+    async def _handle_post(
+        self, request: web.Request
+    ) -> web.StreamResponse:
         try:
             payload = json.loads(await request.read())
         except json.JSONDecodeError:
@@ -565,6 +631,10 @@ class MCPProxy:
                 status=400,
             )
         method = payload.get("method", "")
+        # surfaced to the metrics wrapper (client responses have no
+        # method — they are the reverse leg of a server request)
+        request["mcp_method"] = method or (
+            "response" if "id" in payload else "")
         msg_id = payload.get("id")
         is_notification = msg_id is None
 
@@ -704,6 +774,8 @@ class MCPProxy:
     async def _initialize(
         self, payload: dict[str, Any]
     ) -> tuple[dict[str, Any], str]:
+        t0 = time.monotonic()
+
         async def init_one(b: MCPBackend):
             try:
                 resp, session = await self._call_backend(b, payload)
@@ -723,6 +795,20 @@ class MCPProxy:
             *(init_one(b) for b in self.cfg.backends)
         )
         sessions = {name: sid for name, sid, _ in results if sid}
+        if self.metrics is not None:
+            self.metrics.initialization_duration.observe(
+                time.monotonic() - t0)
+            client_caps = (payload.get("params") or {}).get(
+                "capabilities") or {}
+            for cap in client_caps:
+                self.metrics.capabilities_negotiated.labels(
+                    str(cap), "client").inc()
+            for _, _, resp in results:
+                server_caps = ((resp or {}).get("result") or {}).get(
+                    "capabilities") or {}
+                for cap in server_caps:
+                    self.metrics.capabilities_negotiated.labels(
+                        str(cap), "server").inc()
         # listChanged: the proxy emits notifications/tools/list_changed on
         # config hot-reloads (see update_config)
         caps: dict[str, Any] = {"tools": {"listChanged": True}}
@@ -821,6 +907,11 @@ class MCPProxy:
         http = await self._http()
         async with http.post(backend.url, json=routed,
                              headers=headers) as resp:
+            if self.metrics is not None:
+                self.metrics.method_total.labels(
+                    "tools/call", backend.name,
+                    "success" if resp.status < 400 else "error",
+                ).inc()
             ctype = resp.headers.get("content-type", "")
             if resp.status >= 400:
                 raw = await resp.read()
@@ -1088,6 +1179,10 @@ class MCPProxy:
         )
         try:
             await self._call_backend(backend, restored, sid)
+            if self.metrics is not None:
+                # counted only once actually forwarded — rejected or
+                # failed notifications must not corroborate traffic
+                self.metrics.progress_notifications.inc()
         except (aiohttp.ClientError, RuntimeError) as e:
             logger.warning("progress forward to %s failed: %s",
                            backend_name, e)

@@ -79,6 +79,56 @@ class GenAIMetrics:
         return generate_latest(self.registry)
 
 
+class MCPMetrics:
+    """MCP proxy instruments (reference internal/metrics/mcp_metrics.go:
+    ``mcp.request.duration`` / ``mcp.method.count`` /
+    ``mcp.initialization.duration`` / ``mcp.capabilities.negotiated`` /
+    ``mcp.progress.notifications``, with method/backend/status/error
+    attributes). Lives in the gateway's shared registry — scraped via
+    GenAIMetrics.export on /metrics."""
+
+    def __init__(self, registry: CollectorRegistry):
+        self.registry = registry
+        self.method_total = Counter(
+            "mcp_method_total",
+            "JSON-RPC methods handled by the MCP proxy",
+            ["mcp_method_name", "mcp_backend", "status"],
+            registry=self.registry,
+        )
+        self.request_duration = Histogram(
+            "mcp_request_duration_seconds",
+            "MCP request handling duration",
+            ["mcp_method_name"],
+            registry=self.registry,
+            buckets=_LATENCY_BUCKETS,
+        )
+        self.initialization_duration = Histogram(
+            "mcp_initialization_duration_seconds",
+            "MCP session initialization duration (backend fan-out)",
+            [],
+            registry=self.registry,
+            buckets=_LATENCY_BUCKETS,
+        )
+        self.capabilities_negotiated = Counter(
+            "mcp_capabilities_negotiated_total",
+            "Capabilities negotiated at initialize",
+            ["capability_type", "capability_side"],
+            registry=self.registry,
+        )
+        self.progress_notifications = Counter(
+            "mcp_progress_notifications_total",
+            "Progress notifications routed through the proxy",
+            [],
+            registry=self.registry,
+        )
+        self.errors_total = Counter(
+            "mcp_errors_total",
+            "MCP errors by method and type",
+            ["mcp_method_name", "error_type"],
+            registry=self.registry,
+        )
+
+
 @dataclass
 class RequestMetrics:
     """Per-request lifecycle recorder (reference metrics.Metrics interface,

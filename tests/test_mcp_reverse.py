@@ -509,3 +509,78 @@ class TestHotReloadThroughGateway:
                 await s2.stop()
 
         asyncio.run(main())
+
+
+class TestMCPMetrics:
+    def test_methods_and_errors_recorded(self):
+        """MCP metrics parity (reference mcp_metrics.go): method counts
+        with backend/status, durations, init duration, capabilities,
+        and error types — scraped through the gateway's /metrics."""
+        from aigw_tpu.config.model import Config
+        from aigw_tpu.config.runtime import RuntimeConfig
+        from aigw_tpu.gateway.server import GatewayServer
+
+        async def main():
+            s1 = await ReverseMCPServer("alpha", ["t"]).start()
+            rt = RuntimeConfig.build(Config.parse({
+                "routes": [], "backends": [],
+                "mcp": {"backends": [{"name": "alpha", "url": s1.url}],
+                        "session_seed": "m"},
+            }))
+            gw = GatewayServer(rt)
+            runner = web.AppRunner(gw.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            base = f"http://127.0.0.1:{port}"
+            try:
+                session = await _init_session(base + "/mcp")
+                await _rpc(base + "/mcp", "tools/list", {},
+                           session=session)
+                async with aiohttp.ClientSession() as s:
+                    # tools/call → per-backend counter
+                    async with s.post(base + "/mcp", json={
+                        "jsonrpc": "2.0", "id": 3,
+                        "method": "tools/call",
+                        "params": {"name": "alpha__t"},
+                    }, headers={"mcp-session-id": session}) as r:
+                        await r.read()
+                    # an invalid session → error counter
+                    async with s.post(base + "/mcp", json={
+                        "jsonrpc": "2.0", "id": 4,
+                        "method": "tools/list",
+                    }, headers={"mcp-session-id": "garbage"}) as r:
+                        assert r.status == 404
+                    # a JSON-RPC error envelope riding HTTP 200 must
+                    # also count as an error (unknown tool → -32602)
+                    async with s.post(base + "/mcp", json={
+                        "jsonrpc": "2.0", "id": 5,
+                        "method": "tools/call",
+                        "params": {"name": "ghost__nope"},
+                    }, headers={"mcp-session-id": session}) as r:
+                        assert r.status == 200
+                        assert "error" in await r.json()
+                    async with s.get(base + "/metrics") as r:
+                        text = await r.text()
+            finally:
+                await runner.cleanup()
+                await s1.stop()
+            return text
+
+        text = asyncio.run(main())
+        assert ('mcp_method_total{mcp_backend="",'
+                'mcp_method_name="initialize",status="success"}' in text)
+        assert ('mcp_method_total{mcp_backend="alpha",'
+                'mcp_method_name="tools/call",status="success"}' in text)
+        assert 'mcp_initialization_duration_seconds_count 1.0' in text
+        assert ('mcp_errors_total{error_type="invalid_session_id",'
+                'mcp_method_name="tools/list"}' in text)
+        assert ('mcp_errors_total{error_type="invalid_param",'
+                'mcp_method_name="tools/call"}' in text)
+        assert ('mcp_method_total{mcp_backend="",'
+                'mcp_method_name="tools/call",status="error"}' in text)
+        assert 'mcp_request_duration_seconds_count' in text
+        assert ('mcp_capabilities_negotiated_total{'
+                'capability_side="server",capability_type="tools"}'
+                in text)
