@@ -87,6 +87,10 @@ def main(argv: list[str] | None = None) -> int:
                               "ticks interleaved (0 = off)")
     p_serve.add_argument("--decode-steps-per-tick", type=int, default=8,
                          help="fused decode steps per host round-trip")
+    p_serve.add_argument("--spec-tokens", type=int, default=0,
+                         help="prompt-lookup speculative decoding: draft "
+                              "tokens verified per decode step (0 = off); "
+                              "wins on repetitive/extractive generations")
     p_serve.add_argument("--no-prefix-cache", action="store_true",
                          help="disable automatic prompt prefix caching")
     p_serve.add_argument("--lora", action="append", default=[],
@@ -335,6 +339,7 @@ async def _run_tpuserve(args: argparse.Namespace) -> int:
         enable_prefix_cache=not args.no_prefix_cache,
         sp_prefill_min_tokens=args.sp_prefill_min_tokens,
         prefill_chunk_tokens=args.prefill_chunk_tokens,
+        spec_tokens=args.spec_tokens,
     )
     print(f"tpuserve listening on http://{args.host}:{args.port}", flush=True)
     await _wait_for_signal()

@@ -417,6 +417,67 @@ def decode_step(
     return _logits(p, cfg, x[:, 0]), kv_cache
 
 
+def verify_step(
+    p: dict[str, jax.Array],
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [B, S] pending token + S-1 draft tokens
+    positions: jax.Array,  # [B] int32 position of tokens[:, 0]
+    kv_cache: jax.Array,
+    page_table: jax.Array,  # [B, max_pages]
+    page_size: int,
+    active: jax.Array,  # [B] bool slot occupied
+    limits: jax.Array,  # [B] int32 exclusive max write position
+    mlp=None,
+    lora=None,
+    adapter_idx=None,
+) -> tuple[jax.Array, jax.Array]:
+    """Speculative-decoding verifier: score S candidate positions in one
+    step, returning logits at EVERY position ([B, S, V]) so the engine can
+    accept the longest draft prefix that matches the model's own samples.
+
+    KV safety (the reason draft rejection is free on this layout): K/V for
+    all S positions are scattered, but a later step re-scatters any
+    position it revisits *before* the causal gather (``t <= pos``) can see
+    it, so stale writes from rejected drafts are never read. Writes are
+    fenced by ``limits`` exactly like the decode step's page-safety fence.
+    """
+    B, S = tokens.shape
+    T = page_table.shape[1] * page_size
+    n_slots = kv_cache.shape[2]
+    positions = positions[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    valid = active[:, None] & (positions < limits[:, None])  # [B, S]
+
+    slot = (
+        jnp.take_along_axis(page_table, positions // page_size, axis=1)
+        * page_size
+        + positions % page_size
+    )
+    flat = jnp.where(valid, slot, n_slots)  # OOB → dropped by scatter
+
+    gslot = page_table[:, :, None] * page_size + jnp.arange(
+        page_size, dtype=jnp.int32
+    )
+    gslot = gslot.reshape(B, T)
+    t_idx = jnp.arange(T, dtype=jnp.int32)[None, :]
+
+    x = _embed_rows(p, tokens)
+    for i in range(cfg.n_layers):
+        h = rms_norm(x, p[f"l{i}.attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(p, i, h, positions, cfg, lora, adapter_idx)
+        kv_cache = kv_cache.at[i, 0, flat].set(k, mode="drop")
+        kv_cache = kv_cache.at[i, 1, flat].set(v, mode="drop")
+        k_all = kv_cache[i, 0][gslot]  # [B, T, Hkv, D]
+        v_all = kv_cache[i, 1][gslot]
+        mask = (t_idx[:, None, :] <= positions[:, :, None]) & valid[..., None]
+        attn = _attention(q, k_all, v_all, mask)
+        x = x + _wo_project(p, i, attn, lora, adapter_idx)
+        h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
+        x = x + (mlp(p, i, h) if mlp is not None
+                 else _mlp(p, i, h, lora, adapter_idx))
+    x = rms_norm(x, p["norm_f"], cfg.norm_eps)
+    return _logits(p, cfg, x), kv_cache
+
+
 def hidden_states(
     p: dict[str, jax.Array],
     cfg: LlamaConfig,

@@ -160,3 +160,11 @@ def decode_step(p, cfg: MixtralConfig, tokens, positions, kv_cache,
 def hidden_states(p, cfg: MixtralConfig, tokens, seq_lens):
     return llama.hidden_states(p, cfg.as_llama(), tokens, seq_lens,
                                mlp=_mlp_fn(cfg))
+
+
+def verify_step(p, cfg: MixtralConfig, tokens, positions, kv_cache,
+                page_table, page_size, active, limits,
+                lora=None, adapter_idx=None):
+    return llama.verify_step(p, cfg.as_llama(), tokens, positions, kv_cache,
+                             page_table, page_size, active, limits,
+                             mlp=_mlp_fn(cfg))

@@ -39,6 +39,9 @@ class ModelFns:
     # sequence-parallel (ring-attention) prefill for long prompts; None
     # disables the engine's sp prefill path for the family
     prefill_sp: Any = None
+    # multi-position verifier for speculative decoding; None disables the
+    # engine's prompt-lookup speculation for the family
+    verify_step: Any = None
 
 
 def family_fns(family: str) -> ModelFns:
@@ -46,12 +49,14 @@ def family_fns(family: str) -> ModelFns:
         return ModelFns(llama.init_params, llama.prefill, llama.decode_step,
                         llama.hidden_states,
                         prefill_suffix=llama.prefill_suffix,
-                        prefill_sp=llama.prefill_sp)
+                        prefill_sp=llama.prefill_sp,
+                        verify_step=llama.verify_step)
     if family == "mixtral":
         from aigw_tpu.models import mixtral
 
         return ModelFns(mixtral.init_params, mixtral.prefill,
-                        mixtral.decode_step, mixtral.hidden_states)
+                        mixtral.decode_step, mixtral.hidden_states,
+                        verify_step=mixtral.verify_step)
     raise KeyError(f"unknown model family {family!r}")
 
 

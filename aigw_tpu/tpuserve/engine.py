@@ -84,6 +84,11 @@ class EngineConfig:
     # both the largest compiled bucket and how long active streams
     # stall behind a long prompt. 0 disables (whole-prompt prefill).
     prefill_chunk_tokens: int = 0
+    # Prompt-lookup speculative decoding: number of draft tokens verified
+    # per decode step (0 = off). Each step verifies 1+spec_tokens
+    # positions in one fixed-shape program and advances by the accepted
+    # count — see tpuserve/speculation.py.
+    spec_tokens: int = 0
 
     def __post_init__(self) -> None:
         if self.max_seq_len % self.page_size != 0:
@@ -137,6 +142,9 @@ class _Slot:
     # rebuilds across admissions)
     token_counts: dict[int, int] = field(default_factory=dict)
     adapter_row: int = 0
+    # ordered generated tokens (speculation rebuilds the on-device
+    # history buffer from prompt + these across admissions)
+    gen_tokens: list[int] = field(default_factory=list)
 
 
 @dataclass
@@ -146,6 +154,9 @@ class EngineStats:
     kv_pages_free: int = 0
     kv_occupancy: float = 0.0
     tokens_generated: int = 0
+    # extra tokens landed by accepted speculative drafts (beyond the one
+    # token per step the plain decode path yields)
+    spec_accepted: int = 0
     prefills: int = 0
     sp_prefills: int = 0  # prefills routed through ring attention
     chunked_prefill_steps: int = 0  # intermediate chunk device steps
@@ -343,10 +354,108 @@ class Engine:
             )
             return sampled, state, kv
 
+        # prompt-lookup speculation (tpuserve/speculation.py): replaces
+        # the [B, 1] decode step with a [B, D+1] verify step that advances
+        # by the accepted draft count. Same fixed-geometry contract — one
+        # compiled program for the engine lifetime.
+        self._spec = (
+            cfg.spec_tokens
+            if cfg.spec_tokens > 0 and self.fns.verify_step is not None
+            else 0
+        )
+        model_verify = self.fns.verify_step
+        D = self._spec
+        V = model_cfg.vocab_size
+        H = cfg.max_seq_len
+
+        def _spec_scan(params, lora, kv, state):
+            """K speculative steps; outputs (sampled [K, B, D+1],
+            n_emit [K, B]) — the host emits sampled[k, b, :n_emit[k, b]]."""
+            from aigw_tpu.tpuserve.speculation import (
+                accept_counts,
+                ngram_drafts,
+            )
+
+            D1 = D + 1
+
+            def body(carry, _):
+                kv, st = carry
+                act = st["active"] & (st["positions"] < st["limits"])
+                # penalty slots advance exactly one token per step (see
+                # speculation.py module docstring): poison their drafts
+                elig = (st["freq_pen"] == 0.0) & (st["pres_pen"] == 0.0)
+                drafts = ngram_drafts(st["history"], st["positions"], D)
+                drafts = jnp.where(elig[:, None], drafts, -1)
+                inputs = jnp.concatenate(
+                    [st["tokens"][:, None], jnp.maximum(drafts, 0)], axis=1
+                )
+                logits_all, kv = model_verify(
+                    params, mc, inputs, st["positions"], kv,
+                    st["page_table"], ps, act, st["limits"],
+                    lora=lora, adapter_idx=st["adapter_idx"],
+                )  # [B, D1, V]
+                # counts are window-start values: exact at d=0, and later
+                # positions only accept on penalty-free slots where the
+                # count term is zero anyway
+                lT = logits_all.transpose(1, 0, 2)  # [D1, B, V]
+                lT = jax.vmap(
+                    lambda l: apply_penalties(
+                        l, st["counts"], st["freq_pen"], st["pres_pen"],
+                        st["bias"],
+                    )
+                )(lT)
+                # per-position keys [seed, pos+d] — the same key the
+                # non-speculative path would use at that position, so
+                # accepted tokens are bit-identical to plain decoding
+                offs = jnp.arange(D1, dtype=jnp.uint32)
+                keys_d = (
+                    jnp.broadcast_to(st["keys"], (D1,) + st["keys"].shape)
+                    .at[:, :, 1].add(offs[:, None])
+                )
+                sampled = jax.vmap(
+                    lambda l, k: sample(l, k, st["temp"], st["top_p"],
+                                        st["top_k"])
+                )(lT, keys_d).T  # [B, D1]
+                n_acc = accept_counts(drafts, sampled)
+                n_emit = jnp.where(
+                    act,
+                    jnp.minimum(n_acc + 1, st["limits"] - st["positions"]),
+                    0,
+                )
+                B = sampled.shape[0]
+                rows = jnp.arange(B)
+                new_pending = sampled[rows, jnp.clip(n_emit - 1, 0, D)]
+                d_idx = jnp.arange(D1, dtype=jnp.int32)[None, :]
+                emit_mask = d_idx < n_emit[:, None]  # [B, D1]
+                # sampled[d] is the token at position pos+1+d
+                wpos = jnp.where(emit_mask,
+                                 st["positions"][:, None] + 1 + d_idx, H)
+                history = st["history"].at[rows[:, None], wpos].set(
+                    sampled, mode="drop"
+                )
+                counts = st["counts"].at[
+                    rows[:, None], jnp.where(emit_mask, sampled, V)
+                ].add(1, mode="drop")
+                new = dict(
+                    st,
+                    tokens=jnp.where(n_emit > 0, new_pending, st["tokens"]),
+                    positions=st["positions"] + n_emit,
+                    keys=st["keys"].at[:, 1].add(n_emit.astype(jnp.uint32)),
+                    counts=counts,
+                    history=history,
+                )
+                return (kv, new), (sampled, n_emit)
+
+            (kv, state), out = jax.lax.scan(body, (kv, state), None,
+                                            length=K)
+            return out, state, kv
+
         self._prefill_fn = jax.jit(_prefill_step, donate_argnums=(4,))
         self._prefill_suffix_fn = jax.jit(_prefill_suffix_step,
                                           donate_argnums=(5,))
-        self._decode_fn = jax.jit(_decode_scan, donate_argnums=(2, 3))
+        self._decode_fn = jax.jit(
+            _spec_scan if self._spec else _decode_scan, donate_argnums=(2, 3)
+        )
 
     # -- public API -------------------------------------------------------
     def start(self) -> None:
@@ -715,7 +824,21 @@ class Engine:
                 if 0 <= tok_id < V:
                     bias[i, tok_id] = b
             adapter_idx[i] = s.adapter_row
-        return {
+        state_extra: dict[str, jax.Array] = {}
+        if self._spec:
+            # speculation history: prompt + generated tokens, valid
+            # through the pending token's position
+            history = np.zeros((B, self.cfg.max_seq_len), np.int32)
+            for i, s in enumerate(self._slots):
+                if s is None:
+                    continue
+                pr = s.req.prompt
+                history[i, : len(pr)] = pr
+                history[i, len(pr): len(pr) + len(s.gen_tokens)] = (
+                    s.gen_tokens
+                )
+            state_extra["history"] = jnp.asarray(history)
+        return state_extra | {
             "tokens": jnp.asarray(tokens),
             "positions": jnp.asarray(positions),
             "limits": jnp.asarray(limits),
@@ -732,9 +855,12 @@ class Engine:
             "adapter_idx": jnp.asarray(adapter_idx),
         }
 
-    def _process_window(self, sampled: jax.Array) -> None:
+    def _process_window(self, sampled) -> None:
         """Consume one decode window's sampled tokens (blocks until the
         device finishes that window)."""
+        if isinstance(sampled, tuple):  # speculative window
+            self._process_spec_window(*sampled)
+            return
         toks = np.asarray(sampled)  # [K, B]
         K = toks.shape[0]
         self.stats.decode_steps += K
@@ -745,6 +871,29 @@ class Engine:
                 if not s.started:
                     continue  # admitted after this window was dispatched
                 self._emit_token(i, int(toks[k, i]))
+
+    def _process_spec_window(self, sampled: jax.Array,
+                             n_emit: jax.Array) -> None:
+        """Speculative window: sampled [K, B, D+1], n_emit [K, B] — the
+        leading n_emit tokens of each row are model-exact; the rest are
+        conditioned on rejected drafts and discarded."""
+        toks = np.asarray(sampled)
+        counts = np.asarray(n_emit)
+        K = toks.shape[0]
+        self.stats.decode_steps += K
+        for k in range(K):
+            for i, s in enumerate(self._slots):
+                if s is None or not s.started:
+                    continue
+                n = int(counts[k, i])
+                emitted = 0
+                for d in range(n):
+                    if self._slots[i] is None:
+                        break  # EOS/stop consumed the slot mid-burst
+                    self._emit_token(i, int(toks[k, i, d]))
+                    emitted += 1
+                if emitted > 1:
+                    self.stats.spec_accepted += emitted - 1
 
     def _drain_inflight(self) -> None:
         if self._inflight is not None:
@@ -814,6 +963,7 @@ class Engine:
             # the sampled token is the input of the next decode step
             s.pending_token = tok
             s.token_counts[tok] = s.token_counts.get(tok, 0) + 1
+            s.gen_tokens.append(tok)
 
     def _refresh_stats(self) -> None:
         self.stats.queued = self._queue.qsize()

@@ -682,6 +682,7 @@ class TPUServeServer:
             ("tpuserve_chunked_prefill_steps_total",
              s.chunked_prefill_steps),
             ("tpuserve_decode_steps_total", s.decode_steps),
+            ("tpuserve_spec_accepted_total", s.spec_accepted),
             ("tpuserve_prefix_cache_hits_total", s.prefix_cache_hits),
             ("tpuserve_prefix_tokens_reused_total", s.prefix_tokens_reused),
         ):
@@ -707,6 +708,7 @@ async def run_tpuserve(
     enable_prefix_cache: bool = True,
     sp_prefill_min_tokens: int = 1024,
     prefill_chunk_tokens: int = 0,
+    spec_tokens: int = 0,
 ) -> web.AppRunner:
     server = TPUServeServer(
         model,
@@ -719,6 +721,7 @@ async def run_tpuserve(
             enable_prefix_cache=enable_prefix_cache,
             sp_prefill_min_tokens=sp_prefill_min_tokens,
             prefill_chunk_tokens=prefill_chunk_tokens,
+            spec_tokens=spec_tokens,
         ),
         tp=tp,
         ep=ep,
