@@ -141,6 +141,17 @@ class TPUServeServer:
             lambda p, t, l: hidden(p, self.model_cfg, t, l)
         )
 
+        # host-overlap: encode/template/decode run on a worker pool, not
+        # the event loop — a long prompt's tokenization (or a big final
+        # detokenize) must not stall every other connection's IO. The HF
+        # tokenizer is native and releases the GIL, so this is true
+        # parallelism for real checkpoints.
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._tok_pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="tpuserve-tok"
+        )
+
         self.app = web.Application()
         self.app.router.add_post("/v1/chat/completions", self._chat)
         self.app.router.add_post("/v1/completions", self._completions)
@@ -220,6 +231,7 @@ class TPUServeServer:
 
     async def _on_stop(self, _app) -> None:
         self.engine.stop()
+        self._tok_pool.shutdown(wait=False)
 
     # -- helpers ----------------------------------------------------------
     def _submit(self, prompt: list[int], body: dict[str, Any]):
@@ -254,9 +266,17 @@ class TPUServeServer:
         except oai.SchemaError as e:
             return web.Response(status=400, body=oai.error_body(str(e)),
                                 content_type="application/json")
-        prompt = apply_chat_template(body["messages"], self.tokenizer,
-                                     self.chat_template)
+        prompt = await self._off(
+            apply_chat_template, body["messages"], self.tokenizer,
+            self.chat_template,
+        )
         return await self._generate(request, body, prompt, chat=True)
+
+    async def _off(self, fn, *args):
+        """Run a tokenization-bound callable off the event loop."""
+        return await asyncio.get_running_loop().run_in_executor(
+            self._tok_pool, fn, *args
+        )
 
     async def _completions(self, request: web.Request) -> web.StreamResponse:
         try:
@@ -268,7 +288,9 @@ class TPUServeServer:
         prompt_text = body.get("prompt", "")
         if isinstance(prompt_text, list):
             prompt_text = "".join(prompt_text)
-        prompt = [self.tokenizer.bos_id] + self.tokenizer.encode(prompt_text)
+        prompt = [self.tokenizer.bos_id] + await self._off(
+            self.tokenizer.encode, prompt_text
+        )
         return await self._generate(request, body, prompt, chat=False)
 
     async def _generate(
@@ -576,10 +598,18 @@ class TPUServeServer:
                 content_type="application/json",
             )
         max_len = self.engine.cfg.max_seq_len
+        # encode all string items concurrently on the tokenizer pool
+        str_jobs = {
+            idx: self._off(self.tokenizer.encode, it)
+            for idx, it in enumerate(items) if isinstance(it, str)
+        }
+        str_results = dict(zip(
+            str_jobs, await asyncio.gather(*str_jobs.values())
+        ))
         encoded = []
-        for it in items:
+        for idx, it in enumerate(items):
             if isinstance(it, str):
-                encoded.append(self.tokenizer.encode(it)[:max_len])
+                encoded.append(str_results[idx][:max_len])
             elif isinstance(it, list) and all(isinstance(x, int) for x in it):
                 encoded.append([x % self.model_cfg.vocab_size for x in it][:max_len])
             else:
@@ -618,10 +648,11 @@ class TPUServeServer:
             return web.Response(status=400, body=oai.error_body(str(e)),
                                 content_type="application/json")
         if isinstance(body.get("messages"), list):
-            ids = apply_chat_template(body["messages"], self.tokenizer,
-                                      self.chat_template)
+            ids = await self._off(apply_chat_template, body["messages"],
+                                  self.tokenizer, self.chat_template)
         else:
-            ids = self.tokenizer.encode(str(body.get("prompt", "")))
+            ids = await self._off(self.tokenizer.encode,
+                                  str(body.get("prompt", "")))
         return web.json_response(
             {
                 "count": len(ids),

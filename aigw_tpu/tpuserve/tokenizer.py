@@ -111,31 +111,46 @@ class StreamingDecoder:
     """Incremental detokenizer: emits only text that can no longer change.
 
     Token-by-token ``decode([tok])`` corrupts multi-byte UTF-8 characters
-    and multi-token graphemes; instead the full id list is re-decoded and
-    the stable prefix delta is emitted. Text ending in U+FFFD is held back
-    until the continuation token arrives.
+    and multi-token graphemes; re-decoding the FULL id list per token is
+    O(n\u00b2) per stream and runs on the server's event loop. Instead only a
+    sliding window is re-decoded (the ids since the last committed
+    boundary): the emitted delta is ``decode(window + [tok])`` minus
+    ``decode(window)``, and the window resets whenever its text is stable
+    \u2014 so per-token cost is O(window), independent of generation length.
+    Text ending in U+FFFD (a partial UTF-8 character or an un-mergeable
+    token boundary) is held back until the continuation arrives.
     """
 
     def __init__(self, tokenizer: Tokenizer):
         self._t = tokenizer
         self._ids: list[int] = []
-        self._sent = 0
+        # two lagging pointers: ids[:prefix] are fully emitted;
+        # ids[prefix:read] is the context overlap whose text is
+        # subtracted from each new decode so tokenizer boundary
+        # artifacts (BPE merges, leading-space handling) cancel out
+        self._prefix = 0
+        self._read = 0
 
     def push(self, token_id: int) -> str:
         self._ids.append(token_id)
-        text = self._t.decode(self._ids)
-        # hold back a possibly-incomplete trailing character
-        if text.endswith("\ufffd"):
-            stable = text[: text.rindex("\ufffd")]
-        else:
-            stable = text
-        out = stable[self._sent :]
-        if out:
-            self._sent = len(stable)
-        return out
+        new_text = self._t.decode(self._ids[self._prefix:])
+        # A trailing U+FFFD is *probably* a partial UTF-8 char or an
+        # unfinished merge \u2014 hold it back. But only for a bounded number
+        # of tokens: a model legitimately emitting replacement chars (or
+        # a stream of invalid bytes) must neither stall the client nor
+        # regrow the decode window; real partial characters complete
+        # within a few tokens.
+        if new_text.endswith("\ufffd") and len(self._ids) - self._read < 8:
+            return ""
+        prefix_text = self._t.decode(self._ids[self._prefix: self._read])
+        if len(new_text) <= len(prefix_text):
+            return ""
+        self._prefix = self._read
+        self._read = len(self._ids)
+        return new_text[len(prefix_text):]
 
     def flush(self) -> str:
-        text = self._t.decode(self._ids)
-        out = text[self._sent :]
-        self._sent = len(text)
-        return out
+        new_text = self._t.decode(self._ids[self._prefix:])
+        prefix_text = self._t.decode(self._ids[self._prefix: self._read])
+        self._prefix = self._read = len(self._ids)
+        return new_text[len(prefix_text):]

@@ -345,6 +345,51 @@ class TestServerRobustness:
         out = "".join(d.push(b) for b in emoji) + d.flush()
         assert out == "héllo 🌍"
 
+    def test_streaming_decoder_invalid_byte_passes_through(self):
+        from aigw_tpu.tpuserve.tokenizer import ByteTokenizer, StreamingDecoder
+
+        d = StreamingDecoder(ByteTokenizer())
+        seq = list("ab".encode()) + [0xFF] + list("cd".encode())
+        out = "".join(d.push(b) for b in seq) + d.flush()
+        assert out == "ab�cd"
+
+    def test_streaming_decoder_is_windowed(self):
+        """Per-token decode cost must not grow with stream length (the
+        decoder re-decodes a small lagging window, not the full list)."""
+        from aigw_tpu.tpuserve.tokenizer import ByteTokenizer, StreamingDecoder
+
+        class Counting(ByteTokenizer):
+            max_window = 0
+
+            def decode(self, ids):
+                Counting.max_window = max(Counting.max_window, len(ids))
+                return super().decode(ids)
+
+        d = StreamingDecoder(Counting())
+        for b in ("x" * 5000).encode():
+            d.push(b)
+        d.flush()
+        assert Counting.max_window < 16, Counting.max_window
+
+    def test_streaming_decoder_fffd_run_neither_stalls_nor_grows(self):
+        """A stream of invalid bytes (every decode ends in U+FFFD) must
+        keep emitting progressively and keep the window bounded."""
+        from aigw_tpu.tpuserve.tokenizer import ByteTokenizer, StreamingDecoder
+
+        class Counting(ByteTokenizer):
+            max_window = 0
+
+            def decode(self, ids):
+                Counting.max_window = max(Counting.max_window, len(ids))
+                return super().decode(ids)
+
+        d = StreamingDecoder(Counting())
+        out = "".join(d.push(0x80) for _ in range(1000))
+        assert len(out) >= 900  # emitted during the stream, not at flush
+        out += d.flush()
+        assert out == "�" * 1000
+        assert Counting.max_window < 40, Counting.max_window
+
 
 class TestPrefixCache:
     """Automatic prefix caching: shared prompt prefixes skip recompute and
