@@ -57,6 +57,20 @@ def main(argv: list[str] | None = None) -> int:
     p_conv.add_argument("hf_dir")
     p_conv.add_argument("out_dir")
 
+    p_core = sub.add_parser(
+        "core-config",
+        help="compile the native proxy core's config (native/aigw-core "
+             "serves eligible routes in C++; the rest fall back to the "
+             "Python gateway)")
+    p_core.add_argument("config")
+    p_core.add_argument("-o", "--out", default="aigw-core.json")
+    p_core.add_argument("--listen-host", default="0.0.0.0")
+    p_core.add_argument("--listen-port", type=int, default=1975)
+    p_core.add_argument("--fallback-host", default="127.0.0.1")
+    p_core.add_argument("--fallback-port", type=int, default=1976,
+                        help="where the Python gateway listens (run it "
+                             "with --port matching this)")
+
     p_serve = sub.add_parser("tpuserve", help="run the TPU serving engine")
     p_serve.add_argument("--model", required=True,
                          help="model name or path (see aigw_tpu.models)")
@@ -139,6 +153,32 @@ def main(argv: list[str] | None = None) -> int:
             print(f"UNHEALTHY: {data}", file=sys.stderr)
             return 1
         print(_json.dumps(data))
+        return 0
+
+    if args.cmd == "core-config":
+        from aigw_tpu.config.model import ConfigError, load_config
+        from aigw_tpu.config.nativecore import (
+            compile_core_config,
+            write_core_config,
+        )
+
+        try:
+            cfg = load_config(args.config)
+        except ConfigError as e:
+            print(f"INVALID: {e}", file=sys.stderr)
+            return 1
+        core, skipped = compile_core_config(
+            cfg,
+            listen_host=args.listen_host,
+            listen_port=args.listen_port,
+            fallback_host=args.fallback_host,
+            fallback_port=args.fallback_port,
+        )
+        write_core_config(args.out, core)
+        print(f"{args.out}: {len(core['rules'])} native rules, "
+              f"fallback {args.fallback_host}:{args.fallback_port}")
+        for s in skipped:
+            print(f"  python-path: {s}")
         return 0
 
     if args.cmd == "translate":

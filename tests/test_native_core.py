@@ -1,0 +1,595 @@
+"""Native proxy core (native/proxy_core.cpp) + its config compiler.
+
+Drives the real compiled binary against live aiohttp fake upstreams:
+routing, auth injection, weighted/priority failover, SSE relay,
+keep-alive, fallback behavior, and key-file rotation. The compiler tests
+pin the conservative eligibility rules (anything inexpressible stays on
+the Python path — first non-eligible rule stops compilation so
+first-match-wins order is never violated).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import socket
+import subprocess
+import time
+
+import pytest
+from aiohttp import web
+
+from aigw_tpu.config.model import Config
+from aigw_tpu.config.nativecore import compile_core_config
+
+CORE_BIN = os.path.join(os.path.dirname(__file__), "..", "native",
+                        "aigw-core")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(CORE_BIN),
+    reason="native/aigw-core not built (run `make native`)",
+)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+async def start_upstream(marker: str, port: int, fail_status: int = 0):
+    """Fake upstream: echoes a marker + request details; optional
+    always-fail mode; /sse streams events with flushes."""
+
+    async def handler(request: web.Request) -> web.StreamResponse:
+        if fail_status:
+            return web.json_response({"error": "down"}, status=fail_status)
+        body = await request.read()
+        try:
+            parsed = json.loads(body) if body else {}
+        except ValueError:
+            parsed = {}
+        if parsed.get("stream"):
+            resp = web.StreamResponse(
+                status=200,
+                headers={"content-type": "text/event-stream"})
+            await resp.prepare(request)
+            for i in range(3):
+                await resp.write(
+                    f"data: {json.dumps({'marker': marker, 'i': i})}\n\n"
+                    .encode())
+                await asyncio.sleep(0.02)
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
+        return web.json_response({
+            "marker": marker,
+            "model": parsed.get("model"),
+            "auth": request.headers.get("authorization", ""),
+            "xkey": request.headers.get("x-extra", ""),
+            "host": request.headers.get("host", ""),
+            "path": request.path,
+        })
+
+    app = web.Application()
+    app.router.add_route("*", "/{tail:.*}", handler)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", port)
+    await site.start()
+    return runner
+
+
+def start_core(cfg: dict, tmp_path) -> subprocess.Popen:
+    path = tmp_path / "core.json"
+    path.write_text(json.dumps(cfg))
+    proc = subprocess.Popen(
+        [CORE_BIN, str(path)], stderr=subprocess.PIPE, text=True)
+    line = proc.stderr.readline()
+    assert "listening" in line, line
+    return proc
+
+
+@pytest.fixture
+def ports():
+    return {k: free_port() for k in
+            ("core", "up_a", "up_b", "up_fail", "fallback")}
+
+
+@pytest.fixture
+def core_cfg(ports, tmp_path):
+    key_file = tmp_path / "apikey"
+    key_file.write_text("sk-native-test\n")
+    return {
+        "listen_host": "127.0.0.1",
+        "listen_port": ports["core"],
+        "fallback_host": "127.0.0.1",
+        "fallback_port": ports["fallback"],
+        "endpoints": ["/v1/chat/completions", "/v1/completions",
+                      "/v1/embeddings"],
+        "rules": [
+            {
+                "model_exact": "m-a",
+                "backends": [{
+                    "name": "a", "host": "127.0.0.1",
+                    "port": ports["up_a"], "weight": 1, "priority": 0,
+                    "auth_headers": [{
+                        "name": "authorization", "prefix": "Bearer ",
+                        "value_file": str(key_file)}],
+                    "set_headers": [{"name": "x-extra", "value": "on"}],
+                }],
+            },
+            {
+                "model_prefix": "pfx-",
+                "backends": [{
+                    "name": "b", "host": "127.0.0.1",
+                    "port": ports["up_b"], "weight": 1, "priority": 0,
+                }],
+            },
+            {
+                "model_exact": "m-failover",
+                "backends": [
+                    {"name": "bad", "host": "127.0.0.1",
+                     "port": ports["up_fail"], "priority": 0},
+                    {"name": "good", "host": "127.0.0.1",
+                     "port": ports["up_b"], "priority": 1},
+                ],
+            },
+        ],
+    }
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _post(session, port, path, body, headers=None):
+    async with session.post(
+        f"http://127.0.0.1:{port}{path}", json=body, headers=headers or {}
+    ) as r:
+        return r.status, await r.read()
+
+
+class TestNativeCore:
+    def test_routing_auth_and_keepalive(self, ports, core_cfg,
+                                              tmp_path):
+        run(self._test_routing_auth_and_keepalive(ports, core_cfg, tmp_path))
+
+    async def _test_routing_auth_and_keepalive(self, ports, core_cfg,
+                                              tmp_path):
+        import aiohttp
+
+        up_a = await start_upstream("A", ports["up_a"])
+        proc = start_core(core_cfg, tmp_path)
+        try:
+            async with aiohttp.ClientSession() as s:
+                for _ in range(2):  # two requests over one client conn
+                    status, body = await _post(
+                        s, ports["core"], "/v1/chat/completions",
+                        {"model": "m-a"})
+                    assert status == 200
+                    got = json.loads(body)
+                    assert got["marker"] == "A"
+                    assert got["auth"] == "Bearer sk-native-test"
+                    assert got["xkey"] == "on"
+        finally:
+            proc.kill()
+            await up_a.cleanup()
+
+    def test_model_prefix_and_header_override(self, ports, core_cfg,
+                                                    tmp_path):
+        run(self._test_model_prefix_and_header_override(ports, core_cfg, tmp_path))
+
+    async def _test_model_prefix_and_header_override(self, ports, core_cfg,
+                                                    tmp_path):
+        import aiohttp
+
+        up_b = await start_upstream("B", ports["up_b"])
+        fb = await start_upstream("PY", ports["fallback"])
+        proc = start_core(core_cfg, tmp_path)
+        try:
+            async with aiohttp.ClientSession() as s:
+                status, body = await _post(
+                    s, ports["core"], "/v1/completions",
+                    {"model": "pfx-anything"})
+                assert status == 200
+                assert json.loads(body)["marker"] == "B"
+                # a client-supplied x-aigw-model header is NOT trusted
+                # (the python gateway overwrites it from the body) — the
+                # body model decides, so this goes to the fallback
+                status, body = await _post(
+                    s, ports["core"], "/v1/completions",
+                    {"model": "nomatch"},
+                    headers={"x-aigw-model": "pfx-h"})
+                assert status == 200
+                assert json.loads(body)["marker"] == "PY"
+        finally:
+            proc.kill()
+            await up_b.cleanup()
+            await fb.cleanup()
+
+    def test_priority_failover(self, ports, core_cfg, tmp_path):
+        run(self._test_priority_failover(ports, core_cfg, tmp_path))
+
+    async def _test_priority_failover(self, ports, core_cfg, tmp_path):
+        import aiohttp
+
+        up_fail = await start_upstream("F", ports["up_fail"],
+                                       fail_status=503)
+        up_b = await start_upstream("B", ports["up_b"])
+        proc = start_core(core_cfg, tmp_path)
+        try:
+            async with aiohttp.ClientSession() as s:
+                status, body = await _post(
+                    s, ports["core"], "/v1/chat/completions",
+                    {"model": "m-failover"})
+                assert status == 200
+                assert json.loads(body)["marker"] == "B"
+                async with s.get(
+                    f"http://127.0.0.1:{ports['core']}/aigw-core/stats"
+                ) as r:
+                    stats = json.loads(await r.read())
+                assert stats["retries"] >= 1
+                assert stats["native_requests"] >= 1
+        finally:
+            proc.kill()
+            await up_fail.cleanup()
+            await up_b.cleanup()
+
+    def test_unmatched_and_gets_fall_back(self, ports, core_cfg,
+                                                tmp_path):
+        run(self._test_unmatched_and_gets_fall_back(ports, core_cfg, tmp_path))
+
+    async def _test_unmatched_and_gets_fall_back(self, ports, core_cfg,
+                                                tmp_path):
+        import aiohttp
+
+        fb = await start_upstream("PY", ports["fallback"])
+        proc = start_core(core_cfg, tmp_path)
+        try:
+            async with aiohttp.ClientSession() as s:
+                # unknown model → python gateway
+                status, body = await _post(
+                    s, ports["core"], "/v1/chat/completions",
+                    {"model": "unknown"},
+                    headers={"host": "api.example.com"})
+                assert status == 200
+                got = json.loads(body)
+                assert got["marker"] == "PY"
+                # the client's Host survives the relay (route scoping)
+                assert got["host"] == "api.example.com"
+                # GET endpoints always fall back
+                async with s.get(
+                    f"http://127.0.0.1:{ports['core']}/v1/models"
+                ) as r:
+                    assert r.status == 200
+                    assert json.loads(await r.read())["marker"] == "PY"
+        finally:
+            proc.kill()
+            await fb.cleanup()
+
+    def test_sse_streaming_relay(self, ports, core_cfg, tmp_path):
+        run(self._test_sse_streaming_relay(ports, core_cfg, tmp_path))
+
+    async def _test_sse_streaming_relay(self, ports, core_cfg, tmp_path):
+        import aiohttp
+
+        up_a = await start_upstream("A", ports["up_a"])
+        proc = start_core(core_cfg, tmp_path)
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"http://127.0.0.1:{ports['core']}/v1/chat/completions",
+                    json={"model": "m-a", "stream": True},
+                ) as r:
+                    assert r.status == 200
+                    assert "text/event-stream" in r.headers["content-type"]
+                    text = (await r.read()).decode()
+        finally:
+            proc.kill()
+            await up_a.cleanup()
+        events = [e for e in text.split("\n\n") if e.strip()]
+        assert len(events) == 4 and events[-1] == "data: [DONE]"
+
+    def test_key_file_rotation(self, ports, core_cfg, tmp_path):
+        run(self._test_key_file_rotation(ports, core_cfg, tmp_path))
+
+    async def _test_key_file_rotation(self, ports, core_cfg, tmp_path):
+        import aiohttp
+
+        up_a = await start_upstream("A", ports["up_a"])
+        proc = start_core(core_cfg, tmp_path)
+        try:
+            async with aiohttp.ClientSession() as s:
+                _, body = await _post(s, ports["core"],
+                                      "/v1/chat/completions",
+                                      {"model": "m-a"})
+                assert json.loads(body)["auth"] == "Bearer sk-native-test"
+                key_file = tmp_path / "apikey"
+                key_file.write_text("sk-rotated\n")
+                # force a distinct mtime even on coarse filesystems
+                st = key_file.stat()
+                os.utime(key_file, (st.st_atime, st.st_mtime + 2))
+                _, body = await _post(s, ports["core"],
+                                      "/v1/chat/completions",
+                                      {"model": "m-a"})
+                assert json.loads(body)["auth"] == "Bearer sk-rotated"
+        finally:
+            proc.kill()
+            await up_a.cleanup()
+
+    def test_all_backends_down_503(self, ports, core_cfg, tmp_path):
+        run(self._test_all_backends_down_503(ports, core_cfg, tmp_path))
+
+    async def _test_all_backends_down_503(self, ports, core_cfg, tmp_path):
+        import aiohttp
+
+        # nothing listening on up_a's port
+        proc = start_core(core_cfg, tmp_path)
+        try:
+            async with aiohttp.ClientSession() as s:
+                status, body = await _post(
+                    s, ports["core"], "/v1/chat/completions",
+                    {"model": "m-a"})
+                assert status == 503
+                assert b"no upstream available" in body
+        finally:
+            proc.kill()
+
+    def test_exhausted_retries_relay_real_error(self, ports, core_cfg,
+                                                tmp_path):
+        run(self._test_exhausted_retries_relay_real_error(
+            ports, core_cfg, tmp_path))
+
+    async def _test_exhausted_retries_relay_real_error(self, ports,
+                                                       core_cfg, tmp_path):
+        """Every candidate 429s → the client gets the real upstream 429
+        body, not a synthesized 503 (python _attempt_loop behavior)."""
+        import aiohttp
+
+        up = await start_upstream("F", ports["up_a"], fail_status=429)
+        proc = start_core(core_cfg, tmp_path)
+        try:
+            async with aiohttp.ClientSession() as s:
+                status, body = await _post(
+                    s, ports["core"], "/v1/chat/completions",
+                    {"model": "m-a"})
+                assert status == 429
+                assert json.loads(body)["error"] == "down"
+        finally:
+            proc.kill()
+            await up.cleanup()
+
+    def test_fallback_statuses_are_authoritative(self, ports, core_cfg,
+                                                 tmp_path):
+        run(self._test_fallback_statuses_are_authoritative(
+            ports, core_cfg, tmp_path))
+
+    async def _test_fallback_statuses_are_authoritative(self, ports,
+                                                        core_cfg,
+                                                        tmp_path):
+        """The python gateway's 429 relays to the client untouched — the
+        core must not fail over or mask it."""
+        import aiohttp
+
+        fb = await start_upstream("PY", ports["fallback"], fail_status=429)
+        proc = start_core(core_cfg, tmp_path)
+        try:
+            async with aiohttp.ClientSession() as s:
+                status, body = await _post(
+                    s, ports["core"], "/v1/chat/completions",
+                    {"model": "unrouted"})
+                assert status == 429
+                assert json.loads(body)["error"] == "down"
+        finally:
+            proc.kill()
+            await fb.cleanup()
+
+    def test_head_request_via_fallback(self, ports, core_cfg, tmp_path):
+        run(self._test_head_request_via_fallback(ports, core_cfg, tmp_path))
+
+    async def _test_head_request_via_fallback(self, ports, core_cfg,
+                                              tmp_path):
+        """HEAD responses carry Content-Length but no body — the relay
+        must not wait for bytes that never come."""
+        import aiohttp
+
+        fb = await start_upstream("PY", ports["fallback"])
+        proc = start_core(core_cfg, tmp_path)
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.head(
+                    f"http://127.0.0.1:{ports['core']}/v1/models",
+                    timeout=aiohttp.ClientTimeout(total=10),
+                ) as r:
+                    assert r.status == 200
+        finally:
+            proc.kill()
+            await fb.cleanup()
+
+    def test_expect_100_continue(self, ports, core_cfg, tmp_path):
+        run(self._test_expect_100_continue(ports, core_cfg, tmp_path))
+
+    async def _test_expect_100_continue(self, ports, core_cfg, tmp_path):
+        import aiohttp
+
+        up_a = await start_upstream("A", ports["up_a"])
+        proc = start_core(core_cfg, tmp_path)
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"http://127.0.0.1:{ports['core']}/v1/chat/completions",
+                    json={"model": "m-a"}, expect100=True,
+                    timeout=aiohttp.ClientTimeout(total=10),
+                ) as r:
+                    assert r.status == 200
+                    assert json.loads(await r.read())["marker"] == "A"
+        finally:
+            proc.kill()
+            await up_a.cleanup()
+
+    def test_drained_backend_gets_no_traffic(self, ports, tmp_path):
+        run(self._test_drained_backend_gets_no_traffic(ports, tmp_path))
+
+    async def _test_drained_backend_gets_no_traffic(self, ports, tmp_path):
+        import aiohttp
+
+        up_a = await start_upstream("A", ports["up_a"])
+        up_b = await start_upstream("B", ports["up_b"])
+        cfg = {
+            "listen_host": "127.0.0.1", "listen_port": ports["core"],
+            "fallback_host": "127.0.0.1",
+            "fallback_port": ports["fallback"],
+            "endpoints": ["/v1/chat/completions"],
+            "rules": [{"model_exact": "m", "backends": [
+                {"name": "drained", "host": "127.0.0.1",
+                 "port": ports["up_a"], "weight": 0},
+                {"name": "live", "host": "127.0.0.1",
+                 "port": ports["up_b"], "weight": 1},
+            ]}],
+        }
+        proc = start_core(cfg, tmp_path)
+        try:
+            async with aiohttp.ClientSession() as s:
+                for _ in range(8):
+                    status, body = await _post(
+                        s, ports["core"], "/v1/chat/completions",
+                        {"model": "m"})
+                    assert status == 200
+                    assert json.loads(body)["marker"] == "B"
+        finally:
+            proc.kill()
+            await up_a.cleanup()
+            await up_b.cleanup()
+
+
+class TestCoreConfigCompiler:
+    def base_config(self, **route_kw):
+        return Config.parse({
+            "backends": [
+                {"name": "one", "schema": {"name": "OpenAI"},
+                 "url": "http://127.0.0.1:9001",
+                 "auth": {"kind": "APIKey", "api_key": "file:/tmp/k"}},
+                {"name": "two", "schema": {"name": "OpenAI"},
+                 "url": "http://127.0.0.1:9002"},
+                {"name": "tls", "schema": {"name": "OpenAI"},
+                 "url": "https://api.example.com"},
+                {"name": "anthropic", "schema": {"name": "Anthropic"},
+                 "url": "http://127.0.0.1:9003"},
+            ],
+            "routes": [{
+                "name": "r1",
+                "rules": [
+                    {"models": ["m1", "m2"],
+                     "backends": [{"backend": "one", "weight": 3},
+                                  {"backend": "two", "priority": 1}]},
+                ],
+                **route_kw,
+            }],
+        })
+
+    def test_compiles_eligible_rules(self):
+        core, skipped = compile_core_config(self.base_config())
+        assert skipped == []
+        assert [r["model_exact"] for r in core["rules"]] == ["m1", "m2"]
+        b0 = core["rules"][0]["backends"][0]
+        assert b0["host"] == "127.0.0.1" and b0["port"] == 9001
+        assert b0["weight"] == 3
+        assert b0["auth_headers"][0]["value_file"] == "/tmp/k"
+        assert core["rules"][0]["backends"][1]["priority"] == 1
+
+    def test_tls_backend_stops_compilation(self):
+        cfg = Config.parse({
+            "backends": [
+                {"name": "tls", "schema": {"name": "OpenAI"},
+                 "url": "https://api.example.com"},
+                {"name": "ok", "schema": {"name": "OpenAI"},
+                 "url": "http://127.0.0.1:9002"},
+            ],
+            "routes": [{"name": "r", "rules": [
+                {"models": ["secure"], "backends": ["tls"]},
+                {"models": ["plain"], "backends": ["ok"]},
+            ]}],
+        })
+        core, skipped = compile_core_config(cfg)
+        # the later eligible rule must NOT be compiled: it could shadow
+        # the earlier python-path rule's position in first-match order
+        assert core["rules"] == []
+        assert any("scheme https" in s for s in skipped)
+
+    def test_translation_backend_not_eligible(self):
+        cfg = Config.parse({
+            "backends": [{"name": "a", "schema": {"name": "Anthropic"},
+                          "url": "http://127.0.0.1:9003"}],
+            "routes": [{"name": "r", "rules": [
+                {"models": ["m"], "backends": ["a"]}]}],
+        })
+        core, skipped = compile_core_config(cfg)
+        assert core["rules"] == [] and any("translation" in s
+                                           for s in skipped)
+
+    def test_costs_block_native(self):
+        cfg = Config.parse({
+            "backends": [{"name": "one", "schema": {"name": "OpenAI"},
+                          "url": "http://127.0.0.1:9001"}],
+            "routes": [{"name": "r", "rules": [
+                {"models": ["m"], "backends": ["one"]}]}],
+            "llm_request_costs": [
+                {"metadata_key": "t", "type": "OutputToken"}],
+        })
+        core, skipped = compile_core_config(cfg)
+        assert core["rules"] == []
+        assert any("llm_request_costs" in s for s in skipped)
+
+    def test_catch_all_rule_stops_compilation(self):
+        cfg = Config.parse({
+            "backends": [{"name": "one", "schema": {"name": "OpenAI"},
+                          "url": "http://127.0.0.1:9001"}],
+            "routes": [{"name": "r", "rules": [
+                {"backends": ["one"]},  # no model match → python
+                {"models": ["m"], "backends": ["one"]},
+            ]}],
+        })
+        core, skipped = compile_core_config(cfg)
+        assert core["rules"] == []
+
+    def test_path_prefix_url_not_eligible(self):
+        cfg = Config.parse({
+            "backends": [{"name": "p", "schema": {"name": "OpenAI"},
+                          "url": "http://127.0.0.1:9001/openai"}],
+            "routes": [{"name": "r", "rules": [
+                {"models": ["m"], "backends": ["p"]}]}],
+        })
+        core, skipped = compile_core_config(cfg)
+        assert core["rules"] == []
+        assert any("path prefix" in s for s in skipped)
+
+    def test_drained_backends_omitted(self):
+        cfg = Config.parse({
+            "backends": [
+                {"name": "a", "schema": {"name": "OpenAI"},
+                 "url": "http://127.0.0.1:9001"},
+                {"name": "b", "schema": {"name": "OpenAI"},
+                 "url": "http://127.0.0.1:9002"},
+            ],
+            "routes": [{"name": "r", "rules": [
+                {"models": ["m"],
+                 "backends": [{"backend": "a", "weight": 0},
+                              {"backend": "b", "weight": 2}]}]}],
+        })
+        core, _ = compile_core_config(cfg)
+        names = [b["name"] for b in core["rules"][0]["backends"]]
+        assert names == ["b"]
+
+    def test_hostnames_and_prefixes_carried(self):
+        cfg = Config.parse({
+            "backends": [{"name": "one", "schema": {"name": "OpenAI"},
+                          "url": "http://127.0.0.1:9001"}],
+            "routes": [{"name": "r", "hostnames": ["api.acme.io"],
+                        "rules": [{"model_prefixes": ["gpt-"],
+                                   "backends": ["one"]}]}],
+        })
+        core, _ = compile_core_config(cfg)
+        assert core["rules"][0]["model_prefix"] == "gpt-"
+        assert core["rules"][0]["hostnames"] == ["api.acme.io"]
