@@ -105,6 +105,12 @@ def main(argv: list[str] | None = None) -> int:
                          help="prompt-lookup speculative decoding: draft "
                               "tokens verified per decode step (0 = off); "
                               "wins on repetitive/extractive generations")
+    p_serve.add_argument("--pallas-attn", action="store_true",
+                         help="ragged paged-attention Pallas kernel for "
+                              "decode (single-chip; HBM reads scale with "
+                              "actual sequence lengths; no effect with "
+                              "--spec-tokens, whose verify step uses the "
+                              "gather path)")
     p_serve.add_argument("--no-prefix-cache", action="store_true",
                          help="disable automatic prompt prefix caching")
     p_serve.add_argument("--lora", action="append", default=[],
@@ -380,6 +386,7 @@ async def _run_tpuserve(args: argparse.Namespace) -> int:
         sp_prefill_min_tokens=args.sp_prefill_min_tokens,
         prefill_chunk_tokens=args.prefill_chunk_tokens,
         spec_tokens=args.spec_tokens,
+        pallas_attn=args.pallas_attn,
     )
     print(f"tpuserve listening on http://{args.host}:{args.port}", flush=True)
     await _wait_for_signal()

@@ -373,12 +373,19 @@ def decode_step(
     mlp=None,  # pluggable feed-forward (MoE families override)
     lora=None,  # stacked adapters (models/lora.py)
     adapter_idx=None,  # [B] int32 adapter row per slot
+    attn_impl: str = "",  # "" = XLA gather; "pallas" = ragged paged kernel
 ) -> tuple[jax.Array, jax.Array]:
     """One continuous-batching decode step; returns (logits [B, V], cache).
 
     The hot loop: fixed shapes, cache gathered per sequence window
     [B, T_max] where T_max = max_pages * page_size. Inactive slots are
     masked and write to dropped slots.
+
+    ``attn_impl="pallas"`` replaces the gather+dense attention with the
+    ragged paged-attention kernel (ops/pallas/paged_attention.py): HBM
+    reads scale with actual sequence lengths instead of the padded
+    window. Single-mesh only — under GSPMD the gather path is used (the
+    engine gates this).
     """
     B = tokens.shape[0]
     max_pages = page_table.shape[1]
@@ -392,13 +399,23 @@ def decode_step(
     )  # [B, 1]
     slot = jnp.where(active[:, None], slot, n_slots)  # OOB → dropped
 
-    # gather the full (padded) KV window for each slot
-    t_idx = jnp.arange(T, dtype=jnp.int32)[None, :].repeat(B, 0)  # [B, T]
-    gslot = page_table[:, :, None] * page_size + jnp.arange(
-        page_size, dtype=jnp.int32
-    )
-    gslot = gslot.reshape(B, T)  # [B, T] flat cache indices
-    attend = t_idx <= pos1  # causal within the sequence window [B, T]
+    use_pallas = attn_impl == "pallas"
+    if not use_pallas:
+        # gather the full (padded) KV window for each slot
+        t_idx = jnp.arange(T, dtype=jnp.int32)[None, :].repeat(B, 0)
+        gslot = page_table[:, :, None] * page_size + jnp.arange(
+            page_size, dtype=jnp.int32
+        )
+        gslot = gslot.reshape(B, T)  # [B, T] flat cache indices
+        attend = t_idx <= pos1  # causal within the sequence window
+    else:
+        from aigw_tpu.ops.pallas._compat import is_tpu_backend
+        from aigw_tpu.ops.pallas.paged_attention import (
+            paged_attention_decode_v2,
+        )
+
+        lengths = jnp.where(active, positions + 1, 0)
+        interp = not is_tpu_backend()
 
     x = _embed_rows(p, tokens[:, None])  # [B, 1, dim]
     for i in range(cfg.n_layers):
@@ -406,9 +423,15 @@ def decode_step(
         q, k, v = _project_qkv(p, i, h, pos1, cfg, lora, adapter_idx)
         kv_cache = kv_cache.at[i, 0, slot].set(k, mode="drop")
         kv_cache = kv_cache.at[i, 1, slot].set(v, mode="drop")
-        k_all = kv_cache[i, 0][gslot]  # [B, T, Hkv, D]
-        v_all = kv_cache[i, 1][gslot]
-        attn = _attention(q, k_all, v_all, attend[:, None, :])
+        if use_pallas:
+            attn = paged_attention_decode_v2(
+                q[:, 0], kv_cache[i, 0], kv_cache[i, 1], page_table,
+                lengths, page_size=page_size, interpret=interp,
+            ).reshape(B, 1, cfg.n_heads * cfg.head_dim)
+        else:
+            k_all = kv_cache[i, 0][gslot]  # [B, T, Hkv, D]
+            v_all = kv_cache[i, 1][gslot]
+            attn = _attention(q, k_all, v_all, attend[:, None, :])
         x = x + _wo_project(p, i, attn, lora, adapter_idx)
         h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
         x = x + (mlp(p, i, h) if mlp is not None

@@ -151,10 +151,11 @@ def prefill(p, cfg: MixtralConfig, tokens, seq_lens, kv_cache, page_table,
 
 
 def decode_step(p, cfg: MixtralConfig, tokens, positions, kv_cache,
-                page_table, page_size, active, lora=None, adapter_idx=None):
+                page_table, page_size, active, lora=None, adapter_idx=None,
+                attn_impl=""):
     return llama.decode_step(p, cfg.as_llama(), tokens, positions, kv_cache,
                              page_table, page_size, active,
-                             mlp=_mlp_fn(cfg))
+                             mlp=_mlp_fn(cfg), attn_impl=attn_impl)
 
 
 def hidden_states(p, cfg: MixtralConfig, tokens, seq_lens):

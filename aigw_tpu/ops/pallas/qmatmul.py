@@ -89,5 +89,7 @@ def w8a16_matmul(x: jax.Array, q: jax.Array,
 
     Caller guarantees ``supported(M, K, N)``. Runs interpreted off-TPU
     so CPU tests exercise the same code path."""
-    interpret = jax.default_backend() != "tpu"
-    return _w8a16_matmul(x, q, scale.reshape(1, -1), interpret=interpret)
+    from aigw_tpu.ops.pallas._compat import is_tpu_backend
+
+    return _w8a16_matmul(x, q, scale.reshape(1, -1),
+                         interpret=not is_tpu_backend())

@@ -89,6 +89,10 @@ class EngineConfig:
     # positions in one fixed-shape program and advances by the accepted
     # count — see tpuserve/speculation.py.
     spec_tokens: int = 0
+    # Ragged paged-attention Pallas kernel for the decode hot loop (HBM
+    # reads scale with actual sequence lengths, not the padded window).
+    # Single-chip only: ignored when the engine runs on a mesh.
+    pallas_attn: bool = False
 
     def __post_init__(self) -> None:
         if self.max_seq_len % self.page_size != 0:
@@ -275,6 +279,19 @@ class Engine:
 
         mc, ps = model_cfg, cfg.page_size
         K = cfg.decode_steps_per_tick
+        # ragged paged-attention kernel: single-chip decode only (under
+        # GSPMD the sharded gather path stays)
+        attn_impl = "pallas" if (cfg.pallas_attn and mesh is None) else ""
+        if cfg.pallas_attn and mesh is not None:
+            logger.warning("pallas_attn ignored: engine runs on a mesh "
+                           "(sharded gather path is used)")
+        if attn_impl and cfg.spec_tokens > 0:
+            # the speculative verify step has no kernel variant yet; with
+            # speculation on, every decode goes through verify_step
+            logger.warning("pallas_attn has no effect with spec_tokens>0: "
+                           "the speculative verify path uses the XLA "
+                           "gather attention")
+            attn_impl = ""
 
         model_prefill = self.fns.prefill
         model_decode = self.fns.decode_step
@@ -327,6 +344,7 @@ class Engine:
                     params, mc, st["tokens"], st["positions"], kv,
                     st["page_table"], ps, act,
                     lora=lora, adapter_idx=st["adapter_idx"],
+                    attn_impl=attn_impl,
                 )
                 logits = apply_penalties(
                     logits, st["counts"], st["freq_pen"], st["pres_pen"],

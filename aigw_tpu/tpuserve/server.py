@@ -740,6 +740,7 @@ async def run_tpuserve(
     sp_prefill_min_tokens: int = 1024,
     prefill_chunk_tokens: int = 0,
     spec_tokens: int = 0,
+    pallas_attn: bool = False,
 ) -> web.AppRunner:
     server = TPUServeServer(
         model,
@@ -753,6 +754,7 @@ async def run_tpuserve(
             sp_prefill_min_tokens=sp_prefill_min_tokens,
             prefill_chunk_tokens=prefill_chunk_tokens,
             spec_tokens=spec_tokens,
+            pallas_attn=pallas_attn,
         ),
         tp=tp,
         ep=ep,
