@@ -34,11 +34,9 @@ from __future__ import annotations
 
 import asyncio
 import base64
-import collections
 import fnmatch
 import os
 import re
-import hashlib
 import json
 import logging
 import secrets
@@ -134,6 +132,11 @@ class MCPConfig:
     # production, as the reference requires via flags, mainlib/main.go:337).
     session_seed: str = ""
     session_fallback_seed: str = ""
+    # Shared spool directory for Last-Event-Id replay buffers: set to a
+    # volume all --workers processes / gateway replicas mount and stream
+    # resumption survives reconnecting to a different replica
+    # (mcp/replay.py FileReplayStore). Empty = in-memory, replica-local.
+    replay_dir: str = ""
 
     # parsed MCPAuthzConfig | None (kept out of the frozen dataclass
     # equality on purpose — see parse())
@@ -170,10 +173,41 @@ class MCPConfig:
             # once, so config hot-reloads don't invalidate live sessions
             session_seed=value.get("session_seed", ""),
             session_fallback_seed=value.get("session_fallback_seed", ""),
+            replay_dir=value.get("replay_dir", ""),
             authorization=MCPAuthzConfig.parse(
                 value.get("authorization")
             ),
         )
+
+
+class _ReplayHandle:
+    """Stream-lifetime view of a session's replay buffer.
+
+    Re-resolves the underlying buffer whenever the proxy's store object
+    changes (config hot-reload swapping ``replay_dir``), and pushes the
+    store's blocking file I/O off the event loop — one slow flock on a
+    shared volume must not stall every stream on the replica."""
+
+    def __init__(self, proxy: "MCPProxy", token: str):
+        self._proxy = proxy
+        self._token = token
+        self._store: Any = None
+        self._buf: Any = None
+
+    def _resolve(self):
+        store = self._proxy._replay_store
+        if store is not self._store:
+            self._store = store
+            self._buf = store.buffer(self._token)
+        return self._buf
+
+    async def append(self, encode) -> bytes:
+        buf = self._resolve()
+        return await asyncio.to_thread(buf.append, encode)
+
+    async def events_after(self, last_id: int) -> list[bytes]:
+        buf = self._resolve()
+        return await asyncio.to_thread(buf.events_after, last_id)
 
 
 def _rpc_error(id_: Any, code: int, message: str) -> dict[str, Any]:
@@ -241,12 +275,13 @@ class MCPProxy:
         self._tool_change_listeners: set[asyncio.Event] = set()
         self._ping_seq = 0
         # bounded per-session replay buffers for Last-Event-Id resumption
-        # (reference sse.go). Best-effort and replica-local: the encrypted
-        # session itself stays stateless; only recent stream events are
-        # cached here, keyed by a digest of the session token.
-        self._replay: "collections.OrderedDict[str, collections.deque]" = (
-            collections.OrderedDict()
-        )
+        # (reference sse.go). The encrypted session itself stays
+        # stateless; recent stream events live in the replay store —
+        # in-memory (replica-local) by default, or a shared spool
+        # directory when cfg.replay_dir is set (mcp/replay.py).
+        from aigw_tpu.mcp.replay import make_store
+
+        self._replay_store = make_store(cfg.replay_dir)
 
     def register(self, app: web.Application) -> None:
         app.router.add_post(self.cfg.path, self.handle)
@@ -281,6 +316,10 @@ class MCPProxy:
             from aigw_tpu.mcp.authz import JWTValidator
 
             self._authz = JWTValidator(cfg.authorization)
+        if old.replay_dir != cfg.replay_dir:
+            from aigw_tpu.mcp.replay import make_store
+
+            self._replay_store = make_store(cfg.replay_dir)
         if old.backends != cfg.backends:
             for ev in self._tool_change_listeners:
                 ev.set()
@@ -363,26 +402,17 @@ class MCPProxy:
                 return None, new_session
             return (json.loads(raw) if raw else None), new_session
 
-    _REPLAY_EVENTS = 256  # per session
-    _REPLAY_SESSIONS = 1024
-
     def _replay_buffer(self, session_token: str):
-        """Per-session replay state: (deque, shared id allocator) — the
-        allocator is shared across concurrent streams on the session so
-        event ids stay unique. Returns None without a session token."""
+        """Per-session replay handle with a shared id allocator (ids stay
+        unique across concurrent streams on the session — and across
+        replicas when the store is file-backed). Returns None without a
+        session token. The handle re-resolves its buffer if a config
+        hot-reload swaps the store, so live streams keep buffering into
+        the store reconnects will consult; file I/O runs off the event
+        loop."""
         if not session_token:
             return None
-        key = hashlib.sha256(session_token.encode()).hexdigest()[:32]
-        buf = self._replay.get(key)
-        if buf is None:
-            buf = {"events": collections.deque(maxlen=self._REPLAY_EVENTS),
-                   "next_id": 1}
-            self._replay[key] = buf
-            while len(self._replay) > self._REPLAY_SESSIONS:
-                self._replay.popitem(last=False)
-        else:
-            self._replay.move_to_end(key)
-        return buf
+        return _ReplayHandle(self, session_token)
 
     async def handle_get(self, request: web.Request) -> web.StreamResponse:
         """GET /mcp with Last-Event-Id: replay buffered stream events
@@ -423,9 +453,8 @@ class MCPProxy:
                 last = 0
             buf = self._replay_buffer(token)
             if buf is not None:
-                for event_id, encoded in list(buf["events"]):
-                    if event_id > last:
-                        await resp.write(encoded)
+                for encoded in await buf.events_after(last):
+                    await resp.write(encoded)
             await resp.write_eof()
             return resp
         await self._listen_streams(request, resp, token, sessions)
@@ -500,8 +529,8 @@ class MCPProxy:
             ev, backend_name: str | None = None, replayable: bool = True
         ) -> None:
             await resp.write(
-                self._prepare_relay_event(ev, backend_name, buf,
-                                          replayable=replayable)
+                await self._prepare_relay_event(ev, backend_name, buf,
+                                                replayable=replayable)
             )
 
         def ping_event():
@@ -945,7 +974,7 @@ class MCPProxy:
                 # server→client requests riding the tools/call stream
                 # (elicitation, sampling, roots) need routable ids
                 await out.write(
-                    self._prepare_relay_event(ev, backend.name, buf)
+                    await self._prepare_relay_event(ev, backend.name, buf)
                 )
 
             async for chunk in resp.content.iter_any():
@@ -1041,7 +1070,7 @@ class MCPProxy:
                                          "resource not found")
 
     # -- reverse direction (server→client requests) -----------------------
-    def _prepare_relay_event(
+    async def _prepare_relay_event(
         self, ev, backend_name: str | None, buf,
         replayable: bool = True,
     ) -> bytes:
@@ -1061,15 +1090,14 @@ class MCPProxy:
                 if modified is not msg:
                     ev.data = json.dumps(modified)
         # heartbeats are written without ids and never buffered — they
-        # must not evict resumable events from the bounded replay deque
+        # must not evict resumable events from the bounded replay buffer
         # or advance Last-Event-Id
         if replayable and buf is not None:
-            event_id = buf["next_id"]
-            buf["next_id"] += 1
-            ev.id = str(event_id)
-            encoded = ev.encode()
-            buf["events"].append((event_id, encoded))
-            return encoded
+            def encode_with_id(event_id: int) -> bytes:
+                ev.id = str(event_id)
+                return ev.encode()
+
+            return await buf.append(encode_with_id)
         return ev.encode()
 
     def _modify_server_message(

@@ -1,0 +1,172 @@
+"""Replay stores (mcp/replay.py): shared Last-Event-Id resumption.
+
+The cross-replica test is the point: a stream served by one proxy
+instance must be replayable from a DIFFERENT instance sharing only the
+session seed and the spool directory — the --workers / multi-replica
+deployment shape.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import aiohttp
+from aiohttp import web
+
+from aigw_tpu.mcp.proxy import MCPBackend, MCPConfig, MCPProxy
+from aigw_tpu.mcp.replay import (
+    FileReplayStore,
+    MemoryReplayStore,
+    make_store,
+)
+from tests.test_mcp import FakeMCPServer, _rpc
+
+
+def _enc(event_id: int) -> bytes:
+    return f"id: {event_id}\ndata: x\n\n".encode()
+
+
+class TestFileReplayStore:
+    def test_append_and_replay(self, tmp_path):
+        store = FileReplayStore(str(tmp_path))
+        buf = store.buffer("session-token")
+        for _ in range(5):
+            buf.append(_enc)
+        got = buf.events_after(3)
+        assert got == [_enc(4), _enc(5)]
+
+    def test_ids_unique_across_store_instances(self, tmp_path):
+        """Two replicas (separate store objects, shared dir) allocate
+        disjoint, ordered ids for the same session."""
+        a = FileReplayStore(str(tmp_path)).buffer("tok")
+        b = FileReplayStore(str(tmp_path)).buffer("tok")
+        out = [a.append(_enc), b.append(_enc), a.append(_enc),
+               b.append(_enc)]
+        assert out == [_enc(1), _enc(2), _enc(3), _enc(4)]
+        assert b.events_after(0) == [_enc(i) for i in (1, 2, 3, 4)]
+
+    def test_trim_keeps_latest(self, tmp_path):
+        from aigw_tpu.mcp import replay
+
+        store = FileReplayStore(str(tmp_path))
+        buf = store.buffer("tok")
+        # trims are amortized (every _TRIM_EVERY appends), so the spool
+        # is bounded by the cap plus one trim interval
+        n = replay._REPLAY_EVENTS + 3 * buf._TRIM_EVERY
+        for _ in range(n):
+            buf.append(_enc)
+        got = buf.events_after(0)
+        assert len(got) <= replay._REPLAY_EVENTS + buf._TRIM_EVERY
+        assert got[-1] == _enc(n)
+        # ids keep increasing after trims
+        assert buf.append(_enc) == _enc(n + 1)
+
+    def test_ids_survive_spool_unlink(self, tmp_path):
+        """GC (or an operator) deleting a live session's spool must not
+        restart ids — the live stream's ids stay monotonic."""
+        import os
+
+        buf = FileReplayStore(str(tmp_path)).buffer("tok")
+        for _ in range(5):
+            buf.append(_enc)
+        os.unlink(buf._path)
+        assert buf.append(_enc) == _enc(6)
+
+    def test_large_event_tail_scan(self, tmp_path):
+        """Tail-id scan handles events bigger than one backscan chunk."""
+        big = b"x" * 200_000
+
+        def enc(i: int) -> bytes:
+            return b"id: %d\ndata: %s\n\n" % (i, big)
+
+        buf = FileReplayStore(str(tmp_path)).buffer("tok")
+        buf.append(enc)
+        buf.append(enc)
+        assert buf.append(_enc) == _enc(3)
+
+    def test_missing_session_empty(self, tmp_path):
+        buf = FileReplayStore(str(tmp_path)).buffer("never-written")
+        assert buf.events_after(0) == []
+
+    def test_make_store_selects(self, tmp_path):
+        assert isinstance(make_store(""), MemoryReplayStore)
+        assert isinstance(make_store(str(tmp_path)), FileReplayStore)
+
+
+class TestCrossReplicaReplay:
+    def test_stream_replayed_by_other_replica(self, tmp_path):
+        async def main():
+            class StreamingMCP(FakeMCPServer):
+                async def _handle(self, request):
+                    msg = json.loads(await request.read())
+                    if msg.get("method") == "tools/call":
+                        resp = web.StreamResponse(
+                            status=200,
+                            headers={"content-type": "text/event-stream"})
+                        await resp.prepare(request)
+                        for i in range(3):
+                            note = {"jsonrpc": "2.0",
+                                    "method": "notifications/progress",
+                                    "params": {"progress": i}}
+                            await resp.write(
+                                f"data: {json.dumps(note)}\n\n".encode())
+                        final = {"jsonrpc": "2.0", "id": msg["id"],
+                                 "result": {"content": []}}
+                        await resp.write(
+                            f"data: {json.dumps(final)}\n\n".encode())
+                        await resp.write_eof()
+                        return resp
+                    return await super()._handle(request)
+
+            s1 = await StreamingMCP("alpha", ["work"]).start()
+            cfg = MCPConfig(
+                backends=(MCPBackend(name="alpha", url=s1.url),),
+                session_seed="shared-seed",
+                replay_dir=str(tmp_path),
+            )
+
+            async def start_replica():
+                proxy = MCPProxy(cfg)
+                app = web.Application()
+                proxy.register(app)
+                runner = web.AppRunner(app)
+                await runner.setup()
+                site = web.TCPSite(runner, "127.0.0.1", 0)
+                await site.start()
+                port = site._server.sockets[0].getsockname()[1]
+                return runner, f"http://127.0.0.1:{port}/mcp"
+
+            r1, url1 = await start_replica()
+            r2, url2 = await start_replica()
+            try:
+                _, _, headers = await _rpc(
+                    url1, "initialize",
+                    {"protocolVersion": "2025-06-18", "capabilities": {}})
+                session = headers["mcp-session-id"]
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        url1,
+                        json={"jsonrpc": "2.0", "id": 7,
+                              "method": "tools/call",
+                              "params": {"name": "alpha__work"}},
+                        headers={"mcp-session-id": session},
+                    ) as resp:
+                        await resp.read()
+                    # reconnect lands on the OTHER replica
+                    async with s.get(
+                        url2,
+                        headers={"mcp-session-id": session,
+                                 "last-event-id": "2"},
+                    ) as resp:
+                        assert resp.status == 200
+                        raw = (await resp.read()).decode()
+                assert "id: 3" in raw and "id: 4" in raw
+                assert "id: 1" not in raw and "id: 2" not in raw
+                assert '"result"' in raw
+            finally:
+                await r1.cleanup()
+                await r2.cleanup()
+                await s1.stop()
+
+        asyncio.run(main())
