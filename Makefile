@@ -1,7 +1,7 @@
 # Developer entry points (tests force the CPU fake-chip platform through
 # tests/conftest.py; bench runs on the real TPU).
 
-.PHONY: test test-fast native bench gateway-bench clean
+.PHONY: test test-fast native bench gateway-bench docs clean
 
 test: native
 	python -m pytest tests/ -q
@@ -19,6 +19,9 @@ bench:
 
 gateway-bench:
 	python benchmarks/gateway_overhead.py
+
+docs:
+	python docs/build_site.py
 
 clean:
 	$(MAKE) -C native clean

@@ -203,10 +203,16 @@ class _ReplayHandle:
 
     async def append(self, encode) -> bytes:
         buf = self._resolve()
+        if not self._store.blocking:
+            # in-memory: inline on the loop — race-free (the loop is the
+            # only writer) and no executor dispatch on the hot path
+            return buf.append(encode)
         return await asyncio.to_thread(buf.append, encode)
 
     async def events_after(self, last_id: int) -> list[bytes]:
         buf = self._resolve()
+        if not self._store.blocking:
+            return buf.events_after(last_id)
         return await asyncio.to_thread(buf.events_after, last_id)
 
 

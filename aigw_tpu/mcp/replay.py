@@ -43,6 +43,10 @@ class ReplayBuffer(Protocol):
 
 
 class ReplayStore(Protocol):
+    #: True when buffer methods do blocking I/O and must be called off
+    #: the event loop; False when they are loop-safe inline calls.
+    blocking: bool
+
     def buffer(self, session_token: str) -> ReplayBuffer | None: ...
 
 
@@ -69,6 +73,10 @@ class _MemoryBuffer:
 
 
 class MemoryReplayStore:
+    # deque appends are loop-safe inline: running them on the loop keeps
+    # them race-free (single-threaded) and free of executor dispatch
+    blocking = False
+
     def __init__(self) -> None:
         self._sessions: "collections.OrderedDict[str, _MemoryBuffer]" = (
             collections.OrderedDict()
@@ -104,10 +112,13 @@ class _FileBuffer:
 
     _TRIM_EVERY = 64
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, gc: Callable[[], None] | None = None):
         self._path = path
         self._last_id = 0  # monotonic floor for this buffer's lifetime
         self._appends = 0
+        # store-level GC hook, run inside append (i.e. in the caller's
+        # worker thread, never on the event loop)
+        self._gc = gc
 
     def _read_locked(self, f) -> list[tuple[int, bytes]]:
         events = []
@@ -146,6 +157,8 @@ class _FileBuffer:
         return 0
 
     def append(self, encode: Callable[[int], bytes]) -> bytes:
+        if self._gc is not None:
+            self._gc()
         with open(self._path, "a+b") as f:
             fcntl.flock(f, fcntl.LOCK_EX)
             self._appends += 1
@@ -185,25 +198,28 @@ class _FileBuffer:
 
 
 class FileReplayStore:
+    blocking = True  # flock'd spool I/O: callers must thread-hop
+
     def __init__(self, directory: str):
         self._dir = directory
         os.makedirs(directory, exist_ok=True)
-        self._appends = 0
+        self._gc_tick = 0
 
     def buffer(self, session_token: str) -> _FileBuffer | None:
         if not session_token:
             return None
-        self._maybe_gc()
-        return _FileBuffer(os.path.join(self._dir, _key(session_token)))
+        return _FileBuffer(os.path.join(self._dir, _key(session_token)),
+                           gc=self._maybe_gc)
 
     def _maybe_gc(self) -> None:
-        """Bound the spool directory: every 64th buffer acquisition,
-        delete oldest-by-mtime files beyond the session cap or older
-        than a day. Files touched within the last hour are never
-        deleted, even over the cap — unlinking a live session's spool
-        would break its resumption."""
-        self._appends += 1
-        if self._appends % 64 != 1:
+        """Bound the spool directory: every 64th append (running in the
+        appender's worker thread, never on the event loop), delete
+        oldest-by-mtime files beyond the session cap or older than a
+        day. Files touched within the last hour are never deleted, even
+        over the cap — unlinking a live session's spool would break its
+        resumption."""
+        self._gc_tick += 1
+        if self._gc_tick % 64 != 1:
             return
         try:
             entries = [

@@ -1,0 +1,139 @@
+"""Static docs site generator (the reference ships a docs site; ours is
+dependency-light: stdlib + the `markdown` package already in the image).
+
+Usage: ``python docs/build_site.py [-o docs/_site]`` — renders README.md
+as the index plus every ``docs/*.md`` page with a sidebar, TOC anchors,
+fenced code, and tables. Pure static output; serve with any file server.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import shutil
+
+import markdown
+
+DOCS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(DOCS_DIR)
+
+PAGE_ORDER = [
+    "architecture", "configuration", "serving", "providers",
+    "native-core", "mcp", "observability",
+]
+
+_TEMPLATE = """<!DOCTYPE html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>{title} — aigw-tpu</title>
+<style>
+:root {{ --fg: #1a1d23; --muted: #5c6370; --bg: #ffffff; --side: #f6f7f9;
+        --accent: #0b66c3; --code: #f2f3f5; --border: #e3e5e8; }}
+@media (prefers-color-scheme: dark) {{
+  :root {{ --fg: #d6dae1; --muted: #8b93a1; --bg: #15181d; --side: #1b1f26;
+          --accent: #5ca4ef; --code: #20242c; --border: #2a2f38; }} }}
+* {{ box-sizing: border-box; }}
+body {{ margin: 0; font: 16px/1.65 system-ui, sans-serif;
+       color: var(--fg); background: var(--bg); }}
+.layout {{ display: flex; min-height: 100vh; }}
+nav {{ width: 230px; flex-shrink: 0; background: var(--side);
+      border-right: 1px solid var(--border); padding: 1.5rem 1rem; }}
+nav h1 {{ font-size: 1.05rem; margin: 0 0 1rem; }}
+nav h1 a {{ color: var(--fg); text-decoration: none; }}
+nav a {{ display: block; color: var(--muted); text-decoration: none;
+        padding: .3rem .5rem; border-radius: 6px; font-size: .92rem; }}
+nav a:hover {{ background: var(--code); }}
+nav a.active {{ color: var(--accent); font-weight: 600; }}
+main {{ max-width: 52rem; padding: 2.5rem 3rem; min-width: 0; }}
+main h1, main h2, main h3 {{ line-height: 1.25; }}
+main h2 {{ border-bottom: 1px solid var(--border); padding-bottom: .3rem; }}
+a {{ color: var(--accent); }}
+code {{ background: var(--code); padding: .12em .35em; border-radius: 4px;
+       font-size: .88em; }}
+pre {{ background: var(--code); padding: 1rem; border-radius: 8px;
+      overflow-x: auto; }}
+pre code {{ background: none; padding: 0; }}
+table {{ border-collapse: collapse; width: 100%; font-size: .92rem; }}
+th, td {{ border: 1px solid var(--border); padding: .45rem .6rem;
+         text-align: left; vertical-align: top; }}
+th {{ background: var(--side); }}
+blockquote {{ margin: 0; padding: .2rem 1rem; border-left: 3px solid
+             var(--accent); color: var(--muted); }}
+</style>
+</head>
+<body>
+<div class="layout">
+<nav>
+<h1><a href="index.html">aigw-tpu</a></h1>
+{nav}
+</nav>
+<main>
+{body}
+</main>
+</div>
+</body>
+</html>
+"""
+
+
+def _title_of(md_text: str, fallback: str) -> str:
+    m = re.search(r"^#\s+(.+)$", md_text, re.MULTILINE)
+    return m.group(1).strip() if m else fallback
+
+
+def _fix_links(html: str) -> str:
+    """Rewrite intra-repo .md links to the rendered .html pages."""
+    html = re.sub(r'href="(?:\./)?docs/([\w-]+)\.md"', r'href="\1.html"', html)
+    html = re.sub(r'href="(?:\./)?([\w-]+)\.md"', r'href="\1.html"', html)
+    html = html.replace('href="README.html"', 'href="index.html"')
+    return html
+
+
+def build(out_dir: str) -> list[str]:
+    pages: list[tuple[str, str, str]] = []  # (slug, title, md_text)
+    with open(os.path.join(REPO, "README.md")) as f:
+        readme = f.read()
+    pages.append(("index", "Overview", readme))
+
+    listed = sorted(
+        (n[:-3] for n in os.listdir(DOCS_DIR)
+         if n.endswith(".md") and n != "README.md"),
+        key=lambda s: (PAGE_ORDER.index(s) if s in PAGE_ORDER else 99, s),
+    )
+    for slug in listed:
+        with open(os.path.join(DOCS_DIR, slug + ".md")) as f:
+            text = f.read()
+        pages.append((slug, _title_of(text, slug), text))
+
+    shutil.rmtree(out_dir, ignore_errors=True)
+    os.makedirs(out_dir, exist_ok=True)
+    md = markdown.Markdown(extensions=["fenced_code", "tables", "toc"])
+    written = []
+    for slug, title, text in pages:
+        nav = "\n".join(
+            f'<a href="{s}.html"{" class=\"active\"" if s == slug else ""}>'
+            f"{t}</a>"
+            for s, t, _ in pages
+        )
+        md.reset()
+        body = _fix_links(md.convert(text))
+        path = os.path.join(out_dir, f"{slug}.html")
+        with open(path, "w") as f:
+            f.write(_TEMPLATE.format(title=title, nav=nav, body=body))
+        written.append(path)
+    return written
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-o", "--out", default=os.path.join(DOCS_DIR, "_site"))
+    args = ap.parse_args()
+    written = build(args.out)
+    print(f"{len(written)} pages → {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+"""Docs site generator (docs/build_site.py)."""
+
+import os
+
+from docs.build_site import build
+
+
+def test_site_builds(tmp_path):
+    written = build(str(tmp_path / "site"))
+    names = {os.path.basename(p) for p in written}
+    assert "index.html" in names and "architecture.html" in names
+    for p in written:
+        html = open(p).read()
+        assert "<nav>" in html and "</html>" in html
+        # intra-repo markdown links are rewritten to rendered pages
+        assert '.md"' not in html
