@@ -106,11 +106,10 @@ def main(argv: list[str] | None = None) -> int:
                               "tokens verified per decode step (0 = off); "
                               "wins on repetitive/extractive generations")
     p_serve.add_argument("--pallas-attn", action="store_true",
-                         help="ragged paged-attention Pallas kernel for "
-                              "decode (single-chip; HBM reads scale with "
-                              "actual sequence lengths; no effect with "
-                              "--spec-tokens, whose verify step uses the "
-                              "gather path)")
+                         help="ragged paged-attention Pallas kernels for "
+                              "decode and speculative verify (single-chip; "
+                              "HBM reads scale with actual sequence "
+                              "lengths)")
     p_serve.add_argument("--no-prefix-cache", action="store_true",
                          help="disable automatic prompt prefix caching")
     p_serve.add_argument("--lora", action="append", default=[],

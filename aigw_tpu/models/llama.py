@@ -453,6 +453,7 @@ def verify_step(
     mlp=None,
     lora=None,
     adapter_idx=None,
+    attn_impl: str = "",  # "" = XLA gather; "pallas" = ragged kernel
 ) -> tuple[jax.Array, jax.Array]:
     """Speculative-decoding verifier: score S candidate positions in one
     step, returning logits at EVERY position ([B, S, V]) so the engine can
@@ -467,6 +468,7 @@ def verify_step(
     B, S = tokens.shape
     T = page_table.shape[1] * page_size
     n_slots = kv_cache.shape[2]
+    start = positions
     positions = positions[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
     valid = active[:, None] & (positions < limits[:, None])  # [B, S]
 
@@ -477,11 +479,23 @@ def verify_step(
     )
     flat = jnp.where(valid, slot, n_slots)  # OOB → dropped by scatter
 
-    gslot = page_table[:, :, None] * page_size + jnp.arange(
-        page_size, dtype=jnp.int32
-    )
-    gslot = gslot.reshape(B, T)
-    t_idx = jnp.arange(T, dtype=jnp.int32)[None, :]
+    use_pallas = attn_impl == "pallas"
+    if not use_pallas:
+        gslot = page_table[:, :, None] * page_size + jnp.arange(
+            page_size, dtype=jnp.int32
+        )
+        gslot = gslot.reshape(B, T)
+        t_idx = jnp.arange(T, dtype=jnp.int32)[None, :]
+    else:
+        from aigw_tpu.ops.pallas._compat import is_tpu_backend
+        from aigw_tpu.ops.pallas.paged_attention import (
+            paged_attention_verify,
+        )
+
+        # inactive slots: start <= -(S+1) → zero attendable keys
+        # (the kernel's page gate is pos0 + S - p*page_size)
+        pal_pos = jnp.where(active, start, -(S + 1))
+        interp = not is_tpu_backend()
 
     x = _embed_rows(p, tokens)
     for i in range(cfg.n_layers):
@@ -489,10 +503,17 @@ def verify_step(
         q, k, v = _project_qkv(p, i, h, positions, cfg, lora, adapter_idx)
         kv_cache = kv_cache.at[i, 0, flat].set(k, mode="drop")
         kv_cache = kv_cache.at[i, 1, flat].set(v, mode="drop")
-        k_all = kv_cache[i, 0][gslot]  # [B, T, Hkv, D]
-        v_all = kv_cache[i, 1][gslot]
-        mask = (t_idx[:, None, :] <= positions[:, :, None]) & valid[..., None]
-        attn = _attention(q, k_all, v_all, mask)
+        if use_pallas:
+            attn = paged_attention_verify(
+                q, kv_cache[i, 0], kv_cache[i, 1], page_table, pal_pos,
+                page_size=page_size, interpret=interp,
+            ).reshape(B, S, cfg.n_heads * cfg.head_dim)
+        else:
+            k_all = kv_cache[i, 0][gslot]  # [B, T, Hkv, D]
+            v_all = kv_cache[i, 1][gslot]
+            mask = (t_idx[:, None, :] <= positions[:, :, None]) \
+                & valid[..., None]
+            attn = _attention(q, k_all, v_all, mask)
         x = x + _wo_project(p, i, attn, lora, adapter_idx)
         h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
         x = x + (mlp(p, i, h) if mlp is not None

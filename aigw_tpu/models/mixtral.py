@@ -165,7 +165,7 @@ def hidden_states(p, cfg: MixtralConfig, tokens, seq_lens):
 
 def verify_step(p, cfg: MixtralConfig, tokens, positions, kv_cache,
                 page_table, page_size, active, limits,
-                lora=None, adapter_idx=None):
+                lora=None, adapter_idx=None, attn_impl=""):
     return llama.verify_step(p, cfg.as_llama(), tokens, positions, kv_cache,
                              page_table, page_size, active, limits,
-                             mlp=_mlp_fn(cfg))
+                             mlp=_mlp_fn(cfg), attn_impl=attn_impl)

@@ -37,6 +37,34 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
+def _flash_update(rows, q, k_h, v_h, mask, m_ref, l_ref, acc_ref):
+    """One online-softmax step for a row block: fold this page's
+    masked logits into the running (max, denom, accumulator) scratch.
+    Shared by all three kernels (decode v1/v2 and the speculative
+    verifier) — they differ only in row layout and mask construction."""
+    D = q.shape[1]
+    logits = jax.lax.dot_general(
+        q, k_h,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) / math.sqrt(D)
+    logits = jnp.where(mask, logits, -1e30)
+    m_prev = m_ref[rows, 0:1]
+    m_new = jnp.maximum(m_prev, jnp.max(logits, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    probs = jnp.exp(logits - m_new)
+    l_ref[rows, 0:1] = alpha * l_ref[rows, 0:1] + jnp.sum(
+        probs, axis=1, keepdims=True
+    )
+    pv = jax.lax.dot_general(
+        probs, v_h,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_ref[rows, :] = acc_ref[rows, :] * alpha + pv
+    m_ref[rows, 0:1] = m_new
+
+
 def _decode_kernel(
     # scalar prefetch
     page_table_ref,  # [B * P] int32 — pool page id per (b, p)
@@ -71,32 +99,11 @@ def _decode_kernel(
         q = q_ref[0, 0].astype(jnp.float32)  # [group, D]
         k = k_ref[:].astype(jnp.float32)  # [page, D]
         v = v_ref[:].astype(jnp.float32)  # [page, D]
-        group, D = q.shape
+        group = q.shape[0]
         page = k.shape[0]
-
-        # logits [group, page]
-        logits = jax.lax.dot_general(
-            q, k,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) / math.sqrt(D)
-        idx = jax.lax.broadcasted_iota(jnp.int32, (group, page), 1)
-        logits = jnp.where(idx < valid, logits, -1e30)
-
-        m_prev = m_ref[:, 0:1]  # [group, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(logits, axis=1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        probs = jnp.exp(logits - m_new)  # [group, page]
-        l_ref[:, 0:1] = alpha * l_ref[:, 0:1] + jnp.sum(
-            probs, axis=1, keepdims=True
-        )
-        pv = jax.lax.dot_general(
-            probs, v,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [group, D]
-        acc_ref[:] = acc_ref[:] * alpha + pv
-        m_ref[:, 0:1] = m_new
+        mask = jax.lax.broadcasted_iota(
+            jnp.int32, (group, page), 1) < valid
+        _flash_update(slice(None), q, k, v, mask, m_ref, l_ref, acc_ref)
 
     @pl.when(p == n_pages - 1)
     def _finalize():
@@ -203,27 +210,8 @@ def _decode_kernel_v2(
             rows = slice(h * group, (h + 1) * group)
             k_h = k_ref[:, h * D : (h + 1) * D].astype(jnp.float32)
             v_h = v_ref[:, h * D : (h + 1) * D].astype(jnp.float32)
-            logits = jax.lax.dot_general(
-                q[rows], k_h,
-                dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) / math.sqrt(D)
-            logits = jnp.where(mask, logits, -1e30)
-            m_prev = m_ref[rows, 0:1]
-            m_new = jnp.maximum(m_prev,
-                                jnp.max(logits, axis=1, keepdims=True))
-            alpha = jnp.exp(m_prev - m_new)
-            probs = jnp.exp(logits - m_new)
-            l_ref[rows, 0:1] = alpha * l_ref[rows, 0:1] + jnp.sum(
-                probs, axis=1, keepdims=True
-            )
-            pv = jax.lax.dot_general(
-                probs, v_h,
-                dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            acc_ref[rows, :] = acc_ref[rows, :] * alpha + pv
-            m_ref[rows, 0:1] = m_new
+            _flash_update(rows, q[rows], k_h, v_h, mask,
+                          m_ref, l_ref, acc_ref)
 
     @pl.when(p == n_pages - 1)
     def _finalize():
@@ -280,3 +268,114 @@ def paged_attention_decode_v2(
         out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
         interpret=interpret,
     )(flat_pt, lengths, q, k2d, v2d)
+
+
+def _verify_kernel(
+    page_table_ref,  # [B * P] int32
+    positions_ref,  # [B] int32 — position of query 0; <= -S = slot off
+    q_ref,  # [1, S, H, D]
+    k_ref,  # [page, Hkv * D]
+    v_ref,  # [page, Hkv * D]
+    o_ref,  # [1, S, H, D]
+    m_ref,  # [Hkv * S * group, 128] f32
+    l_ref,  # [Hkv * S * group, 128] f32
+    acc_ref,  # [Hkv * S * group, D] f32
+    *,
+    page_size: int,
+    n_pages: int,
+    n_kv_heads: int,
+):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -1e30)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    pos0 = positions_ref[b]
+    S, H, D = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
+    group = H // n_kv_heads
+    # last query sits at pos0 + S - 1; pages past it contribute nothing
+    valid = jnp.clip(pos0 + S - p * page_size, 0, page_size)
+
+    @pl.when(valid > 0)
+    def _attend():
+        page = k_ref.shape[0]
+        # causal per query row: row r = s * group + g attends global key
+        # j <= pos0 + s, with j = p * page_size + column
+        col = jax.lax.broadcasted_iota(jnp.int32, (S * group, page), 1)
+        row_s = jax.lax.broadcasted_iota(
+            jnp.int32, (S * group, page), 0) // group
+        mask = (p * page_size + col) <= (pos0 + row_s)
+        for h in range(n_kv_heads):
+            rows = slice(h * S * group, (h + 1) * S * group)
+            q = q_ref[0, :, h * group:(h + 1) * group, :].reshape(
+                S * group, D).astype(jnp.float32)
+            k_h = k_ref[:, h * D:(h + 1) * D].astype(jnp.float32)
+            v_h = v_ref[:, h * D:(h + 1) * D].astype(jnp.float32)
+            _flash_update(rows, q, k_h, v_h, mask, m_ref, l_ref, acc_ref)
+
+    @pl.when(p == n_pages - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[:, 0:1], 1e-30)
+        out = acc_ref[:] / denom  # [Hkv * S * group, D]
+        for h in range(n_kv_heads):
+            rows = slice(h * S * group, (h + 1) * S * group)
+            o_ref[0, :, h * group:(h + 1) * group, :] = (
+                out[rows].reshape(S, group, D).astype(o_ref.dtype)
+            )
+
+
+@functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
+def paged_attention_verify(
+    q: jax.Array,  # [B, S, H, D] — S speculative query positions
+    k_pool: jax.Array,  # [n_slots, Hkv, D]
+    v_pool: jax.Array,
+    page_table: jax.Array,  # [B, P]
+    positions: jax.Array,  # [B] int32 position of q[:, 0]; <= -S disables
+    *,
+    page_size: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Multi-query variant for speculative decoding's verify step: S
+    consecutive query positions per sequence (pending token + drafts)
+    attend the paged cache under a per-query causal mask, with the same
+    ragged DMA skip as the decode kernels. Returns [B, S, H, D]."""
+    B, S, H, D = q.shape
+    n_slots, Hkv, _ = k_pool.shape
+    P = page_table.shape[1]
+    k2d = k_pool.reshape(n_slots, Hkv * D)
+    v2d = v_pool.reshape(n_slots, Hkv * D)
+    flat_pt = page_table.reshape(-1)
+
+    def kv_index(b, p, pt, pos):
+        last = jnp.maximum(pos[b] + S - 1, 0) // page_size
+        return pt[b * P + jnp.minimum(p, last)], 0
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, P),
+        in_specs=[
+            pl.BlockSpec((1, S, H, D), lambda b, p, pt, pos: (b, 0, 0, 0)),
+            pl.BlockSpec((page_size, Hkv * D), kv_index),
+            pl.BlockSpec((page_size, Hkv * D), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, S, H, D),
+                               lambda b, p, pt, pos: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Hkv * S * (H // Hkv), 128), jnp.float32),
+            pltpu.VMEM((Hkv * S * (H // Hkv), 128), jnp.float32),
+            pltpu.VMEM((Hkv * S * (H // Hkv), D), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _verify_kernel, page_size=page_size, n_pages=P, n_kv_heads=Hkv
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, S, H, D), q.dtype),
+        interpret=interpret,
+    )(flat_pt, positions, q, k2d, v2d)

@@ -285,13 +285,6 @@ class Engine:
         if cfg.pallas_attn and mesh is not None:
             logger.warning("pallas_attn ignored: engine runs on a mesh "
                            "(sharded gather path is used)")
-        if attn_impl and cfg.spec_tokens > 0:
-            # the speculative verify step has no kernel variant yet; with
-            # speculation on, every decode goes through verify_step
-            logger.warning("pallas_attn has no effect with spec_tokens>0: "
-                           "the speculative verify path uses the XLA "
-                           "gather attention")
-            attn_impl = ""
 
         model_prefill = self.fns.prefill
         model_decode = self.fns.decode_step
@@ -411,6 +404,7 @@ class Engine:
                     params, mc, inputs, st["positions"], kv,
                     st["page_table"], ps, act, st["limits"],
                     lora=lora, adapter_idx=st["adapter_idx"],
+                    attn_impl=attn_impl,
                 )  # [B, D1, V]
                 # counts are window-start values: exact at d=0, and later
                 # positions only accept on penalty-free slots where the
