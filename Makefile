@@ -1,7 +1,7 @@
 # Developer entry points (tests force the CPU fake-chip platform through
 # tests/conftest.py; bench runs on the real TPU).
 
-.PHONY: test test-fast native bench gateway-bench docs clean
+.PHONY: test test-fast native bench gateway-bench docs dist clean
 
 test: native
 	python -m pytest tests/ -q
@@ -25,3 +25,7 @@ docs:
 
 clean:
 	$(MAKE) -C native clean
+
+dist:
+	pip wheel --no-deps --no-build-isolation -w dist/ .
+	@ls -la dist/

@@ -1,0 +1,32 @@
+"""aigw_tpu — a TPU-native AI gateway + serving framework.
+
+A brand-new framework with the capabilities of Envoy AI Gateway
+(reference: envoyproxy/ai-gateway), re-designed TPU-first:
+
+- ``aigw_tpu.config``    — declarative gateway config model + compiler
+  (equivalent of the reference's ``internal/filterapi`` +
+  controller-translate, see reference filterapi/filterconfig.go:25).
+- ``aigw_tpu.schemas``   — provider API schemas (OpenAI, Anthropic, AWS
+  Bedrock, GCP, Cohere) (reference internal/apischema).
+- ``aigw_tpu.translate`` — request/response schema translation matrix
+  (reference internal/translator/translator.go:42-77).
+- ``aigw_tpu.gateway``   — the native data-plane server: two-phase
+  processing (route pass + upstream pass), weighted/priority backend
+  selection, retry/fallback, streaming SSE, credential injection, token
+  cost accounting (reference internal/extproc/processor_impl.go).
+- ``aigw_tpu.tpuserve``  — JAX/XLA continuous-batching inference engine
+  with a paged KV cache, the self-hosted serving path terminating on TPU
+  (the reference's vLLM/InferencePool role, re-imagined for TPU).
+- ``aigw_tpu.models``    — model families (Llama, Mixtral) as pure
+  functional JAX programs.
+- ``aigw_tpu.ops``       — attention ops incl. Pallas TPU kernels.
+- ``aigw_tpu.parallel``  — device mesh, shardings, collectives (TP/EP/
+  DP/SP over ICI; the TPU equivalent of the reference's NCCL-free,
+  XLA-collective design, SURVEY.md §2.9).
+- ``aigw_tpu.obs``       — OTel GenAI metrics + tracing (reference
+  internal/metrics, internal/tracing).
+- ``aigw_tpu.mcp``       — MCP (Model Context Protocol) proxy
+  (reference internal/mcpproxy).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,405 @@
+"""`aigw-tpu` CLI — run the gateway standalone (reference cmd/aigw:
+``aigw run`` embeds the whole system in one process, run.go:91-235).
+
+Subcommands:
+  run <config.yaml|bundle-dir>   start the gateway data plane
+  validate <config>              parse + validate a config, print summary
+  tpuserve <model-config>        start the TPU serving engine (tpuserve)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="aigw-tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_run = sub.add_parser("run", help="run the gateway data plane")
+    p_run.add_argument("config", nargs="?", default="",
+                       help="config YAML/bundle dir (omit to autoconfig "
+                            "from env: OPENAI_API_KEY, ANTHROPIC_API_KEY, "
+                            "AZURE_OPENAI_*, TPUSERVE_URL)")
+    p_run.add_argument("--host", default="127.0.0.1")
+    p_run.add_argument("--port", type=int, default=1975)
+    p_run.add_argument("--watch-interval", type=float, default=5.0)
+    p_run.add_argument("--log-level", default="info")
+    p_run.add_argument(
+        "--workers", type=int, default=1,
+        help="worker processes sharing the port via SO_REUSEPORT "
+             "(each runs the full data plane and watches the config; "
+             "requires an explicit --port)")
+
+    p_val = sub.add_parser("validate", help="validate a config file")
+    p_val.add_argument("config")
+
+    p_tr = sub.add_parser(
+        "translate",
+        help="compile a config and print the normalized runtime view "
+             "(resolved translator pairs, auth kinds, quota rules) as JSON",
+    )
+    p_tr.add_argument("config")
+
+    p_hc = sub.add_parser(
+        "healthcheck",
+        help="probe a gateway/tpuserve /health endpoint (exit 0 = healthy)")
+    p_hc.add_argument("url", nargs="?", default="http://127.0.0.1:1975")
+    p_hc.add_argument("--timeout", type=float, default=5.0)
+
+    p_conv = sub.add_parser(
+        "convert", help="import a local HF safetensors dir into an orbax "
+                        "checkpoint usable by tpuserve")
+    p_conv.add_argument("hf_dir")
+    p_conv.add_argument("out_dir")
+
+    p_core = sub.add_parser(
+        "core-config",
+        help="compile the native proxy core's config (native/aigw-core "
+             "serves eligible routes in C++; the rest fall back to the "
+             "Python gateway)")
+    p_core.add_argument("config")
+    p_core.add_argument("-o", "--out", default="aigw-core.json")
+    p_core.add_argument("--listen-host", default="0.0.0.0")
+    p_core.add_argument("--listen-port", type=int, default=1975)
+    p_core.add_argument("--fallback-host", default="127.0.0.1")
+    p_core.add_argument("--fallback-port", type=int, default=1976,
+                        help="where the Python gateway listens (run it "
+                             "with --port matching this)")
+
+    p_serve = sub.add_parser("tpuserve", help="run the TPU serving engine")
+    p_serve.add_argument("--model", required=True,
+                         help="model name or path (see aigw_tpu.models)")
+    p_serve.add_argument("--host", default="127.0.0.1")
+    p_serve.add_argument("--port", type=int, default=8011)
+    p_serve.add_argument("--max-batch-size", type=int, default=8)
+    p_serve.add_argument("--max-seq-len", type=int, default=2048)
+    p_serve.add_argument("--page-size", type=int, default=128)
+    p_serve.add_argument("--hbm-pages", type=int, default=0,
+                         help="KV pages to allocate (0 = auto)")
+    p_serve.add_argument("--tp", type=int, default=1,
+                         help="tensor-parallel degree (devices on the mesh)")
+    p_serve.add_argument("--ep", type=int, default=1,
+                         help="expert-parallel degree (MoE families; mesh "
+                              "is dp=1 × tp × sp × ep)")
+    p_serve.add_argument("--sp", type=int, default=1,
+                         help="sequence-parallel degree: prompts >= "
+                              "--sp-prefill-min-tokens prefill via ring "
+                              "attention over the sp mesh axis")
+    p_serve.add_argument("--sp-prefill-min-tokens", type=int, default=1024,
+                         help="minimum prompt length routed through the "
+                              "sequence-parallel prefill path")
+    p_serve.add_argument("--quantize", default="", choices=["", "int8"],
+                         help="weight-only quantization (W8A16)")
+    p_serve.add_argument("--prefill-chunk-tokens", type=int, default=0,
+                         help="chunk prompts longer than this into "
+                              "fixed-size prefill steps with decode "
+                              "ticks interleaved (0 = off)")
+    p_serve.add_argument("--decode-steps-per-tick", type=int, default=8,
+                         help="fused decode steps per host round-trip")
+    p_serve.add_argument("--spec-tokens", type=int, default=0,
+                         help="prompt-lookup speculative decoding: draft "
+                              "tokens verified per decode step (0 = off); "
+                              "wins on repetitive/extractive generations")
+    p_serve.add_argument("--pallas-attn", action="store_true",
+                         help="ragged paged-attention Pallas kernels for "
+                              "decode and speculative verify (single-chip; "
+                              "HBM reads scale with actual sequence "
+                              "lengths)")
+    p_serve.add_argument("--no-prefix-cache", action="store_true",
+                         help="disable automatic prompt prefix caching")
+    p_serve.add_argument("--lora", action="append", default=[],
+                         metavar="NAME=ORBAX_DIR",
+                         help="load a LoRA adapter (repeatable); serve it "
+                              "via model '<base>:<name>'")
+    p_serve.add_argument("--platform", default="",
+                         help="force a JAX platform (e.g. cpu for the "
+                              "fake-chip mode; default: auto/TPU)")
+    p_serve.add_argument("--log-level", default="info")
+
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=getattr(logging, getattr(args, "log_level", "info").upper(), 20),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+    if args.cmd == "validate":
+        from aigw_tpu.config.model import ConfigError, load_config
+
+        try:
+            cfg = load_config(args.config)
+        except ConfigError as e:
+            print(f"INVALID: {e}", file=sys.stderr)
+            return 1
+        print(
+            f"OK: {len(cfg.backends)} backends, {len(cfg.routes)} routes, "
+            f"{len(cfg.models)} models, {len(cfg.llm_request_costs)} cost metrics"
+        )
+        return 0
+
+    if args.cmd == "healthcheck":
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                args.url.rstrip("/") + "/health", timeout=args.timeout
+            ) as resp:
+                data = _json.loads(resp.read())
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            print(f"UNHEALTHY: {e}", file=sys.stderr)
+            return 1
+        if data.get("status") != "ok":
+            print(f"UNHEALTHY: {data}", file=sys.stderr)
+            return 1
+        print(_json.dumps(data))
+        return 0
+
+    if args.cmd == "core-config":
+        from aigw_tpu.config.model import ConfigError, load_config
+        from aigw_tpu.config.nativecore import (
+            compile_core_config,
+            write_core_config,
+        )
+
+        try:
+            cfg = load_config(args.config)
+        except ConfigError as e:
+            print(f"INVALID: {e}", file=sys.stderr)
+            return 1
+        core, skipped = compile_core_config(
+            cfg,
+            listen_host=args.listen_host,
+            listen_port=args.listen_port,
+            fallback_host=args.fallback_host,
+            fallback_port=args.fallback_port,
+        )
+        write_core_config(args.out, core)
+        print(f"{args.out}: {len(core['rules'])} native rules, "
+              f"fallback {args.fallback_host}:{args.fallback_port}")
+        for s in skipped:
+            print(f"  python-path: {s}")
+        return 0
+
+    if args.cmd == "translate":
+        import json as _json
+
+        from aigw_tpu.config.model import (
+            APISchemaName,
+            ConfigError,
+            load_config,
+        )
+        from aigw_tpu.config.runtime import RuntimeConfig
+        from aigw_tpu.translate import Endpoint, TranslationError, get_translator
+
+        try:
+            cfg = load_config(args.config)
+            rc = RuntimeConfig.build(cfg)
+        except ConfigError as e:
+            print(f"INVALID: {e}", file=sys.stderr)
+            return 1
+        routes = []
+        for route in cfg.routes:
+            rules = []
+            for rule in route.rules:
+                backends = []
+                for ref in rule.backends:
+                    b = cfg.backend(ref.backend)
+                    try:
+                        # probe: is OpenAI-front chat translatable here?
+                        get_translator(Endpoint.CHAT_COMPLETIONS,
+                                       APISchemaName.OPENAI, b.schema.name)
+                        chat_ok = True
+                    except TranslationError:
+                        chat_ok = False
+                    backends.append({
+                        "backend": ref.backend,
+                        "weight": ref.weight,
+                        "priority": ref.priority,
+                        "schema": b.schema.name.value,
+                        "auth": b.auth.kind.value,
+                        "chat_translation": chat_ok,
+                    })
+                rules.append({
+                    "models": list(rule.models),
+                    "model_prefixes": list(rule.model_prefixes),
+                    "backends": backends,
+                })
+            routes.append({"name": route.name, "rules": rules})
+        print(_json.dumps({
+            "version": cfg.version,
+            "routes": routes,
+            "models": [m.name for m in cfg.models],
+            "costs": [c.to_dict() for c in cfg.llm_request_costs],
+            "quotas": len(rc.rate_limiter.rules),
+            "mcp_backends": len((cfg.mcp or {}).get("backends", [])),
+        }, indent=2))
+        return 0
+
+    if args.cmd == "convert":
+        from aigw_tpu.models.checkpoint import (
+            import_hf_checkpoint,
+            save_checkpoint,
+        )
+
+        params = import_hf_checkpoint(args.hf_dir)
+        save_checkpoint(params, args.out_dir)
+        print(f"converted {len(params)} tensors -> {args.out_dir}")
+        return 0
+
+    if args.cmd == "run":
+        from aigw_tpu.config.model import ConfigError
+
+        try:
+            if getattr(args, "workers", 1) > 1:
+                return _run_gateway_workers(args)
+            return asyncio.run(_run_gateway(args))
+        except ConfigError as e:
+            print(f"config error: {e}", file=sys.stderr)
+            return 1
+    if args.cmd == "tpuserve":
+        if args.platform:
+            import jax
+
+            jax.config.update("jax_platforms", args.platform)
+        return asyncio.run(_run_tpuserve(args))
+    return 2
+
+
+def _run_gateway_workers(args: argparse.Namespace) -> int:
+    """Multi-worker mode: N processes share the port via SO_REUSEPORT,
+    the kernel spreading accepted connections across them — the
+    horizontal-scaling answer to the reference's multi-threaded Envoy
+    core (CPython's GIL caps one process at one core). Each worker runs
+    the complete data plane, including its own config watcher, so hot
+    reloads converge within --watch-interval on every worker; state that
+    was already replica-safe across gateway pods (encrypted MCP
+    sessions, quota windows, circuit breakers) is equally worker-local
+    here."""
+    import multiprocessing
+    import os
+    import secrets
+
+    if args.port == 0:
+        print("--workers requires an explicit --port (SO_REUSEPORT "
+              "workers must bind the same port)", file=sys.stderr)
+        return 1
+    # MCP session tokens are encrypted with mcp.session_seed; when it's
+    # unconfigured each process would otherwise mint its own random seed
+    # and tokens issued by one worker would 404 on the others. One
+    # process-group seed (inherited through the spawn env) keeps
+    # sessions valid on every worker.
+    os.environ.setdefault("AIGW_MCP_SESSION_SEED", secrets.token_hex(32))
+    ctx = multiprocessing.get_context("spawn")
+    procs = [
+        ctx.Process(target=_gateway_worker_main, args=(args,), daemon=True)
+        for _ in range(args.workers - 1)
+    ]
+    for p in procs:
+        p.start()
+    try:
+        return asyncio.run(_run_gateway(args, reuse_port=True))
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.join(timeout=5)
+
+
+def _gateway_worker_main(args: argparse.Namespace) -> None:
+    asyncio.run(_run_gateway(args, reuse_port=True))
+
+
+async def _run_gateway(args: argparse.Namespace,
+                       reuse_port: bool = False) -> int:
+    from aigw_tpu.config.runtime import RuntimeConfig
+    from aigw_tpu.config.watcher import ConfigWatcher
+    from aigw_tpu.gateway.server import run_gateway
+
+    holder = {}
+
+    def on_reload(rc):
+        server = holder.get("server")
+        if server is not None:
+            server.set_runtime(rc)
+
+    watcher = None
+    if args.config:
+        watcher = ConfigWatcher(args.config, on_reload,
+                                interval=args.watch_interval)
+        runtime = watcher.load_initial()
+    else:
+        from aigw_tpu.config.autoconfig import autoconfig_from_env
+
+        cfg = autoconfig_from_env()
+        print(f"autoconfig: {len(cfg.backends)} backend(s): "
+              f"{', '.join(b.name for b in cfg.backends)}", flush=True)
+        runtime = RuntimeConfig.build(cfg)
+    server, runner = await run_gateway(runtime, host=args.host,
+                                       port=args.port,
+                                       reuse_port=reuse_port)
+    holder["server"] = server
+    if watcher is not None:
+        await watcher.start()
+    print(f"gateway listening on http://{args.host}:{args.port}", flush=True)
+    await _wait_for_signal()
+    if watcher is not None:
+        await watcher.stop()
+    await runner.cleanup()
+    return 0
+
+
+async def _run_tpuserve(args: argparse.Namespace) -> int:
+    from aigw_tpu.tpuserve.server import run_tpuserve
+
+    lora_adapters = {}
+    for spec_str in args.lora:
+        name, _, path = spec_str.partition("=")
+        if not name or not path:
+            print(f"--lora expects NAME=ORBAX_DIR, got {spec_str!r}",
+                  file=sys.stderr)
+            return 1
+        from aigw_tpu.models.checkpoint import restore_checkpoint
+
+        lora_adapters[name] = restore_checkpoint(path)
+    runner = await run_tpuserve(
+        model=args.model,
+        host=args.host,
+        port=args.port,
+        max_batch_size=args.max_batch_size,
+        max_seq_len=args.max_seq_len,
+        page_size=args.page_size,
+        hbm_pages=args.hbm_pages,
+        tp=args.tp,
+        ep=args.ep,
+        sp=args.sp,
+        quantize=args.quantize,
+        lora_adapters=lora_adapters or None,
+        decode_steps_per_tick=args.decode_steps_per_tick,
+        enable_prefix_cache=not args.no_prefix_cache,
+        sp_prefill_min_tokens=args.sp_prefill_min_tokens,
+        prefill_chunk_tokens=args.prefill_chunk_tokens,
+        spec_tokens=args.spec_tokens,
+        pallas_attn=args.pallas_attn,
+    )
+    print(f"tpuserve listening on http://{args.host}:{args.port}", flush=True)
+    await _wait_for_signal()
+    await runner.cleanup()
+    return 0
+
+
+async def _wait_for_signal() -> None:
+    loop = asyncio.get_running_loop()
+    stop = asyncio.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,49 @@
+"""Gateway configuration model, compiler and hot-reload watcher.
+
+Equivalent of the reference's decoupled data-plane config
+(``internal/filterapi/filterconfig.go:25`` — "must not be tied to k8s")
+plus the controller's config generation (``internal/controller/gateway.go:348``).
+"""
+
+from aigw_tpu.config.model import (
+    APISchema,
+    APISchemaName,
+    AuthConfig,
+    Backend,
+    BodyMutation,
+    Config,
+    ConfigError,
+    HeaderMutation,
+    LLMRequestCost,
+    LLMRequestCostType,
+    Model,
+    Route,
+    RouteRule,
+    RuleBackendRef,
+    MODEL_NAME_HEADER,
+)
+from aigw_tpu.config.runtime import RuntimeConfig
+from aigw_tpu.config.watcher import ConfigWatcher
+from aigw_tpu.config.bundle import write_bundle, read_bundle
+
+__all__ = [
+    "APISchema",
+    "APISchemaName",
+    "AuthConfig",
+    "Backend",
+    "BodyMutation",
+    "Config",
+    "ConfigError",
+    "ConfigWatcher",
+    "HeaderMutation",
+    "LLMRequestCost",
+    "LLMRequestCostType",
+    "MODEL_NAME_HEADER",
+    "Model",
+    "Route",
+    "RouteRule",
+    "RuleBackendRef",
+    "RuntimeConfig",
+    "read_bundle",
+    "write_bundle",
+]

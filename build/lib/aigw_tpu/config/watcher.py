@@ -1,0 +1,89 @@
+"""Config hot-reload watcher.
+
+Polls a config file or bundle directory (default 5s, the reference's tick —
+filterapi/watcher.go:79-145), checksums content to skip no-op reloads, and
+swaps in a freshly built RuntimeConfig on change. A bad new config is logged
+and rejected; the gateway keeps serving the last good one (the reference's
+watcher has the same keep-last-good semantics).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from typing import Awaitable, Callable
+
+from aigw_tpu.config.bundle import read_bundle
+from aigw_tpu.config.model import Config, load_config
+from aigw_tpu.config.runtime import RuntimeConfig
+
+logger = logging.getLogger(__name__)
+
+ReloadCallback = Callable[[RuntimeConfig], None]
+
+
+class ConfigWatcher:
+    def __init__(
+        self,
+        path: str,
+        on_reload: ReloadCallback,
+        interval: float = 5.0,
+    ):
+        self.path = path
+        self.on_reload = on_reload
+        self.interval = interval
+        self._checksum = ""
+        self._task: asyncio.Task | None = None
+        self._current: RuntimeConfig | None = None
+
+    def _load(self) -> Config:
+        if os.path.isdir(self.path):
+            return read_bundle(self.path)
+        return load_config(self.path)
+
+    def load_initial(self) -> RuntimeConfig:
+        """Synchronous first load; raises on invalid config (startup must
+        fail loudly, reloads must not — same split as the reference)."""
+        cfg = self._load()
+        self._checksum = cfg.checksum()
+        rc = RuntimeConfig.build(cfg)
+        self._current = rc
+        self.on_reload(rc)
+        return rc
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._run(), name="config-watcher")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _run(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval)
+            try:
+                cfg = self._load()
+                checksum = cfg.checksum()
+                if checksum == self._checksum:
+                    continue
+                rc = RuntimeConfig.build(cfg, previous=self._current)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # keep last good config
+                logger.warning("config reload failed, keeping current: %s", e)
+                continue
+            self._checksum = checksum
+            self._current = rc
+            self.on_reload(rc)
+            logger.info(
+                "config reloaded (uuid=%s, %d backends, %d routes)",
+                cfg.uuid,
+                len(cfg.backends),
+                len(cfg.routes),
+            )

@@ -1,0 +1,62 @@
+"""Per-backend circuit breaker (Envoy outlier-detection parity).
+
+The reference data plane gets passive health checking from Envoy (outlier
+ejection on consecutive 5xx, reference cluster config); natively: after
+``threshold`` consecutive failures a backend's circuit opens for
+``cooldown`` seconds and the selector skips it, except when every
+candidate is open (fail-static: better to try a suspect backend than to
+reject outright). Any success closes the circuit.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class _State:
+    consecutive_failures: int = 0
+    open_until: float = 0.0
+
+
+class CircuitBreaker:
+    def __init__(self, threshold: int = 5, cooldown: float = 15.0):
+        self.threshold = threshold
+        self.cooldown = cooldown
+        self._states: dict[str, _State] = {}
+
+    def _state(self, backend: str) -> _State:
+        st = self._states.get(backend)
+        if st is None:
+            st = _State()
+            self._states[backend] = st
+        return st
+
+    def record_success(self, backend: str) -> None:
+        st = self._state(backend)
+        st.consecutive_failures = 0
+        st.open_until = 0.0
+
+    def record_failure(self, backend: str, now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        st = self._state(backend)
+        st.consecutive_failures += 1
+        if st.consecutive_failures >= self.threshold:
+            st.open_until = now + self.cooldown
+
+    def is_open(self, backend: str, now: float | None = None) -> bool:
+        now = time.monotonic() if now is None else now
+        st = self._states.get(backend)
+        return st is not None and now < st.open_until
+
+    def snapshot(self) -> dict[str, dict]:
+        now = time.monotonic()
+        return {
+            name: {
+                "consecutive_failures": st.consecutive_failures,
+                "open_for_s": max(0.0, round(st.open_until - now, 1)),
+            }
+            for name, st in self._states.items()
+            if st.consecutive_failures or st.open_until > now
+        }

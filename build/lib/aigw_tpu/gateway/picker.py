@@ -1,0 +1,184 @@
+"""TPU endpoint picker — KV-occupancy- and topology-aware load balancing.
+
+The role the reference delegates to an external EPP service speaking
+ext_proc (InferencePool → picker sets ``x-gateway-destination-endpoint``,
+reference inferencepool.go:47, post_cluster_modify.go:67-80). Here the
+picker is in-process: it polls each tpuserve replica's ``/state``
+telemetry (KV page occupancy, queue depth, active slots — exported by
+aigw_tpu/tpuserve/server.py) and scores endpoints:
+
+    score = kv_occupancy                     (HBM pressure)
+          + queued / max_slots               (waiting work)
+          + active_slots / max_slots * 0.5   (decode batch load)
+
+Session affinity (``x-aigw-session-affinity``, or derived from the
+conversation head by the gateway) is per-endpoint STICKY: the session
+stays on its previous replica — whose prefix cache holds its KV — unless
+that replica's score exceeds the best alternative by
+``STICKINESS_MARGIN``. Unhealthy or stale endpoints are skipped; with no
+telemetry at all the picker falls back to round-robin.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import itertools
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+import aiohttp
+
+logger = logging.getLogger(__name__)
+
+#: request header carrying a session affinity key (optional)
+AFFINITY_HEADER = "x-aigw-session-affinity"
+
+
+@dataclass(frozen=True)
+class Endpoint:
+    address: str  # host:port
+    slice_name: str = ""  # ICI slice / host grouping label
+
+    @staticmethod
+    def parse(value: Any) -> "Endpoint":
+        if isinstance(value, str):
+            return Endpoint(address=value)
+        return Endpoint(address=value["address"],
+                        slice_name=value.get("slice", ""))
+
+
+@dataclass
+class EndpointState:
+    healthy: bool = False
+    kv_occupancy: float = 0.0
+    queued: int = 0
+    active_slots: int = 0
+    max_slots: int = 1
+    updated_at: float = 0.0
+
+
+class EndpointPicker:
+    """Picker for one backend pool."""
+
+    STALE_AFTER = 10.0  # seconds without telemetry → treat as unknown
+
+    def __init__(self, endpoints: list[Endpoint],
+                 poll_interval: float = 1.0):
+        self.endpoints = endpoints
+        self.poll_interval = poll_interval
+        self.state: dict[str, EndpointState] = {
+            e.address: EndpointState() for e in endpoints
+        }
+        self._rr = itertools.cycle([e.address for e in endpoints])
+        # session key → address, LRU-bounded
+        self._affinity: "collections.OrderedDict[str, str]" = (
+            collections.OrderedDict()
+        )
+        self._task: asyncio.Task | None = None
+
+    # -- polling ----------------------------------------------------------
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._poll_loop(),
+                                         name="endpoint-picker")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _poll_loop(self) -> None:
+        async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=2.0)
+        ) as session:
+            while True:
+                await asyncio.gather(
+                    *(self._poll_one(session, e) for e in self.endpoints),
+                    return_exceptions=True,
+                )
+                await asyncio.sleep(self.poll_interval)
+
+    async def _poll_one(self, session: aiohttp.ClientSession,
+                        e: Endpoint) -> None:
+        st = self.state[e.address]
+        try:
+            async with session.get(f"http://{e.address}/state") as resp:
+                if resp.status != 200:
+                    st.healthy = False
+                    return
+                data = await resp.json()
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            st.healthy = False
+            return
+        st.healthy = True
+        st.kv_occupancy = float(data.get("kv_occupancy", 0.0))
+        st.queued = int(data.get("queued", 0))
+        st.active_slots = int(data.get("active_slots", 0))
+        st.max_slots = max(1, int(data.get("max_slots", 1)))
+        st.updated_at = time.monotonic()
+
+    # -- manual state injection (tests / push-based telemetry) ------------
+    def observe(self, address: str, *, kv_occupancy: float = 0.0,
+                queued: int = 0, active_slots: int = 0,
+                max_slots: int = 1) -> None:
+        st = self.state[address]
+        st.healthy = True
+        st.kv_occupancy = kv_occupancy
+        st.queued = queued
+        st.active_slots = active_slots
+        st.max_slots = max(1, max_slots)
+        st.updated_at = time.monotonic()
+
+    # -- picking ----------------------------------------------------------
+    #: a sticky endpoint keeps the session unless its score exceeds the
+    #: best alternative by this much (KV locality beats small load skew)
+    STICKINESS_MARGIN = 0.5
+    _AFFINITY_MAX = 100_000
+
+    def pick(self, headers: dict[str, str] | None = None) -> str | None:
+        """Returns 'host:port' for the request, or None if no endpoints."""
+        if not self.endpoints:
+            return None
+        now = time.monotonic()
+        affinity_key = (headers or {}).get(AFFINITY_HEADER, "")
+        prev_addr = self._affinity.get(affinity_key) if affinity_key else None
+
+        def score_of(e: Endpoint) -> float | None:
+            st = self.state[e.address]
+            if not (st.healthy and now - st.updated_at < self.STALE_AFTER):
+                return None
+            return (
+                st.kv_occupancy
+                + st.queued / st.max_slots
+                + 0.5 * st.active_slots / st.max_slots
+            )
+
+        scores = {e.address: score_of(e) for e in self.endpoints}
+        fresh = {a: s for a, s in scores.items() if s is not None}
+        if not fresh:
+            # no telemetry (cold start / all down): round-robin blindly
+            chosen = next(self._rr)
+        else:
+            best_addr = min(fresh, key=fresh.__getitem__)
+            chosen = best_addr
+            # per-endpoint stickiness: stay on the session's previous
+            # replica (its prefix cache lives there) unless it is now much
+            # worse than the best choice
+            if (
+                prev_addr in fresh
+                and fresh[prev_addr] <= fresh[best_addr]
+                + self.STICKINESS_MARGIN
+            ):
+                chosen = prev_addr
+        if affinity_key:
+            self._affinity[affinity_key] = chosen
+            self._affinity.move_to_end(affinity_key)
+            while len(self._affinity) > self._AFFINITY_MAX:
+                self._affinity.popitem(last=False)  # LRU eviction
+        return chosen

@@ -1,0 +1,176 @@
+"""Token-budget quotas and rate limiting.
+
+Equivalent of the reference's QuotaPolicy CRD + Envoy ratelimit service leg
+(api/v1alpha1/quota_policy.go:26-165, internal/ratelimit/translator —
+descriptor trees keyed backend/model/client selectors) collapsed into one
+in-process engine, keeping the reference's semantics:
+
+- **Enforcement at request time, consumption at end-of-stream**: token
+  costs are only known after the response completes, so a request is
+  admitted if its descriptor buckets currently have budget, and the actual
+  cost is drawn down afterwards (Envoy's ``apply_on_stream_done``,
+  filterconfig.go:84-87). A burst can therefore overshoot one window by
+  in-flight requests — the same behavior as the reference.
+- **Descriptors**: (rule, model, backend, client-key) tuples; the client
+  key comes from a configurable request header.
+- **Fixed windows** aligned to the unit boundary, like the Envoy ratelimit
+  service's per-unit counters.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from aigw_tpu.config.model import ConfigError
+
+
+@dataclass(frozen=True)
+class QuotaRule:
+    """One quota: budget of a cost metric per time window, optionally
+    scoped to model/backend and keyed by a client header."""
+
+    name: str
+    metadata_key: str  # which LLMRequestCost metric to draw down
+    limit: int
+    window_seconds: float = 60.0
+    model: str = ""  # "" = any
+    backend: str = ""  # "" = any
+    client_key_header: str = ""  # "" = one global bucket
+
+    @staticmethod
+    def parse(value: dict[str, Any]) -> "QuotaRule":
+        try:
+            rule = QuotaRule(
+                name=value["name"],
+                metadata_key=value["metadata_key"],
+                limit=int(value["limit"]),
+                window_seconds=float(value.get("window_seconds", 60.0)),
+                model=value.get("model", ""),
+                backend=value.get("backend", ""),
+                client_key_header=str(
+                    value.get("client_key_header", "")
+                ).lower(),
+            )
+        except KeyError as e:
+            raise ConfigError(f"quota rule missing field {e}") from None
+        if rule.limit <= 0 or rule.window_seconds <= 0:
+            raise ConfigError(f"quota {rule.name}: limit/window must be > 0")
+        return rule
+
+
+@dataclass
+class _Window:
+    start: float
+    used: int
+
+
+class RateLimiter:
+    """In-process descriptor-keyed fixed-window limiter."""
+
+    _SWEEP_EVERY = 1024  # bucket insertions between stale-window sweeps
+
+    def __init__(self, rules: list[QuotaRule]):
+        self.rules = rules
+        self._windows: dict[tuple[str, str], _Window] = {}
+        self._inserts = 0
+        self._window_by_rule = {r.name: r.window_seconds for r in rules}
+
+    def adopt(self, previous: "RateLimiter | None") -> "RateLimiter":
+        """Carry in-flight window counters across a config hot reload so
+        a reload never refills exhausted budgets (rules are matched by
+        name+shape; changed rules start fresh)."""
+        if previous is None:
+            return self
+        prev_rules = {r.name: r for r in previous.rules}
+        keep = {
+            r.name for r in self.rules if prev_rules.get(r.name) == r
+        }
+        for key, window in previous._windows.items():
+            if key[0] in keep:
+                self._windows[key] = window
+        return self
+
+    @staticmethod
+    def from_config_value(value: Any) -> "RateLimiter":
+        rules = [QuotaRule.parse(v) for v in (value or ())]
+        return RateLimiter(rules)
+
+    def _matching(self, model: str, backend: str) -> list[QuotaRule]:
+        return [
+            r
+            for r in self.rules
+            if (not r.model or r.model == model)
+            and (not r.backend or r.backend == backend)
+        ]
+
+    def _bucket(self, rule: QuotaRule, client_key: str,
+                now: float) -> _Window:
+        key = (rule.name, client_key)
+        w = self._windows.get(key)
+        window_start = now - (now % rule.window_seconds)
+        if w is None or w.start != window_start:
+            w = _Window(start=window_start, used=0)
+            self._windows[key] = w
+            self._inserts += 1
+            if self._inserts % self._SWEEP_EVERY == 0:
+                self._sweep(now)
+        return w
+
+    def _sweep(self, now: float) -> None:
+        """Evict expired windows so client-controlled keys can't grow
+        memory without bound."""
+        dead = [
+            k
+            for k, w in self._windows.items()
+            if now - w.start > 2 * self._window_by_rule.get(k[0], 3600.0)
+        ]
+        for k in dead:
+            del self._windows[k]
+
+    def check(
+        self,
+        model: str,
+        backend: str,
+        headers: dict[str, str],
+        now: float | None = None,
+    ) -> tuple[bool, "QuotaRule | None"]:
+        """(True, None) if the request may proceed; otherwise
+        (False, the violated rule)."""
+        now = time.time() if now is None else now
+        for rule in self._matching(model, backend):
+            client_key = headers.get(rule.client_key_header, "") \
+                if rule.client_key_header else ""
+            w = self._bucket(rule, client_key, now)
+            if w.used >= rule.limit:
+                return False, rule
+        return True, None
+
+    def consume(
+        self,
+        costs: dict[str, int],
+        model: str,
+        backend: str,
+        headers: dict[str, str],
+        now: float | None = None,
+    ) -> None:
+        """Draw down matched buckets at end-of-stream."""
+        now = time.time() if now is None else now
+        for rule in self._matching(model, backend):
+            cost = costs.get(rule.metadata_key)
+            if not cost:
+                continue
+            client_key = headers.get(rule.client_key_header, "") \
+                if rule.client_key_header else ""
+            self._bucket(rule, client_key, now).used += cost
+
+    def remaining(
+        self, rule_name: str, client_key: str = "", now: float | None = None
+    ) -> int | None:
+        for rule in self.rules:
+            if rule.name == rule_name:
+                now = time.time() if now is None else now
+                w = self._bucket(rule, client_key, now)
+                return max(0, rule.limit - w.used)
+        return None

@@ -1,0 +1,1066 @@
+"""The native gateway data-plane server.
+
+Replaces the reference's Envoy + ext_proc pair (internal/extproc/server.go,
+processor_impl.go) with one native server that keeps the reference's
+deepest design insight — the **two-phase processor**:
+
+  Phase 1 (route selection): parse the body only enough to extract the
+  model, stamp the model header, match a route. The original parsed body is
+  captured. (≈ routerProcessor.ProcessRequestBody, processor_impl.go:213)
+
+  Phase 2 (upstream, per attempt): against the finally-chosen backend,
+  translate the captured body to the backend schema, apply header/body
+  mutations, inject credentials, send. A retry/fallover constructs a fresh
+  translator and re-translates from the captured body — which is what makes
+  fallback *across schemas* work (processor_impl.go:73-131,334-339).
+
+Streaming responses flow through the translator chunk-by-chunk with token
+usage mined mid-stream; cost metadata is produced at end-of-stream and fed
+to the quota/rate-limit engine (≈ Envoy dynamic metadata consumed by the
+rate-limit filter, filterconfig.go:84-87).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import time
+from typing import Any, Callable
+
+import aiohttp
+from aiohttp import web
+
+from aigw_tpu.config.model import (
+    Config,
+    DESTINATION_ENDPOINT_HEADER,
+    MODEL_NAME_HEADER,
+    ORIGINAL_PATH_HEADER,
+    APISchemaName,
+)
+from aigw_tpu.config.runtime import RuntimeBackend, RuntimeConfig
+from aigw_tpu.gateway.auth import AuthError
+from aigw_tpu.gateway.circuit import CircuitBreaker
+from aigw_tpu.gateway.costs import TokenUsage
+from aigw_tpu.gateway.mutators import apply_body_mutation, apply_header_mutation
+from aigw_tpu.gateway.picker import (
+    AFFINITY_HEADER,
+    Endpoint as PickerEndpoint,
+    EndpointPicker,
+)
+from aigw_tpu.gateway.router import BackendSelector, NoRouteError, match_route
+from aigw_tpu.obs.metrics import GenAIMetrics, RequestMetrics
+from aigw_tpu.obs.tracing import (
+    DEFAULT_HEADER_ATTRIBUTES,
+    SpanContext,
+    Tracer,
+    genai_attributes,
+    header_attributes,
+    parse_header_attribute_mapping,
+)
+from aigw_tpu.schemas import anthropic as anth
+from aigw_tpu.schemas import openai as oai
+from aigw_tpu.translate import Endpoint, TranslationError, get_translator
+
+logger = logging.getLogger(__name__)
+
+#: endpoint path → (Endpoint, front schema, metrics operation)
+_ENDPOINTS: dict[str, tuple[Endpoint, APISchemaName, str]] = {
+    Endpoint.CHAT_COMPLETIONS.value: (
+        Endpoint.CHAT_COMPLETIONS, APISchemaName.OPENAI, "chat"),
+    Endpoint.COMPLETIONS.value: (
+        Endpoint.COMPLETIONS, APISchemaName.OPENAI, "text_completion"),
+    Endpoint.EMBEDDINGS.value: (
+        Endpoint.EMBEDDINGS, APISchemaName.OPENAI, "embeddings"),
+    Endpoint.MESSAGES.value: (
+        Endpoint.MESSAGES, APISchemaName.ANTHROPIC, "chat"),
+    Endpoint.TOKENIZE.value: (
+        Endpoint.TOKENIZE, APISchemaName.OPENAI, "tokenize"),
+    Endpoint.RESPONSES.value: (
+        Endpoint.RESPONSES, APISchemaName.OPENAI, "responses"),
+    Endpoint.IMAGES_GENERATIONS.value: (
+        Endpoint.IMAGES_GENERATIONS, APISchemaName.OPENAI, "image_generation"),
+    Endpoint.RERANK.value: (
+        Endpoint.RERANK, APISchemaName.COHERE, "rerank"),
+    Endpoint.AUDIO_SPEECH.value: (
+        Endpoint.AUDIO_SPEECH, APISchemaName.OPENAI, "audio_speech"),
+    Endpoint.AUDIO_TRANSCRIPTIONS.value: (
+        Endpoint.AUDIO_TRANSCRIPTIONS, APISchemaName.OPENAI,
+        "audio_transcription"),
+    Endpoint.AUDIO_TRANSLATIONS.value: (
+        Endpoint.AUDIO_TRANSLATIONS, APISchemaName.OPENAI,
+        "audio_translation"),
+}
+
+#: endpoints whose request body is multipart/form-data, not JSON — these
+#: pass through untranslated (model extracted from the form part; the
+#: reference's ParseMultipartBody, endpointspec.go)
+_MULTIPART_ENDPOINTS = {
+    Endpoint.AUDIO_TRANSCRIPTIONS,
+    Endpoint.AUDIO_TRANSLATIONS,
+}
+
+
+def _conversation_affinity_key(body: dict) -> str:
+    """Key a conversation by its STABLE head — the system prompt(s) plus
+    the first user message. Unlike the growing message prefix, the head is
+    identical on every turn of one chat, so the picker can pin the
+    conversation to the replica whose prefix cache holds it; distinct
+    conversations differ in their first user message."""
+    import hashlib as _hashlib
+    import json as _json
+
+    messages = body.get("messages")
+    if not isinstance(messages, list) or not messages:
+        return ""
+    head: list = []
+    first_user = None
+    for m in messages:
+        if not isinstance(m, dict):
+            return ""
+        role = m.get("role")
+        if role in ("system", "developer"):
+            head.append(m)
+        elif role == "user":
+            first_user = m
+            break
+        else:
+            break
+    if first_user is None:
+        return ""
+    head.append(first_user)
+    blob = _json.dumps(head, sort_keys=True).encode()
+    return _hashlib.blake2b(blob, digest_size=12).hexdigest()
+
+
+def _multipart_model(raw: bytes, content_type: str) -> str:
+    """Extract the `model` form field from a multipart body without
+    touching the (possibly large) audio parts."""
+    import re as _re
+
+    m = _re.search(r'boundary="?([^";,]+)"?', content_type)
+    if not m:
+        return ""
+    boundary = b"--" + m.group(1).encode()
+    for part in raw.split(boundary):
+        header_end = part.find(b"\r\n\r\n")
+        if header_end < 0:
+            continue
+        headers = part[:header_end]
+        if b'name="model"' in headers:
+            return (
+                part[header_end + 4 :]
+                .rstrip(b"\r\n-")
+                .decode("utf-8", errors="replace")
+                .strip()
+            )
+    return ""
+
+#: upstream statuses that trigger failover to the next backend
+_RETRIABLE_STATUS = {429, 500, 502, 503, 504}
+
+CostSink = Callable[[dict[str, int], dict[str, str]], Any]
+
+
+class _RawBody:
+    """Non-JSON (multipart) request carried through phase 2 untranslated."""
+
+    def __init__(self, raw: bytes, content_type: str, model: str):
+        self.raw = raw
+        self.content_type = content_type
+        self.model = model
+
+
+class GatewayServer:
+    """aiohttp application hosting the full data plane."""
+
+    def __init__(
+        self,
+        runtime: RuntimeConfig,
+        *,
+        metrics: GenAIMetrics | None = None,
+        cost_sink: CostSink | None = None,
+        tracer: Tracer | None = None,
+    ):
+        self._runtime = runtime
+        self.metrics = metrics or GenAIMetrics()
+        self.tracer = tracer or Tracer()
+        # request-header → span-attribute mapping (reference
+        # requestheaderattrs; default agent-session-id:session.id)
+        self._header_attrs = parse_header_attribute_mapping(
+            os.environ.get("AIGW_HEADER_ATTRIBUTES",
+                           DEFAULT_HEADER_ATTRIBUTES)
+        )
+        self._cost_sink = cost_sink
+        # OpenInference privacy knobs + structured access log (reference:
+        # openinference/config.go env vars; Envoy access-log enrichment)
+        from aigw_tpu.obs.accesslog import AccessLogger
+        from aigw_tpu.obs.openinference import TraceConfig as OITraceConfig
+
+        self._oi_config = OITraceConfig.from_env()
+        self.access_log = AccessLogger()
+        self.circuit = CircuitBreaker()
+        self._session: aiohttp.ClientSession | None = None
+        self.app = web.Application(client_max_size=64 * 1024 * 1024)
+        for path in _ENDPOINTS:
+            self.app.router.add_post(path, self._handle)
+        self.app.router.add_get("/v1/models", self._handle_models)
+        self.app.router.add_get("/health", self._handle_health)
+        self.app.router.add_get("/metrics", self._handle_metrics)
+        # debug/admin surface (reference: pprof :6060 + admin server on a
+        # separate local port, internal/pprof/pprof.go:18-40). Off by
+        # default on the data-plane port — any API client could otherwise
+        # read thread stacks and config topology; opt in with
+        # AIGW_ENABLE_DEBUG=true (e.g. when bound to localhost).
+        if os.environ.get("AIGW_ENABLE_DEBUG", "").lower() == "true":
+            self.app.router.add_get("/debug/config", self._handle_debug_config)
+            self.app.router.add_get("/debug/stacks", self._handle_debug_stacks)
+        self._pickers: dict[str, EndpointPicker] = {}
+        self._picker_tasks: set[asyncio.Task] = set()
+        self._build_pickers(runtime)
+        self.app.on_startup.append(self._start_pickers)
+        # MCP proxy is always registered (default path /mcp) so a config
+        # hot-reload can add/change backends, filters, and authz without a
+        # restart — only the HTTP *path* is fixed once the router freezes
+        # (the reference hot-reloads MCPConfig through the same filterapi
+        # bundle watcher as routes).
+        from aigw_tpu.mcp import MCPConfig, MCPProxy
+        from aigw_tpu.obs.metrics import MCPMetrics
+
+        self.mcp = MCPProxy(
+            MCPConfig.parse(runtime.config.mcp or {}),
+            metrics=MCPMetrics(self.metrics.registry),
+        )
+        self.mcp.register(self.app)
+        self.app.on_cleanup.append(self._cleanup)
+
+    # -- lifecycle --------------------------------------------------------
+    @property
+    def runtime(self) -> RuntimeConfig:
+        return self._runtime
+
+    def set_runtime(self, rc: RuntimeConfig) -> None:
+        """Hot-swap config (called by ConfigWatcher). Pickers whose
+        endpoint pools are unchanged are reused so telemetry and session
+        affinity survive reloads."""
+        self._runtime = rc
+        from aigw_tpu.mcp import MCPConfig
+
+        self.mcp.update_config(MCPConfig.parse(rc.config.mcp or {}))
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        old = self._pickers
+        self._build_pickers(rc)
+        if loop is not None:
+            for name, picker in old.items():
+                if self._pickers.get(name) is not picker:
+                    self._spawn(loop, picker.stop())
+            for name, picker in self._pickers.items():
+                if old.get(name) is not picker:
+                    self._spawn(loop, picker.start())
+
+    def _spawn(self, loop: asyncio.AbstractEventLoop, coro) -> None:
+        # the loop holds tasks weakly; retain refs until completion
+        task = loop.create_task(coro)
+        self._picker_tasks.add(task)
+        task.add_done_callback(self._picker_tasks.discard)
+
+    def _build_pickers(self, rc: RuntimeConfig) -> None:
+        from aigw_tpu.config.model import _thaw
+
+        pickers: dict[str, EndpointPicker] = {}
+        for name, rb in rc.backends.items():
+            b = rb.backend
+            if not b.endpoints:
+                continue
+            prev = self._pickers.get(name)
+            key = (b.endpoints, b.picker_poll_interval)
+            if prev is not None and getattr(prev, "_config_key", None) == key:
+                pickers[name] = prev  # unchanged pool: keep state
+                continue
+            picker = EndpointPicker(
+                [PickerEndpoint.parse(_thaw(e)) for e in b.endpoints],
+                poll_interval=b.picker_poll_interval,
+            )
+            picker._config_key = key  # type: ignore[attr-defined]
+            pickers[name] = picker
+        self._pickers = pickers
+
+    async def _start_pickers(self, _app) -> None:
+        for picker in self._pickers.values():
+            await picker.start()
+
+    async def _get_session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                auto_decompress=True,
+                timeout=aiohttp.ClientTimeout(total=None),
+            )
+        return self._session
+
+    async def _cleanup(self, _app: web.Application) -> None:
+        for picker in self._pickers.values():
+            await picker.stop()
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    # -- admin endpoints --------------------------------------------------
+    async def _handle_health(self, _request: web.Request) -> web.Response:
+        return web.json_response({
+            "status": "ok",
+            "uuid": self._runtime.config.uuid,
+            "circuit": self.circuit.snapshot(),
+        })
+
+    async def _handle_metrics(self, _request: web.Request) -> web.Response:
+        return web.Response(body=self.metrics.export(),
+                            content_type="text/plain")
+
+    async def _handle_models(self, request: web.Request) -> web.Response:
+        """/v1/models — configured models, host-scoped like the
+        reference's ModelsByHost (models_processor.go:30-150): models whose
+        serving routes are restricted to other hostnames are hidden."""
+        rc = self._runtime
+        host = request.host.split(":")[0].lower()
+        visible_rules = [
+            rule for route in rc.routes_for_host(host) for rule in route.rules
+        ]
+
+        def visible(name: str) -> bool:
+            probe = {MODEL_NAME_HEADER: name}
+            return any(r.matches(probe) for r in visible_rules)
+
+        body = oai.models_response(
+            (m.name, m.owned_by, m.created_at)
+            for m in rc.config.models
+            if visible(m.name)
+        )
+        return web.json_response(body)
+
+    async def _handle_debug_config(self, _request: web.Request) -> web.Response:
+        """Redacted view of the live config (credentials masked)."""
+        import json as _json
+
+        from aigw_tpu.utils.redaction import SENSITIVE_HEADERS  # noqa: F401
+
+        cfg = self._runtime.config.to_dict()
+        for b in cfg.get("backends", ()):
+            if "auth" in b:
+                b["auth"] = {"kind": b["auth"].get("kind", "?"),
+                             "credentials": "[REDACTED]"}
+        if "mcp" in cfg and isinstance(cfg["mcp"], dict):
+            cfg["mcp"] = dict(cfg["mcp"])
+            cfg["mcp"].pop("session_seed", None)
+            cfg["mcp"].pop("session_fallback_seed", None)
+        return web.json_response(cfg)
+
+    async def _handle_debug_stacks(self, _request: web.Request) -> web.Response:
+        """Thread stack dump — the pprof-goroutine equivalent."""
+        import sys as _sys
+        import traceback as _tb
+
+        out = []
+        for tid, frame in _sys._current_frames().items():
+            out.append(f"--- thread {tid} ---")
+            out.extend(_tb.format_stack(frame))
+        return web.Response(text="\n".join(out),
+                            content_type="text/plain")
+
+    def _log_rejection(
+        self, request: web.Request, status: int, started: float,
+        model: str = "", reason: str = "",
+    ) -> None:
+        """Access-log line for requests rejected before the attempt loop
+        (schema 400s, unknown-model 404s) — the lines operators grep for
+        when debugging client misconfiguration."""
+        if not self.access_log.enabled:
+            return
+        from aigw_tpu.obs.openinference import error_type_for_status
+
+        self.access_log.log(
+            method=request.method,
+            path=request.path,
+            status=status,
+            duration_ms=(time.monotonic() - started) * 1000.0,
+            model=model,
+            error_type=reason or error_type_for_status(status),
+            client=request.remote or "",
+            request_id=request.headers.get("x-request-id", ""),
+        )
+
+    # -- the data plane ---------------------------------------------------
+    async def _handle(self, request: web.Request) -> web.StreamResponse:
+        endpoint, front_schema, operation = _ENDPOINTS[request.path]
+        rc = self._runtime  # pin the config for this request
+        started = time.monotonic()
+        error_body = (
+            anth.error_body
+            if front_schema is APISchemaName.ANTHROPIC
+            else oai.error_body
+        )
+        try:
+            raw = await request.read()
+        except (aiohttp.web.RequestPayloadError,
+                aiohttp.http_exceptions.HttpProcessingError) as e:
+            # e.g. a corrupt gzip request body fails the server-side
+            # inflater mid-read — that's the client's 400, not our 500
+            self._log_rejection(request, 400, started,
+                                reason="bad_request_body")
+            return web.Response(
+                status=400,
+                body=error_body(f"unreadable request body: {e}"),
+                content_type="application/json")
+        # compressed request bodies (reference: extproc decodes encoded
+        # bodies before translation, util.go decodeContentIfNeeded; the
+        # inference-extension conformance drives gzipped JSON).
+        # aiohttp's server layer transparently inflates supported
+        # codings and 400s unsupported/corrupt ones at read time (the
+        # try/except above); this fallback only fires when gzip bytes
+        # reach us undecoded (magic 1f 8b — e.g. behind a raw
+        # transport). The translated upstream body is re-serialized, so
+        # the encoding is consumed and never forwarded.
+        enc = request.headers.get("content-encoding", "").lower().strip()
+        if enc == "gzip" and raw[:2] == b"\x1f\x8b":
+            import gzip as _gzip
+            import zlib as _zlib
+
+            try:
+                raw = _gzip.decompress(raw)
+            except (OSError, EOFError, _zlib.error):
+                self._log_rejection(request, 400, started,
+                                    reason="bad_encoding")
+                return web.Response(
+                    status=400,
+                    body=error_body("invalid gzip request body"),
+                    content_type="application/json")
+        # ---- phase 1: route selection ----------------------------------
+        if endpoint in _MULTIPART_ENDPOINTS:
+            ctype = request.headers.get("content-type", "")
+            model = _multipart_model(raw, ctype)
+            if not model:
+                self._log_rejection(request, 400, started,
+                                    reason="missing_model")
+                return web.Response(
+                    status=400,
+                    body=error_body("missing 'model' form field"),
+                    content_type="application/json")
+            body: Any = _RawBody(raw, ctype, model)
+        else:
+            try:
+                body = oai.parse_json_body(raw)
+                model = oai.request_model(body)
+                if endpoint is Endpoint.CHAT_COMPLETIONS:
+                    oai.validate_chat_request(body)
+                elif endpoint is Endpoint.MESSAGES:
+                    anth.validate_messages_request(body)
+            except oai.SchemaError as e:
+                self._log_rejection(request, 400, started,
+                                    reason="invalid_request")
+                return web.Response(
+                    status=400, body=error_body(str(e)),
+                    content_type="application/json")
+        client_headers = {k.lower(): v for k, v in request.headers.items()}
+        match_headers = {
+            **client_headers,
+            MODEL_NAME_HEADER: model,
+            ORIGINAL_PATH_HEADER: request.path,
+        }
+        try:
+            match = match_route(rc, request.host, match_headers)
+        except NoRouteError:
+            self._log_rejection(request, 404, started, model=model,
+                                reason="model_not_found")
+            return web.Response(
+                status=404,
+                body=error_body(
+                    f"model {model!r} is not served by this gateway",
+                    type_="model_not_found" if front_schema is APISchemaName.OPENAI
+                    else "not_found_error",
+                ),
+                content_type="application/json",
+            )
+
+        req_metrics = RequestMetrics(
+            metrics=self.metrics, operation=operation, request_model=model
+        )
+        selector = BackendSelector(rule=match.rule, circuit=self.circuit)
+        route_name = match.route.name
+
+        # tracing: continue the caller's trace, span per gateway request
+        # (reference: router processor starts the span and injects headers,
+        # processor_impl.go:289-295)
+        span = None
+        if self.tracer.enabled:
+            parent = SpanContext.parse(client_headers.get("traceparent", ""))
+            span = self.tracer.start_span(f"{operation} {model}", parent)
+            span.attributes.update(
+                header_attributes(client_headers, self._header_attrs)
+            )
+            if isinstance(body, dict):
+                span.attributes.update(
+                    self._openinference_request_attrs(endpoint, body, raw)
+                )
+
+        # ---- phase 2: upstream attempts --------------------------------
+        status = 500
+        try:
+            resp_out = await self._attempt_loop(
+                request, endpoint, front_schema, selector, rc, body,
+                req_metrics, route_name, error_body, client_headers, span,
+            )
+            status = resp_out.status
+            return resp_out
+        finally:
+            if span is not None:
+                span.attributes.update(
+                    genai_attributes(
+                        operation=operation,
+                        request_model=model,
+                        response_model=req_metrics.response_model,
+                        backend=req_metrics.provider,
+                        input_tokens=req_metrics.final_usage.input_tokens,
+                        output_tokens=req_metrics.final_usage.output_tokens,
+                        streaming=req_metrics.tokens_seen > 0,
+                    )
+                )
+                if req_metrics.error_type:
+                    span.record_error(req_metrics.error_type)
+                span.end()
+            if self.access_log.enabled:
+                from aigw_tpu.obs.openinference import error_type_for_status
+
+                err = req_metrics.error_type
+                if err.isdigit():
+                    err = error_type_for_status(int(err))
+                self.access_log.log(
+                    method=request.method,
+                    path=request.path,
+                    status=status,
+                    duration_ms=(time.monotonic()
+                                 - req_metrics.start) * 1000.0,
+                    route=route_name,
+                    backend=req_metrics.provider,
+                    model=model,
+                    response_model=req_metrics.response_model,
+                    stream=req_metrics.tokens_seen > 0,
+                    input_tokens=req_metrics.final_usage.input_tokens,
+                    output_tokens=req_metrics.final_usage.output_tokens,
+                    total_tokens=req_metrics.final_usage.total_tokens,
+                    cached_tokens=(
+                        req_metrics.final_usage.cached_input_tokens),
+                    costs=req_metrics.costs,
+                    error_type=err,
+                    client=request.remote or "",
+                    trace_id=(span.context.trace_id
+                              if span is not None else ""),
+                    request_id=client_headers.get("x-request-id", ""),
+                    attempts=req_metrics.attempts,
+                )
+
+    def _openinference_request_attrs(
+        self, endpoint: Endpoint, body: dict[str, Any], raw: bytes
+    ) -> dict[str, Any]:
+        from aigw_tpu.obs import openinference as oi
+
+        try:
+            if endpoint is Endpoint.CHAT_COMPLETIONS:
+                return oi.chat_request_attributes(
+                    body, raw, self._oi_config)
+            if endpoint is Endpoint.MESSAGES:
+                return oi.chat_request_attributes(
+                    body, raw, self._oi_config,
+                    system=oi.LLM_SYSTEM_ANTHROPIC)
+            if endpoint is Endpoint.EMBEDDINGS:
+                return oi.embeddings_request_attributes(
+                    body, raw, self._oi_config)
+            if endpoint is Endpoint.COMPLETIONS:
+                return oi.completion_request_attributes(
+                    body, raw, self._oi_config)
+        except Exception:  # noqa: BLE001 — telemetry must never 500
+            logger.debug("openinference request attrs failed",
+                         exc_info=True)
+        return {}
+
+    def _oi_response_builder(self, endpoint: Endpoint):
+        """One endpoint→builder dispatch for both the unary and
+        streaming span-attribute paths (endpoint MESSAGES ⇔ the
+        Anthropic front)."""
+        from aigw_tpu.obs import openinference as oi
+
+        return {
+            Endpoint.CHAT_COMPLETIONS: oi.chat_response_attributes,
+            Endpoint.MESSAGES: oi.anthropic_response_attributes,
+            Endpoint.EMBEDDINGS: oi.embeddings_response_attributes,
+            Endpoint.COMPLETIONS: oi.completion_response_attributes,
+        }.get(endpoint)
+
+    def _openinference_response_attrs(
+        self, span, endpoint: Endpoint, payload: bytes,
+    ) -> None:
+        builder = self._oi_response_builder(endpoint)
+        if builder is None:
+            return
+        try:
+            resp = json.loads(payload)
+            if not isinstance(resp, dict):
+                return
+            span.attributes.update(builder(resp, self._oi_config))
+        except Exception:  # noqa: BLE001 — telemetry must never 500
+            logger.debug("openinference response attrs failed",
+                         exc_info=True)
+
+    async def _attempt_loop(
+        self, request, endpoint, front_schema, selector, rc, body,
+        req_metrics, route_name, error_body, client_headers, span,
+    ) -> web.StreamResponse:
+        last_error: tuple[int, bytes] = (
+            502,
+            error_body("all upstream backends failed",
+                       type_="upstream_error"),
+        )
+        attempt = 0
+        while True:
+            ref = selector.next_backend()
+            if ref is None:
+                break
+            rb = rc.backends[ref.backend]
+            if attempt > 0:
+                self.metrics.retries_total.labels(route_name, rb.backend.name).inc()
+            attempt += 1
+            req_metrics.attempts = attempt
+            req_metrics.provider = rb.backend.name
+            try:
+                result = await self._attempt(
+                    request, endpoint, front_schema, rb, body,
+                    req_metrics, route_name, error_body, client_headers,
+                    span,
+                )
+            except _RetriableUpstreamError as e:
+                logger.warning(
+                    "backend %s failed (%s), trying next", rb.backend.name, e
+                )
+                if e.count_failure:
+                    self.circuit.record_failure(rb.backend.name)
+                last_error = (e.status, e.client_body)
+                self.metrics.requests_total.labels(
+                    route_name, rb.backend.name, str(e.status)
+                ).inc()
+                continue
+            except AuthError as e:
+                req_metrics.finish(TokenUsage(), error_type="auth")
+                return web.Response(
+                    status=401, body=error_body(str(e), type_="authentication_error"),
+                    content_type="application/json")
+            except (TranslationError, oai.SchemaError) as e:
+                req_metrics.finish(TokenUsage(), error_type="translation")
+                status = getattr(e, "status", 400)  # NotFoundError → 404
+                return web.Response(
+                    status=status,
+                    body=error_body(
+                        str(e),
+                        type_="not_found" if status == 404
+                        else "invalid_request_error"),
+                    content_type="application/json")
+            self.circuit.record_success(rb.backend.name)
+            return result
+
+        req_metrics.finish(TokenUsage(), error_type="upstream_exhausted")
+        return web.Response(
+            status=last_error[0], body=last_error[1],
+            content_type="application/json")
+
+    async def _attempt(
+        self,
+        request: web.Request,
+        endpoint: Endpoint,
+        front_schema: APISchemaName,
+        rb: RuntimeBackend,
+        body: dict[str, Any],
+        req_metrics: RequestMetrics,
+        route_name: str,
+        error_body: Callable[..., bytes],
+        client_headers: dict[str, str],
+        span=None,
+    ) -> web.StreamResponse:
+        backend = rb.backend
+        if rc_limited := self._check_quota(client_headers, rb, req_metrics,
+                                           error_body):
+            return rc_limited
+        if isinstance(body, _RawBody):
+            # multipart passthrough: no translation, original bytes forward
+            from aigw_tpu.translate.base import RequestTx as _RequestTx
+
+            translator = get_translator(
+                Endpoint.CHAT_COMPLETIONS,  # response side is passthrough
+                APISchemaName.OPENAI,
+                APISchemaName.OPENAI,
+            )
+            path = request.path
+            if backend.schema.name is APISchemaName.AZURE_OPENAI:
+                from aigw_tpu.translate.openai_azure import (
+                    DEFAULT_API_VERSION,
+                    _ENDPOINT_SUFFIX,
+                )
+                import urllib.parse as _up2
+
+                dep = _up2.quote(
+                    backend.model_name_override or body.model, safe="")
+                path = (
+                    f"/openai/deployments/{dep}/"
+                    f"{_ENDPOINT_SUFFIX[endpoint]}"
+                    f"?api-version="
+                    f"{backend.schema.version or DEFAULT_API_VERSION}"
+                )
+            tx = _RequestTx(body=body.raw, path=path)
+            out_body = tx.body
+            headers = {
+                "content-type": body.content_type,
+                "accept": "application/json",
+            }
+        else:
+            translator = get_translator(
+                endpoint,
+                front_schema,
+                backend.schema.name,
+                model_name_override=backend.model_name_override,
+                out_version=backend.schema.version,
+            )
+            # Retry safety: translators are contractually read-only over
+            # the captured body (they build fresh structures — the
+            # reference's sjson no-in-place rule, translator.go:140-153),
+            # so each attempt can re-translate without a deep copy.
+            tx = translator.request(body)
+            out_body = apply_body_mutation(tx.body, backend.body_mutation)
+
+            headers = {
+                "content-type": "application/json",
+                "accept": "text/event-stream" if tx.stream
+                else "application/json",
+            }
+        # Endpoint-picker support: an externally pre-selected destination
+        # (the reference's x-gateway-destination-endpoint + ORIGINAL_DST
+        # contract, post_cluster_modify.go:67-80) wins; otherwise the
+        # in-process picker chooses a replica from the backend's pool.
+        dest = request.headers.get(DESTINATION_ENDPOINT_HEADER, "")
+        if not dest and backend.name in self._pickers:
+            pick_headers = client_headers
+            if (
+                backend.picker_content_affinity
+                and AFFINITY_HEADER not in client_headers
+                and isinstance(body, dict)
+            ):
+                key = _conversation_affinity_key(body)
+                if key:
+                    pick_headers = dict(client_headers)
+                    pick_headers[AFFINITY_HEADER] = key
+            dest = self._pickers[backend.name].pick(pick_headers) or ""
+        base_url = f"http://{dest}" if dest else backend.url
+        if not base_url:
+            raise _RetriableUpstreamError(
+                502, error_body(f"backend {backend.name} has no url"),
+                "missing url")
+        headers.update(tx.headers)
+        if span is not None:
+            headers["traceparent"] = span.context.traceparent()
+        headers = apply_header_mutation(headers, backend.header_mutation)
+        import urllib.parse as _up
+
+        headers["host"] = _up.urlsplit(base_url).netloc
+        path = tx.path or request.path
+        headers, path = rb.auth_handler.apply(headers, out_body, path)
+
+        if logger.isEnabledFor(logging.DEBUG):
+            from aigw_tpu.utils.redaction import redact_body, redact_headers
+
+            logger.debug(
+                "upstream attempt backend=%s path=%s headers=%s body=%s",
+                backend.name, path, redact_headers(headers),
+                redact_body(body) if not isinstance(body, _RawBody)
+                else f"[multipart {len(body.raw)} bytes]",
+            )
+        session = await self._get_session()
+        timeout = aiohttp.ClientTimeout(
+            total=backend.request_timeout,
+            sock_connect=min(10.0, backend.request_timeout),
+            sock_read=backend.stream_idle_timeout if tx.stream else None,
+        )
+        try:
+            resp = await session.post(
+                base_url + path, data=out_body, headers=headers, timeout=timeout
+            )
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            raise _RetriableUpstreamError(
+                502, error_body(f"upstream connect error: {e}",
+                                type_="upstream_error"),
+                str(e) or type(e).__name__,
+            ) from None
+
+        async with _closing(resp):
+            if resp.status >= 400:
+                try:
+                    err = await resp.read()
+                except (aiohttp.ClientError, asyncio.TimeoutError):
+                    err = b""
+                client_err = translator.response_error(resp.status, err)
+                if resp.status in _RETRIABLE_STATUS:
+                    raise _RetriableUpstreamError(resp.status, client_err,
+                                                  f"status {resp.status}")
+                req_metrics.finish(TokenUsage(), error_type=str(resp.status))
+                self.metrics.requests_total.labels(
+                    route_name, backend.name, str(resp.status)
+                ).inc()
+                return web.Response(
+                    status=resp.status, body=client_err,
+                    content_type="application/json")
+
+            translator.response_headers(
+                resp.status, {k.lower(): v for k, v in resp.headers.items()}
+            )
+            ctype = resp.headers.get("content-type", "")
+            upstream_streams = tx.stream and (
+                "text/event-stream" in ctype
+                or "vnd.amazon.eventstream" in ctype
+            )
+            if upstream_streams:
+                return await self._stream_response(
+                    request, resp, translator, rb, req_metrics, route_name,
+                    client_headers, front_schema, span=span,
+                    endpoint=endpoint,
+                )
+            try:
+                raw = await resp.read()
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                raise _RetriableUpstreamError(
+                    502,
+                    error_body(f"upstream body read failed: {e}",
+                               type_="upstream_error"),
+                    str(e) or type(e).__name__,
+                ) from None
+            rx = translator.response_body(raw, True)
+            usage = rx.usage
+            req_metrics.response_model = rx.model
+            if span is not None:
+                self._openinference_response_attrs(
+                    span, endpoint, rx.body or raw)
+            req_metrics.finish(usage)
+            self._sink_costs(usage, req_metrics, route_name, client_headers)
+            self.metrics.requests_total.labels(
+                route_name, backend.name, str(resp.status)
+            ).inc()
+            upstream_ctype = resp.headers.get(
+                "content-type", "application/json")
+            return web.Response(
+                status=resp.status, body=rx.body or raw,
+                content_type=upstream_ctype.split(";")[0])
+
+    async def _stream_response(
+        self,
+        request: web.Request,
+        resp: aiohttp.ClientResponse,
+        translator: Any,
+        rb: RuntimeBackend,
+        req_metrics: RequestMetrics,
+        route_name: str,
+        client_headers: dict[str, str],
+        front_schema: APISchemaName = APISchemaName.OPENAI,
+        span=None,
+        endpoint: Endpoint | None = None,
+    ) -> web.StreamResponse:
+        """Proxy the SSE stream through the translator — the hot loop
+        (reference processor_impl.go:481-575)."""
+        out = web.StreamResponse(
+            status=200,
+            headers={
+                "content-type": "text/event-stream",
+                "cache-control": "no-cache",
+                "x-accel-buffering": "no",
+            },
+        )
+        await out.prepare(request)
+        usage = TokenUsage()
+        model = ""
+        # span output attrs for streams: reconstruct the response from
+        # the front-schema SSE bytes (reference sse_converter.go). Only
+        # when tracing is on — the accumulator parses every event.
+        acc = None
+        if span is not None and endpoint in (
+            Endpoint.CHAT_COMPLETIONS, Endpoint.MESSAGES,
+            Endpoint.COMPLETIONS,
+        ):
+            from aigw_tpu.obs.openinference import StreamAccumulator
+
+            acc = StreamAccumulator()
+        try:
+            async for chunk in resp.content.iter_any():
+                rx = translator.response_body(chunk, False)
+                usage = usage.merge_override(rx.usage)
+                model = rx.model or model
+                req_metrics.record_tokens_emitted(rx.tokens_emitted)
+                if rx.body:
+                    if acc is not None:
+                        acc.feed(rx.body)
+                    await out.write(rx.body)
+            rx = translator.response_body(b"", True)
+            usage = usage.merge_override(rx.usage)
+            model = rx.model or model
+            if rx.body:
+                if acc is not None:
+                    acc.feed(rx.body)
+                await out.write(rx.body)
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            # Mid-stream failure: the client already has bytes; surface an
+            # SSE error event rather than failing over (the reference's
+            # per-try idle timeout only retries before response start).
+            # The event is shaped for the *front* schema so the client
+            # SDK recognizes it (Anthropic SDKs need `event: error` with
+            # an Anthropic error envelope).
+            logger.warning("stream from %s aborted: %s", rb.backend.name, e)
+            if front_schema is APISchemaName.ANTHROPIC:
+                await out.write(
+                    b'event: error\n'
+                    b'data: {"type": "error", "error": {"type": '
+                    b'"overloaded_error", "message": '
+                    b'"upstream stream interrupted"}}\n\n'
+                )
+            else:
+                await out.write(
+                    b'data: {"error": {"message": '
+                    b'"upstream stream interrupted", '
+                    b'"type": "upstream_error", "code": null}}\n\n'
+                )
+        req_metrics.response_model = model
+        if acc is not None:
+            final = acc.response()
+            builder = self._oi_response_builder(endpoint)
+            if final is not None and builder is not None:
+                try:
+                    span.attributes.update(
+                        builder(final, self._oi_config))
+                except Exception:  # noqa: BLE001
+                    logger.debug("stream span attrs failed", exc_info=True)
+        req_metrics.finish(usage)
+        self._sink_costs(usage, req_metrics, route_name, client_headers)
+        self.metrics.requests_total.labels(route_name, rb.backend.name, "200").inc()
+        await out.write_eof()
+        return out
+
+    def _check_quota(self, client_headers, rb, req_metrics, error_body):
+        """Admission check against token quotas (reference: Envoy
+        ratelimit filter with domain ai-gateway-quota,
+        extensionserver/quota_ratelimit.go:59). Consumption happens at
+        end-of-stream in _sink_costs."""
+        limiter = self._runtime.rate_limiter
+        if limiter is None or not limiter.rules:
+            return None
+        ok, rule = limiter.check(
+            req_metrics.request_model, rb.backend.name, client_headers
+        )
+        if ok:
+            return None
+        client_err = error_body(
+            f"token quota exceeded (rule {rule.name!r})",
+            type_="rate_limit_error",
+        )
+        if rule.backend:
+            # a backend-scoped budget: other backends may still have
+            # budget, so fail over — but without a circuit-breaker
+            # failure mark (the backend is healthy; a refilled quota
+            # window must not find the circuit open)
+            raise _RetriableUpstreamError(429, client_err,
+                                          f"quota {rule.name}",
+                                          count_failure=False)
+        req_metrics.finish(TokenUsage(), error_type="429")
+        return web.Response(
+            status=429,
+            body=client_err,
+            headers={"retry-after": "1"},
+            content_type="application/json",
+        )
+
+    def _sink_costs(
+        self,
+        usage: TokenUsage,
+        req_metrics: RequestMetrics,
+        route_name: str,
+        client_headers: dict[str, str],
+    ) -> None:
+        """End-of-stream cost metadata (≈ dynamic metadata for the
+        rate-limit filter, extproc/util.go buildDynamicMetadata).
+
+        Quota consumption is keyed by the *request* model — the same value
+        _check_quota matched against — so model-scoped budgets enforce
+        consistently even when the backend reports a versioned response
+        model or a model_name_override rewrote the upstream name."""
+        limiter = self._runtime.rate_limiter
+        has_quota = limiter is not None and limiter.rules
+        if (self._cost_sink is None and not has_quota
+                and not self.access_log.enabled):
+            return
+        model = req_metrics.request_model
+        backend = req_metrics.provider
+        costs = self._runtime.cost_calculator_for(route_name).calculate(
+            usage, model=model, backend=backend, route_name=route_name
+        )
+        if not costs:
+            return
+        req_metrics.costs = dict(costs)
+        if has_quota:
+            limiter.consume(costs, model, backend, client_headers)
+        if self._cost_sink is not None:
+            self._cost_sink(
+                costs,
+                {"model": model, "backend": backend, "route": route_name},
+            )
+
+
+class _RetriableUpstreamError(Exception):
+    def __init__(self, status: int, client_body: bytes, reason: str,
+                 count_failure: bool = True):
+        super().__init__(reason)
+        self.status = status
+        self.client_body = client_body
+        #: whether the circuit breaker should count this as a backend
+        #: failure; quota rejections fail over without poisoning the
+        #: circuit (the backend itself is healthy)
+        self.count_failure = count_failure
+
+
+class _closing:
+    def __init__(self, resp: aiohttp.ClientResponse):
+        self._resp = resp
+
+    async def __aenter__(self):
+        return self._resp
+
+    async def __aexit__(self, *exc):
+        self._resp.release()
+        return False
+
+
+async def run_gateway(
+    runtime: RuntimeConfig,
+    host: str = "127.0.0.1",
+    port: int = 1975,
+    reuse_port: bool = False,
+    **kwargs: Any,
+) -> tuple[GatewayServer, web.AppRunner]:
+    """Start the gateway; returns (server, runner). Caller owns shutdown.
+
+    ``reuse_port=True`` binds with SO_REUSEPORT so multiple worker
+    processes share one listening port, the kernel load-balancing
+    accepted connections across them (the multi-worker mode — Envoy's
+    role in the reference is a multi-threaded C++ proxy; CPython's GIL
+    means horizontal processes, not threads)."""
+    server = GatewayServer(runtime, **kwargs)
+    # aiohttp's per-request INFO access log is pure hot-path overhead
+    # (~4x rps at high concurrency); structured access logging is our
+    # own AIGW_ACCESS_LOG pipeline (obs/accesslog.py)
+    runner = web.AppRunner(server.app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port, reuse_port=reuse_port or None)
+    await site.start()
+    logger.info("gateway listening on %s:%d", host, port)
+    return server, runner

@@ -1,0 +1,10 @@
+"""MCP (Model Context Protocol) proxy (reference internal/mcpproxy).
+
+One client session multiplexed across N backend MCP servers over
+streamable HTTP, with stateless-resumable encrypted composite session IDs,
+aggregated/filtered tool listings, and prefix-routed tool calls.
+"""
+
+from aigw_tpu.mcp.proxy import MCPProxy, MCPBackend, MCPConfig
+
+__all__ = ["MCPBackend", "MCPConfig", "MCPProxy"]

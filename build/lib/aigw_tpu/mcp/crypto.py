@@ -1,0 +1,60 @@
+"""Session-ID encryption (reference internal/mcpproxy/crypto.go:
+PBKDF2-derived AES-GCM with primary/fallback seeds for rotation).
+
+The client-facing MCP session ID *is* the encrypted map of per-backend
+session IDs — the gateway keeps no session table and any replica can
+resume any session (reference session.go:51-66).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import os
+
+from cryptography.exceptions import InvalidTag
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+_PBKDF2_ITERS = 100_000
+_SALT = b"aigw-tpu-mcp-session"
+
+
+class SessionCryptoError(Exception):
+    pass
+
+
+class SessionCrypto:
+    """Encrypt/decrypt session payloads; fallback seed enables seamless
+    key rotation (decrypt tries primary then fallback)."""
+
+    def __init__(self, seed: str, fallback_seed: str = ""):
+        self._keys = [self._derive(seed)]
+        if fallback_seed:
+            self._keys.append(self._derive(fallback_seed))
+
+    @staticmethod
+    def _derive(seed: str) -> AESGCM:
+        key = hashlib.pbkdf2_hmac(
+            "sha256", seed.encode(), _SALT, _PBKDF2_ITERS, dklen=32
+        )
+        return AESGCM(key)
+
+    def encrypt(self, plaintext: bytes) -> str:
+        nonce = os.urandom(12)
+        ct = self._keys[0].encrypt(nonce, plaintext, None)
+        return base64.urlsafe_b64encode(nonce + ct).decode().rstrip("=")
+
+    def decrypt(self, token: str) -> bytes:
+        try:
+            raw = base64.urlsafe_b64decode(token + "=" * (-len(token) % 4))
+        except Exception as e:
+            raise SessionCryptoError(f"malformed session id: {e}") from None
+        if len(raw) < 13:
+            raise SessionCryptoError("session id too short")
+        nonce, ct = raw[:12], raw[12:]
+        for aead in self._keys:
+            try:
+                return aead.decrypt(nonce, ct, None)
+            except InvalidTag:
+                continue
+        raise SessionCryptoError("session id failed authentication")

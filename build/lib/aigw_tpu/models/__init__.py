@@ -1,0 +1,6 @@
+"""Model families as pure functional JAX programs (bfloat16, static shapes,
+jit-compiled once per shape bucket)."""
+
+from aigw_tpu.models.registry import ModelSpec, get_model_spec, register_model
+
+__all__ = ["ModelSpec", "get_model_spec", "register_model"]

@@ -1,0 +1,614 @@
+"""Llama-family transformer as a pure functional JAX program.
+
+TPU-first design decisions (not a port of any torch implementation):
+
+- **bfloat16 everywhere** except RMSNorm accumulation and attention
+  softmax, which run in float32 — keeps the MXU fed while preserving
+  numerics (pallas_guide.md tiling: bf16 tiles are (16, 128)).
+- **Static shapes**: prefill is bucketed by padded sequence length, decode
+  is a fixed [max_batch, 1] step — each shape compiles exactly once.
+- **Paged KV cache**: the cache is a flat page pool
+  ``[L, 2, n_pages * page_size, n_kv_heads, head_dim]``; sequences own
+  pages via an int32 page table. Flattening pages makes cache writes one
+  scatter and cache reads one gather — both XLA-native ops that fuse well,
+  and the same layout the Pallas paged-attention kernel consumes
+  (PAPERS.md: Ragged Paged Attention for TPU).
+- **GQA**: K/V heads are kept un-repeated in the cache (HBM bandwidth is
+  the bottleneck); Q heads are grouped over KV heads inside attention.
+
+Weight layout is a flat dict pytree so `jax.sharding` partition specs can
+be assigned per-leaf by name (aigw_tpu/parallel/sharding.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from aigw_tpu.models.lora import lora_delta
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    tie_embeddings: bool = False
+    # QKV projection bias (the Qwen2 family uses it; Llama doesn't)
+    attn_bias: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+# Published Llama-3 architecture shapes (public model cards).
+LLAMA3_8B = LlamaConfig()
+LLAMA3_70B = LlamaConfig(
+    dim=8192, n_layers=80, n_heads=64, n_kv_heads=8, ffn_dim=28672
+)
+# Qwen2 family: Llama skeleton + QKV bias (+ tied embeddings on small
+# sizes). Published architecture shapes.
+QWEN2_7B = LlamaConfig(
+    vocab_size=152064, dim=3584, n_layers=28, n_heads=28, n_kv_heads=4,
+    ffn_dim=18944, rope_theta=1e6, max_seq_len=32768, attn_bias=True,
+)
+QWEN2_05B = LlamaConfig(
+    vocab_size=151936, dim=896, n_layers=24, n_heads=14, n_kv_heads=2,
+    ffn_dim=4864, rope_theta=1e6, max_seq_len=32768, attn_bias=True,
+    tie_embeddings=True,
+)
+
+#: Tiny config for tests / CPU fake-chip mode (reference's testupstream role)
+TINY = LlamaConfig(
+    vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    ffn_dim=128, max_seq_len=512, rope_theta=10000.0,
+)
+
+
+def init_params(
+    key: jax.Array, cfg: LlamaConfig, dtype: Any = jnp.bfloat16
+) -> dict[str, jax.Array]:
+    """Random-init weights (testing / tiny-random serving)."""
+    keys = iter(jax.random.split(key, 4 + cfg.n_layers * 9))
+
+    def dense(shape, scale=None):
+        scale = scale or 1.0 / math.sqrt(shape[0])
+        return (jax.random.normal(next(keys), shape, jnp.float32) * scale).astype(
+            dtype
+        )
+
+    p: dict[str, jax.Array] = {
+        "embed": dense((cfg.vocab_size, cfg.dim), scale=0.02),
+        "norm_f": jnp.ones((cfg.dim,), dtype),
+    }
+    if not cfg.tie_embeddings:
+        p["lm_head"] = dense((cfg.dim, cfg.vocab_size))
+    hd = cfg.head_dim
+    for i in range(cfg.n_layers):
+        p[f"l{i}.attn_norm"] = jnp.ones((cfg.dim,), dtype)
+        p[f"l{i}.wq"] = dense((cfg.dim, cfg.n_heads * hd))
+        p[f"l{i}.wk"] = dense((cfg.dim, cfg.n_kv_heads * hd))
+        p[f"l{i}.wv"] = dense((cfg.dim, cfg.n_kv_heads * hd))
+        if cfg.attn_bias:
+            p[f"l{i}.bq"] = jnp.zeros((cfg.n_heads * hd,), dtype)
+            p[f"l{i}.bk"] = jnp.zeros((cfg.n_kv_heads * hd,), dtype)
+            p[f"l{i}.bv"] = jnp.zeros((cfg.n_kv_heads * hd,), dtype)
+        p[f"l{i}.wo"] = dense((cfg.n_heads * hd, cfg.dim))
+        p[f"l{i}.mlp_norm"] = jnp.ones((cfg.dim,), dtype)
+        p[f"l{i}.w_gate"] = dense((cfg.dim, cfg.ffn_dim))
+        p[f"l{i}.w_up"] = dense((cfg.dim, cfg.ffn_dim))
+        p[f"l{i}.w_down"] = dense((cfg.ffn_dim, cfg.dim))
+    return p
+
+
+def _w(p: dict[str, jax.Array], key: str) -> jax.Array:
+    """Resolve a weight that may be stored bf16 or int8+scale (W8A16,
+    models/quant.py). The convert-and-scale sits on the matmul operand so
+    XLA fuses it; HBM traffic is the int8 bytes."""
+    q = p.get(key + ".q")
+    if q is None:
+        return p[key]
+    return q.astype(jnp.bfloat16) * p[key + ".scale"].astype(jnp.bfloat16)
+
+
+def _embed_rows(p: dict[str, jax.Array], tokens: jax.Array) -> jax.Array:
+    q = p.get("embed.q")
+    if q is None:
+        return jnp.take(p["embed"], tokens, axis=0)
+    rows = jnp.take(q, tokens, axis=0).astype(jnp.bfloat16)
+    scales = jnp.take(p["embed.scale"][:, 0], tokens, axis=0)
+    return rows * scales[..., None].astype(jnp.bfloat16)
+
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    scale = lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * scale).astype(x.dtype) * w
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embeddings. x: [..., S, H, D], positions broadcastable [..., S]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = (
+        positions.astype(jnp.float32)[..., :, None, None] * freqs[None, None, :]
+    )  # [..., S, 1, D/2]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    o1 = xf1 * cos - xf2 * sin
+    o2 = xf2 * cos + xf1 * sin
+    out = jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+def _attention(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,  # [B, T, Hkv, D]
+    v: jax.Array,  # [B, T, Hkv, D]
+    mask: jax.Array,  # [B, S, T] bool, True = attend
+) -> jax.Array:
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    group = H // Hkv
+    qg = q.reshape(B, S, Hkv, group, D)
+    logits = jnp.einsum(
+        "bshgd,bthd->bhgst", qg, k, preferred_element_type=jnp.float32
+    )
+    logits = logits / math.sqrt(D)
+    logits = jnp.where(mask[:, None, None, :, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhgst,bthd->bshgd", probs.astype(v.dtype), v)
+    return out.reshape(B, S, H * D)
+
+
+def _matmul(p: dict[str, jax.Array], key: str, x: jax.Array) -> jax.Array:
+    """``x @ weight`` with the W8A16 Pallas fast path.
+
+    For quantized weights at decode shapes (small M, aligned K/N) the
+    fused kernel streams int8 and applies the scale to the accumulator
+    (ops/pallas/qmatmul.py); other shapes — prefill, unaligned, or
+    AIGW_PALLAS_QMATMUL=off — fall back to dequant-then-matmul via
+    ``_w`` (XLA fuses the dequant as the matmul's producer)."""
+    q = p.get(key + ".q")
+    if q is None or os.environ.get(
+            "AIGW_PALLAS_QMATMUL", "on").lower() in ("0", "false", "off"):
+        return x @ _w(p, key)
+    from aigw_tpu.ops.pallas import qmatmul
+
+    lead, k = x.shape[:-1], x.shape[-1]
+    m = math.prod(lead)
+    n = q.shape[-1]
+    if not qmatmul.supported(m, k, n):
+        return x @ _w(p, key)
+    y = qmatmul.w8a16_matmul(x.reshape(m, k), q, p[key + ".scale"])
+    return y.reshape(*lead, n)
+
+
+def _wo_project(p, i, attn, lora=None, adapter_idx=None):
+    """Attention out-projection with optional per-slot LoRA delta."""
+    out = _matmul(p, f"l{i}.wo", attn)
+    d = lora_delta(lora, f"l{i}.wo", attn, adapter_idx)
+    return out if d is None else out + d
+
+
+def _project_qkv(p, i, x, positions, cfg, lora=None, adapter_idx=None):
+    hd = cfg.head_dim
+    B, S, _ = x.shape
+    q = _matmul(p, f"l{i}.wq", x)
+    k = _matmul(p, f"l{i}.wk", x)
+    v = _matmul(p, f"l{i}.wv", x)
+    for name, ref in (("wq", "q"), ("wk", "k"), ("wv", "v")):
+        d = lora_delta(lora, f"l{i}.{name}", x, adapter_idx)
+        if d is not None:
+            if ref == "q":
+                q = q + d
+            elif ref == "k":
+                k = k + d
+            else:
+                v = v + d
+    if cfg.attn_bias:
+        q, k, v = q + p[f"l{i}.bq"], k + p[f"l{i}.bk"], v + p[f"l{i}.bv"]
+    q = q.reshape(B, S, cfg.n_heads, hd)
+    k = k.reshape(B, S, cfg.n_kv_heads, hd)
+    v = v.reshape(B, S, cfg.n_kv_heads, hd)
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+    return q, k, v
+
+
+def _mlp(p, i, x, lora=None, adapter_idx=None):
+    def with_delta(y, name, inp):
+        d = lora_delta(lora, f"l{i}.{name}", inp, adapter_idx)
+        return y if d is None else y + d
+
+    gate = jax.nn.silu(with_delta(_matmul(p, f"l{i}.w_gate", x),
+                                  "w_gate", x))
+    up = with_delta(_matmul(p, f"l{i}.w_up", x), "w_up", x)
+    h = gate * up
+    return with_delta(_matmul(p, f"l{i}.w_down", h), "w_down", h)
+
+
+def _logits(p: dict[str, jax.Array], cfg: LlamaConfig, x: jax.Array) -> jax.Array:
+    if cfg.tie_embeddings:
+        return (x @ _w(p, "embed").T).astype(jnp.float32)
+    return _matmul(p, "lm_head", x).astype(jnp.float32)
+
+
+def prefill(
+    p: dict[str, jax.Array],
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [B, S] int32, right-padded
+    seq_lens: jax.Array,  # [B] int32 true lengths
+    kv_cache: jax.Array,  # [L, 2, P*page, Hkv, D]
+    page_table: jax.Array,  # [B, max_pages] int32 page ids
+    page_size: int,
+    mlp=None,  # pluggable feed-forward (MoE families override; see mixtral)
+    lora=None,
+    adapter_idx=None,
+) -> tuple[jax.Array, jax.Array]:
+    """Process prompts; returns (last-position logits [B, V], updated cache).
+
+    Prompt self-attention never reads the cache (the prompt is
+    self-contained); K/V are computed in-registers and scattered into the
+    page pool once at the end — one HBM write per layer.
+    """
+    B, S = tokens.shape
+    positions = jnp.arange(S, dtype=jnp.int32)[None, :].repeat(B, 0)
+    valid = positions < seq_lens[:, None]  # [B, S]
+    causal = positions[:, :, None] >= positions[:, None, :]
+    mask = causal & valid[:, None, :]
+
+    # flat cache slot per (b, s): page_table[b, s // page] * page + s % page
+    n_slots = kv_cache.shape[2]
+    slot = (
+        jnp.take_along_axis(page_table, positions // page_size, axis=1) * page_size
+        + positions % page_size
+    )  # [B, S]
+    x = _embed_rows(p, tokens)
+    for i in range(cfg.n_layers):
+        h = rms_norm(x, p[f"l{i}.attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(p, i, h, positions, cfg, lora, adapter_idx)
+        # padded positions scatter to an out-of-bounds slot, which
+        # mode="drop" discards (negative indices would wrap instead)
+        flat = jnp.where(valid, slot, n_slots)
+        kv_cache = kv_cache.at[i, 0, flat].set(k, mode="drop")
+        kv_cache = kv_cache.at[i, 1, flat].set(v, mode="drop")
+        attn = _attention(q, k, v, mask)
+        x = x + _wo_project(p, i, attn, lora, adapter_idx)
+        h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
+        x = x + (mlp(p, i, h) if mlp is not None
+                 else _mlp(p, i, h, lora, adapter_idx))
+    x = rms_norm(x, p["norm_f"], cfg.norm_eps)
+    last = jnp.take_along_axis(
+        x, (seq_lens - 1)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    return _logits(p, cfg, last), kv_cache
+
+
+def prefill_sp(
+    p: dict[str, jax.Array],
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [B, S] int32, right-padded; S divisible by sp
+    seq_lens: jax.Array,  # [B] int32 true lengths
+    kv_cache: jax.Array,
+    page_table: jax.Array,  # [B, max_pages]
+    page_size: int,
+    *,
+    mesh,  # jax.sharding.Mesh with an "sp" axis
+    strategy: str = "ring",  # "ring" | "ulysses"
+    mlp=None,
+    lora=None,
+    adapter_idx=None,
+) -> tuple[jax.Array, jax.Array]:
+    """Sequence-parallel prefill: context parallelism for prompts whose
+    attention working set exceeds one chip's HBM budget (SURVEY.md §5
+    long-context). Identical to ``prefill`` except attention runs as ring
+    attention over the ``sp`` mesh axis (ops/ring_attention.py) — each
+    device holds S/sp of the sequence and K/V blocks rotate over ICI
+    neighbors.
+
+    Correctness under right padding: ring attention is causal-only (no
+    validity mask), but padding sits at positions >= seq_len, so a valid
+    query at position i < seq_len only ever attends keys <= i, all valid.
+    Outputs at padded positions are garbage and are never read (logits are
+    taken at seq_lens-1; padded K/V scatters are dropped)."""
+    from aigw_tpu.ops.ring_attention import ring_attention
+
+    B, S = tokens.shape
+    positions = jnp.arange(S, dtype=jnp.int32)[None, :].repeat(B, 0)
+    valid = positions < seq_lens[:, None]
+    n_slots = kv_cache.shape[2]
+    slot = (
+        jnp.take_along_axis(page_table, positions // page_size, axis=1)
+        * page_size
+        + positions % page_size
+    )
+    x = _embed_rows(p, tokens)
+    for i in range(cfg.n_layers):
+        h = rms_norm(x, p[f"l{i}.attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(p, i, h, positions, cfg, lora, adapter_idx)
+        flat = jnp.where(valid, slot, n_slots)
+        kv_cache = kv_cache.at[i, 0, flat].set(k, mode="drop")
+        kv_cache = kv_cache.at[i, 1, flat].set(v, mode="drop")
+        attn = ring_attention(
+            q, k.astype(q.dtype), v.astype(q.dtype),
+            mesh=mesh, causal=True, strategy=strategy,
+        ).astype(x.dtype)
+        x = x + _wo_project(p, i, attn, lora, adapter_idx)
+        h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
+        x = x + (mlp(p, i, h) if mlp is not None
+                 else _mlp(p, i, h, lora, adapter_idx))
+    x = rms_norm(x, p["norm_f"], cfg.norm_eps)
+    last = jnp.take_along_axis(
+        x, (seq_lens - 1)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    return _logits(p, cfg, last), kv_cache
+
+
+def decode_step(
+    p: dict[str, jax.Array],
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [B] int32 current token per slot
+    positions: jax.Array,  # [B] int32 position of `tokens`
+    kv_cache: jax.Array,
+    page_table: jax.Array,  # [B, max_pages]
+    page_size: int,
+    active: jax.Array,  # [B] bool slot occupied
+    mlp=None,  # pluggable feed-forward (MoE families override)
+    lora=None,  # stacked adapters (models/lora.py)
+    adapter_idx=None,  # [B] int32 adapter row per slot
+    attn_impl: str = "",  # "" = XLA gather; "pallas" = ragged paged kernel
+) -> tuple[jax.Array, jax.Array]:
+    """One continuous-batching decode step; returns (logits [B, V], cache).
+
+    The hot loop: fixed shapes, cache gathered per sequence window
+    [B, T_max] where T_max = max_pages * page_size. Inactive slots are
+    masked and write to dropped slots.
+
+    ``attn_impl="pallas"`` replaces the gather+dense attention with the
+    ragged paged-attention kernel (ops/pallas/paged_attention.py): HBM
+    reads scale with actual sequence lengths instead of the padded
+    window. Single-mesh only — under GSPMD the gather path is used (the
+    engine gates this).
+    """
+    B = tokens.shape[0]
+    max_pages = page_table.shape[1]
+    T = max_pages * page_size
+    pos1 = positions[:, None]  # [B, 1]
+
+    n_slots = kv_cache.shape[2]
+    slot = (
+        jnp.take_along_axis(page_table, pos1 // page_size, axis=1) * page_size
+        + pos1 % page_size
+    )  # [B, 1]
+    slot = jnp.where(active[:, None], slot, n_slots)  # OOB → dropped
+
+    use_pallas = attn_impl == "pallas"
+    if not use_pallas:
+        # gather the full (padded) KV window for each slot
+        t_idx = jnp.arange(T, dtype=jnp.int32)[None, :].repeat(B, 0)
+        gslot = page_table[:, :, None] * page_size + jnp.arange(
+            page_size, dtype=jnp.int32
+        )
+        gslot = gslot.reshape(B, T)  # [B, T] flat cache indices
+        attend = t_idx <= pos1  # causal within the sequence window
+    else:
+        from aigw_tpu.ops.pallas._compat import is_tpu_backend
+        from aigw_tpu.ops.pallas.paged_attention import (
+            paged_attention_decode_v2,
+        )
+
+        lengths = jnp.where(active, positions + 1, 0)
+        interp = not is_tpu_backend()
+
+    x = _embed_rows(p, tokens[:, None])  # [B, 1, dim]
+    for i in range(cfg.n_layers):
+        h = rms_norm(x, p[f"l{i}.attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(p, i, h, pos1, cfg, lora, adapter_idx)
+        kv_cache = kv_cache.at[i, 0, slot].set(k, mode="drop")
+        kv_cache = kv_cache.at[i, 1, slot].set(v, mode="drop")
+        if use_pallas:
+            attn = paged_attention_decode_v2(
+                q[:, 0], kv_cache[i, 0], kv_cache[i, 1], page_table,
+                lengths, page_size=page_size, interpret=interp,
+            ).reshape(B, 1, cfg.n_heads * cfg.head_dim)
+        else:
+            k_all = kv_cache[i, 0][gslot]  # [B, T, Hkv, D]
+            v_all = kv_cache[i, 1][gslot]
+            attn = _attention(q, k_all, v_all, attend[:, None, :])
+        x = x + _wo_project(p, i, attn, lora, adapter_idx)
+        h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
+        x = x + (mlp(p, i, h) if mlp is not None
+                 else _mlp(p, i, h, lora, adapter_idx))
+    x = rms_norm(x, p["norm_f"], cfg.norm_eps)
+    return _logits(p, cfg, x[:, 0]), kv_cache
+
+
+def verify_step(
+    p: dict[str, jax.Array],
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [B, S] pending token + S-1 draft tokens
+    positions: jax.Array,  # [B] int32 position of tokens[:, 0]
+    kv_cache: jax.Array,
+    page_table: jax.Array,  # [B, max_pages]
+    page_size: int,
+    active: jax.Array,  # [B] bool slot occupied
+    limits: jax.Array,  # [B] int32 exclusive max write position
+    mlp=None,
+    lora=None,
+    adapter_idx=None,
+    attn_impl: str = "",  # "" = XLA gather; "pallas" = ragged kernel
+) -> tuple[jax.Array, jax.Array]:
+    """Speculative-decoding verifier: score S candidate positions in one
+    step, returning logits at EVERY position ([B, S, V]) so the engine can
+    accept the longest draft prefix that matches the model's own samples.
+
+    KV safety (the reason draft rejection is free on this layout): K/V for
+    all S positions are scattered, but a later step re-scatters any
+    position it revisits *before* the causal gather (``t <= pos``) can see
+    it, so stale writes from rejected drafts are never read. Writes are
+    fenced by ``limits`` exactly like the decode step's page-safety fence.
+    """
+    B, S = tokens.shape
+    T = page_table.shape[1] * page_size
+    n_slots = kv_cache.shape[2]
+    start = positions
+    positions = positions[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    valid = active[:, None] & (positions < limits[:, None])  # [B, S]
+
+    slot = (
+        jnp.take_along_axis(page_table, positions // page_size, axis=1)
+        * page_size
+        + positions % page_size
+    )
+    flat = jnp.where(valid, slot, n_slots)  # OOB → dropped by scatter
+
+    use_pallas = attn_impl == "pallas"
+    if not use_pallas:
+        gslot = page_table[:, :, None] * page_size + jnp.arange(
+            page_size, dtype=jnp.int32
+        )
+        gslot = gslot.reshape(B, T)
+        t_idx = jnp.arange(T, dtype=jnp.int32)[None, :]
+    else:
+        from aigw_tpu.ops.pallas._compat import is_tpu_backend
+        from aigw_tpu.ops.pallas.paged_attention import (
+            paged_attention_verify,
+        )
+
+        # inactive slots: start <= -(S+1) → zero attendable keys
+        # (the kernel's page gate is pos0 + S - p*page_size)
+        pal_pos = jnp.where(active, start, -(S + 1))
+        interp = not is_tpu_backend()
+
+    x = _embed_rows(p, tokens)
+    for i in range(cfg.n_layers):
+        h = rms_norm(x, p[f"l{i}.attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(p, i, h, positions, cfg, lora, adapter_idx)
+        kv_cache = kv_cache.at[i, 0, flat].set(k, mode="drop")
+        kv_cache = kv_cache.at[i, 1, flat].set(v, mode="drop")
+        if use_pallas:
+            attn = paged_attention_verify(
+                q, kv_cache[i, 0], kv_cache[i, 1], page_table, pal_pos,
+                page_size=page_size, interpret=interp,
+            ).reshape(B, S, cfg.n_heads * cfg.head_dim)
+        else:
+            k_all = kv_cache[i, 0][gslot]  # [B, T, Hkv, D]
+            v_all = kv_cache[i, 1][gslot]
+            mask = (t_idx[:, None, :] <= positions[:, :, None]) \
+                & valid[..., None]
+            attn = _attention(q, k_all, v_all, mask)
+        x = x + _wo_project(p, i, attn, lora, adapter_idx)
+        h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
+        x = x + (mlp(p, i, h) if mlp is not None
+                 else _mlp(p, i, h, lora, adapter_idx))
+    x = rms_norm(x, p["norm_f"], cfg.norm_eps)
+    return _logits(p, cfg, x), kv_cache
+
+
+def hidden_states(
+    p: dict[str, jax.Array],
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [B, S]
+    seq_lens: jax.Array,  # [B]
+    mlp=None,  # pluggable feed-forward (MoE families override)
+    lora=None,
+    adapter_idx=None,
+) -> jax.Array:
+    """Mean-pooled final hidden states (the /v1/embeddings path)."""
+    B, S = tokens.shape
+    positions = jnp.arange(S, dtype=jnp.int32)[None, :].repeat(B, 0)
+    valid = positions < seq_lens[:, None]
+    causal = positions[:, :, None] >= positions[:, None, :]
+    mask = causal & valid[:, None, :]
+    x = _embed_rows(p, tokens)
+    for i in range(cfg.n_layers):
+        h = rms_norm(x, p[f"l{i}.attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(p, i, h, positions, cfg, lora, adapter_idx)
+        x = x + _wo_project(p, i, _attention(q, k, v, mask), lora,
+                            adapter_idx)
+        h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
+        x = x + (mlp(p, i, h) if mlp is not None
+                 else _mlp(p, i, h, lora, adapter_idx))
+    x = rms_norm(x, p["norm_f"], cfg.norm_eps)
+    w = valid[..., None].astype(jnp.float32)
+    pooled = (x.astype(jnp.float32) * w).sum(1) / jnp.maximum(w.sum(1), 1.0)
+    return pooled
+
+
+def prefill_suffix(
+    p: dict[str, jax.Array],
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [B, S] suffix tokens, right-padded
+    prefix_lens: jax.Array,  # [B] int32 — tokens already in the cache
+    seq_lens: jax.Array,  # [B] int32 — TOTAL length incl. prefix
+    kv_cache: jax.Array,
+    page_table: jax.Array,  # [B, max_pages]
+    page_size: int,
+    mlp=None,
+    lora=None,
+    adapter_idx=None,
+) -> tuple[jax.Array, jax.Array]:
+    """Prefill only the suffix of a prompt whose prefix K/V already sits in
+    cache pages (prefix caching / chunked prefill). Per layer: suffix K/V
+    are scattered into the pool first, then attention gathers the full
+    page window — so suffix queries see both the cached prefix and the
+    suffix itself under a global causal mask. With ``prefix_lens == 0``
+    this degenerates to (a gather-based) full prefill.
+    """
+    B, S = tokens.shape
+    T = page_table.shape[1] * page_size
+    n_slots = kv_cache.shape[2]
+    positions = prefix_lens[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    valid = positions < seq_lens[:, None]  # [B, S]
+
+    slot = (
+        jnp.take_along_axis(page_table, positions // page_size, axis=1)
+        * page_size
+        + positions % page_size
+    )
+    flat = jnp.where(valid, slot, n_slots)  # OOB → dropped by scatter
+
+    gslot = page_table[:, :, None] * page_size + jnp.arange(
+        page_size, dtype=jnp.int32
+    )
+    gslot = gslot.reshape(B, T)
+    t_idx = jnp.arange(T, dtype=jnp.int32)[None, :]
+
+    x = _embed_rows(p, tokens)
+    for i in range(cfg.n_layers):
+        h = rms_norm(x, p[f"l{i}.attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(p, i, h, positions, cfg, lora, adapter_idx)
+        kv_cache = kv_cache.at[i, 0, flat].set(k, mode="drop")
+        kv_cache = kv_cache.at[i, 1, flat].set(v, mode="drop")
+        k_all = kv_cache[i, 0][gslot]  # [B, T, Hkv, D]
+        v_all = kv_cache[i, 1][gslot]
+        # causal over global positions; padded queries masked by `valid`
+        mask = (t_idx[:, None, :] <= positions[:, :, None]) & valid[..., None]
+        attn = _attention(q, k_all, v_all, mask)
+        x = x + _wo_project(p, i, attn, lora, adapter_idx)
+        h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
+        x = x + (mlp(p, i, h) if mlp is not None
+                 else _mlp(p, i, h, lora, adapter_idx))
+    x = rms_norm(x, p["norm_f"], cfg.norm_eps)
+    last = jnp.take_along_axis(
+        x, (seq_lens - prefix_lens - 1)[:, None, None].astype(jnp.int32),
+        axis=1,
+    )[:, 0]
+    return _logits(p, cfg, last), kv_cache

@@ -1,0 +1,171 @@
+"""Mixtral-family (sparse MoE) transformer, TPU-first.
+
+Same GQA attention/paged-KV skeleton as the Llama family (the attention
+internals are imported from models/llama.py — one implementation, two
+families); the MLP is a top-2 mixture of experts implemented GShard-style
+with **dispatch/combine einsums** and a fixed expert capacity:
+
+    gate probs → top-k → position-in-expert (cumsum) → one-hot dispatch
+    [T, E, C] → x_e = einsum(dispatch, x) → batched expert MLP over E →
+    combine = einsum(dispatch·weights, y_e)
+
+Everything is static-shaped, so the whole MoE compiles to einsums that the
+MXU eats, and **expert parallelism is a sharding annotation**: expert
+weights carry PartitionSpec("ep", ...) and GSPMD turns the dispatch /
+combine einsums into all-to-alls over the ``ep`` mesh axis
+(aigw_tpu/parallel/sharding.py::mixtral_param_specs).
+
+Capacity overflow drops tokens from that expert (they keep their other
+top-k expert + the residual path) — the standard trade for static shapes.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from aigw_tpu.models import llama
+from aigw_tpu.models.llama import LlamaConfig
+
+
+@dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    n_experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 2.0
+    rope_theta: float = 1e6
+    norm_eps: float = 1e-5
+    max_seq_len: int = 32768
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def as_llama(self) -> LlamaConfig:
+        """The attention-relevant view consumed by the shared skeleton."""
+        return LlamaConfig(
+            vocab_size=self.vocab_size,
+            dim=self.dim,
+            n_layers=self.n_layers,
+            n_heads=self.n_heads,
+            n_kv_heads=self.n_kv_heads,
+            ffn_dim=self.ffn_dim,
+            rope_theta=self.rope_theta,
+            norm_eps=self.norm_eps,
+            max_seq_len=self.max_seq_len,
+        )
+
+
+MIXTRAL_8X7B = MixtralConfig()
+TINY_MOE = MixtralConfig(
+    vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    ffn_dim=128, n_experts=4, experts_per_token=2, max_seq_len=512,
+    rope_theta=10000.0,
+)
+
+
+def init_params(key: jax.Array, cfg: MixtralConfig,
+                dtype=jnp.bfloat16) -> dict[str, jax.Array]:
+    keys = iter(jax.random.split(key, 4 + cfg.n_layers * 8))
+
+    def dense(shape, scale=None):
+        scale = scale or 1.0 / math.sqrt(shape[-2] if len(shape) > 1 else shape[0])
+        return (jax.random.normal(next(keys), shape, jnp.float32) * scale
+                ).astype(dtype)
+
+    p: dict[str, jax.Array] = {
+        "embed": dense((cfg.vocab_size, cfg.dim), scale=0.02),
+        "norm_f": jnp.ones((cfg.dim,), dtype),
+        "lm_head": dense((cfg.dim, cfg.vocab_size)),
+    }
+    hd = cfg.head_dim
+    E = cfg.n_experts
+    for i in range(cfg.n_layers):
+        p[f"l{i}.attn_norm"] = jnp.ones((cfg.dim,), dtype)
+        p[f"l{i}.wq"] = dense((cfg.dim, cfg.n_heads * hd))
+        p[f"l{i}.wk"] = dense((cfg.dim, cfg.n_kv_heads * hd))
+        p[f"l{i}.wv"] = dense((cfg.dim, cfg.n_kv_heads * hd))
+        p[f"l{i}.wo"] = dense((cfg.n_heads * hd, cfg.dim))
+        p[f"l{i}.mlp_norm"] = jnp.ones((cfg.dim,), dtype)
+        p[f"l{i}.gate"] = dense((cfg.dim, E))
+        p[f"l{i}.w_gate"] = dense((E, cfg.dim, cfg.ffn_dim))
+        p[f"l{i}.w_up"] = dense((E, cfg.dim, cfg.ffn_dim))
+        p[f"l{i}.w_down"] = dense((E, cfg.ffn_dim, cfg.dim))
+    return p
+
+
+def moe_mlp(p: dict[str, jax.Array], i: int, x: jax.Array,
+            cfg: MixtralConfig) -> jax.Array:
+    """Top-k sparse MLP over flattened tokens. x: [B, S, D] → [B, S, D]."""
+    B, S, D = x.shape
+    T = B * S
+    E, K = cfg.n_experts, cfg.experts_per_token
+    C = max(K, int(math.ceil(T * K / E * cfg.capacity_factor)))
+    C = min(C, T)
+    xt = x.reshape(T, D)
+
+    logits = (xt.astype(jnp.float32) @ p[f"l{i}.gate"].astype(jnp.float32))
+    topv, topi = jax.lax.top_k(logits, K)  # [T, K]
+    weights = jax.nn.softmax(topv, axis=-1)  # normalize over chosen experts
+
+    # one-hot expert choice per (token, k): [T, K, E]
+    choice = jax.nn.one_hot(topi, E, dtype=jnp.float32)
+    # position of each (t, k) within its expert: cumulative count over the
+    # flattened (t, k) order
+    flat_choice = choice.reshape(T * K, E)
+    pos = (jnp.cumsum(flat_choice, axis=0) - flat_choice).reshape(T, K, E)
+    pos = jnp.sum(pos * choice, axis=-1).astype(jnp.int32)  # [T, K]
+    keep = pos < C  # capacity fence
+    pos_oh = jax.nn.one_hot(pos, C, dtype=jnp.float32) * keep[..., None]
+    # dispatch [T, E, C]
+    dispatch = jnp.einsum("tke,tkc->tec", choice, pos_oh)
+    combine = jnp.einsum("tke,tkc,tk->tec", choice, pos_oh, weights)
+
+    xe = jnp.einsum("tec,td->ecd", dispatch, xt.astype(jnp.float32))
+    xe = xe.astype(x.dtype)
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, p[f"l{i}.w_gate"]))
+    up = jnp.einsum("ecd,edf->ecf", xe, p[f"l{i}.w_up"])
+    ye = jnp.einsum("ecf,efd->ecd", gate * up, p[f"l{i}.w_down"])
+    out = jnp.einsum("tec,ecd->td", combine, ye.astype(jnp.float32))
+    return out.astype(x.dtype).reshape(B, S, D)
+
+
+def _mlp_fn(cfg: MixtralConfig):
+    return lambda p, i, x: moe_mlp(p, i, x, cfg)
+
+
+def prefill(p, cfg: MixtralConfig, tokens, seq_lens, kv_cache, page_table,
+            page_size, lora=None, adapter_idx=None):
+    # LoRA is llama-family-only for now; args accepted for interface parity
+    return llama.prefill(p, cfg.as_llama(), tokens, seq_lens, kv_cache,
+                         page_table, page_size, mlp=_mlp_fn(cfg))
+
+
+def decode_step(p, cfg: MixtralConfig, tokens, positions, kv_cache,
+                page_table, page_size, active, lora=None, adapter_idx=None,
+                attn_impl=""):
+    return llama.decode_step(p, cfg.as_llama(), tokens, positions, kv_cache,
+                             page_table, page_size, active,
+                             mlp=_mlp_fn(cfg), attn_impl=attn_impl)
+
+
+def hidden_states(p, cfg: MixtralConfig, tokens, seq_lens):
+    return llama.hidden_states(p, cfg.as_llama(), tokens, seq_lens,
+                               mlp=_mlp_fn(cfg))
+
+
+def verify_step(p, cfg: MixtralConfig, tokens, positions, kv_cache,
+                page_table, page_size, active, limits,
+                lora=None, adapter_idx=None, attn_impl=""):
+    return llama.verify_step(p, cfg.as_llama(), tokens, positions, kv_cache,
+                             page_table, page_size, active, limits,
+                             mlp=_mlp_fn(cfg), attn_impl=attn_impl)

@@ -1,0 +1,76 @@
+"""Weight-only int8 quantization (W8A16).
+
+Decode on TPU is weight-streaming-bound (every step reads every weight
+from HBM); symmetric per-output-channel int8 halves that traffic while
+activations stay bf16. Inside the jitted step the int8 block is converted
+and scaled right at the matmul operand, which XLA fuses — HBM sees int8,
+the MXU sees bf16.
+
+Quantized params replace each matrix ``name`` with ``name.q`` (int8) and
+``name.scale`` (f32, per output column; per row for the embedding since it
+is consumed by row gather). Norms and biases stay bf16. The model code
+resolves either representation through ``models.llama._w``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+#: weight-name suffixes eligible for int8 (matrices on the matmul path)
+_MATRIX_KINDS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+@partial(jax.jit, static_argnames=("axis",))
+def _quantize_matrix(w: jax.Array, axis: int) -> tuple[jax.Array, jax.Array]:
+    """Symmetric int8 along ``axis`` (the preserved/output axis).
+
+    Jitted so the f32 upcast fuses into the reduction and the rounding —
+    eager dispatch would materialize a full f32 copy (2GB for an 8B
+    embedding), which busts HBM when quantizing a 16GB bf16 model in
+    place on a 16GB chip."""
+    wf = w.astype(jnp.float32)
+    reduce_axes = tuple(i for i in range(w.ndim) if i != axis)
+    amax = jnp.max(jnp.abs(wf), axis=reduce_axes, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def quantize_params(
+    params: dict[str, jax.Array], consume: bool = False
+) -> dict[str, jax.Array]:
+    """bf16 param dict → W8A16 dict (un-quantized leaves pass through).
+
+    ``consume=True`` removes each bf16 tensor from ``params`` as soon as
+    its int8 replacement is materialized, bounding peak HBM to
+    bf16-model + one tensor instead of bf16 + int8 copies — required to
+    quantize an 8B bf16 model in place on a 16GB chip.
+    """
+    out: dict[str, jax.Array] = {}
+    for name in list(params):
+        w = params.pop(name) if consume else params[name]
+        kind = name.rsplit(".", 1)[-1]
+        if kind in _MATRIX_KINDS and w.ndim >= 2:
+            # output channels = last axis for [in, out] (and [E, in, out])
+            q, scale = _quantize_matrix(w, axis=w.ndim - 1)
+            out[name + ".q"] = q
+            out[name + ".scale"] = scale
+        elif name == "lm_head":
+            q, scale = _quantize_matrix(w, axis=1)
+            out["lm_head.q"] = q
+            out["lm_head.scale"] = scale
+        elif name == "embed":
+            # consumed by row gather: per-row scales
+            q, scale = _quantize_matrix(w, axis=0)
+            out["embed.q"] = q
+            out["embed.scale"] = scale
+        else:
+            out[name] = w
+    return out
+
+
+def is_quantized(params: dict[str, jax.Array]) -> bool:
+    return any(k.endswith(".q") for k in params)

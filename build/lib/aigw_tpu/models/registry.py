@@ -1,0 +1,107 @@
+"""Model registry: name → (family, config, weight source).
+
+The serving engine resolves ``--model`` through this registry. Weight
+sources: ``random`` (tiny test models — the fake-chip mode the reference
+achieves with testupstream), ``orbax:<path>`` sharded checkpoints, or
+``hf:<path>`` local safetensors (no network).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from aigw_tpu.models import llama
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    name: str
+    family: str  # "llama" | "mixtral"
+    config: Any
+    weights: str = "random"  # "random" | "orbax:<dir>" | "hf:<dir>"
+    tokenizer: str = "byte"  # "byte" | path to tokenizer.json
+    chat_template: str = "llama3"  # "llama3" | "chatml"
+
+
+@dataclass(frozen=True)
+class ModelFns:
+    """The functional surface the serving engine drives — uniform across
+    model families (prefill/decode share the paged-KV contract)."""
+
+    init_params: Any
+    prefill: Any
+    decode_step: Any
+    hidden_states: Any
+    # chunked prefill over cached prefix pages; None disables the engine's
+    # prefix cache for the family
+    prefill_suffix: Any = None
+    # sequence-parallel (ring-attention) prefill for long prompts; None
+    # disables the engine's sp prefill path for the family
+    prefill_sp: Any = None
+    # multi-position verifier for speculative decoding; None disables the
+    # engine's prompt-lookup speculation for the family
+    verify_step: Any = None
+
+
+def family_fns(family: str) -> ModelFns:
+    if family == "llama":
+        return ModelFns(llama.init_params, llama.prefill, llama.decode_step,
+                        llama.hidden_states,
+                        prefill_suffix=llama.prefill_suffix,
+                        prefill_sp=llama.prefill_sp,
+                        verify_step=llama.verify_step)
+    if family == "mixtral":
+        from aigw_tpu.models import mixtral
+
+        return ModelFns(mixtral.init_params, mixtral.prefill,
+                        mixtral.decode_step, mixtral.hidden_states,
+                        verify_step=mixtral.verify_step)
+    raise KeyError(f"unknown model family {family!r}")
+
+
+_REGISTRY: dict[str, ModelSpec] = {}
+
+
+def register_model(spec: ModelSpec) -> None:
+    _REGISTRY[spec.name] = spec
+
+
+def get_model_spec(name: str) -> ModelSpec:
+    if name in _REGISTRY:
+        return _REGISTRY[name]
+    raise KeyError(
+        f"unknown model {name!r}; registered: {sorted(_REGISTRY)}"
+    )
+
+
+register_model(ModelSpec("tiny-random", "llama", llama.TINY))
+
+
+def _register_mixtral() -> None:
+    from aigw_tpu.models import mixtral
+
+    register_model(ModelSpec("tiny-moe", "mixtral", mixtral.TINY_MOE))
+    register_model(ModelSpec("mixtral-8x7b", "mixtral",
+                             mixtral.MIXTRAL_8X7B,
+                             weights="orbax:checkpoints/mixtral-8x7b"))
+
+
+_register_mixtral()
+register_model(ModelSpec("llama-3-8b", "llama", llama.LLAMA3_8B,
+                         weights="orbax:checkpoints/llama-3-8b"))
+register_model(ModelSpec("qwen2-7b", "llama", llama.QWEN2_7B,
+                         weights="orbax:checkpoints/qwen2-7b",
+                         chat_template="chatml"))
+register_model(ModelSpec("qwen2-0.5b", "llama", llama.QWEN2_05B,
+                         weights="orbax:checkpoints/qwen2-0.5b",
+                         chat_template="chatml"))
+register_model(ModelSpec(
+    "tiny-qwen", "llama",
+    llama.LlamaConfig(vocab_size=512, dim=64, n_layers=2, n_heads=4,
+                      n_kv_heads=2, ffn_dim=128, max_seq_len=512,
+                      rope_theta=10000.0, attn_bias=True,
+                      tie_embeddings=True),
+))
+register_model(ModelSpec("llama-3-70b", "llama", llama.LLAMA3_70B,
+                         weights="orbax:checkpoints/llama-3-70b"))

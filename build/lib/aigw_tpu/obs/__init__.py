@@ -1,0 +1,2 @@
+"""Observability: OTel GenAI metrics + tracing (reference internal/metrics,
+internal/tracing)."""

@@ -1,0 +1,192 @@
+"""GenAI metrics with OTel semantic-convention names.
+
+Reference: internal/metrics/genai.go:14-24 records
+``gen_ai.client.token.usage``, ``gen_ai.server.request.duration``,
+``gen_ai.server.time_to_first_token``, ``gen_ai.server.time_per_output_token``
+with operation/provider/model/token-type attributes, exported via Prometheus
+(+ optional OTLP). We register the same instruments on a prometheus_client
+registry (dots become underscores per the Prometheus naming translation the
+OTel exporter applies).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from prometheus_client import CollectorRegistry, Counter, Histogram, generate_latest
+
+from aigw_tpu.gateway.costs import TokenUsage
+
+_LATENCY_BUCKETS = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+    10.0, 30.0, 60.0, 120.0,
+)
+_TOKEN_BUCKETS = (
+    1, 4, 16, 64, 256, 1024, 4096, 16384, 65536, 262144, 1048576,
+)
+
+
+class GenAIMetrics:
+    """Instrument set shared by the gateway and tpuserve."""
+
+    def __init__(self, registry: CollectorRegistry | None = None):
+        self.registry = registry or CollectorRegistry()
+        labels = ["gen_ai_operation_name", "gen_ai_provider_name",
+                  "gen_ai_request_model", "gen_ai_response_model"]
+        self.token_usage = Histogram(
+            "gen_ai_client_token_usage",
+            "Number of input/output tokens used per request",
+            labels + ["gen_ai_token_type"],
+            registry=self.registry,
+            buckets=_TOKEN_BUCKETS,
+        )
+        self.request_duration = Histogram(
+            "gen_ai_server_request_duration_seconds",
+            "End-to-end request duration",
+            labels + ["error_type"],
+            registry=self.registry,
+            buckets=_LATENCY_BUCKETS,
+        )
+        self.time_to_first_token = Histogram(
+            "gen_ai_server_time_to_first_token_seconds",
+            "Time until the first streamed token",
+            labels,
+            registry=self.registry,
+            buckets=_LATENCY_BUCKETS,
+        )
+        self.time_per_output_token = Histogram(
+            "gen_ai_server_time_per_output_token_seconds",
+            "Inter-token latency for streamed tokens",
+            labels,
+            registry=self.registry,
+            buckets=_LATENCY_BUCKETS,
+        )
+        self.requests_total = Counter(
+            "aigw_requests_total",
+            "Requests by route/backend/status",
+            ["route", "backend", "status"],
+            registry=self.registry,
+        )
+        self.retries_total = Counter(
+            "aigw_retries_total",
+            "Upstream retry attempts",
+            ["route", "backend"],
+            registry=self.registry,
+        )
+
+    def export(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+class MCPMetrics:
+    """MCP proxy instruments (reference internal/metrics/mcp_metrics.go:
+    ``mcp.request.duration`` / ``mcp.method.count`` /
+    ``mcp.initialization.duration`` / ``mcp.capabilities.negotiated`` /
+    ``mcp.progress.notifications``, with method/backend/status/error
+    attributes). Lives in the gateway's shared registry — scraped via
+    GenAIMetrics.export on /metrics."""
+
+    def __init__(self, registry: CollectorRegistry):
+        self.registry = registry
+        self.method_total = Counter(
+            "mcp_method_total",
+            "JSON-RPC methods handled by the MCP proxy",
+            ["mcp_method_name", "mcp_backend", "status"],
+            registry=self.registry,
+        )
+        self.request_duration = Histogram(
+            "mcp_request_duration_seconds",
+            "MCP request handling duration",
+            ["mcp_method_name"],
+            registry=self.registry,
+            buckets=_LATENCY_BUCKETS,
+        )
+        self.initialization_duration = Histogram(
+            "mcp_initialization_duration_seconds",
+            "MCP session initialization duration (backend fan-out)",
+            [],
+            registry=self.registry,
+            buckets=_LATENCY_BUCKETS,
+        )
+        self.capabilities_negotiated = Counter(
+            "mcp_capabilities_negotiated_total",
+            "Capabilities negotiated at initialize",
+            ["capability_type", "capability_side"],
+            registry=self.registry,
+        )
+        self.progress_notifications = Counter(
+            "mcp_progress_notifications_total",
+            "Progress notifications routed through the proxy",
+            [],
+            registry=self.registry,
+        )
+        self.errors_total = Counter(
+            "mcp_errors_total",
+            "MCP errors by method and type",
+            ["mcp_method_name", "error_type"],
+            registry=self.registry,
+        )
+
+
+@dataclass
+class RequestMetrics:
+    """Per-request lifecycle recorder (reference metrics.Metrics interface,
+    metrics.go:97-127: StartRequest/SetModel/RecordTokenUsage/…)."""
+
+    metrics: GenAIMetrics
+    operation: str = "chat"
+    provider: str = ""
+    request_model: str = ""
+    response_model: str = ""
+    start: float = field(default_factory=time.monotonic)
+    first_token_at: float = 0.0
+    last_token_at: float = 0.0
+    tokens_seen: int = 0
+    final_usage: TokenUsage = field(default_factory=TokenUsage)
+    error_type: str = ""
+    # enrichment surfaced to the structured access log (reference: Envoy
+    # dynamic-metadata pipeline)
+    costs: dict[str, int] = field(default_factory=dict)
+    attempts: int = 0
+
+    def _labels(self) -> list[str]:
+        return [
+            self.operation,
+            self.provider,
+            self.request_model,
+            self.response_model or self.request_model,
+        ]
+
+    def record_tokens_emitted(self, n: int) -> None:
+        """Called per streamed chunk with content tokens (TTFT/ITL gauges,
+        recorded only for streaming — reference processor_impl.go:563)."""
+        if n <= 0:
+            return
+        now = time.monotonic()
+        if self.first_token_at == 0.0:
+            self.first_token_at = now
+            self.metrics.time_to_first_token.labels(*self._labels()).observe(
+                now - self.start
+            )
+        elif self.tokens_seen:
+            itl = (now - self.last_token_at) / n
+            self.metrics.time_per_output_token.labels(*self._labels()).observe(itl)
+        self.last_token_at = now
+        self.tokens_seen += n
+
+    def finish(self, usage: TokenUsage, error_type: str = "") -> None:
+        self.final_usage = usage
+        self.error_type = error_type
+        labels = self._labels()
+        for token_type, n in (
+            ("input", usage.input_tokens),
+            ("output", usage.output_tokens),
+            ("total", usage.total_tokens),
+            ("cached_input", usage.cached_input_tokens),
+        ):
+            if n:
+                self.metrics.token_usage.labels(*labels, token_type).observe(n)
+        self.metrics.request_duration.labels(*labels, error_type).observe(
+            time.monotonic() - self.start
+        )

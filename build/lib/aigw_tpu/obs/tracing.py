@@ -1,0 +1,281 @@
+"""Distributed tracing with OTel GenAI semantic conventions.
+
+Equivalent of the reference's internal/tracing (tracing.go:116-230):
+env-driven configuration, W3C ``traceparent`` propagation to upstreams,
+per-request spans carrying GenAI attributes (model, token usage, TTFT).
+
+The environment provides only the OTel *API* package, not the SDK, so the
+span pipeline here is self-contained: spans are exported as JSON lines
+(console) or OTLP/HTTP JSON (``/v1/traces``) from a background flusher.
+
+Env vars (the reference honors the same ones):
+- ``OTEL_SDK_DISABLED=true``            — tracing off
+- ``OTEL_TRACES_EXPORTER=console|otlp|none``
+- ``OTEL_EXPORTER_OTLP_ENDPOINT``       — e.g. http://collector:4318
+- ``OTEL_SERVICE_NAME``                 — default aigw-tpu
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import re
+import secrets
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
+)
+
+
+@dataclass
+class SpanContext:
+    trace_id: str  # 32 hex
+    span_id: str  # 16 hex
+    sampled: bool = True
+
+    def traceparent(self) -> str:
+        flags = "01" if self.sampled else "00"
+        return f"00-{self.trace_id}-{self.span_id}-{flags}"
+
+    @staticmethod
+    def parse(header: str) -> "SpanContext | None":
+        m = _TRACEPARENT_RE.match(header.strip())
+        if not m:
+            return None
+        _, trace_id, span_id, flags = m.groups()
+        if trace_id == "0" * 32 or span_id == "0" * 16:
+            return None
+        return SpanContext(trace_id=trace_id, span_id=span_id,
+                           sampled=bool(int(flags, 16) & 1))
+
+
+@dataclass
+class Span:
+    name: str
+    context: SpanContext
+    parent_span_id: str = ""
+    start_ns: int = field(default_factory=time.time_ns)
+    end_ns: int = 0
+    attributes: dict[str, Any] = field(default_factory=dict)
+    events: list[tuple[str, int]] = field(default_factory=list)
+    status_error: str = ""
+    _tracer: "Tracer | None" = None
+
+    def set(self, key: str, value: Any) -> "Span":
+        self.attributes[key] = value
+        return self
+
+    def add_event(self, name: str) -> None:
+        self.events.append((name, time.time_ns()))
+
+    def record_error(self, message: str) -> None:
+        self.status_error = message
+
+    def end(self) -> None:
+        self.end_ns = time.time_ns()
+        if self._tracer is not None:
+            self._tracer._export(self)
+
+
+class Tracer:
+    """Span factory + background exporter."""
+
+    def __init__(self, exporter: str = "", service_name: str = ""):
+        disabled = os.environ.get("OTEL_SDK_DISABLED", "").lower() == "true"
+        self.exporter = (
+            "none" if disabled
+            else (exporter or os.environ.get("OTEL_TRACES_EXPORTER",
+                                             "none")).lower()
+        )
+        self.service_name = (
+            service_name or os.environ.get("OTEL_SERVICE_NAME", "aigw-tpu")
+        )
+        self.endpoint = os.environ.get(
+            "OTEL_EXPORTER_OTLP_ENDPOINT", "http://127.0.0.1:4318"
+        ).rstrip("/")
+        self._q: "queue.Queue[Span]" = queue.Queue(maxsize=4096)
+        self._flusher: threading.Thread | None = None
+        if self.exporter == "otlp":
+            self._flusher = threading.Thread(
+                target=self._flush_loop, name="otlp-flusher", daemon=True
+            )
+            self._flusher.start()
+
+    @property
+    def enabled(self) -> bool:
+        return self.exporter in ("console", "otlp")
+
+    def start_span(
+        self, name: str, parent: SpanContext | None = None
+    ) -> Span:
+        # parent-based sampling: honor the caller's opt-out (flags 00)
+        sampled = parent.sampled if parent else True
+        ctx = SpanContext(
+            trace_id=parent.trace_id if parent else secrets.token_hex(16),
+            span_id=secrets.token_hex(8),
+            sampled=sampled,
+        )
+        return Span(
+            name=name,
+            context=ctx,
+            parent_span_id=parent.span_id if parent else "",
+            _tracer=self if self.enabled and sampled else None,
+        )
+
+    # -- export -----------------------------------------------------------
+    def _export(self, span: Span) -> None:
+        if self.exporter == "console":
+            print(json.dumps(self._to_dict(span)), file=sys.stderr)
+        elif self.exporter == "otlp":
+            try:
+                self._q.put_nowait(span)
+            except queue.Full:
+                pass  # drop rather than block the data plane
+
+    def _to_dict(self, s: Span) -> dict[str, Any]:
+        return {
+            "name": s.name,
+            "traceId": s.context.trace_id,
+            "spanId": s.context.span_id,
+            "parentSpanId": s.parent_span_id,
+            "startTimeUnixNano": s.start_ns,
+            "endTimeUnixNano": s.end_ns,
+            "attributes": s.attributes,
+            "events": [{"name": n, "timeUnixNano": t} for n, t in s.events],
+            "status": {"code": 2, "message": s.status_error}
+            if s.status_error
+            else {"code": 1},
+            "service": self.service_name,
+        }
+
+    def _flush_loop(self) -> None:
+        import urllib.request
+
+        while True:
+            spans = [self._q.get()]
+            try:
+                while len(spans) < 128:
+                    spans.append(self._q.get_nowait())
+            except queue.Empty:
+                pass
+            payload = self._otlp_payload(spans)
+            try:
+                req = urllib.request.Request(
+                    f"{self.endpoint}/v1/traces",
+                    data=json.dumps(payload).encode(),
+                    headers={"content-type": "application/json"},
+                )
+                urllib.request.urlopen(req, timeout=5)
+            except Exception:  # noqa: BLE001 — telemetry must never crash
+                pass
+
+    def _otlp_payload(self, spans: list[Span]) -> dict[str, Any]:
+        def attr(k: str, v: Any) -> dict[str, Any]:
+            if isinstance(v, bool):
+                val: dict[str, Any] = {"boolValue": v}
+            elif isinstance(v, int):
+                val = {"intValue": str(v)}
+            elif isinstance(v, float):
+                val = {"doubleValue": v}
+            else:
+                val = {"stringValue": str(v)}
+            return {"key": k, "value": val}
+
+        return {
+            "resourceSpans": [
+                {
+                    "resource": {
+                        "attributes": [
+                            attr("service.name", self.service_name)
+                        ]
+                    },
+                    "scopeSpans": [
+                        {
+                            "scope": {"name": "aigw_tpu"},
+                            "spans": [
+                                {
+                                    **{
+                                        k: v
+                                        for k, v in self._to_dict(s).items()
+                                        if k in ("name", "traceId", "spanId",
+                                                 "parentSpanId", "status")
+                                    },
+                                    "kind": 3,  # CLIENT
+                                    "startTimeUnixNano": str(s.start_ns),
+                                    "endTimeUnixNano": str(s.end_ns),
+                                    "attributes": [
+                                        attr(k, v)
+                                        for k, v in s.attributes.items()
+                                    ],
+                                    "events": [
+                                        {"name": n,
+                                         "timeUnixNano": str(t)}
+                                        for n, t in s.events
+                                    ],
+                                }
+                                for s in spans
+                            ],
+                        }
+                    ],
+                }
+            ]
+        }
+
+
+def genai_attributes(
+    *,
+    operation: str,
+    request_model: str,
+    response_model: str = "",
+    backend: str = "",
+    input_tokens: int = 0,
+    output_tokens: int = 0,
+    streaming: bool = False,
+) -> dict[str, Any]:
+    """GenAI semconv span attributes (reference openinference/* builders)."""
+    attrs: dict[str, Any] = {
+        "gen_ai.operation.name": operation,
+        "gen_ai.request.model": request_model,
+        "gen_ai.provider.name": backend,
+        "llm.is_streaming": streaming,
+    }
+    if response_model:
+        attrs["gen_ai.response.model"] = response_model
+    if input_tokens:
+        attrs["gen_ai.usage.input_tokens"] = input_tokens
+    if output_tokens:
+        attrs["gen_ai.usage.output_tokens"] = output_tokens
+    return attrs
+
+
+def parse_header_attribute_mapping(spec: str) -> list[tuple[str, str]]:
+    """``header:attribute[,header:attribute...]`` → mapping list
+    (reference internalapi.ParseRequestHeaderAttributeMapping; default
+    ``agent-session-id:session.id``). Configured via
+    ``AIGW_HEADER_ATTRIBUTES``."""
+    out: list[tuple[str, str]] = []
+    for pair in spec.split(","):
+        pair = pair.strip()
+        if not pair:
+            continue
+        header, _, attr = pair.partition(":")
+        if header and attr:
+            out.append((header.strip().lower(), attr.strip()))
+    return out
+
+
+DEFAULT_HEADER_ATTRIBUTES = "agent-session-id:session.id"
+
+
+def header_attributes(
+    headers: dict[str, str], mapping: list[tuple[str, str]]
+) -> dict[str, str]:
+    return {
+        attr: headers[h] for h, attr in mapping if h in headers
+    }

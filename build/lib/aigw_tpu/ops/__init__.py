@@ -1,0 +1,2 @@
+"""Attention ops: XLA reference implementations (models/llama.py) and
+Pallas TPU kernels for the hot paths."""

@@ -1,0 +1,30 @@
+"""Backend detection for Pallas kernels.
+
+``jax.default_backend()`` returns the PLATFORM name, which for the
+tunneled-TPU plugin is "axon", not "tpu" — comparing against "tpu" alone
+would silently run kernels in interpret mode on real hardware. Decide by
+inspecting the device itself (platform or device kind), once.
+"""
+
+from __future__ import annotations
+
+import jax
+
+_cache: bool | None = None
+
+
+def is_tpu_backend() -> bool:
+    global _cache
+    if _cache is None:
+        try:
+            d = jax.devices()[0]
+        except Exception:
+            # transient runtime-init failure: do NOT cache — a later call
+            # may succeed, and permanently answering False would silently
+            # run kernels in interpret mode on real hardware
+            return False
+        _cache = (
+            d.platform.lower() == "tpu"
+            or "tpu" in getattr(d, "device_kind", "").lower()
+        )
+    return _cache

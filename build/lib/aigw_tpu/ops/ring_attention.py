@@ -1,0 +1,167 @@
+"""Ring attention: context/sequence parallelism over the ``sp`` mesh axis.
+
+For sequences whose KV exceeds one chip's HBM, the sequence dimension is
+sharded across the mesh; each device computes attention of its **local Q
+shard** against K/V blocks that rotate around the ring via
+``lax.ppermute`` (ICI neighbor exchanges — the blockwise/ring-attention
+construction; SURVEY.md §5 long-context, PAPERS.md). Online softmax
+accumulates across ring steps, so no device ever materializes the full
+sequence.
+
+Communication cost: ``sp - 1`` neighbor hops of the local K/V block per
+attention call, fully overlapped by XLA with the per-step matmuls. This is
+the SPMD equivalent the reference's world has no analogue for (its gateway
+never touches model internals) — first-class here per the north star.
+
+An Ulysses-style alternative (all-to-all head-scatter, cheaper when
+``n_heads ≥ sp``) shares the entry point via ``strategy="ulysses"``.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _ring_attention_local(
+    q: jax.Array,  # [B, S_loc, H, D] — this device's query shard
+    k: jax.Array,  # [B, S_loc, Hkv, D]
+    v: jax.Array,  # [B, S_loc, Hkv, D]
+    *,
+    axis: str,
+    causal: bool,
+) -> jax.Array:
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    group = H // Hkv
+    idx = jax.lax.axis_index(axis)
+    n = jax.lax.psum(1, axis)
+    scale = 1.0 / math.sqrt(D)
+
+    q_pos = idx * S + jnp.arange(S)  # global positions of local queries
+    qg = q.reshape(B, S, Hkv, group, D)
+
+    def block_attend(kb, vb, src):
+        """Logits of local q against block kb/vb originating on `src`."""
+        k_pos = src * S + jnp.arange(S)
+        logits = jnp.einsum(
+            "bshgd,bthd->bhgst", qg, kb,
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]  # [S, S]
+            logits = jnp.where(mask[None, None, None, :, :], logits, -1e30)
+        return logits  # [B, Hkv, group, S, S]
+
+    def step(carry, i):
+        acc, m, l, kb, vb = carry
+        src = (idx - i) % n  # who produced the block we currently hold
+        logits = block_attend(kb, vb, src)
+        m_cur = jnp.max(logits, axis=-1)  # [B, Hkv, group, S]
+        m_new = jnp.maximum(m, m_cur)
+        alpha = jnp.exp(m - m_new)
+        probs = jnp.exp(logits - m_new[..., None])
+        l_new = l * alpha + probs.sum(-1)
+        pv = jnp.einsum("bhgst,bthd->bshgd", probs.astype(vb.dtype), vb)
+        acc = acc * alpha.transpose(0, 3, 1, 2)[..., None] + pv
+        # rotate the block to the next device on the ring
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        kb = jax.lax.ppermute(kb, axis, perm)
+        vb = jax.lax.ppermute(vb, axis, perm)
+        return (acc, m_new, l_new, kb, vb), None
+
+    # pvary: accumulators must be typed as varying over the ring axis or
+    # scan rejects the carry (shard_map's varying-manual-axes check)
+    acc0 = jax.lax.pvary(jnp.zeros((B, S, Hkv, group, D), jnp.float32),
+                         (axis,))
+    m0 = jax.lax.pvary(jnp.full((B, Hkv, group, S), -1e30, jnp.float32),
+                       (axis,))
+    l0 = jax.lax.pvary(jnp.zeros((B, Hkv, group, S), jnp.float32), (axis,))
+    (acc, m, l, _, _), _ = jax.lax.scan(
+        step, (acc0, m0, l0, k, v), jnp.arange(n)
+    )
+    denom = jnp.maximum(l, 1e-30).transpose(0, 3, 1, 2)[..., None]
+    out = (acc / denom).astype(q.dtype)
+    return out.reshape(B, S, H * D)
+
+
+def _ulysses_attention_local(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, axis: str, causal: bool
+) -> jax.Array:
+    """Ulysses: all-to-all so each device holds ALL positions for a slice
+    of heads, attends locally, then all-to-alls back. Requires
+    n_kv_heads % sp == 0."""
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    n = jax.lax.psum(1, axis)
+    group = H // Hkv
+
+    # scatter heads, gather sequence: [B, S, H, D] → [B, S*n, H/n, D]
+    def head_scatter(x):
+        heads = x.shape[2]
+        x = x.reshape(B, S, n, heads // n, D)
+        x = jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                               tiled=False)
+        return x.reshape(B, S * n, heads // n, D)
+
+    def head_gather(x, heads):
+        x = x.reshape(B, n, S, heads // n, D)
+        x = jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                               tiled=False)
+        return x.reshape(B, S, heads, D)
+
+    qh = head_scatter(q)  # [B, T, H/n, D]
+    kh = head_scatter(k)
+    vh = head_scatter(v)
+    T = S * n
+    scale = 1.0 / math.sqrt(D)
+    hq = qh.shape[2]
+    hkv = kh.shape[2]
+    g = hq // hkv
+    qg = qh.reshape(B, T, hkv, g, D)
+    logits = jnp.einsum("bshgd,bthd->bhgst", qg, kh,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        pos = jnp.arange(T)
+        mask = pos[:, None] >= pos[None, :]
+        logits = jnp.where(mask[None, None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhgst,bthd->bshgd", probs.astype(vh.dtype), vh)
+    out = out.reshape(B, T, hq, D)
+    out = head_gather(out, H)
+    return out.reshape(B, S, H * D)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "axis", "causal", "strategy")
+)
+def ring_attention(
+    q: jax.Array,  # [B, S, H, D] — S sharded over `axis`
+    k: jax.Array,  # [B, S, Hkv, D]
+    v: jax.Array,  # [B, S, Hkv, D]
+    *,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = True,
+    strategy: str = "ring",  # "ring" | "ulysses"
+) -> jax.Array:
+    """Sequence-parallel attention; returns [B, S, H*D] sharded like q."""
+    local = (
+        _ring_attention_local if strategy == "ring"
+        else _ulysses_attention_local
+    )
+    fn = jax.shard_map(
+        functools.partial(local, axis=axis, causal=causal),
+        mesh=mesh,
+        in_specs=(
+            P(None, axis, None, None),
+            P(None, axis, None, None),
+            P(None, axis, None, None),
+        ),
+        out_specs=P(None, axis, None),
+    )
+    return fn(q, k, v)

@@ -1,0 +1,49 @@
+"""Mesh construction over ICI/DCN.
+
+Axis conventions (scaling-book style):
+- ``dp``  — data parallel: independent replicas / batch sharding
+- ``tp``  — tensor parallel: attention heads + MLP columns over ICI
+- ``sp``  — sequence/context parallel (ring attention over ICI neighbors)
+- ``ep``  — expert parallel (MoE dispatch axis)
+
+For serving on a single v5e-8 slice the default is a 1×8 (dp×tp) mesh; the
+same code scales to multi-host by letting ``jax.distributed`` enumerate
+devices across DCN (TP stays intra-slice so its collectives ride ICI).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    dp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+    pp: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.tp * self.sp * self.ep * self.pp
+
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return ("dp", "tp", "sp", "ep", "pp")
+
+
+def make_mesh(spec: MeshSpec, devices: list | None = None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    if len(devices) < spec.size:
+        raise ValueError(
+            f"mesh {spec} needs {spec.size} devices, have {len(devices)}"
+        )
+    grid = np.asarray(devices[: spec.size]).reshape(
+        spec.dp, spec.tp, spec.sp, spec.ep, spec.pp
+    )
+    return Mesh(grid, spec.axis_names)

@@ -1,0 +1,87 @@
+"""Partition specs for model states (Megatron-style TP via GSPMD).
+
+Column-parallel in-projections (wq/wk/wv, w_gate/w_up) shard their output
+dimension over ``tp``; row-parallel out-projections (wo, w_down) shard
+their input dimension, so each layer needs exactly ONE all-reduce after
+attention and one after the MLP — which GSPMD inserts automatically from
+these specs (the "annotate shardings, let XLA insert collectives" recipe).
+
+The paged KV cache shards on the KV-head axis over ``tp`` (Llama-3's 8 KV
+heads ÷ TP=8 → one KV head per chip: cache reads/writes are fully local,
+no collective in the decode hot loop).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from aigw_tpu.models.llama import LlamaConfig
+
+
+def llama_param_specs(cfg: LlamaConfig) -> dict[str, P]:
+    specs: dict[str, P] = {
+        # vocab-sharded embedding + head (logits all-gathered by GSPMD)
+        "embed": P("tp", None),
+        "norm_f": P(None),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    for i in range(cfg.n_layers):
+        specs[f"l{i}.attn_norm"] = P(None)
+        specs[f"l{i}.wq"] = P(None, "tp")  # column parallel (heads)
+        specs[f"l{i}.wk"] = P(None, "tp")
+        specs[f"l{i}.wv"] = P(None, "tp")
+        if getattr(cfg, "attn_bias", False):
+            specs[f"l{i}.bq"] = P("tp")
+            specs[f"l{i}.bk"] = P("tp")
+            specs[f"l{i}.bv"] = P("tp")
+        specs[f"l{i}.wo"] = P("tp", None)  # row parallel
+        specs[f"l{i}.mlp_norm"] = P(None)
+        specs[f"l{i}.w_gate"] = P(None, "tp")
+        specs[f"l{i}.w_up"] = P(None, "tp")
+        specs[f"l{i}.w_down"] = P("tp", None)
+    return specs
+
+
+def kv_cache_spec() -> P:
+    """[L, 2, slots, n_kv_heads, head_dim] — shard KV heads over tp."""
+    return P(None, None, None, "tp", None)
+
+
+def shard_params(
+    params: dict[str, jax.Array], cfg: LlamaConfig, mesh: Mesh
+) -> dict[str, jax.Array]:
+    """Place a host pytree onto the mesh with TP shardings."""
+    specs = llama_param_specs(cfg)
+    return {
+        k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+        for k, v in params.items()
+    }
+
+
+def mixtral_param_specs(cfg) -> dict[str, P]:
+    """Expert-parallel + tensor-parallel specs for the Mixtral family.
+
+    Expert weights [E, D, F] shard experts over ``ep`` and the FFN width
+    over ``tp``; GSPMD turns the dispatch/combine einsums in
+    models/mixtral.py into all-to-alls over ``ep`` (SURVEY.md §2.9:
+    "mesh axis for experts + all-to-all dispatch").
+    """
+    specs: dict[str, P] = {
+        "embed": P("tp", None),
+        "norm_f": P(None),
+        "lm_head": P(None, "tp"),
+    }
+    for i in range(cfg.n_layers):
+        specs[f"l{i}.attn_norm"] = P(None)
+        specs[f"l{i}.wq"] = P(None, "tp")
+        specs[f"l{i}.wk"] = P(None, "tp")
+        specs[f"l{i}.wv"] = P(None, "tp")
+        specs[f"l{i}.wo"] = P("tp", None)
+        specs[f"l{i}.mlp_norm"] = P(None)
+        specs[f"l{i}.gate"] = P(None, None)  # router: tiny, replicated
+        specs[f"l{i}.w_gate"] = P("ep", None, "tp")
+        specs[f"l{i}.w_up"] = P("ep", None, "tp")
+        specs[f"l{i}.w_down"] = P("ep", "tp", None)
+    return specs

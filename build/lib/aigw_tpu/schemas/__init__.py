@@ -1,0 +1,8 @@
+"""Provider API schema helpers (reference internal/apischema).
+
+Bodies are handled as parsed JSON (dicts) with typed accessor/validator
+helpers per schema, rather than exhaustive struct mirrors: translation
+composes better over dicts, and unknown provider fields pass through
+unharmed (the reference preserves unknown fields through sjson edits for
+the same reason, translator.go:140-153).
+"""

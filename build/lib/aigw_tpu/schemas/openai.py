@@ -1,0 +1,448 @@
+"""OpenAI API schema helpers (reference internal/apischema/openai/openai.go).
+
+Covers the endpoint surface the gateway fronts: chat completions (incl.
+streaming chunks and tool calls), legacy completions, embeddings, models
+list, tokenize (vLLM-compatible), plus error bodies and usage extraction.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Any, Iterable
+
+from aigw_tpu.gateway.costs import TokenUsage
+
+
+class SchemaError(ValueError):
+    """Client-facing 400: malformed request body."""
+
+    status = 400
+
+
+class NotFoundError(SchemaError):
+    """Client-facing 404: a referenced resource doesn't exist (e.g. an
+    unknown ``previous_response_id`` — OpenAI returns 404 for these,
+    and SDK retry logic branches on 404 vs 400)."""
+
+    status = 404
+
+
+# ---------------------------------------------------------------------------
+# Requests
+# ---------------------------------------------------------------------------
+
+
+def parse_json_body(body: bytes) -> dict[str, Any]:
+    try:
+        data = json.loads(body)
+    except json.JSONDecodeError as e:
+        raise SchemaError(f"invalid JSON body: {e}") from None
+    if not isinstance(data, dict):
+        raise SchemaError("request body must be a JSON object")
+    return data
+
+
+def request_model(body: dict[str, Any]) -> str:
+    model = body.get("model")
+    if not isinstance(model, str) or not model:
+        raise SchemaError("missing required field: model")
+    return model
+
+
+def request_stream(body: dict[str, Any]) -> bool:
+    return bool(body.get("stream", False))
+
+
+def include_stream_usage(body: dict[str, Any]) -> bool:
+    opts = body.get("stream_options") or {}
+    return bool(opts.get("include_usage", False))
+
+
+def message_content_text(content: Any) -> str:
+    """Flatten the string-or-parts content union to text
+    (the union type the reference custom-unmarshals, openai.go)."""
+    if content is None:
+        return ""
+    if isinstance(content, str):
+        return content
+    if isinstance(content, list):
+        out = []
+        for part in content:
+            if isinstance(part, dict) and part.get("type") == "text":
+                out.append(str(part.get("text", "")))
+        return "".join(out)
+    raise SchemaError(f"invalid message content type {type(content).__name__}")
+
+
+#: content-part types accepted in user messages (reference openai.go
+#: ChatCompletionContentPartUnionParam)
+_USER_CONTENT_PART_TYPES = ("text", "image_url", "input_audio", "file")
+
+
+def _validate_content(i: int, role: str, content: Any) -> None:
+    if content is None or isinstance(content, str):
+        return
+    if not isinstance(content, list):
+        raise SchemaError(
+            f"messages[{i}].content must be a string or an array of "
+            f"content parts, got {type(content).__name__}")
+    for j, part in enumerate(content):
+        if not isinstance(part, dict):
+            raise SchemaError(
+                f"messages[{i}].content[{j}] must be an object")
+        ptype = part.get("type")
+        if role == "user":
+            if ptype not in _USER_CONTENT_PART_TYPES:
+                raise SchemaError(
+                    f"messages[{i}].content[{j}] has invalid type "
+                    f"{ptype!r}")
+            if ptype == "text" and not isinstance(part.get("text"), str):
+                raise SchemaError(
+                    f"messages[{i}].content[{j}].text must be a string")
+            if ptype == "image_url" and not isinstance(
+                    part.get("image_url"), dict):
+                raise SchemaError(
+                    f"messages[{i}].content[{j}].image_url must be an "
+                    "object")
+        else:  # assistant/system/developer/tool accept text (+ assistant
+            # refusal) parts
+            if ptype == "refusal" and role == "assistant":
+                if not isinstance(part.get("refusal"), str):
+                    raise SchemaError(
+                        f"messages[{i}].content[{j}].refusal must be a "
+                        "string")
+                continue
+            if ptype != "text":
+                raise SchemaError(
+                    f"messages[{i}].content[{j}] has invalid type "
+                    f"{ptype!r} for role {role!r}")
+            if not isinstance(part.get("text"), str):
+                raise SchemaError(
+                    f"messages[{i}].content[{j}].text must be a string")
+
+
+def _validate_tool_calls(i: int, tool_calls: Any) -> None:
+    if tool_calls is None:
+        return
+    if not isinstance(tool_calls, list):
+        raise SchemaError(f"messages[{i}].tool_calls must be an array")
+    for j, tc in enumerate(tool_calls):
+        if not isinstance(tc, dict):
+            raise SchemaError(
+                f"messages[{i}].tool_calls[{j}] must be an object")
+        ttype = tc.get("type")
+        if ttype == "custom":
+            cu = tc.get("custom")
+            if not isinstance(cu, dict) or not isinstance(
+                    cu.get("name"), str):
+                raise SchemaError(
+                    f"messages[{i}].tool_calls[{j}].custom.name is "
+                    "required")
+            continue
+        if ttype != "function":
+            raise SchemaError(
+                f"messages[{i}].tool_calls[{j}].type must be 'function' "
+                "or 'custom'")
+        fn = tc.get("function")
+        if not isinstance(fn, dict) or not isinstance(fn.get("name"), str):
+            raise SchemaError(
+                f"messages[{i}].tool_calls[{j}].function.name is required")
+        args = fn.get("arguments")
+        if args is not None and not isinstance(args, str):
+            raise SchemaError(
+                f"messages[{i}].tool_calls[{j}].function.arguments must "
+                "be a string")
+
+
+def _validate_tools(body: dict[str, Any]) -> None:
+    tools = body.get("tools")
+    if tools is None:
+        return
+    if not isinstance(tools, list):
+        raise SchemaError("tools must be an array")
+    for i, t in enumerate(tools):
+        if not isinstance(t, dict):
+            raise SchemaError(f"tools[{i}] must be an object")
+        ttype = t.get("type")
+        if ttype != "function":
+            raise SchemaError(
+                f"tools[{i}].type must be 'function', got {ttype!r}")
+        fn = t.get("function")
+        if not isinstance(fn, dict):
+            raise SchemaError(f"tools[{i}].function must be an object")
+        if not isinstance(fn.get("name"), str) or not fn.get("name"):
+            raise SchemaError(f"tools[{i}].function.name is required")
+        params = fn.get("parameters")
+        if params is not None and not isinstance(params, dict):
+            raise SchemaError(
+                f"tools[{i}].function.parameters must be an object")
+
+
+def _validate_tool_choice(body: dict[str, Any]) -> None:
+    choice = body.get("tool_choice")
+    if choice is None:
+        return
+    if isinstance(choice, str):
+        if choice not in ("none", "auto", "required"):
+            raise SchemaError(
+                f"tool_choice must be 'none', 'auto', 'required' or a "
+                f"named-tool object, got {choice!r}")
+        return
+    if not isinstance(choice, dict):
+        raise SchemaError("tool_choice must be a string or an object")
+    if choice.get("type") != "function":
+        raise SchemaError("tool_choice.type must be 'function'")
+    fn = choice.get("function")
+    if not isinstance(fn, dict) or not isinstance(fn.get("name"), str) \
+            or not fn.get("name"):
+        raise SchemaError("tool_choice.function.name is required")
+    if body.get("tools") in (None, []):
+        raise SchemaError(
+            "tool_choice requires a non-empty tools array")
+
+
+def _validate_stream_options(body: dict[str, Any]) -> None:
+    opts = body.get("stream_options")
+    if opts is None:
+        return
+    if not isinstance(opts, dict):
+        raise SchemaError("stream_options must be an object")
+    if not body.get("stream"):
+        raise SchemaError(
+            "stream_options is only allowed when stream is true")
+    iu = opts.get("include_usage")
+    if iu is not None and not isinstance(iu, bool):
+        raise SchemaError("stream_options.include_usage must be a boolean")
+
+
+def _validate_sampling_fields(body: dict[str, Any]) -> None:
+    for key, lo, hi in (("temperature", 0.0, 2.0), ("top_p", 0.0, 1.0),
+                        ("presence_penalty", -2.0, 2.0),
+                        ("frequency_penalty", -2.0, 2.0)):
+        v = body.get(key)
+        if v is None:
+            continue
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            raise SchemaError(f"{key} must be a number")
+        if not (lo <= float(v) <= hi):
+            raise SchemaError(f"{key} must be between {lo} and {hi}")
+    n = body.get("n")
+    if n is not None and (isinstance(n, bool) or not isinstance(n, int)
+                          or n < 1):
+        raise SchemaError("n must be a positive integer")
+    lp = body.get("logprobs")
+    if lp is not None and not isinstance(lp, bool):
+        raise SchemaError("logprobs must be a boolean")
+    tlp = body.get("top_logprobs")
+    if tlp is not None:
+        if isinstance(tlp, bool) or not isinstance(tlp, int) \
+                or not (0 <= tlp <= 20):
+            raise SchemaError("top_logprobs must be an integer in [0, 20]")
+    stop = body.get("stop")
+    if stop is not None and not isinstance(stop, str):
+        if not isinstance(stop, list) or \
+                any(not isinstance(s, str) for s in stop):
+            raise SchemaError(
+                "stop must be a string or an array of strings")
+
+
+def validate_chat_request(body: dict[str, Any]) -> None:
+    """Strict request validation at the edge (reference: typed unmarshal
+    of apischema/openai ChatCompletionRequest 400s malformed bodies
+    before any upstream traffic)."""
+    request_model(body)
+    messages = body.get("messages")
+    if not isinstance(messages, list) or not messages:
+        raise SchemaError("messages must be a non-empty array")
+    for i, m in enumerate(messages):
+        if not isinstance(m, dict):
+            raise SchemaError(f"messages[{i}] must be an object")
+        role = m.get("role")
+        if role not in ("system", "developer", "user", "assistant", "tool"):
+            raise SchemaError(f"messages[{i}] has invalid role {role!r}")
+        _validate_content(i, role, m.get("content"))
+        if role == "assistant":
+            _validate_tool_calls(i, m.get("tool_calls"))
+        if role == "tool" and not isinstance(m.get("tool_call_id"), str):
+            raise SchemaError(
+                f"messages[{i}] with role 'tool' requires tool_call_id")
+    _validate_tools(body)
+    _validate_tool_choice(body)
+    _validate_stream_options(body)
+    _validate_sampling_fields(body)
+    # response_format union (lazy import: translate package imports us)
+    from aigw_tpu.translate.structured import (
+        JSONSchemaError,
+        parse_response_format,
+    )
+
+    try:
+        parse_response_format(body)
+    except JSONSchemaError as e:
+        raise SchemaError(str(e)) from None
+
+
+# ---------------------------------------------------------------------------
+# Responses
+# ---------------------------------------------------------------------------
+
+
+def extract_usage(body: dict[str, Any]) -> TokenUsage:
+    """OpenAI usage object → TokenUsage (incl. details fields)."""
+    u = body.get("usage")
+    if not isinstance(u, dict):
+        return TokenUsage()
+    prompt_details = u.get("prompt_tokens_details") or {}
+    completion_details = u.get("completion_tokens_details") or {}
+    return TokenUsage(
+        input_tokens=int(u.get("prompt_tokens", 0) or 0),
+        output_tokens=int(u.get("completion_tokens", 0) or 0),
+        total_tokens=int(u.get("total_tokens", 0) or 0),
+        cached_input_tokens=int(prompt_details.get("cached_tokens", 0) or 0),
+        reasoning_tokens=int(completion_details.get("reasoning_tokens", 0) or 0),
+    )
+
+
+def usage_dict(usage: TokenUsage) -> dict[str, Any]:
+    d: dict[str, Any] = {
+        "prompt_tokens": usage.input_tokens,
+        "completion_tokens": usage.output_tokens,
+        "total_tokens": usage.total_tokens
+        or usage.input_tokens + usage.output_tokens,
+    }
+    if usage.cached_input_tokens:
+        d["prompt_tokens_details"] = {"cached_tokens": usage.cached_input_tokens}
+    if usage.reasoning_tokens:
+        d["completion_tokens_details"] = {
+            "reasoning_tokens": usage.reasoning_tokens
+        }
+    return d
+
+
+def chat_completion_response(
+    *,
+    model: str,
+    content: str,
+    finish_reason: str = "stop",
+    usage: TokenUsage | None = None,
+    tool_calls: list[dict[str, Any]] | None = None,
+    response_id: str = "",
+) -> dict[str, Any]:
+    message: dict[str, Any] = {"role": "assistant", "content": content}
+    if tool_calls:
+        message["tool_calls"] = tool_calls
+        if not content:
+            message["content"] = None
+    return {
+        "id": response_id or f"chatcmpl-{uuid.uuid4().hex[:24]}",
+        "object": "chat.completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [
+            {"index": 0, "message": message, "finish_reason": finish_reason}
+        ],
+        "usage": usage_dict(usage or TokenUsage()),
+    }
+
+
+def chat_completion_chunk(
+    *,
+    response_id: str,
+    model: str,
+    delta: dict[str, Any] | None = None,
+    finish_reason: str | None = None,
+    usage: TokenUsage | None = None,
+    created: int = 0,
+    logprobs: dict[str, Any] | None = None,
+) -> dict[str, Any]:
+    chunk: dict[str, Any] = {
+        "id": response_id,
+        "object": "chat.completion.chunk",
+        "created": created or int(time.time()),
+        "model": model,
+        "choices": [],
+    }
+    if delta is not None or finish_reason is not None:
+        choice: dict[str, Any] = {
+            "index": 0,
+            "delta": delta if delta is not None else {},
+            "finish_reason": finish_reason,
+        }
+        if logprobs is not None:
+            choice["logprobs"] = logprobs
+        chunk["choices"] = [choice]
+    if usage is not None:
+        chunk["usage"] = usage_dict(usage)
+    return chunk
+
+
+def stream_chunk_sse(
+    *,
+    response_id: str,
+    model: str,
+    created: int,
+    delta: dict[str, Any] | None = None,
+    finish_reason: str | None = None,
+    usage: TokenUsage | None = None,
+    logprobs: dict[str, Any] | None = None,
+) -> bytes:
+    """One chat.completion.chunk encoded as an SSE event — the shared
+    emitter for every cross-schema streaming translator."""
+    from aigw_tpu.translate.sse import SSEEvent
+
+    return SSEEvent(
+        data=json.dumps(
+            chat_completion_chunk(
+                response_id=response_id,
+                model=model,
+                delta=delta,
+                finish_reason=finish_reason,
+                usage=usage,
+                created=created,
+                logprobs=logprobs,
+            )
+        )
+    ).encode()
+
+
+def embeddings_response(
+    *, model: str, vectors: Iterable[list[float]], usage: TokenUsage
+) -> dict[str, Any]:
+    return {
+        "object": "list",
+        "model": model,
+        "data": [
+            {"object": "embedding", "index": i, "embedding": v}
+            for i, v in enumerate(vectors)
+        ],
+        "usage": {
+            "prompt_tokens": usage.input_tokens,
+            "total_tokens": usage.total_tokens or usage.input_tokens,
+        },
+    }
+
+
+def models_response(models: Iterable[tuple[str, str, int]]) -> dict[str, Any]:
+    """(name, owned_by, created) triples → /v1/models body."""
+    return {
+        "object": "list",
+        "data": [
+            {
+                "id": name,
+                "object": "model",
+                "created": created or int(time.time()),
+                "owned_by": owned_by,
+            }
+            for name, owned_by, created in models
+        ],
+    }
+
+
+def error_body(message: str, type_: str = "invalid_request_error", code: Any = None) -> bytes:
+    """OpenAI-format error envelope. The gateway wraps upstream errors the
+    same way the reference does (internalapi user-facing error wrapper)."""
+    return json.dumps(
+        {"error": {"message": message, "type": type_, "code": code}}
+    ).encode()

@@ -1,0 +1,10 @@
+"""Test infrastructure shipped with the package (fake providers,
+cassette record/replay) — the reference ships the equivalent under
+``tests/internal/testopenai`` as an importable package."""
+
+from aigw_tpu.testing.cassettes import (  # noqa: F401
+    Cassette,
+    CassetteServer,
+    Interaction,
+    load_cassette,
+)

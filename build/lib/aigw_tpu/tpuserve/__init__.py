@@ -1,0 +1,7 @@
+"""tpuserve — the JAX/XLA continuous-batching inference engine.
+
+The self-hosted serving path of the gateway, terminating on TPU (the role
+vLLM/InferencePool plays for the reference — SURVEY.md §2.8/§2.9). An
+OpenAI-surface HTTP server in front of a continuous-batching scheduler
+driving jit-compiled prefill/decode steps over a paged KV cache.
+"""

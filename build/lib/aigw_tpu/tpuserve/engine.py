@@ -1,0 +1,983 @@
+"""Continuous-batching engine.
+
+The TPU-native scheduler design (not a vLLM port):
+
+- **Fixed decode geometry**: decode runs a single jit-compiled program of
+  shape [max_batch, 1] every tick; finished slots are masked, not removed,
+  so there is exactly ONE compiled decode program for the engine lifetime.
+- **Bucketed prefill**: prompts are right-padded to power-of-two buckets so
+  the number of compiled prefill programs is log(max_seq_len).
+- **Sampling fused into the step**: logits never leave the device — each
+  tick transfers only [max_batch] int32 sampled tokens to the host.
+- **Donated cache**: the paged KV pool is donated through every step, so
+  XLA updates it in place (no per-tick HBM copy of the cache).
+- **Engine thread**: the loop runs in its own thread; JAX dispatch is
+  async, so the thread overlaps host bookkeeping with device compute.
+  Tokens flow back to asyncio consumers via loop.call_soon_threadsafe.
+
+Telemetry (KV occupancy, queue depth, active slots) feeds the endpoint
+picker — the reference's EPP signal (SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from aigw_tpu.models import llama
+from aigw_tpu.tpuserve.kvcache import (
+    OutOfPagesError,
+    PageAllocator,
+    PrefixCache,
+    RefcountedAllocator,
+)
+from aigw_tpu.tpuserve.sampling import (
+    SamplingParams,
+    apply_penalties,
+    sample,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class EngineOverloadedError(Exception):
+    """Admission queue full — callers should surface 429/503."""
+
+
+@dataclass
+class EngineConfig:
+    max_batch_size: int = 8
+    max_seq_len: int = 2048
+    page_size: int = 128
+    num_pages: int = 0  # 0 = auto: enough for max_batch full sequences
+    min_prefill_bucket: int = 64
+    # Decode steps executed per host round-trip (lax.scan inside one jitted
+    # program). Amortizes host↔device latency; tokens sampled after a
+    # sequence's EOS within a window are discarded by the host.
+    decode_steps_per_tick: int = 8
+    # Automatic prefix caching: full prompt pages are content-addressed and
+    # shared across requests (chat-history reuse → TTFT win).
+    enable_prefix_cache: bool = True
+    # Admission cap: waiting requests beyond this are rejected at submit
+    # (the server surfaces 429 + retry-after) instead of growing an
+    # unbounded queue.
+    max_queued_requests: int = 256
+    # Sequence-parallel prefill: prompts at least this long run through
+    # the ring-attention path when the mesh has an sp axis > 1 (context
+    # parallelism for prompts whose attention working set exceeds one
+    # chip). Shorter prompts use the plain prefill — the ICI rotation
+    # only pays for itself on long sequences.
+    sp_prefill_min_tokens: int = 1024
+    # Chunked prefill: prompts longer than this run as fixed-size
+    # prefill_suffix steps with a decode tick between chunks — bounding
+    # both the largest compiled bucket and how long active streams
+    # stall behind a long prompt. 0 disables (whole-prompt prefill).
+    prefill_chunk_tokens: int = 0
+    # Prompt-lookup speculative decoding: number of draft tokens verified
+    # per decode step (0 = off). Each step verifies 1+spec_tokens
+    # positions in one fixed-shape program and advances by the accepted
+    # count — see tpuserve/speculation.py.
+    spec_tokens: int = 0
+    # Ragged paged-attention Pallas kernel for the decode hot loop (HBM
+    # reads scale with actual sequence lengths, not the padded window).
+    # Single-chip only: ignored when the engine runs on a mesh.
+    pallas_attn: bool = False
+
+    def __post_init__(self) -> None:
+        if self.max_seq_len % self.page_size != 0:
+            raise ValueError(
+                f"max_seq_len ({self.max_seq_len}) must be a multiple of "
+                f"page_size ({self.page_size})"
+            )
+        if self.num_pages == 0:
+            self.num_pages = (
+                self.max_batch_size * self.max_seq_len // self.page_size
+            )
+
+    @property
+    def max_pages_per_seq(self) -> int:
+        return self.max_seq_len // self.page_size
+
+
+@dataclass
+class GenRequest:
+    prompt: list[int]
+    max_tokens: int
+    sampling: SamplingParams
+    stop_token_ids: tuple[int, ...] = ()
+    # (token_id, finish_reason): token_id < 0 means no token, just finish
+    emit: Callable[[int, str | None], None] = lambda t, f: None
+    id: int = 0
+    enqueued_at: float = field(default_factory=time.monotonic)
+    # set by the consumer to abandon the request (client disconnect / stop
+    # sequence hit); the engine frees the slot at the next tick
+    cancelled: threading.Event = field(default_factory=threading.Event)
+    # LoRA adapter name ("" = base model)
+    adapter: str = ""
+
+
+@dataclass
+class _Slot:
+    req: GenRequest
+    # Position at which the *pending input token* will be written by the
+    # next decode step. After prefilling a prompt of length n, the first
+    # sampled token is the pending input at position n.
+    pos: int
+    generated: int
+    key_seed: int
+    pending_token: int = 0
+    limit: int = 0  # exclusive max write position (page-safety fence)
+    page_row: np.ndarray | None = None
+    # becomes True when the slot has been included in a dispatched device
+    # state; windows dispatched earlier don't carry its tokens
+    started: bool = False
+    # generated-token histogram (repetition penalties survive state
+    # rebuilds across admissions)
+    token_counts: dict[int, int] = field(default_factory=dict)
+    adapter_row: int = 0
+    # ordered generated tokens (speculation rebuilds the on-device
+    # history buffer from prompt + these across admissions)
+    gen_tokens: list[int] = field(default_factory=list)
+
+
+@dataclass
+class EngineStats:
+    active_slots: int = 0
+    queued: int = 0
+    kv_pages_free: int = 0
+    kv_occupancy: float = 0.0
+    tokens_generated: int = 0
+    # extra tokens landed by accepted speculative drafts (beyond the one
+    # token per step the plain decode path yields)
+    spec_accepted: int = 0
+    prefills: int = 0
+    sp_prefills: int = 0  # prefills routed through ring attention
+    chunked_prefill_steps: int = 0  # intermediate chunk device steps
+    decode_steps: int = 0
+    prefix_cache_hits: int = 0
+    prefix_tokens_reused: int = 0
+
+
+class Engine:
+    """One model instance on one chip/slice."""
+
+    def __init__(
+        self,
+        params: dict[str, jax.Array],
+        model_cfg: Any,  # LlamaConfig / MixtralConfig (shared attributes)
+        cfg: EngineConfig,
+        eos_token_ids: tuple[int, ...] = (),
+        mesh: Any = None,
+        fns: Any = None,  # models.registry.ModelFns; default = llama
+        lora_params: dict[str, jax.Array] | None = None,
+        adapter_names: tuple[str, ...] = (),
+    ):
+        from aigw_tpu.models.registry import family_fns
+
+        self.fns = fns or family_fns("llama")
+        # multi-LoRA: stacked adapters + name→row map; the LAST row of the
+        # stack is the all-zeros base-model row (models/lora.py)
+        self.lora_params = lora_params
+        self.adapter_rows = {n: i for i, n in enumerate(adapter_names)}
+        self._base_row = len(adapter_names)
+        self.mesh = mesh
+        self.params = params
+        self.model_cfg = model_cfg
+        self.cfg = cfg
+        self.eos = eos_token_ids
+        if cfg.enable_prefix_cache and self.fns.prefill_suffix is not None:
+            self.allocator = RefcountedAllocator(cfg.num_pages, cfg.page_size)
+            self.prefix_cache = PrefixCache(self.allocator, cfg.page_size)
+        else:
+            self.allocator = PageAllocator(cfg.num_pages, cfg.page_size)
+            self.prefix_cache = None
+        self.stats = EngineStats()
+        self.healthy = True
+        self.last_error: str | None = None
+
+        B = cfg.max_batch_size
+        self._slots: list[_Slot | None] = [None] * B
+        self._queue: "queue.Queue[GenRequest]" = queue.Queue()
+        self._seq_ids = itertools.count()
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: threading.Thread | None = None
+
+        # device state. With a mesh, weights/cache are laid out with
+        # tensor/expert-parallel shardings and every jitted step runs SPMD
+        # (GSPMD inserts the collectives; SURVEY.md §2.9).
+        kv_shape = (
+            model_cfg.n_layers,
+            2,
+            cfg.num_pages * cfg.page_size,
+            model_cfg.n_kv_heads,
+            model_cfg.head_dim,
+        )
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            from aigw_tpu.parallel.sharding import (
+                kv_cache_spec,
+                llama_param_specs,
+                mixtral_param_specs,
+            )
+
+            specs = (
+                mixtral_param_specs(model_cfg)
+                if hasattr(model_cfg, "n_experts")
+                else llama_param_specs(model_cfg)
+            )
+
+            def spec_for(key: str, value) -> object:
+                # quantized weights: name.q shards like the base matrix;
+                # name.scale keeps the base spec only on axes it actually
+                # has extent in (keepdims axes of size 1 stay unsharded)
+                from jax.sharding import PartitionSpec as P
+
+                if key.endswith(".q"):
+                    return specs[key[:-2]]
+                if key.endswith(".scale"):
+                    base = specs[key[: -len(".scale")]]
+                    return P(*(
+                        ax if value.shape[i] > 1 else None
+                        for i, ax in enumerate(base)
+                    ))
+                return specs[key]
+
+            self.params = {
+                k: jax.device_put(v, NamedSharding(mesh, spec_for(k, v)))
+                for k, v in params.items()
+            }
+            self.kv_cache = jax.device_put(
+                jnp.zeros(kv_shape, jnp.bfloat16),
+                NamedSharding(mesh, kv_cache_spec()),
+            )
+        else:
+            self.kv_cache = jnp.zeros(kv_shape, jnp.bfloat16)
+        # Per-slot decode state lives ON DEVICE between ticks (uploaded
+        # only when membership/sampling changes) — the decode hot loop
+        # transfers just the sampled [K, B] tokens per round-trip.
+        self._device_state: dict[str, jax.Array] | None = None
+        self._state_dirty = True
+        # 1-deep pipeline: the window dispatched to the device while the
+        # host processes the previous window's tokens.
+        self._inflight: jax.Array | None = None
+        # pages owned by finished sequences are recycled only after the
+        # in-flight window completes (it may still write into them).
+        self._pending_frees: list[int] = []
+
+        mc, ps = model_cfg, cfg.page_size
+        K = cfg.decode_steps_per_tick
+        # ragged paged-attention kernel: single-chip decode only (under
+        # GSPMD the sharded gather path stays)
+        attn_impl = "pallas" if (cfg.pallas_attn and mesh is None) else ""
+        if cfg.pallas_attn and mesh is not None:
+            logger.warning("pallas_attn ignored: engine runs on a mesh "
+                           "(sharded gather path is used)")
+
+        model_prefill = self.fns.prefill
+        model_decode = self.fns.decode_step
+
+        def _prefill_step(params, lora, tokens, seq_lens, kv, page_table,
+                          keys, temp, top_p, top_k, bias, adapter_idx):
+            logits, kv = model_prefill(params, mc, tokens, seq_lens, kv,
+                                       page_table, ps, lora=lora,
+                                       adapter_idx=adapter_idx)
+            return sample(logits + bias, keys, temp, top_p, top_k), kv
+
+        model_prefill_suffix = self.fns.prefill_suffix
+
+        def _prefill_suffix_step(params, lora, tokens, prefix_lens,
+                                 seq_lens, kv, page_table, keys, temp,
+                                 top_p, top_k, bias, adapter_idx):
+            logits, kv = model_prefill_suffix(
+                params, mc, tokens, prefix_lens, seq_lens, kv, page_table,
+                ps, lora=lora, adapter_idx=adapter_idx,
+            )
+            return sample(logits + bias, keys, temp, top_p, top_k), kv
+
+        # sequence-parallel (ring attention) prefill for long prompts on
+        # an sp mesh (SURVEY §2.9 context parallelism)
+        self._sp = int(mesh.shape.get("sp", 1)) if mesh is not None else 1
+        self._prefill_sp_fn = None
+        if self._sp > 1 and self.fns.prefill_sp is not None:
+            model_prefill_sp = self.fns.prefill_sp
+
+            def _prefill_sp_step(params, lora, tokens, seq_lens, kv,
+                                 page_table, keys, temp, top_p, top_k,
+                                 bias, adapter_idx):
+                logits, kv = model_prefill_sp(
+                    params, mc, tokens, seq_lens, kv, page_table, ps,
+                    mesh=mesh, lora=lora, adapter_idx=adapter_idx,
+                )
+                return sample(logits + bias, keys, temp, top_p, top_k), kv
+
+            self._prefill_sp_fn = jax.jit(_prefill_sp_step,
+                                          donate_argnums=(4,))
+
+        def _decode_scan(params, lora, kv, state):
+            """K fused decode+sample steps; sampled tokens feed forward
+            on-device (no host round-trip inside the window)."""
+
+            def body(carry, _):
+                kv, st = carry
+                act = st["active"] & (st["positions"] < st["limits"])
+                logits, kv = model_decode(
+                    params, mc, st["tokens"], st["positions"], kv,
+                    st["page_table"], ps, act,
+                    lora=lora, adapter_idx=st["adapter_idx"],
+                    attn_impl=attn_impl,
+                )
+                logits = apply_penalties(
+                    logits, st["counts"], st["freq_pen"], st["pres_pen"],
+                    st["bias"],
+                )
+                sampled = sample(logits, st["keys"], st["temp"],
+                                 st["top_p"], st["top_k"])
+                step = act.astype(jnp.uint32)
+                B = sampled.shape[0]
+                counts = st["counts"].at[
+                    jnp.arange(B), sampled
+                ].add(act.astype(st["counts"].dtype))
+                new = dict(
+                    st,
+                    tokens=jnp.where(act, sampled, st["tokens"]),
+                    positions=jnp.where(act, st["positions"] + 1,
+                                        st["positions"]),
+                    keys=st["keys"].at[:, 1].add(step),
+                    counts=counts,
+                )
+                return (kv, new), sampled
+
+            (kv, state), sampled = jax.lax.scan(
+                body, (kv, state), None, length=K
+            )
+            return sampled, state, kv
+
+        # prompt-lookup speculation (tpuserve/speculation.py): replaces
+        # the [B, 1] decode step with a [B, D+1] verify step that advances
+        # by the accepted draft count. Same fixed-geometry contract — one
+        # compiled program for the engine lifetime.
+        self._spec = (
+            cfg.spec_tokens
+            if cfg.spec_tokens > 0 and self.fns.verify_step is not None
+            else 0
+        )
+        model_verify = self.fns.verify_step
+        D = self._spec
+        V = model_cfg.vocab_size
+        H = cfg.max_seq_len
+
+        def _spec_scan(params, lora, kv, state):
+            """K speculative steps; outputs (sampled [K, B, D+1],
+            n_emit [K, B]) — the host emits sampled[k, b, :n_emit[k, b]]."""
+            from aigw_tpu.tpuserve.speculation import (
+                accept_counts,
+                ngram_drafts,
+            )
+
+            D1 = D + 1
+
+            def body(carry, _):
+                kv, st = carry
+                act = st["active"] & (st["positions"] < st["limits"])
+                # penalty slots advance exactly one token per step (see
+                # speculation.py module docstring): poison their drafts
+                elig = (st["freq_pen"] == 0.0) & (st["pres_pen"] == 0.0)
+                drafts = ngram_drafts(st["history"], st["positions"], D)
+                drafts = jnp.where(elig[:, None], drafts, -1)
+                inputs = jnp.concatenate(
+                    [st["tokens"][:, None], jnp.maximum(drafts, 0)], axis=1
+                )
+                logits_all, kv = model_verify(
+                    params, mc, inputs, st["positions"], kv,
+                    st["page_table"], ps, act, st["limits"],
+                    lora=lora, adapter_idx=st["adapter_idx"],
+                    attn_impl=attn_impl,
+                )  # [B, D1, V]
+                # counts are window-start values: exact at d=0, and later
+                # positions only accept on penalty-free slots where the
+                # count term is zero anyway
+                lT = logits_all.transpose(1, 0, 2)  # [D1, B, V]
+                lT = jax.vmap(
+                    lambda l: apply_penalties(
+                        l, st["counts"], st["freq_pen"], st["pres_pen"],
+                        st["bias"],
+                    )
+                )(lT)
+                # per-position keys [seed, pos+d] — the same key the
+                # non-speculative path would use at that position, so
+                # accepted tokens are bit-identical to plain decoding
+                offs = jnp.arange(D1, dtype=jnp.uint32)
+                keys_d = (
+                    jnp.broadcast_to(st["keys"], (D1,) + st["keys"].shape)
+                    .at[:, :, 1].add(offs[:, None])
+                )
+                sampled = jax.vmap(
+                    lambda l, k: sample(l, k, st["temp"], st["top_p"],
+                                        st["top_k"])
+                )(lT, keys_d).T  # [B, D1]
+                n_acc = accept_counts(drafts, sampled)
+                n_emit = jnp.where(
+                    act,
+                    jnp.minimum(n_acc + 1, st["limits"] - st["positions"]),
+                    0,
+                )
+                B = sampled.shape[0]
+                rows = jnp.arange(B)
+                new_pending = sampled[rows, jnp.clip(n_emit - 1, 0, D)]
+                d_idx = jnp.arange(D1, dtype=jnp.int32)[None, :]
+                emit_mask = d_idx < n_emit[:, None]  # [B, D1]
+                # sampled[d] is the token at position pos+1+d
+                wpos = jnp.where(emit_mask,
+                                 st["positions"][:, None] + 1 + d_idx, H)
+                history = st["history"].at[rows[:, None], wpos].set(
+                    sampled, mode="drop"
+                )
+                counts = st["counts"].at[
+                    rows[:, None], jnp.where(emit_mask, sampled, V)
+                ].add(1, mode="drop")
+                new = dict(
+                    st,
+                    tokens=jnp.where(n_emit > 0, new_pending, st["tokens"]),
+                    positions=st["positions"] + n_emit,
+                    keys=st["keys"].at[:, 1].add(n_emit.astype(jnp.uint32)),
+                    counts=counts,
+                    history=history,
+                )
+                return (kv, new), (sampled, n_emit)
+
+            (kv, state), out = jax.lax.scan(body, (kv, state), None,
+                                            length=K)
+            return out, state, kv
+
+        self._prefill_fn = jax.jit(_prefill_step, donate_argnums=(4,))
+        self._prefill_suffix_fn = jax.jit(_prefill_suffix_step,
+                                          donate_argnums=(5,))
+        self._decode_fn = jax.jit(
+            _spec_scan if self._spec else _decode_scan, donate_argnums=(2, 3)
+        )
+
+    # -- public API -------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="tpuserve-engine", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Stop the loop; any still-pending requests finish with
+        "error" so waiting consumers never hang."""
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self._abort_all("engine stopped")
+
+    def submit(self, req: GenRequest) -> None:
+        if len(req.prompt) + req.max_tokens > self.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt+max_tokens {len(req.prompt)}+{req.max_tokens} exceeds "
+                f"max_seq_len {self.cfg.max_seq_len}"
+            )
+        if self._queue.qsize() >= self.cfg.max_queued_requests:
+            raise EngineOverloadedError(
+                f"queue full ({self.cfg.max_queued_requests} waiting)"
+            )
+        self._queue.put(req)
+        self._wake.set()
+
+    def warmup(self) -> None:
+        """Compile the decode program before traffic arrives (the first
+        request then only pays the prefill compile for its bucket)."""
+        state = self._build_device_state()
+        _, _, self.kv_cache = self._decode_fn(
+            self.params, self.lora_params, self.kv_cache, state
+        )
+
+    # -- engine loop ------------------------------------------------------
+    def _run(self) -> None:
+        logger.info("engine loop started (batch=%d, pages=%d×%d)",
+                    self.cfg.max_batch_size, self.cfg.num_pages,
+                    self.cfg.page_size)
+        while not self._stop.is_set():
+            try:
+                self._reap_cancelled()
+                admitted = self._admit()
+                worked = self._decode_tick()
+                if self._stop.is_set():
+                    self._drain_inflight()
+                    self._apply_frees()
+            except Exception as e:  # never die silently: fail loudly and
+                # error out every in-flight request instead of hanging them
+                logger.exception("engine tick failed")
+                self.healthy = False
+                self.last_error = f"{type(e).__name__}: {e}"
+                self._abort_all(str(e))
+                return
+            if not admitted and not worked:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+        # deliver any tokens still in flight before exiting
+        try:
+            self._drain_inflight()
+            self._apply_frees()
+        except Exception:
+            pass
+        logger.info("engine loop stopped")
+
+    def _abort_all(self, reason: str) -> None:
+        self._inflight = None
+        self._apply_frees()
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                s.req.emit(-1, "error")
+                self.allocator.free(s.req.id)
+                self._slots[i] = None
+        try:
+            while True:
+                req = self._queue.get_nowait()
+                req.emit(-1, "error")
+        except queue.Empty:
+            pass
+
+    def _reap_cancelled(self) -> None:
+        for i, s in enumerate(self._slots):
+            if s is not None and s.req.cancelled.is_set():
+                self._pending_frees.append(s.req.id)
+                self._slots[i] = None
+                self._state_dirty = True
+
+    def _free_slot_index(self) -> int | None:
+        for i, s in enumerate(self._slots):
+            if s is None:
+                return i
+        return None
+
+    def _admit(self) -> bool:
+        """Admit queued requests: prefill + first token."""
+        admitted = False
+        while True:
+            slot_idx = self._free_slot_index()
+            if slot_idx is None:
+                break
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if req.cancelled.is_set():
+                continue
+            n = len(req.prompt)
+            total = min(n + req.max_tokens, self.cfg.max_seq_len)
+            seq_id = next(self._seq_ids)
+            ps = self.cfg.page_size
+
+            # prefix cache: adopt the longest cached page-prefix (capped so
+            # at least one suffix token remains to produce first logits)
+            cached_pages: list[int] = []
+            chain_keys: list = []
+            if self.prefix_cache is not None and n > 1:
+                hits, hit_pages, chain_keys = self.prefix_cache.lookup(
+                    req.prompt
+                )
+                hits = min(hits, (n - 1) // ps)
+                cached_pages = hit_pages[:hits]
+            prefix_len = len(cached_pages) * ps
+
+            try:
+                if cached_pages:
+                    self.allocator.adopt(seq_id, cached_pages)
+                    extra = self.allocator.pages_for(total) - len(cached_pages)
+                    if extra > 0:
+                        self.allocator.allocate_extra(seq_id, extra)
+                else:
+                    self.allocator.allocate(seq_id, total)
+            except OutOfPagesError:
+                self.allocator.free(seq_id)
+                # put it back and wait for a slot to free pages
+                self._requeue_front(req)
+                break
+            pages = self.allocator.pages(seq_id)
+            req.id = seq_id
+
+            suffix = req.prompt[prefix_len:]
+            ns = len(suffix)
+            use_sp = (
+                self._prefill_sp_fn is not None
+                and prefix_len == 0
+                and ns >= self.cfg.sp_prefill_min_tokens
+            )
+            pt = np.zeros((1, self.cfg.max_pages_per_seq), np.int32)
+            pt[0, : len(pages)] = pages
+
+            adapter_row = self._base_row
+            if req.adapter:
+                row = self.adapter_rows.get(req.adapter)
+                if row is None:
+                    req.emit(-1, "error")
+                    self.allocator.free(seq_id)
+                    continue
+                adapter_row = row
+            key = np.array([[req.sampling.seed or seq_id, 0]], np.uint32)
+            bias_row = np.zeros((1, self.model_cfg.vocab_size), np.float32)
+            for tok_id, b in req.sampling.logit_bias:
+                if 0 <= tok_id < self.model_cfg.vocab_size:
+                    bias_row[0, tok_id] = b
+            sampling_args = (
+                jnp.asarray(key),
+                jnp.asarray([req.sampling.temperature], jnp.float32),
+                jnp.asarray([req.sampling.top_p], jnp.float32),
+                jnp.asarray([req.sampling.top_k], jnp.int32),
+                jnp.asarray(bias_row),
+                jnp.asarray([adapter_row], jnp.int32),
+            )
+            t0 = time.monotonic()
+            # pow2 page bucket covering the sequence — the gather window
+            # of suffix/chunked steps, not the full max_seq_len window
+            need = self.allocator.pages_for(total)
+            bucket = 1
+            while bucket < need:
+                bucket *= 2
+            bucket = min(bucket, self.cfg.max_pages_per_seq)
+
+            # chunked prefill: long prompts run as fixed-size suffix
+            # steps so no giant bucket is ever compiled and a decode
+            # tick runs between chunks — active streams keep emitting
+            # behind a long prompt instead of stalling for its whole
+            # prefill (vLLM-style chunked prefill; the prefill_suffix
+            # kernel with prefix_lens=consumed IS the chunk step)
+            chunk = self.cfg.prefill_chunk_tokens
+            consumed = 0
+            if (chunk > 0 and not use_sp
+                    and self.fns.prefill_suffix is not None
+                    and ns > chunk):
+                # loop-invariant device uploads hoisted; each boundary
+                # is also a cancellation/shutdown yield point — exactly
+                # what chunking exists to provide
+                pt_dev = jnp.asarray(pt[:, :bucket])
+                ctokens = np.zeros((1, chunk), np.int32)
+                aborted = False
+                while ns - consumed > chunk:
+                    if req.cancelled.is_set() or self._stop.is_set():
+                        aborted = True
+                        break
+                    ctokens[0, :] = suffix[consumed:consumed + chunk]
+                    _, self.kv_cache = self._prefill_suffix_fn(
+                        self.params,
+                        self.lora_params,
+                        jnp.asarray(ctokens),
+                        jnp.asarray([prefix_len + consumed], jnp.int32),
+                        jnp.asarray([prefix_len + consumed + chunk],
+                                    jnp.int32),
+                        self.kv_cache,
+                        pt_dev,
+                        *sampling_args,
+                    )
+                    consumed += chunk
+                    self.stats.chunked_prefill_steps += 1
+                    self._decode_tick()
+                if aborted:
+                    self.allocator.free(seq_id)
+                    if self._stop.is_set():
+                        # graceful stop mid-prompt: hand it back like an
+                        # OutOfPages retry; the drain path settles it
+                        if not req.cancelled.is_set():
+                            self._requeue_front(req)
+                        break
+                    continue  # cancelled: next queued request
+
+            eff_prefix = prefix_len + consumed
+            tail = suffix[consumed:]
+            ns_tail = len(tail)
+            # bucketed padded length for the remaining tokens
+            S = self.cfg.min_prefill_bucket
+            while S < ns_tail:
+                S *= 2
+            S = min(S, self.cfg.max_seq_len)
+            if use_sp and S % self._sp:
+                # ring attention shards the padded length over sp — round
+                # the bucket up to a multiple of sp (non-power-of-two sp
+                # like 6 must not silently disable the path)
+                S = -(-S // self._sp) * self._sp
+            tokens = np.zeros((1, S), np.int32)
+            tokens[0, :ns_tail] = tail
+
+            if prefix_len:
+                self.stats.prefix_cache_hits += 1
+                self.stats.prefix_tokens_reused += prefix_len
+            if eff_prefix:
+                next_tok, self.kv_cache = self._prefill_suffix_fn(
+                    self.params,
+                    self.lora_params,
+                    jnp.asarray(tokens),
+                    jnp.asarray([eff_prefix], jnp.int32),
+                    jnp.asarray([n], jnp.int32),
+                    self.kv_cache,
+                    jnp.asarray(pt[:, :bucket]),
+                    *sampling_args,
+                )
+            elif use_sp:
+                self.stats.sp_prefills += 1
+                next_tok, self.kv_cache = self._prefill_sp_fn(
+                    self.params,
+                    self.lora_params,
+                    jnp.asarray(tokens),
+                    jnp.asarray([n], jnp.int32),
+                    self.kv_cache,
+                    jnp.asarray(pt),
+                    *sampling_args,
+                )
+            else:
+                next_tok, self.kv_cache = self._prefill_fn(
+                    self.params,
+                    self.lora_params,
+                    jnp.asarray(tokens),
+                    jnp.asarray([n], jnp.int32),
+                    self.kv_cache,
+                    jnp.asarray(pt),
+                    *sampling_args,
+                )
+            tok = int(next_tok[0])
+            self.stats.prefills += 1
+            if self.prefix_cache is not None and chain_keys:
+                self.prefix_cache.insert(chain_keys, pages)
+            logger.debug("prefill seq=%d len=%d prefix=%d bucket=%d %.1fms",
+                         seq_id, n, prefix_len, S,
+                         1e3 * (time.monotonic() - t0))
+
+            # pos=n-1: _emit_token advances it to n, the write position of
+            # the just-sampled first token.
+            self._slots[slot_idx] = _Slot(
+                req=req, pos=n - 1, generated=0,
+                key_seed=req.sampling.seed or seq_id,
+                limit=total, page_row=pt[0], adapter_row=adapter_row,
+            )
+            self._emit_token(slot_idx, tok)
+            self._state_dirty = True
+            admitted = True
+        return admitted
+
+    def _requeue_front(self, req: GenRequest) -> None:
+        # queue.Queue has no push-front; use a tiny shim list
+        items = [req]
+        try:
+            while True:
+                items.append(self._queue.get_nowait())
+        except queue.Empty:
+            pass
+        for it in items:
+            self._queue.put(it)
+
+    def _decode_bucket_pages(self) -> int:
+        """Smallest power-of-two page count covering every active slot's
+        allocation — the decode gather window shrinks to what the batch
+        actually needs (short sequences don't pay max_seq_len attention).
+        jax.jit compiles one program per bucket shape."""
+        P = self.cfg.max_pages_per_seq
+        need = 1
+        for s in self._slots:
+            if s is not None:
+                need = max(need, -(-s.limit // self.cfg.page_size))
+        bucket = 1
+        while bucket < need:
+            bucket *= 2
+        return min(bucket, P)
+
+    def _build_device_state(self) -> dict[str, jax.Array]:
+        """Upload per-slot state after membership changes (admission /
+        completion) — small arrays, uploaded rarely."""
+        B = self.cfg.max_batch_size
+        P = self._decode_bucket_pages()
+        tokens = np.zeros((B,), np.int32)
+        positions = np.zeros((B,), np.int32)
+        limits = np.zeros((B,), np.int32)
+        active = np.zeros((B,), bool)
+        page_table = np.zeros((B, P), np.int32)
+        keys = np.zeros((B, 2), np.uint32)
+        temp = np.ones((B,), np.float32)
+        top_p = np.ones((B,), np.float32)
+        top_k = np.zeros((B,), np.int32)
+        freq_pen = np.zeros((B,), np.float32)
+        pres_pen = np.zeros((B,), np.float32)
+        V = self.model_cfg.vocab_size
+        counts = np.zeros((B, V), np.int32)
+        bias = np.zeros((B, V), np.float32)
+        adapter_idx = np.full((B,), self._base_row, np.int32)
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            tokens[i] = s.pending_token
+            positions[i] = s.pos
+            limits[i] = s.limit
+            active[i] = True
+            page_table[i] = s.page_row[:P]
+            keys[i, 0] = np.uint32(s.key_seed & 0xFFFFFFFF)
+            keys[i, 1] = np.uint32(s.pos)
+            temp[i] = s.req.sampling.temperature
+            top_p[i] = s.req.sampling.top_p
+            top_k[i] = s.req.sampling.top_k
+            freq_pen[i] = s.req.sampling.frequency_penalty
+            pres_pen[i] = s.req.sampling.presence_penalty
+            for tok_id, cnt in s.token_counts.items():
+                if 0 <= tok_id < V:
+                    counts[i, tok_id] = cnt
+            for tok_id, b in s.req.sampling.logit_bias:
+                if 0 <= tok_id < V:
+                    bias[i, tok_id] = b
+            adapter_idx[i] = s.adapter_row
+        state_extra: dict[str, jax.Array] = {}
+        if self._spec:
+            # speculation history: prompt + generated tokens, valid
+            # through the pending token's position
+            history = np.zeros((B, self.cfg.max_seq_len), np.int32)
+            for i, s in enumerate(self._slots):
+                if s is None:
+                    continue
+                pr = s.req.prompt
+                history[i, : len(pr)] = pr
+                history[i, len(pr): len(pr) + len(s.gen_tokens)] = (
+                    s.gen_tokens
+                )
+            state_extra["history"] = jnp.asarray(history)
+        return state_extra | {
+            "tokens": jnp.asarray(tokens),
+            "positions": jnp.asarray(positions),
+            "limits": jnp.asarray(limits),
+            "active": jnp.asarray(active),
+            "page_table": jnp.asarray(page_table),
+            "keys": jnp.asarray(keys),
+            "temp": jnp.asarray(temp),
+            "top_p": jnp.asarray(top_p),
+            "top_k": jnp.asarray(top_k),
+            "freq_pen": jnp.asarray(freq_pen),
+            "pres_pen": jnp.asarray(pres_pen),
+            "counts": jnp.asarray(counts),
+            "bias": jnp.asarray(bias),
+            "adapter_idx": jnp.asarray(adapter_idx),
+        }
+
+    def _process_window(self, sampled) -> None:
+        """Consume one decode window's sampled tokens (blocks until the
+        device finishes that window)."""
+        if isinstance(sampled, tuple):  # speculative window
+            self._process_spec_window(*sampled)
+            return
+        toks = np.asarray(sampled)  # [K, B]
+        K = toks.shape[0]
+        self.stats.decode_steps += K
+        for k in range(K):
+            for i, s in enumerate(self._slots):
+                if s is None:
+                    continue  # free slot / finished earlier in this window
+                if not s.started:
+                    continue  # admitted after this window was dispatched
+                self._emit_token(i, int(toks[k, i]))
+
+    def _process_spec_window(self, sampled: jax.Array,
+                             n_emit: jax.Array) -> None:
+        """Speculative window: sampled [K, B, D+1], n_emit [K, B] — the
+        leading n_emit tokens of each row are model-exact; the rest are
+        conditioned on rejected drafts and discarded."""
+        toks = np.asarray(sampled)
+        counts = np.asarray(n_emit)
+        K = toks.shape[0]
+        self.stats.decode_steps += K
+        for k in range(K):
+            for i, s in enumerate(self._slots):
+                if s is None or not s.started:
+                    continue
+                n = int(counts[k, i])
+                emitted = 0
+                for d in range(n):
+                    if self._slots[i] is None:
+                        break  # EOS/stop consumed the slot mid-burst
+                    self._emit_token(i, int(toks[k, i, d]))
+                    emitted += 1
+                if emitted > 1:
+                    self.stats.spec_accepted += emitted - 1
+
+    def _drain_inflight(self) -> None:
+        if self._inflight is not None:
+            sampled, self._inflight = self._inflight, None
+            self._process_window(sampled)
+
+    def _apply_frees(self) -> None:
+        for seq_id in self._pending_frees:
+            self.allocator.free(seq_id)
+        self._pending_frees.clear()
+
+    def _decode_tick(self) -> bool:
+        """Pipelined: dispatch window N+1, then process window N while
+        the device runs. State changes (admission/finish) force a drain so
+        the device never decodes against stale page tables."""
+        if self._state_dirty:
+            # finish the window computed under the old state first
+            self._drain_inflight()
+            self._apply_frees()
+            if self._state_dirty:
+                for s in self._slots:
+                    if s is not None:
+                        s.started = True
+                self._device_state = self._build_device_state()
+                self._state_dirty = False
+
+        active_idx = [i for i, s in enumerate(self._slots) if s is not None]
+        if not active_idx:
+            self._drain_inflight()
+            self._apply_frees()
+            self.stats.active_slots = 0
+            self._refresh_stats()
+            return False
+
+        sampled, self._device_state, self.kv_cache = self._decode_fn(
+            self.params, self.lora_params, self.kv_cache, self._device_state
+        )
+        # process the PREVIOUS window while this one runs on-device
+        self._drain_inflight()
+        self._inflight = sampled
+        self.stats.active_slots = sum(s is not None for s in self._slots)
+        self._refresh_stats()
+        return True
+
+    def _emit_token(self, i: int, tok: int) -> None:
+        """Record one generated token for slot i; finish if stopping."""
+        s = self._slots[i]
+        assert s is not None
+        req = s.req
+        s.generated += 1
+        finish: str | None = None
+        if tok in self.eos or tok in req.stop_token_ids:
+            finish = "stop"
+            req.emit(-1, finish)
+        else:
+            s.pos += 1  # where `tok` will be written by the next decode
+            if s.generated >= req.max_tokens or s.pos >= self.cfg.max_seq_len:
+                finish = "length"
+            req.emit(tok, finish)
+        self.stats.tokens_generated += 1
+        if finish is not None:
+            self._pending_frees.append(req.id)
+            self._slots[i] = None
+            self._state_dirty = True
+            self._wake.set()  # maybe admit a queued request
+        else:
+            # the sampled token is the input of the next decode step
+            s.pending_token = tok
+            s.token_counts[tok] = s.token_counts.get(tok, 0) + 1
+            s.gen_tokens.append(tok)
+
+    def _refresh_stats(self) -> None:
+        self.stats.queued = self._queue.qsize()
+        self.stats.kv_pages_free = self.allocator.free_pages
+        self.stats.kv_occupancy = self.allocator.occupancy

@@ -1,0 +1,236 @@
+"""Host-side paged KV cache bookkeeping.
+
+The device side is a flat page pool (models/llama.py); this allocator owns
+which pages belong to which sequence. Free pages are a LIFO stack — O(1)
+alloc/free, no fragmentation by construction (pages are fixed-size).
+
+The occupancy numbers exported here are the load-balancing signal for the
+endpoint picker (BASELINE.json north star: pick pods by KV-cache
+occupancy), the role the reference's EPP plays via
+``x-gateway-destination-endpoint`` (reference inferencepool.go:47).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+class OutOfPagesError(Exception):
+    """KV pool exhausted — request must wait in queue."""
+
+
+@dataclass
+class PageAllocator:
+    num_pages: int
+    page_size: int
+    _free: list[int] = field(default_factory=list)
+    _owned: dict[int, list[int]] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        self._free = list(range(self.num_pages - 1, -1, -1))
+
+    # -- allocation -------------------------------------------------------
+    def pages_for(self, n_tokens: int) -> int:
+        return max(1, -(-n_tokens // self.page_size))
+
+    def can_allocate(self, n_tokens: int) -> bool:
+        return len(self._free) >= self.pages_for(n_tokens)
+
+    def allocate(self, seq_id: int, n_tokens: int) -> list[int]:
+        need = self.pages_for(n_tokens)
+        if len(self._free) < need:
+            raise OutOfPagesError(
+                f"need {need} pages, {len(self._free)} free"
+            )
+        pages = [self._free.pop() for _ in range(need)]
+        self._owned.setdefault(seq_id, []).extend(pages)
+        return pages
+
+    def extend(self, seq_id: int, new_total_tokens: int) -> list[int]:
+        """Grow a sequence to cover new_total_tokens; returns new pages."""
+        owned = self._owned.get(seq_id, [])
+        need = self.pages_for(new_total_tokens) - len(owned)
+        if need <= 0:
+            return []
+        if len(self._free) < need:
+            raise OutOfPagesError(
+                f"extend needs {need} pages, {len(self._free)} free"
+            )
+        pages = [self._free.pop() for _ in range(need)]
+        owned.extend(pages)
+        self._owned[seq_id] = owned
+        return pages
+
+    def free(self, seq_id: int) -> None:
+        for page in self._owned.pop(seq_id, []):
+            self._free.append(page)
+
+    def pages(self, seq_id: int) -> list[int]:
+        return self._owned.get(seq_id, [])
+
+    # -- telemetry (the picker signal) ------------------------------------
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return self.num_pages - len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        return self.used_pages / self.num_pages if self.num_pages else 1.0
+
+
+class RefcountedAllocator(PageAllocator):
+    """PageAllocator with shared (refcounted) pages for prefix caching.
+
+    Pages holding cached prompt prefixes are shared read-only between
+    sequences. A page whose refcount drops to zero but whose content is
+    still registered in the prefix cache parks in an LRU *evictable* pool:
+    it can be revived by a later cache hit, or reclaimed (evicting the
+    cache entry) when fresh allocations need pages.
+    """
+
+    def __post_init__(self) -> None:
+        super().__post_init__()
+        self._refs: dict[int, int] = {}
+        # page id → cache key, insertion-ordered = LRU
+        self._evictable: dict[int, object] = {}
+        self._on_evict = None  # callback(cache_key)
+
+    def set_evict_callback(self, cb) -> None:
+        self._on_evict = cb
+
+    @property
+    def available_pages(self) -> int:
+        return len(self._free) + len(self._evictable)
+
+    def _pop_page(self) -> int:
+        if self._free:
+            return self._free.pop()
+        if self._evictable:
+            page, key = next(iter(self._evictable.items()))
+            del self._evictable[page]
+            if self._on_evict is not None:
+                self._on_evict(key)
+            return page
+        raise OutOfPagesError("no free or evictable pages")
+
+    def can_allocate(self, n_tokens: int) -> bool:
+        return self.available_pages >= self.pages_for(n_tokens)
+
+    @property
+    def free_pages(self) -> int:
+        # evictable pages are reclaimable on demand: report them as free so
+        # the picker/telemetry don't see a phantom-full pool
+        return self.available_pages
+
+    def allocate(self, seq_id: int, n_tokens: int) -> list[int]:
+        return self.allocate_extra(seq_id, self.pages_for(n_tokens))
+
+    def allocate_extra(self, seq_id: int, n_pages: int) -> list[int]:
+        """Allocate n fresh pages (suffix after shared-prefix adoption)."""
+        if self.available_pages < n_pages:
+            raise OutOfPagesError(
+                f"need {n_pages} pages, {self.available_pages} available"
+            )
+        pages = [self._pop_page() for _ in range(n_pages)]
+        for p in pages:
+            self._refs[p] = self._refs.get(p, 0) + 1
+        self._owned.setdefault(seq_id, []).extend(pages)
+        return pages
+
+    def adopt(self, seq_id: int, pages: list[int]) -> None:
+        """Share existing (cached) pages with a new sequence."""
+        for p in pages:
+            self._refs[p] = self._refs.get(p, 0) + 1
+            self._evictable.pop(p, None)  # back in active use
+        self._owned.setdefault(seq_id, []).extend(pages)
+
+    def free(self, seq_id: int) -> None:
+        for page in self._owned.pop(seq_id, []):
+            refs = self._refs.get(page, 1) - 1
+            if refs > 0:
+                self._refs[page] = refs
+                continue
+            self._refs.pop(page, None)
+            key = self._cache_key_of(page)
+            if key is not None:
+                self._evictable[page] = key  # park, revivable
+            else:
+                self._free.append(page)
+
+    # cache bookkeeping — maintained by PrefixCache
+    def _cache_key_of(self, page: int):
+        cache = getattr(self, "_prefix_cache", None)
+        return cache.key_of_page(page) if cache is not None else None
+
+    @property
+    def used_pages(self) -> int:
+        # evictable pages are reclaimable: count them as free capacity
+        return self.num_pages - len(self._free) - len(self._evictable)
+
+
+class PrefixCache:
+    """Content-addressed map of full prompt pages → pool page ids.
+
+    Keys are chain hashes: key_i = H(key_{i-1} ‖ tokens of page i), so a
+    hit on page i implies the whole prefix matches (the vLLM automatic-
+    prefix-caching construction, built independently for this engine).
+    """
+
+    def __init__(self, allocator: "RefcountedAllocator", page_size: int):
+        import hashlib as _h
+
+        self._h = _h
+        self.allocator = allocator
+        self.page_size = page_size
+        self._by_key: dict[bytes, int] = {}
+        self._key_by_page: dict[int, bytes] = {}
+        allocator._prefix_cache = self
+        allocator.set_evict_callback(self._evicted)
+
+    def chain_keys(self, prompt: list[int]) -> list[bytes]:
+        keys = []
+        prev = b""
+        for i in range(len(prompt) // self.page_size):
+            chunk = prompt[i * self.page_size : (i + 1) * self.page_size]
+            h = self._h.blake2b(digest_size=16)
+            h.update(prev)
+            h.update(b",".join(str(t).encode() for t in chunk))
+            prev = h.digest()
+            keys.append(prev)
+        return keys
+
+    def lookup(
+        self, prompt: list[int]
+    ) -> tuple[int, list[int], list[bytes]]:
+        """Longest cached page-prefix → (n_pages, page ids, all chain
+        keys — reusable by insert() so the prompt is hashed once)."""
+        keys = self.chain_keys(prompt)
+        pages: list[int] = []
+        for key in keys:
+            page = self._by_key.get(key)
+            if page is None:
+                break
+            pages.append(page)
+        return len(pages), pages, keys
+
+    def insert(self, keys: list[bytes], page_row: list[int]) -> None:
+        """Register fully-written prompt pages (keys from lookup())."""
+        for i, key in enumerate(keys):
+            if i >= len(page_row):
+                break
+            existing = self._by_key.get(key)
+            if existing is None:
+                self._by_key[key] = page_row[i]
+                self._key_by_page[page_row[i]] = key
+
+    def key_of_page(self, page: int):
+        return self._key_by_page.get(page)
+
+    def _evicted(self, key: bytes) -> None:
+        page = self._by_key.pop(key, None)
+        if page is not None:
+            self._key_by_page.pop(page, None)

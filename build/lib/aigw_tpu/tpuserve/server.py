@@ -1,0 +1,770 @@
+"""tpuserve HTTP server — the OpenAI-compatible surface over the engine.
+
+Endpoints: /v1/chat/completions (stream + non-stream), /v1/completions,
+/v1/embeddings, /tokenize (vLLM-compatible, reference mainlib/main.go:326),
+/v1/models, /health, /metrics, and /state — the KV-occupancy/queue-depth
+telemetry consumed by the gateway's endpoint picker (the reference's EPP
+protocol speaks ext_proc; ours is a plain JSON poll + the same
+``x-gateway-destination-endpoint`` contract, internalapi.go:76).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+import uuid
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from aiohttp import web
+
+from aigw_tpu.gateway.costs import TokenUsage
+from aigw_tpu.models import llama
+from aigw_tpu.models.registry import family_fns, get_model_spec
+from aigw_tpu.obs.metrics import GenAIMetrics, RequestMetrics
+from aigw_tpu.schemas import openai as oai
+from aigw_tpu.translate.sse import SSEEvent
+from aigw_tpu.tpuserve.engine import (
+    Engine,
+    EngineConfig,
+    EngineOverloadedError,
+    GenRequest,
+)
+from aigw_tpu.tpuserve.sampling import SamplingParams
+from aigw_tpu.tpuserve.tokenizer import (
+    StreamingDecoder,
+    apply_chat_template,
+    load_tokenizer,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def _find_stop(text: str, stop_strs: list[str]) -> int | None:
+    """Earliest index where a stop sequence begins, or None."""
+    best = None
+    for s in stop_strs:
+        if not s:
+            continue
+        i = text.find(s)
+        if i >= 0 and (best is None or i < best):
+            best = i
+    return best
+
+
+class TPUServeServer:
+    def __init__(
+        self,
+        model: str,
+        engine_cfg: EngineConfig,
+        metrics: GenAIMetrics | None = None,
+        tp: int = 1,
+        ep: int = 1,  # expert parallel (MoE families)
+        sp: int = 1,  # sequence parallel (ring-attention long prefill)
+        quantize: str = "",  # "" | "int8" (W8A16; llama-family only)
+        # name → adapter param dict (un-stacked [r,in]/[out,r] per target);
+        # served when a request's model == "<base>:<adapter>" or the bare
+        # adapter name
+        lora_adapters: dict[str, dict] | None = None,
+    ):
+        self.model_name = model
+        spec = get_model_spec(model)
+        self.fns = family_fns(spec.family)
+        self.model_cfg = spec.config
+        self.tokenizer = load_tokenizer(spec.tokenizer)
+        self.chat_template = spec.chat_template
+        self.metrics = metrics or GenAIMetrics()
+
+        mesh = None
+        if tp > 1 or ep > 1 or sp > 1:
+            from aigw_tpu.parallel import MeshSpec, make_mesh
+
+            if ep > 1:
+                n_experts = getattr(spec.config, "n_experts", 0)
+                if not n_experts:
+                    raise ValueError(
+                        f"--ep requires a MoE model family; {model!r} "
+                        "has no experts")
+                if n_experts % ep != 0:
+                    raise ValueError(
+                        f"n_experts {n_experts} not divisible by ep={ep}")
+            if tp > 1 and spec.config.n_kv_heads % tp != 0:
+                raise ValueError(
+                    f"n_kv_heads {spec.config.n_kv_heads} not divisible "
+                    f"by tp={tp}")
+            if sp > 1 and self.fns.prefill_sp is None:
+                raise ValueError(
+                    f"--sp requires a model family with a "
+                    f"sequence-parallel prefill; {spec.family!r} has none "
+                    "(devices on the sp axis would sit idle)")
+            mesh = make_mesh(MeshSpec(dp=1, tp=tp, sp=sp, ep=ep))
+            logger.info(
+                "parallel serving: tp=%d ep=%d sp=%d over %s", tp, ep, sp,
+                [str(d) for d in mesh.devices.flat])
+        if quantize and quantize != "int8":
+            raise ValueError(f"unknown quantization {quantize!r}")
+        if quantize == "int8" and spec.family != "llama":
+            raise ValueError(
+                "int8 quantization currently supports the llama family"
+            )
+        params = self._load_params(spec)
+        if quantize == "int8":
+            from aigw_tpu.models.quant import quantize_params
+
+            params = quantize_params(params, consume=True)
+            logger.info("weights quantized to int8 (W8A16)")
+        lora_params = None
+        adapter_names: tuple[str, ...] = ()
+        if lora_adapters:
+            if spec.family != "llama":
+                raise ValueError("LoRA serving supports the llama family")
+            adapter_names = tuple(lora_adapters)
+            lora_params = self._stack_adapters(lora_adapters)
+        self.adapter_names = adapter_names
+        self.engine = Engine(
+            params,
+            self.model_cfg,
+            engine_cfg,
+            eos_token_ids=(self.tokenizer.eos_id,),
+            mesh=mesh,
+            fns=self.fns,
+            lora_params=lora_params,
+            adapter_names=adapter_names,
+        )
+        # jitted embeddings path (bucketed like prefill)
+        hidden = self.fns.hidden_states
+        self._hidden_fn = jax.jit(
+            lambda p, t, l: hidden(p, self.model_cfg, t, l)
+        )
+
+        # host-overlap: encode/template/decode run on a worker pool, not
+        # the event loop — a long prompt's tokenization (or a big final
+        # detokenize) must not stall every other connection's IO. The HF
+        # tokenizer is native and releases the GIL, so this is true
+        # parallelism for real checkpoints.
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._tok_pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="tpuserve-tok"
+        )
+
+        self.app = web.Application()
+        self.app.router.add_post("/v1/chat/completions", self._chat)
+        self.app.router.add_post("/v1/completions", self._completions)
+        self.app.router.add_post("/v1/embeddings", self._embeddings)
+        self.app.router.add_post("/tokenize", self._tokenize)
+        self.app.router.add_get("/v1/models", self._models)
+        self.app.router.add_get("/health", self._health)
+        self.app.router.add_get("/state", self._state)
+        self.app.router.add_get("/metrics", self._metrics)
+        self.app.on_startup.append(self._on_start)
+        self.app.on_cleanup.append(self._on_stop)
+
+    def _load_params(self, spec) -> dict[str, jax.Array]:
+        if spec.weights == "random":
+            logger.info("initializing random weights for %s", spec.name)
+            return self.fns.init_params(jax.random.PRNGKey(0), self.model_cfg)
+        if spec.weights.startswith("orbax:"):
+            from aigw_tpu.models.checkpoint import restore_checkpoint
+
+            path = spec.weights[len("orbax:") :]
+            logger.info("restoring orbax checkpoint %s", path)
+            like = jax.eval_shape(
+                lambda: self.fns.init_params(jax.random.PRNGKey(0),
+                                             self.model_cfg)
+            )
+            return restore_checkpoint(path, like)
+        raise ValueError(f"unsupported weight source {spec.weights}")
+
+    def _stack_adapters(self, adapters: dict[str, dict]):
+        """Per-adapter dicts → stacked [n+1, ...] arrays (last row zero =
+        base model; models/lora.py layout)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        names = list(adapters)
+        keys = set()
+        for d in adapters.values():
+            keys.update(d)
+        stacked = {}
+        for k in keys:
+            rows = []
+            for n in names:
+                arr = adapters[n].get(k)
+                if arr is None:
+                    raise ValueError(
+                        f"adapter {n!r} missing tensor {k!r} (all adapters "
+                        "must target the same modules/rank)"
+                    )
+                if rows and arr.shape != rows[0].shape:
+                    raise ValueError(
+                        f"adapter {n!r} tensor {k!r} shape {arr.shape} "
+                        f"differs from {rows[0].shape} (ranks must match)"
+                    )
+                rows.append(np.asarray(arr, np.float32))
+            rows.append(np.zeros_like(rows[0]))  # base-model zero row
+            stacked[k] = jnp.asarray(np.stack(rows)).astype(jnp.bfloat16)
+        return stacked
+
+    def _resolve_adapter(self, model: str) -> str:
+        """`<base>:<adapter>` or bare adapter name → adapter name.
+        Raises SchemaError for an unknown colon-suffixed adapter (a typo
+        must not silently serve base-model output)."""
+        if model.startswith(self.model_name + ":"):
+            cand = model[len(self.model_name) + 1 :]
+            if cand not in self.adapter_names:
+                raise oai.SchemaError(
+                    f"unknown LoRA adapter {cand!r}; loaded: "
+                    f"{sorted(self.adapter_names)}"
+                )
+            return cand
+        return model if model in self.adapter_names else ""
+
+    async def _on_start(self, _app) -> None:
+        self.engine.start()
+        # compile the decode program off the request path
+        await asyncio.to_thread(self.engine.warmup)
+
+    async def _on_stop(self, _app) -> None:
+        self.engine.stop()
+        self._tok_pool.shutdown(wait=False)
+
+    # -- helpers ----------------------------------------------------------
+    def _submit(self, prompt: list[int], body: dict[str, Any]):
+        """Submit to the engine; returns an asyncio.Queue of
+        (token_id, finish_reason) tuples."""
+        loop = asyncio.get_running_loop()
+        out: asyncio.Queue = asyncio.Queue()
+
+        def emit(tok: int, finish: str | None) -> None:
+            loop.call_soon_threadsafe(out.put_nowait, (tok, finish))
+
+        max_tokens = int(
+            body.get("max_completion_tokens") or body.get("max_tokens") or 256
+        )
+        stop_ids: tuple[int, ...] = ()
+        req = GenRequest(
+            prompt=prompt,
+            max_tokens=max_tokens,
+            sampling=SamplingParams.from_request(body),
+            stop_token_ids=stop_ids,
+            emit=emit,
+            adapter=self._resolve_adapter(str(body.get("model", ""))),
+        )
+        self.engine.submit(req)
+        return out, req
+
+    # -- endpoints --------------------------------------------------------
+    async def _chat(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = oai.parse_json_body(await request.read())
+            oai.validate_chat_request(body)
+        except oai.SchemaError as e:
+            return web.Response(status=400, body=oai.error_body(str(e)),
+                                content_type="application/json")
+        prompt = await self._off(
+            apply_chat_template, body["messages"], self.tokenizer,
+            self.chat_template,
+        )
+        return await self._generate(request, body, prompt, chat=True)
+
+    async def _off(self, fn, *args):
+        """Run a tokenization-bound callable off the event loop."""
+        return await asyncio.get_running_loop().run_in_executor(
+            self._tok_pool, fn, *args
+        )
+
+    async def _completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = oai.parse_json_body(await request.read())
+            oai.request_model(body)
+        except oai.SchemaError as e:
+            return web.Response(status=400, body=oai.error_body(str(e)),
+                                content_type="application/json")
+        prompt_text = body.get("prompt", "")
+        if isinstance(prompt_text, list):
+            prompt_text = "".join(prompt_text)
+        prompt = [self.tokenizer.bos_id] + await self._off(
+            self.tokenizer.encode, prompt_text
+        )
+        return await self._generate(request, body, prompt, chat=False)
+
+    async def _generate(
+        self,
+        request: web.Request,
+        body: dict[str, Any],
+        prompt: list[int],
+        chat: bool,
+    ) -> web.StreamResponse:
+        stream = bool(body.get("stream", False))
+        n = int(body.get("n") or 1)
+        if n > 1:
+            if stream:
+                return web.Response(
+                    status=400,
+                    body=oai.error_body("n>1 with stream is not supported"),
+                    content_type="application/json")
+            if n > self.engine.cfg.max_batch_size:
+                return web.Response(
+                    status=400,
+                    body=oai.error_body(
+                        f"n={n} exceeds max_batch_size "
+                        f"{self.engine.cfg.max_batch_size}"),
+                    content_type="application/json")
+            return await self._generate_n(body, prompt, chat, n)
+        include_usage = oai.include_stream_usage(body)
+        rid = (
+            f"chatcmpl-{uuid.uuid4().hex[:24]}"
+            if chat
+            else f"cmpl-{uuid.uuid4().hex[:24]}"
+        )
+        created = int(time.time())
+        rm = RequestMetrics(
+            metrics=self.metrics,
+            operation="chat" if chat else "text_completion",
+            provider="tpuserve",
+            request_model=body.get("model", self.model_name),
+            response_model=self.model_name,
+        )
+        stops = body.get("stop")
+        stop_strs: list[str] = (
+            [stops] if isinstance(stops, str) else list(stops or [])
+        )
+        try:
+            out, gen_req = self._submit(prompt, body)
+        except EngineOverloadedError as e:
+            return web.Response(
+                status=429,
+                body=oai.error_body(str(e), type_="rate_limit_error"),
+                headers={"retry-after": "1"},
+                content_type="application/json")
+        except oai.SchemaError as e:
+            return web.Response(
+                status=404,
+                body=oai.error_body(str(e), type_="model_not_found"),
+                content_type="application/json")
+        except ValueError as e:
+            return web.Response(status=400, body=oai.error_body(str(e)),
+                                content_type="application/json")
+
+        n_prompt = len(prompt)
+        if not stream:
+            try:
+                text, n_out, finish = await self._collect(out, stop_strs)
+            except asyncio.CancelledError:
+                gen_req.cancelled.set()
+                raise
+            usage = TokenUsage(
+                input_tokens=n_prompt,
+                output_tokens=n_out,
+                total_tokens=n_prompt + n_out,
+            )
+            rm.finish(usage, error_type="engine" if finish == "error"
+                      else "")
+            if finish == "error":
+                return web.Response(
+                    status=500,
+                    body=oai.error_body("engine failure", type_="server_error"),
+                    content_type="application/json",
+                )
+            if chat:
+                resp = oai.chat_completion_response(
+                    model=self.model_name, content=text,
+                    finish_reason=finish, usage=usage, response_id=rid,
+                )
+            else:
+                resp = {
+                    "id": rid,
+                    "object": "text_completion",
+                    "created": created,
+                    "model": self.model_name,
+                    "choices": [
+                        {"index": 0, "text": text, "finish_reason": finish}
+                    ],
+                    "usage": oai.usage_dict(usage),
+                }
+            return web.json_response(resp)
+
+        # streaming
+        resp = web.StreamResponse(
+            status=200,
+            headers={"content-type": "text/event-stream",
+                     "cache-control": "no-cache"},
+        )
+        await resp.prepare(request)
+        decoder = StreamingDecoder(self.tokenizer)
+        emitted = ""
+        n_out = 0
+        finish = "stop"
+
+        async def write_piece(piece: str) -> None:
+            if not piece:
+                return
+            if chat:
+                await resp.write(
+                    oai.stream_chunk_sse(
+                        response_id=rid, model=self.model_name,
+                        created=created, delta={"content": piece},
+                    )
+                )
+            else:
+                await resp.write(
+                    SSEEvent(
+                        data=json.dumps(
+                            {
+                                "id": rid,
+                                "object": "text_completion",
+                                "created": created,
+                                "model": self.model_name,
+                                "choices": [
+                                    {"index": 0, "text": piece,
+                                     "finish_reason": None}
+                                ],
+                            }
+                        )
+                    ).encode()
+                )
+
+        try:
+            if chat:
+                await resp.write(
+                    oai.stream_chunk_sse(
+                        response_id=rid, model=self.model_name,
+                        created=created,
+                        delta={"role": "assistant", "content": ""},
+                    )
+                )
+            while True:
+                # keepalive comments while queued behind prefills so
+                # intermediaries don't drop an apparently-idle stream
+                while True:
+                    try:
+                        tok, fin = await asyncio.wait_for(out.get(),
+                                                          timeout=10.0)
+                        break
+                    except asyncio.TimeoutError:
+                        await resp.write(b": ping\n\n")
+                if tok >= 0:
+                    n_out += 1
+                    rm.record_tokens_emitted(1)
+                    piece = decoder.push(tok)
+                    if piece:
+                        emitted += piece
+                        hit = _find_stop(emitted, stop_strs)
+                        if hit is not None:
+                            # trim to just before the stop sequence
+                            keep = hit - (len(emitted) - len(piece))
+                            await write_piece(piece[:max(keep, 0)])
+                            finish = "stop"
+                            gen_req.cancelled.set()
+                            break
+                        await write_piece(piece)
+                if fin is not None:
+                    finish = fin
+                    if fin != "error":
+                        await write_piece(decoder.flush())
+                    break
+        except (asyncio.CancelledError, ConnectionResetError):
+            # client went away: stop generating, free the slot
+            gen_req.cancelled.set()
+            raise
+        usage = TokenUsage(
+            input_tokens=n_prompt, output_tokens=n_out,
+            total_tokens=n_prompt + n_out,
+        )
+        rm.finish(usage)
+        await resp.write(
+            oai.stream_chunk_sse(
+                response_id=rid, model=self.model_name, created=created,
+                delta={}, finish_reason=finish,
+                usage=usage if include_usage else None,
+            )
+        )
+        await resp.write(SSEEvent(data="[DONE]").encode())
+        await resp.write_eof()
+        return resp
+
+    async def _generate_n(
+        self, body: dict[str, Any], prompt: list[int], chat: bool, n: int
+    ) -> web.Response:
+        """n>1 choices: fan out n engine requests (continuous batching
+        runs them concurrently — same prompt pages shared by the prefix
+        cache) and assemble a multi-choice response."""
+        stops = body.get("stop")
+        stop_strs = [stops] if isinstance(stops, str) else list(stops or [])
+        sampling = SamplingParams.from_request(body)
+        outs = []
+        try:
+            for i in range(n):
+                # distinct seeds per choice so samples differ
+                # deterministically
+                per_choice = dict(body)
+                per_choice["seed"] = (sampling.seed or 0) + i if (
+                    sampling.seed or sampling.temperature > 0
+                ) else 0
+                outs.append(self._submit(prompt, per_choice))
+        except EngineOverloadedError as e:
+            for _q, req in outs:  # don't orphan already-queued choices
+                req.cancelled.set()
+            return web.Response(
+                status=429,
+                body=oai.error_body(str(e), type_="rate_limit_error"),
+                headers={"retry-after": "1"},
+                content_type="application/json")
+        results = await asyncio.gather(
+            *(self._collect(q, stop_strs) for q, _req in outs)
+        )
+        usage = TokenUsage(
+            input_tokens=len(prompt),
+            output_tokens=sum(r[1] for r in results),
+            total_tokens=len(prompt) + sum(r[1] for r in results),
+        )
+        rid = (f"chatcmpl-{uuid.uuid4().hex[:24]}" if chat
+               else f"cmpl-{uuid.uuid4().hex[:24]}")
+        if chat:
+            choices = [
+                {"index": i,
+                 "message": {"role": "assistant", "content": text},
+                 "finish_reason": finish}
+                for i, (text, _n, finish) in enumerate(results)
+            ]
+            resp = {
+                "id": rid, "object": "chat.completion",
+                "created": int(time.time()), "model": self.model_name,
+                "choices": choices, "usage": oai.usage_dict(usage),
+            }
+        else:
+            resp = {
+                "id": rid, "object": "text_completion",
+                "created": int(time.time()), "model": self.model_name,
+                "choices": [
+                    {"index": i, "text": text, "finish_reason": finish}
+                    for i, (text, _n, finish) in enumerate(results)
+                ],
+                "usage": oai.usage_dict(usage),
+            }
+        return web.json_response(resp)
+
+    async def _collect(
+        self, out: asyncio.Queue, stop_strs: list[str]
+    ) -> tuple[str, int, str]:
+        """Drain a generation to completion (non-streaming path)."""
+        decoder = StreamingDecoder(self.tokenizer)
+        text = ""
+        n_out = 0
+        finish = "stop"
+        while True:
+            tok, fin = await out.get()
+            if tok >= 0:
+                n_out += 1
+                text += decoder.push(tok)
+                hit = _find_stop(text, stop_strs)
+                if hit is not None:
+                    return text[:hit], n_out, "stop"
+            if fin is not None:
+                finish = fin
+                if fin != "error":
+                    text += decoder.flush()
+                return text, n_out, finish
+
+    async def _embeddings(self, request: web.Request) -> web.Response:
+        try:
+            body = oai.parse_json_body(await request.read())
+        except oai.SchemaError as e:
+            return web.Response(status=400, body=oai.error_body(str(e)),
+                                content_type="application/json")
+        raw = body.get("input")
+        if isinstance(raw, str):
+            items: list = [raw]
+        elif isinstance(raw, list) and raw and all(
+            isinstance(x, int) for x in raw
+        ):
+            items = [raw]  # a single pre-tokenized input
+        elif isinstance(raw, list):
+            items = list(raw)
+        else:
+            items = []
+        if not items:
+            return web.Response(
+                status=400,
+                body=oai.error_body(
+                    "input must be a string, array of strings, or array of "
+                    "token ids"
+                ),
+                content_type="application/json",
+            )
+        max_len = self.engine.cfg.max_seq_len
+        # encode all string items concurrently on the tokenizer pool
+        str_jobs = {
+            idx: self._off(self.tokenizer.encode, it)
+            for idx, it in enumerate(items) if isinstance(it, str)
+        }
+        str_results = dict(zip(
+            str_jobs, await asyncio.gather(*str_jobs.values())
+        ))
+        encoded = []
+        for idx, it in enumerate(items):
+            if isinstance(it, str):
+                encoded.append(str_results[idx][:max_len])
+            elif isinstance(it, list) and all(isinstance(x, int) for x in it):
+                encoded.append([x % self.model_cfg.vocab_size for x in it][:max_len])
+            else:
+                return web.Response(
+                    status=400,
+                    body=oai.error_body("invalid embeddings input element"),
+                    content_type="application/json",
+                )
+        S = max(8, max(len(e) for e in encoded))
+        S = 1 << (S - 1).bit_length()  # pow2 bucket to bound compiles
+        toks = np.zeros((len(encoded), S), np.int32)
+        lens = np.zeros((len(encoded),), np.int32)
+        for i, e in enumerate(encoded):
+            toks[i, : len(e)] = e
+            lens[i] = len(e)
+        hidden = await asyncio.to_thread(
+            lambda: np.asarray(
+                self._hidden_fn(self.engine.params, jnp.asarray(toks),
+                                jnp.asarray(lens))
+            )
+        )
+        n_tokens = int(lens.sum())
+        usage = TokenUsage(input_tokens=n_tokens, total_tokens=n_tokens)
+        return web.json_response(
+            oai.embeddings_response(
+                model=self.model_name,
+                vectors=[h.tolist() for h in hidden],
+                usage=usage,
+            )
+        )
+
+    async def _tokenize(self, request: web.Request) -> web.Response:
+        try:
+            body = oai.parse_json_body(await request.read())
+        except oai.SchemaError as e:
+            return web.Response(status=400, body=oai.error_body(str(e)),
+                                content_type="application/json")
+        if isinstance(body.get("messages"), list):
+            ids = await self._off(apply_chat_template, body["messages"],
+                                  self.tokenizer, self.chat_template)
+        else:
+            ids = await self._off(self.tokenizer.encode,
+                                  str(body.get("prompt", "")))
+        return web.json_response(
+            {
+                "count": len(ids),
+                "max_model_len": self.engine.cfg.max_seq_len,
+                "tokens": ids,
+            }
+        )
+
+    async def _models(self, _request: web.Request) -> web.Response:
+        entries = [(self.model_name, "tpuserve", 0)] + [
+            (f"{self.model_name}:{a}", "tpuserve-lora", 0)
+            for a in self.adapter_names
+        ]
+        return web.json_response(oai.models_response(entries))
+
+    async def _health(self, _request: web.Request) -> web.Response:
+        if not self.engine.healthy:
+            return web.json_response(
+                {"status": "error", "model": self.model_name,
+                 "error": self.engine.last_error},
+                status=503,
+            )
+        return web.json_response({"status": "ok", "model": self.model_name})
+
+    async def _state(self, _request: web.Request) -> web.Response:
+        """Endpoint-picker telemetry (KV occupancy + queue depth)."""
+        s = self.engine.stats
+        return web.json_response(
+            {
+                "model": self.model_name,
+                "active_slots": s.active_slots,
+                "max_slots": self.engine.cfg.max_batch_size,
+                "queued": s.queued,
+                "kv_pages_free": s.kv_pages_free,
+                "kv_occupancy": s.kv_occupancy,
+                "tokens_generated": s.tokens_generated,
+                "decode_steps": s.decode_steps,
+            }
+        )
+
+    async def _metrics(self, _request: web.Request) -> web.Response:
+        body = self.metrics.export() + self._engine_gauges()
+        return web.Response(body=body, content_type="text/plain")
+
+    def _engine_gauges(self) -> bytes:
+        """EngineStats as Prometheus gauges (the /state telemetry, in
+        scrapeable form)."""
+        s = self.engine.stats
+        lines = []
+        for name, value in (
+            ("tpuserve_active_slots", s.active_slots),
+            ("tpuserve_queued_requests", s.queued),
+            ("tpuserve_kv_pages_free", s.kv_pages_free),
+            ("tpuserve_kv_occupancy", s.kv_occupancy),
+            ("tpuserve_tokens_generated_total", s.tokens_generated),
+            ("tpuserve_prefills_total", s.prefills),
+            ("tpuserve_sp_prefills_total", s.sp_prefills),
+            ("tpuserve_chunked_prefill_steps_total",
+             s.chunked_prefill_steps),
+            ("tpuserve_decode_steps_total", s.decode_steps),
+            ("tpuserve_spec_accepted_total", s.spec_accepted),
+            ("tpuserve_prefix_cache_hits_total", s.prefix_cache_hits),
+            ("tpuserve_prefix_tokens_reused_total", s.prefix_tokens_reused),
+        ):
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {value}")
+        return ("\n".join(lines) + "\n").encode()
+
+
+async def run_tpuserve(
+    model: str,
+    host: str = "127.0.0.1",
+    port: int = 8011,
+    max_batch_size: int = 8,
+    max_seq_len: int = 2048,
+    page_size: int = 128,
+    hbm_pages: int = 0,
+    tp: int = 1,
+    ep: int = 1,
+    sp: int = 1,
+    quantize: str = "",
+    lora_adapters: dict | None = None,
+    decode_steps_per_tick: int = 8,
+    enable_prefix_cache: bool = True,
+    sp_prefill_min_tokens: int = 1024,
+    prefill_chunk_tokens: int = 0,
+    spec_tokens: int = 0,
+    pallas_attn: bool = False,
+) -> web.AppRunner:
+    server = TPUServeServer(
+        model,
+        EngineConfig(
+            max_batch_size=max_batch_size,
+            max_seq_len=max_seq_len,
+            page_size=page_size,
+            num_pages=hbm_pages,
+            decode_steps_per_tick=decode_steps_per_tick,
+            enable_prefix_cache=enable_prefix_cache,
+            sp_prefill_min_tokens=sp_prefill_min_tokens,
+            prefill_chunk_tokens=prefill_chunk_tokens,
+            spec_tokens=spec_tokens,
+            pallas_attn=pallas_attn,
+        ),
+        tp=tp,
+        ep=ep,
+        sp=sp,
+        quantize=quantize,
+        lora_adapters=lora_adapters,
+    )
+    runner = web.AppRunner(server.app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    logger.info("tpuserve listening on %s:%d (model=%s)", host, port, model)
+    return runner

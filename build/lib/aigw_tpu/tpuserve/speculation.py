@@ -1,0 +1,77 @@
+"""Prompt-lookup speculative decoding (n-gram self-speculation).
+
+TPU-native speculation without a draft model: guess the next D tokens by
+finding the most recent earlier occurrence of the current 2-gram in the
+sequence's own token history (prompt + generated) and proposing its
+continuation — then verify all D+1 positions in ONE model step
+(models/llama.py ``verify_step``) and accept the longest draft prefix
+that matches the model's own per-position samples.
+
+Why this fits the engine's fixed-geometry contract (tpuserve/engine.py):
+
+- the verify step has a STATIC shape [B, D+1] — one compiled program,
+  like the [B, 1] decode step it replaces;
+- the draft lookup is a vectorized compare over the on-device history
+  buffer [B, S] — no host round-trip inside the K-step window;
+- per-position PRNG keys are derived from the absolute position, so
+  accepted tokens are sampled from *exactly* the distribution the
+  non-speculative path would have used: speculation on/off produces
+  bit-identical streams for the same seed (asserted in
+  tests/test_spec_decode.py);
+- rejected drafts cost nothing to undo: their stale K/V writes sit at
+  positions the causal gather mask (``t <= pos``) can only reach after
+  a later step has re-scattered them (see ``verify_step`` docstring).
+
+Slots with frequency/presence penalties get poisoned drafts (-1, which
+never equals a sampled id), so they advance one exact token per step —
+penalty counts evolve per accepted token, and within-window count
+updates for multi-token acceptance would be approximate otherwise.
+
+The reference has no serving engine (it routes to upstream providers);
+this subsystem exists because the TPU framework ships its own model
+server (SURVEY.md §2.9). The technique is prompt-lookup decoding
+(PAPERS.md; vLLM's ngram speculator is the public precedent).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def ngram_drafts(
+    history: jax.Array,  # [B, H] int32 token history (prompt + generated)
+    positions: jax.Array,  # [B] int32 — history is valid through `positions`
+    n_draft: int,
+) -> jax.Array:
+    """Propose ``n_draft`` tokens per slot from the last 2-gram's most
+    recent earlier occurrence. Returns [B, n_draft] int32; -1 marks "no
+    proposal" at that offset (never matches a sampled token id).
+    """
+    B, H = history.shape
+    pos = positions[:, None]  # [B, 1]
+    last1 = jnp.take_along_axis(history, jnp.clip(pos, 0, H - 1), 1)
+    last0 = jnp.take_along_axis(history, jnp.clip(pos - 1, 0, H - 1), 1)
+
+    t = jnp.arange(H - 1, dtype=jnp.int32)[None, :]  # match start index
+    m = (history[:, :-1] == last0) & (history[:, 1:] == last1)
+    # the match must end strictly before the current 2-gram starts
+    # (equivalently: its continuation t+2 already exists in history)
+    m = m & (t < pos - 1)
+    found = m.any(axis=1)
+    j = jnp.argmax(jnp.where(m, t, -1), axis=1)  # most recent match start
+
+    d = jnp.arange(n_draft, dtype=jnp.int32)[None, :]
+    src = j[:, None] + 2 + d  # [B, n_draft]
+    valid = found[:, None] & (src <= pos)
+    drafts = jnp.take_along_axis(history, jnp.clip(src, 0, H - 1), 1)
+    return jnp.where(valid, drafts, -1)
+
+
+def accept_counts(drafts: jax.Array, sampled: jax.Array) -> jax.Array:
+    """Longest-matching-prefix acceptance: drafts [B, D] vs the model's
+    own samples at those positions (sampled [B, D+1], where sampled[:, d]
+    is the model's token for the position *after* draft d-1). Returns the
+    number of accepted drafts [B] in [0, D]."""
+    match = (drafts == sampled[:, : drafts.shape[1]]).astype(jnp.int32)
+    return jnp.sum(jnp.cumprod(match, axis=1), axis=1)

@@ -1,0 +1,156 @@
+"""Tokenizers + chat templating for tpuserve.
+
+Two implementations behind one protocol:
+- ``HFTokenizer`` wraps a local ``tokenizer.json`` (tokenizers library; no
+  network) for real checkpoints.
+- ``ByteTokenizer`` is the dependency-free fallback used by tiny-random
+  models and tests (byte-level, vocab 256 + specials) — the fake-chip mode
+  that replaces the reference's testupstream in our test pyramid
+  (SURVEY.md §4 implication (b)).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Protocol
+
+
+class Tokenizer(Protocol):
+    bos_id: int
+    eos_id: int
+
+    def encode(self, text: str) -> list[int]: ...
+    def decode(self, ids: list[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 bytes as tokens 0..255; BOS=256, EOS=257."""
+
+    bos_id = 256
+    eos_id = 257
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: list[int]) -> str:
+        return bytes(i for i in ids if 0 <= i < 256).decode(
+            "utf-8", errors="replace"
+        )
+
+
+class HFTokenizer:
+    def __init__(self, path: str):
+        from tokenizers import Tokenizer as _T
+
+        self._t = _T.from_file(path)
+        vocab = self._t.get_vocab()
+        self.bos_id = vocab.get("<|begin_of_text|>", vocab.get("<s>", 0))
+        # end-of-turn token by family: Llama-3 <|eot_id|>, ChatML (Qwen)
+        # <|im_end|>, GPT-style <|endoftext|>, sentencepiece </s>
+        for tok in ("<|eot_id|>", "<|im_end|>", "<|end_of_text|>",
+                    "<|endoftext|>", "</s>"):
+            if tok in vocab:
+                self.eos_id = vocab[tok]
+                break
+        else:
+            self.eos_id = 0
+
+    def encode(self, text: str) -> list[int]:
+        return self._t.encode(text, add_special_tokens=False).ids
+
+    def decode(self, ids: list[int]) -> str:
+        return self._t.decode(ids, skip_special_tokens=True)
+
+
+def load_tokenizer(source: str) -> Tokenizer:
+    if source == "byte":
+        return ByteTokenizer()
+    return HFTokenizer(source)
+
+
+def apply_chat_template(
+    messages: list[dict[str, Any]], tokenizer: Tokenizer,
+    template: str = "llama3",
+) -> list[int]:
+    """Render an OpenAI-style message list to prompt tokens.
+
+    ``template``: "llama3" (header-id layout), "chatml" (Qwen families),
+    or the plain textual layout for the byte tokenizer. (Template strings
+    are the public prompt formats of the respective model cards.)
+    """
+    from aigw_tpu.schemas.openai import message_content_text
+
+    if isinstance(tokenizer, ByteTokenizer):
+        parts = []
+        for m in messages:
+            parts.append(f"<{m.get('role', 'user')}>: "
+                         f"{message_content_text(m.get('content'))}\n")
+        parts.append("<assistant>: ")
+        return tokenizer.encode("".join(parts))
+
+    if template == "chatml":
+        text = ""
+        for m in messages:
+            role = m.get("role", "user")
+            content = message_content_text(m.get("content"))
+            text += f"<|im_start|>{role}\n{content}<|im_end|>\n"
+        text += "<|im_start|>assistant\n"
+        return tokenizer.encode(text)
+
+    text = "<|begin_of_text|>"
+    for m in messages:
+        role = m.get("role", "user")
+        content = message_content_text(m.get("content"))
+        text += (
+            f"<|start_header_id|>{role}<|end_header_id|>\n\n{content}<|eot_id|>"
+        )
+    text += "<|start_header_id|>assistant<|end_header_id|>\n\n"
+    return tokenizer.encode(text)
+
+
+class StreamingDecoder:
+    """Incremental detokenizer: emits only text that can no longer change.
+
+    Token-by-token ``decode([tok])`` corrupts multi-byte UTF-8 characters
+    and multi-token graphemes; re-decoding the FULL id list per token is
+    O(n\u00b2) per stream and runs on the server's event loop. Instead only a
+    sliding window is re-decoded (the ids since the last committed
+    boundary): the emitted delta is ``decode(window + [tok])`` minus
+    ``decode(window)``, and the window resets whenever its text is stable
+    \u2014 so per-token cost is O(window), independent of generation length.
+    Text ending in U+FFFD (a partial UTF-8 character or an un-mergeable
+    token boundary) is held back until the continuation arrives.
+    """
+
+    def __init__(self, tokenizer: Tokenizer):
+        self._t = tokenizer
+        self._ids: list[int] = []
+        # two lagging pointers: ids[:prefix] are fully emitted;
+        # ids[prefix:read] is the context overlap whose text is
+        # subtracted from each new decode so tokenizer boundary
+        # artifacts (BPE merges, leading-space handling) cancel out
+        self._prefix = 0
+        self._read = 0
+
+    def push(self, token_id: int) -> str:
+        self._ids.append(token_id)
+        new_text = self._t.decode(self._ids[self._prefix:])
+        # A trailing U+FFFD is *probably* a partial UTF-8 char or an
+        # unfinished merge \u2014 hold it back. But only for a bounded number
+        # of tokens: a model legitimately emitting replacement chars (or
+        # a stream of invalid bytes) must neither stall the client nor
+        # regrow the decode window; real partial characters complete
+        # within a few tokens.
+        if new_text.endswith("\ufffd") and len(self._ids) - self._read < 8:
+            return ""
+        prefix_text = self._t.decode(self._ids[self._prefix: self._read])
+        if len(new_text) <= len(prefix_text):
+            return ""
+        self._prefix = self._read
+        self._read = len(self._ids)
+        return new_text[len(prefix_text):]
+
+    def flush(self) -> str:
+        new_text = self._t.decode(self._ids[self._prefix:])
+        prefix_text = self._t.decode(self._ids[self._prefix: self._read])
+        self._prefix = self._read = len(self._ids)
+        return new_text[len(prefix_text):]

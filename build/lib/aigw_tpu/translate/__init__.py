@@ -1,0 +1,28 @@
+"""Schema translation matrix (reference internal/translator/translator.go:42-77).
+
+``get_translator(endpoint, in_schema, out_schema)`` returns a fresh stateful
+translator per request. Streaming translators carry SSE re-encode state and
+emit token-usage deltas per chunk, merged with override semantics.
+"""
+
+from aigw_tpu.translate.base import (
+    Endpoint,
+    RequestTx,
+    ResponseTx,
+    TranslationError,
+    Translator,
+    get_translator,
+    register_translator,
+    supported_pairs,
+)
+
+__all__ = [
+    "Endpoint",
+    "RequestTx",
+    "ResponseTx",
+    "TranslationError",
+    "Translator",
+    "get_translator",
+    "register_translator",
+    "supported_pairs",
+]

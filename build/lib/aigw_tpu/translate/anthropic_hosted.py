@@ -1,0 +1,151 @@
+"""Cloud-hosted Anthropic backends: GCP Vertex and AWS Bedrock.
+
+Both speak the Anthropic messages *body* schema with provider-specific
+envelopes (reference pairs openai→gcpanthropic / openai→awsanthropic and
+anthropic→{gcpanthropic,awsanthropic}, anthropic_helper.go):
+
+- **Vertex**: POST ``…/publishers/anthropic/models/{model}:rawPredict``
+  (``:streamRawPredict?alt=sse`` when streaming); body drops ``model`` and
+  gains ``anthropic_version: vertex-2023-10-16``. Responses are plain
+  Anthropic JSON / SSE.
+- **Bedrock**: POST ``/model/{id}/invoke`` (``invoke-with-response-stream``
+  when streaming); body drops ``model``/``stream`` and gains
+  ``anthropic_version: bedrock-2023-05-31``. Streaming responses are AWS
+  event-stream frames whose payloads are ``{"bytes": base64(anthropic
+  event JSON)}`` — decoded here and re-encoded as Anthropic SSE so the
+  existing state machines (OpenAI-front converter or Anthropic-front
+  passthrough) consume them unchanged.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import urllib.parse
+from typing import Any
+
+from aigw_tpu.config.model import APISchemaName
+from aigw_tpu.translate.base import (
+    Endpoint,
+    RequestTx,
+    ResponseTx,
+    register_translator,
+)
+from aigw_tpu.translate.eventstream import EventStreamParser
+from aigw_tpu.translate.openai_anthropic import OpenAIToAnthropicChat
+from aigw_tpu.translate.passthrough import AnthropicPassthrough
+from aigw_tpu.translate.sse import SSEEvent
+
+VERTEX_ANTHROPIC_VERSION = "vertex-2023-10-16"
+BEDROCK_ANTHROPIC_VERSION = "bedrock-2023-05-31"
+
+
+def _vertexify(tx: RequestTx) -> RequestTx:
+    body = json.loads(tx.body)
+    model = body.pop("model", "")
+    stream = bool(body.pop("stream", False))
+    body["anthropic_version"] = VERTEX_ANTHROPIC_VERSION
+    verb = "streamRawPredict?alt=sse" if stream else "rawPredict"
+    tx.body = json.dumps(body).encode()
+    tx.path = (
+        "/v1/projects/{GCP_PROJECT}/locations/{GCP_REGION}"
+        f"/publishers/anthropic/models/{model}:{verb}"
+    )
+    return tx
+
+
+def _bedrockify(tx: RequestTx) -> RequestTx:
+    body = json.loads(tx.body)
+    model = body.pop("model", "")
+    stream = bool(body.pop("stream", False))
+    body["anthropic_version"] = BEDROCK_ANTHROPIC_VERSION
+    verb = "invoke-with-response-stream" if stream else "invoke"
+    tx.body = json.dumps(body).encode()
+    tx.path = f"/model/{urllib.parse.quote(model, safe='')}/{verb}"
+    return tx
+
+
+class _BedrockAnthropicStream:
+    """Event-stream frames → Anthropic SSE bytes."""
+
+    def __init__(self) -> None:
+        self._es = EventStreamParser()
+
+    def feed(self, chunk: bytes) -> bytes:
+        out = bytearray()
+        for msg in self._es.feed(chunk):
+            if not msg.payload:
+                continue
+            try:
+                wrapper = json.loads(msg.payload)
+                inner = base64.b64decode(wrapper.get("bytes", ""))
+                data = json.loads(inner)
+            except (json.JSONDecodeError, ValueError):
+                continue
+            out += SSEEvent(event=data.get("type", ""),
+                            data=json.dumps(data)).encode()
+        return bytes(out)
+
+
+class OpenAIToVertexAnthropic(OpenAIToAnthropicChat):
+    def __init__(self, **kw: Any):
+        # GCP-hosted Anthropic lacks structured-output support (reference
+        # anthropic_helper.go isGCPBackend check): skip output_config.
+        kw.setdefault("gcp_backend", True)
+        super().__init__(**kw)
+
+    def request(self, body: dict[str, Any]) -> RequestTx:
+        return _vertexify(super().request(body))
+
+
+class OpenAIToBedrockAnthropic(OpenAIToAnthropicChat):
+    def __init__(self, **kw: Any):
+        super().__init__(**kw)
+        self._es_decode = _BedrockAnthropicStream()
+
+    def request(self, body: dict[str, Any]) -> RequestTx:
+        return _bedrockify(super().request(body))
+
+    def response_body(self, chunk: bytes, end_of_stream: bool) -> ResponseTx:
+        if self._stream:
+            chunk = self._es_decode.feed(chunk)
+        return super().response_body(chunk, end_of_stream)
+
+
+class AnthropicToVertexAnthropic(AnthropicPassthrough):
+    def request(self, body: dict[str, Any]) -> RequestTx:
+        return _vertexify(super().request(body))
+
+
+class AnthropicToBedrockAnthropic(AnthropicPassthrough):
+    def __init__(self, **kw: Any):
+        super().__init__(**kw)
+        self._es_decode = _BedrockAnthropicStream()
+
+    def request(self, body: dict[str, Any]) -> RequestTx:
+        return _bedrockify(super().request(body))
+
+    def response_body(self, chunk: bytes, end_of_stream: bool) -> ResponseTx:
+        if self._stream:
+            chunk = self._es_decode.feed(chunk)
+        return super().response_body(chunk, end_of_stream)
+
+
+def _f(cls):
+    def make(*, model_name_override: str = "", stream: bool = False,
+             **_: object):
+        return cls(model_name_override=model_name_override, stream=stream)
+
+    return make
+
+
+# These override the plain-Anthropic registrations from openai_anthropic.py
+# (correct path/envelope for the hosted variants).
+register_translator(Endpoint.CHAT_COMPLETIONS, APISchemaName.OPENAI,
+                    APISchemaName.GCP_ANTHROPIC, _f(OpenAIToVertexAnthropic))
+register_translator(Endpoint.CHAT_COMPLETIONS, APISchemaName.OPENAI,
+                    APISchemaName.AWS_ANTHROPIC, _f(OpenAIToBedrockAnthropic))
+register_translator(Endpoint.MESSAGES, APISchemaName.ANTHROPIC,
+                    APISchemaName.GCP_ANTHROPIC, _f(AnthropicToVertexAnthropic))
+register_translator(Endpoint.MESSAGES, APISchemaName.ANTHROPIC,
+                    APISchemaName.AWS_ANTHROPIC, _f(AnthropicToBedrockAnthropic))

@@ -1,0 +1,460 @@
+"""OpenAI chat/completions front → GCP Vertex AI Gemini backend.
+
+Reference pair: internal/translator openai→gcpvertexai (gemini_helper.go,
+1042 LoC). Uses ``generateContent`` / ``streamGenerateContent?alt=sse``
+under the project/location path; ``{GCP_PROJECT}``/``{GCP_REGION}``
+placeholders are substituted by the GCP auth handler.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Any
+
+from aigw_tpu.config.model import APISchemaName
+from aigw_tpu.gateway.costs import TokenUsage
+from aigw_tpu.schemas import openai as oai
+from aigw_tpu.translate.base import (
+    Endpoint,
+    RequestTx,
+    ResponseTx,
+    TranslationError,
+    Translator,
+    register_translator,
+)
+from aigw_tpu.translate.sse import SSEEvent, SSEParser
+from aigw_tpu.translate.structured import (
+    JSONSchemaError,
+    parse_response_format,
+    to_gemini_schema,
+)
+
+_FINISH_TO_OPENAI = {
+    "STOP": "stop",
+    "MAX_TOKENS": "length",
+    "SAFETY": "content_filter",
+    "RECITATION": "content_filter",
+    "PROHIBITED_CONTENT": "content_filter",
+    "BLOCKLIST": "content_filter",
+    "MALFORMED_FUNCTION_CALL": "tool_calls",
+}
+
+
+def gemini_logprobs_to_openai(result: dict[str, Any]) -> dict[str, Any] | None:
+    """Gemini logprobsResult → OpenAI choice.logprobs
+    (gemini_helper.go geminiLogprobsToOpenAILogprobs:991-1031)."""
+    chosen = result.get("chosenCandidates") or []
+    if not chosen:
+        return None
+    top = result.get("topCandidates") or []
+    content = []
+    for i, c in enumerate(chosen):
+        top_lps = []
+        if i < len(top) and isinstance(top[i], dict):
+            for tc in top[i].get("candidates") or []:
+                top_lps.append({
+                    "token": tc.get("token", ""),
+                    "logprob": float(tc.get("logProbability", 0.0) or 0.0),
+                })
+        content.append({
+            "token": c.get("token", ""),
+            "logprob": float(c.get("logProbability", 0.0) or 0.0),
+            "top_logprobs": top_lps,
+        })
+    return {"content": content}
+
+
+def gemini_usage(data: dict[str, Any]) -> TokenUsage:
+    u = data.get("usageMetadata") or {}
+    inp = int(u.get("promptTokenCount", 0) or 0)
+    out = int(u.get("candidatesTokenCount", 0) or 0)
+    return TokenUsage(
+        input_tokens=inp,
+        output_tokens=out,
+        total_tokens=int(u.get("totalTokenCount", 0) or 0) or inp + out,
+        cached_input_tokens=int(u.get("cachedContentTokenCount", 0) or 0),
+        reasoning_tokens=int(u.get("thoughtsTokenCount", 0) or 0),
+    )
+
+
+def _user_parts(content: Any) -> list[dict[str, Any]]:
+    """User content union → Gemini parts (text + inline/file images)."""
+    if content is None:
+        return []
+    if isinstance(content, str):
+        return [{"text": content}] if content else []
+    parts: list[dict[str, Any]] = []
+    for part in content:
+        ptype = part.get("type")
+        if ptype == "text":
+            if part.get("text"):
+                parts.append({"text": part["text"]})
+        elif ptype == "image_url":
+            url = (part.get("image_url") or {}).get("url", "")
+            if url.startswith("data:"):
+                media, _, b64 = url[len("data:") :].partition(";base64,")
+                parts.append(
+                    {"inlineData": {"mimeType": media or "image/png",
+                                    "data": b64}}
+                )
+            else:
+                parts.append(
+                    {"fileData": {"mimeType": "image/png", "fileUri": url}}
+                )
+        else:
+            raise TranslationError(f"unsupported content part {ptype!r}")
+    return parts
+
+
+def openai_messages_to_gemini(
+    messages: list[dict[str, Any]],
+) -> tuple[dict[str, Any] | None, list[dict[str, Any]]]:
+    system_parts: list[dict[str, Any]] = []
+    contents: list[dict[str, Any]] = []
+
+    def push(role: str, parts: list[dict[str, Any]]) -> None:
+        if not parts:
+            return
+        if contents and contents[-1]["role"] == role:
+            contents[-1]["parts"].extend(parts)
+        else:
+            contents.append({"role": role, "parts": list(parts)})
+
+    for m in messages:
+        role = m.get("role")
+        if role in ("system", "developer"):
+            text = oai.message_content_text(m.get("content"))
+            if text:
+                system_parts.append({"text": text})
+        elif role == "user":
+            push("user", _user_parts(m.get("content")))
+        elif role == "assistant":
+            parts: list[dict[str, Any]] = []
+            text = oai.message_content_text(m.get("content"))
+            if text:
+                parts.append({"text": text})
+            for tc in m.get("tool_calls") or ():
+                fn = tc.get("function") or {}
+                try:
+                    args = json.loads(fn.get("arguments") or "{}")
+                except json.JSONDecodeError:
+                    args = {}
+                parts.append(
+                    {"functionCall": {"name": fn.get("name", ""), "args": args}}
+                )
+            push("model", parts)
+        elif role == "tool":
+            content = oai.message_content_text(m.get("content"))
+            try:
+                response: Any = json.loads(content)
+            except json.JSONDecodeError:
+                response = {"result": content}
+            if not isinstance(response, dict):
+                response = {"result": response}
+            push(
+                "user",
+                [
+                    {
+                        "functionResponse": {
+                            "name": m.get("name", "") or m.get("tool_call_id", ""),
+                            "response": response,
+                        }
+                    }
+                ],
+            )
+        else:
+            raise TranslationError(f"unsupported message role {role!r}")
+    system = {"parts": system_parts} if system_parts else None
+    return system, contents
+
+
+class OpenAIToGeminiChat(Translator):
+    def __init__(self, *, model_name_override: str = "", stream: bool = False,
+                 **_: object):
+        self._override = model_name_override
+        self._stream = stream
+        self._include_usage = False
+        self._parser = SSEParser()
+        self._id = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+        self._created = int(time.time())
+        self._model = ""
+        self._usage = TokenUsage()
+        self._tool_idx = -1
+        self._finish: str | None = None
+        self._sent_role = False
+        self._sent_done = False
+        self._want_logprobs = False
+
+    def request(self, body: dict[str, Any]) -> RequestTx:
+        oai.validate_chat_request(body)
+        self._stream = bool(body.get("stream", False))
+        self._include_usage = oai.include_stream_usage(body)
+        self._model = self._override or body["model"]
+        system, contents = openai_messages_to_gemini(body["messages"])
+        out: dict[str, Any] = {"contents": contents}
+        if system:
+            out["systemInstruction"] = system
+        gen: dict[str, Any] = {}
+        max_tokens = body.get("max_completion_tokens") or body.get("max_tokens")
+        if max_tokens:
+            gen["maxOutputTokens"] = int(max_tokens)
+        if body.get("temperature") is not None:
+            gen["temperature"] = float(body["temperature"])
+        if body.get("top_p") is not None:
+            gen["topP"] = float(body["top_p"])
+        stop = body.get("stop")
+        if stop:
+            gen["stopSequences"] = [stop] if isinstance(stop, str) else list(stop)
+        n = int(body.get("n") or 1)
+        if n > 1:
+            if self._stream:
+                raise TranslationError(
+                    "n>1 is not supported for streaming Gemini requests"
+                )
+            gen["candidateCount"] = n
+        if body.get("seed") is not None:
+            gen["seed"] = int(body["seed"])
+        if body.get("presence_penalty") is not None:
+            gen["presencePenalty"] = float(body["presence_penalty"])
+        if body.get("frequency_penalty") is not None:
+            gen["frequencyPenalty"] = float(body["frequency_penalty"])
+        # logprobs (gemini_helper.go:657-665): top_logprobs → logprobs
+        # count, logprobs flag → responseLogprobs
+        if body.get("top_logprobs") is not None:
+            gen["logprobs"] = int(body["top_logprobs"])
+        if body.get("logprobs") is not None:
+            gen["responseLogprobs"] = bool(body["logprobs"])
+        self._want_logprobs = bool(body.get("logprobs"))
+        self._apply_output_format(body, gen)
+        if gen:
+            out["generationConfig"] = gen
+        tools = body.get("tools")
+        if tools:
+            out["tools"] = [
+                {
+                    "functionDeclarations": [
+                        {
+                            "name": (t.get("function") or {}).get("name", ""),
+                            "description": (t.get("function") or {}).get(
+                                "description", ""
+                            ),
+                            "parameters": (t.get("function") or {}).get(
+                                "parameters", {"type": "object"}
+                            ),
+                        }
+                        for t in tools
+                        if t.get("type") == "function"
+                    ]
+                }
+            ]
+        choice = body.get("tool_choice")
+        if choice == "none":
+            out["toolConfig"] = {"functionCallingConfig": {"mode": "NONE"}}
+        elif choice == "required":
+            out["toolConfig"] = {"functionCallingConfig": {"mode": "ANY"}}
+        elif isinstance(choice, dict) and choice.get("type") == "function":
+            out["toolConfig"] = {
+                "functionCallingConfig": {
+                    "mode": "ANY",
+                    "allowedFunctionNames": [
+                        (choice.get("function") or {}).get("name", "")
+                    ],
+                }
+            }
+        verb = "streamGenerateContent?alt=sse" if self._stream else "generateContent"
+        path = (
+            "/v1/projects/{GCP_PROJECT}/locations/{GCP_REGION}"
+            f"/publishers/google/models/{self._model}:{verb}"
+        )
+        return RequestTx(
+            body=json.dumps(out).encode(), path=path, stream=self._stream
+        )
+
+    def _apply_output_format(self, body: dict[str, Any],
+                             gen: dict[str, Any]) -> None:
+        """response_format + guided_{choice,regex,json} → Gemini response
+        MIME type / schema (gemini_helper.go:667-744). The vLLM-style
+        guided_* vendor fields and response_format are mutually
+        exclusive."""
+        specified = 0
+        rf = parse_response_format(body)
+        if rf is not None:
+            specified += 1
+            if rf.kind == "text":
+                gen["responseMimeType"] = "text/plain"
+            elif rf.kind == "json_object":
+                gen["responseMimeType"] = "application/json"
+            elif rf.kind == "json_schema" and rf.schema is not None:
+                gen["responseMimeType"] = "application/json"
+                try:
+                    gen["responseSchema"] = to_gemini_schema(rf.schema)
+                except JSONSchemaError as e:
+                    raise TranslationError(
+                        f"invalid JSON schema: {e}") from None
+        if body.get("guided_choice") is not None:
+            specified += 1
+            gen["responseMimeType"] = "text/x.enum"
+            gen["responseSchema"] = {"type": "STRING",
+                                     "enum": list(body["guided_choice"])}
+        if body.get("guided_regex"):
+            specified += 1
+            gen["responseMimeType"] = "application/json"
+            gen["responseSchema"] = {"type": "STRING",
+                                     "pattern": str(body["guided_regex"])}
+        if body.get("guided_json") is not None:
+            specified += 1
+            gen["responseMimeType"] = "application/json"
+            gen["responseJsonSchema"] = body["guided_json"]
+        if specified > 1:
+            raise TranslationError(
+                "only one of response_format, guided_choice, guided_regex, "
+                "guided_json can be specified")
+
+    def response_body(self, chunk: bytes, end_of_stream: bool) -> ResponseTx:
+        if self._stream:
+            return self._stream_chunk(chunk, end_of_stream)
+        if not end_of_stream:
+            return ResponseTx()
+        try:
+            data = json.loads(chunk)
+        except json.JSONDecodeError as e:
+            raise TranslationError(f"invalid upstream JSON: {e}") from None
+        usage = gemini_usage(data)
+        model = str(data.get("modelVersion", "") or self._model)
+        choices = []
+        for i, cand in enumerate(data.get("candidates") or [{}]):
+            parts = (cand.get("content") or {}).get("parts") or []
+            text = "".join(p.get("text", "") for p in parts if "text" in p)
+            tool_calls = [
+                {
+                    "id": f"call_{uuid.uuid4().hex[:16]}",
+                    "type": "function",
+                    "function": {
+                        "name": p["functionCall"].get("name", ""),
+                        "arguments": json.dumps(p["functionCall"].get("args", {})),
+                    },
+                }
+                for p in parts
+                if "functionCall" in p
+            ]
+            finish = _FINISH_TO_OPENAI.get(
+                cand.get("finishReason") or "STOP", "stop"
+            )
+            if tool_calls:
+                finish = "tool_calls"
+            message: dict[str, Any] = {"role": "assistant", "content": text}
+            if tool_calls:
+                message["tool_calls"] = tool_calls
+                if not text:
+                    message["content"] = None
+            choice: dict[str, Any] = {
+                "index": i, "message": message, "finish_reason": finish
+            }
+            if self._want_logprobs:
+                lp = gemini_logprobs_to_openai(
+                    cand.get("logprobsResult") or {})
+                if lp is not None:
+                    choice["logprobs"] = lp
+            choices.append(choice)
+        out = {
+            "id": self._id,
+            "object": "chat.completion",
+            "created": self._created,
+            "model": model,
+            "choices": choices,
+            "usage": oai.usage_dict(usage),
+        }
+        return ResponseTx(
+            body=json.dumps(out).encode(), usage=usage, model=model
+        )
+
+    def _stream_chunk(self, chunk: bytes, end_of_stream: bool) -> ResponseTx:
+        events = self._parser.feed(chunk)
+        if end_of_stream:
+            events += self._parser.flush()
+        out = bytearray()
+        usage = TokenUsage()
+        tokens = 0
+        for ev in events:
+            if not ev.data:
+                continue
+            try:
+                data = json.loads(ev.data)
+            except json.JSONDecodeError:
+                continue
+            self._usage = self._usage.merge_override(gemini_usage(data))
+            if not self._sent_role:
+                self._sent_role = True
+                out += self._emit({"role": "assistant", "content": ""})
+            for cand in data.get("candidates") or ():
+                chunk_lp = None
+                if self._want_logprobs:
+                    chunk_lp = gemini_logprobs_to_openai(
+                        cand.get("logprobsResult") or {})
+                for p in (cand.get("content") or {}).get("parts") or ():
+                    if p.get("text"):
+                        tokens += 1
+                        out += self._emit({"content": p["text"]},
+                                          logprobs=chunk_lp)
+                        chunk_lp = None  # attach once per upstream chunk
+                    elif "functionCall" in p:
+                        self._tool_idx += 1
+                        fc = p["functionCall"]
+                        out += self._emit(
+                            {
+                                "tool_calls": [
+                                    {
+                                        "index": self._tool_idx,
+                                        "id": f"call_{uuid.uuid4().hex[:16]}",
+                                        "type": "function",
+                                        "function": {
+                                            "name": fc.get("name", ""),
+                                            "arguments": json.dumps(
+                                                fc.get("args", {})
+                                            ),
+                                        },
+                                    }
+                                ]
+                            }
+                        )
+                        self._finish = "tool_calls"
+                if cand.get("finishReason"):
+                    self._finish = self._finish or _FINISH_TO_OPENAI.get(
+                        cand["finishReason"], "stop"
+                    )
+        if end_of_stream and not self._sent_done:
+            self._sent_done = True
+            usage = usage.merge_override(self._usage)
+            out += SSEEvent(
+                data=json.dumps(
+                    oai.chat_completion_chunk(
+                        response_id=self._id,
+                        model=self._model,
+                        delta={},
+                        finish_reason=self._finish or "stop",
+                        usage=self._usage if self._include_usage else None,
+                        created=self._created,
+                    )
+                )
+            ).encode()
+            out += SSEEvent(data="[DONE]").encode()
+        return ResponseTx(
+            body=bytes(out), usage=usage, model=self._model, tokens_emitted=tokens
+        )
+
+    def _emit(self, delta: dict[str, Any],
+              logprobs: dict[str, Any] | None = None) -> bytes:
+        return oai.stream_chunk_sse(
+            response_id=self._id, model=self._model, created=self._created,
+            delta=delta, logprobs=logprobs,
+        )
+
+
+register_translator(
+    Endpoint.CHAT_COMPLETIONS,
+    APISchemaName.OPENAI,
+    APISchemaName.GCP_VERTEX_AI,
+    OpenAIToGeminiChat,
+)
