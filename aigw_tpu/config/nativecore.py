@@ -114,6 +114,14 @@ def _backend_entry(b: Backend, weight: int, priority: int) -> dict[str, Any]:
         # would be silently dropped
         raise NotEligible(f"backend {b.name!r}: url path prefix "
                           f"{u.path!r}")
+    if u.query or u.fragment:
+        # same verbatim-path reason: ?api-version=... (Azure) would be
+        # silently dropped by the core
+        raise NotEligible(f"backend {b.name!r}: url carries query/fragment")
+    if u.username or u.password:
+        # the core dials hostname:port only; inline credentials would be
+        # silently discarded and requests would reach the upstream unsigned
+        raise NotEligible(f"backend {b.name!r}: url carries userinfo")
     entry: dict[str, Any] = {
         "name": b.name,
         "host": u.hostname,

@@ -145,8 +145,15 @@ class _FileBuffer:
             pos -= step
             f.seek(pos)
             chunk = f.read(step) + chunk
+            # a crash mid-write can leave a torn final line with no \n;
+            # it must not be trusted as the tail id (a truncated "123" read
+            # as "12" would hand out regressed/duplicate event ids)
+            if not chunk.endswith(b"\n"):
+                cut = chunk.rfind(b"\n")
+                if cut == -1:
+                    continue  # keep scanning back for a complete line
+                chunk = chunk[:cut + 1]
             # last complete line = text between the last two newlines
-            # (files always end with \n)
             idx = chunk.rstrip(b"\n").rfind(b"\n")
             if idx != -1 or pos == 0:
                 last = chunk.rstrip(b"\n")[idx + 1:]
@@ -183,6 +190,12 @@ class _FileBuffer:
                     f.write(b"%d %s\n" % (i, base64.b64encode(e)))
             else:
                 f.seek(0, os.SEEK_END)
+                # heal a torn tail (crash mid-write): appending straight
+                # after it would merge two lines and lose both events
+                if f.tell() > 0:
+                    f.seek(-1, os.SEEK_END)
+                    if f.read(1) != b"\n":
+                        f.write(b"\n")
                 f.write(b"%d %s\n" % (event_id, base64.b64encode(encoded)))
             f.flush()
         return encoded

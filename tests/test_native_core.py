@@ -269,6 +269,41 @@ class TestNativeCore:
             proc.kill()
             await fb.cleanup()
 
+    def test_deeply_nested_body_survives(self, ports, core_cfg, tmp_path):
+        run(self._test_deeply_nested_body_survives(ports, core_cfg, tmp_path))
+
+    async def _test_deeply_nested_body_survives(self, ports, core_cfg,
+                                                tmp_path):
+        import aiohttp
+
+        up_a = await start_upstream("A", ports["up_a"])
+        fb = await start_upstream("PY", ports["fallback"])
+        proc = start_core(core_cfg, tmp_path)
+        try:
+            async with aiohttp.ClientSession() as s:
+                # a ~100KB depth bomb must not overflow the parse stack and
+                # kill the listener; model extraction fails → falls back
+                bomb = ('{"a":' + "[" * 25000 + "]" * 25000 + "}").encode()
+                async with s.post(
+                    f"http://127.0.0.1:{ports['core']}/v1/chat/completions",
+                    data=bomb,
+                    headers={"content-type": "application/json"},
+                ) as r:
+                    # relayed to the PY fallback, which answers (the fake
+                    # upstream's own json parser 500s on the bomb — fine;
+                    # what matters is the core relayed instead of dying)
+                    assert r.headers.get("Server", "").startswith("Python")
+                    await r.read()
+                # the core is still alive and routing natively
+                status, body = await _post(
+                    s, ports["core"], "/v1/chat/completions", {"model": "m-a"})
+                assert status == 200
+                assert json.loads(body)["marker"] == "A"
+        finally:
+            proc.kill()
+            await up_a.cleanup()
+            await fb.cleanup()
+
     def test_sse_streaming_relay(self, ports, core_cfg, tmp_path):
         run(self._test_sse_streaming_relay(ports, core_cfg, tmp_path))
 
