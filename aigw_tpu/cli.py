@@ -277,10 +277,13 @@ def _run_gateway_workers(args: argparse.Namespace) -> int:
     horizontal-scaling answer to the reference's multi-threaded Envoy
     core (CPython's GIL caps one process at one core). Each worker runs
     the complete data plane, including its own config watcher, so hot
-    reloads converge within --watch-interval on every worker; state that
-    was already replica-safe across gateway pods (encrypted MCP
-    sessions, quota windows, circuit breakers) is equally worker-local
-    here."""
+    reloads converge within --watch-interval on every worker. Encrypted
+    MCP sessions are worker-agnostic by construction; token-quota
+    budgets and /v1/responses transcripts are shared through flock'd
+    files (AIGW_QUOTA_DIR / AIGW_RESPONSES_DIR, exported below) so a
+    configured budget stays ONE budget across workers and a
+    previous_response_id resolves on whichever worker the follow-up
+    lands on."""
     import multiprocessing
     import os
     import secrets
@@ -295,6 +298,22 @@ def _run_gateway_workers(args: argparse.Namespace) -> int:
     # process-group seed (inherited through the spawn env) keeps
     # sessions valid on every worker.
     os.environ.setdefault("AIGW_MCP_SESSION_SEED", secrets.token_hex(32))
+    # Cross-worker shared state (inherited through the spawn env): one
+    # token-quota budget enforced across all workers, and response
+    # transcripts reachable from whichever worker the follow-up
+    # previous_response_id request lands on.
+    if not (os.environ.get("AIGW_QUOTA_DIR")
+            and os.environ.get("AIGW_RESPONSES_DIR")):
+        import atexit
+        import shutil
+        import tempfile
+
+        shared = tempfile.mkdtemp(prefix=f"aigw-shared-{args.port}-")
+        atexit.register(shutil.rmtree, shared, ignore_errors=True)
+        os.environ.setdefault("AIGW_QUOTA_DIR",
+                              os.path.join(shared, "quota"))
+        os.environ.setdefault("AIGW_RESPONSES_DIR",
+                              os.path.join(shared, "responses"))
     ctx = multiprocessing.get_context("spawn")
     procs = [
         ctx.Process(target=_gateway_worker_main, args=(args,), daemon=True)

@@ -15,10 +15,18 @@ in-process engine, keeping the reference's semantics:
   key comes from a configurable request header.
 - **Fixed windows** aligned to the unit boundary, like the Envoy ratelimit
   service's per-unit counters.
+- **Shared enforcement** (the reference's dedicated ratelimit service fed
+  by xDS, internal/ratelimit/runner/runner.go:36-38): when AIGW_QUOTA_DIR
+  is set, counters live in flock'd files so one budget is enforced across
+  SO_REUSEPORT workers — and across replicas given a shared directory.
+  The multi-worker CLI sets this automatically.
 """
 
 from __future__ import annotations
 
+import fcntl
+import json
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any
@@ -66,13 +74,78 @@ class _Window:
     used: int
 
 
+class FileQuotaBackend:
+    """Shared quota counters: one flock'd JSON file per rule.
+
+    The reference routes token budgets through a *shared* ratelimit
+    service precisely so limits are global across Envoy replicas
+    (internal/ratelimit/runner/runner.go:36-38). Here the shared store
+    is the filesystem: SO_REUSEPORT workers on one host share it
+    automatically, and replicas share it when pointed at a common
+    directory (AIGW_QUOTA_DIR). Fixed windows are aligned to the unit
+    boundary, so every process computes the same window start and the
+    file needs only {start, used-per-client-key}.
+    """
+
+    def __init__(self, directory: str):
+        self._dir = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, rule_name: str) -> str:
+        safe = "".join(
+            c if c.isalnum() or c in "-_" else "_" for c in rule_name
+        )
+        return os.path.join(self._dir, f"quota_{safe}.json")
+
+    @staticmethod
+    def _load(f) -> dict:
+        f.seek(0)
+        raw = f.read()
+        if not raw:
+            return {"start": -1.0, "used": {}}
+        try:
+            return json.loads(raw)
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return {"start": -1.0, "used": {}}
+
+    def get(self, rule_name: str, client_key: str,
+            window_start: float) -> int:
+        try:
+            with open(self._path(rule_name), "r") as f:
+                fcntl.flock(f, fcntl.LOCK_SH)
+                state = self._load(f)
+        except FileNotFoundError:
+            return 0
+        if state.get("start") != window_start:
+            return 0
+        used = state.get("used", {}).get(client_key, 0)
+        return int(used) if isinstance(used, (int, float)) else 0
+
+    def add(self, rule_name: str, client_key: str, window_start: float,
+            amount: int) -> int:
+        with open(self._path(rule_name), "a+") as f:
+            fcntl.flock(f, fcntl.LOCK_EX)
+            state = self._load(f)
+            if state.get("start") != window_start:
+                state = {"start": window_start, "used": {}}
+            used = state["used"]
+            used[client_key] = int(used.get(client_key, 0)) + int(amount)
+            f.seek(0)
+            f.truncate()
+            json.dump(state, f)
+            f.flush()
+            return used[client_key]
+
+
 class RateLimiter:
     """In-process descriptor-keyed fixed-window limiter."""
 
     _SWEEP_EVERY = 1024  # bucket insertions between stale-window sweeps
 
-    def __init__(self, rules: list[QuotaRule]):
+    def __init__(self, rules: list[QuotaRule],
+                 backend: FileQuotaBackend | None = None):
         self.rules = rules
+        self.backend = backend  # shared store: workers/replicas see one budget
         self._windows: dict[tuple[str, str], _Window] = {}
         self._inserts = 0
         self._window_by_rule = {r.name: r.window_seconds for r in rules}
@@ -82,6 +155,10 @@ class RateLimiter:
         a reload never refills exhausted budgets (rules are matched by
         name+shape; changed rules start fresh)."""
         if previous is None:
+            return self
+        if self.backend is not None:
+            # shared counters live in the store, not this object; a hot
+            # reload keeps them by construction
             return self
         prev_rules = {r.name: r for r in previous.rules}
         keep = {
@@ -95,7 +172,11 @@ class RateLimiter:
     @staticmethod
     def from_config_value(value: Any) -> "RateLimiter":
         rules = [QuotaRule.parse(v) for v in (value or ())]
-        return RateLimiter(rules)
+        backend = None
+        quota_dir = os.environ.get("AIGW_QUOTA_DIR")
+        if rules and quota_dir:
+            backend = FileQuotaBackend(quota_dir)
+        return RateLimiter(rules, backend=backend)
 
     def _matching(self, model: str, backend: str) -> list[QuotaRule]:
         return [
@@ -142,8 +223,12 @@ class RateLimiter:
         for rule in self._matching(model, backend):
             client_key = headers.get(rule.client_key_header, "") \
                 if rule.client_key_header else ""
-            w = self._bucket(rule, client_key, now)
-            if w.used >= rule.limit:
+            if self.backend is not None:
+                start = now - (now % rule.window_seconds)
+                used = self.backend.get(rule.name, client_key, start)
+            else:
+                used = self._bucket(rule, client_key, now).used
+            if used >= rule.limit:
                 return False, rule
         return True, None
 
@@ -163,7 +248,11 @@ class RateLimiter:
                 continue
             client_key = headers.get(rule.client_key_header, "") \
                 if rule.client_key_header else ""
-            self._bucket(rule, client_key, now).used += cost
+            if self.backend is not None:
+                start = now - (now % rule.window_seconds)
+                self.backend.add(rule.name, client_key, start, cost)
+            else:
+                self._bucket(rule, client_key, now).used += cost
 
     def remaining(
         self, rule_name: str, client_key: str = "", now: float | None = None
@@ -171,6 +260,10 @@ class RateLimiter:
         for rule in self.rules:
             if rule.name == rule_name:
                 now = time.time() if now is None else now
-                w = self._bucket(rule, client_key, now)
-                return max(0, rule.limit - w.used)
+                if self.backend is not None:
+                    start = now - (now % rule.window_seconds)
+                    used = self.backend.get(rule.name, client_key, start)
+                else:
+                    used = self._bucket(rule, client_key, now).used
+                return max(0, rule.limit - used)
         return None

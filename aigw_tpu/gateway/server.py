@@ -686,8 +686,8 @@ class GatewayServer:
         span=None,
     ) -> web.StreamResponse:
         backend = rb.backend
-        if rc_limited := self._check_quota(client_headers, rb, req_metrics,
-                                           error_body):
+        if rc_limited := await self._check_quota(client_headers, rb,
+                                                 req_metrics, error_body):
             return rc_limited
         if isinstance(body, _RawBody):
             # multipart passthrough: no translation, original bytes forward
@@ -846,7 +846,7 @@ class GatewayServer:
                 self._openinference_response_attrs(
                     span, endpoint, rx.body or raw)
             req_metrics.finish(usage)
-            self._sink_costs(usage, req_metrics, route_name, client_headers)
+            await self._sink_costs(usage, req_metrics, route_name, client_headers)
             self.metrics.requests_total.labels(
                 route_name, backend.name, str(resp.status)
             ).inc()
@@ -942,22 +942,31 @@ class GatewayServer:
                 except Exception:  # noqa: BLE001
                     logger.debug("stream span attrs failed", exc_info=True)
         req_metrics.finish(usage)
-        self._sink_costs(usage, req_metrics, route_name, client_headers)
+        await self._sink_costs(usage, req_metrics, route_name, client_headers)
         self.metrics.requests_total.labels(route_name, rb.backend.name, "200").inc()
         await out.write_eof()
         return out
 
-    def _check_quota(self, client_headers, rb, req_metrics, error_body):
+    async def _check_quota(self, client_headers, rb, req_metrics,
+                           error_body):
         """Admission check against token quotas (reference: Envoy
         ratelimit filter with domain ai-gateway-quota,
         extensionserver/quota_ratelimit.go:59). Consumption happens at
-        end-of-stream in _sink_costs."""
+        end-of-stream in _sink_costs. A shared (flock'd-file) backend
+        can block on cross-worker lock contention, so it runs off the
+        event loop; the in-memory limiter is called inline."""
         limiter = self._runtime.rate_limiter
         if limiter is None or not limiter.rules:
             return None
-        ok, rule = limiter.check(
-            req_metrics.request_model, rb.backend.name, client_headers
-        )
+        if limiter.backend is not None:
+            ok, rule = await asyncio.to_thread(
+                limiter.check,
+                req_metrics.request_model, rb.backend.name, client_headers,
+            )
+        else:
+            ok, rule = limiter.check(
+                req_metrics.request_model, rb.backend.name, client_headers
+            )
         if ok:
             return None
         client_err = error_body(
@@ -980,7 +989,7 @@ class GatewayServer:
             content_type="application/json",
         )
 
-    def _sink_costs(
+    async def _sink_costs(
         self,
         usage: TokenUsage,
         req_metrics: RequestMetrics,
@@ -1008,7 +1017,12 @@ class GatewayServer:
             return
         req_metrics.costs = dict(costs)
         if has_quota:
-            limiter.consume(costs, model, backend, client_headers)
+            if limiter.backend is not None:
+                # flock'd shared store: contention must not stall the loop
+                await asyncio.to_thread(
+                    limiter.consume, costs, model, backend, client_headers)
+            else:
+                limiter.consume(costs, model, backend, client_headers)
         if self._cost_sink is not None:
             self._cost_sink(
                 costs,

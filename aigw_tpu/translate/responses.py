@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import collections
 import json
+import os
 import threading
 import time
 import uuid
@@ -86,9 +87,118 @@ class ResponseStore:
             return list(messages)
 
 
-#: process-global store (same scope as the reference's in-memory MCP
-#: session state; replicas each keep their own, like sticky sessions)
-RESPONSE_STORE = ResponseStore()
+class FileResponseStore:
+    """Transcript store shared across processes via flock'd files.
+
+    A follow-up request carrying ``previous_response_id`` may land on a
+    different SO_REUSEPORT worker (or replica, given a shared
+    directory); a worker-local dict would 404 it. One JSON file per
+    response id, atomically replaced, GC'd by TTL and count.
+
+    The id is client-supplied on lookup, so it is validated against a
+    strict charset before ever touching the filesystem.
+    """
+
+    _GC_EVERY = 64
+
+    def __init__(self, directory: str, max_entries: int = 4096,
+                 ttl_s: float = 3600.0):
+        self._dir = directory
+        self._max = max_entries
+        self._ttl = ttl_s
+        self._puts = 0
+        os.makedirs(directory, exist_ok=True)
+
+    @staticmethod
+    def _safe(response_id: str) -> str | None:
+        if not response_id or len(response_id) > 128:
+            return None
+        if not all(c.isalnum() or c in "-_" for c in response_id):
+            return None
+        return response_id
+
+    def _path(self, safe_id: str) -> str:
+        return os.path.join(self._dir, f"{safe_id}.json")
+
+    def put(self, response_id: str,
+            messages: list[dict[str, Any]]) -> None:
+        safe = self._safe(response_id)
+        if safe is None:
+            return
+        path = self._path(safe)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(messages, f)
+        os.replace(tmp, path)
+        self._puts += 1
+        if self._puts % self._GC_EVERY == 1:
+            self._gc()
+
+    def get(self, response_id: str) -> list[dict[str, Any]] | None:
+        safe = self._safe(response_id)
+        if safe is None:
+            return None
+        path = self._path(safe)
+        try:
+            if time.time() - os.stat(path).st_mtime > self._ttl:
+                return None
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+        return data if isinstance(data, list) else None
+
+    def _gc(self) -> None:
+        try:
+            entries = [
+                (e.stat().st_mtime, e.path)
+                for e in os.scandir(self._dir)
+                if e.name.endswith(".json")
+            ]
+        except OSError:
+            return
+        now = time.time()
+        entries.sort()
+        doomed = [p for mt, p in entries if now - mt > self._ttl]
+        overflow = len(entries) - len(doomed) - self._max
+        if overflow > 0:
+            doomed_set = set(doomed)
+            doomed += [p for mt, p in entries
+                       if p not in doomed_set][:overflow]
+        for p in doomed:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+
+class _StoreRouter:
+    """Lazily picks the store impl so the multi-worker CLI can export
+    AIGW_RESPONSES_DIR before the first request resolves it."""
+
+    def __init__(self) -> None:
+        self._impl: Any = None
+
+    def _resolve(self) -> Any:
+        if self._impl is None:
+            directory = os.environ.get("AIGW_RESPONSES_DIR")
+            self._impl = (FileResponseStore(directory) if directory
+                          else ResponseStore())
+        return self._impl
+
+    def put(self, response_id: str,
+            messages: list[dict[str, Any]]) -> None:
+        self._resolve().put(response_id, messages)
+
+    def get(self, response_id: str) -> list[dict[str, Any]] | None:
+        return self._resolve().get(response_id)
+
+
+#: process-global store. In-memory by default (same scope as the
+#: reference's in-memory MCP session state); file-backed and shared
+#: across workers/replicas when AIGW_RESPONSES_DIR is set (the
+#: multi-worker CLI sets it automatically).
+RESPONSE_STORE = _StoreRouter()
 
 
 def _convert_tools(body: dict[str, Any],

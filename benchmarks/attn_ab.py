@@ -89,11 +89,19 @@ def main() -> None:
                 "impl": name, "regime": regime, "ms_per_step": round(ms, 3),
                 "tokens_per_sec": round(BATCH / (ms / 1e3), 1),
             }), flush=True)
+    summary = {}
     for regime in ("full", "ragged25"):
         g, p = results[("gather", regime)], results[("pallas", regime)]
+        summary[regime] = {
+            "gather_ms": round(g, 3), "pallas_ms": round(p, 3),
+            "pallas_speedup": round(g / p, 3),
+        }
         print(json.dumps({
             "regime": regime, "pallas_speedup": round(g / p, 3),
         }), flush=True)
+    if jax.default_backend() == "tpu":
+        from benchmarks import persist
+        persist.save("attn_ab", summary)
 
 
 if __name__ == "__main__":

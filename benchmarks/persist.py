@@ -1,0 +1,65 @@
+"""Persisted on-chip bench results.
+
+The axon TPU tunnel comes and goes (it was down at snapshot time in
+rounds 1 and 2, zeroing the driver bench both times). Every successful
+on-chip measurement is therefore persisted here as a timestamped JSON
+file and committed, and ``bench.py`` reports the latest persisted
+measurement (with its age) whenever the tunnel is down at bench time.
+``benchmarks/oppo.sh`` probes the tunnel through the round and captures
+numbers whenever it is up.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any
+
+RESULTS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "results")
+
+
+def save(name: str, result: dict[str, Any]) -> str:
+    """Persist one measurement as results/<name>_<utc-stamp>.json."""
+    os.makedirs(RESULTS_DIR, exist_ok=True)
+    stamp = time.strftime("%Y%m%d_%H%M%S", time.gmtime())
+    out = dict(result)
+    out["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    out["bench"] = name
+    path = os.path.join(RESULTS_DIR, f"{name}_{stamp}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def latest(name: str) -> dict[str, Any] | None:
+    """Most recent persisted measurement for ``name`` (by filename stamp)."""
+    try:
+        files = sorted(
+            f for f in os.listdir(RESULTS_DIR)
+            if f.startswith(f"{name}_") and f.endswith(".json")
+        )
+    except FileNotFoundError:
+        return None
+    for fname in reversed(files):
+        try:
+            with open(os.path.join(RESULTS_DIR, fname)) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+    return None
+
+
+def age_hours(result: dict[str, Any]) -> float | None:
+    ts = result.get("captured_at")
+    if not ts:
+        return None
+    try:
+        then = time.mktime(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
+    except ValueError:
+        return None
+    return max(0.0, (time.mktime(time.gmtime()) - then) / 3600.0)

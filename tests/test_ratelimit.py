@@ -154,6 +154,58 @@ class TestReloadCarryover:
         rc3 = RuntimeConfig.build(Config.parse(cfg_dict3), previous=rc2)
         assert rc3.rate_limiter.check("m", "a", {}, now=103)[0]
 
+    def test_shared_backend_one_budget_across_workers(self, tmp_path):
+        """Two RateLimiter instances (≈ two SO_REUSEPORT workers) sharing
+        a FileQuotaBackend enforce ONE budget, not one each — a
+        10-token/min budget admits ~10 tokens total, not ~20 (reference:
+        the shared ratelimit service, runner.go:36-38)."""
+        from aigw_tpu.gateway.ratelimit import FileQuotaBackend
+
+        rules = [QuotaRule(name="cap", metadata_key="total", limit=10,
+                           window_seconds=60,
+                           client_key_header="x-user-id")]
+        a = RateLimiter(list(rules), FileQuotaBackend(str(tmp_path)))
+        b = RateLimiter(list(rules), FileQuotaBackend(str(tmp_path)))
+        h = {"x-user-id": "alice"}
+        # worker A consumes 7 of the 10-token budget
+        assert a.check("m", "be", h, now=1)[0]
+        a.consume({"total": 7}, "m", "be", h, now=1)
+        # worker B sees the same bucket: 3 remaining, still admits...
+        assert b.remaining("cap", "alice", now=2) == 3
+        assert b.check("m", "be", h, now=2)[0]
+        b.consume({"total": 4}, "m", "be", h, now=2)
+        # ...and now BOTH workers refuse: 11 >= 10 consumed globally
+        assert not a.check("m", "be", h, now=3)[0]
+        assert not b.check("m", "be", h, now=3)[0]
+        # other client key and next window are independent
+        assert a.check("m", "be", {"x-user-id": "bob"}, now=3)[0]
+        assert b.check("m", "be", h, now=61)[0]
+
+    def test_shared_backend_survives_reload(self, tmp_path):
+        """adopt() with a shared backend keeps counters by construction
+        (they live in the store, not the object)."""
+        from aigw_tpu.gateway.ratelimit import FileQuotaBackend
+
+        rules = [QuotaRule(name="cap", metadata_key="total", limit=5,
+                           window_seconds=3600)]
+        be = FileQuotaBackend(str(tmp_path))
+        old = RateLimiter(list(rules), be)
+        old.consume({"total": 5}, "m", "b", {}, now=10)
+        new = RateLimiter(list(rules),
+                          FileQuotaBackend(str(tmp_path))).adopt(old)
+        assert not new.check("m", "b", {}, now=11)[0]
+
+    def test_shared_backend_tolerates_corrupt_file(self, tmp_path):
+        from aigw_tpu.gateway.ratelimit import FileQuotaBackend
+
+        be = FileQuotaBackend(str(tmp_path))
+        be.add("cap", "k", 0.0, 3)
+        path = be._path("cap")
+        with open(path, "w") as f:
+            f.write("{torn")
+        assert be.get("cap", "k", 0.0) == 0  # unreadable → empty window
+        assert be.add("cap", "k", 0.0, 2) == 2  # heals on next write
+
     def test_window_sweep(self):
         rl = RateLimiter([QuotaRule(name="r", metadata_key="t", limit=5,
                                     window_seconds=1)])

@@ -306,6 +306,66 @@ class TestResponsesMultiTurn:
         assert expired.get("x") is None
 
 
+class TestFileResponseStore:
+    def test_cross_worker_roundtrip(self, tmp_path):
+        """Two store instances over one directory ≈ two workers: a
+        transcript put by one is readable from the other."""
+        from aigw_tpu.translate.responses import FileResponseStore
+
+        a = FileResponseStore(str(tmp_path))
+        b = FileResponseStore(str(tmp_path))
+        msgs = [{"role": "user", "content": "hi"},
+                {"role": "assistant", "content": "hello"}]
+        a.put("resp_abc123", msgs)
+        assert b.get("resp_abc123") == msgs
+        assert b.get("resp_missing") is None
+
+    def test_client_supplied_id_is_sanitized(self, tmp_path):
+        from aigw_tpu.translate.responses import FileResponseStore
+
+        s = FileResponseStore(str(tmp_path))
+        sentinel = tmp_path.parent / "outside.json"
+        sentinel.write_text("[]")
+        for evil in ("../outside", "a/b", "a\\b", ".", "x" * 200, ""):
+            assert s.get(evil) is None
+        s.put("../outside", [{"role": "user", "content": "x"}])
+        # the escape target was not touched and nothing was stored
+        assert sentinel.read_text() == "[]"
+        assert list(tmp_path.iterdir()) == []
+
+    def test_ttl_and_count_gc(self, tmp_path):
+        import os
+        import time as _t
+        from aigw_tpu.translate.responses import FileResponseStore
+
+        s = FileResponseStore(str(tmp_path), max_entries=2, ttl_s=1000)
+        s._GC_EVERY = 2  # trigger on odd puts (incl. the final, 5th)
+        for i in range(4):
+            s.put(f"resp_{i}", [{"role": "user", "content": str(i)}])
+            _t.sleep(0.02)
+        s.put("resp_last", [{"role": "user", "content": "last"}])
+        remaining = [p for p in os.listdir(tmp_path) if p.endswith(".json")]
+        assert len(remaining) <= 3  # count bound (max 2 + the fresh put)
+        assert s.get("resp_last") is not None
+        # expired entries are invisible even before GC removes them
+        exp = FileResponseStore(str(tmp_path / "exp"), ttl_s=0.0)
+        exp.put("resp_x", [])
+        _t.sleep(0.02)
+        assert exp.get("resp_x") is None
+
+    def test_router_picks_file_store_from_env(self, tmp_path, monkeypatch):
+        from aigw_tpu.translate.responses import (
+            FileResponseStore,
+            _StoreRouter,
+        )
+
+        monkeypatch.setenv("AIGW_RESPONSES_DIR", str(tmp_path))
+        r = _StoreRouter()
+        r.put("resp_env", [{"role": "user", "content": "x"}])
+        assert isinstance(r._impl, FileResponseStore)
+        assert (tmp_path / "resp_env.json").exists()
+
+
 class TestResponsesStreamingTools:
     def test_streaming_tool_call_events(self):
         from aigw_tpu.translate.responses import ResponsesToChat
