@@ -2,9 +2,13 @@
 ``aigw run`` embeds the whole system in one process, run.go:91-235).
 
 Subcommands:
-  run <config.yaml|bundle-dir>   start the gateway data plane
-  validate <config>              parse + validate a config, print summary
+  run <config.yaml|bundle-dir|manifest-dir>  start the gateway data plane
+  validate <config|manifest-dir>  parse + validate, print summary
   tpuserve <model-config>        start the TPU serving engine (tpuserve)
+
+A manifest directory (CRD YAML files) runs under the reconciling control
+plane: edits converge live and per-object Accepted conditions are written
+to <dir>/aigw-status.json (config/controller.py).
 """
 
 from __future__ import annotations
@@ -22,9 +26,11 @@ def main(argv: list[str] | None = None) -> int:
 
     p_run = sub.add_parser("run", help="run the gateway data plane")
     p_run.add_argument("config", nargs="?", default="",
-                       help="config YAML/bundle dir (omit to autoconfig "
-                            "from env: OPENAI_API_KEY, ANTHROPIC_API_KEY, "
-                            "AZURE_OPENAI_*, TPUSERVE_URL)")
+                       help="config YAML, bundle dir, or CRD manifest dir "
+                            "(watched + reconciled with status conditions; "
+                            "omit to autoconfig from env: OPENAI_API_KEY, "
+                            "ANTHROPIC_API_KEY, AZURE_OPENAI_*, "
+                            "TPUSERVE_URL)")
     p_run.add_argument("--host", default="127.0.0.1")
     p_run.add_argument("--port", type=int, default=1975)
     p_run.add_argument("--watch-interval", type=float, default=5.0)
@@ -34,6 +40,12 @@ def main(argv: list[str] | None = None) -> int:
         help="worker processes sharing the port via SO_REUSEPORT "
              "(each runs the full data plane and watches the config; "
              "requires an explicit --port)")
+    p_run.add_argument(
+        "--reuse-port", action="store_true",
+        help="bind with SO_REUSEPORT even with --workers 1, so a "
+             "replacement gateway process can bind the same port and "
+             "take over before this one drains — the rolling zero-"
+             "downtime upgrade path (tests/test_upgrade_e2e.py)")
 
     p_val = sub.add_parser("validate", help="validate a config file")
     p_val.add_argument("config")
@@ -128,10 +140,28 @@ def main(argv: list[str] | None = None) -> int:
     )
 
     if args.cmd == "validate":
+        from aigw_tpu.config.controller import Reconciler, is_manifest_dir
         from aigw_tpu.config.model import ConfigError, load_config
 
         try:
-            cfg = load_config(args.config)
+            if is_manifest_dir(args.config):
+                # reconcile dry run: per-object conditions to stdout
+                import tempfile
+
+                with tempfile.NamedTemporaryFile(suffix=".json") as tf:
+                    rec = Reconciler(args.config, status_path=tf.name)
+                    cfg = rec.load()
+                bad = [
+                    (k, c) for k, c in rec._conditions.items()
+                    if c["status"] != "True"
+                ]
+                for key, cond in bad:
+                    print(f"NOT ACCEPTED {key}: {cond['message']}",
+                          file=sys.stderr)
+                if bad:
+                    return 1
+            else:
+                cfg = load_config(args.config)
         except ConfigError as e:
             print(f"INVALID: {e}", file=sys.stderr)
             return 1
@@ -258,7 +288,8 @@ def main(argv: list[str] | None = None) -> int:
         try:
             if getattr(args, "workers", 1) > 1:
                 return _run_gateway_workers(args)
-            return asyncio.run(_run_gateway(args))
+            return asyncio.run(_run_gateway(
+                args, reuse_port=getattr(args, "reuse_port", False)))
         except ConfigError as e:
             print(f"config error: {e}", file=sys.stderr)
             return 1
@@ -367,6 +398,20 @@ async def _run_gateway(args: argparse.Namespace,
         await watcher.start()
     print(f"gateway listening on http://{args.host}:{args.port}", flush=True)
     await _wait_for_signal()
+    # Graceful drain (Envoy's listener-drain role in the reference's
+    # rolling upgrades): stop accepting first, then give connections the
+    # kernel had already handed us a grace window to deliver and finish
+    # their in-flight request before cleanup closes everything.
+    import os as _os
+
+    for site in list(runner.sites):
+        await site.stop()
+    try:
+        drain = float(_os.environ.get("AIGW_DRAIN_SECONDS", "1.0"))
+    except ValueError:
+        drain = 1.0
+    if drain > 0:
+        await asyncio.sleep(drain)
     if watcher is not None:
         await watcher.stop()
     await runner.cleanup()

@@ -1,10 +1,12 @@
 """Config hot-reload watcher.
 
-Polls a config file or bundle directory (default 5s, the reference's tick —
-filterapi/watcher.go:79-145), checksums content to skip no-op reloads, and
-swaps in a freshly built RuntimeConfig on change. A bad new config is logged
-and rejected; the gateway keeps serving the last good one (the reference's
-watcher has the same keep-last-good semantics).
+Polls a config file, bundle directory, or CRD manifest directory (default
+5s, the reference's tick — filterapi/watcher.go:79-145), checksums content
+to skip no-op reloads, and swaps in a freshly built RuntimeConfig on
+change. A bad new config is logged and rejected; the gateway keeps serving
+the last good one (the reference's watcher has the same keep-last-good
+semantics). A manifest directory goes through the reconciling control
+plane (config.controller), which also writes per-object status conditions.
 """
 
 from __future__ import annotations
@@ -15,6 +17,7 @@ import os
 from typing import Awaitable, Callable
 
 from aigw_tpu.config.bundle import read_bundle
+from aigw_tpu.config.controller import Reconciler, is_manifest_dir
 from aigw_tpu.config.model import Config, load_config
 from aigw_tpu.config.runtime import RuntimeConfig
 
@@ -36,8 +39,13 @@ class ConfigWatcher:
         self._checksum = ""
         self._task: asyncio.Task | None = None
         self._current: RuntimeConfig | None = None
+        self._reconciler: Reconciler | None = None
 
     def _load(self) -> Config:
+        if is_manifest_dir(self.path):
+            if self._reconciler is None:
+                self._reconciler = Reconciler(self.path)
+            return self._reconciler.load()
         if os.path.isdir(self.path):
             return read_bundle(self.path)
         return load_config(self.path)

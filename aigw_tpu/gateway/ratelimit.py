@@ -101,12 +101,19 @@ class FileQuotaBackend:
     def _load(f) -> dict:
         f.seek(0)
         raw = f.read()
+        empty = {"start": -1.0, "used": {}}
         if not raw:
-            return {"start": -1.0, "used": {}}
+            return empty
         try:
-            return json.loads(raw)
+            state = json.loads(raw)
         except (json.JSONDecodeError, UnicodeDecodeError):
-            return {"start": -1.0, "used": {}}
+            return empty
+        # shape-validate too: valid-JSON-wrong-shape (external edit) must
+        # reset, not crash every quota-matched request forever
+        if not isinstance(state, dict) or \
+                not isinstance(state.get("used"), dict):
+            return empty
+        return state
 
     def get(self, rule_name: str, client_key: str,
             window_start: float) -> int:

@@ -732,7 +732,12 @@ class GatewayServer:
             # the captured body (they build fresh structures — the
             # reference's sjson no-in-place rule, translator.go:140-153),
             # so each attempt can re-translate without a deep copy.
-            tx = translator.request(body)
+            if self._translator_blocks(endpoint):
+                # /v1/responses with a file-backed transcript store:
+                # previous_response_id resolution reads disk — off the loop
+                tx = await asyncio.to_thread(translator.request, body)
+            else:
+                tx = translator.request(body)
             out_body = apply_body_mutation(tx.body, backend.body_mutation)
 
             headers = {
@@ -839,7 +844,12 @@ class GatewayServer:
                                type_="upstream_error"),
                     str(e) or type(e).__name__,
                 ) from None
-            rx = translator.response_body(raw, True)
+            if self._translator_blocks(endpoint):
+                # end-of-stream persists the transcript to disk
+                rx = await asyncio.to_thread(
+                    translator.response_body, raw, True)
+            else:
+                rx = translator.response_body(raw, True)
             usage = rx.usage
             req_metrics.response_model = rx.model
             if span is not None:
@@ -903,7 +913,12 @@ class GatewayServer:
                     if acc is not None:
                         acc.feed(rx.body)
                     await out.write(rx.body)
-            rx = translator.response_body(b"", True)
+            if self._translator_blocks(endpoint):
+                # end-of-stream persists the transcript to disk
+                rx = await asyncio.to_thread(
+                    translator.response_body, b"", True)
+            else:
+                rx = translator.response_body(b"", True)
             usage = usage.merge_override(rx.usage)
             model = rx.model or model
             if rx.body:
@@ -946,6 +961,18 @@ class GatewayServer:
         self.metrics.requests_total.labels(route_name, rb.backend.name, "200").inc()
         await out.write_eof()
         return out
+
+    @staticmethod
+    def _translator_blocks(endpoint: "Endpoint | None") -> bool:
+        """True when translator request/end-of-stream calls do disk I/O
+        (file-backed /v1/responses transcript store) and must be
+        thread-hopped off the event loop — same contract as the quota
+        backend below and FileReplayStore.blocking."""
+        if endpoint is not Endpoint.RESPONSES:
+            return False
+        from aigw_tpu.translate.responses import RESPONSE_STORE
+
+        return RESPONSE_STORE.blocking
 
     async def _check_quota(self, client_headers, rb, req_metrics,
                            error_body):

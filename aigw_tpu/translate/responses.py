@@ -186,6 +186,13 @@ class _StoreRouter:
                           else ResponseStore())
         return self._impl
 
+    @property
+    def blocking(self) -> bool:
+        """True when backed by disk: callers on an event loop should
+        thread-hop the translator calls that touch the store (same
+        contract as FileReplayStore.blocking)."""
+        return isinstance(self._resolve(), FileResponseStore)
+
     def put(self, response_id: str,
             messages: list[dict[str, Any]]) -> None:
         self._resolve().put(response_id, messages)

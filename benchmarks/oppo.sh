@@ -21,10 +21,15 @@ EOF
 while true; do
     if probe; then
         echo "[oppo $(date -u +%FT%TZ)] tunnel UP — capturing"
-        timeout 3600 python bench.py && echo "[oppo] headline captured"
-        timeout 2400 python benchmarks/attn_ab.py && echo "[oppo] attn_ab captured"
-        # refresh no more than hourly once we have numbers
-        sleep 3600
+        ok=1
+        timeout 3600 python bench.py && echo "[oppo] headline captured" || ok=0
+        timeout 2400 python benchmarks/attn_ab.py && echo "[oppo] attn_ab captured" || ok=0
+        if [ "$ok" = 1 ]; then
+            sleep 3600  # refresh no more than hourly once we have numbers
+        else
+            echo "[oppo] capture failed — retrying soon (tunnel window may close)"
+            sleep 300
+        fi
     else
         echo "[oppo $(date -u +%FT%TZ)] tunnel down"
         sleep 300

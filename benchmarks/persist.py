@@ -55,11 +55,15 @@ def latest(name: str) -> dict[str, Any] | None:
 
 
 def age_hours(result: dict[str, Any]) -> float | None:
+    import calendar
+
     ts = result.get("captured_at")
     if not ts:
         return None
     try:
-        then = time.mktime(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
+        # timegm, not mktime: the stamp is UTC; mktime would apply the
+        # host's DST rules and skew the age by an hour
+        then = calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
     except ValueError:
         return None
-    return max(0.0, (time.mktime(time.gmtime()) - then) / 3600.0)
+    return max(0.0, (time.time() - then) / 3600.0)

@@ -1,0 +1,239 @@
+"""Watching control plane: the manifest-directory reconciler (VERDICT r2
+item 5; reference internal/controller/controller.go:117-330 — live
+reconcile + status conditions, gateway.go:89).
+
+Covers: editing an AIGatewayRoute manifest while serving reroutes traffic
+with no restart; per-object Accepted conditions land in the status file;
+a broken object (or unparseable file) quarantines only itself.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+import aiohttp
+import pytest
+
+from aigw_tpu.config.controller import Reconciler, is_manifest_dir
+from aigw_tpu.config.model import ConfigError
+from aigw_tpu.config.runtime import RuntimeConfig
+from aigw_tpu.config.watcher import ConfigWatcher
+from aigw_tpu.gateway.server import run_gateway
+
+from fakes import FakeUpstream, openai_chat_response
+
+
+def _backend_yaml(name: str, host: str, port: int) -> str:
+    return f"""
+apiVersion: aigateway.envoyproxy.io/v1alpha1
+kind: AIServiceBackend
+metadata: {{name: {name}}}
+spec:
+  schema: {{name: OpenAI}}
+  backendRef: {{name: {name}, kind: Backend}}
+---
+apiVersion: gateway.envoyproxy.io/v1alpha1
+kind: Backend
+metadata: {{name: {name}}}
+spec:
+  endpoints:
+    - fqdn: {{hostname: {host}, port: {port}}}
+"""
+
+
+def _route_yaml(name: str, model: str, backend: str) -> str:
+    return f"""
+apiVersion: aigateway.envoyproxy.io/v1alpha1
+kind: AIGatewayRoute
+metadata: {{name: {name}}}
+spec:
+  rules:
+    - matches:
+        - headers:
+            - type: Exact
+              name: x-ai-eg-model
+              value: {model}
+      backendRefs:
+        - name: {backend}
+"""
+
+
+class TestReconciler:
+    def test_accepted_conditions_written(self, tmp_path):
+        (tmp_path / "backend.yaml").write_text(
+            _backend_yaml("b1", "127.0.0.1", 8901))
+        (tmp_path / "route.yaml").write_text(_route_yaml("r1", "m1", "b1"))
+        rec = Reconciler(str(tmp_path))
+        cfg = rec.load()
+        assert [r.name for r in cfg.routes] == ["r1"]
+        status = json.loads((tmp_path / "aigw-status.json").read_text())
+        objs = status["objects"]
+        assert objs["AIGatewayRoute/r1"]["status"] == "True"
+        assert objs["AIServiceBackend/b1"]["status"] == "True"
+        assert objs["Backend/b1"]["status"] == "True"
+
+    def test_broken_object_quarantined(self, tmp_path):
+        (tmp_path / "backend.yaml").write_text(
+            _backend_yaml("b1", "127.0.0.1", 8901))
+        (tmp_path / "route.yaml").write_text(_route_yaml("r1", "m1", "b1"))
+        # a BSP with an unsupported type breaks compilation of its object
+        (tmp_path / "bad.yaml").write_text("""
+apiVersion: aigateway.envoyproxy.io/v1alpha1
+kind: BackendSecurityPolicy
+metadata: {name: bad-bsp}
+spec:
+  type: NoSuchAuthKind
+  targetRefs: [{name: b1}]
+""")
+        rec = Reconciler(str(tmp_path))
+        cfg = rec.load()  # does not raise: the rest serves
+        assert [r.name for r in cfg.routes] == ["r1"]
+        objs = json.loads(
+            (tmp_path / "aigw-status.json").read_text())["objects"]
+        bad = objs["BackendSecurityPolicy/bad-bsp"]
+        assert bad["status"] == "False"
+        assert bad["reason"] == "NotAccepted"
+        assert "NoSuchAuthKind" in bad["message"]
+        assert objs["AIGatewayRoute/r1"]["status"] == "True"
+
+    def test_admission_rules_enforced_at_reconcile(self, tmp_path):
+        """An object the reference's API server would refuse at apply
+        time (CEL rule) is NotAccepted by the reconciler with the rule's
+        message — here a reserved rule name."""
+        (tmp_path / "backend.yaml").write_text(
+            _backend_yaml("b1", "127.0.0.1", 8901))
+        (tmp_path / "route.yaml").write_text(_route_yaml("r1", "m1", "b1"))
+        (tmp_path / "reserved.yaml").write_text("""
+apiVersion: aigateway.envoyproxy.io/v1alpha1
+kind: AIGatewayRoute
+metadata: {name: r2}
+spec:
+  rules:
+    - name: route-not-found
+      matches:
+        - headers: [{type: Exact, name: x-ai-eg-model, value: m2}]
+      backendRefs: [{name: b1}]
+""")
+        rec = Reconciler(str(tmp_path))
+        cfg = rec.load()
+        assert [r.name for r in cfg.routes] == ["r1"]
+        objs = json.loads(
+            (tmp_path / "aigw-status.json").read_text())["objects"]
+        assert objs["AIGatewayRoute/r2"]["status"] == "False"
+        assert "reserved" in objs["AIGatewayRoute/r2"]["message"]
+
+    def test_unparseable_file_quarantined(self, tmp_path):
+        (tmp_path / "route.yaml").write_text(_route_yaml("r1", "m1", "b1"))
+        (tmp_path / "torn.yaml").write_text("{unclosed: [")
+        rec = Reconciler(str(tmp_path))
+        cfg = rec.load()
+        assert [r.name for r in cfg.routes] == ["r1"]
+        objs = json.loads(
+            (tmp_path / "aigw-status.json").read_text())["objects"]
+        assert objs["file/torn.yaml"]["reason"] == "ParseError"
+
+    def test_transition_time_only_moves_on_flips(self, tmp_path):
+        (tmp_path / "route.yaml").write_text(_route_yaml("r1", "m1", "b1"))
+        rec = Reconciler(str(tmp_path))
+        rec.load()
+        objs1 = json.loads(
+            (tmp_path / "aigw-status.json").read_text())["objects"]
+        t1 = objs1["AIGatewayRoute/r1"]["lastTransitionTime"]
+        time.sleep(1.1)
+        rec.load()  # no change → same transition time
+        objs2 = json.loads(
+            (tmp_path / "aigw-status.json").read_text())["objects"]
+        assert objs2["AIGatewayRoute/r1"]["lastTransitionTime"] == t1
+
+    def test_empty_dir_is_not_manifest_dir(self, tmp_path):
+        assert not is_manifest_dir(str(tmp_path))
+        (tmp_path / "index.json").write_text("{}")
+        (tmp_path / "x.yaml").write_text("kind: AIGatewayRoute")
+        assert not is_manifest_dir(str(tmp_path))  # bundle wins
+
+    def test_missing_dir_raises(self, tmp_path):
+        with pytest.raises(ConfigError):
+            Reconciler(str(tmp_path / "nope")).load()
+
+
+class TestWatchingControlPlane:
+    def test_edit_route_reroutes_live_traffic(self, tmp_path):
+        """The reference's operating mode: apply/edit a CRD, the gateway
+        reconfigures itself — no restart, status conditions visible."""
+
+        async def main():
+            up_a = FakeUpstream().on_json(
+                "/v1/chat/completions", openai_chat_response(content="A"))
+            up_b = FakeUpstream().on_json(
+                "/v1/chat/completions", openai_chat_response(content="B"))
+            await up_a.start()
+            await up_b.start()
+            host_a = up_a.url.split("//")[1]
+            host_b = up_b.url.split("//")[1]
+            mdir = tmp_path / "manifests"
+            mdir.mkdir()
+            (mdir / "backends.yaml").write_text(
+                _backend_yaml("be-a", *host_a.split(":"))
+                + "---" + _backend_yaml("be-b", *host_b.split(":")))
+            (mdir / "route.yaml").write_text(
+                _route_yaml("r1", "m1", "be-a"))
+
+            holder = {}
+
+            def on_reload(rc):
+                if "server" in holder:
+                    holder["server"].set_runtime(rc)
+
+            watcher = ConfigWatcher(str(mdir), on_reload, interval=0.2)
+            rc0 = watcher.load_initial()
+            server, runner = await run_gateway(rc0, port=0)
+            holder["server"] = server
+            await watcher.start()
+            site = list(runner.sites)[0]
+            port = site._server.sockets[0].getsockname()[1]
+            url = f"http://127.0.0.1:{port}/v1/chat/completions"
+            payload = {"model": "m1",
+                       "messages": [{"role": "user", "content": "hi"}]}
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(url, json=payload) as r:
+                        assert r.status == 200
+                        got = await r.json()
+                        assert got["choices"][0]["message"]["content"] == "A"
+                    # edit the route manifest: point m1 at backend B
+                    (mdir / "route.yaml").write_text(
+                        _route_yaml("r1", "m1", "be-b"))
+                    deadline = time.time() + 10
+                    content = "A"
+                    while time.time() < deadline and content != "B":
+                        await asyncio.sleep(0.25)
+                        async with s.post(url, json=payload) as r:
+                            assert r.status == 200
+                            got = await r.json()
+                            content = got["choices"][0]["message"]["content"]
+                    assert content == "B", "edit never took effect"
+                    # drop a broken manifest next to it: traffic keeps
+                    # flowing and the status file records the quarantine
+                    (mdir / "broken.yaml").write_text("""
+apiVersion: aigateway.envoyproxy.io/v1alpha1
+kind: BackendSecurityPolicy
+metadata: {name: bad-bsp}
+spec: {type: Bogus, targetRefs: [{name: be-b}]}
+""")
+                    await asyncio.sleep(0.8)
+                    async with s.post(url, json=payload) as r:
+                        assert r.status == 200
+                    objs = json.loads(
+                        (mdir / "aigw-status.json").read_text())["objects"]
+                    assert objs["BackendSecurityPolicy/bad-bsp"][
+                        "status"] == "False"
+                    assert objs["AIGatewayRoute/r1"]["status"] == "True"
+            finally:
+                await watcher.stop()
+                await runner.cleanup()
+                await up_a.stop()
+                await up_b.stop()
+
+        asyncio.run(main())

@@ -1,0 +1,176 @@
+"""Replay the reference's CRD-CEL validation fixtures (VERDICT r2 item 7).
+
+The reference validates CRD invariants as CEL/OpenAPI rules against a
+real API server (tests/crdcel/main_test.go:23-227 + testdata). Here the
+same fixture corpus — read in place, never copied — drives
+``config.admission``: every fixture the reference's API server rejects
+must produce an admission error containing the expected phrase, and
+every accepted fixture must validate cleanly.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+import yaml
+
+from aigw_tpu.config import admission
+
+TESTDATA = "/root/reference/tests/crdcel/testdata"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(TESTDATA), reason="reference fixtures not mounted")
+
+# (subdir, fixture, expected-error phrase or "" for accepted) — mirrors
+# the table in tests/crdcel/main_test.go (phrases adapted to this
+# validator's messages where the reference's wording is K8s-generated)
+CASES = [
+    # AIGatewayRoute
+    ("aigatewayroutes", "basic.yaml", ""),
+    ("aigatewayroutes", "rule_name.yaml", ""),
+    ("aigatewayroutes", "duplicate_rule_names.yaml",
+     "rule name must be unique within the route"),
+    ("aigatewayroutes", "reserved_rule_name.yaml",
+     "rule name route-not-found is reserved"),
+    ("aigatewayroutes", "llmcosts.yaml", ""),
+    ("aigatewayroutes", "parent_refs.yaml", ""),
+    ("aigatewayroutes", "parent_refs_default_kind.yaml", ""),
+    ("aigatewayroutes", "parent_refs_invalid_kind.yaml",
+     "only Gateway is supported"),
+    ("aigatewayroutes", "inference_pool_valid.yaml", ""),
+    ("aigatewayroutes", "inference_pool_mixed_backends.yaml",
+     "cannot mix InferencePool and AIServiceBackend"),
+    ("aigatewayroutes", "inference_pool_multiple.yaml",
+     "only one InferencePool backend is allowed per rule"),
+    ("aigatewayroutes", "inference_pool_partial_ref.yaml",
+     "group and kind must be specified together"),
+    ("aigatewayroutes", "inference_pool_unsupported_group.yaml",
+     "only InferencePool from inference.networking.k8s.io group"),
+    ("aigatewayroutes", "too_many_rules.yaml", "must have at most 15"),
+    # AIServiceBackend
+    ("aiservicebackends", "basic.yaml", ""),
+    ("aiservicebackends", "anthropic-schema.yaml", ""),
+    ("aiservicebackends", "basic-eg-backend-aws.yaml", ""),
+    ("aiservicebackends", "basic-eg-backend-azure.yaml", ""),
+    ("aiservicebackends", "unknown_schema.yaml", "unsupported value"),
+    ("aiservicebackends", "k8s-svc.yaml",
+     "must be a Backend resource of Envoy Gateway"),
+    # BackendSecurityPolicy
+    ("backendsecuritypolicies", "basic.yaml", ""),
+    ("backendsecuritypolicies", "unknown_provider.yaml",
+     "unsupported value"),
+    ("backendsecuritypolicies", "missing_type.yaml", "unsupported value"),
+    ("backendsecuritypolicies", "multiple_security_policies.yaml",
+     "only apiKey field should be set"),
+    ("backendsecuritypolicies", "azure_credentials_missing_client_id.yaml",
+     "clientID should be at least 1 chars long"),
+    ("backendsecuritypolicies", "azure_credentials_missing_tenant_id.yaml",
+     "tenantID should be at least 1 chars long"),
+    ("backendsecuritypolicies", "azure_missing_auth.yaml",
+     "exactly one of clientSecretRef or oidcExchangeToken"),
+    ("backendsecuritypolicies", "azure_multiple_auth.yaml",
+     "exactly one of clientSecretRef or oidcExchangeToken"),
+    ("backendsecuritypolicies", "apikey_with_aws_credentials.yaml",
+     "only apiKey field should be set"),
+    ("backendsecuritypolicies", "apikey_with_azure_credentials.yaml",
+     "only apiKey field should be set"),
+    ("backendsecuritypolicies", "apikey_with_gcp_credentials.yaml",
+     "only apiKey field should be set"),
+    ("backendsecuritypolicies", "apikey_with_nil_configuration.yaml",
+     "only apiKey field should be set"),
+    ("backendsecuritypolicies", "aws_with_azure_credentials.yaml",
+     "only awsCredentials field should be set"),
+    ("backendsecuritypolicies", "azure_with_gcp_credentials.yaml",
+     "only azureCredentials field should be set"),
+    ("backendsecuritypolicies", "gcp_with_apikey.yaml",
+     "only gcpCredentials field should be set"),
+    ("backendsecuritypolicies", "azure_oidc.yaml", ""),
+    ("backendsecuritypolicies", "azure_valid_credentials.yaml", ""),
+    ("backendsecuritypolicies", "aws_credential_file.yaml", ""),
+    ("backendsecuritypolicies", "aws_oidc.yaml", ""),
+    ("backendsecuritypolicies", "gcp_oidc.yaml", ""),
+    ("backendsecuritypolicies", "anthropic-apikey.yaml", ""),
+    ("backendsecuritypolicies", "targetrefs_basic.yaml", ""),
+    ("backendsecuritypolicies", "targetrefs_multiple.yaml", ""),
+    ("backendsecuritypolicies", "targetrefs_inferencepool.yaml", ""),
+    ("backendsecuritypolicies", "targetrefs_mixed.yaml", ""),
+    ("backendsecuritypolicies", "targetrefs_invalid_kind.yaml",
+     "must reference AIServiceBackend or InferencePool"),
+    ("backendsecuritypolicies", "targetrefs_invalid_group.yaml",
+     "must reference AIServiceBackend or InferencePool"),
+    # MCPRoute
+    ("mcpgatewayroutes", "basic.yaml", ""),
+    ("mcpgatewayroutes", "same_backend_names.yaml",
+     "all backendRefs names must be unique"),
+    ("mcpgatewayroutes", "parent_refs_invalid_kind.yaml",
+     "only Gateway is supported"),
+    ("mcpgatewayroutes", "tool_selector_missing.yaml",
+     "at least one of include, includeRegex, exclude, or excludeRegex"),
+    ("mcpgatewayroutes", "tool_selector_both.yaml",
+     "include and includeRegex are mutually exclusive"),
+    ("mcpgatewayroutes", "tool_selector_exclude.yaml", ""),
+    ("mcpgatewayroutes", "tool_selector_exclude_regex.yaml", ""),
+    ("mcpgatewayroutes", "tool_selector_include_and_exclude.yaml", ""),
+    ("mcpgatewayroutes", "tool_selector_exclude_both.yaml",
+     "exclude and excludeRegex are mutually exclusive"),
+    ("mcpgatewayroutes", "backend_api_key_inline_and_secret.yaml",
+     "exactly one of secretRef or inline must be set"),
+    ("mcpgatewayroutes", "backend_api_key_missing.yaml",
+     "exactly one of secretRef or inline must be set"),
+    ("mcpgatewayroutes", "backend_api_key_both_header_and_query.yaml",
+     "only one of header or queryParam can be set"),
+    ("mcpgatewayroutes", "jwks_missing.yaml",
+     "either remoteJWKS or localJWKS must be specified"),
+    ("mcpgatewayroutes", "jwks_both.yaml",
+     "remoteJWKS and localJWKS cannot both be specified"),
+    ("mcpgatewayroutes", "authorization_with_jwt_without_oauth.yaml",
+     "oauth must be configured when any authorization rule uses a jwt"),
+    ("mcpgatewayroutes", "authorization_claim_scope_reserved.yaml",
+     "'scope' claim name is reserved"),
+    ("mcpgatewayroutes", "authorization_jwt_missing_scopes_and_claims.yaml",
+     "either scopes or claims must be specified"),
+    ("mcpgatewayroutes", "authorization_without_jwt_source.yaml", ""),
+]
+
+
+@pytest.mark.parametrize(
+    "subdir,fixture,expect",
+    CASES,
+    ids=[f"{d}/{f}" for d, f, _ in CASES],
+)
+def test_cel_fixture(subdir: str, fixture: str, expect: str):
+    path = os.path.join(TESTDATA, subdir, fixture)
+    with open(path, "r", encoding="utf-8") as f:
+        obj = yaml.safe_load(f)
+    errors = admission.validate(obj)
+    if expect:
+        assert errors, f"{fixture}: expected rejection, got accepted"
+        joined = "\n".join(errors)
+        assert expect in joined, (
+            f"{fixture}: expected error containing {expect!r}, "
+            f"got: {joined}")
+    else:
+        assert errors == [], f"{fixture}: expected accepted, got {errors}"
+
+
+def test_every_fixture_is_covered():
+    """New fixtures appearing upstream should fail loudly, not silently
+    skip (the corpus is the contract)."""
+    covered = {(d, f) for d, f, _ in CASES}
+    on_disk = {
+        (d, f)
+        for d in os.listdir(TESTDATA)
+        for f in os.listdir(os.path.join(TESTDATA, d))
+        if f.endswith((".yaml", ".yml"))
+    }
+    missing = on_disk - covered
+    # inference_pool_basic.yaml exists on disk but is absent from the
+    # reference's own test table; tolerate table-absent extras like it
+    # only when they validate cleanly
+    for d, f in sorted(missing):
+        with open(os.path.join(TESTDATA, d, f), encoding="utf-8") as fh:
+            obj = yaml.safe_load(fh)
+        assert admission.validate(obj) == [], (
+            f"uncovered fixture {d}/{f} does not validate cleanly — "
+            "add it to CASES with its expected error")
