@@ -82,6 +82,10 @@ def main(argv: list[str] | None = None) -> int:
     p_core.add_argument("--fallback-port", type=int, default=1976,
                         help="where the Python gateway listens (run it "
                              "with --port matching this)")
+    p_core.add_argument("--access-log", default="",
+                        help="JSON-lines access log for natively routed "
+                             "requests (model/backend/status/duration/"
+                             "token usage per line)")
 
     p_serve = sub.add_parser("tpuserve", help="run the TPU serving engine")
     p_serve.add_argument("--model", required=True,
@@ -208,6 +212,7 @@ def main(argv: list[str] | None = None) -> int:
             listen_port=args.listen_port,
             fallback_host=args.fallback_host,
             fallback_port=args.fallback_port,
+            access_log_path=args.access_log,
         )
         write_core_config(args.out, core)
         print(f"{args.out}: {len(core['rules'])} native rules, "

@@ -104,9 +104,9 @@ def _backend_entry(b: Backend, weight: int, priority: int) -> dict[str, Any]:
     if b.auth.kind not in _STATIC_AUTH_KINDS:
         raise NotEligible(f"backend {b.name!r}: auth {b.auth.kind.value}")
     u = urlsplit(b.url)
-    if u.scheme != "http":
-        raise NotEligible(f"backend {b.name!r}: scheme {u.scheme or '??'} "
-                          "(core is plain-http; TLS stays in Python)")
+    if u.scheme not in ("http", "https"):
+        raise NotEligible(f"backend {b.name!r}: scheme {u.scheme or '??'}")
+    tls = u.scheme == "https"
     if not u.hostname:
         raise NotEligible(f"backend {b.name!r}: no host in url")
     if u.path not in ("", "/"):
@@ -125,11 +125,16 @@ def _backend_entry(b: Backend, weight: int, priority: int) -> dict[str, Any]:
     entry: dict[str, Any] = {
         "name": b.name,
         "host": u.hostname,
-        "port": u.port or 80,
+        "port": u.port or (443 if tls else 80),
         "weight": weight,
         "priority": priority,
         "read_timeout_s": int(max(b.stream_idle_timeout, 1.0)),
     }
+    if tls:
+        # core dials TLS itself (dlopen'd libssl, verified, SNI =
+        # hostname) — real external providers are native-eligible
+        entry["tls"] = True
+        entry["sni"] = u.hostname
     headers = _auth_headers(b.auth)
     if headers:
         entry["auth_headers"] = headers
@@ -149,6 +154,7 @@ def compile_core_config(
     listen_port: int = 1975,
     fallback_host: str = "127.0.0.1",
     fallback_port: int = 1976,
+    access_log_path: str = "",
 ) -> tuple[dict[str, Any], list[str]]:
     """Returns (core_config_dict, skipped_reasons).
 
@@ -216,6 +222,8 @@ def compile_core_config(
         "endpoints": list(NATIVE_ENDPOINTS),
         "rules": rules,
     }
+    if access_log_path:
+        core["access_log_path"] = access_log_path
     return core, skipped
 
 
