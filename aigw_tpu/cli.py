@@ -50,6 +50,16 @@ def main(argv: list[str] | None = None) -> int:
     p_val = sub.add_parser("validate", help="validate a config file")
     p_val.add_argument("config")
 
+    p_status = sub.add_parser(
+        "status",
+        help="print per-object Accepted conditions for a manifest dir "
+             "(the reference surfaces these via `kubectl get`; here they "
+             "live in <dir>/aigw-status.json, written by the reconciling "
+             "gateway, or are computed fresh when no gateway has run)")
+    p_status.add_argument("dir", help="CRD manifest directory")
+    p_status.add_argument("--json", action="store_true",
+                          help="machine-readable output")
+
     p_tr = sub.add_parser(
         "translate",
         help="compile a config and print the normalized runtime view "
@@ -155,10 +165,7 @@ def main(argv: list[str] | None = None) -> int:
                 with tempfile.NamedTemporaryFile(suffix=".json") as tf:
                     rec = Reconciler(args.config, status_path=tf.name)
                     cfg = rec.load()
-                bad = [
-                    (k, c) for k, c in rec._conditions.items()
-                    if c["status"] != "True"
-                ]
+                bad = sorted(rec.not_accepted().items())
                 for key, cond in bad:
                     print(f"NOT ACCEPTED {key}: {cond['message']}",
                           file=sys.stderr)
@@ -174,6 +181,62 @@ def main(argv: list[str] | None = None) -> int:
             f"{len(cfg.models)} models, {len(cfg.llm_request_costs)} cost metrics"
         )
         return 0
+
+    if args.cmd == "status":
+        import json as _json
+        import os as _os
+
+        from aigw_tpu.config.controller import Reconciler, is_manifest_dir
+
+        if not is_manifest_dir(args.dir):
+            print(f"{args.dir}: not a CRD manifest directory",
+                  file=sys.stderr)
+            return 2
+        # Always reconcile live (a dry run against a temp status path) so
+        # the exit code reflects the manifests as they are NOW; the
+        # running gateway's aigw-status.json is only preferred when its
+        # per-object observedChecksums match the live view — a dead
+        # gateway's stale file must not mask a broken (or fixed) edit.
+        import tempfile
+
+        with tempfile.NamedTemporaryFile(suffix=".json") as tf:
+            rec = Reconciler(args.dir, status_path=tf.name)
+            rec.load()
+        conditions = rec.conditions()
+        source = "live"
+        status_file = _os.path.join(args.dir, "aigw-status.json")
+        if _os.path.exists(status_file):
+            try:
+                with open(status_file, encoding="utf-8") as f:
+                    file_conds = _json.load(f).get("objects", {})
+            except (OSError, _json.JSONDecodeError):
+                file_conds = None
+            def _view(c: dict) -> dict:
+                return {k: (v.get("status"), v.get("observedChecksum"))
+                        for k, v in c.items()}
+            if file_conds and _view(file_conds) == _view(conditions):
+                conditions = file_conds
+                source = "aigw-status.json"
+            elif file_conds is not None:
+                source = "live (aigw-status.json stale)"
+        if args.json:
+            print(_json.dumps({"source": source, "objects": conditions},
+                              indent=1, sort_keys=True))
+            return 0 if all(c.get("status") == "True"
+                            for c in conditions.values()) else 1
+        bad = 0
+        for key in sorted(conditions):
+            cond = conditions[key]
+            accepted = cond.get("status") == "True"
+            bad += not accepted
+            mark = "Accepted" if accepted else "NOT ACCEPTED"
+            line = f"{mark:13s} {key}"
+            if not accepted:
+                line += f"  [{cond.get('reason', '')}] {cond.get('message', '')}"
+            print(line)
+        print(f"-- {len(conditions)} objects, {bad} not accepted "
+              f"(source: {source})")
+        return 1 if bad else 0
 
     if args.cmd == "healthcheck":
         import json as _json
@@ -400,6 +463,7 @@ async def _run_gateway(args: argparse.Namespace,
                                        reuse_port=reuse_port)
     holder["server"] = server
     if watcher is not None:
+        server.conditions_fn = watcher.not_accepted
         await watcher.start()
     print(f"gateway listening on http://{args.host}:{args.port}", flush=True)
     await _wait_for_signal()

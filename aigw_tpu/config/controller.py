@@ -230,6 +230,18 @@ class Reconciler:
         except OSError as e:
             logger.warning("status write failed: %s", e)
 
+    def conditions(self) -> dict[str, dict[str, Any]]:
+        """Per-object Accepted conditions from the last load() —
+        ``{key: {status, reason, message, ...}}``. Public accessor for
+        the CLI/status surfaces (the reference exposes the same data as
+        `kubectl get` conditions on each object)."""
+        return dict(self._conditions)
+
+    def not_accepted(self) -> dict[str, dict[str, Any]]:
+        """Subset of conditions() whose status is not \"True\"."""
+        return {k: c for k, c in self._conditions.items()
+                if c.get("status") != "True"}
+
     # -- watcher loader ----------------------------------------------------
 
     def load(self) -> Config:

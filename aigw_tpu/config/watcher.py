@@ -41,6 +41,13 @@ class ConfigWatcher:
         self._current: RuntimeConfig | None = None
         self._reconciler: Reconciler | None = None
 
+    def not_accepted(self) -> dict:
+        """Per-object NOT-Accepted conditions from the reconciling
+        control plane (empty when the source isn't a manifest dir)."""
+        if self._reconciler is None:
+            return {}
+        return self._reconciler.not_accepted()
+
     def _load(self) -> Config:
         if is_manifest_dir(self.path):
             if self._reconciler is None:

@@ -25,6 +25,7 @@ in-process engine, keeping the reference's semantics:
 from __future__ import annotations
 
 import fcntl
+import hashlib
 import json
 import os
 import time
@@ -95,7 +96,21 @@ class FileQuotaBackend:
         safe = "".join(
             c if c.isalnum() or c in "-_" else "_" for c in rule_name
         )
-        return os.path.join(self._dir, f"quota_{safe}.json")
+        # short hash of the raw name: sanitization alone would collapse
+        # distinct rules ('a b' vs 'a_b') onto one file, silently merging
+        # their budgets
+        digest = hashlib.sha256(rule_name.encode()).hexdigest()[:8]
+        path = os.path.join(self._dir, f"quota_{safe}_{digest}.json")
+        # one-time migration from the pre-hash filename so live spent
+        # budgets survive an upgrade (rename is atomic; losers of the
+        # race see the file already gone and just use the new path)
+        legacy = os.path.join(self._dir, f"quota_{safe}.json")
+        if not os.path.exists(path) and os.path.exists(legacy):
+            try:
+                os.rename(legacy, path)
+            except OSError:
+                pass
+        return path
 
     @staticmethod
     def _load(f) -> dict:

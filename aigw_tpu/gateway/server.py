@@ -53,7 +53,6 @@ from aigw_tpu.gateway.router import BackendSelector, NoRouteError, match_route
 from aigw_tpu.obs.metrics import GenAIMetrics, RequestMetrics
 from aigw_tpu.obs.tracing import (
     DEFAULT_HEADER_ATTRIBUTES,
-    SpanContext,
     Tracer,
     genai_attributes,
     header_attributes,
@@ -61,6 +60,7 @@ from aigw_tpu.obs.tracing import (
 )
 from aigw_tpu.schemas import anthropic as anth
 from aigw_tpu.schemas import openai as oai
+from aigw_tpu.schemas import typed as typed_schemas
 from aigw_tpu.translate import Endpoint, TranslationError, get_translator
 
 logger = logging.getLogger(__name__)
@@ -136,13 +136,15 @@ def _conversation_affinity_key(body: dict) -> str:
 
 def _multipart_model(raw: bytes, content_type: str) -> str:
     """Extract the `model` form field from a multipart body without
-    touching the (possibly large) audio parts."""
-    import re as _re
+    touching the (possibly large) audio parts. Boundary parsing is
+    shared with the rewrite path (translate/multipart.py) so the
+    extract and rewrite sides can never disagree on the framing."""
+    from aigw_tpu.translate.multipart import parse_multipart_boundary
 
-    m = _re.search(r'boundary="?([^";,]+)"?', content_type)
-    if not m:
+    b = parse_multipart_boundary(content_type)
+    if not b:
         return ""
-    boundary = b"--" + m.group(1).encode()
+    boundary = b"--" + b.encode()
     for part in raw.split(boundary):
         header_end = part.find(b"\r\n\r\n")
         if header_end < 0:
@@ -201,6 +203,9 @@ class GatewayServer:
         self._oi_config = OITraceConfig.from_env()
         self.access_log = AccessLogger()
         self.circuit = CircuitBreaker()
+        #: optional () -> {key: condition} of NOT-Accepted objects, wired
+        #: by the CLI when the config source is a reconciled manifest dir
+        self.conditions_fn = None
         self._session: aiohttp.ClientSession | None = None
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
         for path in _ENDPOINTS:
@@ -309,11 +314,20 @@ class GatewayServer:
 
     # -- admin endpoints --------------------------------------------------
     async def _handle_health(self, _request: web.Request) -> web.Response:
-        return web.json_response({
+        payload = {
             "status": "ok",
             "uuid": self._runtime.config.uuid,
             "circuit": self.circuit.snapshot(),
-        })
+        }
+        # reconciling control plane: surface quarantined objects so an
+        # operator doesn't have to know to cat aigw-status.json (the
+        # reference shows the same conditions via `kubectl get`)
+        if self.conditions_fn is not None:
+            bad = self.conditions_fn()
+            payload["objects_not_accepted"] = len(bad)
+            if bad:
+                payload["not_accepted"] = sorted(bad)
+        return web.json_response(payload)
 
     async def _handle_metrics(self, _request: web.Request) -> web.Response:
         return web.Response(body=self.metrics.export(),
@@ -452,10 +466,13 @@ class GatewayServer:
             try:
                 body = oai.parse_json_body(raw)
                 model = oai.request_model(body)
-                if endpoint is Endpoint.CHAT_COMPLETIONS:
-                    oai.validate_chat_request(body)
-                elif endpoint is Endpoint.MESSAGES:
+                if endpoint is Endpoint.MESSAGES:
                     anth.validate_messages_request(body)
+                else:
+                    # typed per-endpoint schemas incl. chat vendor fields
+                    # (schemas/typed.py; reference apischema rejects
+                    # malformed bodies before any upstream traffic)
+                    typed_schemas.validate_request(endpoint.value, body)
             except oai.SchemaError as e:
                 self._log_rejection(request, 400, started,
                                     reason="invalid_request")
@@ -494,7 +511,8 @@ class GatewayServer:
         # processor_impl.go:289-295)
         span = None
         if self.tracer.enabled:
-            parent = SpanContext.parse(client_headers.get("traceparent", ""))
+            # OTEL_PROPAGATORS-configured extraction (W3C + B3 variants)
+            parent = self.tracer.propagators.extract(client_headers)
             span = self.tracer.start_span(f"{operation} {model}", parent)
             span.attributes.update(
                 header_attributes(client_headers, self._header_attrs)
@@ -579,6 +597,9 @@ class GatewayServer:
             if endpoint is Endpoint.COMPLETIONS:
                 return oi.completion_request_attributes(
                     body, raw, self._oi_config)
+            if endpoint is Endpoint.RERANK:
+                return oi.rerank_request_attributes(
+                    body, raw, self._oi_config)
         except Exception:  # noqa: BLE001 — telemetry must never 500
             logger.debug("openinference request attrs failed",
                          exc_info=True)
@@ -595,6 +616,7 @@ class GatewayServer:
             Endpoint.MESSAGES: oi.anthropic_response_attributes,
             Endpoint.EMBEDDINGS: oi.embeddings_response_attributes,
             Endpoint.COMPLETIONS: oi.completion_response_attributes,
+            Endpoint.RERANK: oi.rerank_response_attributes,
         }.get(endpoint)
 
     def _openinference_response_attrs(
@@ -714,10 +736,23 @@ class GatewayServer:
                     f"?api-version="
                     f"{backend.schema.version or DEFAULT_API_VERSION}"
                 )
-            tx = _RequestTx(body=body.raw, path=path)
-            out_body = tx.body
+            out_body = body.raw
+            out_ctype = body.content_type
+            if (backend.model_name_override
+                    and backend.model_name_override != body.model):
+                # the reference rewrites the model form field when the
+                # backend overrides the model name, every other part
+                # verbatim (multipart_helper.go:16-66)
+                from aigw_tpu.translate.multipart import (
+                    rewrite_multipart_model,
+                )
+
+                out_body, out_ctype = rewrite_multipart_model(
+                    body.raw, body.content_type,
+                    backend.model_name_override)
+            tx = _RequestTx(body=out_body, path=path)
             headers = {
-                "content-type": body.content_type,
+                "content-type": out_ctype,
                 "accept": "application/json",
             }
         else:
@@ -769,7 +804,7 @@ class GatewayServer:
                 "missing url")
         headers.update(tx.headers)
         if span is not None:
-            headers["traceparent"] = span.context.traceparent()
+            self.tracer.propagators.inject(span.context, headers)
         headers = apply_header_mutation(headers, backend.header_mutation)
         import urllib.parse as _up
 

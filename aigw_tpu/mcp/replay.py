@@ -126,7 +126,13 @@ class _FileBuffer:
         for line in f.read().decode("utf-8", "replace").splitlines():
             sid, _, b64 = line.partition(" ")
             try:
-                events.append((int(sid), base64.b64decode(b64)))
+                payload = base64.b64decode(b64)
+                # a healed torn line whose fragment is only an id decodes
+                # to an empty payload (b64decode(b'') succeeds) — don't
+                # replay it as a phantom empty event
+                if not payload:
+                    continue
+                events.append((int(sid), payload))
             except ValueError:
                 continue  # torn line (crash mid-write): skip
         return events

@@ -502,6 +502,81 @@ def completion_response_attributes(
     return attrs
 
 
+SPAN_KIND_RERANKER = "RERANKER"
+LLM_SYSTEM_COHERE = "cohere"
+RERANKER_MODEL_NAME = "reranker.model_name"
+RERANKER_QUERY = "reranker.query"
+RERANKER_TOP_K = "reranker.top_k"
+
+
+def reranker_input_doc_attr(i: int) -> str:
+    """Flattened input-document key (openinference/rerank.go:45-49)."""
+    return f"reranker.input_documents.{i}.document.content"
+
+
+def reranker_output_doc_attr(i: int) -> str:
+    return f"reranker.output_documents.{i}.document.score"
+
+
+def rerank_request_attributes(
+    req: dict[str, Any], raw: str | bytes, cfg: TraceConfig
+) -> dict[str, Any]:
+    """Cohere /v2/rerank request → OpenInference RERANKER attrs
+    (reference openinference/cohere/rerank.go:84-123)."""
+    attrs: dict[str, Any] = {
+        LLM_SYSTEM: LLM_SYSTEM_COHERE,
+        SPAN_KIND: SPAN_KIND_RERANKER,
+    }
+    if req.get("model"):
+        attrs[RERANKER_MODEL_NAME] = str(req["model"])
+    if req.get("top_n") is not None:
+        attrs[RERANKER_TOP_K] = int(req["top_n"])
+    if req.get("query"):
+        attrs[RERANKER_QUERY] = str(req["query"])
+    if cfg.hide_inputs:
+        attrs[INPUT_VALUE] = REDACTED
+    else:
+        attrs[INPUT_VALUE] = (
+            raw.decode("utf-8", "replace")
+            if isinstance(raw, bytes) else raw
+        )
+        attrs[INPUT_MIME_TYPE] = MIME_TYPE_JSON
+        for i, doc in enumerate(req.get("documents") or ()):
+            text = doc if isinstance(doc, str) else (
+                doc.get("text", "") if isinstance(doc, dict) else "")
+            if text:
+                attrs[reranker_input_doc_attr(i)] = text
+    return attrs
+
+
+def rerank_response_attributes(
+    resp: dict[str, Any], cfg: TraceConfig
+) -> dict[str, Any]:
+    """Cohere /v2/rerank response → attrs (rerank.go:125-154): per-result
+    relevance scores as output documents; token counts survive
+    hide_outputs."""
+    attrs: dict[str, Any] = {}
+    if cfg.hide_outputs:
+        attrs[OUTPUT_VALUE] = REDACTED
+    else:
+        attrs[OUTPUT_VALUE] = json.dumps(resp)
+        attrs[OUTPUT_MIME_TYPE] = MIME_TYPE_JSON
+        for i, res in enumerate(resp.get("results") or ()):
+            if isinstance(res, dict) and "relevance_score" in res:
+                attrs[reranker_output_doc_attr(i)] = float(
+                    res["relevance_score"])
+    tokens = ((resp.get("meta") or {}).get("tokens") or {})
+    inp = tokens.get("input_tokens")
+    out = tokens.get("output_tokens")
+    if inp:
+        attrs[LLM_TOKEN_COUNT_PROMPT] = int(inp)
+    if out:
+        attrs[LLM_TOKEN_COUNT_COMPLETION] = int(out)
+    if inp or out:
+        attrs[LLM_TOKEN_COUNT_TOTAL] = int(inp or 0) + int(out or 0)
+    return attrs
+
+
 class StreamAccumulator:
     """Reconstructs a response dict from front-schema SSE bytes so
     streamed requests get the same output attributes as unary ones

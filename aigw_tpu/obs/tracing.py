@@ -83,6 +83,87 @@ class Span:
             self._tracer._export(self)
 
 
+class Propagators:
+    """Context propagation per ``OTEL_PROPAGATORS`` (reference
+    tracing.go uses contrib autoprop, same env contract): comma list of
+    ``tracecontext`` (W3C traceparent), ``b3`` (single header),
+    ``b3multi`` (X-B3-* headers). Default matches the OTel SDK:
+    ``tracecontext,baggage`` (baggage is a no-op here). Extraction tries
+    each configured propagator in order; injection writes all of them."""
+
+    def __init__(self, spec: str = ""):
+        spec = spec or os.environ.get("OTEL_PROPAGATORS",
+                                      "tracecontext,baggage")
+        self.names = [p.strip().lower() for p in spec.split(",")
+                      if p.strip() and p.strip().lower() != "baggage"]
+        if not self.names:
+            self.names = ["tracecontext"]
+
+    def extract(self, headers: dict[str, str]) -> "SpanContext | None":
+        for name in self.names:
+            ctx = None
+            if name == "tracecontext":
+                ctx = SpanContext.parse(headers.get("traceparent", ""))
+            elif name == "b3":
+                ctx = self._parse_b3_single(headers.get("b3", ""))
+            elif name == "b3multi":
+                ctx = self._parse_b3_multi(headers)
+            if ctx is not None:
+                return ctx
+        return None
+
+    def inject(self, ctx: "SpanContext", headers: dict[str, str]) -> None:
+        for name in self.names:
+            if name == "tracecontext":
+                headers["traceparent"] = ctx.traceparent()
+            elif name == "b3":
+                headers["b3"] = (
+                    f"{ctx.trace_id}-{ctx.span_id}-"
+                    f"{'1' if ctx.sampled else '0'}"
+                )
+            elif name == "b3multi":
+                headers["x-b3-traceid"] = ctx.trace_id
+                headers["x-b3-spanid"] = ctx.span_id
+                headers["x-b3-sampled"] = "1" if ctx.sampled else "0"
+
+    @staticmethod
+    def _hex_id(value: str, width: int) -> str:
+        """Lowercased id iff exactly ``width`` hex chars (64-bit B3
+        trace ids are left-padded first); "" otherwise. Ids flow into
+        protobuf export via bytes.fromhex, so non-hex input must be
+        rejected here, not crash the flusher."""
+        value = value.strip().lower()
+        if width == 32 and len(value) == 16:
+            value = "0" * 16 + value
+        if len(value) != width or not all(
+                c in "0123456789abcdef" for c in value):
+            return ""
+        return value
+
+    @classmethod
+    def _parse_b3_single(cls, value: str) -> "SpanContext | None":
+        parts = value.strip().split("-")
+        if len(parts) < 2:
+            return None
+        trace_id = cls._hex_id(parts[0], 32)
+        span_id = cls._hex_id(parts[1], 16)
+        if not trace_id or not span_id:
+            return None
+        sampled = len(parts) < 3 or parts[2] not in ("0", "false")
+        return SpanContext(trace_id=trace_id, span_id=span_id,
+                           sampled=sampled)
+
+    @classmethod
+    def _parse_b3_multi(cls, headers: dict[str, str]) -> "SpanContext | None":
+        trace_id = cls._hex_id(headers.get("x-b3-traceid", ""), 32)
+        span_id = cls._hex_id(headers.get("x-b3-spanid", ""), 16)
+        if not trace_id or not span_id:
+            return None
+        sampled = headers.get("x-b3-sampled", "1") not in ("0", "false")
+        return SpanContext(trace_id=trace_id, span_id=span_id,
+                           sampled=sampled)
+
+
 class Tracer:
     """Span factory + background exporter."""
 
@@ -99,6 +180,15 @@ class Tracer:
         self.endpoint = os.environ.get(
             "OTEL_EXPORTER_OTLP_ENDPOINT", "http://127.0.0.1:4318"
         ).rstrip("/")
+        # standard OTLP protocol selection (the SDK's env contract):
+        # protobuf is the default a stock collector expects; http/json
+        # kept for the round-1..3 consumers
+        self.protocol = os.environ.get(
+            "OTEL_EXPORTER_OTLP_TRACES_PROTOCOL",
+            os.environ.get("OTEL_EXPORTER_OTLP_PROTOCOL",
+                           "http/protobuf"),
+        ).lower()
+        self.propagators = Propagators()
         self._q: "queue.Queue[Span]" = queue.Queue(maxsize=4096)
         self._flusher: threading.Thread | None = None
         if self.exporter == "otlp":
@@ -164,12 +254,19 @@ class Tracer:
                     spans.append(self._q.get_nowait())
             except queue.Empty:
                 pass
-            payload = self._otlp_payload(spans)
             try:
+                if self.protocol == "http/json":
+                    data = json.dumps(self._otlp_payload(spans)).encode()
+                    ctype = "application/json"
+                else:  # http/protobuf — the standard default
+                    from aigw_tpu.obs.otlp_proto import encode_traces
+
+                    data = encode_traces(spans, self.service_name)
+                    ctype = "application/x-protobuf"
                 req = urllib.request.Request(
                     f"{self.endpoint}/v1/traces",
-                    data=json.dumps(payload).encode(),
-                    headers={"content-type": "application/json"},
+                    data=data,
+                    headers={"content-type": ctype},
                 )
                 urllib.request.urlopen(req, timeout=5)
             except Exception:  # noqa: BLE001 — telemetry must never crash

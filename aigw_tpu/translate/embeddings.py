@@ -14,6 +14,7 @@ from typing import Any
 from aigw_tpu.config.model import APISchemaName
 from aigw_tpu.gateway.costs import TokenUsage
 from aigw_tpu.schemas import openai as oai
+from aigw_tpu.translate import vendor_fields
 from aigw_tpu.translate.base import (
     Endpoint,
     RequestTx,
@@ -33,6 +34,46 @@ def _inputs(body: dict[str, Any]) -> list[str]:
     raise TranslationError("embeddings input must be a string or string array")
 
 
+def _input_items(body: dict[str, Any]) -> list[dict[str, Any]]:
+    """Input union → [{content, task_type?, title?}] items. Supports the
+    reference's object form carrying per-item task_type/title
+    (openai.go:408-432 EmbeddingInputItem) plus plain string forms;
+    request-level vendor fields (openai.go:1840-1854) fill the defaults."""
+    defaults = vendor_fields.gcp_embedding_vendor(body)
+    raw = body.get("input")
+    items: list[dict[str, Any]] = []
+
+    def push(content: str, task_type: str = "", title: str = "") -> None:
+        items.append({
+            "content": content,
+            "task_type": task_type or defaults.get("task_type", ""),
+            "title": title or defaults.get("title", ""),
+        })
+
+    if isinstance(raw, str):
+        push(raw)
+    elif isinstance(raw, list):
+        for x in raw:
+            if isinstance(x, str):
+                push(x)
+            elif isinstance(x, dict):
+                content = x.get("content")
+                texts = [content] if isinstance(content, str) else content
+                if not isinstance(texts, list):
+                    raise TranslationError(
+                        "embedding input object content must be a string "
+                        "or string array")
+                for t in texts:
+                    push(str(t), x.get("task_type", ""), x.get("title", ""))
+            else:
+                raise TranslationError(
+                    "embeddings input must be strings or content objects")
+    else:
+        raise TranslationError(
+            "embeddings input must be a string or array")
+    return items
+
+
 class OpenAIToVertexEmbeddings(Translator):
     """OpenAI /v1/embeddings → Vertex text-embedding ``:predict``."""
 
@@ -42,7 +83,23 @@ class OpenAIToVertexEmbeddings(Translator):
 
     def request(self, body: dict[str, Any]) -> RequestTx:
         self._model = self._override or oai.request_model(body)
-        out = {"instances": [{"content": text} for text in _inputs(body)]}
+        instances = []
+        for item in _input_items(body):
+            inst: dict[str, Any] = {"content": item["content"]}
+            # vendor fields on the predict wire: instances[].task_type /
+            # title, parameters.auto_truncate (openai.go:1841-1843)
+            if item["task_type"]:
+                inst["task_type"] = item["task_type"]
+            if item["title"]:
+                inst["title"] = item["title"]
+            instances.append(inst)
+        out: dict[str, Any] = {"instances": instances}
+        vendor = vendor_fields.gcp_embedding_vendor(body)
+        if "auto_truncate" in vendor:
+            out["parameters"] = {"auto_truncate": vendor["auto_truncate"]}
+        if body.get("dimensions"):
+            out.setdefault("parameters", {})["outputDimensionality"] = int(
+                body["dimensions"])
         path = (
             "/v1/projects/{GCP_PROJECT}/locations/{GCP_REGION}"
             f"/publishers/google/models/{self._model}:predict"

@@ -25,6 +25,7 @@ from aigw_tpu.translate.base import (
     Translator,
     register_translator,
 )
+from aigw_tpu.translate import vendor_fields
 from aigw_tpu.translate.sse import SSEEvent, SSEParser
 from aigw_tpu.translate.structured import parse_response_format
 
@@ -240,6 +241,12 @@ class OpenAIToAnthropicChat(Translator):
                 raise TranslationError(
                     f"unsupported reasoning effort level: {effort!r}")
             out.setdefault("output_config", {})["effort"] = effort
+        # proposal-004 vendor field: thinking union → Messages thinking
+        # param (anthropic_helper.go:577-607, applied at :762); shared by
+        # the GCP/AWS-hosted subclasses
+        thinking = vendor_fields.thinking_to_anthropic(body)
+        if thinking is not None:
+            out["thinking"] = thinking
         if self._stream:
             out["stream"] = True
         if isinstance(body.get("metadata"), dict) and body["metadata"].get("user_id"):

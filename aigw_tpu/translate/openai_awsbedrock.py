@@ -24,6 +24,7 @@ from aigw_tpu.translate.base import (
     Translator,
     register_translator,
 )
+from aigw_tpu.translate import vendor_fields
 from aigw_tpu.translate.eventstream import EventStreamParser
 from aigw_tpu.translate.sse import SSEEvent
 from aigw_tpu.translate.structured import (
@@ -193,6 +194,11 @@ class OpenAIToBedrockChat(Translator):
             inference["stopSequences"] = [stop] if isinstance(stop, str) else list(stop)
         if inference:
             out["inferenceConfig"] = inference
+        # proposal-004 vendor field: thinking union → Converse
+        # additionalModelRequestFields (openai_awsbedrock.go:57-90,:142-146)
+        amrf = vendor_fields.thinking_to_bedrock(body)
+        if amrf is not None:
+            out["additionalModelRequestFields"] = amrf
         tools = body.get("tools")
         # tool_choice "none" means the model must not call tools; Converse
         # has no NONE mode, so omit toolConfig entirely.

@@ -24,6 +24,7 @@ from aigw_tpu.translate.base import (
     Translator,
     register_translator,
 )
+from aigw_tpu.translate import vendor_fields
 from aigw_tpu.translate.sse import SSEEvent, SSEParser
 from aigw_tpu.translate.structured import (
     JSONSchemaError,
@@ -228,6 +229,10 @@ class OpenAIToGeminiChat(Translator):
             gen["responseLogprobs"] = bool(body["logprobs"])
         self._want_logprobs = bool(body.get("logprobs"))
         self._apply_output_format(body, gen)
+        # proposal-004 vendor fields: thinking → thinkingConfig, vendor
+        # generationConfig/safetySettings override translated fields
+        # (openai_gcpvertexai.go:498-594)
+        vendor_fields.apply_gcp_chat_vendor(body, out, gen)
         if gen:
             out["generationConfig"] = gen
         tools = body.get("tools")

@@ -129,9 +129,71 @@ register_translator(
     Endpoint.TOKENIZE, APISchemaName.OPENAI, APISchemaName.GCP_ANTHROPIC,
     _vertex_count_factory,
 )
-# AWS-hosted Anthropic exposes no count-tokens API through Bedrock invoke;
-# leaving the pair unregistered yields a clear TranslationError instead of
-# a wrong upstream URL.
+
+
+class TokenizeToBedrockAnthropicCount(Translator):
+    """vLLM /tokenize → AWS Bedrock CountTokens API
+    (tokenize_awsanthropic.go:29-215): the Anthropic Messages body —
+    anthropic_version set, max_tokens=1 added because Bedrock validates
+    the inner body as a real request, model dropped (it rides the URL) —
+    is base64-wrapped as ``{"input":{"invokeModel":{"body": ...}}}`` and
+    POSTed to ``/model/{model}/count-tokens``. CountTokens rejects
+    cross-region-inference model IDs, so any geography prefix before the
+    ``anthropic.`` provider segment is stripped (:108-116)."""
+
+    def __init__(self, *, model_name_override: str = "", **_: object):
+        self._override = model_name_override
+
+    def request(self, body: dict[str, Any]) -> RequestTx:
+        import base64
+        import urllib.parse
+
+        from aigw_tpu.translate.anthropic_hosted import (
+            BEDROCK_ANTHROPIC_VERSION,
+        )
+        from aigw_tpu.translate.openai_anthropic import (
+            openai_messages_to_anthropic,
+        )
+
+        model = self._override or oai.request_model(body)
+        system, messages = openai_messages_to_anthropic(
+            _tokenize_messages(body))
+        inner: dict[str, Any] = {
+            "messages": messages,
+            "anthropic_version": BEDROCK_ANTHROPIC_VERSION,
+            "max_tokens": 1,
+        }
+        if system:
+            inner["system"] = system
+        path_model = model
+        i = path_model.find("anthropic.")
+        if i > 0:  # CRIS geography prefix (us./eu./apac./us-gov.)
+            path_model = path_model[i:]
+        out = {"input": {"invokeModel": {"body": base64.b64encode(
+            json.dumps(inner).encode()).decode()}}}
+        return RequestTx(
+            body=json.dumps(out).encode(),
+            path=f"/model/{urllib.parse.quote(path_model, safe='')}"
+                 f"/count-tokens",
+        )
+
+    def response_body(self, chunk: bytes, end_of_stream: bool) -> ResponseTx:
+        if not end_of_stream:
+            return ResponseTx()
+        try:
+            data = json.loads(chunk)
+        except json.JSONDecodeError as e:
+            raise TranslationError(f"invalid upstream JSON: {e}") from None
+        count = int(data.get("inputTokens", 0) or 0)
+        out = {"count": count, "max_model_len": None, "tokens": []}
+        usage = TokenUsage(input_tokens=count, total_tokens=count)
+        return ResponseTx(body=json.dumps(out).encode(), usage=usage)
+
+
+register_translator(
+    Endpoint.TOKENIZE, APISchemaName.OPENAI, APISchemaName.AWS_ANTHROPIC,
+    TokenizeToBedrockAnthropicCount,
+)
 register_translator(
     Endpoint.TOKENIZE,
     APISchemaName.OPENAI,

@@ -1,30 +1,54 @@
 """Round benchmark — prints ONE JSON line.
 
-Headline: the BASELINE.json north star measured on the real chip —
-continuous-batching engine decode throughput for **Llama-3-8B
-architecture, W8A16 int8, batch 8, paged KV** (random weights:
-throughput is weight-value-agnostic), plus TTFT. ``vs_baseline`` is the
-engine / raw-JAX-decode-ceiling ratio for the same model — the "≥90% of
-raw JAX tokens/sec" criterion. The raw ceiling is the best raw loop we
-can write: a K-step ``lax.scan`` inside one jit (single-step dispatch
-pays ~8ms/step of tunnel latency and would flatter the engine).
+Headline (round 4+): the BASELINE.json north star measured end to end —
+**tokens/sec through the gateway**: `aigw run` (real CLI subprocess) in
+front of the tpuserve engine, driven over streaming
+`/v1/chat/completions`, for Llama-3-8B architecture W8A16 int8, batch 8,
+paged KV (random weights: throughput is weight-value-agnostic).
+``vs_baseline`` is gateway / raw-JAX-decode-ceiling — the "≥90% of raw
+JAX tokens/sec **through the gateway**" criterion — and ``ttft_ms_p50``
+is time-to-first-token at the HTTP surface (the "<200ms" criterion).
+The engine-only row (round 1-3's headline) is kept as
+``engine_tokens_per_sec`` / ``engine_vs_raw``.
+
+The raw ceiling is the best raw loop we can write: a K-step ``lax.scan``
+inside one jit (single-step dispatch pays ~8ms/step of tunnel latency
+and would flatter the engine).
 
 Falls back to a 1.1B bf16 llama-arch model when the 8B int8 model
-doesn't fit the chip, and prints an honest zero when the TPU tunnel is
-unresponsive (watchdog probe).
+doesn't fit the chip. When the TPU tunnel is unresponsive (watchdog
+probe), reports the latest persisted on-chip run; failing that, a
+CPU-backend gateway/raw ratio with honest labeling (the ratio harness is
+chip-independent; only absolute tok/s needs the chip) via
+``--cpu-gateway-ratio`` in a JAX_PLATFORMS=cpu subprocess.
 
-    {"metric": "...", "value": engine_tokens_per_sec, "unit": "tokens/s",
-     "vs_baseline": engine/raw_ceiling, "ttft_ms_p50": ...}
+    {"metric": "...", "value": gateway_tokens_per_sec, "unit": "tokens/s",
+     "vs_baseline": gateway/raw_ceiling, "ttft_ms_p50": ...,
+     "engine_tokens_per_sec": ..., "engine_vs_raw": ...}
 """
 
 from __future__ import annotations
 
+import asyncio
+import gc
 import json
+import os
+import socket
+import subprocess
 import sys
 import threading
 import time
 
 import jax
+
+# The axon sitecustomize re-applies JAX_PLATFORMS=axon even when the
+# environment says cpu (see tests/conftest.py); config.update after
+# import is the only override that sticks. Without this, CPU-ratio mode
+# hangs forever dialing the dead TPU tunnel.
+if "--cpu-gateway-ratio" in sys.argv or os.environ.get(
+        "JAX_PLATFORMS", "") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 
 from aigw_tpu.models import llama
@@ -35,6 +59,12 @@ FALLBACK_CFG = llama.LlamaConfig(
     vocab_size=32000, dim=2048, n_layers=16, n_heads=16, n_kv_heads=8,
     ffn_dim=8192, max_seq_len=1024, rope_theta=500000.0,
 )
+# CPU-ratio model: small enough that a full gateway→engine run finishes
+# in minutes on the host; the gateway/raw *ratio* is what transfers.
+CPU_CFG = llama.LlamaConfig(
+    vocab_size=8192, dim=512, n_layers=4, n_heads=8, n_kv_heads=4,
+    ffn_dim=1536, max_seq_len=512, rope_theta=10000.0,
+)
 BATCH = 8
 PAGE = 128
 PROMPT_LEN = 128
@@ -42,26 +72,27 @@ GEN_TOKENS = 128
 K_STEPS = 16  # matches EngineConfig.decode_steps_per_tick below
 
 
-def raw_ceiling_tokens_per_sec(params, cfg) -> float:
+def raw_ceiling_tokens_per_sec(params, cfg, batch=BATCH,
+                               prompt_len=PROMPT_LEN) -> float:
     """The ceiling: K decode steps scanned inside one jit — bare model
     math + sampling with dispatch fully amortized; no scheduler, no
     paging bookkeeping, no HTTP."""
     from jax import lax
 
-    ecfg = EngineConfig(max_batch_size=BATCH, max_seq_len=cfg.max_seq_len,
+    ecfg = EngineConfig(max_batch_size=batch, max_seq_len=cfg.max_seq_len,
                         page_size=PAGE)
     kv = jnp.zeros(
         (cfg.n_layers, 2, ecfg.num_pages * PAGE, cfg.n_kv_heads,
          cfg.head_dim), jnp.bfloat16,
     )
-    pt = jnp.arange(BATCH * ecfg.max_pages_per_seq, dtype=jnp.int32).reshape(
-        BATCH, ecfg.max_pages_per_seq
+    pt = jnp.arange(batch * ecfg.max_pages_per_seq, dtype=jnp.int32).reshape(
+        batch, ecfg.max_pages_per_seq
     )
-    active = jnp.ones((BATCH,), bool)
-    keys = jnp.zeros((BATCH, 2), jnp.uint32)
-    temp = jnp.zeros((BATCH,), jnp.float32)
-    top_p = jnp.ones((BATCH,), jnp.float32)
-    top_k = jnp.zeros((BATCH,), jnp.int32)
+    active = jnp.ones((batch,), bool)
+    keys = jnp.zeros((batch, 2), jnp.uint32)
+    temp = jnp.zeros((batch,), jnp.float32)
+    top_p = jnp.ones((batch,), jnp.float32)
+    top_k = jnp.zeros((batch,), jnp.int32)
 
     def kstep(params, tokens, positions, kv):
         def body(carry, _):
@@ -78,8 +109,8 @@ def raw_ceiling_tokens_per_sec(params, cfg) -> float:
         return tokens, positions, kv
 
     kstep = jax.jit(kstep, donate_argnums=(3,))
-    tokens = jnp.ones((BATCH,), jnp.int32)
-    positions = jnp.full((BATCH,), PROMPT_LEN, jnp.int32)
+    tokens = jnp.ones((batch,), jnp.int32)
+    positions = jnp.full((batch,), prompt_len, jnp.int32)
 
     tokens, positions, kv = kstep(params, tokens, positions, kv)  # compile
     jax.block_until_ready(tokens)
@@ -91,35 +122,36 @@ def raw_ceiling_tokens_per_sec(params, cfg) -> float:
             tokens, positions, kv = kstep(params, tokens, positions, kv)
         jax.block_until_ready(tokens)
         dt = time.perf_counter() - t0
-        best = max(best, BATCH * K_STEPS * n_ticks / dt)
+        best = max(best, batch * K_STEPS * n_ticks / dt)
     return best
 
 
-def engine_numbers(params, cfg) -> tuple[float, float]:
-    """The product: same decode through the continuous-batching engine.
-    Returns (tokens/sec, ttft_ms p50 over the batch)."""
+def engine_numbers(params, cfg, batch=BATCH, prompt_len=PROMPT_LEN,
+                   gen_tokens=GEN_TOKENS) -> tuple[float, float]:
+    """The engine row: same decode through the continuous-batching engine
+    (no HTTP). Returns (tokens/sec, ttft_ms p50 over the batch)."""
     eng = Engine(
         params,
         cfg,
-        EngineConfig(max_batch_size=BATCH,
+        EngineConfig(max_batch_size=batch,
                      max_seq_len=cfg.max_seq_len, page_size=PAGE,
                      decode_steps_per_tick=K_STEPS),
     )
     eng.start()
     try:
         eng.warmup()
-        # warm the prefill bucket for PROMPT_LEN
+        # warm the prefill bucket for prompt_len
         done = threading.Event()
         eng.submit(GenRequest(
-            prompt=[1] * PROMPT_LEN, max_tokens=2,
+            prompt=[1] * prompt_len, max_tokens=2,
             sampling=SamplingParams(temperature=0.0),
             emit=lambda t, f: done.set() if f else None,
         ))
         done.wait(timeout=600)
 
-        dones = [threading.Event() for _ in range(BATCH)]
-        counts = [0] * BATCH
-        first_at = [0.0] * BATCH
+        dones = [threading.Event() for _ in range(batch)]
+        counts = [0] * batch
+        first_at = [0.0] * batch
 
         def mk(i):
             def emit(tok, fin):
@@ -132,9 +164,9 @@ def engine_numbers(params, cfg) -> tuple[float, float]:
             return emit
 
         t0 = time.perf_counter()
-        for i in range(BATCH):
+        for i in range(batch):
             eng.submit(GenRequest(
-                prompt=[1 + i] * PROMPT_LEN, max_tokens=GEN_TOKENS,
+                prompt=[1 + i] * prompt_len, max_tokens=gen_tokens,
                 sampling=SamplingParams(temperature=0.0), emit=mk(i),
             ))
         for d in dones:
@@ -145,6 +177,232 @@ def engine_numbers(params, cfg) -> tuple[float, float]:
         return sum(counts) / dt, ttft_p50
     finally:
         eng.stop()
+
+
+# -- through-the-gateway leg (the north star's numerator) -----------------
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _start_tpuserve(model_name: str, cfg, quantize: str, batch: int):
+    """Serve `model_name` (registered on the fly, random weights) over
+    the real tpuserve HTTP surface in a background thread. Returns
+    (base_url, stop_fn)."""
+    from aiohttp import web
+
+    from aigw_tpu.models.registry import (
+        ModelSpec,
+        _REGISTRY,
+        register_model,
+    )
+    from aigw_tpu.tpuserve.server import TPUServeServer
+
+    if model_name not in _REGISTRY:
+        register_model(ModelSpec(model_name, "llama", cfg))
+
+    holder: dict = {}
+    started = threading.Event()
+    stopping = threading.Event()
+
+    def run():
+        async def main():
+            server = TPUServeServer(
+                model=model_name,
+                engine_cfg=EngineConfig(
+                    max_batch_size=batch, max_seq_len=cfg.max_seq_len,
+                    page_size=PAGE, decode_steps_per_tick=K_STEPS,
+                ),
+                quantize=quantize,
+            )
+            runner = web.AppRunner(server.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            holder["port"] = site._server.sockets[0].getsockname()[1]
+            started.set()
+            while not stopping.is_set():
+                await asyncio.sleep(0.2)
+            await runner.cleanup()
+
+        asyncio.run(main())
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    if not started.wait(timeout=1200):
+        raise RuntimeError("tpuserve failed to start within 20min")
+
+    def stop():
+        stopping.set()
+        t.join(timeout=30)
+
+    return f"http://127.0.0.1:{holder['port']}", stop
+
+
+def _start_gateway(upstream_url: str):
+    """`aigw run` (the real CLI) in a subprocess, routing everything to
+    the tpuserve upstream. Forced onto the CPU JAX backend so it can
+    never contend for the TPU the engine holds. Returns (url, proc,
+    cfg_path)."""
+    import tempfile
+
+    import yaml
+
+    cfg = {
+        "version": "v1",
+        "backends": [
+            {"name": "tpuserve", "schema": "OpenAI", "url": upstream_url},
+        ],
+        "routes": [
+            {"name": "bench", "rules": [{"backends": ["tpuserve"]}]},
+        ],
+    }
+    f = tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False)
+    yaml.safe_dump(cfg, f)
+    f.close()
+    port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "aigw_tpu", "run", f.name,
+         "--port", str(port)],
+        cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
+    )
+    return f"http://127.0.0.1:{port}", proc, f.name
+
+
+async def _wait_health(url: str, timeout_s: float = 60.0) -> None:
+    import aiohttp
+
+    deadline = time.time() + timeout_s
+    async with aiohttp.ClientSession() as s:
+        while time.time() < deadline:
+            try:
+                async with s.get(url + "/health") as r:
+                    if r.status == 200:
+                        return
+            except aiohttp.ClientError:
+                pass
+            await asyncio.sleep(0.3)
+    raise RuntimeError(f"{url}/health never came up")
+
+
+async def _drive_stream(url: str, model: str, batch: int, prompt_len: int,
+                        gen_tokens: int, tag: str = "") -> tuple[float, float]:
+    """batch concurrent streaming chats; returns (tokens/sec, ttft_ms_p50).
+    TTFT = first content delta on the wire; tok/s = usage-reported
+    completion tokens / wall clock for the whole batch. ``tag`` makes
+    prompts unique per leg — the engine's refcounted prefix cache would
+    otherwise let the second leg reuse the first leg's prefill pages and
+    invert the direct-vs-gateway comparison."""
+    import aiohttp
+
+    ttfts: list[float] = []
+    totals: list[int] = []
+
+    async def one(s: aiohttp.ClientSession, i: int, t0: float) -> None:
+        body = (tag + chr(65 + i % 26)) * prompt_len
+        payload = {
+            "model": model,
+            "messages": [
+                {"role": "user", "content": body[:prompt_len]}
+            ],
+            "max_tokens": gen_tokens,
+            "temperature": 0.0,
+            "stream": True,
+            "stream_options": {"include_usage": True},
+        }
+        first = None
+        usage = None
+        ntok = 0
+        async with s.post(url + "/v1/chat/completions",
+                          json=payload) as resp:
+            body_preview = b""
+            if resp.status != 200:
+                body_preview = await resp.read()
+            assert resp.status == 200, (resp.status, body_preview[:500])
+            while True:
+                line = await resp.content.readline()
+                if not line:
+                    break
+                line = line.strip()
+                if not line.startswith(b"data: "):
+                    continue
+                data = line[6:]
+                if data == b"[DONE]":
+                    break
+                ev = json.loads(data)
+                if ev.get("usage"):
+                    usage = ev["usage"]
+                ch = ev.get("choices") or []
+                if ch and (ch[0].get("delta") or {}).get("content"):
+                    if first is None:
+                        first = (time.perf_counter() - t0) * 1000.0
+                    ntok += 1
+        if first is not None:
+            ttfts.append(first)
+        totals.append((usage or {}).get("completion_tokens") or ntok)
+
+    timeout = aiohttp.ClientTimeout(total=1200)
+    async with aiohttp.ClientSession(timeout=timeout) as s:
+        t0 = time.perf_counter()
+        await asyncio.gather(*(one(s, i, t0) for i in range(batch)))
+        wall = time.perf_counter() - t0
+    ttfts.sort()
+    p50 = ttfts[len(ttfts) // 2] if ttfts else -1.0
+    return sum(totals) / wall, p50
+
+
+def gateway_numbers(model_name: str, cfg, quantize: str, batch=BATCH,
+                    prompt_len=PROMPT_LEN, gen_tokens=GEN_TOKENS) -> dict:
+    """The north-star numerator: tokens/sec and TTFT through
+    `aigw run` → tpuserve → engine over streaming /v1/chat/completions,
+    plus the same load sent directly to tpuserve (isolates gateway
+    overhead from HTTP-serving overhead)."""
+    serve_url, stop_serve = _start_tpuserve(model_name, cfg, quantize,
+                                            batch)
+    gw_url, proc, cfg_path = _start_gateway(serve_url)
+
+    async def run() -> dict:
+        await _wait_health(serve_url, 1200)
+        await _wait_health(gw_url, 120)
+        # warm every prefill bucket + gateway code path off the clock
+        await _drive_stream(serve_url, model_name, batch, prompt_len, 4,
+                            tag="w")
+        await _drive_stream(gw_url, model_name, batch, prompt_len, 4,
+                            tag="x")
+        # alternate the legs and keep each one's best: a single
+        # direct-then-gateway ordering consistently flattered whichever
+        # leg ran second (server-side caches/CPU clocks keep warming),
+        # inverting the overhead comparison on CPU
+        d_tps = d_ttft = g_tps = g_ttft = 0.0
+        for trial in range(2):
+            dt, dt_ttft = await _drive_stream(
+                serve_url, model_name, batch, prompt_len, gen_tokens,
+                tag=f"d{trial}")
+            gt, gt_ttft = await _drive_stream(
+                gw_url, model_name, batch, prompt_len, gen_tokens,
+                tag=f"g{trial}")
+            if dt > d_tps:
+                d_tps, d_ttft = dt, dt_ttft
+            if gt > g_tps:
+                g_tps, g_ttft = gt, gt_ttft
+        return {
+            "gateway_tps": g_tps, "gateway_ttft_ms_p50": g_ttft,
+            "direct_tps": d_tps, "direct_ttft_ms_p50": d_ttft,
+        }
+
+    try:
+        return asyncio.run(run())
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        os.unlink(cfg_path)
+        stop_serve()
 
 
 def _chip_responsive(timeout_s: float = 180.0) -> bool:
@@ -179,41 +437,114 @@ def _build_8b_int8():
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
     params = quantize_params(params, consume=True)
     jax.block_until_ready(params)
-    return params, cfg, "llama-3-8b-arch W8A16 int8"
+    return params, cfg, "llama-3-8b-arch W8A16 int8", "bench-llama3-8b", \
+        "int8"
 
 
 def _build_fallback():
     params = llama.init_params(jax.random.PRNGKey(0), FALLBACK_CFG)
     jax.block_until_ready(params)
-    return params, FALLBACK_CFG, "1.1B llama-arch bf16"
+    return params, FALLBACK_CFG, "1.1B llama-arch bf16", "bench-llama-1b", ""
+
+
+def _suite(params_holder, cfg, desc, model_name, quantize, batch,
+           prompt_len, gen_tokens, label) -> dict:
+    """``params_holder`` is a one-element list so THIS frame owns the
+    only reference — the caller must del its own binding. The weights
+    are freed before the gateway leg's server builds its own copy (the
+    8B model fits the chip once, not twice)."""
+    params = params_holder.pop()
+    raw = raw_ceiling_tokens_per_sec(params, cfg, batch, prompt_len)
+    engine, engine_ttft = engine_numbers(params, cfg, batch, prompt_len,
+                                         gen_tokens)
+    del params
+    gc.collect()
+    gw = gateway_numbers(model_name, cfg, quantize, batch, prompt_len,
+                         gen_tokens)
+    return {
+        "metric": (
+            f"{label}gateway tokens/sec through `aigw run` → tpuserve "
+            f"streaming /v1/chat/completions, {desc}, batch={batch}, "
+            f"prompt={prompt_len}, paged KV; vs_baseline = gateway / "
+            f"raw-JAX-K-step-scan ceiling (north star: ≥0.9 and "
+            f"ttft_ms_p50 < 200)"
+        ),
+        "value": round(gw["gateway_tps"], 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(gw["gateway_tps"] / raw, 4),
+        "raw_ceiling": round(raw, 1),
+        "ttft_ms_p50": round(gw["gateway_ttft_ms_p50"], 1),
+        "engine_tokens_per_sec": round(engine, 1),
+        "engine_vs_raw": round(engine / raw, 4),
+        "engine_ttft_ms_p50": round(engine_ttft, 1),
+        "serve_direct_tokens_per_sec": round(gw["direct_tps"], 1),
+        "serve_direct_ttft_ms_p50": round(gw["direct_ttft_ms_p50"], 1),
+    }
 
 
 def run_live() -> dict:
     """One full live measurement (assumes the chip answered the probe)."""
     try:
-        params, cfg, desc = _build_8b_int8()
+        params, cfg, desc, model_name, quantize = _build_8b_int8()
     except Exception as e:  # OOM on smaller chips → honest fallback
         print(f"8B int8 build failed ({type(e).__name__}: {e}), "
               f"falling back to 1.1B bf16", file=sys.stderr)
-        params, cfg, desc = _build_fallback()
-    raw = raw_ceiling_tokens_per_sec(params, cfg)
-    engine, ttft_ms = engine_numbers(params, cfg)
-    return {
-        "metric": (
-            f"decode tokens/sec/chip, {desc}, batch={BATCH}, "
-            f"prompt={PROMPT_LEN}, paged KV (engine vs "
-            f"raw-JAX-K-step-scan ceiling in vs_baseline)"
-        ),
-        "value": round(engine, 1),
-        "unit": "tokens/s",
-        "vs_baseline": round(engine / raw, 4),
-        "raw_ceiling": round(raw, 1),
-        "ttft_ms_p50": round(ttft_ms, 1),
-    }
+        params, cfg, desc, model_name, quantize = _build_fallback()
+    holder = [params]
+    del params  # _suite must hold the only reference to free the HBM
+    return _suite(holder, cfg, desc, model_name, quantize, BATCH,
+                  PROMPT_LEN, GEN_TOKENS, label="")
+
+
+def run_cpu_ratio() -> dict:
+    """Chip-independent north-star *ratio* on the CPU backend (honest
+    fallback when the tunnel is down all round): same harness, small
+    model, absolute tok/s NOT comparable to TPU numbers."""
+    params = llama.init_params(jax.random.PRNGKey(0), CPU_CFG)
+    jax.block_until_ready(params)
+    holder = [params]
+    del params
+    res = _suite(
+        holder, CPU_CFG, "0.02B llama-arch bf16", "bench-cpu-tiny", "",
+        batch=BATCH, prompt_len=64, gen_tokens=64,
+        label="CPU BACKEND (TPU tunnel down; ratio is the signal, "
+              "absolute tok/s is not): ",
+    )
+    res["backend"] = jax.default_backend()
+    return res
+
+
+def _cpu_ratio_via_subprocess() -> dict | None:
+    """Run --cpu-gateway-ratio in a JAX_PLATFORMS=cpu subprocess (this
+    process's jax may be wedged on the dead TPU tunnel)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--cpu-gateway-ratio"],
+            env=env, capture_output=True, text=True, timeout=1800,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    print(out.stderr[-2000:], file=sys.stderr)
+    return None
 
 
 def main() -> None:
     from benchmarks import persist
+
+    if "--cpu-gateway-ratio" in sys.argv:
+        result = run_cpu_ratio()
+        persist.save("gateway_cpu", result)
+        print(json.dumps(result))
+        return
 
     if _chip_responsive():
         result = run_live()
@@ -238,13 +569,28 @@ def main() -> None:
         )
         print(json.dumps(result))
         return
+    # No on-chip run exists at all: fall back to the chip-independent
+    # CPU-backend ratio (persisted this round, else measured now).
+    prior = persist.latest("gateway_cpu")
+    if prior is None:
+        prior = _cpu_ratio_via_subprocess()
+    if prior is not None:
+        result = dict(prior)
+        age = persist.age_hours(prior)
+        if age is not None:
+            result["metric"] = (
+                f"{prior['metric']} — persisted {age:.1f}h before bench "
+                f"time; TPU tunnel down all round"
+            )
+        print(json.dumps(result))
+        return
     print(
         json.dumps(
             {
                 "metric": (
-                    "decode tokens/sec/chip — TPU tunnel unresponsive "
-                    "at bench time and no persisted on-chip run exists "
-                    "(device probe timed out)"
+                    "gateway tokens/sec — TPU tunnel unresponsive at "
+                    "bench time, no persisted run exists, and the CPU "
+                    "ratio harness failed"
                 ),
                 "value": 0,
                 "unit": "tokens/s",

@@ -237,3 +237,104 @@ spec: {type: Bogus, targetRefs: [{name: be-b}]}
                 await up_b.stop()
 
         asyncio.run(main())
+
+
+class TestStatusSurfaces:
+    """VERDICT r3 item 9: conditions must be operator-visible — an
+    `aigw status` subcommand and a NotAccepted count in /health (the
+    reference surfaces the same data as `kubectl get` conditions)."""
+
+    def _write_manifests(self, mdir, broken: bool):
+        (mdir / "backend.yaml").write_text(
+            _backend_yaml("b1", "127.0.0.1", 8901))
+        (mdir / "route.yaml").write_text(_route_yaml("r1", "m1", "b1"))
+        if broken:
+            (mdir / "broken.yaml").write_text("""
+apiVersion: aigateway.envoyproxy.io/v1alpha1
+kind: BackendSecurityPolicy
+metadata: {name: bad-bsp}
+spec: {type: Bogus, targetRefs: [{name: b1}]}
+""")
+
+    def test_status_subcommand_all_accepted(self, tmp_path, capsys):
+        from aigw_tpu.cli import main as cli_main
+
+        self._write_manifests(tmp_path, broken=False)
+        rc = cli_main(["status", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "AIGatewayRoute/r1" in out
+        assert "0 not accepted" in out
+
+    def test_status_subcommand_flags_quarantine(self, tmp_path, capsys):
+        from aigw_tpu.cli import main as cli_main
+
+        self._write_manifests(tmp_path, broken=True)
+        rc = cli_main(["status", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "NOT ACCEPTED" in out
+        assert "BackendSecurityPolicy/bad-bsp" in out
+        # json mode is machine-readable and carries the conditions
+        rc = cli_main(["status", str(tmp_path), "--json"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        objs = json.loads(out)["objects"]
+        assert objs["BackendSecurityPolicy/bad-bsp"]["status"] == "False"
+
+    def test_status_prefers_gateway_written_file(self, tmp_path, capsys):
+        from aigw_tpu.cli import main as cli_main
+
+        self._write_manifests(tmp_path, broken=False)
+        # a running gateway's reconciler wrote the status file earlier
+        rec = Reconciler(str(tmp_path))
+        rec.load()
+        rc = cli_main(["status", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "source: aigw-status.json" in out
+
+    def test_health_reports_not_accepted_count(self, tmp_path):
+        async def main():
+            mdir = tmp_path / "manifests"
+            mdir.mkdir()
+            self._write_manifests(mdir, broken=True)
+            watcher = ConfigWatcher(str(mdir), lambda rc: None,
+                                    interval=0.2)
+            rc0 = watcher.load_initial()
+            server, runner = await run_gateway(rc0, port=0)
+            server.conditions_fn = watcher.not_accepted
+            site = list(runner.sites)[0]
+            port = site._server.sockets[0].getsockname()[1]
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(
+                        f"http://127.0.0.1:{port}/health") as r:
+                        assert r.status == 200
+                        payload = await r.json()
+                assert payload["objects_not_accepted"] == 1
+                assert payload["not_accepted"] == [
+                    "BackendSecurityPolicy/bad-bsp"]
+            finally:
+                await runner.cleanup()
+
+        asyncio.run(main())
+
+    def test_status_detects_stale_file(self, tmp_path, capsys):
+        from aigw_tpu.cli import main as cli_main
+
+        self._write_manifests(tmp_path, broken=False)
+        rec = Reconciler(str(tmp_path))
+        rec.load()  # gateway writes aigw-status.json, then "dies"
+        # an operator then breaks a manifest: exit code must reflect NOW
+        (tmp_path / "broken.yaml").write_text("""
+apiVersion: aigateway.envoyproxy.io/v1alpha1
+kind: BackendSecurityPolicy
+metadata: {name: bad-bsp}
+spec: {type: Bogus, targetRefs: [{name: b1}]}
+""")
+        rc = cli_main(["status", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "stale" in out
+        assert "BackendSecurityPolicy/bad-bsp" in out

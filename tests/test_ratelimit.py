@@ -214,3 +214,26 @@ class TestReloadCarryover:
             rl.consume({"t": 1}, "m", "b", {"x": str(i)}, now=float(i * 10))
         # old windows were evicted (2×window grace)
         assert len(rl._windows) < 10
+
+
+class TestQuotaFileMigration:
+    def test_legacy_quota_file_renamed(self, tmp_path):
+        """Pre-hash quota state must survive an upgrade: the old
+        filename is renamed to the hashed one on first touch."""
+        import json as _json
+
+        from aigw_tpu.gateway.ratelimit import FileQuotaBackend
+
+        legacy = tmp_path / "quota_rule-a.json"
+        legacy.write_text(_json.dumps(
+            {"start": 1e12, "used": {"client": 7}}))
+        backend = FileQuotaBackend(str(tmp_path))
+        path = backend._path("rule-a")
+        assert not legacy.exists()
+        assert _json.loads(open(path).read())["used"]["client"] == 7
+
+    def test_distinct_rules_distinct_files(self, tmp_path):
+        from aigw_tpu.gateway.ratelimit import FileQuotaBackend
+
+        backend = FileQuotaBackend(str(tmp_path))
+        assert backend._path("a b") != backend._path("a_b")

@@ -599,3 +599,95 @@ class TestTranslatorPurity:
         assert t2.request(body).body == get_translator(
             Endpoint.CHAT_COMPLETIONS, S.OPENAI, schema,
             model_name_override="override").request(body).body or True
+
+
+class TestTokenizeAWSAnthropic:
+    """tokenize → AWS Bedrock CountTokens (tokenize_awsanthropic.go:
+    InvokeModel wrapper, CRIS prefix strip, inputTokens response)."""
+
+    def test_invoke_model_wrapper(self):
+        import base64
+
+        t = get_translator(Endpoint.TOKENIZE, S.OPENAI, S.AWS_ANTHROPIC)
+        tx = t.request({"model": "anthropic.claude-3-sonnet",
+                        "prompt": "hello world"})
+        assert tx.path == "/model/anthropic.claude-3-sonnet/count-tokens"
+        out = json.loads(tx.body)
+        inner = json.loads(
+            base64.b64decode(out["input"]["invokeModel"]["body"]))
+        # Bedrock validates the inner body as a real request
+        # (tokenize_awsanthropic.go:69-74)
+        assert inner["anthropic_version"] == "bedrock-2023-05-31"
+        assert inner["max_tokens"] == 1
+        assert "model" not in inner  # model rides the URL, not the body
+        assert inner["messages"][0]["role"] == "user"
+
+    def test_cris_prefix_stripped(self):
+        # CountTokens rejects cross-region IDs; drop the geography
+        # prefix before "anthropic." (tokenize_awsanthropic.go:108-116)
+        t = get_translator(Endpoint.TOKENIZE, S.OPENAI, S.AWS_ANTHROPIC)
+        tx = t.request({"model": "apac.anthropic.claude-sonnet-4-6",
+                        "prompt": "x"})
+        assert tx.path == "/model/anthropic.claude-sonnet-4-6/count-tokens"
+
+    def test_messages_form_and_response(self):
+        t = get_translator(Endpoint.TOKENIZE, S.OPENAI, S.AWS_ANTHROPIC)
+        t.request({"model": "anthropic.claude-3-haiku", "messages": [
+            {"role": "system", "content": "be brief"},
+            {"role": "user", "content": "hi"}]})
+        rx = t.response_body(
+            json.dumps({"inputTokens": 42}).encode(), True)
+        got = json.loads(rx.body)
+        assert got["count"] == 42
+        assert rx.usage.input_tokens == 42
+
+
+class TestMultipartModelRewrite:
+    """rewriteMultipartModel parity (multipart_helper.go:16-66): only
+    the model part's value changes; the file part is byte-identical."""
+
+    BOUNDARY = "xxBOUNDxx"
+
+    def _body(self) -> bytes:
+        b = self.BOUNDARY.encode()
+        return (
+            b"--" + b + b"\r\n"
+            b'Content-Disposition: form-data; name="model"\r\n\r\n'
+            b"whisper-1\r\n"
+            b"--" + b + b"\r\n"
+            b'Content-Disposition: form-data; name="file"; '
+            b'filename="a.wav"\r\n'
+            b"Content-Type: audio/wav\r\n\r\n"
+            b"RIFF\x00\x01\x02binary\r\nnot-a-boundary\r\n"
+            b"--" + b + b"--\r\n"
+        )
+
+    def test_rewrites_only_model(self):
+        from aigw_tpu.translate.multipart import rewrite_multipart_model
+
+        raw = self._body()
+        ctype = f'multipart/form-data; boundary="{self.BOUNDARY}"'
+        out, out_ctype = rewrite_multipart_model(raw, ctype, "azure-dep")
+        assert out_ctype == ctype
+        assert b"azure-dep" in out
+        assert b"whisper-1" not in out
+        # file bytes verbatim, including embedded \r\n
+        assert b"RIFF\x00\x01\x02binary\r\nnot-a-boundary" in out
+        # still a well-formed multipart: model extractable again
+        from aigw_tpu.gateway.server import _multipart_model
+
+        assert _multipart_model(out, ctype) == "azure-dep"
+
+    def test_no_model_part_returns_unchanged(self):
+        from aigw_tpu.translate.multipart import rewrite_multipart_model
+
+        raw = self._body().replace(b'name="model"', b'name="other"')
+        ctype = f"multipart/form-data; boundary={self.BOUNDARY}"
+        out, _ = rewrite_multipart_model(raw, ctype, "m")
+        assert out == raw
+
+    def test_not_multipart_returns_unchanged(self):
+        from aigw_tpu.translate.multipart import rewrite_multipart_model
+
+        out, ctype = rewrite_multipart_model(b"{}", "application/json", "m")
+        assert out == b"{}"

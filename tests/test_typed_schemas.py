@@ -1,0 +1,460 @@
+"""Typed per-endpoint request schemas + vendor-specific fields
+(VERDICT r3 item 2 — three-round-old fidelity tail).
+
+Mirrors the reference's apischema strictness: every JSON endpoint
+rejects malformed bodies at the gateway with a 400 naming the offending
+field, before any upstream traffic (internal/apischema/openai/openai.go:
+CompletionRequest :2073, EmbeddingRequest union :1781-1836,
+ImageGenerationRequest :2276, cohere/rerank_v2.go:11), and proposal-004
+vendor fields (thinking / generationConfig / safetySettings /
+auto_truncate / task_type / title) ride the unified OpenAI surface
+through to exactly the backends that understand them
+(openai_gcpvertexai.go:498-594, anthropic_helper.go:577-607,:762,
+openai_awsbedrock.go:57-90,:142-146, vendor_fields_test.go).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from aigw_tpu.schemas.openai import SchemaError
+from aigw_tpu.schemas.typed import validate_request
+from tests.fakes import FakeUpstream
+from tests.test_gateway import make_config, run, start_env, stop_env
+
+
+def ok(path, body):
+    validate_request(path, body)
+
+
+def bad(path, body, fragment):
+    with pytest.raises(SchemaError) as e:
+        validate_request(path, body)
+    assert fragment in str(e.value), str(e.value)
+
+
+# ---------------------------------------------------------------------------
+# /v1/completions (openai.go:2073-2161)
+
+class TestCompletionsSchema:
+    BASE = {"model": "m", "prompt": "hello"}
+
+    def test_valid_forms(self):
+        ok("/v1/completions", self.BASE)
+        ok("/v1/completions", {"model": "m", "prompt": ["a", "b"]})
+        ok("/v1/completions", {"model": "m", "prompt": [1, 2, 3]})
+        ok("/v1/completions", {"model": "m", "prompt": [[1, 2], [3]]})
+        ok("/v1/completions", {**self.BASE, "stop": ["a", "b"],
+                               "logprobs": 5, "n": 4,
+                               "temperature": 1.5, "stream": True,
+                               "stream_options": {"include_usage": True}})
+
+    def test_missing_prompt(self):
+        bad("/v1/completions", {"model": "m"}, "prompt")
+
+    def test_prompt_wrong_type(self):
+        bad("/v1/completions", {"model": "m", "prompt": 42}, "prompt")
+        bad("/v1/completions", {"model": "m", "prompt": {"text": "x"}},
+            "prompt")
+
+    def test_bounds(self):
+        bad("/v1/completions", {**self.BASE, "temperature": 2.5},
+            "temperature")
+        bad("/v1/completions", {**self.BASE, "top_p": -0.1}, "top_p")
+        bad("/v1/completions", {**self.BASE, "logprobs": 6}, "logprobs")
+        bad("/v1/completions", {**self.BASE, "n": 0}, "n")
+        bad("/v1/completions", {**self.BASE, "presence_penalty": -3},
+            "presence_penalty")
+        bad("/v1/completions", {**self.BASE, "best_of": 21}, "best_of")
+
+    def test_stop_too_many(self):
+        bad("/v1/completions",
+            {**self.BASE, "stop": ["a", "b", "c", "d", "e"]}, "stop")
+
+    def test_type_confusion(self):
+        bad("/v1/completions", {**self.BASE, "stream": "yes"}, "stream")
+        bad("/v1/completions", {**self.BASE, "max_tokens": "10"},
+            "max_tokens")
+        # booleans must not pass as integers
+        bad("/v1/completions", {**self.BASE, "max_tokens": True},
+            "max_tokens")
+
+    def test_unknown_fields_pass(self):
+        ok("/v1/completions", {**self.BASE, "novel_field": {"x": 1}})
+
+
+# ---------------------------------------------------------------------------
+# /v1/embeddings (openai.go:1781-1836 discriminated union)
+
+class TestEmbeddingsSchema:
+    def test_valid_forms(self):
+        ok("/v1/embeddings", {"model": "m", "input": "text"})
+        ok("/v1/embeddings", {"model": "m", "input": ["a", "b"]})
+        ok("/v1/embeddings", {"model": "m", "input": [1, 2, 3]})
+        ok("/v1/embeddings", {"model": "m", "input": [[1], [2, 3]]})
+        ok("/v1/embeddings", {"model": "m", "messages": [
+            {"role": "user", "content": "hi"}]})
+        ok("/v1/embeddings", {"model": "m", "input": "x",
+                              "encoding_format": "base64",
+                              "dimensions": 256})
+
+    def test_input_item_objects(self):
+        # openai.go:408-432: objects with content/task_type/title
+        ok("/v1/embeddings", {"model": "m", "input": [
+            {"content": "doc one", "task_type": "RETRIEVAL_DOCUMENT",
+             "title": "One"},
+            {"content": ["a", "b"]},
+        ]})
+        bad("/v1/embeddings", {"model": "m", "input": [{"title": "x"}]},
+            "content")
+        bad("/v1/embeddings", {"model": "m", "input": [
+            {"content": "x", "task_type": "NOT_A_TASK"}]}, "task_type")
+
+    def test_union_discrimination(self):
+        # input+messages → reject, neither → reject (openai.go:1789-1800)
+        bad("/v1/embeddings", {"model": "m", "input": "x",
+                               "messages": [{"role": "user"}]},
+            "not both")
+        bad("/v1/embeddings", {"model": "m"}, "input")
+
+    def test_malformed(self):
+        bad("/v1/embeddings", {"model": "m", "input": 42}, "input")
+        bad("/v1/embeddings", {"model": "m", "input": []}, "input")
+        bad("/v1/embeddings", {"model": "m", "input": "x",
+                               "encoding_format": "hex"},
+            "encoding_format")
+        bad("/v1/embeddings", {"model": "m", "input": "x",
+                               "dimensions": 0}, "dimensions")
+        bad("/v1/embeddings", {"input": "x"}, "model")
+
+    def test_vendor_fields_typed(self):
+        ok("/v1/embeddings", {"model": "m", "input": "x",
+                              "auto_truncate": False,
+                              "task_type": "CLUSTERING", "title": "t"})
+        bad("/v1/embeddings", {"model": "m", "input": "x",
+                               "auto_truncate": "no"}, "auto_truncate")
+
+
+# ---------------------------------------------------------------------------
+# /v1/images/generations (openai.go:2276-2316)
+
+class TestImagesSchema:
+    BASE = {"prompt": "a cat", "model": "img"}
+
+    def test_valid(self):
+        ok("/v1/images/generations", self.BASE)
+        ok("/v1/images/generations", {**self.BASE, "n": 2,
+                                      "quality": "hd", "size": "512x512",
+                                      "response_format": "b64_json",
+                                      "output_compression": 80})
+
+    def test_malformed(self):
+        bad("/v1/images/generations", {"model": "img"}, "prompt")
+        bad("/v1/images/generations", {**self.BASE, "n": 11}, "n")
+        bad("/v1/images/generations",
+            {**self.BASE, "response_format": "binary"}, "response_format")
+        bad("/v1/images/generations", {**self.BASE, "quality": "4k"},
+            "quality")
+        bad("/v1/images/generations",
+            {**self.BASE, "output_compression": 101}, "output_compression")
+
+
+# ---------------------------------------------------------------------------
+# /v2/rerank (cohere/rerank_v2.go:11-24)
+
+class TestRerankSchema:
+    BASE = {"model": "r", "query": "q", "documents": ["d1", "d2"]}
+
+    def test_valid(self):
+        ok("/v2/rerank", self.BASE)
+        ok("/v2/rerank", {**self.BASE, "top_n": 1,
+                          "documents": ["s", {"text": "obj"}]})
+
+    def test_malformed(self):
+        bad("/v2/rerank", {"model": "r", "query": "q"}, "documents")
+        bad("/v2/rerank", {"model": "r", "documents": ["d"]}, "query")
+        bad("/v2/rerank", {**self.BASE, "documents": []}, "documents")
+        bad("/v2/rerank", {**self.BASE, "documents": [42]}, "documents")
+        bad("/v2/rerank", {**self.BASE, "top_n": 0}, "top_n")
+
+
+# ---------------------------------------------------------------------------
+# /v1/audio/speech
+
+class TestSpeechSchema:
+    BASE = {"model": "tts", "input": "say this", "voice": "alloy"}
+
+    def test_valid(self):
+        ok("/v1/audio/speech", self.BASE)
+        ok("/v1/audio/speech", {**self.BASE, "response_format": "wav",
+                                "speed": 1.5})
+
+    def test_malformed(self):
+        bad("/v1/audio/speech", {"model": "tts", "input": "x"}, "voice")
+        bad("/v1/audio/speech", {"model": "tts", "voice": "v"}, "input")
+        bad("/v1/audio/speech", {**self.BASE, "speed": 5.0}, "speed")
+        bad("/v1/audio/speech", {**self.BASE, "response_format": "ogg"},
+            "response_format")
+
+
+# ---------------------------------------------------------------------------
+# /tokenize and /v1/responses
+
+class TestTokenizeAndResponses:
+    def test_tokenize(self):
+        ok("/tokenize", {"model": "m", "prompt": "abc"})
+        ok("/tokenize", {"model": "m",
+                         "messages": [{"role": "user", "content": "x"}]})
+        bad("/tokenize", {"model": "m", "prompt": "x",
+                          "messages": []}, "not both")
+        bad("/tokenize", {"prompt": "x"}, "model")
+
+    def test_responses(self):
+        ok("/v1/responses", {"model": "m", "input": "hello"})
+        ok("/v1/responses", {"model": "m",
+                             "input": [{"role": "user", "content": "x"}],
+                             "unknown_new_field": 1})
+        bad("/v1/responses", {"model": "m", "input": 42}, "input")
+        bad("/v1/responses", {"model": "m", "max_output_tokens": 0},
+            "max_output_tokens")
+
+
+# ---------------------------------------------------------------------------
+# chat vendor fields (thinking union openai.go:931-1010;
+# GCPVertexAIVendorFields openai.go:2004-2022)
+
+class TestChatVendorFieldSchema:
+    BASE = {"model": "m", "messages": [{"role": "user", "content": "x"}]}
+
+    def test_thinking_forms(self):
+        ok("/v1/chat/completions",
+           {**self.BASE, "thinking": {"type": "enabled",
+                                      "budget_tokens": 1000}})
+        ok("/v1/chat/completions",
+           {**self.BASE, "thinking": {"type": "disabled"}})
+        ok("/v1/chat/completions",
+           {**self.BASE, "thinking": {"type": "adaptive",
+                                      "display": "summarized"}})
+
+    def test_thinking_malformed(self):
+        # no type → rejected (openai.go:984 "does not have a type")
+        bad("/v1/chat/completions",
+            {**self.BASE, "thinking": {"budget_tokens": 10}}, "type")
+        bad("/v1/chat/completions",
+            {**self.BASE, "thinking": {"type": "enabled"}},
+            "budget_tokens")
+        bad("/v1/chat/completions",
+            {**self.BASE, "thinking": {"type": "enabled",
+                                       "budget_tokens": -1}},
+            "budget_tokens")
+        bad("/v1/chat/completions",
+            {**self.BASE, "thinking": {"type": "sometimes"}}, "type")
+
+    def test_gcp_vendor_fields(self):
+        ok("/v1/chat/completions", {**self.BASE, "safetySettings": [
+            {"category": "HARM_CATEGORY_HARASSMENT",
+             "threshold": "BLOCK_ONLY_HIGH"}]})
+        ok("/v1/chat/completions", {**self.BASE, "generationConfig": {
+            "media_resolution": "MEDIA_RESOLUTION_LOW"}})
+        bad("/v1/chat/completions",
+            {**self.BASE, "safetySettings": [{"category": "X"}]},
+            "threshold")
+        bad("/v1/chat/completions",
+            {**self.BASE, "safetySettings": {"category": "X"}},
+            "safetySettings")
+        bad("/v1/chat/completions", {**self.BASE, "generationConfig": {
+            "thinkingConfig": {"thinkingBudget": "lots"}}},
+            "thinkingBudget")
+
+
+# ---------------------------------------------------------------------------
+# vendor-field passthrough goldens per backend translator
+
+class TestVendorFieldPassthrough:
+    CHAT = {"model": "m", "messages": [{"role": "user", "content": "x"}]}
+
+    def test_gemini_gets_thinking_and_safety(self):
+        from aigw_tpu.translate.openai_gcp import OpenAIToGeminiChat
+
+        tx = OpenAIToGeminiChat().request({
+            **self.CHAT,
+            "thinking": {"type": "enabled", "budget_tokens": 1000,
+                         "includeThoughts": True},
+            "safetySettings": [{"category": "HARM_CATEGORY_HARASSMENT",
+                                "threshold": "BLOCK_ONLY_HIGH"}],
+            "generationConfig": {
+                "media_resolution": "MEDIA_RESOLUTION_LOW"},
+        })
+        out = json.loads(tx.body)
+        gen = out["generationConfig"]
+        assert gen["thinkingConfig"] == {"thinkingBudget": 1000,
+                                        "includeThoughts": True}
+        assert gen["mediaResolution"] == "MEDIA_RESOLUTION_LOW"
+        assert out["safetySettings"][0]["category"] == (
+            "HARM_CATEGORY_HARASSMENT")
+
+    def test_gemini_vendor_overrides_translated(self):
+        # "vendor fields take precedence" (openai_gcpvertexai.go:574)
+        from aigw_tpu.translate.openai_gcp import OpenAIToGeminiChat
+
+        tx = OpenAIToGeminiChat().request({
+            **self.CHAT, "temperature": 0.2,
+            "generationConfig": {"temperature": 0.9},
+        })
+        assert json.loads(tx.body)["generationConfig"]["temperature"] == 0.9
+
+    def test_anthropic_gets_thinking(self):
+        from aigw_tpu.translate.openai_anthropic import OpenAIToAnthropicChat
+
+        tx = OpenAIToAnthropicChat().request({
+            **self.CHAT,
+            "thinking": {"type": "enabled", "budget_tokens": 512},
+        })
+        assert json.loads(tx.body)["thinking"] == {
+            "type": "enabled", "budget_tokens": 512}
+
+    def test_anthropic_disabled_and_adaptive(self):
+        from aigw_tpu.translate.openai_anthropic import OpenAIToAnthropicChat
+
+        tx = OpenAIToAnthropicChat().request({
+            **self.CHAT, "thinking": {"type": "disabled"}})
+        assert json.loads(tx.body)["thinking"] == {"type": "disabled"}
+        tx = OpenAIToAnthropicChat().request({
+            **self.CHAT, "thinking": {"type": "adaptive",
+                                      "display": "omitted"}})
+        assert json.loads(tx.body)["thinking"] == {
+            "type": "adaptive", "display": "omitted"}
+
+    def test_bedrock_gets_additional_model_request_fields(self):
+        from aigw_tpu.translate.openai_awsbedrock import (
+            OpenAIToBedrockChat,
+        )
+
+        tx = OpenAIToBedrockChat().request({
+            **self.CHAT,
+            "thinking": {"type": "enabled", "budget_tokens": 256},
+        })
+        out = json.loads(tx.body)
+        assert out["additionalModelRequestFields"]["thinking"] == {
+            "type": "enabled", "budget_tokens": 256}
+
+    def test_openai_backend_does_not_get_gcp_fields(self):
+        # the OpenAI passthrough forwards the body as-is — vendor fields
+        # ride along exactly as the user wrote them (reference: OpenAI
+        # backends receive the original marshalled request)
+        from aigw_tpu.config.model import APISchemaName
+        from aigw_tpu.translate.base import Endpoint, get_translator
+
+        tx = get_translator(
+            Endpoint.CHAT_COMPLETIONS, APISchemaName.OPENAI,
+            APISchemaName.OPENAI).request({
+            **self.CHAT, "thinking": {"type": "disabled"}})
+        assert json.loads(tx.body)["thinking"] == {"type": "disabled"}
+
+    def test_vertex_embeddings_vendor_triple(self):
+        from aigw_tpu.translate.embeddings import OpenAIToVertexEmbeddings
+
+        tx = OpenAIToVertexEmbeddings().request({
+            "model": "text-embedding-005",
+            "input": [
+                {"content": "doc", "task_type": "RETRIEVAL_DOCUMENT",
+                 "title": "T"},
+                "plain",
+            ],
+            "auto_truncate": False,
+            "task_type": "RETRIEVAL_QUERY",
+        })
+        out = json.loads(tx.body)
+        assert out["instances"][0] == {
+            "content": "doc", "task_type": "RETRIEVAL_DOCUMENT",
+            "title": "T"}
+        # request-level task_type fills items that don't carry their own
+        assert out["instances"][1] == {"content": "plain",
+                                       "task_type": "RETRIEVAL_QUERY"}
+        assert out["parameters"]["auto_truncate"] is False
+
+
+# ---------------------------------------------------------------------------
+# through the gateway: malformed bodies 400 before upstream traffic
+
+class TestGatewayRejectsBeforeUpstream:
+    def _env(self):
+        up = FakeUpstream()
+        up.on_json("/v1/embeddings", {"object": "list", "data": []})
+        up.on_json("/v1/completions", {"object": "text_completion",
+                                       "choices": []})
+        return up
+
+    def test_embeddings_400_no_upstream_call(self):
+        async def main():
+            up = self._env()
+            server, runner, url, ups = await start_env(
+                {"a": up},
+                lambda urls: make_config(
+                    [{"name": "a", "schema": "OpenAI", "url": urls["a"]}],
+                    [{"name": "r", "rules": [{"backends": ["a"]}]}],
+                ),
+            )
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(url + "/v1/embeddings", json={
+                        "model": "m1", "input": 42,
+                    }) as resp:
+                        assert resp.status == 400
+                        err = await resp.json()
+                        assert "input" in err["error"]["message"]
+                assert len(up.captured) == 0  # rejected BEFORE upstream
+            finally:
+                await stop_env(runner, ups)
+
+        run(main())
+
+    def test_completions_400_names_field(self):
+        async def main():
+            up = self._env()
+            server, runner, url, ups = await start_env(
+                {"a": up},
+                lambda urls: make_config(
+                    [{"name": "a", "schema": "OpenAI", "url": urls["a"]}],
+                    [{"name": "r", "rules": [{"backends": ["a"]}]}],
+                ),
+            )
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(url + "/v1/completions", json={
+                        "model": "m1", "prompt": "x", "temperature": 9,
+                    }) as resp:
+                        assert resp.status == 400
+                        err = await resp.json()
+                        assert "temperature" in err["error"]["message"]
+                assert len(up.captured) == 0
+            finally:
+                await stop_env(runner, ups)
+
+        run(main())
+
+    def test_valid_embeddings_still_flow(self):
+        async def main():
+            up = self._env()
+            server, runner, url, ups = await start_env(
+                {"a": up},
+                lambda urls: make_config(
+                    [{"name": "a", "schema": "OpenAI", "url": urls["a"]}],
+                    [{"name": "r", "rules": [{"backends": ["a"]}]}],
+                ),
+            )
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(url + "/v1/embeddings", json={
+                        "model": "m1", "input": "hello",
+                    }) as resp:
+                        assert resp.status == 200
+                assert len(up.captured) == 1
+            finally:
+                await stop_env(runner, ups)
+
+        run(main())
