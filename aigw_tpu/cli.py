@@ -26,11 +26,14 @@ def main(argv: list[str] | None = None) -> int:
 
     p_run = sub.add_parser("run", help="run the gateway data plane")
     p_run.add_argument("config", nargs="?", default="",
-                       help="config YAML, bundle dir, or CRD manifest dir "
-                            "(watched + reconciled with status conditions; "
-                            "omit to autoconfig from env: OPENAI_API_KEY, "
-                            "ANTHROPIC_API_KEY, AZURE_OPENAI_*, "
-                            "TPUSERVE_URL)")
+                       help="config YAML, bundle dir, CRD manifest dir "
+                            "(watched + reconciled with status conditions), "
+                            "or kube:<kubeconfig>|kube:in-cluster to "
+                            "list/watch the CRDs on a live cluster with "
+                            "Accepted conditions patched onto object "
+                            "status; omit to autoconfig from env: "
+                            "OPENAI_API_KEY, ANTHROPIC_API_KEY, "
+                            "AZURE_OPENAI_*, TPUSERVE_URL)")
     p_run.add_argument("--host", default="127.0.0.1")
     p_run.add_argument("--port", type=int, default=1975)
     p_run.add_argument("--watch-interval", type=float, default=5.0)
@@ -96,6 +99,18 @@ def main(argv: list[str] | None = None) -> int:
                         help="JSON-lines access log for natively routed "
                              "requests (model/backend/status/duration/"
                              "token usage per line)")
+
+    p_quota = sub.add_parser(
+        "quota-service",
+        help="run the shared quota service: gateways on other nodes "
+             "point AIGW_QUOTA_URL here so one token budget is enforced "
+             "with no shared filesystem (the reference's network "
+             "ratelimit-service role)")
+    p_quota.add_argument("--host", default="0.0.0.0")
+    p_quota.add_argument("--port", type=int, default=1981)
+    p_quota.add_argument("--dir", default="/tmp/aigw-quota",
+                         help="counter storage (flock'd files; a shared "
+                              "volume lets the service itself replicate)")
 
     p_serve = sub.add_parser("tpuserve", help="run the TPU serving engine")
     p_serve.add_argument("--model", required=True,
@@ -361,6 +376,18 @@ def main(argv: list[str] | None = None) -> int:
         except ConfigError as e:
             print(f"config error: {e}", file=sys.stderr)
             return 1
+    if args.cmd == "quota-service":
+        from aiohttp import web as _web
+
+        from aigw_tpu.gateway.ratelimit import quota_service_app
+
+        logging.basicConfig(level=logging.INFO)
+        app = quota_service_app(args.dir)
+        print(f"quota service listening on http://{args.host}:{args.port}"
+              f" (dir={args.dir})", flush=True)
+        _web.run_app(app, host=args.host, port=args.port, print=None)
+        return 0
+
     if args.cmd == "tpuserve":
         if args.platform:
             import jax

@@ -1,0 +1,512 @@
+"""Live Kubernetes source for the reconciling control plane.
+
+The reference's primary deployment mode is a controller-runtime manager
+that list/watches the AI Gateway CRDs on a cluster, converges config,
+and writes Accepted conditions back onto each object's status
+(reference internal/controller/controller.go:117-330 — watch wiring per
+kind; gateway.go:89 — the gateway reconciler; `kubectl get` shows the
+conditions). Rounds 1-3 reproduced the reconcile *semantics* against a
+manifest directory; this module feeds the same reconcile loop from a
+real API server.
+
+Design: no Kubernetes client library is vendored (none is available in
+the image) — the API surface needed is four HTTP verbs against a stable
+REST layout, so a ~200-line client over aiohttp covers it:
+
+- ``KubeClient.from_kubeconfig`` / ``in_cluster`` — auth material
+  (bearer token, client cert, CA bundle) from the standard locations.
+- ``list_resource`` / ``watch_resource`` — ``GET /apis/{g}/{v}/{plural}``
+  and the same with ``?watch=true&resourceVersion=`` streaming JSON
+  lines, the protocol `kubectl get -w` speaks.
+- ``patch_status`` — ``PATCH .../{name}/status`` with
+  ``application/merge-patch+json``, the reference's status writeback.
+
+``KubeSource`` runs the watches on a dedicated thread/event loop and
+maintains an object cache; ``KubeReconciler`` plugs that cache into the
+existing Reconciler (admission → compile → quarantine → conditions) and
+pushes per-object conditions back to the cluster. The directory mode
+stays the default; select this source with ``aigw run kube:<kubeconfig>``
+(or ``kube:in-cluster``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import logging
+import os
+import ssl
+import tempfile
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable
+
+logger = logging.getLogger(__name__)
+
+#: kind → (group, version, plural, namespaced). Groups per the reference
+#: CRD manifests (api/v1alpha1; gateway.envoyproxy.io for Backend;
+#: gateway-api + inference-extension for the imported kinds).
+RESOURCES: dict[str, tuple[str, str, str, bool]] = {
+    "AIGatewayRoute": (
+        "aigateway.envoyproxy.io", "v1alpha1", "aigatewayroutes", True),
+    "AIServiceBackend": (
+        "aigateway.envoyproxy.io", "v1alpha1", "aiservicebackends", True),
+    "BackendSecurityPolicy": (
+        "aigateway.envoyproxy.io", "v1alpha1",
+        "backendsecuritypolicies", True),
+    "MCPRoute": (
+        "aigateway.envoyproxy.io", "v1alpha1", "mcproutes", True),
+    "GatewayConfig": (
+        "aigateway.envoyproxy.io", "v1alpha1", "gatewayconfigs", True),
+    "Backend": (
+        "gateway.envoyproxy.io", "v1alpha1", "backends", True),
+    "BackendTLSPolicy": (
+        "gateway.networking.k8s.io", "v1alpha3",
+        "backendtlspolicies", True),
+    "InferencePool": (
+        "inference.networking.x-k8s.io", "v1alpha2",
+        "inferencepools", True),
+    "Secret": ("", "v1", "secrets", True),
+}
+
+#: kinds whose status we own (the reference writes Accepted conditions
+#: only on its own API group's objects)
+STATUS_KINDS = {
+    "AIGatewayRoute", "AIServiceBackend", "BackendSecurityPolicy",
+    "MCPRoute", "GatewayConfig",
+}
+
+
+def resource_path(kind: str, namespace: str = "", name: str = "") -> str:
+    group, version, plural, namespaced = RESOURCES[kind]
+    prefix = f"/apis/{group}/{version}" if group else f"/api/{version}"
+    if namespace and namespaced:
+        path = f"{prefix}/namespaces/{namespace}/{plural}"
+    else:
+        path = f"{prefix}/{plural}"  # cluster-wide (all namespaces)
+    if name:
+        path += f"/{name}"
+    return path
+
+
+@dataclass
+class KubeAuth:
+    server: str
+    token: str = ""
+    ca_data: bytes | None = None
+    client_cert: tuple[str, str] | None = None  # (cert path, key path)
+    insecure: bool = False
+
+    def ssl_context(self) -> ssl.SSLContext | bool:
+        if self.server.startswith("http://"):
+            return False  # plain HTTP (tests, kind port-forwards)
+        ctx = ssl.create_default_context()
+        if self.ca_data:
+            ctx.load_verify_locations(cadata=self.ca_data.decode())
+        if self.client_cert:
+            ctx.load_cert_chain(*self.client_cert)
+        if self.insecure:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        return ctx
+
+
+def _b64_to_tempfile(data: str, suffix: str) -> str:
+    f = tempfile.NamedTemporaryFile("wb", suffix=suffix, delete=False)
+    f.write(base64.b64decode(data))
+    f.close()
+    return f.name
+
+
+def load_kubeconfig(path: str) -> KubeAuth:
+    """Parse the standard kubeconfig: current-context → cluster + user.
+    Supports token, token-file, client-certificate(-data) and
+    certificate-authority(-data)."""
+    import yaml
+
+    with open(path, encoding="utf-8") as f:
+        doc = yaml.safe_load(f) or {}
+    ctx_name = doc.get("current-context", "")
+    contexts = {c["name"]: c["context"] for c in doc.get("contexts", [])}
+    clusters = {c["name"]: c["cluster"] for c in doc.get("clusters", [])}
+    users = {u["name"]: u.get("user", {}) for u in doc.get("users", [])}
+    if ctx_name not in contexts:
+        raise ValueError(f"kubeconfig {path}: no context {ctx_name!r}")
+    ctx = contexts[ctx_name]
+    cluster = clusters.get(ctx.get("cluster", ""), {})
+    user = users.get(ctx.get("user", ""), {})
+    server = cluster.get("server", "")
+    if not server:
+        raise ValueError(f"kubeconfig {path}: cluster has no server")
+    ca_data = None
+    if cluster.get("certificate-authority-data"):
+        ca_data = base64.b64decode(cluster["certificate-authority-data"])
+    elif cluster.get("certificate-authority"):
+        with open(cluster["certificate-authority"], "rb") as f:
+            ca_data = f.read()
+    token = user.get("token", "")
+    if not token and user.get("tokenFile"):
+        with open(user["tokenFile"], encoding="utf-8") as f:
+            token = f.read().strip()
+    client_cert = None
+    if user.get("client-certificate-data") and user.get("client-key-data"):
+        client_cert = (
+            _b64_to_tempfile(user["client-certificate-data"], ".crt"),
+            _b64_to_tempfile(user["client-key-data"], ".key"),
+        )
+    elif user.get("client-certificate") and user.get("client-key"):
+        client_cert = (user["client-certificate"], user["client-key"])
+    return KubeAuth(
+        server=server.rstrip("/"), token=token, ca_data=ca_data,
+        client_cert=client_cert,
+        insecure=bool(cluster.get("insecure-skip-tls-verify")),
+    )
+
+
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+def in_cluster_auth() -> KubeAuth:
+    host = os.environ.get("KUBERNETES_SERVICE_HOST", "")
+    port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+    if not host:
+        raise ValueError("not running in-cluster "
+                         "(KUBERNETES_SERVICE_HOST unset)")
+    with open(f"{_SA_DIR}/token", encoding="utf-8") as f:
+        token = f.read().strip()
+    with open(f"{_SA_DIR}/ca.crt", "rb") as f:
+        ca = f.read()
+    return KubeAuth(server=f"https://{host}:{port}", token=token,
+                    ca_data=ca)
+
+
+class KubeClient:
+    """Async REST client for the subset of the API the reconciler needs.
+    One aiohttp session, created lazily on the owning loop."""
+
+    def __init__(self, auth: KubeAuth):
+        self.auth = auth
+        self._session = None
+
+    def _headers(self) -> dict[str, str]:
+        h = {"accept": "application/json"}
+        if self.auth.token:
+            h["authorization"] = f"Bearer {self.auth.token}"
+        return h
+
+    async def session(self):
+        import aiohttp
+
+        if self._session is None or self._session.closed:
+            conn = aiohttp.TCPConnector(ssl=self.auth.ssl_context())
+            self._session = aiohttp.ClientSession(
+                connector=conn, headers=self._headers())
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    async def list_resource(
+        self, kind: str,
+    ) -> tuple[list[dict], str, bool]:
+        """(objects cluster-wide, list resourceVersion the watch starts
+        from, CRD-installed flag)."""
+        s = await self.session()
+        url = self.auth.server + resource_path(kind)
+        async with s.get(url) as resp:
+            if resp.status == 404:
+                # CRD not installed: empty + not-installed, not fatal
+                # (the reference's manager degrades the same way for
+                # optional kinds); the caller polls slowly instead of
+                # hot-looping a watch on a missing resource
+                return [], "", False
+            resp.raise_for_status()
+            body = await resp.json()
+        items = body.get("items") or []
+        for item in items:
+            item.setdefault("kind", kind)
+            gv = RESOURCES[kind]
+            item.setdefault(
+                "apiVersion", f"{gv[0]}/{gv[1]}" if gv[0] else gv[1])
+        rv = (body.get("metadata") or {}).get("resourceVersion", "")
+        return items, rv, True
+
+    async def watch_resource(
+        self, kind: str, resource_version: str,
+        on_event: Callable[[str, dict], None],
+    ) -> None:
+        """One watch stream; returns when the server closes it (caller
+        re-lists and re-watches — the standard watch loop)."""
+        s = await self.session()
+        url = (self.auth.server + resource_path(kind)
+               + f"?watch=true&resourceVersion={resource_version}"
+               + "&allowWatchBookmarks=true")
+        async with s.get(url, timeout=None) as resp:
+            resp.raise_for_status()
+            buf = b""
+            async for chunk in resp.content.iter_any():
+                buf += chunk
+                while b"\n" in buf:
+                    line, _, buf = buf.partition(b"\n")
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        ev = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    etype = ev.get("type", "")
+                    obj = ev.get("object") or {}
+                    if etype == "BOOKMARK":
+                        continue
+                    if etype == "ERROR" or etype not in (
+                            "ADDED", "MODIFIED", "DELETED"):
+                        # in-stream error (e.g. 410 Gone: expired
+                        # resourceVersion) carries a Status object that
+                        # must never enter the cache — end the stream so
+                        # the caller re-lists
+                        raise RuntimeError(
+                            f"watch {kind}: server sent "
+                            f"{etype or 'untyped'} event")
+                    on_event(etype, obj)
+
+    async def patch_status(self, obj: dict,
+                           conditions: list[dict]) -> bool:
+        """merge-patch Accepted conditions onto the object's /status
+        (the reference's writeback, controller.go status updates)."""
+        kind = obj.get("kind", "")
+        meta = obj.get("metadata") or {}
+        path = resource_path(
+            kind, meta.get("namespace", ""), meta.get("name", ""))
+        s = await self.session()
+        url = self.auth.server + path + "/status"
+        patch = {"status": {"conditions": conditions}}
+        async with s.patch(
+            url, data=json.dumps(patch).encode(),
+            headers={"content-type": "application/merge-patch+json"},
+        ) as resp:
+            if resp.status >= 400:
+                logger.warning(
+                    "status patch %s/%s -> %d", kind,
+                    meta.get("name", ""), resp.status)
+                return False
+            return True
+
+
+class KubeSource:
+    """Object cache fed by list+watch on a dedicated thread. The
+    reconcile loop reads a consistent snapshot via ``objects()``; status
+    patches are shipped back through ``submit()`` onto the same loop."""
+
+    def __init__(self, auth: KubeAuth,
+                 kinds: tuple[str, ...] | None = None):
+        self.auth = auth
+        self.kinds = tuple(kinds or RESOURCES)
+        self._cache: dict[tuple[str, str, str], dict] = {}
+        self._lock = threading.Lock()
+        self._synced = threading.Event()
+        self._stopping = threading.Event()
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._client: KubeClient | None = None
+        self._synced_kinds: set[str] = set()
+        self.generation = 0  # bumped on every cache change
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="kube-source", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopping.set()
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(lambda: None)  # wake
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def wait_synced(self, timeout: float = 30.0) -> bool:
+        return self._synced.wait(timeout)
+
+    def _run(self) -> None:
+        asyncio.run(self._main())
+
+    async def _main(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._client = KubeClient(self.auth)
+        try:
+            tasks = [
+                asyncio.create_task(self._kind_loop(kind),
+                                    name=f"watch-{kind}")
+                for kind in self.kinds
+            ]
+            while not self._stopping.is_set():
+                await asyncio.sleep(0.2)
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+        finally:
+            await self._client.close()
+
+    async def _kind_loop(self, kind: str) -> None:
+        """list → watch → (on stream close/error) re-list, forever.
+        A kind whose CRD is not installed is polled slowly instead of
+        watched (installing the CRD later is picked up within 30s)."""
+        while not self._stopping.is_set():
+            try:
+                items, rv, installed = \
+                    await self._client.list_resource(kind)
+                with self._lock:
+                    for key in [k for k in self._cache if k[0] == kind]:
+                        del self._cache[key]
+                    for item in items:
+                        self._cache[self._key(item)] = item
+                    self.generation += 1
+                self._synced_kinds.add(kind)
+                if self._synced_kinds >= set(self.kinds):
+                    self._synced.set()
+                if not installed:
+                    await asyncio.sleep(30.0)
+                    continue
+                await self._client.watch_resource(kind, rv, self._event)
+            except asyncio.CancelledError:
+                return
+            except Exception as e:  # noqa: BLE001 — network flaps
+                logger.warning("watch %s failed: %s; re-listing", kind, e)
+                await asyncio.sleep(1.0)
+
+    @staticmethod
+    def _key(obj: dict) -> tuple[str, str, str]:
+        meta = obj.get("metadata") or {}
+        return (obj.get("kind", ""), meta.get("namespace", ""),
+                meta.get("name", ""))
+
+    def _event(self, etype: str, obj: dict) -> None:
+        if not obj.get("kind"):
+            return
+        with self._lock:
+            if etype == "DELETED":
+                self._cache.pop(self._key(obj), None)
+            else:  # ADDED / MODIFIED
+                self._cache[self._key(obj)] = obj
+            self.generation += 1
+
+    # -- reconcile-side API ----------------------------------------------
+    def objects(self) -> list[dict]:
+        with self._lock:
+            return [dict(o) for o in self._cache.values()]
+
+    def submit(self, coro) -> None:
+        """Run a coroutine on the source loop (status patches)."""
+        if self._loop is not None and not self._stopping.is_set():
+            asyncio.run_coroutine_threadsafe(coro, self._loop)
+
+    @property
+    def client(self) -> KubeClient:
+        assert self._client is not None
+        return self._client
+
+
+class KubeReconciler:
+    """The Reconciler's admission → compile → quarantine → conditions
+    pipeline (config/controller.py), fed from a KubeSource cache instead
+    of a manifest directory, with conditions written back onto each
+    object's ``status.conditions`` via the API — the reference's
+    controller shape (controller.go:117-330): `kubectl get` then shows
+    Accepted/NotAccepted exactly like the reference's columns.
+    """
+
+    def __init__(self, source: KubeSource,
+                 status_path: str | None = None):
+        from aigw_tpu.config.controller import Reconciler
+
+        self.source = source
+        # delegate: a Reconciler whose file-reading entry points we
+        # bypass; it keeps the condition memory + status file writing
+        if status_path is None:
+            # per-instance path: two gateways on one host must not
+            # clobber each other's report via a shared predictable name
+            fd, status_path = tempfile.mkstemp(
+                prefix="aigw-kube-status-", suffix=".json")
+            os.close(fd)
+        self._rec = Reconciler(directory=".", status_path=status_path)
+        self._patched: dict[str, str] = {}  # key → last patched checksum
+
+    def conditions(self) -> dict[str, dict[str, Any]]:
+        return self._rec.conditions()
+
+    def not_accepted(self) -> dict[str, dict[str, Any]]:
+        return self._rec.not_accepted()
+
+    def load(self):
+        """Compile the current cluster state; patch changed conditions
+        back onto the objects (status subresource, merge-patch)."""
+        from aigw_tpu.config.controller import _KIND_RANK, _obj_key
+
+        objects = self.source.objects()
+        objects.sort(key=lambda o: _KIND_RANK.get(o.get("kind", ""), 99))
+        cfg, errors = self._rec._reconcile(objects)
+        if self._rec._update_conditions(objects, errors, {}):
+            self._rec._write_status()
+        # status writeback: only our API group's kinds, and only when
+        # the condition for the object's current content hasn't been
+        # pushed yet (otherwise every reconcile tick re-patches and the
+        # watch event from our own patch re-triggers the reconcile)
+        conds = self._rec.conditions()
+        for obj in objects:
+            kind = obj.get("kind", "")
+            if kind not in STATUS_KINDS:
+                continue
+            key = _obj_key(obj)
+            cond = conds.get(key)
+            if cond is None:
+                continue
+            stamp = cond.get("observedChecksum", "") + cond["status"]
+            if self._patched.get(key) == stamp:
+                continue
+            # stamp optimistically (dedupes the in-flight window), but
+            # clear on failure so the next reconcile tick retries — a
+            # transient 403/blip must not leave `kubectl get` stale
+            # forever
+            self._patched[key] = stamp
+            k8s_cond = {
+                "type": "Accepted",
+                "status": cond["status"],
+                "reason": cond["reason"],
+                "message": cond["message"],
+                "lastTransitionTime": cond["lastTransitionTime"],
+                "observedGeneration": (
+                    (obj.get("metadata") or {}).get("generation", 0)),
+            }
+            self.source.submit(
+                self._patch_with_retry(obj, k8s_cond, key, stamp))
+        return cfg
+
+    async def _patch_with_retry(self, obj: dict, cond: dict, key: str,
+                                stamp: str) -> None:
+        try:
+            ok = await self.source.client.patch_status(obj, [cond])
+        except Exception as e:  # noqa: BLE001 — network flaps
+            logger.warning("status patch %s failed: %s", key, e)
+            ok = False
+        if not ok and self._patched.get(key) == stamp:
+            del self._patched[key]
+
+
+def parse_kube_target(target: str) -> KubeAuth:
+    """``kube:<kubeconfig-path>`` / ``kube:in-cluster`` / bare ``kube:``
+    (KUBECONFIG env, else ~/.kube/config, else in-cluster)."""
+    spec = target[len("kube:"):] if target.startswith("kube:") else target
+    if spec == "in-cluster":
+        return in_cluster_auth()
+    if not spec:
+        spec = os.environ.get("KUBECONFIG", "")
+        if not spec:
+            default = os.path.expanduser("~/.kube/config")
+            if os.path.exists(default):
+                spec = default
+            else:
+                return in_cluster_auth()
+    return load_kubeconfig(spec)

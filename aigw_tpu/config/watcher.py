@@ -18,7 +18,7 @@ from typing import Awaitable, Callable
 
 from aigw_tpu.config.bundle import read_bundle
 from aigw_tpu.config.controller import Reconciler, is_manifest_dir
-from aigw_tpu.config.model import Config, load_config
+from aigw_tpu.config.model import Config, ConfigError, load_config
 from aigw_tpu.config.runtime import RuntimeConfig
 
 logger = logging.getLogger(__name__)
@@ -40,15 +40,40 @@ class ConfigWatcher:
         self._task: asyncio.Task | None = None
         self._current: RuntimeConfig | None = None
         self._reconciler: Reconciler | None = None
+        self._kube_reconciler = None
+        self._kube_source = None
 
     def not_accepted(self) -> dict:
         """Per-object NOT-Accepted conditions from the reconciling
-        control plane (empty when the source isn't a manifest dir)."""
+        control plane (empty when the source isn't reconciled)."""
+        if self._kube_reconciler is not None:
+            return self._kube_reconciler.not_accepted()
         if self._reconciler is None:
             return {}
         return self._reconciler.not_accepted()
 
     def _load(self) -> Config:
+        if self.path.startswith("kube:"):
+            # live cluster source: list/watch CRDs, conditions patched
+            # back onto object status (config/kube.py — the reference's
+            # controller mode, controller.go:117-330)
+            if self._kube_reconciler is None:
+                from aigw_tpu.config.kube import (
+                    KubeReconciler,
+                    KubeSource,
+                    parse_kube_target,
+                )
+
+                source = KubeSource(parse_kube_target(self.path))
+                source.start()
+                if not source.wait_synced(60.0):
+                    source.stop()
+                    raise ConfigError(
+                        f"kube source {self.path!r} never synced "
+                        "(API server unreachable?)")
+                self._kube_source = source
+                self._kube_reconciler = KubeReconciler(source)
+            return self._kube_reconciler.load()
         if is_manifest_dir(self.path):
             if self._reconciler is None:
                 self._reconciler = Reconciler(self.path)
@@ -78,6 +103,10 @@ class ConfigWatcher:
             except asyncio.CancelledError:
                 pass
             self._task = None
+        if self._kube_source is not None:
+            await asyncio.to_thread(self._kube_source.stop)
+            self._kube_source = None
+            self._kube_reconciler = None
 
     async def _run(self) -> None:
         while True:

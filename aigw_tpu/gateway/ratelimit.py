@@ -159,6 +159,151 @@ class FileQuotaBackend:
             return used[client_key]
 
 
+class HTTPQuotaBackend:
+    """Network quota mode: counters live behind a tiny quota service
+    (`aigw quota-service`) so multi-*node* replicas with no shared
+    filesystem still enforce ONE budget — the role of the reference's
+    over-the-network ratelimit service fed by xDS
+    (internal/ratelimit/runner/runner.go:36-38). Selected with
+    AIGW_QUOTA_URL (takes precedence over AIGW_QUOTA_DIR).
+
+    Failure semantics are Envoy's ratelimit-filter default: **fail
+    open** — an unreachable quota service admits traffic (and skips the
+    draw-down) rather than turning a telemetry outage into an API
+    outage; every failure is logged.
+    """
+
+    def __init__(self, base_url: str, timeout: float = 3.0):
+        import threading
+        import urllib.parse
+
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        parts = urllib.parse.urlsplit(self.base_url)
+        self._https = parts.scheme == "https"
+        self._netloc = parts.netloc
+        self._prefix = parts.path.rstrip("/")
+        # keep-alive connection per calling thread (check/consume run on
+        # executor threads): per-call urlopen would cost a fresh TCP
+        # connect per quota operation and pile up TIME_WAIT sockets on
+        # the quota service at gateway QPS
+        self._local = threading.local()
+
+    def _conn(self):
+        import http.client
+
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            cls = (http.client.HTTPSConnection if self._https
+                   else http.client.HTTPConnection)
+            conn = cls(self._netloc, timeout=self.timeout)
+            self._local.conn = conn
+        return conn
+
+    def _drop_conn(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._local.conn = None
+
+    def _request(self, method: str, rule_name: str,
+                 payload: dict[str, Any]) -> int | None:
+        import logging
+        import urllib.parse
+
+        path = (f"{self._prefix}/v1/quota/"
+                f"{urllib.parse.quote(rule_name, safe='')}")
+        body = None
+        headers = {}
+        if method == "GET":
+            path += "?" + urllib.parse.urlencode(payload)
+        else:
+            body = json.dumps(payload).encode()
+            headers["content-type"] = "application/json"
+        # one retry on a fresh connection: a keep-alive socket the
+        # service closed between calls fails the first attempt benignly
+        for attempt in (0, 1):
+            try:
+                conn = self._conn()
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+                if resp.status >= 400:
+                    raise OSError(f"HTTP {resp.status}")
+                return int(json.loads(data).get("used", 0))
+            except Exception as e:  # noqa: BLE001 — fail open
+                self._drop_conn()
+                if attempt == 1:
+                    logging.getLogger(__name__).warning(
+                        "quota service %s %s failed (%s: %s); "
+                        "failing open", method, path,
+                        type(e).__name__, e)
+        return None
+
+    def get(self, rule_name: str, client_key: str,
+            window_start: float) -> int:
+        used = self._request("GET", rule_name, {
+            "key": client_key, "start": window_start})
+        return 0 if used is None else used
+
+    def add(self, rule_name: str, client_key: str, window_start: float,
+            amount: int) -> int:
+        used = self._request("POST", rule_name, {
+            "key": client_key, "start": window_start,
+            "amount": int(amount)})
+        return 0 if used is None else used
+
+
+def quota_service_app(directory: str):
+    """The quota service itself: an aiohttp app exposing
+    FileQuotaBackend's two operations over HTTP. State stays in flock'd
+    files, so the service can itself run replicated over a shared volume
+    — or singly, giving budget-sharing to gateways with no shared
+    filesystem at all. Run with `aigw quota-service`."""
+    import asyncio as _asyncio
+
+    from aiohttp import web
+
+    store = FileQuotaBackend(directory)
+
+    async def get_used(request: "web.Request") -> "web.Response":
+        rule = request.match_info["rule"]
+        key = request.query.get("key", "")
+        try:
+            start = float(request.query.get("start", "0"))
+        except ValueError:
+            return web.json_response({"error": "bad start"}, status=400)
+        used = await _asyncio.to_thread(store.get, rule, key, start)
+        return web.json_response({"used": used})
+
+    async def add_used(request: "web.Request") -> "web.Response":
+        rule = request.match_info["rule"]
+        try:
+            body = json.loads(await request.read())
+            if not isinstance(body, dict):
+                raise ValueError("body must be an object")
+            key = str(body.get("key", ""))
+            start = float(body["start"])
+            amount = int(body["amount"])
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+            return web.json_response({"error": "bad body"}, status=400)
+        used = await _asyncio.to_thread(
+            store.add, rule, key, start, amount)
+        return web.json_response({"used": used})
+
+    async def health(_request: "web.Request") -> "web.Response":
+        return web.json_response({"status": "ok"})
+
+    app = web.Application()
+    app.router.add_get("/v1/quota/{rule}", get_used)
+    app.router.add_post("/v1/quota/{rule}", add_used)
+    app.router.add_get("/health", health)
+    return app
+
+
 class RateLimiter:
     """In-process descriptor-keyed fixed-window limiter."""
 
@@ -195,8 +340,13 @@ class RateLimiter:
     def from_config_value(value: Any) -> "RateLimiter":
         rules = [QuotaRule.parse(v) for v in (value or ())]
         backend = None
+        quota_url = os.environ.get("AIGW_QUOTA_URL")
         quota_dir = os.environ.get("AIGW_QUOTA_DIR")
-        if rules and quota_dir:
+        if rules and quota_url:
+            # network mode wins: one budget across nodes with no shared
+            # filesystem (the reference's ratelimit-service topology)
+            backend = HTTPQuotaBackend(quota_url)
+        elif rules and quota_dir:
             backend = FileQuotaBackend(quota_dir)
         return RateLimiter(rules, backend=backend)
 
