@@ -16,6 +16,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import logging
+import os
 import signal
 import sys
 
@@ -142,6 +143,10 @@ def main(argv: list[str] | None = None) -> int:
                               "ticks interleaved (0 = off)")
     p_serve.add_argument("--decode-steps-per-tick", type=int, default=8,
                          help="fused decode steps per host round-trip")
+    p_serve.add_argument("--logprobs", type=int, default=0,
+                         help="enable per-token logprobs: max "
+                              "top_logprobs servable per request "
+                              "(0 = off; OpenAI caps requests at 20)")
     p_serve.add_argument("--spec-tokens", type=int, default=0,
                          help="prompt-lookup speculative decoding: draft "
                               "tokens verified per decode step (0 = off); "
@@ -297,6 +302,12 @@ def main(argv: list[str] | None = None) -> int:
               f"fallback {args.fallback_host}:{args.fallback_port}")
         for s in skipped:
             print(f"  python-path: {s}")
+        if cfg.llm_request_costs and args.access_log:
+            # without the tailer, native requests' costs are silently
+            # never computed — make the wiring requirement explicit
+            print(f"  REMINDER: run the gateway with "
+                  f"AIGW_CORE_ACCESS_LOG={args.access_log} so native "
+                  f"requests get spans + post-hoc cost accounting")
         return 0
 
     if args.cmd == "translate":
@@ -492,6 +503,21 @@ async def _run_gateway(args: argparse.Namespace,
     if watcher is not None:
         server.conditions_fn = watcher.not_accepted
         await watcher.start()
+    # native-core telemetry: when the C++ core's access log is shared
+    # with us (AIGW_CORE_ACCESS_LOG), tail it into real OTel spans and
+    # post-hoc CEL costs (obs/native_spans.py)
+    tailer = None
+    core_log = os.environ.get("AIGW_CORE_ACCESS_LOG", "")
+    if core_log:
+        from aigw_tpu.obs.native_spans import NativeLogTailer, make_cost_fn
+
+        tailer = NativeLogTailer(
+            core_log, server.tracer,
+            cost_fn=make_cost_fn(
+                lambda: getattr(holder.get("server"), "_runtime", None),
+                getattr(server, "_cost_sink", None)))
+        tailer.start()
+        print(f"native-core telemetry: tailing {core_log}", flush=True)
     print(f"gateway listening on http://{args.host}:{args.port}", flush=True)
     await _wait_for_signal()
     # Graceful drain (Envoy's listener-drain role in the reference's
@@ -510,6 +536,8 @@ async def _run_gateway(args: argparse.Namespace,
         await asyncio.sleep(drain)
     if watcher is not None:
         await watcher.stop()
+    if tailer is not None:
+        await asyncio.to_thread(tailer.stop)
     await runner.cleanup()
     return 0
 
@@ -546,6 +574,7 @@ async def _run_tpuserve(args: argparse.Namespace) -> int:
         prefill_chunk_tokens=args.prefill_chunk_tokens,
         spec_tokens=args.spec_tokens,
         pallas_attn=args.pallas_attn,
+        logprobs_topk=args.logprobs,
     )
     print(f"tpuserve listening on http://{args.host}:{args.port}", flush=True)
     await _wait_for_signal()

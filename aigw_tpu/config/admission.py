@@ -49,7 +49,23 @@ def validate(obj: dict[str, Any]) -> list[str]:
         return _validate_bsp(spec)
     if kind == "MCPRoute":
         return _validate_mcp(spec)
+    if kind == "QuotaPolicy":
+        return _validate_quota(spec)
     return []
+
+
+def _parse_duration(value: Any) -> float | None:
+    """Gateway-API Duration ("1h2m3s500ms") → seconds, None if unparseable."""
+    import re
+
+    if not isinstance(value, str):
+        return None
+    m = re.fullmatch(
+        r"(?:(\d+)h)?(?:(\d+)m)?(?:(\d+)s)?(?:(\d+)ms)?", value.strip())
+    if not m or not any(m.groups()):
+        return None
+    h, mi, sec, ms = (int(g) if g else 0 for g in m.groups())
+    return h * 3600 + mi * 60 + sec + ms / 1000.0
 
 
 def _validate_parent_refs(spec: dict[str, Any]) -> list[str]:
@@ -98,6 +114,13 @@ def _validate_route(spec: dict[str, Any]) -> list[str]:
                     "from inference.networking.k8s.io group is supported")
                 continue
             pools += 1
+        timeouts = rule.get("timeouts") or {}
+        req_t = _parse_duration(timeouts.get("request"))
+        be_t = _parse_duration(timeouts.get("backendRequest"))
+        if req_t is not None and be_t is not None and be_t > req_t:
+            errors.append(
+                f"spec.rules[{i}].timeouts: backendRequest timeout cannot "
+                "be longer than request timeout")
         if pools and non_pools:
             errors.append(
                 f"spec.rules[{i}]: cannot mix InferencePool and "
@@ -155,6 +178,19 @@ def _validate_bsp(spec: dict[str, Any]) -> list[str]:
             errors.append(
                 "spec.azureCredentials: exactly one of clientSecretRef or "
                 "oidcExchangeToken must be specified")
+    gcp = spec.get("gcpCredentials")
+    if gcp is not None:
+        wif = (gcp.get("workloadIdentityFederationConfig") is not None)
+        cred_file = (gcp.get("credentialsFile") is not None)
+        if wif and cred_file:
+            errors.append(
+                "spec.gcpCredentials: at most one of credentialsFile or "
+                "workloadIdentityFederationConfig may be specified")
+        if not wif and not cred_file:
+            errors.append(
+                "spec.gcpCredentials: exactly one of "
+                "GCPWorkloadIdentityFederationConfig or GCPCredentialsFile "
+                "must be specified")
     target_groups = {
         "AIServiceBackend": "aigateway.envoyproxy.io",
         "InferencePool": "inference.networking.k8s.io",
@@ -188,10 +224,29 @@ def _validate_mcp_tool_selector(sel: dict[str, Any],
     return errors
 
 
+_MCP_REF_GROUPS = {"", "multicluster.x-k8s.io", "gateway.envoyproxy.io"}
+_MCP_REF_KINDS = {"Service", "ServiceImport", "Backend"}
+
+
 def _validate_mcp(spec: dict[str, Any]) -> list[str]:
     errors = _validate_parent_refs(spec)
+    if spec.get("backendRef") is not None:
+        errors.append(
+            "spec: BackendRefs must be used, backendRef is not supported")
+    if not (spec.get("backendRefs") or ()):
+        errors.append("spec: backendRef or backendRefs needs to be set")
     seen: set[str] = set()
     for i, ref in enumerate(spec.get("backendRefs") or ()):
+        group = (ref or {}).get("group", "") or ""
+        rkind = (ref or {}).get("kind", "Service")
+        if group not in _MCP_REF_GROUPS:
+            errors.append(
+                f"spec.backendRefs[{i}]: BackendRefs only supports Core, "
+                "multicluster.x-k8s.io, and gateway.envoyproxy.io groups")
+        elif rkind not in _MCP_REF_KINDS:
+            errors.append(
+                f"spec.backendRefs[{i}]: BackendRefs only supports "
+                "Service, ServiceImport, and Backend kind")
         name = (ref or {}).get("name", "")
         if name in seen:
             errors.append(
@@ -247,4 +302,24 @@ def _validate_mcp(spec: dict[str, Any]) -> list[str]:
                     f"spec.securityPolicy.authorization.rules[{i}].source"
                     ".jwt.claims: 'scope' claim name is reserved for "
                     "OAuth scopes")
+    return errors
+
+
+def _validate_quota(spec: dict[str, Any]) -> list[str]:
+    errors = []
+    for i, ref in enumerate(spec.get("targetRefs") or ()):
+        if (ref or {}).get("kind", "AIServiceBackend") != \
+                "AIServiceBackend":
+            errors.append(
+                f"spec.targetRefs[{i}]: targetRefs must reference "
+                "AIServiceBackend resources")
+    for i, rule in enumerate(spec.get("rules") or ()):
+        for j, m in enumerate((rule or {}).get("matches") or ()):
+            if not any((m or {}).get(k) for k in (
+                    "headers", "methods", "path", "sourceCIDR",
+                    "queryParams")):
+                errors.append(
+                    f"spec.rules[{i}].matches[{j}]: at least one of "
+                    "headers, methods, path, sourceCIDR or queryParams "
+                    "must be specified")
     return errors

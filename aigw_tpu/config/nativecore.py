@@ -167,11 +167,27 @@ def compile_core_config(
     blocked = False
 
     if cfg.llm_request_costs:
-        skipped.append("global llm_request_costs need per-request token "
-                       "accounting (python path for all rules)")
-        blocked = True
+        if access_log_path:
+            # costs are computed post-hoc by the gateway's access-log
+            # tailer (obs/native_spans.py make_cost_fn) from the usage
+            # the core mines off the response tail — cost-bearing rules
+            # can go native when the log pipe exists
+            skipped.append(
+                "note: global llm_request_costs computed post-hoc from "
+                "the native access log (AIGW_CORE_ACCESS_LOG on the "
+                "gateway)")
+        else:
+            skipped.append(
+                "global llm_request_costs need the access-log pipe for "
+                "post-hoc accounting — pass --access-log and set "
+                "AIGW_CORE_ACCESS_LOG on the gateway (python path for "
+                "all rules)")
+            blocked = True
     if cfg.quotas:
-        skipped.append("quotas need per-request accounting "
+        # quotas ENFORCE at admission time (429 before the upstream
+        # call); post-hoc accounting can't do that, so quota-bearing
+        # configs stay on the Python path by design
+        skipped.append("quotas need request-time admission "
                        "(python path for all rules)")
         blocked = True
 

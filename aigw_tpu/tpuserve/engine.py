@@ -93,8 +93,19 @@ class EngineConfig:
     # reads scale with actual sequence lengths, not the padded window).
     # Single-chip only: ignored when the engine runs on a mesh.
     pallas_attn: bool = False
+    # Per-token logprobs (vLLM/OpenAI parity): when > 0, the decode scan
+    # also returns the chosen token's log-probability and the top-k
+    # (ids, values) per step, and requests may set want_logprobs. Static
+    # at trace time — 0 keeps the default decode program byte-identical.
+    # Mutually exclusive with spec_tokens (the verify step emits a
+    # variable number of tokens per step; logprob bookkeeping for
+    # rejected drafts is not worth the complexity).
+    logprobs_topk: int = 0
 
     def __post_init__(self) -> None:
+        if self.logprobs_topk > 0 and self.spec_tokens > 0:
+            raise ValueError(
+                "logprobs_topk and spec_tokens are mutually exclusive")
         if self.max_seq_len % self.page_size != 0:
             raise ValueError(
                 f"max_seq_len ({self.max_seq_len}) must be a multiple of "
@@ -125,6 +136,11 @@ class GenRequest:
     cancelled: threading.Event = field(default_factory=threading.Event)
     # LoRA adapter name ("" = base model)
     adapter: str = ""
+    # Per-token logprobs: when set (and the engine was built with
+    # logprobs_topk > 0), emit_lp is called INSTEAD of emit with
+    # (token, finish, logprob, top) where top = [(token_id, logprob)]
+    # of the engine's top-k (callers slice to the request's own k).
+    emit_lp: "Callable[[int, str | None, float | None, list | None], None] | None" = None
 
 
 @dataclass
@@ -289,12 +305,24 @@ class Engine:
         model_prefill = self.fns.prefill
         model_decode = self.fns.decode_step
 
+        def _sample_maybe_lp(logits, keys, temp, top_p, top_k):
+            """Sample; with logprobs enabled also return (chosen, top-k
+            ids/vals) over the distribution actually sampled from."""
+            sampled = sample(logits, keys, temp, top_p, top_k)
+            if not cfg.logprobs_topk:
+                return sampled
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            chosen = logp[jnp.arange(sampled.shape[0]), sampled]
+            tk_vals, tk_ids = jax.lax.top_k(logp, cfg.logprobs_topk)
+            return sampled, chosen, tk_ids, tk_vals
+
         def _prefill_step(params, lora, tokens, seq_lens, kv, page_table,
                           keys, temp, top_p, top_k, bias, adapter_idx):
             logits, kv = model_prefill(params, mc, tokens, seq_lens, kv,
                                        page_table, ps, lora=lora,
                                        adapter_idx=adapter_idx)
-            return sample(logits + bias, keys, temp, top_p, top_k), kv
+            return _sample_maybe_lp(logits + bias, keys, temp, top_p,
+                                    top_k), kv
 
         model_prefill_suffix = self.fns.prefill_suffix
 
@@ -305,7 +333,8 @@ class Engine:
                 params, mc, tokens, prefix_lens, seq_lens, kv, page_table,
                 ps, lora=lora, adapter_idx=adapter_idx,
             )
-            return sample(logits + bias, keys, temp, top_p, top_k), kv
+            return _sample_maybe_lp(logits + bias, keys, temp, top_p,
+                                    top_k), kv
 
         # sequence-parallel (ring attention) prefill for long prompts on
         # an sp mesh (SURVEY §2.9 context parallelism)
@@ -329,6 +358,7 @@ class Engine:
         def _decode_scan(params, lora, kv, state):
             """K fused decode+sample steps; sampled tokens feed forward
             on-device (no host round-trip inside the window)."""
+            lp_k = cfg.logprobs_topk
 
             def body(carry, _):
                 kv, st = carry
@@ -358,6 +388,14 @@ class Engine:
                     keys=st["keys"].at[:, 1].add(step),
                     counts=counts,
                 )
+                if lp_k:  # static: 0 compiles the exact round-3 program
+                    # logprobs over the PENALIZED distribution — the one
+                    # the token was actually sampled from
+                    logp = jax.nn.log_softmax(
+                        logits.astype(jnp.float32), axis=-1)
+                    chosen = logp[jnp.arange(B), sampled]
+                    tk_vals, tk_ids = jax.lax.top_k(logp, lp_k)
+                    return (kv, new), (sampled, chosen, tk_ids, tk_vals)
                 return (kv, new), sampled
 
             (kv, state), sampled = jax.lax.scan(
@@ -748,6 +786,17 @@ class Engine:
                     jnp.asarray(pt),
                     *sampling_args,
                 )
+            first_lp = None
+            if self.cfg.logprobs_topk and isinstance(next_tok, tuple):
+                next_tok, chosen, tk_ids, tk_vals = next_tok
+                first_lp = (
+                    float(np.asarray(chosen)[0]),
+                    [(int(t), float(v)) for t, v in zip(
+                        np.asarray(tk_ids)[0], np.asarray(tk_vals)[0])],
+                )
+            # note: the sequence-parallel (ring) prefill path does not
+            # compute logprobs — a request served through it omits the
+            # first token's logprob entry
             tok = int(next_tok[0])
             self.stats.prefills += 1
             if self.prefix_cache is not None and chain_keys:
@@ -763,7 +812,7 @@ class Engine:
                 key_seed=req.sampling.seed or seq_id,
                 limit=total, page_row=pt[0], adapter_row=adapter_row,
             )
-            self._emit_token(slot_idx, tok)
+            self._emit_token(slot_idx, tok, first_lp)
             self._state_dirty = True
             admitted = True
         return admitted
@@ -870,9 +919,14 @@ class Engine:
     def _process_window(self, sampled) -> None:
         """Consume one decode window's sampled tokens (blocks until the
         device finishes that window)."""
-        if isinstance(sampled, tuple):  # speculative window
+        if self._spec:  # speculative window (sampled, n_emit)
             self._process_spec_window(*sampled)
             return
+        lp = None
+        if isinstance(sampled, tuple):  # logprobs window
+            sampled, chosen, tk_ids, tk_vals = sampled
+            lp = (np.asarray(chosen), np.asarray(tk_ids),
+                  np.asarray(tk_vals))
         toks = np.asarray(sampled)  # [K, B]
         K = toks.shape[0]
         self.stats.decode_steps += K
@@ -882,7 +936,15 @@ class Engine:
                     continue  # free slot / finished earlier in this window
                 if not s.started:
                     continue  # admitted after this window was dispatched
-                self._emit_token(i, int(toks[k, i]))
+                step_lp = None
+                if lp is not None:
+                    chosen, tk_ids, tk_vals = lp
+                    step_lp = (
+                        float(chosen[k, i]),
+                        [(int(t), float(v))
+                         for t, v in zip(tk_ids[k, i], tk_vals[k, i])],
+                    )
+                self._emit_token(i, int(toks[k, i]), step_lp)
 
     def _process_spec_window(self, sampled: jax.Array,
                              n_emit: jax.Array) -> None:
@@ -950,21 +1012,33 @@ class Engine:
         self._refresh_stats()
         return True
 
-    def _emit_token(self, i: int, tok: int) -> None:
-        """Record one generated token for slot i; finish if stopping."""
+    def _emit_token(self, i: int, tok: int, lp=None) -> None:
+        """Record one generated token for slot i; finish if stopping.
+        ``lp`` = (chosen_logprob, [(top_id, top_logprob)]) when the
+        engine runs with logprobs_topk > 0."""
         s = self._slots[i]
         assert s is not None
         req = s.req
+
+        def _send(t: int, f: str | None) -> None:
+            if req.emit_lp is not None:
+                if lp is None or t < 0:
+                    req.emit_lp(t, f, None, None)
+                else:
+                    req.emit_lp(t, f, lp[0], lp[1])
+            else:
+                req.emit(t, f)
+
         s.generated += 1
         finish: str | None = None
         if tok in self.eos or tok in req.stop_token_ids:
             finish = "stop"
-            req.emit(-1, finish)
+            _send(-1, finish)
         else:
             s.pos += 1  # where `tok` will be written by the next decode
             if s.generated >= req.max_tokens or s.pos >= self.cfg.max_seq_len:
                 finish = "length"
-            req.emit(tok, finish)
+            _send(tok, finish)
         self.stats.tokens_generated += 1
         if finish is not None:
             self._pending_frees.append(req.id)

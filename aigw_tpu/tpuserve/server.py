@@ -234,14 +234,59 @@ class TPUServeServer:
         self._tok_pool.shutdown(wait=False)
 
     # -- helpers ----------------------------------------------------------
-    def _submit(self, prompt: list[int], body: dict[str, Any]):
+    def _check_logprobs(self, body: dict[str, Any]) -> int:
+        """Request logprobs knobs → top-k alternates to return per token
+        (-1 = logprobs off, 0 = chosen-token only). Raises SchemaError
+        (→400) on unservable asks. Two request dialects (OpenAI parity):
+        chat sends `logprobs: bool` + `top_logprobs: int`; legacy
+        /v1/completions sends `logprobs: int` (the alternate count,
+        0 meaning chosen-only). Caps: min(server --logprobs, 20)."""
+        raw = body.get("logprobs")
+        try:
+            if isinstance(raw, bool) or raw is None:
+                want = bool(raw)
+                top_n = int(body.get("top_logprobs") or 0)
+                if top_n and not want:
+                    raise oai.SchemaError(
+                        "top_logprobs requires logprobs: true")
+            else:  # legacy integer form
+                want = True
+                top_n = int(raw)
+        except (TypeError, ValueError):
+            raise oai.SchemaError(
+                "logprobs must be a boolean (chat) or integer (legacy); "
+                "top_logprobs must be an integer") from None
+        if top_n < 0:
+            raise oai.SchemaError("logprobs count must be >= 0")
+        if not want:
+            return -1
+        cap = self.engine.cfg.logprobs_topk
+        if cap <= 0:
+            raise oai.SchemaError(
+                "this server was started without --logprobs; "
+                "per-token logprobs are unavailable")
+        if top_n > min(cap, 20):
+            raise oai.SchemaError(
+                f"top_logprobs {top_n} exceeds the served maximum "
+                f"{min(cap, 20)}")
+        return top_n
+
+    def _submit(self, prompt: list[int], body: dict[str, Any],
+                lp_top_n: int = -1):
         """Submit to the engine; returns an asyncio.Queue of
-        (token_id, finish_reason) tuples."""
+        (token_id, finish_reason, lp) tuples — lp is None without
+        logprobs, else (chosen_logprob, [(top_id, top_logprob)]).
+        ``lp_top_n`` is the already-validated _check_logprobs value
+        (validated once per request; >= 0 attaches logprobs)."""
         loop = asyncio.get_running_loop()
         out: asyncio.Queue = asyncio.Queue()
 
         def emit(tok: int, finish: str | None) -> None:
-            loop.call_soon_threadsafe(out.put_nowait, (tok, finish))
+            loop.call_soon_threadsafe(out.put_nowait, (tok, finish, None))
+
+        def emit_lp(tok: int, finish: str | None, chosen, top) -> None:
+            lp = None if chosen is None else (chosen, top)
+            loop.call_soon_threadsafe(out.put_nowait, (tok, finish, lp))
 
         max_tokens = int(
             body.get("max_completion_tokens") or body.get("max_tokens") or 256
@@ -253,10 +298,39 @@ class TPUServeServer:
             sampling=SamplingParams.from_request(body),
             stop_token_ids=stop_ids,
             emit=emit,
+            emit_lp=emit_lp if lp_top_n >= 0 else None,
             adapter=self._resolve_adapter(str(body.get("model", ""))),
         )
         self.engine.submit(req)
         return out, req
+
+    @staticmethod
+    def _legacy_logprobs(entries: list[dict[str, Any]]) -> dict[str, Any]:
+        """OpenAI legacy /v1/completions logprobs shape from the chat
+        content entries (single source for all three response paths)."""
+        return {
+            "tokens": [e["token"] for e in entries],
+            "token_logprobs": [e["logprob"] for e in entries],
+            "top_logprobs": [
+                {t["token"]: t["logprob"] for t in e["top_logprobs"]}
+                for e in entries],
+        }
+
+    def _lp_entry(self, piece: str, lp, top_n: int) -> dict[str, Any]:
+        """One OpenAI logprobs content entry for an emitted token."""
+        chosen, top = lp
+        entry: dict[str, Any] = {
+            "token": piece,
+            "logprob": float(chosen),
+            "bytes": list(piece.encode("utf-8")),
+        }
+        tops = []
+        for tid, tval in (top or [])[:top_n]:
+            ttext = self.tokenizer.decode([int(tid)])
+            tops.append({"token": ttext, "logprob": float(tval),
+                         "bytes": list(ttext.encode("utf-8"))})
+        entry["top_logprobs"] = tops
+        return entry
 
     # -- endpoints --------------------------------------------------------
     async def _chat(self, request: web.Request) -> web.StreamResponse:
@@ -301,6 +375,14 @@ class TPUServeServer:
         chat: bool,
     ) -> web.StreamResponse:
         stream = bool(body.get("stream", False))
+        try:
+            # logprobs knobs validate to a client 400 up front — every
+            # branch below (incl. n>1) relies on it (the SchemaError
+            # catch around _submit is reserved for unknown-adapter → 404)
+            lp_top_n = self._check_logprobs(body)
+        except oai.SchemaError as e:
+            return web.Response(status=400, body=oai.error_body(str(e)),
+                                content_type="application/json")
         n = int(body.get("n") or 1)
         if n > 1:
             if stream:
@@ -315,7 +397,8 @@ class TPUServeServer:
                         f"n={n} exceeds max_batch_size "
                         f"{self.engine.cfg.max_batch_size}"),
                     content_type="application/json")
-            return await self._generate_n(body, prompt, chat, n)
+            return await self._generate_n(body, prompt, chat, n,
+                                          lp_top_n)
         include_usage = oai.include_stream_usage(body)
         rid = (
             f"chatcmpl-{uuid.uuid4().hex[:24]}"
@@ -335,7 +418,7 @@ class TPUServeServer:
             [stops] if isinstance(stops, str) else list(stops or [])
         )
         try:
-            out, gen_req = self._submit(prompt, body)
+            out, gen_req = self._submit(prompt, body, lp_top_n)
         except EngineOverloadedError as e:
             return web.Response(
                 status=429,
@@ -352,9 +435,11 @@ class TPUServeServer:
                                 content_type="application/json")
 
         n_prompt = len(prompt)
+        want_lp = lp_top_n >= 0
         if not stream:
             try:
-                text, n_out, finish = await self._collect(out, stop_strs)
+                text, n_out, finish, lp_content = await self._collect(
+                    out, stop_strs, lp_top_n)
             except asyncio.CancelledError:
                 gen_req.cancelled.set()
                 raise
@@ -376,6 +461,9 @@ class TPUServeServer:
                     model=self.model_name, content=text,
                     finish_reason=finish, usage=usage, response_id=rid,
                 )
+                if lp_content is not None:
+                    resp["choices"][0]["logprobs"] = {
+                        "content": lp_content}
             else:
                 resp = {
                     "id": rid,
@@ -387,6 +475,10 @@ class TPUServeServer:
                     ],
                     "usage": oai.usage_dict(usage),
                 }
+                if lp_content is not None:
+                    # legacy completions carry token_logprobs/tokens
+                    resp["choices"][0]["logprobs"] = \
+                        self._legacy_logprobs(lp_content)
             return web.json_response(resp)
 
         # streaming
@@ -401,17 +493,26 @@ class TPUServeServer:
         n_out = 0
         finish = "stop"
 
-        async def write_piece(piece: str) -> None:
-            if not piece:
+        async def write_piece(piece: str, lp_entry=None) -> None:
+            # an empty piece (mid-UTF-8 token) still carries its logprob
+            # entry so the streamed list aligns 1:1 with completion
+            # tokens; without logprobs, empty pieces emit nothing
+            if not piece and lp_entry is None:
                 return
             if chat:
                 await resp.write(
                     oai.stream_chunk_sse(
                         response_id=rid, model=self.model_name,
                         created=created, delta={"content": piece},
+                        logprobs={"content": [lp_entry]}
+                        if lp_entry is not None else None,
                     )
                 )
             else:
+                choice: dict[str, Any] = {"index": 0, "text": piece,
+                                          "finish_reason": None}
+                if lp_entry is not None:
+                    choice["logprobs"] = self._legacy_logprobs([lp_entry])
                 await resp.write(
                     SSEEvent(
                         data=json.dumps(
@@ -420,10 +521,7 @@ class TPUServeServer:
                                 "object": "text_completion",
                                 "created": created,
                                 "model": self.model_name,
-                                "choices": [
-                                    {"index": 0, "text": piece,
-                                     "finish_reason": None}
-                                ],
+                                "choices": [choice],
                             }
                         )
                     ).encode()
@@ -443,8 +541,8 @@ class TPUServeServer:
                 # intermediaries don't drop an apparently-idle stream
                 while True:
                     try:
-                        tok, fin = await asyncio.wait_for(out.get(),
-                                                          timeout=10.0)
+                        tok, fin, lp = await asyncio.wait_for(
+                            out.get(), timeout=10.0)
                         break
                     except asyncio.TimeoutError:
                         await resp.write(b": ping\n\n")
@@ -452,17 +550,24 @@ class TPUServeServer:
                     n_out += 1
                     rm.record_tokens_emitted(1)
                     piece = decoder.push(tok)
+                    lp_entry = (self._lp_entry(piece, lp, lp_top_n)
+                                if want_lp and lp is not None else None)
                     if piece:
                         emitted += piece
                         hit = _find_stop(emitted, stop_strs)
                         if hit is not None:
-                            # trim to just before the stop sequence
+                            # trim to just before the stop sequence; the
+                            # truncated final token keeps its lp entry
+                            # (1:1 token/entry alignment)
                             keep = hit - (len(emitted) - len(piece))
-                            await write_piece(piece[:max(keep, 0)])
+                            await write_piece(piece[:max(keep, 0)],
+                                              lp_entry)
                             finish = "stop"
                             gen_req.cancelled.set()
                             break
-                        await write_piece(piece)
+                        await write_piece(piece, lp_entry)
+                    elif lp_entry is not None:
+                        await write_piece("", lp_entry)
                 if fin is not None:
                     finish = fin
                     if fin != "error":
@@ -489,7 +594,8 @@ class TPUServeServer:
         return resp
 
     async def _generate_n(
-        self, body: dict[str, Any], prompt: list[int], chat: bool, n: int
+        self, body: dict[str, Any], prompt: list[int], chat: bool, n: int,
+        lp_top_n: int = -1,
     ) -> web.Response:
         """n>1 choices: fan out n engine requests (continuous batching
         runs them concurrently — same prompt pages shared by the prefix
@@ -506,7 +612,7 @@ class TPUServeServer:
                 per_choice["seed"] = (sampling.seed or 0) + i if (
                     sampling.seed or sampling.temperature > 0
                 ) else 0
-                outs.append(self._submit(prompt, per_choice))
+                outs.append(self._submit(prompt, per_choice, lp_top_n))
         except EngineOverloadedError as e:
             for _q, req in outs:  # don't orphan already-queued choices
                 req.cancelled.set()
@@ -516,7 +622,7 @@ class TPUServeServer:
                 headers={"retry-after": "1"},
                 content_type="application/json")
         results = await asyncio.gather(
-            *(self._collect(q, stop_strs) for q, _req in outs)
+            *(self._collect(q, stop_strs, lp_top_n) for q, _req in outs)
         )
         usage = TokenUsage(
             input_tokens=len(prompt),
@@ -526,12 +632,15 @@ class TPUServeServer:
         rid = (f"chatcmpl-{uuid.uuid4().hex[:24]}" if chat
                else f"cmpl-{uuid.uuid4().hex[:24]}")
         if chat:
-            choices = [
-                {"index": i,
-                 "message": {"role": "assistant", "content": text},
-                 "finish_reason": finish}
-                for i, (text, _n, finish) in enumerate(results)
-            ]
+            choices = []
+            for i, (text, _n, finish, lp_content) in enumerate(results):
+                c: dict[str, Any] = {
+                    "index": i,
+                    "message": {"role": "assistant", "content": text},
+                    "finish_reason": finish}
+                if lp_content is not None:
+                    c["logprobs"] = {"content": lp_content}
+                choices.append(c)
             resp = {
                 "id": rid, "object": "chat.completion",
                 "created": int(time.time()), "model": self.model_name,
@@ -542,34 +651,45 @@ class TPUServeServer:
                 "id": rid, "object": "text_completion",
                 "created": int(time.time()), "model": self.model_name,
                 "choices": [
-                    {"index": i, "text": text, "finish_reason": finish}
-                    for i, (text, _n, finish) in enumerate(results)
+                    {"index": i, "text": text, "finish_reason": finish,
+                     **({"logprobs": self._legacy_logprobs(lp_content)}
+                        if lp_content is not None else {})}
+                    for i, (text, _n, finish, lp_content)
+                    in enumerate(results)
                 ],
                 "usage": oai.usage_dict(usage),
             }
         return web.json_response(resp)
 
     async def _collect(
-        self, out: asyncio.Queue, stop_strs: list[str]
-    ) -> tuple[str, int, str]:
-        """Drain a generation to completion (non-streaming path)."""
+        self, out: asyncio.Queue, stop_strs: list[str],
+        lp_top_n: int = -1,
+    ) -> tuple[str, int, str, list | None]:
+        """Drain a generation to completion (non-streaming path).
+        ``lp_top_n >= 0`` also collects OpenAI logprobs content entries
+        (engine must run with logprobs_topk > 0)."""
         decoder = StreamingDecoder(self.tokenizer)
         text = ""
         n_out = 0
         finish = "stop"
+        lp_content: list | None = [] if lp_top_n >= 0 else None
         while True:
-            tok, fin = await out.get()
+            tok, fin, lp = await out.get()
             if tok >= 0:
                 n_out += 1
-                text += decoder.push(tok)
+                piece = decoder.push(tok)
+                text += piece
+                if lp_content is not None and lp is not None:
+                    lp_content.append(
+                        self._lp_entry(piece, lp, lp_top_n))
                 hit = _find_stop(text, stop_strs)
                 if hit is not None:
-                    return text[:hit], n_out, "stop"
+                    return text[:hit], n_out, "stop", lp_content
             if fin is not None:
                 finish = fin
                 if fin != "error":
                     text += decoder.flush()
-                return text, n_out, finish
+                return text, n_out, finish, lp_content
 
     async def _embeddings(self, request: web.Request) -> web.Response:
         try:
@@ -741,6 +861,7 @@ async def run_tpuserve(
     prefill_chunk_tokens: int = 0,
     spec_tokens: int = 0,
     pallas_attn: bool = False,
+    logprobs_topk: int = 0,
 ) -> web.AppRunner:
     server = TPUServeServer(
         model,
@@ -755,6 +876,7 @@ async def run_tpuserve(
             prefill_chunk_tokens=prefill_chunk_tokens,
             spec_tokens=spec_tokens,
             pallas_attn=pallas_attn,
+            logprobs_topk=logprobs_topk,
         ),
         tp=tp,
         ep=ep,

@@ -114,8 +114,11 @@ class TestHostedCountTokens:
             "/publishers/anthropic/models/count-tokens:rawPredict")
         assert json.loads(tx.body)["model"] == "claude-sonnet"
 
-    def test_bedrock_count_tokens_unregistered(self):
-        from aigw_tpu.translate import TranslationError
-
-        with pytest.raises(TranslationError):
-            get_translator(Endpoint.TOKENIZE, S.OPENAI, S.AWS_ANTHROPIC)
+    def test_bedrock_count_tokens_registered(self):
+        # round 4: tokenize→AWSAnthropic now exists via Bedrock's
+        # CountTokens API (tokenize_awsanthropic.go; tests in
+        # test_translate_chat.TestTokenizeAWSAnthropic)
+        t = get_translator(Endpoint.TOKENIZE, S.OPENAI, S.AWS_ANTHROPIC)
+        tx = t.request({"model": "anthropic.claude-3-haiku",
+                        "prompt": "hi"})
+        assert tx.path.endswith("/count-tokens")

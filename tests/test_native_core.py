@@ -711,7 +711,7 @@ class TestCoreConfigCompiler:
         assert core["rules"] == [] and any("translation" in s
                                            for s in skipped)
 
-    def test_costs_block_native(self):
+    def test_costs_block_native_without_log_pipe(self):
         cfg = Config.parse({
             "backends": [{"name": "one", "schema": {"name": "OpenAI"},
                           "url": "http://127.0.0.1:9001"}],
@@ -723,6 +723,23 @@ class TestCoreConfigCompiler:
         core, skipped = compile_core_config(cfg)
         assert core["rules"] == []
         assert any("llm_request_costs" in s for s in skipped)
+
+    def test_costs_native_with_access_log(self):
+        """VERDICT r3 item 4: cost-bearing rules become native-eligible
+        when the access-log pipe exists — costs are computed post-hoc
+        from mined usage (obs/native_spans.py make_cost_fn)."""
+        cfg = Config.parse({
+            "backends": [{"name": "one", "schema": {"name": "OpenAI"},
+                          "url": "http://127.0.0.1:9001"}],
+            "routes": [{"name": "r", "rules": [
+                {"models": ["m"], "backends": ["one"]}]}],
+            "llm_request_costs": [
+                {"metadata_key": "t", "type": "OutputToken"}],
+        })
+        core, skipped = compile_core_config(
+            cfg, access_log_path="/tmp/core.log")
+        assert len(core["rules"]) == 1  # strictly more eligible than r3
+        assert any("post-hoc" in s for s in skipped)
 
     def test_catch_all_rule_stops_compilation(self):
         cfg = Config.parse({
@@ -775,3 +792,253 @@ class TestCoreConfigCompiler:
         core, _ = compile_core_config(cfg)
         assert core["rules"][0]["model_prefix"] == "gpt-"
         assert core["rules"][0]["hostnames"] == ["api.acme.io"]
+
+
+class TestNativeSpansAndAccessLog:
+    """Round-4 native telemetry: span identity + relay result in the
+    access log, traceparent re-parenting on the upstream hop, usage
+    mining scoped to the real usage object, and the Python tailer that
+    turns log lines into OTel spans + post-hoc CEL costs."""
+
+    def _cfg_with_log(self, ports, tmp_path):
+        log = tmp_path / "core-access.log"
+        return {
+            "listen_host": "127.0.0.1",
+            "listen_port": ports["core"],
+            "fallback_host": "127.0.0.1",
+            "fallback_port": ports["fallback"],
+            "endpoints": ["/v1/chat/completions"],
+            "access_log_path": str(log),
+            "rules": [{
+                "model_exact": "m-a",
+                "backends": [{"name": "a", "host": "127.0.0.1",
+                              "port": ports["up_a"], "weight": 1,
+                              "priority": 0}],
+            }],
+        }, log
+
+    def test_span_identity_and_result_in_log(self, ports, tmp_path):
+        async def main():
+            import aiohttp
+
+            # upstream that echoes the traceparent it received
+            got_tp = {}
+
+            async def handler(request: web.Request) -> web.Response:
+                got_tp["tp"] = request.headers.get("traceparent", "")
+                return web.json_response({
+                    "ok": True,
+                    "usage": {"prompt_tokens": 3, "completion_tokens": 4,
+                              "total_tokens": 7},
+                })
+
+            app = web.Application()
+            app.router.add_route("*", "/{tail:.*}", handler)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", ports["up_a"])
+            await site.start()
+            cfg, log = self._cfg_with_log(ports, tmp_path)
+            proc = start_core(cfg, tmp_path)
+            try:
+                trace = "ab" * 16
+                parent = "cd" * 8
+                async with aiohttp.ClientSession() as s:
+                    status, _ = await _post(
+                        s, ports["core"], "/v1/chat/completions",
+                        {"model": "m-a"},
+                        headers={
+                            "traceparent": f"00-{trace}-{parent}-01"})
+                assert status == 200
+                deadline = time.time() + 5
+                entry = None
+                while time.time() < deadline:
+                    if log.exists() and log.read_text().strip():
+                        entry = json.loads(
+                            log.read_text().strip().splitlines()[-1])
+                        break
+                    await asyncio.sleep(0.05)
+                assert entry, "no access log line"
+                # span identity: same trace, new span, request's span as
+                # parent; upstream got OUR span as its parent
+                assert entry["trace_id"] == trace
+                assert entry["parent_span_id"] == parent
+                assert len(entry["span_id"]) == 16
+                assert entry["span_id"] != parent
+                assert entry["result"] == "complete"
+                assert entry["start_unix_ns"] > 0
+                assert got_tp["tp"] == (
+                    f"00-{trace}-{entry['span_id']}-01")
+                assert entry["usage"]["total_tokens"] == 7
+            finally:
+                proc.terminate()
+                proc.wait(timeout=5)
+                await runner.cleanup()
+
+        run(main())
+
+    def test_usage_scoped_to_usage_object(self, ports, tmp_path):
+        """A response whose CONTENT mentions '"prompt_tokens": 999' must
+        not override the real usage object (r3 advisor finding)."""
+
+        async def main():
+            import aiohttp
+
+            async def handler(request: web.Request) -> web.Response:
+                return web.json_response({
+                    "choices": [{"message": {"content":
+                        'the usage was {"prompt_tokens": 999, '
+                        '"completion_tokens": 888, '
+                        '"total_tokens": 1887}'}}],
+                    "usage": {"prompt_tokens": 3, "completion_tokens": 4,
+                              "total_tokens": 7},
+                })
+
+            app = web.Application()
+            app.router.add_route("*", "/{tail:.*}", handler)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", ports["up_a"])
+            await site.start()
+            cfg, log = self._cfg_with_log(ports, tmp_path)
+            proc = start_core(cfg, tmp_path)
+            try:
+                async with aiohttp.ClientSession() as s:
+                    status, _ = await _post(
+                        s, ports["core"], "/v1/chat/completions",
+                        {"model": "m-a"})
+                assert status == 200
+                deadline = time.time() + 5
+                entry = None
+                while time.time() < deadline:
+                    if log.exists() and log.read_text().strip():
+                        entry = json.loads(
+                            log.read_text().strip().splitlines()[-1])
+                        break
+                    await asyncio.sleep(0.05)
+                assert entry["usage"] == {
+                    "prompt_tokens": 3, "completion_tokens": 4,
+                    "total_tokens": 7}
+            finally:
+                proc.terminate()
+                proc.wait(timeout=5)
+                await runner.cleanup()
+
+        run(main())
+
+    def test_tailer_emits_spans_and_costs(self, tmp_path, capsys):
+        """The gateway-side tailer: one OTel span + CEL costs per native
+        log line, through the standard exporter."""
+        from aigw_tpu.config.runtime import RuntimeConfig
+        from aigw_tpu.obs.native_spans import NativeLogTailer, make_cost_fn
+        from aigw_tpu.obs.tracing import Tracer
+
+        log = tmp_path / "core.log"
+        log.write_text("")  # tailer skips history; create before start
+        tracer = Tracer(exporter="console")
+        rc = RuntimeConfig.build(Config.parse({
+            "backends": [{"name": "a", "schema": {"name": "OpenAI"},
+                          "url": "http://127.0.0.1:9001"}],
+            "routes": [{"name": "r", "rules": [
+                {"models": ["m-a"], "backends": ["a"]}]}],
+            "llm_request_costs": [
+                {"metadata_key": "total", "type": "TotalToken"},
+                {"metadata_key": "double_out", "type": "Expression",
+                 "expression": "output_tokens * 2"}],
+        }))
+        sunk = []
+        tailer = NativeLogTailer(
+            str(log), tracer,
+            cost_fn=make_cost_fn(lambda: rc,
+                                 lambda costs, meta: sunk.append(
+                                     (costs, meta))))
+        tailer.start()
+        try:
+            time.sleep(0.5)
+            with open(log, "a") as f:
+                f.write(json.dumps({
+                    "ts": "2026-07-29T00:00:00Z", "native": True,
+                    "path": "/v1/chat/completions", "model": "m-a",
+                    "backend": "a", "status": 200, "duration_ms": 12,
+                    "result": "complete",
+                    "trace_id": "ef" * 16, "span_id": "12" * 8,
+                    "parent_span_id": "34" * 8,
+                    "start_unix_ns": 1785300000000000000,
+                    "usage": {"prompt_tokens": 3, "completion_tokens": 4,
+                              "total_tokens": 7},
+                }) + "\n")
+            deadline = time.time() + 5
+            while time.time() < deadline and not sunk:
+                time.sleep(0.05)
+        finally:
+            tailer.stop()
+        assert sunk, "cost sink never fed"
+        costs, meta = sunk[0]
+        assert costs["total"] == 7
+        assert costs["double_out"] == 8
+        assert meta["native"] == "true"
+        err = capsys.readouterr().err
+        span = json.loads(err.strip().splitlines()[-1])
+        assert span["traceId"] == "ef" * 16
+        assert span["spanId"] == "12" * 8
+        assert span["parentSpanId"] == "34" * 8
+        assert span["attributes"]["gen_ai.usage.input_tokens"] == 3
+        assert span["attributes"]["aigw.native"] is True
+        assert span["endTimeUnixNano"] - span["startTimeUnixNano"] \
+            == 12_000_000
+
+    def test_anthropic_split_usage_mined(self, ports, tmp_path):
+        """Anthropic streaming puts input_tokens in message_start's
+        usage and only output_tokens in the final message_delta's usage;
+        per-key tail fallback must recover the prompt count while the
+        scoped object still wins for keys it contains."""
+
+        async def main():
+            import aiohttp
+
+            async def handler(request: web.Request) -> web.StreamResponse:
+                resp = web.StreamResponse(
+                    status=200,
+                    headers={"content-type": "text/event-stream"})
+                await resp.prepare(request)
+                await resp.write(
+                    b'event: message_start\ndata: {"type":"message_start",'
+                    b'"message":{"usage":{"input_tokens":11,'
+                    b'"output_tokens":1}}}\n\n')
+                await resp.write(
+                    b'event: message_delta\ndata: {"type":"message_delta",'
+                    b'"usage":{"output_tokens":9}}\n\n')
+                await resp.write_eof()
+                return resp
+
+            app = web.Application()
+            app.router.add_route("*", "/{tail:.*}", handler)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", ports["up_a"])
+            await site.start()
+            cfg, log = self._cfg_with_log(ports, tmp_path)
+            proc = start_core(cfg, tmp_path)
+            try:
+                async with aiohttp.ClientSession() as s:
+                    status, _ = await _post(
+                        s, ports["core"], "/v1/chat/completions",
+                        {"model": "m-a"})
+                assert status == 200
+                deadline = time.time() + 5
+                entry = None
+                while time.time() < deadline:
+                    if log.exists() and log.read_text().strip():
+                        entry = json.loads(
+                            log.read_text().strip().splitlines()[-1])
+                        break
+                    await asyncio.sleep(0.05)
+                assert entry["usage"]["prompt_tokens"] == 11
+                assert entry["usage"]["completion_tokens"] == 9
+                assert entry["usage"]["total_tokens"] == 20
+            finally:
+                proc.terminate()
+                proc.wait(timeout=5)
+                await runner.cleanup()
+
+        run(main())

@@ -613,3 +613,160 @@ def test_top_p_temperature_order():
         top_k=jnp.zeros((B,), jnp.int32),
     )
     assert (toks == 0).all(), toks
+
+
+@pytest.fixture(scope="module")
+def lp_url():
+    """tpuserve with --logprobs 5 (engine logprobs_topk=5)."""
+    from aiohttp import web
+
+    holder = {}
+    started = threading.Event()
+
+    def run():
+        async def main():
+            server = TPUServeServer(
+                "tiny-random",
+                EngineConfig(max_batch_size=2, max_seq_len=256,
+                             page_size=16, min_prefill_bucket=32,
+                             logprobs_topk=5),
+            )
+            runner = web.AppRunner(server.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            holder["port"] = site._server.sockets[0].getsockname()[1]
+            holder["loop"] = asyncio.get_running_loop()
+            started.set()
+            await asyncio.Event().wait()
+
+        try:
+            asyncio.run(main())
+        except RuntimeError:
+            pass
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(timeout=60)
+    yield f"http://127.0.0.1:{holder['port']}"
+    holder["loop"].call_soon_threadsafe(holder["loop"].stop)
+
+
+class TestLogprobs:
+    """Per-token logprobs (vLLM/OpenAI parity; the last translator-tail
+    item from the round-3 verdict: logprobs on the backend that supports
+    them — our own)."""
+
+    def test_engine_greedy_chosen_is_top1(self):
+        """Greedy sampling: the chosen token's logprob must equal the
+        top-1 entry, and the top-1 id must be the sampled token."""
+        cfg = EngineConfig(max_batch_size=2, max_seq_len=128, page_size=16,
+                           min_prefill_bucket=32, logprobs_topk=3)
+        params = llama.init_params(jax.random.PRNGKey(0), llama.TINY)
+        eng = Engine(params, llama.TINY, cfg, eos_token_ids=(257,))
+        eng.start()
+        try:
+            done = threading.Event()
+            rows = []
+
+            def emit_lp(tok, fin, chosen, top):
+                if tok >= 0:
+                    rows.append((tok, chosen, top))
+                if fin is not None:
+                    done.set()
+
+            eng.submit(GenRequest(
+                prompt=[1, 2, 3] * 12, max_tokens=6,
+                sampling=SamplingParams(temperature=0.0),
+                emit_lp=emit_lp))
+            assert done.wait(timeout=120)
+            assert rows
+            for tok, chosen, top in rows:
+                assert len(top) == 3
+                top_ids = [t for t, _ in top]
+                top_vals = [v for _, v in top]
+                assert tok == top_ids[0]  # greedy = argmax
+                assert chosen == pytest.approx(top_vals[0], abs=1e-5)
+                assert top_vals == sorted(top_vals, reverse=True)
+                assert all(v <= 0.0 for v in top_vals)  # log-probs
+        finally:
+            eng.stop()
+
+    def test_spec_and_logprobs_exclusive(self):
+        with pytest.raises(ValueError):
+            EngineConfig(logprobs_topk=3, spec_tokens=2)
+
+    def test_http_logprobs_content(self, lp_url):
+        status, body, _ = asyncio.run(_post(lp_url, "/v1/chat/completions", {
+            "model": "tiny-random",
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 4, "temperature": 0,
+            "logprobs": True, "top_logprobs": 2,
+        }))
+        assert status == 200, body
+        got = json.loads(body)
+        lp = got["choices"][0]["logprobs"]["content"]
+        assert len(lp) >= 1
+        for entry in lp:
+            assert "logprob" in entry and entry["logprob"] <= 0.0
+            assert len(entry["top_logprobs"]) == 2
+            assert isinstance(entry["bytes"], list)
+
+    def test_http_streaming_logprobs(self, lp_url):
+        async def main():
+            async with aiohttp.ClientSession() as s:
+                async with s.post(lp_url + "/v1/chat/completions", json={
+                    "model": "tiny-random",
+                    "messages": [{"role": "user", "content": "go"}],
+                    "max_tokens": 3, "temperature": 0,
+                    "stream": True, "logprobs": True,
+                }) as resp:
+                    assert resp.status == 200
+                    return (await resp.read()).decode()
+
+        text = asyncio.run(main())
+        chunks = [json.loads(line[6:])
+                  for line in text.splitlines()
+                  if line.startswith("data: ") and line != "data: [DONE]"]
+        lp_chunks = [c for c in chunks
+                     if c["choices"] and c["choices"][0].get("logprobs")]
+        assert lp_chunks, text
+        entry = lp_chunks[0]["choices"][0]["logprobs"]["content"][0]
+        assert entry["logprob"] <= 0.0
+
+    def test_logprobs_off_server_400(self, tpuserve_url):
+        status, body, _ = asyncio.run(_post(
+            tpuserve_url, "/v1/chat/completions", {
+                "model": "tiny-random",
+                "messages": [{"role": "user", "content": "x"}],
+                "max_tokens": 2, "logprobs": True,
+            }))
+        assert status == 400
+        assert "--logprobs" in json.loads(body)["error"]["message"]
+
+    def test_top_logprobs_over_cap_400(self, lp_url):
+        status, body, _ = asyncio.run(_post(lp_url, "/v1/chat/completions", {
+            "model": "tiny-random",
+            "messages": [{"role": "user", "content": "x"}],
+            "max_tokens": 2, "logprobs": True, "top_logprobs": 9,
+        }))
+        assert status == 400
+        assert "exceeds" in json.loads(body)["error"]["message"]
+
+    def test_top_logprobs_requires_logprobs(self, lp_url):
+        status, body, _ = asyncio.run(_post(lp_url, "/v1/chat/completions", {
+            "model": "tiny-random",
+            "messages": [{"role": "user", "content": "x"}],
+            "max_tokens": 2, "top_logprobs": 2,
+        }))
+        assert status == 400
+
+    def test_default_path_unchanged(self, tpuserve_url):
+        # a server without logprobs still serves plain requests
+        status, body, _ = asyncio.run(_post(
+            tpuserve_url, "/v1/chat/completions", {
+                "model": "tiny-random",
+                "messages": [{"role": "user", "content": "x"}],
+                "max_tokens": 2, "temperature": 0,
+            }))
+        assert status == 200
