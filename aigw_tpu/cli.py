@@ -101,6 +101,21 @@ def main(argv: list[str] | None = None) -> int:
                              "requests (model/backend/status/duration/"
                              "token usage per line)")
 
+    p_wh = sub.add_parser(
+        "webhook",
+        help="run the pod mutating webhook: injects the aigw gateway "
+             "sidecar into Envoy Gateway pods (the reference's "
+             "gateway_mutator role; K8s requires TLS — pass "
+             "--tls-cert/--tls-key)")
+    p_wh.add_argument("--host", default="0.0.0.0")
+    p_wh.add_argument("--port", type=int, default=9443)
+    p_wh.add_argument("--image", required=True,
+                      help="sidecar image (must provide `python -m "
+                           "aigw_tpu` as entrypoint)")
+    p_wh.add_argument("--gateway-port", type=int, default=1975)
+    p_wh.add_argument("--tls-cert", default="")
+    p_wh.add_argument("--tls-key", default="")
+
     p_quota = sub.add_parser(
         "quota-service",
         help="run the shared quota service: gateways on other nodes "
@@ -387,6 +402,33 @@ def main(argv: list[str] | None = None) -> int:
         except ConfigError as e:
             print(f"config error: {e}", file=sys.stderr)
             return 1
+    if args.cmd == "webhook":
+        import ssl as _ssl
+
+        from aiohttp import web as _web
+
+        from aigw_tpu.config.webhook import webhook_app
+
+        logging.basicConfig(level=logging.INFO)
+        app = webhook_app(args.image, port=args.gateway_port)
+        if bool(args.tls_cert) != bool(args.tls_key):
+            # half a TLS config must fail loudly — with failurePolicy
+            # Ignore on the API-server side, a silently-plain-HTTP
+            # webhook means pods are just never mutated
+            print("webhook: --tls-cert and --tls-key must be provided "
+                  "together", file=sys.stderr)
+            return 1
+        ssl_ctx = None
+        if args.tls_cert and args.tls_key:
+            ssl_ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
+            ssl_ctx.load_cert_chain(args.tls_cert, args.tls_key)
+        print(f"webhook listening on "
+              f"{'https' if ssl_ctx else 'http'}://{args.host}:{args.port}"
+              f"/mutate (sidecar image {args.image})", flush=True)
+        _web.run_app(app, host=args.host, port=args.port,
+                     ssl_context=ssl_ctx, print=None)
+        return 0
+
     if args.cmd == "quota-service":
         from aiohttp import web as _web
 

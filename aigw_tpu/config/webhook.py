@@ -1,0 +1,140 @@
+"""Pod mutating webhook — sidecar injection for gateway pods.
+
+The reference's controller registers a MutatingWebhookConfiguration and
+mutates Envoy Gateway pods to inject the extproc container
+(internal/controller/gateway_mutator.go:126 `Default`, :201
+`ai-gateway-extproc` container; cmd/controller/main.go wires the
+webhook server). Here the injected sidecar is the aigw gateway itself
+running against the cluster (`aigw run kube:in-cluster`) — pods labeled
+with the owning-gateway labels get the container; everything else is
+admitted untouched.
+
+Wire protocol is the standard admission.k8s.io/v1 AdmissionReview:
+Kubernetes POSTs a JSON AdmissionReview, the response carries a
+base64-encoded RFC 6902 JSONPatch. Run with `aigw webhook` (K8s
+requires TLS on webhook endpoints — pass --tls-cert/--tls-key; the
+plain-HTTP mode exists for tests and mesh-terminated TLS).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+#: the labels Envoy Gateway stamps on the pods it owns (the reference
+#: keys its mutation on the same pair, gateway_mutator.go:131-132)
+OWNING_GATEWAY_NAME_LABEL = "gateway.envoyproxy.io/owning-gateway-name"
+OWNING_GATEWAY_NAMESPACE_LABEL = \
+    "gateway.envoyproxy.io/owning-gateway-namespace"
+
+SIDECAR_NAME = "ai-gateway-sidecar"  # ≈ reference's ai-gateway-extproc
+
+
+def build_sidecar(
+    image: str,
+    *,
+    port: int = 1975,
+    log_level: str = "info",
+    extra_env: list[dict[str, str]] | None = None,
+) -> dict[str, Any]:
+    """The injected container spec: the full gateway, configured from
+    the cluster's CRDs via the in-cluster kube source.
+
+    RBAC: the sidecar runs under the POD's service account (Envoy
+    Gateway's), which needs list/watch on the aigw CRD kinds and patch
+    on their /status — the chart ships a ClusterRole + binding for it
+    (charts/aigw-tpu/templates/webhook.yaml, values
+    webhook.envoyGatewayServiceAccount). Without it the sidecar's
+    in-cluster list 403s and the container crash-loops."""
+    return {
+        "name": SIDECAR_NAME,
+        "image": image,
+        "args": ["run", "kube:in-cluster",
+                 "--host", "0.0.0.0",
+                 "--port", str(port),
+                 "--log-level", log_level],
+        "ports": [{"containerPort": port, "name": "aigw"}],
+        "env": list(extra_env or ()),
+        "readinessProbe": {
+            "httpGet": {"path": "/health", "port": port},
+            "initialDelaySeconds": 2,
+            "periodSeconds": 5,
+        },
+    }
+
+
+def mutate_pod(pod: dict[str, Any], image: str,
+               **sidecar_kwargs: Any) -> list[dict[str, Any]]:
+    """JSONPatch ops injecting the gateway sidecar, or [] when the pod
+    is not a gateway pod / already carries the sidecar (idempotent —
+    webhooks re-fire on every pod update)."""
+    labels = (pod.get("metadata") or {}).get("labels") or {}
+    if not labels.get(OWNING_GATEWAY_NAME_LABEL):
+        return []
+    spec = pod.get("spec") or {}
+    containers = spec.get("containers") or []
+    if any(c.get("name") == SIDECAR_NAME for c in containers):
+        return []
+    sidecar = build_sidecar(image, **sidecar_kwargs)
+    if not containers:
+        return [{"op": "add", "path": "/spec/containers",
+                 "value": [sidecar]}]
+    return [{"op": "add", "path": "/spec/containers/-",
+             "value": sidecar}]
+
+
+def review_response(review: dict[str, Any], image: str,
+                    **sidecar_kwargs: Any) -> dict[str, Any]:
+    """AdmissionReview in → AdmissionReview out (always allowed; a
+    telemetry/injection failure must never block pod creation — the
+    reference's webhook has failurePolicy Ignore semantics for the same
+    reason)."""
+    request = review.get("request") or {}
+    uid = request.get("uid", "")
+    response: dict[str, Any] = {"uid": uid, "allowed": True}
+    try:
+        # mutate_pod is a safe no-op for anything without the
+        # owning-gateway label (and the webhook rules already restrict
+        # to pods) — no extra kind-sniffing needed
+        obj = request.get("object") or {}
+        patch = mutate_pod(obj, image, **sidecar_kwargs)
+        if patch:
+            response["patchType"] = "JSONPatch"
+            response["patch"] = base64.b64encode(
+                json.dumps(patch).encode()).decode()
+            name = (obj.get("metadata") or {}).get("name", "?")
+            logger.info("injecting %s into pod %s", SIDECAR_NAME, name)
+    except Exception:  # noqa: BLE001 — admission must not block pods
+        logger.warning("pod mutation failed; admitting unmodified",
+                       exc_info=True)
+    return {
+        "apiVersion": review.get("apiVersion", "admission.k8s.io/v1"),
+        "kind": "AdmissionReview",
+        "response": response,
+    }
+
+
+def webhook_app(image: str, **sidecar_kwargs: Any):
+    """aiohttp app serving POST /mutate (and /health)."""
+    from aiohttp import web
+
+    async def mutate(request: "web.Request") -> "web.Response":
+        try:
+            review = json.loads(await request.read())
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"},
+                                     status=400)
+        return web.json_response(
+            review_response(review, image, **sidecar_kwargs))
+
+    async def health(_request: "web.Request") -> "web.Response":
+        return web.json_response({"status": "ok"})
+
+    app = web.Application()
+    app.router.add_post("/mutate", mutate)
+    app.router.add_get("/health", health)
+    return app

@@ -1,0 +1,146 @@
+"""Pod mutating webhook — sidecar injection (inventory §2.2 #13; the
+reference's gateway_mutator.go:126 Default + ai-gateway-extproc
+container). Speaks real admission.k8s.io/v1 AdmissionReview over HTTP:
+the tests POST review payloads the way the API server would and decode
+the base64 JSONPatch from the response."""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+
+import aiohttp
+from aiohttp import web
+
+from aigw_tpu.config.webhook import (
+    OWNING_GATEWAY_NAME_LABEL,
+    OWNING_GATEWAY_NAMESPACE_LABEL,
+    SIDECAR_NAME,
+    mutate_pod,
+    review_response,
+    webhook_app,
+)
+
+IMAGE = "registry.example/aigw-tpu:4"
+
+
+def _gateway_pod(with_sidecar: bool = False) -> dict:
+    containers = [{"name": "envoy", "image": "envoyproxy/envoy:v1.31"}]
+    if with_sidecar:
+        containers.append({"name": SIDECAR_NAME, "image": IMAGE})
+    return {
+        "kind": "Pod",
+        "metadata": {
+            "name": "eg-gw-abc",
+            "labels": {
+                OWNING_GATEWAY_NAME_LABEL: "gw-1",
+                OWNING_GATEWAY_NAMESPACE_LABEL: "default",
+            },
+        },
+        "spec": {"containers": containers},
+    }
+
+
+def _review(pod: dict) -> dict:
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {
+            "uid": "req-123",
+            "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "object": pod,
+        },
+    }
+
+
+class TestMutatePod:
+    def test_injects_sidecar_into_gateway_pod(self):
+        patch = mutate_pod(_gateway_pod(), IMAGE, port=1975)
+        assert len(patch) == 1
+        assert patch[0]["op"] == "add"
+        assert patch[0]["path"] == "/spec/containers/-"
+        sidecar = patch[0]["value"]
+        assert sidecar["name"] == SIDECAR_NAME
+        assert sidecar["image"] == IMAGE
+        assert "kube:in-cluster" in sidecar["args"]
+        assert sidecar["readinessProbe"]["httpGet"]["path"] == "/health"
+
+    def test_non_gateway_pod_untouched(self):
+        pod = {"kind": "Pod", "metadata": {"name": "app",
+                                           "labels": {"app": "x"}},
+               "spec": {"containers": [{"name": "c"}]}}
+        assert mutate_pod(pod, IMAGE) == []
+
+    def test_idempotent_on_refire(self):
+        # webhooks re-fire on pod updates; a second mutation must no-op
+        assert mutate_pod(_gateway_pod(with_sidecar=True), IMAGE) == []
+
+    def test_patch_applies_cleanly(self):
+        pod = _gateway_pod()
+        patch = mutate_pod(pod, IMAGE)
+        # apply the RFC6902 add op the way the API server would
+        assert patch[0]["path"] == "/spec/containers/-"
+        pod["spec"]["containers"].append(patch[0]["value"])
+        assert [c["name"] for c in pod["spec"]["containers"]] == [
+            "envoy", SIDECAR_NAME]
+
+
+class TestAdmissionReview:
+    def test_review_roundtrip_with_patch(self):
+        out = review_response(_review(_gateway_pod()), IMAGE)
+        resp = out["response"]
+        assert resp["uid"] == "req-123"
+        assert resp["allowed"] is True
+        assert resp["patchType"] == "JSONPatch"
+        patch = json.loads(base64.b64decode(resp["patch"]))
+        assert patch[0]["value"]["name"] == SIDECAR_NAME
+
+    def test_review_no_patch_for_plain_pod(self):
+        pod = {"kind": "Pod", "metadata": {"name": "p", "labels": {}},
+               "spec": {"containers": []}}
+        out = review_response(_review(pod), IMAGE)
+        assert out["response"]["allowed"] is True
+        assert "patch" not in out["response"]
+
+    def test_malformed_object_still_admits(self):
+        # failurePolicy-Ignore semantics: never block pod creation
+        out = review_response(
+            {"request": {"uid": "u1", "object": {"spec": 42}}}, IMAGE)
+        assert out["response"]["allowed"] is True
+
+
+class TestWebhookHTTP:
+    def test_mutate_endpoint_over_http(self):
+        async def main():
+            app = webhook_app(IMAGE, port=2080)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        f"http://127.0.0.1:{port}/mutate",
+                        json=_review(_gateway_pod()),
+                    ) as r:
+                        assert r.status == 200
+                        out = await r.json()
+                    patch = json.loads(base64.b64decode(
+                        out["response"]["patch"]))
+                    sidecar = patch[0]["value"]
+                    assert sidecar["ports"][0]["containerPort"] == 2080
+                    # bad JSON → 400, not 500
+                    async with s.post(
+                        f"http://127.0.0.1:{port}/mutate",
+                        data=b"{not json",
+                    ) as r:
+                        assert r.status == 400
+                    async with s.get(
+                        f"http://127.0.0.1:{port}/health") as r:
+                        assert r.status == 200
+            finally:
+                await runner.cleanup()
+
+        asyncio.run(main())
