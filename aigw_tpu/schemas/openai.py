@@ -106,13 +106,32 @@ def _validate_content(i: int, role: str, content: Any) -> None:
                 raise SchemaError(
                     f"messages[{i}].content[{j}].image_url must be an "
                     "object")
-        else:  # assistant/system/developer/tool accept text (+ assistant
-            # refusal) parts
+        else:  # assistant/system/developer/tool: text, plus assistant
+            # refusal and replayed thinking/redacted_thinking parts
+            # (openai.go:602-612 assistant content types; clients echo
+            # thinking blocks from a previous turn)
             if ptype == "refusal" and role == "assistant":
                 if not isinstance(part.get("refusal"), str):
                     raise SchemaError(
                         f"messages[{i}].content[{j}].refusal must be a "
                         "string")
+                continue
+            if ptype == "thinking" and role == "assistant":
+                if not isinstance(part.get("text"), str):
+                    raise SchemaError(
+                        f"messages[{i}].content[{j}].text must be a "
+                        "string for thinking parts")
+                sig = part.get("signature")
+                if sig is not None and not isinstance(sig, str):
+                    raise SchemaError(
+                        f"messages[{i}].content[{j}].signature must be "
+                        "a string")
+                continue
+            if ptype == "redacted_thinking" and role == "assistant":
+                if not isinstance(part.get("redactedContent"), str):
+                    raise SchemaError(
+                        f"messages[{i}].content[{j}].redactedContent "
+                        "must be a string")
                 continue
             if ptype != "text":
                 raise SchemaError(

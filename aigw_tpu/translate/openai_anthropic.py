@@ -56,10 +56,8 @@ def openai_messages_to_anthropic(
         elif role == "user":
             push("user", _user_content_blocks(m.get("content")))
         elif role == "assistant":
-            blocks: list[dict[str, Any]] = []
-            text = oai.message_content_text(m.get("content"))
-            if text:
-                blocks.append({"type": "text", "text": text})
+            blocks: list[dict[str, Any]] = _assistant_content_blocks(
+                m.get("content"))
             for tc in m.get("tool_calls") or ():
                 fn = tc.get("function") or {}
                 try:
@@ -90,6 +88,52 @@ def openai_messages_to_anthropic(
         else:
             raise TranslationError(f"unsupported message role {role!r}")
     return "\n".join(p for p in system_parts if p), out
+
+
+def _assistant_content_blocks(content: Any) -> list[dict[str, Any]]:
+    """Assistant content union → Anthropic blocks. Beyond plain text,
+    the array form carries thinking/redacted_thinking parts that clients
+    replay from a previous turn (anthropic_helper.go:368-399
+    processAssistantContent): thinking needs BOTH text and signature —
+    Anthropic rejects unsigned thinking blocks when thinking is on —
+    and refusal parts become text."""
+    if content is None:
+        return []
+    if isinstance(content, str):
+        return [{"type": "text", "text": content}] if content else []
+    if isinstance(content, dict):
+        content = [content]
+    if not isinstance(content, list):
+        # unvalidated callers (/tokenize) reach here with raw bodies —
+        # malformed content must 400, not 500
+        raise oai.SchemaError(
+            "assistant content must be a string or an array of parts")
+    blocks: list[dict[str, Any]] = []
+    for part in content:
+        if not isinstance(part, dict):
+            continue  # same tolerance as message_content_text
+        ptype = part.get("type")
+        if ptype == "text":
+            if part.get("text"):
+                blocks.append({"type": "text", "text": part["text"]})
+        elif ptype == "refusal":
+            if part.get("refusal"):
+                blocks.append({"type": "text", "text": part["refusal"]})
+        elif ptype == "thinking":
+            if part.get("text") and part.get("signature"):
+                blocks.append({
+                    "type": "thinking",
+                    "thinking": part["text"],
+                    "signature": part["signature"],
+                })
+        elif ptype == "redacted_thinking":
+            data = part.get("redactedContent")
+            if isinstance(data, str):
+                blocks.append({"type": "redacted_thinking", "data": data})
+        else:
+            raise TranslationError(
+                f"unsupported assistant content part {ptype!r}")
+    return blocks
 
 
 def _user_content_blocks(content: Any) -> list[dict[str, Any]]:

@@ -43,6 +43,50 @@ _STOP_TO_OPENAI = {
 }
 
 
+def _assistant_blocks(content) -> list[dict[str, Any]]:
+    """Assistant content union → Converse blocks. Array parts carry
+    replayed thinking/redacted_thinking blocks
+    (openai_awsbedrock.go:362-399: thinking → reasoningContent.
+    reasoningText{text, signature}; redacted → redactedContent);
+    refusal parts become text."""
+    if content is None:
+        return []
+    if isinstance(content, str):
+        return [{"text": content}] if content else []
+    if isinstance(content, dict):
+        content = [content]
+    if not isinstance(content, list):
+        raise oai.SchemaError(
+            "assistant content must be a string or an array of parts")
+    blocks: list[dict[str, Any]] = []
+    for part in content:
+        if not isinstance(part, dict):
+            continue  # same tolerance as message_content_text
+        ptype = part.get("type")
+        if ptype == "text":
+            if part.get("text"):
+                blocks.append({"text": part["text"]})
+        elif ptype == "refusal":
+            if part.get("refusal"):
+                blocks.append({"text": part["refusal"]})
+        elif ptype == "thinking":
+            if part.get("text"):
+                rt: dict[str, Any] = {"text": part["text"]}
+                if part.get("signature"):
+                    rt["signature"] = part["signature"]
+                blocks.append(
+                    {"reasoningContent": {"reasoningText": rt}})
+        elif ptype == "redacted_thinking":
+            data = part.get("redactedContent")
+            if isinstance(data, str):
+                blocks.append(
+                    {"reasoningContent": {"redactedContent": data}})
+        else:
+            raise TranslationError(
+                f"unsupported assistant content part {ptype!r}")
+    return blocks
+
+
 def openai_messages_to_converse(
     messages: list[dict[str, Any]],
 ) -> tuple[list[dict[str, Any]], list[dict[str, Any]]]:
@@ -67,10 +111,8 @@ def openai_messages_to_converse(
         elif role == "user":
             push("user", _user_blocks(m.get("content")))
         elif role == "assistant":
-            blocks: list[dict[str, Any]] = []
-            text = oai.message_content_text(m.get("content"))
-            if text:
-                blocks.append({"text": text})
+            blocks: list[dict[str, Any]] = _assistant_blocks(
+                m.get("content"))
             for tc in m.get("tool_calls") or ():
                 fn = tc.get("function") or {}
                 try:

@@ -691,3 +691,83 @@ class TestMultipartModelRewrite:
 
         out, ctype = rewrite_multipart_model(b"{}", "application/json", "m")
         assert out == b"{}"
+
+
+class TestAssistantThinkingReplay:
+    """Multi-turn thinking: clients replay the previous turn's thinking
+    blocks as assistant content parts; they must reach the backend in
+    its native shape (anthropic_helper.go:368-399 processAssistantContent;
+    openai_awsbedrock.go:362-399 reasoningContent)."""
+
+    MESSAGES = [
+        {"role": "user", "content": "solve it"},
+        {"role": "assistant", "content": [
+            {"type": "thinking", "text": "let me think...",
+             "signature": "sig-abc"},
+            {"type": "redacted_thinking", "redactedContent": "b64data"},
+            {"type": "text", "text": "the answer is 4"},
+        ]},
+        {"role": "user", "content": "why?"},
+    ]
+
+    def test_anthropic_thinking_blocks(self):
+        from aigw_tpu.translate.openai_anthropic import (
+            openai_messages_to_anthropic,
+        )
+
+        _, msgs = openai_messages_to_anthropic(self.MESSAGES)
+        blocks = msgs[1]["content"]
+        assert blocks[0] == {"type": "thinking",
+                             "thinking": "let me think...",
+                             "signature": "sig-abc"}
+        assert blocks[1] == {"type": "redacted_thinking",
+                             "data": "b64data"}
+        assert blocks[2] == {"type": "text", "text": "the answer is 4"}
+
+    def test_anthropic_unsigned_thinking_dropped(self):
+        # Anthropic rejects unsigned thinking blocks; the reference only
+        # forwards thinking with BOTH text and signature
+        from aigw_tpu.translate.openai_anthropic import (
+            openai_messages_to_anthropic,
+        )
+
+        _, msgs = openai_messages_to_anthropic([
+            {"role": "assistant", "content": [
+                {"type": "thinking", "text": "unsigned"},
+                {"type": "text", "text": "t"}]},
+        ])
+        assert msgs[0]["content"] == [{"type": "text", "text": "t"}]
+
+    def test_refusal_becomes_text(self):
+        from aigw_tpu.translate.openai_anthropic import (
+            openai_messages_to_anthropic,
+        )
+
+        _, msgs = openai_messages_to_anthropic([
+            {"role": "assistant", "content": [
+                {"type": "refusal", "refusal": "I cannot do that"}]},
+        ])
+        assert msgs[0]["content"] == [
+            {"type": "text", "text": "I cannot do that"}]
+
+    def test_bedrock_reasoning_content(self):
+        from aigw_tpu.translate.openai_awsbedrock import (
+            openai_messages_to_converse,
+        )
+
+        _, msgs = openai_messages_to_converse(self.MESSAGES)
+        blocks = msgs[1]["content"]
+        assert blocks[0] == {"reasoningContent": {"reasoningText": {
+            "text": "let me think...", "signature": "sig-abc"}}}
+        assert blocks[1] == {"reasoningContent": {
+            "redactedContent": "b64data"}}
+        assert blocks[2] == {"text": "the answer is 4"}
+
+    def test_plain_string_content_unchanged(self):
+        from aigw_tpu.translate.openai_anthropic import (
+            openai_messages_to_anthropic,
+        )
+
+        _, msgs = openai_messages_to_anthropic([
+            {"role": "assistant", "content": "plain"}])
+        assert msgs[0]["content"] == [{"type": "text", "text": "plain"}]

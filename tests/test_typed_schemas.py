@@ -458,3 +458,28 @@ class TestGatewayRejectsBeforeUpstream:
                 await stop_env(runner, ups)
 
         run(main())
+
+
+class TestAssistantThinkingParts:
+    """Replayed thinking blocks must pass chat validation (the gateway
+    otherwise 400s multi-turn thinking conversations before translation;
+    reference accepts them, openai.go:602-612)."""
+
+    def test_thinking_parts_accepted(self):
+        ok("/v1/chat/completions", {"model": "m", "messages": [
+            {"role": "user", "content": "q"},
+            {"role": "assistant", "content": [
+                {"type": "thinking", "text": "t", "signature": "s"},
+                {"type": "redacted_thinking", "redactedContent": "x"},
+                {"type": "text", "text": "a"}]},
+        ]})
+
+    def test_thinking_text_must_be_string(self):
+        bad("/v1/chat/completions", {"model": "m", "messages": [
+            {"role": "assistant", "content": [
+                {"type": "thinking", "text": 42}]}]}, "thinking")
+
+    def test_thinking_not_valid_for_user(self):
+        bad("/v1/chat/completions", {"model": "m", "messages": [
+            {"role": "user", "content": [
+                {"type": "thinking", "text": "t"}]}]}, "invalid type")
