@@ -117,10 +117,11 @@ def _validate_content(i: int, role: str, content: Any) -> None:
                         "string")
                 continue
             if ptype == "thinking" and role == "assistant":
-                if not isinstance(part.get("text"), str):
+                text = part.get("text", part.get("thinking"))
+                if not isinstance(text, str):
                     raise SchemaError(
-                        f"messages[{i}].content[{j}].text must be a "
-                        "string for thinking parts")
+                        f"messages[{i}].content[{j}] thinking parts "
+                        "need a string text (or thinking) field")
                 sig = part.get("signature")
                 if sig is not None and not isinstance(sig, str):
                     raise SchemaError(
@@ -128,10 +129,12 @@ def _validate_content(i: int, role: str, content: Any) -> None:
                         "a string")
                 continue
             if ptype == "redacted_thinking" and role == "assistant":
-                if not isinstance(part.get("redactedContent"), str):
+                data = part.get("redactedContent", part.get("data"))
+                if not isinstance(data, str):
                     raise SchemaError(
-                        f"messages[{i}].content[{j}].redactedContent "
-                        "must be a string")
+                        f"messages[{i}].content[{j}] redacted_thinking "
+                        "parts need a string redactedContent (or data) "
+                        "field")
                 continue
             if ptype != "text":
                 raise SchemaError(
@@ -348,12 +351,21 @@ def chat_completion_response(
     usage: TokenUsage | None = None,
     tool_calls: list[dict[str, Any]] | None = None,
     response_id: str = "",
+    reasoning_content: str = "",
+    thinking_blocks: list[dict[str, Any]] | None = None,
 ) -> dict[str, Any]:
     message: dict[str, Any] = {"role": "assistant", "content": content}
     if tool_calls:
         message["tool_calls"] = tool_calls
         if not content:
             message["content"] = None
+    # reasoning surfaces (reference: message.ReasoningContent union +
+    # the LiteLLM thinking_blocks convention, openai.go:644-648 — the
+    # blocks carry signatures so clients can replay them next turn)
+    if reasoning_content:
+        message["reasoning_content"] = reasoning_content
+    if thinking_blocks:
+        message["thinking_blocks"] = thinking_blocks
     return {
         "id": response_id or f"chatcmpl-{uuid.uuid4().hex[:24]}",
         "object": "chat.completion",

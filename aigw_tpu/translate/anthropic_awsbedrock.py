@@ -281,19 +281,13 @@ class AnthropicToBedrockConverse(Translator):
                     "input": tu.get("input", {}),
                 })
             elif "reasoningContent" in block:
-                rc = block["reasoningContent"]
-                if "reasoningText" in rc:
-                    content.append({
-                        "type": "thinking",
-                        "thinking": rc["reasoningText"].get("text", ""),
-                        "signature": rc["reasoningText"].get(
-                            "signature", ""),
-                    })
-                elif "redactedContent" in rc:
-                    content.append({
-                        "type": "redacted_thinking",
-                        "data": str(rc["redactedContent"]),
-                    })
+                from aigw_tpu.translate.openai_awsbedrock import (
+                    converse_reasoning_to_thinking,
+                )
+
+                tb = converse_reasoning_to_thinking(block)
+                if tb is not None:
+                    content.append(tb)
         stop = _BEDROCK_STOP_TO_ANTHROPIC.get(
             data.get("stopReason") or "end_turn", "end_turn")
         out = anth.messages_response(

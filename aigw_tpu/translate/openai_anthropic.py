@@ -58,6 +58,13 @@ def openai_messages_to_anthropic(
         elif role == "assistant":
             blocks: list[dict[str, Any]] = _assistant_content_blocks(
                 m.get("content"))
+            # LiteLLM-convention message-level thinking_blocks (the
+            # shape our responses emit): convert when the content parts
+            # didn't already carry thinking
+            if not any(b.get("type") in ("thinking", "redacted_thinking")
+                       for b in blocks):
+                blocks = _assistant_content_blocks(
+                    m.get("thinking_blocks")) + blocks
             for tc in m.get("tool_calls") or ():
                 fn = tc.get("function") or {}
                 try:
@@ -120,14 +127,18 @@ def _assistant_content_blocks(content: Any) -> list[dict[str, Any]]:
             if part.get("refusal"):
                 blocks.append({"type": "text", "text": part["refusal"]})
         elif ptype == "thinking":
-            if part.get("text") and part.get("signature"):
+            # accept both the OpenAI-content-part spelling ("text") and
+            # the shape this gateway emits in thinking_blocks
+            # ("thinking") so responses round-trip verbatim
+            text = part.get("text") or part.get("thinking")
+            if text and part.get("signature"):
                 blocks.append({
                     "type": "thinking",
-                    "thinking": part["text"],
+                    "thinking": text,
                     "signature": part["signature"],
                 })
         elif ptype == "redacted_thinking":
-            data = part.get("redactedContent")
+            data = part.get("redactedContent") or part.get("data")
             if isinstance(data, str):
                 blocks.append({"type": "redacted_thinking", "data": data})
         else:
@@ -237,6 +248,9 @@ class OpenAIToAnthropicChat(Translator):
         self._block_is_tool = False
         self._finish: str | None = None
         self._sent_done = False
+        # in-flight thinking block (text + signature accumulate across
+        # deltas; flushed as a thinking_blocks delta on block stop)
+        self._thinking_acc: dict[str, str] | None = None
 
     # -- request ----------------------------------------------------------
     def request(self, body: dict[str, Any]) -> RequestTx:
@@ -332,6 +346,26 @@ class OpenAIToAnthropicChat(Translator):
             data.get("stop_reason") or "end_turn", "stop"
         )
         model = str(data.get("model", "") or "")
+        # thinking blocks → reasoning_content + replayable
+        # thinking_blocks (anthropic_helper.go:1321-1343; signatures must
+        # survive so the next turn's request can echo them)
+        reasoning_parts: list[str] = []
+        thinking_blocks: list[dict[str, Any]] = []
+        for b in blocks:
+            if b.get("type") == "thinking":
+                if b.get("thinking"):
+                    reasoning_parts.append(b["thinking"])
+                thinking_blocks.append({
+                    "type": "thinking",
+                    "thinking": b.get("thinking", ""),
+                    "signature": b.get("signature", ""),
+                })
+            elif b.get("type") == "redacted_thinking":
+                if b.get("data"):
+                    thinking_blocks.append({
+                        "type": "redacted_thinking",
+                        "data": b["data"],
+                    })
         out = oai.chat_completion_response(
             model=model,
             content=text,
@@ -339,6 +373,8 @@ class OpenAIToAnthropicChat(Translator):
             usage=usage,
             tool_calls=tool_calls or None,
             response_id=self._id,
+            reasoning_content="".join(reasoning_parts),
+            thinking_blocks=thinking_blocks or None,
         )
         return ResponseTx(body=json.dumps(out).encode(), usage=usage, model=model)
 
@@ -367,6 +403,14 @@ class OpenAIToAnthropicChat(Translator):
             elif etype == "content_block_start":
                 block = data.get("content_block") or {}
                 self._block_is_tool = block.get("type") == "tool_use"
+                if block.get("type") == "thinking":
+                    self._thinking_acc = {"type": "thinking",
+                                          "thinking": "", "signature": ""}
+                elif block.get("type") == "redacted_thinking":
+                    # redacted blocks arrive whole on the start event
+                    out += self._emit({"thinking_blocks": [{
+                        "type": "redacted_thinking",
+                        "data": block.get("data", "")}]})
                 if self._block_is_tool:
                     self._tool_idx += 1
                     out += self._emit(
@@ -405,9 +449,29 @@ class OpenAIToAnthropicChat(Translator):
                     )
                 elif dtype == "thinking_delta":
                     tokens += 1
+                    if self._thinking_acc is not None:
+                        self._thinking_acc["thinking"] += \
+                            delta.get("thinking", "")
                     out += self._emit(
                         {"reasoning_content": delta.get("thinking", "")}
                     )
+                elif dtype == "signature_delta":
+                    # the signature arrives at the end of a thinking
+                    # block; without it the client cannot replay the
+                    # block next turn (Anthropic rejects unsigned
+                    # thinking before tool_use) — emit the completed
+                    # block as a thinking_blocks delta, matching the
+                    # unary response shape
+                    if self._thinking_acc is not None:
+                        self._thinking_acc["signature"] += \
+                            delta.get("signature", "")
+            elif etype == "content_block_stop":
+                if self._thinking_acc is not None and (
+                        self._thinking_acc["thinking"]
+                        or self._thinking_acc["signature"]):
+                    out += self._emit(
+                        {"thinking_blocks": [self._thinking_acc]})
+                self._thinking_acc = None
             elif etype == "message_delta":
                 d = data.get("delta") or {}
                 self._finish = anth.STOP_REASON_TO_OPENAI.get(

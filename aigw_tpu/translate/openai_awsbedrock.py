@@ -43,6 +43,24 @@ _STOP_TO_OPENAI = {
 }
 
 
+def converse_reasoning_to_thinking(block: dict[str, Any]) -> dict[str, Any] | None:
+    """One Converse ``reasoningContent`` block → Anthropic-shaped
+    thinking block (shared by the OpenAI and Anthropic fronts so the
+    two mappings can't drift). Returns None for an empty block."""
+    rc = block.get("reasoningContent") or {}
+    rt = rc.get("reasoningText")
+    if rt is not None:
+        return {
+            "type": "thinking",
+            "thinking": rt.get("text", ""),
+            "signature": rt.get("signature", ""),
+        }
+    if rc.get("redactedContent"):
+        return {"type": "redacted_thinking",
+                "data": str(rc["redactedContent"])}
+    return None
+
+
 def _assistant_blocks(content) -> list[dict[str, Any]]:
     """Assistant content union → Converse blocks. Array parts carry
     replayed thinking/redacted_thinking blocks
@@ -70,14 +88,15 @@ def _assistant_blocks(content) -> list[dict[str, Any]]:
             if part.get("refusal"):
                 blocks.append({"text": part["refusal"]})
         elif ptype == "thinking":
-            if part.get("text"):
-                rt: dict[str, Any] = {"text": part["text"]}
+            text = part.get("text") or part.get("thinking")
+            if text:
+                rt: dict[str, Any] = {"text": text}
                 if part.get("signature"):
                     rt["signature"] = part["signature"]
                 blocks.append(
                     {"reasoningContent": {"reasoningText": rt}})
         elif ptype == "redacted_thinking":
-            data = part.get("redactedContent")
+            data = part.get("redactedContent") or part.get("data")
             if isinstance(data, str):
                 blocks.append(
                     {"reasoningContent": {"redactedContent": data}})
@@ -113,6 +132,9 @@ def openai_messages_to_converse(
         elif role == "assistant":
             blocks: list[dict[str, Any]] = _assistant_blocks(
                 m.get("content"))
+            if not any("reasoningContent" in b for b in blocks):
+                blocks = _assistant_blocks(
+                    m.get("thinking_blocks")) + blocks
             for tc in m.get("tool_calls") or ():
                 fn = tc.get("function") or {}
                 try:
@@ -322,8 +344,18 @@ class OpenAIToBedrockChat(Translator):
         msg = (data.get("output") or {}).get("message") or {}
         text_parts: list[str] = []
         tool_calls: list[dict[str, Any]] = []
+        reasoning_parts: list[str] = []
+        thinking_blocks: list[dict[str, Any]] = []
         for block in msg.get("content") or ():
-            if "text" in block:
+            if "reasoningContent" in block:
+                # Converse reasoning → reasoning_content +
+                # replayable thinking_blocks (openai_awsbedrock.go:836)
+                tb = converse_reasoning_to_thinking(block)
+                if tb is not None:
+                    thinking_blocks.append(tb)
+                    if tb.get("thinking"):
+                        reasoning_parts.append(tb["thinking"])
+            elif "text" in block:
                 text_parts.append(block["text"])
             elif "toolUse" in block:
                 tu = block["toolUse"]
@@ -352,6 +384,8 @@ class OpenAIToBedrockChat(Translator):
             usage=usage,
             tool_calls=tool_calls or None,
             response_id=self._id,
+            reasoning_content="".join(reasoning_parts),
+            thinking_blocks=thinking_blocks or None,
         )
         return ResponseTx(
             body=json.dumps(out).encode(), usage=usage, model=self._model

@@ -771,3 +771,160 @@ class TestAssistantThinkingReplay:
         _, msgs = openai_messages_to_anthropic([
             {"role": "assistant", "content": "plain"}])
         assert msgs[0]["content"] == [{"type": "text", "text": "plain"}]
+
+
+class TestThinkingResponseDirection:
+    """Thinking blocks in RESPONSES surface as reasoning_content plus
+    replayable thinking_blocks with signatures (anthropic_helper.go:
+    1321-1343; gemini_helper.go:795-803 LiteLLM convention) — the
+    round-trip partner of TestAssistantThinkingReplay."""
+
+    def test_anthropic_unary_thinking(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI,
+                           S.ANTHROPIC)
+        t.request({"model": "c", "messages": [
+            {"role": "user", "content": "q"}]})
+        rx = t.response_body(json.dumps({
+            "model": "claude-3", "stop_reason": "end_turn",
+            "content": [
+                {"type": "thinking", "thinking": "step 1...",
+                 "signature": "sig-z"},
+                {"type": "redacted_thinking", "data": "b64x"},
+                {"type": "text", "text": "answer"}],
+            "usage": {"input_tokens": 5, "output_tokens": 9},
+        }).encode(), True)
+        msg = json.loads(rx.body)["choices"][0]["message"]
+        assert msg["content"] == "answer"
+        assert msg["reasoning_content"] == "step 1..."
+        assert msg["thinking_blocks"] == [
+            {"type": "thinking", "thinking": "step 1...",
+             "signature": "sig-z"},
+            {"type": "redacted_thinking", "data": "b64x"},
+        ]
+
+    def test_bedrock_unary_reasoning(self):
+        from aigw_tpu.translate.openai_awsbedrock import OpenAIToBedrockChat
+
+        t = OpenAIToBedrockChat()
+        t.request({"model": "m", "messages": [
+            {"role": "user", "content": "q"}]})
+        rx = t.response_body(json.dumps({
+            "output": {"message": {"role": "assistant", "content": [
+                {"reasoningContent": {"reasoningText": {
+                    "text": "hmm", "signature": "s1"}}},
+                {"text": "done"}]}},
+            "stopReason": "end_turn",
+            "usage": {"inputTokens": 3, "outputTokens": 4},
+        }).encode(), True)
+        msg = json.loads(rx.body)["choices"][0]["message"]
+        assert msg["content"] == "done"
+        assert msg["reasoning_content"] == "hmm"
+        assert msg["thinking_blocks"][0]["signature"] == "s1"
+
+    def test_round_trip_replay(self):
+        """A response's thinking_blocks, replayed as the next request's
+        assistant content parts, reach Anthropic in native shape."""
+        from aigw_tpu.translate.openai_anthropic import (
+            openai_messages_to_anthropic,
+        )
+
+        blocks = [{"type": "thinking", "thinking": "t", "signature": "s"}]
+        # client echoes them using the content-part shape
+        parts = [{"type": "thinking", "text": b["thinking"],
+                  "signature": b["signature"]} for b in blocks]
+        _, msgs = openai_messages_to_anthropic([
+            {"role": "assistant", "content": parts}])
+        assert msgs[0]["content"] == [
+            {"type": "thinking", "thinking": "t", "signature": "s"}]
+
+    def test_no_thinking_no_fields(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI,
+                           S.ANTHROPIC)
+        t.request({"model": "c", "messages": [
+            {"role": "user", "content": "q"}]})
+        rx = t.response_body(json.dumps({
+            "model": "claude-3", "stop_reason": "end_turn",
+            "content": [{"type": "text", "text": "plain"}],
+            "usage": {"input_tokens": 1, "output_tokens": 1},
+        }).encode(), True)
+        msg = json.loads(rx.body)["choices"][0]["message"]
+        assert "reasoning_content" not in msg
+        assert "thinking_blocks" not in msg
+
+
+class TestThinkingStreamSignature:
+    def test_streamed_thinking_block_carries_signature(self):
+        """signature_delta must reach the client: the completed block is
+        emitted as a thinking_blocks delta on content_block_stop, so
+        streamed thinking turns are replayable like unary ones."""
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI,
+                           S.ANTHROPIC, stream=True)
+        t.request({"model": "c", "stream": True, "messages": [
+            {"role": "user", "content": "q"}]})
+        events = [
+            {"type": "message_start", "message": {
+                "model": "claude-3", "usage": {"input_tokens": 2}}},
+            {"type": "content_block_start", "index": 0,
+             "content_block": {"type": "thinking", "thinking": ""}},
+            {"type": "content_block_delta", "index": 0, "delta": {
+                "type": "thinking_delta", "thinking": "step "}},
+            {"type": "content_block_delta", "index": 0, "delta": {
+                "type": "thinking_delta", "thinking": "one"}},
+            {"type": "content_block_delta", "index": 0, "delta": {
+                "type": "signature_delta", "signature": "sig-stream"}},
+            {"type": "content_block_stop", "index": 0},
+            {"type": "content_block_start", "index": 1,
+             "content_block": {"type": "text", "text": ""}},
+            {"type": "content_block_delta", "index": 1, "delta": {
+                "type": "text_delta", "text": "4"}},
+            {"type": "content_block_stop", "index": 1},
+            {"type": "message_delta",
+             "delta": {"stop_reason": "end_turn"},
+             "usage": {"output_tokens": 5}},
+            {"type": "message_stop"},
+        ]
+        raw = b"".join(
+            f"event: {e['type']}\ndata: {json.dumps(e)}\n\n".encode()
+            for e in events)
+        body = t.response_body(raw, True).body.decode()
+        deltas = [json.loads(line[6:])["choices"][0]["delta"]
+                  for line in body.splitlines()
+                  if line.startswith("data: ") and line != "data: [DONE]"
+                  and "choices" in line]
+        reasoning = "".join(d.get("reasoning_content", "")
+                            for d in deltas)
+        assert reasoning == "step one"
+        tb = [d["thinking_blocks"] for d in deltas
+              if "thinking_blocks" in d]
+        assert tb == [[{"type": "thinking", "thinking": "step one",
+                        "signature": "sig-stream"}]]
+
+    def test_emitted_blocks_replay_verbatim(self):
+        """The exact shape this gateway emits must be accepted back by
+        its own request path — both as content parts and as
+        message-level thinking_blocks (the round-trip the unary test
+        hand-translated before this fix)."""
+        from aigw_tpu.translate.openai_anthropic import (
+            openai_messages_to_anthropic,
+        )
+
+        emitted = [{"type": "thinking", "thinking": "t",
+                    "signature": "s"},
+                   {"type": "redacted_thinking", "data": "b64"}]
+        # as content parts, verbatim
+        _, msgs = openai_messages_to_anthropic([
+            {"role": "assistant", "content": emitted}])
+        assert msgs[0]["content"][0]["signature"] == "s"
+        assert msgs[0]["content"][1]["data"] == "b64"
+        # as message-level thinking_blocks (LiteLLM convention)
+        _, msgs = openai_messages_to_anthropic([
+            {"role": "assistant", "content": "4",
+             "thinking_blocks": emitted}])
+        assert msgs[0]["content"][0]["type"] == "thinking"
+        assert msgs[0]["content"][1]["type"] == "redacted_thinking"
+        assert msgs[0]["content"][2] == {"type": "text", "text": "4"}
+        # validator accepts the emitted part shapes too
+        from aigw_tpu.schemas.openai import validate_chat_request
+
+        validate_chat_request({"model": "m", "messages": [
+            {"role": "assistant", "content": emitted}]})
