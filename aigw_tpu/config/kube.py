@@ -409,6 +409,16 @@ class KubeSource:
         return self._client
 
 
+def _pod_namespace() -> str:
+    """The pod's own namespace when in-cluster (a Role there is enough
+    for the election lease); "default" otherwise."""
+    try:
+        with open(f"{_SA_DIR}/namespace", encoding="utf-8") as f:
+            return f.read().strip() or "default"
+    except OSError:
+        return "default"
+
+
 class KubeReconciler:
     """The Reconciler's admission → compile → quarantine → conditions
     pipeline (config/controller.py), fed from a KubeSource cache instead
@@ -419,10 +429,30 @@ class KubeReconciler:
     """
 
     def __init__(self, source: KubeSource,
-                 status_path: str | None = None):
+                 status_path: str | None = None,
+                 leader_election: bool | None = None):
         from aigw_tpu.config.controller import Reconciler
 
         self.source = source
+        # Leader election (default on; AIGW_LEADER_ELECTION=off to
+        # disable): every replica serves from its watch cache, but only
+        # the elected leader patches object status — the reference's
+        # manager runs the same split (controller-runtime leader
+        # election, cmd/controller/main.go). Single replica elects
+        # itself trivially.
+        if leader_election is None:
+            leader_election = os.environ.get(
+                "AIGW_LEADER_ELECTION", "").lower() != "off"
+        self._elector: LeaderElector | None = None
+        if leader_election:
+            self._elector = LeaderElector(
+                source.client,
+                lease_name=os.environ.get(
+                    "AIGW_LEASE_NAME", "aigw-tpu-status-writer"),
+                namespace=os.environ.get("AIGW_LEASE_NAMESPACE",
+                                         _pod_namespace()),
+            )
+            source.submit(self._elector.run())
         # delegate: a Reconciler whose file-reading entry points we
         # bypass; it keeps the condition memory + status file writing
         if status_path is None:
@@ -440,6 +470,16 @@ class KubeReconciler:
     def not_accepted(self) -> dict[str, dict[str, Any]]:
         return self._rec.not_accepted()
 
+    def shutdown(self) -> None:
+        """Stop the election loop and surrender the lease NOW (the renew
+        loop may be mid-sleep; waiting for its final iteration would
+        race source teardown) so a peer takes over immediately — a
+        graceful restart must not leave the cluster writer-less for
+        leaseDurationSeconds."""
+        if self._elector is not None:
+            self._elector.stop()
+            self.source.submit(self._elector.release())
+
     def load(self):
         """Compile the current cluster state; patch changed conditions
         back onto the objects (status subresource, merge-patch)."""
@@ -455,6 +495,11 @@ class KubeReconciler:
         # pushed yet (otherwise every reconcile tick re-patches and the
         # watch event from our own patch re-triggers the reconcile)
         conds = self._rec.conditions()
+        if self._elector is not None and not self._elector.is_leader:
+            # not the leader: serve, but leave status writing (and the
+            # patched-stamp cache) to whoever is — if leadership moves
+            # here later, unpatched conditions go out then
+            return cfg
         for obj in objects:
             kind = obj.get("kind", "")
             if kind not in STATUS_KINDS:
@@ -510,3 +555,191 @@ def parse_kube_target(target: str) -> KubeAuth:
             else:
                 return in_cluster_auth()
     return load_kubeconfig(spec)
+
+
+# ---------------------------------------------------------------------------
+# Leader election (coordination.k8s.io Leases)
+# ---------------------------------------------------------------------------
+
+LEASE_PATH = "/apis/coordination.k8s.io/v1/namespaces/{ns}/leases"
+
+
+class LeaderElector:
+    """Lease-based leader election — the reference's manager runs with
+    LeaderElection enabled so only one controller replica writes status
+    (controller-runtime's leasecandidate; cmd/controller/main.go).
+    Multiple gateway replicas in kube mode all *serve* from their watch
+    caches; only the elected leader patches object status, so replicas
+    don't fight over conditions.
+
+    Protocol (client-go parity): acquire the Lease if absent or expired
+    (renewTime + leaseDuration < now), renew every ``renew_seconds``
+    while held, surrender on stop. Clock skew tolerance comes from the
+    duration/renew gap."""
+
+    def __init__(self, client: KubeClient, *, lease_name: str,
+                 namespace: str = "default", identity: str = "",
+                 lease_seconds: float = 15.0, renew_seconds: float = 5.0):
+        import socket
+        import uuid as _uuid
+
+        self.client = client
+        self.lease_name = lease_name
+        self.namespace = namespace
+        self.identity = identity or (
+            f"{socket.gethostname()}_{_uuid.uuid4().hex[:8]}")
+        self.lease_seconds = lease_seconds
+        self.renew_seconds = renew_seconds
+        self._leader = False
+        self._stopping = False
+        self._valid_until = 0.0  # when the lease we hold expires
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leader
+
+    def _became_leader(self) -> None:
+        import time as _time
+
+        self._leader = True
+        self._valid_until = _time.time() + self.lease_seconds
+
+    def _lease_url(self, name: str = "") -> str:
+        url = (self.client.auth.server
+               + LEASE_PATH.format(ns=self.namespace))
+        return f"{url}/{name}" if name else url
+
+    @staticmethod
+    def _now() -> str:
+        import time as _time
+
+        return _time.strftime("%Y-%m-%dT%H:%M:%S.000000Z", _time.gmtime())
+
+    @staticmethod
+    def _parse_micro_time(value: str) -> float:
+        import calendar
+        import time as _time
+
+        try:
+            base = value.split(".")[0]
+            return calendar.timegm(
+                _time.strptime(base, "%Y-%m-%dT%H:%M:%S"))
+        except (ValueError, AttributeError):
+            return 0.0
+
+    async def try_acquire(self) -> bool:
+        """One acquire/renew attempt; updates ``is_leader``. Transient
+        failures do NOT demote while our own lease is still valid."""
+        import time as _time
+
+        s = await self.client.session()
+        try:
+            async with s.get(self._lease_url(self.lease_name)) as resp:
+                if resp.status == 404:
+                    lease = None
+                else:
+                    resp.raise_for_status()
+                    lease = await resp.json()
+            if lease is None:
+                body = {
+                    "apiVersion": "coordination.k8s.io/v1",
+                    "kind": "Lease",
+                    "metadata": {"name": self.lease_name,
+                                 "namespace": self.namespace},
+                    "spec": self._spec(acquisitions=1),
+                }
+                async with s.post(
+                    self._lease_url(),
+                    data=json.dumps(body).encode(),
+                    headers={"content-type": "application/json"},
+                ) as resp:
+                    if resp.status < 300:
+                        self._became_leader()
+                    else:
+                        self._leader = False
+                return self._leader
+            spec = lease.get("spec") or {}
+            holder = spec.get("holderIdentity", "")
+            renew = self._parse_micro_time(
+                spec.get("renewTime", "") or spec.get("acquireTime", ""))
+            duration = float(spec.get("leaseDurationSeconds",
+                                      self.lease_seconds))
+            expired = renew + duration < _time.time()
+            if holder != self.identity and not expired:
+                self._leader = False
+                return False
+            acquisitions = int(spec.get("leaseTransitions", 0) or 0)
+            if holder != self.identity:
+                acquisitions += 1
+            body = dict(lease)
+            body["spec"] = self._spec(acquisitions=acquisitions)
+            async with s.put(
+                self._lease_url(self.lease_name),
+                data=json.dumps(body).encode(),
+                headers={"content-type": "application/json"},
+            ) as resp:
+                # a 409 means another candidate updated first — not us
+                if resp.status < 300:
+                    self._became_leader()
+                else:
+                    self._leader = False
+                    self._valid_until = 0.0
+            return self._leader
+        except Exception as e:  # noqa: BLE001 — election must not crash
+            logger.warning("leader election attempt failed: %s", e)
+            # client-go parity: a transient renew failure does not
+            # abdicate while the lease we wrote is still unexpired —
+            # nobody else can acquire it in that window, so halting our
+            # own status writes would leave the cluster writer-less
+            if self._leader and _time.time() >= self._valid_until:
+                self._leader = False
+            return self._leader
+
+    def _spec(self, acquisitions: int) -> dict[str, Any]:
+        now = self._now()
+        return {
+            "holderIdentity": self.identity,
+            "leaseDurationSeconds": int(self.lease_seconds),
+            "acquireTime": now,
+            "renewTime": now,
+            "leaseTransitions": acquisitions,
+        }
+
+    async def run(self) -> None:
+        """Renew loop; run on the KubeSource loop via ``submit``."""
+        while not self._stopping:
+            await self.try_acquire()
+            await asyncio.sleep(self.renew_seconds)
+        if self._leader:
+            await self.release()
+
+    async def release(self) -> None:
+        """Surrender the lease (graceful shutdown): blank the holder and
+        pre-expire it so a peer can acquire immediately instead of
+        waiting out leaseDurationSeconds."""
+        if not self._leader:
+            return
+        self._leader = False
+        self._valid_until = 0.0
+        try:
+            s = await self.client.session()
+            body = {
+                "apiVersion": "coordination.k8s.io/v1",
+                "kind": "Lease",
+                "metadata": {"name": self.lease_name,
+                             "namespace": self.namespace},
+                "spec": {"holderIdentity": "",
+                         "leaseDurationSeconds": 1,
+                         "renewTime": "1970-01-01T00:00:00.000000Z"},
+            }
+            async with s.put(
+                self._lease_url(self.lease_name),
+                data=json.dumps(body).encode(),
+                headers={"content-type": "application/json"},
+            ) as resp:
+                await resp.read()
+        except Exception as e:  # noqa: BLE001 — best-effort surrender
+            logger.debug("lease release failed: %s", e)
+
+    def stop(self) -> None:
+        self._stopping = True

@@ -104,6 +104,11 @@ class ConfigWatcher:
                 pass
             self._task = None
         if self._kube_source is not None:
+            if self._kube_reconciler is not None:
+                # surrender the status-writer lease before tearing down
+                # the loop the surrender runs on
+                self._kube_reconciler.shutdown()
+                await asyncio.sleep(0.1)
             await asyncio.to_thread(self._kube_source.stop)
             self._kube_source = None
             self._kube_reconciler = None

@@ -28,7 +28,7 @@ from aigw_tpu.config.kube import (
     KubeSource,
     load_kubeconfig,
     resource_path,
-)
+)  # noqa: F401 — KubeReconciler used by the new election tests
 from aigw_tpu.config.runtime import RuntimeConfig
 from aigw_tpu.config.watcher import ConfigWatcher
 from aigw_tpu.gateway.server import run_gateway
@@ -45,6 +45,7 @@ class FakeAPIServer:
         self.objects: dict[tuple[str, str, str], dict] = {}
         self.rv = 100
         self.status_patches: list[tuple[str, dict]] = []
+        self.leases: dict[str, dict] = {}
         self._streams: list[tuple[str, asyncio.Queue]] = []
         self.app = web.Application()
         self.app.router.add_route("*", "/{tail:.*}", self._handle)
@@ -115,6 +116,30 @@ class FakeAPIServer:
     # -- HTTP -------------------------------------------------------------
     async def _handle(self, request: web.Request):
         parts = [p for p in request.path.split("/") if p]
+        # coordination.k8s.io Leases (leader election)
+        if "leases" in parts:
+            i = parts.index("leases")
+            name = parts[i + 1] if len(parts) > i + 1 else ""
+            if request.method == "GET" and name:
+                lease = self.leases.get(name)
+                if lease is None:
+                    return web.json_response({"reason": "NotFound"},
+                                             status=404)
+                return web.json_response(lease)
+            if request.method == "POST":
+                body = json.loads(await request.read())
+                lname = body["metadata"]["name"]
+                if lname in self.leases:
+                    return web.json_response({"reason": "Conflict"},
+                                             status=409)
+                self.leases[lname] = body
+                return web.json_response(body, status=201)
+            if request.method == "PUT" and name:
+                body = json.loads(await request.read())
+                self.leases[name] = body
+                return web.json_response(body)
+            return web.json_response({"reason": "MethodNotAllowed"},
+                                     status=405)
         # .../{plural} or .../namespaces/{ns}/{plural}/{name}[/status]
         if request.method == "PATCH" and parts[-1] == "status":
             kind = _PLURAL_TO_KIND.get(parts[-3], "")
@@ -397,5 +422,153 @@ class TestKubeControlPlaneE2E:
                 await api.stop()
                 await up_a.stop()
                 await up_b.stop()
+
+        asyncio.run(main())
+
+
+class TestLeaderElection:
+    """Only the elected leader writes status; a second replica serves
+    without patching until the lease expires (controller-runtime leader
+    election parity, cmd/controller/main.go)."""
+
+    def test_single_replica_elects_and_patches(self, tmp_path):
+        async def main():
+            api = FakeAPIServer()
+            await api.start()
+            for obj in (_backend_objs("b1", "127.0.0.1", 8901)
+                        + [_route_obj("r1", "m1", "b1")]):
+                api.objects[FakeAPIServer._key(obj)] = obj
+            source = KubeSource(KubeAuth(server=api.url))
+            source.start()
+            try:
+                assert await asyncio.to_thread(source.wait_synced, 30)
+                rec = KubeReconciler(source)
+                deadline = time.time() + 10
+                while time.time() < deadline and \
+                        not (rec._elector and rec._elector.is_leader):
+                    await asyncio.sleep(0.1)
+                assert rec._elector.is_leader
+                assert "aigw-tpu-status-writer" in api.leases
+                await asyncio.to_thread(rec.load)
+                deadline = time.time() + 10
+                while time.time() < deadline and not api.status_patches:
+                    await asyncio.sleep(0.1)
+                assert api.status_patches  # leader writes status
+            finally:
+                if rec._elector:
+                    rec._elector.stop()
+                await asyncio.to_thread(source.stop)
+                await api.stop()
+
+        asyncio.run(main())
+
+    def test_non_leader_serves_without_patching(self, tmp_path):
+        async def main():
+            import json as _json
+
+            api = FakeAPIServer()
+            await api.start()
+            for obj in (_backend_objs("b1", "127.0.0.1", 8901)
+                        + [_route_obj("r1", "m1", "b1")]):
+                api.objects[FakeAPIServer._key(obj)] = obj
+            # a live leader already holds the lease
+            api.leases["aigw-tpu-status-writer"] = {
+                "metadata": {"name": "aigw-tpu-status-writer"},
+                "spec": {
+                    "holderIdentity": "other-replica",
+                    "leaseDurationSeconds": 3600,
+                    "renewTime": time.strftime(
+                        "%Y-%m-%dT%H:%M:%S.000000Z", time.gmtime()),
+                },
+            }
+            source = KubeSource(KubeAuth(server=api.url))
+            source.start()
+            try:
+                assert await asyncio.to_thread(source.wait_synced, 30)
+                rec = KubeReconciler(source)
+                await asyncio.sleep(1.0)  # give election a cycle
+                assert not rec._elector.is_leader
+                cfg = await asyncio.to_thread(rec.load)
+                # serving still works from the watch cache...
+                assert [r.name for r in cfg.routes] == ["r1"]
+                await asyncio.sleep(0.5)
+                # ...but no status patches from the non-leader
+                assert api.status_patches == []
+            finally:
+                rec._elector.stop()
+                await asyncio.to_thread(source.stop)
+                await api.stop()
+
+        asyncio.run(main())
+
+    def test_takeover_on_expired_lease(self, tmp_path):
+        async def main():
+            api = FakeAPIServer()
+            await api.start()
+            api.leases["aigw-tpu-status-writer"] = {
+                "metadata": {"name": "aigw-tpu-status-writer"},
+                "spec": {
+                    "holderIdentity": "dead-replica",
+                    "leaseDurationSeconds": 1,
+                    "renewTime": "2020-01-01T00:00:00.000000Z",
+                },
+            }
+            source = KubeSource(KubeAuth(server=api.url))
+            source.start()
+            try:
+                assert await asyncio.to_thread(source.wait_synced, 30)
+                rec = KubeReconciler(source)
+                deadline = time.time() + 10
+                while time.time() < deadline and \
+                        not rec._elector.is_leader:
+                    await asyncio.sleep(0.1)
+                assert rec._elector.is_leader  # stale lease taken over
+                spec = api.leases["aigw-tpu-status-writer"]["spec"]
+                assert spec["holderIdentity"] == rec._elector.identity
+                assert spec["leaseTransitions"] >= 1
+            finally:
+                rec._elector.stop()
+                await asyncio.to_thread(source.stop)
+                await api.stop()
+
+        asyncio.run(main())
+
+    def test_release_on_shutdown(self, tmp_path):
+        """Graceful shutdown surrenders the lease so a peer can take
+        over immediately instead of waiting out leaseDurationSeconds."""
+
+        async def main():
+            api = FakeAPIServer()
+            await api.start()
+            source = KubeSource(KubeAuth(server=api.url))
+            source.start()
+            try:
+                assert await asyncio.to_thread(source.wait_synced, 30)
+                rec = KubeReconciler(source)
+                deadline = time.time() + 10
+                while time.time() < deadline and \
+                        not rec._elector.is_leader:
+                    await asyncio.sleep(0.1)
+                assert rec._elector.is_leader
+                rec.shutdown()
+                deadline = time.time() + 10
+                while time.time() < deadline:
+                    spec = api.leases[
+                        "aigw-tpu-status-writer"].get("spec", {})
+                    if spec.get("holderIdentity") == "":
+                        break
+                    await asyncio.sleep(0.1)
+                assert spec.get("holderIdentity") == ""
+                # a fresh replica acquires instantly
+                from aigw_tpu.config.kube import LeaderElector
+
+                peer = LeaderElector(source.client,
+                                     lease_name="aigw-tpu-status-writer")
+                fut = asyncio.run_coroutine_threadsafe(
+                    peer.try_acquire(), source._loop)
+                assert await asyncio.to_thread(fut.result, 10)
+            finally:
+                await asyncio.to_thread(source.stop)
+                await api.stop()
 
         asyncio.run(main())
