@@ -40,7 +40,7 @@ def openai_messages_to_anthropic(
     - role:"tool" results → user tool_result blocks
     - consecutive same-role messages merge (Anthropic wants alternation)
     """
-    system_parts: list[str] = []
+    system_blocks: list[dict[str, Any]] = []
     out: list[dict[str, Any]] = []
 
     def push(role: str, blocks: list[dict[str, Any]]) -> None:
@@ -52,7 +52,21 @@ def openai_messages_to_anthropic(
     for m in messages:
         role = m.get("role")
         if role in ("system", "developer"):
-            system_parts.append(oai.message_content_text(m.get("content")))
+            content = m.get("content")
+            if isinstance(content, list):
+                for part in content:
+                    if not isinstance(part, dict) or \
+                            part.get("type") != "text" or \
+                            not part.get("text"):
+                        continue
+                    block = {"type": "text", "text": part["text"]}
+                    if (cc := _cache_control(part)) is not None:
+                        block["cache_control"] = cc
+                    system_blocks.append(block)
+            else:
+                text = oai.message_content_text(content)
+                if text:
+                    system_blocks.append({"type": "text", "text": text})
         elif role == "user":
             push("user", _user_content_blocks(m.get("content")))
         elif role == "assistant":
@@ -71,30 +85,42 @@ def openai_messages_to_anthropic(
                     args = json.loads(fn.get("arguments") or "{}")
                 except json.JSONDecodeError:
                     args = {}
-                blocks.append(
-                    {
-                        "type": "tool_use",
-                        "id": tc.get("id", ""),
-                        "name": fn.get("name", ""),
-                        "input": args,
-                    }
-                )
+                tool_use = {
+                    "type": "tool_use",
+                    "id": tc.get("id", ""),
+                    "name": fn.get("name", ""),
+                    "input": args,
+                }
+                if (cc := _cache_control(tc)) is not None:
+                    tool_use["cache_control"] = cc
+                blocks.append(tool_use)
             if blocks:
                 push("assistant", blocks)
         elif role == "tool":
-            push(
-                "user",
-                [
-                    {
-                        "type": "tool_result",
-                        "tool_use_id": m.get("tool_call_id", ""),
-                        "content": oai.message_content_text(m.get("content")),
-                    }
-                ],
-            )
+            result = {
+                "type": "tool_result",
+                "tool_use_id": m.get("tool_call_id", ""),
+                "content": oai.message_content_text(m.get("content")),
+            }
+            # agent loops put the cache breakpoint after the last tool
+            # result — honor the marker at message level or on any part
+            cc = _cache_control(m)
+            if cc is None and isinstance(m.get("content"), list):
+                for part in m["content"]:
+                    if isinstance(part, dict) and \
+                            (cc := _cache_control(part)) is not None:
+                        break
+            if cc is not None:
+                result["cache_control"] = cc
+            push("user", [result])
         else:
             raise TranslationError(f"unsupported message role {role!r}")
-    return "\n".join(p for p in system_parts if p), out
+    # plain string when nothing carries a cache marker (back-compat and
+    # byte-stable goldens); block form otherwise — a cached system
+    # prompt is THE primary prompt-caching use case and must survive
+    if any("cache_control" in b for b in system_blocks):
+        return system_blocks, out
+    return "\n".join(b["text"] for b in system_blocks), out
 
 
 def _assistant_content_blocks(content: Any) -> list[dict[str, Any]]:
@@ -122,7 +148,10 @@ def _assistant_content_blocks(content: Any) -> list[dict[str, Any]]:
         ptype = part.get("type")
         if ptype == "text":
             if part.get("text"):
-                blocks.append({"type": "text", "text": part["text"]})
+                block = {"type": "text", "text": part["text"]}
+                if (cc := _cache_control(part)) is not None:
+                    block["cache_control"] = cc
+                blocks.append(block)
         elif ptype == "refusal":
             if part.get("refusal"):
                 blocks.append({"type": "text", "text": part["refusal"]})
@@ -147,6 +176,9 @@ def _assistant_content_blocks(content: Any) -> list[dict[str, Any]]:
     return blocks
 
 
+_cache_control = vendor_fields.cache_control_marker
+
+
 def _user_content_blocks(content: Any) -> list[dict[str, Any]]:
     if content is None:
         return []
@@ -156,25 +188,31 @@ def _user_content_blocks(content: Any) -> list[dict[str, Any]]:
     for part in content:
         ptype = part.get("type")
         if ptype == "text":
-            blocks.append({"type": "text", "text": part.get("text", "")})
-        elif ptype == "image_url":
+            if not part.get("text"):
+                continue  # Anthropic rejects empty text blocks
+            block = {"type": "text", "text": part["text"]}
+            if (cc := _cache_control(part)) is not None:
+                block["cache_control"] = cc
+            blocks.append(block)
+            continue
+        if ptype == "image_url":
             url = (part.get("image_url") or {}).get("url", "")
             if url.startswith("data:"):
                 media, _, b64 = url[len("data:") :].partition(";base64,")
-                blocks.append(
-                    {
-                        "type": "image",
-                        "source": {
-                            "type": "base64",
-                            "media_type": media or "image/png",
-                            "data": b64,
-                        },
-                    }
-                )
+                block = {
+                    "type": "image",
+                    "source": {
+                        "type": "base64",
+                        "media_type": media or "image/png",
+                        "data": b64,
+                    },
+                }
             else:
-                blocks.append(
-                    {"type": "image", "source": {"type": "url", "url": url}}
-                )
+                block = {"type": "image",
+                         "source": {"type": "url", "url": url}}
+            if (cc := _cache_control(part)) is not None:
+                block["cache_control"] = cc
+            blocks.append(block)
         else:
             raise TranslationError(f"unsupported content part {ptype!r}")
     return blocks
@@ -184,17 +222,20 @@ def openai_tools_to_anthropic(body: dict[str, Any]) -> dict[str, Any]:
     out: dict[str, Any] = {}
     tools = body.get("tools")
     if tools:
-        out["tools"] = [
-            {
-                "name": (t.get("function") or {}).get("name", ""),
-                "description": (t.get("function") or {}).get("description", ""),
-                "input_schema": (t.get("function") or {}).get(
-                    "parameters", {"type": "object"}
-                ),
+        converted = []
+        for t in tools:
+            if t.get("type") != "function":
+                continue
+            fn = t.get("function") or {}
+            tool = {
+                "name": fn.get("name", ""),
+                "description": fn.get("description", ""),
+                "input_schema": fn.get("parameters", {"type": "object"}),
             }
-            for t in tools
-            if t.get("type") == "function"
-        ]
+            if (cc := _cache_control(fn)) is not None:
+                tool["cache_control"] = cc
+            converted.append(tool)
+        out["tools"] = converted
     choice = body.get("tool_choice")
     if choice == "auto":
         out["tool_choice"] = {"type": "auto"}

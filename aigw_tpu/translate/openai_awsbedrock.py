@@ -61,6 +61,14 @@ def converse_reasoning_to_thinking(block: dict[str, Any]) -> dict[str, Any] | No
     return None
 
 
+def _cache_point(part: dict[str, Any]) -> dict[str, Any] | None:
+    """cache_control on the OpenAI surface → a Converse cachePoint block
+    appended after the cached content (openai_awsbedrock.go:92-99)."""
+    if vendor_fields.cache_control_marker(part) is not None:
+        return {"cachePoint": {"type": "default"}}
+    return None
+
+
 def _assistant_blocks(content) -> list[dict[str, Any]]:
     """Assistant content union → Converse blocks. Array parts carry
     replayed thinking/redacted_thinking blocks
@@ -84,6 +92,8 @@ def _assistant_blocks(content) -> list[dict[str, Any]]:
         if ptype == "text":
             if part.get("text"):
                 blocks.append({"text": part["text"]})
+                if (cp := _cache_point(part)) is not None:
+                    blocks.append(cp)
         elif ptype == "refusal":
             if part.get("refusal"):
                 blocks.append({"text": part["refusal"]})
@@ -124,9 +134,20 @@ def openai_messages_to_converse(
     for m in messages:
         role = m.get("role")
         if role in ("system", "developer"):
-            text = oai.message_content_text(m.get("content"))
-            if text:
-                system.append({"text": text})
+            content = m.get("content")
+            if isinstance(content, list):
+                for part in content:
+                    if not isinstance(part, dict) or \
+                            part.get("type") != "text" or \
+                            not part.get("text"):
+                        continue
+                    system.append({"text": part["text"]})
+                    if (cp := _cache_point(part)) is not None:
+                        system.append(cp)
+            else:
+                text = oai.message_content_text(content)
+                if text:
+                    system.append({"text": text})
         elif role == "user":
             push("user", _user_blocks(m.get("content")))
         elif role == "assistant":
@@ -150,26 +171,34 @@ def openai_messages_to_converse(
                         }
                     }
                 )
+                if (cp := _cache_point(tc)) is not None:
+                    blocks.append(cp)
             if blocks:
                 push("assistant", blocks)
         elif role == "tool":
-            push(
-                "user",
-                [
-                    {
-                        "toolResult": {
-                            "toolUseId": m.get("tool_call_id", ""),
-                            "content": [
-                                {
-                                    "text": oai.message_content_text(
-                                        m.get("content")
-                                    )
-                                }
-                            ],
-                        }
+            result_blocks: list[dict[str, Any]] = [
+                {
+                    "toolResult": {
+                        "toolUseId": m.get("tool_call_id", ""),
+                        "content": [
+                            {
+                                "text": oai.message_content_text(
+                                    m.get("content")
+                                )
+                            }
+                        ],
                     }
-                ],
-            )
+                }
+            ]
+            cc = _cache_point(m)
+            if cc is None and isinstance(m.get("content"), list):
+                for part in m["content"]:
+                    if isinstance(part, dict) and \
+                            (cc := _cache_point(part)) is not None:
+                        break
+            if cc is not None:
+                result_blocks.append(cc)
+            push("user", result_blocks)
         else:
             raise TranslationError(f"unsupported message role {role!r}")
     return system, out
@@ -187,6 +216,8 @@ def _user_blocks(content: Any) -> list[dict[str, Any]]:
         if ptype == "text":
             if part.get("text"):
                 blocks.append({"text": part["text"]})
+                if (cp := _cache_point(part)) is not None:
+                    blocks.append(cp)
         elif ptype == "image_url":
             url = (part.get("image_url") or {}).get("url", "")
             if not url.startswith("data:"):
@@ -198,6 +229,8 @@ def _user_blocks(content: Any) -> list[dict[str, Any]]:
             blocks.append(
                 {"image": {"format": fmt, "source": {"bytes": b64}}}
             )
+            if (cp := _cache_point(part)) is not None:
+                blocks.append(cp)
         else:
             raise TranslationError(f"unsupported content part {ptype!r}")
     return blocks
@@ -269,25 +302,26 @@ class OpenAIToBedrockChat(Translator):
         if body.get("tool_choice") == "none":
             tools = None
         if tools:
-            tool_config: dict[str, Any] = {
-                "tools": [
-                    {
-                        "toolSpec": {
-                            "name": (t.get("function") or {}).get("name", ""),
-                            "description": (t.get("function") or {}).get(
-                                "description", ""
-                            ),
-                            "inputSchema": {
-                                "json": (t.get("function") or {}).get(
-                                    "parameters", {"type": "object"}
-                                )
-                            },
-                        }
+            tool_entries: list[dict[str, Any]] = []
+            for t in tools:
+                if t.get("type") != "function":
+                    continue
+                fn = t.get("function") or {}
+                tool_entries.append({
+                    "toolSpec": {
+                        "name": fn.get("name", ""),
+                        "description": fn.get("description", ""),
+                        "inputSchema": {
+                            "json": fn.get("parameters",
+                                           {"type": "object"})
+                        },
                     }
-                    for t in tools
-                    if t.get("type") == "function"
-                ]
-            }
+                })
+                # cached tool definitions → a cachePoint tool entry
+                # right after (openai_awsbedrock.go:203)
+                if (cp := _cache_point(fn)) is not None:
+                    tool_entries.append(cp)
+            tool_config: dict[str, Any] = {"tools": tool_entries}
             choice = body.get("tool_choice")
             if choice == "required":
                 tool_config["toolChoice"] = {"any": {}}

@@ -102,3 +102,14 @@ def gcp_embedding_vendor(body: dict[str, Any]) -> dict[str, Any]:
     if isinstance(body.get("title"), str):
         out["title"] = body["title"]
     return out
+
+
+def cache_control_marker(part: dict[str, Any]) -> dict[str, Any] | None:
+    """Anthropic prompt-caching marker riding the OpenAI surface
+    (AnthropicContentFields, openai.go:460-462; the reference's
+    isCacheEnabled predicate, anthropic_helper.go:258-260). One shared
+    detector so the Anthropic and Bedrock mappings can't drift."""
+    cc = part.get("cache_control")
+    if isinstance(cc, dict) and cc.get("type") == "ephemeral":
+        return cc
+    return None

@@ -928,3 +928,155 @@ class TestThinkingStreamSignature:
 
         validate_chat_request({"model": "m", "messages": [
             {"role": "assistant", "content": emitted}]})
+
+
+class TestCacheControlPassthrough:
+    """Anthropic prompt caching rides the OpenAI surface as
+    cache_control markers (AnthropicContentFields openai.go:460-462):
+    Anthropic gets cache_control on the block; Converse gets a
+    cachePoint block after the cached content
+    (openai_awsbedrock.go:92-99, :203)."""
+
+    BODY = {
+        "model": "m",
+        "messages": [
+            {"role": "user", "content": [
+                {"type": "text", "text": "big context",
+                 "cache_control": {"type": "ephemeral"}},
+                {"type": "text", "text": "question"}]},
+        ],
+        "tools": [{"type": "function", "function": {
+            "name": "f", "parameters": {"type": "object"},
+            "cache_control": {"type": "ephemeral"}}}],
+    }
+
+    def test_anthropic_blocks_carry_cache_control(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI,
+                           S.ANTHROPIC)
+        out = json.loads(t.request(dict(self.BODY)).body)
+        blocks = out["messages"][0]["content"]
+        assert blocks[0]["cache_control"] == {"type": "ephemeral"}
+        assert "cache_control" not in blocks[1]
+        assert out["tools"][0]["cache_control"] == {"type": "ephemeral"}
+
+    def test_bedrock_cache_points(self):
+        from aigw_tpu.translate.openai_awsbedrock import OpenAIToBedrockChat
+
+        out = json.loads(OpenAIToBedrockChat().request(
+            dict(self.BODY)).body)
+        blocks = out["messages"][0]["content"]
+        assert blocks[0] == {"text": "big context"}
+        assert blocks[1] == {"cachePoint": {"type": "default"}}
+        assert blocks[2] == {"text": "question"}
+        tools = out["toolConfig"]["tools"]
+        assert tools[0]["toolSpec"]["name"] == "f"
+        assert tools[1] == {"cachePoint": {"type": "default"}}
+
+    def test_non_ephemeral_ignored(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI,
+                           S.ANTHROPIC)
+        out = json.loads(t.request({
+            "model": "m",
+            "messages": [{"role": "user", "content": [
+                {"type": "text", "text": "x",
+                 "cache_control": {"type": "permanent"}}]}],
+        }).body)
+        assert "cache_control" not in out["messages"][0]["content"][0]
+
+
+class TestCacheControlCoverage:
+    """The placements that actually matter for prompt caching: a big
+    cached SYSTEM prompt and the after-the-last-tool-result breakpoint
+    (agent loops), on both backends."""
+
+    def test_anthropic_system_cache(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI,
+                           S.ANTHROPIC)
+        out = json.loads(t.request({
+            "model": "m",
+            "messages": [
+                {"role": "system", "content": [
+                    {"type": "text", "text": "BIG PROMPT",
+                     "cache_control": {"type": "ephemeral"}}]},
+                {"role": "user", "content": "q"}],
+        }).body)
+        assert out["system"] == [{
+            "type": "text", "text": "BIG PROMPT",
+            "cache_control": {"type": "ephemeral"}}]
+
+    def test_anthropic_system_stays_string_without_cache(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI,
+                           S.ANTHROPIC)
+        out = json.loads(t.request({
+            "model": "m",
+            "messages": [
+                {"role": "system", "content": "plain"},
+                {"role": "user", "content": "q"}],
+        }).body)
+        assert out["system"] == "plain"
+
+    def test_bedrock_system_cache_point(self):
+        from aigw_tpu.translate.openai_awsbedrock import OpenAIToBedrockChat
+
+        out = json.loads(OpenAIToBedrockChat().request({
+            "model": "m",
+            "messages": [
+                {"role": "system", "content": [
+                    {"type": "text", "text": "BIG",
+                     "cache_control": {"type": "ephemeral"}}]},
+                {"role": "user", "content": "q"}],
+        }).body)
+        assert out["system"] == [{"text": "BIG"},
+                                 {"cachePoint": {"type": "default"}}]
+
+    def test_tool_result_cache_both_backends(self):
+        msgs = [
+            {"role": "user", "content": "go"},
+            {"role": "assistant", "tool_calls": [
+                {"id": "t1", "type": "function",
+                 "function": {"name": "f", "arguments": "{}"}}]},
+            {"role": "tool", "tool_call_id": "t1", "content": "result",
+             "cache_control": {"type": "ephemeral"}},
+        ]
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI,
+                           S.ANTHROPIC)
+        out = json.loads(t.request(
+            {"model": "m", "messages": msgs}).body)
+        tool_result = out["messages"][-1]["content"][0]
+        assert tool_result["type"] == "tool_result"
+        assert tool_result["cache_control"] == {"type": "ephemeral"}
+
+        from aigw_tpu.translate.openai_awsbedrock import OpenAIToBedrockChat
+
+        out = json.loads(OpenAIToBedrockChat().request(
+            {"model": "m", "messages": msgs}).body)
+        blocks = out["messages"][-1]["content"]
+        assert "toolResult" in blocks[0]
+        assert blocks[1] == {"cachePoint": {"type": "default"}}
+
+    def test_bedrock_tool_use_cache_point(self):
+        from aigw_tpu.translate.openai_awsbedrock import OpenAIToBedrockChat
+
+        out = json.loads(OpenAIToBedrockChat().request({
+            "model": "m", "messages": [
+                {"role": "user", "content": "go"},
+                {"role": "assistant", "tool_calls": [
+                    {"id": "t1", "type": "function",
+                     "function": {"name": "f", "arguments": "{}"},
+                     "cache_control": {"type": "ephemeral"}}]}],
+        }).body)
+        blocks = out["messages"][-1]["content"]
+        assert "toolUse" in blocks[0]
+        assert blocks[1] == {"cachePoint": {"type": "default"}}
+
+    def test_empty_text_part_skipped(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI,
+                           S.ANTHROPIC)
+        out = json.loads(t.request({
+            "model": "m",
+            "messages": [{"role": "user", "content": [
+                {"type": "text", "text": ""},
+                {"type": "text", "text": "real"}]}],
+        }).body)
+        assert out["messages"][0]["content"] == [
+            {"type": "text", "text": "real"}]
