@@ -32,10 +32,13 @@ from aigw_tpu.translate.structured import parse_response_format
 
 def openai_messages_to_anthropic(
     messages: list[dict[str, Any]],
-) -> tuple[str, list[dict[str, Any]]]:
-    """OpenAI messages → (system_prompt, anthropic messages).
+) -> tuple["str | list[dict[str, Any]]", list[dict[str, Any]]]:
+    """OpenAI messages → (system, anthropic messages).
 
-    - system/developer roles concatenate into the system parameter
+    - system/developer roles concatenate into the system parameter —
+      returned as a plain string normally, or as a list of text blocks
+      when any system part carries a cache_control marker (the block
+      form is how Anthropic caches system prompts)
     - assistant tool_calls → tool_use blocks
     - role:"tool" results → user tool_result blocks
     - consecutive same-role messages merge (Anthropic wants alternation)

@@ -8,6 +8,7 @@ placeholders are substituted by the GCP auth handler.
 
 from __future__ import annotations
 
+import base64
 import json
 import time
 import uuid
@@ -109,6 +110,30 @@ def _user_parts(content: Any) -> list[dict[str, Any]]:
     return parts
 
 
+#: Google's documented compatibility escape for clients that cannot echo
+#: thought signatures (gemini_helper.go:36-39): Gemini 3.x rejects
+#: multi-turn function calls with no thought_signature at all. REST wire
+#: format carries signatures base64-encoded.
+DUMMY_THOUGHT_SIGNATURE = base64.b64encode(
+    b"skip_thought_signature_validator").decode()
+
+
+def _assistant_thought_signature(m: dict[str, Any]) -> str:
+    """First signature echoed back by the client — from thinking content
+    parts or the thinking_blocks convention (gemini_helper.go:264-296).
+    REST signatures are base64 strings and pass through verbatim."""
+    content = m.get("content")
+    if isinstance(content, list):
+        for part in content:
+            if isinstance(part, dict) and part.get("type") == "thinking" \
+                    and part.get("signature"):
+                return str(part["signature"])
+    for block in m.get("thinking_blocks") or ():
+        if isinstance(block, dict) and block.get("signature"):
+            return str(block["signature"])
+    return ""
+
+
 def openai_messages_to_gemini(
     messages: list[dict[str, Any]],
 ) -> tuple[dict[str, Any] | None, list[dict[str, Any]]]:
@@ -132,19 +157,49 @@ def openai_messages_to_gemini(
         elif role == "user":
             push("user", _user_parts(m.get("content")))
         elif role == "assistant":
+            # part order mirrors the reference helper: functionCall
+            # parts first, then text/thought parts
+            # (gemini_helper.go:301-338 appends tool calls before
+            # content) — the signature rule binds to the FIRST
+            # functionCall, so the order is load-bearing
             parts: list[dict[str, Any]] = []
-            text = oai.message_content_text(m.get("content"))
-            if text:
-                parts.append({"text": text})
-            for tc in m.get("tool_calls") or ():
+            signature = _assistant_thought_signature(m)
+            tool_calls = m.get("tool_calls") or ()
+            for idx, tc in enumerate(tool_calls):
                 fn = tc.get("function") or {}
                 try:
                     args = json.loads(fn.get("arguments") or "{}")
                 except json.JSONDecodeError:
                     args = {}
-                parts.append(
-                    {"functionCall": {"name": fn.get("name", ""), "args": args}}
-                )
+                part = {"functionCall": {"name": fn.get("name", ""),
+                                         "args": args}}
+                # signature rides the FIRST functionCall only (parallel
+                # calls carry one signature; gemini_helper.go:313-323);
+                # no echoed signature → Google's compat escape
+                if idx == 0:
+                    part["thoughtSignature"] = (
+                        signature or DUMMY_THOUGHT_SIGNATURE)
+                parts.append(part)
+            content = m.get("content")
+            if isinstance(content, list):
+                for cp in content:
+                    if not isinstance(cp, dict):
+                        continue
+                    if cp.get("type") == "text" and cp.get("text"):
+                        parts.append({"text": cp["text"]})
+                    elif cp.get("type") == "thinking":
+                        t = cp.get("text") or cp.get("thinking")
+                        if t:
+                            thought = {"text": t, "thought": True}
+                            if not tool_calls and cp.get("signature"):
+                                thought["thoughtSignature"] = \
+                                    cp["signature"]
+                            parts.append(thought)
+                    # refusal/redacted parts have no Gemini shape: skip
+            else:
+                text = oai.message_content_text(content)
+                if text:
+                    parts.append({"text": text})
             push("model", parts)
         elif role == "tool":
             content = oai.message_content_text(m.get("content"))
@@ -187,6 +242,8 @@ class OpenAIToGeminiChat(Translator):
         self._sent_role = False
         self._sent_done = False
         self._want_logprobs = False
+        self._thought_text = ""
+        self._thought_signature = ""
 
     def request(self, body: dict[str, Any]) -> RequestTx:
         oai.validate_chat_request(body)
@@ -331,7 +388,19 @@ class OpenAIToGeminiChat(Translator):
         choices = []
         for i, cand in enumerate(data.get("candidates") or [{}]):
             parts = (cand.get("content") or {}).get("parts") or []
-            text = "".join(p.get("text", "") for p in parts if "text" in p)
+            # thought=true parts are the model's reasoning, NOT content
+            # (gemini_helper.go:790-820: thought summary →
+            # reasoning_content; signatures → thinking_blocks so the
+            # next turn can echo them)
+            text = "".join(p.get("text", "") for p in parts
+                           if "text" in p and not p.get("thought"))
+            thought = "".join(p.get("text", "") for p in parts
+                              if "text" in p and p.get("thought"))
+            signature = ""
+            for p in parts:
+                if p.get("thoughtSignature"):
+                    signature = str(p["thoughtSignature"])
+                    break
             tool_calls = [
                 {
                     "id": f"call_{uuid.uuid4().hex[:16]}",
@@ -354,6 +423,12 @@ class OpenAIToGeminiChat(Translator):
                 message["tool_calls"] = tool_calls
                 if not text:
                     message["content"] = None
+            if thought:
+                message["reasoning_content"] = thought
+            if thought or signature:
+                message["thinking_blocks"] = [{
+                    "type": "thinking", "thinking": thought,
+                    "signature": signature}]
             choice: dict[str, Any] = {
                 "index": i, "message": message, "finish_reason": finish
             }
@@ -399,7 +474,17 @@ class OpenAIToGeminiChat(Translator):
                     chunk_lp = gemini_logprobs_to_openai(
                         cand.get("logprobsResult") or {})
                 for p in (cand.get("content") or {}).get("parts") or ():
-                    if p.get("text"):
+                    if p.get("thoughtSignature") and \
+                            not self._thought_signature:
+                        # FIRST signature wins, matching the unary path
+                        self._thought_signature = \
+                            str(p["thoughtSignature"])
+                    if p.get("text") and p.get("thought"):
+                        tokens += 1
+                        self._thought_text += p["text"]
+                        out += self._emit(
+                            {"reasoning_content": p["text"]})
+                    elif p.get("text"):
                         tokens += 1
                         out += self._emit({"content": p["text"]},
                                           logprobs=chunk_lp)
@@ -431,6 +516,13 @@ class OpenAIToGeminiChat(Translator):
                     )
         if end_of_stream and not self._sent_done:
             self._sent_done = True
+            if self._thought_text or self._thought_signature:
+                # the completed thinking block (with its signature) in
+                # one delta so streamed turns replay like unary ones
+                out += self._emit({"thinking_blocks": [{
+                    "type": "thinking",
+                    "thinking": self._thought_text,
+                    "signature": self._thought_signature}]})
             usage = usage.merge_override(self._usage)
             out += SSEEvent(
                 data=json.dumps(

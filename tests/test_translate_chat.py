@@ -1080,3 +1080,141 @@ class TestCacheControlCoverage:
         }).body)
         assert out["messages"][0]["content"] == [
             {"type": "text", "text": "real"}]
+
+
+class TestGeminiThoughtSignatures:
+    """Gemini 3 thought signatures (gemini_helper.go:36-39, :264-330,
+    :790-820): thought parts are reasoning (never content), signatures
+    round-trip via thinking_blocks, the first functionCall of a
+    multi-turn request carries the echoed signature — or Google's
+    documented compat escape when the client echoed none."""
+
+    def test_response_separates_thought_from_content(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI,
+                           S.GCP_VERTEX_AI)
+        t.request({"model": "g", "messages": [
+            {"role": "user", "content": "q"}]})
+        rx = t.response_body(json.dumps({
+            "candidates": [{"content": {"parts": [
+                {"text": "thinking about it", "thought": True,
+                 "thoughtSignature": "c2ln"},
+                {"text": "the answer"}]},
+                "finishReason": "STOP"}],
+            "usageMetadata": {"promptTokenCount": 3,
+                              "candidatesTokenCount": 5},
+        }).encode(), True)
+        msg = json.loads(rx.body)["choices"][0]["message"]
+        assert msg["content"] == "the answer"
+        assert msg["reasoning_content"] == "thinking about it"
+        assert msg["thinking_blocks"] == [{
+            "type": "thinking", "thinking": "thinking about it",
+            "signature": "c2ln"}]
+
+    def test_request_echoes_signature_on_first_function_call(self):
+        from aigw_tpu.translate.openai_gcp import (
+            openai_messages_to_gemini,
+        )
+
+        _, contents = openai_messages_to_gemini([
+            {"role": "user", "content": "go"},
+            {"role": "assistant",
+             "thinking_blocks": [{"type": "thinking", "thinking": "t",
+                                  "signature": "c2ln"}],
+             "tool_calls": [
+                 {"id": "1", "type": "function",
+                  "function": {"name": "a", "arguments": "{}"}},
+                 {"id": "2", "type": "function",
+                  "function": {"name": "b", "arguments": "{}"}}]},
+        ])
+        parts = contents[1]["parts"]
+        assert parts[0]["thoughtSignature"] == "c2ln"
+        assert "thoughtSignature" not in parts[1]  # first call only
+
+    def test_dummy_signature_when_none_echoed(self):
+        from aigw_tpu.translate.openai_gcp import (
+            DUMMY_THOUGHT_SIGNATURE,
+            openai_messages_to_gemini,
+        )
+
+        _, contents = openai_messages_to_gemini([
+            {"role": "user", "content": "go"},
+            {"role": "assistant", "tool_calls": [
+                {"id": "1", "type": "function",
+                 "function": {"name": "a", "arguments": "{}"}}]},
+        ])
+        assert contents[1]["parts"][0]["thoughtSignature"] == \
+            DUMMY_THOUGHT_SIGNATURE
+        import base64
+
+        assert base64.b64decode(DUMMY_THOUGHT_SIGNATURE) == \
+            b"skip_thought_signature_validator"
+
+    def test_thought_part_without_tools_carries_signature(self):
+        from aigw_tpu.translate.openai_gcp import (
+            openai_messages_to_gemini,
+        )
+
+        _, contents = openai_messages_to_gemini([
+            {"role": "assistant", "content": [
+                {"type": "thinking", "text": "hm", "signature": "c2ln"},
+                {"type": "text", "text": "4"}]},
+        ])
+        parts = contents[0]["parts"]
+        assert parts[0] == {"text": "hm", "thought": True,
+                            "thoughtSignature": "c2ln"}
+        assert parts[1] == {"text": "4"}
+
+    def test_streaming_thought_and_signature(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI,
+                           S.GCP_VERTEX_AI, stream=True)
+        t.request({"model": "g", "stream": True, "messages": [
+            {"role": "user", "content": "q"}]})
+        chunks = [
+            {"candidates": [{"content": {"parts": [
+                {"text": "think", "thought": True}]}}]},
+            {"candidates": [{"content": {"parts": [
+                {"text": "ing", "thought": True,
+                 "thoughtSignature": "c2ln"}]}}]},
+            {"candidates": [{"content": {"parts": [{"text": "4"}]},
+                             "finishReason": "STOP"}],
+             "usageMetadata": {"promptTokenCount": 1,
+                               "candidatesTokenCount": 3}},
+        ]
+        raw = b"".join(f"data: {json.dumps(c)}\r\n\r\n".encode()
+                       for c in chunks)
+        body = t.response_body(raw, True).body.decode()
+        deltas = [json.loads(line[6:])["choices"][0]["delta"]
+                  for line in body.splitlines()
+                  if line.startswith("data: ")
+                  and line != "data: [DONE]" and "choices" in line]
+        reasoning = "".join(d.get("reasoning_content", "")
+                            for d in deltas)
+        content = "".join(d.get("content", "") for d in deltas)
+        assert reasoning == "thinking"
+        assert content == "4"
+        tb = [d["thinking_blocks"] for d in deltas
+              if "thinking_blocks" in d]
+        assert tb == [[{"type": "thinking", "thinking": "thinking",
+                        "signature": "c2ln"}]]
+
+    def test_streaming_keeps_first_signature_like_unary(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI,
+                           S.GCP_VERTEX_AI, stream=True)
+        t.request({"model": "g", "stream": True, "messages": [
+            {"role": "user", "content": "q"}]})
+        chunks = [
+            {"candidates": [{"content": {"parts": [
+                {"text": "t", "thought": True,
+                 "thoughtSignature": "Zmlyc3Q="}]}}]},
+            {"candidates": [{"content": {"parts": [
+                {"functionCall": {"name": "f", "args": {}},
+                 "thoughtSignature": "c2Vjb25k"}]},
+                "finishReason": "STOP"}]},
+        ]
+        raw = b"".join(f"data: {json.dumps(c)}\r\n\r\n".encode()
+                       for c in chunks)
+        body = t.response_body(raw, True).body.decode()
+        tb = [json.loads(line[6:])["choices"][0]["delta"]["thinking_blocks"]
+              for line in body.splitlines()
+              if line.startswith("data: ") and "thinking_blocks" in line]
+        assert tb[0][0]["signature"] == "Zmlyc3Q="  # FIRST, as unary
