@@ -188,9 +188,39 @@ def _validate_tools(body: dict[str, Any]) -> None:
         if not isinstance(t, dict):
             raise SchemaError(f"tools[{i}] must be an object")
         ttype = t.get("type")
+        # the reference's ToolType enum (openai.go:1223-1230): built-in
+        # Gemini tools ride the same list; translators decide support
+        if ttype in ("google_search", "enterprise_search",
+                     "image_generation"):
+            gs = t.get("google_search")
+            if gs is not None:
+                if not isinstance(gs, dict):
+                    raise SchemaError(
+                        f"tools[{i}].google_search must be an object")
+                ed = gs.get("exclude_domains")
+                if ed is not None and (
+                        not isinstance(ed, list)
+                        or not all(isinstance(d, str) for d in ed)):
+                    raise SchemaError(
+                        f"tools[{i}].google_search.exclude_domains must "
+                        "be an array of strings")
+                for key in ("blocking_confidence",):
+                    v = gs.get(key)
+                    if v is not None and not isinstance(v, str):
+                        raise SchemaError(
+                            f"tools[{i}].google_search.{key} must be a "
+                            "string")
+                trf = gs.get("time_range_filter")
+                if trf is not None and not isinstance(trf, dict):
+                    raise SchemaError(
+                        f"tools[{i}].google_search.time_range_filter "
+                        "must be an object")
+            continue
         if ttype != "function":
             raise SchemaError(
-                f"tools[{i}].type must be 'function', got {ttype!r}")
+                f"tools[{i}].type must be 'function', 'google_search', "
+                f"'enterprise_search' or 'image_generation', got "
+                f"{ttype!r}")
         fn = t.get("function")
         if not isinstance(fn, dict):
             raise SchemaError(f"tools[{i}].function must be an object")

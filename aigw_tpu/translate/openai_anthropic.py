@@ -228,7 +228,12 @@ def openai_tools_to_anthropic(body: dict[str, Any]) -> dict[str, Any]:
         converted = []
         for t in tools:
             if t.get("type") != "function":
-                continue
+                # Gemini built-in tools pass the shared validator but
+                # have no Anthropic shape — a clear 400 beats silently
+                # serving without the capability
+                raise TranslationError(
+                    f"tool type {t.get('type')!r} is not supported by "
+                    "Anthropic backends")
             fn = t.get("function") or {}
             tool = {
                 "name": fn.get("name", ""),

@@ -305,7 +305,9 @@ class OpenAIToBedrockChat(Translator):
             tool_entries: list[dict[str, Any]] = []
             for t in tools:
                 if t.get("type") != "function":
-                    continue
+                    raise TranslationError(
+                        f"tool type {t.get('type')!r} is not supported "
+                        "by Bedrock backends")
                 fn = t.get("function") or {}
                 tool_entries.append({
                     "toolSpec": {

@@ -176,7 +176,14 @@ def openai_messages_to_gemini(
                 # signature rides the FIRST functionCall only (parallel
                 # calls carry one signature; gemini_helper.go:313-323);
                 # no echoed signature → Google's compat escape
-                if idx == 0:
+                if idx == 0 and not (
+                        contents and contents[-1]["role"] == "model"
+                        and any("functionCall" in p
+                                for p in contents[-1]["parts"])):
+                    # push() merges consecutive model turns; only the
+                    # first functionCall of the MERGED content may carry
+                    # a signature (Gemini rejects signatures on later
+                    # parallel calls)
                     part["thoughtSignature"] = (
                         signature or DUMMY_THOUGHT_SIGNATURE)
                 parts.append(part)
@@ -294,23 +301,51 @@ class OpenAIToGeminiChat(Translator):
             out["generationConfig"] = gen
         tools = body.get("tools")
         if tools:
-            out["tools"] = [
-                {
-                    "functionDeclarations": [
-                        {
-                            "name": (t.get("function") or {}).get("name", ""),
-                            "description": (t.get("function") or {}).get(
-                                "description", ""
-                            ),
-                            "parameters": (t.get("function") or {}).get(
-                                "parameters", {"type": "object"}
-                            ),
-                        }
-                        for t in tools
-                        if t.get("type") == "function"
-                    ]
-                }
-            ]
+            # function declarations + Gemini built-in tools
+            # (gemini_helper.go:440-497: google_search with
+            # exclude_domains/blocking_confidence/time_range_filter,
+            # enterprise_search; image_generation unsupported)
+            fn_decls = []
+            gemini_tools: list[dict[str, Any]] = []
+            for t in tools:
+                ttype = t.get("type")
+                if ttype == "function":
+                    fn = t.get("function") or {}
+                    fn_decls.append({
+                        "name": fn.get("name", ""),
+                        "description": fn.get("description", ""),
+                        "parameters": fn.get("parameters",
+                                             {"type": "object"}),
+                    })
+                elif ttype == "google_search":
+                    gs_cfg = t.get("google_search") or {}
+                    gs: dict[str, Any] = {}
+                    if gs_cfg.get("exclude_domains"):
+                        gs["excludeDomains"] = list(
+                            gs_cfg["exclude_domains"])
+                    if gs_cfg.get("blocking_confidence"):
+                        gs["blockingConfidence"] = \
+                            gs_cfg["blocking_confidence"]
+                    trf = gs_cfg.get("time_range_filter")
+                    if isinstance(trf, dict):
+                        f: dict[str, Any] = {}
+                        if trf.get("start_time"):
+                            f["startTime"] = trf["start_time"]
+                        if trf.get("end_time"):
+                            f["endTime"] = trf["end_time"]
+                        if f:
+                            gs["timeRangeFilter"] = f
+                    gemini_tools.append({"googleSearch": gs})
+                elif ttype == "enterprise_search":
+                    gemini_tools.append({"enterpriseWebSearch": {}})
+                elif ttype == "image_generation":
+                    raise TranslationError(
+                        "tool-type image generation not supported yet")
+            if fn_decls:
+                gemini_tools.append(
+                    {"functionDeclarations": fn_decls})
+            if gemini_tools:
+                out["tools"] = gemini_tools
         choice = body.get("tool_choice")
         if choice == "none":
             out["toolConfig"] = {"functionCallingConfig": {"mode": "NONE"}}

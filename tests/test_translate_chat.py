@@ -1218,3 +1218,116 @@ class TestGeminiThoughtSignatures:
               for line in body.splitlines()
               if line.startswith("data: ") and "thinking_blocks" in line]
         assert tb[0][0]["signature"] == "Zmlyc3Q="  # FIRST, as unary
+
+
+class TestGeminiBuiltinTools:
+    """Gemini built-in search tools on the unified surface
+    (gemini_helper.go:440-497; ToolType enum openai.go:1223-1230)."""
+
+    def test_google_search_full_config(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI,
+                           S.GCP_VERTEX_AI)
+        out = json.loads(t.request({
+            "model": "g",
+            "messages": [{"role": "user", "content": "news?"}],
+            "tools": [
+                {"type": "google_search", "google_search": {
+                    "exclude_domains": ["example.com"],
+                    "blocking_confidence": "BLOCK_LOW_AND_ABOVE",
+                    "time_range_filter": {
+                        "start_time": "2026-01-01T00:00:00Z",
+                        "end_time": "2026-07-01T00:00:00Z"}}},
+                {"type": "function", "function": {
+                    "name": "f", "parameters": {"type": "object"}}},
+            ],
+        }).body)
+        tools = out["tools"]
+        assert tools[0]["googleSearch"]["excludeDomains"] == \
+            ["example.com"]
+        assert tools[0]["googleSearch"]["blockingConfidence"] == \
+            "BLOCK_LOW_AND_ABOVE"
+        assert tools[0]["googleSearch"]["timeRangeFilter"] == {
+            "startTime": "2026-01-01T00:00:00Z",
+            "endTime": "2026-07-01T00:00:00Z"}
+        assert tools[1]["functionDeclarations"][0]["name"] == "f"
+
+    def test_enterprise_search(self):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI,
+                           S.GCP_VERTEX_AI)
+        out = json.loads(t.request({
+            "model": "g",
+            "messages": [{"role": "user", "content": "q"}],
+            "tools": [{"type": "enterprise_search"}],
+        }).body)
+        assert out["tools"] == [{"enterpriseWebSearch": {}}]
+
+    def test_image_generation_rejected(self):
+        from aigw_tpu.translate.base import TranslationError
+
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI,
+                           S.GCP_VERTEX_AI)
+        with pytest.raises(TranslationError):
+            t.request({
+                "model": "g",
+                "messages": [{"role": "user", "content": "q"}],
+                "tools": [{"type": "image_generation"}],
+            })
+
+    def test_validator_accepts_builtin_types(self):
+        from aigw_tpu.schemas.openai import (
+            SchemaError,
+            validate_chat_request,
+        )
+
+        validate_chat_request({"model": "m", "messages": [
+            {"role": "user", "content": "q"}],
+            "tools": [{"type": "google_search"}]})
+        with pytest.raises(SchemaError):
+            validate_chat_request({"model": "m", "messages": [
+                {"role": "user", "content": "q"}],
+                "tools": [{"type": "shell"}]})
+
+    def test_builtin_tools_rejected_on_anthropic_and_bedrock(self):
+        from aigw_tpu.translate.base import TranslationError
+        from aigw_tpu.translate.openai_awsbedrock import OpenAIToBedrockChat
+
+        body = {"model": "m",
+                "messages": [{"role": "user", "content": "q"}],
+                "tools": [{"type": "google_search"}]}
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI,
+                           S.ANTHROPIC)
+        with pytest.raises(TranslationError):
+            t.request(dict(body))
+        with pytest.raises(TranslationError):
+            OpenAIToBedrockChat().request(dict(body))
+
+    def test_exclude_domains_must_be_string_array(self):
+        from aigw_tpu.schemas.openai import (
+            SchemaError,
+            validate_chat_request,
+        )
+
+        with pytest.raises(SchemaError):
+            validate_chat_request({"model": "m", "messages": [
+                {"role": "user", "content": "q"}],
+                "tools": [{"type": "google_search", "google_search": {
+                    "exclude_domains": "example.com"}}]})
+
+    def test_merged_assistant_turns_one_signature(self):
+        from aigw_tpu.translate.openai_gcp import (
+            openai_messages_to_gemini,
+        )
+
+        _, contents = openai_messages_to_gemini([
+            {"role": "user", "content": "go"},
+            {"role": "assistant", "tool_calls": [
+                {"id": "1", "type": "function",
+                 "function": {"name": "a", "arguments": "{}"}}]},
+            {"role": "assistant", "tool_calls": [
+                {"id": "2", "type": "function",
+                 "function": {"name": "b", "arguments": "{}"}}]},
+        ])
+        parts = contents[1]["parts"]  # merged model turn
+        signed = [p for p in parts if "thoughtSignature" in p]
+        assert len(signed) == 1
+        assert "thoughtSignature" in parts[0]
