@@ -350,7 +350,8 @@ class Engine:
                     params, mc, tokens, seq_lens, kv, page_table, ps,
                     mesh=mesh, lora=lora, adapter_idx=adapter_idx,
                 )
-                return sample(logits + bias, keys, temp, top_p, top_k), kv
+                return _sample_maybe_lp(logits + bias, keys, temp, top_p,
+                                        top_k), kv
 
             self._prefill_sp_fn = jax.jit(_prefill_sp_step,
                                           donate_argnums=(4,))
@@ -794,9 +795,6 @@ class Engine:
                     [(int(t), float(v)) for t, v in zip(
                         np.asarray(tk_ids)[0], np.asarray(tk_vals)[0])],
                 )
-            # note: the sequence-parallel (ring) prefill path does not
-            # compute logprobs — a request served through it omits the
-            # first token's logprob entry
             tok = int(next_tok[0])
             self.stats.prefills += 1
             if self.prefix_cache is not None and chain_keys:

@@ -211,3 +211,53 @@ class TestServerValidation:
                                         min_prefill_bucket=16),
                 tp=3,
             )
+
+
+class TestSequenceParallelLogprobs:
+    def test_sp_prefill_first_token_carries_logprobs(self):
+        """The ring-attention prefill path emits the first token's
+        logprob entry like the plain path (closes the documented sp
+        gap)."""
+        import threading
+
+        from aigw_tpu.models import llama
+        from aigw_tpu.parallel import MeshSpec, make_mesh
+
+        cfg = llama.LlamaConfig(
+            vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=4,
+            ffn_dim=128, max_seq_len=512, rope_theta=10000.0,
+        )
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        mesh = make_mesh(MeshSpec(dp=1, tp=1, sp=8))
+        eng = Engine(
+            params, cfg,
+            EngineConfig(max_batch_size=2, max_seq_len=512, page_size=16,
+                         min_prefill_bucket=32, sp_prefill_min_tokens=64,
+                         logprobs_topk=3),
+            mesh=mesh, eos_token_ids=(255,),
+        )
+        eng.start()
+        try:
+            done = threading.Event()
+            rows = []
+
+            def emit_lp(tok, fin, chosen, top):
+                if tok >= 0:
+                    rows.append((tok, chosen, top))
+                if fin is not None:
+                    done.set()
+
+            eng.submit(GenRequest(
+                prompt=list(range(1, 97)),  # ≥ sp threshold → ring path
+                max_tokens=3,
+                sampling=SamplingParams(temperature=0.0),
+                emit_lp=emit_lp))
+            assert done.wait(timeout=300)
+            assert eng.stats.sp_prefills >= 1  # really took the sp path
+            assert len(rows) >= 1
+            # the FIRST token (from the sp prefill) carries its logprob
+            tok0, chosen0, top0 = rows[0]
+            assert chosen0 is not None and chosen0 <= 0.0
+            assert top0 and top0[0][0] == tok0  # greedy = top-1
+        finally:
+            eng.stop()
