@@ -118,6 +118,48 @@ DUMMY_THOUGHT_SIGNATURE = base64.b64encode(
     b"skip_thought_signature_validator").decode()
 
 
+def _gemini3_or_newer(model: str) -> bool:
+    """True for gemini-3* model names — the version segment, not a bare
+    substring ('gemini-2.5-pro-preview-03-25' contains a '3' but must
+    not pass)."""
+    import re
+
+    return re.search(r"gemini-(\d+)", model.lower()) is not None and \
+        int(re.search(r"gemini-(\d+)", model.lower()).group(1)) >= 3
+
+
+def _reasoning_effort_to_thinking_level(effort: str, model: str) -> str:
+    """OpenAI reasoning_effort → Gemini thinkingLevel, availability and
+    mapping keyed on the model family (gemini_helper.go:595-636:
+    Gemini-3-only; "none" and "high" are Flash-only; "medium" maps to
+    HIGH on Pro)."""
+    is_flash = "flash" in model.lower()
+    if effort == "minimal":
+        # documented OpenAI value; Flash has a native MINIMAL level,
+        # Pro's floor is LOW (mirrors the Anthropic translator's
+        # minimal→low downmapping)
+        return "MINIMAL" if is_flash else "LOW"
+    if effort == "none":
+        if not is_flash:
+            raise TranslationError(
+                "reasoning effort 'none' is only supported for Gemini "
+                "Flash models")
+        return "MINIMAL"
+    if effort == "low":
+        return "LOW"
+    if effort == "medium":
+        return "MEDIUM" if is_flash else "HIGH"
+    if effort == "high":
+        if not is_flash:
+            raise TranslationError(
+                "reasoning effort 'high' is only supported for Gemini "
+                "Flash models")
+        return "HIGH"
+    raise TranslationError(
+        f"unsupported reasoning effort level: {effort!r} "
+        "(supported: none, minimal, low, medium, high)")
+
+
 def _assistant_thought_signature(m: dict[str, Any]) -> str:
     """First signature echoed back by the client — from thinking content
     parts or the thinking_blocks convention (gemini_helper.go:264-296).
@@ -292,6 +334,14 @@ class OpenAIToGeminiChat(Translator):
         if body.get("logprobs") is not None:
             gen["responseLogprobs"] = bool(body["logprobs"])
         self._want_logprobs = bool(body.get("logprobs"))
+        effort = body.get("reasoning_effort")
+        if effort and _gemini3_or_newer(self._model):
+            # Gemini 3.0+ only; older models silently ignore the knob
+            # like the reference's availability gate
+            # (gemini_helper.go:595-599, :728-736)
+            gen["thinkingConfig"] = {
+                "thinkingLevel": _reasoning_effort_to_thinking_level(
+                    str(effort), self._model)}
         self._apply_output_format(body, gen)
         # proposal-004 vendor fields: thinking → thinkingConfig, vendor
         # generationConfig/safetySettings override translated fields

@@ -1331,3 +1331,64 @@ class TestGeminiBuiltinTools:
         signed = [p for p in parts if "thoughtSignature" in p]
         assert len(signed) == 1
         assert "thoughtSignature" in parts[0]
+
+
+class TestGeminiReasoningEffort:
+    """reasoning_effort → Gemini thinkingLevel (gemini_helper.go:595-636:
+    Gemini-3-only; none/high are Flash-only; medium maps to HIGH on
+    Pro)."""
+
+    def _req(self, model, effort):
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI,
+                           S.GCP_VERTEX_AI)
+        return json.loads(t.request({
+            "model": model, "reasoning_effort": effort,
+            "messages": [{"role": "user", "content": "q"}]}).body)
+
+    def test_flash_mappings(self):
+        for effort, level in (("none", "MINIMAL"), ("low", "LOW"),
+                              ("medium", "MEDIUM"), ("high", "HIGH")):
+            out = self._req("gemini-3-flash", effort)
+            assert out["generationConfig"]["thinkingConfig"] == {
+                "thinkingLevel": level}, effort
+
+    def test_pro_medium_maps_high(self):
+        out = self._req("gemini-3-pro", "medium")
+        assert out["generationConfig"]["thinkingConfig"] == {
+            "thinkingLevel": "HIGH"}
+
+    def test_pro_rejects_none_and_high(self):
+        from aigw_tpu.translate.base import TranslationError
+
+        for effort in ("none", "high"):
+            with pytest.raises(TranslationError):
+                self._req("gemini-3-pro", effort)
+
+    def test_older_models_ignore_knob(self):
+        out = self._req("gemini-1.5-pro", "high")
+        assert "thinkingConfig" not in out.get("generationConfig", {})
+
+    def test_vendor_thinking_still_wins(self):
+        # proposal-004 vendor fields apply after translation and
+        # override (openai_gcpvertexai.go:574)
+        t = get_translator(Endpoint.CHAT_COMPLETIONS, S.OPENAI,
+                           S.GCP_VERTEX_AI)
+        out = json.loads(t.request({
+            "model": "gemini-3-flash", "reasoning_effort": "low",
+            "thinking": {"type": "enabled", "budget_tokens": 99},
+            "messages": [{"role": "user", "content": "q"}]}).body)
+        assert out["generationConfig"]["thinkingConfig"] == {
+            "thinkingBudget": 99}
+
+    def test_minimal_maps_per_family(self):
+        assert self._req("gemini-3-flash", "minimal")[
+            "generationConfig"]["thinkingConfig"] == {
+                "thinkingLevel": "MINIMAL"}
+        assert self._req("gemini-3-pro", "minimal")[
+            "generationConfig"]["thinkingConfig"] == {
+                "thinkingLevel": "LOW"}
+
+    def test_dated_2x_snapshot_not_gated_as_gemini3(self):
+        # '03-25' in the snapshot date must not trip the version gate
+        out = self._req("gemini-2.5-pro-preview-03-25", "high")
+        assert "thinkingConfig" not in out.get("generationConfig", {})
