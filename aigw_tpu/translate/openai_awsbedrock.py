@@ -294,6 +294,17 @@ class OpenAIToBedrockChat(Translator):
         # proposal-004 vendor field: thinking union → Converse
         # additionalModelRequestFields (openai_awsbedrock.go:57-90,:142-146)
         amrf = vendor_fields.thinking_to_bedrock(body)
+        # reasoning_effort forwards as reasoning_config for GLM/Nova and
+        # other Bedrock-hosted reasoning models (openai_awsbedrock.go:149-154)
+        effort = body.get("reasoning_effort")
+        if effort is not None:
+            if not isinstance(effort, str):
+                # the reference's typed unmarshal 400s this at the edge
+                # (openai.go:1016 string alias)
+                raise TranslationError(
+                    "reasoning_effort must be a string")
+            amrf = dict(amrf or {})
+            amrf["reasoning_config"] = effort
         if amrf is not None:
             out["additionalModelRequestFields"] = amrf
         tools = body.get("tools")

@@ -1392,3 +1392,34 @@ class TestGeminiReasoningEffort:
         # '03-25' in the snapshot date must not trip the version gate
         out = self._req("gemini-2.5-pro-preview-03-25", "high")
         assert "thinkingConfig" not in out.get("generationConfig", {})
+
+
+class TestBedrockReasoningConfig:
+    def test_reasoning_effort_forwards(self):
+        """reasoning_effort → additionalModelRequestFields.
+        reasoning_config for Bedrock-hosted reasoning models
+        (openai_awsbedrock.go:149-154); composes with the thinking
+        union."""
+        from aigw_tpu.translate.openai_awsbedrock import OpenAIToBedrockChat
+
+        out = json.loads(OpenAIToBedrockChat().request({
+            "model": "us.amazon.nova-pro", "reasoning_effort": "high",
+            "messages": [{"role": "user", "content": "q"}]}).body)
+        assert out["additionalModelRequestFields"] == {
+            "reasoning_config": "high"}
+        out = json.loads(OpenAIToBedrockChat().request({
+            "model": "m", "reasoning_effort": "low",
+            "thinking": {"type": "enabled", "budget_tokens": 64},
+            "messages": [{"role": "user", "content": "q"}]}).body)
+        amrf = out["additionalModelRequestFields"]
+        assert amrf["reasoning_config"] == "low"
+        assert amrf["thinking"]["budget_tokens"] == 64
+
+    def test_non_string_reasoning_effort_rejected(self):
+        from aigw_tpu.translate.base import TranslationError
+        from aigw_tpu.translate.openai_awsbedrock import OpenAIToBedrockChat
+
+        with pytest.raises(TranslationError):
+            OpenAIToBedrockChat().request({
+                "model": "m", "reasoning_effort": {"x": 1},
+                "messages": [{"role": "user", "content": "q"}]})
