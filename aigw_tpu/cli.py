@@ -192,23 +192,60 @@ def main(argv: list[str] | None = None) -> int:
         from aigw_tpu.config.controller import Reconciler, is_manifest_dir
         from aigw_tpu.config.model import ConfigError, load_config
 
+        def report_rejections(rec) -> int:
+            bad = sorted(rec.not_accepted().items())
+            for key, cond in bad:
+                print(f"NOT ACCEPTED {key}: {cond['message']}",
+                      file=sys.stderr)
+            return len(bad)
+
         try:
-            if is_manifest_dir(args.config):
+            if args.config.startswith("kube:"):
+                # one-shot cluster dry run: list the CRDs, reconcile,
+                # print per-object rejections — no status writeback
+                import tempfile
+
+                from aigw_tpu.config.kube import (
+                    KubeReconciler,
+                    KubeSource,
+                    parse_kube_target,
+                )
+
+                source = KubeSource(parse_kube_target(args.config))
+                source.start()
+                try:
+                    if not source.wait_synced(30.0):
+                        print("INVALID: API server never synced",
+                              file=sys.stderr)
+                        return 1
+                    with tempfile.NamedTemporaryFile(
+                            suffix=".json") as tf:
+                        rec = KubeReconciler(source,
+                                             status_path=tf.name,
+                                             leader_election=False,
+                                             dry_run=True)
+                        cfg = rec.load()
+                    if report_rejections(rec):
+                        return 1
+                finally:
+                    source.stop()
+            elif is_manifest_dir(args.config):
                 # reconcile dry run: per-object conditions to stdout
                 import tempfile
 
                 with tempfile.NamedTemporaryFile(suffix=".json") as tf:
                     rec = Reconciler(args.config, status_path=tf.name)
                     cfg = rec.load()
-                bad = sorted(rec.not_accepted().items())
-                for key, cond in bad:
-                    print(f"NOT ACCEPTED {key}: {cond['message']}",
-                          file=sys.stderr)
-                if bad:
+                if report_rejections(rec):
                     return 1
             else:
                 cfg = load_config(args.config)
         except ConfigError as e:
+            print(f"INVALID: {e}", file=sys.stderr)
+            return 1
+        except (OSError, ValueError) as e:
+            # bad kubeconfig / unreadable file: same INVALID contract as
+            # every other validate failure, never a raw traceback
             print(f"INVALID: {e}", file=sys.stderr)
             return 1
         print(

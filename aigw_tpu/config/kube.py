@@ -430,7 +430,8 @@ class KubeReconciler:
 
     def __init__(self, source: KubeSource,
                  status_path: str | None = None,
-                 leader_election: bool | None = None):
+                 leader_election: bool | None = None,
+                 dry_run: bool = False):
         from aigw_tpu.config.controller import Reconciler
 
         self.source = source
@@ -462,6 +463,7 @@ class KubeReconciler:
                 prefix="aigw-kube-status-", suffix=".json")
             os.close(fd)
         self._rec = Reconciler(directory=".", status_path=status_path)
+        self._dry_run = dry_run
         self._patched: dict[str, str] = {}  # key → last patched checksum
 
     def conditions(self) -> dict[str, dict[str, Any]]:
@@ -495,6 +497,9 @@ class KubeReconciler:
         # pushed yet (otherwise every reconcile tick re-patches and the
         # watch event from our own patch re-triggers the reconcile)
         conds = self._rec.conditions()
+        if self._dry_run:
+            # validate mode: report, never write onto the cluster
+            return cfg
         if self._elector is not None and not self._elector.is_leader:
             # not the leader: serve, but leave status writing (and the
             # patched-stamp cache) to whoever is — if leadership moves

@@ -572,3 +572,46 @@ class TestLeaderElection:
                 await api.stop()
 
         asyncio.run(main())
+
+
+class TestKubeValidateCLI:
+    def test_validate_kube_target(self, tmp_path, capsys):
+        """`aigw validate kube:<kubeconfig>` dry-runs the cluster state
+        and prints rejections without writing status."""
+
+        async def main():
+            api = FakeAPIServer()
+            await api.start()
+            for obj in (_backend_objs("b1", "127.0.0.1", 8901)
+                        + [_route_obj("r1", "m1", "b1"),
+                           {"apiVersion":
+                                "aigateway.envoyproxy.io/v1alpha1",
+                            "kind": "BackendSecurityPolicy",
+                            "metadata": {"name": "bad-bsp",
+                                         "namespace": "default"},
+                            "spec": {"type": "Bogus",
+                                     "targetRefs": [{"name": "b1"}]}}]):
+                api.objects[FakeAPIServer._key(obj)] = obj
+            kubeconfig = _write_kubeconfig(tmp_path, api.url)
+            try:
+                from aigw_tpu.cli import main as cli_main
+
+                rc = await asyncio.to_thread(
+                    cli_main, ["validate", f"kube:{kubeconfig}"])
+                captured = capsys.readouterr()
+                assert rc == 1  # the broken BSP fails validation
+                assert "bad-bsp" in captured.err
+                assert api.status_patches == []  # dry run: no writeback
+            finally:
+                await api.stop()
+
+        asyncio.run(main())
+
+    def test_validate_bad_kubeconfig_prints_invalid(self, tmp_path,
+                                                    capsys):
+        from aigw_tpu.cli import main as cli_main
+
+        rc = cli_main(["validate", "kube:/no/such/kubeconfig"])
+        captured = capsys.readouterr()
+        assert rc == 1
+        assert "INVALID" in captured.err
