@@ -605,219 +605,443 @@ class Engine:
         return None
 
     def _admit(self) -> bool:
-        """Admit queued requests: prefill + first token."""
+        """Admit queued requests: prefill + first token.
+
+        Simple prompts (plain full prefill — no prefix-cache hit, not
+        chunked, not sequence-parallel) that are queued together are
+        prefilled in ONE batched [G, S] device call instead of G serial
+        [1, S] calls: a batch-B burst's first tokens arrive after one
+        large MXU-friendly pass rather than a B-step prefill ladder
+        (vLLM-style batched admission, TPU-first shape discipline —
+        padded rows carry seq_len 0, whose K/V scatters drop). Everything
+        else takes the per-request path below."""
         admitted = False
         while True:
-            slot_idx = self._free_slot_index()
-            if slot_idx is None:
+            free = sum(1 for s in self._slots if s is None)
+            if free == 0:
                 break
+            pending: list[GenRequest] = []
             try:
-                req = self._queue.get_nowait()
+                while len(pending) < free:
+                    pending.append(self._queue.get_nowait())
             except queue.Empty:
+                pass
+            if not pending:
                 break
-            if req.cancelled.is_set():
-                continue
+            # Classify once (prompt hashes computed here are reused all
+            # the way to the post-prefill cache insert), then admit in
+            # STRICT arrival order: contiguous runs of ≥2 simple requests
+            # go through the batched prefill, everything else through the
+            # per-request path — so pages are always allocated in arrival
+            # order and a requeued head-of-line request can never be
+            # starved by later simple arrivals grabbing its pages.
+            items: list[tuple[GenRequest, bool, list]] = []
+            seen_chain_heads: set = set()
+            for req in pending:
+                if req.cancelled.is_set():
+                    continue
+                ok, chain = self._classify(req)
+                if ok and chain:
+                    head = chain[0]
+                    if head in seen_chain_heads:
+                        # a batch-mate shares its first prompt page: the
+                        # batched path would prefill the shared prefix
+                        # redundantly with its own page copies — route it
+                        # through the per-request path, which adopts the
+                        # pages the batch inserts in this same pass
+                        ok = False
+                    else:
+                        seen_chain_heads.add(head)
+                items.append((req, ok, chain))
+            stop = False
+            unhandled: list[GenRequest] = []
+            i = 0
+            while i < len(items):
+                req, simple, chain = items[i]
+                if simple:
+                    j = i
+                    while j < len(items) and items[j][1]:
+                        j += 1
+                    if j - i >= 2:
+                        run = items[i:j]
+                        done, leftover = self._admit_batch(
+                            [it[0] for it in run],
+                            {id(it[0]): it[2] for it in run})
+                        admitted |= done > 0
+                        if leftover is not None:  # page pressure
+                            unhandled.extend(leftover)
+                            unhandled.extend(it[0] for it in items[j:])
+                            stop = True
+                            break
+                        i = j
+                        continue
+                r = self._admit_one(req, chain)
+                if r == "admitted":
+                    admitted = True
+                elif r in ("stop", "stop_consumed"):
+                    if r == "stop":
+                        unhandled.append(req)
+                    unhandled.extend(it[0] for it in items[i + 1:])
+                    stop = True
+                    break
+                i += 1
+            if unhandled:
+                # single requeue, arrival order preserved by construction
+                self._requeue_front_many(unhandled)
+            if stop:
+                break
+        return admitted
+
+    def _classify(self, req: GenRequest) -> tuple[bool, list]:
+        """(simple, chain_keys): simple = eligible for the batched
+        prefill (whole-prompt, no cached prefix to adopt, below the
+        sequence-parallel and chunking thresholds, resolvable adapter).
+        chain_keys are the prompt's content hashes — computed ONCE here
+        and reused by both paths; only the cheap cache *probe* is redone
+        at adoption time (cache state moves within a pass)."""
+        n = len(req.prompt)
+        if n < 1:
+            return False, []
+        chain: list = []
+        if self.prefix_cache is not None and n > 1:
+            chain = self.prefix_cache.chain_keys(req.prompt)
+            hits = len(self.prefix_cache.probe(chain))
+            if min(hits, (n - 1) // self.cfg.page_size) > 0:
+                return False, chain
+        if (self._prefill_sp_fn is not None
+                and n >= self.cfg.sp_prefill_min_tokens):
+            return False, chain
+        chunk = self.cfg.prefill_chunk_tokens
+        if (chunk > 0 and self.fns.prefill_suffix is not None
+                and n > chunk):
+            return False, chain
+        if req.adapter and req.adapter not in self.adapter_rows:
+            return False, chain  # singleton path surfaces the error
+        return True, chain
+
+    def _admit_batch(
+        self, reqs: list[GenRequest], chain_by_req: dict[int, list],
+    ) -> tuple[int, list[GenRequest] | None]:
+        """Allocate + batch-prefill ``reqs`` (all simple). Returns
+        (admitted count, leftover): leftover is None without pressure,
+        else the unallocated tail for the CALLER to requeue (alongside
+        anything else it popped, in arrival order)."""
+        prepared: list[tuple[GenRequest, int, int, int]] = []
+        leftover: list[GenRequest] | None = None
+        for i, req in enumerate(reqs):
             n = len(req.prompt)
             total = min(n + req.max_tokens, self.cfg.max_seq_len)
             seq_id = next(self._seq_ids)
-            ps = self.cfg.page_size
-
-            # prefix cache: adopt the longest cached page-prefix (capped so
-            # at least one suffix token remains to produce first logits)
-            cached_pages: list[int] = []
-            chain_keys: list = []
-            if self.prefix_cache is not None and n > 1:
-                hits, hit_pages, chain_keys = self.prefix_cache.lookup(
-                    req.prompt
-                )
-                hits = min(hits, (n - 1) // ps)
-                cached_pages = hit_pages[:hits]
-            prefix_len = len(cached_pages) * ps
-
             try:
-                if cached_pages:
-                    self.allocator.adopt(seq_id, cached_pages)
-                    extra = self.allocator.pages_for(total) - len(cached_pages)
-                    if extra > 0:
-                        self.allocator.allocate_extra(seq_id, extra)
-                else:
-                    self.allocator.allocate(seq_id, total)
+                self.allocator.allocate(seq_id, total)
             except OutOfPagesError:
                 self.allocator.free(seq_id)
-                # put it back and wait for a slot to free pages
-                self._requeue_front(req)
+                leftover = reqs[i:]
                 break
-            pages = self.allocator.pages(seq_id)
-            req.id = seq_id
-
-            suffix = req.prompt[prefix_len:]
-            ns = len(suffix)
-            use_sp = (
-                self._prefill_sp_fn is not None
-                and prefix_len == 0
-                and ns >= self.cfg.sp_prefill_min_tokens
-            )
-            pt = np.zeros((1, self.cfg.max_pages_per_seq), np.int32)
-            pt[0, : len(pages)] = pages
-
-            adapter_row = self._base_row
-            if req.adapter:
-                row = self.adapter_rows.get(req.adapter)
-                if row is None:
-                    req.emit(-1, "error")
-                    self.allocator.free(seq_id)
-                    continue
-                adapter_row = row
-            key = np.array([[req.sampling.seed or seq_id, 0]], np.uint32)
-            bias_row = np.zeros((1, self.model_cfg.vocab_size), np.float32)
-            for tok_id, b in req.sampling.logit_bias:
-                if 0 <= tok_id < self.model_cfg.vocab_size:
-                    bias_row[0, tok_id] = b
-            sampling_args = (
-                jnp.asarray(key),
-                jnp.asarray([req.sampling.temperature], jnp.float32),
-                jnp.asarray([req.sampling.top_p], jnp.float32),
-                jnp.asarray([req.sampling.top_k], jnp.int32),
-                jnp.asarray(bias_row),
-                jnp.asarray([adapter_row], jnp.int32),
-            )
-            t0 = time.monotonic()
-            # pow2 page bucket covering the sequence — the gather window
-            # of suffix/chunked steps, not the full max_seq_len window
-            need = self.allocator.pages_for(total)
-            bucket = 1
-            while bucket < need:
-                bucket *= 2
-            bucket = min(bucket, self.cfg.max_pages_per_seq)
-
-            # chunked prefill: long prompts run as fixed-size suffix
-            # steps so no giant bucket is ever compiled and a decode
-            # tick runs between chunks — active streams keep emitting
-            # behind a long prompt instead of stalling for its whole
-            # prefill (vLLM-style chunked prefill; the prefill_suffix
-            # kernel with prefix_lens=consumed IS the chunk step)
-            chunk = self.cfg.prefill_chunk_tokens
-            consumed = 0
-            if (chunk > 0 and not use_sp
-                    and self.fns.prefill_suffix is not None
-                    and ns > chunk):
-                # loop-invariant device uploads hoisted; each boundary
-                # is also a cancellation/shutdown yield point — exactly
-                # what chunking exists to provide
-                pt_dev = jnp.asarray(pt[:, :bucket])
-                ctokens = np.zeros((1, chunk), np.int32)
-                aborted = False
-                while ns - consumed > chunk:
-                    if req.cancelled.is_set() or self._stop.is_set():
-                        aborted = True
-                        break
-                    ctokens[0, :] = suffix[consumed:consumed + chunk]
-                    _, self.kv_cache = self._prefill_suffix_fn(
-                        self.params,
-                        self.lora_params,
-                        jnp.asarray(ctokens),
-                        jnp.asarray([prefix_len + consumed], jnp.int32),
-                        jnp.asarray([prefix_len + consumed + chunk],
-                                    jnp.int32),
-                        self.kv_cache,
-                        pt_dev,
-                        *sampling_args,
-                    )
-                    consumed += chunk
-                    self.stats.chunked_prefill_steps += 1
-                    self._decode_tick()
-                if aborted:
-                    self.allocator.free(seq_id)
-                    if self._stop.is_set():
-                        # graceful stop mid-prompt: hand it back like an
-                        # OutOfPages retry; the drain path settles it
-                        if not req.cancelled.is_set():
-                            self._requeue_front(req)
-                        break
-                    continue  # cancelled: next queued request
-
-            eff_prefix = prefix_len + consumed
-            tail = suffix[consumed:]
-            ns_tail = len(tail)
-            # bucketed padded length for the remaining tokens
+            prepared.append((req, seq_id, n, total))
+        count = 0
+        # group by padded bucket so each group is one compiled shape
+        groups: dict[int, list] = {}
+        for item in prepared:
             S = self.cfg.min_prefill_bucket
-            while S < ns_tail:
+            while S < item[2]:
                 S *= 2
             S = min(S, self.cfg.max_seq_len)
-            if use_sp and S % self._sp:
-                # ring attention shards the padded length over sp — round
-                # the bucket up to a multiple of sp (non-power-of-two sp
-                # like 6 must not silently disable the path)
-                S = -(-S // self._sp) * self._sp
-            tokens = np.zeros((1, S), np.int32)
-            tokens[0, :ns_tail] = tail
+            groups.setdefault(S, []).append(item)
+        for S, items in groups.items():
+            count += self._prefill_group(S, items, chain_by_req)
+        return count, leftover
 
-            if prefix_len:
-                self.stats.prefix_cache_hits += 1
-                self.stats.prefix_tokens_reused += prefix_len
-            if eff_prefix:
-                next_tok, self.kv_cache = self._prefill_suffix_fn(
-                    self.params,
-                    self.lora_params,
-                    jnp.asarray(tokens),
-                    jnp.asarray([eff_prefix], jnp.int32),
-                    jnp.asarray([n], jnp.int32),
-                    self.kv_cache,
-                    jnp.asarray(pt[:, :bucket]),
-                    *sampling_args,
-                )
-            elif use_sp:
-                self.stats.sp_prefills += 1
-                next_tok, self.kv_cache = self._prefill_sp_fn(
-                    self.params,
-                    self.lora_params,
-                    jnp.asarray(tokens),
-                    jnp.asarray([n], jnp.int32),
-                    self.kv_cache,
-                    jnp.asarray(pt),
-                    *sampling_args,
-                )
-            else:
-                next_tok, self.kv_cache = self._prefill_fn(
-                    self.params,
-                    self.lora_params,
-                    jnp.asarray(tokens),
-                    jnp.asarray([n], jnp.int32),
-                    self.kv_cache,
-                    jnp.asarray(pt),
-                    *sampling_args,
-                )
+    def _prefill_group(self, S: int, items: list,
+                       chain_by_req: dict[int, list]) -> int:
+        """One [G2, S] prefill for a same-bucket group; G2 = G padded to
+        a power of two (compile-shape discipline: log2 batch shapes per
+        bucket, not one per group size). Padded rows have seq_len 0 —
+        their K/V scatters are dropped and their sampled token ignored."""
+        G = len(items)
+        G2 = 1
+        while G2 < G:
+            G2 *= 2
+        P = self.cfg.max_pages_per_seq
+        V = self.model_cfg.vocab_size
+        tokens = np.zeros((G2, S), np.int32)
+        seq_lens = np.zeros((G2,), np.int32)
+        pt = np.zeros((G2, P), np.int32)
+        keys = np.zeros((G2, 2), np.uint32)
+        temp = np.zeros((G2,), np.float32)
+        top_p = np.ones((G2,), np.float32)
+        top_k = np.zeros((G2,), np.int32)
+        bias = np.zeros((G2, V), np.float32)
+        adapter = np.full((G2,), self._base_row, np.int32)
+        t0 = time.monotonic()
+        for g, (req, seq_id, n, _total) in enumerate(items):
+            tokens[g, :n] = req.prompt
+            seq_lens[g] = n
+            pages = self.allocator.pages(seq_id)
+            pt[g, : len(pages)] = pages
+            req.id = seq_id
+            keys[g, 0] = np.uint32(
+                (req.sampling.seed or seq_id) & 0xFFFFFFFF)
+            temp[g] = req.sampling.temperature
+            top_p[g] = req.sampling.top_p
+            top_k[g] = req.sampling.top_k
+            for tok_id, b in req.sampling.logit_bias:
+                if 0 <= tok_id < V:
+                    bias[g, tok_id] = b
+            if req.adapter:
+                adapter[g] = self.adapter_rows[req.adapter]
+        next_tok, self.kv_cache = self._prefill_fn(
+            self.params, self.lora_params, jnp.asarray(tokens),
+            jnp.asarray(seq_lens), self.kv_cache, jnp.asarray(pt),
+            jnp.asarray(keys), jnp.asarray(temp), jnp.asarray(top_p),
+            jnp.asarray(top_k), jnp.asarray(bias), jnp.asarray(adapter))
+        lp_data = None
+        if self.cfg.logprobs_topk and isinstance(next_tok, tuple):
+            next_tok, chosen, tk_ids, tk_vals = next_tok
+            lp_data = (np.asarray(chosen), np.asarray(tk_ids),
+                       np.asarray(tk_vals))
+        toks = np.asarray(next_tok)
+        for g, (req, seq_id, n, total) in enumerate(items):
+            slot_idx = self._free_slot_index()
+            assert slot_idx is not None  # len(items) <= free slots
             first_lp = None
-            if self.cfg.logprobs_topk and isinstance(next_tok, tuple):
-                next_tok, chosen, tk_ids, tk_vals = next_tok
+            if lp_data is not None:
+                chosen, tk_ids, tk_vals = lp_data
                 first_lp = (
-                    float(np.asarray(chosen)[0]),
+                    float(chosen[g]),
                     [(int(t), float(v)) for t, v in zip(
-                        np.asarray(tk_ids)[0], np.asarray(tk_vals)[0])],
+                        tk_ids[g], tk_vals[g])],
                 )
-            tok = int(next_tok[0])
-            self.stats.prefills += 1
-            if self.prefix_cache is not None and chain_keys:
-                self.prefix_cache.insert(chain_keys, pages)
-            logger.debug("prefill seq=%d len=%d prefix=%d bucket=%d %.1fms",
-                         seq_id, n, prefix_len, S,
-                         1e3 * (time.monotonic() - t0))
-
-            # pos=n-1: _emit_token advances it to n, the write position of
-            # the just-sampled first token.
+            chain = chain_by_req.get(id(req), [])
+            if self.prefix_cache is not None and chain:
+                self.prefix_cache.insert(
+                    chain, self.allocator.pages(seq_id))
             self._slots[slot_idx] = _Slot(
                 req=req, pos=n - 1, generated=0,
                 key_seed=req.sampling.seed or seq_id,
-                limit=total, page_row=pt[0], adapter_row=adapter_row,
+                limit=total, page_row=pt[g], adapter_row=int(adapter[g]),
             )
-            self._emit_token(slot_idx, tok, first_lp)
-            self._state_dirty = True
-            admitted = True
-        return admitted
+            self.stats.prefills += 1
+            self._emit_token(slot_idx, int(toks[g]), first_lp)
+        self._state_dirty = True
+        logger.debug("batched prefill G=%d S=%d %.1fms", G, S,
+                     1e3 * (time.monotonic() - t0))
+        return len(items)
 
-    def _requeue_front(self, req: GenRequest) -> None:
+    def _admit_one(self, req: GenRequest, chain: list | None = None) -> str:
+        """Per-request admission (prefix-cache adoption, chunked and
+        sequence-parallel prefills, adapter errors). Returns "admitted",
+        "skipped" (request consumed without a slot), "stop" (page
+        pressure / engine stopping — the CALLER must requeue the request
+        and stop admitting), or "stop_consumed" (stop admitting; the
+        request needs no requeue). ``chain`` = prompt chain keys already
+        hashed by _classify (the probe below stays fresh — an earlier
+        admission this pass may have inserted or evicted pages)."""
+        slot_idx = self._free_slot_index()
+        if slot_idx is None:  # defensive: caller bounds by free slots
+            return "stop"
+        n = len(req.prompt)
+        total = min(n + req.max_tokens, self.cfg.max_seq_len)
+        seq_id = next(self._seq_ids)
+        ps = self.cfg.page_size
+
+        # prefix cache: adopt the longest cached page-prefix (capped so
+        # at least one suffix token remains to produce first logits)
+        cached_pages: list[int] = []
+        chain_keys: list = []
+        if self.prefix_cache is not None and n > 1:
+            chain_keys = (chain if chain is not None
+                          else self.prefix_cache.chain_keys(req.prompt))
+            hit_pages = self.prefix_cache.probe(chain_keys)
+            hits = min(len(hit_pages), (n - 1) // ps)
+            cached_pages = hit_pages[:hits]
+        prefix_len = len(cached_pages) * ps
+
+        try:
+            if cached_pages:
+                self.allocator.adopt(seq_id, cached_pages)
+                extra = self.allocator.pages_for(total) - len(cached_pages)
+                if extra > 0:
+                    self.allocator.allocate_extra(seq_id, extra)
+            else:
+                self.allocator.allocate(seq_id, total)
+        except OutOfPagesError:
+            self.allocator.free(seq_id)
+            # the caller puts it back (in arrival order) to wait for
+            # a slot to free pages
+            return "stop"
+        pages = self.allocator.pages(seq_id)
+        req.id = seq_id
+
+        suffix = req.prompt[prefix_len:]
+        ns = len(suffix)
+        use_sp = (
+            self._prefill_sp_fn is not None
+            and prefix_len == 0
+            and ns >= self.cfg.sp_prefill_min_tokens
+        )
+        pt = np.zeros((1, self.cfg.max_pages_per_seq), np.int32)
+        pt[0, : len(pages)] = pages
+
+        adapter_row = self._base_row
+        if req.adapter:
+            row = self.adapter_rows.get(req.adapter)
+            if row is None:
+                req.emit(-1, "error")
+                self.allocator.free(seq_id)
+                return "skipped"
+            adapter_row = row
+        key = np.array([[req.sampling.seed or seq_id, 0]], np.uint32)
+        bias_row = np.zeros((1, self.model_cfg.vocab_size), np.float32)
+        for tok_id, b in req.sampling.logit_bias:
+            if 0 <= tok_id < self.model_cfg.vocab_size:
+                bias_row[0, tok_id] = b
+        sampling_args = (
+            jnp.asarray(key),
+            jnp.asarray([req.sampling.temperature], jnp.float32),
+            jnp.asarray([req.sampling.top_p], jnp.float32),
+            jnp.asarray([req.sampling.top_k], jnp.int32),
+            jnp.asarray(bias_row),
+            jnp.asarray([adapter_row], jnp.int32),
+        )
+        t0 = time.monotonic()
+        # pow2 page bucket covering the sequence — the gather window
+        # of suffix/chunked steps, not the full max_seq_len window
+        need = self.allocator.pages_for(total)
+        bucket = 1
+        while bucket < need:
+            bucket *= 2
+        bucket = min(bucket, self.cfg.max_pages_per_seq)
+
+        # chunked prefill: long prompts run as fixed-size suffix
+        # steps so no giant bucket is ever compiled and a decode
+        # tick runs between chunks — active streams keep emitting
+        # behind a long prompt instead of stalling for its whole
+        # prefill (vLLM-style chunked prefill; the prefill_suffix
+        # kernel with prefix_lens=consumed IS the chunk step)
+        chunk = self.cfg.prefill_chunk_tokens
+        consumed = 0
+        if (chunk > 0 and not use_sp
+                and self.fns.prefill_suffix is not None
+                and ns > chunk):
+            # loop-invariant device uploads hoisted; each boundary
+            # is also a cancellation/shutdown yield point — exactly
+            # what chunking exists to provide
+            pt_dev = jnp.asarray(pt[:, :bucket])
+            ctokens = np.zeros((1, chunk), np.int32)
+            aborted = False
+            while ns - consumed > chunk:
+                if req.cancelled.is_set() or self._stop.is_set():
+                    aborted = True
+                    break
+                ctokens[0, :] = suffix[consumed:consumed + chunk]
+                _, self.kv_cache = self._prefill_suffix_fn(
+                    self.params,
+                    self.lora_params,
+                    jnp.asarray(ctokens),
+                    jnp.asarray([prefix_len + consumed], jnp.int32),
+                    jnp.asarray([prefix_len + consumed + chunk],
+                                jnp.int32),
+                    self.kv_cache,
+                    pt_dev,
+                    *sampling_args,
+                )
+                consumed += chunk
+                self.stats.chunked_prefill_steps += 1
+                self._decode_tick()
+            if aborted:
+                self.allocator.free(seq_id)
+                if self._stop.is_set():
+                    # graceful stop mid-prompt: hand it back like an
+                    # OutOfPages retry; the drain path settles it
+                    if not req.cancelled.is_set():
+                        return "stop"
+                    return "stop_consumed"
+                return "skipped"  # cancelled: next queued request
+
+        eff_prefix = prefix_len + consumed
+        tail = suffix[consumed:]
+        ns_tail = len(tail)
+        # bucketed padded length for the remaining tokens
+        S = self.cfg.min_prefill_bucket
+        while S < ns_tail:
+            S *= 2
+        S = min(S, self.cfg.max_seq_len)
+        if use_sp and S % self._sp:
+            # ring attention shards the padded length over sp — round
+            # the bucket up to a multiple of sp (non-power-of-two sp
+            # like 6 must not silently disable the path)
+            S = -(-S // self._sp) * self._sp
+        tokens = np.zeros((1, S), np.int32)
+        tokens[0, :ns_tail] = tail
+
+        if prefix_len:
+            self.stats.prefix_cache_hits += 1
+            self.stats.prefix_tokens_reused += prefix_len
+        if eff_prefix:
+            next_tok, self.kv_cache = self._prefill_suffix_fn(
+                self.params,
+                self.lora_params,
+                jnp.asarray(tokens),
+                jnp.asarray([eff_prefix], jnp.int32),
+                jnp.asarray([n], jnp.int32),
+                self.kv_cache,
+                jnp.asarray(pt[:, :bucket]),
+                *sampling_args,
+            )
+        elif use_sp:
+            self.stats.sp_prefills += 1
+            next_tok, self.kv_cache = self._prefill_sp_fn(
+                self.params,
+                self.lora_params,
+                jnp.asarray(tokens),
+                jnp.asarray([n], jnp.int32),
+                self.kv_cache,
+                jnp.asarray(pt),
+                *sampling_args,
+            )
+        else:
+            next_tok, self.kv_cache = self._prefill_fn(
+                self.params,
+                self.lora_params,
+                jnp.asarray(tokens),
+                jnp.asarray([n], jnp.int32),
+                self.kv_cache,
+                jnp.asarray(pt),
+                *sampling_args,
+            )
+        first_lp = None
+        if self.cfg.logprobs_topk and isinstance(next_tok, tuple):
+            next_tok, chosen, tk_ids, tk_vals = next_tok
+            first_lp = (
+                float(np.asarray(chosen)[0]),
+                [(int(t), float(v)) for t, v in zip(
+                    np.asarray(tk_ids)[0], np.asarray(tk_vals)[0])],
+            )
+        tok = int(next_tok[0])
+        self.stats.prefills += 1
+        if self.prefix_cache is not None and chain_keys:
+            self.prefix_cache.insert(chain_keys, pages)
+        logger.debug("prefill seq=%d len=%d prefix=%d bucket=%d %.1fms",
+                     seq_id, n, prefix_len, S,
+                     1e3 * (time.monotonic() - t0))
+
+        # pos=n-1: _emit_token advances it to n, the write position of
+        # the just-sampled first token.
+        self._slots[slot_idx] = _Slot(
+            req=req, pos=n - 1, generated=0,
+            key_seed=req.sampling.seed or seq_id,
+            limit=total, page_row=pt[0], adapter_row=adapter_row,
+        )
+        self._emit_token(slot_idx, tok, first_lp)
+        self._state_dirty = True
+        return "admitted"
+
+    def _requeue_front_many(self, reqs: list[GenRequest]) -> None:
         # queue.Queue has no push-front; use a tiny shim list
-        items = [req]
+        items = list(reqs)
+        if not items:
+            return
         try:
             while True:
                 items.append(self._queue.get_nowait())
@@ -999,6 +1223,30 @@ class Engine:
             self.stats.active_slots = 0
             self._refresh_stats()
             return False
+
+        if self._inflight is not None:
+            # Zombie-window guard: when every active slot reaches its
+            # token limit within the window already in flight, another
+            # dispatch would compute K junk steps against slots that are
+            # all about to finish — junk that delays the next admission
+            # by a full window (and burns K chip-steps per batch drain).
+            # Drain instead; the loop admits or re-dispatches right after.
+            # Conservative under speculation (slots may finish even
+            # sooner than +K; the guard then fires one window later).
+            K = self.cfg.decode_steps_per_tick
+            if all(
+                s is None
+                or (s.started
+                    and (s.generated + K >= s.req.max_tokens
+                         or s.pos + K >= min(s.limit, self.cfg.max_seq_len)))
+                for s in self._slots
+            ):
+                self._drain_inflight()
+                self._apply_frees()
+                self.stats.active_slots = sum(
+                    s is not None for s in self._slots)
+                self._refresh_stats()
+                return True
 
         sampled, self._device_state, self.kv_cache = self._decode_fn(
             self.params, self.lora_params, self.kv_cache, self._device_state

@@ -203,19 +203,18 @@ class PrefixCache:
             keys.append(prev)
         return keys
 
-    def lookup(
-        self, prompt: list[int]
-    ) -> tuple[int, list[int], list[bytes]]:
-        """Longest cached page-prefix → (n_pages, page ids, all chain
-        keys — reusable by insert() so the prompt is hashed once)."""
-        keys = self.chain_keys(prompt)
+    def probe(self, keys: list[bytes]) -> list[int]:
+        """Pages of the longest cached prefix for pre-hashed chain keys.
+        Probes are cheap and must be FRESH at adoption time (an earlier
+        admission in the same pass may have inserted or evicted pages);
+        the hashes themselves are content-derived and reusable."""
         pages: list[int] = []
         for key in keys:
             page = self._by_key.get(key)
             if page is None:
                 break
             pages.append(page)
-        return len(pages), pages, keys
+        return pages
 
     def insert(self, keys: list[bytes], page_row: list[int]) -> None:
         """Register fully-written prompt pages (keys from lookup())."""

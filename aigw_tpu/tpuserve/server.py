@@ -493,26 +493,26 @@ class TPUServeServer:
         n_out = 0
         finish = "stop"
 
-        async def write_piece(piece: str, lp_entry=None) -> None:
+        async def write_piece(piece: str, lp_entries=None) -> None:
             # an empty piece (mid-UTF-8 token) still carries its logprob
-            # entry so the streamed list aligns 1:1 with completion
+            # entries so the streamed list aligns 1:1 with completion
             # tokens; without logprobs, empty pieces emit nothing
-            if not piece and lp_entry is None:
+            if not piece and not lp_entries:
                 return
             if chat:
                 await resp.write(
                     oai.stream_chunk_sse(
                         response_id=rid, model=self.model_name,
                         created=created, delta={"content": piece},
-                        logprobs={"content": [lp_entry]}
-                        if lp_entry is not None else None,
+                        logprobs={"content": lp_entries}
+                        if lp_entries else None,
                     )
                 )
             else:
                 choice: dict[str, Any] = {"index": 0, "text": piece,
                                           "finish_reason": None}
-                if lp_entry is not None:
-                    choice["logprobs"] = self._legacy_logprobs([lp_entry])
+                if lp_entries:
+                    choice["logprobs"] = self._legacy_logprobs(lp_entries)
                 await resp.write(
                     SSEEvent(
                         data=json.dumps(
@@ -536,43 +536,65 @@ class TPUServeServer:
                         delta={"role": "assistant", "content": ""},
                     )
                 )
-            while True:
+            done_streaming = False
+            while not done_streaming:
                 # keepalive comments while queued behind prefills so
                 # intermediaries don't drop an apparently-idle stream
                 while True:
                     try:
-                        tok, fin, lp = await asyncio.wait_for(
+                        first = await asyncio.wait_for(
                             out.get(), timeout=10.0)
                         break
                     except asyncio.TimeoutError:
                         await resp.write(b": ping\n\n")
-                if tok >= 0:
-                    n_out += 1
-                    rm.record_tokens_emitted(1)
-                    piece = decoder.push(tok)
-                    lp_entry = (self._lp_entry(piece, lp, lp_top_n)
-                                if want_lp and lp is not None else None)
-                    if piece:
-                        emitted += piece
-                        hit = _find_stop(emitted, stop_strs)
-                        if hit is not None:
-                            # trim to just before the stop sequence; the
-                            # truncated final token keeps its lp entry
-                            # (1:1 token/entry alignment)
-                            keep = hit - (len(emitted) - len(piece))
-                            await write_piece(piece[:max(keep, 0)],
-                                              lp_entry)
-                            finish = "stop"
-                            gen_req.cancelled.set()
-                            break
-                        await write_piece(piece, lp_entry)
-                    elif lp_entry is not None:
-                        await write_piece("", lp_entry)
-                if fin is not None:
-                    finish = fin
-                    if fin != "error":
-                        await write_piece(decoder.flush())
-                    break
+                # Coalesce the burst: a decode window lands K tokens per
+                # slot on the queue at once; one SSE frame per burst
+                # instead of one per token cuts event-loop wakeups,
+                # json dumps, and syscalls ~K× in the serving hot loop
+                # (OpenAI deltas are arbitrary strings; logprob entries
+                # stay 1:1 with tokens inside the frame's content list).
+                burst = [first]
+                while True:
+                    try:
+                        burst.append(out.get_nowait())
+                    except asyncio.QueueEmpty:
+                        break
+                pieces: list[str] = []
+                lp_entries: list[dict[str, Any]] = []
+                for tok, fin, lp in burst:
+                    if tok >= 0:
+                        n_out += 1
+                        rm.record_tokens_emitted(1)
+                        piece = decoder.push(tok)
+                        lp_entry = (self._lp_entry(piece, lp, lp_top_n)
+                                    if want_lp and lp is not None else None)
+                        if piece:
+                            emitted += piece
+                            hit = _find_stop(emitted, stop_strs)
+                            if hit is not None:
+                                # trim to just before the stop sequence;
+                                # the truncated final token keeps its lp
+                                # entry (1:1 token/entry alignment)
+                                keep = hit - (len(emitted) - len(piece))
+                                pieces.append(piece[:max(keep, 0)])
+                                if lp_entry is not None:
+                                    lp_entries.append(lp_entry)
+                                finish = "stop"
+                                gen_req.cancelled.set()
+                                done_streaming = True
+                                break
+                            pieces.append(piece)
+                            if lp_entry is not None:
+                                lp_entries.append(lp_entry)
+                        elif lp_entry is not None:
+                            lp_entries.append(lp_entry)
+                    if fin is not None:
+                        finish = fin
+                        if fin != "error":
+                            pieces.append(decoder.flush())
+                        done_streaming = True
+                        break
+                await write_piece("".join(pieces), lp_entries)
         except (asyncio.CancelledError, ConnectionResetError):
             # client went away: stop generating, free the slot
             gen_req.cancelled.set()

@@ -73,7 +73,8 @@ K_STEPS = 16  # matches EngineConfig.decode_steps_per_tick below
 
 
 def raw_ceiling_tokens_per_sec(params, cfg, batch=BATCH,
-                               prompt_len=PROMPT_LEN) -> float:
+                               prompt_len=PROMPT_LEN,
+                               k_steps=K_STEPS) -> float:
     """The ceiling: K decode steps scanned inside one jit — bare model
     math + sampling with dispatch fully amortized; no scheduler, no
     paging bookkeeping, no HTTP."""
@@ -104,7 +105,7 @@ def raw_ceiling_tokens_per_sec(params, cfg, batch=BATCH,
             return (nxt, positions + 1, kv), nxt
 
         (tokens, positions, kv), _ = lax.scan(
-            body, (tokens, positions, kv), None, length=K_STEPS
+            body, (tokens, positions, kv), None, length=k_steps
         )
         return tokens, positions, kv
 
@@ -114,7 +115,7 @@ def raw_ceiling_tokens_per_sec(params, cfg, batch=BATCH,
 
     tokens, positions, kv = kstep(params, tokens, positions, kv)  # compile
     jax.block_until_ready(tokens)
-    n_ticks = max(1, 64 // K_STEPS)
+    n_ticks = max(1, 64 // k_steps)
     best = 0.0
     for _ in range(2):  # two trials, keep the best (tunnel jitter)
         t0 = time.perf_counter()
@@ -122,20 +123,23 @@ def raw_ceiling_tokens_per_sec(params, cfg, batch=BATCH,
             tokens, positions, kv = kstep(params, tokens, positions, kv)
         jax.block_until_ready(tokens)
         dt = time.perf_counter() - t0
-        best = max(best, batch * K_STEPS * n_ticks / dt)
+        best = max(best, batch * k_steps * n_ticks / dt)
     return best
 
 
 def engine_numbers(params, cfg, batch=BATCH, prompt_len=PROMPT_LEN,
-                   gen_tokens=GEN_TOKENS) -> tuple[float, float]:
+                   gen_tokens=GEN_TOKENS, k_steps=K_STEPS,
+                   reps=1) -> list[tuple[float, float]]:
     """The engine row: same decode through the continuous-batching engine
-    (no HTTP). Returns (tokens/sec, ttft_ms p50 over the batch)."""
+    (no HTTP). Returns ``reps`` measurements of (tokens/sec, ttft_ms p50
+    over the batch) — callers take the median (r4 verdict: a single rep's
+    variance on a loaded 1-core host swamps the quantity reported)."""
     eng = Engine(
         params,
         cfg,
         EngineConfig(max_batch_size=batch,
                      max_seq_len=cfg.max_seq_len, page_size=PAGE,
-                     decode_steps_per_tick=K_STEPS),
+                     decode_steps_per_tick=k_steps),
     )
     eng.start()
     try:
@@ -149,32 +153,38 @@ def engine_numbers(params, cfg, batch=BATCH, prompt_len=PROMPT_LEN,
         ))
         done.wait(timeout=600)
 
-        dones = [threading.Event() for _ in range(batch)]
-        counts = [0] * batch
-        first_at = [0.0] * batch
+        out: list[tuple[float, float]] = []
+        for rep in range(reps):
+            dones = [threading.Event() for _ in range(batch)]
+            counts = [0] * batch
+            first_at = [0.0] * batch
 
-        def mk(i):
-            def emit(tok, fin):
-                if tok >= 0:
-                    if counts[i] == 0:
-                        first_at[i] = time.perf_counter()
-                    counts[i] += 1
-                if fin is not None:
-                    dones[i].set()
-            return emit
+            def mk(i):
+                def emit(tok, fin):
+                    if tok >= 0:
+                        if counts[i] == 0:
+                            first_at[i] = time.perf_counter()
+                        counts[i] += 1
+                    if fin is not None:
+                        dones[i].set()
+                return emit
 
-        t0 = time.perf_counter()
-        for i in range(batch):
-            eng.submit(GenRequest(
-                prompt=[1 + i] * prompt_len, max_tokens=gen_tokens,
-                sampling=SamplingParams(temperature=0.0), emit=mk(i),
-            ))
-        for d in dones:
-            d.wait(timeout=600)
-        dt = time.perf_counter() - t0
-        ttfts = sorted((f - t0) * 1000.0 for f in first_at if f > 0)
-        ttft_p50 = ttfts[len(ttfts) // 2] if ttfts else -1.0
-        return sum(counts) / dt, ttft_p50
+            t0 = time.perf_counter()
+            for i in range(batch):
+                # distinct prompts per rep: the refcounted prefix cache
+                # must not let rep N reuse rep N-1's prefill pages
+                eng.submit(GenRequest(
+                    prompt=[1 + i + rep * batch] * prompt_len,
+                    max_tokens=gen_tokens,
+                    sampling=SamplingParams(temperature=0.0), emit=mk(i),
+                ))
+            for d in dones:
+                d.wait(timeout=600)
+            dt = time.perf_counter() - t0
+            ttfts = sorted((f - t0) * 1000.0 for f in first_at if f > 0)
+            ttft_p50 = ttfts[len(ttfts) // 2] if ttfts else -1.0
+            out.append((sum(counts) / dt, ttft_p50))
+        return out
     finally:
         eng.stop()
 
@@ -187,7 +197,74 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _start_tpuserve(model_name: str, cfg, quantize: str, batch: int):
+def _start_tpuserve_subproc(model_name: str, cfg, quantize: str,
+                            batch: int, k_steps: int):
+    """Serve `model_name` over the real tpuserve HTTP surface in its own
+    process (benchmarks/serve_child.py) — the deployment topology. The
+    in-thread variant below shares the bench client's GIL, which on a
+    1-core host turns the serve legs into a GIL-convoy measurement
+    (spread 27-36% in r4/r5). Returns (base_url, stop_fn).
+
+    CPU-leg only: the child env pins JAX_PLATFORMS=cpu, so wiring this
+    into the live-TPU suite would silently serve from CPU while the
+    raw/engine legs run on chip — the assert keeps that impossible."""
+    assert jax.default_backend() == "cpu", \
+        "subproc serve leg is pinned to the CPU backend"
+    spec = {
+        "model": model_name,
+        "cfg": {k: getattr(cfg, k) for k in (
+            "vocab_size", "dim", "n_layers", "n_heads", "n_kv_heads",
+            "ffn_dim", "max_seq_len", "rope_theta")},
+        "batch": batch, "page": PAGE, "k": k_steps, "quantize": quantize,
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(here, "benchmarks", "serve_child.py"),
+         json.dumps(spec)],
+        cwd=here, stdout=subprocess.PIPE, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    import select
+
+    port = None
+    deadline = time.time() + 1200
+    buf = ""
+    fd = proc.stdout.fileno()
+    os.set_blocking(fd, False)
+    while time.time() < deadline:
+        # select-based read: a wedged-but-alive child must trip the
+        # deadline, not block readline() forever while holding the lock
+        if proc.poll() is not None:
+            raise RuntimeError("tpuserve child exited before listening")
+        r, _, _ = select.select([fd], [], [], 5.0)
+        if not r:
+            continue
+        buf += os.read(fd, 4096).decode(errors="replace")
+        *complete, buf = buf.split("\n")  # parse full lines only — a
+        # read boundary can split SERVE_PORT=12345 into a valid-looking
+        # truncated number
+        for line in complete:
+            if line.startswith("SERVE_PORT="):
+                port = int(line.split("=", 1)[1])
+                break
+        if port is not None:
+            break
+    if port is None:
+        proc.kill()
+        raise RuntimeError("tpuserve child never reported a port")
+
+    def stop():
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+    return f"http://127.0.0.1:{port}", stop
+
+
+def _start_tpuserve(model_name: str, cfg, quantize: str, batch: int,
+                    k_steps: int = K_STEPS):
     """Serve `model_name` (registered on the fly, random weights) over
     the real tpuserve HTTP surface in a background thread. Returns
     (base_url, stop_fn)."""
@@ -213,7 +290,7 @@ def _start_tpuserve(model_name: str, cfg, quantize: str, batch: int):
                 model=model_name,
                 engine_cfg=EngineConfig(
                     max_batch_size=batch, max_seq_len=cfg.max_seq_len,
-                    page_size=PAGE, decode_steps_per_tick=K_STEPS,
+                    page_size=PAGE, decode_steps_per_tick=k_steps,
                 ),
                 quantize=quantize,
             )
@@ -312,6 +389,16 @@ async def _drive_stream(url: str, model: str, batch: int, prompt_len: int,
             "temperature": 0.0,
             "stream": True,
             "stream_options": {"include_usage": True},
+            # Pin every sampled token to a visible ASCII byte ('a'): with
+            # random weights, greedy output is mostly UTF-8 continuation
+            # bytes that the windowed StreamingDecoder emits as EMPTY
+            # pieces — no SSE chunk on the wire — so "first content
+            # delta" TTFT was measured over the lottery subset of
+            # requests that happened to produce visible text (the r4
+            # "988ms gateway TTFT penalty" was this artifact, not the
+            # gateway). The bias rides the real sampling path (engine
+            # bias_row), so the measured pipeline is unchanged.
+            "logit_bias": {"97": 100},
         }
         first = None
         usage = None
@@ -354,14 +441,39 @@ async def _drive_stream(url: str, model: str, batch: int, prompt_len: int,
     return sum(totals) / wall, p50
 
 
+def _median(xs: list[float]) -> float:
+    s = sorted(xs)
+    return s[len(s) // 2] if s else 0.0
+
+
+def _spread(xs: list[float]) -> float:
+    """(max - min) / median — the r4 verdict's harness-stability gauge.
+    With ≥5 reps the extremes are trimmed first: on a 1-core host a
+    single background event (tunnel probe, log flush) poisons one rep,
+    and the question is whether the *typical* reps agree."""
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    if len(xs) >= 5:
+        xs = xs[1:-1]
+    m = _median(xs)
+    return (xs[-1] - xs[0]) / m if m else 0.0
+
+
 def gateway_numbers(model_name: str, cfg, quantize: str, batch=BATCH,
-                    prompt_len=PROMPT_LEN, gen_tokens=GEN_TOKENS) -> dict:
+                    prompt_len=PROMPT_LEN, gen_tokens=GEN_TOKENS,
+                    k_steps=K_STEPS, reps=3, subproc=False) -> dict:
     """The north-star numerator: tokens/sec and TTFT through
     `aigw run` → tpuserve → engine over streaming /v1/chat/completions,
     plus the same load sent directly to tpuserve (isolates gateway
-    overhead from HTTP-serving overhead)."""
-    serve_url, stop_serve = _start_tpuserve(model_name, cfg, quantize,
-                                            batch)
+    overhead from HTTP-serving overhead). ``reps`` interleaved
+    direct/gateway trials; medians + spread (r4 verdict: best-of-2 on a
+    loaded host reported noise as signal). ``subproc`` runs tpuserve as
+    its own process (the deployment topology; used by the CPU leg where
+    GIL sharing corrupted the measurement)."""
+    start = _start_tpuserve_subproc if subproc else _start_tpuserve
+    serve_url, stop_serve = start(model_name, cfg, quantize,
+                                  batch, k_steps)
     gw_url, proc, cfg_path = _start_gateway(serve_url)
 
     async def run() -> dict:
@@ -372,25 +484,27 @@ def gateway_numbers(model_name: str, cfg, quantize: str, batch=BATCH,
                             tag="w")
         await _drive_stream(gw_url, model_name, batch, prompt_len, 4,
                             tag="x")
-        # alternate the legs and keep each one's best: a single
-        # direct-then-gateway ordering consistently flattered whichever
-        # leg ran second (server-side caches/CPU clocks keep warming),
-        # inverting the overhead comparison on CPU
-        d_tps = d_ttft = g_tps = g_ttft = 0.0
-        for trial in range(2):
+        # interleave the legs so slow drift (CPU clocks, cache warmth)
+        # cancels instead of flattering whichever leg runs later
+        d_tps, d_ttft, g_tps, g_ttft = [], [], [], []
+        for trial in range(reps):
             dt, dt_ttft = await _drive_stream(
                 serve_url, model_name, batch, prompt_len, gen_tokens,
                 tag=f"d{trial}")
             gt, gt_ttft = await _drive_stream(
                 gw_url, model_name, batch, prompt_len, gen_tokens,
                 tag=f"g{trial}")
-            if dt > d_tps:
-                d_tps, d_ttft = dt, dt_ttft
-            if gt > g_tps:
-                g_tps, g_ttft = gt, gt_ttft
+            d_tps.append(dt)
+            d_ttft.append(dt_ttft)
+            g_tps.append(gt)
+            g_ttft.append(gt_ttft)
         return {
-            "gateway_tps": g_tps, "gateway_ttft_ms_p50": g_ttft,
-            "direct_tps": d_tps, "direct_ttft_ms_p50": d_ttft,
+            "gateway_tps": _median(g_tps),
+            "gateway_ttft_ms_p50": _median(g_ttft),
+            "direct_tps": _median(d_tps),
+            "direct_ttft_ms_p50": _median(d_ttft),
+            "gateway_tps_spread": round(_spread(g_tps), 3),
+            "direct_tps_spread": round(_spread(d_tps), 3),
         }
 
     try:
@@ -448,26 +562,33 @@ def _build_fallback():
 
 
 def _suite(params_holder, cfg, desc, model_name, quantize, batch,
-           prompt_len, gen_tokens, label) -> dict:
+           prompt_len, gen_tokens, label, k_steps=K_STEPS,
+           reps=3, subproc=False) -> dict:
     """``params_holder`` is a one-element list so THIS frame owns the
     only reference — the caller must del its own binding. The weights
     are freed before the gateway leg's server builds its own copy (the
     8B model fits the chip once, not twice)."""
     params = params_holder.pop()
-    raw = raw_ceiling_tokens_per_sec(params, cfg, batch, prompt_len)
-    engine, engine_ttft = engine_numbers(params, cfg, batch, prompt_len,
-                                         gen_tokens)
+    raw = raw_ceiling_tokens_per_sec(params, cfg, batch, prompt_len,
+                                     k_steps)
+    engine_runs = engine_numbers(params, cfg, batch, prompt_len,
+                                 gen_tokens, k_steps, reps=reps)
+    engine = _median([r[0] for r in engine_runs])
+    engine_ttft = _median([r[1] for r in engine_runs])
+    engine_spread = _spread([r[0] for r in engine_runs])
     del params
     gc.collect()
     gw = gateway_numbers(model_name, cfg, quantize, batch, prompt_len,
-                         gen_tokens)
+                         gen_tokens, k_steps, reps=reps, subproc=subproc)
+    spreads = (engine_spread, gw["direct_tps_spread"],
+               gw["gateway_tps_spread"])
     return {
         "metric": (
             f"{label}gateway tokens/sec through `aigw run` → tpuserve "
             f"streaming /v1/chat/completions, {desc}, batch={batch}, "
             f"prompt={prompt_len}, paged KV; vs_baseline = gateway / "
             f"raw-JAX-K-step-scan ceiling (north star: ≥0.9 and "
-            f"ttft_ms_p50 < 200)"
+            f"ttft_ms_p50 < 200); medians of {reps} interleaved reps"
         ),
         "value": round(gw["gateway_tps"], 1),
         "unit": "tokens/s",
@@ -479,6 +600,15 @@ def _suite(params_holder, cfg, desc, model_name, quantize, batch,
         "engine_ttft_ms_p50": round(engine_ttft, 1),
         "serve_direct_tokens_per_sec": round(gw["direct_tps"], 1),
         "serve_direct_ttft_ms_p50": round(gw["direct_ttft_ms_p50"], 1),
+        "gateway_ttft_minus_direct_ms": round(
+            gw["gateway_ttft_ms_p50"] - gw["direct_ttft_ms_p50"], 1),
+        "engine_tps_spread": round(engine_spread, 3),
+        "direct_tps_spread": gw["direct_tps_spread"],
+        "gateway_tps_spread": gw["gateway_tps_spread"],
+        # the capture is trustworthy when every leg's reps agree within
+        # 15% (r4 verdict: the engine leg once measured 44% below the
+        # HTTP leg — pure harness variance committed as signal)
+        "harness_stable": all(s <= 0.15 for s in spreads),
     }
 
 
@@ -499,7 +629,10 @@ def run_live() -> dict:
 def run_cpu_ratio() -> dict:
     """Chip-independent north-star *ratio* on the CPU backend (honest
     fallback when the tunnel is down all round): same harness, small
-    model, absolute tok/s NOT comparable to TPU numbers."""
+    model, absolute tok/s NOT comparable to TPU numbers. K=4 instead of
+    the tunnel-tuned 16: on a 1-core host a 16-step window is >1s, and
+    TTFT becomes a lottery over which requests wait out an in-flight
+    window — the quantity measured stops being the gateway."""
     params = llama.init_params(jax.random.PRNGKey(0), CPU_CFG)
     jax.block_until_ready(params)
     holder = [params]
@@ -509,6 +642,7 @@ def run_cpu_ratio() -> dict:
         batch=BATCH, prompt_len=64, gen_tokens=64,
         label="CPU BACKEND (TPU tunnel down; ratio is the signal, "
               "absolute tok/s is not): ",
+        k_steps=4, subproc=True, reps=5,
     )
     res["backend"] = jax.default_backend()
     return res
@@ -537,12 +671,45 @@ def _cpu_ratio_via_subprocess() -> dict | None:
     return None
 
 
+def _bench_lock():
+    """One bench at a time: the oppo.sh capture loop and the driver's
+    end-of-round run must not overlap on a 1-core host (two concurrent
+    suites measure each other). Tries for 15 min, then proceeds with a
+    warning rather than deadlocking the driver."""
+    import fcntl
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    f = open(os.path.join(here, "benchmarks", ".bench.lock"), "w")
+    deadline = time.time() + 900
+    while True:
+        try:
+            fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            return f
+        except OSError:
+            if time.time() > deadline:
+                print("bench lock busy for 15min — proceeding anyway",
+                      file=sys.stderr)
+                return f
+            time.sleep(5)
+
+
 def main() -> None:
     from benchmarks import persist
 
+    # the --cpu-gateway-ratio leg runs as a child of a lock-holding
+    # bench.py (or directly in a dev loop) — locking there would deadlock
+    lock = None  # held for process lifetime  # noqa: F841
+    if "--cpu-gateway-ratio" not in sys.argv:
+        lock = _bench_lock()
+
     if "--cpu-gateway-ratio" in sys.argv:
         result = run_cpu_ratio()
-        persist.save("gateway_cpu", result)
+        if not result.get("harness_stable", True):
+            # one retry: a transient load spike (test suite, compile)
+            # shouldn't burn the round's persisted capture
+            result = run_cpu_ratio()
+        if result.get("harness_stable", True):
+            persist.save("gateway_cpu", result)
         print(json.dumps(result))
         return
 

@@ -12,7 +12,10 @@ set -u
 cd "$(dirname "$0")/.."
 
 probe() {
-    timeout 120 python - <<'EOF' >/dev/null 2>&1
+    # nice -19: on a 1-core host an un-niced probe (jax import + tunnel
+    # dial, up to 120s) lands mid-trial in any concurrently running
+    # bench and corrupts its spread
+    timeout 120 nice -n 19 python - <<'EOF' >/dev/null 2>&1
 import jax.numpy as jnp
 (jnp.ones((256, 256), jnp.bfloat16) @ jnp.ones((256, 256), jnp.bfloat16)).block_until_ready()
 EOF

@@ -1,0 +1,123 @@
+"""tpuserve child process for the bench harness.
+
+The CPU gateway-ratio leg originally ran tpuserve as a *thread* of the
+bench process; on a 1-core host the client loop, server loop, and engine
+thread then convoy on one GIL and the serve legs' spread hit 27-36%
+(r4/r5 instability). Running tpuserve as its own process — exactly how
+it deploys — gives the OS scheduler, not the GIL, the arbitration job.
+
+Takes one argv: a JSON object {model, cfg, batch, page, k, quantize}.
+Prints ``SERVE_PORT=<port>`` once listening, serves until killed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _install_trace(trace_path: str) -> None:
+    """AIGW_TTFT_TRACE: append (event, t, id) lines for handler arrival,
+    engine submit, and first engine emit — TTFT localization only."""
+    import time
+
+    from aigw_tpu.tpuserve.engine import Engine
+
+    f = open(trace_path, "a", buffering=1)
+
+    def log(ev: str, tag: object) -> None:
+        f.write(json.dumps({"ev": ev, "t": time.time(), "tag": tag}) + "\n")
+
+    orig_submit = Engine.submit
+
+    def submit(self, req):
+        tag = req.prompt[:2]
+        log("submit", tag)
+        seen = [False]
+        orig_emit, orig_emit_lp = req.emit, req.emit_lp
+
+        def emit(tok, fin):
+            if not seen[0] and tok >= 0:
+                seen[0] = True
+                log("first_emit", tag)
+            return orig_emit(tok, fin)
+
+        req.emit = emit
+        if orig_emit_lp is not None:
+            def emit_lp(tok, fin, c, t):
+                if not seen[0] and tok >= 0:
+                    seen[0] = True
+                    log("first_emit", tag)
+                return orig_emit_lp(tok, fin, c, t)
+            req.emit_lp = emit_lp
+        return orig_submit(self, req)
+
+    Engine.submit = submit
+
+    from aiohttp import web
+
+    from aigw_tpu.tpuserve import server as srv
+
+    orig_init = srv.TPUServeServer.__init__
+
+    def init(self, *a, **kw):
+        orig_init(self, *a, **kw)
+
+        @web.middleware
+        async def arrival_mw(request, handler):
+            log("arrive", request.path)
+            return await handler(request)
+
+        self.app.middlewares.append(arrival_mw)
+
+    srv.TPUServeServer.__init__ = init
+
+
+def main() -> None:
+    from aiohttp import web
+
+    from aigw_tpu.models import llama
+    from aigw_tpu.models.registry import ModelSpec, register_model
+    from aigw_tpu.tpuserve.engine import EngineConfig
+    from aigw_tpu.tpuserve.server import TPUServeServer
+
+    if os.environ.get("AIGW_TTFT_TRACE"):
+        _install_trace(os.environ["AIGW_TTFT_TRACE"])
+
+    spec = json.loads(sys.argv[1])
+    cfg = llama.LlamaConfig(**spec["cfg"])
+    register_model(ModelSpec(spec["model"], "llama", cfg))
+
+    async def run() -> None:
+        server = TPUServeServer(
+            model=spec["model"],
+            engine_cfg=EngineConfig(
+                max_batch_size=spec["batch"],
+                max_seq_len=cfg.max_seq_len,
+                page_size=spec["page"],
+                decode_steps_per_tick=spec["k"],
+            ),
+            quantize=spec.get("quantize", ""),
+        )
+        runner = web.AppRunner(server.app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        print(f"SERVE_PORT={port}", flush=True)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -573,6 +573,171 @@ def test_stop_finishes_pending_requests():
     assert fins and fins[0] in ("error", "length", "stop")
 
 
+def test_batched_prefill_matches_sequential():
+    """A burst of simple prompts admits through ONE batched prefill
+    (r5: [G, S] device call instead of a G-step prefill ladder). The
+    batched path must be invisible in outputs: each request's tokens
+    equal its solo run, across different prompt lengths (two padded
+    buckets → two groups) and sampling configs."""
+    cfg = EngineConfig(max_batch_size=4, max_seq_len=256, page_size=16,
+                       min_prefill_bucket=16, decode_steps_per_tick=4)
+    params = llama.init_params(jax.random.PRNGKey(0), llama.TINY)
+    eng = Engine(params, llama.TINY, cfg, eos_token_ids=(257,))
+    eng.start()
+    try:
+        prompts = [
+            ([11, 12, 13], dict(temperature=0.0)),
+            ([21, 22, 23, 24, 25], dict(temperature=0.0)),
+            ([31] * 20, dict(temperature=0.0)),  # second bucket (32)
+            ([41, 42], dict(temperature=0.9, seed=7)),
+        ]
+        solos = [collect(eng, p, max_tokens=6, **sp) for p, sp in prompts]
+
+        results: dict[int, list[int]] = {i: [] for i in range(len(prompts))}
+        dones = [threading.Event() for _ in prompts]
+
+        def mk(i):
+            def emit(tok, fin):
+                if tok >= 0:
+                    results[i].append(tok)
+                if fin is not None:
+                    dones[i].set()
+            return emit
+
+        before = eng.stats.prefills
+        for i, (p, sp) in enumerate(prompts):
+            eng.submit(GenRequest(prompt=p, max_tokens=6,
+                                  sampling=SamplingParams(**sp),
+                                  emit=mk(i)))
+        assert all(d.wait(timeout=120) for d in dones)
+        assert eng.stats.prefills == before + len(prompts)
+        for i, (toks, _fin) in enumerate(solos):
+            assert results[i] == toks, f"request {i} diverged"
+    finally:
+        eng.stop()
+
+
+def test_page_pressure_mid_batch_requeues_everything():
+    """When the batched-prefill allocation hits page pressure, every
+    request already popped from the queue — the unallocated simple tail
+    AND the non-simple ones headed for the per-request path — must be
+    requeued, not dropped (r5 review finding: the non-simple `rest` was
+    silently lost, hanging its client forever)."""
+    cfg = EngineConfig(max_batch_size=4, max_seq_len=64, page_size=16,
+                       num_pages=4, min_prefill_bucket=16,
+                       decode_steps_per_tick=4, prefill_chunk_tokens=8,
+                       enable_prefix_cache=False)
+    params = llama.init_params(jax.random.PRNGKey(0), llama.TINY)
+    eng = Engine(params, llama.TINY, cfg)
+    eng.start()
+    try:
+        dones = [threading.Event() for _ in range(3)]
+
+        def mk(i):
+            def emit(tok, fin):
+                if fin is not None:
+                    dones[i].set()
+            return emit
+
+        # A: simple, 3 pages; B: simple, 3 pages (fails after A on the
+        # 4-page pool); C: chunked (prompt > prefill_chunk_tokens)
+        eng.submit(GenRequest(prompt=[1] * 4, max_tokens=40,
+                              sampling=SamplingParams(temperature=0.0),
+                              emit=mk(0)))
+        eng.submit(GenRequest(prompt=[2] * 4, max_tokens=40,
+                              sampling=SamplingParams(temperature=0.0),
+                              emit=mk(1)))
+        eng.submit(GenRequest(prompt=[3] * 12, max_tokens=8,
+                              sampling=SamplingParams(temperature=0.0),
+                              emit=mk(2)))
+        for i, d in enumerate(dones):
+            assert d.wait(timeout=120), f"request {i} never finished"
+    finally:
+        eng.stop()
+
+
+def test_same_burst_shared_prefix_adopts_not_duplicates():
+    """Two same-prompt requests arriving in one burst must still share
+    prompt pages: the second is routed through the per-request path and
+    adopts the pages the batched prefill inserts in the same admission
+    pass (r5 review finding: batching all of them would prefill the
+    shared prefix redundantly with per-request page copies)."""
+    cfg = EngineConfig(max_batch_size=4, max_seq_len=256, page_size=16,
+                       min_prefill_bucket=16, decode_steps_per_tick=4)
+    params = llama.init_params(jax.random.PRNGKey(0), llama.TINY)
+    eng = Engine(params, llama.TINY, cfg, eos_token_ids=(257,))
+    eng.start()
+    try:
+        shared = list(range(10, 50))  # 40 tokens = 2 full pages; fresh
+        hits_before = eng.stats.prefix_cache_hits
+
+        results: dict[int, list[int]] = {0: [], 1: [], 2: []}
+        dones = [threading.Event() for _ in range(3)]
+
+        def mk(i):
+            def emit(tok, fin):
+                if tok >= 0:
+                    results[i].append(tok)
+                if fin is not None:
+                    dones[i].set()
+            return emit
+
+        prompts = [shared, [7] * 8, shared]
+        for i, p in enumerate(prompts):
+            eng.submit(GenRequest(prompt=p, max_tokens=5,
+                                  sampling=SamplingParams(temperature=0.0),
+                                  emit=mk(i)))
+        assert all(d.wait(timeout=120) for d in dones)
+        # the duplicate adopted the pages its batch-mate inserted in the
+        # SAME admission pass, rather than re-prefilling its own copies
+        assert eng.stats.prefix_cache_hits > hits_before
+        assert results[0] == results[2]
+        solo, _ = collect(eng, shared, max_tokens=5, temperature=0.0)
+        assert results[0] == solo
+    finally:
+        eng.stop()
+
+
+def test_no_zombie_window_after_batch_finishes():
+    """When every active slot reaches its token limit within the
+    in-flight decode window, the engine must not dispatch another
+    window: the extra window is K junk steps that delay the next
+    admission by a full window (r5 TTFT fix). max_tokens=9 with K=4
+    needs exactly 2 windows after the prefill token — the old pipeline
+    dispatched (and later drained) a third."""
+    cfg = EngineConfig(max_batch_size=2, max_seq_len=128, page_size=16,
+                       min_prefill_bucket=16, decode_steps_per_tick=4)
+    params = llama.init_params(jax.random.PRNGKey(0), llama.TINY)
+    eng = Engine(params, llama.TINY, cfg)
+    eng.start()
+    try:
+        done = threading.Event()
+        fins = []
+
+        def emit(tok, fin):
+            if fin is not None:
+                fins.append(fin)
+                done.set()
+
+        eng.submit(GenRequest(prompt=[3, 1, 4], max_tokens=9,
+                              sampling=SamplingParams(temperature=0.0),
+                              emit=emit))
+        assert done.wait(timeout=120)
+        # let the loop settle (any zombie window would be drained and
+        # counted here)
+        deadline = time.time() + 10
+        while eng.stats.active_slots and time.time() < deadline:
+            time.sleep(0.05)
+        time.sleep(0.5)
+        # 9 tokens = 1 (prefill) + 8 decode = exactly 2 windows of 4;
+        # a third (zombie) window would show up as 12
+        assert eng.stats.decode_steps <= 8, eng.stats.decode_steps
+        if fins and fins[0] == "length":
+            assert eng.stats.decode_steps == 8
+    finally:
+        eng.stop()
+
+
 def test_queue_overload_raises():
     from aigw_tpu.tpuserve.engine import EngineOverloadedError
 
