@@ -326,3 +326,30 @@ class TestAdmissionCoverage:
             1 for kind in CLASSIFICATION
             for v in CLASSIFICATION[kind].values() if v == "implemented")
         assert implemented >= 35
+
+
+class TestShippedCRDsMatchReference:
+    """The kube mode is only compatible if the CRDs we SHIP (r5:
+    charts/aigw-tpu-crds, so a fresh cluster bootstraps from this repo
+    alone) are schema-identical to the reference's. Compared as parsed
+    YAML — the shipped copies carry a provenance header comment, which
+    must be the ONLY difference."""
+
+    SHIPPED = os.path.join(os.path.dirname(__file__), "..", "charts",
+                           "aigw-tpu-crds", "templates")
+
+    def test_same_file_set(self):
+        ref = {os.path.basename(p)
+               for p in glob.glob(os.path.join(CRD_DIR, "*.yaml"))}
+        shipped = {os.path.basename(p)
+                   for p in glob.glob(os.path.join(self.SHIPPED, "*.yaml"))}
+        assert shipped == ref
+
+    def test_schemas_identical(self):
+        for path in glob.glob(os.path.join(self.SHIPPED, "*.yaml")):
+            name = os.path.basename(path)
+            with open(path) as f:
+                ours = yaml.safe_load(f)
+            with open(os.path.join(CRD_DIR, name)) as f:
+                theirs = yaml.safe_load(f)
+            assert ours == theirs, f"{name} drifted from the reference"
