@@ -61,6 +61,7 @@ from aigw_tpu.obs.tracing import (
 from aigw_tpu.schemas import anthropic as anth
 from aigw_tpu.schemas import openai as oai
 from aigw_tpu.schemas import typed as typed_schemas
+from aigw_tpu.schemas import typed_response
 from aigw_tpu.translate import Endpoint, TranslationError, get_translator
 
 logger = logging.getLogger(__name__)
@@ -885,6 +886,42 @@ class GatewayServer:
                     translator.response_body, raw, True)
             else:
                 rx = translator.response_body(raw, True)
+            # Response-side typed validation (r5): the body the gateway
+            # re-emits must carry the front schema's response shape — a
+            # malformed upstream body is an upstream failure (reference
+            # ResponseError semantics, translator.go:42-77), retriable
+            # on the next backend like any other 502.
+            if (not isinstance(body, _RawBody)
+                    and typed_response.has_spec(endpoint)):
+                parsed = rx.parsed
+                try:
+                    if parsed is None:
+                        parsed = json.loads(rx.body or raw)
+                    typed_response.validate_response(endpoint, parsed)
+                except (json.JSONDecodeError, oai.SchemaError) as e:
+                    if (endpoint is Endpoint.RESPONSES
+                            and isinstance(parsed, dict)):
+                        # the translator persisted a transcript for an
+                        # id the client will never see — roll it back
+                        rid = parsed.get("id")
+                        if isinstance(rid, str) and rid:
+                            from aigw_tpu.translate.responses import (
+                                RESPONSE_STORE,
+                            )
+
+                            if self._translator_blocks(endpoint):
+                                await asyncio.to_thread(
+                                    RESPONSE_STORE.delete, rid)
+                            else:
+                                RESPONSE_STORE.delete(rid)
+                    raise _RetriableUpstreamError(
+                        502,
+                        error_body(
+                            f"upstream returned a malformed "
+                            f"{endpoint.value} response: {e}",
+                            type_="upstream_error"),
+                        f"malformed upstream body: {e}",
+                    ) from None
             usage = rx.usage
             req_metrics.response_model = rx.model
             if span is not None:
@@ -938,6 +975,70 @@ class GatewayServer:
             from aigw_tpu.obs.openinference import StreamAccumulator
 
             acc = StreamAccumulator()
+        # Response-side typed validation for streams (r5): every event
+        # the gateway re-emits is validated against the front schema's
+        # chunk/event spec. Translators may re-emit at arbitrary byte
+        # boundaries (passthrough forwards upstream chunks verbatim), so
+        # events are reassembled across writes: validated-complete
+        # events are relayed, the tail stays buffered, and a malformed
+        # event is NEVER relayed — the stream ends with the error event.
+        sse_buf = b""
+        check_events = typed_response.has_stream_spec(endpoint)
+
+        def _bad_event(raw: bytes) -> "oai.SchemaError | None":
+            # field parsing (multi-line data joining, comments, CRLF)
+            # delegates to the shared SSE parser — only the framing
+            # scan below is local, because verbatim relay needs byte
+            # offsets, which SSEParser does not expose
+            from aigw_tpu.translate.sse import _parse_event
+
+            ev = _parse_event(raw)
+            if ev is None or not ev.data or ev.data.strip() == "[DONE]":
+                return None
+            try:
+                typed_response.validate_stream_event(
+                    endpoint, json.loads(ev.data))
+            except (json.JSONDecodeError, oai.SchemaError) as e:
+                return oai.SchemaError(str(e))
+            return None
+
+        def _scan_events(
+            buf: bytes,
+        ) -> "tuple[bytes, bytes, oai.SchemaError | None]":
+            """(relay-able prefix of complete good events, remainder,
+            error). On error the bad event stays in the remainder.
+            Boundary rules byte-identical to SSEParser.feed: an event
+            ends at the first blank line, \\n\\n or \\r\\n\\r\\n."""
+            ok_end = pos = 0
+            while True:
+                sep = -1
+                seplen = 0
+                for cand in (b"\n\n", b"\r\n\r\n"):
+                    i = buf.find(cand, pos)
+                    if i != -1 and (sep == -1 or i < sep):
+                        sep, seplen = i, len(cand)
+                if sep == -1:
+                    return buf[:ok_end], buf[ok_end:], None
+                err = _bad_event(buf[pos:sep])
+                if err is not None:
+                    return buf[:ok_end], buf[ok_end:], err
+                pos = ok_end = sep + seplen
+
+        async def _relay(body: bytes) -> None:
+            nonlocal sse_buf
+            if not check_events:
+                if acc is not None:
+                    acc.feed(body)
+                await out.write(body)
+                return
+            good, sse_buf, err = _scan_events(sse_buf + body)
+            if good:
+                if acc is not None:
+                    acc.feed(good)
+                await out.write(good)
+            if err is not None:
+                raise err
+
         try:
             async for chunk in resp.content.iter_any():
                 rx = translator.response_body(chunk, False)
@@ -945,9 +1046,7 @@ class GatewayServer:
                 model = rx.model or model
                 req_metrics.record_tokens_emitted(rx.tokens_emitted)
                 if rx.body:
-                    if acc is not None:
-                        acc.feed(rx.body)
-                    await out.write(rx.body)
+                    await _relay(rx.body)
             if self._translator_blocks(endpoint):
                 # end-of-stream persists the transcript to disk
                 rx = await asyncio.to_thread(
@@ -957,29 +1056,43 @@ class GatewayServer:
             usage = usage.merge_override(rx.usage)
             model = rx.model or model
             if rx.body:
-                if acc is not None:
-                    acc.feed(rx.body)
-                await out.write(rx.body)
-        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                await _relay(rx.body)
+            if check_events and sse_buf:
+                # final event not terminated by a blank line (the same
+                # shape SSEParser.flush handles): validate before relay
+                # — the malformed-never-relayed invariant holds at EOF
+                err = _bad_event(sse_buf)
+                if err is not None:
+                    raise err
+                await out.write(sse_buf)
+                sse_buf = b""
+        except (aiohttp.ClientError, asyncio.TimeoutError,
+                oai.SchemaError) as e:
             # Mid-stream failure: the client already has bytes; surface an
             # SSE error event rather than failing over (the reference's
             # per-try idle timeout only retries before response start).
             # The event is shaped for the *front* schema so the client
             # SDK recognizes it (Anthropic SDKs need `event: error` with
-            # an Anthropic error envelope).
-            logger.warning("stream from %s aborted: %s", rb.backend.name, e)
+            # an Anthropic error envelope). A SchemaError means the
+            # upstream emitted a malformed event — it was NOT relayed;
+            # the stream ends with the error event instead.
+            malformed = isinstance(e, oai.SchemaError)
+            logger.warning("stream from %s %s: %s", rb.backend.name,
+                           "emitted malformed event" if malformed
+                           else "aborted", e)
+            msg = ("upstream emitted a malformed stream event"
+                   if malformed else "upstream stream interrupted")
             if front_schema is APISchemaName.ANTHROPIC:
                 await out.write(
                     b'event: error\n'
                     b'data: {"type": "error", "error": {"type": '
-                    b'"overloaded_error", "message": '
-                    b'"upstream stream interrupted"}}\n\n'
+                    b'"overloaded_error", "message": "'
+                    + msg.encode() + b'"}}\n\n'
                 )
             else:
                 await out.write(
-                    b'data: {"error": {"message": '
-                    b'"upstream stream interrupted", '
-                    b'"type": "upstream_error", "code": null}}\n\n'
+                    b'data: {"error": {"message": "' + msg.encode()
+                    + b'", "type": "upstream_error", "code": null}}\n\n'
                 )
         req_metrics.response_model = model
         if acc is not None:

@@ -254,16 +254,121 @@ TOKENIZE = Spec(
 )
 
 # ---------------------------------------------------------------------------
-# /v1/responses (typed shallowly: the Responses surface is large and
-# fast-moving; the load-bearing fields are typed, the rest passes
-# through like vendor fields)
+# /v1/responses — input item unions typed deeply (r4 verdict: the
+# earlier spec was "typed shallowly"). Discriminated on "type"; known
+# types validate their full shape, unknown type strings pass (the item
+# set grows — same forward-compat posture as vendor fields). An item
+# with no "type" is a message iff it carries a role (the API accepts
+# bare {role, content} items).
+
+_RESPONSES_CONTENT_PARTS: dict[str, Spec] = {
+    "input_text": Spec(fields={
+        "text": Field(type="string", required=True, nullable=False)}),
+    "output_text": Spec(fields={
+        "text": Field(type="string", required=True, nullable=False),
+        "annotations": Field(type="array"),
+    }),
+    "refusal": Spec(fields={
+        "refusal": Field(type="string", required=True, nullable=False)}),
+    "input_image": Spec(fields={
+        "image_url": Field(type="string"),
+        "file_id": Field(type="string"),
+        "detail": Field(type="string", enum=("low", "high", "auto")),
+    }),
+    "input_file": Spec(fields={
+        "file_id": Field(type="string"),
+        "filename": Field(type="string"),
+        "file_data": Field(type="string"),
+        "file_url": Field(type="string"),
+    }),
+}
+
+
+def _check_responses_content_part(value: dict, path: str) -> None:
+    t = value.get("type")
+    if not isinstance(t, str) or not t:
+        raise SchemaError(f"{path}.type: is required")
+    spec = _RESPONSES_CONTENT_PARTS.get(t)
+    if spec is not None:
+        validate_object(value, spec, path)
+
+
+_RESPONSES_MESSAGE_ITEM = Spec(fields={
+    "role": Field(type="string", required=True, nullable=False, enum=(
+        "user", "assistant", "system", "developer")),
+    "content": Field(required=True, nullable=False, union=(
+        Field(type="string"),
+        Field(type="array", min_len=1, item=Field(
+            type="object", check=_check_responses_content_part)),
+    )),
+    "status": Field(type="string"),
+})
+
+_RESPONSES_INPUT_ITEMS: dict[str, Spec] = {
+    "message": _RESPONSES_MESSAGE_ITEM,
+    "function_call": Spec(fields={
+        "call_id": Field(type="string", required=True, nullable=False),
+        "name": Field(type="string", required=True, nullable=False),
+        "arguments": Field(type="string", required=True, nullable=False),
+        "status": Field(type="string"),
+    }),
+    "function_call_output": Spec(fields={
+        "call_id": Field(type="string", required=True, nullable=False),
+        "output": Field(required=True, nullable=False, union=(
+            Field(type="string"),
+            Field(type="array"),
+        )),
+        "status": Field(type="string"),
+    }),
+    "reasoning": Spec(fields={
+        "summary": Field(type="array", required=True, item=Field(
+            type="object", spec=Spec(fields={
+                "type": Field(type="string", required=True),
+                "text": Field(type="string"),
+            }))),
+        "encrypted_content": Field(type="string"),
+        "status": Field(type="string"),
+    }),
+    "item_reference": Spec(fields={
+        "id": Field(type="string", required=True, nullable=False),
+    }),
+}
+
+
+def _check_responses_input_item(value: dict, path: str) -> None:
+    t = value.get("type")
+    if t is None:
+        # bare {role, content} message item
+        validate_object(value, _RESPONSES_MESSAGE_ITEM, path)
+        return
+    if not isinstance(t, str) or not t:
+        raise SchemaError(f"{path}.type: must be string")
+    spec = _RESPONSES_INPUT_ITEMS.get(t)
+    if spec is not None:
+        validate_object(value, spec, path)
+
+
+def _check_responses_tool(value: dict, path: str) -> None:
+    t = value.get("type")
+    if not isinstance(t, str) or not t:
+        raise SchemaError(f"{path}.type: is required")
+    if t == "function":
+        validate_object(value, Spec(fields={
+            "name": Field(type="string", required=True, nullable=False,
+                          min_len=1),
+            "parameters": Field(type="object"),
+            "strict": Field(type="boolean"),
+            "description": Field(type="string"),
+        }), path)
+
 
 RESPONSES = Spec(
     fields={
         "model": Field(type="string", required=True, min_len=1),
         "input": Field(union=(
             Field(type="string"),
-            Field(type="array", item=Field(type="object")),
+            Field(type="array", item=Field(
+                type="object", check=_check_responses_input_item)),
         )),
         "instructions": Field(type="string"),
         "max_output_tokens": Field(type="integer", ge=1),
@@ -272,9 +377,18 @@ RESPONSES = Spec(
         "stream": Field(type="boolean"),
         "temperature": Field(type="number", ge=0, le=2),
         "top_p": Field(type="number", ge=0, le=1),
+        "parallel_tool_calls": Field(type="boolean"),
+        "truncation": Field(type="string", enum=("auto", "disabled")),
+        "reasoning": Field(type="object", spec=Spec(fields={
+            "effort": Field(type="string", enum=(
+                "minimal", "low", "medium", "high")),
+            "summary": Field(type="string", enum=(
+                "auto", "concise", "detailed")),
+        })),
         "tool_choice": Field(union=(
             Field(type="string"), Field(type="object"))),
-        "tools": Field(type="array", item=Field(type="object")),
+        "tools": Field(type="array", item=Field(
+            type="object", check=_check_responses_tool)),
     },
 )
 

@@ -68,6 +68,10 @@ class ResponseTx:
     model: str = ""  # response model, when the upstream reports one
     # event boundary markers for metrics: tokens emitted in this chunk
     tokens_emitted: int = 0
+    # Optional: the parsed JSON of ``body`` when the translator already
+    # holds it (non-streaming only) — lets the gateway's response-side
+    # typed validation skip a redundant json.loads on the hot path.
+    parsed: Any = None
 
 
 class Translator(ABC):

@@ -59,10 +59,15 @@ class PassthroughTranslator(Translator):
                 data = json.loads(chunk) if chunk else {}
             except json.JSONDecodeError:
                 return ResponseTx(body=chunk)
+            if not isinstance(data, dict):
+                # non-object JSON: nothing to mine; the gateway's
+                # response-side validation rejects it for typed endpoints
+                return ResponseTx(body=chunk, parsed=data)
             return ResponseTx(
                 body=chunk,
                 usage=self._extract(data),
                 model=str(data.get("model", "") or ""),
+                parsed=data,
             )
         #
 
@@ -80,11 +85,17 @@ class PassthroughTranslator(Translator):
                 data = json.loads(ev.data)
             except json.JSONDecodeError:
                 continue
+            if not isinstance(data, dict):
+                continue  # malformed event: the gateway's response-side
+                # validation rejects it; don't crash the counter
             usage = usage.merge_override(self._extract(data))
             model = str(data.get("model", "") or "") or model
-            for choice in data.get("choices", ()):
-                delta = choice.get("delta") or {}
-                if delta.get("content"):
+            choices = data.get("choices", ())
+            for choice in choices if isinstance(choices, list) else ():
+                if not isinstance(choice, dict):
+                    continue
+                delta = choice.get("delta")
+                if isinstance(delta, dict) and delta.get("content"):
                     tokens += 1
             # Anthropic-shaped stream events carry no "choices"
             if data.get("type") == "content_block_delta":

