@@ -145,12 +145,20 @@ class Reconciler:
         the growing good set — each rejected object is blamed with its
         own error. N+1 compiles of small dicts; only runs on bad input.
         """
-        from aigw_tpu.config import admission
+        from aigw_tpu.config import admission, refgrant
 
         errors: dict[str, str] = {}
+        # cross-object admission: ReferenceGrant enforcement for
+        # cross-namespace backendRefs (reference referencegrant.go)
+        grant_errors = refgrant.validate(objects)
         admitted: list[dict[str, Any]] = []
         for obj in objects:
             errs = admission.validate(obj)
+            # grant verdicts are namespace-qualified: two same-named
+            # routes in different namespaces must not share one
+            gkey = refgrant.obj_key(obj)
+            if gkey in grant_errors:
+                errs = list(errs) + [grant_errors[gkey]]
             if errs:
                 errors[_obj_key(obj)] = "; ".join(errs)
             else:

@@ -52,6 +52,9 @@ _SKIPPED = {
     "ConfigMap", "Role", "RoleBinding", "ClusterRole", "ClusterRoleBinding",
     "InferenceObjective", "InferenceModel", "Namespace", "Job",
     "SecurityPolicy", "EnvoyExtensionPolicy",
+    # consumed by config.refgrant (cross-namespace authorization), not
+    # compiled into the serving config itself
+    "ReferenceGrant",
 }
 
 MODEL_HEADER = "x-ai-eg-model"

@@ -67,6 +67,8 @@ RESOURCES: dict[str, tuple[str, str, str, bool]] = {
     "InferencePool": (
         "inference.networking.x-k8s.io", "v1alpha2",
         "inferencepools", True),
+    "ReferenceGrant": (
+        "gateway.networking.k8s.io", "v1beta1", "referencegrants", True),
     "Secret": ("", "v1", "secrets", True),
 }
 
