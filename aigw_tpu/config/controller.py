@@ -60,9 +60,17 @@ _KIND_RANK = {k: i for i, k in enumerate(_KIND_ORDER)}
 
 
 def _obj_key(obj: dict[str, Any]) -> str:
+    """Object identity for conditions/status. Namespace-qualified for
+    non-default namespaces so same-named objects in different
+    namespaces never share a verdict (r5 review); the bare Kind/name
+    form is kept for the default namespace — the common single-tenant
+    manifest-dir case and the format `aigw status` has always shown.
+    Collision-free: '/' is illegal in K8s names."""
     kind = obj.get("kind", "?")
-    name = (obj.get("metadata") or {}).get("name", "?")
-    return f"{kind}/{name}"
+    meta = obj.get("metadata") or {}
+    name = meta.get("name", "?")
+    ns = meta.get("namespace") or "default"
+    return f"{kind}/{name}" if ns == "default" else f"{kind}/{ns}/{name}"
 
 
 def _obj_checksum(obj: dict[str, Any]) -> str:
@@ -154,13 +162,11 @@ class Reconciler:
         admitted: list[dict[str, Any]] = []
         for obj in objects:
             errs = admission.validate(obj)
-            # grant verdicts are namespace-qualified: two same-named
-            # routes in different namespaces must not share one
-            gkey = refgrant.obj_key(obj)
-            if gkey in grant_errors:
-                errs = list(errs) + [grant_errors[gkey]]
+            key = _obj_key(obj)
+            if key in grant_errors:
+                errs = list(errs) + [grant_errors[key]]
             if errs:
-                errors[_obj_key(obj)] = "; ".join(errs)
+                errors[key] = "; ".join(errs)
             else:
                 admitted.append(obj)
         objects = admitted

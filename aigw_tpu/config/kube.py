@@ -175,9 +175,14 @@ def in_cluster_auth() -> KubeAuth:
     if not host:
         raise ValueError("not running in-cluster "
                          "(KUBERNETES_SERVICE_HOST unset)")
-    with open(f"{_SA_DIR}/token", encoding="utf-8") as f:
+    # AIGW_SA_DIR: test seam — the composed webhook→sidecar e2e runs
+    # the REAL `run kube:in-cluster` args the webhook injects, against
+    # a local TLS apiserver, by pointing the token/ca mount elsewhere
+    # (the reference's envtest plays the same role)
+    sa_dir = os.environ.get("AIGW_SA_DIR", _SA_DIR)
+    with open(f"{sa_dir}/token", encoding="utf-8") as f:
         token = f.read().strip()
-    with open(f"{_SA_DIR}/ca.crt", "rb") as f:
+    with open(f"{sa_dir}/ca.crt", "rb") as f:
         ca = f.read()
     return KubeAuth(server=f"https://{host}:{port}", token=token,
                     ca_data=ca)
@@ -628,9 +633,16 @@ class LeaderElector:
         import time as _time
 
         try:
-            base = value.split(".")[0]
-            return calendar.timegm(
-                _time.strptime(base, "%Y-%m-%dT%H:%M:%S"))
+            base, _, frac = value.partition(".")
+            # seconds-precision RFC3339 carries the Z on the base
+            # ("...T12:00:00Z"): a parse failure here would read as
+            # "expired" and elect a second writer
+            secs = calendar.timegm(
+                _time.strptime(base.rstrip("Zz"), "%Y-%m-%dT%H:%M:%S"))
+            frac = frac.rstrip("Zz")
+            if frac.isdigit():
+                secs += float(f"0.{frac}")
+            return secs
         except (ValueError, AttributeError):
             return 0.0
 
@@ -723,18 +735,35 @@ class LeaderElector:
     async def release(self) -> None:
         """Surrender the lease (graceful shutdown): blank the holder and
         pre-expire it so a peer can acquire immediately instead of
-        waiting out leaseDurationSeconds."""
+        waiting out leaseDurationSeconds.
+
+        Guarded (r5): the blank PUT only goes out if we still HOLD the
+        lease on the server — a peer that acquired after our lease
+        lapsed must not have its fresh lease overwritten by our stale
+        surrender (that window would let a THIRD candidate acquire and
+        give the cluster two writers). The fetched resourceVersion rides
+        the PUT so a real API server 409s any concurrent change."""
         if not self._leader:
             return
         self._leader = False
         self._valid_until = 0.0
         try:
             s = await self.client.session()
+            async with s.get(self._lease_url(self.lease_name)) as resp:
+                if resp.status != 200:
+                    return
+                lease = await resp.json()
+            holder = (lease.get("spec") or {}).get("holderIdentity", "")
+            if holder != self.identity:
+                return  # someone else already holds it — not ours to blank
+            meta = {"name": self.lease_name, "namespace": self.namespace}
+            rv = (lease.get("metadata") or {}).get("resourceVersion")
+            if rv is not None:
+                meta["resourceVersion"] = rv
             body = {
                 "apiVersion": "coordination.k8s.io/v1",
                 "kind": "Lease",
-                "metadata": {"name": self.lease_name,
-                             "namespace": self.namespace},
+                "metadata": meta,
                 "spec": {"holderIdentity": "",
                          "leaseDurationSeconds": 1,
                          "renewTime": "1970-01-01T00:00:00.000000Z"},

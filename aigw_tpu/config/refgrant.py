@@ -40,21 +40,24 @@ def _namespace(obj: dict[str, Any]) -> str:
 
 
 def obj_key(obj: dict[str, Any]) -> str:
-    """Namespace-qualified identity — two same-named routes in
-    different namespaces must not share a validation verdict."""
-    meta = obj.get("metadata") or {}
-    return (f"{obj.get('kind', '?')}/{_namespace(obj)}/"
-            f"{meta.get('name', '?')}")
+    """Same identity as the reconciler's (controller._obj_key):
+    namespace-qualified outside the default namespace, so verdicts
+    key onto exactly the condition each object receives."""
+    from aigw_tpu.config.controller import _obj_key
+
+    return _obj_key(obj)
 
 
 def _grant_allows(grant: dict[str, Any], from_ns: str, to_group: str,
                   to_kind: str, to_name: str) -> bool:
+    # explicit-null tolerance throughout (`or ()`): `from:`/`to:` as
+    # YAML null must quarantine nothing and crash nothing
     spec = grant.get("spec") or {}
     from_ok = any(
         f.get("group") == AIGW_GROUP
         and f.get("kind") == ROUTE_KIND
         and f.get("namespace") == from_ns
-        for f in spec.get("from", ()) if isinstance(f, dict)
+        for f in (spec.get("from") or ()) if isinstance(f, dict)
     )
     if not from_ok:
         return False
@@ -65,7 +68,7 @@ def _grant_allows(grant: dict[str, Any], from_ns: str, to_group: str,
     return any(
         t.get("group") == to_group and t.get("kind") == to_kind
         and (not t.get("name") or t.get("name") == to_name)
-        for t in spec.get("to", ()) if isinstance(t, dict)
+        for t in (spec.get("to") or ()) if isinstance(t, dict)
     )
 
 
@@ -85,10 +88,10 @@ def validate(objects: list[dict[str, Any]]) -> dict[str, str]:
         route_ns = _namespace(obj)
         key = obj_key(obj)
         spec = obj.get("spec") or {}
-        for rule in spec.get("rules", ()):
+        for rule in (spec.get("rules") or ()):
             if not isinstance(rule, dict):
                 continue
-            for ref in rule.get("backendRefs", ()):
+            for ref in (rule.get("backendRefs") or ()):
                 if not isinstance(ref, dict):
                     continue
                 target_ns = ref.get("namespace")

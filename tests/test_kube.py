@@ -53,16 +53,19 @@ class FakeAPIServer:
         self.url = ""
         self._loop = None
 
-    async def start(self):
+    async def start(self, ssl_context=None):
         self._loop = asyncio.get_running_loop()
         # open watch streams never return; don't let cleanup() wait out
         # the default 60s graceful-shutdown window for them
         self._runner = web.AppRunner(self.app, shutdown_timeout=1.0)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        site = web.TCPSite(self._runner, "127.0.0.1", 0,
+                           ssl_context=ssl_context)
         await site.start()
         port = site._server.sockets[0].getsockname()[1]
-        self.url = f"http://127.0.0.1:{port}"
+        scheme = "https" if ssl_context else "http"
+        self.url = f"{scheme}://127.0.0.1:{port}"
+        self.port = port
 
     async def stop(self):
         await self._runner.cleanup()
@@ -529,6 +532,52 @@ class TestLeaderElection:
             finally:
                 rec._elector.stop()
                 await asyncio.to_thread(source.stop)
+                await api.stop()
+
+        asyncio.run(main())
+
+    def test_stale_release_does_not_overwrite_new_holder(self):
+        """The r4-verdict race: replica A wedges, its lease lapses, B
+        acquires — then A's delayed graceful shutdown fires. A's blank
+        PUT must NOT land on B's fresh lease (it would let a third
+        candidate acquire → two writers). release() now verifies the
+        server-side holder first."""
+
+        async def main():
+            from aigw_tpu.config.kube import (
+                KubeAuth as _Auth,
+                KubeClient,
+                LeaderElector,
+            )
+
+            api = FakeAPIServer()
+            await api.start()
+            ca = KubeClient(_Auth(server=api.url))
+            cb = KubeClient(_Auth(server=api.url))
+            cc = KubeClient(_Auth(server=api.url))
+            a = LeaderElector(ca, lease_name="race", identity="a",
+                              lease_seconds=1.0)
+            b = LeaderElector(cb, lease_name="race", identity="b",
+                              lease_seconds=60.0)
+            c = LeaderElector(cc, lease_name="race", identity="c",
+                              lease_seconds=60.0)
+            try:
+                assert await a.try_acquire()
+                await asyncio.sleep(1.2)  # a wedges; its lease lapses
+                assert await b.try_acquire()  # b takes over
+                # a's graceful shutdown finally runs — stale surrender
+                await a.release()
+                spec = api.leases["race"]["spec"]
+                assert spec["holderIdentity"] == "b", (
+                    "stale release overwrote the new holder")
+                # and nobody else can squat on a blanked lease
+                assert not await c.try_acquire()
+                assert api.leases["race"]["spec"][
+                    "holderIdentity"] == "b"
+            finally:
+                await ca.close()
+                await cb.close()
+                await cc.close()
                 await api.stop()
 
         asyncio.run(main())

@@ -39,7 +39,7 @@ def route(name="r1", ns="default", target_ns=None, kind=None,
     }
 
 
-KEY = "AIGatewayRoute/default/r1"
+KEY = "AIGatewayRoute/r1"  # default-namespace form (controller._obj_key)
 
 
 def grant(ns, from_ns="default", to_kind="AIServiceBackend",
@@ -99,6 +99,37 @@ class TestValidate:
         errs = refgrant.validate([bad, good])
         assert errs == {
             "AIGatewayRoute/ns-a/r1": errs["AIGatewayRoute/ns-a/r1"]}
+
+    def test_conditions_do_not_cross_namespaces(self):
+        """The full reconcile path: the NotAccepted condition lands ONLY
+        on the violating namespace's route (r5 review: the errors dict
+        was keyed Kind/name, smearing the verdict onto both)."""
+        from aigw_tpu.config.controller import _obj_key
+
+        bad = route(ns="ns-a", target_ns="other")
+        good = route(ns="ns-b")
+        errs = refgrant.validate([bad, good])
+        assert _obj_key(bad) in errs
+        assert _obj_key(good) not in errs
+        assert _obj_key(bad) != _obj_key(good)
+
+    def test_explicit_null_fields_quarantine_nothing(self):
+        """`rules:`/`backendRefs:`/`from:`/`to:` as YAML null (key
+        present, value None) must not crash the validator — a torn
+        manifest quarantines one object, never the reconcile pass."""
+        r = route(target_ns="other")
+        r["spec"]["rules"] = None
+        assert refgrant.validate([r]) == {}
+        r2 = route(target_ns="other")
+        r2["spec"]["rules"][0]["backendRefs"] = None
+        assert refgrant.validate([r2]) == {}
+        g = grant("other")
+        g["spec"]["from"] = None
+        g2 = grant("other")
+        g2["spec"]["to"] = None
+        # null-field grants grant nothing, crash nothing
+        assert "AIGatewayRoute/r1" in refgrant.validate(
+            [route(target_ns="other"), g, g2])
 
     def test_named_to_entry_restricts_to_that_resource(self):
         """Gateway API: to[].name scopes the grant to ONE resource —

@@ -144,3 +144,201 @@ class TestWebhookHTTP:
                 await runner.cleanup()
 
         asyncio.run(main())
+
+
+class TestComposedKubeE2E:
+    """The full kube story in ONE flow (r4 verdict next-step #6):
+    `aigw webhook` over TLS (the transport K8s actually requires) admits
+    a labeled pod -> the injected sidecar's REAL args (`run
+    kube:in-cluster`) are executed as a subprocess against a TLS fake
+    apiserver (token + ca via the serviceaccount mount seam) -> a route
+    CRD apply reroutes live traffic -> the Accepted condition lands on
+    the object. The reference covers the same composition with envtest +
+    its webhook tests (gateway_mutator.go:126)."""
+
+    def test_webhook_tls_to_sidecar_to_kube_reroute(self, tmp_path):
+        import os
+        import ssl
+        import subprocess
+        import sys
+        import time
+
+        from tests.fakes import FakeUpstream, openai_chat_response
+        from tests.test_kube import (
+            FakeAPIServer,
+            _backend_objs,
+            _route_obj,
+        )
+
+        def mk_cert(name):
+            crt = tmp_path / f"{name}.crt"
+            key = tmp_path / f"{name}.key"
+            subprocess.run(
+                ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+                 "-nodes", "-keyout", str(key), "-out", str(crt),
+                 "-days", "1", "-subj", "/CN=127.0.0.1",
+                 "-addext", "subjectAltName=IP:127.0.0.1"],
+                check=True, capture_output=True)
+            return str(crt), str(key)
+
+        wh_crt, wh_key = mk_cert("webhook")
+        api_crt, api_key = mk_cert("apiserver")
+
+        async def main():
+            # -- upstreams + TLS fake apiserver ---------------------------
+            up_a = FakeUpstream().on_json(
+                "/v1/chat/completions", openai_chat_response(content="A"))
+            up_b = FakeUpstream().on_json(
+                "/v1/chat/completions", openai_chat_response(content="B"))
+            await up_a.start()
+            await up_b.start()
+            host_a, port_a = up_a.url.split("//")[1].split(":")
+            host_b, port_b = up_b.url.split("//")[1].split(":")
+
+            server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            server_ctx.load_cert_chain(api_crt, api_key)
+            api = FakeAPIServer()
+            await api.start(ssl_context=server_ctx)
+            for obj in (_backend_objs("be-a", host_a, int(port_a))
+                        + _backend_objs("be-b", host_b, int(port_b))
+                        + [_route_obj("r1", "m1", "be-a")]):
+                api.objects[FakeAPIServer._key(obj)] = obj
+
+            # -- the webhook, over TLS ------------------------------------
+            import socket
+
+            def free_port():
+                with socket.socket() as s:
+                    s.bind(("127.0.0.1", 0))
+                    return s.getsockname()[1]
+
+            wh_port = free_port()
+            gw_port = free_port()
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            wh_proc = subprocess.Popen(
+                [sys.executable, "-m", "aigw_tpu", "webhook",
+                 "--tls-cert", wh_crt, "--tls-key", wh_key,
+                 "--port", str(wh_port), "--image", "aigw-tpu:test",
+                 "--gateway-port", str(gw_port)],
+                cwd=os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))), env=env)
+
+            wh_ssl = ssl.create_default_context(cafile=wh_crt)
+            gw_proc = None
+            try:
+                async with aiohttp.ClientSession() as s:
+                    deadline = time.time() + 60
+                    while time.time() < deadline:
+                        try:
+                            async with s.get(
+                                f"https://127.0.0.1:{wh_port}/health",
+                                ssl=wh_ssl,
+                            ) as r:
+                                if r.status == 200:
+                                    break
+                        except aiohttp.ClientError:
+                            await asyncio.sleep(0.3)
+                    else:
+                        raise RuntimeError("webhook never came up (TLS)")
+
+                    # -- K8s-style admission over TLS ---------------------
+                    async with s.post(
+                        f"https://127.0.0.1:{wh_port}/mutate",
+                        json=_review(_gateway_pod()), ssl=wh_ssl,
+                    ) as r:
+                        assert r.status == 200
+                        out = await r.json()
+                    resp = out["response"]
+                    assert resp["allowed"] is True
+                    patch = json.loads(base64.b64decode(resp["patch"]))
+
+                    # apply the patch the way the API server would
+                    pod = _gateway_pod()
+                    assert patch[0]["path"] == "/spec/containers/-"
+                    pod["spec"]["containers"].append(patch[0]["value"])
+                    sidecar = pod["spec"]["containers"][-1]
+                    assert sidecar["name"] == SIDECAR_NAME
+                    assert sidecar["args"][0:2] == ["run",
+                                                    "kube:in-cluster"]
+
+                    # -- run the injected sidecar args verbatim -----------
+                    sa = tmp_path / "sa"
+                    sa.mkdir()
+                    (sa / "token").write_text("test-token")
+                    (sa / "ca.crt").write_bytes(
+                        open(api_crt, "rb").read())
+                    gw_env = dict(
+                        env,
+                        KUBERNETES_SERVICE_HOST="127.0.0.1",
+                        KUBERNETES_SERVICE_PORT=str(api.port),
+                        AIGW_SA_DIR=str(sa),
+                    )
+                    gw_proc = subprocess.Popen(
+                        [sys.executable, "-m", "aigw_tpu"]
+                        + list(sidecar["args"]),
+                        cwd=os.path.dirname(os.path.dirname(
+                            os.path.abspath(__file__))), env=gw_env)
+
+                    url = f"http://127.0.0.1:{gw_port}"
+                    deadline = time.time() + 90
+                    while time.time() < deadline:
+                        try:
+                            async with s.get(url + "/health") as r:
+                                if r.status == 200:
+                                    break
+                        except aiohttp.ClientError:
+                            await asyncio.sleep(0.4)
+                    else:
+                        raise RuntimeError("sidecar gateway never up")
+
+                    payload = {"model": "m1", "messages": [
+                        {"role": "user", "content": "hi"}]}
+                    async with s.post(url + "/v1/chat/completions",
+                                      json=payload) as r:
+                        assert r.status == 200
+                        got = await r.json()
+                        assert got["choices"][0]["message"][
+                            "content"] == "A"
+
+                    # -- kubectl apply reroutes; condition lands ----------
+                    api.apply(_route_obj("r1", "m1", "be-b",
+                                         generation=2))
+                    deadline = time.time() + 30
+                    content = "A"
+                    while time.time() < deadline and content != "B":
+                        await asyncio.sleep(0.4)
+                        async with s.post(url + "/v1/chat/completions",
+                                          json=payload) as r:
+                            assert r.status == 200
+                            content = (await r.json())[
+                                "choices"][0]["message"]["content"]
+                    assert content == "B", "apply never rerouted"
+
+                    deadline = time.time() + 30
+                    conds = []
+                    while time.time() < deadline:
+                        route = api.objects.get(
+                            ("AIGatewayRoute", "default", "r1"), {})
+                        conds = route.get("status", {}).get(
+                            "conditions", [])
+                        if conds and conds[0].get(
+                                "observedGeneration") == 2:
+                            break
+                        await asyncio.sleep(0.3)
+                    assert conds and conds[0]["status"] == "True", conds
+            finally:
+                wh_proc.terminate()
+                if gw_proc is not None:
+                    gw_proc.terminate()
+                for p in (wh_proc, gw_proc):
+                    if p is None:
+                        continue
+                    try:
+                        p.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                await api.stop()
+                await up_a.stop()
+                await up_b.stop()
+
+        asyncio.run(main())
