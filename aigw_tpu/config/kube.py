@@ -418,9 +418,12 @@ class KubeSource:
 
 def _pod_namespace() -> str:
     """The pod's own namespace when in-cluster (a Role there is enough
-    for the election lease); "default" otherwise."""
+    for the election lease); "default" otherwise. Honors the same
+    AIGW_SA_DIR seam as in_cluster_auth — credentials and namespace
+    must come from the SAME mount."""
+    sa_dir = os.environ.get("AIGW_SA_DIR", _SA_DIR)
     try:
-        with open(f"{_SA_DIR}/namespace", encoding="utf-8") as f:
+        with open(f"{sa_dir}/namespace", encoding="utf-8") as f:
             return f.read().strip() or "default"
     except OSError:
         return "default"

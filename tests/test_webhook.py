@@ -237,7 +237,9 @@ class TestComposedKubeE2E:
                                 if r.status == 200:
                                     break
                         except aiohttp.ClientError:
-                            await asyncio.sleep(0.3)
+                            pass
+                        await asyncio.sleep(0.3)  # back off on ANY
+                        # not-ready outcome, not just refused conns
                     else:
                         raise RuntimeError("webhook never came up (TLS)")
 
@@ -287,7 +289,8 @@ class TestComposedKubeE2E:
                                 if r.status == 200:
                                     break
                         except aiohttp.ClientError:
-                            await asyncio.sleep(0.4)
+                            pass
+                        await asyncio.sleep(0.4)
                     else:
                         raise RuntimeError("sidecar gateway never up")
 
