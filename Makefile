@@ -23,6 +23,9 @@ gateway-bench:
 docs:
 	python docs/build_site.py
 
+codegen:
+	python -m aigw_tpu.config.clientgen
+
 clean:
 	$(MAKE) -C native clean
 

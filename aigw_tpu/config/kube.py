@@ -59,6 +59,8 @@ RESOURCES: dict[str, tuple[str, str, str, bool]] = {
         "aigateway.envoyproxy.io", "v1alpha1", "mcproutes", True),
     "GatewayConfig": (
         "aigateway.envoyproxy.io", "v1alpha1", "gatewayconfigs", True),
+    "QuotaPolicy": (
+        "aigateway.envoyproxy.io", "v1alpha1", "quotapolicies", True),
     "Backend": (
         "gateway.envoyproxy.io", "v1alpha1", "backends", True),
     "BackendTLSPolicy": (
@@ -76,7 +78,7 @@ RESOURCES: dict[str, tuple[str, str, str, bool]] = {
 #: only on its own API group's objects)
 STATUS_KINDS = {
     "AIGatewayRoute", "AIServiceBackend", "BackendSecurityPolicy",
-    "MCPRoute", "GatewayConfig",
+    "MCPRoute", "GatewayConfig", "QuotaPolicy",
 }
 
 
@@ -319,6 +321,7 @@ class KubeSource:
         self._thread: threading.Thread | None = None
         self._client: KubeClient | None = None
         self._synced_kinds: set[str] = set()
+        self._listeners: list[Callable[[str, dict], None]] = []
         self.generation = 0  # bumped on every cache change
 
     # -- lifecycle --------------------------------------------------------
@@ -366,11 +369,35 @@ class KubeSource:
                 items, rv, installed = \
                     await self._client.list_resource(kind)
                 with self._lock:
-                    for key in [k for k in self._cache if k[0] == kind]:
+                    # resync delta for listeners (client-go replays the
+                    # gap on re-list; informers must not silently miss
+                    # objects created/deleted while the watch was down)
+                    old = {k: v for k, v in self._cache.items()
+                           if k[0] == kind}
+                    new = {self._key(item): item for item in items}
+                    for key in old:
                         del self._cache[key]
-                    for item in items:
-                        self._cache[self._key(item)] = item
+                    self._cache.update(new)
                     self.generation += 1
+                    listeners = list(self._listeners)
+                for key, obj in old.items():
+                    if key not in new:
+                        for fn in listeners:
+                            try:
+                                fn("DELETED", obj)
+                            except Exception:  # noqa: BLE001
+                                logger.exception(
+                                    "informer handler failed")
+                for key, obj in new.items():
+                    prev = old.get(key)
+                    if prev != obj:
+                        etype = "ADDED" if prev is None else "MODIFIED"
+                        for fn in listeners:
+                            try:
+                                fn(etype, obj)
+                            except Exception:  # noqa: BLE001
+                                logger.exception(
+                                    "informer handler failed")
                 self._synced_kinds.add(kind)
                 if self._synced_kinds >= set(self.kinds):
                     self._synced.set()
@@ -399,6 +426,21 @@ class KubeSource:
             else:  # ADDED / MODIFIED
                 self._cache[self._key(obj)] = obj
             self.generation += 1
+            listeners = list(self._listeners)
+        # informer hook (generated <Kind>Informer classes): called on
+        # the watch thread after the cache applied the event
+        for fn in listeners:
+            try:
+                fn(etype, obj)
+            except Exception:  # noqa: BLE001 — a handler must not
+                logger.exception("informer handler failed")  # kill watch
+
+    def add_listener(self, fn: "Callable[[str, dict], None]") -> None:
+        """Subscribe to (event_type, object) pairs — the informer
+        contract over the shared watch (client-go informer parity for
+        the generated clientset, SURVEY §2.1 #8)."""
+        with self._lock:
+            self._listeners.append(fn)
 
     # -- reconcile-side API ----------------------------------------------
     def objects(self) -> list[dict]:

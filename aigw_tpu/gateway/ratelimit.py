@@ -47,6 +47,11 @@ class QuotaRule:
     model: str = ""  # "" = any
     backend: str = ""  # "" = any
     client_key_header: str = ""  # "" = one global bucket
+    # QuotaPolicy "Shared" mode (quotapolicies CRD): rules carrying the
+    # same non-empty group are charged together but the request is
+    # ALLOWED if at least one of them still has headroom. "" = an
+    # independent cap (deny when exhausted), the native default.
+    shared_group: str = ""
 
     @staticmethod
     def parse(value: dict[str, Any]) -> "QuotaRule":
@@ -61,6 +66,7 @@ class QuotaRule:
                 client_key_header=str(
                     value.get("client_key_header", "")
                 ).lower(),
+                shared_group=str(value.get("shared_group", "")),
             )
         except KeyError as e:
             raise ConfigError(f"quota rule missing field {e}") from None
@@ -390,8 +396,12 @@ class RateLimiter:
         now: float | None = None,
     ) -> tuple[bool, "QuotaRule | None"]:
         """(True, None) if the request may proceed; otherwise
-        (False, the violated rule)."""
+        (False, the violated rule). Independent rules deny when
+        exhausted; same-shared_group rules deny only when EVERY member
+        is exhausted (QuotaPolicy Shared mode)."""
         now = time.time() if now is None else now
+        group_ok: dict[str, bool] = {}
+        group_violated: dict[str, QuotaRule] = {}
         for rule in self._matching(model, backend):
             client_key = headers.get(rule.client_key_header, "") \
                 if rule.client_key_header else ""
@@ -400,8 +410,17 @@ class RateLimiter:
                 used = self.backend.get(rule.name, client_key, start)
             else:
                 used = self._bucket(rule, client_key, now).used
-            if used >= rule.limit:
+            ok = used < rule.limit
+            if rule.shared_group:
+                g = rule.shared_group
+                group_ok[g] = group_ok.get(g, False) or ok
+                if not ok:
+                    group_violated.setdefault(g, rule)
+            elif not ok:
                 return False, rule
+        for g, any_ok in group_ok.items():
+            if not any_ok:
+                return False, group_violated[g]
         return True, None
 
     def consume(

@@ -642,7 +642,8 @@ def run_cpu_ratio() -> dict:
         batch=BATCH, prompt_len=64, gen_tokens=64,
         label="CPU BACKEND (TPU tunnel down; ratio is the signal, "
               "absolute tok/s is not): ",
-        k_steps=4, subproc=True, reps=5,
+        k_steps=int(os.environ.get("AIGW_BENCH_CPU_K", "4")),
+        subproc=True, reps=5,
     )
     res["backend"] = jax.default_backend()
     return res

@@ -123,3 +123,65 @@ def test_engine_tp_matches_single_device():
     single = generate(None)
     tp = generate(make_mesh(MeshSpec(dp=1, tp=2)))
     assert single == tp
+
+
+def test_engine_tp_batched_prefill_burst():
+    """A concurrent burst on a tp mesh takes the r5 batched-prefill
+    admission ([G, S] under GSPMD); every request's greedy tokens must
+    match its solo run — sharded batched prefill is output-invisible."""
+    import threading
+
+    from aigw_tpu.parallel import MeshSpec, make_mesh
+    from aigw_tpu.tpuserve.engine import Engine, EngineConfig, GenRequest
+    from aigw_tpu.tpuserve.sampling import SamplingParams
+
+    params = llama.init_params(jax.random.PRNGKey(0), CFG)
+    eng = Engine(
+        params, CFG,
+        EngineConfig(max_batch_size=4, max_seq_len=128, page_size=16,
+                     min_prefill_bucket=16, decode_steps_per_tick=4),
+        eos_token_ids=(), mesh=make_mesh(MeshSpec(dp=1, tp=2)))
+    eng.start()
+    try:
+        prompts = [[3, 1, 4, 1, 5], [2, 7, 1, 8], [9, 9, 9]]
+
+        def run_one(p):
+            done = threading.Event()
+            toks = []
+
+            def emit(tok, fin):
+                if tok >= 0:
+                    toks.append(tok)
+                if fin is not None:
+                    done.set()
+
+            eng.submit(GenRequest(prompt=p, max_tokens=5,
+                                  sampling=SamplingParams(temperature=0.0),
+                                  emit=emit))
+            assert done.wait(timeout=240)
+            return toks
+
+        solos = [run_one(p) for p in prompts]
+
+        results = {i: [] for i in range(len(prompts))}
+        dones = [threading.Event() for _ in prompts]
+
+        def mk(i):
+            def emit(tok, fin):
+                if tok >= 0:
+                    results[i].append(tok)
+                if fin is not None:
+                    dones[i].set()
+            return emit
+
+        before = eng.stats.prefills
+        for i, p in enumerate(prompts):
+            eng.submit(GenRequest(prompt=p, max_tokens=5,
+                                  sampling=SamplingParams(temperature=0.0),
+                                  emit=mk(i)))
+        assert all(d.wait(timeout=240) for d in dones)
+        assert eng.stats.prefills == before + len(prompts)
+        for i, solo in enumerate(solos):
+            assert results[i] == solo, f"request {i} diverged on mesh"
+    finally:
+        eng.stop()

@@ -375,3 +375,142 @@ class TestNetworkQuotaService:
                 await up.stop()
 
         asyncio.run(main())
+
+
+class TestQuotaPolicyCRD:
+    """The QuotaPolicy CRD kind end to end (r5 fix: the kind was
+    admission-validated and chart-shipped but the compiler silently
+    DROPPED it — `kubectl apply` of a QuotaPolicy enforced nothing).
+    Mapping per the reference's quotapolicies schema: targetRefs →
+    backend scope, serviceQuota / perModelQuotas defaultBucket /
+    bucketRules → native rules, costExpression → Expression cost,
+    Distinct header selector → client bucket key, shadowMode skipped."""
+
+    def _objs(self, url, limit=60):
+        return [
+            {"apiVersion": "aigateway.envoyproxy.io/v1alpha1",
+             "kind": "AIGatewayRoute",
+             "metadata": {"name": "r1"},
+             "spec": {"rules": [{
+                 "matches": [{"headers": [{
+                     "type": "Exact", "name": "x-ai-eg-model",
+                     "value": "m1"}]}],
+                 "backendRefs": [{"name": "be"}],
+             }]}},
+            {"apiVersion": "aigateway.envoyproxy.io/v1alpha1",
+             "kind": "AIServiceBackend",
+             "metadata": {"name": "be"},
+             "spec": {"schema": {"name": "OpenAI"},
+                      "backendRef": {"name": "be", "kind": "Backend"}}},
+            {"apiVersion": "gateway.envoyproxy.io/v1alpha1",
+             "kind": "Backend",
+             "metadata": {"name": "be"},
+             "spec": {"endpoints": [{"fqdn": {
+                 "hostname": url.split("//")[1].split(":")[0],
+                 "port": int(url.split(":")[-1])}}]}},
+            {"apiVersion": "aigateway.envoyproxy.io/v1alpha1",
+             "kind": "QuotaPolicy",
+             "metadata": {"name": "q1"},
+             "spec": {
+                 "targetRefs": [{"kind": "AIServiceBackend",
+                                 "name": "be"}],
+                 "perModelQuotas": [{
+                     "modelName": "m1",
+                     "quota": {
+                         "defaultBucket": {"duration": "1h",
+                                           "limit": limit},
+                         "bucketRules": [{
+                             "clientSelectors": [{"headers": [{
+                                 "name": "x-user-id",
+                                 "type": "Distinct"}]}],
+                             "quota": {"duration": "1h",
+                                       "limit": limit},
+                         }],
+                     },
+                 }],
+             }},
+        ]
+
+    def test_compile_produces_rules_and_costs(self):
+        from aigw_tpu.config.crd import compile_crd_objects
+
+        out = compile_crd_objects(self._objs("http://h:1"))
+        rules = {q["name"]: q for q in out["quotas"]}
+        assert "q1/m1/default/be" in rules
+        bucket = rules["q1/m1/bucket0/be"]
+        assert bucket["client_key_header"] == "x-user-id"
+        assert bucket["model"] == "m1" and bucket["backend"] == "be"
+        keys = {c["metadata_key"] for c in out["llm_request_costs"]}
+        assert "aigw_qp_total_tokens" in keys
+        Config.parse(out).validate()
+
+    def test_alphabetical_precedence_for_duplicate_model(self):
+        """The CRD's documented tie-break: when multiple QuotaPolicies
+        define the same model for the same backend, the alphabetically
+        first (namespace/name) policy wins — the loser's rules must NOT
+        be emitted (they would 429 traffic the winner allows)."""
+        from aigw_tpu.config.crd import compile_crd_objects
+
+        def qp(name, ns, limit):
+            return {
+                "apiVersion": "aigateway.envoyproxy.io/v1alpha1",
+                "kind": "QuotaPolicy",
+                "metadata": {"name": name, "namespace": ns},
+                "spec": {
+                    "targetRefs": [{"kind": "AIServiceBackend",
+                                    "name": "be"}],
+                    "perModelQuotas": [{
+                        "modelName": "m1",
+                        "quota": {"defaultBucket": {
+                            "duration": "1h", "limit": limit}}}],
+                },
+            }
+
+        out = compile_crd_objects(
+            [qp("zzz", "default", 10), qp("aaa", "default", 100000)])
+        rules = out["quotas"]
+        assert [r["name"] for r in rules] == ["aaa/m1/default/be"]
+        assert rules[0]["limit"] == 100000
+
+        # same-named policies in different namespaces stay distinct
+        out2 = compile_crd_objects(
+            [qp("q1", "team-a", 5)])
+        assert out2["quotas"][0]["name"].startswith("team-a/q1/")
+
+    def test_429_from_quota_policy_crd(self):
+        async def main():
+            up = FakeUpstream().on_json(
+                "/v1/chat/completions",
+                openai_chat_response(prompt_tokens=5,
+                                     completion_tokens=45),
+            )
+            await up.start()
+            from aigw_tpu.config.crd import compile_crd_objects
+
+            cfg = Config.parse(compile_crd_objects(
+                self._objs(up.url, limit=60)))
+            server, runner = await run_gateway(RuntimeConfig.build(cfg),
+                                               port=0)
+            site = list(runner.sites)[0]
+            port = site._server.sockets[0].getsockname()[1]
+            url = f"http://127.0.0.1:{port}/v1/chat/completions"
+            payload = {"model": "m1",
+                       "messages": [{"role": "user", "content": "hi"}]}
+            try:
+                async with aiohttp.ClientSession() as s:
+                    for expect in (200, 200, 429):
+                        async with s.post(
+                            url, json=payload,
+                            headers={"x-user-id": "u1"},
+                        ) as r:
+                            assert r.status == expect, (
+                                expect, await r.read())
+                    # another client's bucket is untouched
+                    async with s.post(url, json=payload,
+                                      headers={"x-user-id": "u2"}) as r:
+                        assert r.status == 200
+            finally:
+                await runner.cleanup()
+                await up.stop()
+
+        asyncio.run(main())
