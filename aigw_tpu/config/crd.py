@@ -364,10 +364,8 @@ def _quotas_from_quota_policy(
             q = pm.get("quota") or {}
             live_targets = [t for t in targets
                             if (model, t) not in claimed]
-            claimed.update((model, t) for t in live_targets)
             if not live_targets:
                 continue  # a preceding policy owns this (model, backend)
-            key = cost_key(q.get("costExpression"))
             buckets: list[tuple[str, dict[str, Any], str]] = []
             db = q.get("defaultBucket") or {}
             if db.get("limit"):
@@ -379,6 +377,13 @@ def _quotas_from_quota_policy(
                 if brq.get("limit"):
                     buckets.append((f"bucket{j}", brq,
                                     client_header(br)))
+            if not buckets:
+                # a shadow-only / limit-less entry enforces nothing and
+                # must not claim the (model, backend) pair away from an
+                # alphabetically later policy with a real limit
+                continue
+            claimed.update((model, t) for t in live_targets)
+            key = cost_key(q.get("costExpression"))
             for label, bq, hdr in buckets:
                 for t in live_targets:
                     rule = {

@@ -417,6 +417,7 @@ def chat_completion_chunk(
     usage: TokenUsage | None = None,
     created: int = 0,
     logprobs: dict[str, Any] | None = None,
+    index: int = 0,  # choice index (n>1 streaming interleaves choices)
 ) -> dict[str, Any]:
     chunk: dict[str, Any] = {
         "id": response_id,
@@ -427,7 +428,7 @@ def chat_completion_chunk(
     }
     if delta is not None or finish_reason is not None:
         choice: dict[str, Any] = {
-            "index": 0,
+            "index": index,
             "delta": delta if delta is not None else {},
             "finish_reason": finish_reason,
         }
@@ -448,6 +449,7 @@ def stream_chunk_sse(
     finish_reason: str | None = None,
     usage: TokenUsage | None = None,
     logprobs: dict[str, Any] | None = None,
+    index: int = 0,
 ) -> bytes:
     """One chat.completion.chunk encoded as an SSE event — the shared
     emitter for every cross-schema streaming translator."""
@@ -463,6 +465,7 @@ def stream_chunk_sse(
                 usage=usage,
                 created=created,
                 logprobs=logprobs,
+                index=index,
             )
         )
     ).encode()

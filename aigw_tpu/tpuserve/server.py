@@ -385,11 +385,6 @@ class TPUServeServer:
                                 content_type="application/json")
         n = int(body.get("n") or 1)
         if n > 1:
-            if stream:
-                return web.Response(
-                    status=400,
-                    body=oai.error_body("n>1 with stream is not supported"),
-                    content_type="application/json")
             if n > self.engine.cfg.max_batch_size:
                 return web.Response(
                     status=400,
@@ -397,6 +392,9 @@ class TPUServeServer:
                         f"n={n} exceeds max_batch_size "
                         f"{self.engine.cfg.max_batch_size}"),
                     content_type="application/json")
+            if stream:
+                return await self._generate_n_stream(
+                    request, body, prompt, chat, n, lp_top_n)
             return await self._generate_n(body, prompt, chat, n,
                                           lp_top_n)
         include_usage = oai.include_stream_usage(body)
@@ -615,17 +613,14 @@ class TPUServeServer:
         await resp.write_eof()
         return resp
 
-    async def _generate_n(
-        self, body: dict[str, Any], prompt: list[int], chat: bool, n: int,
-        lp_top_n: int = -1,
-    ) -> web.Response:
-        """n>1 choices: fan out n engine requests (continuous batching
-        runs them concurrently — same prompt pages shared by the prefix
-        cache) and assemble a multi-choice response."""
-        stops = body.get("stop")
-        stop_strs = [stops] if isinstance(stops, str) else list(stops or [])
+    def _submit_n(self, body: dict[str, Any], prompt: list[int], n: int,
+                  lp_top_n: int):
+        """Fan out n engine submissions with per-choice seeds (shared by
+        the buffered and streaming n>1 paths — one copy of the seed
+        derivation, overload cleanup, and error mapping). Returns the
+        list of (queue, request) pairs, or an error web.Response."""
         sampling = SamplingParams.from_request(body)
-        outs = []
+        outs: list = []
         try:
             for i in range(n):
                 # distinct seeds per choice so samples differ
@@ -643,6 +638,32 @@ class TPUServeServer:
                 body=oai.error_body(str(e), type_="rate_limit_error"),
                 headers={"retry-after": "1"},
                 content_type="application/json")
+        except oai.SchemaError as e:  # unknown adapter → 404, like n=1
+            for _q, req in outs:
+                req.cancelled.set()
+            return web.Response(
+                status=404,
+                body=oai.error_body(str(e), type_="model_not_found"),
+                content_type="application/json")
+        except ValueError as e:  # bad sampling params → 400, like n=1
+            for _q, req in outs:
+                req.cancelled.set()
+            return web.Response(status=400, body=oai.error_body(str(e)),
+                                content_type="application/json")
+        return outs
+
+    async def _generate_n(
+        self, body: dict[str, Any], prompt: list[int], chat: bool, n: int,
+        lp_top_n: int = -1,
+    ) -> web.Response:
+        """n>1 choices: fan out n engine requests (continuous batching
+        runs them concurrently — same prompt pages shared by the prefix
+        cache) and assemble a multi-choice response."""
+        stops = body.get("stop")
+        stop_strs = [stops] if isinstance(stops, str) else list(stops or [])
+        outs = self._submit_n(body, prompt, n, lp_top_n)
+        if isinstance(outs, web.Response):
+            return outs
         results = await asyncio.gather(
             *(self._collect(q, stop_strs, lp_top_n) for q, _req in outs)
         )
@@ -682,6 +703,167 @@ class TPUServeServer:
                 "usage": oai.usage_dict(usage),
             }
         return web.json_response(resp)
+
+    async def _generate_n_stream(
+        self, request: web.Request, body: dict[str, Any],
+        prompt: list[int], chat: bool, n: int, lp_top_n: int = -1,
+    ) -> web.StreamResponse:
+        """Streaming n>1 (OpenAI parity; previously 400): fan out n
+        engine requests, merge their token streams, and emit one SSE
+        chunk per (choice, burst) carrying that choice's index —
+        clients see the standard interleaved multi-choice stream. The
+        continuous-batching engine runs the choices concurrently; the
+        prefix cache shares their prompt pages."""
+        stops = body.get("stop")
+        stop_strs = [stops] if isinstance(stops, str) else list(stops or [])
+        include_usage = oai.include_stream_usage(body)
+        outs = self._submit_n(body, prompt, n, lp_top_n)
+        if isinstance(outs, web.Response):
+            return outs
+
+        rid = (f"chatcmpl-{uuid.uuid4().hex[:24]}" if chat
+               else f"cmpl-{uuid.uuid4().hex[:24]}")
+        created = int(time.time())
+        rm = RequestMetrics(
+            metrics=self.metrics,
+            operation="chat" if chat else "text_completion",
+            provider="tpuserve",
+            request_model=body.get("model", self.model_name),
+            response_model=self.model_name,
+        )
+        resp = web.StreamResponse(
+            status=200,
+            headers={"content-type": "text/event-stream",
+                     "cache-control": "no-cache"},
+        )
+        await resp.prepare(request)
+
+        merged: asyncio.Queue = asyncio.Queue()
+
+        async def pump(i: int, q: asyncio.Queue) -> None:
+            while True:
+                item = await q.get()
+                await merged.put((i, item))
+                if item[1] is not None:  # finish marker
+                    return
+
+        pumps = [asyncio.create_task(pump(i, q))
+                 for i, (q, _req) in enumerate(outs)]
+        decoders = [StreamingDecoder(self.tokenizer) for _ in range(n)]
+        emitted = [""] * n
+        counts = [0] * n
+        done = [False] * n
+        want_lp = lp_top_n >= 0
+
+        async def write_chunk(i: int, piece: str, lp_entries=None,
+                              finish: str | None = None) -> None:
+            if chat:
+                delta = {"content": piece} if finish is None else {}
+                await resp.write(oai.stream_chunk_sse(
+                    response_id=rid, model=self.model_name,
+                    created=created, delta=delta, index=i,
+                    finish_reason=finish,
+                    logprobs={"content": lp_entries}
+                    if lp_entries else None,
+                ))
+            else:
+                choice: dict[str, Any] = {"index": i, "text": piece,
+                                          "finish_reason": finish}
+                if lp_entries:
+                    choice["logprobs"] = self._legacy_logprobs(lp_entries)
+                await resp.write(SSEEvent(data=json.dumps({
+                    "id": rid, "object": "text_completion",
+                    "created": created, "model": self.model_name,
+                    "choices": [choice],
+                })).encode())
+
+        try:
+            if chat:
+                for i in range(n):
+                    await resp.write(oai.stream_chunk_sse(
+                        response_id=rid, model=self.model_name,
+                        created=created,
+                        delta={"role": "assistant", "content": ""},
+                        index=i,
+                    ))
+            while not all(done):
+                while True:
+                    try:
+                        first = await asyncio.wait_for(merged.get(),
+                                                       timeout=10.0)
+                        break
+                    except asyncio.TimeoutError:
+                        await resp.write(b": ping\n\n")
+                burst = [first]
+                while True:
+                    try:
+                        burst.append(merged.get_nowait())
+                    except asyncio.QueueEmpty:
+                        break
+                # coalesce per choice within the burst
+                pieces: dict[int, list[str]] = {}
+                lps: dict[int, list] = {}
+                fins: dict[int, str] = {}
+                for i, (tok, fin, lp) in burst:
+                    if done[i] or i in fins:
+                        # post-finish tokens in the same burst (e.g.
+                        # after a stop-string hit) must not count
+                        # toward usage — the n=1 path breaks there too
+                        continue
+                    if tok >= 0:
+                        counts[i] += 1
+                        rm.record_tokens_emitted(1)
+                        piece = decoders[i].push(tok)
+                        if want_lp and lp is not None:
+                            lps.setdefault(i, []).append(
+                                self._lp_entry(piece, lp, lp_top_n))
+                        if piece:
+                            emitted[i] += piece
+                            hit = _find_stop(emitted[i], stop_strs)
+                            if hit is not None:
+                                keep = hit - (len(emitted[i])
+                                              - len(piece))
+                                pieces.setdefault(i, []).append(
+                                    piece[:max(keep, 0)])
+                                fins[i] = "stop"
+                                outs[i][1].cancelled.set()
+                                continue
+                            pieces.setdefault(i, []).append(piece)
+                    if fin is not None and i not in fins:
+                        fins[i] = fin
+                        if fin != "error":
+                            tail = decoders[i].flush()
+                            if tail:
+                                pieces.setdefault(i, []).append(tail)
+                for i in sorted(set(pieces) | set(lps) | set(fins)):
+                    text = "".join(pieces.get(i, ()))
+                    if text or lps.get(i):
+                        await write_chunk(i, text, lps.get(i))
+                    if i in fins:
+                        done[i] = True
+                        await write_chunk(i, "", None,
+                                          finish=fins[i] or "stop")
+        except (asyncio.CancelledError, ConnectionResetError):
+            for _q, req in outs:
+                req.cancelled.set()
+            raise
+        finally:
+            for p in pumps:
+                p.cancel()
+        usage = TokenUsage(
+            input_tokens=len(prompt),
+            output_tokens=sum(counts),
+            total_tokens=len(prompt) + sum(counts),
+        )
+        rm.finish(usage)
+        if include_usage:
+            await resp.write(oai.stream_chunk_sse(
+                response_id=rid, model=self.model_name, created=created,
+                delta=None, usage=usage,
+            ))
+        await resp.write(SSEEvent(data="[DONE]").encode())
+        await resp.write_eof()
+        return resp
 
     async def _collect(
         self, out: asyncio.Queue, stop_strs: list[str],

@@ -538,15 +538,49 @@ class TestNChoices:
         )
         assert status == 400
 
-    def test_n_stream_rejected(self, tpuserve_url):
-        status, body, _ = asyncio.run(
-            _post(tpuserve_url, "/v1/chat/completions", {
+    def test_n_streaming_interleaves_choices(self, tpuserve_url):
+        """n>1 + stream (r5: OpenAI parity, previously 400): choices
+        stream interleaved with per-chunk indexes; each index gets its
+        own finish chunk; reassembled texts match the non-streaming
+        n>1 response (greedy, fixed seeds)."""
+        async def main():
+            payload = {
                 "model": "tiny-random",
-                "messages": [{"role": "user", "content": "x"}],
-                "n": 2, "stream": True,
-            })
-        )
-        assert status == 400
+                "messages": [{"role": "user", "content": "count"}],
+                "max_tokens": 6, "temperature": 0.0, "n": 2,
+                "stream": True,
+                "stream_options": {"include_usage": True},
+            }
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    tpuserve_url + "/v1/chat/completions", json=payload,
+                ) as resp:
+                    assert resp.status == 200
+                    raw = (await resp.read()).decode()
+            chunks = [json.loads(x[len("data: "):])
+                      for x in raw.split("\n\n")
+                      if x.startswith("data: ") and "[DONE]" not in x]
+            texts = {0: "", 1: ""}
+            finishes = {}
+            for c in chunks:
+                for ch in c.get("choices", []):
+                    i = ch["index"]
+                    texts[i] += (ch.get("delta") or {}).get(
+                        "content") or ""
+                    if ch.get("finish_reason"):
+                        finishes[i] = ch["finish_reason"]
+            assert set(finishes) == {0, 1}
+            assert any(c.get("usage") for c in chunks)
+            # parity with the non-streaming n>1 path
+            status, body, _ = await _post(
+                tpuserve_url, "/v1/chat/completions",
+                dict(payload, stream=False, stream_options=None))
+            assert status == 200
+            solid = json.loads(body)
+            for ch in solid["choices"]:
+                assert texts[ch["index"]] == ch["message"]["content"]
+
+        asyncio.run(main())
 
 
 def test_stop_finishes_pending_requests():
