@@ -150,8 +150,11 @@ def main(argv: list[str] | None = None) -> int:
     p_serve.add_argument("--sp-prefill-min-tokens", type=int, default=1024,
                          help="minimum prompt length routed through the "
                               "sequence-parallel prefill path")
-    p_serve.add_argument("--quantize", default="", choices=["", "int8"],
-                         help="weight-only quantization (W8A16)")
+    p_serve.add_argument("--quantize", default="",
+                         choices=["", "int8", "int4"],
+                         help="weight-only quantization: int8 (W8A16) "
+                              "or int4 (W4A16, group-128 scales — "
+                              "quarter the HBM weight traffic)")
     p_serve.add_argument("--prefill-chunk-tokens", type=int, default=0,
                          help="chunk prompts longer than this into "
                               "fixed-size prefill steps with decode "

@@ -117,13 +117,23 @@ def init_params(
 
 
 def _w(p: dict[str, jax.Array], key: str) -> jax.Array:
-    """Resolve a weight that may be stored bf16 or int8+scale (W8A16,
-    models/quant.py). The convert-and-scale sits on the matmul operand so
-    XLA fuses it; HBM traffic is the int8 bytes."""
+    """Resolve a weight that may be stored bf16, int8+per-channel scale
+    (W8A16), or int4+group scale (W4A16) — self-describing on q.dtype
+    (models/quant.py). The convert-and-scale sits on the matmul operand
+    so XLA fuses it; HBM traffic is the packed int8/int4 bytes."""
     q = p.get(key + ".q")
     if q is None:
         return p[key]
-    return q.astype(jnp.bfloat16) * p[key + ".scale"].astype(jnp.bfloat16)
+    scale = p[key + ".scale"]
+    if q.dtype == jnp.int4:
+        # group-wise scales along the input axis: scale [..., in/G, out]
+        *lead, n_in, n_out = q.shape
+        groups = scale.shape[-2]
+        wf = q.astype(jnp.bfloat16).reshape(
+            *lead, groups, n_in // groups, n_out)
+        wf = wf * scale.astype(jnp.bfloat16)[..., :, None, :]
+        return wf.reshape(*lead, n_in, n_out)
+    return q.astype(jnp.bfloat16) * scale.astype(jnp.bfloat16)
 
 
 def _embed_rows(p: dict[str, jax.Array], tokens: jax.Array) -> jax.Array:
@@ -186,8 +196,10 @@ def _matmul(p: dict[str, jax.Array], key: str, x: jax.Array) -> jax.Array:
     AIGW_PALLAS_QMATMUL=off — fall back to dequant-then-matmul via
     ``_w`` (XLA fuses the dequant as the matmul's producer)."""
     q = p.get(key + ".q")
-    if q is None or os.environ.get(
+    if q is None or q.dtype != jnp.int8 or os.environ.get(
             "AIGW_PALLAS_QMATMUL", "on").lower() in ("0", "false", "off"):
+        # int4 carries GROUP-wise scales the per-column W8A16 kernel
+        # would silently misapply — int4 always dequants via _w
         return x @ _w(p, key)
     from aigw_tpu.ops.pallas import qmatmul
 

@@ -65,7 +65,7 @@ class TPUServeServer:
         tp: int = 1,
         ep: int = 1,  # expert parallel (MoE families)
         sp: int = 1,  # sequence parallel (ring-attention long prefill)
-        quantize: str = "",  # "" | "int8" (W8A16; llama-family only)
+        quantize: str = "",  # "" | "int8" | "int4" (llama-family only)
         # name → adapter param dict (un-stacked [r,in]/[out,r] per target);
         # served when a request's model == "<base>:<adapter>" or the bare
         # adapter name
@@ -105,18 +105,20 @@ class TPUServeServer:
             logger.info(
                 "parallel serving: tp=%d ep=%d sp=%d over %s", tp, ep, sp,
                 [str(d) for d in mesh.devices.flat])
-        if quantize and quantize != "int8":
+        if quantize and quantize not in ("int8", "int4"):
             raise ValueError(f"unknown quantization {quantize!r}")
-        if quantize == "int8" and spec.family != "llama":
+        if quantize and spec.family != "llama":
             raise ValueError(
-                "int8 quantization currently supports the llama family"
+                "weight quantization currently supports the llama family"
             )
         params = self._load_params(spec)
-        if quantize == "int8":
+        if quantize:
             from aigw_tpu.models.quant import quantize_params
 
-            params = quantize_params(params, consume=True)
-            logger.info("weights quantized to int8 (W8A16)")
+            params = quantize_params(params, consume=True,
+                                     mode=quantize)
+            logger.info("weights quantized to %s (W%sA16)", quantize,
+                        quantize[-1])
         lora_params = None
         adapter_names: tuple[str, ...] = ()
         if lora_adapters:
