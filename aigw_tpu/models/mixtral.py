@@ -132,9 +132,14 @@ def moe_mlp(p: dict[str, jax.Array], i: int, x: jax.Array,
 
     xe = jnp.einsum("tec,td->ecd", dispatch, xt.astype(jnp.float32))
     xe = xe.astype(x.dtype)
-    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, p[f"l{i}.w_gate"]))
-    up = jnp.einsum("ecd,edf->ecf", xe, p[f"l{i}.w_up"])
-    ye = jnp.einsum("ecf,efd->ecd", gate * up, p[f"l{i}.w_down"])
+    # expert weights resolve through llama._w so W8A16/W4A16 params
+    # ([E, in, out] int8 per-channel / int4 group scales) dequantize at
+    # the einsum operand — XLA fuses it; HBM streams the packed bytes
+    gate = jax.nn.silu(jnp.einsum(
+        "ecd,edf->ecf", xe, llama._w(p, f"l{i}.w_gate")))
+    up = jnp.einsum("ecd,edf->ecf", xe, llama._w(p, f"l{i}.w_up"))
+    ye = jnp.einsum("ecf,efd->ecd", gate * up,
+                    llama._w(p, f"l{i}.w_down"))
     out = jnp.einsum("tec,ecd->td", combine, ye.astype(jnp.float32))
     return out.astype(x.dtype).reshape(B, S, D)
 

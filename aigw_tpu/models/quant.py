@@ -49,6 +49,22 @@ def _quantize_matrix(w: jax.Array, axis: int) -> tuple[jax.Array, jax.Array]:
     return q, scale.astype(jnp.float32)
 
 
+@jax.jit
+def _quantize_matrix_int8_channels(
+    w: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Symmetric int8 with one scale per output channel per LEADING
+    index: only the input axis (ndim-2) is reduced, so an [E, in, out]
+    expert stack gets per-expert scales [E, 1, out] — one outlier-heavy
+    expert must not coarsen every other expert's steps ([in, out]
+    matrices reduce to [1, out], identical to before)."""
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=w.ndim - 2, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
 @partial(jax.jit, static_argnames=("group",))
 def _quantize_matrix_int4(
     w: jax.Array, group: int,
@@ -89,14 +105,14 @@ def quantize_params(
             if mode == "int4" and w.shape[-2] % GROUP4 == 0:
                 q, scale = _quantize_matrix_int4(w, GROUP4)
             else:  # int8, or input dim not groupable
-                q, scale = _quantize_matrix(w, axis=w.ndim - 1)
+                q, scale = _quantize_matrix_int8_channels(w)
             out[name + ".q"] = q
             out[name + ".scale"] = scale
         elif name == "lm_head":
             if mode == "int4" and w.shape[0] % GROUP4 == 0:
                 q, scale = _quantize_matrix_int4(w, GROUP4)
             else:
-                q, scale = _quantize_matrix(w, axis=1)
+                q, scale = _quantize_matrix_int8_channels(w)
             out["lm_head.q"] = q
             out["lm_head.scale"] = scale
         elif name == "embed":

@@ -264,9 +264,20 @@ class Engine:
                 if key.endswith(".q"):
                     return specs[key[:-2]]
                 if key.endswith(".scale"):
+                    # int8: keepdims size-1 axes stay unsharded. int4:
+                    # group axes ([.., in/G, out]) shard like the base
+                    # only when divisible by the mesh axis — a group
+                    # count smaller than the axis replicates instead of
+                    # failing device_put
                     base = specs[key[: -len(".scale")]]
+
+                    def ok(i: int, ax) -> bool:
+                        if value.shape[i] <= 1 or ax is None:
+                            return False
+                        return value.shape[i] % mesh.shape[ax] == 0
+
                     return P(*(
-                        ax if value.shape[i] > 1 else None
+                        ax if ok(i, ax) else None
                         for i, ax in enumerate(base)
                     ))
                 return specs[key]

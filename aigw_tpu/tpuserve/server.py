@@ -107,9 +107,10 @@ class TPUServeServer:
                 [str(d) for d in mesh.devices.flat])
         if quantize and quantize not in ("int8", "int4"):
             raise ValueError(f"unknown quantization {quantize!r}")
-        if quantize and spec.family != "llama":
+        if quantize and spec.family not in ("llama", "mixtral"):
             raise ValueError(
-                "weight quantization currently supports the llama family"
+                "weight quantization supports the llama and mixtral "
+                "families"
             )
         params = self._load_params(spec)
         if quantize:
