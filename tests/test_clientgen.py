@@ -94,3 +94,52 @@ class TestClientsetAgainstAPIServer:
                 await api.stop()
 
         asyncio.run(main())
+
+
+class TestInformerResyncDelta:
+    def test_listener_replays_objects_created_during_watch_gap(
+            self, monkeypatch):
+        """client-go informers replay the delta after a watch drop; ours
+        must too (r5 review: the re-list path repopulated the cache
+        without firing listeners, silently desyncing informers)."""
+        async def main():
+            api = FakeAPIServer()
+            await api.start()
+            r1 = _route_obj("r1", "m1", "be")
+            api.objects[FakeAPIServer._key(r1)] = r1
+
+            events: list[tuple[str, str]] = []
+            calls = {"n": 0}
+            orig_watch = KubeClient.watch_resource
+
+            async def flaky_watch(self, kind, rv, cb):
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    # the stream drops; r2 is created during the gap —
+                    # only the re-list can surface it
+                    r2 = _route_obj("r2", "m2", "be")
+                    api.objects[FakeAPIServer._key(r2)] = r2
+                    raise RuntimeError("watch stream dropped")
+                return await orig_watch(self, kind, rv, cb)
+
+            monkeypatch.setattr(KubeClient, "watch_resource",
+                                flaky_watch)
+            source = KubeSource(KubeAuth(server=api.url),
+                                kinds=("AIGatewayRoute",))
+            source.add_listener(
+                lambda et, o: events.append(
+                    (et, (o.get("metadata") or {}).get("name", ""))))
+            source.start()
+            try:
+                assert await asyncio.to_thread(source.wait_synced, 30)
+                deadline = time.time() + 20
+                while time.time() < deadline and \
+                        ("ADDED", "r2") not in events:
+                    await asyncio.sleep(0.2)
+                assert ("ADDED", "r1") in events  # initial list
+                assert ("ADDED", "r2") in events, events  # resync delta
+            finally:
+                await asyncio.to_thread(source.stop)
+                await api.stop()
+
+        asyncio.run(main())

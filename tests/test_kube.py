@@ -664,3 +664,58 @@ class TestKubeValidateCLI:
         captured = capsys.readouterr()
         assert rc == 1
         assert "INVALID" in captured.err
+
+
+class TestQuotaPolicyKubeMode:
+    def test_quota_policy_compiles_and_gets_condition(self, tmp_path):
+        """QuotaPolicy is a watched kind (r5): applying one via the API
+        server lands its rules in the serving config and an Accepted
+        condition on the object's status."""
+
+        async def main():
+            api = FakeAPIServer()
+            await api.start()
+            for obj in _backend_objs("be", "127.0.0.1", 9):
+                api.objects[FakeAPIServer._key(obj)] = obj
+            qp = {
+                "apiVersion": "aigateway.envoyproxy.io/v1alpha1",
+                "kind": "QuotaPolicy",
+                "metadata": {"name": "q1", "namespace": "default",
+                             "generation": 1},
+                "spec": {
+                    "targetRefs": [{"kind": "AIServiceBackend",
+                                    "name": "be"}],
+                    "perModelQuotas": [{
+                        "modelName": "m1",
+                        "quota": {"defaultBucket": {
+                            "duration": "1h", "limit": 60}}}],
+                },
+            }
+            api.objects[FakeAPIServer._key(qp)] = qp
+
+            kubeconfig = _write_kubeconfig(tmp_path, api.url)
+            watcher = ConfigWatcher(f"kube:{kubeconfig}",
+                                    lambda rc: None, interval=0.2)
+            rc = await asyncio.to_thread(watcher.load_initial)
+            await watcher.start()
+            try:
+                limiter = rc.rate_limiter
+                assert limiter is not None
+                assert [r.name for r in limiter.rules] == [
+                    "q1/m1/default/be"]
+                assert limiter.rules[0].model == "m1"
+                deadline = time.time() + 15
+                conds = []
+                while time.time() < deadline:
+                    obj = api.objects.get(
+                        ("QuotaPolicy", "default", "q1"), {})
+                    conds = obj.get("status", {}).get("conditions", [])
+                    if conds:
+                        break
+                    await asyncio.sleep(0.2)
+                assert conds and conds[0]["status"] == "True", conds
+            finally:
+                await watcher.stop()
+                await api.stop()
+
+        asyncio.run(main())
