@@ -21,8 +21,62 @@ import signal
 import sys
 
 
+def _build_version() -> str:
+    """Package version, plus the git revision when running from THIS
+    repo's checkout — the reference stamps the same via the Go linker
+    (internal/version/version.go Current())."""
+    try:
+        from importlib.metadata import version as _pkg_version
+
+        base = _pkg_version("aigw-tpu")
+    except Exception:  # noqa: BLE001 — uninstalled checkout
+        base = "0.1.0"
+    try:
+        import subprocess
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        # only stamp when the repo containing the package IS this
+        # project (a venv nested in some unrelated checkout must not
+        # report that repo's revision as ours)
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"], cwd=root,
+            capture_output=True, text=True, timeout=2,
+        ).stdout.strip()
+        if not top or not os.path.isdir(os.path.join(top, "aigw_tpu")):
+            return base
+        rev = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=root,
+            capture_output=True, text=True, timeout=2,
+        ).stdout.strip()
+        dirty = subprocess.run(
+            ["git", "status", "--porcelain"], cwd=root,
+            capture_output=True, text=True, timeout=2,
+        ).stdout.strip()
+        if rev:
+            return f"{base} ({rev}{'-dirty' if dirty else ''})"
+    except Exception:  # noqa: BLE001 — no git / not a checkout
+        pass
+    return base
+
+
+class _VersionAction(argparse.Action):
+    """Lazy --version: the git stamp's subprocess calls must not tax
+    every other CLI invocation's startup."""
+
+    def __init__(self, option_strings, dest, **kwargs):
+        super().__init__(option_strings, dest, nargs=0, **kwargs)
+
+    def __call__(self, parser, namespace, values, option_string=None):
+        print(f"aigw-tpu {_build_version()}")
+        parser.exit(0)
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="aigw-tpu")
+    parser.add_argument(
+        "--version", action=_VersionAction,
+        help="print version (with git revision when run from a checkout; "
+             "the reference's internal/version linker stamp)")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
     p_run = sub.add_parser("run", help="run the gateway data plane")

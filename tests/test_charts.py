@@ -45,11 +45,7 @@ def _render(path: str, vals: dict) -> str:
                     text = yaml.safe_dump(cur).rstrip("\n")
                 pad = " " * int(im.group(1))
                 text = "\n".join(pad + ln for ln in text.splitlines())
-                if "nindent" not in tail:
-                    # helm `indent` pads every line and the action sits
-                    # at column 0 in the template — keep the first pad
-                    pass
-                else:
+                if "nindent" in tail:  # nindent = newline + indent
                     text = "\n" + text
             return text
         return str(_DEFAULTS.get(expr, "x"))

@@ -127,3 +127,19 @@ def test_runtime_config_build():
     assert set(rc.backends) == {"openai", "tpu"}
     assert rc.cost_calculator is not None
     assert rc.routes_for_host("anything.example.com")
+
+
+def test_cli_version_flag(capsys):
+    """--version (reference internal/version): package version plus git
+    revision when run from a checkout."""
+    import pytest as _pytest
+
+    from aigw_tpu.cli import main
+
+    import re as _re
+
+    with _pytest.raises(SystemExit) as exc:
+        main(["--version"])
+    assert exc.value.code == 0
+    out = capsys.readouterr().out
+    assert _re.match(r"aigw-tpu \d+\.\d+\.\d+", out), out
