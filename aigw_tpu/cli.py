@@ -104,6 +104,15 @@ def main(argv: list[str] | None = None) -> int:
              "replacement gateway process can bind the same port and "
              "take over before this one drains — the rolling zero-"
              "downtime upgrade path (tests/test_upgrade_e2e.py)")
+    p_run.add_argument(
+        "--mcp-config", default="",
+        help="Claude-Desktop-style mcpServers JSON file: http servers "
+             "route through the MCP proxy; stdio servers (command/args) "
+             "are spawned and bridged to Streamable HTTP automatically "
+             "(the reference's aigw run --mcp-config)")
+    p_run.add_argument(
+        "--mcp-json", default="",
+        help="same as --mcp-config but inline JSON")
 
     p_val = sub.add_parser("validate", help="validate a config file")
     p_val.add_argument("config")
@@ -490,6 +499,18 @@ def main(argv: list[str] | None = None) -> int:
 
         try:
             if getattr(args, "workers", 1) > 1:
+                if getattr(args, "mcp_config", "") or \
+                        getattr(args, "mcp_json", ""):
+                    # each worker would spawn its OWN copy of every
+                    # stdio server and SO_REUSEPORT would spray one MCP
+                    # session across divergent children — run the stdio
+                    # server once and point an http entry at it instead
+                    print("config error: --mcp-config/--mcp-json is "
+                          "incompatible with --workers > 1 (stateful "
+                          "stdio servers would be spawned per worker); "
+                          "bridge the server once and use an http url",
+                          file=sys.stderr)
+                    return 1
                 return _run_gateway_workers(args)
             return asyncio.run(_run_gateway(
                 args, reuse_port=getattr(args, "reuse_port", False)))
@@ -620,62 +641,120 @@ async def _run_gateway(args: argparse.Namespace,
         if server is not None:
             server.set_runtime(rc)
 
-    watcher = None
-    if args.config:
-        watcher = ConfigWatcher(args.config, on_reload,
-                                interval=args.watch_interval)
-        runtime = watcher.load_initial()
-    else:
-        from aigw_tpu.config.autoconfig import autoconfig_from_env
+    # --mcp-config / --mcp-json: canonical mcpServers JSON; stdio
+    # servers spawn + bridge to local Streamable HTTP first, then every
+    # server (http + bridged) merges into the MCP proxy's backends —
+    # re-applied on config reloads via the watcher transform
+    bridges: list = []
+    transform = None
+    mcp_text = ""
+    if getattr(args, "mcp_config", ""):
+        with open(os.path.expanduser(args.mcp_config),
+                  encoding="utf-8") as f:
+            mcp_text = f.read()
+    elif getattr(args, "mcp_json", ""):
+        mcp_text = args.mcp_json
+    if mcp_text:
+        import dataclasses
 
-        cfg = autoconfig_from_env()
-        print(f"autoconfig: {len(cfg.backends)} backend(s): "
-              f"{', '.join(b.name for b in cfg.backends)}", flush=True)
-        runtime = RuntimeConfig.build(cfg)
-    server, runner = await run_gateway(runtime, host=args.host,
-                                       port=args.port,
-                                       reuse_port=reuse_port)
-    holder["server"] = server
-    if watcher is not None:
-        server.conditions_fn = watcher.not_accepted
-        await watcher.start()
-    # native-core telemetry: when the C++ core's access log is shared
-    # with us (AIGW_CORE_ACCESS_LOG), tail it into real OTel spans and
-    # post-hoc CEL costs (obs/native_spans.py)
-    tailer = None
-    core_log = os.environ.get("AIGW_CORE_ACCESS_LOG", "")
-    if core_log:
-        from aigw_tpu.obs.native_spans import NativeLogTailer, make_cost_fn
+        from aigw_tpu.mcp.stdio_bridge import (
+            parse_mcp_servers,
+            start_bridges,
+        )
 
-        tailer = NativeLogTailer(
-            core_log, server.tracer,
-            cost_fn=make_cost_fn(
-                lambda: getattr(holder.get("server"), "_runtime", None),
-                getattr(server, "_cost_sink", None)))
-        tailer.start()
-        print(f"native-core telemetry: tailing {core_log}", flush=True)
-    print(f"gateway listening on http://{args.host}:{args.port}", flush=True)
-    await _wait_for_signal()
-    # Graceful drain (Envoy's listener-drain role in the reference's
-    # rolling upgrades): stop accepting first, then give connections the
-    # kernel had already handed us a grace window to deliver and finish
-    # their in-flight request before cleanup closes everything.
-    import os as _os
+        try:
+            http_backends, stdio_specs = parse_mcp_servers(mcp_text)
+            bridged_backends, bridges = await start_bridges(stdio_specs)
+        except ValueError as e:
+            print(f"config error: {e}", file=sys.stderr)
+            return 1
+        mcp_backends = http_backends + bridged_backends
+        print(f"mcp: {len(mcp_backends)} server(s): "
+              f"{', '.join(b['name'] for b in mcp_backends)}"
+              + (f" ({len(bridged_backends)} stdio-bridged)"
+                 if bridged_backends else ""),
+              flush=True)
 
-    for site in list(runner.sites):
-        await site.stop()
+        def transform(cfg):
+            mcp = dict(cfg.mcp or {})
+            existing = list(mcp.get("backends") or ())
+            have = {b.get("name") for b in existing}
+            mcp["backends"] = existing + [
+                b for b in mcp_backends if b["name"] not in have]
+            return dataclasses.replace(cfg, mcp=mcp)
+
     try:
-        drain = float(_os.environ.get("AIGW_DRAIN_SECONDS", "1.0"))
-    except ValueError:
-        drain = 1.0
-    if drain > 0:
-        await asyncio.sleep(drain)
-    if watcher is not None:
-        await watcher.stop()
-    if tailer is not None:
-        await asyncio.to_thread(tailer.stop)
-    await runner.cleanup()
-    return 0
+        watcher = None
+        if args.config:
+            watcher = ConfigWatcher(args.config, on_reload,
+                                    interval=args.watch_interval,
+                                    transform=transform)
+            runtime = watcher.load_initial()
+        else:
+            from aigw_tpu.config.autoconfig import autoconfig_from_env
+
+            cfg = autoconfig_from_env()
+            if transform is not None:
+                cfg = transform(cfg)
+            print(f"autoconfig: {len(cfg.backends)} backend(s): "
+                  f"{', '.join(b.name for b in cfg.backends)}", flush=True)
+            runtime = RuntimeConfig.build(cfg)
+        server, runner = await run_gateway(runtime, host=args.host,
+                                           port=args.port,
+                                           reuse_port=reuse_port)
+        holder["server"] = server
+        if watcher is not None:
+            server.conditions_fn = watcher.not_accepted
+            await watcher.start()
+        # native-core telemetry: when the C++ core's access log is
+        # shared with us (AIGW_CORE_ACCESS_LOG), tail it into real OTel
+        # spans and post-hoc CEL costs (obs/native_spans.py)
+        tailer = None
+        core_log = os.environ.get("AIGW_CORE_ACCESS_LOG", "")
+        if core_log:
+            from aigw_tpu.obs.native_spans import (
+                NativeLogTailer,
+                make_cost_fn,
+            )
+
+            tailer = NativeLogTailer(
+                core_log, server.tracer,
+                cost_fn=make_cost_fn(
+                    lambda: getattr(holder.get("server"), "_runtime",
+                                    None),
+                    getattr(server, "_cost_sink", None)))
+            tailer.start()
+            print(f"native-core telemetry: tailing {core_log}",
+                  flush=True)
+        print(f"gateway listening on http://{args.host}:{args.port}",
+              flush=True)
+        await _wait_for_signal()
+        # Graceful drain (Envoy's listener-drain role in the reference's
+        # rolling upgrades): stop accepting first, then give connections
+        # the kernel had already handed us a grace window to deliver and
+        # finish their in-flight request before cleanup closes
+        # everything.
+        import os as _os
+
+        for site in list(runner.sites):
+            await site.stop()
+        try:
+            drain = float(_os.environ.get("AIGW_DRAIN_SECONDS", "1.0"))
+        except ValueError:
+            drain = 1.0
+        if drain > 0:
+            await asyncio.sleep(drain)
+        if watcher is not None:
+            await watcher.stop()
+        if tailer is not None:
+            await asyncio.to_thread(tailer.stop)
+        await runner.cleanup()
+        return 0
+    finally:
+        # terminate stdio MCP children on EVERY exit path — a config
+        # error or failed bind must not orphan spawned servers
+        for bridge in bridges:
+            await bridge.stop()
 
 
 async def _run_tpuserve(args: argparse.Namespace) -> int:

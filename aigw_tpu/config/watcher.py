@@ -32,10 +32,15 @@ class ConfigWatcher:
         path: str,
         on_reload: ReloadCallback,
         interval: float = 5.0,
+        transform=None,
     ):
         self.path = path
         self.on_reload = on_reload
         self.interval = interval
+        #: optional Config → Config hook applied after every load —
+        #: config-file reloads must re-apply CLI-side merges (e.g. the
+        #: --mcp-config backends) or a touch of the YAML would drop them
+        self.transform = transform
         self._checksum = ""
         self._task: asyncio.Task | None = None
         self._current: RuntimeConfig | None = None
@@ -87,6 +92,8 @@ class ConfigWatcher:
         fail loudly, reloads must not — same split as the reference)."""
         cfg = self._load()
         self._checksum = cfg.checksum()
+        if self.transform is not None:
+            cfg = self.transform(cfg)
         rc = RuntimeConfig.build(cfg)
         self._current = rc
         self.on_reload(rc)
@@ -121,6 +128,8 @@ class ConfigWatcher:
                 checksum = cfg.checksum()
                 if checksum == self._checksum:
                     continue
+                if self.transform is not None:
+                    cfg = self.transform(cfg)
                 rc = RuntimeConfig.build(cfg, previous=self._current)
             except asyncio.CancelledError:
                 raise

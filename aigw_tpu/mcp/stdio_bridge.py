@@ -1,0 +1,323 @@
+"""Claude-Desktop-style ``mcpServers`` config + stdio→HTTP bridging.
+
+The reference's ``aigw run --mcp-config`` accepts the canonical MCP
+client configuration (the JSON format Claude Desktop / Cursor / VS Code
+use), including **stdio** servers (``command`` + ``args``): it spawns
+each process and fronts it with a Streamable-HTTP proxy, then routes
+the MCP gateway at the bridged URL
+(``cmd/aigw/stdio2http.go:proxyStdioMCPServers``,
+``internal/autoconfig/mcp.go:MCPServers``). This module is the
+TPU-native equivalent:
+
+- :func:`parse_mcp_servers` — canonical JSON → (http backend entries,
+  stdio specs)
+- :class:`StdioMCPBridge` — one child process whose newline-delimited
+  JSON-RPC stdio transport is exposed as a local Streamable-HTTP
+  endpoint: POST requests correlate on ``id``; notifications return
+  202; a GET stream relays server-initiated messages as SSE (the
+  reverse direction the MCP proxy already consumes).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from dataclasses import dataclass, field
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class StdioServerSpec:
+    name: str
+    command: str
+    args: tuple[str, ...] = ()
+    env: tuple[tuple[str, str], ...] = ()
+    include_tools: tuple[str, ...] = ()
+
+
+def parse_mcp_servers(
+    text: str,
+) -> tuple[list[dict[str, Any]], list[StdioServerSpec]]:
+    """Canonical ``{"mcpServers": {...}}`` JSON → (native MCP backend
+    dicts for http/streamable-http/sse servers, stdio specs to bridge).
+    Raises ValueError on malformed input — a typo'd MCP config must not
+    silently serve zero tools."""
+    try:
+        data = json.loads(text)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"invalid MCP config JSON: {e}") from None
+    servers = data.get("mcpServers")
+    if not isinstance(servers, dict):
+        raise ValueError('MCP config must carry an "mcpServers" object')
+    backends: list[dict[str, Any]] = []
+    stdio: list[StdioServerSpec] = []
+    for name, entry in servers.items():
+        if not isinstance(entry, dict):
+            raise ValueError(f"mcpServers.{name}: must be an object")
+        command = entry.get("command")
+        if command:
+            stdio.append(StdioServerSpec(
+                name=name,
+                command=str(command),
+                args=tuple(str(a) for a in entry.get("args") or ()),
+                env=tuple((str(k), str(v)) for k, v in
+                          (entry.get("env") or {}).items()),
+                include_tools=tuple(entry.get("includeTools") or ()),
+            ))
+            continue
+        url = entry.get("url")
+        if not url:
+            raise ValueError(
+                f"mcpServers.{name}: needs url (http) or command (stdio)")
+        backend: dict[str, Any] = {"name": name, "url": str(url)}
+        headers = entry.get("headers") or {}
+        if headers:
+            backend["headers"] = [
+                {"name": str(k), "value": str(v)}
+                for k, v in headers.items()
+            ]
+        include = entry.get("includeTools") or ()
+        if include:
+            backend["tool_filter"] = {
+                "include": [str(t) for t in include]}
+        backends.append(backend)
+    return backends, stdio
+
+
+@dataclass
+class _GetStream:
+    queue: "asyncio.Queue[bytes]" = field(default_factory=asyncio.Queue)
+
+
+class StdioMCPBridge:
+    """One stdio MCP child ⟷ local Streamable-HTTP endpoint."""
+
+    def __init__(self, spec: StdioServerSpec,
+                 request_timeout: float = 60.0):
+        self.spec = spec
+        self.request_timeout = request_timeout
+        self.url = ""
+        self._proc: asyncio.subprocess.Process | None = None
+        self._runner = None
+        # internal id → (original client id, future): client ids are
+        # rewritten before reaching the child, so concurrent sessions
+        # with colliding ids can't clobber each other's futures (and
+        # the child never sees duplicate JSON-RPC ids from us)
+        self._pending: dict[str, tuple[Any, asyncio.Future]] = {}
+        self._next_id = 0
+        self._streams: list[_GetStream] = []
+        self._reader_task: asyncio.Task | None = None
+        self._stderr_task: asyncio.Task | None = None
+        self._event_seq = 0
+        self._write_lock = asyncio.Lock()
+
+    async def start(self) -> str:
+        import os
+
+        from aiohttp import web
+
+        env = dict(os.environ)
+        env.update(dict(self.spec.env))
+        self._proc = await asyncio.create_subprocess_exec(
+            self.spec.command, *self.spec.args,
+            stdin=asyncio.subprocess.PIPE,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+            env=env,
+        )
+        self._reader_task = asyncio.create_task(self._read_loop())
+        self._stderr_task = asyncio.create_task(self._stderr_loop())
+
+        app = web.Application()
+        app.router.add_post("/mcp", self._post)
+        app.router.add_get("/mcp", self._get)
+        app.router.add_delete("/mcp", self._delete)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        self.url = f"http://127.0.0.1:{port}/mcp"
+        logger.info("stdio MCP server %r (%s) bridged at %s",
+                    self.spec.name, self.spec.command, self.url)
+        return self.url
+
+    async def stop(self) -> None:
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+        if self._stderr_task is not None:
+            self._stderr_task.cancel()
+        if self._proc is not None and self._proc.returncode is None:
+            self._proc.terminate()
+            try:
+                await asyncio.wait_for(self._proc.wait(), timeout=5)
+            except asyncio.TimeoutError:
+                self._proc.kill()
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # -- child I/O --------------------------------------------------------
+    async def _read_loop(self) -> None:
+        assert self._proc and self._proc.stdout
+        while True:
+            line = await self._proc.stdout.readline()
+            if not line:
+                # child exited: fail every pending request loudly
+                for _orig, fut in self._pending.values():
+                    if not fut.done():
+                        fut.set_exception(
+                            ConnectionError("stdio MCP server exited"))
+                self._pending.clear()
+                return
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line)
+            except json.JSONDecodeError:
+                logger.warning("stdio MCP %s: non-JSON line %r",
+                               self.spec.name, line[:200])
+                continue
+            mid = msg.get("id") if isinstance(msg, dict) else None
+            is_reply = (isinstance(msg, dict) and "method" not in msg
+                        and ("result" in msg or "error" in msg))
+            if is_reply and mid in self._pending:
+                orig_id, fut = self._pending.pop(mid)
+                if not fut.done():
+                    fut.set_result(dict(msg, id=orig_id))
+                continue
+            # server-initiated request/notification (the child's OWN id
+            # space — it must never pop our pending map) → subscribers
+            self._event_seq += 1
+            data = (f"id: {self._event_seq}\n"
+                    f"data: {json.dumps(msg)}\n\n").encode()
+            for s in list(self._streams):
+                s.queue.put_nowait(data)
+
+    async def _stderr_loop(self) -> None:
+        assert self._proc and self._proc.stderr
+        while True:
+            line = await self._proc.stderr.readline()
+            if not line:
+                return
+            logger.debug("stdio MCP %s stderr: %s", self.spec.name,
+                         line.decode(errors="replace").rstrip())
+
+    async def _send(self, msg: dict[str, Any]) -> None:
+        assert self._proc and self._proc.stdin
+        async with self._write_lock:
+            self._proc.stdin.write(json.dumps(msg).encode() + b"\n")
+            await self._proc.stdin.drain()
+
+    # -- HTTP surface -----------------------------------------------------
+    async def _post(self, request):
+        from aiohttp import web
+
+        try:
+            msg = json.loads(await request.read())
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"jsonrpc": "2.0", "id": None,
+                 "error": {"code": -32700, "message": "parse error"}},
+                status=400)
+        if self._proc is None or self._proc.returncode is not None:
+            return web.json_response(
+                {"jsonrpc": "2.0", "id": msg.get("id"),
+                 "error": {"code": -32000,
+                           "message": "stdio MCP server not running"}},
+                status=502)
+        mid = msg.get("id") if isinstance(msg, dict) else None
+        is_request = isinstance(msg, dict) and "method" in msg
+        if mid is None or not is_request:
+            # notification, or the CLIENT's response to a server-
+            # initiated request (id but no method — its id lives in the
+            # child's id space): forward verbatim, nothing to await
+            # (Streamable HTTP: 202)
+            await self._send(msg)
+            return web.Response(status=202)
+        # rewrite the id: concurrent sessions may reuse ids freely
+        self._next_id += 1
+        internal = f"aigwb{self._next_id}"
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[internal] = (mid, fut)
+        await self._send(dict(msg, id=internal))
+        try:
+            reply = await asyncio.wait_for(fut, self.request_timeout)
+        except asyncio.TimeoutError:
+            self._pending.pop(internal, None)
+            return web.json_response(
+                {"jsonrpc": "2.0", "id": mid,
+                 "error": {"code": -32000,
+                           "message": "stdio MCP server timed out"}},
+                status=504)
+        except ConnectionError as e:
+            return web.json_response(
+                {"jsonrpc": "2.0", "id": mid,
+                 "error": {"code": -32000, "message": str(e)}},
+                status=502)
+        headers = {}
+        if isinstance(msg, dict) and msg.get("method") == "initialize":
+            # Streamable HTTP servers assign sessions via this header;
+            # a stdio child is one session by nature, but the MCP proxy
+            # (and other clients) skip backends that never presented one
+            headers["mcp-session-id"] = f"stdio-{self.spec.name}"
+        return web.json_response(reply, headers=headers)
+
+    async def _delete(self, request):
+        # session teardown: the child IS the session; nothing to drop
+        from aiohttp import web
+
+        return web.Response(status=200)
+
+    async def _get(self, request):
+        from aiohttp import web
+
+        resp = web.StreamResponse(
+            status=200,
+            headers={"content-type": "text/event-stream",
+                     "cache-control": "no-cache"})
+        await resp.prepare(request)
+        stream = _GetStream()
+        self._streams.append(stream)
+        try:
+            while True:
+                try:
+                    data = await asyncio.wait_for(stream.queue.get(),
+                                                  timeout=15.0)
+                except asyncio.TimeoutError:
+                    await resp.write(b": ping\n\n")
+                    continue
+                await resp.write(data)
+        except (asyncio.CancelledError, ConnectionResetError):
+            raise
+        finally:
+            self._streams.remove(stream)
+
+
+async def start_bridges(
+    specs: list[StdioServerSpec],
+) -> tuple[list[dict[str, Any]], list[StdioMCPBridge]]:
+    """Spawn + bridge every stdio server; returns (native MCP backend
+    dicts pointing at the bridges, the bridges for shutdown)."""
+    backends: list[dict[str, Any]] = []
+    bridges: list[StdioMCPBridge] = []
+    for spec in specs:
+        bridge = StdioMCPBridge(spec)
+        try:
+            url = await bridge.start()
+        except OSError as e:  # bad command etc: no orphaned siblings
+            for b in bridges:
+                await b.stop()
+            raise ValueError(
+                f"mcpServers.{spec.name}: cannot start "
+                f"{spec.command!r}: {e}") from None
+        bridges.append(bridge)
+        backend: dict[str, Any] = {"name": spec.name, "url": url}
+        if spec.include_tools:
+            backend["tool_filter"] = {
+                "include": list(spec.include_tools)}
+        backends.append(backend)
+    return backends, bridges

@@ -1,0 +1,59 @@
+"""Tiny stdio MCP server fixture: newline-delimited JSON-RPC over
+stdin/stdout (the transport Claude Desktop spawns). Serves initialize,
+tools/list (one `echo` tool), tools/call, ping; emits one
+notifications/message after initialize so bridge GET-stream relaying is
+observable. Run: python tests/stdio_mcp_server.py"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def send(msg: dict) -> None:
+    sys.stdout.write(json.dumps(msg) + "\n")
+    sys.stdout.flush()
+
+
+def main() -> None:
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        msg = json.loads(line)
+        method = msg.get("method", "")
+        mid = msg.get("id")
+        if method == "initialize":
+            send({"jsonrpc": "2.0", "id": mid, "result": {
+                "protocolVersion": msg.get("params", {}).get(
+                    "protocolVersion", "2025-06-18"),
+                "capabilities": {"tools": {}},
+                "serverInfo": {"name": "stdio-fixture",
+                               "version": "1.0"},
+            }})
+        elif method == "notifications/initialized":
+            # server-initiated notification: the bridge must relay it
+            # to GET subscribers
+            send({"jsonrpc": "2.0",
+                  "method": "notifications/message",
+                  "params": {"level": "info", "data": "hello-from-stdio"}})
+        elif method == "tools/list":
+            send({"jsonrpc": "2.0", "id": mid, "result": {"tools": [{
+                "name": "echo",
+                "description": "echo back the input",
+                "inputSchema": {"type": "object", "properties": {
+                    "text": {"type": "string"}}},
+            }]}})
+        elif method == "tools/call":
+            text = (msg.get("params", {}).get("arguments", {})
+                    .get("text", ""))
+            send({"jsonrpc": "2.0", "id": mid, "result": {
+                "content": [{"type": "text", "text": f"echo: {text}"}],
+                "isError": False,
+            }})
+        elif mid is not None:  # ping & friends
+            send({"jsonrpc": "2.0", "id": mid, "result": {}})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,222 @@
+"""--mcp-config: canonical mcpServers JSON + stdio→HTTP bridging
+(reference cmd/aigw/stdio2http.go + internal/autoconfig/mcp.go). The
+bridge spawns the child and fronts its newline-delimited JSON-RPC stdio
+transport as Streamable HTTP; the composed test routes the real MCP
+proxy at a bridged stdio server and calls its tool end to end."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+
+import aiohttp
+import pytest
+
+from aigw_tpu.mcp.stdio_bridge import (
+    StdioMCPBridge,
+    StdioServerSpec,
+    parse_mcp_servers,
+    start_bridges,
+)
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "stdio_mcp_server.py")
+
+
+class TestParse:
+    def test_http_and_stdio_split(self):
+        text = json.dumps({"mcpServers": {
+            "github": {
+                "type": "http",
+                "url": "https://api.githubcopilot.com/mcp/",
+                "headers": {"Authorization": "Bearer x"},
+                "includeTools": ["search_repositories"],
+            },
+            "local": {
+                "command": "python",
+                "args": ["server.py"],
+                "env": {"DEBUG": "1"},
+            },
+        }})
+        backends, stdio = parse_mcp_servers(text)
+        assert backends == [{
+            "name": "github",
+            "url": "https://api.githubcopilot.com/mcp/",
+            "headers": [{"name": "Authorization", "value": "Bearer x"}],
+            "tool_filter": {"include": ["search_repositories"]},
+        }]
+        assert stdio == [StdioServerSpec(
+            name="local", command="python", args=("server.py",),
+            env=(("DEBUG", "1"),))]
+
+    def test_malformed_rejected(self):
+        with pytest.raises(ValueError, match="mcpServers"):
+            parse_mcp_servers("{}")
+        with pytest.raises(ValueError, match="invalid MCP config"):
+            parse_mcp_servers("nope")
+        with pytest.raises(ValueError, match="url .* or command"):
+            parse_mcp_servers('{"mcpServers": {"x": {}}}')
+
+
+class TestBridge:
+    def test_request_response_and_notification_stream(self):
+        async def main():
+            bridge = StdioMCPBridge(StdioServerSpec(
+                name="fix", command=sys.executable, args=(FIXTURE,)))
+            url = await bridge.start()
+            try:
+                async with aiohttp.ClientSession() as s:
+                    # GET stream first so the post-initialize
+                    # notification is observable
+                    stream_got = asyncio.Queue()
+
+                    async def consume():
+                        async with s.get(url) as resp:
+                            assert resp.status == 200
+                            while True:
+                                line = await resp.content.readline()
+                                if not line:
+                                    return
+                                line = line.strip()
+                                if line.startswith(b"data: "):
+                                    stream_got.put_nowait(
+                                        json.loads(line[6:]))
+
+                    task = asyncio.create_task(consume())
+                    await asyncio.sleep(0.2)
+
+                    async with s.post(url, json={
+                        "jsonrpc": "2.0", "id": 1,
+                        "method": "initialize",
+                        "params": {"protocolVersion": "2025-06-18",
+                                   "capabilities": {}},
+                    }) as r:
+                        assert r.status == 200
+                        body = await r.json()
+                    assert body["result"]["serverInfo"][
+                        "name"] == "stdio-fixture"
+
+                    # notification → 202, triggers the fixture's
+                    # server-side notification onto the GET stream
+                    async with s.post(url, json={
+                        "jsonrpc": "2.0",
+                        "method": "notifications/initialized",
+                    }) as r:
+                        assert r.status == 202
+
+                    ev = await asyncio.wait_for(stream_got.get(),
+                                                timeout=10)
+                    assert ev["method"] == "notifications/message"
+                    assert ev["params"]["data"] == "hello-from-stdio"
+
+                    async with s.post(url, json={
+                        "jsonrpc": "2.0", "id": 2,
+                        "method": "tools/call",
+                        "params": {"name": "echo",
+                                   "arguments": {"text": "hi"}},
+                    }) as r:
+                        body = await r.json()
+                    assert body["result"]["content"][0][
+                        "text"] == "echo: hi"
+                    task.cancel()
+            finally:
+                await bridge.stop()
+
+        asyncio.run(main())
+
+    def test_child_exit_fails_pending_cleanly(self):
+        async def main():
+            bridge = StdioMCPBridge(StdioServerSpec(
+                name="dead", command=sys.executable,
+                args=("-c", "pass")), request_timeout=5)
+            url = await bridge.start()
+            try:
+                await asyncio.sleep(0.5)  # child exits immediately
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(url, json={
+                        "jsonrpc": "2.0", "id": 1, "method": "ping",
+                    }) as r:
+                        assert r.status == 502
+                        body = await r.json()
+                    assert "not running" in body["error"]["message"] \
+                        or "exited" in body["error"]["message"]
+            finally:
+                await bridge.stop()
+
+        asyncio.run(main())
+
+
+class TestComposedWithProxy:
+    def test_mcp_proxy_routes_bridged_stdio_tool(self):
+        """The real MCP proxy fronting a bridged stdio server: tools
+        list shows the stdio tool (prefixed per backend) and calling it
+        round-trips through child stdin/stdout."""
+        from aiohttp import web
+
+        from aigw_tpu.mcp import MCPConfig, MCPProxy
+
+        async def main():
+            specs = [StdioServerSpec(name="fix", command=sys.executable,
+                                     args=(FIXTURE,))]
+            backends, bridges = await start_bridges(specs)
+            proxy = MCPProxy(MCPConfig.parse({"backends": backends}))
+            app = web.Application()
+            proxy.register(app)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            url = f"http://127.0.0.1:{port}/mcp"
+            try:
+                async with aiohttp.ClientSession() as s:
+                    headers = {"accept": "application/json, "
+                                         "text/event-stream",
+                               "content-type": "application/json"}
+                    async with s.post(url, headers=headers, json={
+                        "jsonrpc": "2.0", "id": 1,
+                        "method": "initialize",
+                        "params": {"protocolVersion": "2025-06-18",
+                                   "capabilities": {},
+                                   "clientInfo": {"name": "t",
+                                                  "version": "0"}},
+                    }) as r:
+                        assert r.status == 200
+                        sid = r.headers.get("mcp-session-id", "")
+                    if sid:
+                        headers["mcp-session-id"] = sid
+                    async with s.post(url, headers=headers, json={
+                        "jsonrpc": "2.0", "id": 2,
+                        "method": "tools/list",
+                    }) as r:
+                        assert r.status == 200
+                        text = await r.text()
+                    body = json.loads(text.split("data: ", 1)[-1]
+                                      .split("\n")[0]) \
+                        if text.startswith("event:") or \
+                        text.startswith("data:") else json.loads(text)
+                    tools = [t["name"] for t in
+                             body["result"]["tools"]]
+                    assert any("echo" in t for t in tools), tools
+                    tool_name = next(t for t in tools if "echo" in t)
+                    async with s.post(url, headers=headers, json={
+                        "jsonrpc": "2.0", "id": 3,
+                        "method": "tools/call",
+                        "params": {"name": tool_name,
+                                   "arguments": {"text": "via-proxy"}},
+                    }) as r:
+                        assert r.status == 200
+                        text = await r.text()
+                    body = json.loads(text.split("data: ", 1)[-1]
+                                      .split("\n")[0]) \
+                        if text.startswith("event:") or \
+                        text.startswith("data:") else json.loads(text)
+                    assert body["result"]["content"][0][
+                        "text"] == "echo: via-proxy"
+            finally:
+                await runner.cleanup()
+                for b in bridges:
+                    await b.stop()
+
+        asyncio.run(main())
