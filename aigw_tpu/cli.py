@@ -218,12 +218,30 @@ def main(argv: list[str] | None = None) -> int:
                          help="weight-only quantization: int8 (W8A16) "
                               "or int4 (W4A16, group-128 scales — "
                               "quarter the HBM weight traffic)")
-    p_serve.add_argument("--prefill-chunk-tokens", type=int, default=0,
+    p_serve.add_argument("--prefill-chunk-tokens", type=int, default=256,
                          help="chunk prompts longer than this into "
                               "fixed-size prefill steps with decode "
-                              "ticks interleaved (0 = off)")
+                              "ticks interleaved (0 = off; default on "
+                              "so long prompts never stall live "
+                              "decodes)")
     p_serve.add_argument("--decode-steps-per-tick", type=int, default=8,
-                         help="fused decode steps per host round-trip")
+                         help="fused decode steps per host round-trip "
+                              "(the adaptive window's MAX; it shrinks "
+                              "to 1/4 of this under queue pressure)")
+    p_serve.add_argument("--no-adaptive-window", action="store_true",
+                         help="pin the decode window at "
+                              "--decode-steps-per-tick instead of "
+                              "adapting it to queue pressure")
+    p_serve.add_argument("--sync-transfers", action="store_true",
+                         help="fetch decode-window tokens with a "
+                              "blocking device_get at drain time "
+                              "instead of an async copy issued at "
+                              "dispatch (debug/A-B knob)")
+    p_serve.add_argument("--warm-prefill-buckets", type=int, default=0,
+                         help="pre-compile batched-prefill programs "
+                              "for the N smallest prompt buckets at "
+                              "startup (all group sizes) so a traffic "
+                              "burst never pays an XLA compile")
     p_serve.add_argument("--logprobs", type=int, default=0,
                          help="enable per-token logprobs: max "
                               "top_logprobs servable per request "
@@ -790,6 +808,9 @@ async def _run_tpuserve(args: argparse.Namespace) -> int:
         spec_tokens=args.spec_tokens,
         pallas_attn=args.pallas_attn,
         logprobs_topk=args.logprobs,
+        adaptive_decode_window=not args.no_adaptive_window,
+        async_transfers=not args.sync_transfers,
+        warm_prefill_buckets=args.warm_prefill_buckets,
     )
     print(f"tpuserve listening on http://{args.host}:{args.port}", flush=True)
     await _wait_for_signal()

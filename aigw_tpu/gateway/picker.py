@@ -10,6 +10,11 @@ aigw_tpu/tpuserve/server.py) and scores endpoints:
     score = kv_occupancy                     (HBM pressure)
           + queued / max_slots               (waiting work)
           + active_slots / max_slots * 0.5   (decode batch load)
+          + queue_wait_ms / 1000             (queue latency: seconds the
+                                              oldest request has waited —
+                                              a replica whose queue MOVES
+                                              beats one the same depth
+                                              stuck behind a long prefill)
 
 Session affinity (``x-aigw-session-affinity``, or derived from the
 conversation head by the gateway) is per-endpoint STICKY: the session
@@ -57,6 +62,7 @@ class EndpointState:
     queued: int = 0
     active_slots: int = 0
     max_slots: int = 1
+    queue_wait_ms: float = 0.0  # age of the oldest queued request
     updated_at: float = 0.0
 
 
@@ -121,18 +127,20 @@ class EndpointPicker:
         st.queued = int(data.get("queued", 0))
         st.active_slots = int(data.get("active_slots", 0))
         st.max_slots = max(1, int(data.get("max_slots", 1)))
+        st.queue_wait_ms = float(data.get("queue_wait_ms", 0.0))
         st.updated_at = time.monotonic()
 
     # -- manual state injection (tests / push-based telemetry) ------------
     def observe(self, address: str, *, kv_occupancy: float = 0.0,
                 queued: int = 0, active_slots: int = 0,
-                max_slots: int = 1) -> None:
+                max_slots: int = 1, queue_wait_ms: float = 0.0) -> None:
         st = self.state[address]
         st.healthy = True
         st.kv_occupancy = kv_occupancy
         st.queued = queued
         st.active_slots = active_slots
         st.max_slots = max(1, max_slots)
+        st.queue_wait_ms = queue_wait_ms
         st.updated_at = time.monotonic()
 
     # -- picking ----------------------------------------------------------
@@ -157,6 +165,7 @@ class EndpointPicker:
                 st.kv_occupancy
                 + st.queued / st.max_slots
                 + 0.5 * st.active_slots / st.max_slots
+                + st.queue_wait_ms / 1000.0
             )
 
         scores = {e.address: score_of(e) for e in self.endpoints}

@@ -10,10 +10,59 @@ from __future__ import annotations
 
 import base64
 import hashlib
+import hmac as _hmac
 import os
 
-from cryptography.exceptions import InvalidTag
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.exceptions import InvalidTag
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ModuleNotFoundError:  # pragma: no cover - environment-dependent
+    # ``cryptography`` is a real dependency (pyproject), but minimal
+    # images may lack the wheel. The session-ID tokens are produced AND
+    # consumed only by gateway replicas sharing the same seed, so a
+    # stdlib-only AEAD with the same interface keeps the feature alive:
+    # SHA256-counter keystream XOR + truncated HMAC-SHA256 tag
+    # (encrypt-then-MAC). NOT wire-compatible with the AES-GCM tokens —
+    # a mixed fleet must install ``cryptography`` everywhere.
+    class InvalidTag(Exception):  # type: ignore[no-redef]
+        pass
+
+    class AESGCM:  # type: ignore[no-redef]
+        """Drop-in stand-in for cryptography's AESGCM (see above)."""
+
+        def __init__(self, key: bytes):
+            self._key = key
+
+        def _stream(self, nonce: bytes, n: int) -> bytes:
+            out = bytearray()
+            ctr = 0
+            while len(out) < n:
+                out += hashlib.sha256(
+                    self._key + nonce + ctr.to_bytes(8, "big")
+                ).digest()
+                ctr += 1
+            return bytes(out[:n])
+
+        def _tag(self, nonce: bytes, ct: bytes) -> bytes:
+            return _hmac.new(
+                self._key, b"tag" + nonce + ct, hashlib.sha256
+            ).digest()[:16]
+
+        def encrypt(self, nonce: bytes, data: bytes, _aad) -> bytes:
+            ct = bytes(a ^ b
+                       for a, b in zip(data, self._stream(nonce,
+                                                          len(data))))
+            return ct + self._tag(nonce, ct)
+
+        def decrypt(self, nonce: bytes, data: bytes, _aad) -> bytes:
+            if len(data) < 16:
+                raise InvalidTag()
+            ct, tag = data[:-16], data[-16:]
+            if not _hmac.compare_digest(tag, self._tag(nonce, ct)):
+                raise InvalidTag()
+            return bytes(a ^ b
+                         for a, b in zip(ct, self._stream(nonce,
+                                                          len(ct))))
 
 _PBKDF2_ITERS = 100_000
 _SALT = b"aigw-tpu-mcp-session"

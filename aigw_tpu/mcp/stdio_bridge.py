@@ -87,9 +87,17 @@ def parse_mcp_servers(
     return backends, stdio
 
 
+#: per-subscriber fan-out buffer depth: a GET stream that stops reading
+#: must not grow an unbounded queue inside the gateway — beyond this it
+#: is dropped (it can reconnect; SSE ids make the gap visible)
+_STREAM_QUEUE_MAX = 256
+
+
 @dataclass
 class _GetStream:
-    queue: "asyncio.Queue[bytes]" = field(default_factory=asyncio.Queue)
+    queue: "asyncio.Queue[bytes]" = field(
+        default_factory=lambda: asyncio.Queue(maxsize=_STREAM_QUEUE_MAX))
+    dropped: bool = False
 
 
 class StdioMCPBridge:
@@ -195,7 +203,18 @@ class StdioMCPBridge:
             data = (f"id: {self._event_seq}\n"
                     f"data: {json.dumps(msg)}\n\n").encode()
             for s in list(self._streams):
-                s.queue.put_nowait(data)
+                try:
+                    s.queue.put_nowait(data)
+                except asyncio.QueueFull:
+                    # subscriber fell behind: drop IT, not the bridge —
+                    # its handler notices on the next ping tick
+                    s.dropped = True
+                    if s in self._streams:
+                        self._streams.remove(s)
+                    logger.warning(
+                        "stdio MCP %s: dropping slow GET subscriber "
+                        "(%d events buffered)", self.spec.name,
+                        s.queue.qsize())
 
     async def _stderr_loop(self) -> None:
         assert self._proc and self._proc.stderr
@@ -283,7 +302,7 @@ class StdioMCPBridge:
         stream = _GetStream()
         self._streams.append(stream)
         try:
-            while True:
+            while not stream.dropped:
                 try:
                     data = await asyncio.wait_for(stream.queue.get(),
                                                   timeout=15.0)
@@ -294,7 +313,9 @@ class StdioMCPBridge:
         except (asyncio.CancelledError, ConnectionResetError):
             raise
         finally:
-            self._streams.remove(stream)
+            if stream in self._streams:
+                self._streams.remove(stream)
+        return resp
 
 
 async def start_bridges(
@@ -308,9 +329,18 @@ async def start_bridges(
         bridge = StdioMCPBridge(spec)
         try:
             url = await bridge.start()
-        except OSError as e:  # bad command etc: no orphaned siblings
-            for b in bridges:
-                await b.stop()
+        except Exception as e:
+            # Covers both spawn failures (bad command → OSError) and
+            # POST-SPAWN failures (HTTP site setup etc.): the failing
+            # bridge's own child process and reader tasks must be torn
+            # down too, or they orphan — stop() is idempotent on the
+            # half-started pieces. No orphaned siblings either way.
+            for b in (*bridges, bridge):
+                try:
+                    await b.stop()
+                except Exception:  # teardown must not mask the cause
+                    logger.exception("stopping bridge %r after start "
+                                     "failure", b.spec.name)
             raise ValueError(
                 f"mcpServers.{spec.name}: cannot start "
                 f"{spec.command!r}: {e}") from None

@@ -79,6 +79,46 @@ class GenAIMetrics:
         return generate_latest(self.registry)
 
 
+#: EngineStats attribute → Prometheus gauge name. One authoritative map
+#: so tpuserve's /metrics, dashboards, and tests agree on the exported
+#: serving-path surface — including the adaptive decode window
+#: (tpuserve_decode_window_steps: the K most recently dispatched, with
+#: shrink/grow transition counters) and the phase breakdown
+#: (prefill/transfer/emit milliseconds) behind TTFT regressions.
+ENGINE_GAUGES: tuple[tuple[str, str], ...] = (
+    ("active_slots", "tpuserve_active_slots"),
+    ("queued", "tpuserve_queued_requests"),
+    ("queue_wait_ms", "tpuserve_queue_wait_ms"),
+    ("kv_pages_free", "tpuserve_kv_pages_free"),
+    ("kv_occupancy", "tpuserve_kv_occupancy"),
+    ("tokens_generated", "tpuserve_tokens_generated_total"),
+    ("prefills", "tpuserve_prefills_total"),
+    ("sp_prefills", "tpuserve_sp_prefills_total"),
+    ("chunked_prefill_steps", "tpuserve_chunked_prefill_steps_total"),
+    ("decode_steps", "tpuserve_decode_steps_total"),
+    ("decode_window", "tpuserve_decode_window_steps"),
+    ("window_shrinks", "tpuserve_decode_window_shrinks_total"),
+    ("window_grows", "tpuserve_decode_window_grows_total"),
+    ("spec_accepted", "tpuserve_spec_accepted_total"),
+    ("prefix_cache_hits", "tpuserve_prefix_cache_hits_total"),
+    ("prefix_tokens_reused", "tpuserve_prefix_tokens_reused_total"),
+    ("prefill_ms", "tpuserve_prefill_ms_total"),
+    ("transfer_ms", "tpuserve_transfer_ms_total"),
+    ("emit_ms", "tpuserve_emit_ms_total"),
+)
+
+
+def render_engine_gauges(stats: object) -> bytes:
+    """EngineStats → Prometheus text exposition (appended to the
+    prometheus_client registry output on tpuserve's /metrics)."""
+    lines = []
+    for attr, name in ENGINE_GAUGES:
+        value = getattr(stats, attr, 0)
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {value}")
+    return ("\n".join(lines) + "\n").encode()
+
+
 class MCPMetrics:
     """MCP proxy instruments (reference internal/metrics/mcp_metrics.go:
     ``mcp.request.duration`` / ``mcp.method.count`` /

@@ -26,6 +26,17 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+# jax.shard_map stabilized late (0.4.3x still exposes only the
+# experimental path); resolve once so either jax works
+_shard_map = getattr(jax, "shard_map", None)
+if _shard_map is None:  # pragma: no cover - version-dependent
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# lax.pvary types carries as varying over manual axes — a check the new
+# shard_map enforces and the experimental one doesn't have: identity
+# fallback on old jax
+_pvary = getattr(jax.lax, "pvary", lambda x, _axes: x)
+
 
 def _ring_attention_local(
     q: jax.Array,  # [B, S_loc, H, D] — this device's query shard
@@ -76,11 +87,11 @@ def _ring_attention_local(
 
     # pvary: accumulators must be typed as varying over the ring axis or
     # scan rejects the carry (shard_map's varying-manual-axes check)
-    acc0 = jax.lax.pvary(jnp.zeros((B, S, Hkv, group, D), jnp.float32),
+    acc0 = _pvary(jnp.zeros((B, S, Hkv, group, D), jnp.float32),
                          (axis,))
-    m0 = jax.lax.pvary(jnp.full((B, Hkv, group, S), -1e30, jnp.float32),
+    m0 = _pvary(jnp.full((B, Hkv, group, S), -1e30, jnp.float32),
                        (axis,))
-    l0 = jax.lax.pvary(jnp.zeros((B, Hkv, group, S), jnp.float32), (axis,))
+    l0 = _pvary(jnp.zeros((B, Hkv, group, S), jnp.float32), (axis,))
     (acc, m, l, _, _), _ = jax.lax.scan(
         step, (acc0, m0, l0, k, v), jnp.arange(n)
     )
@@ -154,7 +165,7 @@ def ring_attention(
         _ring_attention_local if strategy == "ring"
         else _ulysses_attention_local
     )
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(local, axis=axis, causal=causal),
         mesh=mesh,
         in_specs=(

@@ -25,6 +25,17 @@ from jax.sharding import Mesh, PartitionSpec as P
 from aigw_tpu.models import llama
 from aigw_tpu.models.llama import LlamaConfig
 
+# jax.shard_map stabilized late (0.4.3x still exposes only the
+# experimental path); resolve once so either jax works
+_shard_map = getattr(jax, "shard_map", None)
+if _shard_map is None:  # pragma: no cover - version-dependent
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# lax.pvary types carries as varying over manual axes — a check the new
+# shard_map enforces and the experimental one doesn't have: identity
+# fallback on old jax
+_pvary = getattr(jax.lax, "pvary", lambda x, _axes: x)
+
 _STAGE_KEYS = (
     "attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
     "w_gate", "w_up", "w_down",
@@ -128,10 +139,10 @@ def pipeline_logits(
             )
             return (received, outputs), None
 
-        received0 = jax.lax.pvary(
+        received0 = _pvary(
             jnp.zeros((microbatch, S, D), embed.dtype), ("pp",)
         )
-        outputs0 = jax.lax.pvary(
+        outputs0 = _pvary(
             jnp.zeros((M, microbatch, S, V), jnp.float32), ("pp",)
         )
         (_, outputs), _ = lax.scan(
@@ -139,7 +150,7 @@ def pipeline_logits(
         )
         return outputs[None]  # [1, M, mb, S, V] — this stage's view
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         local,
         mesh=mesh,
         in_specs=(

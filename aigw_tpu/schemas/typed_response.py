@@ -40,8 +40,13 @@ _USAGE = Field(type="object", spec=Spec(fields={
     "total_tokens": Field(type="integer", ge=0),
 }))
 
-_FINISH = Field(type="string", enum=(
-    "stop", "length", "tool_calls", "content_filter", "function_call"))
+# finish_reason: typed as a string, NOT an enum. OpenAI-compatible
+# upstreams legitimately emit values beyond the canonical five
+# ("recitation", "error", "safety", vendor extensions …); rejecting
+# them 502'd valid non-stream bodies and aborted live SSE streams
+# (advisor finding, round 5). Shape is enforced; the value set is the
+# upstream's — same forward-compat posture as unknown fields.
+_FINISH = Field(type="string")
 
 _TOOL_CALL = Field(type="object", spec=Spec(fields={
     "id": Field(type="string"),
@@ -100,7 +105,10 @@ CHAT_CHUNK = Spec(fields={
     "choices": Field(type="array", required=True, item=Field(
         type="object", spec=Spec(fields={
             "index": Field(type="integer", ge=0),
-            "delta": Field(type="object", required=True, spec=Spec(
+            # optional: some upstreams send a final finish_reason-only
+            # chunk with no delta at all — that chunk must not kill the
+            # stream (advisor finding, round 5)
+            "delta": Field(type="object", spec=Spec(
                 fields={
                     "role": Field(type="string"),
                     "content": Field(type="string"),

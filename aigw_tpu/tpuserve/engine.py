@@ -83,7 +83,40 @@ class EngineConfig:
     # prefill_suffix steps with a decode tick between chunks — bounding
     # both the largest compiled bucket and how long active streams
     # stall behind a long prompt. 0 disables (whole-prompt prefill).
-    prefill_chunk_tokens: int = 0
+    # Default ON: a long prompt must never stall in-flight decodes for
+    # its whole prefill (model families without prefill_suffix fall
+    # back to whole-prompt prefill automatically).
+    prefill_chunk_tokens: int = 256
+    # Adaptive decode windows: shrink the per-tick window to
+    # min_decode_steps_per_tick while the admission queue is non-empty
+    # or a stream just started (TTFT-/admission-latency-sensitive), and
+    # regrow to decode_steps_per_tick once the batch is steady
+    # (throughput-sensitive). Each window size is its own compiled
+    # program; the ladder is {min, max} so at most two decode programs
+    # exist per page bucket.
+    adaptive_decode_window: bool = True
+    # Small window used under pressure. 0 = auto: max(1, K // 4).
+    min_decode_steps_per_tick: int = 0
+    # Async device→host token transfers: the sampled-token fetch for a
+    # decode window is started at dispatch time (copy_to_host_async)
+    # and resolved at drain time, so the copy overlaps the next
+    # on-device window instead of blocking the engine thread. False
+    # restores the blocking device_get at drain — token streams are
+    # byte-identical either way (tests/test_serving_overlap.py).
+    async_transfers: bool = True
+    # Idle-burst coalescing: when the engine is COMPLETELY idle and a
+    # request arrives, wait this long for the rest of its burst before
+    # admitting, so B near-simultaneous arrivals prefill as ONE batched
+    # [G, S] call instead of a 1+(B-1) split (a burst's submits span a
+    # few ms of event-loop scheduling). Busy engines never wait —
+    # arrivals already coalesce between decode windows. 0 disables.
+    admission_coalesce_ms: float = 3.0
+    # Pre-compile the batched-prefill programs for the N smallest
+    # prompt buckets at warmup (all power-of-two group sizes up to
+    # max_batch_size): a traffic burst must not pay an XLA prefill
+    # compile for a group shape the warm traffic happened not to hit.
+    # 0 = off (each (group, bucket) shape compiles on first use).
+    warm_prefill_buckets: int = 0
     # Prompt-lookup speculative decoding: number of draft tokens verified
     # per decode step (0 = off). Each step verifies 1+spec_tokens
     # positions in one fixed-shape program and advances by the accepted
@@ -106,6 +139,14 @@ class EngineConfig:
         if self.logprobs_topk > 0 and self.spec_tokens > 0:
             raise ValueError(
                 "logprobs_topk and spec_tokens are mutually exclusive")
+        if self.min_decode_steps_per_tick == 0:
+            self.min_decode_steps_per_tick = max(
+                1, self.decode_steps_per_tick // 4)
+        if self.min_decode_steps_per_tick > self.decode_steps_per_tick:
+            raise ValueError(
+                f"min_decode_steps_per_tick "
+                f"({self.min_decode_steps_per_tick}) exceeds "
+                f"decode_steps_per_tick ({self.decode_steps_per_tick})")
         if self.max_seq_len % self.page_size != 0:
             raise ValueError(
                 f"max_seq_len ({self.max_seq_len}) must be a multiple of "
@@ -155,9 +196,6 @@ class _Slot:
     pending_token: int = 0
     limit: int = 0  # exclusive max write position (page-safety fence)
     page_row: np.ndarray | None = None
-    # becomes True when the slot has been included in a dispatched device
-    # state; windows dispatched earlier don't carry its tokens
-    started: bool = False
     # generated-token histogram (repetition penalties survive state
     # rebuilds across admissions)
     token_counts: dict[int, int] = field(default_factory=dict)
@@ -183,6 +221,38 @@ class EngineStats:
     decode_steps: int = 0
     prefix_cache_hits: int = 0
     prefix_tokens_reused: int = 0
+    # adaptive decode window: the K chosen for the most recent dispatch
+    # and how often the policy moved it (obs/metrics.py exports these)
+    decode_window: int = 0
+    window_shrinks: int = 0
+    window_grows: int = 0
+    # serving-path phase breakdown (cumulative milliseconds):
+    # prefill_ms = host time blocked on prefill device calls,
+    # transfer_ms = host time blocked fetching window tokens,
+    # emit_ms = host time distributing tokens to consumers
+    prefill_ms: float = 0.0
+    transfer_ms: float = 0.0
+    emit_ms: float = 0.0
+    # age of the oldest queued request (picker queue-latency signal)
+    queue_wait_ms: float = 0.0
+
+
+@dataclass
+class _Window:
+    """One dispatched decode window: the on-device sampled tokens plus
+    everything the host needs to settle it at drain time."""
+
+    sampled: Any  # jax array / tuple of arrays (logprobs, speculation)
+    # (slot index, request) pairs the window computes for — slots
+    # admitted after dispatch are not in here, so their rows' junk
+    # samples are never emitted; a (i, req) pair whose slot has been
+    # freed (or re-admitted to a new request) since dispatch is skipped
+    members: tuple[tuple[int, GenRequest], ...]
+    k: int  # window length actually dispatched
+    # sequence ids whose pages become safe to recycle once this window
+    # completes (every window dispatched while they were active has
+    # then finished — nothing on device can still write their pages)
+    frees: list[int]
 
 
 class Engine:
@@ -296,13 +366,28 @@ class Engine:
         # only when membership/sampling changes) — the decode hot loop
         # transfers just the sampled [K, B] tokens per round-trip.
         self._device_state: dict[str, jax.Array] | None = None
-        self._state_dirty = True
+        # Incremental device-state maintenance: membership changes mark
+        # individual rows dirty and are scattered into the live state
+        # with a tiny jitted row update — no pipeline drain, no full
+        # [B, V] re-upload. A full rebuild happens only when the page
+        # bucket grows, under speculation (on-device history), or on
+        # first use.
+        self._dirty_rows: set[int] = set()
+        self._need_rebuild = True
+        self._state_bucket = 0  # page bucket the live state was built at
+        self._row_update_fn = None
         # 1-deep pipeline: the window dispatched to the device while the
         # host processes the previous window's tokens.
-        self._inflight: jax.Array | None = None
-        # pages owned by finished sequences are recycled only after the
-        # in-flight window completes (it may still write into them).
+        self._inflight: _Window | None = None
+        # pages owned by finished sequences are recycled only after
+        # every window dispatched while they were active completes (an
+        # in-flight window may still write into them). Frees discovered
+        # here are captured by the NEXT dispatch and applied when that
+        # window drains.
         self._pending_frees: list[int] = []
+        # adaptive decode window state
+        self._cur_window = cfg.decode_steps_per_tick
+        self._steady_ticks = 0
 
         mc, ps = model_cfg, cfg.page_size
         K = cfg.decode_steps_per_tick
@@ -367,12 +452,14 @@ class Engine:
             self._prefill_sp_fn = jax.jit(_prefill_sp_step,
                                           donate_argnums=(4,))
 
-        def _decode_scan(params, lora, kv, state):
-            """K fused decode+sample steps; sampled tokens feed forward
-            on-device (no host round-trip inside the window)."""
+        def _decode_scan(k: int):
+            """Factory: k fused decode+sample steps; sampled tokens feed
+            forward on-device (no host round-trip inside the window).
+            Each window length is one compiled program (the adaptive
+            ladder is {min, max} so at most two exist per bucket)."""
             lp_k = cfg.logprobs_topk
 
-            def body(carry, _):
+            def body(params, lora, carry):
                 kv, st = carry
                 act = st["active"] & (st["positions"] < st["limits"])
                 logits, kv = model_decode(
@@ -410,10 +497,14 @@ class Engine:
                     return (kv, new), (sampled, chosen, tk_ids, tk_vals)
                 return (kv, new), sampled
 
-            (kv, state), sampled = jax.lax.scan(
-                body, (kv, state), None, length=K
-            )
-            return sampled, state, kv
+            def scan_k(params, lora, kv, state):
+                (kv, state), sampled = jax.lax.scan(
+                    lambda c, _: body(params, lora, c),
+                    (kv, state), None, length=k
+                )
+                return sampled, state, kv
+
+            return scan_k
 
         # prompt-lookup speculation (tpuserve/speculation.py): replaces
         # the [B, 1] decode step with a [B, D+1] verify step that advances
@@ -429,9 +520,10 @@ class Engine:
         V = model_cfg.vocab_size
         H = cfg.max_seq_len
 
-        def _spec_scan(params, lora, kv, state):
-            """K speculative steps; outputs (sampled [K, B, D+1],
-            n_emit [K, B]) — the host emits sampled[k, b, :n_emit[k, b]]."""
+        def _spec_scan(k_steps: int):
+            """Factory: k speculative steps; outputs (sampled
+            [k, B, D+1], n_emit [k, B]) — the host emits
+            sampled[k, b, :n_emit[k, b]]."""
             from aigw_tpu.tpuserve.speculation import (
                 accept_counts,
                 ngram_drafts,
@@ -439,7 +531,7 @@ class Engine:
 
             D1 = D + 1
 
-            def body(carry, _):
+            def body(params, lora, carry):
                 kv, st = carry
                 act = st["active"] & (st["positions"] < st["limits"])
                 # penalty slots advance exactly one token per step (see
@@ -508,16 +600,67 @@ class Engine:
                 )
                 return (kv, new), (sampled, n_emit)
 
-            (kv, state), out = jax.lax.scan(body, (kv, state), None,
-                                            length=K)
-            return out, state, kv
+            def scan_k(params, lora, kv, state):
+                (kv, state), out = jax.lax.scan(
+                    lambda c, _: body(params, lora, c),
+                    (kv, state), None, length=k_steps)
+                return out, state, kv
+
+            return scan_k
 
         self._prefill_fn = jax.jit(_prefill_step, donate_argnums=(4,))
         self._prefill_suffix_fn = jax.jit(_prefill_suffix_step,
                                           donate_argnums=(5,))
-        self._decode_fn = jax.jit(
-            _spec_scan if self._spec else _decode_scan, donate_argnums=(2, 3)
+        self._decode_scan_factory = (
+            _spec_scan if self._spec else _decode_scan
         )
+        self._decode_fns: dict[int, Callable] = {}
+
+    def _decode_fn_for(self, k: int):
+        """Jitted decode program for window length k (cached; jit itself
+        caches per page-bucket shape)."""
+        fn = self._decode_fns.get(k)
+        if fn is None:
+            fn = jax.jit(self._decode_scan_factory(k),
+                         donate_argnums=(2, 3))
+            self._decode_fns[k] = fn
+        return fn
+
+    def _window_ladder(self) -> list[int]:
+        """Window sizes the adaptive policy may dispatch."""
+        K = self.cfg.decode_steps_per_tick
+        if not self.cfg.adaptive_decode_window:
+            return [K]
+        kmin = min(self.cfg.min_decode_steps_per_tick, K)
+        return [K] if kmin == K else [kmin, K]
+
+    def _choose_window(self) -> int:
+        """Adaptive decode window: shrink to the small program while
+        latency matters (requests waiting for admission, or a stream so
+        young its first decode burst hasn't landed), regrow to the full
+        throughput window after two consecutive steady ticks."""
+        K = self.cfg.decode_steps_per_tick
+        ladder = self._window_ladder()
+        if len(ladder) == 1:
+            self.stats.decode_window = K
+            return K
+        kmin = ladder[0]
+        pressured = self._queue.qsize() > 0 or any(
+            s is not None and s.generated <= 1 for s in self._slots
+        )
+        if pressured:
+            self._steady_ticks = 0
+            chosen = kmin
+        else:
+            self._steady_ticks += 1
+            chosen = K if self._steady_ticks >= 2 else self._cur_window
+        if chosen < self._cur_window:
+            self.stats.window_shrinks += 1
+        elif chosen > self._cur_window:
+            self.stats.window_grows += 1
+        self._cur_window = chosen
+        self.stats.decode_window = chosen
+        return chosen
 
     # -- public API -------------------------------------------------------
     def start(self) -> None:
@@ -549,12 +692,44 @@ class Engine:
         self._wake.set()
 
     def warmup(self) -> None:
-        """Compile the decode program before traffic arrives (the first
-        request then only pays the prefill compile for its bucket)."""
-        state = self._build_device_state()
-        _, _, self.kv_cache = self._decode_fn(
-            self.params, self.lora_params, self.kv_cache, state
-        )
+        """Compile every decode-window program in the adaptive ladder —
+        and, with warm_prefill_buckets > 0, the batched-prefill group
+        shapes for the smallest prompt buckets — before traffic arrives
+        (the first burst then pays zero XLA compiles)."""
+        for k in self._window_ladder():
+            state = self._build_device_state()
+            _, _, self.kv_cache = self._decode_fn_for(k)(
+                self.params, self.lora_params, self.kv_cache, state
+            )
+        for b in range(self.cfg.warm_prefill_buckets):
+            S = self.cfg.min_prefill_bucket << b
+            if S > self.cfg.max_seq_len:
+                break
+            self._warm_prefill_shapes(S)
+
+    def _warm_prefill_shapes(self, S: int) -> None:
+        """Run the prefill program for every power-of-two group size at
+        prompt bucket S with all-zero seq_lens: padded-row semantics
+        drop every K/V scatter, so nothing is written — the call exists
+        only to populate the jit cache for that shape."""
+        V = self.model_cfg.vocab_size
+        P = self.cfg.max_pages_per_seq
+        G2 = 1
+        while G2 <= self.cfg.max_batch_size:
+            _, self.kv_cache = self._prefill_fn(
+                self.params, self.lora_params,
+                jnp.zeros((G2, S), jnp.int32),
+                jnp.zeros((G2,), jnp.int32),
+                self.kv_cache,
+                jnp.zeros((G2, P), jnp.int32),
+                jnp.zeros((G2, 2), jnp.uint32),
+                jnp.zeros((G2,), jnp.float32),
+                jnp.ones((G2,), jnp.float32),
+                jnp.zeros((G2,), jnp.int32),
+                jnp.zeros((G2, V), jnp.float32),
+                jnp.full((G2,), self._base_row, jnp.int32),
+            )
+            G2 *= 2
 
     # -- engine loop ------------------------------------------------------
     def _run(self) -> None:
@@ -588,8 +763,14 @@ class Engine:
         logger.info("engine loop stopped")
 
     def _abort_all(self, reason: str) -> None:
-        self._inflight = None
+        if self._inflight is not None:
+            # the in-flight window's captured frees must not leak pages
+            self._pending_frees.extend(self._inflight.frees)
+            self._inflight = None
         self._apply_frees()
+        self._device_state = None
+        self._need_rebuild = True
+        self._dirty_rows.clear()
         for i, s in enumerate(self._slots):
             if s is not None:
                 s.req.emit(-1, "error")
@@ -607,7 +788,7 @@ class Engine:
             if s is not None and s.req.cancelled.is_set():
                 self._pending_frees.append(s.req.id)
                 self._slots[i] = None
-                self._state_dirty = True
+                self._dirty_rows.add(i)
 
     def _free_slot_index(self) -> int | None:
         for i, s in enumerate(self._slots):
@@ -639,6 +820,20 @@ class Engine:
                 pass
             if not pending:
                 break
+            if (self.cfg.admission_coalesce_ms > 0
+                    and len(pending) < free
+                    and self._inflight is None
+                    and all(s is None for s in self._slots)):
+                # completely idle + partial burst: a batch of concurrent
+                # arrivals spans a few ms of event-loop scheduling —
+                # wait once so the whole burst prefills as ONE batched
+                # call instead of a 1+(B-1) split
+                time.sleep(self.cfg.admission_coalesce_ms / 1e3)
+                try:
+                    while len(pending) < free:
+                        pending.append(self._queue.get_nowait())
+                except queue.Empty:
+                    pass
             # Classify once (prompt hashes computed here are reused all
             # the way to the post-prefill cache insert), then admit in
             # STRICT arrival order: contiguous runs of ≥2 simple requests
@@ -812,6 +1007,7 @@ class Engine:
             lp_data = (np.asarray(chosen), np.asarray(tk_ids),
                        np.asarray(tk_vals))
         toks = np.asarray(next_tok)
+        self.stats.prefill_ms += 1e3 * (time.monotonic() - t0)
         for g, (req, seq_id, n, total) in enumerate(items):
             slot_idx = self._free_slot_index()
             assert slot_idx is not None  # len(items) <= free slots
@@ -833,11 +1029,23 @@ class Engine:
                 limit=total, page_row=pt[g], adapter_row=int(adapter[g]),
             )
             self.stats.prefills += 1
+            self._mark_admitted(slot_idx)
             self._emit_token(slot_idx, int(toks[g]), first_lp)
-        self._state_dirty = True
         logger.debug("batched prefill G=%d S=%d %.1fms", G, S,
                      1e3 * (time.monotonic() - t0))
         return len(items)
+
+    def _mark_admitted(self, i: int) -> None:
+        """Mark slot i for an incremental row upload into the live
+        device state. Falls back to a full rebuild when the decode page
+        bucket must grow (new compiled shape) or under speculation (the
+        on-device history buffer has no row-update path)."""
+        self._dirty_rows.add(i)
+        if self._spec:
+            self._need_rebuild = True
+        elif (self._device_state is not None and not self._need_rebuild
+                and self._decode_bucket_pages() > self._state_bucket):
+            self._need_rebuild = True
 
     def _admit_one(self, req: GenRequest, chain: list | None = None) -> str:
         """Per-request admission (prefix-cache adoption, chunked and
@@ -916,6 +1124,7 @@ class Engine:
             jnp.asarray([adapter_row], jnp.int32),
         )
         t0 = time.monotonic()
+        tick_ms = 0.0  # decode time interleaved into the chunk loop
         # pow2 page bucket covering the sequence — the gather window
         # of suffix/chunked steps, not the full max_seq_len window
         need = self.allocator.pages_for(total)
@@ -959,7 +1168,11 @@ class Engine:
                 )
                 consumed += chunk
                 self.stats.chunked_prefill_steps += 1
+                # interleave: active streams keep decoding between
+                # chunks (their windows overlap this chunk's compute)
+                t_tick = time.monotonic()
                 self._decode_tick()
+                tick_ms += 1e3 * (time.monotonic() - t_tick)
             if aborted:
                 self.allocator.free(seq_id)
                 if self._stop.is_set():
@@ -1031,6 +1244,8 @@ class Engine:
             )
         tok = int(next_tok[0])
         self.stats.prefills += 1
+        self.stats.prefill_ms += max(
+            0.0, 1e3 * (time.monotonic() - t0) - tick_ms)
         if self.prefix_cache is not None and chain_keys:
             self.prefix_cache.insert(chain_keys, pages)
         logger.debug("prefill seq=%d len=%d prefix=%d bucket=%d %.1fms",
@@ -1044,8 +1259,8 @@ class Engine:
             key_seed=req.sampling.seed or seq_id,
             limit=total, page_row=pt[0], adapter_row=adapter_row,
         )
+        self._mark_admitted(slot_idx)
         self._emit_token(slot_idx, tok, first_lp)
-        self._state_dirty = True
         return "admitted"
 
     def _requeue_front_many(self, reqs: list[GenRequest]) -> None:
@@ -1077,10 +1292,12 @@ class Engine:
         return min(bucket, P)
 
     def _build_device_state(self) -> dict[str, jax.Array]:
-        """Upload per-slot state after membership changes (admission /
-        completion) — small arrays, uploaded rarely."""
+        """Upload the FULL per-slot state (first build, page-bucket
+        growth, speculation). Ordinary membership changes go through
+        the incremental row update in _apply_row_updates instead."""
         B = self.cfg.max_batch_size
         P = self._decode_bucket_pages()
+        self._state_bucket = P
         tokens = np.zeros((B,), np.int32)
         positions = np.zeros((B,), np.int32)
         limits = np.zeros((B,), np.int32)
@@ -1149,26 +1366,84 @@ class Engine:
             "adapter_idx": jnp.asarray(adapter_idx),
         }
 
-    def _process_window(self, sampled) -> None:
-        """Consume one decode window's sampled tokens (blocks until the
-        device finishes that window)."""
-        if self._spec:  # speculative window (sampled, n_emit)
-            self._process_spec_window(*sampled)
-            return
-        lp = None
-        if isinstance(sampled, tuple):  # logprobs window
-            sampled, chosen, tk_ids, tk_vals = sampled
-            lp = (np.asarray(chosen), np.asarray(tk_ids),
-                  np.asarray(tk_vals))
-        toks = np.asarray(sampled)  # [K, B]
+    def _row_host_values(self, i: int, P: int) -> dict[str, np.ndarray]:
+        """Host-side row i of the device state (cleared when the slot is
+        empty). Shapes/dtypes mirror _build_device_state exactly."""
+        V = self.model_cfg.vocab_size
+        s = self._slots[i]
+        row = {
+            "tokens": np.int32(0),
+            "positions": np.int32(0),
+            "limits": np.int32(0),
+            "active": np.bool_(False),
+            "page_table": np.zeros((P,), np.int32),
+            "keys": np.zeros((2,), np.uint32),
+            "temp": np.float32(1.0),
+            "top_p": np.float32(1.0),
+            "top_k": np.int32(0),
+            "freq_pen": np.float32(0.0),
+            "pres_pen": np.float32(0.0),
+            "counts": np.zeros((V,), np.int32),
+            "bias": np.zeros((V,), np.float32),
+            "adapter_idx": np.int32(self._base_row),
+        }
+        if s is None:
+            return row
+        row["tokens"] = np.int32(s.pending_token)
+        row["positions"] = np.int32(s.pos)
+        row["limits"] = np.int32(s.limit)
+        row["active"] = np.bool_(True)
+        row["page_table"] = np.asarray(s.page_row[:P], np.int32)
+        row["keys"] = np.array(
+            [s.key_seed & 0xFFFFFFFF, s.pos], np.uint32)
+        row["temp"] = np.float32(s.req.sampling.temperature)
+        row["top_p"] = np.float32(s.req.sampling.top_p)
+        row["top_k"] = np.int32(s.req.sampling.top_k)
+        row["freq_pen"] = np.float32(s.req.sampling.frequency_penalty)
+        row["pres_pen"] = np.float32(s.req.sampling.presence_penalty)
+        for tok_id, cnt in s.token_counts.items():
+            if 0 <= tok_id < V:
+                row["counts"][tok_id] = cnt
+        for tok_id, b in s.req.sampling.logit_bias:
+            if 0 <= tok_id < V:
+                row["bias"][tok_id] = b
+        row["adapter_idx"] = np.int32(s.adapter_row)
+        return row
+
+    def _apply_row_updates(self) -> None:
+        """Scatter dirty slot rows into the LIVE device state — no
+        pipeline drain, no full re-upload. JAX chains the update after
+        the in-flight window's scan, so admission/finish no longer
+        stalls the decode pipeline for a whole window."""
+        if self._row_update_fn is None:
+            def _upd(state, i, row):
+                return {
+                    k: (state[k].at[i].set(row[k]) if k in row
+                        else state[k])
+                    for k in state
+                }
+
+            self._row_update_fn = jax.jit(_upd, donate_argnums=(0,))
+        P = self._state_bucket
+        for i in sorted(self._dirty_rows):
+            self._device_state = self._row_update_fn(
+                self._device_state, np.int32(i),
+                self._row_host_values(i, P))
+        self._dirty_rows.clear()
+
+    def _process_window(self, toks: np.ndarray, lp,
+                        members: tuple) -> None:
+        """Distribute one decode window's host-side tokens. Only slots
+        that were members of the window at DISPATCH time (and still hold
+        the same request) receive tokens — rows admitted after dispatch
+        carry junk samples for this window and are skipped."""
         K = toks.shape[0]
         self.stats.decode_steps += K
         for k in range(K):
-            for i, s in enumerate(self._slots):
-                if s is None:
-                    continue  # free slot / finished earlier in this window
-                if not s.started:
-                    continue  # admitted after this window was dispatched
+            for i, req in members:
+                s = self._slots[i]
+                if s is None or s.req is not req:
+                    continue  # finished earlier in this window / re-used
                 step_lp = None
                 if lp is not None:
                     chosen, tk_ids, tk_vals = lp
@@ -1179,23 +1454,23 @@ class Engine:
                     )
                 self._emit_token(i, int(toks[k, i]), step_lp)
 
-    def _process_spec_window(self, sampled: jax.Array,
-                             n_emit: jax.Array) -> None:
+    def _process_spec_window(self, toks: np.ndarray, counts: np.ndarray,
+                             members: tuple) -> None:
         """Speculative window: sampled [K, B, D+1], n_emit [K, B] — the
         leading n_emit tokens of each row are model-exact; the rest are
         conditioned on rejected drafts and discarded."""
-        toks = np.asarray(sampled)
-        counts = np.asarray(n_emit)
         K = toks.shape[0]
         self.stats.decode_steps += K
         for k in range(K):
-            for i, s in enumerate(self._slots):
-                if s is None or not s.started:
+            for i, req in members:
+                s = self._slots[i]
+                if s is None or s.req is not req:
                     continue
                 n = int(counts[k, i])
                 emitted = 0
                 for d in range(n):
-                    if self._slots[i] is None:
+                    cur = self._slots[i]
+                    if cur is None or cur.req is not req:
                         break  # EOS/stop consumed the slot mid-burst
                     self._emit_token(i, int(toks[k, i, d]))
                     emitted += 1
@@ -1203,54 +1478,88 @@ class Engine:
                     self.stats.spec_accepted += emitted - 1
 
     def _drain_inflight(self) -> None:
-        if self._inflight is not None:
-            sampled, self._inflight = self._inflight, None
-            self._process_window(sampled)
+        """Settle the in-flight window: resolve its (already started,
+        under async_transfers) device→host copy, emit tokens, and apply
+        the page frees it was carrying."""
+        w, self._inflight = self._inflight, None
+        if w is None:
+            return
+        t0 = time.monotonic()
+        host = jax.tree_util.tree_map(np.asarray, w.sampled)
+        t1 = time.monotonic()
+        self.stats.transfer_ms += 1e3 * (t1 - t0)
+        if self._spec:
+            self._process_spec_window(host[0], host[1], w.members)
+        elif isinstance(host, tuple):  # logprobs window
+            toks, chosen, tk_ids, tk_vals = host
+            self._process_window(toks, (chosen, tk_ids, tk_vals),
+                                 w.members)
+        else:
+            self._process_window(host, None, w.members)
+        self.stats.emit_ms += 1e3 * (time.monotonic() - t1)
+        for seq_id in w.frees:
+            self.allocator.free(seq_id)
 
     def _apply_frees(self) -> None:
+        """Recycle pages of finished sequences. Only safe with NO window
+        in flight (callers drain first): an in-flight window dispatched
+        while the sequence was active may still write into its pages."""
+        assert self._inflight is None
         for seq_id in self._pending_frees:
             self.allocator.free(seq_id)
         self._pending_frees.clear()
 
     def _decode_tick(self) -> bool:
         """Pipelined: dispatch window N+1, then process window N while
-        the device runs. State changes (admission/finish) force a drain so
-        the device never decodes against stale page tables."""
-        if self._state_dirty:
-            # finish the window computed under the old state first
-            self._drain_inflight()
-            self._apply_frees()
-            if self._state_dirty:
-                for s in self._slots:
-                    if s is not None:
-                        s.started = True
-                self._device_state = self._build_device_state()
-                self._state_dirty = False
-
+        the device runs. Membership changes are scattered into the live
+        device state as row updates (chained asynchronously after the
+        in-flight window), so admissions and completions no longer drain
+        the pipeline; only page-bucket growth / speculation force a full
+        drain + state rebuild."""
         active_idx = [i for i, s in enumerate(self._slots) if s is not None]
         if not active_idx:
             self._drain_inflight()
             self._apply_frees()
+            # quiesced: drop the state so the next admission rebuilds it
+            # right-sized (free here — nothing in flight — and an
+            # oversized page bucket a departed long sequence forced is
+            # released instead of taxing the next batch's gathers)
+            self._device_state = None
+            self._dirty_rows.clear()
             self.stats.active_slots = 0
             self._refresh_stats()
             return False
 
+        if self._need_rebuild or self._device_state is None:
+            # finish the window computed under the old state first
+            self._drain_inflight()
+            self._apply_frees()
+            self._device_state = self._build_device_state()
+            self._need_rebuild = False
+            self._dirty_rows.clear()
+        elif self._dirty_rows:
+            self._apply_row_updates()
+
         if self._inflight is not None:
-            # Zombie-window guard: when every active slot reaches its
+            # Zombie-window guard: when every member slot reaches its
             # token limit within the window already in flight, another
             # dispatch would compute K junk steps against slots that are
             # all about to finish — junk that delays the next admission
             # by a full window (and burns K chip-steps per batch drain).
-            # Drain instead; the loop admits or re-dispatches right after.
-            # Conservative under speculation (slots may finish even
-            # sooner than +K; the guard then fires one window later).
-            K = self.cfg.decode_steps_per_tick
+            # Drain instead; the loop admits or re-dispatches right
+            # after. Slots admitted after the in-flight dispatch are not
+            # advanced by it, so they block the guard (they need a
+            # dispatch). Conservative under speculation (slots may
+            # finish even sooner than +K; the guard then fires one
+            # window later).
+            K = self._inflight.k
+            in_window = {i: req for i, req in self._inflight.members}
             if all(
                 s is None
-                or (s.started
+                or (in_window.get(i) is s.req
                     and (s.generated + K >= s.req.max_tokens
                          or s.pos + K >= min(s.limit, self.cfg.max_seq_len)))
-                for s in self._slots
+                for i, s in enumerate(self._slots)
             ):
                 self._drain_inflight()
                 self._apply_frees()
@@ -1259,12 +1568,25 @@ class Engine:
                 self._refresh_stats()
                 return True
 
-        sampled, self._device_state, self.kv_cache = self._decode_fn(
+        k = self._choose_window()
+        members = tuple(
+            (i, self._slots[i].req) for i in active_idx
+        )
+        frees, self._pending_frees = self._pending_frees, []
+        sampled, self._device_state, self.kv_cache = self._decode_fn_for(k)(
             self.params, self.lora_params, self.kv_cache, self._device_state
         )
+        if self.cfg.async_transfers:
+            # start the device→host token copy now; it overlaps this
+            # window's on-device compute and is resolved at drain time
+            for leaf in jax.tree_util.tree_leaves(sampled):
+                copy = getattr(leaf, "copy_to_host_async", None)
+                if copy is not None:
+                    copy()
         # process the PREVIOUS window while this one runs on-device
         self._drain_inflight()
-        self._inflight = sampled
+        self._inflight = _Window(sampled=sampled, members=members, k=k,
+                                 frees=frees)
         self.stats.active_slots = sum(s is not None for s in self._slots)
         self._refresh_stats()
         return True
@@ -1300,7 +1622,7 @@ class Engine:
         if finish is not None:
             self._pending_frees.append(req.id)
             self._slots[i] = None
-            self._state_dirty = True
+            self._dirty_rows.add(i)
             self._wake.set()  # maybe admit a queued request
         else:
             # the sampled token is the input of the next decode step
@@ -1312,3 +1634,13 @@ class Engine:
         self.stats.queued = self._queue.qsize()
         self.stats.kv_pages_free = self.allocator.free_pages
         self.stats.kv_occupancy = self.allocator.occupancy
+        # age of the oldest waiting request — the picker's queue-latency
+        # term. Peeking the underlying deque is safe here: entries are
+        # only appended by other threads, and a request popped between
+        # the qsize check and the peek just yields a fresher head.
+        try:
+            head = self._queue.queue[0]
+            self.stats.queue_wait_ms = 1e3 * (
+                time.monotonic() - head.enqueued_at)
+        except IndexError:
+            self.stats.queue_wait_ms = 0.0

@@ -25,7 +25,11 @@ from aiohttp import web
 from aigw_tpu.gateway.costs import TokenUsage
 from aigw_tpu.models import llama
 from aigw_tpu.models.registry import family_fns, get_model_spec
-from aigw_tpu.obs.metrics import GenAIMetrics, RequestMetrics
+from aigw_tpu.obs.metrics import (
+    GenAIMetrics,
+    RequestMetrics,
+    render_engine_gauges,
+)
 from aigw_tpu.schemas import openai as oai
 from aigw_tpu.translate.sse import SSEEvent
 from aigw_tpu.tpuserve.engine import (
@@ -42,6 +46,13 @@ from aigw_tpu.tpuserve.tokenizer import (
 )
 
 logger = logging.getLogger(__name__)
+
+
+def _push_all(decoder: StreamingDecoder, toks: list[int]) -> list[str]:
+    """Detokenize a burst (runs on the tokenizer pool: a K-token decode
+    window lands K tokens at once, and their detokenization must not
+    stall every other connection's IO on the event loop)."""
+    return [decoder.push(t) for t in toks]
 
 
 def _find_stop(text: str, stop_strs: list[str]) -> int | None:
@@ -560,13 +571,25 @@ class TPUServeServer:
                         burst.append(out.get_nowait())
                     except asyncio.QueueEmpty:
                         break
+                # big bursts detokenize off the event loop (the HF
+                # tokenizer releases the GIL); tiny ones stay inline —
+                # the executor hop would cost more than it hides. The
+                # decoder is stateful per request, so pre-decoding the
+                # whole burst is safe: tokens past a stop hit are
+                # discarded below and the decoder is never reused after.
+                toks = [t for t, _f, _lp in burst if t >= 0]
+                predecoded = (
+                    iter(await self._off(_push_all, decoder, toks))
+                    if len(toks) >= 4 else None
+                )
                 pieces: list[str] = []
                 lp_entries: list[dict[str, Any]] = []
                 for tok, fin, lp in burst:
                     if tok >= 0:
                         n_out += 1
                         rm.record_tokens_emitted(1)
-                        piece = decoder.push(tok)
+                        piece = (next(predecoded) if predecoded is not None
+                                 else decoder.push(tok))
                         lp_entry = (self._lp_entry(piece, lp, lp_top_n)
                                     if want_lp and lp is not None else None)
                         if piece:
@@ -1005,7 +1028,8 @@ class TPUServeServer:
         return web.json_response({"status": "ok", "model": self.model_name})
 
     async def _state(self, _request: web.Request) -> web.Response:
-        """Endpoint-picker telemetry (KV occupancy + queue depth)."""
+        """Endpoint-picker telemetry (KV occupancy, queue depth, and the
+        queue-latency / adaptive-window signals the picker scores)."""
         s = self.engine.stats
         return web.json_response(
             {
@@ -1013,40 +1037,22 @@ class TPUServeServer:
                 "active_slots": s.active_slots,
                 "max_slots": self.engine.cfg.max_batch_size,
                 "queued": s.queued,
+                "queue_wait_ms": round(s.queue_wait_ms, 3),
                 "kv_pages_free": s.kv_pages_free,
                 "kv_occupancy": s.kv_occupancy,
                 "tokens_generated": s.tokens_generated,
                 "decode_steps": s.decode_steps,
+                "decode_window": s.decode_window,
+                "prefill_ms": round(s.prefill_ms, 3),
+                "transfer_ms": round(s.transfer_ms, 3),
+                "emit_ms": round(s.emit_ms, 3),
             }
         )
 
     async def _metrics(self, _request: web.Request) -> web.Response:
-        body = self.metrics.export() + self._engine_gauges()
+        body = self.metrics.export() + render_engine_gauges(
+            self.engine.stats)
         return web.Response(body=body, content_type="text/plain")
-
-    def _engine_gauges(self) -> bytes:
-        """EngineStats as Prometheus gauges (the /state telemetry, in
-        scrapeable form)."""
-        s = self.engine.stats
-        lines = []
-        for name, value in (
-            ("tpuserve_active_slots", s.active_slots),
-            ("tpuserve_queued_requests", s.queued),
-            ("tpuserve_kv_pages_free", s.kv_pages_free),
-            ("tpuserve_kv_occupancy", s.kv_occupancy),
-            ("tpuserve_tokens_generated_total", s.tokens_generated),
-            ("tpuserve_prefills_total", s.prefills),
-            ("tpuserve_sp_prefills_total", s.sp_prefills),
-            ("tpuserve_chunked_prefill_steps_total",
-             s.chunked_prefill_steps),
-            ("tpuserve_decode_steps_total", s.decode_steps),
-            ("tpuserve_spec_accepted_total", s.spec_accepted),
-            ("tpuserve_prefix_cache_hits_total", s.prefix_cache_hits),
-            ("tpuserve_prefix_tokens_reused_total", s.prefix_tokens_reused),
-        ):
-            lines.append(f"# TYPE {name} gauge")
-            lines.append(f"{name} {value}")
-        return ("\n".join(lines) + "\n").encode()
 
 
 async def run_tpuserve(
@@ -1065,10 +1071,13 @@ async def run_tpuserve(
     decode_steps_per_tick: int = 8,
     enable_prefix_cache: bool = True,
     sp_prefill_min_tokens: int = 1024,
-    prefill_chunk_tokens: int = 0,
+    prefill_chunk_tokens: int = 256,
     spec_tokens: int = 0,
     pallas_attn: bool = False,
     logprobs_topk: int = 0,
+    adaptive_decode_window: bool = True,
+    async_transfers: bool = True,
+    warm_prefill_buckets: int = 0,
 ) -> web.AppRunner:
     server = TPUServeServer(
         model,
@@ -1084,6 +1093,9 @@ async def run_tpuserve(
             spec_tokens=spec_tokens,
             pallas_attn=pallas_attn,
             logprobs_topk=logprobs_topk,
+            adaptive_decode_window=adaptive_decode_window,
+            async_transfers=async_transfers,
+            warm_prefill_buckets=warm_prefill_buckets,
         ),
         tp=tp,
         ep=ep,

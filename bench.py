@@ -129,25 +129,35 @@ def raw_ceiling_tokens_per_sec(params, cfg, batch=BATCH,
 
 def engine_numbers(params, cfg, batch=BATCH, prompt_len=PROMPT_LEN,
                    gen_tokens=GEN_TOKENS, k_steps=K_STEPS,
-                   reps=1) -> list[tuple[float, float]]:
+                   reps=1) -> tuple[list[tuple[float, float]], dict]:
     """The engine row: same decode through the continuous-batching engine
-    (no HTTP). Returns ``reps`` measurements of (tokens/sec, ttft_ms p50
-    over the batch) — callers take the median (r4 verdict: a single rep's
-    variance on a loaded 1-core host swamps the quantity reported)."""
+    (no HTTP). Returns (``reps`` measurements of (tokens/sec, ttft_ms p50
+    over the batch), per-phase host-time breakdown in cumulative ms) —
+    callers take the median of the runs (r4 verdict: a single rep's
+    variance on a loaded 1-core host swamps the quantity reported). The
+    phase dict carries ``prefill_ms`` / ``transfer_ms`` / ``emit_ms``
+    from EngineStats: where the serving path actually spends its host
+    time, so a hot-path regression shows up as a phase, not a vibe."""
     eng = Engine(
         params,
         cfg,
         EngineConfig(max_batch_size=batch,
                      max_seq_len=cfg.max_seq_len, page_size=PAGE,
-                     decode_steps_per_tick=k_steps),
+                     decode_steps_per_tick=k_steps,
+                     # reps must never pay a prefill compile for a group
+                     # shape an earlier rep's arrival split missed
+                     warm_prefill_buckets=2),
     )
     eng.start()
     try:
         eng.warmup()
-        # warm the prefill bucket for prompt_len
+        # warm the prefill bucket for prompt_len AND both adaptive
+        # decode-window programs at the serving page bucket (warmup()
+        # compiles them at the idle bucket; the timed reps must not pay
+        # the compile): enough tokens to ride the window ladder up
         done = threading.Event()
         eng.submit(GenRequest(
-            prompt=[1] * prompt_len, max_tokens=2,
+            prompt=[1] * prompt_len, max_tokens=3 * k_steps + 2,
             sampling=SamplingParams(temperature=0.0),
             emit=lambda t, f: done.set() if f else None,
         ))
@@ -184,7 +194,12 @@ def engine_numbers(params, cfg, batch=BATCH, prompt_len=PROMPT_LEN,
             ttfts = sorted((f - t0) * 1000.0 for f in first_at if f > 0)
             ttft_p50 = ttfts[len(ttfts) // 2] if ttfts else -1.0
             out.append((sum(counts) / dt, ttft_p50))
-        return out
+        phases = {
+            "prefill_ms": round(eng.stats.prefill_ms, 1),
+            "transfer_ms": round(eng.stats.transfer_ms, 1),
+            "emit_ms": round(eng.stats.emit_ms, 1),
+        }
+        return out, phases
     finally:
         eng.stop()
 
@@ -479,11 +494,14 @@ def gateway_numbers(model_name: str, cfg, quantize: str, batch=BATCH,
     async def run() -> dict:
         await _wait_health(serve_url, 1200)
         await _wait_health(gw_url, 120)
-        # warm every prefill bucket + gateway code path off the clock
-        await _drive_stream(serve_url, model_name, batch, prompt_len, 4,
-                            tag="w")
-        await _drive_stream(gw_url, model_name, batch, prompt_len, 4,
-                            tag="x")
+        # warm every prefill bucket + gateway code path off the clock —
+        # long enough to compile BOTH adaptive decode-window programs at
+        # the serving page bucket (kmin fires young, K after steady)
+        warm_gen = max(4, 3 * k_steps + 2)
+        await _drive_stream(serve_url, model_name, batch, prompt_len,
+                            warm_gen, tag="w")
+        await _drive_stream(gw_url, model_name, batch, prompt_len,
+                            warm_gen, tag="x")
         # interleave the legs so slow drift (CPU clocks, cache warmth)
         # cancels instead of flattering whichever leg runs later
         d_tps, d_ttft, g_tps, g_ttft = [], [], [], []
@@ -571,8 +589,8 @@ def _suite(params_holder, cfg, desc, model_name, quantize, batch,
     params = params_holder.pop()
     raw = raw_ceiling_tokens_per_sec(params, cfg, batch, prompt_len,
                                      k_steps)
-    engine_runs = engine_numbers(params, cfg, batch, prompt_len,
-                                 gen_tokens, k_steps, reps=reps)
+    engine_runs, engine_phases = engine_numbers(
+        params, cfg, batch, prompt_len, gen_tokens, k_steps, reps=reps)
     engine = _median([r[0] for r in engine_runs])
     engine_ttft = _median([r[1] for r in engine_runs])
     engine_spread = _spread([r[0] for r in engine_runs])
@@ -605,6 +623,12 @@ def _suite(params_holder, cfg, desc, model_name, quantize, batch,
         "engine_tps_spread": round(engine_spread, 3),
         "direct_tps_spread": gw["direct_tps_spread"],
         "gateway_tps_spread": gw["gateway_tps_spread"],
+        # engine-leg host-time phase breakdown (cumulative ms across the
+        # warm request + all reps): which serving-path phase moved when
+        # the headline does
+        "prefill_ms": engine_phases["prefill_ms"],
+        "transfer_ms": engine_phases["transfer_ms"],
+        "emit_ms": engine_phases["emit_ms"],
         # the capture is trustworthy when every leg's reps agree within
         # 15% (r4 verdict: the engine leg once measured 44% below the
         # HTTP leg — pure harness variance committed as signal)

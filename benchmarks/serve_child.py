@@ -105,6 +105,9 @@ def main() -> None:
                 max_seq_len=cfg.max_seq_len,
                 page_size=spec["page"],
                 decode_steps_per_tick=spec["k"],
+                # timed reps must never pay a prefill compile for a
+                # group shape the warm pass's arrival split missed
+                warm_prefill_buckets=2,
             ),
             quantize=spec.get("quantize", ""),
         )

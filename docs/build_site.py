@@ -113,8 +113,9 @@ def build(out_dir: str) -> list[str]:
     md = markdown.Markdown(extensions=["fenced_code", "tables", "toc"])
     written = []
     for slug, title, text in pages:
+        active = ' class="active"'
         nav = "\n".join(
-            f'<a href="{s}.html"{" class=\"active\"" if s == slug else ""}>'
+            f'<a href="{s}.html"{active if s == slug else ""}>'
             f"{t}</a>"
             for s, t, _ in pages
         )

@@ -1,0 +1,44 @@
+"""Bench-harness smoke: one short engine bench iteration runs in tier-1.
+
+Hot-path regressions (engine hangs, broken pipelining, phase-stat
+plumbing) previously only surfaced at round-end when the driver ran the
+full bench.py capture. This marker-tagged smoke runs the same harness
+functions on the tiny model for a few seconds so tier-1 catches them.
+Run just this layer with ``pytest -m bench_smoke``.
+"""
+
+from __future__ import annotations
+
+import jax
+import pytest
+
+import bench
+from aigw_tpu.models import llama
+from aigw_tpu.models.registry import get_model_spec
+
+
+@pytest.mark.bench_smoke
+def test_bench_engine_iteration_smoke():
+    spec = get_model_spec("tiny-random")
+    params = llama.init_params(jax.random.PRNGKey(0), spec.config)
+    raw = bench.raw_ceiling_tokens_per_sec(
+        params, spec.config, batch=2, prompt_len=16, k_steps=4)
+    assert raw > 0
+    runs, phases = bench.engine_numbers(
+        params, spec.config, batch=2, prompt_len=16, gen_tokens=8,
+        k_steps=4, reps=1)
+    assert len(runs) == 1
+    tps, ttft_p50 = runs[0]
+    assert tps > 0
+    assert ttft_p50 > 0
+    # the phase breakdown the bench JSON line now carries must be live
+    assert set(phases) == {"prefill_ms", "transfer_ms", "emit_ms"}
+    assert phases["prefill_ms"] > 0
+    assert phases["emit_ms"] >= 0
+
+
+@pytest.mark.bench_smoke
+def test_bench_median_and_spread_helpers():
+    assert bench._median([3.0, 1.0, 2.0]) == 2.0
+    assert bench._spread([]) == 0.0
+    assert bench._spread([1.0, 1.0, 1.0]) == 0.0

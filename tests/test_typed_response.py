@@ -77,9 +77,14 @@ class TestSpecs:
         self.bad(Endpoint.CHAT_COMPLETIONS,
                  {"choices": [{"message": {"content": 42}}]},
                  r"choices\[0\].message.content: must be string")
+        # non-canonical finish reasons ("recitation", "error", vendor
+        # extensions) pass through: upstreams emit them legitimately and
+        # rejecting 502'd valid bodies / aborted live streams
+        self.ok(Endpoint.CHAT_COMPLETIONS, {
+            "id": "x", "choices": [{"index": 0, "message": {},
+                                    "finish_reason": "recitation"}]})
         self.bad(Endpoint.CHAT_COMPLETIONS,
-                 {"choices": [{"finish_reason": "banana",
-                               "message": {}}]},
+                 {"choices": [{"finish_reason": 7, "message": {}}]},
                  "finish_reason")
 
     def test_completions(self):
@@ -162,6 +167,11 @@ class TestSpecs:
         with pytest.raises(SchemaError):
             typed_response.validate_stream_event(
                 Endpoint.CHAT_COMPLETIONS, {"choices": [{"delta": "x"}]})
+        # the final finish_reason-only chunk some upstreams send has no
+        # delta at all — it must not kill the stream
+        typed_response.validate_stream_event(
+            Endpoint.CHAT_COMPLETIONS,
+            {"choices": [{"index": 0, "finish_reason": "stop"}]})
         typed_response.validate_stream_event(
             Endpoint.MESSAGES,
             {"type": "content_block_delta", "index": 0,
