@@ -242,6 +242,21 @@ def main(argv: list[str] | None = None) -> int:
                               "for the N smallest prompt buckets at "
                               "startup (all group sizes) so a traffic "
                               "burst never pays an XLA compile")
+    p_serve.add_argument("--no-first-token-fast-path", action="store_true",
+                         help="disable the first-token fast path "
+                              "(async prefill-token host copy, 1ms "
+                              "lone-arrival admission probe, inline "
+                              "first-frame detokenize) — debug/A-B "
+                              "knob; token streams are byte-identical "
+                              "either way")
+    p_serve.add_argument("--prefill-bucket-rungs", type=int, default=2,
+                         choices=[1, 2, 4],
+                         help="prefill bucket rungs per octave: 1 = "
+                              "power-of-two ladder, 2 adds a 1.5xS "
+                              "rung, 4 adds 1.25x/1.5x/1.75x — "
+                              "tighter rungs cut prompt-padding "
+                              "compute (TTFT) at the cost of more "
+                              "compiled prefill shapes")
     p_serve.add_argument("--logprobs", type=int, default=0,
                          help="enable per-token logprobs: max "
                               "top_logprobs servable per request "
@@ -811,6 +826,8 @@ async def _run_tpuserve(args: argparse.Namespace) -> int:
         adaptive_decode_window=not args.no_adaptive_window,
         async_transfers=not args.sync_transfers,
         warm_prefill_buckets=args.warm_prefill_buckets,
+        first_token_fast_path=not args.no_first_token_fast_path,
+        prefill_bucket_rungs=args.prefill_bucket_rungs,
     )
     print(f"tpuserve listening on http://{args.host}:{args.port}", flush=True)
     await _wait_for_signal()

@@ -15,6 +15,15 @@ aigw_tpu/tpuserve/server.py) and scores endpoints:
                                               a replica whose queue MOVES
                                               beats one the same depth
                                               stuck behind a long prefill)
+          + SLICE_PENALTY                    (for sessions only: replicas
+                                              OUTSIDE the session's ICI
+                                              slice — failover and
+                                              load-forced moves prefer a
+                                              same-slice sibling on ties)
+
+    Topology is live, not just configured: each replica reports its own
+    slice on ``/state`` (tpuserve exports ``jax.devices()`` slice_index
+    and chip coords), overriding the static ``slice`` label.
 
 Session affinity (``x-aigw-session-affinity``, or derived from the
 conversation head by the gateway) is per-endpoint STICKY: the session
@@ -63,6 +72,10 @@ class EndpointState:
     active_slots: int = 0
     max_slots: int = 1
     queue_wait_ms: float = 0.0  # age of the oldest queued request
+    # ICI slice reported by the replica itself on /state (TPU multislice
+    # slice_index) — overrides the statically configured slice label, so
+    # topology follows reality after reschedules
+    slice_name: str = ""
     updated_at: float = 0.0
 
 
@@ -78,6 +91,7 @@ class EndpointPicker:
         self.state: dict[str, EndpointState] = {
             e.address: EndpointState() for e in endpoints
         }
+        self._by_addr = {e.address: e for e in endpoints}
         self._rr = itertools.cycle([e.address for e in endpoints])
         # session key → address, LRU-bounded
         self._affinity: "collections.OrderedDict[str, str]" = (
@@ -128,12 +142,14 @@ class EndpointPicker:
         st.active_slots = int(data.get("active_slots", 0))
         st.max_slots = max(1, int(data.get("max_slots", 1)))
         st.queue_wait_ms = float(data.get("queue_wait_ms", 0.0))
+        st.slice_name = str(data.get("slice", "") or "")
         st.updated_at = time.monotonic()
 
     # -- manual state injection (tests / push-based telemetry) ------------
     def observe(self, address: str, *, kv_occupancy: float = 0.0,
                 queued: int = 0, active_slots: int = 0,
-                max_slots: int = 1, queue_wait_ms: float = 0.0) -> None:
+                max_slots: int = 1, queue_wait_ms: float = 0.0,
+                slice_name: str = "") -> None:
         st = self.state[address]
         st.healthy = True
         st.kv_occupancy = kv_occupancy
@@ -141,13 +157,32 @@ class EndpointPicker:
         st.active_slots = active_slots
         st.max_slots = max(1, max_slots)
         st.queue_wait_ms = queue_wait_ms
+        if slice_name:
+            st.slice_name = slice_name
         st.updated_at = time.monotonic()
 
     # -- picking ----------------------------------------------------------
     #: a sticky endpoint keeps the session unless its score exceeds the
     #: best alternative by this much (KV locality beats small load skew)
     STICKINESS_MARGIN = 0.5
+    #: score penalty for leaving the session's current ICI slice: on
+    #: failover (or a load-forced move) a same-slice replica wins score
+    #: ties — it shares the multislice interconnect domain of the
+    #: replica that holds the session's KV, so cross-replica prefix
+    #: migration and any future KV-transfer path stay on ICI instead of
+    #: DCN. Small enough that real load imbalance still dominates.
+    SLICE_PENALTY = 0.25
     _AFFINITY_MAX = 100_000
+
+    def _slice_of(self, addr: str) -> str:
+        """Effective slice of an endpoint: the slice the replica itself
+        reported on /state when available (tpuserve exports
+        jax.devices() topology), else the configured label."""
+        st = self.state.get(addr)
+        if st is not None and st.slice_name:
+            return st.slice_name
+        e = self._by_addr.get(addr)
+        return e.slice_name if e is not None else ""
 
     def pick(self, headers: dict[str, str] | None = None) -> str | None:
         """Returns 'host:port' for the request, or None if no endpoints."""
@@ -156,17 +191,24 @@ class EndpointPicker:
         now = time.monotonic()
         affinity_key = (headers or {}).get(AFFINITY_HEADER, "")
         prev_addr = self._affinity.get(affinity_key) if affinity_key else None
+        # the slice to prefer: where the session's replica lives —
+        # meaningful even when that replica is unhealthy (failover
+        # should land on a same-slice sibling)
+        prev_slice = self._slice_of(prev_addr) if prev_addr else ""
 
         def score_of(e: Endpoint) -> float | None:
             st = self.state[e.address]
             if not (st.healthy and now - st.updated_at < self.STALE_AFTER):
                 return None
-            return (
+            score = (
                 st.kv_occupancy
                 + st.queued / st.max_slots
                 + 0.5 * st.active_slots / st.max_slots
                 + st.queue_wait_ms / 1000.0
             )
+            if prev_slice and self._slice_of(e.address) != prev_slice:
+                score += self.SLICE_PENALTY
+            return score
 
         scores = {e.address: score_of(e) for e in self.endpoints}
         fresh = {a: s for a, s in scores.items() if s is not None}

@@ -952,7 +952,16 @@ class GatewayServer:
         endpoint: Endpoint | None = None,
     ) -> web.StreamResponse:
         """Proxy the SSE stream through the translator — the hot loop
-        (reference processor_impl.go:481-575)."""
+        (reference processor_impl.go:481-575).
+
+        First-frame latency contract: nothing here buffers beyond ONE
+        complete SSE event. ``iter_any`` yields upstream bytes as they
+        arrive, the translator re-emits per chunk, and the typed-stream
+        validator relays every *complete* event immediately (only the
+        partial tail waits for its terminator). Combined with
+        TCP_NODELAY below and ``x-accel-buffering: no``, the first
+        content delta leaves this hop as soon as tpuserve writes it.
+        """
         out = web.StreamResponse(
             status=200,
             headers={
@@ -961,6 +970,9 @@ class GatewayServer:
                 "x-accel-buffering": "no",
             },
         )
+        from aigw_tpu.utils.net import set_tcp_nodelay
+
+        set_tcp_nodelay(request.transport)
         await out.prepare(request)
         usage = TokenUsage()
         model = ""

@@ -105,6 +105,7 @@ ENGINE_GAUGES: tuple[tuple[str, str], ...] = (
     ("prefill_ms", "tpuserve_prefill_ms_total"),
     ("transfer_ms", "tpuserve_transfer_ms_total"),
     ("emit_ms", "tpuserve_emit_ms_total"),
+    ("first_emit_ms", "tpuserve_first_emit_ms_total"),
 )
 
 

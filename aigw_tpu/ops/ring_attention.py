@@ -26,16 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-# jax.shard_map stabilized late (0.4.3x still exposes only the
-# experimental path); resolve once so either jax works
-_shard_map = getattr(jax, "shard_map", None)
-if _shard_map is None:  # pragma: no cover - version-dependent
-    from jax.experimental.shard_map import shard_map as _shard_map
-
-# lax.pvary types carries as varying over manual axes — a check the new
-# shard_map enforces and the experimental one doesn't have: identity
-# fallback on old jax
-_pvary = getattr(jax.lax, "pvary", lambda x, _axes: x)
+from aigw_tpu.utils.shard_compat import shard_map_untyped_carry
 
 
 def _ring_attention_local(
@@ -85,13 +76,12 @@ def _ring_attention_local(
         vb = jax.lax.ppermute(vb, axis, perm)
         return (acc, m_new, l_new, kb, vb), None
 
-    # pvary: accumulators must be typed as varying over the ring axis or
-    # scan rejects the carry (shard_map's varying-manual-axes check)
-    acc0 = _pvary(jnp.zeros((B, S, Hkv, group, D), jnp.float32),
-                         (axis,))
-    m0 = _pvary(jnp.full((B, Hkv, group, S), -1e30, jnp.float32),
-                       (axis,))
-    l0 = _pvary(jnp.zeros((B, Hkv, group, S), jnp.float32), (axis,))
+    # plain accumulators: the varying-manual-axes check that once
+    # required pvary-tagging these is disabled at the shard_map call
+    # (utils/shard_compat.py — the deprecated lax.pvary migration)
+    acc0 = jnp.zeros((B, S, Hkv, group, D), jnp.float32)
+    m0 = jnp.full((B, Hkv, group, S), -1e30, jnp.float32)
+    l0 = jnp.zeros((B, Hkv, group, S), jnp.float32)
     (acc, m, l, _, _), _ = jax.lax.scan(
         step, (acc0, m0, l0, k, v), jnp.arange(n)
     )
@@ -165,7 +155,7 @@ def ring_attention(
         _ring_attention_local if strategy == "ring"
         else _ulysses_attention_local
     )
-    fn = _shard_map(
+    fn = shard_map_untyped_carry(
         functools.partial(local, axis=axis, causal=causal),
         mesh=mesh,
         in_specs=(

@@ -25,16 +25,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from aigw_tpu.models import llama
 from aigw_tpu.models.llama import LlamaConfig
 
-# jax.shard_map stabilized late (0.4.3x still exposes only the
-# experimental path); resolve once so either jax works
-_shard_map = getattr(jax, "shard_map", None)
-if _shard_map is None:  # pragma: no cover - version-dependent
-    from jax.experimental.shard_map import shard_map as _shard_map
-
-# lax.pvary types carries as varying over manual axes — a check the new
-# shard_map enforces and the experimental one doesn't have: identity
-# fallback on old jax
-_pvary = getattr(jax.lax, "pvary", lambda x, _axes: x)
+from aigw_tpu.utils.shard_compat import shard_map_untyped_carry
 
 _STAGE_KEYS = (
     "attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
@@ -139,18 +130,17 @@ def pipeline_logits(
             )
             return (received, outputs), None
 
-        received0 = _pvary(
-            jnp.zeros((microbatch, S, D), embed.dtype), ("pp",)
-        )
-        outputs0 = _pvary(
-            jnp.zeros((M, microbatch, S, V), jnp.float32), ("pp",)
-        )
+        # plain carries: the varying-manual-axes check that once needed
+        # pvary tagging is disabled at the shard_map call
+        # (utils/shard_compat.py — the deprecated lax.pvary migration)
+        received0 = jnp.zeros((microbatch, S, D), embed.dtype)
+        outputs0 = jnp.zeros((M, microbatch, S, V), jnp.float32)
         (_, outputs), _ = lax.scan(
             step, (received0, outputs0), jnp.arange(M + n - 1)
         )
         return outputs[None]  # [1, M, mb, S, V] — this stage's view
 
-    fn = _shard_map(
+    fn = shard_map_untyped_carry(
         local,
         mesh=mesh,
         in_specs=(

@@ -111,12 +111,31 @@ class EngineConfig:
     # few ms of event-loop scheduling). Busy engines never wait —
     # arrivals already coalesce between decode windows. 0 disables.
     admission_coalesce_ms: float = 3.0
+    # First-token fast path: token 0 is sampled by the prefill step
+    # itself, so (a) its device→host copy is started at prefill dispatch
+    # (copy_to_host_async — the same machinery as async_transfers) so
+    # the host never pays a separate fetch round-trip after the compute
+    # lands, and (b) a LONE arrival to an idle engine prefills
+    # immediately instead of riding the admission_coalesce_ms timer
+    # (coalescing only pays when a second request is already queued).
+    # False restores the round-6 behavior; token streams are
+    # byte-identical either way (tests/test_serving_overlap.py).
+    first_token_fast_path: bool = True
     # Pre-compile the batched-prefill programs for the N smallest
     # prompt buckets at warmup (all power-of-two group sizes up to
     # max_batch_size): a traffic burst must not pay an XLA prefill
     # compile for a group shape the warm traffic happened not to hit.
     # 0 = off (each (group, bucket) shape compiles on first use).
     warm_prefill_buckets: int = 0
+    # Prefill bucket rungs per octave: 1 keeps the classic power-of-two
+    # ladder (worst-case padding ≈ 2× the prompt); 2 adds a 1.5×S rung
+    # between octaves (worst-case padding 1.5×); 4 adds 1.25×/1.5×/1.75×
+    # rungs (worst-case 1.25×). Prefill compute scales with the PADDED
+    # length, so padding waste is paid directly in TTFT — a ~90-token
+    # chat prompt on the pow2 ladder runs a 128-wide prefill, ~35%
+    # slower than the 96-wide rung. Compiled-program count stays
+    # bounded: rungs × log2(max_seq/min_bucket) shapes per group size.
+    prefill_bucket_rungs: int = 2
     # Prompt-lookup speculative decoding: number of draft tokens verified
     # per decode step (0 = off). Each step verifies 1+spec_tokens
     # positions in one fixed-shape program and advances by the accepted
@@ -126,6 +145,12 @@ class EngineConfig:
     # reads scale with actual sequence lengths, not the padded window).
     # Single-chip only: ignored when the engine runs on a mesh.
     pallas_attn: bool = False
+    # KV cache element dtype: "bfloat16" (serving default) or
+    # "float32". f32 doubles KV HBM but removes the bf16 rounding that
+    # lets near-tied logits argmax-flip between mathematically
+    # equivalent schedules — the deterministic-equivalence test mode
+    # (tests/test_chunked_prefill.py) and an accuracy-debug knob.
+    kv_cache_dtype: str = "bfloat16"
     # Per-token logprobs (vLLM/OpenAI parity): when > 0, the decode scan
     # also returns the chosen token's log-probability and the top-k
     # (ids, values) per step, and requests may set want_logprobs. Static
@@ -139,6 +164,14 @@ class EngineConfig:
         if self.logprobs_topk > 0 and self.spec_tokens > 0:
             raise ValueError(
                 "logprobs_topk and spec_tokens are mutually exclusive")
+        if self.prefill_bucket_rungs not in (1, 2, 4):
+            raise ValueError(
+                f"prefill_bucket_rungs must be 1, 2, or 4 "
+                f"(got {self.prefill_bucket_rungs})")
+        if self.kv_cache_dtype not in ("bfloat16", "float32"):
+            raise ValueError(
+                f"kv_cache_dtype must be 'bfloat16' or 'float32' "
+                f"(got {self.kv_cache_dtype!r})")
         if self.min_decode_steps_per_tick == 0:
             self.min_decode_steps_per_tick = max(
                 1, self.decode_steps_per_tick // 4)
@@ -229,10 +262,14 @@ class EngineStats:
     # serving-path phase breakdown (cumulative milliseconds):
     # prefill_ms = host time blocked on prefill device calls,
     # transfer_ms = host time blocked fetching window tokens,
-    # emit_ms = host time distributing tokens to consumers
+    # emit_ms = host time distributing tokens to consumers,
+    # first_emit_ms = host time from a prefill's sampled token being
+    # host-available to its first-token emit callback returning (the
+    # fast path's residual: slot setup + prefix-cache insert + emit)
     prefill_ms: float = 0.0
     transfer_ms: float = 0.0
     emit_ms: float = 0.0
+    first_emit_ms: float = 0.0
     # age of the oldest queued request (picker queue-latency signal)
     queue_wait_ms: float = 0.0
 
@@ -310,6 +347,8 @@ class Engine:
             model_cfg.n_kv_heads,
             model_cfg.head_dim,
         )
+        kv_dtype = (jnp.float32 if cfg.kv_cache_dtype == "float32"
+                    else jnp.bfloat16)
         if mesh is not None:
             from jax.sharding import NamedSharding
 
@@ -357,11 +396,11 @@ class Engine:
                 for k, v in params.items()
             }
             self.kv_cache = jax.device_put(
-                jnp.zeros(kv_shape, jnp.bfloat16),
+                jnp.zeros(kv_shape, kv_dtype),
                 NamedSharding(mesh, kv_cache_spec()),
             )
         else:
-            self.kv_cache = jnp.zeros(kv_shape, jnp.bfloat16)
+            self.kv_cache = jnp.zeros(kv_shape, kv_dtype)
         # Per-slot decode state lives ON DEVICE between ticks (uploaded
         # only when membership/sampling changes) — the decode hot loop
         # transfers just the sampled [K, B] tokens per round-trip.
@@ -452,11 +491,23 @@ class Engine:
             self._prefill_sp_fn = jax.jit(_prefill_sp_step,
                                           donate_argnums=(4,))
 
-        def _decode_scan(k: int):
+        def _decode_scan(k: int, lean: bool = False):
             """Factory: k fused decode+sample steps; sampled tokens feed
             forward on-device (no host round-trip inside the window).
             Each window length is one compiled program (the adaptive
-            ladder is {min, max} so at most two exist per bucket)."""
+            ladder is {min, max} so at most two exist per bucket).
+
+            ``lean``: compiled WITHOUT the repetition-penalty ops (the
+            per-step [B, V] counts scatter-add and both penalty terms —
+            logit bias stays). Dispatched whenever no active slot uses
+            penalties: zero penalties contribute exactly 0.0 to every
+            logit, so lean and full windows sample bit-identical tokens
+            while the lean program drops the most expensive non-matmul
+            ops from the hot loop. Device-side counts go stale for
+            penalty-free slots during lean windows — harmless (their
+            penalty coefficients are zero) and refreshed from the
+            host-side token_counts whenever a penalized admission
+            switches the engine back to the full program."""
             lp_k = cfg.logprobs_topk
 
             def body(params, lora, carry):
@@ -468,17 +519,21 @@ class Engine:
                     lora=lora, adapter_idx=st["adapter_idx"],
                     attn_impl=attn_impl,
                 )
-                logits = apply_penalties(
-                    logits, st["counts"], st["freq_pen"], st["pres_pen"],
-                    st["bias"],
-                )
+                if lean:
+                    logits = logits + st["bias"]
+                else:
+                    logits = apply_penalties(
+                        logits, st["counts"], st["freq_pen"],
+                        st["pres_pen"], st["bias"],
+                    )
                 sampled = sample(logits, st["keys"], st["temp"],
                                  st["top_p"], st["top_k"])
                 step = act.astype(jnp.uint32)
                 B = sampled.shape[0]
-                counts = st["counts"].at[
-                    jnp.arange(B), sampled
-                ].add(act.astype(st["counts"].dtype))
+                counts = (st["counts"] if lean
+                          else st["counts"].at[
+                              jnp.arange(B), sampled
+                          ].add(act.astype(st["counts"].dtype)))
                 new = dict(
                     st,
                     tokens=jnp.where(act, sampled, st["tokens"]),
@@ -614,17 +669,76 @@ class Engine:
         self._decode_scan_factory = (
             _spec_scan if self._spec else _decode_scan
         )
-        self._decode_fns: dict[int, Callable] = {}
+        self._decode_fns: dict[tuple[int, bool], Callable] = {}
 
-    def _decode_fn_for(self, k: int):
+    def _decode_fn_for(self, k: int, lean: bool = False):
         """Jitted decode program for window length k (cached; jit itself
-        caches per page-bucket shape)."""
-        fn = self._decode_fns.get(k)
+        caches per page-bucket shape). ``lean`` selects the
+        penalty-free variant (speculation has no lean variant — its
+        draft-eligibility logic reads the penalty fields)."""
+        if self._spec:
+            lean = False
+        fn = self._decode_fns.get((k, lean))
         if fn is None:
-            fn = jax.jit(self._decode_scan_factory(k),
-                         donate_argnums=(2, 3))
-            self._decode_fns[k] = fn
+            scan = (self._decode_scan_factory(k) if self._spec
+                    else self._decode_scan_factory(k, lean))
+            fn = jax.jit(scan, donate_argnums=(2, 3))
+            self._decode_fns[(k, lean)] = fn
         return fn
+
+    def _lean_decode_ok(self) -> bool:
+        """True when no active slot uses repetition penalties — the
+        lean decode program samples bit-identical tokens (zero
+        penalties add exactly 0.0 per logit)."""
+        if self._spec:
+            return False
+        return all(
+            s is None
+            or (s.req.sampling.frequency_penalty == 0.0
+                and s.req.sampling.presence_penalty == 0.0)
+            for s in self._slots
+        )
+
+    def _prefill_bucket(self, n: int) -> int:
+        """Smallest prefill-ladder rung covering ``n`` prompt tokens.
+        Rungs are powers of two of min_prefill_bucket plus, with
+        prefill_bucket_rungs > 1, intermediate rungs at 1.5×S (and
+        1.25×/1.75×S at 4) — prefill compute scales with the padded
+        length, so a tighter rung is a direct TTFT cut."""
+        cfg = self.cfg
+        S = cfg.min_prefill_bucket
+        while S < n:
+            if cfg.prefill_bucket_rungs >= 4 and n <= S + S // 4:
+                S += S // 4
+                break
+            if cfg.prefill_bucket_rungs >= 2 and n <= S + S // 2:
+                S += S // 2
+                break
+            if cfg.prefill_bucket_rungs >= 4 and n <= S + 3 * S // 4:
+                S += 3 * S // 4
+                break
+            S *= 2
+        return min(S, cfg.max_seq_len)
+
+    def _bucket_rungs(self, octave: int) -> list[int]:
+        """The prefill-ladder rungs of one octave (octave 0 starts at
+        min_prefill_bucket), ascending, capped at max_seq_len."""
+        S = self.cfg.min_prefill_bucket << octave
+        quarters = {1: (4,), 2: (4, 6), 4: (4, 5, 6, 7)}[
+            self.cfg.prefill_bucket_rungs]
+        return sorted({
+            min(S * q // 4, self.cfg.max_seq_len) for q in quarters
+        })
+
+    @staticmethod
+    def _start_host_copy(tree: Any) -> None:
+        """Begin the device→host copy of every array leaf now
+        (copy_to_host_async): the transfer overlaps the remaining
+        on-device compute instead of serializing after it."""
+        for leaf in jax.tree_util.tree_leaves(tree):
+            copy = getattr(leaf, "copy_to_host_async", None)
+            if copy is not None:
+                copy()
 
     def _window_ladder(self) -> list[int]:
         """Window sizes the adaptive policy may dispatch."""
@@ -696,16 +810,18 @@ class Engine:
         and, with warm_prefill_buckets > 0, the batched-prefill group
         shapes for the smallest prompt buckets — before traffic arrives
         (the first burst then pays zero XLA compiles)."""
+        leans = (False,) if self._spec else (True, False)
         for k in self._window_ladder():
-            state = self._build_device_state()
-            _, _, self.kv_cache = self._decode_fn_for(k)(
-                self.params, self.lora_params, self.kv_cache, state
-            )
+            for lean in leans:
+                state = self._build_device_state()
+                _, _, self.kv_cache = self._decode_fn_for(k, lean)(
+                    self.params, self.lora_params, self.kv_cache, state
+                )
         for b in range(self.cfg.warm_prefill_buckets):
-            S = self.cfg.min_prefill_bucket << b
-            if S > self.cfg.max_seq_len:
+            if self.cfg.min_prefill_bucket << b > self.cfg.max_seq_len:
                 break
-            self._warm_prefill_shapes(S)
+            for S in self._bucket_rungs(b):
+                self._warm_prefill_shapes(S)
 
     def _warm_prefill_shapes(self, S: int) -> None:
         """Run the prefill program for every power-of-two group size at
@@ -827,13 +943,31 @@ class Engine:
                 # completely idle + partial burst: a batch of concurrent
                 # arrivals spans a few ms of event-loop scheduling —
                 # wait once so the whole burst prefills as ONE batched
-                # call instead of a 1+(B-1) split
-                time.sleep(self.cfg.admission_coalesce_ms / 1e3)
-                try:
-                    while len(pending) < free:
-                        pending.append(self._queue.get_nowait())
-                except queue.Empty:
-                    pass
+                # call instead of a 1+(B-1) split. Under the first-token
+                # fast path a LONE arrival does not ride the full timer:
+                # it probes 1ms for burst evidence (a second queued
+                # request) and otherwise goes straight to prefill —
+                # single-request TTFT stops paying for burst insurance,
+                # while real bursts (which surface a second submit
+                # within the probe) still coalesce fully.
+                wait_ms = self.cfg.admission_coalesce_ms
+                if self.cfg.first_token_fast_path and len(pending) == 1:
+                    probe = min(1.0, wait_ms)
+                    time.sleep(probe / 1e3)
+                    try:
+                        while len(pending) < free:
+                            pending.append(self._queue.get_nowait())
+                    except queue.Empty:
+                        pass
+                    wait_ms = 0.0 if len(pending) == 1 else \
+                        max(0.0, wait_ms - probe)
+                if wait_ms > 0 and len(pending) < free:
+                    time.sleep(wait_ms / 1e3)
+                    try:
+                        while len(pending) < free:
+                            pending.append(self._queue.get_nowait())
+                    except queue.Empty:
+                        pass
             # Classify once (prompt hashes computed here are reused all
             # the way to the post-prefill cache insert), then admit in
             # STRICT arrival order: contiguous runs of ≥2 simple requests
@@ -949,11 +1083,8 @@ class Engine:
         # group by padded bucket so each group is one compiled shape
         groups: dict[int, list] = {}
         for item in prepared:
-            S = self.cfg.min_prefill_bucket
-            while S < item[2]:
-                S *= 2
-            S = min(S, self.cfg.max_seq_len)
-            groups.setdefault(S, []).append(item)
+            groups.setdefault(self._prefill_bucket(item[2]),
+                              []).append(item)
         for S, items in groups.items():
             count += self._prefill_group(S, items, chain_by_req)
         return count, leftover
@@ -1001,6 +1132,11 @@ class Engine:
             jnp.asarray(seq_lens), self.kv_cache, jnp.asarray(pt),
             jnp.asarray(keys), jnp.asarray(temp), jnp.asarray(top_p),
             jnp.asarray(top_k), jnp.asarray(bias), jnp.asarray(adapter))
+        if self.cfg.first_token_fast_path:
+            # token 0's device→host copy starts at dispatch and overlaps
+            # the prefill's remaining on-device compute (async-transfer
+            # machinery; values are identical to the blocking fetch)
+            self._start_host_copy(next_tok)
         lp_data = None
         if self.cfg.logprobs_topk and isinstance(next_tok, tuple):
             next_tok, chosen, tk_ids, tk_vals = next_tok
@@ -1008,6 +1144,7 @@ class Engine:
                        np.asarray(tk_vals))
         toks = np.asarray(next_tok)
         self.stats.prefill_ms += 1e3 * (time.monotonic() - t0)
+        t_first = time.monotonic()
         for g, (req, seq_id, n, total) in enumerate(items):
             slot_idx = self._free_slot_index()
             assert slot_idx is not None  # len(items) <= free slots
@@ -1031,6 +1168,7 @@ class Engine:
             self.stats.prefills += 1
             self._mark_admitted(slot_idx)
             self._emit_token(slot_idx, int(toks[g]), first_lp)
+        self.stats.first_emit_ms += 1e3 * (time.monotonic() - t_first)
         logger.debug("batched prefill G=%d S=%d %.1fms", G, S,
                      1e3 * (time.monotonic() - t0))
         return len(items)
@@ -1187,10 +1325,7 @@ class Engine:
         tail = suffix[consumed:]
         ns_tail = len(tail)
         # bucketed padded length for the remaining tokens
-        S = self.cfg.min_prefill_bucket
-        while S < ns_tail:
-            S *= 2
-        S = min(S, self.cfg.max_seq_len)
+        S = self._prefill_bucket(ns_tail)
         if use_sp and S % self._sp:
             # ring attention shards the padded length over sp — round
             # the bucket up to a multiple of sp (non-power-of-two sp
@@ -1234,6 +1369,9 @@ class Engine:
                 jnp.asarray(pt),
                 *sampling_args,
             )
+        if self.cfg.first_token_fast_path:
+            # start token 0's host copy under the prefill's compute
+            self._start_host_copy(next_tok)
         first_lp = None
         if self.cfg.logprobs_topk and isinstance(next_tok, tuple):
             next_tok, chosen, tk_ids, tk_vals = next_tok
@@ -1246,6 +1384,7 @@ class Engine:
         self.stats.prefills += 1
         self.stats.prefill_ms += max(
             0.0, 1e3 * (time.monotonic() - t0) - tick_ms)
+        t_first = time.monotonic()
         if self.prefix_cache is not None and chain_keys:
             self.prefix_cache.insert(chain_keys, pages)
         logger.debug("prefill seq=%d len=%d prefix=%d bucket=%d %.1fms",
@@ -1261,6 +1400,7 @@ class Engine:
         )
         self._mark_admitted(slot_idx)
         self._emit_token(slot_idx, tok, first_lp)
+        self.stats.first_emit_ms += 1e3 * (time.monotonic() - t_first)
         return "admitted"
 
     def _requeue_front_many(self, reqs: list[GenRequest]) -> None:
@@ -1573,16 +1713,14 @@ class Engine:
             (i, self._slots[i].req) for i in active_idx
         )
         frees, self._pending_frees = self._pending_frees, []
-        sampled, self._device_state, self.kv_cache = self._decode_fn_for(k)(
+        decode_fn = self._decode_fn_for(k, self._lean_decode_ok())
+        sampled, self._device_state, self.kv_cache = decode_fn(
             self.params, self.lora_params, self.kv_cache, self._device_state
         )
         if self.cfg.async_transfers:
             # start the device→host token copy now; it overlaps this
             # window's on-device compute and is resolved at drain time
-            for leaf in jax.tree_util.tree_leaves(sampled):
-                copy = getattr(leaf, "copy_to_host_async", None)
-                if copy is not None:
-                    copy()
+            self._start_host_copy(sampled)
         # process the PREVIOUS window while this one runs on-device
         self._drain_inflight()
         self._inflight = _Window(sampled=sampled, members=members, k=k,

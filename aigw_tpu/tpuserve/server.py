@@ -11,6 +11,7 @@ protocol speaks ext_proc; ours is a plain JSON poll + the same
 from __future__ import annotations
 
 import asyncio
+import functools
 import json
 import logging
 import time
@@ -32,6 +33,7 @@ from aigw_tpu.obs.metrics import (
 )
 from aigw_tpu.schemas import openai as oai
 from aigw_tpu.translate.sse import SSEEvent
+from aigw_tpu.utils.net import set_tcp_nodelay
 from aigw_tpu.tpuserve.engine import (
     Engine,
     EngineConfig,
@@ -53,6 +55,33 @@ def _push_all(decoder: StreamingDecoder, toks: list[int]) -> list[str]:
     window lands K tokens at once, and their detokenization must not
     stall every other connection's IO on the event loop)."""
     return [decoder.push(t) for t in toks]
+
+
+@functools.lru_cache(maxsize=1)
+def _device_topology_cached() -> tuple[str, tuple[int, ...]]:
+    try:
+        d = jax.devices()[0]
+    except Exception:  # backend init failure must not break /state
+        return "", ()
+    # TPU devices expose slice_index on multislice deployments and
+    # coords (the chip's position in the ICI torus); CPU/GPU have
+    # neither — they report an empty slice, and the picker falls back
+    # to the statically configured slice label.
+    slice_idx = getattr(d, "slice_index", None)
+    coords = getattr(d, "coords", None)
+    slice_name = (
+        f"{d.platform}-slice-{slice_idx}" if slice_idx is not None else ""
+    )
+    return slice_name, tuple(coords) if coords is not None else ()
+
+
+def device_topology() -> dict[str, Any]:
+    """ICI topology of this server's devices for /state: the slice the
+    chips belong to and the first chip's torus coords, straight from
+    jax.devices(). The gateway picker keys its same-slice preference
+    (KV/ICI locality on failover) on the ``slice`` field."""
+    slice_name, coords = _device_topology_cached()
+    return {"slice": slice_name, "device_coords": list(coords)}
 
 
 def _find_stop(text: str, stop_strs: list[str]) -> int | None:
@@ -354,11 +383,36 @@ class TPUServeServer:
         except oai.SchemaError as e:
             return web.Response(status=400, body=oai.error_body(str(e)),
                                 content_type="application/json")
-        prompt = await self._off(
-            apply_chat_template, body["messages"], self.tokenizer,
-            self.chat_template,
-        )
+        msgs = body["messages"]
+        if self._small_text(msgs):
+            # first-token fast path: a short prompt's template+encode is
+            # microseconds — the executor round-trip would cost more
+            # than it hides AND spread a burst's submits across extra
+            # event-loop turns (admission coalescing then waits on the
+            # stragglers). Long prompts keep the pool hop.
+            prompt = apply_chat_template(msgs, self.tokenizer,
+                                         self.chat_template)
+        else:
+            prompt = await self._off(
+                apply_chat_template, msgs, self.tokenizer,
+                self.chat_template,
+            )
         return await self._generate(request, body, prompt, chat=True)
+
+    #: request text below this many chars tokenizes inline on the event
+    #: loop (HF tokenizer throughput is ~MB/s; 4KiB is ~ms)
+    _INLINE_TOKENIZE_CHARS = 4096
+
+    @classmethod
+    def _small_text(cls, msgs) -> bool:
+        total = 0
+        for m in msgs if isinstance(msgs, list) else [msgs]:
+            content = m.get("content") if isinstance(m, dict) else m
+            total += len(content) if isinstance(content, str) else \
+                len(str(content))
+            if total >= cls._INLINE_TOKENIZE_CHARS:
+                return False
+        return True
 
     async def _off(self, fn, *args):
         """Run a tokenization-bound callable off the event loop."""
@@ -376,9 +430,13 @@ class TPUServeServer:
         prompt_text = body.get("prompt", "")
         if isinstance(prompt_text, list):
             prompt_text = "".join(prompt_text)
-        prompt = [self.tokenizer.bos_id] + await self._off(
-            self.tokenizer.encode, prompt_text
-        )
+        if len(prompt_text) < self._INLINE_TOKENIZE_CHARS:
+            prompt = [self.tokenizer.bos_id] + self.tokenizer.encode(
+                prompt_text)
+        else:
+            prompt = [self.tokenizer.bos_id] + await self._off(
+                self.tokenizer.encode, prompt_text
+            )
         return await self._generate(request, body, prompt, chat=False)
 
     async def _generate(
@@ -499,11 +557,28 @@ class TPUServeServer:
             headers={"content-type": "text/event-stream",
                      "cache-control": "no-cache"},
         )
+        # first-token fast path: the role frame and the first content
+        # delta are two small writes back to back — Nagle must not hold
+        # the second until the first is ACKed
+        set_tcp_nodelay(request.transport)
         await resp.prepare(request)
         decoder = StreamingDecoder(self.tokenizer)
         emitted = ""
         n_out = 0
         finish = "stop"
+        # Pre-serialized SSE chunk envelope: everything except the
+        # content string is constant for the request's lifetime, so the
+        # hot loop pays one json.dumps of the piece instead of
+        # serializing the whole chunk dict per frame. Built by
+        # splitting a real stream_chunk_sse frame on a sentinel, so the
+        # bytes are identical to the non-template path by construction.
+        tmpl_head = tmpl_tail = b""
+        if chat:
+            sentinel = "\x00aigw-delta-slot\x00"
+            tmpl_head, tmpl_tail = oai.stream_chunk_sse(
+                response_id=rid, model=self.model_name, created=created,
+                delta={"content": sentinel},
+            ).split(json.dumps(sentinel).encode())
 
         async def write_piece(piece: str, lp_entries=None) -> None:
             # an empty piece (mid-UTF-8 token) still carries its logprob
@@ -512,12 +587,16 @@ class TPUServeServer:
             if not piece and not lp_entries:
                 return
             if chat:
+                if not lp_entries:
+                    await resp.write(
+                        tmpl_head + json.dumps(piece).encode()
+                        + tmpl_tail)
+                    return
                 await resp.write(
                     oai.stream_chunk_sse(
                         response_id=rid, model=self.model_name,
                         created=created, delta={"content": piece},
-                        logprobs={"content": lp_entries}
-                        if lp_entries else None,
+                        logprobs={"content": lp_entries},
                     )
                 )
             else:
@@ -549,38 +628,21 @@ class TPUServeServer:
                     )
                 )
             done_streaming = False
-            while not done_streaming:
-                # keepalive comments while queued behind prefills so
-                # intermediaries don't drop an apparently-idle stream
-                while True:
-                    try:
-                        first = await asyncio.wait_for(
-                            out.get(), timeout=10.0)
-                        break
-                    except asyncio.TimeoutError:
-                        await resp.write(b": ping\n\n")
-                # Coalesce the burst: a decode window lands K tokens per
-                # slot on the queue at once; one SSE frame per burst
-                # instead of one per token cuts event-loop wakeups,
-                # json dumps, and syscalls ~K× in the serving hot loop
-                # (OpenAI deltas are arbitrary strings; logprob entries
-                # stay 1:1 with tokens inside the frame's content list).
-                burst = [first]
-                while True:
-                    try:
-                        burst.append(out.get_nowait())
-                    except asyncio.QueueEmpty:
-                        break
-                # big bursts detokenize off the event loop (the HF
-                # tokenizer releases the GIL); tiny ones stay inline —
-                # the executor hop would cost more than it hides. The
-                # decoder is stateful per request, so pre-decoding the
-                # whole burst is safe: tokens past a stop hit are
-                # discarded below and the decoder is never reused after.
+
+            async def handle_burst(burst: list, inline_detok: bool) -> None:
+                """Detokenize + emit one burst as one SSE frame. Big
+                bursts detokenize off the event loop (the HF tokenizer
+                releases the GIL); tiny ones — and the latency-critical
+                FIRST frame (inline_detok) — stay inline: the executor
+                hop would cost more than it hides. The decoder is
+                stateful per request, so pre-decoding the whole burst
+                is safe: tokens past a stop hit are discarded below and
+                the decoder is never reused after."""
+                nonlocal emitted, n_out, finish, done_streaming
                 toks = [t for t, _f, _lp in burst if t >= 0]
                 predecoded = (
                     iter(await self._off(_push_all, decoder, toks))
-                    if len(toks) >= 4 else None
+                    if len(toks) >= 4 and not inline_detok else None
                 )
                 pieces: list[str] = []
                 lp_entries: list[dict[str, Any]] = []
@@ -619,6 +681,42 @@ class TPUServeServer:
                         done_streaming = True
                         break
                 await write_piece("".join(pieces), lp_entries)
+
+            while not done_streaming:
+                # keepalive comments while queued behind prefills so
+                # intermediaries don't drop an apparently-idle stream
+                while True:
+                    try:
+                        first = await asyncio.wait_for(
+                            out.get(), timeout=10.0)
+                        break
+                    except asyncio.TimeoutError:
+                        await resp.write(b": ping\n\n")
+                # Coalesce the burst: a decode window lands K tokens per
+                # slot on the queue at once; one SSE frame per burst
+                # instead of one per token cuts event-loop wakeups,
+                # json dumps, and syscalls ~K× in the serving hot loop
+                # (OpenAI deltas are arbitrary strings; logprob entries
+                # stay 1:1 with tokens inside the frame's content list).
+                burst = [first]
+                while True:
+                    try:
+                        burst.append(out.get_nowait())
+                    except asyncio.QueueEmpty:
+                        break
+                if n_out == 0 and len(burst) > 1:
+                    # first-token fast path: the stream's FIRST token
+                    # rides its own frame — detokenized inline and on
+                    # the wire before the rest of the burst is even
+                    # decoded — so a request that waited out a decode
+                    # window doesn't pay the whole burst's detokenize/
+                    # serialize cost before its first byte
+                    await handle_burst(burst[:1], inline_detok=True)
+                    if not done_streaming:
+                        await handle_burst(burst[1:],
+                                           inline_detok=False)
+                else:
+                    await handle_burst(burst, inline_detok=n_out == 0)
         except (asyncio.CancelledError, ConnectionResetError):
             # client went away: stop generating, free the slot
             gen_req.cancelled.set()
@@ -1046,6 +1144,10 @@ class TPUServeServer:
                 "prefill_ms": round(s.prefill_ms, 3),
                 "transfer_ms": round(s.transfer_ms, 3),
                 "emit_ms": round(s.emit_ms, 3),
+                "first_emit_ms": round(s.first_emit_ms, 3),
+                # ICI topology: the picker's same-slice preference term
+                # (gateway/picker.py) keys on this
+                **device_topology(),
             }
         )
 
@@ -1078,6 +1180,8 @@ async def run_tpuserve(
     adaptive_decode_window: bool = True,
     async_transfers: bool = True,
     warm_prefill_buckets: int = 0,
+    first_token_fast_path: bool = True,
+    prefill_bucket_rungs: int = 2,
 ) -> web.AppRunner:
     server = TPUServeServer(
         model,
@@ -1096,6 +1200,8 @@ async def run_tpuserve(
             adaptive_decode_window=adaptive_decode_window,
             async_transfers=async_transfers,
             warm_prefill_buckets=warm_prefill_buckets,
+            first_token_fast_path=first_token_fast_path,
+            prefill_bucket_rungs=prefill_bucket_rungs,
         ),
         tp=tp,
         ep=ep,

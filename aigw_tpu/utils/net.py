@@ -1,0 +1,27 @@
+"""Small socket/transport helpers shared by the serving surfaces."""
+
+from __future__ import annotations
+
+import socket
+
+
+def set_tcp_nodelay(transport) -> None:
+    """Disable Nagle on a (possibly wrapped) asyncio transport's socket.
+
+    The first-token fast path writes two small SSE frames back to back
+    (role frame, then the first content delta); with Nagle enabled the
+    second frame can sit in the kernel until the first is ACKed — pure
+    added TTFT. aiohttp enables TCP_NODELAY on most server transports
+    already; this makes the latency-critical streams explicit and
+    covers transports (SSL wrappers, proxies) where it may not hold.
+    No-ops on non-TCP transports (unix sockets, tests' mocks).
+    """
+    if transport is None:
+        return
+    sock = transport.get_extra_info("socket")
+    if sock is None:
+        return
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except (OSError, ValueError):
+        pass  # non-TCP socket family / already closed

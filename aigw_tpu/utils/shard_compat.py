@@ -1,0 +1,48 @@
+"""shard_map compatibility across the ``jax.lax.pvary`` deprecation arc.
+
+Ring attention and the pipeline stage loop carry accumulators through a
+``lax.scan`` whose body runs collectives (``ppermute``) over a manual
+mesh axis. Newer shard_map implementations statically track which
+values vary over manual axes and reject a replicated-typed carry that a
+collective made varying; the old workaround was tagging the initial
+accumulators with ``jax.lax.pvary`` — an API that does not exist on
+older jax (0.4.x), moved between releases, and is deprecated in favour
+of opting out of the check itself. This module is the single resolution
+point: ``shard_map_untyped_carry`` disables the varying-manual-axes
+validation via whichever keyword the installed shard_map understands
+(``check_vma`` on the stabilized ``jax.shard_map``, ``check_rep`` on
+the experimental one), so kernel code carries no version shims and no
+pvary calls. Numerics are unaffected — only the static check is off.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+_shard_map_impl = getattr(jax, "shard_map", None)
+if _shard_map_impl is None:  # pragma: no cover - version-dependent
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+try:
+    _PARAMS = set(inspect.signature(_shard_map_impl).parameters)
+except (TypeError, ValueError):  # pragma: no cover - exotic builds
+    _PARAMS = set()
+
+if "check_vma" in _PARAMS:
+    _CHECK_OFF = {"check_vma": False}
+elif "check_rep" in _PARAMS:
+    _CHECK_OFF = {"check_rep": False}
+else:  # pragma: no cover - future signature change
+    _CHECK_OFF = {}
+
+
+def shard_map_untyped_carry(f, *, mesh, in_specs, out_specs):
+    """``shard_map`` with the varying-manual-axes check disabled — the
+    supported replacement for pvary-tagging scan carries (see module
+    docstring)."""
+    return _shard_map_impl(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **_CHECK_OFF,
+    )

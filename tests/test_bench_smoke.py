@@ -32,9 +32,22 @@ def test_bench_engine_iteration_smoke():
     assert tps > 0
     assert ttft_p50 > 0
     # the phase breakdown the bench JSON line now carries must be live
-    assert set(phases) == {"prefill_ms", "transfer_ms", "emit_ms"}
+    assert set(phases) == {"prefill_ms", "transfer_ms", "emit_ms",
+                           "first_emit_ms"}
     assert phases["prefill_ms"] > 0
     assert phases["emit_ms"] >= 0
+    # TTFT regression tripwire (no full bench run needed): the
+    # first-token phase must be live and SMALL — the fast path's whole
+    # point is that the host residual between a prefill's sampled token
+    # and its emit callback is a sliver of the prefill itself. A
+    # pipeline regression that re-routes token 0 through a decode
+    # window or adds host work here blows this ratio long before it
+    # shows in a round-end capture.
+    assert phases["first_emit_ms"] > 0
+    assert phases["first_emit_ms"] < phases["prefill_ms"]
+    # sanity ceiling: nothing in a 2-request tiny-model rep legitimately
+    # spends a second on first-token emission
+    assert phases["first_emit_ms"] < 1000.0
 
 
 @pytest.mark.bench_smoke
@@ -42,3 +55,26 @@ def test_bench_median_and_spread_helpers():
     assert bench._median([3.0, 1.0, 2.0]) == 2.0
     assert bench._spread([]) == 0.0
     assert bench._spread([1.0, 1.0, 1.0]) == 0.0
+
+
+@pytest.mark.bench_smoke
+def test_bench_mfu_analytical():
+    """The mfu field's FLOPs accounting: ≈ 2×(matmul params) at zero
+    context, plus the attention term; scales linearly with tok/s."""
+    spec = get_model_spec("tiny-random")
+    cfg = spec.config
+    f0 = bench.model_flops_per_token(cfg, 0)
+    hd = cfg.head_dim
+    per_layer = (cfg.dim * cfg.n_heads * hd
+                 + 2 * cfg.dim * cfg.n_kv_heads * hd
+                 + cfg.n_heads * hd * cfg.dim
+                 + 3 * cfg.dim * cfg.ffn_dim)
+    assert f0 == 2.0 * (cfg.n_layers * per_layer
+                        + cfg.dim * cfg.vocab_size)
+    # attention term grows with context
+    assert bench.model_flops_per_token(cfg, 512) > f0
+    # mfu is linear in throughput and normalized by the chip peak
+    m1 = bench.model_mfu(cfg, 100.0, 128)
+    assert m1 > 0
+    assert abs(bench.model_mfu(cfg, 200.0, 128) - 2 * m1) < 1e-12
+    assert bench.model_mfu(cfg, 100.0, 128, peak_flops=1e12) > m1

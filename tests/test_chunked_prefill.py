@@ -1,12 +1,31 @@
 """Chunked prefill: long prompts run as fixed-size prefill_suffix steps
 with decode ticks interleaved (engine.py _admit). Greedy output must be
-token-identical to whole-prompt prefill."""
+token-identical to whole-prompt prefill.
+
+Post-mortem of the round-6 probabilistic retry guard (VERDICT r5 #3):
+the observed ~1/2000 chunked-vs-whole divergence was an argmax TIE, not
+a state bug. Chunked prefill accumulates attention in a different order
+than whole-prompt prefill; with random **bf16** weights a near-tied
+logit pair (gap below bf16's ~2^-8 relative rounding) can flip argmax
+under XLA's load-dependent reduction scheduling. Two findings pinned
+it: (1) the KV pages written at every chunk boundary are **bit-exact**
+invariants — later chunks never rewrite earlier rows (the invariant
+test below, misaligned boundaries included), so no cross-chunk state
+corruption exists for a flip to hide in; (2) in f32 (params + KV cache)
+the reduction-order noise is ~1e-6 relative while random-weight logit
+gaps are ~1e-2, so the same comparison is deterministic — 20/20 green
+under parallel suite load where the bf16 variant flaked. The
+equivalence tests therefore run the f32 engine with NO retry; bf16
+behavioral tests (cancel, fallback, cache reuse) keep the serving
+dtype."""
 
 from __future__ import annotations
 
 import threading
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 
 from aigw_tpu.models import llama
 from aigw_tpu.models.registry import get_model_spec
@@ -14,16 +33,20 @@ from aigw_tpu.tpuserve.engine import Engine, EngineConfig, GenRequest
 from aigw_tpu.tpuserve.sampling import SamplingParams
 
 
-def _engine(chunk: int, prefix_cache: bool = True) -> Engine:
+def _engine(chunk: int, prefix_cache: bool = True,
+            f32: bool = False, **over) -> Engine:
     spec = get_model_spec("tiny-random")
-    params = llama.init_params(jax.random.PRNGKey(7), spec.config)
-    return Engine(
-        params, spec.config,
-        EngineConfig(max_batch_size=2, max_seq_len=512, page_size=16,
-                     min_prefill_bucket=16, decode_steps_per_tick=4,
-                     prefill_chunk_tokens=chunk,
-                     enable_prefix_cache=prefix_cache),
-    )
+    params = llama.init_params(
+        jax.random.PRNGKey(7), spec.config,
+        jnp.float32 if f32 else jnp.bfloat16)
+    cfg = dict(max_batch_size=2, max_seq_len=512, page_size=16,
+               min_prefill_bucket=16, decode_steps_per_tick=4,
+               prefill_chunk_tokens=chunk,
+               enable_prefix_cache=prefix_cache)
+    if f32:
+        cfg["kv_cache_dtype"] = "float32"
+    cfg.update(over)
+    return Engine(params, spec.config, EngineConfig(**cfg))
 
 
 def _generate(eng: Engine, prompt: list[int], n: int = 6) -> list[int]:
@@ -46,33 +69,27 @@ def _generate(eng: Engine, prompt: list[int], n: int = 6) -> list[int]:
     return toks
 
 
-def _compare_chunked(prompt, chunk, min_steps, attempts=2):
-    """Greedy chunked-vs-whole comparison with one retry: chunked
-    prefill accumulates attention in a different order than whole-prompt
-    prefill, so with RANDOM bf16 weights a near-tied logit pair can
-    argmax-flip under XLA's load-dependent reduction scheduling
-    (observed ~1/2000 runs). A real chunk-boundary bug diverges
-    deterministically and still fails both attempts."""
-    last = None
-    for _ in range(attempts):
-        ref_eng = _engine(chunk=0)
-        ref_eng.start()
-        try:
-            ref = _generate(ref_eng, prompt)
-        finally:
-            ref_eng.stop()
-        eng = _engine(chunk=chunk)
-        eng.start()
-        try:
-            got = _generate(eng, prompt)
-            assert eng.stats.chunked_prefill_steps >= min_steps
-        finally:
-            eng.stop()
-        if got == ref:
-            return ref
-        last = (got, ref)
-    raise AssertionError(
-        f"chunked output diverged on every attempt: {last[0]} != {last[1]}")
+def _compare_chunked(prompt, chunk, min_steps):
+    """Deterministic greedy chunked-vs-whole equivalence, NO retry: the
+    engines run in f32 (params + KV cache), where reduction-order noise
+    (~1e-6 relative) cannot flip random-weight logit gaps (~1e-2) — see
+    the module docstring's tie-vs-state-bug post-mortem. Any mismatch
+    here is a real chunk-boundary bug."""
+    ref_eng = _engine(chunk=0, f32=True)
+    ref_eng.start()
+    try:
+        ref = _generate(ref_eng, prompt)
+    finally:
+        ref_eng.stop()
+    eng = _engine(chunk=chunk, f32=True)
+    eng.start()
+    try:
+        got = _generate(eng, prompt)
+        assert eng.stats.chunked_prefill_steps >= min_steps
+    finally:
+        eng.stop()
+    assert got == ref, f"chunked output diverged: {got} != {ref}"
+    return ref
 
 
 def test_chunked_matches_unchunked_greedy():
@@ -87,6 +104,80 @@ def test_chunk_boundary_not_multiple_of_page():
     prefix_lens)."""
     prompt = [(11 * i) % 400 + 2 for i in range(100)]
     _compare_chunked(prompt, chunk=24, min_steps=3)  # 24 % 16 != 0
+
+
+def _kv_rows(kv, pages: list[int], n: int, page_size: int) -> np.ndarray:
+    """Host copy of the KV rows holding positions [0, n)."""
+    slots = np.asarray(
+        [pages[p // page_size] * page_size + p % page_size
+         for p in range(n)], np.int32)
+    return np.asarray(kv[:, :, slots])
+
+
+def test_kv_pages_bit_exact_at_every_chunk_boundary():
+    """The state invariant under chunked prefill: each chunk writes
+    ONLY its own positions' K/V rows, so everything written by earlier
+    chunks is BIT-identical at every later boundary — including
+    boundaries that land mid-page (chunk 24 on 16-token pages). This is
+    the probe that separates an argmax tie from genuine cross-chunk
+    state corruption (module docstring post-mortem)."""
+    eng = _engine(chunk=24, f32=True)
+    ps = eng.cfg.page_size
+    chunk = 24
+    prompt = [(13 * i + 5) % 400 + 1 for i in range(100)]
+    n = len(prompt)
+    eng.allocator.allocate(0, n + 4)
+    pages = list(eng.allocator.pages(0))
+    P = eng.cfg.max_pages_per_seq
+    pt = np.zeros((1, P), np.int32)
+    pt[0, : len(pages)] = pages
+    need = eng.allocator.pages_for(n + 4)
+    bucket = 1
+    while bucket < need:
+        bucket *= 2
+    pt_dev = jnp.asarray(pt[:, : min(bucket, P)])
+    V = eng.model_cfg.vocab_size
+    sampling_args = (
+        jnp.zeros((1, 2), jnp.uint32),
+        jnp.asarray([0.0], jnp.float32),
+        jnp.asarray([1.0], jnp.float32),
+        jnp.asarray([0], jnp.int32),
+        jnp.zeros((1, V), jnp.float32),
+        jnp.asarray([eng._base_row], jnp.int32),
+    )
+
+    def suffix_step(tokens_row, prefix_len, seq_len):
+        _, eng.kv_cache = eng._prefill_suffix_fn(
+            eng.params, eng.lora_params, jnp.asarray(tokens_row),
+            jnp.asarray([prefix_len], jnp.int32),
+            jnp.asarray([seq_len], jnp.int32),
+            eng.kv_cache, pt_dev, *sampling_args)
+
+    snaps: list[tuple[int, np.ndarray]] = []
+
+    def check_and_snapshot(consumed: int) -> None:
+        rows = _kv_rows(eng.kv_cache, pages, consumed, ps)
+        for m, prev in snaps:
+            assert rows[:, :, :m].tobytes() == prev.tobytes(), (
+                f"KV rows for positions [0, {m}) changed after the "
+                f"chunk ending at {consumed}")
+        snaps.append((consumed, rows))
+
+    consumed = 0
+    ctokens = np.zeros((1, chunk), np.int32)
+    while n - consumed > chunk:  # the engine's exact chunk loop shape
+        ctokens[0, :] = prompt[consumed:consumed + chunk]
+        suffix_step(ctokens, consumed, consumed + chunk)
+        consumed += chunk
+        check_and_snapshot(consumed)
+    tail = prompt[consumed:]
+    toks = np.zeros((1, eng._prefill_bucket(len(tail))), np.int32)
+    toks[0, : len(tail)] = tail
+    suffix_step(toks, consumed, n)
+    check_and_snapshot(n)
+    # the schedule actually exercised misaligned boundaries
+    assert any(m % ps for m, _ in snaps[:-1])
+    assert len(snaps) >= 4
 
 
 def test_chunked_with_prefix_cache_reuse():
@@ -106,6 +197,25 @@ def test_chunked_with_prefix_cache_reuse():
                 - steps_after_first) <= steps_after_first
     finally:
         eng.stop()
+
+
+def test_bucket_rungs_do_not_change_tokens():
+    """prefill_bucket_rungs changes only PADDING (a 40-token prompt
+    runs a 48-wide prefill on the 1.5× rung ladder vs 64-wide on the
+    pow2 ladder); padded positions are masked, so greedy output is
+    identical — f32 determinism as in _compare_chunked."""
+    prompt = [(3 * i + 1) % 300 + 1 for i in range(40)]
+    outs = {}
+    for rungs in (1, 2):
+        eng = _engine(chunk=0, f32=True, prefill_bucket_rungs=rungs)
+        assert eng._prefill_bucket(40) == (64 if rungs == 1 else 48)
+        eng.start()
+        try:
+            outs[rungs] = _generate(eng, prompt)
+        finally:
+            eng.stop()
+    assert outs[1] == outs[2]
+    assert len(outs[1]) == 6
 
 
 def test_short_prompt_bypasses_chunking():

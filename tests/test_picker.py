@@ -52,6 +52,56 @@ class TestScoring:
         picks = {p.pick() for _ in range(3)}
         assert picks == {e.address for e in p.endpoints}
 
+    def test_failover_prefers_same_slice_on_ties(self):
+        """The session's replica dies; among equally-loaded survivors,
+        failover lands on the SAME-SLICE sibling (ICI locality), not
+        whichever address happens to sort first."""
+        p = make_picker()
+        headers = {AFFINITY_HEADER: "conv-slice"}
+        # session lands on the s1 replica (least loaded)
+        p.observe("10.0.0.1:8011", kv_occupancy=0.50, max_slots=8)
+        p.observe("10.0.0.2:8011", kv_occupancy=0.50, max_slots=8)
+        p.observe("10.0.0.3:8011", kv_occupancy=0.10, max_slots=8)
+        assert p.pick(headers) == "10.0.0.3:8011"
+        # reconfigure the pool so a second s1 replica exists, then kill
+        # the session's replica with the two survivors score-TIED
+        p = EndpointPicker([
+            Endpoint("10.0.0.1:8011", slice_name="s0"),
+            Endpoint("10.0.0.3:8011", slice_name="s1"),
+            Endpoint("10.0.0.4:8011", slice_name="s1"),
+        ])
+        p.observe("10.0.0.1:8011", kv_occupancy=0.30, max_slots=8)
+        p.observe("10.0.0.3:8011", kv_occupancy=0.10, max_slots=8)
+        p.observe("10.0.0.4:8011", kv_occupancy=0.30, max_slots=8)
+        assert p.pick(headers) == "10.0.0.3:8011"
+        p.state["10.0.0.3:8011"].healthy = False
+        # 10.0.0.1 and 10.0.0.4 tie at 0.30 — same-slice wins
+        assert p.pick(headers) == "10.0.0.4:8011"
+        # without a session there is no slice preference: ties break by
+        # min() order (first endpoint)
+        assert p.pick() == "10.0.0.1:8011"
+
+    def test_state_reported_slice_overrides_config(self):
+        """A replica's self-reported /state slice (jax.devices()
+        topology) beats the static config label."""
+        p = EndpointPicker([
+            Endpoint("a:1", slice_name="cfg-s0"),
+            Endpoint("b:1", slice_name="cfg-s1"),
+            Endpoint("c:1", slice_name="cfg-s1"),
+        ])
+        h = {AFFINITY_HEADER: "conv-x"}
+        # b reports it actually lives on s0 now (rescheduled)
+        p.observe("a:1", kv_occupancy=0.10, max_slots=8,
+                  slice_name="tpu-slice-0")
+        p.observe("b:1", kv_occupancy=0.30, max_slots=8,
+                  slice_name="tpu-slice-0")
+        p.observe("c:1", kv_occupancy=0.30, max_slots=8)
+        assert p.pick(h) == "a:1"
+        p.state["a:1"].healthy = False
+        # b (live-reported same slice) beats c (config says s1) at equal
+        # load
+        assert p.pick(h) == "b:1"
+
     def test_slice_affinity(self):
         """A session that landed on slice s1 prefers s1 replicas while
         load is comparable (ICI/KV-cache locality)."""

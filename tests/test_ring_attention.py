@@ -59,3 +59,24 @@ def test_ulysses_matches_full(qkv, causal):
     want = full_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_ring_production_shape_ab_smoke():
+    """A/B smoke at the shape the sp path actually serves — llama-3-8B
+    attention extents (H=32, Hkv=8, D=128) at the sp_prefill_min_tokens
+    threshold (S=1024) — ring kernel on the virtual 8-device mesh vs
+    the single-device XLA reference. Exercises the pvary-migrated scan
+    carries (utils/shard_compat.py) at production extents, where a
+    varying-axes typing bug would corrupt the online-softmax
+    accumulator rather than just failing to trace."""
+    B, S, H, Hkv, D = 1, 1024, 32, 8, 128
+    key = jax.random.PRNGKey(42)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, S, H, D), jnp.float32)
+    k = jax.random.normal(kk, (B, S, Hkv, D), jnp.float32)
+    v = jax.random.normal(kv, (B, S, Hkv, D), jnp.float32)
+    mesh = make_mesh(MeshSpec(dp=1, tp=1, sp=8))
+    got = ring_attention(q, k, v, mesh=mesh, causal=True, strategy="ring")
+    want = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)

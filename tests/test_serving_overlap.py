@@ -120,6 +120,91 @@ def test_async_transfer_tokens_identical_to_blocking():
     assert len(results[True][0]) > 0
 
 
+def test_first_token_fast_path_tokens_identical():
+    """first_token_fast_path on vs off: the knob moves host latency
+    (async token-0 copy, 1ms lone-arrival probe, first_emit
+    accounting), never values — greedy and seeded sampling streams are
+    byte-identical, for both the lone-arrival and burst admission
+    shapes."""
+    results: dict[bool, list[list[int]]] = {}
+    for fast in (False, True):
+        eng = _engine(first_token_fast_path=fast)
+        eng.start()
+        try:
+            # lone arrival (exercises the 1ms probe path)
+            s0 = _Stream()
+            eng.submit(_req([9, 4, 2, 7], 12, s0))
+            assert s0.done.wait(timeout=600)
+            # burst (exercises the batched-prefill fast path)
+            s1, s2 = _Stream(), _Stream()
+            eng.submit(_req([3, 1, 4, 1, 5, 9, 2, 6], 24, s1))
+            eng.submit(_req([2, 7, 1, 8, 2, 8], 24, s2, seed=123,
+                            temp=0.8))
+            assert s1.done.wait(timeout=600)
+            assert s2.done.wait(timeout=600)
+            results[fast] = [s0.toks, s1.toks, s2.toks]
+            if fast:
+                assert eng.stats.first_emit_ms > 0
+        finally:
+            eng.stop()
+    assert results[True] == results[False]
+    assert all(len(t) > 0 for t in results[True])
+
+
+def test_lean_decode_identical_to_full():
+    """Penalty-free batches dispatch the lean decode program (no counts
+    scatter, no penalty terms); forcing the full program on the same
+    requests must produce byte-identical streams — zero penalties add
+    exactly 0.0 per logit."""
+    results: dict[bool, list[list[int]]] = {}
+    for force_full in (False, True):
+        eng = _engine()
+        if force_full:
+            eng._lean_decode_ok = lambda: False  # type: ignore
+        eng.start()
+        try:
+            s1, s2 = _Stream(), _Stream()
+            eng.submit(_req([6, 2, 8, 3, 1], 20, s1))
+            eng.submit(_req([1, 7, 7, 2], 20, s2, seed=99, temp=0.7))
+            assert s1.done.wait(timeout=600)
+            assert s2.done.wait(timeout=600)
+            results[force_full] = [s1.toks, s2.toks]
+        finally:
+            eng.stop()
+    assert results[True] == results[False]
+    assert len(results[False][0]) > 0
+
+
+def test_penalized_request_forces_full_decode():
+    """A request with repetition penalties must route through the full
+    program (and still stream to completion) — the lean fork must never
+    drop penalty bookkeeping for a batch that needs it."""
+    eng = _engine()
+    eng.start()
+    try:
+        s = _Stream()
+        req = GenRequest(
+            prompt=[4, 5, 6], max_tokens=10,
+            sampling=SamplingParams(temperature=0.0,
+                                    frequency_penalty=0.5),
+            emit=s.emit,
+        )
+        eng.submit(req)
+        # engine thread observes the slot as penalized while decoding
+        deadline = time.monotonic() + 600
+        saw_full = False
+        while not s.done.wait(timeout=0.01):
+            if not eng._lean_decode_ok():
+                saw_full = True
+            if time.monotonic() > deadline:
+                break
+        assert s.done.is_set()
+        assert saw_full
+        assert len(s.toks) > 0
+    finally:
+        eng.stop()
+
+
 def test_adaptive_window_shrinks_then_regrows():
     """Queue pressure / young streams force the small window; a steady
     batch regrows to the full decode_steps_per_tick."""
@@ -170,5 +255,6 @@ def test_phase_breakdown_accumulates():
         assert eng.stats.prefill_ms > 0
         assert eng.stats.transfer_ms > 0
         assert eng.stats.emit_ms > 0
+        assert eng.stats.first_emit_ms > 0
     finally:
         eng.stop()

@@ -280,6 +280,9 @@ class TestTPUServeServer:
         got = asyncio.run(main())
         assert got["max_slots"] == 2
         assert "kv_occupancy" in got and "queued" in got
+        # first-token fast-path phase + ICI topology for the picker
+        assert "first_emit_ms" in got
+        assert "slice" in got and "device_coords" in got
 
 
 class TestEngineNumerics:
@@ -972,3 +975,25 @@ class TestLogprobs:
                 "max_tokens": 2, "temperature": 0,
             }))
         assert status == 200
+
+
+class TestSSEByteTemplate:
+    def test_template_frames_byte_identical_to_full_serialization(self):
+        """The streaming fast path splits one real stream_chunk_sse
+        frame on a sentinel and re-joins around json.dumps(piece); the
+        resulting bytes must equal serializing the whole chunk dict —
+        for every escaping-relevant piece shape."""
+        from aigw_tpu.schemas import openai as oai
+
+        sentinel = "\x00aigw-delta-slot\x00"
+        kw = dict(response_id="chatcmpl-abc123", model="tiny-random",
+                  created=1700000000)
+        head, tail = oai.stream_chunk_sse(
+            **kw, delta={"content": sentinel},
+        ).split(json.dumps(sentinel).encode())
+        for piece in ("hello", 'has "quotes" and \\slashes\\',
+                      "newline\nand\ttab", "unicodé ☃",
+                      "", "data: [DONE]", "\x07control"):
+            assert (head + json.dumps(piece).encode() + tail
+                    == oai.stream_chunk_sse(
+                        **kw, delta={"content": piece}))
