@@ -31,6 +31,17 @@ stays on its previous replica — whose prefix cache holds its KV — unless
 that replica's score exceeds the best alternative by
 ``STICKINESS_MARGIN``. Unhealthy or stale endpoints are skipped; with no
 telemetry at all the picker falls back to round-robin.
+
+Prefix affinity (``x-aigw-prefix-hash``, or derived from the request's
+system-prompt head by the gateway) is SOFT, not sticky: requests whose
+prefix hash was recently routed to a replica get a bounded score BONUS
+toward it — that replica's prefix cache already holds the shared
+system-prompt KV pages, so landing there turns the prompt prefill into
+a suffix (or single-token) resume. The bonus is a constant
+(``PREFIX_AFFINITY_BONUS``) while the load/queue_wait terms are
+unbounded, so affinity never overrides saturation; unlike sessions,
+many independent clients share one prefix key, and hard stickiness
+would funnel them all onto one replica.
 """
 
 from __future__ import annotations
@@ -49,6 +60,11 @@ logger = logging.getLogger(__name__)
 
 #: request header carrying a session affinity key (optional)
 AFFINITY_HEADER = "x-aigw-session-affinity"
+
+#: request header carrying a shared-prefix hash (optional; the gateway
+#: derives one from the system/developer message head when the backend
+#: enables the picker) — soft cache-affinity, see module docstring
+PREFIX_HEADER = "x-aigw-prefix-hash"
 
 
 @dataclass(frozen=True)
@@ -72,6 +88,9 @@ class EndpointState:
     active_slots: int = 0
     max_slots: int = 1
     queue_wait_ms: float = 0.0  # age of the oldest queued request
+    # prefix-cache effectiveness reported by the replica on /state
+    # (tpuserve prefix_cache_hit_rate) — dashboard/affinity telemetry
+    prefix_hit_rate: float = 0.0
     # ICI slice reported by the replica itself on /state (TPU multislice
     # slice_index) — overrides the statically configured slice label, so
     # topology follows reality after reschedules
@@ -95,6 +114,11 @@ class EndpointPicker:
         self._rr = itertools.cycle([e.address for e in endpoints])
         # session key → address, LRU-bounded
         self._affinity: "collections.OrderedDict[str, str]" = (
+            collections.OrderedDict()
+        )
+        # prefix hash → address a request with that prefix was most
+        # recently routed to (its prefix cache likely holds the pages)
+        self._prefix_affinity: "collections.OrderedDict[str, str]" = (
             collections.OrderedDict()
         )
         self._task: asyncio.Task | None = None
@@ -142,6 +166,7 @@ class EndpointPicker:
         st.active_slots = int(data.get("active_slots", 0))
         st.max_slots = max(1, int(data.get("max_slots", 1)))
         st.queue_wait_ms = float(data.get("queue_wait_ms", 0.0))
+        st.prefix_hit_rate = float(data.get("prefix_cache_hit_rate", 0.0))
         st.slice_name = str(data.get("slice", "") or "")
         st.updated_at = time.monotonic()
 
@@ -149,6 +174,7 @@ class EndpointPicker:
     def observe(self, address: str, *, kv_occupancy: float = 0.0,
                 queued: int = 0, active_slots: int = 0,
                 max_slots: int = 1, queue_wait_ms: float = 0.0,
+                prefix_hit_rate: float = 0.0,
                 slice_name: str = "") -> None:
         st = self.state[address]
         st.healthy = True
@@ -157,6 +183,7 @@ class EndpointPicker:
         st.active_slots = active_slots
         st.max_slots = max(1, max_slots)
         st.queue_wait_ms = queue_wait_ms
+        st.prefix_hit_rate = prefix_hit_rate
         if slice_name:
             st.slice_name = slice_name
         st.updated_at = time.monotonic()
@@ -172,6 +199,13 @@ class EndpointPicker:
     #: migration and any future KV-transfer path stay on ICI instead of
     #: DCN. Small enough that real load imbalance still dominates.
     SLICE_PENALTY = 0.25
+    #: score bonus toward the replica that recently served this request's
+    #: prefix hash (its prefix cache likely holds the shared prompt
+    #: pages). A CONSTANT, while the occupancy/queue/queue_wait terms are
+    #: unbounded — cache affinity tips ties and small skews but never
+    #: overrides a saturated replica. Below STICKINESS_MARGIN so session
+    #: stickiness (exact-KV locality) outranks prefix locality.
+    PREFIX_AFFINITY_BONUS = 0.3
     _AFFINITY_MAX = 100_000
 
     def _slice_of(self, addr: str) -> str:
@@ -191,6 +225,9 @@ class EndpointPicker:
         now = time.monotonic()
         affinity_key = (headers or {}).get(AFFINITY_HEADER, "")
         prev_addr = self._affinity.get(affinity_key) if affinity_key else None
+        prefix_key = (headers or {}).get(PREFIX_HEADER, "")
+        prefix_addr = (self._prefix_affinity.get(prefix_key)
+                       if prefix_key else None)
         # the slice to prefer: where the session's replica lives —
         # meaningful even when that replica is unhealthy (failover
         # should land on a same-slice sibling)
@@ -208,6 +245,10 @@ class EndpointPicker:
             )
             if prev_slice and self._slice_of(e.address) != prev_slice:
                 score += self.SLICE_PENALTY
+            if prefix_addr == e.address:
+                # prefix-affinity: this replica recently served this
+                # prefix hash — its cache likely still holds the pages
+                score -= self.PREFIX_AFFINITY_BONUS
             return score
 
         scores = {e.address: score_of(e) for e in self.endpoints}
@@ -232,4 +273,12 @@ class EndpointPicker:
             self._affinity.move_to_end(affinity_key)
             while len(self._affinity) > self._AFFINITY_MAX:
                 self._affinity.popitem(last=False)  # LRU eviction
+        if prefix_key:
+            # remember where this prefix landed — the NEXT request with
+            # the same prefix hash prefers the replica whose cache the
+            # routing just warmed (even when load moved it this time)
+            self._prefix_affinity[prefix_key] = chosen
+            self._prefix_affinity.move_to_end(prefix_key)
+            while len(self._prefix_affinity) > self._AFFINITY_MAX:
+                self._prefix_affinity.popitem(last=False)
         return chosen

@@ -46,6 +46,7 @@ from aigw_tpu.gateway.costs import TokenUsage
 from aigw_tpu.gateway.mutators import apply_body_mutation, apply_header_mutation
 from aigw_tpu.gateway.picker import (
     AFFINITY_HEADER,
+    PREFIX_HEADER,
     Endpoint as PickerEndpoint,
     EndpointPicker,
 )
@@ -131,6 +132,33 @@ def _conversation_affinity_key(body: dict) -> str:
     if first_user is None:
         return ""
     head.append(first_user)
+    blob = _json.dumps(head, sort_keys=True).encode()
+    return _hashlib.blake2b(blob, digest_size=12).hexdigest()
+
+
+def _prefix_hash_key(body: dict) -> str:
+    """Key the request's SHARED prompt prefix — the system/developer
+    messages only. Unlike the conversation key (which includes the first
+    user message and so is unique per chat), every request templated
+    from the same system prompt shares this hash, so the picker can
+    steer them toward the replica whose KV prefix cache already holds
+    those pages (soft cache-affinity routing, gateway/picker.py)."""
+    import hashlib as _hashlib
+    import json as _json
+
+    messages = body.get("messages")
+    if not isinstance(messages, list) or not messages:
+        return ""
+    head: list = []
+    for m in messages:
+        if not isinstance(m, dict):
+            return ""
+        if m.get("role") in ("system", "developer"):
+            head.append(m)
+        else:
+            break
+    if not head:
+        return ""
     blob = _json.dumps(head, sort_keys=True).encode()
     return _hashlib.blake2b(blob, digest_size=12).hexdigest()
 
@@ -788,15 +816,21 @@ class GatewayServer:
         dest = request.headers.get(DESTINATION_ENDPOINT_HEADER, "")
         if not dest and backend.name in self._pickers:
             pick_headers = client_headers
-            if (
-                backend.picker_content_affinity
-                and AFFINITY_HEADER not in client_headers
-                and isinstance(body, dict)
-            ):
-                key = _conversation_affinity_key(body)
-                if key:
-                    pick_headers = dict(client_headers)
-                    pick_headers[AFFINITY_HEADER] = key
+            if backend.picker_content_affinity and isinstance(body, dict):
+                derived = {}
+                if AFFINITY_HEADER not in client_headers:
+                    key = _conversation_affinity_key(body)
+                    if key:
+                        derived[AFFINITY_HEADER] = key
+                if PREFIX_HEADER not in client_headers:
+                    # shared system-prompt hash → soft cache-affinity:
+                    # the picker prefers the replica whose prefix cache
+                    # this prompt head was recently routed to
+                    pkey = _prefix_hash_key(body)
+                    if pkey:
+                        derived[PREFIX_HEADER] = pkey
+                if derived:
+                    pick_headers = dict(client_headers) | derived
             dest = self._pickers[backend.name].pick(pick_headers) or ""
         base_url = f"http://{dest}" if dest else backend.url
         if not base_url:

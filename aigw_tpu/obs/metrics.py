@@ -102,6 +102,16 @@ ENGINE_GAUGES: tuple[tuple[str, str], ...] = (
     ("spec_accepted", "tpuserve_spec_accepted_total"),
     ("prefix_cache_hits", "tpuserve_prefix_cache_hits_total"),
     ("prefix_tokens_reused", "tpuserve_prefix_tokens_reused_total"),
+    # prefix-cache reuse surface (ISSUE 3): hit/miss/eviction counters,
+    # the full-hit fast path (CoW'd final page + single-token resume),
+    # and the residency/pinning gauges behind HBM capacity planning
+    ("prefix_cache_misses", "tpuserve_prefix_cache_misses_total"),
+    ("prefix_cache_evictions", "tpuserve_prefix_cache_evictions_total"),
+    ("prefix_full_hits", "tpuserve_prefix_full_hits_total"),
+    ("prefix_cow_copies", "tpuserve_prefix_cow_copies_total"),
+    ("prefix_pages_resident", "tpuserve_prefix_pages_resident"),
+    ("prefix_pages_pinned", "tpuserve_prefix_pages_pinned"),
+    ("prefix_cache_hit_rate", "tpuserve_prefix_cache_hit_rate"),
     ("prefill_ms", "tpuserve_prefill_ms_total"),
     ("transfer_ms", "tpuserve_transfer_ms_total"),
     ("emit_ms", "tpuserve_emit_ms_total"),

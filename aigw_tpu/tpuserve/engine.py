@@ -215,6 +215,12 @@ class GenRequest:
     # (token, finish, logprob, top) where top = [(token_id, logprob)]
     # of the engine's top-k (callers slice to the request's own k).
     emit_lp: "Callable[[int, str | None, float | None, list | None], None] | None" = None
+    # Pre-computed page-chain prefix hashes (kvcache.page_chain_hashes
+    # over this prompt at the ENGINE's page size) — the server's
+    # tokenizer pool rolls them during encode so admission-time lookup
+    # costs no extra pass over the prompt. None (or a stale length —
+    # defensive) falls back to hashing at classification time.
+    prefix_hashes: list | None = None
 
 
 @dataclass
@@ -254,6 +260,20 @@ class EngineStats:
     decode_steps: int = 0
     prefix_cache_hits: int = 0
     prefix_tokens_reused: int = 0
+    # prefix-cache surface (ISSUE 3): misses counted over page-eligible
+    # prompts (≥ one full page of potential reuse), so hit_rate is
+    # hits / (hits + misses) over prompts the cache could have served
+    prefix_cache_misses: int = 0
+    prefix_cache_evictions: int = 0
+    # full-prefix hits: the whole prompt's KV was cached — admission
+    # skips the prompt prefill and runs a single-token resume against a
+    # copy-on-write'd final page
+    prefix_full_hits: int = 0
+    prefix_cow_copies: int = 0
+    # gauges refreshed from the cache/allocator each tick
+    prefix_pages_resident: int = 0
+    prefix_pages_pinned: int = 0
+    prefix_cache_hit_rate: float = 0.0
     # adaptive decode window: the K chosen for the most recent dispatch
     # and how often the policy moved it (obs/metrics.py exports these)
     decode_window: int = 0
@@ -415,6 +435,9 @@ class Engine:
         self._need_rebuild = True
         self._state_bucket = 0  # page bucket the live state was built at
         self._row_update_fn = None
+        # copy-on-write page clone (full-prefix hits): one compiled
+        # program regardless of src/dst ids (dynamic slice indices)
+        self._copy_page_fn = None
         # 1-deep pipeline: the window dispatched to the device while the
         # host processes the previous window's tokens.
         self._inflight: _Window | None = None
@@ -730,6 +753,32 @@ class Engine:
             min(S * q // 4, self.cfg.max_seq_len) for q in quarters
         })
 
+    def _copy_page_dev(self, src: int, dst: int) -> None:
+        """Clone one KV page on-device (copy-on-write for full-prefix
+        hits). Dynamic slice indices: ONE compiled program for any
+        (src, dst) pair; the kv_cache donation chain orders the copy
+        after every already-dispatched window that reads ``src``."""
+        if self._copy_page_fn is None:
+            ps = self.cfg.page_size
+
+            def _cp(kv, src_page, dst_page):
+                rows = jax.lax.dynamic_slice_in_dim(
+                    kv, src_page * ps, ps, axis=2)
+                return jax.lax.dynamic_update_slice_in_dim(
+                    kv, rows, dst_page * ps, axis=2)
+
+            self._copy_page_fn = jax.jit(_cp, donate_argnums=(0,))
+        self.kv_cache = self._copy_page_fn(
+            self.kv_cache, jnp.int32(src), jnp.int32(dst))
+
+    @property
+    def kv_page_bytes(self) -> int:
+        """HBM bytes of one KV page (the /state bytes-pinned signal)."""
+        mc = self.model_cfg
+        itemsize = 4 if self.cfg.kv_cache_dtype == "float32" else 2
+        return (mc.n_layers * 2 * self.cfg.page_size * mc.n_kv_heads
+                * mc.head_dim * itemsize)
+
     @staticmethod
     def _start_host_copy(tree: Any) -> None:
         """Begin the device→host copy of every array leaf now
@@ -1036,17 +1085,24 @@ class Engine:
         """(simple, chain_keys): simple = eligible for the batched
         prefill (whole-prompt, no cached prefix to adopt, below the
         sequence-parallel and chunking thresholds, resolvable adapter).
-        chain_keys are the prompt's content hashes — computed ONCE here
-        and reused by both paths; only the cheap cache *probe* is redone
-        at adoption time (cache state moves within a pass)."""
+        chain_keys are the prompt's content hashes — taken from
+        req.prefix_hashes when the server's tokenizer pool pre-rolled
+        them during encode, else computed ONCE here — and reused by
+        both paths; only the cheap cache *probe* is redone at adoption
+        time (cache state moves within a pass)."""
         n = len(req.prompt)
         if n < 1:
             return False, []
         chain: list = []
         if self.prefix_cache is not None and n > 1:
-            chain = self.prefix_cache.chain_keys(req.prompt)
+            ps = self.cfg.page_size
+            if (req.prefix_hashes is not None
+                    and len(req.prefix_hashes) == n // ps):
+                chain = req.prefix_hashes
+            else:
+                chain = self.prefix_cache.chain_keys(req.prompt)
             hits = len(self.prefix_cache.probe(chain))
-            if min(hits, (n - 1) // self.cfg.page_size) > 0:
+            if min(hits, n // ps) > 0:
                 return False, chain
         if (self._prefill_sp_fn is not None
                 and n >= self.cfg.sp_prefill_min_tokens):
@@ -1158,6 +1214,8 @@ class Engine:
                 )
             chain = chain_by_req.get(id(req), [])
             if self.prefix_cache is not None and chain:
+                # batched path = classified with no reusable prefix
+                self.stats.prefix_cache_misses += 1
                 self.prefix_cache.insert(
                     chain, self.allocator.pages(seq_id))
             self._slots[slot_idx] = _Slot(
@@ -1202,17 +1260,30 @@ class Engine:
         seq_id = next(self._seq_ids)
         ps = self.cfg.page_size
 
-        # prefix cache: adopt the longest cached page-prefix (capped so
-        # at least one suffix token remains to produce first logits)
+        # prefix cache: adopt the longest cached page-prefix. A FULL
+        # prefix hit (every prompt page cached, prompt page-aligned)
+        # adopts everything, copy-on-writes the final page into a
+        # private clone, and resumes with a single-token step — the
+        # prompt prefill dispatch is skipped entirely; the resume rides
+        # the first-token fast path like any prefill's sampled token.
+        # Partial hits must leave at least one suffix token to produce
+        # first logits, which page-granular hashing gives for free.
         cached_pages: list[int] = []
         chain_keys: list = []
+        full_hit = False
         if self.prefix_cache is not None and n > 1:
             chain_keys = (chain if chain is not None
                           else self.prefix_cache.chain_keys(req.prompt))
             hit_pages = self.prefix_cache.probe(chain_keys)
-            hits = min(len(hit_pages), (n - 1) // ps)
+            hits = min(len(hit_pages), n // ps)
+            full_hit = hits > 0 and hits * ps == n
             cached_pages = hit_pages[:hits]
         prefix_len = len(cached_pages) * ps
+        if full_hit:
+            # re-run only the last prompt token: its forward pass
+            # yields the first-token logits; its (bit-recomputed) K/V
+            # lands in the CoW'd private page, never the shared one
+            prefix_len = n - 1
 
         try:
             if cached_pages:
@@ -1220,6 +1291,12 @@ class Engine:
                 extra = self.allocator.pages_for(total) - len(cached_pages)
                 if extra > 0:
                     self.allocator.allocate_extra(seq_id, extra)
+                if full_hit:
+                    shared_last = cached_pages[-1]
+                    fresh = self.allocator.cow_page(seq_id, shared_last)
+                    self._copy_page_dev(shared_last, fresh)
+                    self.stats.prefix_full_hits += 1
+                    self.stats.prefix_cow_copies += 1
             else:
                 self.allocator.allocate(seq_id, total)
         except OutOfPagesError:
@@ -1337,6 +1414,9 @@ class Engine:
         if prefix_len:
             self.stats.prefix_cache_hits += 1
             self.stats.prefix_tokens_reused += prefix_len
+        elif chain_keys:
+            # page-eligible prompt, nothing reusable cached
+            self.stats.prefix_cache_misses += 1
         if eff_prefix:
             next_tok, self.kv_cache = self._prefill_suffix_fn(
                 self.params,
@@ -1453,9 +1533,20 @@ class Engine:
         counts = np.zeros((B, V), np.int32)
         bias = np.zeros((B, V), np.float32)
         adapter_idx = np.full((B,), self._base_row, np.int32)
+        repin = getattr(self.allocator, "repin", None)
         for i, s in enumerate(self._slots):
             if s is None:
                 continue
+            if repin is not None:
+                # full rebuilds re-assert page pins: a speculative
+                # session's adopted prefix pages must survive the
+                # rebuild, never drift into the evictable pool while
+                # the slot still reads them
+                fixed = repin(s.req.id)
+                if fixed:
+                    logger.warning(
+                        "state rebuild re-pinned %d orphaned pages for "
+                        "seq %d", fixed, s.req.id)
             tokens[i] = s.pending_token
             positions[i] = s.pos
             limits[i] = s.limit
@@ -1772,6 +1863,16 @@ class Engine:
         self.stats.queued = self._queue.qsize()
         self.stats.kv_pages_free = self.allocator.free_pages
         self.stats.kv_occupancy = self.allocator.occupancy
+        if self.prefix_cache is not None:
+            self.stats.prefix_cache_evictions = self.prefix_cache.evictions
+            self.stats.prefix_pages_resident = (
+                self.prefix_cache.resident_entries)
+            self.stats.prefix_pages_pinned = (
+                self.allocator.pinned_cached_pages)
+            hm = (self.stats.prefix_cache_hits
+                  + self.stats.prefix_cache_misses)
+            self.stats.prefix_cache_hit_rate = (
+                self.stats.prefix_cache_hits / hm if hm else 0.0)
         # age of the oldest waiting request — the picker's queue-latency
         # term. Peeking the underlying deque is safe here: entries are
         # only appended by other threads, and a request popped between

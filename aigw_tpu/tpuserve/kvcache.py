@@ -12,7 +12,32 @@ occupancy), the role the reference's EPP plays via
 
 from __future__ import annotations
 
+import hashlib
 from dataclasses import dataclass, field
+
+
+def page_chain_hashes(
+    tokens: list[int], page_size: int, prev: bytes = b""
+) -> list[bytes]:
+    """Chained per-page content hashes over full prompt pages.
+
+    key_i = H(key_{i-1} ‖ token ids of page i), so key_i identifies the
+    ENTIRE token prefix through page i — the chain map is a radix tree
+    flattened to one hash lookup per page-aligned depth (the vLLM
+    automatic-prefix-caching construction). Shared between PrefixCache
+    and the server's tokenizer pool, which computes the chain during
+    encode so engine-side lookup costs no extra pass over the prompt.
+    ``prev`` resumes the chain from an already-hashed prefix.
+    """
+    keys: list[bytes] = []
+    for i in range(len(tokens) // page_size):
+        chunk = tokens[i * page_size : (i + 1) * page_size]
+        h = hashlib.blake2b(digest_size=16)
+        h.update(prev)
+        h.update(b",".join(str(t).encode() for t in chunk))
+        prev = h.digest()
+        keys.append(prev)
+    return keys
 
 
 class OutOfPagesError(Exception):
@@ -150,16 +175,55 @@ class RefcountedAllocator(PageAllocator):
 
     def free(self, seq_id: int) -> None:
         for page in self._owned.pop(seq_id, []):
-            refs = self._refs.get(page, 1) - 1
-            if refs > 0:
-                self._refs[page] = refs
-                continue
-            self._refs.pop(page, None)
-            key = self._cache_key_of(page)
-            if key is not None:
-                self._evictable[page] = key  # park, revivable
-            else:
-                self._free.append(page)
+            self._release_page(page)
+
+    def _release_page(self, page: int) -> None:
+        """Drop one reference; a last reference parks cache-registered
+        pages in the LRU evictable pool (revivable by a later hit) and
+        returns unregistered pages to the free stack."""
+        refs = self._refs.get(page, 1) - 1
+        if refs > 0:
+            self._refs[page] = refs
+            return
+        self._refs.pop(page, None)
+        key = self._cache_key_of(page)
+        if key is not None:
+            self._evictable[page] = key  # park, revivable
+        else:
+            self._free.append(page)
+
+    def cow_page(self, seq_id: int, page: int) -> int:
+        """Copy-on-write divergence: replace shared ``page`` in seq_id's
+        chain with a fresh private page the sequence may write into
+        (the caller copies the device-side K/V rows). The shared page
+        keeps its cache registration; its refcount drops by one."""
+        owned = self._owned.get(seq_id, [])
+        idx = owned.index(page)  # ValueError = caller bug, fail loudly
+        if self.available_pages < 1:
+            raise OutOfPagesError("no free or evictable pages for CoW")
+        fresh = self._pop_page()
+        self._refs[fresh] = 1
+        owned[idx] = fresh
+        self._release_page(page)
+        return fresh
+
+    def repin(self, seq_id: int) -> int:
+        """Re-assert pins on a live sequence's pages (full-state
+        rebuilds — speculation rebuilds the on-device history every
+        admission). Any owned page found parked in the evictable pool
+        or missing its refcount is pulled back into active use instead
+        of being orphaned into eviction while the sequence still reads
+        it. Returns the number of pages re-pinned (0 when healthy)."""
+        fixed = 0
+        for p in self._owned.get(seq_id, []):
+            if p in self._evictable:
+                del self._evictable[p]
+                self._refs[p] = self._refs.get(p, 0) + 1
+                fixed += 1
+            elif p not in self._refs:
+                self._refs[p] = 1
+                fixed += 1
+        return fixed
 
     # cache bookkeeping — maintained by PrefixCache
     def _cache_key_of(self, page: int):
@@ -171,6 +235,19 @@ class RefcountedAllocator(PageAllocator):
         # evictable pages are reclaimable: count them as free capacity
         return self.num_pages - len(self._free) - len(self._evictable)
 
+    @property
+    def pinned_cached_pages(self) -> int:
+        """Cache-registered pages currently referenced by live
+        sequences — KV the prefix cache holds PINNED in HBM (the
+        picker-visible ``prefix_pages_pinned`` / bytes-pinned signal;
+        parked evictable pages are resident but reclaimable, not
+        pinned)."""
+        cache = getattr(self, "_prefix_cache", None)
+        if cache is None:
+            return 0
+        return sum(1 for p in self._refs if cache.key_of_page(p)
+                   is not None)
+
 
 class PrefixCache:
     """Content-addressed map of full prompt pages → pool page ids.
@@ -181,27 +258,23 @@ class PrefixCache:
     """
 
     def __init__(self, allocator: "RefcountedAllocator", page_size: int):
-        import hashlib as _h
-
-        self._h = _h
         self.allocator = allocator
         self.page_size = page_size
         self._by_key: dict[bytes, int] = {}
         self._key_by_page: dict[int, bytes] = {}
+        #: entries reclaimed under pool pressure (monotonic counter)
+        self.evictions = 0
         allocator._prefix_cache = self
         allocator.set_evict_callback(self._evicted)
 
     def chain_keys(self, prompt: list[int]) -> list[bytes]:
-        keys = []
-        prev = b""
-        for i in range(len(prompt) // self.page_size):
-            chunk = prompt[i * self.page_size : (i + 1) * self.page_size]
-            h = self._h.blake2b(digest_size=16)
-            h.update(prev)
-            h.update(b",".join(str(t).encode() for t in chunk))
-            prev = h.digest()
-            keys.append(prev)
-        return keys
+        return page_chain_hashes(prompt, self.page_size)
+
+    @property
+    def resident_entries(self) -> int:
+        """Prefixes (page-chain nodes) currently resident — pinned by
+        live sequences or parked evictable."""
+        return len(self._by_key)
 
     def probe(self, keys: list[bytes]) -> list[int]:
         """Pages of the longest cached prefix for pre-hashed chain keys.
@@ -233,3 +306,4 @@ class PrefixCache:
         page = self._by_key.pop(key, None)
         if page is not None:
             self._key_by_page.pop(page, None)
+            self.evictions += 1

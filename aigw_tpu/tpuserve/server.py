@@ -40,6 +40,7 @@ from aigw_tpu.tpuserve.engine import (
     EngineOverloadedError,
     GenRequest,
 )
+from aigw_tpu.tpuserve.kvcache import page_chain_hashes
 from aigw_tpu.tpuserve.sampling import SamplingParams
 from aigw_tpu.tpuserve.tokenizer import (
     StreamingDecoder,
@@ -314,8 +315,28 @@ class TPUServeServer:
                 f"{min(cap, 20)}")
         return top_n
 
+    def _prefix_hashes_for(self, prompt: list[int]) -> list | None:
+        """Roll the prompt's page-chain prefix hashes at the engine's
+        page size — called on the tokenizer pool right after encode, so
+        the engine's prefix-cache lookup costs no extra prompt pass on
+        the admission thread."""
+        if self.engine.prefix_cache is None:
+            return None
+        return page_chain_hashes(prompt, self.engine.cfg.page_size)
+
+    def _encode_chat(self, msgs) -> tuple[list[int], list | None]:
+        """Template+encode a chat AND roll its prefix hashes (one pool
+        job — the hash pass rides the encode's executor hop)."""
+        prompt = apply_chat_template(msgs, self.tokenizer,
+                                     self.chat_template)
+        return prompt, self._prefix_hashes_for(prompt)
+
+    def _encode_text(self, text: str) -> tuple[list[int], list | None]:
+        prompt = [self.tokenizer.bos_id] + self.tokenizer.encode(text)
+        return prompt, self._prefix_hashes_for(prompt)
+
     def _submit(self, prompt: list[int], body: dict[str, Any],
-                lp_top_n: int = -1):
+                lp_top_n: int = -1, prefix_hashes: list | None = None):
         """Submit to the engine; returns an asyncio.Queue of
         (token_id, finish_reason, lp) tuples — lp is None without
         logprobs, else (chosen_logprob, [(top_id, top_logprob)]).
@@ -343,6 +364,7 @@ class TPUServeServer:
             emit=emit,
             emit_lp=emit_lp if lp_top_n >= 0 else None,
             adapter=self._resolve_adapter(str(body.get("model", ""))),
+            prefix_hashes=prefix_hashes,
         )
         self.engine.submit(req)
         return out, req
@@ -389,15 +411,14 @@ class TPUServeServer:
             # microseconds — the executor round-trip would cost more
             # than it hides AND spread a burst's submits across extra
             # event-loop turns (admission coalescing then waits on the
-            # stragglers). Long prompts keep the pool hop.
-            prompt = apply_chat_template(msgs, self.tokenizer,
-                                         self.chat_template)
+            # stragglers). Long prompts keep the pool hop. Both paths
+            # also roll the prompt's prefix-cache chain hashes here, so
+            # engine admission never re-reads the prompt to probe.
+            prompt, hashes = self._encode_chat(msgs)
         else:
-            prompt = await self._off(
-                apply_chat_template, msgs, self.tokenizer,
-                self.chat_template,
-            )
-        return await self._generate(request, body, prompt, chat=True)
+            prompt, hashes = await self._off(self._encode_chat, msgs)
+        return await self._generate(request, body, prompt, chat=True,
+                                    prefix_hashes=hashes)
 
     #: request text below this many chars tokenizes inline on the event
     #: loop (HF tokenizer throughput is ~MB/s; 4KiB is ~ms)
@@ -431,13 +452,12 @@ class TPUServeServer:
         if isinstance(prompt_text, list):
             prompt_text = "".join(prompt_text)
         if len(prompt_text) < self._INLINE_TOKENIZE_CHARS:
-            prompt = [self.tokenizer.bos_id] + self.tokenizer.encode(
-                prompt_text)
+            prompt, hashes = self._encode_text(prompt_text)
         else:
-            prompt = [self.tokenizer.bos_id] + await self._off(
-                self.tokenizer.encode, prompt_text
-            )
-        return await self._generate(request, body, prompt, chat=False)
+            prompt, hashes = await self._off(self._encode_text,
+                                             prompt_text)
+        return await self._generate(request, body, prompt, chat=False,
+                                    prefix_hashes=hashes)
 
     async def _generate(
         self,
@@ -445,6 +465,7 @@ class TPUServeServer:
         body: dict[str, Any],
         prompt: list[int],
         chat: bool,
+        prefix_hashes: list | None = None,
     ) -> web.StreamResponse:
         stream = bool(body.get("stream", False))
         try:
@@ -466,9 +487,10 @@ class TPUServeServer:
                     content_type="application/json")
             if stream:
                 return await self._generate_n_stream(
-                    request, body, prompt, chat, n, lp_top_n)
+                    request, body, prompt, chat, n, lp_top_n,
+                    prefix_hashes)
             return await self._generate_n(body, prompt, chat, n,
-                                          lp_top_n)
+                                          lp_top_n, prefix_hashes)
         include_usage = oai.include_stream_usage(body)
         rid = (
             f"chatcmpl-{uuid.uuid4().hex[:24]}"
@@ -488,7 +510,8 @@ class TPUServeServer:
             [stops] if isinstance(stops, str) else list(stops or [])
         )
         try:
-            out, gen_req = self._submit(prompt, body, lp_top_n)
+            out, gen_req = self._submit(prompt, body, lp_top_n,
+                                        prefix_hashes)
         except EngineOverloadedError as e:
             return web.Response(
                 status=429,
@@ -738,7 +761,7 @@ class TPUServeServer:
         return resp
 
     def _submit_n(self, body: dict[str, Any], prompt: list[int], n: int,
-                  lp_top_n: int):
+                  lp_top_n: int, prefix_hashes: list | None = None):
         """Fan out n engine submissions with per-choice seeds (shared by
         the buffered and streaming n>1 paths — one copy of the seed
         derivation, overload cleanup, and error mapping). Returns the
@@ -753,7 +776,8 @@ class TPUServeServer:
                 per_choice["seed"] = (sampling.seed or 0) + i if (
                     sampling.seed or sampling.temperature > 0
                 ) else 0
-                outs.append(self._submit(prompt, per_choice, lp_top_n))
+                outs.append(self._submit(prompt, per_choice, lp_top_n,
+                                         prefix_hashes))
         except EngineOverloadedError as e:
             for _q, req in outs:  # don't orphan already-queued choices
                 req.cancelled.set()
@@ -778,14 +802,14 @@ class TPUServeServer:
 
     async def _generate_n(
         self, body: dict[str, Any], prompt: list[int], chat: bool, n: int,
-        lp_top_n: int = -1,
+        lp_top_n: int = -1, prefix_hashes: list | None = None,
     ) -> web.Response:
         """n>1 choices: fan out n engine requests (continuous batching
         runs them concurrently — same prompt pages shared by the prefix
         cache) and assemble a multi-choice response."""
         stops = body.get("stop")
         stop_strs = [stops] if isinstance(stops, str) else list(stops or [])
-        outs = self._submit_n(body, prompt, n, lp_top_n)
+        outs = self._submit_n(body, prompt, n, lp_top_n, prefix_hashes)
         if isinstance(outs, web.Response):
             return outs
         results = await asyncio.gather(
@@ -831,6 +855,7 @@ class TPUServeServer:
     async def _generate_n_stream(
         self, request: web.Request, body: dict[str, Any],
         prompt: list[int], chat: bool, n: int, lp_top_n: int = -1,
+        prefix_hashes: list | None = None,
     ) -> web.StreamResponse:
         """Streaming n>1 (OpenAI parity; previously 400): fan out n
         engine requests, merge their token streams, and emit one SSE
@@ -841,7 +866,7 @@ class TPUServeServer:
         stops = body.get("stop")
         stop_strs = [stops] if isinstance(stops, str) else list(stops or [])
         include_usage = oai.include_stream_usage(body)
-        outs = self._submit_n(body, prompt, n, lp_top_n)
+        outs = self._submit_n(body, prompt, n, lp_top_n, prefix_hashes)
         if isinstance(outs, web.Response):
             return outs
 
@@ -1145,6 +1170,16 @@ class TPUServeServer:
                 "transfer_ms": round(s.transfer_ms, 3),
                 "emit_ms": round(s.emit_ms, 3),
                 "first_emit_ms": round(s.first_emit_ms, 3),
+                # prefix-cache surface: the picker's prefix-affinity
+                # scoring and capacity dashboards read these
+                "prefix_cache_hit_rate": round(s.prefix_cache_hit_rate, 4),
+                "prefix_pages_resident": s.prefix_pages_resident,
+                "prefix_pages_pinned": s.prefix_pages_pinned,
+                "prefix_bytes_pinned": (
+                    s.prefix_pages_pinned * self.engine.kv_page_bytes),
+                "prefix_cache_hits": s.prefix_cache_hits,
+                "prefix_cache_misses": s.prefix_cache_misses,
+                "prefix_cache_evictions": s.prefix_cache_evictions,
                 # ICI topology: the picker's same-slice preference term
                 # (gateway/picker.py) keys on this
                 **device_topology(),

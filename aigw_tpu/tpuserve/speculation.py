@@ -27,6 +27,15 @@ never equals a sampled id), so they advance one exact token per step —
 penalty counts evolve per accepted token, and within-window count
 updates for multi-token acceptance would be approximate otherwise.
 
+Prefix-cache interplay: speculation forces a FULL device-state rebuild
+on every admission (the on-device history buffer has no row-update
+path). A rebuild must RE-PIN, never orphan, a live session's adopted
+prefix pages — the engine re-asserts every active slot's page pins via
+``RefcountedAllocator.repin`` inside ``_build_device_state``, so a
+speculative session's shared pages can never drift into the evictable
+pool while the session still reads them (regression:
+tests/test_spec_decode.py::TestSpecPrefixCacheInterplay).
+
 The reference has no serving engine (it routes to upstream providers);
 this subsystem exists because the TPU framework ships its own model
 server (SURVEY.md §2.9). The technique is prompt-lookup decoding

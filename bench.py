@@ -45,8 +45,8 @@ import jax
 # environment says cpu (see tests/conftest.py); config.update after
 # import is the only override that sticks. Without this, CPU-ratio mode
 # hangs forever dialing the dead TPU tunnel.
-if "--cpu-gateway-ratio" in sys.argv or os.environ.get(
-        "JAX_PLATFORMS", "") == "cpu":
+if ("--cpu-gateway-ratio" in sys.argv or "--ab" in sys.argv
+        or os.environ.get("JAX_PLATFORMS", "") == "cpu"):
     jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp
@@ -248,7 +248,10 @@ def _free_port() -> int:
 
 
 def _start_tpuserve_subproc(model_name: str, cfg, quantize: str,
-                            batch: int, k_steps: int):
+                            batch: int, k_steps: int,
+                            engine: dict | None = None,
+                            page: int = PAGE,
+                            param_dtype: str = ""):
     """Serve `model_name` over the real tpuserve HTTP surface in its own
     process (benchmarks/serve_child.py) — the deployment topology. The
     in-thread variant below shares the bench client's GIL, which on a
@@ -265,7 +268,8 @@ def _start_tpuserve_subproc(model_name: str, cfg, quantize: str,
         "cfg": {k: getattr(cfg, k) for k in (
             "vocab_size", "dim", "n_layers", "n_heads", "n_kv_heads",
             "ffn_dim", "max_seq_len", "rope_theta")},
-        "batch": batch, "page": PAGE, "k": k_steps, "quantize": quantize,
+        "batch": batch, "page": page, "k": k_steps, "quantize": quantize,
+        "engine": engine or {}, "param_dtype": param_dtype,
     }
     here = os.path.dirname(os.path.abspath(__file__))
     proc = subprocess.Popen(
@@ -572,6 +576,158 @@ def gateway_numbers(model_name: str, cfg, quantize: str, batch=BATCH,
         stop_serve()
 
 
+# -- gateway_prefix leg: prefix-cache cold vs warm TTFT (ISSUE 3) --------
+
+#: ByteTokenizer chat template: "<system>: {sys}\n<user>: " is the
+#: token head every request shares — 19 chars of scaffolding + the
+#: system prompt. 45 system chars → a 64-token shared prefix, page-
+#: aligned at the leg's 16-token pages (4 reusable pages per request).
+_PREFIX_SYS = "You are a terse assistant. Reply briefly, no".ljust(45, ".")
+_PREFIX_PAGE = 16
+_PREFIX_MIN_BUCKET = 32
+# Leg model: a notch bigger than CPU_CFG so per-request device compute
+# dominates the serving stack's fixed per-request cost (HTTP, probe,
+# emit) — the quantity under test is prefill width, not overhead.
+_PREFIX_CFG = llama.LlamaConfig(
+    vocab_size=8192, dim=768, n_layers=6, n_heads=8, n_kv_heads=4,
+    ffn_dim=2048, max_seq_len=512, rope_theta=10000.0,
+)
+
+
+async def _drive_prefix_one(s, url: str, model: str, user: str,
+                            gen_tokens: int) -> float:
+    """One sequential streaming chat; returns TTFT ms (first content
+    delta on the wire — the logit-bias visible-token rig from
+    _drive_stream)."""
+    payload = {
+        "model": model,
+        "messages": [
+            {"role": "system", "content": _PREFIX_SYS},
+            {"role": "user", "content": user},
+        ],
+        "max_tokens": gen_tokens,
+        "temperature": 0.0,
+        "stream": True,
+        "logit_bias": {"97": 100},
+    }
+    t0 = time.perf_counter()
+    first = -1.0
+    async with s.post(url + "/v1/chat/completions", json=payload) as resp:
+        assert resp.status == 200, resp.status
+        while True:
+            line = await resp.content.readline()
+            if not line:
+                break
+            line = line.strip()
+            if not line.startswith(b"data: "):
+                continue
+            data = line[6:]
+            if data == b"[DONE]":
+                break
+            ev = json.loads(data)
+            ch = ev.get("choices") or []
+            if ch and (ch[0].get("delta") or {}).get("content"):
+                if first < 0:
+                    first = (time.perf_counter() - t0) * 1000.0
+    return first
+
+
+async def _get_state(s, url: str) -> dict:
+    async with s.get(url + "/state") as resp:
+        return await resp.json()
+
+
+def prefix_cache_numbers(reps: int = 3, requests_per_rep: int = 6,
+                         gen_tokens: int = 8) -> dict:
+    """The ``gateway_prefix`` leg: chat requests sharing a 64-token
+    system-prompt head (~96-token prompts, 18-char unique user tails)
+    against TWO tpuserve replicas — prefix cache ON (warm: every
+    request resumes prefill at the shared 64-token offset) and OFF
+    (cold: full-prompt prefill every time). Reps INTERLEAVE the two
+    servers (the ``--ab prefix_cache`` capture mode), so the ±15% host
+    drift documented for this box cancels out of the warm/cold ratio.
+    Sequential requests: the quantity under test is one request's
+    prefill, not batch scheduling. Reports TTFT p50 and per-request
+    device prefill_ms for both sides plus the warm replica's
+    prefix_cache_hit_rate."""
+    import aiohttp
+
+    model_name = "bench-prefix-tiny"
+    # num_pages sized to the leg (4 slots × ~7 pages + cached prefix +
+    # headroom), NOT the auto max_batch×max_seq default: XLA:CPU's K/V
+    # scatter walks the whole cache buffer, so an oversized pool buries
+    # the padded-width signal under a fixed per-call cost on this host
+    # f32 weights + KV on the CPU leg: XLA:CPU repacks bf16 weight
+    # arguments to f32 EVERY call — a width-independent ~35ms tax that
+    # buries the padded-width signal under test (bf16 is native on TPU)
+    engine_common = {"min_prefill_bucket": _PREFIX_MIN_BUCKET,
+                     "num_pages": 48, "max_queued_requests": 64,
+                     "kv_cache_dtype": "float32"}
+    url_on, stop_on = _start_tpuserve_subproc(
+        model_name, _PREFIX_CFG, "", batch=4,
+        k_steps=int(os.environ.get("AIGW_BENCH_CPU_K", "4")),
+        engine=dict(engine_common, enable_prefix_cache=True),
+        page=_PREFIX_PAGE, param_dtype="float32")
+    url_off, stop_off = _start_tpuserve_subproc(
+        model_name, _PREFIX_CFG, "", batch=4,
+        k_steps=int(os.environ.get("AIGW_BENCH_CPU_K", "4")),
+        engine=dict(engine_common, enable_prefix_cache=False),
+        page=_PREFIX_PAGE, param_dtype="float32")
+
+    async def run() -> dict:
+        await _wait_health(url_on, 1200)
+        await _wait_health(url_off, 1200)
+        timeout = aiohttp.ClientTimeout(total=1200)
+        async with aiohttp.ClientSession(timeout=timeout) as s:
+            # off-the-clock warm pass: compiles every shape BOTH legs
+            # dispatch (96-wide cold prefill, 32-wide suffix resume,
+            # both decode-window programs) and primes the shared
+            # prefix pages on the cache-on replica
+            for url in (url_on, url_off):
+                for i in range(3):
+                    await _drive_prefix_one(
+                        s, url, model_name, f"warmup tail {i:02d}..",
+                        gen_tokens)
+            warm_t, cold_t = [], []
+            st_on0 = await _get_state(s, url_on)
+            st_off0 = await _get_state(s, url_off)
+            n = 0
+            for rep in range(reps):
+                # interleave A/B: cache-on then cache-off within each
+                # rep so slow host drift cancels from the ratio
+                for i in range(requests_per_rep):
+                    user = f"q{rep}{i:02d} tail of chat..."[:18]
+                    warm_t.append(await _drive_prefix_one(
+                        s, url_on, model_name, user, gen_tokens))
+                    cold_t.append(await _drive_prefix_one(
+                        s, url_off, model_name, user, gen_tokens))
+                    n += 1
+            st_on1 = await _get_state(s, url_on)
+            st_off1 = await _get_state(s, url_off)
+        warm = _median([t for t in warm_t if t > 0])
+        cold = _median([t for t in cold_t if t > 0])
+        return {
+            "prefix_warm_ttft_ms_p50": round(warm, 1),
+            "prefix_cold_ttft_ms_p50": round(cold, 1),
+            "prefix_warm_vs_cold": round(warm / cold, 4) if cold else 0.0,
+            "prefix_warm_prefill_ms": round(
+                (st_on1["prefill_ms"] - st_on0["prefill_ms"]) / n, 1),
+            "prefix_cold_prefill_ms": round(
+                (st_off1["prefill_ms"] - st_off0["prefill_ms"]) / n, 1),
+            "prefix_cache_hit_rate": st_on1.get(
+                "prefix_cache_hit_rate", 0.0),
+            "prefix_warm_ttft_spread": round(_spread(warm_t), 3),
+            "prefix_cold_ttft_spread": round(_spread(cold_t), 3),
+            "prefix_ab_reps": reps * requests_per_rep,
+        }
+
+    try:
+        return asyncio.run(run())
+    finally:
+        stop_on()
+        stop_off()
+
+
 def _chip_responsive(timeout_s: float = 180.0) -> bool:
     """The axon tunnel can go down entirely (observed 2026-07-28); probe
     with a watchdog so the bench prints an honest line instead of hanging
@@ -716,6 +872,13 @@ def run_cpu_ratio() -> dict:
         subproc=True, reps=5,
     )
     res["backend"] = jax.default_backend()
+    # gateway_prefix leg: cold-vs-warm prefix-cache TTFT rides the same
+    # JSON line (a leg failure must not zero the headline capture)
+    try:
+        res.update(prefix_cache_numbers())
+    except Exception as e:
+        print(f"gateway_prefix leg failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
     return res
 
 
@@ -772,6 +935,22 @@ def main() -> None:
     lock = None  # held for process lifetime  # noqa: F841
     if "--cpu-gateway-ratio" not in sys.argv:
         lock = _bench_lock()
+
+    if "--ab" in sys.argv:
+        idx = sys.argv.index("--ab")
+        target = sys.argv[idx + 1] if idx + 1 < len(sys.argv) else ""
+        if target != "prefix_cache":
+            print(json.dumps({"error": f"unknown --ab target {target!r}; "
+                              "supported: prefix_cache"}))
+            return
+        result = prefix_cache_numbers()
+        result["metric"] = (
+            "gateway_prefix interleaved A/B — prefix_cache on vs off, "
+            "shared 64-token system-prompt head, ~96-token prompts, "
+            "sequential streaming chats on the CPU backend; the "
+            "warm/cold ratio is the signal, absolute ms is not")
+        print(json.dumps(result))
+        return
 
     if "--cpu-gateway-ratio" in sys.argv:
         result = run_cpu_ratio()

@@ -96,6 +96,7 @@ def main() -> None:
     spec = json.loads(sys.argv[1])
     cfg = llama.LlamaConfig(**spec["cfg"])
     register_model(ModelSpec(spec["model"], "llama", cfg))
+    param_dtype = spec.get("param_dtype", "")
 
     async def run() -> None:
         server = TPUServeServer(
@@ -108,9 +109,23 @@ def main() -> None:
                 # timed reps must never pay a prefill compile for a
                 # group shape the warm pass's arrival split missed
                 warm_prefill_buckets=2,
+                # extra EngineConfig overrides (the gateway_prefix A/B
+                # leg toggles enable_prefix_cache / min_prefill_bucket)
+                **spec.get("engine", {}),
             ),
             quantize=spec.get("quantize", ""),
         )
+        if param_dtype == "float32":
+            # CPU-leg fidelity knob: XLA:CPU repacks bf16 weight
+            # ARGUMENTS to f32 on every call (~35ms fixed for the tiny
+            # model — width-independent, so it buries the padded-width
+            # signal the prefix leg measures). bf16 is native on TPU;
+            # the CPU ratio harness serves f32 instead of paying an
+            # artifact of the fallback backend.
+            import jax.numpy as jnp
+
+            server.engine.params = jax.tree_util.tree_map(
+                lambda x: x.astype(jnp.float32), server.engine.params)
         runner = web.AppRunner(server.app)
         await runner.setup()
         site = web.TCPSite(runner, "127.0.0.1", 0)

@@ -218,6 +218,69 @@ def test_bucket_rungs_do_not_change_tokens():
     assert len(outs[1]) == 6
 
 
+class TestPrefixCacheEquivalence:
+    """ISSUE 3 invariant: token streams are BYTE-identical with
+    prefix_cache on vs off, in the deterministic f32 rig (no retry —
+    any mismatch is a real reuse bug). Covers the full-hit path
+    (page-aligned prompt: every page adopted, final page CoW'd, prompt
+    prefill replaced by a single-token resume), the partial-hit path
+    resuming chunked prefill at the matched offset with chunk
+    boundaries that are NOT page-size multiples, and the miss path."""
+
+    def _on_off(self, prompts, chunk, **over):
+        """Generate each prompt on a cache-off engine and a cache-on
+        engine (same order — the on-engine accumulates cache state);
+        returns (off_streams, on_streams, on_engine_stats)."""
+        off = _engine(chunk=chunk, prefix_cache=False, f32=True, **over)
+        off.start()
+        try:
+            ref = [_generate(off, p) for p in prompts]
+        finally:
+            off.stop()
+        on = _engine(chunk=chunk, prefix_cache=True, f32=True, **over)
+        on.start()
+        try:
+            got = [_generate(on, p) for p in prompts]
+            stats = on.stats
+        finally:
+            on.stop()
+        return ref, got, stats
+
+    def test_full_hit_cow_resume_byte_identical(self):
+        # 96 % 16 == 0: the repeat is a FULL aligned hit — all 6 pages
+        # adopted, final page copy-on-write'd, single-token resume
+        prompt = [(7 * i + 3) % 500 + 1 for i in range(96)]
+        ref, got, stats = self._on_off([prompt, prompt], chunk=0)
+        assert ref[0] == ref[1]  # off-engine determinism baseline
+        assert got == ref
+        assert stats.prefix_full_hits == 1
+        assert stats.prefix_cow_copies == 1
+        assert stats.prefix_tokens_reused == 95
+        # the resume must not have re-run the prompt prefill
+        assert stats.prefix_cache_hit_rate == 0.5  # 1 miss, 1 full hit
+
+    def test_partial_hit_resumes_chunked_at_offset_byte_identical(self):
+        # shared 64-token head (4 pages at ps=16); chunk=24 puts every
+        # resumed chunk boundary at 64+24k — never a page multiple
+        head = [(5 * i + 11) % 450 + 1 for i in range(64)]
+        a = head + [(3 * i + 7) % 450 + 1 for i in range(76)]  # 140
+        b = head + [(9 * i + 2) % 450 + 1 for i in range(76)]
+        ref, got, stats = self._on_off([a, b], chunk=24)
+        assert got == ref
+        assert stats.prefix_cache_hits == 1
+        assert stats.prefix_tokens_reused == 64
+        # the resumed tail still ran through the chunk loop
+        assert stats.chunked_prefill_steps >= 4
+
+    def test_miss_path_byte_identical(self):
+        a = [(7 * i + 1) % 400 + 1 for i in range(70)]
+        b = [(7 * i + 2) % 400 + 1 for i in range(70)]  # first page differs
+        ref, got, stats = self._on_off([a, b], chunk=0)
+        assert got == ref
+        assert stats.prefix_cache_hits == 0
+        assert stats.prefix_cache_misses == 2
+
+
 def test_short_prompt_bypasses_chunking():
     eng = _engine(chunk=64)
     eng.start()

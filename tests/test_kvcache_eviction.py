@@ -1,0 +1,198 @@
+"""Eviction-safety property test for the refcounted prefix cache.
+
+The engine's discipline (tpuserve/engine.py): page frees are DEFERRED —
+a finished sequence's pages go to a pending list, are captured by the
+next dispatched decode window, and are only released when that window
+drains (nothing on device can still write them). Shared prefix pages
+additionally carry refcounts, and pages whose refcount hits zero while
+still cache-registered park in an LRU evictable pool that fresh
+allocations may reclaim (evicting the cache entry).
+
+This test drives a randomized admit/complete/dispatch/drain schedule
+through RefcountedAllocator + PrefixCache, mirroring that discipline,
+and asserts the load-bearing invariant at every step: a page is NEVER
+handed to a new allocation (fresh alloc or CoW clone) while it is
+(a) owned by a live sequence chain, or (b) referenced by the still
+in-flight dispatch window — the refcount/deferred-free interplay from
+PR 1 that ISSUE 3's LRU eviction must not break.
+"""
+
+from __future__ import annotations
+
+import random
+
+from aigw_tpu.tpuserve.kvcache import (
+    OutOfPagesError,
+    PrefixCache,
+    RefcountedAllocator,
+    page_chain_hashes,
+)
+
+PS = 4  # page size (tokens) — tiny so chains span several pages
+
+
+def _prompt_pool(rng: random.Random) -> list[list[int]]:
+    """Prompts sharing page-aligned prefixes (so adoption happens) plus
+    unique ones (so insertion/eviction happens)."""
+    heads = [[rng.randrange(1, 50) for _ in range(PS * 2)]
+             for _ in range(3)]
+    pool = []
+    for h in heads:
+        for _ in range(3):
+            tail_len = rng.choice([3, PS, PS * 2 + 1])
+            pool.append(h + [rng.randrange(50, 99)
+                             for _ in range(tail_len)])
+    for _ in range(4):
+        pool.append([rng.randrange(100, 199)
+                     for _ in range(rng.randrange(PS, PS * 4))])
+    return pool
+
+
+def test_randomized_admit_complete_evict_schedule():
+    for trial in range(15):
+        rng = random.Random(1000 + trial)
+        alloc = RefcountedAllocator(num_pages=20, page_size=PS)
+        cache = PrefixCache(alloc, PS)
+        pool = _prompt_pool(rng)
+
+        seq_ids = iter(range(10_000))
+        live: dict[int, list[int]] = {}  # seq -> prompt (owned pages
+        # are read from the allocator, the source of truth)
+        pending_frees: list[int] = []
+        inflight: tuple[frozenset[int], list[int]] | None = None
+
+        def referenced_pages() -> set[int]:
+            pages: set[int] = set()
+            for sid in live:
+                pages.update(alloc.pages(sid))
+            if inflight is not None:
+                pages.update(inflight[0])
+            return pages
+
+        def check_fresh(fresh: list[int], what: str) -> None:
+            bad = set(fresh) & held
+            assert not bad, (
+                f"trial {trial}: {what} handed out page(s) {bad} still "
+                f"referenced by a live chain or in-flight window")
+
+        for step in range(400):
+            op = rng.random()
+            if op < 0.45:  # admit
+                prompt = rng.choice(pool)
+                sid = next(seq_ids)
+                chain = page_chain_hashes(prompt, PS)
+                hit = cache.probe(chain)
+                hits = min(len(hit), len(prompt) // PS)
+                full = hits > 0 and hits * PS == len(prompt)
+                cached = hit[:hits]
+                total = len(prompt) + rng.randrange(1, 6)
+                # snapshot of pages that must NOT be handed out fresh
+                held = referenced_pages()
+                try:
+                    if cached:
+                        alloc.adopt(sid, cached)
+                        extra = alloc.pages_for(total) - len(cached)
+                        if extra > 0:
+                            check_fresh(
+                                alloc.allocate_extra(sid, extra),
+                                "allocate_extra")
+                        if full:
+                            check_fresh(
+                                [alloc.cow_page(sid, cached[-1])],
+                                "cow_page")
+                    else:
+                        check_fresh(alloc.allocate(sid, total),
+                                    "allocate")
+                except OutOfPagesError:
+                    alloc.free(sid)
+                    continue
+                cache.insert(chain, alloc.pages(sid))
+                live[sid] = prompt
+            elif op < 0.65 and live:  # complete (free is DEFERRED)
+                sid = rng.choice(list(live))
+                del live[sid]
+                pending_frees.append(sid)
+            elif op < 0.85:  # dispatch a window
+                if inflight is None:
+                    captured, pending_frees = pending_frees, []
+                    window_pages: set[int] = set()
+                    for sid in live:
+                        window_pages.update(alloc.pages(sid))
+                    # a captured-free seq's pages stay referenced by
+                    # THIS window until it drains
+                    for sid in captured:
+                        window_pages.update(alloc.pages(sid))
+                    inflight = (frozenset(window_pages), captured)
+            else:  # drain the in-flight window → apply its frees
+                if inflight is not None:
+                    _, captured = inflight
+                    inflight = None
+                    for sid in captured:
+                        alloc.free(sid)
+
+            # structural invariants after every step
+            probe_all = set(cache._by_key.values())
+            free_set = set(alloc._free)
+            assert not (probe_all & free_set), (
+                "cache maps a key to a page sitting in the free stack")
+            for p, refs in alloc._refs.items():
+                assert refs > 0
+                assert p not in free_set
+                assert p not in alloc._evictable
+
+        # drain everything: no page may leak
+        if inflight is not None:
+            for sid in inflight[1]:
+                alloc.free(sid)
+        for sid in list(live):
+            alloc.free(sid)
+        for sid in pending_frees:
+            alloc.free(sid)
+        assert alloc.available_pages == alloc.num_pages
+
+
+def test_eviction_reclaims_parked_pages_and_counts():
+    """Parked (refcount-zero, registered) pages are reclaimed LRU-first
+    under pressure, the cache entry dies with them, and the eviction
+    counter advances."""
+    alloc = RefcountedAllocator(num_pages=6, page_size=PS)
+    cache = PrefixCache(alloc, PS)
+    a = [1] * (PS * 2)
+    chain_a = page_chain_hashes(a, PS)
+    alloc.allocate(0, PS * 2)
+    cache.insert(chain_a, alloc.pages(0))
+    alloc.free(0)  # both pages park evictable, entries stay resident
+    assert cache.resident_entries == 2
+    assert alloc.free_pages == 6  # parked pages report as reclaimable
+
+    # a 6-page allocation must reclaim the parked pages (evicting their
+    # entries) rather than fail
+    alloc.allocate(1, PS * 6)
+    assert cache.evictions == 2
+    assert cache.resident_entries == 0
+    assert cache.probe(chain_a) == []
+    alloc.free(1)
+
+
+def test_cow_page_keeps_shared_page_cached():
+    """CoW hands the sequence a private clone; the shared page keeps its
+    registration (and parks for revival once unreferenced)."""
+    alloc = RefcountedAllocator(num_pages=8, page_size=PS)
+    cache = PrefixCache(alloc, PS)
+    prompt = [7] * PS
+    chain = page_chain_hashes(prompt, PS)
+    alloc.allocate(0, PS + 2)
+    cache.insert(chain, alloc.pages(0))
+    shared = alloc.pages(0)[0]
+
+    alloc.adopt(1, [shared])
+    fresh = alloc.cow_page(1, shared)
+    assert fresh != shared
+    assert alloc.pages(1) == [fresh]
+    assert cache.probe(chain) == [shared]  # registration survives CoW
+    assert cache.key_of_page(fresh) is None  # the clone is private
+
+    alloc.free(0)
+    assert cache.probe(chain) == [shared]  # parked, revivable
+    alloc.free(1)
+    assert alloc.available_pages == 8

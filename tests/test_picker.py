@@ -10,6 +10,7 @@ import pytest
 
 from aigw_tpu.gateway.picker import (
     AFFINITY_HEADER,
+    PREFIX_HEADER,
     Endpoint,
     EndpointPicker,
 )
@@ -191,3 +192,81 @@ class TestContentAffinity:
         # …but a LARGE imbalance releases the session
         p.observe("a:1", kv_occupancy=0.95, queued=8, max_slots=8)
         assert p.pick(h) == "b:1"
+
+
+class TestPrefixAffinity:
+    """Soft cache-affinity (ISSUE 3): requests sharing a system-prompt
+    hash prefer the replica whose prefix cache was just warmed — a
+    bounded score bonus, never a hard pin."""
+
+    def _two(self, occ_a=0.30, occ_b=0.30):
+        p = EndpointPicker([Endpoint("a:1"), Endpoint("b:1")])
+        p.observe("a:1", kv_occupancy=occ_a, max_slots=8)
+        p.observe("b:1", kv_occupancy=occ_b, max_slots=8)
+        return p
+
+    def test_recent_prefix_replica_preferred(self):
+        p = self._two(0.30, 0.31)
+        h = {PREFIX_HEADER: "sys-abc"}
+        first = p.pick(h)
+        assert first == "a:1"
+        # modest load skew against the warmed replica → affinity holds
+        # (the shared prefix pages there outweigh a small imbalance)
+        p.observe("a:1", kv_occupancy=0.45, max_slots=8)
+        p.observe("b:1", kv_occupancy=0.25, max_slots=8)
+        assert p.pick(h) == "a:1"
+        # a DIFFERENT prefix has no affinity: plain load wins
+        assert p.pick({PREFIX_HEADER: "sys-other"}) == "b:1"
+
+    def test_affinity_never_overrides_saturation(self):
+        p = self._two(0.10, 0.50)
+        h = {PREFIX_HEADER: "sys-xyz"}
+        assert p.pick(h) == "a:1"
+        # the warmed replica saturates: queue depth + occupancy dwarf
+        # the constant bonus — the request must move off it
+        p.observe("a:1", kv_occupancy=0.95, queued=8, max_slots=8,
+                  queue_wait_ms=500.0)
+        p.observe("b:1", kv_occupancy=0.40, max_slots=8)
+        assert p.pick(h) == "b:1"
+        # and the affinity map follows the traffic: next pick with the
+        # same prefix now prefers b even after a's load recovers a bit
+        p.observe("a:1", kv_occupancy=0.45, max_slots=8)
+        p.observe("b:1", kv_occupancy=0.40, max_slots=8)
+        assert p.pick(h) == "b:1"
+
+    def test_session_stickiness_outranks_prefix_affinity(self):
+        p = self._two(0.30, 0.30)
+        # session pinned to a; prefix recently routed to b
+        p.pick({AFFINITY_HEADER: "conv-1"})
+        assert p._affinity["conv-1"] == "a:1"
+        p._prefix_affinity["sys-1"] = "b:1"
+        h = {AFFINITY_HEADER: "conv-1", PREFIX_HEADER: "sys-1"}
+        # exact-KV session locality must win over shared-prefix locality
+        assert p.pick(h) == "a:1"
+
+    def test_prefix_hit_rate_polled_from_state(self):
+        p = self._two()
+        p.observe("a:1", kv_occupancy=0.1, max_slots=8,
+                  prefix_hit_rate=0.75)
+        assert p.state["a:1"].prefix_hit_rate == 0.75
+
+    def test_prefix_hash_key_shared_across_conversations(self):
+        from aigw_tpu.gateway.server import _prefix_hash_key
+
+        a = {"messages": [{"role": "system", "content": "be terse"},
+                          {"role": "user", "content": "q1"}]}
+        b = {"messages": [{"role": "system", "content": "be terse"},
+                          {"role": "user", "content": "entirely different"}]}
+        k = _prefix_hash_key(a)
+        assert k
+        # DIFFERENT conversations, same system head → same prefix key
+        # (this is what distinguishes it from the conversation key)
+        assert _prefix_hash_key(b) == k
+        from aigw_tpu.gateway.server import _conversation_affinity_key
+        assert _conversation_affinity_key(a) != _conversation_affinity_key(b)
+        # different system prompt → different key; no system head → none
+        c = {"messages": [{"role": "system", "content": "be verbose"},
+                          {"role": "user", "content": "q1"}]}
+        assert _prefix_hash_key(c) != k
+        assert _prefix_hash_key(
+            {"messages": [{"role": "user", "content": "q"}]}) == ""

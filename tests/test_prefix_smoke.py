@@ -1,0 +1,161 @@
+"""Tier-1 smoke for the prefix-cache observability surface (ISSUE 3).
+
+Two tripwires that previously only fired at round-end:
+- the prefix gauges must actually appear on ``/state`` and ``/metrics``
+  (a renamed EngineStats field silently drops a dashboard signal);
+- ``warm_prefill_buckets`` must still pre-compile EVERY tail-width rung
+  of the prefill ladder — a hot-path XLA compile for a rung the warmup
+  missed is exactly the class of TTFT regression PR 1/2 removed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+
+import aiohttp
+import jax
+import jax.numpy as jnp
+import pytest
+
+from aigw_tpu.models import llama
+from aigw_tpu.obs.metrics import ENGINE_GAUGES
+from aigw_tpu.tpuserve.engine import Engine, EngineConfig, GenRequest
+from aigw_tpu.tpuserve.sampling import SamplingParams
+from aigw_tpu.tpuserve.server import TPUServeServer
+
+PREFIX_STATE_FIELDS = (
+    "prefix_cache_hit_rate",
+    "prefix_pages_resident",
+    "prefix_pages_pinned",
+    "prefix_bytes_pinned",
+    "prefix_cache_hits",
+    "prefix_cache_misses",
+    "prefix_cache_evictions",
+)
+
+PREFIX_GAUGES = (
+    "tpuserve_prefix_cache_hits_total",
+    "tpuserve_prefix_cache_misses_total",
+    "tpuserve_prefix_cache_evictions_total",
+    "tpuserve_prefix_full_hits_total",
+    "tpuserve_prefix_cow_copies_total",
+    "tpuserve_prefix_pages_resident",
+    "tpuserve_prefix_pages_pinned",
+    "tpuserve_prefix_cache_hit_rate",
+    "tpuserve_prefix_tokens_reused_total",
+)
+
+
+@pytest.fixture(scope="module")
+def smoke_url():
+    holder = {}
+    started = threading.Event()
+
+    def run():
+        async def main():
+            from aiohttp import web
+
+            server = TPUServeServer(
+                "tiny-random",
+                EngineConfig(max_batch_size=2, max_seq_len=256,
+                             page_size=16, min_prefill_bucket=16),
+            )
+            runner = web.AppRunner(server.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            holder["port"] = site._server.sockets[0].getsockname()[1]
+            holder["loop"] = asyncio.get_running_loop()
+            started.set()
+            await asyncio.Event().wait()
+
+        try:
+            asyncio.run(main())
+        except RuntimeError:
+            pass
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(timeout=120)
+    yield f"http://127.0.0.1:{holder['port']}"
+    holder["loop"].call_soon_threadsafe(holder["loop"].stop)
+
+
+async def _get(url: str, path: str):
+    async with aiohttp.ClientSession() as s:
+        async with s.get(url + path) as resp:
+            assert resp.status == 200
+            return await resp.read()
+
+
+def test_state_exports_prefix_gauges(smoke_url):
+    async def main():
+        # one chat first so the stats are live, not just defaults
+        async with aiohttp.ClientSession() as s:
+            async with s.post(smoke_url + "/v1/chat/completions", json={
+                "model": "tiny-random",
+                "messages": [{"role": "user",
+                              "content": "smoke prefix state " * 3}],
+                "max_tokens": 2,
+            }) as resp:
+                assert resp.status == 200
+        return json.loads(await _get(smoke_url, "/state"))
+
+    state = asyncio.run(main())
+    for field in PREFIX_STATE_FIELDS:
+        assert field in state, f"/state lost {field}"
+    assert state["prefix_cache_hits"] + state["prefix_cache_misses"] >= 1
+    assert state["prefix_bytes_pinned"] >= 0
+
+
+def test_metrics_export_prefix_gauges(smoke_url):
+    text = asyncio.run(_get(smoke_url, "/metrics")).decode()
+    for gauge in PREFIX_GAUGES:
+        assert gauge in text, f"/metrics lost {gauge}"
+
+
+def test_engine_gauges_map_matches_engine_stats():
+    """Every ENGINE_GAUGES attr must exist on EngineStats — a renamed
+    stat otherwise exports a silent constant 0."""
+    from aigw_tpu.tpuserve.engine import EngineStats
+
+    stats = EngineStats()
+    for attr, _name in ENGINE_GAUGES:
+        assert hasattr(stats, attr), attr
+
+
+def test_warm_prefill_buckets_covers_every_rung():
+    """Compile-on-hot-path tripwire: with warm_prefill_buckets=N, every
+    rung of the first N octaves (x1, x1.5 at rungs=2) must be compiled
+    at warmup for every pow2 group size — admitting a prompt at any of
+    those widths afterwards must NOT add a prefill compile."""
+    spec_cfg = llama.TINY
+    params = llama.init_params(jax.random.PRNGKey(0), spec_cfg)
+    eng = Engine(params, spec_cfg, EngineConfig(
+        max_batch_size=2, max_seq_len=256, page_size=16,
+        min_prefill_bucket=16, decode_steps_per_tick=2,
+        warm_prefill_buckets=2, prefill_bucket_rungs=2,
+        enable_prefix_cache=False))
+    eng.warmup()
+    rungs = sorted(set(eng._bucket_rungs(0) + eng._bucket_rungs(1)))
+    assert rungs == [16, 24, 32, 48]
+    warmed = eng._prefill_fn._cache_size()
+    # 4 rungs × group sizes {1, 2} — every (G2, S) shape pre-compiled
+    assert warmed == len(rungs) * 2, warmed
+
+    eng.start()
+    try:
+        for width in rungs:
+            done = threading.Event()
+            eng.submit(GenRequest(
+                prompt=[1 + width] * width, max_tokens=1,
+                sampling=SamplingParams(temperature=0.0),
+                emit=lambda t, f, d=done: d.set() if f else None))
+            assert done.wait(timeout=300)
+        assert eng._prefill_fn._cache_size() == warmed, (
+            "a prompt at a warmed rung width still paid an XLA "
+            "prefill compile on the hot path")
+    finally:
+        eng.stop()

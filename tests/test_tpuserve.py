@@ -427,6 +427,26 @@ class TestPrefixCache:
         finally:
             eng.stop()
 
+    def test_full_hit_cow_isolation(self):
+        """Page-aligned identical prompts: the repeat is a FULL hit —
+        every page adopted, final page CoW'd, single-token resume. The
+        CoW clone must isolate the writer: a THIRD identical request
+        still full-hits the untouched shared pages and matches."""
+        eng = self.make_engine()
+        try:
+            prompt = [(11 * i + 5) % 250 + 1 for i in range(64)]  # 4 pages
+            a, _ = collect(eng, prompt, max_tokens=4, temperature=0.0)
+            b, _ = collect(eng, prompt, max_tokens=4, temperature=0.0)
+            c, _ = collect(eng, prompt, max_tokens=4, temperature=0.0)
+            assert a == b == c
+            assert eng.stats.prefix_full_hits == 2
+            assert eng.stats.prefix_cow_copies == 2
+            # full hits resume at n-1: 63 tokens reused each, never a
+            # whole-prompt prefill
+            assert eng.stats.prefix_tokens_reused == 126
+        finally:
+            eng.stop()
+
     def test_no_false_hits(self):
         eng = self.make_engine()
         try:
