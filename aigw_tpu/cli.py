@@ -262,9 +262,20 @@ def main(argv: list[str] | None = None) -> int:
                               "top_logprobs servable per request "
                               "(0 = off; OpenAI caps requests at 20)")
     p_serve.add_argument("--spec-tokens", type=int, default=0,
-                         help="prompt-lookup speculative decoding: draft "
-                              "tokens verified per decode step (0 = off); "
-                              "wins on repetitive/extractive generations")
+                         help="speculative decoding: max draft tokens "
+                              "verified per decode step (0 = off). "
+                              "Drafts come from n-gram prompt lookup "
+                              "plus prefix-cache continuations; an "
+                              "adaptive per-slot ladder collapses to "
+                              "plain decode when acceptance is poor, "
+                              "so it is safe to leave on")
+    p_serve.add_argument("--no-spec-adaptive", action="store_true",
+                         help="pin the speculative draft length at "
+                              "--spec-tokens instead of the adaptive "
+                              "rung ladder (A/B + determinism knob)")
+    p_serve.add_argument("--no-speculation", action="store_true",
+                         help="force speculative decoding off "
+                              "(overrides --spec-tokens)")
     p_serve.add_argument("--pallas-attn", action="store_true",
                          help="ragged paged-attention Pallas kernels for "
                               "decode and speculative verify (single-chip; "
@@ -820,7 +831,8 @@ async def _run_tpuserve(args: argparse.Namespace) -> int:
         enable_prefix_cache=not args.no_prefix_cache,
         sp_prefill_min_tokens=args.sp_prefill_min_tokens,
         prefill_chunk_tokens=args.prefill_chunk_tokens,
-        spec_tokens=args.spec_tokens,
+        spec_tokens=0 if args.no_speculation else args.spec_tokens,
+        spec_adaptive=not args.no_spec_adaptive,
         pallas_attn=args.pallas_attn,
         logprobs_topk=args.logprobs,
         adaptive_decode_window=not args.no_adaptive_window,

@@ -99,7 +99,19 @@ ENGINE_GAUGES: tuple[tuple[str, str], ...] = (
     ("decode_window", "tpuserve_decode_window_steps"),
     ("window_shrinks", "tpuserve_decode_window_shrinks_total"),
     ("window_grows", "tpuserve_decode_window_grows_total"),
+    # speculative decoding (ISSUE 4): draft/accept volume, the
+    # cumulative acceptance rate, the adaptive ladder's current
+    # dispatch width and transition counters, the prefix-cache
+    # continuation draft source, and the pipeline-draining full-rebuild
+    # counter the zero-rebuild criterion asserts on
     ("spec_accepted", "tpuserve_spec_accepted_total"),
+    ("spec_drafted", "tpuserve_spec_drafted_tokens_total"),
+    ("spec_accept_rate", "tpuserve_spec_accept_rate"),
+    ("spec_draft_len", "tpuserve_spec_draft_len"),
+    ("spec_rung_ups", "tpuserve_spec_rung_ups_total"),
+    ("spec_rung_downs", "tpuserve_spec_rung_downs_total"),
+    ("spec_lookahead_slots", "tpuserve_spec_lookahead_slots_total"),
+    ("state_rebuilds", "tpuserve_state_rebuilds_total"),
     ("prefix_cache_hits", "tpuserve_prefix_cache_hits_total"),
     ("prefix_tokens_reused", "tpuserve_prefix_tokens_reused_total"),
     # prefix-cache reuse surface (ISSUE 3): hit/miss/eviction counters,

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from aigw_tpu.models import llama
+from aigw_tpu.tpuserve import speculation
 from aigw_tpu.tpuserve.kvcache import (
     OutOfPagesError,
     PageAllocator,
@@ -46,6 +47,7 @@ from aigw_tpu.tpuserve.sampling import (
     SamplingParams,
     apply_penalties,
     sample,
+    spec_accept,
 )
 
 logger = logging.getLogger(__name__)
@@ -136,11 +138,18 @@ class EngineConfig:
     # slower than the 96-wide rung. Compiled-program count stays
     # bounded: rungs × log2(max_seq/min_bucket) shapes per group size.
     prefill_bucket_rungs: int = 2
-    # Prompt-lookup speculative decoding: number of draft tokens verified
-    # per decode step (0 = off). Each step verifies 1+spec_tokens
-    # positions in one fixed-shape program and advances by the accepted
-    # count — see tpuserve/speculation.py.
+    # Speculative decoding: the maximum draft tokens verified per decode
+    # step (0 = off). Each draft-length rung of the adaptive ladder
+    # ({0, 2, 4, 8}-style, capped here) is one fixed-shape [B, D+1]
+    # verify program; a step advances by the accepted count — see
+    # tpuserve/speculation.py.
     spec_tokens: int = 0
+    # Adaptive draft length: per-slot controllers walk the rung ladder
+    # on a rolling acceptance EWMA, collapsing to D=0 (plain decode,
+    # zero overhead) on adversarial traffic and re-probing
+    # occasionally. False pins every eligible slot at spec_tokens —
+    # the fixed-D A/B and determinism knob.
+    spec_adaptive: bool = True
     # Ragged paged-attention Pallas kernel for the decode hot loop (HBM
     # reads scale with actual sequence lengths, not the padded window).
     # Single-chip only: ignored when the engine runs on a mesh.
@@ -239,9 +248,18 @@ class _Slot:
     # rebuilds across admissions)
     token_counts: dict[int, int] = field(default_factory=dict)
     adapter_row: int = 0
-    # ordered generated tokens (speculation rebuilds the on-device
-    # history buffer from prompt + these across admissions)
+    # ordered generated tokens (the slot's device history row is built
+    # from prompt + these — uploaded by the incremental row update, not
+    # a full state rebuild)
     gen_tokens: list[int] = field(default_factory=list)
+    # speculative decoding (spec-eligible slots only): the adaptive
+    # draft-length controller, the prefix-cache continuation lookahead
+    # (tokens + the absolute position of tokens[0]), and the draft_len
+    # value currently live on device (to skip no-op row patches)
+    ctrl: Any = None  # speculation.DraftController | None
+    la_base: int = 0
+    la_tokens: list[int] = field(default_factory=list)
+    dev_draft_len: int = 0
 
 
 @dataclass
@@ -254,6 +272,25 @@ class EngineStats:
     # extra tokens landed by accepted speculative drafts (beyond the one
     # token per step the plain decode path yields)
     spec_accepted: int = 0
+    # draft tokens proposed to the verifier (per-slot draft length ×
+    # steps the slot was live in a speculative window)
+    spec_drafted: int = 0
+    # cumulative accepted / drafted (refreshed each tick)
+    spec_accept_rate: float = 0.0
+    # draft width of the most recent dispatch (0 = plain decode — the
+    # adaptive ladder is collapsed or speculation is off)
+    spec_draft_len: int = 0
+    # adaptive-ladder transitions (includes rung-0 re-probes as ups)
+    spec_rung_ups: int = 0
+    spec_rung_downs: int = 0
+    # admissions whose draft source includes a prefix-cache
+    # continuation lookahead (repeated-traffic free drafts)
+    spec_lookahead_slots: int = 0
+    # full device-state rebuilds that drained a LIVE pipeline (page-
+    # bucket growth only — speculative admission no longer forces one;
+    # from-idle builds are not counted). The zero-rebuild acceptance
+    # criterion asserts on this.
+    state_rebuilds: int = 0
     prefills: int = 0
     sp_prefills: int = 0  # prefills routed through ring attention
     chunked_prefill_steps: int = 0  # intermediate chunk device steps
@@ -310,6 +347,11 @@ class _Window:
     # completes (every window dispatched while they were active has
     # then finished — nothing on device can still write their pages)
     frees: list[int]
+    # speculative dispatch width (0 = plain decode window) and the
+    # per-slot draft lengths at dispatch time ((slot, D_slot) pairs) —
+    # the drain-side controller update needs what was actually offered
+    draft: int = 0
+    draft_lens: tuple[tuple[int, int], ...] = ()
 
 
 class Engine:
@@ -428,13 +470,21 @@ class Engine:
         # Incremental device-state maintenance: membership changes mark
         # individual rows dirty and are scattered into the live state
         # with a tiny jitted row update — no pipeline drain, no full
-        # [B, V] re-upload. A full rebuild happens only when the page
-        # bucket grows, under speculation (on-device history), or on
-        # first use.
+        # [B, V] re-upload. The speculative history/lookahead rows ride
+        # the SAME path (a [H] row upload per admission), so a full
+        # rebuild happens only when the page bucket grows or on first
+        # use — never because a slot speculates.
         self._dirty_rows: set[int] = set()
+        # live slots whose adaptive draft rung moved: patched on device
+        # by a draft_len-ONLY scatter (_apply_spec_row_updates). A live
+        # slot's full row must never be re-uploaded mid-pipeline — the
+        # host's positions lag the in-flight window — but draft_len is
+        # position-independent and safe to patch any time.
+        self._spec_dirty: set[int] = set()
         self._need_rebuild = True
         self._state_bucket = 0  # page bucket the live state was built at
         self._row_update_fn = None
+        self._spec_update_fn = None
         # copy-on-write page clone (full-prefix hits): one compiled
         # program regardless of src/dst ids (dynamic slice indices)
         self._copy_page_fn = None
@@ -584,39 +634,54 @@ class Engine:
 
             return scan_k
 
-        # prompt-lookup speculation (tpuserve/speculation.py): replaces
-        # the [B, 1] decode step with a [B, D+1] verify step that advances
-        # by the accepted draft count. Same fixed-geometry contract — one
-        # compiled program for the engine lifetime.
-        self._spec = (
-            cfg.spec_tokens
+        # speculative decoding (tpuserve/speculation.py): a rung ladder
+        # of [B, D+1] verify programs replaces the [B, 1] decode step
+        # whenever an eligible slot's adaptive controller holds a
+        # nonzero draft length; a step advances by the accepted draft
+        # count. Same fixed-geometry contract — one compiled program
+        # per rung, warmed like the prefill ladder.
+        self._spec_rungs = (
+            speculation.draft_rungs(cfg.spec_tokens)
             if cfg.spec_tokens > 0 and self.fns.verify_step is not None
-            else 0
+            else (0,)
         )
+        self._spec_max = self._spec_rungs[-1]
+        self._accept_prior = speculation.AcceptancePrior()
         model_verify = self.fns.verify_step
-        D = self._spec
         V = model_cfg.vocab_size
         H = cfg.max_seq_len
 
-        def _spec_scan(k_steps: int):
-            """Factory: k speculative steps; outputs (sampled
-            [k, B, D+1], n_emit [k, B]) — the host emits
-            sampled[k, b, :n_emit[k, b]]."""
-            from aigw_tpu.tpuserve.speculation import (
-                accept_counts,
-                ngram_drafts,
-            )
-
+        def _spec_scan(k_steps: int, D: int):
+            """Factory: k speculative steps at draft rung D; outputs
+            (sampled [k, B, D+1], n_emit [k, B]) — the host emits
+            sampled[k, b, :n_emit[k, b]]. Slots whose per-slot
+            ``draft_len`` row sits below D get the excess candidate
+            positions poisoned on device: they still advance ≥1
+            model-exact token per step, just without the extra
+            drafts."""
             D1 = D + 1
 
             def body(params, lora, carry):
                 kv, st = carry
                 act = st["active"] & (st["positions"] < st["limits"])
-                # penalty slots advance exactly one token per step (see
-                # speculation.py module docstring): poison their drafts
-                elig = (st["freq_pen"] == 0.0) & (st["pres_pen"] == 0.0)
-                drafts = ngram_drafts(st["history"], st["positions"], D)
-                drafts = jnp.where(elig[:, None], drafts, -1)
+                # penalty and sampling slots advance exactly one token
+                # per step (see speculation.py module docstring):
+                # poison their drafts
+                elig = ((st["freq_pen"] == 0.0)
+                        & (st["pres_pen"] == 0.0)
+                        & (st["temp"] <= 0.0))
+                # multi-source drafts: prefix-cache continuation where
+                # the lookahead buffer covers the position, n-gram
+                # prompt lookup everywhere else
+                ng = speculation.ngram_drafts(
+                    st["history"], st["positions"], D)
+                la = speculation.lookahead_drafts(
+                    st["lookahead"], st["la_base"], st["la_len"],
+                    st["positions"], D)
+                drafts = speculation.combine_drafts(la, ng)
+                d_off = jnp.arange(D, dtype=jnp.int32)[None, :]
+                ok = elig[:, None] & (d_off < st["draft_len"][:, None])
+                drafts = jnp.where(ok, drafts, -1)
                 inputs = jnp.concatenate(
                     [st["tokens"][:, None], jnp.maximum(drafts, 0)], axis=1
                 )
@@ -648,17 +713,13 @@ class Engine:
                     lambda l, k: sample(l, k, st["temp"], st["top_p"],
                                         st["top_k"])
                 )(lT, keys_d).T  # [B, D1]
-                n_acc = accept_counts(drafts, sampled)
-                n_emit = jnp.where(
-                    act,
-                    jnp.minimum(n_acc + 1, st["limits"] - st["positions"]),
-                    0,
-                )
+                n_emit, emit_mask = spec_accept(
+                    drafts, sampled, act,
+                    st["limits"] - st["positions"])
                 B = sampled.shape[0]
                 rows = jnp.arange(B)
                 new_pending = sampled[rows, jnp.clip(n_emit - 1, 0, D)]
                 d_idx = jnp.arange(D1, dtype=jnp.int32)[None, :]
-                emit_mask = d_idx < n_emit[:, None]  # [B, D1]
                 # sampled[d] is the token at position pos+1+d
                 wpos = jnp.where(emit_mask,
                                  st["positions"][:, None] + 1 + d_idx, H)
@@ -676,7 +737,14 @@ class Engine:
                     counts=counts,
                     history=history,
                 )
-                return (kv, new), (sampled, n_emit)
+                # draft tokens actually OFFERED this step (the longest
+                # non-poisoned prefix) — the host-side controllers
+                # distinguish proposed-and-rejected from nothing-to-
+                # propose, and spec_drafted counts real proposals
+                n_prop = jnp.sum(jnp.cumprod(
+                    (drafts >= 0).astype(jnp.int32), axis=1), axis=1)
+                n_prop = jnp.where(act, n_prop, 0)
+                return (kv, new), (sampled, n_emit, n_prop)
 
             def scan_k(params, lora, kv, state):
                 (kv, state), out = jax.lax.scan(
@@ -689,32 +757,32 @@ class Engine:
         self._prefill_fn = jax.jit(_prefill_step, donate_argnums=(4,))
         self._prefill_suffix_fn = jax.jit(_prefill_suffix_step,
                                           donate_argnums=(5,))
-        self._decode_scan_factory = (
-            _spec_scan if self._spec else _decode_scan
-        )
-        self._decode_fns: dict[tuple[int, bool], Callable] = {}
+        self._decode_scan_factory = _decode_scan
+        self._spec_scan_factory = _spec_scan
+        self._decode_fns: dict[tuple[int, bool, int], Callable] = {}
 
-    def _decode_fn_for(self, k: int, lean: bool = False):
-        """Jitted decode program for window length k (cached; jit itself
-        caches per page-bucket shape). ``lean`` selects the
-        penalty-free variant (speculation has no lean variant — its
+    def _decode_fn_for(self, k: int, lean: bool = False,
+                       draft: int = 0):
+        """Jitted decode program for window length k at draft rung
+        ``draft`` (0 = plain decode; cached; jit itself caches per
+        page-bucket shape). ``lean`` selects the penalty-free plain
+        variant (verify programs have no lean variant — their
         draft-eligibility logic reads the penalty fields)."""
-        if self._spec:
+        if draft:
             lean = False
-        fn = self._decode_fns.get((k, lean))
+        fn = self._decode_fns.get((k, lean, draft))
         if fn is None:
-            scan = (self._decode_scan_factory(k) if self._spec
+            scan = (self._spec_scan_factory(k, draft) if draft
                     else self._decode_scan_factory(k, lean))
             fn = jax.jit(scan, donate_argnums=(2, 3))
-            self._decode_fns[(k, lean)] = fn
+            self._decode_fns[(k, lean, draft)] = fn
         return fn
 
     def _lean_decode_ok(self) -> bool:
         """True when no active slot uses repetition penalties — the
         lean decode program samples bit-identical tokens (zero
-        penalties add exactly 0.0 per logit)."""
-        if self._spec:
-            return False
+        penalties add exactly 0.0 per logit). Only consulted for
+        plain-decode dispatches (draft rung 0)."""
         return all(
             s is None
             or (s.req.sampling.frequency_penalty == 0.0
@@ -856,16 +924,36 @@ class Engine:
 
     def warmup(self) -> None:
         """Compile every decode-window program in the adaptive ladder —
-        and, with warm_prefill_buckets > 0, the batched-prefill group
-        shapes for the smallest prompt buckets — before traffic arrives
-        (the first burst then pays zero XLA compiles)."""
-        leans = (False,) if self._spec else (True, False)
+        plain (lean + full) AND every nonzero draft rung of the
+        speculative ladder — and, with warm_prefill_buckets > 0, the
+        batched-prefill group shapes for the smallest prompt buckets —
+        before traffic arrives (the first burst then pays zero XLA
+        compiles, and a mid-stream draft-rung transition never
+        compiles a verify program on the hot path)."""
         for k in self._window_ladder():
-            for lean in leans:
+            for lean in (True, False):
                 state = self._build_device_state()
                 _, _, self.kv_cache = self._decode_fn_for(k, lean)(
                     self.params, self.lora_params, self.kv_cache, state
                 )
+            for d in self._spec_rungs:
+                if d == 0:
+                    continue
+                state = self._build_device_state()
+                _, _, self.kv_cache = self._decode_fn_for(k, False, d)(
+                    self.params, self.lora_params, self.kv_cache, state
+                )
+        # the incremental row-update scatters also run on the hot path
+        # (admission / EOS / rung moves): compile them on a throwaway
+        # state so the first membership change pays nothing
+        state = self._build_device_state()
+        self._dirty_rows.add(0)
+        saved, self._device_state = self._device_state, state
+        self._apply_row_updates()
+        if self._spec_max:
+            self._spec_dirty.add(0)
+            self._apply_spec_row_updates()
+        self._device_state = saved
         for b in range(self.cfg.warm_prefill_buckets):
             if self.cfg.min_prefill_bucket << b > self.cfg.max_seq_len:
                 break
@@ -936,6 +1024,7 @@ class Engine:
         self._device_state = None
         self._need_rebuild = True
         self._dirty_rows.clear()
+        self._spec_dirty.clear()
         for i, s in enumerate(self._slots):
             if s is not None:
                 s.req.emit(-1, "error")
@@ -1217,11 +1306,13 @@ class Engine:
                 # batched path = classified with no reusable prefix
                 self.stats.prefix_cache_misses += 1
                 self.prefix_cache.insert(
-                    chain, self.allocator.pages(seq_id))
+                    chain, self.allocator.pages(seq_id),
+                    tokens=req.prompt)
             self._slots[slot_idx] = _Slot(
                 req=req, pos=n - 1, generated=0,
                 key_seed=req.sampling.seed or seq_id,
                 limit=total, page_row=pt[g], adapter_row=int(adapter[g]),
+                ctrl=self._make_ctrl(req),
             )
             self.stats.prefills += 1
             self._mark_admitted(slot_idx)
@@ -1233,13 +1324,13 @@ class Engine:
 
     def _mark_admitted(self, i: int) -> None:
         """Mark slot i for an incremental row upload into the live
-        device state. Falls back to a full rebuild when the decode page
-        bucket must grow (new compiled shape) or under speculation (the
-        on-device history buffer has no row-update path)."""
+        device state — including its speculation history/lookahead
+        rows, so admissions never drain the pipeline. Falls back to a
+        full rebuild only when the decode page bucket must grow (new
+        compiled shape)."""
         self._dirty_rows.add(i)
-        if self._spec:
-            self._need_rebuild = True
-        elif (self._device_state is not None and not self._need_rebuild
+        self._spec_dirty.discard(i)  # the full row carries draft_len
+        if (self._device_state is not None and not self._need_rebuild
                 and self._decode_bucket_pages() > self._state_bucket):
             self._need_rebuild = True
 
@@ -1304,6 +1395,22 @@ class Engine:
             # the caller puts it back (in arrival order) to wait for
             # a slot to free pages
             return "stop"
+        if self._spec_max:
+            # direct speculative-safety invariant (replaces the old
+            # repin-on-rebuild guard): no page overlapping the slot's
+            # writable tail [n, limit) may be shared — draft K/V
+            # (including rejected drafts') scatters there. Healthy
+            # layouts pass by construction; a violation is CoW-repaired
+            # and logged, never silently corrupted.
+            trunc = getattr(self.allocator, "truncate_to", None)
+            if trunc is not None:
+                for old_pg, new_pg, needs_copy in trunc(seq_id, n):
+                    logger.warning(
+                        "speculative admission CoW'd shared tail page "
+                        "%d->%d for seq %d", old_pg, new_pg, seq_id)
+                    if needs_copy:
+                        self._copy_page_dev(old_pg, new_pg)
+                        self.stats.prefix_cow_copies += 1
         pages = self.allocator.pages(seq_id)
         req.id = seq_id
 
@@ -1466,10 +1573,27 @@ class Engine:
             0.0, 1e3 * (time.monotonic() - t0) - tick_ms)
         t_first = time.monotonic()
         if self.prefix_cache is not None and chain_keys:
-            self.prefix_cache.insert(chain_keys, pages)
+            self.prefix_cache.insert(chain_keys, pages,
+                                     tokens=req.prompt)
         logger.debug("prefill seq=%d len=%d prefix=%d bucket=%d %.1fms",
                      seq_id, n, prefix_len, S,
                      1e3 * (time.monotonic() - t0))
+
+        # speculative draft sources for the new slot: the adaptive
+        # controller, plus — when the radix chain remembers what
+        # followed this prefix last time — one page of continuation
+        # tokens as the lookahead draft buffer (repeated chat traffic's
+        # free high-acceptance source)
+        ctrl = self._make_ctrl(req)
+        la_base = 0
+        la_tokens: list[int] = []
+        if (ctrl is not None and self.prefix_cache is not None
+                and chain_keys):
+            cont = self.prefix_cache.continuation(chain_keys)
+            if cont is not None and cont[0] * ps + len(cont[1]) > n:
+                la_base = cont[0] * ps
+                la_tokens = cont[1]
+                self.stats.spec_lookahead_slots += 1
 
         # pos=n-1: _emit_token advances it to n, the write position of
         # the just-sampled first token.
@@ -1477,6 +1601,7 @@ class Engine:
             req=req, pos=n - 1, generated=0,
             key_seed=req.sampling.seed or seq_id,
             limit=total, page_row=pt[0], adapter_row=adapter_row,
+            ctrl=ctrl, la_base=la_base, la_tokens=la_tokens,
         )
         self._mark_admitted(slot_idx)
         self._emit_token(slot_idx, tok, first_lp)
@@ -1533,20 +1658,9 @@ class Engine:
         counts = np.zeros((B, V), np.int32)
         bias = np.zeros((B, V), np.float32)
         adapter_idx = np.full((B,), self._base_row, np.int32)
-        repin = getattr(self.allocator, "repin", None)
         for i, s in enumerate(self._slots):
             if s is None:
                 continue
-            if repin is not None:
-                # full rebuilds re-assert page pins: a speculative
-                # session's adopted prefix pages must survive the
-                # rebuild, never drift into the evictable pool while
-                # the slot still reads them
-                fixed = repin(s.req.id)
-                if fixed:
-                    logger.warning(
-                        "state rebuild re-pinned %d orphaned pages for "
-                        "seq %d", fixed, s.req.id)
             tokens[i] = s.pending_token
             positions[i] = s.pos
             limits[i] = s.limit
@@ -1567,10 +1681,18 @@ class Engine:
                     bias[i, tok_id] = b
             adapter_idx[i] = s.adapter_row
         state_extra: dict[str, jax.Array] = {}
-        if self._spec:
-            # speculation history: prompt + generated tokens, valid
-            # through the pending token's position
+        if self._spec_max:
+            # speculation rows: token history (prompt + generated,
+            # valid through the pending token's position), the per-slot
+            # adaptive draft length, and the prefix-cache continuation
+            # lookahead. The row update uploads the same fields
+            # per-slot, so admissions never force this full build.
+            L = self.cfg.page_size
             history = np.zeros((B, self.cfg.max_seq_len), np.int32)
+            draft_len = np.zeros((B,), np.int32)
+            lookahead = np.zeros((B, L), np.int32)
+            la_base = np.zeros((B,), np.int32)
+            la_len = np.zeros((B,), np.int32)
             for i, s in enumerate(self._slots):
                 if s is None:
                     continue
@@ -1579,7 +1701,18 @@ class Engine:
                 history[i, len(pr): len(pr) + len(s.gen_tokens)] = (
                     s.gen_tokens
                 )
+                if s.ctrl is not None:
+                    draft_len[i] = s.ctrl.draft_len()
+                    s.dev_draft_len = int(draft_len[i])
+                if s.la_tokens:
+                    lookahead[i, : len(s.la_tokens)] = s.la_tokens
+                    la_base[i] = s.la_base
+                    la_len[i] = len(s.la_tokens)
             state_extra["history"] = jnp.asarray(history)
+            state_extra["draft_len"] = jnp.asarray(draft_len)
+            state_extra["lookahead"] = jnp.asarray(lookahead)
+            state_extra["la_base"] = jnp.asarray(la_base)
+            state_extra["la_len"] = jnp.asarray(la_len)
         return state_extra | {
             "tokens": jnp.asarray(tokens),
             "positions": jnp.asarray(positions),
@@ -1618,6 +1751,13 @@ class Engine:
             "bias": np.zeros((V,), np.float32),
             "adapter_idx": np.int32(self._base_row),
         }
+        if self._spec_max:
+            L = self.cfg.page_size
+            row["history"] = np.zeros((self.cfg.max_seq_len,), np.int32)
+            row["draft_len"] = np.int32(0)
+            row["lookahead"] = np.zeros((L,), np.int32)
+            row["la_base"] = np.int32(0)
+            row["la_len"] = np.int32(0)
         if s is None:
             return row
         row["tokens"] = np.int32(s.pending_token)
@@ -1639,6 +1779,18 @@ class Engine:
             if 0 <= tok_id < V:
                 row["bias"][tok_id] = b
         row["adapter_idx"] = np.int32(s.adapter_row)
+        if self._spec_max:
+            pr = s.req.prompt
+            row["history"][: len(pr)] = pr
+            row["history"][len(pr): len(pr) + len(s.gen_tokens)] = (
+                s.gen_tokens)
+            if s.ctrl is not None:
+                row["draft_len"] = np.int32(s.ctrl.draft_len())
+                s.dev_draft_len = int(row["draft_len"])
+            if s.la_tokens:
+                row["lookahead"][: len(s.la_tokens)] = s.la_tokens
+                row["la_base"] = np.int32(s.la_base)
+                row["la_len"] = np.int32(len(s.la_tokens))
         return row
 
     def _apply_row_updates(self) -> None:
@@ -1661,6 +1813,65 @@ class Engine:
                 self._device_state, np.int32(i),
                 self._row_host_values(i, P))
         self._dirty_rows.clear()
+
+    def _apply_spec_row_updates(self) -> None:
+        """Patch live slots' on-device ``draft_len`` after an adaptive
+        rung move. Unlike the full row update this touches ONLY the
+        draft length — a live slot's positions/history on device run
+        ahead of the host's view while a window is in flight, so
+        re-uploading its full row mid-pipeline would rewind it, but
+        the draft length is position-independent and safe to patch at
+        any time."""
+        if self._spec_update_fn is None:
+            def _sup(state, i, d):
+                return dict(
+                    state, draft_len=state["draft_len"].at[i].set(d))
+
+            self._spec_update_fn = jax.jit(_sup, donate_argnums=(0,))
+        for i in sorted(self._spec_dirty):
+            s = self._slots[i]
+            d = (s.ctrl.draft_len()
+                 if s is not None and s.ctrl is not None else 0)
+            self._device_state = self._spec_update_fn(
+                self._device_state, np.int32(i), np.int32(d))
+            if s is not None:
+                s.dev_draft_len = d
+        self._spec_dirty.clear()
+
+    def _make_ctrl(self, req: GenRequest):
+        """Adaptive draft controller for a fresh slot — or None when
+        the request is ineligible (sampling / penalties: those slots
+        fall back to plain decode and never lift the dispatch width)."""
+        sp = req.sampling
+        if (not self._spec_max or sp.temperature > 0.0
+                or sp.frequency_penalty != 0.0
+                or sp.presence_penalty != 0.0):
+            return None
+        return speculation.DraftController(
+            self._spec_rungs, self._accept_prior, self.cfg.spec_adaptive)
+
+    def _choose_draft_len(self) -> int:
+        """Dispatch draft width: the max of the active eligible slots'
+        adaptive rungs. 0 dispatches the PLAIN decode program —
+        default-on speculation costs nothing once every ladder has
+        collapsed. Ticking the controllers here also runs the rung-0
+        re-probe policy; any rung move is patched on device before the
+        dispatch that follows."""
+        if not self._spec_max:
+            return 0
+        d = 0
+        for i, s in enumerate(self._slots):
+            if s is None or s.ctrl is None:
+                continue
+            before = s.ctrl.draft_len()
+            nd = s.ctrl.tick()
+            if nd > before:
+                self.stats.spec_rung_ups += 1  # rung-0 re-probe
+            if nd != s.dev_draft_len and i not in self._dirty_rows:
+                self._spec_dirty.add(i)
+            d = max(d, nd)
+        self.stats.spec_draft_len = d
+        return d
 
     def _process_window(self, toks: np.ndarray, lp,
                         members: tuple) -> None:
@@ -1686,18 +1897,30 @@ class Engine:
                 self._emit_token(i, int(toks[k, i]), step_lp)
 
     def _process_spec_window(self, toks: np.ndarray, counts: np.ndarray,
-                             members: tuple) -> None:
-        """Speculative window: sampled [K, B, D+1], n_emit [K, B] — the
-        leading n_emit tokens of each row are model-exact; the rest are
-        conditioned on rejected drafts and discarded."""
+                             props: np.ndarray, members: tuple,
+                             draft_lens: tuple = ()) -> None:
+        """Speculative window: sampled [K, B, D+1], n_emit [K, B],
+        n_prop [K, B] — the leading n_emit tokens of each row are
+        model-exact; the rest are conditioned on rejected drafts and
+        discarded. Afterwards each surviving slot's adaptive controller
+        observes the window's proposed/accepted counts and may move its
+        rung (patched on device by the draft_len-only row update before
+        the next dispatch)."""
         K = toks.shape[0]
         self.stats.decode_steps += K
+        dl = dict(draft_lens)
+        proposed = dict.fromkeys(dl, 0)
+        accepted = dict.fromkeys(dl, 0)
+        live = dict.fromkeys(dl, False)
         for k in range(K):
             for i, req in members:
                 s = self._slots[i]
                 if s is None or s.req is not req:
                     continue
                 n = int(counts[k, i])
+                if n > 0:
+                    proposed[i] = proposed.get(i, 0) + int(props[k, i])
+                    live[i] = True
                 emitted = 0
                 for d in range(n):
                     cur = self._slots[i]
@@ -1707,6 +1930,25 @@ class Engine:
                     emitted += 1
                 if emitted > 1:
                     self.stats.spec_accepted += emitted - 1
+                    accepted[i] = accepted.get(i, 0) + emitted - 1
+        for i, req in members:
+            # only slots that decoded under a nonzero draft width this
+            # window carry a controller signal
+            if not live.get(i, False) or dl.get(i, 0) <= 0:
+                continue
+            self.stats.spec_drafted += proposed.get(i, 0)
+            s = self._slots[i]
+            if s is None or s.req is not req or s.ctrl is None:
+                continue
+            move = s.ctrl.observe_window(proposed.get(i, 0),
+                                         accepted.get(i, 0))
+            if move:
+                if move > 0:
+                    self.stats.spec_rung_ups += 1
+                else:
+                    self.stats.spec_rung_downs += 1
+                if i not in self._dirty_rows:
+                    self._spec_dirty.add(i)
 
     def _drain_inflight(self) -> None:
         """Settle the in-flight window: resolve its (already started,
@@ -1719,8 +1961,9 @@ class Engine:
         host = jax.tree_util.tree_map(np.asarray, w.sampled)
         t1 = time.monotonic()
         self.stats.transfer_ms += 1e3 * (t1 - t0)
-        if self._spec:
-            self._process_spec_window(host[0], host[1], w.members)
+        if w.draft:
+            self._process_spec_window(host[0], host[1], host[2],
+                                      w.members, w.draft_lens)
         elif isinstance(host, tuple):  # logprobs window
             toks, chosen, tk_ids, tk_vals = host
             self._process_window(toks, (chosen, tk_ids, tk_vals),
@@ -1762,12 +2005,19 @@ class Engine:
             return False
 
         if self._need_rebuild or self._device_state is None:
+            if self._need_rebuild and self._device_state is not None:
+                # a LIVE pipeline is drained for a full rebuild — only
+                # page-bucket growth lands here now; the speculative
+                # path must never (the zero-rebuild acceptance
+                # criterion asserts on this counter)
+                self.stats.state_rebuilds += 1
             # finish the window computed under the old state first
             self._drain_inflight()
             self._apply_frees()
             self._device_state = self._build_device_state()
             self._need_rebuild = False
             self._dirty_rows.clear()
+            self._spec_dirty.clear()
         elif self._dirty_rows:
             self._apply_row_updates()
 
@@ -1799,12 +2049,25 @@ class Engine:
                 self._refresh_stats()
                 return True
 
+        # speculative dispatch width (and any rung-move patches) must
+        # settle before the program choice below
+        draft = self._choose_draft_len()
+        if self._spec_dirty:
+            self._apply_spec_row_updates()
         k = self._choose_window()
         members = tuple(
             (i, self._slots[i].req) for i in active_idx
         )
+        draft_lens: tuple = ()
+        if draft:
+            draft_lens = tuple(
+                (i, self._slots[i].ctrl.draft_len())
+                for i in active_idx
+                if self._slots[i].ctrl is not None
+            )
         frees, self._pending_frees = self._pending_frees, []
-        decode_fn = self._decode_fn_for(k, self._lean_decode_ok())
+        lean = draft == 0 and self._lean_decode_ok()
+        decode_fn = self._decode_fn_for(k, lean, draft)
         sampled, self._device_state, self.kv_cache = decode_fn(
             self.params, self.lora_params, self.kv_cache, self._device_state
         )
@@ -1815,7 +2078,8 @@ class Engine:
         # process the PREVIOUS window while this one runs on-device
         self._drain_inflight()
         self._inflight = _Window(sampled=sampled, members=members, k=k,
-                                 frees=frees)
+                                 frees=frees, draft=draft,
+                                 draft_lens=draft_lens)
         self.stats.active_slots = sum(s is not None for s in self._slots)
         self._refresh_stats()
         return True
@@ -1863,6 +2127,9 @@ class Engine:
         self.stats.queued = self._queue.qsize()
         self.stats.kv_pages_free = self.allocator.free_pages
         self.stats.kv_occupancy = self.allocator.occupancy
+        self.stats.spec_accept_rate = (
+            self.stats.spec_accepted / self.stats.spec_drafted
+            if self.stats.spec_drafted else 0.0)
         if self.prefix_cache is not None:
             self.stats.prefix_cache_evictions = self.prefix_cache.evictions
             self.stats.prefix_pages_resident = (

@@ -207,23 +207,43 @@ class RefcountedAllocator(PageAllocator):
         self._release_page(page)
         return fresh
 
-    def repin(self, seq_id: int) -> int:
-        """Re-assert pins on a live sequence's pages (full-state
-        rebuilds — speculation rebuilds the on-device history every
-        admission). Any owned page found parked in the evictable pool
-        or missing its refcount is pulled back into active use instead
-        of being orphaned into eviction while the sequence still reads
-        it. Returns the number of pages re-pinned (0 when healthy)."""
-        fixed = 0
-        for p in self._owned.get(seq_id, []):
-            if p in self._evictable:
-                del self._evictable[p]
-                self._refs[p] = self._refs.get(p, 0) + 1
-                fixed += 1
-            elif p not in self._refs:
-                self._refs[p] = 1
-                fixed += 1
-        return fixed
+    def truncate_to(self, seq_id: int, n_tokens: int) -> list[tuple]:
+        """Un-write a sequence's tail from position ``n_tokens`` on:
+        every owned page overlapping [n_tokens, ∞) must be PRIVATELY
+        writable before decode/verify scatters land there — a shared or
+        cache-registered page in that range would let (possibly
+        rejected) draft K/V corrupt state other chains read. This is
+        the speculative-path safety invariant, asserted directly at
+        admission instead of the old repin-on-full-rebuild guard (the
+        per-admission rebuild itself is gone).
+
+        Healthy layouts satisfy the invariant by construction —
+        generation writes land past the registered prompt pages, and
+        full-prefix hits CoW their final page at adoption — so this
+        normally returns []. A violating page is swapped for a fresh
+        private one (its registration and other references survive on
+        the original). Returns [(old_page, fresh_page, needs_copy)]:
+        ``needs_copy`` is True when the page straddles the truncation
+        offset — positions below ``n_tokens`` in it are live history
+        the caller must clone device-side before anything writes."""
+        owned = self._owned.get(seq_id, [])
+        first = n_tokens // self.page_size
+        swaps: list[tuple] = []
+        for idx in range(first, len(owned)):
+            page = owned[idx]
+            shared = (self._refs.get(page, 1) > 1
+                      or self._cache_key_of(page) is not None)
+            if not shared:
+                continue
+            fresh = self._pop_page()
+            self._refs[fresh] = 1
+            owned[idx] = fresh
+            self._release_page(page)
+            swaps.append((
+                page, fresh,
+                idx == first and n_tokens % self.page_size != 0,
+            ))
+        return swaps
 
     # cache bookkeeping — maintained by PrefixCache
     def _cache_key_of(self, page: int):
@@ -262,6 +282,11 @@ class PrefixCache:
         self.page_size = page_size
         self._by_key: dict[bytes, int] = {}
         self._key_by_page: dict[int, bytes] = {}
+        # chain key → the tokens that FOLLOWED that prefix last time it
+        # was inserted (≤ one page) — the speculative continuation draft
+        # source (tpuserve/speculation.py lookahead_drafts). Host memory
+        # only, bounded by residency: evicted entries drop theirs.
+        self._next_tokens: dict[bytes, list[int]] = {}
         #: entries reclaimed under pool pressure (monotonic counter)
         self.evictions = 0
         allocator._prefix_cache = self
@@ -289,8 +314,13 @@ class PrefixCache:
             pages.append(page)
         return pages
 
-    def insert(self, keys: list[bytes], page_row: list[int]) -> None:
-        """Register fully-written prompt pages (keys from lookup())."""
+    def insert(self, keys: list[bytes], page_row: list[int],
+               tokens: list[int] | None = None) -> None:
+        """Register fully-written prompt pages (keys from lookup()).
+        With ``tokens`` (the full prompt) also records, per chain key,
+        up to one page of the tokens that followed that prefix — the
+        speculative continuation draft source. Latest insertion wins:
+        repeated chat traffic keeps the freshest next-turn guess."""
         for i, key in enumerate(keys):
             if i >= len(page_row):
                 break
@@ -298,12 +328,35 @@ class PrefixCache:
             if existing is None:
                 self._by_key[key] = page_row[i]
                 self._key_by_page[page_row[i]] = key
+        if tokens is not None:
+            ps = self.page_size
+            for i, key in enumerate(keys):
+                nxt = tokens[(i + 1) * ps: (i + 2) * ps]
+                # longest-wins, then latest-wins: a re-asked short
+                # prompt's partial tail must not clobber the full-page
+                # continuation a superseding (next-turn) prompt taught
+                if nxt and len(nxt) >= len(self._next_tokens.get(key, ())):
+                    self._next_tokens[key] = nxt
+
+    def continuation(self, keys: list[bytes]) -> tuple[int, list[int]] | None:
+        """Deepest chain key with a recorded continuation: returns
+        (depth_pages, tokens), where ``tokens`` follow absolute
+        position ``depth_pages * page_size``. None when no key of the
+        chain has one. Only a draft HINT — verification rejects stale
+        continuations, so no freshness guarantee is needed."""
+        best: tuple[int, list[int]] | None = None
+        for i, key in enumerate(keys):
+            nxt = self._next_tokens.get(key)
+            if nxt:
+                best = (i + 1, nxt)
+        return best
 
     def key_of_page(self, page: int):
         return self._key_by_page.get(page)
 
     def _evicted(self, key: bytes) -> None:
         page = self._by_key.pop(key, None)
+        self._next_tokens.pop(key, None)
         if page is not None:
             self._key_by_page.pop(page, None)
             self.evictions += 1

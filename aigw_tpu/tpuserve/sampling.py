@@ -71,6 +71,32 @@ def apply_penalties(
     return out
 
 
+def spec_accept(
+    drafts: jax.Array,  # [B, D] int32 proposed tokens (-1 = no proposal)
+    sampled: jax.Array,  # [B, D+1] int32 model samples per position
+    active: jax.Array,  # [B] bool slot occupied + below its limit
+    budget: jax.Array,  # [B] int32 tokens the slot may still emit
+) -> tuple[jax.Array, jax.Array]:
+    """Vectorized acceptance masks for speculative verification.
+
+    Longest-matching-prefix rule per slot: ``n_acc`` drafts whose
+    cumulative match with the model's own samples is unbroken are
+    accepted, and the model's sample at the position after them rides
+    along — so every step emits ``n_acc + 1`` model-exact tokens,
+    clipped to the slot's remaining ``budget`` (the page-safety fence).
+    Returns (n_emit [B] int32, emit_mask [B, D+1] bool): emit_mask[b, d]
+    marks sampled[b, d] as model-exact output; everything past it is
+    conditioned on a rejected draft and must be discarded."""
+    D = drafts.shape[1]
+    match = (drafts == sampled[:, :D]).astype(jnp.int32)
+    n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+    n_emit = jnp.where(
+        active, jnp.minimum(n_acc + 1, jnp.maximum(budget, 0)), 0
+    )
+    d_idx = jnp.arange(D + 1, dtype=jnp.int32)[None, :]
+    return n_emit, d_idx < n_emit[:, None]
+
+
 def sample(
     logits: jax.Array,  # [B, V] float32
     keys: jax.Array,  # [B, 2] uint32 (jax PRNG keys, one per slot)

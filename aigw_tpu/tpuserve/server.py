@@ -1180,6 +1180,16 @@ class TPUServeServer:
                 "prefix_cache_hits": s.prefix_cache_hits,
                 "prefix_cache_misses": s.prefix_cache_misses,
                 "prefix_cache_evictions": s.prefix_cache_evictions,
+                # speculative decoding surface: acceptance telemetry
+                # for dashboards and the bench --ab spec_decode leg
+                "spec_accepted": s.spec_accepted,
+                "spec_drafted": s.spec_drafted,
+                "spec_accept_rate": round(s.spec_accept_rate, 4),
+                "spec_draft_len": s.spec_draft_len,
+                "spec_rung_ups": s.spec_rung_ups,
+                "spec_rung_downs": s.spec_rung_downs,
+                "spec_lookahead_slots": s.spec_lookahead_slots,
+                "state_rebuilds": s.state_rebuilds,
                 # ICI topology: the picker's same-slice preference term
                 # (gateway/picker.py) keys on this
                 **device_topology(),
@@ -1210,6 +1220,7 @@ async def run_tpuserve(
     sp_prefill_min_tokens: int = 1024,
     prefill_chunk_tokens: int = 256,
     spec_tokens: int = 0,
+    spec_adaptive: bool = True,
     pallas_attn: bool = False,
     logprobs_topk: int = 0,
     adaptive_decode_window: bool = True,
@@ -1230,6 +1241,7 @@ async def run_tpuserve(
             sp_prefill_min_tokens=sp_prefill_min_tokens,
             prefill_chunk_tokens=prefill_chunk_tokens,
             spec_tokens=spec_tokens,
+            spec_adaptive=spec_adaptive,
             pallas_attn=pallas_attn,
             logprobs_topk=logprobs_topk,
             adaptive_decode_window=adaptive_decode_window,

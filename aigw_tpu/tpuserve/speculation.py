@@ -1,45 +1,61 @@
-"""Prompt-lookup speculative decoding (n-gram self-speculation).
+"""Adaptive multi-source speculative decoding (engine subsystem).
 
-TPU-native speculation without a draft model: guess the next D tokens by
-finding the most recent earlier occurrence of the current 2-gram in the
-sequence's own token history (prompt + generated) and proposing its
-continuation — then verify all D+1 positions in ONE model step
-(models/llama.py ``verify_step``) and accept the longest draft prefix
-that matches the model's own per-position samples.
+TPU-native speculation without a draft model: guess the next D tokens,
+verify all D+1 positions in ONE model step (``models/llama.py
+verify_step``), and accept the longest draft prefix that matches the
+model's own per-position samples. Two draft sources feed the verifier:
+
+- **n-gram prompt lookup** (``ngram_drafts``): the continuation of the
+  most recent earlier occurrence of the current 2-gram in the
+  sequence's own on-device token history — a vectorized compare over
+  the [B, H] history buffer, no host round-trip inside a decode window
+  (vLLM's ngram speculator is the public precedent).
+- **prefix-cache continuation lookup** (``lookahead_drafts``): the
+  radix page chains (kvcache.PrefixCache) remember which tokens
+  FOLLOWED each cached prompt prefix the last time it was seen — on
+  repeated chat traffic the next assistant turn often replays the
+  previous one, so the cached continuation is a free, high-acceptance
+  draft. The host loads one page of continuation tokens into the
+  slot's device row at admission; positions it covers draft from it,
+  everything else falls back to the n-gram source (``combine_drafts``).
 
 Why this fits the engine's fixed-geometry contract (tpuserve/engine.py):
 
-- the verify step has a STATIC shape [B, D+1] — one compiled program,
-  like the [B, 1] decode step it replaces;
-- the draft lookup is a vectorized compare over the on-device history
-  buffer [B, S] — no host round-trip inside the K-step window;
+- each draft-length rung D is a STATIC [B, D+1] verify program — one
+  compiled program per rung, warmed like the prefill ladder; per-slot
+  draft lengths below the dispatched rung are masked on device
+  (``draft_len`` row), and a rung of 0 dispatches the PLAIN decode
+  program, so collapsed speculation costs literally nothing;
 - per-position PRNG keys are derived from the absolute position, so
   accepted tokens are sampled from *exactly* the distribution the
   non-speculative path would have used: speculation on/off produces
-  bit-identical streams for the same seed (asserted in
-  tests/test_spec_decode.py);
-- rejected drafts cost nothing to undo: their stale K/V writes sit at
-  positions the causal gather mask (``t <= pos``) can only reach after
-  a later step has re-scattered them (see ``verify_step`` docstring).
+  identical greedy streams in the deterministic f32 rig (asserted in
+  tests/test_spec_decode.py and tests/test_spec_equivalence_property.py);
+- rejected drafts cost nothing to undo ON THIS sequence: their stale
+  K/V writes sit at positions the causal gather mask (``t <= pos``)
+  can only reach after a later step has re-scattered them (see
+  ``verify_step``'s docstring; bit-exactness property-tested). The
+  only pages drafts may write into are the slot's PRIVATE tail pages —
+  ``RefcountedAllocator.truncate_to`` asserts (and, CoW-repairing,
+  enforces) that invariant at speculative admission, which is what
+  lets admissions ride the incremental row-update path instead of the
+  full device-state rebuild speculation used to force.
 
-Slots with frequency/presence penalties get poisoned drafts (-1, which
-never equals a sampled id), so they advance one exact token per step —
-penalty counts evolve per accepted token, and within-window count
-updates for multi-token acceptance would be approximate otherwise.
+**Adaptive draft length.** Speculation only pays when drafts are
+accepted; on adversarial traffic a fixed D taxes every step with a
+(D+1)-wide verify that emits one token. Each eligible slot carries a
+``DraftController`` walking a small rung ladder (``draft_rungs``:
+{0, 2, 4, 8}-style) on a rolling acceptance EWMA — shrinking to D=0
+(plain decode, zero overhead) when acceptance is poor and re-probing
+occasionally so a regime change is noticed. New slots start from an
+engine-wide ``AcceptancePrior`` so a burst of adversarial requests
+stops paying the collapse cost after the first few windows see it.
 
-Prefix-cache interplay: speculation forces a FULL device-state rebuild
-on every admission (the on-device history buffer has no row-update
-path). A rebuild must RE-PIN, never orphan, a live session's adopted
-prefix pages — the engine re-asserts every active slot's page pins via
-``RefcountedAllocator.repin`` inside ``_build_device_state``, so a
-speculative session's shared pages can never drift into the evictable
-pool while the session still reads them (regression:
-tests/test_spec_decode.py::TestSpecPrefixCacheInterplay).
-
-The reference has no serving engine (it routes to upstream providers);
-this subsystem exists because the TPU framework ships its own model
-server (SURVEY.md §2.9). The technique is prompt-lookup decoding
-(PAPERS.md; vLLM's ngram speculator is the public precedent).
+Slots with repetition penalties or nonzero temperature fall back to
+plain decode (their drafts are poisoned to -1 on device, and the host
+never lets them lift the dispatched rung): penalty counts evolve per
+accepted token, and multi-token in-window count updates would be
+approximate; sampled acceptance is kept out of scope by design.
 """
 
 from __future__ import annotations
@@ -47,15 +63,151 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+# -- adaptive-ladder tuning ----------------------------------------------
+#: EWMA weight of each window's per-draft acceptance ratio. 0.5 collapses
+#: a cold slot (ewma 1.0) below RUNG_DOWN_BELOW in two zero-acceptance
+#: windows — adversarial streams stop paying for verify width fast.
+EWMA_ALPHA = 0.5
+#: drop one rung when the acceptance EWMA falls below this
+RUNG_DOWN_BELOW = 0.35
+#: climb one rung when the acceptance EWMA rises above this
+RUNG_UP_ABOVE = 0.75
+#: EWMA decay per window in which the draft sources PROPOSED nothing
+#: (no n-gram match, no continuation): the verify width was still
+#: wasted, but it is weaker evidence than proposed-and-rejected — a
+#: young repetitive stream proposes nothing for its first few windows
+#: and must not be collapsed before its pattern establishes.
+NO_PROPOSAL_DECAY = 0.85
+#: windows a collapsed (rung-0) slot waits before re-probing the
+#: smallest nonzero rung. One probe window in 64 bounds steady-state
+#: adversarial overhead to ~1-2% while still noticing a regime change.
+REPROBE_WINDOWS = 64
+#: weight of each window in the engine-wide acceptance prior
+PRIOR_ALPHA = 0.05
+#: prior at/above which a fresh slot starts at the TOP rung
+PRIOR_OPTIMISTIC = 0.6
+#: prior below which a fresh slot starts collapsed (rung 0). Sits just
+#: at the rung-demotion line: traffic whose slots keep collapsing
+#: drags the prior here within a couple of requests, after which new
+#: slots stop paying the per-request collapse cost entirely.
+PRIOR_PESSIMISTIC = 0.35
+
+
+def draft_rungs(max_tokens: int) -> tuple[int, ...]:
+    """The draft-length ladder for a ``spec_tokens`` budget: rung 0
+    (plain decode) plus power-of-two rungs up to the budget — e.g.
+    8 → (0, 2, 4, 8); 3 → (0, 2, 3). Each nonzero rung is one compiled
+    verify program, so the ladder is deliberately short."""
+    if max_tokens <= 0:
+        return (0,)
+    rungs = {0, max_tokens}
+    d = 2
+    while d < max_tokens:
+        rungs.add(d)
+        d *= 2
+    return tuple(sorted(rungs))
+
+
+class AcceptancePrior:
+    """Engine-wide rolling estimate of draft acceptance. New slots
+    start their controller from it, so workloads where speculation
+    never pays (the EWMA collapsed every recent slot) admit straight
+    into rung 0 instead of re-learning per request."""
+
+    def __init__(self) -> None:
+        self.value = 1.0  # optimistic: repetitive traffic wins day one
+
+    def observe(self, ratio: float) -> None:
+        self.value += PRIOR_ALPHA * (ratio - self.value)
+
+    def initial_rung(self, n_rungs: int) -> int:
+        if n_rungs <= 1:
+            return 0
+        if self.value >= PRIOR_OPTIMISTIC:
+            return n_rungs - 1
+        if self.value < PRIOR_PESSIMISTIC:
+            return 0
+        return max(1, (n_rungs - 1) // 2)
+
+
+class DraftController:
+    """Per-slot adaptive draft length over a rung ladder.
+
+    ``tick()`` is called at every dispatch (returns the slot's current
+    draft length; at rung 0 it counts idle windows and periodically
+    re-probes the smallest nonzero rung). ``observe_window()`` is
+    called at drain with the window's drafted/accepted token counts and
+    returns -1/0/+1 for the rung move it made, so the engine can mark
+    the slot's device row dirty and count transitions."""
+
+    def __init__(self, rungs: tuple[int, ...], prior: AcceptancePrior,
+                 adaptive: bool = True) -> None:
+        self.rungs = rungs
+        self.prior = prior
+        self.adaptive = adaptive
+        self.rung = (len(rungs) - 1 if not adaptive
+                     else prior.initial_rung(len(rungs)))
+        # a fresh slot inherits the prior's optimism but never starts
+        # below the demotion line (it deserves at least one window)
+        self.ewma = max(prior.value, RUNG_DOWN_BELOW) if adaptive else 1.0
+        self.idle_windows = 0
+
+    def draft_len(self) -> int:
+        return self.rungs[self.rung]
+
+    def tick(self) -> int:
+        if (self.adaptive and self.rung == 0 and len(self.rungs) > 1):
+            self.idle_windows += 1
+            if self.idle_windows >= REPROBE_WINDOWS:
+                # re-probe: one window at the smallest rung with the
+                # EWMA parked on the demotion line — a single bad
+                # window sends it straight back to 0
+                self.idle_windows = 0
+                self.rung = 1
+                self.ewma = RUNG_DOWN_BELOW
+        return self.draft_len()
+
+    def observe_window(self, proposed: int, accepted: int) -> int:
+        """``proposed`` = draft tokens the sources actually offered the
+        verifier this window (NOT the configured width): rejected
+        proposals collapse the EWMA fast, proposal-less windows decay
+        it slowly, accepted proposals pull it up."""
+        if not self.adaptive:
+            return 0
+        if proposed > 0:
+            ratio = accepted / proposed
+            self.prior.observe(ratio)
+            self.ewma += EWMA_ALPHA * (ratio - self.ewma)
+        else:
+            self.prior.observe(0.0)
+            self.ewma *= NO_PROPOSAL_DECAY
+        if self.ewma < RUNG_DOWN_BELOW and self.rung > 0:
+            self.rung -= 1
+            self.idle_windows = 0
+            return -1
+        if self.ewma > RUNG_UP_ABOVE and self.rung < len(self.rungs) - 1:
+            self.rung += 1
+            return 1
+        return 0
+
+
+# -- draft sources (device-side, jit-able) --------------------------------
 
 def ngram_drafts(
     history: jax.Array,  # [B, H] int32 token history (prompt + generated)
     positions: jax.Array,  # [B] int32 — history is valid through `positions`
     n_draft: int,
 ) -> jax.Array:
-    """Propose ``n_draft`` tokens per slot from the last 2-gram's most
-    recent earlier occurrence. Returns [B, n_draft] int32; -1 marks "no
-    proposal" at that offset (never matches a sampled token id).
+    """Propose ``n_draft`` tokens per slot from an earlier occurrence
+    of the last 2-gram: the most recent match whose continuation has
+    all ``n_draft`` tokens already in history, else the most recent
+    match outright (its continuation clips at ``positions``). The
+    full-continuation preference matters on periodic streams — pure
+    repetition's most recent match is the overlapping one at pos-2,
+    whose continuation is ONE token, wasting all but one lane of the
+    verify width (exactly the high-acceptance traffic speculation
+    exists for). Returns [B, n_draft] int32; -1 marks "no proposal" at
+    that offset (never matches a sampled token id).
     """
     B, H = history.shape
     pos = positions[:, None]  # [B, 1]
@@ -68,13 +220,43 @@ def ngram_drafts(
     # (equivalently: its continuation t+2 already exists in history)
     m = m & (t < pos - 1)
     found = m.any(axis=1)
-    j = jnp.argmax(jnp.where(m, t, -1), axis=1)  # most recent match start
+    j_any = jnp.argmax(jnp.where(m, t, -1), axis=1)  # most recent
+    m_full = m & (t + 1 + n_draft <= pos)  # full continuation on hand
+    j_full = jnp.argmax(jnp.where(m_full, t, -1), axis=1)
+    j = jnp.where(m_full.any(axis=1), j_full, j_any)
 
     d = jnp.arange(n_draft, dtype=jnp.int32)[None, :]
     src = j[:, None] + 2 + d  # [B, n_draft]
     valid = found[:, None] & (src <= pos)
     drafts = jnp.take_along_axis(history, jnp.clip(src, 0, H - 1), 1)
     return jnp.where(valid, drafts, -1)
+
+
+def lookahead_drafts(
+    lookahead: jax.Array,  # [B, L] int32 cached continuation tokens
+    la_base: jax.Array,  # [B] int32 absolute position of lookahead[:, 0]
+    la_len: jax.Array,  # [B] int32 valid length (0 = no continuation)
+    positions: jax.Array,  # [B] int32 pending-token position
+    n_draft: int,
+) -> jax.Array:
+    """Drafts from the prefix cache's continuation buffer: position
+    ``pos + 1 + d`` proposes ``lookahead[pos + 1 + d - la_base]`` when
+    that offset is in range. Returns [B, n_draft] int32 with -1 where
+    the buffer has no proposal (callers fall back to another source).
+    The buffer is a HINT — verification rejects it wherever the stream
+    has diverged from last time's continuation."""
+    B, L = lookahead.shape
+    d = jnp.arange(n_draft, dtype=jnp.int32)[None, :]
+    off = positions[:, None] + 1 + d - la_base[:, None]
+    valid = (off >= 0) & (off < la_len[:, None])
+    toks = jnp.take_along_axis(lookahead, jnp.clip(off, 0, L - 1), 1)
+    return jnp.where(valid, toks, -1)
+
+
+def combine_drafts(primary: jax.Array, fallback: jax.Array) -> jax.Array:
+    """Per-position source selection: take the primary proposal where
+    it exists (>= 0), else the fallback's. Both [B, D] int32."""
+    return jnp.where(primary >= 0, primary, fallback)
 
 
 def accept_counts(drafts: jax.Array, sampled: jax.Array) -> jax.Array:

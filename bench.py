@@ -728,6 +728,192 @@ def prefix_cache_numbers(reps: int = 3, requests_per_rep: int = 6,
         stop_off()
 
 
+# -- spec_decode leg: speculative decoding on/off A/B (ISSUE 4) ----------
+
+#: the speculative children's max draft rung (ladder {0, 2, 4})
+_SPEC_TOKENS = 4
+_SPEC_PAGE = 16
+# Leg model: the ~200MB-of-f32-weights prefix-leg config, NOT the tiny
+# ratio model. Speculation pays when a decode step is dominated by
+# streaming weights (the TPU regime, and on this host the regime any
+# model bigger than L3 cache is in): a (D+1)-wide verify then costs
+# about one step. The 0.02B ratio model fits in cache — compute-bound,
+# a 5-wide verify costs ~5 steps, and the measured "speedup" would be
+# an artifact of the wrong regime in both directions.
+
+
+def _spec_ab_fields(st0: dict, st1: dict) -> dict:
+    """Acceptance telemetry of the spec-on child over one capture,
+    derived from /state deltas (pure — unit-tested by the bench
+    smoke). ``accepted_per_step`` is emitted tokens per device decode
+    step: plain decode is ≤ 1.0 by construction, accepted drafts push
+    it above."""
+    drafted = st1.get("spec_drafted", 0) - st0.get("spec_drafted", 0)
+    accepted = st1.get("spec_accepted", 0) - st0.get("spec_accepted", 0)
+    steps = st1.get("decode_steps", 0) - st0.get("decode_steps", 0)
+    toks = (st1.get("tokens_generated", 0)
+            - st0.get("tokens_generated", 0))
+    return {
+        "spec_accept_rate": (round(accepted / drafted, 4)
+                             if drafted > 0 else 0.0),
+        "drafted_tokens": drafted,
+        "accepted_per_step": round(toks / steps, 3) if steps > 0 else 0.0,
+        "spec_state_rebuilds": st1.get("state_rebuilds", 0),
+    }
+
+
+async def _drive_spec_one(s, url: str, model: str, content: str,
+                          gen_tokens: int, bias: bool) -> tuple:
+    """One sequential streaming chat; returns (duration_s, tokens).
+    ``bias`` pins every sampled token to 'a' — the repetitive-decode
+    workload where drafts fully accept; without it the model free-runs
+    and proposed drafts reject (the forced low-acceptance workload)."""
+    payload = {
+        "model": model,
+        "messages": [{"role": "user", "content": content}],
+        "max_tokens": gen_tokens,
+        "temperature": 0.0,
+        "stream": True,
+        "stream_options": {"include_usage": True},
+    }
+    if bias:
+        payload["logit_bias"] = {"97": 100}
+    t0 = time.perf_counter()
+    usage = None
+    ntok = 0
+    async with s.post(url + "/v1/chat/completions", json=payload) as resp:
+        assert resp.status == 200, resp.status
+        while True:
+            line = await resp.content.readline()
+            if not line:
+                break
+            line = line.strip()
+            if not line.startswith(b"data: "):
+                continue
+            data = line[6:]
+            if data == b"[DONE]":
+                break
+            ev = json.loads(data)
+            if ev.get("usage"):
+                usage = ev["usage"]
+            ch = ev.get("choices") or []
+            if ch and (ch[0].get("delta") or {}).get("content"):
+                ntok += 1
+    dur = time.perf_counter() - t0
+    return dur, (usage or {}).get("completion_tokens") or ntok
+
+
+def spec_decode_numbers(reps: int = 3, requests_per_rep: int = 4,
+                        gen_tokens: int = 96) -> dict:
+    """The ``spec_decode`` A/B leg: decode-heavy sequential streaming
+    chats against THREE tpuserve children — spec-on for the repetitive
+    workload, spec-on for the low-acceptance workload, and spec-off
+    (serving both workloads as the control). Requests INTERLEAVE
+    on/off within each rep (the prefix_cache capture pattern), so host
+    drift cancels out of the tok/s ratios.
+
+    Two spec-on children, not one: the engine-wide acceptance prior is
+    traffic-dependent by design — mixing workloads through one child
+    would measure the prior thrashing between regimes instead of each
+    regime's steady state. The three criteria this leg reports against:
+    accepted_per_step > 1.3 and spec-on/spec-off tok/s ≥ 1.15 on the
+    repetitive leg; spec-on within 3% of spec-off on the forced
+    low-acceptance leg (the adaptive ladder collapsed to D=0)."""
+    import aiohttp
+
+    model_name = "bench-spec-tiny"
+    engine_common = {"min_prefill_bucket": 32, "num_pages": 64,
+                     "max_queued_requests": 64,
+                     "kv_cache_dtype": "float32"}
+    k = int(os.environ.get("AIGW_BENCH_CPU_K", "4"))
+    children = []
+
+    def start(spec: int):
+        url, stop = _start_tpuserve_subproc(
+            model_name, _PREFIX_CFG, "", batch=4, k_steps=k,
+            engine=dict(engine_common, spec_tokens=spec),
+            page=_SPEC_PAGE, param_dtype="float32")
+        children.append(stop)
+        return url
+
+    url_rep = start(_SPEC_TOKENS)   # spec-on, repetitive workload
+    url_low = start(_SPEC_TOKENS)   # spec-on, low-acceptance workload
+    url_off = start(0)              # control
+
+    # repetitive: 'ababab…' prompt + bias→'a' output = the n-gram
+    # source's best case. low-acceptance: the prompt's repeated tail
+    # bigram FORCES proposals, the free-running random-weight greedy
+    # stream rejects them (no proposals at all would never exercise
+    # the ladder).
+    rep_content = "ab" * 16
+    low_content = "the quick brown fox xq jumps over wp lazy dogs xq"
+
+    async def run() -> dict:
+        await _wait_health(url_rep, 1200)
+        await _wait_health(url_low, 1200)
+        await _wait_health(url_off, 1200)
+        timeout = aiohttp.ClientTimeout(total=1200)
+        async with aiohttp.ClientSession(timeout=timeout) as s:
+            # off the clock: compile every dispatched program (plain
+            # lean/full, every draft rung the collapse path crosses)
+            # and teach each spec child its workload's acceptance
+            # prior — the low-acceptance criterion is about the
+            # ladder's steady state, not its first-contact cost
+            for url, content, b in ((url_rep, rep_content, True),
+                                    (url_low, low_content, False),
+                                    (url_off, rep_content, True),
+                                    (url_off, low_content, False)):
+                for _ in range(5):
+                    await _drive_spec_one(s, url, model_name, content,
+                                          gen_tokens, b)
+            st_rep0 = await _get_state(s, url_rep)
+            st_low0 = await _get_state(s, url_low)
+            on_rep, off_rep, on_low, off_low = [], [], [], []
+            for _rep in range(reps):
+                for _i in range(requests_per_rep):
+                    on_rep.append(await _drive_spec_one(
+                        s, url_rep, model_name, rep_content,
+                        gen_tokens, True))
+                    off_rep.append(await _drive_spec_one(
+                        s, url_off, model_name, rep_content,
+                        gen_tokens, True))
+                    on_low.append(await _drive_spec_one(
+                        s, url_low, model_name, low_content,
+                        gen_tokens, False))
+                    off_low.append(await _drive_spec_one(
+                        s, url_off, model_name, low_content,
+                        gen_tokens, False))
+            st_rep1 = await _get_state(s, url_rep)
+            st_low1 = await _get_state(s, url_low)
+
+        def tps(runs):
+            return sum(n for _, n in runs) / sum(d for d, _ in runs)
+
+        fields = _spec_ab_fields(st_rep0, st_rep1)
+        low = _spec_ab_fields(st_low0, st_low1)
+        on, off = tps(on_rep), tps(off_rep)
+        lon, loff = tps(on_low), tps(off_low)
+        return {
+            "spec_on_tps": round(on, 1),
+            "spec_off_tps": round(off, 1),
+            "spec_speedup": round(on / off, 4) if off else 0.0,
+            "spec_low_on_tps": round(lon, 1),
+            "spec_low_off_tps": round(loff, 1),
+            "spec_low_overhead": (round(1.0 - lon / loff, 4)
+                                  if loff else 0.0),
+            "spec_low_draft_len": st_low1.get("spec_draft_len", -1),
+            "spec_low_accept_rate": low["spec_accept_rate"],
+            "spec_ab_reps": reps * requests_per_rep,
+            **fields,
+        }
+
+    try:
+        return asyncio.run(run())
+    finally:
+        for stop in children:
+            stop()
+
+
 def _chip_responsive(timeout_s: float = 180.0) -> bool:
     """The axon tunnel can go down entirely (observed 2026-07-28); probe
     with a watchdog so the bench prints an honest line instead of hanging
@@ -872,12 +1058,17 @@ def run_cpu_ratio() -> dict:
         subproc=True, reps=5,
     )
     res["backend"] = jax.default_backend()
-    # gateway_prefix leg: cold-vs-warm prefix-cache TTFT rides the same
-    # JSON line (a leg failure must not zero the headline capture)
+    # gateway_prefix + spec_decode legs ride the same JSON line (a leg
+    # failure must not zero the headline capture)
     try:
         res.update(prefix_cache_numbers())
     except Exception as e:
         print(f"gateway_prefix leg failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+    try:
+        res.update(spec_decode_numbers())
+    except Exception as e:
+        print(f"spec_decode leg failed: {type(e).__name__}: {e}",
               file=sys.stderr)
     return res
 
@@ -939,16 +1130,27 @@ def main() -> None:
     if "--ab" in sys.argv:
         idx = sys.argv.index("--ab")
         target = sys.argv[idx + 1] if idx + 1 < len(sys.argv) else ""
-        if target != "prefix_cache":
+        if target == "prefix_cache":
+            result = prefix_cache_numbers()
+            result["metric"] = (
+                "gateway_prefix interleaved A/B — prefix_cache on vs "
+                "off, shared 64-token system-prompt head, ~96-token "
+                "prompts, sequential streaming chats on the CPU "
+                "backend; the warm/cold ratio is the signal, absolute "
+                "ms is not")
+        elif target == "spec_decode":
+            result = spec_decode_numbers()
+            result["metric"] = (
+                "spec_decode interleaved A/B — speculative decoding on "
+                "vs off, decode-heavy sequential streaming chats on "
+                "the CPU backend: repetitive leg (n-gram drafts "
+                "accept) and forced low-acceptance leg (adaptive "
+                "ladder collapses to plain decode); the tok/s ratios "
+                "are the signal, absolute tok/s is not")
+        else:
             print(json.dumps({"error": f"unknown --ab target {target!r}; "
-                              "supported: prefix_cache"}))
+                              "supported: prefix_cache, spec_decode"}))
             return
-        result = prefix_cache_numbers()
-        result["metric"] = (
-            "gateway_prefix interleaved A/B — prefix_cache on vs off, "
-            "shared 64-token system-prompt head, ~96-token prompts, "
-            "sequential streaming chats on the CPU backend; the "
-            "warm/cold ratio is the signal, absolute ms is not")
         print(json.dumps(result))
         return
 

@@ -78,3 +78,59 @@ def test_bench_mfu_analytical():
     assert m1 > 0
     assert abs(bench.model_mfu(cfg, 200.0, 128) - 2 * m1) < 1e-12
     assert bench.model_mfu(cfg, 100.0, 128, peak_flops=1e12) > m1
+
+
+@pytest.mark.bench_smoke
+def test_bench_spec_ab_fields():
+    """The --ab spec_decode JSON derives its acceptance telemetry from
+    /state deltas through this pure helper: spec_accept_rate must be
+    present and sane (in [0, 1]), accepted_per_step must reflect
+    multi-token emission, and a regression that renames the /state
+    fields shows up here instead of at round-end."""
+    st0 = {"spec_drafted": 100, "spec_accepted": 40,
+           "decode_steps": 50, "tokens_generated": 60,
+           "state_rebuilds": 0}
+    st1 = {"spec_drafted": 300, "spec_accepted": 220,
+           "decode_steps": 150, "tokens_generated": 310,
+           "state_rebuilds": 0}
+    f = bench._spec_ab_fields(st0, st1)
+    assert f["drafted_tokens"] == 200
+    assert f["spec_accept_rate"] == 0.9
+    assert 0.0 <= f["spec_accept_rate"] <= 1.0
+    assert f["accepted_per_step"] == 2.5  # > 1: drafts actually landed
+    assert f["spec_state_rebuilds"] == 0
+    # empty capture degrades to zeros, never a ZeroDivisionError
+    z = bench._spec_ab_fields(st1, st1)
+    assert z["spec_accept_rate"] == 0.0 and z["accepted_per_step"] == 0.0
+
+
+@pytest.mark.bench_smoke
+def test_bench_spec_engine_stats_live():
+    """A short speculative engine run on the tiny model: the stats the
+    A/B leg consumes (drafted/accepted/accept_rate) must be live and
+    the speculative path must not rebuild device state."""
+    import threading
+
+    from aigw_tpu.tpuserve.engine import Engine, EngineConfig, GenRequest
+    from aigw_tpu.tpuserve.sampling import SamplingParams
+
+    spec = get_model_spec("tiny-random")
+    params = llama.init_params(jax.random.PRNGKey(0), spec.config)
+    eng = Engine(params, spec.config, EngineConfig(
+        max_batch_size=2, max_seq_len=128, page_size=16,
+        min_prefill_bucket=16, decode_steps_per_tick=4, spec_tokens=4))
+    eng.start()
+    try:
+        done = threading.Event()
+        eng.submit(GenRequest(
+            prompt=[1, 2, 3], max_tokens=16,
+            sampling=SamplingParams(temperature=0.0,
+                                    logit_bias=((7, 100.0),)),
+            emit=lambda t, f: done.set() if f else None))
+        assert done.wait(timeout=300)
+        assert eng.stats.spec_drafted > 0
+        assert eng.stats.spec_accepted > 0
+        assert 0.0 < eng.stats.spec_accept_rate <= 1.0
+        assert eng.stats.state_rebuilds == 0
+    finally:
+        eng.stop()

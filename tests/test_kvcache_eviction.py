@@ -196,3 +196,128 @@ def test_cow_page_keeps_shared_page_cached():
     assert cache.probe(chain) == [shared]  # parked, revivable
     alloc.free(1)
     assert alloc.available_pages == 8
+
+
+class TestTruncateTo:
+    """The speculative-path write invariant (ISSUE 4): truncate_to
+    ensures every page overlapping the writable tail is privately
+    owned, CoW-swapping violators — and is a no-op on the healthy
+    layouts the engine constructs."""
+
+    def test_healthy_layout_is_noop(self):
+        alloc = RefcountedAllocator(num_pages=8, page_size=PS)
+        cache = PrefixCache(alloc, PS)
+        prompt = [3] * (PS * 2)
+        alloc.allocate(0, PS * 3)  # prompt pages + generation tail
+        cache.insert(page_chain_hashes(prompt, PS), alloc.pages(0))
+        before = list(alloc.pages(0))
+        # writable tail starts at the prompt end: the registered
+        # prompt pages sit BELOW it, the tail page is private
+        assert alloc.truncate_to(0, PS * 2) == []
+        assert alloc.pages(0) == before
+        alloc.free(0)
+
+    def test_shared_tail_page_is_cow_swapped(self):
+        alloc = RefcountedAllocator(num_pages=8, page_size=PS)
+        PrefixCache(alloc, PS)
+        alloc.allocate(0, PS * 2)
+        shared = alloc.pages(0)[1]
+        alloc.adopt(1, [alloc.pages(0)[0], shared])
+        # seq 1's tail page is SHARED with seq 0: positions >= PS + 1
+        # (misaligned) overlap it, so it must be swapped, with a device
+        # copy (the boundary straddles live history)
+        swaps = alloc.truncate_to(1, PS + 1)
+        assert len(swaps) == 1
+        old, fresh, needs_copy = swaps[0]
+        assert old == shared and needs_copy
+        assert alloc.pages(1)[1] == fresh and fresh != shared
+        # the original page survives for seq 0, refcount back to 1
+        assert alloc.pages(0)[1] == shared
+        assert alloc._refs[shared] == 1
+        alloc.free(0)
+        alloc.free(1)
+        assert alloc.available_pages == 8
+
+    def test_aligned_offset_needs_no_copy(self):
+        alloc = RefcountedAllocator(num_pages=8, page_size=PS)
+        PrefixCache(alloc, PS)
+        alloc.allocate(0, PS * 2)
+        shared = alloc.pages(0)[1]
+        alloc.adopt(1, [alloc.pages(0)[0], shared])
+        # page-aligned truncation: nothing below the offset lives in
+        # the swapped page, so no device copy is required
+        swaps = alloc.truncate_to(1, PS)
+        assert len(swaps) == 1
+        assert swaps[0][0] == shared and not swaps[0][2]
+        alloc.free(0)
+        alloc.free(1)
+
+    def test_registered_tail_page_is_swapped(self):
+        """A cache-REGISTERED page in the writable tail is a violation
+        even at refcount 1: draft writes would corrupt what a future
+        adopter reads."""
+        alloc = RefcountedAllocator(num_pages=8, page_size=PS)
+        cache = PrefixCache(alloc, PS)
+        prompt = [5] * PS
+        alloc.allocate(0, PS * 2)
+        cache.insert(page_chain_hashes(prompt, PS), alloc.pages(0))
+        registered = alloc.pages(0)[0]
+        # truncate INTO the registered page (simulating a rollback
+        # below the prompt end — cannot happen in the engine, but the
+        # invariant must hold regardless)
+        swaps = alloc.truncate_to(0, 1)
+        assert any(old == registered for old, _, _ in swaps)
+        assert cache.key_of_page(registered) is not None  # reg. survives
+        alloc.free(0)
+
+
+class TestContinuationStore:
+    """PrefixCache continuation memory — the speculative lookahead
+    draft source."""
+
+    def test_continuation_recorded_and_depth_preferred(self):
+        alloc = RefcountedAllocator(num_pages=16, page_size=PS)
+        cache = PrefixCache(alloc, PS)
+        long_prompt = list(range(1, PS * 3 + 3))
+        chain = page_chain_hashes(long_prompt, PS)
+        alloc.allocate(0, len(long_prompt))
+        cache.insert(chain, alloc.pages(0), tokens=long_prompt)
+        # deepest key wins: key_2 (3 full pages) continues with the
+        # partial tail; key_1 with page 2
+        depth, toks = cache.continuation(chain)
+        assert depth == 3 and toks == long_prompt[PS * 3:]
+        depth, toks = cache.continuation(chain[:2])
+        assert depth == 2 and toks == long_prompt[PS * 2: PS * 3]
+        alloc.free(0)
+
+    def test_short_reinsert_does_not_clobber_longer(self):
+        alloc = RefcountedAllocator(num_pages=16, page_size=PS)
+        cache = PrefixCache(alloc, PS)
+        long_prompt = list(range(1, PS * 2 + PS + 1))  # 3 full pages
+        chain = page_chain_hashes(long_prompt, PS)
+        alloc.allocate(0, len(long_prompt))
+        cache.insert(chain, alloc.pages(0), tokens=long_prompt)
+        # a re-asked SHORT prompt (2 pages + 1-token tail) shares the
+        # first chain key; its 1-token continuation must not replace
+        # the full page the long prompt taught
+        short = long_prompt[: PS + 1]
+        alloc.allocate(1, len(short))
+        cache.insert(page_chain_hashes(short, PS), alloc.pages(1),
+                     tokens=short)
+        depth, toks = cache.continuation(chain[:1])
+        assert depth == 1 and toks == long_prompt[PS: PS * 2]
+        alloc.free(0)
+        alloc.free(1)
+
+    def test_eviction_drops_continuation(self):
+        alloc = RefcountedAllocator(num_pages=2, page_size=PS)
+        cache = PrefixCache(alloc, PS)
+        prompt = [9] * (PS * 2)
+        chain = page_chain_hashes(prompt, PS)
+        alloc.allocate(0, PS * 2)
+        cache.insert(chain, alloc.pages(0), tokens=prompt)
+        assert cache.continuation(chain) is not None
+        alloc.free(0)  # parks both pages
+        alloc.allocate(1, PS * 2)  # evicts both entries
+        assert cache.continuation(chain) is None
+        alloc.free(1)

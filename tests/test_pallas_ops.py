@@ -215,10 +215,13 @@ class TestVerifyKernel:
         from aigw_tpu.tpuserve.sampling import SamplingParams
 
         def gen(pallas: bool):
+            # fixed draft width: the quantity under test is kernel
+            # acceptance parity, not the adaptive ladder (which would
+            # collapse this low-acceptance random-weight stream)
             cfg = EngineConfig(max_batch_size=2, max_seq_len=128,
                                page_size=16, min_prefill_bucket=16,
                                decode_steps_per_tick=4, spec_tokens=3,
-                               pallas_attn=pallas)
+                               spec_adaptive=False, pallas_attn=pallas)
             params = llama.init_params(jax.random.PRNGKey(0), llama.TINY)
             eng = Engine(params, llama.TINY, cfg, eos_token_ids=(257,))
             eng.start()
@@ -232,9 +235,17 @@ class TestVerifyKernel:
                     if fin is not None:
                         done.set()
 
+                # bias pins the greedy stream to one token: the n-gram
+                # source proposes full drafts once (7,7) repeats, so
+                # BOTH attention impls must accept — a random-weight
+                # free-running stream accepts nothing and the parity
+                # assertion would be vacuous (pre-PR-4 this test
+                # depended on the stream happening to self-repeat)
                 eng.submit(GenRequest(
                     prompt=[5, 6, 7, 5, 6], max_tokens=10,
-                    sampling=SamplingParams(temperature=0.0), emit=emit))
+                    sampling=SamplingParams(
+                        temperature=0.0, logit_bias=((7, 100.0),)),
+                    emit=emit))
                 assert done.wait(timeout=180)
                 return toks, eng.stats.spec_accepted
             finally:
@@ -245,3 +256,97 @@ class TestVerifyKernel:
         # the kernel must ACCEPT like the gather path, not silently
         # reject every draft (output streams would still match)
         assert acc_a == acc_b and acc_a > 0
+
+
+def xla_reference_verify(q, k_pool, v_pool, page_table, positions,
+                         page_size):
+    """Mirror of the gather-based verify attention in models/llama.py:
+    S consecutive query positions per slot under a per-query causal
+    mask (t <= pos0 + s)."""
+    import math
+
+    B, S, H, D = q.shape
+    P = page_table.shape[1]
+    T = P * page_size
+    gslot = page_table[:, :, None] * page_size + jnp.arange(page_size)
+    gslot = gslot.reshape(B, T)
+    k = k_pool[gslot]  # [B, T, Hkv, D]
+    v = v_pool[gslot]
+    Hkv = k.shape[2]
+    group = H // Hkv
+    qg = q.reshape(B, S, Hkv, group, D)
+    logits = jnp.einsum("bshgd,bthd->bhgst", qg.astype(jnp.float32),
+                        k.astype(jnp.float32)) / math.sqrt(D)
+    t_idx = jnp.arange(T)[None, None, :]
+    qpos = positions[:, None, None] + jnp.arange(S)[None, :, None]
+    mask = (t_idx <= qpos) & (positions[:, None, None] > -S)
+    logits = jnp.where(mask[:, None, None, :, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhgst,bthd->bshgd", probs, v.astype(jnp.float32))
+    return out.reshape(B, S, H, D)
+
+
+class TestProductionShapes:
+    """Interpret-mode A/B at llama-3-8B attention geometry (H=32,
+    Hkv=8, D=128, 128-token pages) — VERDICT r5 #7 pre-positioning:
+    the decode AND verify kernels must agree with the XLA gather path
+    at the shapes production would run, so the on-chip flip (or the
+    kernel's deletion) needs only the TPU tunnel, not more CPU-side
+    evidence."""
+
+    B, H, HKV, D = 2, 32, 8, 128
+    PAGE = 128
+    P = 4  # pages per sequence → T = 512
+
+    def _pools(self, seed: int):
+        key = jax.random.PRNGKey(seed)
+        kq, kk, kv, kp = jax.random.split(key, 4)
+        n_pages = 8
+        k_pool = jax.random.normal(
+            kk, (n_pages * self.PAGE, self.HKV, self.D), jnp.float32
+        ).astype(jnp.bfloat16)
+        v_pool = jax.random.normal(
+            kv, (n_pages * self.PAGE, self.HKV, self.D), jnp.float32
+        ).astype(jnp.bfloat16)
+        perm = jax.random.permutation(kp, n_pages)[: self.B * self.P]
+        page_table = perm.reshape(self.B, self.P).astype(jnp.int32)
+        return kq, k_pool, v_pool, page_table
+
+    def test_decode_v2_production_shape(self):
+        kq, k_pool, v_pool, pt = self._pools(11)
+        q = jax.random.normal(
+            kq, (self.B, self.H, self.D), jnp.float32
+        ).astype(jnp.bfloat16)
+        lens = jnp.asarray([385, 129], jnp.int32)  # straddle pages
+        got = paged_attention_decode_v2(
+            q, k_pool, v_pool, pt, lens, page_size=self.PAGE,
+            interpret=True)
+        want = xla_reference(q, k_pool, v_pool, pt, lens, self.PAGE)
+        np.testing.assert_allclose(
+            np.asarray(got, jnp.float32), np.asarray(want),
+            rtol=5e-2, atol=5e-2)
+
+    def test_verify_production_shape(self):
+        from aigw_tpu.ops.pallas.paged_attention import (
+            paged_attention_verify,
+        )
+
+        S = 5  # pending token + 4 drafts — the top bench rung
+        kq, k_pool, v_pool, pt = self._pools(12)
+        q = jax.random.normal(
+            kq, (self.B, S, self.H, self.D), jnp.float32
+        ).astype(jnp.bfloat16)
+        # one slot's verify window straddles a page boundary; the other
+        # sits mid-page
+        positions = jnp.asarray([254, 60], jnp.int32)
+        got = paged_attention_verify(
+            q, k_pool, v_pool, pt, positions, page_size=self.PAGE,
+            interpret=True)
+        want = xla_reference_verify(q, k_pool, v_pool, pt, positions,
+                                    self.PAGE)
+        np.testing.assert_allclose(
+            np.asarray(got, jnp.float32), np.asarray(want),
+            rtol=5e-2, atol=5e-2)
+        # logit-level argmax (acceptance) parity at MODEL level is
+        # covered by TestVerifyKernel; raw bf16 attention outputs are
+        # tie-prone under argmax and not the right comparison here

@@ -47,6 +47,30 @@ PREFIX_GAUGES = (
     "tpuserve_prefix_tokens_reused_total",
 )
 
+# speculative-decoding surface (ISSUE 4): a renamed EngineStats field
+# must not silently drop a dashboard signal or the bench A/B's inputs
+SPEC_STATE_FIELDS = (
+    "spec_accepted",
+    "spec_drafted",
+    "spec_accept_rate",
+    "spec_draft_len",
+    "spec_rung_ups",
+    "spec_rung_downs",
+    "spec_lookahead_slots",
+    "state_rebuilds",
+)
+
+SPEC_GAUGES = (
+    "tpuserve_spec_accepted_total",
+    "tpuserve_spec_drafted_tokens_total",
+    "tpuserve_spec_accept_rate",
+    "tpuserve_spec_draft_len",
+    "tpuserve_spec_rung_ups_total",
+    "tpuserve_spec_rung_downs_total",
+    "tpuserve_spec_lookahead_slots_total",
+    "tpuserve_state_rebuilds_total",
+)
+
 
 @pytest.fixture(scope="module")
 def smoke_url():
@@ -116,6 +140,18 @@ def test_metrics_export_prefix_gauges(smoke_url):
         assert gauge in text, f"/metrics lost {gauge}"
 
 
+def test_state_and_metrics_export_spec_gauges(smoke_url):
+    """Every tpuserve_spec_* gauge must appear on /state and /metrics —
+    even with speculation off (constant 0), so dashboards and the
+    bench A/B never silently lose the surface."""
+    state = json.loads(asyncio.run(_get(smoke_url, "/state")))
+    for field in SPEC_STATE_FIELDS:
+        assert field in state, f"/state lost {field}"
+    text = asyncio.run(_get(smoke_url, "/metrics")).decode()
+    for gauge in SPEC_GAUGES:
+        assert gauge in text, f"/metrics lost {gauge}"
+
+
 def test_engine_gauges_map_matches_engine_stats():
     """Every ENGINE_GAUGES attr must exist on EngineStats — a renamed
     stat otherwise exports a silent constant 0."""
@@ -157,5 +193,69 @@ def test_warm_prefill_buckets_covers_every_rung():
         assert eng._prefill_fn._cache_size() == warmed, (
             "a prompt at a warmed rung width still paid an XLA "
             "prefill compile on the hot path")
+    finally:
+        eng.stop()
+
+
+def _live_compiles(eng) -> int:
+    """Every jitted program the serving hot loop can invoke."""
+    total = eng._prefill_fn._cache_size()
+    total += sum(f._cache_size() for f in eng._decode_fns.values())
+    for f in (eng._row_update_fn, eng._spec_update_fn):
+        if f is not None:
+            total += f._cache_size()
+    return total
+
+
+def test_spec_verify_ladder_warm_no_hot_compiles():
+    """Compile-on-hot-path tripwire for the speculative ladder (ISSUE
+    4): after warmup(), traffic that climbs to the top draft rung,
+    collapses to plain decode through the middle rung, and mixes in a
+    penalized slot must add ZERO XLA compiles — every verify-scan
+    shape, both plain variants, and the row-update scatters are
+    pre-compiled. One 64-token page keeps the decode bucket at the
+    warmup size, so any compile counted here is a real ladder gap, not
+    page-bucket growth."""
+    spec_cfg = llama.TINY
+    params = llama.init_params(jax.random.PRNGKey(0), spec_cfg)
+    eng = Engine(params, spec_cfg, EngineConfig(
+        max_batch_size=2, max_seq_len=256, page_size=64,
+        min_prefill_bucket=16, decode_steps_per_tick=4,
+        spec_tokens=4, warm_prefill_buckets=2,
+        enable_prefix_cache=False))
+    eng.warmup()
+    warmed = _live_compiles(eng)
+    fns = set(eng._decode_fns)
+    # the full ladder exists up front: {kmin, K} × ({lean, full} plain
+    # + every nonzero rung)
+    assert {k for k, _, _ in fns} == {1, 4}
+    assert {d for _, _, d in fns} == {0, 2, 4}
+
+    eng.start()
+    try:
+        cases = [
+            # climbs to and stays at the top rung (D=4 dispatches)
+            dict(prompt=[1, 2, 3], max_tokens=24,
+                 sampling=SamplingParams(temperature=0.0,
+                                         logit_bias=((7, 100.0),))),
+            # proposes-and-rejects: collapses 4 → 2 → 0 (D=2 and both
+            # plain programs dispatch)
+            dict(prompt=[9, 8, 9, 8, 5, 4, 9, 8], max_tokens=24,
+                 sampling=SamplingParams(temperature=0.0)),
+            # penalized slot: the full (non-lean) plain program
+            dict(prompt=[6, 6, 6], max_tokens=8,
+                 sampling=SamplingParams(temperature=0.6, seed=3,
+                                         frequency_penalty=0.5)),
+        ]
+        for kw in cases:
+            done = threading.Event()
+            eng.submit(GenRequest(
+                emit=lambda t, f, d=done: d.set() if f else None, **kw))
+            assert done.wait(timeout=300)
+        assert eng.stats.spec_drafted > 0  # the ladder actually ran
+        assert eng.stats.state_rebuilds == 0
+        assert set(eng._decode_fns) == fns, "new program key on hot path"
+        assert _live_compiles(eng) == warmed, (
+            "speculative traffic paid an XLA compile after warmup")
     finally:
         eng.stop()

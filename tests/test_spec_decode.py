@@ -276,14 +276,19 @@ class TestVerifyStep:
 
 
 class TestSpecPrefixCacheInterplay:
-    """ISSUE 3 satellite: speculation forces a FULL device-state rebuild
-    on every admission (the on-device history buffer has no row-update
-    path). A speculative session that adopted cached prefix pages must
-    keep them pinned across those rebuilds — never orphaned into the
-    evictable pool (where a later allocation could steal live KV) and
-    never double-freed."""
+    """Speculation × prefix-cache regression (ISSUE 3, re-anchored by
+    ISSUE 4): speculative admissions used to force a FULL device-state
+    rebuild, guarded by ``allocator.repin``. The rebuild is gone —
+    admissions ride the incremental row-update path — and the guard is
+    replaced by the DIRECT invariant (``truncate_to``: no shared page
+    is ever writable by drafts without CoW). The observable property is
+    unchanged: a speculative session's adopted prefix pages stay
+    pinned while other admissions churn the batch — never orphaned
+    into the evictable pool (where a later allocation could steal live
+    KV) and never double-freed — and the churn costs ZERO
+    pipeline-draining rebuilds."""
 
-    def test_prefix_pages_survive_full_state_rebuild(self):
+    def test_prefix_pages_survive_concurrent_admissions(self):
         eng = _make_engine(spec_tokens=3)
         try:
             assert eng.prefix_cache is not None  # spec + cache coexist
@@ -315,10 +320,14 @@ class TestSpecPrefixCacheInterplay:
                        if eng.prefix_cache.key_of_page(p) is not None]
             assert adopted, "B adopted no cached pages"
 
-            # concurrent admissions: every one forces a spec rebuild
+            # concurrent admissions: each lands as an incremental row
+            # update while B's speculative stream keeps decoding
             for j in range(3):
                 _collect(eng, [(11 * i + j) % 150 + 1 for i in range(20)],
                          max_tokens=3, temperature=0.0)
+            # the admissions above rode the row-update path: no live
+            # pipeline was ever drained for a full state rebuild
+            assert eng.stats.state_rebuilds == 0
 
             if not done_b.is_set():
                 # B still live: its adopted pages must still be pinned —

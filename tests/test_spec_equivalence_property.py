@@ -1,0 +1,348 @@
+"""Speculation equivalence property matrix in the deterministic f32 rig
+(ISSUE 4).
+
+The load-bearing property is unchanged from the stub era — speculation
+is an *optimization, not a model change* — but the subsystem grew
+multi-source drafts, per-slot adaptive draft lengths, and incremental
+(rebuild-free) state maintenance, so the matrix now covers: mixed
+batches (speculating + plain + penalized + sampled slots), forced low-
+and high-acceptance streams, draft-rung transitions mid-stream, EOS
+delivered inside an accepted multi-token burst, and KV-page
+bit-exactness after rejection rollback at page-aligned and misaligned
+tail offsets. f32 params + f32 KV make greedy equivalence exact (see
+tests/test_chunked_prefill.py's tie-vs-state-bug post-mortem): any
+mismatch here is a real speculation bug, not an argmax tie.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aigw_tpu.models import llama
+from aigw_tpu.tpuserve import speculation
+from aigw_tpu.tpuserve.engine import Engine, EngineConfig, GenRequest
+from aigw_tpu.tpuserve.sampling import SamplingParams
+
+
+def _engine(spec_tokens: int, **over) -> Engine:
+    params = llama.init_params(jax.random.PRNGKey(7), llama.TINY,
+                               jnp.float32)
+    cfg = dict(max_batch_size=4, max_seq_len=256, page_size=16,
+               min_prefill_bucket=16, decode_steps_per_tick=4,
+               spec_tokens=spec_tokens, kv_cache_dtype="float32")
+    cfg.update(over)
+    return Engine(params, llama.TINY, EngineConfig(**cfg),
+                  eos_token_ids=(257,))
+
+
+@pytest.fixture(scope="module")
+def spec_engine():
+    eng = _engine(spec_tokens=4)
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+@pytest.fixture(scope="module")
+def plain_engine():
+    eng = _engine(spec_tokens=0)
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def _run_batch(eng: Engine, reqs: list[dict]) -> list[tuple[list, str]]:
+    """Submit ``reqs`` in order; returns [(tokens, finish)] per req."""
+    out = [([], []) for _ in reqs]
+    dones = [threading.Event() for _ in reqs]
+
+    def mk(i):
+        def emit(tok, fin):
+            if tok >= 0:
+                out[i][0].append(tok)
+            if fin is not None:
+                out[i][1].append(fin)
+                dones[i].set()
+        return emit
+
+    for i, r in enumerate(reqs):
+        eng.submit(GenRequest(
+            prompt=r["prompt"], max_tokens=r.get("max_tokens", 12),
+            sampling=SamplingParams(**r.get("sampling", {})),
+            stop_token_ids=tuple(r.get("stop", ())), emit=mk(i)))
+    for d in dones:
+        assert d.wait(timeout=600), "generation timed out"
+    return [(toks, fins[0]) for toks, fins in out]
+
+
+class TestMixedBatchEquivalence:
+    """One randomized mixed batch, spec-on vs spec-off, token-identical
+    per request — and the spec engine does it with ZERO pipeline-
+    draining state rebuilds."""
+
+    def test_matrix(self, spec_engine, plain_engine):
+        rng = random.Random(0xA14)
+        reqs = [
+            # high acceptance: bias pins the stream, n-gram drafts
+            # fully accept, the controller climbs/holds the top rung
+            {"prompt": [1, 2, 3], "max_tokens": 20,
+             "sampling": {"temperature": 0.0,
+                          "logit_bias": ((7, 100.0),)}},
+            # forced low acceptance: the repeated tail bigram proposes
+            # drafts, the free-running stream rejects them → the
+            # adaptive ladder transitions rungs mid-stream
+            {"prompt": [9, 8, 9, 8, 5, 4, 9, 8], "max_tokens": 16,
+             "sampling": {"temperature": 0.0}},
+            # penalized slot: never speculates, falls back to plain
+            {"prompt": [6, 6, 6, 6], "max_tokens": 10,
+             "sampling": {"temperature": 0.7, "seed": 11,
+                          "frequency_penalty": 0.8,
+                          "presence_penalty": 0.2}},
+            # sampled slot: never speculates either (greedy-only
+            # acceptance by design)
+            {"prompt": [rng.randrange(1, 200) for _ in range(9)],
+             "max_tokens": 10,
+             "sampling": {"temperature": 0.9, "seed": 5}},
+        ]
+        got = _run_batch(spec_engine, reqs)
+        want = _run_batch(plain_engine, reqs)
+        assert got == want
+        # the speculative path admitted 4 requests into a live
+        # batch without a single pipeline-draining rebuild
+        assert spec_engine.stats.state_rebuilds == 0
+        # …and actually speculated (this is not a vacuous pass)
+        assert spec_engine.stats.spec_drafted > 0
+        assert spec_engine.stats.spec_accepted > 0
+        assert 0.0 < spec_engine.stats.spec_accept_rate <= 1.0
+
+    def test_stop_tokens_match_spec_on_off(self, spec_engine,
+                                           plain_engine):
+        """A stop token discovered from the plain stream terminates the
+        spec stream at the same position with the same finish reason —
+        whether or not the stop token arrived inside a burst."""
+        ref, _ = _run_batch(plain_engine, [
+            {"prompt": [3, 1, 3, 1, 2], "max_tokens": 12,
+             "sampling": {"temperature": 0.0}}])[0]
+        assert len(ref) == 12
+        stop_tok = ref[5]
+        req = {"prompt": [3, 1, 3, 1, 2], "max_tokens": 12,
+               "sampling": {"temperature": 0.0},
+               "stop": (stop_tok,)}
+        got = _run_batch(spec_engine, [req])[0]
+        want = _run_batch(plain_engine, [req])[0]
+        assert got == want
+        assert got[1] == "stop"
+
+
+class TestEosInsideAcceptedDraft:
+    """EOS delivered by a multi-token accepted burst must finish the
+    stream exactly there: no trailing burst tokens, slot freed, pages
+    deferred-freed. Driven through _process_spec_window directly — the
+    only deterministic way to pin EOS at a *specific* burst offset
+    (an end-to-end greedy stream can only put EOS at position 0 or at
+    max_tokens)."""
+
+    def test_burst_truncated_at_eos(self):
+        # synthetic drain: one window, K=1 step, n_emit=4, EOS (257)
+        # at burst offset 2, a trailing accepted token after it
+        eng2 = _engine(spec_tokens=4)
+        slot_req = GenRequest(prompt=[1, 2, 3], max_tokens=10,
+                              sampling=SamplingParams(temperature=0.0),
+                              emit=lambda t, f: trail.append((t, f)))
+        trail: list[tuple[int, str | None]] = []
+        from aigw_tpu.tpuserve.engine import _Slot
+
+        slot_req.id = 0
+        eng2.allocator.allocate(0, 13)
+        eng2._slots[0] = _Slot(req=slot_req, pos=3, generated=1,
+                               key_seed=1, limit=13,
+                               page_row=np.zeros(16, np.int32))
+        sampled = np.zeros((1, 4, 5), np.int32)
+        sampled[0, 0, :4] = [11, 12, 257, 13]
+        n_emit = np.zeros((1, 4), np.int32)
+        n_emit[0, 0] = 4
+        props = np.full((1, 4), 3, np.int32)
+        eng2._process_spec_window(sampled, n_emit, props,
+                                  ((0, slot_req),), ((0, 4),))
+        emitted = [t for t, _ in trail if t >= 0]
+        finishes = [f for _, f in trail if f is not None]
+        assert emitted == [11, 12], emitted  # 13 discarded after EOS
+        assert finishes == ["stop"]
+        assert eng2._slots[0] is None  # slot freed
+        assert 0 in eng2._pending_frees  # pages deferred-freed
+
+
+class TestKvBitExactRollback:
+    """Rejected drafts' stale K/V writes must be invisible: after a
+    verify step whose drafts are ALL rejected, continuing the sequence
+    step-by-step yields bit-identical KV pages (at every written
+    position) to a run that never speculated — at page-aligned AND
+    misaligned rollback offsets."""
+
+    def _run(self, prompt_len: int):
+        cfg = llama.TINY
+        params = llama.init_params(jax.random.PRNGKey(3), cfg,
+                                   jnp.float32)
+        ps = 16
+        kv_shape = (cfg.n_layers, 2, 8 * ps, cfg.n_kv_heads,
+                    cfg.head_dim)
+        pt = jnp.asarray([[0, 1, 2, 3]], jnp.int32)
+        prompt = jnp.asarray(
+            [[(i * 5) % 200 + 1 for i in range(prompt_len)]], jnp.int32)
+        lens = jnp.asarray([prompt_len], jnp.int32)
+        feed = [9, 2, 6, 5, 4]  # pending + subsequent decode inputs
+        limits = jnp.asarray([64], jnp.int32)
+        active = jnp.asarray([True])
+
+        def decode_all(kv):
+            outs = []
+            for d, tok in enumerate(feed):
+                _, kv = llama.decode_step(
+                    params, cfg, jnp.asarray([tok], jnp.int32),
+                    jnp.asarray([prompt_len + d], jnp.int32), kv, pt,
+                    ps, active)
+                outs.append(np.asarray(kv[:, :, :prompt_len + d + 1]))
+            return outs
+
+        kv0 = jnp.zeros(kv_shape, jnp.float32)
+        _, kv0 = llama.prefill(params, cfg, prompt, lens, kv0, pt, ps)
+
+        # reference: never speculated
+        ref = decode_all(kv0)
+
+        # speculated: a verify step at the same position with drafts
+        # that CANNOT be accepted (token 0 never sampled here), writing
+        # stale K/V across the tail — including past a page boundary —
+        # then the same sequential decode re-scatters them
+        kv1 = jnp.zeros(kv_shape, jnp.float32)
+        _, kv1 = llama.prefill(params, cfg, prompt, lens, kv1, pt, ps)
+        junk = jnp.asarray([[feed[0], 0, 0, 0, 0]], jnp.int32)
+        _, kv1 = llama.verify_step(params, cfg, junk,
+                                   jnp.asarray([prompt_len], jnp.int32),
+                                   kv1, pt, ps, active, limits)
+        got = decode_all(kv1)
+
+        for d, (r, g) in enumerate(zip(ref, got)):
+            assert (r == g).all(), (
+                f"KV divergence at step {d}, offset {prompt_len}")
+
+    def test_page_aligned_rollback(self):
+        self._run(16)  # drafts start exactly at a page boundary
+
+    def test_misaligned_rollback(self):
+        self._run(13)  # drafts straddle the page-0/page-1 boundary
+
+
+class TestDraftController:
+    """Host-side adaptive-ladder policy (pure python, no device)."""
+
+    def test_collapse_on_rejection_then_reprobe(self):
+        prior = speculation.AcceptancePrior()
+        c = speculation.DraftController((0, 2, 4), prior)
+        assert c.draft_len() == 4  # optimistic prior → top rung
+        moves = [c.observe_window(4, 0) for _ in range(6)]
+        assert c.draft_len() == 0 and moves.count(-1) == 2
+        # rung 0: idle until the re-probe window fires
+        for _ in range(speculation.REPROBE_WINDOWS - 1):
+            assert c.tick() == 0
+        assert c.tick() == 2  # re-probe at the smallest nonzero rung
+        assert c.observe_window(2, 0) == -1  # still bad → straight back
+        assert c.draft_len() == 0
+
+    def test_no_proposals_decay_slower_than_rejection(self):
+        prior = speculation.AcceptancePrior()
+        fast = speculation.DraftController((0, 2, 4), prior)
+        slow = speculation.DraftController((0, 2, 4),
+                                           speculation.AcceptancePrior())
+        fast_w = slow_w = 0
+        while fast.draft_len() > 0:
+            fast.observe_window(4, 0)
+            fast_w += 1
+        while slow.draft_len() > 0:
+            slow.observe_window(0, 0)
+            slow_w += 1
+        assert fast_w < slow_w  # rejected drafts are stronger evidence
+
+    def test_climb_on_acceptance(self):
+        prior = speculation.AcceptancePrior()
+        prior.value = 0.4  # middling → starts mid-ladder
+        c = speculation.DraftController((0, 2, 4, 8), prior)
+        assert c.draft_len() in (2, 4)
+        for _ in range(8):
+            c.observe_window(c.draft_len(), c.draft_len())
+        assert c.draft_len() == 8
+
+    def test_prior_drives_initial_rung(self):
+        p = speculation.AcceptancePrior()
+        p.value = 0.1
+        assert speculation.DraftController((0, 2, 4), p).draft_len() == 0
+        p.value = 0.9
+        assert speculation.DraftController((0, 2, 4), p).draft_len() == 4
+
+    def test_fixed_mode_never_moves(self):
+        c = speculation.DraftController(
+            (0, 2, 4), speculation.AcceptancePrior(), adaptive=False)
+        assert c.draft_len() == 4
+        for _ in range(10):
+            assert c.observe_window(4, 0) == 0
+        assert c.draft_len() == 4 and c.tick() == 4
+
+    def test_rung_ladders(self):
+        assert speculation.draft_rungs(8) == (0, 2, 4, 8)
+        assert speculation.draft_rungs(4) == (0, 2, 4)
+        assert speculation.draft_rungs(3) == (0, 2, 3)
+        assert speculation.draft_rungs(1) == (0, 1)
+        assert speculation.draft_rungs(0) == (0,)
+
+
+class TestDraftSources:
+    """lookahead_drafts / combine_drafts (device-side, tiny shapes)."""
+
+    def test_lookahead_window_and_fallback(self):
+        la = jnp.asarray([[21, 22, 23, 24, 0, 0, 0, 0]], jnp.int32)
+        base = jnp.asarray([10], jnp.int32)
+        ln = jnp.asarray([4], jnp.int32)
+        # pos 10 → drafts for positions 11, 12, 13 → offsets 1, 2, 3
+        d = np.asarray(speculation.lookahead_drafts(
+            la, base, ln, jnp.asarray([10], jnp.int32), 3))
+        assert d.tolist() == [[22, 23, 24]]
+        # pos 12 → offsets 3, 4, 5 → only the first is in range
+        d = np.asarray(speculation.lookahead_drafts(
+            la, base, ln, jnp.asarray([12], jnp.int32), 3))
+        assert d.tolist() == [[24, -1, -1]]
+        # behind the buffer → nothing
+        d = np.asarray(speculation.lookahead_drafts(
+            la, base, jnp.asarray([0], jnp.int32),
+            jnp.asarray([10], jnp.int32), 2))
+        assert (d == -1).all()
+
+    def test_combine_prefers_primary(self):
+        a = jnp.asarray([[5, -1, 7]], jnp.int32)
+        b = jnp.asarray([[1, 2, 3]], jnp.int32)
+        assert np.asarray(
+            speculation.combine_drafts(a, b)).tolist() == [[5, 2, 7]]
+
+    def test_continuation_lookahead_used_end_to_end(self, spec_engine,
+                                                    plain_engine):
+        """A long prompt teaches the radix chain its continuation; a
+        shorter request sharing the head gets the lookahead source and
+        still streams token-identical to a spec-off engine."""
+        long_p = [(i * 7) % 150 + 1 for i in range(48)]
+        short_p = long_p[:21]
+        for eng in (spec_engine, plain_engine):
+            _run_batch(eng, [
+                {"prompt": long_p, "max_tokens": 4,
+                 "sampling": {"temperature": 0.0}}])
+        req = {"prompt": short_p, "max_tokens": 10,
+               "sampling": {"temperature": 0.0}}
+        got = _run_batch(spec_engine, [req])[0]
+        want = _run_batch(plain_engine, [req])[0]
+        assert got == want
+        assert spec_engine.stats.spec_lookahead_slots >= 1
+        assert spec_engine.stats.state_rebuilds == 0
