@@ -283,6 +283,16 @@ def main(argv: list[str] | None = None) -> int:
                               "lengths)")
     p_serve.add_argument("--no-prefix-cache", action="store_true",
                          help="disable automatic prompt prefix caching")
+    p_serve.add_argument("--flight-entries", type=int, default=256,
+                         help="flight-recorder ring size: per-request "
+                              "lifecycle timelines kept in memory and "
+                              "served at /debug/requests (slow-request "
+                              "worst-N entries survive eviction)")
+    p_serve.add_argument("--enable-profile-endpoint", action="store_true",
+                         help="enable /debug/profile?seconds=N on-demand "
+                              "jax.profiler captures (off by default: a "
+                              "profiler on the data port is an "
+                              "inspection/DoS surface)")
     p_serve.add_argument("--lora", action="append", default=[],
                          metavar="NAME=ORBAX_DIR",
                          help="load a LoRA adapter (repeatable); serve it "
@@ -840,6 +850,8 @@ async def _run_tpuserve(args: argparse.Namespace) -> int:
         warm_prefill_buckets=args.warm_prefill_buckets,
         first_token_fast_path=not args.no_first_token_fast_path,
         prefill_bucket_rungs=args.prefill_bucket_rungs,
+        flight_entries=args.flight_entries,
+        enable_profile_endpoint=args.enable_profile_endpoint,
     )
     print(f"tpuserve listening on http://{args.host}:{args.port}", flush=True)
     await _wait_for_signal()

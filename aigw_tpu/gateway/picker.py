@@ -218,8 +218,15 @@ class EndpointPicker:
         e = self._by_addr.get(addr)
         return e.slice_name if e is not None else ""
 
-    def pick(self, headers: dict[str, str] | None = None) -> str | None:
-        """Returns 'host:port' for the request, or None if no endpoints."""
+    def pick(self, headers: dict[str, str] | None = None,
+             explain: dict[str, Any] | None = None) -> str | None:
+        """Returns 'host:port' for the request, or None if no endpoints.
+
+        ``explain``: optional dict the pick fills with WHY the endpoint
+        won (``sticky`` session affinity held / ``prefix_affinity``
+        bonus applied to the winner / ``round_robin`` blind fallback,
+        plus the number of fresh candidates) — the gateway attaches it
+        to the request span so a trace shows the routing decision."""
         if not self.endpoints:
             return None
         now = time.monotonic()
@@ -256,6 +263,8 @@ class EndpointPicker:
         if not fresh:
             # no telemetry (cold start / all down): round-robin blindly
             chosen = next(self._rr)
+            if explain is not None:
+                explain.update(round_robin=True, candidates=0)
         else:
             best_addr = min(fresh, key=fresh.__getitem__)
             chosen = best_addr
@@ -268,6 +277,14 @@ class EndpointPicker:
                 + self.STICKINESS_MARGIN
             ):
                 chosen = prev_addr
+            if explain is not None:
+                explain.update(
+                    candidates=len(fresh),
+                    score=round(fresh[chosen], 4),
+                    sticky=chosen == prev_addr and bool(affinity_key),
+                    prefix_affinity=chosen == prefix_addr
+                    and bool(prefix_key),
+                )
         if affinity_key:
             self._affinity[affinity_key] = chosen
             self._affinity.move_to_end(affinity_key)

@@ -603,7 +603,10 @@ class GatewayServer:
                     client=request.remote or "",
                     trace_id=(span.context.trace_id
                               if span is not None else ""),
+                    span_id=(span.context.span_id
+                             if span is not None else ""),
                     request_id=client_headers.get("x-request-id", ""),
+                    upstream_request_id=req_metrics.upstream_request_id,
                     attempts=req_metrics.attempts,
                 )
 
@@ -831,7 +834,17 @@ class GatewayServer:
                         derived[PREFIX_HEADER] = pkey
                 if derived:
                     pick_headers = dict(client_headers) | derived
-            dest = self._pickers[backend.name].pick(pick_headers) or ""
+            explain: dict[str, Any] | None = (
+                {} if span is not None else None)
+            dest = self._pickers[backend.name].pick(
+                pick_headers, explain=explain) or ""
+            if span is not None and dest:
+                # why the picker chose this replica — the span-level
+                # answer to "which endpoint served me, and was it
+                # cache/session affinity or load"
+                span.set("aigw.endpoint", dest)
+                for k, v in (explain or {}).items():
+                    span.set(f"aigw.pick.{k}", v)
         base_url = f"http://{dest}" if dest else backend.url
         if not base_url:
             raise _RetriableUpstreamError(
@@ -840,6 +853,14 @@ class GatewayServer:
         headers.update(tx.headers)
         if span is not None:
             self.tracer.propagators.inject(span.context, headers)
+        else:
+            # tracing disabled at the gateway: still RELAY the caller's
+            # trace context verbatim so the replica hop can parent its
+            # spans / flight-recorder entries on the caller's trace
+            for h in ("traceparent", "b3", "x-b3-traceid",
+                      "x-b3-spanid", "x-b3-sampled"):
+                if h in client_headers:
+                    headers[h] = client_headers[h]
         headers = apply_header_mutation(headers, backend.header_mutation)
         import urllib.parse as _up
 
@@ -894,6 +915,10 @@ class GatewayServer:
             translator.response_headers(
                 resp.status, {k.lower(): v for k, v in resp.headers.items()}
             )
+            # tpuserve's per-request id: joins this request's access-log
+            # line against the replica's /debug/requests/{id} timeline
+            req_metrics.upstream_request_id = resp.headers.get(
+                "x-aigw-request-id", "")
             ctype = resp.headers.get("content-type", "")
             upstream_streams = tx.stream and (
                 "text/event-stream" in ctype
@@ -968,8 +993,16 @@ class GatewayServer:
             ).inc()
             upstream_ctype = resp.headers.get(
                 "content-type", "application/json")
+            out_headers = {}
+            if req_metrics.upstream_request_id:
+                # relay the replica's request id to the client — the
+                # key a bug report can quote straight into the
+                # replica's /debug/requests/{id}
+                out_headers["x-aigw-request-id"] = (
+                    req_metrics.upstream_request_id)
             return web.Response(
                 status=resp.status, body=rx.body or raw,
+                headers=out_headers,
                 content_type=upstream_ctype.split(";")[0])
 
     async def _stream_response(
@@ -1004,6 +1037,10 @@ class GatewayServer:
                 "x-accel-buffering": "no",
             },
         )
+        if req_metrics.upstream_request_id:
+            # replica request id → client (joins /debug/requests/{id})
+            out.headers["x-aigw-request-id"] = (
+                req_metrics.upstream_request_id)
         from aigw_tpu.utils.net import set_tcp_nodelay
 
         set_tcp_nodelay(request.transport)

@@ -107,7 +107,9 @@ class AccessLogger:
         error_type: str = "",
         client: str = "",
         trace_id: str = "",
+        span_id: str = "",
         request_id: str = "",
+        upstream_request_id: str = "",
         attempts: int = 0,
     ) -> None:
         if self._fp is None:
@@ -142,8 +144,17 @@ class AccessLogger:
             entry["client"] = client
         if trace_id:
             entry["trace_id"] = trace_id
+        if span_id:
+            # with trace_id, joins the line against the exported span
+            # tree AND (via the replica's matching trace id) tpuserve's
+            # /debug/requests flight-recorder timelines
+            entry["span_id"] = span_id
         if request_id:
             entry["request_id"] = request_id
+        if upstream_request_id:
+            # the serving replica's own id (x-aigw-request-id): the
+            # direct key into /debug/requests/{id} on that replica
+            entry["upstream_request_id"] = upstream_request_id
         if attempts > 1:
             entry["attempts"] = attempts
         try:

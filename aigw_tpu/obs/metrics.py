@@ -11,6 +11,7 @@ OTel exporter applies).
 
 from __future__ import annotations
 
+import bisect
 import time
 from dataclasses import dataclass, field
 
@@ -128,6 +129,11 @@ ENGINE_GAUGES: tuple[tuple[str, str], ...] = (
     ("transfer_ms", "tpuserve_transfer_ms_total"),
     ("emit_ms", "tpuserve_emit_ms_total"),
     ("first_emit_ms", "tpuserve_first_emit_ms_total"),
+    # XLA compile tracker (ISSUE 5, obs/xla_events.py): compiles seen
+    # process-wide since the engine came up, and their total wall time —
+    # a nonzero delta after warmup is a hot-path compile regression
+    ("xla_compiles", "tpuserve_xla_compiles_total"),
+    ("xla_compile_ms", "tpuserve_xla_compile_ms_total"),
 )
 
 
@@ -140,6 +146,133 @@ def render_engine_gauges(stats: object) -> bytes:
         lines.append(f"# TYPE {name} gauge")
         lines.append(f"{name} {value}")
     return ("\n".join(lines) + "\n").encode()
+
+
+#: serving-phase histogram surface (ISSUE 5): phase key → Prometheus
+#: family name. The authoritative map — EnginePhases builds its
+#: histograms from it, /metrics renders it, /state derives
+#: phase_percentiles from it, and the tier-1 drift smoke asserts the two
+#: sides agree — so a renamed phase can't silently drop a percentile.
+#: Distinct from the ENGINE_GAUGES *_ms cumulative totals: these are
+#: real per-observation distributions (p50/p95/p99 are readable).
+ENGINE_HISTOGRAMS: tuple[tuple[str, str], ...] = (
+    ("queue_wait", "tpuserve_queue_wait_hist_ms"),
+    ("prefill", "tpuserve_prefill_hist_ms"),
+    ("ttft", "tpuserve_ttft_hist_ms"),
+    ("first_emit", "tpuserve_first_emit_hist_ms"),
+    ("decode_per_token", "tpuserve_decode_per_token_hist_ms"),
+    ("transfer", "tpuserve_transfer_hist_ms"),
+)
+
+#: histogram bucket upper bounds in milliseconds (+Inf implicit). Spans
+#: sub-ms transfer fetches to multi-second queue waits.
+PHASE_BUCKETS_MS: tuple[float, ...] = (
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0, 30000.0,
+)
+
+
+class PhaseHistogram:
+    """Fixed-bucket latency histogram with per-bucket trace-id exemplars.
+
+    Hand-rolled rather than prometheus_client because (a) the writer is
+    the engine thread — observe() must be a couple of list/scalar ops,
+    no label lookups or locks — and (b) classic prometheus_client text
+    export drops exemplars; we render OpenMetrics-style exemplars on the
+    bucket lines ourselves. int/float stores are GIL-atomic; readers
+    (percentiles, render) tolerate a torn count by one observation.
+    """
+
+    __slots__ = ("name", "buckets", "counts", "total", "count",
+                 "exemplars")
+
+    def __init__(self, name: str,
+                 buckets: tuple[float, ...] = PHASE_BUCKETS_MS):
+        self.name = name
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)  # last = +Inf
+        self.total = 0.0
+        self.count = 0
+        # bucket index → (trace_id, observed value) of the most recent
+        # traced observation landing there
+        self.exemplars: dict[int, tuple[str, float]] = {}
+
+    def observe(self, ms: float, trace_id: str = "") -> None:
+        i = bisect.bisect_left(self.buckets, ms)
+        self.counts[i] += 1
+        self.total += ms
+        self.count += 1
+        if trace_id:
+            self.exemplars[i] = (trace_id, ms)
+
+    def percentile(self, q: float) -> float:
+        """q in (0, 1] → linear interpolation inside the target bucket.
+        -1.0 when empty (distinguishable from a real 0ms)."""
+        counts = list(self.counts)
+        n = sum(counts)
+        if n == 0:
+            return -1.0
+        target = q * n
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= target:
+                hi = (self.buckets[i] if i < len(self.buckets)
+                      else self.buckets[-1] * 2)
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                if c == 0:
+                    return hi
+                frac = (target - (cum - c)) / c
+                return lo + (hi - lo) * frac
+        return self.buckets[-1] * 2
+
+    def percentiles(self) -> dict[str, float]:
+        return {
+            "p50": round(self.percentile(0.50), 3),
+            "p95": round(self.percentile(0.95), 3),
+            "p99": round(self.percentile(0.99), 3),
+        }
+
+    def render(self) -> str:
+        """Prometheus histogram exposition; bucket lines carry
+        OpenMetrics-style ``# {trace_id="…"} v`` exemplars when a traced
+        request landed in the bucket."""
+        lines = [f"# TYPE {self.name} histogram"]
+        cum = 0
+        for i, c in enumerate(self.counts):
+            cum += c
+            le = (f"{self.buckets[i]:g}" if i < len(self.buckets)
+                  else "+Inf")
+            line = f'{self.name}_bucket{{le="{le}"}} {cum}'
+            ex = self.exemplars.get(i)
+            if ex is not None:
+                line += f' # {{trace_id="{ex[0]}"}} {ex[1]:g}'
+            lines.append(line)
+        lines.append(f"{self.name}_sum {self.total:g}")
+        lines.append(f"{self.name}_count {cum}")
+        return "\n".join(lines) + "\n"
+
+
+class EnginePhases:
+    """The engine's serving-phase histogram set (one PhaseHistogram per
+    ENGINE_HISTOGRAMS entry). Owned by the Engine; rendered on /metrics
+    and summarized as p50/p95/p99 on /state."""
+
+    def __init__(self) -> None:
+        self.hists: dict[str, PhaseHistogram] = {
+            key: PhaseHistogram(name) for key, name in ENGINE_HISTOGRAMS
+        }
+
+    def observe(self, phase: str, ms: float, trace_id: str = "") -> None:
+        h = self.hists.get(phase)
+        if h is not None:
+            h.observe(ms, trace_id)
+
+    def percentiles(self) -> dict[str, dict[str, float]]:
+        return {key: h.percentiles() for key, h in self.hists.items()}
+
+    def render(self) -> bytes:
+        return "".join(h.render() for h in self.hists.values()).encode()
 
 
 class MCPMetrics:
@@ -212,6 +345,10 @@ class RequestMetrics:
     # dynamic-metadata pipeline)
     costs: dict[str, int] = field(default_factory=dict)
     attempts: int = 0
+    # the serving replica's per-request id (tpuserve's x-aigw-request-id
+    # response header) — joins gateway access-log lines against the
+    # replica's /debug/requests/{id} flight-recorder timeline
+    upstream_request_id: str = ""
 
     def _labels(self) -> list[str]:
         return [

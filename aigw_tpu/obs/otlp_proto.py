@@ -20,7 +20,8 @@ protocol), so a ~100-line encoder beats dragging in a codegen toolchain:
            string name=5; SpanKind kind=6; fixed64 start=7; fixed64 end=8;
            repeated KeyValue attributes=9; repeated Event events=11;
            Status status=15 }
-    Event  { fixed64 time_unix_nano=1; string name=2 }
+    Event  { fixed64 time_unix_nano=1; string name=2;
+             repeated KeyValue attributes=3 }
     Status { string message=2; StatusCode code=3 }
     KeyValue { string key=1; AnyValue value=2 }
     AnyValue { string_value=1 | bool_value=2 | int_value=3 |
@@ -97,8 +98,11 @@ def _span(s: Any) -> bytes:
     out += _fixed64(8, s.end_ns)
     for k, v in s.attributes.items():
         out += _len_field(9, _key_value(k, v))
-    for name, t_ns in s.events:
-        out += _len_field(11, _fixed64(1, t_ns) + _str_field(2, name))
+    for name, t_ns, attrs in s.events:
+        ev = _fixed64(1, t_ns) + _str_field(2, name)
+        for k, v in attrs.items():
+            ev += _len_field(3, _key_value(k, v))  # Event.attributes=3
+        out += _len_field(11, ev)
     if s.status_error:
         out += _len_field(15, _str_field(2, s.status_error)
                           + _varint_field(3, 2))  # STATUS_CODE_ERROR

@@ -63,7 +63,8 @@ class Span:
     start_ns: int = field(default_factory=time.time_ns)
     end_ns: int = 0
     attributes: dict[str, Any] = field(default_factory=dict)
-    events: list[tuple[str, int]] = field(default_factory=list)
+    # (name, time_ns, attributes) — attrs {} for plain markers
+    events: list[tuple[str, int, dict]] = field(default_factory=list)
     status_error: str = ""
     _tracer: "Tracer | None" = None
 
@@ -71,8 +72,9 @@ class Span:
         self.attributes[key] = value
         return self
 
-    def add_event(self, name: str) -> None:
-        self.events.append((name, time.time_ns()))
+    def add_event(self, name: str,
+                  attributes: dict[str, Any] | None = None) -> None:
+        self.events.append((name, time.time_ns(), attributes or {}))
 
     def record_error(self, message: str) -> None:
         self.status_error = message
@@ -241,7 +243,11 @@ class Tracer:
             "startTimeUnixNano": s.start_ns,
             "endTimeUnixNano": s.end_ns,
             "attributes": s.attributes,
-            "events": [{"name": n, "timeUnixNano": t} for n, t in s.events],
+            "events": [
+                {"name": n, "timeUnixNano": t,
+                 **({"attributes": a} if a else {})}
+                for n, t, a in s.events
+            ],
             "status": {"code": 2, "message": s.status_error}
             if s.status_error
             else {"code": 1},
@@ -353,8 +359,12 @@ class Tracer:
                                     ],
                                     "events": [
                                         {"name": n,
-                                         "timeUnixNano": str(t)}
-                                        for n, t in s.events
+                                         "timeUnixNano": str(t),
+                                         "attributes": [
+                                             attr(k, v)
+                                             for k, v in a.items()
+                                         ]}
+                                        for n, t, a in s.events
                                     ],
                                 }
                                 for s in spans

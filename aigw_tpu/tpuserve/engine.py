@@ -36,6 +36,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from aigw_tpu.models import llama
+from aigw_tpu.obs.metrics import EnginePhases
+from aigw_tpu.obs.xla_events import CompileTracker
 from aigw_tpu.tpuserve import speculation
 from aigw_tpu.tpuserve.kvcache import (
     OutOfPagesError,
@@ -230,6 +232,12 @@ class GenRequest:
     # costs no extra pass over the prompt. None (or a stale length —
     # defensive) falls back to hashing at classification time.
     prefix_hashes: list | None = None
+    # Request-lifecycle sink (obs.flight.RequestTrace or None): the
+    # engine reports queue-wait, admission classification, prefill
+    # geometry, first-token, decode windows, and EOS/cancel through it
+    # into the flight recorder + the request's span tree. Duck-typed and
+    # optional — None costs one attribute check per call site.
+    trace: Any = None
 
 
 @dataclass
@@ -260,6 +268,9 @@ class _Slot:
     la_base: int = 0
     la_tokens: list[int] = field(default_factory=list)
     dev_draft_len: int = 0
+    # monotonic time of the slot's first emitted token (feeds the
+    # decode-per-token histogram at finish)
+    first_emit_at: float = 0.0
 
 
 @dataclass
@@ -329,6 +340,11 @@ class EngineStats:
     first_emit_ms: float = 0.0
     # age of the oldest queued request (picker queue-latency signal)
     queue_wait_ms: float = 0.0
+    # XLA compile tracker (obs/xla_events.py): backend compiles observed
+    # since the engine came up and their total wall time — refreshed per
+    # tick; a post-warmup delta is a hot-path compile regression
+    xla_compiles: int = 0
+    xla_compile_ms: float = 0.0
 
 
 @dataclass
@@ -388,6 +404,14 @@ class Engine:
             self.allocator = PageAllocator(cfg.num_pages, cfg.page_size)
             self.prefix_cache = None
         self.stats = EngineStats()
+        # serving-phase latency histograms (queue_wait/prefill/ttft/…)
+        # with trace-id exemplars — /metrics renders them, /state
+        # summarizes p50/p95/p99 (obs/metrics.py ENGINE_HISTOGRAMS)
+        self.phases = EnginePhases()
+        # shared XLA compile tracker: jax.monitoring compile events plus
+        # per-program jit-cache accounting over every hot-path callable
+        # registered below (obs/xla_events.py — the tripwire surface)
+        self.compile_tracker = CompileTracker()
         self.healthy = True
         self.last_error: str | None = None
 
@@ -754,12 +778,21 @@ class Engine:
 
             return scan_k
 
-        self._prefill_fn = jax.jit(_prefill_step, donate_argnums=(4,))
-        self._prefill_suffix_fn = jax.jit(_prefill_suffix_step,
-                                          donate_argnums=(5,))
+        self._prefill_fn = self.compile_tracker.register(
+            "prefill", jax.jit(_prefill_step, donate_argnums=(4,)))
+        self._prefill_suffix_fn = self.compile_tracker.register(
+            "prefill_suffix",
+            jax.jit(_prefill_suffix_step, donate_argnums=(5,)))
+        if self._prefill_sp_fn is not None:
+            self.compile_tracker.register("prefill_sp",
+                                          self._prefill_sp_fn)
         self._decode_scan_factory = _decode_scan
         self._spec_scan_factory = _spec_scan
         self._decode_fns: dict[tuple[int, bool, int], Callable] = {}
+        # admission burst bookkeeping for lifecycle traces: (id, size)
+        # of the burst currently being admitted
+        self._burst_seq = itertools.count(1)
+        self._cur_burst: tuple[int, int] = (0, 0)
 
     def _decode_fn_for(self, k: int, lean: bool = False,
                        draft: int = 0):
@@ -776,6 +809,8 @@ class Engine:
                     else self._decode_scan_factory(k, lean))
             fn = jax.jit(scan, donate_argnums=(2, 3))
             self._decode_fns[(k, lean, draft)] = fn
+            self.compile_tracker.register(
+                f"decode[k={k},lean={lean},d={draft}]", fn)
         return fn
 
     def _lean_decode_ok(self) -> bool:
@@ -835,7 +870,8 @@ class Engine:
                 return jax.lax.dynamic_update_slice_in_dim(
                     kv, rows, dst_page * ps, axis=2)
 
-            self._copy_page_fn = jax.jit(_cp, donate_argnums=(0,))
+            self._copy_page_fn = self.compile_tracker.register(
+                "copy_page", jax.jit(_cp, donate_argnums=(0,)))
         self.kv_cache = self._copy_page_fn(
             self.kv_cache, jnp.int32(src), jnp.int32(dst))
 
@@ -1040,6 +1076,8 @@ class Engine:
     def _reap_cancelled(self) -> None:
         for i, s in enumerate(self._slots):
             if s is not None and s.req.cancelled.is_set():
+                if s.req.trace is not None:
+                    s.req.trace.engine_finish("cancel")
                 self._pending_frees.append(s.req.id)
                 self._slots[i] = None
                 self._dirty_rows.add(i)
@@ -1106,6 +1144,10 @@ class Engine:
                             pending.append(self._queue.get_nowait())
                     except queue.Empty:
                         pass
+            # one coalesced-admission burst id per pass — lifecycle
+            # traces carry it so a trace/flight reader can see which
+            # requests shared a batched prefill
+            self._cur_burst = (next(self._burst_seq), len(pending))
             # Classify once (prompt hashes computed here are reused all
             # the way to the post-prefill cache insert), then admit in
             # STRICT arrival order: contiguous runs of ≥2 simple requests
@@ -1256,6 +1298,23 @@ class Engine:
         bias = np.zeros((G2, V), np.float32)
         adapter = np.full((G2,), self._base_row, np.int32)
         t0 = time.monotonic()
+        burst_id, burst_size = self._cur_burst
+        for _req, _sid, _n, _tt in items:
+            qw = 1e3 * (t0 - _req.enqueued_at)
+            self.phases.observe(
+                "queue_wait", qw,
+                _req.trace.trace_id if _req.trace is not None else "")
+            if _req.trace is not None:
+                _req.trace.queue_wait(qw)
+                # batched = classified with no reusable prefix: a
+                # page-eligible prompt here is a cache miss by
+                # construction; short prompts never probed ("off")
+                _req.trace.admission(
+                    path="batched", burst_id=burst_id,
+                    burst_size=burst_size,
+                    prefix="miss" if chain_by_req.get(id(_req))
+                    else "off",
+                    bucket=S, padded_frac=round(1.0 - _n / S, 3))
         for g, (req, seq_id, n, _total) in enumerate(items):
             tokens[g, :n] = req.prompt
             seq_lens[g] = n
@@ -1288,7 +1347,14 @@ class Engine:
             lp_data = (np.asarray(chosen), np.asarray(tk_ids),
                        np.asarray(tk_vals))
         toks = np.asarray(next_tok)
-        self.stats.prefill_ms += 1e3 * (time.monotonic() - t0)
+        prefill_ms = 1e3 * (time.monotonic() - t0)
+        self.stats.prefill_ms += prefill_ms
+        for _req, _sid, _n, _tt in items:
+            self.phases.observe(
+                "prefill", prefill_ms,
+                _req.trace.trace_id if _req.trace is not None else "")
+            if _req.trace is not None:
+                _req.trace.prefill(prefill_ms, bucket=S, group=G)
         t_first = time.monotonic()
         for g, (req, seq_id, n, total) in enumerate(items):
             slot_idx = self._free_slot_index()
@@ -1316,7 +1382,11 @@ class Engine:
             )
             self.stats.prefills += 1
             self._mark_admitted(slot_idx)
+            t_m = time.monotonic()
             self._emit_token(slot_idx, int(toks[g]), first_lp)
+            self.phases.observe(
+                "first_emit", 1e3 * (time.monotonic() - t_m),
+                req.trace.trace_id if req.trace is not None else "")
         self.stats.first_emit_ms += 1e3 * (time.monotonic() - t_first)
         logger.debug("batched prefill G=%d S=%d %.1fms", G, S,
                      1e3 * (time.monotonic() - t0))
@@ -1414,6 +1484,21 @@ class Engine:
         pages = self.allocator.pages(seq_id)
         req.id = seq_id
 
+        qw = 1e3 * (time.monotonic() - req.enqueued_at)
+        self.phases.observe(
+            "queue_wait", qw,
+            req.trace.trace_id if req.trace is not None else "")
+        if req.trace is not None:
+            burst_id, burst_size = self._cur_burst
+            req.trace.queue_wait(qw)
+            req.trace.admission(
+                path="single", burst_id=burst_id, burst_size=burst_size,
+                prefix=("off" if not chain_keys
+                        else "full" if full_hit
+                        else "partial" if cached_pages else "miss"),
+                pages_adopted=len(cached_pages),
+                prefix_tokens=prefix_len)
+
         suffix = req.prompt[prefix_len:]
         ns = len(suffix)
         use_sp = (
@@ -1490,6 +1575,9 @@ class Engine:
                 )
                 consumed += chunk
                 self.stats.chunked_prefill_steps += 1
+                if req.trace is not None:
+                    req.trace.event("prefill_chunk", tokens=chunk,
+                                    consumed=prefix_len + consumed)
                 # interleave: active streams keep decoding between
                 # chunks (their windows overlap this chunk's compute)
                 t_tick = time.monotonic()
@@ -1569,8 +1657,17 @@ class Engine:
             )
         tok = int(next_tok[0])
         self.stats.prefills += 1
-        self.stats.prefill_ms += max(
-            0.0, 1e3 * (time.monotonic() - t0) - tick_ms)
+        prefill_ms = max(0.0, 1e3 * (time.monotonic() - t0) - tick_ms)
+        self.stats.prefill_ms += prefill_ms
+        self.phases.observe(
+            "prefill", prefill_ms,
+            req.trace.trace_id if req.trace is not None else "")
+        if req.trace is not None:
+            req.trace.prefill(
+                prefill_ms, bucket=S,
+                padded_frac=round(1.0 - ns_tail / S, 3) if S else 0.0,
+                chunks=consumed // chunk if chunk else 0,
+                resumed_at=eff_prefix, sp=use_sp)
         t_first = time.monotonic()
         if self.prefix_cache is not None and chain_keys:
             self.prefix_cache.insert(chain_keys, pages,
@@ -1605,7 +1702,11 @@ class Engine:
         )
         self._mark_admitted(slot_idx)
         self._emit_token(slot_idx, tok, first_lp)
-        self.stats.first_emit_ms += 1e3 * (time.monotonic() - t_first)
+        first_emit_ms = 1e3 * (time.monotonic() - t_first)
+        self.stats.first_emit_ms += first_emit_ms
+        self.phases.observe(
+            "first_emit", first_emit_ms,
+            req.trace.trace_id if req.trace is not None else "")
         return "admitted"
 
     def _requeue_front_many(self, reqs: list[GenRequest]) -> None:
@@ -1806,7 +1907,8 @@ class Engine:
                     for k in state
                 }
 
-            self._row_update_fn = jax.jit(_upd, donate_argnums=(0,))
+            self._row_update_fn = self.compile_tracker.register(
+                "row_update", jax.jit(_upd, donate_argnums=(0,)))
         P = self._state_bucket
         for i in sorted(self._dirty_rows):
             self._device_state = self._row_update_fn(
@@ -1827,7 +1929,8 @@ class Engine:
                 return dict(
                     state, draft_len=state["draft_len"].at[i].set(d))
 
-            self._spec_update_fn = jax.jit(_sup, donate_argnums=(0,))
+            self._spec_update_fn = self.compile_tracker.register(
+                "spec_row_update", jax.jit(_sup, donate_argnums=(0,)))
         for i in sorted(self._spec_dirty):
             s = self._slots[i]
             d = (s.ctrl.draft_len()
@@ -1937,6 +2040,9 @@ class Engine:
             if not live.get(i, False) or dl.get(i, 0) <= 0:
                 continue
             self.stats.spec_drafted += proposed.get(i, 0)
+            if req.trace is not None:
+                req.trace.spec_window(proposed.get(i, 0),
+                                      accepted.get(i, 0))
             s = self._slots[i]
             if s is None or s.req is not req or s.ctrl is None:
                 continue
@@ -1960,7 +2066,14 @@ class Engine:
         t0 = time.monotonic()
         host = jax.tree_util.tree_map(np.asarray, w.sampled)
         t1 = time.monotonic()
-        self.stats.transfer_ms += 1e3 * (t1 - t0)
+        tr_ms = 1e3 * (t1 - t0)
+        self.stats.transfer_ms += tr_ms
+        ex = ""
+        for _i, _req in w.members:
+            if _req.trace is not None:
+                _req.trace.transfer(tr_ms)
+                ex = ex or _req.trace.trace_id
+        self.phases.observe("transfer", tr_ms, ex)
         if w.draft:
             self._process_spec_window(host[0], host[1], host[2],
                                       w.members, w.draft_lens)
@@ -2080,6 +2193,9 @@ class Engine:
         self._inflight = _Window(sampled=sampled, members=members, k=k,
                                  frees=frees, draft=draft,
                                  draft_lens=draft_lens)
+        for _i, _req in members:
+            if _req.trace is not None:
+                _req.trace.decode_window(k, lean, draft)
         self.stats.active_slots = sum(s is not None for s in self._slots)
         self._refresh_stats()
         return True
@@ -2102,6 +2218,15 @@ class Engine:
                 req.emit(t, f)
 
         s.generated += 1
+        if s.generated == 1:
+            s.first_emit_at = time.monotonic()
+            # engine-side TTFT: arrival → first sampled token available
+            # (queue wait + prefill + first-emit residual)
+            self.phases.observe(
+                "ttft", 1e3 * (s.first_emit_at - req.enqueued_at),
+                req.trace.trace_id if req.trace is not None else "")
+            if req.trace is not None:
+                req.trace.first_token()
         finish: str | None = None
         if tok in self.eos or tok in req.stop_token_ids:
             finish = "stop"
@@ -2113,6 +2238,14 @@ class Engine:
             _send(tok, finish)
         self.stats.tokens_generated += 1
         if finish is not None:
+            if s.generated > 1 and s.first_emit_at:
+                self.phases.observe(
+                    "decode_per_token",
+                    1e3 * (time.monotonic() - s.first_emit_at)
+                    / (s.generated - 1),
+                    req.trace.trace_id if req.trace is not None else "")
+            if req.trace is not None:
+                req.trace.engine_finish(finish)
             self._pending_frees.append(req.id)
             self._slots[i] = None
             self._dirty_rows.add(i)
@@ -2125,6 +2258,9 @@ class Engine:
 
     def _refresh_stats(self) -> None:
         self.stats.queued = self._queue.qsize()
+        self.stats.xla_compiles = self.compile_tracker.compiles()
+        self.stats.xla_compile_ms = round(
+            self.compile_tracker.compiles_total_ms(), 3)
         self.stats.kv_pages_free = self.allocator.free_pages
         self.stats.kv_occupancy = self.allocator.occupancy
         self.stats.spec_accept_rate = (

@@ -6,6 +6,15 @@ Endpoints: /v1/chat/completions (stream + non-stream), /v1/completions,
 telemetry consumed by the gateway's endpoint picker (the reference's EPP
 protocol speaks ext_proc; ours is a plain JSON poll + the same
 ``x-gateway-destination-endpoint`` contract, internalapi.go:76).
+
+Observability (ISSUE 5): the gateway's ``traceparent`` no longer dies at
+the replica hop — each request opens a child span here and the engine
+emits lifecycle spans/events under it (queue-wait, admission, prefill,
+first-token, decode windows); every request is also recorded in the
+in-process flight recorder, served at ``/debug/requests[/{id}]`` with no
+tracing backend required, and ``/debug/profile?seconds=N`` captures an
+on-demand ``jax.profiler`` trace when enabled. The response carries
+``x-aigw-request-id`` so gateway access-log lines join against both.
 """
 
 from __future__ import annotations
@@ -14,6 +23,8 @@ import asyncio
 import functools
 import json
 import logging
+import os
+import tempfile
 import time
 import uuid
 from typing import Any
@@ -26,11 +37,13 @@ from aiohttp import web
 from aigw_tpu.gateway.costs import TokenUsage
 from aigw_tpu.models import llama
 from aigw_tpu.models.registry import family_fns, get_model_spec
+from aigw_tpu.obs.flight import FlightRecorder, RequestTrace
 from aigw_tpu.obs.metrics import (
     GenAIMetrics,
     RequestMetrics,
     render_engine_gauges,
 )
+from aigw_tpu.obs.tracing import SpanContext, Tracer, genai_attributes
 from aigw_tpu.schemas import openai as oai
 from aigw_tpu.translate.sse import SSEEvent
 from aigw_tpu.utils.net import set_tcp_nodelay
@@ -111,6 +124,13 @@ class TPUServeServer:
         # served when a request's model == "<base>:<adapter>" or the bare
         # adapter name
         lora_adapters: dict[str, dict] | None = None,
+        tracer: Tracer | None = None,
+        # flight recorder ring size (per-request lifecycle timelines on
+        # /debug/requests — always on; the entries are compact)
+        flight_entries: int = 256,
+        # /debug/profile?seconds=N jax.profiler capture — opt-in: a
+        # profiler endpoint on the data port is a DoS/inspection surface
+        enable_profile_endpoint: bool = False,
     ):
         self.model_name = model
         spec = get_model_spec(model)
@@ -119,6 +139,14 @@ class TPUServeServer:
         self.tokenizer = load_tokenizer(spec.tokenizer)
         self.chat_template = spec.chat_template
         self.metrics = metrics or GenAIMetrics()
+        # env-driven (OTEL_*); service name distinguishes replica spans
+        # from the gateway's in a shared collector
+        self.tracer = tracer or Tracer(
+            service_name=os.environ.get("OTEL_SERVICE_NAME", "")
+            or "tpuserve")
+        self.flight = FlightRecorder(capacity=flight_entries)
+        self._enable_profile = enable_profile_endpoint
+        self._profile_lock = asyncio.Lock()
 
         mesh = None
         if tp > 1 or ep > 1 or sp > 1:
@@ -205,6 +233,10 @@ class TPUServeServer:
         self.app.router.add_get("/health", self._health)
         self.app.router.add_get("/state", self._state)
         self.app.router.add_get("/metrics", self._metrics)
+        self.app.router.add_get("/debug/requests", self._debug_requests)
+        self.app.router.add_get("/debug/requests/{rid}",
+                                self._debug_request)
+        self.app.router.add_get("/debug/profile", self._debug_profile)
         self.app.on_startup.append(self._on_start)
         self.app.on_cleanup.append(self._on_stop)
 
@@ -336,7 +368,8 @@ class TPUServeServer:
         return prompt, self._prefix_hashes_for(prompt)
 
     def _submit(self, prompt: list[int], body: dict[str, Any],
-                lp_top_n: int = -1, prefix_hashes: list | None = None):
+                lp_top_n: int = -1, prefix_hashes: list | None = None,
+                trace: RequestTrace | None = None):
         """Submit to the engine; returns an asyncio.Queue of
         (token_id, finish_reason, lp) tuples — lp is None without
         logprobs, else (chosen_logprob, [(top_id, top_logprob)]).
@@ -365,9 +398,54 @@ class TPUServeServer:
             emit_lp=emit_lp if lp_top_n >= 0 else None,
             adapter=self._resolve_adapter(str(body.get("model", ""))),
             prefix_hashes=prefix_hashes,
+            trace=trace,
         )
         self.engine.submit(req)
         return out, req
+
+    def _begin_trace(
+        self, request: web.Request, rid: str, model: str,
+        prompt: list[int], body: dict[str, Any], stream: bool, chat: bool,
+    ) -> RequestTrace:
+        """Open the replica's request span (child of the caller's trace
+        context when a ``traceparent``/B3 header arrived — the gateway
+        injects one) and the flight-recorder entry. With tracing
+        disabled the caller's trace id is still recorded on the entry,
+        so /debug/requests joins against external traces either way."""
+        headers = {k.lower(): v for k, v in request.headers.items()}
+        parent = self.tracer.propagators.extract(headers)
+        span = None
+        if self.tracer.enabled:
+            op = "chat" if chat else "text_completion"
+            span = self.tracer.start_span(f"tpuserve.{op} {model}",
+                                          parent)
+            span.attributes.update(genai_attributes(
+                operation=op, request_model=model,
+                response_model=self.model_name, backend="tpuserve",
+                streaming=stream))
+            span.set("tpuserve.request_id", rid)
+        entry = self.flight.begin(
+            rid, model=model, prompt_tokens=len(prompt),
+            max_tokens=int(body.get("max_completion_tokens")
+                           or body.get("max_tokens") or 256),
+            stream=stream,
+            trace_id=(span.context.trace_id if span is not None
+                      else parent.trace_id if parent is not None else ""),
+            span_id=(span.context.span_id if span is not None else ""),
+        )
+        return RequestTrace(entry=entry, tracer=self.tracer, span=span)
+
+    def _end_trace(self, trace: RequestTrace, finish: str, n_out: int,
+                   n_prompt: int = 0, error: str = "") -> None:
+        self.flight.finish(trace.entry, finish, n_out)
+        span = trace.span
+        if span is not None:
+            span.set("gen_ai.usage.input_tokens", n_prompt)
+            span.set("gen_ai.usage.output_tokens", n_out)
+            span.set("tpuserve.finish_reason", finish)
+            if error:
+                span.record_error(error)
+            span.end()
 
     @staticmethod
     def _legacy_logprobs(entries: list[dict[str, Any]]) -> dict[str, Any]:
@@ -509,21 +587,30 @@ class TPUServeServer:
         stop_strs: list[str] = (
             [stops] if isinstance(stops, str) else list(stops or [])
         )
+        trace = self._begin_trace(request, rid,
+                                  str(body.get("model", self.model_name)),
+                                  prompt, body, stream, chat)
         try:
             out, gen_req = self._submit(prompt, body, lp_top_n,
-                                        prefix_hashes)
+                                        prefix_hashes, trace)
         except EngineOverloadedError as e:
+            self._end_trace(trace, "rejected", 0, len(prompt),
+                            error=str(e))
             return web.Response(
                 status=429,
                 body=oai.error_body(str(e), type_="rate_limit_error"),
                 headers={"retry-after": "1"},
                 content_type="application/json")
         except oai.SchemaError as e:
+            self._end_trace(trace, "rejected", 0, len(prompt),
+                            error=str(e))
             return web.Response(
                 status=404,
                 body=oai.error_body(str(e), type_="model_not_found"),
                 content_type="application/json")
         except ValueError as e:
+            self._end_trace(trace, "rejected", 0, len(prompt),
+                            error=str(e))
             return web.Response(status=400, body=oai.error_body(str(e)),
                                 content_type="application/json")
 
@@ -535,6 +622,7 @@ class TPUServeServer:
                     out, stop_strs, lp_top_n)
             except asyncio.CancelledError:
                 gen_req.cancelled.set()
+                self._end_trace(trace, "cancelled", 0, n_prompt)
                 raise
             usage = TokenUsage(
                 input_tokens=n_prompt,
@@ -543,6 +631,9 @@ class TPUServeServer:
             )
             rm.finish(usage, error_type="engine" if finish == "error"
                       else "")
+            self._end_trace(trace, finish, n_out, n_prompt,
+                            error="engine failure"
+                            if finish == "error" else "")
             if finish == "error":
                 return web.Response(
                     status=500,
@@ -572,13 +663,17 @@ class TPUServeServer:
                     # legacy completions carry token_logprobs/tokens
                     resp["choices"][0]["logprobs"] = \
                         self._legacy_logprobs(lp_content)
-            return web.json_response(resp)
+            return web.json_response(
+                resp, headers={"x-aigw-request-id": rid})
 
         # streaming
         resp = web.StreamResponse(
             status=200,
             headers={"content-type": "text/event-stream",
-                     "cache-control": "no-cache"},
+                     "cache-control": "no-cache",
+                     # joins the gateway access log / client against the
+                     # flight recorder (/debug/requests/{id}) and spans
+                     "x-aigw-request-id": rid},
         )
         # first-token fast path: the role frame and the first content
         # delta are two small writes back to back — Nagle must not hold
@@ -743,12 +838,14 @@ class TPUServeServer:
         except (asyncio.CancelledError, ConnectionResetError):
             # client went away: stop generating, free the slot
             gen_req.cancelled.set()
+            self._end_trace(trace, "cancelled", n_out, n_prompt)
             raise
         usage = TokenUsage(
             input_tokens=n_prompt, output_tokens=n_out,
             total_tokens=n_prompt + n_out,
         )
         rm.finish(usage)
+        self._end_trace(trace, finish, n_out, n_prompt)
         await resp.write(
             oai.stream_chunk_sse(
                 response_id=rid, model=self.model_name, created=created,
@@ -1190,6 +1287,15 @@ class TPUServeServer:
                 "spec_rung_downs": s.spec_rung_downs,
                 "spec_lookahead_slots": s.spec_lookahead_slots,
                 "state_rebuilds": s.state_rebuilds,
+                # XLA compile tracker (obs/xla_events.py): nonzero
+                # growth after warmup = a hot-path compile regression
+                "xla_compiles": s.xla_compiles,
+                "xla_compile_ms": s.xla_compile_ms,
+                # serving-phase latency distributions (p50/p95/p99 per
+                # ENGINE_HISTOGRAMS phase; -1 = no observations yet) —
+                # the bench reads TTFT/per-token spreads from here
+                # instead of recomputing them client-side
+                "phase_percentiles": self.engine.phases.percentiles(),
                 # ICI topology: the picker's same-slice preference term
                 # (gateway/picker.py) keys on this
                 **device_topology(),
@@ -1197,9 +1303,74 @@ class TPUServeServer:
         )
 
     async def _metrics(self, _request: web.Request) -> web.Response:
-        body = self.metrics.export() + render_engine_gauges(
-            self.engine.stats)
+        body = (self.metrics.export()
+                + render_engine_gauges(self.engine.stats)
+                + self.engine.phases.render())
         return web.Response(body=body, content_type="text/plain")
+
+    # -- debug surface (flight recorder + profiler) -----------------------
+    async def _debug_requests(self, _request: web.Request) -> web.Response:
+        """Recent + slow request timelines from the flight recorder —
+        answerable on any replica with no tracing backend attached."""
+        return web.json_response(self.flight.snapshot())
+
+    async def _debug_request(self, request: web.Request) -> web.Response:
+        entry = self.flight.get(request.match_info["rid"])
+        if entry is None:
+            return web.Response(
+                status=404,
+                body=oai.error_body("unknown request id"),
+                content_type="application/json")
+        return web.json_response(entry.detail())
+
+    #: hard cap on one /debug/profile capture window
+    _PROFILE_MAX_SECONDS = 30.0
+
+    async def _debug_profile(self, request: web.Request) -> web.Response:
+        """On-demand ``jax.profiler`` capture: trace device+host activity
+        for ?seconds=N into a fresh directory and return its path.
+        Opt-in (``--enable-profile-endpoint``): a profiler on the data
+        port is an inspection/DoS surface, so it 404s when disabled."""
+        if not self._enable_profile:
+            return web.Response(
+                status=404,
+                body=oai.error_body(
+                    "profiling endpoint disabled (start tpuserve with "
+                    "--enable-profile-endpoint)"),
+                content_type="application/json")
+        try:
+            seconds = float(request.query.get("seconds", "2"))
+        except ValueError:
+            return web.Response(
+                status=400, body=oai.error_body("seconds must be a number"),
+                content_type="application/json")
+        seconds = min(max(seconds, 0.1), self._PROFILE_MAX_SECONDS)
+        if self._profile_lock.locked():
+            return web.Response(
+                status=409,
+                body=oai.error_body("a profile capture is already running"),
+                content_type="application/json")
+        async with self._profile_lock:
+            out_dir = tempfile.mkdtemp(prefix="tpuserve-profile-")
+
+            def capture() -> None:
+                jax.profiler.start_trace(out_dir)
+                try:
+                    time.sleep(seconds)
+                finally:
+                    jax.profiler.stop_trace()
+
+            try:
+                await asyncio.to_thread(capture)
+            except Exception as e:  # noqa: BLE001 — profiler quirks must
+                # surface as a client error, not a crashed replica
+                return web.Response(
+                    status=500,
+                    body=oai.error_body(f"profiler capture failed: {e}",
+                                        type_="server_error"),
+                    content_type="application/json")
+        return web.json_response(
+            {"profile_dir": out_dir, "seconds": seconds})
 
 
 async def run_tpuserve(
@@ -1228,6 +1399,8 @@ async def run_tpuserve(
     warm_prefill_buckets: int = 0,
     first_token_fast_path: bool = True,
     prefill_bucket_rungs: int = 2,
+    flight_entries: int = 256,
+    enable_profile_endpoint: bool = False,
 ) -> web.AppRunner:
     server = TPUServeServer(
         model,
@@ -1255,6 +1428,8 @@ async def run_tpuserve(
         sp=sp,
         quantize=quantize,
         lora_adapters=lora_adapters,
+        flight_entries=flight_entries,
+        enable_profile_endpoint=enable_profile_endpoint,
     )
     runner = web.AppRunner(server.app)
     await runner.setup()

@@ -555,6 +555,18 @@ def gateway_numbers(model_name: str, cfg, quantize: str, batch=BATCH,
             d_ttft.append(dt_ttft)
             g_tps.append(gt)
             g_ttft.append(gt_ttft)
+        # server-side phase percentiles straight from the replica's
+        # histograms (/state phase_percentiles, ISSUE 5) — p50/p95/p99
+        # for TTFT and per-token latency come from the serving path's
+        # own distributions, not recomputed from the client's samples
+        phase_pct: dict = {}
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(serve_url + "/state") as r:
+                    phase_pct = (await r.json()).get(
+                        "phase_percentiles", {})
+        except aiohttp.ClientError:
+            pass
         return {
             "gateway_tps": _median(g_tps),
             "gateway_ttft_ms_p50": _median(g_ttft),
@@ -562,6 +574,7 @@ def gateway_numbers(model_name: str, cfg, quantize: str, batch=BATCH,
             "direct_ttft_ms_p50": _median(d_ttft),
             "gateway_tps_spread": round(_spread(g_tps), 3),
             "direct_tps_spread": round(_spread(d_tps), 3),
+            "serve_phase_percentiles": phase_pct,
         }
 
     try:
@@ -1007,6 +1020,16 @@ def _suite(params_holder, cfg, desc, model_name, quantize, batch,
         "transfer_ms": engine_phases["transfer_ms"],
         "emit_ms": engine_phases["emit_ms"],
         "first_emit_ms": engine_phases["first_emit_ms"],
+        # serving-side distribution spreads (ISSUE 5): p50/p95/p99 read
+        # from the replica's own phase histograms over the whole capture
+        # (warm + all reps) — the interpretable tail behind the
+        # client-measured medians above
+        "ttft_hist_ms": gw.get("serve_phase_percentiles", {}).get(
+            "ttft", {}),
+        "per_token_hist_ms": gw.get("serve_phase_percentiles", {}).get(
+            "decode_per_token", {}),
+        "queue_wait_hist_ms": gw.get("serve_phase_percentiles", {}).get(
+            "queue_wait", {}),
         # analytical MFU of the engine leg's decode rate (2·matmul
         # params + attention terms per token ÷ chip peak; v5e bf16 peak
         # unless AIGW_CHIP_PEAK_FLOPS overrides). A diagnostic on the

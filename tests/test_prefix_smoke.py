@@ -162,11 +162,62 @@ def test_engine_gauges_map_matches_engine_stats():
         assert hasattr(stats, attr), attr
 
 
+def test_engine_histograms_match_engine_phases():
+    """Histogram-surface drift check (ISSUE 5): every ENGINE_HISTOGRAMS
+    phase must exist in EnginePhases under its declared Prometheus
+    family name, render as a histogram, and surface in the /state
+    percentile summary — a renamed phase otherwise silently drops a
+    dashboard distribution."""
+    from aigw_tpu.obs.metrics import ENGINE_HISTOGRAMS, EnginePhases
+
+    phases = EnginePhases()
+    for key, name in ENGINE_HISTOGRAMS:
+        assert key in phases.hists, key
+        assert phases.hists[key].name == name
+    text = phases.render().decode()
+    pct = phases.percentiles()
+    for key, name in ENGINE_HISTOGRAMS:
+        assert f"# TYPE {name} histogram" in text, name
+        assert f'{name}_bucket{{le="+Inf"}}' in text, name
+        assert set(pct[key]) == {"p50", "p95", "p99"}
+
+
+def test_state_and_metrics_export_phase_histograms(smoke_url):
+    """/state must carry phase_percentiles + the XLA compile counters,
+    and /metrics must serve every phase histogram family — with
+    NON-EMPTY buckets for the phases a completed request must have
+    exercised (queue_wait/prefill/ttft/first_emit)."""
+    from aigw_tpu.obs.metrics import ENGINE_HISTOGRAMS
+
+    state = json.loads(asyncio.run(_get(smoke_url, "/state")))
+    assert "xla_compiles" in state and "xla_compile_ms" in state
+    pct = state["phase_percentiles"]
+    for key, _name in ENGINE_HISTOGRAMS:
+        assert key in pct, f"/state phase_percentiles lost {key}"
+    text = asyncio.run(_get(smoke_url, "/metrics")).decode()
+    for _key, name in ENGINE_HISTOGRAMS:
+        assert f"# TYPE {name} histogram" in text, name
+    # the module-scoped server has answered chats by now: these phases
+    # must hold real observations (+Inf cumulative count > 0)
+    for name in ("tpuserve_queue_wait_hist_ms",
+                 "tpuserve_prefill_hist_ms",
+                 "tpuserve_first_emit_hist_ms",
+                 "tpuserve_ttft_hist_ms"):
+        for line in text.splitlines():
+            if line.startswith(f'{name}_bucket{{le="+Inf"}}'):
+                assert int(line.split()[1]) > 0, line
+                break
+        else:
+            raise AssertionError(f"{name} +Inf bucket missing")
+
+
 def test_warm_prefill_buckets_covers_every_rung():
     """Compile-on-hot-path tripwire: with warm_prefill_buckets=N, every
     rung of the first N octaves (x1, x1.5 at rungs=2) must be compiled
     at warmup for every pow2 group size — admitting a prompt at any of
-    those widths afterwards must NOT add a prefill compile."""
+    those widths afterwards must NOT add a prefill compile. Compile
+    accounting goes through the engine's shared CompileTracker
+    (obs/xla_events.py), not ad-hoc jit-cache spelunking."""
     spec_cfg = llama.TINY
     params = llama.init_params(jax.random.PRNGKey(0), spec_cfg)
     eng = Engine(params, spec_cfg, EngineConfig(
@@ -177,7 +228,7 @@ def test_warm_prefill_buckets_covers_every_rung():
     eng.warmup()
     rungs = sorted(set(eng._bucket_rungs(0) + eng._bucket_rungs(1)))
     assert rungs == [16, 24, 32, 48]
-    warmed = eng._prefill_fn._cache_size()
+    warmed = eng.compile_tracker.programs()["prefill"]
     # 4 rungs × group sizes {1, 2} — every (G2, S) shape pre-compiled
     assert warmed == len(rungs) * 2, warmed
 
@@ -190,21 +241,11 @@ def test_warm_prefill_buckets_covers_every_rung():
                 sampling=SamplingParams(temperature=0.0),
                 emit=lambda t, f, d=done: d.set() if f else None))
             assert done.wait(timeout=300)
-        assert eng._prefill_fn._cache_size() == warmed, (
+        assert eng.compile_tracker.programs()["prefill"] == warmed, (
             "a prompt at a warmed rung width still paid an XLA "
             "prefill compile on the hot path")
     finally:
         eng.stop()
-
-
-def _live_compiles(eng) -> int:
-    """Every jitted program the serving hot loop can invoke."""
-    total = eng._prefill_fn._cache_size()
-    total += sum(f._cache_size() for f in eng._decode_fns.values())
-    for f in (eng._row_update_fn, eng._spec_update_fn):
-        if f is not None:
-            total += f._cache_size()
-    return total
 
 
 def test_spec_verify_ladder_warm_no_hot_compiles():
@@ -215,7 +256,9 @@ def test_spec_verify_ladder_warm_no_hot_compiles():
     shape, both plain variants, and the row-update scatters are
     pre-compiled. One 64-token page keeps the decode bucket at the
     warmup size, so any compile counted here is a real ladder gap, not
-    page-bucket growth."""
+    page-bucket growth. The assertion runs on the engine's shared
+    CompileTracker checkpoint (every hot-path program is registered
+    there — ISSUE 5 replaced the per-test counting helpers)."""
     spec_cfg = llama.TINY
     params = llama.init_params(jax.random.PRNGKey(0), spec_cfg)
     eng = Engine(params, spec_cfg, EngineConfig(
@@ -224,7 +267,7 @@ def test_spec_verify_ladder_warm_no_hot_compiles():
         spec_tokens=4, warm_prefill_buckets=2,
         enable_prefix_cache=False))
     eng.warmup()
-    warmed = _live_compiles(eng)
+    checkpoint = eng.compile_tracker.checkpoint()
     fns = set(eng._decode_fns)
     # the full ladder exists up front: {kmin, K} × ({lean, full} plain
     # + every nonzero rung)
@@ -255,7 +298,7 @@ def test_spec_verify_ladder_warm_no_hot_compiles():
         assert eng.stats.spec_drafted > 0  # the ladder actually ran
         assert eng.stats.state_rebuilds == 0
         assert set(eng._decode_fns) == fns, "new program key on hot path"
-        assert _live_compiles(eng) == warmed, (
+        assert eng.compile_tracker.compiles_since(checkpoint) == 0, (
             "speculative traffic paid an XLA compile after warmup")
     finally:
         eng.stop()
