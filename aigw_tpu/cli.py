@@ -281,6 +281,23 @@ def main(argv: list[str] | None = None) -> int:
                               "decode and speculative verify (single-chip; "
                               "HBM reads scale with actual sequence "
                               "lengths)")
+    p_serve.add_argument("--attention-backend", default="xla-bucketed",
+                         choices=["xla-bucketed", "pallas-ragged"],
+                         help="prefill attention backend: xla-bucketed "
+                              "pads each prompt to a per-sequence "
+                              "bucket rung; pallas-ragged packs a "
+                              "mixed-length admission burst into ONE "
+                              "ragged paged-attention program sized by "
+                              "total tokens (padded to a token-budget "
+                              "chunk), with prefix-cache resumes and "
+                              "chunked continuations as start offsets. "
+                              "Auto-falls back to XLA attention "
+                              "off-TPU and to xla-bucketed on a mesh")
+    p_serve.add_argument("--ragged-chunk-tokens", type=int, default=256,
+                         help="pallas-ragged padding granule: packed "
+                              "totals pad to multiples of this (the "
+                              "compiled-program ladder is its "
+                              "multiples up to 8 chunks per call)")
     p_serve.add_argument("--no-prefix-cache", action="store_true",
                          help="disable automatic prompt prefix caching")
     p_serve.add_argument("--flight-entries", type=int, default=256,
@@ -844,6 +861,8 @@ async def _run_tpuserve(args: argparse.Namespace) -> int:
         spec_tokens=0 if args.no_speculation else args.spec_tokens,
         spec_adaptive=not args.no_spec_adaptive,
         pallas_attn=args.pallas_attn,
+        attention_backend=args.attention_backend,
+        ragged_chunk_tokens=args.ragged_chunk_tokens,
         logprobs_topk=args.logprobs,
         adaptive_decode_window=not args.no_adaptive_window,
         async_transfers=not args.sync_transfers,

@@ -534,6 +534,146 @@ def verify_step(
     return _logits(p, cfg, x), kv_cache
 
 
+def _ragged_window_attention(
+    q: jax.Array,  # [T, H, D] packed queries (f32/bf16)
+    k_pool: jax.Array,  # [n_slots, Hkv, D]
+    v_pool: jax.Array,
+    pt_rows: jax.Array,  # [T, P] page ids of each token's sequence
+    positions: jax.Array,  # [T] absolute position per token
+    valid: jax.Array,  # [T] bool — False for padding rows
+    page_size: int,
+) -> jax.Array:
+    """XLA reference for the ragged prefill attention: online softmax
+    over the page window, one page per loop step — the same math as the
+    Pallas kernel (ops/pallas/paged_attention.ragged_prefill_attention)
+    with memory bounded at [T, page] instead of [T, window], so the
+    CPU/interpret fallback never materializes the full padded window.
+    Returns [T, H * D] in q's dtype."""
+    T, H, D = q.shape
+    Hkv = k_pool.shape[1]
+    grp = H // Hkv
+    P = pt_rows.shape[1]
+    qf = q.astype(jnp.float32).reshape(T, Hkv, grp, D) / math.sqrt(D)
+    offs = jnp.arange(page_size, dtype=jnp.int32)
+
+    def body(p, carry):
+        m, l, acc = carry
+        slots = pt_rows[:, p][:, None] * page_size + offs[None, :]
+        k = k_pool[slots].astype(jnp.float32)  # [T, page, Hkv, D]
+        v = v_pool[slots].astype(jnp.float32)
+        logits = jnp.einsum("thgd,tshd->thgs", qf, k)  # [T, Hkv, grp, page]
+        kp = p * page_size + offs
+        mask = (kp[None, :] <= positions[:, None]) & valid[:, None]
+        logits = jnp.where(mask[:, None, None, :], logits, -1e30)
+        m_new = jnp.maximum(m, logits.max(-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        probs = jnp.exp(logits - m_new)
+        l_new = alpha * l + probs.sum(-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum("thgs,tshd->thgd", probs, v)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((T, Hkv, grp, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((T, Hkv, grp, 1), jnp.float32)
+    acc0 = jnp.zeros((T, Hkv, grp, D), jnp.float32)
+    # traced upper bound: pages past the highest attended position are
+    # fully masked — skip them instead of walking the whole window
+    # (the XLA analogue of the kernel's ragged DMA skip)
+    max_pos = jnp.max(jnp.where(valid, positions, 0))
+    p_hi = jnp.minimum(max_pos // page_size + 1, P)
+    _, l, acc = lax.fori_loop(0, p_hi, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.reshape(T, H * D).astype(q.dtype)
+
+
+def prefill_ragged(
+    p: dict[str, jax.Array],
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [T] int32 — PACKED new tokens, all sequences
+    row_seq: jax.Array,  # [T] int32 — sequence row per token; >= B = padding
+    positions: jax.Array,  # [T] int32 — absolute position per token
+    last_rows: jax.Array,  # [B] int32 — packed index of each row's last token
+    kv_cache: jax.Array,
+    page_table: jax.Array,  # [B, max_pages]
+    page_size: int,
+    *,
+    attn_impl: str = "",  # "" = XLA windowed reference; "pallas" = kernel
+    mlp=None,
+    lora=None,
+    adapter_idx=None,  # [B] int32 adapter row per sequence row
+) -> tuple[jax.Array, jax.Array]:
+    """Ragged prefill: ONE program for any admission-burst geometry.
+
+    The packed layout replaces per-sequence bucket padding: sequence b's
+    new tokens occupy a contiguous run of packed rows (grouped and
+    ascending in b, padding rows at the tail with ``row_seq >= B``), at
+    absolute positions ``positions`` — nonzero first positions make
+    offset-resumed prefill (prefix-cache partial hits, chunked-prefill
+    continuations) first-class. Per layer the chunk's K/V are scattered
+    into the page pool, then every packed query attends its own
+    sequence's page window under a global causal mask — semantically
+    ``prefill_suffix`` with the batch dimension flattened away. Returns
+    (logits at each row's last packed token [B, V], updated cache);
+    rows whose segment does not end the prompt carry don't-care logits
+    the engine ignores.
+    """
+    T = tokens.shape[0]
+    B, P = page_table.shape
+    valid = row_seq < B
+    rs = jnp.minimum(row_seq, B - 1)
+    n_slots = kv_cache.shape[2]
+    pt_rows = page_table[rs]  # [T, P]
+    slot = (
+        jnp.take_along_axis(
+            pt_rows, (positions // page_size)[:, None], axis=1)[:, 0]
+        * page_size
+        + positions % page_size
+    )
+    flat = jnp.where(valid, slot, n_slots)[:, None]  # [T, 1]; OOB drops
+    atok = adapter_idx[rs] if adapter_idx is not None else None
+
+    use_pallas = attn_impl == "pallas"
+    if use_pallas:
+        from aigw_tpu.ops.pallas._compat import is_tpu_backend
+        from aigw_tpu.ops.pallas.paged_attention import (
+            ragged_prefill_attention,
+        )
+
+        interp = not is_tpu_backend()
+        # the kernel's scalar-prefetch metadata, derived from the packed
+        # layout (rows grouped and ascending in b, padding at the tail)
+        cu = jnp.searchsorted(
+            row_seq, jnp.arange(B + 1, dtype=jnp.int32), side="left"
+        ).astype(jnp.int32)
+        start = positions[jnp.minimum(cu[:B], T - 1)]
+
+    # per-token layout [T, 1, ...]: every existing helper (rope, LoRA
+    # deltas, projections) treats the packed rows as batch entries
+    x = _embed_rows(p, tokens[:, None])  # [T, 1, dim]
+    pos2 = positions[:, None]
+    for i in range(cfg.n_layers):
+        h = rms_norm(x, p[f"l{i}.attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(p, i, h, pos2, cfg, lora, atok)
+        kv_cache = kv_cache.at[i, 0, flat].set(k, mode="drop")
+        kv_cache = kv_cache.at[i, 1, flat].set(v, mode="drop")
+        if use_pallas:
+            attn = ragged_prefill_attention(
+                q[:, 0], kv_cache[i, 0], kv_cache[i, 1], page_table,
+                cu, start, page_size=page_size, interpret=interp,
+            ).reshape(T, 1, cfg.n_heads * cfg.head_dim)
+        else:
+            attn = _ragged_window_attention(
+                q[:, 0], kv_cache[i, 0], kv_cache[i, 1], pt_rows,
+                positions, valid, page_size,
+            ).reshape(T, 1, -1)
+        x = x + _wo_project(p, i, attn, lora, atok)
+        h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
+        x = x + (mlp(p, i, h) if mlp is not None
+                 else _mlp(p, i, h, lora, atok))
+    x = rms_norm(x, p["norm_f"], cfg.norm_eps)
+    last = x[jnp.clip(last_rows, 0, T - 1), 0]  # [B, dim]
+    return _logits(p, cfg, last), kv_cache
+
+
 def hidden_states(
     p: dict[str, jax.Array],
     cfg: LlamaConfig,

@@ -42,6 +42,10 @@ class ModelFns:
     # multi-position verifier for speculative decoding; None disables the
     # engine's prompt-lookup speculation for the family
     verify_step: Any = None
+    # packed variable-length prefill (one program per token-budget
+    # chunk); None disables the engine's ragged attention backend for
+    # the family (it falls back to xla-bucketed)
+    prefill_ragged: Any = None
 
 
 def family_fns(family: str) -> ModelFns:
@@ -50,7 +54,8 @@ def family_fns(family: str) -> ModelFns:
                         llama.hidden_states,
                         prefill_suffix=llama.prefill_suffix,
                         prefill_sp=llama.prefill_sp,
-                        verify_step=llama.verify_step)
+                        verify_step=llama.verify_step,
+                        prefill_ragged=llama.prefill_ragged)
     if family == "mixtral":
         from aigw_tpu.models import mixtral
 

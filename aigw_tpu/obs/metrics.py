@@ -129,6 +129,15 @@ ENGINE_GAUGES: tuple[tuple[str, str], ...] = (
     ("transfer_ms", "tpuserve_transfer_ms_total"),
     ("emit_ms", "tpuserve_emit_ms_total"),
     ("first_emit_ms", "tpuserve_first_emit_ms_total"),
+    # prefill padding tax (ISSUE 6): real prompt tokens vs tokens the
+    # padded program geometry processed — the per-replica observable
+    # behind the ragged attention backend's padded_frac claim — plus
+    # the warmup cost (collapsed compile surface = faster cold start)
+    ("prefill_tokens_real", "tpuserve_prefill_tokens_real_total"),
+    ("prefill_tokens_padded", "tpuserve_prefill_tokens_padded_total"),
+    ("prefill_padded_frac", "tpuserve_prefill_padded_frac"),
+    ("warmup_ms", "tpuserve_warmup_ms"),
+    ("warm_programs", "tpuserve_warm_programs"),
     # XLA compile tracker (ISSUE 5, obs/xla_events.py): compiles seen
     # process-wide since the engine came up, and their total wall time —
     # a nonzero delta after warmup is a hot-path compile regression

@@ -24,6 +24,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import logging
+import os
 import queue
 import threading
 import time
@@ -156,6 +157,26 @@ class EngineConfig:
     # reads scale with actual sequence lengths, not the padded window).
     # Single-chip only: ignored when the engine runs on a mesh.
     pallas_attn: bool = False
+    # Prefill attention backend (tpuserve/attention.py):
+    # "xla-bucketed" — the classic per-sequence bucket ladder with
+    # batched same-bucket groups; "pallas-ragged" — a mixed-length
+    # admission burst packs into ONE ragged paged-attention program
+    # sized by TOTAL tokens (padded to a token-budget chunk rung, not
+    # per-sequence buckets), with per-sequence start offsets making
+    # prefix-cache resumes and chunked continuations first-class.
+    # pallas-ragged auto-falls back: XLA windowed attention off-TPU,
+    # xla-bucketed on a mesh or for model families without a ragged
+    # prefill entry point.
+    attention_backend: str = "xla-bucketed"
+    # Ragged backend geometry: packed totals pad to multiples of this
+    # chunk (plus two sub-chunk rungs for short tails/resumes)...
+    ragged_chunk_tokens: int = 256
+    # ...and one packed call carries at most chunk × this many tokens;
+    # larger bursts split at budget boundaries with decode ticks
+    # interleaved (chunked-prefill liveness, kept). The compiled
+    # prefill surface is the rung ladder: ~(ragged_max_chunks + 2)
+    # programs for ANY batch geometry.
+    ragged_max_chunks: int = 8
     # KV cache element dtype: "bfloat16" (serving default) or
     # "float32". f32 doubles KV HBM but removes the bf16 rounding that
     # lets near-tied logits argmax-flip between mathematically
@@ -175,6 +196,16 @@ class EngineConfig:
         if self.logprobs_topk > 0 and self.spec_tokens > 0:
             raise ValueError(
                 "logprobs_topk and spec_tokens are mutually exclusive")
+        from aigw_tpu.tpuserve.attention import BACKENDS
+
+        if self.attention_backend not in BACKENDS:
+            raise ValueError(
+                f"attention_backend must be one of {BACKENDS} "
+                f"(got {self.attention_backend!r})")
+        if self.ragged_chunk_tokens < 8 or self.ragged_max_chunks < 1:
+            raise ValueError(
+                "ragged_chunk_tokens must be >= 8 and ragged_max_chunks "
+                ">= 1")
         if self.prefill_bucket_rungs not in (1, 2, 4):
             raise ValueError(
                 f"prefill_bucket_rungs must be 1, 2, or 4 "
@@ -338,6 +369,19 @@ class EngineStats:
     transfer_ms: float = 0.0
     emit_ms: float = 0.0
     first_emit_ms: float = 0.0
+    # prefill padding tax (ISSUE 6): real prompt tokens vs tokens the
+    # padded program geometry actually processed (bucket/batch padding
+    # on xla-bucketed, chunk-rung residue on pallas-ragged);
+    # padded_frac = 1 - real/padded, refreshed per tick — the
+    # per-replica observable behind the ragged backend's claim
+    prefill_tokens_real: int = 0
+    prefill_tokens_padded: int = 0
+    prefill_padded_frac: float = 0.0
+    # warmup cost: wall time of the last warmup() and the compiled
+    # hot-path program count it left behind (compile tracker) — the
+    # "collapsed compile surface = faster cold start" observables
+    warmup_ms: float = 0.0
+    warm_programs: int = 0
     # age of the oldest queued request (picker queue-latency signal)
     queue_wait_ms: float = 0.0
     # XLA compile tracker (obs/xla_events.py): backend compiles observed
@@ -786,6 +830,38 @@ class Engine:
         if self._prefill_sp_fn is not None:
             self.compile_tracker.register("prefill_sp",
                                           self._prefill_sp_fn)
+        # ragged packed prefill (the pallas-ragged backend's single
+        # program family — one compiled shape per token-budget rung).
+        # Attention impl: the Pallas kernel on TPU, the XLA windowed
+        # reference elsewhere (auto-fallback; AIGW_RAGGED_PREFILL_IMPL
+        # in {xla, pallas} overrides for A/B and parity tests).
+        self._prefill_ragged_fn = None
+        self._ragged_impl = ""
+        model_prefill_ragged = self.fns.prefill_ragged
+        if model_prefill_ragged is not None and mesh is None:
+            from aigw_tpu.ops.pallas._compat import is_tpu_backend
+
+            impl = os.environ.get("AIGW_RAGGED_PREFILL_IMPL", "").lower()
+            if impl not in ("xla", "pallas"):
+                impl = "pallas" if is_tpu_backend() else "xla"
+            self._ragged_impl = "" if impl == "xla" else "pallas"
+            ragged_impl = self._ragged_impl
+
+            def _prefill_ragged_step(params, lora, tokens, row_seq,
+                                     positions, last_rows, kv,
+                                     page_table, keys, temp, top_p,
+                                     top_k, bias, adapter_idx):
+                logits, kv = model_prefill_ragged(
+                    params, mc, tokens, row_seq, positions, last_rows,
+                    kv, page_table, ps, attn_impl=ragged_impl,
+                    lora=lora, adapter_idx=adapter_idx,
+                )
+                return _sample_maybe_lp(logits + bias, keys, temp,
+                                        top_p, top_k), kv
+
+            self._prefill_ragged_fn = self.compile_tracker.register(
+                "prefill_ragged",
+                jax.jit(_prefill_ragged_step, donate_argnums=(6,)))
         self._decode_scan_factory = _decode_scan
         self._spec_scan_factory = _spec_scan
         self._decode_fns: dict[tuple[int, bool, int], Callable] = {}
@@ -793,6 +869,11 @@ class Engine:
         # of the burst currently being admitted
         self._burst_seq = itertools.count(1)
         self._cur_burst: tuple[int, int] = (0, 0)
+        # prefill attention backend (tpuserve/attention.py): owns the
+        # prefill programs + geometry policy behind _admit's dispatch
+        from aigw_tpu.tpuserve.attention import make_attention_backend
+
+        self.attn = make_attention_backend(self)
 
     def _decode_fn_for(self, k: int, lean: bool = False,
                        draft: int = 0):
@@ -962,10 +1043,14 @@ class Engine:
         """Compile every decode-window program in the adaptive ladder —
         plain (lean + full) AND every nonzero draft rung of the
         speculative ladder — and, with warm_prefill_buckets > 0, the
-        batched-prefill group shapes for the smallest prompt buckets —
-        before traffic arrives (the first burst then pays zero XLA
-        compiles, and a mid-stream draft-rung transition never
-        compiles a verify program on the hot path)."""
+        attention backend's prefill surface (every (bucket, group)
+        rung on xla-bucketed; the handful of token-budget chunk rungs
+        on pallas-ragged — fewer programs, faster cold start) — before
+        traffic arrives (the first burst then pays zero XLA compiles,
+        and a mid-stream draft-rung transition never compiles a verify
+        program on the hot path). Records warmup_ms + the compiled
+        program count on EngineStats (/state: cold-start observables)."""
+        t0 = time.monotonic()
         for k in self._window_ladder():
             for lean in (True, False):
                 state = self._build_device_state()
@@ -990,11 +1075,9 @@ class Engine:
             self._spec_dirty.add(0)
             self._apply_spec_row_updates()
         self._device_state = saved
-        for b in range(self.cfg.warm_prefill_buckets):
-            if self.cfg.min_prefill_bucket << b > self.cfg.max_seq_len:
-                break
-            for S in self._bucket_rungs(b):
-                self._warm_prefill_shapes(S)
+        self.attn.warm()
+        self.stats.warmup_ms = round(1e3 * (time.monotonic() - t0), 3)
+        self.stats.warm_programs = self.compile_tracker.program_count()
 
     def _warm_prefill_shapes(self, S: int) -> None:
         """Run the prefill program for every power-of-two group size at
@@ -1239,8 +1322,12 @@ class Engine:
                 and n >= self.cfg.sp_prefill_min_tokens):
             return False, chain
         chunk = self.cfg.prefill_chunk_tokens
-        if (chunk > 0 and self.fns.prefill_suffix is not None
+        if (not self.attn.packs_long_prompts
+                and chunk > 0 and self.fns.prefill_suffix is not None
                 and n > chunk):
+            # the ragged backend packs long prompts itself (budget-split
+            # calls with decode ticks interleaved), so they stay
+            # batch-eligible there
             return False, chain
         if req.adapter and req.adapter not in self.adapter_rows:
             return False, chain  # singleton path surfaces the error
@@ -1265,132 +1352,44 @@ class Engine:
                 self.allocator.free(seq_id)
                 leftover = reqs[i:]
                 break
+            req.id = seq_id
             prepared.append((req, seq_id, n, total))
         count = 0
-        # group by padded bucket so each group is one compiled shape
-        groups: dict[int, list] = {}
-        for item in prepared:
-            groups.setdefault(self._prefill_bucket(item[2]),
-                              []).append(item)
-        for S, items in groups.items():
-            count += self._prefill_group(S, items, chain_by_req)
-        return count, leftover
-
-    def _prefill_group(self, S: int, items: list,
-                       chain_by_req: dict[int, list]) -> int:
-        """One [G2, S] prefill for a same-bucket group; G2 = G padded to
-        a power of two (compile-shape discipline: log2 batch shapes per
-        bucket, not one per group size). Padded rows have seq_len 0 —
-        their K/V scatters are dropped and their sampled token ignored."""
-        G = len(items)
-        G2 = 1
-        while G2 < G:
-            G2 *= 2
-        P = self.cfg.max_pages_per_seq
-        V = self.model_cfg.vocab_size
-        tokens = np.zeros((G2, S), np.int32)
-        seq_lens = np.zeros((G2,), np.int32)
-        pt = np.zeros((G2, P), np.int32)
-        keys = np.zeros((G2, 2), np.uint32)
-        temp = np.zeros((G2,), np.float32)
-        top_p = np.ones((G2,), np.float32)
-        top_k = np.zeros((G2,), np.int32)
-        bias = np.zeros((G2, V), np.float32)
-        adapter = np.full((G2,), self._base_row, np.int32)
-        t0 = time.monotonic()
-        burst_id, burst_size = self._cur_burst
-        for _req, _sid, _n, _tt in items:
-            qw = 1e3 * (t0 - _req.enqueued_at)
-            self.phases.observe(
-                "queue_wait", qw,
-                _req.trace.trace_id if _req.trace is not None else "")
-            if _req.trace is not None:
-                _req.trace.queue_wait(qw)
-                # batched = classified with no reusable prefix: a
-                # page-eligible prompt here is a cache miss by
-                # construction; short prompts never probed ("off")
-                _req.trace.admission(
-                    path="batched", burst_id=burst_id,
-                    burst_size=burst_size,
-                    prefix="miss" if chain_by_req.get(id(_req))
-                    else "off",
-                    bucket=S, padded_frac=round(1.0 - _n / S, 3))
-        for g, (req, seq_id, n, _total) in enumerate(items):
-            tokens[g, :n] = req.prompt
-            seq_lens[g] = n
-            pages = self.allocator.pages(seq_id)
-            pt[g, : len(pages)] = pages
-            req.id = seq_id
-            keys[g, 0] = np.uint32(
-                (req.sampling.seed or seq_id) & 0xFFFFFFFF)
-            temp[g] = req.sampling.temperature
-            top_p[g] = req.sampling.top_p
-            top_k[g] = req.sampling.top_k
-            for tok_id, b in req.sampling.logit_bias:
-                if 0 <= tok_id < V:
-                    bias[g, tok_id] = b
-            if req.adapter:
-                adapter[g] = self.adapter_rows[req.adapter]
-        next_tok, self.kv_cache = self._prefill_fn(
-            self.params, self.lora_params, jnp.asarray(tokens),
-            jnp.asarray(seq_lens), self.kv_cache, jnp.asarray(pt),
-            jnp.asarray(keys), jnp.asarray(temp), jnp.asarray(top_p),
-            jnp.asarray(top_k), jnp.asarray(bias), jnp.asarray(adapter))
-        if self.cfg.first_token_fast_path:
-            # token 0's device→host copy starts at dispatch and overlaps
-            # the prefill's remaining on-device compute (async-transfer
-            # machinery; values are identical to the blocking fetch)
-            self._start_host_copy(next_tok)
-        lp_data = None
-        if self.cfg.logprobs_topk and isinstance(next_tok, tuple):
-            next_tok, chosen, tk_ids, tk_vals = next_tok
-            lp_data = (np.asarray(chosen), np.asarray(tk_ids),
-                       np.asarray(tk_vals))
-        toks = np.asarray(next_tok)
-        prefill_ms = 1e3 * (time.monotonic() - t0)
-        self.stats.prefill_ms += prefill_ms
-        for _req, _sid, _n, _tt in items:
-            self.phases.observe(
-                "prefill", prefill_ms,
-                _req.trace.trace_id if _req.trace is not None else "")
-            if _req.trace is not None:
-                _req.trace.prefill(prefill_ms, bucket=S, group=G)
-        t_first = time.monotonic()
-        for g, (req, seq_id, n, total) in enumerate(items):
-            slot_idx = self._free_slot_index()
-            assert slot_idx is not None  # len(items) <= free slots
-            first_lp = None
-            if lp_data is not None:
-                chosen, tk_ids, tk_vals = lp_data
-                first_lp = (
-                    float(chosen[g]),
-                    [(int(t), float(v)) for t, v in zip(
-                        tk_ids[g], tk_vals[g])],
+        if prepared:
+            # the attention backend owns grouping + device calls
+            # (bucket groups on xla-bucketed, one token-budget pack on
+            # pallas-ragged); the engine owns slots + emission
+            results = self.attn.group_prefill(prepared, chain_by_req)
+            t_first = time.monotonic()
+            for r in results:
+                slot_idx = self._free_slot_index()
+                assert slot_idx is not None  # len(items) <= free slots
+                chain = chain_by_req.get(id(r.req), [])
+                if self.prefix_cache is not None and chain:
+                    # batched path = classified with no reusable prefix
+                    self.stats.prefix_cache_misses += 1
+                    self.prefix_cache.insert(
+                        chain, self.allocator.pages(r.seq_id),
+                        tokens=r.req.prompt)
+                self._slots[slot_idx] = _Slot(
+                    req=r.req, pos=r.n - 1, generated=0,
+                    key_seed=r.req.sampling.seed or r.seq_id,
+                    limit=r.total, page_row=r.page_row,
+                    adapter_row=r.adapter_row,
+                    ctrl=self._make_ctrl(r.req),
                 )
-            chain = chain_by_req.get(id(req), [])
-            if self.prefix_cache is not None and chain:
-                # batched path = classified with no reusable prefix
-                self.stats.prefix_cache_misses += 1
-                self.prefix_cache.insert(
-                    chain, self.allocator.pages(seq_id),
-                    tokens=req.prompt)
-            self._slots[slot_idx] = _Slot(
-                req=req, pos=n - 1, generated=0,
-                key_seed=req.sampling.seed or seq_id,
-                limit=total, page_row=pt[g], adapter_row=int(adapter[g]),
-                ctrl=self._make_ctrl(req),
-            )
-            self.stats.prefills += 1
-            self._mark_admitted(slot_idx)
-            t_m = time.monotonic()
-            self._emit_token(slot_idx, int(toks[g]), first_lp)
-            self.phases.observe(
-                "first_emit", 1e3 * (time.monotonic() - t_m),
-                req.trace.trace_id if req.trace is not None else "")
-        self.stats.first_emit_ms += 1e3 * (time.monotonic() - t_first)
-        logger.debug("batched prefill G=%d S=%d %.1fms", G, S,
-                     1e3 * (time.monotonic() - t0))
-        return len(items)
+                self.stats.prefills += 1
+                self._mark_admitted(slot_idx)
+                t_m = time.monotonic()
+                self._emit_token(slot_idx, r.tok, r.first_lp)
+                self.phases.observe(
+                    "first_emit", 1e3 * (time.monotonic() - t_m),
+                    r.req.trace.trace_id if r.req.trace is not None
+                    else "")
+            self.stats.first_emit_ms += 1e3 * (
+                time.monotonic() - t_first)
+            count = len(results)
+        return count, leftover
 
     def _mark_admitted(self, i: int) -> None:
         """Mark slot i for an incremental row upload into the live
@@ -1531,7 +1530,6 @@ class Engine:
             jnp.asarray([adapter_row], jnp.int32),
         )
         t0 = time.monotonic()
-        tick_ms = 0.0  # decode time interleaved into the chunk loop
         # pow2 page bucket covering the sequence — the gather window
         # of suffix/chunked steps, not the full max_seq_len window
         need = self.allocator.pages_for(total)
@@ -1540,90 +1538,16 @@ class Engine:
             bucket *= 2
         bucket = min(bucket, self.cfg.max_pages_per_seq)
 
-        # chunked prefill: long prompts run as fixed-size suffix
-        # steps so no giant bucket is ever compiled and a decode
-        # tick runs between chunks — active streams keep emitting
-        # behind a long prompt instead of stalling for its whole
-        # prefill (vLLM-style chunked prefill; the prefill_suffix
-        # kernel with prefix_lens=consumed IS the chunk step)
-        chunk = self.cfg.prefill_chunk_tokens
-        consumed = 0
-        if (chunk > 0 and not use_sp
-                and self.fns.prefill_suffix is not None
-                and ns > chunk):
-            # loop-invariant device uploads hoisted; each boundary
-            # is also a cancellation/shutdown yield point — exactly
-            # what chunking exists to provide
-            pt_dev = jnp.asarray(pt[:, :bucket])
-            ctokens = np.zeros((1, chunk), np.int32)
-            aborted = False
-            while ns - consumed > chunk:
-                if req.cancelled.is_set() or self._stop.is_set():
-                    aborted = True
-                    break
-                ctokens[0, :] = suffix[consumed:consumed + chunk]
-                _, self.kv_cache = self._prefill_suffix_fn(
-                    self.params,
-                    self.lora_params,
-                    jnp.asarray(ctokens),
-                    jnp.asarray([prefix_len + consumed], jnp.int32),
-                    jnp.asarray([prefix_len + consumed + chunk],
-                                jnp.int32),
-                    self.kv_cache,
-                    pt_dev,
-                    *sampling_args,
-                )
-                consumed += chunk
-                self.stats.chunked_prefill_steps += 1
-                if req.trace is not None:
-                    req.trace.event("prefill_chunk", tokens=chunk,
-                                    consumed=prefix_len + consumed)
-                # interleave: active streams keep decoding between
-                # chunks (their windows overlap this chunk's compute)
-                t_tick = time.monotonic()
-                self._decode_tick()
-                tick_ms += 1e3 * (time.monotonic() - t_tick)
-            if aborted:
-                self.allocator.free(seq_id)
-                if self._stop.is_set():
-                    # graceful stop mid-prompt: hand it back like an
-                    # OutOfPages retry; the drain path settles it
-                    if not req.cancelled.is_set():
-                        return "stop"
-                    return "stop_consumed"
-                return "skipped"  # cancelled: next queued request
-
-        eff_prefix = prefix_len + consumed
-        tail = suffix[consumed:]
-        ns_tail = len(tail)
-        # bucketed padded length for the remaining tokens
-        S = self._prefill_bucket(ns_tail)
-        if use_sp and S % self._sp:
-            # ring attention shards the padded length over sp — round
-            # the bucket up to a multiple of sp (non-power-of-two sp
-            # like 6 must not silently disable the path)
-            S = -(-S // self._sp) * self._sp
-        tokens = np.zeros((1, S), np.int32)
-        tokens[0, :ns_tail] = tail
-
-        if prefix_len:
-            self.stats.prefix_cache_hits += 1
-            self.stats.prefix_tokens_reused += prefix_len
-        elif chain_keys:
-            # page-eligible prompt, nothing reusable cached
-            self.stats.prefix_cache_misses += 1
-        if eff_prefix:
-            next_tok, self.kv_cache = self._prefill_suffix_fn(
-                self.params,
-                self.lora_params,
-                jnp.asarray(tokens),
-                jnp.asarray([eff_prefix], jnp.int32),
-                jnp.asarray([n], jnp.int32),
-                self.kv_cache,
-                jnp.asarray(pt[:, :bucket]),
-                *sampling_args,
-            )
-        elif use_sp:
+        if use_sp:
+            S = self._prefill_bucket(ns)
+            if S % self._sp:
+                # ring attention shards the padded length over sp —
+                # round the bucket up to a multiple of sp
+                # (non-power-of-two sp like 6 must not silently
+                # disable the path)
+                S = -(-S // self._sp) * self._sp
+            tokens = np.zeros((1, S), np.int32)
+            tokens[0, :ns] = suffix
             self.stats.sp_prefills += 1
             next_tok, self.kv_cache = self._prefill_sp_fn(
                 self.params,
@@ -1634,16 +1558,34 @@ class Engine:
                 jnp.asarray(pt),
                 *sampling_args,
             )
+            self.stats.prefill_tokens_real += ns
+            self.stats.prefill_tokens_padded += S
+            info = {"consumed": 0, "tick_ms": 0.0, "bucket": S,
+                    "chunks": 0,
+                    "padded_frac": round(1.0 - ns / S, 3) if S else 0.0}
         else:
-            next_tok, self.kv_cache = self._prefill_fn(
-                self.params,
-                self.lora_params,
-                jnp.asarray(tokens),
-                jnp.asarray([n], jnp.int32),
-                self.kv_cache,
-                jnp.asarray(pt),
-                *sampling_args,
-            )
+            # the attention backend runs the prompt: bucketed chunk
+            # loop + padded tail on xla-bucketed, token-budget packed
+            # calls on pallas-ragged — both resume at prefix_len and
+            # interleave decode ticks at their boundaries
+            res = self.attn.single_prefill(
+                req, seq_id, suffix, prefix_len, n, total, pt, bucket,
+                sampling_args)
+            if isinstance(res, str):
+                # cancelled / engine stopping mid-prompt: hand it back
+                # like an OutOfPages retry ("stop") or consume it
+                self.allocator.free(seq_id)
+                return res
+            next_tok, info = res
+        tick_ms = info["tick_ms"]
+        eff_prefix = prefix_len + info["consumed"]
+
+        if prefix_len:
+            self.stats.prefix_cache_hits += 1
+            self.stats.prefix_tokens_reused += prefix_len
+        elif chain_keys:
+            # page-eligible prompt, nothing reusable cached
+            self.stats.prefix_cache_misses += 1
         if self.cfg.first_token_fast_path:
             # start token 0's host copy under the prefill's compute
             self._start_host_copy(next_tok)
@@ -1664,16 +1606,16 @@ class Engine:
             req.trace.trace_id if req.trace is not None else "")
         if req.trace is not None:
             req.trace.prefill(
-                prefill_ms, bucket=S,
-                padded_frac=round(1.0 - ns_tail / S, 3) if S else 0.0,
-                chunks=consumed // chunk if chunk else 0,
+                prefill_ms, bucket=info["bucket"],
+                padded_frac=info["padded_frac"],
+                chunks=info["chunks"],
                 resumed_at=eff_prefix, sp=use_sp)
         t_first = time.monotonic()
         if self.prefix_cache is not None and chain_keys:
             self.prefix_cache.insert(chain_keys, pages,
                                      tokens=req.prompt)
         logger.debug("prefill seq=%d len=%d prefix=%d bucket=%d %.1fms",
-                     seq_id, n, prefix_len, S,
+                     seq_id, n, prefix_len, info["bucket"],
                      1e3 * (time.monotonic() - t0))
 
         # speculative draft sources for the new slot: the adaptive
@@ -2127,6 +2069,19 @@ class Engine:
             # finish the window computed under the old state first
             self._drain_inflight()
             self._apply_frees()
+            # that drain may have emitted stop/length finishes: rebuild
+            # membership from the slots that actually survived (a stale
+            # tick-entry index here dereferenced a freed slot and threw
+            # the whole engine into _abort_all)
+            active_idx = [i for i, s in enumerate(self._slots)
+                          if s is not None]
+            if not active_idx:
+                self._device_state = None
+                self._dirty_rows.clear()
+                self._spec_dirty.clear()
+                self.stats.active_slots = 0
+                self._refresh_stats()
+                return True
             self._device_state = self._build_device_state()
             self._need_rebuild = False
             self._dirty_rows.clear()
@@ -2258,6 +2213,10 @@ class Engine:
 
     def _refresh_stats(self) -> None:
         self.stats.queued = self._queue.qsize()
+        if self.stats.prefill_tokens_padded:
+            self.stats.prefill_padded_frac = round(
+                1.0 - self.stats.prefill_tokens_real
+                / self.stats.prefill_tokens_padded, 4)
         self.stats.xla_compiles = self.compile_tracker.compiles()
         self.stats.xla_compile_ms = round(
             self.compile_tracker.compiles_total_ms(), 3)

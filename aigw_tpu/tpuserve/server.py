@@ -1267,6 +1267,18 @@ class TPUServeServer:
                 "transfer_ms": round(s.transfer_ms, 3),
                 "emit_ms": round(s.emit_ms, 3),
                 "first_emit_ms": round(s.first_emit_ms, 3),
+                # prefill attention backend + its padding tax (ISSUE 6):
+                # real prompt tokens vs tokens the padded program
+                # geometry processed; the ragged backend's claim is
+                # padded_frac ≈ chunk residue instead of bucket residue
+                "attention_backend": self.engine.attn.name,
+                "prefill_tokens_real": s.prefill_tokens_real,
+                "prefill_tokens_padded": s.prefill_tokens_padded,
+                "prefill_padded_frac": s.prefill_padded_frac,
+                # cold-start observables: wall time of warmup() and the
+                # compiled hot-path program count it left behind
+                "warmup_ms": s.warmup_ms,
+                "warm_programs": s.warm_programs,
                 # prefix-cache surface: the picker's prefix-affinity
                 # scoring and capacity dashboards read these
                 "prefix_cache_hit_rate": round(s.prefix_cache_hit_rate, 4),
@@ -1393,6 +1405,8 @@ async def run_tpuserve(
     spec_tokens: int = 0,
     spec_adaptive: bool = True,
     pallas_attn: bool = False,
+    attention_backend: str = "xla-bucketed",
+    ragged_chunk_tokens: int = 256,
     logprobs_topk: int = 0,
     adaptive_decode_window: bool = True,
     async_transfers: bool = True,
@@ -1416,6 +1430,8 @@ async def run_tpuserve(
             spec_tokens=spec_tokens,
             spec_adaptive=spec_adaptive,
             pallas_attn=pallas_attn,
+            attention_backend=attention_backend,
+            ragged_chunk_tokens=ragged_chunk_tokens,
             logprobs_topk=logprobs_topk,
             adaptive_decode_window=adaptive_decode_window,
             async_transfers=async_transfers,

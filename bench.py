@@ -560,11 +560,22 @@ def gateway_numbers(model_name: str, cfg, quantize: str, batch=BATCH,
         # for TTFT and per-token latency come from the serving path's
         # own distributions, not recomputed from the client's samples
         phase_pct: dict = {}
+        warm_fields: dict = {}
         try:
             async with aiohttp.ClientSession() as s:
                 async with s.get(serve_url + "/state") as r:
-                    phase_pct = (await r.json()).get(
-                        "phase_percentiles", {})
+                    st = await r.json()
+                    phase_pct = st.get("phase_percentiles", {})
+                    # warmup cost of the serve replica (ISSUE 6): the
+                    # "collapsed compile surface = faster cold start"
+                    # claim is measured, not asserted
+                    warm_fields = {
+                        "serve_warmup_ms": st.get("warmup_ms", 0.0),
+                        "serve_warm_programs": st.get(
+                            "warm_programs", 0),
+                        "serve_attention_backend": st.get(
+                            "attention_backend", ""),
+                    }
         except aiohttp.ClientError:
             pass
         return {
@@ -575,6 +586,7 @@ def gateway_numbers(model_name: str, cfg, quantize: str, batch=BATCH,
             "gateway_tps_spread": round(_spread(g_tps), 3),
             "direct_tps_spread": round(_spread(d_tps), 3),
             "serve_phase_percentiles": phase_pct,
+            **warm_fields,
         }
 
     try:
@@ -927,6 +939,171 @@ def spec_decode_numbers(reps: int = 3, requests_per_rep: int = 4,
             stop()
 
 
+# -- ragged_prefill leg: attention-backend A/B (ISSUE 6) -----------------
+
+# Leg model: tiny llama with a 2048 sequence budget so the mixed-length
+# burst can carry a real long prompt. Page 64 keeps the ragged XLA
+# fallback's per-page window loop short on the CPU host.
+_RAGGED_CFG = llama.LlamaConfig(
+    vocab_size=2048, dim=256, n_layers=4, n_heads=8, n_kv_heads=4,
+    ffn_dim=512, max_seq_len=2048, rope_theta=10000.0,
+)
+_RAGGED_PAGE = 64
+#: the burst's prompt lengths in TOKENS (byte tokenizer: chars + bos).
+#: Five ~97-token chat-sized prompts — on the bucket ladder they share
+#: the 128 bucket, so the batched group pads 5 rows to 8 — plus one
+#: 1024-token prompt. Total 1509 tokens: the ragged pack runs ONE
+#: 1536-wide program (chunk-residue padding only).
+_RAGGED_MIX = (97, 97, 97, 97, 97, 1024)
+
+
+async def _drive_ragged_burst(s, url: str, model: str,
+                              gen_tokens: int, tag: str) -> list[float]:
+    """Fire the mixed-length burst CONCURRENTLY (one coalesced
+    admission) as /v1/completions streams; returns per-request TTFT ms
+    (first content delta on the wire)."""
+
+    async def one(n_tokens: int, i: int) -> float:
+        text = (f"{tag}{i:02d}" + "x" * n_tokens)[: n_tokens - 1]
+        payload = {
+            "model": model,
+            "prompt": text,
+            "max_tokens": gen_tokens,
+            "temperature": 0.0,
+            "stream": True,
+            "logit_bias": {"97": 100},
+        }
+        t0 = time.perf_counter()
+        first = -1.0
+        async with s.post(url + "/v1/completions", json=payload) as resp:
+            assert resp.status == 200, resp.status
+            while True:
+                line = await resp.content.readline()
+                if not line:
+                    break
+                line = line.strip()
+                if not line.startswith(b"data: "):
+                    continue
+                data = line[6:]
+                if data == b"[DONE]":
+                    break
+                ev = json.loads(data)
+                ch = ev.get("choices") or []
+                if ch and ch[0].get("text"):
+                    if first < 0:
+                        first = (time.perf_counter() - t0) * 1000.0
+        return first
+
+    return list(await asyncio.gather(
+        *(one(n, i) for i, n in enumerate(_RAGGED_MIX))))
+
+
+def _ragged_ab_fields(st0: dict, st1: dict, prefix: str) -> dict:
+    """One child's padding-tax + compile telemetry over a capture,
+    derived from /state deltas (pure — unit-tested by the bench
+    smoke)."""
+    real = (st1.get("prefill_tokens_real", 0)
+            - st0.get("prefill_tokens_real", 0))
+    padded = (st1.get("prefill_tokens_padded", 0)
+              - st0.get("prefill_tokens_padded", 0))
+    return {
+        f"{prefix}_padded_frac": (round(1.0 - real / padded, 4)
+                                  if padded > 0 else 0.0),
+        f"{prefix}_prefill_tokens": real,
+        f"{prefix}_warm_programs": st1.get("warm_programs", 0),
+        f"{prefix}_warmup_ms": st1.get("warmup_ms", 0.0),
+        f"{prefix}_hot_compiles": (st1.get("xla_compiles", 0)
+                                   - st0.get("xla_compiles", 0)),
+    }
+
+
+def ragged_prefill_numbers(reps: int = 3, gen_tokens: int = 8) -> dict:
+    """The ``ragged_prefill`` A/B leg: the same mixed-length admission
+    burst (five ~97-token prompts + one 1024-token prompt, fired
+    concurrently so the engine coalesces them) against TWO tpuserve
+    children — attention backend pallas-ragged vs xla-bucketed — with
+    reps interleaved so host drift cancels. What it measures:
+
+    - ``padded_frac`` per backend from the /state token counters: the
+      bucketed ladder pays per-sequence bucket padding PLUS the
+      batched group's pow2 row padding (5 same-bucket prompts pad to
+      8 rows); the ragged pack pays only the token-budget chunk
+      residue of the burst total.
+    - warm-path compile surface: ``warm_programs`` after warmup (the
+      ragged rung ladder vs every (bucket, group) shape), ``warmup_ms``
+      cold-start cost, and zero hot compiles over the timed reps.
+    - TTFT medians for reference. NOTE: on this CPU host the ragged
+      child runs the XLA windowed fallback, whose page loop walks the
+      full 2048-token window — absolute TTFT is NOT the claim here
+      (the DMA-skip kernel only exists on TPU); padded compute and
+      compile surface are."""
+    import aiohttp
+
+    model_name = "bench-ragged-tiny"
+    engine_common = {
+        "min_prefill_bucket": 32, "num_pages": 56,
+        "max_queued_requests": 64, "kv_cache_dtype": "float32",
+        "enable_prefix_cache": False,
+        # the quantity under test is one coalesced burst's geometry —
+        # give the 6 concurrent submits a wider idle-coalesce window so
+        # event-loop scheduling jitter can't split the burst (both
+        # children identical; the wait cancels from the A/B)
+        "admission_coalesce_ms": 20.0,
+    }
+    url_rag, stop_rag = _start_tpuserve_subproc(
+        model_name, _RAGGED_CFG, "", batch=8,
+        k_steps=int(os.environ.get("AIGW_BENCH_CPU_K", "4")),
+        engine=dict(engine_common, attention_backend="pallas-ragged"),
+        page=_RAGGED_PAGE, param_dtype="float32")
+    url_bkt, stop_bkt = _start_tpuserve_subproc(
+        model_name, _RAGGED_CFG, "", batch=8,
+        k_steps=int(os.environ.get("AIGW_BENCH_CPU_K", "4")),
+        engine=dict(engine_common, attention_backend="xla-bucketed"),
+        page=_RAGGED_PAGE, param_dtype="float32")
+
+    async def run() -> dict:
+        await _wait_health(url_rag, 1200)
+        await _wait_health(url_bkt, 1200)
+        timeout = aiohttp.ClientTimeout(total=1200)
+        async with aiohttp.ClientSession(timeout=timeout) as s:
+            # off-the-clock warm pass: compiles every shape either leg
+            # dispatches beyond the warmed ladders (decode page-bucket
+            # growth for the 1024-token stream, singleton group shapes)
+            for url in (url_rag, url_bkt):
+                await _drive_ragged_burst(s, url, model_name,
+                                          gen_tokens, "w")
+            st_rag0 = await _get_state(s, url_rag)
+            st_bkt0 = await _get_state(s, url_bkt)
+            rag_t, bkt_t = [], []
+            for rep in range(reps):
+                rag_t.extend(await _drive_ragged_burst(
+                    s, url_rag, model_name, gen_tokens, f"r{rep}"))
+                bkt_t.extend(await _drive_ragged_burst(
+                    s, url_bkt, model_name, gen_tokens, f"b{rep}"))
+            st_rag1 = await _get_state(s, url_rag)
+            st_bkt1 = await _get_state(s, url_bkt)
+        rag = _median([t for t in rag_t if t > 0])
+        bkt = _median([t for t in bkt_t if t > 0])
+        return {
+            "ragged_ttft_ms_p50": round(rag, 1),
+            "bucketed_ttft_ms_p50": round(bkt, 1),
+            "ragged_vs_bucketed_ttft": (round(rag / bkt, 4)
+                                        if bkt else 0.0),
+            "ragged_backend": st_rag1.get("attention_backend", ""),
+            "ragged_ttft_spread": round(_spread(rag_t), 3),
+            "bucketed_ttft_spread": round(_spread(bkt_t), 3),
+            "ragged_ab_reps": reps * len(_RAGGED_MIX),
+            **_ragged_ab_fields(st_rag0, st_rag1, "ragged"),
+            **_ragged_ab_fields(st_bkt0, st_bkt1, "bucketed"),
+        }
+
+    try:
+        return asyncio.run(run())
+    finally:
+        stop_rag()
+        stop_bkt()
+
+
 def _chip_responsive(timeout_s: float = 180.0) -> bool:
     """The axon tunnel can go down entirely (observed 2026-07-28); probe
     with a watchdog so the bench prints an honest line instead of hanging
@@ -1093,6 +1270,11 @@ def run_cpu_ratio() -> dict:
     except Exception as e:
         print(f"spec_decode leg failed: {type(e).__name__}: {e}",
               file=sys.stderr)
+    try:
+        res.update(ragged_prefill_numbers())
+    except Exception as e:
+        print(f"ragged_prefill leg failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
     return res
 
 
@@ -1170,9 +1352,20 @@ def main() -> None:
                 "accept) and forced low-acceptance leg (adaptive "
                 "ladder collapses to plain decode); the tok/s ratios "
                 "are the signal, absolute tok/s is not")
+        elif target == "ragged_prefill":
+            result = ragged_prefill_numbers()
+            result["metric"] = (
+                "ragged_prefill interleaved A/B — attention backend "
+                "pallas-ragged vs xla-bucketed on the same "
+                "mixed-length admission burst (5×~97 + 1×1024 tokens) "
+                "on the CPU backend: padded_frac (padding tax) and the "
+                "warm compile surface are the signal; absolute TTFT "
+                "is not (the CPU child runs the XLA windowed fallback, "
+                "not the DMA-skip kernel)")
         else:
             print(json.dumps({"error": f"unknown --ab target {target!r}; "
-                              "supported: prefix_cache, spec_decode"}))
+                              "supported: prefix_cache, spec_decode, "
+                              "ragged_prefill"}))
             return
         print(json.dumps(result))
         return

@@ -134,3 +134,23 @@ def test_bench_spec_engine_stats_live():
         assert eng.stats.state_rebuilds == 0
     finally:
         eng.stop()
+
+
+@pytest.mark.bench_smoke
+def test_bench_ragged_ab_fields():
+    """The --ab ragged_prefill JSON derives its padding-tax + compile
+    telemetry from /state deltas through this pure helper: padded_frac
+    must come from the token-counter deltas (not absolutes), warmup
+    fields pass through, and an empty capture degrades to zeros."""
+    st0 = {"prefill_tokens_real": 1000, "prefill_tokens_padded": 1200,
+           "xla_compiles": 7, "warm_programs": 11, "warmup_ms": 900.0}
+    st1 = {"prefill_tokens_real": 2509, "prefill_tokens_padded": 2736,
+           "xla_compiles": 7, "warm_programs": 11, "warmup_ms": 900.0}
+    f = bench._ragged_ab_fields(st0, st1, "ragged")
+    assert f["ragged_prefill_tokens"] == 1509
+    assert f["ragged_padded_frac"] == round(1.0 - 1509 / 1536, 4)
+    assert f["ragged_hot_compiles"] == 0
+    assert f["ragged_warm_programs"] == 11
+    assert f["ragged_warmup_ms"] == 900.0
+    z = bench._ragged_ab_fields(st1, st1, "b")
+    assert z["b_padded_frac"] == 0.0 and z["b_prefill_tokens"] == 0

@@ -302,3 +302,88 @@ def test_spec_verify_ladder_warm_no_hot_compiles():
             "speculative traffic paid an XLA compile after warmup")
     finally:
         eng.stop()
+
+
+# -- ragged attention backend (ISSUE 6) ----------------------------------
+
+RAGGED_STATE_FIELDS = (
+    "attention_backend",
+    "prefill_tokens_real",
+    "prefill_tokens_padded",
+    "prefill_padded_frac",
+    "warmup_ms",
+    "warm_programs",
+)
+
+RAGGED_GAUGES = (
+    "tpuserve_prefill_tokens_real_total",
+    "tpuserve_prefill_tokens_padded_total",
+    "tpuserve_prefill_padded_frac",
+    "tpuserve_warmup_ms",
+    "tpuserve_warm_programs",
+)
+
+
+def test_state_and_metrics_export_padding_fields(smoke_url):
+    """The padding-tax + cold-start surface (ISSUE 6) must appear on
+    /state and /metrics — a renamed EngineStats field silently drops
+    the ragged backend's headline observable."""
+    state = json.loads(asyncio.run(_get(smoke_url, "/state")))
+    for field in RAGGED_STATE_FIELDS:
+        assert field in state, f"/state lost {field}"
+    assert state["attention_backend"] in ("xla-bucketed",
+                                          "pallas-ragged")
+    text = asyncio.run(_get(smoke_url, "/metrics")).decode()
+    for gauge in RAGGED_GAUGES:
+        assert gauge in text, f"/metrics lost {gauge}"
+
+
+def test_ragged_backend_zero_hot_compiles_any_geometry():
+    """Compile-on-hot-path tripwire for the ragged backend (ISSUE 6):
+    after warmup() compiles the token-budget rung ladder, mixed-length
+    admissions at ANY geometry under the warmed budget — lone short
+    prompts, coalesced mixed bursts, totals crossing a budget boundary
+    mid-sequence — must add ZERO XLA/Mosaic compiles. One 64-token
+    page keeps the decode bucket at the warmup size, so any compile
+    counted here is a real rung-ladder gap, not page-bucket growth."""
+    spec_cfg = llama.TINY
+    params = llama.init_params(jax.random.PRNGKey(0), spec_cfg)
+    eng = Engine(params, spec_cfg, EngineConfig(
+        max_batch_size=4, max_seq_len=64, page_size=64,
+        min_prefill_bucket=16, decode_steps_per_tick=4,
+        attention_backend="pallas-ragged", ragged_chunk_tokens=16,
+        ragged_max_chunks=3, warm_prefill_buckets=1,
+        enable_prefix_cache=False))
+    assert eng.attn.name == "pallas-ragged"
+    eng.warmup()
+    assert eng.stats.warm_programs > 0
+    assert eng.stats.warmup_ms > 0
+    checkpoint = eng.compile_tracker.checkpoint()
+    eng.start()
+    try:
+        # distinct geometries: lone tiny prompt, mixed burst, a burst
+        # whose 88-token total crosses the 48-token budget twice
+        # (mid-sequence continuations), and a repeat shape
+        bursts = [
+            [[7, 8, 9]],
+            [[1, 2, 3, 4, 5], [9] * 17, [4] * 29],
+            [[3] * 40, [5] * 31, [6] * 11, [7] * 6],
+            [[2] * 23],
+        ]
+        for prompts in bursts:
+            events = []
+            for p in prompts:
+                done = threading.Event()
+                eng.submit(GenRequest(
+                    prompt=p, max_tokens=4,
+                    sampling=SamplingParams(temperature=0.0),
+                    emit=lambda t, f, d=done: d.set() if f else None))
+                events.append(done)
+            for e in events:
+                assert e.wait(timeout=300)
+        assert eng.stats.prefill_tokens_padded > 0
+        assert eng.compile_tracker.compiles_since(checkpoint) == 0, (
+            f"ragged admissions paid a compile after warmup: "
+            f"{eng.compile_tracker.programs()}")
+    finally:
+        eng.stop()

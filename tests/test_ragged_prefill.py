@@ -1,0 +1,262 @@
+"""Ragged paged-attention prefill (ISSUE 6): the pallas-ragged
+attention backend must produce BYTE-IDENTICAL token streams to the
+xla-bucketed ladder in the deterministic f32 rig (params + KV cache in
+float32 — see tests/test_chunked_prefill.py's tie-vs-state-bug
+post-mortem for why f32 makes greedy equivalence deterministic), across
+every admission shape the backend changes:
+
+- mixed-length batched bursts packed into one token-budget program
+  (including penalized and logit-biased slots),
+- token-budget boundaries splitting a sequence mid-prompt (the chunked
+  prefill continuation as a packed start offset),
+- prefix-cache partial hits (offset-resumed prefill) and full hits
+  (single-token CoW resume),
+- speculating slots (the decode path is untouched, but its KV was
+  written by the ragged prefill).
+
+Plus the geometry units: the token-budget rung ladder, the padded-frac
+accounting both backends report, and the `_prefill_bucket` boundary
+behavior near max_seq_len (the satellite bugfix: a prompt at a capped
+rung must never select a bucket > max_seq_len, and every selectable
+bucket must be on the warmable rung ladder).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aigw_tpu.models import llama
+from aigw_tpu.models.registry import get_model_spec
+from aigw_tpu.tpuserve.engine import Engine, EngineConfig, GenRequest
+from aigw_tpu.tpuserve.sampling import SamplingParams
+
+_SPEC = get_model_spec("tiny-random")
+_PARAMS_F32 = llama.init_params(jax.random.PRNGKey(7), _SPEC.config,
+                                jnp.float32)
+
+
+def _engine(backend: str, **over) -> Engine:
+    # adaptive_decode_window off halves the decode programs each
+    # throwaway engine compiles (tier-1 time budget); both backends run
+    # the same config so equivalence is unaffected
+    cfg = dict(max_batch_size=4, max_seq_len=512, page_size=16,
+               min_prefill_bucket=16, decode_steps_per_tick=4,
+               prefill_chunk_tokens=64, kv_cache_dtype="float32",
+               attention_backend=backend, ragged_chunk_tokens=32,
+               ragged_max_chunks=4, adaptive_decode_window=False)
+    cfg.update(over)
+    return Engine(_PARAMS_F32, _SPEC.config, EngineConfig(**cfg))
+
+
+def _burst(eng: Engine, prompts: list[list[int]],
+           sps: list[SamplingParams] | None = None,
+           n: int = 5) -> list[list[int]]:
+    """Submit all prompts before the engine coalesces, wait for all."""
+    sps = sps or [SamplingParams(temperature=0.0)] * len(prompts)
+    events, results = [], []
+    for p, sp in zip(prompts, sps):
+        done = threading.Event()
+        toks: list[int] = []
+
+        def emit(t, f, toks=toks, done=done):
+            if t >= 0:
+                toks.append(t)
+            if f is not None:
+                done.set()
+
+        eng.submit(GenRequest(prompt=p, max_tokens=n, sampling=sp,
+                              emit=emit))
+        events.append(done)
+        results.append(toks)
+    for e in events:
+        assert e.wait(timeout=900)
+    return results
+
+
+def _ab(run, **engine_over):
+    """Run `run(engine)` on both backends, return (xla, ragged)."""
+    out = {}
+    for be in ("xla-bucketed", "pallas-ragged"):
+        eng = _engine(be, **engine_over)
+        eng.start()
+        try:
+            out[be] = run(eng)
+            # regression guard: the fixed-window mixed burst used to
+            # crash the engine thread (rebuild-drain finishing a slot
+            # whose stale index _decode_tick then dereferenced) — the
+            # streams above would still "pass" via the error path
+            # without this check
+            assert eng.healthy, eng.last_error
+        finally:
+            eng.stop()
+    return out["xla-bucketed"], out["pallas-ragged"]
+
+
+_RNG = np.random.RandomState(11)
+_PROMPTS = {
+    L: _RNG.randint(1, 500, L).tolist() for L in (7, 30, 90, 96, 150)
+}
+
+
+def test_mixed_burst_byte_identical_and_cheaper_padding():
+    """One mixed-length burst — greedy, penalized, and logit-biased
+    slots — packs into token-budget ragged calls (the 150-token prompt
+    crosses the 128-token budget mid-sequence) and must stream the
+    same bytes as the bucket ladder, at a strictly lower padded
+    fraction."""
+    prompts = [_PROMPTS[7], _PROMPTS[30], _PROMPTS[90], _PROMPTS[150]]
+    sps = [SamplingParams(temperature=0.0),
+           SamplingParams(temperature=0.0, frequency_penalty=0.7),
+           SamplingParams(temperature=0.0, logit_bias=((42, 2.0),)),
+           SamplingParams(temperature=0.0)]
+    fracs = {}
+
+    def run(eng):
+        out = _burst(eng, prompts, sps)
+        assert eng.stats.prefill_tokens_padded > 0
+        fracs[eng.attn.name] = (
+            1.0 - eng.stats.prefill_tokens_real
+            / eng.stats.prefill_tokens_padded)
+        return out
+
+    xla, ragged = _ab(run)
+    assert xla == ragged
+    assert fracs["pallas-ragged"] < fracs["xla-bucketed"]
+
+
+def test_prefix_hits_partial_and_full_byte_identical():
+    """One engine pair covers both cache-resume shapes: a partial hit
+    (shared ≥1-page prefix, ragged resumes as a packed segment with a
+    nonzero start position) and an exact page-aligned re-ask full hit
+    (prompt prefill skipped, 1-token resume — on the ragged backend a
+    1-token packed call at the smallest rung)."""
+    base = _PROMPTS[96]  # 96 = 6 pages at page_size 16
+    resumed = base[:64] + _PROMPTS[30][:12]
+
+    def run(eng):
+        first = _burst(eng, [base])
+        assert eng.stats.prefix_cache_hits == 0
+        second = _burst(eng, [resumed])
+        assert eng.stats.prefix_cache_hits == 1, "partial hit not taken"
+        assert eng.stats.prefix_tokens_reused >= 48
+        third = _burst(eng, [base])  # exact re-ask → full hit
+        assert eng.stats.prefix_full_hits == 1, "full hit not taken"
+        return first + second + third
+
+    xla, ragged = _ab(run)
+    assert xla == ragged
+
+
+def test_speculating_slots_byte_identical():
+    """Speculative decoding rides the ragged-prefilled KV: repetitive
+    prompts draft+accept through the verify ladder on both backends
+    and the streams must still match byte for byte."""
+    rep = [5, 6, 7, 8] * 12  # n-gram friendly
+
+    def run(eng):
+        out = _burst(eng, [rep, _PROMPTS[30]], n=12)
+        return out
+
+    xla, ragged = _ab(run, spec_tokens=4)
+    assert xla == ragged
+
+
+def test_ragged_rung_ladder_and_packing_accounting():
+    eng = _engine("pallas-ragged")
+    try:
+        att = eng.attn
+        assert att.name == "pallas-ragged"
+        # chunk 32, max 4 chunks: two sub-chunk rungs + chunk multiples
+        assert att.rungs() == [8, 16, 32, 64, 96, 128]
+        assert att.budget == 128
+        for t, want in ((1, 8), (8, 8), (9, 16), (33, 64), (128, 128)):
+            assert att._rung_for(t) == want
+        eng.start()
+        # 7 + 30 = 37 packed tokens → one 64-rung call
+        _burst(eng, [_PROMPTS[7], _PROMPTS[30]], n=2)
+        assert eng.stats.prefill_tokens_real == 37
+        assert eng.stats.prefill_tokens_padded == 64
+        eng._refresh_stats()
+        assert eng.stats.prefill_padded_frac == pytest.approx(
+            1 - 37 / 64, abs=1e-3)
+    finally:
+        eng.stop()
+
+
+def test_ragged_backend_falls_back_without_model_support():
+    """A family without a ragged prefill entry point must fall back to
+    xla-bucketed (logged), not crash."""
+    from aigw_tpu.models.registry import family_fns
+
+    fns = family_fns("llama")
+    import dataclasses
+
+    eng = Engine(_PARAMS_F32, _SPEC.config,
+                 EngineConfig(max_batch_size=2, max_seq_len=256,
+                              page_size=16, min_prefill_bucket=16,
+                              attention_backend="pallas-ragged"),
+                 fns=dataclasses.replace(fns, prefill_ragged=None))
+    assert eng.attn.name == "xla-bucketed"
+
+
+def test_attention_backend_validated():
+    with pytest.raises(ValueError):
+        EngineConfig(attention_backend="flash-v9")
+
+
+# -- satellite: _prefill_bucket boundary behavior near max_seq_len -------
+
+def _bucket_probe(min_bucket: int, max_seq: int, rungs: int):
+    """A lightweight engine whose cfg is mutated per combo — the bucket
+    helpers read only cfg fields."""
+    eng = _engine("xla-bucketed")
+    eng.cfg.min_prefill_bucket = min_bucket
+    eng.cfg.max_seq_len = max_seq
+    eng.cfg.prefill_bucket_rungs = rungs
+    return eng
+
+
+@pytest.mark.parametrize("min_bucket,max_seq,rungs", [
+    (64, 96, 2), (64, 112, 4), (64, 160, 2), (64, 192, 4),
+    (16, 208, 2), (64, 48, 2),  # max_seq BELOW the smallest bucket
+    (32, 512, 1), (32, 500, 4),
+])
+def test_prefill_bucket_boundary_capped(min_bucket, max_seq, rungs):
+    """A prompt at ANY length up to max_seq_len — including exactly a
+    capped rung — must select a bucket n <= S <= max_seq_len."""
+    eng = _bucket_probe(min_bucket, max_seq, rungs)
+    for n in range(1, max_seq + 1):
+        S = eng._prefill_bucket(n)
+        assert n <= S <= max_seq, (n, S, max_seq)
+
+
+@pytest.mark.parametrize("min_bucket,max_seq,rungs", [
+    (64, 96, 2), (64, 160, 4), (16, 208, 2), (64, 48, 2),
+])
+def test_prefill_bucket_always_on_warmable_rung_ladder(
+        min_bucket, max_seq, rungs):
+    """Every bucket _prefill_bucket can select must appear on SOME
+    octave's rung ladder — otherwise warm_prefill_buckets can never
+    cover it and the hot path pays a compile. (The warmup loop's
+    octave-0 fix: with max_seq_len below min_prefill_bucket the capped
+    octave-0 rung still warms.)"""
+    eng = _bucket_probe(min_bucket, max_seq, rungs)
+    # mirror of the XlaBucketedBackend.warm() octave loop with
+    # warm_prefill_buckets unbounded: octaves end only after the
+    # previous base rung reached max_seq_len, so the first
+    # past-the-cap octave still contributes its capped rung
+    warmable: set[int] = set()
+    b = 0
+    while True:
+        if b > 0 and (min_bucket << (b - 1)) >= max_seq:
+            break
+        warmable.update(eng._bucket_rungs(b))
+        b += 1
+    for n in range(1, max_seq + 1):
+        S = eng._prefill_bucket(n)
+        assert S in warmable, (n, S, sorted(warmable))
