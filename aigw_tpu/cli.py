@@ -312,8 +312,19 @@ def main(argv: list[str] | None = None) -> int:
                               "inspection/DoS surface)")
     p_serve.add_argument("--lora", action="append", default=[],
                          metavar="NAME=ORBAX_DIR",
-                         help="load a LoRA adapter (repeatable); serve it "
-                              "via model '<base>:<name>'")
+                         help="register a LoRA adapter in the zoo "
+                              "(repeatable); serve it via model "
+                              "'<base>:<name>'")
+    p_serve.add_argument("--lora-slots", type=int, default=0,
+                         help="device rows for resident adapters; the "
+                              "rest of the zoo hot-loads on demand with "
+                              "refcounted LRU eviction (0 = one row per "
+                              "registered adapter)")
+    p_serve.add_argument("--tenant-slot-cap", type=int, default=0,
+                         help="max in-flight decode slots one tenant "
+                              "(x-aigw-tenant / adapter suffix) may hold "
+                              "— the fairness guard against one "
+                              "tenant's burst starving others (0 = off)")
     p_serve.add_argument("--platform", default="",
                          help="force a JAX platform (e.g. cpu for the "
                               "fake-chip mode; default: auto/TPU)")
@@ -854,6 +865,8 @@ async def _run_tpuserve(args: argparse.Namespace) -> int:
         sp=args.sp,
         quantize=args.quantize,
         lora_adapters=lora_adapters or None,
+        lora_slots=args.lora_slots,
+        tenant_slot_cap=args.tenant_slot_cap,
         decode_steps_per_tick=args.decode_steps_per_tick,
         enable_prefix_cache=not args.no_prefix_cache,
         sp_prefill_min_tokens=args.sp_prefill_min_tokens,

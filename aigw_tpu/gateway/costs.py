@@ -30,11 +30,14 @@ from aigw_tpu.config.model import (
 )
 
 
-#: Variables available inside cost expressions (reference cel.go:32-49).
+#: Variables available inside cost expressions (reference cel.go:32-49,
+#: plus ``tenant`` — the multi-tenant accounting key the gateway derives
+#: from the x-aigw-tenant header or the model's adapter suffix).
 COST_VARIABLES = (
     "model",
     "backend",
     "route_name",
+    "tenant",
     "input_tokens",
     "output_tokens",
     "total_tokens",
@@ -157,12 +160,14 @@ class CostProgram:
         model: str = "",
         backend: str = "",
         route_name: str = "",
+        tenant: str = "",
     ) -> int:
         env = {
             "__builtins__": {},
             "model": model,
             "backend": backend,
             "route_name": route_name,
+            "tenant": tenant,
             "input_tokens": usage.input_tokens,
             "output_tokens": usage.output_tokens,
             "total_tokens": usage.total_tokens,
@@ -205,6 +210,7 @@ class CostCalculator:
         model: str = "",
         backend: str = "",
         route_name: str = "",
+        tenant: str = "",
     ) -> dict[str, int]:
         out: dict[str, int] = {}
         for cost, prog in self._entries:
@@ -224,7 +230,8 @@ class CostCalculator:
             else:
                 assert prog is not None
                 v = prog.evaluate(
-                    usage, model=model, backend=backend, route_name=route_name
+                    usage, model=model, backend=backend,
+                    route_name=route_name, tenant=tenant,
                 )
             out[cost.metadata_key] = v
         return out

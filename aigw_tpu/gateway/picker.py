@@ -66,6 +66,19 @@ AFFINITY_HEADER = "x-aigw-session-affinity"
 #: enables the picker) — soft cache-affinity, see module docstring
 PREFIX_HEADER = "x-aigw-prefix-hash"
 
+#: request header carrying the LoRA adapter the request needs (derived
+#: by the gateway from the model's ":adapter" suffix). SOFT affinity:
+#: replicas reporting the adapter RESIDENT on /state get a score bonus
+#: — landing there serves from the already-loaded row; any replica of
+#: the pool can still hot-load it, so affinity never gates placement.
+ADAPTER_HEADER = "x-aigw-adapter"
+
+#: request header carrying the tenant key (client-set, or derived by the
+#: gateway from the model's adapter suffix) — relayed upstream so the
+#: replica's fairness guard and the gateway's quota/cost accounting key
+#: on the same tenant
+TENANT_HEADER = "x-aigw-tenant"
+
 
 @dataclass(frozen=True)
 class Endpoint:
@@ -91,6 +104,12 @@ class EndpointState:
     # prefix-cache effectiveness reported by the replica on /state
     # (tpuserve prefix_cache_hit_rate) — dashboard/affinity telemetry
     prefix_hit_rate: float = 0.0
+    # served base model + adapter zoo reported on /state: resident
+    # adapters feed the adapter-affinity score term; registered names
+    # feed the gateway's /v1/models zoo listing
+    model: str = ""
+    adapters_resident: frozenset = frozenset()
+    adapters_registered: tuple = ()
     # ICI slice reported by the replica itself on /state (TPU multislice
     # slice_index) — overrides the statically configured slice label, so
     # topology follows reality after reschedules
@@ -168,6 +187,11 @@ class EndpointPicker:
         st.queue_wait_ms = float(data.get("queue_wait_ms", 0.0))
         st.prefix_hit_rate = float(data.get("prefix_cache_hit_rate", 0.0))
         st.slice_name = str(data.get("slice", "") or "")
+        st.model = str(data.get("model", "") or "")
+        st.adapters_resident = frozenset(
+            data.get("adapters_resident") or ())
+        st.adapters_registered = tuple(
+            data.get("adapters_registered") or ())
         st.updated_at = time.monotonic()
 
     # -- manual state injection (tests / push-based telemetry) ------------
@@ -175,7 +199,10 @@ class EndpointPicker:
                 queued: int = 0, active_slots: int = 0,
                 max_slots: int = 1, queue_wait_ms: float = 0.0,
                 prefix_hit_rate: float = 0.0,
-                slice_name: str = "") -> None:
+                slice_name: str = "",
+                adapters_resident: tuple = (),
+                model: str = "",
+                adapters_registered: tuple = ()) -> None:
         st = self.state[address]
         st.healthy = True
         st.kv_occupancy = kv_occupancy
@@ -186,6 +213,12 @@ class EndpointPicker:
         st.prefix_hit_rate = prefix_hit_rate
         if slice_name:
             st.slice_name = slice_name
+        if adapters_resident:
+            st.adapters_resident = frozenset(adapters_resident)
+        if model:
+            st.model = model
+        if adapters_registered:
+            st.adapters_registered = tuple(adapters_registered)
         st.updated_at = time.monotonic()
 
     # -- picking ----------------------------------------------------------
@@ -206,6 +239,12 @@ class EndpointPicker:
     #: overrides a saturated replica. Below STICKINESS_MARGIN so session
     #: stickiness (exact-KV locality) outranks prefix locality.
     PREFIX_AFFINITY_BONUS = 0.3
+    #: score bonus toward replicas whose /state reports the request's
+    #: LoRA adapter RESIDENT — serving there skips the hot load (a row
+    #: scatter + possible eviction of another tenant's warm adapter).
+    #: Below PREFIX_AFFINITY_BONUS: a resident adapter is cheaper to
+    #: recreate than a warm KV prefix, and any replica can load it.
+    ADAPTER_AFFINITY_BONUS = 0.2
     _AFFINITY_MAX = 100_000
 
     def _slice_of(self, addr: str) -> str:
@@ -235,6 +274,7 @@ class EndpointPicker:
         prefix_key = (headers or {}).get(PREFIX_HEADER, "")
         prefix_addr = (self._prefix_affinity.get(prefix_key)
                        if prefix_key else None)
+        adapter_key = (headers or {}).get(ADAPTER_HEADER, "")
         # the slice to prefer: where the session's replica lives —
         # meaningful even when that replica is unhealthy (failover
         # should land on a same-slice sibling)
@@ -256,6 +296,11 @@ class EndpointPicker:
                 # prefix-affinity: this replica recently served this
                 # prefix hash — its cache likely still holds the pages
                 score -= self.PREFIX_AFFINITY_BONUS
+            if adapter_key and adapter_key in st.adapters_resident:
+                # adapter-affinity: the LoRA row is already loaded
+                # here — serving elsewhere pays a hot load (and may
+                # evict a warm adapter on the other replica)
+                score -= self.ADAPTER_AFFINITY_BONUS
             return score
 
         scores = {e.address: score_of(e) for e in self.endpoints}
@@ -284,6 +329,8 @@ class EndpointPicker:
                     sticky=chosen == prev_addr and bool(affinity_key),
                     prefix_affinity=chosen == prefix_addr
                     and bool(prefix_key),
+                    adapter_affinity=bool(adapter_key) and adapter_key
+                    in self.state[chosen].adapters_resident,
                 )
         if affinity_key:
             self._affinity[affinity_key] = chosen

@@ -22,6 +22,16 @@ class NoRouteError(Exception):
     """No route rule matched (→ 404, the reference's route-not-found rule)."""
 
 
+def split_model(name: str) -> tuple[str, str]:
+    """Model-zoo name resolution: ``<base>:<adapter>`` → (base, adapter);
+    a plain name is (name, ""). The colon convention is tpuserve's LoRA
+    surface (replica /v1/models lists ``llama-3-8b:tenant-a`` style
+    entries); the gateway routes such names by their BASE model and uses
+    the adapter part for tenancy accounting and picker affinity."""
+    base, _, adapter = name.partition(":")
+    return (base, adapter) if adapter else (name, "")
+
+
 @dataclass
 class RouteMatch:
     route: Route
@@ -31,10 +41,24 @@ class RouteMatch:
 def match_route(
     rc: RuntimeConfig, host: str, headers: dict[str, str]
 ) -> RouteMatch:
+    from aigw_tpu.config.model import MODEL_NAME_HEADER
+
     for route in rc.routes_for_host(host):
         for rule in route.rules:
             if rule.matches(headers):
                 return RouteMatch(route=route, rule=rule)
+    # model-zoo fallback: an adapter-suffixed name ("llama-3-8b:tenant-a")
+    # routes to the rule serving its base model — a route per adapter
+    # would make every adapter a config change, and the serving replica
+    # resolves the suffix itself (tpuserve _resolve_adapter)
+    model = headers.get(MODEL_NAME_HEADER, "")
+    base, adapter = split_model(model)
+    if adapter:
+        base_headers = dict(headers, **{MODEL_NAME_HEADER: base})
+        for route in rc.routes_for_host(host):
+            for rule in route.rules:
+                if rule.matches(base_headers):
+                    return RouteMatch(route=route, rule=rule)
     raise NoRouteError("no route matched the request model")
 
 

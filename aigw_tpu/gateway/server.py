@@ -45,12 +45,19 @@ from aigw_tpu.gateway.circuit import CircuitBreaker
 from aigw_tpu.gateway.costs import TokenUsage
 from aigw_tpu.gateway.mutators import apply_body_mutation, apply_header_mutation
 from aigw_tpu.gateway.picker import (
+    ADAPTER_HEADER,
     AFFINITY_HEADER,
     PREFIX_HEADER,
+    TENANT_HEADER,
     Endpoint as PickerEndpoint,
     EndpointPicker,
 )
-from aigw_tpu.gateway.router import BackendSelector, NoRouteError, match_route
+from aigw_tpu.gateway.router import (
+    BackendSelector,
+    NoRouteError,
+    match_route,
+    split_model,
+)
 from aigw_tpu.obs.metrics import GenAIMetrics, RequestMetrics
 from aigw_tpu.obs.tracing import (
     DEFAULT_HEADER_ATTRIBUTES,
@@ -365,7 +372,11 @@ class GatewayServer:
     async def _handle_models(self, request: web.Request) -> web.Response:
         """/v1/models — configured models, host-scoped like the
         reference's ModelsByHost (models_processor.go:30-150): models whose
-        serving routes are restricted to other hostnames are hidden."""
+        serving routes are restricted to other hostnames are hidden.
+        The listing also carries the model ZOO (ISSUE 7): every
+        ``<base>:<adapter>`` name the picker-polled tpuserve replicas
+        report on /state whose base model routes here — so clients
+        discover servable adapters without per-adapter config entries."""
         rc = self._runtime
         host = request.host.split(":")[0].lower()
         visible_rules = [
@@ -373,15 +384,29 @@ class GatewayServer:
         ]
 
         def visible(name: str) -> bool:
-            probe = {MODEL_NAME_HEADER: name}
-            return any(r.matches(probe) for r in visible_rules)
+            base = split_model(name)[0]
+            for probe_name in ({name, base}):
+                probe = {MODEL_NAME_HEADER: probe_name}
+                if any(r.matches(probe) for r in visible_rules):
+                    return True
+            return False
 
-        body = oai.models_response(
+        entries = [
             (m.name, m.owned_by, m.created_at)
             for m in rc.config.models
             if visible(m.name)
-        )
-        return web.json_response(body)
+        ]
+        seen = {e[0] for e in entries}
+        for picker in self._pickers.values():
+            for st in picker.state.values():
+                if not (st.healthy and st.model):
+                    continue
+                for adapter in st.adapters_registered:
+                    name = f"{st.model}:{adapter}"
+                    if name not in seen and visible(name):
+                        seen.add(name)
+                        entries.append((name, "aigw-tpu-lora", 0))
+        return web.json_response(oai.models_response(entries))
 
     async def _handle_debug_config(self, _request: web.Request) -> web.Response:
         """Redacted view of the live config (credentials masked)."""
@@ -509,6 +534,16 @@ class GatewayServer:
                     status=400, body=error_body(str(e)),
                     content_type="application/json")
         client_headers = {k.lower(): v for k, v in request.headers.items()}
+        # multi-tenant accounting key (ISSUE 7): an explicit tenant
+        # header wins; adapter-suffixed zoo names ("llama-3-8b:tenant-a")
+        # default to per-adapter tenancy. Injected into client_headers so
+        # tenant-keyed quota rules (client_key_header: x-aigw-tenant),
+        # the end-of-stream cost sink, and the upstream relay all key on
+        # ONE consistent tenant.
+        tenant = client_headers.get(TENANT_HEADER, "") or \
+            split_model(model)[1]
+        if tenant:
+            client_headers[TENANT_HEADER] = tenant
         match_headers = {
             **client_headers,
             MODEL_NAME_HEADER: model,
@@ -740,8 +775,13 @@ class GatewayServer:
         span=None,
     ) -> web.StreamResponse:
         backend = rb.backend
-        if rc_limited := await self._check_quota(client_headers, rb,
-                                                 req_metrics, error_body):
+        # explicit None check: aiohttp's web.Response is a MutableMapping
+        # over its (empty) per-request state, so a fresh 429 Response is
+        # FALSY — a bare walrus truthiness test silently dropped the
+        # quota rejection and let the request through
+        rc_limited = await self._check_quota(client_headers, rb,
+                                             req_metrics, error_body)
+        if rc_limited is not None:
             return rc_limited
         if isinstance(body, _RawBody):
             # multipart passthrough: no translation, original bytes forward
@@ -834,6 +874,13 @@ class GatewayServer:
                         derived[PREFIX_HEADER] = pkey
                 if derived:
                     pick_headers = dict(client_headers) | derived
+            # adapter-affinity (ISSUE 7): an adapter-suffixed zoo name
+            # prefers replicas whose /state reports the LoRA row already
+            # resident (soft — any replica can hot-load it)
+            adapter = split_model(req_metrics.request_model)[1]
+            if adapter and ADAPTER_HEADER not in pick_headers:
+                pick_headers = dict(pick_headers) | {
+                    ADAPTER_HEADER: adapter}
             explain: dict[str, Any] | None = (
                 {} if span is not None else None)
             dest = self._pickers[backend.name].pick(
@@ -861,6 +908,10 @@ class GatewayServer:
                       "x-b3-spanid", "x-b3-sampled"):
                 if h in client_headers:
                     headers[h] = client_headers[h]
+        if TENANT_HEADER in client_headers:
+            # the replica's fairness guard keys on the SAME tenant the
+            # gateway accounts/ratelimits by
+            headers[TENANT_HEADER] = client_headers[TENANT_HEADER]
         headers = apply_header_mutation(headers, backend.header_mutation)
         import urllib.parse as _up
 
@@ -1269,7 +1320,8 @@ class GatewayServer:
         model = req_metrics.request_model
         backend = req_metrics.provider
         costs = self._runtime.cost_calculator_for(route_name).calculate(
-            usage, model=model, backend=backend, route_name=route_name
+            usage, model=model, backend=backend, route_name=route_name,
+            tenant=client_headers.get(TENANT_HEADER, ""),
         )
         if not costs:
             return

@@ -61,13 +61,19 @@ def init_lora_adapters(
     for i in range(model_cfg.n_layers):
         for kind in lora_cfg.targets:
             d_in, d_out = _DIMS[kind](model_cfg)
+            # both keys are ALWAYS drawn, so the A matrices are identical
+            # whether random_b is on or off — seeded tests comparing the
+            # two modes see the same adapter geometry, not a shifted key
+            # stream (B is zero in the off mode, so the unused key is
+            # free)
+            key_a, key_b = next(keys), next(keys)
             a = (
-                jax.random.normal(next(keys), (rows, lora_cfg.rank, d_in),
+                jax.random.normal(key_a, (rows, lora_cfg.rank, d_in),
                                   jnp.float32)
                 / math.sqrt(d_in) * scale
             )
             if random_b:
-                b = jax.random.normal(next(keys),
+                b = jax.random.normal(key_b,
                                       (rows, d_out, lora_cfg.rank),
                                       jnp.float32) / math.sqrt(lora_cfg.rank)
             else:
@@ -78,6 +84,37 @@ def init_lora_adapters(
             out[f"l{i}.{kind}.lora_a"] = a.astype(dtype)
             out[f"l{i}.{kind}.lora_b"] = b.astype(dtype)
     return out
+
+
+def validate_adapter_params(params: dict, name: str = "") -> None:
+    """Fail fast on malformed adapter dicts: every ``X.lora_a`` must pair
+    with an ``X.lora_b`` of a matching rank (and vice versa). Without
+    this, a missing half surfaced as a bare KeyError deep inside the
+    batched matmul path — useless for diagnosing which adapter/tensor
+    was broken. Called at adapter registration (tpuserve/adapters.py)
+    and defensively by ``lora_delta``."""
+    label = f"adapter {name!r}: " if name else ""
+    for k in params:
+        if k.endswith(".lora_a"):
+            base = k[: -len(".lora_a")]
+            other = base + ".lora_b"
+            if other not in params:
+                raise ValueError(f"{label}{k} has no matching {other}")
+            r_a = params[k].shape[-2]  # [.., r, in]
+            r_b = params[other].shape[-1]  # [.., out, r]
+            if r_a != r_b:
+                raise ValueError(
+                    f"{label}rank mismatch for {base}: lora_a rank "
+                    f"{r_a} vs lora_b rank {r_b}")
+        elif k.endswith(".lora_b"):
+            base = k[: -len(".lora_b")]
+            if base + ".lora_a" not in params:
+                raise ValueError(
+                    f"{label}{k} has no matching {base}.lora_a")
+        else:
+            raise ValueError(
+                f"{label}unexpected tensor {k!r} (expected "
+                "'<layer>.<kind>.lora_a/.lora_b' keys)")
 
 
 def lora_delta(
@@ -92,7 +129,13 @@ def lora_delta(
     a = lora.get(key + ".lora_a")
     if a is None:
         return None
-    b = lora[key + ".lora_b"]
+    b = lora.get(key + ".lora_b")
+    if b is None:
+        # half an adapter pair would otherwise be a bare KeyError with
+        # no tensor name — deep inside a traced matmul stack
+        raise ValueError(
+            f"adapter tensor {key}.lora_b missing while {key}.lora_a "
+            "is present (malformed adapter dict)")
     a_sel = a[idx]  # [B, r, in]
     b_sel = b[idx]  # [B, out, r]
     t = jnp.einsum("bsd,brd->bsr", x, a_sel)

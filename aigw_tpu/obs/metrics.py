@@ -143,6 +143,20 @@ ENGINE_GAUGES: tuple[tuple[str, str], ...] = (
     # a nonzero delta after warmup is a hot-path compile regression
     ("xla_compiles", "tpuserve_xla_compiles_total"),
     ("xla_compile_ms", "tpuserve_xla_compile_ms_total"),
+    # adapter serving subsystem (ISSUE 7, tpuserve/adapters.py): hot
+    # loads into the stacked LoRA rows, LRU evictions under row
+    # pressure, resident adapters, and live slots decoding through a
+    # non-base adapter row
+    ("adapter_loads", "tpuserve_adapter_loads_total"),
+    ("adapter_evictions", "tpuserve_adapter_evictions_total"),
+    ("adapter_resident", "tpuserve_adapter_resident"),
+    ("adapter_slots", "tpuserve_adapter_slots"),
+    # multi-tenant fairness: distinct tenants holding decode slots, the
+    # largest per-tenant in-flight count, and admissions the per-tenant
+    # slot cap deferred (each deferral = one pass a request waited)
+    ("tenants_active", "tpuserve_tenants_active"),
+    ("tenant_max_slots", "tpuserve_tenant_max_slots"),
+    ("tenant_deferrals", "tpuserve_tenant_deferrals_total"),
 )
 
 

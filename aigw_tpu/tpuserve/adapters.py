@@ -1,0 +1,233 @@
+"""Adapter serving subsystem: the model zoo's LoRA rows, hot-loaded.
+
+``models/lora.py`` gives the engine batched per-slot adapter math over
+stacked ``[n_slots+1, r, d]`` device arrays — this module gives those
+arrays a *lifecycle*. The zoo (every adapter the replica can serve) is
+registered host-side; only ``n_slots`` adapters are resident on device
+at a time, each occupying one row of every stacked tensor. Rows are
+managed under the same discipline ``kvcache.py`` uses for KV pages:
+
+- **refcounted**: every live engine slot serving an adapter holds one
+  reference to its row; a row is NEVER reassigned while referenced
+  (the invariant the adapter property test asserts).
+- **LRU-parked**: a row whose refcount drops to zero stays resident
+  (revivable for free by the next request for that adapter) until a
+  non-resident adapter needs the row — then the least-recently-parked
+  row is evicted and rewritten.
+- **hot load**: loading scatters the adapter's tensors into the row
+  with one jitted dynamic-index row update per tensor — the stacked
+  arrays are donated through, so a load is a row-sized write, not a
+  stack-sized copy, and it composes with the engine's in-flight decode
+  windows through the normal JAX dependency order (no pipeline drain,
+  no rebuild). One compiled program per tensor shape regardless of
+  which row is written; ``warm()`` pre-compiles them so the first
+  adapter admission pays zero XLA compiles.
+
+Row ``n_slots`` (the last row) is the all-zeros base-model row and is
+never allocated: base-model requests point there and are bit-exact
+base-model output by construction.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from aigw_tpu.models.lora import validate_adapter_params
+
+logger = logging.getLogger(__name__)
+
+
+class UnknownAdapterError(KeyError):
+    """Adapter name not registered in the zoo (→ 404 at the server)."""
+
+
+class AdapterCapacityError(Exception):
+    """Every device row is pinned by a live slot — the request must
+    wait for a generation to finish (admission requeues it, exactly
+    like KV OutOfPagesError)."""
+
+
+class AdapterStore:
+    """Registry + device residency manager for the stacked LoRA arrays.
+
+    ``register()`` adds adapters to the zoo (host memory only);
+    ``acquire()``/``release()`` manage device rows. All registered
+    adapters must share tensor keys and shapes (one compiled program
+    serves any mix — shape divergence would be a recompile per mix).
+    """
+
+    def __init__(self, n_slots: int, dtype: Any = jnp.bfloat16):
+        if n_slots < 1:
+            raise ValueError("AdapterStore needs at least one row")
+        self.n_slots = n_slots
+        self.dtype = dtype
+        # zoo: name → host param dict (np arrays, template-validated)
+        self._zoo: dict[str, dict[str, np.ndarray]] = {}
+        self._template: dict[str, tuple] | None = None  # key → shape
+        # device residency
+        self._row_of: dict[str, int] = {}
+        self._name_of: dict[int, str] = {}
+        self._refs: dict[int, int] = {}
+        # refcount-0 resident rows, insertion-ordered = LRU
+        self._parked: dict[int, str] = {}
+        self._free: list[int] = list(range(n_slots - 1, -1, -1))
+        #: stacked device arrays [n_slots+1, ...]; row n_slots = zeros.
+        #: Replaced (donated through) on every load — readers must
+        #: fetch fresh per dispatch (the engine's lora_params property).
+        self.params: dict[str, jax.Array] = {}
+        # monotonic counters (EngineStats / /state surface)
+        self.loads = 0
+        self.evictions = 0
+        self._load_fn = None
+
+    # -- zoo ---------------------------------------------------------------
+    def register(self, name: str, adapter: dict) -> None:
+        """Add an adapter to the zoo (host-side; no device traffic).
+        Validates pairing/rank (models/lora.py) and shape agreement with
+        previously registered adapters."""
+        validate_adapter_params(adapter, name)
+        host = {k: np.asarray(v, np.float32) for k, v in adapter.items()}
+        shapes = {k: v.shape for k, v in host.items()}
+        if self._template is None:
+            self._template = shapes
+            self.params = {
+                k: jnp.zeros((self.n_slots + 1, *shape), self.dtype)
+                for k, shape in shapes.items()
+            }
+        elif shapes != self._template:
+            raise ValueError(
+                f"adapter {name!r} tensors {shapes} do not match the "
+                f"zoo template {self._template} (all adapters must "
+                "target the same modules at the same rank)")
+        self._zoo[name] = host
+
+    def names(self) -> tuple[str, ...]:
+        return tuple(self._zoo)
+
+    def knows(self, name: str) -> bool:
+        return name in self._zoo
+
+    @property
+    def base_row(self) -> int:
+        return self.n_slots
+
+    # -- telemetry ---------------------------------------------------------
+    @property
+    def resident_count(self) -> int:
+        return len(self._row_of)
+
+    def resident_names(self) -> list[str]:
+        return sorted(self._row_of)
+
+    def refcount(self, name: str) -> int:
+        row = self._row_of.get(name)
+        return self._refs.get(row, 0) if row is not None else 0
+
+    # -- residency ---------------------------------------------------------
+    def row_of(self, name: str) -> int:
+        """Device row of a RESIDENT adapter (callers hold a reference
+        from acquire(); asking for a non-resident name is a caller
+        bug — fail loudly, never silently serve the wrong row)."""
+        return self._row_of[name]
+
+    def acquire(self, name: str) -> int:
+        """Pin ``name``'s row for one live slot, hot-loading it into a
+        free (or LRU-evicted) row when not resident. Raises
+        UnknownAdapterError / AdapterCapacityError."""
+        if name not in self._zoo:
+            raise UnknownAdapterError(name)
+        row = self._row_of.get(name)
+        if row is not None:
+            self._refs[row] = self._refs.get(row, 0) + 1
+            self._parked.pop(row, None)  # back in active use
+            return row
+        row = self._pop_row()
+        self._load(row, name)
+        self._row_of[name] = row
+        self._name_of[row] = name
+        self._refs[row] = 1
+        return row
+
+    def release(self, row: int) -> None:
+        """Drop one slot's reference; the last reference parks the row
+        in the LRU pool (still resident, revivable for free)."""
+        if row == self.base_row:
+            return
+        name = self._name_of.get(row)
+        if name is None:  # defensive: double release must not corrupt
+            return
+        refs = self._refs.get(row, 1) - 1
+        if refs > 0:
+            self._refs[row] = refs
+            return
+        self._refs.pop(row, None)
+        self._parked[row] = name
+
+    def _pop_row(self) -> int:
+        if self._free:
+            return self._free.pop()
+        if self._parked:
+            row, name = next(iter(self._parked.items()))
+            del self._parked[row]
+            del self._row_of[name]
+            del self._name_of[row]
+            self.evictions += 1
+            logger.info("adapter %r evicted from row %d", name, row)
+            return row
+        raise AdapterCapacityError(
+            f"all {self.n_slots} adapter rows pinned by live slots")
+
+    # -- device load -------------------------------------------------------
+    def _make_load_fn(self):
+        def _set_row(stack: jax.Array, row: jax.Array,
+                     value: jax.Array) -> jax.Array:
+            return stack.at[row].set(value.astype(stack.dtype))
+
+        # donate the stack: a load writes one row in place instead of
+        # copying [n_slots+1, ...]; the dynamic row index keeps it ONE
+        # compiled program per tensor shape for any destination row
+        return jax.jit(_set_row, donate_argnums=(0,))
+
+    def _load(self, row: int, name: str) -> None:
+        if self._load_fn is None:
+            self._load_fn = self._make_load_fn()
+        host = self._zoo[name]
+        r = jnp.int32(row)
+        for k, v in host.items():
+            self.params[k] = self._load_fn(self.params[k], r,
+                                           jnp.asarray(v))
+        self.loads += 1
+        logger.info("adapter %r loaded into row %d", name, row)
+
+    def warm(self) -> None:
+        """Pre-compile the per-tensor row-scatter programs by rewriting
+        the base row with its own zeros (content no-op) — after this,
+        the first hot adapter load adds ZERO XLA compiles."""
+        if not self.params:
+            return
+        if self._load_fn is None:
+            self._load_fn = self._make_load_fn()
+        r = jnp.int32(self.base_row)
+        for k, stack in list(self.params.items()):
+            zero = jnp.zeros(stack.shape[1:], np.float32)
+            self.params[k] = self._load_fn(stack, r, zero)
+
+    # -- invariants (property-test surface) --------------------------------
+    def check_invariants(self) -> None:
+        """Bookkeeping consistency: referenced rows are exactly the
+        resident-minus-parked rows, no row appears in two pools, and
+        the base row is never tracked."""
+        resident_rows = set(self._name_of)
+        assert resident_rows == set(self._row_of.values())
+        assert set(self._refs) | set(self._parked) == resident_rows
+        assert not (set(self._refs) & set(self._parked))
+        assert not (set(self._free) & resident_rows)
+        assert self.base_row not in resident_rows
+        assert len(self._free) + len(resident_rows) == self.n_slots
+        for row, refs in self._refs.items():
+            assert refs > 0, (row, refs)

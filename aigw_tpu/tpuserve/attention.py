@@ -215,8 +215,7 @@ class XlaBucketedBackend(AttentionBackend):
             for tok_id, b in req.sampling.logit_bias:
                 if 0 <= tok_id < V:
                     bias[g, tok_id] = b
-            if req.adapter:
-                adapter[g] = eng.adapter_rows[req.adapter]
+            adapter[g] = eng._adapter_row_of(req)
         next_tok, eng.kv_cache = eng._prefill_fn(
             eng.params, eng.lora_params, jnp.asarray(tokens),
             jnp.asarray(seq_lens), eng.kv_cache, jnp.asarray(pt),
@@ -554,9 +553,7 @@ class RaggedPrefillBackend(AttentionBackend):
             for tok_id, b in req.sampling.logit_bias:
                 if 0 <= tok_id < V:
                     bias[g, tok_id] = b
-            if req.adapter:
-                adapter[g] = eng.adapter_rows.get(
-                    req.adapter, eng._base_row)
+            adapter[g] = eng._adapter_row_of(req)
         return (jnp.asarray(keys), jnp.asarray(temp), jnp.asarray(top_p),
                 jnp.asarray(top_k), jnp.asarray(bias),
                 jnp.asarray(adapter))

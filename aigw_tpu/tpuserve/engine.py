@@ -183,6 +183,16 @@ class EngineConfig:
     # equivalent schedules — the deterministic-equivalence test mode
     # (tests/test_chunked_prefill.py) and an accuracy-debug knob.
     kv_cache_dtype: str = "bfloat16"
+    # Multi-tenant fairness guard (ISSUE 7): the maximum decode slots
+    # any one tenant (GenRequest.tenant; "" is one anonymous tenant) may
+    # hold concurrently. Admissions beyond the cap are deferred (left at
+    # the queue head, arrival order kept) until the tenant frees a slot,
+    # so one tenant's burst can never occupy the whole batch while
+    # another tenant's single request starves. Admission is additionally
+    # deficit-weighted whenever multiple tenants are queued: tenants
+    # holding fewer in-flight slots admit first. 0 disables the cap
+    # (weighted ordering still applies).
+    tenant_slot_cap: int = 0
     # Per-token logprobs (vLLM/OpenAI parity): when > 0, the decode scan
     # also returns the chosen token's log-probability and the top-k
     # (ids, values) per step, and requests may set want_logprobs. Static
@@ -252,6 +262,10 @@ class GenRequest:
     cancelled: threading.Event = field(default_factory=threading.Event)
     # LoRA adapter name ("" = base model)
     adapter: str = ""
+    # Tenant key for fairness + accounting ("" = anonymous). The server
+    # derives it from the x-aigw-tenant header (relayed by the gateway)
+    # or the adapter suffix of the requested model name.
+    tenant: str = ""
     # Per-token logprobs: when set (and the engine was built with
     # logprobs_topk > 0), emit_lp is called INSTEAD of emit with
     # (token, finish, logprob, top) where top = [(token_id, logprob)]
@@ -333,6 +347,21 @@ class EngineStats:
     # from-idle builds are not counted). The zero-rebuild acceptance
     # criterion asserts on this.
     state_rebuilds: int = 0
+    # adapter serving subsystem (ISSUE 7, tpuserve/adapters.py): hot
+    # loads into device rows, LRU evictions under row pressure, the
+    # resident-adapter count, and how many live slots currently decode
+    # through a non-base adapter row
+    adapter_loads: int = 0
+    adapter_evictions: int = 0
+    adapter_resident: int = 0
+    adapter_slots: int = 0
+    # multi-tenant fairness surface: distinct tenants holding decode
+    # slots, the largest per-tenant in-flight count, and admissions
+    # deferred by the per-tenant slot cap (each deferral = one pass a
+    # request waited because its tenant was at cap)
+    tenants_active: int = 0
+    tenant_max_slots: int = 0
+    tenant_deferrals: int = 0
     prefills: int = 0
     sp_prefills: int = 0  # prefills routed through ring attention
     chunked_prefill_steps: int = 0  # intermediate chunk device steps
@@ -427,15 +456,33 @@ class Engine:
         fns: Any = None,  # models.registry.ModelFns; default = llama
         lora_params: dict[str, jax.Array] | None = None,
         adapter_names: tuple[str, ...] = (),
+        # adapter serving subsystem (tpuserve/adapters.py): dynamic
+        # row residency (hot load / refcounted LRU evict) over the
+        # registered zoo. Mutually exclusive with the static
+        # lora_params/adapter_names form above (kept for fixed-stack
+        # deployments and tests).
+        adapter_store: Any = None,
     ):
         from aigw_tpu.models.registry import family_fns
 
         self.fns = fns or family_fns("llama")
         # multi-LoRA: stacked adapters + name→row map; the LAST row of the
-        # stack is the all-zeros base-model row (models/lora.py)
-        self.lora_params = lora_params
-        self.adapter_rows = {n: i for i, n in enumerate(adapter_names)}
-        self._base_row = len(adapter_names)
+        # stack is the all-zeros base-model row (models/lora.py). With an
+        # AdapterStore the stack and the name→row map are DYNAMIC — the
+        # lora_params property reads the store fresh at every dispatch
+        # (hot loads replace the stacked arrays).
+        if adapter_store is not None and (lora_params or adapter_names):
+            raise ValueError(
+                "pass either adapter_store or lora_params/adapter_names, "
+                "not both")
+        self._adapter_store = adapter_store
+        self._lora_static = lora_params
+        if adapter_store is not None:
+            self.adapter_rows = {}  # dynamic: resolved via the store
+            self._base_row = adapter_store.base_row
+        else:
+            self.adapter_rows = {n: i for i, n in enumerate(adapter_names)}
+            self._base_row = len(adapter_names)
         self.mesh = mesh
         self.params = params
         self.model_cfg = model_cfg
@@ -456,6 +503,11 @@ class Engine:
         # per-program jit-cache accounting over every hot-path callable
         # registered below (obs/xla_events.py — the tripwire surface)
         self.compile_tracker = CompileTracker()
+        if self._adapter_store is not None:
+            # the hot-load row scatter runs on the admission path: it is
+            # part of the tripwire surface and warmed by warmup()
+            self._adapter_store._load_fn = self.compile_tracker.register(
+                "adapter_load", self._adapter_store._make_load_fn())
         self.healthy = True
         self.last_error: str | None = None
 
@@ -894,6 +946,109 @@ class Engine:
                 f"decode[k={k},lean={lean},d={draft}]", fn)
         return fn
 
+    # -- adapter rows (tpuserve/adapters.py) -------------------------------
+    @property
+    def lora_params(self):
+        """The stacked LoRA arrays for the NEXT dispatch. With an
+        AdapterStore this must be read fresh every dispatch — hot loads
+        replace the stacked arrays (donated row writes)."""
+        if self._adapter_store is not None:
+            return self._adapter_store.params or None
+        return self._lora_static
+
+    def _adapter_known(self, name: str) -> bool:
+        if self._adapter_store is not None:
+            return self._adapter_store.knows(name)
+        return name in self.adapter_rows
+
+    def _acquire_adapter(self, name: str) -> int:
+        """Resolve an adapter name to its device row for a new slot,
+        pinning (and hot-loading, when non-resident) the row in store
+        mode. Raises adapters.UnknownAdapterError for names outside the
+        zoo and adapters.AdapterCapacityError when every row is pinned
+        (caller requeues, like KV page pressure)."""
+        if self._adapter_store is not None:
+            return self._adapter_store.acquire(name)
+        row = self.adapter_rows.get(name)
+        if row is None:
+            from aigw_tpu.tpuserve.adapters import UnknownAdapterError
+
+            raise UnknownAdapterError(name)
+        return row
+
+    def _release_adapter_row(self, row: int) -> None:
+        """Drop a slot's pin on its adapter row. Safe at slot-free time
+        even with a window in flight: a freed slot's window outputs are
+        discarded at drain (members check), and device-side reads of a
+        subsequently rewritten row are ordered behind the in-flight
+        computation by the normal JAX dependency chain."""
+        if self._adapter_store is not None and row != self._base_row:
+            self._adapter_store.release(row)
+
+    def _adapter_row_of(self, req: GenRequest) -> int:
+        """Device row for an ADMITTED request (the attention backends'
+        sampling-row builder). In store mode the row was acquired at
+        admission, so the lookup must succeed — a missing name here is
+        an acquire-ordering bug, not routine miss traffic."""
+        if not req.adapter:
+            return self._base_row
+        if self._adapter_store is not None:
+            return self._adapter_store.row_of(req.adapter)
+        return self.adapter_rows.get(req.adapter, self._base_row)
+
+    # -- tenant fairness ----------------------------------------------------
+    def _tenant_slots(self) -> dict[str, int]:
+        counts: dict[str, int] = {}
+        for s in self._slots:
+            if s is not None:
+                t = s.req.tenant
+                counts[t] = counts.get(t, 0) + 1
+        return counts
+
+    def _fair_admission(
+        self, pending: list[GenRequest], free: int,
+    ) -> tuple[list[GenRequest], list[GenRequest], int]:
+        """(admit_now, requeue, n_capped): the fairness guard over one
+        admission pass. The per-tenant slot cap defers requests whose
+        tenant already holds (or would reach) ``tenant_slot_cap``
+        in-flight slots; remaining requests are deficit-ordered —
+        tenants with fewer live slots admit first, arrival order kept
+        within a tenant — so a multi-tenant burst splits the batch
+        instead of first-come-take-all. ``requeue`` preserves arrival
+        order (deferred + past-``free`` overflow). Single-tenant
+        traffic with nothing live passes through untouched."""
+        cap = self.cfg.tenant_slot_cap
+        live = self._tenant_slots()
+        if cap <= 0 and len({r.tenant for r in pending} | set(live)) <= 1:
+            return pending[:free], pending[free:], 0
+        taken: dict[str, int] = {}
+        eligible: list[GenRequest] = []
+        capped: list[GenRequest] = []
+        for req in pending:
+            t = req.tenant
+            if cap > 0 and live.get(t, 0) + taken.get(t, 0) >= cap:
+                capped.append(req)
+                continue
+            taken[t] = taken.get(t, 0) + 1
+            eligible.append(req)
+        if len({r.tenant for r in eligible}) > 1:
+            # deficit round-robin: repeatedly admit the earliest request
+            # of the least-loaded tenant (O(n²) on n ≤ queue bound)
+            counts = dict(live)
+            ordered: list[GenRequest] = []
+            rest = list(eligible)
+            while rest:
+                i = min(range(len(rest)),
+                        key=lambda j: (counts.get(rest[j].tenant, 0), j))
+                req = rest.pop(i)
+                counts[req.tenant] = counts.get(req.tenant, 0) + 1
+                ordered.append(req)
+            eligible = ordered
+        admit = eligible[:free]
+        left = set(map(id, capped)) | set(map(id, eligible[free:]))
+        requeue = [r for r in pending if id(r) in left]  # arrival order
+        return admit, requeue, len(capped)
+
     def _lean_decode_ok(self) -> bool:
         """True when no active slot uses repetition penalties — the
         lean decode program samples bit-identical tokens (zero
@@ -1075,6 +1230,11 @@ class Engine:
             self._spec_dirty.add(0)
             self._apply_spec_row_updates()
         self._device_state = saved
+        if self._adapter_store is not None:
+            # the hot-load row scatters run on the admission path: the
+            # first non-resident adapter admission (or any later mix
+            # change) must not pay an XLA compile
+            self._adapter_store.warm()
         self.attn.warm()
         self.stats.warmup_ms = round(1e3 * (time.monotonic() - t0), 3)
         self.stats.warm_programs = self.compile_tracker.program_count()
@@ -1148,6 +1308,7 @@ class Engine:
             if s is not None:
                 s.req.emit(-1, "error")
                 self.allocator.free(s.req.id)
+                self._release_adapter_row(s.adapter_row)
                 self._slots[i] = None
         try:
             while True:
@@ -1162,6 +1323,7 @@ class Engine:
                 if s.req.trace is not None:
                     s.req.trace.engine_finish("cancel")
                 self._pending_frees.append(s.req.id)
+                self._release_adapter_row(s.adapter_row)
                 self._slots[i] = None
                 self._dirty_rows.add(i)
 
@@ -1227,6 +1389,31 @@ class Engine:
                             pending.append(self._queue.get_nowait())
                     except queue.Empty:
                         pass
+            # fairness guard (ISSUE 7): per-tenant slot cap + deficit
+            # ordering over the popped window. Deferred requests must
+            # not occlude admissible tenants still queued behind them,
+            # so when the cap left slots unused the scan extends over
+            # the rest of the queue (bounded by max_queued_requests).
+            admit, fair_requeue, capped = self._fair_admission(
+                pending, free)
+            if fair_requeue and len(admit) < free:
+                more: list[GenRequest] = []
+                try:
+                    while True:
+                        more.append(self._queue.get_nowait())
+                except queue.Empty:
+                    pass
+                if more:
+                    admit, fair_requeue, capped = self._fair_admission(
+                        pending + more, free)
+            self.stats.tenant_deferrals += capped
+            pending = admit
+            fair_stop = bool(fair_requeue)
+            if not pending:
+                # everything at cap: back to the queue head (arrival
+                # order kept) until a tenant frees a slot
+                self._requeue_front_many(fair_requeue)
+                break
             # one coalesced-admission burst id per pass — lifecycle
             # traces carry it so a trace/flight reader can see which
             # requests shared a batched prefill
@@ -1288,10 +1475,13 @@ class Engine:
                     stop = True
                     break
                 i += 1
-            if unhandled:
-                # single requeue, arrival order preserved by construction
-                self._requeue_front_many(unhandled)
-            if stop:
+            if unhandled or fair_requeue:
+                # single requeue: page-pressure leftovers first (they
+                # were at the admission head), then fairness deferrals
+                self._requeue_front_many(unhandled + fair_requeue)
+            if stop or fair_stop:
+                # a fairness deferral must end the pass — looping would
+                # re-pop the deferred head and spin until a slot frees
                 break
         return admitted
 
@@ -1329,7 +1519,7 @@ class Engine:
             # calls with decode ticks interleaved), so they stay
             # batch-eligible there
             return False, chain
-        if req.adapter and req.adapter not in self.adapter_rows:
+        if req.adapter and not self._adapter_known(req.adapter):
             return False, chain  # singleton path surfaces the error
         return True, chain
 
@@ -1340,6 +1530,8 @@ class Engine:
         (admitted count, leftover): leftover is None without pressure,
         else the unallocated tail for the CALLER to requeue (alongside
         anything else it popped, in arrival order)."""
+        from aigw_tpu.tpuserve.adapters import AdapterCapacityError
+
         prepared: list[tuple[GenRequest, int, int, int]] = []
         leftover: list[GenRequest] | None = None
         for i, req in enumerate(reqs):
@@ -1352,6 +1544,19 @@ class Engine:
                 self.allocator.free(seq_id)
                 leftover = reqs[i:]
                 break
+            if req.adapter:
+                # pin (and hot-load, when non-resident) the adapter row
+                # BEFORE the batched prefill builds its sampling rows;
+                # the pin transfers to the slot. All-rows-pinned is the
+                # adapter analogue of page pressure: requeue and wait
+                # for a generation to finish (classify already vetted
+                # the name against the zoo).
+                try:
+                    self._acquire_adapter(req.adapter)
+                except AdapterCapacityError:
+                    self.allocator.free(seq_id)
+                    leftover = reqs[i:]
+                    break
             req.id = seq_id
             prepared.append((req, seq_id, n, total))
         count = 0
@@ -1510,12 +1715,25 @@ class Engine:
 
         adapter_row = self._base_row
         if req.adapter:
-            row = self.adapter_rows.get(req.adapter)
-            if row is None:
+            from aigw_tpu.tpuserve.adapters import (
+                AdapterCapacityError,
+                UnknownAdapterError,
+            )
+
+            try:
+                # pins (and hot-loads, when non-resident) the row; the
+                # pin transfers to the slot below and is released when
+                # the slot frees
+                adapter_row = self._acquire_adapter(req.adapter)
+            except UnknownAdapterError:
                 req.emit(-1, "error")
                 self.allocator.free(seq_id)
                 return "skipped"
-            adapter_row = row
+            except AdapterCapacityError:
+                # every row pinned by live slots: wait like page
+                # pressure (caller requeues in arrival order)
+                self.allocator.free(seq_id)
+                return "stop"
         key = np.array([[req.sampling.seed or seq_id, 0]], np.uint32)
         bias_row = np.zeros((1, self.model_cfg.vocab_size), np.float32)
         for tok_id, b in req.sampling.logit_bias:
@@ -1573,7 +1791,9 @@ class Engine:
                 sampling_args)
             if isinstance(res, str):
                 # cancelled / engine stopping mid-prompt: hand it back
-                # like an OutOfPages retry ("stop") or consume it
+                # like an OutOfPages retry ("stop") or consume it —
+                # the adapter pin never made it to a slot
+                self._release_adapter_row(adapter_row)
                 self.allocator.free(seq_id)
                 return res
             next_tok, info = res
@@ -2202,6 +2422,7 @@ class Engine:
             if req.trace is not None:
                 req.trace.engine_finish(finish)
             self._pending_frees.append(req.id)
+            self._release_adapter_row(s.adapter_row)
             self._slots[i] = None
             self._dirty_rows.add(i)
             self._wake.set()  # maybe admit a queued request
@@ -2222,6 +2443,20 @@ class Engine:
             self.compile_tracker.compiles_total_ms(), 3)
         self.stats.kv_pages_free = self.allocator.free_pages
         self.stats.kv_occupancy = self.allocator.occupancy
+        # adapter residency + tenant fairness gauges (ISSUE 7)
+        if self._adapter_store is not None:
+            self.stats.adapter_loads = self._adapter_store.loads
+            self.stats.adapter_evictions = self._adapter_store.evictions
+            self.stats.adapter_resident = (
+                self._adapter_store.resident_count)
+        else:
+            self.stats.adapter_resident = len(self.adapter_rows)
+        self.stats.adapter_slots = sum(
+            1 for s in self._slots
+            if s is not None and s.adapter_row != self._base_row)
+        tenants = self._tenant_slots()
+        self.stats.tenants_active = len(tenants)
+        self.stats.tenant_max_slots = max(tenants.values(), default=0)
         self.stats.spec_accept_rate = (
             self.stats.spec_accepted / self.stats.spec_drafted
             if self.stats.spec_drafted else 0.0)

@@ -63,6 +63,11 @@ from aigw_tpu.tpuserve.tokenizer import (
 
 logger = logging.getLogger(__name__)
 
+#: tenant key header (set by clients or derived/relayed by the gateway
+#: from the model's adapter suffix) — feeds the engine's fairness guard
+#: and joins the gateway's per-tenant cost/quota accounting
+TENANT_HEADER = "x-aigw-tenant"
+
 
 def _push_all(decoder: StreamingDecoder, toks: list[int]) -> list[str]:
     """Detokenize a burst (runs on the tokenizer pool: a K-token decode
@@ -122,8 +127,16 @@ class TPUServeServer:
         quantize: str = "",  # "" | "int8" | "int4" (llama-family only)
         # name → adapter param dict (un-stacked [r,in]/[out,r] per target);
         # served when a request's model == "<base>:<adapter>" or the bare
-        # adapter name
+        # adapter name. The dict is the ZOO — only lora_slots adapters
+        # are device-resident at a time (tpuserve/adapters.py hot
+        # load/evict); the rest load on first request.
         lora_adapters: dict[str, dict] | None = None,
+        # device rows for resident adapters; 0 = one row per registered
+        # adapter (everything fits, loads are lazy, no eviction churn)
+        lora_slots: int = 0,
+        # per-tenant in-flight decode-slot cap (engine fairness guard);
+        # 0 = off
+        tenant_slot_cap: int = 0,
         tracer: Tracer | None = None,
         # flight recorder ring size (per-request lifecycle timelines on
         # /debug/requests — always on; the entries are compact)
@@ -189,14 +202,19 @@ class TPUServeServer:
                                      mode=quantize)
             logger.info("weights quantized to %s (W%sA16)", quantize,
                         quantize[-1])
-        lora_params = None
-        adapter_names: tuple[str, ...] = ()
+        adapter_store = None
         if lora_adapters:
             if spec.family != "llama":
                 raise ValueError("LoRA serving supports the llama family")
-            adapter_names = tuple(lora_adapters)
-            lora_params = self._stack_adapters(lora_adapters)
-        self.adapter_names = adapter_names
+            from aigw_tpu.tpuserve.adapters import AdapterStore
+
+            adapter_store = AdapterStore(
+                n_slots=lora_slots or len(lora_adapters))
+            for name, adapter in lora_adapters.items():
+                adapter_store.register(name, adapter)
+        self.adapter_store = adapter_store
+        engine_cfg.tenant_slot_cap = (
+            tenant_slot_cap or engine_cfg.tenant_slot_cap)
         self.engine = Engine(
             params,
             self.model_cfg,
@@ -204,8 +222,7 @@ class TPUServeServer:
             eos_token_ids=(self.tokenizer.eos_id,),
             mesh=mesh,
             fns=self.fns,
-            lora_params=lora_params,
-            adapter_names=adapter_names,
+            adapter_store=adapter_store,
         )
         # jitted embeddings path (bucketed like prefill)
         hidden = self.fns.hidden_states
@@ -256,35 +273,12 @@ class TPUServeServer:
             return restore_checkpoint(path, like)
         raise ValueError(f"unsupported weight source {spec.weights}")
 
-    def _stack_adapters(self, adapters: dict[str, dict]):
-        """Per-adapter dicts → stacked [n+1, ...] arrays (last row zero =
-        base model; models/lora.py layout)."""
-        import jax.numpy as jnp
-        import numpy as np
-
-        names = list(adapters)
-        keys = set()
-        for d in adapters.values():
-            keys.update(d)
-        stacked = {}
-        for k in keys:
-            rows = []
-            for n in names:
-                arr = adapters[n].get(k)
-                if arr is None:
-                    raise ValueError(
-                        f"adapter {n!r} missing tensor {k!r} (all adapters "
-                        "must target the same modules/rank)"
-                    )
-                if rows and arr.shape != rows[0].shape:
-                    raise ValueError(
-                        f"adapter {n!r} tensor {k!r} shape {arr.shape} "
-                        f"differs from {rows[0].shape} (ranks must match)"
-                    )
-                rows.append(np.asarray(arr, np.float32))
-            rows.append(np.zeros_like(rows[0]))  # base-model zero row
-            stacked[k] = jnp.asarray(np.stack(rows)).astype(jnp.bfloat16)
-        return stacked
+    @property
+    def adapter_names(self) -> tuple[str, ...]:
+        """The served zoo (registered adapters, resident or not)."""
+        if self.adapter_store is None:
+            return ()
+        return self.adapter_store.names()
 
     def _resolve_adapter(self, model: str) -> str:
         """`<base>:<adapter>` or bare adapter name → adapter name.
@@ -369,7 +363,7 @@ class TPUServeServer:
 
     def _submit(self, prompt: list[int], body: dict[str, Any],
                 lp_top_n: int = -1, prefix_hashes: list | None = None,
-                trace: RequestTrace | None = None):
+                trace: RequestTrace | None = None, tenant: str = ""):
         """Submit to the engine; returns an asyncio.Queue of
         (token_id, finish_reason, lp) tuples — lp is None without
         logprobs, else (chosen_logprob, [(top_id, top_logprob)]).
@@ -389,6 +383,7 @@ class TPUServeServer:
             body.get("max_completion_tokens") or body.get("max_tokens") or 256
         )
         stop_ids: tuple[int, ...] = ()
+        adapter = self._resolve_adapter(str(body.get("model", "")))
         req = GenRequest(
             prompt=prompt,
             max_tokens=max_tokens,
@@ -396,7 +391,10 @@ class TPUServeServer:
             stop_token_ids=stop_ids,
             emit=emit,
             emit_lp=emit_lp if lp_top_n >= 0 else None,
-            adapter=self._resolve_adapter(str(body.get("model", ""))),
+            adapter=adapter,
+            # a tenant header wins; adapter-suffixed traffic without one
+            # defaults to per-adapter tenancy (each adapter ≈ a tenant)
+            tenant=tenant or adapter,
             prefix_hashes=prefix_hashes,
             trace=trace,
         )
@@ -554,6 +552,7 @@ class TPUServeServer:
         except oai.SchemaError as e:
             return web.Response(status=400, body=oai.error_body(str(e)),
                                 content_type="application/json")
+        tenant = request.headers.get(TENANT_HEADER, "")
         n = int(body.get("n") or 1)
         if n > 1:
             if n > self.engine.cfg.max_batch_size:
@@ -566,9 +565,10 @@ class TPUServeServer:
             if stream:
                 return await self._generate_n_stream(
                     request, body, prompt, chat, n, lp_top_n,
-                    prefix_hashes)
+                    prefix_hashes, tenant)
             return await self._generate_n(body, prompt, chat, n,
-                                          lp_top_n, prefix_hashes)
+                                          lp_top_n, prefix_hashes,
+                                          tenant)
         include_usage = oai.include_stream_usage(body)
         rid = (
             f"chatcmpl-{uuid.uuid4().hex[:24]}"
@@ -592,7 +592,7 @@ class TPUServeServer:
                                   prompt, body, stream, chat)
         try:
             out, gen_req = self._submit(prompt, body, lp_top_n,
-                                        prefix_hashes, trace)
+                                        prefix_hashes, trace, tenant)
         except EngineOverloadedError as e:
             self._end_trace(trace, "rejected", 0, len(prompt),
                             error=str(e))
@@ -858,7 +858,8 @@ class TPUServeServer:
         return resp
 
     def _submit_n(self, body: dict[str, Any], prompt: list[int], n: int,
-                  lp_top_n: int, prefix_hashes: list | None = None):
+                  lp_top_n: int, prefix_hashes: list | None = None,
+                  tenant: str = ""):
         """Fan out n engine submissions with per-choice seeds (shared by
         the buffered and streaming n>1 paths — one copy of the seed
         derivation, overload cleanup, and error mapping). Returns the
@@ -874,7 +875,7 @@ class TPUServeServer:
                     sampling.seed or sampling.temperature > 0
                 ) else 0
                 outs.append(self._submit(prompt, per_choice, lp_top_n,
-                                         prefix_hashes))
+                                         prefix_hashes, tenant=tenant))
         except EngineOverloadedError as e:
             for _q, req in outs:  # don't orphan already-queued choices
                 req.cancelled.set()
@@ -900,13 +901,15 @@ class TPUServeServer:
     async def _generate_n(
         self, body: dict[str, Any], prompt: list[int], chat: bool, n: int,
         lp_top_n: int = -1, prefix_hashes: list | None = None,
+        tenant: str = "",
     ) -> web.Response:
         """n>1 choices: fan out n engine requests (continuous batching
         runs them concurrently — same prompt pages shared by the prefix
         cache) and assemble a multi-choice response."""
         stops = body.get("stop")
         stop_strs = [stops] if isinstance(stops, str) else list(stops or [])
-        outs = self._submit_n(body, prompt, n, lp_top_n, prefix_hashes)
+        outs = self._submit_n(body, prompt, n, lp_top_n, prefix_hashes,
+                              tenant)
         if isinstance(outs, web.Response):
             return outs
         results = await asyncio.gather(
@@ -952,7 +955,7 @@ class TPUServeServer:
     async def _generate_n_stream(
         self, request: web.Request, body: dict[str, Any],
         prompt: list[int], chat: bool, n: int, lp_top_n: int = -1,
-        prefix_hashes: list | None = None,
+        prefix_hashes: list | None = None, tenant: str = "",
     ) -> web.StreamResponse:
         """Streaming n>1 (OpenAI parity; previously 400): fan out n
         engine requests, merge their token streams, and emit one SSE
@@ -963,7 +966,8 @@ class TPUServeServer:
         stops = body.get("stop")
         stop_strs = [stops] if isinstance(stops, str) else list(stops or [])
         include_usage = oai.include_stream_usage(body)
-        outs = self._submit_n(body, prompt, n, lp_top_n, prefix_hashes)
+        outs = self._submit_n(body, prompt, n, lp_top_n, prefix_hashes,
+                              tenant)
         if isinstance(outs, web.Response):
             return outs
 
@@ -1251,9 +1255,32 @@ class TPUServeServer:
         """Endpoint-picker telemetry (KV occupancy, queue depth, and the
         queue-latency / adaptive-window signals the picker scores)."""
         s = self.engine.stats
+        store = self.adapter_store
+        tenant_slots = self.engine._tenant_slots()
         return web.json_response(
             {
                 "model": self.model_name,
+                # adapter serving subsystem (ISSUE 7): the zoo, device
+                # residency, load/evict churn, and in-flight adapter
+                # slots — the gateway picker's adapter-affinity signal
+                # and the capacity dashboard for row sizing
+                "adapters_registered": sorted(self.adapter_names),
+                "adapters_resident": (store.resident_names()
+                                      if store is not None else []),
+                "adapter_rows": (store.n_slots if store is not None
+                                 else 0),
+                "adapter_loads": s.adapter_loads,
+                "adapter_evictions": s.adapter_evictions,
+                "adapter_slots": s.adapter_slots,
+                # multi-tenant fairness surface: who holds decode slots
+                # right now, and how often the per-tenant cap deferred
+                # an admission
+                "tenant_slots": {t or "(anonymous)": c
+                                 for t, c in sorted(tenant_slots.items())},
+                "tenants_active": s.tenants_active,
+                "tenant_max_slots": s.tenant_max_slots,
+                "tenant_deferrals": s.tenant_deferrals,
+                "tenant_slot_cap": self.engine.cfg.tenant_slot_cap,
                 "active_slots": s.active_slots,
                 "max_slots": self.engine.cfg.max_batch_size,
                 "queued": s.queued,
@@ -1398,6 +1425,8 @@ async def run_tpuserve(
     sp: int = 1,
     quantize: str = "",
     lora_adapters: dict | None = None,
+    lora_slots: int = 0,
+    tenant_slot_cap: int = 0,
     decode_steps_per_tick: int = 8,
     enable_prefix_cache: bool = True,
     sp_prefill_min_tokens: int = 1024,
@@ -1438,12 +1467,14 @@ async def run_tpuserve(
             warm_prefill_buckets=warm_prefill_buckets,
             first_token_fast_path=first_token_fast_path,
             prefill_bucket_rungs=prefill_bucket_rungs,
+            tenant_slot_cap=tenant_slot_cap,
         ),
         tp=tp,
         ep=ep,
         sp=sp,
         quantize=quantize,
         lora_adapters=lora_adapters,
+        lora_slots=lora_slots,
         flight_entries=flight_entries,
         enable_profile_endpoint=enable_profile_endpoint,
     )

@@ -251,7 +251,8 @@ def _start_tpuserve_subproc(model_name: str, cfg, quantize: str,
                             batch: int, k_steps: int,
                             engine: dict | None = None,
                             page: int = PAGE,
-                            param_dtype: str = ""):
+                            param_dtype: str = "",
+                            lora: dict | None = None):
     """Serve `model_name` over the real tpuserve HTTP surface in its own
     process (benchmarks/serve_child.py) — the deployment topology. The
     in-thread variant below shares the bench client's GIL, which on a
@@ -270,6 +271,7 @@ def _start_tpuserve_subproc(model_name: str, cfg, quantize: str,
             "ffn_dim", "max_seq_len", "rope_theta")},
         "batch": batch, "page": page, "k": k_steps, "quantize": quantize,
         "engine": engine or {}, "param_dtype": param_dtype,
+        "lora": lora or {},
     }
     here = os.path.dirname(os.path.abspath(__file__))
     proc = subprocess.Popen(
@@ -1104,6 +1106,121 @@ def ragged_prefill_numbers(reps: int = 3, gen_tokens: int = 8) -> dict:
         stop_bkt()
 
 
+# -- lora leg: multi-LoRA adapter serving A/B (ISSUE 7) -------------------
+
+#: adapters in the child's zoo / device rows for them. rows < zoo so the
+#: churn phase exercises a real evict+reload; the TIMED mix rotates only
+#: the first `_LORA_ROWS` adapters (all resident after the warm pass) —
+#: the parity claim is about the zero-row batch, not LRU thrash.
+_LORA_ZOO = 5
+_LORA_ROWS = 4
+
+
+def _lora_ab_fields(st0: dict, st1: dict) -> dict:
+    """Adapter-subsystem telemetry over a capture, derived from /state
+    deltas (pure — unit-tested by the bench smoke)."""
+    return {
+        "adapter_loads": (st1.get("adapter_loads", 0)
+                          - st0.get("adapter_loads", 0)),
+        "adapter_evictions": (st1.get("adapter_evictions", 0)
+                              - st0.get("adapter_evictions", 0)),
+        "adapters_resident": len(st1.get("adapters_resident") or ()),
+        "lora_hot_compiles": (st1.get("xla_compiles", 0)
+                              - st0.get("xla_compiles", 0)),
+    }
+
+
+def lora_numbers(reps: int = 3, requests_per_rep: int = 4,
+                 gen_tokens: int = 64) -> dict:
+    """The ``lora`` A/B leg: ONE tpuserve child serving a 5-adapter zoo
+    over 4 device rows; decode-heavy sequential streaming chats
+    interleave adapter-mix traffic (model ``<base>:t{i}``, rotating
+    adapters so the batch's adapter_idx mix changes every request)
+    with base-only traffic (the zero-row control) — host drift cancels
+    from the tok/s ratio. The criteria this leg reports against:
+
+    - ``lora_mix_vs_base`` ≥ 0.95: an adapter-mix request stream is
+      within 5% tok/s of base-only serving on the SAME engine (one
+      compiled program serves any mix; the zero row is an adapter row,
+      so the control pays the identical gather).
+    - ``lora_hot_compiles`` == 0 over the timed reps AND the churn
+      phase (hot load of a non-resident adapter + evict/reload swap a
+      row's CONTENT, never its program).
+    - ``adapter_loads``/``adapter_evictions`` > 0 in the churn phase:
+      the subsystem actually cycled rows, it didn't just serve a
+      static stack."""
+    import aiohttp
+
+    model_name = "bench-lora-tiny"
+    k = int(os.environ.get("AIGW_BENCH_CPU_K", "4"))
+    url, stop = _start_tpuserve_subproc(
+        model_name, _PREFIX_CFG, "", batch=4, k_steps=k,
+        engine={"min_prefill_bucket": 32, "num_pages": 64,
+                "max_queued_requests": 64, "kv_cache_dtype": "float32"},
+        page=_SPEC_PAGE, param_dtype="float32",
+        lora={"adapters": _LORA_ZOO, "rank": 8, "slots": _LORA_ROWS})
+    content = "ab" * 16
+
+    async def run() -> dict:
+        await _wait_health(url, 1200)
+        timeout = aiohttp.ClientTimeout(total=1200)
+        async with aiohttp.ClientSession(timeout=timeout) as s:
+            # off the clock: hot-load the timed rotation's adapters and
+            # compile every dispatched shape (decode page bucket, the
+            # prefill rung, the adapter-load row scatters ride warmup)
+            for i in range(_LORA_ROWS):
+                await _drive_spec_one(s, url, f"{model_name}:t{i}",
+                                      content, gen_tokens, True)
+            await _drive_spec_one(s, url, model_name, content,
+                                  gen_tokens, True)
+            st0 = await _get_state(s, url)
+            mix, base = [], []
+            for _rep in range(reps):
+                for i in range(requests_per_rep):
+                    mix.append(await _drive_spec_one(
+                        s, url, f"{model_name}:t{i % _LORA_ROWS}",
+                        content, gen_tokens, True))
+                    base.append(await _drive_spec_one(
+                        s, url, model_name, content, gen_tokens, True))
+            st1 = await _get_state(s, url)
+            # churn phase (adapter-mix change): t4 is NOT resident —
+            # admitting it hot-loads over the LRU row; re-asking the
+            # evicted adapter reloads it. Still zero compiles.
+            for m in (f"{model_name}:t{_LORA_ROWS}", f"{model_name}:t0",
+                      f"{model_name}:t1"):
+                await _drive_spec_one(s, url, m, content,
+                                      gen_tokens, True)
+            st2 = await _get_state(s, url)
+
+        def tps(runs):
+            return sum(n for _, n in runs) / sum(d for d, _ in runs)
+
+        mix_tps, base_tps = tps(mix), tps(base)
+        churn = _lora_ab_fields(st1, st2)
+        return {
+            "lora_mix_tps": round(mix_tps, 1),
+            "lora_base_tps": round(base_tps, 1),
+            "lora_mix_vs_base": (round(mix_tps / base_tps, 4)
+                                 if base_tps else 0.0),
+            "lora_mix_spread": round(_spread(
+                [n / d for d, n in mix if d > 0]), 3),
+            "lora_ab_reps": reps * requests_per_rep,
+            "lora_zoo": _LORA_ZOO,
+            "lora_rows": st2.get("adapter_rows", 0),
+            # timed-rep telemetry: loads/evictions should be ZERO here
+            # (the rotation is resident) and compiles zero everywhere
+            **_lora_ab_fields(st0, st1),
+            "lora_churn_loads": churn["adapter_loads"],
+            "lora_churn_evictions": churn["adapter_evictions"],
+            "lora_churn_hot_compiles": churn["lora_hot_compiles"],
+        }
+
+    try:
+        return asyncio.run(run())
+    finally:
+        stop()
+
+
 def _chip_responsive(timeout_s: float = 180.0) -> bool:
     """The axon tunnel can go down entirely (observed 2026-07-28); probe
     with a watchdog so the bench prints an honest line instead of hanging
@@ -1275,6 +1392,11 @@ def run_cpu_ratio() -> dict:
     except Exception as e:
         print(f"ragged_prefill leg failed: {type(e).__name__}: {e}",
               file=sys.stderr)
+    try:
+        res.update(lora_numbers())
+    except Exception as e:
+        print(f"lora leg failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
     return res
 
 
@@ -1362,10 +1484,21 @@ def main() -> None:
                 "warm compile surface are the signal; absolute TTFT "
                 "is not (the CPU child runs the XLA windowed fallback, "
                 "not the DMA-skip kernel)")
+        elif target == "lora":
+            result = lora_numbers()
+            result["metric"] = (
+                "lora interleaved A/B — adapter-mix traffic (rotating "
+                "LoRA adapters, model '<base>:t{i}') vs base-only "
+                "traffic (the zero-row control) on ONE 5-adapter/"
+                "4-row tpuserve child, decode-heavy sequential "
+                "streaming chats on the CPU backend; the tok/s ratio "
+                "(parity), zero hot compiles across mix changes and "
+                "the evict/reload churn phase, and the load/eviction "
+                "counters are the signal — absolute tok/s is not")
         else:
             print(json.dumps({"error": f"unknown --ab target {target!r}; "
                               "supported: prefix_cache, spec_decode, "
-                              "ragged_prefill"}))
+                              "ragged_prefill, lora"}))
             return
         print(json.dumps(result))
         return

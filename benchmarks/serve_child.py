@@ -98,9 +98,32 @@ def main() -> None:
     register_model(ModelSpec(spec["model"], "llama", cfg))
     param_dtype = spec.get("param_dtype", "")
 
+    # multi-LoRA zoo for the --ab lora leg: N random-B adapters named
+    # t0..tN-1, `slots` device rows (fewer than N = hot load/evict
+    # churn under traffic)
+    lora_adapters = None
+    lora_slots = 0
+    lora_spec = spec.get("lora") or {}
+    if lora_spec:
+        from aigw_tpu.models.lora import LoRAConfig, init_lora_adapters
+
+        lcfg = LoRAConfig(
+            rank=int(lora_spec.get("rank", 8)), alpha=16.0,
+            targets=tuple(lora_spec.get("targets", ("wq", "wv"))))
+        n = int(lora_spec.get("adapters", 4))
+        stacked = init_lora_adapters(
+            jax.random.PRNGKey(123), cfg, lcfg, n, random_b=True)
+        lora_adapters = {
+            f"t{i}": {k: v[i] for k, v in stacked.items()}
+            for i in range(n)
+        }
+        lora_slots = int(lora_spec.get("slots", 0))
+
     async def run() -> None:
         server = TPUServeServer(
             model=spec["model"],
+            lora_adapters=lora_adapters,
+            lora_slots=lora_slots,
             engine_cfg=EngineConfig(
                 max_batch_size=spec["batch"],
                 max_seq_len=cfg.max_seq_len,

@@ -1,0 +1,525 @@
+"""Adapter serving subsystem (ISSUE 7, tpuserve/adapters.py): hot
+load/evict of LoRA rows under the refcounted discipline, zero-row
+exactness with adapters resident, adapter mixes through the engine's
+batched/speculative paths, the tenant fairness guard, and the gateway's
+model-zoo routing surface."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aigw_tpu.models import llama
+from aigw_tpu.models.lora import (
+    LoRAConfig,
+    init_lora_adapters,
+    lora_delta,
+    validate_adapter_params,
+)
+from aigw_tpu.tpuserve.adapters import (
+    AdapterCapacityError,
+    AdapterStore,
+    UnknownAdapterError,
+)
+from aigw_tpu.tpuserve.engine import Engine, EngineConfig, GenRequest
+from aigw_tpu.tpuserve.sampling import SamplingParams
+
+CFG = llama.TINY
+LORA = LoRAConfig(rank=4, alpha=8.0, targets=("wq", "wv"))
+
+
+def _adapter_rows(n: int, seed: int = 7) -> dict[str, dict]:
+    stacked = init_lora_adapters(jax.random.PRNGKey(seed), CFG, LORA, n,
+                                 random_b=True)
+    return {
+        f"ad{i}": {k: np.asarray(v[i]) for k, v in stacked.items()}
+        for i in range(n)
+    }
+
+
+def _store(n_slots: int, n_adapters: int, **kw) -> AdapterStore:
+    store = AdapterStore(n_slots=n_slots, **kw)
+    for name, adapter in _adapter_rows(n_adapters).items():
+        store.register(name, adapter)
+    return store
+
+
+def _engine(store=None, f32=False, **over) -> Engine:
+    params = llama.init_params(jax.random.PRNGKey(0), CFG,
+                               jnp.float32 if f32 else jnp.bfloat16)
+    cfg = dict(max_batch_size=4, max_seq_len=128, page_size=16,
+               min_prefill_bucket=16, decode_steps_per_tick=4)
+    if f32:
+        cfg["kv_cache_dtype"] = "float32"
+    cfg.update(over)
+    return Engine(params, CFG, EngineConfig(**cfg), adapter_store=store)
+
+
+def _generate(eng, prompt, adapter="", tenant="", max_tokens=5,
+              sampling=None):
+    done = threading.Event()
+    toks: list[int] = []
+    fins: list[str] = []
+
+    def emit(tok, fin):
+        if tok >= 0:
+            toks.append(tok)
+        if fin is not None:
+            fins.append(fin)
+            done.set()
+
+    eng.submit(GenRequest(
+        prompt=prompt, max_tokens=max_tokens,
+        sampling=sampling or SamplingParams(temperature=0.0),
+        emit=emit, adapter=adapter, tenant=tenant))
+    assert done.wait(timeout=300)
+    return toks, fins[0]
+
+
+# -- lora.py hardening (satellite) ----------------------------------------
+
+class TestLoraHardening:
+    def test_missing_lora_b_is_a_clear_error(self):
+        lora = {"l0.wq.lora_a": jnp.zeros((2, 4, CFG.dim))}
+        x = jnp.zeros((1, 1, CFG.dim))
+        with pytest.raises(ValueError, match="l0.wq.lora_b missing"):
+            lora_delta(lora, "l0.wq", x, jnp.array([0]))
+
+    def test_validate_adapter_params(self):
+        good = _adapter_rows(1)["ad0"]
+        validate_adapter_params(good)  # no raise
+        bad = dict(good)
+        removed = next(k for k in bad if k.endswith(".lora_b"))
+        del bad[removed]
+        with pytest.raises(ValueError, match="no matching"):
+            validate_adapter_params(bad, "broken")
+        with pytest.raises(ValueError, match="unexpected tensor"):
+            validate_adapter_params({"l0.wq.weird": np.zeros((1,))})
+        a = next(k for k in good if k.endswith(".lora_a"))
+        mismatched = dict(good)
+        mismatched[a] = np.zeros((8, CFG.dim))  # rank 8 vs lora_b rank 4
+        with pytest.raises(ValueError, match="rank mismatch"):
+            validate_adapter_params(mismatched, "ranky")
+
+    def test_random_b_does_not_shift_a_key_stream(self):
+        """Satellite: init_lora_adapters must consume keys identically
+        with random_b on/off — the A matrices of seeded tests compare
+        across modes."""
+        on = init_lora_adapters(jax.random.PRNGKey(3), CFG, LORA, 2,
+                                random_b=True)
+        off = init_lora_adapters(jax.random.PRNGKey(3), CFG, LORA, 2,
+                                 random_b=False)
+        for k in on:
+            if k.endswith(".lora_a"):
+                np.testing.assert_array_equal(np.asarray(on[k]),
+                                              np.asarray(off[k]))
+            else:
+                assert not np.asarray(off[k]).any()  # B zero when off
+
+
+# -- AdapterStore units ----------------------------------------------------
+
+class TestAdapterStore:
+    def test_register_validates_template(self):
+        store = _store(2, 1)
+        other = LoRAConfig(rank=8, alpha=8.0, targets=("wq", "wv"))
+        stacked = init_lora_adapters(jax.random.PRNGKey(1), CFG, other, 1,
+                                     random_b=True)
+        wrong_rank = {k: np.asarray(v[0]) for k, v in stacked.items()}
+        with pytest.raises(ValueError, match="template"):
+            store.register("wrong", wrong_rank)
+
+    def test_acquire_release_refcount_lru(self):
+        store = _store(2, 3)
+        assert store.base_row == 2
+        r0 = store.acquire("ad0")
+        assert store.acquire("ad0") == r0  # second pin, same row
+        assert store.refcount("ad0") == 2
+        r1 = store.acquire("ad1")
+        assert r1 != r0
+        # all rows pinned: a third adapter cannot displace a live row
+        with pytest.raises(AdapterCapacityError):
+            store.acquire("ad2")
+        store.release(r1)  # ad1 parks (still resident, revivable)
+        assert store.resident_count == 2
+        assert store.acquire("ad1") == r1  # revived for free, no load
+        loads_before = store.loads
+        store.release(r1)
+        r2 = store.acquire("ad2")  # evicts parked ad1
+        assert r2 == r1
+        assert store.evictions == 1
+        assert store.loads == loads_before + 1
+        with pytest.raises(UnknownAdapterError):
+            store.acquire("nope")
+        store.check_invariants()
+
+    def test_loaded_row_contents_match_host(self):
+        store = _store(2, 2)
+        row = store.acquire("ad1")
+        host = _adapter_rows(2)["ad1"]
+        for k, v in host.items():
+            got = np.asarray(store.params[k][row], np.float32)
+            np.testing.assert_allclose(
+                got, v.astype(np.float32), rtol=0.02, atol=0.02)
+        # base row stays all-zeros through loads
+        for k in store.params:
+            assert not np.asarray(store.params[k][store.base_row]).any()
+
+    def test_property_no_row_reassigned_while_pinned(self):
+        """Randomized acquire/release churn over a 3-row store and a
+        6-adapter zoo: an adapter with a live pin must keep its row
+        (and that row must keep its weights) across every intervening
+        load/evict, and the bookkeeping invariants must hold after
+        every operation."""
+        store = _store(3, 6)
+        rng = random.Random(0xADA)
+        pins: dict[str, list[int]] = {}  # name → outstanding pin rows
+        for _ in range(400):
+            name = f"ad{rng.randrange(6)}"
+            if pins.get(name) and rng.random() < 0.5:
+                store.release(pins[name].pop())
+            else:
+                try:
+                    row = store.acquire(name)
+                except AdapterCapacityError:
+                    # all rows pinned — release something and move on
+                    victim = next(n for n, rs in pins.items() if rs)
+                    store.release(pins[victim].pop())
+                    continue
+                if pins.get(name):
+                    assert row == pins[name][-1], (
+                        "pinned adapter moved rows")
+                pins.setdefault(name, []).append(row)
+            store.check_invariants()
+            for n, rows in pins.items():
+                if rows:
+                    assert store.row_of(n) == rows[-1]
+        # spot-check weights of every still-pinned adapter
+        zoo = _adapter_rows(6)
+        key = next(iter(zoo["ad0"]))
+        for n, rows in pins.items():
+            if rows:
+                got = np.asarray(store.params[key][rows[-1]], np.float32)
+                np.testing.assert_allclose(
+                    got, zoo[n][key].astype(np.float32),
+                    rtol=0.02, atol=0.02)
+
+
+# -- engine integration ----------------------------------------------------
+
+class TestEngineAdapterServing:
+    def test_base_stream_byte_identical_with_adapters_resident(self):
+        """Zero-row exactness (f32 rig): with adapters LOADED and
+        resident, base-model requests produce exactly the tokens of an
+        engine with no LoRA at all."""
+        ref = _engine(store=None, f32=True)
+        ref.start()
+        try:
+            want, _ = _generate(ref, [3, 1, 4, 1, 5], max_tokens=8)
+        finally:
+            ref.stop()
+
+        store = _store(2, 2)
+        eng = _engine(store=store, f32=True)
+        eng.start()
+        try:
+            # make both adapters device-resident first
+            _generate(eng, [9, 9, 9], adapter="ad0")
+            _generate(eng, [9, 9, 9], adapter="ad1")
+            assert store.resident_count == 2
+            got, _ = _generate(eng, [3, 1, 4, 1, 5], max_tokens=8)
+            assert got == want
+        finally:
+            eng.stop()
+
+    def test_mixed_adapter_plain_penalized_batch(self):
+        """One concurrent batch mixing two adapters, a plain slot, and
+        a penalized slot: every member matches its solo run."""
+        store = _store(3, 3)
+        eng = _engine(store=store, f32=True)
+        eng.start()
+        try:
+            pen = SamplingParams(temperature=0.0, frequency_penalty=0.8)
+            solo = [
+                _generate(eng, [10, 20, 30], adapter="ad0")[0],
+                _generate(eng, [10, 20, 30], adapter="ad1")[0],
+                _generate(eng, [10, 20, 30])[0],
+                _generate(eng, [10, 20, 30], sampling=pen)[0],
+            ]
+            results: dict[int, list[int]] = {i: [] for i in range(4)}
+            dones = [threading.Event() for _ in range(4)]
+
+            def mk(i):
+                def emit(tok, fin):
+                    if tok >= 0:
+                        results[i].append(tok)
+                    if fin is not None:
+                        dones[i].set()
+                return emit
+
+            specs = [("ad0", None), ("ad1", None), ("", None),
+                     ("", pen)]
+            for i, (ad, sp) in enumerate(specs):
+                eng.submit(GenRequest(
+                    prompt=[10, 20, 30], max_tokens=5,
+                    sampling=sp or SamplingParams(temperature=0.0),
+                    emit=mk(i), adapter=ad))
+            assert all(d.wait(timeout=300) for d in dones)
+            for i in range(4):
+                assert results[i] == solo[i], f"slot {i} diverged"
+        finally:
+            eng.stop()
+
+    def test_adapter_slot_on_speculating_sequence(self):
+        """An adapter slot riding the speculative verify path emits the
+        same tokens as plain decode (spec on/off token-identical, f32
+        rig) — the adapter_idx row reaches the verify program."""
+        outs = {}
+        for spec in (0, 4):
+            store = _store(2, 2)
+            eng = _engine(store=store, f32=True, spec_tokens=spec)
+            eng.start()
+            try:
+                # repetitive prompt: the n-gram source actually drafts
+                outs[spec] = _generate(
+                    eng, [5, 6, 5, 6, 5, 6], adapter="ad0",
+                    max_tokens=10)[0]
+                if spec:
+                    assert eng.stats.state_rebuilds == 0
+            finally:
+                eng.stop()
+        assert outs[0] == outs[4]
+
+    def test_evict_reload_round_trip(self):
+        """2 rows, 3 adapters: the third admission evicts, a later
+        request for the evicted adapter reloads it and reproduces its
+        original output — and rows pinned by live slots survive."""
+        store = _store(2, 3)
+        eng = _engine(store=store)
+        eng.start()
+        try:
+            first = {}
+            for ad in ("ad0", "ad1", "ad2"):
+                first[ad], _ = _generate(eng, [3, 1, 4, 1, 5], adapter=ad)
+            assert store.evictions >= 1
+            for ad in ("ad0", "ad1", "ad2"):
+                again, _ = _generate(eng, [3, 1, 4, 1, 5], adapter=ad)
+                assert again == first[ad], f"{ad} changed after reload"
+            eng._refresh_stats()
+            assert eng.stats.adapter_loads == store.loads >= 4
+            assert eng.stats.adapter_evictions == store.evictions
+            store.check_invariants()
+        finally:
+            eng.stop()
+
+    def test_unknown_adapter_errors_capacity_waits(self):
+        store = _store(1, 2)
+        eng = _engine(store=store)
+        eng.start()
+        try:
+            _, fin = _generate(eng, [1, 2], adapter="nope")
+            assert fin == "error"
+            # capacity: a long ad0 generation pins the only row; an ad1
+            # request must WAIT (requeue), then complete once ad0 frees
+            done0 = threading.Event()
+
+            def emit0(tok, fin):
+                if fin is not None:
+                    done0.set()
+
+            eng.submit(GenRequest(
+                prompt=[7, 8, 9], max_tokens=40,
+                sampling=SamplingParams(temperature=0.0),
+                emit=emit0, adapter="ad0"))
+            time.sleep(0.2)
+            toks, fin = _generate(eng, [4, 5], adapter="ad1",
+                                  max_tokens=3)
+            assert fin in ("stop", "length") and done0.wait(timeout=300)
+            assert store.evictions >= 1  # ad1 displaced the freed ad0
+        finally:
+            eng.stop()
+
+
+# -- tenant fairness -------------------------------------------------------
+
+class TestTenantFairness:
+    def _mk_req(self, tenant):
+        return GenRequest(prompt=[1], max_tokens=1,
+                          sampling=SamplingParams(), tenant=tenant)
+
+    def test_fair_admission_unit(self):
+        eng = _engine(tenant_slot_cap=2)
+        # two live slots for tenant A
+        for i in range(2):
+            eng._slots[i] = type("S", (), {})()
+            eng._slots[i].req = self._mk_req("A")
+        pending = [self._mk_req("A"), self._mk_req("A"),
+                   self._mk_req("B"), self._mk_req("C")]
+        admit, requeue, capped = eng._fair_admission(pending, free=2)
+        # A is at cap: both A requests deferred; B and C admit,
+        # least-loaded-first ordering is stable on the tie
+        assert [r.tenant for r in admit] == ["B", "C"]
+        assert [r.tenant for r in requeue] == ["A", "A"]
+        assert capped == 2
+
+    def test_deficit_ordering_without_cap(self):
+        eng = _engine()  # cap off: ordering still deficit-weighted
+        eng._slots[0] = type("S", (), {})()
+        eng._slots[0].req = self._mk_req("A")
+        pending = [self._mk_req("A"), self._mk_req("A"),
+                   self._mk_req("B")]
+        admit, requeue, capped = eng._fair_admission(pending, free=3)
+        assert [r.tenant for r in admit] == ["B", "A", "A"]
+        assert requeue == [] and capped == 0
+
+    def test_cap_prevents_starvation_end_to_end(self):
+        """Tenant A floods 5 long requests at a 4-slot engine with a
+        2-slot cap; tenant B's short request lands promptly instead of
+        queuing behind the flood, and A never exceeds the cap."""
+        eng = _engine(tenant_slot_cap=2,
+                      admission_coalesce_ms=0.0)
+        eng.start()
+        finished: list[str] = []
+        lock = threading.Lock()
+        dones = []
+        try:
+            def submit(tag, tenant, n_tokens):
+                done = threading.Event()
+                dones.append(done)
+
+                def emit(tok, fin, t=tag):
+                    if fin is not None:
+                        with lock:
+                            finished.append(t)
+                        done.set()
+
+                eng.submit(GenRequest(
+                    prompt=[3, 1, 4], max_tokens=n_tokens,
+                    sampling=SamplingParams(temperature=0.0),
+                    emit=emit, tenant=tenant))
+
+            for i in range(5):
+                submit(f"A{i}", "A", 40)
+            submit("B0", "B", 3)
+            for d in dones:
+                assert d.wait(timeout=600)
+            # B's 3-token request must not finish behind the whole
+            # flood of 40-token A requests
+            assert finished.index("B0") < len(finished) - 2
+            assert eng.stats.tenant_deferrals >= 1
+            assert eng.stats.tenant_max_slots <= 2
+        finally:
+            eng.stop()
+
+
+# -- gateway surface -------------------------------------------------------
+
+class TestGatewayZoo:
+    def test_split_model(self):
+        from aigw_tpu.gateway.router import split_model
+
+        assert split_model("llama-3-8b:tenant-a") == ("llama-3-8b",
+                                                      "tenant-a")
+        assert split_model("llama-3-8b") == ("llama-3-8b", "")
+
+    def test_match_route_base_fallback(self):
+        from aigw_tpu.config.model import MODEL_NAME_HEADER, Config
+        from aigw_tpu.config.runtime import RuntimeConfig
+        from aigw_tpu.gateway.router import NoRouteError, match_route
+
+        rc = RuntimeConfig.build(Config.parse({
+            "version": "v1",
+            "backends": [{"name": "a", "schema": "OpenAI",
+                          "url": "http://x"}],
+            "routes": [{"name": "r", "rules": [
+                {"models": ["m1"], "backends": ["a"]}]}],
+        }))
+        hit = match_route(rc, "h", {MODEL_NAME_HEADER: "m1:tenant-a"})
+        assert hit.route.name == "r"
+        with pytest.raises(NoRouteError):
+            match_route(rc, "h", {MODEL_NAME_HEADER: "m2:tenant-a"})
+
+    def test_picker_adapter_affinity(self):
+        from aigw_tpu.gateway.picker import (
+            ADAPTER_HEADER,
+            Endpoint,
+            EndpointPicker,
+        )
+
+        p = EndpointPicker([Endpoint("a:1"), Endpoint("b:1")])
+        p.observe("a:1", active_slots=1, max_slots=8)
+        p.observe("b:1", active_slots=1, max_slots=8,
+                  adapters_resident=("fr",))
+        explain: dict = {}
+        # tie on load → the adapter-resident replica wins
+        assert p.pick({ADAPTER_HEADER: "fr"}, explain=explain) == "b:1"
+        assert explain["adapter_affinity"] is True
+        # saturation still overrides the bonus
+        p.observe("b:1", active_slots=8, max_slots=8, queued=8,
+                  adapters_resident=("fr",))
+        assert p.pick({ADAPTER_HEADER: "fr"}) == "a:1"
+
+    def test_gateway_models_lists_replica_zoo(self):
+        """Gateway /v1/models merges the adapter zoo discovered from
+        picker-polled replica /state: '<base>:<adapter>' entries appear
+        when their base model routes here, with no per-adapter config."""
+        import asyncio
+
+        import aiohttp
+
+        from aigw_tpu.config.model import Config
+        from aigw_tpu.config.runtime import RuntimeConfig
+        from aigw_tpu.gateway.server import run_gateway
+
+        async def main():
+            cfg = Config.parse({
+                "version": "v1",
+                "backends": [{
+                    "name": "pool", "schema": "OpenAI",
+                    "endpoints": ["127.0.0.1:19997"],
+                }],
+                "routes": [{"name": "r", "rules": [
+                    {"models": ["tiny-random"], "backends": ["pool"]}]}],
+                "models": ["tiny-random"],
+            })
+            server, runner = await run_gateway(RuntimeConfig.build(cfg),
+                                               port=0)
+            site = list(runner.sites)[0]
+            port = site._server.sockets[0].getsockname()[1]
+            # stop the real poll loop (the endpoint is fake — a poll
+            # failure would reset healthy) and inject replica telemetry
+            # (≈ one /state poll result)
+            await server._pickers["pool"].stop()
+            server._pickers["pool"].observe(
+                "127.0.0.1:19997", model="tiny-random",
+                adapters_registered=("fr", "de"),
+                adapters_resident=("fr",))
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(
+                        f"http://127.0.0.1:{port}/v1/models") as r:
+                        assert r.status == 200
+                        ids = [m["id"] for m in
+                               (await r.json())["data"]]
+                assert "tiny-random" in ids
+                assert "tiny-random:fr" in ids
+                assert "tiny-random:de" in ids
+            finally:
+                await runner.cleanup()
+
+        asyncio.run(main())
+
+    def test_cost_expression_tenant_variable(self):
+        from aigw_tpu.gateway.costs import CostProgram, TokenUsage
+
+        prog = CostProgram(
+            "total_tokens * 2 if tenant == 'gold' else total_tokens")
+        u = TokenUsage(total_tokens=10)
+        assert prog.evaluate(u, tenant="gold") == 20
+        assert prog.evaluate(u, tenant="basic") == 10

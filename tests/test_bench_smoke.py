@@ -154,3 +154,24 @@ def test_bench_ragged_ab_fields():
     assert f["ragged_warmup_ms"] == 900.0
     z = bench._ragged_ab_fields(st1, st1, "b")
     assert z["b_padded_frac"] == 0.0 and z["b_prefill_tokens"] == 0
+
+
+@pytest.mark.bench_smoke
+def test_bench_lora_ab_fields():
+    """The --ab lora JSON derives its adapter-subsystem telemetry from
+    /state deltas through this pure helper: load/eviction counters must
+    be capture deltas (not absolutes), residency is the current count,
+    and hot compiles come from the xla counter delta."""
+    st0 = {"adapter_loads": 4, "adapter_evictions": 0,
+           "adapters_resident": ["t0", "t1", "t2", "t3"],
+           "xla_compiles": 12}
+    st1 = {"adapter_loads": 7, "adapter_evictions": 3,
+           "adapters_resident": ["t0", "t1", "t3", "t4"],
+           "xla_compiles": 12}
+    f = bench._lora_ab_fields(st0, st1)
+    assert f["adapter_loads"] == 3
+    assert f["adapter_evictions"] == 3
+    assert f["adapters_resident"] == 4
+    assert f["lora_hot_compiles"] == 0
+    z = bench._lora_ab_fields(st1, st1)
+    assert z["adapter_loads"] == 0 and z["adapter_evictions"] == 0

@@ -324,6 +324,99 @@ RAGGED_GAUGES = (
 )
 
 
+# -- adapter serving + tenancy (ISSUE 7) ---------------------------------
+
+ADAPTER_STATE_FIELDS = (
+    "adapters_registered",
+    "adapters_resident",
+    "adapter_rows",
+    "adapter_loads",
+    "adapter_evictions",
+    "adapter_slots",
+    "tenant_slots",
+    "tenants_active",
+    "tenant_max_slots",
+    "tenant_deferrals",
+    "tenant_slot_cap",
+)
+
+ADAPTER_GAUGES = (
+    "tpuserve_adapter_loads_total",
+    "tpuserve_adapter_evictions_total",
+    "tpuserve_adapter_resident",
+    "tpuserve_adapter_slots",
+    "tpuserve_tenants_active",
+    "tpuserve_tenant_max_slots",
+    "tpuserve_tenant_deferrals_total",
+)
+
+
+def test_state_and_metrics_export_adapter_gauges(smoke_url):
+    """The adapter/tenant surface (ISSUE 7) must appear on /state and
+    /metrics even with no adapters loaded (constant 0 / empty lists) —
+    dashboards and the bench --ab lora leg read these."""
+    state = json.loads(asyncio.run(_get(smoke_url, "/state")))
+    for field in ADAPTER_STATE_FIELDS:
+        assert field in state, f"/state lost {field}"
+    text = asyncio.run(_get(smoke_url, "/metrics")).decode()
+    for gauge in ADAPTER_GAUGES:
+        assert gauge in text, f"/metrics lost {gauge}"
+
+
+def test_adapter_mix_changes_zero_hot_compiles():
+    """Compile-on-hot-path tripwire for the adapter subsystem (ISSUE
+    7): after warmup() (which pre-compiles the hot-load row scatters
+    alongside the decode/prefill surface), traffic that admits a
+    NON-RESIDENT adapter (hot load), switches the batch's adapter mix,
+    mixes adapter and base slots, and forces an eviction+reload must
+    add ZERO XLA compiles — one program family serves any mix. One
+    64-token page keeps the decode bucket at the warmup size."""
+    from aigw_tpu.models.lora import LoRAConfig, init_lora_adapters
+    from aigw_tpu.tpuserve.adapters import AdapterStore
+
+    spec_cfg = llama.TINY
+    lora_cfg = LoRAConfig(rank=4, alpha=8.0, targets=("wq", "wv"))
+    stacked = init_lora_adapters(jax.random.PRNGKey(5), spec_cfg,
+                                 lora_cfg, 3, random_b=True)
+    store = AdapterStore(n_slots=2)
+    for i in range(3):
+        store.register(f"ad{i}", {k: v[i] for k, v in stacked.items()})
+    params = llama.init_params(jax.random.PRNGKey(0), spec_cfg)
+    eng = Engine(params, spec_cfg, EngineConfig(
+        max_batch_size=2, max_seq_len=256, page_size=64,
+        min_prefill_bucket=16, decode_steps_per_tick=4,
+        warm_prefill_buckets=2, enable_prefix_cache=False),
+        adapter_store=store)
+    eng.warmup()
+    checkpoint = eng.compile_tracker.checkpoint()
+    eng.start()
+    try:
+        # mixes: base-only, hot-load ad0, hot-load ad1, concurrent
+        # ad0+base (LRU revival), then ad2 (evicts ad1) and ad1 again
+        # (reloads over the parked ad0)
+        for adapters in (("",), ("ad0",), ("ad1",), ("ad0", ""),
+                         ("ad2",), ("ad1",)):
+            events = []
+            for ad in adapters:
+                done = threading.Event()
+                eng.submit(GenRequest(
+                    prompt=[7, 8, 9], max_tokens=3,
+                    sampling=SamplingParams(temperature=0.0),
+                    emit=lambda t, f, d=done: d.set() if f else None,
+                    adapter=ad))
+                events.append(done)
+            for e in events:
+                assert e.wait(timeout=300)
+        # ad0/ad1/ad2 first loads + ad1's reload after its eviction
+        assert eng.stats.adapter_loads >= 4
+        assert eng.stats.adapter_evictions >= 2
+        assert eng.compile_tracker.compiles_since(checkpoint) == 0, (
+            f"adapter-mix change paid an XLA compile after warmup: "
+            f"{eng.compile_tracker.programs()}")
+    finally:
+        eng.stop()
+
+
 def test_state_and_metrics_export_padding_fields(smoke_url):
     """The padding-tax + cold-start surface (ISSUE 6) must appear on
     /state and /metrics — a renamed EngineStats field silently drops

@@ -123,6 +123,80 @@ class TestGatewayQuota:
         asyncio.run(main())
 
 
+class TestTenantQuota:
+    def test_per_tenant_budget_from_model_suffix(self):
+        """Multi-tenant accounting (ISSUE 7): a quota keyed on
+        x-aigw-tenant enforces per-tenant budgets with NO explicit
+        header — the gateway derives the tenant from the model's
+        adapter suffix ('m1:tenant-a'), routes the name via its base
+        model, and draws the tenant's bucket down at end-of-stream."""
+
+        async def main():
+            up = FakeUpstream().on_json(
+                "/v1/chat/completions",
+                openai_chat_response(prompt_tokens=5,
+                                     completion_tokens=45),
+            )
+            await up.start()
+            cfg = Config.parse({
+                "version": "v1",
+                "backends": [
+                    {"name": "a", "schema": "OpenAI", "url": up.url}
+                ],
+                # only the BASE model is routed: adapter-suffixed names
+                # reach it through the model-zoo fallback
+                "routes": [{"name": "r", "rules": [
+                    {"models": ["m1"], "backends": ["a"]}]}],
+                "llm_request_costs": [
+                    {"metadata_key": "total", "type": "TotalToken"}
+                ],
+                "quotas": [
+                    {"name": "per-tenant", "metadata_key": "total",
+                     "limit": 60, "window_seconds": 3600,
+                     "client_key_header": "x-aigw-tenant"}
+                ],
+            })
+            server, runner = await run_gateway(RuntimeConfig.build(cfg),
+                                               port=0)
+            site = list(runner.sites)[0]
+            port = site._server.sockets[0].getsockname()[1]
+            url = f"http://127.0.0.1:{port}/v1/chat/completions"
+
+            def payload(model):
+                return {"model": model,
+                        "messages": [{"role": "user", "content": "hi"}]}
+
+            try:
+                async with aiohttp.ClientSession() as s:
+                    # tenant-a: 50 + 50 tokens admitted, then 429
+                    for expect in (200, 200, 429):
+                        async with s.post(
+                            url, json=payload("m1:tenant-a"),
+                        ) as r:
+                            assert r.status == expect, (
+                                expect, await r.read())
+                    # tenant-b's bucket is untouched; so is the
+                    # anonymous base-model bucket
+                    async with s.post(url,
+                                      json=payload("m1:tenant-b")) as r:
+                        assert r.status == 200
+                    async with s.post(url, json=payload("m1")) as r:
+                        assert r.status == 200
+                    # an explicit header overrides the derived tenant:
+                    # riding tenant-a's exhausted bucket still 429s on
+                    # the PLAIN model name
+                    async with s.post(
+                        url, json=payload("m1"),
+                        headers={"x-aigw-tenant": "tenant-a"},
+                    ) as r:
+                        assert r.status == 429
+            finally:
+                await runner.cleanup()
+                await up.stop()
+
+        asyncio.run(main())
+
+
 class TestReloadCarryover:
     def test_adopt_preserves_windows(self):
         """Config hot reload must not refill exhausted budgets."""
