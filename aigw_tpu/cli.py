@@ -325,6 +325,14 @@ def main(argv: list[str] | None = None) -> int:
                               "(x-aigw-tenant / adapter suffix) may hold "
                               "— the fairness guard against one "
                               "tenant's burst starving others (0 = off)")
+    p_serve.add_argument("--migration-young-tokens", type=int,
+                         default=64,
+                         help="migration-eligibility window: a slot "
+                              "counts as migratable on /state while its "
+                              "generated tokens are at most this "
+                              "(prefill done, decode young — the "
+                              "gateway's disaggregation signal; 0 = "
+                              "every decoding slot counts)")
     p_serve.add_argument("--platform", default="",
                          help="force a JAX platform (e.g. cpu for the "
                               "fake-chip mode; default: auto/TPU)")
@@ -884,6 +892,7 @@ async def _run_tpuserve(args: argparse.Namespace) -> int:
         prefill_bucket_rungs=args.prefill_bucket_rungs,
         flight_entries=args.flight_entries,
         enable_profile_endpoint=args.enable_profile_endpoint,
+        migration_young_tokens=args.migration_young_tokens,
     )
     print(f"tpuserve listening on http://{args.host}:{args.port}", flush=True)
     await _wait_for_signal()

@@ -278,6 +278,13 @@ class LLMRequestCost:
         return d
 
 
+def _check_picker_mode(mode: str) -> str:
+    if mode not in ("static", "slo"):
+        raise ConfigError(
+            f"picker_mode must be 'static' or 'slo' (got {mode!r})")
+    return mode
+
+
 @dataclass(frozen=True)
 class Backend:
     """One upstream backend: schema + address + auth + mutations.
@@ -302,6 +309,27 @@ class Backend:
     # the replica holding their KV prefix cache. Explicit
     # x-aigw-session-affinity headers still win.
     picker_content_affinity: bool = False
+    # Endpoint-picker scoring mode (ISSUE 8): "static" = the classic
+    # occupancy/queue score sum; "slo" = rank replicas by PREDICTED
+    # TTFT derived from each replica's live phase histograms + queue
+    # depth, with admission control against slo_ttft_ms.
+    picker_mode: str = "static"
+    # TTFT SLO budget in milliseconds for slo mode: when > 0 and every
+    # candidate's predicted TTFT exceeds it, the gateway sheds the
+    # request with 429 + Retry-After instead of queueing into collapse.
+    # 0 = route predictively but never shed.
+    slo_ttft_ms: float = 0.0
+    # Prefill/decode disaggregation (ISSUE 8): let the gateway hand a
+    # young streaming session from a prefill-pressured replica to a
+    # decode-leaning sibling (KV page migration through the replicas'
+    # /migrate endpoints). Requires an endpoint pool.
+    migration: bool = False
+    # Migrate only while the source replica's admission queue is at
+    # least this deep (prefill pressure)…
+    migration_queue_depth: int = 2
+    # …and only sessions still young (streamed tokens ≤ this): mature
+    # decodes have amortized their prefill and aren't worth moving.
+    migration_young_tokens: int = 32
     auth: AuthConfig = AuthConfig()
     header_mutation: HeaderMutation = HeaderMutation()
     body_mutation: BodyMutation = BodyMutation()
@@ -330,6 +358,14 @@ class Backend:
                 picker_content_affinity=bool(
                     value.get("picker_content_affinity", False)
                 ),
+                picker_mode=_check_picker_mode(
+                    str(value.get("picker_mode", "static"))),
+                slo_ttft_ms=float(value.get("slo_ttft_ms", 0.0)),
+                migration=bool(value.get("migration", False)),
+                migration_queue_depth=int(
+                    value.get("migration_queue_depth", 2)),
+                migration_young_tokens=int(
+                    value.get("migration_young_tokens", 32)),
                 auth=AuthConfig.parse(value.get("auth")),
                 header_mutation=HeaderMutation.parse(value.get("header_mutation")),
                 body_mutation=BodyMutation.parse(value.get("body_mutation")),
@@ -350,6 +386,16 @@ class Backend:
             d["picker_poll_interval"] = self.picker_poll_interval
         if self.picker_content_affinity:
             d["picker_content_affinity"] = True
+        if self.picker_mode != "static":
+            d["picker_mode"] = self.picker_mode
+        if self.slo_ttft_ms:
+            d["slo_ttft_ms"] = self.slo_ttft_ms
+        if self.migration:
+            d["migration"] = True
+        if self.migration_queue_depth != 2:
+            d["migration_queue_depth"] = self.migration_queue_depth
+        if self.migration_young_tokens != 32:
+            d["migration_young_tokens"] = self.migration_young_tokens
         if self.auth.kind is not AuthKind.NONE:
             d["auth"] = self.auth.to_dict()
         if self.header_mutation != HeaderMutation():

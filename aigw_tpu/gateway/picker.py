@@ -80,6 +80,21 @@ ADAPTER_HEADER = "x-aigw-adapter"
 TENANT_HEADER = "x-aigw-tenant"
 
 
+class SLOShedError(Exception):
+    """Every fresh candidate's predicted TTFT blows the configured SLO:
+    admitting the request would queue it into collapse. The gateway
+    surfaces 429 + Retry-After instead (ISSUE 8 admission control)."""
+
+    def __init__(self, retry_after_s: int, predicted_ms: float,
+                 slo_ms: float):
+        super().__init__(
+            f"predicted TTFT {predicted_ms:.0f}ms exceeds the "
+            f"{slo_ms:.0f}ms SLO on every candidate replica")
+        self.retry_after_s = retry_after_s
+        self.predicted_ms = predicted_ms
+        self.slo_ms = slo_ms
+
+
 @dataclass(frozen=True)
 class Endpoint:
     address: str  # host:port
@@ -114,6 +129,14 @@ class EndpointState:
     # slice_index) — overrides the statically configured slice label, so
     # topology follows reality after reschedules
     slice_name: str = ""
+    # serving-phase latency distributions polled from /state
+    # (phase → {p50, p95, p99} in ms; -1 = no observations) — the
+    # SLO-aware mode's predictive inputs (ISSUE 8)
+    phase_percentiles: dict = field(default_factory=dict)
+    # migration-eligibility gauge polled from /state: slots whose
+    # prefill is done but decode is young — what a decode-leaning
+    # sibling could take over
+    migratable_slots: int = 0
     updated_at: float = 0.0
 
 
@@ -123,9 +146,24 @@ class EndpointPicker:
     STALE_AFTER = 10.0  # seconds without telemetry → treat as unknown
 
     def __init__(self, endpoints: list[Endpoint],
-                 poll_interval: float = 1.0):
+                 poll_interval: float = 1.0,
+                 mode: str = "static",
+                 slo_ttft_ms: float = 0.0):
+        if mode not in ("static", "slo"):
+            raise ValueError(f"picker mode must be 'static' or 'slo' "
+                             f"(got {mode!r})")
         self.endpoints = endpoints
         self.poll_interval = poll_interval
+        #: "static" — the classic score sum; "slo" — rank candidates by
+        #: PREDICTED TTFT derived from each replica's live phase
+        #: histograms + queue depth (ISSUE 8), falling back to static
+        #: scoring while no replica has histogram data yet
+        self.mode = mode
+        #: admission-control budget for slo mode: when > 0 and every
+        #: fresh candidate's predicted TTFT exceeds it, pick() raises
+        #: SLOShedError instead of routing (the gateway sheds with
+        #: 429 + Retry-After). 0 = route-only (never shed).
+        self.slo_ttft_ms = slo_ttft_ms
         self.state: dict[str, EndpointState] = {
             e.address: EndpointState() for e in endpoints
         }
@@ -186,6 +224,8 @@ class EndpointPicker:
         st.max_slots = max(1, int(data.get("max_slots", 1)))
         st.queue_wait_ms = float(data.get("queue_wait_ms", 0.0))
         st.prefix_hit_rate = float(data.get("prefix_cache_hit_rate", 0.0))
+        st.phase_percentiles = dict(data.get("phase_percentiles") or {})
+        st.migratable_slots = int(data.get("migratable_slots", 0))
         st.slice_name = str(data.get("slice", "") or "")
         st.model = str(data.get("model", "") or "")
         st.adapters_resident = frozenset(
@@ -202,7 +242,9 @@ class EndpointPicker:
                 slice_name: str = "",
                 adapters_resident: tuple = (),
                 model: str = "",
-                adapters_registered: tuple = ()) -> None:
+                adapters_registered: tuple = (),
+                phase_percentiles: dict | None = None,
+                migratable_slots: int = 0) -> None:
         st = self.state[address]
         st.healthy = True
         st.kv_occupancy = kv_occupancy
@@ -211,6 +253,9 @@ class EndpointPicker:
         st.max_slots = max(1, max_slots)
         st.queue_wait_ms = queue_wait_ms
         st.prefix_hit_rate = prefix_hit_rate
+        if phase_percentiles is not None:
+            st.phase_percentiles = dict(phase_percentiles)
+        st.migratable_slots = migratable_slots
         if slice_name:
             st.slice_name = slice_name
         if adapters_resident:
@@ -246,6 +291,42 @@ class EndpointPicker:
     #: recreate than a warm KV prefix, and any replica can load it.
     ADAPTER_AFFINITY_BONUS = 0.2
     _AFFINITY_MAX = 100_000
+
+    # -- slo mode (ISSUE 8) -------------------------------------------------
+    #: affinity adjustments in PREDICTED-TTFT MILLISECONDS (slo mode
+    #: ranks in ms, not score units). A replica whose prefix cache holds
+    #: the prompt head skips most of its prefill — worth a real ms
+    #: bonus; a resident adapter saves a row load; leaving the session's
+    #: slice costs ICI→DCN on any future KV transfer.
+    PREFIX_AFFINITY_BONUS_MS = 100.0
+    ADAPTER_AFFINITY_BONUS_MS = 50.0
+    SLICE_PENALTY_MS = 50.0
+    #: a sticky session stays put unless its replica's predicted TTFT
+    #: exceeds the best candidate's by this much
+    STICKINESS_MARGIN_MS = 250.0
+
+    def predicted_ttft_ms(self, st: EndpointState) -> float | None:
+        """Predicted TTFT for a NEW arrival on this replica, from its
+        live phase histograms (PR 5) + queue depth: the arrival stands
+        behind ``queued`` waiting requests plus itself — admitted in
+        BATCHED prefill passes of up to ``max_slots`` prompts each
+        (tpuserve coalesces same-burst admissions into one [G, S]
+        call, so the queue drains in ceil((queued+1)/max_slots) prefill
+        rounds, not queued+1 serial prefills) — plus however long the
+        current queue head has already been stuck (queue_wait_ms: a
+        moving queue predicts near zero, a wedged one predicts its own
+        stall). None when the replica has no histogram data at all — a
+        replica that has served nothing predicts nothing."""
+        pp = st.phase_percentiles or {}
+        pf = float((pp.get("prefill") or {}).get("p50", -1.0))
+        if pf < 0:
+            # no prefill observations yet (e.g. decode-only so far):
+            # fall back to the whole-TTFT distribution
+            pf = float((pp.get("ttft") or {}).get("p50", -1.0))
+            if pf < 0:
+                return None
+        rounds = -(-(st.queued + 1) // max(1, st.max_slots))
+        return st.queue_wait_ms + pf * rounds
 
     def _slice_of(self, addr: str) -> str:
         """Effective slice of an endpoint: the slice the replica itself
@@ -305,7 +386,63 @@ class EndpointPicker:
 
         scores = {e.address: score_of(e) for e in self.endpoints}
         fresh = {a: s for a, s in scores.items() if s is not None}
-        if not fresh:
+        # slo mode (ISSUE 8): rank by PREDICTED TTFT from live phase
+        # histograms instead of the static score sum. Candidates with no
+        # histogram data yet predict 0 (a replica that has served
+        # nothing is presumed idle); only when NO candidate has data
+        # does the picker fall back to static scoring — and it never
+        # sheds blind.
+        pred_raw: dict[str, float | None] = {}
+        if self.mode == "slo" and fresh:
+            pred_raw = {a: self.predicted_ttft_ms(self.state[a])
+                        for a in fresh}
+        if any(p is not None for p in pred_raw.values()):
+            pred = {a: (p if p is not None else 0.0)
+                    for a, p in pred_raw.items()}
+            if self.slo_ttft_ms > 0:
+                # admission control on the RAW predictions (capacity,
+                # not preference): every candidate blown → shed now
+                # rather than queue the request into collapse
+                best_raw = min(pred.values())
+                if best_raw > self.slo_ttft_ms:
+                    retry = max(1, int(
+                        -(-(best_raw - self.slo_ttft_ms) // 1000)))
+                    if explain is not None:
+                        explain.update(
+                            mode="slo", shed=True, candidates=len(pred),
+                            predicted_ttft_ms={
+                                a: round(p, 1) for a, p in pred.items()},
+                            retry_after_s=retry)
+                    raise SLOShedError(retry, best_raw, self.slo_ttft_ms)
+            adj = {}
+            for a, p in pred.items():
+                v = p
+                if prev_slice and self._slice_of(a) != prev_slice:
+                    v += self.SLICE_PENALTY_MS
+                if prefix_addr == a:
+                    v -= self.PREFIX_AFFINITY_BONUS_MS
+                if adapter_key and adapter_key in \
+                        self.state[a].adapters_resident:
+                    v -= self.ADAPTER_AFFINITY_BONUS_MS
+                adj[a] = v
+            chosen = min(adj, key=adj.__getitem__)
+            if (prev_addr in adj and adj[prev_addr]
+                    <= adj[chosen] + self.STICKINESS_MARGIN_MS):
+                chosen = prev_addr
+            if explain is not None:
+                explain.update(
+                    mode="slo",
+                    candidates=len(adj),
+                    predicted_ttft_ms={a: round(p, 1)
+                                       for a, p in pred.items()},
+                    predicted_ttft_chosen_ms=round(pred[chosen], 1),
+                    sticky=chosen == prev_addr and bool(affinity_key),
+                    prefix_affinity=chosen == prefix_addr
+                    and bool(prefix_key),
+                    adapter_affinity=bool(adapter_key) and adapter_key
+                    in self.state[chosen].adapters_resident,
+                )
+        elif not fresh:
             # no telemetry (cold start / all down): round-robin blindly
             chosen = next(self._rr)
             if explain is not None:

@@ -51,6 +51,7 @@ from aigw_tpu.gateway.picker import (
     TENANT_HEADER,
     Endpoint as PickerEndpoint,
     EndpointPicker,
+    SLOShedError,
 )
 from aigw_tpu.gateway.router import (
     BackendSelector,
@@ -318,13 +319,16 @@ class GatewayServer:
             if not b.endpoints:
                 continue
             prev = self._pickers.get(name)
-            key = (b.endpoints, b.picker_poll_interval)
+            key = (b.endpoints, b.picker_poll_interval, b.picker_mode,
+                   b.slo_ttft_ms)
             if prev is not None and getattr(prev, "_config_key", None) == key:
                 pickers[name] = prev  # unchanged pool: keep state
                 continue
             picker = EndpointPicker(
                 [PickerEndpoint.parse(_thaw(e)) for e in b.endpoints],
                 poll_interval=b.picker_poll_interval,
+                mode=b.picker_mode,
+                slo_ttft_ms=b.slo_ttft_ms,
             )
             picker._config_key = key  # type: ignore[attr-defined]
             pickers[name] = picker
@@ -503,6 +507,26 @@ class GatewayServer:
                 return web.Response(
                     status=400,
                     body=error_body("invalid gzip request body"),
+                    content_type="application/json")
+        elif enc and enc not in ("identity", "gzip", "deflate"):
+            # aiohttp transparently inflates gzip/deflate (and br when
+            # the Brotli package exists); any OTHER declared coding
+            # reaches this handler UNDECODED on this aiohttp — parsing
+            # those raw bytes as JSON would be a silent mis-read, so
+            # it's the client's 400 (the inference-extension
+            # conformance contract: undecodable encodings are 400s,
+            # never 500s or accidental 200s)
+            try:
+                from aiohttp.compression_utils import HAS_BROTLI
+            except ImportError:  # pragma: no cover — old aiohttp
+                HAS_BROTLI = False
+            if not (enc == "br" and HAS_BROTLI):
+                self._log_rejection(request, 400, started,
+                                    reason="bad_encoding")
+                return web.Response(
+                    status=400,
+                    body=error_body(
+                        f"unsupported content-encoding: {enc}"),
                     content_type="application/json")
         # ---- phase 1: route selection ----------------------------------
         if endpoint in _MULTIPART_ENDPOINTS:
@@ -883,15 +907,36 @@ class GatewayServer:
                     ADAPTER_HEADER: adapter}
             explain: dict[str, Any] | None = (
                 {} if span is not None else None)
-            dest = self._pickers[backend.name].pick(
-                pick_headers, explain=explain) or ""
+            try:
+                dest = self._pickers[backend.name].pick(
+                    pick_headers, explain=explain) or ""
+            except SLOShedError as e:
+                # SLO admission control (ISSUE 8): every candidate's
+                # predicted TTFT blows the budget — shed with
+                # 429 + Retry-After instead of queueing into collapse
+                self.metrics.slo_sheds_total.labels(
+                    route_name, backend.name).inc()
+                self.metrics.requests_total.labels(
+                    route_name, backend.name, "429").inc()
+                req_metrics.finish(TokenUsage(), error_type="slo_shed")
+                if span is not None:
+                    span.set("aigw.pick.shed", True)
+                    span.set("aigw.pick.predicted_ttft_ms",
+                             round(e.predicted_ms, 1))
+                return web.Response(
+                    status=429,
+                    body=error_body(str(e), type_="rate_limit_error"),
+                    headers={"retry-after": str(e.retry_after_s)},
+                    content_type="application/json")
             if span is not None and dest:
                 # why the picker chose this replica — the span-level
                 # answer to "which endpoint served me, and was it
-                # cache/session affinity or load"
+                # cache/session affinity or load" (slo mode adds the
+                # per-endpoint predicted TTFTs behind the decision)
                 span.set("aigw.endpoint", dest)
                 for k, v in (explain or {}).items():
-                    span.set(f"aigw.pick.{k}", v)
+                    span.set(f"aigw.pick.{k}",
+                             json.dumps(v) if isinstance(v, dict) else v)
         base_url = f"http://{dest}" if dest else backend.url
         if not base_url:
             raise _RetriableUpstreamError(
@@ -976,10 +1021,21 @@ class GatewayServer:
                 or "vnd.amazon.eventstream" in ctype
             )
             if upstream_streams:
+                migrator = None
+                if (backend.migration and dest
+                        and backend.name in self._pickers
+                        and endpoint in (Endpoint.CHAT_COMPLETIONS,
+                                         Endpoint.COMPLETIONS)):
+                    # prefill/decode disaggregation (ISSUE 8): this
+                    # stream may be handed to a decode-leaning replica
+                    # mid-flight if the source's prefill queue backs up
+                    migrator = _Migrator(
+                        picker=self._pickers[backend.name],
+                        backend=backend, src=dest, session=session)
                 return await self._stream_response(
                     request, resp, translator, rb, req_metrics, route_name,
                     client_headers, front_schema, span=span,
-                    endpoint=endpoint,
+                    endpoint=endpoint, migrator=migrator,
                 )
             try:
                 raw = await resp.read()
@@ -1068,6 +1124,7 @@ class GatewayServer:
         front_schema: APISchemaName = APISchemaName.OPENAI,
         span=None,
         endpoint: Endpoint | None = None,
+        migrator: "_Migrator | None" = None,
     ) -> web.StreamResponse:
         """Proxy the SSE stream through the translator — the hot loop
         (reference processor_impl.go:481-575).
@@ -1181,6 +1238,37 @@ class GatewayServer:
                 req_metrics.record_tokens_emitted(rx.tokens_emitted)
                 if rx.body:
                     await _relay(rx.body)
+                if migrator is not None:
+                    # may cut the session at the source: its stream
+                    # then ends at a token boundary and this loop runs
+                    # to EOF, flushing every pre-cut token first
+                    await migrator.maybe_export(
+                        req_metrics.tokens_seen,
+                        req_metrics.upstream_request_id)
+            if migrator is not None and migrator.export is not None:
+                # splice the decode replica's continuation: frames carry
+                # the SAME response id, terminal frames included — the
+                # client sees one uninterrupted stream
+                cont = await migrator.start_continuation()
+                if cont is None:
+                    # the session was cut but nobody resumed it — this
+                    # is a real mid-stream loss; surface the SSE error
+                    # event via the except path below
+                    raise aiohttp.ClientPayloadError(
+                        "migration continuation failed after export")
+                self.metrics.migrations_total.labels(
+                    route_name, rb.backend.name).inc()
+                if span is not None:
+                    span.set("aigw.migrated_to", migrator.target)
+                async with _closing(cont):
+                    async for chunk in cont.content.iter_any():
+                        rx = translator.response_body(chunk, False)
+                        usage = usage.merge_override(rx.usage)
+                        model = rx.model or model
+                        req_metrics.record_tokens_emitted(
+                            rx.tokens_emitted)
+                        if rx.body:
+                            await _relay(rx.body)
             if self._translator_blocks(endpoint):
                 # end-of-stream persists the transcript to disk
                 rx = await asyncio.to_thread(
@@ -1338,6 +1426,110 @@ class GatewayServer:
                 costs,
                 {"model": model, "backend": backend, "route": route_name},
             )
+
+
+class _Migrator:
+    """Gateway-side orchestrator for migrating ONE streaming session
+    (ISSUE 8 prefill/decode disaggregation). While the gateway relays a
+    stream from its source replica it watches the picker's polled
+    telemetry; when the source's admission queue is deep (prefill
+    pressure), the session is still young, and a decode-leaning sibling
+    exists, it cuts the session via the source's ``/migrate/export``
+    and splices the target's ``/migrate/import`` continuation stream —
+    the client sees one uninterrupted SSE stream under one response id.
+
+    At most one migration attempt per request; a declined or failed
+    export leaves the source serving untouched."""
+
+    def __init__(self, picker: EndpointPicker, backend, src: str,
+                 session: aiohttp.ClientSession):
+        self.picker = picker
+        self.backend = backend
+        self.src = src
+        self.session = session
+        self.attempted = False
+        self.export: dict | None = None
+        self.target: str | None = None
+
+    def _pick_target(self) -> str | None:
+        src_st = self.picker.state.get(self.src)
+        if src_st is None or not src_st.healthy:
+            return None
+        if src_st.queued < self.backend.migration_queue_depth:
+            return None  # no prefill pressure at the source
+        now = time.monotonic()
+        best: str | None = None
+        best_pred = 0.0
+        for addr, st in self.picker.state.items():
+            if addr == self.src or not st.healthy:
+                continue
+            if now - st.updated_at >= self.picker.STALE_AFTER:
+                continue
+            if st.queued > 0 or st.active_slots >= st.max_slots:
+                continue  # not decode-leaning: nowhere to put the slot
+            p = self.picker.predicted_ttft_ms(st)
+            p = 0.0 if p is None else p
+            if best is None or p < best_pred:
+                best, best_pred = addr, p
+        return best
+
+    async def maybe_export(self, tokens_seen: int, rid: str) -> None:
+        """Per-chunk check (cheap dict reads until the trigger fires).
+        On trigger, POSTs the source's export endpoint — after which the
+        source ends its stream at a token boundary and the relay loop
+        runs to EOF naturally, flushing every pre-cut token."""
+        if self.attempted or not rid or tokens_seen < 1:
+            return
+        if tokens_seen > self.backend.migration_young_tokens:
+            self.attempted = True  # matured past migratability
+            return
+        target = self._pick_target()
+        if target is None:
+            return
+        self.attempted = True
+        try:
+            async with self.session.post(
+                f"http://{self.src}/migrate/export",
+                json={"request_id": rid},
+                timeout=aiohttp.ClientTimeout(total=60),
+            ) as r:
+                if r.status != 200:
+                    # 409 = not now (finished / ineligible): the source
+                    # keeps serving, nothing to splice
+                    logger.info("migration export declined (%d)",
+                                r.status)
+                    return
+                self.export = await r.json()
+            self.target = target
+            logger.info("migrating session %s: %s -> %s", rid, self.src,
+                        target)
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            logger.warning("migration export failed: %s", e)
+
+    async def start_continuation(self) -> aiohttp.ClientResponse | None:
+        """Hand the blob to the target replica; returns the SSE response
+        that continues the client stream (original response id), or
+        None when the import failed."""
+        if self.export is None or self.target is None:
+            return None
+        try:
+            r = await self.session.post(
+                f"http://{self.target}/migrate/import",
+                json=self.export,
+                timeout=aiohttp.ClientTimeout(
+                    total=self.backend.request_timeout,
+                    sock_read=self.backend.stream_idle_timeout),
+            )
+            if r.status != 200:
+                body = await r.read()
+                r.release()
+                logger.warning("migration import failed (%d): %s",
+                               r.status, body[:200])
+                return None
+            return r
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            logger.warning("migration import failed: %s", e)
+            return None
 
 
 class _RetriableUpstreamError(Exception):

@@ -75,6 +75,25 @@ class GenAIMetrics:
             ["route", "backend"],
             registry=self.registry,
         )
+        # SLO-aware admission control (ISSUE 8): requests shed with
+        # 429 + Retry-After because every candidate replica's predicted
+        # TTFT blew the configured SLO — load the gateway refused to
+        # queue into collapse
+        self.slo_sheds_total = Counter(
+            "aigw_slo_sheds_total",
+            "Requests shed because predicted TTFT exceeded the SLO on "
+            "every candidate replica",
+            ["route", "backend"],
+            registry=self.registry,
+        )
+        # prefill/decode disaggregation: sessions the gateway moved from
+        # a prefill-pressured replica to a decode-leaning one mid-stream
+        self.migrations_total = Counter(
+            "aigw_migrations_total",
+            "Sessions migrated between replicas by the gateway",
+            ["route", "backend"],
+            registry=self.registry,
+        )
 
     def export(self) -> bytes:
         return generate_latest(self.registry)
@@ -151,6 +170,15 @@ ENGINE_GAUGES: tuple[tuple[str, str], ...] = (
     ("adapter_evictions", "tpuserve_adapter_evictions_total"),
     ("adapter_resident", "tpuserve_adapter_resident"),
     ("adapter_slots", "tpuserve_adapter_slots"),
+    # prefill/decode disaggregation (ISSUE 8): sessions exported to /
+    # imported from sibling replicas with the KV pages that traveled,
+    # plus the live migration-eligibility gauge (prefill done, decode
+    # young) the gateway's orchestrator polls
+    ("migrations_out", "tpuserve_migrations_out_total"),
+    ("migrations_in", "tpuserve_migrations_in_total"),
+    ("migration_pages_out", "tpuserve_migration_pages_out_total"),
+    ("migration_pages_in", "tpuserve_migration_pages_in_total"),
+    ("migratable_slots", "tpuserve_migratable_slots"),
     # multi-tenant fairness: distinct tenants holding decode slots, the
     # largest per-tenant in-flight count, and admissions the per-tenant
     # slot cap deferred (each deferral = one pass a request waited)

@@ -45,6 +45,7 @@ from aigw_tpu.tpuserve.kvcache import (
     PageAllocator,
     PrefixCache,
     RefcountedAllocator,
+    page_chain_hashes,
 )
 from aigw_tpu.tpuserve.sampling import (
     SamplingParams,
@@ -58,6 +59,13 @@ logger = logging.getLogger(__name__)
 
 class EngineOverloadedError(Exception):
     """Admission queue full — callers should surface 429/503."""
+
+
+class MigrationError(Exception):
+    """A migration export/import could not be performed (request not
+    active, finished during the cut, prefix cache disabled, malformed
+    blob). The session is left exactly as it was — a failed export
+    never kills the stream it tried to move."""
 
 
 @dataclass
@@ -193,6 +201,13 @@ class EngineConfig:
     # holding fewer in-flight slots admit first. 0 disables the cap
     # (weighted ordering still applies).
     tenant_slot_cap: int = 0
+    # Prefill/decode disaggregation (ISSUE 8): a slot whose prefill is
+    # done but whose decode is still young (generated <= this) counts
+    # toward the /state ``migratable_slots`` gauge — the gateway's
+    # signal for handing completed-prefill sessions to a decode-leaning
+    # replica. 0 counts every decoding slot as eligible. Export itself
+    # is not gated by this (the orchestrator owns the policy).
+    migration_young_tokens: int = 64
     # Per-token logprobs (vLLM/OpenAI parity): when > 0, the decode scan
     # also returns the chosen token's log-probability and the top-k
     # (ids, values) per step, and requests may set want_logprobs. Static
@@ -277,6 +292,21 @@ class GenRequest:
     # costs no extra pass over the prompt. None (or a stale length —
     # defensive) falls back to hashing at classification time.
     prefix_hashes: list | None = None
+    # Migration continuation (ISSUE 8): set on requests that RESUME a
+    # session exported by another replica. The prompt then carries the
+    # original prompt PLUS every token generated so far; this dict
+    # restores the slot state the continuation must inherit to stay
+    # byte-identical with a solo-served run:
+    #   orig_prompt_len — where the original prompt ended (tokens past
+    #       it are generated history: they seed the repetition-penalty
+    #       counts and are EXCLUDED from usage input accounting),
+    #   generated — tokens already emitted upstream (usage offset),
+    #   key_seed / key_counter — the sampling key state at the cut, so
+    #       the first resumed token samples with the exact key the solo
+    #       run would have used at that position.
+    # None everywhere else; continuation requests always take the
+    # per-request admission path (never the batched prefill).
+    import_state: dict | None = None
     # Request-lifecycle sink (obs.flight.RequestTrace or None): the
     # engine reports queue-wait, admission classification, prefill
     # geometry, first-token, decode windows, and EOS/cancel through it
@@ -362,6 +392,15 @@ class EngineStats:
     tenants_active: int = 0
     tenant_max_slots: int = 0
     tenant_deferrals: int = 0
+    # prefill/decode disaggregation (ISSUE 8): sessions exported to /
+    # imported from other replicas, the KV pages that moved with them,
+    # and the live count of migration-eligible slots (prefill done,
+    # decode young — the gateway's disaggregation signal)
+    migrations_out: int = 0
+    migrations_in: int = 0
+    migration_pages_out: int = 0
+    migration_pages_in: int = 0
+    migratable_slots: int = 0
     prefills: int = 0
     sp_prefills: int = 0  # prefills routed through ring attention
     chunked_prefill_steps: int = 0  # intermediate chunk device steps
@@ -608,6 +647,16 @@ class Engine:
         # copy-on-write page clone (full-prefix hits): one compiled
         # program regardless of src/dst ids (dynamic slice indices)
         self._copy_page_fn = None
+        # migration page movers (ISSUE 8): device→host page gather and
+        # host→device page scatter, each ONE compiled program for any
+        # page id (dynamic indices) — pre-compiled by warmup() so an
+        # import/resume never compiles on the hot path
+        self._export_page_fn = None
+        self._import_page_fn = None
+        # migration control queue: export/import jobs posted by server
+        # threads, executed on the engine thread (which owns kv_cache's
+        # donation chain and the slot table)
+        self._mig_q: "queue.Queue[tuple]" = queue.Queue()
         # 1-deep pipeline: the window dispatched to the device while the
         # host processes the previous window's tokens.
         self._inflight: _Window | None = None
@@ -1111,6 +1160,70 @@ class Engine:
         self.kv_cache = self._copy_page_fn(
             self.kv_cache, jnp.int32(src), jnp.int32(dst))
 
+    def _export_page_dev(self, page: int):
+        """Gather one KV page off the pool (device side of a migration
+        export). Dynamic page index: ONE compiled program for any page;
+        the caller starts the device→host copy asynchronously so the
+        per-page transfers overlap (the async-transfer machinery)."""
+        if self._export_page_fn is None:
+            ps = self.cfg.page_size
+
+            def _ex(kv, pg):
+                return jax.lax.dynamic_slice_in_dim(
+                    kv, pg * ps, ps, axis=2)
+
+            self._export_page_fn = self.compile_tracker.register(
+                "page_export", jax.jit(_ex))
+        return self._export_page_fn(self.kv_cache, jnp.int32(page))
+
+    def _import_rungs(self) -> list[int]:
+        """Page-count rungs of the batched import program: powers of
+        two covering 1..max_pages_per_seq — one compiled program per
+        rung for ANY destination page set."""
+        rungs = []
+        r = 1
+        while True:
+            rungs.append(r)
+            if r >= self.cfg.max_pages_per_seq:
+                return rungs
+            r *= 2
+
+    def _import_pages_dev(self, page_ids: list[int], rows_np) -> None:
+        """Scatter ``len(page_ids)`` host-side KV pages into the pool in
+        ONE donated device call (a fori_loop of dynamic row updates).
+        The page count pads to a pow2 rung by REPEATING the last
+        (page, rows) pair — an idempotent rewrite, so no mask branch is
+        compiled. One program per rung; all rungs pre-compiled by
+        warmup(). Batching matters: per-page donated calls copy the
+        whole pool once per page on backends without buffer donation."""
+        k = len(page_ids)
+        if k == 0:
+            return
+        ps = self.cfg.page_size
+        if self._import_page_fn is None:
+
+            def _im(kv, pages, rows):
+                def body(i, kv):
+                    return jax.lax.dynamic_update_slice_in_dim(
+                        kv, rows[i], pages[i] * ps, axis=2)
+
+                return jax.lax.fori_loop(0, pages.shape[0], body, kv)
+
+            self._import_page_fn = self.compile_tracker.register(
+                "page_import", jax.jit(_im, donate_argnums=(0,)))
+        R = 1
+        while R < k:
+            R *= 2
+        pages = np.full((R,), page_ids[-1], np.int32)
+        pages[:k] = page_ids
+        dtype = (jnp.float32 if self.cfg.kv_cache_dtype == "float32"
+                 else jnp.bfloat16)
+        stacked = np.concatenate(
+            [rows_np] + [rows_np[-1:]] * (R - k), axis=0)
+        self.kv_cache = self._import_page_fn(
+            self.kv_cache, jnp.asarray(pages),
+            jnp.asarray(stacked, dtype))
+
     @property
     def kv_page_bytes(self) -> int:
         """HBM bytes of one KV page (the /state bytes-pinned signal)."""
@@ -1236,6 +1349,14 @@ class Engine:
             # change) must not pay an XLA compile
             self._adapter_store.warm()
         self.attn.warm()
+        # migration page movers: a page export (device→host gather) or
+        # an import at ANY page-count rung must never compile
+        # mid-traffic — round-trip page 0 through the host exactly as a
+        # real migration does (idempotent rewrites of page 0's own
+        # content; nothing is serving yet)
+        rows = np.asarray(self._export_page_dev(0))[None]
+        for r in self._import_rungs():
+            self._import_pages_dev([0] * r, np.repeat(rows, r, axis=0))
         self.stats.warmup_ms = round(1e3 * (time.monotonic() - t0), 3)
         self.stats.warm_programs = self.compile_tracker.program_count()
 
@@ -1263,6 +1384,196 @@ class Engine:
             )
             G2 *= 2
 
+    # -- prefill/decode disaggregation: KV page migration (ISSUE 8) --------
+    def migrate_export(self, req: GenRequest,
+                       timeout: float = 30.0) -> dict:
+        """Cut a live session and serialize its page chain for transfer
+        to another replica: full KV pages (device→host), the chained
+        content hashes identifying them, and the slot's sampling /
+        penalty / key state. Callable from any thread — the cut itself
+        runs on the engine thread at the next tick, after the in-flight
+        decode window settles, so the wire state is exactly a token
+        boundary. Returns {"blob": <json-able dict>, "data": [np page
+        arrays]}. Raises MigrationError (session untouched on failure)
+        or TimeoutError."""
+        box: dict = {"evt": threading.Event()}
+        self._mig_q.put(("export", req, box))
+        self._wake.set()
+        if not box["evt"].wait(timeout):
+            raise TimeoutError("migration export timed out")
+        if "error" in box:
+            raise MigrationError(box["error"])
+        return box["result"]
+
+    def migrate_import(self, tokens: list[int], pages: list[np.ndarray],
+                       timeout: float = 30.0) -> int:
+        """Adopt another replica's exported page chain: scatter the
+        host-side pages into this pool and register them in the prefix
+        cache under their chain hashes — the imported pages then live
+        under the NORMAL refcount/CoW/eviction discipline (parked
+        evictable until the continuation request adopts them; pool
+        pressure can reclaim them like any cached prefix). Returns the
+        number of pages imported. Raises MigrationError / TimeoutError;
+        OutOfPagesError surfaces as MigrationError("…pages…") so the
+        caller can requeue like admission pressure."""
+        box: dict = {"evt": threading.Event()}
+        self._mig_q.put(("import", (tokens, pages), box))
+        self._wake.set()
+        if not box["evt"].wait(timeout):
+            raise TimeoutError("migration import timed out")
+        if "error" in box:
+            raise MigrationError(box["error"])
+        return box["result"]
+
+    def _process_migrations(self) -> None:
+        """Run queued export/import jobs on the engine thread (the only
+        thread allowed to touch kv_cache's donation chain and the slot
+        table). Errors are reported to the waiting caller, never raised
+        into the engine loop."""
+        while True:
+            try:
+                kind, payload, box = self._mig_q.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                if kind == "export":
+                    box["result"] = self._do_export(payload)
+                else:
+                    box["result"] = self._do_import(*payload)
+            except Exception as e:  # noqa: BLE001 — relayed to caller
+                box["error"] = f"{type(e).__name__}: {e}"
+            finally:
+                box["evt"].set()
+
+    def _do_export(self, req: GenRequest) -> dict:
+        """Engine-thread half of migrate_export. Wire rule: only COMPLETE
+        pages whose every row is written KV travel — k = (m-1) // page
+        pages for m total tokens (the last token's K/V is the pending
+        decode input and not yet written). The ≤ one-page token tail is
+        recomputed by the importer's offset resume, so the imported
+        pages are always safe to share under the chain-hash contract
+        ("this page holds ALL of positions [i·ps, (i+1)·ps)")."""
+        if not isinstance(self.allocator, RefcountedAllocator):
+            raise MigrationError(
+                "migration requires the prefix cache "
+                "(refcounted page allocator)")
+        if req.emit_lp is not None:
+            raise MigrationError(
+                "logprobs sessions are not migratable")
+        idx = next((i for i, s in enumerate(self._slots)
+                    if s is not None and s.req is req), None)
+        if idx is None:
+            raise MigrationError(
+                "request is not active (finished, cancelled, or not "
+                "yet admitted)")
+        # settle the in-flight window: it may still write this
+        # sequence's pages, and its tokens must land before the cut so
+        # the exported state is a clean token boundary
+        self._drain_inflight()
+        self._apply_frees()
+        s = self._slots[idx]
+        if s is None or s.req is not req:
+            raise MigrationError("request finished during the export cut")
+        if s.generated < 1:
+            raise MigrationError("prefill not finished (no token yet)")
+        ps = self.cfg.page_size
+        tokens = list(req.prompt) + list(s.gen_tokens)
+        m = len(tokens)
+        k = (m - 1) // ps
+        pages = self.allocator.pages(req.id)[:k]
+        # pin the chain for the duration of the device→host transfer:
+        # nothing may free/evict/CoW these pages while the copy (or the
+        # wire transfer the caller performs next) is in flight
+        pin = self.allocator.begin_export(pages)
+        try:
+            outs = [self._export_page_dev(p) for p in pages]
+            self._start_host_copy(outs)  # per-page copies overlap
+            data = [np.asarray(o) for o in outs]
+        finally:
+            self.allocator.end_export(pin)
+        ims = req.import_state or {}
+        sp = req.sampling
+        blob = {
+            "tokens": tokens,
+            "page_size": ps,
+            "chain": [h.hex() for h in
+                      page_chain_hashes(tokens, ps)[:k]],
+            "kv_dtype": self.cfg.kv_cache_dtype,
+            "orig_prompt_len": ims.get("orig_prompt_len",
+                                       len(req.prompt)),
+            "generated": ims.get("generated", 0) + s.generated,
+            "max_tokens": req.max_tokens - s.generated,
+            "key_seed": s.key_seed,
+            "adapter": req.adapter,
+            "tenant": req.tenant,
+            "stop_token_ids": list(req.stop_token_ids),
+            "sampling": {
+                "temperature": sp.temperature, "top_p": sp.top_p,
+                "top_k": sp.top_k, "seed": sp.seed,
+                "frequency_penalty": sp.frequency_penalty,
+                "presence_penalty": sp.presence_penalty,
+                "logit_bias": [[t, b] for t, b in sp.logit_bias],
+            },
+        }
+        # the cut: finish the slot with "migrated" — pages free under
+        # the normal refcount discipline (cache-registered prompt pages
+        # park evictable; the export pin above already released)
+        if req.trace is not None:
+            req.trace.engine_finish("migrated")
+        self._pending_frees.append(req.id)
+        self._release_adapter_row(s.adapter_row)
+        self._slots[idx] = None
+        self._dirty_rows.add(idx)
+        self._wake.set()
+        req.emit(-1, "migrated")
+        self.stats.migrations_out += 1
+        self.stats.migration_pages_out += len(pages)
+        logger.info("exported seq %d: %d tokens, %d pages", req.id, m,
+                    len(pages))
+        return {"blob": blob, "data": data}
+
+    def _do_import(self, tokens: list[int],
+                   pages_data: list[np.ndarray]) -> int:
+        """Engine-thread half of migrate_import: allocate pages, scatter
+        the imported rows, register the chain in the prefix cache, then
+        release — the pages park evictable (revivable) until the
+        continuation request's admission probe adopts them. No new page
+        lifecycle: from here on they are ordinary cached prefix pages."""
+        if self.prefix_cache is None:
+            raise MigrationError(
+                "migration import requires the prefix cache")
+        ps = self.cfg.page_size
+        k = len(pages_data)
+        if k == 0:
+            return 0
+        if k > (len(tokens) - 1) // ps:
+            raise MigrationError(
+                f"{k} pages exceed the written-KV coverage of "
+                f"{len(tokens)} tokens")
+        mc = self.model_cfg
+        want = (mc.n_layers, 2, ps, mc.n_kv_heads, mc.head_dim)
+        for rows in pages_data:
+            if tuple(rows.shape) != want:
+                raise MigrationError(
+                    f"page shape {tuple(rows.shape)} != expected {want} "
+                    "(mismatched model or page size)")
+        keys = page_chain_hashes(tokens, ps)[:k]
+        seq_id = next(self._seq_ids)
+        self.allocator.allocate_extra(seq_id, k)  # OutOfPages → caller
+        page_ids = self.allocator.pages(seq_id)
+        self._import_pages_dev(page_ids, np.stack(pages_data))
+        self.prefix_cache.insert(keys, page_ids)
+        # release: registered pages park evictable (adopted by the
+        # continuation's probe); pages whose chain key was ALREADY
+        # cached locally were skipped by insert and return to the free
+        # stack immediately
+        self.allocator.free(seq_id)
+        self.stats.migrations_in += 1
+        self.stats.migration_pages_in += k
+        logger.info("imported %d pages for a %d-token chain", k,
+                    len(tokens))
+        return k
+
     # -- engine loop ------------------------------------------------------
     def _run(self) -> None:
         logger.info("engine loop started (batch=%d, pages=%d×%d)",
@@ -1271,6 +1582,7 @@ class Engine:
         while not self._stop.is_set():
             try:
                 self._reap_cancelled()
+                self._process_migrations()
                 admitted = self._admit()
                 worked = self._decode_tick()
                 if self._stop.is_set():
@@ -1314,6 +1626,14 @@ class Engine:
             while True:
                 req = self._queue.get_nowait()
                 req.emit(-1, "error")
+        except queue.Empty:
+            pass
+        # waiting migration callers must not hang until their timeout
+        try:
+            while True:
+                _kind, _payload, box = self._mig_q.get_nowait()
+                box["error"] = f"engine aborted: {reason}"
+                box["evt"].set()
         except queue.Empty:
             pass
 
@@ -1521,6 +1841,10 @@ class Engine:
             return False, chain
         if req.adapter and not self._adapter_known(req.adapter):
             return False, chain  # singleton path surfaces the error
+        if req.import_state is not None:
+            # migration continuations restore key/count state that only
+            # the per-request path knows how to thread into the slot
+            return False, chain
         return True, chain
 
     def _admit_batch(
@@ -1734,7 +2058,16 @@ class Engine:
                 # pressure (caller requeues in arrival order)
                 self.allocator.free(seq_id)
                 return "stop"
-        key = np.array([[req.sampling.seed or seq_id, 0]], np.uint32)
+        # migration continuation (ISSUE 8): resume with the sampling-key
+        # state the solo run would have at this position — the prefill's
+        # sampled token must be the exact token the exporting replica
+        # would have decoded next (key counter m-1 = the position of the
+        # pending input token at the cut)
+        ims = req.import_state or {}
+        key_seed = int(ims.get("key_seed") or
+                       (req.sampling.seed or seq_id))
+        key_counter = int(ims.get("key_counter", 0))
+        key = np.array([[key_seed & 0xFFFFFFFF, key_counter]], np.uint32)
         bias_row = np.zeros((1, self.model_cfg.vocab_size), np.float32)
         for tok_id, b in req.sampling.logit_bias:
             if 0 <= tok_id < self.model_cfg.vocab_size:
@@ -1854,12 +2187,19 @@ class Engine:
                 la_tokens = cont[1]
                 self.stats.spec_lookahead_slots += 1
 
+        # migration continuation: generated-so-far tokens ride in the
+        # prompt tail — they must keep counting toward the repetition
+        # penalties exactly as they did on the exporting replica
+        counts: dict[int, int] = {}
+        for t in req.prompt[int(ims.get("orig_prompt_len", n)):]:
+            counts[t] = counts.get(t, 0) + 1
         # pos=n-1: _emit_token advances it to n, the write position of
         # the just-sampled first token.
         self._slots[slot_idx] = _Slot(
             req=req, pos=n - 1, generated=0,
-            key_seed=req.sampling.seed or seq_id,
+            key_seed=key_seed,
             limit=total, page_row=pt[0], adapter_row=adapter_row,
+            token_counts=counts,
             ctrl=ctrl, la_base=la_base, la_tokens=la_tokens,
         )
         self._mark_admitted(slot_idx)
@@ -2454,6 +2794,11 @@ class Engine:
         self.stats.adapter_slots = sum(
             1 for s in self._slots
             if s is not None and s.adapter_row != self._base_row)
+        young = self.cfg.migration_young_tokens
+        self.stats.migratable_slots = sum(
+            1 for s in self._slots
+            if s is not None and s.generated >= 1
+            and (young <= 0 or s.generated <= young))
         tenants = self._tenant_slots()
         self.stats.tenants_active = len(tenants)
         self.stats.tenant_max_slots = max(tenants.values(), default=0)
@@ -2480,3 +2825,48 @@ class Engine:
                 time.monotonic() - head.enqueued_at)
         except IndexError:
             self.stats.queue_wait_ms = 0.0
+
+
+def continuation_request(blob: dict,
+                         emit: Callable[[int, str | None], None]
+                         = lambda t, f: None,
+                         trace: Any = None) -> GenRequest:
+    """Build the GenRequest that RESUMES a migrated session from an
+    export blob (the wire half of migrate_export). The prompt is the
+    full token history (original prompt + everything generated at the
+    cut); import_state restores the sampling-key/penalty state so the
+    resumed stream is byte-identical to a solo-served run. One builder
+    shared by the /migrate/import endpoint and the migration tests —
+    the wire format has exactly one consumer-side interpretation."""
+    sp = blob.get("sampling") or {}
+    sampling = SamplingParams(
+        temperature=float(sp.get("temperature", 1.0)),
+        top_p=float(sp.get("top_p", 1.0)),
+        top_k=int(sp.get("top_k", 0)),
+        seed=int(sp.get("seed", 0)),
+        frequency_penalty=float(sp.get("frequency_penalty", 0.0)),
+        presence_penalty=float(sp.get("presence_penalty", 0.0)),
+        logit_bias=tuple((int(t), float(b))
+                         for t, b in (sp.get("logit_bias") or ())),
+    )
+    tokens = [int(t) for t in blob["tokens"]]
+    return GenRequest(
+        prompt=tokens,
+        max_tokens=int(blob["max_tokens"]),
+        sampling=sampling,
+        stop_token_ids=tuple(int(t) for t in
+                             (blob.get("stop_token_ids") or ())),
+        emit=emit,
+        adapter=str(blob.get("adapter", "")),
+        tenant=str(blob.get("tenant", "")),
+        import_state={
+            "orig_prompt_len": int(blob.get("orig_prompt_len",
+                                            len(tokens))),
+            "generated": int(blob.get("generated", 0)),
+            "key_seed": int(blob.get("key_seed", 0)),
+            # the pending input token at the cut sat at position m-1 —
+            # the resume's first sample must use its key
+            "key_counter": len(tokens) - 1,
+        },
+        trace=trace,
+    )

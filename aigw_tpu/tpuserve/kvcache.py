@@ -207,6 +207,26 @@ class RefcountedAllocator(PageAllocator):
         self._release_page(page)
         return fresh
 
+    # -- migration export pins (ISSUE 8) -----------------------------------
+    def begin_export(self, pages: list[int]) -> list[int]:
+        """Pin ``pages`` for an in-flight migration export: each page's
+        refcount is bumped so no free/evict/CoW path can hand the page
+        out while its device→host copy (and the cross-replica transfer
+        that follows) may still be reading it — the owning sequence can
+        finish, cancel, or be cut mid-export without racing the wire.
+        Returns the pin token to hand back to :meth:`end_export`."""
+        for p in pages:
+            self._refs[p] = self._refs.get(p, 0) + 1
+            self._evictable.pop(p, None)  # pinned = not reclaimable
+        return list(pages)
+
+    def end_export(self, pin: list[int]) -> None:
+        """Release an export pin: pages drop one reference and rejoin
+        the normal lifecycle (registered pages park evictable, orphans
+        return to the free stack)."""
+        for p in pin:
+            self._release_page(p)
+
     def truncate_to(self, seq_id: int, n_tokens: int) -> list[tuple]:
         """Un-write a sequence's tail from position ``n_tokens`` on:
         every owned page overlapping [n_tokens, ∞) must be PRIVATELY

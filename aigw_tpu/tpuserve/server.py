@@ -52,6 +52,8 @@ from aigw_tpu.tpuserve.engine import (
     EngineConfig,
     EngineOverloadedError,
     GenRequest,
+    MigrationError,
+    continuation_request,
 )
 from aigw_tpu.tpuserve.kvcache import page_chain_hashes
 from aigw_tpu.tpuserve.sampling import SamplingParams
@@ -241,7 +243,14 @@ class TPUServeServer:
             max_workers=2, thread_name_prefix="tpuserve-tok"
         )
 
-        self.app = web.Application()
+        # live streaming sessions by response id — the lookup surface of
+        # the migration export endpoint (ISSUE 8): the gateway quotes
+        # the x-aigw-request-id it already relays
+        self._live: dict[str, tuple[GenRequest, dict]] = {}
+
+        # body cap sized for /migrate/import: a migrated page chain is
+        # megabytes of KV by design (page_bytes × pages on the wire)
+        self.app = web.Application(client_max_size=256 * 1024 * 1024)
         self.app.router.add_post("/v1/chat/completions", self._chat)
         self.app.router.add_post("/v1/completions", self._completions)
         self.app.router.add_post("/v1/embeddings", self._embeddings)
@@ -250,6 +259,8 @@ class TPUServeServer:
         self.app.router.add_get("/health", self._health)
         self.app.router.add_get("/state", self._state)
         self.app.router.add_get("/metrics", self._metrics)
+        self.app.router.add_post("/migrate/export", self._migrate_export)
+        self.app.router.add_post("/migrate/import", self._migrate_import)
         self.app.router.add_get("/debug/requests", self._debug_requests)
         self.app.router.add_get("/debug/requests/{rid}",
                                 self._debug_request)
@@ -435,6 +446,7 @@ class TPUServeServer:
 
     def _end_trace(self, trace: RequestTrace, finish: str, n_out: int,
                    n_prompt: int = 0, error: str = "") -> None:
+        self._live.pop(trace.entry.rid, None)  # no longer exportable
         self.flight.finish(trace.entry, finish, n_out)
         span = trace.span
         if span is not None:
@@ -613,6 +625,18 @@ class TPUServeServer:
                             error=str(e))
             return web.Response(status=400, body=oai.error_body(str(e)),
                                 content_type="application/json")
+        # exportable until a terminal _end_trace: the gateway can hand
+        # this session to a decode replica via POST /migrate/export
+        # (streaming only — a buffered response has nothing to splice)
+        if stream and lp_top_n < 0:
+            self._live[rid] = (gen_req, {
+                "response_id": rid,
+                "model": self.model_name,
+                "created": created,
+                "chat": chat,
+                "include_usage": include_usage,
+                "stop_strs": stop_strs,
+            })
 
         n_prompt = len(prompt)
         want_lp = lp_top_n >= 0
@@ -794,7 +818,11 @@ class TPUServeServer:
                             lp_entries.append(lp_entry)
                     if fin is not None:
                         finish = fin
-                        if fin != "error":
+                        if fin not in ("error", "migrated"):
+                            # migrated: any held-back partial text is
+                            # re-derived by the importing replica's
+                            # primed decoder — flushing it here would
+                            # duplicate it across the seam
                             pieces.append(decoder.flush())
                         done_streaming = True
                         break
@@ -846,16 +874,41 @@ class TPUServeServer:
         )
         rm.finish(usage)
         self._end_trace(trace, finish, n_out, n_prompt)
-        await resp.write(
-            oai.stream_chunk_sse(
-                response_id=rid, model=self.model_name, created=created,
-                delta={}, finish_reason=finish,
-                usage=usage if include_usage else None,
-            )
-        )
+        if finish == "migrated":
+            # the session moved to another replica mid-stream: end THIS
+            # stream with no finish frame and no [DONE] — the importing
+            # replica's continuation stream (spliced by the gateway)
+            # carries the terminal frames under the same response id
+            await resp.write_eof()
+            return resp
+        await resp.write(self._final_stream_frame(
+            chat, rid, created, finish,
+            usage if include_usage else None))
         await resp.write(SSEEvent(data="[DONE]").encode())
         await resp.write_eof()
         return resp
+
+    def _final_stream_frame(self, chat: bool, rid: str, created: int,
+                            finish: str,
+                            usage: TokenUsage | None) -> bytes:
+        """Terminal SSE frame carrying finish_reason (+ usage when
+        requested) in the FRONT schema's chunk shape. Legacy
+        /v1/completions streams previously ended with a chat-shaped
+        chunk here — the gateway's typed stream validator (correctly)
+        rejected it and replaced the stream tail with an error event."""
+        if chat:
+            return oai.stream_chunk_sse(
+                response_id=rid, model=self.model_name, created=created,
+                delta={}, finish_reason=finish, usage=usage)
+        ev: dict[str, Any] = {
+            "id": rid, "object": "text_completion", "created": created,
+            "model": self.model_name,
+            "choices": [{"index": 0, "text": "",
+                         "finish_reason": finish}],
+        }
+        if usage is not None:
+            ev["usage"] = oai.usage_dict(usage)
+        return SSEEvent(data=json.dumps(ev)).encode()
 
     def _submit_n(self, body: dict[str, Any], prompt: list[int], n: int,
                   lp_top_n: int, prefix_hashes: list | None = None,
@@ -1107,10 +1160,21 @@ class TPUServeServer:
         )
         rm.finish(usage)
         if include_usage:
-            await resp.write(oai.stream_chunk_sse(
-                response_id=rid, model=self.model_name, created=created,
-                delta=None, usage=usage,
-            ))
+            if chat:
+                await resp.write(oai.stream_chunk_sse(
+                    response_id=rid, model=self.model_name,
+                    created=created, delta=None, usage=usage,
+                ))
+            else:
+                # legacy completions: the usage chunk must keep the
+                # text_completion shape (choices present, possibly
+                # empty) or the gateway's typed validator drops it
+                await resp.write(SSEEvent(data=json.dumps({
+                    "id": rid, "object": "text_completion",
+                    "created": created, "model": self.model_name,
+                    "choices": [],
+                    "usage": oai.usage_dict(usage),
+                })).encode())
         await resp.write(SSEEvent(data="[DONE]").encode())
         await resp.write_eof()
         return resp
@@ -1281,6 +1345,15 @@ class TPUServeServer:
                 "tenant_max_slots": s.tenant_max_slots,
                 "tenant_deferrals": s.tenant_deferrals,
                 "tenant_slot_cap": self.engine.cfg.tenant_slot_cap,
+                # prefill/decode disaggregation (ISSUE 8): sessions
+                # moved in/out, the KV pages that traveled with them,
+                # and the live migration-eligibility count (prefill
+                # done, decode young) the gateway's orchestrator reads
+                "migrations_out": s.migrations_out,
+                "migrations_in": s.migrations_in,
+                "migration_pages_out": s.migration_pages_out,
+                "migration_pages_in": s.migration_pages_in,
+                "migratable_slots": s.migratable_slots,
                 "active_slots": s.active_slots,
                 "max_slots": self.engine.cfg.max_batch_size,
                 "queued": s.queued,
@@ -1346,6 +1419,222 @@ class TPUServeServer:
                 + render_engine_gauges(self.engine.stats)
                 + self.engine.phases.render())
         return web.Response(body=body, content_type="text/plain")
+
+    # -- prefill/decode disaggregation: KV page migration (ISSUE 8) --------
+    async def _migrate_export(self, request: web.Request) -> web.Response:
+        """Cut a live streaming session and return its wire blob: full
+        KV pages (device→host via the engine's async-transfer path),
+        chain hashes, and the slot's sampling/penalty/key state. The
+        session's SSE stream ends without terminal frames; the caller
+        splices the importing replica's continuation stream. A failed
+        export leaves the session serving exactly as it was (409)."""
+        import base64
+
+        try:
+            body = oai.parse_json_body(await request.read())
+        except oai.SchemaError as e:
+            return web.Response(status=400, body=oai.error_body(str(e)),
+                                content_type="application/json")
+        rid = str(body.get("request_id", ""))
+        live = self._live.get(rid)
+        if live is None:
+            return web.Response(
+                status=404,
+                body=oai.error_body(
+                    f"request {rid!r} is not an exportable live stream"),
+                content_type="application/json")
+        gen_req, meta = live
+        try:
+            out = await asyncio.to_thread(self.engine.migrate_export,
+                                          gen_req)
+        except (MigrationError, TimeoutError) as e:
+            # the session keeps serving on this replica — 409 tells the
+            # orchestrator "not now", not "broken"
+            return web.Response(
+                status=409, body=oai.error_body(str(e)),
+                content_type="application/json")
+        blob = out["blob"]
+        blob["meta"] = meta
+        pages = [
+            {"b64": base64.b64encode(
+                np.asarray(d, np.float32).tobytes()).decode(),
+             "shape": list(d.shape)}
+            for d in out["data"]
+        ]
+        return web.json_response({"blob": blob, "pages": pages})
+
+    async def _migrate_import(
+        self, request: web.Request) -> web.StreamResponse:
+        """Adopt an exported page chain and stream the session's
+        continuation. The pages enter this replica's pool through the
+        prefix-cache registration path (parked evictable, normal
+        refcount/CoW discipline); the continuation request then admits
+        as an offset resume against them — warm path end to end (the
+        page scatters and resume programs are pre-compiled by
+        warmup()). Frames carry the ORIGINAL response id, and usage
+        counts the whole session (generated-so-far offset), so the
+        gateway can splice this stream where the exporter's stopped."""
+        import base64
+
+        try:
+            body = oai.parse_json_body(await request.read())
+        except oai.SchemaError as e:
+            return web.Response(status=400, body=oai.error_body(str(e)),
+                                content_type="application/json")
+        blob = body.get("blob") or {}
+        try:
+            tokens = [int(t) for t in blob["tokens"]]
+            pages = [
+                np.frombuffer(base64.b64decode(p["b64"]), np.float32)
+                .reshape(p["shape"])
+                for p in (body.get("pages") or ())
+            ]
+        except (KeyError, TypeError, ValueError) as e:
+            return web.Response(
+                status=400,
+                body=oai.error_body(f"malformed migration blob: {e}"),
+                content_type="application/json")
+        try:
+            await asyncio.to_thread(self.engine.migrate_import, tokens,
+                                    pages)
+        except (MigrationError, TimeoutError) as e:
+            if "OutOfPages" in str(e):
+                # page pressure rides the normal overload contract
+                return web.Response(
+                    status=503, body=oai.error_body(str(e)),
+                    headers={"retry-after": "1"},
+                    content_type="application/json")
+            return web.Response(
+                status=400, body=oai.error_body(str(e)),
+                content_type="application/json")
+
+        meta = blob.get("meta") or {}
+        rid = str(meta.get("response_id")
+                  or f"chatcmpl-{uuid.uuid4().hex[:24]}")
+        chat = bool(meta.get("chat", True))
+        created = int(meta.get("created") or time.time())
+        stop_strs = [s for s in (meta.get("stop_strs") or ())
+                     if isinstance(s, str)]
+        include_usage = bool(meta.get("include_usage", False))
+        n_prev = int(blob.get("generated", 0))
+        orig_len = int(blob.get("orig_prompt_len", len(tokens)))
+
+        loop = asyncio.get_running_loop()
+        out_q: asyncio.Queue = asyncio.Queue()
+
+        def emit(tok: int, fin: str | None) -> None:
+            loop.call_soon_threadsafe(out_q.put_nowait, (tok, fin))
+
+        creq = continuation_request(blob, emit=emit)
+        creq.prefix_hashes = self._prefix_hashes_for(creq.prompt)
+        entry = self.flight.begin(
+            rid, model=self.model_name, prompt_tokens=len(tokens),
+            max_tokens=creq.max_tokens, stream=True)
+        creq.trace = RequestTrace(entry=entry, tracer=self.tracer,
+                                  span=None)
+        rm = RequestMetrics(
+            metrics=self.metrics,
+            operation="chat" if chat else "text_completion",
+            provider="tpuserve", request_model=self.model_name,
+            response_model=self.model_name)
+        try:
+            self.engine.submit(creq)
+        except EngineOverloadedError as e:
+            return web.Response(
+                status=429,
+                body=oai.error_body(str(e), type_="rate_limit_error"),
+                headers={"retry-after": "1"},
+                content_type="application/json")
+        except ValueError as e:
+            return web.Response(status=400, body=oai.error_body(str(e)),
+                                content_type="application/json")
+        # the continuation itself is exportable again (chained moves)
+        self._live[rid] = (creq, meta)
+
+        resp = web.StreamResponse(
+            status=200,
+            headers={"content-type": "text/event-stream",
+                     "cache-control": "no-cache",
+                     "x-aigw-request-id": rid})
+        set_tcp_nodelay(request.transport)
+        await resp.prepare(request)
+        decoder = StreamingDecoder(self.tokenizer)
+        # prime the detokenizer with the generated-so-far tail: UTF-8
+        # characters and stop strings spanning the migration seam
+        # resolve exactly as they would have on the exporting replica
+        emitted = ""
+        for t in tokens[orig_len:]:
+            emitted += decoder.push(t)
+
+        async def write_piece(piece: str) -> None:
+            if not piece:
+                return
+            if chat:
+                await resp.write(oai.stream_chunk_sse(
+                    response_id=rid, model=self.model_name,
+                    created=created, delta={"content": piece}))
+            else:
+                await resp.write(SSEEvent(data=json.dumps({
+                    "id": rid, "object": "text_completion",
+                    "created": created, "model": self.model_name,
+                    "choices": [{"index": 0, "text": piece,
+                                 "finish_reason": None}],
+                })).encode())
+
+        n_out = 0
+        finish = "stop"
+        try:
+            done = False
+            while not done:
+                first = await out_q.get()
+                burst = [first]
+                while True:
+                    try:
+                        burst.append(out_q.get_nowait())
+                    except asyncio.QueueEmpty:
+                        break
+                pieces: list[str] = []
+                for tok, fin in burst:
+                    if tok >= 0:
+                        n_out += 1
+                        rm.record_tokens_emitted(1)
+                        piece = decoder.push(tok)
+                        if piece:
+                            emitted += piece
+                            hit = _find_stop(emitted, stop_strs)
+                            if hit is not None:
+                                keep = hit - (len(emitted) - len(piece))
+                                pieces.append(piece[:max(keep, 0)])
+                                finish = "stop"
+                                creq.cancelled.set()
+                                done = True
+                                break
+                            pieces.append(piece)
+                    if fin is not None:
+                        finish = fin
+                        if fin not in ("error", "migrated"):
+                            pieces.append(decoder.flush())
+                        done = True
+                        break
+                await write_piece("".join(pieces))
+        except (asyncio.CancelledError, ConnectionResetError):
+            creq.cancelled.set()
+            self._end_trace(creq.trace, "cancelled", n_out, orig_len)
+            raise
+        usage = TokenUsage(
+            input_tokens=orig_len, output_tokens=n_prev + n_out,
+            total_tokens=orig_len + n_prev + n_out)
+        rm.finish(usage)
+        self._end_trace(creq.trace, finish, n_out, orig_len)
+        if finish == "migrated":
+            await resp.write_eof()  # moved again: next replica finishes
+            return resp
+        await resp.write(self._final_stream_frame(
+            chat, rid, created, finish,
+            usage if include_usage else None))
+        await resp.write(SSEEvent(data="[DONE]").encode())
+        await resp.write_eof()
+        return resp
 
     # -- debug surface (flight recorder + profiler) -----------------------
     async def _debug_requests(self, _request: web.Request) -> web.Response:
@@ -1444,6 +1733,7 @@ async def run_tpuserve(
     prefill_bucket_rungs: int = 2,
     flight_entries: int = 256,
     enable_profile_endpoint: bool = False,
+    migration_young_tokens: int = 64,
 ) -> web.AppRunner:
     server = TPUServeServer(
         model,
@@ -1468,6 +1758,7 @@ async def run_tpuserve(
             first_token_fast_path=first_token_fast_path,
             prefill_bucket_rungs=prefill_bucket_rungs,
             tenant_slot_cap=tenant_slot_cap,
+            migration_young_tokens=migration_young_tokens,
         ),
         tp=tp,
         ep=ep,

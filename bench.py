@@ -1221,6 +1221,516 @@ def lora_numbers(reps: int = 3, requests_per_rep: int = 4,
         stop()
 
 
+# -- open-loop load generation + fleet legs (ISSUE 8; ROADMAP 5) ----------
+
+def _poisson_trace(seed: int, n: int, rate_hz: float,
+                   prompt_lens=(48, 96, 160), gen_lens=(8, 16, 24),
+                   tenants=("",), burst_frac=0.25) -> list[dict]:
+    """TokenSim-style open-loop arrival trace: Poisson inter-arrivals
+    with a ``burst_frac`` share of zero-gap (bursty) arrivals, mixed
+    prompt/output lengths and tenants. Seeded — the SAME trace drives
+    both sides of an A/B so the comparison is over identical load."""
+    import random
+
+    rng = random.Random(seed)
+    t = 0.0
+    out = []
+    for i in range(n):
+        gap = (0.0 if (i > 0 and rng.random() < burst_frac)
+               else rng.expovariate(rate_hz))
+        t += gap
+        out.append({
+            "at": t,
+            "prompt_len": rng.choice(list(prompt_lens)),
+            "gen": rng.choice(list(gen_lens)),
+            "tenant": rng.choice(list(tenants)),
+            "i": i,
+        })
+    return out
+
+
+def _parse_hist_buckets(text: str, name: str) -> dict[str, int]:
+    """Cumulative bucket counts of one Prometheus histogram family from
+    /metrics exposition text: {le: cumulative_count}. Tolerates the
+    OpenMetrics exemplar suffix tpuserve renders on bucket lines."""
+    import re
+
+    out: dict[str, int] = {}
+    for m in re.finditer(
+            rf'^{re.escape(name)}_bucket{{le="([^"]+)"}}\s+(\d+)',
+            text, re.M):
+        out[m.group(1)] = int(m.group(2))
+    return out
+
+
+def _sum_hists(hists: list[dict]) -> dict[str, int]:
+    out: dict[str, int] = {}
+    for h in hists:
+        for le, c in h.items():
+            out[le] = out.get(le, 0) + c
+    return out
+
+
+def _goodput_fields(h0: dict, h1: dict, slo_ms: float, arrivals: int,
+                    shed: int, prefix: str) -> dict:
+    """Goodput-under-SLO over one capture window, computed from the
+    SERVER-SIDE TTFT histograms (cumulative bucket deltas), not client
+    clocks: under_slo = requests whose engine-observed TTFT landed in a
+    bucket ≤ the SLO. goodput = under_slo / arrivals — shed and
+    never-served requests count against goodput by construction."""
+    def under(h: dict) -> int:
+        best = 0.0
+        val = 0
+        for le, c in h.items():
+            if le == "+Inf":
+                continue
+            f = float(le)
+            if f <= slo_ms and f >= best:
+                best, val = f, c
+        return val
+
+    total = h1.get("+Inf", 0) - h0.get("+Inf", 0)
+    u = under(h1) - under(h0)
+    return {
+        f"{prefix}_arrivals": arrivals,
+        f"{prefix}_served": total,
+        f"{prefix}_shed": shed,
+        f"{prefix}_under_slo": u,
+        f"{prefix}_goodput": round(u / arrivals, 4) if arrivals else 0.0,
+    }
+
+
+async def _get_text(s, url: str, path: str) -> str:
+    async with s.get(url + path) as resp:
+        return (await resp.read()).decode()
+
+
+async def _ttft_hists(s, urls: list[str]) -> dict[str, int]:
+    """Summed server-side TTFT histogram over a replica set."""
+    hs = []
+    for u in urls:
+        hs.append(_parse_hist_buckets(
+            await _get_text(s, u, "/metrics"), "tpuserve_ttft_hist_ms"))
+    return _sum_hists(hs)
+
+
+async def _drive_openloop(s, url: str, model: str, trace: list[dict],
+                          tag: str = "") -> dict:
+    """Fire the trace open-loop (each request at its arrival time, not
+    gated on completions) as streaming /v1/completions; returns
+    client-side outcome counts. Server-side goodput comes from the
+    replica histograms — the client numbers here are for shed
+    accounting and sanity, not latency claims."""
+    res = {"completed": 0, "shed": 0, "shed_retry_after": 0,
+           "errors": 0, "client_ttft_ms": []}
+
+    async def one(item: dict, t0: float) -> None:
+        delay = t0 + item["at"] - time.perf_counter()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        n = item["prompt_len"]
+        text = (f"{tag}{item['i']:03d}" + "y" * n)[: n - 1]
+        payload = {
+            "model": model, "prompt": text,
+            "max_tokens": item["gen"], "temperature": 0.0,
+            "stream": True, "logit_bias": {"97": 100},
+        }
+        headers = ({"x-aigw-tenant": item["tenant"]}
+                   if item["tenant"] else {})
+        sent = time.perf_counter()
+        try:
+            async with s.post(url + "/v1/completions", json=payload,
+                              headers=headers) as resp:
+                if resp.status == 429:
+                    res["shed"] += 1
+                    if resp.headers.get("retry-after"):
+                        res["shed_retry_after"] += 1
+                    await resp.read()
+                    return
+                if resp.status != 200:
+                    res["errors"] += 1
+                    await resp.read()
+                    return
+                first = -1.0
+                async for line in resp.content:
+                    line = line.strip()
+                    if first < 0 and line.startswith(b"data: ") \
+                            and b'"text"' in line:
+                        first = 1e3 * (time.perf_counter() - sent)
+                res["completed"] += 1
+                if first > 0:
+                    res["client_ttft_ms"].append(first)
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            res["errors"] += 1
+
+    import aiohttp  # noqa: F811 — bench imports lazily by convention
+    t0 = time.perf_counter()
+    await asyncio.gather(*(one(it, t0) for it in trace))
+    return res
+
+
+def _start_gateway_cfg(backend_extra: dict, endpoints: list[str]):
+    """`aigw run` subprocess over a replica POOL with arbitrary backend
+    knobs (picker_mode / slo_ttft_ms / migration …). Returns
+    (url, stop_fn)."""
+    import tempfile
+
+    import yaml
+
+    cfg = {
+        "version": "v1",
+        "backends": [dict(
+            {"name": "pool", "schema": "OpenAI",
+             "endpoints": endpoints, "picker_poll_interval": 0.2},
+            **backend_extra)],
+        "routes": [{"name": "bench", "rules": [{"backends": ["pool"]}]}],
+    }
+    f = tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False)
+    yaml.safe_dump(cfg, f)
+    f.close()
+    port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "aigw_tpu", "run", f.name,
+         "--port", str(port)],
+        cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
+    )
+
+    def stop():
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        os.unlink(f.name)
+
+    return f"http://127.0.0.1:{port}", stop
+
+
+def slo_routing_numbers(arrivals: int = 36, reps: int = 3) -> dict:
+    """The ``slo_routing`` A/B leg: the SAME seeded open-loop arrival
+    trace against two gateway configurations over the same two-replica
+    pool — picker_mode "slo" (predictive TTFT routing + shed) vs
+    "static" (the classic score sum) — goodput-under-SLO computed from
+    the replicas' server-side TTFT histograms. The pool is deliberately
+    heterogeneous: replica A is a PREFILL straggler — every prompt pads
+    to the full 512-token bucket (one rung, min bucket = max seq: the
+    shape a degraded or misconfigured replica takes in production) —
+    which static occupancy/queue scoring cannot see until queues have
+    already built, while the phase histograms price it into every
+    prediction up front. Reps interleave the two gateways over fresh
+    trace seeds; both gateways see identical load."""
+    import aiohttp
+
+    model_name = "bench-slo-tiny"
+    k = int(os.environ.get("AIGW_BENCH_CPU_K", "4"))
+    engine_common = {"num_pages": 64, "max_queued_requests": 64}
+    # replica A: the prefill straggler; replica B: the healthy sibling
+    url_a, stop_a = _start_tpuserve_subproc(
+        model_name, CPU_CFG, "", batch=2, k_steps=k,
+        engine=dict(engine_common, min_prefill_bucket=512,
+                    prefill_bucket_rungs=1),
+        page=16)
+    url_b, stop_b = _start_tpuserve_subproc(
+        model_name, CPU_CFG, "", batch=2, k_steps=k,
+        engine=dict(engine_common, min_prefill_bucket=32),
+        page=16)
+    addrs = [u[len("http://"):] for u in (url_a, url_b)]
+
+    async def run() -> dict:
+        await _wait_health(url_a, 1200)
+        await _wait_health(url_b, 1200)
+        timeout = aiohttp.ClientTimeout(total=1200)
+        async with aiohttp.ClientSession(timeout=timeout) as s:
+            # calibrate the SLO budget off the healthy replica's
+            # unloaded TTFT (sequential, direct). The same pass also
+            # seeds BOTH replicas' phase histograms — the slo gateway
+            # must know A is a prefill straggler from its first poll,
+            # not discover it by routing the first rep's traffic there
+            # (a replica with no data predicts 0 = idle)
+            cal = []
+            for i in range(3):
+                tr = [{"at": 0.0, "prompt_len": 96, "gen": 4,
+                       "tenant": "", "i": i}]
+                r = await _drive_openloop(s, url_b, model_name, tr,
+                                          tag=f"c{i}")
+                cal.extend(r["client_ttft_ms"])
+                await _drive_openloop(s, url_a, model_name, tr,
+                                      tag=f"a{i}")
+            # off the clock: drive every prompt/gen shape the timed
+            # traces use DIRECTLY at each child, so rep 0 never pays an
+            # XLA compile mid-capture (the first capture previously
+            # measured compile stalls, not routing)
+            for url, tg in ((url_b, "wb"), (url_a, "wa")):
+                warm = _poisson_trace(seed=999, n=12, rate_hz=4.0,
+                                      gen_lens=(2, 4, 6))
+                await _drive_openloop(s, url, model_name, warm, tag=tg)
+            base = _median(cal) if cal else 500.0
+            slo_ms = max(300.0, 4.0 * base)
+
+            out: dict = {"slo_routing_slo_ms": round(slo_ms, 1),
+                         "slo_routing_reps": reps}
+            acc: dict[str, list] = {"slo": [], "static": []}
+            sheds = {"slo": 0, "static": 0}
+            retry_ok = 0
+            for rep in range(reps):
+                for mode in ("slo", "static"):
+                    extra = {"picker_mode": mode} if mode == "slo" \
+                        else {}
+                    if mode == "slo":
+                        extra["slo_ttft_ms"] = slo_ms
+                    gw, stop_gw = _start_gateway_cfg(extra, addrs)
+                    try:
+                        await _wait_health(gw, 120)
+                        # let the picker poll real telemetry first
+                        await asyncio.sleep(1.0)
+                        trace = _poisson_trace(
+                            seed=1000 + rep, n=arrivals, rate_hz=1.5,
+                            gen_lens=(2, 4, 6))
+                        h0 = await _ttft_hists(s, [url_a, url_b])
+                        res = await _drive_openloop(
+                            s, gw, model_name, trace,
+                            tag=f"{mode[0]}{rep}")
+                        h1 = await _ttft_hists(s, [url_a, url_b])
+                        g = _goodput_fields(
+                            h0, h1, slo_ms, arrivals, res["shed"],
+                            prefix="x")
+                        acc[mode].append(g["x_goodput"])
+                        sheds[mode] += res["shed"]
+                        retry_ok += res["shed_retry_after"]
+                    finally:
+                        stop_gw()
+            # PAIRED comparison: rep i's slo and static captures ran
+            # the same seeded trace, so per-rep goodput ratios cancel
+            # trace difficulty and host drift; the median ratio is the
+            # claim, the pooled goodputs are context
+            ratios = [s_g / st_g for s_g, st_g in
+                      zip(acc["slo"], acc["static"]) if st_g > 0]
+            slo_g = sum(acc["slo"]) / len(acc["slo"])
+            static_g = sum(acc["static"]) / len(acc["static"])
+            out.update({
+                "slo_goodput": round(slo_g, 4),
+                "static_goodput": round(static_g, 4),
+                "slo_vs_static_goodput": (
+                    round(_median(ratios), 4) if ratios
+                    else (round(slo_g / static_g, 4) if static_g
+                          else 0.0)),
+                "slo_goodput_by_rep": [round(x, 4) for x in acc["slo"]],
+                "static_goodput_by_rep": [round(x, 4)
+                                          for x in acc["static"]],
+                "slo_shed": sheds["slo"],
+                "static_shed": sheds["static"],
+                "slo_shed_retry_after": retry_ok,
+                "slo_goodput_spread": round(_spread(acc["slo"]), 3),
+                "static_goodput_spread": round(
+                    _spread(acc["static"]), 3),
+            })
+            return out
+
+    try:
+        return asyncio.run(run())
+    finally:
+        stop_a()
+        stop_b()
+
+
+async def _disagg_migrate_once(s, url_a: str, url_b: str, model: str,
+                               prompt_len: int, tag: str) -> dict:
+    """One migration rep: stream on A, export after the first tokens,
+    import+resume on B. Returns {resume_ttft_ms, tokens_total,
+    pages_moved, text}."""
+    import aiohttp  # noqa: F811
+
+    n = prompt_len
+    text = (tag + "z" * n)[: n - 1]
+    payload = {"model": model, "prompt": text, "max_tokens": 40,
+               "temperature": 0.0, "stream": True,
+               "logit_bias": {"97": 100}}
+    pieces: list[str] = []
+    rid = ""
+    export = None
+    async with s.post(url_a + "/v1/completions", json=payload) as resp:
+        assert resp.status == 200, resp.status
+        rid = resp.headers.get("x-aigw-request-id", "")
+        got = 0
+        async for line in resp.content:
+            line = line.strip()
+            if not line.startswith(b"data: ") or line[6:] == b"[DONE]":
+                continue
+            ev = json.loads(line[6:])
+            ch = ev.get("choices") or []
+            if ch and ch[0].get("text"):
+                pieces.append(ch[0]["text"])
+                got += 1
+                if got == 2 and export is None:
+                    async with s.post(url_a + "/migrate/export",
+                                      json={"request_id": rid}) as r:
+                        assert r.status == 200, (r.status,
+                                                 await r.read())
+                        export = await r.json()
+        # stream ends at the cut with no terminal frames
+    assert export is not None
+    t0 = time.perf_counter()
+    first = -1.0
+    async with s.post(url_b + "/migrate/import", json=export) as r:
+        assert r.status == 200, (r.status, await r.read())
+        async for line in r.content:
+            line = line.strip()
+            if not line.startswith(b"data: ") or line[6:] == b"[DONE]":
+                continue
+            ev = json.loads(line[6:])
+            ch = ev.get("choices") or []
+            if ch and ch[0].get("text"):
+                if first < 0:
+                    first = 1e3 * (time.perf_counter() - t0)
+                pieces.append(ch[0]["text"])
+    return {
+        "resume_ttft_ms": first,
+        "pages_moved": len(export["pages"]),
+        "cont_tokens": len(export["blob"]["tokens"]),
+        "text": "".join(pieces),
+    }
+
+
+async def _disagg_cold_ttft(s, url: str, model: str, n_tokens: int,
+                            tag: str) -> float:
+    """Cold-prefill TTFT control: a fresh prompt of the SAME total
+    length the migrated session had at its resume."""
+    text = (tag + "q" * n_tokens)[: n_tokens - 1]
+    payload = {"model": model, "prompt": text, "max_tokens": 4,
+               "temperature": 0.0, "stream": True,
+               "logit_bias": {"97": 100}}
+    t0 = time.perf_counter()
+    async with s.post(url + "/v1/completions", json=payload) as resp:
+        assert resp.status == 200, resp.status
+        async for line in resp.content:
+            line = line.strip()
+            if line.startswith(b"data: ") and b'"text"' in line:
+                return 1e3 * (time.perf_counter() - t0)
+    return -1.0
+
+
+def disagg_numbers(reps: int = 5, prompt_len: int = 288,
+                   arrivals: int = 24) -> dict:
+    """The ``disagg`` A/B leg (ISSUE 8), two tpuserve replicas:
+
+    1. **Resume vs cold** (the headline): per interleaved rep, a
+       session streams on A, is exported after its first tokens, and
+       resumes on B through /migrate/import — resume TTFT (import +
+       page adoption + ≤1-page tail recompute + first token) against a
+       cold-prefill TTFT for a fresh prompt of the same total length on
+       the same replica. Target: resume ≤ 0.6× cold.
+    2. **Gateway orchestration under open-loop load**: the same Poisson
+       trace through a migration-ON gateway vs a migration-OFF gateway
+       over the pool (replica A deliberately slow-queued), reporting
+       server-side goodput and the migration counters — proves the
+       DECISION loop (deep prefill queue → hand off to the
+       decode-leaning sibling) fires under real load."""
+    import aiohttp
+
+    model_name = "bench-disagg-tiny"
+    k = int(os.environ.get("AIGW_BENCH_CPU_K", "4"))
+    engine_common = {"min_prefill_bucket": 32, "num_pages": 96,
+                     "max_queued_requests": 64,
+                     "kv_cache_dtype": "float32"}
+    # replica A deliberately single-slot: under the open-loop pass its
+    # admission queue deepens fast (the disaggregation trigger), while
+    # the interleaved resume-vs-cold reps below are sequential and
+    # don't care about batch width
+    url_a, stop_a = _start_tpuserve_subproc(
+        model_name, _PREFIX_CFG, "", batch=1, k_steps=k,
+        engine=dict(engine_common), page=_PREFIX_PAGE,
+        param_dtype="float32")
+    url_b, stop_b = _start_tpuserve_subproc(
+        model_name, _PREFIX_CFG, "", batch=2, k_steps=k,
+        engine=dict(engine_common), page=_PREFIX_PAGE,
+        param_dtype="float32")
+    addrs = [u[len("http://"):] for u in (url_a, url_b)]
+
+    async def run() -> dict:
+        await _wait_health(url_a, 1200)
+        await _wait_health(url_b, 1200)
+        timeout = aiohttp.ClientTimeout(total=1200)
+        async with aiohttp.ClientSession(timeout=timeout) as s:
+            # off the clock: warm both children's resume + cold shapes
+            await _disagg_migrate_once(s, url_a, url_b, model_name,
+                                       prompt_len, "w0")
+            await _disagg_cold_ttft(s, url_b, model_name,
+                                    prompt_len + 8, "w1")
+            resume_t, cold_t, pages = [], [], []
+            for rep in range(reps):
+                m = await _disagg_migrate_once(
+                    s, url_a, url_b, model_name, prompt_len,
+                    f"m{rep:02d}")
+                if m["resume_ttft_ms"] > 0:
+                    resume_t.append(m["resume_ttft_ms"])
+                pages.append(m["pages_moved"])
+                c = await _disagg_cold_ttft(
+                    s, url_b, model_name, m["cont_tokens"],
+                    f"k{rep:02d}")
+                if c > 0:
+                    cold_t.append(c)
+            st_a = await _get_state(s, url_a)
+            st_b = await _get_state(s, url_b)
+
+            # gateway orchestration under open-loop load, mig on/off:
+            # the same seeded trace through a migration-ON gateway and a
+            # migration-OFF gateway, goodput from the replicas'
+            # server-side TTFT histograms against a 2×cold-TTFT budget
+            gw_fields: dict = {}
+            gw_slo = 2.0 * _median(cold_t) if cold_t else 1000.0
+            gw_fields["disagg_gw_slo_ms"] = round(gw_slo, 1)
+            for mig in (True, False):
+                extra = {"migration": mig, "migration_queue_depth": 1,
+                         "migration_young_tokens": 48}
+                gw, stop_gw = _start_gateway_cfg(extra, addrs)
+                try:
+                    await _wait_health(gw, 120)
+                    await asyncio.sleep(1.0)
+                    trace = _poisson_trace(
+                        seed=77, n=arrivals, rate_hz=2.0,
+                        prompt_lens=(96, 160, 224),
+                        gen_lens=(16, 24, 32))
+                    h0 = await _ttft_hists(s, [url_a, url_b])
+                    res = await _drive_openloop(
+                        s, gw, model_name, trace,
+                        tag="g1" if mig else "g0")
+                    h1 = await _ttft_hists(s, [url_a, url_b])
+                    gw_fields.update(_goodput_fields(
+                        h0, h1, gw_slo, arrivals, res["shed"],
+                        prefix="disagg_gw_on" if mig
+                        else "disagg_gw_off"))
+                finally:
+                    stop_gw()
+            st_a2 = await _get_state(s, url_a)
+            st_b2 = await _get_state(s, url_b)
+            gw_fields["disagg_gw_migrations"] = (
+                st_a2["migrations_out"] + st_b2["migrations_out"]
+                - st_a["migrations_out"] - st_b["migrations_out"])
+
+        resume = _median(resume_t)
+        cold = _median(cold_t)
+        return {
+            "disagg_resume_ttft_ms_p50": round(resume, 1),
+            "disagg_cold_ttft_ms_p50": round(cold, 1),
+            "disagg_resume_vs_cold": (round(resume / cold, 4)
+                                      if cold else 0.0),
+            "disagg_resume_spread": round(_spread(resume_t), 3),
+            "disagg_cold_spread": round(_spread(cold_t), 3),
+            "disagg_pages_moved": _median([float(p) for p in pages]),
+            "disagg_migrations_out": st_a["migrations_out"],
+            "disagg_migrations_in": st_b["migrations_in"],
+            "disagg_ab_reps": reps,
+            **gw_fields,
+        }
+
+    try:
+        return asyncio.run(run())
+    finally:
+        stop_a()
+        stop_b()
+
+
 def _chip_responsive(timeout_s: float = 180.0) -> bool:
     """The axon tunnel can go down entirely (observed 2026-07-28); probe
     with a watchdog so the bench prints an honest line instead of hanging
@@ -1397,6 +1907,16 @@ def run_cpu_ratio() -> dict:
     except Exception as e:
         print(f"lora leg failed: {type(e).__name__}: {e}",
               file=sys.stderr)
+    try:
+        res.update(disagg_numbers())
+    except Exception as e:
+        print(f"disagg leg failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+    try:
+        res.update(slo_routing_numbers())
+    except Exception as e:
+        print(f"slo_routing leg failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
     return res
 
 
@@ -1495,10 +2015,30 @@ def main() -> None:
                 "(parity), zero hot compiles across mix changes and "
                 "the evict/reload churn phase, and the load/eviction "
                 "counters are the signal — absolute tok/s is not")
+        elif target == "disagg":
+            result = disagg_numbers()
+            result["metric"] = (
+                "disagg interleaved A/B — prefill/decode disaggregation "
+                "over two tpuserve replicas: a session streamed on A is "
+                "exported after its first tokens and resumed on B via "
+                "KV page migration; resume TTFT vs a cold prefill of "
+                "the same total length (target ≤ 0.6), plus a gateway "
+                "migration-on/off open-loop pass; ratios are the "
+                "signal, absolute ms is not (CPU backend)")
+        elif target == "slo_routing":
+            result = slo_routing_numbers()
+            result["metric"] = (
+                "slo_routing A/B — the same seeded open-loop Poisson "
+                "trace through a picker_mode=slo gateway (predicted-"
+                "TTFT routing + 429 shed) vs a static-score gateway "
+                "over the same heterogeneous 2-replica pool; goodput-"
+                "under-SLO from server-side TTFT histograms is the "
+                "signal (CPU backend)")
         else:
             print(json.dumps({"error": f"unknown --ab target {target!r}; "
                               "supported: prefix_cache, spec_decode, "
-                              "ragged_prefill, lora"}))
+                              "ragged_prefill, lora, disagg, "
+                              "slo_routing"}))
             return
         print(json.dumps(result))
         return

@@ -276,6 +276,8 @@ class TestEngineAdapterServing:
         finally:
             eng.stop()
 
+    @pytest.mark.slow
+
     def test_adapter_slot_on_speculating_sequence(self):
         """An adapter slot riding the speculative verify path emits the
         same tokens as plain decode (spec on/off token-identical, f32

@@ -175,3 +175,146 @@ def test_bench_lora_ab_fields():
     assert f["lora_hot_compiles"] == 0
     z = bench._lora_ab_fields(st1, st1)
     assert z["adapter_loads"] == 0 and z["adapter_evictions"] == 0
+
+
+@pytest.mark.bench_smoke
+def test_bench_openloop_trace_and_goodput_helpers():
+    """Pure helpers behind the open-loop legs (ISSUE 8): the seeded
+    Poisson trace is deterministic and shaped right, histogram parsing
+    survives OpenMetrics exemplar suffixes, and goodput derives from
+    cumulative bucket deltas (shed requests count against goodput)."""
+    t1 = bench._poisson_trace(seed=7, n=20, rate_hz=5.0,
+                              tenants=("a", "b"))
+    t2 = bench._poisson_trace(seed=7, n=20, rate_hz=5.0,
+                              tenants=("a", "b"))
+    assert t1 == t2  # same seed → same trace (the A/B contract)
+    assert len(t1) == 20
+    assert all(t1[i]["at"] <= t1[i + 1]["at"] for i in range(19))
+    assert {it["tenant"] for it in t1} <= {"a", "b"}
+    assert bench._poisson_trace(seed=8, n=20, rate_hz=5.0) != t1
+
+    text = (
+        'tpuserve_ttft_hist_ms_bucket{le="100"} 3 # {trace_id="ab"} 42\n'
+        'tpuserve_ttft_hist_ms_bucket{le="250"} 7\n'
+        'tpuserve_ttft_hist_ms_bucket{le="+Inf"} 9\n'
+        "tpuserve_ttft_hist_ms_sum 1234\n")
+    h1 = bench._parse_hist_buckets(text, "tpuserve_ttft_hist_ms")
+    assert h1 == {"100": 3, "250": 7, "+Inf": 9}
+    h0 = {"100": 1, "250": 1, "+Inf": 1}
+    g = bench._goodput_fields(h0, h1, slo_ms=250.0, arrivals=10,
+                              shed=2, prefix="x")
+    assert g["x_served"] == 8
+    assert g["x_under_slo"] == 6  # Δ of the 250 bucket
+    assert g["x_shed"] == 2
+    assert g["x_goodput"] == 0.6  # under_slo / ARRIVALS, not served
+    z = bench._goodput_fields(h1, h1, 250.0, 0, 0, "z")
+    assert z["z_goodput"] == 0.0  # empty capture, no ZeroDivisionError
+
+
+@pytest.mark.bench_smoke
+def test_bench_openloop_gateway_smoke():
+    """Open-loop smoke (ISSUE 8 satellite): ~50 Poisson arrivals
+    through a real gateway (picker over one tpuserve child) — the
+    load generator and its goodput fields must stay live between bench
+    rounds, and SLO shedding must return 429 + Retry-After."""
+    import asyncio
+    import threading
+
+    import aiohttp
+    from aiohttp import web
+
+    from aigw_tpu.config.model import Config
+    from aigw_tpu.config.runtime import RuntimeConfig
+    from aigw_tpu.gateway.server import run_gateway
+    from aigw_tpu.tpuserve.engine import EngineConfig
+    from aigw_tpu.tpuserve.server import TPUServeServer
+
+    holder = {}
+    started = threading.Event()
+
+    def run_replica():
+        async def main():
+            server = TPUServeServer(
+                "tiny-random",
+                EngineConfig(max_batch_size=2, max_seq_len=256,
+                             page_size=16, min_prefill_bucket=16,
+                             decode_steps_per_tick=2))
+            runner = web.AppRunner(server.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            holder["addr"] = (
+                f"127.0.0.1:{site._server.sockets[0].getsockname()[1]}")
+            holder["loop"] = asyncio.get_running_loop()
+            started.set()
+            await asyncio.Event().wait()
+
+        try:
+            asyncio.run(main())
+        except RuntimeError:
+            pass
+
+    t = threading.Thread(target=run_replica, daemon=True)
+    t.start()
+    assert started.wait(timeout=300)
+    addr = holder["addr"]
+
+    async def main():
+        cfg = Config.parse({
+            "version": "v1",
+            "backends": [{"name": "pool", "schema": "OpenAI",
+                          "endpoints": [addr],
+                          "picker_poll_interval": 0.2,
+                          "picker_mode": "slo",
+                          "slo_ttft_ms": 60000.0}],
+            "routes": [{"name": "bench",
+                        "rules": [{"backends": ["pool"]}]}],
+            "models": ["tiny-random"],
+        })
+        server, runner = await run_gateway(RuntimeConfig.build(cfg),
+                                           port=0)
+        site = list(runner.sites)[0]
+        gw = f"http://127.0.0.1:{site._server.sockets[0].getsockname()[1]}"
+        try:
+            picker = server._pickers["pool"]
+            for _ in range(100):
+                if picker.state[addr].healthy:
+                    break
+                await asyncio.sleep(0.1)
+            async with aiohttp.ClientSession() as s:
+                trace = bench._poisson_trace(
+                    seed=3, n=50, rate_hz=25.0,
+                    prompt_lens=(24, 48), gen_lens=(2, 3),
+                    tenants=("", "tA"))
+                h0 = await bench._ttft_hists(s, [f"http://{addr}"])
+                res = await bench._drive_openloop(
+                    s, gw, "tiny-random", trace, tag="sm")
+                h1 = await bench._ttft_hists(s, [f"http://{addr}"])
+                g = bench._goodput_fields(h0, h1, slo_ms=60000.0,
+                                          arrivals=len(trace),
+                                          shed=res["shed"], prefix="ol")
+                # the generator drove real load and the fields are live
+                assert res["errors"] == 0, res
+                assert res["completed"] + res["shed"] == 50
+                assert g["ol_served"] >= res["completed"]
+                assert set(g) == {"ol_arrivals", "ol_served", "ol_shed",
+                                  "ol_under_slo", "ol_goodput"}
+                assert g["ol_goodput"] > 0.0  # a 60s SLO is met on CPU
+
+                # force the shed path: with live histograms and an
+                # absurd 0.01ms SLO every prediction is blown → every
+                # request sheds with 429 + Retry-After
+                picker.slo_ttft_ms = 0.01
+                shed_trace = bench._poisson_trace(
+                    seed=4, n=6, rate_hz=50.0, prompt_lens=(24,),
+                    gen_lens=(2,))
+                res2 = await bench._drive_openloop(
+                    s, gw, "tiny-random", shed_trace, tag="sh")
+                assert res2["shed"] >= 1, res2
+                assert res2["shed_retry_after"] == res2["shed"], (
+                    "shed responses must carry Retry-After")
+        finally:
+            await runner.cleanup()
+            holder["loop"].call_soon_threadsafe(holder["loop"].stop)
+
+    asyncio.run(main())

@@ -74,6 +74,9 @@ def test_hf_import_matches_native(tmp_path):
     np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=2e-2)
 
 
+@pytest.mark.slow
+
+
 def test_mixtral_hf_import(tmp_path):
     """Mixtral-layout safetensors (per-expert w1/w2/w3 + router gate) import
     into our stacked [E, ...] MoE params with identical logits."""

@@ -31,6 +31,7 @@ from aigw_tpu.models import llama
 from aigw_tpu.models.registry import get_model_spec
 from aigw_tpu.tpuserve.engine import Engine, EngineConfig, GenRequest
 from aigw_tpu.tpuserve.sampling import SamplingParams
+import pytest
 
 
 def _engine(chunk: int, prefix_cache: bool = True,
@@ -92,10 +93,16 @@ def _compare_chunked(prompt, chunk, min_steps):
     return ref
 
 
+@pytest.mark.slow
+
+
 def test_chunked_matches_unchunked_greedy():
     prompt = [(7 * i + 3) % 500 + 1 for i in range(150)]  # > 2 chunks
     ref = _compare_chunked(prompt, chunk=64, min_steps=2)
     assert len(ref) == 6
+
+
+@pytest.mark.slow
 
 
 def test_chunk_boundary_not_multiple_of_page():

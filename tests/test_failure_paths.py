@@ -15,6 +15,7 @@ from aigw_tpu.config.runtime import RuntimeConfig
 from aigw_tpu.gateway.server import run_gateway
 
 from fakes import FakeUpstream, openai_chat_response
+import pytest
 
 
 def run(coro):
@@ -248,6 +249,8 @@ class TestMidStreamFailure:
                 await up.stop()
 
         run(main())
+
+    @pytest.mark.slow
 
     def test_stream_idle_timeout_mid_stream(self):
         """A stalled SSE stream exceeds stream_idle_timeout → the client

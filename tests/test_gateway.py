@@ -650,6 +650,8 @@ class TestTrafficSemantics:
 
         run(main())
 
+    @pytest.mark.slow
+
     def test_stream_idle_timeout_aborts(self):
         """A stalled SSE stream is cut off after stream_idle_timeout with
         an error event (reference examples/stream_idle_timeout →

@@ -60,6 +60,11 @@ def test_randomized_admit_complete_evict_schedule():
         # are read from the allocator, the source of truth)
         pending_frees: list[int] = []
         inflight: tuple[frozenset[int], list[int]] | None = None
+        # open migration-export pins (ISSUE 8): page lists whose
+        # device→host / wire transfer is notionally in flight — the
+        # exported chain must never be handed out while pinned, even
+        # though its owning sequence was freed at the cut
+        exports: list[list[int]] = []
 
         def referenced_pages() -> set[int]:
             pages: set[int] = set()
@@ -67,6 +72,8 @@ def test_randomized_admit_complete_evict_schedule():
                 pages.update(alloc.pages(sid))
             if inflight is not None:
                 pages.update(inflight[0])
+            for pin in exports:
+                pages.update(pin)
             return pages
 
         def check_fresh(fresh: list[int], what: str) -> None:
@@ -108,11 +115,24 @@ def test_randomized_admit_complete_evict_schedule():
                     continue
                 cache.insert(chain, alloc.pages(sid))
                 live[sid] = prompt
-            elif op < 0.65 and live:  # complete (free is DEFERRED)
+            elif op < 0.60 and live:  # complete (free is DEFERRED)
                 sid = rng.choice(list(live))
                 del live[sid]
                 pending_frees.append(sid)
-            elif op < 0.85:  # dispatch a window
+            elif op < 0.70 and live:  # migration export cut (ISSUE 8)
+                # the engine's _do_export discipline: pin the complete
+                # pages, then free the slot immediately — the pinned
+                # chain outlives its owner until end_export
+                sid = rng.choice(list(live))
+                pages = alloc.pages(sid)
+                k = max(1, len(pages) - 1)
+                exports.append(alloc.begin_export(pages[:k]))
+                del live[sid]
+                pending_frees.append(sid)
+            elif op < 0.78 and exports:  # transfer finished
+                alloc.end_export(exports.pop(
+                    rng.randrange(len(exports))))
+            elif op < 0.88:  # dispatch a window
                 if inflight is None:
                     captured, pending_frees = pending_frees, []
                     window_pages: set[int] = set()
@@ -140,7 +160,7 @@ def test_randomized_admit_complete_evict_schedule():
                 assert p not in free_set
                 assert p not in alloc._evictable
 
-        # drain everything: no page may leak
+        # drain everything: no page may leak (export pins included)
         if inflight is not None:
             for sid in inflight[1]:
                 alloc.free(sid)
@@ -148,7 +168,40 @@ def test_randomized_admit_complete_evict_schedule():
             alloc.free(sid)
         for sid in pending_frees:
             alloc.free(sid)
+        for pin in exports:
+            alloc.end_export(pin)
         assert alloc.available_pages == alloc.num_pages
+
+
+def test_export_pin_blocks_reclaim_and_release_parks():
+    """Unit half of the property above: a pinned page is neither
+    allocatable nor evictable while the transfer is in flight; after
+    end_export a registered page parks evictable (revivable), an
+    unregistered one returns to the free stack."""
+    alloc = RefcountedAllocator(num_pages=4, page_size=PS)
+    cache = PrefixCache(alloc, PS)
+    prompt = [3] * (PS * 2)
+    chain = page_chain_hashes(prompt, PS)
+    alloc.allocate(0, PS * 2)
+    reg, unreg = alloc.pages(0)
+    cache.insert(chain[:1], [reg])  # only page 0 is cache-registered
+    pin = alloc.begin_export([reg, unreg])
+    alloc.free(0)  # the cut: the owner is gone, the pin holds
+    assert alloc.available_pages == 2  # pinned pages not reclaimable
+    alloc.allocate(1, PS * 2)  # must take the OTHER two pages
+    assert not set(alloc.pages(1)) & {reg, unreg}
+    try:
+        alloc.allocate(2, PS)
+        raise AssertionError("pinned page was handed out")
+    except OutOfPagesError:
+        pass
+    alloc.end_export(pin)
+    # registered page parks (revivable), unregistered page frees
+    assert cache.probe(chain[:1]) == [reg]
+    assert reg in alloc._evictable
+    assert unreg in alloc._free
+    alloc.free(1)
+    assert alloc.available_pages == 4
 
 
 def test_eviction_reclaims_parked_pages_and_counts():

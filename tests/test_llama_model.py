@@ -25,6 +25,9 @@ def fresh_cache(n_pages=64):
     )
 
 
+@pytest.mark.slow
+
+
 def test_prefill_decode_consistency(params):
     """Teacher-forcing: logits from (prefill prompt → decode token-by-token)
     must match logits from prefilling the whole sequence at once."""
@@ -71,6 +74,9 @@ def test_prefill_respects_padding(params):
     )
     np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=1e-3,
                                atol=1e-3)
+
+
+@pytest.mark.slow
 
 
 def test_batch_isolation(params):
@@ -150,6 +156,8 @@ class TestQwenVariant:
         lb, _ = llama.prefill(p2, self.CFG_Q, tokens, jnp.array([3]),
                               jnp.zeros_like(cache), pt, 16)
         assert float(jnp.abs(la - lb).max()) > 1e-3
+
+    @pytest.mark.slow
 
     def test_prefill_decode_consistency_with_bias(self):
         p = llama.init_params(jax.random.PRNGKey(1), self.CFG_Q)

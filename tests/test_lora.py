@@ -44,6 +44,9 @@ def generate(eng, prompt, adapter=""):
     return toks
 
 
+@pytest.mark.slow
+
+
 def test_zero_row_is_exact_base_model():
     """With adapters loaded, base-model requests (zero row) must produce
     EXACTLY the same tokens as an engine without LoRA at all."""
@@ -61,6 +64,9 @@ def test_zero_row_is_exact_base_model():
         assert got == want
     finally:
         eng.stop()
+
+
+@pytest.mark.slow
 
 
 def test_adapters_change_output_and_are_isolated():
@@ -90,6 +96,9 @@ def test_adapters_change_output_and_are_isolated():
         assert fins == ["error"]
     finally:
         eng.stop()
+
+
+@pytest.mark.slow
 
 
 def test_mixed_batch_adapters_match_solo_runs():
@@ -143,6 +152,7 @@ def test_lora_delta_math():
 
 
 class TestServerLoRA:
+    @pytest.mark.slow
     def test_server_adapter_selection(self):
         """HTTP: model '<base>:<adapter>' routes to the adapter; /v1/models
         lists adapters."""
@@ -200,6 +210,9 @@ class TestServerLoRA:
         asyncio.run(main())
 
 
+@pytest.mark.slow
+
+
 def test_unknown_adapter_suffix_404():
     import asyncio
 
@@ -239,6 +252,9 @@ def test_unknown_adapter_suffix_404():
             await runner.cleanup()
 
     asyncio.run(main())
+
+
+@pytest.mark.slow
 
 
 def test_quantized_base_with_lora_and_prefix_cache():

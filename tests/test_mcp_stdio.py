@@ -60,6 +60,7 @@ class TestParse:
 
 
 class TestBridge:
+    @pytest.mark.slow
     def test_request_response_and_notification_stream(self):
         async def main():
             bridge = StdioMCPBridge(StdioServerSpec(

@@ -64,6 +64,9 @@ def test_topk_weights_normalized(params):
     np.testing.assert_allclose(np.asarray(w.sum(-1)), 1.0, rtol=1e-5)
 
 
+@pytest.mark.slow
+
+
 def test_prefill_decode_consistency(params):
     """The MoE path preserves the paged-KV decode invariant."""
     tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 20), 0,
@@ -83,6 +86,9 @@ def test_prefill_decode_consistency(params):
     np.testing.assert_allclose(
         np.asarray(full), np.asarray(logits), rtol=5e-2, atol=5e-2
     )
+
+
+@pytest.mark.slow
 
 
 def test_expert_parallel_matches_single_device(params):
@@ -111,6 +117,9 @@ def test_expert_parallel_matches_single_device(params):
     )
     assert (np.asarray(ref_logits).argmax(-1)
             == np.asarray(ep_logits).argmax(-1)).all()
+
+
+@pytest.mark.slow
 
 
 def test_engine_serves_tiny_moe():

@@ -366,6 +366,9 @@ class TestPickerExplain:
         assert explain["sticky"] is True
 
 
+@pytest.mark.slow
+
+
 def test_traced_request_adds_zero_compiles_after_warmup():
     """Tracing must never perturb the compiled-program ladder: after
     warmup(), a request carrying a full RequestTrace (span tree + flight

@@ -34,6 +34,9 @@ def test_mesh_too_big_rejected():
         make_mesh(MeshSpec(dp=4, tp=4))
 
 
+@pytest.mark.slow
+
+
 def test_tp_matches_single_device():
     """TP=4 sharded prefill logits == unsharded logits (GSPMD collectives
     preserve the math)."""
@@ -77,10 +80,16 @@ def test_graft_entry_single():
     jax.block_until_ready(out)
 
 
+@pytest.mark.slow
+
+
 def test_graft_dryrun_multichip():
     import __graft_entry__ as g
 
     g.dryrun_multichip(8)
+
+
+@pytest.mark.slow
 
 
 def test_engine_tp_matches_single_device():
@@ -123,6 +132,9 @@ def test_engine_tp_matches_single_device():
     single = generate(None)
     tp = generate(make_mesh(MeshSpec(dp=1, tp=2)))
     assert single == tp
+
+
+@pytest.mark.slow
 
 
 def test_engine_tp_batched_prefill_burst():

@@ -270,3 +270,143 @@ class TestPrefixAffinity:
         assert _prefix_hash_key(c) != k
         assert _prefix_hash_key(
             {"messages": [{"role": "user", "content": "q"}]}) == ""
+
+
+def make_slo_picker(slo_ms: float = 0.0):
+    return EndpointPicker(
+        [Endpoint("10.0.0.1:8011"), Endpoint("10.0.0.2:8011"),
+         Endpoint("10.0.0.3:8011")],
+        mode="slo", slo_ttft_ms=slo_ms,
+    )
+
+
+def _pp(prefill_p50: float, ttft_p50: float = -1.0) -> dict:
+    return {"prefill": {"p50": prefill_p50, "p95": -1, "p99": -1},
+            "ttft": {"p50": ttft_p50, "p95": -1, "p99": -1}}
+
+
+class TestSLOMode:
+    """SLO-aware routing (ISSUE 8): predicted TTFT from phase
+    histograms + queue depth replaces the static score sum; admission
+    control sheds when every candidate blows the budget."""
+
+    def test_predicted_ttft_formula(self):
+        p = make_slo_picker()
+        p.observe("10.0.0.1:8011", queued=3, queue_wait_ms=120.0,
+                  phase_percentiles=_pp(50.0))
+        st = p.state["10.0.0.1:8011"]
+        # queue_wait + prefill_p50 × (queued + 1)
+        assert p.predicted_ttft_ms(st) == 120.0 + 50.0 * 4
+
+    def test_predicted_falls_back_to_ttft_hist(self):
+        p = make_slo_picker()
+        p.observe("10.0.0.1:8011", queued=0,
+                  phase_percentiles=_pp(-1.0, ttft_p50=80.0))
+        assert p.predicted_ttft_ms(p.state["10.0.0.1:8011"]) == 80.0
+        p.observe("10.0.0.2:8011", phase_percentiles=_pp(-1.0, -1.0))
+        assert p.predicted_ttft_ms(p.state["10.0.0.2:8011"]) is None
+
+    def test_routes_by_predicted_not_static_score(self):
+        """A straggler replica with an EMPTY queue but slow prefills
+        loses to a busier-but-fast sibling — exactly the case static
+        occupancy scoring gets backwards."""
+        p = make_slo_picker()
+        p.observe("10.0.0.1:8011", kv_occupancy=0.1, queued=0,
+                  phase_percentiles=_pp(800.0))   # slow straggler
+        p.observe("10.0.0.2:8011", kv_occupancy=0.4, queued=1,
+                  phase_percentiles=_pp(40.0))    # fast, mildly busy
+        p.observe("10.0.0.3:8011", kv_occupancy=0.2, queued=4,
+                  queue_wait_ms=900.0, phase_percentiles=_pp(40.0))
+        explain: dict = {}
+        assert p.pick(explain=explain) == "10.0.0.2:8011"
+        assert explain["mode"] == "slo"
+        # satellite: the per-endpoint predicted TTFTs ride the explain
+        assert explain["predicted_ttft_ms"]["10.0.0.1:8011"] == 800.0
+        assert explain["predicted_ttft_ms"]["10.0.0.2:8011"] == 80.0
+        assert explain["predicted_ttft_chosen_ms"] == 80.0
+
+    def test_cold_candidate_presumed_idle(self):
+        """A replica with no histogram data yet predicts 0 (it has
+        served nothing — it IS idle) and attracts traffic."""
+        p = make_slo_picker()
+        p.observe("10.0.0.1:8011", queued=2,
+                  phase_percentiles=_pp(100.0))
+        p.observe("10.0.0.2:8011", queued=0,
+                  phase_percentiles=_pp(-1.0, -1.0))
+        p.observe("10.0.0.3:8011", queued=1,
+                  phase_percentiles=_pp(100.0))
+        assert p.pick() == "10.0.0.2:8011"
+
+    def test_no_data_anywhere_falls_back_to_static(self):
+        p = make_slo_picker(slo_ms=1.0)  # absurd SLO: would shed…
+        p.observe("10.0.0.1:8011", kv_occupancy=0.9)
+        p.observe("10.0.0.2:8011", kv_occupancy=0.1)
+        p.observe("10.0.0.3:8011", kv_occupancy=0.5)
+        # …but with zero histogram data the picker never sheds blind,
+        # and static scoring picks the least loaded
+        assert p.pick() == "10.0.0.2:8011"
+
+    def test_shed_when_every_candidate_blows_slo(self):
+        from aigw_tpu.gateway.picker import SLOShedError
+
+        p = make_slo_picker(slo_ms=200.0)
+        p.observe("10.0.0.1:8011", queued=5, queue_wait_ms=500.0,
+                  phase_percentiles=_pp(100.0))
+        p.observe("10.0.0.2:8011", queued=3,
+                  phase_percentiles=_pp(150.0))
+        p.observe("10.0.0.3:8011", queued=9, queue_wait_ms=2000.0,
+                  phase_percentiles=_pp(100.0))
+        with pytest.raises(SLOShedError) as ei:
+            p.pick()
+        assert ei.value.retry_after_s >= 1
+        # min predicted = replica 2 at 150·4 = 600ms → 400ms over
+        assert ei.value.predicted_ms == 600.0
+
+    def test_one_good_candidate_prevents_shed(self):
+        p = make_slo_picker(slo_ms=200.0)
+        p.observe("10.0.0.1:8011", queued=5, queue_wait_ms=500.0,
+                  phase_percentiles=_pp(100.0))
+        p.observe("10.0.0.2:8011", queued=0,
+                  phase_percentiles=_pp(50.0))
+        p.observe("10.0.0.3:8011", queued=9,
+                  phase_percentiles=_pp(100.0))
+        assert p.pick() == "10.0.0.2:8011"
+
+    def test_slo_zero_never_sheds(self):
+        p = make_slo_picker(slo_ms=0.0)
+        for a in ("10.0.0.1:8011", "10.0.0.2:8011", "10.0.0.3:8011"):
+            p.observe(a, queued=50, queue_wait_ms=60000.0,
+                      phase_percentiles=_pp(500.0))
+        assert p.pick() in p.state  # routed, not shed
+
+    def test_session_stickiness_in_ms(self):
+        p = make_slo_picker()
+        for a in ("10.0.0.1:8011", "10.0.0.2:8011", "10.0.0.3:8011"):
+            p.observe(a, phase_percentiles=_pp(50.0))
+        h = {AFFINITY_HEADER: "sess-1"}
+        first = p.pick(h)
+        # mild skew (< STICKINESS_MARGIN_MS): the session stays put
+        p.observe(first, queued=2, phase_percentiles=_pp(50.0))
+        assert p.pick(h) == first
+        # blown margin: the session moves
+        p.observe(first, queued=40, queue_wait_ms=5000.0,
+                  phase_percentiles=_pp(50.0))
+        assert p.pick(h) != first
+
+    def test_prefix_affinity_bonus_in_ms(self):
+        p = make_slo_picker()
+        for a in ("10.0.0.1:8011", "10.0.0.2:8011", "10.0.0.3:8011"):
+            p.observe(a, phase_percentiles=_pp(50.0))
+        h = {PREFIX_HEADER: "head-1"}
+        first = p.pick(h)
+        # small disadvantage (< the ms bonus): affinity holds
+        p.observe(first, queued=1, phase_percentiles=_pp(50.0))
+        assert p.pick(h) == first
+        # saturation overrides affinity
+        p.observe(first, queued=30, queue_wait_ms=9000.0,
+                  phase_percentiles=_pp(50.0))
+        assert p.pick(h) != first
+
+    def test_invalid_mode_rejected(self):
+        with pytest.raises(ValueError):
+            EndpointPicker([Endpoint("10.0.0.1:8011")], mode="wat")

@@ -211,6 +211,9 @@ def test_state_and_metrics_export_phase_histograms(smoke_url):
             raise AssertionError(f"{name} +Inf bucket missing")
 
 
+@pytest.mark.slow
+
+
 def test_warm_prefill_buckets_covers_every_rung():
     """Compile-on-hot-path tripwire: with warm_prefill_buckets=N, every
     rung of the first N octaves (x1, x1.5 at rungs=2) must be compiled
@@ -246,6 +249,9 @@ def test_warm_prefill_buckets_covers_every_rung():
             "prefill compile on the hot path")
     finally:
         eng.stop()
+
+
+@pytest.mark.slow
 
 
 def test_spec_verify_ladder_warm_no_hot_compiles():
@@ -480,3 +486,34 @@ def test_ragged_backend_zero_hot_compiles_any_geometry():
             f"{eng.compile_tracker.programs()}")
     finally:
         eng.stop()
+
+
+# prefill/decode disaggregation surface (ISSUE 8): a renamed field here
+# silently breaks the gateway's migration orchestrator (polls
+# migratable_slots) or the bench --ab disagg leg (reads the counters)
+MIGRATION_STATE_FIELDS = (
+    "migrations_out",
+    "migrations_in",
+    "migration_pages_out",
+    "migration_pages_in",
+    "migratable_slots",
+)
+
+MIGRATION_GAUGES = (
+    "tpuserve_migrations_out_total",
+    "tpuserve_migrations_in_total",
+    "tpuserve_migration_pages_out_total",
+    "tpuserve_migration_pages_in_total",
+    "tpuserve_migratable_slots",
+)
+
+
+def test_state_and_metrics_export_migration_gauges(smoke_url):
+    """The migration surface must appear on /state and /metrics even on
+    a replica that has never migrated anything (constant 0)."""
+    state = json.loads(asyncio.run(_get(smoke_url, "/state")))
+    for field in MIGRATION_STATE_FIELDS:
+        assert field in state, f"/state lost {field}"
+    text = asyncio.run(_get(smoke_url, "/metrics")).decode()
+    for gauge in MIGRATION_GAUGES:
+        assert gauge in text, f"/metrics lost {gauge}"

@@ -75,16 +75,59 @@ class TestDecodeIntegration:
             out.append(np.asarray(tokens))
         return np.stack(out)
 
+    def _decode_logits(self, cfg, params, token_seq):
+        """Teacher-forced decode: run the SAME token inputs through the
+        decode step, returning per-step logits (no compounding)."""
+        from aigw_tpu.tpuserve.engine import EngineConfig
+
+        B, PAGE = 2, 64
+        ecfg = EngineConfig(max_batch_size=B, max_seq_len=cfg.max_seq_len,
+                            page_size=PAGE)
+        kv = jnp.zeros(
+            (cfg.n_layers, 2, ecfg.num_pages * PAGE, cfg.n_kv_heads,
+             cfg.head_dim), jnp.bfloat16)
+        pt = jnp.arange(B * ecfg.max_pages_per_seq,
+                        dtype=jnp.int32).reshape(B, -1)
+        active = jnp.ones((B,), bool)
+        positions = jnp.zeros((B,), jnp.int32)
+        out = []
+        for i, tokens in enumerate(token_seq):
+            logits, kv = llama.decode_step(
+                params, cfg, jnp.asarray(tokens), positions + i, kv, pt,
+                PAGE, active)
+            out.append(np.asarray(logits, np.float32))
+        return out
+
     def test_quantized_decode_same_with_kernel_on_off(self, monkeypatch):
+        """Kernel-on vs kernel-off decode parity, tie-aware. The old
+        form compared an 8-step FREE-RUNNING greedy rollout token for
+        token — but scale-after-accumulate vs bf16-dequant differ by a
+        few centi-logits, and random-init bf16 logits produce exact
+        argmax TIES (observed top-2 gap 0.0 at step 4 for this seed), so
+        the rollout was a tie lottery that compounded from the first
+        flip (the same artifact class as the chunked-prefill
+        post-mortem). Teacher-forcing one token sequence through both
+        paths keeps the comparison per-step: logits must agree within
+        kernel tolerance everywhere, and argmax must agree wherever the
+        decision is not inside the numeric noise floor."""
         params = llama.init_params(jax.random.PRNGKey(0), ALIGNED)
         qp = quantize_params(dict(params))
         monkeypatch.setenv("AIGW_PALLAS_QMATMUL", "off")
-        off = self._greedy_tokens(ALIGNED, qp)
+        off_toks = self._greedy_tokens(ALIGNED, qp)
+        seq = [np.array([3, 5], np.int32)] + [t for t in off_toks[:-1]]
+        off_logits = self._decode_logits(ALIGNED, qp, seq)
         monkeypatch.setenv("AIGW_PALLAS_QMATMUL", "on")
-        on = self._greedy_tokens(ALIGNED, qp)
-        # same greedy path (scale-after-accumulate vs bf16-dequant can
-        # flip ties in principle; random-init logits are well separated)
-        assert (off == on).all()
+        on_logits = self._decode_logits(ALIGNED, qp, seq)
+        NOISE = 0.125  # ≳2× the observed on/off max deviation (~0.05)
+        for i, (lo, ln) in enumerate(zip(off_logits, on_logits)):
+            rel = np.abs(lo - ln).max() / (np.abs(lo).max() + 1e-9)
+            assert rel < 0.02, f"step {i}: kernel diverged ({rel:.4f})"
+            for b in range(lo.shape[0]):
+                srt = np.sort(lo[b])[::-1]
+                if srt[0] - srt[1] > NOISE:  # a real decision, not a tie
+                    assert lo[b].argmax() == ln[b].argmax(), (
+                        f"step {i} row {b}: argmax flipped on a "
+                        f"{srt[0] - srt[1]:.3f}-gap decision")
 
     def test_unaligned_config_falls_back(self, monkeypatch):
         """TINY dims (64) are not kernel-eligible — the quantized model

@@ -131,6 +131,9 @@ def _moe_quant_mesh_case(cfg, mode, mesh_spec, seed=0, attempts=2):
     raise AssertionError(f"mesh diverged every attempt: {last}")
 
 
+@pytest.mark.slow
+
+
 def test_quantized_moe_ep_matches_single_device():
     """Quantized Mixtral (r5: expert matrices resolve through llama._w,
     so W8A16/W4A16 MoE serves) — ep×tp-sharded int8 matches unsharded."""
@@ -142,6 +145,9 @@ def test_quantized_moe_ep_matches_single_device():
     assert q.dtype == jnp.int8
     # per-EXPERT scales: one outlier expert must not coarsen the rest
     assert params["l0.w_gate.scale"].shape == (q.shape[0], 1, q.shape[2])
+
+
+@pytest.mark.slow
 
 
 def test_quantized_moe_int4_groups_on_mesh():
@@ -174,6 +180,9 @@ def test_server_accepts_quantized_moe():
     from aigw_tpu.models.quant import is_quantized
 
     assert is_quantized(server.engine.params)
+
+
+@pytest.mark.slow
 
 
 def test_quantized_tp_serving_matches_single_device():
@@ -264,6 +273,8 @@ class TestPenaltiesAndBias:
         finally:
             eng.stop()
 
+    @pytest.mark.slow
+
     def test_frequency_penalty_reduces_repetition(self):
         from aigw_tpu.tpuserve.engine import Engine, EngineConfig
 
@@ -351,6 +362,8 @@ class TestInt4:
         assert np.corrcoef(a.ravel(), b.ravel())[0, 1] > 0.9
         # (argmax agreement is a lottery here: only 2 last-position
         # rows of near-tied random logits — corr is the real signal)
+
+    @pytest.mark.slow
 
     def test_multigroup_decode_matches_dequant_reference(self):
         """K=256 matrices carry 2 scale groups — exactly the shape that

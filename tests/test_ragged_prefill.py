@@ -103,6 +103,9 @@ _PROMPTS = {
 }
 
 
+@pytest.mark.slow
+
+
 def test_mixed_burst_byte_identical_and_cheaper_padding():
     """One mixed-length burst — greedy, penalized, and logit-biased
     slots — packs into token-budget ragged calls (the 150-token prompt

@@ -61,6 +61,9 @@ def test_ulysses_matches_full(qkv, causal):
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
+
+
 def test_ring_production_shape_ab_smoke():
     """A/B smoke at the shape the sp path actually serves — llama-3-8B
     attention extents (H=32, Hkv=8, D=128) at the sp_prefill_min_tokens

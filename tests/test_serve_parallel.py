@@ -67,6 +67,8 @@ class TestExpertParallelServing:
             ep=4, tp=2,
         )
 
+    @pytest.mark.slow
+
     def test_chat_completion_on_ep_mesh(self, ep_url):
         status, body = asyncio.run(_post(ep_url, "/v1/chat/completions", {
             "model": "tiny-moe",
@@ -109,6 +111,7 @@ class TestExpertParallelServing:
 
 
 class TestSequenceParallelPrefill:
+    @pytest.mark.slow
     def test_sp_prefill_matches_plain_prefill(self):
         """Greedy generation through the ring-attention prefill path must
         match the single-path engine exactly (same weights, same prompt)."""

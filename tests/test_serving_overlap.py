@@ -21,6 +21,7 @@ from aigw_tpu.models import llama
 from aigw_tpu.models.registry import get_model_spec
 from aigw_tpu.tpuserve.engine import Engine, EngineConfig, GenRequest
 from aigw_tpu.tpuserve.sampling import SamplingParams
+import pytest
 
 _SPEC = get_model_spec("tiny-random")
 _PARAMS = llama.init_params(jax.random.PRNGKey(3), _SPEC.config)
@@ -62,6 +63,7 @@ def _req(prompt, n, out: _Stream, seed=0, temp=0.0):
     )
 
 
+@pytest.mark.slow
 def test_long_prompt_does_not_stall_inflight_decode():
     """Admit a long (chunked) prompt while another stream is decoding:
     the live stream must keep emitting between prefill chunks instead of
@@ -98,6 +100,9 @@ def test_long_prompt_does_not_stall_inflight_decode():
         eng.stop()
 
 
+@pytest.mark.slow
+
+
 def test_async_transfer_tokens_identical_to_blocking():
     """copy_to_host_async at dispatch vs blocking device_get at drain:
     same computation, byte-identical token streams — greedy and seeded
@@ -118,6 +123,9 @@ def test_async_transfer_tokens_identical_to_blocking():
             eng.stop()
     assert results[True] == results[False]
     assert len(results[True][0]) > 0
+
+
+@pytest.mark.slow
 
 
 def test_first_token_fast_path_tokens_identical():
@@ -149,6 +157,9 @@ def test_first_token_fast_path_tokens_identical():
             eng.stop()
     assert results[True] == results[False]
     assert all(len(t) > 0 for t in results[True])
+
+
+@pytest.mark.slow
 
 
 def test_lean_decode_identical_to_full():
@@ -205,6 +216,7 @@ def test_penalized_request_forces_full_decode():
         eng.stop()
 
 
+@pytest.mark.slow
 def test_adaptive_window_shrinks_then_regrows():
     """Queue pressure / young streams force the small window; a steady
     batch regrows to the full decode_steps_per_tick."""

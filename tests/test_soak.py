@@ -17,6 +17,9 @@ from aigw_tpu.gateway.server import run_gateway
 from tests.test_tpuserve import tpuserve_url  # noqa: F401  (fixture)
 
 
+@pytest.mark.slow
+
+
 def test_mixed_concurrent_soak(tpuserve_url):
     async def main():
         cfg = Config.parse({

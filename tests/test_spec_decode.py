@@ -120,6 +120,8 @@ def plain_engine():
 class TestSpecEquivalence:
     """spec on/off must be indistinguishable to the client."""
 
+    @pytest.mark.slow
+
     def test_greedy_identical(self, spec_engine, plain_engine):
         prompt = [5, 6, 7, 8, 5, 6]  # repeated 2-gram → drafts proposed
         a, fa = _collect(spec_engine, prompt, max_tokens=10, temperature=0.0)
@@ -137,6 +139,8 @@ class TestSpecEquivalence:
         b, _ = _collect(plain_engine, prompt, max_tokens=12,
                         temperature=0.9, seed=11)
         assert a == b
+
+    @pytest.mark.slow
 
     def test_penalty_slots_identical(self, spec_engine, plain_engine):
         prompt = [9, 9, 9, 9]
@@ -288,6 +292,7 @@ class TestSpecPrefixCacheInterplay:
     KV) and never double-freed — and the churn costs ZERO
     pipeline-draining rebuilds."""
 
+    @pytest.mark.slow
     def test_prefix_pages_survive_concurrent_admissions(self):
         eng = _make_engine(spec_tokens=3)
         try:

@@ -328,6 +328,8 @@ class TestDraftSources:
         assert np.asarray(
             speculation.combine_drafts(a, b)).tolist() == [[5, 2, 7]]
 
+    @pytest.mark.slow
+
     def test_continuation_lookahead_used_end_to_end(self, spec_engine,
                                                     plain_engine):
         """A long prompt teaches the radix chain its continuation; a

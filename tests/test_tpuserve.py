@@ -630,6 +630,9 @@ def test_stop_finishes_pending_requests():
     assert fins and fins[0] in ("error", "length", "stop")
 
 
+@pytest.mark.slow
+
+
 def test_batched_prefill_matches_sequential():
     """A burst of simple prompts admits through ONE batched prefill
     (r5: [G, S] device call instead of a G-step prefill ladder). The
@@ -674,6 +677,7 @@ def test_batched_prefill_matches_sequential():
         eng.stop()
 
 
+@pytest.mark.slow
 def test_page_pressure_mid_batch_requeues_everything():
     """When the batched-prefill allocation hits page pressure, every
     request already popped from the queue — the unallocated simple tail
@@ -713,6 +717,7 @@ def test_page_pressure_mid_batch_requeues_everything():
         eng.stop()
 
 
+@pytest.mark.slow
 def test_same_burst_shared_prefix_adopts_not_duplicates():
     """Two same-prompt requests arriving in one burst must still share
     prompt pages: the second is routed through the per-request path and

@@ -86,6 +86,7 @@ def _cfg(path: Path, upstream_url: str, marker_model: str) -> None:
 
 
 class TestRollingUpgrade:
+    @pytest.mark.slow
     def test_zero_dropped_requests_across_process_roll(self, tmp_path):
         async def main():
             up_old = FakeUpstream().on_json(

@@ -13,6 +13,8 @@ import json
 import aiohttp
 from aiohttp import web
 
+import pytest
+
 from aigw_tpu.config.webhook import (
     OWNING_GATEWAY_NAME_LABEL,
     OWNING_GATEWAY_NAMESPACE_LABEL,
@@ -156,6 +158,7 @@ class TestComposedKubeE2E:
     the object. The reference covers the same composition with envtest +
     its webhook tests (gateway_mutator.go:126)."""
 
+    @pytest.mark.slow
     def test_webhook_tls_to_sidecar_to_kube_reroute(self, tmp_path):
         import os
         import ssl
