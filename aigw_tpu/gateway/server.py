@@ -395,8 +395,21 @@ class GatewayServer:
                     return True
             return False
 
-        entries = [
-            (m.name, m.owned_by, m.created_at)
+        # structured-output / tool-calling capability flags (ISSUE 9):
+        # replicas that enforce constraints natively report them on
+        # /state; the merged listing carries them per served base model
+        caps_by_model: dict[str, dict] = {}
+        for picker in self._pickers.values():
+            for st in picker.state.values():
+                if st.healthy and st.model and st.capabilities:
+                    caps_by_model[st.model] = dict(st.capabilities)
+
+        def extra_for(name: str):
+            caps = caps_by_model.get(split_model(name)[0])
+            return {"capabilities": caps} if caps else None
+
+        entries: list[tuple] = [
+            (m.name, m.owned_by, m.created_at, extra_for(m.name))
             for m in rc.config.models
             if visible(m.name)
         ]
@@ -409,7 +422,8 @@ class GatewayServer:
                     name = f"{st.model}:{adapter}"
                     if name not in seen and visible(name):
                         seen.add(name)
-                        entries.append((name, "aigw-tpu-lora", 0))
+                        entries.append((name, "aigw-tpu-lora", 0,
+                                        extra_for(name)))
         return web.json_response(oai.models_response(entries))
 
     async def _handle_debug_config(self, _request: web.Request) -> web.Response:

@@ -58,6 +58,10 @@ class FlightEntry:
     tokens_out: int = 0
     decode_windows: int = 0
     spec_accepted: int = 0
+    # grammar-constrained decoding (ISSUE 9): windows cut at a mask
+    # boundary for this request (each ≈ two windows of slot time —
+    # the per-request view of tpuserve_constraint_rollbacks_total)
+    constraint_rollbacks: int = 0
     transfer_ms: float = 0.0
     finish: str = ""  # "" = in flight
     admission: dict[str, Any] = field(default_factory=dict)
@@ -96,6 +100,7 @@ class FlightEntry:
             stream=self.stream,
             decode_windows=self.decode_windows,
             spec_accepted=self.spec_accepted,
+            constraint_rollbacks=self.constraint_rollbacks,
             transfer_ms=round(self.transfer_ms, 3),
             admission=self.admission,
             events=[
@@ -293,6 +298,16 @@ class RequestTrace:
             if self.entry.decode_windows <= MAX_WINDOW_EVENTS:
                 self.event("spec_accept", proposed=proposed,
                            accepted=accepted)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def constraint_rollback(self) -> None:
+        """One decode window cut at a grammar mask boundary — the slot
+        rolled back to its last accepted token (ISSUE 9)."""
+        try:
+            self.entry.constraint_rollbacks += 1
+            if self.entry.constraint_rollbacks <= MAX_WINDOW_EVENTS:
+                self.event("constraint_rollback")
         except Exception:  # noqa: BLE001
             pass
 

@@ -185,6 +185,24 @@ ENGINE_GAUGES: tuple[tuple[str, str], ...] = (
     ("tenants_active", "tpuserve_tenants_active"),
     ("tenant_max_slots", "tpuserve_tenant_max_slots"),
     ("tenant_deferrals", "tpuserve_tenant_deferrals_total"),
+    # grammar-constrained decoding (ISSUE 9, tpuserve/constrain.py):
+    # live constrained slots, requests admitted with a grammar, window
+    # rollbacks at mask boundaries (the spec-rejection discipline),
+    # device mask-row patches, and the compiled-grammar cache size
+    ("constrained_slots", "tpuserve_constrained_slots"),
+    ("constraint_requests", "tpuserve_constraint_requests_total"),
+    ("constraint_rollbacks", "tpuserve_constraint_rollbacks_total"),
+    ("constraint_mask_updates",
+     "tpuserve_constraint_mask_updates_total"),
+    ("constraint_grammars", "tpuserve_constraint_grammars"),
+    # measured per-device memory (ISSUE 9 satellite): live jax
+    # memory_stats() bytes (0 on backends without them) + the KV pool's
+    # byte occupancy — the picker's first MEASURED memory signal
+    ("device_bytes_in_use", "tpuserve_device_bytes_in_use"),
+    ("device_bytes_limit", "tpuserve_device_bytes_limit"),
+    ("device_memory_frac", "tpuserve_device_memory_frac"),
+    ("kv_pool_bytes", "tpuserve_kv_pool_bytes"),
+    ("kv_bytes_in_use", "tpuserve_kv_bytes_in_use"),
 )
 
 

@@ -488,20 +488,23 @@ def embeddings_response(
     }
 
 
-def models_response(models: Iterable[tuple[str, str, int]]) -> dict[str, Any]:
-    """(name, owned_by, created) triples → /v1/models body."""
-    return {
-        "object": "list",
-        "data": [
-            {
-                "id": name,
-                "object": "model",
-                "created": created or int(time.time()),
-                "owned_by": owned_by,
-            }
-            for name, owned_by, created in models
-        ],
-    }
+def models_response(models: Iterable[tuple]) -> dict[str, Any]:
+    """(name, owned_by, created[, extra]) tuples → /v1/models body.
+    ``extra`` (optional dict) merges into the entry — tpuserve uses it
+    to advertise structured-output/tool capability flags (ISSUE 9)."""
+    data = []
+    for item in models:
+        name, owned_by, created = item[0], item[1], item[2]
+        entry: dict[str, Any] = {
+            "id": name,
+            "object": "model",
+            "created": created or int(time.time()),
+            "owned_by": owned_by,
+        }
+        if len(item) > 3 and item[3]:
+            entry.update(item[3])
+        data.append(entry)
+    return {"object": "list", "data": data}
 
 
 def error_body(message: str, type_: str = "invalid_request_error", code: Any = None) -> bytes:

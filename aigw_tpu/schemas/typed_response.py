@@ -116,7 +116,16 @@ CHAT_CHUNK = Spec(fields={
                         type="object", spec=Spec(fields={
                             "index": Field(type="integer"),
                             "id": Field(type="string"),
-                            "function": Field(type="object"),
+                            "type": Field(type="string"),
+                            # deep (ISSUE 9): tpuserve streams native
+                            # tool_calls deltas — name frames and
+                            # incremental arguments-string frames must
+                            # carry string payloads when present
+                            "function": Field(type="object", spec=Spec(
+                                fields={
+                                    "name": Field(type="string"),
+                                    "arguments": Field(type="string"),
+                                })),
                         }))),
                 })),
             "finish_reason": _FINISH,

@@ -3,5 +3,7 @@
 The self-hosted serving path of the gateway, terminating on TPU (the role
 vLLM/InferencePool plays for the reference — SURVEY.md §2.8/§2.9). An
 OpenAI-surface HTTP server in front of a continuous-batching scheduler
-driving jit-compiled prefill/decode steps over a paged KV cache.
+driving jit-compiled prefill/decode steps over a paged KV cache, with
+grammar-constrained decoding (structured outputs + tool calling) riding
+the same continuous batch (tpuserve/constrain.py, ISSUE 9).
 """

@@ -46,6 +46,11 @@ from aigw_tpu.obs.metrics import (
 from aigw_tpu.obs.tracing import SpanContext, Tracer, genai_attributes
 from aigw_tpu.schemas import openai as oai
 from aigw_tpu.translate.sse import SSEEvent
+from aigw_tpu.translate.structured import (
+    JSONSchemaError,
+    parse_response_format,
+)
+from aigw_tpu.tpuserve import constrain
 from aigw_tpu.utils.net import set_tcp_nodelay
 from aigw_tpu.tpuserve.engine import (
     Engine,
@@ -352,6 +357,86 @@ class TPUServeServer:
                 f"{min(cap, 20)}")
         return top_n
 
+    def _check_constraints(
+        self, body: dict[str, Any], chat: bool, lp_top_n: int, n: int,
+    ) -> tuple[Any, dict[str, Any] | None]:
+        """Grammar-constrained decoding intake (ISSUE 9): normalize
+        ``response_format`` + ``tools``/``tool_choice`` into a compiled
+        TokenFSM (or None) and a response-assembly mode. Every
+        unsupported or malformed ask raises oai.SchemaError → a clear
+        400 — never the old silent free-text 200."""
+        try:
+            rf = parse_response_format(body)
+        except JSONSchemaError as e:
+            raise oai.SchemaError(str(e)) from None
+        if rf is not None and rf.kind == "text":
+            rf = None
+        tools = body.get("tools")
+        choice = body.get("tool_choice")
+        tools_active = bool(tools) and choice != "none"
+        if rf is None and not tools_active:
+            return None, None
+        if not chat:
+            raise oai.SchemaError(
+                "response_format and tools are only supported on "
+                "/v1/chat/completions")
+        if not self.engine.cfg.constrained_decoding:
+            raise oai.SchemaError(
+                "this server was started with --no-constrained-decoding; "
+                "response_format json modes and tool calling are "
+                "unavailable")
+        if lp_top_n >= 0:
+            raise oai.SchemaError(
+                "logprobs cannot be combined with response_format json "
+                "modes or tools (the grammar mask reshapes the "
+                "distribution the logprobs would describe)")
+        if rf is not None and tools_active:
+            raise oai.SchemaError(
+                "response_format json modes cannot be combined with "
+                "tools on this backend; send one or the other")
+        eos = (self.tokenizer.eos_id,)
+        V = self.model_cfg.vocab_size
+        try:
+            if tools_active:
+                if n > 1:
+                    raise oai.SchemaError(
+                        "n > 1 is not supported with tools on this "
+                        "backend")
+                specs = constrain.parse_tools(tools)
+                names = [nm for nm, _s in specs]
+                named = ""
+                if isinstance(choice, dict):
+                    named = str(choice["function"]["name"])
+                    if named not in names:
+                        raise oai.SchemaError(
+                            f"tool_choice names unknown tool {named!r}; "
+                            f"tools declare {names}")
+                    specs = [t for t in specs if t[0] == named]
+                mode = ("named" if named
+                        else "required" if choice == "required"
+                        else "auto")
+                if mode == "auto":
+                    # unconstrained generation; the server detects a
+                    # tool-call envelope in the output stream (a
+                    # grammar that admits ALL text would mask nothing)
+                    return None, {"mode": "tool", "choice": "auto",
+                                  "names": names}
+                fsm = constrain.compile_constraint(
+                    self.tokenizer, V, eos, constrain.spec_for_tools(specs))
+                return fsm, {"mode": "tool", "choice": mode,
+                             "names": [t[0] for t in specs]}
+            if rf.kind == "json_schema" and rf.schema is None:
+                raise oai.SchemaError(
+                    "response_format.json_schema.schema is required for "
+                    "constrained decoding")
+            fsm = constrain.compile_constraint(
+                self.tokenizer, V, eos,
+                constrain.spec_for_response_format(rf.kind, rf.schema))
+            return fsm, {"mode": "json"}
+        except (JSONSchemaError,
+                constrain.UnsupportedConstraintError) as e:
+            raise oai.SchemaError(str(e)) from None
+
     def _prefix_hashes_for(self, prompt: list[int]) -> list | None:
         """Roll the prompt's page-chain prefix hashes at the engine's
         page size — called on the tokenizer pool right after encode, so
@@ -374,7 +459,8 @@ class TPUServeServer:
 
     def _submit(self, prompt: list[int], body: dict[str, Any],
                 lp_top_n: int = -1, prefix_hashes: list | None = None,
-                trace: RequestTrace | None = None, tenant: str = ""):
+                trace: RequestTrace | None = None, tenant: str = "",
+                constraint: Any = None):
         """Submit to the engine; returns an asyncio.Queue of
         (token_id, finish_reason, lp) tuples — lp is None without
         logprobs, else (chosen_logprob, [(top_id, top_logprob)]).
@@ -407,6 +493,7 @@ class TPUServeServer:
             # defaults to per-adapter tenancy (each adapter ≈ a tenant)
             tenant=tenant or adapter,
             prefix_hashes=prefix_hashes,
+            constraint=constraint,
             trace=trace,
         )
         self.engine.submit(req)
@@ -566,6 +653,16 @@ class TPUServeServer:
                                 content_type="application/json")
         tenant = request.headers.get(TENANT_HEADER, "")
         n = int(body.get("n") or 1)
+        try:
+            # grammar-constrained decoding intake (ISSUE 9): malformed
+            # or unsupported response_format/tools asks 400 here — the
+            # old behavior (silently serving free text with a 200) is
+            # gone on every path below
+            constraint, cmode = self._check_constraints(
+                body, chat, lp_top_n, n)
+        except oai.SchemaError as e:
+            return web.Response(status=400, body=oai.error_body(str(e)),
+                                content_type="application/json")
         if n > 1:
             if n > self.engine.cfg.max_batch_size:
                 return web.Response(
@@ -577,10 +674,10 @@ class TPUServeServer:
             if stream:
                 return await self._generate_n_stream(
                     request, body, prompt, chat, n, lp_top_n,
-                    prefix_hashes, tenant)
+                    prefix_hashes, tenant, constraint)
             return await self._generate_n(body, prompt, chat, n,
                                           lp_top_n, prefix_hashes,
-                                          tenant)
+                                          tenant, constraint)
         include_usage = oai.include_stream_usage(body)
         rid = (
             f"chatcmpl-{uuid.uuid4().hex[:24]}"
@@ -604,7 +701,8 @@ class TPUServeServer:
                                   prompt, body, stream, chat)
         try:
             out, gen_req = self._submit(prompt, body, lp_top_n,
-                                        prefix_hashes, trace, tenant)
+                                        prefix_hashes, trace, tenant,
+                                        constraint)
         except EngineOverloadedError as e:
             self._end_trace(trace, "rejected", 0, len(prompt),
                             error=str(e))
@@ -627,8 +725,11 @@ class TPUServeServer:
                                 content_type="application/json")
         # exportable until a terminal _end_trace: the gateway can hand
         # this session to a decode replica via POST /migrate/export
-        # (streaming only — a buffered response has nothing to splice)
-        if stream and lp_top_n < 0:
+        # (streaming only — a buffered response has nothing to splice;
+        # constrained/tool sessions carry FSM or detector state no wire
+        # blob restores, so they stay put)
+        if stream and lp_top_n < 0 and constraint is None \
+                and cmode is None:
             self._live[rid] = (gen_req, {
                 "response_id": rid,
                 "model": self.model_name,
@@ -664,10 +765,29 @@ class TPUServeServer:
                     body=oai.error_body("engine failure", type_="server_error"),
                     content_type="application/json",
                 )
+            tool_calls = None
+            if cmode is not None and cmode["mode"] == "tool":
+                env = constrain.parse_tool_envelope(text, cmode["names"])
+                if env is not None:
+                    name, args = env
+                    tool_calls = [{
+                        "id": f"call_{uuid.uuid4().hex[:24]}",
+                        "type": "function",
+                        "function": {"name": name, "arguments": args},
+                    }]
+                    text = ""
+                    if finish == "stop":
+                        finish = "tool_calls"
+                # auto mode with no envelope: plain content, finish
+                # stays as the engine reported; required/named with no
+                # envelope only happens on a length truncation — the
+                # partial text is returned as content with finish
+                # "length" (the OpenAI truncation contract)
             if chat:
                 resp = oai.chat_completion_response(
                     model=self.model_name, content=text,
                     finish_reason=finish, usage=usage, response_id=rid,
+                    tool_calls=tool_calls,
                 )
                 if lp_content is not None:
                     resp["choices"][0]["logprobs"] = {
@@ -722,6 +842,40 @@ class TPUServeServer:
                 delta={"content": sentinel},
             ).split(json.dumps(sentinel).encode())
 
+        # tool-call streaming (ISSUE 9): required/named generations are
+        # grammar-forced envelopes — split incrementally into OpenAI
+        # tool_calls deltas; auto buffers only while the text is still a
+        # viable envelope prefix, then streams as content or tool call
+        tool_stream: Any = None
+        auto_detect: Any = None
+        if cmode is not None and cmode["mode"] == "tool":
+            if cmode["choice"] == "auto":
+                auto_detect = constrain.AutoToolDetector(cmode["names"])
+            else:
+                tool_stream = constrain.ToolCallParser()
+        tool_call_id = f"call_{uuid.uuid4().hex[:24]}"
+
+        async def write_tool_events(events) -> None:
+            for ev in events:
+                if ev[0] == "name":
+                    await resp.write(oai.stream_chunk_sse(
+                        response_id=rid, model=self.model_name,
+                        created=created,
+                        delta={"tool_calls": [{
+                            "index": 0, "id": tool_call_id,
+                            "type": "function",
+                            "function": {"name": ev[1],
+                                         "arguments": ""},
+                        }]}))
+                elif ev[0] == "args" and ev[1]:
+                    await resp.write(oai.stream_chunk_sse(
+                        response_id=rid, model=self.model_name,
+                        created=created,
+                        delta={"tool_calls": [{
+                            "index": 0,
+                            "function": {"arguments": ev[1]},
+                        }]}))
+
         async def write_piece(piece: str, lp_entries=None) -> None:
             # an empty piece (mid-UTF-8 token) still carries its logprob
             # entries so the streamed list aligns 1:1 with completion
@@ -759,6 +913,25 @@ class TPUServeServer:
                         )
                     ).encode()
                 )
+
+        async def emit_text(piece: str, lp_entries=None) -> None:
+            """Route one detokenized burst: content deltas normally,
+            tool_calls deltas for grammar-forced envelopes, buffered
+            while a tool_choice=auto stream is still ambiguous."""
+            nonlocal tool_stream
+            if tool_stream is not None:
+                await write_tool_events(tool_stream.feed(piece))
+                return
+            if auto_detect is not None and auto_detect.decided is None:
+                decision, text_out = auto_detect.feed(piece)
+                if decision is None:
+                    return  # still a viable envelope prefix: buffer
+                if decision == "tool":
+                    tool_stream = constrain.ToolCallParser()
+                    await write_tool_events(tool_stream.feed(text_out))
+                    return
+                piece = text_out  # diverged: flush the buffer as content
+            await write_piece(piece, lp_entries)
 
         try:
             if chat:
@@ -826,7 +999,7 @@ class TPUServeServer:
                             pieces.append(decoder.flush())
                         done_streaming = True
                         break
-                await write_piece("".join(pieces), lp_entries)
+                await emit_text("".join(pieces), lp_entries)
 
             while not done_streaming:
                 # keepalive comments while queued behind prefills so
@@ -863,11 +1036,20 @@ class TPUServeServer:
                                            inline_detok=False)
                 else:
                     await handle_burst(burst, inline_detok=n_out == 0)
+            if auto_detect is not None and tool_stream is None:
+                # stream ended while the auto detector was still
+                # ambiguous: the held-back prefix was content after all
+                decision, text_rem = auto_detect.finish()
+                if decision == "content" and text_rem:
+                    await write_piece(text_rem)
         except (asyncio.CancelledError, ConnectionResetError):
             # client went away: stop generating, free the slot
             gen_req.cancelled.set()
             self._end_trace(trace, "cancelled", n_out, n_prompt)
             raise
+        if tool_stream is not None and tool_stream.completed \
+                and finish == "stop":
+            finish = "tool_calls"
         usage = TokenUsage(
             input_tokens=n_prompt, output_tokens=n_out,
             total_tokens=n_prompt + n_out,
@@ -912,7 +1094,7 @@ class TPUServeServer:
 
     def _submit_n(self, body: dict[str, Any], prompt: list[int], n: int,
                   lp_top_n: int, prefix_hashes: list | None = None,
-                  tenant: str = ""):
+                  tenant: str = "", constraint: Any = None):
         """Fan out n engine submissions with per-choice seeds (shared by
         the buffered and streaming n>1 paths — one copy of the seed
         derivation, overload cleanup, and error mapping). Returns the
@@ -928,7 +1110,8 @@ class TPUServeServer:
                     sampling.seed or sampling.temperature > 0
                 ) else 0
                 outs.append(self._submit(prompt, per_choice, lp_top_n,
-                                         prefix_hashes, tenant=tenant))
+                                         prefix_hashes, tenant=tenant,
+                                         constraint=constraint))
         except EngineOverloadedError as e:
             for _q, req in outs:  # don't orphan already-queued choices
                 req.cancelled.set()
@@ -954,7 +1137,7 @@ class TPUServeServer:
     async def _generate_n(
         self, body: dict[str, Any], prompt: list[int], chat: bool, n: int,
         lp_top_n: int = -1, prefix_hashes: list | None = None,
-        tenant: str = "",
+        tenant: str = "", constraint: Any = None,
     ) -> web.Response:
         """n>1 choices: fan out n engine requests (continuous batching
         runs them concurrently — same prompt pages shared by the prefix
@@ -962,7 +1145,7 @@ class TPUServeServer:
         stops = body.get("stop")
         stop_strs = [stops] if isinstance(stops, str) else list(stops or [])
         outs = self._submit_n(body, prompt, n, lp_top_n, prefix_hashes,
-                              tenant)
+                              tenant, constraint)
         if isinstance(outs, web.Response):
             return outs
         results = await asyncio.gather(
@@ -1009,6 +1192,7 @@ class TPUServeServer:
         self, request: web.Request, body: dict[str, Any],
         prompt: list[int], chat: bool, n: int, lp_top_n: int = -1,
         prefix_hashes: list | None = None, tenant: str = "",
+        constraint: Any = None,
     ) -> web.StreamResponse:
         """Streaming n>1 (OpenAI parity; previously 400): fan out n
         engine requests, merge their token streams, and emit one SSE
@@ -1020,7 +1204,7 @@ class TPUServeServer:
         stop_strs = [stops] if isinstance(stops, str) else list(stops or [])
         include_usage = oai.include_stream_usage(body)
         outs = self._submit_n(body, prompt, n, lp_top_n, prefix_hashes,
-                              tenant)
+                              tenant, constraint)
         if isinstance(outs, web.Response):
             return outs
 
@@ -1300,8 +1484,15 @@ class TPUServeServer:
         )
 
     async def _models(self, _request: web.Request) -> web.Response:
-        entries = [(self.model_name, "tpuserve", 0)] + [
-            (f"{self.model_name}:{a}", "tpuserve-lora", 0)
+        # capability flags (ISSUE 9): clients (and the gateway's merged
+        # /v1/models) discover which structured-output / tool-calling
+        # workloads this replica enforces natively
+        caps = (dict(constrain.CAPABILITIES)
+                if self.engine.cfg.constrained_decoding else None)
+        extra = {"capabilities": caps} if caps else None
+        entries: list[tuple] = [(self.model_name, "tpuserve", 0, extra)]
+        entries += [
+            (f"{self.model_name}:{a}", "tpuserve-lora", 0, extra)
             for a in self.adapter_names
         ]
         return web.json_response(oai.models_response(entries))
@@ -1354,6 +1545,30 @@ class TPUServeServer:
                 "migration_pages_out": s.migration_pages_out,
                 "migration_pages_in": s.migration_pages_in,
                 "migratable_slots": s.migratable_slots,
+                # grammar-constrained decoding (ISSUE 9): the
+                # capability flag the gateway merges into /v1/models,
+                # live constrained slots, window rollbacks (grammar
+                # cuts), device mask patches, and the compiled-grammar
+                # cache size
+                "constrained_decoding":
+                    self.engine.cfg.constrained_decoding,
+                "capabilities": (dict(constrain.CAPABILITIES)
+                                 if self.engine.cfg.constrained_decoding
+                                 else {}),
+                "constrained_slots": s.constrained_slots,
+                "constraint_requests": s.constraint_requests,
+                "constraint_rollbacks": s.constraint_rollbacks,
+                "constraint_mask_updates": s.constraint_mask_updates,
+                "constraint_grammars": s.constraint_grammars,
+                # measured per-device memory (ISSUE 9 satellite): live
+                # jax memory_stats() bytes (0 off-TPU) + KV-pool byte
+                # occupancy — with `slice` below, the picker's
+                # per-slice memory signal
+                "device_bytes_in_use": s.device_bytes_in_use,
+                "device_bytes_limit": s.device_bytes_limit,
+                "device_memory_frac": s.device_memory_frac,
+                "kv_pool_bytes": s.kv_pool_bytes,
+                "kv_bytes_in_use": s.kv_bytes_in_use,
                 "active_slots": s.active_slots,
                 "max_slots": self.engine.cfg.max_batch_size,
                 "queued": s.queued,
@@ -1734,6 +1949,7 @@ async def run_tpuserve(
     flight_entries: int = 256,
     enable_profile_endpoint: bool = False,
     migration_young_tokens: int = 64,
+    constrained_decoding: bool = True,
 ) -> web.AppRunner:
     server = TPUServeServer(
         model,
@@ -1759,6 +1975,7 @@ async def run_tpuserve(
             prefill_bucket_rungs=prefill_bucket_rungs,
             tenant_slot_cap=tenant_slot_cap,
             migration_young_tokens=migration_young_tokens,
+            constrained_decoding=constrained_decoding,
         ),
         tp=tp,
         ep=ep,

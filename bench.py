@@ -1221,6 +1221,184 @@ def lora_numbers(reps: int = 3, requests_per_rep: int = 4,
         stop()
 
 
+# -- structured leg: grammar-constrained decoding A/B (ISSUE 9) -----------
+
+#: the leg's response_format schema: ONE bounded string field, so the
+#: whole output length is structurally bounded (~53 chars) and every
+#: completed constrained response MUST parse + validate — and grammar
+#: transitions (each ~2 rollback windows on the random-weight model,
+#: where the model never anticipates structure) stay a small fraction
+#: of the content tokens, which is what a real model's traffic looks
+#: like at the window level
+_STRUCT_SCHEMA = {
+    "type": "object",
+    "properties": {"report": {"type": "string", "maxLength": 40}},
+    "required": ["report"],
+    "additionalProperties": False,
+}
+#: worst-case constrained output: {"report":"<40>"} = 53 tokens (byte
+#: tokenizer) + EOS; plain traffic generates the same volume so the
+#: phase throughputs compare token-for-token
+_STRUCT_GEN = 54
+_STRUCT_MAX = 80
+
+
+def _structured_ab_fields(st0: dict, st1: dict) -> dict:
+    """Constraint telemetry of one timed phase from /state deltas —
+    pure so test_bench_smoke can unit-test the field derivation."""
+    return {
+        "structured_requests": (st1.get("constraint_requests", 0)
+                                - st0.get("constraint_requests", 0)),
+        "structured_rollbacks": (st1.get("constraint_rollbacks", 0)
+                                 - st0.get("constraint_rollbacks", 0)),
+        "structured_mask_updates": (
+            st1.get("constraint_mask_updates", 0)
+            - st0.get("constraint_mask_updates", 0)),
+        "structured_hot_compiles": (st1.get("xla_compiles", 0)
+                                    - st0.get("xla_compiles", 0)),
+        "structured_grammars": st1.get("constraint_grammars", 0),
+    }
+
+
+async def _drive_struct_openloop(s, url: str, model_name: str,
+                                 trace: list[dict]) -> tuple:
+    """Fire one open-loop arrival schedule of chat requests (items:
+    {t, constrained}) — arrival-time-fired regardless of completions.
+    Returns (wall_s, total_completion_tokens, [constrained texts])."""
+    texts: list = []
+    totals: list[int] = []
+
+    async def one(item: dict, t0: float) -> None:
+        delay = t0 + item["t"] - time.perf_counter()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        body = {
+            "model": model_name,
+            "messages": [{"role": "user",
+                          "content": f"arrival {item['i']:03d} hi"}],
+            "temperature": 0.0,
+            "logit_bias": {"97": 100},
+        }
+        if item["constrained"]:
+            body["max_tokens"] = _STRUCT_MAX
+            body["response_format"] = {
+                "type": "json_schema",
+                "json_schema": {"name": "r", "schema": _STRUCT_SCHEMA}}
+        else:
+            body["max_tokens"] = _STRUCT_GEN
+        async with s.post(url + "/v1/chat/completions",
+                          json=body) as resp:
+            assert resp.status == 200, (resp.status,
+                                        (await resp.read())[:300])
+            got = await resp.json()
+        totals.append(got["usage"]["completion_tokens"])
+        if item["constrained"]:
+            texts.append(got["choices"][0]["message"]["content"])
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*(one(it, t0) for it in trace))
+    wall = time.perf_counter() - t0
+    return wall, sum(totals), texts
+
+
+def structured_numbers(reps: int = 2, arrivals: int = 12,
+                       constrained_frac: float = 0.25) -> dict:
+    """The ``--ab structured`` leg (ISSUE 9): the same seeded open-loop
+    arrival schedule against ONE tpuserve child (speculation on — the
+    batch genuinely mixes constrained/plain/speculating slots), once
+    with ``constrained_frac`` of arrivals asking for json_schema output
+    and once all-plain at matched token volume. Criteria: every
+    completed constrained response parses AND validates against the
+    requested schema; zero hot XLA compiles across the timed phases;
+    mixed/plain throughput ratio prices the constraint bookkeeping
+    (mask row updates + rollback windows). Per-request byte-identity of
+    unconstrained traffic is the f32-rig test's claim
+    (tests/test_constrained_serving.py), not re-measured here."""
+    import random as _random
+
+    import aiohttp
+
+    model_name = "bench-struct-tiny"
+    # f32 params + f32 KV like the prefix leg: XLA:CPU repacks bf16
+    # weight arguments per call, and an f32→bf16 K/V scatter is a
+    # deprecated implicit cast (bf16 stays the default on TPU)
+    url, stop = _start_tpuserve_subproc(
+        model_name, CPU_CFG, "", batch=8,
+        k_steps=int(os.environ.get("AIGW_BENCH_CPU_K", "4")),
+        engine={"spec_tokens": 4, "kv_cache_dtype": "float32"},
+        param_dtype="float32")
+
+    def mk_trace(seed: int, constrained: bool) -> list[dict]:
+        # seeded staggered arrivals (~0.25s mean gap): open-loop — the
+        # schedule never waits on completions, so slots stay saturated
+        # and the ratio measures steady-state per-window overhead. The
+        # SAME seed yields the same arrival times for both phases;
+        # constrained flags land on a seeded random subset.
+        rng = _random.Random(seed)
+        times, t = [], 0.0
+        for _ in range(arrivals):
+            times.append(t)
+            t += rng.uniform(0.05, 0.45)
+        n_con = round(arrivals * constrained_frac) if constrained else 0
+        con = set(rng.sample(range(arrivals), n_con))
+        return [{"t": times[i], "i": i, "constrained": i in con}
+                for i in range(arrivals)]
+
+    async def run() -> dict:
+        await _wait_health(url, 1200)
+        timeout = aiohttp.ClientTimeout(total=1200)
+        async with aiohttp.ClientSession(timeout=timeout) as s:
+            # off-the-clock warm pass: compiles the decode page bucket,
+            # prefill rung, and the mask-update program; caches the
+            # grammar
+            await _drive_struct_openloop(s, url, model_name, [
+                {"t": 0.0, "i": 0, "constrained": True},
+                {"t": 0.0, "i": 1, "constrained": False},
+            ])
+            st0 = await _get_state(s, url)
+            mixed, plain, all_texts = [], [], []
+            for rep in range(reps):
+                w, n, texts = await _drive_struct_openloop(
+                    s, url, model_name, mk_trace(1000 + rep, True))
+                mixed.append((w, n))
+                all_texts.extend(texts)
+                w, n, _ = await _drive_struct_openloop(
+                    s, url, model_name, mk_trace(1000 + rep, False))
+                plain.append((w, n))
+            st1 = await _get_state(s, url)
+        ok = sum(1 for t in all_texts if _struct_valid(t))
+        ratios = [(nm / wm) / (np_ / wp)
+                  for (wm, nm), (wp, np_) in zip(mixed, plain)
+                  if wm > 0 and wp > 0 and np_ > 0]
+        return {
+            "structured_mixed_tps": round(
+                sum(n for _, n in mixed) / sum(w for w, _ in mixed), 1),
+            "structured_plain_tps": round(
+                sum(n for _, n in plain) / sum(w for w, _ in plain), 1),
+            "structured_mixed_vs_plain": round(_median(ratios), 4),
+            "structured_ratio_spread": round(_spread(ratios), 3),
+            "structured_valid_frac": (round(ok / len(all_texts), 4)
+                                      if all_texts else 0.0),
+            "structured_constrained_responses": len(all_texts),
+            "structured_ab_reps": reps,
+            **_structured_ab_fields(st0, st1),
+        }
+
+    try:
+        return asyncio.run(run())
+    finally:
+        stop()
+
+
+def _struct_valid(text: str) -> bool:
+    from aigw_tpu.tpuserve.constrain import validate_instance
+
+    try:
+        return validate_instance(_STRUCT_SCHEMA, json.loads(text))
+    except ValueError:
+        return False
+
+
 # -- open-loop load generation + fleet legs (ISSUE 8; ROADMAP 5) ----------
 
 def _poisson_trace(seed: int, n: int, rate_hz: float,
@@ -1917,6 +2095,11 @@ def run_cpu_ratio() -> dict:
     except Exception as e:
         print(f"slo_routing leg failed: {type(e).__name__}: {e}",
               file=sys.stderr)
+    try:
+        res.update(structured_numbers())
+    except Exception as e:
+        print(f"structured leg failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
     return res
 
 
@@ -2034,11 +2217,22 @@ def main() -> None:
                 "over the same heterogeneous 2-replica pool; goodput-"
                 "under-SLO from server-side TTFT histograms is the "
                 "signal (CPU backend)")
+        elif target == "structured":
+            result = structured_numbers()
+            result["metric"] = (
+                "structured A/B — grammar-constrained decoding (ISSUE "
+                "9): the same seeded open-loop arrival schedule against "
+                "one speculation-on tpuserve child, 25% of arrivals "
+                "asking for json_schema output vs an all-plain control "
+                "at matched token volume; 100% schema-valid constrained "
+                "responses, zero hot XLA compiles, and the mixed/plain "
+                "throughput ratio (constraint bookkeeping price) are "
+                "the signal (CPU backend)")
         else:
             print(json.dumps({"error": f"unknown --ab target {target!r}; "
                               "supported: prefix_cache, spec_decode, "
                               "ragged_prefill, lora, disagg, "
-                              "slo_routing"}))
+                              "slo_routing, structured"}))
             return
         print(json.dumps(result))
         return

@@ -318,3 +318,44 @@ def test_bench_openloop_gateway_smoke():
             holder["loop"].call_soon_threadsafe(holder["loop"].stop)
 
     asyncio.run(main())
+
+
+@pytest.mark.bench_smoke
+def test_bench_structured_ab_fields():
+    """The --ab structured JSON derives its constraint telemetry from
+    /state deltas through this pure helper: request/rollback/mask
+    counters must be deltas, the hot-compile tripwire a delta of
+    xla_compiles, and a renamed /state field shows up here instead of
+    at round-end."""
+    st0 = {"constraint_requests": 2, "constraint_rollbacks": 10,
+           "constraint_mask_updates": 40, "xla_compiles": 30,
+           "constraint_grammars": 1}
+    st1 = {"constraint_requests": 8, "constraint_rollbacks": 64,
+           "constraint_mask_updates": 300, "xla_compiles": 30,
+           "constraint_grammars": 2}
+    f = bench._structured_ab_fields(st0, st1)
+    assert f["structured_requests"] == 6
+    assert f["structured_rollbacks"] == 54
+    assert f["structured_mask_updates"] == 260
+    assert f["structured_hot_compiles"] == 0
+    assert f["structured_grammars"] == 2
+    # a missing field degrades to 0, never a KeyError at round-end
+    z = bench._structured_ab_fields({}, {})
+    assert z["structured_requests"] == 0
+
+
+@pytest.mark.bench_smoke
+def test_bench_structured_schema_is_bounded_and_validates():
+    """The leg's schema must structurally bound the output below the
+    constrained max_tokens (otherwise length-truncation breaks the
+    100%-valid criterion by construction) and the leg's validator must
+    accept exactly the emitted shape."""
+    schema = bench._STRUCT_SCHEMA
+    ml = schema["properties"]["report"]["maxLength"]
+    worst = len('{"report":""}') + ml
+    assert worst < bench._STRUCT_MAX
+    assert bench._STRUCT_GEN == worst + 1  # matched plain token volume
+    assert bench._struct_valid('{"report":"' + "a" * ml + '"}')
+    assert not bench._struct_valid('{"report":123}')
+    assert not bench._struct_valid('{"report":"' + "a" * 99 + '"}')
+    assert not bench._struct_valid("not json")

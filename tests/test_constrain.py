@@ -1,0 +1,367 @@
+"""Grammar-constrained decoding compiler units (ISSUE 9,
+tpuserve/constrain.py): schema → char automaton → token masks, with a
+brute-force cross-check of every cached mask row, plus the server-side
+envelope stream parsers."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from aigw_tpu.translate.structured import JSONSchemaError
+from aigw_tpu.tpuserve import constrain
+from aigw_tpu.tpuserve.constrain import (
+    AutoToolDetector,
+    ConstraintSpec,
+    NEG_MASK,
+    ToolCallParser,
+    UnsupportedConstraintError,
+    compile_constraint,
+    parse_tool_envelope,
+    parse_tools,
+    spec_for_response_format,
+    spec_for_tools,
+    validate_instance,
+)
+from aigw_tpu.tpuserve.tokenizer import ByteTokenizer
+
+TOK = ByteTokenizer()
+V = 512
+EOS = (TOK.eos_id,)
+
+
+def fsm_for_schema(schema):
+    return compile_constraint(TOK, V, EOS,
+                              spec_for_response_format("json_schema",
+                                                       schema))
+
+
+def greedy_walk(fsm, prefer=ord("a"), max_steps=600):
+    """Follow the masks: prefer 'a' when allowed else the first allowed
+    token; returns (text, completed_cleanly)."""
+    st = fsm.new_state()
+    out = []
+    for _ in range(max_steps):
+        m = st.mask_row()
+        allowed = np.nonzero(m == 0.0)[0]
+        assert len(allowed), "mask allowed nothing"
+        t = prefer if m[prefer] == 0.0 else int(allowed[0])
+        if t in fsm.eos:
+            return "".join(out), True
+        assert st.advance(t), (t, "".join(out))
+        out.append(chr(t))
+    return "".join(out), False
+
+
+class TestSchemaCompiler:
+    def test_object_emits_all_properties_in_order(self):
+        schema = {"type": "object", "properties": {
+            "b": {"type": "boolean"},
+            "a": {"type": "integer"},
+            "s": {"type": "string", "maxLength": 4},
+        }, "required": ["a"], "additionalProperties": False}
+        text, done = greedy_walk(fsm_for_schema(schema))
+        assert done
+        obj = json.loads(text)
+        assert list(obj) == ["b", "a", "s"]  # declaration order
+        assert validate_instance(schema, obj)
+
+    def test_string_min_max_length_enforced(self):
+        schema = {"type": "string", "minLength": 3, "maxLength": 5}
+        fsm = fsm_for_schema(schema)
+        st = fsm.new_state()
+        for ch in '"aa':
+            assert st.advance(ord(ch))
+        # 2 chars < minLength: the close quote must be masked out
+        assert st.mask_row()[ord('"')] == NEG_MASK
+        assert st.advance(ord("a"))
+        assert st.mask_row()[ord('"')] == 0.0
+        for ch in "aa":
+            assert st.advance(ord(ch))
+        # 5 chars = maxLength: only the close quote remains
+        assert st.mask_row()[ord("a")] == NEG_MASK
+        assert st.advance(ord('"'))
+        assert st.accepting
+
+    def test_integer_rejects_leading_zero_run_and_letters(self):
+        fsm = fsm_for_schema({"type": "integer"})
+        st = fsm.new_state()
+        assert st.advance(ord("0"))
+        assert not st.advance(ord("1"))  # "01" is not JSON
+        st2 = fsm.new_state()
+        assert not st2.advance(ord("a"))
+        st3 = fsm.new_state()
+        for ch in "-12":
+            assert st3.advance(ord(ch))
+        assert st3.accepting  # a complete integer accepts (EOS legal)
+        assert not st3.advance(ord("."))  # integers take no fraction
+
+    def test_number_fraction(self):
+        fsm = fsm_for_schema({"type": "number"})
+        st = fsm.new_state()
+        for ch in "3.14":
+            assert st.advance(ord(ch)), ch
+        assert st.accepting
+
+    def test_array_bounds(self):
+        schema = {"type": "array", "items": {"type": "boolean"},
+                  "minItems": 1, "maxItems": 2}
+        fsm = fsm_for_schema(schema)
+        st = fsm.new_state()
+        assert st.advance(ord("["))
+        assert st.mask_row()[ord("]")] == NEG_MASK  # minItems unmet
+        for ch in "true":
+            assert st.advance(ord(ch))
+        for ch in ",false":
+            assert st.advance(ord(ch))
+        assert st.mask_row()[ord(",")] == NEG_MASK  # maxItems reached
+        assert st.advance(ord("]"))
+        assert st.accepting
+
+    def test_enum_union_and_null(self):
+        schema = {"anyOf": [{"type": "null"},
+                            {"enum": ["x", "xy", 7]}]}
+        fsm = fsm_for_schema(schema)
+        for text in ("null", '"x"', '"xy"', "7"):
+            st = fsm.new_state()
+            for ch in text:
+                assert st.advance(ord(ch)), (text, ch)
+            assert st.accepting, text
+        st = fsm.new_state()
+        for ch in '"x':
+            st.advance(ord(ch))
+        # both "x" (close) and "xy" (y) are live — a real union state
+        m = st.mask_row()
+        assert m[ord('"')] == 0.0 and m[ord("y")] == 0.0
+
+    def test_json_object_mode_free_form(self):
+        fsm = compile_constraint(
+            TOK, V, EOS, spec_for_response_format("json_object", None))
+        st = fsm.new_state()
+        for ch in '{"k":[1,{"x":true}],"m":"v"}':
+            assert st.advance(ord(ch)), ch
+        assert st.accepting
+        st2 = fsm.new_state()
+        assert not st2.advance(ord("["))  # JSON mode demands an object
+
+    def test_unsupported_keyword_and_malformed_schema(self):
+        with pytest.raises(UnsupportedConstraintError):
+            fsm_for_schema({"type": "string", "pattern": "a+"})
+        with pytest.raises(UnsupportedConstraintError):
+            fsm_for_schema({"type": "integer", "minimum": 3})
+        with pytest.raises(JSONSchemaError):
+            fsm_for_schema({"type": "object",
+                            "properties": {"a": {"type": "string"}},
+                            "required": ["zz"]})
+        with pytest.raises(JSONSchemaError):
+            fsm_for_schema({"type": 7})
+
+    def test_ref_dereference_reused_not_duplicated(self):
+        schema = {
+            "type": "object",
+            "properties": {"p": {"$ref": "#/$defs/point"}},
+            "required": ["p"], "additionalProperties": False,
+            "$defs": {"point": {"type": "integer"}},
+        }
+        text, done = greedy_walk(fsm_for_schema(schema), prefer=ord("7"))
+        assert done
+        assert isinstance(json.loads(text)["p"], int)
+
+    def test_grammar_cache_shared(self):
+        s = {"type": "object", "properties": {"q": {"type": "boolean"}},
+             "required": ["q"], "additionalProperties": False}
+        a = fsm_for_schema(s)
+        b = fsm_for_schema(s)
+        assert a is b
+        assert constrain.grammar_cache_size() >= 1
+
+
+class TestDeadEnd:
+    def test_unreachable_char_forces_accepted_eos(self):
+        """A grammar state no vocab token can advance (here: the only
+        legal char has no token) must mask down to EOS AND accept that
+        forced EOS — otherwise the engine would roll the window back
+        and re-sample the same EOS forever."""
+        class NoZ(ByteTokenizer):
+            def decode(self, ids):
+                s = super().decode(ids)
+                return "" if s == "z" else s
+
+        tok = NoZ()
+        fsm = compile_constraint(
+            tok, V, EOS, spec_for_response_format(
+                "json_schema", {"const": "z"}))
+        st = fsm.new_state()
+        assert st.advance(ord('"'))
+        m = st.mask_row()
+        assert m[ord("z")] == NEG_MASK  # the token doesn't exist
+        assert m[TOK.eos_id] == 0.0  # forced stop is the only way out
+        assert fsm.dead_ends == 1
+        assert st.advance(TOK.eos_id)  # ...and it must be ACCEPTED
+
+
+class TestMaskBruteForce:
+    def test_mask_rows_match_per_token_probe(self):
+        """Every mask row the trie builds must equal the brute-force
+        per-token answer: token allowed iff all its chars advance the
+        char automaton (EOS iff accepting). Walked over a multi-state
+        generation path so lit/str/num/sep states are all covered."""
+        schema = {"type": "object", "properties": {
+            "t": {"type": "string", "maxLength": 3},
+            "n": {"type": "number"},
+        }, "required": ["t", "n"], "additionalProperties": False}
+        fsm = fsm_for_schema(schema)
+        st = fsm.new_state()
+        path = '{"t":"ab","n":-1.5}'
+        states = [st.state]
+        for ch in path:
+            assert st.advance(ord(ch)), ch
+            states.append(st.state)
+        for state in states:
+            mask = fsm.mask(state)
+            for tid in range(V):
+                s = fsm.table.strs[tid]
+                if tid in fsm.eos:
+                    want = fsm.accepting(state)
+                elif not s:
+                    want = False
+                else:
+                    cur = state
+                    for ch in s:
+                        cur = fsm.cf.advance_char(cur, ch)
+                        if not cur:
+                            break
+                    want = bool(cur)
+                assert (mask[tid] == 0.0) == want, (tid, s, state)
+
+
+class TestToolSpecs:
+    def test_parse_tools_validation(self):
+        with pytest.raises(UnsupportedConstraintError):
+            parse_tools([{"type": "google_search"}])
+        with pytest.raises(JSONSchemaError):
+            parse_tools([{"type": "function",
+                          "function": {"name": "bad name!"}}])
+        with pytest.raises(JSONSchemaError):
+            parse_tools([])
+        out = parse_tools([
+            {"type": "function", "function": {"name": "f",
+             "parameters": {"type": "object"}}},
+            {"type": "function", "function": {"name": "f"}},  # dup
+            {"type": "function", "function": {"name": "g"}},
+        ])
+        assert [n for n, _ in out] == ["f", "g"]
+
+    def test_tool_envelope_grammar_branches_on_name(self):
+        tools = [
+            ("alpha", {"type": "object",
+                       "properties": {"x": {"type": "integer"}},
+                       "required": ["x"],
+                       "additionalProperties": False}),
+            ("beta", None),
+        ]
+        fsm = compile_constraint(TOK, V, EOS, spec_for_tools(tools))
+        for text in ('{"name":"alpha","arguments":{"x":4}}',
+                     '{"name":"beta","arguments":{}}'):
+            st = fsm.new_state()
+            for ch in text:
+                assert st.advance(ord(ch)), (text, ch)
+            assert st.accepting, text
+        st = fsm.new_state()
+        for ch in '{"name":"alpha","arguments":':
+            st.advance(ord(ch))
+        # alpha's arguments grammar applies — '{' then '"x":'
+        assert st.advance(ord("{"))
+        m = st.mask_row()
+        assert m[ord('"')] == 0.0 and m[ord("}")] == NEG_MASK
+
+
+class TestStreamParsers:
+    def test_tool_call_parser_split_across_pieces(self):
+        parser = ToolCallParser()
+        text = '{"name":"get_weather","arguments":{"city":"sf","n":2}}'
+        events = []
+        for i in range(0, len(text), 3):
+            events += parser.feed(text[i:i + 3])
+        assert events[0] == ("name", "get_weather")
+        args = "".join(e[1] for e in events if e[0] == "args")
+        assert json.loads(args) == {"city": "sf", "n": 2}
+        assert events[-1] == ("done",)
+        assert parser.completed
+
+    def test_tool_call_parser_nested_and_strings_with_braces(self):
+        parser = ToolCallParser()
+        args_obj = {"s": "a}b{", "l": [1, {"d": 2}]}
+        text = ('{"name":"t","arguments":'
+                + json.dumps(args_obj, separators=(",", ":")) + "}")
+        events = parser.feed(text)
+        args = "".join(e[1] for e in events if e[0] == "args")
+        assert json.loads(args) == args_obj
+        assert parser.completed
+
+    def test_auto_detector_decides_tool(self):
+        det = AutoToolDetector(["f1", "f2"])
+        d, t = det.feed('{"name":')
+        assert d is None and t == ""
+        d, t = det.feed('"f2","arguments":{')
+        assert d == "tool"
+        assert t == '{"name":"f2","arguments":{'
+
+    def test_auto_detector_decides_content_and_flushes_once(self):
+        det = AutoToolDetector(["f1"])
+        d, t = det.feed('{"na')
+        assert d is None
+        d, t = det.feed("I think…")
+        assert d == "content" and t == '{"naI think…'
+        d, t = det.feed(" more")
+        assert d == "content" and t == " more"  # no re-flush
+        assert det.finish() == ("content", "")
+
+    def test_auto_detector_ambiguous_at_eof_is_content(self):
+        det = AutoToolDetector(["f1"])
+        assert det.feed('{"')[0] is None
+        assert det.finish() == ("content", '{"')
+
+    def test_parse_tool_envelope(self):
+        assert parse_tool_envelope(
+            '{"name":"f","arguments":{"a":1}}', ["f"]) == \
+            ("f", '{"a":1}')
+        assert parse_tool_envelope("plain text", ["f"]) is None
+        assert parse_tool_envelope(
+            '{"name":"g","arguments":{}}', ["f"]) is None
+
+
+class TestInstanceValidator:
+    def test_subset_semantics(self):
+        schema = {"type": "object", "properties": {
+            "a": {"type": "integer"},
+            "b": {"type": "array", "items": {"enum": [1, 2]},
+                  "maxItems": 2},
+        }, "required": ["a"], "additionalProperties": False}
+        assert validate_instance(schema, {"a": 1, "b": [1, 2]})
+        assert not validate_instance(schema, {"a": "x"})
+        assert not validate_instance(schema, {"a": 1, "zz": 0})
+        assert not validate_instance(schema, {"a": 1, "b": [3]})
+        assert not validate_instance(schema, {"a": True})  # bool ≠ int
+        assert validate_instance({"type": "string", "maxLength": 2}, "ab")
+        assert not validate_instance(
+            {"type": "string", "maxLength": 2}, "abc")
+
+
+class TestSpecKeys:
+    def test_property_order_is_part_of_the_key(self):
+        a = spec_for_response_format("json_schema", {
+            "type": "object",
+            "properties": {"a": {"type": "integer"},
+                           "b": {"type": "boolean"}}})
+        b = spec_for_response_format("json_schema", {
+            "type": "object",
+            "properties": {"b": {"type": "boolean"},
+                           "a": {"type": "integer"}}})
+        assert a.key != b.key  # declaration order is grammar-relevant
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(UnsupportedConstraintError):
+            compile_constraint(TOK, V, EOS, ConstraintSpec(kind="xml"))

@@ -1,0 +1,583 @@
+"""Grammar-constrained decoding on the serving path (ISSUE 9).
+
+Engine layer (f32 rig — deterministic, so byte-identity is meaningful):
+constrained, plain, penalized, and speculating slots mix in one decode
+window; unconstrained streams are byte-identical with the subsystem
+compiled in; constrained outputs are deterministic, schema-valid, and
+pay zero pipeline-draining rebuilds and zero post-warm XLA compiles.
+
+Server layer: response_format (all three kinds) and tools/tool_choice
+over HTTP — streamed tool_calls deltas, finish_reason "tool_calls",
+clear 400s for unsupported asks.
+
+Gateway layer (satellite): the typed stream validator accepts
+tool_calls delta frames and the tool_calls finish reason end-to-end,
+and unconstrained streams ride through unchanged.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+
+import aiohttp
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aigw_tpu.config.model import Config
+from aigw_tpu.config.runtime import RuntimeConfig
+from aigw_tpu.gateway.server import run_gateway
+from aigw_tpu.models import llama
+from aigw_tpu.tpuserve import constrain
+from aigw_tpu.tpuserve.engine import (
+    Engine,
+    EngineConfig,
+    GenRequest,
+    MigrationError,
+)
+from aigw_tpu.tpuserve.sampling import SamplingParams
+from aigw_tpu.tpuserve.server import TPUServeServer
+from aigw_tpu.tpuserve.tokenizer import ByteTokenizer
+
+CFG = llama.TINY
+TOK = ByteTokenizer()
+EOS = (TOK.eos_id,)
+
+SCHEMA = {"type": "object", "properties": {
+    "t": {"type": "string", "maxLength": 12},
+}, "required": ["t"], "additionalProperties": False}
+
+TOOLS = [{"type": "function", "function": {
+    "name": "get_weather",
+    "parameters": {"type": "object", "properties": {
+        "city": {"type": "string", "maxLength": 6},
+    }, "required": ["city"], "additionalProperties": False}}}]
+
+
+def _fsm(schema=SCHEMA):
+    return constrain.compile_constraint(
+        TOK, CFG.vocab_size, EOS,
+        constrain.spec_for_response_format("json_schema", schema))
+
+
+@pytest.fixture(scope="module")
+def eng() -> Engine:
+    """ONE f32-rig engine for every equivalence test in this module
+    (warmup is the expensive part): speculation on (rung ladder capped
+    at 4) and warm prefill buckets, so constrained/plain/penalized/
+    speculating slots genuinely share decode windows."""
+    params = llama.init_params(jax.random.PRNGKey(0), CFG, jnp.float32)
+    engine = Engine(params, CFG, EngineConfig(
+        max_batch_size=4, max_seq_len=128, page_size=16,
+        min_prefill_bucket=16, decode_steps_per_tick=4,
+        kv_cache_dtype="float32", spec_tokens=4,
+        warm_prefill_buckets=2), eos_token_ids=EOS)
+    engine.warmup()
+    engine.start()
+    yield engine
+    engine.stop()
+
+
+def _req(prompt_text="hello there", max_tokens=24, constrained=False,
+         bias=(), sampling=None, schema=SCHEMA):
+    toks: list[int] = []
+    done = threading.Event()
+    fins: list[str] = []
+
+    def emit(tok, fin):
+        if tok >= 0:
+            toks.append(tok)
+        if fin is not None:
+            fins.append(fin)
+            done.set()
+
+    req = GenRequest(
+        prompt=TOK.encode(prompt_text), max_tokens=max_tokens,
+        sampling=sampling or SamplingParams(temperature=0.0,
+                                            logit_bias=bias),
+        emit=emit, constraint=_fsm(schema) if constrained else None)
+    return req, toks, done, fins
+
+
+class TestEngineEquivalence:
+    def test_unconstrained_byte_identical_in_mixed_batch(self, eng):
+        """A plain greedy stream must be BYTE-IDENTICAL whether it runs
+        solo or concurrently with constrained slots — the subsystem
+        may not perturb traffic that didn't ask for it."""
+        solo_req, solo_toks, solo_done, _ = _req()
+        eng.submit(solo_req)
+        assert solo_done.wait(300)
+
+        members = [
+            _req(constrained=True, bias=((97, 100.0),)),
+            _req(),  # the plain control
+            _req(constrained=True, bias=((98, 100.0),)),
+        ]
+        for r, *_rest in members:
+            eng.submit(r)
+        for _r, _t, done, _f in members:
+            assert done.wait(300)
+        assert members[1][1] == solo_toks
+        for idx in (0, 2):
+            text = TOK.decode(members[idx][1])
+            assert constrain.validate_instance(
+                SCHEMA, json.loads(text)), text
+        assert eng.healthy
+
+    def test_constrained_deterministic_and_valid(self, eng):
+        a, ta, da, fa = _req(constrained=True, bias=((97, 100.0),))
+        eng.submit(a)
+        assert da.wait(300)
+        b, tb, db, fb = _req(constrained=True, bias=((97, 100.0),))
+        eng.submit(b)
+        assert db.wait(300)
+        assert ta == tb
+        assert fa[0] == "stop"
+        text = TOK.decode(ta)
+        assert constrain.validate_instance(SCHEMA, json.loads(text))
+        assert eng.stats.constraint_rollbacks > 0  # windows > 1 token
+
+    def test_constrained_penalized_and_speculating_mix(self, eng):
+        """The full batch zoo in one decode window: a constrained
+        greedy slot (spec-eligible — it gets a draft controller), a
+        penalized slot, and a sampled slot, under spec_tokens=4. The
+        constrained output stays valid, the speculative path never
+        forces a pipeline-draining rebuild, and the engine stays
+        healthy."""
+        members = [
+            _req(constrained=True, bias=((97, 100.0),),
+                 prompt_text="ab" * 8),
+            _req(sampling=SamplingParams(temperature=0.0,
+                                         frequency_penalty=0.5)),
+            _req(sampling=SamplingParams(temperature=0.7, seed=3)),
+        ]
+        for r, *_rest in members:
+            eng.submit(r)
+        for _r, _t, done, _f in members:
+            assert done.wait(300)
+        text = TOK.decode(members[0][1])
+        assert constrain.validate_instance(
+            SCHEMA, json.loads(text)), text
+        assert eng.stats.state_rebuilds == 0
+        assert eng.healthy
+
+    def test_zero_hot_compiles_after_warm_traffic(self, eng):
+        """CompileTracker tripwire: the earlier tests in this module
+        ARE the warm traffic (every program incl. the page bucket's has
+        run); from here a mixed constrained/plain burst — including
+        rollbacks, which re-upload rows — adds ZERO XLA compiles."""
+        ck = eng.compile_tracker.checkpoint()
+        rb0 = eng.stats.constraint_rollbacks
+        burst = [
+            _req(constrained=True, bias=((97, 100.0),)),
+            _req(),
+            _req(constrained=True, bias=((98, 100.0),)),
+            _req(),
+        ]
+        for r, *_rest in burst:
+            eng.submit(r)
+        for _r, _t, done, _f in burst:
+            assert done.wait(300)
+        assert eng.stats.constraint_rollbacks > rb0
+        assert eng.compile_tracker.compiles_since(ck) == 0, (
+            "constrained traffic compiled on the hot path")
+
+    def test_constrained_sessions_refuse_migration(self, eng):
+        req, toks, done, _ = _req(constrained=True,
+                                  bias=((97, 100.0),), max_tokens=60)
+        eng.submit(req)
+        deadline = 300
+        while not toks and deadline:
+            threading.Event().wait(0.02)
+            deadline -= 1
+        with pytest.raises(MigrationError, match="constrained"):
+            eng.migrate_export(req, timeout=30)
+        # cancel frees the slot at the next tick (no finish emit —
+        # server-side cancel means the client is gone)
+        req.cancelled.set()
+
+    def test_mask_composes_with_user_logit_bias(self, eng):
+        """logit_bias steers WITHIN the grammar: biasing 'b' fills the
+        string field with 'b's; the bias can never escape the mask."""
+        r, t, d, _ = _req(constrained=True, bias=((98, 100.0),))
+        eng.submit(r)
+        assert d.wait(300)
+        obj = json.loads(TOK.decode(t))
+        assert set(obj["t"]) <= {"b"}
+
+
+@pytest.fixture(scope="module")
+def constrained_url():
+    """tpuserve (tiny-random) with constrained decoding on and a
+    4-slot batch, in a thread."""
+    from aiohttp import web
+
+    holder = {}
+    started = threading.Event()
+
+    def run():
+        async def main():
+            server = TPUServeServer(
+                "tiny-random",
+                EngineConfig(max_batch_size=4, max_seq_len=256,
+                             page_size=16, min_prefill_bucket=16,
+                             decode_steps_per_tick=4),
+            )
+            runner = web.AppRunner(server.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            holder["port"] = site._server.sockets[0].getsockname()[1]
+            holder["loop"] = asyncio.get_running_loop()
+            started.set()
+            await asyncio.Event().wait()
+
+        try:
+            asyncio.run(main())
+        except RuntimeError:
+            pass
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(timeout=120)
+    yield f"http://127.0.0.1:{holder['port']}"
+    holder["loop"].call_soon_threadsafe(holder["loop"].stop)
+
+
+def _base_body(**over):
+    body = {"model": "tiny-random", "max_tokens": 60, "temperature": 0.0,
+            "logit_bias": {"97": 100},
+            "messages": [{"role": "user", "content": "hi"}]}
+    body.update(over)
+    return body
+
+
+async def _read_stream(resp):
+    """→ (content, tool_name, tool_args, finish_reason, raw events)."""
+    content, name, args, fin, events = "", None, "", None, []
+    async for line in resp.content:
+        line = line.strip()
+        if not line.startswith(b"data: "):
+            continue
+        d = line[6:]
+        if d == b"[DONE]":
+            break
+        ev = json.loads(d)
+        events.append(ev)
+        for ch in ev.get("choices") or []:
+            delta = ch.get("delta") or {}
+            content += delta.get("content") or ""
+            for t in delta.get("tool_calls") or []:
+                fn = t.get("function") or {}
+                if fn.get("name"):
+                    name = fn["name"]
+                args += fn.get("arguments") or ""
+            if ch.get("finish_reason"):
+                fin = ch["finish_reason"]
+    return content, name, args, fin, events
+
+
+class TestServingHTTP:
+    def test_json_schema_stream_matches_nonstream(self, constrained_url):
+        rf = {"type": "json_schema",
+              "json_schema": {"name": "x", "schema": SCHEMA}}
+
+        async def main():
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    constrained_url + "/v1/chat/completions",
+                    json=_base_body(response_format=rf),
+                ) as r:
+                    assert r.status == 200, await r.text()
+                    body = await r.json()
+                async with s.post(
+                    constrained_url + "/v1/chat/completions",
+                    json=_base_body(response_format=rf, stream=True),
+                ) as r:
+                    assert r.status == 200
+                    return body, await _read_stream(r)
+
+        body, (content, _n, _a, fin, _e) = asyncio.run(main())
+        text = body["choices"][0]["message"]["content"]
+        assert constrain.validate_instance(SCHEMA, json.loads(text))
+        assert content == text
+        assert fin == "stop" == body["choices"][0]["finish_reason"]
+
+    def test_json_object_mode(self, constrained_url):
+        async def main():
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    constrained_url + "/v1/chat/completions",
+                    json=_base_body(
+                        response_format={"type": "json_object"},
+                        logit_bias={"125": 100}),  # prefer '}'
+                ) as r:
+                    assert r.status == 200, await r.text()
+                    return await r.json()
+
+        body = asyncio.run(main())
+        obj = json.loads(body["choices"][0]["message"]["content"])
+        assert isinstance(obj, dict)
+
+    def test_tools_required_and_named(self, constrained_url):
+        async def main():
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    constrained_url + "/v1/chat/completions",
+                    json=_base_body(tools=TOOLS, tool_choice="required"),
+                ) as r:
+                    assert r.status == 200, await r.text()
+                    body = await r.json()
+                async with s.post(
+                    constrained_url + "/v1/chat/completions",
+                    json=_base_body(
+                        tools=TOOLS, stream=True,
+                        tool_choice={"type": "function",
+                                     "function": {"name": "get_weather"}},
+                    ),
+                ) as r:
+                    assert r.status == 200
+                    return body, await _read_stream(r)
+
+        body, (content, name, args, fin, _e) = asyncio.run(main())
+        ch = body["choices"][0]
+        assert ch["finish_reason"] == "tool_calls"
+        tc = ch["message"]["tool_calls"][0]
+        assert tc["type"] == "function"
+        assert tc["function"]["name"] == "get_weather"
+        tool_schema = TOOLS[0]["function"]["parameters"]
+        assert constrain.validate_instance(
+            tool_schema, json.loads(tc["function"]["arguments"]))
+        # streamed named call reassembles to the same contract
+        assert content == "" and name == "get_weather"
+        assert fin == "tool_calls"
+        assert constrain.validate_instance(tool_schema, json.loads(args))
+
+    def test_tool_choice_auto_diverging_output_is_content(
+            self, constrained_url):
+        async def main():
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    constrained_url + "/v1/chat/completions",
+                    json=_base_body(tools=TOOLS, tool_choice="auto",
+                                    stream=True),
+                ) as r:
+                    assert r.status == 200
+                    return await _read_stream(r)
+
+        content, name, _args, fin, _e = asyncio.run(main())
+        assert name is None
+        assert len(content) > 0
+        assert fin in ("stop", "length")
+
+    def test_clear_400s(self, constrained_url):
+        cases = [
+            (_base_body(response_format={"type": "json_schema",
+                                         "json_schema": {"name": "x"}}),
+             "schema is required"),
+            (_base_body(response_format={"type": "json_schema",
+                        "json_schema": {"name": "x", "schema": {
+                            "type": "string", "pattern": "a+"}}}),
+             "unsupported JSON-schema keyword"),
+            (_base_body(tools=[{"type": "google_search"}],
+                        tool_choice="required"),
+             "not executable"),
+            (_base_body(tools=TOOLS,
+                        tool_choice={"type": "function",
+                                     "function": {"name": "nope"}}),
+             "unknown tool"),
+            (_base_body(tools=TOOLS, tool_choice="required", n=2),
+             "n > 1"),
+            (_base_body(tools=TOOLS, tool_choice="required",
+                        response_format={"type": "json_object"}),
+             "cannot be combined"),
+        ]
+
+        async def main():
+            async with aiohttp.ClientSession() as s:
+                for body, expect in cases:
+                    async with s.post(
+                        constrained_url + "/v1/chat/completions",
+                        json=body,
+                    ) as r:
+                        text = await r.text()
+                        assert r.status == 400, (r.status, text)
+                        assert expect in text, (expect, text)
+                # legacy completions: structured asks 400, never free
+                # text with a 200
+                async with s.post(
+                    constrained_url + "/v1/completions",
+                    json={"model": "tiny-random", "prompt": "x",
+                          "max_tokens": 4,
+                          "response_format": {"type": "json_object"}},
+                ) as r:
+                    assert r.status == 400, await r.text()
+
+        asyncio.run(main())
+
+    def test_state_exports_constraint_surface(self, constrained_url):
+        async def main():
+            async with aiohttp.ClientSession() as s:
+                async with s.get(constrained_url + "/state") as r:
+                    st = await r.json()
+                async with s.get(constrained_url
+                                 + "/debug/requests") as r:
+                    flights = await r.json()
+                rollbacks = []
+                for e in flights.get("recent", ()):
+                    async with s.get(constrained_url
+                                     + f"/debug/requests/{e['id']}") as r:
+                        rollbacks.append((await r.json()).get(
+                            "constraint_rollbacks", 0))
+                return st, rollbacks
+
+        st, rollbacks = asyncio.run(main())
+        # the flight recorder carries the per-request rollback view
+        # (earlier tests in this module served constrained requests)
+        assert any(n > 0 for n in rollbacks), \
+            "no flight entry recorded constraint rollbacks"
+        assert st["constrained_decoding"] is True
+        assert st["capabilities"]["tools"] is True
+        assert st["constraint_requests"] >= 1
+        assert st["constraint_grammars"] >= 1
+        for f in ("device_bytes_in_use", "device_bytes_limit",
+                  "device_memory_frac", "kv_pool_bytes",
+                  "kv_bytes_in_use"):
+            assert f in st, f
+
+    def test_models_advertises_capabilities(self, constrained_url):
+        async def main():
+            async with aiohttp.ClientSession() as s:
+                async with s.get(constrained_url + "/v1/models") as r:
+                    return await r.json()
+
+        models = asyncio.run(main())
+        entry = models["data"][0]
+        assert entry["capabilities"]["response_format"] == [
+            "text", "json_object", "json_schema"]
+        assert entry["capabilities"]["tools"] is True
+
+
+def _gateway_config(tpu_url: str) -> Config:
+    return Config.parse({
+        "version": "v1",
+        "backends": [
+            {"name": "tpu", "schema": "TPUServe", "url": tpu_url},
+        ],
+        "routes": [{
+            "name": "serving",
+            "rules": [{"models": ["tiny-random"], "backends": ["tpu"]}],
+        }],
+        "models": ["tiny-random"],
+    })
+
+
+class TestGatewayConformance:
+    """Satellite: gateway→tpuserve structured conformance. The typed
+    stream validator (schemas/typed_response.py) must accept tool_calls
+    delta frames and finish_reason "tool_calls" end-to-end — a frame it
+    rejects would surface as a stream error event and a cut relay."""
+
+    def test_streamed_tool_call_through_gateway(self, constrained_url):
+        async def main():
+            server, runner = await run_gateway(
+                RuntimeConfig.build(_gateway_config(constrained_url)),
+                port=0)
+            site = list(runner.sites)[0]
+            port = site._server.sockets[0].getsockname()[1]
+            url = f"http://127.0.0.1:{port}"
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        url + "/v1/chat/completions",
+                        json=_base_body(
+                            tools=TOOLS, stream=True,
+                            tool_choice={"type": "function", "function":
+                                         {"name": "get_weather"}},
+                        ),
+                    ) as r:
+                        assert r.status == 200, await r.text()
+                        return await _read_stream(r)
+            finally:
+                await runner.cleanup()
+
+        content, name, args, fin, events = asyncio.run(main())
+        assert not any("error" in ev for ev in events), events
+        assert name == "get_weather"
+        assert fin == "tool_calls"
+        assert constrain.validate_instance(
+            TOOLS[0]["function"]["parameters"], json.loads(args))
+
+    def test_unconstrained_stream_identical_through_gateway(
+            self, constrained_url):
+        """The same deterministic plain request direct vs through the
+        gateway (constraint subsystem live on the replica) yields the
+        identical content stream."""
+        async def main():
+            server, runner = await run_gateway(
+                RuntimeConfig.build(_gateway_config(constrained_url)),
+                port=0)
+            site = list(runner.sites)[0]
+            port = site._server.sockets[0].getsockname()[1]
+            gw = f"http://127.0.0.1:{port}"
+            try:
+                out = []
+                async with aiohttp.ClientSession() as s:
+                    for url in (constrained_url, gw):
+                        async with s.post(
+                            url + "/v1/chat/completions",
+                            json=_base_body(stream=True),
+                        ) as r:
+                            assert r.status == 200
+                            out.append(await _read_stream(r))
+                return out
+            finally:
+                await runner.cleanup()
+
+        direct, via_gw = asyncio.run(main())
+        assert direct[0] == via_gw[0]  # content byte-identical
+        assert direct[3] == via_gw[3]  # finish reason
+
+    def test_gateway_models_carries_capability_flags(self):
+        """Gateway /v1/models merges the capability flags a replica
+        reports on /state (picker-polled) into the model listing —
+        clients discover structured-output support at the gateway, not
+        per replica. Telemetry is injected picker-side, the same shape
+        one /state poll would store."""
+        async def main():
+            cfg = Config.parse({
+                "version": "v1",
+                "backends": [{
+                    "name": "pool", "schema": "TPUServe",
+                    "endpoints": ["127.0.0.1:19996"],
+                }],
+                "routes": [{"name": "r", "rules": [
+                    {"models": ["tiny-random"], "backends": ["pool"]}]}],
+                "models": ["tiny-random"],
+            })
+            server, runner = await run_gateway(RuntimeConfig.build(cfg),
+                                               port=0)
+            site = list(runner.sites)[0]
+            port = site._server.sockets[0].getsockname()[1]
+            await server._pickers["pool"].stop()
+            server._pickers["pool"].observe(
+                "127.0.0.1:19996", model="tiny-random")
+            st = server._pickers["pool"].state["127.0.0.1:19996"]
+            st.constrained = True
+            st.capabilities = dict(constrain.CAPABILITIES)
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(
+                        f"http://127.0.0.1:{port}/v1/models") as r:
+                        assert r.status == 200
+                        return await r.json()
+            finally:
+                await runner.cleanup()
+
+        models = asyncio.run(main())
+        entry = next(m for m in models["data"]
+                     if m["id"] == "tiny-random")
+        assert entry.get("capabilities", {}).get("tools") is True

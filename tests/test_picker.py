@@ -41,6 +41,50 @@ class TestScoring:
         p.observe("10.0.0.3:8011", kv_occupancy=0.9, max_slots=8)
         assert p.pick() == "10.0.0.2:8011"
 
+    def test_measured_memory_pressure_penalized(self):
+        """ISSUE 9 satellite (VERDICT r5 residue): the picker consumes
+        the MEASURED device-memory signal (jax memory_stats() polled as
+        device_memory_frac), not just the kv_occupancy label — a
+        replica near its HBM limit loses to an equally-loaded sibling
+        with headroom."""
+        p = make_picker()
+        p.observe("10.0.0.1:8011", kv_occupancy=0.3, max_slots=8,
+                  hbm_frac=0.95)
+        p.observe("10.0.0.2:8011", kv_occupancy=0.3, max_slots=8,
+                  hbm_frac=0.10)
+        p.observe("10.0.0.3:8011", kv_occupancy=0.3, max_slots=8,
+                  hbm_frac=0.90)
+        assert p.pick() == "10.0.0.2:8011"
+        # backends without memory stats report 0.0 — the term vanishes
+        # and the classic ordering is unchanged
+        p2 = make_picker()
+        p2.observe("10.0.0.1:8011", kv_occupancy=0.9, max_slots=8)
+        p2.observe("10.0.0.2:8011", kv_occupancy=0.1, max_slots=8)
+        p2.observe("10.0.0.3:8011", kv_occupancy=0.5, max_slots=8)
+        assert p2.pick() == "10.0.0.2:8011"
+
+    def test_memory_signal_polled_from_state(self, tpuserve_url):
+        """device_memory_frac + capability flags ride the live /state
+        poll into EndpointState."""
+        async def main():
+            host = tpuserve_url.replace("http://", "")
+            p = EndpointPicker([Endpoint(host)], poll_interval=0.1)
+            await p.start()
+            try:
+                for _ in range(100):
+                    st = p.state[host]
+                    if st.healthy:
+                        break
+                    await asyncio.sleep(0.1)
+                assert st.healthy
+                assert 0.0 <= st.hbm_frac <= 1.0
+                assert st.constrained is True
+                assert st.capabilities.get("tools") is True
+            finally:
+                await p.stop()
+
+        asyncio.run(main())
+
     def test_unhealthy_skipped(self):
         p = make_picker()
         p.observe("10.0.0.1:8011", kv_occupancy=0.0)

@@ -517,3 +517,83 @@ def test_state_and_metrics_export_migration_gauges(smoke_url):
     text = asyncio.run(_get(smoke_url, "/metrics")).decode()
     for gauge in MIGRATION_GAUGES:
         assert gauge in text, f"/metrics lost {gauge}"
+
+
+# grammar-constrained decoding surface (ISSUE 9): a renamed field here
+# silently breaks the bench --ab structured leg (reads the counters),
+# the gateway's capability merge (constrained_decoding/capabilities),
+# or the picker's measured memory signal (device_memory_frac)
+CONSTRAINT_STATE_FIELDS = (
+    "constrained_decoding",
+    "capabilities",
+    "constrained_slots",
+    "constraint_requests",
+    "constraint_rollbacks",
+    "constraint_mask_updates",
+    "constraint_grammars",
+)
+
+CONSTRAINT_GAUGES = (
+    "tpuserve_constrained_slots",
+    "tpuserve_constraint_requests_total",
+    "tpuserve_constraint_rollbacks_total",
+    "tpuserve_constraint_mask_updates_total",
+    "tpuserve_constraint_grammars",
+)
+
+MEMORY_STATE_FIELDS = (
+    "device_bytes_in_use",
+    "device_bytes_limit",
+    "device_memory_frac",
+    "kv_pool_bytes",
+    "kv_bytes_in_use",
+)
+
+MEMORY_GAUGES = (
+    "tpuserve_device_bytes_in_use",
+    "tpuserve_device_bytes_limit",
+    "tpuserve_device_memory_frac",
+    "tpuserve_kv_pool_bytes",
+    "tpuserve_kv_bytes_in_use",
+)
+
+
+def test_state_and_metrics_export_constraint_gauges(smoke_url):
+    """The constrained-decoding surface must appear on /state and
+    /metrics even when no constrained request has been served
+    (constant 0 / capability flags)."""
+    state = json.loads(asyncio.run(_get(smoke_url, "/state")))
+    for field in CONSTRAINT_STATE_FIELDS:
+        assert field in state, f"/state lost {field}"
+    assert state["constrained_decoding"] is True
+    assert state["capabilities"].get("tools") is True
+    text = asyncio.run(_get(smoke_url, "/metrics")).decode()
+    for gauge in CONSTRAINT_GAUGES:
+        assert gauge in text, f"/metrics lost {gauge}"
+
+
+def test_state_and_metrics_export_memory_signals(smoke_url):
+    """The measured per-device memory signals (jax memory_stats() +
+    KV-pool bytes) must appear on /state and /metrics — the picker's
+    first measured signal must not silently rot. On CPU the jax bytes
+    are 0; the KV-pool bytes must be real."""
+    async def prime():
+        # one chat so the engine has ticked and refreshed the gauges
+        # (this test must hold even when run in isolation)
+        async with aiohttp.ClientSession() as s:
+            async with s.post(smoke_url + "/v1/chat/completions", json={
+                "model": "tiny-random",
+                "messages": [{"role": "user", "content": "mem smoke"}],
+                "max_tokens": 2,
+            }) as resp:
+                assert resp.status == 200
+
+    asyncio.run(prime())
+    state = json.loads(asyncio.run(_get(smoke_url, "/state")))
+    for field in MEMORY_STATE_FIELDS:
+        assert field in state, f"/state lost {field}"
+    assert state["kv_pool_bytes"] > 0
+    assert 0.0 <= state["device_memory_frac"] <= 1.0
+    text = asyncio.run(_get(smoke_url, "/metrics")).decode()
+    for gauge in MEMORY_GAUGES:
+        assert gauge in text, f"/metrics lost {gauge}"

@@ -442,3 +442,94 @@ class TestInt4:
         params = llama.init_params(jax.random.PRNGKey(0), CFG)
         qp = quantize_params(params, mode="int4")
         assert qp["l0.wq.q"].dtype == jnp.int8
+
+
+class TestInt4OutputQuality:
+    """ROADMAP known-gap closure (ISSUE 9 satellite): "int4 output
+    quality is unvalidated" stops being carried. Fixed-prompt greedy
+    rollouts + top-k logit overlap, int4 (W4A16, group scales) vs the
+    f32 reference, thresholds asserted in a NON-slow test.
+
+    Model: the smallest ratio-model-shaped llama whose input dims are
+    all GROUP4-divisible — on TINY (dim 64 < 128) quantize_params
+    silently falls back to int8 per its non-groupable rule, and the
+    "int4" numbers would be int8's (this test asserts the int4 path
+    actually engaged). Measured on this config with random weights
+    (int4's worst case: no outlier structure, flat logits): argmax
+    agreement 0.36, top-8 overlap 0.55, corr 0.89. The thresholds sit
+    below that but far above broken-quantizer territory (agreement
+    ~1/V≈0.002, overlap ~0.016, corr ~0) — they catch a wrong scale /
+    group layout, and the measured numbers document real int4 quality
+    on this architecture.
+    """
+
+    G_CFG = llama.LlamaConfig(
+        vocab_size=512, dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=256, max_seq_len=256)
+    PROMPT = [1] + [ord(c) for c in
+                    "The quick brown fox jumps over the lazy dog"]
+    STEPS = 16
+
+    @staticmethod
+    def _topk_overlap(a: np.ndarray, b: np.ndarray, k: int = 8) -> float:
+        return len(set(np.argsort(a)[-k:])
+                   & set(np.argsort(b)[-k:])) / k
+
+    def _rollout(self):
+        cfg = self.G_CFG
+        pf = llama.init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+        q4 = quantize_params(
+            llama.init_params(jax.random.PRNGKey(0), cfg), mode="int4")
+        n = len(self.PROMPT)
+        tok = jnp.array([self.PROMPT], jnp.int32)
+        lens = jnp.array([n])
+        pt = jnp.arange(8, dtype=jnp.int32).reshape(1, 8)
+
+        def cache(dtype):
+            return jnp.zeros((cfg.n_layers, 2, 64 * PAGE,
+                              cfg.n_kv_heads, cfg.head_dim), dtype)
+
+        lf, kf = llama.prefill(pf, cfg, tok, lens, cache(jnp.float32),
+                               pt, PAGE)
+        lq, kq = llama.prefill(q4, cfg, tok, lens, cache(jnp.bfloat16),
+                               pt, PAGE)
+        rows = [(np.asarray(lf, np.float32)[0],
+                 np.asarray(lq, np.float32)[0])]
+        # teacher-forced greedy: BOTH models consume the f32 reference's
+        # greedy tokens, so per-step logits stay comparable (a free-
+        # running comparison diverges at the first argmax tie — the
+        # chunked-prefill post-mortem's tie-lottery class)
+        act = jnp.ones((1,), bool)
+        cur, pos = int(rows[0][0].argmax()), n
+        for _ in range(self.STEPS):
+            t = jnp.array([cur], jnp.int32)
+            p = jnp.array([pos], jnp.int32)
+            lf1, kf = llama.decode_step(pf, cfg, t, p, kf, pt, PAGE, act)
+            lq1, kq = llama.decode_step(q4, cfg, t, p, kq, pt, PAGE, act)
+            rows.append((np.asarray(lf1, np.float32)[0],
+                         np.asarray(lq1, np.float32)[0]))
+            cur, pos = int(rows[-1][0].argmax()), pos + 1
+        return rows
+
+    def test_int4_greedy_rollout_and_topk_overlap(self):
+        rows = self._rollout()
+        agree = np.mean([a.argmax() == b.argmax() for a, b in rows])
+        overlap = np.mean([self._topk_overlap(a, b) for a, b in rows])
+        corr = np.mean([np.corrcoef(a, b)[0, 1] for a, b in rows])
+        assert agree >= 0.20, f"int4 greedy argmax agreement {agree:.3f}"
+        assert overlap >= 0.35, f"int4 top-8 logit overlap {overlap:.3f}"
+        assert corr >= 0.80, f"int4 logit correlation {corr:.3f}"
+
+    def test_int4_group_path_engaged(self):
+        """The groupable config must take the REAL int4 path (native
+        int4 dtype, values in [-7, 7], group scales) — not the silent
+        int8 fallback TINY's dim-64 matrices get."""
+        q4 = quantize_params(
+            llama.init_params(jax.random.PRNGKey(0), self.G_CFG),
+            mode="int4")
+        assert is_quantized(q4)
+        wq = q4["l0.wq.q"]
+        assert wq.dtype == jnp.int4, wq.dtype
+        v = np.asarray(wq.astype(jnp.int8))
+        assert v.min() >= -7 and v.max() <= 7
+        assert q4["l0.wq.scale"].shape == (1, 128)  # [in/group, out]

@@ -381,3 +381,64 @@ class TestReviewRegressions:
                           c["choices"][0]["delta"].get("content")]
         lp = content_chunks[0]["choices"][0]["logprobs"]
         assert lp["content"][0]["token"] == "hi"
+
+
+class TestTPUServeConstraintIntegration:
+    """ISSUE 9: the gateway's response_format parser and the TPU-side
+    grammar compiler are ONE pipeline — every kind the parser
+    normalizes must map to a compilable ConstraintSpec (or a clear
+    UnsupportedConstraintError), with JSONSchemaError shared, never
+    duplicated."""
+
+    def test_every_parsed_kind_maps_to_a_spec(self):
+        from aigw_tpu.translate.structured import parse_response_format
+        from aigw_tpu.tpuserve.constrain import (
+            compile_constraint,
+            spec_for_response_format,
+        )
+        from aigw_tpu.tpuserve.tokenizer import ByteTokenizer
+
+        tok = ByteTokenizer()
+        schema = {"type": "object",
+                  "properties": {"a": {"type": "integer"}},
+                  "required": ["a"], "additionalProperties": False}
+        for body, kind in (
+            ({"response_format": {"type": "json_object"}},
+             "json_object"),
+            ({"response_format": {"type": "json_schema",
+                                  "json_schema": {"name": "x",
+                                                  "schema": schema}}},
+             "json_schema"),
+        ):
+            rf = parse_response_format(body)
+            assert rf is not None and rf.kind == kind
+            spec = spec_for_response_format(rf.kind, rf.schema)
+            fsm = compile_constraint(tok, 512, (tok.eos_id,), spec)
+            assert fsm.new_state() is not None
+
+    def test_ref_schema_flows_through_shared_dereference(self):
+        """A $ref schema the gateway would forward compiles through the
+        SAME dereference the provider translators use — and its
+        circular-reference guard raises the shared JSONSchemaError."""
+        import pytest as _pytest
+
+        from aigw_tpu.translate.structured import JSONSchemaError
+        from aigw_tpu.tpuserve.constrain import (
+            compile_constraint,
+            spec_for_response_format,
+        )
+        from aigw_tpu.tpuserve.tokenizer import ByteTokenizer
+
+        tok = ByteTokenizer()
+        good = {"type": "object",
+                "properties": {"p": {"$ref": "#/$defs/leaf"}},
+                "required": ["p"], "additionalProperties": False,
+                "$defs": {"leaf": {"type": "boolean"}}}
+        compile_constraint(tok, 512, (tok.eos_id,),
+                           spec_for_response_format("json_schema", good))
+        circular = {"$ref": "#/$defs/a",
+                    "$defs": {"a": {"$ref": "#/$defs/a"}}}
+        with _pytest.raises(JSONSchemaError, match="circular"):
+            compile_constraint(
+                tok, 512, (tok.eos_id,),
+                spec_for_response_format("json_schema", circular))

@@ -425,3 +425,66 @@ class TestResponsesRequestDeep:
         with pytest.raises(SchemaError, match="name"):
             self.check({"model": "m",
                         "tools": [{"type": "function"}]})
+
+
+class TestToolCallStreamFrames:
+    """ISSUE 9 satellite: the exact chunk shapes tpuserve's constrained
+    tool-calling path emits must pass the typed stream validator — a
+    rejected frame would cut the relay mid-tool-call. Frames mirror
+    server.py's write_tool_events + the terminal finish frame."""
+
+    def _chunk(self, **kw):
+        base = {"id": "chatcmpl-x", "object": "chat.completion.chunk",
+                "created": 1, "model": "tiny-random"}
+        base.update(kw)
+        return base
+
+    def test_tool_call_name_frame(self):
+        typed_response.validate_stream_event(
+            Endpoint.CHAT_COMPLETIONS, self._chunk(choices=[{
+                "index": 0,
+                "delta": {"tool_calls": [{
+                    "index": 0, "id": "call_abc", "type": "function",
+                    "function": {"name": "get_weather",
+                                 "arguments": ""}}]},
+                "finish_reason": None}]))
+
+    def test_tool_call_arguments_delta_frame(self):
+        typed_response.validate_stream_event(
+            Endpoint.CHAT_COMPLETIONS, self._chunk(choices=[{
+                "index": 0,
+                "delta": {"tool_calls": [{
+                    "index": 0,
+                    "function": {"arguments": '{"city":"sf"'}}]},
+                "finish_reason": None}]))
+
+    def test_finish_reason_tool_calls_frame(self):
+        typed_response.validate_stream_event(
+            Endpoint.CHAT_COMPLETIONS, self._chunk(choices=[{
+                "index": 0, "delta": {},
+                "finish_reason": "tool_calls"}]))
+
+    def test_nonstream_tool_calls_response(self):
+        typed_response.validate_response(Endpoint.CHAT_COMPLETIONS, {
+            "id": "x", "object": "chat.completion", "created": 1,
+            "model": "m",
+            "choices": [{"index": 0, "message": {
+                "role": "assistant", "content": None,
+                "tool_calls": [{
+                    "id": "call_abc", "type": "function",
+                    "function": {"name": "f",
+                                 "arguments": '{"a":1}'}}]},
+                "finish_reason": "tool_calls"}],
+            "usage": {"prompt_tokens": 1, "completion_tokens": 9,
+                      "total_tokens": 10}})
+
+    def test_malformed_tool_call_frame_still_rejected(self):
+        """The validator keeps its teeth: a tool_calls delta whose
+        function is not an object fails."""
+        with pytest.raises(SchemaError):
+            typed_response.validate_stream_event(
+                Endpoint.CHAT_COMPLETIONS, self._chunk(choices=[{
+                    "index": 0,
+                    "delta": {"tool_calls": [{
+                        "index": 0, "function": "not-an-object"}]},
+                    "finish_reason": None}]))
