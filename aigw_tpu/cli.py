@@ -242,6 +242,13 @@ def main(argv: list[str] | None = None) -> int:
                               "for the N smallest prompt buckets at "
                               "startup (all group sizes) so a traffic "
                               "burst never pays an XLA compile")
+    p_serve.add_argument("--warm-decode-buckets", type=int, default=0,
+                         help="pre-compile the decode-window ladder "
+                              "(and row-update scatters) at the N "
+                              "smallest pow2 PAGE buckets so the "
+                              "first admission at any covered length "
+                              "never compiles a decode program on the "
+                              "hot path (0 = only the quiesced bucket)")
     p_serve.add_argument("--no-first-token-fast-path", action="store_true",
                          help="disable the first-token fast path "
                               "(async prefill-token host copy, 1ms "
@@ -893,6 +900,7 @@ async def _run_tpuserve(args: argparse.Namespace) -> int:
         adaptive_decode_window=not args.no_adaptive_window,
         async_transfers=not args.sync_transfers,
         warm_prefill_buckets=args.warm_prefill_buckets,
+        warm_decode_buckets=args.warm_decode_buckets,
         first_token_fast_path=not args.no_first_token_fast_path,
         prefill_bucket_rungs=args.prefill_bucket_rungs,
         flight_entries=args.flight_entries,

@@ -7,7 +7,10 @@ picker is in-process: it polls each tpuserve replica's ``/state``
 telemetry (KV page occupancy, queue depth, active slots — exported by
 aigw_tpu/tpuserve/server.py) and scores endpoints:
 
-    score = kv_occupancy                     (HBM pressure)
+    score = kv_occupancy [worst device]      (HBM pressure — on a mesh
+                                              replica the WORST device's
+                                              occupancy, polled from the
+                                              per-device /state map)
           + queued / max_slots               (waiting work)
           + active_slots / max_slots * 0.5   (decode batch load)
           + queue_wait_ms / 1000             (queue latency: seconds the
@@ -147,7 +150,36 @@ class EndpointState:
     # prefill is done but decode is young — what a decode-leaning
     # sibling could take over
     migratable_slots: int = 0
+    # mesh serving (ISSUE 10): the replica's REAL per-device map polled
+    # from /state `devices` (memory_frac / kv_occupancy / param_bytes
+    # per device), the worst-device memory fraction, its device
+    # population, and whether the replica can serve /migrate/export|
+    # import at all (`migration` capability flag; replicas predating
+    # the flag are assumed capable — the export 409 still guards)
+    devices: tuple = ()
+    hbm_frac_worst: float = 0.0
+    mesh_devices: int = 1
+    migration_capable: bool = True
     updated_at: float = 0.0
+
+    def worst_hbm_frac(self) -> float:
+        """Worst per-device memory fraction — the mesh memory signal
+        the score consumes (one hot shard stalls every tensor-parallel
+        step, so the WORST device prices the replica, not device 0).
+        Falls back to the device-0 scalar when the replica exports no
+        per-device data."""
+        per = max((float(d.get("memory_frac", 0.0) or 0.0)
+                   for d in self.devices), default=0.0)
+        return max(self.hbm_frac, self.hbm_frac_worst, per)
+
+    def worst_kv_occupancy(self) -> float:
+        """Worst per-device KV pool occupancy (uniform under pure tensor
+        parallelism — the head-sharded pool allocates pages globally —
+        but real the moment layouts diverge). Never below the scalar
+        gauge."""
+        per = max((float(d.get("kv_occupancy", 0.0) or 0.0)
+                   for d in self.devices), default=0.0)
+        return max(self.kv_occupancy, per)
 
 
 class EndpointPicker:
@@ -237,6 +269,12 @@ class EndpointPicker:
         st.phase_percentiles = dict(data.get("phase_percentiles") or {})
         st.migratable_slots = int(data.get("migratable_slots", 0))
         st.hbm_frac = float(data.get("device_memory_frac", 0.0) or 0.0)
+        st.hbm_frac_worst = float(
+            data.get("device_memory_frac_worst", 0.0) or 0.0)
+        st.devices = tuple(d for d in (data.get("devices") or ())
+                           if isinstance(d, dict))
+        st.mesh_devices = max(1, int(data.get("mesh_devices", 1) or 1))
+        st.migration_capable = bool(data.get("migration", True))
         st.constrained = bool(data.get("constrained_decoding", False))
         st.capabilities = dict(data.get("capabilities") or {})
         st.slice_name = str(data.get("slice", "") or "")
@@ -258,7 +296,10 @@ class EndpointPicker:
                 adapters_registered: tuple = (),
                 phase_percentiles: dict | None = None,
                 migratable_slots: int = 0,
-                hbm_frac: float = 0.0) -> None:
+                hbm_frac: float = 0.0,
+                hbm_frac_worst: float = 0.0,
+                devices: tuple = (),
+                migration_capable: bool = True) -> None:
         st = self.state[address]
         st.healthy = True
         st.kv_occupancy = kv_occupancy
@@ -268,6 +309,10 @@ class EndpointPicker:
         st.queue_wait_ms = queue_wait_ms
         st.prefix_hit_rate = prefix_hit_rate
         st.hbm_frac = hbm_frac
+        st.hbm_frac_worst = hbm_frac_worst
+        if devices:
+            st.devices = tuple(devices)
+        st.migration_capable = migration_capable
         if phase_percentiles is not None:
             st.phase_percentiles = dict(phase_percentiles)
         st.migratable_slots = migratable_slots
@@ -381,7 +426,12 @@ class EndpointPicker:
             if not (st.healthy and now - st.updated_at < self.STALE_AFTER):
                 return None
             score = (
-                st.kv_occupancy
+                # WORST-device KV occupancy and memory pressure (ISSUE
+                # 10): a mesh replica is priced by its hottest shard —
+                # device 0 looking idle says nothing when device 5
+                # holds the saturated head shard. Both reduce to the
+                # scalar gauges on replicas without per-device data.
+                st.worst_kv_occupancy()
                 + st.queued / st.max_slots
                 + 0.5 * st.active_slots / st.max_slots
                 + st.queue_wait_ms / 1000.0
@@ -391,7 +441,7 @@ class EndpointPicker:
                 # numbers look fine — weights/fragmentation/adapters
                 # consume HBM the kv_occupancy label can't see. 0.0 on
                 # backends without memory stats — the term vanishes.
-                + st.hbm_frac
+                + st.worst_hbm_frac()
             )
             if prev_slice and self._slice_of(e.address) != prev_slice:
                 score += self.SLICE_PENALTY
@@ -485,6 +535,10 @@ class EndpointPicker:
                 explain.update(
                     candidates=len(fresh),
                     score=round(fresh[chosen], 4),
+                    # the mesh memory term the score consumed (ISSUE
+                    # 10): worst-DEVICE fraction, not device 0's
+                    hbm_frac_worst=round(
+                        self.state[chosen].worst_hbm_frac(), 4),
                     sticky=chosen == prev_addr and bool(affinity_key),
                     prefix_affinity=chosen == prefix_addr
                     and bool(prefix_key),

@@ -1469,6 +1469,12 @@ class _Migrator:
         src_st = self.picker.state.get(self.src)
         if src_st is None or not src_st.healthy:
             return None
+        if not src_st.migration_capable:
+            # the replica reports `migration: false` on /state (e.g.
+            # prefix cache disabled — no refcounted page export path):
+            # stop polling for this stream instead of 409ing an export
+            self.attempted = True
+            return None
         if src_st.queued < self.backend.migration_queue_depth:
             return None  # no prefill pressure at the source
         now = time.monotonic()
@@ -1477,6 +1483,8 @@ class _Migrator:
         for addr, st in self.picker.state.items():
             if addr == self.src or not st.healthy:
                 continue
+            if not st.migration_capable:
+                continue  # can't adopt a page chain
             if now - st.updated_at >= self.picker.STALE_AFTER:
                 continue
             if st.queued > 0 or st.active_slots >= st.max_slots:

@@ -203,7 +203,44 @@ ENGINE_GAUGES: tuple[tuple[str, str], ...] = (
     ("device_memory_frac", "tpuserve_device_memory_frac"),
     ("kv_pool_bytes", "tpuserve_kv_pool_bytes"),
     ("kv_bytes_in_use", "tpuserve_kv_bytes_in_use"),
+    # mesh serving (ISSUE 10): the engine's local device population,
+    # the WORST per-device memory fraction (the picker's mesh memory
+    # term — one hot shard stalls the whole tensor-parallel step), and
+    # the analytical per-device ICI collective volume (bytes one
+    # decoded token moves over the interconnect, and the running total)
+    ("device_count", "tpuserve_device_count"),
+    ("device_memory_frac_worst", "tpuserve_device_memory_frac_worst"),
+    ("ici_bytes_per_token", "tpuserve_ici_bytes_per_token"),
+    ("ici_bytes_total", "tpuserve_ici_bytes_total"),
 )
+
+#: per-device gauge surface (ISSUE 10): key in one entry of
+#: ``Engine.device_stats`` → labeled Prometheus gauge name. One
+#: authoritative map, same drift-check contract as ENGINE_GAUGES —
+#: every key here must appear in the engine's per-device dicts and
+#: every gauge must render on /metrics with a ``device`` label.
+DEVICE_GAUGES: tuple[tuple[str, str], ...] = (
+    ("bytes_in_use", "tpuserve_device_bytes_in_use_per_device"),
+    ("bytes_limit", "tpuserve_device_bytes_limit_per_device"),
+    ("memory_frac", "tpuserve_device_memory_frac_per_device"),
+    ("kv_pool_bytes", "tpuserve_device_kv_pool_bytes"),
+    ("kv_bytes_in_use", "tpuserve_device_kv_bytes_in_use"),
+    ("kv_occupancy", "tpuserve_device_kv_occupancy"),
+    ("param_bytes", "tpuserve_device_param_bytes"),
+)
+
+
+def render_device_gauges(devices: list) -> bytes:
+    """Per-device stats dicts → labeled Prometheus gauges (appended to
+    tpuserve's /metrics next to the scalar engine gauges)."""
+    lines = []
+    for _key, name in DEVICE_GAUGES:
+        lines.append(f"# TYPE {name} gauge")
+    for dev in devices:
+        label = dev.get("id", 0)
+        for key, name in DEVICE_GAUGES:
+            lines.append(f'{name}{{device="{label}"}} {dev.get(key, 0)}')
+    return ("\n".join(lines) + "\n").encode() if lines else b""
 
 
 def render_engine_gauges(stats: object) -> bytes:

@@ -10,6 +10,7 @@ reference's Envoy↔vLLM boundary.
 
 from aigw_tpu.parallel.mesh import MeshSpec, make_mesh
 from aigw_tpu.parallel.sharding import (
+    analytical_ici_bytes_per_token,
     kv_cache_spec,
     llama_param_specs,
     mixtral_param_specs,
@@ -18,6 +19,7 @@ from aigw_tpu.parallel.sharding import (
 
 __all__ = [
     "MeshSpec",
+    "analytical_ici_bytes_per_token",
     "kv_cache_spec",
     "llama_param_specs",
     "mixtral_param_specs",

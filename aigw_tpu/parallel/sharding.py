@@ -60,6 +60,41 @@ def shard_params(
     }
 
 
+def analytical_ici_bytes_per_token(cfg, mesh, dtype_bytes: int = 2) -> int:
+    """Analytical ICI collective volume of ONE decoded token on a
+    tensor-parallel mesh, in bytes PER DEVICE — the /state
+    ``ici_bytes_per_token`` signal the picker's topology term can price
+    against real occupancy (SURVEY §2.8/§2.9: "load-balances on TPU
+    KV-cache occupancy AND ICI topology").
+
+    The Megatron-via-GSPMD layout above needs, per decoded token:
+
+    - two all-reduces per layer (post-attention ``wo`` and post-MLP
+      ``w_down`` row-parallel outputs), each over a [dim] activation —
+      a ring all-reduce moves ``2 * (tp-1)/tp`` of the buffer per
+      device;
+    - one logits all-gather over the vocab-sharded lm_head output —
+      ``(tp-1)/tp`` of a [vocab] row per device (fused sampling keeps
+      it on device, but the gather itself still crosses ICI);
+    - with expert parallelism, a dispatch + combine all-to-all per
+      layer, each moving ``(ep-1)/ep`` of a [dim] activation.
+
+    Analytical by design (CPU meshes have no ICI to measure); on-chip
+    profiling replaces it, this prices it. 0 when unsharded."""
+    if mesh is None:
+        return 0
+    tp = int(mesh.shape.get("tp", 1))
+    ep = int(mesh.shape.get("ep", 1))
+    total = 0.0
+    if tp > 1:
+        ring = 2.0 * (tp - 1) / tp
+        total += cfg.n_layers * 2 * cfg.dim * dtype_bytes * ring
+        total += cfg.vocab_size * dtype_bytes * (tp - 1) / tp
+    if ep > 1 and getattr(cfg, "n_experts", 0):
+        total += cfg.n_layers * 2 * cfg.dim * dtype_bytes * (ep - 1) / ep
+    return int(total)
+
+
 def mixtral_param_specs(cfg) -> dict[str, P]:
     """Expert-parallel + tensor-parallel specs for the Mixtral family.
 

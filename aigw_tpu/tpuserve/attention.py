@@ -644,21 +644,45 @@ class RaggedPrefillBackend(AttentionBackend):
         return tok_out, info
 
 
-def make_attention_backend(engine: "Engine") -> AttentionBackend:
-    """Resolve EngineConfig.attention_backend with auto-fallback:
-    pallas-ragged needs a single-chip engine and a model family with a
-    ragged prefill entry point; anything else falls back to
-    xla-bucketed (logged — never a silent behavior change)."""
+def resolve_attention_backend(engine: "Engine") -> tuple[str, str]:
+    """The prefill fallback matrix (ISSUE 10): (resolved backend name,
+    WHY), exported verbatim on /state so an operator can see which
+    program family a replica actually runs and the reason — never a
+    silent behavior change.
+
+    | requested     | mesh | TPU | resolved      | attention impl      |
+    |---------------|------|-----|---------------|---------------------|
+    | xla-bucketed  | any  | any | xla-bucketed  | XLA dense (bucketed)|
+    | pallas-ragged | no   | yes | pallas-ragged | Pallas kernel       |
+    | pallas-ragged | no   | no  | pallas-ragged | XLA windowed        |
+    | pallas-ragged | yes  | any | pallas-ragged | XLA windowed (SPMD) |
+    | pallas-ragged | family w/o prefill_ragged | xla-bucketed         |
+
+    The Pallas kernel itself stays single-chip TPU (its scalar-prefetch
+    page walk addresses one local pool); a mesh keeps the RAGGED
+    geometry — token-budget packing, offset resumes, the collapsed
+    warm surface — through the XLA windowed program, which runs SPMD
+    with the KV pool sharded on heads. Only a model family without a
+    ragged prefill entry point forces the bucket ladder."""
     name = engine.cfg.attention_backend
-    if name == "pallas-ragged":
-        if engine.mesh is not None:
-            logger.warning(
-                "attention backend pallas-ragged ignored: engine runs "
-                "on a mesh (xla-bucketed prefill is used)")
-        elif engine._prefill_ragged_fn is None:
-            logger.warning(
-                "attention backend pallas-ragged ignored: model family "
-                "has no ragged prefill (xla-bucketed prefill is used)")
-        else:
-            return RaggedPrefillBackend(engine)
+    if name != "pallas-ragged":
+        return "xla-bucketed", "requested"
+    if engine._prefill_ragged_fn is None:
+        return ("xla-bucketed",
+                "pallas-ragged requested but the model family has no "
+                "ragged prefill entry point")
+    # engine._ragged_reason explains the kernel-vs-windowed choice
+    return "pallas-ragged", engine._ragged_reason
+
+
+def make_attention_backend(engine: "Engine") -> AttentionBackend:
+    """Resolve EngineConfig.attention_backend through the fallback
+    matrix above and build the backend (logged — never silent)."""
+    resolved, reason = resolve_attention_backend(engine)
+    engine.attn_reason = reason
+    if resolved == "pallas-ragged":
+        return RaggedPrefillBackend(engine)
+    if engine.cfg.attention_backend != resolved:
+        logger.warning("attention backend %s falls back to %s: %s",
+                       engine.cfg.attention_backend, resolved, reason)
     return XlaBucketedBackend(engine)

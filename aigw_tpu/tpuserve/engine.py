@@ -74,6 +74,44 @@ def device_memory_stats() -> tuple[int, int]:
             int(ms.get("bytes_limit", 0) or 0))
 
 
+def device_memory_stats_all() -> list[tuple[int, str, int, int]]:
+    """Live (device_id, platform, bytes_in_use, bytes_limit) for EVERY
+    local device — the mesh-serving fix for PR 9's device-0-only poll
+    (a sharded engine's hottest device is rarely device 0). Zeros on
+    backends without memory stats (CPU); the list itself is still real
+    so per-device KV/param accounting has a device to hang off."""
+    out: list[tuple[int, str, int, int]] = []
+    try:
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 — telemetry must never raise
+        return out
+    for d in devices:
+        try:
+            ms = d.memory_stats() or {}
+        except Exception:  # noqa: BLE001
+            ms = {}
+        out.append((int(d.id), str(getattr(d, "platform", "")),
+                    int(ms.get("bytes_in_use", 0) or 0),
+                    int(ms.get("bytes_limit", 0) or 0)))
+    return out
+
+
+def _per_device_bytes(tree: Any) -> dict[int, int]:
+    """Bytes each device holds of ``tree``'s array leaves, from the
+    arrays' real shard layout (an unsharded array is one shard on one
+    device). The measured half of the bench's per-device-param-bytes ≈
+    total/tp claim."""
+    per: dict[int, int] = {}
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards is None:
+            continue
+        for sh in shards:
+            d = int(sh.device.id)
+            per[d] = per.get(d, 0) + int(sh.data.nbytes)
+    return per
+
+
 class MigrationError(Exception):
     """A migration export/import could not be performed (request not
     active, finished during the cut, prefix cache disabled, malformed
@@ -153,6 +191,17 @@ class EngineConfig:
     # compile for a group shape the warm traffic happened not to hit.
     # 0 = off (each (group, bucket) shape compiles on first use).
     warm_prefill_buckets: int = 0
+    # Pre-compile the decode-window ladder (lean/full × window sizes ×
+    # spec verify rungs) AND the row-update scatters at the first N
+    # pow2 PAGE buckets, not just the quiesced bucket-1 state (ISSUE
+    # 10): the decode program re-traces per page-table width, so the
+    # first admission whose sequence needs a bucket the warmup never
+    # visited pays an XLA compile (and a pipeline-draining rebuild) on
+    # the hot path — the CompileTracker showed exactly this at first
+    # mesh admission. 0 keeps the old single-bucket warm (cheapest
+    # cold start); N warms buckets 1, 2, …, 2^(N-1) capped at
+    # max_pages_per_seq.
+    warm_decode_buckets: int = 0
     # Prefill bucket rungs per octave: 1 keeps the classic power-of-two
     # ladder (worst-case padding ≈ 2× the prompt); 2 adds a 1.5×S rung
     # between octaves (worst-case padding 1.5×); 4 adds 1.25×/1.5×/1.75×
@@ -176,7 +225,10 @@ class EngineConfig:
     spec_adaptive: bool = True
     # Ragged paged-attention Pallas kernel for the decode hot loop (HBM
     # reads scale with actual sequence lengths, not the padded window).
-    # Single-chip only: ignored when the engine runs on a mesh.
+    # The KERNEL stays single-chip (no shard_map port): on a mesh the
+    # decode loop keeps the GSPMD gather path, whose KV reads are local
+    # to each head shard anyway; the resolved impl and the reason are
+    # exported on /state (decode_attn_impl / decode_attn_reason).
     pallas_attn: bool = False
     # Prefill attention backend (tpuserve/attention.py):
     # "xla-bucketed" — the classic per-sequence bucket ladder with
@@ -185,9 +237,11 @@ class EngineConfig:
     # sized by TOTAL tokens (padded to a token-budget chunk rung, not
     # per-sequence buckets), with per-sequence start offsets making
     # prefix-cache resumes and chunked continuations first-class.
-    # pallas-ragged auto-falls back: XLA windowed attention off-TPU,
-    # xla-bucketed on a mesh or for model families without a ragged
-    # prefill entry point.
+    # pallas-ragged auto-falls back per the fallback matrix in
+    # tpuserve/attention.py: the Pallas kernel on single-chip TPU, the
+    # XLA windowed program off-TPU AND on a mesh (it runs SPMD with KV
+    # sharded on heads), xla-bucketed only for model families without a
+    # ragged prefill entry point; /state exports the resolution + why.
     attention_backend: str = "xla-bucketed"
     # Ragged backend geometry: packed totals pad to multiples of this
     # chunk (plus two sub-chunk rungs for short tails/resumes)...
@@ -453,6 +507,18 @@ class EngineStats:
     device_memory_frac: float = 0.0
     kv_pool_bytes: int = 0
     kv_bytes_in_use: int = 0
+    # mesh serving (ISSUE 10): REAL per-device signals. device_count is
+    # the engine's local device population (1 off-mesh);
+    # device_memory_frac_worst is the max memory_stats fraction across
+    # them — the picker scores the WORST device, not device 0 (one hot
+    # shard saturates the whole tensor-parallel step). The ICI pair is
+    # the analytical per-device collective volume of the TP/EP layout
+    # (parallel/sharding.analytical_ici_bytes_per_token): bytes one
+    # decoded token moves over ICI, and its cumulative total
+    device_count: int = 1
+    device_memory_frac_worst: float = 0.0
+    ici_bytes_per_token: int = 0
+    ici_bytes_total: int = 0
     prefills: int = 0
     sp_prefills: int = 0  # prefills routed through ring attention
     chunked_prefill_steps: int = 0  # intermediate chunk device steps
@@ -740,17 +806,84 @@ class Engine:
         self._cur_window = cfg.decode_steps_per_tick
         self._steady_ticks = 0
 
+        # per-device accounting (ISSUE 10): bytes of model weights each
+        # device actually holds (measured from shard layouts — the
+        # bench's per-device-bytes ≈ total/tp claim), the analytical
+        # per-device ICI collective volume of one decoded token, and
+        # the rolling per-device stats list _refresh_stats maintains
+        self.param_bytes_by_device = _per_device_bytes(self.params)
+        from aigw_tpu.parallel.sharding import (
+            analytical_ici_bytes_per_token,
+        )
+
+        act_bytes = 2
+        for v in self.params.values():
+            act_bytes = jnp.dtype(v.dtype).itemsize
+            break
+        self.ici_bytes_per_token = analytical_ici_bytes_per_token(
+            model_cfg, mesh, act_bytes)
+        self.stats.ici_bytes_per_token = self.ici_bytes_per_token
+        self.device_stats: list[dict] = []
+
         mc, ps = model_cfg, cfg.page_size
         K = cfg.decode_steps_per_tick
-        # ragged paged-attention kernel: single-chip decode only (under
-        # GSPMD the sharded gather path stays)
+        # decode attention impl resolution (the /state-exported half of
+        # the fallback matrix — tpuserve/attention.py documents the
+        # prefill half): the ragged paged-attention Pallas DECODE kernel
+        # is a single-chip program (its DMA pipeline addresses one local
+        # KV pool; there is no shard_map port), so on a mesh the decode
+        # hot loop keeps the GSPMD gather path — KV is sharded on heads,
+        # so gathers stay device-local and the step needs no extra
+        # collective beyond the layer all-reduces.
         attn_impl = "pallas" if (cfg.pallas_attn and mesh is None) else ""
         if cfg.pallas_attn and mesh is not None:
+            self.decode_attn_impl = "xla-gather"
+            self.decode_attn_reason = (
+                "pallas_attn requested but the engine runs on a mesh: "
+                "the Pallas decode kernel has no shard_map port; the "
+                "GSPMD gather path keeps KV reads local to each head "
+                "shard")
             logger.warning("pallas_attn ignored: engine runs on a mesh "
                            "(sharded gather path is used)")
+        elif attn_impl == "pallas":
+            self.decode_attn_impl = "pallas"
+            self.decode_attn_reason = "pallas_attn requested, single chip"
+        else:
+            self.decode_attn_impl = "xla-gather"
+            self.decode_attn_reason = "default (pallas_attn off)"
 
         model_prefill = self.fns.prefill
         model_decode = self.fns.decode_step
+
+        # Mesh jit-cache discipline (ISSUE 10): the per-slot decode
+        # state chains through donated programs, and GSPMD is free to
+        # give output leaves shardings that differ from the host-built
+        # state's placement — the NEXT dispatch then misses the jit
+        # cache on layout alone and compiles ON THE HOT PATH (the
+        # CompileTracker caught the verify ladder doing exactly this at
+        # second dispatch). Pinning every state leaf to one canonical
+        # sharding — replicated; the state is small next to params/KV —
+        # both at build time (device_put) and at every program output
+        # (with_sharding_constraint inside the jitted fn) makes the
+        # cache key a pure function of shape, exactly like single-chip.
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            state_sharding = NamedSharding(mesh, PartitionSpec())
+
+            def _pin_state(st: dict) -> dict:
+                return {
+                    k: jax.lax.with_sharding_constraint(v, state_sharding)
+                    for k, v in st.items()
+                }
+        else:
+            state_sharding = None
+
+            def _pin_state(st: dict) -> dict:
+                return st
+
+        self._state_sharding = state_sharding
+        self._pin_state = _pin_state
 
         def _sample_maybe_lp(logits, keys, temp, top_p, top_k):
             """Sample; with logprobs enabled also return (chosen, top-k
@@ -869,7 +1002,7 @@ class Engine:
                     lambda c, _: body(params, lora, c),
                     (kv, state), None, length=k
                 )
-                return sampled, state, kv
+                return sampled, _pin_state(state), kv
 
             return scan_k
 
@@ -989,7 +1122,7 @@ class Engine:
                 (kv, state), out = jax.lax.scan(
                     lambda c, _: body(params, lora, c),
                     (kv, state), None, length=k_steps)
-                return out, state, kv
+                return out, _pin_state(state), kv
 
             return scan_k
 
@@ -1008,14 +1141,32 @@ class Engine:
         # in {xla, pallas} overrides for A/B and parity tests).
         self._prefill_ragged_fn = None
         self._ragged_impl = ""
+        self._ragged_reason = "model family has no ragged prefill"
         model_prefill_ragged = self.fns.prefill_ragged
-        if model_prefill_ragged is not None and mesh is None:
+        if model_prefill_ragged is not None:
             from aigw_tpu.ops.pallas._compat import is_tpu_backend
 
             impl = os.environ.get("AIGW_RAGGED_PREFILL_IMPL", "").lower()
             if impl not in ("xla", "pallas"):
-                impl = "pallas" if is_tpu_backend() else "xla"
+                impl = ("pallas" if is_tpu_backend() and mesh is None
+                        else "xla")
+            if impl == "pallas" and mesh is not None:
+                # the kernel's scalar-prefetch page walk addresses ONE
+                # local pool — honor the explicit override only where
+                # it can run
+                impl = "xla"
             self._ragged_impl = "" if impl == "xla" else "pallas"
+            if self._ragged_impl == "pallas":
+                self._ragged_reason = "Pallas kernel (single-chip TPU)"
+            elif mesh is not None:
+                self._ragged_reason = (
+                    "XLA windowed fallback: the Pallas ragged-prefill "
+                    "kernel is single-chip (scalar-prefetch page walk "
+                    "over one local pool); the windowed program runs "
+                    "SPMD with KV sharded on heads")
+            else:
+                self._ragged_reason = (
+                    "XLA windowed fallback: no TPU backend")
             ragged_impl = self._ragged_impl
 
             def _prefill_ragged_step(params, lora, tokens, row_seq,
@@ -1045,6 +1196,9 @@ class Engine:
         from aigw_tpu.tpuserve.attention import make_attention_backend
 
         self.attn = make_attention_backend(self)
+        # populate the per-device /state surface before any traffic
+        # (telemetry consumers poll a freshly booted replica)
+        self._refresh_stats()
 
     def _decode_fn_for(self, k: int, lean: bool = False,
                        draft: int = 0):
@@ -1180,12 +1334,20 @@ class Engine:
             for s in self._slots
         )
 
-    def _prefill_bucket(self, n: int) -> int:
+    def _prefill_bucket(self, n: int, multiple_of: int = 1) -> int:
         """Smallest prefill-ladder rung covering ``n`` prompt tokens.
         Rungs are powers of two of min_prefill_bucket plus, with
         prefill_bucket_rungs > 1, intermediate rungs at 1.5×S (and
         1.25×/1.75×S at 4) — prefill compute scales with the padded
-        length, so a tighter rung is a direct TTFT cut."""
+        length, so a tighter rung is a direct TTFT cut.
+
+        ``multiple_of`` is the mesh divisibility guard (ISSUE 10): a
+        program whose padded length an axis shards (ring attention over
+        ``sp``) must divide that axis, but the 1.5×S rungs usually
+        don't — the guard rounds the CHOSEN rung up to the next
+        multiple instead of abandoning the intermediate ladder, so mesh
+        prompts keep the sub-pow2 rungs (a 96-token prompt on sp=8
+        pads to 96, not 128)."""
         cfg = self.cfg
         S = cfg.min_prefill_bucket
         while S < n:
@@ -1199,7 +1361,10 @@ class Engine:
                 S += 3 * S // 4
                 break
             S *= 2
-        return min(S, cfg.max_seq_len)
+        S = min(S, cfg.max_seq_len)
+        if multiple_of > 1 and S % multiple_of:
+            S = -(-S // multiple_of) * multiple_of
+        return S
 
     def _bucket_rungs(self, octave: int) -> list[int]:
         """The prefill-ladder rungs of one octave (octave 0 starts at
@@ -1302,6 +1467,24 @@ class Engine:
         return (mc.n_layers * 2 * self.cfg.page_size * mc.n_kv_heads
                 * mc.head_dim * itemsize)
 
+    def mesh_axes(self) -> dict[str, int]:
+        """Mesh axis name → size ({} off-mesh) — the /state topology
+        export the picker's ICI term reads."""
+        if self.mesh is None:
+            return {}
+        return {k: int(v) for k, v in self.mesh.shape.items()}
+
+    @property
+    def migratable(self) -> bool:
+        """Whether this engine serves /migrate/export|import (needs the
+        refcounted prefix-cache allocator). Layout-independent: on a
+        mesh the page movers gather/scatter the head-sharded pool
+        through the same full-page wire format (the gather assembles
+        all head shards; the scatter re-shards on write) — /state
+        exports this as the ``migration`` capability flag the gateway
+        _Migrator respects."""
+        return isinstance(self.allocator, RefcountedAllocator)
+
     @staticmethod
     def _start_host_copy(tree: Any) -> None:
         """Begin the device→host copy of every array leaf now
@@ -1389,38 +1572,43 @@ class Engine:
         program on the hot path). Records warmup_ms + the compiled
         program count on EngineStats (/state: cold-start observables)."""
         t0 = time.monotonic()
-        for k in self._window_ladder():
-            for lean in (True, False):
-                state = self._build_device_state()
-                _, _, self.kv_cache = self._decode_fn_for(k, lean)(
-                    self.params, self.lora_params, self.kv_cache, state
-                )
-            for d in self._spec_rungs:
-                if d == 0:
-                    continue
-                state = self._build_device_state()
-                _, _, self.kv_cache = self._decode_fn_for(k, False, d)(
-                    self.params, self.lora_params, self.kv_cache, state
-                )
-        # the incremental row-update scatters also run on the hot path
-        # (admission / EOS / rung moves): compile them on a throwaway
-        # state so the first membership change pays nothing
-        state = self._build_device_state()
-        self._dirty_rows.add(0)
-        saved, self._device_state = self._device_state, state
-        self._apply_row_updates()
-        if self._spec_max:
-            self._spec_dirty.add(0)
-            self._apply_spec_row_updates()
-        # the constrained-decoding bias-row scatter also runs on the hot
-        # path (every FSM advance of a constrained slot): compile it on
-        # the same throwaway state
-        if self.cfg.constrained_decoding:
-            V = self.model_cfg.vocab_size
-            self._device_state = self._cn_update_fn_built()(
-                self._device_state, np.int32(0),
-                np.zeros((V,), np.float32))
-        self._device_state = saved
+        for P in self._warm_page_buckets():
+            for k in self._window_ladder():
+                for lean in (True, False):
+                    state = self._build_device_state(bucket=P)
+                    _, _, self.kv_cache = self._decode_fn_for(k, lean)(
+                        self.params, self.lora_params, self.kv_cache,
+                        state
+                    )
+                for d in self._spec_rungs:
+                    if d == 0:
+                        continue
+                    state = self._build_device_state(bucket=P)
+                    _, _, self.kv_cache = self._decode_fn_for(
+                        k, False, d)(
+                        self.params, self.lora_params, self.kv_cache,
+                        state
+                    )
+            # the incremental row-update scatters also run on the hot
+            # path (admission / EOS / rung moves) and re-trace per
+            # page-bucket state shape: compile them on a throwaway
+            # state at THIS bucket so the first membership change at
+            # any warmed bucket pays nothing
+            state = self._build_device_state(bucket=P)
+            self._dirty_rows.add(0)
+            saved, self._device_state = self._device_state, state
+            self._apply_row_updates()
+            if self._spec_max:
+                self._spec_dirty.add(0)
+                self._apply_spec_row_updates()
+            # the constrained-decoding bias-row scatter also runs on
+            # the hot path (every FSM advance of a constrained slot)
+            if self.cfg.constrained_decoding:
+                V = self.model_cfg.vocab_size
+                self._device_state = self._cn_update_fn_built()(
+                    self._device_state, np.int32(0),
+                    np.zeros((V,), np.float32))
+            self._device_state = saved
         if self._adapter_store is not None:
             # the hot-load row scatters run on the admission path: the
             # first non-resident adapter admission (or any later mix
@@ -1437,6 +1625,25 @@ class Engine:
             self._import_pages_dev([0] * r, np.repeat(rows, r, axis=0))
         self.stats.warmup_ms = round(1e3 * (time.monotonic() - t0), 3)
         self.stats.warm_programs = self.compile_tracker.program_count()
+
+    def _warm_page_buckets(self) -> list[int]:
+        """Page buckets warmup() compiles the decode ladder at:
+        [current quiesced bucket] classically, or — with
+        ``warm_decode_buckets`` = N — the pow2 rungs 1, 2, …, 2^(N-1)
+        capped at max_pages_per_seq, so a first admission at ANY
+        covered sequence length never compiles a decode program (or
+        the matching row-update scatter) on the hot path."""
+        n = self.cfg.warm_decode_buckets
+        if n <= 0:
+            return [self._decode_bucket_pages()]
+        buckets: list[int] = []
+        b = 1
+        for _ in range(n):
+            buckets.append(min(b, self.cfg.max_pages_per_seq))
+            if b >= self.cfg.max_pages_per_seq:
+                break
+            b *= 2
+        return sorted(set(buckets))
 
     def _warm_prefill_shapes(self, S: int) -> None:
         """Run the prefill program for every power-of-two group size at
@@ -2186,13 +2393,11 @@ class Engine:
         bucket = min(bucket, self.cfg.max_pages_per_seq)
 
         if use_sp:
-            S = self._prefill_bucket(ns)
-            if S % self._sp:
-                # ring attention shards the padded length over sp —
-                # round the bucket up to a multiple of sp
-                # (non-power-of-two sp like 6 must not silently
-                # disable the path)
-                S = -(-S // self._sp) * self._sp
+            # ring attention shards the padded length over sp — the
+            # divisibility guard rounds the chosen rung up to a
+            # multiple of sp (non-power-of-two sp like 6 must not
+            # silently disable the path, and intermediate rungs stay)
+            S = self._prefill_bucket(ns, multiple_of=self._sp)
             tokens = np.zeros((1, S), np.int32)
             tokens[0, :ns] = suffix
             self.stats.sp_prefills += 1
@@ -2345,12 +2550,15 @@ class Engine:
             bucket *= 2
         return min(bucket, P)
 
-    def _build_device_state(self) -> dict[str, jax.Array]:
+    def _build_device_state(
+            self, bucket: int | None = None) -> dict[str, jax.Array]:
         """Upload the FULL per-slot state (first build, page-bucket
         growth, speculation). Ordinary membership changes go through
-        the incremental row update in _apply_row_updates instead."""
+        the incremental row update in _apply_row_updates instead.
+        ``bucket`` pins the page-table width (warmup pre-compiling the
+        ladder at buckets traffic hasn't reached yet)."""
         B = self.cfg.max_batch_size
-        P = self._decode_bucket_pages()
+        P = bucket if bucket is not None else self._decode_bucket_pages()
         self._state_bucket = P
         tokens = np.zeros((B,), np.int32)
         positions = np.zeros((B,), np.int32)
@@ -2424,7 +2632,7 @@ class Engine:
             state_extra["lookahead"] = jnp.asarray(lookahead)
             state_extra["la_base"] = jnp.asarray(la_base)
             state_extra["la_len"] = jnp.asarray(la_len)
-        return state_extra | {
+        state = state_extra | {
             "tokens": jnp.asarray(tokens),
             "positions": jnp.asarray(positions),
             "limits": jnp.asarray(limits),
@@ -2440,6 +2648,12 @@ class Engine:
             "bias": jnp.asarray(bias),
             "adapter_idx": jnp.asarray(adapter_idx),
         }
+        if self._state_sharding is not None:
+            # canonical placement: fresh builds and program outputs
+            # (pinned by _pin_state) share ONE layout, so a dispatch is
+            # never a layout-only jit-cache miss on the mesh
+            state = jax.device_put(state, self._state_sharding)
+        return state
 
     def _row_host_values(self, i: int, P: int) -> dict[str, np.ndarray]:
         """Host-side row i of the device state (cleared when the slot is
@@ -2513,11 +2727,11 @@ class Engine:
         stalls the decode pipeline for a whole window."""
         if self._row_update_fn is None:
             def _upd(state, i, row):
-                return {
+                return self._pin_state({
                     k: (state[k].at[i].set(row[k]) if k in row
                         else state[k])
                     for k in state
-                }
+                })
 
             self._row_update_fn = self.compile_tracker.register(
                 "row_update", jax.jit(_upd, donate_argnums=(0,)))
@@ -2538,8 +2752,8 @@ class Engine:
         any time."""
         if self._spec_update_fn is None:
             def _sup(state, i, d):
-                return dict(
-                    state, draft_len=state["draft_len"].at[i].set(d))
+                return self._pin_state(dict(
+                    state, draft_len=state["draft_len"].at[i].set(d)))
 
             self._spec_update_fn = self.compile_tracker.register(
                 "spec_row_update", jax.jit(_sup, donate_argnums=(0,)))
@@ -2567,7 +2781,8 @@ class Engine:
     def _cn_update_fn_built(self):
         if self._cn_update_fn is None:
             def _bup(state, i, row):
-                return dict(state, bias=state["bias"].at[i].set(row))
+                return self._pin_state(dict(
+                    state, bias=state["bias"].at[i].set(row)))
 
             self._cn_update_fn = self.compile_tracker.register(
                 "cn_mask_update", jax.jit(_bup, donate_argnums=(0,)))
@@ -3036,6 +3251,43 @@ class Engine:
                 self.cfg.num_pages * self.kv_page_bytes)
             self.stats.kv_bytes_in_use = round(
                 self.stats.kv_pool_bytes * self.allocator.occupancy)
+            # mesh serving (ISSUE 10): EVERY local device, not just
+            # device 0 — per-device memory_stats, the device's real
+            # share of the (head-sharded) KV pool, and its share of the
+            # model weights, plus the worst-device memory fraction the
+            # picker scores
+            occ = self.allocator.occupancy
+            kv_by_dev = _per_device_bytes(self.kv_cache)
+            # only devices this ENGINE occupies (its param/KV shards):
+            # a single-chip engine in a multi-device process reports
+            # one device, not the process's whole population
+            mine = set(self.param_bytes_by_device) | set(kv_by_dev)
+            devs: list[dict] = []
+            worst = 0.0
+            for did, platform, used_d, limit_d in \
+                    device_memory_stats_all():
+                if mine and did not in mine:
+                    continue
+                frac = round(used_d / limit_d, 4) if limit_d else 0.0
+                worst = max(worst, frac)
+                devs.append({
+                    "id": did,
+                    "platform": platform,
+                    "bytes_in_use": used_d,
+                    "bytes_limit": limit_d,
+                    "memory_frac": frac,
+                    "kv_pool_bytes": kv_by_dev.get(did, 0),
+                    "kv_bytes_in_use": round(
+                        kv_by_dev.get(did, 0) * occ),
+                    "kv_occupancy": round(occ, 4),
+                    "param_bytes":
+                        self.param_bytes_by_device.get(did, 0),
+                })
+            self.device_stats = devs
+            self.stats.device_count = max(1, len(devs))
+            self.stats.device_memory_frac_worst = worst
+        self.stats.ici_bytes_total = (
+            self.ici_bytes_per_token * self.stats.tokens_generated)
         young = self.cfg.migration_young_tokens
         self.stats.migratable_slots = sum(
             1 for s in self._slots

@@ -41,6 +41,7 @@ from aigw_tpu.obs.flight import FlightRecorder, RequestTrace
 from aigw_tpu.obs.metrics import (
     GenAIMetrics,
     RequestMetrics,
+    render_device_gauges,
     render_engine_gauges,
 )
 from aigw_tpu.obs.tracing import SpanContext, Tracer, genai_attributes
@@ -1569,6 +1570,34 @@ class TPUServeServer:
                 "device_memory_frac": s.device_memory_frac,
                 "kv_pool_bytes": s.kv_pool_bytes,
                 "kv_bytes_in_use": s.kv_bytes_in_use,
+                # mesh serving (ISSUE 10): real per-device signals —
+                # the mesh topology (axis → size; {} off-mesh), EVERY
+                # local device's memory/KV/param share (not just
+                # device 0), the worst-device memory fraction the
+                # picker scores, the measured per-device parameter
+                # bytes (≈ total/tp under tensor parallelism — the
+                # bench's memory-split claim), and the analytical ICI
+                # collective volume per decoded token
+                "mesh_axes": self.engine.mesh_axes(),
+                "mesh_devices": s.device_count,
+                "devices": self.engine.device_stats,
+                "device_count": s.device_count,
+                "device_memory_frac_worst": s.device_memory_frac_worst,
+                "param_bytes_total": sum(
+                    self.engine.param_bytes_by_device.values()),
+                "param_bytes_per_device": {
+                    str(k): v for k, v in sorted(
+                        self.engine.param_bytes_by_device.items())},
+                "ici_bytes_per_token": s.ici_bytes_per_token,
+                "ici_bytes_total": s.ici_bytes_total,
+                # the resolved attention choices + WHY (the fallback
+                # matrix, tpuserve/attention.py) and the migration
+                # capability flag the gateway _Migrator respects
+                "attention_backend_reason": getattr(
+                    self.engine, "attn_reason", ""),
+                "decode_attn_impl": self.engine.decode_attn_impl,
+                "decode_attn_reason": self.engine.decode_attn_reason,
+                "migration": self.engine.migratable,
                 "active_slots": s.active_slots,
                 "max_slots": self.engine.cfg.max_batch_size,
                 "queued": s.queued,
@@ -1632,6 +1661,7 @@ class TPUServeServer:
     async def _metrics(self, _request: web.Request) -> web.Response:
         body = (self.metrics.export()
                 + render_engine_gauges(self.engine.stats)
+                + render_device_gauges(self.engine.device_stats)
                 + self.engine.phases.render())
         return web.Response(body=body, content_type="text/plain")
 
@@ -1944,6 +1974,7 @@ async def run_tpuserve(
     adaptive_decode_window: bool = True,
     async_transfers: bool = True,
     warm_prefill_buckets: int = 0,
+    warm_decode_buckets: int = 0,
     first_token_fast_path: bool = True,
     prefill_bucket_rungs: int = 2,
     flight_entries: int = 256,
@@ -1971,6 +2002,7 @@ async def run_tpuserve(
             adaptive_decode_window=adaptive_decode_window,
             async_transfers=async_transfers,
             warm_prefill_buckets=warm_prefill_buckets,
+            warm_decode_buckets=warm_decode_buckets,
             first_token_fast_path=first_token_fast_path,
             prefill_bucket_rungs=prefill_bucket_rungs,
             tenant_slot_cap=tenant_slot_cap,

@@ -252,7 +252,9 @@ def _start_tpuserve_subproc(model_name: str, cfg, quantize: str,
                             engine: dict | None = None,
                             page: int = PAGE,
                             param_dtype: str = "",
-                            lora: dict | None = None):
+                            lora: dict | None = None,
+                            tp: int = 1,
+                            env_extra: dict | None = None):
     """Serve `model_name` over the real tpuserve HTTP surface in its own
     process (benchmarks/serve_child.py) — the deployment topology. The
     in-thread variant below shares the bench client's GIL, which on a
@@ -271,14 +273,14 @@ def _start_tpuserve_subproc(model_name: str, cfg, quantize: str,
             "ffn_dim", "max_seq_len", "rope_theta")},
         "batch": batch, "page": page, "k": k_steps, "quantize": quantize,
         "engine": engine or {}, "param_dtype": param_dtype,
-        "lora": lora or {},
+        "lora": lora or {}, "tp": tp,
     }
     here = os.path.dirname(os.path.abspath(__file__))
     proc = subprocess.Popen(
         [sys.executable, os.path.join(here, "benchmarks", "serve_child.py"),
          json.dumps(spec)],
         cwd=here, stdout=subprocess.PIPE, text=True,
-        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        env=dict(os.environ, JAX_PLATFORMS="cpu", **(env_extra or {})),
     )
     import select
 
@@ -1104,6 +1106,176 @@ def ragged_prefill_numbers(reps: int = 3, gen_tokens: int = 8) -> dict:
     finally:
         stop_rag()
         stop_bkt()
+
+
+# -- mesh leg: tensor-parallel serving A/B (ISSUE 10) ---------------------
+
+#: tensor-parallel degree of the mesh child (virtual devices via
+#: XLA_FLAGS on the child env — the flag must precede jax init, which
+#: is why this leg NEEDS the subprocess topology)
+_MESH_TP = 8
+#: n_kv_heads divisible by _MESH_TP so the paged KV pool shards on
+#: heads (one KV head per virtual device at tp=8)
+_MESH_CFG = llama.LlamaConfig(
+    vocab_size=2048, dim=256, n_layers=4, n_heads=8, n_kv_heads=8,
+    ffn_dim=512, max_seq_len=512, rope_theta=10000.0,
+)
+_MESH_PAGE = 32
+#: the timed burst: mixed prompt lengths in tokens (byte tokenizer),
+#: fired concurrently so both children coalesce one admission
+_MESH_MIX = (24, 48, 90, 90, 130, 200)
+
+
+def _mesh_ab_fields(st0: dict, st1: dict, prefix: str) -> dict:
+    """One child's mesh telemetry over a capture, derived from /state
+    deltas (pure — unit-tested by the bench smoke). The parameter-split
+    fraction is worst-device bytes × devices ÷ total: 1.0 = a perfect
+    total/tp split, the bench's ±10% memory claim."""
+    total = int(st1.get("param_bytes_total", 0) or 0)
+    per = st1.get("param_bytes_per_device") or {}
+    n = max(1, len(per))
+    worst = max((int(v) for v in per.values()), default=0)
+    return {
+        f"{prefix}_devices": int(st1.get("mesh_devices", 1) or 1),
+        f"{prefix}_param_bytes_total": total,
+        f"{prefix}_param_bytes_per_device_max": worst,
+        f"{prefix}_param_split_frac": (round(worst * n / total, 4)
+                                       if total else 0.0),
+        f"{prefix}_hot_compiles": (st1.get("xla_compiles", 0)
+                                   - st0.get("xla_compiles", 0)),
+        f"{prefix}_ici_bytes_per_token": int(
+            st1.get("ici_bytes_per_token", 0) or 0),
+    }
+
+
+async def _drive_mesh_burst(s, url: str, model: str, gen_tokens: int,
+                            tag: str) -> tuple[list[str], float]:
+    """Fire the mixed burst concurrently as streaming /v1/completions;
+    returns (per-request full texts in submit order, wall seconds).
+    One slot samples (explicit seed — deterministic across children),
+    one carries a repetition penalty, the rest run greedy: the mixed-
+    feature batch whose streams must be byte-identical mesh vs single."""
+
+    async def one(n_tokens: int, i: int) -> str:
+        text = (f"{tag}{i:02d}" + "x" * n_tokens)[: n_tokens - 1]
+        payload = {
+            "model": model, "prompt": text, "max_tokens": gen_tokens,
+            "temperature": 0.0, "stream": True,
+        }
+        if i == 1:
+            payload.update(temperature=0.8, top_p=0.9, seed=1234 + i)
+        elif i == 2:
+            payload["frequency_penalty"] = 0.6
+        out: list[str] = []
+        async with s.post(url + "/v1/completions", json=payload) as resp:
+            assert resp.status == 200, resp.status
+            while True:
+                line = await resp.content.readline()
+                if not line:
+                    break
+                line = line.strip()
+                if not line.startswith(b"data: "):
+                    continue
+                data = line[6:]
+                if data == b"[DONE]":
+                    break
+                ev = json.loads(data)
+                ch = ev.get("choices") or []
+                if ch and ch[0].get("text"):
+                    out.append(ch[0]["text"])
+        return "".join(out)
+
+    t0 = time.perf_counter()
+    texts = list(await asyncio.gather(
+        *(one(n, i) for i, n in enumerate(_MESH_MIX))))
+    return texts, time.perf_counter() - t0
+
+
+def mesh_numbers(reps: int = 3, gen_tokens: int = 24) -> dict:
+    """The ``mesh`` A/B leg (ISSUE 10): the SAME seeded mixed-feature
+    traffic against TWO tpuserve children — tp=8 over 8 virtual CPU
+    devices (XLA_FLAGS on the child env) vs single-device — f32 params
+    and KV so greedy streams are deterministic. The portable claims:
+
+    - **byte-identity**: every stream matches between the children
+      (the sharded engine is the same engine);
+    - **memory split**: per-device parameter bytes ≈ total/tp (±10%),
+      measured from real shard layouts on /state;
+    - **compile surface**: zero hot XLA compiles on the warmed mesh
+      path over the timed reps.
+
+    ``mesh_vs_single`` throughput is reported with spreads but is
+    INFORMATIONAL on CPU: 8 virtual devices time-slice one host core,
+    so the ratio measures partitioning overhead, not ICI speedup."""
+    import aiohttp
+
+    model_name = "bench-mesh-tiny"
+    engine_common = {
+        "min_prefill_bucket": 32, "kv_cache_dtype": "float32",
+        "max_queued_requests": 64, "admission_coalesce_ms": 20.0,
+        # decode programs re-trace per page bucket: warm the rungs the
+        # mixed burst reaches (≤ 8 pages) so the timed reps stay
+        # compile-free on BOTH children
+        "warm_decode_buckets": 4,
+    }
+    k = int(os.environ.get("AIGW_BENCH_CPU_K", "4"))
+    url_mesh, stop_mesh = _start_tpuserve_subproc(
+        model_name, _MESH_CFG, "", batch=8, k_steps=k,
+        engine=dict(engine_common), page=_MESH_PAGE,
+        param_dtype="float32", tp=_MESH_TP,
+        env_extra={"XLA_FLAGS":
+                   f"--xla_force_host_platform_device_count={_MESH_TP}"})
+    url_one, stop_one = _start_tpuserve_subproc(
+        model_name, _MESH_CFG, "", batch=8, k_steps=k,
+        engine=dict(engine_common), page=_MESH_PAGE,
+        param_dtype="float32")
+
+    async def run() -> dict:
+        await _wait_health(url_mesh, 1200)
+        await _wait_health(url_one, 1200)
+        timeout = aiohttp.ClientTimeout(total=1200)
+        async with aiohttp.ClientSession(timeout=timeout) as s:
+            # off-the-clock warm pass (page-bucket growth, singleton
+            # shapes the warmed ladder doesn't cover)
+            for url in (url_mesh, url_one):
+                await _drive_mesh_burst(s, url, model_name, gen_tokens,
+                                        "w")
+            st_mesh0 = await _get_state(s, url_mesh)
+            st_one0 = await _get_state(s, url_one)
+            identical = True
+            mesh_tps, one_tps = [], []
+            for rep in range(reps):
+                m_texts, m_wall = await _drive_mesh_burst(
+                    s, url_mesh, model_name, gen_tokens, f"r{rep}")
+                o_texts, o_wall = await _drive_mesh_burst(
+                    s, url_one, model_name, gen_tokens, f"r{rep}")
+                identical &= m_texts == o_texts
+                n_tok = gen_tokens * len(_MESH_MIX)
+                mesh_tps.append(n_tok / m_wall)
+                one_tps.append(n_tok / o_wall)
+            st_mesh1 = await _get_state(s, url_mesh)
+            st_one1 = await _get_state(s, url_one)
+        m, o = _median(mesh_tps), _median(one_tps)
+        return {
+            "mesh_tp": _MESH_TP,
+            "mesh_byte_identical": identical,
+            "mesh_tokens_per_sec": round(m, 2),
+            "single_tokens_per_sec": round(o, 2),
+            "mesh_vs_single": round(m / o, 4) if o else 0.0,
+            "mesh_tps_spread": round(_spread(mesh_tps), 3),
+            "single_tps_spread": round(_spread(one_tps), 3),
+            "mesh_axes": {a: n for a, n in (
+                st_mesh1.get("mesh_axes") or {}).items() if n > 1},
+            "mesh_ab_reps": reps * len(_MESH_MIX),
+            **_mesh_ab_fields(st_mesh0, st_mesh1, "mesh"),
+            **_mesh_ab_fields(st_one0, st_one1, "single"),
+        }
+
+    try:
+        return asyncio.run(run())
+    finally:
+        stop_mesh()
+        stop_one()
 
 
 # -- lora leg: multi-LoRA adapter serving A/B (ISSUE 7) -------------------
@@ -2100,6 +2272,11 @@ def run_cpu_ratio() -> dict:
     except Exception as e:
         print(f"structured leg failed: {type(e).__name__}: {e}",
               file=sys.stderr)
+    try:
+        res.update(mesh_numbers())
+    except Exception as e:
+        print(f"mesh leg failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
     return res
 
 
@@ -2228,11 +2405,23 @@ def main() -> None:
                 "responses, zero hot XLA compiles, and the mixed/plain "
                 "throughput ratio (constraint bookkeeping price) are "
                 "the signal (CPU backend)")
+        elif target == "mesh":
+            result = mesh_numbers()
+            result["metric"] = (
+                "mesh A/B — tensor-parallel serving at parity (ISSUE "
+                "10): the same seeded mixed-feature streaming traffic "
+                "against a tp=8 child (8 virtual CPU devices, params + "
+                "paged KV sharded per the TP layout) vs a single-"
+                "device child; byte-identical streams, per-device "
+                "parameter bytes ≈ total/tp, and zero hot compiles on "
+                "the warmed mesh path are the signal — the throughput "
+                "ratio is informational on CPU (virtual devices time-"
+                "slice one core)")
         else:
             print(json.dumps({"error": f"unknown --ab target {target!r}; "
                               "supported: prefix_cache, spec_decode, "
                               "ragged_prefill, lora, disagg, "
-                              "slo_routing, structured"}))
+                              "slo_routing, structured, mesh"}))
             return
         print(json.dumps(result))
         return

@@ -124,6 +124,12 @@ def main() -> None:
             model=spec["model"],
             lora_adapters=lora_adapters,
             lora_slots=lora_slots,
+            # tensor-parallel child for the --ab mesh leg: the parent
+            # sets XLA_FLAGS=--xla_force_host_platform_device_count so
+            # this process actually has the devices (the flag must be
+            # in the env BEFORE jax initializes — which is why the
+            # mesh A/B runs through subprocess children at all)
+            tp=int(spec.get("tp", 1)),
             engine_cfg=EngineConfig(
                 max_batch_size=spec["batch"],
                 max_seq_len=cfg.max_seq_len,

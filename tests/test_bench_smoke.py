@@ -157,6 +157,34 @@ def test_bench_ragged_ab_fields():
 
 
 @pytest.mark.bench_smoke
+def test_bench_mesh_ab_fields():
+    """The --ab mesh JSON derives its memory-split + compile telemetry
+    from /state deltas through this pure helper: the split fraction is
+    worst-device bytes × devices ÷ total (1.0 = perfect total/tp
+    split — the ±10% claim checks this field), hot compiles are the
+    xla-counter delta, and an empty capture degrades to zeros."""
+    st0 = {"xla_compiles": 9}
+    st1 = {"xla_compiles": 9, "mesh_devices": 8,
+           "param_bytes_total": 800,
+           "param_bytes_per_device": {str(i): 100 for i in range(8)},
+           "ici_bytes_per_token": 3584}
+    f = bench._mesh_ab_fields(st0, st1, "mesh")
+    assert f["mesh_devices"] == 8
+    assert f["mesh_param_bytes_total"] == 800
+    assert f["mesh_param_bytes_per_device_max"] == 100
+    assert f["mesh_param_split_frac"] == 1.0
+    assert f["mesh_hot_compiles"] == 0
+    assert f["mesh_ici_bytes_per_token"] == 3584
+    # a skewed split prices the worst device, not the mean
+    skew = dict(st1, param_bytes_per_device={
+        "0": 200, **{str(i): 600 / 7 for i in range(1, 8)}})
+    assert bench._mesh_ab_fields(st0, skew, "m")["m_param_split_frac"] \
+        == 2.0
+    z = bench._mesh_ab_fields({}, {}, "z")
+    assert z["z_param_split_frac"] == 0.0 and z["z_devices"] == 1
+
+
+@pytest.mark.bench_smoke
 def test_bench_lora_ab_fields():
     """The --ab lora JSON derives its adapter-subsystem telemetry from
     /state deltas through this pure helper: load/eviction counters must

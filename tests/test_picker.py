@@ -63,6 +63,71 @@ class TestScoring:
         p2.observe("10.0.0.3:8011", kv_occupancy=0.5, max_slots=8)
         assert p2.pick() == "10.0.0.2:8011"
 
+    def test_worst_device_memory_scored_not_device_zero(self):
+        """Mesh serving (ISSUE 10): the score consumes the WORST
+        device's memory fraction from the per-device map — a replica
+        whose device 0 looks idle but whose device 5 holds the hot
+        shard loses to an evenly-loaded sibling, and the explain entry
+        names the consumed value."""
+        p = make_picker()
+        hot = tuple({"id": i, "memory_frac": 0.9 if i == 5 else 0.05}
+                    for i in range(8))
+        cool = tuple({"id": i, "memory_frac": 0.2} for i in range(8))
+        # device-0 scalar says replica 1 is the CALMER one — only the
+        # per-device map reveals its hot shard
+        p.observe("10.0.0.1:8011", kv_occupancy=0.3, max_slots=8,
+                  hbm_frac=0.05, devices=hot)
+        p.observe("10.0.0.2:8011", kv_occupancy=0.3, max_slots=8,
+                  hbm_frac=0.2, devices=cool)
+        p.observe("10.0.0.3:8011", kv_occupancy=0.9, max_slots=8)
+        explain: dict = {}
+        assert p.pick(explain=explain) == "10.0.0.2:8011"
+        assert explain["hbm_frac_worst"] == 0.2
+
+    def test_worst_device_kv_occupancy_scored(self):
+        """Per-device KV occupancy: the scalar gauge can under-report a
+        replica whose per-device map shows a fuller pool — the worst
+        device prices it."""
+        p = make_picker()
+        p.observe("10.0.0.1:8011", kv_occupancy=0.1, max_slots=8,
+                  devices=({"id": 0, "kv_occupancy": 0.95},))
+        p.observe("10.0.0.2:8011", kv_occupancy=0.3, max_slots=8,
+                  devices=({"id": 0, "kv_occupancy": 0.3},))
+        p.observe("10.0.0.3:8011", kv_occupancy=0.9, max_slots=8)
+        assert p.pick() == "10.0.0.2:8011"
+        # replicas without per-device data keep the scalar ordering
+        p2 = make_picker()
+        p2.observe("10.0.0.1:8011", kv_occupancy=0.9, max_slots=8)
+        p2.observe("10.0.0.2:8011", kv_occupancy=0.1, max_slots=8)
+        p2.observe("10.0.0.3:8011", kv_occupancy=0.5, max_slots=8)
+        assert p2.pick() == "10.0.0.2:8011"
+
+    def test_mesh_signals_polled_from_state(self, tpuserve_url):
+        """devices / worst-device frac / migration capability ride the
+        live /state poll into EndpointState."""
+        async def main():
+            host = tpuserve_url.replace("http://", "")
+            p = EndpointPicker([Endpoint(host)], poll_interval=0.1)
+            await p.start()
+            try:
+                for _ in range(100):
+                    st = p.state[host]
+                    if st.healthy and st.devices:
+                        break
+                    await asyncio.sleep(0.1)
+                assert st.healthy
+                assert st.devices, "per-device map never polled"
+                assert {"id", "memory_frac", "kv_occupancy"} <= set(
+                    st.devices[0])
+                assert st.mesh_devices >= 1
+                assert st.migration_capable is True
+                assert 0.0 <= st.worst_hbm_frac() <= 1.0
+                assert st.worst_kv_occupancy() >= st.kv_occupancy
+            finally:
+                await p.stop()
+
+        asyncio.run(main())
+
     def test_memory_signal_polled_from_state(self, tpuserve_url):
         """device_memory_frac + capability flags ride the live /state
         poll into EndpointState."""

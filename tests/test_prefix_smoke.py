@@ -597,3 +597,77 @@ def test_state_and_metrics_export_memory_signals(smoke_url):
     text = asyncio.run(_get(smoke_url, "/metrics")).decode()
     for gauge in MEMORY_GAUGES:
         assert gauge in text, f"/metrics lost {gauge}"
+
+
+# mesh serving surface (ISSUE 10): topology + per-device signals must
+# export even on a single-device replica (empty axes, one device) so
+# the picker's worst-device scoring degrades cleanly off-mesh
+MESH_STATE_FIELDS = (
+    "mesh_axes",
+    "mesh_devices",
+    "devices",
+    "device_count",
+    "device_memory_frac_worst",
+    "param_bytes_total",
+    "param_bytes_per_device",
+    "ici_bytes_per_token",
+    "ici_bytes_total",
+    "attention_backend_reason",
+    "decode_attn_impl",
+    "decode_attn_reason",
+    "migration",
+)
+
+MESH_GAUGES = (
+    "tpuserve_device_count",
+    "tpuserve_device_memory_frac_worst",
+    "tpuserve_ici_bytes_per_token",
+    "tpuserve_ici_bytes_total",
+)
+
+
+def test_state_and_metrics_export_mesh_signals(smoke_url):
+    """The mesh-serving surface on a SINGLE-device replica: topology
+    empty, exactly one per-device entry carrying the full key set the
+    per-device gauges render from, migration capability true (prefix
+    cache on), and the decode-attn resolution fields populated."""
+    from aigw_tpu.obs.metrics import DEVICE_GAUGES
+
+    state = json.loads(asyncio.run(_get(smoke_url, "/state")))
+    for field in MESH_STATE_FIELDS:
+        assert field in state, f"/state lost {field}"
+    assert state["mesh_axes"] == {}
+    assert state["device_count"] == 1
+    assert len(state["devices"]) == 1
+    dev = state["devices"][0]
+    for key, _name in DEVICE_GAUGES:
+        assert key in dev, f"per-device entry lost {key}"
+    assert state["param_bytes_total"] > 0
+    assert state["param_bytes_per_device"]
+    assert state["ici_bytes_per_token"] == 0  # unsharded: no ICI
+    assert state["migration"] is True
+    assert state["decode_attn_impl"] in ("xla-gather", "pallas")
+    text = asyncio.run(_get(smoke_url, "/metrics")).decode()
+    for gauge in MESH_GAUGES:
+        assert gauge in text, f"/metrics lost {gauge}"
+    # labeled per-device gauges render for every authoritative entry
+    for _key, name in DEVICE_GAUGES:
+        assert f'{name}{{device="' in text, f"/metrics lost {name}"
+
+
+def test_device_gauges_map_matches_engine_device_stats():
+    """Every DEVICE_GAUGES key must exist in the engine's per-device
+    stats dicts — a renamed key silently drops a labeled gauge."""
+    from aigw_tpu.models.registry import get_model_spec
+    from aigw_tpu.obs.metrics import DEVICE_GAUGES
+
+    spec = get_model_spec("tiny-random")
+    params = llama.init_params(jax.random.PRNGKey(0), spec.config)
+    eng = Engine(params, spec.config, EngineConfig(
+        max_batch_size=2, max_seq_len=256, page_size=16,
+        min_prefill_bucket=16))
+    assert eng.device_stats, "per-device stats empty at construction"
+    for dev in eng.device_stats:
+        for key, _name in DEVICE_GAUGES:
+            assert key in dev, (
+                f"DEVICE_GAUGES key {key!r} missing from device_stats")
