@@ -345,6 +345,13 @@ def main(argv: list[str] | None = None) -> int:
                               "(prefill done, decode young — the "
                               "gateway's disaggregation signal; 0 = "
                               "every decoding slot counts)")
+    p_serve.add_argument("--kv-host-bytes", type=int, default=0,
+                         help="byte budget of the host-RAM KV spill "
+                              "tier (ISSUE 11): cache-registered pages "
+                              "evicted under pool pressure are copied "
+                              "device->host and revived by later "
+                              "prefix hits instead of recomputed; 0 "
+                              "disables the tier")
     p_serve.add_argument("--platform", default="",
                          help="force a JAX platform (e.g. cpu for the "
                               "fake-chip mode; default: auto/TPU)")
@@ -907,6 +914,7 @@ async def _run_tpuserve(args: argparse.Namespace) -> int:
         enable_profile_endpoint=args.enable_profile_endpoint,
         migration_young_tokens=args.migration_young_tokens,
         constrained_decoding=not args.no_constrained_decoding,
+        kv_host_bytes=args.kv_host_bytes,
     )
     print(f"tpuserve listening on http://{args.host}:{args.port}", flush=True)
     await _wait_for_signal()

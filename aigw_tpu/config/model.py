@@ -330,6 +330,13 @@ class Backend:
     # …and only sessions still young (streamed tokens ≤ this): mature
     # decodes have amortized their prefill and aren't worth moving.
     migration_young_tokens: int = 32
+    # Fleet KV memory hierarchy (ISSUE 11): maintain a chain-hash →
+    # replica index from the replicas' polled /state digests and name
+    # chain-holding siblings in the x-aigw-kv-peers header so a prefix
+    # miss on the chosen replica becomes a cross-replica page fetch.
+    # Costs nothing against replicas that don't advertise chains;
+    # False suppresses the peers header entirely.
+    kv_fleet: bool = True
     auth: AuthConfig = AuthConfig()
     header_mutation: HeaderMutation = HeaderMutation()
     body_mutation: BodyMutation = BodyMutation()
@@ -366,6 +373,7 @@ class Backend:
                     value.get("migration_queue_depth", 2)),
                 migration_young_tokens=int(
                     value.get("migration_young_tokens", 32)),
+                kv_fleet=bool(value.get("kv_fleet", True)),
                 auth=AuthConfig.parse(value.get("auth")),
                 header_mutation=HeaderMutation.parse(value.get("header_mutation")),
                 body_mutation=BodyMutation.parse(value.get("body_mutation")),
@@ -396,6 +404,8 @@ class Backend:
             d["migration_queue_depth"] = self.migration_queue_depth
         if self.migration_young_tokens != 32:
             d["migration_young_tokens"] = self.migration_young_tokens
+        if not self.kv_fleet:
+            d["kv_fleet"] = False
         if self.auth.kind is not AuthKind.NONE:
             d["auth"] = self.auth.to_dict()
         if self.header_mutation != HeaderMutation():

@@ -59,6 +59,8 @@ from typing import Any
 
 import aiohttp
 
+from aigw_tpu.gateway.kvindex import KVIndex
+
 logger = logging.getLogger(__name__)
 
 #: request header carrying a session affinity key (optional)
@@ -81,6 +83,21 @@ ADAPTER_HEADER = "x-aigw-adapter"
 #: replica's fairness guard and the gateway's quota/cost accounting key
 #: on the same tenant
 TENANT_HEADER = "x-aigw-tenant"
+
+#: KV chain-hash header (ISSUE 11): the hex content hash of the
+#: request's first prompt page. Usually LEARNED, not client-set — each
+#: tpuserve response carries it, and the picker remembers (prefix-head
+#: → chain) so later requests sharing the prefix head resolve to a
+#: chain the fleet index can locate. A client/test may also set it
+#: directly. Replicas the index says hold the chain get the bounded
+#: fleet-hit bonus and are named as fetch peers.
+KV_CHAIN_HEADER = "x-aigw-kv-chain"
+
+#: upstream request header naming sibling replicas that hold the
+#: request's chain (comma-separated "host:port") — the chosen replica
+#: fetches missing prefix pages from them over POST /kv/pages instead
+#: of re-prefilling (tpuserve/server.py consumes it)
+KV_PEERS_HEADER = "x-aigw-kv-peers"
 
 
 class SLOShedError(Exception):
@@ -160,6 +177,10 @@ class EndpointState:
     hbm_frac_worst: float = 0.0
     mesh_devices: int = 1
     migration_capable: bool = True
+    # KV memory hierarchy (ISSUE 11): the replica's advertised chain-
+    # hash digest (resident + host-spilled) polled from /state — fed
+    # into the picker's fleet-wide KVIndex on every poll
+    kv_chains: tuple = ()
     updated_at: float = 0.0
 
     def worst_hbm_frac(self) -> float:
@@ -220,6 +241,15 @@ class EndpointPicker:
         self._prefix_affinity: "collections.OrderedDict[str, str]" = (
             collections.OrderedDict()
         )
+        # fleet-wide chain-hash → replica index (ISSUE 11), fed by the
+        # kv_chains digests this poll loop already collects
+        self.kv_index = KVIndex()
+        # prefix hash → KV chain hash learned from tpuserve response
+        # headers (x-aigw-kv-chain): resolves a request's prefix head
+        # to the content chain the index can locate, LRU-bounded
+        self._prefix_chain: "collections.OrderedDict[str, str]" = (
+            collections.OrderedDict()
+        )
         self._task: asyncio.Task | None = None
 
     # -- polling ----------------------------------------------------------
@@ -254,10 +284,14 @@ class EndpointPicker:
             async with session.get(f"http://{e.address}/state") as resp:
                 if resp.status != 200:
                     st.healthy = False
+                    self.kv_index.remove(e.address)
                     return
                 data = await resp.json()
         except (aiohttp.ClientError, asyncio.TimeoutError):
             st.healthy = False
+            # expiry on replica death: a fetch pointed at a dead
+            # sibling only wastes the fetch timeout
+            self.kv_index.remove(e.address)
             return
         st.healthy = True
         st.kv_occupancy = float(data.get("kv_occupancy", 0.0))
@@ -283,6 +317,9 @@ class EndpointPicker:
             data.get("adapters_resident") or ())
         st.adapters_registered = tuple(
             data.get("adapters_registered") or ())
+        st.kv_chains = tuple(
+            str(k) for k in (data.get("kv_chains") or ()))
+        self.kv_index.update(e.address, st.kv_chains)
         st.updated_at = time.monotonic()
 
     # -- manual state injection (tests / push-based telemetry) ------------
@@ -299,7 +336,8 @@ class EndpointPicker:
                 hbm_frac: float = 0.0,
                 hbm_frac_worst: float = 0.0,
                 devices: tuple = (),
-                migration_capable: bool = True) -> None:
+                migration_capable: bool = True,
+                kv_chains: tuple = ()) -> None:
         st = self.state[address]
         st.healthy = True
         st.kv_occupancy = kv_occupancy
@@ -324,6 +362,9 @@ class EndpointPicker:
             st.model = model
         if adapters_registered:
             st.adapters_registered = tuple(adapters_registered)
+        if kv_chains:
+            st.kv_chains = tuple(kv_chains)
+            self.kv_index.update(address, st.kv_chains)
         st.updated_at = time.monotonic()
 
     # -- picking ----------------------------------------------------------
@@ -350,6 +391,16 @@ class EndpointPicker:
     #: Below PREFIX_AFFINITY_BONUS: a resident adapter is cheaper to
     #: recreate than a warm KV prefix, and any replica can load it.
     ADAPTER_AFFINITY_BONUS = 0.2
+    #: fleet-hit locality (ISSUE 11): bonus toward replicas the KVIndex
+    #: says HOLD this request's chain (resident or host-spilled) —
+    #: landing there serves the prefix from local memory, landing
+    #: elsewhere costs a cross-replica page fetch. Deliberately BELOW
+    #: session stickiness (a session's exact-KV replica always
+    #: outranks a chain sibling) and ABOVE adapter affinity (warm KV
+    #: pages are dearer to recreate than a LoRA row); like the other
+    #: affinities it is a constant against unbounded load terms, so it
+    #: never beats saturation.
+    KV_FLEET_BONUS = 0.25
     _AFFINITY_MAX = 100_000
 
     # -- slo mode (ISSUE 8) -------------------------------------------------
@@ -360,6 +411,7 @@ class EndpointPicker:
     #: slice costs ICI→DCN on any future KV transfer.
     PREFIX_AFFINITY_BONUS_MS = 100.0
     ADAPTER_AFFINITY_BONUS_MS = 50.0
+    KV_FLEET_BONUS_MS = 75.0
     SLICE_PENALTY_MS = 50.0
     #: a sticky session stays put unless its replica's predicted TTFT
     #: exceeds the best candidate's by this much
@@ -387,6 +439,48 @@ class EndpointPicker:
                 return None
         rounds = -(-(st.queued + 1) // max(1, st.max_slots))
         return st.queue_wait_ms + pf * rounds
+
+    # -- KV memory hierarchy (ISSUE 11) -----------------------------------
+    def note_chain(self, prefix_key: str, chain_hex: str) -> None:
+        """Learn (prefix-head hash → KV chain hash) from a tpuserve
+        response's x-aigw-kv-chain header: the next request sharing the
+        prefix head resolves to a chain the fleet index can locate."""
+        if not prefix_key or not chain_hex:
+            return
+        self._prefix_chain[prefix_key] = chain_hex
+        self._prefix_chain.move_to_end(prefix_key)
+        while len(self._prefix_chain) > self._AFFINITY_MAX:
+            self._prefix_chain.popitem(last=False)
+
+    def _chain_for(self, headers: dict[str, str] | None) -> str:
+        """The request's KV chain hash: an explicit x-aigw-kv-chain
+        header wins, else the chain learned for its prefix-head hash
+        ("" = unknown — fleet terms vanish)."""
+        h = headers or {}
+        chain = h.get(KV_CHAIN_HEADER, "")
+        if chain:
+            return chain
+        pkey = h.get(PREFIX_HEADER, "")
+        return self._prefix_chain.get(pkey, "") if pkey else ""
+
+    def kv_peers(self, chosen: str,
+                 headers: dict[str, str] | None = None,
+                 limit: int = 3) -> list[str]:
+        """Sibling replicas the fleet index says hold this request's
+        chain (healthy, fresh, excluding the chosen replica) — the
+        gateway names them in x-aigw-kv-peers so a prefix miss on
+        ``chosen`` becomes a cross-replica page fetch."""
+        chain = self._chain_for(headers)
+        if not chain:
+            return []
+        now = time.monotonic()
+        out = []
+        for addr in sorted(self.kv_index.replicas(chain)):
+            st = self.state.get(addr)
+            if (addr != chosen and st is not None and st.healthy
+                    and now - st.updated_at < self.STALE_AFTER):
+                out.append(addr)
+        return out[:limit]
 
     def _slice_of(self, addr: str) -> str:
         """Effective slice of an endpoint: the slice the replica itself
@@ -416,6 +510,11 @@ class EndpointPicker:
         prefix_addr = (self._prefix_affinity.get(prefix_key)
                        if prefix_key else None)
         adapter_key = (headers or {}).get(ADAPTER_HEADER, "")
+        # fleet-hit locality (ISSUE 11): replicas the index says hold
+        # this request's KV chain — resident or host-spilled
+        kv_chain = self._chain_for(headers)
+        kv_holders = (self.kv_index.replicas(kv_chain) if kv_chain
+                      else frozenset())
         # the slice to prefer: where the session's replica lives —
         # meaningful even when that replica is unhealthy (failover
         # should land on a same-slice sibling)
@@ -454,6 +553,11 @@ class EndpointPicker:
                 # here — serving elsewhere pays a hot load (and may
                 # evict a warm adapter on the other replica)
                 score -= self.ADAPTER_AFFINITY_BONUS
+            if e.address in kv_holders:
+                # fleet-hit locality: this replica holds the chain's
+                # KV (resident or spilled) — serving here skips both
+                # the re-prefill AND the cross-replica fetch
+                score -= self.KV_FLEET_BONUS
             return score
 
         scores = {e.address: score_of(e) for e in self.endpoints}
@@ -496,6 +600,8 @@ class EndpointPicker:
                 if adapter_key and adapter_key in \
                         self.state[a].adapters_resident:
                     v -= self.ADAPTER_AFFINITY_BONUS_MS
+                if a in kv_holders:
+                    v -= self.KV_FLEET_BONUS_MS
                 adj[a] = v
             chosen = min(adj, key=adj.__getitem__)
             if (prev_addr in adj and adj[prev_addr]
@@ -513,6 +619,7 @@ class EndpointPicker:
                     and bool(prefix_key),
                     adapter_affinity=bool(adapter_key) and adapter_key
                     in self.state[chosen].adapters_resident,
+                    kv_fleet_hit=chosen in kv_holders,
                 )
         elif not fresh:
             # no telemetry (cold start / all down): round-robin blindly
@@ -544,6 +651,7 @@ class EndpointPicker:
                     and bool(prefix_key),
                     adapter_affinity=bool(adapter_key) and adapter_key
                     in self.state[chosen].adapters_resident,
+                    kv_fleet_hit=chosen in kv_holders,
                 )
         if affinity_key:
             self._affinity[affinity_key] = chosen

@@ -47,6 +47,8 @@ from aigw_tpu.gateway.mutators import apply_body_mutation, apply_header_mutation
 from aigw_tpu.gateway.picker import (
     ADAPTER_HEADER,
     AFFINITY_HEADER,
+    KV_CHAIN_HEADER,
+    KV_PEERS_HEADER,
     PREFIX_HEADER,
     TENANT_HEADER,
     Endpoint as PickerEndpoint,
@@ -895,6 +897,7 @@ class GatewayServer:
         # contract, post_cluster_modify.go:67-80) wins; otherwise the
         # in-process picker chooses a replica from the backend's pool.
         dest = request.headers.get(DESTINATION_ENDPOINT_HEADER, "")
+        prefix_key_used = ""
         if not dest and backend.name in self._pickers:
             pick_headers = client_headers
             if backend.picker_content_affinity and isinstance(body, dict):
@@ -951,6 +954,16 @@ class GatewayServer:
                 for k, v in (explain or {}).items():
                     span.set(f"aigw.pick.{k}",
                              json.dumps(v) if isinstance(v, dict) else v)
+            prefix_key_used = pick_headers.get(PREFIX_HEADER, "")
+            if dest and backend.kv_fleet:
+                # KV memory hierarchy (ISSUE 11): name the siblings the
+                # fleet index says hold this request's chain — a prefix
+                # miss on the chosen replica then becomes a page fetch
+                # over /kv/pages instead of a re-prefill
+                peers = self._pickers[backend.name].kv_peers(
+                    dest, pick_headers)
+                if peers:
+                    headers[KV_PEERS_HEADER] = ",".join(peers)
         base_url = f"http://{dest}" if dest else backend.url
         if not base_url:
             raise _RetriableUpstreamError(
@@ -1029,6 +1042,14 @@ class GatewayServer:
             # line against the replica's /debug/requests/{id} timeline
             req_metrics.upstream_request_id = resp.headers.get(
                 "x-aigw-request-id", "")
+            if backend.name in self._pickers:
+                # learn (prefix-head → KV chain) from the replica's
+                # response — the fleet index can then locate this
+                # prompt head's chain for later requests (ISSUE 11)
+                chain_hex = resp.headers.get(KV_CHAIN_HEADER, "")
+                if chain_hex and prefix_key_used:
+                    self._pickers[backend.name].note_chain(
+                        prefix_key_used, chain_hex)
             ctype = resp.headers.get("content-type", "")
             upstream_streams = tx.stream and (
                 "text/event-stream" in ctype

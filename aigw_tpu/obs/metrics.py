@@ -179,6 +179,21 @@ ENGINE_GAUGES: tuple[tuple[str, str], ...] = (
     ("migration_pages_out", "tpuserve_migration_pages_out_total"),
     ("migration_pages_in", "tpuserve_migration_pages_in_total"),
     ("migratable_slots", "tpuserve_migratable_slots"),
+    # KV memory hierarchy (ISSUE 11, tpuserve/kvhost.py): host-spill-
+    # tier churn (pages demoted on eviction / promoted back by prefix
+    # hits / dropped by the host LRU budget), its live occupancy and
+    # byte budget, and cross-replica /kv/pages fetch traffic in both
+    # directions
+    ("kv_spills", "tpuserve_kv_spills_total"),
+    ("kv_revives", "tpuserve_kv_revives_total"),
+    ("kv_spill_evictions", "tpuserve_kv_spill_evictions_total"),
+    ("kv_spilled_pages", "tpuserve_kv_spilled_pages"),
+    ("kv_spill_bytes", "tpuserve_kv_spill_bytes"),
+    ("kv_host_bytes", "tpuserve_kv_host_bytes"),
+    ("kv_fetches_out", "tpuserve_kv_fetches_out_total"),
+    ("kv_fetches_in", "tpuserve_kv_fetches_in_total"),
+    ("kv_fetch_pages_out", "tpuserve_kv_fetch_pages_out_total"),
+    ("kv_fetch_pages_in", "tpuserve_kv_fetch_pages_in_total"),
     # multi-tenant fairness: distinct tenants holding decode slots, the
     # largest per-tenant in-flight count, and admissions the per-tenant
     # slot cap deferred (each deferral = one pass a request waited)

@@ -282,6 +282,16 @@ class EngineConfig:
     # server 400 such requests instead (the pre-subsystem contract,
     # minus the silent free-text 200).
     constrained_decoding: bool = True
+    # KV memory hierarchy (ISSUE 11, tpuserve/kvhost.py): byte budget of
+    # the host-RAM spill tier. When > 0 (and the prefix cache is on), a
+    # cache-registered page reclaimed under pool pressure is copied
+    # device→host and parked in a bounded LRU keyed by its content
+    # chain hash instead of being dropped; a later prefix hit on a
+    # spilled chain revives the pages through the warmed batched import
+    # scatters (no recompute, no hot XLA compile). 0 disables the tier
+    # (classic eviction). The budget counts page bytes in the pool's
+    # native KV dtype.
+    kv_host_bytes: int = 0
     # Per-token logprobs (vLLM/OpenAI parity): when > 0, the decode scan
     # also returns the chosen token's log-probability and the top-k
     # (ids, values) per step, and requests may set want_logprobs. Static
@@ -519,6 +529,24 @@ class EngineStats:
     device_memory_frac_worst: float = 0.0
     ici_bytes_per_token: int = 0
     ici_bytes_total: int = 0
+    # KV memory hierarchy (ISSUE 11): the host-RAM spill tier and the
+    # cross-replica page fetch surface. Spills/revives/spill-evictions
+    # mirror the HostKVTier counters (pages demoted to host RAM on
+    # eviction, pages promoted back by a prefix hit, pages the host
+    # LRU budget dropped); the live pair is what the tier holds NOW.
+    # Fetches count cross-replica /kv/pages traffic: _out = page sets
+    # this replica served to siblings, _in = page sets imported from a
+    # sibling ahead of a local prefill.
+    kv_spills: int = 0
+    kv_revives: int = 0
+    kv_spill_evictions: int = 0
+    kv_spilled_pages: int = 0
+    kv_spill_bytes: int = 0
+    kv_host_bytes: int = 0
+    kv_fetches_out: int = 0
+    kv_fetches_in: int = 0
+    kv_fetch_pages_out: int = 0
+    kv_fetch_pages_in: int = 0
     prefills: int = 0
     sp_prefills: int = 0  # prefills routed through ring attention
     chunked_prefill_steps: int = 0  # intermediate chunk device steps
@@ -660,6 +688,26 @@ class Engine:
         else:
             self.allocator = PageAllocator(cfg.num_pages, cfg.page_size)
             self.prefix_cache = None
+        # KV memory hierarchy (ISSUE 11, tpuserve/kvhost.py): the
+        # host-RAM spill tier. Eviction demotes registered pages into it
+        # (device→host through the warmed page-export program); a prefix
+        # hit on a spilled chain revives them through the warmed batched
+        # import scatters. Requires the refcounted prefix-cache
+        # allocator — without content addressing there is nothing to
+        # key the tier by.
+        self.host_tier = None
+        if cfg.kv_host_bytes > 0 and self.prefix_cache is not None:
+            from aigw_tpu.tpuserve.kvhost import HostKVTier
+
+            self.host_tier = HostKVTier(cfg.kv_host_bytes)
+            self.prefix_cache.spill_sink = self._spill_page
+        # resident+spilled chain-hash digest, refreshed (throttled) on
+        # the engine thread and read lock-free by /state and the fleet
+        # fetch's presence probe (an atomic tuple swap — a slightly
+        # stale digest costs at most one redundant fetch, which the
+        # import path dedupes)
+        self._kv_digest: tuple[str, ...] = ()
+        self._kv_digest_next = 0.0
         self.stats = EngineStats()
         # serving-phase latency histograms (queue_wait/prefill/ttft/…)
         # with trace-id exemplars — /metrics renders them, /state
@@ -1459,6 +1507,168 @@ class Engine:
             self.kv_cache, jnp.asarray(pages),
             jnp.asarray(stacked, dtype))
 
+    # -- KV memory hierarchy: host spill tier + fleet fetch (ISSUE 11) ----
+    def _spill_page(self, key: bytes, page: int) -> None:
+        """Spill sink wired into PrefixCache eviction: copy the
+        about-to-be-reclaimed page's K/V rows device→host and park them
+        in the host tier under the chain key. Runs synchronously inside
+        the allocator's _pop_page on the ENGINE thread — the page is
+        never handed to its new owner before the copy resolves, and the
+        export program is pre-compiled by warmup() (zero hot XLA
+        compiles across spill churn). The evicted page is refcount-0
+        with every window that could write it already drained, so its
+        device rows are stable."""
+        rows = self._export_page_dev(page)
+        self._start_host_copy([rows])
+        self.host_tier.put(key, np.asarray(rows))
+
+    def _revive_chain(self, chain_keys: list) -> int:
+        """Promote the longest spilled run extending the resident
+        prefix back into the pool: allocate pages, scatter the host
+        rows in ONE warmed batched import call, and register them in
+        the prefix cache (parked evictable — the caller's probe adopts
+        them like any cached prefix). Returns pages revived; 0 under
+        page pressure (the rows are put back and the cold prefill path
+        proceeds)."""
+        tier = self.host_tier
+        resident = len(self.prefix_cache.probe(chain_keys))
+        take: list = []
+        while (resident + len(take) < len(chain_keys)
+               and tier.contains(chain_keys[resident + len(take)])):
+            take.append(chain_keys[resident + len(take)])
+        if not take:
+            return 0
+        # remove from the tier FIRST: an interleaved spill during the
+        # allocation below can never LRU-drop the rows mid-revive
+        rows = []
+        for k in take:
+            r = tier.take(k)
+            if r is None:  # raced away (defensive) — revive what's left
+                break
+            rows.append(r)
+        take = take[: len(rows)]
+        if not rows:
+            return 0
+        seq_id = next(self._seq_ids)
+        try:
+            self.allocator.allocate_extra(seq_id, len(rows))
+        except OutOfPagesError:
+            self.allocator.free(seq_id)
+            for k, r in zip(take, rows):  # hand the rows back
+                tier.put(k, r)
+            return 0
+        page_ids = self.allocator.pages(seq_id)
+        self._import_pages_dev(page_ids, np.stack(rows))
+        self.prefix_cache.insert(take, page_ids)
+        # park evictable: the admission that triggered the revive
+        # re-probes and adopts under the normal refcount discipline
+        self.allocator.free(seq_id)
+        logger.debug("revived %d spilled pages", len(rows))
+        return len(rows)
+
+    def _purge_spilled(self, keys: list) -> None:
+        """Strict tiering: a chain that just became resident through a
+        fresh prefill insert must not also occupy the host budget (a
+        stale copy can linger when an earlier chain key was budget-
+        dropped, so no revive fired on the re-ask)."""
+        if self.host_tier is not None:
+            for k in keys:
+                self.host_tier.discard(k)
+
+    def kv_chain_digest(self) -> tuple:
+        """Hex digest of the chain hashes this replica can serve KV for
+        (resident prefix-cache entries + host-spilled pages) — exported
+        on /state, polled into the gateway's fleet index, and consumed
+        by the fleet fetch's local presence probe. Lock-free: an atomic
+        read of the tuple the engine thread refreshes."""
+        return self._kv_digest
+
+    #: digest size bound: a replica advertises at most this many chains
+    KV_DIGEST_MAX = 4096
+
+    def _refresh_kv_digest(self) -> None:
+        """Engine-thread digest rebuild (throttled by _refresh_stats):
+        the only thread that mutates _by_key and the host tier's key
+        set, so iteration here is race-free."""
+        if self.prefix_cache is None:
+            return
+        keys = list(self.prefix_cache._by_key.keys())
+        if self.host_tier is not None:
+            keys.extend(self.host_tier.keys())
+        out: list[str] = []
+        seen: set = set()
+        for k in keys:
+            if k not in seen:
+                seen.add(k)
+                out.append(k.hex())
+                if len(out) >= self.KV_DIGEST_MAX:
+                    break
+        self._kv_digest = tuple(out)
+
+    def kv_export_pages(self, keys: list, timeout: float = 30.0) -> list:
+        """Serve KV pages by chain hash for a sibling replica's fetch
+        (the /kv/pages endpoint): resident pages are pinned and gathered
+        device→host through the migration export program; spilled pages
+        are served straight from the host tier. Returns [(key, np f32
+        rows)] for every key this replica holds — missing keys are
+        simply absent (the fetcher imports the leading contiguous run).
+        Engine-thread execution via the migration control queue."""
+        box: dict = {"evt": threading.Event()}
+        self._mig_q.put(("fetch", keys, box))
+        self._wake.set()
+        if not box["evt"].wait(timeout):
+            raise TimeoutError("kv page fetch timed out")
+        if "error" in box:
+            raise MigrationError(box["error"])
+        return box["result"]
+
+    def _do_fetch(self, keys: list) -> list:
+        if self.prefix_cache is None:
+            return []
+        out: list = []
+        resident: list = []
+        for k in keys:
+            page = self.prefix_cache._by_key.get(k)
+            if page is not None:
+                resident.append((k, page))
+            elif self.host_tier is not None:
+                rows = self.host_tier.get(k)  # peek — the rung stays
+                if rows is not None:
+                    out.append((k, np.asarray(rows, np.float32)))
+        if resident:
+            # pin for the duration of the device→host copy — the same
+            # export discipline as migration (nothing may free/evict/
+            # CoW these pages mid-transfer)
+            pin = self.allocator.begin_export([p for _, p in resident])
+            try:
+                exported = [(k, self._export_page_dev(p))
+                            for k, p in resident]
+                self._start_host_copy([e for _, e in exported])
+                out.extend((k, np.asarray(e, np.float32))
+                           for k, e in exported)
+            finally:
+                self.allocator.end_export(pin)
+        if out:
+            self.stats.kv_fetches_out += 1
+            self.stats.kv_fetch_pages_out += len(out)
+        return out
+
+    def kv_import_pages(self, tokens: list[int], pages: list,
+                        start: int = 0, timeout: float = 30.0) -> int:
+        """Adopt KV pages fetched from a sibling replica: pages hold
+        chain depths [start, start+len) of ``tokens``'s page chain and
+        are registered as cached (non-live) pages — exactly the
+        migration-import lifecycle, counted as fleet fetches instead.
+        Raises MigrationError / TimeoutError like migrate_import."""
+        box: dict = {"evt": threading.Event()}
+        self._mig_q.put(("import", (tokens, pages, start, "fetch"), box))
+        self._wake.set()
+        if not box["evt"].wait(timeout):
+            raise TimeoutError("kv page import timed out")
+        if "error" in box:
+            raise MigrationError(box["error"])
+        return box["result"]
+
     @property
     def kv_page_bytes(self) -> int:
         """HBM bytes of one KV page (the /state bytes-pinned signal)."""
@@ -1702,7 +1912,7 @@ class Engine:
         OutOfPagesError surfaces as MigrationError("…pages…") so the
         caller can requeue like admission pressure."""
         box: dict = {"evt": threading.Event()}
-        self._mig_q.put(("import", (tokens, pages), box))
+        self._mig_q.put(("import", (tokens, pages, 0, "migration"), box))
         self._wake.set()
         if not box["evt"].wait(timeout):
             raise TimeoutError("migration import timed out")
@@ -1723,6 +1933,8 @@ class Engine:
             try:
                 if kind == "export":
                     box["result"] = self._do_export(payload)
+                elif kind == "fetch":
+                    box["result"] = self._do_fetch(payload)
                 else:
                     box["result"] = self._do_import(*payload)
             except Exception as e:  # noqa: BLE001 — relayed to caller
@@ -1823,12 +2035,16 @@ class Engine:
         return {"blob": blob, "data": data}
 
     def _do_import(self, tokens: list[int],
-                   pages_data: list[np.ndarray]) -> int:
-        """Engine-thread half of migrate_import: allocate pages, scatter
-        the imported rows, register the chain in the prefix cache, then
-        release — the pages park evictable (revivable) until the
-        continuation request's admission probe adopts them. No new page
-        lifecycle: from here on they are ordinary cached prefix pages."""
+                   pages_data: list[np.ndarray], start: int = 0,
+                   source: str = "migration") -> int:
+        """Engine-thread half of migrate_import / kv_import_pages:
+        allocate pages, scatter the imported rows, register the chain in
+        the prefix cache, then release — the pages park evictable
+        (revivable) until an admission probe adopts them. No new page
+        lifecycle: from here on they are ordinary cached prefix pages.
+        ``start`` offsets the chain depth the pages land at (a fleet
+        fetch extends an already-resident prefix); ``source`` picks the
+        counters (migration vs cross-replica fetch)."""
         if self.prefix_cache is None:
             raise MigrationError(
                 "migration import requires the prefix cache")
@@ -1836,10 +2052,10 @@ class Engine:
         k = len(pages_data)
         if k == 0:
             return 0
-        if k > (len(tokens) - 1) // ps:
+        if start < 0 or start + k > (len(tokens) - 1) // ps:
             raise MigrationError(
-                f"{k} pages exceed the written-KV coverage of "
-                f"{len(tokens)} tokens")
+                f"pages [{start}, {start + k}) exceed the written-KV "
+                f"coverage of {len(tokens)} tokens")
         mc = self.model_cfg
         want = (mc.n_layers, 2, ps, mc.n_kv_heads, mc.head_dim)
         for rows in pages_data:
@@ -1847,21 +2063,26 @@ class Engine:
                 raise MigrationError(
                     f"page shape {tuple(rows.shape)} != expected {want} "
                     "(mismatched model or page size)")
-        keys = page_chain_hashes(tokens, ps)[:k]
+        keys = page_chain_hashes(tokens, ps)[start:start + k]
         seq_id = next(self._seq_ids)
         self.allocator.allocate_extra(seq_id, k)  # OutOfPages → caller
         page_ids = self.allocator.pages(seq_id)
         self._import_pages_dev(page_ids, np.stack(pages_data))
         self.prefix_cache.insert(keys, page_ids)
+        self._purge_spilled(keys)
         # release: registered pages park evictable (adopted by the
         # continuation's probe); pages whose chain key was ALREADY
         # cached locally were skipped by insert and return to the free
         # stack immediately
         self.allocator.free(seq_id)
-        self.stats.migrations_in += 1
-        self.stats.migration_pages_in += k
-        logger.info("imported %d pages for a %d-token chain", k,
-                    len(tokens))
+        if source == "fetch":
+            self.stats.kv_fetches_in += 1
+            self.stats.kv_fetch_pages_in += k
+        else:
+            self.stats.migrations_in += 1
+            self.stats.migration_pages_in += k
+        logger.info("imported %d pages for a %d-token chain (%s)", k,
+                    len(tokens), source)
         return k
 
     # -- engine loop ------------------------------------------------------
@@ -2118,6 +2339,12 @@ class Engine:
             hits = len(self.prefix_cache.probe(chain))
             if min(hits, n // ps) > 0:
                 return False, chain
+            if (self.host_tier is not None and hits < n // ps
+                    and self.host_tier.contains(chain[hits])):
+                # the chain extends into the host spill tier: the
+                # per-request path revives the spilled pages and
+                # resumes instead of re-prefilling
+                return False, chain
         if (self._prefill_sp_fn is not None
                 and n >= self.cfg.sp_prefill_min_tokens):
             return False, chain
@@ -2194,6 +2421,7 @@ class Engine:
                     self.prefix_cache.insert(
                         chain, self.allocator.pages(r.seq_id),
                         tokens=r.req.prompt)
+                    self._purge_spilled(chain)
                 self._slots[slot_idx] = _Slot(
                     req=r.req, pos=r.n - 1, generated=0,
                     key_seed=r.req.sampling.seed or r.seq_id,
@@ -2258,6 +2486,12 @@ class Engine:
         if self.prefix_cache is not None and n > 1:
             chain_keys = (chain if chain is not None
                           else self.prefix_cache.chain_keys(req.prompt))
+            if self.host_tier is not None:
+                # KV hierarchy revive (ISSUE 11): promote any spilled
+                # run extending the resident prefix back into the pool
+                # BEFORE the probe — the adoption below then sees the
+                # revived pages as ordinary cached prefix
+                self._revive_chain(chain_keys)
             hit_pages = self.prefix_cache.probe(chain_keys)
             hits = min(len(hit_pages), n // ps)
             full_hit = hits > 0 and hits * ps == n
@@ -2468,6 +2702,7 @@ class Engine:
         if self.prefix_cache is not None and chain_keys:
             self.prefix_cache.insert(chain_keys, pages,
                                      tokens=req.prompt)
+            self._purge_spilled(chain_keys)
         logger.debug("prefill seq=%d len=%d prefix=%d bucket=%d %.1fms",
                      seq_id, n, prefix_len, info["bucket"],
                      1e3 * (time.monotonic() - t0))
@@ -3309,6 +3544,21 @@ class Engine:
                   + self.stats.prefix_cache_misses)
             self.stats.prefix_cache_hit_rate = (
                 self.stats.prefix_cache_hits / hm if hm else 0.0)
+        # KV memory hierarchy (ISSUE 11): host-tier occupancy/churn and
+        # the resident+spilled chain digest the fleet index polls
+        # (throttled — the digest walk is O(resident chains))
+        if self.host_tier is not None:
+            tier = self.host_tier
+            self.stats.kv_spills = tier.spills
+            self.stats.kv_revives = tier.revives
+            self.stats.kv_spill_evictions = tier.evictions
+            self.stats.kv_spilled_pages = tier.count
+            self.stats.kv_spill_bytes = tier.bytes_used
+            self.stats.kv_host_bytes = tier.max_bytes
+        now_d = time.monotonic()
+        if self.prefix_cache is not None and now_d >= self._kv_digest_next:
+            self._kv_digest_next = now_d + 0.5
+            self._refresh_kv_digest()
         # age of the oldest waiting request — the picker's queue-latency
         # term. Peeking the underlying deque is safe here: entries are
         # only appended by other threads, and a request popped between

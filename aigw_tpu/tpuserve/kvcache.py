@@ -13,7 +13,10 @@ occupancy), the role the reference's EPP plays via
 from __future__ import annotations
 
 import hashlib
+import logging
 from dataclasses import dataclass, field
+
+logger = logging.getLogger(__name__)
 
 
 def page_chain_hashes(
@@ -309,6 +312,17 @@ class PrefixCache:
         self._next_tokens: dict[bytes, list[int]] = {}
         #: entries reclaimed under pool pressure (monotonic counter)
         self.evictions = 0
+        # KV memory hierarchy (ISSUE 11): optional spill sink called as
+        # sink(chain_key, page_id) the moment a registered page is
+        # reclaimed under pool pressure — BEFORE the registration drops,
+        # while the page's device rows are still this chain's content.
+        # The engine wires it to the device→host export + HostKVTier
+        # put; eviction then demotes the chain instead of destroying it.
+        # The sink runs synchronously inside the allocator's _pop_page,
+        # so the page is never handed to its new owner until the spill
+        # copy has resolved (the spilled-pinned invariant,
+        # tests/test_kvcache_eviction.py).
+        self.spill_sink = None
         allocator._prefix_cache = self
         allocator.set_evict_callback(self._evicted)
 
@@ -378,5 +392,11 @@ class PrefixCache:
         page = self._by_key.pop(key, None)
         self._next_tokens.pop(key, None)
         if page is not None:
+            if self.spill_sink is not None:
+                try:
+                    self.spill_sink(key, page)
+                except Exception:  # noqa: BLE001 — a failed spill must
+                    # degrade to a plain eviction, never kill admission
+                    logger.exception("KV spill failed for page %d", page)
             self._key_by_page.pop(page, None)
             self.evictions += 1

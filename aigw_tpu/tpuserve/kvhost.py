@@ -1,0 +1,150 @@
+"""Host-RAM KV spill tier — the second rung of the KV memory hierarchy.
+
+HBM holds the pages live sequences decode against plus whatever the
+prefix cache can keep parked; everything beyond that used to be dropped
+on eviction and recomputed on the next hit. This tier catches those
+evictions instead: when the refcounted allocator reclaims a parked
+cache-registered page under pool pressure, the engine copies the page's
+K/V rows device→host and parks them HERE, keyed by the same content
+chain hash the prefix cache used. A later prefix hit on a spilled chain
+*revives* the pages through the warmed batched import scatters
+(tpuserve/engine.py `_import_pages_dev` — the PR 8 migration machinery)
+instead of re-prefilling, and the cross-replica fetch endpoint
+(`/kv/pages`) serves spilled chains straight from host memory without
+touching the device at all.
+
+Discipline:
+
+- **Strict tiering**: the budget holds only NON-resident chains. A
+  revive removes the host copy (the page moved back up the hierarchy);
+  a re-eviction re-spills it. No entry is ever both resident and
+  counted against the host budget.
+- **Byte-for-byte**: pages are stored in the pool's native KV dtype
+  exactly as exported — a revived chain is bit-identical to the chain
+  that was never evicted (property-tested in
+  tests/test_kvcache_eviction.py, f32-rig-tested in
+  tests/test_kvtier.py).
+- **Bounded**: ``max_bytes`` (the ``--kv-host-bytes`` knob) is a hard
+  LRU budget. Oversized single pages are refused (counted as
+  evictions), never stored.
+- **Thread-safe**: spills and revives happen on the engine thread, but
+  `/kv/pages` and the `/state` digest read from server threads — every
+  operation takes the tier lock.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any
+
+
+def _size(rows: Any) -> int:
+    """Byte size of a stored page: np arrays expose nbytes; plain
+    byte blobs (the property tests' model device) their length."""
+    n = getattr(rows, "nbytes", None)
+    return int(n) if n is not None else len(rows)
+
+
+class HostKVTier:
+    """Bounded LRU of chain-hash → one host-side KV page."""
+
+    def __init__(self, max_bytes: int):
+        if max_bytes <= 0:
+            raise ValueError(f"max_bytes must be > 0 (got {max_bytes})")
+        self.max_bytes = int(max_bytes)
+        # chain key (bytes) → np page rows; insertion order = LRU
+        self._pages: "collections.OrderedDict[bytes, Any]" = (
+            collections.OrderedDict()
+        )
+        self._bytes = 0
+        self._lock = threading.Lock()
+        #: cumulative pages spilled into the tier
+        self.spills = 0
+        #: cumulative pages revived out of the tier (take())
+        self.revives = 0
+        #: pages dropped by the LRU budget (or refused as oversized)
+        self.evictions = 0
+
+    # -- capacity ---------------------------------------------------------
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return len(self._pages)
+
+    @property
+    def bytes_used(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    # -- spill ------------------------------------------------------------
+    def put(self, key: bytes, rows: Any) -> bool:
+        """Spill one page's rows under its chain key. Returns False when
+        the page alone exceeds the budget (refused, counted evicted).
+        Re-spilling an existing key replaces the entry (refreshing its
+        LRU position); LRU entries drop until the budget holds."""
+        nbytes = _size(rows)
+        with self._lock:
+            if nbytes > self.max_bytes:
+                self.evictions += 1
+                return False
+            old = self._pages.pop(key, None)
+            if old is not None:
+                self._bytes -= _size(old)
+            self._pages[key] = rows
+            self._bytes += nbytes
+            self.spills += 1
+            while self._bytes > self.max_bytes:
+                _, dropped = self._pages.popitem(last=False)
+                self._bytes -= _size(dropped)
+                self.evictions += 1
+            return True
+
+    # -- lookup / revive --------------------------------------------------
+    def contains(self, key: bytes) -> bool:
+        """Presence probe; touches the entry (a chain about to be
+        revived must not be the next LRU victim of an interleaved
+        spill)."""
+        with self._lock:
+            if key not in self._pages:
+                return False
+            self._pages.move_to_end(key)
+            return True
+
+    def get(self, key: bytes):
+        """Peek (cross-replica fetch serving): the page stays in the
+        tier — the sibling gets a copy, this replica keeps its rung."""
+        with self._lock:
+            rows = self._pages.get(key)
+            if rows is not None:
+                self._pages.move_to_end(key)
+            return rows
+
+    def take(self, key: bytes):
+        """Revive: remove and return the page's rows (None = miss). The
+        chain is moving back into HBM — strict tiering frees the host
+        copy."""
+        with self._lock:
+            rows = self._pages.pop(key, None)
+            if rows is not None:
+                self._bytes -= _size(rows)
+                self.revives += 1
+            return rows
+
+    def discard(self, key: bytes) -> None:
+        """Drop a stale host copy of a chain that just became resident
+        AGAIN through a cold prefill (possible when an earlier chain
+        key was budget-dropped, so no revive fired). Content-addressing
+        makes the copy harmless, but strict tiering spends the host
+        budget only on chains HBM does not hold. Not a revive (nothing
+        moved up) and not an eviction (nothing was lost) — uncounted."""
+        with self._lock:
+            rows = self._pages.pop(key, None)
+            if rows is not None:
+                self._bytes -= _size(rows)
+
+    def keys(self) -> tuple:
+        """Snapshot of resident chain keys (the /state digest's spilled
+        half)."""
+        with self._lock:
+            return tuple(self._pages.keys())

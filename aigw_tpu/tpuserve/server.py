@@ -76,6 +76,28 @@ logger = logging.getLogger(__name__)
 #: and joins the gateway's per-tenant cost/quota accounting
 TENANT_HEADER = "x-aigw-tenant"
 
+#: sibling replicas ("host:port", comma-separated) the gateway believes
+#: hold KV for this request's prompt chain (ISSUE 11): on a prefix miss
+#: the server fetches the missing leading pages from them over
+#: POST /kv/pages (the PR 8 byte-identical page wire) and imports them
+#: as cached chains before admission — Mooncake-style KV-centric
+#: serving. Absent/empty = no fetch (cold prefill as before).
+KV_PEERS_HEADER = "x-aigw-kv-peers"
+
+#: response header: the first page-chain hash of the served prompt —
+#: the gateway learns (prefix-head → chain) from it and prices
+#: fleet-hit locality / orders fetch peers on later requests sharing
+#: the same prefix head
+KV_CHAIN_HEADER = "x-aigw-kv-chain"
+
+#: fleet-fetch bounds: peers tried per request, pages per fetch, and
+#: the per-peer HTTP budget — a slow sibling must delay admission by a
+#: bounded amount, never hang it (the cold prefill path is always the
+#: fallback)
+KV_PEERS_MAX = 3
+KV_FETCH_MAX_PAGES = 64
+KV_FETCH_TIMEOUT_S = 10.0
+
 
 def _push_all(decoder: StreamingDecoder, toks: list[int]) -> list[str]:
     """Detokenize a burst (runs on the tokenizer pool: a K-token decode
@@ -254,6 +276,10 @@ class TPUServeServer:
         # the x-aigw-request-id it already relays
         self._live: dict[str, tuple[GenRequest, dict]] = {}
 
+        # lazy aiohttp session for cross-replica /kv/pages fetches
+        # (ISSUE 11) — one pooled session per server, closed on cleanup
+        self._kv_session = None
+
         # body cap sized for /migrate/import: a migrated page chain is
         # megabytes of KV by design (page_bytes × pages on the wire)
         self.app = web.Application(client_max_size=256 * 1024 * 1024)
@@ -267,6 +293,7 @@ class TPUServeServer:
         self.app.router.add_get("/metrics", self._metrics)
         self.app.router.add_post("/migrate/export", self._migrate_export)
         self.app.router.add_post("/migrate/import", self._migrate_import)
+        self.app.router.add_post("/kv/pages", self._kv_pages)
         self.app.router.add_get("/debug/requests", self._debug_requests)
         self.app.router.add_get("/debug/requests/{rid}",
                                 self._debug_request)
@@ -317,6 +344,9 @@ class TPUServeServer:
         await asyncio.to_thread(self.engine.warmup)
 
     async def _on_stop(self, _app) -> None:
+        if self._kv_session is not None:
+            await self._kv_session.close()
+            self._kv_session = None
         self.engine.stop()
         self._tok_pool.shutdown(wait=False)
 
@@ -446,6 +476,18 @@ class TPUServeServer:
         if self.engine.prefix_cache is None:
             return None
         return page_chain_hashes(prompt, self.engine.cfg.page_size)
+
+    @staticmethod
+    def _kv_chain_header(prefix_hashes: list | None) -> dict[str, str]:
+        """x-aigw-kv-chain response header (ISSUE 11): the prompt's
+        first page-chain hash. The gateway learns (prefix-head → chain)
+        from it — its fleet index then knows WHICH chain later requests
+        with the same prefix head need, pricing fleet-hit locality into
+        the picker and ordering fetch peers. Empty dict for prompts
+        without a full page (nothing shareable)."""
+        if not prefix_hashes:
+            return {}
+        return {KV_CHAIN_HEADER: prefix_hashes[0].hex()}
 
     def _encode_chat(self, msgs) -> tuple[list[int], list | None]:
         """Template+encode a chat AND roll its prefix hashes (one pool
@@ -664,6 +706,11 @@ class TPUServeServer:
         except oai.SchemaError as e:
             return web.Response(status=400, body=oai.error_body(str(e)),
                                 content_type="application/json")
+        # fleet KV fetch (ISSUE 11): named siblings may hold this
+        # prompt's chain — import their pages before admission so the
+        # prefill becomes a resume (covers the n>1 fan-out too: the
+        # shared prompt is fetched once)
+        await self._maybe_fleet_fetch(request, prompt, prefix_hashes)
         if n > 1:
             if n > self.engine.cfg.max_batch_size:
                 return web.Response(
@@ -809,7 +856,8 @@ class TPUServeServer:
                     resp["choices"][0]["logprobs"] = \
                         self._legacy_logprobs(lp_content)
             return web.json_response(
-                resp, headers={"x-aigw-request-id": rid})
+                resp, headers={"x-aigw-request-id": rid,
+                               **self._kv_chain_header(prefix_hashes)})
 
         # streaming
         resp = web.StreamResponse(
@@ -818,7 +866,8 @@ class TPUServeServer:
                      "cache-control": "no-cache",
                      # joins the gateway access log / client against the
                      # flight recorder (/debug/requests/{id}) and spans
-                     "x-aigw-request-id": rid},
+                     "x-aigw-request-id": rid,
+                     **self._kv_chain_header(prefix_hashes)},
         )
         # first-token fast path: the role frame and the first content
         # delta are two small writes back to back — Nagle must not hold
@@ -1546,6 +1595,21 @@ class TPUServeServer:
                 "migration_pages_out": s.migration_pages_out,
                 "migration_pages_in": s.migration_pages_in,
                 "migratable_slots": s.migratable_slots,
+                # KV memory hierarchy (ISSUE 11): host-spill-tier
+                # occupancy/churn, cross-replica fetch traffic, and the
+                # resident+spilled chain digest the gateway's fleet
+                # index polls (chain-hash → replica routing)
+                "kv_spills": s.kv_spills,
+                "kv_revives": s.kv_revives,
+                "kv_spill_evictions": s.kv_spill_evictions,
+                "kv_spilled_pages": s.kv_spilled_pages,
+                "kv_spill_bytes": s.kv_spill_bytes,
+                "kv_host_bytes": s.kv_host_bytes,
+                "kv_fetches_out": s.kv_fetches_out,
+                "kv_fetches_in": s.kv_fetches_in,
+                "kv_fetch_pages_out": s.kv_fetch_pages_out,
+                "kv_fetch_pages_in": s.kv_fetch_pages_in,
+                "kv_chains": list(self.engine.kv_chain_digest()),
                 # grammar-constrained decoding (ISSUE 9): the
                 # capability flag the gateway merges into /v1/models,
                 # live constrained slots, window rollbacks (grammar
@@ -1664,6 +1728,134 @@ class TPUServeServer:
                 + render_device_gauges(self.engine.device_stats)
                 + self.engine.phases.render())
         return web.Response(body=body, content_type="text/plain")
+
+    # -- KV memory hierarchy: cross-replica page fetch (ISSUE 11) ----------
+    async def _kv_pages(self, request: web.Request) -> web.Response:
+        """Serve KV pages by content chain hash to a sibling replica:
+        resident pages travel through the pinned device→host export
+        path, host-spilled pages straight from the spill tier — both on
+        the PR 8 f32 page wire (b64 rows + shape). Keys this replica
+        does not hold are simply absent from the response; the fetcher
+        imports the leading contiguous run it got."""
+        import base64
+
+        try:
+            body = oai.parse_json_body(await request.read())
+        except oai.SchemaError as e:
+            return web.Response(status=400, body=oai.error_body(str(e)),
+                                content_type="application/json")
+        raw_keys = body.get("keys")
+        if not isinstance(raw_keys, list) or not raw_keys:
+            return web.Response(
+                status=400,
+                body=oai.error_body("keys must be a non-empty list of "
+                                    "hex chain hashes"),
+                content_type="application/json")
+        try:
+            keys = [bytes.fromhex(str(k)) for k in
+                    raw_keys[:KV_FETCH_MAX_PAGES]]
+        except ValueError as e:
+            return web.Response(
+                status=400,
+                body=oai.error_body(f"malformed chain hash: {e}"),
+                content_type="application/json")
+        try:
+            out = await asyncio.to_thread(self.engine.kv_export_pages,
+                                          keys)
+        except (MigrationError, TimeoutError) as e:
+            return web.Response(
+                status=409, body=oai.error_body(str(e)),
+                content_type="application/json")
+        pages = [
+            {"key": k.hex(),
+             "b64": base64.b64encode(
+                 np.asarray(d, np.float32).tobytes()).decode(),
+             "shape": list(d.shape)}
+            for k, d in out
+        ]
+        return web.json_response({
+            "model": self.model_name,
+            "page_size": self.engine.cfg.page_size,
+            "pages": pages,
+        })
+
+    async def _maybe_fleet_fetch(self, request: web.Request,
+                                 prompt: list[int],
+                                 hashes: list | None) -> None:
+        """Cross-replica KV fetch ahead of admission: when the gateway
+        named sibling replicas that hold this prompt's chain
+        (x-aigw-kv-peers) and the leading pages are missing locally,
+        fetch them over /kv/pages and import them as cached chains —
+        the admission probe then resumes instead of re-prefilling.
+        Strictly best-effort: any failure falls back to cold prefill."""
+        peers_hdr = request.headers.get(KV_PEERS_HEADER, "")
+        eng = self.engine
+        if (not peers_hdr or not hashes
+                or eng.prefix_cache is None):
+            return
+        ps = eng.cfg.page_size
+        # the wire rule (PR 8): only pages whose every row is written KV
+        # travel — cap at the prompt's fully-written coverage
+        usable = min(len(hashes), (len(prompt) - 1) // ps)
+        present = set(eng.kv_chain_digest())
+        miss = 0
+        while miss < usable and hashes[miss].hex() in present:
+            miss += 1
+        if miss >= usable:
+            return
+        want = [h.hex() for h in hashes[miss:usable]]
+        peers = [p.strip() for p in peers_hdr.split(",")
+                 if p.strip()][:KV_PEERS_MAX]
+        for peer in peers:
+            got = await self._fetch_pages_from(peer, want)
+            run: list[np.ndarray] = []
+            for h in want:
+                rows = got.get(h)
+                if rows is None:
+                    break  # leading contiguous run only
+                run.append(rows)
+            if not run:
+                continue
+            try:
+                await asyncio.to_thread(eng.kv_import_pages, prompt,
+                                        run, miss)
+            except (MigrationError, TimeoutError) as e:
+                logger.info("fleet KV import from %s failed: %s",
+                            peer, e)
+                return
+            logger.info("fleet-fetched %d KV pages from %s", len(run),
+                        peer)
+            return
+
+    async def _fetch_pages_from(self, peer: str,
+                                keys_hex: list[str]) -> dict:
+        """POST /kv/pages to one sibling; returns {key_hex: np rows}
+        ({} on any error — the fetch is best-effort)."""
+        import base64
+
+        import aiohttp
+
+        base = peer if "://" in peer else f"http://{peer}"
+        if self._kv_session is None or self._kv_session.closed:
+            self._kv_session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=KV_FETCH_TIMEOUT_S))
+        try:
+            async with self._kv_session.post(
+                    base + "/kv/pages", json={"keys": keys_hex}) as resp:
+                if resp.status != 200:
+                    return {}
+                data = await resp.json()
+        except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
+            return {}
+        out: dict = {}
+        try:
+            for p in data.get("pages") or ():
+                out[str(p["key"])] = (
+                    np.frombuffer(base64.b64decode(p["b64"]), np.float32)
+                    .reshape(p["shape"]))
+        except (KeyError, TypeError, ValueError):
+            return {}
+        return out
 
     # -- prefill/decode disaggregation: KV page migration (ISSUE 8) --------
     async def _migrate_export(self, request: web.Request) -> web.Response:
@@ -1981,6 +2173,7 @@ async def run_tpuserve(
     enable_profile_endpoint: bool = False,
     migration_young_tokens: int = 64,
     constrained_decoding: bool = True,
+    kv_host_bytes: int = 0,
 ) -> web.AppRunner:
     server = TPUServeServer(
         model,
@@ -2008,6 +2201,7 @@ async def run_tpuserve(
             tenant_slot_cap=tenant_slot_cap,
             migration_young_tokens=migration_young_tokens,
             constrained_decoding=constrained_decoding,
+            kv_host_bytes=kv_host_bytes,
         ),
         tp=tp,
         ep=ep,

@@ -2081,6 +2081,199 @@ def disagg_numbers(reps: int = 5, prompt_len: int = 288,
         stop_b()
 
 
+# -- kv_tier leg: fleet KV memory hierarchy (ISSUE 11) -------------------
+
+#: Leg model: compute-heavy relative to its KV bytes (wide dim + big
+#: ffn, few KV heads) — on the CPU rig the cross-replica fetch pays in
+#: page BYTES (b64 wire + import scatter) while the cold prefill pays
+#: in COMPUTE, and this shape keeps the two costs in the same relation
+#: they have on a real chip (where prefill compute dwarfs DCN page
+#: movement). max_seq 512, 16-token pages.
+_KVTIER_CFG = llama.LlamaConfig(
+    vocab_size=8192, dim=1024, n_layers=6, n_heads=16, n_kv_heads=2,
+    ffn_dim=4096, max_seq_len=512, rope_theta=10000.0,
+)
+_KVTIER_HEAD = 128  # shared-prefix head chars (8 full 16-token pages)
+
+
+def _kvtier_ab_fields(st0: dict, st1: dict,
+                      prefix: str = "kvtier") -> dict:
+    """Counter deltas between two /state snapshots — the spill/revive/
+    fetch churn and the hot-compile tripwire the kv_tier leg reports
+    (unit-tested in tests/test_bench_smoke.py)."""
+
+    def d(k: str) -> int:
+        return int(st1.get(k, 0)) - int(st0.get(k, 0))
+
+    return {
+        f"{prefix}_spills": d("kv_spills"),
+        f"{prefix}_revives": d("kv_revives"),
+        f"{prefix}_fetches_in": d("kv_fetches_in"),
+        f"{prefix}_fetches_out": d("kv_fetches_out"),
+        f"{prefix}_fetch_pages_in": d("kv_fetch_pages_in"),
+        f"{prefix}_fetch_pages_out": d("kv_fetch_pages_out"),
+        f"{prefix}_hot_compiles": d("xla_compiles"),
+    }
+
+
+async def _kvtier_openloop(s, url: str, model: str, head: str,
+                           arrivals: int, headers: dict,
+                           tag: str) -> list[float]:
+    """Shared-prefix open-loop burst: ``arrivals`` streaming
+    completions whose prompts share ``head``, fired at staggered
+    arrival times. Returns per-arrival TTFT ms in arrival order —
+    arrival 0 pays the fetch (warm fleet) or the full prefill (cold
+    fleet); later arrivals hit the replica's own cache either way."""
+
+    async def one(i: int, t0: float) -> float:
+        await asyncio.sleep(max(0.0, t0 + 0.08 * i - time.perf_counter()))
+        payload = {"model": model,
+                   "prompt": head + f" {tag}-u{i:02d}",
+                   "max_tokens": 4, "temperature": 0.0,
+                   "stream": True, "logit_bias": {"97": 100}}
+        ts = time.perf_counter()
+        async with s.post(url + "/v1/completions", json=payload,
+                          headers=headers) as resp:
+            assert resp.status == 200, resp.status
+            async for line in resp.content:
+                line = line.strip()
+                if line.startswith(b"data: ") and b'"text"' in line:
+                    return 1e3 * (time.perf_counter() - ts)
+        return -1.0
+
+    t0 = time.perf_counter()
+    return list(await asyncio.gather(
+        *(one(i, t0) for i in range(arrivals))))
+
+
+def kv_tier_numbers(reps: int = 3, arrivals: int = 4) -> dict:
+    """The ``--ab kv_tier`` leg (ISSUE 11), two tpuserve replicas with
+    the host spill tier on:
+
+    1. **Warm fleet vs cold fleet** (the headline): per interleaved
+       rep, replica A is primed with a fresh shared-prefix head, then
+       the same shared-prefix open-loop burst runs against replica B
+       twice — once with A named in x-aigw-kv-peers (warm fleet:
+       arrival 0 fetches A's pages over /kv/pages and resumes) and
+       once with an unprimed head and no peers (cold fleet: arrival 0
+       pays the full prefill). Target: first-arrival TTFT ratio ≤ 0.6.
+    2. **Spill→revive churn on A** (off the clock): distinct floods
+       overflow A's pool so the primed chains spill to host RAM, a
+       re-ask revives one — counters prove the tier moved pages both
+       ways, and the /state xla_compiles delta across a second churn
+       cycle proves the whole spill/revive/fetch path stays off the
+       compiler (CompileTracker tripwire)."""
+    import aiohttp
+
+    model_name = "bench-kvtier-tiny"
+    k = int(os.environ.get("AIGW_BENCH_CPU_K", "4"))
+    engine_common = {"min_prefill_bucket": 32,
+                     "kv_cache_dtype": "float32",
+                     "kv_host_bytes": 1 << 30,
+                     "warm_decode_buckets": 5,
+                     "max_queued_requests": 64}
+    url_a, stop_a = _start_tpuserve_subproc(
+        model_name, _KVTIER_CFG, "", batch=2, k_steps=k,
+        engine=dict(engine_common, num_pages=64), page=_PREFIX_PAGE,
+        param_dtype="float32")
+    url_b, stop_b = _start_tpuserve_subproc(
+        model_name, _KVTIER_CFG, "", batch=4, k_steps=k,
+        engine=dict(engine_common, num_pages=128), page=_PREFIX_PAGE,
+        param_dtype="float32")
+    addr_a = url_a[len("http://"):]
+
+    def head_of(tag: str) -> str:
+        return (tag + "s" * _KVTIER_HEAD)[:_KVTIER_HEAD]
+
+    async def prime(s, tag: str) -> None:
+        payload = {"model": model_name,
+                   "prompt": head_of(tag) + " prime",
+                   "max_tokens": 2, "temperature": 0.0,
+                   "logit_bias": {"97": 100}}
+        async with s.post(url_a + "/v1/completions",
+                          json=payload) as resp:
+            assert resp.status == 200, resp.status
+
+    async def run() -> dict:
+        await _wait_health(url_a, 1200)
+        await _wait_health(url_b, 1200)
+        timeout = aiohttp.ClientTimeout(total=1200)
+        async with aiohttp.ClientSession(timeout=timeout) as s:
+            peers = {"x-aigw-kv-peers": addr_a}
+            # off the clock: one full warm+cold cycle compiles every
+            # shape the timed reps will touch (fetch import rungs and
+            # the suffix resume on B, prefill buckets on both)
+            await prime(s, "w0")
+            # a second identical prime is a partial prefix hit: it
+            # compiles A's offset-resume program off the clock (the
+            # churn's revive re-ask resumes the same way)
+            await prime(s, "w0")
+            await asyncio.sleep(1.0)  # A's digest refresh
+            await _kvtier_openloop(s, url_b, model_name, head_of("w0"),
+                                   arrivals, peers, "w0")
+            await _kvtier_openloop(s, url_b, model_name, head_of("wx"),
+                                   arrivals, {}, "wx")
+
+            st_b0 = await _get_state(s, url_b)
+            st_a0 = await _get_state(s, url_a)
+            warm_t, cold_t, warm_rest = [], [], []
+            for rep in range(reps):
+                await prime(s, f"h{rep:02d}")
+                await asyncio.sleep(1.0)
+                w = await _kvtier_openloop(
+                    s, url_b, model_name, head_of(f"h{rep:02d}"),
+                    arrivals, peers, f"w{rep:02d}")
+                c = await _kvtier_openloop(
+                    s, url_b, model_name, head_of(f"c{rep:02d}"),
+                    arrivals, {}, f"c{rep:02d}")
+                if w[0] > 0:
+                    warm_t.append(w[0])
+                warm_rest += [t for t in w[1:] if t > 0]
+                if c[0] > 0:
+                    cold_t.append(c[0])
+            st_b1 = await _get_state(s, url_b)
+            st_a1 = await _get_state(s, url_a)
+            fields = _kvtier_ab_fields(st_b0, st_b1, "kvtier_b")
+            fields.update(_kvtier_ab_fields(st_a0, st_a1, "kvtier_a"))
+
+            # spill→revive churn on A (off the clock): overflow the
+            # 64-page pool so the primed chains spill, revive one
+            for i in range(8):
+                await prime(s, f"f{i:02d}")
+            st_c0 = await _get_state(s, url_a)
+            for i in range(8, 12):
+                await prime(s, f"f{i:02d}")
+            await prime(s, "h00")  # re-ask: revives if spilled
+            st_c1 = await _get_state(s, url_a)
+            fields.update(_kvtier_ab_fields(st_c0, st_c1,
+                                            "kvtier_churn"))
+
+        warm = _median(warm_t)
+        cold = _median(cold_t)
+        return {
+            "kvtier_warm_ttft_ms_p50": round(warm, 1),
+            "kvtier_cold_ttft_ms_p50": round(cold, 1),
+            "kvtier_warm_vs_cold": (round(warm / cold, 4)
+                                    if cold else 0.0),
+            "kvtier_warm_spread": round(_spread(warm_t), 3),
+            "kvtier_cold_spread": round(_spread(cold_t), 3),
+            # later arrivals of the warm bursts: the replica's own
+            # cache serves them — the shared-prefix economics at
+            # steady state
+            "kvtier_warm_rest_ttft_ms_p50": round(
+                _median(warm_rest), 1) if warm_rest else 0.0,
+            "kvtier_ab_reps": reps,
+            "kvtier_arrivals": arrivals,
+            **fields,
+        }
+
+    try:
+        return asyncio.run(run())
+    finally:
+        stop_a()
+        stop_b()
+
+
 def _chip_responsive(timeout_s: float = 180.0) -> bool:
     """The axon tunnel can go down entirely (observed 2026-07-28); probe
     with a watchdog so the bench prints an honest line instead of hanging
@@ -2277,6 +2470,11 @@ def run_cpu_ratio() -> dict:
     except Exception as e:
         print(f"mesh leg failed: {type(e).__name__}: {e}",
               file=sys.stderr)
+    try:
+        res.update(kv_tier_numbers())
+    except Exception as e:
+        print(f"kv_tier leg failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
     return res
 
 
@@ -2417,11 +2615,24 @@ def main() -> None:
                 "the warmed mesh path are the signal — the throughput "
                 "ratio is informational on CPU (virtual devices time-"
                 "slice one core)")
+        elif target == "kv_tier":
+            result = kv_tier_numbers()
+            result["metric"] = (
+                "kv_tier A/B — fleet KV memory hierarchy (ISSUE 11): "
+                "shared-prefix open-loop bursts against replica B with "
+                "sibling A warm — warm fleet (A named in x-aigw-kv-"
+                "peers: arrival 0 fetches A's pages over /kv/pages and "
+                "resumes) vs cold fleet (unprimed head, full prefill); "
+                "first-arrival TTFT ratio ≤ 0.6 is the claim, plus "
+                "spill→revive churn counters on A's host tier and a "
+                "zero-hot-compile delta across the churn (CPU backend; "
+                "ratios are the signal)")
         else:
             print(json.dumps({"error": f"unknown --ab target {target!r}; "
                               "supported: prefix_cache, spec_decode, "
                               "ragged_prefill, lora, disagg, "
-                              "slo_routing, structured, mesh"}))
+                              "slo_routing, structured, mesh, "
+                              "kv_tier"}))
             return
         print(json.dumps(result))
         return

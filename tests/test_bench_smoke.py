@@ -387,3 +387,31 @@ def test_bench_structured_schema_is_bounded_and_validates():
     assert not bench._struct_valid('{"report":123}')
     assert not bench._struct_valid('{"report":"' + "a" * 99 + '"}')
     assert not bench._struct_valid("not json")
+
+
+@pytest.mark.bench_smoke
+def test_bench_kvtier_ab_fields():
+    """The --ab kv_tier JSON derives its spill/revive/fetch telemetry
+    from /state deltas through this pure helper (ISSUE 11): every
+    field must be a capture DELTA (counters are cumulative on the
+    replica), the hot-compile tripwire is the xla counter delta, and
+    an empty capture degrades to zeros."""
+    st0 = {"kv_spills": 10, "kv_revives": 2, "kv_fetches_in": 1,
+           "kv_fetches_out": 4, "kv_fetch_pages_in": 5,
+           "kv_fetch_pages_out": 20, "xla_compiles": 50}
+    st1 = {"kv_spills": 18, "kv_revives": 6, "kv_fetches_in": 3,
+           "kv_fetches_out": 4, "kv_fetch_pages_in": 15,
+           "kv_fetch_pages_out": 20, "xla_compiles": 50}
+    f = bench._kvtier_ab_fields(st0, st1, "kvt")
+    assert f["kvt_spills"] == 8
+    assert f["kvt_revives"] == 4
+    assert f["kvt_fetches_in"] == 2
+    assert f["kvt_fetches_out"] == 0
+    assert f["kvt_fetch_pages_in"] == 10
+    assert f["kvt_fetch_pages_out"] == 0
+    assert f["kvt_hot_compiles"] == 0
+    # a compile during the capture window trips the field
+    assert bench._kvtier_ab_fields(
+        st0, dict(st1, xla_compiles=52), "k")["k_hot_compiles"] == 2
+    z = bench._kvtier_ab_fields({}, {}, "z")
+    assert all(v == 0 for v in z.values())

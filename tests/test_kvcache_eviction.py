@@ -374,3 +374,200 @@ class TestContinuationStore:
         alloc.allocate(1, PS * 2)  # evicts both entries
         assert cache.continuation(chain) is None
         alloc.free(1)
+
+
+def test_randomized_spill_revive_schedule():
+    """KV memory hierarchy extension of the property test (ISSUE 11):
+    the same randomized admit/complete/dispatch/drain/export schedule
+    with the host spill tier wired in via the PrefixCache spill sink —
+    mirroring the engine's discipline (spill on eviction, revive before
+    the admission probe). Invariants asserted at every step:
+
+    - **spilled-pinned**: the spill sink runs synchronously inside the
+      allocator's eviction, while the page's registration is still
+      intact and before the page reaches its new owner — no page is
+      ever handed out with its spill copy unresolved;
+    - **byte-identity**: a revived chain's content equals the content
+      the chain had when first written (content-addressing makes the
+      expected bytes a pure function of the chain key);
+    - **strict tiering + accounting**: the tier never holds a chain
+      that is also resident, and its byte accounting matches its
+      entries.
+    """
+    from aigw_tpu.tpuserve.kvhost import HostKVTier
+
+    def truth(key: bytes) -> bytes:
+        # content-addressed ground truth: what a page registered under
+        # ``key`` must always hold
+        return b"kv:" + key
+
+    for trial in range(10):
+        rng = random.Random(7000 + trial)
+        alloc = RefcountedAllocator(num_pages=14, page_size=PS)
+        cache = PrefixCache(alloc, PS)
+        tier = HostKVTier(max_bytes=19 * 4)  # ~4 pages and change
+        device: dict[int, bytes] = {}  # page id → content
+        spilling: set[int] = set()
+
+        def sink(key: bytes, page: int) -> None:
+            # the engine's _spill_page, modeled: device→host copy of a
+            # page whose registration is still intact
+            spilling.add(page)
+            assert cache.key_of_page(page) == key, (
+                "spill sink ran after the registration dropped")
+            assert page in device, "spilled a page never written"
+            assert device[page] == truth(key), (
+                "spilled content diverged from the chain's truth")
+            tier.put(key, device[page])
+            spilling.discard(page)  # synchronous: resolved before reuse
+
+        cache.spill_sink = sink
+        pool = _prompt_pool(rng)
+        seq_ids = iter(range(10_000))
+        live: dict[int, list[int]] = {}
+        pending_frees: list[int] = []
+        inflight: tuple[frozenset[int], list[int]] | None = None
+
+        def check_fresh(fresh: list[int], what: str) -> None:
+            assert not spilling, (
+                f"{what} handed out pages mid-spill: {spilling}")
+            bad = set(fresh) & held
+            assert not bad, (
+                f"trial {trial}: {what} handed out page(s) {bad} still "
+                f"referenced by a live chain or in-flight window")
+
+        def revive(chain: list[bytes]) -> None:
+            # the engine's _revive_chain, modeled
+            resident = len(cache.probe(chain))
+            take: list[bytes] = []
+            while (resident + len(take) < len(chain)
+                   and tier.contains(chain[resident + len(take)])):
+                take.append(chain[resident + len(take)])
+            if not take:
+                return
+            rows = [tier.take(k) for k in take]
+            sid = next(seq_ids)
+            try:
+                alloc.allocate_extra(sid, len(rows))
+            except OutOfPagesError:
+                alloc.free(sid)
+                for k, r in zip(take, rows):
+                    tier.put(k, r)
+                return
+            pages = alloc.pages(sid)
+            check_fresh(pages, "revive")
+            for k, r, p in zip(take, rows, pages):
+                assert r == truth(k), (
+                    "revived chain is not byte-identical to the "
+                    "never-evicted chain")
+                device[p] = r
+            cache.insert(take, pages)
+            alloc.free(sid)  # park evictable, adoptable by the probe
+
+        for step in range(400):
+            op = rng.random()
+            if op < 0.45:  # admit (with revive, the engine's order)
+                prompt = rng.choice(pool)
+                sid = next(seq_ids)
+                chain = page_chain_hashes(prompt, PS)
+                held = set()
+                for s in live:
+                    held.update(alloc.pages(s))
+                if inflight is not None:
+                    held.update(inflight[0])
+                revive(chain)
+                hit = cache.probe(chain)
+                hits = min(len(hit), len(prompt) // PS)
+                full = hits > 0 and hits * PS == len(prompt)
+                cached = hit[:hits]
+                total = len(prompt) + rng.randrange(1, 6)
+                try:
+                    if cached:
+                        alloc.adopt(sid, cached)
+                        extra = alloc.pages_for(total) - len(cached)
+                        if extra > 0:
+                            check_fresh(
+                                alloc.allocate_extra(sid, extra),
+                                "allocate_extra")
+                        if full:
+                            old = cached[-1]
+                            fresh = alloc.cow_page(sid, old)
+                            check_fresh([fresh], "cow_page")
+                            device[fresh] = device.get(old, b"")
+                    else:
+                        check_fresh(alloc.allocate(sid, total),
+                                    "allocate")
+                except OutOfPagesError:
+                    alloc.free(sid)
+                    continue
+                # "prefill": write the full prompt pages' content
+                pages = alloc.pages(sid)
+                for i in range(len(prompt) // PS):
+                    device[pages[i]] = truth(chain[i])
+                cache.insert(chain, pages)
+                for k in chain:  # strict tiering: the engine purges
+                    tier.discard(k)  # stale host copies on insert
+                live[sid] = prompt
+            elif op < 0.62 and live:  # complete (free is DEFERRED)
+                sid = rng.choice(list(live))
+                del live[sid]
+                pending_frees.append(sid)
+            elif op < 0.80:  # dispatch a window
+                if inflight is None:
+                    captured, pending_frees = pending_frees, []
+                    window_pages: set[int] = set()
+                    for sid in live:
+                        window_pages.update(alloc.pages(sid))
+                    for sid in captured:
+                        window_pages.update(alloc.pages(sid))
+                    inflight = (frozenset(window_pages), captured)
+            else:  # drain
+                if inflight is not None:
+                    _, captured = inflight
+                    inflight = None
+                    for sid in captured:
+                        alloc.free(sid)
+
+            # structural invariants after every step
+            resident_keys = set(cache._by_key)
+            tier_keys = set(tier.keys())
+            assert not (resident_keys & tier_keys), (
+                "strict tiering violated: a chain is both resident "
+                "and host-spilled")
+            assert tier.bytes_used == sum(
+                len(truth(k)) for k in tier_keys)
+            assert tier.bytes_used <= tier.max_bytes
+
+        # a full drain leaks nothing
+        if inflight is not None:
+            for sid in inflight[1]:
+                alloc.free(sid)
+        for sid in list(live):
+            alloc.free(sid)
+        for sid in pending_frees:
+            alloc.free(sid)
+        assert alloc.available_pages == alloc.num_pages
+        assert tier.spills >= tier.revives
+
+
+def test_spill_sink_failure_degrades_to_plain_eviction():
+    """A raising spill sink must not break eviction: the entry still
+    dies, the page is still handed out, the allocator stays coherent."""
+    alloc = RefcountedAllocator(num_pages=2, page_size=PS)
+    cache = PrefixCache(alloc, PS)
+
+    def bad_sink(key, page):
+        raise RuntimeError("host OOM")
+
+    cache.spill_sink = bad_sink
+    prompt = [3] * (PS * 2)
+    chain = page_chain_hashes(prompt, PS)
+    alloc.allocate(0, PS * 2)
+    cache.insert(chain, alloc.pages(0))
+    alloc.free(0)  # both pages park evictable
+    alloc.allocate(1, PS * 2)  # reclaims both; sink raises twice
+    assert cache.evictions == 2
+    assert cache.resident_entries == 0
+    assert len(alloc.pages(1)) == 2
+    alloc.free(1)
+    assert alloc.available_pages == 2

@@ -1,0 +1,242 @@
+"""Fleet KV page index + picker fleet-hit locality (ISSUE 11).
+
+Unit coverage for gateway/kvindex.KVIndex (replace-per-replica digest
+merge, expiry on replica death, bounded ingest) and for the picker's
+consumption of it: the kv_chains /state digest feeds the index on every
+poll, chain-holding replicas get the bounded KV_FLEET_BONUS — which
+must never beat saturation or session stickiness — and kv_peers names
+healthy chain-holding siblings for the cross-replica fetch header.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from aigw_tpu.gateway.kvindex import KVIndex
+from aigw_tpu.gateway.picker import (
+    AFFINITY_HEADER,
+    KV_CHAIN_HEADER,
+    PREFIX_HEADER,
+    Endpoint,
+    EndpointPicker,
+)
+
+
+class TestKVIndex:
+    def test_update_and_lookup(self):
+        idx = KVIndex()
+        idx.update("a:1", ["k1", "k2"])
+        idx.update("b:1", ["k2", "k3"])
+        assert idx.replicas("k1") == frozenset({"a:1"})
+        assert idx.replicas("k2") == frozenset({"a:1", "b:1"})
+        assert idx.replicas("k3") == frozenset({"b:1"})
+        assert idx.replicas("k4") == frozenset()
+        assert idx.chains == 3
+        assert idx.replicas_indexed == 2
+
+    def test_update_replaces_not_merges(self):
+        """Each poll swaps the replica's set wholesale: chains the
+        replica no longer advertises (evicted beyond its tier) must
+        drop out — a stale index entry sends fetches at a sibling that
+        answers with nothing."""
+        idx = KVIndex()
+        idx.update("a:1", ["k1", "k2"])
+        idx.update("a:1", ["k2", "k3"])
+        assert idx.replicas("k1") == frozenset()
+        assert idx.replicas("k2") == frozenset({"a:1"})
+        assert idx.replicas("k3") == frozenset({"a:1"})
+        assert idx.chains == 2
+
+    def test_remove_on_replica_death(self):
+        idx = KVIndex()
+        idx.update("a:1", ["k1", "k2"])
+        idx.update("b:1", ["k1"])
+        idx.remove("a:1")
+        assert idx.replicas("k1") == frozenset({"b:1"})
+        assert idx.replicas("k2") == frozenset()
+        assert idx.replicas_indexed == 1
+        idx.remove("a:1")  # idempotent
+        assert idx.chains == 1
+
+    def test_per_replica_ingest_bounded(self):
+        idx = KVIndex()
+        idx.update("a:1", (f"k{i}" for i in range(10_000)))
+        assert idx.chains == KVIndex.MAX_KEYS_PER_REPLICA
+
+    def test_empty_update_clears(self):
+        idx = KVIndex()
+        idx.update("a:1", ["k1"])
+        idx.update("a:1", [])
+        assert idx.chains == 0 and idx.replicas_indexed == 0
+
+
+def make_picker():
+    return EndpointPicker([Endpoint("a:1"), Endpoint("b:1"),
+                           Endpoint("c:1")])
+
+
+CHAIN = "ab" * 16
+
+
+class TestFleetHitScoring:
+    def test_holder_wins_at_equal_load(self):
+        p = make_picker()
+        p.observe("a:1", kv_occupancy=0.3, max_slots=8)
+        p.observe("b:1", kv_occupancy=0.3, max_slots=8,
+                  kv_chains=(CHAIN,))
+        p.observe("c:1", kv_occupancy=0.3, max_slots=8)
+        explain: dict = {}
+        assert p.pick({KV_CHAIN_HEADER: CHAIN},
+                      explain=explain) == "b:1"
+        assert explain["kv_fleet_hit"] is True
+
+    def test_never_beats_saturation(self):
+        """The bonus is a constant against unbounded load terms: a
+        saturated chain holder loses to an idle sibling."""
+        p = make_picker()
+        p.observe("a:1", kv_occupancy=0.1, max_slots=8)
+        p.observe("b:1", kv_occupancy=0.9, queued=8, max_slots=8,
+                  kv_chains=(CHAIN,))
+        p.observe("c:1", kv_occupancy=0.5, max_slots=8)
+        explain: dict = {}
+        assert p.pick({KV_CHAIN_HEADER: CHAIN},
+                      explain=explain) == "a:1"
+        assert explain["kv_fleet_hit"] is False
+
+    def test_never_beats_session_stickiness(self):
+        """KV_FLEET_BONUS < STICKINESS_MARGIN by design: a session
+        stays on its exact-KV replica even when a sibling holds the
+        shared chain."""
+        p = make_picker()
+        headers = {AFFINITY_HEADER: "sess-1", KV_CHAIN_HEADER: CHAIN}
+        p.observe("a:1", kv_occupancy=0.3, max_slots=8)
+        p.observe("b:1", kv_occupancy=0.3, max_slots=8,
+                  kv_chains=(CHAIN,))
+        p.observe("c:1", kv_occupancy=0.9, max_slots=8)
+        # pin the session to a:1 first (no chain known yet)
+        assert p.pick({AFFINITY_HEADER: "sess-1"}) in ("a:1", "b:1")
+        p._affinity["sess-1"] = "a:1"
+        assert p.pick(headers) == "a:1"
+
+    def test_outranks_adapter_affinity(self):
+        """Warm KV pages are dearer than a LoRA row: with both
+        affinities in play at equal load, the chain holder wins."""
+        p = make_picker()
+        p.observe("a:1", kv_occupancy=0.3, max_slots=8,
+                  adapters_resident=("t0",))
+        p.observe("b:1", kv_occupancy=0.3, max_slots=8,
+                  kv_chains=(CHAIN,))
+        p.observe("c:1", kv_occupancy=0.9, max_slots=8)
+        assert p.pick({KV_CHAIN_HEADER: CHAIN,
+                       "x-aigw-adapter": "t0"}) == "b:1"
+
+    def test_chain_learned_from_response_header(self):
+        """note_chain (fed by the tpuserve x-aigw-kv-chain response
+        header) resolves a prefix-head hash to its chain, so requests
+        that only carry x-aigw-prefix-hash still get fleet scoring."""
+        p = make_picker()
+        p.observe("a:1", kv_occupancy=0.3, max_slots=8)
+        p.observe("b:1", kv_occupancy=0.3, max_slots=8,
+                  kv_chains=(CHAIN,))
+        p.observe("c:1", kv_occupancy=0.9, max_slots=8)
+        p.note_chain("phash-1", CHAIN)
+        explain: dict = {}
+        got = p.pick({PREFIX_HEADER: "phash-1"}, explain=explain)
+        assert got == "b:1"
+        assert explain["kv_fleet_hit"] is True
+
+    def test_unknown_chain_scores_classically(self):
+        p = make_picker()
+        p.observe("a:1", kv_occupancy=0.1, max_slots=8)
+        p.observe("b:1", kv_occupancy=0.3, max_slots=8,
+                  kv_chains=(CHAIN,))
+        p.observe("c:1", kv_occupancy=0.5, max_slots=8)
+        assert p.pick() == "a:1"
+
+
+class TestKVPeers:
+    def test_names_healthy_holders_excluding_chosen(self):
+        p = make_picker()
+        p.observe("a:1", kv_chains=(CHAIN,))
+        p.observe("b:1", kv_chains=(CHAIN,))
+        p.observe("c:1")
+        peers = p.kv_peers("b:1", {KV_CHAIN_HEADER: CHAIN})
+        assert peers == ["a:1"]
+
+    def test_unknown_chain_names_nobody(self):
+        p = make_picker()
+        p.observe("a:1", kv_chains=(CHAIN,))
+        assert p.kv_peers("b:1", {}) == []
+        assert p.kv_peers("b:1", None) == []
+
+    def test_dead_holder_excluded(self):
+        p = make_picker()
+        p.observe("a:1", kv_chains=(CHAIN,))
+        p.observe("b:1")
+        p.state["a:1"].healthy = False
+        assert p.kv_peers("b:1", {KV_CHAIN_HEADER: CHAIN}) == []
+
+    def test_prefix_head_resolves_via_note_chain(self):
+        p = make_picker()
+        p.observe("a:1", kv_chains=(CHAIN,))
+        p.observe("b:1")
+        p.note_chain("ph", CHAIN)
+        assert p.kv_peers("b:1", {PREFIX_HEADER: "ph"}) == ["a:1"]
+
+    def test_bounded(self):
+        p = EndpointPicker([Endpoint(f"r{i}:1") for i in range(8)])
+        for i in range(8):
+            p.observe(f"r{i}:1", kv_chains=(CHAIN,))
+        assert len(p.kv_peers("r0:1", {KV_CHAIN_HEADER: CHAIN})) == 3
+
+
+class TestLiveDigestPolling:
+    def test_poll_feeds_index_and_death_expires(self, tpuserve_url):
+        """A real tpuserve /state poll carries kv_chains into the
+        index; a dead endpoint's entries expire on the failed poll."""
+
+        async def main():
+            addr = tpuserve_url.replace("http://", "")
+            p = EndpointPicker([Endpoint(addr)], poll_interval=0.1)
+            # seed traffic so the replica has at least one chain
+            import aiohttp
+            timeout = aiohttp.ClientTimeout(total=600)
+            async with aiohttp.ClientSession(timeout=timeout) as s:
+                async with s.post(tpuserve_url + "/v1/completions",
+                                  json={"model": "tiny-random",
+                                        "prompt": "q" * 40,
+                                        "max_tokens": 2,
+                                        "temperature": 0}) as r:
+                    assert r.status == 200
+            await asyncio.sleep(1.0)  # digest refresh on the replica
+            await p.start()
+            try:
+                for _ in range(100):
+                    await asyncio.sleep(0.1)
+                    if p.kv_index.replicas_indexed:
+                        break
+                assert p.state[addr].kv_chains
+                assert p.kv_index.replicas_indexed == 1
+                chain = p.state[addr].kv_chains[0]
+                assert addr in p.kv_index.replicas(chain)
+            finally:
+                await p.stop()
+            # death expiry: poll a vacant port
+            dead = EndpointPicker([Endpoint("127.0.0.1:1")],
+                                  poll_interval=0.1)
+            dead.kv_index.update("127.0.0.1:1", ["stale"])
+            await dead.start()
+            try:
+                for _ in range(50):
+                    await asyncio.sleep(0.1)
+                    if not dead.kv_index.chains:
+                        break
+                assert dead.kv_index.chains == 0
+            finally:
+                await dead.stop()
+
+        asyncio.run(main())
+
+
+# reuse the module-scoped tpuserve fixture
+from tests.test_tpuserve import tpuserve_url  # noqa: E402,F401

@@ -671,3 +671,101 @@ def test_device_gauges_map_matches_engine_device_stats():
         for key, _name in DEVICE_GAUGES:
             assert key in dev, (
                 f"DEVICE_GAUGES key {key!r} missing from device_stats")
+
+
+# KV memory hierarchy surface (ISSUE 11): a renamed field here silently
+# breaks the gateway's fleet index (polls kv_chains), the fleet-fetch
+# presence probe, or the bench --ab kv_tier leg (reads the counters)
+KVTIER_STATE_FIELDS = (
+    "kv_spills",
+    "kv_revives",
+    "kv_spill_evictions",
+    "kv_spilled_pages",
+    "kv_spill_bytes",
+    "kv_host_bytes",
+    "kv_fetches_out",
+    "kv_fetches_in",
+    "kv_fetch_pages_out",
+    "kv_fetch_pages_in",
+    "kv_chains",
+)
+
+KVTIER_GAUGES = (
+    "tpuserve_kv_spills_total",
+    "tpuserve_kv_revives_total",
+    "tpuserve_kv_spill_evictions_total",
+    "tpuserve_kv_spilled_pages",
+    "tpuserve_kv_spill_bytes",
+    "tpuserve_kv_host_bytes",
+    "tpuserve_kv_fetches_out_total",
+    "tpuserve_kv_fetches_in_total",
+    "tpuserve_kv_fetch_pages_out_total",
+    "tpuserve_kv_fetch_pages_in_total",
+)
+
+
+def test_state_and_metrics_export_kvtier_gauges(smoke_url):
+    """The KV-tier surface must appear on /state and /metrics even on a
+    replica without a host tier configured (constant 0 / empty digest
+    list — kv_chains still lists the RESIDENT chains)."""
+    state = json.loads(asyncio.run(_get(smoke_url, "/state")))
+    for field in KVTIER_STATE_FIELDS:
+        assert field in state, f"/state lost {field}"
+    assert isinstance(state["kv_chains"], list)
+    text = asyncio.run(_get(smoke_url, "/metrics")).decode()
+    for gauge in KVTIER_GAUGES:
+        assert gauge in text, f"/metrics lost {gauge}"
+
+
+def test_kv_tier_churn_zero_hot_compiles():
+    """Compile-on-hot-path tripwire for the KV memory hierarchy (ISSUE
+    11): after warmup() compiled the page export/import programs and
+    one suffix resume warmed the offset-resume prefill, a full
+    spill→revive→resume churn cycle — evictions demoting pages to the
+    host tier, a prefix hit promoting them back, the resumed prefill —
+    must add ZERO XLA compiles."""
+    spec_cfg = llama.TINY
+    params = llama.init_params(jax.random.PRNGKey(0), spec_cfg)
+    eng = Engine(params, spec_cfg, EngineConfig(
+        max_batch_size=2, max_seq_len=256, page_size=16,
+        min_prefill_bucket=16, num_pages=24, warm_prefill_buckets=4,
+        # pre-compile the decode ladder + row scatters at every page
+        # bucket this traffic reaches: admission-order-dependent
+        # bucket growth must not masquerade as a tier compile
+        warm_decode_buckets=4,
+        kv_host_bytes=1 << 24))
+    assert eng.host_tier is not None
+    eng.start()
+    eng.warmup()
+
+    def run(prompt, mt=4):
+        done = threading.Event()
+        eng.submit(GenRequest(
+            prompt=prompt, max_tokens=mt,
+            sampling=SamplingParams(temperature=0.0),
+            emit=lambda t, f, d=done: d.set() if f else None))
+        assert done.wait(timeout=300)
+
+    try:
+        shared = [5] * 64
+        run(shared + [9, 9])
+        # warm the partial-hit suffix-resume program (first offset
+        # resume compiles regardless of the tier — PR 3 behavior) and
+        # the flood geometry's prefill/row-update shapes: the compiles
+        # under test must be the TIER's, not first-use page-bucket
+        # growth the flood itself would pay tier or no tier
+        run(shared + [9, 9])
+        run([200] * 48 + [1], mt=2)
+        checkpoint = eng.compile_tracker.checkpoint()
+        # churn: flood evicts + spills the shared chain, the re-ask
+        # revives it and resumes
+        for i in range(14):
+            run([10 + i] * 48 + [1], mt=2)
+        assert eng.host_tier.spills > 0, "flood never spilled"
+        run(shared + [9, 9])
+        assert eng.host_tier.revives > 0, "re-ask never revived"
+        assert eng.compile_tracker.compiles_since(checkpoint) == 0, (
+            f"KV-tier churn paid a compile after warmup: "
+            f"{eng.compile_tracker.programs()}")
+    finally:
+        eng.stop()

@@ -193,8 +193,19 @@ def tpuserve_url():
     holder["loop"].call_soon_threadsafe(holder["loop"].stop)
 
 
+#: client budget for every HTTP call in this module. aiohttp's default
+#: ClientTimeout is total=300s — under a loaded full-suite 1-core batch
+#: a module fixture's FIRST request (fresh engine + warmup compiles
+#: competing for the core) can legitimately exceed that, which showed
+#: up as 2 TestLogprobs timeouts in PR 10's 18-minute tier-1 run while
+#: the same tests pass 8/8 in isolation. The server is local and the
+#: suite has its own timeout; a generous client budget cannot hang CI,
+#: it only stops load-dependent flakes.
+_CLIENT_TIMEOUT = aiohttp.ClientTimeout(total=900)
+
+
 async def _post(url, path, payload):
-    async with aiohttp.ClientSession() as s:
+    async with aiohttp.ClientSession(timeout=_CLIENT_TIMEOUT) as s:
         async with s.post(url + path, json=payload) as resp:
             return resp.status, await resp.read(), dict(resp.headers)
 
@@ -217,7 +228,7 @@ class TestTPUServeServer:
 
     def test_chat_streaming(self, tpuserve_url):
         async def main():
-            async with aiohttp.ClientSession() as s:
+            async with aiohttp.ClientSession(timeout=_CLIENT_TIMEOUT) as s:
                 async with s.post(
                     tpuserve_url + "/v1/chat/completions",
                     json={
@@ -262,7 +273,7 @@ class TestTPUServeServer:
 
     def test_metrics_engine_gauges(self, tpuserve_url):
         async def main():
-            async with aiohttp.ClientSession() as s:
+            async with aiohttp.ClientSession(timeout=_CLIENT_TIMEOUT) as s:
                 async with s.get(tpuserve_url + "/metrics") as resp:
                     return await resp.text()
 
@@ -273,7 +284,7 @@ class TestTPUServeServer:
 
     def test_state_telemetry(self, tpuserve_url):
         async def main():
-            async with aiohttp.ClientSession() as s:
+            async with aiohttp.ClientSession(timeout=_CLIENT_TIMEOUT) as s:
                 async with s.get(tpuserve_url + "/state") as resp:
                     return await resp.json()
 
@@ -509,7 +520,7 @@ class TestStopSequences:
         reports finish_reason=stop (reference: vLLM-compatible serving)."""
 
         async def main():
-            async with aiohttp.ClientSession() as s:
+            async with aiohttp.ClientSession(timeout=_CLIENT_TIMEOUT) as s:
                 # run once unconstrained to learn the greedy continuation
                 async with s.post(tpuserve_url + "/v1/chat/completions",
                                   json={"model": "tiny-random",
@@ -574,7 +585,7 @@ class TestNChoices:
                 "stream": True,
                 "stream_options": {"include_usage": True},
             }
-            async with aiohttp.ClientSession() as s:
+            async with aiohttp.ClientSession(timeout=_CLIENT_TIMEOUT) as s:
                 async with s.post(
                     tpuserve_url + "/v1/chat/completions", json=payload,
                 ) as resp:
@@ -944,7 +955,7 @@ class TestLogprobs:
 
     def test_http_streaming_logprobs(self, lp_url):
         async def main():
-            async with aiohttp.ClientSession() as s:
+            async with aiohttp.ClientSession(timeout=_CLIENT_TIMEOUT) as s:
                 async with s.post(lp_url + "/v1/chat/completions", json={
                     "model": "tiny-random",
                     "messages": [{"role": "user", "content": "go"}],
