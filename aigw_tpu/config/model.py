@@ -337,6 +337,21 @@ class Backend:
     # Costs nothing against replicas that don't advertise chains;
     # False suppresses the peers header entirely.
     kv_fleet: bool = True
+    # Fleet observability plane (ISSUE 12): feed the live SLO burn-rate
+    # monitor from the polled TTFT histograms and record every routing
+    # decision in the /debug/decisions audit ring. False is the A/B
+    # control (bench --ab fleet_obs); /fleet/state and /fleet/metrics
+    # stay served either way (health machine + rollups are ~free).
+    fleet_obs: bool = True
+    # SLO burn-rate monitor knobs: the availability objective the error
+    # budget derives from (goodput target; budget = 1 - objective), the
+    # goodput window length, and how many consecutive over-budget
+    # windows raise the sustained-overshoot flag (the autoscale
+    # predicate). The TTFT threshold itself is slo_ttft_ms (falling
+    # back to the monitor's 500ms default when unset).
+    slo_objective: float = 0.95
+    slo_window_s: float = 30.0
+    slo_burn_windows: int = 3
     auth: AuthConfig = AuthConfig()
     header_mutation: HeaderMutation = HeaderMutation()
     body_mutation: BodyMutation = BodyMutation()
@@ -374,6 +389,10 @@ class Backend:
                 migration_young_tokens=int(
                     value.get("migration_young_tokens", 32)),
                 kv_fleet=bool(value.get("kv_fleet", True)),
+                fleet_obs=bool(value.get("fleet_obs", True)),
+                slo_objective=float(value.get("slo_objective", 0.95)),
+                slo_window_s=float(value.get("slo_window_s", 30.0)),
+                slo_burn_windows=int(value.get("slo_burn_windows", 3)),
                 auth=AuthConfig.parse(value.get("auth")),
                 header_mutation=HeaderMutation.parse(value.get("header_mutation")),
                 body_mutation=BodyMutation.parse(value.get("body_mutation")),
@@ -406,6 +425,14 @@ class Backend:
             d["migration_young_tokens"] = self.migration_young_tokens
         if not self.kv_fleet:
             d["kv_fleet"] = False
+        if not self.fleet_obs:
+            d["fleet_obs"] = False
+        if self.slo_objective != 0.95:
+            d["slo_objective"] = self.slo_objective
+        if self.slo_window_s != 30.0:
+            d["slo_window_s"] = self.slo_window_s
+        if self.slo_burn_windows != 3:
+            d["slo_burn_windows"] = self.slo_burn_windows
         if self.auth.kind is not AuthKind.NONE:
             d["auth"] = self.auth.to_dict()
         if self.header_mutation != HeaderMutation():

@@ -60,6 +60,8 @@ from typing import Any
 import aiohttp
 
 from aigw_tpu.gateway.kvindex import KVIndex
+from aigw_tpu.gateway.fleetstate import FleetState
+from aigw_tpu.obs.slomon import SLOMonitor
 
 logger = logging.getLogger(__name__)
 
@@ -182,6 +184,24 @@ class EndpointState:
     # into the picker's fleet-wide KVIndex on every poll
     kv_chains: tuple = ()
     updated_at: float = 0.0
+    # fleet observability (ISSUE 12): when the last poll SUCCEEDED
+    # (monotonic; 0 = never), consecutive failed polls since, and the
+    # replica's self-reported identity/uptime. The stale-poll fix: a
+    # failed poll used to leave the last-good state frozen with only
+    # `healthy` flipped — these stamps make staleness first-class, so
+    # slo mode and /fleet/state can tell "current truth" from "how the
+    # replica looked before it died".
+    last_poll_ok_ts: float = 0.0
+    poll_failures: int = 0
+    replica_id: str = ""
+    uptime_s: float = 0.0
+
+    def staleness_s(self, now: float | None = None) -> float:
+        """Seconds since the last successful poll (-1 = never)."""
+        if not self.last_poll_ok_ts:
+            return -1.0
+        return max(0.0, (now if now is not None else time.monotonic())
+                   - self.last_poll_ok_ts)
 
     def worst_hbm_frac(self) -> float:
         """Worst per-device memory fraction — the mesh memory signal
@@ -211,7 +231,11 @@ class EndpointPicker:
     def __init__(self, endpoints: list[Endpoint],
                  poll_interval: float = 1.0,
                  mode: str = "static",
-                 slo_ttft_ms: float = 0.0):
+                 slo_ttft_ms: float = 0.0,
+                 fleet_obs: bool = True,
+                 slo_objective: float = 0.95,
+                 slo_window_s: float = 30.0,
+                 slo_burn_windows: int = 3):
         if mode not in ("static", "slo"):
             raise ValueError(f"picker mode must be 'static' or 'slo' "
                              f"(got {mode!r})")
@@ -244,6 +268,17 @@ class EndpointPicker:
         # fleet-wide chain-hash → replica index (ISSUE 11), fed by the
         # kv_chains digests this poll loop already collects
         self.kv_index = KVIndex()
+        # fleet observability plane (ISSUE 12): health state machine +
+        # rollups + the live SLO burn-rate monitor, all fed from this
+        # same poll loop. fleet_obs=False drops the monitor (the A/B
+        # control); the health machine itself is a few dict ops and
+        # stays on — /fleet/state must always answer.
+        self.fleet_obs = fleet_obs
+        self.fleet = FleetState(
+            slomon=SLOMonitor(
+                slo_ms=slo_ttft_ms, objective=slo_objective,
+                window_s=slo_window_s, k_windows=slo_burn_windows)
+            if fleet_obs else None)
         # prefix hash → KV chain hash learned from tpuserve response
         # headers (x-aigw-kv-chain): resolves a request's prefix head
         # to the content chain the index can locate, LRU-bounded
@@ -280,18 +315,33 @@ class EndpointPicker:
     async def _poll_one(self, session: aiohttp.ClientSession,
                         e: Endpoint) -> None:
         st = self.state[e.address]
-        try:
-            async with session.get(f"http://{e.address}/state") as resp:
-                if resp.status != 200:
-                    st.healthy = False
-                    self.kv_index.remove(e.address)
-                    return
-                data = await resp.json()
-        except (aiohttp.ClientError, asyncio.TimeoutError):
+
+        def failed() -> None:
+            # the stale-poll fix (ISSUE 12): a failed poll used to flip
+            # `healthy` and nothing else — the last-good telemetry sat
+            # frozen underneath. Count the failure, feed the fleet
+            # health machine, and leave last_poll_ok_ts aging so every
+            # consumer can SEE the staleness instead of trusting the
+            # replica's last happy self.
             st.healthy = False
+            st.poll_failures += 1
             # expiry on replica death: a fetch pointed at a dead
             # sibling only wastes the fetch timeout
             self.kv_index.remove(e.address)
+            self.fleet.note_poll(e.address, False)
+
+        try:
+            async with session.get(f"http://{e.address}/state") as resp:
+                if resp.status != 200:
+                    failed()
+                    return
+                data = await resp.json()
+        except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
+            # ValueError covers a replica answering 200 with a torn /
+            # non-JSON body (json.JSONDecodeError): previously that
+            # escaped this handler and the replica stayed "healthy" on
+            # entirely stale data — the frozen-EndpointState bug
+            failed()
             return
         st.healthy = True
         st.kv_occupancy = float(data.get("kv_occupancy", 0.0))
@@ -320,7 +370,14 @@ class EndpointPicker:
         st.kv_chains = tuple(
             str(k) for k in (data.get("kv_chains") or ()))
         self.kv_index.update(e.address, st.kv_chains)
+        st.replica_id = str(data.get("replica_id", "") or "")
+        st.uptime_s = float(data.get("uptime_s", 0.0) or 0.0)
+        st.poll_failures = 0
+        st.last_poll_ok_ts = time.monotonic()
         st.updated_at = time.monotonic()
+        # fleet aggregation (ISSUE 12): health machine + rollup source
+        # + the burn-rate monitor's histogram feed, all off this poll
+        self.fleet.note_poll(e.address, True, data)
 
     # -- manual state injection (tests / push-based telemetry) ------------
     def observe(self, address: str, *, kv_occupancy: float = 0.0,
@@ -365,7 +422,10 @@ class EndpointPicker:
         if kv_chains:
             st.kv_chains = tuple(kv_chains)
             self.kv_index.update(address, st.kv_chains)
+        st.poll_failures = 0
+        st.last_poll_ok_ts = time.monotonic()
         st.updated_at = time.monotonic()
+        self.fleet.note_poll(address, True)
 
     # -- picking ----------------------------------------------------------
     #: a sticky endpoint keeps the session unless its score exceeds the
@@ -428,7 +488,16 @@ class EndpointPicker:
         current queue head has already been stuck (queue_wait_ms: a
         moving queue predicts near zero, a wedged one predicts its own
         stall). None when the replica has no histogram data at all — a
-        replica that has served nothing predicts nothing."""
+        replica that has served nothing predicts nothing — and None
+        when the telemetry is STALE (no successful poll within
+        STALE_AFTER): a dead replica's last happy histograms predict
+        nothing either (ISSUE 12 stale-poll fix; pick() also excludes
+        stale endpoints, this guards direct callers like the
+        migration orchestrator and push-fed test state)."""
+        if (st.last_poll_ok_ts
+                and time.monotonic() - st.last_poll_ok_ts
+                >= self.STALE_AFTER):
+            return None
         pp = st.phase_percentiles or {}
         pf = float((pp.get("prefill") or {}).get("p50", -1.0))
         if pf < 0:
@@ -620,6 +689,8 @@ class EndpointPicker:
                     adapter_affinity=bool(adapter_key) and adapter_key
                     in self.state[chosen].adapters_resident,
                     kv_fleet_hit=chosen in kv_holders,
+                    staleness_s=round(
+                        self.state[chosen].staleness_s(now), 3),
                 )
         elif not fresh:
             # no telemetry (cold start / all down): round-robin blindly
@@ -652,6 +723,11 @@ class EndpointPicker:
                     adapter_affinity=bool(adapter_key) and adapter_key
                     in self.state[chosen].adapters_resident,
                     kv_fleet_hit=chosen in kv_holders,
+                    # how old the chosen replica's telemetry is — the
+                    # decision ring / span answer to "was this routed
+                    # on current truth or near-stale data"
+                    staleness_s=round(
+                        self.state[chosen].staleness_s(now), 3),
                 )
         if affinity_key:
             self._affinity[affinity_key] = chosen

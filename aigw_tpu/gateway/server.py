@@ -43,6 +43,11 @@ from aigw_tpu.config.runtime import RuntimeBackend, RuntimeConfig
 from aigw_tpu.gateway.auth import AuthError
 from aigw_tpu.gateway.circuit import CircuitBreaker
 from aigw_tpu.gateway.costs import TokenUsage
+from aigw_tpu.gateway.fleetstate import (
+    DecisionRing,
+    merge_rollups,
+    relabel_exposition,
+)
 from aigw_tpu.gateway.mutators import apply_body_mutation, apply_header_mutation
 from aigw_tpu.gateway.picker import (
     ADAPTER_HEADER,
@@ -61,7 +66,11 @@ from aigw_tpu.gateway.router import (
     match_route,
     split_model,
 )
-from aigw_tpu.obs.metrics import GenAIMetrics, RequestMetrics
+from aigw_tpu.obs.metrics import (
+    GenAIMetrics,
+    RequestMetrics,
+    render_fleet_gauges,
+)
 from aigw_tpu.obs.tracing import (
     DEFAULT_HEADER_ATTRIBUTES,
     Tracer,
@@ -252,6 +261,18 @@ class GatewayServer:
         self.app.router.add_get("/v1/models", self._handle_models)
         self.app.router.add_get("/health", self._handle_health)
         self.app.router.add_get("/metrics", self._handle_metrics)
+        # fleet observability plane (ISSUE 12): one pane of glass over
+        # every picker-polled replica pool — aggregated health/SLO
+        # state, Prometheus federation, and the routing-decision audit
+        # ring (always on, like tpuserve's flight recorder: decisions
+        # are the gateway's timelines and carry no credentials)
+        self.app.router.add_get("/fleet/state", self._handle_fleet_state)
+        self.app.router.add_get("/fleet/metrics",
+                                self._handle_fleet_metrics)
+        self.app.router.add_get("/debug/decisions",
+                                self._handle_decisions)
+        self.decisions = DecisionRing(
+            capacity=int(os.environ.get("AIGW_DECISION_RING", "512")))
         # debug/admin surface (reference: pprof :6060 + admin server on a
         # separate local port, internal/pprof/pprof.go:18-40). Off by
         # default on the data-plane port — any API client could otherwise
@@ -322,7 +343,8 @@ class GatewayServer:
                 continue
             prev = self._pickers.get(name)
             key = (b.endpoints, b.picker_poll_interval, b.picker_mode,
-                   b.slo_ttft_ms)
+                   b.slo_ttft_ms, b.fleet_obs, b.slo_objective,
+                   b.slo_window_s, b.slo_burn_windows)
             if prev is not None and getattr(prev, "_config_key", None) == key:
                 pickers[name] = prev  # unchanged pool: keep state
                 continue
@@ -331,6 +353,10 @@ class GatewayServer:
                 poll_interval=b.picker_poll_interval,
                 mode=b.picker_mode,
                 slo_ttft_ms=b.slo_ttft_ms,
+                fleet_obs=b.fleet_obs,
+                slo_objective=b.slo_objective,
+                slo_window_s=b.slo_window_s,
+                slo_burn_windows=b.slo_burn_windows,
             )
             picker._config_key = key  # type: ignore[attr-defined]
             pickers[name] = picker
@@ -374,6 +400,87 @@ class GatewayServer:
     async def _handle_metrics(self, _request: web.Request) -> web.Response:
         return web.Response(body=self.metrics.export(),
                             content_type="text/plain")
+
+    # -- fleet observability plane (ISSUE 12) -----------------------------
+    async def _handle_fleet_state(self, _request: web.Request
+                                  ) -> web.Response:
+        """Aggregated fleet snapshot: per-replica health machine state
+        + event rings + staleness stamps + key gauges, per-backend
+        rollups, and the live SLO burn-rate windows — one pane of glass
+        over every picker-polled pool."""
+        backends = {
+            name: picker.fleet.snapshot(picker.state)
+            for name, picker in self._pickers.items()
+        }
+        return web.json_response({
+            "ts": round(time.time(), 3),
+            "backends": backends,
+            "fleet": merge_rollups(
+                [b["rollup"] for b in backends.values()]),
+            "decisions_recorded": self.decisions.recorded,
+        })
+
+    async def _handle_fleet_metrics(self, _request: web.Request
+                                    ) -> web.Response:
+        """Prometheus federation: every replica's ``tpuserve_*``
+        samples re-exported with a ``replica`` label (histograms,
+        per-device gauges and exemplars included) plus the
+        ``aigw_fleet_*`` rollup gauges — one scrape covers the fleet."""
+        session = await self._get_session()
+        chunks: list[bytes] = []
+        seen: set = set()
+        errors = 0
+
+        async def scrape(addr: str) -> str | None:
+            try:
+                async with session.get(
+                    f"http://{addr}/metrics",
+                    timeout=aiohttp.ClientTimeout(total=2.0),
+                ) as resp:
+                    if resp.status != 200:
+                        return None
+                    return (await resp.read()).decode(
+                        "utf-8", errors="replace")
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                return None
+
+        for name, picker in self._pickers.items():
+            addrs = [e.address for e in picker.endpoints
+                     if picker.fleet.health_of(e.address) != "down"]
+            texts = await asyncio.gather(*(scrape(a) for a in addrs))
+            for addr, text in zip(addrs, texts):
+                if text is None:
+                    errors += 1
+                    continue
+                chunks.append(
+                    relabel_exposition(text, addr, seen).encode())
+            label = name if len(self._pickers) > 1 else ""
+            chunks.append(render_fleet_gauges(
+                picker.fleet.rollup(picker.state), backend=label))
+        chunks.append(
+            b"# TYPE aigw_fleet_scrape_errors gauge\n"
+            b"aigw_fleet_scrape_errors %d\n" % errors)
+        return web.Response(body=b"".join(chunks),
+                            content_type="text/plain")
+
+    async def _handle_decisions(self, request: web.Request
+                                ) -> web.Response:
+        """The routing-decision audit ring: every pick's full explain
+        (candidates, scores, predicted-TTFT map, affinity terms), shed
+        events with their Retry-After, and migration stamps — filter
+        with ``?rid=<x-aigw-request-id>`` to join one decision against
+        the serving replica's /debug/requests/{id} timeline."""
+        rid = request.query.get("rid", "")
+        try:
+            limit = max(1, min(1000, int(
+                request.query.get("limit", "100"))))
+        except ValueError:
+            limit = 100
+        return web.json_response({
+            "capacity": self.decisions.capacity,
+            "recorded": self.decisions.recorded,
+            "decisions": self.decisions.snapshot(rid=rid, limit=limit),
+        })
 
     async def _handle_models(self, request: web.Request) -> web.Response:
         """/v1/models — configured models, host-scoped like the
@@ -683,6 +790,7 @@ class GatewayServer:
                     request_id=client_headers.get("x-request-id", ""),
                     upstream_request_id=req_metrics.upstream_request_id,
                     attempts=req_metrics.attempts,
+                    decision=req_metrics.decision,
                 )
 
     def _openinference_request_attrs(
@@ -898,6 +1006,7 @@ class GatewayServer:
         # in-process picker chooses a replica from the backend's pool.
         dest = request.headers.get(DESTINATION_ENDPOINT_HEADER, "")
         prefix_key_used = ""
+        decision: dict[str, Any] | None = None
         if not dest and backend.name in self._pickers:
             pick_headers = client_headers
             if backend.picker_content_affinity and isinstance(body, dict):
@@ -922,8 +1031,10 @@ class GatewayServer:
             if adapter and ADAPTER_HEADER not in pick_headers:
                 pick_headers = dict(pick_headers) | {
                     ADAPTER_HEADER: adapter}
-            explain: dict[str, Any] | None = (
-                {} if span is not None else None)
+            # explain is ALWAYS computed now (ISSUE 12): the decision
+            # audit ring records every pick, traced or not — the span
+            # attrs below still only render when tracing is on
+            explain: dict[str, Any] = {}
             try:
                 dest = self._pickers[backend.name].pick(
                     pick_headers, explain=explain) or ""
@@ -936,6 +1047,17 @@ class GatewayServer:
                 self.metrics.requests_total.labels(
                     route_name, backend.name, "429").inc()
                 req_metrics.finish(TokenUsage(), error_type="slo_shed")
+                if backend.fleet_obs:
+                    # shed events land in the audit ring too — "why
+                    # did my request 429" is a routing decision
+                    req_metrics.decision = self.decisions.record(
+                        route=route_name, backend=backend.name,
+                        model=req_metrics.request_model,
+                        request_id=client_headers.get(
+                            "x-request-id", ""),
+                        shed=True,
+                        retry_after_s=e.retry_after_s,
+                        pick=dict(explain))
                 if span is not None:
                     span.set("aigw.pick.shed", True)
                     span.set("aigw.pick.predicted_ttft_ms",
@@ -945,6 +1067,14 @@ class GatewayServer:
                     body=error_body(str(e), type_="rate_limit_error"),
                     headers={"retry-after": str(e.retry_after_s)},
                     content_type="application/json")
+            if dest and backend.fleet_obs:
+                decision = self.decisions.record(
+                    route=route_name, backend=backend.name,
+                    model=req_metrics.request_model,
+                    request_id=client_headers.get("x-request-id", ""),
+                    chosen=dest,
+                    pick=dict(explain))
+                req_metrics.decision = decision
             if span is not None and dest:
                 # why the picker chose this replica — the span-level
                 # answer to "which endpoint served me, and was it
@@ -964,6 +1094,8 @@ class GatewayServer:
                     dest, pick_headers)
                 if peers:
                     headers[KV_PEERS_HEADER] = ",".join(peers)
+                    if decision is not None:
+                        decision["kv_peers"] = list(peers)
         base_url = f"http://{dest}" if dest else backend.url
         if not base_url:
             raise _RetriableUpstreamError(
@@ -1042,6 +1174,12 @@ class GatewayServer:
             # line against the replica's /debug/requests/{id} timeline
             req_metrics.upstream_request_id = resp.headers.get(
                 "x-aigw-request-id", "")
+            if decision is not None and req_metrics.upstream_request_id:
+                # the audit-ring join key (ISSUE 12): the decision now
+                # resolves straight to the serving replica's
+                # flight-recorder timeline under the same id
+                decision["upstream_request_id"] = (
+                    req_metrics.upstream_request_id)
             if backend.name in self._pickers:
                 # learn (prefix-head → KV chain) from the replica's
                 # response — the fleet index can then locate this
@@ -1066,7 +1204,8 @@ class GatewayServer:
                     # mid-flight if the source's prefill queue backs up
                     migrator = _Migrator(
                         picker=self._pickers[backend.name],
-                        backend=backend, src=dest, session=session)
+                        backend=backend, src=dest, session=session,
+                        decision=decision)
                 return await self._stream_response(
                     request, resp, translator, rb, req_metrics, route_name,
                     client_headers, front_schema, span=span,
@@ -1477,7 +1616,8 @@ class _Migrator:
     export leaves the source serving untouched."""
 
     def __init__(self, picker: EndpointPicker, backend, src: str,
-                 session: aiohttp.ClientSession):
+                 session: aiohttp.ClientSession,
+                 decision: dict | None = None):
         self.picker = picker
         self.backend = backend
         self.src = src
@@ -1485,6 +1625,10 @@ class _Migrator:
         self.attempted = False
         self.export: dict | None = None
         self.target: str | None = None
+        #: the request's audit-ring entry (ISSUE 12): a fired migration
+        #: is part of the routing decision's afterlife — stamped here
+        #: so /debug/decisions shows the trigger next to the pick
+        self.decision = decision
 
     def _pick_target(self) -> str | None:
         src_st = self.picker.state.get(self.src)
@@ -1544,6 +1688,13 @@ class _Migrator:
                     return
                 self.export = await r.json()
             self.target = target
+            if self.decision is not None:
+                self.decision["migrated_to"] = target
+                self.decision["migration_trigger"] = {
+                    "src_queued": int(getattr(
+                        self.picker.state.get(self.src), "queued", 0)),
+                    "tokens_seen": tokens_seen,
+                }
             logger.info("migrating session %s: %s -> %s", rid, self.src,
                         target)
         except (aiohttp.ClientError, asyncio.TimeoutError) as e:

@@ -111,6 +111,7 @@ class AccessLogger:
         request_id: str = "",
         upstream_request_id: str = "",
         attempts: int = 0,
+        decision: dict[str, Any] | None = None,
     ) -> None:
         if self._fp is None:
             return
@@ -157,6 +158,25 @@ class AccessLogger:
             entry["upstream_request_id"] = upstream_request_id
         if attempts > 1:
             entry["attempts"] = attempts
+        if decision:
+            # routing outcome (ISSUE 12): the compact view of the
+            # gateway's decision-ring entry — chosen endpoint plus the
+            # flags that change what a log reader does next. The full
+            # explain stays in /debug/decisions (joined by
+            # upstream_request_id), not on every log line.
+            d: dict[str, Any] = {}
+            if decision.get("chosen"):
+                d["endpoint"] = decision["chosen"]
+            pick = decision.get("pick") or {}
+            for flag in ("kv_fleet_hit", "sticky", "prefix_affinity"):
+                if pick.get(flag):
+                    d[flag] = True
+            if decision.get("shed"):
+                d["shed"] = True
+            if decision.get("migrated_to"):
+                d["migrated_to"] = decision["migrated_to"]
+            if d:
+                entry["decision"] = d
         try:
             self._q.put_nowait(json.dumps(entry) + "\n")
         except queue.Full:

@@ -258,6 +258,54 @@ def render_device_gauges(devices: list) -> bytes:
     return ("\n".join(lines) + "\n").encode() if lines else b""
 
 
+#: fleet rollup surface (ISSUE 12): key in ``FleetState.rollup()`` →
+#: aggregate gauge name on the gateway's ``GET /fleet/metrics``. One
+#: authoritative map, same drift-check contract as ENGINE_GAUGES —
+#: every key here must appear in the rollup dict and every gauge must
+#: render on the federation scrape next to the replica-labeled
+#: ``tpuserve_*`` re-exports.
+FLEET_GAUGES: tuple[tuple[str, str], ...] = (
+    ("replicas_total", "aigw_fleet_replicas_total"),
+    ("replicas_up", "aigw_fleet_replicas_up"),
+    ("replicas_degraded", "aigw_fleet_replicas_degraded"),
+    ("replicas_draining", "aigw_fleet_replicas_draining"),
+    ("replicas_down", "aigw_fleet_replicas_down"),
+    ("slots_total", "aigw_fleet_slots_total"),
+    ("slots_free", "aigw_fleet_slots_free"),
+    ("queued_total", "aigw_fleet_queued_total"),
+    ("kv_occupancy_worst", "aigw_fleet_kv_occupancy_worst"),
+    ("kv_occupancy_mean", "aigw_fleet_kv_occupancy_mean"),
+    ("device_memory_frac_worst",
+     "aigw_fleet_device_memory_frac_worst"),
+    ("kv_spills_total", "aigw_fleet_kv_spills_total"),
+    ("kv_revives_total", "aigw_fleet_kv_revives_total"),
+    ("kv_fetch_pages_in_total", "aigw_fleet_kv_fetch_pages_in_total"),
+    ("kv_fetch_pages_out_total",
+     "aigw_fleet_kv_fetch_pages_out_total"),
+    ("migrations_in_total", "aigw_fleet_migrations_in_total"),
+    ("migrations_out_total", "aigw_fleet_migrations_out_total"),
+    ("adapters_resident", "aigw_fleet_adapters_resident"),
+    # live SLO burn-rate monitor (obs/slomon.py): latest closed
+    # window's fleet goodput/burn (-1 = no closed window yet) and the
+    # K-consecutive-windows sustained-overshoot flag ROADMAP item 2's
+    # autoscaler consumes
+    ("slo_goodput", "aigw_fleet_slo_goodput"),
+    ("slo_burn_rate", "aigw_fleet_slo_burn_rate"),
+    ("slo_overshoot_sustained", "aigw_fleet_slo_overshoot_sustained"),
+)
+
+
+def render_fleet_gauges(rollup: dict, backend: str = "") -> bytes:
+    """FleetState rollup dict → aigw_fleet_* Prometheus gauges,
+    labeled by backend pool when the gateway serves more than one."""
+    sel = f'{{backend="{backend}"}}' if backend else ""
+    lines = []
+    for key, name in FLEET_GAUGES:
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name}{sel} {rollup.get(key, 0)}")
+    return ("\n".join(lines) + "\n").encode()
+
+
 def render_engine_gauges(stats: object) -> bytes:
     """EngineStats → Prometheus text exposition (appended to the
     prometheus_client registry output on tpuserve's /metrics)."""
@@ -353,6 +401,21 @@ class PhaseHistogram:
             "p95": round(self.percentile(0.95), 3),
             "p99": round(self.percentile(0.99), 3),
         }
+
+    def cumulative(self) -> dict[str, int]:
+        """Cumulative bucket counts ``{le: count}`` (including +Inf) —
+        the JSON twin of the /metrics bucket lines, exported on /state
+        (``ttft_hist_buckets``) so the gateway's burn-rate monitor
+        (obs/slomon.py) consumes the histogram straight off the poll it
+        already makes, no second scrape."""
+        out: dict[str, int] = {}
+        cum = 0
+        for i, c in enumerate(self.counts):
+            cum += c
+            le = (f"{self.buckets[i]:g}" if i < len(self.buckets)
+                  else "+Inf")
+            out[le] = cum
+        return out
 
     def render(self) -> str:
         """Prometheus histogram exposition; bucket lines carry
@@ -470,6 +533,11 @@ class RequestMetrics:
     # response header) — joins gateway access-log lines against the
     # replica's /debug/requests/{id} flight-recorder timeline
     upstream_request_id: str = ""
+    # the routing decision's audit-ring entry (ISSUE 12, mutable — the
+    # ring owner keeps updating it): the access log extracts the
+    # compact outcome fields so log lines join the decision ring the
+    # same way they join spans and flight timelines
+    decision: dict = field(default_factory=dict)
 
     def _labels(self) -> list[str]:
         return [

@@ -1803,22 +1803,25 @@ class Engine:
             # path (admission / EOS / rung moves) and re-trace per
             # page-bucket state shape: compile them on a throwaway
             # state at THIS bucket so the first membership change at
-            # any warmed bucket pays nothing
+            # any warmed bucket pays nothing. The throwaway stays a
+            # LOCAL — warmup runs on the server thread while the
+            # engine loop is already live, and publishing it through
+            # self._device_state raced the loop's quiesce path (no
+            # active slots → _device_state = None) into the middle of
+            # this warm sequence (observed as warmup crashing on a
+            # None state under slow compiles).
             state = self._build_device_state(bucket=P)
-            self._dirty_rows.add(0)
-            saved, self._device_state = self._device_state, state
-            self._apply_row_updates()
+            state = self._row_update_fn_built()(
+                state, np.int32(0), self._row_host_values(0, P))
             if self._spec_max:
-                self._spec_dirty.add(0)
-                self._apply_spec_row_updates()
+                state = self._spec_update_fn_built()(
+                    state, np.int32(0), np.int32(0))
             # the constrained-decoding bias-row scatter also runs on
             # the hot path (every FSM advance of a constrained slot)
             if self.cfg.constrained_decoding:
                 V = self.model_cfg.vocab_size
-                self._device_state = self._cn_update_fn_built()(
-                    self._device_state, np.int32(0),
-                    np.zeros((V,), np.float32))
-            self._device_state = saved
+                state = self._cn_update_fn_built()(
+                    state, np.int32(0), np.zeros((V,), np.float32))
         if self._adapter_store is not None:
             # the hot-load row scatters run on the admission path: the
             # first non-resident adapter admission (or any later mix
@@ -2955,11 +2958,7 @@ class Engine:
                 row["la_len"] = np.int32(len(s.la_tokens))
         return row
 
-    def _apply_row_updates(self) -> None:
-        """Scatter dirty slot rows into the LIVE device state — no
-        pipeline drain, no full re-upload. JAX chains the update after
-        the in-flight window's scan, so admission/finish no longer
-        stalls the decode pipeline for a whole window."""
+    def _row_update_fn_built(self):
         if self._row_update_fn is None:
             def _upd(state, i, row):
                 return self._pin_state({
@@ -2970,12 +2969,30 @@ class Engine:
 
             self._row_update_fn = self.compile_tracker.register(
                 "row_update", jax.jit(_upd, donate_argnums=(0,)))
+        return self._row_update_fn
+
+    def _apply_row_updates(self) -> None:
+        """Scatter dirty slot rows into the LIVE device state — no
+        pipeline drain, no full re-upload. JAX chains the update after
+        the in-flight window's scan, so admission/finish no longer
+        stalls the decode pipeline for a whole window."""
+        self._row_update_fn_built()
         P = self._state_bucket
         for i in sorted(self._dirty_rows):
             self._device_state = self._row_update_fn(
                 self._device_state, np.int32(i),
                 self._row_host_values(i, P))
         self._dirty_rows.clear()
+
+    def _spec_update_fn_built(self):
+        if self._spec_update_fn is None:
+            def _sup(state, i, d):
+                return self._pin_state(dict(
+                    state, draft_len=state["draft_len"].at[i].set(d)))
+
+            self._spec_update_fn = self.compile_tracker.register(
+                "spec_row_update", jax.jit(_sup, donate_argnums=(0,)))
+        return self._spec_update_fn
 
     def _apply_spec_row_updates(self) -> None:
         """Patch live slots' on-device ``draft_len`` after an adaptive
@@ -2985,13 +3002,7 @@ class Engine:
         re-uploading its full row mid-pipeline would rewind it, but
         the draft length is position-independent and safe to patch at
         any time."""
-        if self._spec_update_fn is None:
-            def _sup(state, i, d):
-                return self._pin_state(dict(
-                    state, draft_len=state["draft_len"].at[i].set(d)))
-
-            self._spec_update_fn = self.compile_tracker.register(
-                "spec_row_update", jax.jit(_sup, donate_argnums=(0,)))
+        self._spec_update_fn_built()
         for i in sorted(self._spec_dirty):
             s = self._slots[i]
             d = (s.ctrl.draft_len()

@@ -190,6 +190,13 @@ class TPUServeServer:
         self.flight = FlightRecorder(capacity=flight_entries)
         self._enable_profile = enable_profile_endpoint
         self._profile_lock = asyncio.Lock()
+        # replica identity for the gateway's fleet aggregator (ISSUE
+        # 12): a fresh id per process boot — the same address with a
+        # NEW id is a restart (counters reset), which the fleet health
+        # ring records as an event instead of mistaking the zeroed
+        # counters for a quiet replica
+        self.replica_id = uuid.uuid4().hex[:16]
+        self._started_at = time.time()
 
         mesh = None
         if tp > 1 or ep > 1 or sp > 1:
@@ -1565,6 +1572,18 @@ class TPUServeServer:
         return web.json_response(
             {
                 "model": self.model_name,
+                # replica identity/uptime (ISSUE 12): the fleet
+                # aggregator keys restart detection on replica_id and
+                # displays uptime per replica
+                "replica_id": self.replica_id,
+                "started_at": round(self._started_at, 3),
+                "uptime_s": round(time.time() - self._started_at, 3),
+                # cumulative TTFT histogram buckets — the gateway's
+                # live SLO burn-rate monitor (obs/slomon.py) computes
+                # windowed goodput from the deltas of this field, off
+                # the /state poll the picker already makes
+                "ttft_hist_buckets":
+                    self.engine.phases.hists["ttft"].cumulative(),
                 # adapter serving subsystem (ISSUE 7): the zoo, device
                 # residency, load/evict churn, and in-flight adapter
                 # slots — the gateway picker's adapter-affinity signal

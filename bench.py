@@ -52,6 +52,7 @@ if ("--cpu-gateway-ratio" in sys.argv or "--ab" in sys.argv
 import jax.numpy as jnp
 
 from aigw_tpu.models import llama
+from aigw_tpu.obs import slomon
 from aigw_tpu.tpuserve.engine import Engine, EngineConfig, GenRequest
 from aigw_tpu.tpuserve.sampling import SamplingParams, sample
 
@@ -1599,18 +1600,11 @@ def _poisson_trace(seed: int, n: int, rate_hz: float,
     return out
 
 
-def _parse_hist_buckets(text: str, name: str) -> dict[str, int]:
-    """Cumulative bucket counts of one Prometheus histogram family from
-    /metrics exposition text: {le: cumulative_count}. Tolerates the
-    OpenMetrics exemplar suffix tpuserve renders on bucket lines."""
-    import re
-
-    out: dict[str, int] = {}
-    for m in re.finditer(
-            rf'^{re.escape(name)}_bucket{{le="([^"]+)"}}\s+(\d+)',
-            text, re.M):
-        out[m.group(1)] = int(m.group(2))
-    return out
+#: histogram parsing generalized into the live gateway monitor (ISSUE
+#: 12, obs/slomon.py) — the bench keeps its old name as an alias; the
+#: shared parser additionally tolerates extra labels, so the gateway's
+#: replica-labeled /fleet/metrics federation parses with the same code
+_parse_hist_buckets = slomon.parse_hist_buckets
 
 
 def _sum_hists(hists: list[dict]) -> dict[str, int]:
@@ -1627,20 +1621,12 @@ def _goodput_fields(h0: dict, h1: dict, slo_ms: float, arrivals: int,
     SERVER-SIDE TTFT histograms (cumulative bucket deltas), not client
     clocks: under_slo = requests whose engine-observed TTFT landed in a
     bucket ≤ the SLO. goodput = under_slo / arrivals — shed and
-    never-served requests count against goodput by construction."""
-    def under(h: dict) -> int:
-        best = 0.0
-        val = 0
-        for le, c in h.items():
-            if le == "+Inf":
-                continue
-            f = float(le)
-            if f <= slo_ms and f >= best:
-                best, val = f, c
-        return val
-
+    never-served requests count against goodput by construction.
+    The bucket math is the shared slomon implementation the gateway's
+    live burn-rate monitor runs on the same histograms."""
     total = h1.get("+Inf", 0) - h0.get("+Inf", 0)
-    u = under(h1) - under(h0)
+    u = (slomon.under_slo_count(h1, slo_ms)
+         - slomon.under_slo_count(h0, slo_ms))
     return {
         f"{prefix}_arrivals": arrivals,
         f"{prefix}_served": total,
@@ -1829,6 +1815,10 @@ def slo_routing_numbers(arrivals: int = 36, reps: int = 3) -> dict:
                         else {}
                     if mode == "slo":
                         extra["slo_ttft_ms"] = slo_ms
+                        # short burn windows so the live monitor closes
+                        # several during the trace — the fleet fields
+                        # below carry real burn data, not -1 sentinels
+                        extra["slo_window_s"] = 5.0
                     gw, stop_gw = _start_gateway_cfg(extra, addrs)
                     try:
                         await _wait_health(gw, 120)
@@ -1848,6 +1838,13 @@ def slo_routing_numbers(arrivals: int = 36, reps: int = 3) -> dict:
                         acc[mode].append(g["x_goodput"])
                         sheds[mode] += res["shed"]
                         retry_ok += res["shed_retry_after"]
+                        if mode == "slo" and rep == reps - 1:
+                            # fleet observability plane (ISSUE 12):
+                            # carry the aggregated fleet snapshot +
+                            # live burn-rate fields into the capture
+                            async with s.get(gw + "/fleet/state") as r:
+                                out.update(_fleet_obs_fields(
+                                    await r.json(), "slo_fleet"))
                     finally:
                         stop_gw()
             # PAIRED comparison: rep i's slo and static captures ran
@@ -1876,6 +1873,214 @@ def slo_routing_numbers(arrivals: int = 36, reps: int = 3) -> dict:
                     _spread(acc["static"]), 3),
             })
             return out
+
+    try:
+        return asyncio.run(run())
+    finally:
+        stop_a()
+        stop_b()
+
+
+# -- fleet observability plane (ISSUE 12) ---------------------------------
+
+def _fleet_obs_fields(snapshot: dict, prefix: str = "fleet") -> dict:
+    """Flatten a gateway /fleet/state payload into bench JSON fields —
+    future BENCH_r* captures carry fleet-level telemetry (health
+    counts, worst pressure, live burn rate), not just client-side
+    ratios (unit-tested in tests/test_bench_smoke.py)."""
+    ru = snapshot.get("fleet") or {}
+    slo: dict = {}
+    health: dict[str, str] = {}
+    for b in (snapshot.get("backends") or {}).values():
+        slo = slo or (b.get("slo") or {})
+        for addr, r in (b.get("replicas") or {}).items():
+            health[addr] = (r.get("health") or {}).get("state", "?")
+    return {
+        f"{prefix}_replicas_up": int(ru.get("replicas_up", 0)),
+        f"{prefix}_replicas_degraded": int(
+            ru.get("replicas_degraded", 0)),
+        f"{prefix}_replicas_down": int(ru.get("replicas_down", 0)),
+        f"{prefix}_slots_free": int(ru.get("slots_free", 0)),
+        f"{prefix}_slots_total": int(ru.get("slots_total", 0)),
+        f"{prefix}_kv_occupancy_worst": float(
+            ru.get("kv_occupancy_worst", 0.0)),
+        f"{prefix}_hbm_frac_worst": float(
+            ru.get("device_memory_frac_worst", 0.0)),
+        f"{prefix}_goodput": float(slo.get("goodput", -1.0)),
+        f"{prefix}_burn_rate": float(slo.get("burn_rate", -1.0)),
+        f"{prefix}_overshoot_sustained": bool(
+            slo.get("sustained_overshoot", False)),
+        f"{prefix}_health": dict(sorted(health.items())),
+        f"{prefix}_decisions": int(
+            snapshot.get("decisions_recorded", 0)),
+    }
+
+
+def _fleet_fields_from_states(st0s: dict, st1s: dict, slo_ms: float,
+                              prefix: str = "fleet") -> dict:
+    """Fleet-level fields for the gateway-LESS legs (kv_tier drives
+    replicas directly): goodput/burn over the leg window from the
+    replicas' cumulative /state ttft_hist_buckets deltas — the same
+    slomon math the gateway monitor runs — plus occupancy/slot
+    rollups from the closing snapshots."""
+    h0 = slomon.sum_buckets(
+        (st or {}).get("ttft_hist_buckets") or {} for st in st0s.values())
+    h1 = slomon.sum_buckets(
+        (st or {}).get("ttft_hist_buckets") or {} for st in st1s.values())
+    served = slomon.total_count(h1) - slomon.total_count(h0)
+    under = (slomon.under_slo_count(h1, slo_ms)
+             - slomon.under_slo_count(h0, slo_ms))
+    goodput = under / served if served > 0 else -1.0
+    occ = [float((st or {}).get("kv_occupancy", 0.0))
+           for st in st1s.values()]
+    return {
+        f"{prefix}_slo_ms": round(slo_ms, 1),
+        f"{prefix}_served": served,
+        f"{prefix}_goodput": round(goodput, 4),
+        f"{prefix}_burn_rate": (
+            round((1.0 - goodput) / 0.05, 4) if goodput >= 0 else -1.0),
+        f"{prefix}_kv_occupancy_worst": round(max(occ, default=0.0), 4),
+        f"{prefix}_slots_total": sum(
+            int((st or {}).get("max_slots", 0)) for st in st1s.values()),
+    }
+
+
+def fleet_obs_numbers(reps: int = 3, arrivals: int = 20) -> dict:
+    """The ``--ab fleet_obs`` leg (ISSUE 12): observability must be
+    ~free. The SAME seeded open-loop trace through two gateway
+    configurations over the same healthy two-replica pool — fleet_obs
+    ON (decision ring recording every pick + the burn-rate monitor
+    chewing polled histograms + a federation scraper hammering
+    /fleet/metrics and /fleet/state at 4 Hz throughout) vs fleet_obs
+    OFF (no ring, no monitor, no scraping). The claim: throughput
+    ratio ≥ 0.95 and ZERO hot XLA compiles from the telemetry path."""
+    import aiohttp
+
+    model_name = "bench-fleetobs-tiny"
+    k = int(os.environ.get("AIGW_BENCH_CPU_K", "4"))
+    # warm_decode_buckets: decode programs re-trace per pow2 page-table
+    # width (the PR 10 lesson) — without pre-compiling the ladder the
+    # timed reps pay first-use decode compiles that would masquerade as
+    # an observability tax in the hot-compile tripwire
+    engine = {"num_pages": 64, "max_queued_requests": 64,
+              "min_prefill_bucket": 32, "warm_decode_buckets": 7}
+    url_a, stop_a = _start_tpuserve_subproc(
+        model_name, CPU_CFG, "", batch=2, k_steps=k, engine=engine,
+        page=16)
+    url_b, stop_b = _start_tpuserve_subproc(
+        model_name, CPU_CFG, "", batch=2, k_steps=k, engine=engine,
+        page=16)
+    addrs = [u[len("http://"):] for u in (url_a, url_b)]
+
+    async def scrape_loop(s, gw: str, stop_evt: asyncio.Event) -> int:
+        n = 0
+        while not stop_evt.is_set():
+            try:
+                async with s.get(gw + "/fleet/metrics") as r:
+                    await r.read()
+                async with s.get(gw + "/fleet/state") as r:
+                    await r.json()
+                n += 1
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                pass
+            await asyncio.sleep(0.25)
+        return n
+
+    async def run() -> dict:
+        await _wait_health(url_a, 1200)
+        await _wait_health(url_b, 1200)
+        timeout = aiohttp.ClientTimeout(total=1200)
+        async with aiohttp.ClientSession(timeout=timeout) as s:
+            # off the clock: compile every shape the timed traces use —
+            # every (prompt_len, gen) combo deterministically, plus a
+            # bursty pass for the coalesced-admission group shapes
+            combos = [(pl, g) for pl in (48, 96, 160)
+                      for g in (2, 4, 6)]
+            for url, tg in ((url_a, "wa"), (url_b, "wb")):
+                warm = [{"at": 0.3 * i, "prompt_len": pl, "gen": g,
+                         "tenant": "", "i": i}
+                        for i, (pl, g) in enumerate(combos)]
+                await _drive_openloop(s, url, model_name, warm, tag=tg)
+                # coalesced-admission group shapes: simultaneous PAIRS
+                # over EVERY prompt-length combination (batch=2
+                # children) — the 0.3s-spaced pass above never
+                # coalesces, and mixed-length pairs land on token-
+                # budget rungs no same-length pair reaches, so a
+                # bursty timed trace would pay those prefill compiles
+                lens = (48, 96, 160)
+                duos = [(a, b) for i, a in enumerate(lens)
+                        for b in lens[i:]]
+                pairs = [{"at": 0.8 * j, "prompt_len": pl, "gen": 2,
+                          "tenant": "", "i": 100 + 2 * j + k}
+                         for j, (a, b) in enumerate(duos)
+                         for k, pl in enumerate((a, b))]
+                await _drive_openloop(s, url, model_name, pairs,
+                                      tag=tg + "p")
+                burst = _poisson_trace(seed=998, n=10, rate_hz=4.0,
+                                       gen_lens=(2, 4, 6))
+                await _drive_openloop(s, url, model_name, burst,
+                                      tag=tg + "b")
+            xla0 = -1
+            tput: dict[str, list] = {"on": [], "off": []}
+            scrapes = 0
+            snap: dict = {}
+            for rep in range(reps):
+                if rep == 1:
+                    # compile tripwire anchored AFTER rep 0: the first
+                    # on/off pair soaks whatever first-use geometry the
+                    # deterministic warm above still missed (arrival-
+                    # timing-dependent coalescing), so the steady-state
+                    # reps isolate compiles the OBSERVABILITY path adds
+                    # — which must be zero
+                    xla0 = sum([(await _get_state(s, u)
+                                 ).get("xla_compiles", 0)
+                                for u in (url_a, url_b)])
+                for mode in ("on", "off"):
+                    extra = ({"slo_window_s": 2.0} if mode == "on"
+                             else {"fleet_obs": False})
+                    gw, stop_gw = _start_gateway_cfg(extra, addrs)
+                    try:
+                        await _wait_health(gw, 120)
+                        await asyncio.sleep(1.0)  # first polls land
+                        trace = _poisson_trace(
+                            seed=1300 + rep, n=arrivals, rate_hz=3.0,
+                            gen_lens=(2, 4, 6))
+                        stop_evt = asyncio.Event()
+                        scraper = (asyncio.create_task(
+                            scrape_loop(s, gw, stop_evt))
+                            if mode == "on" else None)
+                        t0 = time.perf_counter()
+                        res = await _drive_openloop(
+                            s, gw, model_name, trace,
+                            tag=f"{mode[:1]}{rep}")
+                        wall = time.perf_counter() - t0
+                        stop_evt.set()
+                        if scraper is not None:
+                            scrapes += await scraper
+                            snap = await (await s.get(
+                                gw + "/fleet/state")).json()
+                        tput[mode].append(res["completed"] / wall)
+                    finally:
+                        stop_gw()
+            xla1 = sum([(await _get_state(s, u)).get("xla_compiles", 0)
+                        for u in (url_a, url_b)])
+            if xla0 < 0:
+                xla0 = xla1  # reps == 1: no steady-state window
+        ratios = [a / b for a, b in zip(tput["on"], tput["off"])
+                  if b > 0]
+        out = {
+            "fleet_obs_vs_off": round(_median(ratios), 4) if ratios
+            else 0.0,
+            "fleet_obs_vs_off_by_rep": [round(r, 4) for r in ratios],
+            "fleet_obs_spread": round(_spread(tput["on"]), 3),
+            "fleet_off_spread": round(_spread(tput["off"]), 3),
+            "fleet_obs_hot_compiles": int(xla1 - xla0),
+            "fleet_obs_scrapes": scrapes,
+            "fleet_obs_reps": reps,
+            "fleet_obs_arrivals": arrivals,
+        }
+        out.update(_fleet_obs_fields(snap, "fleet_obs"))
+        return out
 
     try:
         return asyncio.run(run())
@@ -2235,6 +2440,14 @@ def kv_tier_numbers(reps: int = 3, arrivals: int = 4) -> dict:
             st_a1 = await _get_state(s, url_a)
             fields = _kvtier_ab_fields(st_b0, st_b1, "kvtier_b")
             fields.update(_kvtier_ab_fields(st_a0, st_a1, "kvtier_a"))
+            # fleet-level telemetry for the capture (ISSUE 12): this
+            # leg has no gateway, so the fleet rollup + goodput over
+            # the timed window come straight from the replicas'
+            # /state histograms via the shared slomon math (1s TTFT
+            # reference SLO — a fixed yardstick, not a target)
+            fields.update(_fleet_fields_from_states(
+                {"a": st_a0, "b": st_b0}, {"a": st_a1, "b": st_b1},
+                slo_ms=1000.0, prefix="kvtier_fleet"))
 
             # spill→revive churn on A (off the clock): overflow the
             # 64-page pool so the primed chains spill, revive one
@@ -2475,6 +2688,11 @@ def run_cpu_ratio() -> dict:
     except Exception as e:
         print(f"kv_tier leg failed: {type(e).__name__}: {e}",
               file=sys.stderr)
+    try:
+        res.update(fleet_obs_numbers())
+    except Exception as e:
+        print(f"fleet_obs leg failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
     return res
 
 
@@ -2627,12 +2845,22 @@ def main() -> None:
                 "spill→revive churn counters on A's host tier and a "
                 "zero-hot-compile delta across the churn (CPU backend; "
                 "ratios are the signal)")
+        elif target == "fleet_obs":
+            result = fleet_obs_numbers()
+            result["metric"] = (
+                "fleet_obs A/B — the fleet observability plane (ISSUE "
+                "12) must be ~free: the same seeded open-loop trace "
+                "through a gateway with the decision ring + burn-rate "
+                "monitor on and a 4Hz /fleet/metrics federation "
+                "scraper running, vs everything off; throughput ratio "
+                "≥ 0.95 and zero hot XLA compiles are the claim (CPU "
+                "backend)")
         else:
             print(json.dumps({"error": f"unknown --ab target {target!r}; "
                               "supported: prefix_cache, spec_decode, "
                               "ragged_prefill, lora, disagg, "
                               "slo_routing, structured, mesh, "
-                              "kv_tier"}))
+                              "kv_tier, fleet_obs"}))
             return
         print(json.dumps(result))
         return

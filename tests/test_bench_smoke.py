@@ -415,3 +415,56 @@ def test_bench_kvtier_ab_fields():
         st0, dict(st1, xla_compiles=52), "k")["k_hot_compiles"] == 2
     z = bench._kvtier_ab_fields({}, {}, "z")
     assert all(v == 0 for v in z.values())
+
+
+@pytest.mark.bench_smoke
+def test_bench_fleet_obs_fields():
+    """Fleet observability fields (ISSUE 12): the --ab legs flatten a
+    gateway /fleet/state payload (and, for gateway-less legs, raw
+    replica states) into the bench JSON line through these pure
+    helpers — BENCH_r* captures then carry fleet-level telemetry."""
+    snap = {
+        "ts": 1.0,
+        "decisions_recorded": 42,
+        "fleet": {"replicas_up": 2, "replicas_degraded": 1,
+                  "replicas_down": 0, "slots_free": 3,
+                  "slots_total": 8, "kv_occupancy_worst": 0.6,
+                  "device_memory_frac_worst": 0.4},
+        "backends": {"pool": {
+            "slo": {"goodput": 0.9, "burn_rate": 2.0,
+                    "sustained_overshoot": True},
+            "replicas": {
+                "h:1": {"health": {"state": "up"}},
+                "h:2": {"health": {"state": "degraded"}},
+            }}},
+    }
+    f = bench._fleet_obs_fields(snap, "fx")
+    assert f["fx_replicas_up"] == 2
+    assert f["fx_replicas_degraded"] == 1
+    assert f["fx_slots_free"] == 3
+    assert f["fx_kv_occupancy_worst"] == 0.6
+    assert f["fx_goodput"] == 0.9
+    assert f["fx_burn_rate"] == 2.0
+    assert f["fx_overshoot_sustained"] is True
+    assert f["fx_health"] == {"h:1": "up", "h:2": "degraded"}
+    assert f["fx_decisions"] == 42
+    # an empty snapshot degrades to sentinels, not KeyErrors
+    z = bench._fleet_obs_fields({}, "z")
+    assert z["z_replicas_up"] == 0 and z["z_goodput"] == -1.0
+
+    # gateway-less legs: burn/goodput from raw /state bucket deltas
+    st0 = {"a": {"ttft_hist_buckets": {"500": 2, "+Inf": 3}},
+           "b": {"ttft_hist_buckets": {"500": 1, "+Inf": 1}}}
+    st1 = {"a": {"ttft_hist_buckets": {"500": 8, "+Inf": 11},
+                 "kv_occupancy": 0.5, "max_slots": 2},
+           "b": {"ttft_hist_buckets": {"500": 4, "+Inf": 4},
+                 "kv_occupancy": 0.2, "max_slots": 4}}
+    g = bench._fleet_fields_from_states(st0, st1, slo_ms=1000.0,
+                                        prefix="kf")
+    assert g["kf_served"] == 11  # (11-3) + (4-1)
+    assert g["kf_goodput"] == round(9 / 11, 4)  # under: (8-2)+(4-1)
+    assert g["kf_kv_occupancy_worst"] == 0.5
+    assert g["kf_slots_total"] == 6
+    # empty window: the -1 sentinel, not a ZeroDivisionError
+    e = bench._fleet_fields_from_states(st1, st1, 1000.0, "e")
+    assert e["e_goodput"] == -1.0 and e["e_burn_rate"] == -1.0

@@ -1,0 +1,629 @@
+"""Fleet observability plane (ISSUE 12): health state machine, fleet
+rollups, the routing-decision audit ring, Prometheus federation, and
+the live two-replica rig.
+
+The live tests run REAL HTTP — stub replicas serving the /state,
+/metrics, and /v1/chat/completions surfaces a tpuserve replica exposes
+(no engine build: the plane under test is the gateway's aggregation
+layer, and a stub can die and resurrect in milliseconds, which is the
+whole point of the rig): killing one replica walks the health machine
+up→degraded→down with every transition in the event ring, restarting
+it walks it back, and one /fleet/metrics scrape serves replica-labeled
+gauges for both replicas.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+import aiohttp
+import pytest
+from aiohttp import web
+
+from aigw_tpu.config.model import Config
+from aigw_tpu.config.runtime import RuntimeConfig
+from aigw_tpu.gateway.fleetstate import (
+    DecisionRing,
+    FleetState,
+    ReplicaHealth,
+    merge_rollups,
+    relabel_exposition,
+)
+from aigw_tpu.gateway.picker import Endpoint, EndpointPicker
+from aigw_tpu.gateway.server import run_gateway
+from aigw_tpu.obs.metrics import FLEET_GAUGES
+from aigw_tpu.obs.slomon import SLOMonitor, parse_hist_buckets
+from tests.fakes import openai_chat_response
+
+
+class TestReplicaHealth:
+    def test_walks_up_degraded_down_and_back(self):
+        h = ReplicaHealth()
+        h.note_success(replica_id="r1")
+        assert h.state == "up"
+        h.note_failure()
+        assert h.state == "degraded"  # first failure only degrades
+        h.note_failure()
+        assert h.state == "degraded"
+        h.note_failure()
+        assert h.state == "down"  # FAILURES_DOWN = 3
+        # recovery hysteresis: one good poll does not resurrect
+        h.note_success(replica_id="r1")
+        assert h.state == "down"
+        h.note_success(replica_id="r1")
+        assert h.state == "up"
+        transitions = [(e["from"], e["to"]) for e in h.events
+                       if "to" in e]
+        assert transitions == [
+            ("unknown", "up"), ("up", "degraded"),
+            ("degraded", "down"), ("down", "up")]
+
+    def test_restart_detected_by_replica_id(self):
+        h = ReplicaHealth()
+        h.note_success(replica_id="boot-1")
+        h.note_success(replica_id="boot-2")
+        restarts = [e for e in h.events if e.get("event") == "restart"]
+        assert len(restarts) == 1
+        assert restarts[0]["old_replica_id"] == "boot-1"
+        assert restarts[0]["new_replica_id"] == "boot-2"
+
+    def test_draining_overlay(self):
+        h = ReplicaHealth()
+        h.note_success()
+        h.set_draining(True)
+        assert h.state == "draining"
+        h.note_success()
+        assert h.state == "draining"  # polls ok, still draining
+        h.set_draining(False)
+        h.note_success()
+        assert h.state == "up"
+
+    def test_event_ring_bounded(self):
+        h = ReplicaHealth()
+        for _ in range(100):
+            h.note_success()
+            h.note_failure()
+        assert len(h.events) <= ReplicaHealth.EVENTS_MAX
+
+    def test_slo_overshoot_degrades(self):
+        h = ReplicaHealth()
+        h.note_success()
+        h.note_success(slo_overshoot=True)
+        assert h.state == "degraded"
+        assert any(e.get("reason") == "slo_overshoot_sustained"
+                   for e in h.events)
+        h.note_success(slo_overshoot=False)
+        assert h.state == "up"
+
+
+class TestDecisionRing:
+    def test_record_mutate_filter(self):
+        ring = DecisionRing(capacity=4)
+        e1 = ring.record(chosen="a:1", pick={"candidates": 2})
+        ring.record(chosen="b:1", pick={"candidates": 2})
+        e1["upstream_request_id"] = "rid-1"  # afterlife mutation
+        got = ring.snapshot(rid="rid-1")
+        assert len(got) == 1 and got[0]["chosen"] == "a:1"
+        assert ring.snapshot()[0]["chosen"] == "b:1"  # newest first
+        for i in range(10):
+            ring.record(chosen=f"c:{i}")
+        assert len(ring) == 4  # bounded
+        assert ring.recorded == 12
+
+    def test_limit(self):
+        ring = DecisionRing(capacity=100)
+        for i in range(50):
+            ring.record(chosen=f"r:{i}")
+        assert len(ring.snapshot(limit=7)) == 7
+
+
+class TestRelabel:
+    TEXT = (
+        "# TYPE tpuserve_active_slots gauge\n"
+        "tpuserve_active_slots 3\n"
+        "# TYPE tpuserve_device_kv_occupancy gauge\n"
+        'tpuserve_device_kv_occupancy{device="1"} 0.5\n'
+        "# TYPE tpuserve_ttft_hist_ms histogram\n"
+        'tpuserve_ttft_hist_ms_bucket{le="100"} 3 '
+        '# {trace_id="ab"} 42.1\n'
+        "tpuserve_ttft_hist_ms_sum 126\n"
+        "# TYPE gen_ai_client_token_usage histogram\n"
+        'gen_ai_client_token_usage_bucket{le="1"} 0\n')
+
+    def test_inject_replica_label(self):
+        out = relabel_exposition(self.TEXT, "h:1")
+        assert 'tpuserve_active_slots{replica="h:1"} 3' in out
+        # existing labels keep their place after the replica label
+        assert ('tpuserve_device_kv_occupancy{replica="h:1",'
+                'device="1"} 0.5') in out
+        # exemplar suffix preserved verbatim
+        assert ('tpuserve_ttft_hist_ms_bucket{replica="h:1",le="100"}'
+                ' 3 # {trace_id="ab"} 42.1') in out
+        # non-tpuserve families dropped (they would collide with the
+        # gateway's own instruments)
+        assert "gen_ai_client_token_usage" not in out
+
+    def test_type_lines_deduped_across_replicas(self):
+        seen: set = set()
+        a = relabel_exposition(self.TEXT, "h:1", seen)
+        b = relabel_exposition(self.TEXT, "h:2", seen)
+        assert a.count("# TYPE tpuserve_active_slots gauge") == 1
+        assert b.count("# TYPE tpuserve_active_slots gauge") == 0
+        assert 'tpuserve_active_slots{replica="h:2"} 3' in b
+
+    def test_parses_with_bench_parser(self):
+        seen: set = set()
+        merged = (relabel_exposition(self.TEXT, "h:1", seen)
+                  + relabel_exposition(self.TEXT, "h:2", seen))
+        h = parse_hist_buckets(merged, "tpuserve_ttft_hist_ms")
+        assert h == {"100": 6}  # summed across both replicas
+
+
+class TestRollup:
+    def _picker(self) -> EndpointPicker:
+        p = EndpointPicker([Endpoint("a:1"), Endpoint("b:1")],
+                           slo_window_s=1.0)
+        p.observe("a:1", kv_occupancy=0.2, max_slots=4, active_slots=1,
+                  queued=2, adapters_resident=("t0", "t1"))
+        p.observe("b:1", kv_occupancy=0.6, max_slots=4, active_slots=4,
+                  hbm_frac=0.7, adapters_resident=("t1", "t2"))
+        p.fleet.note_poll("a:1", True, {
+            "kv_spills": 3, "kv_fetch_pages_in": 8,
+            "adapters_resident": ["t0", "t1"], "migrations_out": 1})
+        p.fleet.note_poll("b:1", True, {
+            "kv_spills": 2, "kv_fetch_pages_out": 8,
+            "adapters_resident": ["t1", "t2"], "migrations_in": 1})
+        return p
+
+    def test_rollup_matches_fleet_gauges(self):
+        """Drift check: every FLEET_GAUGES key must appear in the
+        rollup — a renamed rollup key can't silently drop a gauge."""
+        rollup = self._picker().fleet.rollup(self._picker().state)
+        for key, _name in FLEET_GAUGES:
+            assert key in rollup, f"rollup missing gauge source {key}"
+
+    def test_rollup_values(self):
+        p = self._picker()
+        r = p.fleet.rollup(p.state)
+        assert r["replicas_total"] == 2 and r["replicas_up"] == 2
+        assert r["slots_total"] == 8
+        assert r["slots_free"] == 3  # (4-1) + (4-4)
+        assert r["queued_total"] == 2
+        assert r["kv_occupancy_worst"] == 0.6
+        assert r["kv_occupancy_mean"] == 0.4
+        assert r["device_memory_frac_worst"] == 0.7
+        assert r["kv_spills_total"] == 5
+        assert r["kv_fetch_pages_in_total"] == 8
+        assert r["kv_fetch_pages_out_total"] == 8
+        assert r["migrations_in_total"] == 1
+        assert r["migrations_out_total"] == 1
+        assert r["adapters_resident"] == 3  # union of t0 t1 t2
+
+    def test_snapshot_carries_staleness_and_health(self):
+        p = self._picker()
+        snap = p.fleet.snapshot(p.state)
+        a = snap["replicas"]["a:1"]
+        assert a["health"]["state"] == "up"
+        assert 0.0 <= a["staleness_s"] < 5.0
+        assert a["kv_spills"] == 3
+        assert "slo" in a and a["slo"]["window_s"] == 1.0
+        assert "slo" in snap and "rollup" in snap
+
+    def test_down_replica_counted(self):
+        p = self._picker()
+        for _ in range(3):
+            p.fleet.note_poll("b:1", False)
+        r = p.fleet.rollup(p.state)
+        assert r["replicas_down"] == 1 and r["replicas_up"] == 1
+        # a down replica contributes no serving capacity
+        assert r["slots_total"] == 4
+
+    def test_merge_rollups(self):
+        a = {"replicas_total": 2, "replicas_up": 2, "slots_total": 8,
+             "kv_occupancy_worst": 0.3, "kv_occupancy_mean": 0.2,
+             "slo_goodput": 1.0, "slo_burn_rate": 0.0,
+             "slo_overshoot_sustained": 0}
+        b = {"replicas_total": 1, "replicas_up": 0, "slots_total": 2,
+             "kv_occupancy_worst": 0.9, "kv_occupancy_mean": 0.9,
+             "slo_goodput": 0.5, "slo_burn_rate": 10.0,
+             "slo_overshoot_sustained": 1}
+        m = merge_rollups([a, b])
+        assert m["replicas_total"] == 3 and m["slots_total"] == 10
+        assert m["kv_occupancy_worst"] == 0.9
+        assert m["kv_occupancy_mean"] == pytest.approx(0.433, abs=1e-3)
+        # SLO view follows the worst-burning backend
+        assert m["slo_burn_rate"] == 10.0
+        assert m["slo_goodput"] == 0.5
+        assert m["slo_overshoot_sustained"] == 1
+        assert merge_rollups([a]) == a
+        assert merge_rollups([]) == {}
+
+    def test_fleet_obs_off_drops_monitor_keeps_health(self):
+        p = EndpointPicker([Endpoint("a:1")], fleet_obs=False)
+        p.observe("a:1", kv_occupancy=0.1, max_slots=2)
+        assert p.fleet.slomon is None
+        snap = p.fleet.snapshot(p.state)
+        assert snap["replicas"]["a:1"]["health"]["state"] == "up"
+        assert snap["rollup"]["slo_goodput"] == -1.0
+
+
+# -- live two-replica rig -------------------------------------------------
+
+class StubReplica:
+    """A replica-shaped HTTP server: the /state, /metrics, and chat
+    surfaces the fleet plane consumes — killable and resurrectable in
+    milliseconds, unlike a real engine."""
+
+    def __init__(self, replica_id: str, port: int = 0):
+        self.replica_id = replica_id
+        self.port = port
+        self.url = ""
+        self.address = ""
+        self._runner: web.AppRunner | None = None
+        self.served = 0
+
+    def _state(self) -> dict:
+        n = self.served
+        return {
+            "model": "m1",
+            "replica_id": self.replica_id,
+            "uptime_s": 12.5,
+            "max_slots": 2,
+            "active_slots": 0,
+            "queued": 0,
+            "kv_occupancy": 0.25,
+            "kv_spills": 3,
+            "kv_fetch_pages_in": 8,
+            "migrations_out": 1,
+            "adapters_resident": ["t0"],
+            "phase_percentiles": {
+                "prefill": {"p50": 40.0, "p95": -1, "p99": -1}},
+            "ttft_hist_buckets": {"100": n, "+Inf": n},
+        }
+
+    METRICS = (
+        "# TYPE tpuserve_active_slots gauge\n"
+        "tpuserve_active_slots 0\n"
+        "# TYPE tpuserve_kv_occupancy gauge\n"
+        "tpuserve_kv_occupancy 0.25\n"
+        "# TYPE tpuserve_ttft_hist_ms histogram\n"
+        'tpuserve_ttft_hist_ms_bucket{le="100"} 2\n'
+        'tpuserve_ttft_hist_ms_bucket{le="+Inf"} 2\n'
+        "tpuserve_ttft_hist_ms_sum 84\n")
+
+    async def start(self) -> "StubReplica":
+        app = web.Application()
+
+        async def state(_req):
+            return web.json_response(self._state())
+
+        async def metrics(_req):
+            return web.Response(text=self.METRICS,
+                                content_type="text/plain")
+
+        async def chat(_req):
+            self.served += 1
+            return web.json_response(
+                openai_chat_response("ok", model="m1"),
+                headers={"x-aigw-request-id":
+                         f"{self.replica_id}-{self.served}"})
+
+        app.router.add_get("/state", state)
+        app.router.add_get("/metrics", metrics)
+        app.router.add_post("/v1/chat/completions", chat)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self.address = f"127.0.0.1:{self.port}"
+        return self
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+
+def _fleet_config(addrs: list[str]) -> Config:
+    return Config.parse({
+        "version": "v1",
+        "backends": [{
+            "name": "pool", "schema": "OpenAI",
+            "endpoints": list(addrs),
+            "picker_poll_interval": 0.05,
+            "slo_window_s": 0.5,
+        }],
+        "routes": [{"name": "r", "rules": [
+            {"models": ["m1"], "backends": ["pool"]}]}],
+        "models": ["m1"],
+    })
+
+
+async def _wait_for(cond, timeout: float = 10.0, what: str = ""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = cond()
+        if asyncio.iscoroutine(v):
+            v = await v
+        if v:
+            return v
+        await asyncio.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+class TestLiveFleet:
+    """Acceptance rig: two live replicas behind a real gateway —
+    injected death and recovery walk the health machine with every
+    transition recorded, and one /fleet/metrics scrape covers both."""
+
+    def test_health_walk_federation_and_decisions(self, tmp_path,
+                                                  monkeypatch):
+        monkeypatch.setenv("AIGW_ACCESS_LOG",
+                           str(tmp_path / "access.log"))
+
+        async def main():
+            s1 = await StubReplica("boot-a").start()
+            s2 = await StubReplica("boot-b").start()
+            server, runner = await run_gateway(
+                RuntimeConfig.build(
+                    _fleet_config([s1.address, s2.address])),
+                port=0)
+            site = list(runner.sites)[0]
+            gw = "http://127.0.0.1:%d" % (
+                site._server.sockets[0].getsockname()[1])
+            picker = server._pickers["pool"]
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async def fleet_state() -> dict:
+                        async with s.get(gw + "/fleet/state") as r:
+                            assert r.status == 200
+                            return await r.json()
+
+                    # both replicas reach `up`
+                    await _wait_for(
+                        lambda: picker.fleet.health_of(s1.address)
+                        == "up" and picker.fleet.health_of(s2.address)
+                        == "up", what="both replicas up")
+                    snap = await fleet_state()
+                    pool = snap["backends"]["pool"]
+                    assert snap["fleet"]["replicas_up"] == 2
+                    r1 = pool["replicas"][s1.address]
+                    assert r1["replica_id"] == "boot-a"
+                    assert r1["uptime_s"] == 12.5
+                    assert 0.0 <= r1["staleness_s"] < 5.0
+                    assert pool["rollup"]["slots_total"] == 4
+                    assert pool["rollup"]["kv_spills_total"] == 6
+                    assert pool["rollup"]["adapters_resident"] == 1
+
+                    # one routed request lands in the decision ring,
+                    # joined to the replica's request id
+                    async with s.post(
+                        gw + "/v1/chat/completions",
+                        json={"model": "m1", "messages": [
+                            {"role": "user", "content": "hi"}]},
+                    ) as r:
+                        assert r.status == 200
+                        rid = r.headers.get("x-aigw-request-id", "")
+                    assert rid
+                    async with s.get(gw + "/debug/decisions",
+                                     params={"rid": rid}) as r:
+                        dec = (await r.json())["decisions"]
+                    assert len(dec) == 1
+                    assert dec[0]["chosen"] in (s1.address, s2.address)
+                    assert dec[0]["upstream_request_id"] == rid
+                    assert dec[0]["pick"]["candidates"] == 2
+                    assert "staleness_s" in dec[0]["pick"]
+
+                    # federation: ONE scrape carries replica-labeled
+                    # gauges for both replicas + the fleet rollup, and
+                    # parses with the bench parser
+                    async with s.get(gw + "/fleet/metrics") as r:
+                        text = (await r.read()).decode()
+                    for addr in (s1.address, s2.address):
+                        assert (f'tpuserve_active_slots'
+                                f'{{replica="{addr}"}} 0') in text
+                    assert "aigw_fleet_replicas_up 2" in text
+                    assert "aigw_fleet_scrape_errors 0" in text
+                    h = parse_hist_buckets(text,
+                                           "tpuserve_ttft_hist_ms")
+                    assert h["100"] == 4  # 2 per replica, summed
+
+                    # inject replica death: s2 walks up→degraded→down
+                    await s2.stop()
+                    await _wait_for(
+                        lambda: picker.fleet.health_of(s2.address)
+                        == "down", what="killed replica down")
+                    snap = await fleet_state()
+                    h2 = (snap["backends"]["pool"]["replicas"]
+                          [s2.address]["health"])
+                    walk = [(e["from"], e["to"]) for e in h2["events"]
+                            if "to" in e]
+                    assert ("up", "degraded") in walk
+                    assert ("degraded", "down") in walk
+                    assert snap["fleet"]["replicas_down"] == 1
+                    st2 = picker.state[s2.address]
+                    assert st2.poll_failures >= 3
+                    assert st2.staleness_s() > 0.0
+                    # the stale-poll fix: the dead replica's last happy
+                    # phase histograms no longer predict anything
+                    assert st2.phase_percentiles  # data IS still there
+                    st2.last_poll_ok_ts -= picker.STALE_AFTER
+                    assert picker.predicted_ttft_ms(st2) is None
+
+                    # traffic still routes — to the survivor
+                    async with s.post(
+                        gw + "/v1/chat/completions",
+                        json={"model": "m1", "messages": [
+                            {"role": "user", "content": "hi2"}]},
+                    ) as r:
+                        assert r.status == 200
+                    dec = server.decisions.snapshot()[0]
+                    assert dec["chosen"] == s1.address
+
+                    # recovery: a NEW process on the same port walks
+                    # back up, and the ring records the restart
+                    s2b = await StubReplica("boot-b2",
+                                            port=s2.port).start()
+                    await _wait_for(
+                        lambda: picker.fleet.health_of(s2.address)
+                        == "up", what="restarted replica up")
+                    snap = await fleet_state()
+                    h2 = (snap["backends"]["pool"]["replicas"]
+                          [s2.address]["health"])
+                    assert ("down", "up") in [
+                        (e.get("from"), e.get("to"))
+                        for e in h2["events"]]
+                    assert h2["replica_id"] == "boot-b2"
+                    assert any(e.get("event") == "restart"
+                               for e in h2["events"])
+                    await s2b.stop()
+
+                # access log joins the decision (satellite): the line
+                # carries the routing outcome
+                server.access_log.drain()
+                lines = [json.loads(ln) for ln in
+                         (tmp_path / "access.log").read_text()
+                         .splitlines()]
+                routed = [ln for ln in lines
+                          if ln.get("decision", {}).get("endpoint")]
+                assert routed, f"no decision fields in {lines}"
+                assert routed[0]["decision"]["endpoint"] in (
+                    s1.address, s2.address)
+                assert routed[0]["upstream_request_id"]
+            finally:
+                await runner.cleanup()
+                await s1.stop()
+                await s2.stop()
+
+        asyncio.run(main())
+
+    def test_slo_mode_stale_replica_is_no_data(self):
+        """Regression (stale-poll satellite): in slo mode a replica
+        whose polls fail must drop out of the predicted-TTFT ranking —
+        previously its frozen last-good histograms kept ranking it as
+        its last happy self."""
+
+        async def main():
+            s1 = await StubReplica("sa").start()
+            s2 = await StubReplica("sb").start()
+            p = EndpointPicker(
+                [Endpoint(s1.address), Endpoint(s2.address)],
+                poll_interval=0.05, mode="slo")
+            await p.start()
+            try:
+                await _wait_for(
+                    lambda: p.state[s1.address].healthy
+                    and p.state[s2.address].healthy,
+                    what="both polled")
+                explain: dict = {}
+                assert p.pick(explain=explain) in (s1.address,
+                                                   s2.address)
+                assert explain["mode"] == "slo"
+                assert len(explain["predicted_ttft_ms"]) == 2
+                # kill s2: its frozen phase_percentiles must not keep
+                # it in the candidate map
+                await s2.stop()
+                await _wait_for(
+                    lambda: not p.state[s2.address].healthy,
+                    what="dead replica unhealthy")
+                explain = {}
+                assert p.pick(explain=explain) == s1.address
+                assert list(explain["predicted_ttft_ms"]) == [
+                    s1.address]
+                # and its stats are flagged stale, not silently frozen
+                assert p.state[s2.address].poll_failures >= 1
+                assert p.fleet.health_of(s2.address) != "up"
+            finally:
+                await p.stop()
+                await s1.stop()
+                await s2.stop()
+
+        asyncio.run(main())
+
+
+class TestFleetwatch:
+    """tools/fleetwatch.py — the watch-style /fleet/state table CLI
+    (ISSUE 12 satellite), smoke-tested against a live gateway."""
+
+    @staticmethod
+    def _load():
+        import importlib.util
+        import os
+
+        path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                            "fleetwatch.py")
+        spec = importlib.util.spec_from_file_location(
+            "fleetwatch", os.path.abspath(path))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_render_table_pure(self):
+        fw = self._load()
+        out = fw.render_table({
+            "backends": {"pool": {
+                "replicas": {"h:1": {
+                    "health": {"state": "up", "draining": False},
+                    "active_slots": 1, "max_slots": 2, "queued": 3,
+                    "kv_occupancy": 0.25,
+                    "device_memory_frac_worst": 0.5,
+                    "staleness_s": 0.1, "uptime_s": 61.0,
+                    "slo": {"burn_rate": 2.0, "goodput": 0.9},
+                }},
+                "rollup": {"replicas_up": 1, "slots_free": 1,
+                           "slots_total": 2,
+                           "kv_occupancy_worst": 0.25},
+                "slo": {"burn_rate": 2.0,
+                        "sustained_overshoot": True},
+            }},
+            "decisions_recorded": 5,
+        })
+        assert "h:1" in out and "up" in out
+        assert "1/2" in out and "25" in out
+        assert "SUSTAINED SLO OVERSHOOT" in out
+        assert "decisions recorded: 5" in out
+        # -1 sentinels render as '-', not as negative numbers
+        out2 = fw.render_table({"backends": {"p": {
+            "replicas": {"h:2": {
+                "health": {"state": "down"}, "staleness_s": -1.0,
+                "slo": {"burn_rate": -1.0, "goodput": -1.0}}},
+            "rollup": {}, "slo": {}}}})
+        assert "-1" not in out2
+
+    def test_fleetwatch_once_against_live_gateway(self):
+        import os
+        import subprocess
+        import sys
+
+        async def main():
+            s1 = await StubReplica("fw-a").start()
+            server, runner = await run_gateway(
+                RuntimeConfig.build(_fleet_config([s1.address])),
+                port=0)
+            site = list(runner.sites)[0]
+            gw = "http://127.0.0.1:%d" % (
+                site._server.sockets[0].getsockname()[1])
+            try:
+                await _wait_for(
+                    lambda: server._pickers["pool"].fleet.health_of(
+                        s1.address) == "up", what="replica up")
+                here = os.path.dirname(os.path.abspath(__file__))
+                proc = await asyncio.to_thread(
+                    subprocess.run,
+                    [sys.executable,
+                     os.path.join(here, "..", "tools", "fleetwatch.py"),
+                     gw, "--once"],
+                    capture_output=True, text=True, timeout=60)
+                assert proc.returncode == 0, proc.stderr
+                assert s1.address in proc.stdout
+                assert "up" in proc.stdout
+                assert "pool" in proc.stdout
+            finally:
+                await runner.cleanup()
+                await s1.stop()
+
+        asyncio.run(main())

@@ -519,3 +519,60 @@ class TestSLOMode:
     def test_invalid_mode_rejected(self):
         with pytest.raises(ValueError):
             EndpointPicker([Endpoint("10.0.0.1:8011")], mode="wat")
+
+
+class TestStaleness:
+    """Stale-poll satellite (ISSUE 12): staleness is first-class — a
+    replica whose polls stopped succeeding must be treated as NO-DATA,
+    not as its last happy self."""
+
+    def test_observe_stamps_last_poll_ok(self):
+        p = make_picker()
+        p.observe("10.0.0.1:8011", kv_occupancy=0.1, max_slots=8)
+        st = p.state["10.0.0.1:8011"]
+        assert st.last_poll_ok_ts > 0
+        assert 0.0 <= st.staleness_s() < 1.0
+        assert st.poll_failures == 0
+        # never-polled replicas report the -1 sentinel, not 0 (a fresh
+        # 0 would read as "just polled")
+        assert p.state["10.0.0.2:8011"].staleness_s() == -1.0
+
+    def test_predicted_ttft_none_when_stale(self):
+        """slo mode's formula returns None past STALE_AFTER even when
+        the frozen phase histograms are still present — the killed-
+        replica regression: ranking on a dead replica's last happy
+        percentiles queued real traffic into a corpse."""
+        import time as _time
+
+        p = make_slo_picker()
+        p.observe("10.0.0.1:8011", queued=0,
+                  phase_percentiles=_pp(50.0))
+        st = p.state["10.0.0.1:8011"]
+        assert p.predicted_ttft_ms(st) == 50.0
+        # polls stop succeeding; the data is untouched but old
+        st.last_poll_ok_ts = _time.monotonic() - p.STALE_AFTER - 1.0
+        assert st.phase_percentiles  # the frozen data IS still there
+        assert p.predicted_ttft_ms(st) is None, (
+            "stale replica still predicted from frozen histograms")
+
+    def test_explain_carries_staleness(self):
+        p = make_picker()
+        p.observe("10.0.0.1:8011", kv_occupancy=0.1, max_slots=8)
+        p.observe("10.0.0.2:8011", kv_occupancy=0.5, max_slots=8)
+        p.observe("10.0.0.3:8011", kv_occupancy=0.5, max_slots=8)
+        explain: dict = {}
+        assert p.pick(explain=explain) == "10.0.0.1:8011"
+        assert 0.0 <= explain["staleness_s"] < 5.0
+        # slo mode too
+        p2 = make_slo_picker()
+        for a in ("10.0.0.1:8011", "10.0.0.2:8011", "10.0.0.3:8011"):
+            p2.observe(a, phase_percentiles=_pp(50.0))
+        explain = {}
+        p2.pick(explain=explain)
+        assert "staleness_s" in explain
+
+    def test_fleet_health_follows_observe(self):
+        p = make_picker()
+        p.observe("10.0.0.1:8011", kv_occupancy=0.1, max_slots=8)
+        assert p.fleet.health_of("10.0.0.1:8011") == "up"
+        assert p.fleet.health_of("10.0.0.2:8011") == "unknown"

@@ -769,3 +769,59 @@ def test_kv_tier_churn_zero_hot_compiles():
             f"{eng.compile_tracker.programs()}")
     finally:
         eng.stop()
+
+
+# fleet observability surface (ISSUE 12): a renamed field here silently
+# blinds the gateway's fleet aggregator — replica identity feeds the
+# restart-detecting health ring, ttft_hist_buckets feeds the live SLO
+# burn-rate monitor (obs/slomon.py)
+FLEETOBS_STATE_FIELDS = (
+    "replica_id",
+    "started_at",
+    "uptime_s",
+    "ttft_hist_buckets",
+)
+
+
+def test_state_exports_fleet_identity_and_ttft_buckets(smoke_url):
+    """Replica identity/uptime + the cumulative TTFT bucket dict must
+    export on /state, and the bucket dict must agree with the phase
+    histogram the /metrics exposition renders (same cumulative counts,
+    same ladder, +Inf included)."""
+    from aigw_tpu.obs.metrics import PHASE_BUCKETS_MS
+    from aigw_tpu.obs.slomon import parse_hist_buckets
+
+    state = json.loads(asyncio.run(_get(smoke_url, "/state")))
+    for field in FLEETOBS_STATE_FIELDS:
+        assert field in state, f"/state lost {field}"
+    assert len(state["replica_id"]) >= 8
+    assert state["uptime_s"] > 0
+    buckets = state["ttft_hist_buckets"]
+    assert set(buckets) == {f"{b:g}" for b in PHASE_BUCKETS_MS} | {
+        "+Inf"}
+    # cumulative: monotone along the ladder
+    ladder = [buckets[f"{b:g}"] for b in PHASE_BUCKETS_MS]
+    assert ladder == sorted(ladder)
+    assert buckets["+Inf"] >= ladder[-1]
+    # and consistent with the /metrics histogram (no traffic runs
+    # between the two fetches in this test, so counts are identical)
+    text = asyncio.run(_get(smoke_url, "/metrics")).decode()
+    rendered = parse_hist_buckets(text, "tpuserve_ttft_hist_ms")
+    assert rendered == buckets
+
+
+def test_fleet_gauges_map_matches_rollup():
+    """Every FLEET_GAUGES key must exist in FleetState.rollup() output
+    — a renamed rollup key silently drops an aggregate gauge from the
+    /fleet/metrics federation scrape."""
+    from aigw_tpu.gateway.picker import Endpoint, EndpointPicker
+    from aigw_tpu.obs.metrics import FLEET_GAUGES, render_fleet_gauges
+
+    p = EndpointPicker([Endpoint("a:1")])
+    p.observe("a:1", kv_occupancy=0.2, max_slots=4)
+    rollup = p.fleet.rollup(p.state)
+    for key, _name in FLEET_GAUGES:
+        assert key in rollup, f"rollup missing FLEET_GAUGES key {key}"
+    text = render_fleet_gauges(rollup).decode()
+    for _key, name in FLEET_GAUGES:
+        assert name in text, f"render_fleet_gauges lost {name}"

@@ -1,0 +1,177 @@
+"""Live SLO burn-rate monitor (ISSUE 12, obs/slomon.py).
+
+The bench-only goodput machinery from PR 8, generalized into the
+gateway: sliding-window goodput and error-budget burn from cumulative
+TTFT histogram deltas, plus the K-consecutive-windows sustained-
+overshoot flag ROADMAP item 2's autoscaler consumes. The acceptance
+pair lives here: fed the histograms the PR 8 straggler pool produces
+(every TTFT 1–2.5s against a 300ms SLO), the monitor flags sustained
+overshoot within its window budget; fed the healthy pool's histograms
+(TTFTs 25–100ms), it stays quiet.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from aigw_tpu.obs.slomon import (
+    DEFAULT_SLO_MS,
+    SLOMonitor,
+    parse_hist_buckets,
+    sum_buckets,
+    total_count,
+    under_slo_count,
+)
+
+
+class TestParsing:
+    def test_parse_hist_buckets_with_exemplars(self):
+        text = (
+            "# TYPE tpuserve_ttft_hist_ms histogram\n"
+            'tpuserve_ttft_hist_ms_bucket{le="100"} 3 '
+            '# {trace_id="ab"} 42.1\n'
+            'tpuserve_ttft_hist_ms_bucket{le="250"} 7\n'
+            'tpuserve_ttft_hist_ms_bucket{le="+Inf"} 9\n'
+            "tpuserve_ttft_hist_ms_sum 1234\n")
+        h = parse_hist_buckets(text, "tpuserve_ttft_hist_ms")
+        assert h == {"100": 3, "250": 7, "+Inf": 9}
+
+    def test_parse_tolerates_extra_labels_and_sums(self):
+        """The fleet federation endpoint adds a replica label ahead of
+        le — the parser must still read the buckets, and counts from
+        multiple replicas sum per le (the fleet histogram)."""
+        text = (
+            'tpuserve_ttft_hist_ms_bucket{replica="h:1",le="100"} 3\n'
+            'tpuserve_ttft_hist_ms_bucket{replica="h:1",le="+Inf"} 5\n'
+            'tpuserve_ttft_hist_ms_bucket{replica="h:2",le="100"} 4\n'
+            'tpuserve_ttft_hist_ms_bucket{replica="h:2",le="+Inf"} 4\n')
+        h = parse_hist_buckets(text, "tpuserve_ttft_hist_ms")
+        assert h == {"100": 7, "+Inf": 9}
+
+    def test_under_slo_largest_bucket_at_or_below(self):
+        h = {"100": 3, "250": 7, "500": 8, "+Inf": 9}
+        assert under_slo_count(h, 250.0) == 7
+        assert under_slo_count(h, 300.0) == 7
+        assert under_slo_count(h, 99.0) == 0
+        assert under_slo_count(h, 1e9) == 8  # +Inf never counts
+        assert total_count(h) == 9
+
+    def test_sum_buckets(self):
+        assert sum_buckets([{"100": 1, "+Inf": 2},
+                            {"100": 3, "+Inf": 4}, {}]) == {
+            "100": 4, "+Inf": 6}
+
+
+def _buckets(under: int, over: int, slo_le: str = "250",
+             over_le: str = "2500") -> dict[str, int]:
+    """Cumulative bucket dict with ``under`` observations at/below the
+    SLO bucket and ``over`` far above it."""
+    return {slo_le: under, over_le: under + over,
+            "+Inf": under + over}
+
+
+class TestBurnRate:
+    def test_window_goodput_and_burn(self):
+        m = SLOMonitor(slo_ms=300.0, objective=0.95, window_s=10.0,
+                       k_windows=3)
+        m.observe("r", _buckets(0, 0), ts=0.0)
+        # 8 under, 2 over in the first closed window
+        m.observe("r", _buckets(8, 2), ts=10.0)
+        snap = m.snapshot("r")
+        assert snap["goodput"] == 0.8
+        # (1 - 0.8) / (1 - 0.95) = 4x budget burn
+        assert snap["burn_rate"] == 4.0
+        assert snap["windows"][0]["served"] == 10
+        assert snap["windows"][0]["under_slo"] == 8
+
+    def test_window_not_closed_early(self):
+        m = SLOMonitor(slo_ms=300.0, window_s=10.0)
+        m.observe("r", _buckets(0, 0), ts=0.0)
+        m.observe("r", _buckets(5, 5), ts=5.0)  # mid-window: no close
+        assert m.snapshot("r")["goodput"] == -1.0
+
+    def test_straggler_pool_flags_within_window_budget(self):
+        """The PR 8 straggler shape: every TTFT lands 1–2.5s against a
+        300ms SLO (the prefill-straggler replica pads every prompt to
+        the full bucket). The sustained flag must raise within the
+        window budget — k_windows closed windows — and not before."""
+        m = SLOMonitor(slo_ms=300.0, objective=0.95, window_s=10.0,
+                       k_windows=3)
+        m.observe("straggler", _buckets(0, 0), ts=0.0)
+        total = 0
+        for w in range(1, 4):  # exactly k_windows = 3 closed windows
+            total += 4  # 4 served per window, ALL over the SLO
+            m.observe("straggler", _buckets(0, total), ts=10.0 * w)
+            if w < 3:
+                assert not m.sustained("straggler"), (
+                    f"flag raised after only {w} windows — hysteresis "
+                    "gone")
+        assert m.sustained("straggler"), (
+            "3 consecutive fully-over-budget windows did not raise "
+            "the sustained flag")
+        snap = m.snapshot("straggler")
+        assert snap["burn_rate"] == 20.0  # 100% errors / 5% budget
+        assert snap["over_budget_streak"] == 3
+
+    def test_healthy_pool_stays_quiet(self):
+        """Healthy-pool histograms (everything well under the SLO)
+        never raise the flag, however long they run."""
+        m = SLOMonitor(slo_ms=300.0, objective=0.95, window_s=10.0,
+                       k_windows=3)
+        m.observe("healthy", _buckets(0, 0), ts=0.0)
+        total = 0
+        for w in range(1, 13):
+            total += 6
+            m.observe("healthy", _buckets(total, 0), ts=10.0 * w)
+        assert not m.sustained("healthy")
+        snap = m.snapshot("healthy")
+        assert snap["goodput"] == 1.0
+        assert snap["burn_rate"] == 0.0
+
+    def test_single_good_window_clears_streak(self):
+        m = SLOMonitor(slo_ms=300.0, window_s=10.0, k_windows=2)
+        m.observe("r", _buckets(0, 0), ts=0.0)
+        m.observe("r", _buckets(0, 4), ts=10.0)   # over
+        m.observe("r", _buckets(4, 4), ts=20.0)   # recovered
+        m.observe("r", _buckets(4, 8), ts=30.0)   # over again
+        assert not m.sustained("r")  # streak is 1, not 3
+
+    def test_idle_window_clears_streak_not_flag_forever(self):
+        """No traffic is not an overshoot: an idle window resets the
+        streak — a sustained flag must mean sustained BAD service, not
+        stale history an autoscaler would scale out on."""
+        m = SLOMonitor(slo_ms=300.0, window_s=10.0, k_windows=2)
+        m.observe("r", _buckets(0, 0), ts=0.0)
+        m.observe("r", _buckets(0, 4), ts=10.0)
+        m.observe("r", _buckets(0, 8), ts=20.0)
+        assert m.sustained("r")
+        m.observe("r", _buckets(0, 8), ts=30.0)  # idle window
+        assert not m.sustained("r")
+
+    def test_counter_reset_reanchors_without_garbage(self):
+        """A replica restart zeroes its cumulative counters — the torn
+        (negative-delta) window is skipped, not reported."""
+        m = SLOMonitor(slo_ms=300.0, window_s=10.0)
+        m.observe("r", _buckets(50, 10), ts=0.0)
+        m.observe("r", _buckets(2, 0), ts=10.0)  # restarted process
+        assert m.snapshot("r")["windows"] == []
+        m.observe("r", _buckets(6, 0), ts=20.0)  # clean window after
+        assert m.snapshot("r")["goodput"] == 1.0
+
+    def test_forget_drops_state(self):
+        m = SLOMonitor(slo_ms=300.0, window_s=10.0)
+        m.observe("r", _buckets(0, 0), ts=0.0)
+        m.observe("r", _buckets(0, 4), ts=10.0)
+        m.forget("r")
+        assert m.snapshot("r")["windows"] == []
+        assert "r" not in m.keys()
+
+    def test_default_slo_when_unset(self):
+        assert SLOMonitor(slo_ms=0.0).slo_ms == DEFAULT_SLO_MS
+        assert SLOMonitor(slo_ms=250.0).slo_ms == 250.0
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            SLOMonitor(objective=1.5)
+        with pytest.raises(ValueError):
+            SLOMonitor(window_s=0.0)

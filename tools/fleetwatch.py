@@ -1,0 +1,127 @@
+#!/usr/bin/env python3
+"""fleetwatch — a tiny ``watch``-style view of the gateway's fleet.
+
+Renders ``GET /fleet/state`` (the ISSUE 12 fleet observability plane)
+as a per-replica table: health, slots, queue, worst KV / HBM pressure,
+SLO burn rate, and telemetry staleness — the terminal companion for
+bench runs and the MULTICHIP dryrun, where tailing N replica ``/state``
+endpoints by hand stops scaling at N=2.
+
+Usage:
+    python tools/fleetwatch.py http://127.0.0.1:1975 [--interval 2]
+    python tools/fleetwatch.py http://127.0.0.1:1975 --once
+
+stdlib-only (urllib) on purpose: it must run anywhere the bench runs,
+including bare containers without aiohttp installed for the client.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+_COLUMNS = ("REPLICA", "HEALTH", "SLOTS", "QUEUE", "KV%", "HBM%",
+            "BURN", "GOODPUT", "STALE(s)", "UPTIME(s)")
+
+
+def fetch(url: str, timeout: float = 5.0) -> dict:
+    with urllib.request.urlopen(url.rstrip("/") + "/fleet/state",
+                                timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _fmt(v, pct: bool = False) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, (int, float)) and v < 0:
+        return "-"  # -1 sentinels: no data yet
+    if pct:
+        return f"{100.0 * float(v):.0f}"
+    if isinstance(v, float):
+        return f"{v:.2f}"
+    return str(v)
+
+
+def render_table(snapshot: dict) -> str:
+    """One /fleet/state payload → the table string (pure function —
+    the tier-1 smoke drives it against a live gateway's snapshot)."""
+    lines: list[str] = []
+    widths = [22, 9, 7, 6, 5, 5, 6, 8, 9, 10]
+
+    def row(cells) -> str:
+        return "  ".join(str(c).ljust(w)[:max(w, len(str(c)))]
+                         for c, w in zip(cells, widths)).rstrip()
+
+    for name, b in sorted((snapshot.get("backends") or {}).items()):
+        lines.append(f"pool {name}")
+        lines.append(row(_COLUMNS))
+        for addr, r in sorted((b.get("replicas") or {}).items()):
+            h = (r.get("health") or {}).get("state", "?")
+            if (r.get("health") or {}).get("draining"):
+                h = "draining"
+            slo = r.get("slo") or {}
+            lines.append(row((
+                addr, h,
+                f"{r.get('active_slots', 0)}/{r.get('max_slots', 0)}",
+                r.get("queued", 0),
+                _fmt(r.get("kv_occupancy"), pct=True),
+                _fmt(r.get("device_memory_frac_worst"), pct=True),
+                _fmt(slo.get("burn_rate")),
+                _fmt(slo.get("goodput")),
+                _fmt(r.get("staleness_s")),
+                _fmt(round(float(r.get("uptime_s", 0.0)))),
+            )))
+        ru = b.get("rollup") or {}
+        slo = b.get("slo") or {}
+        lines.append(
+            f"  up {ru.get('replicas_up', 0)}"
+            f" degraded {ru.get('replicas_degraded', 0)}"
+            f" draining {ru.get('replicas_draining', 0)}"
+            f" down {ru.get('replicas_down', 0)}"
+            f" | slots {ru.get('slots_free', 0)}/"
+            f"{ru.get('slots_total', 0)} free"
+            f" | worst kv {_fmt(ru.get('kv_occupancy_worst'), pct=True)}%"
+            f" | fleet burn {_fmt(slo.get('burn_rate'))}"
+            + (" ** SUSTAINED SLO OVERSHOOT **"
+               if slo.get("sustained_overshoot") else ""))
+        lines.append("")
+    lines.append(
+        f"decisions recorded: {snapshot.get('decisions_recorded', 0)}")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("url", help="gateway base url, e.g. "
+                    "http://127.0.0.1:1975")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit (scripts, tests)")
+    args = ap.parse_args(argv)
+    while True:
+        try:
+            snap = fetch(args.url)
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            print(f"fleetwatch: {args.url}: {e}", file=sys.stderr)
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        out = render_table(snap)
+        if args.once:
+            print(out)
+            return 0
+        # clear + home, watch-style
+        sys.stdout.write("\x1b[2J\x1b[H")
+        print(time.strftime("%H:%M:%S"), args.url)
+        print(out, flush=True)
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
