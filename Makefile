@@ -1,7 +1,7 @@
 # Developer entry points (tests force the CPU fake-chip platform through
 # tests/conftest.py; bench runs on the real TPU).
 
-.PHONY: test test-fast native bench gateway-bench docs dist clean
+.PHONY: test test-fast native bench gateway-bench tpu-capture docs dist clean
 
 test: native
 	python -m pytest tests/ -q
@@ -19,6 +19,12 @@ bench:
 
 gateway-bench:
 	python benchmarks/gateway_overhead.py
+
+# One-shot on-chip capture (tok/s/chip, measured MFU vs analytical,
+# ICI measured vs priced) — run the first time the TPU tunnel is up;
+# prints a TPU_CAPTURE {...} line and persists the JSON artifact.
+tpu-capture:
+	python tools/tpu_capture.py
 
 docs:
 	python docs/build_site.py

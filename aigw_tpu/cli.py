@@ -300,6 +300,24 @@ def main(argv: list[str] | None = None) -> int:
                               "chunked continuations as start offsets. "
                               "Auto-falls back to XLA attention "
                               "off-TPU and to xla-bucketed on a mesh")
+    p_serve.add_argument("--decode-backend", default="auto",
+                         choices=["auto", "chained", "fused"],
+                         help="decode attention rung: chained (rope → "
+                              "scatter → gather/kernel, the classic "
+                              "path) or fused — ONE program per decode "
+                              "dispatch (RoPE + KV append + paged "
+                              "attention; Pallas kernel on single-chip "
+                              "TPU, XLA page walk off-TPU, shard_map "
+                              "local-shard walk on a mesh). auto = "
+                              "chained; /state exports the resolution")
+    p_serve.add_argument("--kv-cache-dtype", default="bfloat16",
+                         choices=["bfloat16", "float32", "int8", "int4"],
+                         help="KV page element dtype. int8/int4 store "
+                              "quantized pages + per-page scale blocks "
+                              "(~0.52x / ~0.27x the bf16 KV bytes at "
+                              "head_dim 128 — more concurrent sessions "
+                              "per chip), dequantized in-kernel / at "
+                              "the gather")
     p_serve.add_argument("--ragged-chunk-tokens", type=int, default=256,
                          help="pallas-ragged padding granule: packed "
                               "totals pad to multiples of this (the "
@@ -902,6 +920,8 @@ async def _run_tpuserve(args: argparse.Namespace) -> int:
         spec_adaptive=not args.no_spec_adaptive,
         pallas_attn=args.pallas_attn,
         attention_backend=args.attention_backend,
+        decode_backend=args.decode_backend,
+        kv_cache_dtype=args.kv_cache_dtype,
         ragged_chunk_tokens=args.ragged_chunk_tokens,
         logprobs_topk=args.logprobs,
         adaptive_decode_window=not args.no_adaptive_window,

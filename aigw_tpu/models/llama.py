@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from aigw_tpu.models import kvq
 from aigw_tpu.models.lora import lora_delta
 
 
@@ -219,7 +220,8 @@ def _wo_project(p, i, attn, lora=None, adapter_idx=None):
     return out if d is None else out + d
 
 
-def _project_qkv(p, i, x, positions, cfg, lora=None, adapter_idx=None):
+def _project_qkv(p, i, x, positions, cfg, lora=None, adapter_idx=None,
+                 apply_rope=True):
     hd = cfg.head_dim
     B, S, _ = x.shape
     q = _matmul(p, f"l{i}.wq", x)
@@ -239,8 +241,9 @@ def _project_qkv(p, i, x, positions, cfg, lora=None, adapter_idx=None):
     q = q.reshape(B, S, cfg.n_heads, hd)
     k = k.reshape(B, S, cfg.n_kv_heads, hd)
     v = v.reshape(B, S, cfg.n_kv_heads, hd)
-    q = rope(q, positions, cfg.rope_theta)
-    k = rope(k, positions, cfg.rope_theta)
+    if apply_rope:  # the fused decode kernel ropes Q/K in-kernel
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
     return q, k, v
 
 
@@ -287,7 +290,7 @@ def prefill(
     mask = causal & valid[:, None, :]
 
     # flat cache slot per (b, s): page_table[b, s // page] * page + s % page
-    n_slots = kv_cache.shape[2]
+    n_slots = kvq.n_slots(kv_cache)
     slot = (
         jnp.take_along_axis(page_table, positions // page_size, axis=1) * page_size
         + positions % page_size
@@ -299,8 +302,7 @@ def prefill(
         # padded positions scatter to an out-of-bounds slot, which
         # mode="drop" discards (negative indices would wrap instead)
         flat = jnp.where(valid, slot, n_slots)
-        kv_cache = kv_cache.at[i, 0, flat].set(k, mode="drop")
-        kv_cache = kv_cache.at[i, 1, flat].set(v, mode="drop")
+        kv_cache = kvq.scatter_kv(kv_cache, i, flat, k, v)
         attn = _attention(q, k, v, mask)
         x = x + _wo_project(p, i, attn, lora, adapter_idx)
         h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
@@ -345,7 +347,7 @@ def prefill_sp(
     B, S = tokens.shape
     positions = jnp.arange(S, dtype=jnp.int32)[None, :].repeat(B, 0)
     valid = positions < seq_lens[:, None]
-    n_slots = kv_cache.shape[2]
+    n_slots = kvq.n_slots(kv_cache)
     slot = (
         jnp.take_along_axis(page_table, positions // page_size, axis=1)
         * page_size
@@ -356,8 +358,7 @@ def prefill_sp(
         h = rms_norm(x, p[f"l{i}.attn_norm"], cfg.norm_eps)
         q, k, v = _project_qkv(p, i, h, positions, cfg, lora, adapter_idx)
         flat = jnp.where(valid, slot, n_slots)
-        kv_cache = kv_cache.at[i, 0, flat].set(k, mode="drop")
-        kv_cache = kv_cache.at[i, 1, flat].set(v, mode="drop")
+        kv_cache = kvq.scatter_kv(kv_cache, i, flat, k, v)
         attn = ring_attention(
             q, k.astype(q.dtype), v.astype(q.dtype),
             mesh=mesh, causal=True, strategy=strategy,
@@ -385,26 +386,38 @@ def decode_step(
     mlp=None,  # pluggable feed-forward (MoE families override)
     lora=None,  # stacked adapters (models/lora.py)
     adapter_idx=None,  # [B] int32 adapter row per slot
-    attn_impl: str = "",  # "" = XLA gather; "pallas" = ragged paged kernel
+    attn_impl: str = "",  # see below
+    mesh=None,  # jax Mesh — required by attn_impl="fused" on a mesh
 ) -> tuple[jax.Array, jax.Array]:
     """One continuous-batching decode step; returns (logits [B, V], cache).
 
-    The hot loop: fixed shapes, cache gathered per sequence window
-    [B, T_max] where T_max = max_pages * page_size. Inactive slots are
-    masked and write to dropped slots.
+    The hot loop: fixed shapes, inactive slots masked (their K/V writes
+    drop). ``attn_impl`` selects the decode-attention rung (resolved by
+    tpuserve/attention.py's fallback matrix, never directly by users):
 
-    ``attn_impl="pallas"`` replaces the gather+dense attention with the
-    ragged paged-attention kernel (ops/pallas/paged_attention.py): HBM
-    reads scale with actual sequence lengths instead of the padded
-    window. Single-mesh only — under GSPMD the gather path is used (the
-    engine gates this).
+    - ``""`` — XLA gather: the full padded window [B, T_max] is
+      gathered per slot and runs dense attention (dequantizing at the
+      gather when the pool is int8/int4).
+    - ``"pallas"`` — the chained ragged paged-attention kernel
+      (ops/pallas/paged_attention.py): scatter first, kernel reads the
+      pool. Native-dtype pools only.
+    - ``"fused"`` — the fused-step XLA reference
+      (ops/pallas/decode_fused.paged_decode_walk): scatter (quantizing
+      in-pass), then online-softmax page walk — memory bounded at
+      [B, page], never the padded window. With ``mesh`` the walk runs
+      per head-shard inside shard_map: each device walks its LOCAL
+      pool shard — no GSPMD gather.
+    - ``"fused-pallas"`` — ONE kernel per dispatch
+      (ops/pallas/decode_fused.fused_paged_decode): RoPE + quantized
+      append + paged attention fused; requires the engine's reserved
+      dump page (last pool page) for inactive-slot writes.
     """
     B = tokens.shape[0]
     max_pages = page_table.shape[1]
     T = max_pages * page_size
     pos1 = positions[:, None]  # [B, 1]
 
-    n_slots = kv_cache.shape[2]
+    n_slots = kvq.n_slots(kv_cache)
     slot = (
         jnp.take_along_axis(page_table, pos1 // page_size, axis=1) * page_size
         + pos1 % page_size
@@ -412,7 +425,13 @@ def decode_step(
     slot = jnp.where(active[:, None], slot, n_slots)  # OOB → dropped
 
     use_pallas = attn_impl == "pallas"
-    if not use_pallas:
+    use_fused_kernel = attn_impl == "fused-pallas"
+    use_fused_walk = attn_impl == "fused"
+    if use_pallas and kvq.is_quantized(kv_cache):
+        raise NotImplementedError(
+            "the chained Pallas decode kernel has no quantized-pool "
+            "rung — the fallback matrix resolves int8/int4 to fused")
+    if not (use_pallas or use_fused_kernel or use_fused_walk):
         # gather the full (padded) KV window for each slot
         t_idx = jnp.arange(T, dtype=jnp.int32)[None, :].repeat(B, 0)
         gslot = page_table[:, :, None] * page_size + jnp.arange(
@@ -420,7 +439,7 @@ def decode_step(
         )
         gslot = gslot.reshape(B, T)  # [B, T] flat cache indices
         attend = t_idx <= pos1  # causal within the sequence window
-    else:
+    elif use_pallas:
         from aigw_tpu.ops.pallas._compat import is_tpu_backend
         from aigw_tpu.ops.pallas.paged_attention import (
             paged_attention_decode_v2,
@@ -428,22 +447,60 @@ def decode_step(
 
         lengths = jnp.where(active, positions + 1, 0)
         interp = not is_tpu_backend()
+    elif use_fused_walk:
+        from aigw_tpu.ops.pallas.decode_fused import (
+            paged_decode_walk,
+            paged_decode_walk_spmd,
+        )
 
+        lengths = jnp.where(active, positions + 1, 0)
+    else:
+        from aigw_tpu.ops.pallas._compat import is_tpu_backend
+        from aigw_tpu.ops.pallas.decode_fused import fused_paged_decode
+
+        interp = not is_tpu_backend()
+
+    HD = cfg.n_heads * cfg.head_dim
     x = _embed_rows(p, tokens[:, None])  # [B, 1, dim]
     for i in range(cfg.n_layers):
         h = rms_norm(x, p[f"l{i}.attn_norm"], cfg.norm_eps)
-        q, k, v = _project_qkv(p, i, h, pos1, cfg, lora, adapter_idx)
-        kv_cache = kv_cache.at[i, 0, slot].set(k, mode="drop")
-        kv_cache = kv_cache.at[i, 1, slot].set(v, mode="drop")
-        if use_pallas:
-            attn = paged_attention_decode_v2(
-                q[:, 0], kv_cache[i, 0], kv_cache[i, 1], page_table,
-                lengths, page_size=page_size, interpret=interp,
-            ).reshape(B, 1, cfg.n_heads * cfg.head_dim)
+        q, k, v = _project_qkv(p, i, h, pos1, cfg, lora, adapter_idx,
+                               apply_rope=not use_fused_kernel)
+        if use_fused_kernel:
+            # RoPE + append + attention in ONE kernel; the pool leaves
+            # come back with the new row already written
+            kr, ksc = kvq.layer_pool(kv_cache, i, 0)
+            vr, vsc = kvq.layer_pool(kv_cache, i, 1)
+            outs = fused_paged_decode(
+                q[:, 0], k[:, 0], v[:, 0], kr, vr, page_table,
+                positions, active, k_scale=ksc, v_scale=vsc,
+                rope_theta=cfg.rope_theta, page_size=page_size,
+                interpret=interp)
+            attn = outs[0].reshape(B, 1, HD)
+            kv_cache = kvq.set_layer_pool(kv_cache, i, *outs[1:])
         else:
-            k_all = kv_cache[i, 0][gslot]  # [B, T, Hkv, D]
-            v_all = kv_cache[i, 1][gslot]
-            attn = _attention(q, k_all, v_all, attend[:, None, :])
+            kv_cache = kvq.scatter_kv(kv_cache, i, slot, k, v)
+            if use_pallas:
+                attn = paged_attention_decode_v2(
+                    q[:, 0], kv_cache[i, 0], kv_cache[i, 1], page_table,
+                    lengths, page_size=page_size, interpret=interp,
+                ).reshape(B, 1, HD)
+            elif use_fused_walk:
+                kr, ksc = kvq.layer_pool(kv_cache, i, 0)
+                vr, vsc = kvq.layer_pool(kv_cache, i, 1)
+                if mesh is not None:
+                    attn = paged_decode_walk_spmd(
+                        q[:, 0], kr, vr, page_table, lengths,
+                        mesh=mesh, page_size=page_size,
+                        k_scale=ksc, v_scale=vsc)
+                else:
+                    attn = paged_decode_walk(
+                        q[:, 0], kr, vr, page_table, lengths,
+                        page_size=page_size, k_scale=ksc, v_scale=vsc)
+                attn = attn.reshape(B, 1, HD)
+            else:
+                k_all, v_all = kvq.gather_kv(kv_cache, i, gslot)
+                attn = _attention(q, k_all, v_all, attend[:, None, :])
         x = x + _wo_project(p, i, attn, lora, adapter_idx)
         h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
         x = x + (mlp(p, i, h) if mlp is not None
@@ -479,7 +536,7 @@ def verify_step(
     """
     B, S = tokens.shape
     T = page_table.shape[1] * page_size
-    n_slots = kv_cache.shape[2]
+    n_slots = kvq.n_slots(kv_cache)
     start = positions
     positions = positions[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
     valid = active[:, None] & (positions < limits[:, None])  # [B, S]
@@ -492,6 +549,10 @@ def verify_step(
     flat = jnp.where(valid, slot, n_slots)  # OOB → dropped by scatter
 
     use_pallas = attn_impl == "pallas"
+    if use_pallas and kvq.is_quantized(kv_cache):
+        raise NotImplementedError(
+            "the Pallas verify kernel has no quantized-pool rung — the "
+            "fallback matrix keeps int8/int4 on the gather-dequant path")
     if not use_pallas:
         gslot = page_table[:, :, None] * page_size + jnp.arange(
             page_size, dtype=jnp.int32
@@ -513,16 +574,14 @@ def verify_step(
     for i in range(cfg.n_layers):
         h = rms_norm(x, p[f"l{i}.attn_norm"], cfg.norm_eps)
         q, k, v = _project_qkv(p, i, h, positions, cfg, lora, adapter_idx)
-        kv_cache = kv_cache.at[i, 0, flat].set(k, mode="drop")
-        kv_cache = kv_cache.at[i, 1, flat].set(v, mode="drop")
+        kv_cache = kvq.scatter_kv(kv_cache, i, flat, k, v)
         if use_pallas:
             attn = paged_attention_verify(
                 q, kv_cache[i, 0], kv_cache[i, 1], page_table, pal_pos,
                 page_size=page_size, interpret=interp,
             ).reshape(B, S, cfg.n_heads * cfg.head_dim)
         else:
-            k_all = kv_cache[i, 0][gslot]  # [B, T, Hkv, D]
-            v_all = kv_cache[i, 1][gslot]
+            k_all, v_all = kvq.gather_kv(kv_cache, i, gslot)
             mask = (t_idx[:, None, :] <= positions[:, :, None]) \
                 & valid[..., None]
             attn = _attention(q, k_all, v_all, mask)
@@ -536,12 +595,14 @@ def verify_step(
 
 def _ragged_window_attention(
     q: jax.Array,  # [T, H, D] packed queries (f32/bf16)
-    k_pool: jax.Array,  # [n_slots, Hkv, D]
+    k_pool: jax.Array,  # [n_slots, Hkv, D] (native or int8/int4)
     v_pool: jax.Array,
     pt_rows: jax.Array,  # [T, P] page ids of each token's sequence
     positions: jax.Array,  # [T] absolute position per token
     valid: jax.Array,  # [T] bool — False for padding rows
     page_size: int,
+    k_scale: jax.Array | None = None,  # [n_slots, Hkv] (quantized pool)
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
     """XLA reference for the ragged prefill attention: online softmax
     over the page window, one page per loop step — the same math as the
@@ -561,6 +622,9 @@ def _ragged_window_attention(
         slots = pt_rows[:, p][:, None] * page_size + offs[None, :]
         k = k_pool[slots].astype(jnp.float32)  # [T, page, Hkv, D]
         v = v_pool[slots].astype(jnp.float32)
+        if k_scale is not None:  # quantized pages: dequant at the read
+            k = k * k_scale[slots][..., None]
+            v = v * v_scale[slots][..., None]
         logits = jnp.einsum("thgd,tshd->thgs", qf, k)  # [T, Hkv, grp, page]
         kp = p * page_size + offs
         mask = (kp[None, :] <= positions[:, None]) & valid[:, None]
@@ -620,7 +684,7 @@ def prefill_ragged(
     B, P = page_table.shape
     valid = row_seq < B
     rs = jnp.minimum(row_seq, B - 1)
-    n_slots = kv_cache.shape[2]
+    n_slots = kvq.n_slots(kv_cache)
     pt_rows = page_table[rs]  # [T, P]
     slot = (
         jnp.take_along_axis(
@@ -632,6 +696,11 @@ def prefill_ragged(
     atok = adapter_idx[rs] if adapter_idx is not None else None
 
     use_pallas = attn_impl == "pallas"
+    if use_pallas and kvq.is_quantized(kv_cache):
+        raise NotImplementedError(
+            "the Pallas ragged-prefill kernel has no quantized-pool "
+            "rung — the fallback matrix keeps int8/int4 on the XLA "
+            "windowed path")
     if use_pallas:
         from aigw_tpu.ops.pallas._compat import is_tpu_backend
         from aigw_tpu.ops.pallas.paged_attention import (
@@ -653,17 +722,18 @@ def prefill_ragged(
     for i in range(cfg.n_layers):
         h = rms_norm(x, p[f"l{i}.attn_norm"], cfg.norm_eps)
         q, k, v = _project_qkv(p, i, h, pos2, cfg, lora, atok)
-        kv_cache = kv_cache.at[i, 0, flat].set(k, mode="drop")
-        kv_cache = kv_cache.at[i, 1, flat].set(v, mode="drop")
+        kv_cache = kvq.scatter_kv(kv_cache, i, flat, k, v)
         if use_pallas:
             attn = ragged_prefill_attention(
                 q[:, 0], kv_cache[i, 0], kv_cache[i, 1], page_table,
                 cu, start, page_size=page_size, interpret=interp,
             ).reshape(T, 1, cfg.n_heads * cfg.head_dim)
         else:
+            kr, ksc = kvq.layer_pool(kv_cache, i, 0)
+            vr, vsc = kvq.layer_pool(kv_cache, i, 1)
             attn = _ragged_window_attention(
-                q[:, 0], kv_cache[i, 0], kv_cache[i, 1], pt_rows,
-                positions, valid, page_size,
+                q[:, 0], kr, vr, pt_rows, positions, valid, page_size,
+                k_scale=ksc, v_scale=vsc,
             ).reshape(T, 1, -1)
         x = x + _wo_project(p, i, attn, lora, atok)
         h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
@@ -726,7 +796,7 @@ def prefill_suffix(
     """
     B, S = tokens.shape
     T = page_table.shape[1] * page_size
-    n_slots = kv_cache.shape[2]
+    n_slots = kvq.n_slots(kv_cache)
     positions = prefix_lens[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
     valid = positions < seq_lens[:, None]  # [B, S]
 
@@ -747,10 +817,8 @@ def prefill_suffix(
     for i in range(cfg.n_layers):
         h = rms_norm(x, p[f"l{i}.attn_norm"], cfg.norm_eps)
         q, k, v = _project_qkv(p, i, h, positions, cfg, lora, adapter_idx)
-        kv_cache = kv_cache.at[i, 0, flat].set(k, mode="drop")
-        kv_cache = kv_cache.at[i, 1, flat].set(v, mode="drop")
-        k_all = kv_cache[i, 0][gslot]  # [B, T, Hkv, D]
-        v_all = kv_cache[i, 1][gslot]
+        kv_cache = kvq.scatter_kv(kv_cache, i, flat, k, v)
+        k_all, v_all = kvq.gather_kv(kv_cache, i, gslot)
         # causal over global positions; padded queries masked by `valid`
         mask = (t_idx[:, None, :] <= positions[:, :, None]) & valid[..., None]
         attn = _attention(q, k_all, v_all, mask)

@@ -157,10 +157,11 @@ def prefill(p, cfg: MixtralConfig, tokens, seq_lens, kv_cache, page_table,
 
 def decode_step(p, cfg: MixtralConfig, tokens, positions, kv_cache,
                 page_table, page_size, active, lora=None, adapter_idx=None,
-                attn_impl=""):
+                attn_impl="", mesh=None):
     return llama.decode_step(p, cfg.as_llama(), tokens, positions, kv_cache,
                              page_table, page_size, active,
-                             mlp=_mlp_fn(cfg), attn_impl=attn_impl)
+                             mlp=_mlp_fn(cfg), attn_impl=attn_impl,
+                             mesh=mesh)
 
 
 def hidden_states(p, cfg: MixtralConfig, tokens, seq_lens):

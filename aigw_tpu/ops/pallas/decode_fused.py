@@ -1,0 +1,486 @@
+"""Fused Pallas TPU decode step: RoPE + KV append + paged attention in
+ONE kernel per dispatch, with optional int8/int4 KV pages dequantized
+in-kernel against per-page scale blocks (models/kvq.py layout).
+
+The chained decode path runs, per layer: rope (XLA) → K/V scatter
+(XLA) → window gather → dense attention — four HBM round-trips of which
+the padded-window gather is the largest. This kernel collapses them:
+
+- Grid ``(B, P)`` — sequence, then pages innermost, exactly the
+  ``paged_attention_decode_v2`` walk (scalar-prefetch page table,
+  data-dependent index map, ragged DMA skip: pages past a sequence's
+  last valid page clamp to it, so the Pallas pipeline skips the
+  re-fetch and HBM traffic scales with real cache occupancy).
+- **RoPE in-kernel**: per-dispatch interleaved cos/sin tables
+  ``[B, D]`` are precomputed once outside (they depend only on the
+  positions scalar vector); the rotation itself — the per-head FLOPs —
+  runs in VMEM as ``x·cos + (x @ S)·sin`` where ``S`` is the constant
+  pair-swap matrix (built from iotas; a [D, D] MXU matmul instead of a
+  lane-strided shuffle, which Mosaic lays out poorly).
+- **In-kernel append**: the new K/V row (quantized when the pool is
+  int8/int4: symmetric absmax per head, the kvq.py recipe bit-for-bit)
+  is written into its page through ``input_output_aliases`` on the pool
+  buffers — the output block spec targets the append page, which for a
+  mid-page append IS the final walk block already in VMEM, so the
+  read-modify-write costs one extra block copy-out, not a scatter pass
+  over HBM. A page-aligned append starts a fresh page (no prior rows to
+  preserve). Inactive slots write a zero row into the pool's LAST page,
+  which the engine reserves as a dump page no page table ever
+  references (the Pallas output pipeline must write *somewhere*; the
+  XLA paths get the same guarantee from OOB-drop scatters).
+- **Attention**: online softmax over the walked pages (pool rows
+  ``< position``) with the new token's K/V folded in-register at
+  finalize — the attended value for the current token is exactly the
+  quantize→dequantize round-trip later steps will read back from HBM,
+  so a token's view of itself never drifts between steps.
+- **In-kernel dequant**: quantized pages multiply by their scale
+  column as they stream through VMEM — the packed layout never
+  round-trips through HBM at full width.
+
+Semantics match ``paged_decode_walk`` below (the XLA fused reference
+the engine runs off-TPU and, under a mesh, per head-shard inside
+shard_map): scatter-then-walk attends pool rows ``<= position`` where
+row ``position`` holds the freshly appended (round-tripped) values —
+identical numbers to walk-then-fold. Parity is asserted in
+tests/test_pallas_ops.py at production shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_QMAX = {"int8": 127.0, "int4": 7.0}
+
+
+def _rope_tables(positions: jax.Array, head_dim: int,
+                 rope_theta: float):
+    """Interleaved cos/sin tables [B, D] for the kernel's in-VMEM
+    rotation: column d carries angle(pos, d // 2)."""
+    freqs = 1.0 / (rope_theta ** (
+        jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    full = jnp.repeat(freqs, 2)  # [D]
+    ang = positions.astype(jnp.float32)[:, None] * full[None, :]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def _swap_matrix(D: int):
+    """Constant [D, D] pair-swap-with-sign matrix: (x @ S)[2i] =
+    -x[2i+1], (x @ S)[2i+1] = x[2i] — the rotate-pairs half of
+    interleaved RoPE as an MXU matmul."""
+    r = jax.lax.broadcasted_iota(jnp.int32, (D, D), 0)
+    c = jax.lax.broadcasted_iota(jnp.int32, (D, D), 1)
+    up = ((c == r + 1) & (r % 2 == 0)).astype(jnp.float32)
+    dn = ((c == r - 1) & (r % 2 == 1)).astype(jnp.float32)
+    return up - dn
+
+
+def _rope_rows(x: jax.Array, cos: jax.Array, sin: jax.Array):
+    """Rotate rows [R, D] by the interleaved tables [D] (f32 in/out)."""
+    S = _swap_matrix(x.shape[-1])
+    rot = jax.lax.dot_general(
+        x, S, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return x * cos[None, :] + rot * sin[None, :]
+
+
+def _fused_kernel(
+    # scalar prefetch
+    pt_ref,  # [B * P] int32 — pool page id per (b, p)
+    len_ref,  # [B] int32 — pool rows already written (= position)
+    act_ref,  # [B] int32 — 1 when the slot decodes this step
+    apg_ref,  # [B] int32 — pool page the new row lands in (dump page
+    #           for inactive slots)
+    arow_ref,  # [B] int32 — row within that page (position % page)
+    # blocks
+    q_ref,  # [1, H * D] unroped query
+    kn_ref,  # [1, Hkv * D] unroped new key
+    vn_ref,  # [1, Hkv * D] new value
+    cos_ref,  # [1, D] f32
+    sin_ref,  # [1, D] f32
+    k_ref,  # [page, Hkv * D] pool page (walk index map)
+    v_ref,  # [page, Hkv * D]
+    *rest,  # [ks_ref, vs_ref,] o_ref, ko_ref, vo_ref[, kso_ref, vso_ref]
+    #         + scratch m_ref, l_ref, acc_ref, qr_ref
+    page_size: int,
+    n_pages: int,
+    n_kv_heads: int,
+    head_dim: int,
+    qmax: float,
+):
+    quant = qmax > 0.0
+    if quant:
+        (ks_ref, vs_ref, o_ref, ko_ref, vo_ref, kso_ref, vso_ref,
+         m_ref, l_ref, acc_ref, qr_ref) = rest
+    else:
+        o_ref, ko_ref, vo_ref, m_ref, l_ref, acc_ref, qr_ref = rest
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    D = head_dim
+    H = q_ref.shape[1] // D
+    grp = H // n_kv_heads
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -1e30)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        # rope q once per sequence; reused (pre-scaled) by every page
+        # step and the finalize fold
+        cos = cos_ref[0]
+        sin = sin_ref[0]
+        q = q_ref[0].astype(jnp.float32).reshape(H, D)
+        # round through the model compute dtype exactly like the XLA
+        # path (rope() returns x.dtype before attention reads it)
+        qr = _rope_rows(q, cos, sin).astype(q_ref.dtype).astype(
+            jnp.float32)
+        qr_ref[:] = qr / math.sqrt(D)
+
+    length = len_ref[b]
+    valid = jnp.clip(length - p * page_size, 0, page_size)
+
+    @pl.when(valid > 0)
+    def _attend():
+        page = k_ref.shape[0]
+        mask = jax.lax.broadcasted_iota(
+            jnp.int32, (grp, page), 1) < valid
+        for h in range(n_kv_heads):
+            rows = slice(h * grp, (h + 1) * grp)
+            k_h = k_ref[:, h * D:(h + 1) * D].astype(jnp.float32)
+            v_h = v_ref[:, h * D:(h + 1) * D].astype(jnp.float32)
+            if quant:
+                k_h = k_h * ks_ref[:, h:h + 1]
+                v_h = v_h * vs_ref[:, h:h + 1]
+            logits = jax.lax.dot_general(
+                qr_ref[rows, :], k_h,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # qr is pre-scaled by 1/sqrt(D)
+            logits = jnp.where(mask, logits, -1e30)
+            m_prev = m_ref[rows, 0:1]
+            m_new = jnp.maximum(
+                m_prev, jnp.max(logits, axis=1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            probs = jnp.exp(logits - m_new)
+            l_ref[rows, 0:1] = alpha * l_ref[rows, 0:1] + jnp.sum(
+                probs, axis=1, keepdims=True)
+            pv = jax.lax.dot_general(
+                probs, v_h,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_ref[rows, :] = acc_ref[rows, :] * alpha + pv
+            m_ref[rows, 0:1] = m_new
+
+    @pl.when(p == n_pages - 1)
+    def _finalize():
+        is_act = act_ref[b] == 1
+        arow = arow_ref[b]
+        cos = cos_ref[0]
+        sin = sin_ref[0]
+        kn = _rope_rows(
+            kn_ref[0].astype(jnp.float32).reshape(n_kv_heads, D),
+            cos, sin).astype(kn_ref.dtype).astype(jnp.float32)  # [Hkv, D]
+        vn = vn_ref[0].astype(jnp.float32).reshape(n_kv_heads, D)
+        if quant:
+            # the kvq.py recipe, bit-for-bit: symmetric absmax/head,
+            # round-half-even, qmax-clipped
+            k_amax = jnp.max(jnp.abs(kn), axis=1)
+            v_amax = jnp.max(jnp.abs(vn), axis=1)
+            k_s = jnp.where(k_amax > 0.0, k_amax / qmax, 1.0)
+            v_s = jnp.where(v_amax > 0.0, v_amax / qmax, 1.0)
+            kq = jnp.clip(jnp.round(kn / k_s[:, None]), -qmax, qmax)
+            vq = jnp.clip(jnp.round(vn / v_s[:, None]), -qmax, qmax)
+            # the value every later read dequantizes to — fold THAT
+            k_eff = kq * k_s[:, None]
+            v_eff = vq * v_s[:, None]
+        else:
+            # the bf16/f32 round-trip the chained scatter+gather pays
+            k_eff = kn.astype(ko_ref.dtype).astype(jnp.float32)
+            v_eff = vn.astype(vo_ref.dtype).astype(jnp.float32)
+
+        @pl.when(is_act)
+        def _fold_new_token():
+            for h in range(n_kv_heads):
+                rows = slice(h * grp, (h + 1) * grp)
+                logit = jax.lax.dot_general(
+                    qr_ref[rows, :], k_eff[h:h + 1, :],
+                    dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )  # [grp, 1]
+                m_prev = m_ref[rows, 0:1]
+                m_new = jnp.maximum(m_prev, logit)
+                alpha = jnp.exp(m_prev - m_new)
+                pnew = jnp.exp(logit - m_new)
+                l_ref[rows, 0:1] = (alpha * l_ref[rows, 0:1] + pnew)
+                acc_ref[rows, :] = (acc_ref[rows, :] * alpha
+                                    + pnew * v_eff[h:h + 1, :])
+                m_ref[rows, 0:1] = m_new
+
+        denom = jnp.maximum(l_ref[:, 0:1], 1e-30)
+        o_ref[0] = (acc_ref[:] / denom).reshape(1, H * D)[0].astype(
+            o_ref.dtype)
+
+        # -- append: rewrite the target page with the new row ----------
+        page = k_ref.shape[0]
+        row_mask = jax.lax.broadcasted_iota(
+            jnp.int32, (page, n_kv_heads * D), 0) == arow
+        # page-aligned append starts a FRESH page (the walk never
+        # fetched it — rows past the append are unwritten future
+        # positions); mid-page appends extend the final walk block
+        fresh = arow == 0
+        base_k = jnp.where(fresh, jnp.zeros_like(k_ref), k_ref[:])
+        base_v = jnp.where(fresh, jnp.zeros_like(v_ref), v_ref[:])
+        if quant:
+            new_k = kq.reshape(1, n_kv_heads * D).astype(ko_ref.dtype)
+            new_v = vq.reshape(1, n_kv_heads * D).astype(vo_ref.dtype)
+        else:
+            new_k = kn.reshape(1, n_kv_heads * D).astype(ko_ref.dtype)
+            new_v = vn.reshape(1, n_kv_heads * D).astype(vo_ref.dtype)
+        zero_row = jnp.zeros_like(new_k)
+        ko_ref[:] = jnp.where(
+            row_mask, jnp.where(is_act, new_k, zero_row), base_k)
+        vo_ref[:] = jnp.where(
+            row_mask, jnp.where(is_act, new_v, zero_row), base_v)
+        if quant:
+            srow_mask = jax.lax.broadcasted_iota(
+                jnp.int32, (page, n_kv_heads), 0) == arow
+            base_ks = jnp.where(fresh, jnp.zeros_like(ks_ref),
+                                ks_ref[:])
+            base_vs = jnp.where(fresh, jnp.zeros_like(vs_ref),
+                                vs_ref[:])
+            kso_ref[:] = jnp.where(
+                srow_mask,
+                jnp.where(is_act, k_s[None, :], 0.0), base_ks)
+            vso_ref[:] = jnp.where(
+                srow_mask,
+                jnp.where(is_act, v_s[None, :], 0.0), base_vs)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("rope_theta", "page_size", "interpret"))
+def fused_paged_decode(
+    q: jax.Array,  # [B, H, D] UNROPED query
+    k_new: jax.Array,  # [B, Hkv, D] UNROPED new key
+    v_new: jax.Array,  # [B, Hkv, D] new value
+    k_rows: jax.Array,  # [n_slots, Hkv, D] pool (native or int8/int4)
+    v_rows: jax.Array,
+    page_table: jax.Array,  # [B, P] int32
+    positions: jax.Array,  # [B] int32 — position of the new token
+    active: jax.Array,  # [B] bool
+    k_scale: jax.Array | None = None,  # [n_slots, Hkv] f32 (quantized)
+    v_scale: jax.Array | None = None,
+    *,
+    rope_theta: float,
+    page_size: int,
+    interpret: bool = False,
+):
+    """One fused decode dispatch. Returns ``(attn [B, H, D] in q's
+    dtype, k_rows', v_rows'[, k_scale', v_scale'])`` — the pool leaves
+    are updated IN the kernel (input_output_aliases) with the new row
+    appended at ``positions``; inactive rows write a zero row into the
+    pool's last page (the engine-reserved dump page)."""
+    B, H, D = q.shape
+    n_slots, Hkv, _ = k_rows.shape
+    P = page_table.shape[1]
+    quant = k_scale is not None
+    qdt = str(k_rows.dtype)
+    qmax = _QMAX.get(qdt, 0.0) if quant else 0.0
+
+    lengths = jnp.where(active, positions, 0).astype(jnp.int32)
+    act = active.astype(jnp.int32)
+    dump_page = n_slots // page_size - 1
+    app_idx = jnp.clip(positions // page_size, 0, P - 1)
+    app_page = jnp.where(
+        active,
+        jnp.take_along_axis(page_table, app_idx[:, None], axis=1)[:, 0],
+        dump_page).astype(jnp.int32)
+    app_row = jnp.where(active, positions % page_size, 0).astype(
+        jnp.int32)
+    cos_t, sin_t = _rope_tables(positions, D, rope_theta)
+
+    q2d = q.reshape(B, H * D)
+    kn2d = k_new.reshape(B, Hkv * D)
+    vn2d = v_new.reshape(B, Hkv * D)
+    k2d = k_rows.reshape(n_slots, Hkv * D)
+    v2d = v_rows.reshape(n_slots, Hkv * D)
+    flat_pt = page_table.reshape(-1)
+
+    def row_index(b, p, pt, ln, ac, apg, ar):
+        return b, 0
+
+    def kv_index(b, p, pt, ln, ac, apg, ar):
+        # ragged DMA skip: pages past the last valid page clamp to it
+        last = jnp.maximum(ln[b] - 1, 0) // page_size
+        return pt[b * P + jnp.minimum(p, last)], 0
+
+    def append_index(b, p, pt, ln, ac, apg, ar):
+        return apg[b], 0
+
+    in_specs = [
+        pl.BlockSpec((1, H * D), row_index),
+        pl.BlockSpec((1, Hkv * D), row_index),
+        pl.BlockSpec((1, Hkv * D), row_index),
+        pl.BlockSpec((1, D), row_index),
+        pl.BlockSpec((1, D), row_index),
+        pl.BlockSpec((page_size, Hkv * D), kv_index),
+        pl.BlockSpec((page_size, Hkv * D), kv_index),
+    ]
+    inputs = [q2d, kn2d, vn2d, cos_t, sin_t, k2d, v2d]
+    out_specs = [
+        pl.BlockSpec((1, H * D), row_index),
+        pl.BlockSpec((page_size, Hkv * D), append_index),
+        pl.BlockSpec((page_size, Hkv * D), append_index),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((B, H * D), q.dtype),
+        jax.ShapeDtypeStruct(k2d.shape, k2d.dtype),
+        jax.ShapeDtypeStruct(v2d.shape, v2d.dtype),
+    ]
+    # alias indices count ALL flattened operands, scalar-prefetch args
+    # included (5 scalars, then q/kn/vn/cos/sin at 5-9, pools at 10+)
+    aliases = {10: 1, 11: 2}  # k2d → ko, v2d → vo
+    if quant:
+        in_specs += [
+            pl.BlockSpec((page_size, Hkv), kv_index),
+            pl.BlockSpec((page_size, Hkv), kv_index),
+        ]
+        inputs += [k_scale, v_scale]
+        out_specs += [
+            pl.BlockSpec((page_size, Hkv), append_index),
+            pl.BlockSpec((page_size, Hkv), append_index),
+        ]
+        out_shape += [
+            jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
+            jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype),
+        ]
+        aliases[12] = 3  # k_scale → kso
+        aliases[13] = 4  # v_scale → vso
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(B, P),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=[
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, D), jnp.float32),
+            pltpu.VMEM((H, D), jnp.float32),  # roped q / sqrt(D)
+        ],
+    )
+    kernel = functools.partial(
+        _fused_kernel, page_size=page_size, n_pages=P,
+        n_kv_heads=Hkv, head_dim=D, qmax=qmax,
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(flat_pt, lengths, act, app_page, app_row, *inputs)
+    attn = outs[0].reshape(B, H, D)
+    k_out = outs[1].reshape(n_slots, Hkv, D)
+    v_out = outs[2].reshape(n_slots, Hkv, D)
+    if quant:
+        return attn, k_out, v_out, outs[3], outs[4]
+    return attn, k_out, v_out
+
+
+def paged_decode_walk(
+    q: jax.Array,  # [B, H, D] roped query
+    k_rows: jax.Array,  # [n_slots, Hkv, D] pool (native or int8/int4)
+    v_rows: jax.Array,
+    page_table: jax.Array,  # [B, P] int32
+    lengths: jax.Array,  # [B] int32 — rows to attend (incl. new token)
+    *,
+    page_size: int,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
+) -> jax.Array:
+    """XLA fused-decode reference: online-softmax paged attention,
+    one page per loop step — the fused kernel's math with memory
+    bounded at [B, page, Hkv, D] instead of the gather path's full
+    padded [B, T] window. The new token's K/V are already scattered
+    (``lengths`` includes them), so walk-then-read equals the kernel's
+    walk-then-fold. Quantized pools dequantize at the read. Off-TPU
+    this IS the serving path; on a mesh it runs per head-shard inside
+    shard_map (paged_decode_walk_spmd). Returns [B, H, D] in q's
+    dtype."""
+    B, H, D = q.shape
+    Hkv = k_rows.shape[1]
+    grp = H // Hkv
+    P = page_table.shape[1]
+    qf = q.astype(jnp.float32).reshape(B, Hkv, grp, D) / math.sqrt(D)
+    offs = jnp.arange(page_size, dtype=jnp.int32)
+
+    def body(p, carry):
+        m, l, acc = carry
+        slots = page_table[:, p][:, None] * page_size + offs[None, :]
+        k = k_rows[slots].astype(jnp.float32)  # [B, page, Hkv, D]
+        v = v_rows[slots].astype(jnp.float32)
+        if k_scale is not None:
+            k = k * k_scale[slots][..., None]
+            v = v * v_scale[slots][..., None]
+        logits = jnp.einsum("bhgd,bshd->bhgs", qf, k)
+        kpos = p * page_size + offs
+        mask = kpos[None, :] < lengths[:, None]  # [B, page]
+        logits = jnp.where(mask[:, None, None, :], logits, -1e30)
+        m_new = jnp.maximum(m, logits.max(-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        probs = jnp.exp(logits - m_new)
+        l_new = alpha * l + probs.sum(-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum("bhgs,bshd->bhgd", probs, v)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((B, Hkv, grp, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((B, Hkv, grp, 1), jnp.float32)
+    acc0 = jnp.zeros((B, Hkv, grp, D), jnp.float32)
+    # traced upper bound — the XLA analogue of the ragged DMA skip
+    p_hi = jnp.clip((jnp.max(lengths) - 1) // page_size + 1, 0, P)
+    _, l, acc = jax.lax.fori_loop(0, p_hi, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.reshape(B, H, D).astype(q.dtype)
+
+
+def paged_decode_walk_spmd(
+    q, k_rows, v_rows, page_table, lengths, *, mesh, page_size,
+    k_scale=None, v_scale=None, axis: str = "tp",
+):
+    """The fused walk under shard_map: each device walks ITS local
+    head shard of the pool — per-device local reads, no GSPMD gather,
+    no cross-device collective inside attention (the layer all-reduce
+    after wo is unchanged). Requires H and Hkv divisible by the axis
+    size (the resolution matrix guards this)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as Ps
+
+    heads = Ps(None, axis, None)
+    quant = k_scale is not None
+
+    if quant:
+        def local(q_, k_, v_, ks_, vs_, pt_, ln_):
+            return paged_decode_walk(
+                q_, k_, v_, pt_, ln_, page_size=page_size,
+                k_scale=ks_, v_scale=vs_)
+
+        in_specs = (heads, heads, heads, Ps(None, axis), Ps(None, axis),
+                    Ps(None, None), Ps(None))
+        args = (q, k_rows, v_rows, k_scale, v_scale, page_table,
+                lengths)
+    else:
+        def local(q_, k_, v_, pt_, ln_):
+            return paged_decode_walk(
+                q_, k_, v_, pt_, ln_, page_size=page_size)
+
+        in_specs = (heads, heads, heads, Ps(None, None), Ps(None))
+        args = (q, k_rows, v_rows, page_table, lengths)
+    return shard_map(local, mesh=mesh, in_specs=in_specs,
+                     out_specs=heads, check_rep=False)(*args)

@@ -39,6 +39,7 @@ replica.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from dataclasses import dataclass
 from typing import Any, TYPE_CHECKING
@@ -53,6 +54,10 @@ logger = logging.getLogger(__name__)
 
 #: valid EngineConfig.attention_backend values
 BACKENDS = ("xla-bucketed", "pallas-ragged")
+
+#: valid EngineConfig.decode_backend values ("auto" = chained today;
+#: the fused rung is opt-in until an on-chip capture flips the default)
+DECODE_BACKENDS = ("auto", "chained", "fused")
 
 
 @dataclass
@@ -650,13 +655,14 @@ def resolve_attention_backend(engine: "Engine") -> tuple[str, str]:
     program family a replica actually runs and the reason — never a
     silent behavior change.
 
-    | requested     | mesh | TPU | resolved      | attention impl      |
-    |---------------|------|-----|---------------|---------------------|
-    | xla-bucketed  | any  | any | xla-bucketed  | XLA dense (bucketed)|
-    | pallas-ragged | no   | yes | pallas-ragged | Pallas kernel       |
-    | pallas-ragged | no   | no  | pallas-ragged | XLA windowed        |
-    | pallas-ragged | yes  | any | pallas-ragged | XLA windowed (SPMD) |
-    | pallas-ragged | family w/o prefill_ragged | xla-bucketed         |
+    | requested     | mesh | TPU | kv dtype  | resolved      | attention impl      |
+    |---------------|------|-----|-----------|---------------|---------------------|
+    | xla-bucketed  | any  | any | any       | xla-bucketed  | XLA dense (bucketed)|
+    | pallas-ragged | no   | yes | native    | pallas-ragged | Pallas kernel       |
+    | pallas-ragged | no   | yes | int8/int4 | pallas-ragged | XLA windowed (dequant at read) |
+    | pallas-ragged | no   | no  | any       | pallas-ragged | XLA windowed        |
+    | pallas-ragged | yes  | any | any       | pallas-ragged | XLA windowed (SPMD) |
+    | pallas-ragged | family w/o prefill_ragged | —         | xla-bucketed         |
 
     The Pallas kernel itself stays single-chip TPU (its scalar-prefetch
     page walk addresses one local pool); a mesh keeps the RAGGED
@@ -673,6 +679,82 @@ def resolve_attention_backend(engine: "Engine") -> tuple[str, str]:
                 "ragged prefill entry point")
     # engine._ragged_reason explains the kernel-vs-windowed choice
     return "pallas-ragged", engine._ragged_reason
+
+
+def resolve_decode_backend(cfg, model_cfg, mesh) -> tuple[str, str]:
+    """The DECODE half of the fallback matrix (ISSUE 13): (resolved
+    decode-attention impl, WHY), exported verbatim on /state as
+    ``decode_attn_impl`` / ``decode_attn_reason`` — never a silent
+    behavior change. Requested = ``decode_backend`` (+ the legacy
+    ``pallas_attn`` knob, which names the CHAINED kernel rung).
+
+    | requested          | mesh | TPU | kv dtype  | resolved        |
+    |--------------------|------|-----|-----------|-----------------|
+    | auto/chained       | any  | any | native    | xla-gather      |
+    | auto/chained       | any  | any | int8/int4 | xla-gather (dequant at the gather) |
+    | chained+pallas_attn| no   | any | native    | pallas (chained kernel; interpret off-TPU) |
+    | chained+pallas_attn| no   | any | int8/int4 | fused rung (chained kernel has no quantized rung) |
+    | chained+pallas_attn| yes  | any | any       | fused-xla-spmd  |
+    | fused              | no   | yes | any       | fused-pallas    |
+    | fused              | no   | no  | any       | fused-xla       |
+    | fused              | yes  | any | any       | fused-xla-spmd  |
+    | fused family, heads % tp != 0   | any       | xla-gather (narrowed) |
+
+    The old ``pallas_attn × mesh → xla-gather`` row (the PR 10 "GSPMD
+    gather path" export) is GONE: a mesh now walks each device's LOCAL
+    head shard of the pool inside shard_map (fused-xla-spmd) — no
+    gather, no padded-window HBM traffic — whenever the head counts
+    divide the tp axis. The one remaining gather-on-mesh row is the
+    narrowed indivisible-heads case, exported with its own reason. The
+    speculative VERIFY step keeps the chained path at every rung
+    (its multi-position kernel has no fused port; quantized pools run
+    gather-dequant), which `Engine.verify_attn_impl` exports.
+
+    ``AIGW_DECODE_FUSED_IMPL`` in {xla, pallas} overrides the
+    kernel-vs-reference choice for A/B and interpret-mode parity runs,
+    exactly like AIGW_RAGGED_PREFILL_IMPL on the prefill side."""
+    from aigw_tpu.ops.pallas._compat import is_tpu_backend
+
+    quant = cfg.kv_cache_dtype in ("int8", "int4")
+    req = "chained" if cfg.decode_backend == "auto" else cfg.decode_backend
+    wants_fused = req == "fused" or (
+        req == "chained" and cfg.pallas_attn and (quant or mesh is not None))
+    if not wants_fused:
+        if cfg.pallas_attn and mesh is None:
+            return "pallas", "pallas_attn requested, single chip"
+        if quant:
+            return ("xla-gather",
+                    f"default chained path; {cfg.kv_cache_dtype} KV "
+                    "pages dequantize against their per-page scales at "
+                    "the window gather")
+        return "xla-gather", "default (pallas_attn off)"
+    why = ("decode_backend=fused" if req == "fused" else
+           ("pallas_attn requested with "
+            f"{cfg.kv_cache_dtype} KV pages: the chained kernel has no "
+            "quantized rung" if quant else
+            "pallas_attn requested on a mesh"))
+    if mesh is not None:
+        tp = int(mesh.shape.get("tp", 1))
+        if tp > 1 and (model_cfg.n_heads % tp
+                       or model_cfg.n_kv_heads % tp):
+            return ("xla-gather",
+                    f"{why}, but heads ({model_cfg.n_heads}q/"
+                    f"{model_cfg.n_kv_heads}kv) do not divide tp={tp}: "
+                    "the shard_map local walk needs whole head shards "
+                    "per device; the GSPMD gather keeps reads "
+                    "head-local (narrowed row)")
+        return ("fused-xla-spmd",
+                f"{why}: each device walks its LOCAL head shard of the "
+                "paged pool inside shard_map — the GSPMD gather row is "
+                "deleted")
+    impl_env = os.environ.get("AIGW_DECODE_FUSED_IMPL", "").lower()
+    if impl_env == "pallas" or (impl_env != "xla" and is_tpu_backend()):
+        return ("fused-pallas",
+                f"{why}: fused Pallas kernel (RoPE + append + paged "
+                "attention in one dispatch, single-chip TPU)")
+    return ("fused-xla",
+            f"{why}: XLA fused reference (online-softmax page walk; "
+            "no TPU backend — interpret mode is too slow to serve)")
 
 
 def make_attention_backend(engine: "Engine") -> AttentionBackend:

@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from aigw_tpu.models import llama
+from aigw_tpu.models import kvq, llama
 from aigw_tpu.obs.metrics import EnginePhases
 from aigw_tpu.obs.xla_events import CompileTracker
 from aigw_tpu.tpuserve import constrain, speculation
@@ -223,13 +223,23 @@ class EngineConfig:
     # occasionally. False pins every eligible slot at spec_tokens —
     # the fixed-D A/B and determinism knob.
     spec_adaptive: bool = True
-    # Ragged paged-attention Pallas kernel for the decode hot loop (HBM
-    # reads scale with actual sequence lengths, not the padded window).
-    # The KERNEL stays single-chip (no shard_map port): on a mesh the
-    # decode loop keeps the GSPMD gather path, whose KV reads are local
-    # to each head shard anyway; the resolved impl and the reason are
-    # exported on /state (decode_attn_impl / decode_attn_reason).
+    # Ragged paged-attention Pallas kernel for the CHAINED decode loop
+    # (HBM reads scale with actual sequence lengths, not the padded
+    # window). Resolved through the decode fallback matrix
+    # (tpuserve/attention.resolve_decode_backend): single-chip native
+    # pools run the chained kernel; a mesh or a quantized pool
+    # escalates to the fused rung (the PR 10 gather-on-mesh row is
+    # deleted); /state exports the resolution + why.
     pallas_attn: bool = False
+    # Decode attention rung (ISSUE 13, tpuserve/attention.py):
+    # "auto"/"chained" — the classic per-layer chain (rope → scatter →
+    # window gather / chained Pallas kernel); "fused" — ONE program
+    # per decode dispatch: RoPE + quantized KV append + online-softmax
+    # paged attention (the Pallas kernel on single-chip TPU, an XLA
+    # page-walk reference off-TPU, and a shard_map per-device local
+    # pool walk on a mesh — no GSPMD gather). The resolved impl and
+    # reason export on /state (decode_attn_impl / decode_attn_reason).
+    decode_backend: str = "auto"
     # Prefill attention backend (tpuserve/attention.py):
     # "xla-bucketed" — the classic per-sequence bucket ladder with
     # batched same-bucket groups; "pallas-ragged" — a mixed-length
@@ -252,11 +262,19 @@ class EngineConfig:
     # prefill surface is the rung ladder: ~(ragged_max_chunks + 2)
     # programs for ANY batch geometry.
     ragged_max_chunks: int = 8
-    # KV cache element dtype: "bfloat16" (serving default) or
-    # "float32". f32 doubles KV HBM but removes the bf16 rounding that
-    # lets near-tied logits argmax-flip between mathematically
-    # equivalent schedules — the deterministic-equivalence test mode
-    # (tests/test_chunked_prefill.py) and an accuracy-debug knob.
+    # KV cache element dtype: "bfloat16" (serving default), "float32"
+    # (doubles KV HBM but removes the bf16 rounding that lets near-tied
+    # logits argmax-flip between mathematically equivalent schedules —
+    # the deterministic-equivalence test mode), or "int8"/"int4"
+    # (ISSUE 13, models/kvq.py): pages store quantized rows plus
+    # per-page scale blocks (one f32 absmax scale per token row × KV
+    # head), dequantized in-kernel / at the gather — ~0.52x / ~0.27x
+    # the bf16 KV bytes at head_dim 128, which is the
+    # concurrent-sessions-per-chip lever. Quantized pages ride the
+    # whole stack (spill/revive, migration + fleet fetch at native
+    # dtype + scales, spec verify, CoW); the chained Pallas kernels
+    # have no quantized rung, so the fallback matrix reroutes those
+    # requests (attention.resolve_decode_backend).
     kv_cache_dtype: str = "bfloat16"
     # Multi-tenant fairness guard (ISSUE 7): the maximum decode slots
     # any one tenant (GenRequest.tenant; "" is one anonymous tenant) may
@@ -319,10 +337,17 @@ class EngineConfig:
             raise ValueError(
                 f"prefill_bucket_rungs must be 1, 2, or 4 "
                 f"(got {self.prefill_bucket_rungs})")
-        if self.kv_cache_dtype not in ("bfloat16", "float32"):
+        from aigw_tpu.models import kvq
+        from aigw_tpu.tpuserve.attention import DECODE_BACKENDS
+
+        if self.kv_cache_dtype not in kvq.KV_DTYPES:
             raise ValueError(
-                f"kv_cache_dtype must be 'bfloat16' or 'float32' "
+                f"kv_cache_dtype must be one of {kvq.KV_DTYPES} "
                 f"(got {self.kv_cache_dtype!r})")
+        if self.decode_backend not in DECODE_BACKENDS:
+            raise ValueError(
+                f"decode_backend must be one of {DECODE_BACKENDS} "
+                f"(got {self.decode_backend!r})")
         if self.min_decode_steps_per_tick == 0:
             self.min_decode_steps_per_tick = max(
                 1, self.decode_steps_per_tick // 4)
@@ -529,6 +554,13 @@ class EngineStats:
     device_memory_frac_worst: float = 0.0
     ici_bytes_per_token: int = 0
     ici_bytes_total: int = 0
+    # quantized KV pages (ISSUE 13, models/kvq.py): bits per stored KV
+    # element (32/16 native, 8/4 quantized) and the all-layer HBM bytes
+    # one cached token costs INCLUDING its per-page scale share — the
+    # capacity-planning pair behind "half the KV bytes = twice the
+    # concurrent sessions per chip"
+    kv_quant_bits: int = 16
+    kv_bytes_per_token: float = 0.0
     # KV memory hierarchy (ISSUE 11): the host-RAM spill tier and the
     # cross-replica page fetch surface. Spills/revives/spill-evictions
     # mirror the HostKVTier counters (pages demoted to host RAM on
@@ -709,6 +741,9 @@ class Engine:
         self._kv_digest: tuple[str, ...] = ()
         self._kv_digest_next = 0.0
         self.stats = EngineStats()
+        self.stats.kv_quant_bits = kvq.quant_bits(cfg.kv_cache_dtype)
+        self.stats.kv_bytes_per_token = round(
+            self.kv_page_bytes / cfg.page_size, 3)
         # serving-phase latency histograms (queue_wait/prefill/ttft/…)
         # with trace-id exemplars — /metrics renders them, /state
         # summarizes p50/p95/p99 (obs/metrics.py ENGINE_HISTOGRAMS)
@@ -735,16 +770,21 @@ class Engine:
 
         # device state. With a mesh, weights/cache are laid out with
         # tensor/expert-parallel shardings and every jitted step runs SPMD
-        # (GSPMD inserts the collectives; SURVEY.md §2.9).
+        # (GSPMD inserts the collectives; SURVEY.md §2.9). The pool
+        # carries ONE extra page past the allocator's range — the fused
+        # decode kernel's dump page: its output pipeline must write
+        # every slot's append block somewhere, and inactive slots land
+        # here instead of whatever page their stale table row names
+        # (the XLA paths get the same guarantee from OOB-drop
+        # scatters). Never allocated, never referenced by a page
+        # table, excluded from capacity accounting.
         kv_shape = (
             model_cfg.n_layers,
             2,
-            cfg.num_pages * cfg.page_size,
+            (cfg.num_pages + 1) * cfg.page_size,
             model_cfg.n_kv_heads,
             model_cfg.head_dim,
         )
-        kv_dtype = (jnp.float32 if cfg.kv_cache_dtype == "float32"
-                    else jnp.bfloat16)
         if mesh is not None:
             from jax.sharding import NamedSharding
 
@@ -791,12 +831,11 @@ class Engine:
                 k: jax.device_put(v, NamedSharding(mesh, spec_for(k, v)))
                 for k, v in params.items()
             }
+            pool = kvq.make_pool(kv_shape, cfg.kv_cache_dtype)
             self.kv_cache = jax.device_put(
-                jnp.zeros(kv_shape, kv_dtype),
-                NamedSharding(mesh, kv_cache_spec()),
-            )
+                pool, kvq.pool_sharding_tree(pool, mesh, kv_cache_spec()))
         else:
-            self.kv_cache = jnp.zeros(kv_shape, kv_dtype)
+            self.kv_cache = kvq.make_pool(kv_shape, cfg.kv_cache_dtype)
         # Per-slot decode state lives ON DEVICE between ticks (uploaded
         # only when membership/sampling changes) — the decode hot loop
         # transfers just the sampled [K, B] tokens per round-trip.
@@ -875,30 +914,33 @@ class Engine:
 
         mc, ps = model_cfg, cfg.page_size
         K = cfg.decode_steps_per_tick
-        # decode attention impl resolution (the /state-exported half of
-        # the fallback matrix — tpuserve/attention.py documents the
-        # prefill half): the ragged paged-attention Pallas DECODE kernel
-        # is a single-chip program (its DMA pipeline addresses one local
-        # KV pool; there is no shard_map port), so on a mesh the decode
-        # hot loop keeps the GSPMD gather path — KV is sharded on heads,
-        # so gathers stay device-local and the step needs no extra
-        # collective beyond the layer all-reduces.
-        attn_impl = "pallas" if (cfg.pallas_attn and mesh is None) else ""
-        if cfg.pallas_attn and mesh is not None:
-            self.decode_attn_impl = "xla-gather"
-            self.decode_attn_reason = (
-                "pallas_attn requested but the engine runs on a mesh: "
-                "the Pallas decode kernel has no shard_map port; the "
-                "GSPMD gather path keeps KV reads local to each head "
-                "shard")
-            logger.warning("pallas_attn ignored: engine runs on a mesh "
-                           "(sharded gather path is used)")
-        elif attn_impl == "pallas":
-            self.decode_attn_impl = "pallas"
-            self.decode_attn_reason = "pallas_attn requested, single chip"
-        else:
-            self.decode_attn_impl = "xla-gather"
-            self.decode_attn_reason = "default (pallas_attn off)"
+        # decode attention rung (the /state-exported half of the
+        # fallback matrix — tpuserve/attention.resolve_decode_backend
+        # documents the full requested × mesh × TPU × kv-dtype table;
+        # resolve_attention_backend documents the prefill half)
+        from aigw_tpu.tpuserve.attention import resolve_decode_backend
+
+        self.decode_attn_impl, self.decode_attn_reason = (
+            resolve_decode_backend(cfg, model_cfg, mesh))
+        if (cfg.pallas_attn or cfg.decode_backend == "fused") \
+                and self.decode_attn_impl == "xla-gather":
+            logger.warning("decode backend fell back to xla-gather: %s",
+                           self.decode_attn_reason)
+        # decode_step's attn_impl argument + whether it needs the mesh
+        attn_impl = {
+            "xla-gather": "",
+            "pallas": "pallas",
+            "fused-xla": "fused",
+            "fused-xla-spmd": "fused",
+            "fused-pallas": "fused-pallas",
+        }[self.decode_attn_impl]
+        decode_mesh = mesh if self.decode_attn_impl == "fused-xla-spmd" \
+            else None
+        # the speculative verify step keeps the chained path at every
+        # rung: its multi-position kernel has no fused port, and the
+        # gather-dequant path serves quantized pools
+        self.verify_attn_impl = (
+            "pallas" if self.decode_attn_impl == "pallas" else "")
 
         model_prefill = self.fns.prefill
         model_decode = self.fns.decode_step
@@ -1010,7 +1052,7 @@ class Engine:
                     params, mc, st["tokens"], st["positions"], kv,
                     st["page_table"], ps, act,
                     lora=lora, adapter_idx=st["adapter_idx"],
-                    attn_impl=attn_impl,
+                    attn_impl=attn_impl, mesh=decode_mesh,
                 )
                 if lean:
                     logits = logits + st["bias"]
@@ -1068,6 +1110,7 @@ class Engine:
         self._spec_max = self._spec_rungs[-1]
         self._accept_prior = speculation.AcceptancePrior()
         model_verify = self.fns.verify_step
+        verify_impl = self.verify_attn_impl
         V = model_cfg.vocab_size
         H = cfg.max_seq_len
 
@@ -1109,7 +1152,7 @@ class Engine:
                     params, mc, inputs, st["positions"], kv,
                     st["page_table"], ps, act, st["limits"],
                     lora=lora, adapter_idx=st["adapter_idx"],
-                    attn_impl=attn_impl,
+                    attn_impl=verify_impl,
                 )  # [B, D1, V]
                 # counts are window-start values: exact at d=0, and later
                 # positions only accept on penalty-free slots where the
@@ -1203,9 +1246,21 @@ class Engine:
                 # local pool — honor the explicit override only where
                 # it can run
                 impl = "xla"
+            quant_kv = kvq.is_quantized_dtype(cfg.kv_cache_dtype)
+            if impl == "pallas" and quant_kv:
+                # narrowed matrix row: the ragged prefill kernel has no
+                # quantized-pool rung — the XLA windowed program
+                # dequantizes prefix pages at the read
+                impl = "xla"
             self._ragged_impl = "" if impl == "xla" else "pallas"
             if self._ragged_impl == "pallas":
                 self._ragged_reason = "Pallas kernel (single-chip TPU)"
+            elif quant_kv:
+                self._ragged_reason = (
+                    f"XLA windowed fallback: {cfg.kv_cache_dtype} KV "
+                    "pages — the ragged prefill kernel has no "
+                    "quantized-pool rung; the windowed program "
+                    "dequantizes prefix pages at the read")
             elif mesh is not None:
                 self._ragged_reason = (
                     "XLA windowed fallback: the Pallas ragged-prefill "
@@ -1433,10 +1488,16 @@ class Engine:
             ps = self.cfg.page_size
 
             def _cp(kv, src_page, dst_page):
-                rows = jax.lax.dynamic_slice_in_dim(
-                    kv, src_page * ps, ps, axis=2)
-                return jax.lax.dynamic_update_slice_in_dim(
-                    kv, rows, dst_page * ps, axis=2)
+                # tree_map: the quantized pool's scale leaf pages on
+                # the same slot axis, so a page copy moves its scale
+                # block with it
+                def cp_leaf(leaf):
+                    rows = jax.lax.dynamic_slice_in_dim(
+                        leaf, src_page * ps, ps, axis=2)
+                    return jax.lax.dynamic_update_slice_in_dim(
+                        leaf, rows, dst_page * ps, axis=2)
+
+                return jax.tree_util.tree_map(cp_leaf, kv)
 
             self._copy_page_fn = self.compile_tracker.register(
                 "copy_page", jax.jit(_cp, donate_argnums=(0,)))
@@ -1452,8 +1513,9 @@ class Engine:
             ps = self.cfg.page_size
 
             def _ex(kv, pg):
-                return jax.lax.dynamic_slice_in_dim(
-                    kv, pg * ps, ps, axis=2)
+                return jax.tree_util.tree_map(
+                    lambda leaf: jax.lax.dynamic_slice_in_dim(
+                        leaf, pg * ps, ps, axis=2), kv)
 
             self._export_page_fn = self.compile_tracker.register(
                 "page_export", jax.jit(_ex))
@@ -1471,7 +1533,8 @@ class Engine:
                 return rungs
             r *= 2
 
-    def _import_pages_dev(self, page_ids: list[int], rows_np) -> None:
+    def _import_pages_dev(self, page_ids: list[int],
+                          rows_np: list) -> None:
         """Scatter ``len(page_ids)`` host-side KV pages into the pool in
         ONE donated device call (a fori_loop of dynamic row updates).
         The page count pads to a pow2 rung by REPEATING the last
@@ -1487,8 +1550,10 @@ class Engine:
 
             def _im(kv, pages, rows):
                 def body(i, kv):
-                    return jax.lax.dynamic_update_slice_in_dim(
-                        kv, rows[i], pages[i] * ps, axis=2)
+                    return jax.tree_util.tree_map(
+                        lambda leaf, r: jax.lax.dynamic_update_slice_in_dim(
+                            leaf, r[i], pages[i] * ps, axis=2),
+                        kv, rows)
 
                 return jax.lax.fori_loop(0, pages.shape[0], body, kv)
 
@@ -1499,13 +1564,24 @@ class Engine:
             R *= 2
         pages = np.full((R,), page_ids[-1], np.int32)
         pages[:k] = page_ids
-        dtype = (jnp.float32 if self.cfg.kv_cache_dtype == "float32"
-                 else jnp.bfloat16)
-        stacked = np.concatenate(
-            [rows_np] + [rows_np[-1:]] * (R - k), axis=0)
+        # rows_np: a LIST of host-side pages — np [L, 2, ps, Hkv, D]
+        # arrays (native pools) or {"q","scale"} dicts (quantized) —
+        # stacked per leaf; the pow2 rung pads with idempotent
+        # rewrites of the last page
+        dt = self.cfg.kv_cache_dtype
+        host = list(rows_np) + [rows_np[-1]] * (R - k)
+        if kvq.is_quantized_dtype(dt):
+            stacked = {
+                "q": jnp.asarray(np.stack([h["q"] for h in host]),
+                                 kvq.compute_dtype(dt)),
+                "scale": jnp.asarray(
+                    np.stack([h["scale"] for h in host]), jnp.float32),
+            }
+        else:
+            stacked = jnp.asarray(np.stack(host),
+                                  kvq.compute_dtype(dt))
         self.kv_cache = self._import_page_fn(
-            self.kv_cache, jnp.asarray(pages),
-            jnp.asarray(stacked, dtype))
+            self.kv_cache, jnp.asarray(pages), stacked)
 
     # -- KV memory hierarchy: host spill tier + fleet fetch (ISSUE 11) ----
     def _spill_page(self, key: bytes, page: int) -> None:
@@ -1520,7 +1596,7 @@ class Engine:
         device rows are stable."""
         rows = self._export_page_dev(page)
         self._start_host_copy([rows])
-        self.host_tier.put(key, np.asarray(rows))
+        self.host_tier.put(key, kvq.page_to_host(rows))
 
     def _revive_chain(self, chain_keys: list) -> int:
         """Promote the longest spilled run extending the resident
@@ -1558,7 +1634,7 @@ class Engine:
                 tier.put(k, r)
             return 0
         page_ids = self.allocator.pages(seq_id)
-        self._import_pages_dev(page_ids, np.stack(rows))
+        self._import_pages_dev(page_ids, rows)
         self.prefix_cache.insert(take, page_ids)
         # park evictable: the admission that triggered the revive
         # re-probes and adopts under the normal refcount discipline
@@ -1625,6 +1701,16 @@ class Engine:
     def _do_fetch(self, keys: list) -> list:
         if self.prefix_cache is None:
             return []
+        # the wire rule for quantized pools: pages travel at NATIVE
+        # dtype + their scale blocks, bit-exactly (re-rounding through
+        # f32 would silently change what the importer serves); native
+        # pools keep the PR 8 f32 wire
+        quant = kvq.is_quantized_dtype(self.cfg.kv_cache_dtype)
+
+        def wire(rows):
+            host = kvq.page_to_host(rows)
+            return host if quant else np.asarray(host, np.float32)
+
         out: list = []
         resident: list = []
         for k in keys:
@@ -1634,7 +1720,8 @@ class Engine:
             elif self.host_tier is not None:
                 rows = self.host_tier.get(k)  # peek — the rung stays
                 if rows is not None:
-                    out.append((k, np.asarray(rows, np.float32)))
+                    out.append((k, rows if quant
+                                else np.asarray(rows, np.float32)))
         if resident:
             # pin for the duration of the device→host copy — the same
             # export discipline as migration (nothing may free/evict/
@@ -1644,8 +1731,7 @@ class Engine:
                 exported = [(k, self._export_page_dev(p))
                             for k, p in resident]
                 self._start_host_copy([e for _, e in exported])
-                out.extend((k, np.asarray(e, np.float32))
-                           for k, e in exported)
+                out.extend((k, wire(e)) for k, e in exported)
             finally:
                 self.allocator.end_export(pin)
         if out:
@@ -1671,11 +1757,15 @@ class Engine:
 
     @property
     def kv_page_bytes(self) -> int:
-        """HBM bytes of one KV page (the /state bytes-pinned signal)."""
+        """HBM bytes of one KV page (the /state bytes-pinned signal).
+        Quantized pools count the packed element bytes PLUS the page's
+        f32 scale block (one scale per token row × KV head per k/v)."""
         mc = self.model_cfg
-        itemsize = 4 if self.cfg.kv_cache_dtype == "float32" else 2
-        return (mc.n_layers * 2 * self.cfg.page_size * mc.n_kv_heads
-                * mc.head_dim * itemsize)
+        per_elt = kvq.bytes_per_kv_element(self.cfg.kv_cache_dtype)
+        scale = (4 if kvq.is_quantized_dtype(self.cfg.kv_cache_dtype)
+                 else 0)
+        return int(mc.n_layers * 2 * self.cfg.page_size * mc.n_kv_heads
+                   * (mc.head_dim * per_elt + scale))
 
     def mesh_axes(self) -> dict[str, int]:
         """Mesh axis name → size ({} off-mesh) — the /state topology
@@ -1833,9 +1923,9 @@ class Engine:
         # mid-traffic — round-trip page 0 through the host exactly as a
         # real migration does (idempotent rewrites of page 0's own
         # content; nothing is serving yet)
-        rows = np.asarray(self._export_page_dev(0))[None]
+        rows = kvq.page_to_host(self._export_page_dev(0))
         for r in self._import_rungs():
-            self._import_pages_dev([0] * r, np.repeat(rows, r, axis=0))
+            self._import_pages_dev([0] * r, [rows] * r)
         self.stats.warmup_ms = round(1e3 * (time.monotonic() - t0), 3)
         self.stats.warm_programs = self.compile_tracker.program_count()
 
@@ -1993,7 +2083,7 @@ class Engine:
         try:
             outs = [self._export_page_dev(p) for p in pages]
             self._start_host_copy(outs)  # per-page copies overlap
-            data = [np.asarray(o) for o in outs]
+            data = [kvq.page_to_host(o) for o in outs]
         finally:
             self.allocator.end_export(pin)
         ims = req.import_state or {}
@@ -2062,15 +2152,22 @@ class Engine:
         mc = self.model_cfg
         want = (mc.n_layers, 2, ps, mc.n_kv_heads, mc.head_dim)
         for rows in pages_data:
-            if tuple(rows.shape) != want:
+            if not kvq.page_matches_dtype(rows,
+                                          self.cfg.kv_cache_dtype):
                 raise MigrationError(
-                    f"page shape {tuple(rows.shape)} != expected {want} "
+                    "page dtype does not match this engine's "
+                    f"kv_cache_dtype={self.cfg.kv_cache_dtype!r} "
+                    "(quantized pages only scatter into a matching "
+                    "quantized pool)")
+            if not kvq.page_shape_ok(rows, want):
+                raise MigrationError(
+                    f"page shape != expected {want} "
                     "(mismatched model or page size)")
         keys = page_chain_hashes(tokens, ps)[start:start + k]
         seq_id = next(self._seq_ids)
         self.allocator.allocate_extra(seq_id, k)  # OutOfPages → caller
         page_ids = self.allocator.pages(seq_id)
-        self._import_pages_dev(page_ids, np.stack(pages_data))
+        self._import_pages_dev(page_ids, pages_data)
         self.prefix_cache.insert(keys, page_ids)
         self._purge_spilled(keys)
         # release: registered pages park evictable (adopted by the

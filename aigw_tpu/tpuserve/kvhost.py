@@ -40,10 +40,13 @@ from typing import Any
 
 
 def _size(rows: Any) -> int:
-    """Byte size of a stored page: np arrays expose nbytes; plain
-    byte blobs (the property tests' model device) their length."""
-    n = getattr(rows, "nbytes", None)
-    return int(n) if n is not None else len(rows)
+    """Byte size of a stored page: np arrays expose nbytes; quantized
+    {"q","scale"} pages charge their packed device size (models/kvq);
+    plain byte blobs (the property tests' model device) their
+    length."""
+    from aigw_tpu.models import kvq
+
+    return kvq.page_nbytes(rows)
 
 
 class HostKVTier:

@@ -71,6 +71,49 @@ from aigw_tpu.tpuserve.tokenizer import (
 
 logger = logging.getLogger(__name__)
 
+
+def encode_wire_page(d) -> dict:
+    """Host-side KV page → JSON-able wire dict. Native pages keep the
+    PR 8 f32 wire ({b64, shape}); quantized {"q","scale"} pages (ISSUE
+    13) add ``dtype`` + ``scale_b64``/``scale_shape`` and travel
+    BIT-exactly at native dtype + scales — no re-rounding through f32.
+    (int4 serializes one element per byte on the wire — the JSON
+    transport is not the packed HBM layout.)"""
+    import base64
+
+    if isinstance(d, dict):
+        q = np.ascontiguousarray(d["q"])
+        s = np.ascontiguousarray(d["scale"], dtype=np.float32)
+        return {
+            "b64": base64.b64encode(q.tobytes()).decode(),
+            "shape": list(q.shape),
+            "dtype": str(q.dtype),
+            "scale_b64": base64.b64encode(s.tobytes()).decode(),
+            "scale_shape": list(s.shape),
+        }
+    arr = np.asarray(d, np.float32)
+    return {"b64": base64.b64encode(arr.tobytes()).decode(),
+            "shape": list(arr.shape)}
+
+
+def decode_wire_page(p: dict):
+    """Inverse of :func:`encode_wire_page` (raises KeyError/ValueError
+    on malformed input — callers map that to 400)."""
+    import base64
+
+    dt = p.get("dtype")
+    if dt:
+        import ml_dtypes
+
+        np_dt = {"int8": np.int8, "int4": ml_dtypes.int4}[str(dt)]
+        q = np.frombuffer(base64.b64decode(p["b64"]),
+                          np_dt).reshape(p["shape"])
+        scale = np.frombuffer(base64.b64decode(p["scale_b64"]),
+                              np.float32).reshape(p["scale_shape"])
+        return {"q": q, "scale": scale}
+    return np.frombuffer(base64.b64decode(p["b64"]),
+                         np.float32).reshape(p["shape"])
+
 #: tenant key header (set by clients or derived/relayed by the gateway
 #: from the model's adapter suffix) — feeds the engine's fairness guard
 #: and joins the gateway's per-tenant cost/quota accounting
@@ -1653,6 +1696,15 @@ class TPUServeServer:
                 "device_memory_frac": s.device_memory_frac,
                 "kv_pool_bytes": s.kv_pool_bytes,
                 "kv_bytes_in_use": s.kv_bytes_in_use,
+                # quantized KV pages (ISSUE 13): bits per stored
+                # element, bytes one cached token costs across layers
+                # (scales included), and the configured pool dtype —
+                # the capacity math behind int8 ≈ 0.52x / int4 ≈ 0.27x
+                # of the bf16 pool at head_dim 128
+                "kv_quant_bits": s.kv_quant_bits,
+                "kv_bytes_per_token": s.kv_bytes_per_token,
+                "kv_cache_dtype": self.engine.cfg.kv_cache_dtype,
+                "decode_backend": self.engine.cfg.decode_backend,
                 # mesh serving (ISSUE 10): real per-device signals —
                 # the mesh topology (axis → size; {} off-mesh), EVERY
                 # local device's memory/KV/param share (not just
@@ -1742,8 +1794,15 @@ class TPUServeServer:
         )
 
     async def _metrics(self, _request: web.Request) -> web.Response:
+        # info-style gauge for the RESOLVED decode rung (the fallback
+        # matrix outcome is a string; dashboards select on the label)
+        impl_info = (
+            "# TYPE tpuserve_decode_attn_impl gauge\n"
+            f'tpuserve_decode_attn_impl{{impl='
+            f'"{self.engine.decode_attn_impl}"}} 1\n').encode()
         body = (self.metrics.export()
                 + render_engine_gauges(self.engine.stats)
+                + impl_info
                 + render_device_gauges(self.engine.device_stats)
                 + self.engine.phases.render())
         return web.Response(body=body, content_type="text/plain")
@@ -1785,13 +1844,7 @@ class TPUServeServer:
             return web.Response(
                 status=409, body=oai.error_body(str(e)),
                 content_type="application/json")
-        pages = [
-            {"key": k.hex(),
-             "b64": base64.b64encode(
-                 np.asarray(d, np.float32).tobytes()).decode(),
-             "shape": list(d.shape)}
-            for k, d in out
-        ]
+        pages = [dict(encode_wire_page(d), key=k.hex()) for k, d in out]
         return web.json_response({
             "model": self.model_name,
             "page_size": self.engine.cfg.page_size,
@@ -1869,9 +1922,7 @@ class TPUServeServer:
         out: dict = {}
         try:
             for p in data.get("pages") or ():
-                out[str(p["key"])] = (
-                    np.frombuffer(base64.b64decode(p["b64"]), np.float32)
-                    .reshape(p["shape"]))
+                out[str(p["key"])] = decode_wire_page(p)
         except (KeyError, TypeError, ValueError):
             return {}
         return out
@@ -1911,12 +1962,7 @@ class TPUServeServer:
                 content_type="application/json")
         blob = out["blob"]
         blob["meta"] = meta
-        pages = [
-            {"b64": base64.b64encode(
-                np.asarray(d, np.float32).tobytes()).decode(),
-             "shape": list(d.shape)}
-            for d in out["data"]
-        ]
+        pages = [encode_wire_page(d) for d in out["data"]]
         return web.json_response({"blob": blob, "pages": pages})
 
     async def _migrate_import(
@@ -1940,11 +1986,8 @@ class TPUServeServer:
         blob = body.get("blob") or {}
         try:
             tokens = [int(t) for t in blob["tokens"]]
-            pages = [
-                np.frombuffer(base64.b64decode(p["b64"]), np.float32)
-                .reshape(p["shape"])
-                for p in (body.get("pages") or ())
-            ]
+            pages = [decode_wire_page(p)
+                     for p in (body.get("pages") or ())]
         except (KeyError, TypeError, ValueError) as e:
             return web.Response(
                 status=400,
@@ -2180,6 +2223,8 @@ async def run_tpuserve(
     spec_adaptive: bool = True,
     pallas_attn: bool = False,
     attention_backend: str = "xla-bucketed",
+    decode_backend: str = "auto",
+    kv_cache_dtype: str = "bfloat16",
     ragged_chunk_tokens: int = 256,
     logprobs_topk: int = 0,
     adaptive_decode_window: bool = True,
@@ -2209,6 +2254,8 @@ async def run_tpuserve(
             spec_adaptive=spec_adaptive,
             pallas_attn=pallas_attn,
             attention_backend=attention_backend,
+            decode_backend=decode_backend,
+            kv_cache_dtype=kv_cache_dtype,
             ragged_chunk_tokens=ragged_chunk_tokens,
             logprobs_topk=logprobs_topk,
             adaptive_decode_window=adaptive_decode_window,

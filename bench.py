@@ -1945,6 +1945,173 @@ def _fleet_fields_from_states(st0s: dict, st1s: dict, slo_ms: float,
     }
 
 
+# -- decode_fused leg: fused decode step + quantized KV pages (ISSUE 13) --
+
+# Leg model with head_dim 64 — the smallest serving-shaped head at
+# which the int8 capacity claim holds ((64 + 4) / 128 = 0.53; TINY's
+# D=16 pays 0.625 because the f32 scale is amortized over too few
+# elements and would falsify a true claim).
+_FUSED_CFG = llama.LlamaConfig(
+    vocab_size=2048, dim=256, n_layers=4, n_heads=4, n_kv_heads=2,
+    ffn_dim=512, max_seq_len=1024, rope_theta=10000.0,
+)
+_FUSED_PAGE = 32
+
+
+async def _drive_decode_one(s, url: str, model: str, content: str,
+                            gen_tokens: int) -> tuple:
+    """One greedy sequential streaming chat; returns
+    (duration_s, tokens, joined_text) — the text is the
+    stream-identity probe."""
+    payload = {
+        "model": model,
+        "messages": [{"role": "user", "content": content}],
+        "max_tokens": gen_tokens,
+        "temperature": 0.0,
+        "stream": True,
+        "stream_options": {"include_usage": True},
+    }
+    t0 = time.perf_counter()
+    usage = None
+    parts: list[str] = []
+    async with s.post(url + "/v1/chat/completions", json=payload) as resp:
+        assert resp.status == 200, resp.status
+        while True:
+            line = await resp.content.readline()
+            if not line:
+                break
+            line = line.strip()
+            if not line.startswith(b"data: "):
+                continue
+            data = line[6:]
+            if data == b"[DONE]":
+                break
+            ev = json.loads(data)
+            if ev.get("usage"):
+                usage = ev["usage"]
+            ch = ev.get("choices") or []
+            delta = (ch[0].get("delta") or {}) if ch else {}
+            if delta.get("content"):
+                parts.append(delta["content"])
+    dur = time.perf_counter() - t0
+    ntok = (usage or {}).get("completion_tokens") or len(parts)
+    return dur, ntok, "".join(parts)
+
+
+def decode_fused_numbers(reps: int = 3, requests_per_rep: int = 4,
+                         gen_tokens: int = 64) -> dict:
+    """The ``--ab decode_fused`` leg (ISSUE 13): decode-heavy greedy
+    streaming chats against THREE tpuserve children on identical
+    seeded traffic, requests interleaved so host drift cancels:
+
+    - **fused vs chained** (both f32 KV): the same prompts must stream
+      IDENTICAL text (the f32-rig equivalence, measured over the real
+      HTTP surface), zero hot compiles on either child, and the tok/s
+      ratio is reported. On this CPU backend the fused child runs the
+      XLA page-walk reference, so the ratio is bookkeeping parity —
+      the kernel's HBM win needs the TPU capture (tools/tpu_capture).
+    - **int8-KV fused vs native**: capacity — kv_bytes_per_token and
+      the pool-bytes ratio from /state (claim: ≤ 0.55x) — and quality,
+      as greedy-token agreement against the native child's streams on
+      the same prompts (the PR 9 int4-weight smoke's role, measured
+      end-to-end)."""
+    import aiohttp
+
+    model_name = "bench-fused-tiny"
+    k = int(os.environ.get("AIGW_BENCH_CPU_K", "4"))
+    engine_common = {"min_prefill_bucket": 32, "num_pages": 96,
+                     "max_queued_requests": 64,
+                     "warm_decode_buckets": 3}
+    children = []
+
+    def start(backend: str, kv_dtype: str, pdtype: str):
+        url, stop = _start_tpuserve_subproc(
+            model_name, _FUSED_CFG, "", batch=4, k_steps=k,
+            engine=dict(engine_common, decode_backend=backend,
+                        kv_cache_dtype=kv_dtype),
+            page=_FUSED_PAGE, param_dtype=pdtype)
+        children.append(stop)
+        return url
+
+    url_fu = start("fused", "float32", "float32")
+    url_ch = start("auto", "float32", "float32")
+    url_q8 = start("fused", "int8", "float32")
+
+    prompts = [f"decode fused probe {i} " + "ab" * 24
+               for i in range(requests_per_rep)]
+
+    async def run() -> dict:
+        await _wait_health(url_fu, 1200)
+        await _wait_health(url_ch, 1200)
+        await _wait_health(url_q8, 1200)
+        timeout = aiohttp.ClientTimeout(total=1200)
+        async with aiohttp.ClientSession(timeout=timeout) as s:
+            # off the clock: compile whatever the warm pass missed
+            for url in (url_fu, url_ch, url_q8):
+                await _drive_decode_one(s, url, model_name,
+                                        prompts[0], gen_tokens)
+            st_fu0 = await _get_state(s, url_fu)
+            st_ch0 = await _get_state(s, url_ch)
+            fu, ch, q8 = [], [], []
+            for _rep in range(reps):
+                for p in prompts:
+                    fu.append(await _drive_decode_one(
+                        s, url_fu, model_name, p, gen_tokens))
+                    ch.append(await _drive_decode_one(
+                        s, url_ch, model_name, p, gen_tokens))
+                    q8.append(await _drive_decode_one(
+                        s, url_q8, model_name, p, gen_tokens))
+            st_fu1 = await _get_state(s, url_fu)
+            st_ch1 = await _get_state(s, url_ch)
+            st_q8 = await _get_state(s, url_q8)
+
+        def tps(runs):
+            return sum(n for _, n, _t in runs) / sum(
+                d for d, _n, _t in runs)
+
+        identical = all(a[2] == b[2] for a, b in zip(fu, ch))
+
+        def agree(a: str, b: str) -> float:
+            n = max(len(a), len(b), 1)
+            same = sum(1 for x, y in zip(a, b) if x == y)
+            return same / n
+
+        q8_agree = (sum(agree(a[2], b[2]) for a, b in zip(q8, ch))
+                    / max(len(q8), 1))
+        ratio = tps(fu) / tps(ch) if tps(ch) else 0.0
+        return {
+            "decode_fused_tps": round(tps(fu), 1),
+            "decode_chained_tps": round(tps(ch), 1),
+            "decode_fused_ratio": round(ratio, 4),
+            "decode_fused_identical_streams": identical,
+            "decode_fused_impl": st_fu1.get("decode_attn_impl", ""),
+            "decode_fused_hot_compiles": (
+                st_fu1.get("xla_compiles", 0)
+                - st_fu0.get("xla_compiles", 0)),
+            "decode_chained_hot_compiles": (
+                st_ch1.get("xla_compiles", 0)
+                - st_ch0.get("xla_compiles", 0)),
+            "kv_int8_bytes_per_token": st_q8.get(
+                "kv_bytes_per_token", 0),
+            "kv_native_bytes_per_token": st_ch1.get(
+                "kv_bytes_per_token", 0),
+            # native child runs f32 KV (the rig); quote the claim
+            # against the SERVING dtype: bf16 = f32 / 2
+            "kv_int8_bytes_ratio_vs_bf16": round(
+                st_q8.get("kv_bytes_per_token", 0)
+                / max(st_ch1.get("kv_bytes_per_token", 1) / 2.0, 1e-9),
+                4),
+            "kv_int8_greedy_agreement": round(q8_agree, 4),
+            "decode_fused_ab_reps": reps * requests_per_rep,
+        }
+
+    try:
+        return asyncio.run(run())
+    finally:
+        for stop in children:
+            stop()
+
+
 def fleet_obs_numbers(reps: int = 3, arrivals: int = 20) -> dict:
     """The ``--ab fleet_obs`` leg (ISSUE 12): observability must be
     ~free. The SAME seeded open-loop trace through two gateway
@@ -2693,6 +2860,11 @@ def run_cpu_ratio() -> dict:
     except Exception as e:
         print(f"fleet_obs leg failed: {type(e).__name__}: {e}",
               file=sys.stderr)
+    try:
+        res.update(decode_fused_numbers())
+    except Exception as e:
+        print(f"decode_fused leg failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
     return res
 
 
@@ -2855,12 +3027,24 @@ def main() -> None:
                 "scraper running, vs everything off; throughput ratio "
                 "≥ 0.95 and zero hot XLA compiles are the claim (CPU "
                 "backend)")
+        elif target == "decode_fused":
+            result = decode_fused_numbers()
+            result["metric"] = (
+                "decode_fused interleaved A/B — fused decode step + "
+                "quantized KV pages (ISSUE 13): the same greedy "
+                "decode-heavy chats against fused-vs-chained f32 "
+                "children (streams must be identical; tok/s ratio is "
+                "bookkeeping parity on the CPU backend — the kernel's "
+                "HBM win needs the on-chip capture) and an int8-KV "
+                "fused child (bytes/token ≤ 0.55x bf16 and greedy "
+                "agreement vs the native child are the capacity/"
+                "quality signals)")
         else:
             print(json.dumps({"error": f"unknown --ab target {target!r}; "
                               "supported: prefix_cache, spec_decode, "
                               "ragged_prefill, lora, disagg, "
                               "slo_routing, structured, mesh, "
-                              "kv_tier, fleet_obs"}))
+                              "kv_tier, fleet_obs, decode_fused"}))
             return
         print(json.dumps(result))
         return

@@ -238,6 +238,7 @@ class TestEngineAdapterServing:
         finally:
             eng.stop()
 
+    @pytest.mark.slow
     def test_mixed_adapter_plain_penalized_batch(self):
         """One concurrent batch mixing two adapters, a plain slot, and
         a penalized slot: every member matches its solo run."""

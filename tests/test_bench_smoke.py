@@ -240,6 +240,7 @@ def test_bench_openloop_trace_and_goodput_helpers():
 
 
 @pytest.mark.bench_smoke
+@pytest.mark.slow
 def test_bench_openloop_gateway_smoke():
     """Open-loop smoke (ISSUE 8 satellite): ~50 Poisson arrivals
     through a real gateway (picker over one tpuserve child) — the

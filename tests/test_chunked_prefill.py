@@ -253,6 +253,7 @@ class TestPrefixCacheEquivalence:
             on.stop()
         return ref, got, stats
 
+    @pytest.mark.slow
     def test_full_hit_cow_resume_byte_identical(self):
         # 96 % 16 == 0: the repeat is a FULL aligned hit — all 6 pages
         # adopted, final page copy-on-write'd, single-token resume
@@ -266,6 +267,7 @@ class TestPrefixCacheEquivalence:
         # the resume must not have re-run the prompt prefill
         assert stats.prefix_cache_hit_rate == 0.5  # 1 miss, 1 full hit
 
+    @pytest.mark.slow
     def test_partial_hit_resumes_chunked_at_offset_byte_identical(self):
         # shared 64-token head (4 pages at ps=16); chunk=24 puts every
         # resumed chunk boundary at 64+24k — never a page multiple

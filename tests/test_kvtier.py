@@ -140,6 +140,7 @@ class TestSpillRevive:
     """f32 rig: eviction spills, a re-ask revives, streams stay
     byte-identical and the prompt is NOT recomputed."""
 
+    @pytest.mark.slow
     def test_spill_revive_byte_identical_no_recompute(self):
         eng = _f32_engine()
         try:

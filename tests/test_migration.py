@@ -145,6 +145,7 @@ def rig():
             e.stop()
 
 
+@pytest.mark.slow
 def test_migrated_stream_byte_identical_speculating(rig):
     """Greedy bias-pinned stream (the speculating fast path: n-gram
     drafts accept) — solo vs migrated must match byte for byte, and the
@@ -166,6 +167,7 @@ def test_migrated_stream_byte_identical_speculating(rig):
     assert len(out["blob"]["chain"]) == len(out["data"])
 
 
+@pytest.mark.slow
 def test_migrated_stream_byte_identical_sampled_penalized(rig):
     """Seeded sampling + frequency penalty (spec-ineligible slot → the
     plain decode program): the continuation must restore the sampling
@@ -181,6 +183,7 @@ def test_migrated_stream_byte_identical_sampled_penalized(rig):
     assert toks_a + toks_b == solo
 
 
+@pytest.mark.slow
 def test_migrated_lora_slot():
     """A LoRA-adapter slot migrates: the continuation re-acquires the
     adapter row on the importing engine and the stream stays
@@ -228,6 +231,7 @@ def test_import_rejects_malformed_pages(rig):
         eng_b.migrate_import([1] * 17, [good, good])
 
 
+@pytest.mark.slow
 def test_migration_zero_hot_compiles():
     """The tripwire (acceptance criterion): after warmup() plus one
     same-geometry warm pass, a full export→import→resume adds ZERO XLA
@@ -360,6 +364,7 @@ async def _stream_chat(s, url, payload):
     return pieces, saw_done, finish, rid
 
 
+@pytest.mark.slow
 def test_http_migrate_endpoints_splice_identical():
     """The wire flow: a stream cut via POST /migrate/export ends WITHOUT
     terminal frames; POST /migrate/import streams the continuation under

@@ -366,6 +366,205 @@ class TestProductionShapes:
         # tie-prone under argmax and not the right comparison here
 
 
+# -- fused decode kernel (ISSUE 13) --------------------------------------
+
+class TestFusedDecodeKernel:
+    """Interpret-mode parity for the FUSED decode step (RoPE + KV
+    append + paged attention in one kernel, optionally over int8/int4
+    pages with per-page scale blocks) vs the scatter-then-walk XLA
+    reference that serves off-TPU — at llama-3-8B attention geometry
+    (H=32, Hkv=8, D=128, 128-token pages) with page-misaligned append
+    offsets, page-aligned fresh-page appends, inactive slots, and both
+    quantized dtypes."""
+
+    THETA = 10000.0
+
+    def _case(self, B, H, Hkv, D, ps, n_pages, P, positions, active,
+              qdt=None, seed=0):
+        from aigw_tpu.models import kvq, llama
+        from aigw_tpu.ops.pallas.decode_fused import (
+            fused_paged_decode,
+            paged_decode_walk,
+        )
+
+        key = jax.random.PRNGKey(seed)
+        kq, kk, kv, kp, k1, k2 = jax.random.split(key, 6)
+        q = jax.random.normal(kq, (B, H, D), jnp.float32).astype(
+            jnp.bfloat16)
+        kn = jax.random.normal(k1, (B, Hkv, D), jnp.float32).astype(
+            jnp.bfloat16)
+        vn = jax.random.normal(k2, (B, Hkv, D), jnp.float32).astype(
+            jnp.bfloat16)
+        kf = jax.random.normal(kk, (n_pages * ps, Hkv, D), jnp.float32)
+        vf = jax.random.normal(kv, (n_pages * ps, Hkv, D), jnp.float32)
+        if qdt:
+            k_pool, k_s = kvq.quantize_rows(kf, qdt)
+            v_pool, v_s = kvq.quantize_rows(vf, qdt)
+        else:
+            k_pool, k_s = kf.astype(jnp.bfloat16), None
+            v_pool, v_s = vf.astype(jnp.bfloat16), None
+        # non-contiguous page tables; the LAST pool page stays free —
+        # the engine-reserved dump page inactive appends land in
+        perm = jax.random.permutation(kp, n_pages - 1)[: B * P]
+        pt = perm.reshape(B, P).astype(jnp.int32)
+        positions = jnp.asarray(positions, jnp.int32)
+        active = jnp.asarray(active)
+
+        outs = fused_paged_decode(
+            q, kn, vn, k_pool, v_pool, pt, positions, active,
+            k_scale=k_s, v_scale=v_s, rope_theta=self.THETA,
+            page_size=ps, interpret=True)
+
+        # reference: rope at XLA level, quantize+scatter, then walk
+        pos2 = positions[:, None]
+        qr = llama.rope(q.reshape(B, 1, H, D).astype(jnp.float32),
+                        pos2, self.THETA)[:, 0].astype(jnp.bfloat16)
+        knr = llama.rope(kn.reshape(B, 1, Hkv, D).astype(jnp.float32),
+                         pos2, self.THETA)[:, 0].astype(jnp.bfloat16)
+        slot = (jnp.take_along_axis(pt, pos2 // ps, axis=1) * ps
+                + pos2 % ps)[:, 0]
+        lens = jnp.where(active, positions + 1, 0)
+        if qdt:
+            qk, sk = kvq.quantize_rows(knr, qdt)
+            qv, sv = kvq.quantize_rows(vn, qdt)
+            kp2, vp2, ks2, vs2 = k_pool, v_pool, k_s, v_s
+            for b in range(B):
+                if not bool(active[b]):
+                    continue
+                kp2 = kp2.at[slot[b]].set(qk[b])
+                vp2 = vp2.at[slot[b]].set(qv[b])
+                ks2 = ks2.at[slot[b]].set(sk[b])
+                vs2 = vs2.at[slot[b]].set(sv[b])
+            want = paged_decode_walk(qr, kp2, vp2, pt, lens,
+                                     page_size=ps, k_scale=ks2,
+                                     v_scale=vs2)
+        else:
+            kp2, vp2 = k_pool, v_pool
+            for b in range(B):
+                if not bool(active[b]):
+                    continue
+                kp2 = kp2.at[slot[b]].set(knr[b])
+                vp2 = vp2.at[slot[b]].set(vn[b])
+            want = paged_decode_walk(qr, kp2, vp2, pt, lens,
+                                     page_size=ps)
+        return outs, want, (pt, slot, positions, active, k_pool,
+                            knr, vn)
+
+    def _assert_active_close(self, outs, want, active, rtol=5e-2):
+        got = np.asarray(outs[0], jnp.float32)
+        ref = np.asarray(want, jnp.float32)
+        for b in range(got.shape[0]):
+            if bool(active[b]):
+                np.testing.assert_allclose(got[b], ref[b],
+                                           rtol=rtol, atol=rtol)
+
+    def test_production_shape_native(self):
+        # misaligned mid-page append (385 % 128 = 1) and a page-
+        # boundary-straddling length, llama-3-8B heads
+        outs, want, aux = self._case(
+            B=2, H=32, Hkv=8, D=128, ps=128, n_pages=9, P=4,
+            positions=[385, 129], active=[True, True])
+        self._assert_active_close(outs, want, [True, True])
+        # the appended row must be the roped new K, bit-for-bit the
+        # XLA recipe (rope → compute-dtype round)
+        pt, slot, positions, active, k_pool, knr, vn = aux
+        np.testing.assert_array_equal(
+            np.asarray(outs[1][slot[0]]), np.asarray(knr[0]))
+        np.testing.assert_array_equal(
+            np.asarray(outs[2][slot[1]]), np.asarray(vn[1]))
+
+    @pytest.mark.parametrize("qdt", ["int8", "int4"])
+    def test_production_shape_quantized(self, qdt):
+        from aigw_tpu.models import kvq
+
+        outs, want, aux = self._case(
+            B=2, H=32, Hkv=8, D=128, ps=128, n_pages=9, P=4,
+            positions=[385, 129], active=[True, True], qdt=qdt)
+        self._assert_active_close(outs, want, [True, True])
+        # appended int rows + scales follow the kvq recipe (scales may
+        # differ by an f32 ulp from FMA contraction in the in-kernel
+        # rope — assert tight closeness, not bit equality)
+        pt, slot, positions, active, k_pool, knr, vn = aux
+        qk, sk = kvq.quantize_rows(knr, qdt)
+        got_q = np.asarray(outs[1][slot[0]], np.int32)
+        ref_q = np.asarray(qk[0], np.int32)
+        assert np.abs(got_q - ref_q).max() <= 1
+        np.testing.assert_allclose(np.asarray(outs[3][slot[0]]),
+                                   np.asarray(sk[0]), rtol=1e-5)
+
+    def test_fresh_page_pos0_and_inactive(self):
+        """Page-aligned appends start a fresh page; pos=0 attends only
+        itself; inactive slots leave every table-referenced page
+        untouched (their write lands in the dump page)."""
+        B, H, Hkv, D, ps, n_pages, P = 3, 4, 2, 128, 16, 16, 4
+        outs, want, aux = self._case(
+            B=B, H=H, Hkv=Hkv, D=D, ps=ps, n_pages=n_pages, P=P,
+            positions=[16, 0, 33], active=[True, True, False])
+        self._assert_active_close(outs, want, [True, True, False])
+        pt, slot, positions, active, k_pool, knr, vn = aux
+        # inactive slot 2: its pages (and every non-append page) are
+        # bit-identical to the input pool; only the dump page may churn
+        touched = {int(pt[0, 1]), int(pt[1, 0]), n_pages - 1}
+        mask = np.ones(n_pages * ps, bool)
+        for pg in touched:
+            mask[pg * ps:(pg + 1) * ps] = False
+        np.testing.assert_array_equal(np.asarray(outs[1])[mask],
+                                      np.asarray(k_pool)[mask])
+        # pos=0: the fresh page's row 0 is the appended K row
+        np.testing.assert_array_equal(
+            np.asarray(outs[1][int(pt[1, 0]) * ps]),
+            np.asarray(knr[1]))
+
+
+@pytest.mark.slow
+def test_engine_fused_pallas_interpret_matches_chained():
+    """End-to-end: the engine forced onto the fused Pallas kernel
+    (interpret mode via AIGW_DECODE_FUSED_IMPL) generates the same
+    greedy stream as the chained gather engine."""
+    import os
+    import threading
+
+    from aigw_tpu.models import llama
+    from aigw_tpu.tpuserve.engine import Engine, EngineConfig, GenRequest
+    from aigw_tpu.tpuserve.sampling import SamplingParams
+
+    def gen(impl_env: str):
+        cfg = EngineConfig(max_batch_size=2, max_seq_len=128,
+                           page_size=16, min_prefill_bucket=16,
+                           decode_steps_per_tick=4,
+                           decode_backend="fused" if impl_env else "auto")
+        params = llama.init_params(jax.random.PRNGKey(0), llama.TINY)
+        if impl_env:
+            os.environ["AIGW_DECODE_FUSED_IMPL"] = impl_env
+        try:
+            eng = Engine(params, llama.TINY, cfg, eos_token_ids=(257,))
+        finally:
+            os.environ.pop("AIGW_DECODE_FUSED_IMPL", None)
+        if impl_env:
+            assert eng.decode_attn_impl == "fused-pallas"
+        eng.start()
+        try:
+            done = threading.Event()
+            toks: list[int] = []
+
+            def emit(tok, fin):
+                if tok >= 0:
+                    toks.append(tok)
+                if fin is not None:
+                    done.set()
+
+            eng.submit(GenRequest(prompt=[5, 3, 8, 1], max_tokens=6,
+                                  sampling=SamplingParams(temperature=0.0),
+                                  emit=emit))
+            assert done.wait(timeout=300)
+            assert eng.healthy, eng.last_error
+            return toks
+        finally:
+            eng.stop()
+
+    assert gen("pallas") == gen("")
+
+
 # -- ragged prefill kernel (ISSUE 6) -------------------------------------
 
 def xla_reference_ragged(q, k_pool, v_pool, page_table, cu, starts,

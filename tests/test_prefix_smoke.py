@@ -369,6 +369,7 @@ def test_state_and_metrics_export_adapter_gauges(smoke_url):
         assert gauge in text, f"/metrics lost {gauge}"
 
 
+@pytest.mark.slow
 def test_adapter_mix_changes_zero_hot_compiles():
     """Compile-on-hot-path tripwire for the adapter subsystem (ISSUE
     7): after warmup() (which pre-compiles the hot-load row scatters
@@ -547,6 +548,13 @@ MEMORY_STATE_FIELDS = (
     "device_memory_frac",
     "kv_pool_bytes",
     "kv_bytes_in_use",
+    # quantized KV pages + fused decode (ISSUE 13)
+    "kv_quant_bits",
+    "kv_bytes_per_token",
+    "kv_cache_dtype",
+    "decode_backend",
+    "decode_attn_impl",
+    "decode_attn_reason",
 )
 
 MEMORY_GAUGES = (
@@ -555,6 +563,10 @@ MEMORY_GAUGES = (
     "tpuserve_device_memory_frac",
     "tpuserve_kv_pool_bytes",
     "tpuserve_kv_bytes_in_use",
+    "tpuserve_kv_quant_bits",
+    "tpuserve_kv_bytes_per_token",
+    # the resolved decode rung rides /metrics as a labeled info gauge
+    'tpuserve_decode_attn_impl{impl="',
 )
 
 
@@ -594,6 +606,15 @@ def test_state_and_metrics_export_memory_signals(smoke_url):
         assert field in state, f"/state lost {field}"
     assert state["kv_pool_bytes"] > 0
     assert 0.0 <= state["device_memory_frac"] <= 1.0
+    # ISSUE 13 capacity fields: native bf16 default on the smoke
+    # server — 16 bits/element, bytes/token = L*2*Hkv*D*2
+    assert state["kv_quant_bits"] == 16
+    assert state["kv_bytes_per_token"] > 0
+    assert state["kv_cache_dtype"] == "bfloat16"
+    assert state["decode_backend"] == "auto"
+    assert state["decode_attn_impl"] in (
+        "xla-gather", "pallas", "fused-xla", "fused-pallas",
+        "fused-xla-spmd")
     text = asyncio.run(_get(smoke_url, "/metrics")).decode()
     for gauge in MEMORY_GAUGES:
         assert gauge in text, f"/metrics lost {gauge}"
@@ -717,6 +738,7 @@ def test_state_and_metrics_export_kvtier_gauges(smoke_url):
         assert gauge in text, f"/metrics lost {gauge}"
 
 
+@pytest.mark.slow
 def test_kv_tier_churn_zero_hot_compiles():
     """Compile-on-hot-path tripwire for the KV memory hierarchy (ISSUE
     11): after warmup() compiled the page export/import programs and

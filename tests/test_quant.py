@@ -511,6 +511,7 @@ class TestInt4OutputQuality:
             cur, pos = int(rows[-1][0].argmax()), pos + 1
         return rows
 
+    @pytest.mark.slow
     def test_int4_greedy_rollout_and_topk_overlap(self):
         rows = self._rollout()
         agree = np.mean([a.argmax() == b.argmax() for a, b in rows])

@@ -132,6 +132,7 @@ def test_mixed_burst_byte_identical_and_cheaper_padding():
     assert fracs["pallas-ragged"] < fracs["xla-bucketed"]
 
 
+@pytest.mark.slow
 def test_prefix_hits_partial_and_full_byte_identical():
     """One engine pair covers both cache-resume shapes: a partial hit
     (shared ≥1-page prefix, ragged resumes as a packed segment with a
@@ -155,6 +156,7 @@ def test_prefix_hits_partial_and_full_byte_identical():
     assert xla == ragged
 
 
+@pytest.mark.slow
 def test_speculating_slots_byte_identical():
     """Speculative decoding rides the ragged-prefilled KV: repetitive
     prompts draft+accept through the verify ladder on both backends

@@ -93,6 +93,7 @@ def test_mixed_concurrent_soak(tpuserve_url):
     asyncio.run(main())
 
 
+@pytest.mark.slow
 def test_hot_reload_under_load(tpuserve_url):
     """Config hot-swap while traffic is in flight: no dropped requests,
     new config takes effect."""

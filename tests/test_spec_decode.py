@@ -128,6 +128,7 @@ class TestSpecEquivalence:
         b, fb = _collect(plain_engine, prompt, max_tokens=10, temperature=0.0)
         assert a == b and fa == fb
 
+    @pytest.mark.slow
     def test_sampled_identical_under_rejection(self, spec_engine,
                                                plain_engine):
         """Random-weight sampling rejects nearly every draft; the streams

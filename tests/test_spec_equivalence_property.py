@@ -86,6 +86,7 @@ class TestMixedBatchEquivalence:
     per request — and the spec engine does it with ZERO pipeline-
     draining state rebuilds."""
 
+    @pytest.mark.slow
     def test_matrix(self, spec_engine, plain_engine):
         rng = random.Random(0xA14)
         reqs = [
