@@ -1,7 +1,7 @@
 # Developer entry points (tests force the CPU fake-chip platform through
 # tests/conftest.py; bench runs on the real TPU).
 
-.PHONY: test test-fast native bench gateway-bench tpu-capture docs dist clean
+.PHONY: test test-fast native bench gateway-bench tpu-capture chaos docs dist clean
 
 test: native
 	python -m pytest tests/ -q
@@ -25,6 +25,13 @@ gateway-bench:
 # prints a TPU_CAPTURE {...} line and persists the JSON artifact.
 tpu-capture:
 	python tools/tpu_capture.py
+
+# Fleet control plane chaos smoke (ISSUE 14): the non-slow half of the
+# chaos matrix — controller predicates/hysteresis, drain routing,
+# breaker unification, pre-first-byte failover — against stub replicas.
+# The kill -9 / drain-retire rigs over real engines are the slow tier.
+chaos:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet_controller.py -q -m 'not slow' -p no:cacheprovider
 
 docs:
 	python docs/build_site.py

@@ -363,6 +363,15 @@ def main(argv: list[str] | None = None) -> int:
                               "(prefill done, decode young — the "
                               "gateway's disaggregation signal; 0 = "
                               "every decoding slot counts)")
+    p_serve.add_argument("--drain-grace", type=float, default=30.0,
+                         help="graceful-shutdown budget in seconds "
+                              "(ISSUE 14): on SIGTERM/SIGINT the "
+                              "server flips draining (new admissions "
+                              "503 with Retry-After, /state reports "
+                              "draining: true), waits up to this long "
+                              "for live slots to finish or migrate "
+                              "off, then exits 0; a second signal "
+                              "skips the wait")
     p_serve.add_argument("--kv-host-bytes", type=int, default=0,
                          help="byte budget of the host-RAM KV spill "
                               "tier (ISSUE 11): cache-registered pages "
@@ -937,7 +946,13 @@ async def _run_tpuserve(args: argparse.Namespace) -> int:
         kv_host_bytes=args.kv_host_bytes,
     )
     print(f"tpuserve listening on http://{args.host}:{args.port}", flush=True)
-    await _wait_for_signal()
+    # graceful shutdown (ISSUE 14): the first SIGTERM/SIGINT drains —
+    # 503 new admissions, wait out live slots — then exits 0; a second
+    # signal skips the wait
+    server = runner.app["tpuserve_server"]
+    stop = asyncio.Event()
+    server.install_signal_drain(stop, grace_s=args.drain_grace)
+    await stop.wait()
     await runner.cleanup()
     return 0
 

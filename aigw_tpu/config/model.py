@@ -285,6 +285,29 @@ def _check_picker_mode(mode: str) -> str:
     return mode
 
 
+def _check_controller(value: dict[str, Any]) -> Any:
+    """Validate a backend's fleet-controller block at parse time (the
+    knobs are consumed by gateway/controller.ControllerConfig; storing
+    the frozen mapping keeps Backend hashable). Lazy import: the config
+    layer must stay importable without the gateway stack."""
+    raw = value.get("controller")
+    if raw is None:
+        return None
+    from aigw_tpu.gateway.controller import ControllerConfig
+
+    if not value.get("endpoints"):
+        raise ConfigError(
+            f"backend {value.get('name', '?')!r}: controller requires "
+            "an endpoint pool")
+    try:
+        ControllerConfig.parse(dict(raw))
+    except (TypeError, ValueError) as e:
+        raise ConfigError(
+            f"backend {value.get('name', '?')!r}: invalid controller "
+            f"block: {e}") from None
+    return _freeze(raw)
+
+
 @dataclass(frozen=True)
 class Backend:
     """One upstream backend: schema + address + auth + mutations.
@@ -352,6 +375,15 @@ class Backend:
     slo_objective: float = 0.95
     slo_window_s: float = 30.0
     slo_burn_windows: int = 3
+    # Fleet control plane (ISSUE 14): the replica lifecycle manager —
+    # autoscaling off the SLO monitor's sustained-overshoot flag,
+    # scale-in via lossless drain, crash failover. A mapping of
+    # gateway/controller.ControllerConfig knobs (min_replicas,
+    # max_replicas, tick_s, scale_cooldown_s, idle_ticks,
+    # idle_slots_frac, down_grace_s, drain_timeout_s, launcher:
+    # {kind: local, spec: {...}, env: {...}}). None = static pool (no
+    # controller). Requires an endpoint pool.
+    controller: Any = None
     auth: AuthConfig = AuthConfig()
     header_mutation: HeaderMutation = HeaderMutation()
     body_mutation: BodyMutation = BodyMutation()
@@ -393,6 +425,7 @@ class Backend:
                 slo_objective=float(value.get("slo_objective", 0.95)),
                 slo_window_s=float(value.get("slo_window_s", 30.0)),
                 slo_burn_windows=int(value.get("slo_burn_windows", 3)),
+                controller=_check_controller(value),
                 auth=AuthConfig.parse(value.get("auth")),
                 header_mutation=HeaderMutation.parse(value.get("header_mutation")),
                 body_mutation=BodyMutation.parse(value.get("body_mutation")),
@@ -433,6 +466,8 @@ class Backend:
             d["slo_window_s"] = self.slo_window_s
         if self.slo_burn_windows != 3:
             d["slo_burn_windows"] = self.slo_burn_windows
+        if self.controller is not None:
+            d["controller"] = _thaw(self.controller)
         if self.auth.kind is not AuthKind.NONE:
             d["auth"] = self.auth.to_dict()
         if self.header_mutation != HeaderMutation():

@@ -6,24 +6,44 @@ ejection on consecutive 5xx, reference cluster config); natively: after
 ``cooldown`` seconds and the selector skips it, except when every
 candidate is open (fail-static: better to try a suspect backend than to
 reject outright). Any success closes the circuit.
+
+Unified with the fleet health machine (ISSUE 14): the gateway keys the
+same breaker by replica address for picked endpoints, installs an
+``on_transition`` hook that lands every open/close in the fleet event
+ring, and the endpoint picker consults ``is_open`` through its merged
+routability view — a breaker-open replica can no longer be scored
+healthy just because its /state polls still answer.
 """
 
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
+from typing import Callable
 
 
 @dataclass
 class _State:
     consecutive_failures: int = 0
     open_until: float = 0.0
+    #: whether the last recorded transition was an open (so close events
+    #: fire once, not on every success)
+    open_recorded: bool = False
+
+
+#: transition hook signature: (key, opened, consecutive_failures)
+TransitionHook = Callable[[str, bool, int], None]
 
 
 class CircuitBreaker:
-    def __init__(self, threshold: int = 5, cooldown: float = 15.0):
+    def __init__(self, threshold: int = 5, cooldown: float = 15.0,
+                 on_transition: TransitionHook | None = None):
         self.threshold = threshold
         self.cooldown = cooldown
+        #: called on every open/close transition — the gateway wires it
+        #: into the fleet event rings; exceptions are the caller's bug
+        #: (the hook must be non-raising bookkeeping)
+        self.on_transition = on_transition
         self._states: dict[str, _State] = {}
 
     def _state(self, backend: str) -> _State:
@@ -37,6 +57,10 @@ class CircuitBreaker:
         st = self._state(backend)
         st.consecutive_failures = 0
         st.open_until = 0.0
+        if st.open_recorded:
+            st.open_recorded = False
+            if self.on_transition is not None:
+                self.on_transition(backend, False, 0)
 
     def record_failure(self, backend: str, now: float | None = None) -> None:
         now = time.monotonic() if now is None else now
@@ -44,6 +68,11 @@ class CircuitBreaker:
         st.consecutive_failures += 1
         if st.consecutive_failures >= self.threshold:
             st.open_until = now + self.cooldown
+            if not st.open_recorded:
+                st.open_recorded = True
+                if self.on_transition is not None:
+                    self.on_transition(backend, True,
+                                       st.consecutive_failures)
 
     def is_open(self, backend: str, now: float | None = None) -> bool:
         now = time.monotonic() if now is None else now

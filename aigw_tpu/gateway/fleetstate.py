@@ -67,7 +67,7 @@ class ReplicaHealth:
     EVENTS_MAX = 32
 
     __slots__ = ("state", "since", "failures", "successes", "draining",
-                 "replica_id", "events")
+                 "replica_id", "events", "breaker_open")
 
     def __init__(self) -> None:
         self.state = UNKNOWN
@@ -76,6 +76,11 @@ class ReplicaHealth:
         self.successes = 0   # consecutive good polls
         self.draining = False
         self.replica_id = ""  # identity from /state; change = restart
+        # circuit-breaker overlay (ISSUE 14): the gateway's per-replica
+        # breaker feeds its open/close transitions here so the fleet
+        # view and the breaker can never disagree about a replica that
+        # answers /state polls but fails every request
+        self.breaker_open = False
         self.events: collections.deque = collections.deque(
             maxlen=self.EVENTS_MAX)
 
@@ -129,12 +134,27 @@ class ReplicaHealth:
             self._to(DRAINING, "drain_requested")
         # released: the next successful poll restores up/degraded
 
+    def note_breaker(self, opened: bool, failures: int = 0) -> None:
+        """Circuit-breaker transition for this replica: the open/close
+        lands in the same event ring as health transitions, and the
+        ``breaker_open`` flag joins the picker's merged routability
+        view (a breaker-open replica is never scored healthy)."""
+        if opened == self.breaker_open:
+            return
+        self.breaker_open = opened
+        self.events.append({
+            "ts": round(time.time(), 3),
+            "event": "breaker_open" if opened else "breaker_closed",
+            "consecutive_failures": failures,
+        })
+
     def to_dict(self) -> dict[str, Any]:
         return {
             "state": self.state,
             "since": round(self.since, 3),
             "consecutive_failures": self.failures,
             "draining": self.draining,
+            "breaker_open": self.breaker_open,
             "replica_id": self.replica_id,
             "events": list(self.events),
         }
@@ -188,6 +208,22 @@ class FleetState:
 
     def mark_draining(self, addr: str, on: bool = True) -> None:
         self.health.setdefault(addr, ReplicaHealth()).set_draining(on)
+
+    def mark_breaker(self, addr: str, opened: bool,
+                     failures: int = 0) -> None:
+        """Circuit-breaker transition feed (ISSUE 14 unification)."""
+        self.health.setdefault(addr, ReplicaHealth()).note_breaker(
+            opened, failures)
+
+    def forget(self, addr: str) -> None:
+        """Drop a retired replica entirely (controller scale-in): its
+        health machine, cached telemetry, and SLO windows — the replica
+        is gone on purpose, not flapping."""
+        self.health.pop(addr, None)
+        self.last_state.pop(addr, None)
+        self._cum.pop(addr, None)
+        if self.slomon is not None:
+            self.slomon.forget(addr)
 
     # -- read side --------------------------------------------------------
     def health_of(self, addr: str) -> str:

@@ -60,7 +60,7 @@ from typing import Any
 import aiohttp
 
 from aigw_tpu.gateway.kvindex import KVIndex
-from aigw_tpu.gateway.fleetstate import FleetState
+from aigw_tpu.gateway.fleetstate import DOWN, DRAINING, FleetState
 from aigw_tpu.obs.slomon import SLOMonitor
 
 logger = logging.getLogger(__name__)
@@ -285,7 +285,61 @@ class EndpointPicker:
         self._prefix_chain: "collections.OrderedDict[str, str]" = (
             collections.OrderedDict()
         )
+        # merged routability (ISSUE 14): the gateway installs its
+        # circuit breaker here so pick() consults ONE view — health
+        # machine (down/draining) + breaker state — instead of the two
+        # tracking overlapping failure evidence independently
+        self.breaker = None
         self._task: asyncio.Task | None = None
+
+    # -- fleet membership (ISSUE 14 controller) ---------------------------
+    def add_endpoint(self, address: str, slice_name: str = "") -> None:
+        """Join a freshly launched replica to the pool (scale-out /
+        failover replacement). Idempotent; the poll loop picks it up on
+        its next cycle."""
+        if address in self._by_addr:
+            return
+        e = Endpoint(address=address, slice_name=slice_name)
+        self.endpoints.append(e)
+        self._by_addr[address] = e
+        self.state[address] = EndpointState()
+        self._rr = itertools.cycle([x.address for x in self.endpoints])
+
+    def remove_endpoint(self, address: str) -> None:
+        """Retire a replica from the pool (scale-in after drain, or a
+        crashed replica the controller replaced): drops its telemetry,
+        fleet health entry, index entries, and affinity memory."""
+        self.endpoints = [e for e in self.endpoints
+                          if e.address != address]
+        self._by_addr.pop(address, None)
+        self.state.pop(address, None)
+        self.kv_index.remove(address)
+        self.fleet.forget(address)
+        self.forget_endpoint(address)
+        # pick() returns None before touching the cycle when the pool
+        # is empty, so an empty cycle is never advanced
+        self._rr = itertools.cycle([x.address for x in self.endpoints])
+
+    def forget_endpoint(self, address: str) -> None:
+        """Drop session/prefix affinity entries pointing at a dead or
+        retired replica — the controller's "re-route queued work" hook:
+        the next request of an affine session re-picks over the live
+        pool instead of chasing its dead home through the stickiness
+        margin."""
+        for mapping in (self._affinity, self._prefix_affinity):
+            for key in [k for k, v in mapping.items() if v == address]:
+                del mapping[key]
+
+    def is_routable(self, address: str) -> bool:
+        """The merged health view (ISSUE 14): a replica is routable
+        only when the fleet health machine doesn't have it down or
+        draining AND the gateway's circuit breaker (when installed)
+        isn't open for it. Poll-level freshness/health is layered on
+        top by the score path."""
+        if self.fleet.health_of(address) in (DOWN, DRAINING):
+            return False
+        return not (self.breaker is not None
+                    and self.breaker.is_open(address))
 
     # -- polling ----------------------------------------------------------
     async def start(self) -> None:
@@ -314,7 +368,9 @@ class EndpointPicker:
 
     async def _poll_one(self, session: aiohttp.ClientSession,
                         e: Endpoint) -> None:
-        st = self.state[e.address]
+        st = self.state.get(e.address)
+        if st is None:
+            return  # removed (controller scale-in) mid-poll-cycle
 
         def failed() -> None:
             # the stale-poll fix (ISSUE 12): a failed poll used to flip
@@ -562,16 +618,23 @@ class EndpointPicker:
         return e.slice_name if e is not None else ""
 
     def pick(self, headers: dict[str, str] | None = None,
-             explain: dict[str, Any] | None = None) -> str | None:
+             explain: dict[str, Any] | None = None,
+             exclude: frozenset | set | None = None) -> str | None:
         """Returns 'host:port' for the request, or None if no endpoints.
 
         ``explain``: optional dict the pick fills with WHY the endpoint
         won (``sticky`` session affinity held / ``prefix_affinity``
         bonus applied to the winner / ``round_robin`` blind fallback,
         plus the number of fresh candidates) — the gateway attaches it
-        to the request span so a trace shows the routing decision."""
+        to the request span so a trace shows the routing decision.
+
+        ``exclude``: replicas to skip entirely — the pre-first-byte
+        failover retry (ISSUE 14) re-picks with the replica that just
+        refused the connection excluded, so the retry can't land on
+        the same dead process the poll loop hasn't condemned yet."""
         if not self.endpoints:
             return None
+        exclude = exclude or frozenset()
         now = time.monotonic()
         affinity_key = (headers or {}).get(AFFINITY_HEADER, "")
         prev_addr = self._affinity.get(affinity_key) if affinity_key else None
@@ -591,6 +654,13 @@ class EndpointPicker:
 
         def score_of(e: Endpoint) -> float | None:
             st = self.state[e.address]
+            if e.address in exclude:
+                return None
+            if not self.is_routable(e.address):
+                # merged view (ISSUE 14): down, DRAINING (the controller
+                # is moving its sessions off — new work must not land
+                # there), or the circuit breaker is open for it
+                return None
             if not (st.healthy and now - st.updated_at < self.STALE_AFTER):
                 return None
             score = (
@@ -695,6 +765,12 @@ class EndpointPicker:
         elif not fresh:
             # no telemetry (cold start / all down): round-robin blindly
             chosen = next(self._rr)
+            for _ in range(len(self.endpoints)):
+                # an excluded replica just actively refused — even the
+                # blind fallback must not hand the retry right back
+                if chosen not in exclude:
+                    break
+                chosen = next(self._rr)
             if explain is not None:
                 explain.update(round_robin=True, candidates=0)
         else:

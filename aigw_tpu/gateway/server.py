@@ -42,6 +42,11 @@ from aigw_tpu.config.model import (
 from aigw_tpu.config.runtime import RuntimeBackend, RuntimeConfig
 from aigw_tpu.gateway.auth import AuthError
 from aigw_tpu.gateway.circuit import CircuitBreaker
+from aigw_tpu.gateway.controller import (
+    ControllerConfig,
+    FleetController,
+    build_launcher,
+)
 from aigw_tpu.gateway.costs import TokenUsage
 from aigw_tpu.gateway.fleetstate import (
     DecisionRing,
@@ -69,6 +74,7 @@ from aigw_tpu.gateway.router import (
 from aigw_tpu.obs.metrics import (
     GenAIMetrics,
     RequestMetrics,
+    render_controller_gauges,
     render_fleet_gauges,
 )
 from aigw_tpu.obs.tracing import (
@@ -250,7 +256,14 @@ class GatewayServer:
 
         self._oi_config = OITraceConfig.from_env()
         self.access_log = AccessLogger()
-        self.circuit = CircuitBreaker()
+        # circuit breaker unified with the fleet health machine (ISSUE
+        # 14): keyed by backend name for logical backends AND by
+        # replica address for picked endpoints; every open/close lands
+        # in the replica's fleet event ring, and the picker's merged
+        # routability view consults is_open — one failure-evidence
+        # surface, not two that can disagree
+        self.circuit = CircuitBreaker(
+            on_transition=self._on_circuit_transition)
         #: optional () -> {key: condition} of NOT-Accepted objects, wired
         #: by the CLI when the config source is a reconciled manifest dir
         self.conditions_fn = None
@@ -283,6 +296,9 @@ class GatewayServer:
             self.app.router.add_get("/debug/stacks", self._handle_debug_stacks)
         self._pickers: dict[str, EndpointPicker] = {}
         self._picker_tasks: set[asyncio.Task] = set()
+        # fleet control plane (ISSUE 14): one lifecycle manager per
+        # backend pool that configures a `controller` block
+        self._controllers: dict[str, FleetController] = {}
         self._build_pickers(runtime)
         self.app.on_startup.append(self._start_pickers)
         # MCP proxy is always registered (default path /mcp) so a config
@@ -318,14 +334,21 @@ class GatewayServer:
         except RuntimeError:
             loop = None
         old = self._pickers
+        old_ctl = self._controllers
         self._build_pickers(rc)
         if loop is not None:
+            for name, ctl in old_ctl.items():
+                if self._controllers.get(name) is not ctl:
+                    self._spawn(loop, ctl.stop())
             for name, picker in old.items():
                 if self._pickers.get(name) is not picker:
                     self._spawn(loop, picker.stop())
             for name, picker in self._pickers.items():
                 if old.get(name) is not picker:
                     self._spawn(loop, picker.start())
+            for name, ctl in self._controllers.items():
+                if old_ctl.get(name) is not ctl:
+                    self._spawn(loop, ctl.start())
 
     def _spawn(self, loop: asyncio.AbstractEventLoop, coro) -> None:
         # the loop holds tasks weakly; retain refs until completion
@@ -361,10 +384,51 @@ class GatewayServer:
             picker._config_key = key  # type: ignore[attr-defined]
             pickers[name] = picker
         self._pickers = pickers
+        # the merged routability view: the picker consults the SAME
+        # breaker the attempt loop feeds, keyed by replica address
+        for picker in self._pickers.values():
+            picker.breaker = self.circuit
+        self._build_controllers(rc)
+
+    def _build_controllers(self, rc: RuntimeConfig) -> None:
+        from aigw_tpu.config.model import _thaw
+
+        controllers: dict[str, FleetController] = {}
+        for name, rb in rc.backends.items():
+            raw = rb.backend.controller
+            picker = self._pickers.get(name)
+            if raw is None or picker is None:
+                continue
+            cfg = ControllerConfig.parse(_thaw(raw))
+            if not cfg.enabled:
+                continue
+            prev = self._controllers.get(name)
+            if (prev is not None and prev.picker is picker
+                    and getattr(prev, "_config_raw", None) == raw):
+                controllers[name] = prev  # unchanged: keep its state
+                continue
+            ctl = FleetController(
+                picker=picker, cfg=cfg,
+                launcher=build_launcher(cfg.launcher),
+                decisions=self.decisions, backend=name)
+            ctl._config_raw = raw  # type: ignore[attr-defined]
+            controllers[name] = ctl
+        self._controllers = controllers
 
     async def _start_pickers(self, _app) -> None:
         for picker in self._pickers.values():
             await picker.start()
+        for ctl in self._controllers.values():
+            await ctl.start()
+
+    def _on_circuit_transition(self, key: str, opened: bool,
+                               failures: int) -> None:
+        """Breaker open/close → the fleet event ring of whichever pool
+        knows this key as a replica address (ISSUE 14 unification).
+        Backend-name keys have no replica entry and are skipped."""
+        for picker in self._pickers.values():
+            if key in picker.state:
+                picker.fleet.mark_breaker(key, opened, failures)
 
     async def _get_session(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
@@ -375,6 +439,10 @@ class GatewayServer:
         return self._session
 
     async def _cleanup(self, _app: web.Application) -> None:
+        for ctl in self._controllers.values():
+            # stops the control loop AND terminates launcher-owned
+            # replica processes — shutdown must not orphan children
+            await ctl.stop()
         for picker in self._pickers.values():
             await picker.stop()
         if self._session is not None and not self._session.closed:
@@ -412,6 +480,11 @@ class GatewayServer:
             name: picker.fleet.snapshot(picker.state)
             for name, picker in self._pickers.items()
         }
+        for name, ctl in self._controllers.items():
+            if name in backends:
+                # lifecycle manager state (ISSUE 14): scaling decisions,
+                # drains in progress, and the bounded action ring
+                backends[name]["controller"] = ctl.snapshot()
         return web.json_response({
             "ts": round(time.time(), 3),
             "backends": backends,
@@ -457,6 +530,10 @@ class GatewayServer:
             label = name if len(self._pickers) > 1 else ""
             chunks.append(render_fleet_gauges(
                 picker.fleet.rollup(picker.state), backend=label))
+            ctl = self._controllers.get(name)
+            if ctl is not None:
+                chunks.append(render_controller_gauges(
+                    ctl.gauge_values(), backend=label))
         chunks.append(
             b"# TYPE aigw_fleet_scrape_errors gauge\n"
             b"aigw_fleet_scrape_errors %d\n" % errors)
@@ -1007,8 +1084,8 @@ class GatewayServer:
         dest = request.headers.get(DESTINATION_ENDPOINT_HEADER, "")
         prefix_key_used = ""
         decision: dict[str, Any] | None = None
+        pick_headers = client_headers
         if not dest and backend.name in self._pickers:
-            pick_headers = client_headers
             if backend.picker_content_affinity and isinstance(body, dict):
                 derived = {}
                 if AFFINITY_HEADER not in client_headers:
@@ -1138,16 +1215,87 @@ class GatewayServer:
             sock_connect=min(10.0, backend.request_timeout),
             sock_read=backend.stream_idle_timeout if tx.stream else None,
         )
-        try:
-            resp = await session.post(
-                base_url + path, data=out_body, headers=headers, timeout=timeout
-            )
-        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
-            raise _RetriableUpstreamError(
-                502, error_body(f"upstream connect error: {e}",
-                                type_="upstream_error"),
-                str(e) or type(e).__name__,
-            ) from None
+        #: this request went through the picker (an external
+        #: x-gateway-destination-endpoint pin is NOT failed over —
+        #: the pinner chose that exact replica on purpose)
+        picked = bool(dest) and backend.name in self._pickers
+
+        def _move_dest(nxt: str) -> None:
+            # pre-first-byte failover (ISSUE 14): re-aim the SAME
+            # translated request at a sibling replica. Only the
+            # destination-derived pieces change; the translated body,
+            # auth, and mutations were all destination-independent.
+            nonlocal dest, base_url
+            if decision is not None:
+                decision.setdefault("failover_from", []).append(dest)
+                decision["chosen"] = nxt
+            if span is not None:
+                span.set("aigw.pick.failover_from", dest)
+            if KV_PEERS_HEADER in headers:
+                peers = [p for p in headers[KV_PEERS_HEADER].split(",")
+                         if p and p != nxt]
+                if peers:
+                    headers[KV_PEERS_HEADER] = ",".join(peers)
+                else:
+                    del headers[KV_PEERS_HEADER]
+            dest = nxt
+            base_url = f"http://{dest}"
+            headers["host"] = _up.urlsplit(base_url).netloc
+
+        def _sibling(tried: set[str]) -> str | None:
+            picker = self._pickers.get(backend.name)
+            if picker is None:
+                return None
+            try:
+                nxt = picker.pick(pick_headers, exclude=frozenset(tried))
+            except SLOShedError:
+                return None
+            return nxt if nxt and nxt not in tried else None
+
+        # at most ONE sibling retry, and only before any stream byte has
+        # been relayed: a connect error or an immediate retriable 5xx
+        # from a picked replica re-picks the next-ranked sibling instead
+        # of surfacing the dead replica's error to the client
+        failed_over = not picked
+        breaker_counted: set[str] = set()
+        while True:
+            try:
+                resp = await session.post(
+                    base_url + path, data=out_body, headers=headers,
+                    timeout=timeout
+                )
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                if picked:
+                    # per-replica breaker evidence: the dead process is
+                    # condemned by address, not just its whole backend
+                    self.circuit.record_failure(dest)
+                if not failed_over:
+                    nxt = _sibling({dest})
+                    if nxt is not None:
+                        failed_over = True
+                        logger.warning(
+                            "pre-first-byte failover %s -> %s (%s)",
+                            dest, nxt, e)
+                        _move_dest(nxt)
+                        continue
+                raise _RetriableUpstreamError(
+                    502, error_body(f"upstream connect error: {e}",
+                                    type_="upstream_error"),
+                    str(e) or type(e).__name__,
+                ) from None
+            if (not failed_over and resp.status in (500, 502, 503, 504)):
+                self.circuit.record_failure(dest)
+                breaker_counted.add(dest)
+                nxt = _sibling({dest})
+                if nxt is not None:
+                    failed_over = True
+                    logger.warning(
+                        "pre-first-byte failover %s -> %s (status %d)",
+                        dest, nxt, resp.status)
+                    resp.release()
+                    _move_dest(nxt)
+                    continue
+            break
 
         async with _closing(resp):
             if resp.status >= 400:
@@ -1157,6 +1305,8 @@ class GatewayServer:
                     err = b""
                 client_err = translator.response_error(resp.status, err)
                 if resp.status in _RETRIABLE_STATUS:
+                    if picked and dest not in breaker_counted:
+                        self.circuit.record_failure(dest)
                     raise _RetriableUpstreamError(resp.status, client_err,
                                                   f"status {resp.status}")
                 req_metrics.finish(TokenUsage(), error_type=str(resp.status))
@@ -1167,6 +1317,9 @@ class GatewayServer:
                     status=resp.status, body=client_err,
                     content_type="application/json")
 
+            if picked:
+                # response started: close the replica-address circuit
+                self.circuit.record_success(dest)
             translator.response_headers(
                 resp.status, {k.lower(): v for k, v in resp.headers.items()}
             )
@@ -1425,6 +1578,12 @@ class GatewayServer:
                 # client sees one uninterrupted stream
                 cont = await migrator.start_continuation()
                 if cont is None:
+                    # resume from the last exported state on another
+                    # sibling (ISSUE 14): the blob is in hand and no
+                    # continuation byte was relayed yet, so a second
+                    # target adopts the chain gap-free
+                    cont = await migrator.retry_continuation()
+                if cont is None:
                     # the session was cut but nobody resumed it — this
                     # is a real mid-stream loss; surface the SSE error
                     # event via the except path below
@@ -1630,7 +1789,17 @@ class _Migrator:
         #: so /debug/decisions shows the trigger next to the pick
         self.decision = decision
 
-    def _pick_target(self) -> str | None:
+    def _drain_requested(self) -> bool:
+        """The source replica is draining (controller scale-in/update,
+        operator /drain, or its own /state announcement) — every
+        migration-capable stream must move off regardless of queue
+        pressure or age (ISSUE 14 lossless drain)."""
+        h = self.picker.fleet.health.get(self.src)
+        return h is not None and h.draining
+
+    def _pick_target(self, force: bool = False,
+                     exclude: set | frozenset = frozenset()
+                     ) -> str | None:
         src_st = self.picker.state.get(self.src)
         if src_st is None or not src_st.healthy:
             return None
@@ -1640,14 +1809,16 @@ class _Migrator:
             # stop polling for this stream instead of 409ing an export
             self.attempted = True
             return None
-        if src_st.queued < self.backend.migration_queue_depth:
+        if not force and src_st.queued < self.backend.migration_queue_depth:
             return None  # no prefill pressure at the source
         now = time.monotonic()
         best: str | None = None
         best_pred = 0.0
         for addr, st in self.picker.state.items():
-            if addr == self.src or not st.healthy:
+            if addr == self.src or addr in exclude or not st.healthy:
                 continue
+            if not self.picker.is_routable(addr):
+                continue  # down/draining/breaker-open: not a new home
             if not st.migration_capable:
                 continue  # can't adopt a page chain
             if now - st.updated_at >= self.picker.STALE_AFTER:
@@ -1667,10 +1838,11 @@ class _Migrator:
         runs to EOF naturally, flushing every pre-cut token."""
         if self.attempted or not rid or tokens_seen < 1:
             return
-        if tokens_seen > self.backend.migration_young_tokens:
+        draining = self._drain_requested()
+        if not draining and tokens_seen > self.backend.migration_young_tokens:
             self.attempted = True  # matured past migratability
             return
-        target = self._pick_target()
+        target = self._pick_target(force=draining)
         if target is None:
             return
         self.attempted = True
@@ -1694,11 +1866,37 @@ class _Migrator:
                     "src_queued": int(getattr(
                         self.picker.state.get(self.src), "queued", 0)),
                     "tokens_seen": tokens_seen,
+                    "drain": draining,
                 }
             logger.info("migrating session %s: %s -> %s", rid, self.src,
                         target)
         except (aiohttp.ClientError, asyncio.TimeoutError) as e:
             logger.warning("migration export failed: %s", e)
+
+    async def retry_continuation(self) -> aiohttp.ClientResponse | None:
+        """Resume from the last exported state on a DIFFERENT sibling
+        (ISSUE 14 crash failover): the cut already happened and the
+        blob is in hand — if the chosen target died or refused the
+        import, any other idle migration-capable replica can adopt the
+        chain. The client stream stays gap-free by construction: the
+        continuation always starts at the export cut, and zero
+        continuation bytes were relayed before this retry. Returns None
+        when no alternative target exists (the caller degrades to the
+        typed error event)."""
+        if self.export is None or self.target is None:
+            return None
+        failed = self.target
+        nxt = self._pick_target(force=True, exclude={failed})
+        if nxt is None:
+            return None
+        self.target = nxt
+        if self.decision is not None:
+            self.decision.setdefault(
+                "migration_retargeted_from", []).append(failed)
+            self.decision["migrated_to"] = nxt
+        logger.info("migration continuation retarget %s -> %s",
+                    failed, nxt)
+        return await self.start_continuation()
 
     async def start_continuation(self) -> aiohttp.ClientResponse | None:
         """Hand the blob to the target replica; returns the SSE response

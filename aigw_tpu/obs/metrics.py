@@ -315,6 +315,38 @@ def render_fleet_gauges(rollup: dict, backend: str = "") -> bytes:
     return ("\n".join(lines) + "\n").encode()
 
 
+#: fleet control plane surface (ISSUE 14): key in
+#: ``FleetController.gauge_values()`` → gauge name on the gateway's
+#: ``GET /fleet/metrics``. Same drift-check contract as FLEET_GAUGES —
+#: every key here must appear in the controller's gauge dict and every
+#: gauge must render on the federation scrape when a controller is
+#: attached to the pool.
+CONTROLLER_GAUGES: tuple[tuple[str, str], ...] = (
+    ("scale_outs", "aigw_ctl_scale_outs_total"),
+    ("scale_ins", "aigw_ctl_scale_ins_total"),
+    ("drains", "aigw_ctl_drains_total"),
+    ("retires", "aigw_ctl_retires_total"),
+    ("failovers", "aigw_ctl_failovers_total"),
+    ("launch_failures", "aigw_ctl_launch_failures_total"),
+    ("launches_in_flight", "aigw_ctl_launches_in_flight"),
+    ("drains_in_progress", "aigw_ctl_drains_in_progress"),
+    ("replicas_min", "aigw_ctl_replicas_min"),
+    ("replicas_max", "aigw_ctl_replicas_max"),
+    ("replicas_live", "aigw_ctl_replicas_live"),
+    ("idle_streak", "aigw_ctl_idle_streak"),
+)
+
+
+def render_controller_gauges(values: dict, backend: str = "") -> bytes:
+    """FleetController gauge dict → aigw_ctl_* Prometheus gauges."""
+    sel = f'{{backend="{backend}"}}' if backend else ""
+    lines = []
+    for key, name in CONTROLLER_GAUGES:
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name}{sel} {values.get(key, 0)}")
+    return ("\n".join(lines) + "\n").encode()
+
+
 def render_engine_gauges(stats: object) -> bytes:
     """EngineStats → Prometheus text exposition (appended to the
     prometheus_client registry output on tpuserve's /metrics)."""

@@ -240,6 +240,13 @@ class TPUServeServer:
         # counters for a quiet replica
         self.replica_id = uuid.uuid4().hex[:16]
         self._started_at = time.time()
+        # graceful drain (ISSUE 14): when set, NEW generation work is
+        # refused with 503+Retry-After while live slots finish or
+        # migrate off; /state reports it so the gateway's fleet health
+        # machine (and its controller) see the drain on the next poll.
+        # Flipped by POST /drain (the controller's retire protocol) or
+        # the SIGTERM/SIGINT handler (install_signal_drain).
+        self.draining = False
 
         mesh = None
         if tp > 1 or ep > 1 or sp > 1:
@@ -333,6 +340,9 @@ class TPUServeServer:
         # body cap sized for /migrate/import: a migrated page chain is
         # megabytes of KV by design (page_bytes × pages on the wire)
         self.app = web.Application(client_max_size=256 * 1024 * 1024)
+        # callers holding only the AppRunner (run_tpuserve) reach the
+        # server through the app, e.g. to install the drain handler
+        self.app["tpuserve_server"] = self
         self.app.router.add_post("/v1/chat/completions", self._chat)
         self.app.router.add_post("/v1/completions", self._completions)
         self.app.router.add_post("/v1/embeddings", self._embeddings)
@@ -341,6 +351,7 @@ class TPUServeServer:
         self.app.router.add_get("/health", self._health)
         self.app.router.add_get("/state", self._state)
         self.app.router.add_get("/metrics", self._metrics)
+        self.app.router.add_post("/drain", self._drain)
         self.app.router.add_post("/migrate/export", self._migrate_export)
         self.app.router.add_post("/migrate/import", self._migrate_import)
         self.app.router.add_post("/kv/pages", self._kv_pages)
@@ -735,6 +746,11 @@ class TPUServeServer:
         chat: bool,
         prefix_hashes: list | None = None,
     ) -> web.StreamResponse:
+        if self.draining:
+            # graceful drain (ISSUE 14): no NEW sessions while retiring
+            # — live ones keep streaming below until they finish or the
+            # gateway migrates them off
+            return self._drain_refusal()
         stream = bool(body.get("stream", False))
         try:
             # logprobs knobs validate to a client 400 up front — every
@@ -1597,6 +1613,87 @@ class TPUServeServer:
         ]
         return web.json_response(oai.models_response(entries))
 
+    # -- graceful drain (ISSUE 14) ----------------------------------------
+    def _drain_refusal(self) -> web.Response:
+        """503 + Retry-After for new work on a draining replica: the
+        gateway's pre-first-byte failover retries the next-ranked
+        sibling; a direct client backs off and re-resolves."""
+        return web.Response(
+            status=503,
+            body=oai.error_body(
+                "replica is draining (shutting down or being retired); "
+                "retry against another replica",
+                type_="server_error"),
+            headers={"retry-after": "2", "x-aigw-draining": "1"},
+            content_type="application/json")
+
+    async def _drain(self, request: web.Request) -> web.Response:
+        """POST /drain — the control plane's retire protocol: flips the
+        draining flag (``{"on": false}`` un-drains, e.g. a cancelled
+        rolling update) and reports what's still live. Admissions are
+        refused from the moment the flag is up; live slots keep
+        serving until they finish or the gateway migrates them off."""
+        try:
+            raw = await request.read()
+            body = oai.parse_json_body(raw) if raw.strip() else {}
+        except oai.SchemaError as e:
+            return web.Response(status=400, body=oai.error_body(str(e)),
+                                content_type="application/json")
+        self.draining = bool(body.get("on", True))
+        s = self.engine.stats
+        return web.json_response({
+            "draining": self.draining,
+            "active_slots": s.active_slots,
+            "queued": s.queued,
+            "live_streams": len(self._live),
+            "migratable_slots": s.migratable_slots,
+        })
+
+    async def drain(self, timeout_s: float = 60.0,
+                    poll_s: float = 0.1) -> bool:
+        """Drain to empty: refuse new admissions and wait until the
+        engine holds zero active slots and an empty queue (sessions
+        finish naturally or the gateway migrates them away). Returns
+        True when fully drained within the budget — the graceful-exit
+        criterion (exit 0 with zero live slots)."""
+        self.draining = True
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            s = self.engine.stats
+            if s.active_slots == 0 and s.queued == 0:
+                return True
+            await asyncio.sleep(poll_s)
+        s = self.engine.stats
+        return s.active_slots == 0 and s.queued == 0
+
+    def install_signal_drain(self, stop_event: asyncio.Event,
+                             grace_s: float = 30.0) -> None:
+        """SIGTERM/SIGINT → graceful drain, then set ``stop_event`` so
+        the caller can cleanup + exit 0. A second signal skips the
+        drain (operator insisting). Call from within the running
+        loop."""
+        loop = asyncio.get_running_loop()
+
+        def _handle() -> None:
+            if self.draining:
+                stop_event.set()  # second signal: immediate
+                return
+
+            async def _go() -> None:
+                drained = await self.drain(grace_s)
+                logger.info("drain %s; shutting down",
+                            "complete" if drained else "timed out")
+                stop_event.set()
+
+            logger.info("signal received: draining (grace %.0fs)",
+                        grace_s)
+            self._drain_task = loop.create_task(_go())
+
+        import signal as _signal
+
+        for sig in (_signal.SIGTERM, _signal.SIGINT):
+            loop.add_signal_handler(sig, _handle)
+
     async def _health(self, _request: web.Request) -> web.Response:
         if not self.engine.healthy:
             return web.json_response(
@@ -1621,6 +1718,10 @@ class TPUServeServer:
                 "replica_id": self.replica_id,
                 "started_at": round(self._started_at, 3),
                 "uptime_s": round(time.time() - self._started_at, 3),
+                # graceful drain (ISSUE 14): the gateway's fleet health
+                # machine honors this as the control-plane overlay —
+                # the picker stops routing here on the next poll
+                "draining": self.draining,
                 # cumulative TTFT histogram buckets — the gateway's
                 # live SLO burn-rate monitor (obs/slomon.py) computes
                 # windowed goodput from the deltas of this field, off
@@ -1967,6 +2068,10 @@ class TPUServeServer:
 
     async def _migrate_import(
         self, request: web.Request) -> web.StreamResponse:
+        if self.draining:
+            # a draining replica must not ADOPT sessions either — the
+            # migration orchestrator reads 503 as "pick someone else"
+            return self._drain_refusal()
         """Adopt an exported page chain and stream the session's
         continuation. The pages enter this replica's pool through the
         prefix-cache registration path (parked evictable, normal

@@ -2112,6 +2112,33 @@ def decode_fused_numbers(reps: int = 3, requests_per_rep: int = 4,
             stop()
 
 
+async def _warm_openloop_shapes(s, url: str, model: str, tag: str,
+                                gen_lens=(2, 4, 6)) -> None:
+    """Off the clock: compile every shape a timed open-loop trace can
+    use — every (prompt_len, gen) combo deterministically, simultaneous
+    PAIRS over every prompt-length combination (batch=2 children
+    coalesce admissions into group shapes the spaced pass never
+    reaches), and a bursty pass for arrival-timing-dependent geometry.
+    Shared by the fleet_obs and fleet_ctl legs — their hot-compile
+    tripwires must measure the telemetry/control path, not first-use
+    compiles."""
+    combos = [(pl, g) for pl in (48, 96, 160) for g in gen_lens]
+    warm = [{"at": 0.3 * i, "prompt_len": pl, "gen": g,
+             "tenant": "", "i": i}
+            for i, (pl, g) in enumerate(combos)]
+    await _drive_openloop(s, url, model, warm, tag=tag)
+    lens = (48, 96, 160)
+    duos = [(a, b) for i, a in enumerate(lens) for b in lens[i:]]
+    pairs = [{"at": 0.8 * j, "prompt_len": pl, "gen": gen_lens[0],
+              "tenant": "", "i": 100 + 2 * j + kk}
+             for j, (a, b) in enumerate(duos)
+             for kk, pl in enumerate((a, b))]
+    await _drive_openloop(s, url, model, pairs, tag=tag + "p")
+    burst = _poisson_trace(seed=998, n=10, rate_hz=4.0,
+                           gen_lens=gen_lens)
+    await _drive_openloop(s, url, model, burst, tag=tag + "b")
+
+
 def fleet_obs_numbers(reps: int = 3, arrivals: int = 20) -> dict:
     """The ``--ab fleet_obs`` leg (ISSUE 12): observability must be
     ~free. The SAME seeded open-loop trace through two gateway
@@ -2158,35 +2185,11 @@ def fleet_obs_numbers(reps: int = 3, arrivals: int = 20) -> dict:
         await _wait_health(url_b, 1200)
         timeout = aiohttp.ClientTimeout(total=1200)
         async with aiohttp.ClientSession(timeout=timeout) as s:
-            # off the clock: compile every shape the timed traces use —
-            # every (prompt_len, gen) combo deterministically, plus a
-            # bursty pass for the coalesced-admission group shapes
-            combos = [(pl, g) for pl in (48, 96, 160)
-                      for g in (2, 4, 6)]
+            # off the clock: compile every shape the timed traces use
+            # (combos + coalesced pairs + bursty pass — the shared
+            # open-loop warm helper)
             for url, tg in ((url_a, "wa"), (url_b, "wb")):
-                warm = [{"at": 0.3 * i, "prompt_len": pl, "gen": g,
-                         "tenant": "", "i": i}
-                        for i, (pl, g) in enumerate(combos)]
-                await _drive_openloop(s, url, model_name, warm, tag=tg)
-                # coalesced-admission group shapes: simultaneous PAIRS
-                # over EVERY prompt-length combination (batch=2
-                # children) — the 0.3s-spaced pass above never
-                # coalesces, and mixed-length pairs land on token-
-                # budget rungs no same-length pair reaches, so a
-                # bursty timed trace would pay those prefill compiles
-                lens = (48, 96, 160)
-                duos = [(a, b) for i, a in enumerate(lens)
-                        for b in lens[i:]]
-                pairs = [{"at": 0.8 * j, "prompt_len": pl, "gen": 2,
-                          "tenant": "", "i": 100 + 2 * j + k}
-                         for j, (a, b) in enumerate(duos)
-                         for k, pl in enumerate((a, b))]
-                await _drive_openloop(s, url, model_name, pairs,
-                                      tag=tg + "p")
-                burst = _poisson_trace(seed=998, n=10, rate_hz=4.0,
-                                       gen_lens=(2, 4, 6))
-                await _drive_openloop(s, url, model_name, burst,
-                                      tag=tg + "b")
+                await _warm_openloop_shapes(s, url, model_name, tg)
             xla0 = -1
             tput: dict[str, list] = {"on": [], "off": []}
             scrapes = 0
@@ -2254,6 +2257,273 @@ def fleet_obs_numbers(reps: int = 3, arrivals: int = 20) -> dict:
     finally:
         stop_a()
         stop_b()
+
+
+def _classify_stream(status: int, data_lines: list[bytes],
+                     aborted: bool) -> str:
+    """Outcome of one streamed request under churn (ISSUE 14):
+
+    - ``complete`` — the stream reached its ``[DONE]`` terminal;
+    - ``typed_error`` — a clean, client-parseable failure: a non-200
+      JSON error response, or an SSE ``{"error": ...}`` event ending
+      the stream (the gateway's mid-stream failure contract);
+    - ``torn`` — the connection died (or the stream just stopped)
+      without either. Torn streams are the DROPPED count the fleet_ctl
+      acceptance criterion requires to be zero.
+    """
+    if status != 200:
+        return "typed_error"
+    if any(ln.strip() == b"[DONE]" for ln in data_lines):
+        return "complete"
+    if aborted:
+        return "torn"
+    for ln in data_lines:
+        try:
+            ev = json.loads(ln)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(ev, dict) and "error" in ev:
+            return "typed_error"
+    return "torn"
+
+
+async def _drive_openloop_strict(s, url: str, model: str,
+                                 trace: list[dict],
+                                 tag: str = "") -> dict:
+    """Open-loop driver with torn-stream accounting: like
+    ``_drive_openloop`` but every arrival is classified complete /
+    typed_error / torn via :func:`_classify_stream` — the chaos legs'
+    zero-dropped-streams claim is the ``torn`` count staying zero
+    while replicas are killed under the trace."""
+    import aiohttp  # noqa: F811
+
+    res: dict = {"complete": 0, "typed_error": 0, "torn": 0,
+                 "client_ttft_ms": []}
+
+    async def one(item: dict, t0: float) -> None:
+        delay = t0 + item["at"] - time.perf_counter()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        n = item["prompt_len"]
+        text = (f"{tag}{item['i']:03d}" + "y" * n)[: n - 1]
+        payload = {
+            "model": model, "prompt": text,
+            "max_tokens": item["gen"], "temperature": 0.0,
+            "stream": True, "logit_bias": {"97": 100},
+        }
+        status = 0
+        data_lines: list[bytes] = []
+        aborted = False
+        first = -1.0
+        sent = time.perf_counter()
+        try:
+            async with s.post(url + "/v1/completions",
+                              json=payload) as resp:
+                status = resp.status
+                if status != 200:
+                    await resp.read()
+                else:
+                    async for line in resp.content:
+                        line = line.strip()
+                        if not line.startswith(b"data: "):
+                            continue
+                        d = line[6:]
+                        data_lines.append(d)
+                        if first < 0 and b'"text"' in d:
+                            first = 1e3 * (time.perf_counter() - sent)
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            aborted = True
+        res[_classify_stream(status, data_lines, aborted)] += 1
+        if first > 0:
+            res["client_ttft_ms"].append(first)
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*(one(it, t0) for it in trace))
+    return res
+
+
+def fleet_ctl_numbers(arrivals: int = 24) -> dict:
+    """The ``--ab fleet_ctl`` leg (ISSUE 14): the fleet control plane
+    under injected churn. The seeded open-loop trace runs against a
+    2-replica pool behind a controller-enabled gateway while the
+    harness (1) ``kill -9``s replica A mid-decode — the crash case: the
+    controller must detect it, re-route, and launch a replacement
+    through the LocalProcessLauncher; (2) floods the survivor until the
+    SLO monitor's sustained-overshoot flag trips — the controller must
+    scale out. The claims: dropped (torn) streams == 0 — every client
+    sees a complete stream or a typed error event — goodput recovers to
+    ≥0.9× the pre-event window in a bounded, reported time, and the
+    SURVIVING replica pays zero hot XLA compiles throughout."""
+    import aiohttp
+
+    from tools import chaos
+
+    model_name = "bench-fleetctl-tiny"
+    k = int(os.environ.get("AIGW_BENCH_CPU_K", "4"))
+    engine = {"num_pages": 64, "max_queued_requests": 64,
+              "min_prefill_bucket": 32, "warm_decode_buckets": 7}
+    child_spec = {
+        "model": model_name,
+        "cfg": {key: getattr(CPU_CFG, key) for key in (
+            "vocab_size", "dim", "n_layers", "n_heads", "n_kv_heads",
+            "ffn_dim", "max_seq_len", "rope_theta")},
+        "batch": 2, "page": 16, "k": k, "quantize": "",
+        "engine": engine, "param_dtype": "", "lora": {}, "tp": 1,
+    }
+    rep_a = chaos.spawn_replica(child_spec)
+    rep_b = chaos.spawn_replica(child_spec)
+    gen_lens = (3, 5, 7)
+
+    gw, stop_gw = _start_gateway_cfg({
+        "picker_poll_interval": 0.1,
+        "migration": True,
+        "migration_queue_depth": 2,
+        # static picker mode: slo_ttft_ms feeds ONLY the burn-rate
+        # monitor (no shedding) — the scale-out predicate's SLO
+        "slo_ttft_ms": 150.0,
+        "slo_window_s": 1.5,
+        "slo_burn_windows": 2,
+        "controller": {
+            "min_replicas": 2, "max_replicas": 3,
+            "tick_s": 0.25, "down_grace_s": 0.5,
+            "scale_cooldown_s": 3.0,
+            # scale-in disabled for the leg (it would retire the
+            # replica the tripwire is anchored on)
+            "idle_ticks": 1_000_000,
+            "drain_timeout_s": 30.0,
+            "launcher": {"kind": "local", "spec": child_spec,
+                         "term_grace_s": 5.0},
+        },
+    }, [rep_a.address, rep_b.address])
+
+    async def run() -> dict:
+        await _wait_health(rep_a.url, 1200)
+        await _wait_health(rep_b.url, 1200)
+        timeout = aiohttp.ClientTimeout(total=1200)
+        async with aiohttp.ClientSession(timeout=timeout) as s:
+            for url, tg in ((rep_a.url, "fa"), (rep_b.url, "fb")):
+                await _warm_openloop_shapes(s, url, model_name, tg,
+                                            gen_lens=gen_lens)
+            await _wait_health(gw, 180)
+            await asyncio.sleep(1.2)  # first polls land
+
+            async def ctl_state() -> dict:
+                snap = await (await s.get(gw + "/fleet/state")).json()
+                return (snap["backends"]["pool"].get("controller")
+                        or {})
+
+            # the survivor's compile tripwire anchors AFTER its warm
+            xla0 = (await _get_state(s, rep_b.url)).get(
+                "xla_compiles", 0)
+
+            outcomes = {"complete": 0, "typed_error": 0, "torn": 0}
+
+            def tally(r: dict) -> None:
+                for key in outcomes:
+                    outcomes[key] += r[key]
+
+            # ---- pre-event window --------------------------------
+            pre = await _drive_openloop_strict(
+                s, gw, model_name,
+                _poisson_trace(seed=1400, n=arrivals, rate_hz=3.0,
+                               gen_lens=gen_lens), tag="pr")
+            tally(pre)
+            goodput_pre = pre["complete"] / arrivals
+
+            # ---- crash injection: kill -9 A mid-decode -----------
+            evt_trace = _poisson_trace(seed=1401, n=arrivals,
+                                       rate_hz=3.0, gen_lens=gen_lens)
+            kill_at = evt_trace[arrivals // 3]["at"] + 0.15
+            t_kill = [0.0]
+
+            async def assassin() -> None:
+                await asyncio.sleep(kill_at)
+                t_kill[0] = time.perf_counter()
+                rep_a.kill9()
+
+            evt, _ = await asyncio.gather(
+                _drive_openloop_strict(s, gw, model_name, evt_trace,
+                                       tag="ev"),
+                assassin())
+            tally(evt)
+            goodput_event = evt["complete"] / arrivals
+
+            # ---- failover: detection + replacement launch --------
+            deadline = time.perf_counter() + 900
+            ctl: dict = {}
+            while time.perf_counter() < deadline:
+                ctl = await ctl_state()
+                if (ctl.get("counters", {}).get("failovers", 0) >= 1
+                        and len(ctl.get("replicas_live") or ()) >= 2):
+                    break
+                await asyncio.sleep(0.5)
+            failovers = ctl.get("counters", {}).get("failovers", 0)
+            launched = ctl.get("counters", {}).get("launch_failures", 0)
+
+            # ---- goodput recovery probes -------------------------
+            recovery_s = -1.0
+            probe_n = 8
+            probe_seed = 1500
+            while time.perf_counter() - t_kill[0] < 900:
+                probe = await _drive_openloop_strict(
+                    s, gw, model_name,
+                    _poisson_trace(seed=probe_seed, n=probe_n,
+                                   rate_hz=4.0, gen_lens=gen_lens),
+                    tag=f"p{probe_seed % 100}")
+                probe_seed += 1
+                tally(probe)
+                if probe["complete"] / probe_n >= 0.9 * goodput_pre:
+                    recovery_s = time.perf_counter() - t_kill[0]
+                    break
+
+            # ---- triggered scale-out: flood past the SLO ---------
+            scale_outs = 0
+            for flood_round in range(4):
+                flood = await _drive_openloop_strict(
+                    s, gw, model_name,
+                    _poisson_trace(seed=1600 + flood_round, n=20,
+                                   rate_hz=12.0, gen_lens=gen_lens),
+                    tag=f"fl{flood_round}")
+                tally(flood)
+                ctl = await ctl_state()
+                scale_outs = ctl.get("counters", {}).get(
+                    "scale_outs", 0)
+                if scale_outs >= 1:
+                    break
+                await asyncio.sleep(1.6)  # let a window close
+
+            xla1 = (await _get_state(s, rep_b.url)).get(
+                "xla_compiles", 0)
+            ctl = await ctl_state()
+            snap = await (await s.get(gw + "/fleet/state")).json()
+        return {
+            "fleet_ctl_arrivals": sum(outcomes.values()),
+            "fleet_ctl_complete": outcomes["complete"],
+            "fleet_ctl_typed_errors": outcomes["typed_error"],
+            # the acceptance criterion: zero torn/hung streams — every
+            # client saw a complete stream or a typed error event
+            "fleet_ctl_dropped_streams": outcomes["torn"],
+            "fleet_ctl_goodput_pre": round(goodput_pre, 4),
+            "fleet_ctl_goodput_event": round(goodput_event, 4),
+            "fleet_ctl_recovery_s": round(recovery_s, 2),
+            "fleet_ctl_recovered": recovery_s >= 0,
+            "fleet_ctl_failovers": failovers,
+            "fleet_ctl_scale_outs": scale_outs,
+            "fleet_ctl_launch_failures": launched,
+            "fleet_ctl_replicas_live": len(
+                ctl.get("replicas_live") or ()),
+            "fleet_ctl_lifecycle_events": len(ctl.get("events") or ()),
+            "fleet_ctl_survivor_hot_compiles": int(xla1 - xla0),
+            "fleet_ctl_fleet_up": snap.get("fleet", {}).get(
+                "replicas_up", 0),
+        }
+
+    try:
+        return asyncio.run(run())
+    finally:
+        stop_gw()  # gateway cleanup terminates launcher-owned children
+        rep_a.kill9()
+        rep_b.term(timeout=30)
 
 
 async def _disagg_migrate_once(s, url_a: str, url_b: str, model: str,
@@ -2865,6 +3135,11 @@ def run_cpu_ratio() -> dict:
     except Exception as e:
         print(f"decode_fused leg failed: {type(e).__name__}: {e}",
               file=sys.stderr)
+    try:
+        res.update(fleet_ctl_numbers())
+    except Exception as e:
+        print(f"fleet_ctl leg failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
     return res
 
 
@@ -3039,12 +3314,26 @@ def main() -> None:
                 "fused child (bytes/token ≤ 0.55x bf16 and greedy "
                 "agreement vs the native child are the capacity/"
                 "quality signals)")
+        elif target == "fleet_ctl":
+            result = fleet_ctl_numbers()
+            result["metric"] = (
+                "fleet_ctl chaos A/B — the fleet control plane (ISSUE "
+                "14) under injected churn: the seeded open-loop trace "
+                "over a controller-enabled 2-replica pool with one "
+                "kill -9 mid-decode (failover: re-route + replacement "
+                "launch through the local launcher) and one flood-"
+                "triggered scale-out (the SLO monitor's sustained-"
+                "overshoot predicate); dropped (torn) streams == 0, "
+                "goodput recovery ≥0.9× the pre-event window in a "
+                "bounded reported time, and zero hot XLA compiles on "
+                "the surviving replica are the claims (CPU backend)")
         else:
             print(json.dumps({"error": f"unknown --ab target {target!r}; "
                               "supported: prefix_cache, spec_decode, "
                               "ragged_prefill, lora, disagg, "
                               "slo_routing, structured, mesh, "
-                              "kv_tier, fleet_obs, decode_fused"}))
+                              "kv_tier, fleet_obs, decode_fused, "
+                              "fleet_ctl"}))
             return
         print(json.dumps(result))
         return

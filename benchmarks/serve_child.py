@@ -93,6 +93,15 @@ def main() -> None:
     if os.environ.get("AIGW_TTFT_TRACE"):
         _install_trace(os.environ["AIGW_TTFT_TRACE"])
 
+    # chaos injection (tools/chaos.py): a slow-start replica stalls
+    # here — the launcher and controller must tolerate a child that
+    # takes arbitrarily long to report its port
+    slow = float(os.environ.get("AIGW_CHAOS_SLOW_START_S", "0") or 0)
+    if slow > 0:
+        import time
+
+        time.sleep(slow)
+
     spec = json.loads(sys.argv[1])
     cfg = llama.LlamaConfig(**spec["cfg"])
     register_model(ModelSpec(spec["model"], "llama", cfg))
@@ -161,7 +170,16 @@ def main() -> None:
         await site.start()
         port = site._server.sockets[0].getsockname()[1]
         print(f"SERVE_PORT={port}", flush=True)
-        await asyncio.Event().wait()
+        # graceful shutdown (ISSUE 14): SIGTERM/SIGINT drains — refuse
+        # new admissions with 503, let live slots finish or migrate —
+        # then exits 0 with zero live slots; a second signal skips the
+        # drain. kill -9 stays the chaos harness's crash injection.
+        stop = asyncio.Event()
+        server.install_signal_drain(
+            stop, grace_s=float(os.environ.get(
+                "AIGW_DRAIN_GRACE_S", "60") or 60))
+        await stop.wait()
+        await runner.cleanup()
 
     asyncio.run(run())
 

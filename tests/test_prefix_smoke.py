@@ -802,6 +802,9 @@ FLEETOBS_STATE_FIELDS = (
     "started_at",
     "uptime_s",
     "ttft_hist_buckets",
+    # graceful drain (ISSUE 14): the fleet health machine's
+    # control-plane overlay — losing it breaks lossless drain
+    "draining",
 )
 
 

@@ -88,6 +88,32 @@ def render_table(snapshot: dict) -> str:
             f" | fleet burn {_fmt(slo.get('burn_rate'))}"
             + (" ** SUSTAINED SLO OVERSHOOT **"
                if slo.get("sustained_overshoot") else ""))
+        ctl = b.get("controller")
+        if ctl:
+            # fleet control plane (ISSUE 14): scaling decisions, drains
+            # in progress, and the last lifecycle actions
+            c = ctl.get("counters") or {}
+            lines.append(
+                f"  controller [{ctl.get('min_replicas', '?')}.."
+                f"{ctl.get('max_replicas', '?')}]"
+                f" live {len(ctl.get('replicas_live') or ())}"
+                f" | out {c.get('scale_outs', 0)}"
+                f" in {c.get('scale_ins', 0)}"
+                f" drains {c.get('drains', 0)}"
+                f" failovers {c.get('failovers', 0)}"
+                f" launch-fail {c.get('launch_failures', 0)}"
+                + (f" | launching {ctl.get('launches_in_flight')}"
+                   if ctl.get("launches_in_flight") else "")
+                + (f" | DRAINING {', '.join(ctl['drains_in_progress'])}"
+                   if ctl.get("drains_in_progress") else ""))
+            for ev in list(ctl.get("events") or ())[-3:]:
+                lines.append(
+                    "    "
+                    + time.strftime("%H:%M:%S",
+                                    time.localtime(ev.get("ts", 0)))
+                    + f" {ev.get('action', '?')}"
+                    + (f" {ev['replica']}" if ev.get("replica") else "")
+                    + (f" ({ev['reason']})" if ev.get("reason") else ""))
         lines.append("")
     lines.append(
         f"decisions recorded: {snapshot.get('decisions_recorded', 0)}")
