@@ -1,7 +1,15 @@
 # Developer entry points (tests force the CPU fake-chip platform through
 # tests/conftest.py; bench runs on the real TPU).
 
-.PHONY: test test-fast native bench gateway-bench tpu-capture chaos docs dist clean
+.PHONY: test test-fast native bench gateway-bench tpu-capture chaos docs dist clean lint
+
+# aigw-check (ISSUE 15): the invariant lint suite — jit-surface
+# registry, engine-thread discipline, async-blocking, determinism, and
+# gauge/state drift — over the whole package. Exit 1 on any
+# unsuppressed finding; tests/test_staticcheck.py runs the same gate
+# in tier-1. See docs/development.md for the rule catalog.
+lint:
+	env JAX_PLATFORMS=cpu python tools/staticcheck.py
 
 test: native
 	python -m pytest tests/ -q
@@ -30,8 +38,11 @@ tpu-capture:
 # chaos matrix — controller predicates/hysteresis, drain routing,
 # breaker unification, pre-first-byte failover — against stub replicas.
 # The kill -9 / drain-retire rigs over real engines are the slow tier.
+# AIGW_TSAN=1: the engine-thread sanitizer is asserted on under churn —
+# a thread-discipline violation fails the chaos run loudly instead of
+# corrupting streams silently (ISSUE 15).
 chaos:
-	env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet_controller.py -q -m 'not slow' -p no:cacheprovider
+	env JAX_PLATFORMS=cpu AIGW_TSAN=1 python -m pytest tests/test_fleet_controller.py -q -m 'not slow' -p no:cacheprovider
 
 docs:
 	python docs/build_site.py

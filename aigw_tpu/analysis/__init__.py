@@ -1,0 +1,19 @@
+"""aigw-check: in-tree static analysis for the serving stack's
+correctness rules (ISSUE 15).
+
+Import-light on purpose: the engine imports
+``aigw_tpu.analysis.registry`` (the ``@engine_thread_only`` sanitizer)
+on its construction path, so this package root must not pull in the
+pass machinery or obs/metrics. Reach the framework explicitly:
+
+    from aigw_tpu.analysis.core import run_checks
+    from aigw_tpu.analysis import manifest
+"""
+
+from aigw_tpu.analysis.registry import (  # noqa: F401
+    DEFAULT_CONFIG,
+    AnalysisConfig,
+    EngineThreadViolation,
+    ThreadDomain,
+    engine_thread_only,
+)

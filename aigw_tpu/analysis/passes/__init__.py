@@ -1,0 +1,19 @@
+"""The aigw-check pass set — one module per invariant (ISSUE 15)."""
+
+from aigw_tpu.analysis.passes import (
+    async_blocking,
+    determinism,
+    gauge_drift,
+    jit_registry,
+    thread_discipline,
+)
+
+ALL_PASSES = (
+    jit_registry,
+    thread_discipline,
+    async_blocking,
+    determinism,
+    gauge_drift,
+)
+
+RULES = tuple(m.RULE for m in ALL_PASSES)

@@ -1,0 +1,191 @@
+"""Rule ``jit-registry``: no unwarmable programs on the serving path.
+
+Every ``jax.jit`` / ``pjit`` / ``shard_map`` construction inside the
+serving modules must be accounted for by the compile tripwire surface:
+either it flows into a ``CompileTracker.register(...)`` call at the
+construction site (directly, or via a local/attribute the same scope
+registers), or it is declared in ``analysis.registry.JIT_WARM_SURFACE``
+with the reason it is warmed anyway (module-level Pallas kernels
+dispatched inside already-registered programs, factories whose caller
+registers the result). A jitted callable that is neither is the PR 6
+capped-rung bug class: a program warmup() cannot see, paying its XLA
+compile on the hot path the first time traffic reaches it.
+
+Stale ``JIT_WARM_SURFACE`` keys are also findings — a renamed kernel
+cannot leave a dangling exemption.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from aigw_tpu.analysis.core import (
+    Finding,
+    Source,
+    build_parents,
+    dotted_name,
+    iter_functions,
+)
+from aigw_tpu.analysis.registry import AnalysisConfig
+
+RULE = "jit-registry"
+
+_JIT_HEADS = {"jit", "pjit", "shard_map"}
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    name = dotted_name(node)
+    if not name:
+        return False
+    head = name.rsplit(".", 1)[-1]
+    if head not in _JIT_HEADS:
+        return False
+    # bare Name('jit') only counts when it plausibly IS jax.jit; the
+    # dotted forms (jax.jit, pjit.pjit, …) always count
+    return True
+
+
+def _in_register_call(node: ast.AST,
+                      parents: dict[ast.AST, ast.AST]) -> bool:
+    cur = parents.get(node)
+    while cur is not None:
+        if (isinstance(cur, ast.Call)
+                and isinstance(cur.func, ast.Attribute)
+                and cur.func.attr == "register"):
+            return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Module)):
+            return False
+        cur = parents.get(cur)
+    return False
+
+
+def _assign_target(node: ast.AST,
+                   parents: dict[ast.AST, ast.AST]) -> str | None:
+    """Dotted repr of the single assignment target whose value chain
+    contains ``node`` ('self._prefill_sp_fn', 'fn'), else None."""
+    cur: ast.AST | None = node
+    while cur is not None and not isinstance(cur, ast.stmt):
+        cur = parents.get(cur)
+    if isinstance(cur, ast.Assign) and len(cur.targets) == 1:
+        return dotted_name(cur.targets[0]) or None
+    return None
+
+
+def _scope_registers(scope: ast.AST, target: str) -> bool:
+    """True when ``scope`` contains ``<x>.register(..., <target>, ...)``."""
+    for node in ast.walk(scope):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "register"):
+            for arg in node.args:
+                if dotted_name(arg) == target:
+                    return True
+    return False
+
+
+def _enclosing_scope(node: ast.AST, parents: dict[ast.AST, ast.AST],
+                     qual_of: dict[ast.AST, str]):
+    """(qualname, scope node) of the innermost function holding
+    ``node`` — ('', module) at top level."""
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return qual_of.get(cur, cur.name), cur
+        cur = parents.get(cur)
+    return "", None
+
+
+def check(sources: list[Source], config: AnalysisConfig) -> list[Finding]:
+    out: list[Finding] = []
+    seen_keys: set[str] = set()
+    scoped = [s for s in sources
+              if any(s.rel == p or s.rel.startswith(p)
+                     for p in config.jit_scope)]
+    for src in scoped:
+        parents = build_parents(src.tree)
+        qual_of = {node: q for q, node in iter_functions(src.tree)}
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.Attribute, ast.Name)):
+                continue
+            # only the ROOT of a dotted chain (skip 'jax' inside jax.jit)
+            if isinstance(parents.get(node), ast.Attribute):
+                continue
+            if not _is_jit_ref(node):
+                continue
+            if not isinstance(node, ast.Attribute):
+                # bare names: accept only known imported constructors
+                if node.id not in _JIT_HEADS:
+                    continue
+            qual, scope = _enclosing_scope(node, parents, qual_of)
+
+            # decorator usage (@functools.partial(jax.jit, …) or
+            # @jax.jit): the jit surface IS the decorated function
+            dec_parent = parents.get(node)
+            decorated = None
+            probe: ast.AST | None = node
+            while probe is not None and not isinstance(probe, ast.stmt):
+                nxt = parents.get(probe)
+                if (isinstance(nxt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))
+                        and probe in nxt.decorator_list):
+                    decorated = nxt
+                    break
+                probe = nxt
+            if decorated is not None:
+                key = f"{src.rel}::{qual_of.get(decorated, decorated.name)}"
+                if key in config.jit_warm_surface:
+                    seen_keys.add(key)
+                    continue
+                out.append(Finding(
+                    RULE, src.rel, decorated.lineno,
+                    f"jit-decorated callable "
+                    f"{qual_of.get(decorated, decorated.name)!r} is not "
+                    "in JIT_WARM_SURFACE — an unwarmable program "
+                    "compiles on the hot path (PR 6 capped-rung class); "
+                    "register it with the CompileTracker or declare how "
+                    "it is warmed in analysis/registry.py"))
+                continue
+
+            # call usage: jax.jit(...) somewhere in an expression
+            if not (isinstance(dec_parent, ast.Call)
+                    and dec_parent.func is node):
+                # a bare reference (e.g. functools.partial(jax.jit, …)
+                # in expression position): treat the surrounding call
+                # as the site
+                site = dec_parent if isinstance(dec_parent, ast.Call) \
+                    else node
+            else:
+                site = dec_parent
+            if _in_register_call(site, parents):
+                continue
+            target = _assign_target(site, parents)
+            if target is not None and scope is not None \
+                    and _scope_registers(scope, target):
+                continue
+            if target is not None and scope is None \
+                    and _scope_registers(src.tree, target):
+                continue
+            key = f"{src.rel}::{qual}" if qual else f"{src.rel}::<module>"
+            if key in config.jit_warm_surface:
+                seen_keys.add(key)
+                continue
+            out.append(Finding(
+                RULE, src.rel, node.lineno,
+                f"jit/pjit/shard_map constructed in {qual or '<module>'} "
+                "without flowing into CompileTracker.register() and "
+                "without a JIT_WARM_SURFACE declaration — unwarmable "
+                "program (hot-path compile, the PR 6 bug class)"))
+
+    # stale registry entries for files actually under check
+    checked = {s.rel for s in scoped}
+    for key in config.jit_warm_surface:
+        rel = key.split("::", 1)[0]
+        if rel in checked and key not in seen_keys:
+            src = next(s for s in scoped if s.rel == rel)
+            out.append(Finding(
+                RULE, src.rel, 1,
+                f"JIT_WARM_SURFACE entry {key!r} matches no jit site — "
+                "stale registry entry (renamed/deleted callable); "
+                "remove it from analysis/registry.py"))
+    return out

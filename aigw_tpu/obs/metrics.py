@@ -101,7 +101,11 @@ class GenAIMetrics:
 
 #: EngineStats attribute → Prometheus gauge name. One authoritative map
 #: so tpuserve's /metrics, dashboards, and tests agree on the exported
-#: serving-path surface — including the adaptive decode window
+#: serving-path surface. The /state twin of this contract is generated
+#: in analysis/manifest.py (STATE_ONLY/METRICS_ONLY exemptions) and
+#: enforced statically by the ``gauge-drift`` lint pass + the tier-1
+#: drift smokes — adding an attr here without exporting it on /state
+#: requires a METRICS_ONLY entry there. Including the adaptive decode window
 #: (tpuserve_decode_window_steps: the K most recently dispatched, with
 #: shrink/grow transition counters) and the phase breakdown
 #: (prefill/transfer/emit milliseconds) behind TTFT regressions.

@@ -190,7 +190,11 @@ class AdapterStore:
 
         # donate the stack: a load writes one row in place instead of
         # copying [n_slots+1, ...]; the dynamic row index keeps it ONE
-        # compiled program per tensor shape for any destination row
+        # compiled program per tensor shape for any destination row.
+        # Factory only — the Engine registers the returned callable
+        # with its CompileTracker ("adapter_load") and warm() compiles
+        # it; declared in analysis/registry.py JIT_WARM_SURFACE (rule
+        # jit-registry).
         return jax.jit(_set_row, donate_argnums=(0,))
 
     def _load(self, row: int, name: str) -> None:

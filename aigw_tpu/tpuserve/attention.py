@@ -75,7 +75,16 @@ class GroupResult:
 
 
 class AttentionBackend:
-    """Owns the engine's prefill programs and their geometry policy."""
+    """Owns the engine's prefill programs and their geometry policy.
+
+    Compile discipline (rule ``jit-registry``, make lint): any jitted
+    program a backend constructs must flow into the engine's
+    ``compile_tracker.register(...)`` so ``warm()`` and the
+    zero-hot-compile tripwires see it — an unregistered program is an
+    unwarmable one (the PR 6 capped-rung bug class). Module-level
+    Pallas kernels a backend dispatches are declared in
+    ``analysis/registry.py::JIT_WARM_SURFACE`` instead.
+    """
 
     name = "base"
     #: True when the batched-admission path may take prompts longer

@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from aigw_tpu.analysis.registry import engine_thread_only
 from aigw_tpu.models import kvq, llama
 from aigw_tpu.obs.metrics import EnginePhases
 from aigw_tpu.obs.xla_events import CompileTracker
@@ -1584,6 +1585,7 @@ class Engine:
             self.kv_cache, jnp.asarray(pages), stacked)
 
     # -- KV memory hierarchy: host spill tier + fleet fetch (ISSUE 11) ----
+    @engine_thread_only
     def _spill_page(self, key: bytes, page: int) -> None:
         """Spill sink wired into PrefixCache eviction: copy the
         about-to-be-reclaimed page's K/V rows device→host and park them
@@ -1598,6 +1600,7 @@ class Engine:
         self._start_host_copy([rows])
         self.host_tier.put(key, kvq.page_to_host(rows))
 
+    @engine_thread_only
     def _revive_chain(self, chain_keys: list) -> int:
         """Promote the longest spilled run extending the resident
         prefix back into the pool: allocate pages, scatter the host
@@ -1662,6 +1665,7 @@ class Engine:
     #: digest size bound: a replica advertises at most this many chains
     KV_DIGEST_MAX = 4096
 
+    @engine_thread_only
     def _refresh_kv_digest(self) -> None:
         """Engine-thread digest rebuild (throttled by _refresh_stats):
         the only thread that mutates _by_key and the host tier's key
@@ -1698,6 +1702,7 @@ class Engine:
             raise MigrationError(box["error"])
         return box["result"]
 
+    @engine_thread_only
     def _do_fetch(self, keys: list) -> list:
         if self.prefix_cache is None:
             return []
@@ -1803,6 +1808,7 @@ class Engine:
         kmin = min(self.cfg.min_decode_steps_per_tick, K)
         return [K] if kmin == K else [kmin, K]
 
+    @engine_thread_only
     def _choose_window(self) -> int:
         """Adaptive decode window: shrink to the small program while
         latency matters (requests waiting for admission, or a stream so
@@ -2013,6 +2019,7 @@ class Engine:
             raise MigrationError(box["error"])
         return box["result"]
 
+    @engine_thread_only
     def _process_migrations(self) -> None:
         """Run queued export/import jobs on the engine thread (the only
         thread allowed to touch kv_cache's donation chain and the slot
@@ -2035,6 +2042,7 @@ class Engine:
             finally:
                 box["evt"].set()
 
+    @engine_thread_only
     def _do_export(self, req: GenRequest) -> dict:
         """Engine-thread half of migrate_export. Wire rule: only COMPLETE
         pages whose every row is written KV travel — k = (m-1) // page
@@ -2127,6 +2135,7 @@ class Engine:
                     len(pages))
         return {"blob": blob, "data": data}
 
+    @engine_thread_only
     def _do_import(self, tokens: list[int],
                    pages_data: list[np.ndarray], start: int = 0,
                    source: str = "migration") -> int:
@@ -2217,6 +2226,7 @@ class Engine:
             pass
         logger.info("engine loop stopped")
 
+    @engine_thread_only
     def _abort_all(self, reason: str) -> None:
         if self._inflight is not None:
             # the in-flight window's captured frees must not leak pages
@@ -2248,6 +2258,7 @@ class Engine:
         except queue.Empty:
             pass
 
+    @engine_thread_only
     def _reap_cancelled(self) -> None:
         for i, s in enumerate(self._slots):
             if s is not None and s.req.cancelled.is_set():
@@ -2264,6 +2275,7 @@ class Engine:
                 return i
         return None
 
+    @engine_thread_only
     def _admit(self) -> bool:
         """Admit queued requests: prefill + first token.
 
@@ -2468,6 +2480,7 @@ class Engine:
             return False, chain
         return True, chain
 
+    @engine_thread_only
     def _admit_batch(
         self, reqs: list[GenRequest], chain_by_req: dict[int, list],
     ) -> tuple[int, list[GenRequest] | None]:
@@ -2542,6 +2555,7 @@ class Engine:
             count = len(results)
         return count, leftover
 
+    @engine_thread_only
     def _mark_admitted(self, i: int) -> None:
         """Mark slot i for an incremental row upload into the live
         device state — including its speculation history/lookahead
@@ -2555,6 +2569,7 @@ class Engine:
                 and self._decode_bucket_pages() > self._state_bucket):
             self._need_rebuild = True
 
+    @engine_thread_only
     def _admit_one(self, req: GenRequest, chain: list | None = None) -> str:
         """Per-request admission (prefix-cache adoption, chunked and
         sequence-parallel prefills, adapter errors). Returns "admitted",
@@ -2891,10 +2906,16 @@ class Engine:
         growth, speculation). Ordinary membership changes go through
         the incremental row update in _apply_row_updates instead.
         ``bucket`` pins the page-table width (warmup pre-compiling the
-        ladder at buckets traffic hasn't reached yet)."""
+        ladder at buckets traffic hasn't reached yet).
+
+        PURE builder — it must not publish anything through self:
+        warmup() calls it from the server thread while the engine loop
+        is live, and a side-effecting write here (this method used to
+        set self._state_bucket) raced _mark_admitted's bucket-growth
+        check into skipping a rebuild the live batch needed. The
+        engine-thread caller in _decode_tick records the bucket."""
         B = self.cfg.max_batch_size
         P = bucket if bucket is not None else self._decode_bucket_pages()
-        self._state_bucket = P
         tokens = np.zeros((B,), np.int32)
         positions = np.zeros((B,), np.int32)
         limits = np.zeros((B,), np.int32)
@@ -3068,6 +3089,7 @@ class Engine:
                 "row_update", jax.jit(_upd, donate_argnums=(0,)))
         return self._row_update_fn
 
+    @engine_thread_only
     def _apply_row_updates(self) -> None:
         """Scatter dirty slot rows into the LIVE device state — no
         pipeline drain, no full re-upload. JAX chains the update after
@@ -3091,6 +3113,7 @@ class Engine:
                 "spec_row_update", jax.jit(_sup, donate_argnums=(0,)))
         return self._spec_update_fn
 
+    @engine_thread_only
     def _apply_spec_row_updates(self) -> None:
         """Patch live slots' on-device ``draft_len`` after an adaptive
         rung move. Unlike the full row update this touches ONLY the
@@ -3131,6 +3154,7 @@ class Engine:
                 "cn_mask_update", jax.jit(_bup, donate_argnums=(0,)))
         return self._cn_update_fn
 
+    @engine_thread_only
     def _apply_cn_row_updates(self) -> None:
         """Patch live constrained slots' on-device bias rows after an
         FSM advance. Like the draft_len patch, the bias row is
@@ -3147,6 +3171,7 @@ class Engine:
             self.stats.constraint_mask_updates += 1
         self._cn_dirty.clear()
 
+    @engine_thread_only
     def _cn_verify(self, i: int, s: _Slot, tok: int,
                    dispatch_mask) -> bool:
         """Verify + advance slot i's constraint FSM with ``tok``, which
@@ -3180,6 +3205,7 @@ class Engine:
         self._cn_rollback(i, s)
         return False
 
+    @engine_thread_only
     def _cn_rollback(self, i: int, s: _Slot) -> None:
         s.cn_epoch += 1
         self._dirty_rows.add(i)
@@ -3200,6 +3226,7 @@ class Engine:
         return speculation.DraftController(
             self._spec_rungs, self._accept_prior, self.cfg.spec_adaptive)
 
+    @engine_thread_only
     def _choose_draft_len(self) -> int:
         """Dispatch draft width: the max of the active eligible slots'
         adaptive rungs. 0 dispatches the PLAIN decode program —
@@ -3223,6 +3250,7 @@ class Engine:
         self.stats.spec_draft_len = d
         return d
 
+    @engine_thread_only
     def _process_window(self, toks: np.ndarray, lp,
                         members: tuple,
                         cn_epochs: dict | None = None) -> None:
@@ -3258,6 +3286,7 @@ class Engine:
                     )
                 self._emit_token(i, int(toks[k, i]), step_lp)
 
+    @engine_thread_only
     def _process_spec_window(self, toks: np.ndarray, counts: np.ndarray,
                              props: np.ndarray, members: tuple,
                              draft_lens: tuple = (),
@@ -3324,6 +3353,7 @@ class Engine:
                 if i not in self._dirty_rows:
                     self._spec_dirty.add(i)
 
+    @engine_thread_only
     def _drain_inflight(self) -> None:
         """Settle the in-flight window: resolve its (already started,
         under async_transfers) device→host copy, emit tokens, and apply
@@ -3357,6 +3387,7 @@ class Engine:
         for seq_id in w.frees:
             self.allocator.free(seq_id)
 
+    @engine_thread_only
     def _apply_frees(self) -> None:
         """Recycle pages of finished sequences. Only safe with NO window
         in flight (callers drain first): an in-flight window dispatched
@@ -3366,6 +3397,7 @@ class Engine:
             self.allocator.free(seq_id)
         self._pending_frees.clear()
 
+    @engine_thread_only
     def _decode_tick(self) -> bool:
         """Pipelined: dispatch window N+1, then process window N while
         the device runs. Membership changes are scattered into the live
@@ -3412,7 +3444,9 @@ class Engine:
                 self.stats.active_slots = 0
                 self._refresh_stats()
                 return True
-            self._device_state = self._build_device_state()
+            P = self._decode_bucket_pages()
+            self._device_state = self._build_device_state(bucket=P)
+            self._state_bucket = P
             self._need_rebuild = False
             self._dirty_rows.clear()
             self._spec_dirty.clear()
@@ -3497,6 +3531,7 @@ class Engine:
         self._refresh_stats()
         return True
 
+    @engine_thread_only
     def _emit_token(self, i: int, tok: int, lp=None) -> None:
         """Record one generated token for slot i; finish if stopping.
         ``lp`` = (chosen_logprob, [(top_id, top_logprob)]) when the
@@ -3554,6 +3589,7 @@ class Engine:
             s.token_counts[tok] = s.token_counts.get(tok, 0) + 1
             s.gen_tokens.append(tok)
 
+    @engine_thread_only
     def _refresh_stats(self) -> None:
         self.stats.queued = self._queue.qsize()
         if self.stats.prefill_tokens_padded:

@@ -1705,7 +1705,14 @@ class TPUServeServer:
 
     async def _state(self, _request: web.Request) -> web.Response:
         """Endpoint-picker telemetry (KV occupancy, queue depth, and the
-        queue-latency / adaptive-window signals the picker scores)."""
+        queue-latency / adaptive-window signals the picker scores).
+
+        Drift contract (rule ``gauge-drift``, make lint): every literal
+        key below must be an ENGINE_GAUGES attr or carry a STATE_ONLY
+        exemption in analysis/manifest.py, and every non-exempt gauge
+        attr must appear here — keep new fields literal string keys so
+        the static pass sees them (** spreads carry only the dynamic
+        topology surface)."""
         s = self.engine.stats
         store = self.adapter_store
         tenant_slots = self.engine._tenant_slots()

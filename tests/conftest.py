@@ -13,6 +13,15 @@ the real chip and the suite takes minutes instead of seconds.
 
 import os
 
+# Engine-thread sanitizer (ISSUE 15, aigw_tpu/analysis/registry.py):
+# every @engine_thread_only method asserts it runs on the owning engine
+# thread whenever that thread is live. On for the WHOLE suite — the f32
+# rigs prove the checks don't perturb byte-identity or the zero-hot-
+# compile tripwires, and the chaos/churn tests get thread-discipline
+# violations as loud failures instead of corrupted streams. Must be set
+# before aigw_tpu imports (the flag is read once at import).
+os.environ.setdefault("AIGW_TSAN", "1")
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -314,12 +314,14 @@ class TestEngineAdapterServing:
             for ad in ("ad0", "ad1", "ad2"):
                 again, _ = _generate(eng, [3, 1, 4, 1, 5], adapter=ad)
                 assert again == first[ad], f"{ad} changed after reload"
-            eng._refresh_stats()
-            assert eng.stats.adapter_loads == store.loads >= 4
-            assert eng.stats.adapter_evictions == store.evictions
-            store.check_invariants()
         finally:
             eng.stop()
+        # stats refresh is engine-thread-only (AIGW_TSAN asserts on
+        # it): refresh after the loop has joined — counters survive
+        eng._refresh_stats()
+        assert eng.stats.adapter_loads == store.loads >= 4
+        assert eng.stats.adapter_evictions == store.evictions
+        store.check_invariants()
 
     def test_unknown_adapter_errors_capacity_waits(self):
         store = _store(1, 2)

@@ -208,15 +208,18 @@ class TestSpillRevive:
             _run(eng, shared + [9, 9])
             for i in range(14):
                 _run(eng, [10 + i] * 48 + [1], mt=2)
-            eng._refresh_kv_digest()
-            digest = set(eng.kv_chain_digest())
-            spilled = {k.hex() for k in eng.host_tier.keys()}
-            resident = {k.hex()
-                        for k in eng.prefix_cache._by_key.keys()}
-            assert spilled and spilled <= digest
-            assert resident <= digest
         finally:
             eng.stop()
+        # the digest rebuild is engine-thread-only (AIGW_TSAN asserts
+        # on it) — refresh after the loop has joined, exactly like the
+        # stop()→_abort_all path; cache + host tier survive stop()
+        eng._refresh_kv_digest()
+        digest = set(eng.kv_chain_digest())
+        spilled = {k.hex() for k in eng.host_tier.keys()}
+        resident = {k.hex()
+                    for k in eng.prefix_cache._by_key.keys()}
+        assert spilled and spilled <= digest
+        assert resident <= digest
 
 
 def _start_server(kv_host_bytes: int = 1 << 24):

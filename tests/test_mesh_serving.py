@@ -23,6 +23,7 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -174,9 +175,15 @@ def test_param_and_kv_bytes_split_across_devices(pair):
     single_total = sum(single.param_bytes_by_device.values())
     assert len(single.param_bytes_by_device) == 1
     assert 0 <= total - single_total < 0.05 * single_total
-    # the per-device /state map carries the KV pool split too
+    # the per-device /state map carries the KV pool split too. The
+    # stats refresh is engine-thread-only (AIGW_TSAN asserts on it)
+    # and the fixture engine is live: defeat the memory-poll throttle
+    # and let the idle engine loop (which refreshes every tick) pick
+    # it up instead of forcing a cross-thread refresh.
     mesh._mem_next = 0.0
-    mesh._refresh_stats()
+    deadline = time.monotonic() + 10
+    while not mesh.device_stats and time.monotonic() < deadline:
+        time.sleep(0.05)
     devs = mesh.device_stats
     assert len(devs) == 8
     kv = {d["kv_pool_bytes"] for d in devs}

@@ -19,57 +19,22 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+from aigw_tpu.analysis import manifest
 from aigw_tpu.models import llama
 from aigw_tpu.obs.metrics import ENGINE_GAUGES
 from aigw_tpu.tpuserve.engine import Engine, EngineConfig, GenRequest
 from aigw_tpu.tpuserve.sampling import SamplingParams
 from aigw_tpu.tpuserve.server import TPUServeServer
 
-PREFIX_STATE_FIELDS = (
-    "prefix_cache_hit_rate",
-    "prefix_pages_resident",
-    "prefix_pages_pinned",
-    "prefix_bytes_pinned",
-    "prefix_cache_hits",
-    "prefix_cache_misses",
-    "prefix_cache_evictions",
-)
+PREFIX_STATE_FIELDS = manifest.state_fields("prefix")
 
-PREFIX_GAUGES = (
-    "tpuserve_prefix_cache_hits_total",
-    "tpuserve_prefix_cache_misses_total",
-    "tpuserve_prefix_cache_evictions_total",
-    "tpuserve_prefix_full_hits_total",
-    "tpuserve_prefix_cow_copies_total",
-    "tpuserve_prefix_pages_resident",
-    "tpuserve_prefix_pages_pinned",
-    "tpuserve_prefix_cache_hit_rate",
-    "tpuserve_prefix_tokens_reused_total",
-)
+PREFIX_GAUGES = manifest.gauge_names("prefix")
 
 # speculative-decoding surface (ISSUE 4): a renamed EngineStats field
 # must not silently drop a dashboard signal or the bench A/B's inputs
-SPEC_STATE_FIELDS = (
-    "spec_accepted",
-    "spec_drafted",
-    "spec_accept_rate",
-    "spec_draft_len",
-    "spec_rung_ups",
-    "spec_rung_downs",
-    "spec_lookahead_slots",
-    "state_rebuilds",
-)
+SPEC_STATE_FIELDS = manifest.state_fields("spec")
 
-SPEC_GAUGES = (
-    "tpuserve_spec_accepted_total",
-    "tpuserve_spec_drafted_tokens_total",
-    "tpuserve_spec_accept_rate",
-    "tpuserve_spec_draft_len",
-    "tpuserve_spec_rung_ups_total",
-    "tpuserve_spec_rung_downs_total",
-    "tpuserve_spec_lookahead_slots_total",
-    "tpuserve_state_rebuilds_total",
-)
+SPEC_GAUGES = manifest.gauge_names("spec")
 
 
 @pytest.fixture(scope="module")
@@ -312,49 +277,16 @@ def test_spec_verify_ladder_warm_no_hot_compiles():
 
 # -- ragged attention backend (ISSUE 6) ----------------------------------
 
-RAGGED_STATE_FIELDS = (
-    "attention_backend",
-    "prefill_tokens_real",
-    "prefill_tokens_padded",
-    "prefill_padded_frac",
-    "warmup_ms",
-    "warm_programs",
-)
+RAGGED_STATE_FIELDS = manifest.state_fields("ragged")
 
-RAGGED_GAUGES = (
-    "tpuserve_prefill_tokens_real_total",
-    "tpuserve_prefill_tokens_padded_total",
-    "tpuserve_prefill_padded_frac",
-    "tpuserve_warmup_ms",
-    "tpuserve_warm_programs",
-)
+RAGGED_GAUGES = manifest.gauge_names("ragged")
 
 
 # -- adapter serving + tenancy (ISSUE 7) ---------------------------------
 
-ADAPTER_STATE_FIELDS = (
-    "adapters_registered",
-    "adapters_resident",
-    "adapter_rows",
-    "adapter_loads",
-    "adapter_evictions",
-    "adapter_slots",
-    "tenant_slots",
-    "tenants_active",
-    "tenant_max_slots",
-    "tenant_deferrals",
-    "tenant_slot_cap",
-)
+ADAPTER_STATE_FIELDS = manifest.state_fields("adapter")
 
-ADAPTER_GAUGES = (
-    "tpuserve_adapter_loads_total",
-    "tpuserve_adapter_evictions_total",
-    "tpuserve_adapter_resident",
-    "tpuserve_adapter_slots",
-    "tpuserve_tenants_active",
-    "tpuserve_tenant_max_slots",
-    "tpuserve_tenant_deferrals_total",
-)
+ADAPTER_GAUGES = manifest.gauge_names("adapter")
 
 
 def test_state_and_metrics_export_adapter_gauges(smoke_url):
@@ -492,21 +424,9 @@ def test_ragged_backend_zero_hot_compiles_any_geometry():
 # prefill/decode disaggregation surface (ISSUE 8): a renamed field here
 # silently breaks the gateway's migration orchestrator (polls
 # migratable_slots) or the bench --ab disagg leg (reads the counters)
-MIGRATION_STATE_FIELDS = (
-    "migrations_out",
-    "migrations_in",
-    "migration_pages_out",
-    "migration_pages_in",
-    "migratable_slots",
-)
+MIGRATION_STATE_FIELDS = manifest.state_fields("migration")
 
-MIGRATION_GAUGES = (
-    "tpuserve_migrations_out_total",
-    "tpuserve_migrations_in_total",
-    "tpuserve_migration_pages_out_total",
-    "tpuserve_migration_pages_in_total",
-    "tpuserve_migratable_slots",
-)
+MIGRATION_GAUGES = manifest.gauge_names("migration")
 
 
 def test_state_and_metrics_export_migration_gauges(smoke_url):
@@ -524,50 +444,14 @@ def test_state_and_metrics_export_migration_gauges(smoke_url):
 # silently breaks the bench --ab structured leg (reads the counters),
 # the gateway's capability merge (constrained_decoding/capabilities),
 # or the picker's measured memory signal (device_memory_frac)
-CONSTRAINT_STATE_FIELDS = (
-    "constrained_decoding",
-    "capabilities",
-    "constrained_slots",
-    "constraint_requests",
-    "constraint_rollbacks",
-    "constraint_mask_updates",
-    "constraint_grammars",
-)
+CONSTRAINT_STATE_FIELDS = manifest.state_fields("constraint")
 
-CONSTRAINT_GAUGES = (
-    "tpuserve_constrained_slots",
-    "tpuserve_constraint_requests_total",
-    "tpuserve_constraint_rollbacks_total",
-    "tpuserve_constraint_mask_updates_total",
-    "tpuserve_constraint_grammars",
-)
+CONSTRAINT_GAUGES = manifest.gauge_names("constraint")
 
-MEMORY_STATE_FIELDS = (
-    "device_bytes_in_use",
-    "device_bytes_limit",
-    "device_memory_frac",
-    "kv_pool_bytes",
-    "kv_bytes_in_use",
-    # quantized KV pages + fused decode (ISSUE 13)
-    "kv_quant_bits",
-    "kv_bytes_per_token",
-    "kv_cache_dtype",
-    "decode_backend",
-    "decode_attn_impl",
-    "decode_attn_reason",
-)
+MEMORY_STATE_FIELDS = manifest.state_fields("memory")
 
-MEMORY_GAUGES = (
-    "tpuserve_device_bytes_in_use",
-    "tpuserve_device_bytes_limit",
-    "tpuserve_device_memory_frac",
-    "tpuserve_kv_pool_bytes",
-    "tpuserve_kv_bytes_in_use",
-    "tpuserve_kv_quant_bits",
-    "tpuserve_kv_bytes_per_token",
-    # the resolved decode rung rides /metrics as a labeled info gauge
-    'tpuserve_decode_attn_impl{impl="',
-)
+MEMORY_GAUGES = (manifest.gauge_names("memory")
+                 + manifest.EXTRA_METRICS["memory"])
 
 
 def test_state_and_metrics_export_constraint_gauges(smoke_url):
@@ -623,28 +507,9 @@ def test_state_and_metrics_export_memory_signals(smoke_url):
 # mesh serving surface (ISSUE 10): topology + per-device signals must
 # export even on a single-device replica (empty axes, one device) so
 # the picker's worst-device scoring degrades cleanly off-mesh
-MESH_STATE_FIELDS = (
-    "mesh_axes",
-    "mesh_devices",
-    "devices",
-    "device_count",
-    "device_memory_frac_worst",
-    "param_bytes_total",
-    "param_bytes_per_device",
-    "ici_bytes_per_token",
-    "ici_bytes_total",
-    "attention_backend_reason",
-    "decode_attn_impl",
-    "decode_attn_reason",
-    "migration",
-)
+MESH_STATE_FIELDS = manifest.state_fields("mesh")
 
-MESH_GAUGES = (
-    "tpuserve_device_count",
-    "tpuserve_device_memory_frac_worst",
-    "tpuserve_ici_bytes_per_token",
-    "tpuserve_ici_bytes_total",
-)
+MESH_GAUGES = manifest.gauge_names("mesh")
 
 
 def test_state_and_metrics_export_mesh_signals(smoke_url):
@@ -697,32 +562,9 @@ def test_device_gauges_map_matches_engine_device_stats():
 # KV memory hierarchy surface (ISSUE 11): a renamed field here silently
 # breaks the gateway's fleet index (polls kv_chains), the fleet-fetch
 # presence probe, or the bench --ab kv_tier leg (reads the counters)
-KVTIER_STATE_FIELDS = (
-    "kv_spills",
-    "kv_revives",
-    "kv_spill_evictions",
-    "kv_spilled_pages",
-    "kv_spill_bytes",
-    "kv_host_bytes",
-    "kv_fetches_out",
-    "kv_fetches_in",
-    "kv_fetch_pages_out",
-    "kv_fetch_pages_in",
-    "kv_chains",
-)
+KVTIER_STATE_FIELDS = manifest.state_fields("kvtier")
 
-KVTIER_GAUGES = (
-    "tpuserve_kv_spills_total",
-    "tpuserve_kv_revives_total",
-    "tpuserve_kv_spill_evictions_total",
-    "tpuserve_kv_spilled_pages",
-    "tpuserve_kv_spill_bytes",
-    "tpuserve_kv_host_bytes",
-    "tpuserve_kv_fetches_out_total",
-    "tpuserve_kv_fetches_in_total",
-    "tpuserve_kv_fetch_pages_out_total",
-    "tpuserve_kv_fetch_pages_in_total",
-)
+KVTIER_GAUGES = manifest.gauge_names("kvtier")
 
 
 def test_state_and_metrics_export_kvtier_gauges(smoke_url):
@@ -797,15 +639,7 @@ def test_kv_tier_churn_zero_hot_compiles():
 # blinds the gateway's fleet aggregator — replica identity feeds the
 # restart-detecting health ring, ttft_hist_buckets feeds the live SLO
 # burn-rate monitor (obs/slomon.py)
-FLEETOBS_STATE_FIELDS = (
-    "replica_id",
-    "started_at",
-    "uptime_s",
-    "ttft_hist_buckets",
-    # graceful drain (ISSUE 14): the fleet health machine's
-    # control-plane overlay — losing it breaks lossless drain
-    "draining",
-)
+FLEETOBS_STATE_FIELDS = manifest.state_fields("fleetobs")
 
 
 def test_state_exports_fleet_identity_and_ttft_buckets(smoke_url):

@@ -1,0 +1,449 @@
+"""aigw-check (ISSUE 15): the invariant lint suite's own tier-1 gate.
+
+Three layers:
+
+- per-rule fixtures: one seeded violation proving each rule FIRES, one
+  clean twin proving it doesn't, and the suppression syntax honored;
+- the runtime half: ``@engine_thread_only`` under ``AIGW_TSAN=1``
+  (conftest turns it on suite-wide) raises from a foreign thread while
+  the owner thread is live — including on a real started Engine;
+- the regression gate: a whole-tree run over ``aigw_tpu/`` asserting
+  ZERO unsuppressed findings, so any future change that breaks an
+  invariant fails tier-1 exactly like ``make lint``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from aigw_tpu.analysis.core import Source, run_passes
+from aigw_tpu.analysis.registry import (
+    DEFAULT_CONFIG,
+    AnalysisConfig,
+    EngineThreadViolation,
+    ThreadDomain,
+    engine_thread_only,
+)
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def _check(tmp_path: Path, rel: str, code: str, config: AnalysisConfig,
+           rules: set[str] | None = None):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(code)
+    findings, suppressed = run_passes(
+        [Source.load(p, tmp_path)], config, rules=rules)
+    return findings, suppressed
+
+
+def _fixture_config(**kw) -> AnalysisConfig:
+    base = dict(
+        thread_domains=(),
+        jit_scope=(),
+        jit_warm_surface={},
+        determinism_modules=(),
+        wallclock_modules=(),
+        state_server="absent.py",
+        fleetstate_module="absent.py",
+    )
+    base.update(kw)
+    return AnalysisConfig(**base)
+
+
+# -- rule: jit-registry --------------------------------------------------
+
+JIT_CFG = _fixture_config(jit_scope=("fix/",))
+
+
+def test_jit_registry_fires_on_unregistered_jit(tmp_path):
+    findings, _ = _check(tmp_path, "fix/eng.py", (
+        "import jax\n"
+        "class E:\n"
+        "    def build(self):\n"
+        "        self.fn = jax.jit(lambda x: x)\n"
+    ), JIT_CFG)
+    assert [f.rule for f in findings] == ["jit-registry"]
+    assert findings[0].line == 4
+
+
+def test_jit_registry_clean_when_registered(tmp_path):
+    # both idioms the engine uses: jit inline in the register call, and
+    # assign-then-register (the prefill_sp / _decode_fn_for pattern)
+    findings, _ = _check(tmp_path, "fix/eng.py", (
+        "import jax\n"
+        "class E:\n"
+        "    def build(self, tracker):\n"
+        "        self.a = tracker.register('a', jax.jit(lambda x: x))\n"
+        "        self.b = jax.jit(lambda x: x)\n"
+        "        tracker.register('b', self.b)\n"
+        "        fn = jax.jit(lambda x: x)\n"
+        "        tracker.register('c', fn)\n"
+    ), JIT_CFG)
+    assert findings == []
+
+
+def test_jit_registry_warm_surface_and_stale_entries(tmp_path):
+    code = (
+        "import functools, jax\n"
+        "@functools.partial(jax.jit, static_argnames=('n',))\n"
+        "def kernel(x, n):\n"
+        "    return x\n"
+    )
+    ok = _fixture_config(jit_scope=("fix/",), jit_warm_surface={
+        "fix/k.py::kernel": "dispatched inside a registered program"})
+    findings, _ = _check(tmp_path, "fix/k.py", code, ok)
+    assert findings == []
+    # without the declaration the decorator site is a finding
+    findings, _ = _check(tmp_path, "fix/k.py", code, JIT_CFG)
+    assert [f.rule for f in findings] == ["jit-registry"]
+    # and a declaration matching nothing is itself a finding
+    stale = _fixture_config(jit_scope=("fix/",), jit_warm_surface={
+        "fix/k.py::kernel": "ok",
+        "fix/k.py::renamed_kernel": "stale"})
+    findings, _ = _check(tmp_path, "fix/k.py", code, stale)
+    assert len(findings) == 1 and "stale" in findings[0].message
+
+
+# -- rule: engine-thread -------------------------------------------------
+
+THREAD_CFG = _fixture_config(thread_domains=(ThreadDomain(
+    path="fix/eng.py", cls="Eng", thread_attr="_thread",
+    entry_methods=("_run",), allowed_methods=("__init__",),
+    guarded_fields=("_state", "_slots")),))
+
+
+def test_engine_thread_fires_on_undecorated_mutation(tmp_path):
+    findings, _ = _check(tmp_path, "fix/eng.py", (
+        "class Eng:\n"
+        "    def __init__(self):\n"
+        "        self._state = None\n"
+        "        self._slots = [None]\n"
+        "    def _run(self):\n"
+        "        self._state = 1\n"
+        "    def warmup(self):\n"
+        "        self._state = object()\n"     # the PR 12 bug class
+        "        self._slots[0] = 'x'\n"
+        "        self._slots.append('y')\n"
+    ), THREAD_CFG)
+    assert [f.rule for f in findings] == ["engine-thread"] * 3
+    assert [f.line for f in findings] == [8, 9, 10]
+
+
+def test_engine_thread_clean_when_annotated(tmp_path):
+    findings, _ = _check(tmp_path, "fix/eng.py", (
+        "from aigw_tpu.analysis.registry import engine_thread_only\n"
+        "class Eng:\n"
+        "    def __init__(self):\n"
+        "        self._state = None\n"
+        "        self._slots = [None]\n"
+        "    def _run(self):\n"
+        "        self._state = 1\n"
+        "        self._slots[0] = None\n"
+        "    @engine_thread_only\n"
+        "    def _tick(self):\n"
+        "        self._state, self._slots = None, []\n"
+        "    def reader(self):\n"
+        "        return self._state\n"        # reads are always fine
+    ), THREAD_CFG)
+    assert findings == []
+
+
+def test_engine_thread_flags_stale_registry_fields(tmp_path):
+    cfg = _fixture_config(thread_domains=(ThreadDomain(
+        path="fix/eng.py", cls="Eng", thread_attr="_thread",
+        entry_methods=("_run",), allowed_methods=("__init__",),
+        guarded_fields=("_renamed_away",)),))
+    findings, _ = _check(tmp_path, "fix/eng.py", (
+        "class Eng:\n"
+        "    def __init__(self):\n"
+        "        self._state = None\n"
+        "    def _run(self):\n"
+        "        pass\n"
+    ), cfg)
+    assert len(findings) == 1
+    assert "stale THREAD_DOMAINS entry" in findings[0].message
+
+
+# -- rule: async-blocking ------------------------------------------------
+
+ASYNC_CFG = _fixture_config()
+
+
+def test_async_blocking_fires_inside_async_def(tmp_path):
+    findings, _ = _check(tmp_path, "fix/srv.py", (
+        "import time\n"
+        "async def handler(request):\n"
+        "    time.sleep(1.0)\n"
+        "    eng.migrate_export(req)\n"
+    ), ASYNC_CFG)
+    assert [f.rule for f in findings] == ["async-blocking"] * 2
+    assert [f.line for f in findings] == [3, 4]
+
+
+def test_async_blocking_clean_for_to_thread_idiom(tmp_path):
+    findings, _ = _check(tmp_path, "fix/srv.py", (
+        "import asyncio, time\n"
+        "async def handler(request):\n"
+        "    def capture():\n"
+        "        time.sleep(1.0)\n"          # dispatched off-loop
+        "    await asyncio.to_thread(capture)\n"
+        "    out = await asyncio.to_thread(eng.migrate_export, req)\n"
+        "    await asyncio.sleep(0.1)\n"
+        "def sync_path():\n"
+        "    time.sleep(1.0)\n"              # not an async context
+    ), ASYNC_CFG)
+    assert findings == []
+
+
+# -- rule: determinism ---------------------------------------------------
+
+DET_CFG = _fixture_config(determinism_modules=("fix/",),
+                          wallclock_modules=("fix/pure/",))
+
+
+def test_determinism_fires_on_global_rng_and_wallclock(tmp_path):
+    findings, _ = _check(tmp_path, "fix/pure/sampling.py", (
+        "import random, time\n"
+        "import numpy as np\n"
+        "def draw():\n"
+        "    a = random.random()\n"
+        "    b = np.random.rand(3)\n"
+        "    t = time.monotonic()\n"
+        "    return a, b, t\n"
+    ), DET_CFG)
+    assert [f.rule for f in findings] == ["determinism"] * 3
+    assert [f.line for f in findings] == [4, 5, 6]
+
+
+def test_determinism_clean_for_keyed_and_seeded_rng(tmp_path):
+    findings, _ = _check(tmp_path, "fix/pure/sampling.py", (
+        "import jax, random\n"
+        "import numpy as np\n"
+        "def draw(key):\n"
+        "    a = jax.random.categorical(key, logits)\n"
+        "    rng = np.random.default_rng(1234)\n"
+        "    r = random.Random(7)\n"
+        "    return a, rng.random(), r.random()\n"
+    ), DET_CFG)
+    assert findings == []
+
+
+def test_determinism_wallclock_scoped_to_pure_modules(tmp_path):
+    # engine-style modules may read time for stats: only the RNG rule
+    # applies outside the wallclock scope
+    findings, _ = _check(tmp_path, "fix/engine.py", (
+        "import time\n"
+        "def tick():\n"
+        "    return time.monotonic()\n"
+    ), DET_CFG)
+    assert findings == []
+
+
+# -- rule: gauge-drift ---------------------------------------------------
+
+def _state_handler_code(keys) -> str:
+    body = ",\n".join(f"        {k!r}: 0" for k in keys)
+    return (
+        "class Srv:\n"
+        "    async def _state(self, request):\n"
+        "        return json_response({\n"
+        f"{body},\n"
+        "        **topology(),\n"
+        "        })\n"
+    )
+
+
+def test_gauge_drift_clean_on_manifest_exact_keys(tmp_path):
+    from aigw_tpu.analysis import manifest
+
+    cfg = _fixture_config(state_server="fix/srv.py")
+    findings, _ = _check(
+        tmp_path, "fix/srv.py",
+        _state_handler_code(sorted(manifest.expected_state_keys())), cfg)
+    assert findings == []
+
+
+def test_gauge_drift_fires_on_unknown_and_lost_fields(tmp_path):
+    from aigw_tpu.analysis import manifest
+
+    keys = sorted(manifest.expected_state_keys())
+    keys.remove("kv_occupancy")          # lost: picker input vanishes
+    keys.append("bogus_new_field")       # unknown: no gauge, no exemption
+    cfg = _fixture_config(state_server="fix/srv.py")
+    findings, _ = _check(tmp_path, "fix/srv.py",
+                         _state_handler_code(keys), cfg)
+    msgs = "\n".join(f.message for f in findings)
+    assert all(f.rule == "gauge-drift" for f in findings)
+    assert "bogus_new_field" in msgs
+    assert "kv_occupancy" in msgs and "lost" in msgs
+
+
+def test_gauge_drift_checks_fleet_rollup(tmp_path):
+    cfg = _fixture_config(fleetstate_module="fix/fleet.py")
+    findings, _ = _check(tmp_path, "fix/fleet.py", (
+        "class FleetState:\n"
+        "    def rollup(self, picker_state):\n"
+        "        return {'replicas_total': 1}\n"
+    ), cfg)
+    assert findings and all(f.rule == "gauge-drift" for f in findings)
+    assert any("replicas_up" in f.message for f in findings)
+
+
+def test_manifest_groups_cover_the_legacy_drift_tuples():
+    """The generated groups must keep covering the fields the old
+    hand-maintained tuples asserted on (spot anchors per subsystem —
+    a matcher regression here silently shrinks a drift smoke)."""
+    from aigw_tpu.analysis import manifest
+
+    anchors = {
+        "prefix": ("prefix_cache_hit_rate", "prefix_bytes_pinned"),
+        "spec": ("spec_accept_rate", "state_rebuilds"),
+        "ragged": ("attention_backend", "prefill_padded_frac"),
+        "adapter": ("adapters_registered", "tenant_slot_cap"),
+        "migration": ("migratable_slots", "migration_pages_in"),
+        "constraint": ("constrained_decoding", "capabilities"),
+        "memory": ("device_memory_frac", "kv_bytes_per_token"),
+        "mesh": ("mesh_axes", "device_memory_frac_worst", "migration"),
+        "kvtier": ("kv_chains", "kv_fetch_pages_in"),
+        "fleetobs": ("replica_id", "ttft_hist_buckets", "draining"),
+    }
+    for group, fields in anchors.items():
+        got = manifest.state_fields(group)
+        for f in fields:
+            assert f in got, (group, f, got)
+    assert "tpuserve_prefix_full_hits_total" in manifest.gauge_names(
+        "prefix")
+    assert "tpuserve_spec_accept_rate" in manifest.gauge_names("spec")
+    # every /state field belongs to ENGINE_GAUGES or a documented
+    # exemption — the same invariant the static pass enforces
+    from aigw_tpu.obs.metrics import ENGINE_GAUGES
+
+    attrs = {a for a, _ in ENGINE_GAUGES}
+    for key in manifest.expected_state_keys():
+        assert key in attrs or key in manifest.STATE_ONLY, key
+
+
+# -- suppression syntax --------------------------------------------------
+
+def test_suppression_honored_with_reason(tmp_path):
+    findings, suppressed = _check(tmp_path, "fix/srv.py", (
+        "import time\n"
+        "async def handler(request):\n"
+        "    # aigw: lint-ok(async-blocking): sub-ms debug knob, "
+        "documented\n"
+        "    time.sleep(0.001)\n"
+    ), ASYNC_CFG)
+    assert findings == []
+    assert [f.rule for f in suppressed] == ["async-blocking"]
+
+
+def test_suppression_without_reason_is_a_finding(tmp_path):
+    findings, _ = _check(tmp_path, "fix/srv.py", (
+        "import time\n"
+        "async def handler(request):\n"
+        "    time.sleep(0.001)  # aigw: lint-ok(async-blocking)\n"
+    ), ASYNC_CFG)
+    rules = sorted(f.rule for f in findings)
+    assert rules == ["async-blocking", "suppression"]
+
+
+def test_suppression_for_unknown_rule_is_a_finding(tmp_path):
+    findings, _ = _check(tmp_path, "fix/x.py", (
+        "# aigw: lint-ok(no-such-rule): whatever\n"
+        "x = 1\n"
+    ), ASYNC_CFG)
+    assert [f.rule for f in findings] == ["suppression"]
+
+
+def test_suppression_does_not_leak_to_other_rules(tmp_path):
+    findings, _ = _check(tmp_path, "fix/srv.py", (
+        "import time\n"
+        "async def handler(request):\n"
+        "    # aigw: lint-ok(determinism): wrong rule named\n"
+        "    time.sleep(0.001)\n"
+    ), ASYNC_CFG)
+    assert [f.rule for f in findings] == ["async-blocking"]
+
+
+# -- runtime sanitizer (@engine_thread_only, AIGW_TSAN=1) ----------------
+
+class _Dummy:
+    def __init__(self):
+        self._thread = None
+
+    @engine_thread_only
+    def poke(self):
+        return 42
+
+
+def test_tsan_decorator_allows_when_owner_thread_dead():
+    d = _Dummy()
+    assert d.poke() == 42  # never started
+    t = threading.Thread(target=lambda: None)
+    t.start()
+    t.join()
+    d._thread = t
+    assert d.poke() == 42  # joined: construction/stop-path calls legal
+
+
+def test_tsan_decorator_raises_from_foreign_thread_while_live():
+    d = _Dummy()
+    stop = threading.Event()
+    t = threading.Thread(target=stop.wait, daemon=True)
+    t.start()
+    d._thread = t
+    try:
+        with pytest.raises(EngineThreadViolation):
+            d.poke()
+        # …and the owner thread itself is always allowed
+        out: list = []
+        t2 = threading.Thread(target=lambda: out.append(d.poke()))
+        d._thread = t2
+        t2.start()
+        t2.join()
+        assert out == [42]
+    finally:
+        stop.set()
+
+
+def test_tsan_guards_the_real_engine_loop():
+    """The sanitizer is live on Engine: calling an engine-thread-only
+    method from the test thread while the loop runs raises; the same
+    call after stop() is legal (the stop()→_abort_all path)."""
+    import jax
+
+    from aigw_tpu.models import llama
+    from aigw_tpu.tpuserve.engine import Engine, EngineConfig
+
+    params = llama.init_params(jax.random.PRNGKey(0), llama.TINY)
+    eng = Engine(params, llama.TINY, EngineConfig(
+        max_batch_size=2, max_seq_len=64, page_size=16,
+        min_prefill_bucket=16, enable_prefix_cache=False))
+    eng.start()
+    try:
+        deadline = time.monotonic() + 10
+        while not eng._thread.is_alive():
+            assert time.monotonic() < deadline
+        with pytest.raises(EngineThreadViolation):
+            eng._refresh_stats()
+    finally:
+        eng.stop()
+    eng._refresh_stats()  # owner thread joined: allowed again
+
+
+# -- the regression gate -------------------------------------------------
+
+def test_whole_tree_has_zero_unsuppressed_findings():
+    """`make lint` as a tier-1 test: every rule over every file under
+    aigw_tpu/, zero unsuppressed findings. A new invariant violation
+    (or a stale registry/manifest entry) fails here first."""
+    from aigw_tpu.analysis.core import run_checks
+
+    findings, _suppressed = run_checks(REPO_ROOT, config=DEFAULT_CONFIG)
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)
