@@ -86,6 +86,18 @@ STATE_ONLY: dict[str, str] = {
     "prefix_bytes_pinned": "derived: prefix_pages_pinned × page bytes",
     "phase_percentiles": "p50/p95/p99 dict derived from "
                          "ENGINE_HISTOGRAMS",
+    # long-context serving surface (the picker's context-length filter
+    # and prompt-priced TTFT model read these)
+    "max_seq_len": "EngineConfig echo; advertised context length the "
+                   "gateway filters candidates by",
+    "sp": "mesh sp axis size (1 off-mesh); topology echo",
+    "sp_prefill_mode": "resolved sp routing (chunked | monolithic | "
+                       "off), string",
+    "prefill_ms_per_token": "derived: token-decayed prefill rate "
+                            "(EngineStats.prefill_ms_per_token(), ~16k-"
+                            "token half-life; lifetime mean until the "
+                            "first observed call) — the picker's "
+                            "prompt-length TTFT pricing rate",
 }
 
 
@@ -128,6 +140,9 @@ GROUPS: dict[str, Group] = {
     "kvtier": Group(
         prefixes=("kv_spill", "kv_fetch", "kv_revives"),
         exact=("kv_host_bytes", "kv_chains")),
+    "longctx": Group(
+        prefixes=("sp_",),
+        exact=("sp", "max_seq_len", "prefill_ms_per_token")),
     "fleetobs": Group(
         exact=("replica_id", "started_at", "uptime_s",
                "ttft_hist_buckets", "draining")),

@@ -105,6 +105,7 @@ THREAD_DOMAINS: tuple[ThreadDomain, ...] = (
         guarded_fields=(
             "_device_state",
             "_slots",
+            "_reserved_slots",
             "_inflight",
             "_pending_frees",
             "_dirty_rows",

@@ -29,10 +29,18 @@ from __future__ import annotations
 class KVIndex:
     """chain-hash (hex) → set of replica addresses."""
 
-    #: per-replica digest bound — matches the replica-side export bound
-    #: (tpuserve Engine.KV_DIGEST_MAX); a misbehaving replica cannot
-    #: balloon the gateway's memory
-    MAX_KEYS_PER_REPLICA = 4096
+    #: per-replica digest bound — a misbehaving replica cannot balloon
+    #: the gateway's memory. Sized for the LONG-CONTEXT geometry: the
+    #: replica-side export bound is geometry-aware now (tpuserve
+    #: Engine.kv_digest_max() scales with max_pages_per_seq off the
+    #: KV_DIGEST_MAX=4096 floor — a single 128k chain at 128-token
+    #: pages is 1024 keys, so the old flat 4096 truncated the fleet
+    #: index to ~4 long chains per replica and long-prefix fleet hits
+    #: silently vanished). The gateway accepts the largest digest any
+    #: supported geometry exports: 8 chains × 8192 pages (1M tokens at
+    #: 128-token pages). ~64 B/key ⇒ ≤4 MiB per replica, still a
+    #: memory bound, not a truncation in practice.
+    MAX_KEYS_PER_REPLICA = 65536
 
     def __init__(self) -> None:
         self._by_addr: dict[str, frozenset[str]] = {}

@@ -101,6 +101,13 @@ KV_CHAIN_HEADER = "x-aigw-kv-chain"
 #: of re-prefilling (tpuserve/server.py consumes it)
 KV_PEERS_HEADER = "x-aigw-kv-peers"
 
+#: request header carrying the client's own prompt-token count
+#: (optional). When absent the gateway estimates one from the prompt
+#: byte length before pick() — the estimate feeds the picker's
+#: context-length filter and the prompt-priced TTFT model, never the
+#: replica (tpuserve recounts with its real tokenizer on admission).
+PROMPT_TOKENS_HEADER = "x-aigw-prompt-tokens"
+
 
 class SLOShedError(Exception):
     """Every fresh candidate's predicted TTFT blows the configured SLO:
@@ -115,6 +122,23 @@ class SLOShedError(Exception):
         self.retry_after_s = retry_after_s
         self.predicted_ms = predicted_ms
         self.slo_ms = slo_ms
+
+
+class ContextLengthError(Exception):
+    """The request's prompt exceeds the advertised ``max_seq_len`` of
+    EVERY fresh candidate replica: routing it anywhere would burn a
+    full admission round-trip just to collect tpuserve's over-length
+    ValueError mid-stream. The gateway surfaces a clean 400 instead
+    (long-context satellite: context length is a routing input, not a
+    replica-side surprise)."""
+
+    def __init__(self, prompt_tokens: int, max_ctx: int):
+        super().__init__(
+            f"prompt of ~{prompt_tokens} tokens exceeds the "
+            f"{max_ctx}-token context length of every candidate "
+            f"replica")
+        self.prompt_tokens = prompt_tokens
+        self.max_ctx = max_ctx
 
 
 @dataclass(frozen=True)
@@ -195,6 +219,14 @@ class EndpointState:
     poll_failures: int = 0
     replica_id: str = ""
     uptime_s: float = 0.0
+    # long-context serving: the replica's advertised context length
+    # (0 = not advertised, filter vanishes), its sequence-parallel
+    # axis size, and the measured prefill cost per token — the
+    # context-length filter and the prompt-priced TTFT model read
+    # these off /state
+    max_seq_len: int = 0
+    sp: int = 1
+    prefill_ms_per_token: float = 0.0
 
     def staleness_s(self, now: float | None = None) -> float:
         """Seconds since the last successful poll (-1 = never)."""
@@ -428,6 +460,10 @@ class EndpointPicker:
         self.kv_index.update(e.address, st.kv_chains)
         st.replica_id = str(data.get("replica_id", "") or "")
         st.uptime_s = float(data.get("uptime_s", 0.0) or 0.0)
+        st.max_seq_len = int(data.get("max_seq_len", 0) or 0)
+        st.sp = max(1, int(data.get("sp", 1) or 1))
+        st.prefill_ms_per_token = float(
+            data.get("prefill_ms_per_token", 0.0) or 0.0)
         st.poll_failures = 0
         st.last_poll_ok_ts = time.monotonic()
         st.updated_at = time.monotonic()
@@ -450,7 +486,10 @@ class EndpointPicker:
                 hbm_frac_worst: float = 0.0,
                 devices: tuple = (),
                 migration_capable: bool = True,
-                kv_chains: tuple = ()) -> None:
+                kv_chains: tuple = (),
+                max_seq_len: int = 0,
+                sp: int = 1,
+                prefill_ms_per_token: float = 0.0) -> None:
         st = self.state[address]
         st.healthy = True
         st.kv_occupancy = kv_occupancy
@@ -478,6 +517,15 @@ class EndpointPicker:
         if kv_chains:
             st.kv_chains = tuple(kv_chains)
             self.kv_index.update(address, st.kv_chains)
+        if max_seq_len:
+            st.max_seq_len = max_seq_len
+        if sp > 1:
+            # mirror the max_seq_len/prefill_ms_per_token guards: a
+            # push-fed observe() that omits sp must not reset a polled
+            # replica's advertised sp axis back to the default
+            st.sp = sp
+        if prefill_ms_per_token:
+            st.prefill_ms_per_token = prefill_ms_per_token
         st.poll_failures = 0
         st.last_poll_ok_ts = time.monotonic()
         st.updated_at = time.monotonic()
@@ -533,7 +581,8 @@ class EndpointPicker:
     #: exceeds the best candidate's by this much
     STICKINESS_MARGIN_MS = 250.0
 
-    def predicted_ttft_ms(self, st: EndpointState) -> float | None:
+    def predicted_ttft_ms(self, st: EndpointState,
+                          prompt_tokens: int = 0) -> float | None:
         """Predicted TTFT for a NEW arrival on this replica, from its
         live phase histograms (PR 5) + queue depth: the arrival stands
         behind ``queued`` waiting requests plus itself — admitted in
@@ -549,7 +598,16 @@ class EndpointPicker:
         STALE_AFTER): a dead replica's last happy histograms predict
         nothing either (ISSUE 12 stale-poll fix; pick() also excludes
         stale endpoints, this guards direct callers like the
-        migration orchestrator and push-fed test state)."""
+        migration orchestrator and push-fed test state).
+
+        ``prompt_tokens`` (long-context satellite): when the caller
+        knows the request's prompt length AND the replica exports its
+        measured ``prefill_ms_per_token`` rate, the prediction adds the
+        EXCESS of this prompt's priced prefill over the histogram p50 —
+        a 64k prompt is not a p50 prefill, and routing it as one
+        systematically under-predicts the very requests the chunked-sp
+        path exists for. 0 (or an un-priced replica) leaves the
+        historical model untouched."""
         if (st.last_poll_ok_ts
                 and time.monotonic() - st.last_poll_ok_ts
                 >= self.STALE_AFTER):
@@ -563,7 +621,13 @@ class EndpointPicker:
             if pf < 0:
                 return None
         rounds = -(-(st.queued + 1) // max(1, st.max_slots))
-        return st.queue_wait_ms + pf * rounds
+        pred = st.queue_wait_ms + pf * rounds
+        if prompt_tokens > 0 and st.prefill_ms_per_token > 0:
+            # the arrival's own prefill is one of those rounds; when
+            # its priced cost exceeds the p50 round, charge the excess
+            pred += max(
+                0.0, prompt_tokens * st.prefill_ms_per_token - pf)
+        return pred
 
     # -- KV memory hierarchy (ISSUE 11) -----------------------------------
     def note_chain(self, prefix_key: str, chain_hex: str) -> None:
@@ -642,6 +706,13 @@ class EndpointPicker:
         prefix_addr = (self._prefix_affinity.get(prefix_key)
                        if prefix_key else None)
         adapter_key = (headers or {}).get(ADAPTER_HEADER, "")
+        # long-context satellite: the request's (estimated) prompt
+        # token count — context-length filter + prompt-priced TTFT
+        try:
+            prompt_tokens = max(0, int(
+                (headers or {}).get(PROMPT_TOKENS_HEADER, 0) or 0))
+        except (TypeError, ValueError):
+            prompt_tokens = 0
         # fleet-hit locality (ISSUE 11): replicas the index says hold
         # this request's KV chain — resident or host-spilled
         kv_chain = self._chain_for(headers)
@@ -701,6 +772,30 @@ class EndpointPicker:
 
         scores = {e.address: score_of(e) for e in self.endpoints}
         fresh = {a: s for a, s in scores.items() if s is not None}
+        # context-length filter (long-context satellite): drop fresh
+        # candidates whose advertised max_seq_len the prompt exceeds —
+        # tpuserve would only 400 it after a full admission round-trip
+        # (or worse, mid-stream). When EVERY fresh candidate is
+        # length-filtered the request is unroutable as a matter of
+        # capability, not load: raise so the gateway answers a clean
+        # 400 — falling into round-robin would knowingly route to a
+        # replica that must reject.
+        if prompt_tokens and fresh:
+            fits = {a: s for a, s in fresh.items()
+                    if not (self.state[a].max_seq_len
+                            and prompt_tokens
+                            > self.state[a].max_seq_len)}
+            if not fits:
+                max_ctx = max(self.state[a].max_seq_len for a in fresh)
+                if explain is not None:
+                    explain.update(
+                        ctx_filtered=len(fresh),
+                        prompt_tokens=prompt_tokens,
+                        max_ctx=max_ctx)
+                raise ContextLengthError(prompt_tokens, max_ctx)
+            if explain is not None and len(fits) < len(fresh):
+                explain["ctx_filtered"] = len(fresh) - len(fits)
+            fresh = fits
         # slo mode (ISSUE 8): rank by PREDICTED TTFT from live phase
         # histograms instead of the static score sum. Candidates with no
         # histogram data yet predict 0 (a replica that has served
@@ -709,7 +804,8 @@ class EndpointPicker:
         # sheds blind.
         pred_raw: dict[str, float | None] = {}
         if self.mode == "slo" and fresh:
-            pred_raw = {a: self.predicted_ttft_ms(self.state[a])
+            pred_raw = {a: self.predicted_ttft_ms(self.state[a],
+                                                  prompt_tokens)
                         for a in fresh}
         if any(p is not None for p in pred_raw.values()):
             pred = {a: (p if p is not None else 0.0)

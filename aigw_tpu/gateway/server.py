@@ -60,7 +60,9 @@ from aigw_tpu.gateway.picker import (
     KV_CHAIN_HEADER,
     KV_PEERS_HEADER,
     PREFIX_HEADER,
+    PROMPT_TOKENS_HEADER,
     TENANT_HEADER,
+    ContextLengthError,
     Endpoint as PickerEndpoint,
     EndpointPicker,
     SLOShedError,
@@ -186,6 +188,36 @@ def _prefix_hash_key(body: dict) -> str:
         return ""
     blob = _json.dumps(head, sort_keys=True).encode()
     return _hashlib.blake2b(blob, digest_size=12).hexdigest()
+
+
+def _prompt_token_estimate(body: dict) -> int:
+    """Conservative prompt-token estimate for the picker's
+    context-length filter and prompt-priced TTFT model (long-context
+    satellite). An explicit x-aigw-prompt-tokens header wins upstream
+    of this; the estimate only needs the right order of magnitude:
+    bytes/4 approximates BPE tokens and UNDER-estimates byte-level
+    tokenizers, so a borderline prompt never draws a spurious gateway
+    400 — it routes, and the replica's own over-length check still
+    guards, exactly as before this filter existed."""
+    n = 0
+    prompt = body.get("prompt")
+    if isinstance(prompt, str):
+        n += len(prompt.encode("utf-8", errors="ignore"))
+    messages = body.get("messages")
+    if isinstance(messages, list):
+        for m in messages:
+            if not isinstance(m, dict):
+                continue
+            c = m.get("content")
+            if isinstance(c, str):
+                n += len(c.encode("utf-8", errors="ignore"))
+            elif isinstance(c, list):
+                for part in c:
+                    if (isinstance(part, dict)
+                            and isinstance(part.get("text"), str)):
+                        n += len(part["text"].encode(
+                            "utf-8", errors="ignore"))
+    return n // 4
 
 
 def _multipart_model(raw: bytes, content_type: str) -> str:
@@ -1108,6 +1140,17 @@ class GatewayServer:
             if adapter and ADAPTER_HEADER not in pick_headers:
                 pick_headers = dict(pick_headers) | {
                     ADAPTER_HEADER: adapter}
+            # long-context satellite: prompt length is a routing input —
+            # an explicit client header wins, else estimate from the
+            # prompt bytes so the picker can filter replicas whose
+            # advertised max_seq_len the prompt exceeds and price the
+            # prefill into its predicted TTFT
+            if (PROMPT_TOKENS_HEADER not in pick_headers
+                    and isinstance(body, dict)):
+                est = _prompt_token_estimate(body)
+                if est:
+                    pick_headers = dict(pick_headers) | {
+                        PROMPT_TOKENS_HEADER: str(est)}
             # explain is ALWAYS computed now (ISSUE 12): the decision
             # audit ring records every pick, traced or not — the span
             # attrs below still only render when tracing is on
@@ -1143,6 +1186,34 @@ class GatewayServer:
                     status=429,
                     body=error_body(str(e), type_="rate_limit_error"),
                     headers={"retry-after": str(e.retry_after_s)},
+                    content_type="application/json")
+            except ContextLengthError as e:
+                # long-context satellite: the prompt exceeds EVERY
+                # fresh candidate's advertised context length — answer
+                # a clean 400 at the gateway instead of collecting the
+                # replica's over-length error after a routed admission
+                self.metrics.requests_total.labels(
+                    route_name, backend.name, "400").inc()
+                req_metrics.finish(
+                    TokenUsage(), error_type="context_length")
+                if backend.fleet_obs:
+                    req_metrics.decision = self.decisions.record(
+                        route=route_name, backend=backend.name,
+                        model=req_metrics.request_model,
+                        request_id=client_headers.get(
+                            "x-request-id", ""),
+                        context_rejected=True,
+                        prompt_tokens=e.prompt_tokens,
+                        max_ctx=e.max_ctx,
+                        pick=dict(explain))
+                if span is not None:
+                    span.set("aigw.pick.context_rejected", True)
+                    span.set("aigw.pick.prompt_tokens", e.prompt_tokens)
+                    span.set("aigw.pick.max_ctx", e.max_ctx)
+                return web.Response(
+                    status=400,
+                    body=error_body(
+                        str(e), type_="invalid_request_error"),
                     content_type="application/json")
             if dest and backend.fleet_obs:
                 decision = self.decisions.record(
@@ -1248,7 +1319,7 @@ class GatewayServer:
                 return None
             try:
                 nxt = picker.pick(pick_headers, exclude=frozenset(tried))
-            except SLOShedError:
+            except (SLOShedError, ContextLengthError):
                 return None
             return nxt if nxt and nxt not in tried else None
 

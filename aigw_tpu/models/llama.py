@@ -374,6 +374,76 @@ def prefill_sp(
     return _logits(p, cfg, last), kv_cache
 
 
+def prefill_sp_suffix(
+    p: dict[str, jax.Array],
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [B, S] chunk tokens, right-padded; S % sp == 0
+    prefix_lens: jax.Array,  # [B] int32 — tokens already in the cache
+    seq_lens: jax.Array,  # [B] int32 — TOTAL length incl. prefix
+    kv_cache: jax.Array,
+    page_table: jax.Array,  # [B, pages]; pages*page_size % sp == 0
+    page_size: int,
+    *,
+    mesh,  # jax.sharding.Mesh with an "sp" axis
+    mlp=None,
+    lora=None,
+    adapter_idx=None,
+) -> tuple[jax.Array, jax.Array]:
+    """Sequence-parallel chunked prefill resuming at an arbitrary
+    page-aligned offset: ``prefill_suffix`` semantics with ring attention
+    over the ``sp`` axis (ops/ring_attention.ring_attention_prefix).
+
+    Per layer the chunk's K/V scatter into the pool first (so the next
+    chunk's window pass sees them), then attention runs two ring passes
+    under one online-softmax carry: chunk-causal over the in-register
+    K/V, plus the gathered page window masked to ``t < prefix_len``.
+    With ``prefix_lens == 0`` the window pass is fully masked and this
+    degenerates to ``prefill_sp`` over one chunk. Padded queries are
+    garbage-out (never read); their scatters drop via the OOB slot.
+    """
+    from aigw_tpu.ops.ring_attention import ring_attention_prefix
+
+    B, S = tokens.shape
+    T = page_table.shape[1] * page_size
+    n_slots = kvq.n_slots(kv_cache)
+    positions = prefix_lens[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    valid = positions < seq_lens[:, None]  # [B, S]
+
+    slot = (
+        jnp.take_along_axis(page_table, positions // page_size, axis=1)
+        * page_size
+        + positions % page_size
+    )
+    flat = jnp.where(valid, slot, n_slots)  # OOB → dropped by scatter
+
+    gslot = page_table[:, :, None] * page_size + jnp.arange(
+        page_size, dtype=jnp.int32
+    )
+    gslot = gslot.reshape(B, T)
+
+    x = _embed_rows(p, tokens)
+    for i in range(cfg.n_layers):
+        h = rms_norm(x, p[f"l{i}.attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(p, i, h, positions, cfg, lora, adapter_idx)
+        kv_cache = kvq.scatter_kv(kv_cache, i, flat, k, v)
+        k_all, v_all = kvq.gather_kv(kv_cache, i, gslot)
+        attn = ring_attention_prefix(
+            q, k.astype(q.dtype), v.astype(q.dtype),
+            k_all.astype(q.dtype), v_all.astype(q.dtype),
+            prefix_lens, mesh=mesh,
+        ).astype(x.dtype)
+        x = x + _wo_project(p, i, attn, lora, adapter_idx)
+        h = rms_norm(x, p[f"l{i}.mlp_norm"], cfg.norm_eps)
+        x = x + (mlp(p, i, h) if mlp is not None
+                 else _mlp(p, i, h, lora, adapter_idx))
+    x = rms_norm(x, p["norm_f"], cfg.norm_eps)
+    last = jnp.take_along_axis(
+        x, (seq_lens - prefix_lens - 1)[:, None, None].astype(jnp.int32),
+        axis=1,
+    )[:, 0]
+    return _logits(p, cfg, last), kv_cache
+
+
 def decode_step(
     p: dict[str, jax.Array],
     cfg: LlamaConfig,

@@ -39,6 +39,10 @@ class ModelFns:
     # sequence-parallel (ring-attention) prefill for long prompts; None
     # disables the engine's sp prefill path for the family
     prefill_sp: Any = None
+    # sequence-parallel chunked prefill resuming at a page-aligned
+    # offset (ring attention + cached-window pass); None falls the sp
+    # path back to the monolithic full-rung program
+    prefill_sp_suffix: Any = None
     # multi-position verifier for speculative decoding; None disables the
     # engine's prompt-lookup speculation for the family
     verify_step: Any = None
@@ -54,6 +58,7 @@ def family_fns(family: str) -> ModelFns:
                         llama.hidden_states,
                         prefill_suffix=llama.prefill_suffix,
                         prefill_sp=llama.prefill_sp,
+                        prefill_sp_suffix=llama.prefill_sp_suffix,
                         verify_step=llama.verify_step,
                         prefill_ragged=llama.prefill_ragged)
     if family == "mixtral":

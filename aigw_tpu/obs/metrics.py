@@ -118,6 +118,12 @@ ENGINE_GAUGES: tuple[tuple[str, str], ...] = (
     ("tokens_generated", "tpuserve_tokens_generated_total"),
     ("prefills", "tpuserve_prefills_total"),
     ("sp_prefills", "tpuserve_sp_prefills_total"),
+    # long-context sp serving (sequence-sharded chunked prefill):
+    # chunked-vs-monolithic routing volume and offset resumes on the
+    # sp path (prefix-cache partial hits / migration continuations)
+    ("sp_chunked_prefills", "tpuserve_sp_chunked_prefills_total"),
+    ("sp_resume_prefills", "tpuserve_sp_resume_prefills_total"),
+    ("sp_interactive_admits", "tpuserve_sp_interactive_admits_total"),
     ("chunked_prefill_steps", "tpuserve_chunked_prefill_steps_total"),
     ("decode_steps", "tpuserve_decode_steps_total"),
     ("decode_window", "tpuserve_decode_window_steps"),

@@ -249,6 +249,7 @@ class XlaBucketedBackend(AttentionBackend):
         self._account(int(seq_lens.sum()), G2 * S)
         prefill_ms = 1e3 * (time.monotonic() - t0)
         eng.stats.prefill_ms += prefill_ms
+        eng.stats.note_prefill_call(prefill_ms, int(seq_lens.sum()))
         results = []
         for g, (req, seq_id, n, total) in enumerate(items):
             eng.phases.observe(
@@ -358,6 +359,96 @@ class XlaBucketedBackend(AttentionBackend):
             "chunks": consumed // chunk if chunk else 0,
             "padded_frac": round(1.0 - ns_tail / S, 3) if S else 0.0,
         }
+
+
+def sp_chunked_prefill(eng, req, seq_id: int, suffix: list[int],
+                       prefix_len: int, n: int, pt: np.ndarray,
+                       bucket: int, sampling_args: tuple):
+    """Sequence-sharded chunked prefill — the long-context sp path.
+
+    The ``single_prefill`` chunk-loop discipline composed with ring
+    attention: fixed ``sp_chunk_tokens``-sized ``prefill_sp_suffix``
+    steps (chunk rung rounded up to a multiple of the sp axis), a
+    decode tick between chunks so live streams keep emitting behind a
+    128k prefill, resume at the page-aligned ``prefix_len`` a prefix
+    hit / migration continuation left in the pool, and a bucketed tail
+    rung — sp-path padding collapses from full-rung residue to tail
+    residue.
+
+    Module-level (not a backend method): the sp route preempts the
+    attention backend's ``single_prefill`` for long suffixes whichever
+    backend is configured. Same return contract as ``single_prefill``
+    ("stop" | "stop_consumed" | "skipped" | (next_tok, info))."""
+    cfg = eng.cfg
+    sp = eng._sp
+    ns = len(suffix)
+    tick_ms = 0.0
+    chunk = max(cfg.sp_chunk_tokens, sp)
+    chunk = -(-chunk // sp) * sp  # ring shards the chunk over sp
+    consumed = 0
+    # the gather window of every chunk step: the pow2 page bucket
+    # covering the sequence (page_size % sp == 0 is build-gated, so
+    # the window shards evenly)
+    pt_dev = jnp.asarray(pt[:, :bucket])
+    if ns > chunk:
+        ctokens = np.zeros((1, chunk), np.int32)
+        while ns - consumed > chunk:
+            # chunk boundaries are cancellation/shutdown yield points —
+            # exactly what chunking exists to provide
+            if req.cancelled.is_set() or eng._stop.is_set():
+                if eng._stop.is_set():
+                    if not req.cancelled.is_set():
+                        return "stop"
+                    return "stop_consumed"
+                return "skipped"
+            ctokens[0, :] = suffix[consumed:consumed + chunk]
+            _, eng.kv_cache = eng._prefill_sp_suffix_fn(
+                eng.params,
+                eng.lora_params,
+                jnp.asarray(ctokens),
+                jnp.asarray([prefix_len + consumed], jnp.int32),
+                jnp.asarray([prefix_len + consumed + chunk], jnp.int32),
+                eng.kv_cache,
+                pt_dev,
+                *sampling_args,
+            )
+            consumed += chunk
+            eng.stats.prefill_tokens_real += chunk
+            eng.stats.prefill_tokens_padded += chunk
+            eng.stats.chunked_prefill_steps += 1
+            if req.trace is not None:
+                req.trace.event("prefill_chunk", tokens=chunk,
+                                consumed=prefix_len + consumed, sp=True)
+            # interleave: SHORT queued arrivals admit into free slots
+            # (their own fast prefill emits their first token NOW, not
+            # after this long prefill drains), then live streams — the
+            # just-admitted one included — take a decode tick
+            t_tick = time.monotonic()
+            eng._admit_interactive()
+            eng._decode_tick()
+            tick_ms += 1e3 * (time.monotonic() - t_tick)
+    tail = suffix[consumed:]
+    ns_tail = len(tail)
+    S = eng._prefill_bucket(ns_tail, multiple_of=sp)
+    tokens = np.zeros((1, S), np.int32)
+    tokens[0, :ns_tail] = tail
+    next_tok, eng.kv_cache = eng._prefill_sp_suffix_fn(
+        eng.params,
+        eng.lora_params,
+        jnp.asarray(tokens),
+        jnp.asarray([prefix_len + consumed], jnp.int32),
+        jnp.asarray([n], jnp.int32),
+        eng.kv_cache,
+        pt_dev,
+        *sampling_args,
+    )
+    eng.stats.prefill_tokens_real += ns_tail
+    eng.stats.prefill_tokens_padded += S
+    return next_tok, {
+        "consumed": consumed, "tick_ms": tick_ms, "bucket": S,
+        "chunks": consumed // chunk,
+        "padded_frac": round(1.0 - ns_tail / S, 3) if S else 0.0,
+    }
 
 
 @dataclass
@@ -603,6 +694,7 @@ class RaggedPrefillBackend(AttentionBackend):
         prefill_ms = max(
             0.0, 1e3 * (time.monotonic() - t0) - info["tick_ms"])
         eng.stats.prefill_ms += prefill_ms
+        eng.stats.note_prefill_call(prefill_ms, info["real"])
         results = []
         for s, (req, seq_id, n, total) in zip(segs, items):
             eng.phases.observe(

@@ -104,6 +104,10 @@ def _per_device_bytes(tree: Any) -> dict[int, int]:
     total/tp claim."""
     per: dict[int, int] = {}
     for leaf in jax.tree_util.tree_leaves(tree):
+        if getattr(leaf, "is_deleted", lambda: False)():
+            # a donated-away buffer (mid-reassignment on another
+            # thread) is a stats gap, not an engine-loop fatality
+            continue
         shards = getattr(leaf, "addressable_shards", None)
         if shards is None:
             continue
@@ -144,6 +148,24 @@ class EngineConfig:
     # chip). Shorter prompts use the plain prefill — the ICI rotation
     # only pays for itself on long sequences.
     sp_prefill_min_tokens: int = 1024
+    # Sequence-parallel chunked prefill (the long-context path):
+    # "chunked" runs sp prompts as sp_chunk_tokens-sized ring-attention
+    # chunk steps (models.<family>.prefill_sp_suffix) with a decode
+    # tick between chunks — the chunked-prefill liveness guarantee
+    # holds on the sp path too, and the path resumes at page-aligned
+    # prefix-cache / migration offsets. "monolithic" restores the
+    # single full-rung ring-attention program (no interleaving, no
+    # resume — prefix hits fall back to the single-device chunk loop).
+    # The chunked path additionally requires page_size % sp == 0 (the
+    # gathered page window is sharded over sp); other geometries fall
+    # back to monolithic automatically.
+    sp_prefill_mode: str = "chunked"  # "chunked" | "monolithic"
+    # Chunk size for the sp chunked path, rounded up to a multiple of
+    # the sp axis at use. Larger than prefill_chunk_tokens by default:
+    # each sp chunk step re-gathers the sequence's page window, so
+    # chunks amortize the window pass while staying small enough that
+    # decode ticks interleave every few hundred ms at 32k-128k.
+    sp_chunk_tokens: int = 2048
     # Chunked prefill: prompts longer than this run as fixed-size
     # prefill_suffix steps with a decode tick between chunks — bounding
     # both the largest compiled bucket and how long active streams
@@ -582,6 +604,16 @@ class EngineStats:
     kv_fetch_pages_in: int = 0
     prefills: int = 0
     sp_prefills: int = 0  # prefills routed through ring attention
+    # long-context sp surface: sp prefills that ran as chunked
+    # ring-attention steps (vs one monolithic full-rung program), and
+    # how many of those resumed at a nonzero cached offset (prefix-
+    # cache partial hit / migration continuation on the sp path)
+    sp_chunked_prefills: int = 0
+    sp_resume_prefills: int = 0
+    # short requests admitted AT a chunk boundary of a running sp
+    # chunked prefill — the decode-liveness counter: each one is a
+    # first token that did not wait out a long prefill
+    sp_interactive_admits: int = 0
     chunked_prefill_steps: int = 0  # intermediate chunk device steps
     decode_steps: int = 0
     prefix_cache_hits: int = 0
@@ -636,6 +668,35 @@ class EngineStats:
     # tick; a post-warmup delta is a hot-path compile regression
     xla_compiles: int = 0
     xla_compile_ms: float = 0.0
+    # prefill rate the gateway prices prompt length with (/state
+    # prefill_ms_per_token): a token-decayed average rather than the
+    # process-lifetime mean, so a traffic-mix change (chunked-sp long
+    # prompts start arriving) re-prices within roughly one half-life
+    # of prefilled tokens instead of lagging forever. Both
+    # accumulators decay by 0.5 ** (tokens / half_life) per observed
+    # prefill call, so the ratio is an exponentially weighted mean
+    # over the most recent ~PREFILL_RATE_HALF_LIFE_TOKENS tokens.
+    prefill_ms_decayed: float = 0.0
+    prefill_tokens_decayed: float = 0.0
+
+    PREFILL_RATE_HALF_LIFE_TOKENS = 16384
+
+    def note_prefill_call(self, ms: float, tokens: int) -> None:
+        """Fold one prefill device call (``ms`` host-blocked time over
+        ``tokens`` real prompt tokens) into the decayed rate."""
+        if tokens <= 0:
+            return
+        decay = 0.5 ** (tokens / self.PREFILL_RATE_HALF_LIFE_TOKENS)
+        self.prefill_ms_decayed = self.prefill_ms_decayed * decay + ms
+        self.prefill_tokens_decayed = (
+            self.prefill_tokens_decayed * decay + tokens)
+
+    def prefill_ms_per_token(self) -> float:
+        """The advertised per-token prefill rate: the decayed mean once
+        any call has been observed, else the lifetime mean (0 cold)."""
+        if self.prefill_tokens_decayed > 0:
+            return self.prefill_ms_decayed / self.prefill_tokens_decayed
+        return self.prefill_ms / max(1, self.prefill_tokens_real)
 
 
 @dataclass
@@ -763,6 +824,12 @@ class Engine:
 
         B = cfg.max_batch_size
         self._slots: list[_Slot | None] = [None] * B
+        # slot indices picked by an in-flight _admit_one whose _Slot is
+        # not installed yet (the prefill runs between pick and install).
+        # sp_chunked_prefill re-enters admission at chunk boundaries —
+        # without the reservation a nested _admit_one would pick the
+        # same first-None index and the outer install would orphan it.
+        self._reserved_slots: set[int] = set()
         self._queue: "queue.Queue[GenRequest]" = queue.Queue()
         self._seq_ids = itertools.count()
         self._stop = threading.Event()
@@ -1027,6 +1094,33 @@ class Engine:
             self._prefill_sp_fn = jax.jit(_prefill_sp_step,
                                           donate_argnums=(4,))
 
+        # sequence-sharded CHUNKED prefill: the prefill_suffix contract
+        # (resume at a page-aligned offset, full-window gather) with
+        # ring attention per chunk — the long-context path. Requires
+        # page_size % sp == 0 so the gathered page window shards evenly
+        # over the sp axis; other geometries (e.g. sp=6, page 128) fall
+        # back to the monolithic program above.
+        self._prefill_sp_suffix_fn = None
+        if (self._sp > 1 and self.fns.prefill_sp_suffix is not None
+                and cfg.sp_prefill_mode == "chunked"
+                and ps % self._sp == 0):
+            model_prefill_sp_suffix = self.fns.prefill_sp_suffix
+
+            def _prefill_sp_suffix_step(params, lora, tokens,
+                                        prefix_lens, seq_lens, kv,
+                                        page_table, keys, temp, top_p,
+                                        top_k, bias, adapter_idx):
+                logits, kv = model_prefill_sp_suffix(
+                    params, mc, tokens, prefix_lens, seq_lens, kv,
+                    page_table, ps, mesh=mesh, lora=lora,
+                    adapter_idx=adapter_idx,
+                )
+                return _sample_maybe_lp(logits + bias, keys, temp,
+                                        top_p, top_k), kv
+
+            self._prefill_sp_suffix_fn = jax.jit(
+                _prefill_sp_suffix_step, donate_argnums=(5,))
+
         def _decode_scan(k: int, lean: bool = False):
             """Factory: k fused decode+sample steps; sampled tokens feed
             forward on-device (no host round-trip inside the window).
@@ -1226,6 +1320,9 @@ class Engine:
         if self._prefill_sp_fn is not None:
             self.compile_tracker.register("prefill_sp",
                                           self._prefill_sp_fn)
+        if self._prefill_sp_suffix_fn is not None:
+            self.compile_tracker.register("prefill_sp_chunked",
+                                          self._prefill_sp_suffix_fn)
         # ragged packed prefill (the pallas-ragged backend's single
         # program family — one compiled shape per token-budget rung).
         # Attention impl: the Pallas kernel on TPU, the XLA windowed
@@ -1295,6 +1392,10 @@ class Engine:
         # of the burst currently being admitted
         self._burst_seq = itertools.count(1)
         self._cur_burst: tuple[int, int] = (0, 0)
+        # reentrancy latch for chunk-boundary admission: a short
+        # request admitted mid-chunk-loop may itself run a chunked
+        # (non-sp) prefill whose boundaries must NOT admit again
+        self._in_chunk_admit = False
         # prefill attention backend (tpuserve/attention.py): owns the
         # prefill programs + geometry policy behind _admit's dispatch
         from aigw_tpu.tpuserve.attention import make_attention_backend
@@ -1662,8 +1763,25 @@ class Engine:
         read of the tuple the engine thread refreshes."""
         return self._kv_digest
 
-    #: digest size bound: a replica advertises at most this many chains
+    #: digest size FLOOR: a replica always advertises at least this
+    #: many chain keys (the pre-long-context flat bound)
     KV_DIGEST_MAX = 4096
+
+    #: full-length chains the geometry-aware digest bound guarantees
+    #: room for (kv_digest_max below)
+    KV_DIGEST_MIN_CHAINS = 8
+
+    def kv_digest_max(self) -> int:
+        """Geometry-aware digest bound: ``max(KV_DIGEST_MAX,
+        KV_DIGEST_MIN_CHAINS * max_pages_per_seq)``. Chain keys are
+        per-PAGE hashes, so a single 128k chain at 128-token pages is
+        1024 keys — the flat 4096 bound silently truncated the
+        advertisement to ~4 long chains, making every later chain
+        invisible to the fleet KV index (unfetchable cross-replica)
+        even though this replica held its pages. The gateway-side
+        mirror is KVIndex.MAX_KEYS_PER_REPLICA (gateway/kvindex.py)."""
+        return max(self.KV_DIGEST_MAX,
+                   self.KV_DIGEST_MIN_CHAINS * self.cfg.max_pages_per_seq)
 
     @engine_thread_only
     def _refresh_kv_digest(self) -> None:
@@ -1677,11 +1795,12 @@ class Engine:
             keys.extend(self.host_tier.keys())
         out: list[str] = []
         seen: set = set()
+        bound = self.kv_digest_max()
         for k in keys:
             if k not in seen:
                 seen.add(k)
                 out.append(k.hex())
-                if len(out) >= self.KV_DIGEST_MAX:
+                if len(out) >= bound:
                     break
         self._kv_digest = tuple(out)
 
@@ -1924,6 +2043,11 @@ class Engine:
             # change) must not pay an XLA compile
             self._adapter_store.warm()
         self.attn.warm()
+        if self.cfg.warm_prefill_buckets > 0:
+            # the sequence-sharded chunked-prefill ladder is engine-
+            # owned (it preempts the backend for long suffixes), so the
+            # backend warm above never covers it
+            self._warm_sp_prefill_shapes()
         # migration page movers: a page export (device→host gather) or
         # an import at ANY page-count rung must never compile
         # mid-traffic — round-trip page 0 through the host exactly as a
@@ -1977,6 +2101,48 @@ class Engine:
                 jnp.full((G2,), self._base_row, jnp.int32),
             )
             G2 *= 2
+
+    def _warm_sp_prefill_shapes(self) -> None:
+        """Compile the sequence-sharded chunked-prefill surface: the
+        chunk program plus every tail rung at or below it, at each warm
+        page bucket large enough to ever host an sp prefill (the gather
+        window covers prompt+max_tokens >= sp_prefill_min_tokens, so
+        smaller buckets can never see the path). All-zero seq_lens:
+        padded-row semantics drop every K/V scatter and the last-index
+        gather clamps, so the calls only populate the jit cache. The
+        surface stays log-sized — (tail rungs <= chunk) x (eligible
+        pow2 buckets) — which is what keeps zero-hot-compile tripwires
+        green at 32k-128k geometry without warming a 128k monolithic
+        rung."""
+        if self._prefill_sp_suffix_fn is None:
+            return
+        cfg = self.cfg
+        sp = self._sp
+        chunk = max(cfg.sp_chunk_tokens, sp)
+        chunk = -(-chunk // sp) * sp
+        rungs = {chunk}
+        for t in range(1, chunk + 1):
+            rungs.add(self._prefill_bucket(t, multiple_of=sp))
+        min_need = -(-cfg.sp_prefill_min_tokens // cfg.page_size)
+        V = self.model_cfg.vocab_size
+        for P in self._warm_page_buckets():
+            if P < min_need:
+                continue
+            for S in sorted(rungs):
+                _, self.kv_cache = self._prefill_sp_suffix_fn(
+                    self.params, self.lora_params,
+                    jnp.zeros((1, S), jnp.int32),
+                    jnp.zeros((1,), jnp.int32),
+                    jnp.zeros((1,), jnp.int32),
+                    self.kv_cache,
+                    jnp.zeros((1, P), jnp.int32),
+                    jnp.zeros((1, 2), jnp.uint32),
+                    jnp.zeros((1,), jnp.float32),
+                    jnp.ones((1,), jnp.float32),
+                    jnp.zeros((1,), jnp.int32),
+                    jnp.zeros((1, V), jnp.float32),
+                    jnp.full((1,), self._base_row, jnp.int32),
+                )
 
     # -- prefill/decode disaggregation: KV page migration (ISSUE 8) --------
     def migrate_export(self, req: GenRequest,
@@ -2271,9 +2437,13 @@ class Engine:
 
     def _free_slot_index(self) -> int | None:
         for i, s in enumerate(self._slots):
-            if s is None:
+            if s is None and i not in self._reserved_slots:
                 return i
         return None
+
+    def _free_slot_count(self) -> int:
+        return sum(1 for i, s in enumerate(self._slots)
+                   if s is None and i not in self._reserved_slots)
 
     @engine_thread_only
     def _admit(self) -> bool:
@@ -2289,7 +2459,7 @@ class Engine:
         else takes the per-request path below."""
         admitted = False
         while True:
-            free = sum(1 for s in self._slots if s is None)
+            free = self._free_slot_count()
             if free == 0:
                 break
             pending: list[GenRequest] = []
@@ -2428,6 +2598,60 @@ class Engine:
                 break
         return admitted
 
+    @engine_thread_only
+    def _admit_interactive(self) -> bool:
+        """Chunk-boundary admission (long-context decode liveness):
+        called by ``sp_chunked_prefill`` between chunk steps. Pops the
+        queue, admits SHORT requests — below sp_prefill_min_tokens,
+        so they can never re-enter the sp chunk loop — into free slots
+        through the normal per-request path, and requeues everything
+        else in arrival order. An interactive request that arrives
+        behind a 128k prefill gets its first token at the next chunk
+        boundary (its own short prefill) and keeps streaming through
+        the boundary decode ticks, instead of waiting out the whole
+        long prefill. The fairness guard runs over the short subset,
+        so tenant caps hold at boundaries too. Reentrancy-latched: a
+        short admission's own chunked (non-sp) prefill must not admit
+        again from its boundaries."""
+        if self._in_chunk_admit:
+            return False
+        free = self._free_slot_count()
+        if free == 0:
+            return False
+        backlog: list[GenRequest] = []
+        try:
+            while True:
+                backlog.append(self._queue.get_nowait())
+        except queue.Empty:
+            pass
+        if not backlog:
+            return False
+        shorts = [r for r in backlog
+                  if len(r.prompt) < self.cfg.sp_prefill_min_tokens]
+        admitted = False
+        handled: set[int] = set()
+        self._in_chunk_admit = True
+        try:
+            admit, _fair_rq, capped = self._fair_admission(shorts, free)
+            self.stats.tenant_deferrals += capped
+            for req in admit:
+                if req.cancelled.is_set():
+                    handled.add(id(req))
+                    continue
+                _ok, chain = self._classify(req)
+                r = self._admit_one(req, chain)
+                if r == "stop":
+                    break  # shutdown: leave it (and the rest) queued
+                handled.add(id(req))
+                if r == "admitted":
+                    admitted = True
+                    self.stats.sp_interactive_admits += 1
+        finally:
+            self._in_chunk_admit = False
+        self._requeue_front_many(
+            [r for r in backlog if id(r) not in handled])
+        return admitted
+
     def _classify(self, req: GenRequest) -> tuple[bool, list]:
         """(simple, chain_keys): simple = eligible for the batched
         prefill (whole-prompt, no cached prefix to adopt, below the
@@ -2457,7 +2681,8 @@ class Engine:
                 # per-request path revives the spilled pages and
                 # resumes instead of re-prefilling
                 return False, chain
-        if (self._prefill_sp_fn is not None
+        if ((self._prefill_sp_fn is not None
+             or self._prefill_sp_suffix_fn is not None)
                 and n >= self.cfg.sp_prefill_min_tokens):
             return False, chain
         chunk = self.cfg.prefill_chunk_tokens
@@ -2582,6 +2807,20 @@ class Engine:
         slot_idx = self._free_slot_index()
         if slot_idx is None:  # defensive: caller bounds by free slots
             return "stop"
+        # the _Slot is not installed until AFTER the prefill, and
+        # sp_chunked_prefill re-enters admission (_admit_interactive)
+        # at chunk boundaries: reserve the index so a nested admission
+        # cannot pick it and get clobbered when this install lands.
+        # The finally also covers every abort return below.
+        self._reserved_slots.add(slot_idx)
+        try:
+            return self._admit_one_reserved(req, slot_idx, chain)
+        finally:
+            self._reserved_slots.discard(slot_idx)
+
+    @engine_thread_only
+    def _admit_one_reserved(self, req: GenRequest, slot_idx: int,
+                            chain: list | None) -> str:
         n = len(req.prompt)
         total = min(n + req.max_tokens, self.cfg.max_seq_len)
         seq_id = next(self._seq_ids)
@@ -2673,8 +2912,19 @@ class Engine:
 
         suffix = req.prompt[prefix_len:]
         ns = len(suffix)
+        # sp routing: the chunked path (ring-attention chunk steps with
+        # offset resume + decode interleaving) takes every long suffix;
+        # the monolithic full-rung program remains only for geometries
+        # the chunked program can't shard (page_size % sp != 0) or when
+        # sp_prefill_mode="monolithic" — and it still can't resume, so
+        # prefix hits there fall through to the single-device loop.
+        use_sp_chunked = (
+            self._prefill_sp_suffix_fn is not None
+            and ns >= self.cfg.sp_prefill_min_tokens
+        )
         use_sp = (
-            self._prefill_sp_fn is not None
+            not use_sp_chunked
+            and self._prefill_sp_fn is not None
             and prefix_len == 0
             and ns >= self.cfg.sp_prefill_min_tokens
         )
@@ -2741,7 +2991,26 @@ class Engine:
             bucket *= 2
         bucket = min(bucket, self.cfg.max_pages_per_seq)
 
-        if use_sp:
+        if use_sp_chunked:
+            # sequence-sharded chunked prefill: ring-attention chunk
+            # steps resuming at the cached page-aligned offset, decode
+            # ticks at the boundaries — the long-context path
+            # (tpuserve/attention.sp_chunked_prefill)
+            from aigw_tpu.tpuserve.attention import sp_chunked_prefill
+
+            res = sp_chunked_prefill(
+                self, req, seq_id, suffix, prefix_len, n, pt, bucket,
+                sampling_args)
+            if isinstance(res, str):
+                self._release_adapter_row(adapter_row)
+                self.allocator.free(seq_id)
+                return res
+            next_tok, info = res
+            self.stats.sp_prefills += 1
+            self.stats.sp_chunked_prefills += 1
+            if prefix_len:
+                self.stats.sp_resume_prefills += 1
+        elif use_sp:
             # ring attention shards the padded length over sp — the
             # divisibility guard rounds the chosen rung up to a
             # multiple of sp (non-power-of-two sp like 6 must not
@@ -2804,6 +3073,7 @@ class Engine:
         self.stats.prefills += 1
         prefill_ms = max(0.0, 1e3 * (time.monotonic() - t0) - tick_ms)
         self.stats.prefill_ms += prefill_ms
+        self.stats.note_prefill_call(prefill_ms, ns)
         self.phases.observe(
             "prefill", prefill_ms,
             req.trace.trace_id if req.trace is not None else "")
@@ -2812,7 +3082,7 @@ class Engine:
                 prefill_ms, bucket=info["bucket"],
                 padded_frac=info["padded_frac"],
                 chunks=info["chunks"],
-                resumed_at=eff_prefix, sp=use_sp)
+                resumed_at=eff_prefix, sp=use_sp or use_sp_chunked)
         t_first = time.monotonic()
         if self.prefix_cache is not None and chain_keys:
             self.prefix_cache.insert(chain_keys, pages,

@@ -400,9 +400,17 @@ class TPUServeServer:
         return model if model in self.adapter_names else ""
 
     async def _on_start(self, _app) -> None:
-        self.engine.start()
-        # compile the decode program off the request path
+        # compile the decode program off the request path — and BEFORE
+        # the engine loop exists: warmup donates kv_cache through
+        # dozens of jit calls, and a live engine thread reading
+        # self.kv_cache between a donated dispatch and its reassignment
+        # (the idle tick's _refresh_stats does exactly that) hits a
+        # deleted array and kills the loop. The startup hook runs
+        # before the listener accepts, so nothing is serving yet either
+        # way; to_thread only keeps the event loop's signal handling
+        # live during the (long) compile.
         await asyncio.to_thread(self.engine.warmup)
+        self.engine.start()
 
     async def _on_stop(self, _app) -> None:
         if self._kv_session is not None:
@@ -1821,6 +1829,25 @@ class TPUServeServer:
                 # bytes (≈ total/tp under tensor parallelism — the
                 # bench's memory-split claim), and the analytical ICI
                 # collective volume per decoded token
+                # long-context serving surface: the advertised context
+                # length + sp axis (the gateway picker's over-length
+                # filter rejects prompts no replica can hold, and its
+                # predicted-TTFT model prices prompt length with the
+                # measured per-token prefill rate), the sp prefill mode
+                # actually routing, and the chunked/resume counters
+                "max_seq_len": self.engine.cfg.max_seq_len,
+                "sp": self.engine._sp,
+                "sp_prefill_mode": (
+                    "chunked"
+                    if self.engine._prefill_sp_suffix_fn is not None
+                    else "monolithic"
+                    if self.engine._prefill_sp_fn is not None
+                    else "off"),
+                "sp_chunked_prefills": s.sp_chunked_prefills,
+                "sp_resume_prefills": s.sp_resume_prefills,
+                "sp_interactive_admits": s.sp_interactive_admits,
+                "prefill_ms_per_token": round(
+                    s.prefill_ms_per_token(), 4),
                 "mesh_axes": self.engine.mesh_axes(),
                 "mesh_devices": s.device_count,
                 "devices": self.engine.device_stats,

@@ -255,6 +255,7 @@ def _start_tpuserve_subproc(model_name: str, cfg, quantize: str,
                             param_dtype: str = "",
                             lora: dict | None = None,
                             tp: int = 1,
+                            sp: int = 1,
                             env_extra: dict | None = None):
     """Serve `model_name` over the real tpuserve HTTP surface in its own
     process (benchmarks/serve_child.py) — the deployment topology. The
@@ -274,7 +275,7 @@ def _start_tpuserve_subproc(model_name: str, cfg, quantize: str,
             "ffn_dim", "max_seq_len", "rope_theta")},
         "batch": batch, "page": page, "k": k_steps, "quantize": quantize,
         "engine": engine or {}, "param_dtype": param_dtype,
-        "lora": lora or {}, "tp": tp,
+        "lora": lora or {}, "tp": tp, "sp": sp,
     }
     here = os.path.dirname(os.path.abspath(__file__))
     proc = subprocess.Popen(
@@ -2924,6 +2925,223 @@ def kv_tier_numbers(reps: int = 3, arrivals: int = 4) -> dict:
         stop_b()
 
 
+_LONGCTX_SP = 8
+#: page_size % sp == 0 (16 % 8) so the chunked-sp suffix program builds;
+#: 4096-token sessions at 16-token pages = 256 pages — long enough that
+#: a monolithic sp prefill visibly starves queued short arrivals on the
+#: CPU backend, short enough that the leg fits the bench budget
+_LONGCTX_CFG = llama.LlamaConfig(
+    vocab_size=2048, dim=256, n_layers=4, n_heads=8, n_kv_heads=8,
+    ffn_dim=512, max_seq_len=4096, rope_theta=10000.0,
+)
+_LONGCTX_PAGE = 16
+_LONGCTX_LONG = 3500    # long-prompt tokens (byte tokenizer)
+_LONGCTX_SHORT = 48     # interactive prompt tokens (< sp_prefill_min)
+_LONGCTX_HEAD = 1664    # resume head: 104 full 16-token pages
+_LONGCTX_CONT = 512     # continuation ≥ sp_prefill_min → sp offset resume
+
+
+def _p95(xs: list[float]) -> float:
+    s = sorted(xs)
+    if not s:
+        return 0.0
+    return s[min(len(s) - 1, int(round(0.95 * (len(s) - 1))))]
+
+
+async def _longctx_stream(s, url: str, model: str, prompt: str,
+                          max_tokens: int) -> float:
+    """One streaming completion; returns TTFT ms (awaits the full
+    stream so the caller knows the session's slot is free after)."""
+    payload = {"model": model, "prompt": prompt,
+               "max_tokens": max_tokens, "temperature": 0.0,
+               "stream": True, "logit_bias": {"97": 100}}
+    ttft = -1.0
+    t0 = time.perf_counter()
+    async with s.post(url + "/v1/completions", json=payload) as resp:
+        assert resp.status == 200, resp.status
+        async for line in resp.content:
+            line = line.strip()
+            if (line.startswith(b"data: ") and b'"text"' in line
+                    and ttft < 0):
+                ttft = 1e3 * (time.perf_counter() - t0)
+    return ttft
+
+
+async def _longctx_cycle(s, url: str, model: str, tag: str,
+                         arrivals: int) -> tuple[list[float], float]:
+    """The decode-liveness probe: fire one long prompt, then — while
+    its prefill is in flight — a concurrent burst of short interactive
+    streams. Returns (interactive TTFTs ms, long TTFT ms). On the
+    chunked child the shorts admit at the next chunk boundary; on the
+    monolithic child they wait out the whole sharded prefill."""
+    long_prompt = (f"{tag}L" + "x" * _LONGCTX_LONG)[:_LONGCTX_LONG]
+    long_task = asyncio.ensure_future(
+        _longctx_stream(s, url, model, long_prompt, 4))
+    await asyncio.sleep(0.25)  # long prefill underway
+
+    async def one(i: int) -> float:
+        text = (f"{tag}i{i:02d} " + "q" * _LONGCTX_SHORT)
+        return await _longctx_stream(s, url, model,
+                                     text[:_LONGCTX_SHORT], 4)
+
+    ttfts = list(await asyncio.gather(*(one(i)
+                                        for i in range(arrivals))))
+    long_ttft = await long_task
+    return ttfts, long_ttft
+
+
+async def _longctx_resume_cycle(s, url: str, model: str,
+                                tag: str) -> tuple[float, float]:
+    """Warm-resume vs cold on the chunked child: prime a page-aligned
+    long head, re-ask head+continuation (prefix-cache partial hit →
+    the sp chunk loop resumes at the adopted offset, only the ≥512-
+    token suffix is computed), vs a cold prompt of the same total
+    length. Returns (warm TTFT ms, cold TTFT ms)."""
+    head = (f"{tag}h" + "s" * _LONGCTX_HEAD)[:_LONGCTX_HEAD]
+    await _longctx_stream(s, url, model, head, 2)  # prime the chain
+    warm = await _longctx_stream(
+        s, url, model, head + "c" * _LONGCTX_CONT, 4)
+    n = _LONGCTX_HEAD + _LONGCTX_CONT
+    cold = await _longctx_stream(
+        s, url, model, (f"{tag}x" + "z" * n)[:n], 4)
+    return warm, cold
+
+
+def longctx_numbers(reps: int = 3, arrivals: int = 4) -> dict:
+    """The ``--ab longctx`` leg (ISSUE 17): the same long-context
+    traffic against TWO sp=8 tpuserve children (8 virtual CPU devices)
+    — sequence-sharded CHUNKED prefill vs the MONOLITHIC sp path. The
+    portable claims:
+
+    - **decode liveness / interactive TTFT**: short streams fired
+      mid-long-prefill admit at chunk boundaries on the chunked child
+      (``sp_interactive_admits`` counts them) instead of waiting out
+      the whole sharded prefill — interactive TTFT p95 target ≥ 2×
+      better chunked vs monolithic;
+    - **offset resume**: re-asking a primed page-aligned head +
+      continuation resumes the chunk loop at the adopted offset
+      (``sp_resume_prefills``) — warm/cold TTFT ratio target ≤ 0.6;
+    - **padding tax**: the chunk rung ladder keeps the sp path's
+      padded_frac < 0.05 while the monolithic path pays the full
+      top-rung residue;
+    - **compile surface**: zero hot XLA compiles over the timed reps
+      at long-context geometry (CompileTracker tripwire).
+
+    Absolute ms is NOT the signal on CPU — ratios and counters are."""
+    import aiohttp
+
+    model_name = "bench-longctx-tiny"
+    k = int(os.environ.get("AIGW_BENCH_CPU_K", "4"))
+    engine_common = {
+        "min_prefill_bucket": 32, "kv_cache_dtype": "float32",
+        "max_queued_requests": 64, "num_pages": 768,
+        # interactive arrivals must hit the queue immediately — the
+        # leg measures chunk-boundary admission, not coalescing
+        "admission_coalesce_ms": 0.0,
+        # CPU-scale overrides: long prompts chunk at 256 tokens so a
+        # 3500-token prefill has ~13 boundaries on a 1-core host
+        "sp_prefill_min_tokens": 256, "sp_chunk_tokens": 256,
+        "warm_decode_buckets": 4,
+        # TTFT is the metric and the off-clock warm cycle absorbs the
+        # shape compiles; the spec ladder would only widen the warm
+        # surface and add draft nondeterminism to a random-weight rig
+        "spec_tokens": 0,
+    }
+    env = {"XLA_FLAGS":
+           f"--xla_force_host_platform_device_count={_LONGCTX_SP}"}
+    url_c, stop_c = _start_tpuserve_subproc(
+        model_name, _LONGCTX_CFG, "", batch=6, k_steps=k,
+        engine=dict(engine_common, sp_prefill_mode="chunked"),
+        page=_LONGCTX_PAGE, param_dtype="float32", sp=_LONGCTX_SP,
+        env_extra=env)
+    url_m, stop_m = _start_tpuserve_subproc(
+        model_name, _LONGCTX_CFG, "", batch=6, k_steps=k,
+        engine=dict(engine_common, sp_prefill_mode="monolithic"),
+        page=_LONGCTX_PAGE, param_dtype="float32", sp=_LONGCTX_SP,
+        env_extra=env)
+
+    async def run() -> dict:
+        await _wait_health(url_c, 1200)
+        await _wait_health(url_m, 1200)
+        timeout = aiohttp.ClientTimeout(total=1200)
+        async with aiohttp.ClientSession(timeout=timeout) as s:
+            # off the clock: one full cycle per child compiles every
+            # shape the timed reps touch (chunk rungs at each offset,
+            # the monolithic top rung, interactive singletons, decode
+            # page buckets, and the chunked child's resume suffix)
+            await _longctx_cycle(s, url_c, model_name, "w", arrivals)
+            await _longctx_cycle(s, url_m, model_name, "w", arrivals)
+            await _longctx_resume_cycle(s, url_c, model_name, "w")
+
+            st_c0 = await _get_state(s, url_c)
+            st_m0 = await _get_state(s, url_m)
+            c_int, m_int = [], []
+            c_long, m_long = [], []
+            warm_t, cold_t = [], []
+            for rep in range(reps):
+                ci, cl = await _longctx_cycle(
+                    s, url_c, model_name, f"r{rep}", arrivals)
+                mi, ml = await _longctx_cycle(
+                    s, url_m, model_name, f"r{rep}", arrivals)
+                c_int += [t for t in ci if t > 0]
+                m_int += [t for t in mi if t > 0]
+                c_long.append(cl)
+                m_long.append(ml)
+                w, c = await _longctx_resume_cycle(
+                    s, url_c, model_name, f"r{rep}")
+                if w > 0:
+                    warm_t.append(w)
+                if c > 0:
+                    cold_t.append(c)
+            st_c1 = await _get_state(s, url_c)
+            st_m1 = await _get_state(s, url_m)
+
+        def d(st0: dict, st1: dict, key: str) -> int:
+            return int(st1.get(key, 0)) - int(st0.get(key, 0))
+
+        ci95, mi95 = _p95(c_int), _p95(m_int)
+        warm, cold = _median(warm_t), _median(cold_t)
+        return {
+            "longctx_sp": _LONGCTX_SP,
+            "longctx_prompt_tokens": _LONGCTX_LONG,
+            "longctx_max_seq_len": int(
+                st_c1.get("max_seq_len", 0) or 0),
+            "longctx_interactive_ttft_ms_p95_chunked": round(ci95, 1),
+            "longctx_interactive_ttft_ms_p95_monolithic": round(
+                mi95, 1),
+            # ≥ 2.0 is the decode-liveness claim
+            "longctx_interactive_gain": (round(mi95 / ci95, 4)
+                                         if ci95 > 0 else 0.0),
+            "longctx_long_ttft_ms_p50_chunked": round(
+                _median(c_long), 1),
+            "longctx_long_ttft_ms_p50_monolithic": round(
+                _median(m_long), 1),
+            "longctx_resume_ttft_ms_p50": round(warm, 1),
+            "longctx_cold_ttft_ms_p50": round(cold, 1),
+            # ≤ 0.6 is the offset-resume claim
+            "longctx_resume_vs_cold": (round(warm / cold, 4)
+                                       if cold else 0.0),
+            "longctx_interactive_spread": round(_spread(c_int), 3),
+            "longctx_resume_spread": round(_spread(warm_t), 3),
+            "longctx_chunked_prefills": d(
+                st_c0, st_c1, "sp_chunked_prefills"),
+            "longctx_resume_prefills": d(
+                st_c0, st_c1, "sp_resume_prefills"),
+            "longctx_interactive_admits": d(
+                st_c0, st_c1, "sp_interactive_admits"),
+            "longctx_ab_reps": reps,
+            "longctx_arrivals": arrivals,
+            **_ragged_ab_fields(st_c0, st_c1, "longctx_chunked"),
+            **_ragged_ab_fields(st_m0, st_m1, "longctx_monolithic"),
+        }
+
+    try:
+        return asyncio.run(run())
+    finally:
+        stop_c()
+        stop_m()
+
+
 def _chip_responsive(timeout_s: float = 180.0) -> bool:
     """The axon tunnel can go down entirely (observed 2026-07-28); probe
     with a watchdog so the bench prints an honest line instead of hanging
@@ -3140,6 +3358,11 @@ def run_cpu_ratio() -> dict:
     except Exception as e:
         print(f"fleet_ctl leg failed: {type(e).__name__}: {e}",
               file=sys.stderr)
+    try:
+        res.update(longctx_numbers())
+    except Exception as e:
+        print(f"longctx leg failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
     return res
 
 
@@ -3327,13 +3550,27 @@ def main() -> None:
                 "goodput recovery ≥0.9× the pre-event window in a "
                 "bounded reported time, and zero hot XLA compiles on "
                 "the surviving replica are the claims (CPU backend)")
+        elif target == "longctx":
+            result = longctx_numbers()
+            result["metric"] = (
+                "longctx A/B — sequence-sharded chunked prefill "
+                "(ISSUE 17): the same long-context traffic against "
+                "two sp=8 children (8 virtual CPU devices) — chunked "
+                "vs monolithic sp prefill; short interactive streams "
+                "fired mid-long-prefill admit at chunk boundaries "
+                "(interactive TTFT p95 ≥ 2× better chunked) and a "
+                "primed head + continuation resumes the chunk loop "
+                "at the adopted page offset (warm/cold TTFT ≤ 0.6); "
+                "padded_frac < 0.05 on the chunk rung ladder and "
+                "zero hot XLA compiles at long-context geometry are "
+                "the guardrails (CPU backend; ratios are the signal)")
         else:
             print(json.dumps({"error": f"unknown --ab target {target!r}; "
                               "supported: prefix_cache, spec_decode, "
                               "ragged_prefill, lora, disagg, "
                               "slo_routing, structured, mesh, "
                               "kv_tier, fleet_obs, decode_fused, "
-                              "fleet_ctl"}))
+                              "fleet_ctl, longctx"}))
             return
         print(json.dumps(result))
         return

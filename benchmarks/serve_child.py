@@ -139,6 +139,9 @@ def main() -> None:
             # in the env BEFORE jax initializes — which is why the
             # mesh A/B runs through subprocess children at all)
             tp=int(spec.get("tp", 1)),
+            # sequence-parallel child for the --ab longctx leg (same
+            # XLA_FLAGS device-count contract as tp above)
+            sp=int(spec.get("sp", 1)),
             engine_cfg=EngineConfig(
                 max_batch_size=spec["batch"],
                 max_seq_len=cfg.max_seq_len,

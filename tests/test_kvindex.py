@@ -60,8 +60,27 @@ class TestKVIndex:
 
     def test_per_replica_ingest_bounded(self):
         idx = KVIndex()
-        idx.update("a:1", (f"k{i}" for i in range(10_000)))
+        idx.update("a:1", (f"k{i}"
+                           for i in range(KVIndex.MAX_KEYS_PER_REPLICA
+                                          + 10_000)))
         assert idx.chains == KVIndex.MAX_KEYS_PER_REPLICA
+
+    def test_long_context_digest_fits(self):
+        """Geometry regression (long-context satellite): the gateway
+        bound must hold the digest a 128k-context replica exports —
+        Engine.kv_digest_max() at max_pages_per_seq=1024 (128k tokens
+        / 128-token pages) advertises KV_DIGEST_MIN_CHAINS × 1024 =
+        8192 keys. Under the old flat 4096 bound the index silently
+        truncated that to ~4 long chains and fleet hits vanished."""
+        from aigw_tpu.tpuserve.engine import Engine
+
+        pages_128k = 128 * 1024 // 128
+        digest = Engine.KV_DIGEST_MIN_CHAINS * pages_128k
+        assert digest <= KVIndex.MAX_KEYS_PER_REPLICA
+        idx = KVIndex()
+        idx.update("a:1", (f"c{i}" for i in range(digest)))
+        assert idx.chains == digest  # nothing truncated
+        assert "a:1" in idx.replicas(f"c{digest - 1}")
 
     def test_empty_update_clears(self):
         idx = KVIndex()

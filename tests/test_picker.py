@@ -11,6 +11,8 @@ import pytest
 from aigw_tpu.gateway.picker import (
     AFFINITY_HEADER,
     PREFIX_HEADER,
+    PROMPT_TOKENS_HEADER,
+    ContextLengthError,
     Endpoint,
     EndpointPicker,
 )
@@ -576,3 +578,100 @@ class TestStaleness:
         p.observe("10.0.0.1:8011", kv_occupancy=0.1, max_slots=8)
         assert p.fleet.health_of("10.0.0.1:8011") == "up"
         assert p.fleet.health_of("10.0.0.2:8011") == "unknown"
+
+
+class TestLongContext:
+    """Long-context satellite: /state advertises max_seq_len +
+    prefill_ms_per_token; the picker filters candidates the prompt
+    doesn't fit and prices the prompt's prefill into predicted TTFT
+    instead of treating a 64k prompt as a p50 prefill."""
+
+    def test_over_length_filtered_to_fitting_replica(self):
+        p = make_picker()
+        p.observe("10.0.0.1:8011", kv_occupancy=0.1, max_slots=8,
+                  max_seq_len=8192)
+        p.observe("10.0.0.2:8011", kv_occupancy=0.5, max_slots=8,
+                  max_seq_len=131072)
+        p.observe("10.0.0.3:8011", kv_occupancy=0.2, max_slots=8,
+                  max_seq_len=8192)
+        explain: dict = {}
+        # 32k prompt: only the 128k replica fits, despite worse load
+        got = p.pick({PROMPT_TOKENS_HEADER: "32768"}, explain=explain)
+        assert got == "10.0.0.2:8011"
+        assert explain["ctx_filtered"] == 2
+
+    def test_over_length_everywhere_raises_not_round_robins(self):
+        p = make_picker()
+        for a in ("10.0.0.1:8011", "10.0.0.2:8011", "10.0.0.3:8011"):
+            p.observe(a, kv_occupancy=0.1, max_slots=8,
+                      max_seq_len=8192)
+        with pytest.raises(ContextLengthError) as ei:
+            p.pick({PROMPT_TOKENS_HEADER: "32768"})
+        assert ei.value.prompt_tokens == 32768
+        assert ei.value.max_ctx == 8192
+
+    def test_unadvertised_length_never_filters(self):
+        """Replicas predating the max_seq_len export (0) must keep
+        routing — the filter is opt-in by advertisement."""
+        p = make_picker()
+        for a in ("10.0.0.1:8011", "10.0.0.2:8011", "10.0.0.3:8011"):
+            p.observe(a, kv_occupancy=0.1, max_slots=8)
+        assert p.pick({PROMPT_TOKENS_HEADER: "1000000"}) is not None
+
+    def test_garbage_header_ignored(self):
+        p = make_picker()
+        for a in ("10.0.0.1:8011", "10.0.0.2:8011", "10.0.0.3:8011"):
+            p.observe(a, kv_occupancy=0.1, max_slots=8,
+                      max_seq_len=256)
+        assert p.pick({PROMPT_TOKENS_HEADER: "lots"}) is not None
+
+    def test_observe_without_sp_keeps_advertised_axis(self):
+        """A push-fed observe() that omits sp (migration orchestrator,
+        tests) must not reset a polled replica's advertised sp axis to
+        the default — same guard as max_seq_len/prefill_ms_per_token."""
+        p = make_picker()
+        p.observe("10.0.0.1:8011", kv_occupancy=0.1, max_slots=8,
+                  sp=8, max_seq_len=131072,
+                  prefill_ms_per_token=0.01)
+        p.observe("10.0.0.1:8011", kv_occupancy=0.4)
+        st = p.state["10.0.0.1:8011"]
+        assert st.sp == 8
+        assert st.max_seq_len == 131072
+        assert st.prefill_ms_per_token == 0.01
+
+    def test_prompt_priced_ttft(self):
+        """predicted_ttft_ms charges the excess of the prompt's priced
+        prefill over the p50 round — and only the excess, so short
+        prompts keep the pure histogram prediction."""
+        p = make_slo_picker()
+        p.observe("10.0.0.1:8011", queued=0,
+                  phase_percentiles=_pp(50.0),
+                  prefill_ms_per_token=0.01)
+        st = p.state["10.0.0.1:8011"]
+        assert p.predicted_ttft_ms(st) == 50.0
+        assert p.predicted_ttft_ms(st, 1000) == 50.0  # 10ms < p50
+        # 64k tokens × 0.01 ms = 640ms priced prefill, excess 590
+        assert p.predicted_ttft_ms(st, 65536) == pytest.approx(
+            50.0 + 65536 * 0.01 - 50.0)
+        # un-priced replica (no rate exported): unchanged
+        p.observe("10.0.0.2:8011", queued=0,
+                  phase_percentiles=_pp(50.0))
+        st2 = p.state["10.0.0.2:8011"]
+        assert p.predicted_ttft_ms(st2, 65536) == 50.0
+
+    def test_slo_mode_routes_long_prompt_to_cheap_prefill(self):
+        """In slo mode a long prompt prefers the replica whose
+        measured per-token prefill rate (the chunked-sp replica) is
+        lower, even when short-prompt histograms tie."""
+        p = make_slo_picker()
+        p.observe("10.0.0.1:8011", phase_percentiles=_pp(50.0),
+                  prefill_ms_per_token=0.05, max_seq_len=131072)
+        p.observe("10.0.0.2:8011", phase_percentiles=_pp(50.0),
+                  prefill_ms_per_token=0.01, max_seq_len=131072)
+        p.observe("10.0.0.3:8011", phase_percentiles=_pp(50.0),
+                  prefill_ms_per_token=0.05, max_seq_len=131072)
+        explain: dict = {}
+        got = p.pick({PROMPT_TOKENS_HEADER: "65536"}, explain=explain)
+        assert got == "10.0.0.2:8011"
+        # short prompts still tie (any candidate is fine)
+        assert p.pick({PROMPT_TOKENS_HEADER: "100"}) is not None

@@ -127,6 +127,27 @@ def test_engine_gauges_map_matches_engine_stats():
         assert hasattr(stats, attr), attr
 
 
+def test_prefill_rate_decays_to_recent_mix():
+    """The advertised prefill_ms_per_token must track a traffic-mix
+    change (token-decayed mean), not the process-lifetime average: a
+    long steady history at one rate converges to a NEW rate within a
+    few half-lives of tokens — and falls back to the lifetime mean
+    before any call is observed."""
+    from aigw_tpu.tpuserve.engine import EngineStats
+
+    st = EngineStats()
+    st.prefill_ms, st.prefill_tokens_real = 500.0, 100_000
+    assert st.prefill_ms_per_token() == pytest.approx(0.005)
+    # 1M tokens at 0.005 ms/tok, then 3 half-lives at 0.05 ms/tok
+    for _ in range(100):
+        st.note_prefill_call(0.005 * 10_000, 10_000)
+    for _ in range(3):
+        st.note_prefill_call(0.05 * 16_384, 16_384)
+    rate = st.prefill_ms_per_token()
+    assert 0.04 < rate <= 0.05, rate  # lifetime mean would sit ≈ 0.007
+    st.note_prefill_call(10.0, 0)  # zero-token calls never divide
+
+
 def test_engine_histograms_match_engine_phases():
     """Histogram-surface drift check (ISSUE 5): every ENGINE_HISTOGRAMS
     phase must exist in EnginePhases under its declared Prometheus

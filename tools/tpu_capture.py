@@ -90,6 +90,55 @@ def _ici_microbench(reps: int = 20) -> dict:
     }
 
 
+def _ring_seq_microbench(reps: int = 20) -> dict:
+    """Measured ICI on the SEQUENCE axis: time one ``ppermute`` hop of
+    a ring-attention K/V block over every local device — the neighbor
+    exchange one sp-sharded prefill chunk pays (sp-1) times per ring
+    pass (aigw_tpu/ops/ring_attention.py). Block shape matches the
+    8B-class geometry the chunked-sp path serves: 8 KV heads × 512
+    local tokens × 128 head dim, K and V together, f32 so the bytes
+    are exact. Reported next to the priced link bandwidth so the
+    sequence-axis row of the capture is measured-vs-model, same as
+    the psum row above."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec
+
+    devs = jax.local_devices()
+    n = len(devs)
+    if n < 2:
+        return {}
+    mesh = Mesh(np.array(devs), ("x",))
+    # [2(K,V), n_kv_heads, S_loc, head_dim] — one device's ring block
+    kv = jnp.ones((2, 8, 512, 128), jnp.float32)
+    block_bytes = kv.size * 4
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    from jax.experimental.shard_map import shard_map
+
+    fn = jax.jit(shard_map(
+        lambda x: jax.lax.ppermute(x, "x", perm), mesh=mesh,
+        in_specs=PartitionSpec(), out_specs=PartitionSpec(),
+        check_rep=False))
+    fn(kv).block_until_ready()  # compile off the clock
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(kv)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / reps
+    return {
+        "ring_devices": n,
+        "ring_hop_us": round(dt * 1e6, 2),
+        # each chip sends its whole block to one neighbor per hop
+        "ring_gbps_measured": round(block_bytes / dt / 1e9, 2),
+        "ring_gbps_priced": ICI_GBPS_PRICED,
+        # a full ring pass rotates the block (n-1) times per chip —
+        # the sequence-axis volume one chunk's attention prices
+        "ring_pass_bytes_per_chip": block_bytes * (n - 1),
+    }
+
+
 def main() -> int:
     import jax
 
@@ -123,6 +172,7 @@ def main() -> int:
         "mfu_context": ctx,
     })
     capture.update(_ici_microbench())
+    capture.update(_ring_seq_microbench())
     path = persist.save("tpu_capture", capture)
     capture["artifact"] = path
     print("TPU_CAPTURE " + json.dumps(capture))
