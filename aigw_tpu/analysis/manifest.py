@@ -98,6 +98,12 @@ STATE_ONLY: dict[str, str] = {
                             "token half-life; lifetime mean until the "
                             "first observed call) — the picker's "
                             "prompt-length TTFT pricing rate",
+    # MoE serving surface (ISSUE 18)
+    "moe_expert_load": "per-expert token list [E]; /metrics renders "
+                       "the labeled tpuserve_moe_expert_load twins",
+    "moe_layer_drops": "per-layer capacity-drop list [L]; /metrics "
+                       "renders the labeled tpuserve_moe_layer_drops "
+                       "twins",
 }
 
 
@@ -146,12 +152,15 @@ GROUPS: dict[str, Group] = {
     "fleetobs": Group(
         exact=("replica_id", "started_at", "uptime_s",
                "ttft_hist_buckets", "draining")),
+    "moe": Group(prefixes=("moe_",)),
 }
 
 #: /metrics substrings a group's smoke must also assert on but that are
 #: not plain ENGINE_GAUGES families (labeled info gauges).
 EXTRA_METRICS: dict[str, tuple[str, ...]] = {
     "memory": ('tpuserve_decode_attn_impl{impl="',),
+    "moe": ('tpuserve_moe_expert_load{expert="',
+            'tpuserve_moe_layer_drops{layer="'),
 }
 
 

@@ -117,6 +117,11 @@ THREAD_DOMAINS: tuple[ThreadDomain, ...] = (
             "_steady_ticks",
             "_kv_digest",
             "_kv_digest_next",
+            # MoE routing accumulators (ISSUE 18): numpy [E] / [L]
+            # arrays _fold_moe grows from program routing-stats leaves
+            # — folded at drain/prefill settle, both engine-thread-only
+            "_moe_expert_tokens",
+            "_moe_layer_drops",
         ),
     ),
 )

@@ -227,6 +227,13 @@ class EndpointState:
     max_seq_len: int = 0
     sp: int = 1
     prefill_ms_per_token: float = 0.0
+    # MoE serving (ISSUE 18): hottest-expert load ratio polled from
+    # /state (max expert tokens / mean; 1.0 = perfectly balanced, 0.0 =
+    # dense replica — the term vanishes). PR 10 worst-device discipline
+    # extended to expert shards: an expert-parallel replica's step time
+    # is its hottest expert's, so imbalance prices the replica even
+    # when slots and queue look fine.
+    moe_expert_imbalance: float = 0.0
 
     def staleness_s(self, now: float | None = None) -> float:
         """Seconds since the last successful poll (-1 = never)."""
@@ -464,6 +471,8 @@ class EndpointPicker:
         st.sp = max(1, int(data.get("sp", 1) or 1))
         st.prefill_ms_per_token = float(
             data.get("prefill_ms_per_token", 0.0) or 0.0)
+        st.moe_expert_imbalance = float(
+            data.get("moe_expert_imbalance", 0.0) or 0.0)
         st.poll_failures = 0
         st.last_poll_ok_ts = time.monotonic()
         st.updated_at = time.monotonic()
@@ -489,7 +498,8 @@ class EndpointPicker:
                 kv_chains: tuple = (),
                 max_seq_len: int = 0,
                 sp: int = 1,
-                prefill_ms_per_token: float = 0.0) -> None:
+                prefill_ms_per_token: float = 0.0,
+                moe_expert_imbalance: float = 0.0) -> None:
         st = self.state[address]
         st.healthy = True
         st.kv_occupancy = kv_occupancy
@@ -526,6 +536,8 @@ class EndpointPicker:
             st.sp = sp
         if prefill_ms_per_token:
             st.prefill_ms_per_token = prefill_ms_per_token
+        if moe_expert_imbalance:
+            st.moe_expert_imbalance = moe_expert_imbalance
         st.poll_failures = 0
         st.last_poll_ok_ts = time.monotonic()
         st.updated_at = time.monotonic()
@@ -565,6 +577,16 @@ class EndpointPicker:
     #: affinities it is a constant against unbounded load terms, so it
     #: never beats saturation.
     KV_FLEET_BONUS = 0.25
+    #: MoE expert-imbalance penalty (ISSUE 18): scales with how far the
+    #: replica's hottest expert runs above the mean (imbalance − 1,
+    #: clamped to [0, 1]) — an expert-parallel step is as slow as its
+    #: hottest expert shard, so a skewed router prices the replica like
+    #: a hot device. BOUNDED by the constant: below STICKINESS_MARGIN
+    #: (session KV locality still outranks router skew — moving a
+    #: session costs more than a slow expert) and above
+    #: ADAPTER_AFFINITY_BONUS (a saturated expert shard outweighs a
+    #: warm LoRA row). 0 on dense replicas — the term vanishes.
+    MOE_IMBALANCE_PENALTY = 0.25
     _AFFINITY_MAX = 100_000
 
     # -- slo mode (ISSUE 8) -------------------------------------------------
@@ -752,6 +774,11 @@ class EndpointPicker:
                 # backends without memory stats — the term vanishes.
                 + st.worst_hbm_frac()
             )
+            if st.moe_expert_imbalance > 1.0:
+                # MoE router skew (ISSUE 18): price the replica by its
+                # hottest expert — bounded so load terms still dominate
+                score += self.MOE_IMBALANCE_PENALTY * min(
+                    1.0, st.moe_expert_imbalance - 1.0)
             if prev_slice and self._slice_of(e.address) != prev_slice:
                 score += self.SLICE_PENALTY
             if prefix_addr == e.address:

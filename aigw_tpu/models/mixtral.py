@@ -104,8 +104,16 @@ def init_params(key: jax.Array, cfg: MixtralConfig,
 
 
 def moe_mlp(p: dict[str, jax.Array], i: int, x: jax.Array,
-            cfg: MixtralConfig) -> jax.Array:
-    """Top-k sparse MLP over flattened tokens. x: [B, S, D] → [B, S, D]."""
+            cfg: MixtralConfig, tape: list | None = None) -> jax.Array:
+    """Top-k sparse MLP over flattened tokens. x: [B, S, D] → [B, S, D].
+
+    ``tape`` is a trace-time accumulator: when a list is passed, each
+    layer appends one ``[E + 1]`` int32 vector — per-expert placed
+    (token, k) assignments followed by the count the capacity fence
+    dropped — which the family entry points stack into the ``[L, E+1]``
+    routing-stats leaf behind their ``moe_stats`` kwarg. Counts are
+    over every row the program processed, padding included: they are
+    truthful to device compute, not to prompt text."""
     B, S, D = x.shape
     T = B * S
     E, K = cfg.n_experts, cfg.experts_per_token
@@ -129,6 +137,10 @@ def moe_mlp(p: dict[str, jax.Array], i: int, x: jax.Array,
     # dispatch [T, E, C]
     dispatch = jnp.einsum("tke,tkc->tec", choice, pos_oh)
     combine = jnp.einsum("tke,tkc,tk->tec", choice, pos_oh, weights)
+    if tape is not None:
+        placed = jnp.sum(dispatch, axis=(0, 2)).astype(jnp.int32)  # [E]
+        dropped = jnp.sum(~keep).astype(jnp.int32)
+        tape.append(jnp.concatenate([placed, dropped[None]]))
 
     xe = jnp.einsum("tec,td->ecd", dispatch, xt.astype(jnp.float32))
     xe = xe.astype(x.dtype)
@@ -144,24 +156,90 @@ def moe_mlp(p: dict[str, jax.Array], i: int, x: jax.Array,
     return out.astype(x.dtype).reshape(B, S, D)
 
 
-def _mlp_fn(cfg: MixtralConfig):
-    return lambda p, i, x: moe_mlp(p, i, x, cfg)
+def _mlp_fn(cfg: MixtralConfig, tape: list | None = None):
+    return lambda p, i, x: moe_mlp(p, i, x, cfg, tape=tape)
+
+
+def _with_moe(out, tape):
+    """(logits, kv) + a traced tape → (logits, kv, [L, E+1] stats)."""
+    logits, kv_cache = out
+    return logits, kv_cache, jnp.stack(tape)
+
+
+# Every entry point of the llama skeleton is delegated with the MoE MLP
+# plugged in — full feature parity (ragged prefill, chunked suffix
+# resume, sequence-parallel prefill, fused decode, spec-decode verify),
+# no family rows left in the fallback matrices. The static ``moe_stats``
+# kwarg turns on the routing-stats leaf: the engine jits its programs
+# with moe_stats=True for MoE families, so per-expert load and
+# capacity drops ride the results it already fetches — no extra
+# device→host sync. LoRA is llama-family-only for now; the args are
+# accepted for interface parity.
 
 
 def prefill(p, cfg: MixtralConfig, tokens, seq_lens, kv_cache, page_table,
-            page_size, lora=None, adapter_idx=None):
-    # LoRA is llama-family-only for now; args accepted for interface parity
-    return llama.prefill(p, cfg.as_llama(), tokens, seq_lens, kv_cache,
-                         page_table, page_size, mlp=_mlp_fn(cfg))
+            page_size, lora=None, adapter_idx=None, moe_stats=False):
+    tape: list | None = [] if moe_stats else None
+    out = llama.prefill(p, cfg.as_llama(), tokens, seq_lens, kv_cache,
+                        page_table, page_size, mlp=_mlp_fn(cfg, tape))
+    return _with_moe(out, tape) if moe_stats else out
+
+
+def prefill_suffix(p, cfg: MixtralConfig, tokens, prefix_lens, seq_lens,
+                   kv_cache, page_table, page_size, lora=None,
+                   adapter_idx=None, moe_stats=False):
+    tape: list | None = [] if moe_stats else None
+    out = llama.prefill_suffix(p, cfg.as_llama(), tokens, prefix_lens,
+                               seq_lens, kv_cache, page_table, page_size,
+                               mlp=_mlp_fn(cfg, tape))
+    return _with_moe(out, tape) if moe_stats else out
+
+
+def prefill_sp(p, cfg: MixtralConfig, tokens, seq_lens, kv_cache,
+               page_table, page_size, *, mesh, strategy="ring", lora=None,
+               adapter_idx=None, moe_stats=False):
+    tape: list | None = [] if moe_stats else None
+    out = llama.prefill_sp(p, cfg.as_llama(), tokens, seq_lens, kv_cache,
+                           page_table, page_size, mesh=mesh,
+                           strategy=strategy, mlp=_mlp_fn(cfg, tape))
+    return _with_moe(out, tape) if moe_stats else out
+
+
+def prefill_sp_suffix(p, cfg: MixtralConfig, tokens, prefix_lens, seq_lens,
+                      kv_cache, page_table, page_size, *, mesh, lora=None,
+                      adapter_idx=None, moe_stats=False):
+    tape: list | None = [] if moe_stats else None
+    out = llama.prefill_sp_suffix(p, cfg.as_llama(), tokens, prefix_lens,
+                                  seq_lens, kv_cache, page_table,
+                                  page_size, mesh=mesh,
+                                  mlp=_mlp_fn(cfg, tape))
+    return _with_moe(out, tape) if moe_stats else out
+
+
+def prefill_ragged(p, cfg: MixtralConfig, tokens, row_seq, positions,
+                   last_rows, kv_cache, page_table, page_size, *,
+                   attn_impl="", lora=None, adapter_idx=None,
+                   moe_stats=False):
+    # the packed [T, 1, D] token stream reuses the per-token rope/matmul
+    # helpers; the dispatch/combine einsums are shape-agnostic over the
+    # flattened token axis, so MoE rides the ragged stream unchanged
+    tape: list | None = [] if moe_stats else None
+    out = llama.prefill_ragged(p, cfg.as_llama(), tokens, row_seq,
+                               positions, last_rows, kv_cache, page_table,
+                               page_size, attn_impl=attn_impl,
+                               mlp=_mlp_fn(cfg, tape))
+    return _with_moe(out, tape) if moe_stats else out
 
 
 def decode_step(p, cfg: MixtralConfig, tokens, positions, kv_cache,
                 page_table, page_size, active, lora=None, adapter_idx=None,
-                attn_impl="", mesh=None):
-    return llama.decode_step(p, cfg.as_llama(), tokens, positions, kv_cache,
-                             page_table, page_size, active,
-                             mlp=_mlp_fn(cfg), attn_impl=attn_impl,
-                             mesh=mesh)
+                attn_impl="", mesh=None, moe_stats=False):
+    tape: list | None = [] if moe_stats else None
+    out = llama.decode_step(p, cfg.as_llama(), tokens, positions, kv_cache,
+                            page_table, page_size, active,
+                            mlp=_mlp_fn(cfg, tape), attn_impl=attn_impl,
+                            mesh=mesh)
+    return _with_moe(out, tape) if moe_stats else out
 
 
 def hidden_states(p, cfg: MixtralConfig, tokens, seq_lens):
@@ -171,7 +249,10 @@ def hidden_states(p, cfg: MixtralConfig, tokens, seq_lens):
 
 def verify_step(p, cfg: MixtralConfig, tokens, positions, kv_cache,
                 page_table, page_size, active, limits,
-                lora=None, adapter_idx=None, attn_impl=""):
-    return llama.verify_step(p, cfg.as_llama(), tokens, positions, kv_cache,
-                             page_table, page_size, active, limits,
-                             mlp=_mlp_fn(cfg), attn_impl=attn_impl)
+                lora=None, adapter_idx=None, attn_impl="",
+                moe_stats=False):
+    tape: list | None = [] if moe_stats else None
+    out = llama.verify_step(p, cfg.as_llama(), tokens, positions, kv_cache,
+                            page_table, page_size, active, limits,
+                            mlp=_mlp_fn(cfg, tape), attn_impl=attn_impl)
+    return _with_moe(out, tape) if moe_stats else out

@@ -47,9 +47,15 @@ class ModelFns:
     # engine's prompt-lookup speculation for the family
     verify_step: Any = None
     # packed variable-length prefill (one program per token-budget
-    # chunk); None disables the engine's ragged attention backend for
-    # the family (it falls back to xla-bucketed)
+    # chunk). Every registered family provides it; None remains only as
+    # the hand-built-ModelFns escape hatch (it falls the attention
+    # backend back to xla-bucketed)
     prefill_ragged: Any = None
+    # static kwarg contract: entry points accept ``moe_stats=True`` and
+    # return a trailing [L, E+1] int32 routing-stats leaf (per-expert
+    # placed counts + capacity drops per layer). The engine turns it on
+    # for MoE families (configs carrying ``n_experts``)
+    moe_stats: bool = False
 
 
 def family_fns(family: str) -> ModelFns:
@@ -66,7 +72,12 @@ def family_fns(family: str) -> ModelFns:
 
         return ModelFns(mixtral.init_params, mixtral.prefill,
                         mixtral.decode_step, mixtral.hidden_states,
-                        verify_step=mixtral.verify_step)
+                        prefill_suffix=mixtral.prefill_suffix,
+                        prefill_sp=mixtral.prefill_sp,
+                        prefill_sp_suffix=mixtral.prefill_sp_suffix,
+                        verify_step=mixtral.verify_step,
+                        prefill_ragged=mixtral.prefill_ragged,
+                        moe_stats=True)
     raise KeyError(f"unknown model family {family!r}")
 
 

@@ -246,6 +246,18 @@ ENGINE_GAUGES: tuple[tuple[str, str], ...] = (
     # decode_attn_impl/decode_attn_reason.
     ("kv_quant_bits", "tpuserve_kv_quant_bits"),
     ("kv_bytes_per_token", "tpuserve_kv_bytes_per_token"),
+    # MoE serving (ISSUE 18, expert-parallel families): tokens the
+    # router PLACED into expert capacity slots vs tokens DROPPED at the
+    # capacity limit (both count padding rows — truthful to device
+    # compute), the drop fraction, and the hottest-expert load ratio
+    # (max expert tokens / mean — 1.0 is perfectly balanced). The
+    # imbalance gauge is the picker's MoE pricing signal: PR 10
+    # worst-device discipline, a replica is as fast as its hottest
+    # expert. Constant 0 on dense families.
+    ("moe_tokens_routed", "tpuserve_moe_tokens_routed_total"),
+    ("moe_tokens_dropped", "tpuserve_moe_tokens_dropped_total"),
+    ("moe_dropped_frac", "tpuserve_moe_dropped_frac"),
+    ("moe_expert_imbalance", "tpuserve_moe_expert_imbalance"),
 )
 
 #: per-device gauge surface (ISSUE 10): key in one entry of
@@ -275,6 +287,23 @@ def render_device_gauges(devices: list) -> bytes:
         for key, name in DEVICE_GAUGES:
             lines.append(f'{name}{{device="{label}"}} {dev.get(key, 0)}')
     return ("\n".join(lines) + "\n").encode() if lines else b""
+
+
+def render_moe_gauges(expert_load: list, layer_drops: list) -> bytes:
+    """MoE per-expert / per-layer accumulators → labeled Prometheus
+    gauges (appended to tpuserve's /metrics on MoE families only;
+    dense families contribute zero bytes). The /state twins are the
+    ``moe_expert_load`` / ``moe_layer_drops`` list fields — same
+    ordering, expert index = gauge label."""
+    if not expert_load and not layer_drops:
+        return b""
+    lines = ["# TYPE tpuserve_moe_expert_load gauge"]
+    for e, n in enumerate(expert_load):
+        lines.append(f'tpuserve_moe_expert_load{{expert="{e}"}} {n}')
+    lines.append("# TYPE tpuserve_moe_layer_drops gauge")
+    for layer, n in enumerate(layer_drops):
+        lines.append(f'tpuserve_moe_layer_drops{{layer="{layer}"}} {n}')
+    return ("\n".join(lines) + "\n").encode()
 
 
 #: fleet rollup surface (ISSUE 12): key in ``FleetState.rollup()`` →

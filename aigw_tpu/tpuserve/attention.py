@@ -230,7 +230,7 @@ class XlaBucketedBackend(AttentionBackend):
                 if 0 <= tok_id < V:
                     bias[g, tok_id] = b
             adapter[g] = eng._adapter_row_of(req)
-        next_tok, eng.kv_cache = eng._prefill_fn(
+        next_tok, eng.kv_cache, moe = eng._prefill_fn(
             eng.params, eng.lora_params, jnp.asarray(tokens),
             jnp.asarray(seq_lens), eng.kv_cache, jnp.asarray(pt),
             jnp.asarray(keys), jnp.asarray(temp), jnp.asarray(top_p),
@@ -246,6 +246,9 @@ class XlaBucketedBackend(AttentionBackend):
             lp_data = (np.asarray(chosen), np.asarray(tk_ids),
                        np.asarray(tk_vals))
         toks = np.asarray(next_tok)
+        # token fetch above already synced the program; the fold is a
+        # free host-side np add on the settled routing-stats leaf
+        eng._fold_moe(moe)
         self._account(int(seq_lens.sum()), G2 * S)
         prefill_ms = 1e3 * (time.monotonic() - t0)
         eng.stats.prefill_ms += prefill_ms
@@ -287,6 +290,10 @@ class XlaBucketedBackend(AttentionBackend):
         # kernel with prefix_lens=consumed IS the chunk step)
         chunk = cfg.prefill_chunk_tokens
         consumed = 0
+        # chunk-step routing-stats leaves settle with their programs;
+        # fold them only at the end so the host never syncs mid-loop
+        # (the decode interleave between chunks stays pipelined)
+        moes: list = []
         if (chunk > 0 and eng.fns.prefill_suffix is not None
                 and ns > chunk):
             # loop-invariant device uploads hoisted; each boundary
@@ -302,7 +309,7 @@ class XlaBucketedBackend(AttentionBackend):
                         return "stop_consumed"
                     return "skipped"
                 ctokens[0, :] = suffix[consumed:consumed + chunk]
-                _, eng.kv_cache = eng._prefill_suffix_fn(
+                _, eng.kv_cache, cmoe = eng._prefill_suffix_fn(
                     eng.params,
                     eng.lora_params,
                     jnp.asarray(ctokens),
@@ -313,6 +320,7 @@ class XlaBucketedBackend(AttentionBackend):
                     pt_dev,
                     *sampling_args,
                 )
+                moes.append(cmoe)
                 consumed += chunk
                 self._account(chunk, chunk)
                 eng.stats.chunked_prefill_steps += 1
@@ -333,7 +341,7 @@ class XlaBucketedBackend(AttentionBackend):
         tokens = np.zeros((1, S), np.int32)
         tokens[0, :ns_tail] = tail
         if eff_prefix:
-            next_tok, eng.kv_cache = eng._prefill_suffix_fn(
+            next_tok, eng.kv_cache, moe = eng._prefill_suffix_fn(
                 eng.params,
                 eng.lora_params,
                 jnp.asarray(tokens),
@@ -344,7 +352,7 @@ class XlaBucketedBackend(AttentionBackend):
                 *sampling_args,
             )
         else:
-            next_tok, eng.kv_cache = eng._prefill_fn(
+            next_tok, eng.kv_cache, moe = eng._prefill_fn(
                 eng.params,
                 eng.lora_params,
                 jnp.asarray(tokens),
@@ -353,6 +361,9 @@ class XlaBucketedBackend(AttentionBackend):
                 jnp.asarray(pt),
                 *sampling_args,
             )
+        moes.append(moe)
+        for m in moes:
+            eng._fold_moe(m)
         self._account(ns_tail, S)
         return next_tok, {
             "consumed": consumed, "tick_ms": tick_ms, "bucket": S,
@@ -390,6 +401,9 @@ def sp_chunked_prefill(eng, req, seq_id: int, suffix: list[int],
     # covering the sequence (page_size % sp == 0 is build-gated, so
     # the window shards evenly)
     pt_dev = jnp.asarray(pt[:, :bucket])
+    # folded only after the tail call — no mid-loop host sync (the
+    # interactive admits + decode ticks between chunks stay pipelined)
+    moes: list = []
     if ns > chunk:
         ctokens = np.zeros((1, chunk), np.int32)
         while ns - consumed > chunk:
@@ -402,7 +416,7 @@ def sp_chunked_prefill(eng, req, seq_id: int, suffix: list[int],
                     return "stop_consumed"
                 return "skipped"
             ctokens[0, :] = suffix[consumed:consumed + chunk]
-            _, eng.kv_cache = eng._prefill_sp_suffix_fn(
+            _, eng.kv_cache, cmoe = eng._prefill_sp_suffix_fn(
                 eng.params,
                 eng.lora_params,
                 jnp.asarray(ctokens),
@@ -412,6 +426,7 @@ def sp_chunked_prefill(eng, req, seq_id: int, suffix: list[int],
                 pt_dev,
                 *sampling_args,
             )
+            moes.append(cmoe)
             consumed += chunk
             eng.stats.prefill_tokens_real += chunk
             eng.stats.prefill_tokens_padded += chunk
@@ -432,7 +447,7 @@ def sp_chunked_prefill(eng, req, seq_id: int, suffix: list[int],
     S = eng._prefill_bucket(ns_tail, multiple_of=sp)
     tokens = np.zeros((1, S), np.int32)
     tokens[0, :ns_tail] = tail
-    next_tok, eng.kv_cache = eng._prefill_sp_suffix_fn(
+    next_tok, eng.kv_cache, moe = eng._prefill_sp_suffix_fn(
         eng.params,
         eng.lora_params,
         jnp.asarray(tokens),
@@ -442,6 +457,9 @@ def sp_chunked_prefill(eng, req, seq_id: int, suffix: list[int],
         pt_dev,
         *sampling_args,
     )
+    moes.append(moe)
+    for m in moes:
+        eng._fold_moe(m)
     eng.stats.prefill_tokens_real += ns_tail
     eng.stats.prefill_tokens_padded += S
     return next_tok, {
@@ -530,7 +548,7 @@ class RaggedPrefillBackend(AttentionBackend):
             jnp.full((B,), eng._base_row, jnp.int32),
         )
         for T in self.rungs():
-            _, eng.kv_cache = eng._prefill_ragged_fn(
+            _, eng.kv_cache, _ = eng._prefill_ragged_fn(
                 eng.params, eng.lora_params,
                 jnp.zeros((T,), jnp.int32),
                 jnp.full((T,), B, jnp.int32),  # all padding rows
@@ -558,6 +576,9 @@ class RaggedPrefillBackend(AttentionBackend):
             pt[s.g] = s.page_row[:P]
         pt_dev = jnp.asarray(pt)
         final_out: dict[int, Any] = {}
+        # MoE routing-stats leaves, one per packed call; folded after the
+        # loop so no mid-loop host sync stalls the packed stream
+        moes: list = []
         calls = 0
         tick_ms = 0.0
         real = padded = 0
@@ -607,12 +628,13 @@ class RaggedPrefillBackend(AttentionBackend):
                 last_rows[s.g] = o + take - 1
                 s.done += take
                 o += take
-            next_tok, eng.kv_cache = eng._prefill_ragged_fn(
+            next_tok, eng.kv_cache, moe = eng._prefill_ragged_fn(
                 eng.params, eng.lora_params,
                 jnp.asarray(tokens), jnp.asarray(row_seq),
                 jnp.asarray(positions), jnp.asarray(last_rows),
                 eng.kv_cache, pt_dev, *sampling_args,
             )
+            moes.append(moe)
             calls += 1
             real += t_used
             padded += T
@@ -630,6 +652,8 @@ class RaggedPrefillBackend(AttentionBackend):
         # intermediate budget-boundary device steps ride the same gauge
         # as the bucketed chunk loop
         eng.stats.chunked_prefill_steps += max(0, calls - 1)
+        for m in moes:
+            eng._fold_moe(m)
         self._account(real, padded)
         return final_out, {
             "tick_ms": tick_ms, "bucket": last_rung, "chunks": calls - 1,
@@ -763,21 +787,28 @@ def resolve_attention_backend(engine: "Engine") -> tuple[str, str]:
     | pallas-ragged | no   | yes | int8/int4 | pallas-ragged | XLA windowed (dequant at read) |
     | pallas-ragged | no   | no  | any       | pallas-ragged | XLA windowed        |
     | pallas-ragged | yes  | any | any       | pallas-ragged | XLA windowed (SPMD) |
-    | pallas-ragged | family w/o prefill_ragged | —         | xla-bucketed         |
+
+    The old ``family w/o prefill_ragged → xla-bucketed`` row is GONE
+    (ISSUE 18): every registered model family — dense and MoE alike —
+    provides a ragged prefill entry point, so no family is routed off
+    the packed stream anymore. What remains below is an escape hatch
+    for hand-built ``ModelFns`` (tests construct them with
+    ``prefill_ragged=None``), not a family property.
 
     The Pallas kernel itself stays single-chip TPU (its scalar-prefetch
     page walk addresses one local pool); a mesh keeps the RAGGED
     geometry — token-budget packing, offset resumes, the collapsed
     warm surface — through the XLA windowed program, which runs SPMD
-    with the KV pool sharded on heads. Only a model family without a
-    ragged prefill entry point forces the bucket ladder."""
+    with the KV pool sharded on heads."""
     name = engine.cfg.attention_backend
     if name != "pallas-ragged":
         return "xla-bucketed", "requested"
     if engine._prefill_ragged_fn is None:
+        # not a family row: every registered family ships
+        # prefill_ragged; only hand-built ModelFns land here
         return ("xla-bucketed",
-                "pallas-ragged requested but the model family has no "
-                "ragged prefill entry point")
+                "pallas-ragged requested but these hand-built ModelFns "
+                "have no ragged prefill entry point")
     # engine._ragged_reason explains the kernel-vs-windowed choice
     return "pallas-ragged", engine._ragged_reason
 
@@ -799,7 +830,13 @@ def resolve_decode_backend(cfg, model_cfg, mesh) -> tuple[str, str]:
     | fused              | no   | yes | any       | fused-pallas    |
     | fused              | no   | no  | any       | fused-xla       |
     | fused              | yes  | any | any       | fused-xla-spmd  |
-    | fused family, heads % tp != 0   | any       | xla-gather (narrowed) |
+    | fused, heads % tp != 0          | any       | xla-gather (narrowed) |
+
+    The fused rung has no model-family exception (ISSUE 18): MoE
+    families run the same fused decode programs as dense ones — the
+    expert dispatch/combine einsums live in the MLP, outside the
+    attention rung entirely. The one narrowed row left is geometric:
+    head counts that do not divide the tp axis.
 
     The old ``pallas_attn × mesh → xla-gather`` row (the PR 10 "GSPMD
     gather path" export) is GONE: a mesh now walks each device's LOCAL

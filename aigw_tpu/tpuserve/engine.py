@@ -637,6 +637,18 @@ class EngineStats:
     decode_window: int = 0
     window_shrinks: int = 0
     window_grows: int = 0
+    # MoE routing surface (ISSUE 18, MoE families only — constant 0 on
+    # dense models): cumulative (token, k) expert assignments placed /
+    # dropped by the capacity fence across every layer, the resulting
+    # drop fraction, and the hottest-expert load imbalance (max
+    # per-expert tokens / mean — 1.0 is perfectly balanced). Counts are
+    # over rows the programs processed, padding included. The picker
+    # prices imbalance with the PR 10 worst-device discipline: a
+    # replica is as fast as its hottest expert shard.
+    moe_tokens_routed: int = 0
+    moe_tokens_dropped: int = 0
+    moe_dropped_frac: float = 0.0
+    moe_expert_imbalance: float = 0.0
     # serving-path phase breakdown (cumulative milliseconds):
     # prefill_ms = host time blocked on prefill device calls,
     # transfer_ms = host time blocked fetching window tokens,
@@ -729,6 +741,12 @@ class _Window:
     # state demands the very same mask, which makes accepted streams
     # bit-identical to true per-step constrained decoding
     cn_epochs: tuple[tuple[int, int, Any], ...] = ()
+    # MoE routing stats for the whole window (device [L, E+1] int32 —
+    # per-expert placed counts + capacity drops, summed over the k
+    # scan steps; None on dense families). Folded into the host
+    # accumulators at DRAIN, when the window's results are fetched
+    # anyway — reading it at dispatch would force a device sync
+    moe: Any = None
 
 
 class Engine:
@@ -1013,6 +1031,34 @@ class Engine:
         model_prefill = self.fns.prefill
         model_decode = self.fns.decode_step
 
+        # MoE routing stats (ISSUE 18): MoE families (ModelFns with
+        # moe_stats=True) take a static ``moe_stats=True`` kwarg and
+        # return a trailing [L, E+1] int32 routing-stats leaf —
+        # per-expert placed (token, k) counts + capacity drops per
+        # layer. Every jitted wrapper below returns that leaf in a
+        # uniform trailing position (None on dense families: a leafless
+        # pytree node, so the llama programs stay byte-identical) and
+        # the host call sites fold it into the numpy accumulators via
+        # _fold_moe. No extra device→host sync: the leaf rides the
+        # result fetches the host already makes.
+        self._moe = bool(getattr(self.fns, "moe_stats", False))
+        is_moe = self._moe
+        moe_kw = {"moe_stats": True} if is_moe else {}
+        self._moe_experts = (int(getattr(model_cfg, "n_experts", 0))
+                             if is_moe else 0)
+        self._moe_expert_tokens = np.zeros(
+            max(self._moe_experts, 1), np.int64)
+        self._moe_layer_drops = np.zeros(
+            max(int(model_cfg.n_layers), 1), np.int64)
+
+        def _moe_split(out):
+            """Normalize a model-entry-point result to
+            (logits, kv, moe-or-None)."""
+            if is_moe:
+                return out
+            logits, kv = out
+            return logits, kv, None
+
         # Mesh jit-cache discipline (ISSUE 10): the per-slot decode
         # state chains through donated programs, and GSPMD is free to
         # give output leaves shardings that differ from the host-built
@@ -1056,23 +1102,22 @@ class Engine:
 
         def _prefill_step(params, lora, tokens, seq_lens, kv, page_table,
                           keys, temp, top_p, top_k, bias, adapter_idx):
-            logits, kv = model_prefill(params, mc, tokens, seq_lens, kv,
-                                       page_table, ps, lora=lora,
-                                       adapter_idx=adapter_idx)
+            logits, kv, moe = _moe_split(model_prefill(
+                params, mc, tokens, seq_lens, kv, page_table, ps,
+                lora=lora, adapter_idx=adapter_idx, **moe_kw))
             return _sample_maybe_lp(logits + bias, keys, temp, top_p,
-                                    top_k), kv
+                                    top_k), kv, moe
 
         model_prefill_suffix = self.fns.prefill_suffix
 
         def _prefill_suffix_step(params, lora, tokens, prefix_lens,
                                  seq_lens, kv, page_table, keys, temp,
                                  top_p, top_k, bias, adapter_idx):
-            logits, kv = model_prefill_suffix(
+            logits, kv, moe = _moe_split(model_prefill_suffix(
                 params, mc, tokens, prefix_lens, seq_lens, kv, page_table,
-                ps, lora=lora, adapter_idx=adapter_idx,
-            )
+                ps, lora=lora, adapter_idx=adapter_idx, **moe_kw))
             return _sample_maybe_lp(logits + bias, keys, temp, top_p,
-                                    top_k), kv
+                                    top_k), kv, moe
 
         # sequence-parallel (ring attention) prefill for long prompts on
         # an sp mesh (SURVEY §2.9 context parallelism)
@@ -1084,12 +1129,12 @@ class Engine:
             def _prefill_sp_step(params, lora, tokens, seq_lens, kv,
                                  page_table, keys, temp, top_p, top_k,
                                  bias, adapter_idx):
-                logits, kv = model_prefill_sp(
+                logits, kv, moe = _moe_split(model_prefill_sp(
                     params, mc, tokens, seq_lens, kv, page_table, ps,
                     mesh=mesh, lora=lora, adapter_idx=adapter_idx,
-                )
+                    **moe_kw))
                 return _sample_maybe_lp(logits + bias, keys, temp, top_p,
-                                        top_k), kv
+                                        top_k), kv, moe
 
             self._prefill_sp_fn = jax.jit(_prefill_sp_step,
                                           donate_argnums=(4,))
@@ -1110,13 +1155,12 @@ class Engine:
                                         prefix_lens, seq_lens, kv,
                                         page_table, keys, temp, top_p,
                                         top_k, bias, adapter_idx):
-                logits, kv = model_prefill_sp_suffix(
+                logits, kv, moe = _moe_split(model_prefill_sp_suffix(
                     params, mc, tokens, prefix_lens, seq_lens, kv,
                     page_table, ps, mesh=mesh, lora=lora,
-                    adapter_idx=adapter_idx,
-                )
+                    adapter_idx=adapter_idx, **moe_kw))
                 return _sample_maybe_lp(logits + bias, keys, temp,
-                                        top_p, top_k), kv
+                                        top_p, top_k), kv, moe
 
             self._prefill_sp_suffix_fn = jax.jit(
                 _prefill_sp_suffix_step, donate_argnums=(5,))
@@ -1141,14 +1185,14 @@ class Engine:
             lp_k = cfg.logprobs_topk
 
             def body(params, lora, carry):
-                kv, st = carry
+                kv, st, macc = carry
                 act = st["active"] & (st["positions"] < st["limits"])
-                logits, kv = model_decode(
+                logits, kv, moe = _moe_split(model_decode(
                     params, mc, st["tokens"], st["positions"], kv,
                     st["page_table"], ps, act,
                     lora=lora, adapter_idx=st["adapter_idx"],
-                    attn_impl=attn_impl, mesh=decode_mesh,
-                )
+                    attn_impl=attn_impl, mesh=decode_mesh, **moe_kw))
+                macc = macc if moe is None else macc + moe
                 if lean:
                     logits = logits + st["bias"]
                 else:
@@ -1179,15 +1223,18 @@ class Engine:
                         logits.astype(jnp.float32), axis=-1)
                     chosen = logp[jnp.arange(B), sampled]
                     tk_vals, tk_ids = jax.lax.top_k(logp, lp_k)
-                    return (kv, new), (sampled, chosen, tk_ids, tk_vals)
-                return (kv, new), sampled
+                    return (kv, new, macc), (sampled, chosen, tk_ids,
+                                             tk_vals)
+                return (kv, new, macc), sampled
 
             def scan_k(params, lora, kv, state):
-                (kv, state), sampled = jax.lax.scan(
+                macc0 = (jnp.zeros((mc.n_layers, mc.n_experts + 1),
+                                   jnp.int32) if is_moe else None)
+                (kv, state, macc), sampled = jax.lax.scan(
                     lambda c, _: body(params, lora, c),
-                    (kv, state), None, length=k
+                    (kv, state, macc0), None, length=k
                 )
-                return sampled, _pin_state(state), kv
+                return sampled, _pin_state(state), kv, macc
 
             return scan_k
 
@@ -1220,7 +1267,7 @@ class Engine:
             D1 = D + 1
 
             def body(params, lora, carry):
-                kv, st = carry
+                kv, st, macc = carry
                 act = st["active"] & (st["positions"] < st["limits"])
                 # penalty and sampling slots advance exactly one token
                 # per step (see speculation.py module docstring):
@@ -1243,12 +1290,12 @@ class Engine:
                 inputs = jnp.concatenate(
                     [st["tokens"][:, None], jnp.maximum(drafts, 0)], axis=1
                 )
-                logits_all, kv = model_verify(
+                logits_all, kv, moe = _moe_split(model_verify(
                     params, mc, inputs, st["positions"], kv,
                     st["page_table"], ps, act, st["limits"],
                     lora=lora, adapter_idx=st["adapter_idx"],
-                    attn_impl=verify_impl,
-                )  # [B, D1, V]
+                    attn_impl=verify_impl, **moe_kw))  # [B, D1, V]
+                macc = macc if moe is None else macc + moe
                 # counts are window-start values: exact at d=0, and later
                 # positions only accept on penalty-free slots where the
                 # count term is zero anyway
@@ -1302,13 +1349,15 @@ class Engine:
                 n_prop = jnp.sum(jnp.cumprod(
                     (drafts >= 0).astype(jnp.int32), axis=1), axis=1)
                 n_prop = jnp.where(act, n_prop, 0)
-                return (kv, new), (sampled, n_emit, n_prop)
+                return (kv, new, macc), (sampled, n_emit, n_prop)
 
             def scan_k(params, lora, kv, state):
-                (kv, state), out = jax.lax.scan(
+                macc0 = (jnp.zeros((mc.n_layers, mc.n_experts + 1),
+                                   jnp.int32) if is_moe else None)
+                (kv, state, macc), out = jax.lax.scan(
                     lambda c, _: body(params, lora, c),
-                    (kv, state), None, length=k_steps)
-                return out, _pin_state(state), kv
+                    (kv, state, macc0), None, length=k_steps)
+                return out, _pin_state(state), kv, macc
 
             return scan_k
 
@@ -1330,7 +1379,8 @@ class Engine:
         # in {xla, pallas} overrides for A/B and parity tests).
         self._prefill_ragged_fn = None
         self._ragged_impl = ""
-        self._ragged_reason = "model family has no ragged prefill"
+        self._ragged_reason = ("no ragged prefill entry point "
+                               "(hand-built ModelFns)")
         model_prefill_ragged = self.fns.prefill_ragged
         if model_prefill_ragged is not None:
             from aigw_tpu.ops.pallas._compat import is_tpu_backend
@@ -1374,13 +1424,12 @@ class Engine:
                                      positions, last_rows, kv,
                                      page_table, keys, temp, top_p,
                                      top_k, bias, adapter_idx):
-                logits, kv = model_prefill_ragged(
+                logits, kv, moe = _moe_split(model_prefill_ragged(
                     params, mc, tokens, row_seq, positions, last_rows,
                     kv, page_table, ps, attn_impl=ragged_impl,
-                    lora=lora, adapter_idx=adapter_idx,
-                )
+                    lora=lora, adapter_idx=adapter_idx, **moe_kw))
                 return _sample_maybe_lp(logits + bias, keys, temp,
-                                        top_p, top_k), kv
+                                        top_p, top_k), kv, moe
 
             self._prefill_ragged_fn = self.compile_tracker.register(
                 "prefill_ragged",
@@ -2001,7 +2050,8 @@ class Engine:
             for k in self._window_ladder():
                 for lean in (True, False):
                     state = self._build_device_state(bucket=P)
-                    _, _, self.kv_cache = self._decode_fn_for(k, lean)(
+                    _, _, self.kv_cache, _ = self._decode_fn_for(
+                        k, lean)(
                         self.params, self.lora_params, self.kv_cache,
                         state
                     )
@@ -2009,7 +2059,7 @@ class Engine:
                     if d == 0:
                         continue
                     state = self._build_device_state(bucket=P)
-                    _, _, self.kv_cache = self._decode_fn_for(
+                    _, _, self.kv_cache, _ = self._decode_fn_for(
                         k, False, d)(
                         self.params, self.lora_params, self.kv_cache,
                         state
@@ -2056,6 +2106,10 @@ class Engine:
         rows = kvq.page_to_host(self._export_page_dev(0))
         for r in self._import_rungs():
             self._import_pages_dev([0] * r, [rows] * r)
+        # NOTE: warm passes discard program results wholesale, so the
+        # MoE routing accumulators stay at zero here — the exported
+        # stats count real traffic only (folds happen at the traffic
+        # call sites, on the engine thread)
         self.stats.warmup_ms = round(1e3 * (time.monotonic() - t0), 3)
         self.stats.warm_programs = self.compile_tracker.program_count()
 
@@ -2087,7 +2141,7 @@ class Engine:
         P = self.cfg.max_pages_per_seq
         G2 = 1
         while G2 <= self.cfg.max_batch_size:
-            _, self.kv_cache = self._prefill_fn(
+            _, self.kv_cache, _ = self._prefill_fn(
                 self.params, self.lora_params,
                 jnp.zeros((G2, S), jnp.int32),
                 jnp.zeros((G2,), jnp.int32),
@@ -2129,7 +2183,7 @@ class Engine:
             if P < min_need:
                 continue
             for S in sorted(rungs):
-                _, self.kv_cache = self._prefill_sp_suffix_fn(
+                _, self.kv_cache, _ = self._prefill_sp_suffix_fn(
                     self.params, self.lora_params,
                     jnp.zeros((1, S), jnp.int32),
                     jnp.zeros((1,), jnp.int32),
@@ -3019,7 +3073,7 @@ class Engine:
             tokens = np.zeros((1, S), np.int32)
             tokens[0, :ns] = suffix
             self.stats.sp_prefills += 1
-            next_tok, self.kv_cache = self._prefill_sp_fn(
+            next_tok, self.kv_cache, moe = self._prefill_sp_fn(
                 self.params,
                 self.lora_params,
                 jnp.asarray(tokens),
@@ -3028,6 +3082,7 @@ class Engine:
                 jnp.asarray(pt),
                 *sampling_args,
             )
+            self._fold_moe(moe)
             self.stats.prefill_tokens_real += ns
             self.stats.prefill_tokens_padded += S
             info = {"consumed": 0, "tick_ms": 0.0, "bucket": S,
@@ -3654,8 +3709,38 @@ class Engine:
         else:
             self._process_window(host, None, w.members, ce)
         self.stats.emit_ms += 1e3 * (time.monotonic() - t1)
+        # the window's routing-stats leaf settles with the window — a
+        # dispatch-time read would sync against the running program
+        self._fold_moe(w.moe)
         for seq_id in w.frees:
             self.allocator.free(seq_id)
+
+    @engine_thread_only
+    def _fold_moe(self, moe) -> None:
+        """Fold one program's [L, E+1] routing-stats leaf (per-expert
+        placed counts + capacity drops per layer) into the numpy
+        accumulators behind the /state MoE surface. No-op (None) on
+        dense families — call sites stay uniform."""
+        if moe is None:
+            return
+        arr = np.asarray(moe, np.int64)
+        self._moe_expert_tokens += arr[:, :-1].sum(axis=0)
+        self._moe_layer_drops += arr[:, -1]
+
+    def moe_expert_load(self) -> list[int]:
+        """Per-expert placed-token totals [E] for /state and the
+        labeled /metrics twins; [] on dense families. Read-only
+        snapshot — safe off the engine thread (int64 element reads are
+        GIL-atomic; a torn read is one fold stale, like every gauge)."""
+        if not self._moe:
+            return []
+        return [int(x) for x in self._moe_expert_tokens]
+
+    def moe_layer_drops(self) -> list[int]:
+        """Per-layer capacity-drop totals [L]; [] on dense families."""
+        if not self._moe:
+            return []
+        return [int(x) for x in self._moe_layer_drops]
 
     @engine_thread_only
     def _apply_frees(self) -> None:
@@ -3781,7 +3866,7 @@ class Engine:
         frees, self._pending_frees = self._pending_frees, []
         lean = draft == 0 and self._lean_decode_ok()
         decode_fn = self._decode_fn_for(k, lean, draft)
-        sampled, self._device_state, self.kv_cache = decode_fn(
+        sampled, self._device_state, self.kv_cache, moe = decode_fn(
             self.params, self.lora_params, self.kv_cache, self._device_state
         )
         if self.cfg.async_transfers:
@@ -3793,7 +3878,7 @@ class Engine:
         self._inflight = _Window(sampled=sampled, members=members, k=k,
                                  frees=frees, draft=draft,
                                  draft_lens=draft_lens,
-                                 cn_epochs=cn_epochs)
+                                 cn_epochs=cn_epochs, moe=moe)
         for _i, _req in members:
             if _req.trace is not None:
                 _req.trace.decode_window(k, lean, draft)
@@ -3945,6 +4030,23 @@ class Engine:
         tenants = self._tenant_slots()
         self.stats.tenants_active = len(tenants)
         self.stats.tenant_max_slots = max(tenants.values(), default=0)
+        # MoE routing surface (ISSUE 18): scalars derived from the
+        # per-expert / per-layer accumulators _fold_moe maintains. The
+        # imbalance is hottest-expert / mean — the PR 10 worst-device
+        # discipline (an ep-sharded replica steps at its hottest
+        # expert's pace), priced by the gateway picker off /state.
+        if self._moe:
+            placed = float(self._moe_expert_tokens.sum())
+            dropped = float(self._moe_layer_drops.sum())
+            self.stats.moe_tokens_routed = int(placed)
+            self.stats.moe_tokens_dropped = int(dropped)
+            self.stats.moe_dropped_frac = round(
+                dropped / (placed + dropped), 6) if placed + dropped \
+                else 0.0
+            mean = placed / max(self._moe_experts, 1)
+            self.stats.moe_expert_imbalance = round(
+                float(self._moe_expert_tokens.max()) / mean, 4) \
+                if mean > 0 else 0.0
         self.stats.spec_accept_rate = (
             self.stats.spec_accepted / self.stats.spec_drafted
             if self.stats.spec_drafted else 0.0)

@@ -43,6 +43,7 @@ from aigw_tpu.obs.metrics import (
     RequestMetrics,
     render_device_gauges,
     render_engine_gauges,
+    render_moe_gauges,
 )
 from aigw_tpu.obs.tracing import SpanContext, Tracer, genai_attributes
 from aigw_tpu.schemas import openai as oai
@@ -1821,6 +1822,18 @@ class TPUServeServer:
                 "kv_bytes_per_token": s.kv_bytes_per_token,
                 "kv_cache_dtype": self.engine.cfg.kv_cache_dtype,
                 "decode_backend": self.engine.cfg.decode_backend,
+                # MoE serving surface (ISSUE 18): router placement /
+                # capacity-drop scalars plus the per-expert token list
+                # the picker prices (worst-expert discipline — a
+                # replica is as fast as its hottest expert shard) and
+                # the per-layer drop list. All-zero / empty on dense
+                # families
+                "moe_tokens_routed": s.moe_tokens_routed,
+                "moe_tokens_dropped": s.moe_tokens_dropped,
+                "moe_dropped_frac": s.moe_dropped_frac,
+                "moe_expert_imbalance": s.moe_expert_imbalance,
+                "moe_expert_load": self.engine.moe_expert_load(),
+                "moe_layer_drops": self.engine.moe_layer_drops(),
                 # mesh serving (ISSUE 10): real per-device signals —
                 # the mesh topology (axis → size; {} off-mesh), EVERY
                 # local device's memory/KV/param share (not just
@@ -1939,6 +1952,8 @@ class TPUServeServer:
                 + render_engine_gauges(self.engine.stats)
                 + impl_info
                 + render_device_gauges(self.engine.device_stats)
+                + render_moe_gauges(self.engine.moe_expert_load(),
+                                    self.engine.moe_layer_drops())
                 + self.engine.phases.render())
         return web.Response(body=body, content_type="text/plain")
 

@@ -51,7 +51,7 @@ if ("--cpu-gateway-ratio" in sys.argv or "--ab" in sys.argv
 
 import jax.numpy as jnp
 
-from aigw_tpu.models import llama
+from aigw_tpu.models import llama, mixtral
 from aigw_tpu.obs import slomon
 from aigw_tpu.tpuserve.engine import Engine, EngineConfig, GenRequest
 from aigw_tpu.tpuserve.sampling import SamplingParams, sample
@@ -256,7 +256,8 @@ def _start_tpuserve_subproc(model_name: str, cfg, quantize: str,
                             lora: dict | None = None,
                             tp: int = 1,
                             sp: int = 1,
-                            env_extra: dict | None = None):
+                            env_extra: dict | None = None,
+                            family: str = "llama"):
     """Serve `model_name` over the real tpuserve HTTP surface in its own
     process (benchmarks/serve_child.py) — the deployment topology. The
     in-thread variant below shares the bench client's GIL, which on a
@@ -268,11 +269,14 @@ def _start_tpuserve_subproc(model_name: str, cfg, quantize: str,
     raw/engine legs run on chip — the assert keeps that impossible."""
     assert jax.default_backend() == "cpu", \
         "subproc serve leg is pinned to the CPU backend"
+    cfg_keys = ["vocab_size", "dim", "n_layers", "n_heads",
+                "n_kv_heads", "ffn_dim", "max_seq_len", "rope_theta"]
+    if family == "mixtral":
+        # the --ab moe child (ISSUE 18) ships the expert geometry too
+        cfg_keys += ["n_experts", "experts_per_token", "capacity_factor"]
     spec = {
-        "model": model_name,
-        "cfg": {k: getattr(cfg, k) for k in (
-            "vocab_size", "dim", "n_layers", "n_heads", "n_kv_heads",
-            "ffn_dim", "max_seq_len", "rope_theta")},
+        "model": model_name, "family": family,
+        "cfg": {k: getattr(cfg, k) for k in cfg_keys},
         "batch": batch, "page": page, "k": k_steps, "quantize": quantize,
         "engine": engine or {}, "param_dtype": param_dtype,
         "lora": lora or {}, "tp": tp, "sp": sp,
@@ -3276,6 +3280,155 @@ def run_live() -> dict:
                   PROMPT_LEN, GEN_TOKENS, label="")
 
 
+# -- moe leg: expert-parallel serving at parity (ISSUE 18) ----------------
+
+#: tiny-moe serving geometry (4 experts top-2, GQA GROUP=2) at bench
+#: scale — the family the deleted fallback-matrix rows used to demote
+_MOE_CFG = mixtral.MixtralConfig(
+    vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    ffn_dim=128, n_experts=4, experts_per_token=2, max_seq_len=512,
+    rope_theta=10000.0,
+)
+_MOE_PAGE = 16
+#: seeded mixed-length admission burst (byte-tokenizer token counts),
+#: fired concurrently so both children coalesce one admission. Five
+#: ~97-token prompts share the 128 bucket — the bucketed control pads
+#: each to 128 AND pads the 5-row group to 8 rows; the ragged pack
+#: pays only the chunk residue of the 685-token total
+_MOE_MIX = (97, 97, 97, 97, 97, 200)
+
+
+async def _drive_moe_burst(s, url: str, model: str, gen_tokens: int,
+                           tag: str) -> list[tuple[float, str]]:
+    """Fire the MoE mixed-length burst concurrently; returns per-request
+    (TTFT ms, generated text) — the text feeds the byte-identity check
+    between the ragged+fused child and the bucketed+chained control."""
+
+    async def one(n_tokens: int, i: int) -> tuple[float, str]:
+        text = (f"{tag}{i:02d}" + "x" * n_tokens)[: n_tokens - 1]
+        payload = {
+            "model": model,
+            "prompt": text,
+            "max_tokens": gen_tokens,
+            "temperature": 0.0,
+            "stream": True,
+            "logit_bias": {"97": 100},
+        }
+        t0 = time.perf_counter()
+        first = -1.0
+        out: list[str] = []
+        async with s.post(url + "/v1/completions", json=payload) as resp:
+            assert resp.status == 200, resp.status
+            while True:
+                line = await resp.content.readline()
+                if not line:
+                    break
+                line = line.strip()
+                if not line.startswith(b"data: "):
+                    continue
+                data = line[6:]
+                if data == b"[DONE]":
+                    break
+                ev = json.loads(data)
+                ch = ev.get("choices") or []
+                if ch and ch[0].get("text"):
+                    if first < 0:
+                        first = (time.perf_counter() - t0) * 1000.0
+                    out.append(ch[0]["text"])
+        return first, "".join(out)
+
+    return list(await asyncio.gather(
+        *(one(n, i) for i, n in enumerate(_MOE_MIX))))
+
+
+def moe_numbers(reps: int = 3, gen_tokens: int = 8) -> dict:
+    """The ``--ab moe`` leg (ISSUE 18): the same seeded mixed-length
+    burst against TWO tiny-moe tpuserve children — ragged prefill +
+    fused decode (the program families the deleted fallback rows now
+    admit MoE to) vs the xla-bucketed + chained control — with reps
+    interleaved so host drift cancels. The claims:
+
+    - **byte-identical streams**: expert parity is exactness, not
+      closeness — both children serve f32 params/KV and greedy
+      sampling, so every generated character must match.
+    - **padding tax**: the bucketed child pays bucket + pow2 group-row
+      padding; the ragged pack pays only chunk residue (per-child
+      padded_frac from the /state token counters).
+    - **routing surface**: moe_dropped_frac / moe_expert_imbalance /
+      moe_tokens_routed off the child's /state — the gauges the
+      gateway picker prices (PR 10 worst-device discipline).
+    - zero hot compiles on either child over the timed reps. TTFT
+      medians are reference only: the CPU host runs the XLA fallbacks,
+      not the DMA-skip kernels."""
+    import aiohttp
+
+    model_name = "bench-moe-tiny"
+    engine_common = {
+        "min_prefill_bucket": 32, "num_pages": 112,
+        "max_queued_requests": 64, "kv_cache_dtype": "float32",
+        "enable_prefix_cache": False,
+        # one coalesced admission is the quantity under test (same
+        # rationale as the ragged leg; the wait cancels from the A/B)
+        "admission_coalesce_ms": 20.0,
+    }
+    k = int(os.environ.get("AIGW_BENCH_CPU_K", "4"))
+    url_moe, stop_moe = _start_tpuserve_subproc(
+        model_name, _MOE_CFG, "", batch=8, k_steps=k,
+        engine=dict(engine_common, attention_backend="pallas-ragged",
+                    decode_backend="fused"),
+        page=_MOE_PAGE, param_dtype="float32", family="mixtral")
+    url_ctl, stop_ctl = _start_tpuserve_subproc(
+        model_name, _MOE_CFG, "", batch=8, k_steps=k,
+        engine=dict(engine_common, attention_backend="xla-bucketed"),
+        page=_MOE_PAGE, param_dtype="float32", family="mixtral")
+
+    async def run() -> dict:
+        await _wait_health(url_moe, 1200)
+        await _wait_health(url_ctl, 1200)
+        timeout = aiohttp.ClientTimeout(total=1200)
+        async with aiohttp.ClientSession(timeout=timeout) as s:
+            # off-the-clock warm pass: compile whatever shapes the warm
+            # ladders missed on either leg
+            for url in (url_moe, url_ctl):
+                await _drive_moe_burst(s, url, model_name, gen_tokens,
+                                       "w")
+            st_moe0 = await _get_state(s, url_moe)
+            st_ctl0 = await _get_state(s, url_ctl)
+            moe_runs, ctl_runs = [], []
+            for rep in range(reps):
+                moe_runs.extend(await _drive_moe_burst(
+                    s, url_moe, model_name, gen_tokens, f"r{rep}"))
+                ctl_runs.extend(await _drive_moe_burst(
+                    s, url_ctl, model_name, gen_tokens, f"r{rep}"))
+            st_moe1 = await _get_state(s, url_moe)
+            st_ctl1 = await _get_state(s, url_ctl)
+        identical = all(a[1] == b[1]
+                        for a, b in zip(moe_runs, ctl_runs))
+        mt = _median([t for t, _ in moe_runs if t > 0])
+        ct = _median([t for t, _ in ctl_runs if t > 0])
+        return {
+            "moe_ragged_ttft_ms_p50": round(mt, 1),
+            "moe_bucketed_ttft_ms_p50": round(ct, 1),
+            "moe_identical_streams": identical,
+            "moe_backend": st_moe1.get("attention_backend", ""),
+            "moe_decode_impl": st_moe1.get("decode_attn_impl", ""),
+            "moe_dropped_frac": st_moe1.get("moe_dropped_frac", 0.0),
+            "moe_expert_imbalance": st_moe1.get(
+                "moe_expert_imbalance", 0.0),
+            "moe_tokens_routed": (st_moe1.get("moe_tokens_routed", 0)
+                                  - st_moe0.get("moe_tokens_routed", 0)),
+            "moe_ab_reps": reps * len(_MOE_MIX),
+            **_ragged_ab_fields(st_moe0, st_moe1, "moe_ragged"),
+            **_ragged_ab_fields(st_ctl0, st_ctl1, "moe_bucketed"),
+        }
+
+    try:
+        return asyncio.run(run())
+    finally:
+        stop_moe()
+        stop_ctl()
+
+
 def run_cpu_ratio() -> dict:
     """Chip-independent north-star *ratio* on the CPU backend (honest
     fallback when the tunnel is down all round): same harness, small
@@ -3362,6 +3515,11 @@ def run_cpu_ratio() -> dict:
         res.update(longctx_numbers())
     except Exception as e:
         print(f"longctx leg failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+    try:
+        res.update(moe_numbers())
+    except Exception as e:
+        print(f"moe leg failed: {type(e).__name__}: {e}",
               file=sys.stderr)
     return res
 
@@ -3564,13 +3722,25 @@ def main() -> None:
                 "padded_frac < 0.05 on the chunk rung ladder and "
                 "zero hot XLA compiles at long-context geometry are "
                 "the guardrails (CPU backend; ratios are the signal)")
+        elif target == "moe":
+            result = moe_numbers()
+            result["metric"] = (
+                "moe interleaved A/B — expert-parallel serving at "
+                "parity (ISSUE 18): the same seeded mixed-length "
+                "burst against a tiny-moe ragged+fused child vs the "
+                "xla-bucketed+chained control (the two deleted "
+                "fallback-matrix rows); byte-identical streams, the "
+                "padded_frac gap, zero hot compiles, and the "
+                "moe_dropped_frac / expert-imbalance routing gauges "
+                "are the signal — absolute TTFT is not (CPU backend "
+                "runs the XLA fallbacks, not the DMA-skip kernels)")
         else:
             print(json.dumps({"error": f"unknown --ab target {target!r}; "
                               "supported: prefix_cache, spec_decode, "
                               "ragged_prefill, lora, disagg, "
                               "slo_routing, structured, mesh, "
                               "kv_tier, fleet_obs, decode_fused, "
-                              "fleet_ctl, longctx"}))
+                              "fleet_ctl, longctx, moe"}))
             return
         print(json.dumps(result))
         return

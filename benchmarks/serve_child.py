@@ -103,8 +103,16 @@ def main() -> None:
         time.sleep(slow)
 
     spec = json.loads(sys.argv[1])
-    cfg = llama.LlamaConfig(**spec["cfg"])
-    register_model(ModelSpec(spec["model"], "llama", cfg))
+    family = spec.get("family", "llama")
+    if family == "mixtral":
+        # the --ab moe leg (ISSUE 18): expert-parallel child on the
+        # same serving surface as dense families
+        from aigw_tpu.models import mixtral
+
+        cfg = mixtral.MixtralConfig(**spec["cfg"])
+    else:
+        cfg = llama.LlamaConfig(**spec["cfg"])
+    register_model(ModelSpec(spec["model"], family, cfg))
     param_dtype = spec.get("param_dtype", "")
 
     # multi-LoRA zoo for the --ab lora leg: N random-B adapters named

@@ -154,7 +154,7 @@ def test_kv_pages_bit_exact_at_every_chunk_boundary():
     )
 
     def suffix_step(tokens_row, prefix_len, seq_len):
-        _, eng.kv_cache = eng._prefill_suffix_fn(
+        _, eng.kv_cache, _ = eng._prefill_suffix_fn(
             eng.params, eng.lora_params, jnp.asarray(tokens_row),
             jnp.asarray([prefix_len], jnp.int32),
             jnp.asarray([seq_len], jnp.int32),
@@ -334,9 +334,11 @@ def _pages_in_use(eng):
                                        {}).values())
 
 
-def test_moe_family_without_suffix_fn_falls_back():
-    """mixtral has no prefill_suffix: chunking must silently fall back
-    to whole-prompt prefill instead of killing the engine."""
+def test_moe_family_chunked_prefill_works():
+    """mixtral ships prefill_suffix (ISSUE 18): a long prompt chunks
+    through the MoE family exactly like a dense one — no silent
+    whole-prompt fallback — and the routing accumulators see every
+    chunk's tokens."""
     from aigw_tpu.models import mixtral
     from aigw_tpu.models.registry import family_fns, get_model_spec
 
@@ -355,6 +357,9 @@ def test_moe_family_without_suffix_fn_falls_back():
                          n=4)
         assert len(toks) == 4
         assert eng.healthy
-        assert eng.stats.chunked_prefill_steps == 0
+        assert eng.stats.chunked_prefill_steps > 0
+        # every layer routes every token top-k ways; the accumulators
+        # must have folded the chunked prefill stream
+        assert int(eng._moe_expert_tokens.sum()) > 0
     finally:
         eng.stop()

@@ -492,6 +492,28 @@ class TestFusedDecodeKernel:
         np.testing.assert_allclose(np.asarray(outs[3][slot[0]]),
                                    np.asarray(sk[0]), rtol=1e-5)
 
+    def test_tiny_moe_geometry(self):
+        """tiny-moe attention geometry (ISSUE 18): H=4, Hkv=2 (GROUP
+        divides heads), D=16, 16-token pages — the shapes the MoE
+        family's fused decode serves at now that the family exception
+        row is gone. Mid-page and page-straddling appends."""
+        outs, want, aux = self._case(
+            B=3, H=4, Hkv=2, D=16, ps=16, n_pages=16, P=4,
+            positions=[17, 0, 48], active=[True, True, True])
+        self._assert_active_close(outs, want, [True, True, True])
+        pt, slot, positions, active, k_pool, knr, vn = aux
+        # appended K row is the roped new K, bit-for-bit the XLA recipe
+        np.testing.assert_array_equal(
+            np.asarray(outs[1][slot[0]]), np.asarray(knr[0]))
+
+    def test_tiny_moe_geometry_quantized(self):
+        """Same MoE geometry over int8 pages — the resolver gate the
+        tentpole deleted means these shapes now serve quantized too."""
+        outs, want, aux = self._case(
+            B=2, H=4, Hkv=2, D=16, ps=16, n_pages=12, P=4,
+            positions=[33, 16], active=[True, True], qdt="int8")
+        self._assert_active_close(outs, want, [True, True])
+
     def test_fresh_page_pos0_and_inactive(self):
         """Page-aligned appends start a fresh page; pos=0 attends only
         itself; inactive slots leave every table-referenced page
@@ -657,6 +679,15 @@ class TestRaggedPrefillKernel:
         # hit / chunked continuation shapes)
         self._run(lens=[5, 9, 14], starts=[3, 8, 21],
                   page_size=8, q_block=8, H=4, Hkv=4, D=32, n_pages=24)
+
+    def test_tiny_moe_geometry_mixed_lengths(self):
+        # tiny-moe attention geometry (ISSUE 18): H=4, Hkv=2 (GQA
+        # GROUP=2 divides heads), D=16, 16-token pages — the ragged
+        # program the MoE family admits through now that the
+        # family-fallback row is gone; one offset-resumed sequence
+        self._run(lens=[7, 30, 13], starts=[0, 5, 0],
+                  page_size=16, q_block=16, H=4, Hkv=2, D=16,
+                  n_pages=16)
 
     @pytest.mark.slow
 

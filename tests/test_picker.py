@@ -383,6 +383,81 @@ class TestPrefixAffinity:
             {"messages": [{"role": "user", "content": "q"}]}) == ""
 
 
+class TestMoEImbalance:
+    """MoE expert-imbalance pricing (ISSUE 18): the hottest-expert load
+    ratio polled off /state penalizes skewed expert-parallel replicas —
+    bounded below session stickiness, above adapter affinity."""
+
+    def _two(self):
+        p = EndpointPicker([Endpoint("a:1"), Endpoint("b:1")])
+        p.observe("a:1", kv_occupancy=0.3, max_slots=8)
+        p.observe("b:1", kv_occupancy=0.3, max_slots=8)
+        return p
+
+    def test_skewed_router_loses_at_equal_load(self):
+        p = self._two()
+        # a's hottest expert runs 2.5x the mean; b is balanced
+        p.observe("a:1", kv_occupancy=0.3, max_slots=8,
+                  moe_expert_imbalance=2.5)
+        p.observe("b:1", kv_occupancy=0.3, max_slots=8,
+                  moe_expert_imbalance=1.0)
+        assert p.pick() == "b:1"
+        # dense replicas (imbalance 0) are never penalized: classic
+        # load ordering is unchanged
+        p2 = self._two()
+        p2.observe("a:1", kv_occupancy=0.1, max_slots=8)
+        p2.observe("b:1", kv_occupancy=0.5, max_slots=8)
+        assert p2.pick() == "a:1"
+
+    def test_never_overrides_session_stickiness(self):
+        """MOE_IMBALANCE_PENALTY < STICKINESS_MARGIN by design: a
+        session stays on its exact-KV replica even when that replica's
+        router is maximally skewed."""
+        p = self._two()
+        h = {AFFINITY_HEADER: "sess-moe"}
+        assert p.pick(h) in ("a:1", "b:1")
+        p._affinity["sess-moe"] = "a:1"
+        p.observe("a:1", kv_occupancy=0.3, max_slots=8,
+                  moe_expert_imbalance=4.0)  # clamps to the constant
+        p.observe("b:1", kv_occupancy=0.3, max_slots=8)
+        assert p.pick(h) == "a:1"
+
+    def test_outranks_adapter_affinity(self):
+        """MOE_IMBALANCE_PENALTY > ADAPTER_AFFINITY_BONUS by design: a
+        saturated expert shard costs more than re-loading a LoRA row —
+        the balanced replica wins even without the adapter resident."""
+        assert (EndpointPicker.MOE_IMBALANCE_PENALTY
+                > EndpointPicker.ADAPTER_AFFINITY_BONUS)
+        assert (EndpointPicker.MOE_IMBALANCE_PENALTY
+                < EndpointPicker.STICKINESS_MARGIN)
+        p = self._two()
+        p.observe("a:1", kv_occupancy=0.3, max_slots=8,
+                  adapters_resident=("t0",),
+                  moe_expert_imbalance=3.0)
+        p.observe("b:1", kv_occupancy=0.3, max_slots=8)
+        assert p.pick({"x-aigw-adapter": "t0"}) == "b:1"
+
+    def test_imbalance_polled_from_state(self, tpuserve_url):
+        """moe_expert_imbalance rides the live /state poll into
+        EndpointState (0.0 on the dense tiny model — term vanishes)."""
+        async def main():
+            host = tpuserve_url.replace("http://", "")
+            p = EndpointPicker([Endpoint(host)], poll_interval=0.1)
+            await p.start()
+            try:
+                for _ in range(100):
+                    st = p.state[host]
+                    if st.healthy:
+                        break
+                    await asyncio.sleep(0.1)
+                assert st.healthy
+                assert st.moe_expert_imbalance == 0.0
+            finally:
+                await p.stop()
+
+        asyncio.run(main())
+
+
 def make_slo_picker(slo_ms: float = 0.0):
     return EndpointPicker(
         [Endpoint("10.0.0.1:8011"), Endpoint("10.0.0.2:8011"),

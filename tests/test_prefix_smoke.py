@@ -690,6 +690,51 @@ def test_state_exports_fleet_identity_and_ttft_buckets(smoke_url):
     assert rendered == buckets
 
 
+# MoE serving surface (ISSUE 18): the scalar routing gauges export
+# everywhere (constant 0 on dense families) so dashboards and the
+# picker's imbalance term never hit a missing key; the labeled
+# per-expert/per-layer twins render only on MoE families
+MOE_STATE_FIELDS = manifest.state_fields("moe")
+
+MOE_GAUGES = manifest.gauge_names("moe")
+
+
+def test_state_and_metrics_export_moe_gauges(smoke_url):
+    """The MoE surface on a DENSE replica: every scalar field/gauge
+    present (constant 0), the per-expert/per-layer lists empty, and
+    the labeled twins absent (zero rendered bytes) — the drift
+    contract still covers them via render_moe_gauges below."""
+    state = json.loads(asyncio.run(_get(smoke_url, "/state")))
+    for field in MOE_STATE_FIELDS:
+        assert field in state, f"/state lost {field}"
+    assert state["moe_tokens_routed"] == 0
+    assert state["moe_dropped_frac"] == 0.0
+    assert state["moe_expert_imbalance"] == 0.0
+    assert state["moe_expert_load"] == []
+    assert state["moe_layer_drops"] == []
+    text = asyncio.run(_get(smoke_url, "/metrics")).decode()
+    for gauge in MOE_GAUGES:
+        assert gauge in text, f"/metrics lost {gauge}"
+    for labeled in manifest.EXTRA_METRICS["moe"]:
+        assert labeled not in text, (
+            f"dense replica rendered MoE labeled gauge {labeled}")
+
+
+def test_moe_labeled_gauges_render_for_moe_accumulators():
+    """render_moe_gauges (the labeled /metrics twins of the /state
+    moe_expert_load / moe_layer_drops lists) must carry every
+    EXTRA_METRICS['moe'] substring the MoE drift group asserts on —
+    same index order as the lists."""
+    from aigw_tpu.obs.metrics import render_moe_gauges
+
+    text = render_moe_gauges([5, 9, 2, 0], [1, 0]).decode()
+    for labeled in manifest.EXTRA_METRICS["moe"]:
+        assert labeled in text, f"render_moe_gauges lost {labeled}"
+    assert 'tpuserve_moe_expert_load{expert="1"} 9' in text
+    assert 'tpuserve_moe_layer_drops{layer="0"} 1' in text
+    assert render_moe_gauges([], []) == b""
+
+
 def test_fleet_gauges_map_matches_rollup():
     """Every FLEET_GAUGES key must exist in FleetState.rollup() output
     — a renamed rollup key silently drops an aggregate gauge from the

@@ -139,6 +139,55 @@ def _ring_seq_microbench(reps: int = 20) -> dict:
     }
 
 
+def _moe_ep_microbench(reps: int = 20) -> dict:
+    """Measured ICI on the EXPERT axis (ISSUE 18): time one
+    ``all_to_all`` of a dispatch-sized activation block over every
+    local device — the collective one expert-parallel MoE layer pays
+    twice (dispatch to the expert's home device, combine back).
+    Block shape matches the 8x7B-class geometry the expert-parallel
+    path serves: 8 tokens × top-2 slots × 4096 dim, f32 so the bytes
+    are exact. Reported next to the priced link bandwidth so the
+    MoE row of the capture is measured-vs-model, like the psum and
+    ring rows above."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec
+
+    devs = jax.local_devices()
+    n = len(devs)
+    if n < 2:
+        return {}
+    mesh = Mesh(np.array(devs), ("x",))
+    # [n shards, tokens × top-2, dim] — one device's dispatch block
+    blk = jnp.ones((n, 16, 4096), jnp.float32)
+    block_bytes = blk.size * 4
+
+    from jax.experimental.shard_map import shard_map
+
+    fn = jax.jit(shard_map(
+        lambda x: jax.lax.all_to_all(x, "x", 0, 0, tiled=False),
+        mesh=mesh, in_specs=PartitionSpec(),
+        out_specs=PartitionSpec(), check_rep=False))
+    fn(blk).block_until_ready()  # compile off the clock
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(blk)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / reps
+    # each chip ships (n-1)/n of its block over the links per a2a
+    payload = block_bytes * (n - 1) / n
+    return {
+        "moe_ep_devices": n,
+        "moe_a2a_us": round(dt * 1e6, 2),
+        "moe_gbps_measured": round(payload / dt / 1e9, 2),
+        "moe_gbps_priced": ICI_GBPS_PRICED,
+        # dispatch + combine per MoE layer — the expert-axis volume
+        # one routed token batch prices
+        "moe_layer_bytes_per_chip": int(payload * 2),
+    }
+
+
 def main() -> int:
     import jax
 
@@ -173,6 +222,7 @@ def main() -> int:
     })
     capture.update(_ici_microbench())
     capture.update(_ring_seq_microbench())
+    capture.update(_moe_ep_microbench())
     path = persist.save("tpu_capture", capture)
     capture["artifact"] = path
     print("TPU_CAPTURE " + json.dumps(capture))
