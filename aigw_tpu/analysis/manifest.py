@@ -98,6 +98,9 @@ STATE_ONLY: dict[str, str] = {
                             "token half-life; lifetime mean until the "
                             "first observed call) — the picker's "
                             "prompt-length TTFT pricing rate",
+    # priority-tiered serving surface (ISSUE 19)
+    "batch_slot_frac": "EngineConfig echo; the batch class's slot "
+                       "ceiling fraction",
     # MoE serving surface (ISSUE 18)
     "moe_expert_load": "per-expert token list [E]; /metrics renders "
                        "the labeled tpuserve_moe_expert_load twins",
@@ -153,6 +156,7 @@ GROUPS: dict[str, Group] = {
         exact=("replica_id", "started_at", "uptime_s",
                "ttft_hist_buckets", "draining")),
     "moe": Group(prefixes=("moe_",)),
+    "batch": Group(prefixes=("batch_",)),
 }
 
 #: /metrics substrings a group's smoke must also assert on but that are

@@ -117,6 +117,10 @@ THREAD_DOMAINS: tuple[ThreadDomain, ...] = (
             "_steady_ticks",
             "_kv_digest",
             "_kv_digest_next",
+            # parked batch sessions (ISSUE 19): preempted offline
+            # streams stashed host-side between park and resume — both
+            # ends of that lifecycle run on the engine loop
+            "_parked_batch",
             # MoE routing accumulators (ISSUE 18): numpy [E] / [L]
             # arrays _fold_moe grows from program routing-stats leaves
             # — folded at drain/prefill settle, both engine-thread-only

@@ -431,6 +431,11 @@ class FleetController:
                 self._spawn_launch("failover replacement")
 
     def _tick_scale_out(self, now: float) -> None:
+        # keyed on INTERACTIVE SLO burn only (ISSUE 19): the burn
+        # monitor reads the replicas' TTFT histograms, and the engine
+        # never observes batch streams into those — a fleet saturated
+        # with offline soak but meeting interactive TTFT does not
+        # scale out; batch absorbs the slack instead
         mon = self.picker.fleet.slomon
         if mon is None or not mon.sustained(SLOMonitor.FLEET_KEY):
             return
@@ -462,7 +467,12 @@ class FleetController:
             if st is None or not st.healthy:
                 continue
             slots_total += st.max_slots
-            slots_free += max(0, st.max_slots - st.active_slots)
+            # idleness is judged on INTERACTIVE occupancy (ISSUE 19):
+            # batch soak is SUPPOSED to fill idle slots — counting it
+            # would let a big offline backlog pin fleet capacity the
+            # interactive class no longer needs
+            slots_free += max(0, st.max_slots
+                              - (st.active_slots - st.batch_active))
             queued += st.queued
         idle = (slots_total > 0 and queued == 0
                 and slots_free / slots_total >= self.cfg.idle_slots_frac)
@@ -491,7 +501,10 @@ class FleetController:
             if st is None:
                 return 0.0
             return (st.active_slots + st.queued
-                    + float(getattr(st, "migratable_slots", 0)) * 0.01)
+                    + float(getattr(st, "migratable_slots", 0)) * 0.01
+                    # prefer retiring the replica with the least batch
+                    # backlog to wait out (its state is replica-local)
+                    + float(getattr(st, "batch_queued", 0)) * 0.1)
 
         owned = [a for a in live
                  if self.launcher is not None and self.launcher.owns(a)]
@@ -554,6 +567,11 @@ class FleetController:
             if self._health_of(addr) == DOWN:
                 break  # died mid-drain: nothing left to wait for
             if (st.healthy and st.active_slots == 0 and st.queued == 0
+                    # batch backlog drains BEFORE retirement (ISSUE
+                    # 19): queued + parked offline work is replica-
+                    # local in-memory state — pulling the plug early
+                    # would strand it, so the soak finishes first
+                    and st.batch_queued == 0 and st.batch_active == 0
                     and st.staleness_s() >= 0):
                 drained = True
                 break

@@ -331,6 +331,13 @@ class FleetState:
                     if hasattr(st, "worst_hbm_frac") else 0.0),
                 "migratable_slots": int(
                     getattr(st, "migratable_slots", 0)),
+                # priority-tiered serving (ISSUE 19): the offline
+                # class's per-replica footprint — fleetwatch's batch
+                # columns and the controller's retire-drain read these
+                "batch_queued": int(getattr(st, "batch_queued", 0)),
+                "batch_active": int(getattr(st, "batch_active", 0)),
+                "batch_preemptions": int(
+                    getattr(st, "batch_preemptions", 0)),
                 "adapters_resident": sorted(
                     getattr(st, "adapters_resident", ()) or ()),
                 "kv_spills": int(last.get("kv_spills", 0) or 0),

@@ -86,6 +86,13 @@ ADAPTER_HEADER = "x-aigw-adapter"
 #: on the same tenant
 TENANT_HEADER = "x-aigw-tenant"
 
+#: priority class header (ISSUE 19): requests marked ``batch`` ride the
+#: offline tier — the picker routes them to the replica with the MOST
+#: idle capacity (the inverse of the interactive preference) and never
+#: SLO-sheds them (batch queues, it doesn't 429); relayed upstream so
+#: the replica's two-class scheduler sees the same class
+PRIORITY_HEADER = "x-aigw-priority"
+
 #: KV chain-hash header (ISSUE 11): the hex content hash of the
 #: request's first prompt page. Usually LEARNED, not client-set — each
 #: tpuserve response carries it, and the picker remembers (prefix-head
@@ -234,6 +241,15 @@ class EndpointState:
     # is its hottest expert's, so imbalance prices the replica even
     # when slots and queue look fine.
     moe_expert_imbalance: float = 0.0
+    # priority-tiered serving (ISSUE 19): the replica's offline-class
+    # footprint polled from /state. ``queued``/``queue_wait_ms`` above
+    # stay interactive-only (batch rides its own engine queue), so
+    # predicted_ttft_ms never prices batch backlog; these feed the
+    # batch routing branch (most idle capacity), fleetwatch's per-class
+    # columns, and the controller's retire-drain wait.
+    batch_queued: int = 0
+    batch_active: int = 0
+    batch_preemptions: int = 0
 
     def staleness_s(self, now: float | None = None) -> float:
         """Seconds since the last successful poll (-1 = never)."""
@@ -473,6 +489,10 @@ class EndpointPicker:
             data.get("prefill_ms_per_token", 0.0) or 0.0)
         st.moe_expert_imbalance = float(
             data.get("moe_expert_imbalance", 0.0) or 0.0)
+        st.batch_queued = int(data.get("batch_queued", 0) or 0)
+        st.batch_active = int(data.get("batch_active", 0) or 0)
+        st.batch_preemptions = int(
+            data.get("batch_preemptions", 0) or 0)
         st.poll_failures = 0
         st.last_poll_ok_ts = time.monotonic()
         st.updated_at = time.monotonic()
@@ -499,7 +519,10 @@ class EndpointPicker:
                 max_seq_len: int = 0,
                 sp: int = 1,
                 prefill_ms_per_token: float = 0.0,
-                moe_expert_imbalance: float = 0.0) -> None:
+                moe_expert_imbalance: float = 0.0,
+                batch_queued: int = 0,
+                batch_active: int = 0,
+                batch_preemptions: int = 0) -> None:
         st = self.state[address]
         st.healthy = True
         st.kv_occupancy = kv_occupancy
@@ -538,6 +561,10 @@ class EndpointPicker:
             st.prefill_ms_per_token = prefill_ms_per_token
         if moe_expert_imbalance:
             st.moe_expert_imbalance = moe_expert_imbalance
+        st.batch_queued = batch_queued
+        st.batch_active = batch_active
+        if batch_preemptions:
+            st.batch_preemptions = batch_preemptions
         st.poll_failures = 0
         st.last_poll_ok_ts = time.monotonic()
         st.updated_at = time.monotonic()
@@ -829,12 +856,32 @@ class EndpointPicker:
         # nothing is presumed idle); only when NO candidate has data
         # does the picker fall back to static scoring — and it never
         # sheds blind.
+        # offline tier routing (ISSUE 19): batch goes to the replica
+        # with the MOST idle capacity — total footprint (interactive
+        # slots + queue + its own class's backlog) over slot count,
+        # plus KV pressure. Batch is NEVER SLO-shed: the slo branch
+        # below (and its shed) is skipped entirely — a loaded fleet
+        # queues batch on the least-loaded replica and lets the
+        # two-class engine scheduler soak slots as they free up.
+        batch_pick = (headers or {}).get(PRIORITY_HEADER, "") == "batch"
         pred_raw: dict[str, float | None] = {}
-        if self.mode == "slo" and fresh:
+        if self.mode == "slo" and fresh and not batch_pick:
             pred_raw = {a: self.predicted_ttft_ms(self.state[a],
                                                   prompt_tokens)
                         for a in fresh}
-        if any(p is not None for p in pred_raw.values()):
+        if batch_pick and fresh:
+
+            def batch_load(a: str) -> float:
+                st = self.state[a]
+                return ((st.active_slots + st.queued + st.batch_queued)
+                        / st.max_slots + st.worst_kv_occupancy())
+
+            chosen = min(sorted(fresh), key=batch_load)
+            if explain is not None:
+                explain.update(
+                    mode="batch", candidates=len(fresh),
+                    batch_load=round(batch_load(chosen), 4))
+        elif any(p is not None for p in pred_raw.values()):
             pred = {a: (p if p is not None else 0.0)
                     for a, p in pred_raw.items()}
             if self.slo_ttft_ms > 0:

@@ -60,6 +60,7 @@ from aigw_tpu.gateway.picker import (
     KV_CHAIN_HEADER,
     KV_PEERS_HEADER,
     PREFIX_HEADER,
+    PRIORITY_HEADER,
     PROMPT_TOKENS_HEADER,
     TENANT_HEADER,
     ContextLengthError,
@@ -316,6 +317,20 @@ class GatewayServer:
                                 self._handle_fleet_metrics)
         self.app.router.add_get("/debug/decisions",
                                 self._handle_decisions)
+        # offline batch tier (ISSUE 19): file upload + batch lifecycle
+        # forwarded to a picker-chosen replica (batch priority — most
+        # idle capacity); later polls follow the id → replica map so
+        # submit/poll/fetch land on the replica that holds the state
+        self.app.router.add_post("/v1/files", self._handle_file_upload)
+        self.app.router.add_get("/v1/files/{fid}/content",
+                                self._handle_batch_forward)
+        self.app.router.add_post("/v1/batches",
+                                 self._handle_batch_create)
+        self.app.router.add_get("/v1/batches/{bid}",
+                                self._handle_batch_forward)
+        self.app.router.add_post("/v1/batches/{bid}/cancel",
+                                 self._handle_batch_forward)
+        self._batch_replica: dict[str, str] = {}
         self.decisions = DecisionRing(
             capacity=int(os.environ.get("AIGW_DECISION_RING", "512")))
         # debug/admin surface (reference: pprof :6060 + admin server on a
@@ -500,6 +515,123 @@ class GatewayServer:
     async def _handle_metrics(self, _request: web.Request) -> web.Response:
         return web.Response(body=self.metrics.export(),
                             content_type="text/plain")
+
+    # -- offline batch tier (ISSUE 19) ------------------------------------
+    #: bound on the (file/batch id → replica) routing map
+    _BATCH_MAP_MAX = 10_000
+
+    def _batch_pick(self) -> str | None:
+        """A replica for NEW batch state: the first configured pool's
+        batch-priority pick — most idle capacity, never SLO-shed (the
+        picker's batch branch skips admission control entirely)."""
+        for _name, picker in sorted(self._pickers.items()):
+            dest = picker.pick({PRIORITY_HEADER: "batch"})
+            if dest:
+                return dest
+        return None
+
+    def _remember_batch(self, obj_id: str, addr: str) -> None:
+        self._batch_replica[obj_id] = addr
+        while len(self._batch_replica) > self._BATCH_MAP_MAX:
+            self._batch_replica.pop(next(iter(self._batch_replica)))
+
+    async def _proxy_batch(self, request: web.Request, addr: str,
+                           raw: bytes | None = None
+                           ) -> tuple[int, bytes, str]:
+        """Forward one batch-surface request to its replica verbatim;
+        (status, body, content_type) — upstream failures map to 502."""
+        session = await self._get_session()
+        if raw is None:
+            raw = await request.read()
+        try:
+            async with session.request(
+                    request.method, f"http://{addr}{request.path}",
+                    data=raw,
+                    headers={"content-type": request.headers.get(
+                        "content-type", "application/json")},
+                    timeout=aiohttp.ClientTimeout(total=60.0)) as resp:
+                return (resp.status, await resp.read(),
+                        resp.content_type or "application/json")
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            return (502,
+                    error_body(f"batch replica {addr} unreachable: {e}",
+                               type_="server_error"),
+                    "application/json")
+
+    async def _handle_file_upload(self, request: web.Request
+                                  ) -> web.Response:
+        dest = self._batch_pick()
+        if dest is None:
+            return web.Response(
+                status=503,
+                body=error_body("no replica available for batch work",
+                                type_="server_error"),
+                content_type="application/json")
+        status, body, ctype = await self._proxy_batch(request, dest)
+        if status == 200:
+            try:
+                fid = str(json.loads(body).get("id", ""))
+            except ValueError:
+                fid = ""
+            if fid:
+                self._remember_batch(fid, dest)
+        return web.Response(status=status, body=body,
+                            content_type=ctype)
+
+    async def _handle_batch_create(self, request: web.Request
+                                   ) -> web.Response:
+        """POST /v1/batches — lands on the replica already holding the
+        input file (the id → replica map); a miss falls back to a fresh
+        batch pick, where the unknown file id 404s honestly."""
+        raw = await request.read()
+        try:
+            fid = str(json.loads(raw).get("input_file_id", ""))
+        except ValueError:
+            fid = ""
+        dest = self._batch_replica.get(fid) or self._batch_pick()
+        if dest is None:
+            return web.Response(
+                status=503,
+                body=error_body("no replica available for batch work",
+                                type_="server_error"),
+                content_type="application/json")
+        status, body, ctype = await self._proxy_batch(request, dest,
+                                                      raw=raw)
+        if status == 200:
+            try:
+                bid = str(json.loads(body).get("id", ""))
+            except ValueError:
+                bid = ""
+            if bid:
+                self._remember_batch(bid, dest)
+        return web.Response(status=status, body=body,
+                            content_type=ctype)
+
+    async def _handle_batch_forward(self, request: web.Request
+                                    ) -> web.Response:
+        """Poll / cancel / output fetch — follows the id → replica
+        map (batch state is replica-local by design)."""
+        oid = (request.match_info.get("bid")
+               or request.match_info.get("fid") or "")
+        dest = self._batch_replica.get(oid)
+        if dest is None:
+            return web.Response(
+                status=404,
+                body=error_body(f"unknown batch object {oid!r}"),
+                content_type="application/json")
+        status, body, ctype = await self._proxy_batch(request, dest)
+        if status == 200 and request.match_info.get("bid"):
+            # learn the output file id from poll bodies so the later
+            # GET /v1/files/{ofid}/content resolves to the same replica
+            try:
+                ofid = str(json.loads(body).get("output_file_id")
+                           or "")
+            except ValueError:
+                ofid = ""
+            if ofid:
+                self._remember_batch(ofid, dest)
+        return web.Response(status=status, body=body,
+                            content_type=ctype)
 
     # -- fleet observability plane (ISSUE 12) -----------------------------
     async def _handle_fleet_state(self, _request: web.Request
@@ -1264,6 +1396,10 @@ class GatewayServer:
             # the replica's fairness guard keys on the SAME tenant the
             # gateway accounts/ratelimits by
             headers[TENANT_HEADER] = client_headers[TENANT_HEADER]
+        if PRIORITY_HEADER in client_headers:
+            # priority class (ISSUE 19): the replica's two-class
+            # scheduler must see the SAME class the picker routed by
+            headers[PRIORITY_HEADER] = client_headers[PRIORITY_HEADER]
         headers = apply_header_mutation(headers, backend.header_mutation)
         import urllib.parse as _up
 

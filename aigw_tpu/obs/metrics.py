@@ -258,6 +258,17 @@ ENGINE_GAUGES: tuple[tuple[str, str], ...] = (
     ("moe_tokens_dropped", "tpuserve_moe_tokens_dropped_total"),
     ("moe_dropped_frac", "tpuserve_moe_dropped_frac"),
     ("moe_expert_imbalance", "tpuserve_moe_expert_imbalance"),
+    # priority-tiered serving (ISSUE 19): the offline /v1/batches
+    # class. Queued = never-shed backlog + host-parked preempted
+    # sessions; active = decode slots it holds (≤ the batch_slot_frac
+    # ceiling); preemptions/resumed = the park→resume churn interactive
+    # arrivals drive; tokens = the idle-slot-soak volume the bench's
+    # batch_tier A/B prices against measured idle capacity.
+    ("batch_queued", "tpuserve_batch_queued"),
+    ("batch_active", "tpuserve_batch_active"),
+    ("batch_preemptions", "tpuserve_batch_preemptions_total"),
+    ("batch_resumed", "tpuserve_batch_resumed_total"),
+    ("batch_tokens", "tpuserve_batch_tokens_total"),
 )
 
 #: per-device gauge surface (ISSUE 10): key in one entry of

@@ -22,6 +22,7 @@ picker — the reference's EPP signal (SURVEY.md §3.4).
 from __future__ import annotations
 
 import dataclasses
+import heapq
 import itertools
 import logging
 import os
@@ -333,6 +334,16 @@ class EngineConfig:
     # (classic eviction). The budget counts page bytes in the pool's
     # native KV dtype.
     kv_host_bytes: int = 0
+    # Priority-tiered serving (ISSUE 19): ceiling on the fraction of
+    # decode slots the offline batch class may occupy at once (at least
+    # one slot when > 0). Batch requests admit only when the
+    # interactive queue is empty and stay under this footprint, so a
+    # saturating /v1/batches backlog can never crowd interactive
+    # admissions out of the batch — interactive pressure additionally
+    # preempts batch sessions (window shrink, then park) to reclaim
+    # slots. 1.0 lets batch soak every idle slot; interactive still
+    # evicts it on arrival.
+    batch_slot_frac: float = 0.5
     # Per-token logprobs (vLLM/OpenAI parity): when > 0, the decode scan
     # also returns the chosen token's log-probability and the top-k
     # (ids, values) per step, and requests may set want_logprobs. Static
@@ -356,6 +367,10 @@ class EngineConfig:
             raise ValueError(
                 "ragged_chunk_tokens must be >= 8 and ragged_max_chunks "
                 ">= 1")
+        if not 0.0 < self.batch_slot_frac <= 1.0:
+            raise ValueError(
+                f"batch_slot_frac must be in (0, 1] "
+                f"(got {self.batch_slot_frac})")
         if self.prefill_bucket_rungs not in (1, 2, 4):
             raise ValueError(
                 f"prefill_bucket_rungs must be 1, 2, or 4 "
@@ -413,6 +428,14 @@ class GenRequest:
     # derives it from the x-aigw-tenant header (relayed by the gateway)
     # or the adapter suffix of the requested model name.
     tenant: str = ""
+    # Priority class (ISSUE 19): "interactive" rides the normal
+    # admission queue; "batch" rides the never-shed offline queue,
+    # admits only into slots interactive doesn't want (ceiling:
+    # batch_slot_frac), and may be preempted — parked host-side and
+    # resumed later byte-identically — when interactive arrivals need
+    # its slot. The server derives it from the x-aigw-priority header
+    # or the /v1/batches surface.
+    priority: str = "interactive"
     # Per-token logprobs: when set (and the engine was built with
     # logprobs_topk > 0), emit_lp is called INSTEAD of emit with
     # (token, finish, logprob, top) where top = [(token_id, logprob)]
@@ -536,6 +559,20 @@ class EngineStats:
     tenants_active: int = 0
     tenant_max_slots: int = 0
     tenant_deferrals: int = 0
+    # priority-tiered serving (ISSUE 19): the offline batch class.
+    # batch_queued counts waiting batch work (the never-shed queue plus
+    # host-parked preempted sessions), batch_active the decode slots it
+    # holds now (always <= the batch_slot_frac ceiling),
+    # batch_preemptions the sessions parked off-device because an
+    # interactive arrival wanted the slot, batch_resumed the parked
+    # sessions re-admitted (byte-identical continuation), batch_tokens
+    # the tokens the class has generated — the idle-slot-soak volume
+    # the bench's batch_tier A/B prices.
+    batch_queued: int = 0
+    batch_active: int = 0
+    batch_preemptions: int = 0
+    batch_resumed: int = 0
+    batch_tokens: int = 0
     # prefill/decode disaggregation (ISSUE 8): sessions exported to /
     # imported from other replicas, the KV pages that moved with them,
     # and the live count of migration-eligible slots (prefill done,
@@ -849,6 +886,17 @@ class Engine:
         # same first-None index and the outer install would orphan it.
         self._reserved_slots: set[int] = set()
         self._queue: "queue.Queue[GenRequest]" = queue.Queue()
+        # priority-tiered serving (ISSUE 19): the offline batch class.
+        # Its queue is SEPARATE (and unbounded — batch never sheds) so
+        # every interactive signal stays batch-free for free: the
+        # window-shrink pressure predicate, queue_wait_ms, /state
+        # ``queued``, and the chunk-boundary interactive admission all
+        # read only self._queue. Parked sessions are preempted batch
+        # streams cut off-device through the migration export path
+        # ({"blob", "data", emit/cancelled/trace}), resumed (oldest
+        # first) into slots interactive doesn't want.
+        self._batch_q: "queue.Queue[GenRequest]" = queue.Queue()
+        self._parked_batch: list[dict] = []
         self._seq_ids = itertools.count()
         self._stop = threading.Event()
         self._wake = threading.Event()
@@ -1559,17 +1607,32 @@ class Engine:
             taken[t] = taken.get(t, 0) + 1
             eligible.append(req)
         if len({r.tenant for r in eligible}) > 1:
-            # deficit round-robin: repeatedly admit the earliest request
-            # of the least-loaded tenant (O(n²) on n ≤ queue bound)
-            counts = dict(live)
+            # deficit round-robin in ONE pass (ISSUE 19 satellite — the
+            # old scan re-walked the whole remainder per admission,
+            # O(n²) on the queue bound): per-tenant FIFOs + a heap
+            # keyed (live-slot count, head arrival index). Only a
+            # tenant's HEAD can ever win the old min-scan (same count,
+            # earlier position than its followers), and comparing head
+            # positions across tenants is comparing arrival indices —
+            # so popping the heap min and re-pushing the tenant at
+            # count+1 with its next head reproduces the old order
+            # exactly (tests/test_batch_tier.py holds the old loop as
+            # the property-test oracle).
+            fifos: dict[str, list[tuple[int, GenRequest]]] = {}
+            for j, req in enumerate(eligible):
+                fifos.setdefault(req.tenant, []).append((j, req))
+            heap = [(live.get(t, 0), lst[0][0], t)
+                    for t, lst in fifos.items()]
+            heapq.heapify(heap)
+            heads = dict.fromkeys(fifos, 0)
             ordered: list[GenRequest] = []
-            rest = list(eligible)
-            while rest:
-                i = min(range(len(rest)),
-                        key=lambda j: (counts.get(rest[j].tenant, 0), j))
-                req = rest.pop(i)
-                counts[req.tenant] = counts.get(req.tenant, 0) + 1
-                ordered.append(req)
+            while heap:
+                cnt, _, t = heapq.heappop(heap)
+                lst, h = fifos[t], heads[t]
+                ordered.append(lst[h][1])
+                heads[t] = h + 1
+                if h + 1 < len(lst):
+                    heapq.heappush(heap, (cnt + 1, lst[h + 1][0], t))
             eligible = ordered
         admit = eligible[:free]
         left = set(map(id, capped)) | set(map(id, eligible[free:]))
@@ -1988,8 +2051,15 @@ class Engine:
             self.stats.decode_window = K
             return K
         kmin = ladder[0]
+        # pressure is an INTERACTIVE signal (ISSUE 19): batch rides its
+        # own queue (never in self._queue) and a freshly admitted batch
+        # stream has no TTFT stake — only interactive arrivals and
+        # young interactive streams shrink the window. This is the
+        # first preemption rung: a waiting interactive request cuts the
+        # dispatch window under every live batch slot immediately.
         pressured = self._queue.qsize() > 0 or any(
-            s is not None and s.generated <= 1 for s in self._slots
+            s is not None and s.generated <= 1
+            and s.req.priority != "batch" for s in self._slots
         )
         if pressured:
             self._steady_ticks = 0
@@ -2027,6 +2097,14 @@ class Engine:
                 f"prompt+max_tokens {len(req.prompt)}+{req.max_tokens} exceeds "
                 f"max_seq_len {self.cfg.max_seq_len}"
             )
+        if req.priority == "batch":
+            # the offline tier never sheds: batch work QUEUES under
+            # pressure (unbounded — the /v1/batches surface bounds
+            # in-flight lines host-side) instead of 429ing, and admits
+            # only into slots interactive doesn't want
+            self._batch_q.put(req)
+            self._wake.set()
+            return
         if self._queue.qsize() >= self.cfg.max_queued_requests:
             raise EngineOverloadedError(
                 f"queue full ({self.cfg.max_queued_requests} waiting)"
@@ -2299,6 +2377,33 @@ class Engine:
             raise MigrationError("request finished during the export cut")
         if s.generated < 1:
             raise MigrationError("prefill not finished (no token yet)")
+        # the cut: finish the slot with "migrated" — pages free under
+        # the normal refcount discipline (cache-registered prompt pages
+        # park evictable; the export pin already released)
+        if req.trace is not None:
+            req.trace.engine_finish("migrated")
+        out = self._export_cut(idx)
+        req.emit(-1, "migrated")
+        self.stats.migrations_out += 1
+        self.stats.migration_pages_out += len(out["data"])
+        logger.info("exported seq %d: %d tokens, %d pages", req.id,
+                    len(out["blob"]["tokens"]), len(out["data"]))
+        return out
+
+    @engine_thread_only
+    def _export_cut(self, idx: int) -> dict:
+        """Serialize slot ``idx``'s session at the (already settled)
+        token boundary and free the slot — the shared engine-thread cut
+        behind both the migration export (wire transfer to a sibling)
+        and the batch-preemption park (host-side stash on THIS
+        replica). Wire rule unchanged: only complete written pages
+        travel; the ≤ one-page tail is recomputed by the resume's
+        offset prefill. The CALLER owns emit/trace/counter semantics —
+        migration finishes the stream, a park keeps the consumer
+        attached. Returns {"blob": <json-able>, "data": [np pages]}."""
+        s = self._slots[idx]
+        assert s is not None
+        req = s.req
         ps = self.cfg.page_size
         tokens = list(req.prompt) + list(s.gen_tokens)
         m = len(tokens)
@@ -2329,6 +2434,7 @@ class Engine:
             "key_seed": s.key_seed,
             "adapter": req.adapter,
             "tenant": req.tenant,
+            "priority": req.priority,
             "stop_token_ids": list(req.stop_token_ids),
             "sampling": {
                 "temperature": sp.temperature, "top_p": sp.top_p,
@@ -2338,22 +2444,67 @@ class Engine:
                 "logit_bias": [[t, b] for t, b in sp.logit_bias],
             },
         }
-        # the cut: finish the slot with "migrated" — pages free under
-        # the normal refcount discipline (cache-registered prompt pages
-        # park evictable; the export pin above already released)
-        if req.trace is not None:
-            req.trace.engine_finish("migrated")
         self._pending_frees.append(req.id)
         self._release_adapter_row(s.adapter_row)
         self._slots[idx] = None
         self._dirty_rows.add(idx)
         self._wake.set()
-        req.emit(-1, "migrated")
-        self.stats.migrations_out += 1
-        self.stats.migration_pages_out += len(pages)
-        logger.info("exported seq %d: %d tokens, %d pages", req.id, m,
-                    len(pages))
         return {"blob": blob, "data": data}
+
+    @engine_thread_only
+    def _park_batch_slot(self, idx: int) -> bool:
+        """Preemption rung (ii): cut one live BATCH slot off the device
+        through the migration export machinery and stash it host-side
+        (pages + blob + the still-attached consumer callback); the
+        batch tier resumes it byte-identically once interactive stops
+        wanting the slot. Returns True when the slot is free afterward
+        (parked, or found finished by the settle), False when the
+        session is not parkable — no token yet, logprobs/constrained
+        (the blob carries neither), or no refcounted allocator — and
+        the caller should try another victim."""
+        s = self._slots[idx]
+        if s is None:
+            return True
+        req = s.req
+        if (not isinstance(self.allocator, RefcountedAllocator)
+                or req.emit_lp is not None
+                or req.constraint is not None
+                or s.generated < 1):
+            return False
+        # settle the in-flight window so the cut is a token boundary
+        self._drain_inflight()
+        self._apply_frees()
+        s = self._slots[idx]
+        if s is None or s.req is not req:
+            return True  # finished during the settle — slot is free
+        if req.trace is not None:
+            req.trace.engine_finish("parked")
+        entry = self._export_cut(idx)
+        entry["emit"] = req.emit
+        entry["cancelled"] = req.cancelled
+        self._parked_batch.append(entry)
+        self.stats.batch_preemptions += 1
+        logger.info("parked batch seq %d (%d pages) for interactive "
+                    "admission", req.id, len(entry["data"]))
+        return True
+
+    @engine_thread_only
+    def _preempt_batch(self) -> bool:
+        """Park live batch slots so WAITING interactive requests can
+        admit — called by _admit when every slot is taken. Parks at
+        most as many sessions as requests are waiting. Returns True
+        when at least one slot freed."""
+        want = self._queue.qsize()
+        if want <= 0:
+            return False
+        freed = 0
+        for i, s in enumerate(self._slots):
+            if freed >= want:
+                break
+            if (s is not None and s.req.priority == "batch"
+                    and self._park_batch_slot(i)):
+                freed += 1
+        return freed > 0
 
     @engine_thread_only
     def _do_import(self, tokens: list[int],
@@ -2407,6 +2558,11 @@ class Engine:
         if source == "fetch":
             self.stats.kv_fetches_in += 1
             self.stats.kv_fetch_pages_in += k
+        elif source == "parked":
+            # batch park/resume is intra-replica: it rides the
+            # batch_preemptions / batch_resumed pair, not the
+            # cross-replica migration counters
+            pass
         else:
             self.stats.migrations_in += 1
             self.stats.migration_pages_in += k
@@ -2424,6 +2580,8 @@ class Engine:
                 self._reap_cancelled()
                 self._process_migrations()
                 admitted = self._admit()
+                # the offline tier soaks whatever interactive left idle
+                admitted |= self._admit_batch_tier()
                 worked = self._decode_tick()
                 if self._stop.is_set():
                     self._drain_inflight()
@@ -2469,6 +2627,17 @@ class Engine:
                 req.emit(-1, "error")
         except queue.Empty:
             pass
+        # the batch tier's queue and parked sessions have waiting
+        # consumers too (never-shed ≠ never-finished on engine death)
+        try:
+            while True:
+                req = self._batch_q.get_nowait()
+                req.emit(-1, "error")
+        except queue.Empty:
+            pass
+        for park in self._parked_batch:
+            park["emit"](-1, "error")
+        self._parked_batch.clear()
         # waiting migration callers must not hang until their timeout
         try:
             while True:
@@ -2484,6 +2653,11 @@ class Engine:
             if s is not None and s.req.cancelled.is_set():
                 if s.req.trace is not None:
                     s.req.trace.engine_finish("cancel")
+                # a cancelled stream still has a waiting consumer (the
+                # batch runner's _collect, a non-streaming handler):
+                # reaping the slot without a terminal event would hang
+                # it forever — a /v1/batches cancel must finalize
+                s.req.emit(-1, "cancelled")
                 self._pending_frees.append(s.req.id)
                 self._release_adapter_row(s.adapter_row)
                 self._slots[i] = None
@@ -2515,7 +2689,15 @@ class Engine:
         while True:
             free = self._free_slot_count()
             if free == 0:
-                break
+                # interactive arrivals under a full batch reclaim slots
+                # from the offline class (ISSUE 19): rung (i) — the
+                # shrunk dispatch window — already bounded the wait;
+                # rung (ii) parks batch sessions host-side
+                if not self._preempt_batch():
+                    break
+                free = self._free_slot_count()
+                if free == 0:
+                    break
             pending: list[GenRequest] = []
             try:
                 while len(pending) < free:
@@ -2705,6 +2887,112 @@ class Engine:
         self._requeue_front_many(
             [r for r in backlog if id(r) not in handled])
         return admitted
+
+    def _batch_ceiling(self) -> int:
+        """Most decode slots the batch class may hold at once."""
+        return max(1, int(self.cfg.batch_slot_frac
+                          * self.cfg.max_batch_size))
+
+    def _batch_active(self) -> int:
+        return sum(1 for s in self._slots
+                   if s is not None and s.req.priority == "batch")
+
+    @engine_thread_only
+    def _admit_batch_tier(self) -> bool:
+        """Admit offline work into slots interactive doesn't want: runs
+        AFTER the interactive admission pass, only while the
+        interactive queue is empty, and never past the batch_slot_frac
+        ceiling — the priority generalization of the deficit-weighted
+        tenant scan (which still orders WITHIN the class). Parked
+        (preempted) sessions resume first, oldest first: their pages
+        re-import through the migration scatter path, the continuation
+        admission adopts them from the prefix cache, and the resumed
+        stream is byte-identical to an uninterrupted run
+        (tests/test_batch_tier.py's f32 rig)."""
+        admitted = False
+        while True:
+            if self._queue.qsize() > 0:
+                break  # interactive wants the slots — yield
+            room = min(self._free_slot_count(),
+                       self._batch_ceiling() - self._batch_active())
+            if room <= 0:
+                break
+            if self._parked_batch:
+                park = self._parked_batch[0]
+                if park["cancelled"].is_set():
+                    # dropping a parked session is a cancel FINISH, not
+                    # a silent vanish — its _collect is still waiting
+                    park["emit"](-1, "cancelled")
+                    self._parked_batch.pop(0)
+                    continue
+                try:
+                    self._do_import(
+                        [int(t) for t in park["blob"]["tokens"]],
+                        park["data"], 0, "parked")
+                except (MigrationError, OutOfPagesError):
+                    break  # pool pressure: retry at a later pass
+                req = continuation_request(park["blob"],
+                                           emit=park["emit"])
+                req.cancelled = park["cancelled"]
+                self._parked_batch.pop(0)
+                _ok, chain = self._classify(req)
+                r = self._admit_one(req, chain)
+                if r == "admitted":
+                    admitted = True
+                    self.stats.batch_resumed += 1
+                elif r == "stop":
+                    # page pressure mid-admission: the imported pages
+                    # stay cached (evictable) — re-park, retry later
+                    self._parked_batch.insert(0, park)
+                    break
+                elif r == "stop_consumed":
+                    break
+                continue
+            pending: list[GenRequest] = []
+            try:
+                while len(pending) < room:
+                    pending.append(self._batch_q.get_nowait())
+            except queue.Empty:
+                pass
+            if not pending:
+                break
+            admit, requeue, capped = self._fair_admission(pending, room)
+            self.stats.tenant_deferrals += capped
+            stop = False
+            unhandled: list[GenRequest] = []
+            for j, req in enumerate(admit):
+                if req.cancelled.is_set():
+                    # popped from _batch_q with a consumer still
+                    # draining its queue — finalize, don't drop
+                    req.emit(-1, "cancelled")
+                    continue
+                _ok, chain = self._classify(req)
+                r = self._admit_one(req, chain)
+                if r == "admitted":
+                    admitted = True
+                elif r in ("stop", "stop_consumed"):
+                    if r == "stop":
+                        unhandled.append(req)
+                    unhandled.extend(admit[j + 1:])
+                    stop = True
+                    break
+            if unhandled or requeue:
+                self._requeue_batch_front(unhandled + requeue)
+            if stop or requeue:
+                break
+        return admitted
+
+    def _requeue_batch_front(self, reqs: list[GenRequest]) -> None:
+        items = list(reqs)
+        if not items:
+            return
+        try:
+            while True:
+                items.append(self._batch_q.get_nowait())
+        except queue.Empty:
+            pass
+        for it in items:
+            self._batch_q.put(it)
 
     def _classify(self, req: GenRequest) -> tuple[bool, list]:
         """(simple, chain_keys): simple = eligible for the batched
@@ -3908,10 +4196,15 @@ class Engine:
         if s.generated == 1:
             s.first_emit_at = time.monotonic()
             # engine-side TTFT: arrival → first sampled token available
-            # (queue wait + prefill + first-emit residual)
-            self.phases.observe(
-                "ttft", 1e3 * (s.first_emit_at - req.enqueued_at),
-                req.trace.trace_id if req.trace is not None else "")
+            # (queue wait + prefill + first-emit residual). Batch
+            # streams are EXCLUDED — the histogram feeds the SLO
+            # burn-rate monitor and the gateway's predicted-TTFT
+            # pricing, both of which must see only interactive latency
+            # (offline work queuing for minutes is by design, not burn)
+            if req.priority != "batch":
+                self.phases.observe(
+                    "ttft", 1e3 * (s.first_emit_at - req.enqueued_at),
+                    req.trace.trace_id if req.trace is not None else "")
             if req.trace is not None:
                 req.trace.first_token()
         finish: str | None = None
@@ -3924,6 +4217,8 @@ class Engine:
                 finish = "length"
             _send(tok, finish)
         self.stats.tokens_generated += 1
+        if req.priority == "batch":
+            self.stats.batch_tokens += 1
         if finish is not None:
             if s.generated > 1 and s.first_emit_at:
                 self.phases.observe(
@@ -3946,7 +4241,13 @@ class Engine:
 
     @engine_thread_only
     def _refresh_stats(self) -> None:
+        # ``queued`` is INTERACTIVE depth only — the picker's
+        # predicted_ttft_ms and the controller's idle predicate price
+        # it; offline backlog rides the batch_* pair below
         self.stats.queued = self._queue.qsize()
+        self.stats.batch_queued = (self._batch_q.qsize()
+                                   + len(self._parked_batch))
+        self.stats.batch_active = self._batch_active()
         if self.stats.prefill_tokens_padded:
             self.stats.prefill_padded_frac = round(
                 1.0 - self.stats.prefill_tokens_real
@@ -4119,6 +4420,7 @@ def continuation_request(blob: dict,
         emit=emit,
         adapter=str(blob.get("adapter", "")),
         tenant=str(blob.get("tenant", "")),
+        priority=str(blob.get("priority", "interactive")),
         import_state={
             "orig_prompt_len": int(blob.get("orig_prompt_len",
                                             len(tokens))),

@@ -120,6 +120,14 @@ def decode_wire_page(p: dict):
 #: and joins the gateway's per-tenant cost/quota accounting
 TENANT_HEADER = "x-aigw-tenant"
 
+#: priority class header (ISSUE 19): ``batch`` rides the engine's
+#: offline tier — admitted only into slots interactive doesn't want,
+#: preempted (window shrink, then host-side park) under interactive
+#: pressure, and NEVER 429-shed (the engine's batch queue is
+#: unbounded). Anything else (absent, "", "interactive") is the
+#: default interactive class.
+PRIORITY_HEADER = "x-aigw-priority"
+
 #: sibling replicas ("host:port", comma-separated) the gateway believes
 #: hold KV for this request's prompt chain (ISSUE 11): on a prefix miss
 #: the server fetches the missing leading pages from them over
@@ -338,6 +346,17 @@ class TPUServeServer:
         # (ISSUE 11) — one pooled session per server, closed on cleanup
         self._kv_session = None
 
+        # offline batch tier (ISSUE 19): in-memory file store (JSONL in,
+        # JSONL out) + batch objects and their runner tasks. Batch lines
+        # run through the normal submit path at priority="batch" — the
+        # engine's unbounded batch queue absorbs any backlog, so the
+        # tier never 429-sheds.
+        self._files: dict[str, bytes] = {}
+        self._batches: dict[str, dict] = {}
+        self._batch_lines: dict[str, list] = {}
+        self._batch_tasks: dict[str, asyncio.Task] = {}
+        self._batch_live: dict[str, list[GenRequest]] = {}
+
         # body cap sized for /migrate/import: a migrated page chain is
         # megabytes of KV by design (page_bytes × pages on the wire)
         self.app = web.Application(client_max_size=256 * 1024 * 1024)
@@ -353,6 +372,15 @@ class TPUServeServer:
         self.app.router.add_get("/state", self._state)
         self.app.router.add_get("/metrics", self._metrics)
         self.app.router.add_post("/drain", self._drain)
+        # offline batch tier (ISSUE 19): file upload + batch lifecycle
+        self.app.router.add_post("/v1/files", self._file_upload)
+        self.app.router.add_get("/v1/files/{fid}/content",
+                                self._file_content)
+        self.app.router.add_post("/v1/batches", self._batch_create)
+        self.app.router.add_get("/v1/batches", self._batch_list)
+        self.app.router.add_get("/v1/batches/{bid}", self._batch_get)
+        self.app.router.add_post("/v1/batches/{bid}/cancel",
+                                 self._batch_cancel)
         self.app.router.add_post("/migrate/export", self._migrate_export)
         self.app.router.add_post("/migrate/import", self._migrate_import)
         self.app.router.add_post("/kv/pages", self._kv_pages)
@@ -414,6 +442,8 @@ class TPUServeServer:
         self.engine.start()
 
     async def _on_stop(self, _app) -> None:
+        for task in self._batch_tasks.values():
+            task.cancel()
         if self._kv_session is not None:
             await self._kv_session.close()
             self._kv_session = None
@@ -573,7 +603,7 @@ class TPUServeServer:
     def _submit(self, prompt: list[int], body: dict[str, Any],
                 lp_top_n: int = -1, prefix_hashes: list | None = None,
                 trace: RequestTrace | None = None, tenant: str = "",
-                constraint: Any = None):
+                constraint: Any = None, priority: str = "interactive"):
         """Submit to the engine; returns an asyncio.Queue of
         (token_id, finish_reason, lp) tuples — lp is None without
         logprobs, else (chosen_logprob, [(top_id, top_logprob)]).
@@ -605,6 +635,7 @@ class TPUServeServer:
             # a tenant header wins; adapter-suffixed traffic without one
             # defaults to per-adapter tenancy (each adapter ≈ a tenant)
             tenant=tenant or adapter,
+            priority=priority,
             prefix_hashes=prefix_hashes,
             constraint=constraint,
             trace=trace,
@@ -770,6 +801,12 @@ class TPUServeServer:
             return web.Response(status=400, body=oai.error_body(str(e)),
                                 content_type="application/json")
         tenant = request.headers.get(TENANT_HEADER, "")
+        # priority class (ISSUE 19): "batch" rides the engine's offline
+        # tier (never 429-shed — its queue is unbounded); anything else
+        # is interactive
+        priority = ("batch"
+                    if request.headers.get(PRIORITY_HEADER, "") == "batch"
+                    else "interactive")
         n = int(body.get("n") or 1)
         try:
             # grammar-constrained decoding intake (ISSUE 9): malformed
@@ -797,10 +834,10 @@ class TPUServeServer:
             if stream:
                 return await self._generate_n_stream(
                     request, body, prompt, chat, n, lp_top_n,
-                    prefix_hashes, tenant, constraint)
+                    prefix_hashes, tenant, constraint, priority)
             return await self._generate_n(body, prompt, chat, n,
                                           lp_top_n, prefix_hashes,
-                                          tenant, constraint)
+                                          tenant, constraint, priority)
         include_usage = oai.include_stream_usage(body)
         rid = (
             f"chatcmpl-{uuid.uuid4().hex[:24]}"
@@ -825,7 +862,7 @@ class TPUServeServer:
         try:
             out, gen_req = self._submit(prompt, body, lp_top_n,
                                         prefix_hashes, trace, tenant,
-                                        constraint)
+                                        constraint, priority)
         except EngineOverloadedError as e:
             self._end_trace(trace, "rejected", 0, len(prompt),
                             error=str(e))
@@ -1219,7 +1256,8 @@ class TPUServeServer:
 
     def _submit_n(self, body: dict[str, Any], prompt: list[int], n: int,
                   lp_top_n: int, prefix_hashes: list | None = None,
-                  tenant: str = "", constraint: Any = None):
+                  tenant: str = "", constraint: Any = None,
+                  priority: str = "interactive"):
         """Fan out n engine submissions with per-choice seeds (shared by
         the buffered and streaming n>1 paths — one copy of the seed
         derivation, overload cleanup, and error mapping). Returns the
@@ -1236,7 +1274,8 @@ class TPUServeServer:
                 ) else 0
                 outs.append(self._submit(prompt, per_choice, lp_top_n,
                                          prefix_hashes, tenant=tenant,
-                                         constraint=constraint))
+                                         constraint=constraint,
+                                         priority=priority))
         except EngineOverloadedError as e:
             for _q, req in outs:  # don't orphan already-queued choices
                 req.cancelled.set()
@@ -1263,6 +1302,7 @@ class TPUServeServer:
         self, body: dict[str, Any], prompt: list[int], chat: bool, n: int,
         lp_top_n: int = -1, prefix_hashes: list | None = None,
         tenant: str = "", constraint: Any = None,
+        priority: str = "interactive",
     ) -> web.Response:
         """n>1 choices: fan out n engine requests (continuous batching
         runs them concurrently — same prompt pages shared by the prefix
@@ -1270,7 +1310,7 @@ class TPUServeServer:
         stops = body.get("stop")
         stop_strs = [stops] if isinstance(stops, str) else list(stops or [])
         outs = self._submit_n(body, prompt, n, lp_top_n, prefix_hashes,
-                              tenant, constraint)
+                              tenant, constraint, priority)
         if isinstance(outs, web.Response):
             return outs
         results = await asyncio.gather(
@@ -1317,7 +1357,7 @@ class TPUServeServer:
         self, request: web.Request, body: dict[str, Any],
         prompt: list[int], chat: bool, n: int, lp_top_n: int = -1,
         prefix_hashes: list | None = None, tenant: str = "",
-        constraint: Any = None,
+        constraint: Any = None, priority: str = "interactive",
     ) -> web.StreamResponse:
         """Streaming n>1 (OpenAI parity; previously 400): fan out n
         engine requests, merge their token streams, and emit one SSE
@@ -1329,7 +1369,7 @@ class TPUServeServer:
         stop_strs = [stops] if isinstance(stops, str) else list(stops or [])
         include_usage = oai.include_stream_usage(body)
         outs = self._submit_n(body, prompt, n, lp_top_n, prefix_hashes,
-                              tenant, constraint)
+                              tenant, constraint, priority)
         if isinstance(outs, web.Response):
             return outs
 
@@ -1622,6 +1662,276 @@ class TPUServeServer:
         ]
         return web.json_response(oai.models_response(entries))
 
+    # -- offline batch tier (ISSUE 19) ------------------------------------
+    #: request lines accepted per batch file (a replica-local in-memory
+    #: store, not a durable object store — bound the blast radius)
+    _BATCH_MAX_LINES = 10_000
+
+    async def _file_upload(self, request: web.Request) -> web.Response:
+        """POST /v1/files — accept a raw JSONL batch input body and
+        return a file id. Intentionally raw-body (not multipart): the
+        gateway forwards bytes verbatim and the batch surface is the
+        only consumer."""
+        if self.draining:
+            return self._drain_refusal()
+        raw = await request.read()
+        if not raw.strip():
+            return web.Response(
+                status=400,
+                body=oai.error_body("empty file body; POST the JSONL "
+                                    "batch input as the request body"),
+                content_type="application/json")
+        fid = f"file-{uuid.uuid4().hex[:24]}"
+        self._files[fid] = raw
+        return web.json_response({
+            "id": fid, "object": "file", "bytes": len(raw),
+            "created_at": int(time.time()), "purpose": "batch",
+        })
+
+    async def _file_content(self, request: web.Request) -> web.Response:
+        raw = self._files.get(request.match_info["fid"])
+        if raw is None:
+            return web.Response(
+                status=404, body=oai.error_body("unknown file id"),
+                content_type="application/json")
+        return web.Response(body=raw,
+                            content_type="application/jsonl")
+
+    def _parse_batch_lines(self, raw: bytes,
+                           endpoint: str) -> list[tuple[str, dict]]:
+        """Validate the whole JSONL input up front — every malformed
+        shape is a 400 naming its line BEFORE any engine work runs (a
+        half-executed batch that then 400s would strand its output).
+        Raises oai.SchemaError."""
+        lines: list[tuple[str, dict]] = []
+        seen: set[str] = set()
+        for i, ln in enumerate(raw.splitlines(), start=1):
+            if not ln.strip():
+                continue
+            try:
+                obj = json.loads(ln)
+            except ValueError:
+                raise oai.SchemaError(
+                    f"line {i}: not valid JSON") from None
+            if not isinstance(obj, dict):
+                raise oai.SchemaError(
+                    f"line {i}: each line must be a JSON object")
+            cid = obj.get("custom_id")
+            if not isinstance(cid, str) or not cid:
+                raise oai.SchemaError(
+                    f"line {i}: custom_id must be a non-empty string")
+            if cid in seen:
+                raise oai.SchemaError(
+                    f"line {i}: duplicate custom_id {cid!r}")
+            seen.add(cid)
+            if obj.get("method", "POST") != "POST":
+                raise oai.SchemaError(
+                    f"line {i}: method must be POST")
+            url = obj.get("url", endpoint)
+            if url != endpoint:
+                raise oai.SchemaError(
+                    f"line {i}: url {url!r} does not match the batch "
+                    f"endpoint {endpoint!r}")
+            body = obj.get("body")
+            if not isinstance(body, dict):
+                raise oai.SchemaError(
+                    f"line {i}: body must be a JSON object")
+            if body.get("stream"):
+                raise oai.SchemaError(
+                    f"line {i}: stream is not supported in batches")
+            lines.append((cid, body))
+        if not lines:
+            raise oai.SchemaError("batch input has no request lines")
+        if len(lines) > self._BATCH_MAX_LINES:
+            raise oai.SchemaError(
+                f"batch input has {len(lines)} lines; this replica "
+                f"caps a batch at {self._BATCH_MAX_LINES}")
+        return lines
+
+    async def _batch_create(self, request: web.Request) -> web.Response:
+        """POST /v1/batches — validate the input file, register the
+        batch object, and start the runner. Batch work is NEVER
+        429-shed: lines enter the engine's unbounded batch queue and
+        soak idle decode slots at strict low priority."""
+        if self.draining:
+            return self._drain_refusal()
+        try:
+            body = oai.parse_json_body(await request.read())
+        except oai.SchemaError as e:
+            return web.Response(status=400, body=oai.error_body(str(e)),
+                                content_type="application/json")
+        endpoint = str(body.get("endpoint", ""))
+        if endpoint not in ("/v1/chat/completions", "/v1/completions"):
+            return web.Response(
+                status=400,
+                body=oai.error_body(
+                    "endpoint must be /v1/chat/completions or "
+                    "/v1/completions"),
+                content_type="application/json")
+        fid = str(body.get("input_file_id", ""))
+        raw = self._files.get(fid)
+        if raw is None:
+            return web.Response(
+                status=404,
+                body=oai.error_body(f"unknown input_file_id {fid!r}"),
+                content_type="application/json")
+        try:
+            lines = self._parse_batch_lines(raw, endpoint)
+        except oai.SchemaError as e:
+            return web.Response(status=400, body=oai.error_body(str(e)),
+                                content_type="application/json")
+        bid = f"batch_{uuid.uuid4().hex[:24]}"
+        self._batches[bid] = {
+            "id": bid, "object": "batch", "endpoint": endpoint,
+            "input_file_id": fid, "status": "in_progress",
+            "output_file_id": None, "created_at": int(time.time()),
+            "request_counts": {"total": len(lines), "completed": 0,
+                               "failed": 0},
+        }
+        self._batch_lines[bid] = lines
+        self._batch_live[bid] = []
+        self._batch_tasks[bid] = asyncio.create_task(
+            self._run_batch(bid))
+        return web.json_response(self._batches[bid])
+
+    async def _batch_list(self, _request: web.Request) -> web.Response:
+        return web.json_response({
+            "object": "list",
+            "data": sorted(self._batches.values(),
+                           key=lambda b: b["created_at"]),
+        })
+
+    async def _batch_get(self, request: web.Request) -> web.Response:
+        b = self._batches.get(request.match_info["bid"])
+        if b is None:
+            return web.Response(
+                status=404, body=oai.error_body("unknown batch id"),
+                content_type="application/json")
+        return web.json_response(b)
+
+    async def _batch_cancel(self, request: web.Request) -> web.Response:
+        """POST /v1/batches/{id}/cancel — stop submitting new lines and
+        cancel the in-flight ones; the runner finalizes to
+        ``cancelled`` with the lines that DID finish in the output."""
+        bid = request.match_info["bid"]
+        b = self._batches.get(bid)
+        if b is None:
+            return web.Response(
+                status=404, body=oai.error_body("unknown batch id"),
+                content_type="application/json")
+        if b["status"] == "in_progress":
+            b["status"] = "cancelling"
+            for req in self._batch_live.get(bid, ()):
+                req.cancelled.set()
+        return web.json_response(b)
+
+    async def _batch_one(self, bid: str, body: dict[str, Any],
+                         chat: bool) -> tuple[int, dict[str, Any]]:
+        """Run ONE batch line through the normal submit path at
+        priority="batch" (non-streaming). Returns (status_code,
+        response body) — per-line failures are output lines, never a
+        batch-level error."""
+        try:
+            if chat:
+                oai.validate_chat_request(body)
+                prompt, hashes = await self._off(self._encode_chat,
+                                                 body["messages"])
+            else:
+                oai.request_model(body)
+                text_in = body.get("prompt", "")
+                if isinstance(text_in, list):
+                    text_in = "".join(text_in)
+                prompt, hashes = await self._off(self._encode_text,
+                                                 text_in)
+            lp_top_n = self._check_logprobs(body)
+            tenant = str(body.get("user", ""))
+            out, gen_req = self._submit(prompt, body, lp_top_n, hashes,
+                                        tenant=tenant, priority="batch")
+        except oai.SchemaError as e:
+            return 400, json.loads(oai.error_body(str(e)))
+        except ValueError as e:
+            return 400, json.loads(oai.error_body(str(e)))
+        self._batch_live[bid].append(gen_req)
+        stops = body.get("stop")
+        stop_strs = ([stops] if isinstance(stops, str)
+                     else list(stops or []))
+        try:
+            text, n_out, finish, lp_content = await self._collect(
+                out, stop_strs, lp_top_n)
+        finally:
+            self._batch_live[bid].remove(gen_req)
+        if finish == "error":
+            return 500, json.loads(oai.error_body(
+                "engine failure", type_="server_error"))
+        usage = TokenUsage(input_tokens=len(prompt),
+                           output_tokens=n_out,
+                           total_tokens=len(prompt) + n_out)
+        if chat:
+            resp = oai.chat_completion_response(
+                model=self.model_name, content=text,
+                finish_reason=finish, usage=usage,
+                response_id=f"chatcmpl-{uuid.uuid4().hex[:24]}")
+            if lp_content is not None:
+                resp["choices"][0]["logprobs"] = {"content": lp_content}
+        else:
+            resp = {
+                "id": f"cmpl-{uuid.uuid4().hex[:24]}",
+                "object": "text_completion",
+                "created": int(time.time()),
+                "model": self.model_name,
+                "choices": [{"index": 0, "text": text,
+                             "finish_reason": finish}],
+                "usage": oai.usage_dict(usage),
+            }
+            if lp_content is not None:
+                resp["choices"][0]["logprobs"] = \
+                    self._legacy_logprobs(lp_content)
+        return 200, resp
+
+    async def _run_batch(self, bid: str) -> None:
+        """The batch runner: drive every line at priority="batch" with
+        bounded concurrency (one engine's worth — backlog beyond that
+        sits in the replica, not as thousands of parked asyncio
+        queues), assemble the JSONL output file, finalize the batch
+        object."""
+        b = self._batches[bid]
+        lines = self._batch_lines.pop(bid)
+        chat = b["endpoint"] == "/v1/chat/completions"
+        sem = asyncio.Semaphore(max(2, self.engine.cfg.max_batch_size))
+        out_lines: list[bytes | None] = [None] * len(lines)
+
+        async def one(i: int, cid: str, body: dict[str, Any]) -> None:
+            async with sem:
+                if b["status"] != "in_progress":
+                    return  # cancelled before this line started
+                status, resp = await self._batch_one(bid, body, chat)
+                entry = {
+                    "id": f"batch_req_{uuid.uuid4().hex[:16]}",
+                    "custom_id": cid,
+                    "response": {"status_code": status, "body": resp},
+                    "error": None,
+                }
+                if status == 200:
+                    b["request_counts"]["completed"] += 1
+                else:
+                    b["request_counts"]["failed"] += 1
+                out_lines[i] = json.dumps(entry).encode()
+
+        try:
+            await asyncio.gather(*(one(i, cid, body)
+                                   for i, (cid, body) in enumerate(lines)))
+        except asyncio.CancelledError:
+            for req in self._batch_live.get(bid, ()):
+                req.cancelled.set()
+            raise
+        ofid = f"file-{uuid.uuid4().hex[:24]}"
+        self._files[ofid] = b"\n".join(
+            ln for ln in out_lines if ln is not None) + b"\n"
+        b["output_file_id"] = ofid
+        b["status"] = ("cancelled" if b["status"] == "cancelling"
+                       else "completed")
+        self._batch_tasks.pop(bid, None)
+
     # -- graceful drain (ISSUE 14) ----------------------------------------
     def _drain_refusal(self) -> web.Response:
         """503 + Retry-After for new work on a draining replica: the
@@ -1654,6 +1964,8 @@ class TPUServeServer:
             "draining": self.draining,
             "active_slots": s.active_slots,
             "queued": s.queued,
+            "batch_queued": s.batch_queued,
+            "batch_active": s.batch_active,
             "live_streams": len(self._live),
             "migratable_slots": s.migratable_slots,
         })
@@ -1669,11 +1981,16 @@ class TPUServeServer:
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             s = self.engine.stats
-            if s.active_slots == 0 and s.queued == 0:
+            # batch backlog (queued + parked) must clear too: a retired
+            # replica's in-memory batch state is gone — scale-in waits
+            # for the soak to finish before pulling the plug
+            if (s.active_slots == 0 and s.queued == 0
+                    and s.batch_queued == 0):
                 return True
             await asyncio.sleep(poll_s)
         s = self.engine.stats
-        return s.active_slots == 0 and s.queued == 0
+        return (s.active_slots == 0 and s.queued == 0
+                and s.batch_queued == 0)
 
     def install_signal_drain(self, stop_event: asyncio.Event,
                              grace_s: float = 30.0) -> None:
@@ -1884,6 +2201,18 @@ class TPUServeServer:
                 "active_slots": s.active_slots,
                 "max_slots": self.engine.cfg.max_batch_size,
                 "queued": s.queued,
+                # priority-tiered serving (ISSUE 19): the offline class's
+                # footprint. ``queued``/``queue_wait_ms`` above stay
+                # interactive-only by construction (batch rides its own
+                # engine queue) — the picker's predicted_ttft_ms never
+                # prices batch backlog; its batch routing and the
+                # controller's retire-drain read these instead
+                "batch_queued": s.batch_queued,
+                "batch_active": s.batch_active,
+                "batch_preemptions": s.batch_preemptions,
+                "batch_resumed": s.batch_resumed,
+                "batch_tokens": s.batch_tokens,
+                "batch_slot_frac": self.engine.cfg.batch_slot_frac,
                 "queue_wait_ms": round(s.queue_wait_ms, 3),
                 "kv_pages_free": s.kv_pages_free,
                 "kv_occupancy": s.kv_occupancy,

@@ -569,6 +569,8 @@ class TestFleetwatch:
                 "replicas": {"h:1": {
                     "health": {"state": "up", "draining": False},
                     "active_slots": 1, "max_slots": 2, "queued": 3,
+                    "batch_queued": 7, "batch_active": 1,
+                    "batch_preemptions": 4,
                     "kv_occupancy": 0.25,
                     "device_memory_frac_worst": 0.5,
                     "staleness_s": 0.1, "uptime_s": 61.0,
@@ -586,6 +588,10 @@ class TestFleetwatch:
         assert "1/2" in out and "25" in out
         assert "SUSTAINED SLO OVERSHOOT" in out
         assert "decisions recorded: 5" in out
+        # offline-tier columns (ISSUE 19) render per replica
+        assert "BQUEUE" in out and "BACT" in out and "BPRE" in out
+        row = next(ln for ln in out.splitlines() if ln.startswith("h:1"))
+        assert row.split()[4:7] == ["7", "1", "4"]
         # -1 sentinels render as '-', not as negative numbers
         out2 = fw.render_table({"backends": {"p": {
             "replicas": {"h:2": {

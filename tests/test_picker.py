@@ -750,3 +750,51 @@ class TestLongContext:
         assert got == "10.0.0.2:8011"
         # short prompts still tie (any candidate is fine)
         assert p.pick({PROMPT_TOKENS_HEADER: "100"}) is not None
+
+
+class TestBatchRouting:
+    """Offline-tier routing (ISSUE 19): x-aigw-priority: batch routes
+    to the replica with the MOST idle capacity — footprint (interactive
+    slots + queue + its own backlog) over slot count plus KV pressure —
+    and is never SLO-shed."""
+
+    def test_batch_routes_to_most_idle_by_batch_load(self):
+        from aigw_tpu.gateway.picker import PRIORITY_HEADER
+
+        p = make_picker()
+        # replica 1 LOOKS idle interactively but carries a deep batch
+        # backlog; replica 2 is mildly busy with zero backlog — batch
+        # load prices 1 at (0+0+40)/8+0.1=5.1 vs 2 at (2+1+0)/8+0.3
+        p.observe("10.0.0.1:8011", kv_occupancy=0.1, queued=0,
+                  active_slots=0, max_slots=8, batch_queued=40)
+        p.observe("10.0.0.2:8011", kv_occupancy=0.3, queued=1,
+                  active_slots=2, max_slots=8, batch_queued=0)
+        p.observe("10.0.0.3:8011", kv_occupancy=0.9, queued=6,
+                  active_slots=8, max_slots=8, batch_queued=0)
+        explain: dict = {}
+        got = p.pick({PRIORITY_HEADER: "batch"}, explain=explain)
+        assert got == "10.0.0.2:8011"
+        assert explain["mode"] == "batch"
+        assert explain["candidates"] == 3
+        # an interactive pick with the same fleet still goes by the
+        # static score — the backlog term is batch-only
+        assert p.pick() == "10.0.0.1:8011"
+
+    def test_batch_pick_skips_slo_shed(self):
+        from aigw_tpu.gateway.picker import (PRIORITY_HEADER,
+                                             SLOShedError)
+
+        p = make_slo_picker(slo_ms=200.0)
+        for a, q in (("10.0.0.1:8011", 5), ("10.0.0.2:8011", 3),
+                     ("10.0.0.3:8011", 9)):
+            p.observe(a, queued=q, queue_wait_ms=500.0, max_slots=8,
+                      phase_percentiles=_pp(150.0))
+        # every candidate blows the SLO: interactive sheds…
+        with pytest.raises(SLOShedError):
+            p.pick()
+        # …but the batch tier queues server-side instead — it routes
+        # to the least-footprint replica rather than bouncing a 429
+        explain: dict = {}
+        got = p.pick({PRIORITY_HEADER: "batch"}, explain=explain)
+        assert got == "10.0.0.2:8011"
+        assert explain["mode"] == "batch"

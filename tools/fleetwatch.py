@@ -24,8 +24,9 @@ import time
 import urllib.error
 import urllib.request
 
-_COLUMNS = ("REPLICA", "HEALTH", "SLOTS", "QUEUE", "KV%", "HBM%",
-            "BURN", "GOODPUT", "STALE(s)", "UPTIME(s)")
+_COLUMNS = ("REPLICA", "HEALTH", "SLOTS", "QUEUE", "BQUEUE", "BACT",
+            "BPRE", "KV%", "HBM%", "BURN", "GOODPUT", "STALE(s)",
+            "UPTIME(s)")
 
 
 def fetch(url: str, timeout: float = 5.0) -> dict:
@@ -50,7 +51,7 @@ def render_table(snapshot: dict) -> str:
     """One /fleet/state payload → the table string (pure function —
     the tier-1 smoke drives it against a live gateway's snapshot)."""
     lines: list[str] = []
-    widths = [22, 9, 7, 6, 5, 5, 6, 8, 9, 10]
+    widths = [22, 9, 7, 6, 6, 5, 5, 5, 5, 6, 8, 9, 10]
 
     def row(cells) -> str:
         return "  ".join(str(c).ljust(w)[:max(w, len(str(c)))]
@@ -68,6 +69,11 @@ def render_table(snapshot: dict) -> str:
                 addr, h,
                 f"{r.get('active_slots', 0)}/{r.get('max_slots', 0)}",
                 r.get("queued", 0),
+                # offline class footprint (ISSUE 19): queued+parked
+                # batch work, batch-held slots, preemption churn
+                r.get("batch_queued", 0),
+                r.get("batch_active", 0),
+                r.get("batch_preemptions", 0),
                 _fmt(r.get("kv_occupancy"), pct=True),
                 _fmt(r.get("device_memory_frac_worst"), pct=True),
                 _fmt(slo.get("burn_rate")),
