@@ -23,10 +23,11 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-from aigw_tpu.obs.metrics import ENGINE_GAUGES, FLEET_GAUGES
+from aigw_tpu.obs.metrics import ENGINE_GAUGES, FLEET_GAUGES, USAGE_GAUGES
 
 ENGINE_GAUGE_ATTRS: tuple[str, ...] = tuple(a for a, _ in ENGINE_GAUGES)
 FLEET_GAUGE_KEYS: tuple[str, ...] = tuple(k for k, _ in FLEET_GAUGES)
+USAGE_GAUGE_KEYS: tuple[str, ...] = tuple(k for k, _ in USAGE_GAUGES)
 
 #: EngineStats gauges that intentionally do NOT export on /state
 #: (they ride /metrics only) — attr → reason.
@@ -157,6 +158,9 @@ GROUPS: dict[str, Group] = {
                "ttft_hist_buckets", "draining")),
     "moe": Group(prefixes=("moe_",)),
     "batch": Group(prefixes=("batch_",)),
+    # engine-truth usage metering (ISSUE 20): the MeterRecord counter
+    # family the gateway's ledger reconciles against
+    "meter": Group(prefixes=("meter_",)),
 }
 
 #: /metrics substrings a group's smoke must also assert on but that are

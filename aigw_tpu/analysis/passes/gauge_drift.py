@@ -11,7 +11,9 @@ the generated manifest (``analysis.manifest``):
 - every ``ENGINE_GAUGES`` attr must appear on /state or carry a
   ``METRICS_ONLY`` exemption;
 - every ``FLEET_GAUGES`` key must appear among the literal keys of
-  ``FleetState.rollup``'s return dict.
+  ``FleetState.rollup``'s return dict;
+- every ``USAGE_GAUGES`` key must appear among the literal keys of
+  ``UsageLedger.snapshot``'s return dict (ISSUE 20 metering ledger).
 
 The manifest module validates its own exemption tables at import, so a
 stale exemption fails here too.
@@ -112,4 +114,23 @@ def check(sources: list[Source], config: AnalysisConfig) -> list[Finding]:
                         "FleetState.rollup()'s literal keys — the "
                         "/fleet/metrics federation scrape loses the "
                         "aggregate"))
+
+    usrc = by_rel.get(config.usage_module)
+    if usrc is not None:
+        fn = _function(usrc.tree, "snapshot")
+        if fn is None:
+            out.append(Finding(
+                RULE, usrc.rel, 1,
+                "UsageLedger.snapshot not found — update AnalysisConfig"))
+        else:
+            payload = _largest_dict(fn)
+            keys = _literal_keys(payload) if payload is not None else {}
+            for key in manifest.USAGE_GAUGE_KEYS:
+                if key not in keys:
+                    out.append(Finding(
+                        RULE, usrc.rel, fn.lineno,
+                        f"USAGE_GAUGES key {key!r} missing from "
+                        "UsageLedger.snapshot()'s literal keys — the "
+                        "gateway /metrics scrape loses the metering "
+                        "gauge"))
     return out

@@ -217,6 +217,8 @@ class AnalysisConfig:
     state_handler: str = "_state"
     #: module holding FleetState.rollup (FLEET_GAUGES twin)
     fleetstate_module: str = "aigw_tpu/gateway/fleetstate.py"
+    #: module holding UsageLedger.snapshot (USAGE_GAUGES twin)
+    usage_module: str = "aigw_tpu/gateway/usage.py"
 
 
 DEFAULT_CONFIG = AnalysisConfig()

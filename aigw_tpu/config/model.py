@@ -690,6 +690,13 @@ class Config:
     # QuotaPolicy equivalent); stored frozen for hashability.
     quotas: tuple[Any, ...] = ()
     mcp: dict[str, Any] | None = None  # parsed by aigw_tpu.mcp
+    # Engine-truth usage metering (ISSUE 20): the gateway ledger's
+    # knobs, stored frozen. None = metering ON with defaults (in-memory
+    # ledger, 60s windows, no budgets). Mapping keys: enabled (bool),
+    # window_s (float), retain_windows (int), journal (JSONL path, ""
+    # = in-memory), budgets ({tenant: cost-per-window}), burn_windows
+    # (K consecutive over-budget windows → sustained alert).
+    usage: Any = None
     version: str = CONFIG_VERSION
     uuid: str = ""
 
@@ -744,6 +751,8 @@ class Config:
             ),
             quotas=tuple(_freeze(q) for q in value.get("quotas", ())),
             mcp=value.get("mcp"),
+            usage=(_freeze(value["usage"])
+                   if value.get("usage") is not None else None),
             version=version,
             uuid=value.get("uuid", ""),
         )
@@ -766,6 +775,8 @@ class Config:
             d["quotas"] = [_thaw(q) for q in self.quotas]
         if self.mcp is not None:
             d["mcp"] = self.mcp
+        if self.usage is not None:
+            d["usage"] = _thaw(self.usage)
         return d
 
     def checksum(self) -> str:

@@ -33,6 +33,12 @@ from aigw_tpu.config.model import (
 #: Variables available inside cost expressions (reference cel.go:32-49,
 #: plus ``tenant`` — the multi-tenant accounting key the gateway derives
 #: from the x-aigw-tenant header or the model's adapter suffix).
+#:
+#: The second block is the engine-truth meter surface: variables sourced
+#: from the tpuserve ``MeterRecord`` a self-hosted response carries in
+#: ``usage.aigw_meter``. They default to 0 (or "" for ``priority``) when
+#: the backend is an external provider that meters nothing, so one cost
+#: expression can price both paths.
 COST_VARIABLES = (
     "model",
     "backend",
@@ -44,6 +50,15 @@ COST_VARIABLES = (
     "cached_input_tokens",
     "cache_creation_input_tokens",
     "reasoning_tokens",
+    # engine-truth meter variables (tpuserve MeterRecord)
+    "prefill_padded_tokens",
+    "prefix_reused_tokens",
+    "decode_tokens",
+    "spec_drafted_tokens",
+    "spec_accepted_tokens",
+    "kv_page_byte_seconds",
+    "host_page_byte_seconds",
+    "priority",
 )
 
 _ALLOWED_NODES = (
@@ -85,6 +100,17 @@ _ALLOWED_FUNCS = {"min": min, "max": max, "int": int, "float": float, "abs": abs
 _MAX_UINT64 = (1 << 64) - 1
 
 
+def meter_to_tuple(record: dict) -> tuple:
+    """Flatten an engine MeterRecord dict into a hashable, order-stable
+    tuple of ``(key, value)`` pairs for carriage inside ``TokenUsage``."""
+    return tuple(sorted((str(k), v) for k, v in record.items()))
+
+
+def meter_dict(usage: "TokenUsage") -> dict:
+    """Inverse of :func:`meter_to_tuple` for the usage's meter payload."""
+    return dict(usage.meter)
+
+
 @dataclass(frozen=True)
 class TokenUsage:
     """Cumulative token usage for one request.
@@ -92,6 +118,10 @@ class TokenUsage:
     The reference accumulates usage with *override* semantics — the last
     usage chunk on a stream wins (extproc/processor_impl.go:556-574,
     metrics.TokenUsage). ``merge_override`` implements exactly that.
+
+    ``meter`` carries the engine-truth MeterRecord (when the backend is
+    tpuserve) as a sorted tuple of ``(key, value)`` pairs so the dataclass
+    stays frozen/hashable; :func:`meter_dict` recovers the mapping.
     """
 
     input_tokens: int = 0
@@ -100,6 +130,7 @@ class TokenUsage:
     cached_input_tokens: int = 0
     cache_creation_input_tokens: int = 0
     reasoning_tokens: int = 0
+    meter: tuple = ()
 
     def merge_override(self, other: "TokenUsage") -> "TokenUsage":
         """Fields present (non-zero) in ``other`` override ours."""
@@ -114,6 +145,7 @@ class TokenUsage:
             cache_creation_input_tokens=other.cache_creation_input_tokens
             or self.cache_creation_input_tokens,
             reasoning_tokens=other.reasoning_tokens or self.reasoning_tokens,
+            meter=other.meter or self.meter,
         )
 
 
@@ -162,6 +194,7 @@ class CostProgram:
         route_name: str = "",
         tenant: str = "",
     ) -> int:
+        m = dict(usage.meter)
         env = {
             "__builtins__": {},
             "model": model,
@@ -174,6 +207,14 @@ class CostProgram:
             "cached_input_tokens": usage.cached_input_tokens,
             "cache_creation_input_tokens": usage.cache_creation_input_tokens,
             "reasoning_tokens": usage.reasoning_tokens,
+            "prefill_padded_tokens": m.get("prefill_padded", 0),
+            "prefix_reused_tokens": m.get("prefix_reused", 0),
+            "decode_tokens": m.get("decode_tokens", 0),
+            "spec_drafted_tokens": m.get("spec_drafted", 0),
+            "spec_accepted_tokens": m.get("spec_accepted", 0),
+            "kv_page_byte_seconds": m.get("hbm_page_byte_s", 0.0),
+            "host_page_byte_seconds": m.get("host_page_byte_s", 0.0),
+            "priority": m.get("priority", ""),
             **_ALLOWED_FUNCS,
         }
         out = eval(self._code, env)  # noqa: S307 — AST whitelisted above

@@ -74,11 +74,13 @@ from aigw_tpu.gateway.router import (
     match_route,
     split_model,
 )
+from aigw_tpu.gateway.usage import UsageLedger
 from aigw_tpu.obs.metrics import (
     GenAIMetrics,
     RequestMetrics,
     render_controller_gauges,
     render_fleet_gauges,
+    render_usage_gauges,
 )
 from aigw_tpu.obs.tracing import (
     DEFAULT_HEADER_ATTRIBUTES,
@@ -307,6 +309,10 @@ class GatewayServer:
         self.app.router.add_get("/v1/models", self._handle_models)
         self.app.router.add_get("/health", self._handle_health)
         self.app.router.add_get("/metrics", self._handle_metrics)
+        # engine-truth usage metering (ISSUE 20): the per-tenant token
+        # & KV-residency cost ledger + its query/export API
+        self.app.router.add_get("/usage", self._handle_usage)
+        self.usage_ledger = self._build_usage_ledger(runtime)
         # fleet observability plane (ISSUE 12): one pane of glass over
         # every picker-polled replica pool — aggregated health/SLO
         # state, Prometheus federation, and the routing-decision audit
@@ -368,10 +374,42 @@ class GatewayServer:
     def runtime(self) -> RuntimeConfig:
         return self._runtime
 
+    @staticmethod
+    def _build_usage_ledger(runtime: RuntimeConfig) -> UsageLedger | None:
+        """The metering ledger from the config's ``usage`` block.
+        Metering is ON by default (no block = in-memory ledger with
+        defaults); ``usage: {enabled: false}`` is the A/B off leg."""
+        from aigw_tpu.config.model import _thaw
+
+        raw = _thaw(runtime.config.usage) or {}
+        if not isinstance(raw, dict):
+            raw = {}
+        if not raw.get("enabled", True):
+            return None
+        journal = str(raw.get("journal", "") or "")
+        budgets = raw.get("budgets") or {}
+        kwargs = dict(
+            window_s=float(raw.get("window_s", 60.0)),
+            retain_windows=int(raw.get("retain_windows", 64)),
+            budgets={str(k): float(v) for k, v in budgets.items()},
+            burn_windows=int(raw.get("burn_windows", 3)),
+        )
+        if journal:
+            # crash-safe resume: replay what survived, keep appending
+            return UsageLedger.replay(journal, **kwargs)
+        return UsageLedger(**kwargs)
+
     def set_runtime(self, rc: RuntimeConfig) -> None:
         """Hot-swap config (called by ConfigWatcher). Pickers whose
         endpoint pools are unchanged are reused so telemetry and session
         affinity survive reloads."""
+        if rc.config.usage != self._runtime.config.usage:
+            # metering knobs changed: rebuild (a journal-backed ledger
+            # replays itself, so totals survive the swap)
+            old_ledger = self.usage_ledger
+            self.usage_ledger = self._build_usage_ledger(rc)
+            if old_ledger is not None:
+                old_ledger.close()
         self._runtime = rc
         from aigw_tpu.mcp import MCPConfig
 
@@ -494,6 +532,8 @@ class GatewayServer:
             await picker.stop()
         if self._session is not None and not self._session.closed:
             await self._session.close()
+        if self.usage_ledger is not None:
+            self.usage_ledger.close()
 
     # -- admin endpoints --------------------------------------------------
     async def _handle_health(self, _request: web.Request) -> web.Response:
@@ -513,8 +553,35 @@ class GatewayServer:
         return web.json_response(payload)
 
     async def _handle_metrics(self, _request: web.Request) -> web.Response:
-        return web.Response(body=self.metrics.export(),
-                            content_type="text/plain")
+        body = self.metrics.export()
+        if self.usage_ledger is not None:
+            body += render_usage_gauges(self.usage_ledger.snapshot())
+        return web.Response(body=body, content_type="text/plain")
+
+    async def _handle_usage(self, request: web.Request) -> web.Response:
+        """``GET /usage`` (ISSUE 20): the metering ledger's windowed
+        per-tenant/per-model view. Query params: ``since`` (unix ts),
+        ``tenant``, ``model`` filter the windows; ``export=jsonl``
+        streams the filtered windows as JSON lines instead (the bulk
+        export a billing pipeline ingests)."""
+        if self.usage_ledger is None:
+            return web.json_response(
+                {"error": "usage metering disabled"}, status=404)
+        try:
+            since = float(request.query.get("since", "0") or 0.0)
+        except ValueError:
+            since = 0.0
+        payload = self.usage_ledger.query(
+            since=since,
+            tenant=request.query.get("tenant", ""),
+            model=request.query.get("model", ""),
+        )
+        if request.query.get("export", "") == "jsonl":
+            body = "".join(json.dumps(w, sort_keys=True) + "\n"
+                           for w in payload["windows"])
+            return web.Response(body=body.encode(),
+                                content_type="application/jsonl")
+        return web.json_response(payload)
 
     # -- offline batch tier (ISSUE 19) ------------------------------------
     #: bound on the (file/batch id → replica) routing map
@@ -1939,18 +2006,36 @@ class GatewayServer:
         Quota consumption is keyed by the *request* model — the same value
         _check_quota matched against — so model-scoped budgets enforce
         consistently even when the backend reports a versioned response
-        model or a model_name_override rewrote the upstream name."""
+        model or a model_name_override rewrote the upstream name.
+
+        ISSUE 20: the usage ledger records here too — EVERY finished
+        request, with or without configured cost programs — folding the
+        engine MeterRecord (usage.aigw_meter) into the per-tenant
+        windowed ledger, reconciling it against the mined token counts,
+        and stamping the priced cost onto the request's decision-ring
+        entry so /debug/decisions shows what each pick cost."""
         limiter = self._runtime.rate_limiter
         has_quota = limiter is not None and limiter.rules
+        ledger = self.usage_ledger
         if (self._cost_sink is None and not has_quota
-                and not self.access_log.enabled):
+                and not self.access_log.enabled and ledger is None):
             return
         model = req_metrics.request_model
         backend = req_metrics.provider
+        tenant = client_headers.get(TENANT_HEADER, "")
         costs = self._runtime.cost_calculator_for(route_name).calculate(
             usage, model=model, backend=backend, route_name=route_name,
-            tenant=client_headers.get(TENANT_HEADER, ""),
+            tenant=tenant,
         )
+        if ledger is not None:
+            # ledger cost = the summed configured cost metrics (0 when
+            # no cost programs are configured — the token/residency
+            # columns still accumulate engine truth)
+            total_cost = sum(costs.values())
+            ledger.record(tenant, model, usage, cost=total_cost)
+            req_metrics.decision["cost"] = total_cost
+            if costs:
+                req_metrics.decision["costs"] = dict(costs)
         if not costs:
             return
         req_metrics.costs = dict(costs)

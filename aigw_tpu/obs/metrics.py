@@ -269,6 +269,26 @@ ENGINE_GAUGES: tuple[tuple[str, str], ...] = (
     ("batch_preemptions", "tpuserve_batch_preemptions_total"),
     ("batch_resumed", "tpuserve_batch_resumed_total"),
     ("batch_tokens", "tpuserve_batch_tokens_total"),
+    # engine-truth usage metering (ISSUE 20): cumulative MeterRecord
+    # totals. Every terminal stream (stop/length/cancelled/error — and
+    # a migrated continuation exactly once for the spliced whole) emits
+    # one record; these counters only move inside the engine's
+    # _meter_emit funnel, so the gateway ledger's per-tenant sums
+    # reconcile against them token-for-token. The page·byte·second
+    # pair is the TPU-native residency dimension: KV bytes × seconds
+    # occupied in HBM and in the host spill/park tier.
+    ("meter_records", "tpuserve_meter_records_total"),
+    ("meter_prefill_tokens", "tpuserve_meter_prefill_tokens_total"),
+    ("meter_prefill_padded_tokens",
+     "tpuserve_meter_prefill_padded_tokens_total"),
+    ("meter_prefix_reused_tokens",
+     "tpuserve_meter_prefix_reused_tokens_total"),
+    ("meter_decode_tokens", "tpuserve_meter_decode_tokens_total"),
+    ("meter_spec_drafted", "tpuserve_meter_spec_drafted_total"),
+    ("meter_spec_accepted", "tpuserve_meter_spec_accepted_total"),
+    ("meter_hbm_page_byte_s", "tpuserve_meter_hbm_page_byte_s_total"),
+    ("meter_host_page_byte_s",
+     "tpuserve_meter_host_page_byte_s_total"),
 )
 
 #: per-device gauge surface (ISSUE 10): key in one entry of
@@ -362,6 +382,44 @@ def render_fleet_gauges(rollup: dict, backend: str = "") -> bytes:
     for key, name in FLEET_GAUGES:
         lines.append(f"# TYPE {name} gauge")
         lines.append(f"{name}{sel} {rollup.get(key, 0)}")
+    return ("\n".join(lines) + "\n").encode()
+
+
+#: usage-metering ledger surface (ISSUE 20): key in
+#: ``UsageLedger.snapshot()`` → gauge name on the gateway's
+#: ``GET /metrics``. Same drift-check contract as FLEET_GAUGES —
+#: every key here must appear as a literal in the ledger's snapshot()
+#: dict (gateway/usage.py) and every gauge must render on the scrape.
+USAGE_GAUGES: tuple[tuple[str, str], ...] = (
+    ("records_total", "aigw_usage_records_total"),
+    ("prefill_tokens_total", "aigw_usage_prefill_tokens_total"),
+    ("prefill_padded_tokens_total",
+     "aigw_usage_prefill_padded_tokens_total"),
+    ("prefix_reused_tokens_total",
+     "aigw_usage_prefix_reused_tokens_total"),
+    ("decode_tokens_total", "aigw_usage_decode_tokens_total"),
+    ("spec_drafted_total", "aigw_usage_spec_drafted_total"),
+    ("spec_accepted_total", "aigw_usage_spec_accepted_total"),
+    ("hbm_page_byte_s_total", "aigw_usage_hbm_page_byte_s_total"),
+    ("host_page_byte_s_total", "aigw_usage_host_page_byte_s_total"),
+    ("cost_total", "aigw_usage_cost_total"),
+    ("tenants", "aigw_usage_tenants"),
+    ("windows_closed_total", "aigw_usage_windows_closed_total"),
+    ("journal_lines_total", "aigw_usage_journal_lines_total"),
+    ("reconcile_mismatches_total",
+     "aigw_usage_reconcile_mismatches_total"),
+    ("over_budget_tenants", "aigw_usage_over_budget_tenants"),
+    ("burn_sustained_tenants", "aigw_usage_burn_sustained_tenants"),
+)
+
+
+def render_usage_gauges(snapshot: dict) -> bytes:
+    """UsageLedger snapshot dict → aigw_usage_* Prometheus gauges
+    (appended to the gateway's /metrics scrape)."""
+    lines = []
+    for key, name in USAGE_GAUGES:
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {snapshot.get(key, 0)}")
     return ("\n".join(lines) + "\n").encode()
 
 

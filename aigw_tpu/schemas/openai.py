@@ -12,7 +12,7 @@ import time
 import uuid
 from typing import Any, Iterable
 
-from aigw_tpu.gateway.costs import TokenUsage
+from aigw_tpu.gateway.costs import TokenUsage, meter_to_tuple
 
 
 class SchemaError(ValueError):
@@ -342,18 +342,25 @@ def validate_chat_request(body: dict[str, Any]) -> None:
 
 
 def extract_usage(body: dict[str, Any]) -> TokenUsage:
-    """OpenAI usage object → TokenUsage (incl. details fields)."""
+    """OpenAI usage object → TokenUsage (incl. details fields).
+
+    ``usage.aigw_meter`` is the engine-truth MeterRecord a tpuserve
+    backend attaches to its stream tail; external providers never send
+    it and the key passes typed validation as an unknown field.
+    """
     u = body.get("usage")
     if not isinstance(u, dict):
         return TokenUsage()
     prompt_details = u.get("prompt_tokens_details") or {}
     completion_details = u.get("completion_tokens_details") or {}
+    meter = u.get("aigw_meter")
     return TokenUsage(
         input_tokens=int(u.get("prompt_tokens", 0) or 0),
         output_tokens=int(u.get("completion_tokens", 0) or 0),
         total_tokens=int(u.get("total_tokens", 0) or 0),
         cached_input_tokens=int(prompt_details.get("cached_tokens", 0) or 0),
         reasoning_tokens=int(completion_details.get("reasoning_tokens", 0) or 0),
+        meter=meter_to_tuple(meter) if isinstance(meter, dict) else (),
     )
 
 
@@ -370,6 +377,8 @@ def usage_dict(usage: TokenUsage) -> dict[str, Any]:
         d["completion_tokens_details"] = {
             "reasoning_tokens": usage.reasoning_tokens
         }
+    if usage.meter:
+        d["aigw_meter"] = dict(usage.meter)
     return d
 
 

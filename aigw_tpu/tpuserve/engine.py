@@ -474,6 +474,14 @@ class GenRequest:
     # into the flight recorder + the request's span tree. Duck-typed and
     # optional — None costs one attribute check per call site.
     trace: Any = None
+    # Usage metering sink (ISSUE 20): called EXACTLY ONCE per request
+    # lifetime with the engine-truth MeterRecord dict, on the engine
+    # thread, strictly before the terminal emit — so a consumer that
+    # dequeues the finish item observes the record. Migrated/parked
+    # continuations do NOT fire it at the cut; the accumulated meter
+    # rides the export blob and the resumed slot's record covers the
+    # whole spliced stream. None = metering off for this request.
+    meter_sink: "Callable[[dict], None] | None" = None
 
 
 @dataclass
@@ -513,6 +521,22 @@ class _Slot:
     # window's tokens (they were sampled past a grammar violation)
     cn: Any = None  # constrain.ConstraintState | None
     cn_epoch: int = 0
+    # usage metering accumulators (ISSUE 20) — engine-truth per-request
+    # counts folded into the MeterRecord at the terminal emit. Residency
+    # is integrated piecewise: m_res_bytes is the slot's current KV
+    # page·bytes and m_res_t0 the wall clock it last changed, so
+    # HBM page·byte·seconds accrue as sum(bytes × dwell) across segments.
+    m_prefill_real: int = 0
+    m_prefill_padded: int = 0
+    m_prefix_reused: int = 0
+    m_spec_drafted: int = 0
+    m_spec_accepted: int = 0
+    m_res_t0: float = 0.0
+    m_res_bytes: int = 0
+    m_hbm_pbs: float = 0.0
+    # carry imported from a migration/park export blob: the meter
+    # accumulated by earlier segments of this spliced stream
+    m_carry: dict | None = None
 
 
 @dataclass
@@ -727,6 +751,23 @@ class EngineStats:
     # over the most recent ~PREFILL_RATE_HALF_LIFE_TOKENS tokens.
     prefill_ms_decayed: float = 0.0
     prefill_tokens_decayed: float = 0.0
+    # usage metering (ISSUE 20): engine-truth accounting counters,
+    # incremented ONLY inside _meter_emit — i.e. exactly when a
+    # MeterRecord is handed to the request's sink — so the gateway's
+    # ledger totals reconcile against these token-for-token by
+    # construction. meter_records counts records emitted;
+    # meter_*_tokens mirror the per-record token dimensions; the
+    # page_byte_s pair integrates KV residency (HBM + host-parked)
+    # in page·byte·seconds, the TPU-native cost dimension.
+    meter_records: int = 0
+    meter_prefill_tokens: int = 0
+    meter_prefill_padded_tokens: int = 0
+    meter_prefix_reused_tokens: int = 0
+    meter_decode_tokens: int = 0
+    meter_spec_drafted: int = 0
+    meter_spec_accepted: int = 0
+    meter_hbm_page_byte_s: float = 0.0
+    meter_host_page_byte_s: float = 0.0
 
     PREFILL_RATE_HALF_LIFE_TOKENS = 16384
 
@@ -2390,6 +2431,145 @@ class Engine:
                     len(out["blob"]["tokens"]), len(out["data"]))
         return out
 
+    # -- usage metering (ISSUE 20) ---------------------------------------
+    #
+    # One MeterRecord per request LIFETIME, emitted on the engine thread
+    # strictly before the terminal emit (FIFO + the consumer's queue make
+    # it visible when the finish item is dequeued). Migration/park cuts
+    # never emit — the accumulated meter rides the export blob and the
+    # resumed slot's terminal record covers the whole spliced stream.
+    # EngineStats.meter_* counters are incremented ONLY in _meter_emit,
+    # so a ledger built from the records reconciles against /state
+    # token-for-token by construction.
+
+    _METER_SUM_KEYS = ("prefill_real", "prefill_padded", "prefix_reused",
+                       "decode_tokens", "spec_drafted", "spec_accepted",
+                       "segments")
+
+    @engine_thread_only
+    def _meter_fold(self, s: "_Slot") -> dict:
+        """Fold slot accumulators + any imported carry into one meter
+        dict (no finish/schema — the terminal record adds those; the
+        same dict rides an export blob as the continuation carry).
+        HBM residency integrates the current dwell segment at the
+        slot's PRESENT page footprint: pages × kv_page_bytes × dwell_s."""
+        req = s.req
+        now = time.monotonic()
+        bytes_now = s.m_res_bytes
+        try:
+            bytes_now = (len(self.allocator.pages(req.id))
+                         * self.kv_page_bytes)
+        except Exception:
+            pass
+        hbm = s.m_hbm_pbs
+        if s.m_res_t0 > 0.0:
+            hbm += (now - s.m_res_t0) * bytes_now
+        rec = {
+            "prefill_real": s.m_prefill_real,
+            "prefill_padded": s.m_prefill_padded,
+            "prefix_reused": s.m_prefix_reused,
+            "decode_tokens": s.generated,
+            "spec_drafted": s.m_spec_drafted,
+            "spec_accepted": s.m_spec_accepted,
+            "hbm_page_byte_s": round(hbm, 6),
+            "host_page_byte_s": 0.0,
+            "segments": 1,
+            "tenant": req.tenant,
+            "priority": req.priority,
+        }
+        c = s.m_carry
+        if c:
+            for key in self._METER_SUM_KEYS:
+                rec[key] += int(c.get(key, 0))
+            rec["hbm_page_byte_s"] = round(
+                rec["hbm_page_byte_s"]
+                + float(c.get("hbm_page_byte_s", 0.0)), 6)
+            rec["host_page_byte_s"] = round(
+                float(c.get("host_page_byte_s", 0.0)), 6)
+        return rec
+
+    @engine_thread_only
+    def _meter_emit(self, rec: dict, sink) -> None:
+        """THE single point where meter counters move and a record
+        reaches its sink — every emission path funnels here."""
+        st = self.stats
+        st.meter_records += 1
+        st.meter_prefill_tokens += rec["prefill_real"]
+        st.meter_prefill_padded_tokens += rec["prefill_padded"]
+        st.meter_prefix_reused_tokens += rec["prefix_reused"]
+        st.meter_decode_tokens += rec["decode_tokens"]
+        st.meter_spec_drafted += rec["spec_drafted"]
+        st.meter_spec_accepted += rec["spec_accepted"]
+        st.meter_hbm_page_byte_s = round(
+            st.meter_hbm_page_byte_s + rec["hbm_page_byte_s"], 6)
+        st.meter_host_page_byte_s = round(
+            st.meter_host_page_byte_s + rec["host_page_byte_s"], 6)
+        if sink is not None:
+            try:
+                sink(rec)
+            except Exception:
+                logger.exception("meter sink failed")
+
+    @engine_thread_only
+    def _meter_finish(self, s: "_Slot", finish: str) -> None:
+        """Terminal record for a live slot (EOS/length/cancel/error)."""
+        rec = self._meter_fold(s)
+        rec["schema"] = 1
+        rec["finish"] = finish
+        self._meter_emit(rec, s.req.meter_sink)
+
+    @engine_thread_only
+    def _meter_zero(self, req: GenRequest, finish: str) -> None:
+        """Terminal record for a request that never held a slot
+        (cancelled/errored in a queue, unknown adapter). Usually all
+        zeros; a queued CONTINUATION still carries its segments' meter."""
+        c = (req.import_state or {}).get("meter_carry") or {}
+        rec = {
+            "schema": 1,
+            "finish": finish,
+            "prefill_real": int(c.get("prefill_real", 0)),
+            "prefill_padded": int(c.get("prefill_padded", 0)),
+            "prefix_reused": int(c.get("prefix_reused", 0)),
+            "decode_tokens": int(c.get("decode_tokens", 0)),
+            "spec_drafted": int(c.get("spec_drafted", 0)),
+            "spec_accepted": int(c.get("spec_accepted", 0)),
+            "hbm_page_byte_s": round(float(c.get("hbm_page_byte_s", 0.0)), 6),
+            "host_page_byte_s": round(
+                float(c.get("host_page_byte_s", 0.0)), 6),
+            "segments": int(c.get("segments", 0)),
+            "tenant": req.tenant,
+            "priority": req.priority,
+        }
+        self._meter_emit(rec, req.meter_sink)
+
+    @engine_thread_only
+    def _meter_parked(self, park: dict, finish: str) -> None:
+        """Terminal record for a host-parked session that will never
+        resume (cancelled while parked / engine abort): the exported
+        carry plus the host-spill residency accrued while parked."""
+        blob = park["blob"]
+        c = dict(blob.get("meter") or {})
+        now = time.monotonic()
+        host = (float(c.get("host_page_byte_s", 0.0))
+                + (now - park.get("parked_at", now))
+                * park.get("park_bytes", 0))
+        rec = {
+            "schema": 1,
+            "finish": finish,
+            "prefill_real": int(c.get("prefill_real", 0)),
+            "prefill_padded": int(c.get("prefill_padded", 0)),
+            "prefix_reused": int(c.get("prefix_reused", 0)),
+            "decode_tokens": int(c.get("decode_tokens", 0)),
+            "spec_drafted": int(c.get("spec_drafted", 0)),
+            "spec_accepted": int(c.get("spec_accepted", 0)),
+            "hbm_page_byte_s": round(float(c.get("hbm_page_byte_s", 0.0)), 6),
+            "host_page_byte_s": round(host, 6),
+            "segments": int(c.get("segments", 0)),
+            "tenant": str(blob.get("tenant", "")),
+            "priority": str(blob.get("priority", "batch")),
+        }
+        self._meter_emit(rec, park.get("meter_sink"))
+
     @engine_thread_only
     def _export_cut(self, idx: int) -> dict:
         """Serialize slot ``idx``'s session at the (already settled)
@@ -2443,6 +2623,11 @@ class Engine:
                 "presence_penalty": sp.presence_penalty,
                 "logit_bias": [[t, b] for t, b in sp.logit_bias],
             },
+            # usage metering (ISSUE 20): the cut emits NO MeterRecord —
+            # this carry (slot accumulators + upstream segments, HBM
+            # residency integrated to the cut) rides to the resume so
+            # the spliced stream meters exactly once at its real end
+            "meter": self._meter_fold(s),
         }
         self._pending_frees.append(req.id)
         self._release_adapter_row(s.adapter_row)
@@ -2482,6 +2667,12 @@ class Engine:
         entry = self._export_cut(idx)
         entry["emit"] = req.emit
         entry["cancelled"] = req.cancelled
+        # metering: the parked dwell accrues HOST page·byte·seconds
+        # (pages live in host RAM, not HBM) — folded into the carry at
+        # resume, or into the terminal record if it never resumes
+        entry["meter_sink"] = req.meter_sink
+        entry["parked_at"] = time.monotonic()
+        entry["park_bytes"] = len(entry["data"]) * self.kv_page_bytes
         self._parked_batch.append(entry)
         self.stats.batch_preemptions += 1
         logger.info("parked batch seq %d (%d pages) for interactive "
@@ -2617,6 +2808,7 @@ class Engine:
         self._spec_dirty.clear()
         for i, s in enumerate(self._slots):
             if s is not None:
+                self._meter_finish(s, "error")
                 s.req.emit(-1, "error")
                 self.allocator.free(s.req.id)
                 self._release_adapter_row(s.adapter_row)
@@ -2624,6 +2816,7 @@ class Engine:
         try:
             while True:
                 req = self._queue.get_nowait()
+                self._meter_zero(req, "error")
                 req.emit(-1, "error")
         except queue.Empty:
             pass
@@ -2632,10 +2825,12 @@ class Engine:
         try:
             while True:
                 req = self._batch_q.get_nowait()
+                self._meter_zero(req, "error")
                 req.emit(-1, "error")
         except queue.Empty:
             pass
         for park in self._parked_batch:
+            self._meter_parked(park, "error")
             park["emit"](-1, "error")
         self._parked_batch.clear()
         # waiting migration callers must not hang until their timeout
@@ -2657,6 +2852,7 @@ class Engine:
                 # batch runner's _collect, a non-streaming handler):
                 # reaping the slot without a terminal event would hang
                 # it forever — a /v1/batches cancel must finalize
+                self._meter_finish(s, "cancelled")
                 s.req.emit(-1, "cancelled")
                 self._pending_frees.append(s.req.id)
                 self._release_adapter_row(s.adapter_row)
@@ -2778,6 +2974,8 @@ class Engine:
             seen_chain_heads: set = set()
             for req in pending:
                 if req.cancelled.is_set():
+                    # consumed without a slot — still meters (zeros)
+                    self._meter_zero(req, "cancelled")
                     continue
                 ok, chain = self._classify(req)
                 if ok and chain:
@@ -2872,6 +3070,7 @@ class Engine:
             self.stats.tenant_deferrals += capped
             for req in admit:
                 if req.cancelled.is_set():
+                    self._meter_zero(req, "cancelled")
                     handled.add(id(req))
                     continue
                 _ok, chain = self._classify(req)
@@ -2922,6 +3121,7 @@ class Engine:
                 if park["cancelled"].is_set():
                     # dropping a parked session is a cancel FINISH, not
                     # a silent vanish — its _collect is still waiting
+                    self._meter_parked(park, "cancelled")
                     park["emit"](-1, "cancelled")
                     self._parked_batch.pop(0)
                     continue
@@ -2931,9 +3131,22 @@ class Engine:
                         park["data"], 0, "parked")
                 except (MigrationError, OutOfPagesError):
                     break  # pool pressure: retry at a later pass
+                # close the parked dwell: host-spill residency accrued
+                # while off-device joins the carry the resume inherits
+                carry = park["blob"].get("meter")
+                if carry is not None:
+                    now = time.monotonic()
+                    carry["host_page_byte_s"] = round(
+                        float(carry.get("host_page_byte_s", 0.0))
+                        + (now - park.get("parked_at", now))
+                        * park.get("park_bytes", 0), 6)
+                    # a failed admission re-parks this entry: re-anchor
+                    # so the next fold never double-charges this dwell
+                    park["parked_at"] = now
                 req = continuation_request(park["blob"],
                                            emit=park["emit"])
                 req.cancelled = park["cancelled"]
+                req.meter_sink = park.get("meter_sink")
                 self._parked_batch.pop(0)
                 _ok, chain = self._classify(req)
                 r = self._admit_one(req, chain)
@@ -2964,6 +3177,7 @@ class Engine:
                 if req.cancelled.is_set():
                     # popped from _batch_q with a consumer still
                     # draining its queue — finalize, don't drop
+                    self._meter_zero(req, "cancelled")
                     req.emit(-1, "cancelled")
                     continue
                 _ok, chain = self._classify(req)
@@ -3108,6 +3322,15 @@ class Engine:
                     limit=r.total, page_row=r.page_row,
                     adapter_row=r.adapter_row,
                     ctrl=self._make_ctrl(r.req),
+                    # metering: the batched path exposes no per-request
+                    # padding geometry — charge the real prompt volume
+                    # (padding shows up in the aggregate prefill_tokens_*
+                    # pair, not the per-request record) and start the
+                    # HBM residency clock at the admitted footprint
+                    m_prefill_real=r.n, m_prefill_padded=r.n,
+                    m_res_t0=time.monotonic(),
+                    m_res_bytes=(len(self.allocator.pages(r.seq_id))
+                                 * self.kv_page_bytes),
                 )
                 self.stats.prefills += 1
                 self._mark_admitted(slot_idx)
@@ -3286,6 +3509,7 @@ class Engine:
                 # the slot frees
                 adapter_row = self._acquire_adapter(req.adapter)
             except UnknownAdapterError:
+                self._meter_zero(req, "error")
                 req.emit(-1, "error")
                 self.allocator.free(seq_id)
                 return "skipped"
@@ -3457,6 +3681,12 @@ class Engine:
         counts: dict[int, int] = {}
         for t in req.prompt[int(ims.get("orig_prompt_len", n)):]:
             counts[t] = counts.get(t, 0) + 1
+        # usage metering (ISSUE 20): prefill attribution + the HBM
+        # residency clock. Padded volume is geometry-derived from the
+        # backend's padded_frac (= 1 - real/processed), so all three
+        # prefill paths report through one formula.
+        pf = float(info.get("padded_frac") or 0.0)
+        m_padded = int(round(ns / (1.0 - pf))) if 0.0 < pf < 1.0 else ns
         # pos=n-1: _emit_token advances it to n, the write position of
         # the just-sampled first token.
         self._slots[slot_idx] = _Slot(
@@ -3466,6 +3696,11 @@ class Engine:
             token_counts=counts,
             ctrl=ctrl, la_base=la_base, la_tokens=la_tokens,
             cn=cn,
+            m_prefill_real=ns, m_prefill_padded=m_padded,
+            m_prefix_reused=prefix_len,
+            m_res_t0=time.monotonic(),
+            m_res_bytes=len(pages) * self.kv_page_bytes,
+            m_carry=ims.get("meter_carry"),
         )
         self._mark_admitted(slot_idx)
         if cn is not None:
@@ -3931,6 +4166,10 @@ class Engine:
                 if n > 0:
                     proposed[i] = proposed.get(i, 0) + int(props[k, i])
                     live[i] = True
+                    # meter attribution BEFORE the emit loop: a slot
+                    # that finishes mid-step carries this step's drafts
+                    # in its terminal record
+                    s.m_spec_drafted += int(props[k, i])
                 emitted = 0
                 for d in range(n):
                     cur = self._slots[i]
@@ -3939,6 +4178,11 @@ class Engine:
                     if cur.cn is not None and not self._cn_verify(
                             i, cur, int(toks[k, i, d]), ce[i][1]):
                         break  # mask boundary: rolled back here
+                    if emitted > 0:
+                        # every token past the first is a landed draft;
+                        # credited before its emit so a finish on the
+                        # accepted token itself still meters it
+                        cur.m_spec_accepted += 1
                     self._emit_token(i, int(toks[k, i, d]))
                     emitted += 1
                 if emitted > 1:
@@ -4208,14 +4452,20 @@ class Engine:
             if req.trace is not None:
                 req.trace.first_token()
         finish: str | None = None
+        send_tok = tok
         if tok in self.eos or tok in req.stop_token_ids:
             finish = "stop"
-            _send(-1, finish)
+            send_tok = -1
         else:
             s.pos += 1  # where `tok` will be written by the next decode
             if s.generated >= req.max_tokens or s.pos >= self.cfg.max_seq_len:
                 finish = "length"
-            _send(tok, finish)
+        if finish is not None:
+            # MeterRecord BEFORE the terminal emit: the consumer that
+            # dequeues the finish item observes the record (engine
+            # thread posts both; call_soon_threadsafe keeps FIFO order)
+            self._meter_finish(s, finish)
+        _send(send_tok, finish)
         self.stats.tokens_generated += 1
         if req.priority == "batch":
             self.stats.batch_tokens += 1
@@ -4429,6 +4679,10 @@ def continuation_request(blob: dict,
             # the pending input token at the cut sat at position m-1 —
             # the resume's first sample must use its key
             "key_counter": len(tokens) - 1,
+            # usage metering (ISSUE 20): the meter accumulated by the
+            # exporting segment(s) — the resumed slot folds it into its
+            # single terminal MeterRecord so a spliced stream meters once
+            "meter_carry": blob.get("meter"),
         },
         trace=trace,
     )

@@ -34,7 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from aiohttp import web
 
-from aigw_tpu.gateway.costs import TokenUsage
+from aigw_tpu.gateway.costs import TokenUsage, meter_to_tuple
 from aigw_tpu.models import llama
 from aigw_tpu.models.registry import family_fns, get_model_spec
 from aigw_tpu.obs.flight import FlightRecorder, RequestTrace
@@ -604,13 +604,20 @@ class TPUServeServer:
                 lp_top_n: int = -1, prefix_hashes: list | None = None,
                 trace: RequestTrace | None = None, tenant: str = "",
                 constraint: Any = None, priority: str = "interactive"):
-        """Submit to the engine; returns an asyncio.Queue of
-        (token_id, finish_reason, lp) tuples — lp is None without
-        logprobs, else (chosen_logprob, [(top_id, top_logprob)]).
+        """Submit to the engine; returns (queue, req, meter_box) — the
+        queue yields (token_id, finish_reason, lp) tuples, lp is None
+        without logprobs, else (chosen_logprob, [(top_id, top_logprob)]).
         ``lp_top_n`` is the already-validated _check_logprobs value
-        (validated once per request; >= 0 attaches logprobs)."""
+        (validated once per request; >= 0 attaches logprobs).
+
+        ``meter_box`` is a plain dict the engine fills with the
+        request's MeterRecord strictly BEFORE posting the terminal emit
+        (same engine thread, same loop.call_soon_threadsafe FIFO), so a
+        consumer that dequeued the finish item reads a complete box —
+        the engine-truth usage the response's ``aigw_meter`` carries."""
         loop = asyncio.get_running_loop()
         out: asyncio.Queue = asyncio.Queue()
+        meter_box: dict[str, Any] = {}
 
         def emit(tok: int, finish: str | None) -> None:
             loop.call_soon_threadsafe(out.put_nowait, (tok, finish, None))
@@ -639,9 +646,48 @@ class TPUServeServer:
             prefix_hashes=prefix_hashes,
             constraint=constraint,
             trace=trace,
+            meter_sink=meter_box.update,
         )
         self.engine.submit(req)
-        return out, req
+        return out, req, meter_box
+
+    def _usage_from_meter(self, n_prompt: int, n_out: int,
+                          box: dict[str, Any] | None) -> TokenUsage:
+        """Response usage from the stream-observed counts plus the
+        engine's MeterRecord: cached_tokens is the prefix-cache reuse
+        the engine actually skipped (satellite: the gateway reads
+        cached_input_tokens off self-hosted responses at last), and the
+        record itself rides ``usage.aigw_meter``. An empty box (stream
+        ended before its record — e.g. a stop-string cancel races the
+        engine reap) degrades to plain counts."""
+        if not box:
+            return TokenUsage(input_tokens=n_prompt, output_tokens=n_out,
+                              total_tokens=n_prompt + n_out)
+        return TokenUsage(
+            input_tokens=n_prompt, output_tokens=n_out,
+            total_tokens=n_prompt + n_out,
+            cached_input_tokens=int(box.get("prefix_reused", 0) or 0),
+            meter=meter_to_tuple(box),
+        )
+
+    @staticmethod
+    def _merge_meter_boxes(boxes: list[dict]) -> dict[str, Any]:
+        """Field-wise sum of the n>1 fan-out's per-choice MeterRecords:
+        n choices are n engine requests and n records; the response's
+        single usage object carries their total (numeric fields summed,
+        identity fields from the first record)."""
+        merged: dict[str, Any] = {}
+        for b in boxes:
+            if not b:
+                continue
+            for k, v in b.items():
+                if k == "schema":
+                    merged[k] = v
+                elif isinstance(v, bool) or not isinstance(v, (int, float)):
+                    merged.setdefault(k, v)
+                else:
+                    merged[k] = round(merged.get(k, 0) + v, 6)
+        return merged
 
     def _begin_trace(
         self, request: web.Request, rid: str, model: str,
@@ -860,9 +906,9 @@ class TPUServeServer:
                                   str(body.get("model", self.model_name)),
                                   prompt, body, stream, chat)
         try:
-            out, gen_req = self._submit(prompt, body, lp_top_n,
-                                        prefix_hashes, trace, tenant,
-                                        constraint, priority)
+            out, gen_req, meter_box = self._submit(
+                prompt, body, lp_top_n, prefix_hashes, trace, tenant,
+                constraint, priority)
         except EngineOverloadedError as e:
             self._end_trace(trace, "rejected", 0, len(prompt),
                             error=str(e))
@@ -909,11 +955,7 @@ class TPUServeServer:
                 gen_req.cancelled.set()
                 self._end_trace(trace, "cancelled", 0, n_prompt)
                 raise
-            usage = TokenUsage(
-                input_tokens=n_prompt,
-                output_tokens=n_out,
-                total_tokens=n_prompt + n_out,
-            )
+            usage = self._usage_from_meter(n_prompt, n_out, meter_box)
             rm.finish(usage, error_type="engine" if finish == "error"
                       else "")
             self._end_trace(trace, finish, n_out, n_prompt,
@@ -1212,10 +1254,7 @@ class TPUServeServer:
         if tool_stream is not None and tool_stream.completed \
                 and finish == "stop":
             finish = "tool_calls"
-        usage = TokenUsage(
-            input_tokens=n_prompt, output_tokens=n_out,
-            total_tokens=n_prompt + n_out,
-        )
+        usage = self._usage_from_meter(n_prompt, n_out, meter_box)
         rm.finish(usage)
         self._end_trace(trace, finish, n_out, n_prompt)
         if finish == "migrated":
@@ -1261,7 +1300,8 @@ class TPUServeServer:
         """Fan out n engine submissions with per-choice seeds (shared by
         the buffered and streaming n>1 paths — one copy of the seed
         derivation, overload cleanup, and error mapping). Returns the
-        list of (queue, request) pairs, or an error web.Response."""
+        list of (queue, request, meter_box) triples, or an error
+        web.Response."""
         sampling = SamplingParams.from_request(body)
         outs: list = []
         try:
@@ -1277,7 +1317,7 @@ class TPUServeServer:
                                          constraint=constraint,
                                          priority=priority))
         except EngineOverloadedError as e:
-            for _q, req in outs:  # don't orphan already-queued choices
+            for _q, req, _b in outs:  # don't orphan already-queued choices
                 req.cancelled.set()
             return web.Response(
                 status=429,
@@ -1285,14 +1325,14 @@ class TPUServeServer:
                 headers={"retry-after": "1"},
                 content_type="application/json")
         except oai.SchemaError as e:  # unknown adapter → 404, like n=1
-            for _q, req in outs:
+            for _q, req, _b in outs:
                 req.cancelled.set()
             return web.Response(
                 status=404,
                 body=oai.error_body(str(e), type_="model_not_found"),
                 content_type="application/json")
         except ValueError as e:  # bad sampling params → 400, like n=1
-            for _q, req in outs:
+            for _q, req, _b in outs:
                 req.cancelled.set()
             return web.Response(status=400, body=oai.error_body(str(e)),
                                 content_type="application/json")
@@ -1314,12 +1354,20 @@ class TPUServeServer:
         if isinstance(outs, web.Response):
             return outs
         results = await asyncio.gather(
-            *(self._collect(q, stop_strs, lp_top_n) for q, _req in outs)
+            *(self._collect(q, stop_strs, lp_top_n)
+              for q, _req, _b in outs)
         )
+        # single-metering on fan-out (satellite): each choice is one
+        # engine request with exactly one MeterRecord; the response's
+        # one usage object carries their field-wise sum
+        merged_meter = self._merge_meter_boxes([b for _q, _r, b in outs])
         usage = TokenUsage(
             input_tokens=len(prompt),
             output_tokens=sum(r[1] for r in results),
             total_tokens=len(prompt) + sum(r[1] for r in results),
+            cached_input_tokens=int(
+                merged_meter.get("prefix_reused", 0) or 0),
+            meter=meter_to_tuple(merged_meter) if merged_meter else (),
         )
         rid = (f"chatcmpl-{uuid.uuid4().hex[:24]}" if chat
                else f"cmpl-{uuid.uuid4().hex[:24]}")
@@ -1400,7 +1448,7 @@ class TPUServeServer:
                     return
 
         pumps = [asyncio.create_task(pump(i, q))
-                 for i, (q, _req) in enumerate(outs)]
+                 for i, (q, _req, _b) in enumerate(outs)]
         decoders = [StreamingDecoder(self.tokenizer) for _ in range(n)]
         emitted = [""] * n
         counts = [0] * n
@@ -1496,16 +1544,20 @@ class TPUServeServer:
                         await write_chunk(i, "", None,
                                           finish=fins[i] or "stop")
         except (asyncio.CancelledError, ConnectionResetError):
-            for _q, req in outs:
+            for _q, req, _b in outs:
                 req.cancelled.set()
             raise
         finally:
             for p in pumps:
                 p.cancel()
+        merged_meter = self._merge_meter_boxes([b for _q, _r, b in outs])
         usage = TokenUsage(
             input_tokens=len(prompt),
             output_tokens=sum(counts),
             total_tokens=len(prompt) + sum(counts),
+            cached_input_tokens=int(
+                merged_meter.get("prefix_reused", 0) or 0),
+            meter=meter_to_tuple(merged_meter) if merged_meter else (),
         )
         rm.finish(usage)
         if include_usage:
@@ -1845,8 +1897,9 @@ class TPUServeServer:
                                                  text_in)
             lp_top_n = self._check_logprobs(body)
             tenant = str(body.get("user", ""))
-            out, gen_req = self._submit(prompt, body, lp_top_n, hashes,
-                                        tenant=tenant, priority="batch")
+            out, gen_req, meter_box = self._submit(
+                prompt, body, lp_top_n, hashes,
+                tenant=tenant, priority="batch")
         except oai.SchemaError as e:
             return 400, json.loads(oai.error_body(str(e)))
         except ValueError as e:
@@ -1863,9 +1916,10 @@ class TPUServeServer:
         if finish == "error":
             return 500, json.loads(oai.error_body(
                 "engine failure", type_="server_error"))
-        usage = TokenUsage(input_tokens=len(prompt),
-                           output_tokens=n_out,
-                           total_tokens=len(prompt) + n_out)
+        # /v1/batches output lines carry full usage incl. the engine
+        # meter (satellite) — a parked/resumed line's record spans the
+        # whole spliced session including host-spill residency
+        usage = self._usage_from_meter(len(prompt), n_out, meter_box)
         if chat:
             resp = oai.chat_completion_response(
                 model=self.model_name, content=text,
@@ -2254,6 +2308,20 @@ class TPUServeServer:
                 "spec_rung_ups": s.spec_rung_ups,
                 "spec_rung_downs": s.spec_rung_downs,
                 "spec_lookahead_slots": s.spec_lookahead_slots,
+                # engine-truth usage metering (ISSUE 20): cumulative
+                # MeterRecord totals — the gateway's usage ledger
+                # reconciles its per-tenant sums against these counters
+                # token-for-token (they only move inside _meter_emit,
+                # the single record funnel)
+                "meter_records": s.meter_records,
+                "meter_prefill_tokens": s.meter_prefill_tokens,
+                "meter_prefill_padded_tokens": s.meter_prefill_padded_tokens,
+                "meter_prefix_reused_tokens": s.meter_prefix_reused_tokens,
+                "meter_decode_tokens": s.meter_decode_tokens,
+                "meter_spec_drafted": s.meter_spec_drafted,
+                "meter_spec_accepted": s.meter_spec_accepted,
+                "meter_hbm_page_byte_s": s.meter_hbm_page_byte_s,
+                "meter_host_page_byte_s": s.meter_host_page_byte_s,
                 "state_rebuilds": s.state_rebuilds,
                 # XLA compile tracker (obs/xla_events.py): nonzero
                 # growth after warmup = a hot-path compile regression
@@ -2503,11 +2571,17 @@ class TPUServeServer:
 
         loop = asyncio.get_running_loop()
         out_q: asyncio.Queue = asyncio.Queue()
+        meter_box: dict[str, Any] = {}
 
         def emit(tok: int, fin: str | None) -> None:
             loop.call_soon_threadsafe(out_q.put_nowait, (tok, fin))
 
         creq = continuation_request(blob, emit=emit)
+        # single-metering across the splice (satellite): the exporter
+        # emitted NO record at the cut; this continuation's terminal
+        # record — fed by the blob's meter carry — covers the WHOLE
+        # session, so the gateway's spliced stream meters exactly once
+        creq.meter_sink = meter_box.update
         creq.prefix_hashes = self._prefix_hashes_for(creq.prompt)
         entry = self.flight.begin(
             rid, model=self.model_name, prompt_tokens=len(tokens),
@@ -2603,9 +2677,8 @@ class TPUServeServer:
             creq.cancelled.set()
             self._end_trace(creq.trace, "cancelled", n_out, orig_len)
             raise
-        usage = TokenUsage(
-            input_tokens=orig_len, output_tokens=n_prev + n_out,
-            total_tokens=orig_len + n_prev + n_out)
+        usage = self._usage_from_meter(orig_len, n_prev + n_out,
+                                       meter_box)
         rm.finish(usage)
         self._end_trace(creq.trace, finish, n_out, orig_len)
         if finish == "migrated":

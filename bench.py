@@ -1656,12 +1656,15 @@ async def _ttft_hists(s, urls: list[str]) -> dict[str, int]:
 
 
 async def _drive_openloop(s, url: str, model: str, trace: list[dict],
-                          tag: str = "") -> dict:
+                          tag: str = "",
+                          payload_extra: dict | None = None) -> dict:
     """Fire the trace open-loop (each request at its arrival time, not
     gated on completions) as streaming /v1/completions; returns
     client-side outcome counts. Server-side goodput comes from the
     replica histograms — the client numbers here are for shed
-    accounting and sanity, not latency claims."""
+    accounting and sanity, not latency claims. ``payload_extra`` merges
+    extra request fields (the metering leg opts streams into the usage
+    tail frame with it — the meter rides that frame to the gateway)."""
     res = {"completed": 0, "shed": 0, "shed_retry_after": 0,
            "errors": 0, "client_ttft_ms": []}
 
@@ -1676,6 +1679,8 @@ async def _drive_openloop(s, url: str, model: str, trace: list[dict],
             "max_tokens": item["gen"], "temperature": 0.0,
             "stream": True, "logit_bias": {"97": 100},
         }
+        if payload_extra:
+            payload.update(payload_extra)
         headers = ({"x-aigw-tenant": item["tenant"]}
                    if item["tenant"] else {})
         sent = time.perf_counter()
@@ -1710,9 +1715,11 @@ async def _drive_openloop(s, url: str, model: str, trace: list[dict],
     return res
 
 
-def _start_gateway_cfg(backend_extra: dict, endpoints: list[str]):
+def _start_gateway_cfg(backend_extra: dict, endpoints: list[str],
+                       top_extra: dict | None = None):
     """`aigw run` subprocess over a replica POOL with arbitrary backend
-    knobs (picker_mode / slo_ttft_ms / migration …). Returns
+    knobs (picker_mode / slo_ttft_ms / migration …) plus optional
+    TOP-LEVEL config keys (usage block, llm_request_costs). Returns
     (url, stop_fn)."""
     import tempfile
 
@@ -1726,6 +1733,8 @@ def _start_gateway_cfg(backend_extra: dict, endpoints: list[str]):
             **backend_extra)],
         "routes": [{"name": "bench", "rules": [{"backends": ["pool"]}]}],
     }
+    if top_extra:
+        cfg.update(top_extra)
     f = tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False)
     yaml.safe_dump(cfg, f)
     f.close()
@@ -2256,6 +2265,147 @@ def fleet_obs_numbers(reps: int = 3, arrivals: int = 20) -> dict:
         }
         out.update(_fleet_obs_fields(snap, "fleet_obs"))
         return out
+
+    try:
+        return asyncio.run(run())
+    finally:
+        stop_a()
+        stop_b()
+
+
+def metering_numbers(reps: int = 3, arrivals: int = 20) -> dict:
+    """The ``--ab metering`` leg (ISSUE 20): engine-truth usage
+    metering must be ~free. The SAME seeded open-loop trace through
+    two gateway configurations over the same healthy two-replica pool
+    — metering ON (engine MeterRecords journaled into a 2s-window
+    ledger, a CostProgram pricing every request through the new meter
+    variables, /usage polled at 4 Hz throughout) vs metering OFF
+    (``usage: {enabled: false}``, no cost programs). The claim:
+    throughput ratio ≥ 0.95 and ZERO hot XLA compiles from the
+    metering path; the on-leg also cross-checks ledger totals against
+    the replicas' meter_* counters (exact decode-token reconciliation
+    rides tier-1 — here it is a live smoke)."""
+    import aiohttp
+
+    model_name = "bench-metering-tiny"
+    k = int(os.environ.get("AIGW_BENCH_CPU_K", "4"))
+    engine = {"num_pages": 64, "max_queued_requests": 64,
+              "min_prefill_bucket": 32, "warm_decode_buckets": 7}
+    url_a, stop_a = _start_tpuserve_subproc(
+        model_name, CPU_CFG, "", batch=2, k_steps=k, engine=engine,
+        page=16)
+    url_b, stop_b = _start_tpuserve_subproc(
+        model_name, CPU_CFG, "", batch=2, k_steps=k, engine=engine,
+        page=16)
+    addrs = [u[len("http://"):] for u in (url_a, url_b)]
+    #: the on-leg's top-level config: tight ledger windows plus a cost
+    #: expression over the NEW meter variables (decode + padded prefill
+    #: + residency) so the priced path is on the clock, not a stub
+    metering_cfg = {
+        "usage": {"window_s": 2.0, "budgets": {"bench": 1e9}},
+        "llm_request_costs": [{
+            "metadata_key": "tpu_cost",
+            "type": "Expression",
+            "expression": ("decode_tokens * 2 + prefill_padded_tokens"
+                           " + int(kv_page_byte_seconds)"),
+        }],
+    }
+
+    async def usage_loop(s, gw: str, stop_evt: asyncio.Event) -> int:
+        n = 0
+        while not stop_evt.is_set():
+            try:
+                async with s.get(gw + "/usage") as r:
+                    await r.json()
+                async with s.get(gw + "/metrics") as r:
+                    await r.read()
+                n += 1
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                pass
+            await asyncio.sleep(0.25)
+        return n
+
+    async def run() -> dict:
+        await _wait_health(url_a, 1200)
+        await _wait_health(url_b, 1200)
+        timeout = aiohttp.ClientTimeout(total=1200)
+        async with aiohttp.ClientSession(timeout=timeout) as s:
+            for url, tg in ((url_a, "wa"), (url_b, "wb")):
+                await _warm_openloop_shapes(s, url, model_name, tg)
+            xla0 = -1
+            tput: dict[str, list] = {"on": [], "off": []}
+            polls = 0
+            usage_snap: dict = {}
+            for rep in range(reps):
+                if rep == 1:
+                    # compile tripwire anchored AFTER rep 0 (same
+                    # discipline as fleet_obs: the first pair soaks
+                    # arrival-timing-dependent first-use geometry, so
+                    # steady-state isolates compiles METERING adds —
+                    # which must be zero)
+                    xla0 = sum([(await _get_state(s, u)
+                                 ).get("xla_compiles", 0)
+                                for u in (url_a, url_b)])
+                for mode in ("on", "off"):
+                    top = (metering_cfg if mode == "on"
+                           else {"usage": {"enabled": False}})
+                    gw, stop_gw = _start_gateway_cfg({}, addrs,
+                                                     top_extra=top)
+                    try:
+                        await _wait_health(gw, 120)
+                        await asyncio.sleep(1.0)  # first polls land
+                        trace = _poisson_trace(
+                            seed=2000 + rep, n=arrivals, rate_hz=3.0,
+                            gen_lens=(2, 4, 6),
+                            tenants=("bench", "team-b"))
+                        stop_evt = asyncio.Event()
+                        poller = (asyncio.create_task(
+                            usage_loop(s, gw, stop_evt))
+                            if mode == "on" else None)
+                        t0 = time.perf_counter()
+                        # both legs request the usage tail frame so the
+                        # traces stay byte-identical; only the on-leg
+                        # has a ledger to mine the meter into
+                        res = await _drive_openloop(
+                            s, gw, model_name, trace,
+                            tag=f"m{mode[:1]}{rep}",
+                            payload_extra={"stream_options": {
+                                "include_usage": True}})
+                        wall = time.perf_counter() - t0
+                        stop_evt.set()
+                        if poller is not None:
+                            polls += await poller
+                            usage_snap = await (await s.get(
+                                gw + "/usage")).json()
+                        tput[mode].append(res["completed"] / wall)
+                    finally:
+                        stop_gw()
+            xla1 = sum([(await _get_state(s, u)).get("xla_compiles", 0)
+                        for u in (url_a, url_b)])
+            if xla0 < 0:
+                xla0 = xla1  # reps == 1: no steady-state window
+            # live reconciliation smoke for the LAST on-leg gateway:
+            # its ledger's record count must equal the trace size (one
+            # MeterRecord per finished request, exactly once)
+            totals = (usage_snap.get("totals") or {})
+        ratios = [a / b for a, b in zip(tput["on"], tput["off"])
+                  if b > 0]
+        return {
+            "metering_vs_off": round(_median(ratios), 4) if ratios
+            else 0.0,
+            "metering_vs_off_by_rep": [round(r, 4) for r in ratios],
+            "metering_on_spread": round(_spread(tput["on"]), 3),
+            "metering_off_spread": round(_spread(tput["off"]), 3),
+            "metering_hot_compiles": int(xla1 - xla0),
+            "metering_usage_polls": polls,
+            "metering_ledger_records": int(totals.get("records", 0)),
+            "metering_ledger_decode_tokens": int(
+                totals.get("decode_tokens", 0)),
+            "metering_ledger_cost": int(totals.get("cost", 0)),
+            "metering_records_expected": arrivals,
+            "metering_reps": reps,
+            "metering_arrivals": arrivals,
+        }
 
     try:
         return asyncio.run(run())
@@ -3812,6 +3962,11 @@ def run_cpu_ratio() -> dict:
     except Exception as e:
         print(f"batch_tier leg failed: {type(e).__name__}: {e}",
               file=sys.stderr)
+    try:
+        res.update(metering_numbers())
+    except Exception as e:
+        print(f"metering leg failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
     return res
 
 
@@ -4040,13 +4195,26 @@ def main() -> None:
                 "is identical to the uninterrupted run with "
                 "state_rebuilds == 0 (CPU backend; ratios are the "
                 "signal)")
+        elif target == "metering":
+            result = metering_numbers()
+            result["metric"] = (
+                "metering A/B — engine-truth usage metering (ISSUE "
+                "20) must be ~free: the same seeded open-loop trace "
+                "through a gateway journaling every MeterRecord into "
+                "the windowed per-tenant ledger with a meter-variable "
+                "CostProgram pricing each request and a 4Hz /usage + "
+                "/metrics poller running, vs usage disabled; "
+                "throughput ratio ≥ 0.95, zero hot XLA compiles, and "
+                "ledger record count == completed trace requests are "
+                "the claims (CPU backend)")
         else:
             print(json.dumps({"error": f"unknown --ab target {target!r}; "
                               "supported: prefix_cache, spec_decode, "
                               "ragged_prefill, lora, disagg, "
                               "slo_routing, structured, mesh, "
                               "kv_tier, fleet_obs, decode_fused, "
-                              "fleet_ctl, longctx, moe, batch_tier"}))
+                              "fleet_ctl, longctx, moe, batch_tier, "
+                              "metering"}))
             return
         print(json.dumps(result))
         return

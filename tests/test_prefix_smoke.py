@@ -750,3 +750,59 @@ def test_fleet_gauges_map_matches_rollup():
     text = render_fleet_gauges(rollup).decode()
     for _key, name in FLEET_GAUGES:
         assert name in text, f"render_fleet_gauges lost {name}"
+
+
+# engine-truth usage metering surface (ISSUE 20): the tpuserve_meter_*
+# counters are the reconciliation baseline the gateway ledger is audited
+# against — a renamed field silently breaks exact cost attribution
+METER_STATE_FIELDS = manifest.state_fields("meter")
+
+METER_GAUGES = manifest.gauge_names("meter")
+
+
+def test_state_and_metrics_export_meter_gauges(smoke_url):
+    """Every tpuserve_meter_* counter must appear on /state and
+    /metrics, and after at least one completed request the record
+    counter and decode-token counter must have moved — the engine is
+    the metering source of truth, so a dead counter means the whole
+    ledger under-bills silently."""
+
+    async def main():
+        # one chat first so the counters are live, not just defaults
+        async with aiohttp.ClientSession() as s:
+            async with s.post(smoke_url + "/v1/chat/completions", json={
+                "model": "tiny-random",
+                "messages": [{"role": "user",
+                              "content": "smoke meter state " * 3}],
+                "max_tokens": 2,
+            }) as resp:
+                assert resp.status == 200
+        return json.loads(await _get(smoke_url, "/state"))
+
+    state = asyncio.run(main())
+    for field in METER_STATE_FIELDS:
+        assert field in state, f"/state lost {field}"
+    assert state["meter_records"] >= 1
+    assert state["meter_decode_tokens"] >= 1
+    assert state["meter_prefill_tokens"] >= 1
+    assert state["meter_hbm_page_byte_s"] >= 0.0
+    text = asyncio.run(_get(smoke_url, "/metrics")).decode()
+    for gauge in METER_GAUGES:
+        assert gauge in text, f"/metrics lost {gauge}"
+
+
+def test_usage_gauges_map_matches_ledger_snapshot():
+    """Every USAGE_GAUGES key must exist in UsageLedger.snapshot()
+    output — a renamed snapshot key silently drops an aigw_usage_*
+    family from the gateway /metrics exposition (the staticcheck
+    gauge-drift pass enforces the same contract on literal keys)."""
+    from aigw_tpu.gateway.usage import UsageLedger
+    from aigw_tpu.obs.metrics import USAGE_GAUGES, render_usage_gauges
+
+    led = UsageLedger(window_s=60.0)
+    snap = led.snapshot()
+    for key, _name in USAGE_GAUGES:
+        assert key in snap, f"snapshot missing USAGE_GAUGES key {key}"
+    text = render_usage_gauges(snap).decode()
+    for _key, name in USAGE_GAUGES:
+        assert name in text, f"render_usage_gauges lost {name}"

@@ -1,0 +1,760 @@
+"""Engine-truth usage metering (ISSUE 20).
+
+Three layers of the metering plane, audited bottom-up:
+
+- **ledger properties** — window merge is associative AND commutative
+  (residency accumulates in integer micro units, so grouping can never
+  change a total) and JSONL journal replay reconstructs the exact
+  ledger, torn tail included;
+- **single metering** — every stream lifetime produces EXACTLY one
+  MeterRecord: an n>1 fan-out merges per-branch records into one usage,
+  a migrated/spliced session meters once on the importer with
+  ``segments == 2`` and nothing at the cut, and a cancelled batch
+  stream meters once in every cancellation state;
+- **exact reconciliation** — a mixed trace (spec decode, prefix hits,
+  batch tier, n>1, multiple tenants) through a real gateway over an
+  f32 tpuserve pool lands in the ledger with totals equal to the
+  replicas' ``meter_*`` /state counters token for token, and the
+  ``GET /usage`` + fleetwatch ``--tenants`` surfaces render it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import random
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from aigw_tpu.gateway.costs import TokenUsage, meter_to_tuple
+from aigw_tpu.gateway.usage import (
+    FLOAT_FIELDS,
+    INT_FIELDS,
+    UsageLedger,
+    merge_windows,
+    reconciles,
+    window_view,
+    zero_window,
+)
+from aigw_tpu.models import llama
+from aigw_tpu.models.registry import get_model_spec
+from aigw_tpu.tpuserve.engine import (
+    Engine,
+    EngineConfig,
+    GenRequest,
+    continuation_request,
+)
+from aigw_tpu.tpuserve.sampling import SamplingParams
+
+# -- ledger property tests -------------------------------------------------
+
+
+def _rand_window(rng: random.Random) -> dict:
+    w = zero_window(rng.uniform(1, 100), rng.uniform(100, 200))
+    for f in INT_FIELDS:
+        w[f] = rng.randint(0, 10_000)
+    for f in FLOAT_FIELDS:
+        # micro ints, like a folded record: 6-decimal floats land here
+        w[f + "_u"] = rng.randint(0, 10**12)
+    return w
+
+
+def test_merge_windows_associative_commutative():
+    """Property: over random windows, (a+b)+c == a+(b+c) and
+    a+b == b+a on EVERY field — the reason ledger totals cannot depend
+    on arrival order."""
+    rng = random.Random(20)
+    for _ in range(200):
+        a, b, c = (_rand_window(rng) for _ in range(3))
+        assert merge_windows(merge_windows(a, b), c) == \
+            merge_windows(a, merge_windows(b, c))
+        assert merge_windows(a, b) == merge_windows(b, a)
+    # identity
+    w = _rand_window(rng)
+    assert merge_windows(w, zero_window()) == w
+
+
+def _rand_usage(rng: random.Random) -> TokenUsage:
+    decode = rng.randint(1, 50)
+    meter = {
+        "schema": 1,
+        "finish": "stop",
+        "prefill_real": rng.randint(1, 200),
+        "prefill_padded": rng.randint(0, 31),
+        "prefix_reused": rng.randint(0, 64),
+        "decode_tokens": decode,
+        "spec_drafted": rng.randint(0, 20),
+        "spec_accepted": rng.randint(0, 20),
+        "hbm_page_byte_s": round(rng.uniform(0, 5e5), 6),
+        "host_page_byte_s": round(rng.uniform(0, 1e4), 6),
+        "segments": 1,
+        "tenant": "",
+        "priority": "interactive",
+    }
+    return TokenUsage(input_tokens=meter["prefill_real"],
+                      output_tokens=decode - rng.randint(0, 1),
+                      total_tokens=0, meter=meter_to_tuple(meter))
+
+
+def test_fold_order_never_changes_totals():
+    """The same record set folded in any order produces identical
+    totals and per-tenant aggregates — micro-int accumulation makes
+    the residency floats order-proof too. (Window ROTATION follows
+    arrival order, so only the value surfaces are compared.)"""
+    rng = random.Random(21)
+    records = [("t%d" % rng.randint(0, 2), "m", _rand_usage(rng),
+                rng.randint(0, 9), 100.0 + 37.0 * i)
+               for i in range(7)]
+
+    def value_surface(led: UsageLedger):
+        snap = {k: v for k, v in led.snapshot().items()
+                if k != "windows_closed_total"}
+        q = led.query()
+        return (led.totals(), snap,
+                {t: {k: v for k, v in agg.items()
+                     if k not in ("t0", "t1")}
+                 for t, agg in q["tenants"].items()})
+
+    views = []
+    for perm in itertools.islice(itertools.permutations(records), 24):
+        led = UsageLedger(window_s=60.0, retain_windows=256)
+        for tenant, model, usage, cost, ts in perm:
+            led.record(tenant, model, usage, cost=cost, ts=ts)
+        views.append(value_surface(led))
+    assert all(v == views[0] for v in views[1:])
+
+
+def test_journal_replay_is_exact(tmp_path):
+    """Crash-safety: replaying the JSONL journal reconstructs the exact
+    totals, per-tenant aggregates and gauge snapshot; a torn final line
+    (the only artifact a crash mid-append can leave) is ignored; the
+    replayed ledger keeps appending to the same file."""
+    rng = random.Random(22)
+    path = str(tmp_path / "usage.jsonl")
+    led = UsageLedger(path, window_s=5.0, budgets={"t0": 100.0})
+    for i in range(40):
+        led.record(rng.choice(("t0", "t1", "")),
+                   rng.choice(("m-a", "m-b")), _rand_usage(rng),
+                   cost=rng.randint(0, 50), ts=1000.0 + 2.0 * i)
+    led.close()
+
+    back = UsageLedger.replay(path, window_s=5.0,
+                              budgets={"t0": 100.0})
+    assert back.totals() == led.totals()
+    assert back.snapshot() == led.snapshot()
+    assert back.query() == led.query()
+
+    # torn tail: a partial line must not poison anything before it
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write('{"ts": 9999.0, "tenant": "t0", "rec')
+    torn = UsageLedger.replay(path, window_s=5.0,
+                              budgets={"t0": 100.0})
+    assert torn.totals() == led.totals()
+
+    # and the replayed ledger is live: appends reach the same journal
+    n0 = torn.journal_lines
+    torn.record("t0", "m-a", _rand_usage(rng), cost=1, ts=2000.0)
+    torn.close()
+    assert torn.journal_lines == n0 + 1
+
+
+def test_budget_burn_machine():
+    """slomon-style burn: K consecutive over-budget CLOSED windows set
+    the sustained flag; an idle gap clears the streak (sustained means
+    sustained spend, not stale history); under-budget resets."""
+    led = UsageLedger(window_s=1.0, budgets={"t": 10.0},
+                      burn_windows=2)
+    u = TokenUsage(input_tokens=1, output_tokens=1)
+
+    led.record("t", "m", u, cost=15, ts=100.0)   # window 100: over
+    led.record("t", "m", u, cost=15, ts=101.0)   # closes 100
+    b = led.burn("t")
+    assert b["burn_rate"] == 1.5 and b["over_budget"]
+    assert b["over_streak"] == 1 and not b["sustained"]
+
+    led.record("t", "m", u, cost=15, ts=102.0)   # closes 101: streak 2
+    assert led.sustained("t")
+    assert led.snapshot()["burn_sustained_tenants"] == 1
+
+    # idle gap (window 103+104 empty) then another over window: the
+    # streak restarts at 1 — no longer sustained
+    led.record("t", "m", u, cost=15, ts=105.0)   # closes 102, gap 3
+    assert led.burn("t")["over_streak"] == 1
+    assert not led.sustained("t")
+
+    # under-budget window resets outright
+    led.record("t", "m", u, cost=2, ts=106.0)    # closes 105 (over)
+    led.record("t", "m", u, cost=2, ts=107.0)    # closes 106 (under)
+    assert led.burn("t")["over_streak"] == 0
+    assert not led.burn("t")["over_budget"]
+
+    # tenants without a budget never enter the burn machine
+    led.record("x", "m", u, cost=999, ts=108.0)
+    led.record("x", "m", u, cost=999, ts=109.0)
+    assert led.burn("x")["burn_rate"] == -1.0
+
+
+def test_reconcile_slack_is_stop_tokens_per_segment():
+    """The engine's decode_tokens includes a consumed stop token the
+    stream never emitted — mined output_tokens must sit within one stop
+    token per stream segment; anything else is a mismatch."""
+    def usage(out, decode, segments=1):
+        return TokenUsage(
+            output_tokens=out,
+            meter=meter_to_tuple({"decode_tokens": decode,
+                                  "segments": segments}))
+
+    assert reconciles(usage(8, 8))
+    assert reconciles(usage(8, 9))          # consumed stop token
+    assert not reconciles(usage(8, 10))     # over slack
+    assert not reconciles(usage(8, 7))      # engine under client?!
+    assert reconciles(usage(8, 10, segments=2))  # one per segment
+    assert reconciles(TokenUsage(output_tokens=5))  # no meter: vacuous
+
+    led = UsageLedger(window_s=60.0)
+    led.record("", "m", usage(8, 12), ts=1.0)
+    assert led.snapshot()["reconcile_mismatches_total"] == 1
+
+
+# -- single metering: the engine's exactly-once contract (f32 rig) ---------
+
+_PROMPT = [(7 * i + 3) % 500 + 1 for i in range(50)]
+
+
+def _mk_engine(**over) -> Engine:
+    spec = get_model_spec("tiny-random")
+    params = llama.init_params(jax.random.PRNGKey(7), spec.config,
+                               jnp.float32)
+    cfg = dict(max_batch_size=2, max_seq_len=512, page_size=16,
+               min_prefill_bucket=16, decode_steps_per_tick=4,
+               spec_tokens=4, kv_cache_dtype="float32")
+    cfg.update(over)
+    eng = Engine(params, spec.config, EngineConfig(**cfg))
+    eng.start()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def meter_rig():
+    """(A, B) speculating f32 engines — the migrated-splice and
+    cancellation audits share them."""
+    engines = [_mk_engine(), _mk_engine()]
+    try:
+        yield engines
+    finally:
+        for e in engines:
+            e.stop()
+
+
+def _submit(eng, prompt, n, priority="interactive", records=None):
+    toks: list[int] = []
+    done = threading.Event()
+    first = threading.Event()
+
+    def emit(tok, fin):
+        if tok >= 0:
+            toks.append(tok)
+            first.set()
+        if fin is not None:
+            done.set()
+
+    req = GenRequest(prompt=list(prompt), max_tokens=n,
+                     sampling=SamplingParams(temperature=0.0),
+                     emit=emit, priority=priority,
+                     meter_sink=(records.append
+                                 if records is not None else None))
+    eng.submit(req)
+    return req, toks, done, first
+
+
+def _meter_counts(eng):
+    st = eng.stats
+    return {
+        "records": st.meter_records,
+        "prefill": st.meter_prefill_tokens,
+        "decode": st.meter_decode_tokens,
+        "drafted": st.meter_spec_drafted,
+        "accepted": st.meter_spec_accepted,
+    }
+
+
+def test_one_record_per_stream_and_counters_match(meter_rig):
+    """A finished stream emits exactly one MeterRecord; its fields are
+    the truth (prompt length, emitted tokens within stop-token slack,
+    spec attribution) and the /state counters moved by exactly the
+    record's amounts — they only ever move in _meter_emit."""
+    eng = meter_rig[0]
+    c0 = _meter_counts(eng)
+    records: list[dict] = []
+    _, toks, done, _ = _submit(eng, _PROMPT, 12, records=records)
+    assert done.wait(timeout=900)
+    assert len(records) == 1, "stream must meter exactly once"
+    rec = records[0]
+    assert rec["schema"] == 1 and rec["segments"] == 1
+    assert rec["prefill_real"] == len(_PROMPT)
+    assert len(toks) <= rec["decode_tokens"] <= len(toks) + 1
+    assert rec["spec_drafted"] >= rec["spec_accepted"] >= 0
+    assert rec["hbm_page_byte_s"] > 0.0
+    c1 = _meter_counts(eng)
+    assert c1["records"] - c0["records"] == 1
+    assert c1["prefill"] - c0["prefill"] == rec["prefill_real"]
+    assert c1["decode"] - c0["decode"] == rec["decode_tokens"]
+    assert c1["drafted"] - c0["drafted"] == rec["spec_drafted"]
+    assert c1["accepted"] - c0["accepted"] == rec["spec_accepted"]
+
+
+def test_migrated_stream_meters_exactly_once(meter_rig):
+    """A migrated session: the export CUT emits nothing on the source
+    (finish='migrated' is not a billing event — the meter rides the
+    blob), and the importer's terminal record covers the whole spliced
+    stream: segments == 2, decode_tokens == both halves' tokens within
+    stop-token slack."""
+    eng_a, eng_b = meter_rig
+    for _attempt in range(4):
+        a0 = _meter_counts(eng_a)
+        req, toks_a, done_a, first = _submit(eng_a, _PROMPT, 24)
+        assert first.wait(timeout=900)
+        try:
+            out = eng_a.migrate_export(req)
+        except Exception:
+            assert done_a.wait(timeout=900)
+            continue  # raced to completion — retry with a fresh stream
+        break
+    else:
+        raise AssertionError("export never won the race in 4 attempts")
+    assert done_a.wait(timeout=60)
+    assert _meter_counts(eng_a) == a0, \
+        "the migration cut must not emit a MeterRecord"
+    assert out["blob"]["meter"]["segments"] == 1
+    assert out["blob"]["meter"]["decode_tokens"] == len(toks_a)
+
+    b0 = _meter_counts(eng_b)
+    eng_b.migrate_import(out["blob"]["tokens"], out["data"])
+    records: list[dict] = []
+    toks_b: list[int] = []
+    done_b = threading.Event()
+
+    def emit_b(tok, fin):
+        if tok >= 0:
+            toks_b.append(tok)
+        if fin is not None:
+            done_b.set()
+
+    creq = continuation_request(out["blob"], emit=emit_b)
+    creq.meter_sink = records.append
+    eng_b.submit(creq)
+    assert done_b.wait(timeout=900)
+    assert len(records) == 1, "spliced stream must meter exactly once"
+    rec = records[0]
+    assert rec["segments"] == 2
+    total = len(toks_a) + len(toks_b)
+    assert total <= rec["decode_tokens"] <= total + 2
+    # prefix-reused pages are metered in prefix_reused, not re-billed
+    # as prefill — together they cover at least the original prompt
+    assert rec["prefill_real"] + rec["prefix_reused"] >= len(_PROMPT)
+    b1 = _meter_counts(eng_b)
+    assert b1["records"] - b0["records"] == 1
+    assert b1["decode"] - b0["decode"] == rec["decode_tokens"]
+
+
+def test_cancelled_batch_streams_meter_exactly_once(meter_rig):
+    """Cancellation in every state — mid-decode in a slot, waiting in
+    the batch queue, under interactive preemption pressure (possibly
+    parked host-side) — still produces exactly one terminal
+    MeterRecord."""
+    eng = meter_rig[1]
+
+    # (i) cancelled mid-decode in a slot
+    records: list[dict] = []
+    req, toks, done, first = _submit(eng, _PROMPT, 180,
+                                     priority="batch", records=records)
+    assert first.wait(timeout=900)
+    req.cancelled.set()
+    assert done.wait(timeout=60)
+    assert len(records) == 1
+    assert records[0]["finish"] == "cancelled"
+    assert len(toks) <= records[0]["decode_tokens"] <= len(toks) + 1
+
+    # (ii) cancelled while still queued: a zero record, exactly one
+    holders = [_submit(eng, _PROMPT, 180, priority="batch")
+               for _ in range(2)]
+    qrecords: list[dict] = []
+    qreq, _, qdone, _ = _submit(eng, _PROMPT, 32, priority="batch",
+                                records=qrecords)
+    qreq.cancelled.set()
+    for h, _, _, _ in holders:
+        h.cancelled.set()
+    for _, _, d, _ in holders:
+        assert d.wait(timeout=60)
+    assert qdone.wait(timeout=60)
+    assert len(qrecords) == 1
+    assert qrecords[0]["finish"] == "cancelled"
+    assert qrecords[0]["decode_tokens"] == 0
+
+    # (iii) cancelled under interactive pressure (parked or live)
+    records = []
+    req, toks, done, first = _submit(eng, _PROMPT, 180,
+                                     priority="batch", records=records)
+    assert first.wait(timeout=900)
+    burst = [_submit(eng, [900 + i, 3, 5], 8) for i in range(4)]
+    req.cancelled.set()
+    for _, _, d, _ in burst:
+        assert d.wait(timeout=900)
+    assert done.wait(timeout=60)
+    assert len(records) == 1, \
+        "park + cancel must not double-meter the stream"
+    assert records[0]["finish"] == "cancelled"
+    assert records[0]["decode_tokens"] >= len(toks)
+
+
+def test_n_fanout_meters_once_per_branch_engine_side():
+    """n>1 fan-out is n engine streams → n MeterRecords engine-side;
+    the SERVER merges the per-branch boxes into one usage (the e2e
+    below sees one ledger record whose totals are the branch sums)."""
+    eng = _mk_engine(max_batch_size=4, spec_tokens=0)
+    try:
+        c0 = _meter_counts(eng)
+        runs = [_submit(eng, _PROMPT, 6) for _ in range(3)]
+        for _, _, d, _ in runs:
+            assert d.wait(timeout=900)
+        c1 = _meter_counts(eng)
+        assert c1["records"] - c0["records"] == 3
+        emitted = sum(len(t) for _, t, _, _ in runs)
+        assert emitted <= c1["decode"] - c0["decode"] <= emitted + 3
+    finally:
+        eng.stop()
+
+
+# -- exact reconciliation: gateway ledger vs engine counters (e2e) ---------
+
+
+@pytest.fixture(scope="module")
+def meter_pool():
+    """Two real speculating f32 tpuserve replicas in one background
+    loop — the reconciliation pool."""
+    from aiohttp import web
+
+    from aigw_tpu.tpuserve.server import TPUServeServer
+
+    holder: dict = {}
+    started = threading.Event()
+
+    def run():
+        async def main():
+            addrs = []
+            for _ in range(2):
+                server = TPUServeServer(
+                    "tiny-random",
+                    EngineConfig(max_batch_size=2, max_seq_len=512,
+                                 page_size=16, min_prefill_bucket=16,
+                                 decode_steps_per_tick=4, spec_tokens=4,
+                                 kv_cache_dtype="float32",
+                                 batch_slot_frac=0.5))
+                runner = web.AppRunner(server.app)
+                await runner.setup()
+                site = web.TCPSite(runner, "127.0.0.1", 0)
+                await site.start()
+                addrs.append("127.0.0.1:%d"
+                             % site._server.sockets[0].getsockname()[1])
+            holder["addrs"] = addrs
+            holder["loop"] = asyncio.get_running_loop()
+            started.set()
+            await asyncio.Event().wait()
+
+        try:
+            asyncio.run(main())
+        except RuntimeError:
+            pass
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(timeout=300)
+    yield holder
+    holder["loop"].call_soon_threadsafe(holder["loop"].stop)
+
+
+def _meter_state(state: dict) -> dict:
+    return {k: v for k, v in state.items() if k.startswith("meter_")}
+
+
+def _sum_states(a: dict, b: dict) -> dict:
+    return {k: (round(a[k] + b[k], 6) if isinstance(a[k], float)
+                else a[k] + b[k]) for k in a}
+
+
+def test_gateway_ledger_reconciles_with_engine_counters(
+        meter_pool, tmp_path):
+    """The tentpole acceptance: a mixed trace — spec decode on, prefix
+    hits, the batch tier, an n>1 fan-out, two tenants — through a real
+    gateway over the f32 pool; the ledger's totals must equal the
+    replicas' meter_* counter DELTAS token for token (and residency to
+    the 6-decimal contract), with zero reconcile mismatches; /usage
+    serves the same numbers; fleetwatch --tenants renders them."""
+    import aiohttp
+
+    from aigw_tpu.config.model import Config
+    from aigw_tpu.config.runtime import RuntimeConfig
+    from aigw_tpu.gateway.server import run_gateway
+
+    addrs = meter_pool["addrs"]
+    journal = str(tmp_path / "usage.jsonl")
+    cfg = Config.parse({
+        "version": "v1",
+        "backends": [{
+            "name": "pool", "schema": "TPUServe",
+            "endpoints": [{"address": a} for a in addrs],
+            "picker_poll_interval": 0.2,
+        }],
+        "routes": [{"name": "serving", "rules": [
+            {"model_prefixes": ["tiny"], "backends": ["pool"]}]}],
+        "models": ["tiny-random"],
+        "usage": {"window_s": 0.5, "journal": journal,
+                  "budgets": {"acme": 1000000.0}},
+        "llm_request_costs": [
+            {"metadata_key": "tok_cost", "type": "Expression",
+             "expression": "decode_tokens * 3 + prefill_padded_tokens"},
+        ],
+    })
+
+    async def main():
+        server, runner = await run_gateway(RuntimeConfig.build(cfg),
+                                           port=0)
+        site = list(runner.sites)[0]
+        gw = ("http://127.0.0.1:%d"
+              % site._server.sockets[0].getsockname()[1])
+        picker = server._pickers["pool"]
+        try:
+            for _ in range(150):
+                if all(st.healthy for st in picker.state.values()):
+                    break
+                await asyncio.sleep(0.1)
+            assert all(st.healthy for st in picker.state.values())
+            timeout = aiohttp.ClientTimeout(total=900)
+            async with aiohttp.ClientSession(timeout=timeout) as s:
+                st0 = []
+                for a in addrs:
+                    async with s.get(f"http://{a}/state") as r:
+                        st0.append(_meter_state(await r.json()))
+
+                sent = 0
+                # prefix hits: the same long prompt 3x per tenant — the
+                # picker spreads over 2 replicas, so by pigeonhole at
+                # least one send repeats a replica and reuses its pages
+                cached = []
+                for tenant in ("acme", "beta"):
+                    for _ in range(3):
+                        async with s.post(
+                                gw + "/v1/chat/completions",
+                                json={"model": "tiny-random",
+                                      "messages": [{
+                                          "role": "user",
+                                          "content":
+                                              f"{tenant} meter " * 12}],
+                                      "max_tokens": 4,
+                                      "temperature": 0},
+                                headers={"x-aigw-tenant": tenant}) \
+                                as resp:
+                            assert resp.status == 200
+                            body = await resp.json()
+                        sent += 1
+                        assert body["usage"]["completion_tokens"] >= 1
+                        details = body["usage"].get(
+                            "prompt_tokens_details") or {}
+                        cached.append(details.get("cached_tokens", 0))
+                # satellite: engine-truth cached tokens on the OpenAI
+                # surface — some repeated prompt reused prefix pages
+                assert max(cached) > 0, cached
+
+                # n>1 fan-out: ONE ledger record, branch sums
+                async with s.post(
+                        gw + "/v1/completions",
+                        json={"model": "tiny-random", "prompt": "fan",
+                              "n": 2, "max_tokens": 4,
+                              "temperature": 0},
+                        headers={"x-aigw-tenant": "acme"}) as resp:
+                    assert resp.status == 200
+                    fan = await resp.json()
+                assert len(fan["choices"]) == 2
+                meter = dict((fan["usage"].get("aigw_meter") or {}))
+                assert meter.get("segments") == 2
+                sent += 1
+
+                # batch tier: priority header rides the offline class
+                async with s.post(
+                        gw + "/v1/completions",
+                        json={"model": "tiny-random", "prompt": "bt",
+                              "max_tokens": 3, "temperature": 0},
+                        headers={"x-aigw-tenant": "beta",
+                                 "x-aigw-priority": "batch"}) as resp:
+                    assert resp.status == 200
+                    await resp.read()
+                sent += 1
+
+                # one streamed chat (usage rides the stream tail)
+                async with s.post(
+                        gw + "/v1/chat/completions",
+                        json={"model": "tiny-random",
+                              "messages": [{"role": "user",
+                                            "content": "stream me"}],
+                              "max_tokens": 4, "temperature": 0,
+                              "stream": True,
+                              "stream_options": {
+                                  "include_usage": True}},
+                        headers={"x-aigw-tenant": "acme"}) as resp:
+                    assert resp.status == 200
+                    async for _line in resp.content:
+                        pass
+                sent += 1
+
+                led = server.usage_ledger
+                assert led is not None
+                for _ in range(100):
+                    if led.totals()["records"] >= sent:
+                        break
+                    await asyncio.sleep(0.1)
+                totals = led.totals()
+                assert totals["records"] == sent
+                assert led.snapshot()["reconcile_mismatches_total"] == 0
+
+                st1 = []
+                for a in addrs:
+                    async with s.get(f"http://{a}/state") as r:
+                        st1.append(_meter_state(await r.json()))
+                delta = _sum_states(
+                    {k: (round(st1[0][k] - st0[0][k], 6)
+                         if isinstance(st1[0][k], float)
+                         else st1[0][k] - st0[0][k]) for k in st1[0]},
+                    {k: (round(st1[1][k] - st0[1][k], 6)
+                         if isinstance(st1[1][k], float)
+                         else st1[1][k] - st0[1][k]) for k in st1[1]})
+
+                # token-for-token: the ledger IS the engine truth
+                assert totals["prefill_tokens"] == \
+                    delta["meter_prefill_tokens"]
+                assert totals["prefill_padded_tokens"] == \
+                    delta["meter_prefill_padded_tokens"]
+                assert totals["prefix_reused_tokens"] == \
+                    delta["meter_prefix_reused_tokens"]
+                assert totals["decode_tokens"] == \
+                    delta["meter_decode_tokens"]
+                assert totals["spec_drafted"] == \
+                    delta["meter_spec_drafted"]
+                assert totals["spec_accepted"] == \
+                    delta["meter_spec_accepted"]
+                # the n>1 fan-out is 2 engine records in 1 ledger line
+                assert delta["meter_records"] == sent + 1
+                # residency: micro-int ledger totals equal the engine's
+                # 6-decimal accumulators at the 6-decimal contract
+                assert totals["hbm_page_byte_s"] == pytest.approx(
+                    delta["meter_hbm_page_byte_s"], abs=2e-6)
+                assert totals["spec_drafted"] > 0, "spec never ran"
+                assert totals["prefix_reused_tokens"] > 0, \
+                    "prefix cache never hit"
+
+                # the priced path: decision-ring cost stamping + ledger
+                assert totals["cost"] == totals["decode_tokens"] * 3 \
+                    + totals["prefill_padded_tokens"]
+
+                # GET /usage serves the same totals + tenant views
+                async with s.get(gw + "/usage") as resp:
+                    assert resp.status == 200
+                    payload = await resp.json()
+                assert payload["totals"] == totals
+                assert set(payload["tenants"]) == {"acme", "beta"}
+                acme = payload["tenants"]["acme"]
+                assert acme["budget"]["budget"] == 1000000.0
+                async with s.get(gw + "/usage?tenant=acme") as resp:
+                    only = await resp.json()
+                assert set(only["tenants"]) == {"acme"}
+                async with s.get(gw + "/usage?export=jsonl") as resp:
+                    assert resp.status == 200
+                    assert "jsonl" in resp.content_type
+                    lines = [json.loads(x) for x in
+                             (await resp.read()).decode().splitlines()]
+                assert lines, "jsonl export empty"
+
+                # aigw_usage_* gauges on the gateway /metrics
+                mets = (await (await s.get(gw + "/metrics")).read()
+                        ).decode()
+                assert ("aigw_usage_records_total %d" % sent) in mets
+                assert "aigw_usage_decode_tokens_total" in mets
+
+                # the journal is crash-safe truth: replay == live
+                back = UsageLedger.replay(journal, window_s=0.5)
+                assert back.totals() == totals
+
+                # satellite: fleetwatch --tenants --once renders it
+                from tools.fleetwatch import main as fw_main
+                import io
+                import contextlib
+
+                buf = io.StringIO()
+                with contextlib.redirect_stdout(buf):
+                    rc = await asyncio.to_thread(
+                        fw_main, [gw, "--tenants", "--once"])
+                assert rc == 0
+                out = buf.getvalue()
+                assert "TENANT" in out and "acme" in out
+                assert "totals: %d reqs" % sent in out
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(main())
+
+
+def test_batches_output_lines_carry_usage(meter_pool):
+    """Satellite: /v1/batches output lines carry usage with
+    prompt/completion token counts and the engine meter attached."""
+    import time as _time
+
+    import aiohttp
+
+    a = meter_pool["addrs"][0]
+
+    async def main():
+        timeout = aiohttp.ClientTimeout(total=900)
+        async with aiohttp.ClientSession(timeout=timeout) as s:
+            raw = ("\n".join(
+                json.dumps({"custom_id": f"u{i}", "method": "POST",
+                            "url": "/v1/completions",
+                            "body": {"model": "tiny-random",
+                                     "prompt": f"usage line {i}",
+                                     "max_tokens": 3,
+                                     "temperature": 0.0}})
+                for i in range(2)) + "\n").encode()
+            async with s.post(f"http://{a}/v1/files", data=raw) as r:
+                f = await r.json()
+            async with s.post(f"http://{a}/v1/batches", json={
+                    "input_file_id": f["id"],
+                    "endpoint": "/v1/completions"}) as r:
+                assert r.status == 200
+                b = await r.json()
+            deadline = _time.monotonic() + 600
+            while _time.monotonic() < deadline:
+                async with s.get(f"http://{a}/v1/batches/{b['id']}") \
+                        as r:
+                    b = await r.json()
+                if b["status"] == "completed":
+                    break
+                await asyncio.sleep(0.1)
+            assert b["status"] == "completed"
+            async with s.get(
+                    f"http://{a}/v1/files/{b['output_file_id']}"
+                    "/content") as r:
+                recs = [json.loads(x) for x in
+                        (await r.read()).decode().splitlines()]
+            assert len(recs) == 2
+            for rec in recs:
+                usage = rec["response"]["body"]["usage"]
+                assert usage["prompt_tokens"] >= 1
+                assert usage["completion_tokens"] >= 1
+                meter = usage.get("aigw_meter")
+                assert meter and meter["decode_tokens"] >= \
+                    usage["completion_tokens"]
+                assert meter["priority"] == "batch"
+
+    asyncio.run(main())

@@ -7,9 +7,15 @@ SLO burn rate, and telemetry staleness — the terminal companion for
 bench runs and the MULTICHIP dryrun, where tailing N replica ``/state``
 endpoints by hand stops scaling at N=2.
 
+``--tenants`` switches to the usage-metering view (ISSUE 20): one row
+per tenant rendered from ``GET /usage`` — tokens, measured decode
+tok/s over the ledger span, KV residency, priced cost, and the budget
+burn machine (burn rate + the K-consecutive-windows sustained flag).
+
 Usage:
     python tools/fleetwatch.py http://127.0.0.1:1975 [--interval 2]
     python tools/fleetwatch.py http://127.0.0.1:1975 --once
+    python tools/fleetwatch.py http://127.0.0.1:1975 --tenants --once
 
 stdlib-only (urllib) on purpose: it must run anywhere the bench runs,
 including bare containers without aiohttp installed for the client.
@@ -31,6 +37,12 @@ _COLUMNS = ("REPLICA", "HEALTH", "SLOTS", "QUEUE", "BQUEUE", "BACT",
 
 def fetch(url: str, timeout: float = 5.0) -> dict:
     with urllib.request.urlopen(url.rstrip("/") + "/fleet/state",
+                                timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def fetch_usage(url: str, timeout: float = 5.0) -> dict:
+    with urllib.request.urlopen(url.rstrip("/") + "/usage",
                                 timeout=timeout) as resp:
         return json.loads(resp.read().decode("utf-8"))
 
@@ -126,6 +138,62 @@ def render_table(snapshot: dict) -> str:
     return "\n".join(lines)
 
 
+_TENANT_COLUMNS = ("TENANT", "REQS", "PREFILL", "REUSED", "DECODE",
+                   "TOK/S", "HBM PB·S", "HOST PB·S", "COST", "BURN",
+                   "BUDGET")
+
+
+def render_tenants_table(payload: dict) -> str:
+    """One ``GET /usage`` payload → the per-tenant table string (pure
+    function — the tier-1 smoke drives it against a live gateway).
+
+    TOK/S is measured decode throughput over each tenant's ledger span
+    (first to last record); BURN is the budget burn machine's latest
+    closed-window rate, flagged ``!OVER`` past 1.0 and ``!SUSTAINED``
+    after K consecutive over-budget windows."""
+    lines: list[str] = []
+    widths = [16, 6, 9, 8, 8, 8, 10, 10, 8, 10, 8]
+
+    def row(cells) -> str:
+        return "  ".join(str(c).ljust(w)[:max(w, len(str(c)))]
+                         for c, w in zip(cells, widths)).rstrip()
+
+    lines.append(f"usage window {payload.get('window_s', 0)}s, "
+                 f"{payload.get('retained_windows', 0)} closed "
+                 "window(s) retained")
+    lines.append(row(_TENANT_COLUMNS))
+    for tenant, t in sorted((payload.get("tenants") or {}).items()):
+        span = float(t.get("t1", 0.0)) - float(t.get("t0", 0.0))
+        decode = int(t.get("decode_tokens", 0))
+        tok_s = decode / span if span > 0 else -1.0
+        budget = t.get("budget") or {}
+        burn = budget.get("burn_rate", -1.0)
+        flag = ("!SUSTAINED" if budget.get("sustained")
+                else "!OVER" if budget.get("over_budget") else "")
+        lines.append(row((
+            tenant or "(anonymous)",
+            t.get("records", 0),
+            t.get("prefill_tokens", 0),
+            t.get("prefix_reused_tokens", 0),
+            decode,
+            _fmt(round(tok_s, 2) if tok_s >= 0 else -1),
+            _fmt(t.get("hbm_page_byte_s")),
+            _fmt(t.get("host_page_byte_s")),
+            t.get("cost", 0),
+            (_fmt(burn) + flag) if flag else _fmt(burn),
+            _fmt(budget.get("budget") or None),
+        )))
+    tot = payload.get("totals") or {}
+    lines.append(
+        f"  totals: {tot.get('records', 0)} reqs"
+        f" | prefill {tot.get('prefill_tokens', 0)}"
+        f" (+{tot.get('prefill_padded_tokens', 0)} padded geometry,"
+        f" {tot.get('prefix_reused_tokens', 0)} cache-reused)"
+        f" | decode {tot.get('decode_tokens', 0)}"
+        f" | cost {tot.get('cost', 0)}")
+    return "\n".join(lines)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("url", help="gateway base url, e.g. "
@@ -134,17 +202,22 @@ def main(argv: list[str] | None = None) -> int:
                     help="refresh seconds (default 2)")
     ap.add_argument("--once", action="store_true",
                     help="print one snapshot and exit (scripts, tests)")
+    ap.add_argument("--tenants", action="store_true",
+                    help="per-tenant usage/cost/burn view from "
+                    "GET /usage instead of the replica table")
     args = ap.parse_args(argv)
     while True:
         try:
-            snap = fetch(args.url)
+            if args.tenants:
+                out = render_tenants_table(fetch_usage(args.url))
+            else:
+                out = render_table(fetch(args.url))
         except (urllib.error.URLError, OSError, ValueError) as e:
             print(f"fleetwatch: {args.url}: {e}", file=sys.stderr)
             if args.once:
                 return 1
             time.sleep(args.interval)
             continue
-        out = render_table(snap)
         if args.once:
             print(out)
             return 0
